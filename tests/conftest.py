@@ -1,0 +1,29 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding is validated
+without TPU hardware, as the reference's distributed paths are tested
+in-process — SURVEY.md §4). These env vars must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mem_env():
+    from toplingdb_tpu.env import MemEnv
+
+    return MemEnv()
+
+
+@pytest.fixture
+def tmp_db_path(tmp_path):
+    return str(tmp_path / "db")

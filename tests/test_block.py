@@ -1,0 +1,97 @@
+import random
+
+import pytest
+
+from toplingdb_tpu.table.block import BlockBuilder, BlockIter
+
+
+def bytewise(a, b):
+    return (a > b) - (a < b)
+
+
+def build(entries, restart_interval=4):
+    b = BlockBuilder(restart_interval=restart_interval)
+    for k, v in entries:
+        b.add(k, v)
+    return b.finish()
+
+
+def test_roundtrip_sequential():
+    entries = [(f"key{i:05d}".encode(), f"val{i}".encode()) for i in range(100)]
+    data = build(entries)
+    it = BlockIter(data, bytewise)
+    it.seek_to_first()
+    assert list(it.entries()) == entries
+
+
+def test_prefix_compression_shrinks():
+    entries = [(f"commonprefix{i:05d}".encode(), b"v") for i in range(64)]
+    data = build(entries, restart_interval=16)
+    raw = sum(len(k) + len(v) for k, v in entries)
+    assert len(data) < raw  # shared prefixes elided
+
+
+def test_seek():
+    entries = [(f"k{i:04d}".encode(), str(i).encode()) for i in range(0, 200, 2)]
+    data = build(entries)
+    it = BlockIter(data, bytewise)
+    # Exact hit.
+    it.seek(b"k0100")
+    assert it.valid() and it.key() == b"k0100"
+    # Between keys: lands on next.
+    it.seek(b"k0101")
+    assert it.valid() and it.key() == b"k0102"
+    # Before first.
+    it.seek(b"")
+    assert it.valid() and it.key() == b"k0000"
+    # After last.
+    it.seek(b"k9999")
+    assert not it.valid()
+
+
+def test_seek_for_prev():
+    entries = [(f"k{i:04d}".encode(), b"v") for i in range(0, 100, 10)]
+    it = BlockIter(build(entries), bytewise)
+    it.seek_for_prev(b"k0055")
+    assert it.valid() and it.key() == b"k0050"
+    it.seek_for_prev(b"k0050")
+    assert it.valid() and it.key() == b"k0050"
+    it.seek_for_prev(b"k")
+    assert not it.valid()
+
+
+def test_prev_walk():
+    entries = [(f"k{i:03d}".encode(), str(i).encode()) for i in range(37)]
+    it = BlockIter(build(entries, restart_interval=5), bytewise)
+    it.seek_to_last()
+    got = []
+    while it.valid():
+        got.append((it.key(), it.value()))
+        it.prev()
+    assert got == list(reversed(entries))
+
+
+def test_random_seeks_match_sorted_list():
+    rng = random.Random(7)
+    keys = sorted({rng.randbytes(rng.randint(1, 12)) for _ in range(300)})
+    entries = [(k, k[::-1]) for k in keys]
+    it = BlockIter(build(entries, restart_interval=7), bytewise)
+    for _ in range(200):
+        t = rng.randbytes(rng.randint(1, 12))
+        it.seek(t)
+        expect = next((k for k in keys if k >= t), None)
+        if expect is None:
+            assert not it.valid()
+        else:
+            assert it.valid() and it.key() == expect
+
+
+def test_empty_block():
+    data = BlockBuilder().finish()
+    it = BlockIter(data, bytewise)
+    it.seek_to_first()
+    assert not it.valid()
+    it.seek(b"x")
+    assert not it.valid()
+    it.seek_to_last()
+    assert not it.valid()

@@ -1,0 +1,82 @@
+import pytest
+
+from toplingdb_tpu.utils import coding, crc32c
+from toplingdb_tpu.utils.status import Corruption
+
+
+def test_fixed_roundtrip():
+    assert coding.decode_fixed32(coding.encode_fixed32(0xDEADBEEF)) == 0xDEADBEEF
+    assert coding.decode_fixed64(coding.encode_fixed64(2**56 + 7)) == 2**56 + 7
+    assert coding.encode_fixed32(1) == b"\x01\x00\x00\x00"
+
+
+@pytest.mark.parametrize(
+    "v", [0, 1, 127, 128, 300, 2**21, 2**28 - 1, 2**32 - 1, 2**56, 2**64 - 1]
+)
+def test_varint_roundtrip(v):
+    enc = coding.encode_varint64(v)
+    dec, off = coding.decode_varint64(enc)
+    assert dec == v
+    assert off == len(enc)
+    assert coding.varint_length(v) == len(enc)
+
+
+def test_varint_truncated():
+    with pytest.raises(Corruption):
+        coding.decode_varint64(b"\x80")
+
+
+def test_length_prefixed():
+    out = bytearray()
+    coding.put_length_prefixed_slice(out, b"hello")
+    coding.put_length_prefixed_slice(out, b"")
+    s1, off = coding.get_length_prefixed_slice(out, 0)
+    s2, off = coding.get_length_prefixed_slice(out, off)
+    assert s1 == b"hello" and s2 == b"" and off == len(out)
+
+
+# CRC32C known-answer tests (Castagnoli standard vectors).
+def test_crc32c_vectors():
+    assert crc32c.value(b"") == 0
+    assert crc32c.value(b"123456789") == 0xE3069283
+    assert crc32c.value(bytes(32)) == 0x8A9136AA
+    assert crc32c.value(bytes([0xFF] * 32)) == 0x62A8AB43
+
+
+def test_crc32c_extend_composes():
+    data = b"hello world, this is a crc composition test"
+    whole = crc32c.value(data)
+    part = crc32c.extend(crc32c.value(data[:10]), data[10:])
+    assert whole == part
+
+
+def test_crc_mask_roundtrip():
+    c = crc32c.value(b"foo")
+    assert crc32c.mask(c) != c
+    assert crc32c.unmask(crc32c.mask(c)) == c
+
+
+def test_native_matches_python_fallback():
+    from toplingdb_tpu import native
+    from toplingdb_tpu.utils.crc32c import _table
+
+    if native.lib() is None:
+        pytest.skip("native lib unavailable")
+    data = bytes(range(256)) * 7 + b"tail"
+    t = _table()
+    c = 0xFFFFFFFF
+    for b in data:
+        c = t[(c ^ b) & 0xFF] ^ (c >> 8)
+    py = (c ^ 0xFFFFFFFF) & 0xFFFFFFFF
+    assert crc32c.value(data) == py
+
+
+def test_xxh64_known_answers():
+    # Public xxh64 test vectors.
+    assert crc32c.xxh64(b"", 0) == 0xEF46DB3751D8E999
+    assert crc32c.xxh64(b"a", 0) == 0xD24EC4F1A98C6E5B
+    assert crc32c.xxh64(b"abc", 0) == 0x44BC2CF5AD770999
+    assert (
+        crc32c.xxh64(b"Nobody inspects the spammish repetition", 0)
+        == 0xFBCEA83C8A378BF1
+    )

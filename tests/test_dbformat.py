@@ -1,0 +1,59 @@
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.dbformat import (
+    BYTEWISE,
+    InternalKeyComparator,
+    LookupKey,
+    ParsedInternalKey,
+    ValueType,
+    make_internal_key,
+    split_internal_key,
+)
+
+
+def test_pack_roundtrip():
+    for seq in (0, 1, 12345, dbformat.MAX_SEQUENCE_NUMBER):
+        for t in (ValueType.VALUE, ValueType.DELETION, ValueType.MERGE):
+            ik = make_internal_key(b"key", seq, t)
+            uk, s, tt = split_internal_key(ik)
+            assert (uk, s, tt) == (b"key", seq, t)
+
+
+def test_internal_key_ordering():
+    icmp = InternalKeyComparator(BYTEWISE)
+    # Same user key: higher seqno sorts FIRST.
+    a = make_internal_key(b"k", 100, ValueType.VALUE)
+    b = make_internal_key(b"k", 99, ValueType.VALUE)
+    assert icmp.compare(a, b) < 0
+    # Different user keys: bytewise order dominates.
+    c = make_internal_key(b"ka", 1, ValueType.VALUE)
+    assert icmp.compare(a, c) < 0
+    # Same (key, seqno): higher type sorts first.
+    d = make_internal_key(b"k", 100, ValueType.MERGE)
+    assert icmp.compare(d, a) < 0
+
+
+def test_lookup_key_sees_older_versions():
+    icmp = InternalKeyComparator(BYTEWISE)
+    lk = LookupKey(b"k", 50)
+    # Seeking to lk.internal_key must land at-or-after entries with seq <= 50.
+    newer = make_internal_key(b"k", 51, ValueType.VALUE)
+    visible = make_internal_key(b"k", 50, ValueType.VALUE)
+    older = make_internal_key(b"k", 10, ValueType.VALUE)
+    assert icmp.compare(newer, lk.internal_key) < 0
+    assert icmp.compare(lk.internal_key, visible) < 0  # seek key sorts before
+    assert icmp.compare(visible, older) < 0
+
+
+def test_shortest_separator():
+    icmp = InternalKeyComparator(BYTEWISE)
+    a = make_internal_key(b"abcdefg", 5, ValueType.VALUE)
+    b = make_internal_key(b"abzzzzz", 3, ValueType.VALUE)
+    sep = icmp.find_shortest_separator(a, b)
+    assert icmp.compare(a, sep) <= 0
+    assert icmp.compare(sep, b) < 0
+    assert len(sep) <= len(a)
+
+
+def test_parsed_internal_key():
+    p = ParsedInternalKey(b"u", 7, ValueType.MERGE)
+    assert ParsedInternalKey.parse(p.encode()) == p

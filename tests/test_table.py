@@ -1,0 +1,130 @@
+import random
+
+import pytest
+
+from toplingdb_tpu.db.dbformat import (
+    BYTEWISE,
+    InternalKeyComparator,
+    ValueType,
+    make_internal_key,
+)
+from toplingdb_tpu.env import MemEnv
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+from toplingdb_tpu.table.reader import TableReader
+from toplingdb_tpu.utils.status import Corruption
+
+ICMP = InternalKeyComparator(BYTEWISE)
+
+
+def build_table(env, path, entries, opts=None, tombstones=()):
+    w = env.new_writable_file(path)
+    b = TableBuilder(w, ICMP, opts)
+    for k, v in entries:
+        b.add(k, v)
+    for begin, end in tombstones:
+        b.add_tombstone(begin, end)
+    props = b.finish()
+    w.close()
+    return props
+
+
+def make_entries(n, vlen=20, seed=3):
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        ik = make_internal_key(f"user{i:06d}".encode(), n - i, ValueType.VALUE)
+        out.append((ik, rng.randbytes(vlen)))
+    return out
+
+
+@pytest.mark.parametrize("compression", [fmt.NO_COMPRESSION, fmt.ZLIB_COMPRESSION])
+def test_table_roundtrip(compression):
+    env = MemEnv()
+    entries = make_entries(500)
+    opts = TableOptions(block_size=512, compression=compression)
+    props = build_table(env, "/t.sst", entries, opts)
+    assert props.num_entries == 500
+    assert props.num_data_blocks > 1
+
+    r = TableReader(env.new_random_access_file("/t.sst"), ICMP, opts)
+    assert r.properties.num_entries == 500
+    it = r.new_iterator()
+    it.seek_to_first()
+    assert list(it.entries()) == entries
+
+
+def test_table_seek_and_bounds():
+    env = MemEnv()
+    entries = make_entries(300)
+    build_table(env, "/t.sst", entries, TableOptions(block_size=256))
+    r = TableReader(env.new_random_access_file("/t.sst"), ICMP)
+    it = r.new_iterator()
+    # Seek to a key in the middle (user key order).
+    target = make_internal_key(b"user000150", 2**56 - 1, 0x7F)
+    it.seek(target)
+    assert it.valid()
+    assert it.key() == entries[150][0]
+    # Past the end.
+    it.seek(make_internal_key(b"zzzz", 0, 0))
+    assert not it.valid()
+    it.seek_to_last()
+    assert it.key() == entries[-1][0]
+
+
+def test_filter_blocks_negative_lookups():
+    env = MemEnv()
+    entries = make_entries(200)
+    build_table(env, "/t.sst", entries)
+    r = TableReader(env.new_random_access_file("/t.sst"), ICMP)
+    for i in range(0, 200, 10):
+        assert r.key_may_match(f"user{i:06d}".encode())
+    misses = sum(
+        1 for i in range(2000) if r.key_may_match(f"absent{i:06d}".encode())
+    )
+    assert misses < 100  # ~10 bits/key bloom: <<5% false positives
+
+
+def test_checksum_detects_corruption():
+    env = MemEnv()
+    entries = make_entries(100)
+    build_table(env, "/t.sst", entries)
+    # Flip one byte in the middle of the file.
+    st = env._files["/t.sst"]
+    st.data[50] ^= 0xFF
+    with pytest.raises(Corruption):
+        r = TableReader(env.new_random_access_file("/t.sst"), ICMP)
+        it = r.new_iterator()
+        it.seek_to_first()
+        list(it.entries())
+
+
+def test_range_del_block():
+    env = MemEnv()
+    entries = make_entries(50)
+    begin = make_internal_key(b"user000010", 1000, ValueType.RANGE_DELETION)
+    build_table(env, "/t.sst", entries, tombstones=[(begin, b"user000020")])
+    r = TableReader(env.new_random_access_file("/t.sst"), ICMP)
+    assert r.properties.num_range_deletions == 1
+    tombs = r.range_del_entries()
+    assert tombs == [(begin, b"user000020")]
+
+
+def test_anchors_and_offsets():
+    env = MemEnv()
+    entries = make_entries(1000)
+    build_table(env, "/t.sst", entries, TableOptions(block_size=256))
+    r = TableReader(env.new_random_access_file("/t.sst"), ICMP)
+    anchors = r.anchors(8)
+    assert 1 <= len(anchors) <= 8
+    offs = [r.approximate_offset_of(a) for a in anchors]
+    assert offs == sorted(offs)
+
+
+def test_empty_table():
+    env = MemEnv()
+    build_table(env, "/t.sst", [])
+    r = TableReader(env.new_random_access_file("/t.sst"), ICMP)
+    it = r.new_iterator()
+    it.seek_to_first()
+    assert not it.valid()

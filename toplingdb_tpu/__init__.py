@@ -1,0 +1,28 @@
+"""toplingdb_tpu: a TPU-native LSM key-value storage framework.
+
+A brand-new framework with the capabilities of ToplingDB (a RocksDB-fork LSM
+engine, reference at /root/reference): WAL + memtable write path, versioned SST
+levels with MANIFEST metadata, MVCC reads/iterators/snapshots, leveled/universal
+compaction — with the compute-heavy compaction data plane (k-way merge, MVCC
+garbage collection, merge-operand folding, SST block encoding) re-designed
+TPU-first as JAX/XLA kernels over columnar key/value blocks, fanned out one
+compaction job per TPU chip through a serializable distributed-compaction
+boundary (the analogue of ToplingDB's dcompact, reference
+db/compaction/compaction_executor.h:160-178).
+
+Package layout:
+  utils/      coding, crc32c, status, options, config registry, statistics
+  db/         DB core: WAL, memtable, versions/MANIFEST, write path, iterators
+  table/      SST formats: block-based builder/reader, table cache
+  models/     pluggable format "model families" (table factories, memtable reps)
+  compaction/ pickers, compaction iterator (MVCC GC), executor boundary
+  ops/        JAX/Pallas kernels: sort-merge, visibility masking, encode
+  parallel/   device-mesh fan-out (one job per chip; in-job range sharding)
+  env/        filesystem/env abstraction (posix, in-memory)
+  tools/      db_bench-style driver, sst_dump, ldb-style admin
+  native/     C++ components (crc32c/xxhash, skiplist memtable) via ctypes
+"""
+
+__version__ = "0.1.0"
+
+from toplingdb_tpu.utils.status import Status, NotFound, Corruption, InvalidArgument  # noqa: F401

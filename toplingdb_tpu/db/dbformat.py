@@ -1,0 +1,204 @@
+"""Internal key format and comparators.
+
+An internal key is `user_key + packed(seqno, type)` where the trailer is 8
+bytes: `(seqno << 8) | type`, stored little-endian fixed64 — same layout and
+semantics as the reference (db/dbformat.h:43-57,371 in /root/reference).
+Ordering: user keys ascending by the user comparator, then seqno DESCENDING,
+then type descending — so the newest version of a key sorts first. Because the
+trailer is compared as a big integer descending, decreasing (seqno,type) means
+increasing encoded trailer is *later*; we compare trailers reversed.
+
+kMaxSequenceNumber is 2^56-1; seqno 0 is reserved to mean "visible to
+everyone" (assigned to keys compacted to the bottommost level with no
+snapshot in the way).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass
+
+from toplingdb_tpu.utils.status import Corruption
+
+_U64 = struct.Struct("<Q")
+
+MAX_SEQUENCE_NUMBER = (1 << 56) - 1
+
+
+class ValueType(enum.IntEnum):
+    """Record types in the keyspace (reference db/dbformat.h:43-57)."""
+
+    DELETION = 0x0
+    VALUE = 0x1
+    MERGE = 0x2
+    LOG_DATA = 0x3          # WAL-only annotation, never in the keyspace
+    SINGLE_DELETION = 0x7
+    RANGE_DELETION = 0xF    # DeleteRange tombstone
+    BLOB_INDEX = 0x11       # value is a pointer into a blob file
+    MAX = 0x7F
+
+
+# Highest type value used when constructing "seek" keys: for a given
+# (user_key, seqno), the largest type sorts first in internal order.
+VALUE_TYPE_FOR_SEEK = ValueType.MAX
+
+
+def pack_seq_type(seq: int, t: ValueType | int) -> int:
+    assert 0 <= seq <= MAX_SEQUENCE_NUMBER, seq
+    return (seq << 8) | int(t)
+
+
+def unpack_seq_type(packed: int) -> tuple[int, int]:
+    return packed >> 8, packed & 0xFF
+
+
+def make_internal_key(user_key: bytes, seq: int, t: ValueType | int) -> bytes:
+    return user_key + _U64.pack(pack_seq_type(seq, t))
+
+
+def split_internal_key(ikey: bytes) -> tuple[bytes, int, int]:
+    """Returns (user_key, seqno, value_type)."""
+    if len(ikey) < 8:
+        raise Corruption(f"internal key too short: {len(ikey)}")
+    seq, t = unpack_seq_type(_U64.unpack_from(ikey, len(ikey) - 8)[0])
+    return ikey[:-8], seq, t
+
+
+def extract_user_key(ikey: bytes) -> bytes:
+    if len(ikey) < 8:
+        raise Corruption(f"internal key too short: {len(ikey)}")
+    return ikey[:-8]
+
+
+def extract_seqno(ikey: bytes) -> int:
+    return _U64.unpack_from(ikey, len(ikey) - 8)[0] >> 8
+
+
+def extract_value_type(ikey: bytes) -> int:
+    # Trailer is little-endian fixed64 of (seqno << 8 | type): the type is the
+    # LOW byte, i.e. the first byte of the 8-byte trailer.
+    if len(ikey) < 8:
+        raise Corruption(f"internal key too short: {len(ikey)}")
+    return ikey[-8]
+
+
+class Comparator:
+    """User-key comparator interface (reference include/rocksdb/comparator.h).
+
+    Subclasses override compare/name; find_shortest_separator and
+    find_short_successor shorten index-block keys.
+    """
+
+    def name(self) -> str:
+        return "tpulsm.BytewiseComparator"
+
+    def compare(self, a: bytes, b: bytes) -> int:
+        return (a > b) - (a < b)
+
+    def equal(self, a: bytes, b: bytes) -> bool:
+        return self.compare(a, b) == 0
+
+    def find_shortest_separator(self, start: bytes, limit: bytes) -> bytes:
+        """Returns a key k with start <= k < limit, as short as possible."""
+        # Find common prefix.
+        n = min(len(start), len(limit))
+        i = 0
+        while i < n and start[i] == limit[i]:
+            i += 1
+        if i >= n:
+            return start  # one is a prefix of the other
+        b = start[i]
+        if b < 0xFF and b + 1 < limit[i]:
+            return start[: i] + bytes([b + 1])
+        return start
+
+    def find_short_successor(self, key: bytes) -> bytes:
+        """Returns a short key k >= key."""
+        for i, b in enumerate(key):
+            if b != 0xFF:
+                return key[: i] + bytes([b + 1])
+        return key
+
+
+class ReverseBytewiseComparator(Comparator):
+    def name(self) -> str:
+        return "tpulsm.ReverseBytewiseComparator"
+
+    def compare(self, a: bytes, b: bytes) -> int:
+        return (a < b) - (a > b)
+
+    def find_shortest_separator(self, start: bytes, limit: bytes) -> bytes:
+        return start
+
+    def find_short_successor(self, key: bytes) -> bytes:
+        return key
+
+
+BYTEWISE = Comparator()
+REVERSE_BYTEWISE = ReverseBytewiseComparator()
+
+
+class InternalKeyComparator:
+    """Orders internal keys: user key asc, then (seqno, type) desc
+    (reference db/dbformat.h InternalKeyComparator)."""
+
+    def __init__(self, user_cmp: Comparator = BYTEWISE):
+        self.user_comparator = user_cmp
+
+    def name(self) -> str:
+        return "tpulsm.InternalKeyComparator:" + self.user_comparator.name()
+
+    def compare(self, a: bytes, b: bytes) -> int:
+        r = self.user_comparator.compare(a[:-8], b[:-8])
+        if r != 0:
+            return r
+        anum = _U64.unpack_from(a, len(a) - 8)[0]
+        bnum = _U64.unpack_from(b, len(b) - 8)[0]
+        # Descending by packed (seqno, type).
+        return (anum < bnum) - (anum > bnum)
+
+    def find_shortest_separator(self, start: bytes, limit: bytes) -> bytes:
+        su, lu = start[:-8], limit[:-8]
+        tmp = self.user_comparator.find_shortest_separator(su, lu)
+        if len(tmp) < len(su) and self.user_comparator.compare(su, tmp) < 0:
+            # User key became shorter physically but larger logically: tag with
+            # the earliest possible (seqno, type) so it still sorts before limit.
+            out = tmp + _U64.pack(pack_seq_type(MAX_SEQUENCE_NUMBER, VALUE_TYPE_FOR_SEEK))
+            assert self.compare(start, out) < 0
+            assert self.compare(out, limit) < 0
+            return out
+        return start
+
+    def find_short_successor(self, key: bytes) -> bytes:
+        uk = key[:-8]
+        tmp = self.user_comparator.find_short_successor(uk)
+        if len(tmp) < len(uk) and self.user_comparator.compare(uk, tmp) < 0:
+            out = tmp + _U64.pack(pack_seq_type(MAX_SEQUENCE_NUMBER, VALUE_TYPE_FOR_SEEK))
+            assert self.compare(key, out) < 0
+            return out
+        return key
+
+
+@dataclass(frozen=True)
+class ParsedInternalKey:
+    user_key: bytes
+    sequence: int
+    type: int
+
+    @staticmethod
+    def parse(ikey: bytes) -> "ParsedInternalKey":
+        uk, seq, t = split_internal_key(ikey)
+        return ParsedInternalKey(uk, seq, t)
+
+    def encode(self) -> bytes:
+        return make_internal_key(self.user_key, self.sequence, self.type)
+
+
+class LookupKey:
+    """The key forms needed for a point lookup at a snapshot seqno
+    (reference db/dbformat.h LookupKey): memtable key == internal key here."""
+
+    def __init__(self, user_key: bytes, seq: int):
+        self.user_key = user_key
+        self.internal_key = make_internal_key(user_key, seq, VALUE_TYPE_FOR_SEEK)

@@ -1,0 +1,18 @@
+"""Env / FileSystem abstraction.
+
+The reference splits OS access behind Env/FileSystem (include/rocksdb/env.h:151,
+include/rocksdb/file_system.h:257 in /root/reference) so tests can substitute
+in-memory and fault-injecting filesystems. We keep the same seam: PosixEnv is
+the real thing; MemEnv backs unit tests; wrappers can interpose for fault
+injection and IO counting.
+"""
+
+from toplingdb_tpu.env.env import (  # noqa: F401
+    Env,
+    PosixEnv,
+    MemEnv,
+    WritableFile,
+    RandomAccessFile,
+    SequentialFile,
+    default_env,
+)

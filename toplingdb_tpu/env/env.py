@@ -1,0 +1,358 @@
+"""Filesystem/Env implementations: posix and in-memory.
+
+Interface mirrors the reference's FileSystem surface that the LSM engine
+actually uses (new_*_file, rename, list, lock), not its full breadth.
+File handles expose explicit append/read-at/sync so WAL durability and
+SST reads have the same contract as the reference's WritableFileWriter /
+RandomAccessFileReader (file/ in /root/reference).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+import time
+
+from toplingdb_tpu.utils.status import IOError_, NotFound
+
+
+class WritableFile:
+    def append(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def file_size(self) -> int:
+        raise NotImplementedError
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RandomAccessFile:
+    def read(self, offset: int, n: int) -> bytes:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SequentialFile:
+    def read(self, n: int) -> bytes:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Env:
+    """Abstract Env: files + clock + misc (reference include/rocksdb/env.h:151)."""
+
+    def new_writable_file(self, path: str) -> WritableFile:
+        raise NotImplementedError
+
+    def new_random_access_file(self, path: str) -> RandomAccessFile:
+        raise NotImplementedError
+
+    def new_sequential_file(self, path: str) -> SequentialFile:
+        raise NotImplementedError
+
+    def file_exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def get_file_size(self, path: str) -> int:
+        raise NotImplementedError
+
+    def delete_file(self, path: str) -> None:
+        raise NotImplementedError
+
+    def rename_file(self, src: str, dst: str) -> None:
+        raise NotImplementedError
+
+    def create_dir(self, path: str) -> None:
+        raise NotImplementedError
+
+    def get_children(self, path: str) -> list[str]:
+        raise NotImplementedError
+
+    def now_micros(self) -> int:
+        return int(time.time() * 1e6)
+
+    def read_file(self, path: str) -> bytes:
+        f = self.new_random_access_file(path)
+        try:
+            return f.read(0, f.size())
+        finally:
+            f.close()
+
+    def write_file(self, path: str, data: bytes, sync: bool = False) -> None:
+        f = self.new_writable_file(path)
+        try:
+            f.append(data)
+            if sync:
+                f.sync()
+        finally:
+            f.close()
+
+
+# ---------------------------------------------------------------------------
+# Posix
+# ---------------------------------------------------------------------------
+
+
+class _PosixWritable(WritableFile):
+    def __init__(self, path: str):
+        try:
+            self._f = open(path, "wb")
+        except OSError as e:
+            raise IOError_(f"open {path}: {e}") from e
+        self._size = 0
+
+    def append(self, data: bytes) -> None:
+        self._f.write(data)
+        self._size += len(data)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def file_size(self) -> int:
+        return self._size
+
+
+class _PosixRandomAccess(RandomAccessFile):
+    def __init__(self, path: str):
+        try:
+            self._f = open(path, "rb")
+        except FileNotFoundError as e:
+            raise NotFound(f"{path}") from e
+        except OSError as e:
+            raise IOError_(f"open {path}: {e}") from e
+        self._size = os.fstat(self._f.fileno()).st_size
+
+    def read(self, offset: int, n: int) -> bytes:
+        return os.pread(self._f.fileno(), n, offset)
+
+    def size(self) -> int:
+        return self._size
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+class _PosixSequential(SequentialFile):
+    def __init__(self, path: str):
+        try:
+            self._f = open(path, "rb")
+        except FileNotFoundError as e:
+            raise NotFound(f"{path}") from e
+        except OSError as e:
+            raise IOError_(f"open {path}: {e}") from e
+
+    def read(self, n: int) -> bytes:
+        return self._f.read(n)
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+class PosixEnv(Env):
+    def new_writable_file(self, path: str) -> WritableFile:
+        return _PosixWritable(path)
+
+    def new_random_access_file(self, path: str) -> RandomAccessFile:
+        return _PosixRandomAccess(path)
+
+    def new_sequential_file(self, path: str) -> SequentialFile:
+        return _PosixSequential(path)
+
+    def file_exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def get_file_size(self, path: str) -> int:
+        try:
+            return os.path.getsize(path)
+        except FileNotFoundError as e:
+            raise NotFound(path) from e
+
+    def delete_file(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except FileNotFoundError as e:
+            raise NotFound(path) from e
+
+    def rename_file(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def create_dir(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def get_children(self, path: str) -> list[str]:
+        try:
+            return sorted(os.listdir(path))
+        except FileNotFoundError as e:
+            raise NotFound(path) from e
+
+
+# ---------------------------------------------------------------------------
+# In-memory (reference env/mock_env.cc analogue)
+# ---------------------------------------------------------------------------
+
+
+class _MemFileState:
+    __slots__ = ("data", "synced_len")
+
+    def __init__(self):
+        self.data = bytearray()
+        self.synced_len = 0
+
+
+class _MemWritable(WritableFile):
+    def __init__(self, st: _MemFileState):
+        self._st = st
+
+    def append(self, data: bytes) -> None:
+        self._st.data += data
+
+    def sync(self) -> None:
+        self._st.synced_len = len(self._st.data)
+
+    def close(self) -> None:
+        pass
+
+    def file_size(self) -> int:
+        return len(self._st.data)
+
+
+class _MemRandomAccess(RandomAccessFile):
+    def __init__(self, st: _MemFileState):
+        self._st = st
+
+    def read(self, offset: int, n: int) -> bytes:
+        return bytes(self._st.data[offset : offset + n])
+
+    def size(self) -> int:
+        return len(self._st.data)
+
+
+class _MemSequential(SequentialFile):
+    def __init__(self, st: _MemFileState):
+        self._buf = io.BytesIO(bytes(st.data))
+
+    def read(self, n: int) -> bytes:
+        return self._buf.read(n)
+
+
+class MemEnv(Env):
+    """In-memory Env for tests. `drop_unsynced()` simulates a crash that loses
+    un-synced bytes (the core trick of the reference's FaultInjectionTestFS,
+    utilities/fault_injection_fs.h:204)."""
+
+    def __init__(self):
+        self._files: dict[str, _MemFileState] = {}
+        self._dirs: set[str] = {"/"}
+        self._lock = threading.Lock()
+
+    def _norm(self, path: str) -> str:
+        return os.path.normpath(path)
+
+    def new_writable_file(self, path: str) -> WritableFile:
+        with self._lock:
+            st = _MemFileState()
+            self._files[self._norm(path)] = st
+            return _MemWritable(st)
+
+    def new_random_access_file(self, path: str) -> RandomAccessFile:
+        with self._lock:
+            st = self._files.get(self._norm(path))
+            if st is None:
+                raise NotFound(path)
+            return _MemRandomAccess(st)
+
+    def new_sequential_file(self, path: str) -> SequentialFile:
+        with self._lock:
+            st = self._files.get(self._norm(path))
+            if st is None:
+                raise NotFound(path)
+            return _MemSequential(st)
+
+    def file_exists(self, path: str) -> bool:
+        p = self._norm(path)
+        return p in self._files or p in self._dirs
+
+    def get_file_size(self, path: str) -> int:
+        st = self._files.get(self._norm(path))
+        if st is None:
+            raise NotFound(path)
+        return len(st.data)
+
+    def delete_file(self, path: str) -> None:
+        with self._lock:
+            if self._files.pop(self._norm(path), None) is None:
+                raise NotFound(path)
+
+    def rename_file(self, src: str, dst: str) -> None:
+        with self._lock:
+            st = self._files.pop(self._norm(src), None)
+            if st is None:
+                raise NotFound(src)
+            self._files[self._norm(dst)] = st
+
+    def create_dir(self, path: str) -> None:
+        self._dirs.add(self._norm(path))
+
+    def get_children(self, path: str) -> list[str]:
+        p = self._norm(path)
+        out = set()
+        for f in self._files.keys() | self._dirs:
+            if f != p and os.path.dirname(f) == p:
+                out.add(os.path.basename(f))
+        return sorted(out)
+
+    def drop_unsynced(self) -> None:
+        """Crash simulation: truncate every file to its last synced length."""
+        with self._lock:
+            for st in self._files.values():
+                del st.data[st.synced_len :]
+
+
+_default = PosixEnv()
+
+
+def default_env() -> Env:
+    return _default

@@ -1,0 +1,65 @@
+"""Loader for the native C++ library.
+
+Builds `_tpulsm_native.so` from the C++ sources on first import (cached by
+mtime) and exposes the C ABI via ctypes. Falls back gracefully: callers check
+`lib()` for None and use pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "tpulsm_native.cc")
+_SO = os.path.join(_DIR, "_tpulsm_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", _SO + ".tmp", _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def lib() -> ctypes.CDLL | None:
+    """Returns the loaded native library, building it if needed; None if
+    the toolchain is unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        need_build = not os.path.exists(_SO) or (
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        )
+        if need_build and not _build():
+            return None
+        try:
+            l = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        l.tpulsm_crc32c_extend.restype = ctypes.c_uint32
+        l.tpulsm_crc32c_extend.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        l.tpulsm_xxh64.restype = ctypes.c_uint64
+        l.tpulsm_xxh64.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+        ]
+        _lib = l
+        return _lib

@@ -1,0 +1,156 @@
+// Native C++ core for toplingdb_tpu.
+//
+// The reference implements these primitives in C++ (util/crc32c.cc,
+// util/xxhash.h, util/hash.cc in /root/reference); we do the same, exposed
+// through a plain C ABI consumed via ctypes. Design is original: table-driven
+// slicing-by-8 CRC32C and a from-spec xxhash64.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -o _tpulsm_native.so tpulsm_native.cc
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli, polynomial 0x82f63b78 reflected), slicing-by-8.
+// Semantics match the reference util/crc32c.h: Value/Extend plus the rotated
+// mask used to store CRCs of CRC-carrying payloads.
+// ---------------------------------------------------------------------------
+
+static uint32_t kCrcTable[8][256];
+static bool kCrcInit = false;
+
+static void crc32c_init() {
+  if (kCrcInit) return;
+  const uint32_t poly = 0x82f63b78u;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+    kCrcTable[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = kCrcTable[0][i];
+    for (int t = 1; t < 8; t++) {
+      c = kCrcTable[0][c & 0xff] ^ (c >> 8);
+      kCrcTable[t][i] = c;
+    }
+  }
+  kCrcInit = true;
+}
+
+uint32_t tpulsm_crc32c_extend(uint32_t crc, const uint8_t* data, size_t n) {
+  crc32c_init();
+  uint32_t c = crc ^ 0xffffffffu;
+  // Align to 8 bytes.
+  while (n && (reinterpret_cast<uintptr_t>(data) & 7)) {
+    c = kCrcTable[0][(c ^ *data++) & 0xff] ^ (c >> 8);
+    n--;
+  }
+  while (n >= 8) {
+    uint64_t w;
+    std::memcpy(&w, data, 8);
+    w ^= c;
+    c = kCrcTable[7][w & 0xff] ^ kCrcTable[6][(w >> 8) & 0xff] ^
+        kCrcTable[5][(w >> 16) & 0xff] ^ kCrcTable[4][(w >> 24) & 0xff] ^
+        kCrcTable[3][(w >> 32) & 0xff] ^ kCrcTable[2][(w >> 40) & 0xff] ^
+        kCrcTable[1][(w >> 48) & 0xff] ^ kCrcTable[0][(w >> 56) & 0xff];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) {
+    c = kCrcTable[0][(c ^ *data++) & 0xff] ^ (c >> 8);
+  }
+  return c ^ 0xffffffffu;
+}
+
+// ---------------------------------------------------------------------------
+// xxHash64 — implemented from the public spec. Used for bloom-filter probes
+// and general hashing (the reference vendors xxhash in util/xxhash.h).
+// ---------------------------------------------------------------------------
+
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t xxh_round(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl64(acc, 31);
+  acc *= P1;
+  return acc;
+}
+
+static inline uint64_t xxh_merge_round(uint64_t acc, uint64_t val) {
+  val = xxh_round(0, val);
+  acc ^= val;
+  acc = acc * P1 + P4;
+  return acc;
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+uint64_t tpulsm_xxh64(const uint8_t* data, size_t len, uint64_t seed) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2;
+    uint64_t v2 = seed + P2;
+    uint64_t v3 = seed;
+    uint64_t v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = xxh_round(v1, read64(p)); p += 8;
+      v2 = xxh_round(v2, read64(p)); p += 8;
+      v3 = xxh_round(v3, read64(p)); p += 8;
+      v4 = xxh_round(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = xxh_merge_round(h, v1);
+    h = xxh_merge_round(h, v2);
+    h = xxh_merge_round(h, v3);
+    h = xxh_merge_round(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h ^= xxh_round(0, read64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)read32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+}  // extern "C"

@@ -1,0 +1,189 @@
+"""SST builder: block-based table writer.
+
+The default table format, structured like the reference's
+BlockBasedTableBuilder (table/block_based/block_based_table_builder.cc:961-1150
+in /root/reference): data blocks cut at `block_size`, a single-level index of
+shortest separators, a whole-file bloom filter over user keys, a range-deletion
+meta block, a properties meta block, a metaindex, and the fixed footer.
+
+Keys added must be internal keys in InternalKeyComparator order. Range
+tombstones go to their own meta block via `add_tombstone` (internal begin key →
+end user key), mirroring the reference's kRangeDelBlockName handling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.block import BlockBuilder
+from toplingdb_tpu.table.filter import BloomFilterPolicy, FilterPolicy
+from toplingdb_tpu.table.properties import TableProperties
+
+METAINDEX_FILTER = b"filter.fullfilter"
+METAINDEX_PROPERTIES = b"tpulsm.properties"
+METAINDEX_RANGE_DEL = b"tpulsm.range_del"
+
+
+@dataclass
+class TableOptions:
+    block_size: int = 4096
+    restart_interval: int = 16
+    index_restart_interval: int = 1
+    compression: int = fmt.NO_COMPRESSION
+    filter_policy: FilterPolicy | None = field(default_factory=BloomFilterPolicy)
+    whole_key_filtering: bool = True
+    verify_checksums: bool = True
+
+
+class TableBuilder:
+    def __init__(
+        self,
+        wfile,
+        icmp: InternalKeyComparator,
+        options: TableOptions | None = None,
+        column_family_id: int = 0,
+        creation_time: int = 0,
+    ):
+        self.opts = options or TableOptions()
+        self._w = wfile
+        self._icmp = icmp
+        self._data_block = BlockBuilder(self.opts.restart_interval)
+        self._index_block = BlockBuilder(self.opts.index_restart_interval)
+        self._filter_keys: list[bytes] = []
+        self._range_del_block = BlockBuilder(restart_interval=1)
+        self.props = TableProperties(
+            comparator_name=icmp.user_comparator.name(),
+            filter_policy_name=(
+                self.opts.filter_policy.name() if self.opts.filter_policy else ""
+            ),
+            compression_name=str(self.opts.compression),
+            column_family_id=column_family_id,
+            creation_time=creation_time,
+            smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
+        )
+        self._last_key: bytes | None = None
+        self._pending_index_entry = False
+        self._pending_handle: fmt.BlockHandle | None = None
+        self._smallest: bytes | None = None
+        self._largest: bytes | None = None
+        self._finished = False
+
+    # ------------------------------------------------------------------
+
+    @property
+    def num_entries(self) -> int:
+        return self.props.num_entries + self.props.num_range_deletions
+
+    def file_size(self) -> int:
+        return self._w.file_size()
+
+    @property
+    def smallest_key(self) -> bytes | None:
+        return self._smallest
+
+    @property
+    def largest_key(self) -> bytes | None:
+        return self._largest
+
+    def _track_bounds(self, ikey: bytes) -> None:
+        if self._smallest is None or self._icmp.compare(ikey, self._smallest) < 0:
+            self._smallest = ikey
+        if self._largest is None or self._icmp.compare(ikey, self._largest) > 0:
+            self._largest = ikey
+        seq = dbformat.extract_seqno(ikey)
+        self.props.smallest_seqno = min(self.props.smallest_seqno, seq)
+        self.props.largest_seqno = max(self.props.largest_seqno, seq)
+
+    def add(self, ikey: bytes, value: bytes) -> None:
+        assert not self._finished
+        if self._last_key is not None:
+            assert self._icmp.compare(self._last_key, ikey) < 0, (
+                f"keys out of order: {self._last_key!r} >= {ikey!r}"
+            )
+        if self._pending_index_entry:
+            sep = self._icmp.find_shortest_separator(self._last_key, ikey)
+            self._index_block.add(sep, self._pending_handle.encode())
+            self._pending_index_entry = False
+        uk, _, t = dbformat.split_internal_key(ikey)
+        if self.opts.filter_policy and self.opts.whole_key_filtering:
+            self._filter_keys.append(uk)
+        self._data_block.add(ikey, value)
+        self._last_key = ikey
+        self._track_bounds(ikey)
+        self.props.num_entries += 1
+        self.props.raw_key_size += len(ikey)
+        self.props.raw_value_size += len(value)
+        if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
+            self.props.num_deletions += 1
+        elif t == ValueType.MERGE:
+            self.props.num_merge_operands += 1
+        if self._data_block.current_size_estimate() >= self.opts.block_size:
+            self._flush_data_block()
+
+    def add_tombstone(self, begin_ikey: bytes, end_user_key: bytes) -> None:
+        """Range tombstone: begin internal key (type RANGE_DELETION) → end user
+        key (exclusive)."""
+        assert not self._finished
+        self._range_del_block.add(begin_ikey, end_user_key)
+        self.props.num_range_deletions += 1
+        self._track_bounds(begin_ikey)
+        # The tombstone covers up to end_user_key exclusive; widen largest.
+        end_ikey = dbformat.make_internal_key(
+            end_user_key, dbformat.MAX_SEQUENCE_NUMBER, dbformat.VALUE_TYPE_FOR_SEEK
+        )
+        if self._largest is None or self._icmp.compare(end_ikey, self._largest) > 0:
+            self._largest = end_ikey
+
+    def _flush_data_block(self) -> None:
+        if self._data_block.empty():
+            return
+        raw = self._data_block.finish()
+        self._pending_handle = fmt.write_block(self._w, raw, self.opts.compression)
+        self._pending_index_entry = True
+        self.props.data_size += len(raw)
+        self.props.num_data_blocks += 1
+        self._data_block.reset()
+
+    def finish(self) -> TableProperties:
+        assert not self._finished
+        self._flush_data_block()
+        if self._pending_index_entry:
+            succ = self._icmp.find_short_successor(self._last_key)
+            self._index_block.add(succ, self._pending_handle.encode())
+            self._pending_index_entry = False
+
+        metaindex = BlockBuilder(restart_interval=1)
+        meta_entries: list[tuple[bytes, fmt.BlockHandle]] = []
+
+        if self.opts.filter_policy and self._filter_keys:
+            fdata = self.opts.filter_policy.create_filter(self._filter_keys)
+            fh = fmt.write_block(self._w, fdata, fmt.NO_COMPRESSION)
+            self.props.filter_size = len(fdata)
+            meta_entries.append((METAINDEX_FILTER, fh))
+
+        if not self._range_del_block.empty():
+            rd = self._range_del_block.finish()
+            rh = fmt.write_block(self._w, rd, fmt.NO_COMPRESSION)
+            meta_entries.append((METAINDEX_RANGE_DEL, rh))
+
+        # Index size must be known before the properties block is serialized.
+        iraw = self._index_block.finish()
+        self.props.index_size = len(iraw)
+
+        pblock = self.props.encode_block()
+        ph = fmt.write_block(self._w, pblock, fmt.NO_COMPRESSION)
+        meta_entries.append((METAINDEX_PROPERTIES, ph))
+
+        for name, handle in sorted(meta_entries):
+            metaindex.add(name, handle.encode())
+        mih = fmt.write_block(self._w, metaindex.finish(), fmt.NO_COMPRESSION)
+
+        ih = fmt.write_block(self._w, iraw, self.opts.compression)
+
+        self._w.append(fmt.Footer(mih, ih).encode())
+        self._w.flush()
+        self._finished = True
+        return self.props

@@ -1,0 +1,156 @@
+"""On-disk SST framing: block handles, trailers, footer, compression.
+
+Structure follows the reference's BlockBasedTable framing (table/format.h:39-133
+in /root/reference): every block is written as
+    payload' | compression_type(1B) | masked_crc32c(4B over payload'+type)
+and the file ends with a fixed-size footer
+    checksum_type(1B) | metaindex_handle | index_handle | padding | version(4B) | magic(8B)
+Handles are (offset, size) varint64 pairs. The magic number is our own — this
+is a new format ("tpulsm SST v1"), structured like BlockBasedTable but not
+byte-identical to it.
+"""
+
+from __future__ import annotations
+
+import bz2
+import lzma
+import zlib
+from dataclasses import dataclass
+
+from toplingdb_tpu.utils import coding, crc32c
+from toplingdb_tpu.utils.status import Corruption, NotSupported
+
+MAGIC = 0x7470756C736D5354  # "tpulsmST" big-endian spelling, stored fixed64 LE
+FOOTER_VERSION = 1
+BLOCK_TRAILER_SIZE = 5  # type byte + crc32
+MAX_HANDLE_LEN = 20     # two varint64s
+FOOTER_LEN = 1 + 2 * MAX_HANDLE_LEN + 4 + 8
+
+# Compression type byte (values chosen to match the reference's enum where the
+# codec exists in both: kNoCompression=0, kZlibCompression=2, kBZip2=3,
+# kLZMA has no reference equivalent and takes a private value).
+NO_COMPRESSION = 0
+ZLIB_COMPRESSION = 2
+BZIP2_COMPRESSION = 3
+LZMA_COMPRESSION = 0x21
+
+CHECKSUM_CRC32C = 1
+
+
+@dataclass(frozen=True)
+class BlockHandle:
+    offset: int
+    size: int  # payload size, excluding the 5-byte trailer
+
+    def encode(self) -> bytes:
+        return coding.encode_varint64(self.offset) + coding.encode_varint64(self.size)
+
+    @staticmethod
+    def decode(buf: bytes, off: int = 0) -> tuple["BlockHandle", int]:
+        o, off = coding.decode_varint64(buf, off)
+        s, off = coding.decode_varint64(buf, off)
+        return BlockHandle(o, s), off
+
+    @staticmethod
+    def decode_exact(buf: bytes) -> "BlockHandle":
+        h, _ = BlockHandle.decode(buf, 0)
+        return h
+
+
+@dataclass(frozen=True)
+class Footer:
+    metaindex_handle: BlockHandle
+    index_handle: BlockHandle
+    checksum_type: int = CHECKSUM_CRC32C
+    version: int = FOOTER_VERSION
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        out.append(self.checksum_type)
+        out += self.metaindex_handle.encode()
+        out += self.index_handle.encode()
+        out += b"\x00" * (1 + 2 * MAX_HANDLE_LEN - len(out))
+        out += coding.encode_fixed32(self.version)
+        out += coding.encode_fixed64(MAGIC)
+        assert len(out) == FOOTER_LEN
+        return bytes(out)
+
+    @staticmethod
+    def decode(buf: bytes) -> "Footer":
+        if len(buf) < FOOTER_LEN:
+            raise Corruption("footer too short")
+        tail = buf[-FOOTER_LEN:]
+        magic = coding.decode_fixed64(tail, FOOTER_LEN - 8)
+        if magic != MAGIC:
+            raise Corruption(f"bad SST magic: {magic:#x}")
+        version = coding.decode_fixed32(tail, FOOTER_LEN - 12)
+        checksum_type = tail[0]
+        mih, off = BlockHandle.decode(tail, 1)
+        ih, _ = BlockHandle.decode(tail, off)
+        return Footer(mih, ih, checksum_type, version)
+
+
+def compress(data: bytes, ctype: int) -> bytes:
+    if ctype == NO_COMPRESSION:
+        return data
+    if ctype == ZLIB_COMPRESSION:
+        return zlib.compress(data, 6)
+    if ctype == BZIP2_COMPRESSION:
+        return bz2.compress(data)
+    if ctype == LZMA_COMPRESSION:
+        return lzma.compress(data)
+    raise NotSupported(f"compression type {ctype}")
+
+
+def decompress(data: bytes, ctype: int) -> bytes:
+    if ctype == NO_COMPRESSION:
+        return data
+    if ctype == ZLIB_COMPRESSION:
+        return zlib.decompress(data)
+    if ctype == BZIP2_COMPRESSION:
+        return bz2.decompress(data)
+    if ctype == LZMA_COMPRESSION:
+        return lzma.decompress(data)
+    raise Corruption(f"unknown compression type {ctype}")
+
+
+def write_block(wfile, raw: bytes, ctype: int) -> BlockHandle:
+    """Compress (if profitable), frame with trailer, append. Returns handle.
+
+    Mirrors BlockBasedTableBuilder::WriteBlock (reference
+    table/block_based/block_based_table_builder.cc:1092-1150): fall back to
+    uncompressed when compression gains <12.5%.
+    """
+    payload = raw
+    out_type = NO_COMPRESSION
+    if ctype != NO_COMPRESSION:
+        c = compress(raw, ctype)
+        if len(c) < len(raw) - len(raw) // 8:
+            payload, out_type = c, ctype
+    offset = wfile.file_size()
+    crc = crc32c.value(payload + bytes([out_type]))
+    wfile.append(payload)
+    wfile.append(bytes([out_type]))
+    wfile.append(coding.encode_fixed32(crc32c.mask(crc)))
+    return BlockHandle(offset, len(payload))
+
+
+def read_block(rfile, handle: BlockHandle, verify_checksums: bool = True) -> bytes:
+    """Read, verify trailer CRC, decompress."""
+    buf = rfile.read(handle.offset, handle.size + BLOCK_TRAILER_SIZE)
+    if len(buf) != handle.size + BLOCK_TRAILER_SIZE:
+        raise Corruption(
+            f"truncated block read at {handle.offset}: "
+            f"got {len(buf)}, want {handle.size + BLOCK_TRAILER_SIZE}"
+        )
+    payload = buf[: handle.size]
+    ctype = buf[handle.size]
+    if verify_checksums:
+        stored = crc32c.unmask(coding.decode_fixed32(buf, handle.size + 1))
+        actual = crc32c.value(payload + bytes([ctype]))
+        if stored != actual:
+            raise Corruption(
+                f"block checksum mismatch at {handle.offset}: "
+                f"stored {stored:#x} != computed {actual:#x}"
+            )
+    return decompress(payload, ctype)

@@ -1,0 +1,79 @@
+"""TableProperties: per-SST metadata stored in a meta block.
+
+Analogue of the reference's TableProperties / meta_blocks.cc
+(table/table_properties.cc in /root/reference). `raw_key_size` /
+`raw_value_size` feed compaction stats and the distributed-compaction
+result accounting (reference compaction_executor.h:120-158).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from toplingdb_tpu.table.block import BlockBuilder, BlockIter
+
+
+@dataclass
+class TableProperties:
+    num_entries: int = 0
+    num_deletions: int = 0
+    num_merge_operands: int = 0
+    num_range_deletions: int = 0
+    raw_key_size: int = 0
+    raw_value_size: int = 0
+    data_size: int = 0
+    index_size: int = 0
+    filter_size: int = 0
+    num_data_blocks: int = 0
+    comparator_name: str = ""
+    filter_policy_name: str = ""
+    compression_name: str = ""
+    creation_time: int = 0
+    smallest_seqno: int = 0
+    largest_seqno: int = 0
+    column_family_id: int = 0
+    user_collected: dict[str, bytes] = field(default_factory=dict)
+
+    _INT_FIELDS = (
+        "num_entries", "num_deletions", "num_merge_operands",
+        "num_range_deletions", "raw_key_size", "raw_value_size", "data_size",
+        "index_size", "filter_size", "num_data_blocks", "creation_time",
+        "smallest_seqno", "largest_seqno", "column_family_id",
+    )
+    _STR_FIELDS = ("comparator_name", "filter_policy_name", "compression_name")
+
+    def encode_block(self) -> bytes:
+        b = BlockBuilder(restart_interval=1)
+        items: list[tuple[bytes, bytes]] = []
+        for f in self._INT_FIELDS:
+            items.append((f"tpulsm.{f}".encode(), str(getattr(self, f)).encode()))
+        for f in self._STR_FIELDS:
+            items.append((f"tpulsm.{f}".encode(), getattr(self, f).encode()))
+        for k, v in self.user_collected.items():
+            items.append((f"user.{k}".encode(), v))
+        for k, v in sorted(items):
+            b.add(k, v)
+        return b.finish()
+
+    @staticmethod
+    def decode_block(data: bytes) -> "TableProperties":
+        from toplingdb_tpu.db.dbformat import BYTEWISE
+        from toplingdb_tpu.utils.status import Corruption
+
+        props = TableProperties()
+        it = BlockIter(data, BYTEWISE.compare)
+        it.seek_to_first()
+        for k, v in it.entries():
+            ks = k.decode(errors="replace")
+            if ks.startswith("tpulsm."):
+                name = ks[len("tpulsm."):]
+                if name in TableProperties._INT_FIELDS:
+                    try:
+                        setattr(props, name, int(v))
+                    except ValueError as e:
+                        raise Corruption(f"bad table property {ks}: {v!r}") from e
+                elif name in TableProperties._STR_FIELDS:
+                    setattr(props, name, v.decode(errors="replace"))
+            elif ks.startswith("user."):
+                props.user_collected[ks[len("user."):]] = v
+        return props

@@ -1,0 +1,205 @@
+"""SST reader: open, point lookup support, two-level iteration.
+
+Counterpart of the reference's BlockBasedTable reader
+(table/block_based/block_based_table_reader.cc:2095 `Get`,
+block_based_table_iterator in /root/reference): footer → metaindex →
+{filter, properties, range-del} blocks, single-level index in memory,
+data blocks fetched (and optionally cached) per seek.
+"""
+
+from __future__ import annotations
+
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.dbformat import InternalKeyComparator
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.block import BlockIter
+from toplingdb_tpu.table.builder import (
+    METAINDEX_FILTER,
+    METAINDEX_PROPERTIES,
+    METAINDEX_RANGE_DEL,
+    TableOptions,
+)
+from toplingdb_tpu.table.filter import filter_policy_from_name
+from toplingdb_tpu.table.properties import TableProperties
+
+
+class TableReader:
+    def __init__(self, rfile, icmp: InternalKeyComparator, options: TableOptions | None = None,
+                 block_cache=None, cache_key_prefix: bytes = b""):
+        self.opts = options or TableOptions()
+        self._f = rfile
+        self._icmp = icmp
+        self._cache = block_cache
+        self._cache_prefix = cache_key_prefix
+        size = rfile.size()
+        footer_buf = rfile.read(max(0, size - fmt.FOOTER_LEN), fmt.FOOTER_LEN)
+        self.footer = fmt.Footer.decode(footer_buf)
+        self._index_data = fmt.read_block(
+            rfile, self.footer.index_handle, self.opts.verify_checksums
+        )
+        meta = fmt.read_block(
+            rfile, self.footer.metaindex_handle, self.opts.verify_checksums
+        )
+        self._meta_handles: dict[bytes, fmt.BlockHandle] = {}
+        mit = BlockIter(meta, dbformat.BYTEWISE.compare)
+        mit.seek_to_first()
+        for k, v in mit.entries():
+            self._meta_handles[k] = fmt.BlockHandle.decode_exact(v)
+
+        self.properties = TableProperties()
+        ph = self._meta_handles.get(METAINDEX_PROPERTIES)
+        if ph is not None:
+            self.properties = TableProperties.decode_block(
+                fmt.read_block(rfile, ph, self.opts.verify_checksums)
+            )
+
+        self._filter_data: bytes | None = None
+        self._filter_policy = None
+        fh = self._meta_handles.get(METAINDEX_FILTER)
+        if fh is not None:
+            self._filter_data = fmt.read_block(rfile, fh, self.opts.verify_checksums)
+            self._filter_policy = filter_policy_from_name(
+                self.properties.filter_policy_name
+            )
+
+        self._range_del_data: bytes | None = None
+        rh = self._meta_handles.get(METAINDEX_RANGE_DEL)
+        if rh is not None:
+            self._range_del_data = fmt.read_block(rfile, rh, self.opts.verify_checksums)
+
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        self._f.close()
+
+    def key_may_match(self, user_key: bytes) -> bool:
+        if self._filter_policy is None or self._filter_data is None:
+            return True
+        return self._filter_policy.key_may_match(user_key, self._filter_data)
+
+    def _read_data_block(self, handle: fmt.BlockHandle) -> bytes:
+        if self._cache is not None:
+            ckey = self._cache_prefix + handle.encode()
+            data = self._cache.lookup(ckey)
+            if data is not None:
+                return data
+            data = fmt.read_block(self._f, handle, self.opts.verify_checksums)
+            self._cache.insert(ckey, data, len(data))
+            return data
+        return fmt.read_block(self._f, handle, self.opts.verify_checksums)
+
+    def new_iterator(self) -> "TableIterator":
+        return TableIterator(self)
+
+    def range_del_entries(self) -> list[tuple[bytes, bytes]]:
+        """Raw (begin_internal_key, end_user_key) tombstones in this file."""
+        if self._range_del_data is None:
+            return []
+        it = BlockIter(self._range_del_data, self._icmp.compare)
+        it.seek_to_first()
+        return list(it.entries())
+
+    def approximate_offset_of(self, ikey: bytes) -> int:
+        """Approximate file offset of ikey (reference TableReader::
+        ApproximateOffsetOf) — used for subcompaction boundary sizing."""
+        idx = BlockIter(self._index_data, self._icmp.compare)
+        idx.seek(ikey)
+        if idx.valid():
+            return fmt.BlockHandle.decode_exact(idx.value()).offset
+        return self.footer.metaindex_handle.offset
+
+    def anchors(self, max_anchors: int = 32) -> list[bytes]:
+        """Sampled keys for subcompaction boundary picking (reference
+        TableReader::Anchors, used by GenSubcompactionBoundaries,
+        compaction_job.cc:604-640)."""
+        idx = BlockIter(self._index_data, self._icmp.compare)
+        idx.seek_to_first()
+        keys = [k for k, _ in idx.entries()]
+        if len(keys) <= max_anchors:
+            return keys
+        step = len(keys) / max_anchors
+        return [keys[int(i * step)] for i in range(max_anchors)]
+
+
+class TableIterator:
+    """Two-level iterator: index block → data block."""
+
+    def __init__(self, reader: TableReader):
+        self._r = reader
+        self._cmp = reader._icmp.compare
+        self._idx = BlockIter(reader._index_data, self._cmp)
+        self._data: BlockIter | None = None
+
+    def _load_data_block(self) -> None:
+        if not self._idx.valid():
+            self._data = None
+            return
+        handle = fmt.BlockHandle.decode_exact(self._idx.value())
+        self._data = BlockIter(self._r._read_data_block(handle), self._cmp)
+
+    def valid(self) -> bool:
+        return self._data is not None and self._data.valid()
+
+    def key(self) -> bytes:
+        return self._data.key()
+
+    def value(self) -> bytes:
+        return self._data.value()
+
+    def seek_to_first(self) -> None:
+        self._idx.seek_to_first()
+        self._load_data_block()
+        if self._data is not None:
+            self._data.seek_to_first()
+            self._skip_forward_empty()
+
+    def seek_to_last(self) -> None:
+        self._idx.seek_to_last()
+        self._load_data_block()
+        if self._data is not None:
+            self._data.seek_to_last()
+            self._skip_backward_empty()
+
+    def seek(self, target: bytes) -> None:
+        self._idx.seek(target)
+        self._load_data_block()
+        if self._data is not None:
+            self._data.seek(target)
+            self._skip_forward_empty()
+
+    def seek_for_prev(self, target: bytes) -> None:
+        self.seek(target)
+        if not self.valid():
+            self.seek_to_last()
+            return
+        if self._cmp(self.key(), target) > 0:
+            self.prev()
+
+    def next(self) -> None:
+        assert self.valid()
+        self._data.next()
+        self._skip_forward_empty()
+
+    def prev(self) -> None:
+        assert self.valid()
+        self._data.prev()
+        self._skip_backward_empty()
+
+    def _skip_forward_empty(self) -> None:
+        while self._data is not None and not self._data.valid():
+            self._idx.next()
+            self._load_data_block()
+            if self._data is not None:
+                self._data.seek_to_first()
+
+    def _skip_backward_empty(self) -> None:
+        while self._data is not None and not self._data.valid():
+            self._idx.prev()
+            self._load_data_block()
+            if self._data is not None:
+                self._data.seek_to_last()
+
+    def entries(self):
+        while self.valid():
+            yield self.key(), self.value()
+            self.next()

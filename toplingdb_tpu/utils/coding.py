@@ -1,0 +1,111 @@
+"""Integer/byte coding primitives.
+
+Wire-compatible semantics with the reference's util/coding.h: little-endian
+fixed 32/64, LEB128 varint 32/64, and length-prefixed slices. These encodings
+appear in every on-disk structure (blocks, SST footers, MANIFEST edits, WAL
+payloads), so they are frozen here first (SURVEY.md §7 step 1).
+"""
+
+from __future__ import annotations
+
+import struct
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_U16 = struct.Struct("<H")
+
+MAX_VARINT64_LEN = 10
+MAX_VARINT32_LEN = 5
+
+
+def encode_fixed16(v: int) -> bytes:
+    return _U16.pack(v & 0xFFFF)
+
+
+def encode_fixed32(v: int) -> bytes:
+    return _U32.pack(v & 0xFFFFFFFF)
+
+
+def encode_fixed64(v: int) -> bytes:
+    return _U64.pack(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def decode_fixed16(buf: bytes, off: int = 0) -> int:
+    return _U16.unpack_from(buf, off)[0]
+
+
+def decode_fixed32(buf: bytes, off: int = 0) -> int:
+    return _U32.unpack_from(buf, off)[0]
+
+
+def decode_fixed64(buf: bytes, off: int = 0) -> int:
+    return _U64.unpack_from(buf, off)[0]
+
+
+def encode_varint32(v: int) -> bytes:
+    return encode_varint64(v & 0xFFFFFFFF)
+
+
+def encode_varint64(v: int) -> bytes:
+    if v < 0:
+        raise ValueError("varint must be non-negative")
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def decode_varint32(buf, off: int = 0) -> tuple[int, int]:
+    """Returns (value, new_offset)."""
+    v, off = decode_varint64(buf, off)
+    if v > 0xFFFFFFFF:
+        from toplingdb_tpu.utils.status import Corruption
+
+        raise Corruption("varint32 overflow")
+    return v, off
+
+
+def decode_varint64(buf, off: int = 0) -> tuple[int, int]:
+    """Returns (value, new_offset)."""
+    result = 0
+    shift = 0
+    n = len(buf)
+    while shift <= 63:
+        if off >= n:
+            from toplingdb_tpu.utils.status import Corruption
+
+            raise Corruption("truncated varint")
+        b = buf[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if b < 0x80:
+            return result, off
+        shift += 7
+    from toplingdb_tpu.utils.status import Corruption
+
+    raise Corruption("varint too long")
+
+
+def varint_length(v: int) -> int:
+    n = 1
+    while v >= 0x80:
+        v >>= 7
+        n += 1
+    return n
+
+
+def put_length_prefixed_slice(out: bytearray, s: bytes) -> None:
+    out += encode_varint32(len(s))
+    out += s
+
+
+def get_length_prefixed_slice(buf, off: int = 0) -> tuple[bytes, int]:
+    """Returns (slice, new_offset)."""
+    n, off = decode_varint32(buf, off)
+    if off + n > len(buf):
+        from toplingdb_tpu.utils.status import Corruption
+
+        raise Corruption("truncated length-prefixed slice")
+    return bytes(buf[off : off + n]), off + n

@@ -1,0 +1,111 @@
+"""CRC32C (Castagnoli) with the reference's masking scheme.
+
+Semantics match reference util/crc32c.h: `value`/`extend`, plus `mask`/
+`unmask` — CRCs stored inside CRC-protected payloads (WAL records, block
+trailers) are rotated and offset so that computing the CRC of a string
+containing embedded CRCs is well-behaved.
+
+Hot path is the native C++ slicing-by-8 implementation
+(toplingdb_tpu/native/tpulsm_native.cc); a table-driven Python fallback keeps
+the package importable without a toolchain.
+"""
+
+from __future__ import annotations
+
+from toplingdb_tpu import native
+
+_MASK_DELTA = 0xA282EAD8
+
+_POLY = 0x82F63B78
+_py_table: list[int] | None = None
+
+
+def _table() -> list[int]:
+    global _py_table
+    if _py_table is None:
+        t = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (_POLY ^ (c >> 1)) if (c & 1) else (c >> 1)
+            t.append(c)
+        _py_table = t
+    return _py_table
+
+
+def extend(crc: int, data: bytes) -> int:
+    l = native.lib()
+    if l is not None:
+        return l.tpulsm_crc32c_extend(crc & 0xFFFFFFFF, bytes(data), len(data))
+    t = _table()
+    c = (crc ^ 0xFFFFFFFF) & 0xFFFFFFFF
+    for b in data:
+        c = t[(c ^ b) & 0xFF] ^ (c >> 8)
+    return (c ^ 0xFFFFFFFF) & 0xFFFFFFFF
+
+
+def value(data: bytes) -> int:
+    return extend(0, data)
+
+
+def mask(crc: int) -> int:
+    """Rotate right by 15 bits and add a constant (reference util/crc32c.h:46)."""
+    crc &= 0xFFFFFFFF
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+def unmask(masked: int) -> int:
+    rot = (masked - _MASK_DELTA) & 0xFFFFFFFF
+    return ((rot >> 17) | (rot << 15)) & 0xFFFFFFFF
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    """xxHash64 of `data` (bloom probes, general hashing)."""
+    l = native.lib()
+    if l is not None:
+        return l.tpulsm_xxh64(bytes(data), len(data), seed)
+    # Pure-Python xxh64 fallback (from the public spec).
+    P1 = 11400714785074694791
+    P2 = 14029467366897019727
+    P3 = 1609587929392839161
+    P4 = 9650029242287828579
+    P5 = 2870177450012600261
+    M = 0xFFFFFFFFFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    def rnd(acc, inp):
+        acc = (acc + inp * P2) & M
+        return (rotl(acc, 31) * P1) & M
+
+    n = len(data)
+    p = 0
+    if n >= 32:
+        v1, v2, v3, v4 = (seed + P1 + P2) & M, (seed + P2) & M, seed & M, (seed - P1) & M
+        while p + 32 <= n:
+            v1 = rnd(v1, int.from_bytes(data[p : p + 8], "little")); p += 8
+            v2 = rnd(v2, int.from_bytes(data[p : p + 8], "little")); p += 8
+            v3 = rnd(v3, int.from_bytes(data[p : p + 8], "little")); p += 8
+            v4 = rnd(v4, int.from_bytes(data[p : p + 8], "little")); p += 8
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ rnd(0, v)) * P1 + P4) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while p + 8 <= n:
+        h = ((rotl(h ^ rnd(0, int.from_bytes(data[p : p + 8], "little")), 27)) * P1 + P4) & M
+        p += 8
+    if p + 4 <= n:
+        h = ((rotl(h ^ (int.from_bytes(data[p : p + 4], "little") * P1) & M, 23)) * P2 + P3) & M
+        p += 4
+    while p < n:
+        h = (rotl(h ^ (data[p] * P5) & M, 11) * P1) & M
+        p += 1
+    h ^= h >> 33
+    h = (h * P2) & M
+    h ^= h >> 29
+    h = (h * P3) & M
+    h ^= h >> 32
+    return h

@@ -1,0 +1,99 @@
+"""Status/error model.
+
+The reference threads a `Status` value through every call
+(util/status.cc, include/rocksdb/status.h in /root/reference). Python has
+exceptions; we use them, but keep a Status taxonomy so error classification
+(ErrorHandler severity mapping, reference db/error_handler.h:28) has the same
+vocabulary.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Code(enum.IntEnum):
+    OK = 0
+    NOT_FOUND = 1
+    CORRUPTION = 2
+    NOT_SUPPORTED = 3
+    INVALID_ARGUMENT = 4
+    IO_ERROR = 5
+    MERGE_IN_PROGRESS = 6
+    INCOMPLETE = 7
+    SHUTDOWN_IN_PROGRESS = 8
+    TIMED_OUT = 9
+    ABORTED = 10
+    BUSY = 11
+    EXPIRED = 12
+    TRY_AGAIN = 13
+    COMPACTION_TOO_LARGE = 14
+    COLUMN_FAMILY_DROPPED = 15
+
+
+class Severity(enum.IntEnum):
+    """Background-error severity, mirroring reference db/error_handler.h."""
+
+    NO_ERROR = 0
+    SOFT_ERROR = 1      # writes may stall, reads fine, auto-recoverable
+    HARD_ERROR = 2      # writes stopped until Resume()
+    FATAL_ERROR = 3     # DB must be reopened
+    UNRECOVERABLE = 4
+
+
+class Status(Exception):
+    """Base error for the framework. `code` classifies it."""
+
+    code: Code = Code.IO_ERROR
+
+    def __init__(self, msg: str = "", *, retryable: bool = False):
+        super().__init__(msg)
+        self.retryable = retryable
+
+    @property
+    def message(self) -> str:
+        return str(self)
+
+
+class NotFound(Status):
+    code = Code.NOT_FOUND
+
+
+class Corruption(Status):
+    code = Code.CORRUPTION
+
+
+class NotSupported(Status):
+    code = Code.NOT_SUPPORTED
+
+
+class InvalidArgument(Status):
+    code = Code.INVALID_ARGUMENT
+
+
+class IOError_(Status):
+    code = Code.IO_ERROR
+
+
+class MergeInProgress(Status):
+    code = Code.MERGE_IN_PROGRESS
+
+
+class Incomplete(Status):
+    code = Code.INCOMPLETE
+
+
+class ShutdownInProgress(Status):
+    code = Code.SHUTDOWN_IN_PROGRESS
+
+
+class TryAgain(Status):
+    code = Code.TRY_AGAIN
+
+
+class Busy(Status):
+    code = Code.BUSY
+
+
+class Expired(Status):
+    code = Code.EXPIRED
