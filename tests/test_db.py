@@ -1,0 +1,171 @@
+import struct
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import FlushOptions, Options, ReadOptions, WriteOptions
+from toplingdb_tpu.utils.merge_operator import StringAppendOperator, UInt64AddOperator
+from toplingdb_tpu.utils.status import InvalidArgument
+
+
+def opts(**kw):
+    kw.setdefault("write_buffer_size", 32 * 1024)
+    return Options(**kw)
+
+
+def test_open_put_get_close_reopen(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put(b"a", b"1")
+        db.put(b"b", b"2")
+        assert db.get(b"a") == b"1"
+        assert db.get(b"missing") is None
+    with DB.open(tmp_db_path, opts()) as db:
+        assert db.get(b"a") == b"1"
+        assert db.get(b"b") == b"2"
+
+
+def test_create_if_missing_false(tmp_db_path):
+    with pytest.raises(InvalidArgument):
+        DB.open(tmp_db_path, opts(create_if_missing=False))
+
+
+def test_error_if_exists(tmp_db_path):
+    DB.open(tmp_db_path, opts()).close()
+    with pytest.raises(InvalidArgument):
+        DB.open(tmp_db_path, opts(error_if_exists=True))
+
+
+def test_overwrite_and_delete(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put(b"k", b"v1")
+        db.put(b"k", b"v2")
+        assert db.get(b"k") == b"v2"
+        db.delete(b"k")
+        assert db.get(b"k") is None
+        db.put(b"k", b"v3")
+        assert db.get(b"k") == b"v3"
+
+
+def test_flush_and_read_from_sst(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        for i in range(100):
+            db.put(b"key%04d" % i, b"val%04d" % i)
+        db.flush()
+        assert db.mem.empty()
+        assert len(db.versions.current.files[0]) >= 1
+        assert db.get(b"key0050") == b"val0050"
+        db.delete(b"key0050")
+        db.flush()
+        assert db.get(b"key0050") is None  # tombstone in newer L0 file
+
+
+def test_recovery_replays_wal(tmp_db_path):
+    db = DB.open(tmp_db_path, opts())
+    db.put(b"durable", b"yes", WriteOptions(sync=True))
+    # Simulate crash: drop the handle without close() (no flush).
+    db._closed = True
+    db2 = DB.open(tmp_db_path, opts())
+    assert db2.get(b"durable") == b"yes"
+    db2.close()
+
+
+def test_auto_flush_on_write_buffer_full(tmp_db_path):
+    with DB.open(tmp_db_path, opts(write_buffer_size=8 * 1024)) as db:
+        for i in range(2000):
+            db.put(b"key%06d" % i, b"x" * 30)
+        assert len(db.versions.current.files[0]) > 0
+        assert db.get(b"key000000") == b"x" * 30
+        assert db.get(b"key001999") == b"x" * 30
+
+
+def test_snapshot_isolation(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put(b"k", b"old")
+        snap = db.get_snapshot()
+        db.put(b"k", b"new")
+        db.delete(b"k2")
+        assert db.get(b"k", ReadOptions(snapshot=snap)) == b"old"
+        assert db.get(b"k") == b"new"
+        # Snapshot survives flush.
+        db.flush()
+        assert db.get(b"k", ReadOptions(snapshot=snap)) == b"old"
+        snap.release()
+
+
+def test_merge_operator(tmp_db_path):
+    with DB.open(tmp_db_path, opts(merge_operator=UInt64AddOperator())) as db:
+        db.merge(b"c", struct.pack("<Q", 1))
+        db.merge(b"c", struct.pack("<Q", 2))
+        assert struct.unpack("<Q", db.get(b"c"))[0] == 3
+        db.flush()
+        db.merge(b"c", struct.pack("<Q", 10))  # operand in mem, base in SST
+        assert struct.unpack("<Q", db.get(b"c"))[0] == 13
+        db.put(b"c", struct.pack("<Q", 100))   # put resets the chain
+        db.merge(b"c", struct.pack("<Q", 1))
+        assert struct.unpack("<Q", db.get(b"c"))[0] == 101
+
+
+def test_merge_across_flush_with_delete(tmp_db_path):
+    with DB.open(tmp_db_path, opts(merge_operator=StringAppendOperator())) as db:
+        db.put(b"s", b"base")
+        db.flush()
+        db.delete(b"s")
+        db.merge(b"s", b"x")
+        db.merge(b"s", b"y")
+        assert db.get(b"s") == b"x,y"  # delete cuts the chain from base
+
+
+def test_delete_range(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        for i in range(100):
+            db.put(b"key%03d" % i, b"v")
+        db.delete_range(b"key020", b"key040")
+        assert db.get(b"key019") == b"v"
+        assert db.get(b"key020") is None
+        assert db.get(b"key039") is None
+        assert db.get(b"key040") == b"v"
+        # Writes after the tombstone are visible.
+        db.put(b"key025", b"back")
+        assert db.get(b"key025") == b"back"
+        # Survives flush and reopen.
+        db.flush()
+        assert db.get(b"key030") is None
+    with DB.open(tmp_db_path, opts()) as db:
+        assert db.get(b"key030") is None
+        assert db.get(b"key025") == b"back"
+
+
+def test_write_batch_atomic(tmp_db_path):
+    from toplingdb_tpu.db.write_batch import WriteBatch
+
+    with DB.open(tmp_db_path, opts()) as db:
+        b = WriteBatch()
+        b.put(b"a", b"1")
+        b.put(b"b", b"2")
+        b.delete(b"a")
+        db.write(b)
+        assert db.get(b"a") is None
+        assert db.get(b"b") == b"2"
+
+
+def test_reopen_after_many_flushes(tmp_db_path):
+    expected = {}
+    for round_ in range(3):
+        with DB.open(tmp_db_path, opts()) as db:
+            for i in range(50):
+                k = b"key%03d" % (round_ * 50 + i)
+                v = b"r%d" % round_
+                db.put(k, v)
+                expected[k] = v
+            db.flush()
+    with DB.open(tmp_db_path, opts()) as db:
+        for k, v in expected.items():
+            assert db.get(k) == v, k
+
+
+def test_get_property(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put(b"a", b"1")
+        db.flush()
+        assert "L0: 1 files" in db.get_property("tpulsm.stats")
+        assert db.get_property("tpulsm.num-files-at-level0") == "1"

@@ -1,0 +1,128 @@
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options, ReadOptions
+from toplingdb_tpu.utils.merge_operator import StringAppendOperator
+
+
+def opts(**kw):
+    kw.setdefault("write_buffer_size", 16 * 1024)
+    return Options(**kw)
+
+
+@pytest.fixture
+def db(tmp_db_path):
+    with DB.open(tmp_db_path, opts(merge_operator=StringAppendOperator())) as d:
+        yield d
+
+
+def fill(db, n=50, prefix=b"key"):
+    for i in range(n):
+        db.put(prefix + b"%04d" % i, b"v%04d" % i)
+
+
+def test_forward_scan(db):
+    fill(db)
+    it = db.new_iterator()
+    it.seek_to_first()
+    got = list(it.entries())
+    assert got == [(b"key%04d" % i, b"v%04d" % i) for i in range(50)]
+
+
+def test_scan_across_memtable_and_sst(db):
+    fill(db, 30)
+    db.flush()
+    for i in range(30, 60):
+        db.put(b"key%04d" % i, b"v%04d" % i)
+    it = db.new_iterator()
+    it.seek_to_first()
+    assert len(list(it.entries())) == 60
+
+
+def test_newest_version_wins(db):
+    db.put(b"k", b"old")
+    db.flush()
+    db.put(b"k", b"new")
+    it = db.new_iterator()
+    it.seek_to_first()
+    assert list(it.entries()) == [(b"k", b"new")]
+
+
+def test_deleted_keys_hidden(db):
+    fill(db, 10)
+    db.delete(b"key0005")
+    it = db.new_iterator()
+    it.seek_to_first()
+    keys = [k for k, _ in it.entries()]
+    assert b"key0005" not in keys
+    assert len(keys) == 9
+
+
+def test_seek_and_bounds(db):
+    fill(db, 20)
+    it = db.new_iterator(ReadOptions(
+        iterate_lower_bound=b"key0005", iterate_upper_bound=b"key0015"
+    ))
+    it.seek_to_first()
+    keys = [k for k, _ in it.entries()]
+    assert keys[0] == b"key0005"
+    assert keys[-1] == b"key0014"
+    it.seek(b"key0000")
+    assert it.key() == b"key0005"  # clamped to lower bound
+
+
+def test_backward_scan(db):
+    fill(db, 20)
+    db.delete(b"key0010")
+    it = db.new_iterator()
+    it.seek_to_last()
+    got = []
+    while it.valid():
+        got.append(it.key())
+        it.prev()
+    expect = [b"key%04d" % i for i in reversed(range(20)) if i != 10]
+    assert got == expect
+
+
+def test_seek_for_prev(db):
+    fill(db, 10)
+    it = db.new_iterator()
+    it.seek_for_prev(b"key00055")
+    assert it.valid() and it.key() == b"key0005"
+
+
+def test_iterator_snapshot_consistency(db):
+    fill(db, 10)
+    it = db.new_iterator()
+    db.put(b"key0099", b"late")
+    it.seek_to_first()
+    keys = [k for k, _ in it.entries()]
+    assert b"key0099" not in keys  # iterator sees its creation snapshot
+
+
+def test_merge_in_iterator(db):
+    db.put(b"m", b"base")
+    db.merge(b"m", b"x")
+    db.flush()
+    db.merge(b"m", b"y")
+    it = db.new_iterator()
+    it.seek_to_first()
+    assert list(it.entries()) == [(b"m", b"base,x,y")]
+
+
+def test_range_del_in_iterator(db):
+    fill(db, 30)
+    db.flush()
+    db.delete_range(b"key0010", b"key0020")
+    it = db.new_iterator()
+    it.seek_to_first()
+    keys = [k for k, _ in it.entries()]
+    assert len(keys) == 20
+    assert b"key0010" not in keys and b"key0019" not in keys
+    # Backward too.
+    it.seek_to_last()
+    back = []
+    while it.valid():
+        back.append(it.key())
+        it.prev()
+    assert back == list(reversed(keys))

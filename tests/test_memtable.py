@@ -1,0 +1,73 @@
+from toplingdb_tpu.db.dbformat import (
+    InternalKeyComparator,
+    ValueType,
+    make_internal_key,
+    split_internal_key,
+)
+from toplingdb_tpu.db.memtable import MemTable
+
+ICMP = InternalKeyComparator()
+MAXSEQ = 2**56 - 1
+
+
+def test_versions_newest_first():
+    m = MemTable(ICMP)
+    m.add(1, ValueType.VALUE, b"k", b"v1")
+    m.add(5, ValueType.VALUE, b"k", b"v5")
+    m.add(3, ValueType.VALUE, b"k", b"v3")
+    assert [s for s, _, _ in m.entries_for_key(b"k", MAXSEQ)] == [5, 3, 1]
+    # Snapshot at 4 hides seq 5.
+    assert [s for s, _, _ in m.entries_for_key(b"k", 4)] == [3, 1]
+
+
+def test_iteration_order():
+    m = MemTable(ICMP)
+    m.add(2, ValueType.VALUE, b"b", b"vb")
+    m.add(1, ValueType.VALUE, b"a", b"va")
+    m.add(3, ValueType.DELETION, b"a", b"")
+    keys = [split_internal_key(k)[:2] for k, _ in m.iter_entries()]
+    assert keys == [(b"a", 3), (b"a", 1), (b"b", 2)]
+
+
+def test_range_tombstone_coverage():
+    m = MemTable(ICMP)
+    m.add(10, ValueType.RANGE_DELETION, b"c", b"g")
+    assert m.covering_tombstone_seq(b"c", MAXSEQ) == 10
+    assert m.covering_tombstone_seq(b"f", MAXSEQ) == 10
+    assert m.covering_tombstone_seq(b"g", MAXSEQ) == 0  # end exclusive
+    assert m.covering_tombstone_seq(b"b", MAXSEQ) == 0
+    assert m.covering_tombstone_seq(b"d", 9) == 0  # snapshot before tombstone
+
+
+def test_memtable_iterator_protocol():
+    m = MemTable(ICMP)
+    for i in range(10):
+        m.add(i + 1, ValueType.VALUE, b"k%02d" % i, b"v%d" % i)
+    it = m.new_iterator()
+    it.seek_to_first()
+    assert it.valid()
+    ks = []
+    while it.valid():
+        ks.append(split_internal_key(it.key())[0])
+        it.next()
+    assert ks == [b"k%02d" % i for i in range(10)]
+    it.seek(make_internal_key(b"k05", MAXSEQ, 0x7F))
+    assert split_internal_key(it.key())[0] == b"k05"
+    it.prev()
+    assert split_internal_key(it.key())[0] == b"k04"
+    it.seek_to_last()
+    assert split_internal_key(it.key())[0] == b"k09"
+
+
+def test_iterator_stable_under_concurrent_insert():
+    m = MemTable(ICMP)
+    for i in range(0, 20, 2):
+        m.add(i + 1, ValueType.VALUE, b"k%02d" % i, b"v")
+    it = m.new_iterator()
+    it.seek_to_first()
+    seen = [split_internal_key(it.key())[0]]
+    # Insert new keys while iterating; iterator must not skip/repeat.
+    m.add(100, ValueType.VALUE, b"k01", b"new")
+    it.next()
+    seen.append(split_internal_key(it.key())[0])
+    assert seen == [b"k00", b"k01"]

@@ -1,0 +1,67 @@
+import pytest
+
+from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
+from toplingdb_tpu.db.memtable import MemTable
+from toplingdb_tpu.db.write_batch import WriteBatch
+from toplingdb_tpu.utils.status import Corruption
+
+
+def test_batch_encode_iterate():
+    b = WriteBatch()
+    b.put(b"k1", b"v1")
+    b.delete(b"k2")
+    b.merge(b"k3", b"m1")
+    b.single_delete(b"k4")
+    b.delete_range(b"a", b"z")
+    b.put_log_data(b"annotation")  # not counted
+    assert b.count() == 5
+    got = list(b.entries())
+    assert got == [
+        (ValueType.VALUE, b"k1", b"v1"),
+        (ValueType.DELETION, b"k2", None),
+        (ValueType.MERGE, b"k3", b"m1"),
+        (ValueType.SINGLE_DELETION, b"k4", None),
+        (ValueType.RANGE_DELETION, b"a", b"z"),
+    ]
+
+
+def test_batch_roundtrip_bytes():
+    b = WriteBatch()
+    b.put(b"key", b"value")
+    b.set_sequence(42)
+    b2 = WriteBatch(b.data())
+    assert b2.sequence() == 42
+    assert b2.count() == 1
+    assert list(b2.entries()) == list(b.entries())
+
+
+def test_batch_append_from():
+    a = WriteBatch()
+    a.put(b"k1", b"v1")
+    b = WriteBatch()
+    b.put(b"k2", b"v2")
+    a.append_from(b)
+    assert a.count() == 2
+    assert [k for _, k, _ in a.entries()] == [b"k1", b"k2"]
+
+
+def test_count_mismatch_detected():
+    b = WriteBatch()
+    b.put(b"k", b"v")
+    b.set_count(3)
+    with pytest.raises(Corruption):
+        list(b.entries())
+
+
+def test_insert_into_memtable_assigns_seqnos():
+    b = WriteBatch()
+    b.put(b"ka", b"v1")
+    b.put(b"kb", b"v2")
+    b.set_sequence(10)
+    mem = MemTable(InternalKeyComparator())
+    consumed = b.insert_into(mem)
+    assert consumed == 2
+    entries = list(mem.entries_for_key(b"ka", 2**56 - 1))
+    assert entries == [(10, ValueType.VALUE, b"v1")]
+    entries = list(mem.entries_for_key(b"kb", 2**56 - 1))
+    assert entries == [(11, ValueType.VALUE, b"v2")]

@@ -1,0 +1,406 @@
+"""DB: the central engine object.
+
+Analogue of the reference's DBImpl (db/db_impl/db_impl.cc in /root/reference):
+open/recover, the write path (WAL + memtable), point reads through
+memtable → immutables → versioned SST levels, flush, iterators, snapshots,
+and obsolete-file GC. Background compaction is driven by the scheduler in
+toplingdb_tpu/compaction (installed via `_maybe_schedule_compaction`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from toplingdb_tpu.db import dbformat, filename
+from toplingdb_tpu.db.db_iter import DBIter
+from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
+from toplingdb_tpu.db.flush_job import flush_memtable_to_table
+from toplingdb_tpu.db.get_context import GetContext
+from toplingdb_tpu.db.level_iterator import LevelIterator
+from toplingdb_tpu.db.log import LogReader, LogWriter
+from toplingdb_tpu.db.memtable import MemTable
+from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone
+from toplingdb_tpu.db.snapshot import SnapshotList
+from toplingdb_tpu.db.table_cache import TableCache
+from toplingdb_tpu.db.version_edit import VersionEdit
+from toplingdb_tpu.db.version_set import VersionSet
+from toplingdb_tpu.db.write_batch import WriteBatch
+from toplingdb_tpu.env import Env, default_env
+from toplingdb_tpu.options import FlushOptions, Options, ReadOptions, WriteOptions
+from toplingdb_tpu.table.merging_iterator import MergingIterator
+from toplingdb_tpu.utils.status import Corruption, InvalidArgument, NotFound
+
+_DEFAULT_READ = ReadOptions()
+_DEFAULT_WRITE = WriteOptions()
+
+
+class DB:
+    """Single-column-family LSM engine instance. Use DB.open()."""
+
+    def __init__(self, dbname: str, options: Options, env: Env):
+        self.dbname = dbname
+        self.options = options
+        self.env = env
+        self.icmp = InternalKeyComparator(options.comparator)
+        self.versions = VersionSet(env, dbname, self.icmp, options.num_levels)
+        self.table_cache = TableCache(env, dbname, self.icmp, options.table_options)
+        self.mem = MemTable(self.icmp)
+        self.imm: list[MemTable] = []  # immutable memtables, newest first
+        self.snapshots = SnapshotList()
+        self._mutex = threading.RLock()
+        self._wal: LogWriter | None = None
+        self._wal_number = 0
+        self._closed = False
+        self._compaction_scheduler = None  # set by compaction module
+        self._mem_id_counter = 0
+        self.identity = ""
+
+    # ==================================================================
+    # Open / close
+    # ==================================================================
+
+    @staticmethod
+    def open(dbname: str, options: Options | None = None, env: Env | None = None) -> "DB":
+        """Reference DBImpl::Open (db/db_impl/db_impl_open.cc:1906)."""
+        options = options or Options()
+        env = env or default_env()
+        env.create_dir(dbname)
+        db = DB(dbname, options, env)
+        current = filename.current_file_name(dbname)
+        if env.file_exists(current):
+            if options.error_if_exists:
+                raise InvalidArgument(f"{dbname} exists (error_if_exists)")
+            db._recover()
+        else:
+            if not options.create_if_missing:
+                raise InvalidArgument(f"{dbname} does not exist (create_if_missing=False)")
+            db.versions.create_new()
+            env.write_file(
+                filename.identity_file_name(dbname), uuid.uuid4().hex.encode()
+            )
+        try:
+            db.identity = env.read_file(filename.identity_file_name(dbname)).decode()
+        except NotFound:
+            db.identity = uuid.uuid4().hex
+            env.write_file(filename.identity_file_name(dbname), db.identity.encode())
+        db._new_wal()
+        db._delete_obsolete_files()
+        db._maybe_schedule_compaction()
+        return db
+
+    def _recover(self) -> None:
+        self.versions.recover()
+        # Replay WALs >= versions.log_number in file-number order
+        # (reference DBImpl::Recover → RecoverLogFiles).
+        wal_numbers = []
+        for child in self.env.get_children(self.dbname):
+            ftype, num = filename.parse_file_name(child)
+            if ftype == filename.FileType.WAL and num >= self.versions.log_number:
+                wal_numbers.append(num)
+            if ftype in (filename.FileType.WAL, filename.FileType.TABLE,
+                         filename.FileType.MANIFEST):
+                self.versions.mark_file_number_used(num)
+        max_seq = self.versions.last_sequence
+        for num in sorted(wal_numbers):
+            path = filename.log_file_name(self.dbname, num)
+            reader = LogReader(self.env.new_sequential_file(path))
+            for rec in reader.records():
+                batch = WriteBatch(rec)
+                batch.insert_into(self.mem)
+                end_seq = batch.sequence() + batch.count() - 1
+                max_seq = max(max_seq, end_seq)
+        self.versions.last_sequence = max_seq
+        if not self.mem.empty():
+            self._flush_memtables([self.mem], wal_number=self.versions.next_file_number)
+            self.mem = self._fresh_memtable()
+
+    def _fresh_memtable(self) -> MemTable:
+        m = MemTable(self.icmp)
+        self._mem_id_counter += 1
+        m.mem_id = self._mem_id_counter
+        return m
+
+    def _new_wal(self) -> None:
+        self._wal_number = self.versions.new_file_number()
+        w = self.env.new_writable_file(
+            filename.log_file_name(self.dbname, self._wal_number)
+        )
+        self._wal = LogWriter(w)
+
+    def close(self) -> None:
+        with self._mutex:
+            if self._closed:
+                return
+            if not self.mem.empty() or self.imm:
+                self.flush(FlushOptions())
+            if self._wal is not None:
+                self._wal.sync()
+                self._wal.close()
+            self.versions.close()
+            self.table_cache.close()
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ==================================================================
+    # Write path
+    # ==================================================================
+
+    def put(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+        b = WriteBatch()
+        b.put(key, value)
+        self.write(b, opts)
+
+    def delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+        b = WriteBatch()
+        b.delete(key)
+        self.write(b, opts)
+
+    def single_delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+        b = WriteBatch()
+        b.single_delete(key)
+        self.write(b, opts)
+
+    def merge(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+        b = WriteBatch()
+        b.merge(key, value)
+        self.write(b, opts)
+
+    def delete_range(self, begin: bytes, end: bytes,
+                     opts: WriteOptions = _DEFAULT_WRITE) -> None:
+        b = WriteBatch()
+        b.delete_range(begin, end)
+        self.write(b, opts)
+
+    def write(self, batch: WriteBatch, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+        """The write path (reference DBImpl::WriteImpl,
+        db/db_impl/db_impl_write.cc:169): WAL append, then memtable insert,
+        then publish the sequence."""
+        if batch.is_empty():
+            return
+        with self._mutex:
+            self._check_open()
+            seq = self.versions.last_sequence + 1
+            batch.set_sequence(seq)
+            if self.options.wal_enabled and not opts.disable_wal:
+                self._wal.add_record(batch.data())
+                if opts.sync:
+                    self._wal.sync()
+                else:
+                    self._wal.flush()
+            batch.insert_into(self.mem)
+            self.versions.last_sequence = seq + batch.count() - 1
+            if self.mem.approximate_memory_usage() >= self.options.write_buffer_size:
+                self._switch_memtable()
+                self._flush_immutables()
+
+    def _switch_memtable(self) -> None:
+        """Seal the active memtable and start a new WAL (reference
+        DBImpl::SwitchMemtable)."""
+        if self._wal is not None:
+            self._wal.sync()
+            self._wal.close()
+        self.imm.insert(0, self.mem)
+        self.mem = self._fresh_memtable()
+        self._new_wal()
+
+    def _flush_immutables(self) -> None:
+        if not self.imm:
+            return
+        mems = list(self.imm)
+        self._flush_memtables(mems, wal_number=self._wal_number)
+        self.imm = []
+        self._delete_obsolete_files()
+        self._maybe_schedule_compaction()
+
+    def _flush_memtables(self, mems: list[MemTable], wal_number: int) -> None:
+        fnum = self.versions.new_file_number()
+        meta = flush_memtable_to_table(
+            self.env, self.dbname, fnum, self.icmp, mems,
+            self.options.table_options, creation_time=int(time.time()),
+        )
+        edit = VersionEdit(log_number=wal_number)
+        if meta is not None:
+            edit.add_file(0, meta)
+        self.versions.log_and_apply(edit)
+
+    def flush(self, fopts: FlushOptions = FlushOptions()) -> None:
+        with self._mutex:
+            self._check_open()
+            if not self.mem.empty():
+                self._switch_memtable()
+            self._flush_immutables()
+
+    # ==================================================================
+    # Read path
+    # ==================================================================
+
+    def get(self, key: bytes, opts: ReadOptions = _DEFAULT_READ) -> bytes | None:
+        """Point lookup (reference DBImpl::GetImpl, db_impl.cc:2079).
+        Returns None if not found."""
+        self._check_open()
+        snap_seq = (
+            opts.snapshot.sequence if opts.snapshot is not None
+            else self.versions.last_sequence
+        )
+        ctx = GetContext(key, snap_seq, self.options.merge_operator)
+        # 1. Active memtable, then immutables (newest first).
+        for mem in [self.mem] + self.imm:
+            ctx.add_tombstone_seq(mem.covering_tombstone_seq(key, snap_seq))
+            for seq, t, val in mem.entries_for_key(key, snap_seq):
+                if not ctx.save_value(seq, t, val):
+                    return ctx.result()
+        # 2. SST files, newest data first.
+        version = self.versions.current
+        for level, f in version.files_for_get(key):
+            reader = self.table_cache.get_reader(f.number)
+            for begin_ikey, end_uk in reader.range_del_entries():
+                t = RangeTombstone.from_table_entry(begin_ikey, end_uk)
+                ucmp = self.icmp.user_comparator
+                if ucmp.compare(t.begin, key) <= 0 and ucmp.compare(key, t.end) < 0:
+                    ctx.add_tombstone_seq(t.seq)
+            if not reader.key_may_match(key):
+                continue
+            it = reader.new_iterator()
+            it.seek(dbformat.make_internal_key(
+                key, snap_seq, dbformat.VALUE_TYPE_FOR_SEEK
+            ))
+            while it.valid():
+                uk, seq, t = dbformat.split_internal_key(it.key())
+                if self.icmp.user_comparator.compare(uk, key) != 0:
+                    break
+                if seq <= snap_seq:
+                    if not ctx.save_value(seq, t, it.value()):
+                        return ctx.result()
+                it.next()
+        ctx.finish()
+        return ctx.result()
+
+    def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ) -> list[bytes | None]:
+        return [self.get(k, opts) for k in keys]
+
+    def key_exists(self, key: bytes, opts: ReadOptions = _DEFAULT_READ) -> bool:
+        return self.get(key, opts) is not None
+
+    # ==================================================================
+    # Iterators & snapshots
+    # ==================================================================
+
+    def new_iterator(self, opts: ReadOptions = _DEFAULT_READ) -> DBIter:
+        """MVCC iterator over the whole keyspace (reference
+        DBImpl::NewIterator → DBIter over a MergingIterator)."""
+        self._check_open()
+        with self._mutex:
+            snap_seq = (
+                opts.snapshot.sequence if opts.snapshot is not None
+                else self.versions.last_sequence
+            )
+            version = self.versions.current
+            children = []
+            rd = RangeDelAggregator(self.icmp.user_comparator)
+            for mem in [self.mem] + self.imm:
+                children.append(mem.new_iterator())
+                for seq, begin, end in mem.range_del_entries():
+                    rd.add(RangeTombstone(seq, begin, end))
+            for f in version.files[0]:
+                reader = self.table_cache.get_reader(f.number)
+                children.append(reader.new_iterator())
+                for b, e in reader.range_del_entries():
+                    rd.add(RangeTombstone.from_table_entry(b, e))
+            for level in range(1, version.num_levels):
+                if version.files[level]:
+                    children.append(
+                        LevelIterator(self.table_cache, version.files[level], self.icmp)
+                    )
+                    # Only files that actually hold tombstones are opened here
+                    # (num_range_deletions travels in the MANIFEST metadata);
+                    # data blocks are still opened lazily by LevelIterator.
+                    for f in version.files[level]:
+                        if f.num_range_deletions == 0:
+                            continue
+                        reader = self.table_cache.get_reader(f.number)
+                        for b, e in reader.range_del_entries():
+                            rd.add(RangeTombstone.from_table_entry(b, e))
+            internal = MergingIterator(self.icmp.compare, children)
+            return DBIter(
+                internal, self.icmp, snap_seq,
+                range_del_agg=None if rd.empty() else rd,
+                merge_operator=self.options.merge_operator,
+                lower_bound=opts.iterate_lower_bound,
+                upper_bound=opts.iterate_upper_bound,
+            )
+
+    def get_snapshot(self):
+        return self.snapshots.new_snapshot(self.versions.last_sequence)
+
+    def release_snapshot(self, snap) -> None:
+        snap.release()
+
+    # ==================================================================
+    # Maintenance
+    # ==================================================================
+
+    def compact_range(self, begin: bytes | None = None, end: bytes | None = None) -> None:
+        """Manual compaction; wired up by the compaction module."""
+        self.flush()
+        if self._compaction_scheduler is not None:
+            self._compaction_scheduler.compact_range(begin, end)
+
+    def _maybe_schedule_compaction(self) -> None:
+        if self._compaction_scheduler is not None and not self.options.disable_auto_compactions:
+            self._compaction_scheduler.maybe_schedule()
+
+    def _delete_obsolete_files(self) -> None:
+        """GC: remove WALs below the manifest log number, non-live SSTs, and
+        stale MANIFESTs (reference DBImpl::DeleteObsoleteFiles)."""
+        live = self.versions.live_files()
+        for child in self.env.get_children(self.dbname):
+            ftype, num = filename.parse_file_name(child)
+            keep = True
+            if ftype == filename.FileType.WAL:
+                keep = num >= self.versions.log_number or num == self._wal_number
+            elif ftype == filename.FileType.TABLE:
+                keep = num in live
+            elif ftype == filename.FileType.MANIFEST:
+                keep = num == self.versions.manifest_file_number
+            elif ftype == filename.FileType.TEMP:
+                keep = False
+            if not keep:
+                if ftype == filename.FileType.TABLE:
+                    self.table_cache.evict(num)
+                try:
+                    self.env.delete_file(f"{self.dbname}/{child}")
+                except NotFound:
+                    pass
+
+    def get_property(self, name: str) -> str | None:
+        v = self.versions.current
+        if name == "tpulsm.stats" or name == "tpulsm.levelstats":
+            lines = [f"last_seq={self.versions.last_sequence} "
+                     f"mem_entries={self.mem.num_entries} imm={len(self.imm)}"]
+            for level in range(v.num_levels):
+                n = len(v.files[level])
+                if n:
+                    lines.append(f"L{level}: {n} files {v.total_bytes(level)} bytes")
+            return "\n".join(lines)
+        if name == "tpulsm.num-files":
+            return str(v.num_files())
+        if name.startswith("tpulsm.num-files-at-level"):
+            try:
+                lvl = int(name[len("tpulsm.num-files-at-level"):])
+            except ValueError:
+                return None
+            return str(len(v.files[lvl])) if 0 <= lvl < v.num_levels else None
+        return None
+
+    def _check_open(self) -> None:
+        if self._closed:
+            from toplingdb_tpu.utils.status import ShutdownInProgress
+
+            raise ShutdownInProgress("DB is closed")

@@ -1,0 +1,65 @@
+"""FlushJob: memtable → L0 SST (reference db/flush_job.cc:213,833
+`WriteLevel0Table` in /root/reference)."""
+
+from __future__ import annotations
+
+from toplingdb_tpu.db import filename
+from toplingdb_tpu.db.memtable import MemTable
+from toplingdb_tpu.db.range_del import RangeTombstone, fragment_tombstones
+from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
+from toplingdb_tpu.table.builder import TableBuilder
+from toplingdb_tpu.table.merging_iterator import MergingIterator
+
+
+def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
+                            memtables: list[MemTable], table_options,
+                            creation_time: int = 0) -> FileMetaData | None:
+    """Write one or more memtables (newest first) to a single L0 SST via a
+    k-way merge of their already-sorted iterators. Returns None if there was
+    nothing to write."""
+    tombstones: list[RangeTombstone] = []
+    total = 0
+    for mem in memtables:
+        total += len(mem._rep)
+        for seq, begin, end in mem.range_del_entries():
+            tombstones.append(RangeTombstone(seq, begin, end))
+    if total == 0 and not tombstones:
+        return None
+
+    path = filename.table_file_name(dbname, file_number)
+    w = env.new_writable_file(path)
+    try:
+        builder = TableBuilder(
+            w, icmp, table_options, creation_time=creation_time
+        )
+        merger = MergingIterator(
+            icmp.compare, [m.new_iterator() for m in memtables]
+        )
+        merger.seek_to_first()
+        last_ikey = None
+        for ikey, val in merger.entries():
+            # Exact duplicate internal keys across memtables (WAL replay):
+            # the newer source (lower child index) surfaced first; skip dups.
+            if last_ikey is not None and icmp.compare(last_ikey, ikey) == 0:
+                continue
+            builder.add(ikey, val)
+            last_ikey = ikey
+        for frag in fragment_tombstones(tombstones, icmp.user_comparator):
+            begin_ikey, end_uk = frag.to_table_entry()
+            builder.add_tombstone(begin_ikey, end_uk)
+        props = builder.finish()
+        w.sync()
+    finally:
+        w.close()
+
+    return FileMetaData(
+        number=file_number,
+        file_size=env.get_file_size(path),
+        smallest=builder.smallest_key,
+        largest=builder.largest_key,
+        smallest_seqno=props.smallest_seqno,
+        largest_seqno=props.largest_seqno,
+        num_entries=props.num_entries,
+        num_deletions=props.num_deletions,
+        num_range_deletions=props.num_range_deletions,
+    )

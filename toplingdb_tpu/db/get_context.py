@@ -1,0 +1,104 @@
+"""GetContext: the per-lookup state machine.
+
+Same role as the reference's GetContext (table/get_context.h:67 in
+/root/reference): sources (memtable, immutable memtables, L0 files newest→
+oldest, then deeper levels) feed visible entries for the target user key in
+newest→oldest order; the context tracks kNotFound → kFound/kDeleted/
+kMerge-in-progress transitions, accumulates merge operands, and respects the
+max covering range-tombstone seqno seen so far.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from toplingdb_tpu.db.dbformat import ValueType
+from toplingdb_tpu.utils.status import Corruption, MergeInProgress
+
+
+class GetState(enum.Enum):
+    NOT_FOUND = 0
+    FOUND = 1
+    DELETED = 2
+    MERGE = 3       # operand chain open; keep descending into older sources
+    CORRUPT = 4
+
+
+class GetContext:
+    def __init__(self, user_key: bytes, snapshot_seq: int, merge_operator=None):
+        self.user_key = user_key
+        self.snapshot_seq = snapshot_seq
+        self.merge_operator = merge_operator
+        self.state = GetState.NOT_FOUND
+        self.value: bytes | None = None
+        self.operands: list[bytes] = []   # collected newest→oldest
+        self.max_covering_tombstone_seq = 0
+        self.found_final_value = False
+
+    # ------------------------------------------------------------------
+
+    def add_tombstone_seq(self, seq: int) -> None:
+        """Register a range tombstone covering the key (from the current or a
+        newer source)."""
+        if seq <= self.snapshot_seq and seq > self.max_covering_tombstone_seq:
+            self.max_covering_tombstone_seq = seq
+
+    def save_value(self, seq: int, t: int, value: bytes) -> bool:
+        """Feed one visible point entry (seq <= snapshot already filtered by
+        caller, newest first). Returns False when the lookup is complete and
+        no older sources need to be consulted."""
+        assert not self.found_final_value
+        if seq <= self.max_covering_tombstone_seq:
+            t = ValueType.DELETION  # shadowed by a newer range tombstone
+        if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+            if self.state == GetState.MERGE:
+                self.state = GetState.FOUND
+                self.value = self._fold(value)
+            else:
+                self.state = GetState.FOUND
+                self.value = value
+            self.found_final_value = True
+            return False
+        if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
+            if self.state == GetState.MERGE:
+                self.state = GetState.FOUND
+                self.value = self._fold(None)
+            else:
+                self.state = GetState.DELETED
+            self.found_final_value = True
+            return False
+        if t == ValueType.MERGE:
+            if self.merge_operator is None:
+                self.state = GetState.CORRUPT
+                self.found_final_value = True
+                return False
+            self.state = GetState.MERGE
+            self.operands.append(value)
+            return True
+        raise Corruption(f"unexpected value type {t} in lookup")
+
+    def finish(self) -> None:
+        """No more sources. Resolve an open merge chain against no base."""
+        if self.state == GetState.MERGE:
+            self.value = self._fold(None)
+            self.state = GetState.FOUND
+            self.found_final_value = True
+
+    def _fold(self, base: bytes | None) -> bytes:
+        # operands were collected newest→oldest; full_merge wants oldest→newest.
+        return self.merge_operator.full_merge(
+            self.user_key, base, list(reversed(self.operands))
+        )
+
+    # ------------------------------------------------------------------
+
+    def result(self) -> bytes | None:
+        """Returns the value, or None if not found / deleted. Raises on
+        merge-without-operator."""
+        if self.state == GetState.CORRUPT:
+            raise MergeInProgress(
+                "merge operands found but no merge_operator configured"
+            )
+        if self.state == GetState.FOUND:
+            return self.value
+        return None

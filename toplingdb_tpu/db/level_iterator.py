@@ -1,0 +1,94 @@
+"""LevelIterator: concatenation over one sorted level's files
+(reference's LevelIterator inside db/version_set.cc)."""
+
+from __future__ import annotations
+
+from toplingdb_tpu.db.version_edit import FileMetaData
+
+
+class LevelIterator:
+    def __init__(self, table_cache, files: list[FileMetaData], icmp):
+        self._tc = table_cache
+        self._files = files
+        self._icmp = icmp
+        self._file_idx = -1
+        self._iter = None
+
+    def _open(self, idx: int) -> None:
+        self._file_idx = idx
+        if 0 <= idx < len(self._files):
+            reader = self._tc.get_reader(self._files[idx].number)
+            self._iter = reader.new_iterator()
+        else:
+            self._iter = None
+
+    def valid(self) -> bool:
+        return self._iter is not None and self._iter.valid()
+
+    def key(self):
+        return self._iter.key()
+
+    def value(self):
+        return self._iter.value()
+
+    def seek_to_first(self) -> None:
+        self._open(0)
+        if self._iter is not None:
+            self._iter.seek_to_first()
+            self._skip_forward()
+
+    def seek_to_last(self) -> None:
+        self._open(len(self._files) - 1)
+        if self._iter is not None:
+            self._iter.seek_to_last()
+            self._skip_backward()
+
+    def seek(self, target) -> None:
+        # Binary search for first file whose largest >= target.
+        lo, hi = 0, len(self._files) - 1
+        pick = len(self._files)
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            if self._icmp.compare(self._files[mid].largest, target) >= 0:
+                pick = mid
+                hi = mid - 1
+            else:
+                lo = mid + 1
+        self._open(pick)
+        if self._iter is not None:
+            self._iter.seek(target)
+            self._skip_forward()
+
+    def seek_for_prev(self, target) -> None:
+        self.seek(target)
+        if not self.valid():
+            self.seek_to_last()
+            return
+        if self._icmp.compare(self.key(), target) > 0:
+            self.prev()
+
+    def next(self) -> None:
+        assert self.valid()
+        self._iter.next()
+        self._skip_forward()
+
+    def prev(self) -> None:
+        assert self.valid()
+        self._iter.prev()
+        self._skip_backward()
+
+    def _skip_forward(self) -> None:
+        while self._iter is not None and not self._iter.valid():
+            if self._file_idx + 1 >= len(self._files):
+                self._iter = None
+                return
+            self._open(self._file_idx + 1)
+            self._iter.seek_to_first()
+
+    def _skip_backward(self) -> None:
+        while self._iter is not None and not self._iter.valid():
+            if self._file_idx - 1 < 0:
+                self._iter = None
+                return
+            self._open(self._file_idx - 1)
+            self._iter.seek_to_last()

@@ -1,0 +1,167 @@
+"""WAL / MANIFEST record framing: writer and reader.
+
+Same framing as the reference's log format (db/log_format.h:20-43,
+db/log_writer.cc, db/log_reader.cc in /root/reference): the file is a
+sequence of 32KiB blocks; each record fragment is
+    masked_crc32c(4B) | length(2B LE) | type(1B) | payload
+with type FULL/FIRST/MIDDLE/LAST so records can span blocks; a block's unusable
+tail (<7B) is zero-padded. The CRC covers type+payload. Both the WAL and the
+MANIFEST use this framing.
+"""
+
+from __future__ import annotations
+
+from toplingdb_tpu.utils import coding, crc32c
+from toplingdb_tpu.utils.status import Corruption
+
+BLOCK_SIZE = 32768
+HEADER_SIZE = 7
+
+FULL = 1
+FIRST = 2
+MIDDLE = 3
+LAST = 4
+
+
+class LogWriter:
+    def __init__(self, wfile):
+        self._f = wfile
+        self._block_offset = wfile.file_size() % BLOCK_SIZE
+
+    def add_record(self, data: bytes) -> None:
+        left = len(data)
+        pos = 0
+        begin = True
+        while True:
+            leftover = BLOCK_SIZE - self._block_offset
+            if leftover < HEADER_SIZE:
+                if leftover > 0:
+                    self._f.append(b"\x00" * leftover)
+                self._block_offset = 0
+                leftover = BLOCK_SIZE
+            avail = leftover - HEADER_SIZE
+            frag = min(left, avail)
+            end = left == frag
+            if begin and end:
+                t = FULL
+            elif begin:
+                t = FIRST
+            elif end:
+                t = LAST
+            else:
+                t = MIDDLE
+            self._emit(t, data[pos : pos + frag])
+            pos += frag
+            left -= frag
+            begin = False
+            if left == 0:
+                break
+
+    def _emit(self, t: int, frag: bytes) -> None:
+        crc = crc32c.value(bytes([t]) + frag)
+        hdr = (
+            coding.encode_fixed32(crc32c.mask(crc))
+            + coding.encode_fixed16(len(frag))
+            + bytes([t])
+        )
+        self._f.append(hdr)
+        self._f.append(frag)
+        self._block_offset += HEADER_SIZE + len(frag)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def sync(self) -> None:
+        self._f.sync()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class LogReader:
+    """Sequential record reader. By default tolerates a truncated tail (the
+    normal crash case — reference log_reader's eof handling) but raises
+    Corruption on checksum mismatches in the middle of the log."""
+
+    def __init__(self, sfile, verify_checksums: bool = True):
+        self._f = sfile
+        self._verify = verify_checksums
+        self._buf = b""
+        self._buf_off = 0
+        self._eof = False
+
+    def _read_block(self) -> bool:
+        data = self._f.read(BLOCK_SIZE)
+        self._buf = data
+        self._buf_off = 0
+        if len(data) < BLOCK_SIZE:
+            self._eof = True
+        return len(data) > 0
+
+    def _next_fragment(self):
+        """Returns (type, payload) or None at end of log."""
+        while True:
+            if self._buf_off + HEADER_SIZE > len(self._buf):
+                if self._eof:
+                    return None
+                if not self._read_block():
+                    return None
+                continue
+            b = self._buf
+            off = self._buf_off
+            stored_crc = coding.decode_fixed32(b, off)
+            length = coding.decode_fixed16(b, off + 4)
+            t = b[off + 6]
+            if t == 0 and length == 0:
+                # Zero-padded block tail; skip to the next block.
+                self._buf_off = len(self._buf)
+                continue
+            if off + HEADER_SIZE + length > len(b):
+                if self._eof:
+                    return None  # truncated tail fragment: drop it
+                raise Corruption("log fragment overflows block")
+            payload = b[off + HEADER_SIZE : off + HEADER_SIZE + length]
+            self._buf_off = off + HEADER_SIZE + length
+            if self._verify:
+                actual = crc32c.value(bytes([t]) + payload)
+                if crc32c.unmask(stored_crc) != actual:
+                    if self._eof:
+                        return None  # torn final write
+                    raise Corruption("log record checksum mismatch")
+            return t, payload
+
+    def read_record(self) -> bytes | None:
+        """Returns the next full record, or None at clean end-of-log."""
+        partial = None
+        while True:
+            frag = self._next_fragment()
+            if frag is None:
+                # A dangling FIRST/MIDDLE chain at EOF is a torn write: drop.
+                return None
+            t, payload = frag
+            if t == FULL:
+                if partial is not None:
+                    raise Corruption("FULL record inside fragmented record")
+                return bytes(payload)
+            if t == FIRST:
+                if partial is not None:
+                    raise Corruption("FIRST record inside fragmented record")
+                partial = bytearray(payload)
+            elif t == MIDDLE:
+                if partial is None:
+                    raise Corruption("MIDDLE record without FIRST")
+                partial += payload
+            elif t == LAST:
+                if partial is None:
+                    raise Corruption("LAST record without FIRST")
+                partial += payload
+                return bytes(partial)
+            else:
+                raise Corruption(f"unknown log record type {t}")
+
+    def records(self):
+        while True:
+            r = self.read_record()
+            if r is None:
+                return
+            yield r

@@ -1,0 +1,227 @@
+"""MemTable: the in-memory sorted run, with pluggable representations.
+
+Role matches the reference MemTable (db/memtable.cc:1263 `Get`, `Add`;
+rep factories at include/rocksdb/memtablerep.h:64,309 in /root/reference).
+Entries are ordered by (user_key asc, packed(seqno,type) desc) — internal key
+order. Range tombstones are kept in a side list (like the reference's separate
+range_del memtable) and fragmented at read time.
+
+Reps:
+  PyVectorRep  — bisect-maintained sorted list (the default pure-Python rep;
+                 analogue of VectorRep + always-sorted).
+Future: native C++ skiplist via ctypes, CSPP-style trie.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.dbformat import ValueType
+
+_MAX_PACKED = (1 << 64) - 1
+
+
+def _sort_key(user_key: bytes, packed: int) -> tuple[bytes, int]:
+    # Ascending tuple order == internal key order (seqno/type descending).
+    return (user_key, _MAX_PACKED - packed)
+
+
+class MemTableRep:
+    """Pluggable sorted container of ((user_key, inv_packed) -> value)."""
+
+    def insert(self, skey, value: bytes) -> None:
+        raise NotImplementedError
+
+    def iter_from(self, skey):
+        raise NotImplementedError
+
+    def iter_all(self):
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class PyVectorRep(MemTableRep):
+    """Entries are stored as single (sort_key, value) tuples in ONE list so
+    every insert is a single list mutation — atomic under the GIL — and
+    lockless readers can never observe a key paired with the wrong value."""
+
+    def __init__(self):
+        self._items: list[tuple[tuple[bytes, int], bytes]] = []
+
+    def insert(self, skey, value: bytes) -> None:
+        i = bisect.bisect_left(self._items, skey, key=lambda it: it[0])
+        if i < len(self._items) and self._items[i][0] == skey:
+            # Same (user_key, seqno, type) re-inserted (WAL replay): last wins.
+            self._items[i] = (skey, value)
+            return
+        self._items.insert(i, (skey, value))
+
+    def iter_from(self, skey):
+        i = bisect.bisect_left(self._items, skey, key=lambda it: it[0])
+        while i < len(self._items):
+            yield self._items[i]
+            i += 1
+
+    def iter_all(self):
+        yield from self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class MemTable:
+    def __init__(self, icmp: dbformat.InternalKeyComparator, rep: MemTableRep | None = None):
+        self._icmp = icmp
+        self._rep = rep if rep is not None else PyVectorRep()
+        self._range_dels: list[tuple[int, bytes, bytes]] = []  # (seq, begin, end)
+        self._mem_usage = 0
+        self._num_entries = 0
+        self._num_deletes = 0
+        self._first_seqno: int | None = None
+        self._lock = threading.Lock()
+        self.mem_id = 0
+
+    # ------------------------------------------------------------------
+
+    def add(self, seq: int, t: int, user_key: bytes, value: bytes) -> None:
+        with self._lock:
+            if t == ValueType.RANGE_DELETION:
+                self._range_dels.append((seq, user_key, value))
+            else:
+                packed = dbformat.pack_seq_type(seq, t)
+                self._rep.insert(_sort_key(user_key, packed), value)
+            self._num_entries += 1
+            if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
+                self._num_deletes += 1
+            self._mem_usage += len(user_key) + len(value) + 24
+            if self._first_seqno is None:
+                self._first_seqno = seq
+
+    def entries_for_key(self, user_key: bytes, snapshot_seq: int):
+        """Yield (seq, type, value) for user_key with seq <= snapshot,
+        newest first — the feed for GetContext."""
+        start = _sort_key(user_key, dbformat.pack_seq_type(snapshot_seq, 0xFF))
+        for (uk, inv), val in self._rep.iter_from(start):
+            if uk != user_key:
+                break
+            seq, t = dbformat.unpack_seq_type(_MAX_PACKED - inv)
+            if seq > snapshot_seq:
+                continue
+            yield seq, t, val
+
+    def covering_tombstone_seq(self, user_key: bytes, snapshot_seq: int) -> int:
+        """Max seqno of a range tombstone covering user_key at the snapshot
+        (0 = none)."""
+        best = 0
+        ucmp = self._icmp.user_comparator
+        for seq, begin, end in self._range_dels:
+            if seq <= snapshot_seq and ucmp.compare(begin, user_key) <= 0 \
+                    and ucmp.compare(user_key, end) < 0:
+                best = max(best, seq)
+        return best
+
+    # ------------------------------------------------------------------
+
+    def iter_entries(self):
+        """Yields (internal_key, value) in internal key order (point entries
+        only; range tombstones via range_del_entries)."""
+        for (uk, inv), val in self._rep.iter_all():
+            seq, t = dbformat.unpack_seq_type(_MAX_PACKED - inv)
+            yield dbformat.make_internal_key(uk, seq, t), val
+
+    def iter_from(self, ikey: bytes):
+        uk, seq, t = dbformat.split_internal_key(ikey)
+        start = _sort_key(uk, dbformat.pack_seq_type(seq, t))
+        for (k, inv), val in self._rep.iter_from(start):
+            s, tt = dbformat.unpack_seq_type(_MAX_PACKED - inv)
+            yield dbformat.make_internal_key(k, s, tt), val
+
+    def range_del_entries(self):
+        """Yields (seq, begin_user_key, end_user_key)."""
+        yield from self._range_dels
+
+    # ------------------------------------------------------------------
+
+    def new_iterator(self) -> "MemTableIterator":
+        return MemTableIterator(self)
+
+    def approximate_memory_usage(self) -> int:
+        return self._mem_usage
+
+    @property
+    def num_entries(self) -> int:
+        return self._num_entries
+
+    @property
+    def num_deletes(self) -> int:
+        return self._num_deletes
+
+    @property
+    def first_seqno(self):
+        return self._first_seqno
+
+    def empty(self) -> bool:
+        return self._num_entries == 0
+
+
+class MemTableIterator:
+    """Standard iterator protocol over a memtable's point entries.
+
+    Tolerates concurrent inserts: positions are re-derived by bisect on the
+    stored sort key, so list shifts cannot skip or repeat entries (the Python
+    analogue of iterating a lock-free skiplist)."""
+
+    def __init__(self, mem: MemTable):
+        self._mem = mem
+        self._rep: PyVectorRep = mem._rep  # type: ignore[assignment]
+        self._skey = None   # current (user_key, inv_packed) or None
+        self._value = None
+
+    def _load(self, i: int) -> None:
+        items = self._rep._items
+        if 0 <= i < len(items):
+            self._skey, self._value = items[i]
+        else:
+            self._skey = None
+            self._value = None
+
+    def valid(self) -> bool:
+        return self._skey is not None
+
+    def key(self) -> bytes:
+        uk, inv = self._skey
+        seq, t = dbformat.unpack_seq_type(_MAX_PACKED - inv)
+        return dbformat.make_internal_key(uk, seq, t)
+
+    def value(self) -> bytes:
+        return self._value
+
+    def seek_to_first(self) -> None:
+        self._load(0)
+
+    def seek_to_last(self) -> None:
+        self._load(len(self._rep._items) - 1)
+
+    def seek(self, ikey: bytes) -> None:
+        uk, seq, t = dbformat.split_internal_key(ikey)
+        skey = _sort_key(uk, dbformat.pack_seq_type(seq, t))
+        self._load(bisect.bisect_left(self._rep._items, skey, key=lambda it: it[0]))
+
+    def seek_for_prev(self, ikey: bytes) -> None:
+        uk, seq, t = dbformat.split_internal_key(ikey)
+        skey = _sort_key(uk, dbformat.pack_seq_type(seq, t))
+        self._load(bisect.bisect_right(self._rep._items, skey, key=lambda it: it[0]) - 1)
+
+    def next(self) -> None:
+        assert self.valid()
+        i = bisect.bisect_right(self._rep._items, self._skey, key=lambda it: it[0])
+        self._load(i)
+
+    def prev(self) -> None:
+        assert self.valid()
+        i = bisect.bisect_left(self._rep._items, self._skey, key=lambda it: it[0])
+        self._load(i - 1)

@@ -1,0 +1,61 @@
+"""TableCache: cache of open TableReaders keyed by file number
+(reference db/table_cache.cc:92 in /root/reference)."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from toplingdb_tpu.db import filename
+from toplingdb_tpu.db.dbformat import InternalKeyComparator
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.table.reader import TableReader
+
+
+class TableCache:
+    def __init__(self, env, dbname: str, icmp: InternalKeyComparator,
+                 table_options: TableOptions | None = None, capacity: int = 512,
+                 block_cache=None):
+        self._env = env
+        self._dbname = dbname
+        self._icmp = icmp
+        self._topts = table_options or TableOptions()
+        self._capacity = capacity
+        self._block_cache = block_cache
+        self._readers: OrderedDict[int, TableReader] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get_reader(self, file_number: int) -> TableReader:
+        with self._lock:
+            r = self._readers.get(file_number)
+            if r is not None:
+                self._readers.move_to_end(file_number)
+                return r
+        path = filename.table_file_name(self._dbname, file_number)
+        r = TableReader(
+            self._env.new_random_access_file(path), self._icmp, self._topts,
+            block_cache=self._block_cache,
+            cache_key_prefix=file_number.to_bytes(8, "little"),
+        )
+        with self._lock:
+            existing = self._readers.get(file_number)
+            if existing is not None:
+                r.close()
+                return existing
+            self._readers[file_number] = r
+            while len(self._readers) > self._capacity:
+                # Drop the reference only: live iterators may still hold the
+                # reader; its file handle is reclaimed when the last reference
+                # dies (the Python analogue of the reference's cache pinning).
+                self._readers.popitem(last=False)
+            return r
+
+    def evict(self, file_number: int) -> None:
+        with self._lock:
+            self._readers.pop(file_number, None)
+
+    def close(self) -> None:
+        with self._lock:
+            for r in self._readers.values():
+                r.close()
+            self._readers.clear()

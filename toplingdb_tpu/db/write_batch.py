@@ -1,0 +1,129 @@
+"""WriteBatch: the serialized update record and WAL payload.
+
+Same wire shape as the reference (db/write_batch.cc in /root/reference):
+    fixed64 sequence | fixed32 count | records*
+where each record is a type byte followed by length-prefixed slices:
+    VALUE            key value
+    DELETION         key
+    SINGLE_DELETION  key
+    MERGE            key value
+    RANGE_DELETION   begin_key end_key
+    LOG_DATA         blob                (not counted, not applied)
+A batch is the atomic unit of the write path: it is appended to the WAL as one
+record and then applied to the memtable entry by entry with consecutive
+sequence numbers.
+"""
+
+from __future__ import annotations
+
+from toplingdb_tpu.db.dbformat import ValueType
+from toplingdb_tpu.utils import coding
+from toplingdb_tpu.utils.status import Corruption
+
+HEADER_SIZE = 12
+
+
+class WriteBatch:
+    def __init__(self, data: bytes | None = None):
+        if data is not None:
+            if len(data) < HEADER_SIZE:
+                raise Corruption("write batch header too small")
+            self._rep = bytearray(data)
+        else:
+            self._rep = bytearray(HEADER_SIZE)
+
+    # -- mutation -------------------------------------------------------
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._add_record(ValueType.VALUE, key, value)
+
+    def delete(self, key: bytes) -> None:
+        self._add_record(ValueType.DELETION, key)
+
+    def single_delete(self, key: bytes) -> None:
+        self._add_record(ValueType.SINGLE_DELETION, key)
+
+    def merge(self, key: bytes, value: bytes) -> None:
+        self._add_record(ValueType.MERGE, key, value)
+
+    def delete_range(self, begin: bytes, end: bytes) -> None:
+        self._add_record(ValueType.RANGE_DELETION, begin, end)
+
+    def put_log_data(self, blob: bytes) -> None:
+        self._rep.append(ValueType.LOG_DATA)
+        coding.put_length_prefixed_slice(self._rep, blob)
+
+    def _add_record(self, t: ValueType, *slices: bytes) -> None:
+        self._rep.append(t)
+        for s in slices:
+            coding.put_length_prefixed_slice(self._rep, s)
+        self.set_count(self.count() + 1)
+
+    def clear(self) -> None:
+        self._rep = bytearray(HEADER_SIZE)
+
+    def append_from(self, other: "WriteBatch") -> None:
+        """Group-commit helper: append other's records to self."""
+        self._rep += other._rep[HEADER_SIZE:]
+        self.set_count(self.count() + other.count())
+
+    # -- header ---------------------------------------------------------
+
+    def sequence(self) -> int:
+        return coding.decode_fixed64(self._rep, 0)
+
+    def set_sequence(self, seq: int) -> None:
+        self._rep[0:8] = coding.encode_fixed64(seq)
+
+    def count(self) -> int:
+        return coding.decode_fixed32(self._rep, 8)
+
+    def set_count(self, n: int) -> None:
+        self._rep[8:12] = coding.encode_fixed32(n)
+
+    def data(self) -> bytes:
+        return bytes(self._rep)
+
+    def data_size(self) -> int:
+        return len(self._rep)
+
+    def is_empty(self) -> bool:
+        return self.count() == 0
+
+    # -- iteration ------------------------------------------------------
+
+    def entries(self):
+        """Yields (value_type, key, value_or_none). RANGE_DELETION yields
+        (type, begin_key, end_key). LOG_DATA is skipped."""
+        rep = self._rep
+        off = HEADER_SIZE
+        n = 0
+        while off < len(rep):
+            t = rep[off]
+            off += 1
+            if t in (ValueType.VALUE, ValueType.MERGE, ValueType.RANGE_DELETION):
+                k, off = coding.get_length_prefixed_slice(rep, off)
+                v, off = coding.get_length_prefixed_slice(rep, off)
+                yield t, k, v
+                n += 1
+            elif t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
+                k, off = coding.get_length_prefixed_slice(rep, off)
+                yield t, k, None
+                n += 1
+            elif t == ValueType.LOG_DATA:
+                _, off = coding.get_length_prefixed_slice(rep, off)
+            else:
+                raise Corruption(f"unknown write batch record type {t}")
+        if n != self.count():
+            raise Corruption(
+                f"write batch count mismatch: header {self.count()}, actual {n}"
+            )
+
+    def insert_into(self, memtable, sequence: int | None = None) -> int:
+        """Apply to a memtable; returns the number of sequence numbers
+        consumed (== count)."""
+        seq = self.sequence() if sequence is None else sequence
+        for t, k, v in self.entries():
+            memtable.add(seq, t, k, v if v is not None else b"")
+            seq += 1
+        return self.count()

@@ -1,0 +1,110 @@
+"""DB and column-family options.
+
+Condensed analogue of the reference's DBOptions/ColumnFamilyOptions
+(include/rocksdb/options.h in /root/reference), keeping the fields the engine
+actually consults. Construction-from-JSON lives in utils/config.py (the
+SidePlugin-equivalent layer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from toplingdb_tpu.db.dbformat import BYTEWISE, Comparator
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.builder import TableOptions
+
+
+@dataclass
+class Options:
+    # -- DB behavior ----------------------------------------------------
+    create_if_missing: bool = True
+    error_if_exists: bool = False
+    paranoid_checks: bool = True
+    comparator: Comparator = field(default_factory=lambda: BYTEWISE)
+    merge_operator: Any = None          # MergeOperator instance or None
+    compaction_filter: Any = None
+
+    # -- write path -----------------------------------------------------
+    write_buffer_size: int = 4 * 1024 * 1024
+    max_write_buffer_number: int = 2
+    db_write_buffer_size: int = 0       # 0 = unlimited (WriteBufferManager)
+    wal_enabled: bool = True
+
+    # -- LSM shape ------------------------------------------------------
+    num_levels: int = 7
+    level0_file_num_compaction_trigger: int = 4
+    level0_slowdown_writes_trigger: int = 20
+    level0_stop_writes_trigger: int = 36
+    max_bytes_for_level_base: int = 64 * 1024 * 1024
+    max_bytes_for_level_multiplier: float = 10.0
+    target_file_size_base: int = 8 * 1024 * 1024
+    target_file_size_multiplier: int = 1
+    max_compaction_bytes: int = 25 * 8 * 1024 * 1024
+    compaction_style: str = "leveled"   # leveled | universal | fifo
+
+    # universal compaction knobs (reference universal_compaction.h)
+    universal_size_ratio: int = 1
+    universal_min_merge_width: int = 2
+    universal_max_merge_width: int = 2**31 - 1
+    universal_max_size_amplification_percent: int = 200
+
+    # fifo knobs
+    fifo_max_table_files_size: int = 1024 * 1024 * 1024
+
+    # -- background work ------------------------------------------------
+    max_background_jobs: int = 2
+    max_subcompactions: int = 1
+    disable_auto_compactions: bool = False
+
+    # -- table format ---------------------------------------------------
+    table_options: TableOptions = field(default_factory=TableOptions)
+    compression: int = fmt.NO_COMPRESSION
+    bottommost_compression: Optional[int] = None
+
+    # -- distributed compaction (the dcompact boundary) -----------------
+    compaction_executor_factory: Any = None  # CompactionExecutorFactory
+
+    # -- observability --------------------------------------------------
+    statistics: Any = None
+    listeners: list = field(default_factory=list)
+    info_log: Any = None
+
+    def max_bytes_for_level(self, level: int) -> int:
+        """Target size of level L (L>=1)."""
+        base = self.max_bytes_for_level_base
+        mult = self.max_bytes_for_level_multiplier
+        size = base
+        for _ in range(1, level):
+            size = int(size * mult)
+        return size
+
+    def target_file_size(self, level: int) -> int:
+        size = self.target_file_size_base
+        for _ in range(1, max(1, level)):
+            size *= self.target_file_size_multiplier
+        return size
+
+
+@dataclass
+class ReadOptions:
+    verify_checksums: bool = True
+    snapshot: Any = None                # Snapshot object or None
+    fill_cache: bool = True
+    iterate_lower_bound: Optional[bytes] = None
+    iterate_upper_bound: Optional[bytes] = None
+    # Topling extension analogue: return existence without copying the value
+    # (reference include/rocksdb/options.h:1637 just_check_key_exists).
+    just_check_key_exists: bool = False
+
+
+@dataclass
+class WriteOptions:
+    sync: bool = False
+    disable_wal: bool = False
+
+
+@dataclass
+class FlushOptions:
+    wait: bool = True

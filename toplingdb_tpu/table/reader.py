@@ -63,6 +63,7 @@ class TableReader:
             )
 
         self._range_del_data: bytes | None = None
+        self._range_del_cache: list[tuple[bytes, bytes]] | None = None
         rh = self._meta_handles.get(METAINDEX_RANGE_DEL)
         if rh is not None:
             self._range_del_data = fmt.read_block(rfile, rh, self.opts.verify_checksums)
@@ -92,12 +93,15 @@ class TableReader:
         return TableIterator(self)
 
     def range_del_entries(self) -> list[tuple[bytes, bytes]]:
-        """Raw (begin_internal_key, end_user_key) tombstones in this file."""
+        """Raw (begin_internal_key, end_user_key) tombstones in this file
+        (parsed once, cached)."""
         if self._range_del_data is None:
             return []
-        it = BlockIter(self._range_del_data, self._icmp.compare)
-        it.seek_to_first()
-        return list(it.entries())
+        if self._range_del_cache is None:
+            it = BlockIter(self._range_del_data, self._icmp.compare)
+            it.seek_to_first()
+            self._range_del_cache = list(it.entries())
+        return self._range_del_cache
 
     def approximate_offset_of(self, ikey: bytes) -> int:
         """Approximate file offset of ikey (reference TableReader::

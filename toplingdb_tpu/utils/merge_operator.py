@@ -1,0 +1,119 @@
+"""MergeOperator API + stock operators.
+
+Same contract as the reference (include/rocksdb/merge_operator.h,
+utilities/merge_operators/ in /root/reference): `full_merge` folds an operand
+chain onto an optional base value (newest operand LAST in our convention —
+operands are passed oldest→newest); `partial_merge` may combine adjacent
+operands without a base. Stock operators mirror the reference's set.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+class MergeOperator:
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def full_merge(self, key: bytes, existing: bytes | None,
+                   operands: list[bytes]) -> bytes:
+        """Fold operands (oldest→newest) onto existing; must succeed."""
+        raise NotImplementedError
+
+    def partial_merge(self, key: bytes, left: bytes, right: bytes) -> bytes | None:
+        """Combine two adjacent operands (left older); None = cannot."""
+        return None
+
+    def allow_single_operand(self) -> bool:
+        return False
+
+
+class PutOperator(MergeOperator):
+    """Merge == overwrite: last operand wins (reference put.cc)."""
+
+    def name(self) -> str:
+        return "PutOperator"
+
+    def full_merge(self, key, existing, operands):
+        return operands[-1] if operands else (existing or b"")
+
+    def partial_merge(self, key, left, right):
+        return right
+
+
+class UInt64AddOperator(MergeOperator):
+    """uint64 little-endian addition (reference uint64add.cc)."""
+
+    def name(self) -> str:
+        return "UInt64AddOperator"
+
+    @staticmethod
+    def _dec(v: bytes | None) -> int:
+        if not v:
+            return 0
+        if len(v) == 8:
+            return struct.unpack("<Q", v)[0]
+        return int.from_bytes(v[:8].ljust(8, b"\x00"), "little")
+
+    def full_merge(self, key, existing, operands):
+        total = self._dec(existing)
+        for op in operands:
+            total = (total + self._dec(op)) & 0xFFFFFFFFFFFFFFFF
+        return struct.pack("<Q", total)
+
+    def partial_merge(self, key, left, right):
+        return struct.pack(
+            "<Q", (self._dec(left) + self._dec(right)) & 0xFFFFFFFFFFFFFFFF
+        )
+
+
+class StringAppendOperator(MergeOperator):
+    """Append with delimiter (reference string_append/stringappend.cc)."""
+
+    def __init__(self, delim: bytes = b","):
+        self.delim = delim
+
+    def name(self) -> str:
+        return "StringAppendOperator"
+
+    def full_merge(self, key, existing, operands):
+        parts = ([existing] if existing is not None else []) + list(operands)
+        return self.delim.join(parts)
+
+    def partial_merge(self, key, left, right):
+        return left + self.delim + right
+
+
+class MaxOperator(MergeOperator):
+    """Bytewise max (reference max.cc)."""
+
+    def name(self) -> str:
+        return "MaxOperator"
+
+    def full_merge(self, key, existing, operands):
+        best = existing if existing is not None else b""
+        for op in operands:
+            if op > best:
+                best = op
+        return best
+
+    def partial_merge(self, key, left, right):
+        return max(left, right)
+
+
+_REGISTRY = {
+    "put": PutOperator,
+    "uint64add": UInt64AddOperator,
+    "stringappend": StringAppendOperator,
+    "max": MaxOperator,
+}
+
+
+def create_merge_operator(name: str) -> MergeOperator:
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        from toplingdb_tpu.utils.status import InvalidArgument
+
+        raise InvalidArgument(f"unknown merge operator {name!r}") from None
