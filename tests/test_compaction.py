@@ -1,0 +1,230 @@
+"""End-to-end compaction tests (shaped after reference db_compaction_test.cc)."""
+
+import struct
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options, ReadOptions
+from toplingdb_tpu.utils.compaction_filter import CompactionFilter, Decision
+from toplingdb_tpu.utils.merge_operator import UInt64AddOperator
+
+
+def opts(**kw):
+    kw.setdefault("write_buffer_size", 8 * 1024)
+    kw.setdefault("target_file_size_base", 16 * 1024)
+    kw.setdefault("max_bytes_for_level_base", 64 * 1024)
+    return Options(**kw)
+
+
+def fill(db, n, fmt_=b"key%06d", val=b"v%08d", mod=None):
+    for i in range(n):
+        k = fmt_ % (i % mod if mod else i)
+        db.put(k, val % i)
+
+
+def test_auto_leveled_compaction_moves_data_down(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        fill(db, 6000)
+        db.flush()
+        db.wait_for_compactions()
+        v = db.versions.current
+        deeper = sum(len(v.files[l]) for l in range(1, v.num_levels))
+        assert deeper > 0, db.get_property("tpulsm.stats")
+        for i in range(0, 6000, 501):
+            assert db.get(b"key%06d" % i) == b"v%08d" % i
+
+
+def test_overwrites_are_garbage_collected(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        fill(db, 9000, mod=1000)  # 9x overwrites
+        db.flush()
+        db.compact_range()
+        v = db.versions.current
+        total_entries = sum(f.num_entries for _, f in v.all_files())
+        assert total_entries == 1000  # exactly one version per key survives
+        for k in range(0, 1000, 97):
+            last = max(i for i in range(k, 9000, 1000))
+            assert db.get(b"key%06d" % k) == b"v%08d" % last
+
+
+def test_deletes_reclaimed_at_bottommost(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        fill(db, 1000)
+        for i in range(0, 1000, 2):
+            db.delete(b"key%06d" % i)
+        db.flush()
+        db.compact_range()
+        v = db.versions.current
+        total = sum(f.num_entries for _, f in v.all_files())
+        assert total == 500  # tombstones and dead values gone
+        assert db.get(b"key%06d" % 0) is None
+        assert db.get(b"key%06d" % 1) == b"v%08d" % 1
+
+
+def test_snapshot_survives_compaction(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put(b"k", b"old")
+        snap = db.get_snapshot()
+        db.put(b"k", b"new")
+        db.delete(b"dead")
+        db.flush()
+        db.compact_range()
+        assert db.get(b"k", ReadOptions(snapshot=snap)) == b"old"
+        assert db.get(b"k") == b"new"
+        snap.release()
+        db.compact_range()
+        assert db.get(b"k") == b"new"
+
+
+def test_merge_operands_fold_in_compaction(tmp_db_path):
+    with DB.open(tmp_db_path, opts(merge_operator=UInt64AddOperator())) as db:
+        for _ in range(10):
+            db.merge(b"ctr", struct.pack("<Q", 1))
+        db.flush()
+        db.compact_range()
+        v = db.versions.current
+        total = sum(f.num_entries for _, f in v.all_files())
+        assert total == 1  # chain folded to a single record
+        assert struct.unpack("<Q", db.get(b"ctr"))[0] == 10
+
+
+def test_delete_range_through_compaction(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        fill(db, 2000)
+        db.delete_range(b"key000500", b"key001000")
+        db.flush()
+        db.compact_range()
+        assert db.get(b"key000499") == b"v%08d" % 499
+        assert db.get(b"key000500") is None
+        assert db.get(b"key000999") is None
+        assert db.get(b"key001000") == b"v%08d" % 1000
+        v = db.versions.current
+        total = sum(f.num_entries for _, f in v.all_files())
+        assert total == 1500  # covered keys physically gone at bottommost
+
+
+def test_compaction_filter_applied(tmp_db_path):
+    class DropPrefix(CompactionFilter):
+        def name(self):
+            return "DropPrefix"
+
+        def filter(self, level, key, value):
+            if key.startswith(b"tmp_"):
+                return Decision.REMOVE, None
+            return Decision.KEEP, None
+
+    with DB.open(tmp_db_path, opts(compaction_filter=DropPrefix())) as db:
+        db.put(b"keep_1", b"v")
+        db.put(b"tmp_1", b"v")
+        db.put(b"tmp_2", b"v")
+        db.flush()
+        db.compact_range()
+        assert db.get(b"keep_1") == b"v"
+        assert db.get(b"tmp_1") is None
+        assert db.get(b"tmp_2") is None
+
+
+def test_universal_compaction_correctness(tmp_db_path):
+    with DB.open(tmp_db_path, opts(compaction_style="universal",
+                                   level0_file_num_compaction_trigger=3)) as db:
+        for round_ in range(6):
+            for i in range(300):
+                db.put(b"key%04d" % i, b"r%d" % round_)
+            db.flush()
+        db.wait_for_compactions()
+        for i in range(300):
+            assert db.get(b"key%04d" % i) == b"r5"
+        it = db.new_iterator()
+        it.seek_to_first()
+        assert sum(1 for _ in it.entries()) == 300
+
+
+def test_fifo_compaction_drops_oldest(tmp_db_path):
+    with DB.open(tmp_db_path, opts(
+        compaction_style="fifo", fifo_max_table_files_size=40 * 1024,
+        write_buffer_size=8 * 1024, disable_auto_compactions=False,
+    )) as db:
+        for i in range(4000):
+            db.put(b"key%06d" % i, b"x" * 40)
+        db.flush()
+        db.wait_for_compactions()
+        v = db.versions.current
+        assert v.total_bytes(0) <= 60 * 1024  # bounded
+        # Newest keys still present.
+        assert db.get(b"key%06d" % 3999) is not None
+
+
+def test_compacted_db_reopens_correctly(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        fill(db, 3000, mod=500)
+        db.flush()
+        db.compact_range()
+    with DB.open(tmp_db_path, opts()) as db:
+        for k in range(0, 500, 41):
+            last = max(i for i in range(k, 3000, 500))
+            assert db.get(b"key%06d" % k) == b"v%08d" % last
+
+
+def test_l0_to_l1_trigger(tmp_db_path):
+    with DB.open(tmp_db_path, opts(
+        level0_file_num_compaction_trigger=4, disable_auto_compactions=True
+    )) as db:
+        for r in range(5):
+            for i in range(100):
+                db.put(b"k%04d" % i, b"r%d" % r)
+            db.flush()
+        assert len(db.versions.current.files[0]) == 5
+        db.options.disable_auto_compactions = False
+        db._maybe_schedule_compaction()
+        db.wait_for_compactions()
+        v = db.versions.current
+        assert len(v.files[0]) == 0
+        assert len(v.files[1]) > 0
+        for i in range(100):
+            assert db.get(b"k%04d" % i) == b"r4"
+
+
+def test_range_tombstone_with_snapshot_not_resurrected(tmp_db_path):
+    """Review regression: bottommost compaction must keep a range tombstone
+    that is newer than a live snapshot, or deleted keys resurrect."""
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put(b"k", b"v")
+        snap = db.get_snapshot()
+        db.delete_range(b"a", b"z")
+        db.flush()
+        db.compact_range()
+        assert db.get(b"k") is None            # tombstone still effective
+        assert db.get(b"k", ReadOptions(snapshot=snap)) == b"v"
+        snap.release()
+        db.compact_range()
+        assert db.get(b"k") is None
+
+
+def test_tombstones_with_many_outputs_no_overlap(tmp_db_path):
+    """Review regression: surviving tombstones + output cutting must not
+    produce overlapping files at L1+ (single-output mode)."""
+    with DB.open(tmp_db_path, opts(target_file_size_base=4 * 1024)) as db:
+        fill(db, 3000)
+        snap = db.get_snapshot()  # keeps tombstone alive through compaction
+        db.delete_range(b"key000100", b"key002900")
+        db.flush()
+        db.compact_range()       # would raise Corruption on overlap
+        assert db.get(b"key000050") == b"v%08d" % 50
+        assert db.get(b"key000500") is None
+        snap.release()
+    with DB.open(tmp_db_path, opts()) as db:  # recovery re-checks overlap
+        assert db.get(b"key002950") == b"v%08d" % 2950
+
+
+def test_background_error_surfaces_and_resume(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put(b"a", b"1")
+        db._set_background_error(RuntimeError("boom"))
+        with pytest.raises(Exception):
+            db.put(b"b", b"2")
+        with pytest.raises(Exception):
+            db.wait_for_compactions()
+        db.resume()
+        db.put(b"b", b"2")
+        assert db.get(b"b") == b"2"

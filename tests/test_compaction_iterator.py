@@ -1,0 +1,291 @@
+"""CompactionIterator state-machine tests, shaped after the reference's
+compaction_iterator_test.cc: pure in-memory input, assert exact survivors."""
+
+import pytest
+
+from toplingdb_tpu.compaction.compaction_iterator import CompactionIterator
+from toplingdb_tpu.db.dbformat import (
+    InternalKeyComparator,
+    ValueType,
+    make_internal_key,
+    split_internal_key,
+)
+from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone
+from toplingdb_tpu.utils.compaction_filter import CompactionFilter, Decision
+from toplingdb_tpu.utils.merge_operator import StringAppendOperator, UInt64AddOperator
+
+ICMP = InternalKeyComparator()
+
+
+class FakeIter:
+    def __init__(self, entries):
+        # entries: [(user_key, seq, type, value)] — will be sorted internally.
+        items = [
+            (make_internal_key(k, s, t), v) for k, s, t, v in entries
+        ]
+        items.sort(key=lambda kv: _W(kv[0]))
+        self._items = items
+        self._i = 0
+
+    def valid(self):
+        return self._i < len(self._items)
+
+    def key(self):
+        return self._items[self._i][0]
+
+    def value(self):
+        return self._items[self._i][1]
+
+    def next(self):
+        self._i += 1
+
+    def seek_to_first(self):
+        self._i = 0
+
+
+class _W:
+    __slots__ = ("k",)
+
+    def __init__(self, k):
+        self.k = k
+
+    def __lt__(self, other):
+        return ICMP.compare(self.k, other.k) < 0
+
+
+def run(entries, snapshots=(), bottommost=False, merge_op=None, cfilter=None,
+        tombstones=()):
+    rd = None
+    if tombstones:
+        rd = RangeDelAggregator(ICMP.user_comparator)
+        for seq, b, e in tombstones:
+            rd.add(RangeTombstone(seq, b, e))
+    ci = CompactionIterator(
+        FakeIter(entries), ICMP, list(snapshots), bottommost_level=bottommost,
+        merge_operator=merge_op, compaction_filter=cfilter, range_del_agg=rd,
+    )
+    out = []
+    for ikey, v in ci.entries():
+        uk, s, t = split_internal_key(ikey)
+        out.append((uk, s, t, v))
+    return out, ci
+
+
+def test_dedup_no_snapshots():
+    out, _ = run([
+        (b"a", 5, ValueType.VALUE, b"v5"),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+        (b"b", 4, ValueType.VALUE, b"vb"),
+    ])
+    assert out == [(b"a", 5, ValueType.VALUE, b"v5"), (b"b", 4, ValueType.VALUE, b"vb")]
+
+
+def test_snapshot_preserves_old_version():
+    out, _ = run([
+        (b"a", 5, ValueType.VALUE, b"v5"),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+    ], snapshots=[4])
+    assert out == [
+        (b"a", 5, ValueType.VALUE, b"v5"),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+    ]
+
+
+def test_multiple_snapshots_stripes():
+    out, _ = run([
+        (b"a", 9, ValueType.VALUE, b"v9"),
+        (b"a", 7, ValueType.VALUE, b"v7"),
+        (b"a", 5, ValueType.VALUE, b"v5"),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+    ], snapshots=[4, 8])
+    # Stripes: (8,inf]=v9 | (4,8]=v7 (v5 obsolete) | [0,4]=v3
+    assert out == [
+        (b"a", 9, ValueType.VALUE, b"v9"),
+        (b"a", 7, ValueType.VALUE, b"v7"),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+    ]
+
+
+def test_tombstone_kept_above_bottommost():
+    out, _ = run([
+        (b"a", 5, ValueType.DELETION, b""),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+    ])
+    assert out == [(b"a", 5, ValueType.DELETION, b"")]
+
+
+def test_tombstone_dropped_at_bottommost():
+    out, _ = run([
+        (b"a", 5, ValueType.DELETION, b""),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+        (b"b", 4, ValueType.VALUE, b"vb"),
+    ], bottommost=True)
+    assert out == [(b"b", 0, ValueType.VALUE, b"vb")]  # seqno zeroed too
+
+
+def test_tombstone_kept_at_bottommost_with_snapshot():
+    out, _ = run([
+        (b"a", 5, ValueType.DELETION, b""),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+    ], snapshots=[4], bottommost=True)
+    # The deletion is protected by snapshot 4; the value below the earliest
+    # snapshot may legally have its seqno zeroed.
+    assert out == [
+        (b"a", 5, ValueType.DELETION, b""),
+        (b"a", 0, ValueType.VALUE, b"v3"),
+    ]
+
+
+def test_single_delete_annihilates_pair():
+    out, ci = run([
+        (b"a", 5, ValueType.SINGLE_DELETION, b""),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+        (b"b", 2, ValueType.VALUE, b"vb"),
+    ])
+    assert out == [(b"b", 2, ValueType.VALUE, b"vb")]
+    assert ci.num_single_del_pairs == 1
+
+
+def test_single_delete_kept_across_snapshot_boundary():
+    out, _ = run([
+        (b"a", 5, ValueType.SINGLE_DELETION, b""),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+    ], snapshots=[4])
+    assert out == [
+        (b"a", 5, ValueType.SINGLE_DELETION, b""),
+        (b"a", 3, ValueType.VALUE, b"v3"),
+    ]
+
+
+def test_unmatched_single_delete_travels():
+    out, _ = run([(b"a", 5, ValueType.SINGLE_DELETION, b"")])
+    assert out == [(b"a", 5, ValueType.SINGLE_DELETION, b"")]
+    out, _ = run([(b"a", 5, ValueType.SINGLE_DELETION, b"")], bottommost=True)
+    assert out == []
+
+
+def test_merge_fold_onto_base():
+    op = StringAppendOperator()
+    out, ci = run([
+        (b"a", 5, ValueType.MERGE, b"m2"),
+        (b"a", 4, ValueType.MERGE, b"m1"),
+        (b"a", 3, ValueType.VALUE, b"base"),
+    ], merge_op=op)
+    assert out == [(b"a", 5, ValueType.VALUE, b"base,m1,m2")]
+
+
+def test_merge_fold_over_delete():
+    op = StringAppendOperator()
+    out, _ = run([
+        (b"a", 5, ValueType.MERGE, b"m1"),
+        (b"a", 4, ValueType.DELETION, b""),
+        (b"a", 3, ValueType.VALUE, b"old"),
+    ], merge_op=op)
+    # Delete cuts the chain; merge result becomes a Put superseding it.
+    assert out == [(b"a", 5, ValueType.VALUE, b"m1")]
+
+
+def test_merge_partial_merge_without_base():
+    op = UInt64AddOperator()
+    import struct
+
+    out, _ = run([
+        (b"a", 5, ValueType.MERGE, struct.pack("<Q", 3)),
+        (b"a", 4, ValueType.MERGE, struct.pack("<Q", 4)),
+    ], merge_op=op)
+    # No base in inputs and not bottommost: operands combine into one MERGE.
+    assert out == [(b"a", 5, ValueType.MERGE, struct.pack("<Q", 7))]
+
+
+def test_merge_finalized_at_bottommost():
+    op = UInt64AddOperator()
+    import struct
+
+    out, _ = run([
+        (b"a", 5, ValueType.MERGE, struct.pack("<Q", 3)),
+        (b"a", 4, ValueType.MERGE, struct.pack("<Q", 4)),
+    ], merge_op=op, bottommost=True)
+    # Folded to a VALUE; at the bottommost level its seqno is zeroed.
+    assert out == [(b"a", 0, ValueType.VALUE, struct.pack("<Q", 7))]
+
+
+def test_merge_respects_snapshot_stripes():
+    op = StringAppendOperator()
+    out, _ = run([
+        (b"a", 6, ValueType.MERGE, b"new"),
+        (b"a", 3, ValueType.MERGE, b"old"),
+    ], snapshots=[4], merge_op=op)
+    # Operands in different stripes must not combine.
+    assert out == [
+        (b"a", 6, ValueType.MERGE, b"new"),
+        (b"a", 3, ValueType.MERGE, b"old"),
+    ]
+
+
+def test_range_tombstone_drops_covered():
+    out, ci = run([
+        (b"b", 3, ValueType.VALUE, b"vb"),
+        (b"x", 4, ValueType.VALUE, b"vx"),
+    ], tombstones=[(10, b"a", b"c")])
+    assert out == [(b"x", 4, ValueType.VALUE, b"vx")]
+    assert ci.num_dropped_tombstone == 1
+
+
+def test_range_tombstone_respects_stripe():
+    out, _ = run([
+        (b"b", 3, ValueType.VALUE, b"vb"),
+    ], snapshots=[5], tombstones=[(10, b"a", b"c")])
+    # Snapshot at 5 must still see b@3; tombstone@10 is in a newer stripe.
+    assert out == [(b"b", 3, ValueType.VALUE, b"vb")]
+
+
+def test_compaction_filter_removes():
+    class DropOdd(CompactionFilter):
+        def name(self):
+            return "DropOdd"
+
+        def filter(self, level, key, value):
+            if int(key[-1:] or b"0") % 2:
+                return Decision.REMOVE, None
+            return Decision.KEEP, None
+
+    out, ci = run([
+        (b"k1", 3, ValueType.VALUE, b"v"),
+        (b"k2", 4, ValueType.VALUE, b"v"),
+    ], cfilter=DropOdd())
+    assert [o[0] for o in out] == [b"k2"]
+    assert ci.num_dropped_filtered == 1
+
+
+def test_compaction_filter_change_value():
+    class Rewrite(CompactionFilter):
+        def name(self):
+            return "Rewrite"
+
+        def filter(self, level, key, value):
+            return Decision.CHANGE_VALUE, b"rewritten"
+
+    out, _ = run([(b"k", 3, ValueType.VALUE, b"v")], cfilter=Rewrite())
+    assert out[0][3] == b"rewritten"
+
+
+def test_compaction_filter_skips_snapshot_protected():
+    class DropAll(CompactionFilter):
+        def name(self):
+            return "DropAll"
+
+        def filter(self, level, key, value):
+            return Decision.REMOVE, None
+
+    out, _ = run([(b"k", 6, ValueType.VALUE, b"v")], snapshots=[3], cfilter=DropAll())
+    # Entry newer than a snapshot is not handed to the filter.
+    assert out == [(b"k", 6, ValueType.VALUE, b"v")]
+
+
+def test_seqno_zeroing_only_at_bottommost():
+    out, _ = run([(b"k", 6, ValueType.VALUE, b"v")])
+    assert out == [(b"k", 6, ValueType.VALUE, b"v")]
+    out, _ = run([(b"k", 6, ValueType.VALUE, b"v")], bottommost=True)
+    assert out == [(b"k", 0, ValueType.VALUE, b"v")]
+    out, _ = run([(b"k", 6, ValueType.VALUE, b"v")], snapshots=[3], bottommost=True)
+    assert out == [(b"k", 6, ValueType.VALUE, b"v")]  # protected by snapshot

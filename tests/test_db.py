@@ -73,7 +73,8 @@ def test_auto_flush_on_write_buffer_full(tmp_db_path):
     with DB.open(tmp_db_path, opts(write_buffer_size=8 * 1024)) as db:
         for i in range(2000):
             db.put(b"key%06d" % i, b"x" * 30)
-        assert len(db.versions.current.files[0]) > 0
+        db.wait_for_compactions()
+        assert db.versions.current.num_files() > 0
         assert db.get(b"key000000") == b"x" * 30
         assert db.get(b"key001999") == b"x" * 30
 

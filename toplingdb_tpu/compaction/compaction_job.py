@@ -1,0 +1,194 @@
+"""CompactionJob: execute one picked compaction on the local CPU.
+
+Mirrors the reference's CompactionJob::RunLocal →
+ProcessKeyValueCompaction (db/compaction/compaction_job.cc:659,1390 in
+/root/reference): build the merged input iterator, drive the
+CompactionIterator MVCC GC, and cut output files at the target size. The
+executor boundary (executor.py) can divert `run` to a remote/TPU device; this
+module is also the worker-side implementation.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from toplingdb_tpu.db import dbformat, filename
+from toplingdb_tpu.db.level_iterator import LevelIterator
+from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone, fragment_tombstones
+from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
+from toplingdb_tpu.compaction.compaction_iterator import CompactionIterator
+from toplingdb_tpu.compaction.picker import Compaction
+from toplingdb_tpu.table.builder import TableBuilder
+from toplingdb_tpu.table.merging_iterator import MergingIterator
+
+
+@dataclass
+class CompactionStats:
+    """Per-job stats (reference CompactionJobStats / CompactionResults
+    timing fields, compaction_executor.h:120-158)."""
+
+    input_records: int = 0
+    output_records: int = 0
+    input_bytes: int = 0
+    output_bytes: int = 0
+    output_files: int = 0
+    dropped_obsolete: int = 0
+    dropped_tombstone: int = 0
+    merged_records: int = 0
+    work_time_usec: int = 0
+    device: str = "cpu"
+
+
+def run_compaction_to_tables(
+    env, dbname: str, icmp, compaction: Compaction, table_cache,
+    table_options, snapshots: list[int], merge_operator=None,
+    compaction_filter=None, new_file_number=None,
+) -> tuple[list[FileMetaData], CompactionStats]:
+    """The data plane: merge inputs → GC → build output tables.
+    `new_file_number` is a callable allocating file numbers."""
+    t0 = time.time()
+    stats = CompactionStats()
+    stats.input_bytes = compaction.total_input_bytes()
+
+    # Input iterators: every L0-ish input file individually; level inputs as
+    # one concatenating iterator per level (reference
+    # VersionSet::MakeInputIterator, compaction_job.cc:1470).
+    children = []
+    rd = RangeDelAggregator(icmp.user_comparator)
+    if compaction.level == 0:
+        for f in compaction.inputs:
+            r = table_cache.get_reader(f.number)
+            children.append(r.new_iterator())
+            for b, e in r.range_del_entries():
+                rd.add(RangeTombstone.from_table_entry(b, e))
+    else:
+        files = sorted(compaction.inputs, key=lambda f: icmp.sort_key(f.smallest))
+        children.append(LevelIterator(table_cache, files, icmp))
+        for f in files:
+            r = table_cache.get_reader(f.number)
+            for b, e in r.range_del_entries():
+                rd.add(RangeTombstone.from_table_entry(b, e))
+    if compaction.output_level_inputs:
+        files = sorted(
+            compaction.output_level_inputs, key=lambda f: icmp.sort_key(f.smallest)
+        )
+        children.append(LevelIterator(table_cache, files, icmp))
+        for f in files:
+            r = table_cache.get_reader(f.number)
+            for b, e in r.range_del_entries():
+                rd.add(RangeTombstone.from_table_entry(b, e))
+
+    merger = MergingIterator(icmp.compare, children)
+    merger.seek_to_first()
+    ci = CompactionIterator(
+        merger, icmp, snapshots,
+        bottommost_level=compaction.bottommost,
+        merge_operator=merge_operator,
+        compaction_filter=compaction_filter,
+        compaction_filter_level=compaction.output_level,
+        range_del_agg=None if rd.empty() else rd,
+    )
+
+    outputs: list[FileMetaData] = []
+    builder = None
+    wfile = None
+    fnum = None
+
+    def open_output():
+        nonlocal builder, wfile, fnum
+        fnum = new_file_number()
+        wfile = env.new_writable_file(filename.table_file_name(dbname, fnum))
+        builder = TableBuilder(wfile, icmp, table_options,
+                               creation_time=int(time.time()))
+
+    def close_output(pending_tombstones):
+        nonlocal builder, wfile, fnum
+        if builder is None:
+            return
+        for frag in pending_tombstones:
+            b, e = frag.to_table_entry()
+            builder.add_tombstone(b, e)
+        if builder.num_entries == 0:
+            # Nothing written: abandon the file.
+            wfile.close()
+            env.delete_file(filename.table_file_name(dbname, fnum))
+            builder = None
+            wfile = None
+            return
+        props = builder.finish()
+        wfile.sync()
+        wfile.close()
+        meta = FileMetaData(
+            number=fnum,
+            file_size=env.get_file_size(filename.table_file_name(dbname, fnum)),
+            smallest=builder.smallest_key,
+            largest=builder.largest_key,
+            smallest_seqno=props.smallest_seqno,
+            largest_seqno=props.largest_seqno,
+            num_entries=props.num_entries,
+            num_deletions=props.num_deletions,
+            num_range_deletions=props.num_range_deletions,
+        )
+        outputs.append(meta)
+        stats.output_bytes += meta.file_size
+        stats.output_files += 1
+        builder = None
+        wfile = None
+
+    # Surviving range tombstones. At the bottommost level a tombstone is only
+    # droppable when no live snapshot can still observe a key it shadows —
+    # exactly the stripe-0 rule point DELETIONs use; a tombstone newer than
+    # some snapshot must be kept or it would resurrect older kept entries.
+    surviving_tombstones = []
+    if not rd.empty():
+        import bisect as _bisect
+
+        snaps = sorted(snapshots)
+        frags = fragment_tombstones(rd.tombstones(), icmp.user_comparator)
+        if compaction.bottommost:
+            surviving_tombstones = [
+                f for f in frags if _bisect.bisect_left(snaps, f.seq) > 0
+            ]
+        else:
+            surviving_tombstones = frags
+
+    last_user_key = None
+    for ikey, value in ci.entries():
+        if builder is None:
+            open_output()
+        uk = dbformat.extract_user_key(ikey)
+        if (builder.file_size() >= compaction.max_output_file_size
+                and last_user_key is not None
+                and not surviving_tombstones
+                and icmp.user_comparator.compare(uk, last_user_key) != 0):
+            # Cut outputs only at user-key boundaries (all versions of a key
+            # stay in one file, reference CompactionOutputs::ShouldStopBefore).
+            # When range tombstones survive, a single output is produced:
+            # add_tombstone widens file bounds to the tombstone span, and
+            # splitting would make sibling outputs overlap at L1+ (proper
+            # per-file tombstone partitioning is a later-round refinement).
+            close_output([])
+            open_output()
+        builder.add(ikey, value)
+        stats.output_records += 1
+        last_user_key = uk
+    if surviving_tombstones and builder is None:
+        open_output()
+    close_output(surviving_tombstones)
+
+    stats.input_records = ci.num_input_records
+    stats.dropped_obsolete = ci.num_dropped_obsolete
+    stats.dropped_tombstone = ci.num_dropped_tombstone
+    stats.merged_records = ci.num_merged
+    stats.work_time_usec = int((time.time() - t0) * 1e6)
+    return outputs, stats
+
+
+def make_version_edit(compaction: Compaction, outputs: list[FileMetaData]) -> VersionEdit:
+    edit = VersionEdit()
+    for level, f in compaction.all_inputs():
+        edit.delete_file(level, f.number)
+    for meta in outputs:
+        edit.add_file(compaction.output_level, meta)
+    return edit

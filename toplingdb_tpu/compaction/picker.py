@@ -1,0 +1,257 @@
+"""Compaction picking: which files to merge next.
+
+Leveled strategy mirrors the reference's score-driven picker
+(db/compaction/compaction_picker_level.cc in /root/reference): L0 scores by
+file count against the trigger, L1+ by level bytes against the target; the
+highest-scoring level compacts into level+1, expanding inputs to all
+overlapping files. Universal and FIFO pickers cover the other two styles
+(reference compaction_picker_universal.cc, compaction_picker_fifo.cc).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.version_edit import FileMetaData
+from toplingdb_tpu.db.version_set import Version
+
+
+@dataclass
+class Compaction:
+    """A picked compaction: inputs at `level` (+ overlapping at output_level),
+    producing files at output_level (reference db/compaction/compaction.h)."""
+
+    level: int
+    output_level: int
+    inputs: list[FileMetaData]          # files at `level`
+    output_level_inputs: list[FileMetaData] = field(default_factory=list)
+    bottommost: bool = False
+    reason: str = ""
+    max_output_file_size: int = 8 * 1024 * 1024
+
+    def all_inputs(self) -> list[tuple[int, FileMetaData]]:
+        return [(self.level, f) for f in self.inputs] + [
+            (self.output_level, f) for f in self.output_level_inputs
+        ]
+
+    def total_input_bytes(self) -> int:
+        return sum(f.file_size for _, f in self.all_inputs())
+
+    def num_input_files(self) -> int:
+        return len(self.inputs) + len(self.output_level_inputs)
+
+
+class CompactionPicker:
+    def __init__(self, options, icmp):
+        self.options = options
+        self.icmp = icmp
+
+    def compaction_score(self, version: Version) -> list[tuple[float, int]]:
+        raise NotImplementedError
+
+    def pick_compaction(self, version: Version) -> Compaction | None:
+        raise NotImplementedError
+
+    # -- shared helpers -------------------------------------------------
+
+    def _key_range(self, files) -> tuple[bytes, bytes]:
+        smallest = min((f.smallest for f in files), key=self.icmp.sort_key)
+        largest = max((f.largest for f in files), key=self.icmp.sort_key)
+        return smallest, largest
+
+    def _expand_range_to_level(self, version: Version, level: int,
+                               smallest: bytes, largest: bytes) -> list[FileMetaData]:
+        """All files at `level` overlapping [smallest, largest] (internal
+        keys) — INCLUDING being_compacted ones, so callers can detect a
+        conflict with a running job and abort the pick (silently omitting
+        them would produce overlapping outputs)."""
+        su = dbformat.extract_user_key(smallest)
+        lu = dbformat.extract_user_key(largest)
+        return version.overlapping_files(level, su, lu)
+
+    def _is_bottommost(self, version: Version, output_level: int,
+                       smallest: bytes, largest: bytes) -> bool:
+        ucmp = self.icmp.user_comparator
+        su = dbformat.extract_user_key(smallest)
+        lu = dbformat.extract_user_key(largest)
+        for lvl in range(output_level + 1, version.num_levels):
+            if version.overlapping_files(lvl, su, lu):
+                return False
+        return True
+
+
+
+class LeveledCompactionPicker(CompactionPicker):
+    def compaction_score(self, version: Version) -> list[tuple[float, int]]:
+        """(score, level) sorted descending; score >= 1.0 needs compaction
+        (reference VersionStorageInfo::ComputeCompactionScore)."""
+        scores = []
+        n_l0 = len([f for f in version.files[0] if not f.being_compacted])
+        scores.append(
+            (n_l0 / self.options.level0_file_num_compaction_trigger, 0)
+        )
+        for level in range(1, version.num_levels - 1):
+            total = sum(
+                f.file_size for f in version.files[level] if not f.being_compacted
+            )
+            scores.append((total / self.options.max_bytes_for_level(level), level))
+        scores.sort(key=lambda s: -s[0])
+        return scores
+
+    def pick_compaction(self, version: Version) -> Compaction | None:
+        for score, level in self.compaction_score(version):
+            if score < 1.0:
+                break
+            c = self._pick_level(version, level)
+            if c is not None:
+                return c
+        return None
+
+    def _pick_level(self, version: Version, level: int) -> Compaction | None:
+        if level == 0:
+            inputs = [f for f in version.files[0] if not f.being_compacted]
+            if len(inputs) < self.options.level0_file_num_compaction_trigger:
+                return None
+            if any(f.being_compacted for f in version.files[0]):
+                return None  # L0→L1 must take all L0 files; wait
+            output_level = 1
+        else:
+            # Pick the largest not-being-compacted file (simple heuristic;
+            # the reference uses kByCompensatedSize by default).
+            candidates = [f for f in version.files[level] if not f.being_compacted]
+            if not candidates:
+                return None
+            inputs = [max(candidates, key=lambda f: f.file_size)]
+            output_level = level + 1
+        if output_level >= version.num_levels:
+            return None
+        smallest, largest = self._key_range(inputs)
+        if level > 0:
+            # Expand inputs at the same level to cover the user-key range
+            # fully; abort on conflict with a running job.
+            more = self._expand_range_to_level(version, level, smallest, largest)
+            if any(f.being_compacted for f in more):
+                return None
+            merged = {f.number: f for f in inputs + more}
+            inputs = sorted(merged.values(), key=lambda f: f.number)
+            smallest, largest = self._key_range(inputs)
+        outputs = self._expand_range_to_level(version, output_level, smallest, largest)
+        if any(f.being_compacted for f in outputs):
+            return None
+        all_small, all_large = self._key_range(inputs + outputs) if outputs else (smallest, largest)
+        return Compaction(
+            level=level,
+            output_level=output_level,
+            inputs=inputs,
+            output_level_inputs=outputs,
+            bottommost=self._is_bottommost(version, output_level, all_small, all_large),
+            reason=f"L{level} score",
+            max_output_file_size=self.options.target_file_size(output_level),
+        )
+
+
+class UniversalCompactionPicker(CompactionPicker):
+    """Size-tiered universal compaction over L0-resident sorted runs
+    (reference compaction_picker_universal.cc). Runs live in L0 (newest
+    first) plus at most one full-keyspace run in the last level."""
+
+    def compaction_score(self, version: Version) -> list[tuple[float, int]]:
+        n = len(version.files[0])
+        return [(n / max(1, self.options.level0_file_num_compaction_trigger), 0)]
+
+    def pick_compaction(self, version: Version) -> Compaction | None:
+        runs = [f for f in version.files[0] if not f.being_compacted]
+        if len(runs) < self.options.level0_file_num_compaction_trigger:
+            return None
+        if any(f.being_compacted for f in version.files[0]):
+            return None
+        opts = self.options
+        # 1. Size-amplification trigger: total/newest vs percent.
+        last_level = version.num_levels - 1
+        base = version.files[last_level]
+        younger_bytes = sum(f.file_size for f in runs)
+        base_bytes = sum(f.file_size for f in base)
+        if base and not any(f.being_compacted for f in base):
+            if base_bytes > 0 and younger_bytes * 100 >= (
+                opts.universal_max_size_amplification_percent * base_bytes
+            ):
+                smallest, largest = self._key_range(runs + base)
+                return Compaction(
+                    level=0, output_level=last_level, inputs=runs,
+                    output_level_inputs=list(base), bottommost=True,
+                    reason="universal size-amp",
+                    max_output_file_size=2**62,
+                )
+        # 2. Size-ratio trigger: merge a prefix of similar-sized runs
+        # (newest first; runs sorted newest→oldest already).
+        picked = [runs[-1]]
+        total = runs[-1].file_size
+        for f in reversed(runs[:-1]):
+            if total * (100 + opts.universal_size_ratio) >= f.file_size * 100:
+                picked.append(f)
+                total += f.file_size
+            else:
+                break
+        if len(picked) >= opts.universal_min_merge_width:
+            picked = picked[: opts.universal_max_merge_width]
+            picked_set = {f.number for f in picked}
+            inputs = [f for f in version.files[0] if f.number in picked_set]
+            bottom = self._is_bottommost(
+                version, 0, *self._key_range(inputs)
+            ) and len(inputs) == len(version.files[0])
+            return Compaction(
+                level=0, output_level=0, inputs=inputs,
+                bottommost=bottom, reason="universal size-ratio",
+                max_output_file_size=2**62,
+            )
+        # 3. Fall back: merge all runs into the last level.
+        if base and any(f.being_compacted for f in base):
+            return None
+        smallest, largest = self._key_range(runs + list(base)) if base else self._key_range(runs)
+        return Compaction(
+            level=0, output_level=last_level, inputs=runs,
+            output_level_inputs=list(base), bottommost=True,
+            reason="universal merge-all", max_output_file_size=2**62,
+        )
+
+
+class FIFOCompactionPicker(CompactionPicker):
+    """Drop oldest files when total size exceeds the budget
+    (reference compaction_picker_fifo.cc). Deletion-only: output nothing."""
+
+    def compaction_score(self, version: Version) -> list[tuple[float, int]]:
+        total = sum(f.file_size for f in version.files[0])
+        return [(total / max(1, self.options.fifo_max_table_files_size), 0)]
+
+    def pick_compaction(self, version: Version) -> Compaction | None:
+        total = sum(f.file_size for f in version.files[0])
+        if total <= self.options.fifo_max_table_files_size:
+            return None
+        # files[0] is newest-first; drop from the tail (oldest).
+        drop = []
+        for f in reversed(version.files[0]):
+            if f.being_compacted:
+                break
+            drop.append(f)
+            total -= f.file_size
+            if total <= self.options.fifo_max_table_files_size:
+                break
+        if not drop:
+            return None
+        return Compaction(
+            level=0, output_level=0, inputs=drop, reason="fifo ttl/size",
+        )
+
+
+def create_picker(options, icmp) -> CompactionPicker:
+    style = options.compaction_style
+    if style == "leveled":
+        return LeveledCompactionPicker(options, icmp)
+    if style == "universal":
+        return UniversalCompactionPicker(options, icmp)
+    if style == "fifo":
+        return FIFOCompactionPicker(options, icmp)
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    raise InvalidArgument(f"unknown compaction style {style!r}")

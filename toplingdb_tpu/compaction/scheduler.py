@@ -1,0 +1,253 @@
+"""Background compaction scheduling.
+
+Role of the reference's MaybeScheduleFlushOrCompaction → BGWorkCompaction
+chain (db/db_impl/db_impl_compaction_flush.cc:2662-3279 in /root/reference):
+after every flush/compaction the scores are re-evaluated and jobs run on a
+bounded worker pool. Jobs route through the CompactionExecutor boundary when
+one is configured (device=cpu|tpu|remote), with fallback to local
+(reference compaction_job.cc:648-655).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+
+from toplingdb_tpu.db import dbformat
+
+from toplingdb_tpu.compaction.compaction_job import (
+    make_version_edit,
+    run_compaction_to_tables,
+)
+from toplingdb_tpu.compaction.picker import Compaction, create_picker
+
+
+class CompactionScheduler:
+    def __init__(self, db, background: bool = True):
+        self.db = db
+        self.picker = create_picker(db.options, db.icmp)
+        self.background = background
+        self._pending = 0
+        self._running = 0
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._shutdown = False
+        self._manual_active = False
+        self.last_error: BaseException | None = None
+        self.num_completed = 0
+
+    # ------------------------------------------------------------------
+
+    def maybe_schedule(self) -> None:
+        if self.db.options.disable_auto_compactions:
+            return
+        if self.background:
+            with self._lock:
+                if self._shutdown or self._manual_active:
+                    return
+                if self._running + self._pending >= self.db.options.max_background_jobs:
+                    return
+                self._pending += 1
+            t = threading.Thread(target=self._bg_work, daemon=True)
+            t.start()
+        else:
+            while self._run_one():
+                pass
+
+    def _bg_work(self) -> None:
+        # Keep running jobs in THIS thread until no work remains: _running
+        # stays nonzero for the whole drain, so wait_idle() can never observe
+        # a false idle gap between one job finishing and its follow-up being
+        # scheduled.
+        with self._lock:
+            self._pending -= 1
+            self._running += 1
+        try:
+            while True:
+                with self._lock:
+                    if self._shutdown or self._manual_active:
+                        break
+                if not self._run_one():
+                    break
+        except BaseException as e:
+            # Surface to the DB's error handler: writes fail until resume()
+            # (reference ErrorHandler, db/error_handler.h:28).
+            self.last_error = e
+            self.db._set_background_error(e)
+            traceback.print_exc()
+        finally:
+            with self._lock:
+                self._running -= 1
+                self._cv.notify_all()
+
+    def wait_idle(self) -> None:
+        """Block until no compaction is running or pending (test/bench aid)."""
+        while True:
+            with self._lock:
+                if self._running == 0 and self._pending == 0:
+                    return
+                self._cv.wait(timeout=0.1)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+        self.wait_idle()
+
+    # ------------------------------------------------------------------
+
+    def _run_one(self) -> bool:
+        db = self.db
+        with db._mutex:
+            version = db.versions.current
+            c = self.picker.pick_compaction(version)
+            if c is None:
+                return False
+            for _, f in c.all_inputs():
+                f.being_compacted = True
+        try:
+            self._run_compaction(c)
+        finally:
+            with db._mutex:
+                for _, f in c.all_inputs():
+                    f.being_compacted = False
+        with self._lock:
+            self.num_completed += 1
+        return True
+
+    def _run_compaction(self, c: Compaction) -> None:
+        db = self.db
+        if not c.output_level_inputs and not c.inputs:
+            return
+        if c.reason.startswith("fifo"):
+            # Deletion-only compaction.
+            edit = make_version_edit(c, [])
+            with db._mutex:
+                db.versions.log_and_apply(edit)
+                db._delete_obsolete_files()
+            return
+        snapshots = db.snapshots.sequences()
+        pending: list[int] = []
+
+        def alloc() -> int:
+            # Protect in-flight outputs from obsolete-file GC until the
+            # version edit lands (reference DBImpl pending_outputs_).
+            n = db.versions.new_file_number()
+            with db._mutex:
+                db._pending_outputs.add(n)
+            pending.append(n)
+            return n
+
+        try:
+            executor = None
+            factory = db.options.compaction_executor_factory
+            if factory is not None and not factory.should_run_local(c):
+                executor = factory.new_executor(c)
+            if executor is not None:
+                try:
+                    outputs, stats = executor.execute(db, c, snapshots, alloc)
+                except Exception:
+                    if not factory.allow_fallback_to_local():
+                        raise
+                    traceback.print_exc()
+                    outputs, stats = self._run_local(c, snapshots, alloc)
+            else:
+                outputs, stats = self._run_local(c, snapshots, alloc)
+            if db.options.statistics is not None:
+                db.options.statistics.record_compaction(stats)
+            edit = make_version_edit(c, outputs)
+            with db._mutex:
+                db.versions.log_and_apply(edit)
+                db._delete_obsolete_files()
+        finally:
+            with db._mutex:
+                db._pending_outputs.difference_update(pending)
+
+    def _run_local(self, c: Compaction, snapshots, alloc):
+        db = self.db
+        return run_compaction_to_tables(
+            db.env, db.dbname, db.icmp, c, db.table_cache,
+            db.options.table_options, snapshots,
+            merge_operator=db.options.merge_operator,
+            compaction_filter=db.options.compaction_filter,
+            new_file_number=alloc,
+        )
+
+    # ------------------------------------------------------------------
+
+    def compact_range(self, begin: bytes | None, end: bytes | None) -> None:
+        """Manual compaction: push overlapping files down level by level
+        (reference DBImpl::CompactRange). Pauses auto scheduling while
+        running so picks cannot race."""
+        with self._lock:
+            self._manual_active = True
+        try:
+            self.wait_idle()
+            self._compact_range_impl(begin, end)
+        finally:
+            with self._lock:
+                self._manual_active = False
+        self.maybe_schedule()
+
+    def _compact_range_impl(self, begin: bytes | None, end: bytes | None) -> None:
+        db = self.db
+        version = db.versions.current
+        if db.options.compaction_style == "universal":
+            self._manual_universal()
+            return
+        for level in range(0, version.num_levels - 1):
+            with db._mutex:
+                version = db.versions.current
+                if level == 0:
+                    inputs = list(version.files[0])
+                else:
+                    inputs = [
+                        f for f in version.overlapping_files(level, begin, end)
+                    ]
+                if not inputs:
+                    continue
+                smallest = min((f.smallest for f in inputs), key=db.icmp.sort_key)
+                largest = max((f.largest for f in inputs), key=db.icmp.sort_key)
+                su = dbformat.extract_user_key(smallest)
+                lu = dbformat.extract_user_key(largest)
+                outputs = version.overlapping_files(level + 1, su, lu)
+                c = Compaction(
+                    level=level, output_level=level + 1, inputs=inputs,
+                    output_level_inputs=outputs,
+                    bottommost=self.picker._is_bottommost(
+                        version, level + 1, smallest, largest
+                    ),
+                    reason="manual",
+                    max_output_file_size=db.options.target_file_size(level + 1),
+                )
+                for _, f in c.all_inputs():
+                    f.being_compacted = True
+            try:
+                self._run_compaction(c)
+            finally:
+                with db._mutex:
+                    for _, f in c.all_inputs():
+                        f.being_compacted = False
+
+    def _manual_universal(self) -> None:
+        db = self.db
+        with db._mutex:
+            version = db.versions.current
+            runs = list(version.files[0])
+            last = version.num_levels - 1
+            base = list(version.files[last])
+            if not runs and not base:
+                return
+            c = Compaction(
+                level=0, output_level=last, inputs=runs,
+                output_level_inputs=base, bottommost=True,
+                reason="manual universal", max_output_file_size=2**62,
+            )
+            for _, f in c.all_inputs():
+                f.being_compacted = True
+        try:
+            self._run_compaction(c)
+        finally:
+            with db._mutex:
+                for _, f in c.all_inputs():
+                    f.being_compacted = False
+

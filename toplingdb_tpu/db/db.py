@@ -30,7 +30,7 @@ from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.env import Env, default_env
 from toplingdb_tpu.options import FlushOptions, Options, ReadOptions, WriteOptions
 from toplingdb_tpu.table.merging_iterator import MergingIterator
-from toplingdb_tpu.utils.status import Corruption, InvalidArgument, NotFound
+from toplingdb_tpu.utils.status import Corruption, InvalidArgument, IOError_, NotFound
 
 _DEFAULT_READ = ReadOptions()
 _DEFAULT_WRITE = WriteOptions()
@@ -54,6 +54,8 @@ class DB:
         self._wal_number = 0
         self._closed = False
         self._compaction_scheduler = None  # set by compaction module
+        self._pending_outputs: set[int] = set()  # files being written by jobs
+        self._bg_error: BaseException | None = None
         self._mem_id_counter = 0
         self.identity = ""
 
@@ -87,6 +89,9 @@ class DB:
             env.write_file(filename.identity_file_name(dbname), db.identity.encode())
         db._new_wal()
         db._delete_obsolete_files()
+        from toplingdb_tpu.compaction.scheduler import CompactionScheduler
+
+        db._compaction_scheduler = CompactionScheduler(db)
         db._maybe_schedule_compaction()
         return db
 
@@ -130,6 +135,8 @@ class DB:
         self._wal = LogWriter(w)
 
     def close(self) -> None:
+        if self._compaction_scheduler is not None:
+            self._compaction_scheduler.shutdown()
         with self._mutex:
             if self._closed:
                 return
@@ -186,6 +193,10 @@ class DB:
             return
         with self._mutex:
             self._check_open()
+            if self._bg_error is not None:
+                raise IOError_(
+                    f"background error pending (call resume()): {self._bg_error!r}"
+                )
             seq = self.versions.last_sequence + 1
             batch.set_sequence(seq)
             if self.options.wal_enabled and not opts.disable_wal:
@@ -334,6 +345,7 @@ class DB:
                 merge_operator=self.options.merge_operator,
                 lower_bound=opts.iterate_lower_bound,
                 upper_bound=opts.iterate_upper_bound,
+                pinned=version,
             )
 
     def get_snapshot(self):
@@ -352,6 +364,25 @@ class DB:
         if self._compaction_scheduler is not None:
             self._compaction_scheduler.compact_range(begin, end)
 
+    def wait_for_compactions(self) -> None:
+        if self._compaction_scheduler is not None:
+            self._compaction_scheduler.wait_idle()
+        if self._bg_error is not None:
+            raise IOError_(f"background error: {self._bg_error!r}")
+
+    def _set_background_error(self, e: BaseException) -> None:
+        """Reference ErrorHandler::SetBGError: stop writes until resume()."""
+        with self._mutex:
+            if self._bg_error is None:
+                self._bg_error = e
+
+    def resume(self) -> None:
+        """Clear a background error and restart background work (reference
+        DB::Resume / ErrorHandler::RecoverFromBGError)."""
+        with self._mutex:
+            self._bg_error = None
+        self._maybe_schedule_compaction()
+
     def _maybe_schedule_compaction(self) -> None:
         if self._compaction_scheduler is not None and not self.options.disable_auto_compactions:
             self._compaction_scheduler.maybe_schedule()
@@ -366,7 +397,7 @@ class DB:
             if ftype == filename.FileType.WAL:
                 keep = num >= self.versions.log_number or num == self._wal_number
             elif ftype == filename.FileType.TABLE:
-                keep = num in live
+                keep = num in live or num in self._pending_outputs
             elif ftype == filename.FileType.MANIFEST:
                 keep = num == self.versions.manifest_file_number
             elif ftype == filename.FileType.TEMP:

@@ -17,7 +17,12 @@ class DBIter:
     def __init__(self, internal_iter, icmp, snapshot_seq: int,
                  range_del_agg=None, merge_operator=None,
                  lower_bound: bytes | None = None,
-                 upper_bound: bytes | None = None):
+                 upper_bound: bytes | None = None,
+                 pinned=None):
+        # `pinned` keeps the source Version (and anything else) alive for the
+        # iterator's lifetime so obsolete-file GC cannot delete SSTs that
+        # LevelIterator children will open lazily.
+        self._pinned = pinned
         self._iter = internal_iter
         self._icmp = icmp
         self._ucmp = icmp.user_comparator

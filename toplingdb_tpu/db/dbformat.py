@@ -139,12 +139,32 @@ BYTEWISE = Comparator()
 REVERSE_BYTEWISE = ReverseBytewiseComparator()
 
 
+class _OrderedKey:
+    """Wrapper making a comparator usable as a sort key function."""
+
+    __slots__ = ("cmp", "k")
+
+    def __init__(self, cmp, k):
+        self.cmp = cmp
+        self.k = k
+
+    def __lt__(self, other):
+        return self.cmp(self.k, other.k) < 0
+
+    def __eq__(self, other):
+        return self.cmp(self.k, other.k) == 0
+
+
 class InternalKeyComparator:
     """Orders internal keys: user key asc, then (seqno, type) desc
     (reference db/dbformat.h InternalKeyComparator)."""
 
     def __init__(self, user_cmp: Comparator = BYTEWISE):
         self.user_comparator = user_cmp
+
+    def sort_key(self, k: bytes) -> "_OrderedKey":
+        """For use as `key=` in sorted()/min()/max() over internal keys."""
+        return _OrderedKey(self.compare, k)
 
     def name(self) -> str:
         return "tpulsm.InternalKeyComparator:" + self.user_comparator.name()

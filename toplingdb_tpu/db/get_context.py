@@ -48,8 +48,12 @@ class GetContext:
         caller, newest first). Returns False when the lookup is complete and
         no older sources need to be consulted."""
         assert not self.found_final_value
-        if seq <= self.max_covering_tombstone_seq:
-            t = ValueType.DELETION  # shadowed by a newer range tombstone
+        if seq < self.max_covering_tombstone_seq:
+            # Shadowed by a strictly newer range tombstone. Strict: seqnos are
+            # unique per write, and seqno-zeroed entries (bottommost
+            # compaction) must not be swallowed by the 0 "no tombstone"
+            # sentinel.
+            t = ValueType.DELETION
         if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
             if self.state == GetState.MERGE:
                 self.state = GetState.FOUND

@@ -11,6 +11,7 @@ VersionEdits to produce new Versions.
 from __future__ import annotations
 
 import threading
+import weakref
 
 from toplingdb_tpu.db import dbformat, filename
 from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
@@ -50,7 +51,7 @@ class Version:
         L0 newest-to-oldest, then each deeper level's single candidate
         (reference FilePicker, version_set.cc:235)."""
         ucmp = self.icmp.user_comparator
-        for f in sorted(self.files[0], key=lambda m: -m.number):
+        for f in self.files[0]:  # already newest-first
             if (ucmp.compare(dbformat.extract_user_key(f.smallest), user_key) <= 0
                     and ucmp.compare(user_key, dbformat.extract_user_key(f.largest)) <= 0):
                 yield 0, f
@@ -107,6 +108,11 @@ class VersionBuilder:
     def apply(self, edit: VersionEdit) -> None:
         for level, number in edit.deleted_files:
             self._deleted.add((level, number))
+            # Multi-edit replay (MANIFEST recovery): a file added by an
+            # earlier edit and deleted later must not survive in _added.
+            self._added[level] = [
+                f for f in self._added[level] if f.number != number
+            ]
         for level, meta in edit.new_files:
             self._deleted.discard((level, meta.number))
             self._added[level].append(meta)
@@ -120,7 +126,10 @@ class VersionBuilder:
                 if (level, f.number) not in self._deleted
             ] + self._added[level]
             if level == 0:
-                merged.sort(key=lambda m: -m.number)  # newest first
+                # Newest data first. Seqno order (not file number): a
+                # universal compaction's output holds OLD data under a NEW
+                # file number and must sort after untouched newer runs.
+                merged.sort(key=lambda m: (-m.largest_seqno, -m.number))
             else:
                 merged.sort(key=lambda m: _SmallestKey(icmp, m.smallest))
                 # Sanity: non-overlapping ranges in L1+.
@@ -152,7 +161,14 @@ class VersionSet:
         self.dbname = dbname
         self.icmp = icmp
         self.num_levels = num_levels
+        # Weak registry of every Version still referenced anywhere (readers
+        # hold strong refs while in flight) — the GC analogue of the
+        # reference's Version refcounts / SuperVersion (db/column_family.h:210):
+        # obsolete-file deletion must respect files visible to ANY live
+        # Version, not just `current`.
+        self._all_versions: "weakref.WeakSet[Version]" = weakref.WeakSet()
         self.current: Version = Version(icmp, num_levels)
+        self._all_versions.add(self.current)
         self.last_sequence = 0
         self.log_number = 0          # WALs with number < this are obsolete
         self.prev_log_number = 0
@@ -227,6 +243,7 @@ class VersionSet:
                 f"opened with {self.icmp.user_comparator.name()}"
             )
         self.current = builder.save()
+        self._all_versions.add(self.current)
         self.mark_file_number_used(self.manifest_file_number)
         # Reopen the manifest for appending new edits.
         self._reopen_manifest_for_append(path)
@@ -272,6 +289,7 @@ class VersionSet:
             self._manifest_writer.add_record(edit.encode())
             if sync:
                 self._manifest_writer.sync()
+            self._all_versions.add(new_version)
             self.current = new_version
 
     def close(self) -> None:
@@ -282,4 +300,10 @@ class VersionSet:
     # -- introspection --------------------------------------------------
 
     def live_files(self) -> set[int]:
-        return {f.number for _, f in self.current.all_files()}
+        """Files referenced by the current version OR any version still held
+        by an in-flight reader/iterator."""
+        out: set[int] = set()
+        for v in list(self._all_versions) + [self.current]:
+            for _, f in v.all_files():
+                out.add(f.number)
+        return out

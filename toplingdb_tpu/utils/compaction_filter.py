@@ -1,0 +1,38 @@
+"""CompactionFilter API + stock filters.
+
+Same contract as the reference (include/rocksdb/compaction_filter.h,
+utilities/compaction_filters/ in /root/reference): consulted for each
+surviving VALUE entry during compaction; may drop or rewrite it.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Decision(enum.Enum):
+    KEEP = 0
+    REMOVE = 1
+    CHANGE_VALUE = 2
+
+
+class CompactionFilter:
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def filter(self, level: int, key: bytes, value: bytes) -> tuple[Decision, bytes | None]:
+        """Returns (decision, new_value). new_value used for CHANGE_VALUE."""
+        return Decision.KEEP, None
+
+
+class RemoveEmptyValueCompactionFilter(CompactionFilter):
+    """Drop entries whose value is empty (reference
+    utilities/compaction_filters/remove_emptyvalue_compactionfilter.cc)."""
+
+    def name(self) -> str:
+        return "RemoveEmptyValueCompactionFilter"
+
+    def filter(self, level, key, value):
+        if value == b"":
+            return Decision.REMOVE, None
+        return Decision.KEEP, None
