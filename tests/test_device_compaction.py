@@ -1,0 +1,220 @@
+"""Device data plane: parity with the CPU CompactionIterator, byte-identical
+SST outputs, and the serialized worker boundary."""
+
+import random
+import struct
+
+import pytest
+
+from toplingdb_tpu.compaction.compaction_iterator import CompactionIterator
+from toplingdb_tpu.db.dbformat import (
+    InternalKeyComparator,
+    ValueType,
+    make_internal_key,
+)
+from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone
+from toplingdb_tpu.ops.device_compaction import device_gc_entries
+from toplingdb_tpu.utils.merge_operator import UInt64AddOperator
+
+ICMP = InternalKeyComparator()
+
+
+class ListIter:
+    def __init__(self, items):
+        self._items = items
+        self._i = 0
+
+    def valid(self):
+        return self._i < len(self._items)
+
+    def key(self):
+        return self._items[self._i][0]
+
+    def value(self):
+        return self._items[self._i][1]
+
+    def next(self):
+        self._i += 1
+
+
+def cpu_reference(entries, snaps, bottom, rd=None, op=None):
+    srt = sorted(entries, key=lambda kv: ICMP.sort_key(kv[0]))
+    ci = CompactionIterator(
+        ListIter(srt), ICMP, snaps, bottommost_level=bottom,
+        merge_operator=op, range_del_agg=rd,
+    )
+    return list(ci.entries())
+
+
+def gen_workload(rng, n, key_space=200, with_merge=True):
+    entries = []
+    for seq in range(1, n + 1):
+        k = b"key%04d" % rng.randrange(key_space)
+        r = rng.random()
+        if r < 0.6:
+            entries.append((make_internal_key(k, seq, ValueType.VALUE),
+                            b"v%06d" % seq))
+        elif r < 0.75:
+            entries.append((make_internal_key(k, seq, ValueType.DELETION), b""))
+        elif r < 0.85 and with_merge:
+            entries.append((make_internal_key(k, seq, ValueType.MERGE),
+                            struct.pack("<Q", seq)))
+        else:
+            entries.append((make_internal_key(k, seq, ValueType.SINGLE_DELETION), b""))
+    return entries
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_device_matches_cpu_state_machine(seed):
+    rng = random.Random(seed)
+    entries = gen_workload(rng, rng.randrange(50, 400))
+    maxseq = len(entries)
+    snaps = sorted(rng.sample(range(1, maxseq + 1), rng.randrange(0, 4)))
+    bottom = rng.random() < 0.5
+    rd = None
+    if rng.random() < 0.6:
+        rd = RangeDelAggregator(ICMP.user_comparator)
+        for _ in range(rng.randrange(1, 4)):
+            a = b"key%04d" % rng.randrange(200)
+            b = b"key%04d" % rng.randrange(200)
+            if a > b:
+                a, b = b, a
+            if a != b:
+                rd.add(RangeTombstone(rng.randrange(1, maxseq), a, b))
+        if rd.empty():
+            rd = None
+    op = UInt64AddOperator()
+    want = cpu_reference(entries, snaps, bottom, rd, op)
+    got = list(device_gc_entries(
+        entries, ICMP, snaps, bottom, merge_operator=op, rd=rd
+    ))
+    assert got == want
+
+
+def test_device_empty_and_single():
+    assert list(device_gc_entries([], ICMP, [], True)) == []
+    e = [(make_internal_key(b"k", 1, ValueType.VALUE), b"v")]
+    assert list(device_gc_entries(e, ICMP, [], False)) == e
+
+
+def test_device_unsorted_input_is_merged():
+    # Entries arrive as concatenated runs, unsorted overall.
+    run1 = [(make_internal_key(b"b", 2, ValueType.VALUE), b"v2"),
+            (make_internal_key(b"d", 4, ValueType.VALUE), b"v4")]
+    run2 = [(make_internal_key(b"a", 1, ValueType.VALUE), b"v1"),
+            (make_internal_key(b"c", 3, ValueType.VALUE), b"v3")]
+    got = list(device_gc_entries(run1 + run2, ICMP, [], False))
+    assert [k[:-8] for k, _ in got] == [b"a", b"b", b"c", b"d"]
+
+
+def test_full_sst_byte_parity(tmp_path):
+    """run_compaction_to_tables vs run_device_compaction: identical bytes."""
+    from toplingdb_tpu.compaction.compaction_job import run_compaction_to_tables
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db.table_cache import TableCache
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.ops.device_compaction import run_device_compaction
+    from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+
+    env = default_env()
+    dbdir = str(tmp_path)
+    rng = random.Random(99)
+    topts = TableOptions(block_size=512)
+
+    # Build two input "runs" as real SSTs.
+    metas = []
+    seq = 1
+    for fnum in (11, 12):
+        entries = []
+        for i in range(300):
+            k = b"key%05d" % rng.randrange(400)
+            entries.append((make_internal_key(k, seq, ValueType.VALUE),
+                            b"val%08d" % seq))
+            seq += 1
+        entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))
+        dedup = [e for i, e in enumerate(entries)
+                 if i == 0 or ICMP.compare(entries[i - 1][0], e[0]) != 0]
+        import toplingdb_tpu.db.filename as fn
+        w = env.new_writable_file(fn.table_file_name(dbdir, fnum))
+        b = TableBuilder(w, ICMP, topts)
+        for k, v in dedup:
+            b.add(k, v)
+        props = b.finish()
+        w.close()
+        metas.append(FileMetaData(
+            number=fnum, file_size=env.get_file_size(fn.table_file_name(dbdir, fnum)),
+            smallest=b.smallest_key, largest=b.largest_key,
+            smallest_seqno=props.smallest_seqno, largest_seqno=props.largest_seqno,
+        ))
+
+    tc = TableCache(env, dbdir, ICMP, topts)
+    c = Compaction(level=0, output_level=1, inputs=metas, bottommost=True,
+                   max_output_file_size=16 * 1024)
+
+    def make_alloc(start):
+        state = [start]
+
+        def alloc():
+            state[0] += 1
+            return state[0]
+
+        return alloc
+
+    out_cpu, _ = run_compaction_to_tables(
+        env, dbdir, ICMP, c, tc, topts, [], new_file_number=make_alloc(100),
+        creation_time=12345,
+    )
+    out_dev, _ = run_device_compaction(
+        env, dbdir, ICMP, c, tc, topts, [], new_file_number=make_alloc(200),
+        creation_time=12345, device_name="cpu-jax",
+    )
+    assert len(out_cpu) == len(out_dev) >= 1
+    import toplingdb_tpu.db.filename as fn
+    for mc, md in zip(out_cpu, out_dev):
+        bc = open(fn.table_file_name(dbdir, mc.number), "rb").read()
+        bd = open(fn.table_file_name(dbdir, md.number), "rb").read()
+        assert bc == bd  # bit-identical SSTs (BASELINE.json north-star check)
+        assert mc.smallest == md.smallest and mc.largest == md.largest
+
+
+def test_subprocess_worker_end_to_end(tmp_db_path):
+    from toplingdb_tpu.compaction.executor import SubprocessCompactionExecutorFactory
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    opts = Options(
+        write_buffer_size=8 * 1024,
+        compaction_executor_factory=SubprocessCompactionExecutorFactory(device="cpu"),
+    )
+    with DB.open(tmp_db_path, opts) as db:
+        for i in range(3000):
+            db.put(b"key%05d" % (i % 1000), b"val%07d" % i)
+        db.flush()
+        db.compact_range()
+        db.wait_for_compactions()
+        for k in range(0, 1000, 83):
+            last = max(i for i in range(k, 3000, 1000))
+            assert db.get(b"key%05d" % k) == b"val%07d" % last
+        v = db.versions.current
+        assert sum(f.num_entries for _, f in v.all_files()) == 1000
+
+
+def test_device_executor_in_db(tmp_db_path):
+    from toplingdb_tpu.compaction.executor import DeviceCompactionExecutorFactory
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    opts = Options(
+        write_buffer_size=8 * 1024,
+        compaction_executor_factory=DeviceCompactionExecutorFactory(device="cpu-jax"),
+    )
+    with DB.open(tmp_db_path, opts) as db:
+        for i in range(3000):
+            db.put(b"key%05d" % (i % 1000), b"val%07d" % i)
+        db.delete_range(b"key00100", b"key00200")
+        db.flush()
+        db.compact_range()
+        assert db.get(b"key00150") is None
+        assert db.get(b"key00250") is not None
+        assert db._compaction_scheduler.last_error is None

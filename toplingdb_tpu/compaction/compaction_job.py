@@ -1,17 +1,21 @@
-"""CompactionJob: execute one picked compaction on the local CPU.
+"""CompactionJob: execute one picked compaction.
 
 Mirrors the reference's CompactionJob::RunLocal →
 ProcessKeyValueCompaction (db/compaction/compaction_job.cc:659,1390 in
-/root/reference): build the merged input iterator, drive the
-CompactionIterator MVCC GC, and cut output files at the target size. The
-executor boundary (executor.py) can divert `run` to a remote/TPU device; this
-module is also the worker-side implementation.
+/root/reference). The job is split into three shared stages so the CPU path
+and the TPU/device path (toplingdb_tpu/ops/device_compaction.py) produce
+byte-identical outputs:
+
+  collect_inputs()              open input files, gather range tombstones
+  CompactionIterator / device   the data plane (survivor stream)
+  build_outputs()               output-file cutting + table building
 """
 
 from __future__ import annotations
 
+import bisect
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from toplingdb_tpu.db import dbformat, filename
 from toplingdb_tpu.db.level_iterator import LevelIterator
@@ -37,59 +41,62 @@ class CompactionStats:
     dropped_tombstone: int = 0
     merged_records: int = 0
     work_time_usec: int = 0
+    rpc_time_usec: int = 0   # transport time for remote jobs (curl analogue)
     device: str = "cpu"
 
 
-def run_compaction_to_tables(
-    env, dbname: str, icmp, compaction: Compaction, table_cache,
-    table_options, snapshots: list[int], merge_operator=None,
-    compaction_filter=None, new_file_number=None,
-) -> tuple[list[FileMetaData], CompactionStats]:
-    """The data plane: merge inputs → GC → build output tables.
-    `new_file_number` is a callable allocating file numbers."""
-    t0 = time.time()
-    stats = CompactionStats()
-    stats.input_bytes = compaction.total_input_bytes()
-
-    # Input iterators: every L0-ish input file individually; level inputs as
-    # one concatenating iterator per level (reference
-    # VersionSet::MakeInputIterator, compaction_job.cc:1470).
+def collect_inputs(compaction: Compaction, table_cache, icmp):
+    """Open all input files; returns (children_iterators, range_del_agg)
+    (reference VersionSet::MakeInputIterator, compaction_job.cc:1470)."""
     children = []
     rd = RangeDelAggregator(icmp.user_comparator)
+
+    def add_tombs(f):
+        r = table_cache.get_reader(f.number)
+        for b, e in r.range_del_entries():
+            rd.add(RangeTombstone.from_table_entry(b, e))
+        return r
+
     if compaction.level == 0:
         for f in compaction.inputs:
-            r = table_cache.get_reader(f.number)
+            r = add_tombs(f)
             children.append(r.new_iterator())
-            for b, e in r.range_del_entries():
-                rd.add(RangeTombstone.from_table_entry(b, e))
     else:
         files = sorted(compaction.inputs, key=lambda f: icmp.sort_key(f.smallest))
         children.append(LevelIterator(table_cache, files, icmp))
         for f in files:
-            r = table_cache.get_reader(f.number)
-            for b, e in r.range_del_entries():
-                rd.add(RangeTombstone.from_table_entry(b, e))
+            add_tombs(f)
     if compaction.output_level_inputs:
         files = sorted(
             compaction.output_level_inputs, key=lambda f: icmp.sort_key(f.smallest)
         )
         children.append(LevelIterator(table_cache, files, icmp))
         for f in files:
-            r = table_cache.get_reader(f.number)
-            for b, e in r.range_del_entries():
-                rd.add(RangeTombstone.from_table_entry(b, e))
+            add_tombs(f)
+    return children, rd
 
-    merger = MergingIterator(icmp.compare, children)
-    merger.seek_to_first()
-    ci = CompactionIterator(
-        merger, icmp, snapshots,
-        bottommost_level=compaction.bottommost,
-        merge_operator=merge_operator,
-        compaction_filter=compaction_filter,
-        compaction_filter_level=compaction.output_level,
-        range_del_agg=None if rd.empty() else rd,
-    )
 
+def surviving_tombstone_fragments(rd: RangeDelAggregator, snapshots: list[int],
+                                  bottommost: bool, ucmp):
+    """Tombstones that must be written to outputs. At the bottommost level a
+    fragment is droppable only in snapshot stripe 0 (same rule as point
+    DELETIONs); newer-than-a-snapshot tombstones must be kept or they would
+    resurrect older kept entries."""
+    if rd.empty():
+        return []
+    snaps = sorted(snapshots)
+    frags = fragment_tombstones(rd.tombstones(), ucmp)
+    if bottommost:
+        return [f for f in frags if bisect.bisect_left(snaps, f.seq) > 0]
+    return frags
+
+
+def build_outputs(env, dbname: str, icmp, compaction: Compaction,
+                  entries_iter, surviving_tombstones, new_file_number,
+                  table_options, stats: CompactionStats,
+                  creation_time: int) -> list[FileMetaData]:
+    """Cut the survivor stream into output tables (reference
+    CompactionOutputs / SubcompactionState::AddToOutput)."""
     outputs: list[FileMetaData] = []
     builder = None
     wfile = None
@@ -100,7 +107,7 @@ def run_compaction_to_tables(
         fnum = new_file_number()
         wfile = env.new_writable_file(filename.table_file_name(dbname, fnum))
         builder = TableBuilder(wfile, icmp, table_options,
-                               creation_time=int(time.time()))
+                               creation_time=creation_time)
 
     def close_output(pending_tombstones):
         nonlocal builder, wfile, fnum
@@ -110,7 +117,6 @@ def run_compaction_to_tables(
             b, e = frag.to_table_entry()
             builder.add_tombstone(b, e)
         if builder.num_entries == 0:
-            # Nothing written: abandon the file.
             wfile.close()
             env.delete_file(filename.table_file_name(dbname, fnum))
             builder = None
@@ -136,25 +142,8 @@ def run_compaction_to_tables(
         builder = None
         wfile = None
 
-    # Surviving range tombstones. At the bottommost level a tombstone is only
-    # droppable when no live snapshot can still observe a key it shadows —
-    # exactly the stripe-0 rule point DELETIONs use; a tombstone newer than
-    # some snapshot must be kept or it would resurrect older kept entries.
-    surviving_tombstones = []
-    if not rd.empty():
-        import bisect as _bisect
-
-        snaps = sorted(snapshots)
-        frags = fragment_tombstones(rd.tombstones(), icmp.user_comparator)
-        if compaction.bottommost:
-            surviving_tombstones = [
-                f for f in frags if _bisect.bisect_left(snaps, f.seq) > 0
-            ]
-        else:
-            surviving_tombstones = frags
-
     last_user_key = None
-    for ikey, value in ci.entries():
+    for ikey, value in entries_iter:
         if builder is None:
             open_output()
         uk = dbformat.extract_user_key(ikey)
@@ -176,7 +165,37 @@ def run_compaction_to_tables(
     if surviving_tombstones and builder is None:
         open_output()
     close_output(surviving_tombstones)
+    return outputs
 
+
+def run_compaction_to_tables(
+    env, dbname: str, icmp, compaction: Compaction, table_cache,
+    table_options, snapshots: list[int], merge_operator=None,
+    compaction_filter=None, new_file_number=None, creation_time=None,
+) -> tuple[list[FileMetaData], CompactionStats]:
+    """The CPU data plane: heap merge → CompactionIterator GC → outputs."""
+    t0 = time.time()
+    stats = CompactionStats()
+    stats.input_bytes = compaction.total_input_bytes()
+    children, rd = collect_inputs(compaction, table_cache, icmp)
+    merger = MergingIterator(icmp.compare, children)
+    merger.seek_to_first()
+    ci = CompactionIterator(
+        merger, icmp, snapshots,
+        bottommost_level=compaction.bottommost,
+        merge_operator=merge_operator,
+        compaction_filter=compaction_filter,
+        compaction_filter_level=compaction.output_level,
+        range_del_agg=None if rd.empty() else rd,
+    )
+    tombs = surviving_tombstone_fragments(
+        rd, snapshots, compaction.bottommost, icmp.user_comparator
+    )
+    outputs = build_outputs(
+        env, dbname, icmp, compaction, ci.entries(), tombs,
+        new_file_number, table_options, stats,
+        creation_time if creation_time is not None else int(time.time()),
+    )
     stats.input_records = ci.num_input_records
     stats.dropped_obsolete = ci.num_dropped_obsolete
     stats.dropped_tombstone = ci.num_dropped_tombstone

@@ -1,0 +1,189 @@
+"""Compaction worker process: the dcompact worker analogue.
+
+Runs one serialized compaction job from a job dir (params.json → SST outputs
++ results.json). This is the process that owns the TPU in a disaggregated
+deployment: the DB process never touches JAX; the worker reads input SSTs
+from shared storage, runs the device data plane, and writes outputs back
+(reference: the absent topling-dcompact worker binary, whose DB-side
+contract is db/compaction/compaction_executor.h in /root/reference).
+
+Usage: python -m toplingdb_tpu.compaction.worker --job-dir DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+import traceback
+
+
+def run_job(job_dir: str) -> int:
+    from toplingdb_tpu.compaction.compaction_job import (
+        CompactionStats, build_outputs, surviving_tombstone_fragments,
+    )
+    from toplingdb_tpu.compaction.executor import (
+        CompactionParams, CompactionResults, encode_file_meta,
+    )
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db import dbformat
+    from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.table.builder import TableOptions
+    from toplingdb_tpu.table.reader import TableReader
+    from toplingdb_tpu.utils.compaction_filter import create_compaction_filter
+
+    with open(os.path.join(job_dir, "params.json")) as f:
+        params = CompactionParams.from_json(f.read())
+    t0 = time.time()
+    env = default_env()
+    if params.comparator == dbformat.BYTEWISE.name():
+        ucmp = dbformat.BYTEWISE
+    elif params.comparator == dbformat.REVERSE_BYTEWISE.name():
+        ucmp = dbformat.REVERSE_BYTEWISE
+    else:
+        raise ValueError(f"unknown comparator {params.comparator!r}")
+    icmp = dbformat.InternalKeyComparator(ucmp)
+    merge_op = (
+        _merge_operator_by_name(params.merge_operator)
+        if params.merge_operator else None
+    )
+    cfilter = (
+        create_compaction_filter(params.compaction_filter)
+        if params.compaction_filter else None
+    )
+    topts = TableOptions(
+        block_size=params.block_size, compression=params.compression
+    )
+
+    # Read inputs (raw, unsorted — the device sort is the merge).
+    entries = []
+    rd = RangeDelAggregator(ucmp)
+    readers = []
+    for path in params.input_files:
+        r = TableReader(env.new_random_access_file(path), icmp, topts)
+        readers.append(r)
+        it = r.new_iterator()
+        it.seek_to_first()
+        for k, v in it.entries():
+            entries.append((k, v))
+        for b, e in r.range_del_entries():
+            rd.add(RangeTombstone.from_table_entry(b, e))
+
+    stats = CompactionStats(device=params.device)
+    stats.input_records = len(entries)
+    stats.input_bytes = sum(env.get_file_size(p) for p in params.input_files)
+
+    fake_compaction = Compaction(
+        level=0, output_level=params.output_level, inputs=[],
+        bottommost=params.bottommost,
+        max_output_file_size=params.max_output_file_size,
+    )
+
+    counter = [0]
+
+    def alloc():
+        counter[0] += 1
+        return counter[0]
+
+    if params.device in ("tpu", "cpu-jax", "device"):
+        from toplingdb_tpu.ops.device_compaction import device_gc_entries
+
+        stream = device_gc_entries(
+            entries, icmp, params.snapshots, params.bottommost,
+            merge_operator=merge_op, compaction_filter=cfilter,
+            compaction_filter_level=params.output_level,
+            rd=None if rd.empty() else rd,
+        )
+    else:
+        # CPU reference path over a host-sorted stream.
+        from toplingdb_tpu.compaction.compaction_iterator import CompactionIterator
+
+        entries.sort(key=lambda kv: icmp.sort_key(kv[0]))
+        stream = CompactionIterator(
+            _ListIter(entries), icmp, params.snapshots,
+            bottommost_level=params.bottommost, merge_operator=merge_op,
+            compaction_filter=cfilter,
+            compaction_filter_level=params.output_level,
+            range_del_agg=None if rd.empty() else rd,
+        ).entries()
+
+    tombs = surviving_tombstone_fragments(
+        rd, params.snapshots, params.bottommost, ucmp
+    )
+    outputs = build_outputs(
+        env, params.output_dir, icmp, fake_compaction, stream, tombs,
+        alloc, topts, stats, params.creation_time,
+    )
+    results = CompactionResults(
+        status="ok",
+        output_files=[
+            encode_file_meta(m, f"{m.number:06d}.sst") for m in outputs
+        ],
+        stats=dataclasses.asdict(stats),
+        work_time_usec=int((time.time() - t0) * 1e6),
+    )
+    with open(os.path.join(job_dir, "results.json"), "w") as f:
+        f.write(results.to_json())
+    return 0
+
+
+def _merge_operator_by_name(name: str):
+    from toplingdb_tpu.utils.merge_operator import (
+        MaxOperator, PutOperator, StringAppendOperator, UInt64AddOperator,
+    )
+
+    table = {
+        "PutOperator": PutOperator,
+        "UInt64AddOperator": UInt64AddOperator,
+        "StringAppendOperator": StringAppendOperator,
+        "MaxOperator": MaxOperator,
+    }
+    return table[name]()
+
+
+class _ListIter:
+    def __init__(self, items):
+        self._items = items
+        self._i = 0
+
+    def valid(self):
+        return self._i < len(self._items)
+
+    def key(self):
+        return self._items[self._i][0]
+
+    def value(self):
+        return self._items[self._i][1]
+
+    def next(self):
+        self._i += 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--job-dir", required=True)
+    args = ap.parse_args(argv)
+    try:
+        return run_job(args.job_dir)
+    except Exception as e:
+        traceback.print_exc()
+        try:
+            from toplingdb_tpu.compaction.executor import CompactionResults
+
+            with open(os.path.join(args.job_dir, "results.json"), "w") as f:
+                f.write(CompactionResults(
+                    status=f"{type(e).__name__}: {e}", output_files=[],
+                    stats={},
+                ).to_json())
+        except OSError:
+            pass
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

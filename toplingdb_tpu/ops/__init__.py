@@ -1,0 +1,29 @@
+"""JAX/XLA/Pallas kernels: the TPU compaction data plane.
+
+This package re-expresses the compute-heavy half of compaction — the k-way
+merge (reference table/merging_iterator.cc), the MVCC GC state machine
+(reference db/compaction/compaction_iterator.cc:475), and block encoding
+prep (reference table/block_based/block_based_table_builder.cc) — as
+fixed-shape array programs:
+
+  columnar.py            entries ⇄ fixed-width key words + metadata arrays
+  compaction_kernels.py  sort-merge + visibility/tombstone masking (jit)
+  pallas_kernels.py      Pallas TPU kernels (shared-prefix lengths for
+                         restart-point block building)
+  device_compaction.py   host orchestration: run a compaction's data plane
+                         on device, bit-identical to the CPU path
+
+Design notes (TPU-first, not a port):
+  * Internal-key order is realized as a multi-operand `jax.lax.sort` over
+    big-endian key words + inverted (seqno,type) words — the whole k-way
+    merge collapses into one device sort, instead of a scalar loser tree.
+  * MVCC GC becomes segment ops over the sorted stream: group boundaries by
+    vectorized word compare, snapshot stripes by `searchsorted`, survivor
+    masks by shifted comparisons — no data-dependent control flow.
+  * Groups needing sequential semantics (merge-operand folding with
+    user-defined operators, single-delete pairing) are flagged on device and
+    resolved on host; everything else never leaves the array program.
+
+int64 note: seqnos are 56-bit; device arrays carry the packed (seqno,type)
+as two uint32 words to stay in TPU-native 32-bit lanes.
+"""

@@ -1,0 +1,106 @@
+"""Columnar fixed-shape representation of KV entries for device kernels.
+
+The variable-length problem (SURVEY.md §7 risk 2): keys become [N, W] int32
+big-endian words (zero-padded, with an explicit length word as tie-break);
+values stay host-side as a Python list indexed by the `idx` column — the
+device decides ordering/survival, the host moves bytes.
+
+Word transform: big-endian packing makes lexicographic byte order equal
+numeric word order; XOR 0x80000000 maps unsigned order onto int32 order so
+`jax.lax.sort` (signed) sorts correctly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from toplingdb_tpu.db import dbformat
+
+_SIGN = np.uint32(0x80000000)
+
+
+def keys_to_words(user_keys: list[bytes], max_key_bytes: int) -> np.ndarray:
+    """[N, W] int32, W = ceil(max_key_bytes/4), big-endian packed, sign-mapped."""
+    n = len(user_keys)
+    w = (max_key_bytes + 3) // 4
+    buf = np.zeros((n, w * 4), dtype=np.uint8)
+    for i, k in enumerate(user_keys):
+        buf[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
+    words = buf.reshape(n, w, 4).astype(np.uint32)
+    packed = (
+        (words[:, :, 0] << 24) | (words[:, :, 1] << 16)
+        | (words[:, :, 2] << 8) | words[:, :, 3]
+    )
+    return (packed ^ _SIGN).astype(np.int32)
+
+
+class ColumnarEntries:
+    """Host-side columnar view of N internal-key entries."""
+
+    __slots__ = (
+        "key_words", "key_len", "inv_hi", "inv_lo", "vtype", "values",
+        "user_keys", "max_key_bytes", "n",
+    )
+
+    def __init__(self, key_words, key_len, inv_hi, inv_lo, vtype, values,
+                 user_keys, max_key_bytes):
+        self.key_words = key_words
+        self.key_len = key_len
+        self.inv_hi = inv_hi
+        self.inv_lo = inv_lo
+        self.vtype = vtype
+        self.values = values
+        self.user_keys = user_keys
+        self.max_key_bytes = max_key_bytes
+        self.n = len(values)
+
+    @staticmethod
+    def from_entries(entries: list[tuple[bytes, bytes]],
+                     max_key_bytes: int | None = None) -> "ColumnarEntries":
+        """entries: [(internal_key, value)] in any order."""
+        user_keys: list[bytes] = []
+        values: list[bytes] = []
+        n = len(entries)
+        key_len = np.zeros(n, dtype=np.int32)
+        inv_hi = np.zeros(n, dtype=np.int32)
+        inv_lo = np.zeros(n, dtype=np.int32)
+        vtype = np.zeros(n, dtype=np.int32)
+        maxlen = 0
+        inv_max = (1 << 64) - 1
+        for i, (ikey, val) in enumerate(entries):
+            uk, seq, t = dbformat.split_internal_key(ikey)
+            user_keys.append(uk)
+            values.append(val)
+            maxlen = max(maxlen, len(uk))
+            key_len[i] = len(uk)
+            inv = inv_max - dbformat.pack_seq_type(seq, t)
+            # Two sign-mapped big-endian-ordered words: hi first.
+            inv_hi[i] = np.int32(np.uint32(inv >> 32) ^ _SIGN)
+            inv_lo[i] = np.int32(np.uint32(inv & 0xFFFFFFFF) ^ _SIGN)
+            vtype[i] = t
+        if max_key_bytes is None:
+            max_key_bytes = max(4, maxlen)
+        if maxlen > max_key_bytes:
+            raise ValueError(
+                f"key length {maxlen} exceeds device key budget {max_key_bytes}"
+            )
+        key_words = keys_to_words(user_keys, max_key_bytes)
+        return ColumnarEntries(
+            key_words, key_len, inv_hi, inv_lo, vtype, values, user_keys,
+            max_key_bytes,
+        )
+
+    def seq_type_of(self, i: int) -> tuple[int, int]:
+        inv_max = (1 << 64) - 1
+        hi = np.uint32(np.int32(self.inv_hi[i])) ^ _SIGN
+        lo = np.uint32(np.int32(self.inv_lo[i])) ^ _SIGN
+        packed = inv_max - ((int(hi) << 32) | int(lo))
+        return dbformat.unpack_seq_type(packed)
+
+
+def seq_words(snapshot_seqs: list[int]) -> tuple[np.ndarray, np.ndarray]:
+    """Snapshot seqnos as (hi, lo) uint32 pairs (plain, not sign-mapped) for
+    device searchsorted over 64-bit values split into words."""
+    hi = np.array([s >> 32 for s in snapshot_seqs], dtype=np.uint32)
+    lo = np.array([s & 0xFFFFFFFF for s in snapshot_seqs], dtype=np.uint32)
+    return hi, lo

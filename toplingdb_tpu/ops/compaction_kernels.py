@@ -1,0 +1,215 @@
+"""Device kernels: sort-merge + MVCC GC masking.
+
+The k-way merge + CompactionIterator state machine (reference
+table/merging_iterator.cc + db/compaction/compaction_iterator.cc:475),
+re-expressed as two jitted array programs:
+
+  pad_columns(...) + device_sort(...)   one multi-operand `jax.lax.sort`
+      realizes internal-key order over all input runs at once (the whole
+      merge); sorted columns stay on device for the GC kernel.
+  gc_mask(...)   survivor decisions as shifted/segment comparisons over the
+      sorted stream — no data-dependent control flow.
+
+Shapes are padded to the next power of two so XLA compiles one program per
+size bucket, not per job. All lanes are 32-bit (TPU-native); 64-bit packed
+(seqno,type) values travel as hi/lo uint32 word pairs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from toplingdb_tpu.db.dbformat import ValueType
+from toplingdb_tpu.utils.status import NotSupported
+
+_SIGN = 0x80000000
+MAX_SNAPSHOTS = 64
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def pad_columns(col) -> dict:
+    """Pad a ColumnarEntries to the next power of two. Sentinel rows sort
+    last (int32 max keys) and carry vtype=-1."""
+    n = col.n
+    p = _next_pow2(max(1, n))
+    w = col.key_words.shape[1]
+    int32max = np.iinfo(np.int32).max
+    out = {
+        "n": n, "w": w,
+        "key_words": np.full((p, w), int32max, dtype=np.int32),
+        "key_len": np.full(p, int32max, dtype=np.int32),
+        "inv_hi": np.full(p, int32max, dtype=np.int32),
+        "inv_lo": np.full(p, int32max, dtype=np.int32),
+        "vtype": np.full(p, -1, dtype=np.int32),
+    }
+    out["key_words"][:n] = col.key_words
+    out["key_len"][:n] = col.key_len
+    out["inv_hi"][:n] = col.inv_hi
+    out["inv_lo"][:n] = col.inv_lo
+    out["vtype"][:n] = col.vtype
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sort
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("num_key_words",))
+def _sort_impl(key_words, key_len, inv_hi, inv_lo, vtype, idx, num_key_words):
+    operands = tuple(key_words[:, w] for w in range(num_key_words)) + (
+        key_len, inv_hi, inv_lo, vtype, idx,
+    )
+    out = jax.lax.sort(operands, num_keys=num_key_words + 3)
+    key_words_sorted = jnp.stack(out[:num_key_words], axis=1)
+    key_len_s, inv_hi_s, inv_lo_s, vtype_s, perm = out[num_key_words:]
+    return key_words_sorted, key_len_s, inv_hi_s, inv_lo_s, vtype_s, perm
+
+
+def device_sort(padded: dict):
+    """Sort padded columns into internal-key order on device. Returns a dict
+    of SORTED on-device columns (padding rows last) plus the permutation of
+    original indices as np.ndarray[:n]."""
+    p = padded["key_words"].shape[0]
+    idx = np.arange(p, dtype=np.int32)
+    kw, kl, ih, il, vt, perm = _sort_impl(
+        padded["key_words"], padded["key_len"], padded["inv_hi"],
+        padded["inv_lo"], padded["vtype"], idx, padded["w"],
+    )
+    sorted_cols = {
+        "n": padded["n"], "w": padded["w"],
+        "key_words": kw, "key_len": kl, "inv_hi": ih, "inv_lo": il,
+        "vtype": vt,
+    }
+    return sorted_cols, np.asarray(perm)[: padded["n"]]
+
+
+# ---------------------------------------------------------------------------
+# GC mask
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
+def _gc_mask_impl(key_words, key_len, inv_hi, inv_lo, vtype,
+                  snap_hi, snap_lo, tomb_hi, tomb_lo,
+                  num_key_words, bottommost):
+    """All inputs are SORTED columns (internal-key order, padded).
+    tomb_hi/lo: per-entry max covering tombstone seqno words (0 = none).
+    Returns keep, zero_seq, host_resolve, group_id (all padded length)."""
+    n = key_words.shape[0]
+    u = lambda x: jax.lax.bitcast_convert_type(x, jnp.uint32)
+
+    # --- group boundaries: user key change ---
+    prev_words = jnp.roll(key_words, 1, axis=0)
+    same_words = jnp.all(key_words == prev_words, axis=1)
+    same_len = key_len == jnp.roll(key_len, 1)
+    same_key = (same_words & same_len).at[0].set(False)
+    new_key = ~same_key
+    group_id = jnp.cumsum(new_key.astype(jnp.int32)) - 1
+
+    # --- seqno recovery: packed = ~inv (64-bit), seq = packed >> 8 ---
+    inv_hi_u = u(inv_hi) ^ jnp.uint32(_SIGN)
+    inv_lo_u = u(inv_lo) ^ jnp.uint32(_SIGN)
+    packed_hi = ~inv_hi_u
+    packed_lo = ~inv_lo_u
+    seq_hi = packed_hi >> 8                                   # top 24 bits
+    seq_lo = (packed_hi << 24) | (packed_lo >> 8)             # low 32 bits
+
+    # --- snapshot stripe: count of snapshots strictly below seq ---
+    # snap arrays are sorted ascending, padded with 2^56 (never < any seq).
+    s_hi = snap_hi[None, :]
+    s_lo = snap_lo[None, :]
+    e_hi = seq_hi[:, None]
+    e_lo = seq_lo[:, None]
+    snap_lt = (s_hi < e_hi) | ((s_hi == e_hi) & (s_lo < e_lo))
+    stripe = jnp.sum(snap_lt, axis=1).astype(jnp.int32)
+
+    # --- first-in-(group, stripe): the only candidate survivor ---
+    prev_stripe = jnp.roll(stripe, 1)
+    first_in_stripe = new_key | (stripe != prev_stripe)
+
+    # --- tombstone coverage (same-stripe shadowing) ---
+    has_tomb = (tomb_hi | tomb_lo) != 0
+    tomb_newer = (tomb_hi > seq_hi) | ((tomb_hi == seq_hi) & (tomb_lo > seq_lo))
+    t_hi = tomb_hi[:, None]
+    t_lo = tomb_lo[:, None]
+    tsnap_lt = (s_hi < t_hi) | ((s_hi == t_hi) & (s_lo < t_lo))
+    tomb_stripe = jnp.sum(tsnap_lt, axis=1).astype(jnp.int32)
+    covered = has_tomb & tomb_newer & (tomb_stripe == stripe)
+
+    # --- complex groups: contain MERGE or SINGLE_DELETION → host resolves ---
+    is_complex = (vtype == int(ValueType.MERGE)) | (
+        vtype == int(ValueType.SINGLE_DELETION)
+    )
+    group_complex = jax.ops.segment_max(
+        is_complex.astype(jnp.int32), group_id, num_segments=n,
+        indices_are_sorted=True,
+    )
+    host_resolve = group_complex[group_id] > 0
+
+    # --- survivor rules (simple groups) ---
+    is_pad = vtype < 0
+    keep = first_in_stripe & ~covered & ~is_pad
+    drop_bottom_del = (
+        bool(bottommost)
+        & (stripe == 0)
+        & (vtype == int(ValueType.DELETION))
+    )
+    keep = keep & ~drop_bottom_del
+    zero_seq = (
+        keep
+        & bool(bottommost)
+        & (stripe == 0)
+        & (vtype == int(ValueType.VALUE))
+    )
+    keep = keep & ~host_resolve
+    return keep, zero_seq, host_resolve & ~is_pad, group_id
+
+
+def gc_mask(sorted_cols: dict, snapshots: list[int],
+            tomb_cover: np.ndarray | None, bottommost: bool):
+    """Host wrapper over sorted on-device columns from device_sort().
+    tomb_cover: [n] uint64 max covering tombstone seq per sorted entry
+    (None = no tombstones). Returns (keep, zero_seq, host_resolve, group_id)
+    as numpy arrays trimmed to n."""
+    if len(snapshots) > MAX_SNAPSHOTS:
+        # Falling back to the host path is the caller's job; silently
+        # truncating would merge stripes and corrupt MVCC.
+        raise NotSupported(
+            f"device GC supports <= {MAX_SNAPSHOTS} live snapshots, "
+            f"got {len(snapshots)}"
+        )
+    p = sorted_cols["key_words"].shape[0]
+    n = sorted_cols["n"]
+    pad_snap = 1 << 56
+    snaps = sorted(snapshots) + [pad_snap] * (MAX_SNAPSHOTS - len(snapshots))
+    snap_hi = np.array([s >> 32 for s in snaps], dtype=np.uint32)
+    snap_lo = np.array([s & 0xFFFFFFFF for s in snaps], dtype=np.uint32)
+    if tomb_cover is None:
+        tomb_hi = np.zeros(p, dtype=np.uint32)
+        tomb_lo = np.zeros(p, dtype=np.uint32)
+    else:
+        tc = np.zeros(p, dtype=np.uint64)
+        tc[:n] = tomb_cover
+        tomb_hi = (tc >> np.uint64(32)).astype(np.uint32)
+        tomb_lo = (tc & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    keep, zero_seq, host_resolve, group_id = _gc_mask_impl(
+        sorted_cols["key_words"], sorted_cols["key_len"],
+        sorted_cols["inv_hi"], sorted_cols["inv_lo"], sorted_cols["vtype"],
+        snap_hi, snap_lo, tomb_hi, tomb_lo,
+        sorted_cols["w"], bool(bottommost),
+    )
+    return (
+        np.asarray(keep)[:n], np.asarray(zero_seq)[:n],
+        np.asarray(host_resolve)[:n], np.asarray(group_id)[:n],
+    )

@@ -1,0 +1,170 @@
+"""Device (TPU) compaction data plane: host orchestration.
+
+Replaces the CPU heap-merge + CompactionIterator with:
+  1. raw sequential reads of every input file (no host merge),
+  2. one device sort realizing internal-key order (ops.compaction_kernels),
+  3. device GC masking (stripes, visibility, tombstone shadowing),
+  4. host resolution of "complex" groups (merge operands / single-delete),
+  5. the SAME build_outputs() as the CPU path → byte-identical SSTs.
+
+This is the kernel surface called out in SURVEY.md §3.4/§7 step 5; the
+serializable executor boundary (compaction/executor.py) selects it with
+device="tpu"|"cpu" (the jax backend).
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+
+import numpy as np
+
+from toplingdb_tpu.compaction.compaction_iterator import CompactionIterator
+from toplingdb_tpu.compaction.compaction_job import (
+    CompactionStats,
+    build_outputs,
+    surviving_tombstone_fragments,
+)
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone, fragment_tombstones
+from toplingdb_tpu.ops import compaction_kernels as ck
+from toplingdb_tpu.ops.columnar import ColumnarEntries
+
+
+def collect_raw_entries(compaction, table_cache, icmp):
+    """Sequentially read every input file's entries (NO host merge — the
+    device sort is the merge). Returns (entries list, RangeDelAggregator)."""
+    entries: list[tuple[bytes, bytes]] = []
+    rd = RangeDelAggregator(icmp.user_comparator)
+    for _, f in compaction.all_inputs():
+        r = table_cache.get_reader(f.number)
+        it = r.new_iterator()
+        it.seek_to_first()
+        for k, v in it.entries():
+            entries.append((k, v))
+        for b, e in r.range_del_entries():
+            rd.add(RangeTombstone.from_table_entry(b, e))
+    return entries, rd
+
+
+def _tombstone_cover(sorted_user_keys: list[bytes], rd: RangeDelAggregator,
+                     ucmp) -> np.ndarray | None:
+    """Per-sorted-entry max covering tombstone seqno (uint64), via interval
+    mapping on host (tombstone fragments are few; entries are many)."""
+    if rd.empty():
+        return None
+    n = len(sorted_user_keys)
+    cover = np.zeros(n, dtype=np.uint64)
+    for frag in fragment_tombstones(rd.tombstones(), ucmp):
+        lo = bisect.bisect_left(sorted_user_keys, frag.begin)
+        hi = bisect.bisect_left(sorted_user_keys, frag.end)
+        if lo < hi:
+            np.maximum(cover[lo:hi], np.uint64(frag.seq), out=cover[lo:hi])
+    return cover
+
+
+def device_gc_entries(entries, icmp, snapshots, bottommost,
+                      merge_operator=None, compaction_filter=None,
+                      compaction_filter_level=0, rd=None,
+                      max_key_bytes=None):
+    """Runs the device data plane over raw (unsorted) entries; yields the
+    surviving (internal_key, value) stream — semantically identical to
+    CompactionIterator.entries() over the merged sorted input."""
+    if not entries:
+        return
+    if icmp.user_comparator.name() != dbformat.BYTEWISE.name():
+        # The device sort realizes bytewise-ascending user-key order; other
+        # comparators must use the host path (scheduler falls back).
+        from toplingdb_tpu.utils.status import NotSupported
+
+        raise NotSupported(
+            f"device compaction requires the bytewise comparator, "
+            f"got {icmp.user_comparator.name()!r}"
+        )
+    col = ColumnarEntries.from_entries(entries, max_key_bytes)
+    padded = ck.pad_columns(col)
+    sorted_cols, perm = ck.device_sort(padded)
+    sorted_uks = [col.user_keys[i] for i in perm]
+    cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator) if rd else None
+    keep, zero_seq, host_resolve, group_id = ck.gc_mask(
+        sorted_cols, snapshots, cover, bottommost
+    )
+
+    # Host-side finishing: complex groups through the reference state
+    # machine; simple survivors filtered/zeroed to match it exactly.
+    helper = CompactionIterator(
+        _EmptyIter(), icmp, snapshots, bottommost_level=bottommost,
+        merge_operator=merge_operator, compaction_filter=compaction_filter,
+        compaction_filter_level=compaction_filter_level, range_del_agg=rd,
+    )
+    earliest = min(snapshots) if snapshots else dbformat.MAX_SEQUENCE_NUMBER
+    from toplingdb_tpu.utils.compaction_filter import Decision
+
+    n = col.n
+    i = 0
+    while i < n:
+        if host_resolve[i]:
+            g = group_id[i]
+            j = i
+            group = []
+            while j < n and group_id[j] == g:
+                oi = perm[j]
+                seq, t = col.seq_type_of(oi)
+                group.append((seq, t, col.values[oi]))
+                j += 1
+            yield from helper._process_group(sorted_uks[i], group)
+            i = j
+            continue
+        if keep[i]:
+            oi = perm[i]
+            seq, t = col.seq_type_of(oi)
+            val = col.values[oi]
+            uk = sorted_uks[i]
+            if (compaction_filter is not None and t == dbformat.ValueType.VALUE
+                    and seq <= earliest):
+                d, newv = compaction_filter.filter(
+                    compaction_filter_level, uk, val
+                )
+                if d == Decision.REMOVE:
+                    i += 1
+                    continue
+                if d == Decision.CHANGE_VALUE:
+                    val = newv if newv is not None else b""
+            if zero_seq[i]:
+                seq = 0
+            yield dbformat.make_internal_key(uk, seq, t), val
+        i += 1
+
+
+class _EmptyIter:
+    def valid(self):
+        return False
+
+
+def run_device_compaction(env, dbname, icmp, compaction, table_cache,
+                          table_options, snapshots, merge_operator=None,
+                          compaction_filter=None, new_file_number=None,
+                          creation_time=None, device_name="tpu"):
+    """Device counterpart of run_compaction_to_tables — same signature shape,
+    byte-identical outputs."""
+    t0 = time.time()
+    stats = CompactionStats(device=device_name)
+    stats.input_bytes = compaction.total_input_bytes()
+    entries, rd = collect_raw_entries(compaction, table_cache, icmp)
+    stats.input_records = len(entries)
+    rd_or_none = None if rd.empty() else rd
+    stream = device_gc_entries(
+        entries, icmp, snapshots, compaction.bottommost,
+        merge_operator=merge_operator, compaction_filter=compaction_filter,
+        compaction_filter_level=compaction.output_level, rd=rd_or_none,
+    )
+    tombs = surviving_tombstone_fragments(
+        rd, snapshots, compaction.bottommost, icmp.user_comparator
+    )
+    outputs = build_outputs(
+        env, dbname, icmp, compaction, stream, tombs, new_file_number,
+        table_options, stats,
+        creation_time if creation_time is not None else int(time.time()),
+    )
+    stats.work_time_usec = int((time.time() - t0) * 1e6)
+    return outputs, stats

@@ -36,3 +36,26 @@ class RemoveEmptyValueCompactionFilter(CompactionFilter):
         if value == b"":
             return Decision.REMOVE, None
         return Decision.KEEP, None
+
+
+# Name → factory registry: how filters travel across the serialized
+# compaction boundary (the ObjectRpcParam.clazz analogue, reference
+# compaction_executor.h:9-14). Custom filters must register to be usable by
+# remote/subprocess workers.
+_REGISTRY: dict[str, type] = {
+    "RemoveEmptyValueCompactionFilter": RemoveEmptyValueCompactionFilter,
+}
+
+
+def register_compaction_filter(cls: type) -> type:
+    _REGISTRY[cls().name()] = cls
+    return cls
+
+
+def create_compaction_filter(name: str) -> CompactionFilter:
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        from toplingdb_tpu.utils.status import InvalidArgument
+
+        raise InvalidArgument(f"unknown compaction filter {name!r}") from None
