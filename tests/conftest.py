@@ -7,7 +7,9 @@ in-process — SURVEY.md §4). These env vars must be set before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU backend: the axon (TPU) sitecustomize bootstrap sets
+# JAX_PLATFORMS=axon before pytest starts, so setdefault would be a no-op.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -8,30 +8,21 @@ device decides ordering/survival, the host moves bytes.
 Word transform: big-endian packing makes lexicographic byte order equal
 numeric word order; XOR 0x80000000 maps unsigned order onto int32 order so
 `jax.lax.sort` (signed) sorts correctly.
+
+Encoding is fully vectorized (numpy): one `np.array(keys, 'S...')` pad, one
+take_along_axis for the 8-byte trailers — no per-entry Python loop.
 """
 
 from __future__ import annotations
+
+import sys
 
 import numpy as np
 
 from toplingdb_tpu.db import dbformat
 
 _SIGN = np.uint32(0x80000000)
-
-
-def keys_to_words(user_keys: list[bytes], max_key_bytes: int) -> np.ndarray:
-    """[N, W] int32, W = ceil(max_key_bytes/4), big-endian packed, sign-mapped."""
-    n = len(user_keys)
-    w = (max_key_bytes + 3) // 4
-    buf = np.zeros((n, w * 4), dtype=np.uint8)
-    for i, k in enumerate(user_keys):
-        buf[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
-    words = buf.reshape(n, w, 4).astype(np.uint32)
-    packed = (
-        (words[:, :, 0] << 24) | (words[:, :, 1] << 16)
-        | (words[:, :, 2] << 8) | words[:, :, 3]
-    )
-    return (packed ^ _SIGN).astype(np.int32)
+_INV_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 class ColumnarEntries:
@@ -39,18 +30,19 @@ class ColumnarEntries:
 
     __slots__ = (
         "key_words", "key_len", "inv_hi", "inv_lo", "vtype", "values",
-        "user_keys", "max_key_bytes", "n",
+        "ikeys", "seq", "max_key_bytes", "n",
     )
 
     def __init__(self, key_words, key_len, inv_hi, inv_lo, vtype, values,
-                 user_keys, max_key_bytes):
+                 ikeys, seq, max_key_bytes):
         self.key_words = key_words
-        self.key_len = key_len
+        self.key_len = key_len      # user-key lengths [N] int32
         self.inv_hi = inv_hi
         self.inv_lo = inv_lo
-        self.vtype = vtype
-        self.values = values
-        self.user_keys = user_keys
+        self.vtype = vtype          # [N] int32
+        self.values = values        # list[bytes]
+        self.ikeys = ikeys          # list[bytes] original internal keys
+        self.seq = seq              # [N] uint64 seqnos
         self.max_key_bytes = max_key_bytes
         self.n = len(values)
 
@@ -58,49 +50,62 @@ class ColumnarEntries:
     def from_entries(entries: list[tuple[bytes, bytes]],
                      max_key_bytes: int | None = None) -> "ColumnarEntries":
         """entries: [(internal_key, value)] in any order."""
-        user_keys: list[bytes] = []
-        values: list[bytes] = []
         n = len(entries)
-        key_len = np.zeros(n, dtype=np.int32)
-        inv_hi = np.zeros(n, dtype=np.int32)
-        inv_lo = np.zeros(n, dtype=np.int32)
-        vtype = np.zeros(n, dtype=np.int32)
-        maxlen = 0
-        inv_max = (1 << 64) - 1
-        for i, (ikey, val) in enumerate(entries):
-            uk, seq, t = dbformat.split_internal_key(ikey)
-            user_keys.append(uk)
-            values.append(val)
-            maxlen = max(maxlen, len(uk))
-            key_len[i] = len(uk)
-            inv = inv_max - dbformat.pack_seq_type(seq, t)
-            # Two sign-mapped big-endian-ordered words: hi first.
-            inv_hi[i] = np.int32(np.uint32(inv >> 32) ^ _SIGN)
-            inv_lo[i] = np.int32(np.uint32(inv & 0xFFFFFFFF) ^ _SIGN)
-            vtype[i] = t
+        ikeys = [k for k, _ in entries]
+        values = [v for _, v in entries]
+        lens = np.fromiter((len(k) for k in ikeys), dtype=np.int64, count=n)
+        if n and lens.min() < 8:
+            from toplingdb_tpu.utils.status import Corruption
+
+            raise Corruption("internal key shorter than 8 bytes")
+        max_ik = int(lens.max()) if n else 8
+        # Zero-padded byte matrix of all internal keys (C-level pad).
+        arr = (
+            np.array(ikeys, dtype=f"S{max_ik}")
+            .view(np.uint8)
+            .reshape(n, max_ik)
+            if n else np.zeros((0, max_ik), dtype=np.uint8)
+        )
+        # Little-endian fixed64 trailer per row.
+        tr_idx = (lens[:, None] - 8) + np.arange(8)[None, :]
+        trailer = np.take_along_axis(arr, tr_idx, axis=1)
+        packed = np.ascontiguousarray(trailer).view(np.uint64).reshape(n)
+        if sys.byteorder == "big":  # the trailer bytes on disk are LE
+            packed = packed.byteswap()
+        seq = packed >> np.uint64(8)
+        vtype = (packed & np.uint64(0xFF)).astype(np.int32)
+        inv = _INV_MAX - packed
+        inv_hi = ((inv >> np.uint64(32)).astype(np.uint32) ^ _SIGN).view(np.int32)
+        inv_lo = ((inv & np.uint64(0xFFFFFFFF)).astype(np.uint32) ^ _SIGN).view(np.int32)
+
+        uk_len = (lens - 8).astype(np.int32)
+        maxlen = int(uk_len.max()) if n else 0
         if max_key_bytes is None:
             max_key_bytes = max(4, maxlen)
         if maxlen > max_key_bytes:
             raise ValueError(
                 f"key length {maxlen} exceeds device key budget {max_key_bytes}"
             )
-        key_words = keys_to_words(user_keys, max_key_bytes)
+        w = (max_key_bytes + 3) // 4
+        kb = np.zeros((n, w * 4), dtype=np.uint8)
+        span = min(max_ik, w * 4)
+        kb[:, :span] = arr[:, :span]
+        # Zero out trailer bytes that bled into the key region.
+        col = np.arange(w * 4, dtype=np.int64)[None, :]
+        kb *= col < uk_len[:, None]
+        words = np.ascontiguousarray(kb).reshape(n, w, 4).astype(np.uint32)
+        packed_words = (
+            (words[:, :, 0] << 24) | (words[:, :, 1] << 16)
+            | (words[:, :, 2] << 8) | words[:, :, 3]
+        )
+        key_words = (packed_words ^ _SIGN).view(np.int32)
         return ColumnarEntries(
-            key_words, key_len, inv_hi, inv_lo, vtype, values, user_keys,
+            key_words, uk_len, inv_hi, inv_lo, vtype, values, ikeys, seq,
             max_key_bytes,
         )
 
+    def user_key(self, i: int) -> bytes:
+        return self.ikeys[i][:-8]
+
     def seq_type_of(self, i: int) -> tuple[int, int]:
-        inv_max = (1 << 64) - 1
-        hi = np.uint32(np.int32(self.inv_hi[i])) ^ _SIGN
-        lo = np.uint32(np.int32(self.inv_lo[i])) ^ _SIGN
-        packed = inv_max - ((int(hi) << 32) | int(lo))
-        return dbformat.unpack_seq_type(packed)
-
-
-def seq_words(snapshot_seqs: list[int]) -> tuple[np.ndarray, np.ndarray]:
-    """Snapshot seqnos as (hi, lo) uint32 pairs (plain, not sign-mapped) for
-    device searchsorted over 64-bit values split into words."""
-    hi = np.array([s >> 32 for s in snapshot_seqs], dtype=np.uint32)
-    lo = np.array([s & 0xFFFFFFFF for s in snapshot_seqs], dtype=np.uint32)
-    return hi, lo
+        return int(self.seq[i]), int(self.vtype[i])

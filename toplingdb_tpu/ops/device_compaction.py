@@ -84,8 +84,11 @@ def device_gc_entries(entries, icmp, snapshots, bottommost,
     col = ColumnarEntries.from_entries(entries, max_key_bytes)
     padded = ck.pad_columns(col)
     sorted_cols, perm = ck.device_sort(padded)
-    sorted_uks = [col.user_keys[i] for i in perm]
-    cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator) if rd else None
+    cover = None
+    sorted_uks = None
+    if rd is not None:
+        sorted_uks = [col.user_key(i) for i in perm]
+        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator)
     keep, zero_seq, host_resolve, group_id = ck.gc_mask(
         sorted_cols, snapshots, cover, bottommost
     )
@@ -101,6 +104,9 @@ def device_gc_entries(entries, icmp, snapshots, bottommost,
     from toplingdb_tpu.utils.compaction_filter import Decision
 
     n = col.n
+    values = col.values
+    ikeys = col.ikeys
+    fast = compaction_filter is None  # fast path: emit original ikey bytes
     i = 0
     while i < n:
         if host_resolve[i]:
@@ -109,19 +115,26 @@ def device_gc_entries(entries, icmp, snapshots, bottommost,
             group = []
             while j < n and group_id[j] == g:
                 oi = perm[j]
-                seq, t = col.seq_type_of(oi)
-                group.append((seq, t, col.values[oi]))
+                group.append((int(col.seq[oi]), int(col.vtype[oi]), values[oi]))
                 j += 1
-            yield from helper._process_group(sorted_uks[i], group)
+            yield from helper._process_group(col.user_key(perm[i]), group)
             i = j
             continue
         if keep[i]:
             oi = perm[i]
-            seq, t = col.seq_type_of(oi)
-            val = col.values[oi]
-            uk = sorted_uks[i]
-            if (compaction_filter is not None and t == dbformat.ValueType.VALUE
-                    and seq <= earliest):
+            if fast:
+                if zero_seq[i]:
+                    yield dbformat.make_internal_key(
+                        ikeys[oi][:-8], 0, int(col.vtype[oi])
+                    ), values[oi]
+                else:
+                    yield ikeys[oi], values[oi]
+                i += 1
+                continue
+            seq, t = int(col.seq[oi]), int(col.vtype[oi])
+            val = values[oi]
+            uk = col.user_key(oi)
+            if t == dbformat.ValueType.VALUE and seq <= earliest:
                 d, newv = compaction_filter.filter(
                     compaction_filter_level, uk, val
                 )

@@ -1,0 +1,17 @@
+"""Multi-chip parallelism: the framework's scale-out axes.
+
+Two axes over a jax.sharding.Mesh, mirroring the reference's two parallelism
+mechanisms (SURVEY.md §2.3):
+
+  'jobs'   one compaction job per chip — the dcompact fan-out axis
+           (reference: one CompactionJob per worker process). Jobs are
+           independent: no collectives on the hot path.
+  'range'  key-range sharding WITHIN one job — the subcompaction axis
+           (reference GenSubcompactionBoundaries, compaction_job.cc:604-640),
+           realized as a distributed sample-sort: local sort → splitter
+           all_gather → all_to_all redistribution → local merge → boundary
+           halo exchange (ppermute) for the GC mask.
+
+distributed_gc.py implements the 'range' axis; fanout.py stacks jobs on the
+'jobs' axis and drives whole pods.
+"""
