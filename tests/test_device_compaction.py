@@ -218,3 +218,71 @@ def test_device_executor_in_db(tmp_db_path):
         assert db.get(b"key00150") is None
         assert db.get(b"key00250") is not None
         assert db._compaction_scheduler.last_error is None
+
+
+def test_columnar_fast_path_byte_parity(tmp_path):
+    """Single-output jobs take the native columnar path; bytes must equal the
+    per-entry CPU path exactly."""
+    from toplingdb_tpu.compaction.compaction_job import run_compaction_to_tables
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db.table_cache import TableCache
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.ops.device_compaction import run_device_compaction
+    from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+    import toplingdb_tpu.db.filename as fn
+
+    env = default_env()
+    dbdir = str(tmp_path)
+    rng = random.Random(5)
+    topts = TableOptions(block_size=512)
+    metas = []
+    seq = 1
+    for fnum in (21, 22, 23):
+        entries = []
+        for i in range(250):
+            k = b"key%05d" % rng.randrange(300)
+            t = ValueType.VALUE if rng.random() < 0.8 else ValueType.DELETION
+            entries.append((make_internal_key(k, seq, t), b"val%06d" % seq))
+            seq += 1
+        entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))
+        w = env.new_writable_file(fn.table_file_name(dbdir, fnum))
+        b = TableBuilder(w, ICMP, topts)
+        for k, v in entries:
+            b.add(k, v)
+        props = b.finish()
+        w.close()
+        metas.append(FileMetaData(
+            number=fnum, file_size=env.get_file_size(fn.table_file_name(dbdir, fnum)),
+            smallest=b.smallest_key, largest=b.largest_key,
+            smallest_seqno=props.smallest_seqno, largest_seqno=props.largest_seqno,
+        ))
+    tc = TableCache(env, dbdir, ICMP, topts)
+    # Single-output (huge max size) with snapshots: fast-path eligible.
+    c = Compaction(level=0, output_level=2, inputs=metas, bottommost=True,
+                   max_output_file_size=1 << 62)
+
+    def mk(start):
+        s = [start]
+
+        def alloc():
+            s[0] += 1
+            return s[0]
+
+        return alloc
+
+    out_cpu, _ = run_compaction_to_tables(
+        env, dbdir, ICMP, c, tc, topts, [200, 400], new_file_number=mk(500),
+        creation_time=7,
+    )
+    out_dev, stats = run_device_compaction(
+        env, dbdir, ICMP, c, tc, topts, [200, 400], new_file_number=mk(600),
+        creation_time=7, device_name="cpu-jax",
+    )
+    assert len(out_cpu) == len(out_dev) == 1
+    bc = open(fn.table_file_name(dbdir, out_cpu[0].number), "rb").read()
+    bd = open(fn.table_file_name(dbdir, out_dev[0].number), "rb").read()
+    assert bc == bd
+    assert out_cpu[0].smallest == out_dev[0].smallest
+    assert out_cpu[0].largest == out_dev[0].largest
+    assert out_cpu[0].num_entries == out_dev[0].num_entries

@@ -61,5 +61,49 @@ def lib() -> ctypes.CDLL | None:
         l.tpulsm_xxh64.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
         ]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        l.tpulsm_decode_block.restype = ctypes.c_int64
+        l.tpulsm_decode_block.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,            # block, len
+            u8p, ctypes.c_int64,                        # key_out, cap
+            u8p, ctypes.c_int64,                        # val_out, cap
+            i32p, i32p, i32p, i32p, ctypes.c_int64,     # offs/lens, max_entries
+        ]
+        l.tpulsm_build_block.restype = ctypes.c_int64
+        l.tpulsm_build_block.argtypes = [
+            u8p, i32p, i32p,                            # key buf/offs/lens
+            u8p, i32p, i32p,                            # val buf/offs/lens
+            i64p,                                       # trailer_override
+            i32p, ctypes.c_int64, ctypes.c_int64,       # order, start, n_total
+            ctypes.c_int64, ctypes.c_int64,             # block_size, restart_int
+            u8p, ctypes.c_int64, i64p,                  # out, cap, out_len
+        ]
+        l.tpulsm_decode_blocks.restype = ctypes.c_int64
+        l.tpulsm_decode_blocks.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,            # file buf, len
+            i64p, i64p, ctypes.c_int64,                 # block offs/lens, n
+            ctypes.c_int32,                             # verify_crc
+            u8p, ctypes.c_int64, u8p, ctypes.c_int64,   # key/val out + caps
+            i32p, i32p, i32p, i32p, ctypes.c_int64,
+        ]
+        l.tpulsm_bloom_build.restype = None
+        l.tpulsm_bloom_build.argtypes = [
+            u8p, i32p, i32p, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_uint32, u8p,
+        ]
         _lib = l
         return _lib
+
+
+def np_u8p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def np_i32p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def np_i64p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))

@@ -153,4 +153,244 @@ uint64_t tpulsm_xxh64(const uint8_t* data, size_t len, uint64_t seed) {
   return h;
 }
 
+// ---------------------------------------------------------------------------
+// Block codec: the restart-point entry format of toplingdb_tpu/table/block.py
+//   entry = varint32 shared | varint32 non_shared | varint32 value_len
+//           | key_delta | value
+// with a fixed32 restart array + fixed32 restart count at the end.
+// These functions are the native fast path for bulk scans (decode) and
+// compaction output building (encode); byte-compatible with the Python
+// BlockBuilder/BlockIter by construction (tests assert equality).
+// ---------------------------------------------------------------------------
+
+static inline const uint8_t* get_varint32(const uint8_t* p, const uint8_t* end,
+                                          uint32_t* v) {
+  uint32_t result = 0;
+  int shift = 0;
+  while (p < end && shift <= 28) {
+    uint32_t b = *p++;
+    result |= (b & 0x7f) << shift;
+    if (b < 0x80) { *v = result; return p; }
+    shift += 7;
+  }
+  return nullptr;
+}
+
+static inline size_t varint32_len(uint32_t v) {
+  size_t n = 1;
+  while (v >= 0x80) { v >>= 7; n++; }
+  return n;
+}
+
+static inline uint8_t* put_varint32(uint8_t* p, uint32_t v) {
+  while (v >= 0x80) { *p++ = (v & 0x7f) | 0x80; v >>= 7; }
+  *p++ = (uint8_t)v;
+  return p;
+}
+
+// Decode one block. Returns the number of entries, or a negative error:
+//   -1 corrupt, -2 key buffer too small, -3 value buffer too small,
+//   -4 entry arrays too small.
+// key bytes are prefix-restored into key_out; values copied into val_out.
+int64_t tpulsm_decode_block(
+    const uint8_t* block, int64_t block_len,
+    uint8_t* key_out, int64_t key_cap,
+    uint8_t* val_out, int64_t val_cap,
+    int32_t* key_offs, int32_t* key_lens,
+    int32_t* val_offs, int32_t* val_lens, int64_t max_entries) {
+  if (block_len < 4) return -1;
+  uint32_t num_restarts;
+  std::memcpy(&num_restarts, block + block_len - 4, 4);
+  int64_t limit = block_len - 4 - 4 * (int64_t)num_restarts;
+  if (limit < 0) return -1;
+  const uint8_t* p = block;
+  const uint8_t* end = block + limit;
+  int64_t n = 0;
+  int64_t key_used = 0, val_used = 0;
+  uint8_t* last_key = nullptr;
+  uint32_t last_len = 0;
+  while (p < end) {
+    uint32_t shared, non_shared, vlen;
+    p = get_varint32(p, end, &shared);
+    if (!p) return -1;
+    p = get_varint32(p, end, &non_shared);
+    if (!p) return -1;
+    p = get_varint32(p, end, &vlen);
+    if (!p) return -1;
+    if (p + non_shared + vlen > end) return -1;
+    if (shared > last_len) return -1;
+    if (n >= max_entries) return -4;
+    uint32_t klen = shared + non_shared;
+    if (key_used + klen > key_cap) return -2;
+    if (val_used + vlen > val_cap) return -3;
+    // Offsets are int32 on the Python side: refuse >2GiB columnar buffers
+    // (-7 = too large for the native path; caller falls back).
+    if (key_used + klen > 0x7FFFFF00LL || val_used + vlen > 0x7FFFFF00LL)
+      return -7;
+    uint8_t* kdst = key_out + key_used;
+    if (shared) std::memcpy(kdst, last_key, shared);
+    std::memcpy(kdst + shared, p, non_shared);
+    p += non_shared;
+    std::memcpy(val_out + val_used, p, vlen);
+    p += vlen;
+    key_offs[n] = (int32_t)key_used;
+    key_lens[n] = (int32_t)klen;
+    val_offs[n] = (int32_t)val_used;
+    val_lens[n] = (int32_t)vlen;
+    last_key = kdst;
+    last_len = klen;
+    key_used += klen;
+    val_used += vlen;
+    n++;
+  }
+  return n;
+}
+
+// Build one data block from columnar entries in `order` starting at `start`.
+// Consumes entries until the size estimate reaches block_size_limit (always
+// at least one). trailer_override[i] >= 0 replaces the key's trailing 8
+// bytes with that little-endian value (seqno zeroing). Returns entries
+// consumed; *out_len receives the block byte length (including restart
+// array). Returns negative on overflow of out_cap (-2).
+int64_t tpulsm_build_block(
+    const uint8_t* key_buf, const int32_t* key_offs, const int32_t* key_lens,
+    const uint8_t* val_buf, const int32_t* val_offs, const int32_t* val_lens,
+    const int64_t* trailer_override,
+    const int32_t* order, int64_t start, int64_t n_total,
+    int64_t block_size_limit, int64_t restart_interval,
+    uint8_t* out, int64_t out_cap, int64_t* out_len) {
+  uint8_t last_key[4096];
+  uint32_t last_len = 0;
+  uint8_t cur_key[4096];
+  int64_t used = 0;
+  int64_t consumed = 0;
+  uint32_t restarts[1024];
+  uint32_t num_restarts = 1;
+  restarts[0] = 0;
+  int64_t counter = 0;
+  for (int64_t i = start; i < n_total; i++) {
+    int32_t e = order[i];
+    uint32_t klen = (uint32_t)key_lens[e];
+    if (klen > sizeof(cur_key)) return -3;  // key too long for native path
+    std::memcpy(cur_key, key_buf + key_offs[e], klen);
+    if (trailer_override[e] >= 0 && klen >= 8) {
+      uint64_t t = (uint64_t)trailer_override[e];
+      for (int b = 0; b < 8; b++) cur_key[klen - 8 + b] = (t >> (8 * b)) & 0xff;
+    }
+    uint32_t vlen = (uint32_t)val_lens[e];
+    uint32_t shared = 0;
+    if (counter < restart_interval) {
+      uint32_t mx = klen < last_len ? klen : last_len;
+      while (shared < mx && last_key[shared] == cur_key[shared]) shared++;
+    } else {
+      if (num_restarts >= 1024) {
+        // Restart table full: cutting here would diverge byte-wise from the
+        // Python BlockBuilder (unbounded restarts) — refuse (-8) so the
+        // caller falls back to the per-entry path.
+        return -8;
+      }
+      restarts[num_restarts++] = (uint32_t)used;
+      counter = 0;
+    }
+    uint32_t non_shared = klen - shared;
+    int64_t need = (int64_t)varint32_len(shared) + varint32_len(non_shared) +
+                   varint32_len(vlen) + non_shared + vlen;
+    if (used + need + 4 * (num_restarts + 1) + 4 > out_cap) return -2;
+    uint8_t* p = out + used;
+    p = put_varint32(p, shared);
+    p = put_varint32(p, non_shared);
+    p = put_varint32(p, vlen);
+    std::memcpy(p, cur_key + shared, non_shared);
+    p += non_shared;
+    std::memcpy(p, val_buf + val_offs[e], vlen);
+    p += vlen;
+    used = p - out;
+    std::memcpy(last_key, cur_key, klen);
+    last_len = klen;
+    counter++;
+    consumed++;
+    // Size estimate mirrors BlockBuilder.current_size_estimate().
+    if (used + 4 * (int64_t)num_restarts + 4 >= block_size_limit) break;
+  }
+  // Restart array + count.
+  for (uint32_t r = 0; r < num_restarts; r++) {
+    std::memcpy(out + used, &restarts[r], 4);
+    used += 4;
+  }
+  std::memcpy(out + used, &num_restarts, 4);
+  used += 4;
+  *out_len = used;
+  return consumed;
+}
+
+// Bulk whole-file decode: every data block parsed in one native call.
+// Blocks must be uncompressed (type byte 0) — returns -5 otherwise so the
+// caller can fall back to per-block Python decompression. verify_crc != 0
+// checks each block's masked crc32c trailer (returns -6 on mismatch).
+// Returns total entries, or negative error (same codes as decode_block).
+int64_t tpulsm_decode_blocks(
+    const uint8_t* file_buf, int64_t file_len,
+    const int64_t* block_offs, const int64_t* block_lens, int64_t n_blocks,
+    int32_t verify_crc,
+    uint8_t* key_out, int64_t key_cap,
+    uint8_t* val_out, int64_t val_cap,
+    int32_t* key_offs, int32_t* key_lens,
+    int32_t* val_offs, int32_t* val_lens, int64_t max_entries) {
+  int64_t total = 0;
+  int64_t key_used = 0, val_used = 0;
+  for (int64_t b = 0; b < n_blocks; b++) {
+    int64_t off = block_offs[b];
+    int64_t len = block_lens[b];
+    if (off < 0 || off + len + 5 > file_len) return -1;
+    uint8_t ctype = file_buf[off + len];
+    if (ctype != 0) return -5;
+    if (verify_crc) {
+      uint32_t stored;
+      std::memcpy(&stored, file_buf + off + len + 1, 4);
+      // unmask: rot right 17 after subtracting delta (see utils/crc32c.py).
+      uint32_t rot = stored - 0xa282ead8u;
+      uint32_t crc = (rot >> 17) | (rot << 15);
+      uint32_t actual = tpulsm_crc32c_extend(0, file_buf + off, (size_t)(len + 1));
+      if (crc != actual) return -6;
+    }
+    int64_t rc = tpulsm_decode_block(
+        file_buf + off, len,
+        key_out + key_used, key_cap - key_used,
+        val_out + val_used, val_cap - val_used,
+        key_offs + total, key_lens + total,
+        val_offs + total, val_lens + total, max_entries - total);
+    if (rc < 0) return rc;
+    if (key_used > 0x7FFFFF00LL || val_used > 0x7FFFFF00LL) return -7;
+    // Shift offsets to the global buffers.
+    for (int64_t i = 0; i < rc; i++) {
+      key_offs[total + i] += (int32_t)key_used;
+      val_offs[total + i] += (int32_t)val_used;
+    }
+    if (rc > 0) {
+      key_used = key_offs[total + rc - 1] + key_lens[total + rc - 1];
+      val_used = val_offs[total + rc - 1] + val_lens[total + rc - 1];
+    }
+    total += rc;
+  }
+  return total;
+}
+
+// Bloom filter bit array fill; must match table/filter.py BloomFilterPolicy:
+// h = xxh64(key, 0xA0761D64); h2 = rotr(h, 33) | 1; probe_i = (h + i*h2) % bits.
+void tpulsm_bloom_build(
+    const uint8_t* key_buf, const int32_t* key_offs, const int32_t* key_lens,
+    int64_t n, uint64_t num_bits, uint32_t num_probes, uint8_t* bits) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = tpulsm_xxh64(key_buf + key_offs[i], (size_t)key_lens[i],
+                              0xA0761D64ULL);
+    uint64_t h2 = ((h >> 33) | (h << 31)) | 1ULL;
+    uint64_t x = h;
+    for (uint32_t k = 0; k < num_probes; k++) {
+      uint64_t b = x % num_bits;
+      bits[b >> 3] |= (uint8_t)(1u << (b & 7));
+      x += h2;
+    }
+  }
+}
+
 }  // extern "C"

@@ -1,0 +1,360 @@
+"""Columnar SST IO: native bulk decode and native table building.
+
+The host-side halves of the TPU compaction pipeline that the profile showed
+dominating (SURVEY.md §7 step 5 "host↔device streaming"): whole-file scans
+into flat buffers via the C++ block decoder, and output building via the C++
+block builder + bloom fill — no per-entry Python. File framing (compression,
+trailers, index/filter/props/metaindex/footer) reuses the same Python pieces
+as TableBuilder, so outputs are byte-identical to the per-entry path for
+uncut (single-output) jobs; tests assert it.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from toplingdb_tpu import native
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.block import BlockBuilder, BlockIter
+from toplingdb_tpu.table.builder import (
+    METAINDEX_FILTER,
+    METAINDEX_PROPERTIES,
+    METAINDEX_RANGE_DEL,
+)
+from toplingdb_tpu.table.properties import TableProperties
+from toplingdb_tpu.utils.status import Corruption, NotSupported
+
+
+class ColumnarKV:
+    """Flat-buffer view of N (internal_key, value) entries."""
+
+    __slots__ = ("key_buf", "key_offs", "key_lens", "val_buf", "val_offs",
+                 "val_lens", "n")
+
+    def __init__(self, key_buf, key_offs, key_lens, val_buf, val_offs, val_lens):
+        self.key_buf = key_buf
+        self.key_offs = key_offs
+        self.key_lens = key_lens
+        self.val_buf = val_buf
+        self.val_offs = val_offs
+        self.val_lens = val_lens
+        self.n = len(key_offs)
+
+    def ikey(self, i: int) -> bytes:
+        o = self.key_offs[i]
+        return self.key_buf[o : o + self.key_lens[i]].tobytes()
+
+    def value(self, i: int) -> bytes:
+        o = self.val_offs[i]
+        return self.val_buf[o : o + self.val_lens[i]].tobytes()
+
+    def to_entries(self) -> list[tuple[bytes, bytes]]:
+        return [(self.ikey(i), self.value(i)) for i in range(self.n)]
+
+    @staticmethod
+    def concat(parts: list["ColumnarKV"]) -> "ColumnarKV":
+        if len(parts) == 1:
+            return parts[0]
+        key_buf = np.concatenate([p.key_buf for p in parts])
+        val_buf = np.concatenate([p.val_buf for p in parts])
+        ko, vo = [], []
+        k_shift = 0
+        v_shift = 0
+        for p in parts:
+            ko.append(p.key_offs + k_shift)
+            vo.append(p.val_offs + v_shift)
+            k_shift += len(p.key_buf)
+            v_shift += len(p.val_buf)
+        return ColumnarKV(
+            key_buf, np.concatenate(ko),
+            np.concatenate([p.key_lens for p in parts]),
+            val_buf, np.concatenate(vo),
+            np.concatenate([p.val_lens for p in parts]),
+        )
+
+
+def scan_table_columnar(reader) -> ColumnarKV:
+    """Whole-file bulk scan through the native block decoder. Uncompressed
+    files decode in ONE native call over the raw file bytes; compressed files
+    fall back to per-block decompression + decode."""
+    lib = native.lib()
+    if lib is None:
+        raise NotSupported("native library unavailable")
+    idx = BlockIter(reader._index_data, reader._icmp.compare)
+    idx.seek_to_first()
+    handles = [
+        fmt.BlockHandle.decode_exact(enc) for _, enc in idx.entries()
+    ]
+    if not handles:
+        return ColumnarKV(
+            np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
+        )
+
+    # Bulk path: whole file in one read, all blocks in one native call.
+    file_size = reader._f.size()
+    raw = reader._f.read(0, file_size)
+    block_offs = np.array([h.offset for h in handles], dtype=np.int64)
+    block_lens = np.array([h.size for h in handles], dtype=np.int64)
+    data_bytes = int(block_lens.sum())
+    key_cap = 4 * data_bytes + 4096
+    val_cap = data_bytes + 4096
+    max_e = data_bytes // 3 + 64
+    while True:
+        key_out = np.empty(key_cap, dtype=np.uint8)
+        val_out = np.empty(val_cap, dtype=np.uint8)
+        key_offs = np.empty(max_e, dtype=np.int32)
+        key_lens = np.empty(max_e, dtype=np.int32)
+        val_offs = np.empty(max_e, dtype=np.int32)
+        val_lens = np.empty(max_e, dtype=np.int32)
+        rc = lib.tpulsm_decode_blocks(
+            bytes(raw), file_size,
+            native.np_i64p(block_offs), native.np_i64p(block_lens),
+            len(handles), 1 if reader.opts.verify_checksums else 0,
+            native.np_u8p(key_out), key_cap,
+            native.np_u8p(val_out), val_cap,
+            native.np_i32p(key_offs), native.np_i32p(key_lens),
+            native.np_i32p(val_offs), native.np_i32p(val_lens), max_e,
+        )
+        if rc == -2:
+            key_cap *= 4
+            continue
+        if rc == -3:
+            val_cap *= 4
+            continue
+        if rc == -4:
+            max_e *= 4
+            continue
+        if rc == -5:
+            break  # compressed blocks: per-block fallback below
+        if rc == -6:
+            raise Corruption("block checksum mismatch (native bulk scan)")
+        if rc == -7:
+            raise NotSupported("input too large for native columnar path")
+        if rc < 0:
+            raise Corruption(f"native bulk decode failed rc={rc}")
+        n = int(rc)
+        key_used = int(key_offs[n - 1] + key_lens[n - 1]) if n else 0
+        val_used = int(val_offs[n - 1] + val_lens[n - 1]) if n else 0
+        return ColumnarKV(
+            key_out[:key_used].copy(), key_offs[:n].copy(), key_lens[:n].copy(),
+            val_out[:val_used].copy(), val_offs[:n].copy(), val_lens[:n].copy(),
+        )
+
+    parts = []
+    for handle in handles:
+        data = reader._read_data_block(handle)
+        blen = len(data)
+        key_cap = 4 * blen + 4096
+        val_cap = blen + 4096
+        max_e = blen // 3 + 16
+        while True:
+            key_out = np.empty(key_cap, dtype=np.uint8)
+            val_out = np.empty(val_cap, dtype=np.uint8)
+            key_offs = np.empty(max_e, dtype=np.int32)
+            key_lens = np.empty(max_e, dtype=np.int32)
+            val_offs = np.empty(max_e, dtype=np.int32)
+            val_lens = np.empty(max_e, dtype=np.int32)
+            rc = lib.tpulsm_decode_block(
+                bytes(data), blen,
+                native.np_u8p(key_out), key_cap,
+                native.np_u8p(val_out), val_cap,
+                native.np_i32p(key_offs), native.np_i32p(key_lens),
+                native.np_i32p(val_offs), native.np_i32p(val_lens), max_e,
+            )
+            if rc == -2:
+                key_cap *= 4
+                continue
+            if rc == -3:
+                val_cap *= 4
+                continue
+            if rc == -4:
+                max_e *= 4
+                continue
+            if rc == -7:
+                raise NotSupported("input too large for native columnar path")
+            if rc < 0:
+                raise Corruption(f"native block decode failed rc={rc}")
+            break
+        n = int(rc)
+        key_used = int(key_offs[n - 1] + key_lens[n - 1]) if n else 0
+        val_used = int(val_offs[n - 1] + val_lens[n - 1]) if n else 0
+        parts.append(ColumnarKV(
+            key_out[:key_used].copy(), key_offs[:n].copy(), key_lens[:n].copy(),
+            val_out[:val_used].copy(), val_offs[:n].copy(), val_lens[:n].copy(),
+        ))
+    if not parts:
+        return ColumnarKV(
+            np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
+        )
+    return ColumnarKV.concat(parts)
+
+
+def write_table_columnar(wfile, icmp, options, kv: ColumnarKV,
+                         order: np.ndarray, trailer_override: np.ndarray,
+                         vtypes: np.ndarray, seqs: np.ndarray,
+                         tombstones, creation_time: int):
+    """Build one SST from `kv` entries in `order`, byte-identical to
+    TableBuilder fed the same stream. trailer_override[i] (per ORIGINAL
+    entry index) >= 0 replaces the 8-byte key trailer (seqno zeroing).
+    vtypes/seqs are per original index, post-override values."""
+    lib = native.lib()
+    if lib is None:
+        raise NotSupported("native library unavailable")
+    n_total = len(order)
+    order = np.ascontiguousarray(order, dtype=np.int32)
+    trailer_override = np.ascontiguousarray(trailer_override, dtype=np.int64)
+
+    props = TableProperties(
+        comparator_name=icmp.user_comparator.name(),
+        filter_policy_name=(
+            options.filter_policy.name() if options.filter_policy else ""
+        ),
+        compression_name=str(options.compression),
+        creation_time=creation_time,
+        smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
+    )
+    index_block = BlockBuilder(options.index_restart_interval)
+
+    max_entry = int(kv.key_lens.max() if kv.n else 0) + int(
+        kv.val_lens.max() if kv.n else 0
+    )
+    out_cap = options.block_size * 2 + max_entry + 8192
+    out_buf = np.empty(out_cap, dtype=np.uint8)
+    out_len = np.zeros(1, dtype=np.int64)
+
+    def entry_key(pos: int) -> bytes:
+        e = int(order[pos])
+        k = kv.ikey(e)
+        t = int(trailer_override[e])
+        if t >= 0:
+            k = k[:-8] + t.to_bytes(8, "little")
+        return k
+
+    start = 0
+    pending_last_key: bytes | None = None
+    pending_handle = None
+    first_key: bytes | None = None
+    last_key: bytes | None = None
+    # Hoist ctypes pointer conversions out of the per-block loop.
+    p_kbuf = native.np_u8p(kv.key_buf)
+    p_koff = native.np_i32p(kv.key_offs)
+    p_klen = native.np_i32p(kv.key_lens)
+    p_vbuf = native.np_u8p(kv.val_buf)
+    p_voff = native.np_i32p(kv.val_offs)
+    p_vlen = native.np_i32p(kv.val_lens)
+    p_tro = native.np_i64p(trailer_override)
+    p_order = native.np_i32p(order)
+    p_outlen = native.np_i64p(out_len)
+    p_out = native.np_u8p(out_buf)
+    while start < n_total:
+        rc = lib.tpulsm_build_block(
+            p_kbuf, p_koff, p_klen, p_vbuf, p_voff, p_vlen, p_tro,
+            p_order, start, n_total,
+            options.block_size, options.restart_interval,
+            p_out, out_cap, p_outlen,
+        )
+        if rc == -2:
+            out_cap *= 4
+            out_buf = np.empty(out_cap, dtype=np.uint8)
+            p_out = native.np_u8p(out_buf)
+            continue
+        if rc == -3 or rc == -8:
+            # Key too long for the native stack buffer / restart table full:
+            # the per-entry path handles these.
+            raise NotSupported(f"native block build unsupported input rc={rc}")
+        if rc <= 0:
+            raise Corruption(f"native block build failed rc={rc}")
+        raw = out_buf[: int(out_len[0])].tobytes()
+        if first_key is None:
+            first_key = entry_key(start)
+        block_last = entry_key(start + int(rc) - 1)
+        if pending_last_key is not None:
+            sep = icmp.find_shortest_separator(pending_last_key, entry_key(start))
+            index_block.add(sep, pending_handle.encode())
+        pending_handle = fmt.write_block(wfile, raw, options.compression)
+        pending_last_key = block_last
+        props.data_size += len(raw)
+        props.num_data_blocks += 1
+        start += int(rc)
+        last_key = block_last
+    if pending_last_key is not None:
+        succ = icmp.find_short_successor(pending_last_key)
+        index_block.add(succ, pending_handle.encode())
+
+    # Stats over emitted entries (vectorized).
+    sel = order
+    props.num_entries = n_total
+    props.raw_key_size = int(kv.key_lens[sel].sum()) if n_total else 0
+    props.raw_value_size = int(kv.val_lens[sel].sum()) if n_total else 0
+    vt = vtypes[sel] if n_total else vtypes[:0]
+    props.num_deletions = int(np.count_nonzero(
+        (vt == int(dbformat.ValueType.DELETION))
+        | (vt == int(dbformat.ValueType.SINGLE_DELETION))
+    ))
+    props.num_merge_operands = int(np.count_nonzero(
+        vt == int(dbformat.ValueType.MERGE)
+    ))
+    sq = seqs[sel] if n_total else seqs[:0]
+    props.smallest_seqno = int(sq.min()) if n_total else 0
+    props.largest_seqno = int(sq.max()) if n_total else 0
+
+    meta_entries = []
+    metaindex = BlockBuilder(restart_interval=1)
+    if options.filter_policy and options.whole_key_filtering and n_total:
+        from toplingdb_tpu.utils import coding
+
+        bp = options.filter_policy
+        num_bits = max(64, int(n_total * bp.bits_per_key))
+        num_bytes = (num_bits + 7) // 8
+        num_bits = num_bytes * 8
+        bits = np.zeros(num_bytes, dtype=np.uint8)
+        uk_lens = (kv.key_lens[sel] - 8).astype(np.int32)
+        offs = kv.key_offs[sel].astype(np.int32)
+        lib.tpulsm_bloom_build(
+            native.np_u8p(kv.key_buf), native.np_i32p(np.ascontiguousarray(offs)),
+            native.np_i32p(np.ascontiguousarray(uk_lens)), n_total,
+            num_bits, bp.num_probes, native.np_u8p(bits),
+        )
+        fdata = (coding.encode_varint32(num_bits) + bytes([bp.num_probes])
+                 + bits.tobytes())
+        fh = fmt.write_block(wfile, fdata, fmt.NO_COMPRESSION)
+        props.filter_size = len(fdata)
+        meta_entries.append((METAINDEX_FILTER, fh))
+
+    smallest = first_key
+    largest = last_key
+    if tombstones:
+        rdb = BlockBuilder(restart_interval=1)
+        for frag in tombstones:
+            b, e = frag.to_table_entry()
+            rdb.add(b, e)
+            props.num_range_deletions += 1
+            if smallest is None or icmp.compare(b, smallest) < 0:
+                smallest = b
+            end_ikey = dbformat.make_internal_key(
+                e, dbformat.MAX_SEQUENCE_NUMBER, dbformat.VALUE_TYPE_FOR_SEEK
+            )
+            if largest is None or icmp.compare(end_ikey, largest) > 0:
+                largest = end_ikey
+            props.smallest_seqno = min(props.smallest_seqno, frag.seq)
+            props.largest_seqno = max(props.largest_seqno, frag.seq)
+        rh = fmt.write_block(wfile, rdb.finish(), fmt.NO_COMPRESSION)
+        meta_entries.append((METAINDEX_RANGE_DEL, rh))
+
+    iraw = index_block.finish()
+    props.index_size = len(iraw)
+    pblock = props.encode_block()
+    ph = fmt.write_block(wfile, pblock, fmt.NO_COMPRESSION)
+    meta_entries.append((METAINDEX_PROPERTIES, ph))
+    for name, handle in sorted(meta_entries):
+        metaindex.add(name, handle.encode())
+    mih = fmt.write_block(wfile, metaindex.finish(), fmt.NO_COMPRESSION)
+    ih = fmt.write_block(wfile, iraw, options.compression)
+    wfile.append(fmt.Footer(mih, ih).encode())
+    wfile.flush()
+    return props, smallest, largest

@@ -176,6 +176,52 @@ def _gc_mask_impl(key_words, key_len, inv_hi, inv_lo, vtype,
     return keep, zero_seq, host_resolve & ~is_pad, group_id
 
 
+@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
+def _fused_sort_gc_impl(key_words, key_len, inv_hi, inv_lo, vtype, idx,
+                        snap_hi, snap_lo, num_key_words, bottommost):
+    """Sort + GC mask in ONE device program (single host round trip for
+    tombstone-free jobs). Returns (order, zero_flags, count, has_complex):
+    order[i] for i < count = original indices of survivors in output order."""
+    kw, kl, ih, il, vt, perm = _sort_impl(
+        key_words, key_len, inv_hi, inv_lo, vtype, idx, num_key_words
+    )
+    n = kw.shape[0]
+    zeros = jnp.zeros(n, dtype=jnp.uint32)
+    keep, zero_seq, host_resolve, _ = _gc_mask_impl(
+        kw, kl, ih, il, vt, snap_hi, snap_lo, zeros, zeros,
+        num_key_words, bottommost,
+    )
+    # Compact survivors to the front, preserving sorted order.
+    take = jnp.argsort(~keep, stable=True)
+    order = perm[take]
+    zero_flags = zero_seq[take]
+    count = jnp.sum(keep.astype(jnp.int32))
+    has_complex = jnp.any(host_resolve)
+    return order, zero_flags, count, has_complex
+
+
+def fused_sort_gc(padded: dict, snapshots: list[int], bottommost: bool):
+    """Host wrapper for the fused kernel (no range tombstones).
+    Returns (order np[count], zero_flags np[count], has_complex bool)."""
+    if len(snapshots) > MAX_SNAPSHOTS:
+        raise NotSupported(
+            f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
+        )
+    p = padded["key_words"].shape[0]
+    pad_snap = 1 << 56
+    snaps = sorted(snapshots) + [pad_snap] * (MAX_SNAPSHOTS - len(snapshots))
+    snap_hi = np.array([s >> 32 for s in snaps], dtype=np.uint32)
+    snap_lo = np.array([s & 0xFFFFFFFF for s in snaps], dtype=np.uint32)
+    idx = np.arange(p, dtype=np.int32)
+    order, zero_flags, count, has_complex = _fused_sort_gc_impl(
+        padded["key_words"], padded["key_len"], padded["inv_hi"],
+        padded["inv_lo"], padded["vtype"], idx, snap_hi, snap_lo,
+        padded["w"], bool(bottommost),
+    )
+    c = int(count)
+    return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)
+
+
 def gc_mask(sorted_cols: dict, snapshots: list[int],
             tomb_cover: np.ndarray | None, bottommost: bool):
     """Host wrapper over sorted on-device columns from device_sort().

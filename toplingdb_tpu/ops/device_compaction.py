@@ -63,6 +63,12 @@ def _tombstone_cover(sorted_user_keys: list[bytes], rd: RangeDelAggregator,
     return cover
 
 
+# Longest user key the device paths accept: the sort uses one operand per
+# 4 key bytes, and XLA compile time grows with operand count. Longer keys
+# route to the host CompactionIterator (scheduler fallback-to-local).
+MAX_DEVICE_KEY_BYTES = 128
+
+
 def device_gc_entries(entries, icmp, snapshots, bottommost,
                       merge_operator=None, compaction_filter=None,
                       compaction_filter_level=0, rd=None,
@@ -72,6 +78,15 @@ def device_gc_entries(entries, icmp, snapshots, bottommost,
     CompactionIterator.entries() over the merged sorted input."""
     if not entries:
         return
+    if max_key_bytes is None:
+        longest = max(len(k) for k, _ in entries) - 8
+        if longest > MAX_DEVICE_KEY_BYTES:
+            from toplingdb_tpu.utils.status import NotSupported
+
+            raise NotSupported(
+                f"user keys up to {longest}B exceed the device key budget "
+                f"({MAX_DEVICE_KEY_BYTES}B); use the CPU path"
+            )
     if icmp.user_comparator.name() != dbformat.BYTEWISE.name():
         # The device sort realizes bytewise-ascending user-key order; other
         # comparators must use the host path (scheduler falls back).
@@ -154,12 +169,191 @@ class _EmptyIter:
         return False
 
 
+class _FallbackToEntries(Exception):
+    """Raised inside the columnar fast path when the job needs per-entry
+    semantics (complex groups present)."""
+
+
+def columnar_from_kv(kv, max_key_bytes: int | None = None):
+    """Build the device sort columns straight from flat buffers — the
+    zero-Python-loop encode for the fast path."""
+    import types
+
+    import sys
+
+    n = kv.n
+    offs = kv.key_offs.astype(np.int64)
+    lens = kv.key_lens.astype(np.int64)
+    tr_idx = (offs + lens - 8)[:, None] + np.arange(8)[None, :]
+    trailer = np.ascontiguousarray(kv.key_buf[tr_idx])
+    packed = trailer.view(np.uint64).reshape(n)
+    if sys.byteorder == "big":  # trailer bytes on disk are LE
+        packed = packed.byteswap()
+    seq = packed >> np.uint64(8)
+    vtype = (packed & np.uint64(0xFF)).astype(np.int32)
+    inv = np.uint64(0xFFFFFFFFFFFFFFFF) - packed
+    sign = np.uint32(0x80000000)
+    inv_hi = ((inv >> np.uint64(32)).astype(np.uint32) ^ sign).view(np.int32)
+    inv_lo = ((inv & np.uint64(0xFFFFFFFF)).astype(np.uint32) ^ sign).view(np.int32)
+    uk_len = (lens - 8).astype(np.int32)
+    maxlen = int(uk_len.max()) if n else 0
+    if max_key_bytes is None:
+        max_key_bytes = max(4, maxlen)
+    w = (max_key_bytes + 3) // 4
+    span = w * 4
+    idx = offs[:, None] + np.arange(span)[None, :]
+    np.clip(idx, 0, max(len(kv.key_buf) - 1, 0), out=idx)
+    kb = kv.key_buf[idx] if n else np.zeros((0, span), dtype=np.uint8)
+    kb = kb * (np.arange(span)[None, :] < uk_len[:, None])
+    words = np.ascontiguousarray(kb).reshape(n, w, 4).astype(np.uint32)
+    packed_words = (
+        (words[:, :, 0] << 24) | (words[:, :, 1] << 16)
+        | (words[:, :, 2] << 8) | words[:, :, 3]
+    )
+    key_words = (packed_words ^ sign).view(np.int32)
+    return types.SimpleNamespace(
+        key_words=key_words, key_len=uk_len, inv_hi=inv_hi, inv_lo=inv_lo,
+        vtype=vtype, seq=seq, n=n,
+    )
+
+
+def _collect_raw_columnar(compaction, table_cache, icmp):
+    from toplingdb_tpu.ops.columnar_io import ColumnarKV, scan_table_columnar
+
+    parts = []
+    rd = RangeDelAggregator(icmp.user_comparator)
+    for _, f in compaction.all_inputs():
+        r = table_cache.get_reader(f.number)
+        parts.append(scan_table_columnar(r))
+        for b, e in r.range_del_entries():
+            rd.add(RangeTombstone.from_table_entry(b, e))
+    return ColumnarKV.concat(parts), rd
+
+
+def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
+                                    table_options, snapshots, merge_operator,
+                                    new_file_number, creation_time,
+                                    device_name):
+    from toplingdb_tpu.compaction.compaction_job import (
+        surviving_tombstone_fragments,
+    )
+    from toplingdb_tpu.db import filename
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.ops.columnar_io import write_table_columnar
+
+    from toplingdb_tpu.utils.status import NotSupported
+
+    t0 = time.time()
+    stats = CompactionStats(device=device_name)
+    stats.input_bytes = compaction.total_input_bytes()
+    try:
+        kv, rd = _collect_raw_columnar(compaction, table_cache, icmp)
+    except NotSupported:
+        raise _FallbackToEntries()  # >2GiB columnar buffers etc.
+    stats.input_records = kv.n
+    if kv.n == 0 and rd.empty():
+        stats.work_time_usec = int((time.time() - t0) * 1e6)
+        return [], stats
+    if kv.n and int(kv.key_lens.max()) - 8 > MAX_DEVICE_KEY_BYTES:
+        # Exceeds the sort-operand budget (and the 4096B native block-builder
+        # key buffer); the entries path re-checks and routes to the CPU.
+        raise _FallbackToEntries()
+    col = columnar_from_kv(kv)
+    padded = ck.pad_columns(col)
+    if rd.empty():
+        # Tombstone-free: single fused device program, one round trip.
+        order, zero_flags, has_complex = ck.fused_sort_gc(
+            padded, snapshots, compaction.bottommost
+        )
+        if has_complex:
+            raise _FallbackToEntries()
+        zero_orig = order[zero_flags]
+    else:
+        sorted_cols, perm = ck.device_sort(padded)
+        sorted_uks = [
+            kv.key_buf[kv.key_offs[i]: kv.key_offs[i] + kv.key_lens[i] - 8]
+            .tobytes() for i in perm
+        ]
+        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator)
+        keep, zero_seq, host_resolve, group_id = ck.gc_mask(
+            sorted_cols, snapshots, cover, bottommost=compaction.bottommost
+        )
+        if host_resolve.any():
+            raise _FallbackToEntries()  # merge/single-delete groups present
+        order = perm[keep]
+        zero_orig = perm[zero_seq]
+
+    trailer_override = np.full(kv.n, -1, dtype=np.int64)
+    # packed trailer for seq 0 is just the type byte.
+    trailer_override[zero_orig] = col.vtype[zero_orig].astype(np.int64)
+    seqs = col.seq.copy()
+    seqs[zero_orig] = 0
+
+    tombs = surviving_tombstone_fragments(
+        rd, snapshots, compaction.bottommost, icmp.user_comparator
+    )
+    outputs = []
+    if len(order) or tombs:
+        fnum = new_file_number()
+        path = filename.table_file_name(dbname, fnum)
+        w = env.new_writable_file(path)
+        try:
+            props, smallest, largest = write_table_columnar(
+                w, icmp, table_options, kv, order, trailer_override,
+                col.vtype, seqs, tombs,
+                creation_time if creation_time is not None else int(time.time()),
+            )
+            w.sync()
+        except NotSupported:
+            # Native builder refused (oversized key / restart overflow):
+            # remove the partial file and use the per-entry path.
+            w.close()
+            env.delete_file(path)
+            raise _FallbackToEntries()
+        finally:
+            w.close()
+        if props.num_entries == 0 and props.num_range_deletions == 0:
+            env.delete_file(path)
+        else:
+            meta = FileMetaData(
+                number=fnum, file_size=env.get_file_size(path),
+                smallest=smallest, largest=largest,
+                smallest_seqno=props.smallest_seqno,
+                largest_seqno=props.largest_seqno,
+                num_entries=props.num_entries,
+                num_deletions=props.num_deletions,
+                num_range_deletions=props.num_range_deletions,
+            )
+            outputs.append(meta)
+            stats.output_bytes += meta.file_size
+            stats.output_files += 1
+            stats.output_records = props.num_entries
+    stats.work_time_usec = int((time.time() - t0) * 1e6)
+    return outputs, stats
+
+
 def run_device_compaction(env, dbname, icmp, compaction, table_cache,
                           table_options, snapshots, merge_operator=None,
                           compaction_filter=None, new_file_number=None,
                           creation_time=None, device_name="tpu"):
     """Device counterpart of run_compaction_to_tables — same signature shape,
-    byte-identical outputs."""
+    byte-identical outputs. Jobs that can't cut output files (single-output)
+    with no compaction filter take the fully-columnar native fast path; the
+    rest stream through the per-entry generator."""
+    from toplingdb_tpu import native
+
+    if (native.lib() is not None
+            and compaction_filter is None
+            and icmp.user_comparator.name() == dbformat.BYTEWISE.name()
+            and compaction.max_output_file_size >= compaction.total_input_bytes()):
+        try:
+            return _run_device_compaction_columnar(
+                env, dbname, icmp, compaction, table_cache, table_options,
+                snapshots, merge_operator, new_file_number, creation_time,
+                device_name,
+            )
+        except _FallbackToEntries:
+            pass
     t0 = time.time()
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()
