@@ -1,0 +1,646 @@
+"""Utilities layer: checkpoint, backup, sst writer/ingest, TTL, WBWI,
+transactions, statistics, listeners, config/registry, HTTP introspection."""
+
+import json
+import struct
+import threading
+import urllib.request
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options, ReadOptions, WriteOptions
+
+
+def opts(**kw):
+    kw.setdefault("write_buffer_size", 16 * 1024)
+    return Options(**kw)
+
+
+# -- checkpoint / backup ----------------------------------------------------
+
+
+def test_checkpoint_is_openable(tmp_path):
+    from toplingdb_tpu.utilities.checkpoint import create_checkpoint
+
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "ckpt")
+    with DB.open(src, opts()) as db:
+        for i in range(500):
+            db.put(b"k%04d" % i, b"v%04d" % i)
+        create_checkpoint(db, dst)
+        db.put(b"after", b"x")  # not in checkpoint
+    with DB.open(dst, opts()) as db2:
+        assert db2.get(b"k0123") == b"v0123"
+        assert db2.get(b"after") is None
+
+
+def test_backup_restore_and_purge(tmp_path):
+    from toplingdb_tpu.utilities.backup_engine import BackupEngine
+
+    src = str(tmp_path / "src")
+    be = BackupEngine(str(tmp_path / "backups"))
+    with DB.open(src, opts()) as db:
+        db.put(b"a", b"1")
+        b1 = be.create_backup(db)
+        db.put(b"b", b"2")
+        b2 = be.create_backup(db)
+    infos = be.get_backup_info()
+    assert [i["backup_id"] for i in infos] == [b1, b2]
+    restored = str(tmp_path / "restored")
+    be.restore_db_from_backup(b1, restored)
+    with DB.open(restored, opts()) as db2:
+        assert db2.get(b"a") == b"1"
+        assert db2.get(b"b") is None
+    be.purge_old_backups(1)
+    assert [i["backup_id"] for i in be.get_backup_info()] == [b2]
+
+
+# -- sst file writer / ingestion -------------------------------------------
+
+
+def test_sst_file_writer_and_ingest(tmp_path):
+    from toplingdb_tpu.utilities.sst_file_writer import (
+        SstFileReader, SstFileWriter, ingest_external_file,
+    )
+
+    ext = str(tmp_path / "ext.sst")
+    w = SstFileWriter()
+    w.open(ext)
+    for i in range(100):
+        w.put(b"ing%04d" % i, b"x%04d" % i)
+    w.finish()
+
+    r = SstFileReader(ext)
+    assert r.properties.num_entries == 100
+
+    dbdir = str(tmp_path / "db")
+    with DB.open(dbdir, opts()) as db:
+        db.put(b"existing", b"1")
+        snap = db.get_snapshot()
+        level = ingest_external_file(db, ext)
+        assert db.get(b"ing0050") == b"x0050"
+        assert db.get(b"existing") == b"1"
+        # Snapshot taken before ingestion must not see ingested keys.
+        assert db.get(b"ing0050", ReadOptions(snapshot=snap)) is None
+        snap.release()
+    with DB.open(dbdir, opts()) as db:
+        assert db.get(b"ing0099") == b"x0099"
+
+
+def test_sst_writer_rejects_out_of_order(tmp_path):
+    from toplingdb_tpu.utilities.sst_file_writer import SstFileWriter
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    w = SstFileWriter()
+    w.open(str(tmp_path / "x.sst"))
+    w.put(b"b", b"1")
+    with pytest.raises(InvalidArgument):
+        w.put(b"a", b"2")
+
+
+# -- TTL --------------------------------------------------------------------
+
+
+def test_ttl_db(tmp_path):
+    from toplingdb_tpu.utilities.ttl import TtlDB
+
+    clock = [1000.0]
+    with TtlDB.open(str(tmp_path / "db"), ttl=100, options=opts(),
+                    clock=lambda: clock[0]) as db:
+        db.put(b"k", b"v")
+        assert db.get(b"k") == b"v"
+        clock[0] += 200  # expire
+        assert db.get(b"k") is None
+        db.flush()
+        db.compact_range()  # filter physically drops it
+        v = db.db.versions.current
+        assert sum(f.num_entries for _, f in v.all_files()) == 0
+
+
+# -- WriteBatchWithIndex ----------------------------------------------------
+
+
+def test_wbwi_read_your_writes(tmp_path):
+    from toplingdb_tpu.utilities.write_batch_with_index import WriteBatchWithIndex
+
+    with DB.open(str(tmp_path / "db"), opts()) as db:
+        db.put(b"base", b"db-val")
+        db.put(b"gone", b"x")
+        w = WriteBatchWithIndex()
+        w.put(b"new", b"batch-val")
+        w.delete(b"gone")
+        w.put(b"base", b"overridden")
+        assert w.get_from_batch_and_db(db, b"new") == b"batch-val"
+        assert w.get_from_batch_and_db(db, b"gone") is None
+        assert w.get_from_batch_and_db(db, b"base") == b"overridden"
+        assert w.get_from_batch_and_db(db, b"missing") is None
+        # Commit applies atomically.
+        db.write(w.batch)
+        assert db.get(b"base") == b"overridden"
+        assert db.get(b"gone") is None
+
+
+def test_wbwi_merge_with_db_base(tmp_path):
+    from toplingdb_tpu.utilities.write_batch_with_index import WriteBatchWithIndex
+    from toplingdb_tpu.utils.merge_operator import UInt64AddOperator
+
+    op = UInt64AddOperator()
+    with DB.open(str(tmp_path / "db"), opts(merge_operator=op)) as db:
+        db.put(b"c", struct.pack("<Q", 10))
+        w = WriteBatchWithIndex(op)
+        w.merge(b"c", struct.pack("<Q", 5))
+        assert struct.unpack("<Q", w.get_from_batch_and_db(db, b"c"))[0] == 15
+
+
+def test_wbwi_iterator_with_base(tmp_path):
+    from toplingdb_tpu.utilities.write_batch_with_index import WriteBatchWithIndex
+
+    with DB.open(str(tmp_path / "db"), opts()) as db:
+        db.put(b"a", b"1")
+        db.put(b"c", b"3")
+        w = WriteBatchWithIndex()
+        w.put(b"b", b"2")
+        w.delete(b"c")
+        w.put(b"d", b"4")
+        merged = w.iterator_with_base(db)
+        assert merged == [(b"a", b"1"), (b"b", b"2"), (b"d", b"4")]
+
+
+# -- transactions -----------------------------------------------------------
+
+
+def test_pessimistic_transaction_commit_rollback(tmp_path):
+    from toplingdb_tpu.utilities.transactions import TransactionDB
+
+    with TransactionDB.open(str(tmp_path / "db"), opts()) as tdb:
+        txn = tdb.begin_transaction()
+        txn.put(b"k", b"v1")
+        assert txn.get(b"k") == b"v1"          # read your writes
+        assert tdb.get(b"k") is None           # not visible before commit
+        txn.commit()
+        assert tdb.get(b"k") == b"v1"
+
+        txn2 = tdb.begin_transaction()
+        txn2.put(b"k", b"v2")
+        txn2.rollback()
+        assert tdb.get(b"k") == b"v1"
+
+
+def test_pessimistic_lock_conflict(tmp_path):
+    from toplingdb_tpu.utilities.transactions import TransactionDB
+    from toplingdb_tpu.utils.status import Busy
+
+    with TransactionDB.open(str(tmp_path / "db"), opts()) as tdb:
+        t1 = tdb.begin_transaction(lock_timeout=0.1)
+        t2 = tdb.begin_transaction(lock_timeout=0.1)
+        t1.put(b"k", b"t1")
+        with pytest.raises(Busy):
+            t2.put(b"k", b"t2")
+        t1.commit()
+        t2.put(b"k", b"t2")  # lock now free
+        t2.commit()
+        assert tdb.get(b"k") == b"t2"
+
+
+def test_deadlock_detection(tmp_path):
+    from toplingdb_tpu.utilities.transactions import DeadlockError, TransactionDB
+    from toplingdb_tpu.utils.status import Busy
+
+    with TransactionDB.open(str(tmp_path / "db"), opts()) as tdb:
+        t1 = tdb.begin_transaction(lock_timeout=5.0)
+        t2 = tdb.begin_transaction(lock_timeout=5.0)
+        t1.put(b"a", b"1")
+        t2.put(b"b", b"2")
+        errors = []
+
+        def t1_waits():
+            try:
+                t1.put(b"b", b"1b")  # blocks on t2
+            except Busy as e:
+                errors.append(e)
+
+        th = threading.Thread(target=t1_waits)
+        th.start()
+        import time
+
+        time.sleep(0.1)
+        with pytest.raises(Busy):  # DeadlockError is a Busy
+            t2.put(b"a", b"2a")
+        t2.rollback()
+        th.join()
+        t1.commit()
+
+
+def test_get_for_update_blocks_writers(tmp_path):
+    from toplingdb_tpu.utilities.transactions import TransactionDB
+    from toplingdb_tpu.utils.status import Busy
+
+    with TransactionDB.open(str(tmp_path / "db"), opts()) as tdb:
+        tdb.put(b"k", b"v0")
+        t1 = tdb.begin_transaction(lock_timeout=0.1)
+        assert t1.get_for_update(b"k") == b"v0"
+        t2 = tdb.begin_transaction(lock_timeout=0.1)
+        with pytest.raises(Busy):
+            t2.put(b"k", b"nope")
+        t1.commit()
+
+
+def test_optimistic_transaction_conflict(tmp_path):
+    from toplingdb_tpu.utilities.transactions import OptimisticTransactionDB
+    from toplingdb_tpu.utils.status import Busy
+
+    with OptimisticTransactionDB.open(str(tmp_path / "db"), opts()) as odb:
+        odb.db.put(b"k", b"v0")
+        t1 = odb.begin_transaction()
+        t2 = odb.begin_transaction()
+        assert t1.get_for_update(b"k") == b"v0"
+        t2.put(b"k", b"t2")
+        t2.commit()
+        t1.put(b"k", b"t1")
+        with pytest.raises(Busy):
+            t1.commit()
+        assert odb.get(b"k") == b"t2"
+
+
+def test_optimistic_no_conflict(tmp_path):
+    from toplingdb_tpu.utilities.transactions import OptimisticTransactionDB
+
+    with OptimisticTransactionDB.open(str(tmp_path / "db"), opts()) as odb:
+        t1 = odb.begin_transaction()
+        t1.put(b"x", b"1")
+        t1.commit()
+        assert odb.get(b"x") == b"1"
+
+
+# -- statistics / listeners -------------------------------------------------
+
+
+def test_statistics_collected(tmp_path):
+    from toplingdb_tpu.utils import statistics as st
+
+    stats = st.Statistics()
+    with DB.open(str(tmp_path / "db"), opts(statistics=stats)) as db:
+        for i in range(2000):
+            db.put(b"k%05d" % i, b"v" * 50)
+        db.flush()
+        db.compact_range()
+        assert stats.get_ticker_count(st.NUMBER_KEYS_WRITTEN) == 2000
+        assert stats.get_ticker_count(st.FLUSH_WRITE_BYTES) > 0
+        assert stats.get_ticker_count(st.COMPACT_READ_BYTES) > 0
+        assert stats.get_ticker_count(st.LCOMPACTION_READ_BYTES) > 0
+        h = stats.get_histogram(st.COMPACTION_TIME_MICROS)
+        assert h.count >= 1
+        assert "COUNT" in stats.to_string()
+
+
+def test_listener_callbacks(tmp_path):
+    from toplingdb_tpu.utils.listener import EventListener
+
+    events = []
+
+    class L(EventListener):
+        def on_flush_completed(self, db, info):
+            events.append(("flush", info.file_number))
+
+        def on_compaction_completed(self, db, info):
+            events.append(("compaction", info.input_level, info.output_level))
+
+    with DB.open(str(tmp_path / "db"), opts(listeners=[L()])) as db:
+        for i in range(100):
+            db.put(b"k%03d" % i, b"v")
+        db.flush()
+        db.compact_range()
+    kinds = {e[0] for e in events}
+    assert "flush" in kinds and "compaction" in kinds
+
+
+def test_event_log_written(tmp_path):
+    dbdir = str(tmp_path / "db")
+    with DB.open(dbdir, opts()) as db:
+        db.put(b"a", b"1")
+        db.flush()
+    lines = open(dbdir + "/LOG").read().strip().splitlines()
+    evs = [json.loads(l)["event"] for l in lines]
+    assert "flush_finished" in evs
+
+
+# -- config / registry / HTTP -----------------------------------------------
+
+
+def test_options_from_config_and_repo(tmp_path):
+    from toplingdb_tpu.utils.config import SidePluginRepo
+
+    repo = SidePluginRepo()
+    cfg = {
+        "path": str(tmp_path / "db"),
+        "options": {
+            "write_buffer_size": 32768,
+            "compaction_style": "leveled",
+            "merge_operator": "uint64add",
+            "statistics": "default",
+            "table_options": {"block_size": 2048,
+                              "filter_policy": {"class": "bloom",
+                                                "params": {"bits_per_key": 12}}},
+        },
+    }
+    db = repo.open_db(cfg, name="testdb")
+    db.merge(b"c", struct.pack("<Q", 4))
+    db.merge(b"c", struct.pack("<Q", 6))
+    assert struct.unpack("<Q", db.get(b"c"))[0] == 10
+    assert db.options.write_buffer_size == 32768
+    assert db.options.table_options.block_size == 2048
+
+    port = repo.start_http()
+    def fetch(path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return json.loads(r.read())
+    assert fetch("/dbs") == {"dbs": ["testdb"]}
+    assert "levelstats" in fetch("/stats/testdb")
+    assert fetch("/config/testdb")["path"] == cfg["path"]
+    repo.close_all()
+
+
+def test_config_rejects_unknown_option():
+    from toplingdb_tpu.utils.config import options_from_config
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    with pytest.raises(InvalidArgument):
+        options_from_config({"no_such_option": 1})
+
+
+# -- tools ------------------------------------------------------------------
+
+
+def test_db_bench_cli(tmp_path, capsys):
+    from toplingdb_tpu.tools.db_bench import main
+
+    main([
+        "--benchmarks=fillseq,readseq,readrandom,compact,stats",
+        "--num=500", f"--db={tmp_path}/bench",
+    ])
+    out = capsys.readouterr().out
+    assert "fillseq" in out and "ops/sec" in out
+
+
+def test_sst_dump_cli(tmp_path, capsys):
+    from toplingdb_tpu.tools.sst_dump import main as sst_main
+
+    dbdir = str(tmp_path / "db")
+    with DB.open(dbdir, opts()) as db:
+        for i in range(50):
+            db.put(b"k%03d" % i, b"v%03d" % i)
+        db.flush()
+        files = [f for _, f in db.versions.current.all_files()]
+        path = f"{dbdir}/{files[0].number:06d}.sst"
+    assert sst_main([f"--file={path}", "--command=verify"]) == 0
+    assert sst_main([f"--file={path}", "--command=props"]) == 0
+    out = capsys.readouterr().out
+    assert "num_entries: 50" in out
+
+
+def test_ldb_cli(tmp_path, capsys):
+    from toplingdb_tpu.tools.ldb import main as ldb_main
+
+    dbdir = str(tmp_path / "db")
+    assert ldb_main([f"--db={dbdir}", "put", "alpha", "1"]) == 0
+    assert ldb_main([f"--db={dbdir}", "get", "alpha"]) == 0
+    assert ldb_main([f"--db={dbdir}", "scan"]) == 0
+    assert ldb_main([f"--db={dbdir}", "manifest_dump"]) == 0
+    out = capsys.readouterr().out
+    assert "alpha" in out
+    assert ldb_main([f"--db={dbdir}", "get", "missing"]) == 1
+
+
+# -- read-only / secondary --------------------------------------------------
+
+
+def test_readonly_db(tmp_path):
+    from toplingdb_tpu.db.db_readonly import ReadOnlyDB
+    from toplingdb_tpu.utils.status import NotSupported
+
+    src = str(tmp_path / "db")
+    with DB.open(src, opts()) as db:
+        for i in range(100):
+            db.put(b"k%03d" % i, b"v%03d" % i)
+        db.flush()
+        db.put(b"unflushed", b"wal-only")
+    ro = ReadOnlyDB.open(src)
+    assert ro.get(b"k050") == b"v050"
+    assert ro.get(b"unflushed") == b"wal-only"  # WAL replayed read-only
+    with pytest.raises(NotSupported):
+        ro.put(b"x", b"y")
+    ro.close()
+    # Primary can still open normally afterward.
+    with DB.open(src, opts()) as db:
+        assert db.get(b"k050") == b"v050"
+
+
+def test_secondary_catches_up(tmp_path):
+    from toplingdb_tpu.db.db_readonly import SecondaryDB
+
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts())
+    db.put(b"a", b"1")
+    db.flush()
+    sec = SecondaryDB.open(src)
+    assert sec.get(b"a") == b"1"
+    db.put(b"b", b"2")
+    db.flush()
+    sec.try_catch_up_with_primary()
+    assert sec.get(b"b") == b"2"
+    sec.close()
+    db.close()
+
+
+# -- trace / replay ---------------------------------------------------------
+
+
+def test_trace_replay_analyze(tmp_path):
+    from toplingdb_tpu.utils.trace import Replayer, Tracer, analyze_trace
+
+    src = str(tmp_path / "db")
+    trace = str(tmp_path / "trace.bin")
+    with DB.open(src, opts()) as db:
+        t = Tracer(db, trace)
+        t.put(b"a", b"1")
+        t.put(b"b", b"2")
+        t.get(b"a")
+        t.delete(b"b")
+        t.close()
+    dst = str(tmp_path / "replayed")
+    with DB.open(dst, opts()) as db2:
+        n = Replayer(db2, trace).replay()
+        assert n == 4
+        assert db2.get(b"a") == b"1"
+        assert db2.get(b"b") is None
+        stats = analyze_trace(db2.env, trace)
+        assert stats["total_ops"] == 4
+        assert stats["per_op"]["put"] == 2
+
+
+# -- cache / rate limiter / write buffer manager -----------------------------
+
+
+def test_lru_cache_and_block_cache_integration(tmp_path):
+    from toplingdb_tpu.utils.cache import LRUCache
+    from toplingdb_tpu.db.table_cache import TableCache
+    from toplingdb_tpu.db.dbformat import InternalKeyComparator
+
+    cache = LRUCache(1 << 20)
+    src = str(tmp_path / "db")
+    with DB.open(src, opts()) as db:
+        for i in range(500):
+            db.put(b"k%04d" % i, b"v" * 100)
+        db.flush()
+        files = [f for _, f in db.versions.current.all_files()]
+    icmp = InternalKeyComparator()
+    from toplingdb_tpu.env import default_env
+
+    tc = TableCache(default_env(), src, icmp, block_cache=cache)
+    r = tc.get_reader(files[0].number)
+    it = r.new_iterator(); it.seek_to_first()
+    sum(1 for _ in it.entries())
+    it2 = r.new_iterator(); it2.seek_to_first()
+    sum(1 for _ in it2.entries())
+    assert cache.usage() > 0
+    assert cache.hit_rate() > 0.3
+
+
+def test_rate_limiter_enforces_rate():
+    import time
+
+    from toplingdb_tpu.utils.rate_limiter import RateLimiter
+
+    rl = RateLimiter(1_000_000)  # 1 MB/s
+    t0 = time.monotonic()
+    for _ in range(5):
+        rl.request(100_000)  # 500 KB total
+    dt = time.monotonic() - t0
+    assert rl.total_through == 500_000
+    assert dt >= 0.25  # at 1MB/s, 500KB needs >= ~0.4s with initial burst
+
+
+def test_write_buffer_manager():
+    from toplingdb_tpu.utils.rate_limiter import WriteBufferManager
+
+    m = WriteBufferManager(1000)
+    m.reserve(600)
+    assert not m.should_flush()
+    m.reserve(600)
+    assert m.should_flush()
+    m.free(900)
+    assert not m.should_flush()
+
+
+# -- fault injection --------------------------------------------------------
+
+
+def test_fault_injection_env(tmp_path):
+    from toplingdb_tpu.env.fault_injection import FaultInjectionEnv
+    from toplingdb_tpu.env import PosixEnv
+    from toplingdb_tpu.utils.status import Status
+
+    fenv = FaultInjectionEnv(PosixEnv())
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts(), env=fenv)
+    db.put(b"synced", b"1", WriteOptions(sync=True))
+    db.put(b"unsynced", b"2")
+    fenv.drop_unsynced_and_deactivate()
+    with pytest.raises(Status):
+        db.put(b"x", b"y", WriteOptions(sync=True))
+    db._closed = True  # simulate crash (no clean close)
+    fenv.reactivate_and_truncate()
+    db2 = DB.open(src, opts(), env=fenv)
+    assert db2.get(b"synced") == b"1"
+    assert db2.get(b"unsynced") is None  # lost with the crash
+    db2.close()
+    assert fenv.io_counts.get("append", 0) > 0
+
+
+# -- stress tool ------------------------------------------------------------
+
+
+def test_db_stress_small(tmp_path):
+    from toplingdb_tpu.tools.db_stress import main as stress_main
+
+    rc = stress_main([
+        f"--db={tmp_path}/sdb", "--ops=1500", "--threads=3", "--max-key=200",
+    ])
+    assert rc == 0
+    # Second run verifies persisted expected state against the reopened DB.
+    rc = stress_main([
+        f"--db={tmp_path}/sdb", "--ops=500", "--threads=2", "--max-key=200",
+    ])
+    assert rc == 0
+
+
+# -- review regressions -----------------------------------------------------
+
+
+def test_backup_purge_with_double_digit_ids(tmp_path):
+    """Review regression: purge must drop the numerically oldest backups,
+    not the lexicographically smallest filenames."""
+    from toplingdb_tpu.utilities.backup_engine import BackupEngine
+
+    src = str(tmp_path / "src")
+    be = BackupEngine(str(tmp_path / "backups"))
+    with DB.open(src, opts()) as db:
+        ids = []
+        for i in range(11):
+            db.put(b"k%02d" % i, b"v")
+            ids.append(be.create_backup(db))
+    be.purge_old_backups(2)
+    kept = [i["backup_id"] for i in be.get_backup_info()]
+    assert kept == ids[-2:]  # the NEWEST two survive
+    restored = str(tmp_path / "restored")
+    be.restore_db_from_backup(kept[-1], restored)
+    with DB.open(restored, opts()) as db2:
+        assert db2.get(b"k10") == b"v"
+
+
+def test_optimistic_conflict_between_snapshot_and_track(tmp_path):
+    """Review regression: a write landing between txn snapshot and
+    get_for_update must still be detected as a conflict."""
+    from toplingdb_tpu.utilities.transactions import OptimisticTransactionDB
+    from toplingdb_tpu.utils.status import Busy
+
+    with OptimisticTransactionDB.open(str(tmp_path / "db"), opts()) as odb:
+        odb.db.put(b"k", b"v0")
+        t1 = odb.begin_transaction()       # snapshot here
+        odb.db.put(b"k", b"v1")            # interleaved write
+        assert t1.get_for_update(b"k") == b"v0"  # reads at snapshot
+        t1.put(b"k", b"t1")
+        with pytest.raises(Busy):
+            t1.commit()                     # lost update prevented
+        assert odb.get(b"k") == b"v1"
+
+
+def test_checkpoint_on_mem_env():
+    """Review regression: checkpoint must work through a non-posix Env."""
+    from toplingdb_tpu.env import MemEnv
+    from toplingdb_tpu.utilities.checkpoint import create_checkpoint
+
+    env = MemEnv()
+    db = DB.open("/db", opts(), env=env)
+    for i in range(50):
+        db.put(b"k%02d" % i, b"v%02d" % i)
+    create_checkpoint(db, "/ckpt")
+    db.close()
+    db2 = DB.open("/ckpt", opts(), env=env)
+    assert db2.get(b"k25") == b"v25"
+    db2.close()
+
+
+def test_rate_limiter_oversized_request():
+    """Review regression: requests larger than one refill period must still
+    be throttled (split into chunks)."""
+    import time
+
+    from toplingdb_tpu.utils.rate_limiter import RateLimiter
+
+    rl = RateLimiter(1_000_000, refill_period_us=50_000)  # 50KB/period
+    t0 = time.monotonic()
+    rl.request(500_000)  # 10 periods worth
+    dt = time.monotonic() - t0
+    assert dt >= 0.3

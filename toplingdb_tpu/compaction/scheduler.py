@@ -158,6 +158,27 @@ class CompactionScheduler:
             with db._mutex:
                 db.versions.log_and_apply(edit)
                 db._delete_obsolete_files()
+            from toplingdb_tpu.utils.listener import CompactionJobInfo, notify
+
+            db.event_logger.log(
+                "compaction_finished", input_level=c.level,
+                output_level=c.output_level, device=stats.device,
+                input_records=stats.input_records,
+                output_records=stats.output_records,
+                input_bytes=stats.input_bytes, output_bytes=stats.output_bytes,
+                micros=stats.work_time_usec, reason=c.reason,
+            )
+            notify(db.options.listeners, "on_compaction_completed", db,
+                   CompactionJobInfo(
+                       db_name=db.dbname, input_level=c.level,
+                       output_level=c.output_level,
+                       input_files=[f.number for _, f in c.all_inputs()],
+                       output_files=[m.number for m in outputs],
+                       input_records=stats.input_records,
+                       output_records=stats.output_records,
+                       elapsed_micros=stats.work_time_usec,
+                       device=stats.device, reason=c.reason,
+                   ))
         finally:
             with db._mutex:
                 db._pending_outputs.difference_update(pending)

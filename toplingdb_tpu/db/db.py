@@ -58,6 +58,24 @@ class DB:
         self._bg_error: BaseException | None = None
         self._mem_id_counter = 0
         self.identity = ""
+        self.stats = options.statistics  # may be None
+        from toplingdb_tpu.utils.listener import EventLogger
+
+        self._log_file = None
+        if not options.read_only:
+            try:
+                # Through the Env (fault injection / MemEnv see it too); the
+                # previous LOG is rolled aside like the reference's
+                # auto_roll_logger.
+                if env.file_exists(f"{dbname}/LOG"):
+                    env.rename_file(f"{dbname}/LOG", f"{dbname}/LOG.old")
+                self._log_file = env.new_writable_file(f"{dbname}/LOG")
+            except Exception:
+                pass
+        self.event_logger = EventLogger(
+            (lambda line: self._log_file.append(line.encode() + b"\n"))
+            if self._log_file is not None else None
+        )
 
     # ==================================================================
     # Open / close
@@ -147,6 +165,8 @@ class DB:
                 self._wal.close()
             self.versions.close()
             self.table_cache.close()
+            if self._log_file is not None:
+                self._log_file.close()
             self._closed = True
 
     def __enter__(self):
@@ -207,6 +227,11 @@ class DB:
                     self._wal.flush()
             batch.insert_into(self.mem)
             self.versions.last_sequence = seq + batch.count() - 1
+            if self.stats is not None:
+                from toplingdb_tpu.utils import statistics as st
+
+                self.stats.record_tick(st.NUMBER_KEYS_WRITTEN, batch.count())
+                self.stats.record_tick(st.BYTES_WRITTEN, batch.data_size())
             if self.mem.approximate_memory_usage() >= self.options.write_buffer_size:
                 self._switch_memtable()
                 self._flush_immutables()
@@ -231,6 +256,7 @@ class DB:
         self._maybe_schedule_compaction()
 
     def _flush_memtables(self, mems: list[MemTable], wal_number: int) -> None:
+        t0 = time.time()
         fnum = self.versions.new_file_number()
         meta = flush_memtable_to_table(
             self.env, self.dbname, fnum, self.icmp, mems,
@@ -240,6 +266,26 @@ class DB:
         if meta is not None:
             edit.add_file(0, meta)
         self.versions.log_and_apply(edit)
+        if meta is not None:
+            from toplingdb_tpu.utils import statistics as st
+            from toplingdb_tpu.utils.listener import FlushJobInfo, notify
+
+            if self.stats is not None:
+                self.stats.record_tick(st.FLUSH_WRITE_BYTES, meta.file_size)
+                self.stats.record_in_histogram(
+                    st.FLUSH_TIME_MICROS, (time.time() - t0) * 1e6
+                )
+            self.event_logger.log(
+                "flush_finished", file_number=meta.number,
+                file_size=meta.file_size, num_entries=meta.num_entries,
+            )
+            notify(self.options.listeners, "on_flush_completed", self,
+                   FlushJobInfo(
+                       db_name=self.dbname, file_number=meta.number,
+                       file_size=meta.file_size, num_entries=meta.num_entries,
+                       smallest_seqno=meta.smallest_seqno,
+                       largest_seqno=meta.largest_seqno,
+                   ))
 
     def flush(self, fopts: FlushOptions = FlushOptions()) -> None:
         with self._mutex:

@@ -1,0 +1,102 @@
+"""Read-only and secondary DB access (reference db/db_impl/db_impl_readonly.cc
+and db_impl_secondary.cc in /root/reference).
+
+ReadOnlyDB: a DB opened without WAL replay into mutable state and without
+taking ownership of the dir — writes raise. SecondaryDB additionally follows
+the primary: try_catch_up_with_primary() re-reads CURRENT/MANIFEST and tails
+new WALs into its own memtable view.
+"""
+
+from __future__ import annotations
+
+from toplingdb_tpu.db import filename
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.db.log import LogReader
+from toplingdb_tpu.db.write_batch import WriteBatch
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.utils.status import NotSupported
+
+
+class ReadOnlyDB(DB):
+    @staticmethod
+    def open(dbname: str, options: Options | None = None, env=None) -> "ReadOnlyDB":
+        options = options or Options()
+        options.create_if_missing = False
+        options.disable_auto_compactions = True
+        options.read_only = True
+        from toplingdb_tpu.env import default_env
+
+        env = env or default_env()
+        db = ReadOnlyDB(dbname, options, env)
+        db.versions.recover(readonly=True)
+        db._replay_wals_into_mem()
+        db._compaction_scheduler = None
+        return db
+
+    def _replay_wals_into_mem(self) -> None:
+        for child in self.env.get_children(self.dbname):
+            ftype, num = filename.parse_file_name(child)
+            if ftype == filename.FileType.WAL and num >= self.versions.log_number:
+                try:
+                    reader = LogReader(self.env.new_sequential_file(
+                        filename.log_file_name(self.dbname, num)))
+                    for rec in reader.records():
+                        batch = WriteBatch(rec)
+                        batch.insert_into(self.mem)
+                        end = batch.sequence() + batch.count() - 1
+                        if end > self.versions.last_sequence:
+                            self.versions.last_sequence = end
+                except Exception:
+                    pass  # primary may be appending; read what's durable
+
+    def write(self, batch, opts=None) -> None:
+        raise NotSupported("DB is open read-only")
+
+    def flush(self, fopts=None) -> None:
+        raise NotSupported("DB is open read-only")
+
+    def compact_range(self, begin=None, end=None) -> None:
+        raise NotSupported("DB is open read-only")
+
+    def close(self) -> None:
+        with self._mutex:
+            if self._closed:
+                return
+            self.versions._manifest_writer = None
+            self.table_cache.close()
+            if self._log_file is not None:
+                self._log_file.close()
+            self._closed = True
+
+
+class SecondaryDB(ReadOnlyDB):
+    """Follows a live primary (reference DBImplSecondary)."""
+
+    @staticmethod
+    def open(dbname: str, options: Options | None = None, env=None) -> "SecondaryDB":
+        options = options or Options()
+        options.create_if_missing = False
+        options.disable_auto_compactions = True
+        options.read_only = True
+        from toplingdb_tpu.env import default_env
+
+        env = env or default_env()
+        db = SecondaryDB(dbname, options, env)
+        db.versions.recover(readonly=True)
+        db._replay_wals_into_mem()
+        db._compaction_scheduler = None
+        return db
+
+    def try_catch_up_with_primary(self) -> None:
+        """Re-read CURRENT → MANIFEST and WAL tails (reference
+        TryCatchUpWithPrimary)."""
+        from toplingdb_tpu.db.memtable import MemTable
+        from toplingdb_tpu.db.version_set import VersionSet
+
+        with self._mutex:
+            vs = VersionSet(self.env, self.dbname, self.icmp,
+                            self.options.num_levels)
+            vs.recover(readonly=True)
+            self.versions = vs
+            self.mem = MemTable(self.icmp)
+            self._replay_wals_into_mem()

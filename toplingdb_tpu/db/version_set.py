@@ -212,9 +212,11 @@ class VersionSet:
         self._manifest_writer.sync()
         filename.set_current_file(self.env, self.dbname, self.manifest_file_number)
 
-    def recover(self) -> None:
+    def recover(self, readonly: bool = False) -> None:
         """Replay CURRENT → MANIFEST into the in-memory state
-        (reference VersionSet::Recover, version_set.cc:6196)."""
+        (reference VersionSet::Recover, version_set.cc:6196). With
+        readonly=True the directory is not touched (no manifest roll), and
+        log_and_apply is unavailable."""
         cur = self.env.read_file(filename.current_file_name(self.dbname))
         name = cur.decode().strip()
         if not name.startswith("MANIFEST-"):
@@ -245,8 +247,9 @@ class VersionSet:
         self.current = builder.save()
         self._all_versions.add(self.current)
         self.mark_file_number_used(self.manifest_file_number)
-        # Reopen the manifest for appending new edits.
-        self._reopen_manifest_for_append(path)
+        if not readonly:
+            # Reopen the manifest for appending new edits.
+            self._reopen_manifest_for_append(path)
 
     def _reopen_manifest_for_append(self, path: str) -> None:
         # Env has no append mode; rewrite the manifest as a fresh snapshot in

@@ -1,0 +1,150 @@
+"""Fault-injection Env: the crash/IO-error test harness seam
+(reference utilities/fault_injection_fs.h:204 FaultInjectionTestFS in
+/root/reference): wraps any Env; can drop unsynced writes ("crash"), inject
+errors on the Nth operation or per-operation-type, and count IO."""
+
+from __future__ import annotations
+
+import threading
+
+from toplingdb_tpu.env.env import Env, RandomAccessFile, SequentialFile, WritableFile
+from toplingdb_tpu.utils.status import IOError_
+
+
+class FaultInjectionEnv(Env):
+    def __init__(self, base: Env):
+        self.base = base
+        self._mu = threading.Lock()
+        self._unsynced: dict[str, int] = {}   # path → synced length
+        self._files: dict[str, "_FIWritable"] = {}
+        self.fail_after_ops: int | None = None
+        self.fail_ops: set[str] = set()       # e.g. {"append", "sync", "read"}
+        self.op_count = 0
+        self.io_counts: dict[str, int] = {}
+        self._filesystem_active = True
+
+    # ------------------------------------------------------------------
+
+    def _op(self, kind: str) -> None:
+        with self._mu:
+            self.op_count += 1
+            self.io_counts[kind] = self.io_counts.get(kind, 0) + 1
+            if not self._filesystem_active:
+                raise IOError_(f"injected: filesystem inactive ({kind})")
+            if kind in self.fail_ops:
+                raise IOError_(f"injected {kind} error")
+            if self.fail_after_ops is not None and self.op_count > self.fail_after_ops:
+                raise IOError_(f"injected error after {self.fail_after_ops} ops")
+
+    def drop_unsynced_and_deactivate(self) -> None:
+        """Simulate a crash: future IO fails until reactivate(); unsynced
+        data in tracked writables is lost (truncate on reactivate)."""
+        with self._mu:
+            self._filesystem_active = False
+
+    def reactivate_and_truncate(self) -> None:
+        """Come back from the crash: truncate files to their synced length."""
+        with self._mu:
+            self._filesystem_active = True
+            import os
+
+            for path, synced in self._unsynced.items():
+                try:
+                    with open(path, "rb+") as f:
+                        f.truncate(synced)
+                except OSError:
+                    pass
+            self._unsynced.clear()
+
+    # -- Env interface --------------------------------------------------
+
+    def new_writable_file(self, path: str) -> WritableFile:
+        self._op("open_w")
+        f = self.base.new_writable_file(path)
+        wrapped = _FIWritable(self, path, f)
+        with self._mu:
+            self._unsynced[path] = 0
+        return wrapped
+
+    def new_random_access_file(self, path: str) -> RandomAccessFile:
+        self._op("open_r")
+        return _FIRandom(self, self.base.new_random_access_file(path))
+
+    def new_sequential_file(self, path: str) -> SequentialFile:
+        self._op("open_s")
+        return _FISequential(self, self.base.new_sequential_file(path))
+
+    def file_exists(self, path: str) -> bool:
+        return self.base.file_exists(path)
+
+    def get_file_size(self, path: str) -> int:
+        return self.base.get_file_size(path)
+
+    def delete_file(self, path: str) -> None:
+        self._op("delete")
+        self.base.delete_file(path)
+
+    def rename_file(self, src: str, dst: str) -> None:
+        self._op("rename")
+        self.base.rename_file(src, dst)
+
+    def create_dir(self, path: str) -> None:
+        self.base.create_dir(path)
+
+    def get_children(self, path: str):
+        return self.base.get_children(path)
+
+
+class _FIWritable(WritableFile):
+    def __init__(self, env: FaultInjectionEnv, path: str, base: WritableFile):
+        self._env = env
+        self._path = path
+        self._base = base
+
+    def append(self, data: bytes) -> None:
+        self._env._op("append")
+        self._base.append(data)
+
+    def flush(self) -> None:
+        self._base.flush()
+
+    def sync(self) -> None:
+        self._env._op("sync")
+        self._base.sync()
+        with self._env._mu:
+            self._env._unsynced[self._path] = self._base.file_size()
+
+    def close(self) -> None:
+        self._base.close()
+
+    def file_size(self) -> int:
+        return self._base.file_size()
+
+
+class _FIRandom(RandomAccessFile):
+    def __init__(self, env, base):
+        self._env = env
+        self._base = base
+
+    def read(self, offset, n):
+        self._env._op("read")
+        return self._base.read(offset, n)
+
+    def size(self):
+        return self._base.size()
+
+    def close(self):
+        self._base.close()
+
+
+class _FISequential(SequentialFile):
+    def __init__(self, env, base):
+        self._env = env
+        self._base = base
+
+    def read(self, n):
+        self._env._op("read")
+        return self._base.read(n)
+
+    def close(self):
+        self._base.close()

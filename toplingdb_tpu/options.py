@@ -22,6 +22,7 @@ class Options:
     create_if_missing: bool = True
     error_if_exists: bool = False
     paranoid_checks: bool = True
+    read_only: bool = False             # set by ReadOnlyDB/SecondaryDB.open
     comparator: Comparator = field(default_factory=lambda: BYTEWISE)
     merge_operator: Any = None          # MergeOperator instance or None
     compaction_filter: Any = None

@@ -1,0 +1,193 @@
+"""db_bench: the canonical benchmark driver.
+
+Workload set mirrors the reference's db_bench dispatch
+(tools/db_bench_tool.cc:3784-3893 in /root/reference): comma-separated
+benchmarks run in order against one DB. `--json` loads a SidePlugin-style
+config document (the Topling -json flag analogue).
+
+Usage:
+  python -m toplingdb_tpu.tools.db_bench --benchmarks=fillseq,readrandom \
+      --num=100000 --db=/tmp/bench_db [--json=config.json] [--value-size=100]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import time
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options, ReadOptions, WriteOptions
+from toplingdb_tpu.db.write_batch import WriteBatch
+
+
+class Bench:
+    def __init__(self, args):
+        self.args = args
+        self.rng = random.Random(args.seed)
+        if args.json:
+            from toplingdb_tpu.utils.config import options_from_config
+
+            with open(args.json) as f:
+                cfg = json.load(f)
+            self.options = options_from_config(cfg.get("options", cfg))
+        else:
+            self.options = Options()
+        if args.statistics and self.options.statistics is None:
+            from toplingdb_tpu.utils.statistics import Statistics
+
+            self.options.statistics = Statistics()
+        self.db: DB | None = None
+
+    def key(self, i: int) -> bytes:
+        return b"%016d" % i
+
+    def value(self, i: int) -> bytes:
+        data = (b"%d" % i) * (self.args.value_size // max(1, len(b"%d" % i)) + 1)
+        return data[: self.args.value_size]
+
+    def open_db(self, fresh: bool) -> None:
+        if self.db is not None:
+            self.db.close()
+            self.db = None
+        if fresh and not self.args.use_existing_db and os.path.exists(self.args.db):
+            shutil.rmtree(self.args.db)
+        self.db = DB.open(self.args.db, self.options)
+
+    def run(self) -> None:
+        for name in self.args.benchmarks.split(","):
+            name = name.strip()
+            fn = getattr(self, "bench_" + name, None)
+            if fn is None:
+                print(f"unknown benchmark: {name}")
+                continue
+            fresh = name.startswith("fill")
+            if self.db is None or fresh:
+                self.open_db(fresh)
+            n = self.args.num
+            t0 = time.time()
+            ops = fn(n)
+            dt = time.time() - t0
+            ops = ops or n
+            print(
+                f"{name:<20} : {dt * 1e6 / ops:10.3f} micros/op "
+                f"{ops / dt:12.0f} ops/sec; {dt:8.2f} s"
+            )
+        if self.db is not None:
+            if self.args.print_stats and self.db.stats is not None:
+                print(self.db.stats.to_string())
+            self.db.close()
+
+    # -- workloads ------------------------------------------------------
+
+    def bench_fillseq(self, n):
+        wo = WriteOptions(disable_wal=self.args.disable_wal)
+        batch = self.args.batch_size
+        i = 0
+        while i < n:
+            b = WriteBatch()
+            for _ in range(min(batch, n - i)):
+                b.put(self.key(i), self.value(i))
+                i += 1
+            self.db.write(b, wo)
+        return n
+
+    def bench_fillrandom(self, n):
+        wo = WriteOptions(disable_wal=self.args.disable_wal)
+        batch = self.args.batch_size
+        i = 0
+        while i < n:
+            b = WriteBatch()
+            for _ in range(min(batch, n - i)):
+                b.put(self.key(self.rng.randrange(n)), self.value(i))
+                i += 1
+            self.db.write(b, wo)
+        return n
+
+    def bench_overwrite(self, n):
+        return self.bench_fillrandom(n)
+
+    def bench_readseq(self, n):
+        it = self.db.new_iterator()
+        it.seek_to_first()
+        count = 0
+        while it.valid() and count < n:
+            it.key(), it.value()
+            it.next()
+            count += 1
+        return count
+
+    def bench_readrandom(self, n):
+        ro = ReadOptions()
+        hits = 0
+        for _ in range(n):
+            if self.db.get(self.key(self.rng.randrange(self.args.num)), ro) is not None:
+                hits += 1
+        return n
+
+    def bench_multireadrandom(self, n):
+        ro = ReadOptions()
+        done = 0
+        while done < n:
+            ks = [self.key(self.rng.randrange(self.args.num))
+                  for _ in range(min(16, n - done))]
+            self.db.multi_get(ks, ro)
+            done += len(ks)
+        return n
+
+    def bench_readwhilewriting(self, n):
+        import threading
+
+        stop = []
+
+        def writer():
+            i = 0
+            while not stop:
+                self.db.put(self.key(self.rng.randrange(self.args.num)),
+                            self.value(i))
+                i += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            return self.bench_readrandom(n)
+        finally:
+            stop.append(1)
+            t.join()
+
+    def bench_deleteseq(self, n):
+        for i in range(n):
+            self.db.delete(self.key(i))
+        return n
+
+    def bench_compact(self, n):
+        self.db.compact_range()
+        return 1
+
+    def bench_stats(self, n):
+        print(self.db.get_property("tpulsm.stats"))
+        return 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--benchmarks", default="fillseq,readrandom")
+    ap.add_argument("--num", type=int, default=100000)
+    ap.add_argument("--db", default="/tmp/tpulsm_bench")
+    ap.add_argument("--value-size", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=301)
+    ap.add_argument("--json", default=None, help="SidePlugin-style config")
+    ap.add_argument("--disable-wal", action="store_true")
+    ap.add_argument("--use-existing-db", action="store_true")
+    ap.add_argument("--statistics", action="store_true")
+    ap.add_argument("--print-stats", action="store_true")
+    args = ap.parse_args(argv)
+    Bench(args).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,256 @@
+"""db_stress: randomized stateful stress + crash-recovery harness.
+
+Reference db_stress_tool/ + tools/db_crashtest.py in /root/reference: an
+ExpectedState mirrors every key's latest value and survives kills; worker
+threads run random ops; blackbox mode kill -9's the child process at random
+intervals, reopens, and verifies against the model.
+
+Crash-consistent model: every op is journaled write-ahead (fsync) BEFORE the
+synced DB write, and committed AFTER it. On recovery, a key whose newest
+journal record is uncommitted may legally hold either the pending value or
+the previous committed one (the reference's ExpectedState pending-write
+semantics).
+
+Usage:
+  python -m toplingdb_tpu.tools.db_stress --ops=20000 --threads=4 \
+      --db=/tmp/stressdb [--crash-test --rounds=3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+
+class ExpectedState:
+    """Write-ahead op journal: lines
+      {"op": "W"|"D", "id": n, "key": k, "value": v}   (pre-write, fsynced)
+      {"op": "C", "id": n}                             (post-write commit)
+    Recovery derives, per key: last committed value + optional pending op.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a")
+        self._mu = threading.Lock()
+        self._next_id = 1
+
+    def load(self):
+        """Returns (committed: {key: value|None}, pending: {key: [values]})."""
+        committed: dict[str, str | None] = {}
+        key_ops: dict[int, tuple[str, str | None]] = {}
+        committed_ids: set[int] = set()
+        order: list[tuple[int, str]] = []
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail line from a crash
+                    if rec["op"] == "C":
+                        committed_ids.add(rec["id"])
+                    else:
+                        v = rec.get("value") if rec["op"] == "W" else None
+                        key_ops[rec["id"]] = (rec["key"], v)
+                        order.append((rec["id"], rec["key"]))
+                        if rec["id"] >= self._next_id:
+                            self._next_id = rec["id"] + 1
+        pending: dict[str, list[str | None]] = {}
+        for op_id, key in order:
+            _, v = key_ops[op_id]
+            if op_id in committed_ids:
+                committed[key] = v
+                pending.pop(key, None)
+            else:
+                pending.setdefault(key, []).append(v)
+        return committed, pending
+
+    def begin(self, key: str, value: str | None) -> int:
+        with self._mu:
+            op_id = self._next_id
+            self._next_id += 1
+            rec = {"op": "W" if value is not None else "D", "id": op_id,
+                   "key": key}
+            if value is not None:
+                rec["value"] = value
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            return op_id
+
+    def commit(self, op_id: int) -> None:
+        with self._mu:
+            self._f.write(json.dumps({"op": "C", "id": op_id}) + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def verify(db, committed, pending) -> int:
+    bad = 0
+    keys = set(committed) | set(pending)
+    for k in sorted(keys):
+        got = db.get(k.encode())
+        acceptable = set()
+        if k in committed:
+            acceptable.add(committed[k])
+        elif k in pending:
+            acceptable.add(None)  # pending op on a never-committed key
+        for v in pending.get(k, ()):
+            acceptable.add(v)
+        want = {v.encode() if v is not None else None for v in acceptable}
+        if got not in want:
+            bad += 1
+            if bad <= 10:
+                print(f"MISMATCH key={k} got={got} acceptable={want}")
+    return bad
+
+
+def run_stress(args) -> int:
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options, WriteOptions
+
+    model_path = args.db + ".journal"
+    expected = ExpectedState(model_path)
+    committed, pending = expected.load()
+    db = DB.open(args.db, Options(write_buffer_size=args.write_buffer_size))
+
+    bad = verify(db, committed, pending)
+    if bad:
+        print(f"VERIFICATION FAILED: {bad} mismatches")
+        db.close()
+        return 1
+    print(f"verified {len(committed) + len(pending)} keys from previous "
+          f"state: OK")
+    # Fold pending into committed using what the DB actually holds.
+    model = dict(committed)
+    for k in pending:
+        got = db.get(k.encode())
+        model[k] = got.decode() if got is not None else None
+
+    lock = threading.Lock()
+    errors = []
+    ops_done = [0]
+
+    def worker(tid: int):
+        rng = random.Random(args.seed + tid)
+        wo_sync = WriteOptions(sync=True)
+        while ops_done[0] < args.ops and not errors:
+            try:
+                k = "key%06d" % rng.randrange(args.max_key)
+                r = rng.random()
+                with lock:
+                    if r < 0.55:
+                        v = "val%010d" % rng.randrange(10**9)
+                        op = expected.begin(k, v)
+                        db.put(k.encode(), v.encode(), wo_sync)
+                        expected.commit(op)
+                        model[k] = v
+                    elif r < 0.75:
+                        op = expected.begin(k, None)
+                        db.delete(k.encode(), wo_sync)
+                        expected.commit(op)
+                        model[k] = None
+                    elif r < 0.9:
+                        got = db.get(k.encode())
+                        want = model.get(k)
+                        wantb = want.encode() if want is not None else None
+                        if k in model and got != wantb:
+                            errors.append(f"read mismatch {k}: {got} != {wantb}")
+                    else:
+                        it = db.new_iterator()
+                        it.seek(k.encode())
+                        for _ in range(5):
+                            if not it.valid():
+                                break
+                            it.next()
+                    ops_done[0] += 1
+            except Exception as e:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(args.threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    expected.close()
+    db.close()
+    if errors:
+        print("STRESS ERRORS:", errors[:5])
+        return 1
+    print(f"stress OK: {ops_done[0]} ops, {args.threads} threads")
+    return 0
+
+
+def run_crash_test(args) -> int:
+    """Blackbox crash loop (reference tools/db_crashtest.py): run the stress
+    child, kill -9 it at a random moment, reopen + verify, repeat."""
+    rng = random.Random(args.seed or None)
+    for round_ in range(args.rounds):
+        cmd = [
+            sys.executable, "-m", "toplingdb_tpu.tools.db_stress",
+            f"--db={args.db}", f"--ops={args.ops}",
+            f"--threads={args.threads}", f"--seed={args.seed + round_}",
+            f"--max-key={args.max_key}",
+        ]
+        child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+        kill_after = rng.uniform(0.5, args.kill_after)
+        try:
+            out, _ = child.communicate(timeout=kill_after)
+            if child.returncode != 0:
+                print(out.decode())
+                print(f"round {round_}: child failed rc={child.returncode}")
+                return 1
+            print(f"round {round_}: completed cleanly")
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait()
+            print(f"round {round_}: killed at {kill_after:.1f}s; verifying...")
+        # Verification happens at the start of the next child run.
+    vcmd = [
+        sys.executable, "-m", "toplingdb_tpu.tools.db_stress",
+        f"--db={args.db}", "--ops=0", "--threads=1",
+        f"--max-key={args.max_key}",
+    ]
+    r = subprocess.run(vcmd, capture_output=True)
+    sys.stdout.write(r.stdout.decode())
+    if r.returncode != 0:
+        print("FINAL VERIFICATION FAILED")
+        return 1
+    print("crash test passed")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--db", default="/tmp/tpulsm_stress")
+    ap.add_argument("--ops", type=int, default=10000)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--max-key", type=int, default=2000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--write-buffer-size", type=int, default=64 * 1024)
+    ap.add_argument("--crash-test", action="store_true")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--kill-after", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    if args.crash_test:
+        return run_crash_test(args)
+    return run_stress(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

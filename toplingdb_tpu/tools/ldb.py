@@ -1,0 +1,149 @@
+"""ldb: CLI admin tool (reference tools/ldb_cmd.cc in /root/reference).
+
+Usage:
+  python -m toplingdb_tpu.tools.ldb --db=DIR <command> [args]
+Commands:
+  get KEY | put KEY VALUE | delete KEY | scan [--from=K] [--to=K] [--limit=N]
+  batchput K1 V1 K2 V2 ... | deleterange BEGIN END
+  manifest_dump | wal_dump WALFILE | list_files | checkpoint DEST
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options, ReadOptions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--db", required=True)
+    ap.add_argument("--hex", action="store_true")
+    ap.add_argument("command")
+    ap.add_argument("cmd_args", nargs="*")
+    ap.add_argument("--from", dest="from_key", default=None)
+    ap.add_argument("--to", dest="to_key", default=None)
+    ap.add_argument("--limit", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    def enc(s: str) -> bytes:
+        return bytes.fromhex(s) if args.hex else s.encode()
+
+    def dec(b: bytes) -> str:
+        return b.hex() if args.hex else b.decode(errors="replace")
+
+    cmd = args.command
+    a = args.cmd_args
+
+    if cmd == "manifest_dump":
+        return _manifest_dump(args.db)
+    if cmd == "wal_dump":
+        return _wal_dump(a[0])
+    if cmd == "list_files":
+        from toplingdb_tpu.env import default_env
+
+        for child in default_env().get_children(args.db):
+            print(child)
+        return 0
+
+    db = DB.open(args.db, Options(create_if_missing=(cmd in ("put", "batchput"))))
+    try:
+        if cmd == "get":
+            v = db.get(enc(a[0]))
+            if v is None:
+                print("Key not found")
+                return 1
+            print(dec(v))
+        elif cmd == "put":
+            db.put(enc(a[0]), enc(a[1]))
+            print("OK")
+        elif cmd == "delete":
+            db.delete(enc(a[0]))
+            print("OK")
+        elif cmd == "deleterange":
+            db.delete_range(enc(a[0]), enc(a[1]))
+            print("OK")
+        elif cmd == "batchput":
+            from toplingdb_tpu.db.write_batch import WriteBatch
+
+            b = WriteBatch()
+            for k, v in zip(a[::2], a[1::2]):
+                b.put(enc(k), enc(v))
+            db.write(b)
+            print("OK")
+        elif cmd == "scan":
+            ro = ReadOptions(
+                iterate_lower_bound=enc(args.from_key) if args.from_key else None,
+                iterate_upper_bound=enc(args.to_key) if args.to_key else None,
+            )
+            it = db.new_iterator(ro)
+            it.seek_to_first()
+            n = 0
+            for k, v in it.entries():
+                print(f"{dec(k)} : {dec(v)}")
+                n += 1
+                if args.limit and n >= args.limit:
+                    break
+        elif cmd == "checkpoint":
+            from toplingdb_tpu.utilities.checkpoint import create_checkpoint
+
+            create_checkpoint(db, a[0])
+            print(f"checkpoint created at {a[0]}")
+        elif cmd == "stats":
+            print(db.get_property("tpulsm.stats"))
+        else:
+            print(f"unknown command {cmd!r}", file=sys.stderr)
+            return 2
+    finally:
+        db.close()
+    return 0
+
+
+def _manifest_dump(dbname: str) -> int:
+    from toplingdb_tpu.db import filename
+    from toplingdb_tpu.db.log import LogReader
+    from toplingdb_tpu.db.version_edit import VersionEdit
+    from toplingdb_tpu.env import default_env
+
+    env = default_env()
+    cur = env.read_file(filename.current_file_name(dbname)).decode().strip()
+    num = int(cur[len("MANIFEST-"):])
+    path = filename.manifest_file_name(dbname, num)
+    print(f"# {cur}")
+    for i, rec in enumerate(LogReader(env.new_sequential_file(path)).records()):
+        e = VersionEdit.decode(rec)
+        parts = []
+        if e.comparator:
+            parts.append(f"comparator={e.comparator}")
+        if e.log_number is not None:
+            parts.append(f"log_number={e.log_number}")
+        if e.next_file_number is not None:
+            parts.append(f"next_file={e.next_file_number}")
+        if e.last_sequence is not None:
+            parts.append(f"last_seq={e.last_sequence}")
+        for lvl, n in e.deleted_files:
+            parts.append(f"del(L{lvl},{n})")
+        for lvl, m in e.new_files:
+            parts.append(f"add(L{lvl},{m.number},{m.file_size}B,{m.num_entries}e)")
+        print(f"edit {i}: " + " ".join(parts))
+    return 0
+
+
+def _wal_dump(path: str) -> int:
+    from toplingdb_tpu.db.log import LogReader
+    from toplingdb_tpu.db.write_batch import WriteBatch
+    from toplingdb_tpu.env import default_env
+
+    env = default_env()
+    for rec in LogReader(env.new_sequential_file(path)).records():
+        b = WriteBatch(rec)
+        print(f"seq={b.sequence()} count={b.count()}")
+        for t, k, v in b.entries():
+            print(f"  type={t} key={k!r} value={v!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""BackupEngine: incremental backups with shared-file dedup
+(reference utilities/backup/backup_engine.cc in /root/reference).
+
+Layout under backup_dir:
+  shared/<file_size>_<crc32c>_<name>.sst    content-addressed SSTs
+  meta/<backup_id>.json                     manifest of one backup
+  private/<backup_id>/                      per-backup MANIFEST/CURRENT copy
+Restore rebuilds a DB dir from a backup id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from toplingdb_tpu.db import filename
+from toplingdb_tpu.utils import crc32c
+from toplingdb_tpu.utils.status import InvalidArgument, NotFound
+
+
+class BackupEngine:
+    def __init__(self, backup_dir: str):
+        self.dir = backup_dir
+        os.makedirs(os.path.join(backup_dir, "shared"), exist_ok=True)
+        os.makedirs(os.path.join(backup_dir, "meta"), exist_ok=True)
+        os.makedirs(os.path.join(backup_dir, "private"), exist_ok=True)
+
+    # ------------------------------------------------------------------
+
+    def _next_backup_id(self) -> int:
+        ids = [int(f.split(".")[0]) for f in os.listdir(os.path.join(self.dir, "meta"))
+               if f.split(".")[0].isdigit()]
+        return max(ids, default=0) + 1
+
+    def create_backup(self, db) -> int:
+        """Snapshot the DB (checkpoint = atomic consistent view), then dedup
+        its SSTs into shared/ — the file list and the MANIFEST come from the
+        SAME checkpoint, so concurrent compactions can't skew them."""
+        from toplingdb_tpu.utilities.checkpoint import create_checkpoint
+
+        backup_id = self._next_backup_id()
+        private = os.path.join(self.dir, "private", str(backup_id))
+        os.makedirs(private, exist_ok=True)
+        tmp_ckpt = private + ".ckpt"
+        if os.path.exists(tmp_ckpt):
+            shutil.rmtree(tmp_ckpt)
+        create_checkpoint(db, tmp_ckpt)
+        files = []
+        for name in sorted(os.listdir(tmp_ckpt)):
+            ftype, num = filename.parse_file_name(name)
+            path = os.path.join(tmp_ckpt, name)
+            if ftype != filename.FileType.TABLE:
+                shutil.copy2(path, os.path.join(private, name))
+                continue
+            with open(path, "rb") as s:
+                data = s.read()
+            crc = crc32c.value(data)
+            shared_name = f"{len(data)}_{crc:08x}_{num:06d}.sst"
+            shared_path = os.path.join(self.dir, "shared", shared_name)
+            if not os.path.exists(shared_path):
+                with open(shared_path + ".tmp", "wb") as d:
+                    d.write(data)
+                os.replace(shared_path + ".tmp", shared_path)
+            files.append({
+                "number": num, "shared": shared_name,
+                "size": len(data), "crc32c": crc,
+            })
+        shutil.rmtree(tmp_ckpt)
+        meta = {"backup_id": backup_id, "files": files}
+        meta_path = os.path.join(self.dir, "meta", f"{backup_id}.json")
+        with open(meta_path + ".tmp", "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(meta_path + ".tmp", meta_path)
+        return backup_id
+
+    def get_backup_info(self) -> list[dict]:
+        out = []
+        meta_dir = os.path.join(self.dir, "meta")
+        ids = sorted(
+            int(name[:-5]) for name in os.listdir(meta_dir)
+            if name.endswith(".json") and name[:-5].isdigit()
+        )
+        for bid in ids:  # numeric order: purge must drop OLDEST first
+            with open(os.path.join(meta_dir, f"{bid}.json")) as f:
+                m = json.load(f)
+            out.append({
+                "backup_id": m["backup_id"],
+                "num_files": len(m["files"]),
+                "size": sum(f["size"] for f in m["files"]),
+            })
+        return out
+
+    def restore_db_from_backup(self, backup_id: int, db_dir: str) -> None:
+        meta_path = os.path.join(self.dir, "meta", f"{backup_id}.json")
+        if not os.path.exists(meta_path):
+            raise NotFound(f"backup {backup_id}")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        os.makedirs(db_dir, exist_ok=True)
+        for f in meta["files"]:
+            src = os.path.join(self.dir, "shared", f["shared"])
+            with open(src, "rb") as s:
+                data = s.read()
+            if crc32c.value(data) != f["crc32c"]:
+                from toplingdb_tpu.utils.status import Corruption
+
+                raise Corruption(f"backup file {f['shared']} checksum mismatch")
+            dst = filename.table_file_name(db_dir, f["number"])
+            with open(dst, "wb") as d:
+                d.write(data)
+        private = os.path.join(self.dir, "private", str(backup_id))
+        for name in os.listdir(private):
+            shutil.copy2(os.path.join(private, name), os.path.join(db_dir, name))
+
+    def purge_old_backups(self, num_to_keep: int) -> None:
+        infos = self.get_backup_info()
+        to_drop = infos[: max(0, len(infos) - num_to_keep)]
+        keep_ids = {i["backup_id"] for i in infos} - {i["backup_id"] for i in to_drop}
+        # Collect shared files still referenced.
+        referenced = set()
+        for bid in keep_ids:
+            with open(os.path.join(self.dir, "meta", f"{bid}.json")) as f:
+                for fi in json.load(f)["files"]:
+                    referenced.add(fi["shared"])
+        for info in to_drop:
+            bid = info["backup_id"]
+            os.remove(os.path.join(self.dir, "meta", f"{bid}.json"))
+            shutil.rmtree(os.path.join(self.dir, "private", str(bid)),
+                          ignore_errors=True)
+        for name in os.listdir(os.path.join(self.dir, "shared")):
+            if name not in referenced:
+                os.remove(os.path.join(self.dir, "shared", name))

@@ -1,0 +1,70 @@
+"""Checkpoint: consistent openable snapshot of a live DB in a new directory
+(reference utilities/checkpoint/checkpoint_impl.cc in /root/reference):
+hard-link SSTs (copy on filesystems without links), write a fresh MANIFEST
+snapshot + CURRENT, flush first so no WAL tail is needed."""
+
+from __future__ import annotations
+
+import os
+
+from toplingdb_tpu.db import filename
+from toplingdb_tpu.db.log import LogWriter
+from toplingdb_tpu.db.version_edit import VersionEdit
+from toplingdb_tpu.utils.status import InvalidArgument
+
+
+def create_checkpoint(db, dest: str) -> None:
+    env = db.env
+    if env.file_exists(dest):
+        try:
+            if env.get_children(dest):
+                raise InvalidArgument(
+                    f"checkpoint dir {dest} exists and is not empty"
+                )
+        except InvalidArgument:
+            raise
+        except Exception:
+            pass
+    env.create_dir(dest)
+    with db._mutex:
+        db.flush()
+        version = db.versions.current
+        last_seq = db.versions.last_sequence
+        files = [(lvl, f) for lvl, f in version.all_files()]
+        # Hard-link every live SST when the env is the real posix FS; copy
+        # through the Env otherwise (MemEnv / fault injection stay in the
+        # loop).
+        from toplingdb_tpu.env.env import PosixEnv
+
+        for _, f in files:
+            src = filename.table_file_name(db.dbname, f.number)
+            dst = filename.table_file_name(dest, f.number)
+            linked = False
+            if type(env) is PosixEnv:
+                try:
+                    os.link(src, dst)
+                    linked = True
+                except OSError:
+                    pass
+            if not linked:
+                env.write_file(dst, env.read_file(src), sync=True)
+        # Fresh MANIFEST snapshot.
+        manifest_number = 1
+        edit = VersionEdit(
+            comparator=db.icmp.user_comparator.name(),
+            log_number=0,
+            next_file_number=db.versions.next_file_number,
+            last_sequence=last_seq,
+        )
+        for lvl, f in files:
+            edit.add_file(lvl, f)
+        w = LogWriter(db.env.new_writable_file(
+            filename.manifest_file_name(dest, manifest_number)
+        ))
+        w.add_record(edit.encode())
+        w.sync()
+        w.close()
+        filename.set_current_file(db.env, dest, manifest_number)
+        db.env.write_file(
+            filename.identity_file_name(dest), db.identity.encode()
+        )

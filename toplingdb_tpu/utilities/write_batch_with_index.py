@@ -1,0 +1,137 @@
+"""WriteBatchWithIndex: an indexed, uncommitted write buffer.
+
+Reference utilities/write_batch_with_index/ in /root/reference — the
+structure backing transactions: every update is both appended to a WriteBatch
+(for atomic commit) and indexed in a sorted in-memory view so the
+transaction can read its own writes (`get_from_batch_and_db`) and iterate
+batch+DB merged (`iterator_with_base`). The pluggable rep mirrors the
+WBWIFactory hook (write_batch_with_index.h:313 — where the reference's
+20x-faster CSPP_WBWI plugs in).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from toplingdb_tpu.db.dbformat import ValueType
+from toplingdb_tpu.db.write_batch import WriteBatch
+from toplingdb_tpu.options import ReadOptions
+from toplingdb_tpu.utils.status import MergeInProgress
+
+
+class WriteBatchWithIndex:
+    def __init__(self, merge_operator=None):
+        self.batch = WriteBatch()
+        self._merge_op = merge_operator
+        # Sorted index: (user_key, insertion_order) → last write wins at read.
+        self._items: list[tuple[bytes, int, int, bytes | None]] = []
+        # (key, order, type, value); kept sorted by (key, order).
+        self._order = 0
+
+    # -- writes ---------------------------------------------------------
+
+    def _index(self, t: ValueType, key: bytes, value: bytes | None) -> None:
+        self._order += 1
+        entry = (key, self._order, int(t), value)
+        bisect.insort(self._items, entry, key=lambda e: (e[0], e[1]))
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.batch.put(key, value)
+        self._index(ValueType.VALUE, key, value)
+
+    def delete(self, key: bytes) -> None:
+        self.batch.delete(key)
+        self._index(ValueType.DELETION, key, None)
+
+    def single_delete(self, key: bytes) -> None:
+        self.batch.single_delete(key)
+        self._index(ValueType.SINGLE_DELETION, key, None)
+
+    def merge(self, key: bytes, value: bytes) -> None:
+        self.batch.merge(key, value)
+        self._index(ValueType.MERGE, key, value)
+
+    def clear(self) -> None:
+        self.batch.clear()
+        self._items.clear()
+        self._order = 0
+
+    def count(self) -> int:
+        return self.batch.count()
+
+    # -- reads ----------------------------------------------------------
+
+    def _batch_view(self, key: bytes):
+        """Newest-first updates for key in this batch: [(type, value)]."""
+        i = bisect.bisect_left(self._items, (key, 0), key=lambda e: (e[0], e[1]))
+        out = []
+        while i < len(self._items) and self._items[i][0] == key:
+            out.append((self._items[i][2], self._items[i][3]))
+            i += 1
+        out.reverse()  # newest first
+        return out
+
+    def get_from_batch(self, key: bytes):
+        """(found, value_or_None) from the batch alone; found=False means the
+        batch says nothing conclusive (no entry, or an open merge chain)."""
+        operands = []
+        for t, v in self._batch_view(key):
+            if t == int(ValueType.VALUE):
+                if operands:
+                    v = self._fold(key, v, operands)
+                return True, v
+            if t in (int(ValueType.DELETION), int(ValueType.SINGLE_DELETION)):
+                if operands:
+                    return True, self._fold(key, None, operands)
+                return True, None
+            if t == int(ValueType.MERGE):
+                operands.append(v)
+        if operands:
+            return False, operands  # open chain: caller folds with DB value
+        return False, None
+
+    def _fold(self, key, base, operands):
+        if self._merge_op is None:
+            raise MergeInProgress("merge in batch but no merge_operator")
+        return self._merge_op.full_merge(key, base, list(reversed(operands)))
+
+    def get_from_batch_and_db(self, db, key: bytes,
+                              opts: ReadOptions = ReadOptions()):
+        found, v = self.get_from_batch(key)
+        if found:
+            return v
+        if isinstance(v, list):  # open merge chain
+            base = db.get(key, opts)
+            return self._fold(key, base, v)
+        return db.get(key, opts)
+
+    def iterator_with_base(self, db, opts: ReadOptions = ReadOptions()):
+        """Merged forward iteration over batch + DB (newest batch state wins;
+        reference BaseDeltaIterator)."""
+        db_it = db.new_iterator(opts)
+        db_it.seek_to_first()
+        db_pairs = list(db_it.entries())
+        # Batch resolved view per key.
+        batch_keys = sorted({e[0] for e in self._items})
+        merged = []
+        bi = di = 0
+        while bi < len(batch_keys) or di < len(db_pairs):
+            if di >= len(db_pairs) or (
+                bi < len(batch_keys) and batch_keys[bi] <= db_pairs[di][0]
+            ):
+                k = batch_keys[bi]
+                skip_db = di < len(db_pairs) and db_pairs[di][0] == k
+                found, v = self.get_from_batch(k)
+                if found:
+                    if v is not None:
+                        merged.append((k, v))
+                elif isinstance(v, list):
+                    base = db_pairs[di][1] if skip_db else None
+                    merged.append((k, self._fold(k, base, v)))
+                if skip_db:
+                    di += 1
+                bi += 1
+            else:
+                merged.append(db_pairs[di])
+                di += 1
+        return merged

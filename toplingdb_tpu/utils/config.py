@@ -1,0 +1,231 @@
+"""JSON-driven configuration + object registry + HTTP introspection.
+
+The SidePlugin-equivalent layer (reference README.md:8-16 and the in-tree
+ObjectRegistry ancestor, utilities/object_registry.cc in /root/reference):
+
+  ObjectRegistry      (category, name) → factory; objects created from JSON
+                      specs {"class": name, "params": {...}} or plain names.
+  SidePluginRepo      named objects + DBs opened from one JSON document;
+                      embedded HTTP server exposing stats/levels/config
+                      (the WebView analogue).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from toplingdb_tpu.utils.status import InvalidArgument
+
+
+class ObjectRegistry:
+    _global: "ObjectRegistry | None" = None
+
+    def __init__(self):
+        self._factories: dict[tuple[str, str], object] = {}
+
+    @classmethod
+    def default(cls) -> "ObjectRegistry":
+        if cls._global is None:
+            cls._global = cls()
+            _register_builtins(cls._global)
+        return cls._global
+
+    def register(self, category: str, name: str, factory) -> None:
+        self._factories[(category, name)] = factory
+
+    def create(self, category: str, spec):
+        """spec: name string, or {"class": name, "params": {...}}."""
+        if spec is None:
+            return None
+        if isinstance(spec, str):
+            name, params = spec, {}
+        elif isinstance(spec, dict):
+            name = spec.get("class") or spec.get("name")
+            params = spec.get("params", {})
+        else:
+            return spec  # already an object
+        f = self._factories.get((category, name))
+        if f is None:
+            raise InvalidArgument(f"no {category} factory named {name!r}")
+        return f(**params)
+
+    def names(self, category: str) -> list[str]:
+        return sorted(n for c, n in self._factories if c == category)
+
+
+def _register_builtins(reg: ObjectRegistry) -> None:
+    from toplingdb_tpu.db import dbformat
+    from toplingdb_tpu.compaction.executor import (
+        DeviceCompactionExecutorFactory,
+        SubprocessCompactionExecutorFactory,
+    )
+    from toplingdb_tpu.table.filter import BloomFilterPolicy
+    from toplingdb_tpu.utils.compaction_filter import (
+        RemoveEmptyValueCompactionFilter,
+    )
+    from toplingdb_tpu.utils.merge_operator import (
+        MaxOperator, PutOperator, StringAppendOperator, UInt64AddOperator,
+    )
+    from toplingdb_tpu.utils.statistics import Statistics
+
+    reg.register("comparator", "bytewise", lambda: dbformat.BYTEWISE)
+    reg.register("comparator", "reverse_bytewise", lambda: dbformat.REVERSE_BYTEWISE)
+    reg.register("merge_operator", "put", PutOperator)
+    reg.register("merge_operator", "uint64add", UInt64AddOperator)
+    reg.register("merge_operator", "stringappend", StringAppendOperator)
+    reg.register("merge_operator", "max", MaxOperator)
+    reg.register("compaction_filter", "remove_empty_value",
+                 RemoveEmptyValueCompactionFilter)
+    reg.register("filter_policy", "bloom",
+                 lambda bits_per_key=10.0: BloomFilterPolicy(bits_per_key))
+    reg.register("compaction_executor_factory", "device",
+                 DeviceCompactionExecutorFactory)
+    reg.register("compaction_executor_factory", "subprocess",
+                 SubprocessCompactionExecutorFactory)
+    reg.register("statistics", "default", Statistics)
+
+
+def options_from_config(cfg: dict):
+    """Build Options from a JSON-style dict (the SidePlugin config shape)."""
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.table.builder import TableOptions
+
+    reg = ObjectRegistry.default()
+    opts = Options()
+    simple = {
+        "create_if_missing", "error_if_exists", "paranoid_checks",
+        "write_buffer_size", "max_write_buffer_number", "wal_enabled",
+        "num_levels", "level0_file_num_compaction_trigger",
+        "level0_slowdown_writes_trigger", "level0_stop_writes_trigger",
+        "max_bytes_for_level_base", "max_bytes_for_level_multiplier",
+        "target_file_size_base", "target_file_size_multiplier",
+        "max_compaction_bytes", "compaction_style", "max_background_jobs",
+        "max_subcompactions", "disable_auto_compactions",
+        "universal_size_ratio", "universal_min_merge_width",
+        "universal_max_merge_width",
+        "universal_max_size_amplification_percent",
+        "fifo_max_table_files_size",
+    }
+    for k, v in cfg.items():
+        if k in simple:
+            setattr(opts, k, v)
+        elif k == "comparator":
+            opts.comparator = reg.create("comparator", v)
+        elif k == "merge_operator":
+            opts.merge_operator = reg.create("merge_operator", v)
+        elif k == "compaction_filter":
+            opts.compaction_filter = reg.create("compaction_filter", v)
+        elif k == "compaction_executor_factory":
+            opts.compaction_executor_factory = reg.create(
+                "compaction_executor_factory", v
+            )
+        elif k == "statistics":
+            opts.statistics = reg.create("statistics", v)
+        elif k == "table_options":
+            t = TableOptions()
+            for tk, tv in v.items():
+                if tk == "filter_policy":
+                    t.filter_policy = reg.create("filter_policy", tv)
+                else:
+                    setattr(t, tk, tv)
+            opts.table_options = t
+        else:
+            raise InvalidArgument(f"unknown option {k!r}")
+    return opts
+
+
+class SidePluginRepo:
+    """Open DBs from one JSON document; serve introspection over HTTP
+    (reference java SidePluginRepo + rockside WebView)."""
+
+    def __init__(self):
+        self._dbs: dict[str, object] = {}
+        self._configs: dict[str, dict] = {}
+        self._server: ThreadingHTTPServer | None = None
+
+    def open_db(self, config, name: str | None = None):
+        """config: dict or JSON string: {"path": ..., "options": {...}}."""
+        from toplingdb_tpu.db.db import DB
+
+        if isinstance(config, str):
+            config = json.loads(config)
+        path = config["path"]
+        name = name or config.get("name") or path
+        opts = options_from_config(config.get("options", {}))
+        db = DB.open(path, opts)
+        self._dbs[name] = db
+        self._configs[name] = config
+        return db
+
+    def get_db(self, name: str):
+        return self._dbs.get(name)
+
+    def close_all(self) -> None:
+        self.stop_http()
+        for db in self._dbs.values():
+            db.close()
+        self._dbs.clear()
+
+    # -- HTTP introspection --------------------------------------------
+
+    def start_http(self, port: int = 0) -> int:
+        """Serves /dbs, /stats/<name>, /levels/<name>, /config/<name>.
+        Returns the bound port."""
+        repo = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("/") if p]
+                try:
+                    body = repo._route(parts)
+                    code = 200 if body is not None else 404
+                    body = body if body is not None else {"error": "not found"}
+                except Exception as e:  # introspection must not crash
+                    code, body = 500, {"error": repr(e)}
+                data = json.dumps(body, indent=1, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return self._server.server_address[1]
+
+    def stop_http(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    def _route(self, parts: list[str]):
+        if not parts or parts == ["dbs"]:
+            return {"dbs": sorted(self._dbs)}
+        kind, name = parts[0], "/".join(parts[1:])
+        db = self._dbs.get(name)
+        if db is None:
+            return None
+        if kind == "stats":
+            out = {"levelstats": db.get_property("tpulsm.stats")}
+            if db.stats is not None:
+                out["statistics"] = db.stats.to_string().split("\n")
+            return out
+        if kind == "levels":
+            v = db.versions.current
+            return {
+                f"L{lvl}": [
+                    {"file": f.number, "size": f.file_size,
+                     "entries": f.num_entries}
+                    for f in v.files[lvl]
+                ]
+                for lvl in range(v.num_levels) if v.files[lvl]
+            }
+        if kind == "config":
+            return self._configs.get(name)
+        return None

@@ -1,0 +1,100 @@
+"""EventListener callbacks + structured EventLogger.
+
+Reference include/rocksdb/listener.h:565 (EventListener) and
+logging/event_logger.cc (JSON event stream) in /root/reference. Listeners
+also travel to distributed compaction workers in the reference
+(CompactionParams::listeners); ours fire on the DB side after results merge.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FlushJobInfo:
+    db_name: str
+    file_number: int
+    file_size: int
+    num_entries: int
+    smallest_seqno: int
+    largest_seqno: int
+
+
+@dataclass
+class CompactionJobInfo:
+    db_name: str
+    input_level: int
+    output_level: int
+    input_files: list = field(default_factory=list)
+    output_files: list = field(default_factory=list)
+    input_records: int = 0
+    output_records: int = 0
+    elapsed_micros: int = 0
+    device: str = "cpu"
+    reason: str = ""
+
+
+@dataclass
+class IngestionInfo:
+    db_name: str
+    external_file_path: str
+    internal_file_number: int
+    level: int
+
+
+class EventListener:
+    """Override any subset (reference EventListener)."""
+
+    def on_flush_completed(self, db, info: FlushJobInfo) -> None:
+        pass
+
+    def on_compaction_completed(self, db, info: CompactionJobInfo) -> None:
+        pass
+
+    def on_table_file_created(self, db, path: str, file_number: int) -> None:
+        pass
+
+    def on_table_file_deleted(self, db, path: str) -> None:
+        pass
+
+    def on_external_file_ingested(self, db, info: IngestionInfo) -> None:
+        pass
+
+    def on_background_error(self, db, error: BaseException) -> None:
+        pass
+
+
+def notify(listeners, method: str, *args) -> None:
+    for l in listeners or ():
+        try:
+            getattr(l, method)(*args)
+        except Exception:
+            pass  # listener failures must never take down the engine
+
+
+class EventLogger:
+    """Structured JSON event stream (reference logging/event_logger.cc):
+    one JSON object per line, `time_micros` + `event` + payload. Thread-safe:
+    user write/flush threads and background compaction threads share one
+    sink."""
+
+    def __init__(self, sink=None):
+        import threading
+
+        self._sink = sink  # callable(str) or file-like; None = discarded
+        self._mu = threading.Lock()
+
+    def log(self, event: str, **payload) -> str:
+        rec = {"time_micros": int(time.time() * 1e6), "event": event}
+        rec.update(payload)
+        line = json.dumps(rec)
+        if self._sink is not None:
+            with self._mu:
+                if callable(self._sink):
+                    self._sink(line)
+                else:
+                    self._sink.write(line + "\n")
+        return line

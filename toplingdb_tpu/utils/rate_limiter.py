@@ -1,0 +1,160 @@
+"""RateLimiter + WriteController + WriteBufferManager + SstFileManager —
+the flow-control quartet (reference util/rate_limiter.cc,
+db/write_controller.cc, memtable/write_buffer_manager.cc,
+file/sst_file_manager_impl.cc in /root/reference)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class RateLimiter:
+    """Token-bucket byte rate limiter (reference GenericRateLimiter)."""
+
+    def __init__(self, bytes_per_second: int, refill_period_us: int = 100_000):
+        self.rate = bytes_per_second
+        self._period = refill_period_us / 1e6
+        self._available = bytes_per_second * self._period
+        self._last_refill = time.monotonic()
+        self._mu = threading.Lock()
+        self.total_through = 0
+
+    def request(self, n: int) -> None:
+        """Blocks until n bytes of budget are available. Oversized requests
+        are split into period-sized chunks (reference GenericRateLimiter), so
+        a 1MB write against a 100KB/period budget still throttles."""
+        budget = max(1, int(self.rate * self._period))
+        while n > 0:
+            chunk = min(n, budget)
+            self._request_chunk(chunk)
+            n -= chunk
+
+    def _request_chunk(self, n: int) -> None:
+        while True:
+            with self._mu:
+                now = time.monotonic()
+                elapsed = now - self._last_refill
+                if elapsed >= self._period:
+                    self._available = min(
+                        self.rate * self._period,
+                        self._available + self.rate * elapsed,
+                    )
+                    self._last_refill = now
+                if self._available >= n:
+                    self._available -= n
+                    self.total_through += n
+                    return
+            time.sleep(self._period / 4)
+
+
+class WriteController:
+    """Write throttling state: normal / delayed / stopped
+    (reference db/write_controller.h). The DB consults it before each write;
+    compaction pressure sets delays."""
+
+    def __init__(self):
+        self._stopped = False
+        self._delay_bytes_per_sec = 0
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self.total_stall_micros = 0
+
+    def stop_writes(self) -> None:
+        with self._mu:
+            self._stopped = True
+
+    def resume_writes(self) -> None:
+        with self._cv:
+            self._stopped = False
+            self._delay_bytes_per_sec = 0
+            self._cv.notify_all()
+
+    def set_delay(self, bytes_per_sec: int) -> None:
+        with self._mu:
+            self._delay_bytes_per_sec = bytes_per_sec
+
+    def wait_if_stalled(self, write_bytes: int, timeout: float = 10.0) -> None:
+        t0 = time.monotonic()
+        with self._cv:
+            while self._stopped and time.monotonic() - t0 < timeout:
+                self._cv.wait(0.05)
+        if self._delay_bytes_per_sec > 0 and write_bytes > 0:
+            delay = write_bytes / self._delay_bytes_per_sec
+            time.sleep(min(delay, 1.0))
+        stall = time.monotonic() - t0
+        if stall > 0.001:
+            self.total_stall_micros += int(stall * 1e6)
+
+
+class WriteBufferManager:
+    """DB-wide memtable memory budget (reference write_buffer_manager.h:37):
+    when the sum over all DBs exceeds the budget, callers should flush."""
+
+    def __init__(self, buffer_size: int):
+        self.buffer_size = buffer_size
+        self._usage = 0
+        self._mu = threading.Lock()
+
+    def reserve(self, n: int) -> None:
+        with self._mu:
+            self._usage += n
+
+    def free(self, n: int) -> None:
+        with self._mu:
+            self._usage = max(0, self._usage - n)
+
+    def memory_usage(self) -> int:
+        return self._usage
+
+    def should_flush(self) -> bool:
+        return self.buffer_size > 0 and self._usage >= self.buffer_size
+
+
+class SstFileManager:
+    """Tracks SST disk usage; rate-limited trash deletion (reference
+    include/rocksdb/sst_file_manager.h:26, file/delete_scheduler.cc)."""
+
+    def __init__(self, bytes_per_sec_delete: int = 0,
+                 max_trash_db_ratio: float = 0.25):
+        self.rate = bytes_per_sec_delete
+        self._tracked: dict[str, int] = {}
+        self._mu = threading.Lock()
+
+    def on_add_file(self, path: str, size: int | None = None) -> None:
+        with self._mu:
+            if size is None:
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = 0
+            self._tracked[path] = size
+
+    def on_delete_file(self, path: str) -> None:
+        with self._mu:
+            self._tracked.pop(path, None)
+
+    def total_size(self) -> int:
+        with self._mu:
+            return sum(self._tracked.values())
+
+    def schedule_delete(self, path: str) -> None:
+        """Rate-limited deletion: rename to .trash, delete slowly."""
+        size = self._tracked.get(path, 0)
+        trash = path + ".trash"
+        try:
+            os.replace(path, trash)
+        except OSError:
+            return
+        self.on_delete_file(path)
+
+        def worker():
+            if self.rate > 0 and size > 0:
+                time.sleep(min(size / self.rate, 10.0))
+            try:
+                os.remove(trash)
+            except OSError:
+                pass
+
+        threading.Thread(target=worker, daemon=True).start()

@@ -1,0 +1,191 @@
+"""Statistics: tickers + histograms (reference include/rocksdb/statistics.h
+in /root/reference), including the Topling local-vs-distributed compaction
+split (LCOMPACTION_*/DCOMPACTION_*, statistics.h:643-651) that makes the
+BASELINE.json metric directly measurable."""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import defaultdict
+
+# Ticker names (the subset the engine records; extensible by string).
+BLOCK_CACHE_HIT = "block.cache.hit"
+BLOCK_CACHE_MISS = "block.cache.miss"
+BLOOM_USEFUL = "bloom.filter.useful"
+BYTES_WRITTEN = "bytes.written"
+BYTES_READ = "bytes.read"
+NUMBER_KEYS_WRITTEN = "number.keys.written"
+NUMBER_KEYS_READ = "number.keys.read"
+COMPACT_READ_BYTES = "compact.read.bytes"
+COMPACT_WRITE_BYTES = "compact.write.bytes"
+FLUSH_WRITE_BYTES = "flush.write.bytes"
+STALL_MICROS = "stall.micros"
+WAL_SYNCS = "wal.syncs"
+# Topling split: local vs distributed (device/remote) compaction bytes.
+LCOMPACTION_READ_BYTES = "lcompaction.read.bytes"
+LCOMPACTION_WRITE_BYTES = "lcompaction.write.bytes"
+DCOMPACTION_READ_BYTES = "dcompaction.read.bytes"
+DCOMPACTION_WRITE_BYTES = "dcompaction.write.bytes"
+
+# Histogram names.
+DB_GET_MICROS = "db.get.micros"
+DB_WRITE_MICROS = "db.write.micros"
+COMPACTION_TIME_MICROS = "compaction.time.micros"
+LCOMPACTION_TIME_MICROS = "lcompaction.time.micros"
+DCOMPACTION_TIME_MICROS = "dcompaction.time.micros"
+FLUSH_TIME_MICROS = "flush.time.micros"
+SST_READ_MICROS = "sst.read.micros"
+
+
+class Histogram:
+    """Power-of-two bucketed histogram (lock-free-ish: GIL-atomic adds)."""
+
+    __slots__ = ("buckets", "count", "sum", "min", "max")
+
+    def __init__(self):
+        self.buckets = [0] * 64
+        self.count = 0
+        self.sum = 0
+        self.min = math.inf
+        self.max = 0
+
+    def add(self, v: float) -> None:
+        b = max(0, min(63, int(v).bit_length())) if v >= 1 else 0
+        self.buckets[b] += 1
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    @property
+    def average(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        if not self.count:
+            return 0.0
+        target = self.count * p / 100.0
+        acc = 0
+        for b, n in enumerate(self.buckets):
+            acc += n
+            if acc >= target:
+                return float(1 << b)
+        return float(self.max)
+
+    def to_string(self) -> str:
+        return (
+            f"count={self.count} avg={self.average:.1f} "
+            f"p50={self.percentile(50):.0f} p99={self.percentile(99):.0f} "
+            f"max={self.max:.0f}"
+        )
+
+
+class Statistics:
+    def __init__(self):
+        self._tickers: dict[str, int] = defaultdict(int)
+        self._histograms: dict[str, Histogram] = defaultdict(Histogram)
+        self._lock = threading.Lock()
+
+    def record_tick(self, name: str, count: int = 1) -> None:
+        with self._lock:
+            self._tickers[name] += count
+
+    def get_ticker_count(self, name: str) -> int:
+        with self._lock:
+            return self._tickers.get(name, 0)
+
+    def record_in_histogram(self, name: str, value: float) -> None:
+        with self._lock:
+            self._histograms[name].add(value)
+
+    def get_histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self._histograms[name]
+
+    def record_compaction(self, stats) -> None:
+        """Merge a CompactionStats from a finished job; distributed/device
+        jobs go to the D* counters (reference compaction_job.cc:1113-1135
+        stat merge-back)."""
+        local = stats.device == "cpu"
+        if local:
+            self.record_tick(LCOMPACTION_READ_BYTES, stats.input_bytes)
+            self.record_tick(LCOMPACTION_WRITE_BYTES, stats.output_bytes)
+            self.record_in_histogram(LCOMPACTION_TIME_MICROS, stats.work_time_usec)
+        else:
+            self.record_tick(DCOMPACTION_READ_BYTES, stats.input_bytes)
+            self.record_tick(DCOMPACTION_WRITE_BYTES, stats.output_bytes)
+            self.record_in_histogram(DCOMPACTION_TIME_MICROS, stats.work_time_usec)
+        self.record_tick(COMPACT_READ_BYTES, stats.input_bytes)
+        self.record_tick(COMPACT_WRITE_BYTES, stats.output_bytes)
+        self.record_in_histogram(COMPACTION_TIME_MICROS, stats.work_time_usec)
+
+    def to_string(self) -> str:
+        lines = []
+        for k in sorted(self._tickers):
+            lines.append(f"{k} COUNT : {self._tickers[k]}")
+        for k in sorted(self._histograms):
+            lines.append(f"{k} : {self._histograms[k].to_string()}")
+        return "\n".join(lines)
+
+
+class PerfContext:
+    """Per-thread perf counters (reference include/rocksdb/perf_context.h).
+    Access via perf_context() — a thread-local instance."""
+
+    _FIELDS = (
+        "user_key_comparison_count", "block_read_count", "block_read_byte",
+        "block_cache_hit_count", "bloom_memtable_hit_count",
+        "bloom_sst_hit_count", "bloom_sst_miss_count",
+        "get_from_memtable_count", "seek_on_memtable_count",
+        "next_on_memtable_count", "write_wal_time", "write_memtable_time",
+        "get_snapshot_time", "get_from_output_files_time",
+    )
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        for f in self._FIELDS:
+            setattr(self, f, 0)
+
+    def to_dict(self) -> dict:
+        return {f: getattr(self, f) for f in self._FIELDS}
+
+
+_perf_tls = threading.local()
+
+
+def perf_context() -> PerfContext:
+    ctx = getattr(_perf_tls, "ctx", None)
+    if ctx is None:
+        ctx = PerfContext()
+        _perf_tls.ctx = ctx
+    return ctx
+
+
+class IOStatsContext:
+    """Per-thread IO counters (reference include/rocksdb/iostats_context.h)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.bytes_written = 0
+        self.bytes_read = 0
+        self.write_nanos = 0
+        self.read_nanos = 0
+        self.fsync_nanos = 0
+
+
+_iostats_tls = threading.local()
+
+
+def iostats_context() -> IOStatsContext:
+    ctx = getattr(_iostats_tls, "ctx", None)
+    if ctx is None:
+        ctx = IOStatsContext()
+        _iostats_tls.ctx = ctx
+    return ctx

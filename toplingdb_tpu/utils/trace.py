@@ -1,0 +1,128 @@
+"""Workload tracing + replay (reference trace_replay/trace_replay.cc,
+include/rocksdb/utilities/replayer.h, tools/trace_analyzer_tool.cc in
+/root/reference): record Get/Put/Delete/Merge/DeleteRange/Iterator ops with
+timestamps to a log-framed file; replay them against any DB; analyze
+per-type/key statistics."""
+
+from __future__ import annotations
+
+import time
+
+from toplingdb_tpu.db.log import LogReader, LogWriter
+from toplingdb_tpu.utils import coding
+
+OP_GET = 1
+OP_PUT = 2
+OP_DELETE = 3
+OP_MERGE = 4
+OP_DELETE_RANGE = 5
+OP_ITER_SEEK = 6
+OP_WRITE_BATCH = 7
+
+_OP_NAMES = {
+    OP_GET: "get", OP_PUT: "put", OP_DELETE: "delete", OP_MERGE: "merge",
+    OP_DELETE_RANGE: "delete_range", OP_ITER_SEEK: "iter_seek",
+    OP_WRITE_BATCH: "write_batch",
+}
+
+
+class Tracer:
+    """Wraps a DB; every operation is both executed and recorded."""
+
+    def __init__(self, db, trace_path: str):
+        self._db = db
+        self._w = LogWriter(db.env.new_writable_file(trace_path))
+
+    def _rec(self, op: int, *slices: bytes) -> None:
+        out = bytearray()
+        out += coding.encode_varint32(op)
+        out += coding.encode_varint64(int(time.time() * 1e6))
+        for s in slices:
+            coding.put_length_prefixed_slice(out, s)
+        self._w.add_record(bytes(out))
+
+    def get(self, key, opts=None):
+        self._rec(OP_GET, key)
+        return self._db.get(key) if opts is None else self._db.get(key, opts)
+
+    def put(self, key, value, opts=None):
+        self._rec(OP_PUT, key, value)
+        return self._db.put(key, value) if opts is None else self._db.put(key, value, opts)
+
+    def delete(self, key, opts=None):
+        self._rec(OP_DELETE, key)
+        return self._db.delete(key)
+
+    def merge(self, key, value, opts=None):
+        self._rec(OP_MERGE, key, value)
+        return self._db.merge(key, value)
+
+    def delete_range(self, begin, end, opts=None):
+        self._rec(OP_DELETE_RANGE, begin, end)
+        return self._db.delete_range(begin, end)
+
+    def close(self) -> None:
+        self._w.sync()
+        self._w.close()
+
+
+def read_trace(env, trace_path: str):
+    """Yields (op, time_micros, [slices])."""
+    for rec in LogReader(env.new_sequential_file(trace_path)).records():
+        op, off = coding.decode_varint32(rec, 0)
+        ts, off = coding.decode_varint64(rec, off)
+        slices = []
+        while off < len(rec):
+            s, off = coding.get_length_prefixed_slice(rec, off)
+            slices.append(s)
+        yield op, ts, slices
+
+
+class Replayer:
+    """Replay a trace against a DB (reference Replayer)."""
+
+    def __init__(self, db, trace_path: str):
+        self._db = db
+        self._path = trace_path
+
+    def replay(self, fast_forward: bool = True) -> int:
+        n = 0
+        prev_ts = None
+        for op, ts, slices in read_trace(self._db.env, self._path):
+            if not fast_forward and prev_ts is not None:
+                time.sleep(max(0, (ts - prev_ts) / 1e6))
+            prev_ts = ts
+            if op == OP_GET:
+                self._db.get(slices[0])
+            elif op == OP_PUT:
+                self._db.put(slices[0], slices[1])
+            elif op == OP_DELETE:
+                self._db.delete(slices[0])
+            elif op == OP_MERGE:
+                self._db.merge(slices[0], slices[1])
+            elif op == OP_DELETE_RANGE:
+                self._db.delete_range(slices[0], slices[1])
+            n += 1
+        return n
+
+
+def analyze_trace(env, trace_path: str) -> dict:
+    """Per-op-type counts + hottest keys (reference trace_analyzer)."""
+    from collections import Counter
+
+    ops = Counter()
+    keys = Counter()
+    total = 0
+    for op, ts, slices in read_trace(env, trace_path):
+        ops[_OP_NAMES.get(op, str(op))] += 1
+        if slices:
+            keys[bytes(slices[0])] += 1
+        total += 1
+    return {
+        "total_ops": total,
+        "per_op": dict(ops),
+        "hottest_keys": [
+            {"key": k.decode(errors="replace"), "count": c}
+            for k, c in keys.most_common(10)
+        ],
+    }
