@@ -1,0 +1,177 @@
+"""Column families: isolation, atomic cross-CF batches, recovery, drop,
+compaction per CF (reference column_family_test.cc shape)."""
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.db.write_batch import WriteBatch
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.utils.status import Status
+
+
+def opts(**kw):
+    kw.setdefault("write_buffer_size", 8 * 1024)
+    return Options(**kw)
+
+
+def test_cf_isolation(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        users = db.create_column_family("users")
+        posts = db.create_column_family("posts")
+        db.put(b"k", b"default-v")
+        db.put(b"k", b"users-v", cf=users)
+        db.put(b"k", b"posts-v", cf=posts)
+        assert db.get(b"k") == b"default-v"
+        assert db.get(b"k", cf=users) == b"users-v"
+        assert db.get(b"k", cf=posts) == b"posts-v"
+        db.delete(b"k", cf=users)
+        assert db.get(b"k", cf=users) is None
+        assert db.get(b"k") == b"default-v"
+
+
+def test_cf_atomic_batch(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        a = db.create_column_family("a")
+        b = WriteBatch()
+        b.put(b"x", b"1")
+        b.put(b"y", b"2", cf=a.id)
+        b.delete(b"x", cf=a.id)
+        db.write(b)
+        assert db.get(b"x") == b"1"
+        assert db.get(b"y", cf=a) == b"2"
+        assert db.get(b"x", cf=a) is None
+
+
+def test_cf_survive_reopen_with_flush_and_wal(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        logs = db.create_column_family("logs")
+        for i in range(500):
+            db.put(b"k%04d" % i, b"d%04d" % i)
+            db.put(b"k%04d" % i, b"l%04d" % i, cf=logs)
+        db.flush()
+        db.put(b"wal-only", b"dv")
+        db.put(b"wal-only", b"lv", cf=logs)
+        # No clean close: simulate crash.
+        db._wal.sync()
+        db._closed = True
+    with DB.open(tmp_db_path, opts()) as db:
+        logs = db.get_column_family("logs")
+        assert logs is not None
+        assert db.get(b"k0100") == b"d0100"
+        assert db.get(b"k0100", cf=logs) == b"l0100"
+        assert db.get(b"wal-only") == b"dv"
+        assert db.get(b"wal-only", cf=logs) == b"lv"
+
+
+def test_cf_iterators_are_per_cf(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        aux = db.create_column_family("aux")
+        db.put(b"d1", b"1")
+        db.put(b"a1", b"2", cf=aux)
+        it = db.new_iterator()
+        it.seek_to_first()
+        assert [k for k, _ in it.entries()] == [b"d1"]
+        it = db.new_iterator(cf=aux)
+        it.seek_to_first()
+        assert [k for k, _ in it.entries()] == [b"a1"]
+
+
+def test_cf_compaction_independent(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        big = db.create_column_family("big")
+        for i in range(4000):
+            db.put(b"key%05d" % (i % 1000), b"v%07d" % i, cf=big)
+        db.put(b"small", b"1")
+        db.flush()
+        db.compact_range()
+        db.wait_for_compactions()
+        assert db.get(b"small") == b"1"
+        for k in range(0, 1000, 83):
+            last = max(i for i in range(k, 4000, 1000))
+            assert db.get(b"key%05d" % k, cf=big) == b"v%07d" % last
+        vbig = db.versions.cf_current(big.id)
+        assert sum(f.num_entries for _, f in vbig.all_files()) == 1000
+
+
+def test_cf_drop(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        tmp = db.create_column_family("tmp")
+        db.put(b"k", b"v", cf=tmp)
+        db.flush()
+        db.drop_column_family(tmp)
+        with pytest.raises(Status):
+            db.get(b"k", cf=tmp)
+    with DB.open(tmp_db_path, opts()) as db:
+        assert db.get_column_family("tmp") is None
+
+
+def test_cf_name_reuse_after_drop(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        c1 = db.create_column_family("c")
+        db.put(b"k", b"old", cf=c1)
+        db.flush()
+        db.drop_column_family(c1)
+        c2 = db.create_column_family("c")
+        assert c2.id != c1.id
+        assert db.get(b"k", cf=c2) is None  # fresh keyspace
+    with DB.open(tmp_db_path, opts()) as db:
+        c = db.get_column_family("c")
+        assert db.get(b"k", cf=c) is None
+
+
+def test_checkpoint_includes_all_cfs(tmp_db_path, tmp_path):
+    """Review regression: checkpoint must snapshot every CF."""
+    from toplingdb_tpu.utilities.checkpoint import create_checkpoint
+
+    dst = str(tmp_path / "ckpt")
+    with DB.open(tmp_db_path, opts()) as db:
+        aux = db.create_column_family("aux")
+        db.put(b"d", b"1")
+        db.put(b"a", b"2", cf=aux)
+        create_checkpoint(db, dst)
+    with DB.open(dst, opts()) as db2:
+        aux2 = db2.get_column_family("aux")
+        assert aux2 is not None
+        assert db2.get(b"d") == b"1"
+        assert db2.get(b"a", cf=aux2) == b"2"
+
+
+def test_readonly_db_respects_cfs(tmp_db_path):
+    """Review regression: RO WAL replay must not bleed CFs together."""
+    from toplingdb_tpu.db.db_readonly import ReadOnlyDB
+
+    with DB.open(tmp_db_path, opts()) as db:
+        aux = db.create_column_family("aux")
+        db.put(b"k", b"default-v")
+        db.put(b"k", b"aux-v", cf=aux)
+    ro = ReadOnlyDB.open(tmp_db_path)
+    assert ro.get(b"k") == b"default-v"
+    aux_ro = ro.get_column_family("aux")
+    assert ro.get(b"k", cf=aux_ro) == b"aux-v"
+    ro.close()
+
+
+def test_drop_cf_with_inflight_compaction_edit(tmp_db_path):
+    """Review regression: a version edit for a dropped CF is discarded, not a
+    KeyError."""
+    from toplingdb_tpu.db.version_edit import VersionEdit
+
+    with DB.open(tmp_db_path, opts()) as db:
+        aux = db.create_column_family("aux")
+        db.put(b"x", b"1", cf=aux)
+        db.flush()
+        db.drop_column_family(aux)
+        # Simulate the in-flight job's install after the drop.
+        db.versions.log_and_apply(VersionEdit(column_family=aux.id))
+        db.put(b"ok", b"1")
+        assert db.get(b"ok") == b"1"
+
+
+def test_double_drop_raises_cleanly(tmp_db_path):
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    with DB.open(tmp_db_path, opts()) as db:
+        aux = db.create_column_family("aux")
+        db.drop_column_family(aux)
+        with pytest.raises(InvalidArgument):
+            db.versions.drop_column_family(aux.id)

@@ -205,7 +205,7 @@ def run_compaction_to_tables(
 
 
 def make_version_edit(compaction: Compaction, outputs: list[FileMetaData]) -> VersionEdit:
-    edit = VersionEdit()
+    edit = VersionEdit(column_family=compaction.cf_id)
     for level, f in compaction.all_inputs():
         edit.delete_file(level, f.number)
     for meta in outputs:

@@ -29,6 +29,7 @@ class Compaction:
     bottommost: bool = False
     reason: str = ""
     max_output_file_size: int = 8 * 1024 * 1024
+    cf_id: int = 0
 
     def all_inputs(self) -> list[tuple[int, FileMetaData]]:
         return [(self.level, f) for f in self.inputs] + [

@@ -98,8 +98,23 @@ class CompactionScheduler:
     def _run_one(self) -> bool:
         db = self.db
         with db._mutex:
-            version = db.versions.current
-            c = self.picker.pick_compaction(version)
+            # Visit CFs by descending top compaction score — fixed id order
+            # would starve later CFs under sustained load on an earlier one.
+            scored = []
+            for cf_id in db.versions.column_families:
+                version = db.versions.cf_current(cf_id)
+                scores = self.picker.compaction_score(version)
+                top = scores[0][0] if scores else 0.0
+                scored.append((top, cf_id, version))
+            scored.sort(key=lambda s: -s[0])
+            c = None
+            for top, cf_id, version in scored:
+                if top < 1.0:
+                    break
+                c = self.picker.pick_compaction(version)
+                if c is not None:
+                    c.cf_id = cf_id
+                    break
             if c is None:
                 return False
             for _, f in c.all_inputs():
@@ -210,14 +225,21 @@ class CompactionScheduler:
         self.maybe_schedule()
 
     def _compact_range_impl(self, begin: bytes | None, end: bytes | None) -> None:
+        for cf_id in sorted(self.db.versions.column_families):
+            self._compact_range_cf(begin, end, cf_id)
+
+    def _compact_range_cf(self, begin: bytes | None, end: bytes | None,
+                          cf_id: int) -> None:
         db = self.db
-        version = db.versions.current
+        if cf_id not in db.versions.column_families:
+            return  # dropped concurrently
+        version = db.versions.cf_current(cf_id)
         if db.options.compaction_style == "universal":
-            self._manual_universal()
+            self._manual_universal(cf_id)
             return
         for level in range(0, version.num_levels - 1):
             with db._mutex:
-                version = db.versions.current
+                version = db.versions.cf_current(cf_id)
                 if level == 0:
                     inputs = list(version.files[0])
                 else:
@@ -239,6 +261,7 @@ class CompactionScheduler:
                     ),
                     reason="manual",
                     max_output_file_size=db.options.target_file_size(level + 1),
+                    cf_id=cf_id,
                 )
                 for _, f in c.all_inputs():
                     f.being_compacted = True
@@ -249,10 +272,10 @@ class CompactionScheduler:
                     for _, f in c.all_inputs():
                         f.being_compacted = False
 
-    def _manual_universal(self) -> None:
+    def _manual_universal(self, cf_id: int = 0) -> None:
         db = self.db
         with db._mutex:
-            version = db.versions.current
+            version = db.versions.cf_current(cf_id)
             runs = list(version.files[0])
             last = version.num_levels - 1
             base = list(version.files[last])
@@ -262,6 +285,7 @@ class CompactionScheduler:
                 level=0, output_level=last, inputs=runs,
                 output_level_inputs=base, bottommost=True,
                 reason="manual universal", max_output_file_size=2**62,
+                cf_id=cf_id,
             )
             for _, f in c.all_inputs():
                 f.being_compacted = True

@@ -36,8 +36,33 @@ _DEFAULT_READ = ReadOptions()
 _DEFAULT_WRITE = WriteOptions()
 
 
+class ColumnFamilyHandle:
+    """Opaque per-CF handle (reference include/rocksdb/db.h
+    ColumnFamilyHandle)."""
+
+    __slots__ = ("id", "name")
+
+    def __init__(self, cf_id: int, name: str):
+        self.id = cf_id
+        self.name = name
+
+    def __repr__(self):
+        return f"ColumnFamilyHandle({self.id}, {self.name!r})"
+
+
+class _CFData:
+    """Mutable per-CF state (the reference's ColumnFamilyData memtable side)."""
+
+    __slots__ = ("handle", "mem", "imm")
+
+    def __init__(self, handle: ColumnFamilyHandle, icmp):
+        self.handle = handle
+        self.mem = MemTable(icmp)
+        self.imm: list[MemTable] = []
+
+
 class DB:
-    """Single-column-family LSM engine instance. Use DB.open()."""
+    """LSM engine instance (multi column family). Use DB.open()."""
 
     def __init__(self, dbname: str, options: Options, env: Env):
         self.dbname = dbname
@@ -46,8 +71,10 @@ class DB:
         self.icmp = InternalKeyComparator(options.comparator)
         self.versions = VersionSet(env, dbname, self.icmp, options.num_levels)
         self.table_cache = TableCache(env, dbname, self.icmp, options.table_options)
-        self.mem = MemTable(self.icmp)
-        self.imm: list[MemTable] = []  # immutable memtables, newest first
+        self.default_cf = ColumnFamilyHandle(0, "default")
+        self._cfs: dict[int, _CFData] = {
+            0: _CFData(self.default_cf, self.icmp)
+        }
         self.snapshots = SnapshotList()
         self._mutex = threading.RLock()
         self._wal: LogWriter | None = None
@@ -76,6 +103,62 @@ class DB:
             (lambda line: self._log_file.append(line.encode() + b"\n"))
             if self._log_file is not None else None
         )
+
+    # -- default-CF views (most callers are single-CF) ------------------
+
+    @property
+    def mem(self) -> MemTable:
+        return self._cfs[0].mem
+
+    @mem.setter
+    def mem(self, m: MemTable) -> None:
+        self._cfs[0].mem = m
+
+    @property
+    def imm(self) -> list:
+        return self._cfs[0].imm
+
+    @imm.setter
+    def imm(self, v: list) -> None:
+        self._cfs[0].imm = v
+
+    def _cf_id(self, cf) -> int:
+        if cf is None:
+            return 0
+        if isinstance(cf, ColumnFamilyHandle):
+            return cf.id
+        return int(cf)
+
+    def _cf_data(self, cf) -> _CFData:
+        cfd = self._cfs.get(self._cf_id(cf))
+        if cfd is None:
+            raise InvalidArgument(f"unknown column family {cf!r}")
+        return cfd
+
+    # -- column family management ---------------------------------------
+
+    def create_column_family(self, name: str) -> ColumnFamilyHandle:
+        with self._mutex:
+            cf_id = self.versions.create_column_family(name)
+            h = ColumnFamilyHandle(cf_id, name)
+            self._cfs[cf_id] = _CFData(h, self.icmp)
+            return h
+
+    def drop_column_family(self, handle: ColumnFamilyHandle) -> None:
+        with self._mutex:
+            self.versions.drop_column_family(handle.id)
+            self._cfs.pop(handle.id, None)
+            self._delete_obsolete_files()
+
+    def list_column_families(self) -> list[ColumnFamilyHandle]:
+        with self._mutex:
+            return [cfd.handle for cfd in self._cfs.values()]
+
+    def get_column_family(self, name: str) -> ColumnFamilyHandle | None:
+        for cfd in self._cfs.values():
+            if cfd.handle.name == name:
+                return cfd.handle
+        return None
 
     # ==================================================================
     # Open / close
@@ -115,6 +198,7 @@ class DB:
 
     def _recover(self) -> None:
         self.versions.recover()
+        self._materialize_cfs()
         # Replay WALs >= versions.log_number in file-number order
         # (reference DBImpl::Recover → RecoverLogFiles).
         wal_numbers = []
@@ -126,18 +210,34 @@ class DB:
                          filename.FileType.MANIFEST):
                 self.versions.mark_file_number_used(num)
         max_seq = self.versions.last_sequence
+        mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
         for num in sorted(wal_numbers):
             path = filename.log_file_name(self.dbname, num)
             reader = LogReader(self.env.new_sequential_file(path))
             for rec in reader.records():
                 batch = WriteBatch(rec)
-                batch.insert_into(self.mem)
+                batch.insert_into(mems)
                 end_seq = batch.sequence() + batch.count() - 1
                 max_seq = max(max_seq, end_seq)
         self.versions.last_sequence = max_seq
-        if not self.mem.empty():
-            self._flush_memtables([self.mem], wal_number=self.versions.next_file_number)
-            self.mem = self._fresh_memtable()
+        any_flushed = False
+        for cf_id, cfd in self._cfs.items():
+            if not cfd.mem.empty():
+                self._flush_memtables([cfd.mem], wal_number=None, cf_id=cf_id)
+                cfd.mem = self._fresh_memtable()
+                any_flushed = True
+        if any_flushed:
+            # Single atomic log_number advance once every CF is durable.
+            self.versions.log_and_apply(
+                VersionEdit(log_number=self.versions.next_file_number)
+            )
+
+    def _materialize_cfs(self) -> None:
+        """Build per-CF memtable state from the recovered VersionSet."""
+        for cf_id, st in self.versions.column_families.items():
+            if cf_id not in self._cfs:
+                h = ColumnFamilyHandle(cf_id, st.name)
+                self._cfs[cf_id] = _CFData(h, self.icmp)
 
     def _fresh_memtable(self) -> MemTable:
         m = MemTable(self.icmp)
@@ -158,7 +258,7 @@ class DB:
         with self._mutex:
             if self._closed:
                 return
-            if not self.mem.empty() or self.imm:
+            if any(not c.mem.empty() or c.imm for c in self._cfs.values()):
                 self.flush(FlushOptions())
             if self._wal is not None:
                 self._wal.sync()
@@ -179,30 +279,34 @@ class DB:
     # Write path
     # ==================================================================
 
-    def put(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+    def put(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE,
+            cf=None) -> None:
         b = WriteBatch()
-        b.put(key, value)
+        b.put(key, value, cf=self._cf_id(cf))
         self.write(b, opts)
 
-    def delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+    def delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
+               cf=None) -> None:
         b = WriteBatch()
-        b.delete(key)
+        b.delete(key, cf=self._cf_id(cf))
         self.write(b, opts)
 
-    def single_delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+    def single_delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
+                      cf=None) -> None:
         b = WriteBatch()
-        b.single_delete(key)
+        b.single_delete(key, cf=self._cf_id(cf))
         self.write(b, opts)
 
-    def merge(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+    def merge(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE,
+              cf=None) -> None:
         b = WriteBatch()
-        b.merge(key, value)
+        b.merge(key, value, cf=self._cf_id(cf))
         self.write(b, opts)
 
     def delete_range(self, begin: bytes, end: bytes,
-                     opts: WriteOptions = _DEFAULT_WRITE) -> None:
+                     opts: WriteOptions = _DEFAULT_WRITE, cf=None) -> None:
         b = WriteBatch()
-        b.delete_range(begin, end)
+        b.delete_range(begin, end, cf=self._cf_id(cf))
         self.write(b, opts)
 
     def write(self, batch: WriteBatch, opts: WriteOptions = _DEFAULT_WRITE) -> None:
@@ -225,44 +329,59 @@ class DB:
                     self._wal.sync()
                 else:
                     self._wal.flush()
-            batch.insert_into(self.mem)
+            batch.insert_into({cf_id: cfd.mem for cf_id, cfd in self._cfs.items()})
             self.versions.last_sequence = seq + batch.count() - 1
             if self.stats is not None:
                 from toplingdb_tpu.utils import statistics as st
 
                 self.stats.record_tick(st.NUMBER_KEYS_WRITTEN, batch.count())
                 self.stats.record_tick(st.BYTES_WRITTEN, batch.data_size())
-            if self.mem.approximate_memory_usage() >= self.options.write_buffer_size:
+            total_mem = sum(
+                c.mem.approximate_memory_usage() for c in self._cfs.values()
+            )
+            if total_mem >= self.options.write_buffer_size:
                 self._switch_memtable()
                 self._flush_immutables()
 
     def _switch_memtable(self) -> None:
-        """Seal the active memtable and start a new WAL (reference
-        DBImpl::SwitchMemtable)."""
+        """Seal every CF's non-empty active memtable and start a new WAL
+        (reference DBImpl::SwitchMemtable; all-CF switching = atomic-flush
+        behavior so log_number can advance safely)."""
         if self._wal is not None:
             self._wal.sync()
             self._wal.close()
-        self.imm.insert(0, self.mem)
-        self.mem = self._fresh_memtable()
+        for cfd in self._cfs.values():
+            if not cfd.mem.empty():
+                cfd.imm.insert(0, cfd.mem)
+                cfd.mem = self._fresh_memtable()
         self._new_wal()
 
     def _flush_immutables(self) -> None:
-        if not self.imm:
-            return
-        mems = list(self.imm)
-        self._flush_memtables(mems, wal_number=self._wal_number)
-        self.imm = []
-        self._delete_obsolete_files()
-        self._maybe_schedule_compaction()
+        flushed = False
+        for cf_id, cfd in self._cfs.items():
+            if not cfd.imm:
+                continue
+            mems = list(cfd.imm)
+            self._flush_memtables(mems, wal_number=None, cf_id=cf_id)
+            cfd.imm = []
+            flushed = True
+        if flushed:
+            # Advance log_number only after EVERY CF's data below the current
+            # WAL is durable in SSTs — a crash mid-flush must still replay
+            # the old WALs for the unflushed CFs.
+            self.versions.log_and_apply(VersionEdit(log_number=self._wal_number))
+            self._delete_obsolete_files()
+            self._maybe_schedule_compaction()
 
-    def _flush_memtables(self, mems: list[MemTable], wal_number: int) -> None:
+    def _flush_memtables(self, mems: list[MemTable], wal_number: int | None,
+                         cf_id: int = 0) -> None:
         t0 = time.time()
         fnum = self.versions.new_file_number()
         meta = flush_memtable_to_table(
             self.env, self.dbname, fnum, self.icmp, mems,
             self.options.table_options, creation_time=int(time.time()),
         )
-        edit = VersionEdit(log_number=wal_number)
+        edit = VersionEdit(log_number=wal_number, column_family=cf_id)
         if meta is not None:
             edit.add_file(0, meta)
         self.versions.log_and_apply(edit)
@@ -290,7 +409,7 @@ class DB:
     def flush(self, fopts: FlushOptions = FlushOptions()) -> None:
         with self._mutex:
             self._check_open()
-            if not self.mem.empty():
+            if any(not c.mem.empty() for c in self._cfs.values()):
                 self._switch_memtable()
             self._flush_immutables()
 
@@ -298,23 +417,25 @@ class DB:
     # Read path
     # ==================================================================
 
-    def get(self, key: bytes, opts: ReadOptions = _DEFAULT_READ) -> bytes | None:
+    def get(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
+            cf=None) -> bytes | None:
         """Point lookup (reference DBImpl::GetImpl, db_impl.cc:2079).
         Returns None if not found."""
         self._check_open()
+        cfd = self._cf_data(cf)
         snap_seq = (
             opts.snapshot.sequence if opts.snapshot is not None
             else self.versions.last_sequence
         )
         ctx = GetContext(key, snap_seq, self.options.merge_operator)
         # 1. Active memtable, then immutables (newest first).
-        for mem in [self.mem] + self.imm:
+        for mem in [cfd.mem] + cfd.imm:
             ctx.add_tombstone_seq(mem.covering_tombstone_seq(key, snap_seq))
             for seq, t, val in mem.entries_for_key(key, snap_seq):
                 if not ctx.save_value(seq, t, val):
                     return ctx.result()
         # 2. SST files, newest data first.
-        version = self.versions.current
+        version = self.versions.cf_current(cfd.handle.id)
         for level, f in version.files_for_get(key):
             reader = self.table_cache.get_reader(f.number)
             for begin_ikey, end_uk in reader.range_del_entries():
@@ -349,19 +470,20 @@ class DB:
     # Iterators & snapshots
     # ==================================================================
 
-    def new_iterator(self, opts: ReadOptions = _DEFAULT_READ) -> DBIter:
+    def new_iterator(self, opts: ReadOptions = _DEFAULT_READ, cf=None) -> DBIter:
         """MVCC iterator over the whole keyspace (reference
         DBImpl::NewIterator → DBIter over a MergingIterator)."""
         self._check_open()
+        cfd = self._cf_data(cf)
         with self._mutex:
             snap_seq = (
                 opts.snapshot.sequence if opts.snapshot is not None
                 else self.versions.last_sequence
             )
-            version = self.versions.current
+            version = self.versions.cf_current(cfd.handle.id)
             children = []
             rd = RangeDelAggregator(self.icmp.user_comparator)
-            for mem in [self.mem] + self.imm:
+            for mem in [cfd.mem] + cfd.imm:
                 children.append(mem.new_iterator())
                 for seq, begin, end in mem.range_del_entries():
                     rd.add(RangeTombstone(seq, begin, end))

@@ -34,20 +34,25 @@ class ReadOnlyDB(DB):
         return db
 
     def _replay_wals_into_mem(self) -> None:
-        for child in self.env.get_children(self.dbname):
-            ftype, num = filename.parse_file_name(child)
-            if ftype == filename.FileType.WAL and num >= self.versions.log_number:
-                try:
-                    reader = LogReader(self.env.new_sequential_file(
-                        filename.log_file_name(self.dbname, num)))
-                    for rec in reader.records():
-                        batch = WriteBatch(rec)
-                        batch.insert_into(self.mem)
-                        end = batch.sequence() + batch.count() - 1
-                        if end > self.versions.last_sequence:
-                            self.versions.last_sequence = end
-                except Exception:
-                    pass  # primary may be appending; read what's durable
+        self._materialize_cfs()
+        mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
+        wal_numbers = sorted(
+            num for child in self.env.get_children(self.dbname)
+            for ftype, num in [filename.parse_file_name(child)]
+            if ftype == filename.FileType.WAL and num >= self.versions.log_number
+        )
+        for num in wal_numbers:
+            try:
+                reader = LogReader(self.env.new_sequential_file(
+                    filename.log_file_name(self.dbname, num)))
+                for rec in reader.records():
+                    batch = WriteBatch(rec)
+                    batch.insert_into(mems)
+                    end = batch.sequence() + batch.count() - 1
+                    if end > self.versions.last_sequence:
+                        self.versions.last_sequence = end
+            except Exception:
+                pass  # primary may be appending; read what's durable
 
     def write(self, batch, opts=None) -> None:
         raise NotSupported("DB is open read-only")

@@ -154,6 +154,19 @@ class _SmallestKey:
         return self.icmp.compare(self.k, other.k) < 0
 
 
+class ColumnFamilyState:
+    """Per-CF metadata inside the VersionSet (the reference's
+    ColumnFamilyData, db/column_family.h)."""
+
+    __slots__ = ("cf_id", "name", "current", "dropped")
+
+    def __init__(self, cf_id: int, name: str, current: Version):
+        self.cf_id = cf_id
+        self.name = name
+        self.current = current
+        self.dropped = False
+
+
 class VersionSet:
     def __init__(self, env, dbname: str, icmp: InternalKeyComparator,
                  num_levels: int = 7):
@@ -167,8 +180,12 @@ class VersionSet:
         # obsolete-file deletion must respect files visible to ANY live
         # Version, not just `current`.
         self._all_versions: "weakref.WeakSet[Version]" = weakref.WeakSet()
-        self.current: Version = Version(icmp, num_levels)
-        self._all_versions.add(self.current)
+        v0 = Version(icmp, num_levels)
+        self._all_versions.add(v0)
+        self.column_families: dict[int, ColumnFamilyState] = {
+            0: ColumnFamilyState(0, "default", v0)
+        }
+        self.max_column_family = 0
         self.last_sequence = 0
         self.log_number = 0          # WALs with number < this are obsolete
         self.prev_log_number = 0
@@ -176,6 +193,19 @@ class VersionSet:
         self._next_file_number = 2
         self._manifest_writer: LogWriter | None = None
         self._lock = threading.Lock()
+
+    # The default CF's Version — the single-CF view used everywhere the CF
+    # doesn't matter.
+    @property
+    def current(self) -> Version:
+        return self.column_families[0].current
+
+    @current.setter
+    def current(self, v: Version) -> None:
+        self.column_families[0].current = v
+
+    def cf_current(self, cf_id: int) -> Version:
+        return self.column_families[cf_id].current
 
     # -- number allocation ---------------------------------------------
 
@@ -204,6 +234,8 @@ class VersionSet:
             log_number=0,
             next_file_number=self._next_file_number,
             last_sequence=0,
+            column_family_add="default",
+            max_column_family=0,
         )
         path = filename.manifest_file_name(self.dbname, self.manifest_file_number)
         w = self.env.new_writable_file(path)
@@ -224,10 +256,23 @@ class VersionSet:
         self.manifest_file_number = int(name[len("MANIFEST-"):])
         path = filename.manifest_file_name(self.dbname, self.manifest_file_number)
         reader = LogReader(self.env.new_sequential_file(path))
-        builder = VersionBuilder(Version(self.icmp, self.num_levels))
+        builders: dict[int, VersionBuilder] = {}
+        cf_names: dict[int, str] = {}
+        dropped: set[int] = set()
         have_comparator = None
+        next_cf_hint = 0
         for rec in reader.records():
             edit = VersionEdit.decode(rec)
+            cf = edit.column_family
+            if edit.column_family_add is not None:
+                cf_names[cf] = edit.column_family_add
+                builders.setdefault(
+                    cf, VersionBuilder(Version(self.icmp, self.num_levels))
+                )
+            if edit.column_family_drop:
+                dropped.add(cf)
+            if edit.max_column_family is not None:
+                next_cf_hint = max(next_cf_hint, edit.max_column_family)
             if edit.comparator is not None:
                 have_comparator = edit.comparator
             if edit.log_number is not None:
@@ -238,14 +283,29 @@ class VersionSet:
                 self._next_file_number = edit.next_file_number
             if edit.last_sequence is not None:
                 self.last_sequence = edit.last_sequence
-            builder.apply(edit)
+            if edit.new_files or edit.deleted_files:
+                builders.setdefault(
+                    cf, VersionBuilder(Version(self.icmp, self.num_levels))
+                ).apply(edit)
         if have_comparator is not None and have_comparator != self.icmp.user_comparator.name():
             raise Corruption(
                 f"comparator mismatch: DB created with {have_comparator}, "
                 f"opened with {self.icmp.user_comparator.name()}"
             )
-        self.current = builder.save()
-        self._all_versions.add(self.current)
+        builders.setdefault(0, VersionBuilder(Version(self.icmp, self.num_levels)))
+        cf_names.setdefault(0, "default")
+        self.column_families = {}
+        for cf, b in builders.items():
+            if cf in dropped:
+                continue
+            v = b.save()
+            self._all_versions.add(v)
+            self.column_families[cf] = ColumnFamilyState(
+                cf, cf_names.get(cf, f"cf{cf}"), v
+            )
+        self.max_column_family = max(
+            [next_cf_hint] + list(self.column_families)
+        )
         self.mark_file_number_used(self.manifest_file_number)
         if not readonly:
             # Reopen the manifest for appending new edits.
@@ -259,33 +319,48 @@ class VersionSet:
         newpath = filename.manifest_file_name(self.dbname, self.manifest_file_number)
         w = self.env.new_writable_file(newpath)
         self._manifest_writer = LogWriter(w)
-        snap = self._snapshot_edit()
-        self._manifest_writer.add_record(snap.encode())
+        for snap in self._snapshot_edits():
+            self._manifest_writer.add_record(snap.encode())
         self._manifest_writer.sync()
         filename.set_current_file(self.env, self.dbname, self.manifest_file_number)
 
-    def _snapshot_edit(self) -> VersionEdit:
-        edit = VersionEdit(
-            comparator=self.icmp.user_comparator.name(),
-            log_number=self.log_number,
-            prev_log_number=self.prev_log_number,
-            next_file_number=self._next_file_number,
-            last_sequence=self.last_sequence,
-        )
-        for level, f in self.current.all_files():
-            edit.add_file(level, f)
-        return edit
+    def _snapshot_edits(self) -> list[VersionEdit]:
+        edits = []
+        for cf_id in sorted(self.column_families):
+            st = self.column_families[cf_id]
+            edit = VersionEdit(
+                column_family=cf_id,
+                column_family_add=st.name,
+                max_column_family=self.max_column_family,
+            )
+            if cf_id == 0:
+                edit.comparator = self.icmp.user_comparator.name()
+                edit.log_number = self.log_number
+                edit.prev_log_number = self.prev_log_number
+                edit.next_file_number = self._next_file_number
+                edit.last_sequence = self.last_sequence
+            for level, f in st.current.all_files():
+                edit.add_file(level, f)
+            edits.append(edit)
+        return edits
 
     def log_and_apply(self, edit: VersionEdit, sync: bool = True) -> None:
-        """Append edit to MANIFEST and install the resulting Version
-        (reference VersionSet::LogAndApply, version_set.cc:6033)."""
+        """Append edit to MANIFEST and install the resulting Version for the
+        edit's column family (reference VersionSet::LogAndApply,
+        version_set.cc:6033)."""
         with self._lock:
+            cf = edit.column_family
+            st = self.column_families.get(cf)
+            if st is None:
+                # CF dropped while the job was in flight: discard the edit
+                # (the reference drops edits for dropped CFs the same way).
+                return
             if edit.log_number is not None:
                 assert edit.log_number >= self.log_number
                 self.log_number = edit.log_number
             edit.next_file_number = self._next_file_number
             edit.last_sequence = self.last_sequence
-            builder = VersionBuilder(self.current)
+            builder = VersionBuilder(st.current)
             builder.apply(edit)
             new_version = builder.save()
             assert self._manifest_writer is not None
@@ -293,7 +368,45 @@ class VersionSet:
             if sync:
                 self._manifest_writer.sync()
             self._all_versions.add(new_version)
-            self.current = new_version
+            st.current = new_version
+
+    def create_column_family(self, name: str) -> int:
+        """Register a new CF in the MANIFEST; returns its id (reference
+        VersionSet::CreateColumnFamily)."""
+        with self._lock:
+            for st in self.column_families.values():
+                if st.name == name:
+                    raise Corruption(f"column family {name!r} already exists")
+            cf_id = self.max_column_family + 1
+            self.max_column_family = cf_id
+            edit = VersionEdit(
+                column_family=cf_id, column_family_add=name,
+                max_column_family=cf_id,
+            )
+            assert self._manifest_writer is not None
+            self._manifest_writer.add_record(edit.encode())
+            self._manifest_writer.sync()
+            v = Version(self.icmp, self.num_levels)
+            self._all_versions.add(v)
+            self.column_families[cf_id] = ColumnFamilyState(cf_id, name, v)
+            return cf_id
+
+    def drop_column_family(self, cf_id: int) -> None:
+        with self._lock:
+            if cf_id == 0:
+                raise Corruption("cannot drop the default column family")
+            if cf_id not in self.column_families:
+                from toplingdb_tpu.utils.status import InvalidArgument
+
+                raise InvalidArgument(
+                    f"column family {cf_id} does not exist (double drop?)"
+                )
+            st = self.column_families.pop(cf_id)
+            st.dropped = True
+            edit = VersionEdit(column_family=cf_id, column_family_drop=True)
+            assert self._manifest_writer is not None
+            self._manifest_writer.add_record(edit.encode())
+            self._manifest_writer.sync()
 
     def close(self) -> None:
         if self._manifest_writer is not None:
@@ -303,10 +416,13 @@ class VersionSet:
     # -- introspection --------------------------------------------------
 
     def live_files(self) -> set[int]:
-        """Files referenced by the current version OR any version still held
-        by an in-flight reader/iterator."""
+        """Files referenced by any CF's current version OR any version still
+        held by an in-flight reader/iterator."""
         out: set[int] = set()
-        for v in list(self._all_versions) + [self.current]:
+        versions = list(self._all_versions) + [
+            st.current for st in self.column_families.values()
+        ]
+        for v in versions:
             for _, f in v.all_files():
                 out.add(f.number)
         return out

@@ -12,6 +12,10 @@ where each record is a type byte followed by length-prefixed slices:
 A batch is the atomic unit of the write path: it is appended to the WAL as one
 record and then applied to the memtable entry by entry with consecutive
 sequence numbers.
+
+Column families: non-default-CF records use the CF-prefixed record types
+(0x80 | base_type) followed by a varint32 column family id — the same scheme
+as the reference's kTypeColumnFamily* records.
 """
 
 from __future__ import annotations
@@ -21,6 +25,7 @@ from toplingdb_tpu.utils import coding
 from toplingdb_tpu.utils.status import Corruption
 
 HEADER_SIZE = 12
+_CF_FLAG = 0x80
 
 
 class WriteBatch:
@@ -34,27 +39,31 @@ class WriteBatch:
 
     # -- mutation -------------------------------------------------------
 
-    def put(self, key: bytes, value: bytes) -> None:
-        self._add_record(ValueType.VALUE, key, value)
+    def put(self, key: bytes, value: bytes, cf: int = 0) -> None:
+        self._add_record(ValueType.VALUE, cf, key, value)
 
-    def delete(self, key: bytes) -> None:
-        self._add_record(ValueType.DELETION, key)
+    def delete(self, key: bytes, cf: int = 0) -> None:
+        self._add_record(ValueType.DELETION, cf, key)
 
-    def single_delete(self, key: bytes) -> None:
-        self._add_record(ValueType.SINGLE_DELETION, key)
+    def single_delete(self, key: bytes, cf: int = 0) -> None:
+        self._add_record(ValueType.SINGLE_DELETION, cf, key)
 
-    def merge(self, key: bytes, value: bytes) -> None:
-        self._add_record(ValueType.MERGE, key, value)
+    def merge(self, key: bytes, value: bytes, cf: int = 0) -> None:
+        self._add_record(ValueType.MERGE, cf, key, value)
 
-    def delete_range(self, begin: bytes, end: bytes) -> None:
-        self._add_record(ValueType.RANGE_DELETION, begin, end)
+    def delete_range(self, begin: bytes, end: bytes, cf: int = 0) -> None:
+        self._add_record(ValueType.RANGE_DELETION, cf, begin, end)
 
     def put_log_data(self, blob: bytes) -> None:
         self._rep.append(ValueType.LOG_DATA)
         coding.put_length_prefixed_slice(self._rep, blob)
 
-    def _add_record(self, t: ValueType, *slices: bytes) -> None:
-        self._rep.append(t)
+    def _add_record(self, t: ValueType, cf: int, *slices: bytes) -> None:
+        if cf == 0:
+            self._rep.append(t)
+        else:
+            self._rep.append(_CF_FLAG | t)
+            self._rep += coding.encode_varint32(cf)
         for s in slices:
             coding.put_length_prefixed_slice(self._rep, s)
         self.set_count(self.count() + 1)
@@ -93,22 +102,34 @@ class WriteBatch:
     # -- iteration ------------------------------------------------------
 
     def entries(self):
-        """Yields (value_type, key, value_or_none). RANGE_DELETION yields
-        (type, begin_key, end_key). LOG_DATA is skipped."""
+        """Yields (value_type, key, value_or_none) for the DEFAULT column
+        family only (other CFs' records are skipped — use entries_cf() when
+        column families matter). RANGE_DELETION yields (type, begin, end);
+        LOG_DATA is skipped."""
+        for cf, t, k, v in self.entries_cf():
+            if cf == 0:
+                yield t, k, v
+
+    def entries_cf(self):
+        """Yields (cf_id, value_type, key, value_or_none)."""
         rep = self._rep
         off = HEADER_SIZE
         n = 0
         while off < len(rep):
             t = rep[off]
             off += 1
+            cf = 0
+            if t & _CF_FLAG and t != ValueType.LOG_DATA:
+                t &= ~_CF_FLAG
+                cf, off = coding.decode_varint32(rep, off)
             if t in (ValueType.VALUE, ValueType.MERGE, ValueType.RANGE_DELETION):
                 k, off = coding.get_length_prefixed_slice(rep, off)
                 v, off = coding.get_length_prefixed_slice(rep, off)
-                yield t, k, v
+                yield cf, t, k, v
                 n += 1
             elif t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
                 k, off = coding.get_length_prefixed_slice(rep, off)
-                yield t, k, None
+                yield cf, t, k, None
                 n += 1
             elif t == ValueType.LOG_DATA:
                 _, off = coding.get_length_prefixed_slice(rep, off)
@@ -120,10 +141,14 @@ class WriteBatch:
             )
 
     def insert_into(self, memtable, sequence: int | None = None) -> int:
-        """Apply to a memtable; returns the number of sequence numbers
-        consumed (== count)."""
+        """Apply to one memtable (single-CF) or a {cf_id: memtable} dict;
+        returns the number of sequence numbers consumed (== count).
+        Records for CFs absent from the dict are skipped (dropped CF)."""
         seq = self.sequence() if sequence is None else sequence
-        for t, k, v in self.entries():
-            memtable.add(seq, t, k, v if v is not None else b"")
+        is_map = isinstance(memtable, dict)
+        for cf, t, k, v in self.entries_cf():
+            mem = memtable.get(cf) if is_map else memtable
+            if mem is not None:
+                mem.add(seq, t, k, v if v is not None else b"")
             seq += 1
         return self.count()

@@ -140,8 +140,9 @@ def _wal_dump(path: str) -> int:
     for rec in LogReader(env.new_sequential_file(path)).records():
         b = WriteBatch(rec)
         print(f"seq={b.sequence()} count={b.count()}")
-        for t, k, v in b.entries():
-            print(f"  type={t} key={k!r} value={v!r}")
+        for cf, t, k, v in b.entries_cf():
+            cftag = f" cf={cf}" if cf else ""
+            print(f"  type={t}{cftag} key={k!r} value={v!r}")
     return 0
 
 

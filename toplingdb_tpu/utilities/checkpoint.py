@@ -28,9 +28,14 @@ def create_checkpoint(db, dest: str) -> None:
     env.create_dir(dest)
     with db._mutex:
         db.flush()
-        version = db.versions.current
         last_seq = db.versions.last_sequence
-        files = [(lvl, f) for lvl, f in version.all_files()]
+        # EVERY column family's files (a checkpoint is a whole-DB snapshot).
+        cf_files: dict[int, list] = {}
+        files = []
+        for cf_id, st in sorted(db.versions.column_families.items()):
+            cur = [(lvl, f) for lvl, f in st.current.all_files()]
+            cf_files[cf_id] = cur
+            files.extend(cur)
         # Hard-link every live SST when the env is the real posix FS; copy
         # through the Env otherwise (MemEnv / fault injection stay in the
         # loop).
@@ -48,20 +53,26 @@ def create_checkpoint(db, dest: str) -> None:
                     pass
             if not linked:
                 env.write_file(dst, env.read_file(src), sync=True)
-        # Fresh MANIFEST snapshot.
+        # Fresh MANIFEST snapshot: one edit per column family.
         manifest_number = 1
-        edit = VersionEdit(
-            comparator=db.icmp.user_comparator.name(),
-            log_number=0,
-            next_file_number=db.versions.next_file_number,
-            last_sequence=last_seq,
-        )
-        for lvl, f in files:
-            edit.add_file(lvl, f)
         w = LogWriter(db.env.new_writable_file(
             filename.manifest_file_name(dest, manifest_number)
         ))
-        w.add_record(edit.encode())
+        for cf_id in sorted(cf_files):
+            st = db.versions.column_families[cf_id]
+            edit = VersionEdit(
+                column_family=cf_id,
+                column_family_add=st.name,
+                max_column_family=db.versions.max_column_family,
+            )
+            if cf_id == 0:
+                edit.comparator = db.icmp.user_comparator.name()
+                edit.log_number = 0
+                edit.next_file_number = db.versions.next_file_number
+                edit.last_sequence = last_seq
+            for lvl, f in cf_files[cf_id]:
+                edit.add_file(lvl, f)
+            w.add_record(edit.encode())
         w.sync()
         w.close()
         filename.set_current_file(db.env, dest, manifest_number)
