@@ -1,0 +1,58 @@
+"""Pallas kernel: shared-prefix lengths vs a Python reference."""
+
+import numpy as np
+import pytest
+
+from toplingdb_tpu.ops.pallas_kernels import shared_prefix_lengths
+
+
+def ref_prefix(keys: list[bytes]) -> list[int]:
+    out = [0]
+    for a, b in zip(keys, keys[1:]):
+        n = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            n += 1
+        out.append(n)
+    return out
+
+
+def to_matrix(keys, k=32):
+    m = np.zeros((len(keys), k), dtype=np.uint8)
+    for i, key in enumerate(keys):
+        m[i, : len(key)] = np.frombuffer(key, dtype=np.uint8)
+    return m, np.array([len(key) for key in keys], dtype=np.int32)
+
+
+def test_prefix_kernel_matches_reference():
+    keys = sorted(
+        b"key%05d" % (i * 7 % 1000) for i in range(500)
+    )
+    m, lens = to_matrix(keys)
+    got = shared_prefix_lengths(m, lens)
+    assert got.tolist() == ref_prefix(keys)
+
+
+def test_prefix_kernel_zero_padding_not_counted():
+    # "ab" vs "ab\x00cd": zero padding of the shorter key must not extend
+    # the shared prefix beyond its true length.
+    keys = [b"ab", b"ab\x00cd"]
+    m, lens = to_matrix(keys, k=8)
+    got = shared_prefix_lengths(m, lens)
+    assert got.tolist() == [0, 2]
+
+
+def test_prefix_kernel_random():
+    import random
+
+    rng = random.Random(3)
+    keys = sorted({rng.randbytes(rng.randint(1, 30)) for _ in range(700)})
+    m, lens = to_matrix(keys)
+    got = shared_prefix_lengths(m, lens)
+    assert got.tolist() == ref_prefix(keys)
+
+
+def test_prefix_kernel_single_and_empty():
+    m, lens = to_matrix([b"solo"])
+    assert shared_prefix_lengths(m, lens).tolist() == [0]

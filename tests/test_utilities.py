@@ -644,3 +644,40 @@ def test_rate_limiter_oversized_request():
     rl.request(500_000)  # 10 periods worth
     dt = time.monotonic() - t0
     assert dt >= 0.3
+
+
+# -- sync points / wide columns ---------------------------------------------
+
+
+def test_sync_point_callbacks_and_dependencies(tmp_path):
+    from toplingdb_tpu.utils.sync_point import get_sync_point_registry
+
+    reg = get_sync_point_registry()
+    seen = []
+    try:
+        reg.set_callback("FlushJob::Start", lambda arg: seen.append("flush"))
+        reg.enable_processing()
+        with DB.open(str(tmp_path / "db"), opts()) as db:
+            db.put(b"k", b"v")
+            db.flush()
+        assert "flush" in seen
+    finally:
+        reg.clear_all()
+
+
+def test_wide_columns(tmp_path):
+    from toplingdb_tpu.db.wide_columns import (
+        DEFAULT_COLUMN, decode_entity, get_entity, put_entity,
+    )
+
+    with DB.open(str(tmp_path / "db"), opts()) as db:
+        put_entity(db, b"user1", {b"name": b"ada", b"age": b"36"})
+        db.put(b"plain", b"simple-value")
+        e = get_entity(db, b"user1")
+        assert e == {b"name": b"ada", b"age": b"36"}
+        # Plain values present as the default column.
+        assert get_entity(db, b"plain") == {DEFAULT_COLUMN: b"simple-value"}
+        assert get_entity(db, b"missing") is None
+        db.flush()
+        db.compact_range()
+        assert get_entity(db, b"user1")[b"name"] == b"ada"

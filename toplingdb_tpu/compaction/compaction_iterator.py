@@ -46,7 +46,9 @@ class CompactionIterator:
     def __init__(self, input_iter, icmp, snapshots: list[int],
                  bottommost_level: bool = False, merge_operator=None,
                  compaction_filter=None, compaction_filter_level: int = 0,
-                 range_del_agg=None, preserve_deletes: bool = False):
+                 range_del_agg=None, preserve_deletes: bool = False,
+                 blob_resolver=None):
+        self._blob_resolver = blob_resolver  # BLOB_INDEX payload → value
         self._input = input_iter
         self._icmp = icmp
         self._ucmp = icmp.user_comparator
@@ -225,6 +227,14 @@ class CompactionIterator:
         if j < n and self._stripe(entries[j][0]) == newest_stripe:
             seq, t, val = entries[j]
             if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+                if t == ValueType.BLOB_INDEX:
+                    # The merge base lives in a blob file: fold the REAL
+                    # value, never the raw index bytes.
+                    if self._blob_resolver is None:
+                        raise Corruption(
+                            "merge over a blob value but no blob resolver"
+                        )
+                    val = self._blob_resolver(val)
                 v = self._merge_op.full_merge(uk, val, list(reversed(operands)))
                 self.num_merged += 1
                 # Consume the base too; skip the rest of the stripe.

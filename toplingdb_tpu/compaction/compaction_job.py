@@ -172,6 +172,7 @@ def run_compaction_to_tables(
     env, dbname: str, icmp, compaction: Compaction, table_cache,
     table_options, snapshots: list[int], merge_operator=None,
     compaction_filter=None, new_file_number=None, creation_time=None,
+    blob_resolver=None,
 ) -> tuple[list[FileMetaData], CompactionStats]:
     """The CPU data plane: heap merge → CompactionIterator GC → outputs."""
     t0 = time.time()
@@ -187,6 +188,7 @@ def run_compaction_to_tables(
         compaction_filter=compaction_filter,
         compaction_filter_level=compaction.output_level,
         range_del_agg=None if rd.empty() else rd,
+        blob_resolver=blob_resolver,
     )
     tombs = surviving_tombstone_fragments(
         rd, snapshots, compaction.bottommost, icmp.user_comparator

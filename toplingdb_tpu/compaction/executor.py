@@ -83,6 +83,7 @@ class DeviceCompactionExecutor(CompactionExecutor):
             compaction_filter=db.options.compaction_filter,
             new_file_number=new_file_number,
             device_name=self.device,
+            blob_resolver=db.blob_source.get,
         )
 
 

@@ -169,6 +169,9 @@ class CompactionScheduler:
                 outputs, stats = self._run_local(c, snapshots, alloc)
             if db.options.statistics is not None:
                 db.options.statistics.record_compaction(stats)
+            from toplingdb_tpu.utils.sync_point import sync_point_callback
+
+            sync_point_callback("CompactionJob::BeforeInstall", c)
             edit = make_version_edit(c, outputs)
             with db._mutex:
                 db.versions.log_and_apply(edit)
@@ -206,6 +209,7 @@ class CompactionScheduler:
             merge_operator=db.options.merge_operator,
             compaction_filter=db.options.compaction_filter,
             new_file_number=alloc,
+            blob_resolver=db.blob_source.get,
         )
 
     # ------------------------------------------------------------------

@@ -90,6 +90,9 @@ def run_job(job_dir: str) -> int:
         counter[0] += 1
         return counter[0]
 
+    from toplingdb_tpu.db.blob import BlobSource
+
+    blob_source = BlobSource(env, params.dbname)
     if params.device in ("tpu", "cpu-jax", "device"):
         from toplingdb_tpu.ops.device_compaction import device_gc_entries
 
@@ -98,6 +101,7 @@ def run_job(job_dir: str) -> int:
             merge_operator=merge_op, compaction_filter=cfilter,
             compaction_filter_level=params.output_level,
             rd=None if rd.empty() else rd,
+            blob_resolver=blob_source.get,
         )
     else:
         # CPU reference path over a host-sorted stream.
@@ -110,6 +114,7 @@ def run_job(job_dir: str) -> int:
             compaction_filter=cfilter,
             compaction_filter_level=params.output_level,
             range_del_agg=None if rd.empty() else rd,
+            blob_resolver=blob_source.get,
         ).entries()
 
     tombs = surviving_tombstone_fragments(

@@ -75,6 +75,9 @@ class DB:
         self._cfs: dict[int, _CFData] = {
             0: _CFData(self.default_cf, self.icmp)
         }
+        from toplingdb_tpu.db.blob import BlobSource
+
+        self.blob_source = BlobSource(env, dbname)
         self.snapshots = SnapshotList()
         self._mutex = threading.RLock()
         self._wal: LogWriter | None = None
@@ -265,6 +268,7 @@ class DB:
                 self._wal.close()
             self.versions.close()
             self.table_cache.close()
+            self.blob_source.close()
             if self._log_file is not None:
                 self._log_file.close()
             self._closed = True
@@ -375,11 +379,20 @@ class DB:
 
     def _flush_memtables(self, mems: list[MemTable], wal_number: int | None,
                          cf_id: int = 0) -> None:
+        from toplingdb_tpu.utils.sync_point import sync_point
+
+        sync_point("FlushJob::Start")
         t0 = time.time()
         fnum = self.versions.new_file_number()
+        blob_num = (
+            self.versions.new_file_number()
+            if self.options.enable_blob_files else None
+        )
         meta = flush_memtable_to_table(
             self.env, self.dbname, fnum, self.icmp, mems,
             self.options.table_options, creation_time=int(time.time()),
+            blob_file_number=blob_num,
+            min_blob_size=self.options.min_blob_size,
         )
         edit = VersionEdit(log_number=wal_number, column_family=cf_id)
         if meta is not None:
@@ -427,7 +440,10 @@ class DB:
             opts.snapshot.sequence if opts.snapshot is not None
             else self.versions.last_sequence
         )
-        ctx = GetContext(key, snap_seq, self.options.merge_operator)
+        ctx = GetContext(
+            key, snap_seq, self.options.merge_operator,
+            blob_resolver=self.blob_source.get,
+        )
         # 1. Active memtable, then immutables (newest first).
         for mem in [cfd.mem] + cfd.imm:
             ctx.add_tombstone_seq(mem.covering_tombstone_seq(key, snap_seq))
@@ -514,6 +530,7 @@ class DB:
                 lower_bound=opts.iterate_lower_bound,
                 upper_bound=opts.iterate_upper_bound,
                 pinned=version,
+                blob_resolver=self.blob_source.get,
             )
 
     def get_snapshot(self):

@@ -18,7 +18,8 @@ class DBIter:
                  range_del_agg=None, merge_operator=None,
                  lower_bound: bytes | None = None,
                  upper_bound: bytes | None = None,
-                 pinned=None):
+                 pinned=None, blob_resolver=None):
+        self._blob_resolver = blob_resolver
         # `pinned` keeps the source Version (and anything else) alive for the
         # iterator's lifetime so obsolete-file GC cannot delete SSTs that
         # LevelIterator children will open lazily.
@@ -158,13 +159,16 @@ class DBIter:
                 skip_key = uk  # key is dead; skip all its older versions
                 self._iter.next()
                 continue
-            if t == ValueType.VALUE:
+            if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+                v = self._iter.value()
+                if t == ValueType.BLOB_INDEX:
+                    v = self._resolve_blob(v)
                 if merge_key is not None:
-                    self._emit_merge(merge_key, self._iter.value(), operands)
+                    self._emit_merge(merge_key, v, operands)
                     return
                 self._valid = True
                 self._key = uk
-                self._value = self._iter.value()
+                self._value = v
                 return
             if t == ValueType.MERGE:
                 if self._merge_op is None:
@@ -179,6 +183,11 @@ class DBIter:
             self._emit_merge(merge_key, None, operands)
             return
         self._valid = False
+
+    def _resolve_blob(self, idx: bytes) -> bytes:
+        if self._blob_resolver is None:
+            raise Corruption("blob index found but no blob resolver")
+        return self._blob_resolver(idx)
 
     def _emit_merge(self, uk: bytes, base: bytes | None, operands: list[bytes]) -> None:
         # operands collected newest→oldest.
@@ -225,7 +234,9 @@ class DBIter:
                     self._emit_merge(uk, None, operands)
                     return True
                 return False
-            if t == ValueType.VALUE:
+            if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+                if t == ValueType.BLOB_INDEX:
+                    val = self._resolve_blob(val)
                 if operands:
                     self._emit_merge(uk, val, operands)
                 else:

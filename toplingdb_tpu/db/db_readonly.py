@@ -69,6 +69,7 @@ class ReadOnlyDB(DB):
                 return
             self.versions._manifest_writer = None
             self.table_cache.close()
+            self.blob_source.close()
             if self._log_file is not None:
                 self._log_file.close()
             self._closed = True

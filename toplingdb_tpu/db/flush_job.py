@@ -13,10 +13,14 @@ from toplingdb_tpu.table.merging_iterator import MergingIterator
 
 def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
                             memtables: list[MemTable], table_options,
-                            creation_time: int = 0) -> FileMetaData | None:
+                            creation_time: int = 0,
+                            blob_file_number: int | None = None,
+                            min_blob_size: int = 0) -> FileMetaData | None:
     """Write one or more memtables (newest first) to a single L0 SST via a
     k-way merge of their already-sorted iterators. Returns None if there was
-    nothing to write."""
+    nothing to write. With blob_file_number set, values >= min_blob_size go
+    to a sibling blob file and the SST stores BLOB_INDEX pointers
+    (reference BlobFileBuilder integration in flush)."""
     tombstones: list[RangeTombstone] = []
     total = 0
     for mem in memtables:
@@ -25,6 +29,14 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
             tombstones.append(RangeTombstone(seq, begin, end))
     if total == 0 and not tombstones:
         return None
+
+    blob_builder = None
+    if blob_file_number is not None:
+        # min_blob_size == 0 means "separate every value" (the reference's
+        # semantics), not "disabled" — the enable flag gates separation.
+        from toplingdb_tpu.db.blob import BlobFileBuilder
+
+        blob_builder = BlobFileBuilder(env, dbname, blob_file_number)
 
     path = filename.table_file_name(dbname, file_number)
     w = env.new_writable_file(path)
@@ -37,13 +49,25 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
         )
         merger.seek_to_first()
         last_ikey = None
+        from toplingdb_tpu.db import dbformat as _dbf
+
         for ikey, val in merger.entries():
             # Exact duplicate internal keys across memtables (WAL replay):
             # the newer source (lower child index) surfaced first; skip dups.
             if last_ikey is not None and icmp.compare(last_ikey, ikey) == 0:
                 continue
-            builder.add(ikey, val)
             last_ikey = ikey
+            if (blob_builder is not None
+                    and ikey[-8] == _dbf.ValueType.VALUE
+                    and len(val) >= min_blob_size):
+                uk, seq, _ = _dbf.split_internal_key(ikey)
+                idx = blob_builder.add(uk, val)
+                builder.add(
+                    _dbf.make_internal_key(uk, seq, _dbf.ValueType.BLOB_INDEX),
+                    idx,
+                )
+                continue
+            builder.add(ikey, val)
         for frag in fragment_tombstones(tombstones, icmp.user_comparator):
             begin_ikey, end_uk = frag.to_table_entry()
             builder.add_tombstone(begin_ikey, end_uk)
@@ -51,6 +75,14 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
         w.sync()
     finally:
         w.close()
+        if blob_builder is not None:
+            from toplingdb_tpu.db.blob import blob_file_name
+
+            if blob_builder.finish() == 0:
+                try:
+                    env.delete_file(blob_file_name(dbname, blob_file_number))
+                except Exception:
+                    pass
 
     return FileMetaData(
         number=file_number,

@@ -25,10 +25,12 @@ class GetState(enum.Enum):
 
 
 class GetContext:
-    def __init__(self, user_key: bytes, snapshot_seq: int, merge_operator=None):
+    def __init__(self, user_key: bytes, snapshot_seq: int, merge_operator=None,
+                 blob_resolver=None):
         self.user_key = user_key
         self.snapshot_seq = snapshot_seq
         self.merge_operator = merge_operator
+        self.blob_resolver = blob_resolver  # BLOB_INDEX payload → real value
         self.state = GetState.NOT_FOUND
         self.value: bytes | None = None
         self.operands: list[bytes] = []   # collected newest→oldest
@@ -54,7 +56,12 @@ class GetContext:
             # compaction) must not be swallowed by the 0 "no tombstone"
             # sentinel.
             t = ValueType.DELETION
-        if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+        if t == ValueType.BLOB_INDEX:
+            if self.blob_resolver is None:
+                raise Corruption("blob index found but no blob resolver")
+            value = self.blob_resolver(value)
+            t = ValueType.VALUE
+        if t == ValueType.VALUE:
             if self.state == GetState.MERGE:
                 self.state = GetState.FOUND
                 self.value = self._fold(value)

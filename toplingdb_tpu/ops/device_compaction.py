@@ -72,7 +72,7 @@ MAX_DEVICE_KEY_BYTES = 128
 def device_gc_entries(entries, icmp, snapshots, bottommost,
                       merge_operator=None, compaction_filter=None,
                       compaction_filter_level=0, rd=None,
-                      max_key_bytes=None):
+                      max_key_bytes=None, blob_resolver=None):
     """Runs the device data plane over raw (unsorted) entries; yields the
     surviving (internal_key, value) stream — semantically identical to
     CompactionIterator.entries() over the merged sorted input."""
@@ -114,6 +114,7 @@ def device_gc_entries(entries, icmp, snapshots, bottommost,
         _EmptyIter(), icmp, snapshots, bottommost_level=bottommost,
         merge_operator=merge_operator, compaction_filter=compaction_filter,
         compaction_filter_level=compaction_filter_level, range_del_agg=rd,
+        blob_resolver=blob_resolver,
     )
     earliest = min(snapshots) if snapshots else dbformat.MAX_SEQUENCE_NUMBER
     from toplingdb_tpu.utils.compaction_filter import Decision
@@ -335,7 +336,8 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
 def run_device_compaction(env, dbname, icmp, compaction, table_cache,
                           table_options, snapshots, merge_operator=None,
                           compaction_filter=None, new_file_number=None,
-                          creation_time=None, device_name="tpu"):
+                          creation_time=None, device_name="tpu",
+                          blob_resolver=None):
     """Device counterpart of run_compaction_to_tables — same signature shape,
     byte-identical outputs. Jobs that can't cut output files (single-output)
     with no compaction filter take the fully-columnar native fast path; the
@@ -364,6 +366,7 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
         entries, icmp, snapshots, compaction.bottommost,
         merge_operator=merge_operator, compaction_filter=compaction_filter,
         compaction_filter_level=compaction.output_level, rd=rd_or_none,
+        blob_resolver=blob_resolver,
     )
     tombs = surviving_tombstone_fragments(
         rd, snapshots, compaction.bottommost, icmp.user_comparator

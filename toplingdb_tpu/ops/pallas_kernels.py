@@ -1,0 +1,86 @@
+"""Pallas TPU kernels.
+
+The block-encoding prep op: shared-prefix lengths between consecutive sorted
+keys — the per-entry scalar loop at the heart of the reference's
+BlockBuilder::Add (table/block_based/block_builder.cc) re-expressed as a VPU
+program: keys live as [N, 128] byte lanes (TPU-native last dim), the kernel
+computes `cumprod(eq) → sum` per row against the previous row.
+
+This is the building block for full on-device block assembly (offsets via
+prefix sums, then byte scatter); the current output feeds/validates the
+native encoder. Runs in interpret mode on CPU tests, compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+KEY_LANES = 128  # last-dim tile width on TPU
+# 1024 rows per grid step: XLA lays out 1-D s32 outputs with tile
+# T(min(n, 1024)), and the Mosaic block shape must match it exactly.
+_BLOCK_ROWS = 1024
+
+
+def _prefix_kernel(keys_ref, prev_ref, out_ref):
+    keys = keys_ref[:]          # [B, 128] int32 (one byte per lane)
+    prev = prev_ref[:]
+    neq = keys != prev
+    # Common prefix = index of the first differing lane (cumprod doesn't
+    # lower in Mosaic; iota + reduce-min does).
+    lane = jax.lax.broadcasted_iota(jnp.int32, keys.shape, 1)
+    first_diff = jnp.min(
+        jnp.where(neq, lane, jnp.int32(KEY_LANES)), axis=1
+    )
+    out_ref[:] = first_diff
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _shared_prefix_impl(keys, prev, interpret):
+    from jax.experimental import pallas as pl
+
+    n = keys.shape[0]
+    grid = (n // _BLOCK_ROWS,)
+    return pl.pallas_call(
+        _prefix_kernel,
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, KEY_LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, KEY_LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_ROWS,), lambda i: (i,)),
+        interpret=interpret,
+    )(keys, prev)
+
+
+def shared_prefix_lengths(key_bytes: np.ndarray,
+                          key_lens: np.ndarray | None = None,
+                          interpret: bool | None = None) -> np.ndarray:
+    """out[i] = length of the common prefix of row i and row i-1 (out[0]=0).
+
+    key_bytes: [N, K] uint8 (K <= 128), zero-padded rows of SORTED keys.
+    key_lens: optional true lengths; the result is clamped to
+    min(len[i], len[i-1]) so zero padding can't inflate prefixes.
+    """
+    n, k = key_bytes.shape
+    if k > KEY_LANES:
+        raise ValueError(f"keys wider than {KEY_LANES} bytes")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    pad_n = -(-max(n, 1) // _BLOCK_ROWS) * _BLOCK_ROWS
+    buf = np.zeros((pad_n, KEY_LANES), dtype=np.int32)
+    buf[:n, :k] = key_bytes
+    prev = np.zeros_like(buf)
+    prev[1:] = buf[:-1]
+    prev[0, :] = -1  # row 0 matches nothing
+    out = np.asarray(_shared_prefix_impl(buf, prev, interpret))[:n]
+    if key_lens is not None and n:
+        lens = key_lens.astype(np.int64)
+        cap = np.minimum(lens, np.roll(lens, 1))
+        cap[0] = 0
+        out = np.minimum(out, cap).astype(np.int32)
+    return out

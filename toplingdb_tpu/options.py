@@ -59,6 +59,10 @@ class Options:
     max_subcompactions: int = 1
     disable_auto_compactions: bool = False
 
+    # -- blob files (key-value separation, reference db/blob/) ----------
+    enable_blob_files: bool = False
+    min_blob_size: int = 256
+
     # -- table format ---------------------------------------------------
     table_options: TableOptions = field(default_factory=TableOptions)
     compression: int = fmt.NO_COMPRESSION

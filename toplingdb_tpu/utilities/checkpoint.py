@@ -53,6 +53,22 @@ def create_checkpoint(db, dest: str) -> None:
                     pass
             if not linked:
                 env.write_file(dst, env.read_file(src), sync=True)
+        # Blob files too (append-only and never deleted, so snapshotting all
+        # of them is safe; blob-aware filtering is a GC-round refinement).
+        for child in env.get_children(db.dbname):
+            if not child.endswith(".blob"):
+                continue
+            src = f"{db.dbname}/{child}"
+            dst = f"{dest}/{child}"
+            linked = False
+            if type(env) is PosixEnv:
+                try:
+                    os.link(src, dst)
+                    linked = True
+                except OSError:
+                    pass
+            if not linked:
+                env.write_file(dst, env.read_file(src), sync=True)
         # Fresh MANIFEST snapshot: one edit per column family.
         manifest_number = 1
         w = LogWriter(db.env.new_writable_file(
