@@ -286,3 +286,59 @@ def test_columnar_fast_path_byte_parity(tmp_path):
     assert out_cpu[0].smallest == out_dev[0].smallest
     assert out_cpu[0].largest == out_dev[0].largest
     assert out_cpu[0].num_entries == out_dev[0].num_entries
+
+
+def test_http_dcompact_service_end_to_end(tmp_db_path):
+    """HTTP worker service: DB routes compactions over HTTP + shared dir
+    (the curl+NFS transport shape of the reference's dcompact)."""
+    from toplingdb_tpu.compaction.dcompact_service import (
+        DcompactWorkerService, HttpCompactionExecutorFactory,
+    )
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    svc = DcompactWorkerService(device="cpu")
+    port = svc.start()
+    try:
+        opts = Options(
+            write_buffer_size=8 * 1024,
+            compaction_executor_factory=HttpCompactionExecutorFactory(
+                [f"http://127.0.0.1:{port}"], device="cpu",
+            ),
+        )
+        with DB.open(tmp_db_path, opts) as db:
+            for i in range(3000):
+                db.put(b"key%05d" % (i % 1000), b"val%07d" % i)
+            db.flush()
+            db.compact_range()
+            db.wait_for_compactions()
+            for k in range(0, 1000, 83):
+                last = max(i for i in range(k, 3000, 1000))
+                assert db.get(b"key%05d" % k) == b"val%07d" % last
+        assert svc.jobs_done >= 1
+    finally:
+        svc.stop()
+
+
+def test_http_dcompact_fallback_on_dead_worker(tmp_db_path):
+    """Unreachable worker → fallback-to-local keeps the DB correct."""
+    from toplingdb_tpu.compaction.dcompact_service import (
+        HttpCompactionExecutorFactory,
+    )
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    opts = Options(
+        write_buffer_size=8 * 1024,
+        compaction_executor_factory=HttpCompactionExecutorFactory(
+            ["http://127.0.0.1:1"], device="cpu", timeout=0.5,
+        ),
+    )
+    with DB.open(tmp_db_path, opts) as db:
+        for i in range(2000):
+            db.put(b"key%05d" % (i % 500), b"val%07d" % i)
+        db.flush()
+        db.compact_range()
+        for k in range(0, 500, 41):
+            last = max(i for i in range(k, 2000, 500))
+            assert db.get(b"key%05d" % k) == b"val%07d" % last

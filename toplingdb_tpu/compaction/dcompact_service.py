@@ -1,0 +1,179 @@
+"""dcompact worker service: HTTP job submission over shared storage.
+
+The transport shape of the reference's distributed compaction (curl control
+plane + NFS data plane; CompactionExecutorFactory::JobUrl,
+compaction_executor.h:146,177 in /root/reference): a worker host runs
+`DcompactWorkerService` (one process per TPU chip in a pod); the DB side's
+`HttpCompactionExecutor` POSTs {"job_dir": ...} to /dcompact and waits for
+CompactionResults. Bulk data (input SSTs, output SSTs, params/results JSON)
+moves through the shared filesystem, exactly like the reference's
+NFS/S3 exchange.
+
+Worker:  python -m toplingdb_tpu.compaction.dcompact_service --port 8080 \
+             [--device tpu] [--workers 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from toplingdb_tpu.compaction.executor import (
+    CompactionExecutorFactory,
+    SubprocessCompactionExecutor,
+)
+from toplingdb_tpu.utils.status import IOError_
+
+
+class DcompactWorkerService:
+    """Hosts job execution: POST /dcompact {"job_dir": ...} → runs the job
+    in-process (owning the chip), returns the results JSON. GET /stats for
+    introspection."""
+
+    def __init__(self, device: str = "cpu", max_workers: int = 1):
+        self.device = device
+        self._sem = threading.Semaphore(max_workers)
+        self._server: ThreadingHTTPServer | None = None
+        self.jobs_done = 0
+        self.jobs_failed = 0
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/stats":
+                    self._reply(200, {
+                        "device": svc.device, "jobs_done": svc.jobs_done,
+                        "jobs_failed": svc.jobs_failed,
+                    })
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/dcompact":
+                    self._reply(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n))
+                    job_dir = req["job_dir"]
+                    with svc._sem:  # one job per chip at a time
+                        import os
+
+                        from toplingdb_tpu.compaction import worker
+
+                        os.makedirs(job_dir, exist_ok=True)
+                        # The worker owns the device: override the submitted
+                        # params' device with this service's.
+                        ppath = os.path.join(job_dir, "params.json")
+                        with open(ppath) as pf:
+                            params = json.load(pf)
+                        if params.get("device") != svc.device:
+                            params["device"] = svc.device
+                            with open(ppath, "w") as pf:
+                                json.dump(params, pf, indent=1)
+                        rc = worker.run_job(job_dir)
+                    with open(f"{job_dir}/results.json") as f:
+                        results = json.load(f)
+                    svc.jobs_done += 1
+                    self._reply(200, results)
+                except Exception as e:  # job failure → structured error
+                    svc.jobs_failed += 1
+                    self._reply(500, {"status": f"{type(e).__name__}: {e}",
+                                      "output_files": [], "stats": {}})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+class HttpCompactionExecutorFactory(CompactionExecutorFactory):
+    """DB-side factory: jobs go to worker URLs round-robin (the JobUrl
+    mechanism). Falls back to local on any transport/worker error."""
+
+    def __init__(self, worker_urls: list[str], device: str = "cpu",
+                 allow_fallback: bool = True, min_input_bytes: int = 0,
+                 job_root: str | None = None, timeout: float = 3600.0):
+        self.worker_urls = list(worker_urls)
+        self.device = device
+        self._allow_fallback = allow_fallback
+        self.min_input_bytes = min_input_bytes
+        self.job_root = job_root
+        self.timeout = timeout
+        self._rr = 0
+
+    def should_run_local(self, compaction) -> bool:
+        return compaction.total_input_bytes() < self.min_input_bytes
+
+    def allow_fallback_to_local(self) -> bool:
+        return self._allow_fallback
+
+    def job_url(self, job_id: int, attempt: int) -> str:
+        return self.worker_urls[(job_id + attempt) % len(self.worker_urls)]
+
+    def new_executor(self, compaction):
+        url = self.worker_urls[self._rr % len(self.worker_urls)]
+        self._rr += 1
+
+        def spawn(job_dir: str, device: str) -> None:
+            req = urllib.request.Request(
+                url + "/dcompact",
+                data=json.dumps({"job_dir": job_dir}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    if r.status != 200:
+                        raise IOError_(f"worker {url} HTTP {r.status}")
+                    r.read()  # results also land in job_dir/results.json
+            except OSError as e:
+                raise IOError_(f"dcompact POST to {url} failed: {e}") from e
+
+        return SubprocessCompactionExecutor(
+            self.device, self.job_root, spawn=spawn
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--host", default="0.0.0.0",
+                    help="bind address (cross-host deployments need non-loopback)")
+    ap.add_argument("--device", default="cpu")
+    ap.add_argument("--workers", type=int, default=1)
+    args = ap.parse_args(argv)
+    svc = DcompactWorkerService(args.device, args.workers)
+    port = svc.start(args.port, args.host)
+    print(f"dcompact worker listening on {args.host}:{port} "
+          f"(device={svc.device})", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,17 @@ _SIGN = 0x80000000
 MAX_SNAPSHOTS = 64
 
 
+
+def _split_snapshots(snapshots: list[int]) -> tuple[np.ndarray, np.ndarray]:
+    """Sorted snapshot seqnos padded to MAX_SNAPSHOTS with the 2^56 sentinel,
+    split into (hi, lo) uint32 word arrays for the device kernels."""
+    pad_snap = 1 << 56
+    snaps = sorted(snapshots) + [pad_snap] * (MAX_SNAPSHOTS - len(snapshots))
+    snap_hi = np.array([x >> 32 for x in snaps], dtype=np.uint32)
+    snap_lo = np.array([x & 0xFFFFFFFF for x in snaps], dtype=np.uint32)
+    return snap_hi, snap_lo
+
+
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -176,28 +187,39 @@ def _gc_mask_impl(key_words, key_len, inv_hi, inv_lo, vtype,
     return keep, zero_seq, host_resolve & ~is_pad, group_id
 
 
-@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
-def _fused_sort_gc_impl(key_words, key_len, inv_hi, inv_lo, vtype, idx,
-                        snap_hi, snap_lo, num_key_words, bottommost):
-    """Sort + GC mask in ONE device program (single host round trip for
-    tombstone-free jobs). Returns (order, zero_flags, count, has_complex):
-    order[i] for i < count = original indices of survivors in output order."""
+
+def _sort_gc_compact_tail(key_words, key_len, inv_hi, inv_lo, vtype,
+                          snap_hi, snap_lo, num_key_words, bottommost):
+    """Traced tail shared by the fused kernels: sort → GC mask (no
+    tombstones) → survivors compacted to the front in sorted order."""
+    n = key_words.shape[0]
+    idxs = jnp.arange(n, dtype=jnp.int32)
     kw, kl, ih, il, vt, perm = _sort_impl(
-        key_words, key_len, inv_hi, inv_lo, vtype, idx, num_key_words
+        key_words, key_len, inv_hi, inv_lo, vtype, idxs, num_key_words
     )
-    n = kw.shape[0]
     zeros = jnp.zeros(n, dtype=jnp.uint32)
     keep, zero_seq, host_resolve, _ = _gc_mask_impl(
         kw, kl, ih, il, vt, snap_hi, snap_lo, zeros, zeros,
         num_key_words, bottommost,
     )
-    # Compact survivors to the front, preserving sorted order.
     take = jnp.argsort(~keep, stable=True)
     order = perm[take]
     zero_flags = zero_seq[take]
     count = jnp.sum(keep.astype(jnp.int32))
     has_complex = jnp.any(host_resolve)
     return order, zero_flags, count, has_complex
+
+
+@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
+def _fused_sort_gc_impl(key_words, key_len, inv_hi, inv_lo, vtype, idx,
+                        snap_hi, snap_lo, num_key_words, bottommost):
+    """Sort + GC mask in ONE device program (single host round trip for
+    tombstone-free jobs). Returns (order, zero_flags, count, has_complex):
+    order[i] for i < count = original indices of survivors in output order."""
+    return _sort_gc_compact_tail(
+        key_words, key_len, inv_hi, inv_lo, vtype, snap_hi, snap_lo,
+        num_key_words, bottommost,
+    )
 
 
 def fused_sort_gc(padded: dict, snapshots: list[int], bottommost: bool):
@@ -208,15 +230,83 @@ def fused_sort_gc(padded: dict, snapshots: list[int], bottommost: bool):
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
         )
     p = padded["key_words"].shape[0]
-    pad_snap = 1 << 56
-    snaps = sorted(snapshots) + [pad_snap] * (MAX_SNAPSHOTS - len(snapshots))
-    snap_hi = np.array([s >> 32 for s in snaps], dtype=np.uint32)
-    snap_lo = np.array([s & 0xFFFFFFFF for s in snaps], dtype=np.uint32)
+    snap_hi, snap_lo = _split_snapshots(snapshots)
     idx = np.arange(p, dtype=np.int32)
     order, zero_flags, count, has_complex = _fused_sort_gc_impl(
         padded["key_words"], padded["key_len"], padded["inv_hi"],
         padded["inv_lo"], padded["vtype"], idx, snap_hi, snap_lo,
         padded["w"], bool(bottommost),
+    )
+    c = int(count)
+    return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)
+
+
+@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
+def _fused_encode_sort_gc_impl(key_buf, key_offs, key_lens, valid,
+                               snap_hi, snap_lo, num_key_words, bottommost):
+    """Columnar encode + sort + GC mask, all ON DEVICE: the host uploads raw
+    internal-key bytes + offsets only (≈half the bytes of pre-built columns)
+    and downloads the survivor order. Tombstone-free jobs only."""
+    n = key_offs.shape[0]
+    span = num_key_words * 4
+    u32 = jnp.uint32
+
+    # --- trailer: 8 LE bytes at offs+len-8 → packed (seq<<8|type) ---
+    tr_idx = (key_offs + key_lens - 8)[:, None] + jnp.arange(8)[None, :]
+    tr = key_buf[jnp.clip(tr_idx, 0, key_buf.shape[0] - 1)].astype(u32)
+    packed_lo = tr[:, 0] | (tr[:, 1] << 8) | (tr[:, 2] << 16) | (tr[:, 3] << 24)
+    packed_hi = tr[:, 4] | (tr[:, 5] << 8) | (tr[:, 6] << 16) | (tr[:, 7] << 24)
+    vtype = (packed_lo & u32(0xFF)).astype(jnp.int32)
+    vtype = jnp.where(valid, vtype, -1)
+    inv_hi_u = ~packed_hi
+    inv_lo_u = ~packed_lo
+    sign = u32(0x80000000)
+    i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    inv_hi = i32(inv_hi_u ^ sign)
+    inv_lo = i32(inv_lo_u ^ sign)
+    int32max = jnp.int32(2**31 - 1)
+    inv_hi = jnp.where(valid, inv_hi, int32max)
+    inv_lo = jnp.where(valid, inv_lo, int32max)
+
+    # --- user-key words: gather span bytes, mask past uk_len, pack BE ---
+    uk_len = (key_lens - 8).astype(jnp.int32)
+    idx = key_offs[:, None] + jnp.arange(span)[None, :]
+    kb = key_buf[jnp.clip(idx, 0, key_buf.shape[0] - 1)].astype(u32)
+    kb = kb * (jnp.arange(span)[None, :] < uk_len[:, None])
+    kb = kb.reshape(n, num_key_words, 4)
+    words = (kb[:, :, 0] << 24) | (kb[:, :, 1] << 16) | (kb[:, :, 2] << 8) | kb[:, :, 3]
+    key_words = i32(words ^ sign)
+    key_words = jnp.where(valid[:, None], key_words, int32max)
+    key_len = jnp.where(valid, uk_len, int32max)
+
+    return _sort_gc_compact_tail(
+        key_words, key_len, inv_hi, inv_lo, vtype, snap_hi, snap_lo,
+        num_key_words, bottommost,
+    )
+
+
+def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
+                         key_lens: np.ndarray, max_key_bytes: int,
+                         snapshots: list[int], bottommost: bool):
+    """Host wrapper: raw flat key bytes in, survivor order out (no range
+    tombstones). Returns (order[count], zero_flags[count], has_complex)."""
+    if len(snapshots) > MAX_SNAPSHOTS:
+        raise NotSupported(
+            f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
+        )
+    n = len(key_offs)
+    p = _next_pow2(max(1, n))
+    w = (max_key_bytes + 3) // 4
+    offs = np.zeros(p, dtype=np.int32)
+    lens = np.full(p, 8, dtype=np.int32)  # pad rows: 8-byte dummy trailer
+    valid = np.zeros(p, dtype=bool)
+    offs[:n] = key_offs
+    lens[:n] = key_lens
+    valid[:n] = True
+    snap_hi, snap_lo = _split_snapshots(snapshots)
+    kb = key_buf if len(key_buf) >= 8 else np.zeros(8, dtype=np.uint8)
+    order, zero_flags, count, has_complex = _fused_encode_sort_gc_impl(
+        kb, offs, lens, valid, snap_hi, snap_lo, w, bool(bottommost),
     )
     c = int(count)
     return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)
@@ -237,10 +327,7 @@ def gc_mask(sorted_cols: dict, snapshots: list[int],
         )
     p = sorted_cols["key_words"].shape[0]
     n = sorted_cols["n"]
-    pad_snap = 1 << 56
-    snaps = sorted(snapshots) + [pad_snap] * (MAX_SNAPSHOTS - len(snapshots))
-    snap_hi = np.array([s >> 32 for s in snaps], dtype=np.uint32)
-    snap_lo = np.array([s & 0xFFFFFFFF for s in snaps], dtype=np.uint32)
+    snap_hi, snap_lo = _split_snapshots(snapshots)
     if tomb_cover is None:
         tomb_hi = np.zeros(p, dtype=np.uint32)
         tomb_lo = np.zeros(p, dtype=np.uint32)

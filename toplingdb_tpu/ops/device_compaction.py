@@ -180,19 +180,13 @@ def columnar_from_kv(kv, max_key_bytes: int | None = None):
     zero-Python-loop encode for the fast path."""
     import types
 
-    import sys
-
     n = kv.n
     offs = kv.key_offs.astype(np.int64)
     lens = kv.key_lens.astype(np.int64)
-    tr_idx = (offs + lens - 8)[:, None] + np.arange(8)[None, :]
-    trailer = np.ascontiguousarray(kv.key_buf[tr_idx])
-    packed = trailer.view(np.uint64).reshape(n)
-    if sys.byteorder == "big":  # trailer bytes on disk are LE
-        packed = packed.byteswap()
-    seq = packed >> np.uint64(8)
-    vtype = (packed & np.uint64(0xFF)).astype(np.int32)
-    inv = np.uint64(0xFFFFFFFFFFFFFFFF) - packed
+    tv = _kv_seq_vtype(kv)
+    seq = tv.seq
+    vtype = tv.vtype
+    inv = np.uint64(0xFFFFFFFFFFFFFFFF) - tv.packed
     sign = np.uint32(0x80000000)
     inv_hi = ((inv >> np.uint64(32)).astype(np.uint32) ^ sign).view(np.int32)
     inv_lo = ((inv & np.uint64(0xFFFFFFFF)).astype(np.uint32) ^ sign).view(np.int32)
@@ -215,6 +209,28 @@ def columnar_from_kv(kv, max_key_bytes: int | None = None):
     return types.SimpleNamespace(
         key_words=key_words, key_len=uk_len, inv_hi=inv_hi, inv_lo=inv_lo,
         vtype=vtype, seq=seq, n=n,
+    )
+
+
+def _kv_seq_vtype(kv):
+    """Trailer columns (packed, seq, vtype) from flat buffers — shared by the
+    full columnar encode and the cheap post-fused-run subset."""
+    import sys
+    import types
+
+    n = kv.n
+    offs = kv.key_offs.astype(np.int64)
+    lens = kv.key_lens.astype(np.int64)
+    tr_idx = (offs + lens - 8)[:, None] + np.arange(8)[None, :]
+    trailer = np.ascontiguousarray(kv.key_buf[tr_idx])
+    packed = trailer.view(np.uint64).reshape(n)
+    if sys.byteorder == "big":  # trailer bytes on disk are LE
+        packed = packed.byteswap()
+    return types.SimpleNamespace(
+        packed=packed,
+        seq=packed >> np.uint64(8),
+        vtype=(packed & np.uint64(0xFF)).astype(np.int32),
+        n=n,
     )
 
 
@@ -259,17 +275,21 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # Exceeds the sort-operand budget (and the 4096B native block-builder
         # key buffer); the entries path re-checks and routes to the CPU.
         raise _FallbackToEntries()
-    col = columnar_from_kv(kv)
-    padded = ck.pad_columns(col)
     if rd.empty():
-        # Tombstone-free: single fused device program, one round trip.
-        order, zero_flags, has_complex = ck.fused_sort_gc(
-            padded, snapshots, compaction.bottommost
+        # Tombstone-free: encode + sort + GC in ONE device program fed raw
+        # key bytes (half the upload of pre-built columns, no host gather).
+        mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
+        order, zero_flags, has_complex = ck.fused_encode_sort_gc(
+            kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
+            compaction.bottommost,
         )
         if has_complex:
             raise _FallbackToEntries()
         zero_orig = order[zero_flags]
+        col = _kv_seq_vtype(kv)
     else:
+        col = columnar_from_kv(kv)
+        padded = ck.pad_columns(col)
         sorted_cols, perm = ck.device_sort(padded)
         sorted_uks = [
             kv.key_buf[kv.key_offs[i]: kv.key_offs[i] + kv.key_lens[i] - 8]

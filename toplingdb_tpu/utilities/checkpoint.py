@@ -36,39 +36,27 @@ def create_checkpoint(db, dest: str) -> None:
             cur = [(lvl, f) for lvl, f in st.current.all_files()]
             cf_files[cf_id] = cur
             files.extend(cur)
-        # Hard-link every live SST when the env is the real posix FS; copy
-        # through the Env otherwise (MemEnv / fault injection stay in the
-        # loop).
+        # Hard-link when the env is the real posix FS; copy through the Env
+        # otherwise (MemEnv / fault injection stay in the loop).
         from toplingdb_tpu.env.env import PosixEnv
 
-        for _, f in files:
-            src = filename.table_file_name(db.dbname, f.number)
-            dst = filename.table_file_name(dest, f.number)
-            linked = False
+        def link_or_copy(src: str, dst: str) -> None:
             if type(env) is PosixEnv:
                 try:
                     os.link(src, dst)
-                    linked = True
+                    return
                 except OSError:
                     pass
-            if not linked:
-                env.write_file(dst, env.read_file(src), sync=True)
+            env.write_file(dst, env.read_file(src), sync=True)
+
+        for _, f in files:
+            link_or_copy(filename.table_file_name(db.dbname, f.number),
+                         filename.table_file_name(dest, f.number))
         # Blob files too (append-only and never deleted, so snapshotting all
         # of them is safe; blob-aware filtering is a GC-round refinement).
         for child in env.get_children(db.dbname):
-            if not child.endswith(".blob"):
-                continue
-            src = f"{db.dbname}/{child}"
-            dst = f"{dest}/{child}"
-            linked = False
-            if type(env) is PosixEnv:
-                try:
-                    os.link(src, dst)
-                    linked = True
-                except OSError:
-                    pass
-            if not linked:
-                env.write_file(dst, env.read_file(src), sync=True)
+            if child.endswith(".blob"):
+                link_or_copy(f"{db.dbname}/{child}", f"{dest}/{child}")
         # Fresh MANIFEST snapshot: one edit per column family.
         manifest_number = 1
         w = LogWriter(db.env.new_writable_file(

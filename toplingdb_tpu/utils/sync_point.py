@@ -60,9 +60,9 @@ class _SyncPointRegistry:
     def process(self, name: str, arg=None) -> None:
         if not self._enabled:
             return
-        cb = self._callbacks.get(name)
-        if cb is not None:
-            cb(arg)
+        # Wait for predecessors FIRST, then run the callback (reference
+        # sync_point_impl.cc: PredecessorsAllCleared gates the callback), so
+        # a callback on "B" with dependency A→B observes post-A state.
         with self._cv:
             preds = self._predecessors.get(name)
             if preds:
@@ -70,6 +70,10 @@ class _SyncPointRegistry:
                     p in self._cleared for p in preds
                 ):
                     self._cv.wait(timeout=5.0)
+        cb = self._callbacks.get(name)
+        if cb is not None:
+            cb(arg)
+        with self._cv:
             self._cleared.add(name)
             self._cv.notify_all()
 
