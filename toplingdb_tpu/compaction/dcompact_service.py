@@ -37,8 +37,16 @@ class DcompactWorkerService:
         self.device = device
         self._sem = threading.Semaphore(max_workers)
         self._server: ThreadingHTTPServer | None = None
+        self._counter_mu = threading.Lock()
         self.jobs_done = 0
         self.jobs_failed = 0
+
+    def _count(self, ok: bool) -> None:
+        with self._counter_mu:
+            if ok:
+                self.jobs_done += 1
+            else:
+                self.jobs_failed += 1
 
     def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
         svc = self
@@ -90,10 +98,10 @@ class DcompactWorkerService:
                         rc = worker.run_job(job_dir)
                     with open(f"{job_dir}/results.json") as f:
                         results = json.load(f)
-                    svc.jobs_done += 1
+                    svc._count(ok=True)
                     self._reply(200, results)
                 except Exception as e:  # job failure → structured error
-                    svc.jobs_failed += 1
+                    svc._count(ok=False)
                     self._reply(500, {"status": f"{type(e).__name__}: {e}",
                                       "output_files": [], "stats": {}})
 

@@ -55,9 +55,11 @@ class _CFData:
 
     __slots__ = ("handle", "mem", "imm")
 
-    def __init__(self, handle: ColumnFamilyHandle, icmp):
+    def __init__(self, handle: ColumnFamilyHandle, icmp, rep_name: str = "vector"):
+        from toplingdb_tpu.db.memtable import create_memtable_rep
+
         self.handle = handle
-        self.mem = MemTable(icmp)
+        self.mem = MemTable(icmp, create_memtable_rep(rep_name))
         self.imm: list[MemTable] = []
 
 
@@ -73,7 +75,7 @@ class DB:
         self.table_cache = TableCache(env, dbname, self.icmp, options.table_options)
         self.default_cf = ColumnFamilyHandle(0, "default")
         self._cfs: dict[int, _CFData] = {
-            0: _CFData(self.default_cf, self.icmp)
+            0: _CFData(self.default_cf, self.icmp, options.memtable_rep)
         }
         from toplingdb_tpu.db.blob import BlobSource
 
@@ -144,7 +146,7 @@ class DB:
         with self._mutex:
             cf_id = self.versions.create_column_family(name)
             h = ColumnFamilyHandle(cf_id, name)
-            self._cfs[cf_id] = _CFData(h, self.icmp)
+            self._cfs[cf_id] = _CFData(h, self.icmp, self.options.memtable_rep)
             return h
 
     def drop_column_family(self, handle: ColumnFamilyHandle) -> None:
@@ -240,10 +242,12 @@ class DB:
         for cf_id, st in self.versions.column_families.items():
             if cf_id not in self._cfs:
                 h = ColumnFamilyHandle(cf_id, st.name)
-                self._cfs[cf_id] = _CFData(h, self.icmp)
+                self._cfs[cf_id] = _CFData(h, self.icmp, self.options.memtable_rep)
 
     def _fresh_memtable(self) -> MemTable:
-        m = MemTable(self.icmp)
+        from toplingdb_tpu.db.memtable import create_memtable_rep
+
+        m = MemTable(self.icmp, create_memtable_rep(self.options.memtable_rep))
         self._mem_id_counter += 1
         m.mem_id = self._mem_id_counter
         return m

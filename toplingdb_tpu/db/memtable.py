@@ -29,7 +29,9 @@ def _sort_key(user_key: bytes, packed: int) -> tuple[bytes, int]:
 
 
 class MemTableRep:
-    """Pluggable sorted container of ((user_key, inv_packed) -> value)."""
+    """Pluggable sorted container of ((user_key, inv_packed) -> value) —
+    the reference's MemTableRep factory seam (memtablerep.h:64,309), where
+    the CSPP-style reps plug in."""
 
     def insert(self, skey, value: bytes) -> None:
         raise NotImplementedError
@@ -42,6 +44,108 @@ class MemTableRep:
 
     def __len__(self) -> int:
         raise NotImplementedError
+
+    # Positional cursor protocol for MemTableIterator: each method returns
+    # an opaque position or None; entry_at(pos) -> (skey, value).
+    def pos_first(self):
+        raise NotImplementedError
+
+    def pos_last(self):
+        raise NotImplementedError
+
+    def pos_seek_ge(self, skey):
+        raise NotImplementedError
+
+    def pos_seek_lt(self, skey):
+        raise NotImplementedError
+
+    def pos_next(self, pos):
+        raise NotImplementedError
+
+    def entry_at(self, pos):
+        raise NotImplementedError
+
+    def memory_usage(self) -> int:
+        return 0
+
+
+class NativeSkipListRep(MemTableRep):
+    """Arena skiplist in C++ (native/tpulsm_native.cc) — the native memtable
+    (reference InlineSkipList / the CSPP seam). Requires the native lib."""
+
+    def __init__(self):
+        from toplingdb_tpu import native
+
+        self._l = native.pylib()
+        if self._l is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._l.tpulsm_skiplist_new()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._l.tpulsm_skiplist_free(self._h)
+            self._h = None
+
+    def insert(self, skey, value: bytes) -> None:
+        uk, inv = skey
+        self._l.tpulsm_skiplist_insert(
+            self._h, uk, len(uk), inv, value, len(value)
+        )
+
+    def __len__(self) -> int:
+        return self._l.tpulsm_skiplist_count(self._h)
+
+    def memory_usage(self) -> int:
+        return self._l.tpulsm_skiplist_memory(self._h)
+
+    def _node_entry(self, node):
+        import ctypes
+
+        kptr = ctypes.c_void_p()
+        klen = ctypes.c_uint32()
+        inv = ctypes.c_uint64()
+        vptr = ctypes.c_void_p()
+        vlen = ctypes.c_uint32()
+        self._l.tpulsm_skiplist_node(
+            node, ctypes.byref(kptr), ctypes.byref(klen), ctypes.byref(inv),
+            ctypes.byref(vptr), ctypes.byref(vlen),
+        )
+        uk = ctypes.string_at(kptr, klen.value)
+        val = ctypes.string_at(vptr, vlen.value)
+        return (uk, inv.value), val
+
+    def iter_from(self, skey):
+        uk, inv = skey
+        node = self._l.tpulsm_skiplist_seek_ge(self._h, uk, len(uk), inv)
+        while node:
+            yield self._node_entry(node)
+            node = self._l.tpulsm_skiplist_next(node)
+
+    def iter_all(self):
+        node = self._l.tpulsm_skiplist_first(self._h)
+        while node:
+            yield self._node_entry(node)
+            node = self._l.tpulsm_skiplist_next(node)
+
+    def pos_first(self):
+        return self._l.tpulsm_skiplist_first(self._h) or None
+
+    def pos_last(self):
+        return self._l.tpulsm_skiplist_last(self._h) or None
+
+    def pos_seek_ge(self, skey):
+        uk, inv = skey
+        return self._l.tpulsm_skiplist_seek_ge(self._h, uk, len(uk), inv) or None
+
+    def pos_seek_lt(self, skey):
+        uk, inv = skey
+        return self._l.tpulsm_skiplist_seek_lt(self._h, uk, len(uk), inv) or None
+
+    def pos_next(self, pos):
+        return self._l.tpulsm_skiplist_next(pos) or None
+
+    def entry_at(self, pos):
+        return self._node_entry(pos)
 
 
 class PyVectorRep(MemTableRep):
@@ -71,6 +175,50 @@ class PyVectorRep(MemTableRep):
 
     def __len__(self) -> int:
         return len(self._items)
+
+    # Positions are sort keys (re-bisected per step): list shifts from
+    # concurrent inserts cannot skip or repeat entries.
+    def _at(self, i: int):
+        return self._items[i][0] if 0 <= i < len(self._items) else None
+
+    def pos_first(self):
+        return self._at(0)
+
+    def pos_last(self):
+        return self._at(len(self._items) - 1)
+
+    def pos_seek_ge(self, skey):
+        return self._at(bisect.bisect_left(self._items, skey, key=lambda e: e[0]))
+
+    def pos_seek_lt(self, skey):
+        return self._at(bisect.bisect_left(self._items, skey, key=lambda e: e[0]) - 1)
+
+    def pos_next(self, pos):
+        return self._at(bisect.bisect_right(self._items, pos, key=lambda e: e[0]))
+
+    def entry_at(self, pos):
+        # bisect + index are two steps; a concurrent insert between them can
+        # shift the list. Entries are never removed, so re-checking the key
+        # and re-bisecting converges.
+        while True:
+            i = bisect.bisect_left(self._items, pos, key=lambda e: e[0])
+            entry = self._items[i]
+            if entry[0] == pos:
+                return entry
+
+
+def create_memtable_rep(name: str) -> MemTableRep:
+    """Factory seam (reference memtablerep.h:309): 'vector' | 'skiplist'."""
+    if name == "vector":
+        return PyVectorRep()
+    if name == "skiplist":
+        try:
+            return NativeSkipListRep()
+        except RuntimeError:
+            return PyVectorRep()  # no toolchain: degrade gracefully
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    raise InvalidArgument(f"unknown memtable rep {name!r}")
 
 
 class MemTable:
@@ -169,59 +317,59 @@ class MemTable:
 
 
 class MemTableIterator:
-    """Standard iterator protocol over a memtable's point entries.
+    """Standard iterator protocol over a memtable's point entries, built on
+    the rep's positional cursor protocol — works over both the Python vector
+    rep and the native C++ skiplist.
 
-    Tolerates concurrent inserts: positions are re-derived by bisect on the
-    stored sort key, so list shifts cannot skip or repeat entries (the Python
-    analogue of iterating a lock-free skiplist)."""
+    Tolerates concurrent inserts: vector-rep positions are sort keys
+    (re-bisected per step, the Python analogue of iterating a lock-free
+    skiplist); native skiplist nodes are stable arena pointers."""
 
     def __init__(self, mem: MemTable):
-        self._mem = mem
-        self._rep: PyVectorRep = mem._rep  # type: ignore[assignment]
-        self._skey = None   # current (user_key, inv_packed) or None
-        self._value = None
+        self._rep = mem._rep
+        self._pos = None
+        self._entry = None
 
-    def _load(self, i: int) -> None:
-        items = self._rep._items
-        if 0 <= i < len(items):
-            self._skey, self._value = items[i]
-        else:
-            self._skey = None
-            self._value = None
+    def _set(self, pos) -> None:
+        self._pos = pos
+        self._entry = self._rep.entry_at(pos) if pos is not None else None
 
     def valid(self) -> bool:
-        return self._skey is not None
+        return self._entry is not None
 
     def key(self) -> bytes:
-        uk, inv = self._skey
+        uk, inv = self._entry[0]
         seq, t = dbformat.unpack_seq_type(_MAX_PACKED - inv)
         return dbformat.make_internal_key(uk, seq, t)
 
     def value(self) -> bytes:
-        return self._value
+        return self._entry[1]
 
     def seek_to_first(self) -> None:
-        self._load(0)
+        self._set(self._rep.pos_first())
 
     def seek_to_last(self) -> None:
-        self._load(len(self._rep._items) - 1)
+        self._set(self._rep.pos_last())
 
     def seek(self, ikey: bytes) -> None:
         uk, seq, t = dbformat.split_internal_key(ikey)
-        skey = _sort_key(uk, dbformat.pack_seq_type(seq, t))
-        self._load(bisect.bisect_left(self._rep._items, skey, key=lambda it: it[0]))
+        self._set(self._rep.pos_seek_ge(
+            _sort_key(uk, dbformat.pack_seq_type(seq, t))
+        ))
 
     def seek_for_prev(self, ikey: bytes) -> None:
         uk, seq, t = dbformat.split_internal_key(ikey)
         skey = _sort_key(uk, dbformat.pack_seq_type(seq, t))
-        self._load(bisect.bisect_right(self._rep._items, skey, key=lambda it: it[0]) - 1)
+        pos = self._rep.pos_seek_ge(skey)
+        if pos is not None and self._rep.entry_at(pos)[0] == skey:
+            self._set(pos)
+        else:
+            self._set(self._rep.pos_seek_lt(skey))
 
     def next(self) -> None:
         assert self.valid()
-        i = bisect.bisect_right(self._rep._items, self._skey, key=lambda it: it[0])
-        self._load(i)
+        self._set(self._rep.pos_next(self._pos))
 
     def prev(self) -> None:
         assert self.valid()
-        i = bisect.bisect_left(self._rep._items, self._skey, key=lambda it: it[0])
-        self._load(i - 1)
+        self._set(self._rep.pos_seek_lt(self._entry[0]))

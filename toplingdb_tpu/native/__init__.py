@@ -97,6 +97,53 @@ def lib() -> ctypes.CDLL | None:
         return _lib
 
 
+_pylib: "ctypes.PyDLL | None" = None
+
+
+def pylib() -> "ctypes.PyDLL | None":
+    """GIL-holding handle for the skiplist memtable: calls do NOT release the
+    GIL, so single-writer mutation is safe against lockless Python readers."""
+    global _pylib
+    if _pylib is not None:
+        return _pylib
+    if lib() is None:  # ensures the .so is built
+        return None
+    l = ctypes.PyDLL(_SO)
+    vp = ctypes.c_void_p
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    l.tpulsm_skiplist_new.restype = vp
+    l.tpulsm_skiplist_new.argtypes = []
+    l.tpulsm_skiplist_free.restype = None
+    l.tpulsm_skiplist_free.argtypes = [vp]
+    l.tpulsm_skiplist_insert.restype = ctypes.c_int32
+    l.tpulsm_skiplist_insert.argtypes = [
+        vp, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    l.tpulsm_skiplist_count.restype = ctypes.c_int64
+    l.tpulsm_skiplist_count.argtypes = [vp]
+    l.tpulsm_skiplist_memory.restype = ctypes.c_int64
+    l.tpulsm_skiplist_memory.argtypes = [vp]
+    for name in ("tpulsm_skiplist_seek_ge", "tpulsm_skiplist_seek_lt"):
+        fn = getattr(l, name)
+        fn.restype = vp
+        fn.argtypes = [vp, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+    for name in ("tpulsm_skiplist_first", "tpulsm_skiplist_last"):
+        fn = getattr(l, name)
+        fn.restype = vp
+        fn.argtypes = [vp]
+    l.tpulsm_skiplist_next.restype = vp
+    l.tpulsm_skiplist_next.argtypes = [vp]
+    l.tpulsm_skiplist_node.restype = None
+    l.tpulsm_skiplist_node.argtypes = [
+        vp, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    _pylib = l
+    return _pylib
+
+
 def np_u8p(arr):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 

@@ -9,6 +9,7 @@
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include <vector>
 
 extern "C" {
 
@@ -391,6 +392,206 @@ void tpulsm_bloom_build(
       x += h2;
     }
   }
+}
+
+// ---------------------------------------------------------------------------
+// Arena skiplist memtable rep (the native analogue of the reference's
+// InlineSkipList memtable, memtable/inlineskiplist.h; the CSPP-memtable seam
+// in Python is MemTableRep — this is its native implementation).
+// Ordering: user_key bytewise ascending, then inv_packed (u64) ascending
+// (inv = ~(seq<<8|type), so newer versions sort first).
+// Called under the Python GIL via ctypes.PyDLL: single-writer semantics.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct SLNode {
+  const uint8_t* key;
+  uint32_t key_len;
+  uint32_t val_len;
+  uint64_t inv_packed;
+  const uint8_t* val;
+  int height;
+  SLNode* next[1];  // variable length
+};
+
+struct Arena {
+  std::vector<uint8_t*> blocks;
+  size_t used = 0;
+  size_t cap = 0;
+  size_t total = 0;
+
+  uint8_t* alloc(size_t n) {
+    n = (n + 7) & ~size_t(7);
+    if (used + n > cap) {
+      size_t bs = n > (1u << 20) ? n : (1u << 20);
+      blocks.push_back(new uint8_t[bs]);
+      used = 0;
+      cap = bs;
+      total += bs;
+    }
+    uint8_t* p = blocks.back() + used;
+    used += n;
+    return p;
+  }
+  ~Arena() {
+    for (auto* b : blocks) delete[] b;
+  }
+};
+
+static const int kMaxHeight = 12;
+
+struct SkipList {
+  Arena arena;
+  SLNode* head;
+  int max_height = 1;
+  uint64_t rnd = 0x9E3779B97F4A7C15ULL;
+  int64_t count = 0;
+
+  SkipList() {
+    head = alloc_node(kMaxHeight);
+    head->key = nullptr;
+    head->key_len = 0;
+    for (int i = 0; i < kMaxHeight; i++) head->next[i] = nullptr;
+  }
+
+  SLNode* alloc_node(int height) {
+    size_t sz = sizeof(SLNode) + (height - 1) * sizeof(SLNode*);
+    SLNode* n = reinterpret_cast<SLNode*>(arena.alloc(sz));
+    n->height = height;
+    return n;
+  }
+
+  int random_height() {
+    rnd ^= rnd << 13; rnd ^= rnd >> 7; rnd ^= rnd << 17;
+    int h = 1;
+    uint64_t r = rnd;
+    while (h < kMaxHeight && (r & 3) == 0) { h++; r >>= 2; }
+    return h;
+  }
+
+  // <0: a < b (a = node key triple, b = probe)
+  static int cmp(const uint8_t* ak, uint32_t al, uint64_t ainv,
+                 const uint8_t* bk, uint32_t bl, uint64_t binv) {
+    uint32_t m = al < bl ? al : bl;
+    int r = m ? std::memcmp(ak, bk, m) : 0;
+    if (r) return r;
+    if (al != bl) return al < bl ? -1 : 1;
+    if (ainv != binv) return ainv < binv ? -1 : 1;
+    return 0;
+  }
+
+  // First node with node >= probe; fills prev[] when non-null.
+  SLNode* seek_ge(const uint8_t* k, uint32_t kl, uint64_t inv,
+                  SLNode** prev) {
+    SLNode* x = head;
+    int level = max_height - 1;
+    while (true) {
+      SLNode* nxt = x->next[level];
+      bool go_right = nxt && cmp(nxt->key, nxt->key_len, nxt->inv_packed,
+                                 k, kl, inv) < 0;
+      if (go_right) {
+        x = nxt;
+      } else {
+        if (prev) prev[level] = x;
+        if (level == 0) return nxt;
+        level--;
+      }
+    }
+  }
+
+  // Returns 1 on fresh insert, 0 on in-place replace of an exact duplicate.
+  int insert(const uint8_t* k, uint32_t kl, uint64_t inv,
+             const uint8_t* v, uint32_t vl) {
+    SLNode* prev[kMaxHeight];
+    for (int i = 0; i < kMaxHeight; i++) prev[i] = head;
+    SLNode* ge = seek_ge(k, kl, inv, prev);
+    if (ge && cmp(ge->key, ge->key_len, ge->inv_packed, k, kl, inv) == 0) {
+      uint8_t* vcopy = arena.alloc(vl);
+      std::memcpy(vcopy, v, vl);
+      ge->val = vcopy;
+      ge->val_len = vl;
+      return 0;
+    }
+    int h = random_height();
+    if (h > max_height) max_height = h;
+    SLNode* n = alloc_node(h);
+    uint8_t* kcopy = arena.alloc(kl + vl);
+    std::memcpy(kcopy, k, kl);
+    std::memcpy(kcopy + kl, v, vl);
+    n->key = kcopy;
+    n->key_len = kl;
+    n->val = kcopy + kl;
+    n->val_len = vl;
+    n->inv_packed = inv;
+    for (int i = 0; i < h; i++) {
+      n->next[i] = prev[i]->next[i];
+      prev[i]->next[i] = n;
+    }
+    count++;
+    return 1;
+  }
+};
+
+}  // namespace
+
+void* tpulsm_skiplist_new() { return new SkipList(); }
+void tpulsm_skiplist_free(void* h) { delete static_cast<SkipList*>(h); }
+
+int32_t tpulsm_skiplist_insert(void* h, const uint8_t* k, uint32_t kl,
+                               uint64_t inv, const uint8_t* v, uint32_t vl) {
+  return static_cast<SkipList*>(h)->insert(k, kl, inv, v, vl);
+}
+
+int64_t tpulsm_skiplist_count(void* h) {
+  return static_cast<SkipList*>(h)->count;
+}
+
+int64_t tpulsm_skiplist_memory(void* h) {
+  return (int64_t)static_cast<SkipList*>(h)->arena.total;
+}
+
+void* tpulsm_skiplist_seek_ge(void* h, const uint8_t* k, uint32_t kl,
+                              uint64_t inv) {
+  return static_cast<SkipList*>(h)->seek_ge(k, kl, inv, nullptr);
+}
+
+void* tpulsm_skiplist_first(void* h) {
+  return static_cast<SkipList*>(h)->head->next[0];
+}
+
+void* tpulsm_skiplist_next(void* node) {
+  return static_cast<SLNode*>(node)->next[0];
+}
+
+// Last node strictly BEFORE the probe (nullptr if none) — the O(log n)
+// backward step of the iterator protocol.
+void* tpulsm_skiplist_seek_lt(void* h, const uint8_t* k, uint32_t kl,
+                              uint64_t inv) {
+  SkipList* sl = static_cast<SkipList*>(h);
+  SLNode* prev[kMaxHeight];
+  for (int i = 0; i < kMaxHeight; i++) prev[i] = sl->head;
+  sl->seek_ge(k, kl, inv, prev);
+  return prev[0] == sl->head ? nullptr : prev[0];
+}
+
+void* tpulsm_skiplist_last(void* h) {
+  SkipList* sl = static_cast<SkipList*>(h);
+  SLNode* x = sl->head;
+  for (int level = sl->max_height - 1; level >= 0; level--) {
+    while (x->next[level]) x = x->next[level];
+  }
+  return x == sl->head ? nullptr : x;
+}
+
+void tpulsm_skiplist_node(void* node, const uint8_t** k, uint32_t* kl,
+                          uint64_t* inv, const uint8_t** v, uint32_t* vl) {
+  SLNode* n = static_cast<SLNode*>(node);
+  *k = n->key;
+  *kl = n->key_len;
+  *inv = n->inv_packed;
+  *v = n->val;
+  *vl = n->val_len;
 }
 
 }  // extern "C"

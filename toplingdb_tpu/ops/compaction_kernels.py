@@ -304,7 +304,13 @@ def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
     lens[:n] = key_lens
     valid[:n] = True
     snap_hi, snap_lo = _split_snapshots(snapshots)
-    kb = key_buf if len(key_buf) >= 8 else np.zeros(8, dtype=np.uint8)
+    # Pad the raw byte buffer to a pow2 bucket too: otherwise every distinct
+    # total-key-byte count compiles a fresh XLA program (the row count is
+    # already bucketed; the gather clips, so over-length is semantically
+    # safe).
+    blen = _next_pow2(max(8, len(key_buf)))
+    kb = np.zeros(blen, dtype=np.uint8)
+    kb[: len(key_buf)] = key_buf
     order, zero_flags, count, has_complex = _fused_encode_sort_gc_impl(
         kb, offs, lens, valid, snap_hi, snap_lo, w, bool(bottommost),
     )

@@ -28,6 +28,7 @@ class Options:
     compaction_filter: Any = None
 
     # -- write path -----------------------------------------------------
+    memtable_rep: str = "skiplist"       # 'skiplist' (native C++) | 'vector'
     write_buffer_size: int = 4 * 1024 * 1024
     max_write_buffer_number: int = 2
     db_write_buffer_size: int = 0       # 0 = unlimited (WriteBufferManager)
