@@ -252,3 +252,80 @@ def test_wide_column_magic_collision(tmp_db_path):
         e = get_entity(db, b"k")
         # Must fall back to the default-column view, not raise.
         assert e == {DEFAULT_COLUMN: tricky} or DEFAULT_COLUMN not in e
+
+
+def test_multi_get_batched(tmp_db_path):
+    with DB.open(tmp_db_path, opts(write_buffer_size=8 * 1024)) as db:
+        for i in range(2000):
+            db.put(b"key%05d" % (i % 600), b"v%07d" % i)
+        db.flush()
+        db.delete(b"key00005")
+        db.delete_range(b"key00100", b"key00110")
+        keys = [b"key%05d" % k for k in range(0, 600, 7)] + [b"missing", b"key00005", b"key00105"]
+        got = db.multi_get(keys)
+        want = [db.get(k) for k in keys]
+        assert got == want
+        assert db.multi_get([]) == []
+
+
+def test_multi_get_newest_version_across_levels(tmp_db_path):
+    """A key with its newest version in L0 and older versions deeper must not
+    be resolved from the deeper file first."""
+    with DB.open(tmp_db_path, opts(disable_auto_compactions=True)) as db:
+        db.put(b"k", b"old")
+        db.put(b"other", b"x")
+        db.flush()
+        db.compact_range()          # old version now at the bottom level
+        db.put(b"k", b"new")
+        db.flush()                  # new version in L0
+        assert db.multi_get([b"k", b"other"]) == [b"new", b"x"]
+
+
+def test_write_stall_on_l0_pileup(tmp_db_path):
+    with DB.open(tmp_db_path, opts(
+        write_buffer_size=4 * 1024, disable_auto_compactions=True,
+    )) as db:
+        import time
+
+        for r in range(5):
+            for i in range(100):
+                db.put(b"k%05d" % (r * 100 + i), b"x" * 30)
+            db.flush()
+        assert len(db.versions.current.files[0]) >= 5
+        # Stalls are a no-op while compaction is disabled (bulk-load mode).
+        t0 = time.monotonic()
+        db._maybe_stall_writes(timeout=1.0)
+        assert time.monotonic() - t0 < 0.2
+        # Enable compaction and lower the triggers: the stall must hold until
+        # L0 drains below the stop trigger (or the timeout).
+        db.options.level0_slowdown_writes_trigger = 2
+        db.options.level0_stop_writes_trigger = 4
+        db.options.disable_auto_compactions = False
+        t0 = time.monotonic()
+        db._maybe_stall_writes(timeout=3.0)
+        dt = time.monotonic() - t0
+        assert db._max_l0_files() < 4 or dt >= 3.0
+        db.wait_for_compactions()
+
+
+def test_repair_db(tmp_db_path):
+    from toplingdb_tpu.db.repair import repair_db
+
+    with DB.open(tmp_db_path, opts(write_buffer_size=8 * 1024)) as db:
+        for i in range(1500):
+            db.put(b"key%05d" % i, b"v%05d" % i)
+        db.flush()
+        db.put(b"wal-only", b"yes")
+        db._wal.sync()
+        db._closed = True  # crash
+    import os
+
+    # Destroy the MANIFEST entirely.
+    for f in os.listdir(tmp_db_path):
+        if f.startswith("MANIFEST") or f == "CURRENT":
+            os.remove(f"{tmp_db_path}/{f}")
+    report = repair_db(tmp_db_path, opts())
+    assert report["tables_kept"] >= 1
+    with DB.open(tmp_db_path, opts()) as db:
+        assert db.get(b"key00750") == b"v00750"
+        assert db.get(b"wal-only") == b"yes"

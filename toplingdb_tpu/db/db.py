@@ -323,6 +323,8 @@ class DB:
         then publish the sequence."""
         if batch.is_empty():
             return
+        self._check_open()  # fail fast before any stall sleep
+        self._maybe_stall_writes()
         with self._mutex:
             self._check_open()
             if self._bg_error is not None:
@@ -434,6 +436,45 @@ class DB:
     # Read path
     # ==================================================================
 
+    def _probe_memtable(self, mem, key: bytes, snap_seq: int,
+                        ctx: GetContext) -> bool:
+        """One memtable source; returns False when the lookup is complete."""
+        ctx.add_tombstone_seq(mem.covering_tombstone_seq(key, snap_seq))
+        for seq, t, val in mem.entries_for_key(key, snap_seq):
+            if not ctx.save_value(seq, t, val):
+                return False
+        return True
+
+    def _probe_file(self, reader, key: bytes, snap_seq: int, ctx: GetContext,
+                    tombs, it=None) -> tuple[bool, object]:
+        """One SST source; `tombs` is the file's parsed RangeTombstone list;
+        `it` is a reusable iterator for this reader (created on demand).
+        Returns (continue?, iterator)."""
+        ucmp = self.icmp.user_comparator
+        for t in tombs:
+            if ucmp.compare(t.begin, key) <= 0 and ucmp.compare(key, t.end) < 0:
+                ctx.add_tombstone_seq(t.seq)
+        if not reader.key_may_match(key):
+            return True, it
+        if it is None:
+            it = reader.new_iterator()
+        it.seek(dbformat.make_internal_key(
+            key, snap_seq, dbformat.VALUE_TYPE_FOR_SEEK
+        ))
+        while it.valid():
+            uk, seq, t = dbformat.split_internal_key(it.key())
+            if ucmp.compare(uk, key) != 0:
+                break
+            if seq <= snap_seq:
+                if not ctx.save_value(seq, t, it.value()):
+                    return False, it
+            it.next()
+        return True, it
+
+    def _parsed_tombstones(self, reader):
+        return [RangeTombstone.from_table_entry(b, e)
+                for b, e in reader.range_del_entries()]
+
     def get(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
             cf=None) -> bytes | None:
         """Point lookup (reference DBImpl::GetImpl, db_impl.cc:2079).
@@ -450,38 +491,122 @@ class DB:
         )
         # 1. Active memtable, then immutables (newest first).
         for mem in [cfd.mem] + cfd.imm:
-            ctx.add_tombstone_seq(mem.covering_tombstone_seq(key, snap_seq))
-            for seq, t, val in mem.entries_for_key(key, snap_seq):
-                if not ctx.save_value(seq, t, val):
-                    return ctx.result()
+            if not self._probe_memtable(mem, key, snap_seq, ctx):
+                return ctx.result()
         # 2. SST files, newest data first.
         version = self.versions.cf_current(cfd.handle.id)
         for level, f in version.files_for_get(key):
             reader = self.table_cache.get_reader(f.number)
-            for begin_ikey, end_uk in reader.range_del_entries():
-                t = RangeTombstone.from_table_entry(begin_ikey, end_uk)
-                ucmp = self.icmp.user_comparator
-                if ucmp.compare(t.begin, key) <= 0 and ucmp.compare(key, t.end) < 0:
-                    ctx.add_tombstone_seq(t.seq)
-            if not reader.key_may_match(key):
-                continue
-            it = reader.new_iterator()
-            it.seek(dbformat.make_internal_key(
-                key, snap_seq, dbformat.VALUE_TYPE_FOR_SEEK
-            ))
-            while it.valid():
-                uk, seq, t = dbformat.split_internal_key(it.key())
-                if self.icmp.user_comparator.compare(uk, key) != 0:
-                    break
-                if seq <= snap_seq:
-                    if not ctx.save_value(seq, t, it.value()):
-                        return ctx.result()
-                it.next()
+            more, _ = self._probe_file(
+                reader, key, snap_seq, ctx, self._parsed_tombstones(reader)
+            )
+            if not more:
+                return ctx.result()
         ctx.finish()
         return ctx.result()
 
-    def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ) -> list[bytes | None]:
-        return [self.get(k, opts) for k in keys]
+    def _max_l0_files(self) -> int:
+        return max(
+            (len(self.versions.cf_current(cf_id).files[0])
+             for cf_id in self.versions.column_families), default=0,
+        )
+
+    def _maybe_stall_writes(self, timeout: float = 10.0) -> None:
+        """L0 back-pressure (reference WriteController + the
+        level0_slowdown/stop triggers, db_impl_write.cc DelayWrite): past the
+        slowdown trigger writes are delayed; past the stop trigger they block
+        until compaction drains L0 (the worst CF counts — a pileup in any CF
+        throttles). No-op when nothing can drain L0 (auto compaction off /
+        no scheduler): stalling a bulk load forever helps no one."""
+        import time as _time
+
+        opts = self.options
+        if (opts.disable_auto_compactions
+                or self._compaction_scheduler is None):
+            return
+        n_l0 = self._max_l0_files()
+        if n_l0 >= opts.level0_stop_writes_trigger:
+            from toplingdb_tpu.utils import statistics as st
+
+            t0 = _time.monotonic()
+            while (self._max_l0_files() >= opts.level0_stop_writes_trigger
+                   and _time.monotonic() - t0 < timeout
+                   and not self._closed):
+                self._maybe_schedule_compaction()
+                _time.sleep(0.01)
+            stalled = _time.monotonic() - t0
+            if self.stats is not None:
+                self.stats.record_tick(st.STALL_MICROS, int(stalled * 1e6))
+            if stalled >= timeout:
+                self.event_logger.log(
+                    "write_stall_timeout", l0_files=self._max_l0_files(),
+                    stalled_s=round(stalled, 2),
+                )
+        elif n_l0 >= opts.level0_slowdown_writes_trigger:
+            # Proportional delay ramp toward the stop trigger.
+            span = max(1, opts.level0_stop_writes_trigger
+                       - opts.level0_slowdown_writes_trigger)
+            frac = (n_l0 - opts.level0_slowdown_writes_trigger + 1) / span
+            _time.sleep(min(0.05 * frac, 0.05))
+
+    def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,
+                  cf=None) -> list[bytes | None]:
+        """Batched point lookups (reference DBImpl::MultiGet, including the
+        Topling fiber variant db_impl.cc:3026-3227 — our batching analogue
+        groups all keys per source so each memtable/file is visited once,
+        instead of per-key)."""
+        self._check_open()
+        cfd = self._cf_data(cf)
+        snap_seq = (
+            opts.snapshot.sequence if opts.snapshot is not None
+            else self.versions.last_sequence
+        )
+        resolver = self.blob_source.get
+        ctxs = {
+            k: GetContext(k, snap_seq, self.options.merge_operator,
+                          blob_resolver=resolver)
+            for k in keys
+        }
+        live = dict(ctxs)
+        # 1. Memtables: one pass per source for ALL live keys.
+        for mem in [cfd.mem] + cfd.imm:
+            for k in list(live):
+                if not self._probe_memtable(mem, k, snap_seq, live[k]):
+                    del live[k]
+        # 2. SSTs: group keys by candidate file so each reader/iterator is
+        # reused across the batch (the fiber MultiGet's IO-batching effect).
+        version = self.versions.cf_current(cfd.handle.id)
+        if live:
+            per_file: dict[int, list[bytes]] = {}
+            for k in live:
+                for level, f in version.files_for_get(k):
+                    per_file.setdefault(f.number, []).append(k)
+            # Visit files in global level order — L0 newest-first, then each
+            # deeper level — which preserves EVERY key's newest-first source
+            # order (per-key candidates are a subsequence of this walk).
+            file_order = [
+                f for lvl in range(version.num_levels)
+                for f in version.files[lvl] if f.number in per_file
+            ]
+            for f in file_order:
+                todo = [k for k in per_file[f.number] if k in live]
+                if not todo:
+                    continue
+                reader = self.table_cache.get_reader(f.number)
+                tombs = self._parsed_tombstones(reader)  # once per file
+                it = None
+                for k in sorted(todo):
+                    ctx = live.get(k)
+                    if ctx is None:
+                        continue
+                    more, it = self._probe_file(
+                        reader, k, snap_seq, ctx, tombs, it
+                    )
+                    if not more:
+                        del live[k]
+        for ctx in live.values():
+            ctx.finish()
+        return [ctxs[k].result() for k in keys]
 
     def key_exists(self, key: bytes, opts: ReadOptions = _DEFAULT_READ) -> bool:
         return self.get(key, opts) is not None
