@@ -1,0 +1,129 @@
+"""SingleFastTable format + adaptive factory dispatch."""
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.db.dbformat import (
+    BYTEWISE, InternalKeyComparator, ValueType, make_internal_key,
+)
+from toplingdb_tpu.env import MemEnv
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.table.factory import new_table_builder, open_table
+from toplingdb_tpu.table.single_fast import SingleFastTableReader
+from toplingdb_tpu.utils.status import Corruption
+
+ICMP = InternalKeyComparator(BYTEWISE)
+
+
+def build_sf(env, path, n=300, tombstones=()):
+    opts = TableOptions(format="single_fast")
+    w = env.new_writable_file(path)
+    b = new_table_builder(w, ICMP, opts)
+    entries = [
+        (make_internal_key(b"key%05d" % i, i + 1, ValueType.VALUE),
+         b"val%06d" % i)
+        for i in range(n)
+    ]
+    for k, v in entries:
+        b.add(k, v)
+    for begin, end in tombstones:
+        b.add_tombstone(begin, end)
+    props = b.finish()
+    w.close()
+    return entries, props
+
+
+def test_single_fast_roundtrip_and_dispatch():
+    env = MemEnv()
+    entries, props = build_sf(env, "/t.sst")
+    r = open_table(env.new_random_access_file("/t.sst"), ICMP,
+                   TableOptions(format="single_fast"))
+    assert isinstance(r, SingleFastTableReader)  # adaptive magic dispatch
+    assert r.properties.num_entries == 300
+    it = r.new_iterator()
+    it.seek_to_first()
+    assert list(it.entries()) == entries
+
+
+def test_single_fast_seek_prev_bloom():
+    env = MemEnv()
+    entries, _ = build_sf(env, "/t.sst")
+    r = open_table(env.new_random_access_file("/t.sst"), ICMP)
+    it = r.new_iterator()
+    it.seek(make_internal_key(b"key00150", 2**56 - 1, 0x7F))
+    assert it.key() == entries[150][0]
+    it.prev()
+    assert it.key() == entries[149][0]
+    it.seek_to_last()
+    assert it.key() == entries[-1][0]
+    assert r.key_may_match(b"key00001")
+    misses = sum(1 for i in range(1000) if r.key_may_match(b"no%05d" % i))
+    assert misses < 60
+
+
+def test_single_fast_checksum_detects_corruption():
+    env = MemEnv()
+    build_sf(env, "/t.sst")
+    st = env._files["/t.sst"]
+    st.data[40] ^= 0xFF
+    with pytest.raises(Corruption):
+        open_table(env.new_random_access_file("/t.sst"), ICMP)
+
+
+def test_single_fast_range_del_and_anchors():
+    env = MemEnv()
+    begin = make_internal_key(b"key00010", 999, ValueType.RANGE_DELETION)
+    entries, props = build_sf(env, "/t.sst", tombstones=[(begin, b"key00020")])
+    r = open_table(env.new_random_access_file("/t.sst"), ICMP)
+    assert r.range_del_entries() == [(begin, b"key00020")]
+    anchors = r.anchors(8)
+    assert 1 <= len(anchors) <= 8
+
+
+def test_db_with_single_fast_format(tmp_db_path):
+    """Full DB stack on the single_fast format: flush, compaction (the
+    device fast path must fall back), reopen, CFs, deletes."""
+    opts = Options(
+        write_buffer_size=8 * 1024,
+        table_options=TableOptions(format="single_fast"),
+    )
+    with DB.open(tmp_db_path, opts) as db:
+        for i in range(3000):
+            db.put(b"key%05d" % (i % 1000), b"val%07d" % i)
+        db.delete(b"key00007")
+        db.flush()
+        db.compact_range()
+        assert db.get(b"key00007") is None
+        for k in range(0, 1000, 83):
+            if k == 7:
+                continue
+            last = max(i for i in range(k, 3000, 1000))
+            assert db.get(b"key%05d" % k) == b"val%07d" % last
+        it = db.new_iterator()
+        it.seek_to_first()
+        assert sum(1 for _ in it.entries()) == 999
+    with DB.open(tmp_db_path, opts) as db:
+        assert db.get(b"key00500") == b"val%07d" % 2500
+
+
+def test_mixed_formats_in_one_db(tmp_db_path):
+    """Adaptive dispatch: files written as single_fast stay readable after
+    the DB switches to the block format (and vice versa)."""
+    sf = Options(write_buffer_size=8 * 1024,
+                 table_options=TableOptions(format="single_fast"),
+                 disable_auto_compactions=True)
+    with DB.open(tmp_db_path, sf) as db:
+        for i in range(500):
+            db.put(b"sf%04d" % i, b"1")
+        db.flush()
+    blk = Options(write_buffer_size=8 * 1024, disable_auto_compactions=True)
+    with DB.open(tmp_db_path, blk) as db:
+        for i in range(500):
+            db.put(b"bb%04d" % i, b"2")
+        db.flush()
+        assert db.get(b"sf0250") == b"1"   # single_fast file via adaptive open
+        assert db.get(b"bb0250") == b"2"   # block file
+        db.compact_range()                  # merges both formats
+        assert db.get(b"sf0250") == b"1"
+        assert db.get(b"bb0250") == b"2"

@@ -23,7 +23,7 @@ from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone, fragm
 from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
 from toplingdb_tpu.compaction.compaction_iterator import CompactionIterator
 from toplingdb_tpu.compaction.picker import Compaction
-from toplingdb_tpu.table.builder import TableBuilder
+from toplingdb_tpu.table.factory import new_table_builder
 from toplingdb_tpu.table.merging_iterator import MergingIterator
 
 
@@ -106,8 +106,8 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
         nonlocal builder, wfile, fnum
         fnum = new_file_number()
         wfile = env.new_writable_file(filename.table_file_name(dbname, fnum))
-        builder = TableBuilder(wfile, icmp, table_options,
-                               creation_time=creation_time)
+        builder = new_table_builder(wfile, icmp, table_options,
+                                    creation_time=creation_time)
 
     def close_output(pending_tombstones):
         nonlocal builder, wfile, fnum

@@ -134,6 +134,7 @@ class CompactionParams:
     compression: int
     block_size: int
     creation_time: int
+    table_format: str = "block"
     smallest_seqno_guard: int = 0
     device: str = "cpu"
 
@@ -260,6 +261,7 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             block_size=opts.table_options.block_size,
             creation_time=int(time.time()),
             device=self.device,
+            table_format=getattr(opts.table_options, "format", "block"),
         )
         with open(os.path.join(job_dir, "params.json"), "w") as f:
             f.write(params.to_json())

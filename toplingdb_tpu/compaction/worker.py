@@ -34,7 +34,7 @@ def run_job(job_dir: str) -> int:
     from toplingdb_tpu.env import default_env
     from toplingdb_tpu.options import Options
     from toplingdb_tpu.table.builder import TableOptions
-    from toplingdb_tpu.table.reader import TableReader
+    from toplingdb_tpu.table.factory import open_table
     from toplingdb_tpu.utils.compaction_filter import create_compaction_filter
 
     with open(os.path.join(job_dir, "params.json")) as f:
@@ -57,7 +57,8 @@ def run_job(job_dir: str) -> int:
         if params.compaction_filter else None
     )
     topts = TableOptions(
-        block_size=params.block_size, compression=params.compression
+        block_size=params.block_size, compression=params.compression,
+        format=getattr(params, "table_format", "block"),
     )
 
     # Read inputs (raw, unsorted — the device sort is the merge).
@@ -65,7 +66,7 @@ def run_job(job_dir: str) -> int:
     rd = RangeDelAggregator(ucmp)
     readers = []
     for path in params.input_files:
-        r = TableReader(env.new_random_access_file(path), icmp, topts)
+        r = open_table(env.new_random_access_file(path), icmp, topts)
         readers.append(r)
         it = r.new_iterator()
         it.seek_to_first()

@@ -7,7 +7,7 @@ from toplingdb_tpu.db import filename
 from toplingdb_tpu.db.memtable import MemTable
 from toplingdb_tpu.db.range_del import RangeTombstone, fragment_tombstones
 from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
-from toplingdb_tpu.table.builder import TableBuilder
+from toplingdb_tpu.table.factory import new_table_builder
 from toplingdb_tpu.table.merging_iterator import MergingIterator
 
 
@@ -41,7 +41,7 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
     path = filename.table_file_name(dbname, file_number)
     w = env.new_writable_file(path)
     try:
-        builder = TableBuilder(
+        builder = new_table_builder(
             w, icmp, table_options, creation_time=creation_time
         )
         merger = MergingIterator(

@@ -24,7 +24,7 @@ from toplingdb_tpu.db.flush_job import flush_memtable_to_table
 from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
 from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.options import Options
-from toplingdb_tpu.table.reader import TableReader
+from toplingdb_tpu.table.factory import open_table
 
 
 def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
@@ -58,7 +58,7 @@ def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
         max_file_number = max(max_file_number, num)
         path = filename.table_file_name(dbname, num)
         try:
-            r = TableReader(env.new_random_access_file(path), icmp,
+            r = open_table(env.new_random_access_file(path), icmp,
                             options.table_options)
             it = r.new_iterator()
             it.seek_to_first()

@@ -9,7 +9,7 @@ from collections import OrderedDict
 from toplingdb_tpu.db import filename
 from toplingdb_tpu.db.dbformat import InternalKeyComparator
 from toplingdb_tpu.table.builder import TableOptions
-from toplingdb_tpu.table.reader import TableReader
+from toplingdb_tpu.table.factory import open_table
 
 
 class TableCache:
@@ -32,7 +32,7 @@ class TableCache:
                 self._readers.move_to_end(file_number)
                 return r
         path = filename.table_file_name(self._dbname, file_number)
-        r = TableReader(
+        r = open_table(
             self._env.new_random_access_file(path), self._icmp, self._topts,
             block_cache=self._block_cache,
             cache_key_prefix=file_number.to_bytes(8, "little"),

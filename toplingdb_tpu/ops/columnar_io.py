@@ -83,6 +83,8 @@ def scan_table_columnar(reader) -> ColumnarKV:
     lib = native.lib()
     if lib is None:
         raise NotSupported("native library unavailable")
+    if not hasattr(reader, "_index_data"):
+        raise NotSupported("bulk columnar scan requires the block format")
     idx = BlockIter(reader._index_data, reader._icmp.compare)
     idx.seek_to_first()
     handles = [

@@ -366,6 +366,7 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
 
     if (native.lib() is not None
             and compaction_filter is None
+            and getattr(table_options, "format", "block") == "block"
             and icmp.user_comparator.name() == dbformat.BYTEWISE.name()
             and compaction.max_output_file_size >= compaction.total_input_bytes()):
         try:

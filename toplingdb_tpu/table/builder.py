@@ -29,6 +29,7 @@ METAINDEX_RANGE_DEL = b"tpulsm.range_del"
 
 @dataclass
 class TableOptions:
+    format: str = "block"           # 'block' | 'single_fast' (table/factory.py)
     block_size: int = 4096
     restart_interval: int = 16
     index_restart_interval: int = 1

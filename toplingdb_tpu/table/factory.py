@@ -1,0 +1,46 @@
+"""Table format factory + adaptive reader dispatch.
+
+The pluggable-SST seam (reference TableFactory registry,
+table/table_factory.cc:18-40, and the adaptive reader, table/adaptive/ in
+/root/reference): builders are chosen by `TableOptions.format`; readers are
+dispatched by footer magic, so a DB can hold a mix of formats (e.g.
+single_fast at L0/L1, block at L2+) and always open every file.
+"""
+
+from __future__ import annotations
+
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+from toplingdb_tpu.table.reader import TableReader
+from toplingdb_tpu.table.single_fast import (
+    SingleFastTableBuilder,
+    SingleFastTableReader,
+)
+from toplingdb_tpu.utils.status import Corruption, InvalidArgument
+
+FORMATS = ("block", "single_fast")
+
+
+def new_table_builder(wfile, icmp, options: TableOptions | None = None,
+                      **kw):
+    options = options or TableOptions()
+    f = getattr(options, "format", "block")
+    if f == "block":
+        return TableBuilder(wfile, icmp, options, **kw)
+    if f == "single_fast":
+        return SingleFastTableBuilder(wfile, icmp, options, **kw)
+    raise InvalidArgument(f"unknown table format {f!r}")
+
+
+def open_table(rfile, icmp, options: TableOptions | None = None,
+               block_cache=None, cache_key_prefix: bytes = b""):
+    """Adaptive open: dispatch on the footer magic."""
+    size = rfile.size()
+    tail = rfile.read(max(0, size - fmt.FOOTER_LEN), fmt.FOOTER_LEN)
+    magic = fmt.Footer.read_magic(tail)
+    if magic == fmt.MAGIC:
+        return TableReader(rfile, icmp, options, block_cache=block_cache,
+                           cache_key_prefix=cache_key_prefix)
+    if magic == fmt.SINGLE_FAST_MAGIC:
+        return SingleFastTableReader(rfile, icmp, options)
+    raise Corruption(f"unknown SST magic {magic:#x}")

@@ -21,6 +21,7 @@ from toplingdb_tpu.utils import coding, crc32c
 from toplingdb_tpu.utils.status import Corruption, NotSupported
 
 MAGIC = 0x7470756C736D5354  # "tpulsmST" big-endian spelling, stored fixed64 LE
+SINGLE_FAST_MAGIC = 0x7470756C736D4654  # "tpulsmFT": the flat L0/L1 format
 FOOTER_VERSION = 1
 BLOCK_TRAILER_SIZE = 5  # type byte + crc32
 MAX_HANDLE_LEN = 20     # two varint64s
@@ -63,6 +64,7 @@ class Footer:
     index_handle: BlockHandle
     checksum_type: int = CHECKSUM_CRC32C
     version: int = FOOTER_VERSION
+    magic: int = MAGIC
 
     def encode(self) -> bytes:
         out = bytearray()
@@ -71,23 +73,30 @@ class Footer:
         out += self.index_handle.encode()
         out += b"\x00" * (1 + 2 * MAX_HANDLE_LEN - len(out))
         out += coding.encode_fixed32(self.version)
-        out += coding.encode_fixed64(MAGIC)
+        out += coding.encode_fixed64(self.magic)
         assert len(out) == FOOTER_LEN
         return bytes(out)
 
     @staticmethod
-    def decode(buf: bytes) -> "Footer":
+    def read_magic(buf: bytes) -> int:
+        """Format dispatch (the reference's adaptive table, table/adaptive/)."""
+        if len(buf) < FOOTER_LEN:
+            raise Corruption("footer too short")
+        return coding.decode_fixed64(buf, len(buf) - 8)
+
+    @staticmethod
+    def decode(buf: bytes, expected_magic: int = MAGIC) -> "Footer":
         if len(buf) < FOOTER_LEN:
             raise Corruption("footer too short")
         tail = buf[-FOOTER_LEN:]
         magic = coding.decode_fixed64(tail, FOOTER_LEN - 8)
-        if magic != MAGIC:
+        if magic != expected_magic:
             raise Corruption(f"bad SST magic: {magic:#x}")
         version = coding.decode_fixed32(tail, FOOTER_LEN - 12)
         checksum_type = tail[0]
         mih, off = BlockHandle.decode(tail, 1)
         ih, _ = BlockHandle.decode(tail, off)
-        return Footer(mih, ih, checksum_type, version)
+        return Footer(mih, ih, checksum_type, version, magic)
 
 
 def compress(data: bytes, ctype: int) -> bytes:

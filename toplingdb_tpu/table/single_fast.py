@@ -1,0 +1,338 @@
+"""SingleFastTable: the flat, all-in-RAM SST format for hot levels.
+
+The analogue of the reference's Topling SingleFastTable (the L0/L1 format of
+the absent topling-sst submodule; README.md:50 claims it as a headline) and
+of PlainTable (table/plain/): no blocks, no prefix compression — entries are
+a flat [varint klen | varint vlen | ikey | value] region, the index is a raw
+fixed32 offset array, and the reader holds the whole file in memory, so a
+point lookup is a pure binary search (no per-block linear scan) and a scan
+is a linear decode. Shares the bloom filter / properties / range-del meta
+blocks and the footer shape with the block format; dispatched by footer
+magic (table/factory.py — the adaptive-table mechanism).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.block import BlockBuilder, BlockIter
+from toplingdb_tpu.table.builder import (
+    METAINDEX_FILTER,
+    METAINDEX_PROPERTIES,
+    METAINDEX_RANGE_DEL,
+    TableOptions,
+)
+from toplingdb_tpu.table.filter import filter_policy_from_name
+from toplingdb_tpu.table.properties import TableProperties
+from toplingdb_tpu.utils import coding, crc32c
+from toplingdb_tpu.utils.status import Corruption
+
+METAINDEX_DATA_CRC = b"tpulsm.sf.data_crc"
+
+
+class SingleFastTableBuilder:
+    """Same surface as TableBuilder (build_outputs/flush compatible)."""
+
+    def __init__(self, wfile, icmp: InternalKeyComparator,
+                 options: TableOptions | None = None,
+                 column_family_id: int = 0, creation_time: int = 0):
+        self.opts = options or TableOptions()
+        self._w = wfile
+        self._icmp = icmp
+        self._buf = bytearray()
+        self._offsets: list[int] = []
+        self._filter_keys: list[bytes] = []
+        self._range_del_block = BlockBuilder(restart_interval=1)
+        self.props = TableProperties(
+            comparator_name=icmp.user_comparator.name(),
+            filter_policy_name=(
+                self.opts.filter_policy.name() if self.opts.filter_policy else ""
+            ),
+            compression_name="single_fast",
+            column_family_id=column_family_id,
+            creation_time=creation_time,
+            smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
+        )
+        self._last_key: bytes | None = None
+        self._smallest: bytes | None = None
+        self._largest: bytes | None = None
+        self._finished = False
+
+    @property
+    def num_entries(self) -> int:
+        return self.props.num_entries + self.props.num_range_deletions
+
+    def file_size(self) -> int:
+        return self._w.file_size() + len(self._buf)
+
+    @property
+    def smallest_key(self) -> bytes | None:
+        return self._smallest
+
+    @property
+    def largest_key(self) -> bytes | None:
+        return self._largest
+
+    def _track_bounds(self, ikey: bytes) -> None:
+        if self._smallest is None or self._icmp.compare(ikey, self._smallest) < 0:
+            self._smallest = ikey
+        if self._largest is None or self._icmp.compare(ikey, self._largest) > 0:
+            self._largest = ikey
+        seq = dbformat.extract_seqno(ikey)
+        self.props.smallest_seqno = min(self.props.smallest_seqno, seq)
+        self.props.largest_seqno = max(self.props.largest_seqno, seq)
+
+    def add(self, ikey: bytes, value: bytes) -> None:
+        assert not self._finished
+        if self._last_key is not None:
+            assert self._icmp.compare(self._last_key, ikey) < 0
+        if len(self._buf) + len(ikey) + len(value) + 10 > 0xFFFFFF00:
+            # Offsets are fixed32: refuse before appending (no torn region)
+            # rather than overflow into a corrupt index at finish().
+            from toplingdb_tpu.utils.status import NotSupported
+
+            raise NotSupported(
+                "single_fast table data region exceeds 4GiB; use the block "
+                "format or a smaller max_output_file_size"
+            )
+        self._offsets.append(len(self._buf))
+        self._buf += coding.encode_varint32(len(ikey))
+        self._buf += coding.encode_varint32(len(value))
+        self._buf += ikey
+        self._buf += value
+        self._last_key = ikey
+        self._track_bounds(ikey)
+        uk, _, t = dbformat.split_internal_key(ikey)
+        if self.opts.filter_policy and self.opts.whole_key_filtering:
+            self._filter_keys.append(uk)
+        self.props.num_entries += 1
+        self.props.raw_key_size += len(ikey)
+        self.props.raw_value_size += len(value)
+        if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
+            self.props.num_deletions += 1
+        elif t == ValueType.MERGE:
+            self.props.num_merge_operands += 1
+
+    def add_tombstone(self, begin_ikey: bytes, end_user_key: bytes) -> None:
+        assert not self._finished
+        self._range_del_block.add(begin_ikey, end_user_key)
+        self.props.num_range_deletions += 1
+        self._track_bounds(begin_ikey)
+        end_ikey = dbformat.make_internal_key(
+            end_user_key, dbformat.MAX_SEQUENCE_NUMBER,
+            dbformat.VALUE_TYPE_FOR_SEEK,
+        )
+        if self._largest is None or self._icmp.compare(end_ikey, self._largest) > 0:
+            self._largest = end_ikey
+
+    def finish(self) -> TableProperties:
+        assert not self._finished
+        data = bytes(self._buf)
+        self._w.append(data)  # flat data region at offset 0, unframed
+        self.props.data_size = len(data)
+        self.props.num_data_blocks = 1
+
+        metaindex = BlockBuilder(restart_interval=1)
+        meta_entries = []
+        # Whole-region checksum (entries have no per-block trailers).
+        crc = crc32c.mask(crc32c.value(data))
+        ch = fmt.write_block(self._w, coding.encode_fixed32(crc),
+                             fmt.NO_COMPRESSION)
+        meta_entries.append((METAINDEX_DATA_CRC, ch))
+
+        if self.opts.filter_policy and self._filter_keys:
+            fdata = self.opts.filter_policy.create_filter(self._filter_keys)
+            fh = fmt.write_block(self._w, fdata, fmt.NO_COMPRESSION)
+            self.props.filter_size = len(fdata)
+            meta_entries.append((METAINDEX_FILTER, fh))
+        if not self._range_del_block.empty():
+            rh = fmt.write_block(self._w, self._range_del_block.finish(),
+                                 fmt.NO_COMPRESSION)
+            meta_entries.append((METAINDEX_RANGE_DEL, rh))
+
+        # Raw fixed32 offset array as the "index block".
+        iraw = np.asarray(self._offsets, dtype="<u4").tobytes()
+        self.props.index_size = len(iraw)
+
+        pblock = self.props.encode_block()
+        ph = fmt.write_block(self._w, pblock, fmt.NO_COMPRESSION)
+        meta_entries.append((METAINDEX_PROPERTIES, ph))
+        for name, handle in sorted(meta_entries):
+            metaindex.add(name, handle.encode())
+        mih = fmt.write_block(self._w, metaindex.finish(), fmt.NO_COMPRESSION)
+        ih = fmt.write_block(self._w, iraw, fmt.NO_COMPRESSION)
+        self._w.append(fmt.Footer(mih, ih, magic=fmt.SINGLE_FAST_MAGIC).encode())
+        self._w.flush()
+        self._finished = True
+        return self.props
+
+
+class SingleFastTableReader:
+    """Same surface as TableReader. The whole file is resident in memory."""
+
+    def __init__(self, rfile, icmp: InternalKeyComparator,
+                 options: TableOptions | None = None, block_cache=None,
+                 cache_key_prefix: bytes = b""):
+        self.opts = options or TableOptions()
+        self._icmp = icmp
+        size = rfile.size()
+        self._data = rfile.read(0, size)
+        rfile.close()
+        self.footer = fmt.Footer.decode(self._data, fmt.SINGLE_FAST_MAGIC)
+        iraw = fmt.read_block(_Mem(self._data), self.footer.index_handle,
+                              self.opts.verify_checksums)
+        self._offsets = np.frombuffer(iraw, dtype="<u4")
+        meta = fmt.read_block(_Mem(self._data), self.footer.metaindex_handle,
+                              self.opts.verify_checksums)
+        mit = BlockIter(meta, dbformat.BYTEWISE.compare)
+        mit.seek_to_first()
+        self._meta_handles = {
+            k: fmt.BlockHandle.decode_exact(v) for k, v in mit.entries()
+        }
+        self.properties = TableProperties()
+        ph = self._meta_handles.get(METAINDEX_PROPERTIES)
+        if ph is not None:
+            self.properties = TableProperties.decode_block(
+                fmt.read_block(_Mem(self._data), ph, self.opts.verify_checksums)
+            )
+        if self.opts.verify_checksums:
+            ch = self._meta_handles.get(METAINDEX_DATA_CRC)
+            if ch is not None:
+                stored = crc32c.unmask(coding.decode_fixed32(
+                    fmt.read_block(_Mem(self._data), ch, True), 0
+                ))
+                data_len = self.properties.data_size
+                if crc32c.value(self._data[:data_len]) != stored:
+                    raise Corruption("single_fast data region checksum mismatch")
+        self._filter_data = None
+        self._filter_policy = None
+        fh = self._meta_handles.get(METAINDEX_FILTER)
+        if fh is not None:
+            self._filter_data = fmt.read_block(
+                _Mem(self._data), fh, self.opts.verify_checksums
+            )
+            self._filter_policy = filter_policy_from_name(
+                self.properties.filter_policy_name
+            )
+        self._range_del_cache = None
+        rh = self._meta_handles.get(METAINDEX_RANGE_DEL)
+        self._range_del_data = (
+            fmt.read_block(_Mem(self._data), rh, self.opts.verify_checksums)
+            if rh is not None else None
+        )
+        self.n = len(self._offsets)
+
+    # -- entry decode ---------------------------------------------------
+
+    def _entry(self, i: int) -> tuple[bytes, bytes]:
+        off = int(self._offsets[i])
+        klen, off = coding.decode_varint32(self._data, off)
+        vlen, off = coding.decode_varint32(self._data, off)
+        k = self._data[off : off + klen]
+        v = self._data[off + klen : off + klen + vlen]
+        return k, v
+
+    def _lower_bound(self, target: bytes) -> int:
+        lo, hi = 0, self.n
+        cmp = self._icmp.compare
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if cmp(self._entry(mid)[0], target) < 0:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    # -- TableReader surface -------------------------------------------
+
+    def close(self) -> None:
+        pass
+
+    def key_may_match(self, user_key: bytes) -> bool:
+        if self._filter_policy is None or self._filter_data is None:
+            return True
+        return self._filter_policy.key_may_match(user_key, self._filter_data)
+
+    def new_iterator(self) -> "SingleFastIterator":
+        return SingleFastIterator(self)
+
+    def range_del_entries(self):
+        if self._range_del_data is None:
+            return []
+        if self._range_del_cache is None:
+            it = BlockIter(self._range_del_data, self._icmp.compare)
+            it.seek_to_first()
+            self._range_del_cache = list(it.entries())
+        return self._range_del_cache
+
+    def approximate_offset_of(self, ikey: bytes) -> int:
+        i = self._lower_bound(ikey)
+        return int(self._offsets[i]) if i < self.n else self.properties.data_size
+
+    def anchors(self, max_anchors: int = 32):
+        if self.n == 0:
+            return []
+        step = max(1, self.n // max_anchors)
+        return [self._entry(i)[0] for i in range(0, self.n, step)][:max_anchors]
+
+
+class _Mem:
+    """RandomAccessFile view over an in-memory bytes object."""
+
+    def __init__(self, data: bytes):
+        self._d = data
+
+    def read(self, offset: int, n: int) -> bytes:
+        return self._d[offset : offset + n]
+
+    def size(self) -> int:
+        return len(self._d)
+
+
+class SingleFastIterator:
+    def __init__(self, r: SingleFastTableReader):
+        self._r = r
+        self._i = r.n  # invalid
+
+    def valid(self) -> bool:
+        return 0 <= self._i < self._r.n
+
+    def key(self) -> bytes:
+        return self._r._entry(self._i)[0]
+
+    def value(self) -> bytes:
+        return self._r._entry(self._i)[1]
+
+    def seek_to_first(self) -> None:
+        self._i = 0
+
+    def seek_to_last(self) -> None:
+        self._i = self._r.n - 1
+
+    def seek(self, target: bytes) -> None:
+        self._i = self._r._lower_bound(target)
+
+    def seek_for_prev(self, target: bytes) -> None:
+        i = self._r._lower_bound(target)
+        if i < self._r.n and self._r._icmp.compare(
+            self._r._entry(i)[0], target
+        ) == 0:
+            self._i = i
+        else:
+            self._i = i - 1
+
+    def next(self) -> None:
+        assert self.valid()
+        self._i += 1
+
+    def prev(self) -> None:
+        assert self.valid()
+        self._i -= 1
+
+    def entries(self):
+        while self.valid():
+            yield self.key(), self.value()
+            self.next()

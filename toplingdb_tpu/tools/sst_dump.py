@@ -11,7 +11,7 @@ import argparse
 
 from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType, split_internal_key
 from toplingdb_tpu.env import default_env
-from toplingdb_tpu.table.reader import TableReader
+from toplingdb_tpu.table.factory import open_table
 
 _TYPE_NAMES = {
     int(ValueType.VALUE): "PUT",
@@ -31,7 +31,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     env = default_env()
-    r = TableReader(env.new_random_access_file(args.file), InternalKeyComparator())
+    r = open_table(env.new_random_access_file(args.file), InternalKeyComparator())
     p = r.properties
     if args.command == "props":
         for f in p._INT_FIELDS:

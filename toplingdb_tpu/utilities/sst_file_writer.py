@@ -14,8 +14,8 @@ from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
 from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
 from toplingdb_tpu.env import default_env
 from toplingdb_tpu.options import Options
-from toplingdb_tpu.table.builder import TableBuilder, TableOptions
-from toplingdb_tpu.table.reader import TableReader
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.table.factory import new_table_builder, open_table
 from toplingdb_tpu.utils.status import InvalidArgument
 
 
@@ -27,7 +27,7 @@ class SstFileWriter:
     def __init__(self, options: Options | None = None):
         self.options = options or Options()
         self.icmp = InternalKeyComparator(self.options.comparator)
-        self._builder: TableBuilder | None = None
+        self._builder = None
         self._wfile = None
         self._path = None
         self._last_user_key: bytes | None = None
@@ -35,7 +35,7 @@ class SstFileWriter:
     def open(self, path: str) -> None:
         self._path = path
         self._wfile = default_env().new_writable_file(path)
-        self._builder = TableBuilder(
+        self._builder = new_table_builder(
             self._wfile, self.icmp, self.options.table_options
         )
 
@@ -78,7 +78,7 @@ class SstFileReader:
     def __init__(self, path: str, options: Options | None = None):
         self.options = options or Options()
         icmp = InternalKeyComparator(self.options.comparator)
-        self._reader = TableReader(
+        self._reader = open_table(
             default_env().new_random_access_file(path), icmp,
             self.options.table_options,
         )
@@ -102,7 +102,7 @@ def ingest_external_file(db, external_path: str, move: bool = False) -> int:
     level. The file's entries must not overlap the memtable (flushed first
     if they do)."""
     opts = db.options
-    reader = TableReader(
+    reader = open_table(
         db.env.new_random_access_file(external_path), db.icmp,
         opts.table_options,
     )
@@ -121,7 +121,7 @@ def ingest_external_file(db, external_path: str, move: bool = False) -> int:
         fnum = db.versions.new_file_number()
         dst = filename.table_file_name(db.dbname, fnum)
         w = db.env.new_writable_file(dst)
-        b = TableBuilder(w, db.icmp, opts.table_options)
+        b = new_table_builder(w, db.icmp, opts.table_options)
         it.seek_to_first()
         for ikey, v in it.entries():
             uk, _, t = dbformat.split_internal_key(ikey)
