@@ -6,15 +6,26 @@ in-process — SURVEY.md §4). These env vars must be set before jax imports.
 """
 
 import os
+import sys
 
 # Force the CPU backend: the axon (TPU) sitecustomize bootstrap sets
-# JAX_PLATFORMS=axon before pytest starts, so setdefault would be a no-op.
+# JAX_PLATFORMS=axon before pytest starts, so setdefault would be a no-op —
+# and it may ALSO have imported jax already, in which case the env var was
+# captured at import time and only jax.config can redirect the platform.
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+if "jax" in sys.modules:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 import pytest  # noqa: E402
 

@@ -329,3 +329,213 @@ def test_repair_db(tmp_db_path):
     with DB.open(tmp_db_path, opts()) as db:
         assert db.get(b"key00750") == b"v00750"
         assert db.get(b"wal-only") == b"yes"
+
+
+def test_group_commit_concurrent_writers(tmp_db_path):
+    """Many threads write concurrently; the leader/follower protocol must
+    apply every batch exactly once with distinct sequences (reference
+    WriteThread::JoinBatchGroup semantics)."""
+    import threading
+
+    n_threads, per_thread = 8, 50
+    with DB.open(tmp_db_path, opts(write_buffer_size=1 << 20)) as db:
+        errs = []
+
+        def writer(tid):
+            try:
+                for i in range(per_thread):
+                    db.put(f"t{tid:02d}-{i:04d}".encode(), f"v{tid}.{i}".encode())
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert db.versions.last_sequence == n_threads * per_thread
+        for tid in range(n_threads):
+            for i in range(per_thread):
+                assert db.get(f"t{tid:02d}-{i:04d}".encode()) == \
+                    f"v{tid}.{i}".encode()
+    # Durability: every write must be replayable from the merged WAL records.
+    with DB.open(tmp_db_path, opts()) as db:
+        assert db.get(b"t00-0000") == b"v0.0"
+        assert db.get(b"t07-0049") == b"v7.49"
+
+
+def test_group_commit_merges_queued_followers(tmp_db_path):
+    """While the leader is stuck inside the WAL append, followers queue up;
+    the next leader must commit them as ONE merged WAL record."""
+    import threading
+    import time
+
+    with DB.open(tmp_db_path, opts()) as db:
+        wal = db._wal
+        real_add = wal.add_record
+        records = []
+        gate = threading.Event()
+
+        def slow_add(data):
+            records.append(data)
+            if len(records) == 1:
+                gate.wait(5.0)  # hold the leader so followers pile up
+            real_add(data)
+
+        wal.add_record = slow_add
+        t0 = threading.Thread(target=db.put, args=(b"lead", b"0"))
+        t0.start()
+        while not records:
+            time.sleep(0.001)
+        followers = [
+            threading.Thread(target=db.put, args=(f"f{i}".encode(), b"x"))
+            for i in range(4)
+        ]
+        for t in followers:
+            t.start()
+        time.sleep(0.05)  # let followers enqueue behind the stuck leader
+        gate.set()
+        t0.join()
+        for t in followers:
+            t.join()
+        # Leader's record + one merged record for the queued followers.
+        assert len(records) == 2
+        from toplingdb_tpu.db.write_batch import WriteBatch
+
+        merged = WriteBatch(records[1])
+        assert merged.count() == 4
+        for i in range(4):
+            assert db.get(f"f{i}".encode()) == b"x"
+
+
+def _blob_files(db):
+    from toplingdb_tpu.db import filename as fn
+
+    return sorted(
+        num for child in db.env.get_children(db.dbname)
+        for t, num in [fn.parse_file_name(child)] if t == fn.FileType.BLOB
+    )
+
+
+def test_blob_refs_tracked_and_unreferenced_blob_deleted(tmp_db_path):
+    """FileMetaData.blob_refs keeps referenced blob files alive; once every
+    referencing SST is compacted away, the blob file is GC'd."""
+    o = opts(enable_blob_files=True, min_blob_size=10,
+             disable_auto_compactions=True)
+    with DB.open(tmp_db_path, o) as db:
+        db.put(b"k1", b"B" * 100)
+        db.flush()
+        assert db.versions.current.files[0][0].blob_refs, \
+            "flush must record the blob ref"
+        assert len(_blob_files(db)) == 1
+        # Overwrite with a small value, then compact to the bottom: the old
+        # blob entry is superseded, no SST references the blob file anymore.
+        db.put(b"k1", b"small")
+        db.flush()
+        db.compact_range()
+        assert db.get(b"k1") == b"small"
+        assert _blob_files(db) == [], "unreferenced blob file must be deleted"
+    with DB.open(tmp_db_path, o) as db:
+        assert db.get(b"k1") == b"small"
+
+
+def test_blob_refs_survive_reopen_and_passthrough_compaction(tmp_db_path):
+    o = opts(enable_blob_files=True, min_blob_size=10,
+             disable_auto_compactions=True)
+    with DB.open(tmp_db_path, o) as db:
+        for i in range(5):
+            db.put(f"k{i}".encode(), f"V{i}".encode() * 20)
+        db.flush()
+        refs0 = db.versions.current.files[0][0].blob_refs
+        assert refs0
+    with DB.open(tmp_db_path, o) as db:  # MANIFEST round-trip
+        assert db.versions.current.files[0][0].blob_refs == refs0
+        db.compact_range()  # passthrough: output SST must carry the refs
+        files = [f for lvl in db.versions.current.files for f in lvl]
+        assert len(files) == 1
+        assert files[0].blob_refs == refs0
+        assert len(_blob_files(db)) == 1
+        for i in range(5):
+            assert db.get(f"k{i}".encode()) == f"V{i}".encode() * 20
+
+
+def test_blob_garbage_collection_rewrites_old_files(tmp_db_path):
+    """With GC enabled at cutoff 1.0, compaction rewrites every surviving
+    blob out of the aged files, which are then deleted."""
+    o = opts(enable_blob_files=True, min_blob_size=10,
+             enable_blob_garbage_collection=True,
+             blob_garbage_collection_age_cutoff=1.0,
+             disable_auto_compactions=True)
+    with DB.open(tmp_db_path, o) as db:
+        for i in range(4):
+            db.put(f"a{i}".encode(), f"X{i}".encode() * 30)
+        db.flush()
+        for i in range(4):
+            db.put(f"b{i}".encode(), f"Y{i}".encode() * 30)
+        db.flush()
+        old = _blob_files(db)
+        assert len(old) == 2
+        db.compact_range()
+        new = _blob_files(db)
+        assert len(new) == 1 and new[0] not in old, \
+            "survivors must move to ONE fresh blob file; aged files deleted"
+        for i in range(4):
+            assert db.get(f"a{i}".encode()) == f"X{i}".encode() * 30
+            assert db.get(f"b{i}".encode()) == f"Y{i}".encode() * 30
+    with DB.open(tmp_db_path, o) as db:
+        assert db.get(b"a0") == b"X0" * 30
+
+
+def test_blob_gc_inlines_small_survivors(tmp_db_path):
+    """A GC'd blob whose value now sits under min_blob_size is inlined back
+    into the SST (type flips BLOB_INDEX → VALUE)."""
+    o = opts(enable_blob_files=True, min_blob_size=10,
+             disable_auto_compactions=True)
+    with DB.open(tmp_db_path, o) as db:
+        db.put(b"k", b"Z" * 50)
+        db.flush()
+    # Reopen with a bigger min_blob_size: at GC time the 50B value is below
+    # the new 100B threshold, so it must be inlined.
+    o2 = opts(enable_blob_files=True, min_blob_size=100,
+              enable_blob_garbage_collection=True,
+              blob_garbage_collection_age_cutoff=1.0,
+              disable_auto_compactions=True)
+    with DB.open(tmp_db_path, o2) as db:
+        db.compact_range()
+        assert db.get(b"k") == b"Z" * 50
+        assert _blob_files(db) == []
+        files = [f for lvl in db.versions.current.files for f in lvl]
+        assert all(not f.blob_refs for f in files)
+
+
+def test_repair_db_multi_cf(tmp_db_path):
+    """Repair reconstructs column families from table properties and WAL
+    CF-prefixed records (reference db/repair.cc keeps CFs too)."""
+    import os
+
+    from toplingdb_tpu.db.repair import repair_db
+
+    with DB.open(tmp_db_path, opts()) as db:
+        cf = db.create_column_family("meta")
+        db.put(b"dk", b"dv")
+        db.put(b"mk", b"mv", cf=cf)
+        db.flush()
+        db.put(b"wal-d", b"1")
+        db.put(b"wal-m", b"2", cf=cf)
+        db._wal.sync()
+        db._closed = True  # crash
+    for f in os.listdir(tmp_db_path):
+        if f.startswith("MANIFEST") or f == "CURRENT":
+            os.remove(f"{tmp_db_path}/{f}")
+    report = repair_db(tmp_db_path, opts())
+    assert "meta" in report["column_families"].values()
+    with DB.open(tmp_db_path, opts()) as db:
+        cf = db.get_column_family("meta")
+        assert cf is not None
+        assert db.get(b"dk") == b"dv"
+        assert db.get(b"mk", cf=cf) == b"mv"
+        assert db.get(b"wal-d") == b"1"
+        assert db.get(b"wal-m", cf=cf) == b"2"
+        assert db.get(b"mk") is None, "CF data must not leak into default"

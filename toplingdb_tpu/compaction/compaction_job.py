@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass
 
 from toplingdb_tpu.db import dbformat, filename
+from toplingdb_tpu.db.blob import decode_blob_index
 from toplingdb_tpu.db.level_iterator import LevelIterator
 from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone, fragment_tombstones
 from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
@@ -94,20 +95,26 @@ def surviving_tombstone_fragments(rd: RangeDelAggregator, snapshots: list[int],
 def build_outputs(env, dbname: str, icmp, compaction: Compaction,
                   entries_iter, surviving_tombstones, new_file_number,
                   table_options, stats: CompactionStats,
-                  creation_time: int) -> list[FileMetaData]:
+                  creation_time: int,
+                  column_family: tuple[int, str] = (0, "default"),
+                  ) -> list[FileMetaData]:
     """Cut the survivor stream into output tables (reference
     CompactionOutputs / SubcompactionState::AddToOutput)."""
     outputs: list[FileMetaData] = []
     builder = None
     wfile = None
     fnum = None
+    blob_refs: set[int] = set()
 
     def open_output():
         nonlocal builder, wfile, fnum
         fnum = new_file_number()
         wfile = env.new_writable_file(filename.table_file_name(dbname, fnum))
         builder = new_table_builder(wfile, icmp, table_options,
-                                    creation_time=creation_time)
+                                    creation_time=creation_time,
+                                    column_family_id=column_family[0],
+                                    column_family_name=column_family[1])
+        blob_refs.clear()
 
     def close_output(pending_tombstones):
         nonlocal builder, wfile, fnum
@@ -135,6 +142,7 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
             num_entries=props.num_entries,
             num_deletions=props.num_deletions,
             num_range_deletions=props.num_range_deletions,
+            blob_refs=sorted(blob_refs),
         )
         outputs.append(meta)
         stats.output_bytes += meta.file_size
@@ -160,6 +168,8 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
             close_output([])
             open_output()
         builder.add(ikey, value)
+        if ikey[-8] == dbformat.ValueType.BLOB_INDEX:
+            blob_refs.add(decode_blob_index(value)[0])
         stats.output_records += 1
         last_user_key = uk
     if surviving_tombstones and builder is None:
@@ -172,9 +182,11 @@ def run_compaction_to_tables(
     env, dbname: str, icmp, compaction: Compaction, table_cache,
     table_options, snapshots: list[int], merge_operator=None,
     compaction_filter=None, new_file_number=None, creation_time=None,
-    blob_resolver=None,
+    blob_resolver=None, blob_gc=None, column_family: tuple[int, str] = (0, "default"),
 ) -> tuple[list[FileMetaData], CompactionStats]:
-    """The CPU data plane: heap merge → CompactionIterator GC → outputs."""
+    """The CPU data plane: heap merge → CompactionIterator GC → outputs.
+    `blob_gc` is an optional BlobGarbageCollector rewriting survivors out of
+    aged blob files (reference blob GC during compaction)."""
     t0 = time.time()
     stats = CompactionStats()
     stats.input_bytes = compaction.total_input_bytes()
@@ -193,11 +205,22 @@ def run_compaction_to_tables(
     tombs = surviving_tombstone_fragments(
         rd, snapshots, compaction.bottommost, icmp.user_comparator
     )
-    outputs = build_outputs(
-        env, dbname, icmp, compaction, ci.entries(), tombs,
-        new_file_number, table_options, stats,
-        creation_time if creation_time is not None else int(time.time()),
-    )
+    stream = ci.entries()
+    if blob_gc is not None and blob_gc.active:
+        stream = blob_gc.rewrite(stream)
+    try:
+        outputs = build_outputs(
+            env, dbname, icmp, compaction, stream, tombs,
+            new_file_number, table_options, stats,
+            creation_time if creation_time is not None else int(time.time()),
+            column_family=column_family,
+        )
+    except BaseException:
+        if blob_gc is not None:
+            blob_gc.abort()
+        raise
+    if blob_gc is not None:
+        blob_gc.finish()
     stats.input_records = ci.num_input_records
     stats.dropped_obsolete = ci.num_dropped_obsolete
     stats.dropped_tombstone = ci.num_dropped_tombstone

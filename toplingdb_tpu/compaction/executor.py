@@ -74,6 +74,7 @@ class DeviceCompactionExecutor(CompactionExecutor):
         self.device = device
 
     def execute(self, db, compaction, snapshots, new_file_number):
+        from toplingdb_tpu.db.blob import maybe_new_blob_gc
         from toplingdb_tpu.ops.device_compaction import run_device_compaction
 
         return run_device_compaction(
@@ -84,6 +85,8 @@ class DeviceCompactionExecutor(CompactionExecutor):
             new_file_number=new_file_number,
             device_name=self.device,
             blob_resolver=db.blob_source.get,
+            blob_gc=maybe_new_blob_gc(db, compaction, new_file_number),
+            column_family=(compaction.cf_id, db.cf_name(compaction.cf_id)),
         )
 
 
@@ -137,6 +140,8 @@ class CompactionParams:
     table_format: str = "block"
     smallest_seqno_guard: int = 0
     device: str = "cpu"
+    cf_id: int = 0
+    cf_name: str = "default"
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=1)
@@ -176,6 +181,7 @@ def encode_file_meta(meta: FileMetaData, path: str) -> dict:
         "num_entries": meta.num_entries,
         "num_deletions": meta.num_deletions,
         "num_range_deletions": meta.num_range_deletions,
+        "blob_refs": list(meta.blob_refs),
     }
 
 
@@ -190,6 +196,7 @@ def decode_file_meta(d: dict, number: int) -> FileMetaData:
         num_entries=d["num_entries"],
         num_deletions=d["num_deletions"],
         num_range_deletions=d["num_range_deletions"],
+        blob_refs=list(d.get("blob_refs", [])),
     )
 
 
@@ -262,6 +269,8 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             creation_time=int(time.time()),
             device=self.device,
             table_format=getattr(opts.table_options, "format", "block"),
+            cf_id=compaction.cf_id,
+            cf_name=db.cf_name(compaction.cf_id),
         )
         with open(os.path.join(job_dir, "params.json"), "w") as f:
             f.write(params.to_json())

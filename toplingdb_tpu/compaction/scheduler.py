@@ -202,6 +202,8 @@ class CompactionScheduler:
                 db._pending_outputs.difference_update(pending)
 
     def _run_local(self, c: Compaction, snapshots, alloc):
+        from toplingdb_tpu.db.blob import maybe_new_blob_gc
+
         db = self.db
         return run_compaction_to_tables(
             db.env, db.dbname, db.icmp, c, db.table_cache,
@@ -210,6 +212,8 @@ class CompactionScheduler:
             compaction_filter=db.options.compaction_filter,
             new_file_number=alloc,
             blob_resolver=db.blob_source.get,
+            blob_gc=maybe_new_blob_gc(db, c, alloc),
+            column_family=(c.cf_id, db.cf_name(c.cf_id)),
         )
 
     # ------------------------------------------------------------------
@@ -226,6 +230,10 @@ class CompactionScheduler:
         finally:
             with self._lock:
                 self._manual_active = False
+        # The per-level loop's frame pinned the previous Version (weak-ref
+        # lifetime) during the last install; sweep again now it's released.
+        with self.db._mutex:
+            self.db._delete_obsolete_files()
         self.maybe_schedule()
 
     def _compact_range_impl(self, begin: bytes | None, end: bytes | None) -> None:

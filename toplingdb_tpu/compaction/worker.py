@@ -124,6 +124,8 @@ def run_job(job_dir: str) -> int:
     outputs = build_outputs(
         env, params.output_dir, icmp, fake_compaction, stream, tombs,
         alloc, topts, stats, params.creation_time,
+        column_family=(getattr(params, "cf_id", 0),
+                       getattr(params, "cf_name", "default")),
     )
     results = CompactionResults(
         status="ok",

@@ -2,9 +2,12 @@
 
 Reference db/blob/* in /root/reference (BlobFileBuilder/Reader/Source,
 BlobIndex): values >= min_blob_size are written to .blob files at flush; the
-LSM keeps a BLOB_INDEX entry pointing at (file, offset, size). Compaction
-passes blob indexes through untouched (blob GC is a later-round item; unknown
-file types are never deleted by obsolete-file GC, so blob files are safe).
+LSM keeps a BLOB_INDEX entry pointing at (file, offset, size). Each SST's
+FileMetaData carries the set of blob files it references (blob_refs), so
+obsolete-file GC can delete unreferenced blob files, and compaction-time
+blob GC (reference blob_garbage_collection_age_cutoff + BlobCountingIterator)
+rewrites survivors out of the oldest referenced blob files via
+BlobGarbageCollector.
 
 Blob file format:
   header:  magic "TPULSMBL" (8B)
@@ -122,9 +125,122 @@ class BlobSource:
                     self._readers[fn] = r
         return r.get(offset, size, verify)
 
+    def evict(self, file_number: int) -> None:
+        with self._mu:
+            r = self._readers.pop(file_number, None)
+        if r is not None:
+            r.close()
+
     def close(self) -> None:
         with self._mu:
             readers = list(self._readers.values())
             self._readers.clear()
         for r in readers:
             r.close()
+
+
+class BlobGarbageCollector:
+    """Compaction-time blob GC (reference
+    blob_garbage_collection_age_cutoff semantics,
+    db/blob/blob_file_builder.cc + compaction GC wiring): given the blob
+    files referenced by the compaction's inputs, the oldest `age_cutoff`
+    fraction are GC targets. Surviving entries pointing into a target file
+    have their values resolved and rewritten — into a fresh blob file when
+    still >= min_blob_size, inline otherwise — so the old file's reference
+    count drains and obsolete-file GC reclaims it."""
+
+    def __init__(self, env, dbname: str, input_blob_refs: list[int],
+                 age_cutoff: float, min_blob_size: int, blob_resolver,
+                 new_file_number):
+        import math
+
+        refs = sorted(set(input_blob_refs))
+        n_gc = min(len(refs), math.ceil(len(refs) * age_cutoff))
+        self.gc_files = set(refs[:n_gc])  # oldest fraction by file number
+        self._env = env
+        self._dbname = dbname
+        self._min_blob_size = min_blob_size
+        self._resolver = blob_resolver
+        self._new_file_number = new_file_number
+        self._builder: BlobFileBuilder | None = None
+        self.new_blob_file: int | None = None
+        self.rewritten = 0
+        self.inlined = 0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.gc_files)
+
+    def rewrite(self, stream):
+        """Map a survivor (internal_key, value) stream, rewriting blob
+        indexes that point into GC-target files."""
+        from toplingdb_tpu.db import dbformat
+
+        bi = dbformat.ValueType.BLOB_INDEX
+        for ikey, value in stream:
+            if ikey[-8] == bi:
+                fn, _, _ = decode_blob_index(value)
+                if fn in self.gc_files:
+                    uk, seq, _ = dbformat.split_internal_key(ikey)
+                    raw = self._resolver(value)
+                    if len(raw) >= self._min_blob_size:
+                        if self._builder is None:
+                            self.new_blob_file = self._new_file_number()
+                            self._builder = BlobFileBuilder(
+                                self._env, self._dbname, self.new_blob_file
+                            )
+                        value = self._builder.add(uk, raw)
+                        self.rewritten += 1
+                    else:
+                        ikey = dbformat.make_internal_key(
+                            uk, seq, dbformat.ValueType.VALUE
+                        )
+                        value = raw
+                        self.inlined += 1
+            yield ikey, value
+
+    def finish(self) -> None:
+        """Close the output blob file (delete if nothing was written)."""
+        if self._builder is None:
+            return
+        if self._builder.finish() == 0:
+            try:
+                self._env.delete_file(
+                    blob_file_name(self._dbname, self.new_blob_file)
+                )
+            except Exception:
+                pass
+            self.new_blob_file = None
+        self._builder = None
+
+    def abort(self) -> None:
+        """Failed compaction: close and delete the half-written output blob
+        file (its pointers were never installed in any SST)."""
+        if self._builder is None:
+            return
+        self._builder.finish()
+        try:
+            self._env.delete_file(
+                blob_file_name(self._dbname, self.new_blob_file)
+            )
+        except Exception:
+            pass
+        self.new_blob_file = None
+        self._builder = None
+
+
+def maybe_new_blob_gc(db, compaction, new_file_number):
+    """Shared constructor for the compaction-time collector (used by the
+    local scheduler AND the device executor so the eligibility policy can't
+    diverge): None unless GC is enabled and the inputs reference blob
+    files."""
+    opts = db.options
+    if not opts.enable_blob_garbage_collection:
+        return None
+    refs = [fn for _, f in compaction.all_inputs() for fn in f.blob_refs]
+    if not refs:
+        return None
+    return BlobGarbageCollector(
+        db.env, db.dbname, refs, opts.blob_garbage_collection_age_cutoff,
+        opts.min_blob_size, db.blob_source.get, new_file_number,
+    )

@@ -35,6 +35,27 @@ from toplingdb_tpu.utils.status import Corruption, InvalidArgument, IOError_, No
 _DEFAULT_READ = ReadOptions()
 _DEFAULT_WRITE = WriteOptions()
 
+# Cap on bytes merged into one commit group (reference
+# max_write_batch_group_size_bytes, db/db_impl/db_impl_write.cc).
+_MAX_WRITE_GROUP_BYTES = 1 << 20
+
+
+class _Writer:
+    """One queued write (reference WriteThread::Writer, db/write_thread.h:32).
+
+    Lifecycle: enqueued → either becomes the group leader (front of queue) or
+    blocks on its event until a leader commits it (done=True) or promotes it
+    to lead the next group (done=False)."""
+
+    __slots__ = ("batch", "opts", "done", "error", "event")
+
+    def __init__(self, batch: WriteBatch, opts: WriteOptions):
+        self.batch = batch
+        self.opts = opts
+        self.done = False
+        self.error: BaseException | None = None
+        self.event = threading.Event()
+
 
 class ColumnFamilyHandle:
     """Opaque per-CF handle (reference include/rocksdb/db.h
@@ -82,6 +103,8 @@ class DB:
         self.blob_source = BlobSource(env, dbname)
         self.snapshots = SnapshotList()
         self._mutex = threading.RLock()
+        self._writers: list[_Writer] = []  # FIFO write queue (leader = [0])
+        self._wq_lock = threading.Lock()
         self._wal: LogWriter | None = None
         self._wal_number = 0
         self._closed = False
@@ -165,6 +188,13 @@ class DB:
                 return cfd.handle
         return None
 
+    def cf_name(self, cf_id: int) -> str:
+        cfd = self._cfs.get(cf_id)
+        if cfd is not None:
+            return cfd.handle.name
+        st = self.versions.column_families.get(cf_id)
+        return st.name if st is not None else f"cf{cf_id}"
+
     # ==================================================================
     # Open / close
     # ==================================================================
@@ -212,7 +242,7 @@ class DB:
             if ftype == filename.FileType.WAL and num >= self.versions.log_number:
                 wal_numbers.append(num)
             if ftype in (filename.FileType.WAL, filename.FileType.TABLE,
-                         filename.FileType.MANIFEST):
+                         filename.FileType.MANIFEST, filename.FileType.BLOB):
                 self.versions.mark_file_number_used(num)
         max_seq = self.versions.last_sequence
         mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
@@ -318,34 +348,113 @@ class DB:
         self.write(b, opts)
 
     def write(self, batch: WriteBatch, opts: WriteOptions = _DEFAULT_WRITE) -> None:
-        """The write path (reference DBImpl::WriteImpl,
-        db/db_impl/db_impl_write.cc:169): WAL append, then memtable insert,
-        then publish the sequence."""
+        """Group-commit write path (reference DBImpl::WriteImpl +
+        WriteThread::JoinBatchGroup, db/db_impl/db_impl_write.cc:169,311):
+        concurrent writers queue up; the front writer leads, merging the
+        queue into one WAL append + one fsync, then applies every batch to
+        the memtables and publishes the group's last sequence at once."""
         if batch.is_empty():
             return
         self._check_open()  # fail fast before any stall sleep
         self._maybe_stall_writes()
+        w = _Writer(batch, opts)
+        with self._wq_lock:
+            self._writers.append(w)
+            is_leader = self._writers[0] is w
+        if not is_leader:
+            interrupted: BaseException | None = None
+            while True:
+                try:
+                    w.event.wait()
+                    break
+                except BaseException as e:  # noqa: BLE001
+                    # Async interrupt (KeyboardInterrupt) mid-wait: the queue
+                    # slot MUST still resolve — abandoning it would deadlock
+                    # every later writer behind a never-driven leader.
+                    interrupted = e
+            if w.done:
+                if interrupted is not None:
+                    raise interrupted
+                if w.error is not None:
+                    raise w.error
+                return
+            # Woken with done=False: promoted to lead the next group.
+            self._lead_write_group(w)
+            if interrupted is not None:
+                raise interrupted
+            return
+        self._lead_write_group(w)
+
+    def _lead_write_group(self, leader: _Writer) -> None:
+        # Snapshot the group: leader + queued followers with the same WAL
+        # disposition, capped in bytes so a giant group can't starve later
+        # writers' latency (reference WriteThread::EnterAsBatchGroupLeader).
+        with self._wq_lock:
+            group = [leader]
+            size = leader.batch.data_size()
+            for w in self._writers[1:]:
+                if w.opts.disable_wal != leader.opts.disable_wal:
+                    break
+                size += w.batch.data_size()
+                if size > _MAX_WRITE_GROUP_BYTES:
+                    break
+                group.append(w)
+        err: BaseException | None = None
+        try:
+            self._commit_write_group(group)
+        except BaseException as e:  # propagate to the whole group
+            err = e
+        with self._wq_lock:
+            del self._writers[: len(group)]
+            nxt = self._writers[0] if self._writers else None
+        for w in group:
+            w.done = True
+            w.error = err
+            if w is not leader:
+                w.event.set()
+        if nxt is not None:
+            nxt.event.set()  # done=False → it takes over as leader
+        if err is not None:
+            raise err
+
+    def _commit_write_group(self, group: list[_Writer]) -> None:
         with self._mutex:
             self._check_open()
             if self._bg_error is not None:
                 raise IOError_(
                     f"background error pending (call resume()): {self._bg_error!r}"
                 )
-            seq = self.versions.last_sequence + 1
-            batch.set_sequence(seq)
-            if self.options.wal_enabled and not opts.disable_wal:
-                self._wal.add_record(batch.data())
-                if opts.sync:
+            first_seq = self.versions.last_sequence + 1
+            seq = first_seq
+            for w in group:
+                w.batch.set_sequence(seq)
+                seq += w.batch.count()
+            if self.options.wal_enabled and not group[0].opts.disable_wal:
+                if len(group) == 1:
+                    self._wal.add_record(group[0].batch.data())
+                else:
+                    merged = WriteBatch()
+                    merged.set_sequence(first_seq)
+                    for w in group:
+                        merged.append_from(w.batch)
+                    self._wal.add_record(merged.data())
+                if any(w.opts.sync for w in group):
                     self._wal.sync()
                 else:
                     self._wal.flush()
-            batch.insert_into({cf_id: cfd.mem for cf_id, cfd in self._cfs.items()})
-            self.versions.last_sequence = seq + batch.count() - 1
+            mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
+            for w in group:
+                w.batch.insert_into(mems)
+            self.versions.last_sequence = seq - 1
             if self.stats is not None:
                 from toplingdb_tpu.utils import statistics as st
 
-                self.stats.record_tick(st.NUMBER_KEYS_WRITTEN, batch.count())
-                self.stats.record_tick(st.BYTES_WRITTEN, batch.data_size())
+                self.stats.record_tick(
+                    st.NUMBER_KEYS_WRITTEN, sum(w.batch.count() for w in group)
+                )
+                self.stats.record_tick(
+                    st.BYTES_WRITTEN, sum(w.batch.data_size() for w in group)
+                )
             total_mem = sum(
                 c.mem.approximate_memory_usage() for c in self._cfs.values()
             )
@@ -394,16 +503,27 @@ class DB:
             self.versions.new_file_number()
             if self.options.enable_blob_files else None
         )
-        meta = flush_memtable_to_table(
-            self.env, self.dbname, fnum, self.icmp, mems,
-            self.options.table_options, creation_time=int(time.time()),
-            blob_file_number=blob_num,
-            min_blob_size=self.options.min_blob_size,
-        )
-        edit = VersionEdit(log_number=wal_number, column_family=cf_id)
-        if meta is not None:
-            edit.add_file(0, meta)
-        self.versions.log_and_apply(edit)
+        # Guard in-flight outputs (incl. the blob sibling) from obsolete-file
+        # GC until the version edit lands.
+        self._pending_outputs.add(fnum)
+        if blob_num is not None:
+            self._pending_outputs.add(blob_num)
+        try:
+            meta = flush_memtable_to_table(
+                self.env, self.dbname, fnum, self.icmp, mems,
+                self.options.table_options, creation_time=int(time.time()),
+                blob_file_number=blob_num,
+                min_blob_size=self.options.min_blob_size,
+                column_family=(cf_id, self.cf_name(cf_id)),
+            )
+            edit = VersionEdit(log_number=wal_number, column_family=cf_id)
+            if meta is not None:
+                edit.add_file(0, meta)
+            self.versions.log_and_apply(edit)
+        finally:
+            self._pending_outputs.discard(fnum)
+            if blob_num is not None:
+                self._pending_outputs.discard(blob_num)
         if meta is not None:
             from toplingdb_tpu.utils import statistics as st
             from toplingdb_tpu.utils.listener import FlushJobInfo, notify
@@ -704,7 +824,7 @@ class DB:
     def _delete_obsolete_files(self) -> None:
         """GC: remove WALs below the manifest log number, non-live SSTs, and
         stale MANIFESTs (reference DBImpl::DeleteObsoleteFiles)."""
-        live = self.versions.live_files()
+        live, live_blobs = self.versions.live_file_sets()
         for child in self.env.get_children(self.dbname):
             ftype, num = filename.parse_file_name(child)
             keep = True
@@ -712,6 +832,8 @@ class DB:
                 keep = num >= self.versions.log_number or num == self._wal_number
             elif ftype == filename.FileType.TABLE:
                 keep = num in live or num in self._pending_outputs
+            elif ftype == filename.FileType.BLOB:
+                keep = num in live_blobs or num in self._pending_outputs
             elif ftype == filename.FileType.MANIFEST:
                 keep = num == self.versions.manifest_file_number
             elif ftype == filename.FileType.TEMP:
@@ -719,6 +841,8 @@ class DB:
             if not keep:
                 if ftype == filename.FileType.TABLE:
                     self.table_cache.evict(num)
+                elif ftype == filename.FileType.BLOB:
+                    self.blob_source.evict(num)
                 try:
                     self.env.delete_file(f"{self.dbname}/{child}")
                 except NotFound:

@@ -24,6 +24,7 @@ class FileType(enum.Enum):
     LOCK = "lock"
     OPTIONS = "options"
     TEMP = "dbtmp"
+    BLOB = "blob"
     UNKNOWN = "unknown"
 
 
@@ -85,6 +86,8 @@ def parse_file_name(fname: str) -> tuple[FileType, int]:
             return FileType.TABLE, int(stem)
         if ext == "dbtmp":
             return FileType.TEMP, int(stem)
+        if ext == "blob":
+            return FileType.BLOB, int(stem)
     return FileType.UNKNOWN, 0
 
 

@@ -15,7 +15,9 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
                             memtables: list[MemTable], table_options,
                             creation_time: int = 0,
                             blob_file_number: int | None = None,
-                            min_blob_size: int = 0) -> FileMetaData | None:
+                            min_blob_size: int = 0,
+                            column_family: tuple[int, str] = (0, "default"),
+                            ) -> FileMetaData | None:
     """Write one or more memtables (newest first) to a single L0 SST via a
     k-way merge of their already-sorted iterators. Returns None if there was
     nothing to write. With blob_file_number set, values >= min_blob_size go
@@ -42,7 +44,9 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
     w = env.new_writable_file(path)
     try:
         builder = new_table_builder(
-            w, icmp, table_options, creation_time=creation_time
+            w, icmp, table_options, creation_time=creation_time,
+            column_family_id=column_family[0],
+            column_family_name=column_family[1],
         )
         merger = MergingIterator(
             icmp.compare, [m.new_iterator() for m in memtables]
@@ -94,4 +98,8 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
         num_entries=props.num_entries,
         num_deletions=props.num_deletions,
         num_range_deletions=props.num_range_deletions,
+        blob_refs=(
+            [blob_file_number]
+            if blob_builder is not None and blob_builder.num_values else []
+        ),
     )

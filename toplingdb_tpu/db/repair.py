@@ -2,14 +2,14 @@
 (reference db/repair.cc in /root/reference).
 
 Strategy (same as the reference's RepairDB): archive the old MANIFEST/CURRENT,
-scan every .sst for bounds/seqnos (checksum-verified), replay any WALs into a
-fresh L0 table, then write a new MANIFEST placing every surviving table in L0
+scan every .sst for bounds/seqnos (checksum-verified), replay any WALs into
+fresh L0 tables, then write a new MANIFEST placing every surviving table in L0
 — overlap-safe because L0 allows overlapping ranges; the next compaction
 re-sorts the tree.
 
-Limitation (round 1): multi-CF DBs are flattened into the default column
-family (the MANIFEST that mapped tables to CFs is the thing that was lost);
-CF reconstruction from table properties is a later refinement.
+Column families are reconstructed from the column_family_id/name stored in
+every table's properties block (the reference keeps the same property,
+table/table_properties.cc) — WAL records carry their CF ids natively.
 """
 
 from __future__ import annotations
@@ -47,12 +47,16 @@ def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
             env.rename_file(f"{dbname}/{child}", f"{archive}/{child}")
             report["archived"].append(child)
 
-    # 2. Scan tables: verified ones survive with recomputed metadata.
-    metas: list[FileMetaData] = []
+    # 2. Scan tables: verified ones survive with recomputed metadata,
+    # grouped into their column family (id+name from the properties block).
+    metas: dict[int, list[FileMetaData]] = {}
+    cf_names: dict[int, str] = {0: "default"}
     max_file_number = 1
     max_seq = 0
     for child in children:
         ftype, num = filename.parse_file_name(child)
+        if ftype == filename.FileType.BLOB:
+            max_file_number = max(max_file_number, num)  # don't reuse
         if ftype != filename.FileType.TABLE:
             continue
         max_file_number = max(max_file_number, num)
@@ -65,11 +69,18 @@ def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
             smallest = None
             largest = None
             n = 0
-            for k, _ in it.entries():  # checksum-verified full scan
+            blob_refs = set()
+            from toplingdb_tpu.db.blob import decode_blob_index
+
+            for k, v in it.entries():  # checksum-verified full scan
                 if smallest is None:
                     smallest = k
                 largest = k
                 n += 1
+                if k[-8] == dbformat.ValueType.BLOB_INDEX:
+                    # Keep the referenced blob files alive in the rebuilt
+                    # MANIFEST, or obsolete-file GC would orphan the values.
+                    blob_refs.add(decode_blob_index(v)[0])
             for b, e in r.range_del_entries():
                 if smallest is None or icmp.compare(b, smallest) < 0:
                     smallest = b
@@ -82,13 +93,19 @@ def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
             if smallest is None:
                 raise ValueError("empty table")
             props = r.properties
-            metas.append(FileMetaData(
+            cf_id = props.column_family_id
+            if props.column_family_name:
+                cf_names[cf_id] = props.column_family_name
+            else:
+                cf_names.setdefault(cf_id, f"cf{cf_id}")
+            metas.setdefault(cf_id, []).append(FileMetaData(
                 number=num, file_size=env.get_file_size(path),
                 smallest=smallest, largest=largest,
                 smallest_seqno=props.smallest_seqno,
                 largest_seqno=props.largest_seqno,
                 num_entries=n,
                 num_range_deletions=props.num_range_deletions,
+                blob_refs=sorted(blob_refs),
             ))
             max_seq = max(max_seq, props.largest_seqno)
             report["tables_kept"] += 1
@@ -102,7 +119,7 @@ def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
     from toplingdb_tpu.utils.status import Corruption, NotFound
 
     report["wal_errors"] = 0
-    mem = MemTable(icmp)
+    mems: dict[int, MemTable] = {}
     for child in children:
         ftype, num = filename.parse_file_name(child)
         if ftype != filename.FileType.WAL:
@@ -113,37 +130,56 @@ def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
                 filename.log_file_name(dbname, num)))
             for rec in reader.records():
                 batch = WriteBatch(rec)
-                batch.insert_into(mem)
+                for cf, _, _, _ in batch.entries_cf():
+                    if cf not in mems:
+                        mems[cf] = MemTable(icmp)
+                        cf_names.setdefault(cf, f"cf{cf}")
+                batch.insert_into(mems)
                 report["wal_records"] += batch.count()
                 max_seq = max(max_seq, batch.sequence() + batch.count() - 1)
         except (Corruption, NotFound):
             report["wal_errors"] += 1
-    if not mem.empty():
+    for cf_id, mem in sorted(mems.items()):
+        if mem.empty():
+            continue
         fnum = max_file_number + 1
         max_file_number = fnum
         meta = flush_memtable_to_table(
-            env, dbname, fnum, icmp, [mem], options.table_options
+            env, dbname, fnum, icmp, [mem], options.table_options,
+            column_family=(cf_id, cf_names[cf_id]),
         )
         if meta is not None:
-            metas.append(meta)
+            metas.setdefault(cf_id, []).append(meta)
             report["tables_kept"] += 1
 
-    # 4. Fresh MANIFEST: everything goes to L0 (overlap-legal).
+    # 4. Fresh MANIFEST: everything goes to L0 (overlap-legal), with one
+    # CF-add record per reconstructed column family.
     manifest_number = max_file_number + 1
-    edit = VersionEdit(
+    all_cfs = sorted(set(cf_names) | set(metas) | {0})
+    records = [VersionEdit(
         comparator=icmp.user_comparator.name(),
         log_number=max_file_number + 2,
         next_file_number=max_file_number + 3,
         last_sequence=max_seq,
         column_family_add="default",
-        max_column_family=0,
-    )
-    for m in metas:
-        edit.add_file(0, m)
+        max_column_family=max(all_cfs),
+    )]
+    for cf_id in all_cfs:
+        if cf_id != 0:
+            records.append(VersionEdit(
+                column_family=cf_id, column_family_add=cf_names[cf_id]
+            ))
+        if metas.get(cf_id):
+            e = VersionEdit(column_family=cf_id)
+            for m in metas[cf_id]:
+                e.add_file(0, m)
+            records.append(e)
     w = LogWriter(env.new_writable_file(
         filename.manifest_file_name(dbname, manifest_number)))
-    w.add_record(edit.encode())
+    for e in records:
+        w.add_record(e.encode())
     w.sync()
     w.close()
     filename.set_current_file(env, dbname, manifest_number)
+    report["column_families"] = {cf: cf_names[cf] for cf in all_cfs}
     return report

@@ -25,6 +25,7 @@ TAG_COLUMN_FAMILY = 9           # selects CF for this edit
 TAG_COLUMN_FAMILY_ADD = 10
 TAG_COLUMN_FAMILY_DROP = 11
 TAG_MAX_COLUMN_FAMILY = 12
+TAG_NEW_FILE_BLOBS = 13         # NEW_FILE + trailing blob_refs list
 
 
 @dataclass
@@ -41,9 +42,10 @@ class FileMetaData:
     num_entries: int = 0
     num_deletions: int = 0
     num_range_deletions: int = 0
+    blob_refs: list[int] = field(default_factory=list)  # referenced blob files
     being_compacted: bool = False  # in-memory only
 
-    def encode(self) -> bytes:
+    def encode(self, include_refs: bool = False) -> bytes:
         out = bytearray()
         out += coding.encode_varint64(self.number)
         out += coding.encode_varint64(self.file_size)
@@ -54,10 +56,17 @@ class FileMetaData:
         out += coding.encode_varint64(self.num_entries)
         out += coding.encode_varint64(self.num_deletions)
         out += coding.encode_varint64(self.num_range_deletions)
+        if include_refs:
+            # Only under TAG_NEW_FILE_BLOBS — TAG_NEW_FILE keeps the original
+            # layout so MANIFESTs written before blob_refs existed still parse.
+            out += coding.encode_varint64(len(self.blob_refs))
+            for fn in self.blob_refs:
+                out += coding.encode_varint64(fn)
         return bytes(out)
 
     @staticmethod
-    def decode(buf: bytes, off: int) -> tuple["FileMetaData", int]:
+    def decode(buf: bytes, off: int,
+               with_refs: bool = False) -> tuple["FileMetaData", int]:
         number, off = coding.decode_varint64(buf, off)
         size, off = coding.decode_varint64(buf, off)
         smallest, off = coding.get_length_prefixed_slice(buf, off)
@@ -67,7 +76,14 @@ class FileMetaData:
         ne, off = coding.decode_varint64(buf, off)
         nd, off = coding.decode_varint64(buf, off)
         nrd, off = coding.decode_varint64(buf, off)
-        return FileMetaData(number, size, smallest, largest, ssq, lsq, ne, nd, nrd), off
+        refs = []
+        if with_refs:
+            nrefs, off = coding.decode_varint64(buf, off)
+            for _ in range(nrefs):
+                fn, off = coding.decode_varint64(buf, off)
+                refs.append(fn)
+        return FileMetaData(number, size, smallest, largest, ssq, lsq,
+                            ne, nd, nrd, refs), off
 
 
 @dataclass
@@ -131,9 +147,10 @@ class VersionEdit:
             out += coding.encode_varint64(level)
             out += coding.encode_varint64(number)
         for level, meta in self.new_files:
-            tag(TAG_NEW_FILE)
+            has_refs = bool(meta.blob_refs)
+            tag(TAG_NEW_FILE_BLOBS if has_refs else TAG_NEW_FILE)
             out += coding.encode_varint64(level)
-            out += meta.encode()
+            out += meta.encode(include_refs=has_refs)
         return bytes(out)
 
     @staticmethod
@@ -169,9 +186,11 @@ class VersionEdit:
                 lvl, off = coding.decode_varint64(buf, off)
                 num, off = coding.decode_varint64(buf, off)
                 e.deleted_files.append((lvl, num))
-            elif t == TAG_NEW_FILE:
+            elif t == TAG_NEW_FILE or t == TAG_NEW_FILE_BLOBS:
                 lvl, off = coding.decode_varint64(buf, off)
-                meta, off = FileMetaData.decode(buf, off)
+                meta, off = FileMetaData.decode(
+                    buf, off, with_refs=(t == TAG_NEW_FILE_BLOBS)
+                )
                 e.new_files.append((lvl, meta))
             else:
                 raise Corruption(f"unknown VersionEdit tag {t}")

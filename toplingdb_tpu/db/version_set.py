@@ -415,14 +415,20 @@ class VersionSet:
 
     # -- introspection --------------------------------------------------
 
-    def live_files(self) -> set[int]:
-        """Files referenced by any CF's current version OR any version still
-        held by an in-flight reader/iterator."""
-        out: set[int] = set()
+    def live_file_sets(self) -> tuple[set[int], set[int]]:
+        """(sst_numbers, blob_numbers) referenced by any CF's current version
+        OR any version still held by an in-flight reader/iterator — the
+        deletion guards for obsolete-file GC, filled in one pass."""
+        ssts: set[int] = set()
+        blobs: set[int] = set()
         versions = list(self._all_versions) + [
             st.current for st in self.column_families.values()
         ]
         for v in versions:
             for _, f in v.all_files():
-                out.add(f.number)
-        return out
+                ssts.add(f.number)
+                blobs.update(f.blob_refs)
+        return ssts, blobs
+
+    def live_files(self) -> set[int]:
+        return self.live_file_sets()[0]

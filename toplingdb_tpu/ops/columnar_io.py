@@ -5,8 +5,10 @@ dominating (SURVEY.md §7 step 5 "host↔device streaming"): whole-file scans
 into flat buffers via the C++ block decoder, and output building via the C++
 block builder + bloom fill — no per-entry Python. File framing (compression,
 trailers, index/filter/props/metaindex/footer) reuses the same Python pieces
-as TableBuilder, so outputs are byte-identical to the per-entry path for
-uncut (single-output) jobs; tests assert it.
+as TableBuilder, and write_tables_columnar replicates build_outputs' output
+cutting (user-key boundary after max_output_file_size) exactly, so outputs
+are byte-identical to the per-entry path for single- AND multi-output jobs;
+tests/test_columnar_writer.py asserts it.
 """
 
 from __future__ import annotations
@@ -196,31 +198,162 @@ def scan_table_columnar(reader) -> ColumnarKV:
     return ColumnarKV.concat(parts)
 
 
-def write_table_columnar(wfile, icmp, options, kv: ColumnarKV,
-                         order: np.ndarray, trailer_override: np.ndarray,
-                         vtypes: np.ndarray, seqs: np.ndarray,
-                         tombstones, creation_time: int):
-    """Build one SST from `kv` entries in `order`, byte-identical to
-    TableBuilder fed the same stream. trailer_override[i] (per ORIGINAL
-    entry index) >= 0 replaces the 8-byte key trailer (seqno zeroing).
-    vtypes/seqs are per original index, post-override values."""
+class _ColumnarSST:
+    """Framing state for ONE output file of the columnar writer (index,
+    props, meta blocks, footer) — the TableBuilder-equivalent file shell."""
+
+    def __init__(self, env, dbname, fnum, icmp, options, creation_time,
+                 column_family=(0, "default")):
+        from toplingdb_tpu.db import filename as _fn
+
+        self.fnum = fnum
+        self.path = _fn.table_file_name(dbname, fnum)
+        self.w = env.new_writable_file(self.path)
+        self._icmp = icmp
+        self._options = options
+        self.index_block = BlockBuilder(options.index_restart_interval)
+        self.props = TableProperties(
+            comparator_name=icmp.user_comparator.name(),
+            filter_policy_name=(
+                options.filter_policy.name() if options.filter_policy else ""
+            ),
+            compression_name=str(options.compression),
+            column_family_id=column_family[0],
+            column_family_name=column_family[1],
+            creation_time=creation_time,
+            smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
+        )
+        self.pending_last_key: bytes | None = None
+        self.pending_handle = None
+        self.first_key: bytes | None = None
+        self.last_key: bytes | None = None
+        self.num_entries = 0
+
+    def add_block(self, raw: bytes, block_first: bytes, block_last: bytes,
+                  n_entries: int) -> None:
+        if self.first_key is None:
+            self.first_key = block_first
+        if self.pending_last_key is not None:
+            sep = self._icmp.find_shortest_separator(
+                self.pending_last_key, block_first
+            )
+            self.index_block.add(sep, self.pending_handle.encode())
+        self.pending_handle = fmt.write_block(
+            self.w, raw, self._options.compression
+        )
+        self.pending_last_key = block_last
+        self.props.data_size += len(raw)
+        self.props.num_data_blocks += 1
+        self.last_key = block_last
+        self.num_entries += n_entries
+
+    def finish(self, lib, kv, sel, vtypes, seqs, tombstones):
+        """Write meta blocks + footer; `sel` = the original-index selection
+        of this file's entries (stats/bloom are vectorized over it)."""
+        icmp = self._icmp
+        options = self._options
+        props = self.props
+        n = len(sel)
+        if self.pending_last_key is not None:
+            succ = icmp.find_short_successor(self.pending_last_key)
+            self.index_block.add(succ, self.pending_handle.encode())
+        props.num_entries = n
+        props.raw_key_size = int(kv.key_lens[sel].sum()) if n else 0
+        props.raw_value_size = int(kv.val_lens[sel].sum()) if n else 0
+        vt = vtypes[sel] if n else vtypes[:0]
+        props.num_deletions = int(np.count_nonzero(
+            (vt == int(dbformat.ValueType.DELETION))
+            | (vt == int(dbformat.ValueType.SINGLE_DELETION))
+        ))
+        props.num_merge_operands = int(np.count_nonzero(
+            vt == int(dbformat.ValueType.MERGE)
+        ))
+        sq = seqs[sel] if n else seqs[:0]
+        props.smallest_seqno = int(sq.min()) if n else 0
+        props.largest_seqno = int(sq.max()) if n else 0
+
+        meta_entries = []
+        metaindex = BlockBuilder(restart_interval=1)
+        if options.filter_policy and options.whole_key_filtering and n:
+            from toplingdb_tpu.utils import coding
+
+            bp = options.filter_policy
+            num_bits = max(64, int(n * bp.bits_per_key))
+            num_bytes = (num_bits + 7) // 8
+            num_bits = num_bytes * 8
+            bits = np.zeros(num_bytes, dtype=np.uint8)
+            uk_lens = (kv.key_lens[sel] - 8).astype(np.int32)
+            offs = kv.key_offs[sel].astype(np.int32)
+            lib.tpulsm_bloom_build(
+                native.np_u8p(kv.key_buf),
+                native.np_i32p(np.ascontiguousarray(offs)),
+                native.np_i32p(np.ascontiguousarray(uk_lens)), n,
+                num_bits, bp.num_probes, native.np_u8p(bits),
+            )
+            fdata = (coding.encode_varint32(num_bits) + bytes([bp.num_probes])
+                     + bits.tobytes())
+            fh = fmt.write_block(self.w, fdata, fmt.NO_COMPRESSION)
+            props.filter_size = len(fdata)
+            meta_entries.append((METAINDEX_FILTER, fh))
+
+        smallest = self.first_key
+        largest = self.last_key
+        if tombstones:
+            rdb = BlockBuilder(restart_interval=1)
+            for frag in tombstones:
+                b, e = frag.to_table_entry()
+                rdb.add(b, e)
+                props.num_range_deletions += 1
+                if smallest is None or icmp.compare(b, smallest) < 0:
+                    smallest = b
+                end_ikey = dbformat.make_internal_key(
+                    e, dbformat.MAX_SEQUENCE_NUMBER, dbformat.VALUE_TYPE_FOR_SEEK
+                )
+                if largest is None or icmp.compare(end_ikey, largest) > 0:
+                    largest = end_ikey
+                props.smallest_seqno = min(props.smallest_seqno, frag.seq)
+                props.largest_seqno = max(props.largest_seqno, frag.seq)
+            rh = fmt.write_block(self.w, rdb.finish(), fmt.NO_COMPRESSION)
+            meta_entries.append((METAINDEX_RANGE_DEL, rh))
+
+        iraw = self.index_block.finish()
+        props.index_size = len(iraw)
+        pblock = props.encode_block()
+        ph = fmt.write_block(self.w, pblock, fmt.NO_COMPRESSION)
+        meta_entries.append((METAINDEX_PROPERTIES, ph))
+        for name, handle in sorted(meta_entries):
+            metaindex.add(name, handle.encode())
+        mih = fmt.write_block(self.w, metaindex.finish(), fmt.NO_COMPRESSION)
+        ih = fmt.write_block(self.w, iraw, options.compression)
+        self.w.append(fmt.Footer(mih, ih).encode())
+        self.w.flush()
+        self.w.sync()
+        self.w.close()
+        return props, smallest, largest
+
+
+def write_tables_columnar(env, dbname, new_file_number, icmp, options,
+                          kv: ColumnarKV, order: np.ndarray,
+                          trailer_override: np.ndarray, vtypes: np.ndarray,
+                          seqs: np.ndarray, tombstones, creation_time: int,
+                          max_output_file_size: int = 2 ** 62,
+                          column_family=(0, "default")):
+    """Build output SSTs from `kv` entries in `order`, byte-identical to
+    TableBuilder fed the same stream through build_outputs — including the
+    output-cutting rule (cut at a user-key boundary once the file's written
+    bytes reach max_output_file_size; reference
+    CompactionOutputs::ShouldStopBefore). Cutting is disabled while range
+    tombstones survive, matching the per-entry path. trailer_override[i]
+    (per ORIGINAL entry index) >= 0 replaces the 8-byte key trailer (seqno
+    zeroing). Returns a list of (fnum, path, props, smallest, largest, sel)
+    where sel is the original-index selection written to that file.
+    On any failure every partial output is deleted before re-raising."""
     lib = native.lib()
     if lib is None:
         raise NotSupported("native library unavailable")
     n_total = len(order)
     order = np.ascontiguousarray(order, dtype=np.int32)
     trailer_override = np.ascontiguousarray(trailer_override, dtype=np.int64)
-
-    props = TableProperties(
-        comparator_name=icmp.user_comparator.name(),
-        filter_policy_name=(
-            options.filter_policy.name() if options.filter_policy else ""
-        ),
-        compression_name=str(options.compression),
-        creation_time=creation_time,
-        smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
-    )
-    index_block = BlockBuilder(options.index_restart_interval)
 
     max_entry = int(kv.key_lens.max() if kv.n else 0) + int(
         kv.val_lens.max() if kv.n else 0
@@ -237,11 +370,15 @@ def write_table_columnar(wfile, icmp, options, kv: ColumnarKV,
             k = k[:-8] + t.to_bytes(8, "little")
         return k
 
-    start = 0
-    pending_last_key: bytes | None = None
-    pending_handle = None
-    first_key: bytes | None = None
-    last_key: bytes | None = None
+    def same_user_key(pos_a: int, pos_b: int) -> bool:
+        a, b = int(order[pos_a]), int(order[pos_b])
+        la, lb = int(kv.key_lens[a]) - 8, int(kv.key_lens[b]) - 8
+        if la != lb:
+            return False
+        oa, ob = int(kv.key_offs[a]), int(kv.key_offs[b])
+        return bool(np.array_equal(kv.key_buf[oa:oa + la],
+                                   kv.key_buf[ob:ob + lb]))
+
     # Hoist ctypes pointer conversions out of the per-block loop.
     p_kbuf = native.np_u8p(kv.key_buf)
     p_koff = native.np_i32p(kv.key_offs)
@@ -253,110 +390,75 @@ def write_table_columnar(wfile, icmp, options, kv: ColumnarKV,
     p_order = native.np_i32p(order)
     p_outlen = native.np_i64p(out_len)
     p_out = native.np_u8p(out_buf)
-    while start < n_total:
-        rc = lib.tpulsm_build_block(
-            p_kbuf, p_koff, p_klen, p_vbuf, p_voff, p_vlen, p_tro,
-            p_order, start, n_total,
-            options.block_size, options.restart_interval,
-            p_out, out_cap, p_outlen,
-        )
-        if rc == -2:
-            out_cap *= 4
-            out_buf = np.empty(out_cap, dtype=np.uint8)
-            p_out = native.np_u8p(out_buf)
-            continue
-        if rc == -3 or rc == -8:
-            # Key too long for the native stack buffer / restart table full:
-            # the per-entry path handles these.
-            raise NotSupported(f"native block build unsupported input rc={rc}")
-        if rc <= 0:
-            raise Corruption(f"native block build failed rc={rc}")
-        raw = out_buf[: int(out_len[0])].tobytes()
-        if first_key is None:
-            first_key = entry_key(start)
-        block_last = entry_key(start + int(rc) - 1)
-        if pending_last_key is not None:
-            sep = icmp.find_shortest_separator(pending_last_key, entry_key(start))
-            index_block.add(sep, pending_handle.encode())
-        pending_handle = fmt.write_block(wfile, raw, options.compression)
-        pending_last_key = block_last
-        props.data_size += len(raw)
-        props.num_data_blocks += 1
-        start += int(rc)
-        last_key = block_last
-    if pending_last_key is not None:
-        succ = icmp.find_short_successor(pending_last_key)
-        index_block.add(succ, pending_handle.encode())
 
-    # Stats over emitted entries (vectorized).
-    sel = order
-    props.num_entries = n_total
-    props.raw_key_size = int(kv.key_lens[sel].sum()) if n_total else 0
-    props.raw_value_size = int(kv.val_lens[sel].sum()) if n_total else 0
-    vt = vtypes[sel] if n_total else vtypes[:0]
-    props.num_deletions = int(np.count_nonzero(
-        (vt == int(dbformat.ValueType.DELETION))
-        | (vt == int(dbformat.ValueType.SINGLE_DELETION))
-    ))
-    props.num_merge_operands = int(np.count_nonzero(
-        vt == int(dbformat.ValueType.MERGE)
-    ))
-    sq = seqs[sel] if n_total else seqs[:0]
-    props.smallest_seqno = int(sq.min()) if n_total else 0
-    props.largest_seqno = int(sq.max()) if n_total else 0
-
-    meta_entries = []
-    metaindex = BlockBuilder(restart_interval=1)
-    if options.filter_policy and options.whole_key_filtering and n_total:
-        from toplingdb_tpu.utils import coding
-
-        bp = options.filter_policy
-        num_bits = max(64, int(n_total * bp.bits_per_key))
-        num_bytes = (num_bits + 7) // 8
-        num_bits = num_bytes * 8
-        bits = np.zeros(num_bytes, dtype=np.uint8)
-        uk_lens = (kv.key_lens[sel] - 8).astype(np.int32)
-        offs = kv.key_offs[sel].astype(np.int32)
-        lib.tpulsm_bloom_build(
-            native.np_u8p(kv.key_buf), native.np_i32p(np.ascontiguousarray(offs)),
-            native.np_i32p(np.ascontiguousarray(uk_lens)), n_total,
-            num_bits, bp.num_probes, native.np_u8p(bits),
-        )
-        fdata = (coding.encode_varint32(num_bits) + bytes([bp.num_probes])
-                 + bits.tobytes())
-        fh = fmt.write_block(wfile, fdata, fmt.NO_COMPRESSION)
-        props.filter_size = len(fdata)
-        meta_entries.append((METAINDEX_FILTER, fh))
-
-    smallest = first_key
-    largest = last_key
-    if tombstones:
-        rdb = BlockBuilder(restart_interval=1)
-        for frag in tombstones:
-            b, e = frag.to_table_entry()
-            rdb.add(b, e)
-            props.num_range_deletions += 1
-            if smallest is None or icmp.compare(b, smallest) < 0:
-                smallest = b
-            end_ikey = dbformat.make_internal_key(
-                e, dbformat.MAX_SEQUENCE_NUMBER, dbformat.VALUE_TYPE_FOR_SEEK
+    can_cut = not tombstones  # single output while tombstones survive
+    results = []
+    cur: _ColumnarSST | None = None
+    lo = 0
+    start = 0
+    try:
+        cur = _ColumnarSST(env, dbname, new_file_number(), icmp, options,
+                           creation_time, column_family)
+        while start < n_total:
+            limit = n_total
+            if (can_cut and cur.num_entries
+                    and cur.w.file_size() >= max_output_file_size):
+                if not same_user_key(start, start - 1):
+                    # Cut HERE (the per-entry path's pre-add check).
+                    sel = order[lo:start]
+                    results.append((cur.fnum, cur.path) + cur.finish(
+                        lib, kv, sel, vtypes, seqs, []
+                    ) + (sel,))
+                    cur = _ColumnarSST(env, dbname, new_file_number(), icmp,
+                                       options, creation_time, column_family)
+                    lo = start
+                else:
+                    # Same user key spans the boundary: all its versions stay
+                    # in this file; bound the block at the end of the run so
+                    # the cut re-check happens there.
+                    j = start
+                    while j < n_total and same_user_key(j, j - 1):
+                        j += 1
+                    limit = j
+            rc = lib.tpulsm_build_block(
+                p_kbuf, p_koff, p_klen, p_vbuf, p_voff, p_vlen, p_tro,
+                p_order, start, limit,
+                options.block_size, options.restart_interval,
+                p_out, out_cap, p_outlen,
             )
-            if largest is None or icmp.compare(end_ikey, largest) > 0:
-                largest = end_ikey
-            props.smallest_seqno = min(props.smallest_seqno, frag.seq)
-            props.largest_seqno = max(props.largest_seqno, frag.seq)
-        rh = fmt.write_block(wfile, rdb.finish(), fmt.NO_COMPRESSION)
-        meta_entries.append((METAINDEX_RANGE_DEL, rh))
-
-    iraw = index_block.finish()
-    props.index_size = len(iraw)
-    pblock = props.encode_block()
-    ph = fmt.write_block(wfile, pblock, fmt.NO_COMPRESSION)
-    meta_entries.append((METAINDEX_PROPERTIES, ph))
-    for name, handle in sorted(meta_entries):
-        metaindex.add(name, handle.encode())
-    mih = fmt.write_block(wfile, metaindex.finish(), fmt.NO_COMPRESSION)
-    ih = fmt.write_block(wfile, iraw, options.compression)
-    wfile.append(fmt.Footer(mih, ih).encode())
-    wfile.flush()
-    return props, smallest, largest
+            if rc == -2:
+                out_cap *= 4
+                out_buf = np.empty(out_cap, dtype=np.uint8)
+                p_out = native.np_u8p(out_buf)
+                continue
+            if rc == -3 or rc == -8:
+                # Key too long for the native stack buffer / restart table
+                # full: the per-entry path handles these.
+                raise NotSupported(
+                    f"native block build unsupported input rc={rc}"
+                )
+            if rc <= 0:
+                raise Corruption(f"native block build failed rc={rc}")
+            raw = out_buf[: int(out_len[0])].tobytes()
+            cur.add_block(raw, entry_key(start),
+                          entry_key(start + int(rc) - 1), int(rc))
+            start += int(rc)
+        sel = order[lo:n_total]
+        results.append((cur.fnum, cur.path) + cur.finish(
+            lib, kv, sel, vtypes, seqs, tombstones
+        ) + (sel,))
+        cur = None
+        return results
+    except BaseException:
+        if cur is not None:
+            cur.w.close()
+            try:
+                env.delete_file(cur.path)
+            except Exception:
+                pass
+        for r in results:
+            try:
+                env.delete_file(r[1])
+            except Exception:
+                pass
+        raise

@@ -242,12 +242,14 @@ def fused_sort_gc(padded: dict, snapshots: list[int], bottommost: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
-def _fused_encode_sort_gc_impl(key_buf, key_offs, key_lens, valid,
+def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
                                snap_hi, snap_lo, num_key_words, bottommost):
     """Columnar encode + sort + GC mask, all ON DEVICE: the host uploads raw
-    internal-key bytes + offsets only (≈half the bytes of pre-built columns)
-    and downloads the survivor order. Tombstone-free jobs only."""
-    n = key_offs.shape[0]
+    internal-key bytes + lengths only (entries are densely packed, so the
+    offsets are an on-device exclusive cumsum) and downloads the survivor
+    order. Tombstone-free jobs only."""
+    n = key_lens.shape[0]
+    key_offs = jnp.cumsum(key_lens) - key_lens  # dense layout: offs from lens
     span = num_key_words * 4
     u32 = jnp.uint32
 
@@ -295,12 +297,18 @@ def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
         )
     n = len(key_offs)
+    # The device derives offsets as an exclusive cumsum of the lengths; that
+    # requires the dense end-to-end layout ColumnarKV scans produce.
+    if n and (int(key_offs[0]) != 0
+              or int(key_offs[-1]) + int(key_lens[-1]) != len(key_buf)
+              or not np.array_equal(
+                  key_offs[1:], (np.cumsum(key_lens) - key_lens)[1:]
+              )):
+        raise NotSupported("fused encode requires densely packed key buffers")
     p = _next_pow2(max(1, n))
     w = (max_key_bytes + 3) // 4
-    offs = np.zeros(p, dtype=np.int32)
-    lens = np.full(p, 8, dtype=np.int32)  # pad rows: 8-byte dummy trailer
+    lens = np.zeros(p, dtype=np.int32)  # pad rows: zero-length (masked)
     valid = np.zeros(p, dtype=bool)
-    offs[:n] = key_offs
     lens[:n] = key_lens
     valid[:n] = True
     snap_hi, snap_lo = _split_snapshots(snapshots)
@@ -312,7 +320,7 @@ def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
     kb = np.zeros(blen, dtype=np.uint8)
     kb[: len(key_buf)] = key_buf
     order, zero_flags, count, has_complex = _fused_encode_sort_gc_impl(
-        kb, offs, lens, valid, snap_hi, snap_lo, w, bool(bottommost),
+        kb, lens, valid, snap_hi, snap_lo, w, bool(bottommost),
     )
     c = int(count)
     return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)

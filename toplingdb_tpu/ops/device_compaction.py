@@ -250,13 +250,12 @@ def _collect_raw_columnar(compaction, table_cache, icmp):
 def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                                     table_options, snapshots, merge_operator,
                                     new_file_number, creation_time,
-                                    device_name):
+                                    device_name, column_family=(0, "default")):
     from toplingdb_tpu.compaction.compaction_job import (
         surviving_tombstone_fragments,
     )
-    from toplingdb_tpu.db import filename
     from toplingdb_tpu.db.version_edit import FileMetaData
-    from toplingdb_tpu.ops.columnar_io import write_table_columnar
+    from toplingdb_tpu.ops.columnar_io import write_tables_columnar
 
     from toplingdb_tpu.utils.status import NotSupported
 
@@ -279,10 +278,13 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # Tombstone-free: encode + sort + GC in ONE device program fed raw
         # key bytes (half the upload of pre-built columns, no host gather).
         mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
-        order, zero_flags, has_complex = ck.fused_encode_sort_gc(
-            kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
-            compaction.bottommost,
-        )
+        try:
+            order, zero_flags, has_complex = ck.fused_encode_sort_gc(
+                kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
+                compaction.bottommost,
+            )
+        except NotSupported:
+            raise _FallbackToEntries()  # non-dense buffers etc.
         if has_complex:
             raise _FallbackToEntries()
         zero_orig = order[zero_flags]
@@ -315,27 +317,29 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     )
     outputs = []
     if len(order) or tombs:
-        fnum = new_file_number()
-        path = filename.table_file_name(dbname, fnum)
-        w = env.new_writable_file(path)
         try:
-            props, smallest, largest = write_table_columnar(
-                w, icmp, table_options, kv, order, trailer_override,
-                col.vtype, seqs, tombs,
+            files = write_tables_columnar(
+                env, dbname, new_file_number, icmp, table_options, kv,
+                order, trailer_override, col.vtype, seqs, tombs,
                 creation_time if creation_time is not None else int(time.time()),
+                max_output_file_size=compaction.max_output_file_size,
+                column_family=column_family,
             )
-            w.sync()
         except NotSupported:
             # Native builder refused (oversized key / restart overflow):
-            # remove the partial file and use the per-entry path.
-            w.close()
-            env.delete_file(path)
+            # the per-entry path handles these (partials already cleaned).
             raise _FallbackToEntries()
-        finally:
-            w.close()
-        if props.num_entries == 0 and props.num_range_deletions == 0:
-            env.delete_file(path)
-        else:
+        from toplingdb_tpu.db.blob import decode_blob_index
+
+        for fnum, path, props, smallest, largest, sel in files:
+            if props.num_entries == 0 and props.num_range_deletions == 0:
+                env.delete_file(path)
+                continue
+            blob_refs = set()
+            bi_mask = col.vtype[sel] == dbformat.ValueType.BLOB_INDEX
+            if bi_mask.any():
+                for oi in sel[bi_mask]:
+                    blob_refs.add(decode_blob_index(kv.value(oi))[0])
             meta = FileMetaData(
                 number=fnum, file_size=env.get_file_size(path),
                 smallest=smallest, largest=largest,
@@ -344,11 +348,12 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 num_entries=props.num_entries,
                 num_deletions=props.num_deletions,
                 num_range_deletions=props.num_range_deletions,
+                blob_refs=sorted(blob_refs),
             )
             outputs.append(meta)
             stats.output_bytes += meta.file_size
             stats.output_files += 1
-            stats.output_records = props.num_entries
+            stats.output_records += props.num_entries
     stats.work_time_usec = int((time.time() - t0) * 1e6)
     return outputs, stats
 
@@ -357,23 +362,25 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
                           table_options, snapshots, merge_operator=None,
                           compaction_filter=None, new_file_number=None,
                           creation_time=None, device_name="tpu",
-                          blob_resolver=None):
+                          blob_resolver=None, blob_gc=None,
+                          column_family=(0, "default")):
     """Device counterpart of run_compaction_to_tables — same signature shape,
-    byte-identical outputs. Jobs that can't cut output files (single-output)
-    with no compaction filter take the fully-columnar native fast path; the
-    rest stream through the per-entry generator."""
+    byte-identical outputs (including output cutting). Jobs without a
+    compaction filter take the fully-columnar native fast path; the rest
+    stream through the per-entry generator. Active blob GC rewrites values,
+    so it routes through the per-entry path."""
     from toplingdb_tpu import native
 
     if (native.lib() is not None
             and compaction_filter is None
+            and (blob_gc is None or not blob_gc.active)
             and getattr(table_options, "format", "block") == "block"
-            and icmp.user_comparator.name() == dbformat.BYTEWISE.name()
-            and compaction.max_output_file_size >= compaction.total_input_bytes()):
+            and icmp.user_comparator.name() == dbformat.BYTEWISE.name()):
         try:
             return _run_device_compaction_columnar(
                 env, dbname, icmp, compaction, table_cache, table_options,
                 snapshots, merge_operator, new_file_number, creation_time,
-                device_name,
+                device_name, column_family,
             )
         except _FallbackToEntries:
             pass
@@ -392,10 +399,20 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
     tombs = surviving_tombstone_fragments(
         rd, snapshots, compaction.bottommost, icmp.user_comparator
     )
-    outputs = build_outputs(
-        env, dbname, icmp, compaction, stream, tombs, new_file_number,
-        table_options, stats,
-        creation_time if creation_time is not None else int(time.time()),
-    )
+    if blob_gc is not None and blob_gc.active:
+        stream = blob_gc.rewrite(stream)
+    try:
+        outputs = build_outputs(
+            env, dbname, icmp, compaction, stream, tombs, new_file_number,
+            table_options, stats,
+            creation_time if creation_time is not None else int(time.time()),
+            column_family=column_family,
+        )
+    except BaseException:
+        if blob_gc is not None:
+            blob_gc.abort()
+        raise
+    if blob_gc is not None:
+        blob_gc.finish()
     stats.work_time_usec = int((time.time() - t0) * 1e6)
     return outputs, stats

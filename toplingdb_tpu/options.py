@@ -63,6 +63,11 @@ class Options:
     # -- blob files (key-value separation, reference db/blob/) ----------
     enable_blob_files: bool = False
     min_blob_size: int = 256
+    # Compaction-time blob GC: rewrite survivors out of the oldest
+    # `age_cutoff` fraction of referenced blob files (reference
+    # enable_blob_garbage_collection / blob_garbage_collection_age_cutoff).
+    enable_blob_garbage_collection: bool = False
+    blob_garbage_collection_age_cutoff: float = 0.25
 
     # -- table format ---------------------------------------------------
     table_options: TableOptions = field(default_factory=TableOptions)

@@ -46,6 +46,7 @@ class TableBuilder:
         icmp: InternalKeyComparator,
         options: TableOptions | None = None,
         column_family_id: int = 0,
+        column_family_name: str = "",
         creation_time: int = 0,
     ):
         self.opts = options or TableOptions()
@@ -62,6 +63,7 @@ class TableBuilder:
             ),
             compression_name=str(self.opts.compression),
             column_family_id=column_family_id,
+            column_family_name=column_family_name,
             creation_time=creation_time,
             smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
         )

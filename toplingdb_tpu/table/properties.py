@@ -32,6 +32,7 @@ class TableProperties:
     smallest_seqno: int = 0
     largest_seqno: int = 0
     column_family_id: int = 0
+    column_family_name: str = ""
     user_collected: dict[str, bytes] = field(default_factory=dict)
 
     _INT_FIELDS = (
@@ -40,7 +41,8 @@ class TableProperties:
         "index_size", "filter_size", "num_data_blocks", "creation_time",
         "smallest_seqno", "largest_seqno", "column_family_id",
     )
-    _STR_FIELDS = ("comparator_name", "filter_policy_name", "compression_name")
+    _STR_FIELDS = ("comparator_name", "filter_policy_name", "compression_name",
+                   "column_family_name")
 
     def encode_block(self) -> bytes:
         b = BlockBuilder(restart_interval=1)

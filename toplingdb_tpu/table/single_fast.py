@@ -38,7 +38,8 @@ class SingleFastTableBuilder:
 
     def __init__(self, wfile, icmp: InternalKeyComparator,
                  options: TableOptions | None = None,
-                 column_family_id: int = 0, creation_time: int = 0):
+                 column_family_id: int = 0, column_family_name: str = "",
+                 creation_time: int = 0):
         self.opts = options or TableOptions()
         self._w = wfile
         self._icmp = icmp
@@ -53,6 +54,7 @@ class SingleFastTableBuilder:
             ),
             compression_name="single_fast",
             column_family_id=column_family_id,
+            column_family_name=column_family_name,
             creation_time=creation_time,
             smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
         )
