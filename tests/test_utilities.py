@@ -681,3 +681,155 @@ def test_wide_columns(tmp_path):
         db.flush()
         db.compact_range()
         assert get_entity(db, b"user1")[b"name"] == b"ada"
+
+
+def test_compact_on_deletion_collector(tmp_db_path):
+    """Collector marks tombstone-dense files; the picker prioritizes them
+    (reference compact_on_deletion_collector.cc)."""
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.table_properties_collector import (
+        CompactOnDeletionCollectorFactory,
+    )
+
+    o = Options(disable_auto_compactions=True)
+    o.table_options.properties_collector_factories = [
+        CompactOnDeletionCollectorFactory(window_size=16, deletion_trigger=8)
+    ]
+    with DB.open(tmp_db_path, o) as db:
+        for i in range(100):
+            db.put(b"k%03d" % i, b"v")
+        for i in range(40, 60):
+            db.delete(b"k%03d" % i)
+        db.flush()
+        f = db.versions.current.files[0][0]
+        assert f.marked_for_compaction, "dense deletions must mark the file"
+        # Sparse deletions (below the window trigger) must NOT mark —
+        # asserted in the SAME session the collector ran in.
+        for i in range(100):
+            db.put(b"s%03d" % i, b"v")
+        db.delete(b"s050")
+        db.flush()
+        newest = max((f for lvl in db.versions.current.files for f in lvl),
+                     key=lambda f: f.number)
+        assert not newest.marked_for_compaction
+    with DB.open(tmp_db_path, Options(disable_auto_compactions=True)) as db:
+        # The mark survives reopen (persisted via the extended NEW_FILE tag).
+        assert any(f.marked_for_compaction
+                   for lvl in db.versions.current.files for f in lvl)
+
+
+def test_user_collected_properties_in_sst(tmp_db_path):
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.table_properties_collector import (
+        TablePropertiesCollector, TablePropertiesCollectorFactory,
+    )
+
+    class Counting(TablePropertiesCollector):
+        def __init__(self):
+            self.n = 0
+
+        def name(self):
+            return "Counting"
+
+        def add_user_key(self, key, value, entry_type, seq, file_size):
+            self.n += 1
+
+        def finish(self):
+            return {"counting.n": str(self.n).encode()}
+
+    class F(TablePropertiesCollectorFactory):
+        def name(self):
+            return "CountingFactory"
+
+        def create(self):
+            return Counting()
+
+    o = Options(disable_auto_compactions=True)
+    o.table_options.properties_collector_factories = [F()]
+    with DB.open(tmp_db_path, o) as db:
+        for i in range(25):
+            db.put(b"k%02d" % i, b"v")
+        db.flush()
+        f = db.versions.current.files[0][0]
+        r = db.table_cache.get_reader(f.number)
+        assert r.properties.user_collected["counting.n"] == b"25"
+
+
+def test_new_merge_operators():
+    import struct
+
+    from toplingdb_tpu.utils.merge_operator import (
+        AggMergeOperator, BytesXOROperator, CassandraValueMergeOperator,
+        SortListOperator, create_merge_operator,
+    )
+
+    x = BytesXOROperator()
+    assert x.full_merge(b"k", b"\x0f\x0f", [b"\xff"]) == b"\xf0\x0f"
+    assert x.partial_merge(b"k", b"\x01", b"\x01") == b"\x00"
+
+    s = SortListOperator()
+    assert s.full_merge(b"k", b"5,1", [b"3", b"2,4"]) == b"1,2,3,4,5"
+
+    a = AggMergeOperator()
+    packed = a.full_merge(b"k", a.pack(b"sum", struct.pack("<Q", 10)),
+                          [a.pack(b"sum", struct.pack("<Q", 5)),
+                           a.pack(b"sum", struct.pack("<Q", 7))])
+    assert struct.unpack("<Q", a._unpack(packed)[1])[0] == 22
+    last = a.full_merge(b"k", None, [a.pack(b"last", b"A"),
+                                     a.pack(b"last", b"B")])
+    assert a._unpack(last)[1] == b"B"
+
+    c = CassandraValueMergeOperator()
+    from toplingdb_tpu.utils import coding
+
+    def row(cid, ts, val):
+        return (coding.encode_varint32(cid) + struct.pack("<Q", ts)
+                + coding.encode_varint32(len(val)) + val)
+
+    merged = c.full_merge(b"k", row(1, 100, b"old") + row(2, 50, b"keep"),
+                          [row(1, 200, b"new")])
+    cols = c._cols(merged)
+    assert cols[1] == (200, b"new") and cols[2] == (50, b"keep")
+
+    for name in ("bytesxor", "sortlist", "aggmerge", "cassandra",
+                 "CassandraValueMergeOperator"):
+        assert create_merge_operator(name) is not None
+
+
+def test_stats_history_and_seqno_time(tmp_db_path):
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.statistics import Statistics
+
+    o = Options(statistics=Statistics(), seqno_time_sample_period_sec=0)
+    with DB.open(tmp_db_path, o) as db:
+        db.put(b"a", b"1")
+        db.persist_stats()
+        db.put(b"b", b"2")
+        db.put(b"c", b"3")
+        db.persist_stats()
+        hist = db.get_stats_history()
+        assert len(hist) == 2
+        from toplingdb_tpu.utils import statistics as st
+
+        # Second sample holds only the delta (2 keys) since the first.
+        assert hist[1][1].get(st.NUMBER_KEYS_WRITTEN) == 2
+        # seqno↔time mapping sampled on every group (period 0).
+        assert len(db.seqno_to_time) >= 1
+        t = db.seqno_to_time.get_proximal_time(db.versions.last_sequence)
+        assert t is not None
+        assert db.seqno_to_time.get_proximal_seqno(2 ** 40) is not None
+
+
+def test_seqno_to_time_mapping_unit():
+    from toplingdb_tpu.utils.seqno_to_time import SeqnoToTimeMapping
+
+    m = SeqnoToTimeMapping(max_capacity=4)
+    for i in range(1, 11):
+        m.append(i * 10, 1000 + i)
+    assert len(m) <= 5
+    assert m.get_proximal_time(5) is None       # predates mapping
+    assert m.get_proximal_time(100) == 1010     # newest pair kept
+    assert m.get_proximal_seqno(999) is None

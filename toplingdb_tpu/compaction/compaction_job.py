@@ -143,6 +143,7 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
             num_deletions=props.num_deletions,
             num_range_deletions=props.num_range_deletions,
             blob_refs=sorted(blob_refs),
+            marked_for_compaction=builder.need_compaction,
         )
         outputs.append(meta)
         stats.output_bytes += meta.file_size

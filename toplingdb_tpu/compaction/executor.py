@@ -35,6 +35,9 @@ import time
 from toplingdb_tpu.compaction.compaction_job import CompactionStats
 from toplingdb_tpu.compaction.picker import Compaction
 from toplingdb_tpu.db import filename
+from toplingdb_tpu.utils.table_properties_collector import (
+    serialize_collector_factory,
+)
 from toplingdb_tpu.db.version_edit import FileMetaData
 from toplingdb_tpu.utils.status import Corruption, IOError_
 
@@ -142,6 +145,7 @@ class CompactionParams:
     device: str = "cpu"
     cf_id: int = 0
     cf_name: str = "default"
+    collectors: list = dataclasses.field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=1)
@@ -182,6 +186,7 @@ def encode_file_meta(meta: FileMetaData, path: str) -> dict:
         "num_deletions": meta.num_deletions,
         "num_range_deletions": meta.num_range_deletions,
         "blob_refs": list(meta.blob_refs),
+        "marked_for_compaction": meta.marked_for_compaction,
     }
 
 
@@ -197,6 +202,7 @@ def decode_file_meta(d: dict, number: int) -> FileMetaData:
         num_deletions=d["num_deletions"],
         num_range_deletions=d["num_range_deletions"],
         blob_refs=list(d.get("blob_refs", [])),
+        marked_for_compaction=d.get("marked_for_compaction", False),
     )
 
 
@@ -271,6 +277,10 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             table_format=getattr(opts.table_options, "format", "block"),
             cf_id=compaction.cf_id,
             cf_name=db.cf_name(compaction.cf_id),
+            collectors=[
+                serialize_collector_factory(f)
+                for f in opts.table_options.properties_collector_factories
+            ],
         )
         with open(os.path.join(job_dir, "params.json"), "w") as f:
             f.write(params.to_json())

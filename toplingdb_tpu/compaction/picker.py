@@ -88,15 +88,28 @@ class LeveledCompactionPicker(CompactionPicker):
         """(score, level) sorted descending; score >= 1.0 needs compaction
         (reference VersionStorageInfo::ComputeCompactionScore)."""
         scores = []
-        n_l0 = len([f for f in version.files[0] if not f.being_compacted])
-        scores.append(
-            (n_l0 / self.options.level0_file_num_compaction_trigger, 0)
-        )
+        l0 = [f for f in version.files[0] if not f.being_compacted]
+        l0_score = len(l0) / self.options.level0_file_num_compaction_trigger
+        if any(f.marked_for_compaction for f in l0):
+            l0_score = max(l0_score, 1.0)
+        scores.append((l0_score, 0))
+        last = version.num_levels - 1
+        if any(f.marked_for_compaction and not f.being_compacted
+               for f in version.files[last]):
+            # Bottommost marked files are rewritten in place (reference
+            # bottommost_files_marked_for_compaction_).
+            scores.append((1.0, last))
         for level in range(1, version.num_levels - 1):
             total = sum(
                 f.file_size for f in version.files[level] if not f.being_compacted
             )
-            scores.append((total / self.options.max_bytes_for_level(level), level))
+            score = total / self.options.max_bytes_for_level(level)
+            if any(f.marked_for_compaction and not f.being_compacted
+                   for f in version.files[level]):
+                # Collector-flagged files (reference
+                # files_marked_for_compaction_) force the level eligible.
+                score = max(score, 1.0)
+            scores.append((score, level))
         scores.sort(key=lambda s: -s[0])
         return scores
 
@@ -110,11 +123,25 @@ class LeveledCompactionPicker(CompactionPicker):
         return None
 
     def _pick_level(self, version: Version, level: int) -> Compaction | None:
+        if level == version.num_levels - 1:
+            # In-place rewrite of a collector-marked bottommost file.
+            marked = [f for f in version.files[level]
+                      if f.marked_for_compaction and not f.being_compacted]
+            if not marked:
+                return None
+            f0 = marked[0]
+            return Compaction(
+                level=level, output_level=level, inputs=[f0],
+                output_level_inputs=[], bottommost=True,
+                reason="bottommost marked",
+                max_output_file_size=self.options.target_file_size(level),
+            )
         if level == 0:
             inputs = [f for f in version.files[0] if not f.being_compacted]
-            if len(inputs) < self.options.level0_file_num_compaction_trigger:
+            if (len(inputs) < self.options.level0_file_num_compaction_trigger
+                    and not any(f.marked_for_compaction for f in inputs)):
                 return None
-            if any(f.being_compacted for f in version.files[0]):
+            if not inputs or any(f.being_compacted for f in version.files[0]):
                 return None  # L0→L1 must take all L0 files; wait
             output_level = 1
         else:
@@ -123,7 +150,8 @@ class LeveledCompactionPicker(CompactionPicker):
             candidates = [f for f in version.files[level] if not f.being_compacted]
             if not candidates:
                 return None
-            inputs = [max(candidates, key=lambda f: f.file_size)]
+            marked = [f for f in candidates if f.marked_for_compaction]
+            inputs = [max(marked or candidates, key=lambda f: f.file_size)]
             output_level = level + 1
         if output_level >= version.num_levels:
             return None

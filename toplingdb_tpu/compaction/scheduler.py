@@ -172,6 +172,12 @@ class CompactionScheduler:
             from toplingdb_tpu.utils.sync_point import sync_point_callback
 
             sync_point_callback("CompactionJob::BeforeInstall", c)
+            if c.reason == "bottommost marked":
+                # The rewrite already dropped everything droppable; keeping a
+                # collector re-mark would rewrite the same file forever while
+                # snapshots pin its remaining tombstones.
+                for m in outputs:
+                    m.marked_for_compaction = False
             edit = make_version_edit(c, outputs)
             with db._mutex:
                 db.versions.log_and_apply(edit)

@@ -56,9 +56,17 @@ def run_job(job_dir: str) -> int:
         create_compaction_filter(params.compaction_filter)
         if params.compaction_filter else None
     )
+    from toplingdb_tpu.utils.table_properties_collector import (
+        create_collector_factory,
+    )
+
     topts = TableOptions(
         block_size=params.block_size, compression=params.compression,
         format=getattr(params, "table_format", "block"),
+        properties_collector_factories=[
+            create_collector_factory(d)
+            for d in getattr(params, "collectors", [])
+        ],
     )
 
     # Read inputs (raw, unsorted — the device sort is the merge).
@@ -141,17 +149,9 @@ def run_job(job_dir: str) -> int:
 
 
 def _merge_operator_by_name(name: str):
-    from toplingdb_tpu.utils.merge_operator import (
-        MaxOperator, PutOperator, StringAppendOperator, UInt64AddOperator,
-    )
+    from toplingdb_tpu.utils.merge_operator import create_merge_operator
 
-    table = {
-        "PutOperator": PutOperator,
-        "UInt64AddOperator": UInt64AddOperator,
-        "StringAppendOperator": StringAppendOperator,
-        "MaxOperator": MaxOperator,
-    }
-    return table[name]()
+    return create_merge_operator(name)
 
 
 class _ListIter:

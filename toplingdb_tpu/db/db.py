@@ -114,6 +114,20 @@ class DB:
         self._mem_id_counter = 0
         self.identity = ""
         self.stats = options.statistics  # may be None
+        from toplingdb_tpu.utils.seqno_to_time import SeqnoToTimeMapping
+        from toplingdb_tpu.utils.stats_history import (
+            StatsDumpScheduler, StatsHistory,
+        )
+
+        self.stats_history = StatsHistory(self.stats)
+        self._stats_dumper = (
+            StatsDumpScheduler(self.stats_history,
+                               options.stats_persist_period_sec)
+            if self.stats is not None and options.stats_persist_period_sec > 0
+            else None
+        )
+        self.seqno_to_time = SeqnoToTimeMapping()
+        self._last_seqno_time_sample = 0.0
         from toplingdb_tpu.utils.listener import EventLogger
 
         self._log_file = None
@@ -290,6 +304,8 @@ class DB:
         self._wal = LogWriter(w)
 
     def close(self) -> None:
+        if self._stats_dumper is not None:
+            self._stats_dumper.stop()
         if self._compaction_scheduler is not None:
             self._compaction_scheduler.shutdown()
         with self._mutex:
@@ -446,6 +462,11 @@ class DB:
             for w in group:
                 w.batch.insert_into(mems)
             self.versions.last_sequence = seq - 1
+            now = time.time()
+            if now - self._last_seqno_time_sample >= \
+                    self.options.seqno_time_sample_period_sec:
+                self._last_seqno_time_sample = now
+                self.seqno_to_time.append(seq - 1, int(now))
             if self.stats is not None:
                 from toplingdb_tpu.utils import statistics as st
 
@@ -847,6 +868,15 @@ class DB:
                     self.env.delete_file(f"{self.dbname}/{child}")
                 except NotFound:
                     pass
+
+    def get_stats_history(self, start_time: int = 0, end_time: int = 2 ** 62):
+        """Time-series ticker deltas (reference DBImpl::GetStatsHistory,
+        db/db_impl/db_impl.cc:1102). Samples are taken every
+        stats_persist_period_sec, or manually via persist_stats()."""
+        return self.stats_history.get(start_time, end_time)
+
+    def persist_stats(self) -> None:
+        self.stats_history.snapshot()
 
     def get_property(self, name: str) -> str | None:
         v = self.versions.current

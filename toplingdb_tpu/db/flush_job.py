@@ -102,4 +102,5 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
             [blob_file_number]
             if blob_builder is not None and blob_builder.num_values else []
         ),
+        marked_for_compaction=builder.need_compaction,
     )

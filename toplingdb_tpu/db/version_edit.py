@@ -25,7 +25,9 @@ TAG_COLUMN_FAMILY = 9           # selects CF for this edit
 TAG_COLUMN_FAMILY_ADD = 10
 TAG_COLUMN_FAMILY_DROP = 11
 TAG_MAX_COLUMN_FAMILY = 12
-TAG_NEW_FILE_BLOBS = 13         # NEW_FILE + trailing blob_refs list
+TAG_NEW_FILE_EXT = 13           # NEW_FILE + varint flags [+ blob_refs list]
+_EXT_FLAG_MARKED = 1            # marked_for_compaction
+_EXT_FLAG_BLOBS = 2             # blob_refs list follows
 
 
 @dataclass
@@ -44,8 +46,16 @@ class FileMetaData:
     num_range_deletions: int = 0
     blob_refs: list[int] = field(default_factory=list)  # referenced blob files
     being_compacted: bool = False  # in-memory only
+    # Set by a TablePropertiesCollector's need_compact() — prioritized by the
+    # picker; persisted via the extended NEW_FILE tag (reference persists it
+    # as a NewFile4 custom field).
+    marked_for_compaction: bool = False
 
-    def encode(self, include_refs: bool = False) -> bytes:
+    def _ext_flags(self) -> int:
+        return ((_EXT_FLAG_MARKED if self.marked_for_compaction else 0)
+                | (_EXT_FLAG_BLOBS if self.blob_refs else 0))
+
+    def encode(self, extended: bool = False) -> bytes:
         out = bytearray()
         out += coding.encode_varint64(self.number)
         out += coding.encode_varint64(self.file_size)
@@ -56,17 +66,20 @@ class FileMetaData:
         out += coding.encode_varint64(self.num_entries)
         out += coding.encode_varint64(self.num_deletions)
         out += coding.encode_varint64(self.num_range_deletions)
-        if include_refs:
-            # Only under TAG_NEW_FILE_BLOBS — TAG_NEW_FILE keeps the original
-            # layout so MANIFESTs written before blob_refs existed still parse.
-            out += coding.encode_varint64(len(self.blob_refs))
-            for fn in self.blob_refs:
-                out += coding.encode_varint64(fn)
+        if extended:
+            # Only under TAG_NEW_FILE_EXT — TAG_NEW_FILE keeps the original
+            # layout so MANIFESTs written before the flags existed still parse.
+            flags = self._ext_flags()
+            out += coding.encode_varint64(flags)
+            if flags & _EXT_FLAG_BLOBS:
+                out += coding.encode_varint64(len(self.blob_refs))
+                for fn in self.blob_refs:
+                    out += coding.encode_varint64(fn)
         return bytes(out)
 
     @staticmethod
     def decode(buf: bytes, off: int,
-               with_refs: bool = False) -> tuple["FileMetaData", int]:
+               extended: bool = False) -> tuple["FileMetaData", int]:
         number, off = coding.decode_varint64(buf, off)
         size, off = coding.decode_varint64(buf, off)
         smallest, off = coding.get_length_prefixed_slice(buf, off)
@@ -76,14 +89,19 @@ class FileMetaData:
         ne, off = coding.decode_varint64(buf, off)
         nd, off = coding.decode_varint64(buf, off)
         nrd, off = coding.decode_varint64(buf, off)
-        refs = []
-        if with_refs:
-            nrefs, off = coding.decode_varint64(buf, off)
-            for _ in range(nrefs):
-                fn, off = coding.decode_varint64(buf, off)
-                refs.append(fn)
+        refs: list[int] = []
+        marked = False
+        if extended:
+            flags, off = coding.decode_varint64(buf, off)
+            marked = bool(flags & _EXT_FLAG_MARKED)
+            if flags & _EXT_FLAG_BLOBS:
+                nrefs, off = coding.decode_varint64(buf, off)
+                for _ in range(nrefs):
+                    fn, off = coding.decode_varint64(buf, off)
+                    refs.append(fn)
         return FileMetaData(number, size, smallest, largest, ssq, lsq,
-                            ne, nd, nrd, refs), off
+                            ne, nd, nrd, refs,
+                            marked_for_compaction=marked), off
 
 
 @dataclass
@@ -147,10 +165,10 @@ class VersionEdit:
             out += coding.encode_varint64(level)
             out += coding.encode_varint64(number)
         for level, meta in self.new_files:
-            has_refs = bool(meta.blob_refs)
-            tag(TAG_NEW_FILE_BLOBS if has_refs else TAG_NEW_FILE)
+            ext = meta._ext_flags() != 0
+            tag(TAG_NEW_FILE_EXT if ext else TAG_NEW_FILE)
             out += coding.encode_varint64(level)
-            out += meta.encode(include_refs=has_refs)
+            out += meta.encode(extended=ext)
         return bytes(out)
 
     @staticmethod
@@ -186,10 +204,10 @@ class VersionEdit:
                 lvl, off = coding.decode_varint64(buf, off)
                 num, off = coding.decode_varint64(buf, off)
                 e.deleted_files.append((lvl, num))
-            elif t == TAG_NEW_FILE or t == TAG_NEW_FILE_BLOBS:
+            elif t == TAG_NEW_FILE or t == TAG_NEW_FILE_EXT:
                 lvl, off = coding.decode_varint64(buf, off)
                 meta, off = FileMetaData.decode(
-                    buf, off, with_refs=(t == TAG_NEW_FILE_BLOBS)
+                    buf, off, extended=(t == TAG_NEW_FILE_EXT)
                 )
                 e.new_files.append((lvl, meta))
             else:

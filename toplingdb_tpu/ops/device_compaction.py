@@ -374,6 +374,7 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
     if (native.lib() is not None
             and compaction_filter is None
             and (blob_gc is None or not blob_gc.active)
+            and not getattr(table_options, "properties_collector_factories", None)
             and getattr(table_options, "format", "block") == "block"
             and icmp.user_comparator.name() == dbformat.BYTEWISE.name()):
         try:

@@ -69,6 +69,14 @@ class Options:
     enable_blob_garbage_collection: bool = False
     blob_garbage_collection_age_cutoff: float = 0.25
 
+    # -- observability --------------------------------------------------
+    # Periodic ticker snapshots for DB.get_stats_history (reference
+    # stats_persist_period_sec; 0 = manual persist_stats() only).
+    stats_persist_period_sec: int = 0
+    # Sampling cadence of the seqno↔time mapping (reference
+    # seqno_to_time_mapping recording period).
+    seqno_time_sample_period_sec: int = 60
+
     # -- table format ---------------------------------------------------
     table_options: TableOptions = field(default_factory=TableOptions)
     compression: int = fmt.NO_COMPRESSION

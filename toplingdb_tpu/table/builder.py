@@ -37,6 +37,9 @@ class TableOptions:
     filter_policy: FilterPolicy | None = field(default_factory=BloomFilterPolicy)
     whole_key_filtering: bool = True
     verify_checksums: bool = True
+    # User TablePropertiesCollectorFactory list (reference
+    # table_properties_collector_factories); a fresh collector per SST.
+    properties_collector_factories: list = field(default_factory=list)
 
 
 class TableBuilder:
@@ -73,6 +76,10 @@ class TableBuilder:
         self._smallest: bytes | None = None
         self._largest: bytes | None = None
         self._finished = False
+        self._collectors = [
+            f.create() for f in self.opts.properties_collector_factories
+        ]
+        self.need_compaction = False
 
     # ------------------------------------------------------------------
 
@@ -110,9 +117,11 @@ class TableBuilder:
             sep = self._icmp.find_shortest_separator(self._last_key, ikey)
             self._index_block.add(sep, self._pending_handle.encode())
             self._pending_index_entry = False
-        uk, _, t = dbformat.split_internal_key(ikey)
+        uk, seq_, t = dbformat.split_internal_key(ikey)
         if self.opts.filter_policy and self.opts.whole_key_filtering:
             self._filter_keys.append(uk)
+        for c in self._collectors:
+            c.add_user_key(uk, value, t, seq_, self._w.file_size())
         self._data_block.add(ikey, value)
         self._last_key = ikey
         self._track_bounds(ikey)
@@ -152,6 +161,10 @@ class TableBuilder:
 
     def finish(self) -> TableProperties:
         assert not self._finished
+        for c in self._collectors:
+            self.props.user_collected.update(c.finish())
+            if c.need_compact():
+                self.need_compaction = True
         self._flush_data_block()
         if self._pending_index_entry:
             succ = self._icmp.find_short_successor(self._last_key)

@@ -62,6 +62,10 @@ class SingleFastTableBuilder:
         self._smallest: bytes | None = None
         self._largest: bytes | None = None
         self._finished = False
+        self._collectors = [
+            f.create() for f in self.opts.properties_collector_factories
+        ]
+        self.need_compaction = False
 
     @property
     def num_entries(self) -> int:
@@ -107,9 +111,11 @@ class SingleFastTableBuilder:
         self._buf += value
         self._last_key = ikey
         self._track_bounds(ikey)
-        uk, _, t = dbformat.split_internal_key(ikey)
+        uk, seq_, t = dbformat.split_internal_key(ikey)
         if self.opts.filter_policy and self.opts.whole_key_filtering:
             self._filter_keys.append(uk)
+        for c in self._collectors:
+            c.add_user_key(uk, value, t, seq_, len(self._buf))
         self.props.num_entries += 1
         self.props.raw_key_size += len(ikey)
         self.props.raw_value_size += len(value)
@@ -132,6 +138,10 @@ class SingleFastTableBuilder:
 
     def finish(self) -> TableProperties:
         assert not self._finished
+        for c in self._collectors:
+            self.props.user_collected.update(c.finish())
+            if c.need_compact():
+                self.need_compaction = True
         data = bytes(self._buf)
         self._w.append(data)  # flat data region at offset 0, unframed
         self.props.data_size = len(data)

@@ -1,0 +1,72 @@
+"""Stats history: time-series snapshots of the Statistics tickers.
+
+Analogue of the reference's InMemoryStatsHistoryIterator /
+PersistentStatsHistoryIterator (monitoring/in_memory_stats_history.cc,
+monitoring/persistent_stats_history.cc; surfaced via DBImpl::GetStatsHistory,
+db/db_impl/db_impl.cc:1102). Snapshots are delta-encoded like the reference
+(each sample stores the ticker increase since the previous sample).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class StatsHistory:
+    """Bounded in-memory ring of (timestamp, {ticker: delta}) samples."""
+
+    def __init__(self, statistics, max_samples: int = 1024):
+        self._stats = statistics
+        self._max = max_samples
+        self._samples: list[tuple[int, dict[str, int]]] = []
+        self._last_absolute: dict[str, int] = {}
+        self._mu = threading.Lock()
+
+    def snapshot(self, now: int | None = None) -> None:
+        """Record the ticker deltas since the previous snapshot."""
+        if self._stats is None:
+            return
+        now = int(time.time()) if now is None else now
+        with self._stats._lock:
+            absolute = dict(self._stats._tickers)
+        with self._mu:
+            delta = {
+                k: v - self._last_absolute.get(k, 0)
+                for k, v in absolute.items()
+                if v - self._last_absolute.get(k, 0)
+            }
+            self._last_absolute = absolute
+            self._samples.append((now, delta))
+            if len(self._samples) > self._max:
+                del self._samples[: len(self._samples) - self._max]
+
+    def get(self, start_time: int = 0,
+            end_time: int = 2 ** 62) -> list[tuple[int, dict[str, int]]]:
+        """Samples with start_time <= ts < end_time (reference
+        GetStatsHistory contract)."""
+        with self._mu:
+            return [
+                (ts, dict(d)) for ts, d in self._samples
+                if start_time <= ts < end_time
+            ]
+
+
+class StatsDumpScheduler:
+    """Periodic snapshot thread (reference stats_persist_period_sec /
+    the periodic task scheduler). Daemonized; stop() joins."""
+
+    def __init__(self, history: StatsHistory, period_sec: float):
+        self._history = history
+        self._period = period_sec
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._period):
+            self._history.snapshot()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
