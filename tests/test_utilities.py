@@ -833,3 +833,84 @@ def test_seqno_to_time_mapping_unit():
     assert m.get_proximal_time(5) is None       # predates mapping
     assert m.get_proximal_time(100) == 1010     # newest pair kept
     assert m.get_proximal_seqno(999) is None
+
+
+def test_persistent_cache_spill_and_restart(tmp_path):
+    """Evicted LRU blocks spill to the persistent tier, misses promote back,
+    and the on-disk index survives a restart (reference
+    utilities/persistent_cache + SecondaryCache promotion)."""
+    from toplingdb_tpu.utils.cache import LRUCache
+    from toplingdb_tpu.utils.persistent_cache import PersistentCache
+
+    pdir = str(tmp_path / "pcache")
+    sec = PersistentCache(pdir, capacity_bytes=1 << 20, file_size=8 * 1024)
+    lru = LRUCache(4 * 1024, num_shards=1, secondary=sec)
+    blocks = {b"blk%03d" % i: bytes([i % 256]) * 512 for i in range(32)}
+    for k, v in blocks.items():
+        lru.insert(k, v, len(v))
+    # Early blocks were evicted from the 4KiB primary — must hit via disk.
+    assert lru.lookup(b"blk000") == blocks[b"blk000"]
+    assert sec.hits >= 1
+    # Promotion: now resident in primary (no new secondary hit needed).
+    h = sec.hits
+    assert lru.lookup(b"blk000") == blocks[b"blk000"]
+    assert sec.hits == h
+    sec.close()
+    # Restart: index rebuilt from the cache files.
+    sec2 = PersistentCache(pdir, capacity_bytes=1 << 20, file_size=8 * 1024)
+    assert sec2.lookup(b"blk005") == blocks[b"blk005"]
+    sec2.close()
+
+
+def test_persistent_cache_capacity_eviction(tmp_path):
+    import os
+
+    from toplingdb_tpu.utils.persistent_cache import PersistentCache
+
+    pdir = str(tmp_path / "pc2")
+    pc = PersistentCache(pdir, capacity_bytes=32 * 1024, file_size=8 * 1024)
+    for i in range(200):
+        pc.insert(b"k%04d" % i, b"x" * 500)
+    assert pc.usage() <= 40 * 1024  # capacity + one in-flight file
+    assert pc.lookup(b"k0199") is not None  # newest kept
+    assert pc.lookup(b"k0000") is None      # oldest file dropped
+    assert len(os.listdir(pdir)) <= 6
+    pc.close()
+
+
+def test_persistent_cache_ignores_corrupt_tail(tmp_path):
+    import os
+
+    from toplingdb_tpu.utils.persistent_cache import PersistentCache
+
+    pdir = str(tmp_path / "pc3")
+    pc = PersistentCache(pdir, capacity_bytes=1 << 20)
+    pc.insert(b"good", b"G" * 100)
+    pc.insert(b"torn", b"T" * 100)
+    pc.close()
+    f = sorted(os.listdir(pdir))[0]
+    path = os.path.join(pdir, f)
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:-30])  # tear the last record
+    pc2 = PersistentCache(pdir, capacity_bytes=1 << 20)
+    assert pc2.lookup(b"good") == b"G" * 100
+    assert pc2.lookup(b"torn") is None
+    pc2.close()
+
+
+def test_db_with_block_cache_and_persistent_tier(tmp_db_path, tmp_path):
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.cache import LRUCache
+    from toplingdb_tpu.utils.persistent_cache import PersistentCache
+
+    sec = PersistentCache(str(tmp_path / "pc"), capacity_bytes=1 << 20)
+    o = Options(disable_auto_compactions=True,
+                block_cache=LRUCache(8 * 1024, secondary=sec))
+    with DB.open(tmp_db_path, o) as db:
+        for i in range(2000):
+            db.put(b"key%05d" % i, b"v%05d" % i)
+        db.flush()
+        for i in range(0, 2000, 17):
+            assert db.get(b"key%05d" % i) == b"v%05d" % i
+    sec.close()

@@ -220,6 +220,7 @@ class CompactionScheduler:
             blob_resolver=db.blob_source.get,
             blob_gc=maybe_new_blob_gc(db, c, alloc),
             column_family=(c.cf_id, db.cf_name(c.cf_id)),
+            max_subcompactions=db.options.max_subcompactions,
         )
 
     # ------------------------------------------------------------------

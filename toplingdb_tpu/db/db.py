@@ -93,7 +93,9 @@ class DB:
         self.env = env
         self.icmp = InternalKeyComparator(options.comparator)
         self.versions = VersionSet(env, dbname, self.icmp, options.num_levels)
-        self.table_cache = TableCache(env, dbname, self.icmp, options.table_options)
+        self.table_cache = TableCache(env, dbname, self.icmp,
+                                      options.table_options,
+                                      block_cache=options.block_cache)
         self.default_cf = ColumnFamilyHandle(0, "default")
         self._cfs: dict[int, _CFData] = {
             0: _CFData(self.default_cf, self.icmp, options.memtable_rep)

@@ -16,12 +16,18 @@ class TableCache:
     def __init__(self, env, dbname: str, icmp: InternalKeyComparator,
                  table_options: TableOptions | None = None, capacity: int = 512,
                  block_cache=None):
+        import uuid
+
         self._env = env
         self._dbname = dbname
         self._icmp = icmp
         self._topts = table_options or TableOptions()
         self._capacity = capacity
         self._block_cache = block_cache
+        # Per-DB-open uniquifier: a shared block cache (reference cache-key
+        # session id) must never serve one DB's blocks to another DB whose
+        # file numbers collide.
+        self._cache_session = uuid.uuid4().bytes[:8]
         self._readers: OrderedDict[int, TableReader] = OrderedDict()
         self._lock = threading.Lock()
 
@@ -35,7 +41,7 @@ class TableCache:
         r = open_table(
             self._env.new_random_access_file(path), self._icmp, self._topts,
             block_cache=self._block_cache,
-            cache_key_prefix=file_number.to_bytes(8, "little"),
+            cache_key_prefix=self._cache_session + file_number.to_bytes(8, "little"),
         )
         with self._lock:
             existing = self._readers.get(file_number)

@@ -72,6 +72,15 @@ class Version:
                 dbformat.extract_user_key(fl[pick].smallest), user_key
             ) <= 0:
                 yield level, fl[pick]
+                # A range tombstone's exclusive end widens a file's largest
+                # bound to (end_uk, MAX_SEQ); the NEXT file may legally start
+                # at the same user key (reference FilePicker walks files
+                # while the user key still overlaps).
+                while (pick + 1 < len(fl) and ucmp.compare(
+                        dbformat.extract_user_key(fl[pick + 1].smallest),
+                        user_key) <= 0):
+                    pick += 1
+                    yield level, fl[pick]
 
     def num_files(self) -> int:
         return sum(len(fl) for fl in self.files)

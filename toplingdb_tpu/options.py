@@ -77,6 +77,12 @@ class Options:
     # seqno_to_time_mapping recording period).
     seqno_time_sample_period_sec: int = 60
 
+    # -- caches ---------------------------------------------------------
+    # Shared block cache (utils.cache.LRUCache; optionally backed by a
+    # utils.persistent_cache.PersistentCache secondary tier). None = the
+    # reader's per-file behavior without a shared cache.
+    block_cache: Optional[object] = None
+
     # -- table format ---------------------------------------------------
     table_options: TableOptions = field(default_factory=TableOptions)
     compression: int = fmt.NO_COMPRESSION

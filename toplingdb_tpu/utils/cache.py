@@ -1,5 +1,8 @@
 """Block cache: sharded LRU with optional strict capacity
-(reference cache/lru_cache.cc, cache/sharded_cache.h in /root/reference).
+(reference cache/lru_cache.cc, cache/sharded_cache.h in /root/reference),
+plus an optional secondary tier (reference SecondaryCache /
+utilities/persistent_cache): evicted byte values spill to the secondary,
+and primary misses promote secondary hits back.
 Plugged into TableReader via TableCache(block_cache=...)."""
 
 from __future__ import annotations
@@ -9,25 +12,37 @@ from collections import OrderedDict
 
 
 class LRUCache:
-    def __init__(self, capacity_bytes: int, num_shards: int = 16):
+    def __init__(self, capacity_bytes: int, num_shards: int = 16,
+                 secondary=None):
         self._shards = [
-            _Shard(max(1, capacity_bytes // num_shards))
+            _Shard(max(1, capacity_bytes // num_shards),
+                   spill=secondary.insert if secondary is not None else None)
             for _ in range(num_shards)
         ]
         self._n = num_shards
         self.capacity = capacity_bytes
+        self.secondary = secondary
 
     def _shard(self, key: bytes) -> "_Shard":
         return self._shards[hash(key) % self._n]
 
     def lookup(self, key: bytes):
-        return self._shard(key).lookup(key)
+        v = self._shard(key).lookup(key)
+        if v is None and self.secondary is not None:
+            v = self.secondary.lookup(key)
+            if v is not None:
+                self._shard(key).insert(key, v, len(v))  # promote
+        return v
 
     def insert(self, key: bytes, value, charge: int) -> None:
         self._shard(key).insert(key, value, charge)
 
     def erase(self, key: bytes) -> None:
         self._shard(key).erase(key)
+        if self.secondary is not None:
+            erase = getattr(self.secondary, "erase", None)
+            if erase is not None:
+                erase(key)  # or the secondary would resurrect the entry
 
     def usage(self) -> int:
         return sum(s.usage for s in self._shards)
@@ -39,13 +54,14 @@ class LRUCache:
 
 
 class _Shard:
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, spill=None):
         self._cap = capacity
         self._items: OrderedDict[bytes, tuple[object, int]] = OrderedDict()
         self.usage = 0
         self.hits = 0
         self.misses = 0
         self._mu = threading.Lock()
+        self._spill = spill  # secondary.insert(key, value) on eviction
 
     def lookup(self, key: bytes):
         with self._mu:
@@ -64,9 +80,14 @@ class _Shard:
                 self.usage -= old[1]
             self._items[key] = (value, charge)
             self.usage += charge
+            evicted = []
             while self.usage > self._cap and self._items:
-                _, (_, c) = self._items.popitem(last=False)
+                k, (v, c) = self._items.popitem(last=False)
                 self.usage -= c
+                evicted.append((k, v))
+        if self._spill is not None:
+            for k, v in evicted:
+                self._spill(k, v)
 
     def erase(self, key: bytes) -> None:
         with self._mu:

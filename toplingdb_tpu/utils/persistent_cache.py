@@ -1,0 +1,160 @@
+"""Persistent (on-disk) block cache tier.
+
+Analogue of the reference's persistent cache / compressed secondary cache
+(utilities/persistent_cache/, cache/compressed_secondary_cache.cc in
+/root/reference): blocks evicted from the in-memory LRU spill to local
+cache files; lookups that miss memory are served from disk and promoted
+back. Survives process restarts (the index is rebuilt by scanning the
+cache files; CRC-checked records, torn tails ignored).
+
+Layout: `cache-NNNNNN.data` files of records
+    varint32 klen | varint32 vlen | key | value | fixed32 masked_crc(value)
+rolled at `file_size` bytes; eviction drops whole files oldest-first once
+total size exceeds `capacity` (the reference's persistent cache evicts at
+file granularity too).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from toplingdb_tpu.utils import coding, crc32c
+
+
+class PersistentCache:
+    def __init__(self, path: str, capacity_bytes: int = 256 << 20,
+                 file_size: int = 4 << 20):
+        self._dir = path
+        self._cap = capacity_bytes
+        self._file_size = max(4096, file_size)
+        self._index: dict[bytes, tuple[int, int, int]] = {}  # key -> (file, off, vlen)
+        self._files: list[int] = []       # file numbers, oldest first
+        self._sizes: dict[int, int] = {}
+        self._cur: int | None = None
+        self._cur_f = None
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        os.makedirs(path, exist_ok=True)
+        self._recover()
+
+    # -- layout helpers -------------------------------------------------
+
+    def _fname(self, num: int) -> str:
+        return os.path.join(self._dir, f"cache-{num:06d}.data")
+
+    def _recover(self) -> None:
+        nums = sorted(
+            int(n[len("cache-"):-len(".data")])
+            for n in os.listdir(self._dir)
+            if n.startswith("cache-") and n.endswith(".data")
+        )
+        for num in nums:
+            path = self._fname(num)
+            try:
+                data = open(path, "rb").read()
+            except OSError:
+                continue
+            off = 0
+            while off < len(data):
+                try:
+                    klen, o = coding.decode_varint32(data, off)
+                    vlen, o = coding.decode_varint32(data, o)
+                    key = bytes(data[o : o + klen])
+                    vo = o + klen
+                    value = data[vo : vo + vlen]
+                    stored = coding.decode_fixed32(data, vo + vlen)
+                    if len(value) != vlen or crc32c.unmask(stored) != \
+                            crc32c.value(value):
+                        break  # torn/corrupt tail: ignore the rest
+                    self._index[key] = (num, vo, vlen)
+                    off = vo + vlen + 4
+                except Exception:
+                    break
+            self._files.append(num)
+            self._sizes[num] = off
+        self._enforce_capacity()
+
+    # -- cache interface ------------------------------------------------
+
+    def lookup(self, key: bytes) -> bytes | None:
+        with self._mu:
+            loc = self._index.get(key)
+        if loc is None:
+            self.misses += 1
+            return None
+        num, off, vlen = loc
+        try:
+            with open(self._fname(num), "rb") as f:
+                f.seek(off)
+                value = f.read(vlen)
+        except OSError:
+            return None
+        if len(value) != vlen:
+            return None
+        self.hits += 1
+        return value
+
+    def insert(self, key: bytes, value: bytes) -> None:
+        if not isinstance(value, (bytes, bytearray)):
+            return  # only raw blocks spill to disk
+        rec = bytearray()
+        rec += coding.encode_varint32(len(key))
+        rec += coding.encode_varint32(len(value))
+        rec += key
+        voff_in_rec = len(rec)
+        rec += value
+        rec += coding.encode_fixed32(crc32c.mask(crc32c.value(bytes(value))))
+        with self._mu:
+            if key in self._index:
+                return
+            if self._cur_f is None or \
+                    self._sizes.get(self._cur, 0) >= self._file_size:
+                self._roll_locked()
+            base = self._sizes[self._cur]
+            self._cur_f.write(rec)
+            self._cur_f.flush()
+            self._index[key] = (self._cur, base + voff_in_rec, len(value))
+            self._sizes[self._cur] = base + len(rec)
+            self._enforce_capacity()
+
+    def _roll_locked(self) -> None:
+        if self._cur_f is not None:
+            self._cur_f.close()
+        num = (self._files[-1] + 1) if self._files else 0
+        self._cur = num
+        self._files.append(num)
+        self._sizes[num] = 0
+        self._cur_f = open(self._fname(num), "ab")
+
+    def _enforce_capacity(self) -> None:
+        while sum(self._sizes.values()) > self._cap and len(self._files) > 1:
+            old = self._files.pop(0)
+            if old == self._cur:
+                self._files.insert(0, old)
+                break
+            self._index = {
+                k: loc for k, loc in self._index.items() if loc[0] != old
+            }
+            self._sizes.pop(old, None)
+            try:
+                os.remove(self._fname(old))
+            except OSError:
+                pass
+
+    def erase(self, key: bytes) -> None:
+        """Drop the index entry (the record's bytes are reclaimed when its
+        file ages out — file-granularity storage, key-granularity delete)."""
+        with self._mu:
+            self._index.pop(key, None)
+
+    def close(self) -> None:
+        with self._mu:
+            if self._cur_f is not None:
+                self._cur_f.close()
+                self._cur_f = None
+
+    def usage(self) -> int:
+        with self._mu:
+            return sum(self._sizes.values())
