@@ -539,3 +539,31 @@ def test_repair_db_multi_cf(tmp_db_path):
         assert db.get(b"wal-d") == b"1"
         assert db.get(b"wal-m", cf=cf) == b"2"
         assert db.get(b"mk") is None, "CF data must not leak into default"
+
+
+def test_write_buffer_manager_across_dbs(tmp_path):
+    """A shared WriteBufferManager budget forces early flushes across DB
+    instances and tracks usage (reference write_buffer_manager.h:37)."""
+    from toplingdb_tpu.utils.rate_limiter import WriteBufferManager
+
+    wbm = WriteBufferManager(24 * 1024)
+    o1 = opts(write_buffer_size=1 << 26, write_buffer_manager=wbm)
+    o2 = opts(write_buffer_size=1 << 26, write_buffer_manager=wbm)
+    with DB.open(str(tmp_path / "db1"), o1) as db1, \
+            DB.open(str(tmp_path / "db2"), o2) as db2:
+        for i in range(400):
+            db1.put(b"a%04d" % i, b"x" * 40)
+            db2.put(b"b%04d" % i, b"y" * 40)
+        # Per-DB write_buffer_size (64MiB) would never flush; the shared
+        # 24KiB budget must have.
+        flushed = (db1.versions.current.num_files()
+                   + db2.versions.current.num_files())
+        assert flushed > 0, "shared budget never triggered a flush"
+        assert wbm.memory_usage() <= 64 * 1024
+        assert db1.get(b"a0000") == b"x" * 40
+        assert db2.get(b"b0399") == b"y" * 40
+        # Manual flush must release the charge too (not only close).
+        db1.flush()
+        db2.flush()
+        assert wbm.memory_usage() == 0, "flush must release the DB's charge"
+    assert wbm.memory_usage() == 0, "close must release the DB's charge"

@@ -128,3 +128,85 @@ def test_empty_table():
     it = r.new_iterator()
     it.seek_to_first()
     assert not it.valid()
+
+
+def test_two_level_index_parity(mem_env):
+    """Partitioned (two-level) index: same read behavior as the flat index
+    (reference kTwoLevelIndexSearch partitioned index)."""
+    from toplingdb_tpu.db import dbformat
+    from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
+    from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+    from toplingdb_tpu.table.reader import TableReader
+
+    icmp = InternalKeyComparator(dbformat.BYTEWISE)
+    entries = [
+        (dbformat.make_internal_key(b"key%05d" % i, 100 + i, ValueType.VALUE),
+         b"val%05d" % i)
+        for i in range(5000)
+    ]
+    readers = {}
+    for kind in ("binary", "two_level"):
+        path = f"/{kind}.sst"
+        w = mem_env.new_writable_file(path)
+        b = TableBuilder(w, icmp, TableOptions(
+            block_size=256, index_type=kind, metadata_block_size=512,
+        ))
+        for k, v in entries:
+            b.add(k, v)
+        props = b.finish()
+        w.close()
+        assert props.index_type == kind
+        r = TableReader(mem_env.new_random_access_file(path), icmp,
+                        TableOptions(block_size=256))
+        assert r.properties.index_type == kind
+        readers[kind] = r
+    flat, part = readers["binary"], readers["two_level"]
+    assert part._partitioned_index and not flat._partitioned_index
+    # Top-level index must be much smaller than the flat one.
+    assert len(part._index_data) < len(flat._index_data) / 4
+    # Full scan equality.
+    itf, itp = flat.new_iterator(), part.new_iterator()
+    itf.seek_to_first(); itp.seek_to_first()
+    assert list(itf.entries()) == list(itp.entries())
+    # Seeks across partitions, boundaries, misses.
+    for probe in (b"key00000", b"key02500", b"key04999", b"key03333x",
+                  b"aaa", b"zzz"):
+        t = dbformat.make_internal_key(probe, 2 ** 40, ValueType.VALUE)
+        itf, itp = flat.new_iterator(), part.new_iterator()
+        itf.seek(t); itp.seek(t)
+        assert itf.valid() == itp.valid(), probe
+        if itf.valid():
+            assert itf.key() == itp.key() and itf.value() == itp.value()
+    # Reverse iteration parity.
+    itf, itp = flat.new_iterator(), part.new_iterator()
+    itf.seek_to_last(); itp.seek_to_last()
+    got_f, got_p = [], []
+    while itf.valid():
+        got_f.append(itf.key()); itf.prev()
+    while itp.valid():
+        got_p.append(itp.key()); itp.prev()
+    assert got_f == got_p
+    assert part.anchors(8) == flat.anchors(8)
+
+
+def test_two_level_index_in_db_compaction(tmp_path):
+    """A DB configured with partitioned indexes round-trips through flush,
+    compaction (device fast path falls back), and reopen."""
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    d = str(tmp_path / "db")
+    o = Options(write_buffer_size=16 * 1024, disable_auto_compactions=True)
+    o.table_options.index_type = "two_level"
+    o.table_options.metadata_block_size = 512
+    with DB.open(d, o) as db:
+        for i in range(4000):
+            db.put(b"key%05d" % (i % 3000), b"v%05d" % i)
+        db.flush()
+        db.compact_range()
+        assert db.get(b"key01500") is not None
+        f = [f for lvl in db.versions.current.files for f in lvl][0]
+        assert db.table_cache.get_reader(f.number).properties.index_type == \
+            "two_level"
+    with DB.open(d, o) as db:
+        assert db.get(b"key02999") == b"v%05d" % 2999

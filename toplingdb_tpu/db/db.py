@@ -130,6 +130,7 @@ class DB:
         )
         self.seqno_to_time = SeqnoToTimeMapping()
         self._last_seqno_time_sample = 0.0
+        self._wbm_charged = 0  # bytes charged to options.write_buffer_manager
         from toplingdb_tpu.utils.listener import EventLogger
 
         self._log_file = None
@@ -318,6 +319,10 @@ class DB:
             if self._wal is not None:
                 self._wal.sync()
                 self._wal.close()
+            wbm = self.options.write_buffer_manager
+            if wbm is not None and self._wbm_charged:
+                wbm.free(self._wbm_charged)
+                self._wbm_charged = 0
             self.versions.close()
             self.table_cache.close()
             self.blob_source.close()
@@ -481,9 +486,32 @@ class DB:
             total_mem = sum(
                 c.mem.approximate_memory_usage() for c in self._cfs.values()
             )
-            if total_mem >= self.options.write_buffer_size:
+            wbm = self.options.write_buffer_manager
+            self._sync_wbm()
+            if total_mem >= self.options.write_buffer_size or (
+                    wbm is not None and wbm.should_flush()
+                    and total_mem >= 4096):  # floor: don't thrash tiny DBs
                 self._switch_memtable()
                 self._flush_immutables()
+
+    def _sync_wbm(self) -> None:
+        """Reconcile this DB's memtable memory with the shared
+        WriteBufferManager (reference WriteBufferManager charging) — called
+        wherever memtable memory changes (writes AND flushes)."""
+        wbm = self.options.write_buffer_manager
+        if wbm is None:
+            return
+        total = sum(
+            c.mem.approximate_memory_usage()
+            + sum(m.approximate_memory_usage() for m in c.imm)
+            for c in self._cfs.values()
+        )
+        delta = total - self._wbm_charged
+        if delta > 0:
+            wbm.reserve(delta)
+        elif delta < 0:
+            wbm.free(-delta)
+        self._wbm_charged = total
 
     def _switch_memtable(self) -> None:
         """Seal every CF's non-empty active memtable and start a new WAL
@@ -514,6 +542,7 @@ class DB:
             self.versions.log_and_apply(VersionEdit(log_number=self._wal_number))
             self._delete_obsolete_files()
             self._maybe_schedule_compaction()
+        self._sync_wbm()
 
     def _flush_memtables(self, mems: list[MemTable], wal_number: int | None,
                          cf_id: int = 0) -> None:

@@ -85,9 +85,9 @@ def scan_table_columnar(reader) -> ColumnarKV:
     lib = native.lib()
     if lib is None:
         raise NotSupported("native library unavailable")
-    if not hasattr(reader, "_index_data"):
+    if not hasattr(reader, "new_index_iterator"):
         raise NotSupported("bulk columnar scan requires the block format")
-    idx = BlockIter(reader._index_data, reader._icmp.compare)
+    idx = reader.new_index_iterator()  # flat or partitioned
     idx.seek_to_first()
     handles = [
         fmt.BlockHandle.decode_exact(enc) for _, enc in idx.entries()

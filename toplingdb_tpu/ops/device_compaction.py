@@ -376,6 +376,7 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
             and (blob_gc is None or not blob_gc.active)
             and not getattr(table_options, "properties_collector_factories", None)
             and getattr(table_options, "format", "block") == "block"
+            and getattr(table_options, "index_type", "binary") == "binary"
             and icmp.user_comparator.name() == dbformat.BYTEWISE.name()):
         try:
             return _run_device_compaction_columnar(

@@ -77,6 +77,11 @@ class Options:
     # seqno_to_time_mapping recording period).
     seqno_time_sample_period_sec: int = 60
 
+    # Cross-DB memtable memory budget (utils.rate_limiter.WriteBufferManager;
+    # reference write_buffer_manager.h:37). Shared between DB instances;
+    # over budget, writers flush their memtables early.
+    write_buffer_manager: Optional[object] = None
+
     # -- caches ---------------------------------------------------------
     # Shared block cache (utils.cache.LRUCache; optionally backed by a
     # utils.persistent_cache.PersistentCache secondary tier). None = the

@@ -33,6 +33,12 @@ class TableOptions:
     block_size: int = 4096
     restart_interval: int = 16
     index_restart_interval: int = 1
+    # 'binary' = one in-memory index block; 'two_level' = partitioned index
+    # (reference kTwoLevelIndexSearch / partitioned index-filter): index
+    # entries split into metadata_block_size partitions behind a small top
+    # index, loaded lazily and block-cached — the big-SST memory saver.
+    index_type: str = "binary"
+    metadata_block_size: int = 4096
     compression: int = fmt.NO_COMPRESSION
     filter_policy: FilterPolicy | None = field(default_factory=BloomFilterPolicy)
     whole_key_filtering: bool = True
@@ -56,7 +62,14 @@ class TableBuilder:
         self._w = wfile
         self._icmp = icmp
         self._data_block = BlockBuilder(self.opts.restart_interval)
-        self._index_block = BlockBuilder(self.opts.index_restart_interval)
+        self._two_level_index = self.opts.index_type == "two_level"
+        # Flat index builds incrementally (prefix-compressed as we go); only
+        # the partitioned mode needs the entries buffered for chunking.
+        self._index_block = (
+            None if self._two_level_index
+            else BlockBuilder(self.opts.index_restart_interval)
+        )
+        self._index_entries: list[tuple[bytes, bytes]] = []  # two-level only
         self._filter_keys: list[bytes] = []
         self._range_del_block = BlockBuilder(restart_interval=1)
         self.props = TableProperties(
@@ -115,7 +128,7 @@ class TableBuilder:
             )
         if self._pending_index_entry:
             sep = self._icmp.find_shortest_separator(self._last_key, ikey)
-            self._index_block.add(sep, self._pending_handle.encode())
+            self._index_add(sep, self._pending_handle.encode())
             self._pending_index_entry = False
         uk, seq_, t = dbformat.split_internal_key(ikey)
         if self.opts.filter_policy and self.opts.whole_key_filtering:
@@ -149,6 +162,12 @@ class TableBuilder:
         if self._largest is None or self._icmp.compare(end_ikey, self._largest) > 0:
             self._largest = end_ikey
 
+    def _index_add(self, key: bytes, handle_bytes: bytes) -> None:
+        if self._index_block is not None:
+            self._index_block.add(key, handle_bytes)
+        else:
+            self._index_entries.append((key, handle_bytes))
+
     def _flush_data_block(self) -> None:
         if self._data_block.empty():
             return
@@ -168,7 +187,7 @@ class TableBuilder:
         self._flush_data_block()
         if self._pending_index_entry:
             succ = self._icmp.find_short_successor(self._last_key)
-            self._index_block.add(succ, self._pending_handle.encode())
+            self._index_add(succ, self._pending_handle.encode())
             self._pending_index_entry = False
 
         metaindex = BlockBuilder(restart_interval=1)
@@ -186,8 +205,44 @@ class TableBuilder:
             meta_entries.append((METAINDEX_RANGE_DEL, rh))
 
         # Index size must be known before the properties block is serialized.
-        iraw = self._index_block.finish()
-        self.props.index_size = len(iraw)
+        two_level = self._two_level_index and len(self._index_entries) > 1
+        self.props.index_type = "two_level" if two_level else "binary"
+        if two_level:
+            # Partition blocks go to the file now; the footer's index handle
+            # points at the small top-level index over them.
+            top = BlockBuilder(self.opts.index_restart_interval)
+            part = BlockBuilder(self.opts.index_restart_interval)
+            part_size = 0
+            last_key = None
+            total = 0
+            for k, v in self._index_entries:
+                part.add(k, v)
+                part_size += len(k) + len(v) + 8
+                last_key = k
+                if part_size >= self.opts.metadata_block_size:
+                    raw = part.finish()
+                    ph = fmt.write_block(self._w, raw, self.opts.compression)
+                    top.add(last_key, ph.encode())
+                    total += len(raw)
+                    part = BlockBuilder(self.opts.index_restart_interval)
+                    part_size = 0
+            if part_size:
+                raw = part.finish()
+                ph = fmt.write_block(self._w, raw, self.opts.compression)
+                top.add(last_key, ph.encode())
+                total += len(raw)
+            iraw = top.finish()
+            self.props.index_size = total + len(iraw)
+        elif self._index_block is not None:
+            iraw = self._index_block.finish()
+            self.props.index_size = len(iraw)
+        else:
+            # two_level requested but 0-1 index entries: flat degenerate.
+            flat = BlockBuilder(self.opts.index_restart_interval)
+            for k, v in self._index_entries:
+                flat.add(k, v)
+            iraw = flat.finish()
+            self.props.index_size = len(iraw)
 
         pblock = self.props.encode_block()
         ph = fmt.write_block(self._w, pblock, fmt.NO_COMPRESSION)

@@ -33,6 +33,7 @@ class TableProperties:
     largest_seqno: int = 0
     column_family_id: int = 0
     column_family_name: str = ""
+    index_type: str = "binary"  # 'binary' | 'two_level' (partitioned)
     user_collected: dict[str, bytes] = field(default_factory=dict)
 
     _INT_FIELDS = (
@@ -42,7 +43,7 @@ class TableProperties:
         "smallest_seqno", "largest_seqno", "column_family_id",
     )
     _STR_FIELDS = ("comparator_name", "filter_policy_name", "compression_name",
-                   "column_family_name")
+                   "column_family_name", "index_type")
 
     def encode_block(self) -> bytes:
         b = BlockBuilder(restart_interval=1)

@@ -68,6 +68,11 @@ class TableReader:
         if rh is not None:
             self._range_del_data = fmt.read_block(rfile, rh, self.opts.verify_checksums)
 
+        # Partitioned index: _index_data is the small top-level index; the
+        # partition blocks load lazily through the block cache (reference
+        # partitioned index readers, table/block_based/partitioned_index_*).
+        self._partitioned_index = self.properties.index_type == "two_level"
+
     # ------------------------------------------------------------------
 
     def close(self) -> None:
@@ -92,6 +97,13 @@ class TableReader:
     def new_iterator(self) -> "TableIterator":
         return TableIterator(self)
 
+    def new_index_iterator(self):
+        """Iterator over (separator_key, data BlockHandle bytes) — flat or
+        partition-hopping depending on the file's index_type."""
+        if self._partitioned_index:
+            return _PartitionedIndexIter(self)
+        return BlockIter(self._index_data, self._icmp.compare)
+
     def range_del_entries(self) -> list[tuple[bytes, bytes]]:
         """Raw (begin_internal_key, end_user_key) tombstones in this file
         (parsed once, cached)."""
@@ -106,7 +118,7 @@ class TableReader:
     def approximate_offset_of(self, ikey: bytes) -> int:
         """Approximate file offset of ikey (reference TableReader::
         ApproximateOffsetOf) — used for subcompaction boundary sizing."""
-        idx = BlockIter(self._index_data, self._icmp.compare)
+        idx = self.new_index_iterator()
         idx.seek(ikey)
         if idx.valid():
             return fmt.BlockHandle.decode_exact(idx.value()).offset
@@ -116,7 +128,7 @@ class TableReader:
         """Sampled keys for subcompaction boundary picking (reference
         TableReader::Anchors, used by GenSubcompactionBoundaries,
         compaction_job.cc:604-640)."""
-        idx = BlockIter(self._index_data, self._icmp.compare)
+        idx = self.new_index_iterator()
         idx.seek_to_first()
         keys = [k for k, _ in idx.entries()]
         if len(keys) <= max_anchors:
@@ -125,13 +137,90 @@ class TableReader:
         return [keys[int(i * step)] for i in range(max_anchors)]
 
 
-class TableIterator:
-    """Two-level iterator: index block → data block."""
+class _PartitionedIndexIter:
+    """BlockIter-shaped view over a two-level (partitioned) index: the
+    in-memory top block maps last-separator → partition handle; partition
+    blocks load on demand through the reader's block cache."""
 
     def __init__(self, reader: TableReader):
         self._r = reader
         self._cmp = reader._icmp.compare
-        self._idx = BlockIter(reader._index_data, self._cmp)
+        self._top = BlockIter(reader._index_data, self._cmp)
+        self._sub: BlockIter | None = None
+
+    def _load(self) -> None:
+        if not self._top.valid():
+            self._sub = None
+            return
+        h = fmt.BlockHandle.decode_exact(self._top.value())
+        self._sub = BlockIter(self._r._read_data_block(h), self._cmp)
+
+    def valid(self) -> bool:
+        return self._sub is not None and self._sub.valid()
+
+    def key(self) -> bytes:
+        return self._sub.key()
+
+    def value(self) -> bytes:
+        return self._sub.value()
+
+    def seek_to_first(self) -> None:
+        self._top.seek_to_first()
+        self._load()
+        if self._sub is not None:
+            self._sub.seek_to_first()
+
+    def seek_to_last(self) -> None:
+        self._top.seek_to_last()
+        self._load()
+        if self._sub is not None:
+            self._sub.seek_to_last()
+
+    def seek(self, target: bytes) -> None:
+        self._top.seek(target)
+        self._load()
+        if self._sub is not None:
+            # Each top key is its partition's LAST separator, so the landed
+            # partition always contains a separator >= target.
+            self._sub.seek(target)
+
+    def seek_for_prev(self, target: bytes) -> None:
+        self.seek(target)
+        if not self.valid():
+            self.seek_to_last()
+            return
+        if self._cmp(self.key(), target) > 0:
+            self.prev()
+
+    def next(self) -> None:
+        self._sub.next()
+        if not self._sub.valid():
+            self._top.next()
+            self._load()
+            if self._sub is not None:
+                self._sub.seek_to_first()
+
+    def prev(self) -> None:
+        self._sub.prev()
+        if not self._sub.valid():
+            self._top.prev()
+            self._load()
+            if self._sub is not None:
+                self._sub.seek_to_last()
+
+    def entries(self):
+        while self.valid():
+            yield self.key(), self.value()
+            self.next()
+
+
+class TableIterator:
+    """Two-level iterator: index (flat or partitioned) → data block."""
+
+    def __init__(self, reader: TableReader):
+        self._r = reader
+        self._cmp = reader._icmp.compare
+        self._idx = reader.new_index_iterator()
         self._data: BlockIter | None = None
 
     def _load_data_block(self) -> None:
