@@ -914,3 +914,114 @@ def test_db_with_block_cache_and_persistent_tier(tmp_db_path, tmp_path):
         for i in range(0, 2000, 17):
             assert db.get(b"key%05d" % i) == b"v%05d" % i
     sec.close()
+
+
+def test_options_persistence_round_trip(tmp_db_path):
+    """DB.open persists OPTIONS-NNNN; load_latest_options rebuilds an
+    equivalent Options (reference PersistRocksDBOptions/LoadLatestOptions)."""
+    import os
+
+    from toplingdb_tpu.utils.config import load_latest_options
+    from toplingdb_tpu.utils.merge_operator import UInt64AddOperator
+
+    from toplingdb_tpu.table.filter import BloomFilterPolicy
+    from toplingdb_tpu.utils.compaction_filter import (
+        RemoveEmptyValueCompactionFilter,
+    )
+
+    o = opts(write_buffer_size=12345, compaction_style="universal",
+             merge_operator=UInt64AddOperator(), num_levels=5,
+             compaction_filter=RemoveEmptyValueCompactionFilter())
+    o.table_options.block_size = 8192
+    o.table_options.index_type = "two_level"
+    o.table_options.filter_policy = BloomFilterPolicy(20.0)
+    with DB.open(tmp_db_path, o) as db:
+        db.put(b"k", b"v")
+    assert any(f.startswith("OPTIONS-") for f in os.listdir(tmp_db_path))
+    loaded = load_latest_options(tmp_db_path)
+    assert loaded.write_buffer_size == 12345
+    assert loaded.compaction_style == "universal"
+    assert loaded.num_levels == 5
+    assert loaded.merge_operator.name() == "UInt64AddOperator"
+    assert loaded.table_options.block_size == 8192
+    assert loaded.table_options.index_type == "two_level"
+    assert loaded.table_options.filter_policy.bits_per_key == 20.0
+    assert loaded.compaction_filter.name() == \
+        "RemoveEmptyValueCompactionFilter"
+    # Reopen rolls a fresh OPTIONS file and GCs the old one.
+    with DB.open(tmp_db_path, o) as db:
+        files = [f for f in os.listdir(tmp_db_path) if f.startswith("OPTIONS-")]
+        assert len(files) == 1
+
+
+def test_overlay_env(mem_env, tmp_path):
+    """OverlayEnv (reference CatFileSystem, env/fs_cat.cc): reads fall
+    through to the base, writes land in the overlay, deletes/renames never
+    touch the base."""
+    from toplingdb_tpu.env import MemEnv
+    from toplingdb_tpu.env.overlay import OverlayEnv
+    from toplingdb_tpu.utils.status import NotFound
+
+    base = mem_env
+    base.create_dir("/db")
+    base.write_file("/db/000010.sst", b"BASE-SST")
+    base.write_file("/db/CURRENT", b"MANIFEST-000002\n")
+    over = MemEnv()
+    over.create_dir("/db")
+    env = OverlayEnv(base, over)
+
+    assert env.read_file("/db/000010.sst") == b"BASE-SST"
+    env.write_file("/db/000020.sst", b"NEW-SST")
+    assert env.read_file("/db/000020.sst") == b"NEW-SST"
+    assert not base.file_exists("/db/000020.sst"), "write leaked to base"
+    assert sorted(env.get_children("/db")) == [
+        "000010.sst", "000020.sst", "CURRENT"]
+
+    # Overlay shadows base on same path.
+    env.write_file("/db/CURRENT", b"MANIFEST-000009\n")
+    assert env.read_file("/db/CURRENT") == b"MANIFEST-000009\n"
+    assert base.read_file("/db/CURRENT") == b"MANIFEST-000002\n"
+
+    # Delete of a base file = whiteout; base untouched.
+    env.delete_file("/db/000010.sst")
+    assert not env.file_exists("/db/000010.sst")
+    assert base.file_exists("/db/000010.sst")
+    with pytest.raises(NotFound):
+        env.read_file("/db/000010.sst")
+    assert env.get_children("/db") == ["000020.sst", "CURRENT"]
+
+    # Rename of a base file copies up + whiteouts the source.
+    base.write_file("/db/000011.sst", b"B11")
+    env.rename_file("/db/000011.sst", "/db/000030.sst")
+    assert env.read_file("/db/000030.sst") == b"B11"
+    assert not env.file_exists("/db/000011.sst")
+    assert base.file_exists("/db/000011.sst")
+
+
+def test_worker_reads_through_overlay_env(tmp_path):
+    """A read-only base DB dir + overlay: a DB opens and reads through
+    OverlayEnv without writing to the base (the dcompact worker mount
+    pattern)."""
+    import os
+
+    from toplingdb_tpu.env import MemEnv, PosixEnv
+    from toplingdb_tpu.env.overlay import OverlayEnv
+
+    src = str(tmp_path / "primary")
+    with DB.open(src, opts()) as db:
+        for i in range(300):
+            db.put(b"k%04d" % i, b"v%04d" % i)
+        db.flush()
+    before = sorted(os.listdir(src))
+    over = MemEnv()
+    over.create_dir(src)
+    env = OverlayEnv(PosixEnv(), over)
+    from toplingdb_tpu.db.db import DB as DB2
+
+    db2 = DB2.open(src, opts(), env=env)
+    assert db2.get(b"k0123") == b"v0123"
+    db2.put(b"extra", b"x")
+    db2.flush()
+    assert db2.get(b"extra") == b"x"
+    db2.close()
+    assert sorted(os.listdir(src)) == before, "base dir was modified!"

@@ -131,6 +131,7 @@ class DB:
         self.seqno_to_time = SeqnoToTimeMapping()
         self._last_seqno_time_sample = 0.0
         self._wbm_charged = 0  # bytes charged to options.write_buffer_manager
+        self._options_file_number = 0  # latest persisted OPTIONS file
         from toplingdb_tpu.utils.listener import EventLogger
 
         self._log_file = None
@@ -241,6 +242,12 @@ class DB:
             db.identity = uuid.uuid4().hex
             env.write_file(filename.identity_file_name(dbname), db.identity.encode())
         db._new_wal()
+        try:
+            from toplingdb_tpu.utils.config import persist_options
+
+            persist_options(db)  # reference PersistRocksDBOptions on open
+        except Exception:
+            pass  # OPTIONS persistence is best-effort, like the reference
         db._delete_obsolete_files()
         from toplingdb_tpu.compaction.scheduler import CompactionScheduler
 
@@ -888,6 +895,9 @@ class DB:
                 keep = num in live_blobs or num in self._pending_outputs
             elif ftype == filename.FileType.MANIFEST:
                 keep = num == self.versions.manifest_file_number
+            elif ftype == filename.FileType.OPTIONS:
+                keep = (num == self._options_file_number
+                        or self._options_file_number == 0)
             elif ftype == filename.FileType.TEMP:
                 keep = False
             if not keep:

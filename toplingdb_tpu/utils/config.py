@@ -72,10 +72,19 @@ def _register_builtins(reg: ObjectRegistry) -> None:
 
     reg.register("comparator", "bytewise", lambda: dbformat.BYTEWISE)
     reg.register("comparator", "reverse_bytewise", lambda: dbformat.REVERSE_BYTEWISE)
+    from toplingdb_tpu.utils.merge_operator import (
+        AggMergeOperator, BytesXOROperator, CassandraValueMergeOperator,
+        SortListOperator,
+    )
+
     reg.register("merge_operator", "put", PutOperator)
     reg.register("merge_operator", "uint64add", UInt64AddOperator)
     reg.register("merge_operator", "stringappend", StringAppendOperator)
     reg.register("merge_operator", "max", MaxOperator)
+    reg.register("merge_operator", "bytesxor", BytesXOROperator)
+    reg.register("merge_operator", "sortlist", SortListOperator)
+    reg.register("merge_operator", "aggmerge", AggMergeOperator)
+    reg.register("merge_operator", "cassandra", CassandraValueMergeOperator)
     reg.register("compaction_filter", "remove_empty_value",
                  RemoveEmptyValueCompactionFilter)
     reg.register("filter_policy", "bloom",
@@ -87,6 +96,40 @@ def _register_builtins(reg: ObjectRegistry) -> None:
     reg.register("statistics", "default", Statistics)
 
 
+_SIMPLE_OPTION_KEYS = {
+    "create_if_missing", "error_if_exists", "paranoid_checks",
+    "write_buffer_size", "max_write_buffer_number", "wal_enabled",
+    "num_levels", "level0_file_num_compaction_trigger",
+    "level0_slowdown_writes_trigger", "level0_stop_writes_trigger",
+    "max_bytes_for_level_base", "max_bytes_for_level_multiplier",
+    "target_file_size_base", "target_file_size_multiplier",
+    "max_compaction_bytes", "compaction_style", "max_background_jobs",
+    "max_subcompactions", "disable_auto_compactions",
+    "universal_size_ratio", "universal_min_merge_width",
+    "universal_max_merge_width",
+    "universal_max_size_amplification_percent",
+    "fifo_max_table_files_size",
+    "enable_blob_files", "min_blob_size",
+    "enable_blob_garbage_collection", "blob_garbage_collection_age_cutoff",
+    "stats_persist_period_sec", "seqno_time_sample_period_sec",
+}
+
+# MergeOperator.name() → registry key, for options_to_config round-trips.
+_MERGE_OP_NAMES = {
+    "PutOperator": "put", "UInt64AddOperator": "uint64add",
+    "StringAppendOperator": "stringappend", "MaxOperator": "max",
+    "BytesXOROperator": "bytesxor", "MergeSortOperator": "sortlist",
+    "AggMergeOperator.v1": "aggmerge",
+    "CassandraValueMergeOperator": "cassandra",
+}
+
+_SIMPLE_TABLE_KEYS = (
+    "format", "block_size", "restart_interval", "index_restart_interval",
+    "compression", "whole_key_filtering", "verify_checksums", "index_type",
+    "metadata_block_size",
+)
+
+
 def options_from_config(cfg: dict):
     """Build Options from a JSON-style dict (the SidePlugin config shape)."""
     from toplingdb_tpu.options import Options
@@ -94,22 +137,8 @@ def options_from_config(cfg: dict):
 
     reg = ObjectRegistry.default()
     opts = Options()
-    simple = {
-        "create_if_missing", "error_if_exists", "paranoid_checks",
-        "write_buffer_size", "max_write_buffer_number", "wal_enabled",
-        "num_levels", "level0_file_num_compaction_trigger",
-        "level0_slowdown_writes_trigger", "level0_stop_writes_trigger",
-        "max_bytes_for_level_base", "max_bytes_for_level_multiplier",
-        "target_file_size_base", "target_file_size_multiplier",
-        "max_compaction_bytes", "compaction_style", "max_background_jobs",
-        "max_subcompactions", "disable_auto_compactions",
-        "universal_size_ratio", "universal_min_merge_width",
-        "universal_max_merge_width",
-        "universal_max_size_amplification_percent",
-        "fifo_max_table_files_size",
-    }
     for k, v in cfg.items():
-        if k in simple:
+        if k in _SIMPLE_OPTION_KEYS:
             setattr(opts, k, v)
         elif k == "comparator":
             opts.comparator = reg.create("comparator", v)
@@ -134,6 +163,94 @@ def options_from_config(cfg: dict):
         else:
             raise InvalidArgument(f"unknown option {k!r}")
     return opts
+
+
+def options_to_config(opts) -> dict:
+    """Serialize Options to the same JSON-style dict options_from_config
+    reads — the OPTIONS-NNNN persistence format (reference
+    options/options_parser.cc PersistRocksDBOptions). Non-default simple
+    fields plus registry-known plugin objects; unregistered plugin objects
+    (custom user classes) are skipped, as the reference skips unknown
+    customizables."""
+    from toplingdb_tpu.options import Options
+
+    base = Options()
+    out: dict = {}
+    for k in sorted(_SIMPLE_OPTION_KEYS):
+        v = getattr(opts, k)
+        if v != getattr(base, k):
+            out[k] = v
+    if opts.comparator.name() == "tpulsm.ReverseBytewiseComparator":
+        out["comparator"] = "reverse_bytewise"
+    # (any other non-bytewise comparator is an unregistered custom object —
+    # skipped, like the reference skips unknown customizables)
+    if opts.merge_operator is not None:
+        key = _MERGE_OP_NAMES.get(opts.merge_operator.name())
+        if key is not None:
+            out["merge_operator"] = key
+    if (opts.compaction_filter is not None
+            and opts.compaction_filter.name()
+            == "RemoveEmptyValueCompactionFilter"):
+        out["compaction_filter"] = "remove_empty_value"
+    if opts.statistics is not None:
+        out["statistics"] = "default"
+    t = opts.table_options
+    from toplingdb_tpu.table.builder import TableOptions
+
+    tbase = TableOptions()
+    tout: dict = {}
+    for k in _SIMPLE_TABLE_KEYS:
+        v = getattr(t, k)
+        if v != getattr(tbase, k):
+            tout[k] = v
+    if t.filter_policy is None:
+        tout["filter_policy"] = None
+    elif t.filter_policy.name().startswith("tpulsm.BloomFilter"):
+        bits = getattr(t.filter_policy, "bits_per_key", 10.0)
+        if bits != 10.0:
+            tout["filter_policy"] = {
+                "class": "bloom", "params": {"bits_per_key": bits},
+            }
+    if tout:
+        out["table_options"] = tout
+    return out
+
+
+def persist_options(db) -> None:
+    """Write OPTIONS-NNNN next to the DB (reference PersistRocksDBOptions on
+    every successful open); older OPTIONS files become obsolete."""
+    import json as _json
+
+    from toplingdb_tpu.db import filename as _fn
+
+    num = db.versions.new_file_number()
+    db.env.write_file(
+        _fn.options_file_name(db.dbname, num),
+        _json.dumps(options_to_config(db.options), indent=1).encode(),
+    )
+    db._options_file_number = num
+
+
+def load_latest_options(dbname: str, env=None):
+    """Rebuild Options from the newest OPTIONS-NNNN file (reference
+    LoadLatestOptions). Returns None if no OPTIONS file exists."""
+    import json as _json
+
+    from toplingdb_tpu.db import filename as _fn
+
+    if env is None:
+        from toplingdb_tpu.env import default_env
+
+        env = default_env()
+    nums = [
+        num for child in env.get_children(dbname)
+        for t, num in [_fn.parse_file_name(child)]
+        if t == _fn.FileType.OPTIONS
+    ]
+    if not nums:
+        return None
+    data = env.read_file(_fn.options_file_name(dbname, max(nums)))
+    return options_from_config(_json.loads(data.decode()))
 
 
 class SidePluginRepo:
