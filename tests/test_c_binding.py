@@ -1,0 +1,47 @@
+"""The flat C API (toplingdb_tpu/bindings/c — the reference's db/c.cc role):
+compile the shared lib + demo with the system toolchain and drive the full
+open/put/get/delete/flush/reopen cycle from C."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CDIR = os.path.join(ROOT, "toplingdb_tpu", "bindings", "c")
+
+
+@pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("gcc") is None
+    or shutil.which("python3-config") is None,
+    reason="C toolchain unavailable",
+)
+def test_c_binding_end_to_end(tmp_path):
+    lib = os.path.join(CDIR, "libtpulsm_c.so")
+    demo = str(tmp_path / "demo")
+    subprocess.run(
+        f"g++ -shared -fPIC -O2 tpulsm_c.c -o libtpulsm_c.so "
+        f"$(python3-config --includes) $(python3-config --ldflags --embed)",
+        shell=True, cwd=CDIR, check=True,
+    )
+    subprocess.run(
+        f"gcc -O2 demo.c -o {demo} -I{CDIR} -L{CDIR} -ltpulsm_c "
+        f"-Wl,-rpath,{CDIR}",
+        shell=True, cwd=CDIR, check=True,
+    )
+    env = dict(os.environ)
+    # The embedded interpreter needs the repo (and the jax plugin dir when
+    # present) on PYTHONPATH; the C caller never imports jax.
+    pypath = ROOT
+    if os.path.isdir("/root/.axon_site"):
+        pypath += ":/root/.axon_site"
+    env["PYTHONPATH"] = pypath
+    out = subprocess.run(
+        [demo, str(tmp_path / "cdb")], env=env, capture_output=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr.decode()
+    assert b"C-API-OK" in out.stdout
+    assert os.path.exists(lib)

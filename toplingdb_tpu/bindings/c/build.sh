@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build libtpulsm_c.so (the embedded-engine C binding) and the demo binary.
+# Consumers need PYTHONPATH to reach the toplingdb_tpu package at runtime.
+set -e
+cd "$(dirname "$0")"
+g++ -shared -fPIC -O2 tpulsm_c.c -o libtpulsm_c.so \
+    $(python3-config --includes) $(python3-config --ldflags --embed)
+gcc -O2 demo.c -o tpulsm_demo -I. -L. -ltpulsm_c -Wl,-rpath,"$PWD"
+echo "built libtpulsm_c.so + tpulsm_demo"

@@ -1,0 +1,64 @@
+/* Smoke demo for the C binding: open → put → get → delete → flush →
+ * reopen-visible. Exits 0 on success, nonzero with a message otherwise. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpulsm_c.h"
+
+#define CHECK(err)                                         \
+    if (err) {                                             \
+        fprintf(stderr, "FAIL: %s\n", err);                \
+        return 1;                                          \
+    }
+
+int main(int argc, char** argv) {
+    const char* path = argc > 1 ? argv[1] : "/tmp/tpulsm_c_demo";
+    char* err = NULL;
+    tpulsm_init();
+    tpulsm_db_t* db = tpulsm_open(path, 1, &err);
+    CHECK(err);
+    tpulsm_put(db, "hello", 5, "world", 5, &err);
+    CHECK(err);
+    size_t n = 0;
+    char* v = tpulsm_get(db, "hello", 5, &n, &err);
+    CHECK(err);
+    if (!v || n != 5 || memcmp(v, "world", 5) != 0) {
+        fprintf(stderr, "FAIL: get mismatch\n");
+        return 1;
+    }
+    tpulsm_free(v);
+    v = tpulsm_get(db, "missing", 7, &n, &err);
+    CHECK(err);
+    if (v) {
+        fprintf(stderr, "FAIL: missing key returned a value\n");
+        return 1;
+    }
+    tpulsm_delete(db, "hello", 5, &err);
+    CHECK(err);
+    tpulsm_put(db, "durable", 7, "yes", 3, &err);
+    CHECK(err);
+    tpulsm_flush(db, &err);
+    CHECK(err);
+    tpulsm_close(db);
+
+    db = tpulsm_open(path, 0, &err); /* reopen: recovery path */
+    CHECK(err);
+    v = tpulsm_get(db, "durable", 7, &n, &err);
+    CHECK(err);
+    if (!v || n != 3 || memcmp(v, "yes", 3) != 0) {
+        fprintf(stderr, "FAIL: durability\n");
+        return 1;
+    }
+    tpulsm_free(v);
+    v = tpulsm_get(db, "hello", 5, &n, &err);
+    CHECK(err);
+    if (v) {
+        fprintf(stderr, "FAIL: deleted key resurrected\n");
+        return 1;
+    }
+    tpulsm_close(db);
+    tpulsm_shutdown();
+    printf("C-API-OK\n");
+    return 0;
+}

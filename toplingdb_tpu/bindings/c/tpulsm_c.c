@@ -1,0 +1,202 @@
+/* Flat C binding over the embedded engine (see tpulsm_c.h).
+ *
+ * Uses the CPython C API directly (no pybind11 in this toolchain). All
+ * entry points take the GIL via PyGILState_Ensure, so the library is safe
+ * to call from multiple C threads; the engine's own locking provides the
+ * DB-level thread safety.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpulsm_c.h"
+
+struct tpulsm_db_t {
+    PyObject* obj; /* toplingdb_tpu.db.db.DB instance */
+};
+
+static char* dup_cstr(const char* s) {
+    size_t n = strlen(s) + 1;
+    char* out = (char*)malloc(n);
+    if (out) memcpy(out, s, n);
+    return out;
+}
+
+static void set_err_from_python(char** errptr) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    if (errptr) {
+        PyObject* s = value ? PyObject_Str(value) : NULL;
+        const char* msg = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+        *errptr = dup_cstr(msg ? msg : "unknown python error");
+        Py_XDECREF(s);
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+}
+
+static PyThreadState* g_main_tstate = NULL;
+static int g_owns_interpreter = 0;
+
+int tpulsm_init(void) {
+    if (Py_IsInitialized()) return 0; /* host already embeds Python */
+    Py_InitializeEx(0);
+    g_owns_interpreter = 1;
+    /* Release the GIL so worker threads can take it via PyGILState. */
+    g_main_tstate = PyEval_SaveThread();
+    return 0;
+}
+
+void tpulsm_shutdown(void) {
+    /* Only tear down an interpreter WE created; finalizing a host's
+     * interpreter (or calling Py_FinalizeEx without a thread state) would
+     * abort the process. */
+    if (!g_owns_interpreter || !Py_IsInitialized()) return;
+    PyEval_RestoreThread(g_main_tstate);
+    Py_FinalizeEx();
+    g_main_tstate = NULL;
+    g_owns_interpreter = 0;
+}
+
+tpulsm_db_t* tpulsm_open(const char* path, int create_if_missing,
+                         char** errptr) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_db_t* out = NULL;
+    PyObject* mod = PyImport_ImportModule("toplingdb_tpu.db.db");
+    if (!mod) { set_err_from_python(errptr); goto done; }
+    {
+        PyObject* omod = PyImport_ImportModule("toplingdb_tpu.options");
+        if (!omod) { Py_DECREF(mod); set_err_from_python(errptr); goto done; }
+        PyObject* opts = PyObject_CallMethod(
+            omod, "Options", NULL);
+        if (opts) {
+            PyObject* flag = create_if_missing ? Py_True : Py_False;
+            PyObject_SetAttrString(opts, "create_if_missing", flag);
+        }
+        PyObject* dbcls = opts ? PyObject_GetAttrString(mod, "DB") : NULL;
+        PyObject* db = dbcls ? PyObject_CallMethod(
+            dbcls, "open", "sO", path, opts) : NULL;
+        if (db) {
+            out = (tpulsm_db_t*)malloc(sizeof(*out));
+            if (out) {
+                out->obj = db;
+            } else {
+                Py_DECREF(db);
+                if (errptr) *errptr = dup_cstr("out of memory");
+            }
+        } else {
+            set_err_from_python(errptr);
+        }
+        Py_XDECREF(dbcls);
+        Py_XDECREF(opts);
+        Py_DECREF(omod);
+        Py_DECREF(mod);
+    }
+done:
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_close(tpulsm_db_t* db) {
+    if (!db) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(db->obj, "close", NULL);
+    if (!r) PyErr_Clear();
+    Py_XDECREF(r);
+    Py_DECREF(db->obj);
+    PyGILState_Release(g);
+    free(db);
+}
+
+void tpulsm_put(tpulsm_db_t* db, const char* key, size_t keylen,
+                const char* val, size_t vallen, char** errptr) {
+    if (!db) {
+        if (errptr) *errptr = dup_cstr("null db handle");
+        return;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(
+        db->obj, "put", "y#y#", key, (Py_ssize_t)keylen,
+        val, (Py_ssize_t)vallen);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+char* tpulsm_get(tpulsm_db_t* db, const char* key, size_t keylen,
+                 size_t* vallen, char** errptr) {
+    if (!db) {
+        if (errptr) *errptr = dup_cstr("null db handle");
+        if (vallen) *vallen = 0;
+        return NULL;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    char* out = NULL;
+    if (vallen) *vallen = 0;
+    PyObject* r = PyObject_CallMethod(
+        db->obj, "get", "y#", key, (Py_ssize_t)keylen);
+    if (!r) {
+        set_err_from_python(errptr);
+    } else if (r != Py_None) {
+        char* buf = NULL;
+        Py_ssize_t n = 0;
+        if (PyBytes_AsStringAndSize(r, &buf, &n) == 0) {
+            out = (char*)malloc(n > 0 ? (size_t)n : 1);
+            if (out) {
+                memcpy(out, buf, (size_t)n);
+                if (vallen) *vallen = (size_t)n;
+            } else if (errptr) {
+                /* NULL + untouched errptr means "absent" — OOM must NOT
+                 * masquerade as a missing key. */
+                *errptr = dup_cstr("out of memory");
+            }
+        } else {
+            set_err_from_python(errptr);
+        }
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_delete(tpulsm_db_t* db, const char* key, size_t keylen,
+                   char** errptr) {
+    if (!db) {
+        if (errptr) *errptr = dup_cstr("null db handle");
+        return;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(
+        db->obj, "delete", "y#", key, (Py_ssize_t)keylen);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_flush(tpulsm_db_t* db, char** errptr) {
+    if (!db) {
+        if (errptr) *errptr = dup_cstr("null db handle");
+        return;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(db->obj, "flush", NULL);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_compact_range(tpulsm_db_t* db, char** errptr) {
+    if (!db) {
+        if (errptr) *errptr = dup_cstr("null db handle");
+        return;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(db->obj, "compact_range", NULL);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_free(void* ptr) { free(ptr); }

@@ -1,0 +1,48 @@
+/* tpulsm flat C API.
+ *
+ * Role of the reference's C binding (db/c.cc, include/rocksdb/c.h in the
+ * upstream): a stable C ABI for foreign-language consumers. The engine runs
+ * embedded (libpython); call tpulsm_init() once per process before any
+ * other function (it boots the interpreter; PYTHONPATH must reach the
+ * toplingdb_tpu package).
+ *
+ * Error convention mirrors rocksdb_*: every fallible call takes char** errptr;
+ * on failure *errptr is a malloc'd message the caller frees with
+ * tpulsm_free(); on success it is left untouched.
+ */
+#ifndef TPULSM_C_H
+#define TPULSM_C_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tpulsm_db_t tpulsm_db_t;
+
+/* Process-wide init/teardown of the embedded engine runtime. */
+int tpulsm_init(void);
+void tpulsm_shutdown(void);
+
+tpulsm_db_t* tpulsm_open(const char* path, int create_if_missing,
+                         char** errptr);
+void tpulsm_close(tpulsm_db_t* db);
+
+void tpulsm_put(tpulsm_db_t* db, const char* key, size_t keylen,
+                const char* val, size_t vallen, char** errptr);
+/* Returns a malloc'd value (caller frees with tpulsm_free) or NULL when the
+ * key is absent (with *errptr untouched) or on error (with *errptr set). */
+char* tpulsm_get(tpulsm_db_t* db, const char* key, size_t keylen,
+                 size_t* vallen, char** errptr);
+void tpulsm_delete(tpulsm_db_t* db, const char* key, size_t keylen,
+                   char** errptr);
+void tpulsm_flush(tpulsm_db_t* db, char** errptr);
+void tpulsm_compact_range(tpulsm_db_t* db, char** errptr);
+
+void tpulsm_free(void* ptr);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* TPULSM_C_H */
