@@ -127,3 +127,56 @@ def test_mixed_formats_in_one_db(tmp_db_path):
         db.compact_range()                  # merges both formats
         assert db.get(b"sf0250") == b"1"
         assert db.get(b"bb0250") == b"2"
+
+
+def test_hash_index_point_lookups(tmp_db_path):
+    """single_fast + hash_index: O(1) bucket probes serve point lookups
+    (the CuckooTable role); versions/snapshots/misses behave identically."""
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options, ReadOptions
+
+    o = Options(disable_auto_compactions=True)
+    o.table_options.format = "single_fast"
+    o.table_options.hash_index = True
+    with DB.open(tmp_db_path, o) as db:
+        for i in range(2000):
+            db.put(b"key%05d" % i, b"v1-%05d" % i)
+        snap = db.get_snapshot()
+        for i in range(0, 2000, 2):
+            db.put(b"key%05d" % i, b"v2-%05d" % i)
+        db.flush()
+        f = db.versions.current.files[0][0]
+        r = db.table_cache.get_reader(f.number)
+        assert r.has_hash_index
+        assert r.hash_probe(b"key00042") is not None
+        assert r.hash_probe(b"nope") is None
+        assert db.get(b"key00042") == b"v2-00042"
+        assert db.get(b"key00043") == b"v1-00043"
+        assert db.get(b"missing") is None
+        assert db.get(b"key00042", ReadOptions(snapshot=snap)) == b"v1-00042"
+        snap.release()
+    with DB.open(tmp_db_path, o) as db:
+        assert db.get(b"key01999") == b"v1-01999"
+        assert db.get(b"key01998") == b"v2-01998"
+
+
+def test_hash_index_vs_binary_same_results(tmp_db_path):
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    o = Options(disable_auto_compactions=True)
+    o.table_options.format = "single_fast"
+    o.table_options.hash_index = True
+    with DB.open(tmp_db_path, o) as db:
+        for i in range(500):
+            db.put(b"k%04d" % (i * 7 % 997), b"v%04d" % i)
+        db.flush()
+        f = db.versions.current.files[0][0]
+        r = db.table_cache.get_reader(f.number)
+        it = r.new_iterator()
+        it.seek_to_first()
+        for ikey, _ in it.entries():
+            uk = ikey[:-8]
+            j = r.hash_probe(uk)
+            assert j is not None
+            assert r._entry(j)[0][:-8] == uk

@@ -1025,3 +1025,25 @@ def test_worker_reads_through_overlay_env(tmp_path):
     assert db2.get(b"extra") == b"x"
     db2.close()
     assert sorted(os.listdir(src)) == before, "base dir was modified!"
+
+
+def test_io_tracing_env(tmp_path):
+    """IOTracingEnv records file ops as JSONL; parse_io_trace aggregates
+    (reference io_tracer + io_tracer_parser)."""
+    from toplingdb_tpu.env import PosixEnv
+    from toplingdb_tpu.env.io_tracer import IOTracer, IOTracingEnv, parse_io_trace
+
+    trace = str(tmp_path / "io.trace")
+    tracer = IOTracer(trace)
+    env = IOTracingEnv(PosixEnv(), tracer)
+    d = str(tmp_path / "db")
+    with DB.open(d, opts(), env=env) as db:
+        for i in range(200):
+            db.put(b"k%04d" % i, b"v" * 50)
+        db.flush()
+        assert db.get(b"k0100") == b"v" * 50
+    tracer.close()
+    agg = parse_io_trace(trace)
+    assert agg["append"]["count"] > 0 and agg["append"]["bytes"] > 0
+    assert "sync" in agg and "read" in agg
+    assert agg["read"]["bytes"] > 0

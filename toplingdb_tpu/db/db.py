@@ -635,11 +635,21 @@ class DB:
                 ctx.add_tombstone_seq(t.seq)
         if not reader.key_may_match(key):
             return True, it
-        if it is None:
-            it = reader.new_iterator()
-        it.seek(dbformat.make_internal_key(
-            key, snap_seq, dbformat.VALUE_TYPE_FOR_SEEK
-        ))
+        if getattr(reader, "has_hash_index", False):
+            # O(1) bucket probe (single_fast hash index): lands on the
+            # newest version; the loop below skips seqs above the snapshot.
+            ordinal = reader.hash_probe(key)
+            if ordinal is None:
+                return True, it  # definitively absent from this file
+            if it is None:
+                it = reader.new_iterator()
+            it.seek_ordinal(ordinal)
+        else:
+            if it is None:
+                it = reader.new_iterator()
+            it.seek(dbformat.make_internal_key(
+                key, snap_seq, dbformat.VALUE_TYPE_FOR_SEEK
+            ))
         while it.valid():
             uk, seq, t = dbformat.split_internal_key(it.key())
             if ucmp.compare(uk, key) != 0:

@@ -39,6 +39,9 @@ class TableOptions:
     # index, loaded lazily and block-cached — the big-SST memory saver.
     index_type: str = "binary"
     metadata_block_size: int = 4096
+    # single_fast only: also write an open-addressed hash bucket index for
+    # O(1) point lookups (the CuckooTable / PlainTable prefix-hash role).
+    hash_index: bool = False
     compression: int = fmt.NO_COMPRESSION
     filter_policy: FilterPolicy | None = field(default_factory=BloomFilterPolicy)
     whole_key_filtering: bool = True

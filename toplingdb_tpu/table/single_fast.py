@@ -31,6 +31,7 @@ from toplingdb_tpu.utils import coding, crc32c
 from toplingdb_tpu.utils.status import Corruption
 
 METAINDEX_DATA_CRC = b"tpulsm.sf.data_crc"
+METAINDEX_HASH_INDEX = b"tpulsm.sf.hash_index"
 
 
 class SingleFastTableBuilder:
@@ -160,6 +161,36 @@ class SingleFastTableBuilder:
             fh = fmt.write_block(self._w, fdata, fmt.NO_COMPRESSION)
             self.props.filter_size = len(fdata)
             meta_entries.append((METAINDEX_FILTER, fh))
+        if (self.opts.hash_index and self._offsets
+                and self._icmp.user_comparator.name()
+                == dbformat.BYTEWISE.name()):
+            # Bytewise comparator only: the hash dedups/matches by BYTE
+            # equality, which must coincide with comparator equality.
+            # O(1) point-lookup bucket array (the CuckooTable / PlainTable
+            # prefix-hash role, reference table/cuckoo/ + table/plain/):
+            # open-addressed xxh64 buckets at <=0.7 load, each holding
+            # 1 + the ordinal of the NEWEST version of one user key.
+            n = len(self._offsets)
+            nb = 1
+            while nb < (n * 10) // 7 + 1:
+                nb <<= 1
+            buckets = np.zeros(nb, dtype="<u4")
+            mask = nb - 1
+            prev_uk = None
+            for i, off in enumerate(self._offsets):
+                klen, o = coding.decode_varint32(self._buf, off)
+                _, o = coding.decode_varint32(self._buf, o)
+                uk = bytes(self._buf[o : o + klen - 8])
+                if uk == prev_uk:
+                    continue  # hash maps to the first (newest) version
+                prev_uk = uk
+                h = crc32c.xxh64(uk) & mask
+                while buckets[h]:
+                    h = (h + 1) & mask
+                buckets[h] = i + 1
+            hh = fmt.write_block(self._w, buckets.tobytes(),
+                                 fmt.NO_COMPRESSION)
+            meta_entries.append((METAINDEX_HASH_INDEX, hh))
         if not self._range_del_block.empty():
             rh = fmt.write_block(self._w, self._range_del_block.finish(),
                                  fmt.NO_COMPRESSION)
@@ -235,6 +266,15 @@ class SingleFastTableReader:
             fmt.read_block(_Mem(self._data), rh, self.opts.verify_checksums)
             if rh is not None else None
         )
+        self._hash_buckets = None
+        hh = self._meta_handles.get(METAINDEX_HASH_INDEX)
+        if hh is not None:
+            self._hash_buckets = np.frombuffer(
+                fmt.read_block(_Mem(self._data), hh,
+                               self.opts.verify_checksums),
+                dtype="<u4",
+            )
+        self.has_hash_index = self._hash_buckets is not None
         self.n = len(self._offsets)
 
     # -- entry decode ---------------------------------------------------
@@ -267,6 +307,28 @@ class SingleFastTableReader:
         if self._filter_policy is None or self._filter_data is None:
             return True
         return self._filter_policy.key_may_match(user_key, self._filter_data)
+
+    def hash_probe(self, user_key: bytes) -> int | None:
+        """O(1) lookup: ordinal of the NEWEST version of user_key, or None
+        when the key is definitively absent from this file. Only meaningful
+        when has_hash_index (bytewise-comparator files only)."""
+        buckets = self._hash_buckets
+        if buckets is None:
+            return None
+        mask = len(buckets) - 1
+        h = crc32c.xxh64(user_key) & mask
+        for _ in range(len(buckets)):  # bounded: corrupt blocks can't hang
+            v = int(buckets[h])
+            if v == 0:
+                return None
+            i = v - 1
+            if i >= self.n:
+                raise Corruption("single_fast hash index bucket out of range")
+            k = self._entry(i)[0]
+            if k[:-8] == user_key:
+                return i
+            h = (h + 1) & mask
+        raise Corruption("single_fast hash index has no empty buckets")
 
     def new_iterator(self) -> "SingleFastIterator":
         return SingleFastIterator(self)
@@ -326,6 +388,10 @@ class SingleFastIterator:
 
     def seek(self, target: bytes) -> None:
         self._i = self._r._lower_bound(target)
+
+    def seek_ordinal(self, i: int) -> None:
+        """Position directly at entry ordinal i (hash_probe fast path)."""
+        self._i = i
 
     def seek_for_prev(self, target: bytes) -> None:
         i = self._r._lower_bound(target)
