@@ -33,17 +33,32 @@ class CompactionScheduler:
         self._cv = threading.Condition(self._lock)
         self._shutdown = False
         self._manual_active = False
+        self._paused = 0
         self.last_error: BaseException | None = None
         self.num_completed = 0
 
     # ------------------------------------------------------------------
+
+    def pause(self) -> None:
+        """Reference DB::PauseBackgroundWork: block until running jobs
+        drain, then hold new ones."""
+        with self._lock:
+            self._paused += 1
+        self.wait_idle()
+
+    def resume_background(self) -> None:
+        with self._lock:
+            self._paused = max(0, self._paused - 1)
+        self.maybe_schedule()
 
     def maybe_schedule(self) -> None:
         if self.db.options.disable_auto_compactions:
             return
         if self.background:
             with self._lock:
-                if self._shutdown or self._manual_active:
+                # _paused must be checked under the lock, or a racing
+                # schedule could slip in after pause() returned.
+                if self._shutdown or self._manual_active or self._paused:
                     return
                 if self._running + self._pending >= self.db.options.max_background_jobs:
                     return
@@ -51,6 +66,9 @@ class CompactionScheduler:
             t = threading.Thread(target=self._bg_work, daemon=True)
             t.start()
         else:
+            with self._lock:
+                if self._paused:
+                    return
             while self._run_one():
                 pass
 

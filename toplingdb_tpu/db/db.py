@@ -711,8 +711,9 @@ class DB:
 
         opts = self.options
         if (opts.disable_auto_compactions
-                or self._compaction_scheduler is None):
-            return
+                or self._compaction_scheduler is None
+                or self._compaction_scheduler._paused):
+            return  # nothing can drain L0; stalling would only block
         n_l0 = self._max_l0_files()
         if n_l0 >= opts.level0_stop_writes_trigger:
             from toplingdb_tpu.utils import statistics as st
@@ -919,6 +920,104 @@ class DB:
                     self.env.delete_file(f"{self.dbname}/{child}")
                 except NotFound:
                     pass
+
+    def verify_checksum(self) -> None:
+        """Full checksum scan of every live SST (reference
+        DB::VerifyChecksum): every data block is read FROM DISK and
+        CRC-verified — cached readers/blocks are bypassed, as the reference
+        scans with fill_cache=false; raises Corruption on the first bad
+        block. Holding the Version objects pins the files against
+        concurrent obsolete-file GC."""
+        import dataclasses as _dc
+
+        from toplingdb_tpu.table.factory import open_table
+
+        with self._mutex:
+            versions = [
+                self.versions.cf_current(cf_id)
+                for cf_id in self.versions.column_families
+            ]
+        topts = _dc.replace(self.options.table_options, verify_checksums=True)
+        for version in versions:
+            for _, f in version.all_files():
+                path = filename.table_file_name(self.dbname, f.number)
+                reader = open_table(
+                    self.env.new_random_access_file(path), self.icmp, topts
+                )
+                try:
+                    it = reader.new_iterator()
+                    it.seek_to_first()
+                    for _ in it.entries():  # decoding verifies block CRCs
+                        pass
+                finally:
+                    reader.close()
+
+    def get_approximate_sizes(self, ranges: list[tuple[bytes, bytes]],
+                              cf=None) -> list[int]:
+        """Approximate on-disk bytes per [begin, end) user-key range
+        (reference DB::GetApproximateSizes via ApproximateOffsetOf)."""
+        cfd = self._cf_data(cf)
+        ucmp = self.icmp.user_comparator
+        version = self.versions.cf_current(cfd.handle.id)
+        out = []
+        for begin, end in ranges:
+            bk = dbformat.make_internal_key(
+                begin, dbformat.MAX_SEQUENCE_NUMBER,
+                dbformat.VALUE_TYPE_FOR_SEEK)
+            ek = dbformat.make_internal_key(
+                end, dbformat.MAX_SEQUENCE_NUMBER,
+                dbformat.VALUE_TYPE_FOR_SEEK)
+            total = 0
+            for level in range(version.num_levels):
+                for f in version.files[level]:
+                    # Metadata-only overlap check before touching a reader.
+                    if (ucmp.compare(dbformat.extract_user_key(f.largest),
+                                     begin) < 0
+                            or ucmp.compare(end, dbformat.extract_user_key(
+                                f.smallest)) < 0):
+                        continue
+                    reader = self.table_cache.get_reader(f.number)
+                    lo = reader.approximate_offset_of(bk)
+                    hi = reader.approximate_offset_of(ek)
+                    if hi > lo:
+                        total += hi - lo
+            out.append(total)
+        return out
+
+    def delete_files_in_range(self, begin: bytes, end: bytes, cf=None) -> int:
+        """Drop whole SSTs fully contained in [begin, end) (reference
+        DeleteFilesInRange — the bulk-wipe fast path; boundary files keep
+        their data, which a DeleteRange + compaction then clears). Returns
+        the number of files dropped."""
+        cfd = self._cf_data(cf)
+        ucmp = self.icmp.user_comparator
+        with self._mutex:
+            version = self.versions.cf_current(cfd.handle.id)
+            doomed: list[tuple[int, int]] = []
+            for level in range(1, version.num_levels):  # L0 ranges overlap
+                for f in version.files[level]:
+                    if f.being_compacted:
+                        continue
+                    fs = dbformat.extract_user_key(f.smallest)
+                    fl = dbformat.extract_user_key(f.largest)
+                    if ucmp.compare(begin, fs) <= 0 and ucmp.compare(fl, end) < 0:
+                        doomed.append((level, f.number))
+            if not doomed:
+                return 0
+            edit = VersionEdit(column_family=cfd.handle.id)
+            for level, num in doomed:
+                edit.delete_file(level, num)
+            self.versions.log_and_apply(edit)
+            self._delete_obsolete_files()
+            return len(doomed)
+
+    def pause_background_work(self) -> None:
+        if self._compaction_scheduler is not None:
+            self._compaction_scheduler.pause()
+
+    def continue_background_work(self) -> None:
+        if self._compaction_scheduler is not None:
+            self._compaction_scheduler.resume_background()
 
     def get_stats_history(self, start_time: int = 0, end_time: int = 2 ** 62):
         """Time-series ticker deltas (reference DBImpl::GetStatsHistory,

@@ -42,6 +42,11 @@ class TableOptions:
     # single_fast only: also write an open-addressed hash bucket index for
     # O(1) point lookups (the CuckooTable / PlainTable prefix-hash role).
     hash_index: bool = False
+    # >1 enables the producer/consumer compression pipeline (reference
+    # CompressionOptions.parallel_threads / ParallelCompressionRep,
+    # block_based_table_builder.cc:818-825): data blocks compress on worker
+    # threads (zlib/bz2/lzma release the GIL) and write in order.
+    compression_parallel_threads: int = 1
     compression: int = fmt.NO_COMPRESSION
     filter_policy: FilterPolicy | None = field(default_factory=BloomFilterPolicy)
     whole_key_filtering: bool = True
@@ -96,6 +101,20 @@ class TableBuilder:
             f.create() for f in self.opts.properties_collector_factories
         ]
         self.need_compaction = False
+        # Parallel-compression pipeline state (active only when compressing
+        # with >1 threads): blocks compress out-of-band, write in order, and
+        # the index is assembled at finish from recorded block boundaries.
+        self._par_pool = None
+        self._par_blocks: list = []  # (future, first_key, last_key, raw_len)
+        self._par_meta: list = []    # (first_key, last_key, BlockHandle)
+        self._block_first_key: bytes | None = None
+        if (self.opts.compression != fmt.NO_COMPRESSION
+                and self.opts.compression_parallel_threads > 1):
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._par_pool = ThreadPoolExecutor(
+                max_workers=self.opts.compression_parallel_threads
+            )
 
     # ------------------------------------------------------------------
 
@@ -104,7 +123,12 @@ class TableBuilder:
         return self.props.num_entries + self.props.num_range_deletions
 
     def file_size(self) -> int:
-        return self._w.file_size()
+        size = self._w.file_size()
+        if self._par_blocks:
+            # Count queued-but-unwritten blocks (raw size upper bound) so
+            # compaction's output-cut trigger doesn't lag the pipeline.
+            size += sum(b[3] for b in self._par_blocks)
+        return size
 
     @property
     def smallest_key(self) -> bytes | None:
@@ -133,6 +157,8 @@ class TableBuilder:
             sep = self._icmp.find_shortest_separator(self._last_key, ikey)
             self._index_add(sep, self._pending_handle.encode())
             self._pending_index_entry = False
+        if self._data_block.empty():
+            self._block_first_key = ikey
         uk, seq_, t = dbformat.split_internal_key(ikey)
         if self.opts.filter_policy and self.opts.whole_key_filtering:
             self._filter_keys.append(uk)
@@ -175,11 +201,33 @@ class TableBuilder:
         if self._data_block.empty():
             return
         raw = self._data_block.finish()
-        self._pending_handle = fmt.write_block(self._w, raw, self.opts.compression)
-        self._pending_index_entry = True
-        self.props.data_size += len(raw)
-        self.props.num_data_blocks += 1
+        if self._par_pool is not None:
+            fut = self._par_pool.submit(
+                fmt.compress_for_block, raw, self.opts.compression
+            )
+            self._par_blocks.append(
+                (fut, self._block_first_key, self._last_key, len(raw))
+            )
+            self._drain_parallel(wait=False)
+        else:
+            self._pending_handle = fmt.write_block(
+                self._w, raw, self.opts.compression
+            )
+            self._pending_index_entry = True
+            self.props.data_size += len(raw)
+            self.props.num_data_blocks += 1
         self._data_block.reset()
+
+    def _drain_parallel(self, wait: bool) -> None:
+        """Write completed compressed blocks in submission order (bounds
+        memory during the build; `wait` drains everything at finish)."""
+        while self._par_blocks and (wait or self._par_blocks[0][0].done()):
+            fut, first, last, raw_len = self._par_blocks.pop(0)
+            payload, out_type = fut.result()
+            h = fmt.write_compressed_block(self._w, payload, out_type)
+            self._par_meta.append((first, last, h))
+            self.props.data_size += raw_len
+            self.props.num_data_blocks += 1
 
     def finish(self) -> TableProperties:
         assert not self._finished
@@ -188,6 +236,19 @@ class TableBuilder:
             if c.need_compact():
                 self.need_compaction = True
         self._flush_data_block()
+        if self._par_pool is not None:
+            self._drain_parallel(wait=True)
+            self._par_pool.shutdown()
+            # Index from recorded block boundaries — same separators as the
+            # sequential path computes incrementally.
+            for i, (first, last, h) in enumerate(self._par_meta):
+                if i + 1 < len(self._par_meta):
+                    sep = self._icmp.find_shortest_separator(
+                        last, self._par_meta[i + 1][0]
+                    )
+                else:
+                    sep = self._icmp.find_short_successor(last)
+                self._index_add(sep, h.encode())
         if self._pending_index_entry:
             succ = self._icmp.find_short_successor(self._last_key)
             self._index_add(succ, self._pending_handle.encode())

@@ -11,9 +11,57 @@ import threading
 from collections import OrderedDict
 
 
+class BlockCacheTracer:
+    """Access-trace hook (reference trace_replay/block_cache_tracer.cc +
+    tools/block_cache_analyzer): JSONL records of every cache lookup."""
+
+    def __init__(self, trace_path: str):
+        import json
+        import time
+
+        self._json = json
+        self._time = time
+        self._f = open(trace_path, "a", buffering=1)
+        self._mu = threading.Lock()
+
+    def record_access(self, key: bytes, hit: bool) -> None:
+        line = self._json.dumps({
+            "ts_us": int(self._time.time() * 1e6),
+            "key": key.hex(), "hit": hit,
+        })
+        with self._mu:
+            self._f.write(line + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def analyze_block_cache_trace(trace_path: str) -> dict:
+    """Aggregate hit/miss counts + per-key-prefix reuse (the
+    block_cache_analyzer role)."""
+    import json
+
+    hits = misses = 0
+    per_file: dict[str, int] = {}
+    with open(trace_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            if rec["hit"]:
+                hits += 1
+            else:
+                misses += 1
+            per_file[rec["key"][:32]] = per_file.get(rec["key"][:32], 0) + 1
+    total = hits + misses
+    return {"hits": hits, "misses": misses,
+            "hit_ratio": hits / total if total else 0.0,
+            "accesses_per_file_prefix": per_file}
+
+
 class LRUCache:
     def __init__(self, capacity_bytes: int, num_shards: int = 16,
-                 secondary=None):
+                 secondary=None, tracer: BlockCacheTracer | None = None):
         self._shards = [
             _Shard(max(1, capacity_bytes // num_shards),
                    spill=secondary.insert if secondary is not None else None)
@@ -22,6 +70,7 @@ class LRUCache:
         self._n = num_shards
         self.capacity = capacity_bytes
         self.secondary = secondary
+        self.tracer = tracer
 
     def _shard(self, key: bytes) -> "_Shard":
         return self._shards[hash(key) % self._n]
@@ -32,6 +81,8 @@ class LRUCache:
             v = self.secondary.lookup(key)
             if v is not None:
                 self._shard(key).insert(key, v, len(v))  # promote
+        if self.tracer is not None:
+            self.tracer.record_access(key, v is not None)
         return v
 
     def insert(self, key: bytes, value, charge: int) -> None:
