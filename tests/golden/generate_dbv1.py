@@ -1,0 +1,42 @@
+"""Regenerate tests/golden/dbv1 (run from the repo root).
+
+ONLY run this when an INTENTIONAL format change lands — the golden dir
+exists to catch unintentional ones. Regeneration must be deterministic:
+frozen clock, fixed data. Commit the regenerated dir together with the
+format change and note it in the commit message.
+"""
+
+import shutil
+import uuid
+from unittest import mock
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.table import format as fmt
+
+_FIXED_UUID = uuid.UUID("0" * 31 + "1")
+
+
+def main(dest: str = "tests/golden/dbv1") -> None:
+    shutil.rmtree(dest, ignore_errors=True)
+    with mock.patch("time.time", lambda: 1753750000.0), \
+            mock.patch("uuid.uuid4", lambda: _FIXED_UUID):
+        o = Options(write_buffer_size=1 << 20, disable_auto_compactions=True,
+                    enable_blob_files=True, min_blob_size=64)
+        o.table_options.compression = fmt.ZLIB_COMPRESSION
+        with DB.open(dest, o) as db:
+            cf = db.create_column_family("meta")
+            for i in range(500):
+                db.put(b"key%04d" % i, b"value-%04d" % i)
+            db.put(b"big", b"B" * 500)          # blob-separated
+            db.put(b"mk", b"mv", cf=cf)
+            db.delete(b"key0100")
+            db.delete_range(b"key0200", b"key0210")
+            db.flush()
+            db.put(b"wal-tail", b"unflushed")   # stays in the WAL
+            db._wal.sync()
+            db._closed = True                   # crash-style: WAL replay
+
+
+if __name__ == "__main__":
+    main()
