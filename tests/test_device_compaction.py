@@ -342,3 +342,20 @@ def test_http_dcompact_fallback_on_dead_worker(tmp_db_path):
         for k in range(0, 500, 41):
             last = max(i for i in range(k, 2000, 500))
             assert db.get(b"key%05d" % k) == b"val%07d" % last
+
+
+def test_device_in_stripe_tombstone_not_masked_by_newer_stripe():
+    """Regression (model-check seed 23): two range tombstones covering a key
+    straddle a snapshot; the in-stripe (older) tombstone must still delete
+    the value even though the max covering seq is above the snapshot —
+    device and host must agree."""
+    k = b"key084"
+    entries = [(make_internal_key(k, 219, ValueType.VALUE), b"v000322")]
+    rd = RangeDelAggregator(ICMP.user_comparator)
+    rd.add(RangeTombstone(262, b"key031", b"key091"))  # below snapshot: kills
+    rd.add(RangeTombstone(283, b"key063", b"key137"))  # above snapshot
+    snaps = [276, 286]
+    want = cpu_reference(entries, snaps, True, rd, None)
+    got = list(device_gc_entries(entries, ICMP, snaps, True, rd=rd))
+    assert got == want
+    assert got == [], "value@219 must be deleted by tombstone@262 (stripe 0)"

@@ -77,11 +77,18 @@ class CompactionIterator:
         return bisect.bisect_left(self._snapshots, seq)
 
     def _tomb_covers(self, user_key: bytes, seq: int) -> bool:
-        """Covered by a newer range tombstone in the same stripe."""
+        """Covered by a newer range tombstone in the same stripe.
+
+        The search must be BOUNDED BY THE ENTRY'S STRIPE: a covering
+        tombstone above the next snapshot must not mask an in-stripe one
+        (tombstones at seqs t1 < snap < t2 both covering the key: the entry
+        at seq < t1 dies by t1 even though the global max is t2)."""
         if self._rd is None:
             return False
-        tomb_seq = self._rd.max_covering_seq(user_key, dbformat.MAX_SEQUENCE_NUMBER)
-        return tomb_seq > seq and self._stripe(tomb_seq) == self._stripe(seq)
+        stripe = self._stripe(seq)
+        upper = (self._snapshots[stripe] if stripe < len(self._snapshots)
+                 else dbformat.MAX_SEQUENCE_NUMBER)
+        return self._rd.max_covering_seq(user_key, upper) > seq
 
     # ------------------------------------------------------------------
 

@@ -842,7 +842,7 @@ class DB:
                         for b, e in reader.range_del_entries():
                             rd.add(RangeTombstone.from_table_entry(b, e))
             internal = MergingIterator(self.icmp.compare, children)
-            return DBIter(
+            it = DBIter(
                 internal, self.icmp, snap_seq,
                 range_del_agg=None if rd.empty() else rd,
                 merge_operator=self.options.merge_operator,
@@ -851,6 +851,12 @@ class DB:
                 pinned=version,
                 blob_resolver=self.blob_source.get,
             )
+            if opts.snapshot is None:
+                # Refresh re-reads at the LATEST sequence; snapshot-pinned
+                # iterators can't refresh (reference Iterator::Refresh
+                # returns NotSupported for them).
+                it._refresh_fn = lambda: self.new_iterator(opts, cf)
+            return it
 
     def get_snapshot(self):
         return self.snapshots.new_snapshot(self.versions.last_sequence)

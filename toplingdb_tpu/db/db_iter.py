@@ -35,6 +35,20 @@ class DBIter:
         self._valid = False
         self._key: bytes | None = None
         self._value: bytes | None = None
+        self._refresh_fn = None  # set by DB.new_iterator
+
+    def refresh(self) -> None:
+        """Rebind to the DB's CURRENT state (reference Iterator::Refresh):
+        new memtable/SST sources and the latest sequence. The position is
+        invalidated — seek again, as in the reference."""
+        if self._refresh_fn is None:
+            from toplingdb_tpu.utils.status import NotSupported
+
+            raise NotSupported("iterator was not created by DB.new_iterator")
+        fresh = self._refresh_fn()
+        fn = self._refresh_fn
+        self.__dict__.update(fresh.__dict__)
+        self._refresh_fn = fn
 
     # -- public protocol ------------------------------------------------
 

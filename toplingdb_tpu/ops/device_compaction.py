@@ -48,18 +48,33 @@ def collect_raw_entries(compaction, table_cache, icmp):
 
 
 def _tombstone_cover(sorted_user_keys: list[bytes], rd: RangeDelAggregator,
-                     ucmp) -> np.ndarray | None:
-    """Per-sorted-entry max covering tombstone seqno (uint64), via interval
-    mapping on host (tombstone fragments are few; entries are many)."""
+                     ucmp, sorted_seqs, snapshots) -> np.ndarray | None:
+    """Per-sorted-entry max covering tombstone seqno (uint64), CLAMPED TO
+    EACH ENTRY'S SNAPSHOT STRIPE — a tombstone above the next snapshot must
+    not mask an in-stripe one (it can't delete the entry, but the in-stripe
+    one does). Interval mapping on host (fragments are few; entries many)."""
     if rd.empty():
         return None
     n = len(sorted_user_keys)
     cover = np.zeros(n, dtype=np.uint64)
+    seqs = np.asarray(sorted_seqs, dtype=np.uint64)
+    snaps = np.asarray(sorted(snapshots), dtype=np.uint64)
+    if len(snaps):
+        idx = np.searchsorted(snaps, seqs, side="left")
+        upper = np.where(
+            idx < len(snaps), snaps[np.minimum(idx, len(snaps) - 1)],
+            np.uint64(dbformat.MAX_SEQUENCE_NUMBER),
+        )
+    else:
+        upper = np.full(n, dbformat.MAX_SEQUENCE_NUMBER, dtype=np.uint64)
     for frag in fragment_tombstones(rd.tombstones(), ucmp):
         lo = bisect.bisect_left(sorted_user_keys, frag.begin)
         hi = bisect.bisect_left(sorted_user_keys, frag.end)
         if lo < hi:
-            np.maximum(cover[lo:hi], np.uint64(frag.seq), out=cover[lo:hi])
+            t = np.uint64(frag.seq)
+            sl = slice(lo, hi)
+            elig = (t > seqs[sl]) & (t <= upper[sl]) & (t > cover[sl])
+            cover[sl] = np.where(elig, t, cover[sl])
     return cover
 
 
@@ -103,7 +118,8 @@ def device_gc_entries(entries, icmp, snapshots, bottommost,
     sorted_uks = None
     if rd is not None:
         sorted_uks = [col.user_key(i) for i in perm]
-        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator)
+        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator,
+                                 col.seq[perm], snapshots)
     keep, zero_seq, host_resolve, group_id = ck.gc_mask(
         sorted_cols, snapshots, cover, bottommost
     )
@@ -297,7 +313,8 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             kv.key_buf[kv.key_offs[i]: kv.key_offs[i] + kv.key_lens[i] - 8]
             .tobytes() for i in perm
         ]
-        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator)
+        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator,
+                                 col.seq[perm], snapshots)
         keep, zero_seq, host_resolve, group_id = ck.gc_mask(
             sorted_cols, snapshots, cover, bottommost=compaction.bottommost
         )
