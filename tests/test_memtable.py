@@ -71,3 +71,79 @@ def test_iterator_stable_under_concurrent_insert():
     it.next()
     seen.append(split_internal_key(it.key())[0])
     assert seen == [b"k00", b"k01"]
+
+
+def test_hash_prefix_rep_matches_skiplist_semantics(tmp_path):
+    """hash_skiplist rep (prefix-bucketed): same DB behavior as the default
+    rep — ordered scans, reverse iteration, version visibility."""
+    import random
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    rng = random.Random(5)
+    dumps = {}
+    for rep in ("skiplist", "hash_skiplist"):
+        d = str(tmp_path / rep)
+        db = DB.open(d, Options(write_buffer_size=1 << 22, memtable_rep=rep,
+                                disable_auto_compactions=True))
+        model = {}
+        for i in range(3000):
+            k = b"key%05d" % rng.randrange(2000)
+            if rng.random() < 0.85:
+                v = b"v%05d" % i
+                db.put(k, v); model[k] = v
+            else:
+                db.delete(k); model.pop(k, None)
+        rng = random.Random(5)  # same sequence for both reps
+        for k in (b"key00000", b"key01000", b"key01999", b"zzz"):
+            assert db.get(k) == model.get(k)
+        it = db.new_iterator()
+        it.seek_to_first()
+        fwd = list(it.entries())
+        assert fwd == sorted(model.items())
+        it2 = db.new_iterator()
+        it2.seek_to_last()
+        rev = []
+        while it2.valid():
+            rev.append((it2.key(), it2.value()))
+            it2.prev()
+        assert rev == fwd[::-1]
+        it3 = db.new_iterator()
+        it3.seek(b"key01000")
+        assert it3.valid()
+        dumps[rep] = fwd
+        db.close()
+    assert dumps["skiplist"] == dumps["hash_skiplist"]
+
+
+def test_hash_prefix_rep_unit():
+    from toplingdb_tpu.db.memtable import HashPrefixRep
+
+    r = HashPrefixRep(prefix_len=3)
+    import random
+
+    rng = random.Random(1)
+    keys = []
+    for i in range(500):
+        uk = b"%03d-%04d" % (rng.randrange(20), i)
+        skey = (uk, rng.randrange(1 << 32))
+        keys.append(skey)
+        r.insert(skey, b"v%d" % i)
+    assert len(r) == 500
+    ordered = [k for k, _ in r.iter_all()]
+    assert ordered == sorted(keys)
+    # Cursor walk equals iter_all.
+    walked = []
+    pos = r.pos_first()
+    while pos is not None:
+        walked.append(r.entry_at(pos)[0])
+        pos = r.pos_next(pos)
+    assert walked == ordered
+    # seek_ge / seek_lt on bucket boundaries.
+    mid = sorted(keys)[250]
+    assert r.entry_at(r.pos_seek_ge(mid))[0] == mid
+    lt = r.pos_seek_lt(mid)
+    assert r.entry_at(lt)[0] == sorted(keys)[249]
+    assert r.pos_seek_lt(sorted(keys)[0]) is None
+    assert r.pos_seek_ge((b"\xff\xff\xff\xff", 0)) is None

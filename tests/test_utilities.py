@@ -1047,3 +1047,72 @@ def test_io_tracing_env(tmp_path):
     assert agg["append"]["count"] > 0 and agg["append"]["bytes"] > 0
     assert "sync" in agg and "read" in agg
     assert agg["read"]["bytes"] > 0
+
+
+def test_two_phase_commit_recovery(tmp_path):
+    """2PC: a prepared transaction survives a crash and can be committed or
+    rolled back after recovery (reference Prepare/GetAllPreparedTransactions)."""
+    from toplingdb_tpu.utilities.transactions import TransactionDB
+
+    d = str(tmp_path / "db")
+    tdb = TransactionDB.open(d, opts())
+    tdb.put(b"base", b"v")
+    t1 = tdb.begin_transaction()
+    t1.set_name("t1")
+    t1.put(b"pk", b"pv")
+    t1.prepare()
+    t2 = tdb.begin_transaction()
+    t2.set_name("t2")
+    t2.put(b"rk", b"rv")
+    t2.prepare()
+    # Crash: no commit, no clean close.
+    tdb.db._wal.sync()
+    tdb.db._closed = True
+    tdb.db._compaction_scheduler.shutdown()
+
+    tdb2 = TransactionDB.open(d, opts())
+    assert tdb2.get(b"pk") is None, "prepared data must not be visible"
+    recovered = {t.name: t for t in tdb2.get_prepared_transactions()}
+    assert set(recovered) == {"t1", "t2"}
+    recovered["t1"].commit()
+    recovered["t2"].rollback()
+    assert tdb2.get(b"pk") == b"pv"
+    assert tdb2.get(b"rk") is None
+    assert tdb2.get(b"base") == b"v"
+    tdb2.close()
+    # After a clean cycle nothing is pending and data persists.
+    tdb3 = TransactionDB.open(d, opts())
+    assert tdb3.get_prepared_transactions() == []
+    assert tdb3.get(b"pk") == b"pv"
+    tdb3.close()
+
+
+def test_two_phase_commit_crash_after_commit_write(tmp_path):
+    """Crash between the commit write and the prep-file delete must NOT
+    double-apply on recovery (the hidden commit marker resolves it)."""
+    from toplingdb_tpu.utilities.transactions import TransactionDB
+
+    d = str(tmp_path / "db")
+    tdb = TransactionDB.open(d, opts())
+    t = tdb.begin_transaction()
+    t.set_name("tx")
+    t.put(b"k", b"v1")
+    t.prepare()
+    # Simulate the torn commit: write the batch+marker but keep the prep
+    # file (as if we crashed before deleting it).
+    from toplingdb_tpu.db.write_batch import WriteBatch
+
+    marker = TransactionDB._MARKER_PREFIX + b"tx"
+    batch = WriteBatch(t.wbwi.batch.data())
+    batch.put(marker, b"1", cf=tdb._txn_cf.id)
+    tdb.db.write(batch)
+    tdb.db._wal.sync()
+    tdb.db._closed = True
+    tdb.db._compaction_scheduler.shutdown()
+
+    tdb2 = TransactionDB.open(d, opts())
+    assert tdb2.get_prepared_transactions() == [], \
+        "already-committed txn offered again"
+    assert tdb2.get(b"k") == b"v1"
+    assert tdb2.db.get(marker, cf=tdb2._txn_cf) is None, "marker must be swept"
+    tdb2.close()

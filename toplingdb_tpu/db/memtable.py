@@ -207,8 +207,122 @@ class PyVectorRep(MemTableRep):
                 return entry
 
 
+class HashPrefixRep(MemTableRep):
+    """Prefix-bucketed rep (reference HashSkipListRep / HashLinkListRep,
+    memtable/hash_skiplist_rep.cc:22, hash_linklist_rep.cc:160): entries
+    bucket by the user key's leading `prefix_len` bytes, so point lookups
+    touch one small bucket. Because the bucket key is a LEADING slice of the
+    sort key, buckets are contiguous spans of the global order — full
+    iteration is sorted-bucket concatenation, not an N-way merge."""
+
+    def __init__(self, prefix_len: int = 8):
+        self._plen = prefix_len
+        self._buckets: dict[bytes, PyVectorRep] = {}
+        # Only WRITERS (serialized by the memtable write lock) replace this
+        # list, and they swap in a fully-built one — lockless readers always
+        # see a consistent snapshot and never mutate shared state.
+        self._sorted: list[bytes] = []
+        self._n = 0
+
+    def _pfx(self, skey) -> bytes:
+        return skey[0][: self._plen]
+
+    def _prefixes(self) -> list[bytes]:
+        return self._sorted
+
+    def insert(self, skey, value: bytes) -> None:
+        p = self._pfx(skey)
+        b = self._buckets.get(p)
+        if b is None:
+            b = self._buckets[p] = PyVectorRep()
+            self._sorted = sorted(self._buckets)  # atomic swap for readers
+        before = len(b)
+        b.insert(skey, value)
+        self._n += len(b) - before
+
+    def iter_from(self, skey):
+        sp = self._prefixes()
+        p = self._pfx(skey)
+        i = bisect.bisect_left(sp, p)
+        if i < len(sp) and sp[i] == p:
+            yield from self._buckets[p].iter_from(skey)
+            i += 1
+        for j in range(i, len(sp)):
+            yield from self._buckets[sp[j]].iter_all()
+
+    def iter_all(self):
+        for p in self._prefixes():
+            yield from self._buckets[p].iter_all()
+
+    def __len__(self) -> int:
+        return self._n
+
+    def pos_first(self):
+        for p in self._prefixes():
+            pos = self._buckets[p].pos_first()
+            if pos is not None:
+                return pos
+        return None
+
+    def pos_last(self):
+        for p in reversed(self._prefixes()):
+            pos = self._buckets[p].pos_last()
+            if pos is not None:
+                return pos
+        return None
+
+    def pos_seek_ge(self, skey):
+        sp = self._prefixes()
+        p = self._pfx(skey)
+        i = bisect.bisect_left(sp, p)
+        while i < len(sp):
+            b = self._buckets[sp[i]]
+            pos = b.pos_seek_ge(skey) if sp[i] == p else b.pos_first()
+            if pos is not None:
+                return pos
+            i += 1
+        return None
+
+    def pos_seek_lt(self, skey):
+        sp = self._prefixes()
+        p = self._pfx(skey)
+        i = bisect.bisect_left(sp, p)
+        if i < len(sp) and sp[i] == p:
+            pos = self._buckets[p].pos_seek_lt(skey)
+            if pos is not None:
+                return pos
+        i -= 1
+        while i >= 0:
+            pos = self._buckets[sp[i]].pos_last()
+            if pos is not None:
+                return pos
+            i -= 1
+        return None
+
+    def pos_next(self, pos):
+        p = self._pfx(pos)
+        nxt = self._buckets[p].pos_next(pos)
+        if nxt is not None:
+            return nxt
+        sp = self._prefixes()
+        i = bisect.bisect_right(sp, p)
+        while i < len(sp):
+            q = self._buckets[sp[i]].pos_first()
+            if q is not None:
+                return q
+            i += 1
+        return None
+
+    def entry_at(self, pos):
+        return self._buckets[self._pfx(pos)].entry_at(pos)
+
+    def memory_usage(self) -> int:
+        return sum(b.memory_usage() for b in self._buckets.values())
+
+
 def create_memtable_rep(name: str) -> MemTableRep:
-    """Factory seam (reference memtablerep.h:309): 'vector' | 'skiplist'."""
+    """Factory seam (reference memtablerep.h:309):
+    'vector' | 'skiplist' | 'hash_skiplist'."""
     if name == "vector":
         return PyVectorRep()
     if name == "skiplist":
@@ -216,6 +330,8 @@ def create_memtable_rep(name: str) -> MemTableRep:
             return NativeSkipListRep()
         except RuntimeError:
             return PyVectorRep()  # no toolchain: degrade gracefully
+    if name in ("hash_skiplist", "hash_linklist", "prefix_hash"):
+        return HashPrefixRep()
     from toplingdb_tpu.utils.status import InvalidArgument
 
     raise InvalidArgument(f"unknown memtable rep {name!r}")

@@ -169,24 +169,51 @@ class PessimisticTransaction(_TxnBase):
             self._txn_db.lock_manager.unlock_all(self.id, [key])
             self._locked.discard(key)
 
+    def set_name(self, name: str) -> None:
+        """Name for 2PC (reference Transaction::SetName — required before
+        Prepare so recovery can identify the transaction). Names are unique
+        among undecided transactions and immutable once set."""
+        if not name or "/" in name or name.startswith("."):
+            raise InvalidArgument(f"bad transaction name {name!r}")
+        if self.state != "started":
+            raise InvalidArgument(f"cannot rename in state {self.state}")
+        if getattr(self, "name", None) is not None:
+            raise InvalidArgument("transaction already named")
+        self._txn_db._register_name(name)
+        self.name = name
+
     def prepare(self) -> None:
-        """2PC phase 1: persist the batch to the WAL as a prepared record
-        (simplified: the batch is staged durably in the txn registry)."""
+        """2PC phase 1 (reference Transaction::Prepare): persist the batch
+        durably so a crash between prepare and commit leaves the transaction
+        recoverable via TransactionDB.get_prepared_transactions()."""
         if self.state != "started":
             raise InvalidArgument(f"cannot prepare from state {self.state}")
+        if getattr(self, "name", None) is None:
+            raise InvalidArgument("set_name() required before prepare()")
+        self._txn_db._persist_prepared(self)
         self.state = "prepared"
 
     def commit(self) -> None:
         if self.state not in ("started", "prepared"):
             raise InvalidArgument(f"cannot commit from state {self.state}")
-        try:
+        # Locks release only on SUCCESS: a failed commit of a prepared txn
+        # must stay prepared with its keys locked, or a retry/recovery
+        # commit would stomp newer writes (lost update).
+        if self.state == "prepared":
+            self._txn_db._commit_prepared(self)
+        else:
             if not self.wbwi.batch.is_empty():
                 self._db.write(self.wbwi.batch, self._wo)
-            self.state = "committed"
-        finally:
-            self._release()
+            if getattr(self, "name", None) is not None:
+                self._txn_db._release_name(self.name)
+        self.state = "committed"
+        self._release()
 
     def rollback(self) -> None:
+        if self.state == "prepared":
+            self._txn_db._discard_prepared(self)
+        elif getattr(self, "name", None) is not None:
+            self._txn_db._release_name(self.name)
         super().rollback()
         self._release()
 
@@ -198,15 +225,158 @@ class PessimisticTransaction(_TxnBase):
 
 class TransactionDB:
     """Pessimistic transaction DB (reference PessimisticTransactionDB,
-    WriteCommitted policy)."""
+    WriteCommitted policy). 2PC: prepared transactions persist in
+    `<db>/txns/<name>.prep` (batch + lock set, fsynced); commit appends a
+    hidden marker key in the same atomic batch so recovery can tell a
+    crash-after-commit from a still-prepared transaction (the reference
+    uses WAL Prepare/Commit markers for the same purpose)."""
+
+    _MARKER_PREFIX = b"txn."
+    _TXN_CF = "__tpulsm_txn__"
 
     def __init__(self, db: DB):
         self.db = db
         self.lock_manager = PointLockManager()
+        self._txn_dir = f"{db.dbname}/txns"
+        self._recovered: list[PessimisticTransaction] = []
+        self._names: set[str] = set()
+        self._names_mu = threading.Lock()
+        # Commit markers live in their own column family so user-keyspace
+        # scans never see them (the reference keeps its markers in the WAL).
+        cf = db.get_column_family(self._TXN_CF)
+        self._txn_cf = cf if cf is not None else \
+            db.create_column_family(self._TXN_CF)
+        try:
+            db.env.create_dir(self._txn_dir)
+        except Exception:
+            pass
+        self._recover_prepared()
+
+    def _register_name(self, name: str) -> None:
+        with self._names_mu:
+            if name in self._names or self.db.env.file_exists(
+                    self._prep_path(name)):
+                raise InvalidArgument(
+                    f"transaction name {name!r} already in use"
+                )
+            self._names.add(name)
+
+    def _release_name(self, name: str) -> None:
+        with self._names_mu:
+            self._names.discard(name)
 
     @staticmethod
     def open(path: str, options: Options | None = None) -> "TransactionDB":
         return TransactionDB(DB.open(path, options))
+
+    # -- 2PC journal ----------------------------------------------------
+
+    def _prep_path(self, name: str) -> str:
+        return f"{self._txn_dir}/{name}.prep"
+
+    def _persist_prepared(self, txn) -> None:
+        import json as _json
+
+        doc = _json.dumps({
+            "name": txn.name,
+            "batch": txn.wbwi.batch.data().hex(),
+            "locks": [k.hex() for k in txn._locked],
+        })
+        self.db.env.write_file(self._prep_path(txn.name), doc.encode(),
+                               sync=True)
+
+    def _commit_prepared(self, txn) -> None:
+        from toplingdb_tpu.db.write_batch import WriteBatch
+
+        marker = self._MARKER_PREFIX + txn.name.encode()
+        batch = WriteBatch(txn.wbwi.batch.data())
+        batch.put(marker, b"1", cf=self._txn_cf.id)
+        self.db.write(batch, txn._wo)
+        try:
+            self.db.env.delete_file(self._prep_path(txn.name))
+        except Exception:
+            pass
+        self.db.delete(marker, cf=self._txn_cf)
+        if txn in self._recovered:
+            self._recovered.remove(txn)
+        self._release_name(txn.name)
+
+    def _discard_prepared(self, txn) -> None:
+        try:
+            self.db.env.delete_file(self._prep_path(txn.name))
+        except Exception:
+            pass
+        if txn in self._recovered:
+            self._recovered.remove(txn)
+        self._release_name(txn.name)
+
+    def _recover_prepared(self) -> None:
+        import json as _json
+
+        from toplingdb_tpu.utils.status import NotFound
+
+        try:
+            children = self.db.env.get_children(self._txn_dir)
+        except NotFound:
+            return
+        live_names: set[str] = set()
+        for child in sorted(children):
+            if not child.endswith(".prep"):
+                continue
+            # IO errors PROPAGATE (hiding a prepared txn loses its locks);
+            # only unparseable content counts as a torn prepare.
+            raw = self.db.env.read_file(f"{self._txn_dir}/{child}")
+            try:
+                doc = _json.loads(raw.decode())
+                name = doc["name"]
+                batch_data = bytes.fromhex(doc["batch"])
+                locks = [bytes.fromhex(kh) for kh in doc["locks"]]
+            except (ValueError, KeyError, UnicodeDecodeError):
+                # Torn prepare: quarantine so it can't be re-read forever.
+                self.db.env.rename_file(
+                    f"{self._txn_dir}/{child}",
+                    f"{self._txn_dir}/{child}.corrupt",
+                )
+                continue
+            marker = self._MARKER_PREFIX + name.encode()
+            if self.db.get(marker, cf=self._txn_cf) is not None:
+                # Crashed between commit-write and prep-file delete: the
+                # batch is already durable — finish the bookkeeping.
+                try:
+                    self.db.env.delete_file(self._prep_path(name))
+                except NotFound:
+                    pass
+                self.db.delete(marker, cf=self._txn_cf)
+                continue
+            txn = PessimisticTransaction(self, WriteOptions())
+            txn.name = name
+            self._names.add(name)
+            live_names.add(name)
+            from toplingdb_tpu.db.write_batch import WriteBatch
+
+            txn.wbwi.batch = WriteBatch(batch_data)
+            for k in locks:
+                self.lock_manager.try_lock(txn.id, k, 0.0)
+                txn._locked.add(k)
+            txn.state = "prepared"
+            self._recovered.append(txn)
+        # Sweep orphan markers (crash between prep delete and marker
+        # delete): any marker without a surviving .prep is garbage.
+        it = self.db.new_iterator(cf=self._txn_cf)
+        it.seek(self._MARKER_PREFIX)
+        orphans = []
+        while it.valid() and it.key().startswith(self._MARKER_PREFIX):
+            name = it.key()[len(self._MARKER_PREFIX):].decode(errors="replace")
+            if name not in live_names:
+                orphans.append(it.key())
+            it.next()
+        for k in orphans:
+            self.db.delete(k, cf=self._txn_cf)
+
+    def get_prepared_transactions(self) -> list:
+        """Recovered prepared-but-undecided transactions (reference
+        GetAllPreparedTransactions); commit() or rollback() each."""
+        return list(self._recovered)
 
     def begin_transaction(self, write_options: WriteOptions = WriteOptions(),
                           lock_timeout: float = 1.0) -> PessimisticTransaction:
