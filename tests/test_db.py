@@ -658,3 +658,20 @@ def test_block_cache_tracer(tmp_db_path, tmp_path):
     agg = analyze_block_cache_trace(trace)
     assert agg["hits"] + agg["misses"] > 0
     assert agg["hits"] > 0, "repeat reads must hit the cache"
+
+
+def test_extended_properties(tmp_db_path):
+    with DB.open(tmp_db_path, opts(disable_auto_compactions=True)) as db:
+        for i in range(200):
+            db.put(b"k%04d" % i, b"v")
+        db.flush()
+        for i in range(100, 300):
+            db.put(b"k%04d" % i, b"v")
+        snap = db.get_snapshot()
+        assert int(db.get_property("tpulsm.estimate-num-keys")) >= 200
+        assert int(db.get_property("tpulsm.cur-size-all-mem-tables")) > 0
+        assert db.get_property("tpulsm.num-snapshots") == "1"
+        assert int(db.get_property("tpulsm.estimate-live-data-size")) > 0
+        assert db.get_property("tpulsm.background-errors") == "0"
+        assert db.get_property("tpulsm.num-running-compactions") == "0"
+        snap.release()

@@ -36,6 +36,7 @@ class CompactionScheduler:
         self._paused = 0
         self.last_error: BaseException | None = None
         self.num_completed = 0
+        self.num_trivial_moves = 0
 
     # ------------------------------------------------------------------
 
@@ -157,6 +158,50 @@ class CompactionScheduler:
             with db._mutex:
                 db.versions.log_and_apply(edit)
                 db._delete_obsolete_files()
+            return
+        def _bottom_move_ok(f) -> bool:
+            # A bottommost rewrite exists to GC tombstones / fold merges;
+            # a file with neither loses nothing by moving.
+            if f.num_deletions or f.num_range_deletions:
+                return False
+            props = db.table_cache.get_reader(f.number).properties
+            return props.num_merge_operands == 0
+
+        if (len(c.inputs) == 1 and not c.output_level_inputs
+                and c.level > 0 and c.output_level > c.level
+                and db.options.compaction_filter is None
+                and (not c.bottommost or _bottom_move_ok(c.inputs[0]))
+                and not (db.options.enable_blob_garbage_collection
+                         and c.inputs[0].blob_refs)):
+            # Trivial move (reference Compaction::IsTrivialMove /
+            # db_impl_compaction_flush.cc): nothing overlaps below — just
+            # relocate the file's metadata, no rewrite, no IO.
+            meta = c.inputs[0]
+            from toplingdb_tpu.db.version_edit import VersionEdit
+
+            edit = VersionEdit(column_family=c.cf_id)
+            edit.delete_file(c.level, meta.number)
+            edit.add_file(c.output_level, meta)
+            with db._mutex:
+                db.versions.log_and_apply(edit)
+            with self._lock:
+                self.num_trivial_moves += 1
+            db.event_logger.log(
+                "trivial_move", file_number=meta.number,
+                from_level=c.level, to_level=c.output_level,
+            )
+            from toplingdb_tpu.utils.listener import CompactionJobInfo, notify
+
+            notify(db.options.listeners, "on_compaction_completed", db,
+                   CompactionJobInfo(
+                       db_name=db.dbname, input_level=c.level,
+                       output_level=c.output_level,
+                       input_files=[meta.number], output_files=[meta.number],
+                       input_records=meta.num_entries,
+                       output_records=meta.num_entries,
+                       elapsed_micros=0, device="move",
+                       reason="trivial move",
+                   ))
             return
         snapshots = db.snapshots.sequences()
         pending: list[int] = []

@@ -1046,6 +1046,36 @@ class DB:
             return "\n".join(lines)
         if name == "tpulsm.num-files":
             return str(v.num_files())
+        if name == "tpulsm.estimate-num-keys":
+            # Reference rocksdb.estimate-num-keys: live table entries minus
+            # deletions plus memtable entries (overcounts overwrites).
+            n = sum(
+                max(0, m.num_entries - 2 * m.num_deletes)
+                for c in self._cfs.values() for m in [c.mem] + c.imm
+            )
+            for cf_id in self.versions.column_families:
+                for _, f in self.versions.cf_current(cf_id).all_files():
+                    n += max(0, f.num_entries - 2 * f.num_deletions)
+            return str(n)
+        if name == "tpulsm.cur-size-all-mem-tables":
+            return str(sum(
+                c.mem.approximate_memory_usage()
+                + sum(m.approximate_memory_usage() for m in c.imm)
+                for c in self._cfs.values()
+            ))
+        if name == "tpulsm.num-snapshots":
+            return str(len(self.snapshots.sequences()))
+        if name == "tpulsm.estimate-live-data-size":
+            return str(sum(
+                f.file_size
+                for cf_id in self.versions.column_families
+                for _, f in self.versions.cf_current(cf_id).all_files()
+            ))
+        if name == "tpulsm.background-errors":
+            return "1" if self._bg_error is not None else "0"
+        if name == "tpulsm.num-running-compactions":
+            s = self._compaction_scheduler
+            return str(s._running if s is not None else 0)
         if name.startswith("tpulsm.num-files-at-level"):
             try:
                 lvl = int(name[len("tpulsm.num-files-at-level"):])
