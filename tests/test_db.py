@@ -675,3 +675,39 @@ def test_extended_properties(tmp_db_path):
         assert db.get_property("tpulsm.background-errors") == "0"
         assert db.get_property("tpulsm.num-running-compactions") == "0"
         snap.release()
+
+
+def test_get_merge_operands(tmp_db_path):
+    with DB.open(tmp_db_path, opts(merge_operator=StringAppendOperator())) as db:
+        db.put(b"k", b"base")
+        db.merge(b"k", b"a")
+        db.flush()
+        db.merge(b"k", b"b")
+        assert db.get_merge_operands(b"k") == [b"base", b"a", b"b"]
+        assert db.get(b"k") == b"base,a,b"
+        db.put(b"plain", b"v")
+        assert db.get_merge_operands(b"plain") == [b"v"]
+        assert db.get_merge_operands(b"missing") == []
+        db.delete(b"k")
+        db.merge(b"k", b"after")
+        assert db.get_merge_operands(b"k") == [b"after"]
+
+
+def test_get_merge_operands_snapshot_and_zeroed(tmp_db_path):
+    """Review regressions: a post-snapshot range tombstone must not hide the
+    chain under the snapshot, and seqno-zeroed survivors stay visible."""
+    with DB.open(tmp_db_path, opts(merge_operator=StringAppendOperator(),
+                                   disable_auto_compactions=True)) as db:
+        db.put(b"k", b"base")
+        db.merge(b"k", b"a")
+        snap = db.get_snapshot()
+        db.delete_range(b"a", b"z")
+        db.flush()
+        assert db.get_merge_operands(b"k") == []  # covered now
+        assert db.get_merge_operands(
+            b"k", ReadOptions(snapshot=snap)) == [b"base", b"a"]
+        snap.release()
+        # Seqno-zeroed value after bottommost compaction stays visible.
+        db.put(b"z2", b"zv")
+        db.compact_range()
+        assert db.get_merge_operands(b"z2") == [b"zv"]

@@ -801,6 +801,41 @@ class DB:
     def key_exists(self, key: bytes, opts: ReadOptions = _DEFAULT_READ) -> bool:
         return self.get(key, opts) is not None
 
+    def get_merge_operands(self, key: bytes,
+                           opts: ReadOptions = _DEFAULT_READ,
+                           cf=None) -> list[bytes]:
+        """The UNMERGED chain for a key (reference DB::GetMergeOperands):
+        the base value (if any) first, then merge operands oldest→newest.
+        A plain key returns [value]; a missing/deleted key returns [].
+        Reuses GetContext's visibility/tombstone state machine in
+        collect-only mode."""
+        self._check_open()
+        cfd = self._cf_data(cf)
+        snap_seq = (
+            opts.snapshot.sequence if opts.snapshot is not None
+            else self.versions.last_sequence
+        )
+        ctx = GetContext(
+            key, snap_seq, None, blob_resolver=self.blob_source.get,
+            collect_operands=True,
+        )
+        more = True
+        for mem in [cfd.mem] + cfd.imm:
+            if not self._probe_memtable(mem, key, snap_seq, ctx):
+                more = False
+                break
+        if more:
+            version = self.versions.cf_current(cfd.handle.id)
+            for level, f in version.files_for_get(key):
+                reader = self.table_cache.get_reader(f.number)
+                cont, _ = self._probe_file(
+                    reader, key, snap_seq, ctx, self._parsed_tombstones(reader)
+                )
+                if not cont:
+                    break
+        ctx.finish()
+        return ctx.merge_operand_list()
+
     # ==================================================================
     # Iterators & snapshots
     # ==================================================================
@@ -1064,7 +1099,7 @@ class DB:
                 for c in self._cfs.values()
             ))
         if name == "tpulsm.num-snapshots":
-            return str(len(self.snapshots.sequences()))
+            return str(self.snapshots.num_live())
         if name == "tpulsm.estimate-live-data-size":
             return str(sum(
                 f.file_size

@@ -26,7 +26,7 @@ class GetState(enum.Enum):
 
 class GetContext:
     def __init__(self, user_key: bytes, snapshot_seq: int, merge_operator=None,
-                 blob_resolver=None):
+                 blob_resolver=None, collect_operands: bool = False):
         self.user_key = user_key
         self.snapshot_seq = snapshot_seq
         self.merge_operator = merge_operator
@@ -36,6 +36,10 @@ class GetContext:
         self.operands: list[bytes] = []   # collected newest→oldest
         self.max_covering_tombstone_seq = 0
         self.found_final_value = False
+        # collect_operands (reference DB::GetMergeOperands): keep the chain
+        # unfolded — same visibility/tombstone state machine, no folding,
+        # no merge_operator required.
+        self.collect_operands = collect_operands
 
     # ------------------------------------------------------------------
 
@@ -62,7 +66,7 @@ class GetContext:
             value = self.blob_resolver(value)
             t = ValueType.VALUE
         if t == ValueType.VALUE:
-            if self.state == GetState.MERGE:
+            if self.state == GetState.MERGE and not self.collect_operands:
                 self.state = GetState.FOUND
                 self.value = self._fold(value)
             else:
@@ -72,14 +76,17 @@ class GetContext:
             return False
         if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
             if self.state == GetState.MERGE:
-                self.state = GetState.FOUND
-                self.value = self._fold(None)
+                if self.collect_operands:
+                    pass  # chain ends with no base; keep the operands
+                else:
+                    self.state = GetState.FOUND
+                    self.value = self._fold(None)
             else:
                 self.state = GetState.DELETED
             self.found_final_value = True
             return False
         if t == ValueType.MERGE:
-            if self.merge_operator is None:
+            if self.merge_operator is None and not self.collect_operands:
                 self.state = GetState.CORRUPT
                 self.found_final_value = True
                 return False
@@ -90,10 +97,20 @@ class GetContext:
 
     def finish(self) -> None:
         """No more sources. Resolve an open merge chain against no base."""
-        if self.state == GetState.MERGE:
+        if self.state == GetState.MERGE and not self.collect_operands:
             self.value = self._fold(None)
             self.state = GetState.FOUND
             self.found_final_value = True
+
+    def merge_operand_list(self) -> list[bytes]:
+        """collect_operands result: base value (if any) first, then merge
+        operands oldest→newest; [] when missing/deleted."""
+        out: list[bytes] = []
+        if self.state in (GetState.FOUND, GetState.MERGE) and \
+                self.value is not None:
+            out.append(self.value)
+        out.extend(reversed(self.operands))
+        return out
 
     def _fold(self, base: bytes | None) -> bytes:
         # operands were collected newest→oldest; full_merge wants oldest→newest.

@@ -45,6 +45,12 @@ class SnapshotList:
         with self._lock:
             return not self._snapshots
 
+    def num_live(self) -> int:
+        """Count of live snapshot OBJECTS (distinct seqnos may collapse in
+        sequences(); the reference's num-snapshots counts objects)."""
+        with self._lock:
+            return len(self._snapshots)
+
     def sequences(self) -> list[int]:
         """Sorted live snapshot seqnos — the visibility stripes compaction
         must preserve (reference CompactionIterator's snapshot list)."""

@@ -6,6 +6,8 @@ Commands:
   get KEY | put KEY VALUE | delete KEY | scan [--from=K] [--to=K] [--limit=N]
   batchput K1 V1 K2 V2 ... | deleterange BEGIN END
   manifest_dump | wal_dump WALFILE | list_files | checkpoint DEST
+  repair | ingest_extern_sst FILE | approxsize --from=K --to=K
+  verify_checksum | list_column_families
 """
 
 from __future__ import annotations
@@ -37,6 +39,12 @@ def main(argv=None) -> int:
     cmd = args.command
     a = args.cmd_args
 
+    if cmd == "repair":
+        from toplingdb_tpu.db.repair import repair_db
+
+        report = repair_db(args.db)
+        print(report)
+        return 0
     if cmd == "manifest_dump":
         return _manifest_dump(args.db)
     if cmd == "wal_dump":
@@ -93,6 +101,34 @@ def main(argv=None) -> int:
             print(f"checkpoint created at {a[0]}")
         elif cmd == "stats":
             print(db.get_property("tpulsm.stats"))
+        elif cmd == "ingest_extern_sst":
+            from toplingdb_tpu.utilities.sst_file_writer import (
+                ingest_external_file,
+            )
+
+            level = ingest_external_file(db, a[0])
+            print(f"ingested at level {level}")
+        elif cmd == "approxsize":
+            lo = enc(args.from_key) if args.from_key else b""
+            if args.to_key:
+                hi = enc(args.to_key)
+            else:
+                # Unbounded: one byte past the largest live user key.
+                from toplingdb_tpu.db import dbformat
+
+                largest = max(
+                    (dbformat.extract_user_key(f.largest)
+                     for _, f in db.versions.current.all_files()),
+                    default=b"",
+                )
+                hi = largest + b"\x00"
+            print(db.get_approximate_sizes([(lo, hi)])[0])
+        elif cmd == "verify_checksum":
+            db.verify_checksum()
+            print("OK")
+        elif cmd == "list_column_families":
+            for h in db.list_column_families():
+                print(h.name)
         else:
             print(f"unknown command {cmd!r}", file=sys.stderr)
             return 2
