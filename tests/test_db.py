@@ -711,3 +711,37 @@ def test_get_merge_operands_snapshot_and_zeroed(tmp_db_path):
         db.put(b"z2", b"zv")
         db.compact_range()
         assert db.get_merge_operands(b"z2") == [b"zv"]
+
+
+def test_put_get_entity_api(tmp_db_path):
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put_entity(b"user1", {b"name": b"alice", b"age": b"30"})
+        e = db.get_entity(b"user1")
+        assert e == {b"name": b"alice", b"age": b"30"}
+        db.put(b"plain", b"v")
+        assert db.get_entity(b"plain") == {b"": b"v"}
+        assert db.get_entity(b"missing") is None
+        db.flush()
+        db.compact_range()
+        assert db.get_entity(b"user1")[b"name"] == b"alice"
+
+
+def test_set_options_dynamic(tmp_db_path):
+    from toplingdb_tpu.utils.config import load_latest_options
+
+    with DB.open(tmp_db_path, opts()) as db:
+        db.set_options({"write_buffer_size": 999_999,
+                        "disable_auto_compactions": True})
+        assert db.options.write_buffer_size == 999_999
+        with pytest.raises(InvalidArgument):
+            db.set_options({"num_levels": 3})  # immutable
+        with pytest.raises(InvalidArgument):
+            db.set_options({"no_such_option": 1})
+        loaded = load_latest_options(tmp_db_path)
+        assert loaded.write_buffer_size == 999_999
+        assert loaded.disable_auto_compactions is True
+        import os
+
+        n_opts = sum(1 for f in os.listdir(tmp_db_path)
+                     if f.startswith("OPTIONS-"))
+        assert n_opts == 1, "old OPTIONS file not rolled"

@@ -1116,3 +1116,34 @@ def test_two_phase_commit_crash_after_commit_write(tmp_path):
     assert tdb2.get(b"k") == b"v1"
     assert tdb2.db.get(marker, cf=tdb2._txn_cf) is None, "marker must be swept"
     tdb2.close()
+
+
+def test_http_setoptions(tmp_path):
+    """POST /setoptions/<db> applies dynamic option changes (the rockside
+    online-config role)."""
+    import urllib.request as rq
+
+    from toplingdb_tpu.utils.config import SidePluginRepo
+
+    repo = SidePluginRepo()
+    repo.open_db({"path": str(tmp_path / "db"), "name": "d1", "options": {}})
+    port = repo.start_http()
+    req = rq.Request(
+        f"http://127.0.0.1:{port}/setoptions/d1",
+        data=json.dumps({"write_buffer_size": 777_777}).encode(),
+        method="POST",
+    )
+    body = json.loads(rq.urlopen(req).read())
+    assert body["ok"] is True
+    assert repo.get_db("d1").options.write_buffer_size == 777_777
+    # Bad option → 400.
+    req = rq.Request(
+        f"http://127.0.0.1:{port}/setoptions/d1",
+        data=json.dumps({"num_levels": 2}).encode(), method="POST",
+    )
+    try:
+        rq.urlopen(req)
+        raise AssertionError("expected HTTP 400")
+    except Exception as e:
+        assert getattr(e, "code", None) == 400
+    repo.close_all()

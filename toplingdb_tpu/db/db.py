@@ -801,6 +801,22 @@ class DB:
     def key_exists(self, key: bytes, opts: ReadOptions = _DEFAULT_READ) -> bool:
         return self.get(key, opts) is not None
 
+    def put_entity(self, key: bytes, columns: dict[bytes, bytes],
+                   opts: WriteOptions = _DEFAULT_WRITE, cf=None) -> None:
+        """Wide-column write (reference DB::PutEntity)."""
+        from toplingdb_tpu.db.wide_columns import encode_entity
+
+        self.put(key, encode_entity(columns), opts, cf=cf)
+
+    def get_entity(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
+                   cf=None) -> dict[bytes, bytes] | None:
+        """Wide-column read (reference DB::GetEntity); plain values present
+        as the anonymous default column."""
+        from toplingdb_tpu.db.wide_columns import decode_entity
+
+        v = self.get(key, opts, cf=cf)
+        return None if v is None else decode_entity(v)
+
     def get_merge_operands(self, key: bytes,
                            opts: ReadOptions = _DEFAULT_READ,
                            cf=None) -> list[bytes]:
@@ -1059,6 +1075,60 @@ class DB:
     def continue_background_work(self) -> None:
         if self._compaction_scheduler is not None:
             self._compaction_scheduler.resume_background()
+
+    _MUTABLE_OPTIONS = frozenset({
+        "write_buffer_size", "level0_file_num_compaction_trigger",
+        "level0_slowdown_writes_trigger", "level0_stop_writes_trigger",
+        "disable_auto_compactions", "max_bytes_for_level_base",
+        "max_bytes_for_level_multiplier", "target_file_size_base",
+        "target_file_size_multiplier", "max_compaction_bytes",
+        "max_subcompactions", "max_background_jobs",
+        "enable_blob_garbage_collection",
+        "blob_garbage_collection_age_cutoff", "min_blob_size",
+        "seqno_time_sample_period_sec",
+    })
+
+    def set_options(self, changes: dict) -> None:
+        """Online option changes for the mutable subset (reference
+        DB::SetOptions; the SidePlugin online-config mechanism). Unknown or
+        immutable names — and values of the wrong type — raise
+        InvalidArgument; the new values persist to a fresh OPTIONS file
+        (persistence failures propagate). Serialized under the DB mutex so
+        concurrent callers (the threaded HTTP server) can't interleave the
+        OPTIONS-file roll."""
+        base = Options()
+        for k, v in changes.items():
+            if k not in self._MUTABLE_OPTIONS:
+                raise InvalidArgument(f"option {k!r} is not dynamically "
+                                      f"changeable")
+            want = type(getattr(base, k))
+            if want is bool:
+                ok = isinstance(v, bool)
+            elif want is int:
+                ok = isinstance(v, int) and not isinstance(v, bool)
+            elif want is float:
+                ok = isinstance(v, (int, float)) and not isinstance(v, bool)
+            else:
+                ok = isinstance(v, want)
+            if not ok:
+                raise InvalidArgument(
+                    f"option {k!r} expects {want.__name__}, "
+                    f"got {type(v).__name__}"
+                )
+        from toplingdb_tpu.utils.config import persist_options
+
+        with self._mutex:
+            for k, v in changes.items():
+                setattr(self.options, k, v)
+            old = self._options_file_number
+            persist_options(self)
+            if old:
+                try:
+                    self.env.delete_file(
+                        filename.options_file_name(self.dbname, old))
+                except NotFound:
+                    pass
+        self._maybe_schedule_compaction()
 
     def get_stats_history(self, start_time: int = 0, end_time: int = 2 ** 62):
         """Time-series ticker deltas (reference DBImpl::GetStatsHistory,

@@ -56,17 +56,13 @@ def decode_entity(value: bytes) -> dict[bytes, bytes]:
 
 def put_entity(db, key: bytes, columns: dict[bytes, bytes], *, opts=None,
                cf=None) -> None:
-    kw = {}
-    if opts is not None:
-        kw["opts"] = opts
-    db.put(key, encode_entity(columns), cf=cf, **kw)
+    """Thin alias for DB.put_entity (kept for callers that import the
+    module functions)."""
+    kw = {"opts": opts} if opts is not None else {}
+    db.put_entity(key, columns, cf=cf, **kw)
 
 
 def get_entity(db, key: bytes, *, opts=None, cf=None) -> dict[bytes, bytes] | None:
-    kw = {}
-    if opts is not None:
-        kw["opts"] = opts
-    v = db.get(key, cf=cf, **kw)
-    if v is None:
-        return None
-    return decode_entity(v)
+    """Thin alias for DB.get_entity."""
+    kw = {"opts": opts} if opts is not None else {}
+    return db.get_entity(key, cf=cf, **kw)

@@ -296,6 +296,14 @@ class SidePluginRepo:
             def log_message(self, *a):
                 pass
 
+            def _send_json(self, code: int, body) -> None:
+                data = json.dumps(body, indent=1, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):
                 parts = [p for p in self.path.split("/") if p]
                 try:
@@ -304,12 +312,29 @@ class SidePluginRepo:
                     body = body if body is not None else {"error": "not found"}
                 except Exception as e:  # introspection must not crash
                     code, body = 500, {"error": repr(e)}
-                data = json.dumps(body, indent=1, default=str).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                self._send_json(code, body)
+
+            def do_POST(self):
+                # Online option change (the rockside online-config role):
+                # POST /setoptions/<name> {"write_buffer_size": ...}
+                parts = [p for p in self.path.split("/") if p]
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    if parts and parts[0] == "setoptions":
+                        db = repo._dbs.get("/".join(parts[1:]))
+                        if db is None:
+                            code, body = 404, {"error": "no such db"}
+                        else:
+                            db.set_options(payload)
+                            code, body = 200, {"ok": True, "applied": payload}
+                    else:
+                        code, body = 404, {"error": "not found"}
+                except (InvalidArgument, ValueError) as e:  # client's fault
+                    code, body = 400, {"error": repr(e)}
+                except Exception as e:  # server-side failure
+                    code, body = 500, {"error": repr(e)}
+                self._send_json(code, body)
 
         self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
