@@ -1147,3 +1147,49 @@ def test_http_setoptions(tmp_path):
     except Exception as e:
         assert getattr(e, "code", None) == 400
     repo.close_all()
+
+
+def test_clock_cache(tmp_db_path):
+    from toplingdb_tpu.utils.cache import ClockCache
+
+    c = ClockCache(1000)
+    for i in range(10):
+        c.insert(b"k%02d" % i, b"x" * 90, 100)
+    assert c.usage() <= 1000
+    # Touch a subset: their ref bits protect them through the next sweep.
+    for i in (0, 1):
+        c.lookup(b"k%02d" % i)
+    for i in range(10, 16):
+        c.insert(b"k%02d" % i, b"y" * 90, 100)
+    assert c.usage() <= 1000
+    c.erase(b"k15")
+    assert c.lookup(b"k15") is None
+    # As a DB block cache.
+    from toplingdb_tpu.db.db import DB
+
+    with DB.open(tmp_db_path, opts(block_cache=ClockCache(64 * 1024),
+                                   disable_auto_compactions=True)) as db:
+        for i in range(2000):
+            db.put(b"key%05d" % i, b"v%05d" % i)
+        db.flush()
+        for i in range(0, 2000, 7):
+            assert db.get(b"key%05d" % i) == b"v%05d" % i
+        assert db.options.block_cache.hits > 0
+
+
+def test_compressed_secondary_cache(tmp_db_path):
+    from toplingdb_tpu.utils.cache import CompressedSecondaryCache, LRUCache
+
+    sec = CompressedSecondaryCache(1 << 20)
+    lru = LRUCache(2 * 1024, num_shards=1, secondary=sec)
+    blocks = {b"b%02d" % i: (b"content-%02d" % i) * 40 for i in range(20)}
+    for k, v in blocks.items():
+        lru.insert(k, v, len(v))
+    # Early blocks spilled compressed; lookup decompresses + promotes.
+    assert lru.lookup(b"b00") == blocks[b"b00"]
+    assert sec.hits >= 1
+    assert sec.usage() < sum(len(v) for v in blocks.values()), \
+        "tier must actually compress"
+    sec.erase(b"b01")
+    lru2 = LRUCache(1024, num_shards=1, secondary=sec)
+    assert lru2.lookup(b"b01") is None

@@ -104,6 +104,162 @@ class LRUCache:
         return hits / total if total else 0.0
 
 
+class ClockCache:
+    """CLOCK-eviction cache (reference cache/clock_cache.cc HyperClockCache's
+    role): a ring of slots with reference bits — lookups only SET a bit (no
+    list reordering, far less lock work than LRU), eviction sweeps the clock
+    hand clearing bits until it finds a cold slot. Same surface as LRUCache
+    (lookup/insert/erase/usage/hit_rate + optional secondary tier)."""
+
+    def __init__(self, capacity_bytes: int, secondary=None, tracer=None):
+        self._cap = capacity_bytes
+        self._slots: dict[bytes, list] = {}  # key -> [value, charge, refbit]
+        self._ring: list[bytes] = []
+        self._hand = 0
+        self._usage = 0
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.secondary = secondary
+        self.tracer = tracer
+
+    def lookup(self, key: bytes):
+        slot = self._slots.get(key)
+        if slot is not None:
+            slot[2] = 1  # reference bit: no lock, no reordering
+            self.hits += 1
+            if self.tracer is not None:
+                self.tracer.record_access(key, True)
+            return slot[0]
+        self.misses += 1
+        v = None
+        if self.secondary is not None:
+            v = self.secondary.lookup(key)
+            if v is not None:
+                self.insert(key, v, len(v))  # promote
+        if self.tracer is not None:
+            self.tracer.record_access(key, v is not None)
+        return v
+
+    def insert(self, key: bytes, value, charge: int) -> None:
+        evicted = []
+        with self._mu:
+            old = self._slots.get(key)
+            if old is not None:
+                self._usage += charge - old[1]
+                old[0], old[1], old[2] = value, charge, 1
+            else:
+                self._slots[key] = [value, charge, 1]
+                self._ring.append(key)
+                self._usage += charge
+            # CLOCK sweep: clear ref bits until cold slots free the budget.
+            # Bound captured ONCE — recomputing against the shrinking ring
+            # could stop the sweep while cold evictable slots remain.
+            spins = 0
+            limit = 2 * len(self._ring) + 2
+            while self._usage > self._cap and self._ring and spins < limit:
+                if self._hand >= len(self._ring):
+                    self._hand = 0
+                k = self._ring[self._hand]
+                slot = self._slots.get(k)
+                if slot is None:  # lazily drop erased keys from the ring
+                    self._ring.pop(self._hand)
+                    continue
+                if slot[2]:
+                    slot[2] = 0
+                    self._hand += 1
+                elif k == key:
+                    self._hand += 1  # never evict the entry being inserted
+                else:
+                    self._ring.pop(self._hand)
+                    del self._slots[k]
+                    self._usage -= slot[1]
+                    evicted.append((k, slot[0]))
+                spins += 1
+        if self.secondary is not None:
+            for k, v in evicted:
+                self.secondary.insert(k, v)
+
+    def erase(self, key: bytes) -> None:
+        with self._mu:
+            slot = self._slots.pop(key, None)
+            if slot is not None:
+                self._usage -= slot[1]
+                try:
+                    # Eager purge: lazy cleanup only runs during eviction
+                    # sweeps, so under-capacity erase/re-insert churn would
+                    # grow the ring without bound.
+                    self._ring.remove(key)
+                except ValueError:
+                    pass
+        if self.secondary is not None:
+            erase = getattr(self.secondary, "erase", None)
+            if erase is not None:
+                erase(key)
+
+    def usage(self) -> int:
+        return self._usage
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class CompressedSecondaryCache:
+    """In-RAM compressed tier (reference cache/compressed_secondary_cache.cc):
+    evicted uncompressed blocks are zlib-compressed and kept in a bounded
+    FIFO dict; hits decompress and promote back to the primary."""
+
+    def __init__(self, capacity_bytes: int = 64 << 20, level: int = 1):
+        import zlib
+
+        self._zlib = zlib
+        self._cap = capacity_bytes
+        self._level = level
+        self._items: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._usage = 0
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def insert(self, key: bytes, value) -> None:
+        if not isinstance(value, (bytes, bytearray)):
+            return
+        c = self._zlib.compress(bytes(value), self._level)
+        with self._mu:
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._usage -= len(old)  # REPLACE: never serve stale bytes
+            self._items[key] = c
+            self._usage += len(c)
+            while self._usage > self._cap and self._items:
+                _, dropped = self._items.popitem(last=False)
+                self._usage -= len(dropped)
+
+    def lookup(self, key: bytes):
+        """Hit = ownership transfer: the entry is POPPED (the caller
+        promotes it to the primary, as the reference secondary cache hands
+        its value over) — re-eviction re-spills fresh bytes."""
+        with self._mu:
+            c = self._items.pop(key, None)
+            if c is not None:
+                self._usage -= len(c)
+        if c is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return self._zlib.decompress(c)
+
+    def erase(self, key: bytes) -> None:
+        with self._mu:
+            c = self._items.pop(key, None)
+            if c is not None:
+                self._usage -= len(c)
+
+    def usage(self) -> int:
+        return self._usage
+
+
 class _Shard:
     def __init__(self, capacity: int, spill=None):
         self._cap = capacity
