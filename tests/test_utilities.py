@@ -1193,3 +1193,89 @@ def test_compressed_secondary_cache(tmp_db_path):
     sec.erase(b"b01")
     lru2 = LRUCache(1024, num_shards=1, secondary=sec)
     assert lru2.lookup(b"b01") is None
+
+
+def test_auto_sort_table_builder(tmp_path):
+    """VecAutoSortTable role: unsorted bulk adds sort at finish with
+    last-write-wins on duplicates."""
+    import random
+
+    from toplingdb_tpu.db import dbformat
+    from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
+    from toplingdb_tpu.env import PosixEnv
+    from toplingdb_tpu.table.builder import TableOptions
+    from toplingdb_tpu.table.factory import new_table_builder, open_table
+
+    env = PosixEnv()
+    icmp = InternalKeyComparator(dbformat.BYTEWISE)
+    path = str(tmp_path / "auto.sst")
+    w = env.new_writable_file(path)
+    topts = TableOptions(format="single_fast", auto_sort=True)
+    b = new_table_builder(w, icmp, topts)
+    rng = random.Random(4)
+    keys = list(range(500))
+    rng.shuffle(keys)
+    for i in keys:
+        b.add(dbformat.make_internal_key(b"k%04d" % i, 7, ValueType.VALUE),
+              b"old%04d" % i)
+    # Duplicate internal key: the LAST add must win.
+    b.add(dbformat.make_internal_key(b"k0042", 7, ValueType.VALUE), b"NEW")
+    props = b.finish()
+    w.close()
+    assert props.num_entries == 500
+    r = open_table(env.new_random_access_file(path), icmp, topts)
+    it = r.new_iterator()
+    it.seek_to_first()
+    got = list(it.entries())
+    assert [k[:-8] for k, _ in got] == [b"k%04d" % i for i in range(500)]
+    assert dict((k[:-8], v) for k, v in got)[b"k0042"] == b"NEW"
+
+
+def test_option_change_migration(tmp_path):
+    from toplingdb_tpu.utilities.option_migration import migrate_options
+
+    d = str(tmp_path / "db")
+    leveled = opts(compaction_style="leveled", disable_auto_compactions=True)
+    with DB.open(d, leveled) as db:
+        for i in range(3000):
+            db.put(b"key%05d" % i, b"v%05d" % i)
+        db.flush()
+        db.compact_range()
+    # leveled → fifo: every file must end up in L0.
+    fifo = opts(compaction_style="fifo", disable_auto_compactions=True)
+    migrate_options(d, leveled, fifo)
+    with DB.open(d, fifo) as db:
+        v = db.versions.current
+        assert all(not v.files[lvl] for lvl in range(1, v.num_levels)), \
+            "files left outside L0 after fifo migration"
+        assert db.get(b"key01500") == b"v01500"
+    # fifo → universal round trip stays readable.
+    uni = opts(compaction_style="universal", disable_auto_compactions=True)
+    migrate_options(d, fifo, uni)
+    with DB.open(d, uni) as db:
+        assert db.get(b"key02999") == b"v02999"
+
+
+def test_auto_recovery_from_retryable_error(tmp_path):
+    """A retryable background IO error auto-resumes (reference
+    StartRecoverFromRetryableBGIOError) without a manual resume()."""
+    import time as _t
+
+    from toplingdb_tpu.utils.status import IOError_
+
+    d = str(tmp_path / "db")
+    with DB.open(d, opts()) as db:
+        db.put(b"a", b"1")
+        db._set_background_error(IOError_("transient", retryable=True))
+        deadline = _t.time() + 5.0
+        while db._bg_error is not None and _t.time() < deadline:
+            _t.sleep(0.02)
+        assert db._bg_error is None, "auto recovery never cleared the error"
+        db.put(b"b", b"2")  # writes work again
+        assert db.get(b"b") == b"2"
+        # NON-retryable errors stay latched until manual resume().
+        db._set_background_error(IOError_("permanent"))
+        _t.sleep(0.3)
+        assert db._bg_error is not None
+        db.resume()
+        db.put(b"c", b"3")

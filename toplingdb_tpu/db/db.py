@@ -932,10 +932,53 @@ class DB:
             raise IOError_(f"background error: {self._bg_error!r}")
 
     def _set_background_error(self, e: BaseException) -> None:
-        """Reference ErrorHandler::SetBGError: stop writes until resume()."""
+        """Reference ErrorHandler::SetBGError: stop writes until resume().
+        Retryable IO errors additionally start the auto-recovery thread
+        (reference StartRecoverFromRetryableBGIOError,
+        db/error_handler.cc:631): retry resume() with backoff until the
+        transient fault clears or attempts run out."""
         with self._mutex:
-            if self._bg_error is None:
-                self._bg_error = e
+            if self._bg_error is not None:
+                return
+            self._bg_error = e
+        if getattr(e, "retryable", False):
+            t = threading.Thread(target=self._auto_recover_loop, args=(e,),
+                                 daemon=True)
+            t.start()
+
+    def _auto_recover_loop(self, target: BaseException,
+                           max_attempts: int = 10,
+                           base_delay: float = 0.05) -> None:
+        """Only ever clears THE error it was started for (or retryable ones
+        it re-latched itself) — a concurrently latched non-retryable error,
+        or a manual resume(), ends the loop untouched (reference checks the
+        recovery error identity the same way)."""
+        for attempt in range(max_attempts):
+            time.sleep(min(base_delay * (2 ** attempt), 2.0))
+            with self._mutex:
+                if self._closed or self._bg_error is not target:
+                    return
+            try:
+                self.resume()
+                self.wait_for_compactions()
+                self.event_logger.log("auto_recovery_succeeded",
+                                      attempts=attempt + 1)
+                from toplingdb_tpu.utils.listener import notify
+
+                notify(self.options.listeners, "on_error_recovery_completed",
+                       self, None)
+                return
+            except Exception as err:  # still failing
+                if not getattr(err, "retryable", False):
+                    self._set_background_error(err)  # latch; stop retrying
+                    return
+                with self._mutex:
+                    if self._bg_error is None:
+                        self._bg_error = err
+                    elif self._bg_error is not err:
+                        return  # someone else latched; not ours to clear
+                target = err
+        self.event_logger.log("auto_recovery_gave_up", attempts=max_attempts)
 
     def resume(self) -> None:
         """Clear a background error and restart background work (reference

@@ -42,6 +42,10 @@ class TableOptions:
     # single_fast only: also write an open-addressed hash bucket index for
     # O(1) point lookups (the CuckooTable / PlainTable prefix-hash role).
     hash_index: bool = False
+    # single_fast only: accept UNSORTED adds and sort at finish (the Topling
+    # VecAutoSortTable role — bulk loads without pre-sorting); exact
+    # duplicate internal keys dedup last-write-wins.
+    auto_sort: bool = False
     # >1 enables the producer/consumer compression pipeline (reference
     # CompressionOptions.parallel_threads / ParallelCompressionRep,
     # block_based_table_builder.cc:818-825): data blocks compress on worker

@@ -25,6 +25,11 @@ def new_table_builder(wfile, icmp, options: TableOptions | None = None,
                       **kw):
     options = options or TableOptions()
     f = getattr(options, "format", "block")
+    if getattr(options, "auto_sort", False) and f != "single_fast":
+        raise InvalidArgument(
+            "auto_sort is a single_fast-format feature (the block builder "
+            "requires sorted adds)"
+        )
     if f == "block":
         return TableBuilder(wfile, icmp, options, **kw)
     if f == "single_fast":

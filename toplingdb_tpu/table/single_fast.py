@@ -67,13 +67,16 @@ class SingleFastTableBuilder:
             f.create() for f in self.opts.properties_collector_factories
         ]
         self.need_compaction = False
+        self._unsorted: list[tuple[bytes, bytes]] = []  # auto_sort buffer
+        self._unsorted_bytes = 0
 
     @property
     def num_entries(self) -> int:
         return self.props.num_entries + self.props.num_range_deletions
 
     def file_size(self) -> int:
-        return self._w.file_size() + len(self._buf)
+        # _unsorted_bytes: output-cutting must see buffered auto_sort adds.
+        return self._w.file_size() + len(self._buf) + self._unsorted_bytes
 
     @property
     def smallest_key(self) -> bytes | None:
@@ -94,6 +97,14 @@ class SingleFastTableBuilder:
 
     def add(self, ikey: bytes, value: bytes) -> None:
         assert not self._finished
+        if self.opts.auto_sort:
+            # VecAutoSortTable mode: buffer now, sort at finish.
+            self._unsorted.append((ikey, value))
+            self._unsorted_bytes += len(ikey) + len(value) + 10
+            return
+        self._add_sorted(ikey, value)
+
+    def _add_sorted(self, ikey: bytes, value: bytes) -> None:
         if self._last_key is not None:
             assert self._icmp.compare(self._last_key, ikey) < 0
         if len(self._buf) + len(ikey) + len(value) + 10 > 0xFFFFFF00:
@@ -139,6 +150,19 @@ class SingleFastTableBuilder:
 
     def finish(self) -> TableProperties:
         assert not self._finished
+        if self.opts.auto_sort and self._unsorted:
+            # Reverse + STABLE sort: among exact-duplicate internal keys the
+            # latest add comes first, so dedup keeps last-write-wins.
+            ents = sorted(reversed(self._unsorted),
+                          key=lambda kv: self._icmp.sort_key(kv[0]))
+            self._unsorted = []
+            self._unsorted_bytes = 0
+            prev = None
+            for k, v in ents:
+                if prev is not None and self._icmp.compare(prev, k) == 0:
+                    continue  # older duplicate
+                self._add_sorted(k, v)
+                prev = k
         for c in self._collectors:
             self.props.user_collected.update(c.finish())
             if c.need_compact():

@@ -1,0 +1,46 @@
+"""Option-change migration.
+
+Analogue of the reference's option_change_migration
+(utilities/option_change_migration/option_change_migration.cc): reshape an
+existing DB's file layout so a different compaction style's invariants hold
+before reopening with the new options:
+
+  * → leveled: any layout is legal; a full manual compaction tidies it.
+  * → universal: the picker sees L0 runs + one base run in the last level;
+    a full compaction leaves exactly that shape.
+  * → fifo: ALL files must live in L0 (fifo only ever looks there); after
+    compacting, every file is MOVED to L0 (overlap-legal).
+"""
+
+from __future__ import annotations
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.db.version_edit import VersionEdit
+from toplingdb_tpu.options import Options
+
+
+def migrate_options(dbname: str, from_options: Options, to_options: Options,
+                    env=None) -> None:
+    """Run the migration and persist the new options. The DB must be closed;
+    it is reopened briefly twice (old options to reshape, new to validate)."""
+    with DB.open(dbname, from_options, env=env) as db:
+        db.compact_range()  # one sorted run at the bottom
+        if to_options.compaction_style == "fifo":
+            moved = False
+            with db._mutex:
+                for cf_id in db.versions.column_families:
+                    v = db.versions.cf_current(cf_id)
+                    edit = VersionEdit(column_family=cf_id)
+                    any_move = False
+                    for level in range(1, v.num_levels):
+                        for f in v.files[level]:
+                            edit.delete_file(level, f.number)
+                            edit.add_file(0, f)
+                            any_move = True
+                    if any_move:
+                        db.versions.log_and_apply(edit)
+                        moved = True
+            if moved:
+                db.event_logger.log("option_migration_moved_to_l0")
+    # Validate + persist the new options (writes a fresh OPTIONS file).
+    DB.open(dbname, to_options, env=env).close()

@@ -126,7 +126,7 @@ _MERGE_OP_NAMES = {
 _SIMPLE_TABLE_KEYS = (
     "format", "block_size", "restart_interval", "index_restart_interval",
     "compression", "whole_key_filtering", "verify_checksums", "index_type",
-    "metadata_block_size", "hash_index",
+    "metadata_block_size", "hash_index", "auto_sort",
 )
 
 
