@@ -118,6 +118,9 @@ def main():
                 except Exception:
                     pass
             tpu_fallback = True
+            # With no accelerator, the vectorized host sort (np.lexsort)
+            # beats running the jax program on the cpu backend.
+            os.environ["TPULSM_HOST_SORT"] = "1"
             print("jax backend unreachable; falling back to cpu backend",
                   file=sys.stderr, flush=True)
 

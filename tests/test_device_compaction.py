@@ -359,3 +359,111 @@ def test_device_in_stripe_tombstone_not_masked_by_newer_stripe():
     got = list(device_gc_entries(entries, ICMP, snaps, True, rd=rd))
     assert got == want
     assert got == [], "value@219 must be deleted by tombstone@262 (stripe 0)"
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13, 14])
+def test_host_sort_twin_matches_fused_kernel(seed):
+    """fused_encode_sort_gc_host (the TPULSM_HOST_SORT numpy twin used when
+    no accelerator is reachable) must produce IDENTICAL outputs to the jax
+    fused kernel."""
+    import numpy as np
+
+    from toplingdb_tpu.ops import compaction_kernels as ck
+
+    rng = random.Random(seed)
+    entries = gen_workload(rng, rng.randrange(30, 300))
+    entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))  # any order works; vary
+    if seed % 2:
+        rng.shuffle(entries)
+    key_buf = bytearray()
+    offs, lens = [], []
+    for ik, _ in entries:
+        offs.append(len(key_buf))
+        lens.append(len(ik))
+        key_buf += ik
+    kb = np.frombuffer(bytes(key_buf), dtype=np.uint8)
+    ko = np.array(offs, np.int64)
+    kl = np.array(lens, np.int64)
+    mkb = max(4, int(kl.max()) - 8)
+    snaps = sorted(rng.sample(range(1, len(entries) + 2),
+                              rng.randrange(0, 4)))
+    bottom = rng.random() < 0.5
+    a = ck.fused_encode_sort_gc(kb, ko, kl, mkb, snaps, bottom)
+    b = ck.fused_encode_sort_gc_host(kb, ko, kl, mkb, snaps, bottom)
+    assert np.array_equal(a[0], b[0]), "survivor order differs"
+    assert np.array_equal(a[1], b[1]), "zero flags differ"
+    assert a[2] == b[2], "has_complex differs"
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_host_sort_twin_varlen_keys_and_big_seqnos(seed):
+    """Host-twin parity where it's riskiest: variable-length keys (length
+    tie-break, prefix ordering) and seqnos crossing the 2^24/2^32 word
+    boundaries of the device's split-word sort."""
+    import numpy as np
+
+    from toplingdb_tpu.db.dbformat import ValueType, make_internal_key
+    from toplingdb_tpu.ops import compaction_kernels as ck
+
+    rng = random.Random(seed)
+    entries = []
+    for i in range(rng.randrange(50, 250)):
+        klen = rng.randrange(1, 24)
+        uk = bytes(rng.randrange(97, 100) for _ in range(klen))  # a-c: dups
+        seq = rng.choice([rng.randrange(1, 1 << 10),
+                          rng.randrange(1 << 23, 1 << 25),
+                          rng.randrange(1 << 31, 1 << 40)])
+        t = ValueType.VALUE if rng.random() < 0.8 else ValueType.DELETION
+        entries.append((make_internal_key(uk, seq, t), b"v%d" % i))
+    key_buf = bytearray()
+    offs, lens = [], []
+    for ik, _ in entries:
+        offs.append(len(key_buf)); lens.append(len(ik)); key_buf += ik
+    kb = np.frombuffer(bytes(key_buf), dtype=np.uint8)
+    ko = np.array(offs, np.int64); kl = np.array(lens, np.int64)
+    mkb = max(4, int(kl.max()) - 8)
+    snaps = sorted(rng.sample(range(1, 1 << 40), rng.randrange(0, 5)))
+    bottom = rng.random() < 0.5
+    a = ck.fused_encode_sort_gc(kb, ko, kl, mkb, snaps, bottom)
+    b = ck.fused_encode_sort_gc_host(kb, ko, kl, mkb, snaps, bottom)
+    assert np.array_equal(a[0], b[0])
+    assert np.array_equal(a[1], b[1])
+    assert a[2] == b[2]
+
+
+def test_host_sort_tombstone_path_byte_parity(tmp_path, monkeypatch):
+    """TPULSM_HOST_SORT=1 covers the tombstone-bearing columnar branch too:
+    same SST bytes as the jax path."""
+    import os
+
+    from toplingdb_tpu.compaction.executor import DeviceCompactionExecutorFactory
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    outs = {}
+    for host in (0, 1):
+        if host:
+            monkeypatch.setenv("TPULSM_HOST_SORT", "1")
+        else:
+            monkeypatch.delenv("TPULSM_HOST_SORT", raising=False)
+        d = str(tmp_path / f"db{host}")
+        o = Options(write_buffer_size=1 << 20, disable_auto_compactions=True,
+                    compaction_executor_factory=DeviceCompactionExecutorFactory(
+                        device="cpu-jax"))
+        with DB.open(d, o) as db:
+            for i in range(3000):
+                db.put(b"key%05d" % (i % 2000), b"v%05d" % i)
+            snap = db.get_snapshot()  # pins the tombstone through compaction
+            db.delete_range(b"key00500", b"key01500")
+            db.flush()
+            from unittest import mock
+
+            with mock.patch("time.time", lambda: 1753750123.0):
+                db.compact_range()
+            snap.release()
+            ssts = sorted(f for f in os.listdir(d) if f.endswith(".sst"))
+            outs[host] = [open(os.path.join(d, f), "rb").read()
+                          for f in ssts]
+    assert len(outs[0]) == len(outs[1]) and outs[0], "no outputs"
+    for x, y in zip(outs[0], outs[1]):
+        assert x == y, "host-sort tombstone path bytes differ from jax path"

@@ -241,6 +241,113 @@ def fused_sort_gc(padded: dict, snapshots: list[int], bottommost: bool):
     return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)
 
 
+def host_encode_sort(key_buf: np.ndarray, key_offs: np.ndarray,
+                     key_lens: np.ndarray, max_key_bytes: int):
+    """NumPy half-twin: columnar encode + np.lexsort into internal-key
+    order. Returns (s, words, uk_len, seq, vtype) with s = sorted→original
+    permutation and the UNSORTED per-entry columns."""
+    n = len(key_offs)
+    offs = key_offs.astype(np.int64)
+    lens = key_lens.astype(np.int64)
+
+    # Trailer → packed (seq<<8|type), little-endian on disk.
+    tr_idx = (offs + lens - 8)[:, None] + np.arange(8)[None, :]
+    tr = key_buf[tr_idx].astype(np.uint64)
+    packed = np.zeros(n, dtype=np.uint64)
+    for i in range(8):
+        packed |= tr[:, i] << np.uint64(8 * i)
+    seq = packed >> np.uint64(8)
+    vtype = (packed & np.uint64(0xFF)).astype(np.int32)
+    inv = ~packed  # descending seq under an ascending sort
+
+    # Big-endian user-key words, zero-masked past each key's length.
+    w = (max_key_bytes + 3) // 4
+    span = w * 4
+    uk_len = lens - 8
+    idx = offs[:, None] + np.arange(span)[None, :]
+    np.clip(idx, 0, max(len(key_buf) - 1, 0), out=idx)
+    kb = key_buf[idx].astype(np.uint32)
+    kb *= np.arange(span)[None, :] < uk_len[:, None]
+    kbw = kb.reshape(n, w, 4)
+    words = ((kbw[:, :, 0] << 24) | (kbw[:, :, 1] << 16)
+             | (kbw[:, :, 2] << 8) | kbw[:, :, 3])
+
+    # lexsort: LAST column is primary — mirror the device operand order
+    # (key words..., key_len, inv): stable, so duplicate internal keys keep
+    # input order (the device sort has no key ties for distinct seqnos).
+    s = np.lexsort((inv, uk_len) + tuple(
+        words[:, j] for j in range(w - 1, -1, -1)
+    ))
+    return s, words, uk_len, seq, vtype
+
+
+def host_gc_mask(skw, slen, sseq, svt, snapshots, cover, bottommost):
+    """NumPy twin of the GC mask over SORTED columns; `cover` is the
+    per-sorted-entry stripe-clamped max covering tombstone seq (or None).
+    Returns (keep, zero_seq, host_resolve, group_id) like gc_mask."""
+    n = len(sseq)
+    same_key = np.zeros(n, dtype=bool)
+    if n > 1:
+        same_key[1:] = np.all(skw[1:] == skw[:-1], axis=1) & (
+            slen[1:] == slen[:-1]
+        )
+    new_key = ~same_key
+    snaps = np.asarray(sorted(snapshots), dtype=np.uint64)
+    stripe = np.searchsorted(snaps, sseq, side="left").astype(np.int64)
+    first_in_stripe = new_key.copy()
+    if n > 1:
+        first_in_stripe[1:] |= stripe[1:] != stripe[:-1]
+
+    is_complex = (svt == int(ValueType.MERGE)) | (
+        svt == int(ValueType.SINGLE_DELETION)
+    )
+    group_id = np.cumsum(new_key) - 1
+    starts = np.flatnonzero(new_key)
+    group_complex = (np.bitwise_or.reduceat(is_complex, starts)
+                     if n else np.zeros(0, dtype=bool))
+    host_resolve = group_complex[group_id] if n else is_complex
+
+    covered = np.zeros(n, dtype=bool)
+    if cover is not None:
+        c = np.asarray(cover, dtype=np.uint64)
+        covered = (c != 0) & (c > sseq)  # cover is stripe-clamped already
+
+    keep = first_in_stripe & ~covered
+    if bottommost:
+        keep &= ~((stripe == 0) & (svt == int(ValueType.DELETION)))
+    zero_seq = (
+        keep & bool(bottommost) & (stripe == 0)
+        & (svt == int(ValueType.VALUE))
+    )
+    keep &= ~host_resolve
+    return keep, zero_seq, host_resolve, group_id
+
+
+def fused_encode_sort_gc_host(key_buf: np.ndarray, key_offs: np.ndarray,
+                              key_lens: np.ndarray, max_key_bytes: int,
+                              snapshots: list[int], bottommost: bool):
+    """NumPy twin of fused_encode_sort_gc for accelerator-less deployments
+    (selected via TPULSM_HOST_SORT=1, e.g. the bench's tpu-unreachable
+    fallback): np.lexsort realizes the same internal-key order and the GC
+    mask is the same vector math — outputs are identical (parity-tested)."""
+    if len(snapshots) > MAX_SNAPSHOTS:
+        raise NotSupported(
+            f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
+        )
+    n = len(key_offs)
+    if n == 0:
+        return np.empty(0, np.int32), np.empty(0, bool), False
+    s, words, uk_len, seq, vtype = host_encode_sort(
+        key_buf, key_offs, key_lens, max_key_bytes
+    )
+    keep, zero_seq, host_resolve, _ = host_gc_mask(
+        words[s], uk_len[s], seq[s], vtype[s], snapshots, None, bottommost
+    )
+    order = s[keep].astype(np.int32)
+    zero_flags = zero_seq[keep]
+    return order, zero_flags, bool(host_resolve.any())
+
+
 @functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
 def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
                                snap_hi, snap_lo, num_key_words, bottommost):

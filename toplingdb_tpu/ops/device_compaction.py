@@ -15,6 +15,7 @@ device="tpu"|"cpu" (the jax backend).
 from __future__ import annotations
 
 import bisect
+import os
 import time
 
 import numpy as np
@@ -82,6 +83,12 @@ def _tombstone_cover(sorted_user_keys: list[bytes], rd: RangeDelAggregator,
 # 4 key bytes, and XLA compile time grows with operand count. Longer keys
 # route to the host CompactionIterator (scheduler fallback-to-local).
 MAX_DEVICE_KEY_BYTES = 128
+
+
+def _host_sort() -> bool:
+    """TPULSM_HOST_SORT=1: no accelerator attached — the numpy twins beat
+    running the jax programs on the cpu backend (set by bench's fallback)."""
+    return os.environ.get("TPULSM_HOST_SORT") == "1"
 
 
 def device_gc_entries(entries, icmp, snapshots, bottommost,
@@ -294,8 +301,12 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # Tombstone-free: encode + sort + GC in ONE device program fed raw
         # key bytes (half the upload of pre-built columns, no host gather).
         mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
+        fused = (
+            ck.fused_encode_sort_gc_host if _host_sort()
+            else ck.fused_encode_sort_gc
+        )
         try:
-            order, zero_flags, has_complex = ck.fused_encode_sort_gc(
+            order, zero_flags, has_complex = fused(
                 kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
                 compaction.bottommost,
             )
@@ -304,6 +315,27 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         if has_complex:
             raise _FallbackToEntries()
         zero_orig = order[zero_flags]
+        col = _kv_seq_vtype(kv)
+    elif _host_sort():
+        # Accelerator-less: numpy twins for the tombstone-bearing path too.
+        mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
+        s, words, uk_len, seq, vtype = ck.host_encode_sort(
+            kv.key_buf, kv.key_offs, kv.key_lens, mkb
+        )
+        sorted_uks = [
+            kv.key_buf[kv.key_offs[i]: kv.key_offs[i] + kv.key_lens[i] - 8]
+            .tobytes() for i in s
+        ]
+        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator,
+                                 seq[s], snapshots)
+        keep, zero_seq, host_resolve, _ = ck.host_gc_mask(
+            words[s], uk_len[s], seq[s], vtype[s], snapshots, cover,
+            compaction.bottommost,
+        )
+        if host_resolve.any():
+            raise _FallbackToEntries()
+        order = s[keep]
+        zero_orig = s[zero_seq]
         col = _kv_seq_vtype(kv)
     else:
         col = columnar_from_kv(kv)
