@@ -93,6 +93,16 @@ def lib() -> ctypes.CDLL | None:
             u8p, i32p, i32p, ctypes.c_int64,
             ctypes.c_uint64, ctypes.c_uint32, u8p,
         ]
+        try:
+            # A stale .so may predate this symbol; degrade to the numpy
+            # sort twin instead of breaking every native caller.
+            l.tpulsm_sort_entries.restype = ctypes.c_int32
+            l.tpulsm_sort_entries.argtypes = [
+                u8p, i64p, i64p, ctypes.c_int64,        # key buf/offs/lens, n
+                i32p, u8p,                              # order_out, new_key_out
+            ]
+        except AttributeError:
+            pass
         _lib = l
         return _lib
 

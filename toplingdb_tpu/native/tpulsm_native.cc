@@ -6,12 +6,63 @@
 // slicing-by-8 CRC32C and a from-spec xxhash64.
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 -o _tpulsm_native.so tpulsm_native.cc
+#include <algorithm>
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include <numeric>
 #include <vector>
 
 extern "C" {
+
+// ---------------------------------------------------------------------------
+// Internal-key sort: order entries by (user key bytes asc, key length asc,
+// seqno desc) — the exact order the device sort realizes with zero-padded
+// big-endian key words + length tie-break + inverted packed trailer. Also
+// emits the adjacent new-user-key boundaries the GC mask needs.
+// Returns 0 on success.
+// ---------------------------------------------------------------------------
+int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
+                            const int64_t* lens, int64_t n,
+                            int32_t* order_out, uint8_t* new_key_out) {
+  std::vector<int32_t> idx(n);
+  std::iota(idx.begin(), idx.end(), 0);
+  auto packed_of = [&](int32_t i) -> uint64_t {
+    // 8 LE trailer bytes assembled with shifts: endian-independent.
+    const uint8_t* t = key_buf + offs[i] + lens[i] - 8;
+    uint64_t p = 0;
+    for (int b = 0; b < 8; b++) p |= static_cast<uint64_t>(t[b]) << (8 * b);
+    return p;  // (seq << 8) | type
+  };
+  // stable: duplicate internal keys keep input order (the survivor choice
+  // must be deterministic, matching the np.lexsort twin).
+  std::stable_sort(idx.begin(), idx.end(), [&](int32_t a, int32_t b) {
+    const uint8_t* ka = key_buf + offs[a];
+    const uint8_t* kb = key_buf + offs[b];
+    const size_t la = static_cast<size_t>(lens[a] - 8);
+    const size_t lb = static_cast<size_t>(lens[b] - 8);
+    const int c = std::memcmp(ka, kb, la < lb ? la : lb);
+    if (c != 0) return c < 0;
+    if (la != lb) return la < lb;
+    return packed_of(a) > packed_of(b);  // newer seq first
+  });
+  std::memcpy(order_out, idx.data(), n * sizeof(int32_t));
+  for (int64_t i = 0; i < n; i++) {
+    if (i == 0) {
+      new_key_out[i] = 1;
+      continue;
+    }
+    const int32_t a = idx[i - 1], b = idx[i];
+    const size_t la = static_cast<size_t>(lens[a] - 8);
+    const size_t lb = static_cast<size_t>(lens[b] - 8);
+    new_key_out[i] =
+        (la != lb ||
+         std::memcmp(key_buf + offs[a], key_buf + offs[b], la) != 0)
+            ? 1
+            : 0;
+  }
+  return 0;
+}
 
 // ---------------------------------------------------------------------------
 // CRC32C (Castagnoli, polynomial 0x82f63b78 reflected), slicing-by-8.

@@ -250,14 +250,8 @@ def host_encode_sort(key_buf: np.ndarray, key_offs: np.ndarray,
     offs = key_offs.astype(np.int64)
     lens = key_lens.astype(np.int64)
 
-    # Trailer → packed (seq<<8|type), little-endian on disk.
-    tr_idx = (offs + lens - 8)[:, None] + np.arange(8)[None, :]
-    tr = key_buf[tr_idx].astype(np.uint64)
-    packed = np.zeros(n, dtype=np.uint64)
-    for i in range(8):
-        packed |= tr[:, i] << np.uint64(8 * i)
-    seq = packed >> np.uint64(8)
-    vtype = (packed & np.uint64(0xFF)).astype(np.int32)
+    seq, vtype = _trailer_seq_vtype(key_buf, key_offs, key_lens)
+    packed = (seq << np.uint64(8)) | vtype.astype(np.uint64)
     inv = ~packed  # descending seq under an ascending sort
 
     # Big-endian user-key words, zero-masked past each key's length.
@@ -281,17 +275,37 @@ def host_encode_sort(key_buf: np.ndarray, key_offs: np.ndarray,
     return s, words, uk_len, seq, vtype
 
 
-def host_gc_mask(skw, slen, sseq, svt, snapshots, cover, bottommost):
-    """NumPy twin of the GC mask over SORTED columns; `cover` is the
-    per-sorted-entry stripe-clamped max covering tombstone seq (or None).
-    Returns (keep, zero_seq, host_resolve, group_id) like gc_mask."""
+def host_sort_order(key_buf: np.ndarray, key_offs: np.ndarray,
+                    key_lens: np.ndarray):
+    """(order, new_key) via the native byte-span comparator (std::sort in
+    C++, GIL released) — same order as the device sort; None when the
+    native lib is unavailable."""
+    from toplingdb_tpu import native
+
+    lib = native.lib()
+    if lib is None or not hasattr(lib, "tpulsm_sort_entries"):
+        return None
+    n = len(key_offs)
+    offs = np.ascontiguousarray(key_offs, dtype=np.int64)
+    lens = np.ascontiguousarray(key_lens, dtype=np.int64)
+    kb = np.ascontiguousarray(key_buf)
+    order = np.empty(n, dtype=np.int32)
+    new_key = np.empty(n, dtype=np.uint8)
+    rc = lib.tpulsm_sort_entries(
+        native.np_u8p(kb), native.np_i64p(offs), native.np_i64p(lens), n,
+        native.np_i32p(order), native.np_u8p(new_key),
+    )
+    if rc != 0:
+        return None
+    return order, new_key.astype(bool)
+
+
+def host_gc_mask(new_key, sseq, svt, snapshots, cover, bottommost):
+    """NumPy twin of the GC mask over SORTED columns; `new_key` marks
+    user-key group starts, `cover` is the per-sorted-entry stripe-clamped
+    max covering tombstone seq (or None). Returns (keep, zero_seq,
+    host_resolve, group_id) like gc_mask."""
     n = len(sseq)
-    same_key = np.zeros(n, dtype=bool)
-    if n > 1:
-        same_key[1:] = np.all(skw[1:] == skw[:-1], axis=1) & (
-            slen[1:] == slen[:-1]
-        )
-    new_key = ~same_key
     snaps = np.asarray(sorted(snapshots), dtype=np.uint64)
     stripe = np.searchsorted(snaps, sseq, side="left").astype(np.int64)
     first_in_stripe = new_key.copy()
@@ -337,15 +351,51 @@ def fused_encode_sort_gc_host(key_buf: np.ndarray, key_offs: np.ndarray,
     n = len(key_offs)
     if n == 0:
         return np.empty(0, np.int32), np.empty(0, bool), False
-    s, words, uk_len, seq, vtype = host_encode_sort(
+    s, new_key, seq, vtype = host_sort_with_boundaries(
         key_buf, key_offs, key_lens, max_key_bytes
     )
     keep, zero_seq, host_resolve, _ = host_gc_mask(
-        words[s], uk_len[s], seq[s], vtype[s], snapshots, None, bottommost
+        new_key, seq[s], vtype[s], snapshots, None, bottommost
     )
     order = s[keep].astype(np.int32)
     zero_flags = zero_seq[keep]
     return order, zero_flags, bool(host_resolve.any())
+
+
+def host_sort_with_boundaries(key_buf, key_offs, key_lens, max_key_bytes):
+    """Shared host-path front half: (s, new_key, seq, vtype) — the native
+    comparator when available, else the lexsort twin."""
+    nat = host_sort_order(key_buf, key_offs, key_lens)
+    if nat is not None:
+        s, new_key = nat
+        seq, vtype = _trailer_seq_vtype(key_buf, key_offs, key_lens)
+    else:
+        s, words, uk_len, seq, vtype = host_encode_sort(
+            key_buf, key_offs, key_lens, max_key_bytes
+        )
+        new_key = _new_key_from_words(words[s], uk_len[s])
+    return s, new_key, seq, vtype
+
+
+def _trailer_seq_vtype(key_buf, key_offs, key_lens):
+    offs = key_offs.astype(np.int64)
+    lens = key_lens.astype(np.int64)
+    tr_idx = (offs + lens - 8)[:, None] + np.arange(8)[None, :]
+    tr = key_buf[tr_idx].astype(np.uint64)
+    packed = np.zeros(len(offs), dtype=np.uint64)
+    for i in range(8):
+        packed |= tr[:, i] << np.uint64(8 * i)
+    return packed >> np.uint64(8), (packed & np.uint64(0xFF)).astype(np.int32)
+
+
+def _new_key_from_words(skw, slen):
+    n = len(slen)
+    same_key = np.zeros(n, dtype=bool)
+    if n > 1:
+        same_key[1:] = np.all(skw[1:] == skw[:-1], axis=1) & (
+            slen[1:] == slen[:-1]
+        )
+    return ~same_key
 
 
 @functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))

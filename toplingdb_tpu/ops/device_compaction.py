@@ -317,9 +317,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         zero_orig = order[zero_flags]
         col = _kv_seq_vtype(kv)
     elif _host_sort():
-        # Accelerator-less: numpy twins for the tombstone-bearing path too.
+        # Accelerator-less: host twins for the tombstone-bearing path too.
         mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
-        s, words, uk_len, seq, vtype = ck.host_encode_sort(
+        s, new_key, seq, vtype = ck.host_sort_with_boundaries(
             kv.key_buf, kv.key_offs, kv.key_lens, mkb
         )
         sorted_uks = [
@@ -329,7 +329,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator,
                                  seq[s], snapshots)
         keep, zero_seq, host_resolve, _ = ck.host_gc_mask(
-            words[s], uk_len[s], seq[s], vtype[s], snapshots, cover,
+            new_key, seq[s], vtype[s], snapshots, cover,
             compaction.bottommost,
         )
         if host_resolve.any():
