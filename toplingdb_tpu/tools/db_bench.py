@@ -40,6 +40,13 @@ class Bench:
             from toplingdb_tpu.utils.statistics import Statistics
 
             self.options.statistics = Statistics()
+        if ("mergerandom" in args.benchmarks
+                and self.options.merge_operator is None):
+            # mergerandom writes uint64 operands; reads after it would fail
+            # with MergeInProgress without an operator.
+            from toplingdb_tpu.utils.merge_operator import UInt64AddOperator
+
+            self.options.merge_operator = UInt64AddOperator()
         self.db: DB | None = None
 
     def key(self, i: int) -> bytes:
@@ -128,6 +135,32 @@ class Bench:
                 hits += 1
         return n
 
+    def bench_seekrandom(self, n):
+        ro = ReadOptions()
+        it = self.db.new_iterator(ro)
+        for _ in range(n):
+            it.seek(self.key(self.rng.randrange(self.args.num)))
+            if it.valid():
+                it.key(), it.value()
+        return n
+
+    def bench_mergerandom(self, n):
+        import struct
+
+        wo = WriteOptions(disable_wal=self.args.disable_wal)
+        for i in range(n):
+            self.db.merge(self.key(self.rng.randrange(self.args.num)),
+                          struct.pack("<Q", 1), wo)
+        return n
+
+    def bench_fillrandombatch(self, n):
+        saved = self.args.batch_size
+        self.args.batch_size = max(saved, 100)
+        try:
+            return self.bench_fillrandom(n)
+        finally:
+            self.args.batch_size = saved
+
     def bench_multireadrandom(self, n):
         ro = ReadOptions()
         done = 0
@@ -187,7 +220,10 @@ def main(argv=None):
     ap.add_argument("--print-stats", action="store_true")
     args = ap.parse_args(argv)
     Bench(args).run()
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

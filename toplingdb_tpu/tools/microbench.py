@@ -1,0 +1,119 @@
+"""Microbenchmarks of the hot primitives (reference microbench/
+db_basic_bench.cc): block build/decode, crc32c, xxh64, memtable insert,
+host/native sort. Prints one JSON object per benchmark.
+
+Usage: python -m toplingdb_tpu.tools.microbench [--n=N] [--filter=SUBSTR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _bench(name, fn, n_items, repeats=3):
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({
+        "bench": name, "items": n_items, "best_s": round(best, 5),
+        "items_per_s": round(n_items / best) if best else None,
+    }))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--filter", default="")
+    args = ap.parse_args(argv)
+    n = args.n
+
+    import numpy as np
+
+    from toplingdb_tpu.db import dbformat
+    from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
+    from toplingdb_tpu.db.memtable import MemTable
+    from toplingdb_tpu.utils import crc32c
+
+    icmp = InternalKeyComparator()
+    entries = [
+        (dbformat.make_internal_key(b"key%08d" % i, i + 1, ValueType.VALUE),
+         b"value-%08d" % i)
+        for i in range(n)
+    ]
+    payload = b"x" * (1 << 20)
+
+    def run(name, fn, items):
+        if args.filter in name:
+            _bench(name, fn, items)
+
+    run("crc32c_1MiB", lambda: [crc32c.value(payload) for _ in range(16)],
+        16 << 20)
+    run("xxh64_1MiB", lambda: [crc32c.xxh64(payload) for _ in range(16)],
+        16 << 20)
+
+    def memtable_insert():
+        m = MemTable(icmp)
+        for i, (ik, v) in enumerate(entries):
+            m.add(i + 1, int(ValueType.VALUE), ik[:-8], v)
+
+    run("memtable_insert", memtable_insert, n)
+
+    from toplingdb_tpu.env import MemEnv
+    from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+
+    env = MemEnv()
+
+    def block_build():
+        w = env.new_writable_file("/mb.sst")
+        b = TableBuilder(w, icmp, TableOptions())
+        for ik, v in entries:
+            b.add(ik, v)
+        b.finish()
+        w.close()
+
+    run("table_build", block_build, n)
+
+    from toplingdb_tpu.table.reader import TableReader
+
+    if args.filter in "table_scan":
+        block_build()  # scan setup — skip when filtered out
+
+    def table_scan():
+        r = TableReader(env.new_random_access_file("/mb.sst"), icmp,
+                        TableOptions())
+        it = r.new_iterator()
+        it.seek_to_first()
+        c = 0
+        for _ in it.entries():
+            c += 1
+        assert c == n
+
+    run("table_scan", table_scan, n)
+
+    from toplingdb_tpu.ops import compaction_kernels as ck
+
+    key_buf = bytearray()
+    offs, lens = [], []
+    for ik, _ in entries:
+        offs.append(len(key_buf))
+        lens.append(len(ik))
+        key_buf += ik
+    kb = np.frombuffer(bytes(key_buf), dtype=np.uint8)
+    ko = np.array(offs, np.int64)
+    kl = np.array(lens, np.int64)
+
+    if ck.host_sort_order(kb[: int(kl[0])], ko[:1], kl[:1]) is not None:
+        run("native_sort", lambda: ck.host_sort_order(kb, ko, kl), n)
+    run("lexsort_twin",
+        lambda: ck.host_encode_sort(kb, ko, kl, 12), n)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
