@@ -132,6 +132,7 @@ class DB:
         self._last_seqno_time_sample = 0.0
         self._wbm_charged = 0  # bytes charged to options.write_buffer_manager
         self._options_file_number = 0  # latest persisted OPTIONS file
+        self._mget_pool = None  # lazy long-lived async multi_get executor
         from toplingdb_tpu.utils.listener import EventLogger
 
         self._log_file = None
@@ -316,6 +317,9 @@ class DB:
     def close(self) -> None:
         if self._stats_dumper is not None:
             self._stats_dumper.stop()
+        if self._mget_pool is not None:
+            self._mget_pool.shutdown(wait=True)
+            self._mget_pool = None
         if self._compaction_scheduler is not None:
             self._compaction_scheduler.shutdown()
         with self._mutex:
@@ -684,15 +688,21 @@ class DB:
                 return ctx.result()
         # 2. SST files, newest data first.
         version = self.versions.cf_current(cfd.handle.id)
-        for level, f in version.files_for_get(key):
-            reader = self.table_cache.get_reader(f.number)
-            more, _ = self._probe_file(
-                reader, key, snap_seq, ctx, self._parsed_tombstones(reader)
-            )
-            if not more:
-                return ctx.result()
-        ctx.finish()
+        self._walk_sst_chain(version, key, snap_seq, ctx)
         return ctx.result()
+
+    def _walk_sst_chain(self, version, key: bytes, snap_seq: int, ctx,
+                        tombs_for=None) -> None:
+        """Probe the key's SST candidates newest-first until the lookup
+        completes (shared by get, async multi_get, get_merge_operands)."""
+        for _level, f in version.files_for_get(key):
+            reader = self.table_cache.get_reader(f.number)
+            tombs = (tombs_for(f) if tombs_for is not None
+                     else self._parsed_tombstones(reader))
+            more, _ = self._probe_file(reader, key, snap_seq, ctx, tombs)
+            if not more:
+                return
+        ctx.finish()
 
     def _max_l0_files(self) -> int:
         return max(
@@ -766,6 +776,39 @@ class DB:
         # 2. SSTs: group keys by candidate file so each reader/iterator is
         # reused across the batch (the fiber MultiGet's IO-batching effect).
         version = self.versions.cf_current(cfd.handle.id)
+        if live and opts.async_io and len(live) > 1:
+            # Fiber-MultiGet analogue: each missing key walks its own file
+            # chain on a worker thread (one "fiber" per key; file pread
+            # releases the GIL, so misses overlap their IO). Per-file
+            # tombstone parses are memoized across the batch.
+            tombs_cache: dict[int, list] = {}
+            cache_mu = threading.Lock()
+
+            def tombs_for(f):
+                t = tombs_cache.get(f.number)
+                if t is None:
+                    with cache_mu:
+                        t = tombs_cache.get(f.number)
+                        if t is None:
+                            t = self._parsed_tombstones(
+                                self.table_cache.get_reader(f.number))
+                            tombs_cache[f.number] = t
+                return t
+
+            pool = self._mget_pool
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = self._mget_pool = ThreadPoolExecutor(
+                    max_workers=max(1, opts.async_queue_depth),
+                    thread_name_prefix="mget",
+                )
+            list(pool.map(
+                lambda k: self._walk_sst_chain(
+                    version, k, snap_seq, ctxs[k], tombs_for),
+                list(live),
+            ))
+            return [ctxs[k].result() for k in keys]
         if live:
             per_file: dict[int, list[bytes]] = {}
             for k in live:
@@ -842,14 +885,7 @@ class DB:
                 break
         if more:
             version = self.versions.cf_current(cfd.handle.id)
-            for level, f in version.files_for_get(key):
-                reader = self.table_cache.get_reader(f.number)
-                cont, _ = self._probe_file(
-                    reader, key, snap_seq, ctx, self._parsed_tombstones(reader)
-                )
-                if not cont:
-                    break
-        ctx.finish()
+            self._walk_sst_chain(version, key, snap_seq, ctx)
         return ctx.merge_operand_list()
 
     # ==================================================================

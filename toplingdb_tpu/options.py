@@ -127,6 +127,11 @@ class ReadOptions:
     # Topling extension analogue: return existence without copying the value
     # (reference include/rocksdb/options.h:1637 just_check_key_exists).
     just_check_key_exists: bool = False
+    # Fiber/io_uring MultiGet analogue (reference db_impl.cc:3026-3227 +
+    # options.h:1723 async_queue_depth): memtable misses walk their SST
+    # chains on parallel threads (pread releases the GIL).
+    async_io: bool = False
+    async_queue_depth: int = 8
 
 
 @dataclass
