@@ -246,14 +246,43 @@ class UniversalCompactionPicker(CompactionPicker):
 
 
 class FIFOCompactionPicker(CompactionPicker):
-    """Drop oldest files when total size exceeds the budget
-    (reference compaction_picker_fifo.cc). Deletion-only: output nothing."""
+    """Drop oldest files when total size exceeds the budget, or when older
+    than fifo_ttl_seconds (reference compaction_picker_fifo.cc incl.
+    CompactionOptionsFIFO.ttl). Deletion-only: output nothing.
+    `creation_time_fn` (set by the scheduler) reads a file's creation time
+    from its cached table properties."""
+
+    creation_time_fn = None  # f -> unix time | None
 
     def compaction_score(self, version: Version) -> list[tuple[float, int]]:
         total = sum(f.file_size for f in version.files[0])
-        return [(total / max(1, self.options.fifo_max_table_files_size), 0)]
+        score = total / max(1, self.options.fifo_max_table_files_size)
+        if self._ttl_expired(version):
+            score = max(score, 1.0)
+        return [(score, 0)]
+
+    def _ttl_expired(self, version: Version) -> list:
+        ttl = self.options.fifo_ttl_seconds
+        if not ttl or self.creation_time_fn is None:
+            return []
+        import time as _t
+
+        cutoff = int(_t.time()) - ttl
+        out = []
+        for f in version.files[0]:
+            if f.being_compacted:
+                continue
+            ct = self.creation_time_fn(f)
+            if ct and ct <= cutoff:
+                out.append(f)
+        return out
 
     def pick_compaction(self, version: Version) -> Compaction | None:
+        expired = self._ttl_expired(version)
+        if expired:
+            return Compaction(
+                level=0, output_level=0, inputs=expired, reason="fifo ttl",
+            )
         total = sum(f.file_size for f in version.files[0])
         if total <= self.options.fifo_max_table_files_size:
             return None

@@ -26,6 +26,8 @@ class CompactionScheduler:
     def __init__(self, db, background: bool = True):
         self.db = db
         self.picker = create_picker(db.options, db.icmp)
+        # Age policies need table properties (creation_time lives there).
+        self.picker.creation_time_fn = self._file_creation_time
         self.background = background
         self._pending = 0
         self._running = 0
@@ -114,8 +116,52 @@ class CompactionScheduler:
 
     # ------------------------------------------------------------------
 
+    def _file_creation_time(self, f):
+        """Creation time from table properties, memoized on the meta so the
+        age sweeps never re-open files (a sweep across a big DB would
+        otherwise thrash the table-cache LRU on every cycle)."""
+        ct = getattr(f, "_creation_time_cache", None)
+        if ct is not None:
+            return ct or None  # 0 sentinel = previously failed / absent
+        try:
+            ct = self.db.table_cache.get_reader(f.number).properties \
+                .creation_time
+        except Exception as e:
+            self.db.event_logger.log(
+                "creation_time_unreadable", file_number=f.number,
+                error=repr(e),
+            )
+            ct = 0
+        f._creation_time_cache = ct
+        return ct or None
+
+    def _apply_periodic_marking(self) -> None:
+        """Reference periodic_compaction_seconds: files past the age get
+        marked so the picker rewrites them (the rewrite refreshes
+        creation_time; 'bottommost marked' outputs suppress re-marks).
+        Leveled style only — the universal/FIFO pickers don't consult
+        marked_for_compaction (FIFO ages out via fifo_ttl_seconds)."""
+        db = self.db
+        per = db.options.periodic_compaction_seconds
+        if not per or db.options.compaction_style != "leveled":
+            return
+        import time as _t
+
+        cutoff = int(_t.time()) - per
+        with db._mutex:
+            for cf_id in list(db.versions.column_families):
+                v = db.versions.cf_current(cf_id)
+                for lvl in range(v.num_levels):
+                    for f in v.files[lvl]:
+                        if f.marked_for_compaction or f.being_compacted:
+                            continue
+                        ct = self._file_creation_time(f)
+                        if ct and ct <= cutoff:
+                            f.marked_for_compaction = True
+
     def _run_one(self) -> bool:
         db = self.db
+        self._apply_periodic_marking()
         with db._mutex:
             # Visit CFs by descending top compaction score — fixed id order
             # would starve later CFs under sustained load on an earlier one.

@@ -1164,7 +1164,8 @@ class DB:
         "max_subcompactions", "max_background_jobs",
         "enable_blob_garbage_collection",
         "blob_garbage_collection_age_cutoff", "min_blob_size",
-        "seqno_time_sample_period_sec",
+        "seqno_time_sample_period_sec", "fifo_ttl_seconds",
+        "periodic_compaction_seconds",
     })
 
     def set_options(self, changes: dict) -> None:

@@ -54,6 +54,13 @@ class Options:
 
     # fifo knobs
     fifo_max_table_files_size: int = 1024 * 1024 * 1024
+    # Drop FIFO files older than this (reference CompactionOptionsFIFO.ttl;
+    # 0 = off).
+    fifo_ttl_seconds: int = 0
+    # Rewrite any file older than this so old data keeps moving down and
+    # expired-data filters re-run (reference periodic_compaction_seconds;
+    # 0 = off; leveled style only — FIFO ages out via fifo_ttl_seconds).
+    periodic_compaction_seconds: int = 0
 
     # -- background work ------------------------------------------------
     max_background_jobs: int = 2

@@ -108,7 +108,8 @@ _SIMPLE_OPTION_KEYS = {
     "universal_size_ratio", "universal_min_merge_width",
     "universal_max_merge_width",
     "universal_max_size_amplification_percent",
-    "fifo_max_table_files_size",
+    "fifo_max_table_files_size", "fifo_ttl_seconds",
+    "periodic_compaction_seconds",
     "enable_blob_files", "min_blob_size",
     "enable_blob_garbage_collection", "blob_garbage_collection_age_cutoff",
     "stats_persist_period_sec", "seqno_time_sample_period_sec",
