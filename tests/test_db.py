@@ -773,3 +773,26 @@ def test_async_multi_get_matches_sync(tmp_db_path):
         asnap = db.multi_get(keys, ReadOptions(snapshot=snap, async_io=True))
         assert asnap == ssnap
         snap.release()
+
+
+def test_persistent_stats_history(tmp_db_path):
+    """persist_stats(to_db=True) stores samples in the hidden stats CF;
+    they survive reopen (reference persist_stats_to_disk)."""
+    from toplingdb_tpu.utils import statistics as st
+    from toplingdb_tpu.utils.statistics import Statistics
+
+    o = opts(statistics=Statistics())
+    with DB.open(tmp_db_path, o) as db:
+        db.put(b"a", b"1")
+        db.persist_stats(to_db=True)
+        hist = db.get_stats_history(include_persisted=True)
+        assert hist and any(
+            d.get(st.NUMBER_KEYS_WRITTEN) for _, d in hist
+        )
+    with DB.open(tmp_db_path, opts(statistics=Statistics())) as db:
+        hist = db.get_stats_history(include_persisted=True)
+        assert hist, "persisted samples lost on reopen"
+        # Hidden CF stays out of the default keyspace.
+        it = db.new_iterator()
+        it.seek_to_first()
+        assert [k for k, _ in it.entries()] == [b"a"]

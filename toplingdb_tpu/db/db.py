@@ -1210,14 +1210,63 @@ class DB:
                     pass
         self._maybe_schedule_compaction()
 
-    def get_stats_history(self, start_time: int = 0, end_time: int = 2 ** 62):
+    _STATS_CF = "__tpulsm_stats__"
+
+    def get_stats_history(self, start_time: int = 0, end_time: int = 2 ** 62,
+                          include_persisted: bool = False):
         """Time-series ticker deltas (reference DBImpl::GetStatsHistory,
         db/db_impl/db_impl.cc:1102). Samples are taken every
-        stats_persist_period_sec, or manually via persist_stats()."""
-        return self.stats_history.get(start_time, end_time)
+        stats_persist_period_sec, or manually via persist_stats(). With
+        include_persisted, samples stored in the hidden stats CF by
+        persist_stats(to_db=True) are merged in (the reference's
+        persist_stats_to_disk / ___rocksdb_stats_history___ CF)."""
+        out = self.stats_history.get(start_time, end_time)
+        if include_persisted:
+            import json as _json
 
-    def persist_stats(self) -> None:
+            in_memory = {ts for ts, _ in out}
+            cf = self.get_column_family(self._STATS_CF)
+            if cf is not None:
+                it = self.new_iterator(cf=cf)
+                it.seek(b"%020d" % start_time)
+                while it.valid():
+                    try:
+                        ts = int(it.key().split(b".")[0].decode())
+                        delta = {
+                            k: int(v) for k, v in
+                            _json.loads(it.value().decode()).items()
+                        }
+                    except (ValueError, UnicodeDecodeError):
+                        it.next()
+                        continue  # foreign/corrupt entry: skip, don't crash
+                    if ts >= end_time:
+                        break
+                    if ts not in in_memory:  # avoid double-counting samples
+                        out.append((ts, delta))
+                    it.next()
+                out.sort(key=lambda s: s[0])
+        return out
+
+    def persist_stats(self, to_db: bool = False) -> None:
         self.stats_history.snapshot()
+        if not to_db:
+            return
+        sample = self.stats_history.last_sample()
+        if sample is None:
+            return
+        import json as _json
+
+        with self._mutex:
+            cf = self.get_column_family(self._STATS_CF)
+            if cf is None:
+                cf = self.create_column_family(self._STATS_CF)
+            self._stats_persist_seq = getattr(
+                self, "_stats_persist_seq", 0) + 1
+            seq = self._stats_persist_seq
+        ts, delta = sample
+        # Counter suffix: two persists in the same second must not collide.
+        self.put(b"%020d.%06d" % (ts, seq), _json.dumps(delta).encode(),
+                 cf=cf)
 
     def get_property(self, name: str) -> str | None:
         v = self.versions.current

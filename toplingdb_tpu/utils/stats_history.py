@@ -41,6 +41,15 @@ class StatsHistory:
             if len(self._samples) > self._max:
                 del self._samples[: len(self._samples) - self._max]
 
+    def last_sample(self):
+        """Most recent (ts, delta) or None — taken under the lock so a
+        concurrent snapshot() can't hand back someone else's sample."""
+        with self._mu:
+            if not self._samples:
+                return None
+            ts, d = self._samples[-1]
+            return ts, dict(d)
+
     def get(self, start_time: int = 0,
             end_time: int = 2 ** 62) -> list[tuple[int, dict[str, int]]]:
         """Samples with start_time <= ts < end_time (reference
