@@ -796,3 +796,27 @@ def test_persistent_stats_history(tmp_db_path):
         it = db.new_iterator()
         it.seek_to_first()
         assert [k for k, _ in it.entries()] == [b"a"]
+
+
+def test_disable_enable_file_deletions(tmp_db_path):
+    import os
+
+    with DB.open(tmp_db_path, opts(disable_auto_compactions=True)) as db:
+        for i in range(500):
+            db.put(b"k%03d" % i, b"v")
+        db.flush()
+        old = {f for f in os.listdir(tmp_db_path) if f.endswith(".sst")}
+        db.disable_file_deletions()
+        db.disable_file_deletions()  # counted
+        db.compact_range()
+        now = {f for f in os.listdir(tmp_db_path) if f.endswith(".sst")}
+        assert old <= now, "obsolete inputs deleted while pinned"
+        db.enable_file_deletions()
+        db.compact_range()
+        still = {f for f in os.listdir(tmp_db_path) if f.endswith(".sst")}
+        assert old <= still, "second disable ignored"
+        db.enable_file_deletions()
+        after = {f for f in os.listdir(tmp_db_path) if f.endswith(".sst")}
+        assert not (old & after), "obsolete files kept after enable"
+        assert db.get(b"k250") == b"v"
+        db.flush_wal(sync=True)

@@ -133,6 +133,7 @@ class DB:
         self._wbm_charged = 0  # bytes charged to options.write_buffer_manager
         self._options_file_number = 0  # latest persisted OPTIONS file
         self._mget_pool = None  # lazy long-lived async multi_get executor
+        self._file_deletions_disabled = 0  # DisableFileDeletions pin count
         from toplingdb_tpu.utils.listener import EventLogger
 
         self._log_file = None
@@ -1027,9 +1028,34 @@ class DB:
         if self._compaction_scheduler is not None and not self.options.disable_auto_compactions:
             self._compaction_scheduler.maybe_schedule()
 
+    def disable_file_deletions(self) -> None:
+        """Reference DB::DisableFileDeletions (used by backup/checkpoint
+        tools to pin the file set while copying). Counted: each disable
+        needs a matching enable."""
+        with self._mutex:
+            self._file_deletions_disabled += 1
+
+    def enable_file_deletions(self, force: bool = False) -> None:
+        with self._mutex:
+            n = self._file_deletions_disabled
+            self._file_deletions_disabled = 0 if force else max(0, n - 1)
+            if n > 0 and self._file_deletions_disabled == 0:
+                self._delete_obsolete_files()  # final unpin purges
+
+    def flush_wal(self, sync: bool = False) -> None:
+        """Reference DB::FlushWAL/SyncWAL."""
+        with self._mutex:
+            if self._wal is not None:
+                if sync:
+                    self._wal.sync()
+                else:
+                    self._wal.flush()
+
     def _delete_obsolete_files(self) -> None:
         """GC: remove WALs below the manifest log number, non-live SSTs, and
         stale MANIFESTs (reference DBImpl::DeleteObsoleteFiles)."""
+        if self._file_deletions_disabled:
+            return  # a backup/checkpoint is pinning the file set
         live, live_blobs = self.versions.live_file_sets()
         for child in self.env.get_children(self.dbname):
             ftype, num = filename.parse_file_name(child)

@@ -26,6 +26,17 @@ def create_checkpoint(db, dest: str) -> None:
         except Exception:
             pass
     env.create_dir(dest)
+    # Pin the file set (reference DisableFileDeletions during checkpoint);
+    # the mutex already excludes GC, but the pin also protects any future
+    # restructuring that copies outside the lock.
+    db.disable_file_deletions()
+    try:
+        _checkpoint_locked(db, env, dest)
+    finally:
+        db.enable_file_deletions()
+
+
+def _checkpoint_locked(db, env, dest: str) -> None:
     with db._mutex:
         db.flush()
         last_seq = db.versions.last_sequence
@@ -52,8 +63,9 @@ def create_checkpoint(db, dest: str) -> None:
         for _, f in files:
             link_or_copy(filename.table_file_name(db.dbname, f.number),
                          filename.table_file_name(dest, f.number))
-        # Blob files too (append-only and never deleted, so snapshotting all
-        # of them is safe; blob-aware filtering is a GC-round refinement).
+        # Blob files too: all present ones (deletions are excluded for the
+        # duration, so every LIVE blob is here; extra not-yet-GC'd ones are
+        # harmless dead weight in the snapshot).
         for child in env.get_children(db.dbname):
             if child.endswith(".blob"):
                 link_or_copy(f"{db.dbname}/{child}", f"{dest}/{child}")
