@@ -34,8 +34,13 @@ class WriteBatch:
             if len(data) < HEADER_SIZE:
                 raise Corruption("write batch header too small")
             self._rep = bytearray(data)
+            self._ops = None  # unknown provenance: decode when applying
         else:
             self._rep = bytearray(HEADER_SIZE)
+            # Ops built through this object are ALSO kept parsed so
+            # insert_into need not re-decode the bytes it just encoded
+            # (write-path hot loop); wire-deserialized batches decode.
+            self._ops: list | None = []
 
     # -- mutation -------------------------------------------------------
 
@@ -67,14 +72,28 @@ class WriteBatch:
         for s in slices:
             coding.put_length_prefixed_slice(self._rep, s)
         self.set_count(self.count() + 1)
+        if self._ops is not None:
+            # bytes() snapshots: the decode path yields immutable copies, so
+            # the fast path must too (a caller-mutated bytearray would
+            # otherwise diverge memtable contents from the WAL bytes).
+            self._ops.append((
+                cf, int(t), bytes(slices[0]),
+                bytes(slices[1]) if len(slices) > 1 else None,
+            ))
 
     def clear(self) -> None:
         self._rep = bytearray(HEADER_SIZE)
+        self._ops = []
 
     def append_from(self, other: "WriteBatch") -> None:
         """Group-commit helper: append other's records to self."""
         self._rep += other._rep[HEADER_SIZE:]
         self.set_count(self.count() + other.count())
+        if self._ops is not None:
+            if other._ops is not None:
+                self._ops.extend(other._ops)
+            else:
+                self._ops = None  # provenance lost: decode when applying
 
     # -- header ---------------------------------------------------------
 
@@ -112,6 +131,14 @@ class WriteBatch:
 
     def entries_cf(self):
         """Yields (cf_id, value_type, key, value_or_none)."""
+        if self._ops is not None:
+            if len(self._ops) != self.count():
+                raise Corruption(
+                    f"write batch count mismatch: header {self.count()}, "
+                    f"ops {len(self._ops)}"
+                )
+            yield from self._ops
+            return
         rep = self._rep
         off = HEADER_SIZE
         n = 0
