@@ -259,10 +259,16 @@ class VersionSet:
         readonly=True the directory is not touched (no manifest roll), and
         log_and_apply is unavailable."""
         cur = self.env.read_file(filename.current_file_name(self.dbname))
-        name = cur.decode().strip()
+        try:
+            name = cur.decode().strip()
+        except UnicodeDecodeError:
+            raise Corruption("CURRENT file holds undecodable bytes") from None
         if not name.startswith("MANIFEST-"):
             raise Corruption(f"CURRENT points at {name!r}")
-        self.manifest_file_number = int(name[len("MANIFEST-"):])
+        try:
+            self.manifest_file_number = int(name[len("MANIFEST-"):])
+        except ValueError:
+            raise Corruption(f"CURRENT points at {name!r}") from None
         path = filename.manifest_file_name(self.dbname, self.manifest_file_number)
         reader = LogReader(self.env.new_sequential_file(path))
         builders: dict[int, VersionBuilder] = {}

@@ -260,6 +260,52 @@ class CompressedSecondaryCache:
         return self._usage
 
 
+class SimCache:
+    """Cache simulator (reference utilities/simulator_cache/sim_cache.cc):
+    wraps a real cache and ALSO tracks what the hit rate WOULD be at a
+    different capacity — key-only ghost LRU, no values stored — so capacity
+    planning doesn't need a second deployment."""
+
+    def __init__(self, real_cache, sim_capacity_bytes: int):
+        self.real = real_cache
+        # Key-only ghost reuses the LRU shard (correct charge replacement
+        # on re-insert, one eviction implementation) — the reference wraps
+        # a key-only cache object the same way.
+        self._ghost = _Shard(sim_capacity_bytes)
+        self.sim_hits = 0
+        self.sim_misses = 0
+
+    def lookup(self, key: bytes):
+        v = self.real.lookup(key)
+        if self._ghost.lookup(key) is not None:
+            self.sim_hits += 1
+        else:
+            self.sim_misses += 1
+            if isinstance(v, (bytes, bytearray)):
+                # Real hit the ghost had evicted: re-admit with the TRUE
+                # charge. Real misses admit via the follow-up insert().
+                self._ghost.insert(key, True, len(v))
+        return v
+
+    def insert(self, key: bytes, value, charge: int) -> None:
+        self.real.insert(key, value, charge)
+        self._ghost.insert(key, True, charge)
+
+    def erase(self, key: bytes) -> None:
+        self.real.erase(key)
+        self._ghost.erase(key)
+
+    def usage(self) -> int:
+        return self.real.usage()
+
+    def sim_hit_rate(self) -> float:
+        total = self.sim_hits + self.sim_misses
+        return self.sim_hits / total if total else 0.0
+
+    def hit_rate(self) -> float:
+        return self.real.hit_rate()
+
+
 class _Shard:
     def __init__(self, capacity: int, spill=None):
         self._cap = capacity
