@@ -76,23 +76,6 @@ def build_inputs(env, dbdir, icmp, n_entries, num_runs=4):
     return metas, topts, raw_bytes
 
 
-def probe_jax_backend(timeout_s: float) -> bool:
-    """The axon (TPU-tunnel) backend can hang FOREVER inside
-    make_c_api_client when the tunnel is down — probe it in a killable
-    subprocess so bench can fall back instead of hanging."""
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=timeout_s,
-        )
-        return out.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
-
-
 def main():
     n_entries = int(os.environ.get("BENCH_N", "1000000"))
     device = os.environ.get("BENCH_DEVICE", "tpu")
@@ -100,26 +83,16 @@ def main():
 
     tpu_fallback = False
     if device in ("tpu", "cpu-jax"):
+        from toplingdb_tpu.utils.backend_probe import ensure_reachable_backend
+
         probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
         print(f"probing jax backend ({probe_s:.0f}s budget)...",
               file=sys.stderr, flush=True)
-        if not probe_jax_backend(probe_s):
-            # Unreachable accelerator: run the same device data plane on the
-            # CPU jax backend and SAY SO rather than hang with no output.
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            os.environ["PALLAS_AXON_POOL_IPS"] = ""
-            if "jax" in sys.modules:
-                # sitecustomize pre-imported jax, so the env var was already
-                # captured; only jax.config can redirect the platform now.
-                import jax
-
-                try:
-                    jax.config.update("jax_platforms", "cpu")
-                except Exception:
-                    pass
+        if not ensure_reachable_backend(probe_s):
+            # Unreachable accelerator (process now on the cpu backend):
+            # run the same data plane through the byte-parity host twins
+            # and SAY SO rather than hang with no output.
             tpu_fallback = True
-            # With no accelerator, the vectorized host sort (np.lexsort)
-            # beats running the jax program on the cpu backend.
             os.environ["TPULSM_HOST_SORT"] = "1"
             print("jax backend unreachable; falling back to cpu backend",
                   file=sys.stderr, flush=True)

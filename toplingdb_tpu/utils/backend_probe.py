@@ -1,0 +1,65 @@
+"""Accelerator-backend reachability probe.
+
+The axon (TPU-tunnel) jax plugin can hang FOREVER inside backend client
+creation when the tunnel is down — no error, no timeout. Anything that may
+touch the accelerator non-interactively (bench, driver entry points) probes
+first in a KILLABLE subprocess and falls back to the cpu backend when
+unreachable. Shared here so the tunnel-handling logic cannot diverge
+between callers."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+
+
+def probe_jax_backend(timeout_s: float) -> bool:
+    """True iff `import jax; jax.devices()` completes in a fresh process.
+    Runs in its own session with output discarded: a timeout kills the
+    whole process GROUP (the plugin may spawn helpers that would otherwise
+    hold pipes open past the child's death)."""
+    try:
+        p = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+    except OSError:
+        return False
+    try:
+        return p.wait(timeout=timeout_s) == 0
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            p.kill()
+        p.wait()
+        return False
+
+
+def redirect_to_cpu_backend() -> None:
+    """Point THIS process at the cpu backend — env vars for a not-yet-
+    imported jax, jax.config for one the sitecustomize pre-imported."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    if "jax" in sys.modules:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def ensure_reachable_backend(timeout_s: float = 120.0) -> bool:
+    """Returns True when the configured accelerator is reachable (or no
+    accelerator is configured); on False the process has been redirected to
+    the cpu backend."""
+    if os.environ.get("JAX_PLATFORMS") != "axon":
+        return True
+    if probe_jax_backend(timeout_s):
+        return True
+    redirect_to_cpu_backend()
+    return False
