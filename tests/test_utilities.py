@@ -1342,3 +1342,41 @@ def test_sim_cache(tmp_db_path):
         bc = db.options.block_cache
         assert bc.sim_hit_rate() > bc.hit_rate(), \
             "bigger simulated capacity should hit more"
+
+
+def test_thread_status_registry(tmp_db_path):
+    """Background ops report to the thread-status registry (reference
+    monitoring/thread_status_updater.cc); visible via tpulsm.threads."""
+    from toplingdb_tpu.utils.sync_point import get_sync_point_registry
+    from toplingdb_tpu.utils.thread_status import (
+        get_thread_list, thread_operation,
+    )
+
+    with thread_operation("unit-op", "stage1", "mydb"):
+        rows = [r for r in get_thread_list() if r["operation"] == "unit-op"]
+        assert rows and rows[0]["stage"] == "stage1"
+        assert rows[0]["db"] == "mydb"
+    assert not [r for r in get_thread_list() if r["operation"] == "unit-op"]
+
+    # A real compaction reports itself: pause it mid-install and look.
+    seen = []
+    sp = get_sync_point_registry()
+    sp.set_callback("CompactionJob::BeforeInstall",
+                    lambda c: seen.extend(get_thread_list()))
+    sp.enable_processing()
+    try:
+        with DB.open(tmp_db_path, opts(disable_auto_compactions=True)) as db:
+            for i in range(300):
+                db.put(b"k%03d" % i, b"v")
+            db.flush()
+            db.compact_range()
+
+            def strip(rows):
+                return [{k: v for k, v in r.items() if k != "elapsed_s"}
+                        for r in rows]
+
+            assert strip(json.loads(db.get_property("tpulsm.threads"))) == \
+                strip(get_thread_list())
+    finally:
+        sp.clear_all()
+    assert any(r["operation"] == "compaction" for r in seen), seen

@@ -249,6 +249,14 @@ class CompactionScheduler:
                        reason="trivial move",
                    ))
             return
+        from toplingdb_tpu.utils.thread_status import thread_operation
+
+        with thread_operation("compaction",
+                              f"L{c.level}->L{c.output_level}", db.dbname):
+            self._run_compaction_inner(c)
+
+    def _run_compaction_inner(self, c: Compaction) -> None:
+        db = self.db
         snapshots = db.snapshots.sequences()
         pending: list[int] = []
 

@@ -561,6 +561,13 @@ class DB:
         from toplingdb_tpu.utils.sync_point import sync_point
 
         sync_point("FlushJob::Start")
+        from toplingdb_tpu.utils.thread_status import thread_operation
+
+        with thread_operation("flush", f"cf{cf_id}", self.dbname):
+            self._flush_memtables_inner(mems, wal_number, cf_id)
+
+    def _flush_memtables_inner(self, mems: list[MemTable],
+                               wal_number: int | None, cf_id: int) -> None:
         t0 = time.time()
         fnum = self.versions.new_file_number()
         blob_num = (
@@ -1336,6 +1343,12 @@ class DB:
         if name == "tpulsm.num-running-compactions":
             s = self._compaction_scheduler
             return str(s._running if s is not None else 0)
+        if name == "tpulsm.threads":
+            import json as _json
+
+            from toplingdb_tpu.utils.thread_status import get_thread_list
+
+            return _json.dumps(get_thread_list())
         if name.startswith("tpulsm.num-files-at-level"):
             try:
                 lvl = int(name[len("tpulsm.num-files-at-level"):])
