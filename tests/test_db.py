@@ -820,3 +820,20 @@ def test_disable_enable_file_deletions(tmp_db_path):
         assert not (old & after), "obsolete files kept after enable"
         assert db.get(b"k250") == b"v"
         db.flush_wal(sync=True)
+
+
+def test_empty_range_delete_is_noop(tmp_db_path):
+    """Soak regression: delete_range(begin == end) deletes nothing and must
+    not flush a boundless empty table into the MANIFEST."""
+    with DB.open(tmp_db_path, opts()) as db:
+        db.delete_range(b"k", b"k")       # empty range, empty memtable
+        db.flush()                        # must not crash / write junk
+        assert db.versions.current.num_files() == 0
+        db.put(b"a", b"1")
+        db.delete_range(b"z", b"a")       # inverted = empty too
+        db.flush()
+        assert db.get(b"a") == b"1"
+        db.delete_range(b"a", b"a\x00")   # minimal REAL range
+        assert db.get(b"a") is None
+    with DB.open(tmp_db_path, opts()) as db:
+        assert db.get(b"a") is None

@@ -75,6 +75,13 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
         for frag in fragment_tombstones(tombstones, icmp.user_comparator):
             begin_ikey, end_uk = frag.to_table_entry()
             builder.add_tombstone(begin_ikey, end_uk)
+        if builder.num_entries == 0:
+            # Defense-in-depth: with the memtable rejecting degenerate
+            # tombstones this is unreachable from current callers, but a
+            # boundless empty table must NEVER reach the MANIFEST.
+            w.close()
+            env.delete_file(path)
+            return None
         props = builder.finish()
         w.sync()
     finally:

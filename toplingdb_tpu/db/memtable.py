@@ -354,6 +354,11 @@ class MemTable:
     def add(self, seq: int, t: int, user_key: bytes, value: bytes) -> None:
         with self._lock:
             if t == ValueType.RANGE_DELETION:
+                if self._icmp.user_comparator.compare(user_key, value) >= 0:
+                    # Empty range [begin >= end): deletes nothing, and a
+                    # memtable holding ONLY degenerate tombstones would
+                    # otherwise flush a boundless empty table.
+                    return
                 self._range_dels.append((seq, user_key, value))
             else:
                 packed = dbformat.pack_seq_type(seq, t)
