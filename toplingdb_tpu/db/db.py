@@ -36,11 +36,6 @@ _DEFAULT_READ = ReadOptions()
 _DEFAULT_WRITE = WriteOptions()
 
 
-def _blob_name(num: int) -> str:
-    from toplingdb_tpu.db.blob import blob_file_name
-
-    return blob_file_name("", num)
-
 # Cap on bytes merged into one commit group (reference
 # max_write_batch_group_size_bytes, db/db_impl/db_impl_write.cc).
 _MAX_WRITE_GROUP_BYTES = 1 << 20
@@ -1194,11 +1189,11 @@ class DB:
         caller must TRUNCATE its copy at manifest_file_size or the copy
         references files newer than the snapshot. Hold
         disable_file_deletions() while copying."""
-        import os as _os
+        from toplingdb_tpu.db.blob import blob_file_name
 
+        self._check_open()
         if flush_memtable:
             self.flush()
-        base = _os.path.basename
         with self._mutex:
             # CURRENT versions only — files pinned solely by in-flight
             # readers are not part of a consistent copy (reference
@@ -1209,15 +1204,15 @@ class DB:
                 for _, f in self.versions.cf_current(cf_id).all_files():
                     ssts.add(f.number)
                     blobs.update(f.blob_refs)
-            out = [base(filename.table_file_name("", n))
-                   for n in sorted(ssts)]
-            out += [base(_blob_name(n)) for n in sorted(blobs)]
-            out.append(base(filename.current_file_name("")))
-            out.append(base(filename.manifest_file_name(
-                "", self.versions.manifest_file_number)))
+            # filename helpers with dbname="" yield bare basenames.
+            out = [filename.table_file_name("", n) for n in sorted(ssts)]
+            out += [blob_file_name("", n) for n in sorted(blobs)]
+            out.append(filename.current_file_name(""))
+            out.append(filename.manifest_file_name(
+                "", self.versions.manifest_file_number))
             if self._options_file_number:
-                out.append(base(filename.options_file_name(
-                    "", self._options_file_number)))
+                out.append(filename.options_file_name(
+                    "", self._options_file_number))
             return out, self.versions.manifest_size()
 
     def get_sorted_wal_files(self) -> list[str]:
@@ -1226,8 +1221,7 @@ class DB:
         on-disk WAL is returned — a concurrent flush may have advanced
         log_number, but the pinned older WALs can still carry data absent
         from a get_live_files snapshot taken earlier."""
-        import os as _os
-
+        self._check_open()
         with self._mutex:
             pinned = self._file_deletions_disabled > 0
             nums = sorted(
@@ -1237,8 +1231,7 @@ class DB:
                 and (pinned or num >= self.versions.log_number
                      or num == self._wal_number)
             )
-            return [_os.path.basename(filename.log_file_name("", n))
-                    for n in nums]
+            return [filename.log_file_name("", n) for n in nums]
 
     def pause_background_work(self) -> None:
         if self._compaction_scheduler is not None:

@@ -365,7 +365,14 @@ class VersionSet:
         manifest_file_size)."""
         with self._lock:
             if self._manifest_writer is None:
-                return 0
+                # Readonly open (no writer): the on-disk size IS the
+                # consistent size (nobody is appending).
+                try:
+                    return self.env.get_file_size(
+                        filename.manifest_file_name(
+                            self.dbname, self.manifest_file_number))
+                except Exception:
+                    return 0
             self._manifest_writer.sync()
             return self._manifest_writer._f.file_size()
 
