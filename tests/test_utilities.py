@@ -1380,3 +1380,35 @@ def test_thread_status_registry(tmp_db_path):
     finally:
         sp.clear_all()
     assert any(r["operation"] == "compaction" for r in seen), seen
+
+
+def test_wbwi_skiplist_rep_matches_list_rep():
+    """The native-skiplist WBWI index (CSPP_WBWI role) behaves identically
+    to the sorted-list baseline across put/delete/merge interleavings."""
+    import random
+
+    from toplingdb_tpu.utilities.write_batch_with_index import (
+        WriteBatchWithIndex,
+    )
+    from toplingdb_tpu.utils.merge_operator import StringAppendOperator
+
+    from toplingdb_tpu import native
+
+    if native.lib() is None:
+        pytest.skip("native library unavailable")
+    rng = random.Random(2)
+    ops = [(rng.choice("PPDM"), b"k%03d" % rng.randrange(150),
+            b"v%04d" % i) for i in range(3000)]
+    views = {}
+    for rep in ("list", "skiplist"):
+        w = WriteBatchWithIndex(StringAppendOperator(), rep=rep)
+        for op, k, v in ops:
+            if op == "P":
+                w.put(k, v)
+            elif op == "D":
+                w.delete(k)
+            else:
+                w.merge(k, v)
+        views[rep] = ({k: w.get_from_batch(k) for k in w.key_set()},
+                      w.key_set())
+    assert views["list"] == views["skiplist"]

@@ -164,8 +164,8 @@ class PessimisticTransaction(_TxnBase):
     def undo_get_for_update(self, key: bytes) -> None:
         # The reference keeps the lock until commit if the key was written;
         # we match: only unwritten keys are released.
-        batch_keys = {e[0] for e in self.wbwi._items}
-        if key in self._locked and key not in batch_keys:
+        written = bool(self.wbwi._batch_view(key))  # one seek, not a scan
+        if key in self._locked and not written:
             self._txn_db.lock_manager.unlock_all(self.id, [key])
             self._locked.discard(key)
 

@@ -19,21 +19,109 @@ from toplingdb_tpu.options import ReadOptions
 from toplingdb_tpu.utils.status import MergeInProgress
 
 
+class _ListIndex:
+    """Sorted-list index (the baseline WBWI rep)."""
+
+    def __init__(self):
+        self._items: list[tuple[bytes, int, int, bytes | None]] = []
+        self._order = 0
+
+    def insert(self, t: int, key: bytes, value: bytes | None) -> None:
+        self._order += 1
+        entry = (key, self._order, t, value)
+        bisect.insort(self._items, entry, key=lambda e: (e[0], e[1]))
+
+    def newest_first(self, key: bytes) -> list[tuple[int, bytes | None]]:
+        i = bisect.bisect_left(self._items, (key, 0),
+                               key=lambda e: (e[0], e[1]))
+        out = []
+        while i < len(self._items) and self._items[i][0] == key:
+            out.append((self._items[i][2], self._items[i][3]))
+            i += 1
+        out.reverse()
+        return out
+
+    def keys(self) -> list[bytes]:
+        out = []
+        for k, _, _, _ in self._items:
+            if not out or out[-1] != k:
+                out.append(k)
+        return out
+
+    def clear(self) -> None:
+        self._items.clear()
+        self._order = 0
+
+
+class _SkipIndex:
+    """Native arena-skiplist index — the CSPP_WBWI analogue (reference
+    README.md:46 claims 20x over the std::skiplist WBWI; ours reuses the
+    same native rep the memtable runs on). Entries order newest-first per
+    key via an inverted insertion counter."""
+
+    _DELETES = (int(ValueType.DELETION), int(ValueType.SINGLE_DELETION))
+
+    def __init__(self):
+        from toplingdb_tpu.db.memtable import NativeSkipListRep
+
+        self._rep = NativeSkipListRep()
+        self._order = 0
+
+    def insert(self, t: int, key: bytes, value: bytes | None) -> None:
+        self._order += 1
+        inv = (1 << 64) - 1 - self._order  # newest sorts first
+        self._rep.insert((key, inv),
+                         bytes([t]) + (value if value is not None else b""))
+
+    def newest_first(self, key: bytes) -> list[tuple[int, bytes | None]]:
+        out = []
+        for (uk, _inv), v in self._rep.iter_from((key, 0)):
+            if uk != key:
+                break
+            t = v[0]
+            # value-absence is derivable from the type — no marker byte.
+            out.append((t, None if t in self._DELETES else bytes(v[1:])))
+        return out
+
+    def keys(self) -> list[bytes]:
+        out = []
+        for (uk, _inv), _v in self._rep.iter_all():
+            if not out or out[-1] != uk:
+                out.append(uk)
+        return out
+
+    def clear(self) -> None:
+        from toplingdb_tpu.db.memtable import NativeSkipListRep
+
+        self._rep = NativeSkipListRep()
+        self._order = 0
+
+
+def _make_index(rep: str):
+    if rep == "list":
+        return _ListIndex()
+    if rep in ("skiplist", "auto"):
+        try:
+            return _SkipIndex()
+        except Exception:
+            if rep == "skiplist":
+                raise
+            return _ListIndex()  # auto: no native toolchain
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    raise InvalidArgument(f"unknown WBWI rep {rep!r}")
+
+
 class WriteBatchWithIndex:
-    def __init__(self, merge_operator=None):
+    def __init__(self, merge_operator=None, rep: str = "auto"):
         self.batch = WriteBatch()
         self._merge_op = merge_operator
-        # Sorted index: (user_key, insertion_order) → last write wins at read.
-        self._items: list[tuple[bytes, int, int, bytes | None]] = []
-        # (key, order, type, value); kept sorted by (key, order).
-        self._order = 0
+        self._idx = _make_index(rep)
 
     # -- writes ---------------------------------------------------------
 
     def _index(self, t: ValueType, key: bytes, value: bytes | None) -> None:
-        self._order += 1
-        entry = (key, self._order, int(t), value)
-        bisect.insort(self._items, entry, key=lambda e: (e[0], e[1]))
+        self._idx.insert(int(t), key, value)
 
     def put(self, key: bytes, value: bytes) -> None:
         self.batch.put(key, value)
@@ -53,8 +141,11 @@ class WriteBatchWithIndex:
 
     def clear(self) -> None:
         self.batch.clear()
-        self._items.clear()
-        self._order = 0
+        self._idx.clear()
+
+    def key_set(self) -> list[bytes]:
+        """Distinct keys written through this batch, sorted."""
+        return self._idx.keys()
 
     def count(self) -> int:
         return self.batch.count()
@@ -63,13 +154,7 @@ class WriteBatchWithIndex:
 
     def _batch_view(self, key: bytes):
         """Newest-first updates for key in this batch: [(type, value)]."""
-        i = bisect.bisect_left(self._items, (key, 0), key=lambda e: (e[0], e[1]))
-        out = []
-        while i < len(self._items) and self._items[i][0] == key:
-            out.append((self._items[i][2], self._items[i][3]))
-            i += 1
-        out.reverse()  # newest first
-        return out
+        return self._idx.newest_first(key)
 
     def get_from_batch(self, key: bytes):
         """(found, value_or_None) from the batch alone; found=False means the
@@ -112,7 +197,7 @@ class WriteBatchWithIndex:
         db_it.seek_to_first()
         db_pairs = list(db_it.entries())
         # Batch resolved view per key.
-        batch_keys = sorted({e[0] for e in self._items})
+        batch_keys = self._idx.keys()
         merged = []
         bi = di = 0
         while bi < len(batch_keys) or di < len(db_pairs):
