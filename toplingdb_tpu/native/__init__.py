@@ -100,6 +100,7 @@ def lib() -> ctypes.CDLL | None:
             l.tpulsm_sort_entries.argtypes = [
                 u8p, i64p, i64p, ctypes.c_int64,        # key buf/offs/lens, n
                 i32p, u8p,                              # order_out, new_key_out
+                ctypes.POINTER(ctypes.c_uint64),        # packed_out (nullable)
             ]
         except AttributeError:
             pass

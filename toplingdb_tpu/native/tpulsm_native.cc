@@ -24,7 +24,8 @@ extern "C" {
 // ---------------------------------------------------------------------------
 int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
                             const int64_t* lens, int64_t n,
-                            int32_t* order_out, uint8_t* new_key_out) {
+                            int32_t* order_out, uint8_t* new_key_out,
+                            uint64_t* packed_out /* nullable */) {
   auto packed_of = [&](int32_t i) -> uint64_t {
     // 8 LE trailer bytes assembled with shifts: endian-independent.
     const uint8_t* t = key_buf + offs[i] + lens[i] - 8;
@@ -32,6 +33,11 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
     for (int b = 0; b < 8; b++) p |= static_cast<uint64_t>(t[b]) << (8 * b);
     return p;  // (seq << 8) | type
   };
+  if (packed_out) {
+    // Emit per-ORIGINAL-index trailers so callers skip a numpy re-gather.
+    for (int64_t i = 0; i < n; i++)
+      packed_out[i] = packed_of(static_cast<int32_t>(i));
+  }
   int64_t max_uklen = 0;
   for (int64_t i = 0; i < n; i++) {
     const int64_t l = lens[i] - 8;
@@ -54,7 +60,9 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
       uint64_t kw = 0;
       for (int64_t b = 0; b < l; b++)
         kw |= static_cast<uint64_t>(k[b]) << (8 * (7 - b));
-      es[i] = {kw, packed_of(static_cast<int32_t>(i)),
+      es[i] = {kw,
+               packed_out ? packed_out[i]
+                          : packed_of(static_cast<int32_t>(i)),
                static_cast<uint32_t>(l), static_cast<int32_t>(i)};
     }
     std::stable_sort(es.begin(), es.end(), [](const E& a, const E& b) {

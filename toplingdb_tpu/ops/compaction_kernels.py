@@ -277,9 +277,13 @@ def host_encode_sort(key_buf: np.ndarray, key_offs: np.ndarray,
 
 def host_sort_order(key_buf: np.ndarray, key_offs: np.ndarray,
                     key_lens: np.ndarray):
-    """(order, new_key) via the native byte-span comparator (std::sort in
-    C++, GIL released) — same order as the device sort; None when the
-    native lib is unavailable."""
+    """(order, new_key, packed) via the native byte-span comparator
+    (std::stable_sort in C++, GIL released) — same order as the device
+    sort; `packed` = per-ORIGINAL-index (seq<<8|type) trailers so callers
+    skip re-gathering them in numpy. None when the native lib is
+    unavailable."""
+    import ctypes
+
     from toplingdb_tpu import native
 
     lib = native.lib()
@@ -291,13 +295,21 @@ def host_sort_order(key_buf: np.ndarray, key_offs: np.ndarray,
     kb = np.ascontiguousarray(key_buf)
     order = np.empty(n, dtype=np.int32)
     new_key = np.empty(n, dtype=np.uint8)
+    # Sentinel prefill: a stale 6-arg .so would leave packed unwritten —
+    # (seq=MAX, type=0xFF) is not a valid trailer, so survival means stale.
+    packed = np.full(n, np.uint64(0xFFFFFFFFFFFFFFFF), dtype=np.uint64)
     rc = lib.tpulsm_sort_entries(
         native.np_u8p(kb), native.np_i64p(offs), native.np_i64p(lens), n,
         native.np_i32p(order), native.np_u8p(new_key),
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
     )
     if rc != 0:
         return None
-    return order, new_key.astype(bool)
+    if n and packed[0] == np.uint64(0xFFFFFFFFFFFFFFFF):
+        # Old binary ignored packed_out: derive trailers in numpy instead.
+        seq, vtype = _trailer_seq_vtype(kb, offs, lens)
+        packed = (seq << np.uint64(8)) | vtype.astype(np.uint64)
+    return order, new_key.astype(bool), packed
 
 
 def host_gc_mask(new_key, sseq, svt, snapshots, cover, bottommost):
@@ -340,17 +352,29 @@ def host_gc_mask(new_key, sseq, svt, snapshots, cover, bottommost):
 def fused_encode_sort_gc_host(key_buf: np.ndarray, key_offs: np.ndarray,
                               key_lens: np.ndarray, max_key_bytes: int,
                               snapshots: list[int], bottommost: bool):
-    """NumPy twin of fused_encode_sort_gc for accelerator-less deployments
-    (selected via TPULSM_HOST_SORT=1, e.g. the bench's tpu-unreachable
-    fallback): np.lexsort realizes the same internal-key order and the GC
-    mask is the same vector math — outputs are identical (parity-tested)."""
+    """Host twin of fused_encode_sort_gc (same 3-tuple contract)."""
+    r = host_fused_full(key_buf, key_offs, key_lens, max_key_bytes,
+                        snapshots, bottommost)
+    return r[0], r[1], r[2]
+
+
+def host_fused_full(key_buf: np.ndarray, key_offs: np.ndarray,
+                    key_lens: np.ndarray, max_key_bytes: int,
+                    snapshots: list[int], bottommost: bool):
+    """Host twin of the fused kernel for accelerator-less deployments
+    (TPULSM_HOST_SORT=1): native/lexsort order + vectorized GC mask —
+    outputs identical to the jax path (parity-tested). Returns
+    (order, zero_flags, has_complex, seq, vtype) with seq/vtype per
+    ORIGINAL index so callers skip their own trailer gather."""
     if len(snapshots) > MAX_SNAPSHOTS:
         raise NotSupported(
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
         )
     n = len(key_offs)
     if n == 0:
-        return np.empty(0, np.int32), np.empty(0, bool), False
+        e = np.empty(0, np.uint64)
+        return (np.empty(0, np.int32), np.empty(0, bool), False,
+                e, e.astype(np.int32))
     s, new_key, seq, vtype = host_sort_with_boundaries(
         key_buf, key_offs, key_lens, max_key_bytes
     )
@@ -359,7 +383,7 @@ def fused_encode_sort_gc_host(key_buf: np.ndarray, key_offs: np.ndarray,
     )
     order = s[keep].astype(np.int32)
     zero_flags = zero_seq[keep]
-    return order, zero_flags, bool(host_resolve.any())
+    return order, zero_flags, bool(host_resolve.any()), seq, vtype
 
 
 def host_sort_with_boundaries(key_buf, key_offs, key_lens, max_key_bytes):
@@ -367,8 +391,9 @@ def host_sort_with_boundaries(key_buf, key_offs, key_lens, max_key_bytes):
     comparator when available, else the lexsort twin."""
     nat = host_sort_order(key_buf, key_offs, key_lens)
     if nat is not None:
-        s, new_key = nat
-        seq, vtype = _trailer_seq_vtype(key_buf, key_offs, key_lens)
+        s, new_key, packed = nat
+        seq = packed >> np.uint64(8)
+        vtype = (packed & np.uint64(0xFF)).astype(np.int32)
     else:
         s, words, uk_len, seq, vtype = host_encode_sort(
             key_buf, key_offs, key_lens, max_key_bytes

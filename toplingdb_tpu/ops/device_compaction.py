@@ -301,21 +301,29 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # Tombstone-free: encode + sort + GC in ONE device program fed raw
         # key bytes (half the upload of pre-built columns, no host gather).
         mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
-        fused = (
-            ck.fused_encode_sort_gc_host if _host_sort()
-            else ck.fused_encode_sort_gc
-        )
         try:
-            order, zero_flags, has_complex = fused(
-                kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
-                compaction.bottommost,
-            )
+            if _host_sort():
+                import types as _types
+
+                order, zero_flags, has_complex, seq_a, vt_a = \
+                    ck.host_fused_full(
+                        kv.key_buf, kv.key_offs, kv.key_lens, mkb,
+                        snapshots, compaction.bottommost,
+                    )
+                col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
+            else:
+                order, zero_flags, has_complex = ck.fused_encode_sort_gc(
+                    kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
+                    compaction.bottommost,
+                )
+                col = None
         except NotSupported:
             raise _FallbackToEntries()  # non-dense buffers etc.
         if has_complex:
             raise _FallbackToEntries()
         zero_orig = order[zero_flags]
-        col = _kv_seq_vtype(kv)
+        if col is None:
+            col = _kv_seq_vtype(kv)
     elif _host_sort():
         # Accelerator-less: host twins for the tombstone-bearing path too.
         mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
@@ -336,7 +344,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             raise _FallbackToEntries()
         order = s[keep]
         zero_orig = s[zero_seq]
-        col = _kv_seq_vtype(kv)
+        import types as _types
+
+        col = _types.SimpleNamespace(seq=seq, vtype=vtype, n=kv.n)
     else:
         col = columnar_from_kv(kv)
         padded = ck.pad_columns(col)
