@@ -23,7 +23,7 @@ _tried = False
 
 def _build() -> bool:
     cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
         "-o", _SO + ".tmp", _SRC,
     ]
     try:
@@ -101,6 +101,17 @@ def lib() -> ctypes.CDLL | None:
                 u8p, i64p, i64p, ctypes.c_int64,        # key buf/offs/lens, n
                 i32p, u8p,                              # order_out, new_key_out
                 ctypes.POINTER(ctypes.c_uint64),        # packed_out (nullable)
+            ]
+            l.tpulsm_build_data_section.restype = ctypes.c_int64
+            l.tpulsm_build_data_section.argtypes = [
+                u8p, i32p, i32p,                        # key buf/offs/lens
+                u8p, i32p, i32p,                        # val buf/offs/lens
+                i64p,                                   # trailer_override
+                i32p, ctypes.c_int64, ctypes.c_int64,   # order, start, limit
+                ctypes.c_int64, ctypes.c_int64,         # block_size, restart_int
+                ctypes.c_int64, ctypes.c_int64,         # base_size, max_size
+                i64p, i64p, ctypes.c_int64,             # counts, plens, max_blocks
+                u8p, ctypes.c_int64, i64p,              # out, cap, out_len
             ]
         except AttributeError:
             pass

@@ -5,13 +5,33 @@
 // through a plain C ABI consumed via ctypes. Design is original: table-driven
 // slicing-by-8 CRC32C and a from-spec xxhash64.
 //
-// Build: g++ -O3 -shared -fPIC -std=c++17 -o _tpulsm_native.so tpulsm_native.cc
+// Build: g++ -O3 -shared -fPIC -std=c++17 -pthread \
+//          -o _tpulsm_native.so tpulsm_native.cc
 #include <algorithm>
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
 #include <numeric>
+#include <thread>
 #include <vector>
+#ifdef __linux__
+#include <sched.h>
+#endif
+
+// CPUs this PROCESS may run on (cgroup quota / affinity mask), not the
+// host's core count — containers routinely pin far fewer than
+// hardware_concurrency() reports.
+static size_t effective_cpus() {
+#ifdef __linux__
+  cpu_set_t s;
+  if (sched_getaffinity(0, sizeof(s), &s) == 0) {
+    int c = CPU_COUNT(&s);
+    if (c > 0) return static_cast<size_t>(c);
+  }
+#endif
+  unsigned h = std::thread::hardware_concurrency();
+  return h ? h : 1;
+}
 
 extern "C" {
 
@@ -65,11 +85,111 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
                           : packed_of(static_cast<int32_t>(i)),
                static_cast<uint32_t>(l), static_cast<int32_t>(i)};
     }
-    std::stable_sort(es.begin(), es.end(), [](const E& a, const E& b) {
+    // idx as the final tiebreak makes the order STRICT and total, so an
+    // unstable chunked parallel sort + merges yields exactly the sequence
+    // stable_sort would — independent of thread count. The single-core
+    // radix path below realises the same order (stable LSD over the same
+    // composite), so every path emits identical bytes.
+    auto cmp = [](const E& a, const E& b) {
       if (a.kw != b.kw) return a.kw < b.kw;
       if (a.len != b.len) return a.len < b.len;
-      return a.packed > b.packed;  // newer seq first
-    });
+      if (a.packed != b.packed) return a.packed > b.packed;  // newer seq first
+      return a.idx < b.idx;
+    };
+    size_t nthreads = effective_cpus();
+    if (nthreads > 8) nthreads = 8;
+    if (n < (1 << 16)) {
+      std::sort(es.begin(), es.end(), cmp);
+    } else if (nthreads < 4) {
+      // Stable LSD radix, 16-bit digits, least-significant first over the
+      // composite (kw, len, packed DESC): ~packed low..high, len, kw
+      // low..high. Constant digits (shared key prefixes, small seqnos)
+      // skip their scatter pass entirely.
+      std::vector<E> tmp(n);
+      std::vector<E>* src = &es;
+      std::vector<E>* dst = &tmp;
+      std::vector<int64_t> hist(1 << 16);
+      auto digit_of = [](const E& e, int d) -> uint32_t {
+        if (d < 4) return (uint32_t)((~e.packed) >> (16 * d)) & 0xffff;
+        if (d == 4) return e.len & 0xffff;
+        return (uint32_t)(e.kw >> (16 * (d - 5))) & 0xffff;
+      };
+      for (int d = 0; d < 9; d++) {
+        std::fill(hist.begin(), hist.end(), 0);
+        const E* s = src->data();
+        for (int64_t i = 0; i < n; i++) hist[digit_of(s[i], d)]++;
+        uint32_t first = digit_of(s[0], d);
+        if (hist[first] == n) continue;  // constant digit: order unchanged
+        int64_t sum = 0;
+        for (int64_t b = 0; b < (1 << 16); b++) {
+          int64_t c = hist[b];
+          hist[b] = sum;
+          sum += c;
+        }
+        E* o = dst->data();
+        for (int64_t i = 0; i < n; i++) o[hist[digit_of(s[i], d)]++] = s[i];
+        std::swap(src, dst);
+      }
+      if (src != &es) es = std::move(*src);
+    } else {
+      // No exception may cross the extern "C" boundary: a failed thread
+      // spawn (cgroup pid limit, transient EAGAIN) runs the task inline on
+      // this thread instead, and a failed scratch allocation degrades to a
+      // serial sort over the already-sorted chunks.
+      auto spawn_or_inline = [](std::vector<std::thread>& pool, auto&& fn) {
+        try {
+          pool.emplace_back(fn);
+        } catch (...) {
+          fn();
+        }
+      };
+      std::vector<size_t> bounds(nthreads + 1);
+      for (size_t t = 0; t <= nthreads; t++)
+        bounds[t] = static_cast<size_t>(n) * t / nthreads;
+      std::vector<std::thread> workers;
+      for (size_t t = 1; t < nthreads; t++)
+        spawn_or_inline(workers, [&es, &bounds, t, &cmp] {
+          std::sort(es.begin() + bounds[t], es.begin() + bounds[t + 1], cmp);
+        });
+      std::sort(es.begin(), es.begin() + bounds[1], cmp);
+      for (auto& w : workers) w.join();
+      std::vector<E> tmp;
+      try {
+        tmp.resize(n);
+      } catch (...) {
+        tmp.clear();
+      }
+      if (tmp.empty()) {
+        std::sort(es.begin(), es.end(), cmp);
+      } else {
+        // Bottom-up pairwise merges; pairs within a pass run concurrently.
+        std::vector<E>* src = &es;
+        std::vector<E>* dst = &tmp;
+        while (bounds.size() > 2) {
+          std::vector<size_t> nb;
+          nb.push_back(0);
+          std::vector<std::thread> mergers;
+          for (size_t r = 0; r + 2 < bounds.size(); r += 2) {
+            size_t lo = bounds[r], mid = bounds[r + 1], hi = bounds[r + 2];
+            spawn_or_inline(mergers, [src, dst, lo, mid, hi, &cmp] {
+              std::merge(src->begin() + lo, src->begin() + mid,
+                         src->begin() + mid, src->begin() + hi,
+                         dst->begin() + lo, cmp);
+            });
+            nb.push_back(hi);
+          }
+          if (bounds.size() % 2 == 0) {  // odd run count: copy the tail run
+            size_t lo = bounds[bounds.size() - 2], hi = bounds.back();
+            std::copy(src->begin() + lo, src->begin() + hi, dst->begin() + lo);
+            nb.push_back(hi);
+          }
+          for (auto& w : mergers) w.join();
+          std::swap(src, dst);
+          bounds = std::move(nb);
+        }
+        if (src != &es) es = std::move(*src);
+      }
+    }
     for (int64_t i = 0; i < n; i++) {
       order_out[i] = es[i].idx;
       new_key_out[i] =
@@ -420,6 +540,63 @@ int64_t tpulsm_build_block(
   used += 4;
   *out_len = used;
   return consumed;
+}
+
+// Build a RUN of framed data blocks in one call: each block is the exact
+// bytes tpulsm_build_block emits, followed by the uncompressed type byte (0)
+// and the masked crc32c trailer — i.e. write_block(NO_COMPRESSION) framing
+// (reference table/format.cc block trailer). Stops when entries in
+// [start, limit) are exhausted, when the output-file cut budget is reached
+// (base_file_size + bytes emitted so far >= max_file_size, checked BEFORE
+// every block except the first, mirroring the caller's per-iteration cut
+// check), or when the per-block metadata arrays fill. Always emits at least
+// one block or returns an error. block_counts[b]/block_payload_lens[b]
+// receive entries-consumed and UNFRAMED payload length per block; *out_len
+// the total framed section length. Returns blocks emitted, or negative:
+// -2 out buffer too small for even one block, -3/-8 propagated from
+// tpulsm_build_block on the first block (later blocks: returns the partial
+// run and the next call surfaces the error).
+int64_t tpulsm_build_data_section(
+    const uint8_t* key_buf, const int32_t* key_offs, const int32_t* key_lens,
+    const uint8_t* val_buf, const int32_t* val_offs, const int32_t* val_lens,
+    const int64_t* trailer_override,
+    const int32_t* order, int64_t start, int64_t limit,
+    int64_t block_size_limit, int64_t restart_interval,
+    int64_t base_file_size, int64_t max_file_size,
+    int64_t* block_counts, int64_t* block_payload_lens, int64_t max_blocks,
+    uint8_t* out, int64_t out_cap, int64_t* out_len) {
+  int64_t pos = start;
+  int64_t used = 0;
+  int64_t nb = 0;
+  while (pos < limit) {
+    if (nb > 0) {
+      if (base_file_size + used >= max_file_size) break;
+      if (nb >= max_blocks) break;
+    }
+    int64_t payload_len = 0;
+    int64_t avail = out_cap - used - 5;  // leave room for the 5-byte trailer
+    int64_t rc = (avail <= 0) ? -2 : tpulsm_build_block(
+        key_buf, key_offs, key_lens, val_buf, val_offs, val_lens,
+        trailer_override, order, pos, limit,
+        block_size_limit, restart_interval,
+        out + used, avail, &payload_len);
+    if (rc <= 0) {
+      if (nb > 0) break;  // partial run; next call retries/fails this block
+      return rc;
+    }
+    uint8_t* trailer = out + used + payload_len;
+    trailer[0] = 0;  // kNoCompression
+    uint32_t crc = tpulsm_crc32c_extend(0, out + used, (size_t)(payload_len + 1));
+    uint32_t masked = ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+    std::memcpy(trailer + 1, &masked, 4);
+    block_counts[nb] = rc;
+    block_payload_lens[nb] = payload_len;
+    nb++;
+    used += payload_len + 5;
+    pos += rc;
+  }
+  *out_len = used;
+  return nb;
 }
 
 // Bulk whole-file decode: every data block parsed in one native call.

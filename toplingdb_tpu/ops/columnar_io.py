@@ -30,6 +30,11 @@ from toplingdb_tpu.table.properties import TableProperties
 from toplingdb_tpu.utils.status import Corruption, NotSupported
 
 
+# Soft per-native-call output budget for the bulk block builder: bounds the
+# section buffer and the transient Python copy on arbitrarily large jobs.
+_SECTION_RUN_BYTES = 8 << 20
+
+
 class ColumnarKV:
     """Flat-buffer view of N (internal_key, value) entries."""
 
@@ -229,8 +234,10 @@ class _ColumnarSST:
         self.last_key: bytes | None = None
         self.num_entries = 0
 
-    def add_block(self, raw: bytes, block_first: bytes, block_last: bytes,
-                  n_entries: int) -> None:
+    def _account_block(self, handle, raw_len: int, block_first: bytes,
+                       block_last: bytes, n_entries: int) -> None:
+        """Index/props bookkeeping shared by the per-block and bulk paths —
+        one implementation so the two can't diverge byte-wise."""
         if self.first_key is None:
             self.first_key = block_first
         if self.pending_last_key is not None:
@@ -238,14 +245,32 @@ class _ColumnarSST:
                 self.pending_last_key, block_first
             )
             self.index_block.add(sep, self.pending_handle.encode())
-        self.pending_handle = fmt.write_block(
-            self.w, raw, self._options.compression
-        )
+        self.pending_handle = handle
         self.pending_last_key = block_last
-        self.props.data_size += len(raw)
+        self.props.data_size += raw_len
         self.props.num_data_blocks += 1
         self.last_key = block_last
         self.num_entries += n_entries
+
+    def add_block(self, raw: bytes, block_first: bytes, block_last: bytes,
+                  n_entries: int) -> None:
+        handle = fmt.write_block(self.w, raw, self._options.compression)
+        self._account_block(handle, len(raw), block_first, block_last,
+                            n_entries)
+
+    def add_framed_section(self, section: bytes, blocks) -> None:
+        """Bulk form of add_block: `section` is a pre-framed run of
+        uncompressed blocks (payload + type byte + crc trailer, exactly what
+        write_block emits) and `blocks` yields
+        (payload_len, first_key, last_key, n_entries) per block in file
+        order. One append for the whole run."""
+        offset = self.w.file_size()
+        for payload_len, block_first, block_last, n_entries in blocks:
+            self._account_block(fmt.BlockHandle(offset, payload_len),
+                                payload_len, block_first, block_last,
+                                n_entries)
+            offset += payload_len + fmt.BLOCK_TRAILER_SIZE
+        self.w.append(section)
 
     def finish(self, lib, kv, sel, vtypes, seqs, tombstones):
         """Write meta blocks + footer; `sel` = the original-index selection
@@ -392,6 +417,33 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
     p_out = native.np_u8p(out_buf)
 
     can_cut = not tombstones  # single output while tombstones survive
+
+    # Bulk framing: emit a whole RUN of framed blocks per native call
+    # (payload + type byte + crc trailer, byte-identical to write_block)
+    # instead of one block per call — the per-block Python loop dominates
+    # the write side at bench scale. Only for uncompressed output; a stale
+    # .so without the symbol degrades to the per-block path.
+    use_section = (options.compression == fmt.NO_COMPRESSION
+                   and hasattr(lib, "tpulsm_build_data_section"))
+    if use_section and n_total:
+        sec_bytes = int(kv.key_lens[order].sum()) + int(
+            kv.val_lens[order].sum())
+        # Each native call emits at most ~_SECTION_RUN_BYTES (stopping a run
+        # early is free: the next call continues the same file), so the
+        # section buffer and the per-call copy stay bounded no matter how
+        # large the compaction or the output-file budget is.
+        sec_cap = min(sec_bytes + sec_bytes // 4,
+                      _SECTION_RUN_BYTES + out_cap) + (1 << 16)
+        sec_buf = np.empty(sec_cap, dtype=np.uint8)
+        max_blocks = sec_cap // max(1, options.block_size) + 1024
+        sec_counts = np.empty(max_blocks, dtype=np.int64)
+        sec_plens = np.empty(max_blocks, dtype=np.int64)
+        sec_len = np.zeros(1, dtype=np.int64)
+        p_sec = native.np_u8p(sec_buf)
+        p_counts = native.np_i64p(sec_counts)
+        p_plens = native.np_i64p(sec_plens)
+        p_seclen = native.np_i64p(sec_len)
+
     results = []
     cur: _ColumnarSST | None = None
     lo = 0
@@ -420,6 +472,42 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                     while j < n_total and same_user_key(j, j - 1):
                         j += 1
                     limit = j
+            if use_section:
+                base_size = cur.w.file_size()
+                budget = base_size + _SECTION_RUN_BYTES
+                if can_cut and max_output_file_size < budget:
+                    budget = max_output_file_size
+                rc = lib.tpulsm_build_data_section(
+                    p_kbuf, p_koff, p_klen, p_vbuf, p_voff, p_vlen, p_tro,
+                    p_order, start, limit,
+                    options.block_size, options.restart_interval,
+                    base_size, budget,
+                    p_counts, p_plens, max_blocks,
+                    p_sec, sec_cap, p_seclen,
+                )
+                if rc == -2:
+                    sec_cap *= 4
+                    sec_buf = np.empty(sec_cap, dtype=np.uint8)
+                    p_sec = native.np_u8p(sec_buf)
+                    continue
+                if rc == -3 or rc == -8:
+                    raise NotSupported(
+                        f"native block build unsupported input rc={rc}"
+                    )
+                if rc <= 0:
+                    raise Corruption(f"native section build failed rc={rc}")
+                nb = int(rc)
+                section = sec_buf[: int(sec_len[0])].tobytes()
+                blocks = []
+                pos = start
+                for b in range(nb):
+                    cnt = int(sec_counts[b])
+                    blocks.append((int(sec_plens[b]), entry_key(pos),
+                                   entry_key(pos + cnt - 1), cnt))
+                    pos += cnt
+                cur.add_framed_section(section, blocks)
+                start = pos
+                continue
             rc = lib.tpulsm_build_block(
                 p_kbuf, p_koff, p_klen, p_vbuf, p_voff, p_vlen, p_tro,
                 p_order, start, limit,
