@@ -104,17 +104,26 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
       // Stable LSD radix, 16-bit digits, least-significant first over the
       // composite (kw, len, packed DESC): ~packed low..high, len, kw
       // low..high. Constant digits (shared key prefixes, small seqnos)
-      // skip their scatter pass entirely.
-      std::vector<E> tmp(n);
+      // skip their scatter pass entirely. No exception may cross the
+      // extern "C" boundary: failed scratch allocation degrades to a
+      // comparison sort in place.
+      std::vector<E> tmp;
+      std::vector<int64_t> hist;
+      try {
+        tmp.resize(n);
+        hist.resize(1 << 16);
+      } catch (...) {
+        std::sort(es.begin(), es.end(), cmp);
+        tmp.clear();
+      }
       std::vector<E>* src = &es;
       std::vector<E>* dst = &tmp;
-      std::vector<int64_t> hist(1 << 16);
       auto digit_of = [](const E& e, int d) -> uint32_t {
         if (d < 4) return (uint32_t)((~e.packed) >> (16 * d)) & 0xffff;
         if (d == 4) return e.len & 0xffff;
         return (uint32_t)(e.kw >> (16 * (d - 5))) & 0xffff;
       };
-      for (int d = 0; d < 9; d++) {
+      for (int d = 0; d < 9 && !tmp.empty(); d++) {
         std::fill(hist.begin(), hist.end(), 0);
         const E* s = src->data();
         for (int64_t i = 0; i < n; i++) hist[digit_of(s[i], d)]++;
