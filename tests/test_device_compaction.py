@@ -467,3 +467,96 @@ def test_host_sort_tombstone_path_byte_parity(tmp_path, monkeypatch):
     assert len(outs[0]) == len(outs[1]) and outs[0], "no outputs"
     for x, y in zip(outs[0], outs[1]):
         assert x == y, "host-sort tombstone path bytes differ from jax path"
+
+
+def test_multi_shard_parity(tmp_path, monkeypatch):
+    """TPULSM_DEVICE_SHARDS>1 splits the job into user-key-range shards
+    (per-shard device programs, stitched survivor orders); bytes must equal
+    the single-shard device path and the CPU path — both uniform-length and
+    variable-length keys."""
+    from toplingdb_tpu.compaction.compaction_job import run_compaction_to_tables
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db.table_cache import TableCache
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.ops import device_compaction as dc
+    from toplingdb_tpu.ops.device_compaction import run_device_compaction
+    from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+    import os
+    import toplingdb_tpu.db.filename as fn
+
+    env = default_env()
+    topts = TableOptions(block_size=512)
+    # Shard even the small test inputs.
+    monkeypatch.setattr(dc, "_SHARD_MIN_ROWS", 1)
+    for mode, keyfmt in (
+        ("uniform", lambda r: b"key%05d" % r.randrange(400)),
+        ("varlen", lambda r: b"k%0*d" % (r.randrange(3, 9), r.randrange(400))),
+    ):
+        dbdir = str(tmp_path / mode)
+        os.makedirs(dbdir)
+        rng = random.Random(17)
+        metas = []
+        seq = 1
+        for fnum in (41, 42, 43):
+            entries = []
+            for _ in range(300):
+                t = (ValueType.VALUE if rng.random() < 0.8
+                     else ValueType.DELETION)
+                entries.append(
+                    (make_internal_key(keyfmt(rng), seq, t), b"val%06d" % seq)
+                )
+                seq += 1
+            entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))
+            w = env.new_writable_file(fn.table_file_name(dbdir, fnum))
+            b = TableBuilder(w, ICMP, topts)
+            last = None
+            for k, v in entries:
+                if last == k:
+                    continue
+                b.add(k, v)
+                last = k
+            props = b.finish()
+            w.close()
+            metas.append(FileMetaData(
+                number=fnum,
+                file_size=env.get_file_size(fn.table_file_name(dbdir, fnum)),
+                smallest=b.smallest_key, largest=b.largest_key,
+                smallest_seqno=props.smallest_seqno,
+                largest_seqno=props.largest_seqno,
+            ))
+        tc = TableCache(env, dbdir, ICMP, topts)
+
+        def mk(base):
+            s = [base]
+
+            def alloc():
+                s[0] += 1
+                return s[0]
+
+            return alloc
+
+        outs = {}
+        for shards in (0, 1, 4, 7):
+            c = Compaction(level=0, output_level=2, inputs=list(metas),
+                           bottommost=True, max_output_file_size=1 << 62)
+            if shards:
+                monkeypatch.setenv("TPULSM_DEVICE_SHARDS", str(shards))
+                outs[shards], _ = run_device_compaction(
+                    env, dbdir, ICMP, c, tc, topts, [250, 600],
+                    new_file_number=mk(500 + shards * 20), creation_time=7,
+                    device_name="cpu-jax",
+                )
+            else:
+                monkeypatch.delenv("TPULSM_DEVICE_SHARDS", raising=False)
+                outs[0], _ = run_compaction_to_tables(
+                    env, dbdir, ICMP, c, tc, topts, [250, 600],
+                    new_file_number=mk(490), creation_time=7,
+                )
+        ref = [open(fn.table_file_name(dbdir, m.number), "rb").read()
+               for m in outs[0]]
+        assert ref, f"{mode}: no outputs"
+        for shards in (1, 4, 7):
+            got = [open(fn.table_file_name(dbdir, m.number), "rb").read()
+                   for m in outs[shards]]
+            assert got == ref, f"{mode}: shards={shards} bytes differ"

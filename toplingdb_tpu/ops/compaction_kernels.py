@@ -423,34 +423,25 @@ def _new_key_from_words(skw, slen):
     return ~same_key
 
 
-@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
-def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
-                               snap_hi, snap_lo, num_key_words, bottommost):
-    """Columnar encode + sort + GC mask, all ON DEVICE: the host uploads raw
-    internal-key bytes + lengths only (entries are densely packed, so the
-    offsets are an on-device exclusive cumsum) and downloads the survivor
-    order. Tombstone-free jobs only."""
+def _encode_from_bytes(key_buf, key_offs, key_lens, valid, num_key_words):
+    """Shared traced encode from raw internal-key bytes: trailer unpack +
+    BE user-key word pack, invalid rows masked to the int32max sentinel.
+    Returns (key_words, key_len, inv_hi, inv_lo, vtype)."""
     n = key_lens.shape[0]
-    key_offs = jnp.cumsum(key_lens) - key_lens  # dense layout: offs from lens
     span = num_key_words * 4
     u32 = jnp.uint32
+    sign = u32(_SIGN)
+    i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    int32max = jnp.int32(2**31 - 1)
 
     # --- trailer: 8 LE bytes at offs+len-8 → packed (seq<<8|type) ---
     tr_idx = (key_offs + key_lens - 8)[:, None] + jnp.arange(8)[None, :]
     tr = key_buf[jnp.clip(tr_idx, 0, key_buf.shape[0] - 1)].astype(u32)
     packed_lo = tr[:, 0] | (tr[:, 1] << 8) | (tr[:, 2] << 16) | (tr[:, 3] << 24)
     packed_hi = tr[:, 4] | (tr[:, 5] << 8) | (tr[:, 6] << 16) | (tr[:, 7] << 24)
-    vtype = (packed_lo & u32(0xFF)).astype(jnp.int32)
-    vtype = jnp.where(valid, vtype, -1)
-    inv_hi_u = ~packed_hi
-    inv_lo_u = ~packed_lo
-    sign = u32(0x80000000)
-    i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
-    inv_hi = i32(inv_hi_u ^ sign)
-    inv_lo = i32(inv_lo_u ^ sign)
-    int32max = jnp.int32(2**31 - 1)
-    inv_hi = jnp.where(valid, inv_hi, int32max)
-    inv_lo = jnp.where(valid, inv_lo, int32max)
+    vtype = jnp.where(valid, (packed_lo & u32(0xFF)).astype(jnp.int32), -1)
+    inv_hi = jnp.where(valid, i32(~packed_hi ^ sign), int32max)
+    inv_lo = jnp.where(valid, i32(~packed_lo ^ sign), int32max)
 
     # --- user-key words: gather span bytes, mask past uk_len, pack BE ---
     uk_len = (key_lens - 8).astype(jnp.int32)
@@ -459,13 +450,268 @@ def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
     kb = kb * (jnp.arange(span)[None, :] < uk_len[:, None])
     kb = kb.reshape(n, num_key_words, 4)
     words = (kb[:, :, 0] << 24) | (kb[:, :, 1] << 16) | (kb[:, :, 2] << 8) | kb[:, :, 3]
-    key_words = i32(words ^ sign)
-    key_words = jnp.where(valid[:, None], key_words, int32max)
+    key_words = jnp.where(valid[:, None], i32(words ^ sign), int32max)
     key_len = jnp.where(valid, uk_len, int32max)
+    return key_words, key_len, inv_hi, inv_lo, vtype
 
+
+def _sort_gc_packed_tail(key_words, key_len, inv_hi, inv_lo, vtype, idx,
+                         snap_hi, snap_lo, num_key_words, bottommost):
+    """Traced tail shared by the chunked fused kernels: sort (carrying idx)
+    → GC mask (no tombstones) → ONE int32 result array
+    [packed_order..., count, has_complex] with each survivor's zero-seq
+    flag in its order entry's sign bit."""
+    u32 = jnp.uint32
+    i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    n = key_words.shape[0]
+    kw, kl, ih, il, vt, perm = _sort_impl(
+        key_words, key_len, inv_hi, inv_lo, vtype, idx, num_key_words,
+    )
+    zeros = jnp.zeros(n, dtype=jnp.uint32)
+    keep, zero_seq, host_resolve, _ = _gc_mask_impl(
+        kw, kl, ih, il, vt, snap_hi, snap_lo, zeros, zeros,
+        num_key_words, bottommost,
+    )
+    take = jnp.argsort(~keep, stable=True)
+    packed_order = i32(
+        jax.lax.bitcast_convert_type(perm[take], u32)
+        | (zero_seq[take].astype(u32) << 31)
+    )
+    extras = jnp.stack([
+        jnp.sum(keep.astype(jnp.int32)),
+        jnp.any(host_resolve).astype(jnp.int32),
+    ])
+    return jnp.concatenate([packed_order, extras])
+
+
+@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
+def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
+                               snap_hi, snap_lo, num_key_words, bottommost):
+    """Columnar encode + sort + GC mask, all ON DEVICE: the host uploads raw
+    internal-key bytes + lengths only (entries are densely packed, so the
+    offsets are an on-device exclusive cumsum) and downloads the survivor
+    order. Tombstone-free jobs only."""
+    key_offs = jnp.cumsum(key_lens) - key_lens  # dense layout: offs from lens
+    key_words, key_len, inv_hi, inv_lo, vtype = _encode_from_bytes(
+        key_buf, key_offs, key_lens, valid, num_key_words,
+    )
     return _sort_gc_compact_tail(
         key_words, key_len, inv_hi, inv_lo, vtype, snap_hi, snap_lo,
         num_key_words, bottommost,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
+def _fused_chunks_impl(kbs, lens8s, ns, row_bases, snap_hi, snap_lo,
+                       num_key_words, bottommost):
+    """Chunked variant of _fused_encode_sort_gc_impl: one padded (key-bytes,
+    uint8-lens) pair PER INPUT FILE, uploaded as each file is scanned so
+    host IO overlaps the host→device transfers. Validity is derived on
+    device from the per-chunk row counts `ns` (nothing but raw bytes +
+    lengths crosses the link), and the whole result comes back as ONE int32
+    array: [packed_order..., count, has_complex] with the zero-seq flag in
+    each order entry's sign bit."""
+    int32max = jnp.int32(2**31 - 1)
+    lens_parts, offs_parts, valid_parts, orig_parts = [], [], [], []
+    byte_base = 0
+    for j, l8 in enumerate(lens8s):
+        rows = l8.shape[0]
+        iota = jnp.arange(rows, dtype=jnp.int32)
+        valid = iota < ns[j]
+        lens = jnp.where(valid, l8.astype(jnp.int32), 0)
+        offs = byte_base + jnp.cumsum(lens) - lens
+        lens_parts.append(lens)
+        offs_parts.append(offs)
+        valid_parts.append(valid)
+        # Original row index in the host's concatenated ColumnarKV. Invalid
+        # rows may collide with later chunks' values — they are masked out
+        # of the survivor set, so their sort position is irrelevant.
+        orig_parts.append(jnp.where(valid, row_bases[j] + iota, int32max))
+        byte_base += kbs[j].shape[0]
+    key_buf = jnp.concatenate(kbs)
+    key_lens = jnp.concatenate(lens_parts)
+    key_offs = jnp.concatenate(offs_parts)
+    valid = jnp.concatenate(valid_parts)
+    orig = jnp.concatenate(orig_parts)
+    key_words, key_len, inv_hi, inv_lo, vtype = _encode_from_bytes(
+        key_buf, key_offs, key_lens, valid, num_key_words,
+    )
+    return _sort_gc_packed_tail(
+        key_words, key_len, inv_hi, inv_lo, vtype, orig,
+        snap_hi, snap_lo, num_key_words, bottommost,
+    )
+
+
+def begin_chunk_upload(key_buf: np.ndarray, key_lens: np.ndarray):
+    """Pad one file's dense raw key bytes + lengths to pow2 buckets and
+    START their host→device transfers (device_put is async: the copy
+    streams while the caller scans the next input file). Returns an opaque
+    handle for fused_encode_sort_gc_chunks. Raises NotSupported for keys
+    whose length exceeds uint8 (the device key budget is far below that)."""
+    n = len(key_lens)
+    if n and int(key_lens.max()) > 255:
+        raise NotSupported("chunked fused path requires key lengths <= 255")
+    b = _next_pow2(max(8, len(key_buf)))
+    kb = np.zeros(b, dtype=np.uint8)
+    kb[: len(key_buf)] = key_buf
+    p = _next_pow2(max(1, n))
+    l8 = np.zeros(p, dtype=np.uint8)
+    l8[:n] = key_lens
+    return (jax.device_put(kb), jax.device_put(l8), n)
+
+
+def fused_chunks_start(handles, snapshots: list[int], bottommost: bool,
+                       max_key_bytes: int):
+    """DISPATCH the fused encode+sort+GC over per-file chunk handles from
+    begin_chunk_upload (in ColumnarKV.concat row order) and return the
+    in-flight device array — the caller overlaps host work, then decodes
+    with fused_chunks_finish."""
+    if len(snapshots) > MAX_SNAPSHOTS:
+        raise NotSupported(
+            f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
+        )
+    if not handles:
+        return None
+    ns = np.array([h[2] for h in handles], dtype=np.int32)
+    row_bases = np.cumsum(ns, dtype=np.int32) - ns
+    snap_hi, snap_lo = _split_snapshots(snapshots)
+    w = (max_key_bytes + 3) // 4
+    return _fused_chunks_impl(
+        tuple(h[0] for h in handles), tuple(h[1] for h in handles),
+        ns, row_bases, snap_hi, snap_lo, w, bool(bottommost),
+    )
+
+
+def fused_chunks_finish(out):
+    """Block on a fused_chunks_start result: (order[count],
+    zero_flags[count], has_complex), order indexing the concatenated host
+    columns."""
+    if out is None:
+        return np.empty(0, np.int32), np.empty(0, bool), False
+    arr = np.asarray(out)
+    count = int(arr[-2])
+    has_complex = bool(arr[-1])
+    po = arr[:count].view(np.uint32)
+    order = (po & np.uint32(0x7FFFFFFF)).astype(np.int32)
+    zero_flags = (po >> np.uint32(31)).astype(bool)
+    return order, zero_flags, has_complex
+
+
+def fused_encode_sort_gc_chunks(handles, snapshots: list[int],
+                                bottommost: bool, max_key_bytes: int):
+    """One-shot wrapper: dispatch + decode."""
+    return fused_chunks_finish(
+        fused_chunks_start(handles, snapshots, bottommost, max_key_bytes)
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_key_words", "uk_len", "bottommost")
+)
+def _fused_uniform_impl(uks, pks, ns, min_his, min_los, row_bases,
+                        snap_hi, snap_lo, num_key_words, uk_len, bottommost):
+    """Uniform-key-length variant of _fused_chunks_impl. Each chunk ships
+    only its user-key bytes (trailers stripped on host) plus ONE uint32 per
+    entry: (seq - chunk_min_seq) << 8 | vtype, seq deltas < 2^24. No device
+    gathers (rows are a reshape), and the sort carries w+1 key operands
+    instead of w+3 keys + 2 payloads. Tombstone-free jobs only."""
+    u32 = jnp.uint32
+    int32max = jnp.int32(2**31 - 1)
+    sign = u32(_SIGN)
+    i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    span = num_key_words * 4
+    words_p, ih_p, il_p, vt_p, kl_p, orig_p = [], [], [], [], [], []
+    for j, pk in enumerate(pks):
+        rows = pk.shape[0]
+        iota = jnp.arange(rows, dtype=jnp.int32)
+        valid = iota < ns[j]
+        kb = uks[j].reshape(rows, uk_len)
+        if span > uk_len:
+            kb = jnp.pad(kb, ((0, 0), (0, span - uk_len)))
+        kb = kb.astype(u32).reshape(rows, num_key_words, 4)
+        words = (
+            (kb[:, :, 0] << 24) | (kb[:, :, 1] << 16)
+            | (kb[:, :, 2] << 8) | kb[:, :, 3]
+        )
+        words = jnp.where(valid[:, None], i32(words ^ sign), int32max)
+        # Reconstruct the FULL 64-bit packed trailer (seq<<8|type) from the
+        # 24-bit chunk-relative delta + the chunk's min seqno: deltas from
+        # different chunks are not comparable, the absolute words are.
+        rel = pk >> 8
+        seq_lo = min_los[j] + rel
+        carry = (seq_lo < min_los[j]).astype(u32)
+        seq_hi = min_his[j] + carry
+        vt = (pk & u32(0xFF))
+        packed_hi = (seq_hi << 8) | (seq_lo >> 24)
+        packed_lo = (seq_lo << 8) | vt
+        ih = jnp.where(valid, i32(~packed_hi ^ sign), int32max)
+        il = jnp.where(valid, i32(~packed_lo ^ sign), int32max)
+        words_p.append(words)
+        ih_p.append(ih)
+        il_p.append(il)
+        vt_p.append(jnp.where(valid, vt.astype(jnp.int32), -1))
+        kl_p.append(jnp.where(valid, jnp.int32(uk_len), int32max))
+        orig_p.append(jnp.where(valid, row_bases[j] + iota, int32max))
+    key_words = jnp.concatenate(words_p)
+    inv_hi = jnp.concatenate(ih_p)
+    inv_lo = jnp.concatenate(il_p)
+    vtype = jnp.concatenate(vt_p)
+    key_len = jnp.concatenate(kl_p)
+    orig = jnp.concatenate(orig_p)
+    return _sort_gc_packed_tail(
+        key_words, key_len, inv_hi, inv_lo, vtype, orig,
+        snap_hi, snap_lo, num_key_words, bottommost,
+    )
+
+
+def begin_uniform_chunk_upload(key_buf: np.ndarray, n: int, key_len: int):
+    """Strip the 8-byte trailers from one file's dense uniform-length key
+    buffer and START the transfers of (user-key bytes, packed32) — half the
+    bytes of the generic chunk upload. Raises NotSupported when the chunk's
+    seqno span exceeds 24 bits (the uint32 packing budget)."""
+    import sys as _sys
+
+    kb2 = key_buf[: n * key_len].reshape(n, key_len)
+    tr = np.ascontiguousarray(kb2[:, -8:]).view(np.uint64).reshape(n)
+    if _sys.byteorder == "big":
+        tr = tr.byteswap()
+    seq = tr >> np.uint64(8)
+    min_seq = int(seq.min()) if n else 0
+    rel = seq - np.uint64(min_seq)
+    if n and int(rel.max()) >= 1 << 24:
+        raise NotSupported("chunk seqno span exceeds the 24-bit delta budget")
+    pk32 = ((rel << np.uint64(8)) | (tr & np.uint64(0xFF))).astype(np.uint32)
+    uk_len = key_len - 8
+    uk = np.ascontiguousarray(kb2[:, :uk_len])
+    p = _next_pow2(max(1, n))
+    ukp = np.zeros(p * uk_len, dtype=np.uint8)
+    ukp[: n * uk_len] = uk.reshape(-1)
+    pkp = np.zeros(p, dtype=np.uint32)
+    pkp[:n] = pk32
+    return (jax.device_put(ukp), jax.device_put(pkp), n, min_seq, uk_len)
+
+
+def fused_uniform_start(handles, snapshots: list[int], bottommost: bool):
+    """Dispatch the uniform-key fused program over chunk handles from
+    begin_uniform_chunk_upload (ColumnarKV.concat row order)."""
+    if len(snapshots) > MAX_SNAPSHOTS:
+        raise NotSupported(
+            f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
+        )
+    if not handles:
+        return None
+    uk_len = handles[0][4]
+    ns = np.array([h[2] for h in handles], dtype=np.int32)
+    row_bases = np.cumsum(ns, dtype=np.int32) - ns
+    mins = np.array([h[3] for h in handles], dtype=np.uint64)
+    min_his = (mins >> np.uint64(32)).astype(np.uint32)
+    min_los = (mins & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    snap_hi, snap_lo = _split_snapshots(snapshots)
+    w = (max(uk_len, 4) + 3) // 4
+    return _fused_uniform_impl(
+        tuple(h[0] for h in handles), tuple(h[1] for h in handles),
+        ns, min_his, min_los, row_bases, snap_hi, snap_lo,
+        w, uk_len, bool(bottommost),
     )
 
 

@@ -244,8 +244,18 @@ def _kv_seq_vtype(kv):
     n = kv.n
     offs = kv.key_offs.astype(np.int64)
     lens = kv.key_lens.astype(np.int64)
-    tr_idx = (offs + lens - 8)[:, None] + np.arange(8)[None, :]
-    trailer = np.ascontiguousarray(kv.key_buf[tr_idx])
+    if n and kv.key_lens.min() == kv.key_lens.max() and len(
+            kv.key_buf) == n * int(lens[0]) and int(offs[0]) == 0 and int(
+            offs[-1]) == (n - 1) * int(lens[0]) and np.array_equal(
+            np.diff(offs), lens[:-1]):
+        # Uniform key length over a dense buffer: the trailers are a strided
+        # view — no [n,8] gather.
+        trailer = np.ascontiguousarray(
+            kv.key_buf.reshape(n, int(lens[0]))[:, -8:]
+        )
+    else:
+        tr_idx = (offs + lens - 8)[:, None] + np.arange(8)[None, :]
+        trailer = np.ascontiguousarray(kv.key_buf[tr_idx])
     packed = trailer.view(np.uint64).reshape(n)
     if sys.byteorder == "big":  # trailer bytes on disk are LE
         packed = packed.byteswap()
@@ -257,17 +267,148 @@ def _kv_seq_vtype(kv):
     )
 
 
-def _collect_raw_columnar(compaction, table_cache, icmp):
+def _part_user_key(part, i: int) -> bytes:
+    o = int(part.key_offs[i])
+    return part.key_buf[o: o + int(part.key_lens[i]) - 8].tobytes()
+
+
+def _shard_splitters(part, n_shards: int) -> list[bytes]:
+    """Evenly spaced user keys from one sorted part (deduped, ascending)."""
+    spl = []
+    for s in range(1, n_shards):
+        spl.append(_part_user_key(part, part.n * s // n_shards))
+    return sorted(set(spl))
+
+
+def _part_bounds(part, splitters: list[bytes]) -> list[int]:
+    """Row bounds [0, b1, ..., n] for one sorted part: b_s = first row whose
+    user key >= splitters[s-1] (all copies of a user key land in ONE shard)."""
+    b = [0]
+    for spl in splitters:
+        lo, hi = b[-1], part.n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if _part_user_key(part, mid) < spl:
+                lo = mid + 1
+            else:
+                hi = mid
+        b.append(lo)
+    b.append(part.n)
+    return b
+
+
+def _device_shards() -> int:
+    # Default 1: on tunneled dev rigs the per-shard download latency beats
+    # the transfer/compute overlap. Raise on real PCIe-attached hosts.
+    try:
+        return max(1, int(os.environ.get("TPULSM_DEVICE_SHARDS", "1")))
+    except ValueError:
+        return 1
+
+
+# Below this row count a job runs as one shard: the pipeline's transfer/
+# compute overlap cannot recoup the extra per-shard dispatch latency.
+_SHARD_MIN_ROWS = 1 << 18
+
+
+def _collect_raw_columnar(compaction, table_cache, icmp, want_uploads=False):
+    """Scan every input file into columnar buffers. With want_uploads, ALSO
+    split each sorted part into user-key-range shards and start the async
+    host→device chunk transfers as each file is scanned — host IO, the
+    link, and (later) the per-shard device programs all overlap. Returns
+    (kv, rd, shards) where shards is None when the chunked device path
+    does not apply (tombstones present, sparse layout, oversized keys);
+    otherwise shards[s] = (handles, row_ranges) with row_ranges the
+    (global_lo, global_hi) row spans into the concatenated kv that each
+    handle covers, in handle order."""
     from toplingdb_tpu.ops.columnar_io import ColumnarKV, scan_table_columnar
+    from toplingdb_tpu.utils.status import NotSupported
 
     parts = []
+    upload_ok = want_uploads
+    splitters = None
+    shards = None
+    mode = None
+    uniform_len = 0
+    row_base = 0
     rd = RangeDelAggregator(icmp.user_comparator)
     for _, f in compaction.all_inputs():
         r = table_cache.get_reader(f.number)
-        parts.append(scan_table_columnar(r))
+        part = scan_table_columnar(r)
         for b, e in r.range_del_entries():
             rd.add(RangeTombstone.from_table_entry(b, e))
-    return ColumnarKV.concat(parts), rd
+        if upload_ok and part.n:
+            # Full density validation (same precondition fused_encode_sort_gc
+            # enforces): the device derives offsets as a cumsum of lengths,
+            # so EVERY interior offset must match, not just the endpoints.
+            dense = (
+                int(part.key_offs[0]) == 0
+                and int(part.key_offs[-1]) + int(part.key_lens[-1])
+                == len(part.key_buf)
+                and np.array_equal(
+                    part.key_offs[1:],
+                    (np.cumsum(part.key_lens) - part.key_lens)[1:],
+                )
+            )
+            if dense and rd.empty():
+                if mode is None:
+                    # The first non-empty part picks the transfer mode:
+                    # uniform key length ships trailer-stripped bytes +
+                    # one uint32 per entry (half the generic upload).
+                    L = int(part.key_lens[0])
+                    if (part.key_lens.min() == part.key_lens.max()
+                            and len(part.key_buf) == part.n * L):
+                        mode, uniform_len = "uniform", L
+                    else:
+                        mode = "generic"
+                if mode == "uniform" and not (
+                        part.key_lens.min() == part.key_lens.max()
+                        == uniform_len
+                        and len(part.key_buf) == part.n * uniform_len):
+                    upload_ok = False
+                if upload_ok and splitters is None:
+                    # Range splitters come from the first non-empty part;
+                    # later parts are assumed similarly distributed (skew
+                    # only costs balance, never correctness).
+                    n_shards = (
+                        _device_shards() if part.n >= _SHARD_MIN_ROWS else 1
+                    )
+                    splitters = _shard_splitters(part, n_shards)
+                    shards = [([], []) for _ in range(len(splitters) + 1)]
+                if upload_ok:
+                    try:
+                        bounds = _part_bounds(part, splitters)
+                        for s in range(len(bounds) - 1):
+                            lo, hi = bounds[s], bounds[s + 1]
+                            if lo == hi:
+                                continue
+                            blo = int(part.key_offs[lo])
+                            bhi = int(part.key_offs[hi - 1]) + int(
+                                part.key_lens[hi - 1]
+                            )
+                            if mode == "uniform":
+                                h = ck.begin_uniform_chunk_upload(
+                                    part.key_buf[blo:bhi], hi - lo,
+                                    uniform_len,
+                                )
+                            else:
+                                h = ck.begin_chunk_upload(
+                                    part.key_buf[blo:bhi],
+                                    part.key_lens[lo:hi],
+                                )
+                            shards[s][0].append(h)
+                            shards[s][1].append((row_base + lo, row_base + hi))
+                    except NotSupported:
+                        upload_ok = False
+            else:
+                upload_ok = False
+        parts.append(part)
+        row_base += part.n
+    if not upload_ok or not rd.empty() or shards is None:
+        shards = None
+    else:
+        shards = [sh for sh in shards if sh[0]]
+    return ColumnarKV.concat(parts), rd, (shards, mode)
 
 
 def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
@@ -286,7 +427,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()
     try:
-        kv, rd = _collect_raw_columnar(compaction, table_cache, icmp)
+        kv, rd, (shards, shard_mode) = _collect_raw_columnar(
+            compaction, table_cache, icmp, want_uploads=not _host_sort(),
+        )
     except NotSupported:
         raise _FallbackToEntries()  # >2GiB columnar buffers etc.
     stats.input_records = kv.n
@@ -311,6 +454,42 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                         snapshots, compaction.bottommost,
                     )
                 col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
+            elif shards is not None:
+                # Per-file per-shard chunks already streaming to the device
+                # since scan time. Dispatch every shard's program up front
+                # (the device pipelines them: shard s+1 computes while
+                # shard s downloads), overlap the host trailer decode, then
+                # stitch shard-local survivor orders back to global rows.
+                if shard_mode == "uniform":
+                    pendings = [
+                        ck.fused_uniform_start(
+                            h, snapshots, compaction.bottommost,
+                        )
+                        for h, _ in shards
+                    ]
+                else:
+                    pendings = [
+                        ck.fused_chunks_start(
+                            h, snapshots, compaction.bottommost, mkb,
+                        )
+                        for h, _ in shards
+                    ]
+                col = _kv_seq_vtype(kv)
+                has_complex = False
+                parts_o, parts_z = [], []
+                for (h, ranges), pending in zip(shards, pendings):
+                    o, z, hc = ck.fused_chunks_finish(pending)
+                    has_complex |= hc
+                    lmap = np.concatenate([
+                        np.arange(lo, hi, dtype=np.int32)
+                        for lo, hi in ranges
+                    ]) if ranges else np.empty(0, np.int32)
+                    parts_o.append(lmap[o])
+                    parts_z.append(z)
+                order = (np.concatenate(parts_o) if parts_o
+                         else np.empty(0, np.int32))
+                zero_flags = (np.concatenate(parts_z) if parts_z
+                              else np.empty(0, bool))
             else:
                 order, zero_flags, has_complex = ck.fused_encode_sort_gc(
                     kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
