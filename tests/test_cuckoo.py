@@ -1,0 +1,250 @@
+"""CuckooTable format: 2-probe point lookups, displacement build, adaptive
+dispatch, DB read-path integration (reference table/cuckoo/)."""
+
+import random
+
+import pytest
+
+from toplingdb_tpu.db.dbformat import (
+    BYTEWISE, InternalKeyComparator, ValueType, make_internal_key,
+)
+from toplingdb_tpu.env import MemEnv
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.table.cuckoo import (
+    CuckooTableBuilder,
+    CuckooTableReader,
+    _bucket_pair,
+)
+from toplingdb_tpu.table.factory import new_table_builder, open_table
+from toplingdb_tpu.utils.status import NotSupported
+
+ICMP = InternalKeyComparator(BYTEWISE)
+
+
+def build_cuckoo(env, path, keys, opts=None):
+    opts = opts or TableOptions(format="cuckoo")
+    w = env.new_writable_file(path)
+    b = new_table_builder(w, ICMP, opts)
+    assert isinstance(b, CuckooTableBuilder)
+    entries = []
+    for i, uk in enumerate(sorted(keys)):
+        ik = make_internal_key(uk, i + 1, ValueType.VALUE)
+        v = b"v-" + uk
+        b.add(ik, v)
+        entries.append((ik, v))
+    props = b.finish()
+    w.close()
+    return entries, props
+
+
+def test_cuckoo_roundtrip_probe_and_dispatch():
+    env = MemEnv()
+    keys = [b"key%05d" % i for i in range(500)]
+    entries, props = build_cuckoo(env, "/c.sst", keys)
+    r = open_table(env.new_random_access_file("/c.sst"), ICMP)
+    assert isinstance(r, CuckooTableReader)  # adaptive magic dispatch
+    assert r.has_hash_index
+    assert r.properties.num_entries == 500
+    # Every present key resolves through at most two buckets.
+    for ik, v in entries:
+        i = r.hash_probe(ik[:-8])
+        assert i is not None and r._entry(i) == (ik, v)
+    # Absent keys are definitively rejected.
+    for uk in (b"nope", b"key99999", b""):
+        assert r.hash_probe(uk) is None
+    # Ordered iteration comes from the sorted data region.
+    it = r.new_iterator()
+    it.seek_to_first()
+    assert list(it.entries()) == entries
+
+
+def test_cuckoo_displacement_stress():
+    """Random keys at high load force displacement chains (and possibly
+    growth); every key must still resolve."""
+    env = MemEnv()
+    rng = random.Random(42)
+    keys = list({b"k%016x" % rng.getrandbits(60) for _ in range(4000)})
+    entries, _ = build_cuckoo(env, "/big.sst", keys)
+    r = open_table(env.new_random_access_file("/big.sst"), ICMP)
+    for ik, v in entries:
+        i = r.hash_probe(ik[:-8])
+        assert i is not None and r._entry(i)[1] == v
+    # The index holds every key in one of its two candidate buckets.
+    mask = len(r._buckets) - 1
+    for ik, _ in entries:
+        b1, b2 = _bucket_pair(ik[:-8], mask)
+        ordinals = {int(r._buckets[b1]) - 1, int(r._buckets[b2]) - 1}
+        assert r._lower_bound(ik) in ordinals
+
+
+def test_cuckoo_rejects_duplicates_and_range_dels():
+    env = MemEnv()
+    w = env.new_writable_file("/dup.sst")
+    b = new_table_builder(w, ICMP, TableOptions(format="cuckoo"))
+    b.add(make_internal_key(b"aaa", 5, ValueType.VALUE), b"v1")
+    with pytest.raises(NotSupported):
+        b.add(make_internal_key(b"aaa", 3, ValueType.VALUE), b"v0")
+    with pytest.raises(NotSupported):
+        b.add_tombstone(
+            make_internal_key(b"b", 9, ValueType.RANGE_DELETION), b"c"
+        )
+
+
+def test_cuckoo_compaction_output_and_db_get(tmp_path):
+    """A bottommost compaction can emit cuckoo files (unique user keys after
+    GC), and the DB read path probes them through the adaptive factory."""
+    from toplingdb_tpu.compaction.compaction_job import run_compaction_to_tables
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db.table_cache import TableCache
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.table.builder import TableBuilder
+    import toplingdb_tpu.db.filename as fn
+
+    env = default_env()
+    dbdir = str(tmp_path)
+    block_opts = TableOptions(block_size=512)
+    metas = []
+    seq = 1
+    rng = random.Random(3)
+    for fnum in (61, 62):
+        entries = []
+        for _ in range(200):
+            k = b"key%04d" % rng.randrange(250)
+            entries.append(
+                (make_internal_key(k, seq, ValueType.VALUE), b"val%05d" % seq)
+            )
+            seq += 1
+        entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))
+        w = env.new_writable_file(fn.table_file_name(dbdir, fnum))
+        b = TableBuilder(w, ICMP, block_opts)
+        last = None
+        for k, v in entries:
+            if last == k:
+                continue
+            b.add(k, v)
+            last = k
+        props = b.finish()
+        w.close()
+        metas.append(FileMetaData(
+            number=fnum,
+            file_size=env.get_file_size(fn.table_file_name(dbdir, fnum)),
+            smallest=b.smallest_key, largest=b.largest_key,
+            smallest_seqno=props.smallest_seqno,
+            largest_seqno=props.largest_seqno,
+        ))
+    tc = TableCache(env, dbdir, ICMP, block_opts)
+    c = Compaction(level=0, output_level=2, inputs=metas, bottommost=True,
+                   max_output_file_size=1 << 62)
+    cnt = [100]
+
+    def alloc():
+        cnt[0] += 1
+        return cnt[0]
+
+    outs, _ = run_compaction_to_tables(
+        env, dbdir, ICMP, c, tc, TableOptions(format="cuckoo"), [],
+        new_file_number=alloc, creation_time=1,
+    )
+    assert outs
+    r = open_table(
+        env.new_random_access_file(fn.table_file_name(dbdir, outs[0].number)),
+        ICMP,
+    )
+    assert isinstance(r, CuckooTableReader)
+    it = r.new_iterator()
+    it.seek_to_first()
+    got = list(it.entries())
+    # bottommost GC: one version per user key, seqs zeroed
+    uks = [k[:-8] for k, _ in got]
+    assert len(set(uks)) == len(uks) == r.properties.num_entries > 0
+    for ik, v in got:
+        assert r.hash_probe(ik[:-8]) is not None
+
+
+def test_cuckoo_empty_table_and_fail_fast():
+    env = MemEnv()
+    # Empty table: writable AND readable (valid empty index).
+    w = env.new_writable_file("/e.sst")
+    b = new_table_builder(w, ICMP, TableOptions(format="cuckoo"))
+    b.finish()
+    w.close()
+    r = open_table(env.new_random_access_file("/e.sst"), ICMP)
+    assert isinstance(r, CuckooTableReader)
+    assert r.hash_probe(b"anything") is None
+    it = r.new_iterator()
+    it.seek_to_first()
+    assert not it.valid()
+    # Non-bytewise comparator: refused at construction, before any bytes.
+    from toplingdb_tpu.db.dbformat import Comparator
+
+    class Rev(Comparator):
+        def name(self):
+            return "test.reverse"
+
+        def compare(self, a, b):
+            return (a < b) - (a > b)
+
+    w2 = env.new_writable_file("/r.sst")
+    with pytest.raises(NotSupported):
+        new_table_builder(w2, InternalKeyComparator(Rev()),
+                          TableOptions(format="cuckoo"))
+
+
+def test_cuckoo_failed_job_leaves_no_orphans(tmp_path):
+    """A mid-stream NotSupported (duplicate user keys survive under a
+    snapshot) must fail the compaction WITHOUT leaving partial outputs."""
+    from toplingdb_tpu.compaction.compaction_job import run_compaction_to_tables
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db.table_cache import TableCache
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.table.builder import TableBuilder
+    import os
+    import toplingdb_tpu.db.filename as fn
+
+    env = default_env()
+    dbdir = str(tmp_path)
+    block_opts = TableOptions(block_size=512)
+    metas = []
+    seq = 1
+    for fnum in (71, 72):
+        entries = []
+        for i in range(100):
+            entries.append((
+                make_internal_key(b"key%04d" % i, seq, ValueType.VALUE),
+                b"val%05d" % seq,
+            ))
+            seq += 1
+        entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))
+        w = env.new_writable_file(fn.table_file_name(dbdir, fnum))
+        b = TableBuilder(w, ICMP, block_opts)
+        for k, v in entries:
+            b.add(k, v)
+        props = b.finish()
+        w.close()
+        metas.append(FileMetaData(
+            number=fnum,
+            file_size=env.get_file_size(fn.table_file_name(dbdir, fnum)),
+            smallest=b.smallest_key, largest=b.largest_key,
+            smallest_seqno=props.smallest_seqno,
+            largest_seqno=props.largest_seqno,
+        ))
+    tc = TableCache(env, dbdir, ICMP, block_opts)
+    c = Compaction(level=0, output_level=2, inputs=metas, bottommost=True,
+                   max_output_file_size=4096)  # several outputs
+    cnt = [300]
+
+    def alloc():
+        cnt[0] += 1
+        return cnt[0]
+
+    before = set(os.listdir(dbdir))
+    with pytest.raises(NotSupported):
+        # snapshot 150 keeps two versions of early keys → duplicate user
+        # keys reach the cuckoo builder mid-stream.
+        run_compaction_to_tables(
+            env, dbdir, ICMP, c, tc, TableOptions(format="cuckoo"), [150],
+            new_file_number=alloc, creation_time=1,
+        )
+    assert set(os.listdir(dbdir)) == before, "orphan outputs left behind"

@@ -220,30 +220,50 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
         wfile = None
 
     last_user_key = None
-    for ikey, value in entries_iter:
-        if builder is None:
+    try:
+        for ikey, value in entries_iter:
+            if builder is None:
+                open_output()
+            uk = dbformat.extract_user_key(ikey)
+            if (builder.file_size() >= compaction.max_output_file_size
+                    and last_user_key is not None
+                    and not surviving_tombstones
+                    and icmp.user_comparator.compare(uk, last_user_key) != 0):
+                # Cut outputs only at user-key boundaries (all versions of a
+                # key stay in one file, reference
+                # CompactionOutputs::ShouldStopBefore). When range tombstones
+                # survive, a single output is produced: add_tombstone widens
+                # file bounds to the tombstone span, and splitting would make
+                # sibling outputs overlap at L1+ (proper per-file tombstone
+                # partitioning is a later-round refinement).
+                close_output([])
+                open_output()
+            builder.add(ikey, value)
+            if ikey[-8] == dbformat.ValueType.BLOB_INDEX:
+                blob_refs.add(decode_blob_index(value)[0])
+            stats.output_records += 1
+            last_user_key = uk
+        if surviving_tombstones and builder is None:
             open_output()
-        uk = dbformat.extract_user_key(ikey)
-        if (builder.file_size() >= compaction.max_output_file_size
-                and last_user_key is not None
-                and not surviving_tombstones
-                and icmp.user_comparator.compare(uk, last_user_key) != 0):
-            # Cut outputs only at user-key boundaries (all versions of a key
-            # stay in one file, reference CompactionOutputs::ShouldStopBefore).
-            # When range tombstones survive, a single output is produced:
-            # add_tombstone widens file bounds to the tombstone span, and
-            # splitting would make sibling outputs overlap at L1+ (proper
-            # per-file tombstone partitioning is a later-round refinement).
-            close_output([])
-            open_output()
-        builder.add(ikey, value)
-        if ikey[-8] == dbformat.ValueType.BLOB_INDEX:
-            blob_refs.add(decode_blob_index(value)[0])
-        stats.output_records += 1
-        last_user_key = uk
-    if surviving_tombstones and builder is None:
-        open_output()
-    close_output(surviving_tombstones)
+        close_output(surviving_tombstones)
+    except BaseException:
+        # Failed job: no partial or completed output may survive (the
+        # reference's CompactionJob cleanup contract) — e.g. a mid-stream
+        # NotSupported from a restrictive format (cuckoo duplicate user
+        # key) must not leave orphan SSTs.
+        if wfile is not None:
+            wfile.close()
+        for m in outputs:
+            try:
+                env.delete_file(filename.table_file_name(dbname, m.number))
+            except Exception:
+                pass
+        if fnum is not None and builder is not None:
+            try:
+                env.delete_file(filename.table_file_name(dbname, fnum))
+            except Exception:
+                pass
+        raise
     return outputs
 
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from toplingdb_tpu.table import format as fmt
 from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+from toplingdb_tpu.table.cuckoo import CuckooTableBuilder, CuckooTableReader
 from toplingdb_tpu.table.reader import TableReader
 from toplingdb_tpu.table.single_fast import (
     SingleFastTableBuilder,
@@ -18,7 +19,7 @@ from toplingdb_tpu.table.single_fast import (
 )
 from toplingdb_tpu.utils.status import Corruption, InvalidArgument
 
-FORMATS = ("block", "single_fast")
+FORMATS = ("block", "single_fast", "cuckoo")
 
 
 def new_table_builder(wfile, icmp, options: TableOptions | None = None,
@@ -34,6 +35,8 @@ def new_table_builder(wfile, icmp, options: TableOptions | None = None,
         return TableBuilder(wfile, icmp, options, **kw)
     if f == "single_fast":
         return SingleFastTableBuilder(wfile, icmp, options, **kw)
+    if f == "cuckoo":
+        return CuckooTableBuilder(wfile, icmp, options, **kw)
     raise InvalidArgument(f"unknown table format {f!r}")
 
 
@@ -48,4 +51,6 @@ def open_table(rfile, icmp, options: TableOptions | None = None,
                            cache_key_prefix=cache_key_prefix)
     if magic == fmt.SINGLE_FAST_MAGIC:
         return SingleFastTableReader(rfile, icmp, options)
+    if magic == fmt.CUCKOO_MAGIC:
+        return CuckooTableReader(rfile, icmp, options)
     raise Corruption(f"unknown SST magic {magic:#x}")

@@ -37,6 +37,8 @@ METAINDEX_HASH_INDEX = b"tpulsm.sf.hash_index"
 class SingleFastTableBuilder:
     """Same surface as TableBuilder (build_outputs/flush compatible)."""
 
+    FOOTER_MAGIC = fmt.SINGLE_FAST_MAGIC
+
     def __init__(self, wfile, icmp: InternalKeyComparator,
                  options: TableOptions | None = None,
                  column_family_id: int = 0, column_family_name: str = "",
@@ -148,6 +150,43 @@ class SingleFastTableBuilder:
         if self._largest is None or self._icmp.compare(end_ikey, self._largest) > 0:
             self._largest = end_ikey
 
+    def _entry_user_key(self, i: int) -> bytes:
+        off = self._offsets[i]
+        klen, o = coding.decode_varint32(self._buf, off)
+        _, o = coding.decode_varint32(self._buf, o)
+        return bytes(self._buf[o: o + klen - 8])
+
+    def _hash_index_block(self) -> tuple[bytes, bytes] | None:
+        """(metaindex name, raw block bytes) of the point-lookup index, or
+        None. Subclass hook — the cuckoo format swaps in its own table."""
+        if not (self.opts.hash_index and self._offsets
+                and self._icmp.user_comparator.name()
+                == dbformat.BYTEWISE.name()):
+            # Bytewise comparator only: the hash dedups/matches by BYTE
+            # equality, which must coincide with comparator equality.
+            return None
+        # O(1) point-lookup bucket array (the PlainTable prefix-hash role,
+        # reference table/plain/): open-addressed xxh64 buckets at <=0.7
+        # load, each holding 1 + the ordinal of the NEWEST version of one
+        # user key.
+        n = len(self._offsets)
+        nb = 1
+        while nb < (n * 10) // 7 + 1:
+            nb <<= 1
+        buckets = np.zeros(nb, dtype="<u4")
+        mask = nb - 1
+        prev_uk = None
+        for i in range(n):
+            uk = self._entry_user_key(i)
+            if uk == prev_uk:
+                continue  # hash maps to the first (newest) version
+            prev_uk = uk
+            h = crc32c.xxh64(uk) & mask
+            while buckets[h]:
+                h = (h + 1) & mask
+            buckets[h] = i + 1
+        return METAINDEX_HASH_INDEX, buckets.tobytes()
+
     def finish(self) -> TableProperties:
         assert not self._finished
         if self.opts.auto_sort and self._unsorted:
@@ -185,36 +224,11 @@ class SingleFastTableBuilder:
             fh = fmt.write_block(self._w, fdata, fmt.NO_COMPRESSION)
             self.props.filter_size = len(fdata)
             meta_entries.append((METAINDEX_FILTER, fh))
-        if (self.opts.hash_index and self._offsets
-                and self._icmp.user_comparator.name()
-                == dbformat.BYTEWISE.name()):
-            # Bytewise comparator only: the hash dedups/matches by BYTE
-            # equality, which must coincide with comparator equality.
-            # O(1) point-lookup bucket array (the CuckooTable / PlainTable
-            # prefix-hash role, reference table/cuckoo/ + table/plain/):
-            # open-addressed xxh64 buckets at <=0.7 load, each holding
-            # 1 + the ordinal of the NEWEST version of one user key.
-            n = len(self._offsets)
-            nb = 1
-            while nb < (n * 10) // 7 + 1:
-                nb <<= 1
-            buckets = np.zeros(nb, dtype="<u4")
-            mask = nb - 1
-            prev_uk = None
-            for i, off in enumerate(self._offsets):
-                klen, o = coding.decode_varint32(self._buf, off)
-                _, o = coding.decode_varint32(self._buf, o)
-                uk = bytes(self._buf[o : o + klen - 8])
-                if uk == prev_uk:
-                    continue  # hash maps to the first (newest) version
-                prev_uk = uk
-                h = crc32c.xxh64(uk) & mask
-                while buckets[h]:
-                    h = (h + 1) & mask
-                buckets[h] = i + 1
-            hh = fmt.write_block(self._w, buckets.tobytes(),
-                                 fmt.NO_COMPRESSION)
-            meta_entries.append((METAINDEX_HASH_INDEX, hh))
+        hash_block = self._hash_index_block()
+        if hash_block is not None:
+            name, hdata = hash_block
+            hh = fmt.write_block(self._w, hdata, fmt.NO_COMPRESSION)
+            meta_entries.append((name, hh))
         if not self._range_del_block.empty():
             rh = fmt.write_block(self._w, self._range_del_block.finish(),
                                  fmt.NO_COMPRESSION)
@@ -231,7 +245,7 @@ class SingleFastTableBuilder:
             metaindex.add(name, handle.encode())
         mih = fmt.write_block(self._w, metaindex.finish(), fmt.NO_COMPRESSION)
         ih = fmt.write_block(self._w, iraw, fmt.NO_COMPRESSION)
-        self._w.append(fmt.Footer(mih, ih, magic=fmt.SINGLE_FAST_MAGIC).encode())
+        self._w.append(fmt.Footer(mih, ih, magic=self.FOOTER_MAGIC).encode())
         self._w.flush()
         self._finished = True
         return self.props
@@ -239,6 +253,8 @@ class SingleFastTableBuilder:
 
 class SingleFastTableReader:
     """Same surface as TableReader. The whole file is resident in memory."""
+
+    FOOTER_MAGIC = fmt.SINGLE_FAST_MAGIC
 
     def __init__(self, rfile, icmp: InternalKeyComparator,
                  options: TableOptions | None = None, block_cache=None,
@@ -248,7 +264,7 @@ class SingleFastTableReader:
         size = rfile.size()
         self._data = rfile.read(0, size)
         rfile.close()
-        self.footer = fmt.Footer.decode(self._data, fmt.SINGLE_FAST_MAGIC)
+        self.footer = fmt.Footer.decode(self._data, self.FOOTER_MAGIC)
         iraw = fmt.read_block(_Mem(self._data), self.footer.index_handle,
                               self.opts.verify_checksums)
         self._offsets = np.frombuffer(iraw, dtype="<u4")
@@ -290,6 +306,10 @@ class SingleFastTableReader:
             fmt.read_block(_Mem(self._data), rh, self.opts.verify_checksums)
             if rh is not None else None
         )
+        self.n = len(self._offsets)
+        self._load_hash_index()
+
+    def _load_hash_index(self) -> None:
         self._hash_buckets = None
         hh = self._meta_handles.get(METAINDEX_HASH_INDEX)
         if hh is not None:
@@ -299,7 +319,6 @@ class SingleFastTableReader:
                 dtype="<u4",
             )
         self.has_hash_index = self._hash_buckets is not None
-        self.n = len(self._offsets)
 
     # -- entry decode ---------------------------------------------------
 
