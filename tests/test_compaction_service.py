@@ -1,0 +1,115 @@
+"""Upstream CompactionService path: DB::OpenAndCompact analogue + the
+DB-side executor (reference db/compaction/compaction_service_test.cc)."""
+
+import json
+import os
+
+import pytest
+
+from toplingdb_tpu.compaction.compaction_service import (
+    CompactionServiceExecutorFactory,
+    CompactionServiceInput,
+    CompactionServiceResult,
+    InProcessCompactionService,
+    SubprocessCompactionService,
+    open_and_compact,
+)
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options
+
+
+def _fill_db(path, n=3000, overwrite=2000):
+    o = Options(write_buffer_size=1 << 14, disable_auto_compactions=True)
+    db = DB.open(path, o)
+    for i in range(n):
+        db.put(b"key%05d" % (i % overwrite), b"val%06d" % i)
+    db.delete(b"key00007")
+    db.flush()
+    return db
+
+
+def test_open_and_compact_worker_side(tmp_path):
+    dbp = str(tmp_path / "db")
+    outp = str(tmp_path / "out")
+    db = _fill_db(dbp)
+    version = db.versions.cf_current(0)
+    nums = [f.number for f in version.files[0]]
+    assert len(nums) >= 2
+    db.close()
+
+    inp = CompactionServiceInput(
+        cf_name="default", input_files=nums, output_level=2,
+        bottommost=True, snapshots=[], max_output_file_size=1 << 62,
+    )
+    res = CompactionServiceResult.from_json(
+        open_and_compact(dbp, outp, inp.to_json())
+    )
+    assert res.status == "ok", res.status
+    assert res.output_files and res.bytes_written > 0
+    # Outputs exist in output_dir only; the source DB dir is untouched.
+    for d in res.output_files:
+        assert os.path.exists(os.path.join(outp, d["path"]))
+    assert not any(f.startswith("service") for f in os.listdir(dbp))
+
+    # Unknown input file -> in-band error, not an exception.
+    bad = CompactionServiceInput(
+        cf_name="default", input_files=[999999], output_level=2,
+        bottommost=True, snapshots=[], max_output_file_size=1 << 62,
+    )
+    res2 = CompactionServiceResult.from_json(
+        open_and_compact(dbp, outp, bad.to_json())
+    )
+    assert res2.status != "ok" and "999999" in res2.status
+    # Unknown CF -> in-band error.
+    res3 = CompactionServiceResult.from_json(
+        open_and_compact(dbp, outp, CompactionServiceInput(
+            cf_name="nope", input_files=nums, output_level=2,
+            bottommost=True, snapshots=[], max_output_file_size=1 << 62,
+        ).to_json())
+    )
+    assert res3.status != "ok"
+
+
+def test_service_executor_end_to_end(tmp_path):
+    """DB routes its compaction through the service executor; results are
+    installed under DB-allocated numbers and reads see compacted data."""
+    dbp = str(tmp_path / "db")
+    svc = InProcessCompactionService()
+    db = _fill_db(dbp)
+    db.close()
+
+    o = Options(
+        disable_auto_compactions=True,
+        compaction_executor_factory=CompactionServiceExecutorFactory(svc),
+    )
+    db = DB.open(dbp, o)
+    db.compact_range()
+    assert svc.jobs >= 1
+    assert db.get(b"key00007") is None
+    assert db.get(b"key00008") is not None
+    assert db.get(b"key01999") == b"val%06d" % 1999
+    # All data now below L0.
+    version = db.versions.cf_current(0)
+    assert not version.files[0]
+    db.close()
+    # Reopen cleanly (MANIFEST installed the service outputs).
+    db = DB.open(dbp, Options())
+    assert db.get(b"key00008") is not None
+    db.close()
+
+
+def test_service_subprocess_transport(tmp_path):
+    dbp = str(tmp_path / "db")
+    db = _fill_db(dbp, n=800, overwrite=500)
+    version = db.versions.cf_current(0)
+    nums = [f.number for f in version.files[0]]
+    db.close()
+    outp = str(tmp_path / "out")
+    res = CompactionServiceResult.from_json(SubprocessCompactionService()(
+        dbp, outp, CompactionServiceInput(
+            cf_name="default", input_files=nums, output_level=2,
+            bottommost=True, snapshots=[], max_output_file_size=1 << 62,
+        ).to_json()
+    ))
+    assert res.status == "ok", res.status
+    assert res.output_files

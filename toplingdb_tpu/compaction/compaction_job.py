@@ -252,13 +252,20 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
         # NotSupported from a restrictive format (cuckoo duplicate user
         # key) must not leave orphan SSTs.
         if wfile is not None:
-            wfile.close()
+            try:
+                wfile.close()
+            except Exception:
+                pass
         for m in outputs:
             try:
                 env.delete_file(filename.table_file_name(dbname, m.number))
             except Exception:
                 pass
-        if fnum is not None and builder is not None:
+        # fnum may name an output whose builder never constructed (the
+        # ctor raised) — the file exists, so delete unconditionally; a
+        # stale fnum from a completed output is already gone above and the
+        # double delete is swallowed.
+        if fnum is not None:
             try:
                 env.delete_file(filename.table_file_name(dbname, fnum))
             except Exception:

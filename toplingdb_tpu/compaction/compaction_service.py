@@ -1,0 +1,338 @@
+"""Upstream-style remote compaction: the CompactionService path.
+
+The reference ships TWO remote-compaction mechanisms: Topling's dcompact
+(CompactionExecutor plugin + job dirs — ours lives in
+compaction/executor.py + compaction/worker.py) and Meta's upstream
+CompactionService (include/rocksdb/options.h:436: a plugin receives one
+serialized per-subcompaction job; the worker side calls
+DB::OpenAndCompact(name, output_dir, input, &output) —
+include/rocksdb/db.h:320-325, db/compaction/compaction_service_job.cc).
+
+This module is the upstream-shaped half:
+
+  open_and_compact(dbname, output_dir, input_json)  worker side — opens the
+      source DB READ-ONLY from shared storage (MANIFEST recovery only, no
+      WAL ownership), resolves the job's input files out of the live
+      Version, runs the shared compaction data plane, writes outputs to
+      output_dir and returns the serialized result.
+  CompactionServiceExecutorFactory  DB side — plugs the service into the
+      SAME executor seam the scheduler already routes through
+      (compaction/executor.py), so service jobs get fallback-to-local and
+      stats merge-back for free. The transport is a pluggable callable:
+      in-process (tests), subprocess (process isolation), or anything
+      HTTP-shaped.
+
+Options (comparator, merge operator, table format) are NOT shipped in the
+job: the worker loads them from the DB's persisted OPTIONS file, the same
+way the reference worker gets them from the options file named in
+CompactionServiceInput.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+from toplingdb_tpu.compaction.compaction_job import (
+    CompactionStats,
+    run_compaction_to_tables,
+)
+from toplingdb_tpu.compaction.executor import (
+    CompactionExecutor,
+    CompactionExecutorFactory,
+    decode_file_meta,
+    encode_file_meta,
+)
+from toplingdb_tpu.compaction.picker import Compaction
+from toplingdb_tpu.db import filename
+from toplingdb_tpu.utils.status import Corruption, InvalidArgument
+
+
+@dataclasses.dataclass
+class CompactionServiceInput:
+    """One job, serialized DB→worker (reference CompactionServiceInput,
+    options.h / compaction_service_job.cc)."""
+
+    cf_name: str
+    input_files: list[int]           # file NUMBERS, resolved via the Version
+    output_level: int
+    bottommost: bool
+    snapshots: list[int]
+    max_output_file_size: int
+    creation_time: int = 0
+    device: str = "cpu"
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "CompactionServiceInput":
+        return CompactionServiceInput(**json.loads(s))
+
+
+@dataclasses.dataclass
+class CompactionServiceResult:
+    """Worker→DB result (reference CompactionServiceResult)."""
+
+    status: str                      # "ok" | error text
+    output_files: list[dict]         # encode_file_meta dicts, paths relative
+    stats: dict = dataclasses.field(default_factory=dict)
+    bytes_read: int = 0
+    bytes_written: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "CompactionServiceResult":
+        return CompactionServiceResult(**json.loads(s))
+
+
+def open_and_compact(dbname: str, output_dir: str, input_json: str,
+                     env=None) -> str:
+    """Worker entry point (reference DB::OpenAndCompact,
+    include/rocksdb/db.h:320-325): one read-only open, one compaction,
+    outputs under output_dir named like table files. Never touches the
+    source DB dir. Returns CompactionServiceResult JSON (errors reported
+    in .status rather than raised, matching the RPC shape)."""
+    from toplingdb_tpu.db.db_readonly import ReadOnlyDB
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.utils.config import load_latest_options
+
+    env = env or default_env()
+    try:
+        inp = CompactionServiceInput.from_json(input_json)
+        # None (no OPTIONS file persisted) legitimately means defaults; a
+        # CORRUPT/unreadable OPTIONS file must fail the job in-band rather
+        # than silently compact with the wrong comparator/merge operator.
+        options = load_latest_options(dbname, env=env)
+        db = ReadOnlyDB.open(dbname, options, env=env)
+        try:
+            cfd = None
+            for c in db._cfs.values():
+                if c.handle.name == inp.cf_name:
+                    cfd = c
+                    break
+            if cfd is None:
+                raise InvalidArgument(f"no column family {inp.cf_name!r}")
+            version = db.versions.cf_current(cfd.handle.id)
+            by_number = {
+                f.number: f for level_files in version.files
+                for f in level_files
+            }
+            metas = []
+            for num in inp.input_files:
+                f = by_number.get(num)
+                if f is None:
+                    raise Corruption(
+                        f"input file {num} not in the current version "
+                        f"(compaction already superseded?)"
+                    )
+                metas.append(f)
+            compaction = Compaction(
+                level=0,  # per-file iterators: correct for any input mix
+                output_level=inp.output_level,
+                inputs=metas,
+                bottommost=inp.bottommost,
+                max_output_file_size=inp.max_output_file_size,
+            )
+            env.create_dir(output_dir)
+            counter = [0]
+
+            def alloc():
+                counter[0] += 1
+                return counter[0]
+
+            topts = db.options.table_options
+            outputs, stats = run_compaction_to_tables(
+                env, output_dir, db.icmp, compaction, db.table_cache,
+                topts, list(inp.snapshots),
+                merge_operator=db.options.merge_operator,
+                compaction_filter=getattr(
+                    db.options, "compaction_filter", None
+                ),
+                new_file_number=alloc,
+                creation_time=inp.creation_time or None,
+                column_family=(cfd.handle.id, cfd.handle.name),
+            )
+            files = [
+                encode_file_meta(
+                    m, os.path.basename(
+                        filename.table_file_name(output_dir, m.number)
+                    )
+                )
+                for m in outputs
+            ]
+            return CompactionServiceResult(
+                status="ok", output_files=files,
+                stats=dataclasses.asdict(stats),
+                bytes_read=stats.input_bytes,
+                bytes_written=stats.output_bytes,
+            ).to_json()
+        finally:
+            db.close()
+    except Exception as e:  # RPC shape: errors travel in-band
+        return CompactionServiceResult(
+            status=f"{type(e).__name__}: {e}", output_files=[],
+        ).to_json()
+
+
+class InProcessCompactionService:
+    """Transport: run the worker half in this process (reference
+    compaction_service_test.cc's MyTestCompactionService shape)."""
+
+    def __init__(self, env=None):
+        self._env = env
+        self.jobs = 0
+
+    def __call__(self, dbname: str, output_dir: str, input_json: str) -> str:
+        self.jobs += 1
+        return open_and_compact(dbname, output_dir, input_json,
+                                env=self._env)
+
+
+class SubprocessCompactionService:
+    """Transport: a fresh worker process per job (full isolation — the
+    reference's remote worker binary, minus the network)."""
+
+    def __call__(self, dbname: str, output_dir: str, input_json: str) -> str:
+        import toplingdb_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(toplingdb_tpu.__file__)
+        ))
+        p = subprocess.run(
+            [sys.executable, "-m",
+             "toplingdb_tpu.compaction.compaction_service",
+             dbname, output_dir],
+            input=input_json, capture_output=True, text=True, cwd=pkg_root,
+        )
+        if p.returncode != 0 or not p.stdout.strip():
+            return CompactionServiceResult(
+                status=f"worker process failed: {p.stderr[-500:]}",
+                output_files=[],
+            ).to_json()
+        return p.stdout.strip().splitlines()[-1]
+
+
+class CompactionServiceExecutor(CompactionExecutor):
+    """DB-side half: serialize → service → install (the role of
+    ProcessKeyValueCompactionWithCompactionService,
+    compaction_job.cc:1393-1402)."""
+
+    def __init__(self, service, job_root: str | None = None):
+        self._service = service
+        self._job_root = job_root
+        self._output_dir = None
+        self._env = None
+
+    _job_seq = [0]
+
+    def execute(self, db, compaction, snapshots, new_file_number):
+        env = self._env = db.env
+        root = self._job_root or os.path.join(db.dbname, "service_jobs")
+        env.create_dir(root)
+        # pid + process-global counter: unique under concurrent scheduler
+        # fan-out AND across worker processes sharing the job root.
+        CompactionServiceExecutor._job_seq[0] += 1
+        out_dir = self._output_dir = os.path.join(
+            root,
+            f"job-{os.getpid()}-{CompactionServiceExecutor._job_seq[0]:06d}",
+        )
+        cfd = getattr(compaction, "cfd", None)
+        cf_name = cfd.handle.name if cfd is not None else "default"
+        inp = CompactionServiceInput(
+            cf_name=cf_name,
+            input_files=[f.number for _, f in compaction.all_inputs()],
+            output_level=compaction.output_level,
+            bottommost=compaction.bottommost,
+            snapshots=list(snapshots),
+            max_output_file_size=compaction.max_output_file_size,
+            creation_time=int(time.time()),
+        )
+        t0 = time.time()
+        try:
+            res = CompactionServiceResult.from_json(
+                self._service(db.dbname, out_dir, inp.to_json())
+            )
+            if res.status != "ok":
+                raise Corruption(f"compaction service failed: {res.status}")
+            outputs = []
+            stats = CompactionStats(device="service")
+            for k, v in (res.stats or {}).items():
+                if hasattr(stats, k) and isinstance(v, (int, float)):
+                    setattr(stats, k, v)
+            # Install: move each output under a DB-allocated file number
+            # (reference RunRemote's RenameFile loop, compaction_job.cc:1019).
+            for d in res.output_files:
+                num = new_file_number()
+                src = os.path.join(out_dir, d["path"])
+                dst = filename.table_file_name(db.dbname, num)
+                env.rename_file(src, dst)
+                outputs.append(decode_file_meta(d, num))
+        except BaseException:
+            # Self-contained cleanup: the scheduler's fallback path does
+            # not call clean_files, and un-installed worker outputs must
+            # not accumulate under the DB dir.
+            self.clean_files()
+            raise
+        stats.rpc_time_usec = int((time.time() - t0) * 1e6)
+        stats.device = "service"
+        self.clean_files()  # emptied job dir
+        return outputs, stats
+
+    def clean_files(self):
+        if self._output_dir is not None and self._env is not None:
+            try:
+                for child in self._env.get_children(self._output_dir):
+                    self._env.delete_file(
+                        os.path.join(self._output_dir, child)
+                    )
+            except Exception:
+                pass
+            try:
+                os.rmdir(self._output_dir)  # best-effort for posix envs
+            except OSError:
+                pass
+
+
+class CompactionServiceExecutorFactory(CompactionExecutorFactory):
+    """ColumnFamilyOptions.compaction_service analogue, routed through the
+    standard executor seam so the scheduler's fallback-to-local and stats
+    merge-back apply."""
+
+    def __init__(self, service=None, allow_fallback: bool = True,
+                 job_root: str | None = None):
+        self._service = service or InProcessCompactionService()
+        self._allow_fallback = allow_fallback
+        self._job_root = job_root
+
+    def should_run_local(self, compaction: Compaction) -> bool:
+        return False
+
+    def allow_fallback_to_local(self) -> bool:
+        return self._allow_fallback
+
+    def new_executor(self, compaction: Compaction) -> CompactionExecutor:
+        return CompactionServiceExecutor(self._service, self._job_root)
+
+    def job_url(self, job_id: int, attempt: int) -> str:
+        return f"service://job-{job_id:05d}/att-{attempt:02d}"
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print("usage: python -m toplingdb_tpu.compaction.compaction_service "
+              "<dbname> <output_dir>  (input JSON on stdin)", file=sys.stderr)
+        return 2
+    print(open_and_compact(argv[0], argv[1], sys.stdin.read()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,16 +41,19 @@ METAINDEX_CUCKOO_INDEX = b"tpulsm.cuckoo.index"
 _MAX_KICKS = 500
 
 
-def _bucket_pair(user_key: bytes, mask: int) -> tuple[int, int]:
+def _bucket_pair_from_hash(h: int, mask: int) -> tuple[int, int]:
     """Two bucket candidates from one xxh64 (low/high halves). When both
     halves collide onto one bucket the alternate is the adjacent one so
     displacement always has somewhere to go."""
-    h = crc32c.xxh64(user_key)
     b1 = h & mask
     b2 = (h >> 32) & mask
     if b2 == b1:
         b2 = (b1 + 1) & mask
     return b1, b2
+
+
+def _bucket_pair(user_key: bytes, mask: int) -> tuple[int, int]:
+    return _bucket_pair_from_hash(crc32c.xxh64(user_key), mask)
 
 
 class CuckooTableBuilder(SingleFastTableBuilder):
@@ -85,25 +88,29 @@ class CuckooTableBuilder(SingleFastTableBuilder):
         if not self._offsets:
             return None
         n = len(self._offsets)
-        uks = [self._entry_user_key(i) for i in range(n)]
+        # Hash each key ONCE; displacement kicks and grow retries then cost
+        # two mask ops per step instead of a fresh xxh64.
+        hashes = [
+            crc32c.xxh64(self._entry_user_key(i)) for i in range(n)
+        ]
         # 2-choice single-slot cuckoo hashing is only reliably placeable
         # below ~0.5 load; sizing at >= 2n skips doomed placement passes.
         nb = 4
         while nb < 2 * n:
             nb <<= 1
         while True:
-            buckets = self._try_place(uks, nb)
+            buckets = self._try_place(hashes, nb)
             if buckets is not None:
                 return METAINDEX_CUCKOO_INDEX, buckets.tobytes()
             nb <<= 1
 
     @staticmethod
-    def _try_place(uks: list[bytes], nb: int) -> np.ndarray | None:
+    def _try_place(hashes: list[int], nb: int) -> np.ndarray | None:
         mask = nb - 1
         buckets = np.zeros(nb, dtype="<u4")  # ordinal + 1; 0 = empty
-        for i, uk in enumerate(uks):
+        for i, h in enumerate(hashes):
             cur = i
-            b1, b2 = _bucket_pair(uk, mask)
+            b1, b2 = _bucket_pair_from_hash(h, mask)
             pos = b1 if not buckets[b1] else b2
             for _ in range(_MAX_KICKS):
                 if not buckets[pos]:
@@ -112,7 +119,7 @@ class CuckooTableBuilder(SingleFastTableBuilder):
                 victim = int(buckets[pos]) - 1
                 buckets[pos] = cur + 1
                 cur = victim
-                v1, v2 = _bucket_pair(uks[cur], mask)
+                v1, v2 = _bucket_pair_from_hash(hashes[cur], mask)
                 pos = v2 if pos == v1 else v1
             else:
                 return None  # displacement cycle: grow
