@@ -1,0 +1,105 @@
+"""The trace-analysis CLI tools (reference trace_analyzer,
+io_tracer_parser, block_cache_analyzer binaries)."""
+
+import json
+import os
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options
+
+
+def test_trace_analyzer_tool(tmp_path, capsys):
+    from toplingdb_tpu.tools import trace_analyzer
+    from toplingdb_tpu.utils.trace import Tracer
+
+    dbp = str(tmp_path / "db")
+    trace = str(tmp_path / "trace.bin")
+    with DB.open(dbp, Options()) as db:
+        t = Tracer(db, trace)
+        for i in range(60):
+            t.put(b"key%03d" % (i % 20), b"v" * (i % 7 + 1))
+        for i in range(40):
+            t.get(b"key%03d" % (i % 10))
+        t.delete(b"key001")
+        t.close()
+
+    report = trace_analyzer.analyze(db.env, trace)
+    assert report["total_ops"] == 101
+    assert report["per_op"] == {"put": 60, "get": 40, "delete": 1}
+    assert report["unique_keys"] == 20
+    assert report["hottest_keys"][0]["count"] >= 7
+    assert report["key_size_dist"]["p50"] == 6
+    assert report["value_size_dist"]["max"] == 7
+
+    outdir = str(tmp_path / "out")
+    rc = trace_analyzer.main(
+        [trace, "--json", "--output-dir", outdir, "-k", "3"]
+    )
+    assert rc == 0
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["total_ops"] == 101 and len(printed["hottest_keys"]) == 3
+    files = sorted(os.listdir(outdir))
+    assert files == ["delete-key_counts.txt", "get-key_counts.txt",
+                     "put-key_counts.txt"]
+    first = open(os.path.join(outdir, "get-key_counts.txt")).readline().split()
+    assert int(first[1]) == 4  # hottest get key: 40 gets over 10 keys
+
+    # Human-readable mode exercises the non-json printer.
+    assert trace_analyzer.main([trace]) == 0
+    assert "hottest keys" in capsys.readouterr().out
+
+
+def test_io_tracer_parser_tool(tmp_path, capsys):
+    from toplingdb_tpu.env.io_tracer import IOTracer, IOTracingEnv
+    from toplingdb_tpu.env import PosixEnv
+    from toplingdb_tpu.tools import io_tracer_parser
+
+    trace = str(tmp_path / "io.jsonl")
+    tracer = IOTracer(trace)
+    env = IOTracingEnv(PosixEnv(), tracer)
+    f = env.new_writable_file(str(tmp_path / "a.bin"))
+    f.append(b"x" * 1000)
+    f.sync()
+    f.close()
+    r = env.new_random_access_file(str(tmp_path / "a.bin"))
+    r.read(0, 100)
+    r.read(500, 100)
+    tracer.close()
+
+    report = io_tracer_parser.parse(trace)
+    assert report["total_records"] >= 4
+    assert report["per_op"]["append"]["bytes"] == 1000
+    assert report["per_op"]["read"]["count"] == 2
+    assert any(p.endswith("a.bin") for p in report["per_file"])
+
+    assert io_tracer_parser.main([trace, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["total_records"] >= 4
+    assert io_tracer_parser.main([trace]) == 0
+    assert "top files by bytes" in capsys.readouterr().out
+
+
+def test_block_cache_analyzer_tool(tmp_path, capsys):
+    from toplingdb_tpu.tools import block_cache_analyzer
+    from toplingdb_tpu.utils.cache import BlockCacheTracer, LRUCache
+
+    trace = str(tmp_path / "bc.jsonl")
+    tracer = BlockCacheTracer(trace)
+    cache = LRUCache(1 << 20, tracer=tracer)
+    for rep in range(3):
+        for i in range(10):
+            k = b"block-%03d" % i
+            if cache.lookup(k) is None:
+                cache.insert(k, b"data" * 10, charge=40)
+    tracer.close()
+
+    report = block_cache_analyzer.analyze(trace)
+    assert report["accesses"] == 30
+    assert report["misses"] == 10 and report["hits"] == 20
+    assert abs(report["hit_ratio"] - 20 / 30) < 1e-4  # report rounds to 4dp
+    assert report["unique_blocks"] == 10
+    assert report["hottest_blocks"][0]["accesses"] == 3
+
+    assert block_cache_analyzer.main([trace, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["accesses"] == 30
+    assert block_cache_analyzer.main([trace, "-n", "2"]) == 0
+    assert "hit ratio" in capsys.readouterr().out

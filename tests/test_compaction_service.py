@@ -113,3 +113,32 @@ def test_service_subprocess_transport(tmp_path):
     ))
     assert res.status == "ok", res.status
     assert res.output_files
+
+
+def test_service_executor_non_default_cf(tmp_path):
+    """Jobs carry the real column family, not 'default' (the worker resolves
+    input numbers against that CF's version)."""
+    dbp = str(tmp_path / "db")
+    svc = InProcessCompactionService()
+    o = Options(write_buffer_size=1 << 14, disable_auto_compactions=True)
+    db = DB.open(dbp, o)
+    cf = db.create_column_family("meta")
+    for i in range(2000):
+        db.put(b"m%05d" % (i % 900), b"val%06d" % i, cf=cf)
+    db.flush()
+    db.close()
+
+    o2 = Options(
+        disable_auto_compactions=True,
+        compaction_executor_factory=CompactionServiceExecutorFactory(
+            svc, allow_fallback=False,  # a cf mix-up must FAIL, not fall back
+        ),
+    )
+    db = DB.open(dbp, o2)
+    cf = db.get_column_family("meta")
+    db.compact_range()  # covers every CF, incl. "meta"
+    assert svc.jobs >= 1
+    assert db.get(b"m00899", cf=cf) == b"val%06d" % 1799
+    version = db.versions.cf_current(cf.id)
+    assert not version.files[0]
+    db.close()

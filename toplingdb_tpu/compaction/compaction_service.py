@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import itertools
 import os
 import subprocess
 import sys
@@ -147,6 +148,9 @@ def open_and_compact(dbname: str, output_dir: str, input_json: str,
                 counter[0] += 1
                 return counter[0]
 
+            from toplingdb_tpu.db.blob import BlobSource
+
+            blob_source = BlobSource(env, dbname)
             topts = db.options.table_options
             outputs, stats = run_compaction_to_tables(
                 env, output_dir, db.icmp, compaction, db.table_cache,
@@ -157,6 +161,7 @@ def open_and_compact(dbname: str, output_dir: str, input_json: str,
                 ),
                 new_file_number=alloc,
                 creation_time=inp.creation_time or None,
+                blob_resolver=blob_source.get,
                 column_family=(cfd.handle.id, cfd.handle.name),
             )
             files = [
@@ -230,23 +235,46 @@ class CompactionServiceExecutor(CompactionExecutor):
         self._output_dir = None
         self._env = None
 
-    _job_seq = [0]
+    _job_seq = itertools.count(1)
 
     def execute(self, db, compaction, snapshots, new_file_number):
         env = self._env = db.env
         root = self._job_root or os.path.join(db.dbname, "service_jobs")
         env.create_dir(root)
-        # pid + process-global counter: unique under concurrent scheduler
+        # pid + process-global atomic counter (itertools.count next() is a
+        # single bytecode under the GIL): unique under concurrent scheduler
         # fan-out AND across worker processes sharing the job root.
-        CompactionServiceExecutor._job_seq[0] += 1
+        seq = next(CompactionServiceExecutor._job_seq)
         out_dir = self._output_dir = os.path.join(
-            root,
-            f"job-{os.getpid()}-{CompactionServiceExecutor._job_seq[0]:06d}",
+            root, f"job-{os.getpid()}-{seq:06d}",
         )
-        cfd = getattr(compaction, "cfd", None)
-        cf_name = cfd.handle.name if cfd is not None else "default"
+        # The worker reconstructs options from the persisted OPTIONS file,
+        # which can only carry REGISTERED plugin objects — an unregistered
+        # comparator/merge-operator/compaction-filter would silently compact
+        # with defaults. Raise here instead: the scheduler falls back to
+        # local, which has the live objects.
+        from toplingdb_tpu.utils.config import options_to_config
+
+        cfg = options_to_config(db.options)
+        opts = db.options
+        if opts.comparator.name() != "tpulsm.BytewiseComparator" and \
+                "comparator" not in cfg:
+            raise Corruption(
+                "unregistered comparator cannot travel the service boundary"
+            )
+        if opts.merge_operator is not None and "merge_operator" not in cfg:
+            raise Corruption(
+                "unregistered merge operator cannot travel the service "
+                "boundary"
+            )
+        if getattr(opts, "compaction_filter", None) is not None and \
+                "compaction_filter" not in cfg:
+            raise Corruption(
+                "unregistered compaction filter cannot travel the service "
+                "boundary"
+            )
         inp = CompactionServiceInput(
-            cf_name=cf_name,
+            cf_name=db.cf_name(compaction.cf_id),
             input_files=[f.number for _, f in compaction.all_inputs()],
             output_level=compaction.output_level,
             bottommost=compaction.bottommost,
