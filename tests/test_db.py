@@ -873,3 +873,52 @@ def test_get_live_files_and_wal_files(tmp_db_path):
     with DB.open(dst, opts(enable_blob_files=True, min_blob_size=64)) as db2:
         assert db2.get(b"k0100") == b"V" * 100
         assert db2.get(b"k0000") == b"V" * 10
+
+
+def test_error_handler_severity_taxonomy(tmp_path):
+    """Reference ErrorHandler severity mapping (db/error_handler.h:28):
+    SOFT keeps foreground writes alive, HARD blocks writes until resume(),
+    FATAL/UNRECOVERABLE (corruption / MANIFEST) refuse resume()."""
+    from toplingdb_tpu.utils.status import (
+        Corruption, IOError_, Severity,
+    )
+
+    db = DB.open(str(tmp_path / "db"), Options())
+    # SOFT: retryable flush IO error — writes continue, severity visible.
+    db._set_background_error(IOError_("enospc", retryable=True), "flush")
+    assert db._bg_error_severity == Severity.SOFT_ERROR
+    db.put(b"k", b"v")  # foreground writes stay up under SOFT
+    assert db.get(b"k") == b"v"
+    db.resume()
+    assert db.get_property("tpulsm.background-errors") == "0"
+
+    # HARD: non-retryable WAL-adjacent error — writes raise until resume.
+    db._set_background_error(IOError_("disk gone"), "wal")
+    assert db._bg_error_severity == Severity.HARD_ERROR
+    with pytest.raises(IOError_):
+        db.put(b"k2", b"v2")
+    db.resume()
+    db.put(b"k2", b"v2")
+
+    # Escalation: a later worse error replaces a milder one.
+    db._set_background_error(IOError_("enospc", retryable=True), "flush")
+    db._set_background_error(Corruption("bad block"), "flush")
+    assert db._bg_error_severity == Severity.FATAL_ERROR
+    with pytest.raises(IOError_):
+        db.resume()
+    assert db.get_property("tpulsm.bg-error-severity") == "FATAL_ERROR"
+    # Reads still work at FATAL; reopen is the way out.
+    assert db.get(b"k2") == b"v2"
+    db._bg_error = None  # simulate reopen for close()
+    db._bg_error_severity = Severity.NO_ERROR
+    db.close()
+
+    # UNRECOVERABLE: corruption discovered BY compaction.
+    db = DB.open(str(tmp_path / "db2"), Options())
+    db._set_background_error(Corruption("merge saw garbage"), "compaction")
+    assert db._bg_error_severity == Severity.UNRECOVERABLE
+    with pytest.raises(IOError_):
+        db.resume()
+    db._bg_error = None
+    db._bg_error_severity = Severity.NO_ERROR
+    db.close()

@@ -259,17 +259,17 @@ class CompactionServiceExecutor(CompactionExecutor):
         opts = db.options
         if opts.comparator.name() != "tpulsm.BytewiseComparator" and \
                 "comparator" not in cfg:
-            raise Corruption(
+            raise InvalidArgument(
                 "unregistered comparator cannot travel the service boundary"
             )
         if opts.merge_operator is not None and "merge_operator" not in cfg:
-            raise Corruption(
+            raise InvalidArgument(
                 "unregistered merge operator cannot travel the service "
                 "boundary"
             )
         if getattr(opts, "compaction_filter", None) is not None and \
                 "compaction_filter" not in cfg:
-            raise Corruption(
+            raise InvalidArgument(
                 "unregistered compaction filter cannot travel the service "
                 "boundary"
             )

@@ -114,6 +114,8 @@ class DB:
         self._compaction_scheduler = None  # set by compaction module
         self._pending_outputs: set[int] = set()  # files being written by jobs
         self._bg_error: BaseException | None = None
+        from toplingdb_tpu.utils.status import Severity as _Sev
+        self._bg_error_severity = _Sev.NO_ERROR
         self._mem_id_counter = 0
         self.identity = ""
         self.stats = options.statistics  # may be None
@@ -457,9 +459,13 @@ class DB:
         with self._mutex:
             self._check_open()
             if self._bg_error is not None:
-                raise IOError_(
-                    f"background error pending (call resume()): {self._bg_error!r}"
-                )
+                from toplingdb_tpu.utils.status import Severity as _Sev
+
+                if self._bg_error_severity >= _Sev.HARD_ERROR:
+                    raise IOError_(
+                        f"background error pending (call resume()): "
+                        f"{self._bg_error!r}"
+                    )
             first_seq = self.versions.last_sequence + 1
             seq = first_seq
             for w in group:
@@ -976,17 +982,55 @@ class DB:
         if self._bg_error is not None:
             raise IOError_(f"background error: {self._bg_error!r}")
 
-    def _set_background_error(self, e: BaseException) -> None:
-        """Reference ErrorHandler::SetBGError: stop writes until resume().
-        Retryable IO errors additionally start the auto-recovery thread
-        (reference StartRecoverFromRetryableBGIOError,
-        db/error_handler.cc:631): retry resume() with backoff until the
-        transient fault clears or attempts run out."""
+    def _classify_bg_error(self, e: BaseException, reason: str):
+        """Map (error, background reason) → Severity, mirroring the
+        reference's ErrorHandler severity tables (db/error_handler.cc:
+        kSoft for retryable/no-space flush+compaction IO errors, kFatal for
+        MANIFEST failures and corruption, kUnrecoverable for corruption
+        found BY compaction — it would be baked into new SSTs)."""
+        from toplingdb_tpu.utils.status import Corruption as _Corr
+        from toplingdb_tpu.utils.status import Severity
+
+        if isinstance(e, _Corr):
+            return (Severity.UNRECOVERABLE if reason == "compaction"
+                    else Severity.FATAL_ERROR)
+        if reason == "manifest":
+            return Severity.FATAL_ERROR
+        if getattr(e, "retryable", False) and reason in (
+                "flush", "compaction"):
+            return Severity.SOFT_ERROR
+        return Severity.HARD_ERROR
+
+    def _set_background_error(self, e: BaseException,
+                              reason: str = "compaction") -> None:
+        """Reference ErrorHandler::SetBGError. Severity decides behavior:
+        SOFT (retryable flush/compaction IO) — foreground writes continue,
+        background work pauses, auto-recovery retries; HARD — writes raise
+        until resume(); FATAL/UNRECOVERABLE (corruption, MANIFEST loss) —
+        resume() refuses, the DB must be reopened."""
+        from toplingdb_tpu.utils.status import Severity
+
+        sev = self._classify_bg_error(e, reason)
         with self._mutex:
             if self._bg_error is not None:
-                return
-            self._bg_error = e
-        if getattr(e, "retryable", False):
+                # Only ever escalate (reference keeps the max severity).
+                if sev <= self._bg_error_severity:
+                    return
+                self._bg_error = e
+                self._bg_error_severity = sev
+            else:
+                self._bg_error = e
+                self._bg_error_severity = sev
+        # Listener + auto-recovery apply to escalations too: monitoring must
+        # learn the DB got WORSE, and a retryable error that replaced the
+        # one a recovery thread was chasing needs a fresh thread (the old
+        # one exits at its `is not target` identity check).
+        from toplingdb_tpu.utils.listener import notify
+
+        notify(self.options.listeners, "on_background_error", self, e)
+        if sev == Severity.SOFT_ERROR or (
+                getattr(e, "retryable", False)
+                and sev < Severity.FATAL_ERROR):
             t = threading.Thread(target=self._auto_recover_loop, args=(e,),
                                  daemon=True)
             t.start()
@@ -1020,6 +1064,9 @@ class DB:
                 with self._mutex:
                     if self._bg_error is None:
                         self._bg_error = err
+                        self._bg_error_severity = self._classify_bg_error(
+                            err, "flush"
+                        )
                     elif self._bg_error is not err:
                         return  # someone else latched; not ours to clear
                 target = err
@@ -1027,9 +1074,21 @@ class DB:
 
     def resume(self) -> None:
         """Clear a background error and restart background work (reference
-        DB::Resume / ErrorHandler::RecoverFromBGError)."""
+        DB::Resume / ErrorHandler::RecoverFromBGError). FATAL and
+        UNRECOVERABLE errors (corruption, MANIFEST loss) refuse: the DB
+        must be reopened to rebuild consistent state."""
+        from toplingdb_tpu.utils.status import Severity as _Sev
+
         with self._mutex:
+            if (self._bg_error is not None
+                    and self._bg_error_severity >= _Sev.FATAL_ERROR):
+                raise IOError_(
+                    f"background error is not resumable "
+                    f"({self._bg_error_severity.name}); reopen the DB: "
+                    f"{self._bg_error!r}"
+                )
             self._bg_error = None
+            self._bg_error_severity = _Sev.NO_ERROR
         self._maybe_schedule_compaction()
 
     def _maybe_schedule_compaction(self) -> None:
@@ -1366,6 +1425,10 @@ class DB:
             return "\n".join(lines)
         if name == "tpulsm.num-files":
             return str(v.num_files())
+        if name == "tpulsm.background-errors":
+            return str(int(self._bg_error is not None))
+        if name == "tpulsm.bg-error-severity":
+            return self._bg_error_severity.name
         if name == "tpulsm.estimate-num-keys":
             # Reference rocksdb.estimate-num-keys: live table entries minus
             # deletions plus memtable entries (overcounts overwrites).

@@ -40,20 +40,11 @@ class IOTracer:
 
 def parse_io_trace(trace_path: str) -> dict:
     """Aggregate an IO trace (the io_tracer_parser role): per-op counts,
-    bytes, and latency totals."""
-    out: dict[str, dict] = {}
-    with open(trace_path) as f:
-        for line in f:
-            if not line.strip():
-                continue
-            rec = json.loads(line)
-            agg = out.setdefault(
-                rec["op"], {"count": 0, "bytes": 0, "latency_us": 0}
-            )
-            agg["count"] += 1
-            agg["bytes"] += rec.get("len", 0)
-            agg["latency_us"] += rec.get("latency_us", 0)
-    return out
+    bytes, and latency totals. Delegates to the CLI parser so there is
+    exactly ONE parse loop (tools/io_tracer_parser.py)."""
+    from toplingdb_tpu.tools.io_tracer_parser import parse
+
+    return parse(trace_path)["per_op"]
 
 
 class _TracedWritable:

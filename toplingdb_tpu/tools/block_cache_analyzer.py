@@ -18,7 +18,9 @@ import sys
 from collections import Counter
 
 
-def analyze(trace_path: str, top_n: int = 10) -> dict:
+def analyze(trace_path: str, top_n: int | None = 10) -> dict:
+    """top_n=None returns EVERY block in hottest_blocks (callers that
+    need full coverage, e.g. the legacy aggregate wrapper)."""
     hits = misses = 0
     per_key = Counter()
     key_misses = Counter()
@@ -53,7 +55,7 @@ def analyze(trace_path: str, top_n: int = 10) -> dict:
         "hottest_blocks": [
             {"key": k, "accesses": c, "misses": key_misses.get(k, 0)}
             for k, c in per_key.most_common(top_n)
-        ],
+        ],  # most_common(None) = all, count-sorted
         "miss_ratio_timeline": [
             {"second": s, "accesses": h + m,
              "miss_ratio": round(m / (h + m), 4) if h + m else 0.0}

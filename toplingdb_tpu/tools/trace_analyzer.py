@@ -21,7 +21,9 @@ from collections import Counter, defaultdict
 from toplingdb_tpu.utils.trace import _OP_NAMES, read_trace
 
 
-def analyze(env, trace_path: str, top_k: int = 10) -> dict:
+def _analyze_full(env, trace_path: str, top_k: int = 10):
+    """(json-clean report, per-op key Counters). The single aggregation
+    loop behind both the CLI and utils.trace.analyze_trace."""
     ops = Counter()
     key_hits: dict[str, Counter] = defaultdict(Counter)
     key_sizes = Counter()
@@ -47,7 +49,7 @@ def analyze(env, trace_path: str, top_k: int = 10) -> dict:
         all_keys.update(c)
     span_s = ((last_ts - first_ts) / 1e6) if total and last_ts != first_ts else 0.0
     qps = sorted(per_second.values())
-    return {
+    report = {
         "total_ops": total,
         "per_op": dict(ops),
         "unique_keys": len(all_keys),
@@ -60,31 +62,45 @@ def analyze(env, trace_path: str, top_k: int = 10) -> dict:
             {"key": k.decode(errors="replace"), "count": c}
             for k, c in all_keys.most_common(top_k)
         ],
-        "_key_hits": key_hits,  # stripped before printing
     }
+    return report, key_hits
+
+
+def analyze(env, trace_path: str, top_k: int = 10) -> dict:
+    """JSON-serializable trace report."""
+    return _analyze_full(env, trace_path, top_k)[0]
 
 
 def _dist(c: Counter) -> dict:
+    """Percentiles straight from the (size, count) pairs — O(distinct
+    sizes) memory, never materializing one element per observation."""
     if not c:
         return {}
-    sizes = sorted(c.elements())
-    n = len(sizes)
+    items = sorted(c.items())
+    n = sum(c.values())
+    def pct(rank):  # value at 0-based rank
+        cum = 0
+        for size, cnt in items:
+            cum += cnt
+            if cum > rank:
+                return size
+        return items[-1][0]
     return {
         "count": n,
-        "min": sizes[0],
-        "p50": sizes[n // 2],
-        "p99": sizes[min(n - 1, (n * 99) // 100)],
-        "max": sizes[-1],
-        "avg": round(sum(sizes) / n, 1),
+        "min": items[0][0],
+        "p50": pct(n // 2),
+        "p99": pct(min(n - 1, (n * 99) // 100)),
+        "max": items[-1][0],
+        "avg": round(sum(s * cnt for s, cnt in items) / n, 1),
     }
 
 
-def write_key_counts(report: dict, output_dir: str) -> list[str]:
+def write_key_counts(key_hits: dict, output_dir: str) -> list[str]:
     """Per-op '<op>-key_counts.txt' files: 'hex_key count' per line sorted
     by count desc (the reference analyzer's key-space artifacts)."""
     os.makedirs(output_dir, exist_ok=True)
     written = []
-    for op, counts in report["_key_hits"].items():
+    for op, counts in key_hits.items():
         path = os.path.join(output_dir, f"{op}-key_counts.txt")
         with open(path, "w") as f:
             for k, c in counts.most_common():
@@ -106,11 +122,10 @@ def main(argv=None) -> int:
 
     from toplingdb_tpu.env import default_env
 
-    report = analyze(default_env(), args.trace, args.top_k)
+    report, key_hits = _analyze_full(default_env(), args.trace, args.top_k)
     if args.output_dir:
-        for p in write_key_counts(report, args.output_dir):
+        for p in write_key_counts(key_hits, args.output_dir):
             print(f"wrote {p}", file=sys.stderr)
-    report.pop("_key_hits")
     if args.json:
         print(json.dumps(report, indent=1))
         return 0

@@ -38,24 +38,16 @@ class BlockCacheTracer:
 
 def analyze_block_cache_trace(trace_path: str) -> dict:
     """Aggregate hit/miss counts + per-key-prefix reuse (the
-    block_cache_analyzer role)."""
-    import json
+    block_cache_analyzer role). Delegates to the CLI analyzer so there is
+    exactly ONE aggregation loop (tools/block_cache_analyzer.py)."""
+    from toplingdb_tpu.tools.block_cache_analyzer import analyze
 
-    hits = misses = 0
+    r = analyze(trace_path, top_n=None)
     per_file: dict[str, int] = {}
-    with open(trace_path) as f:
-        for line in f:
-            if not line.strip():
-                continue
-            rec = json.loads(line)
-            if rec["hit"]:
-                hits += 1
-            else:
-                misses += 1
-            per_file[rec["key"][:32]] = per_file.get(rec["key"][:32], 0) + 1
-    total = hits + misses
-    return {"hits": hits, "misses": misses,
-            "hit_ratio": hits / total if total else 0.0,
+    for e in r["hottest_blocks"]:
+        per_file[e["key"][:32]] = per_file.get(e["key"][:32], 0) + e["accesses"]
+    return {"hits": r["hits"], "misses": r["misses"],
+            "hit_ratio": r["hit_ratio"],
             "accesses_per_file_prefix": per_file}
 
 

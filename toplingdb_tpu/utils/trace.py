@@ -107,22 +107,9 @@ class Replayer:
 
 
 def analyze_trace(env, trace_path: str) -> dict:
-    """Per-op-type counts + hottest keys (reference trace_analyzer)."""
-    from collections import Counter
+    """Per-op-type counts + hottest keys (reference trace_analyzer).
+    Thin wrapper over the full CLI analyzer so there is exactly ONE
+    aggregation loop (tools/trace_analyzer.py)."""
+    from toplingdb_tpu.tools.trace_analyzer import analyze
 
-    ops = Counter()
-    keys = Counter()
-    total = 0
-    for op, ts, slices in read_trace(env, trace_path):
-        ops[_OP_NAMES.get(op, str(op))] += 1
-        if slices:
-            keys[bytes(slices[0])] += 1
-        total += 1
-    return {
-        "total_ops": total,
-        "per_op": dict(ops),
-        "hottest_keys": [
-            {"key": k.decode(errors="replace"), "count": c}
-            for k, c in keys.most_common(10)
-        ],
-    }
+    return analyze(env, trace_path)
