@@ -1412,3 +1412,203 @@ def test_wbwi_skiplist_rep_matches_list_rep():
         views[rep] = ({k: w.get_from_batch(k) for k in w.key_set()},
                       w.key_set())
     assert views["list"] == views["skiplist"]
+
+
+# -- range locking (Toku locktree role) -------------------------------------
+
+
+def test_range_lock_conflict_and_release(tmp_path):
+    """A locked interval blocks writes to ANY key inside it; release at
+    commit unblocks (reference utilities/transactions/lock/range/)."""
+    from toplingdb_tpu.utilities.transactions import TransactionDB
+    from toplingdb_tpu.utils.status import Busy
+
+    with TransactionDB.open(str(tmp_path / "db"),
+                            use_range_locking=True) as tdb:
+        t1 = tdb.begin_transaction()
+        t1.get_range_lock(b"k20", b"k40")
+        t1.put(b"k25", b"t1")  # inside own range: no self-conflict
+        t2 = tdb.begin_transaction(lock_timeout=0.1)
+        t2.put(b"k10", b"t2")  # outside the range: fine
+        with pytest.raises(Busy):
+            t2.put(b"k30", b"t2")  # inside t1's range: blocked
+        with pytest.raises(Busy):
+            t2.get_range_lock(b"k39", b"k99")  # overlapping range: blocked
+        t1.commit()
+        t2.put(b"k30", b"t2")  # released
+        t2.get_range_lock(b"k39", b"k99")
+        t2.commit()
+        assert tdb.get(b"k25") == b"t1"
+        assert tdb.get(b"k30") == b"t2"
+
+
+def test_range_lock_deadlock_detection(tmp_path):
+    from toplingdb_tpu.utilities.transactions import (
+        DeadlockError, TransactionDB,
+    )
+    import threading
+
+    with TransactionDB.open(str(tmp_path / "db"),
+                            use_range_locking=True) as tdb:
+        t1 = tdb.begin_transaction(lock_timeout=5.0)
+        t2 = tdb.begin_transaction(lock_timeout=5.0)
+        t1.get_range_lock(b"a", b"c")
+        t2.get_range_lock(b"x", b"z")
+        errs = []
+
+        def t2_crosses():
+            try:
+                t2.get_range_lock(b"b", b"b")  # waits on t1
+            except Exception as e:
+                errs.append(e)
+
+        th = threading.Thread(target=t2_crosses)
+        th.start()
+        import time as _t
+
+        _t.sleep(0.1)
+        with pytest.raises(DeadlockError):
+            t1.get_range_lock(b"y", b"y")  # t1→t2 while t2→t1: cycle
+        t1.rollback()
+        th.join()
+        t2.rollback()
+
+
+def test_range_lock_escalation():
+    """Holding more than max_ranges_per_txn ranges merges consecutive owned
+    ranges into hulls (Toku lock escalation: bounded memory, safe
+    over-locking)."""
+    from toplingdb_tpu.utilities.transactions import RangeLockManager
+
+    mgr = RangeLockManager(max_ranges_per_txn=8)
+    for i in range(40):
+        k = b"k%04d" % (i * 2)  # disjoint single-key ranges
+        mgr.try_lock_range(1, k, k)
+    assert len(mgr._ranges) <= 8 + 1
+    # The hull covers everything in between — another txn is kept out.
+    from toplingdb_tpu.utils.status import Busy
+
+    with pytest.raises(Busy):
+        mgr.try_lock_range(2, b"k0001", b"k0001", timeout=0.05)
+    mgr.unlock_all(1)
+    mgr.try_lock_range(2, b"k0001", b"k0001", timeout=0.05)
+
+
+def test_range_lock_merges_own_overlaps():
+    from toplingdb_tpu.utilities.transactions import RangeLockManager
+
+    mgr = RangeLockManager()
+    mgr.try_lock_range(7, b"a", b"f")
+    mgr.try_lock_range(7, b"d", b"m")   # overlaps own: merged to [a, m]
+    mgr.try_lock_range(7, b"m", b"p")
+    assert len(mgr._ranges) <= 2
+    covered = mgr._overlaps(b"a", b"p")
+    assert all(r[2] == 7 for r in covered)
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    with pytest.raises(InvalidArgument):
+        mgr.try_lock_range(7, b"z", b"a")
+
+
+def test_range_lock_multi_holder_deadlock():
+    """Cycles through ANY holder of an overlapping range are detected —
+    single-edge tracking would miss them (t3 waits on {t1, t2})."""
+    from toplingdb_tpu.utilities.transactions import (
+        DeadlockError, RangeLockManager,
+    )
+    import threading
+    import time as _t
+
+    mgr = RangeLockManager()
+    mgr.try_lock_range(1, b"a", b"b")
+    mgr.try_lock_range(2, b"c", b"d")
+    res = {}
+
+    def t3_wants_both():
+        try:
+            mgr.try_lock_range(3, b"a", b"d", timeout=5.0)
+            res["t3"] = "got"
+        except Exception as e:
+            res["t3"] = type(e).__name__
+
+    th = threading.Thread(target=t3_wants_both)
+    th.start()
+    _t.sleep(0.15)
+    # t3 waits on BOTH holders (multi-edge), not an arbitrary one.
+    with mgr._cv:
+        assert mgr._waits_for.get(3) == {1, 2}
+    # Cycle through the SECOND holder: t3 already holds [m,n]? it holds
+    # nothing — so create one via a 4th txn chain: t2 waits on t4, t4
+    # requests t1's... keep it direct: t1 (a holder t3 waits on) requests
+    # a range held by a txn that waits on t3 — t4 holds [p,q], waits on
+    # t3's pending? t3 holds nothing while blocked. Exercise instead the
+    # detector over set-valued edges: t2 requests a range of t4 where t4
+    # waits on t3 — the t3→{1,2} edge closes t2→t4→t3→t2.
+    mgr.try_lock_range(4, b"p", b"q")
+    wait4 = {}
+
+    def t4_waits_on_t3_target():
+        # t4 requests inside [a,d] — blocked by t1/t2 alongside t3; record
+        # its edge then time out quickly.
+        try:
+            mgr.try_lock_range(4, b"a", b"a", timeout=0.2)
+            wait4["r"] = "got"
+        except Exception as e:
+            wait4["r"] = type(e).__name__
+
+    th4 = threading.Thread(target=t4_waits_on_t3_target)
+    th4.start()
+    _t.sleep(0.05)
+    with pytest.raises(DeadlockError):
+        # t1 requests t4's range: t1 → t4 → {t1, t2} closes the cycle
+        # through the holder-SET edge.
+        mgr.try_lock_range(1, b"p", b"p", timeout=1.0)
+    th4.join()
+    mgr.unlock_all(1)
+    mgr.unlock_all(2)
+    mgr.unlock_all(4)
+    th.join()
+    assert res["t3"] == "got"
+
+
+def test_range_lock_2pc_recovery(tmp_path):
+    """A prepared transaction's RANGE locks survive crash recovery: the gap
+    stays protected until the recovered txn is decided."""
+    import os
+    import subprocess
+    import sys
+
+    dbp = str(tmp_path / "db")
+    child = f'''
+import sys, os
+sys.path.insert(0, {os.getcwd()!r})
+from toplingdb_tpu.utilities.transactions import TransactionDB
+tdb = TransactionDB.open({dbp!r}, use_range_locking=True)
+t = tdb.begin_transaction()
+t.get_range_lock(b"g100", b"g200")
+t.put(b"g150", b"prepared-val")
+t.set_name("gaplock")
+t.prepare()
+os._exit(0)  # crash before deciding
+'''
+    r = subprocess.run([sys.executable, "-c", child], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+    from toplingdb_tpu.utilities.transactions import TransactionDB
+    from toplingdb_tpu.utils.status import Busy, InvalidArgument
+
+    # Reopening WITHOUT range locking refuses (the gap cannot be protected).
+    with pytest.raises(InvalidArgument):
+        TransactionDB.open(dbp)
+    tdb = TransactionDB.open(dbp, use_range_locking=True)
+    [rec] = tdb.get_prepared_transactions()
+    assert rec.name == "gaplock"
+    t2 = tdb.begin_transaction(lock_timeout=0.05)
+    with pytest.raises(Busy):
+        t2.put(b"g175", b"intruder")  # inside the recovered range
+    rec.commit()
+    t2.put(b"g175", b"after-commit")
+    t2.commit()
+    assert tdb.get(b"g150") == b"prepared-val"
+    assert tdb.get(b"g175") == b"after-commit"
+    tdb.close()

@@ -1059,7 +1059,19 @@ class DB:
                 return
             except Exception as err:  # still failing
                 if not getattr(err, "retryable", False):
-                    self._set_background_error(err)  # latch; stop retrying
+                    # `err` may be wait_for_compactions' non-retryable
+                    # WRAPPER around the real latched error — escalating on
+                    # it would turn one failed retry of a transient fault
+                    # into a permanent write outage. Keep retrying as long
+                    # as the LATCHED error is still a retryable one.
+                    with self._mutex:
+                        latched = self._bg_error
+                    if latched is not None and getattr(
+                            latched, "retryable", False):
+                        target = latched
+                        continue
+                    if latched is None:
+                        self._set_background_error(err)  # genuine new error
                     return
                 with self._mutex:
                     if self._bg_error is None:
@@ -1068,7 +1080,12 @@ class DB:
                             err, "flush"
                         )
                     elif self._bg_error is not err:
-                        return  # someone else latched; not ours to clear
+                        if getattr(self._bg_error, "retryable", False):
+                            # the scheduler re-latched its own retryable
+                            # error; chase that one instead of exiting
+                            target = self._bg_error
+                            continue
+                        return  # worse error latched; not ours to clear
                 target = err
         self.event_logger.log("auto_recovery_gave_up", attempts=max_attempts)
 

@@ -28,6 +28,33 @@ class DeadlockError(Busy):
     pass
 
 
+def _has_wait_cycle(waits_for: dict, waiter: int, holder: int,
+                    max_steps: int = 256) -> bool:
+    """Would waiter→holder close a cycle? DFS over the wait-for graph;
+    values may be a single txn id (point locks: one holder per key) or a
+    set of ids (range locks: many holders per interval). Callers hold
+    their own lock around waits_for."""
+    seen = set()
+    stack = [holder]
+    steps = 0
+    while stack and steps < max_steps:
+        cur = stack.pop()
+        steps += 1
+        if cur == waiter:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        nxt = waits_for.get(cur)
+        if nxt is None:
+            continue
+        if isinstance(nxt, (set, frozenset)):
+            stack.extend(nxt)
+        else:
+            stack.append(nxt)
+    return False
+
+
 class PointLockManager:
     """Striped exclusive point locks with wait-for-graph deadlock detection."""
 
@@ -46,15 +73,7 @@ class PointLockManager:
 
     def _would_deadlock(self, waiter: int, holder: int) -> bool:
         with self._wf_mu:
-            cur = holder
-            for _ in range(64):
-                nxt = self._waits_for.get(cur)
-                if nxt is None:
-                    return False
-                if nxt == waiter:
-                    return True
-                cur = nxt
-        return False
+            return _has_wait_cycle(self._waits_for, waiter, holder)
 
     def try_lock(self, txn_id: int, key: bytes, timeout: float = 1.0) -> None:
         s = self._stripe(key)
@@ -93,6 +112,117 @@ class PointLockManager:
                 s["cv"].notify_all()
         with self._wf_mu:
             self._waits_for.pop(txn_id, None)
+
+
+class RangeLockManager:
+    """Range (gap) locks — the role of the reference's Toku `locktree`
+    (utilities/transactions/lock/range/range_tree/): a transaction can lock
+    a whole user-key interval [begin, end] (closed), blocking writers to
+    ANY key inside it, with the same wait-for-graph deadlock detection as
+    point locks and Toku-style lock escalation (when one transaction holds
+    more than max_ranges_per_txn ranges, adjacent owned ranges merge into
+    their hull — over-locking is safe, unbounded memory is not).
+
+    Point locks are single-key ranges, so this manager is a drop-in for
+    PointLockManager (try_lock / unlock_all have the same shape)."""
+
+    def __init__(self, max_ranges_per_txn: int = 1024):
+        self._cv = threading.Condition()
+        self._ranges: list[list] = []  # [begin, end, owner], sorted by begin
+        self._max_per_txn = max_ranges_per_txn
+        self._counts: dict[int, int] = {}
+        self._waits_for: dict[int, int] = {}
+
+    # -- internals (all under self._cv) --------------------------------
+
+    def _overlaps(self, b: bytes, e: bytes):
+        # Linear scan: a begin-sorted list cannot bound the scan start
+        # (an early range may extend past b), and escalation already
+        # bounds the list length.
+        return [r for r in self._ranges if r[0] <= e and r[1] >= b]
+
+    def _insert(self, txn_id: int, b: bytes, e: bytes) -> None:
+        import bisect
+
+        # Merge with owned overlapping/adjacent ranges into one hull.
+        merged_b, merged_e = b, e
+        keep = []
+        for r in self._overlaps(b, e):
+            if r[2] == txn_id:
+                merged_b = min(merged_b, r[0])
+                merged_e = max(merged_e, r[1])
+                keep.append(r)
+        for r in keep:
+            self._ranges.remove(r)
+            self._counts[txn_id] -= 1
+        bisect.insort(self._ranges, [merged_b, merged_e, txn_id])
+        self._counts[txn_id] = self._counts.get(txn_id, 0) + 1
+        if self._counts[txn_id] > self._max_per_txn:
+            self._escalate(txn_id)
+
+    def _escalate(self, txn_id: int) -> None:
+        """Merge CONSECUTIVE ranges owned by txn_id (no other owner's range
+        between them) into their hull — Toku lock escalation: widens the
+        lock footprint (safe) to bound memory."""
+        out = []
+        for r in self._ranges:
+            if (out and r[2] == txn_id and out[-1][2] == txn_id):
+                out[-1][1] = max(out[-1][1], r[1])
+            else:
+                out.append(r)
+        freed = len(self._ranges) - len(out)
+        if freed:
+            self._ranges = out
+            self._counts[txn_id] -= freed
+
+    # -- public surface --------------------------------------------------
+
+    def try_lock_range(self, txn_id: int, begin: bytes, end: bytes,
+                       timeout: float = 1.0) -> None:
+        if begin > end:
+            raise InvalidArgument("range lock begin > end")
+        deadline = time.time() + timeout
+        with self._cv:
+            while True:
+                holders = {
+                    r[2] for r in self._overlaps(begin, end)
+                    if r[2] != txn_id
+                }
+                if not holders:
+                    self._insert(txn_id, begin, end)
+                    self._waits_for.pop(txn_id, None)
+                    return
+                # A range waits on EVERY holder of an overlapping range:
+                # single-edge tracking would miss cycles through the rest.
+                for holder in holders:
+                    if _has_wait_cycle(self._waits_for, txn_id, holder):
+                        self._waits_for.pop(txn_id, None)  # no stale edge
+                        raise DeadlockError(
+                            f"deadlock: txn {txn_id} → txn {holder} on "
+                            f"[{begin!r}, {end!r}]"
+                        )
+                self._waits_for[txn_id] = set(holders)
+                remain = deadline - time.time()
+                if remain <= 0:
+                    self._waits_for.pop(txn_id, None)
+                    raise Busy(
+                        f"range lock timeout on [{begin!r}, {end!r}] "
+                        f"(held by {len(holders)} txns)"
+                    )
+                self._cv.wait(min(remain, 0.05))
+
+    def try_lock(self, txn_id: int, key: bytes, timeout: float = 1.0) -> None:
+        self.try_lock_range(txn_id, key, key, timeout)
+
+    def unlock_all(self, txn_id: int, keys=None) -> None:
+        """Release EVERY range owned by txn_id (ranges may cover many keys,
+        so per-key release would leak; the reference's locktree likewise
+        releases by owner at commit/rollback)."""
+        with self._cv:
+            self._ranges = [r for r in self._ranges if r[2] != txn_id]
+            self._counts.pop(txn_id, None)
+            self._waits_for.pop(txn_id, None)
+            self._cv.notify_all()
 
 
 class _TxnBase:
@@ -150,6 +280,7 @@ class PessimisticTransaction(_TxnBase):
         super().__init__(txn_db.db, write_options)
         self._txn_db = txn_db
         self._locked: set[bytes] = set()
+        self._locked_ranges: list[tuple[bytes, bytes]] = []
         self._lock_timeout = lock_timeout
 
     def _before_write(self, key: bytes) -> None:
@@ -161,9 +292,25 @@ class PessimisticTransaction(_TxnBase):
         self._before_write(key)
         return self.get(key)
 
+    def get_range_lock(self, begin: bytes, end: bytes) -> None:
+        """Lock the whole user-key interval [begin, end] (reference
+        Transaction::GetRangeLock — range-locking TransactionDBs only)."""
+        mgr = self._txn_db.lock_manager
+        if not isinstance(mgr, RangeLockManager):
+            raise InvalidArgument(
+                "get_range_lock requires TransactionDB.open("
+                "use_range_locking=True)"
+            )
+        mgr.try_lock_range(self.id, begin, end, self._lock_timeout)
+        self._locked_ranges.append((begin, end))
+
     def undo_get_for_update(self, key: bytes) -> None:
         # The reference keeps the lock until commit if the key was written;
-        # we match: only unwritten keys are released.
+        # we match: only unwritten keys are released. Under RANGE locking
+        # partial release is unsupported (the locktree frees by owner at
+        # commit/rollback) — keeping the lock is safe over-locking.
+        if isinstance(self._txn_db.lock_manager, RangeLockManager):
+            return
         written = bool(self.wbwi._batch_view(key))  # one seek, not a scan
         if key in self._locked and not written:
             self._txn_db.lock_manager.unlock_all(self.id, [key])
@@ -234,9 +381,13 @@ class TransactionDB:
     _MARKER_PREFIX = b"txn."
     _TXN_CF = "__tpulsm_txn__"
 
-    def __init__(self, db: DB):
+    def __init__(self, db: DB, use_range_locking: bool = False):
         self.db = db
-        self.lock_manager = PointLockManager()
+        # Reference TransactionDBOptions::lock_mgr_handle: "point" (default)
+        # or the range-capable locktree manager.
+        self.lock_manager = (
+            RangeLockManager() if use_range_locking else PointLockManager()
+        )
         self._txn_dir = f"{db.dbname}/txns"
         self._recovered: list[PessimisticTransaction] = []
         self._names: set[str] = set()
@@ -266,8 +417,9 @@ class TransactionDB:
             self._names.discard(name)
 
     @staticmethod
-    def open(path: str, options: Options | None = None) -> "TransactionDB":
-        return TransactionDB(DB.open(path, options))
+    def open(path: str, options: Options | None = None,
+             use_range_locking: bool = False) -> "TransactionDB":
+        return TransactionDB(DB.open(path, options), use_range_locking)
 
     # -- 2PC journal ----------------------------------------------------
 
@@ -281,6 +433,9 @@ class TransactionDB:
             "name": txn.name,
             "batch": txn.wbwi.batch.data().hex(),
             "locks": [k.hex() for k in txn._locked],
+            "range_locks": [
+                [b.hex(), e.hex()] for b, e in txn._locked_ranges
+            ],
         })
         self.db.env.write_file(self._prep_path(txn.name), doc.encode(),
                                sync=True)
@@ -331,6 +486,10 @@ class TransactionDB:
                 name = doc["name"]
                 batch_data = bytes.fromhex(doc["batch"])
                 locks = [bytes.fromhex(kh) for kh in doc["locks"]]
+                range_locks = [
+                    (bytes.fromhex(b), bytes.fromhex(e))
+                    for b, e in doc.get("range_locks", [])
+                ]
             except (ValueError, KeyError, UnicodeDecodeError):
                 # Torn prepare: quarantine so it can't be re-read forever.
                 self.db.env.rename_file(
@@ -358,6 +517,15 @@ class TransactionDB:
             for k in locks:
                 self.lock_manager.try_lock(txn.id, k, 0.0)
                 txn._locked.add(k)
+            if range_locks and not isinstance(self.lock_manager,
+                                              RangeLockManager):
+                raise InvalidArgument(
+                    f"prepared transaction {name!r} holds range locks; "
+                    f"reopen with use_range_locking=True"
+                )
+            for b, e in range_locks:
+                self.lock_manager.try_lock_range(txn.id, b, e, 0.0)
+                txn._locked_ranges.append((b, e))
             txn.state = "prepared"
             self._recovered.append(txn)
         # Sweep orphan markers (crash between prep delete and marker
