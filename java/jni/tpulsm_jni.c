@@ -1,0 +1,309 @@
+/* JNI glue: org.toplingdb.* ↔ the flat C ABI (tpulsm_c.h).
+ *
+ * The role of the reference's java/rocksjni/*.cc. Every errptr-style
+ * failure becomes a thrown org.toplingdb.TpuLsmException; byte[] keys and
+ * values move through Get/Release with JNI_ABORT on read-only access.
+ *
+ * Build (java/Makefile): gcc -shared -fPIC tpulsm_jni.c -ltpulsm_c \
+ *   -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+ *   -I../toplingdb_tpu/bindings/c
+ */
+#include <jni.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpulsm_c.h"
+
+static void throw_tpulsm(JNIEnv* env, const char* msg) {
+    jclass cls = (*env)->FindClass(env, "org/toplingdb/TpuLsmException");
+    if (cls != NULL) {
+        (*env)->ThrowNew(env, cls, msg ? msg : "unknown engine error");
+    }
+}
+
+static int check_err(JNIEnv* env, char* err) {
+    if (err != NULL) {
+        throw_tpulsm(env, err);
+        tpulsm_free(err);
+        return 1;
+    }
+    return 0;
+}
+
+/* -- TpuLsmDB ----------------------------------------------------------- */
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_initEngine(JNIEnv* env, jclass cls) {
+    (void)env; (void)cls;
+    tpulsm_init();
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_TpuLsmDB_openNative(JNIEnv* env, jclass cls, jstring path,
+                                       jboolean create) {
+    (void)cls;
+    char* err = NULL;
+    const char* cpath = (*env)->GetStringUTFChars(env, path, NULL);
+    if (cpath == NULL) return 0;
+    tpulsm_db_t* db = tpulsm_open(cpath, create == JNI_TRUE, &err);
+    (*env)->ReleaseStringUTFChars(env, path, cpath);
+    if (check_err(env, err)) return 0;
+    if (db == NULL) {
+        throw_tpulsm(env, "open failed");
+        return 0;
+    }
+    return (jlong)(intptr_t)db;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_closeNative(JNIEnv* env, jclass cls, jlong h) {
+    (void)env; (void)cls;
+    tpulsm_close((tpulsm_db_t*)(intptr_t)h);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_putNative(JNIEnv* env, jclass cls, jlong h,
+                                      jbyteArray key, jbyteArray val) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, key);
+    jsize vlen = (*env)->GetArrayLength(env, val);
+    jbyte* k = (*env)->GetByteArrayElements(env, key, NULL);
+    jbyte* v = (*env)->GetByteArrayElements(env, val, NULL);
+    if (k != NULL && v != NULL) {
+        tpulsm_put((tpulsm_db_t*)(intptr_t)h, (const char*)k, (size_t)klen,
+                   (const char*)v, (size_t)vlen, &err);
+    }
+    if (k != NULL) (*env)->ReleaseByteArrayElements(env, key, k, JNI_ABORT);
+    if (v != NULL) (*env)->ReleaseByteArrayElements(env, val, v, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_toplingdb_TpuLsmDB_getNative(JNIEnv* env, jclass cls, jlong h,
+                                      jbyteArray key) {
+    (void)cls;
+    char* err = NULL;
+    size_t vlen = 0;
+    jsize klen = (*env)->GetArrayLength(env, key);
+    jbyte* k = (*env)->GetByteArrayElements(env, key, NULL);
+    if (k == NULL) return NULL;
+    char* v = tpulsm_get((tpulsm_db_t*)(intptr_t)h, (const char*)k,
+                         (size_t)klen, &vlen, &err);
+    (*env)->ReleaseByteArrayElements(env, key, k, JNI_ABORT);
+    if (check_err(env, err)) {
+        if (v != NULL) tpulsm_free(v);
+        return NULL;
+    }
+    if (v == NULL) return NULL; /* absent */
+    jbyteArray out = (*env)->NewByteArray(env, (jsize)vlen);
+    if (out != NULL) {
+        (*env)->SetByteArrayRegion(env, out, 0, (jsize)vlen,
+                                   (const jbyte*)v);
+    }
+    tpulsm_free(v);
+    return out;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_deleteNative(JNIEnv* env, jclass cls, jlong h,
+                                         jbyteArray key) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, key);
+    jbyte* k = (*env)->GetByteArrayElements(env, key, NULL);
+    if (k != NULL) {
+        tpulsm_delete((tpulsm_db_t*)(intptr_t)h, (const char*)k,
+                      (size_t)klen, &err);
+        (*env)->ReleaseByteArrayElements(env, key, k, JNI_ABORT);
+    }
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_writeNative(JNIEnv* env, jclass cls, jlong h,
+                                        jlong wb) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_write((tpulsm_db_t*)(intptr_t)h,
+                 (tpulsm_writebatch_t*)(intptr_t)wb, &err);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_flushNative(JNIEnv* env, jclass cls, jlong h) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_flush((tpulsm_db_t*)(intptr_t)h, &err);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_compactRangeNative(JNIEnv* env, jclass cls,
+                                               jlong h) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_compact_range((tpulsm_db_t*)(intptr_t)h, &err);
+    check_err(env, err);
+}
+
+JNIEXPORT jstring JNICALL
+Java_org_toplingdb_TpuLsmDB_propertyNative(JNIEnv* env, jclass cls, jlong h,
+                                           jstring name) {
+    (void)cls;
+    const char* cname = (*env)->GetStringUTFChars(env, name, NULL);
+    if (cname == NULL) return NULL;
+    char* v = tpulsm_property_value((tpulsm_db_t*)(intptr_t)h, cname);
+    (*env)->ReleaseStringUTFChars(env, name, cname);
+    if (v == NULL) return NULL;
+    jstring out = (*env)->NewStringUTF(env, v);
+    tpulsm_free(v);
+    return out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_TpuLsmDB_iteratorNative(JNIEnv* env, jclass cls,
+                                           jlong h) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_iterator_t* it =
+        tpulsm_create_iterator((tpulsm_db_t*)(intptr_t)h, &err);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)it;
+}
+
+/* -- WriteBatch --------------------------------------------------------- */
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_WriteBatch_createNative(JNIEnv* env, jclass cls) {
+    (void)env; (void)cls;
+    return (jlong)(intptr_t)tpulsm_writebatch_create();
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_WriteBatch_destroyNative(JNIEnv* env, jclass cls,
+                                            jlong h) {
+    (void)env; (void)cls;
+    tpulsm_writebatch_destroy((tpulsm_writebatch_t*)(intptr_t)h);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_WriteBatch_putNative(JNIEnv* env, jclass cls, jlong h,
+                                        jbyteArray key, jbyteArray val) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, key);
+    jsize vlen = (*env)->GetArrayLength(env, val);
+    jbyte* k = (*env)->GetByteArrayElements(env, key, NULL);
+    jbyte* v = (*env)->GetByteArrayElements(env, val, NULL);
+    if (k != NULL && v != NULL) {
+        tpulsm_writebatch_put((tpulsm_writebatch_t*)(intptr_t)h,
+                              (const char*)k, (size_t)klen,
+                              (const char*)v, (size_t)vlen, &err);
+    }
+    if (k != NULL) (*env)->ReleaseByteArrayElements(env, key, k, JNI_ABORT);
+    if (v != NULL) (*env)->ReleaseByteArrayElements(env, val, v, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_WriteBatch_deleteNative(JNIEnv* env, jclass cls, jlong h,
+                                           jbyteArray key) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, key);
+    jbyte* k = (*env)->GetByteArrayElements(env, key, NULL);
+    if (k != NULL) {
+        tpulsm_writebatch_delete((tpulsm_writebatch_t*)(intptr_t)h,
+                                 (const char*)k, (size_t)klen, &err);
+        (*env)->ReleaseByteArrayElements(env, key, k, JNI_ABORT);
+    }
+    check_err(env, err);
+}
+
+/* -- TpuLsmIterator ------------------------------------------------------ */
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmIterator_destroyNative(JNIEnv* env, jclass cls,
+                                                jlong h) {
+    (void)env; (void)cls;
+    tpulsm_iter_destroy((tpulsm_iterator_t*)(intptr_t)h);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmIterator_seekToFirstNative(JNIEnv* env, jclass cls,
+                                                    jlong h) {
+    (void)env; (void)cls;
+    tpulsm_iter_seek_to_first((tpulsm_iterator_t*)(intptr_t)h);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmIterator_seekToLastNative(JNIEnv* env, jclass cls,
+                                                   jlong h) {
+    (void)env; (void)cls;
+    tpulsm_iter_seek_to_last((tpulsm_iterator_t*)(intptr_t)h);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmIterator_seekNative(JNIEnv* env, jclass cls,
+                                             jlong h, jbyteArray target) {
+    (void)cls;
+    jsize tlen = (*env)->GetArrayLength(env, target);
+    jbyte* t = (*env)->GetByteArrayElements(env, target, NULL);
+    if (t != NULL) {
+        tpulsm_iter_seek((tpulsm_iterator_t*)(intptr_t)h, (const char*)t,
+                         (size_t)tlen);
+        (*env)->ReleaseByteArrayElements(env, target, t, JNI_ABORT);
+    }
+}
+
+JNIEXPORT jboolean JNICALL
+Java_org_toplingdb_TpuLsmIterator_validNative(JNIEnv* env, jclass cls,
+                                              jlong h) {
+    (void)env; (void)cls;
+    return tpulsm_iter_valid((tpulsm_iterator_t*)(intptr_t)h)
+        ? JNI_TRUE : JNI_FALSE;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmIterator_nextNative(JNIEnv* env, jclass cls,
+                                             jlong h) {
+    (void)env; (void)cls;
+    tpulsm_iter_next((tpulsm_iterator_t*)(intptr_t)h);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmIterator_prevNative(JNIEnv* env, jclass cls,
+                                             jlong h) {
+    (void)env; (void)cls;
+    tpulsm_iter_prev((tpulsm_iterator_t*)(intptr_t)h);
+}
+
+static jbyteArray iter_bytes_to_java(JNIEnv* env, char* buf, size_t n) {
+    if (buf == NULL) return NULL;
+    jbyteArray out = (*env)->NewByteArray(env, (jsize)n);
+    if (out != NULL) {
+        (*env)->SetByteArrayRegion(env, out, 0, (jsize)n,
+                                   (const jbyte*)buf);
+    }
+    tpulsm_free(buf);
+    return out;
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_toplingdb_TpuLsmIterator_keyNative(JNIEnv* env, jclass cls,
+                                            jlong h) {
+    (void)cls;
+    size_t n = 0;
+    char* buf = tpulsm_iter_key((tpulsm_iterator_t*)(intptr_t)h, &n);
+    return iter_bytes_to_java(env, buf, n);
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_toplingdb_TpuLsmIterator_valueNative(JNIEnv* env, jclass cls,
+                                              jlong h) {
+    (void)cls;
+    size_t n = 0;
+    char* buf = tpulsm_iter_value((tpulsm_iterator_t*)(intptr_t)h, &n);
+    return iter_bytes_to_java(env, buf, n);
+}

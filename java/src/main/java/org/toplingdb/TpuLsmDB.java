@@ -1,0 +1,125 @@
+package org.toplingdb;
+
+/**
+ * Java binding of the toplingdb_tpu engine — the RocksJava role
+ * (reference java/src/main/java/org/rocksdb/RocksDB.java) over the flat C
+ * ABI in toplingdb_tpu/bindings/c (reference db/c.cc), via the JNI glue in
+ * java/jni/tpulsm_jni.c.
+ *
+ * Usage:
+ *   try (TpuLsmDB db = TpuLsmDB.open("/data/db", true)) {
+ *       db.put(key, value);
+ *       byte[] v = db.get(key);
+ *   }
+ *
+ * The engine embeds a Python interpreter (the C ABI handles
+ * initialization); the JVM process needs PYTHONPATH to reach the
+ * toplingdb_tpu package, and java.library.path must contain
+ * libtpulsm_jni.so + libtpulsm_c.so.
+ */
+public class TpuLsmDB implements AutoCloseable {
+    static {
+        System.loadLibrary("tpulsm_jni");
+        initEngine();
+    }
+
+    private long handle;
+
+    private TpuLsmDB(long handle) {
+        this.handle = handle;
+    }
+
+    /** Open (and optionally create) a database at {@code path}. */
+    public static TpuLsmDB open(String path, boolean createIfMissing)
+            throws TpuLsmException {
+        long h = openNative(path, createIfMissing);
+        return new TpuLsmDB(h);
+    }
+
+    public void put(byte[] key, byte[] value) throws TpuLsmException {
+        checkOpen();
+        putNative(handle, key, value);
+    }
+
+    /** @return the value, or null when the key is absent. */
+    public byte[] get(byte[] key) throws TpuLsmException {
+        checkOpen();
+        return getNative(handle, key);
+    }
+
+    public void delete(byte[] key) throws TpuLsmException {
+        checkOpen();
+        deleteNative(handle, key);
+    }
+
+    /** Atomically apply a batch of updates. */
+    public void write(WriteBatch batch) throws TpuLsmException {
+        checkOpen();
+        writeNative(handle, batch.handle());
+    }
+
+    public void flush() throws TpuLsmException {
+        checkOpen();
+        flushNative(handle);
+    }
+
+    public void compactRange() throws TpuLsmException {
+        checkOpen();
+        compactRangeNative(handle);
+    }
+
+    /** Engine property (e.g. "tpulsm.stats"), or null when unknown. */
+    public String getProperty(String name) {
+        if (handle == 0) {
+            return null;
+        }
+        return propertyNative(handle, name);
+    }
+
+    public TpuLsmIterator newIterator() throws TpuLsmException {
+        checkOpen();
+        return new TpuLsmIterator(iteratorNative(handle));
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            closeNative(handle);
+            handle = 0;
+        }
+    }
+
+    private void checkOpen() throws TpuLsmException {
+        if (handle == 0) {
+            throw new TpuLsmException("database is closed");
+        }
+    }
+
+    private static native void initEngine();
+
+    private static native long openNative(String path, boolean create)
+            throws TpuLsmException;
+
+    private static native void closeNative(long h);
+
+    private static native void putNative(long h, byte[] k, byte[] v)
+            throws TpuLsmException;
+
+    private static native byte[] getNative(long h, byte[] k)
+            throws TpuLsmException;
+
+    private static native void deleteNative(long h, byte[] k)
+            throws TpuLsmException;
+
+    private static native void writeNative(long h, long wb)
+            throws TpuLsmException;
+
+    private static native void flushNative(long h) throws TpuLsmException;
+
+    private static native void compactRangeNative(long h)
+            throws TpuLsmException;
+
+    private static native String propertyNative(long h, String name);
+
+    private static native long iteratorNative(long h) throws TpuLsmException;
+}

@@ -1,0 +1,9 @@
+package org.toplingdb;
+
+/** Engine error surfaced through the C ABI's errptr convention (the role
+ *  of the reference's org.rocksdb.RocksDBException). */
+public class TpuLsmException extends Exception {
+    public TpuLsmException(String msg) {
+        super(msg);
+    }
+}

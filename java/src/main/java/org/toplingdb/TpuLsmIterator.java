@@ -1,0 +1,69 @@
+package org.toplingdb;
+
+/** Ordered cursor over the database (reference org.rocksdb.RocksIterator
+ *  over rocksdb_iter_*). Obtain via {@link TpuLsmDB#newIterator()}. */
+public class TpuLsmIterator implements AutoCloseable {
+    private long handle;
+
+    TpuLsmIterator(long handle) {
+        this.handle = handle;
+    }
+
+    public void seekToFirst() {
+        seekToFirstNative(handle);
+    }
+
+    public void seekToLast() {
+        seekToLastNative(handle);
+    }
+
+    public void seek(byte[] target) {
+        seekNative(handle, target);
+    }
+
+    public boolean isValid() {
+        return handle != 0 && validNative(handle);
+    }
+
+    public void next() {
+        nextNative(handle);
+    }
+
+    public void prev() {
+        prevNative(handle);
+    }
+
+    public byte[] key() {
+        return keyNative(handle);
+    }
+
+    public byte[] value() {
+        return valueNative(handle);
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            destroyNative(handle);
+            handle = 0;
+        }
+    }
+
+    private static native void destroyNative(long h);
+
+    private static native void seekToFirstNative(long h);
+
+    private static native void seekToLastNative(long h);
+
+    private static native void seekNative(long h, byte[] target);
+
+    private static native boolean validNative(long h);
+
+    private static native void nextNative(long h);
+
+    private static native void prevNative(long h);
+
+    private static native byte[] keyNative(long h);
+
+    private static native byte[] valueNative(long h);
+}

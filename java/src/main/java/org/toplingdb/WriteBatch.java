@@ -1,0 +1,50 @@
+package org.toplingdb;
+
+/** Atomic update batch (reference org.rocksdb.WriteBatch over
+ *  rocksdb_writebatch_*). */
+public class WriteBatch implements AutoCloseable {
+    private long handle;
+
+    public WriteBatch() {
+        handle = createNative();
+    }
+
+    public void put(byte[] key, byte[] value) throws TpuLsmException {
+        check();
+        putNative(handle, key, value);
+    }
+
+    public void delete(byte[] key) throws TpuLsmException {
+        check();
+        deleteNative(handle, key);
+    }
+
+    long handle() throws TpuLsmException {
+        check();
+        return handle;
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            destroyNative(handle);
+            handle = 0;
+        }
+    }
+
+    private void check() throws TpuLsmException {
+        if (handle == 0) {
+            throw new TpuLsmException("write batch is closed");
+        }
+    }
+
+    private static native long createNative();
+
+    private static native void destroyNative(long h);
+
+    private static native void putNative(long h, byte[] k, byte[] v)
+            throws TpuLsmException;
+
+    private static native void deleteNative(long h, byte[] k)
+            throws TpuLsmException;
+}

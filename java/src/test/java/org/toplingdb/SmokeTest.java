@@ -1,0 +1,60 @@
+package org.toplingdb;
+
+/** End-to-end smoke test (run by java/Makefile's `make test` and the
+ *  pytest gate). Prints JAVA-API-OK and exits 0 on success. */
+public final class SmokeTest {
+    private SmokeTest() { }
+
+    public static void main(String[] args) throws Exception {
+        String path = args.length > 0 ? args[0] : "/tmp/tpulsm_java_smoke";
+        try (TpuLsmDB db = TpuLsmDB.open(path, true)) {
+            db.put(b("hello"), b("world"));
+            expect(eq(db.get(b("hello")), b("world")), "get");
+            expect(db.get(b("missing")) == null, "missing get");
+            db.delete(b("hello"));
+            expect(db.get(b("hello")) == null, "delete");
+
+            try (WriteBatch wb = new WriteBatch()) {
+                wb.put(b("a"), b("1"));
+                wb.put(b("b"), b("2"));
+                wb.delete(b("a"));
+                db.write(wb);
+            }
+            expect(db.get(b("a")) == null, "batch delete");
+            expect(eq(db.get(b("b")), b("2")), "batch put");
+
+            db.put(b("c"), b("3"));
+            int n = 0;
+            try (TpuLsmIterator it = db.newIterator()) {
+                for (it.seekToFirst(); it.isValid(); it.next()) {
+                    expect(it.key() != null && it.value() != null,
+                           "iter kv");
+                    n++;
+                }
+            }
+            expect(n == 2, "iterator count " + n);
+            expect(db.getProperty("tpulsm.estimate-num-keys") != null,
+                   "property");
+            db.flush();
+        }
+        try (TpuLsmDB db = TpuLsmDB.open(path, false)) {
+            expect(eq(db.get(b("b")), b("2")), "durability");
+        }
+        System.out.println("JAVA-API-OK");
+    }
+
+    private static byte[] b(String s) {
+        return s.getBytes(java.nio.charset.StandardCharsets.UTF_8);
+    }
+
+    private static boolean eq(byte[] x, byte[] y) {
+        return java.util.Arrays.equals(x, y);
+    }
+
+    private static void expect(boolean ok, String what) {
+        if (!ok) {
+            System.err.println("FAIL: " + what);
+            System.exit(1);
+        }
+    }
+}

@@ -38,6 +38,79 @@ int main(int argc, char** argv) {
     CHECK(err);
     tpulsm_put(db, "durable", 7, "yes", 3, &err);
     CHECK(err);
+
+    /* write batch: atomic multi-op */
+    tpulsm_writebatch_t* wb = tpulsm_writebatch_create();
+    if (!wb) { fprintf(stderr, "FAIL: writebatch_create\n"); return 1; }
+    tpulsm_writebatch_put(wb, "wb1", 3, "a", 1, &err);
+    CHECK(err);
+    tpulsm_writebatch_put(wb, "wb2", 3, "b", 1, &err);
+    CHECK(err);
+    tpulsm_writebatch_delete(wb, "wb1", 3, &err);
+    CHECK(err);
+    tpulsm_write(db, wb, &err);
+    CHECK(err);
+    tpulsm_writebatch_destroy(wb);
+    v = tpulsm_get(db, "wb2", 3, &n, &err);
+    CHECK(err);
+    if (!v || n != 1 || v[0] != 'b') {
+        fprintf(stderr, "FAIL: writebatch apply\n");
+        return 1;
+    }
+    tpulsm_free(v);
+    v = tpulsm_get(db, "wb1", 3, &n, &err);
+    CHECK(err);
+    if (v) {
+        fprintf(stderr, "FAIL: batch delete did not apply\n");
+        return 1;
+    }
+
+    /* iterator: full forward scan + seek + reverse step */
+    tpulsm_iterator_t* it = tpulsm_create_iterator(db, &err);
+    CHECK(err);
+    int count = 0;
+    for (tpulsm_iter_seek_to_first(it); tpulsm_iter_valid(it);
+         tpulsm_iter_next(it)) {
+        size_t kl = 0, vl = 0;
+        char* k = tpulsm_iter_key(it, &kl);
+        char* val2 = tpulsm_iter_value(it, &vl);
+        if (!k || !val2 || kl == 0) {
+            fprintf(stderr, "FAIL: iter key/value\n");
+            return 1;
+        }
+        tpulsm_free(k);
+        tpulsm_free(val2);
+        count++;
+    }
+    if (count != 2) { /* durable + wb2 */
+        fprintf(stderr, "FAIL: iter count %d != 2\n", count);
+        return 1;
+    }
+    tpulsm_iter_seek(it, "wb", 2);
+    if (!tpulsm_iter_valid(it)) {
+        fprintf(stderr, "FAIL: iter seek\n");
+        return 1;
+    }
+    tpulsm_iter_seek_to_last(it);
+    tpulsm_iter_prev(it);
+    if (!tpulsm_iter_valid(it)) {
+        fprintf(stderr, "FAIL: iter prev\n");
+        return 1;
+    }
+    tpulsm_iter_destroy(it);
+
+    /* property introspection */
+    char* prop = tpulsm_property_value(db, "tpulsm.estimate-num-keys");
+    if (!prop) {
+        fprintf(stderr, "FAIL: property_value\n");
+        return 1;
+    }
+    tpulsm_free(prop);
+    if (tpulsm_property_value(db, "tpulsm.no-such-prop") != NULL) {
+        fprintf(stderr, "FAIL: unknown property not NULL\n");
+        return 1;
+    }
+
     tpulsm_flush(db, &err);
     CHECK(err);
     tpulsm_close(db);

@@ -200,3 +200,231 @@ void tpulsm_compact_range(tpulsm_db_t* db, char** errptr) {
 }
 
 void tpulsm_free(void* ptr) { free(ptr); }
+
+/* -- write batches ------------------------------------------------------ */
+
+struct tpulsm_writebatch_t {
+    PyObject* obj; /* toplingdb_tpu.db.write_batch.WriteBatch */
+};
+
+tpulsm_writebatch_t* tpulsm_writebatch_create(void) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_writebatch_t* out = NULL;
+    PyObject* mod = PyImport_ImportModule("toplingdb_tpu.db.write_batch");
+    PyObject* wb = mod ? PyObject_CallMethod(mod, "WriteBatch", NULL) : NULL;
+    if (wb) {
+        out = (tpulsm_writebatch_t*)malloc(sizeof(*out));
+        if (out) out->obj = wb;
+        else Py_DECREF(wb);
+    } else {
+        PyErr_Clear();
+    }
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_writebatch_destroy(tpulsm_writebatch_t* wb) {
+    if (!wb) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_DECREF(wb->obj);
+    PyGILState_Release(g);
+    free(wb);
+}
+
+void tpulsm_writebatch_put(tpulsm_writebatch_t* wb, const char* key,
+                           size_t keylen, const char* val, size_t vallen,
+                           char** errptr) {
+    if (!wb) {
+        if (errptr) *errptr = dup_cstr("null writebatch handle");
+        return;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(
+        wb->obj, "put", "y#y#", key, (Py_ssize_t)keylen,
+        val, (Py_ssize_t)vallen);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_writebatch_delete(tpulsm_writebatch_t* wb, const char* key,
+                              size_t keylen, char** errptr) {
+    if (!wb) {
+        if (errptr) *errptr = dup_cstr("null writebatch handle");
+        return;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(
+        wb->obj, "delete", "y#", key, (Py_ssize_t)keylen);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_write(tpulsm_db_t* db, tpulsm_writebatch_t* wb, char** errptr) {
+    if (!db || !wb) {
+        if (errptr) *errptr = dup_cstr("null handle");
+        return;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(db->obj, "write", "O", wb->obj);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+/* -- iterators ----------------------------------------------------------- */
+
+struct tpulsm_iterator_t {
+    PyObject* obj;   /* DBIter */
+    char* last_err;  /* sticky key/value failure (tpulsm_iter_get_error) */
+};
+
+tpulsm_iterator_t* tpulsm_create_iterator(tpulsm_db_t* db, char** errptr) {
+    if (!db) {
+        if (errptr) *errptr = dup_cstr("null db handle");
+        return NULL;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_iterator_t* out = NULL;
+    PyObject* it = PyObject_CallMethod(db->obj, "new_iterator", NULL);
+    if (it) {
+        out = (tpulsm_iterator_t*)malloc(sizeof(*out));
+        if (out) {
+            out->obj = it;
+            out->last_err = NULL;
+        } else {
+            Py_DECREF(it);
+            if (errptr) *errptr = dup_cstr("out of memory");
+        }
+    } else {
+        set_err_from_python(errptr);
+    }
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_iter_destroy(tpulsm_iterator_t* it) {
+    if (!it) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_DECREF(it->obj);
+    PyGILState_Release(g);
+    free(it->last_err);
+    free(it);
+}
+
+static void iter_call0(tpulsm_iterator_t* it, const char* name) {
+    if (!it) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(it->obj, name, NULL);
+    if (!r) PyErr_Clear(); /* position ops surface via valid() */
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_iter_seek_to_first(tpulsm_iterator_t* it) {
+    iter_call0(it, "seek_to_first");
+}
+
+void tpulsm_iter_seek_to_last(tpulsm_iterator_t* it) {
+    iter_call0(it, "seek_to_last");
+}
+
+void tpulsm_iter_seek(tpulsm_iterator_t* it, const char* key, size_t keylen) {
+    if (!it) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(
+        it->obj, "seek", "y#", key, (Py_ssize_t)keylen);
+    if (!r) PyErr_Clear();
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+int tpulsm_iter_valid(tpulsm_iterator_t* it) {
+    if (!it) return 0;
+    PyGILState_STATE g = PyGILState_Ensure();
+    int out = 0;
+    PyObject* r = PyObject_CallMethod(it->obj, "valid", NULL);
+    if (r) out = PyObject_IsTrue(r) == 1;
+    else PyErr_Clear();
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_iter_next(tpulsm_iterator_t* it) { iter_call0(it, "next"); }
+
+void tpulsm_iter_prev(tpulsm_iterator_t* it) { iter_call0(it, "prev"); }
+
+static void iter_set_err(tpulsm_iterator_t* it, const char* msg) {
+    free(it->last_err);
+    it->last_err = dup_cstr(msg);
+}
+
+static char* iter_bytes(tpulsm_iterator_t* it, const char* name,
+                        size_t* lenp) {
+    if (lenp) *lenp = 0;
+    if (!it) return NULL;
+    PyGILState_STATE g = PyGILState_Ensure();
+    char* out = NULL;
+    PyObject* r = PyObject_CallMethod(it->obj, name, NULL);
+    if (r) {
+        char* buf = NULL;
+        Py_ssize_t n = 0;
+        if (PyBytes_AsStringAndSize(r, &buf, &n) == 0) {
+            out = (char*)malloc(n > 0 ? (size_t)n : 1);
+            if (out) {
+                memcpy(out, buf, (size_t)n);
+                if (lenp) *lenp = (size_t)n;
+            } else {
+                /* NULL must not read as "no data": record the OOM. */
+                iter_set_err(it, "out of memory");
+            }
+        } else {
+            char* e = NULL;
+            set_err_from_python(&e);
+            iter_set_err(it, e ? e : "non-bytes iterator result");
+            free(e);
+        }
+    } else {
+        char* e = NULL;
+        set_err_from_python(&e);
+        iter_set_err(it, e ? e : "iterator access failed");
+        free(e);
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_iter_get_error(tpulsm_iterator_t* it, char** errptr) {
+    if (it && it->last_err && errptr) *errptr = dup_cstr(it->last_err);
+}
+
+char* tpulsm_iter_key(tpulsm_iterator_t* it, size_t* klen) {
+    return iter_bytes(it, "key", klen);
+}
+
+char* tpulsm_iter_value(tpulsm_iterator_t* it, size_t* vlen) {
+    return iter_bytes(it, "value", vlen);
+}
+
+/* -- introspection ------------------------------------------------------- */
+
+char* tpulsm_property_value(tpulsm_db_t* db, const char* name) {
+    if (!db) return NULL;
+    PyGILState_STATE g = PyGILState_Ensure();
+    char* out = NULL;
+    PyObject* r = PyObject_CallMethod(db->obj, "get_property", "s", name);
+    if (r && r != Py_None) {
+        const char* s = PyUnicode_AsUTF8(r);
+        if (s) out = dup_cstr(s);
+        else PyErr_Clear();
+    } else if (!r) {
+        PyErr_Clear();
+    }
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return out;
+}

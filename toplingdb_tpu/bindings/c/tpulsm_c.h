@@ -42,6 +42,41 @@ void tpulsm_compact_range(tpulsm_db_t* db, char** errptr);
 
 void tpulsm_free(void* ptr);
 
+/* -- write batches (reference rocksdb_writebatch_*) ---------------------- */
+typedef struct tpulsm_writebatch_t tpulsm_writebatch_t;
+tpulsm_writebatch_t* tpulsm_writebatch_create(void);
+void tpulsm_writebatch_destroy(tpulsm_writebatch_t* wb);
+void tpulsm_writebatch_put(tpulsm_writebatch_t* wb, const char* key,
+                           size_t keylen, const char* val, size_t vallen,
+                           char** errptr);
+void tpulsm_writebatch_delete(tpulsm_writebatch_t* wb, const char* key,
+                              size_t keylen, char** errptr);
+/* Atomic apply of the whole batch. */
+void tpulsm_write(tpulsm_db_t* db, tpulsm_writebatch_t* wb, char** errptr);
+
+/* -- iterators (reference rocksdb_iter_*) -------------------------------- */
+typedef struct tpulsm_iterator_t tpulsm_iterator_t;
+tpulsm_iterator_t* tpulsm_create_iterator(tpulsm_db_t* db, char** errptr);
+void tpulsm_iter_destroy(tpulsm_iterator_t* it);
+void tpulsm_iter_seek_to_first(tpulsm_iterator_t* it);
+void tpulsm_iter_seek_to_last(tpulsm_iterator_t* it);
+void tpulsm_iter_seek(tpulsm_iterator_t* it, const char* key, size_t keylen);
+int tpulsm_iter_valid(tpulsm_iterator_t* it);
+void tpulsm_iter_next(tpulsm_iterator_t* it);
+void tpulsm_iter_prev(tpulsm_iterator_t* it);
+/* Key/value of the current position: malloc'd copies (tpulsm_free).
+ * NULL while valid() means an ERROR (OOM or engine failure), never an
+ * empty key — check tpulsm_iter_get_error. */
+char* tpulsm_iter_key(tpulsm_iterator_t* it, size_t* klen);
+char* tpulsm_iter_value(tpulsm_iterator_t* it, size_t* vlen);
+/* Last key/value error on this iterator (rocksdb_iter_get_error role):
+ * sets *errptr to a malloc'd message, or leaves it untouched if none. */
+void tpulsm_iter_get_error(tpulsm_iterator_t* it, char** errptr);
+
+/* -- introspection (reference rocksdb_property_value) -------------------- */
+/* malloc'd property string (tpulsm_free), or NULL when unknown. */
+char* tpulsm_property_value(tpulsm_db_t* db, const char* name);
+
 #ifdef __cplusplus
 }
 #endif

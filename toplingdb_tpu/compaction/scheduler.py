@@ -196,16 +196,6 @@ class CompactionScheduler:
             self.num_completed += 1
         return True
 
-    @staticmethod
-    def _log_and_apply_manifest(db, edit) -> None:
-        """MANIFEST writes get tagged so a failure latches as FATAL
-        (reference BackgroundErrorReason::kManifestWrite)."""
-        try:
-            db.versions.log_and_apply(edit)
-        except BaseException as e:
-            e._bg_reason = "manifest"
-            raise
-
     def _run_compaction(self, c: Compaction) -> None:
         db = self.db
         if not c.output_level_inputs and not c.inputs:
@@ -214,7 +204,7 @@ class CompactionScheduler:
             # Deletion-only compaction.
             edit = make_version_edit(c, [])
             with db._mutex:
-                self._log_and_apply_manifest(db, edit)
+                db.versions.log_and_apply(edit)
                 db._delete_obsolete_files()
             return
         def _bottom_move_ok(f) -> bool:
@@ -241,7 +231,7 @@ class CompactionScheduler:
             edit.delete_file(c.level, meta.number)
             edit.add_file(c.output_level, meta)
             with db._mutex:
-                self._log_and_apply_manifest(db, edit)
+                db.versions.log_and_apply(edit)
             with self._lock:
                 self.num_trivial_moves += 1
             db.event_logger.log(
@@ -309,7 +299,7 @@ class CompactionScheduler:
                     m.marked_for_compaction = False
             edit = make_version_edit(c, outputs)
             with db._mutex:
-                self._log_and_apply_manifest(db, edit)
+                db.versions.log_and_apply(edit)
                 db._delete_obsolete_files()
             from toplingdb_tpu.utils.listener import CompactionJobInfo, notify
 

@@ -1058,35 +1058,25 @@ class DB:
                        self, None)
                 return
             except Exception as err:  # still failing
-                if not getattr(err, "retryable", False):
-                    # `err` may be wait_for_compactions' non-retryable
-                    # WRAPPER around the real latched error — escalating on
-                    # it would turn one failed retry of a transient fault
-                    # into a permanent write outage. Keep retrying as long
-                    # as the LATCHED error is still a retryable one.
-                    with self._mutex:
-                        latched = self._bg_error
-                    if latched is not None and getattr(
-                            latched, "retryable", False):
-                        target = latched
-                        continue
-                    if latched is None:
-                        self._set_background_error(err)  # genuine new error
-                    return
+                # ONE thread per latched error: chase only `target`. A new
+                # error latched through _set_background_error spawns its
+                # own successor thread, so any identity mismatch means
+                # this thread's watch is over — re-targeting here would
+                # leave two loops calling resume() concurrently.
                 with self._mutex:
-                    if self._bg_error is None:
-                        self._bg_error = err
-                        self._bg_error_severity = self._classify_bg_error(
-                            err, "flush"
-                        )
-                    elif self._bg_error is not err:
-                        if getattr(self._bg_error, "retryable", False):
-                            # the scheduler re-latched its own retryable
-                            # error; chase that one instead of exiting
-                            target = self._bg_error
-                            continue
-                        return  # worse error latched; not ours to clear
-                target = err
+                    latched = self._bg_error
+                if latched is target and getattr(
+                        target, "retryable", False):
+                    continue  # still our transient error; keep retrying
+                if latched is None:
+                    # Our retry cleared the old latch but then failed with
+                    # a fresh error nothing latched yet: go through the
+                    # front door (classification + successor thread) and
+                    # bow out.
+                    self._set_background_error(
+                        err, getattr(err, "_bg_reason", "flush")
+                    )
+                return
         self.event_logger.log("auto_recovery_gave_up", attempts=max_attempts)
 
     def resume(self) -> None:

@@ -379,7 +379,20 @@ class VersionSet:
     def log_and_apply(self, edit: VersionEdit, sync: bool = True) -> None:
         """Append edit to MANIFEST and install the resulting Version for the
         edit's column family (reference VersionSet::LogAndApply,
-        version_set.cc:6033)."""
+        version_set.cc:6033). Failures are tagged _bg_reason="manifest" so
+        the DB's ErrorHandler latches them FATAL no matter which caller
+        surfaced them (reference BackgroundErrorReason::kManifestWrite)."""
+        try:
+            self._log_and_apply_locked(edit, sync)
+        except BaseException as e:
+            try:
+                e._bg_reason = "manifest"
+            except AttributeError:
+                pass  # exceptions with __slots__: classification falls back
+            raise
+
+    def _log_and_apply_locked(self, edit: VersionEdit,
+                              sync: bool = True) -> None:
         with self._lock:
             cf = edit.column_family
             st = self.column_families.get(cf)

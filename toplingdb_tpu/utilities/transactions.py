@@ -131,7 +131,7 @@ class RangeLockManager:
         self._ranges: list[list] = []  # [begin, end, owner], sorted by begin
         self._max_per_txn = max_ranges_per_txn
         self._counts: dict[int, int] = {}
-        self._waits_for: dict[int, int] = {}
+        self._waits_for: dict[int, set[int]] = {}
 
     # -- internals (all under self._cv) --------------------------------
 
@@ -401,7 +401,13 @@ class TransactionDB:
             db.env.create_dir(self._txn_dir)
         except Exception:
             pass
-        self._recover_prepared()
+        try:
+            self._recover_prepared()
+        except BaseException:
+            # A recovery refusal (e.g. prepared range locks without
+            # use_range_locking) must not leak the fully-opened DB.
+            db.close()
+            raise
 
     def _register_name(self, name: str) -> None:
         with self._names_mu:
