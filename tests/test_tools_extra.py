@@ -79,3 +79,29 @@ def test_sst_file_writer_fuzz(tmp_path, seed):
         it2.seek_to_first()
         for _ in it2.entries():
             pass
+
+
+def test_db_bench_full_workload_matrix(tmp_path, capsys):
+    """Every dispatchable workload runs green (the reference's ~40-name
+    dispatch table, tools/db_bench_tool.cc:3784-3893)."""
+    import re
+
+    from toplingdb_tpu.tools import db_bench
+
+    names = ("fillseq,readseq,readreverse,readrandom,readmissing,readhot,"
+             "seekrandom,fillrandom,overwrite,updaterandom,appendrandom,"
+             "readrandomwriterandom,mergerandom,readwhilemerging,"
+             "readwhilewriting,seekrandomwhilewriting,multireadrandom,"
+             "fillsync,fill100K,fillseekseq,deleterandom,deleteseq,flush,"
+             "compact,compactall,waitforcompaction,verifychecksum,crc32c,"
+             "xxhash,stats,levelstats,sstables,memstats,randomtransaction")
+    rc = db_bench.main([
+        "--num=400", f"--db={tmp_path / 'bench'}",
+        f"--benchmarks={names}",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for name in names.split(","):
+        assert re.search(rf"^{name} ", out, re.M), \
+            f"workload {name} produced no report line"
+    assert "unknown benchmark" not in out

@@ -40,14 +40,20 @@ class Bench:
             from toplingdb_tpu.utils.statistics import Statistics
 
             self.options.statistics = Statistics()
+        self.db: DB | None = None
         if ("mergerandom" in args.benchmarks
-                and self.options.merge_operator is None):
-            # mergerandom writes uint64 operands; reads after it would fail
-            # with MergeInProgress without an operator.
+                or "readwhilemerging" in args.benchmarks):
+            # merge workloads write uint64 operands; reads after them would
+            # fail with MergeInProgress without an operator.
+            self._ensure_merge_operator()
+
+    def _ensure_merge_operator(self) -> None:
+        if self.options.merge_operator is None:
             from toplingdb_tpu.utils.merge_operator import UInt64AddOperator
 
             self.options.merge_operator = UInt64AddOperator()
-        self.db: DB | None = None
+            if self.db is not None:
+                self.open_db(fresh=False)
 
     def key(self, i: int) -> bytes:
         return b"%016d" % i
@@ -171,37 +177,230 @@ class Bench:
             done += len(ks)
         return n
 
-    def bench_readwhilewriting(self, n):
+    def _with_background(self, bg_op, fg_bench, n):
+        """Run fg_bench(n) while a daemon thread loops bg_op(i) — the
+        shared scaffold of the *while-writing / *while-merging mixes."""
         import threading
 
-        stop = []
+        stop = threading.Event()
 
-        def writer():
+        def loop():
             i = 0
-            while not stop:
-                self.db.put(self.key(self.rng.randrange(self.args.num)),
-                            self.value(i))
+            while not stop.is_set():
+                bg_op(i)
                 i += 1
 
-        t = threading.Thread(target=writer, daemon=True)
+        t = threading.Thread(target=loop, daemon=True)
         t.start()
         try:
-            return self.bench_readrandom(n)
+            return fg_bench(n)
         finally:
-            stop.append(1)
+            stop.set()
             t.join()
+
+    def bench_readwhilewriting(self, n):
+        return self._with_background(
+            lambda i: self.db.put(
+                self.key(self.rng.randrange(self.args.num)), self.value(i)
+            ),
+            self.bench_readrandom, n,
+        )
 
     def bench_deleteseq(self, n):
         for i in range(n):
             self.db.delete(self.key(i))
         return n
 
+    def bench_deleterandom(self, n):
+        for _ in range(n):
+            self.db.delete(self.key(self.rng.randrange(self.args.num)))
+        return n
+
+    def bench_fillsync(self, n):
+        wo = WriteOptions(sync=True)
+        m = min(n, max(1, n // 100))  # reference runs num/100 synced writes
+        for i in range(m):
+            self.db.put(self.key(self.rng.randrange(n)), self.value(i), wo)
+        return m
+
+    def bench_fill100K(self, n):
+        wo = WriteOptions(disable_wal=self.args.disable_wal)
+        m = min(n, max(1, n // 1000))
+        big = b"x" * 100_000
+        for i in range(m):
+            self.db.put(self.key(i), big, wo)
+        return m
+
+    def bench_readmissing(self, n):
+        ro = ReadOptions()
+        for _ in range(n):
+            # '.' suffix never collides with written keys.
+            self.db.get(self.key(self.rng.randrange(self.args.num)) + b".",
+                        ro)
+        return n
+
+    def bench_readhot(self, n):
+        ro = ReadOptions()
+        span = max(1, self.args.num // 100)  # hottest 1% of the key space
+        for _ in range(n):
+            self.db.get(self.key(self.rng.randrange(span)), ro)
+        return n
+
+    def bench_readreverse(self, n):
+        it = self.db.new_iterator()
+        it.seek_to_last()
+        count = 0
+        while it.valid() and count < n:
+            it.key(), it.value()
+            it.prev()
+            count += 1
+        return count
+
+    def bench_updaterandom(self, n):
+        # read-modify-write (reference updaterandom)
+        ro = ReadOptions()
+        wo = WriteOptions(disable_wal=self.args.disable_wal)
+        for i in range(n):
+            k = self.key(self.rng.randrange(self.args.num))
+            self.db.get(k, ro)
+            self.db.put(k, self.value(i), wo)
+        return n
+
+    def bench_appendrandom(self, n):
+        ro = ReadOptions()
+        wo = WriteOptions(disable_wal=self.args.disable_wal)
+        for i in range(n):
+            k = self.key(self.rng.randrange(self.args.num))
+            old = self.db.get(k, ro) or b""
+            self.db.put(k, (old + self.value(i))[:1024], wo)
+        return n
+
+    def bench_readrandomwriterandom(self, n):
+        ro = ReadOptions()
+        wo = WriteOptions(disable_wal=self.args.disable_wal)
+        for i in range(n):
+            k = self.key(self.rng.randrange(self.args.num))
+            if i % 10 < 9:  # reference readwritepercent default: 90% reads
+                self.db.get(k, ro)
+            else:
+                self.db.put(k, self.value(i), wo)
+        return n
+
+    def bench_readwhilemerging(self, n):
+        import struct
+
+        self._ensure_merge_operator()
+        return self._with_background(
+            lambda i: self.db.merge(
+                self.key(self.rng.randrange(self.args.num)),
+                struct.pack("<Q", 1),
+            ),
+            self.bench_readrandom, n,
+        )
+
+    def bench_seekrandomwhilewriting(self, n):
+        return self._with_background(
+            lambda i: self.db.put(
+                self.key(self.rng.randrange(self.args.num)), self.value(i)
+            ),
+            self.bench_seekrandom, n,
+        )
+
+    def bench_fillseekseq(self, n):
+        # Sequential writes interleaved with a seek to every 16th
+        # just-written key (the reference's fillseekseq write+seek mix).
+        wo = WriteOptions(disable_wal=self.args.disable_wal)
+        for i in range(n):
+            self.db.put(self.key(i), self.value(i), wo)
+            if i % 16 == 0:
+                it = self.db.new_iterator()
+                it.seek(self.key(i))
+                assert it.valid() and it.key() == self.key(i)
+        return n
+
+    def bench_randomtransaction(self, n):
+        from toplingdb_tpu.utilities.transactions import TransactionDB
+
+        # Each txn moves "value" between 4 random accounts atomically
+        # (reference randomtransaction's bank workload shape).
+        self.db.close()
+        tdb = TransactionDB.open(self.args.db, self.options)
+        try:
+            m = max(1, n // 10)
+            for _ in range(m):
+                t = tdb.begin_transaction()
+                for _ in range(4):
+                    k = self.key(self.rng.randrange(self.args.num))
+                    v = t.get(k) or b"0"
+                    t.put(k, v[:64] + b"+")
+                t.commit()
+            return m * 4
+        finally:
+            tdb.close()
+            self.db = DB.open(self.args.db, self.options)
+
     def bench_compact(self, n):
         self.db.compact_range()
         return 1
 
+    def bench_compactall(self, n):
+        return self.bench_compact(n)
+
+    def bench_waitforcompaction(self, n):
+        self.db.wait_for_compactions()
+        return 1
+
+    def bench_flush(self, n):
+        self.db.flush()
+        return 1
+
+    def bench_verifychecksum(self, n):
+        # The engine's own checksum sweep (reference DB::VerifyChecksum) —
+        # it pins/locks correctly and closes its readers.
+        self.db.verify_checksum()
+        return 1
+
+    def bench_crc32c(self, n):
+        from toplingdb_tpu.utils import crc32c
+
+        block = b"x" * 4096
+        for _ in range(n):
+            crc32c.value(block)
+        return n
+
+    def bench_xxhash(self, n):
+        from toplingdb_tpu.utils import crc32c
+
+        block = b"x" * 4096
+        for _ in range(n):
+            crc32c.xxh64(block)
+        return n
+
     def bench_stats(self, n):
         print(self.db.get_property("tpulsm.stats"))
+        return 1
+
+    def bench_levelstats(self, n):
+        print(self.db.get_property("tpulsm.levelstats"))
+        return 1
+
+    def bench_sstables(self, n):
+        from toplingdb_tpu.db.dbformat import extract_user_key
+
+        for cf_id in self.db.versions.column_families:
+            v = self.db.versions.cf_current(cf_id)
+            for level, level_files in enumerate(v.files):
+                for f in level_files:
+                    print(f"cf{cf_id} L{level} #{f.number} "
+                          f"{f.file_size}B "
+                          f"[{extract_user_key(f.smallest)!r} .. "
+                          f"{extract_user_key(f.largest)!r}]")
+        return 1
+
+    def bench_memstats(self, n):
+        for cf_id, cfd in self.db._cfs.items():
+            print(f"cf{cf_id} mem_entries={cfd.mem.num_entries} "
+                  f"imm={len(cfd.imm)}")
         return 1
 
 
