@@ -1,0 +1,265 @@
+"""PlainTable format + SliceTransform / prefix-bloom / prefix-iteration.
+
+Covers the reference's table/plain/ (prefix hash index, binary search in
+bucket), SliceTransform (include/rocksdb/slice_transform.h), prefix bloom
+filters (whole_key_filtering=false) and ReadOptions.prefix_same_as_start.
+"""
+
+import pytest
+
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.dbformat import BYTEWISE, InternalKeyComparator
+from toplingdb_tpu.env.env import default_env
+from toplingdb_tpu.options import Options, ReadOptions
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.table.factory import new_table_builder, open_table
+from toplingdb_tpu.table.plain import PlainTableReader
+from toplingdb_tpu.utils.slice_transform import (
+    CappedPrefixTransform,
+    FixedPrefixTransform,
+    NoopTransform,
+    slice_transform_from_name,
+)
+from toplingdb_tpu.utils.status import InvalidArgument
+
+ICMP = InternalKeyComparator(BYTEWISE)
+
+
+def ik(uk: bytes, seq: int = 1, t: int = dbformat.ValueType.VALUE) -> bytes:
+    return dbformat.make_internal_key(uk, seq, t)
+
+
+# -- SliceTransform ------------------------------------------------------
+
+def test_slice_transforms():
+    f = FixedPrefixTransform(3)
+    assert f.transform(b"abcdef") == b"abc"
+    assert f.in_domain(b"abc") and not f.in_domain(b"ab")
+    c = CappedPrefixTransform(3)
+    assert c.transform(b"ab") == b"ab" and c.in_domain(b"a")
+    n = NoopTransform()
+    assert n.transform(b"xy") == b"xy"
+    for t in (f, c, n):
+        rt = slice_transform_from_name(t.name())
+        assert rt is not None and rt.name() == t.name()
+    assert slice_transform_from_name("custom.whatever") is None
+
+
+# -- plain table build/read ---------------------------------------------
+
+def _build_plain(tmp_path, entries, topts=None):
+    env = default_env()
+    topts = topts or TableOptions(
+        format="plain", prefix_extractor=FixedPrefixTransform(4)
+    )
+    path = str(tmp_path / "t.sst")
+    b = new_table_builder(env.new_writable_file(path), ICMP, topts)
+    for k, v in entries:
+        b.add(k, v)
+    b.finish()
+    return env, path, topts
+
+
+def test_plain_requires_extractor(tmp_path):
+    env = default_env()
+    with pytest.raises(InvalidArgument):
+        new_table_builder(
+            env.new_writable_file(str(tmp_path / "x.sst")), ICMP,
+            TableOptions(format="plain"),
+        )
+
+
+def test_plain_build_and_probe(tmp_path):
+    entries = []
+    for grp in (b"aaaa", b"bbbb", b"cccc"):
+        for i in range(5):
+            entries.append((ik(grp + b"%02d" % i, seq=10 + i), b"v" + grp))
+    # short (out-of-domain) key
+    entries.append((ik(b"zz", seq=3), b"short"))
+    entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))
+    env, path, topts = _build_plain(tmp_path, entries)
+
+    r = open_table(env.new_random_access_file(path), ICMP, topts)
+    assert isinstance(r, PlainTableReader)
+    assert r.has_hash_index
+    assert r.properties.prefix_extractor_name.startswith("tpulsm.FixedPrefix")
+
+    # in-domain hits: newest version ordinal
+    o = r.hash_probe(b"bbbb03")
+    assert o is not None
+    assert r._entry(o)[0][:-8] == b"bbbb03"
+    # in-domain miss within an existing group
+    assert r.hash_probe(b"bbbb99") is None
+    # miss: nonexistent prefix group
+    assert r.hash_probe(b"qqqq00") is None
+    # out-of-domain fallback
+    o = r.hash_probe(b"zz")
+    assert o is not None and r._entry(o)[1] == b"short"
+    assert r.hash_probe(b"z") is None
+    # prefix group entry point
+    s = r.prefix_seek_start(b"cccc")
+    assert s is not None and r._entry(s)[0][:-8] == b"cccc00"
+    assert r.prefix_seek_start(b"dddd") is None
+    # iteration still total-order
+    it = r.new_iterator()
+    it.seek_to_first()
+    keys = [k for k, _ in it.entries()]
+    assert keys == [k for k, _ in entries]
+
+
+def test_plain_newest_version_wins(tmp_path):
+    entries = [
+        (ik(b"aaaak", seq=9), b"new"),
+        (ik(b"aaaak", seq=5), b"old"),
+    ]
+    env, path, topts = _build_plain(tmp_path, entries)
+    r = open_table(env.new_random_access_file(path), ICMP, topts)
+    o = r.hash_probe(b"aaaak")
+    assert r._entry(o)[1] == b"new"
+
+
+# -- prefix bloom --------------------------------------------------------
+
+def test_prefix_only_filter_block_format(tmp_path):
+    env = default_env()
+    topts = TableOptions(
+        prefix_extractor=FixedPrefixTransform(4), whole_key_filtering=False
+    )
+    path = str(tmp_path / "b.sst")
+    b = new_table_builder(env.new_writable_file(path), ICMP, topts)
+    n = 0
+    for g in range(3):
+        for i in range(70):
+            n += 1
+            b.add(ik(b"pre%d-%04d" % (g, i), seq=n), b"v")
+    b.finish()
+    r = open_table(env.new_random_access_file(path), ICMP)
+    assert r.properties.whole_key_filtering == 0
+    # same prefix, absent key → filter can NOT rule it out
+    assert r.key_may_match(b"pre0-9999")
+    # absent prefix → almost surely ruled out
+    hits = sum(r.key_may_match(b"zzz%d-far" % i) for i in range(50))
+    assert hits <= 5
+    # prefix probe surface
+    assert r.prefix_may_match(b"pre0")
+
+
+# -- end-to-end DB with plain format + prefix iteration ------------------
+
+def test_db_plain_format_end_to_end(tmp_path):
+    from toplingdb_tpu.db.db import DB
+
+    opts = Options(
+        prefix_extractor=FixedPrefixTransform(4),
+        table_options=TableOptions(format="plain"),
+        write_buffer_size=1 << 20,
+        memtable_rep="hash_skiplist:4",
+    )
+    db = DB.open(str(tmp_path / "db"), opts)
+    for g in (b"user", b"item", b"sess"):
+        for i in range(30):
+            db.put(g + b"%03d" % i, b"val-" + g + b"%03d" % i)
+    db.flush()
+    db.put(b"user001", b"overwritten")  # in memtable, over an SST value
+    assert db.get(b"user005") == b"val-user005"
+    assert db.get(b"user001") == b"overwritten"
+    assert db.get(b"none999") is None
+
+    # prefix_same_as_start: stops at the end of the prefix group
+    it = db.new_iterator(ReadOptions(prefix_same_as_start=True))
+    it.seek(b"item010")
+    got = [k for k, _ in it.entries()]
+    assert got == [b"item%03d" % i for i in range(10, 30)]
+
+    # total_order_seek overrides prefix mode
+    it = db.new_iterator(
+        ReadOptions(prefix_same_as_start=True, total_order_seek=True)
+    )
+    it.seek(b"item010")
+    got = [k for k, _ in it.entries()]
+    assert got[-1] == b"user029" and len(got) == 20 + 30 + 30
+
+    db.close()
+
+
+def test_db_plain_compaction_roundtrip(tmp_path):
+    from toplingdb_tpu.db.db import DB
+
+    opts = Options(
+        prefix_extractor=FixedPrefixTransform(4),
+        table_options=TableOptions(format="plain"),
+        level0_file_num_compaction_trigger=100,  # manual compact only
+    )
+    db = DB.open(str(tmp_path / "db"), opts)
+    for i in range(50):
+        db.put(b"pfx%05d" % i, b"v%d" % i)
+    db.flush()
+    for i in range(0, 50, 2):
+        db.put(b"pfx%05d" % i, b"w%d" % i)
+    db.flush()
+    db.compact_range()
+    for i in range(50):
+        want = b"w%d" % i if i % 2 == 0 else b"v%d" % i
+        assert db.get(b"pfx%05d" % i) == want
+    db.close()
+
+
+def test_extractor_change_across_reopen(tmp_path):
+    """Old files keep answering probes via their RECORDED extractor even
+    when the live options extractor changed (resolve_file_extractor)."""
+    entries = [(ik(b"aaaabbbb", seq=4), b"v1"), (ik(b"ccccdddd", seq=5), b"v2")]
+    env, path, _ = _build_plain(
+        tmp_path, entries,
+        TableOptions(format="plain", prefix_extractor=FixedPrefixTransform(4)),
+    )
+    # reopen with an 8-byte extractor: probes must still hit
+    r = open_table(
+        env.new_random_access_file(path), ICMP,
+        TableOptions(format="plain", prefix_extractor=FixedPrefixTransform(8)),
+    )
+    o = r.hash_probe(b"aaaabbbb")
+    assert o is not None and r._entry(o)[1] == b"v1"
+    # prefix-only bloom, same scenario: no false negatives
+    topts = TableOptions(
+        prefix_extractor=FixedPrefixTransform(4), whole_key_filtering=False
+    )
+    p2 = str(tmp_path / "b2.sst")
+    b = new_table_builder(env.new_writable_file(p2), ICMP, topts)
+    b.add(ik(b"aaaabbbb", seq=1), b"v")
+    b.finish()
+    r2 = open_table(
+        env.new_random_access_file(p2), ICMP,
+        TableOptions(prefix_extractor=FixedPrefixTransform(8)),
+    )
+    assert r2.key_may_match(b"aaaabbbb")
+
+
+def test_seek_to_first_with_lower_bound_is_total_order(tmp_path):
+    from toplingdb_tpu.db.db import DB
+
+    opts = Options(prefix_extractor=FixedPrefixTransform(2))
+    db = DB.open(str(tmp_path / "db"), opts)
+    db.put(b"aab", b"1")
+    db.put(b"ac1", b"2")
+    it = db.new_iterator(ReadOptions(
+        prefix_same_as_start=True, iterate_lower_bound=b"aa"
+    ))
+    it.seek_to_first()
+    assert [k for k, _ in it.entries()] == [b"aab", b"ac1"]
+    # but an explicit Seek still arms prefix mode
+    it = db.new_iterator(ReadOptions(prefix_same_as_start=True))
+    it.seek(b"aa")
+    assert [k for k, _ in it.entries()] == [b"aab"]
+    db.close()
+
+
+def test_options_config_roundtrip_prefix():
+    from toplingdb_tpu.utils.config import (
+        options_from_config, options_to_config,
+    )
+
+    opts = Options(prefix_extractor=FixedPrefixTransform(7))
+    cfg = options_to_config(opts)
+    assert cfg["prefix_extractor"]["params"]["length"] == 7
+    opts2 = options_from_config(cfg)
+    assert opts2.prefix_extractor.name() == opts.prefix_extractor.name()

@@ -141,6 +141,10 @@ class CompactionParams:
     block_size: int
     creation_time: int
     table_format: str = "block"
+    # SliceTransform serialized name (utils/slice_transform.py) or None —
+    # required when table_format == 'plain' (prefix hash index) and feeds
+    # prefix blooms for the other formats.
+    prefix_extractor: str | None = None
     smallest_seqno_guard: int = 0
     device: str = "cpu"
     cf_id: int = 0
@@ -275,6 +279,11 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             creation_time=int(time.time()),
             device=self.device,
             table_format=getattr(opts.table_options, "format", "block"),
+            prefix_extractor=(
+                opts.table_options.prefix_extractor.name()
+                if getattr(opts.table_options, "prefix_extractor", None)
+                else None
+            ),
             cf_id=compaction.cf_id,
             cf_name=db.cf_name(compaction.cf_id),
             collectors=[

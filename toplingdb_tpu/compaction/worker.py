@@ -60,9 +60,15 @@ def run_job(job_dir: str) -> int:
         create_collector_factory,
     )
 
+    from toplingdb_tpu.utils.slice_transform import slice_transform_from_name
+
     topts = TableOptions(
         block_size=params.block_size, compression=params.compression,
         format=getattr(params, "table_format", "block"),
+        prefix_extractor=(
+            slice_transform_from_name(params.prefix_extractor)
+            if getattr(params, "prefix_extractor", None) else None
+        ),
         properties_collector_factories=[
             create_collector_factory(d)
             for d in getattr(params, "collectors", [])

@@ -93,6 +93,30 @@ class DB:
         self.options = options
         self.env = env
         self.icmp = InternalKeyComparator(options.comparator)
+        if (options.prefix_extractor is not None
+                and options.table_options.prefix_extractor is None):
+            # CF-level extractor feeds the table layer (prefix blooms, plain
+            # format), like reference CFOptions.prefix_extractor does.
+            options.table_options.prefix_extractor = options.prefix_extractor
+        if getattr(options.table_options, "format", "block") == "plain":
+            # Fail at open, not in a background flush/compaction job.
+            from toplingdb_tpu.utils.slice_transform import (
+                slice_transform_from_name,
+            )
+            from toplingdb_tpu.utils.status import InvalidArgument
+
+            pe = options.table_options.prefix_extractor
+            if pe is None:
+                raise InvalidArgument(
+                    "plain table format requires Options.prefix_extractor"
+                )
+            if (options.compaction_executor_factory is not None
+                    and slice_transform_from_name(pe.name()) is None):
+                raise InvalidArgument(
+                    "plain format with a remote compaction executor needs a "
+                    "stock prefix_extractor (fixed/capped/noop) — custom "
+                    "extractors can't be reconstructed by workers"
+                )
         self.versions = VersionSet(env, dbname, self.icmp, options.num_levels)
         self.table_cache = TableCache(env, dbname, self.icmp,
                                       options.table_options,
@@ -952,6 +976,10 @@ class DB:
                 upper_bound=opts.iterate_upper_bound,
                 pinned=version,
                 blob_resolver=self.blob_source.get,
+                prefix_extractor=self.options.prefix_extractor,
+                prefix_same_as_start=(
+                    opts.prefix_same_as_start and not opts.total_order_seek
+                ),
             )
             if opts.snapshot is None:
                 # Refresh re-reads at the LATEST sequence; snapshot-pinned

@@ -18,7 +18,8 @@ class DBIter:
                  range_del_agg=None, merge_operator=None,
                  lower_bound: bytes | None = None,
                  upper_bound: bytes | None = None,
-                 pinned=None, blob_resolver=None):
+                 pinned=None, blob_resolver=None,
+                 prefix_extractor=None, prefix_same_as_start: bool = False):
         self._blob_resolver = blob_resolver
         # `pinned` keeps the source Version (and anything else) alive for the
         # iterator's lifetime so obsolete-file GC cannot delete SSTs that
@@ -36,6 +37,11 @@ class DBIter:
         self._key: bytes | None = None
         self._value: bytes | None = None
         self._refresh_fn = None  # set by DB.new_iterator
+        # Prefix-mode iteration (reference ReadOptions.prefix_same_as_start):
+        # after Seek, the iterator dies at the end of the seek target's
+        # prefix group. Armed per-Seek; total-order entry points clear it.
+        self._pe = prefix_extractor if prefix_same_as_start else None
+        self._prefix: bytes | None = None
 
     def refresh(self) -> None:
         """Rebind to the DB's CURRENT state (reference Iterator::Refresh):
@@ -64,15 +70,23 @@ class DBIter:
         return self._value
 
     def seek_to_first(self) -> None:
+        # Total-order entry point: never arms prefix mode, even when a lower
+        # bound redirects it through a seek.
+        self._prefix = None
         if self._lower is not None:
-            self.seek(self._lower)
+            self._seek_impl(self._lower, arm_prefix=False)
             return
         self._iter.seek_to_first()
         self._find_next_user_entry(skip_key=None)
 
     def seek(self, user_key: bytes) -> None:
+        self._seek_impl(user_key, arm_prefix=True)
+
+    def _seek_impl(self, user_key: bytes, arm_prefix: bool) -> None:
         if self._lower is not None and self._ucmp.compare(user_key, self._lower) < 0:
             user_key = self._lower
+        if arm_prefix:
+            self._arm_prefix(user_key)
         target = dbformat.make_internal_key(
             user_key, self._seq, dbformat.VALUE_TYPE_FOR_SEEK
         )
@@ -80,6 +94,7 @@ class DBIter:
         self._find_next_user_entry(skip_key=None)
 
     def seek_to_last(self) -> None:
+        self._prefix = None
         if self._upper is not None:
             # Upper bound is exclusive: (upper, MAX_SEQ, FOR_SEEK) sorts before
             # every entry of user key `upper`, so seek_for_prev lands strictly
@@ -95,6 +110,7 @@ class DBIter:
         self._find_prev_user_entry()
 
     def seek_for_prev(self, user_key: bytes) -> None:
+        self._arm_prefix(user_key)
         target = dbformat.make_internal_key(user_key, 0, 0)
         # All entries for user_key sort before target's successor; position at
         # the last entry <= (user_key, seq 0): that's the oldest entry of
@@ -132,6 +148,19 @@ class DBIter:
 
     # -- internals ------------------------------------------------------
 
+    def _arm_prefix(self, seek_key: bytes) -> None:
+        self._prefix = (
+            self._pe.transform(seek_key)
+            if self._pe is not None and self._pe.in_domain(seek_key)
+            else None
+        )
+
+    def _out_of_prefix(self, uk: bytes) -> bool:
+        return self._prefix is not None and (
+            not self._pe.in_domain(uk)
+            or self._pe.transform(uk) != self._prefix
+        )
+
     def _out_of_upper(self, uk: bytes) -> bool:
         return self._upper is not None and self._ucmp.compare(uk, self._upper) >= 0
 
@@ -152,7 +181,7 @@ class DBIter:
         while self._iter.valid():
             ikey = self._iter.key()
             uk, seq, t = dbformat.split_internal_key(ikey)
-            if self._out_of_upper(uk):
+            if self._out_of_upper(uk) or self._out_of_prefix(uk):
                 break
             if skip_key is not None and self._ucmp.compare(uk, skip_key) <= 0:
                 self._iter.next()
@@ -214,7 +243,7 @@ class DBIter:
         before the internal iterator's position, scanning backward."""
         while self._iter.valid():
             uk = dbformat.extract_user_key(self._iter.key())
-            if self._out_of_lower(uk):
+            if self._out_of_lower(uk) or self._out_of_prefix(uk):
                 break
             if self._out_of_upper(uk):
                 self._iter.prev()

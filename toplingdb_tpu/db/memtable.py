@@ -334,6 +334,16 @@ def create_memtable_rep(name: str) -> MemTableRep:
         return HashPrefixRep()
     from toplingdb_tpu.utils.status import InvalidArgument
 
+    if name.startswith(("hash_skiplist:", "hash_linklist:", "prefix_hash:")):
+        # 'hash_skiplist:N' buckets by an N-byte prefix (matches a
+        # FixedPrefixTransform(N) CF extractor).
+        try:
+            plen = int(name.split(":", 1)[1])
+        except ValueError as e:
+            raise InvalidArgument(f"bad memtable rep prefix len in {name!r}") from e
+        if plen <= 0:
+            raise InvalidArgument(f"memtable rep prefix len must be positive: {name!r}")
+        return HashPrefixRep(prefix_len=plen)
     raise InvalidArgument(f"unknown memtable rep {name!r}")
 
 

@@ -26,6 +26,12 @@ class Options:
     comparator: Comparator = field(default_factory=lambda: BYTEWISE)
     merge_operator: Any = None          # MergeOperator instance or None
     compaction_filter: Any = None
+    # SliceTransform (utils/slice_transform.py) or None (reference
+    # ColumnFamilyOptions.prefix_extractor): enables prefix bloom filters,
+    # the 'plain' table format's prefix hash index, and
+    # ReadOptions.prefix_same_as_start iteration. Propagated into
+    # table_options at open.
+    prefix_extractor: Any = None
 
     # -- write path -----------------------------------------------------
     memtable_rep: str = "skiplist"       # 'skiplist' (native C++) | 'vector'
@@ -139,6 +145,13 @@ class ReadOptions:
     # chains on parallel threads (pread releases the GIL).
     async_io: bool = False
     async_queue_depth: int = 8
+    # Prefix-mode iteration (reference ReadOptions.prefix_same_as_start):
+    # an iterator becomes invalid once it leaves the prefix group of its
+    # Seek target (requires Options.prefix_extractor).
+    prefix_same_as_start: bool = False
+    # Escape hatch (reference total_order_seek): ignore prefix mode for this
+    # read even when prefix_same_as_start defaults have been configured.
+    total_order_seek: bool = False
 
 
 @dataclass

@@ -54,6 +54,11 @@ class TableOptions:
     compression: int = fmt.NO_COMPRESSION
     filter_policy: FilterPolicy | None = field(default_factory=BloomFilterPolicy)
     whole_key_filtering: bool = True
+    # SliceTransform (utils/slice_transform.py) or None. When set, key
+    # prefixes ALSO go into the bloom filter (reference prefix bloom,
+    # FullFilterBlockBuilder), readers can probe prefix_may_match(), and the
+    # 'plain' format builds its prefix hash index from it.
+    prefix_extractor: object | None = None
     verify_checksums: bool = True
     # User TablePropertiesCollectorFactory list (reference
     # table_properties_collector_factories); a fresh collector per SST.
@@ -83,6 +88,7 @@ class TableBuilder:
         )
         self._index_entries: list[tuple[bytes, bytes]] = []  # two-level only
         self._filter_keys: list[bytes] = []
+        self._last_filter_prefix: bytes | None = None
         self._range_del_block = BlockBuilder(restart_interval=1)
         self.props = TableProperties(
             comparator_name=icmp.user_comparator.name(),
@@ -90,10 +96,15 @@ class TableBuilder:
                 self.opts.filter_policy.name() if self.opts.filter_policy else ""
             ),
             compression_name=str(self.opts.compression),
+            prefix_extractor_name=(
+                self.opts.prefix_extractor.name()
+                if self.opts.prefix_extractor else ""
+            ),
             column_family_id=column_family_id,
             column_family_name=column_family_name,
             creation_time=creation_time,
             smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
+            whole_key_filtering=1 if self.opts.whole_key_filtering else 0,
         )
         self._last_key: bytes | None = None
         self._pending_index_entry = False
@@ -164,8 +175,15 @@ class TableBuilder:
         if self._data_block.empty():
             self._block_first_key = ikey
         uk, seq_, t = dbformat.split_internal_key(ikey)
-        if self.opts.filter_policy and self.opts.whole_key_filtering:
-            self._filter_keys.append(uk)
+        if self.opts.filter_policy:
+            if self.opts.whole_key_filtering:
+                self._filter_keys.append(uk)
+            pe = self.opts.prefix_extractor
+            if pe is not None and pe.in_domain(uk):
+                p = pe.transform(uk)
+                if p != self._last_filter_prefix:
+                    self._filter_keys.append(p)
+                    self._last_filter_prefix = p
         for c in self._collectors:
             c.add_user_key(uk, value, t, seq_, self._w.file_size())
         self._data_block.add(ikey, value)

@@ -12,6 +12,7 @@ from __future__ import annotations
 from toplingdb_tpu.table import format as fmt
 from toplingdb_tpu.table.builder import TableBuilder, TableOptions
 from toplingdb_tpu.table.cuckoo import CuckooTableBuilder, CuckooTableReader
+from toplingdb_tpu.table.plain import PlainTableBuilder, PlainTableReader
 from toplingdb_tpu.table.reader import TableReader
 from toplingdb_tpu.table.single_fast import (
     SingleFastTableBuilder,
@@ -19,7 +20,7 @@ from toplingdb_tpu.table.single_fast import (
 )
 from toplingdb_tpu.utils.status import Corruption, InvalidArgument
 
-FORMATS = ("block", "single_fast", "cuckoo")
+FORMATS = ("block", "single_fast", "cuckoo", "plain")
 
 
 def new_table_builder(wfile, icmp, options: TableOptions | None = None,
@@ -37,6 +38,8 @@ def new_table_builder(wfile, icmp, options: TableOptions | None = None,
         return SingleFastTableBuilder(wfile, icmp, options, **kw)
     if f == "cuckoo":
         return CuckooTableBuilder(wfile, icmp, options, **kw)
+    if f == "plain":
+        return PlainTableBuilder(wfile, icmp, options, **kw)
     raise InvalidArgument(f"unknown table format {f!r}")
 
 
@@ -53,4 +56,6 @@ def open_table(rfile, icmp, options: TableOptions | None = None,
         return SingleFastTableReader(rfile, icmp, options)
     if magic == fmt.CUCKOO_MAGIC:
         return CuckooTableReader(rfile, icmp, options)
+    if magic == fmt.PLAIN_MAGIC:
+        return PlainTableReader(rfile, icmp, options)
     raise Corruption(f"unknown SST magic {magic:#x}")

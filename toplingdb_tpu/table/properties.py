@@ -27,12 +27,16 @@ class TableProperties:
     num_data_blocks: int = 0
     comparator_name: str = ""
     filter_policy_name: str = ""
+    prefix_extractor_name: str = ""
     compression_name: str = ""
     creation_time: int = 0
     smallest_seqno: int = 0
     largest_seqno: int = 0
     column_family_id: int = 0
     column_family_name: str = ""
+    # 1 when the filter block holds whole user keys (it may ALSO hold
+    # prefixes when prefix_extractor_name is set); 0 = prefix-only filter.
+    whole_key_filtering: int = 1
     index_type: str = "binary"  # 'binary' | 'two_level' (partitioned)
     user_collected: dict[str, bytes] = field(default_factory=dict)
 
@@ -41,8 +45,10 @@ class TableProperties:
         "num_range_deletions", "raw_key_size", "raw_value_size", "data_size",
         "index_size", "filter_size", "num_data_blocks", "creation_time",
         "smallest_seqno", "largest_seqno", "column_family_id",
+        "whole_key_filtering",
     )
-    _STR_FIELDS = ("comparator_name", "filter_policy_name", "compression_name",
+    _STR_FIELDS = ("comparator_name", "filter_policy_name",
+                   "prefix_extractor_name", "compression_name",
                    "column_family_name", "index_type")
 
     def encode_block(self) -> bytes:

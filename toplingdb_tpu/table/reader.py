@@ -81,7 +81,38 @@ class TableReader:
     def key_may_match(self, user_key: bytes) -> bool:
         if self._filter_policy is None or self._filter_data is None:
             return True
+        # Prefix-only filters (whole_key_filtering=False + prefix_extractor,
+        # reference BlockBasedTableOptions): point lookups probe the PREFIX.
+        if not self._whole_key_filtering():
+            pe = self._prefix_extractor()
+            if pe is None:
+                return True  # custom extractor we can't reconstruct
+            if not pe.in_domain(user_key):
+                return True
+            return self._filter_policy.key_may_match(
+                pe.transform(user_key), self._filter_data
+            )
         return self._filter_policy.key_may_match(user_key, self._filter_data)
+
+    def _whole_key_filtering(self) -> bool:
+        return bool(self.properties.whole_key_filtering)
+
+    def _prefix_extractor(self):
+        from toplingdb_tpu.utils.slice_transform import resolve_file_extractor
+
+        return resolve_file_extractor(
+            getattr(self.opts, "prefix_extractor", None),
+            self.properties.prefix_extractor_name,
+        )
+
+    def prefix_may_match(self, prefix: bytes) -> bool:
+        """Probe the filter with an already-extracted prefix (prefix Seek
+        short-circuit, reference FilterBlockReader::PrefixMayMatch). Only
+        meaningful when the file was built with a prefix_extractor."""
+        if (self._filter_policy is None or self._filter_data is None
+                or not self.properties.prefix_extractor_name):
+            return True
+        return self._filter_policy.key_may_match(prefix, self._filter_data)
 
     def _read_data_block(self, handle: fmt.BlockHandle) -> bytes:
         if self._cache is not None:

@@ -56,15 +56,21 @@ class SingleFastTableBuilder:
                 self.opts.filter_policy.name() if self.opts.filter_policy else ""
             ),
             compression_name="single_fast",
+            prefix_extractor_name=(
+                self.opts.prefix_extractor.name()
+                if getattr(self.opts, "prefix_extractor", None) else ""
+            ),
             column_family_id=column_family_id,
             column_family_name=column_family_name,
             creation_time=creation_time,
             smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
+            whole_key_filtering=1 if self.opts.whole_key_filtering else 0,
         )
         self._last_key: bytes | None = None
         self._smallest: bytes | None = None
         self._largest: bytes | None = None
         self._finished = False
+        self._last_filter_prefix: bytes | None = None
         self._collectors = [
             f.create() for f in self.opts.properties_collector_factories
         ]
@@ -126,8 +132,15 @@ class SingleFastTableBuilder:
         self._last_key = ikey
         self._track_bounds(ikey)
         uk, seq_, t = dbformat.split_internal_key(ikey)
-        if self.opts.filter_policy and self.opts.whole_key_filtering:
-            self._filter_keys.append(uk)
+        if self.opts.filter_policy:
+            if self.opts.whole_key_filtering:
+                self._filter_keys.append(uk)
+            pe = getattr(self.opts, "prefix_extractor", None)
+            if pe is not None and pe.in_domain(uk):
+                p = pe.transform(uk)
+                if p != self._last_filter_prefix:
+                    self._filter_keys.append(p)
+                    self._last_filter_prefix = p
         for c in self._collectors:
             c.add_user_key(uk, value, t, seq_, len(self._buf))
         self.props.num_entries += 1
@@ -349,6 +362,20 @@ class SingleFastTableReader:
     def key_may_match(self, user_key: bytes) -> bool:
         if self._filter_policy is None or self._filter_data is None:
             return True
+        if not self.properties.whole_key_filtering:
+            from toplingdb_tpu.utils.slice_transform import (
+                resolve_file_extractor,
+            )
+
+            pe = resolve_file_extractor(
+                getattr(self.opts, "prefix_extractor", None),
+                self.properties.prefix_extractor_name,
+            )
+            if pe is None or not pe.in_domain(user_key):
+                return True
+            return self._filter_policy.key_may_match(
+                pe.transform(user_key), self._filter_data
+            )
         return self._filter_policy.key_may_match(user_key, self._filter_data)
 
     def hash_probe(self, user_key: bytes) -> int | None:

@@ -94,6 +94,15 @@ def _register_builtins(reg: ObjectRegistry) -> None:
     reg.register("compaction_executor_factory", "subprocess",
                  SubprocessCompactionExecutorFactory)
     reg.register("statistics", "default", Statistics)
+    from toplingdb_tpu.utils.slice_transform import (
+        CappedPrefixTransform, FixedPrefixTransform, NoopTransform,
+    )
+
+    reg.register("prefix_extractor", "fixed",
+                 lambda length=8: FixedPrefixTransform(length))
+    reg.register("prefix_extractor", "capped",
+                 lambda length=8: CappedPrefixTransform(length))
+    reg.register("prefix_extractor", "noop", NoopTransform)
 
 
 _SIMPLE_OPTION_KEYS = {
@@ -147,6 +156,8 @@ def options_from_config(cfg: dict):
             opts.merge_operator = reg.create("merge_operator", v)
         elif k == "compaction_filter":
             opts.compaction_filter = reg.create("compaction_filter", v)
+        elif k == "prefix_extractor":
+            opts.prefix_extractor = reg.create("prefix_extractor", v)
         elif k == "compaction_executor_factory":
             opts.compaction_executor_factory = reg.create(
                 "compaction_executor_factory", v
@@ -195,6 +206,19 @@ def options_to_config(opts) -> dict:
         out["compaction_filter"] = "remove_empty_value"
     if opts.statistics is not None:
         out["statistics"] = "default"
+    pe = opts.prefix_extractor
+    if pe is not None:
+        pname = pe.name()
+        if pname.startswith("tpulsm.FixedPrefix."):
+            out["prefix_extractor"] = {
+                "class": "fixed", "params": {"length": pe.n},
+            }
+        elif pname.startswith("tpulsm.CappedPrefix."):
+            out["prefix_extractor"] = {
+                "class": "capped", "params": {"length": pe.n},
+            }
+        elif pname == "tpulsm.Noop":
+            out["prefix_extractor"] = "noop"
     t = opts.table_options
     from toplingdb_tpu.table.builder import TableOptions
 
