@@ -152,6 +152,10 @@ class ReadOptions:
     # Escape hatch (reference total_order_seek): ignore prefix mode for this
     # read even when prefix_same_as_start defaults have been configured.
     total_order_seek: bool = False
+    # Tailing iterator (reference ReadOptions.tailing → ForwardIterator,
+    # db/forward_iterator.cc): forward-only, sees new writes after catching
+    # up at end-of-data; incompatible with `snapshot`.
+    tailing: bool = False
 
 
 @dataclass
