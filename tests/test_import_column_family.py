@@ -1,0 +1,132 @@
+"""Export/Import column family — reference Checkpoint::ExportColumnFamily +
+DB::CreateColumnFamilyWithImport (db/import_column_family_job.cc)."""
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options, ReadOptions
+from toplingdb_tpu.utilities.checkpoint import (
+    ExportImportFilesMetaData,
+    export_column_family,
+)
+from toplingdb_tpu.utils.status import InvalidArgument
+
+
+def _filled_db(path, n=500, compact=True):
+    db = DB.open(str(path), Options(write_buffer_size=32 * 1024))
+    for i in range(n):
+        db.put(b"key%05d" % i, b"val%05d" % i)
+    db.flush()
+    for i in range(0, n, 3):
+        db.put(b"key%05d" % i, b"upd%05d" % i)
+    db.flush()
+    if compact:
+        db.compact_range()
+    return db
+
+
+def test_export_import_roundtrip(tmp_path):
+    src = _filled_db(tmp_path / "src")
+    meta = export_column_family(src, None, str(tmp_path / "exp"))
+    assert meta.files and meta.db_comparator_name
+    src.close()
+
+    dst = DB.open(str(tmp_path / "dst"), Options())
+    dst.put(b"own", b"data")
+    h = dst.create_column_family_with_import("imported", str(tmp_path / "exp"))
+    # imported data readable in the new CF
+    assert dst.get(b"key00003", cf=h) == b"upd00003"
+    assert dst.get(b"key00001", cf=h) == b"val00001"
+    assert dst.get(b"own", cf=h) is None
+    assert dst.get(b"own") == b"data"
+    # full scan count
+    it = dst.new_iterator(ReadOptions(), cf=h)
+    it.seek_to_first()
+    assert sum(1 for _ in it.entries()) == 500
+    # survives reopen
+    dst.close()
+    dst = DB.open(str(tmp_path / "dst"), Options())
+    h2 = dst.get_column_family("imported")
+    assert h2 is not None
+    assert dst.get(b"key00042", cf=h2) == b"upd00042"
+    dst.close()
+
+
+def test_import_with_explicit_metadata_and_move(tmp_path):
+    src = _filled_db(tmp_path / "src", n=50)
+    meta = export_column_family(src, None, str(tmp_path / "exp"))
+    src.close()
+    dst = DB.open(str(tmp_path / "dst"), Options())
+    h = dst.create_column_family_with_import(
+        "cf2", str(tmp_path / "exp"), metadata=meta, move_files=True
+    )
+    assert dst.get(b"key00049", cf=h) == b"val00049"
+    # exported SSTs were consumed
+    left = [p for p in (tmp_path / "exp").iterdir() if p.suffix == ".sst"]
+    assert not left
+    dst.close()
+
+
+def test_import_multi_level_layout(tmp_path):
+    # No final compact: levels 0 + compacted level both present
+    src = _filled_db(tmp_path / "src", n=300, compact=False)
+    meta = export_column_family(src, None, str(tmp_path / "exp"))
+    levels = {f.level for f in meta.files}
+    src.close()
+    dst = DB.open(str(tmp_path / "dst"), Options())
+    h = dst.create_column_family_with_import("cf", str(tmp_path / "exp"))
+    for i in range(300):
+        want = b"upd%05d" % i if i % 3 == 0 else b"val%05d" % i
+        assert dst.get(b"key%05d" % i, cf=h) == want
+    st = dst.versions.column_families[h.id]
+    assert {lvl for lvl, _ in st.current.all_files()} == levels
+    dst.close()
+
+
+def test_import_comparator_mismatch(tmp_path):
+    src = _filled_db(tmp_path / "src", n=20)
+    export_column_family(src, None, str(tmp_path / "exp"))
+    src.close()
+    meta = None
+    from toplingdb_tpu.db.dbformat import REVERSE_BYTEWISE
+
+    dst = DB.open(str(tmp_path / "dst"), Options(comparator=REVERSE_BYTEWISE))
+    with pytest.raises(InvalidArgument):
+        dst.create_column_family_with_import("cf", str(tmp_path / "exp"), meta)
+    # failed import leaves no half-created CF behind
+    assert dst.get_column_family("cf") is None
+    dst.close()
+
+
+def test_import_seqno_visibility(tmp_path):
+    """Imported files carry seqnos from the source DB, which can be far
+    ahead of the destination's — they must still be visible."""
+    src = _filled_db(tmp_path / "src", n=200)  # plenty of seqnos
+    export_column_family(src, None, str(tmp_path / "exp"))
+    src.close()
+    dst = DB.open(str(tmp_path / "dst"), Options())  # fresh: last_seq ~ 0
+    h = dst.create_column_family_with_import("cf", str(tmp_path / "exp"))
+    assert dst.get(b"key00000", cf=h) == b"upd00000"
+    # new writes in the dest still supersede imported data
+    dst.put(b"key00000", b"newer", cf=h)
+    assert dst.get(b"key00000", cf=h) == b"newer"
+    dst.close()
+
+
+def test_export_dir_must_be_empty(tmp_path):
+    src = _filled_db(tmp_path / "src", n=10)
+    (tmp_path / "exp").mkdir()
+    (tmp_path / "exp" / "junk").write_text("x")
+    with pytest.raises(InvalidArgument):
+        export_column_family(src, None, str(tmp_path / "exp"))
+    src.close()
+
+
+def test_metadata_file_roundtrip(tmp_path):
+    src = _filled_db(tmp_path / "src", n=30)
+    meta = export_column_family(src, None, str(tmp_path / "exp"))
+    loaded = ExportImportFilesMetaData.load(str(tmp_path / "exp"), src.env)
+    assert loaded.db_comparator_name == meta.db_comparator_name
+    assert [f.name for f in loaded.files] == [f.name for f in meta.files]
+    assert loaded.files[0].smallest == meta.files[0].smallest
+    src.close()

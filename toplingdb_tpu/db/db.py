@@ -96,8 +96,14 @@ class DB:
         if (options.prefix_extractor is not None
                 and options.table_options.prefix_extractor is None):
             # CF-level extractor feeds the table layer (prefix blooms, plain
-            # format), like reference CFOptions.prefix_extractor does.
-            options.table_options.prefix_extractor = options.prefix_extractor
+            # format), like reference CFOptions.prefix_extractor does. Copy:
+            # the caller's TableOptions object must not be mutated.
+            import dataclasses as _dcs
+
+            options.table_options = _dcs.replace(
+                options.table_options,
+                prefix_extractor=options.prefix_extractor,
+            )
         if getattr(options.table_options, "format", "block") == "plain":
             # Fail at open, not in a background flush/compaction job.
             from toplingdb_tpu.utils.slice_transform import (
@@ -224,6 +230,20 @@ class DB:
             self.versions.drop_column_family(handle.id)
             self._cfs.pop(handle.id, None)
             self._delete_obsolete_files()
+
+    def create_column_family_with_import(
+        self, name: str, source_dir: str, metadata=None,
+        move_files: bool = False,
+    ) -> ColumnFamilyHandle:
+        """Create a CF populated from a Checkpoint export_column_family dir
+        (reference DB::CreateColumnFamilyWithImport /
+        ImportColumnFamilyJob, db/import_column_family_job.cc)."""
+        from toplingdb_tpu.db.import_column_family_job import (
+            import_column_family,
+        )
+
+        return import_column_family(self, name, source_dir, metadata,
+                                    move_files=move_files)
 
     def list_column_families(self) -> list[ColumnFamilyHandle]:
         with self._mutex:
@@ -935,6 +955,14 @@ class DB:
         """MVCC iterator over the whole keyspace (reference
         DBImpl::NewIterator → DBIter over a MergingIterator)."""
         self._check_open()
+        if opts.tailing:
+            import dataclasses as _dcs
+
+            from toplingdb_tpu.db.forward_iterator import ForwardIterator
+
+            return ForwardIterator(
+                self, _dcs.replace(opts, tailing=False), cf=cf
+            )
         cfd = self._cf_data(cf)
         with self._mutex:
             snap_seq = (

@@ -78,6 +78,23 @@ class BloomFilterPolicy(FilterPolicy):
             return True  # corrupt filter: fail open
 
 
+def filter_probe(policy: FilterPolicy | None, filter_data: bytes | None,
+                 whole_key_filtering: bool, prefix_extractor,
+                 user_key: bytes) -> bool:
+    """The point-lookup filter probe shared by every table reader: whole-key
+    probe normally; prefix probe when the file holds a prefix-only filter
+    (whole_key_filtering=0 in its properties). Fails open when the filter or
+    a needed extractor is unavailable."""
+    if policy is None or filter_data is None:
+        return True
+    if not whole_key_filtering:
+        pe = prefix_extractor
+        if pe is None or not pe.in_domain(user_key):
+            return True
+        return policy.key_may_match(pe.transform(user_key), filter_data)
+    return policy.key_may_match(user_key, filter_data)
+
+
 def filter_policy_from_name(name: str) -> FilterPolicy | None:
     if name.startswith("tpulsm.BloomFilter:"):
         return BloomFilterPolicy(float(name.split(":", 1)[1]))

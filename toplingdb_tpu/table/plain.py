@@ -29,7 +29,6 @@ from toplingdb_tpu.table.single_fast import (
     SingleFastTableReader,
 )
 from toplingdb_tpu.utils import crc32c
-from toplingdb_tpu.utils.slice_transform import resolve_file_extractor
 from toplingdb_tpu.utils.status import Corruption, InvalidArgument
 
 METAINDEX_PREFIX_INDEX = b"tpulsm.pt.prefix_index"
@@ -99,10 +98,7 @@ class PlainTableReader(SingleFastTableReader):
                                self.opts.verify_checksums),
                 dtype="<u4",
             )
-        self._pe = resolve_file_extractor(
-            getattr(self.opts, "prefix_extractor", None),
-            self.properties.prefix_extractor_name,
-        )
+        self._pe = self._resolved_pe  # resolved by SingleFastTableReader
         # has_hash_index drives the DB Get fast path; the fallback inside
         # hash_probe keeps the contract for out-of-domain keys.
         self.has_hash_index = True

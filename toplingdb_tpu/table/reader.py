@@ -19,7 +19,7 @@ from toplingdb_tpu.table.builder import (
     METAINDEX_RANGE_DEL,
     TableOptions,
 )
-from toplingdb_tpu.table.filter import filter_policy_from_name
+from toplingdb_tpu.table.filter import filter_policy_from_name, filter_probe
 from toplingdb_tpu.table.properties import TableProperties
 
 
@@ -62,6 +62,15 @@ class TableReader:
                 self.properties.filter_policy_name
             )
 
+        # The extractor this FILE's prefix structures were built with,
+        # resolved once (hot Get path must not reconstruct it per probe).
+        from toplingdb_tpu.utils.slice_transform import resolve_file_extractor
+
+        self._resolved_pe = resolve_file_extractor(
+            getattr(self.opts, "prefix_extractor", None),
+            self.properties.prefix_extractor_name,
+        )
+
         self._range_del_data: bytes | None = None
         self._range_del_cache: list[tuple[bytes, bytes]] | None = None
         rh = self._meta_handles.get(METAINDEX_RANGE_DEL)
@@ -79,30 +88,10 @@ class TableReader:
         self._f.close()
 
     def key_may_match(self, user_key: bytes) -> bool:
-        if self._filter_policy is None or self._filter_data is None:
-            return True
-        # Prefix-only filters (whole_key_filtering=False + prefix_extractor,
-        # reference BlockBasedTableOptions): point lookups probe the PREFIX.
-        if not self._whole_key_filtering():
-            pe = self._prefix_extractor()
-            if pe is None:
-                return True  # custom extractor we can't reconstruct
-            if not pe.in_domain(user_key):
-                return True
-            return self._filter_policy.key_may_match(
-                pe.transform(user_key), self._filter_data
-            )
-        return self._filter_policy.key_may_match(user_key, self._filter_data)
-
-    def _whole_key_filtering(self) -> bool:
-        return bool(self.properties.whole_key_filtering)
-
-    def _prefix_extractor(self):
-        from toplingdb_tpu.utils.slice_transform import resolve_file_extractor
-
-        return resolve_file_extractor(
-            getattr(self.opts, "prefix_extractor", None),
-            self.properties.prefix_extractor_name,
+        return filter_probe(
+            self._filter_policy, self._filter_data,
+            bool(self.properties.whole_key_filtering),
+            self._resolved_pe, user_key,
         )
 
     def prefix_may_match(self, prefix: bytes) -> bool:

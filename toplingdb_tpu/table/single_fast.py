@@ -320,6 +320,13 @@ class SingleFastTableReader:
             if rh is not None else None
         )
         self.n = len(self._offsets)
+        from toplingdb_tpu.utils.slice_transform import resolve_file_extractor
+
+        # Resolved once: the hot probe path must not rebuild the extractor.
+        self._resolved_pe = resolve_file_extractor(
+            getattr(self.opts, "prefix_extractor", None),
+            self.properties.prefix_extractor_name,
+        )
         self._load_hash_index()
 
     def _load_hash_index(self) -> None:
@@ -360,23 +367,13 @@ class SingleFastTableReader:
         pass
 
     def key_may_match(self, user_key: bytes) -> bool:
-        if self._filter_policy is None or self._filter_data is None:
-            return True
-        if not self.properties.whole_key_filtering:
-            from toplingdb_tpu.utils.slice_transform import (
-                resolve_file_extractor,
-            )
+        from toplingdb_tpu.table.filter import filter_probe
 
-            pe = resolve_file_extractor(
-                getattr(self.opts, "prefix_extractor", None),
-                self.properties.prefix_extractor_name,
-            )
-            if pe is None or not pe.in_domain(user_key):
-                return True
-            return self._filter_policy.key_may_match(
-                pe.transform(user_key), self._filter_data
-            )
-        return self._filter_policy.key_may_match(user_key, self._filter_data)
+        return filter_probe(
+            self._filter_policy, self._filter_data,
+            bool(self.properties.whole_key_filtering),
+            self._resolved_pe, user_key,
+        )
 
     def hash_probe(self, user_key: bytes) -> int | None:
         """O(1) lookup: ordinal of the NEWEST version of user_key, or None

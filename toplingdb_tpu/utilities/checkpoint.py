@@ -8,6 +8,10 @@ from __future__ import annotations
 import os
 
 from toplingdb_tpu.db import filename
+from toplingdb_tpu.db.import_column_family_job import (  # noqa: F401
+    ExportImportFilesMetaData,
+    export_column_family,
+)
 from toplingdb_tpu.db.log import LogWriter
 from toplingdb_tpu.db.version_edit import VersionEdit
 from toplingdb_tpu.utils.status import InvalidArgument
