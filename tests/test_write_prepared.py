@@ -1,0 +1,256 @@
+"""WritePrepared / WriteUnprepared transaction policies.
+
+Reference utilities/transactions/write_prepared_txn_db.cc and
+write_unprepared_txn_db.cc: data reaches the DB at Prepare (or earlier, for
+unprepared spills), commit is a marker write, and visibility is enforced by
+snapshot-checker-style exclusion of undecided seqno ranges.
+"""
+
+import pytest
+
+from toplingdb_tpu.options import Options, ReadOptions, WriteOptions
+from toplingdb_tpu.utilities.transactions import (
+    TransactionDB,
+    WritePreparedTransaction,
+    WriteUnpreparedTransaction,
+)
+from toplingdb_tpu.utils.status import InvalidArgument
+
+
+def wp_open(path, **kw):
+    return TransactionDB.open(str(path), Options(),
+                              write_policy="write_prepared", **kw)
+
+
+def test_policy_dispatch(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    txn = tdb.begin_transaction()
+    assert isinstance(txn, WritePreparedTransaction)
+    tdb.close()
+    tdb = TransactionDB.open(str(tmp_path / "db2"), Options(),
+                             write_policy="write_unprepared")
+    assert isinstance(tdb.begin_transaction(), WriteUnpreparedTransaction)
+    tdb.close()
+    with pytest.raises(InvalidArgument):
+        TransactionDB.open(str(tmp_path / "db3"), Options(),
+                           write_policy="bogus")
+
+
+def test_prepared_data_invisible_until_commit(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    tdb.put(b"base", b"committed")
+    txn = tdb.begin_transaction()
+    txn.set_name("t1")
+    txn.put(b"k1", b"v1")
+    txn.put(b"base", b"overwritten")
+    txn.prepare()
+    # data is IN the DB now, but invisible to everyone else
+    assert tdb.get(b"k1") is None
+    assert tdb.get(b"base") == b"committed"
+    it = tdb.db.new_iterator(ReadOptions())
+    it.seek_to_first()
+    assert [k for k, _ in it.entries()] == [b"base"]
+    # ... but the txn reads its own writes
+    assert txn.get(b"k1") == b"v1"
+    txn.commit()
+    assert tdb.get(b"k1") == b"v1"
+    assert tdb.get(b"base") == b"overwritten"
+    tdb.close()
+
+
+def test_snapshot_taken_during_prepare_never_sees_data(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    txn = tdb.begin_transaction()
+    txn.set_name("t1")
+    txn.put(b"x", b"txn-value")
+    txn.prepare()
+    snap = tdb.db.get_snapshot()          # while undecided
+    txn.commit()
+    # the commit point is after the snapshot: still invisible to it
+    assert tdb.get(b"x", ReadOptions(snapshot=snap)) is None
+    assert tdb.get(b"x") == b"txn-value"  # fresh read sees it
+    snap.release()
+    tdb.close()
+
+
+def test_rollback_restores_previous_values(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    tdb.put(b"a", b"old-a")
+    txn = tdb.begin_transaction()
+    txn.set_name("t1")
+    txn.put(b"a", b"new-a")
+    txn.put(b"b", b"new-b")
+    txn.delete(b"a")  # multiple ops on same txn
+    txn.prepare()
+    txn.rollback()
+    assert tdb.get(b"a") == b"old-a"
+    assert tdb.get(b"b") is None
+    # locks released: another txn can write immediately
+    t2 = tdb.begin_transaction()
+    t2.put(b"a", b"after")
+    t2.commit()
+    assert tdb.get(b"a") == b"after"
+    tdb.close()
+
+
+def test_commit_without_prepare_is_atomic_write(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    txn = tdb.begin_transaction()
+    txn.put(b"k", b"v")
+    txn.commit()
+    assert tdb.get(b"k") == b"v"
+    tdb.close()
+
+
+def test_recovery_of_prepared_txn(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    txn = tdb.begin_transaction()
+    txn.set_name("crashy")
+    txn.put(b"pending", b"data")
+    txn.prepare()
+    tdb.db.close()  # abrupt-ish: no commit/rollback decision
+
+    tdb = wp_open(tmp_path / "db")
+    # undecided data stays invisible after recovery
+    assert tdb.get(b"pending") is None
+    recovered = tdb.get_prepared_transactions()
+    assert len(recovered) == 1 and recovered[0].name == "crashy"
+    recovered[0].commit()
+    assert tdb.get(b"pending") == b"data"
+    tdb.close()
+    # decision survives another reopen
+    tdb = wp_open(tmp_path / "db")
+    assert tdb.get(b"pending") == b"data"
+    assert not tdb.get_prepared_transactions()
+    tdb.close()
+
+
+def test_recovery_rollback_of_prepared_txn(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    tdb.put(b"k", b"original")
+    txn = tdb.begin_transaction()
+    txn.set_name("crashy")
+    txn.put(b"k", b"uncommitted")
+    txn.prepare()
+    tdb.db.close()
+
+    tdb = wp_open(tmp_path / "db")
+    assert tdb.get(b"k") == b"original"
+    tdb.get_prepared_transactions()[0].rollback()
+    assert tdb.get(b"k") == b"original"
+    tdb.close()
+    tdb = wp_open(tmp_path / "db")
+    assert tdb.get(b"k") == b"original"
+    tdb.close()
+
+
+def test_prepared_survives_flush_and_compaction(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    for i in range(100):
+        tdb.put(b"w%03d" % i, b"v%d" % i)
+    txn = tdb.begin_transaction()
+    txn.set_name("t1")
+    txn.put(b"w050", b"pending")
+    txn.prepare()
+    tdb.db.flush()
+    tdb.db.compact_range()
+    assert tdb.get(b"w050") == b"v50"  # still the committed value
+    txn.commit()
+    assert tdb.get(b"w050") == b"pending"
+    tdb.close()
+
+
+def test_unprepared_spills_stay_invisible(tmp_path):
+    tdb = TransactionDB.open(str(tmp_path / "db"), Options(),
+                             write_policy="write_unprepared")
+    txn = tdb.begin_transaction()
+    txn.spill_threshold = 256  # force frequent spills
+    big = b"x" * 64
+    for i in range(50):
+        txn.put(b"big%03d" % i, big)
+    assert txn._spill_off is not None, "expected at least one spill"
+    # spilled data invisible to outside readers
+    assert tdb.get(b"big000") is None
+    # read-your-own-writes across spills
+    assert txn.get(b"big000") == big
+    txn.commit()
+    assert tdb.get(b"big049") == big
+    tdb.close()
+
+
+def test_unprepared_rollback_and_crash_abort(tmp_path):
+    tdb = TransactionDB.open(str(tmp_path / "db"), Options(),
+                             write_policy="write_unprepared")
+    tdb.put(b"big000", b"pre-existing")
+    txn = tdb.begin_transaction()
+    txn.spill_threshold = 128
+    for i in range(30):
+        txn.put(b"big%03d" % i, b"y" * 64)
+    assert txn._spill_off is not None
+    txn.rollback()
+    assert tdb.get(b"big000") == b"pre-existing"
+    assert tdb.get(b"big001") is None
+
+    # crash with spilled-but-never-prepared data → auto-abort at recovery
+    txn2 = tdb.begin_transaction()
+    txn2.spill_threshold = 128
+    for i in range(30):
+        txn2.put(b"crash%03d" % i, b"z" * 64)
+    assert txn2._spill_off is not None
+    tdb.db.close()  # no decision
+    tdb = TransactionDB.open(str(tmp_path / "db"), Options(),
+                             write_policy="write_unprepared")
+    assert tdb.get(b"crash000") is None
+    assert tdb.get(b"big000") == b"pre-existing"
+    assert not tdb.get_prepared_transactions()  # aborted, not recovered
+    tdb.close()
+
+
+def test_snapshot_exclusion_survives_commit_and_compaction(tmp_path):
+    """A snapshot taken while a txn is prepared must read the PRE-txn value
+    even after the txn commits and compaction runs (the parked compaction
+    guard keeps the old version alive)."""
+    tdb = wp_open(tmp_path / "db")
+    tdb.put(b"k", b"pre")
+    txn = tdb.begin_transaction()
+    txn.set_name("t1")
+    txn.put(b"k", b"txn")
+    txn.prepare()
+    snap = tdb.db.get_snapshot()
+    txn.commit()  # guard must be parked: snap still excludes [lo, hi]
+    tdb.db.flush()
+    tdb.db.compact_range()
+    assert tdb.get(b"k", ReadOptions(snapshot=snap)) == b"pre"
+    assert tdb.get(b"k") == b"txn"
+    snap.release()
+    # next txn op sweeps the parked guard
+    tdb.begin_transaction().rollback()
+    assert not tdb._parked_guards
+    tdb.close()
+
+
+def test_reserved_rb_names_rejected(tmp_path):
+    tdb = wp_open(tmp_path / "db")
+    txn = tdb.begin_transaction()
+    with pytest.raises(InvalidArgument):
+        txn.set_name("rb.evil")
+    tdb.close()
+
+
+def test_wp_and_wc_conflict_isolation(tmp_path):
+    """Locks still guard across policies: a prepared WP txn holds its keys."""
+    tdb = wp_open(tmp_path / "db")
+    txn = tdb.begin_transaction()
+    txn.set_name("holder")
+    txn.put(b"locked", b"v")
+    txn.prepare()
+    t2 = tdb.begin_transaction(lock_timeout=0.05)
+    from toplingdb_tpu.utils.status import Busy
+
+    with pytest.raises(Busy):
+        t2.put(b"locked", b"other")
+    txn.commit()
+    t2.put(b"locked", b"other")
+    t2.commit()
+    assert tdb.get(b"locked") == b"other"
+    tdb.close()

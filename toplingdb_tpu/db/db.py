@@ -48,14 +48,20 @@ class _Writer:
     blocks on its event until a leader commits it (done=True) or promotes it
     to lead the next group (done=False)."""
 
-    __slots__ = ("batch", "opts", "done", "error", "event")
+    __slots__ = ("batch", "opts", "done", "error", "event", "on_sequenced")
 
-    def __init__(self, batch: WriteBatch, opts: WriteOptions):
+    def __init__(self, batch: WriteBatch, opts: WriteOptions,
+                 on_sequenced=None):
         self.batch = batch
         self.opts = opts
         self.done = False
         self.error: BaseException | None = None
         self.event = threading.Event()
+        # Optional callable(first_seq, last_seq) fired INSIDE the commit
+        # critical section, before the group's last_sequence publishes —
+        # the WritePrepared policy registers its undecided seqno range here
+        # so no reader can ever observe the data unexcluded.
+        self.on_sequenced = on_sequenced
 
 
 class ColumnFamilyHandle:
@@ -147,6 +153,12 @@ class DB:
         from toplingdb_tpu.utils.status import Severity as _Sev
         self._bg_error_severity = _Sev.NO_ERROR
         self._mem_id_counter = 0
+        # WritePrepared policy hook (reference SnapshotChecker): a callable
+        # returning the seqno ranges of prepared-but-undecided transactions,
+        # which every read must treat as invisible. Set by
+        # utilities.transactions.TransactionDB under write_prepared /
+        # write_unprepared write policies; None = plain visibility.
+        self._undecided_provider = None
         self.identity = ""
         self.stats = options.statistics  # may be None
         from toplingdb_tpu.utils.seqno_to_time import SeqnoToTimeMapping
@@ -429,7 +441,8 @@ class DB:
         b.delete_range(begin, end, cf=self._cf_id(cf))
         self.write(b, opts)
 
-    def write(self, batch: WriteBatch, opts: WriteOptions = _DEFAULT_WRITE) -> None:
+    def write(self, batch: WriteBatch, opts: WriteOptions = _DEFAULT_WRITE,
+              on_sequenced=None) -> None:
         """Group-commit write path (reference DBImpl::WriteImpl +
         WriteThread::JoinBatchGroup, db/db_impl/db_impl_write.cc:169,311):
         concurrent writers queue up; the front writer leads, merging the
@@ -439,7 +452,7 @@ class DB:
             return
         self._check_open()  # fail fast before any stall sleep
         self._maybe_stall_writes()
-        w = _Writer(batch, opts)
+        w = _Writer(batch, opts, on_sequenced)
         with self._wq_lock:
             self._writers.append(w)
             is_leader = self._writers[0] is w
@@ -531,6 +544,14 @@ class DB:
             mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
             for w in group:
                 w.batch.insert_into(mems)
+            # on_sequenced fires only after the WAL append + memtable insert
+            # succeeded (a failed group must not leak registrations), but
+            # BEFORE the group's sequence publishes: entries stay invisible
+            # (seq > last_sequence) until the registration exists.
+            for w in group:
+                if w.on_sequenced is not None:
+                    s0 = w.batch.sequence()
+                    w.on_sequenced(s0, s0 + w.batch.count() - 1)
             self.versions.last_sequence = seq - 1
             now = time.time()
             if now - self._last_seqno_time_sample >= \
@@ -740,6 +761,7 @@ class DB:
         ctx = GetContext(
             key, snap_seq, self.options.merge_operator,
             blob_resolver=self.blob_source.get,
+            excluded_ranges=self._excluded_for(opts),
         )
         # 1. Active memtable, then immutables (newest first).
         for mem in [cfd.mem] + cfd.imm:
@@ -821,9 +843,10 @@ class DB:
             else self.versions.last_sequence
         )
         resolver = self.blob_source.get
+        excluded = self._excluded_for(opts)
         ctxs = {
             k: GetContext(k, snap_seq, self.options.merge_operator,
-                          blob_resolver=resolver)
+                          blob_resolver=resolver, excluded_ranges=excluded)
             for k in keys
         }
         live = dict(ctxs)
@@ -935,7 +958,7 @@ class DB:
         )
         ctx = GetContext(
             key, snap_seq, None, blob_resolver=self.blob_source.get,
-            collect_operands=True,
+            collect_operands=True, excluded_ranges=self._excluded_for(opts),
         )
         more = True
         for mem in [cfd.mem] + cfd.imm:
@@ -1008,6 +1031,7 @@ class DB:
                 prefix_same_as_start=(
                     opts.prefix_same_as_start and not opts.total_order_seek
                 ),
+                excluded_ranges=self._excluded_for(opts),
             )
             if opts.snapshot is None:
                 # Refresh re-reads at the LATEST sequence; snapshot-pinned
@@ -1016,8 +1040,21 @@ class DB:
                 it._refresh_fn = lambda: self.new_iterator(opts, cf)
             return it
 
+    def _excluded_for(self, opts) -> tuple:
+        """Seqno ranges invisible to this read (undecided WritePrepared
+        transactions): a snapshot carries the set captured at its creation;
+        snapshot-less reads use the live set."""
+        if opts.snapshot is not None:
+            return getattr(opts.snapshot, "excluded_ranges", ())
+        fn = self._undecided_provider
+        return fn() if fn is not None else ()
+
     def get_snapshot(self):
-        return self.snapshots.new_snapshot(self.versions.last_sequence)
+        fn = self._undecided_provider
+        return self.snapshots.new_snapshot(
+            self.versions.last_sequence,
+            excluded_ranges=fn() if fn is not None else (),
+        )
 
     def release_snapshot(self, snap) -> None:
         snap.release()

@@ -19,7 +19,8 @@ class DBIter:
                  lower_bound: bytes | None = None,
                  upper_bound: bytes | None = None,
                  pinned=None, blob_resolver=None,
-                 prefix_extractor=None, prefix_same_as_start: bool = False):
+                 prefix_extractor=None, prefix_same_as_start: bool = False,
+                 excluded_ranges: tuple = ()):
         self._blob_resolver = blob_resolver
         # `pinned` keeps the source Version (and anything else) alive for the
         # iterator's lifetime so obsolete-file GC cannot delete SSTs that
@@ -42,6 +43,8 @@ class DBIter:
         # prefix group. Armed per-Seek; total-order entry points clear it.
         self._pe = prefix_extractor if prefix_same_as_start else None
         self._prefix: bytes | None = None
+        # Undecided WritePrepared transaction data (see db/snapshot.py).
+        self._excluded_ranges = excluded_ranges
 
     def refresh(self) -> None:
         """Rebind to the DB's CURRENT state (reference Iterator::Refresh):
@@ -167,6 +170,12 @@ class DBIter:
     def _out_of_lower(self, uk: bytes) -> bool:
         return self._lower is not None and self._ucmp.compare(uk, self._lower) < 0
 
+    def _excluded(self, seq: int) -> bool:
+        for lo, hi in self._excluded_ranges:
+            if lo <= seq <= hi:
+                return True
+        return False
+
     def _tomb_covers(self, uk: bytes, seq: int) -> bool:
         return (
             self._rd is not None
@@ -186,7 +195,9 @@ class DBIter:
             if skip_key is not None and self._ucmp.compare(uk, skip_key) <= 0:
                 self._iter.next()
                 continue
-            if seq > self._seq:
+            if seq > self._seq or (
+                self._excluded_ranges and self._excluded(seq)
+            ):
                 self._iter.next()
                 continue
             if merge_key is not None and self._ucmp.compare(uk, merge_key) != 0:
@@ -256,7 +267,9 @@ class DBIter:
                 uk2, seq2, t2 = dbformat.split_internal_key(k2)
                 if self._ucmp.compare(uk2, uk) != 0:
                     break
-                if seq2 <= self._seq:
+                if seq2 <= self._seq and not (
+                    self._excluded_ranges and self._excluded(seq2)
+                ):
                     entries.append((seq2, t2, self._iter.value()))
                 self._iter.prev()
             # entries is ordered oldest→...→newest? Backward walk yields

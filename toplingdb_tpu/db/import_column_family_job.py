@@ -162,38 +162,54 @@ def import_column_family(db, name: str, source_dir: str,
             f"{metadata.db_comparator_name!r}, DB uses "
             f"{db.icmp.user_comparator.name()!r}"
         )
+    # Copy + verify every file OUTSIDE the DB mutex (a multi-GB import must
+    # not stall concurrent reads/writes); only the CF creation and the
+    # version install need the lock. Fresh file numbers are race-free
+    # (VersionSet allocates under its own lock) and nothing references the
+    # copies until log_and_apply.
+    edit_files: list[tuple[int, FileMetaData]] = []
+    max_seqno = 0
+    copied: list[str] = []
+    try:
+        for ef in metadata.files:
+            src = os.path.join(source_dir, ef.name)
+            if not env.file_exists(src):
+                raise Corruption(f"exported file missing: {src}")
+            num = db.versions.new_file_number()
+            dst = filename.table_file_name(db.dbname, num)
+            _link_or_copy(env, src, dst)
+            copied.append(dst)
+            # Verify the table opens and matches the manifest's claims
+            # (reference import verifies via GetIngestedFileInfo).
+            reader = db.table_cache.get_reader(num)
+            if reader.properties.num_entries != ef.num_entries:
+                raise Corruption(
+                    f"{src}: entry count {reader.properties.num_entries} "
+                    f"!= exported metadata {ef.num_entries}"
+                )
+            edit_files.append((ef.level, FileMetaData(
+                number=num, file_size=ef.file_size,
+                smallest=ef.smallest, largest=ef.largest,
+                smallest_seqno=ef.smallest_seqno,
+                largest_seqno=ef.largest_seqno,
+                num_entries=ef.num_entries,
+                num_deletions=ef.num_deletions,
+                num_range_deletions=ef.num_range_deletions,
+            )))
+            max_seqno = max(max_seqno, ef.largest_seqno)
+    except Exception:
+        for p in copied:
+            try:
+                env.delete_file(p)
+            except Exception:
+                pass
+        raise
     with db._mutex:
         handle = db.create_column_family(name)
         try:
             edit = VersionEdit(column_family=handle.id)
-            max_seqno = 0
-            copied: list[str] = []
-            for ef in metadata.files:
-                src = os.path.join(source_dir, ef.name)
-                if not env.file_exists(src):
-                    raise Corruption(f"exported file missing: {src}")
-                num = db.versions.new_file_number()
-                dst = filename.table_file_name(db.dbname, num)
-                _link_or_copy(env, src, dst)
-                copied.append(dst)
-                # Verify the table opens and matches the manifest's claims
-                # (reference import verifies via GetIngestedFileInfo).
-                reader = db.table_cache.get_reader(num)
-                if reader.properties.num_entries != ef.num_entries:
-                    raise Corruption(
-                        f"{src}: entry count {reader.properties.num_entries} "
-                        f"!= exported metadata {ef.num_entries}"
-                    )
-                edit.add_file(ef.level, FileMetaData(
-                    number=num, file_size=ef.file_size,
-                    smallest=ef.smallest, largest=ef.largest,
-                    smallest_seqno=ef.smallest_seqno,
-                    largest_seqno=ef.largest_seqno,
-                    num_entries=ef.num_entries,
-                    num_deletions=ef.num_deletions,
-                    num_range_deletions=ef.num_range_deletions,
-                ))
-                max_seqno = max(max_seqno, ef.largest_seqno)
+            for lvl, meta in edit_files:
+                edit.add_file(lvl, meta)
             # Imported seqnos must be visible in THIS DB.
             if max_seqno > db.versions.last_sequence:
                 edit.last_sequence = max_seqno
@@ -208,10 +224,10 @@ def import_column_family(db, name: str, source_dir: str,
                     pass
             db.drop_column_family(handle)
             raise
-        if move_files:
-            for ef in metadata.files:
-                try:
-                    env.delete_file(os.path.join(source_dir, ef.name))
-                except Exception:
-                    pass
+    if move_files:
+        for ef in metadata.files:
+            try:
+                env.delete_file(os.path.join(source_dir, ef.name))
+            except Exception:
+                pass
     return handle

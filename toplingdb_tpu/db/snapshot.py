@@ -7,10 +7,18 @@ import threading
 
 
 class Snapshot:
-    __slots__ = ("sequence", "_list")
+    __slots__ = ("sequence", "excluded_ranges", "_list")
 
-    def __init__(self, sequence: int, slist: "SnapshotList"):
+    def __init__(self, sequence: int, slist: "SnapshotList",
+                 excluded_ranges: tuple = ()):
         self.sequence = sequence
+        # Seqno ranges INVISIBLE to this snapshot despite being <= sequence:
+        # data written to the DB by prepared-but-undecided transactions at
+        # snapshot-creation time (the WritePrepared policy; the reference's
+        # SnapshotChecker / old_commit_map role). Any such transaction that
+        # later commits gets a commit point after this snapshot, so the
+        # exclusion is permanent for this snapshot's lifetime.
+        self.excluded_ranges = excluded_ranges
         self._list = slist
 
     def release(self) -> None:
@@ -28,8 +36,9 @@ class SnapshotList:
         self._lock = threading.Lock()
         self._snapshots: list[Snapshot] = []
 
-    def new_snapshot(self, sequence: int) -> Snapshot:
-        s = Snapshot(sequence, self)
+    def new_snapshot(self, sequence: int,
+                     excluded_ranges: tuple = ()) -> Snapshot:
+        s = Snapshot(sequence, self, excluded_ranges)
         with self._lock:
             self._snapshots.append(s)
         return s
@@ -60,3 +69,15 @@ class SnapshotList:
     def oldest(self) -> int | None:
         seqs = self.sequences()
         return seqs[0] if seqs else None
+
+    def any_excluding(self, lo: int, hi: int) -> bool:
+        """Is any live snapshot still excluding a seqno range overlapping
+        [lo, hi]? (WritePrepared guard-snapshot lifetime: the compaction
+        guard below an undecided range must outlive every snapshot that
+        captured its exclusion.)"""
+        with self._lock:
+            for s in self._snapshots:
+                for el, eh in s.excluded_ranges:
+                    if el <= hi and lo <= eh:
+                        return True
+        return False

@@ -322,6 +322,12 @@ class PessimisticTransaction(_TxnBase):
         among undecided transactions and immutable once set."""
         if not name or "/" in name or name.startswith("."):
             raise InvalidArgument(f"bad transaction name {name!r}")
+        if name.startswith("rb."):
+            # Reserved: 'txn.' + 'rb.X' would collide with the rollback
+            # marker of transaction 'X' (TransactionDB._RB_PREFIX).
+            raise InvalidArgument(
+                f"transaction names may not start with 'rb.': {name!r}"
+            )
         if self.state != "started":
             raise InvalidArgument(f"cannot rename in state {self.state}")
         if getattr(self, "name", None) is not None:
@@ -381,8 +387,13 @@ class TransactionDB:
     _MARKER_PREFIX = b"txn."
     _TXN_CF = "__tpulsm_txn__"
 
-    def __init__(self, db: DB, use_range_locking: bool = False):
+    def __init__(self, db: DB, use_range_locking: bool = False,
+                 write_policy: str = "write_committed"):
+        if write_policy not in ("write_committed", "write_prepared",
+                                "write_unprepared"):
+            raise InvalidArgument(f"unknown write policy {write_policy!r}")
         self.db = db
+        self.write_policy = write_policy
         # Reference TransactionDBOptions::lock_mgr_handle: "point" (default)
         # or the range-capable locktree manager.
         self.lock_manager = (
@@ -392,6 +403,15 @@ class TransactionDB:
         self._recovered: list[PessimisticTransaction] = []
         self._names: set[str] = set()
         self._names_mu = threading.Lock()
+        # WritePrepared/WriteUnprepared: seqno ranges of in-DB data belonging
+        # to undecided transactions (name → [(lo, hi), ...]). Exposed to the
+        # engine's read paths via DB._undecided_provider (the reference's
+        # SnapshotChecker / commit-cache visibility role).
+        self._undecided: dict[str, list] = {}
+        self._undecided_mu = threading.Lock()
+        self._parked_guards: list = []  # (guard snapshot, ranges) — see
+        #                                 _wp_release_guard
+        db._undecided_provider = self._undecided_ranges
         # Commit markers live in their own column family so user-keyspace
         # scans never see them (the reference keeps its markers in the WAL).
         cf = db.get_column_family(self._TXN_CF)
@@ -424,8 +444,10 @@ class TransactionDB:
 
     @staticmethod
     def open(path: str, options: Options | None = None,
-             use_range_locking: bool = False) -> "TransactionDB":
-        return TransactionDB(DB.open(path, options), use_range_locking)
+             use_range_locking: bool = False,
+             write_policy: str = "write_committed") -> "TransactionDB":
+        return TransactionDB(DB.open(path, options), use_range_locking,
+                             write_policy)
 
     # -- 2PC journal ----------------------------------------------------
 
@@ -490,6 +512,11 @@ class TransactionDB:
             try:
                 doc = _json.loads(raw.decode())
                 name = doc["name"]
+                if doc.get("policy") in ("write_prepared",
+                                         "write_unprepared"):
+                    self._recover_wp(name, doc)
+                    live_names.add(name)
+                    continue
                 batch_data = bytes.fromhex(doc["batch"])
                 locks = [bytes.fromhex(kh) for kh in doc["locks"]]
                 range_locks = [
@@ -552,8 +579,236 @@ class TransactionDB:
         GetAllPreparedTransactions); commit() or rollback() each."""
         return list(self._recovered)
 
+    # -- WritePrepared / WriteUnprepared machinery ----------------------
+    #
+    # Reference write_prepared_txn_db.cc / write_unprepared_txn_db.cc: data
+    # reaches the DB (WAL + memtable) at Prepare time — commit is a tiny
+    # marker write, not a second copy of the batch. Visibility is enforced
+    # by the engine: every read excludes the seqno ranges of undecided
+    # transactions (DB._undecided_provider; snapshots capture the set at
+    # creation, the old_commit_map role). Rollback follows the reference's
+    # design: write compensating records restoring each key's pre-prepare
+    # value, then let the whole range become visible — the compensation is
+    # newer, so the observable state is the rollback.
+
+    _RB_PREFIX = b"txn.rb."
+
+    def _undecided_ranges(self) -> tuple:
+        with self._undecided_mu:
+            return tuple(r for rs in self._undecided.values() for r in rs)
+
+    def _wp_unregister(self, name: str) -> None:
+        with self._undecided_mu:
+            self._undecided.pop(name, None)
+
+    def _wp_write_batch(self, txn, batch) -> None:
+        """Write `batch` into the DB invisibly, recording the new seqno
+        range on the transaction. The exclusion registers via the write
+        path's on_sequenced hook — inside the commit critical section,
+        before the group's last_sequence publishes — so no reader can ever
+        observe the data unexcluded."""
+        if batch.is_empty():
+            return
+        db = self.db
+
+        def on_sequenced(lo: int, hi: int) -> None:
+            with self._undecided_mu:
+                self._undecided.setdefault(txn.name, []).append((lo, hi))
+            txn._wp_ranges.append((lo, hi))
+            if txn._guard_snap is None:
+                # Compaction guard: a visibility boundary below the
+                # undecided data so background GC never folds/drops across
+                # it (the reference excludes the snapshot-checker from
+                # compaction similarly conservatively).
+                txn._guard_snap = db.snapshots.new_snapshot(lo - 1)
+
+        # Prepare durability: the reference syncs the WAL at prepare.
+        db.write(batch, WriteOptions(sync=True), on_sequenced=on_sequenced)
+
+    def _wp_journal(self, txn, finalized: bool) -> None:
+        """Persist the transaction's WP journal (.prep file). Written with
+        finalized=False BEFORE any data write (intent: a crash rolls the
+        transaction back) and rewritten with finalized=True at Prepare.
+
+        lo_hint: a lower bound on any seqno this transaction's data can
+        occupy, taken BEFORE the data write. If we crash after the data hits
+        the WAL but before the journal records the actual ranges, recovery
+        still compensates correctly by reading each key just below lo_hint —
+        sound because the transaction holds locks on every written key, so
+        no other writer can touch them in between."""
+        import json as _json
+
+        if txn._wp_lo_hint is None:
+            txn._wp_lo_hint = self.db.versions.last_sequence + 1
+        doc = _json.dumps({
+            "policy": "write_prepared",
+            "name": txn.name,
+            "finalized": finalized,
+            "lo_hint": txn._wp_lo_hint,
+            "ranges": [[lo, hi] for lo, hi in txn._wp_ranges],
+            "keys": [k.hex() for k in sorted(txn._wp_keys)],
+            "locks": [k.hex() for k in txn._locked],
+            "range_locks": [
+                [b.hex(), e.hex()] for b, e in txn._locked_ranges
+            ],
+        })
+        self.db.env.write_file(self._prep_path(txn.name), doc.encode(),
+                               sync=True)
+
+    def _wp_prepare(self, txn) -> None:
+        txn._wp_keys.update(txn.wbwi.key_set())
+        self._wp_journal(txn, finalized=False)   # intent first: crash = abort
+        self._wp_write_batch(txn, txn._wp_pending_batch())
+        self._wp_journal(txn, finalized=True)
+
+    def _wp_commit(self, txn) -> None:
+        from toplingdb_tpu.db.write_batch import WriteBatch
+
+        marker = self._MARKER_PREFIX + txn.name.encode()
+        b = WriteBatch()
+        b.put(marker, b"1", cf=self._txn_cf.id)
+        self.db.write(b, WriteOptions(sync=True))  # the commit point
+        self._wp_unregister(txn.name)              # data becomes visible
+        self._wp_release_guard(txn)
+        try:
+            self.db.env.delete_file(self._prep_path(txn.name))
+        except Exception:
+            pass
+        self.db.delete(marker, cf=self._txn_cf)
+        if txn in self._recovered:
+            self._recovered.remove(txn)
+        self._release_name(txn.name)
+
+    def _wp_rollback(self, txn) -> None:
+        from toplingdb_tpu.db.write_batch import WriteBatch
+
+        rb_marker = self._RB_PREFIX + txn.name.encode()
+        mb = WriteBatch()
+        mb.put(rb_marker, b"1", cf=self._txn_cf.id)
+        self.db.write(mb, WriteOptions(sync=True))  # rollback decision point
+        # Compensating records: each written key's value just below the
+        # transaction's first seqno (reference WritePreparedTxn::
+        # RollbackInternal reads prior versions the same way). When the
+        # ranges were never journaled (crash mid-prepare), lo_hint bounds
+        # them from below — see _wp_journal.
+        lo0 = (min(lo for lo, _ in txn._wp_ranges) if txn._wp_ranges
+               else txn._wp_lo_hint)
+        if lo0 is not None and txn._wp_keys:
+            snap = self.db.snapshots.new_snapshot(
+                lo0 - 1, excluded_ranges=self._undecided_ranges()
+            )
+            comp = WriteBatch()
+            try:
+                for k in sorted(txn._wp_keys):
+                    v = self.db.get(k, ReadOptions(snapshot=snap))
+                    if v is None:
+                        comp.delete(k)
+                    else:
+                        comp.put(k, v)
+            finally:
+                snap.release()
+            self.db.write(comp, WriteOptions(sync=True))
+        self._wp_unregister(txn.name)  # original + compensation now visible
+        self._wp_release_guard(txn)
+        try:
+            self.db.env.delete_file(self._prep_path(txn.name))
+        except Exception:
+            pass
+        self.db.delete(rb_marker, cf=self._txn_cf)
+        if txn in self._recovered:
+            self._recovered.remove(txn)
+        self._release_name(txn.name)
+
+    def _wp_release_guard(self, txn) -> None:
+        g = txn._guard_snap
+        txn._guard_snap = None
+        if g is None:
+            return
+        ranges = tuple(txn._wp_ranges)
+        if ranges and self.db.snapshots.any_excluding(
+            min(lo for lo, _ in ranges), max(hi for _, hi in ranges)
+        ):
+            # A live snapshot captured this transaction's exclusion:
+            # compaction must keep the pre-transaction versions that
+            # snapshot reads, so the guard is PARKED until every such
+            # snapshot dies (swept opportunistically).
+            with self._undecided_mu:
+                self._parked_guards.append((g, ranges))
+            return
+        g.release()
+
+    def _sweep_parked_guards(self) -> None:
+        with self._undecided_mu:
+            parked, self._parked_guards = self._parked_guards, []
+        keep = []
+        for g, ranges in parked:
+            if self.db.snapshots.any_excluding(
+                min(lo for lo, _ in ranges), max(hi for _, hi in ranges)
+            ):
+                keep.append((g, ranges))
+            else:
+                g.release()
+        if keep:
+            with self._undecided_mu:
+                self._parked_guards.extend(keep)
+
+    def _recover_wp(self, name: str, doc: dict) -> None:
+        """Recovery for a WritePrepared/WriteUnprepared journal file."""
+        marker = self._MARKER_PREFIX + name.encode()
+        if self.db.get(marker, cf=self._txn_cf) is not None:
+            # Committed; crash before cleanup. Data is visible already.
+            try:
+                self.db.env.delete_file(self._prep_path(name))
+            except Exception:
+                pass
+            self.db.delete(marker, cf=self._txn_cf)
+            return
+        txn = WritePreparedTransaction(self, WriteOptions())
+        txn.name = name
+        txn._wp_ranges = [(lo, hi) for lo, hi in doc.get("ranges", [])]
+        txn._wp_lo_hint = doc.get("lo_hint")
+        txn._wp_keys = {bytes.fromhex(k) for k in doc.get("keys", [])}
+        with self._names_mu:
+            self._names.add(name)
+        with self._undecided_mu:
+            self._undecided[name] = list(txn._wp_ranges)
+        if txn._wp_ranges:
+            txn._guard_snap = self.db.snapshots.new_snapshot(
+                min(lo for lo, _ in txn._wp_ranges) - 1
+            )
+        rb = self.db.get(self._RB_PREFIX + name.encode(), cf=self._txn_cf)
+        if rb is not None or not doc.get("finalized", False):
+            # Mid-rollback, or crashed before Prepare finished: the
+            # transaction never became durable-prepared — roll it back
+            # (idempotent: compensation re-reads below the first seqno).
+            self._wp_rollback(txn)
+            return
+        for kh in doc.get("locks", []):
+            k = bytes.fromhex(kh)
+            self.lock_manager.try_lock(txn.id, k, 0.0)
+            txn._locked.add(k)
+        range_locks = [
+            (bytes.fromhex(b), bytes.fromhex(e))
+            for b, e in doc.get("range_locks", [])
+        ]
+        if range_locks and not isinstance(self.lock_manager, RangeLockManager):
+            raise InvalidArgument(
+                f"prepared transaction {name!r} holds range locks; "
+                f"reopen with use_range_locking=True"
+            )
+        for b, e in range_locks:
+            self.lock_manager.try_lock_range(txn.id, b, e, 0.0)
+            txn._locked_ranges.append((b, e))
+        txn.state = "prepared"
+        self._recovered.append(txn)
+
     def begin_transaction(self, write_options: WriteOptions = WriteOptions(),
                           lock_timeout: float = 1.0) -> PessimisticTransaction:
+        self._sweep_parked_guards()
+        if self.write_policy == "write_prepared":
+            return WritePreparedTransaction(self, write_options, lock_timeout)
+        if self.write_policy == "write_unprepared":
+            return WriteUnpreparedTransaction(self, write_options, lock_timeout)
         return PessimisticTransaction(self, write_options, lock_timeout)
 
     # Non-transactional access locks implicitly (reference WriteCommitted
@@ -568,6 +823,10 @@ class TransactionDB:
         return self.db.get(key, opts)
 
     def close(self) -> None:
+        with self._undecided_mu:
+            parked, self._parked_guards = self._parked_guards, []
+        for g, _ in parked:
+            g.release()
         self.db.close()
 
     def __enter__(self):
@@ -575,6 +834,141 @@ class TransactionDB:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class WritePreparedTransaction(PessimisticTransaction):
+    """WritePrepared policy (reference write_prepared_txn_db.cc): Prepare
+    writes the batch into the DB (WAL + memtable, synced) so Commit is a
+    marker write — no second copy of a large batch at the commit point. The
+    data stays invisible to every reader until the commit marker lands (see
+    TransactionDB._wp_* and DB._undecided_provider)."""
+
+    def __init__(self, txn_db: "TransactionDB", write_options: WriteOptions,
+                 lock_timeout: float = 1.0):
+        super().__init__(txn_db, write_options, lock_timeout)
+        self._wp_ranges: list[tuple[int, int]] = []  # in-DB undecided seqnos
+        self._wp_keys: set[bytes] = set()            # for rollback records
+        self._wp_lo_hint: int | None = None          # see _wp_journal
+        self._guard_snap = None                      # compaction guard
+
+    def _wp_pending_batch(self):
+        """The batch portion not yet written to the DB (everything, for
+        plain WritePrepared; the unprepared subclass spills early)."""
+        return self.wbwi.batch
+
+    def prepare(self) -> None:
+        if self.state != "started":
+            raise InvalidArgument(f"cannot prepare from state {self.state}")
+        if getattr(self, "name", None) is None:
+            raise InvalidArgument("set_name() required before prepare()")
+        self._txn_db._wp_prepare(self)
+        self.state = "prepared"
+
+    def commit(self) -> None:
+        if self.state == "started":
+            # Commit without Prepare: a single atomic batch write IS the
+            # commit point — identical to the WriteCommitted fast path.
+            super().commit()
+            return
+        if self.state != "prepared":
+            raise InvalidArgument(f"cannot commit from state {self.state}")
+        self._txn_db._wp_commit(self)
+        self.state = "committed"
+        self._release()
+
+    def rollback(self) -> None:
+        if self.state == "prepared":
+            self._txn_db._wp_rollback(self)
+            self.wbwi.clear()
+            self.state = "rolledback"
+            self._release()
+            return
+        super().rollback()
+
+
+class WriteUnpreparedTransaction(WritePreparedTransaction):
+    """WriteUnprepared policy (reference write_unprepared_txn_db.cc): batch
+    fragments SPILL into the DB while the transaction is still running, so a
+    transaction larger than memory never materializes its full batch. Each
+    spill extends the undecided seqno ranges; Prepare flushes the remainder
+    and finalizes the journal. The WBWI index is retained for
+    read-your-own-writes across spills."""
+
+    #: spill once the unflushed batch bytes exceed this (reference
+    #: TransactionOptions::write_batch_flush_threshold).
+    spill_threshold: int = 64 * 1024
+
+    def __init__(self, txn_db: "TransactionDB", write_options: WriteOptions,
+                 lock_timeout: float = 1.0,
+                 spill_threshold: int | None = None):
+        super().__init__(txn_db, write_options, lock_timeout)
+        if spill_threshold is not None:
+            self.spill_threshold = spill_threshold
+        self._spill_off = None  # byte offset of unspilled tail in the batch
+        self._spill_count = 0
+
+    def _unspilled(self):
+        from toplingdb_tpu.db.write_batch import HEADER_SIZE, WriteBatch
+
+        if self._spill_off is None:
+            return self.wbwi.batch
+        full = self.wbwi.batch
+        part = WriteBatch()
+        part._rep = bytearray(part._rep[:HEADER_SIZE])
+        part._rep += full._rep[self._spill_off:]
+        part.set_count(full.count() - self._spill_count)
+        # Carry the parsed-ops tail too (kept in lockstep with the bytes).
+        part._ops = (
+            list(full._ops[self._spill_count:])
+            if full._ops is not None else None
+        )
+        return part
+
+    def _wp_pending_batch(self):
+        return self._unspilled()
+
+    def _maybe_spill(self) -> None:
+        pending = self._unspilled()
+        if pending.data_size() <= self.spill_threshold:
+            return
+        if getattr(self, "name", None) is None:
+            # Spills need a recoverable identity before any data hits the
+            # WAL (the reference assigns XIDs internally).
+            self.set_name(f"__unprep.{self.id}")
+        self._wp_keys.update(self.wbwi.key_set())
+        self._txn_db._wp_journal(self, finalized=False)  # intent first
+        self._txn_db._wp_write_batch(self, pending)
+        self._txn_db._wp_journal(self, finalized=False)  # record the range
+        self._spill_off = len(self.wbwi.batch._rep)
+        self._spill_count = self.wbwi.batch.count()
+
+    def put(self, key: bytes, value: bytes) -> None:
+        super().put(key, value)
+        self._maybe_spill()
+
+    def delete(self, key: bytes) -> None:
+        super().delete(key)
+        self._maybe_spill()
+
+    def merge(self, key: bytes, value: bytes) -> None:
+        super().merge(key, value)
+        self._maybe_spill()
+
+    def commit(self) -> None:
+        if self.state == "started" and self._spill_off is not None:
+            # Data is already partially in the DB: a commit must go through
+            # the marker protocol (implicit prepare, as the reference does).
+            self.prepare()
+        super().commit()
+
+    def rollback(self) -> None:
+        if self.state == "started" and self._spill_off is not None:
+            self._txn_db._wp_rollback(self)
+            self.wbwi.clear()
+            self.state = "rolledback"
+            self._release()
+            return
+        super().rollback()
 
 
 class OptimisticTransaction(_TxnBase):
