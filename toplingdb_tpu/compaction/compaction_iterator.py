@@ -47,7 +47,7 @@ class CompactionIterator:
                  bottommost_level: bool = False, merge_operator=None,
                  compaction_filter=None, compaction_filter_level: int = 0,
                  range_del_agg=None, preserve_deletes: bool = False,
-                 blob_resolver=None):
+                 blob_resolver=None, full_history_ts_low: int = 0):
         self._blob_resolver = blob_resolver  # BLOB_INDEX payload → value
         self._input = input_iter
         self._icmp = icmp
@@ -61,6 +61,14 @@ class CompactionIterator:
         self._filter = compaction_filter
         self._filter_level = compaction_filter_level
         self._rd = range_del_agg
+        self._full_history_ts_low = full_history_ts_low
+        # User-defined timestamps: groups are per ENCODED key (key+ts), so a
+        # "group" is one VERSION of a logical key — bottommost tombstone
+        # dropping must be disabled (the tombstone still shadows older-ts
+        # versions living in other groups, and history below it must remain
+        # readable). History reclamation happens only via the
+        # full_history_ts_low trim in entries().
+        self._ts_sz = getattr(self._ucmp, "timestamp_size", 0)
         # Counters (feed compaction stats; reference compaction_job stats).
         self.num_input_records = 0
         self.num_dropped_obsolete = 0
@@ -93,7 +101,40 @@ class CompactionIterator:
     # ------------------------------------------------------------------
 
     def entries(self):
-        """Yields surviving (internal_key, value) in internal-key order."""
+        """Yields surviving (internal_key, value) in internal-key order.
+        With a ts comparator and full_history_ts_low set, versions below the
+        trim point collapse to their newest (reference UDT history trim)."""
+        ts_sz = getattr(self._ucmp, "timestamp_size", 0)
+        if not (ts_sz and self._full_history_ts_low):
+            yield from self._entries_impl()
+            return
+        low_b = dbformat.encode_ts(self._full_history_ts_low)
+        prev_stripped: bytes | None = None
+        # Seqno of the newest RETAINED below-low version of the current
+        # logical key, or None. A below-low version behind it may only drop
+        # when the retained one is visible to EVERY live seqno snapshot
+        # (seq < earliest_snapshot) — otherwise a snapshot older than the
+        # retained version still reads the one behind it.
+        kept_seq: int | None = None
+        for ikey, val in self._entries_impl():
+            uk = dbformat.extract_user_key(ikey)
+            stripped, tsb = uk[:-ts_sz], uk[-ts_sz:]
+            if stripped != prev_stripped:
+                prev_stripped = stripped
+                kept_seq = None
+            # Suffixes store ~ts: suffix AFTER low_b ⇔ ts < ts_low.
+            if tsb > low_b:
+                # Versions come newest-ts first: the first below-low one is
+                # the value visible at ts_low; later ones are unreachable
+                # (reads below ts_low are outside the contract) unless a
+                # live snapshot cannot yet see the retained one.
+                if kept_seq is not None and kept_seq < self._earliest_snapshot:
+                    self.num_dropped_obsolete += 1
+                    continue
+                kept_seq = dbformat.extract_seqno(ikey)
+            yield ikey, val
+
+    def _entries_impl(self):
         it = self._input
         if not it.valid():
             return
@@ -163,7 +204,7 @@ class CompactionIterator:
                 i += 1
                 continue
             if t == ValueType.DELETION:
-                if not (self._bottommost and stripe == 0):
+                if self._ts_sz or not (self._bottommost and stripe == 0):
                     survivors.append((seq, t, val))
                 else:
                     self.num_dropped_tombstone += 1
@@ -178,7 +219,8 @@ class CompactionIterator:
             raise Corruption(f"unexpected type {t} in compaction input")
         if pending_single_del is not None:
             sd_seq, sd_t, sd_v = pending_single_del
-            if not (self._bottommost and self._stripe(sd_seq) == 0):
+            if self._ts_sz or not (self._bottommost
+                                   and self._stripe(sd_seq) == 0):
                 survivors.append(pending_single_del)
             else:
                 self.num_dropped_tombstone += 1

@@ -365,6 +365,9 @@ def _run_subcompactions(env, dbname, icmp, compaction, table_cache,
                 compaction_filter_level=compaction.output_level,
                 range_del_agg=None if rd.empty() else rd,
                 blob_resolver=blob_resolver,
+                full_history_ts_low=getattr(
+                    compaction, "full_history_ts_low", 0
+                ),
             )
             frags = _clip_fragments(all_frags, lo, hi, ucmp)
             stream = ci.entries()

@@ -30,6 +30,10 @@ class Compaction:
     reason: str = ""
     max_output_file_size: int = 8 * 1024 * 1024
     cf_id: int = 0
+    # User-defined-timestamp history trim point (reference
+    # full_history_ts_low / increase_full_history_ts_low): among versions
+    # with ts < this, only the newest survives compaction. 0 = keep all.
+    full_history_ts_low: int = 0
 
     def all_inputs(self) -> list[tuple[int, FileMetaData]]:
         return [(self.level, f) for f in self.inputs] + [

@@ -181,6 +181,7 @@ class CompactionScheduler:
                 c = self.picker.pick_compaction(version)
                 if c is not None:
                     c.cf_id = cf_id
+                    c.full_history_ts_low = self.db.options.full_history_ts_low
                     break
             if c is None:
                 return False
@@ -400,6 +401,7 @@ class CompactionScheduler:
                     reason="manual",
                     max_output_file_size=db.options.target_file_size(level + 1),
                     cf_id=cf_id,
+                    full_history_ts_low=db.options.full_history_ts_low,
                 )
                 for _, f in c.all_inputs():
                     f.being_compacted = True
@@ -424,6 +426,7 @@ class CompactionScheduler:
                 output_level_inputs=base, bottommost=True,
                 reason="manual universal", max_output_file_size=2**62,
                 cf_id=cf_id,
+                full_history_ts_low=db.options.full_history_ts_low,
             )
             for _, f in c.all_inputs():
                 f.being_compacted = True

@@ -45,6 +45,11 @@ def run_job(job_dir: str) -> int:
         ucmp = dbformat.BYTEWISE
     elif params.comparator == dbformat.REVERSE_BYTEWISE.name():
         ucmp = dbformat.REVERSE_BYTEWISE
+    elif params.comparator == dbformat.U64_TS_BYTEWISE.name():
+        # Raw ordering is plain bytewise (inverted-ts suffix encoding), so
+        # the worker's merge/GC path is unchanged; the UDT history-trim
+        # optimization is local-only (keeping all versions is always safe).
+        ucmp = dbformat.U64_TS_BYTEWISE
     else:
         raise ValueError(f"unknown comparator {params.comparator!r}")
     icmp = dbformat.InternalKeyComparator(ucmp)

@@ -304,8 +304,21 @@ class DB:
             env.write_file(filename.identity_file_name(dbname), db.identity.encode())
         db._new_wal()
         try:
-            from toplingdb_tpu.utils.config import persist_options
+            from toplingdb_tpu.utils.config import (
+                load_latest_options, persist_options,
+            )
 
+            if db.icmp.user_comparator.timestamp_size:
+                # full_history_ts_low is monotonic ACROSS reopens (the
+                # reference persists it in the MANIFEST): take the max of
+                # the caller's value and the persisted one — already-trimmed
+                # history must never become readable again.
+                prev = load_latest_options(dbname, env=env)
+                if prev is not None:
+                    options.full_history_ts_low = max(
+                        options.full_history_ts_low,
+                        prev.full_history_ts_low,
+                    )
             persist_options(db)  # reference PersistRocksDBOptions on open
         except Exception:
             pass  # OPTIONS persistence is best-effort, like the reference
@@ -411,32 +424,57 @@ class DB:
     # Write path
     # ==================================================================
 
+    def _ts_key(self, key: bytes, ts: int | None) -> bytes:
+        """Suffix the user timestamp when the comparator carries one
+        (reference user-defined-timestamp write paths: Put(cf, key, ts, v))."""
+        sz = self.icmp.user_comparator.timestamp_size
+        if sz == 0:
+            if ts is not None:
+                raise InvalidArgument(
+                    "timestamp given but the comparator has none "
+                    "(use Options(comparator=U64_TS_BYTEWISE))"
+                )
+            return key
+        if ts is None:
+            raise InvalidArgument(
+                "this DB's comparator requires a timestamp on every write"
+            )
+        return dbformat.encode_ts_key(key, ts)
+
     def put(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE,
-            cf=None) -> None:
+            cf=None, ts: int | None = None) -> None:
         b = WriteBatch()
-        b.put(key, value, cf=self._cf_id(cf))
+        b.put(self._ts_key(key, ts), value, cf=self._cf_id(cf))
         self.write(b, opts)
 
     def delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
-               cf=None) -> None:
+               cf=None, ts: int | None = None) -> None:
         b = WriteBatch()
-        b.delete(key, cf=self._cf_id(cf))
+        b.delete(self._ts_key(key, ts), cf=self._cf_id(cf))
         self.write(b, opts)
 
     def single_delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
-                      cf=None) -> None:
+                      cf=None, ts: int | None = None) -> None:
         b = WriteBatch()
-        b.single_delete(key, cf=self._cf_id(cf))
+        b.single_delete(self._ts_key(key, ts), cf=self._cf_id(cf))
         self.write(b, opts)
 
     def merge(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE,
               cf=None) -> None:
+        if self.icmp.user_comparator.timestamp_size:
+            raise InvalidArgument(
+                "Merge is not supported with user-defined timestamps"
+            )
         b = WriteBatch()
         b.merge(key, value, cf=self._cf_id(cf))
         self.write(b, opts)
 
     def delete_range(self, begin: bytes, end: bytes,
                      opts: WriteOptions = _DEFAULT_WRITE, cf=None) -> None:
+        if self.icmp.user_comparator.timestamp_size:
+            raise InvalidArgument(
+                "DeleteRange is not supported with user-defined timestamps"
+            )
         b = WriteBatch()
         b.delete_range(begin, end, cf=self._cf_id(cf))
         self.write(b, opts)
@@ -753,6 +791,13 @@ class DB:
         """Point lookup (reference DBImpl::GetImpl, db_impl.cc:2079).
         Returns None if not found."""
         self._check_open()
+        if self.icmp.user_comparator.timestamp_size:
+            return self._get_with_ts(key, opts, cf)
+        if opts.timestamp is not None:
+            raise InvalidArgument(
+                "ReadOptions.timestamp requires a timestamp-carrying "
+                "comparator (U64_TS_BYTEWISE)"
+            )
         cfd = self._cf_data(cf)
         snap_seq = (
             opts.snapshot.sequence if opts.snapshot is not None
@@ -830,6 +875,29 @@ class DB:
             frac = (n_l0 - opts.level0_slowdown_writes_trigger + 1) / span
             _time.sleep(min(0.05 * frac, 0.05))
 
+    def _ts_lookup(self, it, key: bytes) -> tuple[bytes, int] | None:
+        """Shared ts-DB point lookup over an existing ts-aware iterator:
+        seek lands directly on the newest visible version of the key."""
+        it.seek(key)
+        if it.valid() and it.key() == key:
+            return it.value(), it.timestamp()
+        return None
+
+    def _get_with_ts(self, key: bytes, opts: ReadOptions, cf) -> bytes | None:
+        """Point lookup on a timestamped DB (reference GetImpl with
+        ReadOptions.timestamp)."""
+        hit = self._ts_lookup(self.new_iterator(opts, cf=cf), key)
+        if hit is None:
+            return None
+        return b"" if opts.just_check_key_exists else hit[0]
+
+    def get_with_ts(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
+                    cf=None) -> tuple[bytes, int] | None:
+        """Get returning (value, version timestamp) — the reference's
+        Get(..., std::string* timestamp) overload."""
+        self._check_open()
+        return self._ts_lookup(self.new_iterator(opts, cf=cf), key)
+
     def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,
                   cf=None) -> list[bytes | None]:
         """Batched point lookups (reference DBImpl::MultiGet, including the
@@ -837,6 +905,15 @@ class DB:
         groups all keys per source so each memtable/file is visited once,
         instead of per-key)."""
         self._check_open()
+        if self.icmp.user_comparator.timestamp_size:
+            # ONE iterator for the whole batch (single view/mutex), seeked
+            # across the keys in sorted order.
+            it = self.new_iterator(opts, cf=cf)
+            hits = {}
+            for k in sorted(set(keys)):
+                hit = self._ts_lookup(it, k)
+                hits[k] = None if hit is None else hit[0]
+            return [hits[k] for k in keys]
         cfd = self._cf_data(cf)
         snap_seq = (
             opts.snapshot.sequence if opts.snapshot is not None
@@ -1032,6 +1109,7 @@ class DB:
                     opts.prefix_same_as_start and not opts.total_order_seek
                 ),
                 excluded_ranges=self._excluded_for(opts),
+                read_ts=opts.timestamp,
             )
             if opts.snapshot is None:
                 # Refresh re-reads at the LATEST sequence; snapshot-pinned
@@ -1048,6 +1126,25 @@ class DB:
             return getattr(opts.snapshot, "excluded_ranges", ())
         fn = self._undecided_provider
         return fn() if fn is not None else ()
+
+    def increase_full_history_ts_low(self, ts_low: int) -> None:
+        """Raise the UDT history trim point (reference
+        DB::IncreaseFullHistoryTsLow): future compactions collapse versions
+        below it. Monotonic; requires a ts comparator."""
+        if self.icmp.user_comparator.timestamp_size == 0:
+            raise InvalidArgument("DB has no user-defined timestamps")
+        if ts_low < self.options.full_history_ts_low:
+            raise InvalidArgument(
+                f"full_history_ts_low can only increase "
+                f"({ts_low} < {self.options.full_history_ts_low})"
+            )
+        self.options.full_history_ts_low = ts_low
+        try:
+            from toplingdb_tpu.utils.config import persist_options
+
+            persist_options(self)  # survives reopen (monotonic contract)
+        except Exception:
+            pass
 
     def get_snapshot(self):
         fn = self._undecided_provider

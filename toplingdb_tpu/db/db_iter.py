@@ -20,7 +20,8 @@ class DBIter:
                  upper_bound: bytes | None = None,
                  pinned=None, blob_resolver=None,
                  prefix_extractor=None, prefix_same_as_start: bool = False,
-                 excluded_ranges: tuple = ()):
+                 excluded_ranges: tuple = (),
+                 read_ts: int | None = None):
         self._blob_resolver = blob_resolver
         # `pinned` keeps the source Version (and anything else) alive for the
         # iterator's lifetime so obsolete-file GC cannot delete SSTs that
@@ -45,6 +46,18 @@ class DBIter:
         self._prefix: bytes | None = None
         # Undecided WritePrepared transaction data (see db/snapshot.py).
         self._excluded_ranges = excluded_ranges
+        # User-defined timestamps (reference ReadOptions.timestamp / the
+        # TOPLINGDB_WITH_TIMESTAMP feature): with a ts-carrying comparator,
+        # the iterator dedups by the STRIPPED key, hides versions newer than
+        # read_ts, and key() returns the stripped key (timestamp() has the
+        # version's ts). Requires the bytewise+u64ts comparator, so stripped
+        # keys compare as raw bytes.
+        self._ts_sz = getattr(self._ucmp, "timestamp_size", 0)
+        self._read_ts_b = (
+            dbformat.encode_ts(read_ts)
+            if (self._ts_sz and read_ts is not None) else None
+        )
+        self._key_full: bytes | None = None
 
     def refresh(self) -> None:
         """Rebind to the DB's CURRENT state (reference Iterator::Refresh):
@@ -72,6 +85,30 @@ class DBIter:
         assert self._valid
         return self._value
 
+    def timestamp(self) -> int | None:
+        """User timestamp of the current entry (ts-comparator DBs only)."""
+        assert self._valid
+        if not self._ts_sz:
+            return None
+        return dbformat.decode_ts(self._key_full[-self._ts_sz:])
+
+    def _vkey(self, uk: bytes) -> bytes:
+        """The user-VISIBLE key: escape + ts suffix stripped in ts mode."""
+        return dbformat.strip_ts(uk) if self._ts_sz else uk
+
+    def _vcmp(self, a: bytes, b: bytes) -> int:
+        """Compare two visible keys (already stripped)."""
+        if self._ts_sz:
+            return (a > b) - (a < b)  # u64ts requires the bytewise base
+        return self._ucmp.compare(a, b)
+
+    def _ts_invisible(self, uk: bytes) -> bool:
+        # Suffixes store ~ts (dbformat.encode_ts): smaller suffix = newer
+        # timestamp, so a version is invisible (ts > read_ts) when its
+        # suffix sorts BEFORE the read timestamp's.
+        return (self._read_ts_b is not None
+                and uk[-self._ts_sz:] < self._read_ts_b)
+
     def seek_to_first(self) -> None:
         # Total-order entry point: never arms prefix mode, even when a lower
         # bound redirects it through a seek.
@@ -86,10 +123,20 @@ class DBIter:
         self._seek_impl(user_key, arm_prefix=True)
 
     def _seek_impl(self, user_key: bytes, arm_prefix: bool) -> None:
-        if self._lower is not None and self._ucmp.compare(user_key, self._lower) < 0:
+        if self._lower is not None and self._vcmp(user_key, self._lower) < 0:
             user_key = self._lower
         if arm_prefix:
             self._arm_prefix(user_key)
+        if self._ts_sz:
+            # Land on the newest VISIBLE version: (key, read_ts) sorts after
+            # every newer-ts version (ts orders descending), skipping them
+            # in the seek itself. No read_ts → newest of all (ts MAX sorts
+            # first among the key's versions).
+            user_key = dbformat.encode_ts_key(
+                user_key,
+                dbformat.decode_ts(self._read_ts_b)
+                if self._read_ts_b is not None else dbformat.MAX_TIMESTAMP,
+            )
         target = dbformat.make_internal_key(
             user_key, self._seq, dbformat.VALUE_TYPE_FOR_SEEK
         )
@@ -102,8 +149,12 @@ class DBIter:
             # Upper bound is exclusive: (upper, MAX_SEQ, FOR_SEEK) sorts before
             # every entry of user key `upper`, so seek_for_prev lands strictly
             # below the bound under any comparator.
+            upper = self._upper
+            if self._ts_sz:
+                # ts MAX sorts first: the FIRST version of upper.
+                upper = dbformat.encode_ts_key(upper, dbformat.MAX_TIMESTAMP)
             target = dbformat.make_internal_key(
-                self._upper, dbformat.MAX_SEQUENCE_NUMBER,
+                upper, dbformat.MAX_SEQUENCE_NUMBER,
                 dbformat.VALUE_TYPE_FOR_SEEK,
             )
             self._iter.seek_for_prev(target)
@@ -114,6 +165,9 @@ class DBIter:
 
     def seek_for_prev(self, user_key: bytes) -> None:
         self._arm_prefix(user_key)
+        if self._ts_sz:
+            # (key, ts=0) is the LAST version of key in ts-descending order.
+            user_key = dbformat.encode_ts_key(user_key, 0)
         target = dbformat.make_internal_key(user_key, 0, 0)
         # All entries for user_key sort before target's successor; position at
         # the last entry <= (user_key, seq 0): that's the oldest entry of
@@ -130,16 +184,21 @@ class DBIter:
     def prev(self) -> None:
         assert self._valid
         # Move internal iterator to strictly before the current user key.
-        cur = self._key
+        cur = self._key  # visible (stripped) key
         if not self._iter.valid():
             # Forward resolution (e.g. a merge chain) exhausted the internal
             # iterator; re-position at the last entry before cur's versions.
+            first = (
+                dbformat.encode_ts_key(cur, dbformat.MAX_TIMESTAMP)
+                if self._ts_sz else cur
+            )
             self._iter.seek_for_prev(dbformat.make_internal_key(
-                cur, dbformat.MAX_SEQUENCE_NUMBER, dbformat.VALUE_TYPE_FOR_SEEK
+                first, dbformat.MAX_SEQUENCE_NUMBER,
+                dbformat.VALUE_TYPE_FOR_SEEK
             ))
         else:
-            while self._iter.valid() and self._ucmp.compare(
-                dbformat.extract_user_key(self._iter.key()), cur
+            while self._iter.valid() and self._vcmp(
+                self._vkey(dbformat.extract_user_key(self._iter.key())), cur
             ) >= 0:
                 self._iter.prev()
         self._find_prev_user_entry()
@@ -164,11 +223,11 @@ class DBIter:
             or self._pe.transform(uk) != self._prefix
         )
 
-    def _out_of_upper(self, uk: bytes) -> bool:
-        return self._upper is not None and self._ucmp.compare(uk, self._upper) >= 0
+    def _out_of_upper(self, vk: bytes) -> bool:
+        return self._upper is not None and self._vcmp(vk, self._upper) >= 0
 
-    def _out_of_lower(self, uk: bytes) -> bool:
-        return self._lower is not None and self._ucmp.compare(uk, self._lower) < 0
+    def _out_of_lower(self, vk: bytes) -> bool:
+        return self._lower is not None and self._vcmp(vk, self._lower) < 0
 
     def _excluded(self, seq: int) -> bool:
         for lo, hi in self._excluded_ranges:
@@ -190,9 +249,10 @@ class DBIter:
         while self._iter.valid():
             ikey = self._iter.key()
             uk, seq, t = dbformat.split_internal_key(ikey)
-            if self._out_of_upper(uk) or self._out_of_prefix(uk):
+            vkey = self._vkey(uk)
+            if self._out_of_upper(vkey) or self._out_of_prefix(vkey):
                 break
-            if skip_key is not None and self._ucmp.compare(uk, skip_key) <= 0:
+            if skip_key is not None and self._vcmp(vkey, skip_key) <= 0:
                 self._iter.next()
                 continue
             if seq > self._seq or (
@@ -200,7 +260,11 @@ class DBIter:
             ):
                 self._iter.next()
                 continue
-            if merge_key is not None and self._ucmp.compare(uk, merge_key) != 0:
+            if self._ts_sz and self._ts_invisible(uk):
+                # Version newer than the read timestamp.
+                self._iter.next()
+                continue
+            if merge_key is not None and self._vcmp(vkey, merge_key) != 0:
                 # Merge chain ran to the end of this key with no base.
                 self._emit_merge(merge_key, None, operands)
                 return
@@ -210,7 +274,7 @@ class DBIter:
                 if merge_key is not None:
                     self._emit_merge(merge_key, None, operands)
                     return
-                skip_key = uk  # key is dead; skip all its older versions
+                skip_key = vkey  # key is dead; skip all its older versions
                 self._iter.next()
                 continue
             if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
@@ -221,14 +285,19 @@ class DBIter:
                     self._emit_merge(merge_key, v, operands)
                     return
                 self._valid = True
-                self._key = uk
+                self._key = vkey
+                self._key_full = uk
                 self._value = v
                 return
             if t == ValueType.MERGE:
+                if self._ts_sz:
+                    raise MergeInProgress(
+                        "Merge is not supported with user-defined timestamps"
+                    )
                 if self._merge_op is None:
                     raise MergeInProgress("merge entry but no merge_operator")
                 if merge_key is None:
-                    merge_key = uk
+                    merge_key = vkey
                 operands.append(self._iter.value())
                 self._iter.next()
                 continue
@@ -244,9 +313,10 @@ class DBIter:
         return self._blob_resolver(idx)
 
     def _emit_merge(self, uk: bytes, base: bytes | None, operands: list[bytes]) -> None:
-        # operands collected newest→oldest.
+        # operands collected newest→oldest. (ts mode never reaches here.)
         self._valid = True
         self._key = uk
+        self._key_full = uk
         self._value = self._merge_op.full_merge(uk, base, list(reversed(operands)))
 
     def _find_prev_user_entry(self) -> None:
@@ -254,11 +324,16 @@ class DBIter:
         before the internal iterator's position, scanning backward."""
         while self._iter.valid():
             uk = dbformat.extract_user_key(self._iter.key())
-            if self._out_of_lower(uk) or self._out_of_prefix(uk):
+            vkey = self._vkey(uk)
+            if self._out_of_lower(vkey) or self._out_of_prefix(vkey):
                 break
-            if self._out_of_upper(uk):
+            if self._out_of_upper(vkey):
                 self._iter.prev()
                 continue
+            if self._ts_sz:
+                if self._resolve_backward_ts(vkey):
+                    return
+                continue  # key dead/invisible: keep scanning backward
             # Collect all entries of this user key (backward walk hits them
             # oldest-internal-position... i.e. lowest seq first).
             entries: list[tuple[int, int, bytes]] = []
@@ -280,6 +355,43 @@ class DBIter:
             # Key dead/invisible: continue scanning previous keys.
         self._valid = False
 
+    def _resolve_backward_ts(self, vkey: bytes) -> bool:
+        """ts-mode backward resolution: walk every (ts, seq) version of the
+        stripped key, pick the newest visible one, surface it if live. The
+        internal iterator ends strictly before vkey's entries."""
+        best = None  # (ts_suffix, seq, type, value) — max by (ts, seq)
+        while self._iter.valid():
+            uk2, seq2, t2 = dbformat.split_internal_key(self._iter.key())
+            if self._vkey(uk2) != vkey:
+                break
+            if (seq2 <= self._seq
+                    and not (self._excluded_ranges and self._excluded(seq2))
+                    and not self._ts_invisible(uk2)):
+                if t2 == ValueType.MERGE:
+                    raise MergeInProgress(
+                        "Merge is not supported with user-defined timestamps"
+                    )
+                # Suffix stores ~ts: the NEWEST version has the SMALLEST
+                # suffix; among equal ts the largest seq wins.
+                cand = (uk2[-self._ts_sz:], seq2, t2, self._iter.value(), uk2)
+                if best is None or (cand[0], -cand[1]) < (best[0], -best[1]):
+                    best = cand
+            self._iter.prev()
+        if best is None:
+            return False
+        _tsb, seq_, t_, val, full = best
+        if self._tomb_covers(full, seq_) or t_ in (
+            ValueType.DELETION, ValueType.SINGLE_DELETION
+        ):
+            return False
+        if t_ == ValueType.BLOB_INDEX:
+            val = self._resolve_blob(val)
+        self._valid = True
+        self._key = vkey
+        self._key_full = full
+        self._value = val
+        return True
+
     def _resolve_backward(self, uk: bytes, entries: list[tuple[int, int, bytes]]) -> bool:
         operands: list[bytes] = []
         for seq, t, val in reversed(entries):  # newest first
@@ -298,6 +410,7 @@ class DBIter:
                 else:
                     self._valid = True
                     self._key = uk
+                    self._key_full = uk
                     self._value = val
                 return True
             if t == ValueType.MERGE:

@@ -68,6 +68,12 @@ class Options:
     # 0 = off; leveled style only — FIFO ages out via fifo_ttl_seconds).
     periodic_compaction_seconds: int = 0
 
+    # User-defined timestamps: versions with ts below this trim point
+    # collapse to the newest one at compaction (reference
+    # full_history_ts_low; DB.increase_full_history_ts_low raises it).
+    # Only meaningful with a ts-carrying comparator. 0 = keep full history.
+    full_history_ts_low: int = 0
+
     # -- background work ------------------------------------------------
     max_background_jobs: int = 2
     max_subcompactions: int = 1
@@ -156,6 +162,10 @@ class ReadOptions:
     # db/forward_iterator.cc): forward-only, sees new writes after catching
     # up at end-of-data; incompatible with `snapshot`.
     tailing: bool = False
+    # User-defined timestamp to read AS OF (reference ReadOptions.timestamp,
+    # the TOPLINGDB_WITH_TIMESTAMP feature): only versions with ts <= this
+    # are visible. Requires a timestamp-carrying comparator. None = latest.
+    timestamp: Optional[int] = None
 
 
 @dataclass

@@ -72,6 +72,7 @@ def _register_builtins(reg: ObjectRegistry) -> None:
 
     reg.register("comparator", "bytewise", lambda: dbformat.BYTEWISE)
     reg.register("comparator", "reverse_bytewise", lambda: dbformat.REVERSE_BYTEWISE)
+    reg.register("comparator", "u64ts_bytewise", lambda: dbformat.U64_TS_BYTEWISE)
     from toplingdb_tpu.utils.merge_operator import (
         AggMergeOperator, BytesXOROperator, CassandraValueMergeOperator,
         SortListOperator,
@@ -119,6 +120,7 @@ _SIMPLE_OPTION_KEYS = {
     "universal_max_size_amplification_percent",
     "fifo_max_table_files_size", "fifo_ttl_seconds",
     "periodic_compaction_seconds",
+    "full_history_ts_low",
     "enable_blob_files", "min_blob_size",
     "enable_blob_garbage_collection", "blob_garbage_collection_age_cutoff",
     "stats_persist_period_sec", "seqno_time_sample_period_sec",
@@ -194,6 +196,8 @@ def options_to_config(opts) -> dict:
             out[k] = v
     if opts.comparator.name() == "tpulsm.ReverseBytewiseComparator":
         out["comparator"] = "reverse_bytewise"
+    elif opts.comparator.name() == "tpulsm.BytewiseComparator.u64ts":
+        out["comparator"] = "u64ts_bytewise"
     # (any other non-bytewise comparator is an unregistered custom object —
     # skipped, like the reference skips unknown customizables)
     if opts.merge_operator is not None:
