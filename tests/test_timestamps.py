@@ -125,14 +125,9 @@ def test_full_history_ts_low_trims_compaction(tmp_path):
     # the value visible at ts_low; ts=10 dropped)
     assert db.get(b"k", ReadOptions(timestamp=26)) == b"v@20"
     assert db.get(b"k") == b"v@30"
-    it = db.new_iterator(ReadOptions())
-    it.seek(b"k")
-    # count physical versions via internal iterator on a fresh scan
-    mem_versions = 0
-    it2 = db.new_iterator(ReadOptions(timestamp=10))
-    it2.seek_to_first()
-    # ts=10 version was trimmed: read below ts_low finds the ts<=10... none
-    assert not it2.valid() or it2.key() != b"k" or it2.timestamp() != 10
+    # reads below the trim point are rejected, not silently wrong
+    with pytest.raises(InvalidArgument):
+        db.new_iterator(ReadOptions(timestamp=10))
     db.close()
 
 
@@ -186,6 +181,71 @@ def test_single_delete_and_unsupported_ops(db):
         db.merge(b"k", b"v")
     with pytest.raises(InvalidArgument):
         db.delete_range(b"a", b"z")
+
+
+def test_raw_batch_rejected_on_ts_db(db):
+    """A raw (un-timestamped) key must never enter a ts DB — including via
+    DB.write and transactions (regression: poisoned iteration)."""
+    from toplingdb_tpu.db.write_batch import WriteBatch
+
+    b = WriteBatch()
+    b.put(b"raw", b"v")
+    with pytest.raises(InvalidArgument):
+        db.write(b)
+    b2 = WriteBatch()
+    b2.delete_range(b"a", b"z")
+    with pytest.raises(InvalidArgument):
+        db.write(b2)
+    # iteration still healthy
+    db.put(b"ok", b"v", ts=1)
+    it = db.new_iterator()
+    it.seek_to_first()
+    assert [k for k, _ in it.entries()] == [b"ok"]
+
+
+def test_reads_below_ts_low_rejected(tmp_path):
+    db = DB.open(str(tmp_path / "db"), Options(comparator=U64_TS_BYTEWISE))
+    db.put(b"k", b"v@10", ts=10)
+    db.put(b"k", b"v@30", ts=30)
+    db.increase_full_history_ts_low(20)
+    for fn in (
+        lambda: db.get(b"k", ReadOptions(timestamp=12)),
+        lambda: db.new_iterator(ReadOptions(timestamp=12)),
+        lambda: db.multi_get([b"k"], ReadOptions(timestamp=12)),
+    ):
+        with pytest.raises(InvalidArgument):
+            fn()
+    assert db.get(b"k", ReadOptions(timestamp=25)) == b"v@10"
+    db.close()
+
+
+def test_ts_guard_on_plain_db_iterator_and_multiget(tmp_path):
+    plain = DB.open(str(tmp_path / "plain"), Options())
+    with pytest.raises(InvalidArgument):
+        plain.new_iterator(ReadOptions(timestamp=5))
+    with pytest.raises(InvalidArgument):
+        plain.multi_get([b"k"], ReadOptions(timestamp=5))
+    plain.close()
+
+
+def test_bottommost_drops_fully_trimmed_tombstone(tmp_path):
+    """delete + whole history below ts_low at bottommost → the tombstone
+    itself is reclaimed (regression: deleted keys leaking space forever)."""
+    db = DB.open(str(tmp_path / "db"), Options(comparator=U64_TS_BYTEWISE))
+    db.put(b"dead", b"v", ts=3)
+    db.delete(b"dead", ts=5)
+    db.put(b"live", b"v", ts=6)
+    db.flush()
+    db.increase_full_history_ts_low(100)
+    db.compact_range()
+    assert db.get(b"dead") is None
+    assert db.get(b"live") == b"v"
+    # physically gone: no version of 'dead' remains in any SST
+    st = db.versions.column_families[0]
+    total = sum(f.num_entries + f.num_deletions
+                for _, f in st.current.all_files())
+    assert total == 1  # just 'live'
+    db.close()
 
 
 def test_multi_get_with_ts(db):

@@ -1,0 +1,68 @@
+"""Whitebox crash testing — TEST_KILL_RANDOM kill points + the db_stress
+--whitebox crash loop (reference tools/db_crashtest.py whitebox mode)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from toplingdb_tpu.utils.kill_point import KILLED_EXIT_CODE, reset_for_tests
+from toplingdb_tpu.utils.kill_point import test_kill_random as kill_marker
+
+
+def test_unarmed_is_noop(monkeypatch):
+    monkeypatch.delenv("TPULSM_KILL_ODDS", raising=False)
+    reset_for_tests()
+    for _ in range(100):
+        kill_marker("VersionSet::LogAndApply:BeforeManifestWrite")
+    reset_for_tests()
+
+
+def test_prefix_filter_spares_other_points(monkeypatch):
+    monkeypatch.setenv("TPULSM_KILL_ODDS", "1")  # certain death if armed
+    monkeypatch.setenv("TPULSM_KILL_PREFIX", "FlushJob")
+    reset_for_tests()
+    kill_marker("DBImpl::WriteImpl:AfterWAL")  # not armed: survives
+    reset_for_tests()
+
+
+def test_armed_point_kills_subprocess():
+    src = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        from toplingdb_tpu.utils.kill_point import test_kill_random
+        test_kill_random("FlushJob::AfterTableWrite")
+        print("survived")
+    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ, TPULSM_KILL_ODDS="1", TPULSM_KILL_SEED="7")
+    r = subprocess.run([sys.executable, "-c", src], env=env,
+                       capture_output=True)
+    assert r.returncode == KILLED_EXIT_CODE
+    assert b"survived" not in r.stdout
+
+
+@pytest.mark.parametrize("prefix", [
+    "DBImpl::WriteImpl:AfterWAL",
+    "FlushJob::AfterTableWrite",
+    "VersionSet::LogAndApply",
+])
+def test_whitebox_crash_loop_recovers(tmp_path, prefix):
+    """Arm one durability window at a time; the crash loop must recover and
+    verify after every fired kill point."""
+    db = str(tmp_path / "db")
+    cmd = [
+        sys.executable, "-m", "toplingdb_tpu.tools.db_stress",
+        f"--db={db}", "--crash-test", "--whitebox",
+        "--rounds=3", "--ops=4000", "--threads=2", "--max-key=300",
+        "--kill-odds=40", f"--kill-prefix={prefix}",
+        "--kill-after=30", "--seed=11",
+        "--write-buffer-size=8192",  # frequent switches/flushes
+    ]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(cmd, capture_output=True, timeout=240, env=env)
+    out = r.stdout.decode()
+    assert r.returncode == 0, out + r.stderr.decode()
+    assert "crash test passed" in out

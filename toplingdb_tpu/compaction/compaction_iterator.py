@@ -131,7 +131,20 @@ class CompactionIterator:
                 if kept_seq is not None and kept_seq < self._earliest_snapshot:
                     self.num_dropped_obsolete += 1
                     continue
-                kept_seq = dbformat.extract_seqno(ikey)
+                seq_e = dbformat.extract_seqno(ikey)
+                t_e = dbformat.extract_value_type(ikey)
+                if (self._bottommost and kept_seq is None
+                        and t_e in (ValueType.DELETION,
+                                    ValueType.SINGLE_DELETION)
+                        and seq_e < self._earliest_snapshot):
+                    # The key's visible-at-ts_low state is "deleted" and
+                    # nothing lies beneath this level: the tombstone has
+                    # done its job — drop it, and the kept_seq guard drops
+                    # the older versions it shadowed.
+                    self.num_dropped_tombstone += 1
+                    kept_seq = seq_e
+                    continue
+                kept_seq = seq_e
             yield ikey, val
 
     def _entries_impl(self):

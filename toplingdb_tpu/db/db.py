@@ -424,6 +424,31 @@ class DB:
     # Write path
     # ==================================================================
 
+    def _validate_ts_batch(self, batch: WriteBatch) -> None:
+        """Every key entering a ts-comparator DB must be encode_ts_key-form;
+        a single raw key would poison iteration forever (strip_ts raises on
+        it). Write paths that can't carry a timestamp (transactions,
+        DeleteRange) are rejected here rather than corrupting the DB."""
+        if getattr(batch, "_ts_checked", False):
+            return
+        for _cf, t, key, _val in batch.entries_cf():
+            if t == ValueType.RANGE_DELETION:
+                raise InvalidArgument(
+                    "DeleteRange is not supported with user-defined "
+                    "timestamps"
+                )
+            if t == ValueType.LOG_DATA:
+                continue
+            try:
+                dbformat.strip_ts(key)
+            except ValueError as e:
+                raise InvalidArgument(
+                    f"key {key!r} lacks a timestamp suffix; this DB's "
+                    f"comparator requires ts= on every write (transactions "
+                    f"do not support user-defined timestamps)"
+                ) from e
+        batch._ts_checked = True
+
     def _ts_key(self, key: bytes, ts: int | None) -> bytes:
         """Suffix the user timestamp when the comparator carries one
         (reference user-defined-timestamp write paths: Put(cf, key, ts, v))."""
@@ -489,6 +514,8 @@ class DB:
         if batch.is_empty():
             return
         self._check_open()  # fail fast before any stall sleep
+        if self.icmp.user_comparator.timestamp_size:
+            self._validate_ts_batch(batch)
         self._maybe_stall_writes()
         w = _Writer(batch, opts, on_sequenced)
         with self._wq_lock:
@@ -579,6 +606,9 @@ class DB:
                     self._wal.sync()
                 else:
                     self._wal.flush()
+                from toplingdb_tpu.utils.kill_point import test_kill_random
+
+                test_kill_random("DBImpl::WriteImpl:AfterWAL")
             mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
             for w in group:
                 w.batch.insert_into(mems)
@@ -639,6 +669,9 @@ class DB:
         """Seal every CF's non-empty active memtable and start a new WAL
         (reference DBImpl::SwitchMemtable; all-CF switching = atomic-flush
         behavior so log_number can advance safely)."""
+        from toplingdb_tpu.utils.kill_point import test_kill_random
+
+        test_kill_random("DBImpl::SwitchMemtable:Start")
         if self._wal is not None:
             self._wal.sync()
             self._wal.close()
@@ -697,6 +730,9 @@ class DB:
                 min_blob_size=self.options.min_blob_size,
                 column_family=(cf_id, self.cf_name(cf_id)),
             )
+            from toplingdb_tpu.utils.kill_point import test_kill_random
+
+            test_kill_random("FlushJob::AfterTableWrite")
             edit = VersionEdit(log_number=wal_number, column_family=cf_id)
             if meta is not None:
                 edit.add_file(0, meta)
@@ -793,11 +829,7 @@ class DB:
         self._check_open()
         if self.icmp.user_comparator.timestamp_size:
             return self._get_with_ts(key, opts, cf)
-        if opts.timestamp is not None:
-            raise InvalidArgument(
-                "ReadOptions.timestamp requires a timestamp-carrying "
-                "comparator (U64_TS_BYTEWISE)"
-            )
+        self._check_read_ts(opts)
         cfd = self._cf_data(cf)
         snap_seq = (
             opts.snapshot.sequence if opts.snapshot is not None
@@ -875,6 +907,25 @@ class DB:
             frac = (n_l0 - opts.level0_slowdown_writes_trigger + 1) / span
             _time.sleep(min(0.05 * frac, 0.05))
 
+    def _check_read_ts(self, opts: ReadOptions) -> None:
+        """Validate ReadOptions.timestamp against this DB (reference: reads
+        need a ts comparator, and reading below full_history_ts_low is
+        InvalidArgument — that history may already be collapsed, so the
+        answer would depend on compaction timing)."""
+        if opts.timestamp is None:
+            return
+        if self.icmp.user_comparator.timestamp_size == 0:
+            raise InvalidArgument(
+                "ReadOptions.timestamp requires a timestamp-carrying "
+                "comparator (U64_TS_BYTEWISE)"
+            )
+        if opts.timestamp < self.options.full_history_ts_low:
+            raise InvalidArgument(
+                f"cannot read at ts={opts.timestamp}: history below "
+                f"full_history_ts_low={self.options.full_history_ts_low} "
+                f"may be collapsed"
+            )
+
     def _ts_lookup(self, it, key: bytes) -> tuple[bytes, int] | None:
         """Shared ts-DB point lookup over an existing ts-aware iterator:
         seek lands directly on the newest visible version of the key."""
@@ -905,6 +956,7 @@ class DB:
         groups all keys per source so each memtable/file is visited once,
         instead of per-key)."""
         self._check_open()
+        self._check_read_ts(opts)
         if self.icmp.user_comparator.timestamp_size:
             # ONE iterator for the whole batch (single view/mutex), seeked
             # across the keys in sorted order.
@@ -1055,6 +1107,7 @@ class DB:
         """MVCC iterator over the whole keyspace (reference
         DBImpl::NewIterator → DBIter over a MergingIterator)."""
         self._check_open()
+        self._check_read_ts(opts)
         if opts.tailing:
             import dataclasses as _dcs
 
@@ -1138,13 +1191,18 @@ class DB:
                 f"full_history_ts_low can only increase "
                 f"({ts_low} < {self.options.full_history_ts_low})"
             )
+        old = self.options.full_history_ts_low
         self.options.full_history_ts_low = ts_low
-        try:
-            from toplingdb_tpu.utils.config import persist_options
+        from toplingdb_tpu.utils.config import persist_options
 
-            persist_options(self)  # survives reopen (monotonic contract)
+        try:
+            # The bump must be durable BEFORE any compaction trims under it
+            # — otherwise a reopen resets the floor and already-collapsed
+            # history becomes silently readable. Persist or roll back.
+            persist_options(self)
         except Exception:
-            pass
+            self.options.full_history_ts_low = old
+            raise
 
     def get_snapshot(self):
         fn = self._undecided_provider

@@ -409,9 +409,13 @@ class VersionSet:
             builder.apply(edit)
             new_version = builder.save()
             assert self._manifest_writer is not None
+            from toplingdb_tpu.utils.kill_point import test_kill_random
+
+            test_kill_random("VersionSet::LogAndApply:BeforeManifestWrite")
             self._manifest_writer.add_record(edit.encode())
             if sync:
                 self._manifest_writer.sync()
+            test_kill_random("VersionSet::LogAndApply:AfterManifestWrite")
             self._all_versions.add(new_version)
             st.current = new_version
 

@@ -196,8 +196,15 @@ def run_stress(args) -> int:
 
 
 def run_crash_test(args) -> int:
-    """Blackbox crash loop (reference tools/db_crashtest.py): run the stress
-    child, kill -9 it at a random moment, reopen + verify, repeat."""
+    """Crash loop (reference tools/db_crashtest.py). Blackbox: run the
+    stress child, kill -9 it at a random wall-clock moment. Whitebox
+    (--whitebox): the child ALSO self-kills at armed TEST_KILL_RANDOM
+    markers inside the engine's durability windows (after-WAL,
+    memtable-switch, after-SST-write, before/after-MANIFEST-write), hitting
+    the exact crash points wall-clock kills rarely land on. Either way the
+    next round reopens and verifies against the expected-state journal."""
+    from toplingdb_tpu.utils.kill_point import KILLED_EXIT_CODE
+
     rng = random.Random(args.seed or None)
     for round_ in range(args.rounds):
         cmd = [
@@ -206,16 +213,26 @@ def run_crash_test(args) -> int:
             f"--threads={args.threads}", f"--seed={args.seed + round_}",
             f"--max-key={args.max_key}",
         ]
+        env = dict(os.environ)
+        if args.whitebox:
+            env["TPULSM_KILL_ODDS"] = str(args.kill_odds)
+            env["TPULSM_KILL_SEED"] = str(args.seed + round_)
+            if args.kill_prefix:
+                env["TPULSM_KILL_PREFIX"] = args.kill_prefix
         child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                 stderr=subprocess.STDOUT)
+                                 stderr=subprocess.STDOUT, env=env)
         kill_after = rng.uniform(0.5, args.kill_after)
         try:
             out, _ = child.communicate(timeout=kill_after)
-            if child.returncode != 0:
+            if child.returncode == KILLED_EXIT_CODE:
+                print(f"round {round_}: whitebox kill point fired; "
+                      f"verifying...")
+            elif child.returncode != 0:
                 print(out.decode())
                 print(f"round {round_}: child failed rc={child.returncode}")
                 return 1
-            print(f"round {round_}: completed cleanly")
+            else:
+                print(f"round {round_}: completed cleanly")
         except subprocess.TimeoutExpired:
             child.kill()
             child.wait()
@@ -246,6 +263,10 @@ def main(argv=None) -> int:
     ap.add_argument("--crash-test", action="store_true")
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--kill-after", type=float, default=5.0)
+    # Whitebox mode (reference db_crashtest.py whitebox / TEST_KILL_RANDOM).
+    ap.add_argument("--whitebox", action="store_true")
+    ap.add_argument("--kill-odds", type=int, default=300)
+    ap.add_argument("--kill-prefix", default="")
     args = ap.parse_args(argv)
     if args.crash_test:
         return run_crash_test(args)
