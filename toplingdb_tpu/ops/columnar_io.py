@@ -372,12 +372,38 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
     (per ORIGINAL entry index) >= 0 replaces the 8-byte key trailer (seqno
     zeroing). Returns a list of (fnum, path, props, smallest, largest, sel)
     where sel is the original-index selection written to that file.
-    On any failure every partial output is deleted before re-raising."""
+    On any failure every partial output is deleted before re-raising.
+
+    `order` may also be an ITERATOR of int32 chunks (the device-shard
+    pipeline: shard s's survivors stream into SSTs while shard s+1 is still
+    computing/downloading). Chunks must be key-range-ordered with no user
+    key spanning a chunk boundary, and the caller may update
+    trailer_override/seqs rows for a chunk any time before yielding it."""
     lib = native.lib()
     if lib is None:
         raise NotSupported("native library unavailable")
-    n_total = len(order)
-    order = np.ascontiguousarray(order, dtype=np.int32)
+    if isinstance(order, np.ndarray):
+        # Whole array up front: no copy, no withhold/rebuild of the final
+        # block (exhausted from the start).
+        chunks = iter(())
+        order = np.ascontiguousarray(order, dtype=np.int32)
+        start_filled = len(order)
+        start_exhausted = True
+    else:
+        chunks = iter(order)
+        # Survivor count unknown until the last chunk arrives; kv.n bounds it.
+        order = np.empty(kv.n, dtype=np.int32)
+        start_filled = 0
+        start_exhausted = False
+        # Streaming callers mutate trailer_override/seqs rows right before
+        # yielding each chunk; a dtype/layout conversion here would COPY and
+        # silently sever that aliasing, so demand the exact form instead.
+        if (trailer_override.dtype != np.int64
+                or not trailer_override.flags.c_contiguous):
+            raise NotSupported(
+                "streamed order requires a C-contiguous int64 "
+                "trailer_override (mutations must alias the writer's view)"
+            )
     trailer_override = np.ascontiguousarray(trailer_override, dtype=np.int64)
 
     max_entry = int(kv.key_lens.max() if kv.n else 0) + int(
@@ -425,9 +451,9 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
     # .so without the symbol degrades to the per-block path.
     use_section = (options.compression == fmt.NO_COMPRESSION
                    and hasattr(lib, "tpulsm_build_data_section"))
-    if use_section and n_total:
-        sec_bytes = int(kv.key_lens[order].sum()) + int(
-            kv.val_lens[order].sum())
+    if use_section and kv.n:
+        # Upper bound over ALL entries (the survivor set streams in).
+        sec_bytes = int(kv.key_lens.sum()) + int(kv.val_lens.sum())
         # Each native call emits at most ~_SECTION_RUN_BYTES (stopping a run
         # early is free: the next call continues the same file), so the
         # section buffer and the per-call copy stay bounded no matter how
@@ -448,11 +474,27 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
     cur: _ColumnarSST | None = None
     lo = 0
     start = 0
+    filled = start_filled      # rows of `order` received so far
+    exhausted = start_exhausted
     try:
         cur = _ColumnarSST(env, dbname, new_file_number(), icmp, options,
                            creation_time, column_family)
-        while start < n_total:
-            limit = n_total
+        need_fetch = False
+        while True:
+            if start >= filled or need_fetch:
+                need_fetch = False
+                if not exhausted:
+                    nxt = next(chunks, None)
+                    if nxt is None:
+                        exhausted = True
+                    else:
+                        nxt = np.ascontiguousarray(nxt, dtype=np.int32)
+                        order[filled:filled + len(nxt)] = nxt
+                        filled += len(nxt)
+                    continue
+                if start >= filled:
+                    break
+            limit = filled
             if (can_cut and cur.num_entries
                     and cur.w.file_size() >= max_output_file_size):
                 if not same_user_key(start, start - 1):
@@ -469,7 +511,7 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                     # in this file; bound the block at the end of the run so
                     # the cut re-check happens there.
                     j = start
-                    while j < n_total and same_user_key(j, j - 1):
+                    while j < filled and same_user_key(j, j - 1):
                         j += 1
                     limit = j
             if use_section:
@@ -497,14 +539,28 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                 if rc <= 0:
                     raise Corruption(f"native section build failed rc={rc}")
                 nb = int(rc)
-                section = sec_buf[: int(sec_len[0])].tobytes()
+                sec_total = int(sec_len[0])
+                pos = start + sum(int(sec_counts[b]) for b in range(nb))
+                if not exhausted and pos == filled:
+                    # The final block ended at the chunk boundary — it may
+                    # have been starved, not full. Withhold it until more
+                    # data arrives so block layout matches the
+                    # whole-array build byte-for-byte.
+                    last_cnt = int(sec_counts[nb - 1])
+                    nb -= 1
+                    pos -= last_cnt
+                    sec_total -= int(sec_plens[nb]) + fmt.BLOCK_TRAILER_SIZE
+                    need_fetch = True
+                    if nb == 0:
+                        continue
+                section = sec_buf[:sec_total].tobytes()
                 blocks = []
-                pos = start
+                bpos = start
                 for b in range(nb):
                     cnt = int(sec_counts[b])
-                    blocks.append((int(sec_plens[b]), entry_key(pos),
-                                   entry_key(pos + cnt - 1), cnt))
-                    pos += cnt
+                    blocks.append((int(sec_plens[b]), entry_key(bpos),
+                                   entry_key(bpos + cnt - 1), cnt))
+                    bpos += cnt
                 cur.add_framed_section(section, blocks)
                 start = pos
                 continue
@@ -527,11 +583,16 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                 )
             if rc <= 0:
                 raise Corruption(f"native block build failed rc={rc}")
+            if not exhausted and start + int(rc) == filled:
+                # Possibly starved at the chunk boundary: rebuild this block
+                # once more data arrives (see the section path above).
+                need_fetch = True
+                continue
             raw = out_buf[: int(out_len[0])].tobytes()
             cur.add_block(raw, entry_key(start),
                           entry_key(start + int(rc) - 1), int(rc))
             start += int(rc)
-        sel = order[lo:n_total]
+        sel = order[lo:filled]
         results.append((cur.fnum, cur.path) + cur.finish(
             lib, kv, sel, vtypes, seqs, tombstones
         ) + (sel,))

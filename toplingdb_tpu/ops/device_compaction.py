@@ -458,8 +458,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 # Per-file per-shard chunks already streaming to the device
                 # since scan time. Dispatch every shard's program up front
                 # (the device pipelines them: shard s+1 computes while
-                # shard s downloads), overlap the host trailer decode, then
-                # stitch shard-local survivor orders back to global rows.
+                # shard s downloads), then STREAM each shard's survivors
+                # straight into the SST writer — block building overlaps
+                # the remaining shards' compute + download.
                 if shard_mode == "uniform":
                     pendings = [
                         ck.fused_uniform_start(
@@ -476,20 +477,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                     ]
                 col = _kv_seq_vtype(kv)
                 has_complex = False
-                parts_o, parts_z = [], []
-                for (h, ranges), pending in zip(shards, pendings):
-                    o, z, hc = ck.fused_chunks_finish(pending)
-                    has_complex |= hc
-                    lmap = np.concatenate([
-                        np.arange(lo, hi, dtype=np.int32)
-                        for lo, hi in ranges
-                    ]) if ranges else np.empty(0, np.int32)
-                    parts_o.append(lmap[o])
-                    parts_z.append(z)
-                order = (np.concatenate(parts_o) if parts_o
-                         else np.empty(0, np.int32))
-                zero_flags = (np.concatenate(parts_z) if parts_z
-                              else np.empty(0, bool))
+                order = None  # streamed; see _shard_order_chunks below
             else:
                 order, zero_flags, has_complex = ck.fused_encode_sort_gc(
                     kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
@@ -500,7 +488,10 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             raise _FallbackToEntries()  # non-dense buffers etc.
         if has_complex:
             raise _FallbackToEntries()
-        zero_orig = order[zero_flags]
+        if order is None:
+            zero_orig = None  # shard streaming: trailers set per chunk
+        else:
+            zero_orig = order[zero_flags]
         if col is None:
             col = _kv_seq_vtype(kv)
     elif _host_sort():
@@ -545,20 +536,42 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         zero_orig = perm[zero_seq]
 
     trailer_override = np.full(kv.n, -1, dtype=np.int64)
-    # packed trailer for seq 0 is just the type byte.
-    trailer_override[zero_orig] = col.vtype[zero_orig].astype(np.int64)
     seqs = col.seq.copy()
-    seqs[zero_orig] = 0
+    if zero_orig is not None:
+        # packed trailer for seq 0 is just the type byte.
+        trailer_override[zero_orig] = col.vtype[zero_orig].astype(np.int64)
+        seqs[zero_orig] = 0
+        order_feed = order
+    else:
+        # Shard streaming: each chunk's trailers/seqs land just before the
+        # writer consumes it (the writer reads both arrays per native call).
+        def _shard_order_chunks():
+            for (_h, ranges), pending in zip(shards, pendings):
+                o, z, hc = ck.fused_chunks_finish(pending)
+                if hc:
+                    raise _FallbackToEntries()
+                lmap = np.concatenate([
+                    np.arange(lo, hi, dtype=np.int32)
+                    for lo, hi in ranges
+                ]) if ranges else np.empty(0, np.int32)
+                order_s = lmap[o]
+                zero_s = order_s[z]
+                trailer_override[zero_s] = \
+                    col.vtype[zero_s].astype(np.int64)
+                seqs[zero_s] = 0
+                yield order_s
+
+        order_feed = _shard_order_chunks()
 
     tombs = surviving_tombstone_fragments(
         rd, snapshots, compaction.bottommost, icmp.user_comparator
     )
     outputs = []
-    if len(order) or tombs:
+    if order is None or len(order) or tombs:
         try:
             files = write_tables_columnar(
                 env, dbname, new_file_number, icmp, table_options, kv,
-                order, trailer_override, col.vtype, seqs, tombs,
+                order_feed, trailer_override, col.vtype, seqs, tombs,
                 creation_time if creation_time is not None else int(time.time()),
                 max_output_file_size=compaction.max_output_file_size,
                 column_family=column_family,
