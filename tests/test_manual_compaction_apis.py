@@ -1,0 +1,152 @@
+"""CompactFiles / SuggestCompactRange / PromoteL0 (reference db.h manual
+compaction APIs), RemapEnv (env/fs_remap.cc role), and the benchmark
+regression tooling (tools/benchmark.sh + benchmark_compare.sh role)."""
+
+import json
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.utils.status import Busy, InvalidArgument
+
+
+def _db_with_l0_files(tmp_path, n_files=3, overlap=True):
+    db = DB.open(str(tmp_path / "db"), Options(
+        level0_file_num_compaction_trigger=100,  # no auto compaction
+    ))
+    for i in range(n_files):
+        lo = 0 if overlap else i * 100
+        for j in range(lo, lo + 100):
+            db.put(b"key%06d" % j, b"f%d-%d" % (i, j))
+        db.flush()
+    return db
+
+
+def test_compact_files(tmp_path):
+    db = _db_with_l0_files(tmp_path)
+    version = db.versions.cf_current(0)
+    nums = [f.number for f in version.files[0]]
+    assert len(nums) == 3
+    db.compact_files(nums, output_level=2)
+    version = db.versions.cf_current(0)
+    assert not version.files[0]
+    assert version.files[2]
+    for j in range(100):
+        assert db.get(b"key%06d" % j) == b"f2-%d" % j  # newest file wins
+    with pytest.raises(InvalidArgument):
+        db.compact_files([999999], output_level=2)  # not live
+    db.close()
+
+
+def test_compact_files_level_validation(tmp_path):
+    db = _db_with_l0_files(tmp_path)
+    version = db.versions.cf_current(0)
+    nums = [f.number for f in version.files[0]]
+    db.compact_files(nums[:1], output_level=1)
+    version = db.versions.cf_current(0)
+    l0 = [f.number for f in version.files[0]]
+    l1 = [f.number for f in version.files[1]]
+    assert len(l0) == 2 and len(l1) == 1
+    # L0 + L1 inputs into L1: allowed (source level + output level)
+    db.compact_files(l0 + l1, output_level=1)
+    version = db.versions.cf_current(0)
+    assert not version.files[0] and version.files[1]
+    # compacting upward is rejected
+    with pytest.raises(InvalidArgument):
+        db.compact_files([version.files[1][0].number], output_level=0)
+    db.close()
+
+
+def test_suggest_compact_range(tmp_path):
+    db = _db_with_l0_files(tmp_path, overlap=False)
+    marked = db.suggest_compact_range(b"key000150", b"key000250")
+    version = db.versions.cf_current(0)
+    flagged = [f for _, f in version.all_files() if f.marked_for_compaction]
+    assert marked == len(flagged) and 1 <= marked <= 2
+    # idempotent
+    assert db.suggest_compact_range(b"key000150", b"key000250") == 0
+    db.close()
+
+
+def test_promote_l0(tmp_path):
+    db = _db_with_l0_files(tmp_path, overlap=False)  # disjoint L0 files
+    db.promote_l0(target_level=2)
+    version = db.versions.cf_current(0)
+    assert not version.files[0] and len(version.files[2]) == 3
+    for j in range(250, 260):
+        assert db.get(b"key%06d" % j) == b"f2-%d" % j
+    db.close()
+    # survives reopen (metadata-only move went through the MANIFEST)
+    db = DB.open(str(tmp_path / "db"), Options())
+    assert db.get(b"key000000") == b"f0-0"
+    db.close()
+
+
+def test_promote_l0_rejects_overlap(tmp_path):
+    db = _db_with_l0_files(tmp_path, overlap=True)
+    with pytest.raises(InvalidArgument):
+        db.promote_l0()
+    db.close()
+
+
+def test_remap_env(tmp_path):
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.env.remap import RemapEnv
+
+    real = str(tmp_path / "real")
+    env = RemapEnv(default_env(), {"/virtual/db": real,
+                                   "/virtual/db/sub": str(tmp_path / "sub")})
+    env.create_dir("/virtual/db")
+    env.write_file("/virtual/db/x.txt", b"hello", sync=True)
+    assert (tmp_path / "real" / "x.txt").read_bytes() == b"hello"
+    assert env.read_file("/virtual/db/x.txt") == b"hello"
+    assert env.file_exists("/virtual/db/x.txt")
+    assert env.get_file_size("/virtual/db/x.txt") == 5
+    # longest prefix wins
+    env.create_dir("/virtual/db/sub")
+    env.write_file("/virtual/db/sub/y.txt", b"yy")
+    assert (tmp_path / "sub" / "y.txt").read_bytes() == b"yy"
+    # unmapped paths pass through
+    p = str(tmp_path / "plain.txt")
+    env.write_file(p, b"p")
+    assert env.read_file(p) == b"p"
+    env.rename_file("/virtual/db/x.txt", "/virtual/db/z.txt")
+    assert env.get_children("/virtual/db") == ["z.txt"]
+    # a whole DB works through the remap
+    db = DB.open("/virtual/db2", Options(),
+                 env=RemapEnv(default_env(), {"/virtual/db2":
+                                              str(tmp_path / "db2")}))
+    db.put(b"k", b"v")
+    db.flush()
+    db.close()
+    assert (tmp_path / "db2").is_dir()
+    db = DB.open("/virtual/db2", Options(),
+                 env=RemapEnv(default_env(), {"/virtual/db2":
+                                              str(tmp_path / "db2")}))
+    assert db.get(b"k") == b"v"
+    db.close()
+
+
+def test_benchmark_suite_and_compare(tmp_path, capsys):
+    from toplingdb_tpu.tools.benchmark import main as bench_main
+    from toplingdb_tpu.tools.benchmark_compare import main as cmp_main
+
+    out1 = str(tmp_path / "base.json")
+    out2 = str(tmp_path / "new.json")
+    for out in (out1, out2):
+        rc = bench_main([
+            "--suite", "quick", "--num", "2000",
+            "--db", str(tmp_path / "benchdb"), "--out", out,
+        ])
+        assert rc == 0
+        doc = json.loads(open(out).read())
+        assert {r["name"] for r in doc["results"]} == {"fillseq", "readrandom"}
+        assert all(r["ops_per_sec"] > 0 for r in doc["results"])
+    assert cmp_main([out1, out2, "--threshold", "0.01"]) == 0
+    # forge a regression
+    doc = json.loads(open(out2).read())
+    doc["results"][0]["ops_per_sec"] = 1.0
+    open(out2, "w").write(json.dumps(doc))
+    assert cmp_main([out1, out2, "--threshold", "0.85"]) == 1
+    capsys.readouterr()

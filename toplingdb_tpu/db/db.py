@@ -30,7 +30,9 @@ from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.env import Env, default_env
 from toplingdb_tpu.options import FlushOptions, Options, ReadOptions, WriteOptions
 from toplingdb_tpu.table.merging_iterator import MergingIterator
-from toplingdb_tpu.utils.status import Corruption, InvalidArgument, IOError_, NotFound
+from toplingdb_tpu.utils.status import (
+    Busy, Corruption, InvalidArgument, IOError_, NotFound,
+)
 
 _DEFAULT_READ = ReadOptions()
 _DEFAULT_WRITE = WriteOptions()
@@ -1223,6 +1225,153 @@ class DB:
         self.flush()
         if self._compaction_scheduler is not None:
             self._compaction_scheduler.compact_range(begin, end)
+
+    def compact_files(self, file_numbers: list[int], output_level: int,
+                      cf=None) -> None:
+        """Compact a caller-chosen set of files into output_level (reference
+        DB::CompactFiles, db.h): files must live at one source level and/or
+        at output_level itself."""
+        cfd = self._cf_data(cf)
+        from toplingdb_tpu.compaction.picker import Compaction
+
+        if not 0 <= output_level < self.options.num_levels:
+            raise InvalidArgument(
+                f"output_level {output_level} out of range "
+                f"[0, {self.options.num_levels})"
+            )
+        want = set(file_numbers)
+        with self._mutex:
+            version = self.versions.cf_current(cfd.handle.id)
+            by_level: dict[int, list] = {}
+            for lvl, f in version.all_files():
+                if f.number in want:
+                    by_level.setdefault(lvl, []).append(f)
+                    want.discard(f.number)
+            if want:
+                raise InvalidArgument(f"files not live: {sorted(want)}")
+            src_levels = [lvl for lvl in by_level if lvl != output_level]
+            if len(src_levels) > 1:
+                raise InvalidArgument(
+                    f"input files span levels {sorted(by_level)}; at most "
+                    f"one source level plus output_level {output_level}"
+                )
+            src = src_levels[0] if src_levels else output_level
+            if src > output_level:
+                raise InvalidArgument(
+                    f"source level {src} is below output level {output_level}"
+                )
+            inputs = by_level.get(src, [])
+            out_inputs = (
+                by_level.get(output_level, []) if src != output_level else []
+            )
+            if any(f.being_compacted for f in inputs + out_inputs):
+                raise Busy("some input files are already being compacted")
+            # Sorted-level + read-path safety (reference CompactFiles
+            # sanitization): nothing overlapping the compaction's key range
+            # may be left behind at the source level, between the levels, or
+            # unlisted at the output level — otherwise newer data moves
+            # BELOW older data (stale reads) or a level loses its
+            # non-overlapping invariant.
+            all_in = inputs + out_inputs
+            if all_in:
+                su = dbformat.extract_user_key(
+                    min((f.smallest for f in all_in), key=self.icmp.sort_key))
+                lu = dbformat.extract_user_key(
+                    max((f.largest for f in all_in), key=self.icmp.sort_key))
+                listed = {f.number for f in all_in}
+                for lvl in range(src, output_level + 1):
+                    for f in version.overlapping_files(lvl, su, lu):
+                        if f.number not in listed:
+                            raise InvalidArgument(
+                                f"file #{f.number} at L{lvl} overlaps the "
+                                f"compaction range but is not listed; "
+                                f"include it (or its level) in file_numbers"
+                            )
+            c = Compaction(
+                level=src, output_level=output_level, inputs=inputs,
+                output_level_inputs=out_inputs,
+                bottommost=self._compaction_scheduler.picker._is_bottommost(
+                    version, output_level,
+                    min((f.smallest for f in inputs + out_inputs),
+                        key=self.icmp.sort_key),
+                    max((f.largest for f in inputs + out_inputs),
+                        key=self.icmp.sort_key),
+                ) if inputs + out_inputs else False,
+                reason="compact_files",
+                max_output_file_size=self.options.target_file_size(output_level),
+                cf_id=cfd.handle.id,
+                full_history_ts_low=self.options.full_history_ts_low,
+            )
+            for _, f in c.all_inputs():
+                f.being_compacted = True
+        try:
+            self._compaction_scheduler._run_compaction(c)
+        finally:
+            with self._mutex:
+                for _, f in c.all_inputs():
+                    f.being_compacted = False
+
+    def suggest_compact_range(self, begin: bytes | None = None,
+                              end: bytes | None = None, cf=None) -> int:
+        """Mark files overlapping [begin, end) for compaction (reference
+        DB::SuggestCompactRange): the picker prioritizes marked files on its
+        next pass. Returns the number of files marked."""
+        cfd = self._cf_data(cf)
+        ucmp = self.icmp.user_comparator
+        marked = 0
+        with self._mutex:
+            version = self.versions.cf_current(cfd.handle.id)
+            for _lvl, f in version.all_files():
+                fs = dbformat.extract_user_key(f.smallest)
+                fl = dbformat.extract_user_key(f.largest)
+                if begin is not None and ucmp.compare(fl, begin) < 0:
+                    continue
+                if end is not None and ucmp.compare(fs, end) >= 0:
+                    continue
+                if not f.marked_for_compaction:
+                    f.marked_for_compaction = True
+                    marked += 1
+        if marked:
+            self._maybe_schedule_compaction()
+        return marked
+
+    def promote_l0(self, target_level: int = 1, cf=None) -> None:
+        """Metadata-only move of ALL L0 files to target_level (reference
+        DB::PromoteL0): requires pairwise non-overlapping L0 files and
+        empty levels 1..target_level."""
+        if not 1 <= target_level < self.options.num_levels:
+            raise InvalidArgument(
+                f"target_level {target_level} out of range "
+                f"[1, {self.options.num_levels})"
+            )
+        cfd = self._cf_data(cf)
+        ucmp = self.icmp.user_comparator
+        with self._mutex:
+            version = self.versions.cf_current(cfd.handle.id)
+            l0 = list(version.files[0])
+            if not l0:
+                return
+            for lvl in range(1, target_level + 1):
+                if version.files[lvl]:
+                    raise InvalidArgument(
+                        f"level {lvl} is not empty; cannot promote L0 over it"
+                    )
+            ordered = sorted(
+                l0, key=lambda f: self.icmp.sort_key(f.smallest)
+            )
+            for a, b in zip(ordered, ordered[1:]):
+                if ucmp.compare(dbformat.extract_user_key(a.largest),
+                                dbformat.extract_user_key(b.smallest)) >= 0:
+                    raise InvalidArgument(
+                        "L0 files overlap; compact instead of promoting"
+                    )
+            if any(f.being_compacted for f in l0):
+                raise Busy("L0 files are being compacted")
+            edit = VersionEdit(column_family=cfd.handle.id)
+            for f in l0:
+                edit.delete_file(0, f.number)
+                edit.add_file(target_level, f)
+            self.versions.log_and_apply(edit)
 
     def wait_for_compactions(self) -> None:
         if self._compaction_scheduler is not None:

@@ -1,0 +1,86 @@
+"""Benchmark suite driver with machine-readable results.
+
+The analogue of the reference's tools/benchmark.sh + benchmark_ci.py
+(/root/reference): runs a named workload SUITE through db_bench and writes
+one JSON results file per run, which tools/benchmark_compare.py diffs
+against a baseline run (the benchmark_compare.sh / regression_test.sh
+role).
+
+Usage:
+  python -m toplingdb_tpu.tools.benchmark --suite standard \
+      --out results.json [--num 100000] [--db /tmp/bench]
+  python -m toplingdb_tpu.tools.benchmark_compare base.json new.json \
+      [--threshold 0.85]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import shutil
+import time
+
+SUITES = {
+    # the reference benchmark.sh's canonical progression
+    "standard": ("fillseq,readseq,fillrandom,readrandom,overwrite,"
+                 "readreverse,seekrandom,deleterandom"),
+    "write": "fillseq,fillrandom,fillbatch,overwrite,fillsync",
+    "read": "fillrandom,readrandom,readseq,readreverse,multireadrandom,"
+            "seekrandom,readmissing",
+    "mixed": "fillrandom,readwhilewriting,readrandomwriterandom,"
+             "updaterandom",
+    "compact": "fillrandom,compact,readrandom",
+    "quick": "fillseq,readrandom",
+}
+
+
+def run_suite(suite: str, num: int, db: str, value_size: int = 100) -> dict:
+    """Run the suite in-process via db_bench's Bench and return the
+    structured results document."""
+    from toplingdb_tpu.tools import db_bench as dbb
+
+    benchmarks = SUITES.get(suite, suite)  # unknown name = literal list
+    parser = dbb.build_parser()
+    ns = parser.parse_args([
+        f"--benchmarks={benchmarks}", f"--num={num}", f"--db={db}",
+        f"--value-size={value_size}",
+    ])
+    b = dbb.Bench(ns)
+    b.run()
+    return {
+        "meta": {
+            "suite": suite, "num": num, "value_size": value_size,
+            "timestamp": int(time.time()),
+            "platform": platform.platform(),
+        },
+        "results": b.results,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", default="standard",
+                    help=f"one of {', '.join(SUITES)} or a literal "
+                         f"comma-separated workload list")
+    ap.add_argument("--num", type=int, default=100000)
+    ap.add_argument("--db", default="/tmp/tpulsm_benchmark")
+    ap.add_argument("--value-size", type=int, default=100)
+    ap.add_argument("--out", default=None, help="results JSON path")
+    ap.add_argument("--keep-db", action="store_true")
+    args = ap.parse_args(argv)
+    doc = run_suite(args.suite, args.num, args.db, args.value_size)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {args.out} ({len(doc['results'])} workloads)")
+    if not args.keep_db and os.path.exists(args.db):
+        shutil.rmtree(args.db, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Compare two benchmark result files; fail on regression.
+
+The analogue of the reference's tools/benchmark_compare.sh +
+regression_test.sh (/root/reference): given a BASELINE results JSON and a
+NEW one (both from tools/benchmark.py), print a per-workload ratio table
+and exit nonzero when any workload's throughput fell below
+threshold * baseline — the CI regression gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def compare(base: dict, new: dict, threshold: float) -> tuple[list, bool]:
+    base_by = {r["name"]: r for r in base["results"]}
+    new_names = {r["name"] for r in new["results"]}
+    rows = []
+    regressed = False
+    for r in new["results"]:
+        b = base_by.get(r["name"])
+        if b is None or not b["ops_per_sec"]:
+            rows.append((r["name"], None, r["ops_per_sec"], None, ""))
+            continue
+        ratio = r["ops_per_sec"] / b["ops_per_sec"]
+        flag = ""
+        if ratio < threshold:
+            flag = "REGRESSION"
+            regressed = True
+        elif ratio > 1 / threshold:
+            flag = "improved"
+        rows.append((r["name"], b["ops_per_sec"], r["ops_per_sec"],
+                     ratio, flag))
+    # A workload that vanished from the new run (crash, rename, empty suite)
+    # is the failure the gate exists to catch, not a pass.
+    for name, b in base_by.items():
+        if name not in new_names:
+            rows.append((name, b["ops_per_sec"], 0.0, 0.0, "MISSING"))
+            regressed = True
+    return rows, regressed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=0.85,
+                    help="fail when new < threshold * baseline ops/sec")
+    args = ap.parse_args(argv)
+    with open(args.baseline) as f:
+        base = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    rows, regressed = compare(base, new, args.threshold)
+    print(f"{'workload':<24} {'baseline':>12} {'new':>12} {'ratio':>7}")
+    for name, b, n, ratio, flag in rows:
+        bs = f"{b:12.0f}" if b is not None else f"{'(new)':>12}"
+        rs = f"{ratio:7.2f}" if ratio is not None else f"{'-':>7}"
+        print(f"{name:<24} {bs} {n:12.0f} {rs} {flag}")
+    if regressed:
+        print(f"FAILED: regression below {args.threshold:.0%} of baseline")
+        return 1
+    print("OK: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

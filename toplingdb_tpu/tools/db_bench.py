@@ -71,6 +71,7 @@ class Bench:
         self.db = DB.open(self.args.db, self.options)
 
     def run(self) -> None:
+        self.results = []  # structured rows for tools/benchmark.py
         for name in self.args.benchmarks.split(","):
             name = name.strip()
             fn = getattr(self, "bench_" + name, None)
@@ -85,6 +86,11 @@ class Bench:
             ops = fn(n)
             dt = time.time() - t0
             ops = ops or n
+            self.results.append({
+                "name": name, "ops": ops, "seconds": round(dt, 4),
+                "ops_per_sec": round(ops / dt, 1),
+                "micros_per_op": round(dt * 1e6 / ops, 3),
+            })
             print(
                 f"{name:<20} : {dt * 1e6 / ops:10.3f} micros/op "
                 f"{ops / dt:12.0f} ops/sec; {dt:8.2f} s"
@@ -404,7 +410,7 @@ class Bench:
         return 1
 
 
-def main(argv=None):
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
     ap.add_argument("--benchmarks", default="fillseq,readrandom")
     ap.add_argument("--num", type=int, default=100000)
@@ -417,7 +423,11 @@ def main(argv=None):
     ap.add_argument("--use-existing-db", action="store_true")
     ap.add_argument("--statistics", action="store_true")
     ap.add_argument("--print-stats", action="store_true")
-    args = ap.parse_args(argv)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
     Bench(args).run()
     return 0
 
