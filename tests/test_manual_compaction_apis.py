@@ -40,18 +40,26 @@ def test_compact_files(tmp_path):
 
 
 def test_compact_files_level_validation(tmp_path):
+    """Reference SanitizeCompactionInputFilesForAllLevels
+    (compaction_picker.cc:908) EXPANDS a partial input set: at L0 every
+    file older than the newest listed file comes along; overlapping
+    output-level files are pulled in automatically."""
     db = _db_with_l0_files(tmp_path)
     version = db.versions.cf_current(0)
-    nums = [f.number for f in version.files[0]]
-    db.compact_files(nums[:1], output_level=1)
+    nums = [f.number for f in version.files[0]]  # newest-first
+    # The OLDEST L0 file alone: nothing older to pull in — moves by itself,
+    # newer overlapping runs legally stay above it.
+    db.compact_files(nums[-1:], output_level=1)
     version = db.versions.cf_current(0)
-    l0 = [f.number for f in version.files[0]]
-    l1 = [f.number for f in version.files[1]]
-    assert len(l0) == 2 and len(l1) == 1
-    # L0 + L1 inputs into L1: allowed (source level + output level)
-    db.compact_files(l0 + l1, output_level=1)
+    assert len(version.files[0]) == 2 and len(version.files[1]) == 1
+    # The NEWEST remaining L0 file: the older overlapping L0 file AND the
+    # overlapping L1 file are auto-included (else reads would find stale
+    # data above the moved output).
+    db.compact_files([version.files[0][0].number], output_level=1)
     version = db.versions.cf_current(0)
     assert not version.files[0] and version.files[1]
+    for j in range(100):
+        assert db.get(b"key%06d" % j) == b"f2-%d" % j  # newest still wins
     # compacting upward is rejected
     with pytest.raises(InvalidArgument):
         db.compact_files([version.files[1][0].number], output_level=0)

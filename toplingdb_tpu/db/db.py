@@ -1264,29 +1264,78 @@ class DB:
             out_inputs = (
                 by_level.get(output_level, []) if src != output_level else []
             )
-            if any(f.being_compacted for f in inputs + out_inputs):
-                raise Busy("some input files are already being compacted")
-            # Sorted-level + read-path safety (reference CompactFiles
-            # sanitization): nothing overlapping the compaction's key range
-            # may be left behind at the source level, between the levels, or
-            # unlisted at the output level — otherwise newer data moves
-            # BELOW older data (stale reads) or a level loses its
-            # non-overlapping invariant.
+            # Reference CompactFiles sanitization EXPANDS the caller's set
+            # rather than rejecting it
+            # (compaction_picker.cc:908 SanitizeCompactionInputFilesForAllLevels):
+            # at L0 every file OLDER than the newest listed file comes along
+            # (newer unlisted runs stay on top, so reads never see stale data
+            # below newer data); at sorted levels the listed run is widened
+            # across same-user-key boundaries; at the output level all
+            # overlapping files are included to keep it non-overlapping.
+            listed = {f.number for f in inputs + out_inputs}
+            ucmp = self.icmp.user_comparator
+
+            def _widen(lvl_files, lo, hi):
+                # Same-user-key boundary widening (reference while-loops at
+                # compaction_picker.cc:959-975): a neighbor sharing a
+                # boundary user key must come along, else seqno zeroing can
+                # reorder that key across the excluded file.
+                while lo > 0 and ucmp.compare(
+                        dbformat.extract_user_key(lvl_files[lo - 1].largest),
+                        dbformat.extract_user_key(
+                            lvl_files[lo].smallest)) >= 0:
+                    lo -= 1
+                while hi + 1 < len(lvl_files) and ucmp.compare(
+                        dbformat.extract_user_key(lvl_files[hi + 1].smallest),
+                        dbformat.extract_user_key(
+                            lvl_files[hi].largest)) <= 0:
+                    hi += 1
+                return lo, hi
+
+            if inputs and src == 0:
+                # L0 is time-ordered, not key-ordered: every file OLDER than
+                # the newest listed file comes along (for intra-L0 jobs too —
+                # a non-contiguous subset compacted past an unlisted middle
+                # file would re-sort newer data below it).
+                l0 = version.files[0]  # newest-first
+                first = min(i for i, f in enumerate(l0)
+                            if f.number in listed)
+                inputs = list(l0[first:])
+            elif inputs and src >= 1:
+                lvl_files = version.files[src]  # sorted by smallest key
+                idxs = [i for i, f in enumerate(lvl_files)
+                        if f.number in listed]
+                lo, hi = _widen(lvl_files, min(idxs), max(idxs))
+                inputs = list(lvl_files[lo:hi + 1])
             all_in = inputs + out_inputs
             if all_in:
                 su = dbformat.extract_user_key(
                     min((f.smallest for f in all_in), key=self.icmp.sort_key))
                 lu = dbformat.extract_user_key(
                     max((f.largest for f in all_in), key=self.icmp.sort_key))
-                listed = {f.number for f in all_in}
-                for lvl in range(src, output_level + 1):
+                if src != output_level and output_level > 0:
+                    out_files = version.files[output_level]
+                    ov = {f.number for f in version.overlapping_files(
+                        output_level, su, lu)}
+                    oidxs = [i for i, f in enumerate(out_files)
+                             if f.number in ov]
+                    if oidxs:
+                        lo, hi = _widen(out_files, min(oidxs), max(oidxs))
+                        out_inputs = list(out_files[lo:hi + 1])
+                    else:
+                        out_inputs = []
+                # Intermediate levels can't be represented by a two-level
+                # Compaction: anything overlapping there keeps its newer
+                # data ABOVE the moved output, which is unsafe — reject.
+                for lvl in range(src + 1, output_level):
                     for f in version.overlapping_files(lvl, su, lu):
-                        if f.number not in listed:
-                            raise InvalidArgument(
-                                f"file #{f.number} at L{lvl} overlaps the "
-                                f"compaction range but is not listed; "
-                                f"include it (or its level) in file_numbers"
-                            )
+                        raise InvalidArgument(
+                            f"file #{f.number} at intermediate L{lvl} "
+                            f"overlaps the compaction range; compact it "
+                            f"first or choose output_level {lvl}"
+                        )
+            if any(f.being_compacted for f in inputs + out_inputs):
+                raise Busy("some input files are already being compacted")
             c = Compaction(
                 level=src, output_level=output_level, inputs=inputs,
                 output_level_inputs=out_inputs,
