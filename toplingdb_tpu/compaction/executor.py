@@ -82,7 +82,9 @@ class DeviceCompactionExecutor(CompactionExecutor):
 
         return run_device_compaction(
             db.env, db.dbname, db.icmp, compaction, db.table_cache,
-            db.options.table_options, snapshots,
+            db.options.table_options_for_level(
+                compaction.output_level, compaction.bottommost),
+            snapshots,
             merge_operator=db.options.merge_operator,
             compaction_filter=db.options.compaction_filter,
             new_file_number=new_file_number,
@@ -274,7 +276,8 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             compaction_filter=(
                 opts.compaction_filter.name() if opts.compaction_filter else None
             ),
-            compression=opts.table_options.compression,
+            compression=opts.compression_for_level(
+                compaction.output_level, compaction.bottommost),
             block_size=opts.table_options.block_size,
             creation_time=int(time.time()),
             device=self.device,

@@ -333,7 +333,8 @@ class CompactionScheduler:
         db = self.db
         return run_compaction_to_tables(
             db.env, db.dbname, db.icmp, c, db.table_cache,
-            db.options.table_options, snapshots,
+            db.options.table_options_for_level(c.output_level, c.bottommost),
+            snapshots,
             merge_operator=db.options.merge_operator,
             compaction_filter=db.options.compaction_filter,
             new_file_number=alloc,

@@ -727,7 +727,8 @@ class DB:
         try:
             meta = flush_memtable_to_table(
                 self.env, self.dbname, fnum, self.icmp, mems,
-                self.options.table_options, creation_time=int(time.time()),
+                self.options.table_options_for_level(0),
+                creation_time=int(time.time()),
                 blob_file_number=blob_num,
                 min_blob_size=self.options.min_blob_size,
                 column_family=(cf_id, self.cf_name(cf_id)),

@@ -22,9 +22,11 @@ from toplingdb_tpu.db import dbformat
 from toplingdb_tpu.table import format as fmt
 from toplingdb_tpu.table.block import BlockBuilder, BlockIter
 from toplingdb_tpu.table.builder import (
+    METAINDEX_COMPRESSION_DICT,
     METAINDEX_FILTER,
     METAINDEX_PROPERTIES,
     METAINDEX_RANGE_DEL,
+    CompressionOptions,
 )
 from toplingdb_tpu.table.properties import TableProperties
 from toplingdb_tpu.utils.status import Corruption, NotSupported
@@ -208,7 +210,7 @@ class _ColumnarSST:
     props, meta blocks, footer) — the TableBuilder-equivalent file shell."""
 
     def __init__(self, env, dbname, fnum, icmp, options, creation_time,
-                 column_family=(0, "default")):
+                 column_family=(0, "default"), pool=None):
         from toplingdb_tpu.db import filename as _fn
 
         self.fnum = fnum
@@ -216,6 +218,21 @@ class _ColumnarSST:
         self.w = env.new_writable_file(self.path)
         self._icmp = icmp
         self._options = options
+        # Compressed output: blocks compress on `pool` threads (the codecs
+        # release the GIL) and write in order; ZSTD dictionary training
+        # buffers the first train_budget() of raw blocks, as in
+        # TableBuilder (reference parallel compression + dict,
+        # block_based_table_builder.cc:818-825, util/compression.h:1435).
+        self._pool = pool
+        self._copts = getattr(options, "compression_opts", None) \
+            or CompressionOptions()
+        self._dict: bytes | None = (
+            b"" if (options.compression == fmt.ZSTD_COMPRESSION
+                    and self._copts.max_dict_bytes > 0) else None
+        )
+        self._dict_samples: list = []
+        self._dict_bytes = 0
+        self._pending: list = []  # (future|tuple, raw_len, first, last, n)
         self.index_block = BlockBuilder(options.index_restart_interval)
         self.props = TableProperties(
             comparator_name=icmp.user_comparator.name(),
@@ -252,11 +269,53 @@ class _ColumnarSST:
         self.last_key = block_last
         self.num_entries += n_entries
 
+    def pending_bytes(self) -> int:
+        """Raw bytes buffered for dict training / in the compress queue —
+        counted into the output-cut size check so it can't lag."""
+        return self._dict_bytes + sum(p[1] for p in self._pending)
+
     def add_block(self, raw: bytes, block_first: bytes, block_last: bytes,
                   n_entries: int) -> None:
-        handle = fmt.write_block(self.w, raw, self._options.compression)
+        if self._dict == b"":
+            self._dict_samples.append((raw, block_first, block_last,
+                                       n_entries))
+            self._dict_bytes += len(raw)
+            if self._dict_bytes >= self._copts.train_budget():
+                self._train_dict_and_flush()
+            return
+        if self._pool is not None \
+                and self._options.compression != fmt.NO_COMPRESSION:
+            fut = self._pool.submit(
+                fmt.compress_for_block, raw, self._options.compression,
+                self._copts.level, self._dict or b"",
+            )
+            self._pending.append((fut, len(raw), block_first, block_last,
+                                  n_entries))
+            self._drain(wait=False)
+            return
+        handle = fmt.write_block(self.w, raw, self._options.compression,
+                                 self._copts.level, self._dict or b"")
         self._account_block(handle, len(raw), block_first, block_last,
                             n_entries)
+
+    def _train_dict_and_flush(self) -> None:
+        from toplingdb_tpu.utils import codecs
+
+        self._dict = codecs.zstd_train_dictionary(
+            [r for r, _f, _l, _n in self._dict_samples],
+            self._copts.max_dict_bytes,
+        )
+        samples, self._dict_samples, self._dict_bytes = \
+            self._dict_samples, [], 0
+        for raw, first, last, n in samples:
+            self.add_block(raw, first, last, n)
+
+    def _drain(self, wait: bool) -> None:
+        while self._pending and (wait or self._pending[0][0].done()):
+            fut, raw_len, first, last, n = self._pending.pop(0)
+            payload, out_type = fut.result()
+            h = fmt.write_compressed_block(self.w, payload, out_type)
+            self._account_block(h, raw_len, first, last, n)
 
     def add_framed_section(self, section: bytes, blocks) -> None:
         """Bulk form of add_block: `section` is a pre-framed run of
@@ -275,6 +334,9 @@ class _ColumnarSST:
     def finish(self, lib, kv, sel, vtypes, seqs, tombstones):
         """Write meta blocks + footer; `sel` = the original-index selection
         of this file's entries (stats/bloom are vectorized over it)."""
+        if self._dict == b"":
+            self._train_dict_and_flush()  # small file: train from the lot
+        self._drain(wait=True)
         icmp = self._icmp
         options = self._options
         props = self.props
@@ -340,6 +402,10 @@ class _ColumnarSST:
                 props.largest_seqno = max(props.largest_seqno, frag.seq)
             rh = fmt.write_block(self.w, rdb.finish(), fmt.NO_COMPRESSION)
             meta_entries.append((METAINDEX_RANGE_DEL, rh))
+
+        if self._dict:
+            dh = fmt.write_block(self.w, self._dict, fmt.NO_COMPRESSION)
+            meta_entries.append((METAINDEX_COMPRESSION_DICT, dh))
 
         iraw = self.index_block.finish()
         props.index_size = len(iraw)
@@ -470,6 +536,14 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
         p_plens = native.np_i64p(sec_plens)
         p_seclen = native.np_i64p(sec_len)
 
+    pool = None
+    if (options.compression != fmt.NO_COMPRESSION
+            and getattr(options, "compression_parallel_threads", 1) > 1):
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(
+            max_workers=options.compression_parallel_threads)
+
     results = []
     cur: _ColumnarSST | None = None
     lo = 0
@@ -478,7 +552,7 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
     exhausted = start_exhausted
     try:
         cur = _ColumnarSST(env, dbname, new_file_number(), icmp, options,
-                           creation_time, column_family)
+                           creation_time, column_family, pool)
         need_fetch = False
         while True:
             if start >= filled or need_fetch:
@@ -496,7 +570,8 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                     break
             limit = filled
             if (can_cut and cur.num_entries
-                    and cur.w.file_size() >= max_output_file_size):
+                    and cur.w.file_size() + cur.pending_bytes()
+                    >= max_output_file_size):
                 if not same_user_key(start, start - 1):
                     # Cut HERE (the per-entry path's pre-add check).
                     sel = order[lo:start]
@@ -504,7 +579,8 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                         lib, kv, sel, vtypes, seqs, []
                     ) + (sel,))
                     cur = _ColumnarSST(env, dbname, new_file_number(), icmp,
-                                       options, creation_time, column_family)
+                                       options, creation_time, column_family,
+                                       pool)
                     lo = start
                 else:
                     # Same user key spans the boundary: all its versions stay
@@ -611,3 +687,6 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
             except Exception:
                 pass
         raise
+    finally:
+        if pool is not None:
+            pool.shutdown()

@@ -237,6 +237,9 @@ def fused_sort_gc(padded: dict, snapshots: list[int], bottommost: bool):
         padded["inv_lo"], padded["vtype"], idx, snap_hi, snap_lo,
         padded["w"], bool(bottommost),
     )
+    for a in (order, zero_flags, count, has_complex):
+        if hasattr(a, "copy_to_host_async"):
+            a.copy_to_host_async()
     c = int(count)
     return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)
 
@@ -455,34 +458,6 @@ def _encode_from_bytes(key_buf, key_offs, key_lens, valid, num_key_words):
     return key_words, key_len, inv_hi, inv_lo, vtype
 
 
-def _sort_gc_packed_tail(key_words, key_len, inv_hi, inv_lo, vtype, idx,
-                         snap_hi, snap_lo, num_key_words, bottommost):
-    """Traced tail shared by the chunked fused kernels: sort (carrying idx)
-    → GC mask (no tombstones) → ONE int32 result array
-    [packed_order..., count, has_complex] with each survivor's zero-seq
-    flag in its order entry's sign bit."""
-    u32 = jnp.uint32
-    i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
-    n = key_words.shape[0]
-    kw, kl, ih, il, vt, perm = _sort_impl(
-        key_words, key_len, inv_hi, inv_lo, vtype, idx, num_key_words,
-    )
-    zeros = jnp.zeros(n, dtype=jnp.uint32)
-    keep, zero_seq, host_resolve, _ = _gc_mask_impl(
-        kw, kl, ih, il, vt, snap_hi, snap_lo, zeros, zeros,
-        num_key_words, bottommost,
-    )
-    take = jnp.argsort(~keep, stable=True)
-    packed_order = i32(
-        jax.lax.bitcast_convert_type(perm[take], u32)
-        | (zero_seq[take].astype(u32) << 31)
-    )
-    extras = jnp.stack([
-        jnp.sum(keep.astype(jnp.int32)),
-        jnp.any(host_resolve).astype(jnp.int32),
-    ])
-    return jnp.concatenate([packed_order, extras])
-
 
 @functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
 def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
@@ -501,174 +476,105 @@ def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
-def _fused_chunks_impl(kbs, lens8s, ns, row_bases, snap_hi, snap_lo,
-                       num_key_words, bottommost):
-    """Chunked variant of _fused_encode_sort_gc_impl: one padded (key-bytes,
-    uint8-lens) pair PER INPUT FILE, uploaded as each file is scanned so
-    host IO overlaps the host→device transfers. Validity is derived on
-    device from the per-chunk row counts `ns` (nothing but raw bytes +
-    lengths crosses the link), and the whole result comes back as ONE int32
-    array: [packed_order..., count, has_complex] with the zero-seq flag in
-    each order entry's sign bit."""
-    int32max = jnp.int32(2**31 - 1)
-    lens_parts, offs_parts, valid_parts, orig_parts = [], [], [], []
-    byte_base = 0
-    for j, l8 in enumerate(lens8s):
-        rows = l8.shape[0]
-        iota = jnp.arange(rows, dtype=jnp.int32)
-        valid = iota < ns[j]
-        lens = jnp.where(valid, l8.astype(jnp.int32), 0)
-        offs = byte_base + jnp.cumsum(lens) - lens
-        lens_parts.append(lens)
-        offs_parts.append(offs)
-        valid_parts.append(valid)
-        # Original row index in the host's concatenated ColumnarKV. Invalid
-        # rows may collide with later chunks' values — they are masked out
-        # of the survivor set, so their sort position is irrelevant.
-        orig_parts.append(jnp.where(valid, row_bases[j] + iota, int32max))
-        byte_base += kbs[j].shape[0]
-    key_buf = jnp.concatenate(kbs)
-    key_lens = jnp.concatenate(lens_parts)
-    key_offs = jnp.concatenate(offs_parts)
-    valid = jnp.concatenate(valid_parts)
-    orig = jnp.concatenate(orig_parts)
-    key_words, key_len, inv_hi, inv_lo, vtype = _encode_from_bytes(
-        key_buf, key_offs, key_lens, valid, num_key_words,
-    )
-    return _sort_gc_packed_tail(
-        key_words, key_len, inv_hi, inv_lo, vtype, orig,
-        snap_hi, snap_lo, num_key_words, bottommost,
-    )
-
-
-def begin_chunk_upload(key_buf: np.ndarray, key_lens: np.ndarray):
-    """Pad one file's dense raw key bytes + lengths to pow2 buckets and
-    START their host→device transfers (device_put is async: the copy
-    streams while the caller scans the next input file). Returns an opaque
-    handle for fused_encode_sort_gc_chunks. Raises NotSupported for keys
-    whose length exceeds uint8 (the device key budget is far below that)."""
-    n = len(key_lens)
-    if n and int(key_lens.max()) > 255:
-        raise NotSupported("chunked fused path requires key lengths <= 255")
-    b = _next_pow2(max(8, len(key_buf)))
-    kb = np.zeros(b, dtype=np.uint8)
-    kb[: len(key_buf)] = key_buf
-    p = _next_pow2(max(1, n))
-    l8 = np.zeros(p, dtype=np.uint8)
-    l8[:n] = key_lens
-    return (jax.device_put(kb), jax.device_put(l8), n)
-
-
-def fused_chunks_start(handles, snapshots: list[int], bottommost: bool,
-                       max_key_bytes: int):
-    """DISPATCH the fused encode+sort+GC over per-file chunk handles from
-    begin_chunk_upload (in ColumnarKV.concat row order) and return the
-    in-flight device array — the caller overlaps host work, then decodes
-    with fused_chunks_finish."""
-    if len(snapshots) > MAX_SNAPSHOTS:
-        raise NotSupported(
-            f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
-        )
-    if not handles:
-        return None
-    ns = np.array([h[2] for h in handles], dtype=np.int32)
-    row_bases = np.cumsum(ns, dtype=np.int32) - ns
-    snap_hi, snap_lo = _split_snapshots(snapshots)
-    w = (max_key_bytes + 3) // 4
-    return _fused_chunks_impl(
-        tuple(h[0] for h in handles), tuple(h[1] for h in handles),
-        ns, row_bases, snap_hi, snap_lo, w, bool(bottommost),
-    )
-
-
-def fused_chunks_finish(out):
-    """Block on a fused_chunks_start result: (order[count],
-    zero_flags[count], has_complex), order indexing the concatenated host
-    columns."""
-    if out is None:
-        return np.empty(0, np.int32), np.empty(0, bool), False
-    arr = np.asarray(out)
-    count = int(arr[-2])
-    has_complex = bool(arr[-1])
-    po = arr[:count].view(np.uint32)
-    order = (po & np.uint32(0x7FFFFFFF)).astype(np.int32)
-    zero_flags = (po >> np.uint32(31)).astype(bool)
-    return order, zero_flags, has_complex
-
-
-def fused_encode_sort_gc_chunks(handles, snapshots: list[int],
-                                bottommost: bool, max_key_bytes: int):
-    """One-shot wrapper: dispatch + decode."""
-    return fused_chunks_finish(
-        fused_chunks_start(handles, snapshots, bottommost, max_key_bytes)
-    )
+# Per-shard row budget for the 3-byte packed-order download: local row ids
+# must fit 22 bits (bit 23 carries the zero-seq flag, bit 22 is spare).
+MAX_SHARD_ROWS = 1 << 22
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_key_words", "uk_len", "bottommost")
+    jax.jit, static_argnames=("ns", "num_key_words", "uk_len", "bottommost")
 )
-def _fused_uniform_impl(uks, pks, ns, min_his, min_los, row_bases,
-                        snap_hi, snap_lo, num_key_words, uk_len, bottommost):
-    """Uniform-key-length variant of _fused_chunks_impl. Each chunk ships
-    only its user-key bytes (trailers stripped on host) plus ONE uint32 per
-    entry: (seq - chunk_min_seq) << 8 | vtype, seq deltas < 2^24. No device
-    gathers (rows are a reshape), and the sort carries w+1 key operands
-    instead of w+3 keys + 2 payloads. Tombstone-free jobs only."""
+def _fused_uniform_shard_impl(ukb, pkb, min_his, min_los,
+                              snap_hi, snap_lo, ns, num_key_words, uk_len,
+                              bottommost):
+    """ONE range-shard's encode+sort+GC over ONE uploaded buffer pair:
+    `ukb` = trailer-stripped user-key bytes of every chunk packed
+    contiguously (padded rows zero), `pkb` = one uint32 per row
+    ((seq - chunk_min_seq) << 8 | vtype, deltas < 2^24). Chunk row counts
+    `ns` are static, so per-chunk seqno reconstruction is static slicing —
+    no per-chunk device buffers, TWO host→device transfers per shard
+    total. The result is (packed_bytes u8[3p], meta i32[2]): three
+    byte-planes of the 24-bit survivor row ids (bit 23 = zero-seq flag) —
+     3/4 the download of int32 orders — plus [count, has_complex].
+    Tombstone-free jobs only."""
     u32 = jnp.uint32
     int32max = jnp.int32(2**31 - 1)
     sign = u32(_SIGN)
     i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
     span = num_key_words * 4
-    words_p, ih_p, il_p, vt_p, kl_p, orig_p = [], [], [], [], [], []
-    for j, pk in enumerate(pks):
-        rows = pk.shape[0]
-        iota = jnp.arange(rows, dtype=jnp.int32)
-        valid = iota < ns[j]
-        kb = uks[j].reshape(rows, uk_len)
-        if span > uk_len:
-            kb = jnp.pad(kb, ((0, 0), (0, span - uk_len)))
-        kb = kb.astype(u32).reshape(rows, num_key_words, 4)
-        words = (
-            (kb[:, :, 0] << 24) | (kb[:, :, 1] << 16)
-            | (kb[:, :, 2] << 8) | kb[:, :, 3]
-        )
-        words = jnp.where(valid[:, None], i32(words ^ sign), int32max)
-        # Reconstruct the FULL 64-bit packed trailer (seq<<8|type) from the
-        # 24-bit chunk-relative delta + the chunk's min seqno: deltas from
-        # different chunks are not comparable, the absolute words are.
+    p = pkb.shape[0]
+    total = int(sum(ns))
+    iota = jnp.arange(p, dtype=jnp.int32)
+    valid = iota < total
+
+    kb = ukb.reshape(p, uk_len)
+    if span > uk_len:
+        kb = jnp.pad(kb, ((0, 0), (0, span - uk_len)))
+    kb = kb.astype(u32).reshape(p, num_key_words, 4)
+    words = (
+        (kb[:, :, 0] << 24) | (kb[:, :, 1] << 16)
+        | (kb[:, :, 2] << 8) | kb[:, :, 3]
+    )
+    key_words = jnp.where(valid[:, None], i32(words ^ sign), int32max)
+
+    # Reconstruct full 64-bit packed trailers (seq<<8|type) chunk by chunk
+    # (static bounds): deltas from different chunks are not comparable,
+    # the absolute words are.
+    ih_p, il_p, vt_p = [], [], []
+    start = 0
+    for j, n_j in enumerate(ns):
+        pk = jax.lax.slice_in_dim(pkb, start, start + n_j)
         rel = pk >> 8
         seq_lo = min_los[j] + rel
         carry = (seq_lo < min_los[j]).astype(u32)
         seq_hi = min_his[j] + carry
-        vt = (pk & u32(0xFF))
+        vt = pk & u32(0xFF)
         packed_hi = (seq_hi << 8) | (seq_lo >> 24)
         packed_lo = (seq_lo << 8) | vt
-        ih = jnp.where(valid, i32(~packed_hi ^ sign), int32max)
-        il = jnp.where(valid, i32(~packed_lo ^ sign), int32max)
-        words_p.append(words)
-        ih_p.append(ih)
-        il_p.append(il)
-        vt_p.append(jnp.where(valid, vt.astype(jnp.int32), -1))
-        kl_p.append(jnp.where(valid, jnp.int32(uk_len), int32max))
-        orig_p.append(jnp.where(valid, row_bases[j] + iota, int32max))
-    key_words = jnp.concatenate(words_p)
-    inv_hi = jnp.concatenate(ih_p)
-    inv_lo = jnp.concatenate(il_p)
-    vtype = jnp.concatenate(vt_p)
-    key_len = jnp.concatenate(kl_p)
-    orig = jnp.concatenate(orig_p)
-    return _sort_gc_packed_tail(
-        key_words, key_len, inv_hi, inv_lo, vtype, orig,
-        snap_hi, snap_lo, num_key_words, bottommost,
+        ih_p.append(i32(~packed_hi ^ sign))
+        il_p.append(i32(~packed_lo ^ sign))
+        vt_p.append(vt.astype(jnp.int32))
+        start += n_j
+    pad_rows = p - total
+    if pad_rows:
+        ih_p.append(jnp.full(pad_rows, int32max, jnp.int32))
+        il_p.append(jnp.full(pad_rows, int32max, jnp.int32))
+        vt_p.append(jnp.full(pad_rows, -1, jnp.int32))
+    inv_hi = jnp.concatenate(ih_p) if len(ih_p) > 1 else ih_p[0]
+    inv_lo = jnp.concatenate(il_p) if len(il_p) > 1 else il_p[0]
+    vtype = jnp.concatenate(vt_p) if len(vt_p) > 1 else vt_p[0]
+    key_len = jnp.where(valid, jnp.int32(uk_len), int32max)
+
+    kw, kl, ih, il, vt, perm = _sort_impl(
+        key_words, key_len, inv_hi, inv_lo, vtype, iota, num_key_words,
     )
+    zeros = jnp.zeros(p, dtype=jnp.uint32)
+    keep, zero_seq, host_resolve, _ = _gc_mask_impl(
+        kw, kl, ih, il, vt, snap_hi, snap_lo, zeros, zeros,
+        num_key_words, bottommost,
+    )
+    take = jnp.argsort(~keep, stable=True)
+    po = (
+        jax.lax.bitcast_convert_type(perm[take], u32)
+        | (zero_seq[take].astype(u32) << 23)
+    )
+    packed_bytes = jnp.concatenate([
+        (po & u32(0xFF)).astype(jnp.uint8),
+        ((po >> 8) & u32(0xFF)).astype(jnp.uint8),
+        ((po >> 16) & u32(0xFF)).astype(jnp.uint8),
+    ])
+    meta = jnp.stack([
+        jnp.sum(keep.astype(jnp.int32)),
+        jnp.any(host_resolve).astype(jnp.int32),
+    ])
+    return packed_bytes, meta
 
 
-def begin_uniform_chunk_upload(key_buf: np.ndarray, n: int, key_len: int):
-    """Strip the 8-byte trailers from one file's dense uniform-length key
-    buffer and START the transfers of (user-key bytes, packed32) — half the
-    bytes of the generic chunk upload. Raises NotSupported when the chunk's
-    seqno span exceeds 24 bits (the uint32 packing budget)."""
+def prepare_uniform_chunk(key_buf: np.ndarray, n: int, key_len: int):
+    """Host half of the uniform upload: strip the 8-byte trailers from one
+    dense uniform-length key slice; no device traffic. Returns
+    (uk_bytes, pk32, min_seq, n, uk_len). Raises NotSupported when the
+    chunk's seqno span exceeds 24 bits (the uint32 packing budget)."""
     import sys as _sys
 
     kb2 = key_buf[: n * key_len].reshape(n, key_len)
@@ -682,37 +588,78 @@ def begin_uniform_chunk_upload(key_buf: np.ndarray, n: int, key_len: int):
         raise NotSupported("chunk seqno span exceeds the 24-bit delta budget")
     pk32 = ((rel << np.uint64(8)) | (tr & np.uint64(0xFF))).astype(np.uint32)
     uk_len = key_len - 8
-    uk = np.ascontiguousarray(kb2[:, :uk_len])
-    p = _next_pow2(max(1, n))
-    ukp = np.zeros(p * uk_len, dtype=np.uint8)
-    ukp[: n * uk_len] = uk.reshape(-1)
-    pkp = np.zeros(p, dtype=np.uint32)
-    pkp[:n] = pk32
-    return (jax.device_put(ukp), jax.device_put(pkp), n, min_seq, uk_len)
+    uk = np.ascontiguousarray(kb2[:, :uk_len]).reshape(-1)
+    return (uk, pk32, min_seq, n, uk_len)
 
 
-def fused_uniform_start(handles, snapshots: list[int], bottommost: bool):
-    """Dispatch the uniform-key fused program over chunk handles from
-    begin_uniform_chunk_upload (ColumnarKV.concat row order)."""
+def upload_uniform_shard(chunks):
+    """Pack one shard's prepared chunks (prepare_uniform_chunk outputs, in
+    row order) into ONE key-byte buffer + ONE packed32 buffer, pad rows to
+    the next power of two, and START the two host→device transfers
+    (device_put is async). Tunneled rigs pay a fixed ~60ms per transfer
+    regardless of size, so two big transfers beat 2-per-chunk small ones."""
+    uk_len = chunks[0][4]
+    ns = tuple(int(c[3]) for c in chunks)
+    total = sum(ns)
+    if total > MAX_SHARD_ROWS:
+        raise NotSupported(
+            f"shard rows {total} exceed the 24-bit packed-order budget"
+        )
+    p = _next_pow2(max(1, total))
+    ukb = np.zeros(p * uk_len, dtype=np.uint8)
+    pkb = np.zeros(p, dtype=np.uint32)
+    pos = 0
+    for uk, pk32, _mn, n, _l in chunks:
+        ukb[pos * uk_len:(pos + n) * uk_len] = uk
+        pkb[pos:pos + n] = pk32
+        pos += n
+    mins = np.array([c[2] for c in chunks], dtype=np.uint64)
+    return (
+        jax.device_put(ukb), jax.device_put(pkb), ns,
+        (mins >> np.uint64(32)).astype(np.uint32),
+        (mins & np.uint64(0xFFFFFFFF)).astype(np.uint32), uk_len,
+    )
+
+
+def fused_uniform_shard_start(handle, snapshots: list[int], bottommost: bool):
+    """Dispatch one shard's fused program over an upload_uniform_shard
+    handle; enqueues the D2H copies so results stream back as the program
+    finishes. Decode with fused_uniform_shard_finish."""
     if len(snapshots) > MAX_SNAPSHOTS:
         raise NotSupported(
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
         )
-    if not handles:
-        return None
-    uk_len = handles[0][4]
-    ns = np.array([h[2] for h in handles], dtype=np.int32)
-    row_bases = np.cumsum(ns, dtype=np.int32) - ns
-    mins = np.array([h[3] for h in handles], dtype=np.uint64)
-    min_his = (mins >> np.uint64(32)).astype(np.uint32)
-    min_los = (mins & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    ukb, pkb, ns, min_his, min_los, uk_len = handle
     snap_hi, snap_lo = _split_snapshots(snapshots)
     w = (max(uk_len, 4) + 3) // 4
-    return _fused_uniform_impl(
-        tuple(h[0] for h in handles), tuple(h[1] for h in handles),
-        ns, min_his, min_los, row_bases, snap_hi, snap_lo,
-        w, uk_len, bool(bottommost),
+    out = _fused_uniform_shard_impl(
+        ukb, pkb, min_his, min_los, snap_hi, snap_lo,
+        ns, w, uk_len, bool(bottommost),
     )
+    for a in out:
+        if hasattr(a, "copy_to_host_async"):
+            a.copy_to_host_async()
+    return out
+
+
+def fused_uniform_shard_finish(pending):
+    """Block on one shard's result: (order[count] int32 LOCAL shard rows,
+    zero_flags[count] bool, has_complex)."""
+    packed_bytes, meta = pending
+    m = np.asarray(meta)
+    c = int(m[0])
+    has_complex = bool(m[1])
+    arr = np.asarray(packed_bytes)
+    p = arr.size // 3
+    a = arr.reshape(3, p)
+    po = (
+        a[0, :c].astype(np.uint32)
+        | (a[1, :c].astype(np.uint32) << 8)
+        | (a[2, :c].astype(np.uint32) << 16)
+    )
+    order = (po & np.uint32(MAX_SHARD_ROWS - 1)).astype(np.int32)
+    zero_flags = (po >> np.uint32(23)).astype(bool)
+    return order, zero_flags, has_complex
 
 
 def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
@@ -750,6 +697,9 @@ def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
     order, zero_flags, count, has_complex = _fused_encode_sort_gc_impl(
         kb, lens, valid, snap_hi, snap_lo, w, bool(bottommost),
     )
+    for a in (order, zero_flags, count, has_complex):
+        if hasattr(a, "copy_to_host_async"):
+            a.copy_to_host_async()  # stream D2H; sync np.asarray is ~15x
     c = int(count)
     return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)
 

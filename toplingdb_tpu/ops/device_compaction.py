@@ -297,13 +297,25 @@ def _part_bounds(part, splitters: list[bytes]) -> list[int]:
     return b
 
 
-def _device_shards() -> int:
-    # Default 1: on tunneled dev rigs the per-shard download latency beats
-    # the transfer/compute overlap. Raise on real PCIe-attached hosts.
+def _device_shards(total_rows: int) -> int:
+    """Range-shard count: TPULSM_DEVICE_SHARDS wins; otherwise size shards
+    to ~512K rows (pow2 count, so per-shard padded shapes land in the same
+    compile bucket) up to the 24-bit packed-order budget."""
+    env = os.environ.get("TPULSM_DEVICE_SHARDS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
     try:
-        return max(1, int(os.environ.get("TPULSM_DEVICE_SHARDS", "1")))
+        target = max(1 << 16, int(os.environ.get(
+            "TPULSM_SHARD_ROWS", str(1 << 20))))
     except ValueError:
-        return 1
+        target = 1 << 20
+    s = 1
+    while s < 16 and total_rows // s > target:
+        s *= 2
+    return s
 
 
 # Below this row count a job runs as one shard: the pipeline's transfer/
@@ -312,103 +324,104 @@ _SHARD_MIN_ROWS = 1 << 18
 
 
 def _collect_raw_columnar(compaction, table_cache, icmp, want_uploads=False):
-    """Scan every input file into columnar buffers. With want_uploads, ALSO
-    split each sorted part into user-key-range shards and start the async
-    host→device chunk transfers as each file is scanned — host IO, the
-    link, and (later) the per-shard device programs all overlap. Returns
-    (kv, rd, shards) where shards is None when the chunked device path
-    does not apply (tombstones present, sparse layout, oversized keys);
-    otherwise shards[s] = (handles, row_ranges) with row_ranges the
+    """Scan every input file into columnar buffers — in parallel threads
+    (the native block decoder runs GIL-free under ctypes). With
+    want_uploads, ALSO split the sorted parts into user-key-range shards
+    and prepare (host-side, no device traffic yet) each shard's uniform
+    chunk columns. Returns (kv, rd, shards) where shards is None when the
+    sharded uniform device path does not apply (tombstones, sparse layout,
+    non-uniform key lengths, oversized shards); otherwise shards[s] =
+    (chunks, row_ranges): prepare_uniform_chunk outputs plus the
     (global_lo, global_hi) row spans into the concatenated kv that each
-    handle covers, in handle order."""
+    chunk covers, in chunk order."""
+    from concurrent.futures import ThreadPoolExecutor
+
     from toplingdb_tpu.ops.columnar_io import ColumnarKV, scan_table_columnar
     from toplingdb_tpu.utils.status import NotSupported
 
-    parts = []
-    upload_ok = want_uploads
-    splitters = None
-    shards = None
-    mode = None
-    uniform_len = 0
-    row_base = 0
+    readers = [
+        table_cache.get_reader(f.number) for _, f in compaction.all_inputs()
+    ]
+    if len(readers) > 1:
+        with ThreadPoolExecutor(min(8, len(readers))) as ex:
+            parts = list(ex.map(scan_table_columnar, readers))
+    else:
+        parts = [scan_table_columnar(r) for r in readers]
     rd = RangeDelAggregator(icmp.user_comparator)
-    for _, f in compaction.all_inputs():
-        r = table_cache.get_reader(f.number)
-        part = scan_table_columnar(r)
+    for r in readers:
         for b, e in r.range_del_entries():
             rd.add(RangeTombstone.from_table_entry(b, e))
-        if upload_ok and part.n:
-            # Full density validation (same precondition fused_encode_sort_gc
-            # enforces): the device derives offsets as a cumsum of lengths,
-            # so EVERY interior offset must match, not just the endpoints.
-            dense = (
-                int(part.key_offs[0]) == 0
-                and int(part.key_offs[-1]) + int(part.key_lens[-1])
-                == len(part.key_buf)
-                and np.array_equal(
-                    part.key_offs[1:],
-                    (np.cumsum(part.key_lens) - part.key_lens)[1:],
-                )
+
+    shards = None
+    if want_uploads and rd.empty():
+        shards = _prepare_uniform_shards(parts)
+    return ColumnarKV.concat(parts), rd, shards
+
+
+def _prepare_uniform_shards(parts):
+    """Host half of the sharded uniform device path: validate density +
+    uniform key length, pick range splitters, slice every part into
+    per-shard chunks. Returns shards list or None when ineligible."""
+    from toplingdb_tpu.utils.status import NotSupported
+
+    uniform_len = 0
+    total_rows = 0
+    for part in parts:
+        if not part.n:
+            continue
+        L = int(part.key_lens[0])
+        dense_uniform = (
+            part.key_lens.min() == part.key_lens.max()
+            and len(part.key_buf) == part.n * L
+            and int(part.key_offs[0]) == 0
+            and np.array_equal(
+                part.key_offs[1:],
+                (np.cumsum(part.key_lens) - part.key_lens)[1:],
             )
-            if dense and rd.empty():
-                if mode is None:
-                    # The first non-empty part picks the transfer mode:
-                    # uniform key length ships trailer-stripped bytes +
-                    # one uint32 per entry (half the generic upload).
-                    L = int(part.key_lens[0])
-                    if (part.key_lens.min() == part.key_lens.max()
-                            and len(part.key_buf) == part.n * L):
-                        mode, uniform_len = "uniform", L
-                    else:
-                        mode = "generic"
-                if mode == "uniform" and not (
-                        part.key_lens.min() == part.key_lens.max()
-                        == uniform_len
-                        and len(part.key_buf) == part.n * uniform_len):
-                    upload_ok = False
-                if upload_ok and splitters is None:
-                    # Range splitters come from the first non-empty part;
-                    # later parts are assumed similarly distributed (skew
-                    # only costs balance, never correctness).
-                    n_shards = (
-                        _device_shards() if part.n >= _SHARD_MIN_ROWS else 1
-                    )
-                    splitters = _shard_splitters(part, n_shards)
-                    shards = [([], []) for _ in range(len(splitters) + 1)]
-                if upload_ok:
-                    try:
-                        bounds = _part_bounds(part, splitters)
-                        for s in range(len(bounds) - 1):
-                            lo, hi = bounds[s], bounds[s + 1]
-                            if lo == hi:
-                                continue
-                            blo = int(part.key_offs[lo])
-                            bhi = int(part.key_offs[hi - 1]) + int(
-                                part.key_lens[hi - 1]
-                            )
-                            if mode == "uniform":
-                                h = ck.begin_uniform_chunk_upload(
-                                    part.key_buf[blo:bhi], hi - lo,
-                                    uniform_len,
-                                )
-                            else:
-                                h = ck.begin_chunk_upload(
-                                    part.key_buf[blo:bhi],
-                                    part.key_lens[lo:hi],
-                                )
-                            shards[s][0].append(h)
-                            shards[s][1].append((row_base + lo, row_base + hi))
-                    except NotSupported:
-                        upload_ok = False
-            else:
-                upload_ok = False
-        parts.append(part)
-        row_base += part.n
-    if not upload_ok or not rd.empty() or shards is None:
-        shards = None
-    else:
-        shards = [sh for sh in shards if sh[0]]
-    return ColumnarKV.concat(parts), rd, (shards, mode)
+        )
+        if not dense_uniform:
+            return None
+        if uniform_len and L != uniform_len:
+            return None
+        uniform_len = L
+        total_rows += part.n
+    if not total_rows:
+        return None
+
+    splitters = None
+    for part in parts:
+        if part.n:
+            n_shards = (
+                _device_shards(total_rows)
+                if total_rows >= _SHARD_MIN_ROWS else 1
+            )
+            splitters = _shard_splitters(part, n_shards)
+            break
+    shards = [([], []) for _ in range(len(splitters) + 1)]
+    row_base = 0
+    try:
+        for part in parts:
+            if not part.n:
+                continue
+            bounds = _part_bounds(part, splitters)
+            for s in range(len(bounds) - 1):
+                lo, hi = bounds[s], bounds[s + 1]
+                if lo == hi:
+                    continue
+                blo = int(part.key_offs[lo])
+                bhi = int(part.key_offs[hi - 1]) + int(part.key_lens[hi - 1])
+                shards[s][0].append(ck.prepare_uniform_chunk(
+                    part.key_buf[blo:bhi], hi - lo, uniform_len,
+                ))
+                shards[s][1].append((row_base + lo, row_base + hi))
+            row_base += part.n
+    except NotSupported:
+        return None
+    shards = [sh for sh in shards if sh[0]]
+    for chunks, _ranges in shards:
+        if sum(c[3] for c in chunks) > ck.MAX_SHARD_ROWS:
+            return None  # skewed splitters blew the 24-bit row budget
+    return shards or None
 
 
 def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
@@ -427,7 +440,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()
     try:
-        kv, rd, (shards, shard_mode) = _collect_raw_columnar(
+        kv, rd, shards = _collect_raw_columnar(
             compaction, table_cache, icmp, want_uploads=not _host_sort(),
         )
     except NotSupported:
@@ -455,26 +468,20 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                     )
                 col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
             elif shards is not None:
-                # Per-file per-shard chunks already streaming to the device
-                # since scan time. Dispatch every shard's program up front
-                # (the device pipelines them: shard s+1 computes while
-                # shard s downloads), then STREAM each shard's survivors
-                # straight into the SST writer — block building overlaps
-                # the remaining shards' compute + download.
-                if shard_mode == "uniform":
-                    pendings = [
-                        ck.fused_uniform_start(
-                            h, snapshots, compaction.bottommost,
-                        )
-                        for h, _ in shards
-                    ]
-                else:
-                    pendings = [
-                        ck.fused_chunks_start(
-                            h, snapshots, compaction.bottommost, mkb,
-                        )
-                        for h, _ in shards
-                    ]
+                # Upload + dispatch every shard up front (device_put and
+                # jit dispatch are async; shard s+1's transfer streams
+                # while shard s computes, and fused_uniform_shard_start
+                # enqueues each D2H copy so results stream back), then
+                # STREAM each shard's survivors straight into the SST
+                # writer — block building overlaps the remaining shards'
+                # compute + download.
+                pendings = [
+                    ck.fused_uniform_shard_start(
+                        ck.upload_uniform_shard(chunks), snapshots,
+                        compaction.bottommost,
+                    )
+                    for chunks, _ in shards
+                ]
                 col = _kv_seq_vtype(kv)
                 has_complex = False
                 order = None  # streamed; see _shard_order_chunks below
@@ -546,8 +553,8 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # Shard streaming: each chunk's trailers/seqs land just before the
         # writer consumes it (the writer reads both arrays per native call).
         def _shard_order_chunks():
-            for (_h, ranges), pending in zip(shards, pendings):
-                o, z, hc = ck.fused_chunks_finish(pending)
+            for (_chunks, ranges), pending in zip(shards, pendings):
+                o, z, hc = ck.fused_uniform_shard_finish(pending)
                 if hc:
                     raise _FallbackToEntries()
                 lmap = np.concatenate([

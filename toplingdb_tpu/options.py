@@ -111,6 +111,10 @@ class Options:
     table_options: TableOptions = field(default_factory=TableOptions)
     compression: int = fmt.NO_COMPRESSION
     bottommost_compression: Optional[int] = None
+    # Per-level codec list (reference ColumnFamilyOptions::compression_per_level,
+    # include/rocksdb/options.h): levels past the end reuse the last entry;
+    # empty = `compression` (or table_options.compression).
+    compression_per_level: list = field(default_factory=list)
 
     # -- distributed compaction (the dcompact boundary) -----------------
     compaction_executor_factory: Any = None  # CompactionExecutorFactory
@@ -134,6 +138,30 @@ class Options:
         for _ in range(1, max(1, level)):
             size *= self.target_file_size_multiplier
         return size
+
+    def compression_for_level(self, level: int,
+                              bottommost: bool = False) -> int:
+        """Effective codec for an output level (reference
+        Compaction::GetCompressionType: bottommost_compression wins at the
+        last level, then compression_per_level, then the base codec)."""
+        if bottommost and self.bottommost_compression is not None:
+            return self.bottommost_compression
+        if self.compression_per_level:
+            idx = min(level, len(self.compression_per_level) - 1)
+            return self.compression_per_level[idx]
+        if self.compression != fmt.NO_COMPRESSION:
+            return self.compression
+        return self.table_options.compression
+
+    def table_options_for_level(self, level: int, bottommost: bool = False):
+        """table_options with the per-level codec applied (identity when
+        nothing level-specific is configured)."""
+        eff = self.compression_for_level(level, bottommost)
+        if eff == self.table_options.compression:
+            return self.table_options
+        import dataclasses
+
+        return dataclasses.replace(self.table_options, compression=eff)
 
 
 @dataclass

@@ -25,6 +25,24 @@ from toplingdb_tpu.table.properties import TableProperties
 METAINDEX_FILTER = b"filter.fullfilter"
 METAINDEX_PROPERTIES = b"tpulsm.properties"
 METAINDEX_RANGE_DEL = b"tpulsm.range_del"
+METAINDEX_COMPRESSION_DICT = b"tpulsm.compression_dict"
+
+
+@dataclass
+class CompressionOptions:
+    """Per-codec tuning (reference CompressionOptions,
+    include/rocksdb/advanced_options.h): `level` feeds the codec,
+    `max_dict_bytes` > 0 enables ZSTD dictionary compression (the dict is
+    trained from the file's first `zstd_max_train_bytes` of raw blocks —
+    default 100x the dict size — stored in a metaindex block, and applied
+    to every data block; reference util/compression.h:1435-1476)."""
+
+    level: int | None = None
+    max_dict_bytes: int = 0
+    zstd_max_train_bytes: int = 0
+
+    def train_budget(self) -> int:
+        return self.zstd_max_train_bytes or self.max_dict_bytes * 100
 
 
 @dataclass
@@ -52,6 +70,8 @@ class TableOptions:
     # threads (zlib/bz2/lzma release the GIL) and write in order.
     compression_parallel_threads: int = 1
     compression: int = fmt.NO_COMPRESSION
+    compression_opts: CompressionOptions = field(
+        default_factory=CompressionOptions)
     filter_policy: FilterPolicy | None = field(default_factory=BloomFilterPolicy)
     whole_key_filtering: bool = True
     # SliceTransform (utils/slice_transform.py) or None. When set, key
@@ -130,6 +150,16 @@ class TableBuilder:
             self._par_pool = ThreadPoolExecutor(
                 max_workers=self.opts.compression_parallel_threads
             )
+        # ZSTD dictionary state: None = disabled, b"" = training pending
+        # (raw blocks buffer in _dict_samples until the train budget),
+        # non-empty = trained and applied to every subsequent data block.
+        copts = self.opts.compression_opts
+        self._dict: bytes | None = (
+            b"" if (self.opts.compression == fmt.ZSTD_COMPRESSION
+                    and copts.max_dict_bytes > 0) else None
+        )
+        self._dict_samples: list = []   # (raw, first_key, last_key)
+        self._dict_sample_bytes = 0
 
     # ------------------------------------------------------------------
 
@@ -143,6 +173,7 @@ class TableBuilder:
             # Count queued-but-unwritten blocks (raw size upper bound) so
             # compaction's output-cut trigger doesn't lag the pipeline.
             size += sum(b[3] for b in self._par_blocks)
+        size += self._dict_sample_bytes  # dict-training buffer, same reason
         return size
 
     @property
@@ -223,22 +254,59 @@ class TableBuilder:
         if self._data_block.empty():
             return
         raw = self._data_block.finish()
-        if self._par_pool is not None:
-            fut = self._par_pool.submit(
-                fmt.compress_for_block, raw, self.opts.compression
-            )
-            self._par_blocks.append(
-                (fut, self._block_first_key, self._last_key, len(raw))
-            )
-            self._drain_parallel(wait=False)
+        if self._dict == b"":
+            # Dictionary training pending: buffer raw blocks until the
+            # train budget (reference buffers data_begin the same way,
+            # block_based_table_builder.cc EnterUnbuffered).
+            self._dict_samples.append(
+                (raw, self._block_first_key, self._last_key))
+            self._dict_sample_bytes += len(raw)
+            if (self._dict_sample_bytes
+                    >= self.opts.compression_opts.train_budget()):
+                self._train_dict_and_flush()
+        elif self._par_pool is not None or self._dict is not None:
+            self._emit_deferred(raw, self._block_first_key, self._last_key)
         else:
             self._pending_handle = fmt.write_block(
-                self._w, raw, self.opts.compression
+                self._w, raw, self.opts.compression,
+                self.opts.compression_opts.level,
             )
             self._pending_index_entry = True
             self.props.data_size += len(raw)
             self.props.num_data_blocks += 1
         self._data_block.reset()
+
+    def _emit_deferred(self, raw: bytes, first: bytes, last: bytes) -> None:
+        """Deferred-index block emission (parallel pipeline and/or dict
+        mode): compressed out-of-band or inline, index assembled at finish
+        from recorded boundaries."""
+        copts = self.opts.compression_opts
+        if self._par_pool is not None:
+            fut = self._par_pool.submit(
+                fmt.compress_for_block, raw, self.opts.compression,
+                copts.level, self._dict or b"",
+            )
+            self._par_blocks.append((fut, first, last, len(raw)))
+            self._drain_parallel(wait=False)
+        else:
+            payload, out_type = fmt.compress_for_block(
+                raw, self.opts.compression, copts.level, self._dict or b"")
+            h = fmt.write_compressed_block(self._w, payload, out_type)
+            self._par_meta.append((first, last, h))
+            self.props.data_size += len(raw)
+            self.props.num_data_blocks += 1
+
+    def _train_dict_and_flush(self) -> None:
+        from toplingdb_tpu.utils import codecs
+
+        self._dict = codecs.zstd_train_dictionary(
+            [r for r, _, _ in self._dict_samples],
+            self.opts.compression_opts.max_dict_bytes,
+        )
+        for raw, first, last in self._dict_samples:
+            self._emit_deferred(raw, first, last)
+        self._dict_samples = []
+        self._dict_sample_bytes = 0
 
     def _drain_parallel(self, wait: bool) -> None:
         """Write completed compressed blocks in submission order (bounds
@@ -258,9 +326,12 @@ class TableBuilder:
             if c.need_compact():
                 self.need_compaction = True
         self._flush_data_block()
+        if self._dict == b"":
+            self._train_dict_and_flush()  # small file: train from the lot
         if self._par_pool is not None:
             self._drain_parallel(wait=True)
             self._par_pool.shutdown()
+        if self._par_meta:
             # Index from recorded block boundaries — same separators as the
             # sequential path computes incrementally.
             for i, (first, last, h) in enumerate(self._par_meta):
@@ -289,6 +360,10 @@ class TableBuilder:
             rd = self._range_del_block.finish()
             rh = fmt.write_block(self._w, rd, fmt.NO_COMPRESSION)
             meta_entries.append((METAINDEX_RANGE_DEL, rh))
+
+        if self._dict:
+            dh = fmt.write_block(self._w, self._dict, fmt.NO_COMPRESSION)
+            meta_entries.append((METAINDEX_COMPRESSION_DICT, dh))
 
         # Index size must be known before the properties block is serialized.
         two_level = self._two_level_index and len(self._index_entries) > 1

@@ -30,11 +30,17 @@ MAX_HANDLE_LEN = 20     # two varint64s
 FOOTER_LEN = 1 + 2 * MAX_HANDLE_LEN + 4 + 8
 
 # Compression type byte (values chosen to match the reference's enum where the
-# codec exists in both: kNoCompression=0, kZlibCompression=2, kBZip2=3,
-# kLZMA has no reference equivalent and takes a private value).
+# codec exists in both — include/rocksdb/compression_type.h:22-28:
+# kNoCompression=0, kSnappyCompression=1, kZlibCompression=2, kBZip2=3,
+# kLZ4=4, kLZ4HC=5, kZSTD=7; kLZMA has no reference equivalent and takes a
+# private value).
 NO_COMPRESSION = 0
+SNAPPY_COMPRESSION = 1
 ZLIB_COMPRESSION = 2
 BZIP2_COMPRESSION = 3
+LZ4_COMPRESSION = 4
+LZ4HC_COMPRESSION = 5
+ZSTD_COMPRESSION = 7
 LZMA_COMPRESSION = 0x21
 
 CHECKSUM_CRC32C = 1
@@ -101,36 +107,66 @@ class Footer:
         return Footer(mih, ih, checksum_type, version, magic)
 
 
-def compress(data: bytes, ctype: int) -> bytes:
+def compress(data: bytes, ctype: int, level: int | None = None,
+             dict_: bytes = b"") -> bytes:
     if ctype == NO_COMPRESSION:
         return data
+    if ctype == SNAPPY_COMPRESSION:
+        from toplingdb_tpu.utils import codecs
+
+        return codecs.snappy_compress(data)
     if ctype == ZLIB_COMPRESSION:
-        return zlib.compress(data, 6)
+        return zlib.compress(data, 6 if level is None else level)
     if ctype == BZIP2_COMPRESSION:
         return bz2.compress(data)
+    if ctype == LZ4_COMPRESSION:
+        from toplingdb_tpu.utils import codecs
+
+        return codecs.lz4_compress(data)
+    if ctype == LZ4HC_COMPRESSION:
+        from toplingdb_tpu.utils import codecs
+
+        return codecs.lz4_compress(data, hc=True, level=level or 9)
+    if ctype == ZSTD_COMPRESSION:
+        from toplingdb_tpu.utils import codecs
+
+        return codecs.zstd_compress(data, 3 if level is None else level, dict_)
     if ctype == LZMA_COMPRESSION:
         return lzma.compress(data)
     raise NotSupported(f"compression type {ctype}")
 
 
-def decompress(data: bytes, ctype: int) -> bytes:
+def decompress(data: bytes, ctype: int, dict_: bytes = b"") -> bytes:
     if ctype == NO_COMPRESSION:
         return data
+    if ctype == SNAPPY_COMPRESSION:
+        from toplingdb_tpu.utils import codecs
+
+        return codecs.snappy_decompress(data)
     if ctype == ZLIB_COMPRESSION:
         return zlib.decompress(data)
     if ctype == BZIP2_COMPRESSION:
         return bz2.decompress(data)
+    if ctype in (LZ4_COMPRESSION, LZ4HC_COMPRESSION):
+        from toplingdb_tpu.utils import codecs
+
+        return codecs.lz4_decompress(data)
+    if ctype == ZSTD_COMPRESSION:
+        from toplingdb_tpu.utils import codecs
+
+        return codecs.zstd_decompress(data, dict_)
     if ctype == LZMA_COMPRESSION:
         return lzma.decompress(data)
     raise Corruption(f"unknown compression type {ctype}")
 
 
-def compress_for_block(raw: bytes, ctype: int) -> tuple[bytes, int]:
+def compress_for_block(raw: bytes, ctype: int, level: int | None = None,
+                       dict_: bytes = b"") -> tuple[bytes, int]:
     """The CPU half of write_block: (payload, effective_type) with the
     <12.5%-gain fallback to uncompressed — safe to run on worker threads
-    (zlib/bz2/lzma release the GIL)."""
+    (all codecs release the GIL under ctypes/stdlib)."""
     if ctype != NO_COMPRESSION:
-        c = compress(raw, ctype)
+        c = compress(raw, ctype, level, dict_)
         if len(c) < len(raw) - len(raw) // 8:
             return c, ctype
     return raw, NO_COMPRESSION
@@ -146,18 +182,20 @@ def write_compressed_block(wfile, payload: bytes, out_type: int) -> BlockHandle:
     return BlockHandle(offset, len(payload))
 
 
-def write_block(wfile, raw: bytes, ctype: int) -> BlockHandle:
+def write_block(wfile, raw: bytes, ctype: int, level: int | None = None,
+                dict_: bytes = b"") -> BlockHandle:
     """Compress (if profitable), frame with trailer, append. Returns handle.
 
     Mirrors BlockBasedTableBuilder::WriteBlock (reference
     table/block_based/block_based_table_builder.cc:1092-1150): fall back to
     uncompressed when compression gains <12.5%.
     """
-    payload, out_type = compress_for_block(raw, ctype)
+    payload, out_type = compress_for_block(raw, ctype, level, dict_)
     return write_compressed_block(wfile, payload, out_type)
 
 
-def read_block(rfile, handle: BlockHandle, verify_checksums: bool = True) -> bytes:
+def read_block(rfile, handle: BlockHandle, verify_checksums: bool = True,
+               dict_: bytes = b"") -> bytes:
     """Read, verify trailer CRC, decompress."""
     buf = rfile.read(handle.offset, handle.size + BLOCK_TRAILER_SIZE)
     if len(buf) != handle.size + BLOCK_TRAILER_SIZE:
@@ -175,4 +213,4 @@ def read_block(rfile, handle: BlockHandle, verify_checksums: bool = True) -> byt
                 f"block checksum mismatch at {handle.offset}: "
                 f"stored {stored:#x} != computed {actual:#x}"
             )
-    return decompress(payload, ctype)
+    return decompress(payload, ctype, dict_)

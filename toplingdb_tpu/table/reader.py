@@ -14,6 +14,7 @@ from toplingdb_tpu.db.dbformat import InternalKeyComparator
 from toplingdb_tpu.table import format as fmt
 from toplingdb_tpu.table.block import BlockIter
 from toplingdb_tpu.table.builder import (
+    METAINDEX_COMPRESSION_DICT,
     METAINDEX_FILTER,
     METAINDEX_PROPERTIES,
     METAINDEX_RANGE_DEL,
@@ -77,6 +78,14 @@ class TableReader:
         if rh is not None:
             self._range_del_data = fmt.read_block(rfile, rh, self.opts.verify_checksums)
 
+        # ZSTD dictionary the data blocks were compressed with (reference
+        # kCompressionDictBlockName / UncompressionDict).
+        self._compression_dict = b""
+        dh = self._meta_handles.get(METAINDEX_COMPRESSION_DICT)
+        if dh is not None:
+            self._compression_dict = fmt.read_block(
+                rfile, dh, self.opts.verify_checksums)
+
         # Partitioned index: _index_data is the small top-level index; the
         # partition blocks load lazily through the block cache (reference
         # partitioned index readers, table/block_based/partitioned_index_*).
@@ -109,10 +118,12 @@ class TableReader:
             data = self._cache.lookup(ckey)
             if data is not None:
                 return data
-            data = fmt.read_block(self._f, handle, self.opts.verify_checksums)
+            data = fmt.read_block(self._f, handle, self.opts.verify_checksums,
+                                  self._compression_dict)
             self._cache.insert(ckey, data, len(data))
             return data
-        return fmt.read_block(self._f, handle, self.opts.verify_checksums)
+        return fmt.read_block(self._f, handle, self.opts.verify_checksums,
+                              self._compression_dict)
 
     def new_iterator(self) -> "TableIterator":
         return TableIterator(self)
