@@ -108,10 +108,12 @@ def main():
     if device in ("tpu", "cpu-jax"):
         from toplingdb_tpu.utils.backend_probe import ensure_reachable_backend
 
-        probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
-        print(f"probing jax backend ({probe_s:.0f}s budget)...",
+        probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+        probe_tries = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+        print(f"probing jax backend ({probe_tries}x{probe_s:.0f}s budget)...",
               file=sys.stderr, flush=True)
-        if not ensure_reachable_backend(probe_s):
+        if not ensure_reachable_backend(probe_s, attempts=probe_tries,
+                                        backoff_s=30.0):
             # Unreachable accelerator (process now on the cpu backend):
             # run the same data plane through the byte-parity host twins
             # and SAY SO rather than hang with no output.

@@ -187,3 +187,85 @@ def test_options_compression_for_level():
                  bottommost_compression=fmt.ZSTD_COMPRESSION)
     assert o2.compression_for_level(3) == fmt.SNAPPY_COMPRESSION
     assert o2.compression_for_level(6, bottommost=True) == fmt.ZSTD_COMPRESSION
+
+
+def test_dict_training_failure_disables_dict(tmp_path, monkeypatch):
+    """ADVICE r2 (high): a failed ZDICT training returns b"" — the same
+    value as the 'training pending' sentinel. The replay must DISABLE the
+    dict and still write every block (before the fix: the columnar writer
+    silently dropped all buffered blocks / recursed; the TableBuilder mixed
+    incremental and deferred index entries out of order)."""
+    import numpy as np
+
+    from toplingdb_tpu.ops.columnar_io import (ColumnarKV,
+                                               write_tables_columnar)
+    from toplingdb_tpu.utils import codecs
+
+    monkeypatch.setattr(codecs, "zstd_train_dictionary",
+                        lambda samples, cap: b"")
+    env = default_env()
+    icmp = InternalKeyComparator()
+    opts = TableOptions(
+        compression=fmt.ZSTD_COMPRESSION, block_size=512,
+        compression_opts=CompressionOptions(
+            max_dict_bytes=4096, zstd_max_train_bytes=1 << 16),
+    )
+
+    # --- TableBuilder path ---
+    p = str(tmp_path / "tb.sst")
+    w = env.new_writable_file(p)
+    b = TableBuilder(w, icmp, opts)
+    for i in range(4000):
+        b.add(make_internal_key(b"key%06d" % i, i + 1, ValueType.VALUE),
+              b"val-%06d-padding-padding" % i)
+    props = b.finish()
+    w.close()
+    assert props.num_data_blocks > 0
+    r = TableReader(env.new_random_access_file(p), icmp, opts)
+    assert not r._compression_dict
+    it = r.new_iterator()
+    it.seek_to_first()
+    got = list(it.entries())
+    assert len(got) == 4000
+    assert got[250][1] == b"val-000250-padding-padding"
+    # index order intact: a cold point-seek must land correctly
+    it2 = r.new_iterator()
+    it2.seek(make_internal_key(b"key003500", 1 << 50, ValueType.VALUE))
+    assert it2.valid()
+
+    # --- columnar writer path (the silent-data-loss repro shape) ---
+    n = 200
+    keys = np.frombuffer(
+        b"".join(make_internal_key(b"ck%06d" % i, i + 1, ValueType.VALUE)
+                 for i in range(n)), dtype=np.uint8).copy()
+    vals = np.frombuffer(
+        b"".join(b"columnar-value-%06d" % i for i in range(n)),
+        dtype=np.uint8).copy()
+    kv = ColumnarKV(
+        keys, np.arange(n, dtype=np.int32) * 16,
+        np.full(n, 16, dtype=np.int32),
+        vals, np.arange(n, dtype=np.int32) * 21,
+        np.full(n, 21, dtype=np.int32),
+    )
+    counter = [77]
+
+    def alloc():
+        counter[0] += 1
+        return counter[0]
+
+    files = write_tables_columnar(
+        env, str(tmp_path), alloc, icmp, opts, kv,
+        np.arange(n, dtype=np.int32), np.full(n, -1, dtype=np.int64),
+        np.full(n, int(ValueType.VALUE), dtype=np.int32),
+        np.arange(1, n + 1, dtype=np.uint64), [], creation_time=1,
+    )
+    assert len(files) == 1
+    _fnum, path, cprops, _s, _l, _sel = files[0]
+    assert cprops.num_entries == n
+    assert cprops.num_data_blocks > 0  # was 0 before the fix (data loss)
+    rr = TableReader(env.new_random_access_file(path), icmp, opts)
+    it3 = rr.new_iterator()
+    it3.seek_to_first()
+    got3 = list(it3.entries())
+    assert len(got3) == n
+    assert got3[42][1] == b"columnar-value-000042"

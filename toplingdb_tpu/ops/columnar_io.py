@@ -305,6 +305,11 @@ class _ColumnarSST:
             [r for r, _f, _l, _n in self._dict_samples],
             self._copts.max_dict_bytes,
         )
+        if self._dict == b"":
+            # Training failed (ZDICT needs enough distinct samples). b"" is
+            # the 'training pending' sentinel, so leaving it would make the
+            # replay below re-buffer forever; disable the dict instead.
+            self._dict = None
         samples, self._dict_samples, self._dict_bytes = \
             self._dict_samples, [], 0
         for raw, first, last, n in samples:

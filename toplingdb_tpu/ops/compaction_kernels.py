@@ -482,18 +482,20 @@ MAX_SHARD_ROWS = 1 << 22
 
 
 @functools.partial(
-    jax.jit, static_argnames=("ns", "num_key_words", "uk_len", "bottommost")
+    jax.jit, static_argnames=("num_key_words", "uk_len", "bottommost")
 )
-def _fused_uniform_shard_impl(ukb, pkb, min_his, min_los,
-                              snap_hi, snap_lo, ns, num_key_words, uk_len,
+def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
+                              snap_hi, snap_lo, total, num_key_words, uk_len,
                               bottommost):
     """ONE range-shard's encode+sort+GC over ONE uploaded buffer pair:
     `ukb` = trailer-stripped user-key bytes of every chunk packed
     contiguously (padded rows zero), `pkb` = one uint32 per row
-    ((seq - chunk_min_seq) << 8 | vtype, deltas < 2^24). Chunk row counts
-    `ns` are static, so per-chunk seqno reconstruction is static slicing —
-    no per-chunk device buffers, TWO host→device transfers per shard
-    total. The result is (packed_bytes u8[3p], meta i32[2]): three
+    ((seq - chunk_min_seq) << 8 | vtype, deltas < 2^24). Chunk row starts
+    arrive as a small DEVICE array `starts` (pow2-padded with sentinel
+    2^31-1), so per-row chunk ids come from one searchsorted and the jit
+    cache keys only on pow2-padded shapes — arbitrary chunk-size tuples
+    reuse one compilation. TWO bulk host→device transfers per shard.
+    The result is (packed_bytes u8[3p], meta i32[2]): three
     byte-planes of the 24-bit survivor row ids (bit 23 = zero-seq flag) —
      3/4 the download of int32 orders — plus [count, has_complex].
     Tombstone-free jobs only."""
@@ -503,7 +505,6 @@ def _fused_uniform_shard_impl(ukb, pkb, min_his, min_los,
     i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
     span = num_key_words * 4
     p = pkb.shape[0]
-    total = int(sum(ns))
     iota = jnp.arange(p, dtype=jnp.int32)
     valid = iota < total
 
@@ -517,32 +518,22 @@ def _fused_uniform_shard_impl(ukb, pkb, min_his, min_los,
     )
     key_words = jnp.where(valid[:, None], i32(words ^ sign), int32max)
 
-    # Reconstruct full 64-bit packed trailers (seq<<8|type) chunk by chunk
-    # (static bounds): deltas from different chunks are not comparable,
-    # the absolute words are.
-    ih_p, il_p, vt_p = [], [], []
-    start = 0
-    for j, n_j in enumerate(ns):
-        pk = jax.lax.slice_in_dim(pkb, start, start + n_j)
-        rel = pk >> 8
-        seq_lo = min_los[j] + rel
-        carry = (seq_lo < min_los[j]).astype(u32)
-        seq_hi = min_his[j] + carry
-        vt = pk & u32(0xFF)
-        packed_hi = (seq_hi << 8) | (seq_lo >> 24)
-        packed_lo = (seq_lo << 8) | vt
-        ih_p.append(i32(~packed_hi ^ sign))
-        il_p.append(i32(~packed_lo ^ sign))
-        vt_p.append(vt.astype(jnp.int32))
-        start += n_j
-    pad_rows = p - total
-    if pad_rows:
-        ih_p.append(jnp.full(pad_rows, int32max, jnp.int32))
-        il_p.append(jnp.full(pad_rows, int32max, jnp.int32))
-        vt_p.append(jnp.full(pad_rows, -1, jnp.int32))
-    inv_hi = jnp.concatenate(ih_p) if len(ih_p) > 1 else ih_p[0]
-    inv_lo = jnp.concatenate(il_p) if len(il_p) > 1 else il_p[0]
-    vtype = jnp.concatenate(vt_p) if len(vt_p) > 1 else vt_p[0]
+    # Reconstruct full 64-bit packed trailers (seq<<8|type): per-row chunk
+    # id via searchsorted over the chunk starts, then add that chunk's
+    # 64-bit min seqno to the 24-bit delta. Deltas from different chunks
+    # are not comparable; the absolute words are.
+    cid = jnp.searchsorted(starts, iota, side="right") - 1
+    rel = pkb >> 8
+    mlo = min_los[cid]
+    seq_lo = mlo + rel
+    carry = (seq_lo < mlo).astype(u32)
+    seq_hi = min_his[cid] + carry
+    vt = pkb & u32(0xFF)
+    packed_hi = (seq_hi << 8) | (seq_lo >> 24)
+    packed_lo = (seq_lo << 8) | vt
+    inv_hi = jnp.where(valid, i32(~packed_hi ^ sign), int32max)
+    inv_lo = jnp.where(valid, i32(~packed_lo ^ sign), int32max)
+    vtype = jnp.where(valid, vt.astype(jnp.int32), -1)
     key_len = jnp.where(valid, jnp.int32(uk_len), int32max)
 
     kw, kl, ih, il, vt, perm = _sort_impl(
@@ -614,10 +605,19 @@ def upload_uniform_shard(chunks):
         pkb[pos:pos + n] = pk32
         pos += n
     mins = np.array([c[2] for c in chunks], dtype=np.uint64)
+    # Chunk starts + per-chunk min seqnos, pow2-padded so the jit cache
+    # keys on O(log nchunks) shapes instead of every (n0, n1, ...) tuple.
+    nc = _next_pow2(max(1, len(ns)))
+    starts = np.full(nc, 2**31 - 1, dtype=np.int32)
+    starts[: len(ns)] = np.cumsum([0] + list(ns[:-1]), dtype=np.int64)
+    min_his = np.zeros(nc, dtype=np.uint32)
+    min_los = np.zeros(nc, dtype=np.uint32)
+    min_his[: len(ns)] = (mins >> np.uint64(32)).astype(np.uint32)
+    min_los[: len(ns)] = (mins & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     return (
-        jax.device_put(ukb), jax.device_put(pkb), ns,
-        (mins >> np.uint64(32)).astype(np.uint32),
-        (mins & np.uint64(0xFFFFFFFF)).astype(np.uint32), uk_len,
+        jax.device_put(ukb), jax.device_put(pkb), total,
+        jax.device_put(starts), jax.device_put(min_his),
+        jax.device_put(min_los), uk_len,
     )
 
 
@@ -629,12 +629,12 @@ def fused_uniform_shard_start(handle, snapshots: list[int], bottommost: bool):
         raise NotSupported(
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
         )
-    ukb, pkb, ns, min_his, min_los, uk_len = handle
+    ukb, pkb, total, starts, min_his, min_los, uk_len = handle
     snap_hi, snap_lo = _split_snapshots(snapshots)
     w = (max(uk_len, 4) + 3) // 4
     out = _fused_uniform_shard_impl(
-        ukb, pkb, min_his, min_los, snap_hi, snap_lo,
-        ns, w, uk_len, bool(bottommost),
+        ukb, pkb, starts, min_his, min_los, snap_hi, snap_lo,
+        np.int32(total), w, uk_len, bool(bottommost),
     )
     for a in out:
         if hasattr(a, "copy_to_host_async"):

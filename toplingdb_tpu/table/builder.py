@@ -160,6 +160,7 @@ class TableBuilder:
         )
         self._dict_samples: list = []   # (raw, first_key, last_key)
         self._dict_sample_bytes = 0
+        self._force_deferred = False    # set when dict training fails
 
     # ------------------------------------------------------------------
 
@@ -264,7 +265,8 @@ class TableBuilder:
             if (self._dict_sample_bytes
                     >= self.opts.compression_opts.train_budget()):
                 self._train_dict_and_flush()
-        elif self._par_pool is not None or self._dict is not None:
+        elif (self._par_pool is not None or self._dict is not None
+                or self._force_deferred):
             self._emit_deferred(raw, self._block_first_key, self._last_key)
         else:
             self._pending_handle = fmt.write_block(
@@ -303,6 +305,12 @@ class TableBuilder:
             [r for r, _, _ in self._dict_samples],
             self.opts.compression_opts.max_dict_bytes,
         )
+        if self._dict == b"":
+            # Training failed: disable the dict (don't re-buffer), but stay
+            # in deferred-emission mode so index entries keep accumulating
+            # in _par_meta in file order with the replayed blocks below.
+            self._dict = None
+            self._force_deferred = True
         for raw, first, last in self._dict_samples:
             self._emit_deferred(raw, first, last)
         self._dict_samples = []

@@ -53,13 +53,21 @@ def redirect_to_cpu_backend() -> None:
             pass
 
 
-def ensure_reachable_backend(timeout_s: float = 120.0) -> bool:
+def ensure_reachable_backend(timeout_s: float = 120.0,
+                             attempts: int = 1,
+                             backoff_s: float = 30.0) -> bool:
     """Returns True when the configured accelerator is reachable (or no
     accelerator is configured); on False the process has been redirected to
-    the cpu backend."""
+    the cpu backend. `attempts` > 1 retries with `backoff_s` sleeps so one
+    transient tunnel outage doesn't decide an entire bench run."""
+    import time
+
     if os.environ.get("JAX_PLATFORMS") != "axon":
         return True
-    if probe_jax_backend(timeout_s):
-        return True
+    for i in range(max(1, attempts)):
+        if i:
+            time.sleep(backoff_s)
+        if probe_jax_backend(timeout_s):
+            return True
     redirect_to_cpu_backend()
     return False

@@ -197,6 +197,13 @@ def zstd_decompress(data: bytes, dict_: bytes = b"") -> bytes:
     size = lib.ZSTD_getFrameContentSize(data, len(data))
     if size in (2 ** 64 - 1, 2 ** 64 - 2):  # ERROR / UNKNOWN
         raise Corruption("corrupt zstd block header")
+    # The content size is untrusted frame-header bytes: bound it before
+    # allocating (a crafted block can claim ~2^64 and OOM the process).
+    # The floor must admit any block a builder can legitimately write —
+    # a single huge RLE-friendly value can compress >100000x — so only
+    # reject sizes beyond a 4 GiB absolute ceiling.
+    if size > max(1 << 32, 1000 * len(data)):
+        raise Corruption("zstd block claims implausible content size")
     out = ctypes.create_string_buffer(max(1, size))
     if dict_:
         dctx = lib.ZSTD_createDCtx()
