@@ -47,10 +47,13 @@ class _Writer:
     """One queued write (reference WriteThread::Writer, db/write_thread.h:32).
 
     Lifecycle: enqueued → either becomes the group leader (front of queue) or
-    blocks on its event until a leader commits it (done=True) or promotes it
-    to lead the next group (done=False)."""
+    blocks on its event until a leader commits it (done=True), promotes it
+    to lead the next group (done=False), or drafts it into a parallel
+    memtable phase (parallel=True — the reference's
+    STATE_PARALLEL_MEMTABLE_WRITER)."""
 
-    __slots__ = ("batch", "opts", "done", "error", "event", "on_sequenced")
+    __slots__ = ("batch", "opts", "done", "error", "event", "on_sequenced",
+                 "parallel", "pg", "pg_mems")
 
     def __init__(self, batch: WriteBatch, opts: WriteOptions,
                  on_sequenced=None):
@@ -64,6 +67,31 @@ class _Writer:
         # the WritePrepared policy registers its undecided seqno range here
         # so no reader can ever observe the data unexcluded.
         self.on_sequenced = on_sequenced
+        self.parallel = False          # drafted into parallel memtable phase
+        self.pg = None                 # _InsertBarrier of the phase
+        self.pg_mems = None            # {cf_id: MemTable} snapshot to insert
+
+
+class _InsertBarrier:
+    """Completion barrier for one group's parallel memtable phase
+    (reference WriteThread::LaunchParallelMemTableWriters /
+    CompleteParallelMemTableWriter)."""
+
+    __slots__ = ("remaining", "all_done", "error", "lock")
+
+    def __init__(self, n: int):
+        self.remaining = n
+        self.all_done = threading.Event()
+        self.error: BaseException | None = None
+        self.lock = threading.Lock()
+
+    def member_done(self, err: BaseException | None = None) -> None:
+        with self.lock:
+            if err is not None and self.error is None:
+                self.error = err
+            self.remaining -= 1
+            if self.remaining == 0:
+                self.all_done.set()
 
 
 class ColumnFamilyHandle:
@@ -146,6 +174,17 @@ class DB:
         self._mutex = threading.RLock()
         self._writers: list[_Writer] = []  # FIFO write queue (leader = [0])
         self._wq_lock = threading.Lock()
+        # Staged write modes (pipelined/unordered): seqno ALLOCATION runs
+        # ahead of PUBLICATION. _alloc_ranges holds (first,last) of groups
+        # whose memtable phase is still in flight, in allocation order;
+        # completions mark themselves in _complete_firsts and last_sequence
+        # advances as a low watermark. _mt_cv (on _mutex) signals completion
+        # to memtable-switch / snapshot / close waiters.
+        self._mt_cv = threading.Condition(self._mutex)
+        self._mt_inflight = 0
+        self._seq_alloc = 0
+        self._alloc_ranges: list[tuple[int, int]] = []
+        self._complete_firsts: set[int] = set()
         self._wal: LogWriter | None = None
         self._wal_number = 0
         self._closed = False
@@ -400,6 +439,11 @@ class DB:
         with self._mutex:
             if self._closed:
                 return
+            # Drain staged (pipelined/unordered) memtable phases before
+            # flushing — their entries are WAL-durable but must land in the
+            # memtables for the final flush to carry them.
+            while self._mt_inflight > 0:
+                self._mt_cv.wait(timeout=10.0)
             if any(not c.mem.empty() or c.imm for c in self._cfs.values()):
                 self.flush(FlushOptions())
             if self._wal is not None:
@@ -534,6 +578,16 @@ class DB:
                     # slot MUST still resolve — abandoning it would deadlock
                     # every later writer behind a never-driven leader.
                     interrupted = e
+            if w.parallel:
+                # Drafted into the group's parallel memtable phase: insert
+                # our own batch (GIL-free native path), then wait for the
+                # leader to publish (reference parallel memtable writers).
+                interrupted = self._parallel_member(w) or interrupted
+                if interrupted is not None:
+                    raise interrupted
+                if w.error is not None:
+                    raise w.error
+                return
             if w.done:
                 if interrupted is not None:
                     raise interrupted
@@ -547,10 +601,29 @@ class DB:
             return
         self._lead_write_group(w)
 
-    def _lead_write_group(self, leader: _Writer) -> None:
-        # Snapshot the group: leader + queued followers with the same WAL
-        # disposition, capped in bytes so a giant group can't starve later
-        # writers' latency (reference WriteThread::EnterAsBatchGroupLeader).
+    def _parallel_member(self, w: _Writer) -> BaseException | None:
+        """Follower half of a parallel memtable phase: insert own batch,
+        report to the barrier, block until the leader completes the group.
+        Returns an async interrupt caught mid-wait (re-raised by write())."""
+        w.event.clear()
+        err: BaseException | None = None
+        try:
+            w.batch.insert_into(w.pg_mems)
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        w.pg.member_done(err)
+        interrupted: BaseException | None = None
+        while True:
+            try:
+                w.event.wait()
+                return interrupted
+            except BaseException as e:  # noqa: BLE001
+                interrupted = e  # leader WILL complete us; keep the slot
+
+    def _snapshot_group(self, leader: _Writer) -> list[_Writer]:
+        # Leader + queued followers with the same WAL disposition, capped in
+        # bytes so a giant group can't starve later writers' latency
+        # (reference WriteThread::EnterAsBatchGroupLeader).
         with self._wq_lock:
             group = [leader]
             size = leader.batch.data_size()
@@ -561,6 +634,13 @@ class DB:
                 if size > _MAX_WRITE_GROUP_BYTES:
                     break
                 group.append(w)
+        return group
+
+    def _lead_write_group(self, leader: _Writer) -> None:
+        group = self._snapshot_group(leader)
+        if self.options.unordered_write or self.options.enable_pipelined_write:
+            self._lead_write_group_staged(leader, group)
+            return
         err: BaseException | None = None
         try:
             self._commit_write_group(group)
@@ -579,6 +659,163 @@ class DB:
         if err is not None:
             raise err
 
+    def _lead_write_group_staged(self, leader: _Writer,
+                                 group: list[_Writer]) -> None:
+        """Pipelined / unordered write path (reference PipelinedWriteImpl
+        db_impl_write.cc:657 and WriteImplWALOnly :267-301): the WAL stage
+        runs under _mutex, then the NEXT group's leader is woken — its WAL
+        append overlaps this group's memtable inserts. Publication advances
+        as an in-order low watermark over completed groups."""
+        err: BaseException | None = None
+        first = last = 0
+        mems: dict | None = None
+        try:
+            with self._mutex:
+                self._check_open()
+                if self._bg_error is not None:
+                    from toplingdb_tpu.utils.status import Severity as _Sev
+
+                    if self._bg_error_severity >= _Sev.HARD_ERROR:
+                        raise IOError_(
+                            f"background error pending (call resume()): "
+                            f"{self._bg_error!r}"
+                        )
+                first = max(self._seq_alloc,
+                            self.versions.last_sequence) + 1
+                seq = first
+                for w in group:
+                    w.batch.set_sequence(seq)
+                    seq += w.batch.count()
+                last = seq - 1
+                self._append_group_wal(group, first)
+                mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
+                self._seq_alloc = last
+                self._alloc_ranges.append((first, last))
+                self._mt_inflight += 1
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        # Hand the queue to the next leader NOW (the overlap window).
+        with self._wq_lock:
+            del self._writers[: len(group)]
+            nxt = self._writers[0] if self._writers else None
+        if nxt is not None:
+            nxt.event.set()
+        if err is not None:
+            for w in group:
+                w.done = True
+                w.error = err
+                if w is not leader:
+                    w.event.set()
+            raise err
+        # Memtable phase: unordered mode always fans out (each writer
+        # inserts its own batch, truly parallel via the GIL-free native
+        # inserts); pipelined-only mode fans out when allowed.
+        fan_out = len(group) > 1 and (
+            self.options.unordered_write
+            or self.options.allow_concurrent_memtable_write
+        )
+        if fan_out:
+            pg = _InsertBarrier(len(group))
+            for w in group[1:]:
+                w.pg = pg
+                w.pg_mems = mems
+                w.parallel = True
+                w.event.set()
+            try:
+                leader.batch.insert_into(mems)
+                pg.member_done()
+            except BaseException as e:  # noqa: BLE001
+                pg.member_done(e)
+            pg.all_done.wait()
+            err = pg.error
+        else:
+            try:
+                for w in group:
+                    w.batch.insert_into(mems)
+            except BaseException as e:  # noqa: BLE001
+                err = e
+        self._complete_staged_group(group, first, last, err)
+        if err is not None:
+            raise err
+
+    def _append_group_wal(self, group: list[_Writer], first_seq: int) -> None:
+        """WAL append + durability for one group (caller holds _mutex)."""
+        if self.options.wal_enabled and not group[0].opts.disable_wal:
+            if len(group) == 1:
+                self._wal.add_record(group[0].batch.data())
+            else:
+                merged = WriteBatch()
+                merged.set_sequence(first_seq)
+                for w in group:
+                    merged.append_from(w.batch)
+                self._wal.add_record(merged.data())
+            if any(w.opts.sync for w in group):
+                self._wal.sync()
+            else:
+                self._wal.flush()
+            from toplingdb_tpu.utils.kill_point import test_kill_random
+
+            test_kill_random("DBImpl::WriteImpl:AfterWAL")
+
+    def _complete_staged_group(self, group: list[_Writer], first: int,
+                               last: int, err: BaseException | None) -> None:
+        """Mark one staged group's memtable phase complete, advance the
+        publish watermark in allocation order, and run the post-commit work
+        (stats, flush trigger) when the watermark moved. The group is marked
+        complete even on error — its records are durable in the WAL, and
+        stalling the watermark would deadlock every later write."""
+        with self._mutex:
+            self._mt_inflight -= 1
+            if err is None:
+                for w in group:
+                    if w.on_sequenced is not None:
+                        s0 = w.batch.sequence()
+                        w.on_sequenced(s0, s0 + w.batch.count() - 1)
+            self._complete_firsts.add(first)
+            while (self._alloc_ranges
+                   and self._alloc_ranges[0][0] in self._complete_firsts):
+                f, l = self._alloc_ranges.pop(0)
+                self._complete_firsts.discard(f)
+                self.versions.last_sequence = l
+            if not self._closed:
+                self._post_publish_work(group)
+            self._mt_cv.notify_all()
+        for w in group:
+            w.done = True
+            w.error = err
+            w.parallel = False
+            if w is not group[0]:
+                w.event.set()
+
+    def _post_publish_work(self, group: list[_Writer]) -> None:
+        """Stats + seqno/time sampling + flush trigger after a publish
+        (caller holds _mutex)."""
+        seq_top = self.versions.last_sequence + 1
+        now = time.time()
+        if now - self._last_seqno_time_sample >= \
+                self.options.seqno_time_sample_period_sec:
+            self._last_seqno_time_sample = now
+            self.seqno_to_time.append(seq_top - 1, int(now))
+        if self.stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self.stats.record_tick(
+                st.NUMBER_KEYS_WRITTEN, sum(w.batch.count() for w in group)
+            )
+            self.stats.record_tick(
+                st.BYTES_WRITTEN, sum(w.batch.data_size() for w in group)
+            )
+        total_mem = sum(
+            c.mem.approximate_memory_usage() for c in self._cfs.values()
+        )
+        wbm = self.options.write_buffer_manager
+        self._sync_wbm()
+        if total_mem >= self.options.write_buffer_size or (
+                wbm is not None and wbm.should_flush()
+                and total_mem >= 4096):  # floor: don't thrash tiny DBs
+            self._switch_memtable()
+            self._flush_immutables()
+
     def _commit_write_group(self, group: list[_Writer]) -> None:
         with self._mutex:
             self._check_open()
@@ -590,30 +827,41 @@ class DB:
                         f"background error pending (call resume()): "
                         f"{self._bg_error!r}"
                     )
-            first_seq = self.versions.last_sequence + 1
+            first_seq = max(self._seq_alloc, self.versions.last_sequence) + 1
             seq = first_seq
             for w in group:
                 w.batch.set_sequence(seq)
                 seq += w.batch.count()
-            if self.options.wal_enabled and not group[0].opts.disable_wal:
-                if len(group) == 1:
-                    self._wal.add_record(group[0].batch.data())
-                else:
-                    merged = WriteBatch()
-                    merged.set_sequence(first_seq)
-                    for w in group:
-                        merged.append_from(w.batch)
-                    self._wal.add_record(merged.data())
-                if any(w.opts.sync for w in group):
-                    self._wal.sync()
-                else:
-                    self._wal.flush()
-                from toplingdb_tpu.utils.kill_point import test_kill_random
-
-                test_kill_random("DBImpl::WriteImpl:AfterWAL")
+            self._seq_alloc = seq - 1
+            self._append_group_wal(group, first_seq)
             mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
-            for w in group:
-                w.batch.insert_into(mems)
+            if (self.options.allow_concurrent_memtable_write
+                    and len(group) > 1):
+                # Parallel memtable phase (reference
+                # LaunchParallelMemTableWriters): followers insert their own
+                # batches concurrently — the native skiplist insert is
+                # lock-free and GIL-releasing, so this scales with threads.
+                # The leader holds _mutex throughout, so no memtable switch
+                # can race the phase.
+                pg = _InsertBarrier(len(group))
+                for w in group[1:]:
+                    w.pg = pg
+                    w.pg_mems = mems
+                    w.parallel = True
+                    w.event.set()
+                try:
+                    group[0].batch.insert_into(mems)
+                    pg.member_done()
+                except BaseException as e:  # noqa: BLE001
+                    pg.member_done(e)
+                pg.all_done.wait()
+                for w in group[1:]:
+                    w.parallel = False
+                if pg.error is not None:
+                    raise pg.error
+            else:
+                for w in group:
+                    w.batch.insert_into(mems)
             # on_sequenced fires only after the WAL append + memtable insert
             # succeeded (a failed group must not leak registrations), but
             # BEFORE the group's sequence publishes: entries stay invisible
@@ -673,6 +921,12 @@ class DB:
         behavior so log_number can advance safely)."""
         from toplingdb_tpu.utils.kill_point import test_kill_random
 
+        # Staged groups insert into the active memtables OUTSIDE _mutex
+        # (pipelined/unordered modes): sealing a memtable mid-insert could
+        # let the flush miss an already-published entry. Drain them first
+        # (reference WriteThread::WaitForMemTableWriters).
+        while self._mt_inflight > 0:
+            self._mt_cv.wait(timeout=10.0)
         test_kill_random("DBImpl::SwitchMemtable:Start")
         if self._wal is not None:
             self._wal.sync()
@@ -1208,6 +1462,15 @@ class DB:
             raise
 
     def get_snapshot(self):
+        if self.options.unordered_write:
+            # Unordered writes publish out of allocation order: drain the
+            # in-flight memtable phases that were allocated before now, so
+            # the snapshot sees a prefix-consistent sequence history
+            # (reference DBImpl::GetSnapshotImpl -> WaitForPendingWrites).
+            with self._mutex:
+                target = self._seq_alloc
+                while self.versions.last_sequence < target:
+                    self._mt_cv.wait(timeout=10.0)
         fn = self._undecided_provider
         return self.snapshots.new_snapshot(
             self.versions.last_sequence,

@@ -92,6 +92,32 @@ class NativeSkipListRep(MemTableRep):
             self._h, uk, len(uk), inv, value, len(value)
         )
 
+    def insert_batch(self, keybuf, key_offs, key_lens, invs,
+                     valbuf, val_offs, val_lens, n: int) -> None:
+        """Bulk insert from flat numpy buffers — ONE ctypes call with the
+        GIL released for the whole loop (the native skiplist insert is
+        lock-free, reference InsertConcurrently), so concurrent writer
+        threads run truly in parallel."""
+        from toplingdb_tpu import native
+
+        cl = native.lib()  # CDLL: releases the GIL during the call
+        if cl is None or not hasattr(cl, "tpulsm_skiplist_insert_batch"):
+            for i in range(n):
+                o, ln = key_offs[i], key_lens[i]
+                vo, vl = val_offs[i], val_lens[i]
+                self.insert((keybuf[o:o + ln].tobytes(), int(invs[i])),
+                            valbuf[vo:vo + vl].tobytes())
+            return
+        import ctypes
+
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        cl.tpulsm_skiplist_insert_batch(
+            self._h, native.np_u8p(keybuf), native.np_i64p(key_offs),
+            native.np_i32p(key_lens),
+            invs.ctypes.data_as(u64p), native.np_u8p(valbuf),
+            native.np_i64p(val_offs), native.np_i32p(val_lens), n,
+        )
+
     def __len__(self) -> int:
         return self._l.tpulsm_skiplist_count(self._h)
 
@@ -379,6 +405,64 @@ class MemTable:
             self._mem_usage += len(user_key) + len(value) + 24
             if self._first_seqno is None:
                 self._first_seqno = seq
+
+    def add_batch(self, first_seq: int, ops) -> int:
+        """Apply a run of parsed ops [(type, key, value_or_None)] with
+        consecutive seqnos starting at first_seq (reference
+        WriteBatchInternal::InsertInto driving InsertConcurrently). With the
+        native skiplist rep the point inserts happen in ONE GIL-releasing
+        native call; thread-safe against concurrent add/add_batch callers.
+        Returns the number of sequence numbers consumed (== len(ops))."""
+        n = len(ops)
+        rep_batch = getattr(self._rep, "insert_batch", None)
+        if rep_batch is None or n < 4:
+            for i, (t, k, v) in enumerate(ops):
+                self.add(first_seq + i, t, k, v if v is not None else b"")
+            return n
+        import numpy as np
+
+        points = []   # (seq, t, k, v) point ops, in order
+        mem_delta = 0
+        deletes = 0
+        with self._lock:
+            for i, (t, k, v) in enumerate(ops):
+                seq = first_seq + i
+                v = v if v is not None else b""
+                if t == ValueType.RANGE_DELETION:
+                    if self._icmp.user_comparator.compare(k, v) >= 0:
+                        continue
+                    self._range_dels.append((seq, k, v))
+                else:
+                    points.append((seq, t, k, v))
+                if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
+                    deletes += 1
+                mem_delta += len(k) + len(v) + 24
+            self._num_entries += n
+            self._num_deletes += deletes
+            self._mem_usage += mem_delta
+            if self._first_seqno is None:
+                self._first_seqno = first_seq
+        if not points:
+            return n
+        m = len(points)
+        key_lens = np.fromiter((len(p[2]) for p in points), np.int32, m)
+        val_lens = np.fromiter((len(p[3]) for p in points), np.int32, m)
+        key_offs = np.zeros(m, np.int64)
+        val_offs = np.zeros(m, np.int64)
+        np.cumsum(key_lens[:-1], out=key_offs[1:])
+        np.cumsum(val_lens[:-1], out=val_offs[1:])
+        keybuf = np.frombuffer(
+            b"".join(p[2] for p in points), np.uint8).copy()
+        valbuf = np.frombuffer(
+            b"".join(p[3] for p in points), np.uint8).copy()
+        invs = np.fromiter(
+            (_MAX_PACKED - dbformat.pack_seq_type(p[0], p[1])
+             for p in points), np.uint64, m)
+        # Outside self._lock: the native rep is internally thread-safe, so
+        # concurrent groups' inserts overlap GIL-free.
+        rep_batch(keybuf, key_offs, key_lens, invs,
+                  valbuf, val_offs, val_lens, m)
+        return n
 
     def entries_for_key(self, user_key: bytes, snapshot_seq: int):
         """Yield (seq, type, value) for user_key with seq <= snapshot,

@@ -170,12 +170,25 @@ class WriteBatch:
     def insert_into(self, memtable, sequence: int | None = None) -> int:
         """Apply to one memtable (single-CF) or a {cf_id: memtable} dict;
         returns the number of sequence numbers consumed (== count).
-        Records for CFs absent from the dict are skipped (dropped CF)."""
+        Records for CFs absent from the dict are skipped (dropped CF).
+        Runs of consecutive records for the same memtable go through
+        MemTable.add_batch (one GIL-releasing native call per run)."""
         seq = self.sequence() if sequence is None else sequence
         is_map = isinstance(memtable, dict)
+        run_mem = None
+        run_seq = seq
+        run: list = []
         for cf, t, k, v in self.entries_cf():
             mem = memtable.get(cf) if is_map else memtable
+            if mem is not run_mem:
+                if run:
+                    run_mem.add_batch(run_seq, run)
+                    run = []
+                run_mem = mem
+                run_seq = seq
             if mem is not None:
-                mem.add(seq, t, k, v if v is not None else b"")
+                run.append((t, k, v))
             seq += 1
+        if run and run_mem is not None:
+            run_mem.add_batch(run_seq, run)
         return self.count()

@@ -115,6 +115,18 @@ def lib() -> ctypes.CDLL | None:
             ]
         except AttributeError:
             pass
+        try:
+            # Batch memtable insert on the GIL-RELEASING handle: the whole
+            # loop runs without the GIL (the skiplist insert is lock-free),
+            # so concurrent writer threads scale past the interpreter lock.
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            l.tpulsm_skiplist_insert_batch.restype = ctypes.c_int64
+            l.tpulsm_skiplist_insert_batch.argtypes = [
+                ctypes.c_void_p, u8p, i64p, i32p, u64p,
+                u8p, i64p, i32p, ctypes.c_int64,
+            ]
+        except AttributeError:
+            pass
         _lib = l
         return _lib
 

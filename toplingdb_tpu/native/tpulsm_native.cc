@@ -8,9 +8,11 @@
 // Build: g++ -O3 -shared -fPIC -std=c++17 -pthread \
 //          -o _tpulsm_native.so tpulsm_native.cc
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include <mutex>
 #include <numeric>
 #include <thread>
 #include <vector>
@@ -684,7 +686,13 @@ void tpulsm_bloom_build(
 // in Python is MemTableRep — this is its native implementation).
 // Ordering: user_key bytewise ascending, then inv_packed (u64) ascending
 // (inv = ~(seq<<8|type), so newer versions sort first).
-// Called under the Python GIL via ctypes.PyDLL: single-writer semantics.
+//
+// Concurrency: inserts are LOCK-FREE (CAS splice per level, the reference's
+// InsertConcurrently shape, memtable/inlineskiplist.h:61) and the batch
+// entry point is called WITHOUT the GIL (ctypes.CDLL), so multiple Python
+// writer threads insert in parallel. Readers (ctypes.PyDLL, under the GIL)
+// traverse acquire-loaded next pointers of fully-initialized nodes — safe
+// against concurrent writers with no reader-side locking.
 // ---------------------------------------------------------------------------
 
 namespace {
@@ -692,27 +700,34 @@ namespace {
 struct SLNode {
   const uint8_t* key;
   uint32_t key_len;
-  uint32_t val_len;
   uint64_t inv_packed;
-  const uint8_t* val;
+  // Value = pointer to a [u32 len][bytes] arena record; a single atomic so
+  // in-place replace (WAL-replay duplicate) can't tear against readers.
+  std::atomic<const uint8_t*> val;
   int height;
-  SLNode* next[1];  // variable length
+  std::atomic<SLNode*> next[1];  // variable length
+
+  SLNode* nxt(int level, std::memory_order o = std::memory_order_acquire) {
+    return next[level].load(o);
+  }
 };
 
 struct Arena {
   std::vector<uint8_t*> blocks;
   size_t used = 0;
   size_t cap = 0;
-  size_t total = 0;
+  std::atomic<size_t> total{0};
+  std::mutex mu;
 
   uint8_t* alloc(size_t n) {
     n = (n + 7) & ~size_t(7);
+    std::lock_guard<std::mutex> g(mu);
     if (used + n > cap) {
       size_t bs = n > (1u << 20) ? n : (1u << 20);
       blocks.push_back(new uint8_t[bs]);
       used = 0;
       cap = bs;
-      total += bs;
+      total.fetch_add(bs, std::memory_order_relaxed);
     }
     uint8_t* p = blocks.back() + used;
     used += n;
@@ -725,28 +740,34 @@ struct Arena {
 
 static const int kMaxHeight = 12;
 
+static uint64_t random_height_seed() {
+  static std::atomic<uint64_t> c{0x9E3779B97F4A7C15ULL};
+  return c.fetch_add(0xBF58476D1CE4E5B9ULL, std::memory_order_relaxed);
+}
+
 struct SkipList {
   Arena arena;
   SLNode* head;
-  int max_height = 1;
-  uint64_t rnd = 0x9E3779B97F4A7C15ULL;
-  int64_t count = 0;
+  std::atomic<int> max_height{1};
+  std::atomic<int64_t> count{0};
 
   SkipList() {
     head = alloc_node(kMaxHeight);
     head->key = nullptr;
     head->key_len = 0;
-    for (int i = 0; i < kMaxHeight; i++) head->next[i] = nullptr;
+    for (int i = 0; i < kMaxHeight; i++)
+      head->next[i].store(nullptr, std::memory_order_relaxed);
   }
 
   SLNode* alloc_node(int height) {
-    size_t sz = sizeof(SLNode) + (height - 1) * sizeof(SLNode*);
+    size_t sz = sizeof(SLNode) + (height - 1) * sizeof(std::atomic<SLNode*>);
     SLNode* n = reinterpret_cast<SLNode*>(arena.alloc(sz));
     n->height = height;
     return n;
   }
 
   int random_height() {
+    thread_local uint64_t rnd = random_height_seed();
     rnd ^= rnd << 13; rnd ^= rnd >> 7; rnd ^= rnd << 17;
     int h = 1;
     uint64_t r = rnd;
@@ -765,54 +786,88 @@ struct SkipList {
     return 0;
   }
 
+  static int cmp_node(SLNode* a, const uint8_t* k, uint32_t kl, uint64_t inv) {
+    return cmp(a->key, a->key_len, a->inv_packed, k, kl, inv);
+  }
+
   // First node with node >= probe; fills prev[] when non-null.
   SLNode* seek_ge(const uint8_t* k, uint32_t kl, uint64_t inv,
                   SLNode** prev) {
     SLNode* x = head;
-    int level = max_height - 1;
+    int level = max_height.load(std::memory_order_acquire) - 1;
     while (true) {
-      SLNode* nxt = x->next[level];
-      bool go_right = nxt && cmp(nxt->key, nxt->key_len, nxt->inv_packed,
-                                 k, kl, inv) < 0;
+      SLNode* nxt_ = x->nxt(level);
+      bool go_right = nxt_ && cmp_node(nxt_, k, kl, inv) < 0;
       if (go_right) {
-        x = nxt;
+        x = nxt_;
       } else {
         if (prev) prev[level] = x;
-        if (level == 0) return nxt;
+        if (level == 0) return nxt_;
         level--;
       }
     }
   }
 
+  static void set_val(SLNode* n, Arena& a, const uint8_t* v, uint32_t vl) {
+    uint8_t* rec = a.alloc(4 + vl);
+    std::memcpy(rec, &vl, 4);
+    if (vl) std::memcpy(rec + 4, v, vl);
+    n->val.store(rec, std::memory_order_release);
+  }
+
   // Returns 1 on fresh insert, 0 on in-place replace of an exact duplicate.
+  // Safe for concurrent callers (CAS splice; duplicates replace the value
+  // atomically — only WAL replay produces them, and that is single-threaded,
+  // but the path is still race-safe).
   int insert(const uint8_t* k, uint32_t kl, uint64_t inv,
              const uint8_t* v, uint32_t vl) {
     SLNode* prev[kMaxHeight];
     for (int i = 0; i < kMaxHeight; i++) prev[i] = head;
     SLNode* ge = seek_ge(k, kl, inv, prev);
-    if (ge && cmp(ge->key, ge->key_len, ge->inv_packed, k, kl, inv) == 0) {
-      uint8_t* vcopy = arena.alloc(vl);
-      std::memcpy(vcopy, v, vl);
-      ge->val = vcopy;
-      ge->val_len = vl;
+    if (ge && cmp_node(ge, k, kl, inv) == 0) {
+      set_val(ge, arena, v, vl);
       return 0;
     }
     int h = random_height();
-    if (h > max_height) max_height = h;
+    int mh = max_height.load(std::memory_order_relaxed);
+    while (h > mh &&
+           !max_height.compare_exchange_weak(mh, h,
+                                             std::memory_order_relaxed)) {
+    }
     SLNode* n = alloc_node(h);
-    uint8_t* kcopy = arena.alloc(kl + vl);
+    uint8_t* kcopy = arena.alloc(kl);
     std::memcpy(kcopy, k, kl);
-    std::memcpy(kcopy + kl, v, vl);
     n->key = kcopy;
     n->key_len = kl;
-    n->val = kcopy + kl;
-    n->val_len = vl;
     n->inv_packed = inv;
+    set_val(n, arena, v, vl);
+    // Splice bottom-up (reference InsertConcurrently): the node becomes
+    // reachable at level 0 first; higher levels are shortcuts. Only level 0
+    // may observe an exact duplicate (n not yet linked there) — at that
+    // point replace-in-place and abandon n entirely.
     for (int i = 0; i < h; i++) {
-      n->next[i] = prev[i]->next[i];
-      prev[i]->next[i] = n;
+      while (true) {
+        // prev[i] may be stale after a lost race: re-walk right as needed.
+        SLNode* p = prev[i];
+        SLNode* nx = p->nxt(i);
+        while (nx && nx != n && cmp_node(nx, k, kl, inv) < 0) {
+          p = nx;
+          nx = p->nxt(i);
+        }
+        if (i == 0 && nx && cmp_node(nx, k, kl, inv) == 0) {
+          // Concurrent/replayed duplicate: last value wins, atomically.
+          set_val(nx, arena, v, vl);
+          return 0;
+        }
+        n->next[i].store(nx, std::memory_order_relaxed);
+        if (p->next[i].compare_exchange_strong(nx, n,
+                                               std::memory_order_release)) {
+          break;
+        }
+        prev[i] = p;  // retry from the rescanned position
+      }
     }
-    count++;
+    count.fetch_add(1, std::memory_order_relaxed);
     return 1;
   }
 };
@@ -828,11 +883,12 @@ int32_t tpulsm_skiplist_insert(void* h, const uint8_t* k, uint32_t kl,
 }
 
 int64_t tpulsm_skiplist_count(void* h) {
-  return static_cast<SkipList*>(h)->count;
+  return static_cast<SkipList*>(h)->count.load(std::memory_order_relaxed);
 }
 
 int64_t tpulsm_skiplist_memory(void* h) {
-  return (int64_t)static_cast<SkipList*>(h)->arena.total;
+  return (int64_t)static_cast<SkipList*>(h)->arena.total.load(
+      std::memory_order_relaxed);
 }
 
 void* tpulsm_skiplist_seek_ge(void* h, const uint8_t* k, uint32_t kl,
@@ -841,11 +897,11 @@ void* tpulsm_skiplist_seek_ge(void* h, const uint8_t* k, uint32_t kl,
 }
 
 void* tpulsm_skiplist_first(void* h) {
-  return static_cast<SkipList*>(h)->head->next[0];
+  return static_cast<SkipList*>(h)->head->nxt(0);
 }
 
 void* tpulsm_skiplist_next(void* node) {
-  return static_cast<SLNode*>(node)->next[0];
+  return static_cast<SLNode*>(node)->nxt(0);
 }
 
 // Last node strictly BEFORE the probe (nullptr if none) — the O(log n)
@@ -862,8 +918,9 @@ void* tpulsm_skiplist_seek_lt(void* h, const uint8_t* k, uint32_t kl,
 void* tpulsm_skiplist_last(void* h) {
   SkipList* sl = static_cast<SkipList*>(h);
   SLNode* x = sl->head;
-  for (int level = sl->max_height - 1; level >= 0; level--) {
-    while (x->next[level]) x = x->next[level];
+  for (int level = sl->max_height.load(std::memory_order_acquire) - 1;
+       level >= 0; level--) {
+    while (x->nxt(level)) x = x->nxt(level);
   }
   return x == sl->head ? nullptr : x;
 }
@@ -874,8 +931,28 @@ void tpulsm_skiplist_node(void* node, const uint8_t** k, uint32_t* kl,
   *k = n->key;
   *kl = n->key_len;
   *inv = n->inv_packed;
-  *v = n->val;
-  *vl = n->val_len;
+  const uint8_t* rec = n->val.load(std::memory_order_acquire);
+  uint32_t len;
+  std::memcpy(&len, rec, 4);
+  *v = rec + 4;
+  *vl = len;
+}
+
+// Batch insert: n entries from flat buffers, ONE ctypes crossing with the
+// GIL released for the whole loop (registered on the CDLL handle). Safe to
+// call from multiple threads concurrently (lock-free splice). Returns the
+// number of FRESH inserts (duplicates replaced in place don't count).
+int64_t tpulsm_skiplist_insert_batch(
+    void* h, const uint8_t* keybuf, const int64_t* key_offs,
+    const int32_t* key_lens, const uint64_t* invs, const uint8_t* valbuf,
+    const int64_t* val_offs, const int32_t* val_lens, int64_t n) {
+  SkipList* sl = static_cast<SkipList*>(h);
+  int64_t fresh = 0;
+  for (int64_t i = 0; i < n; i++) {
+    fresh += sl->insert(keybuf + key_offs[i], (uint32_t)key_lens[i], invs[i],
+                        valbuf + val_offs[i], (uint32_t)val_lens[i]);
+  }
+  return fresh;
 }
 
 }  // extern "C"

@@ -39,6 +39,19 @@ class Options:
     max_write_buffer_number: int = 2
     db_write_buffer_size: int = 0       # 0 = unlimited (WriteBufferManager)
     wal_enabled: bool = True
+    # Group members insert their own batches into the (lock-free native)
+    # memtable in parallel (reference allow_concurrent_memtable_write,
+    # db/db_impl/db_impl_write.cc:550 LaunchParallelMemTableWriters).
+    allow_concurrent_memtable_write: bool = True
+    # Overlap group N+1's WAL append with group N's memtable insert
+    # (reference enable_pipelined_write, db_impl_write.cc:657
+    # PipelinedWriteImpl). Publish order is preserved.
+    enable_pipelined_write: bool = False
+    # Relax write ordering: seqno allocation + WAL stay ordered, memtable
+    # inserts run unordered in each writer's thread; visibility advances as
+    # a low watermark and GetSnapshot drains pending writes (reference
+    # unordered_write, db_impl_write.cc:267-301 WriteImplWALOnly).
+    unordered_write: bool = False
 
     # -- LSM shape ------------------------------------------------------
     num_levels: int = 7
