@@ -392,7 +392,8 @@ def test_host_sort_twin_matches_fused_kernel(seed):
     b = ck.fused_encode_sort_gc_host(kb, ko, kl, mkb, snaps, bottom)
     assert np.array_equal(a[0], b[0]), "survivor order differs"
     assert np.array_equal(a[1], b[1]), "zero flags differ"
-    assert a[2] == b[2], "has_complex differs"
+    assert np.array_equal(a[2], b[2]), "complex flags differ"
+    assert a[3] == b[3], "has_complex differs"
 
 
 @pytest.mark.parametrize("seed", [21, 22, 23])
@@ -428,7 +429,8 @@ def test_host_sort_twin_varlen_keys_and_big_seqnos(seed):
     b = ck.fused_encode_sort_gc_host(kb, ko, kl, mkb, snaps, bottom)
     assert np.array_equal(a[0], b[0])
     assert np.array_equal(a[1], b[1])
-    assert a[2] == b[2]
+    assert np.array_equal(a[2], b[2])
+    assert a[3] == b[3]
 
 
 def test_host_sort_tombstone_path_byte_parity(tmp_path, monkeypatch):
@@ -560,3 +562,127 @@ def test_multi_shard_parity(tmp_path, monkeypatch):
             got = [open(fn.table_file_name(dbdir, m.number), "rb").read()
                    for m in outs[shards]]
             assert got == ref, f"{mode}: shards={shards} bytes differ"
+
+
+@pytest.mark.parametrize("shards", [0, 4])
+def test_device_columnar_complex_tombstones_snapshots(tmp_path, monkeypatch,
+                                                      shards):
+    """The columnar device path (NOT the per-entry fallback) must handle a
+    job with DeleteRange fragments + MERGE/SINGLE_DELETE groups + 200 live
+    snapshots, byte-identical to the CPU path (VERDICT r2 task 2: cover
+    rides the fused kernels, complex groups fold host-side in-stream, the
+    snapshot cap is bucketed past 64)."""
+    import os
+    import struct
+
+    from toplingdb_tpu.compaction.compaction_job import run_compaction_to_tables
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db.dbformat import MAX_SEQUENCE_NUMBER
+    from toplingdb_tpu.db.table_cache import TableCache
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.ops import device_compaction as dc
+    from toplingdb_tpu.ops.device_compaction import run_device_compaction
+    from toplingdb_tpu.table.builder import TableBuilder, TableOptions
+    import toplingdb_tpu.db.filename as fn
+
+    env = default_env()
+    topts = TableOptions(block_size=512)
+    dbdir = str(tmp_path / f"s{shards}")
+    os.makedirs(dbdir)
+    rng = random.Random(77 + shards)
+    if shards:
+        monkeypatch.setattr(dc, "_SHARD_MIN_ROWS", 1)
+        monkeypatch.setenv("TPULSM_DEVICE_SHARDS", str(shards))
+    else:
+        monkeypatch.delenv("TPULSM_DEVICE_SHARDS", raising=False)
+
+    metas = []
+    seq = 1
+    for fnum in (61, 62, 63):
+        entries = []
+        for _ in range(400):
+            k = b"key%05d" % rng.randrange(500)
+            r = rng.random()
+            if r < 0.6:
+                entries.append((make_internal_key(k, seq, ValueType.VALUE),
+                                b"val%06d" % seq))
+            elif r < 0.8:
+                entries.append((make_internal_key(k, seq, ValueType.MERGE),
+                                struct.pack("<Q", seq % 97)))
+            elif r < 0.9:
+                entries.append((make_internal_key(k, seq, ValueType.DELETION),
+                                b""))
+            else:
+                entries.append((make_internal_key(
+                    k, seq, ValueType.SINGLE_DELETION), b""))
+            seq += 1
+        entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))
+        dedup = [e for i, e in enumerate(entries)
+                 if i == 0 or entries[i - 1][0] != e[0]]
+        w = env.new_writable_file(fn.table_file_name(dbdir, fnum))
+        b = TableBuilder(w, ICMP, topts)
+        for k, v in dedup:
+            b.add(k, v)
+        # Two range tombstones per file, written into the range-del block.
+        for _ in range(2):
+            lo = rng.randrange(450)
+            begin = b"key%05d" % lo
+            end = b"key%05d" % (lo + rng.randrange(10, 60))
+            b.add_tombstone(
+                make_internal_key(begin, seq, ValueType.RANGE_DELETION), end)
+            seq += 1
+        props = b.finish()
+        w.close()
+        metas.append(FileMetaData(
+            number=fnum,
+            file_size=env.get_file_size(fn.table_file_name(dbdir, fnum)),
+            smallest=b.smallest_key, largest=b.largest_key,
+            smallest_seqno=props.smallest_seqno,
+            largest_seqno=props.largest_seqno,
+        ))
+    tc = TableCache(env, dbdir, ICMP, topts)
+    snapshots = sorted(rng.sample(range(1, seq), 200))  # > old 64 cap
+    op = UInt64AddOperator()
+
+    def mk(base):
+        s = [base]
+
+        def alloc():
+            s[0] += 1
+            return s[0]
+
+        return alloc
+
+    c1 = Compaction(level=0, output_level=2, inputs=list(metas),
+                    bottommost=True, max_output_file_size=1 << 62)
+    out_cpu, _ = run_compaction_to_tables(
+        env, dbdir, ICMP, c1, tc, topts, snapshots, merge_operator=op,
+        new_file_number=mk(700), creation_time=7,
+    )
+
+    # The per-entry fallback must NOT run: this job must stay columnar.
+    def no_fallback(*a, **k):
+        raise AssertionError("columnar path fell back to per-entry scan")
+
+    monkeypatch.setattr(dc, "collect_raw_entries", no_fallback)
+    c2 = Compaction(level=0, output_level=2, inputs=list(metas),
+                    bottommost=True, max_output_file_size=1 << 62)
+    out_dev, _ = run_device_compaction(
+        env, dbdir, ICMP, c2, tc, topts, snapshots, merge_operator=op,
+        new_file_number=mk(800), creation_time=7, device_name="cpu-jax",
+    )
+    assert len(out_cpu) == len(out_dev) >= 1
+    for mc, md in zip(out_cpu, out_dev):
+        bc = open(fn.table_file_name(dbdir, mc.number), "rb").read()
+        bd = open(fn.table_file_name(dbdir, md.number), "rb").read()
+        assert bc == bd, "complex/tombstone columnar path bytes differ"
+        assert mc.smallest == md.smallest and mc.largest == md.largest
+        assert mc.num_entries == md.num_entries
+
+
+def test_device_columnar_complex_host_twin_parity(tmp_path, monkeypatch):
+    """TPULSM_HOST_SORT=1 twin of the complex/tombstone columnar path."""
+    monkeypatch.setenv("TPULSM_HOST_SORT", "1")
+    test_device_columnar_complex_tombstones_snapshots(
+        tmp_path, monkeypatch, 0)

@@ -27,15 +27,24 @@ from toplingdb_tpu.db.dbformat import ValueType
 from toplingdb_tpu.utils.status import NotSupported
 
 _SIGN = 0x80000000
-MAX_SNAPSHOTS = 64
+# Stripe computation is an [N, S] broadcast compare, linear in the padded
+# snapshot count; pad to pow2 buckets (>=64) so the jit cache stays small
+# and typical jobs pay the 64-wide compare. Above the cap the scheduler
+# falls back to the host path.
+MAX_SNAPSHOTS = 1024
+_MIN_SNAP_BUCKET = 64
 
 
 
 def _split_snapshots(snapshots: list[int]) -> tuple[np.ndarray, np.ndarray]:
-    """Sorted snapshot seqnos padded to MAX_SNAPSHOTS with the 2^56 sentinel,
-    split into (hi, lo) uint32 word arrays for the device kernels."""
+    """Sorted snapshot seqnos padded to the next pow2 bucket (>=64) with the
+    2^56 sentinel, split into (hi, lo) uint32 word arrays for the device
+    kernels."""
     pad_snap = 1 << 56
-    snaps = sorted(snapshots) + [pad_snap] * (MAX_SNAPSHOTS - len(snapshots))
+    bucket = _MIN_SNAP_BUCKET
+    while bucket < len(snapshots):
+        bucket *= 2
+    snaps = sorted(snapshots) + [pad_snap] * (bucket - len(snapshots))
     snap_hi = np.array([x >> 32 for x in snaps], dtype=np.uint32)
     snap_lo = np.array([x & 0xFFFFFFFF for x in snaps], dtype=np.uint32)
     return snap_hi, snap_lo
@@ -189,33 +198,45 @@ def _gc_mask_impl(key_words, key_len, inv_hi, inv_lo, vtype,
 
 
 def _sort_gc_compact_tail(key_words, key_len, inv_hi, inv_lo, vtype,
-                          snap_hi, snap_lo, num_key_words, bottommost):
-    """Traced tail shared by the fused kernels: sort → GC mask (no
-    tombstones) → survivors compacted to the front in sorted order."""
+                          snap_hi, snap_lo, num_key_words, bottommost,
+                          tomb_hi_orig=None, tomb_lo_orig=None):
+    """Traced tail shared by the fused kernels: sort → GC mask → survivors
+    compacted to the front in sorted order. Rows of complex groups (MERGE /
+    SINGLE_DELETE present) are INCLUDED in the output stream, flagged via
+    cx_flags, so the host can fold them without abandoning the columnar
+    path. tomb_*_orig: per-ORIGINAL-index max covering tombstone seqno
+    words (None = tombstone-free job)."""
     n = key_words.shape[0]
     idxs = jnp.arange(n, dtype=jnp.int32)
     kw, kl, ih, il, vt, perm = _sort_impl(
         key_words, key_len, inv_hi, inv_lo, vtype, idxs, num_key_words
     )
-    zeros = jnp.zeros(n, dtype=jnp.uint32)
+    if tomb_hi_orig is None:
+        tomb_hi = tomb_lo = jnp.zeros(n, dtype=jnp.uint32)
+    else:
+        tomb_hi = tomb_hi_orig[perm]
+        tomb_lo = tomb_lo_orig[perm]
     keep, zero_seq, host_resolve, _ = _gc_mask_impl(
-        kw, kl, ih, il, vt, snap_hi, snap_lo, zeros, zeros,
+        kw, kl, ih, il, vt, snap_hi, snap_lo, tomb_hi, tomb_lo,
         num_key_words, bottommost,
     )
-    take = jnp.argsort(~keep, stable=True)
+    out = keep | host_resolve
+    take = jnp.argsort(~out, stable=True)
     order = perm[take]
     zero_flags = zero_seq[take]
-    count = jnp.sum(keep.astype(jnp.int32))
+    cx_flags = host_resolve[take]
+    count = jnp.sum(out.astype(jnp.int32))
     has_complex = jnp.any(host_resolve)
-    return order, zero_flags, count, has_complex
+    return order, zero_flags, cx_flags, count, has_complex
 
 
 @functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
 def _fused_sort_gc_impl(key_words, key_len, inv_hi, inv_lo, vtype, idx,
                         snap_hi, snap_lo, num_key_words, bottommost):
     """Sort + GC mask in ONE device program (single host round trip for
-    tombstone-free jobs). Returns (order, zero_flags, count, has_complex):
-    order[i] for i < count = original indices of survivors in output order."""
+    tombstone-free jobs). Returns (order, zero_flags, cx_flags, count,
+    has_complex): order[i] for i < count = original indices of survivors
+    (incl. complex-group rows, flagged) in output order."""
     return _sort_gc_compact_tail(
         key_words, key_len, inv_hi, inv_lo, vtype, snap_hi, snap_lo,
         num_key_words, bottommost,
@@ -224,7 +245,8 @@ def _fused_sort_gc_impl(key_words, key_len, inv_hi, inv_lo, vtype, idx,
 
 def fused_sort_gc(padded: dict, snapshots: list[int], bottommost: bool):
     """Host wrapper for the fused kernel (no range tombstones).
-    Returns (order np[count], zero_flags np[count], has_complex bool)."""
+    Returns (order np[count], zero_flags np[count], cx_flags np[count],
+    has_complex bool)."""
     if len(snapshots) > MAX_SNAPSHOTS:
         raise NotSupported(
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
@@ -232,16 +254,17 @@ def fused_sort_gc(padded: dict, snapshots: list[int], bottommost: bool):
     p = padded["key_words"].shape[0]
     snap_hi, snap_lo = _split_snapshots(snapshots)
     idx = np.arange(p, dtype=np.int32)
-    order, zero_flags, count, has_complex = _fused_sort_gc_impl(
+    order, zero_flags, cx_flags, count, has_complex = _fused_sort_gc_impl(
         padded["key_words"], padded["key_len"], padded["inv_hi"],
         padded["inv_lo"], padded["vtype"], idx, snap_hi, snap_lo,
         padded["w"], bool(bottommost),
     )
-    for a in (order, zero_flags, count, has_complex):
+    for a in (order, zero_flags, cx_flags, count, has_complex):
         if hasattr(a, "copy_to_host_async"):
             a.copy_to_host_async()
     c = int(count)
-    return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)
+    return (np.asarray(order)[:c], np.asarray(zero_flags)[:c],
+            np.asarray(cx_flags)[:c], bool(has_complex))
 
 
 def host_encode_sort(key_buf: np.ndarray, key_offs: np.ndarray,
@@ -354,21 +377,25 @@ def host_gc_mask(new_key, sseq, svt, snapshots, cover, bottommost):
 
 def fused_encode_sort_gc_host(key_buf: np.ndarray, key_offs: np.ndarray,
                               key_lens: np.ndarray, max_key_bytes: int,
-                              snapshots: list[int], bottommost: bool):
-    """Host twin of fused_encode_sort_gc (same 3-tuple contract)."""
+                              snapshots: list[int], bottommost: bool,
+                              cover: np.ndarray | None = None):
+    """Host twin of fused_encode_sort_gc (same 4-tuple contract)."""
     r = host_fused_full(key_buf, key_offs, key_lens, max_key_bytes,
-                        snapshots, bottommost)
-    return r[0], r[1], r[2]
+                        snapshots, bottommost, cover)
+    return r[0], r[1], r[2], r[3]
 
 
 def host_fused_full(key_buf: np.ndarray, key_offs: np.ndarray,
                     key_lens: np.ndarray, max_key_bytes: int,
-                    snapshots: list[int], bottommost: bool):
+                    snapshots: list[int], bottommost: bool,
+                    cover: np.ndarray | None = None):
     """Host twin of the fused kernel for accelerator-less deployments
     (TPULSM_HOST_SORT=1): native/lexsort order + vectorized GC mask —
-    outputs identical to the jax path (parity-tested). Returns
-    (order, zero_flags, has_complex, seq, vtype) with seq/vtype per
-    ORIGINAL index so callers skip their own trailer gather."""
+    outputs identical to the jax path (parity-tested). `cover`: optional
+    per-ORIGINAL-row uint64 max covering tombstone seqno. Returns
+    (order, zero_flags, cx_flags, has_complex, seq, vtype) with seq/vtype
+    per ORIGINAL index so callers skip their own trailer gather; `order`
+    includes complex-group rows, flagged by cx_flags."""
     if len(snapshots) > MAX_SNAPSHOTS:
         raise NotSupported(
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
@@ -376,17 +403,20 @@ def host_fused_full(key_buf: np.ndarray, key_offs: np.ndarray,
     n = len(key_offs)
     if n == 0:
         e = np.empty(0, np.uint64)
-        return (np.empty(0, np.int32), np.empty(0, bool), False,
-                e, e.astype(np.int32))
+        return (np.empty(0, np.int32), np.empty(0, bool),
+                np.empty(0, bool), False, e, e.astype(np.int32))
     s, new_key, seq, vtype = host_sort_with_boundaries(
         key_buf, key_offs, key_lens, max_key_bytes
     )
     keep, zero_seq, host_resolve, _ = host_gc_mask(
-        new_key, seq[s], vtype[s], snapshots, None, bottommost
+        new_key, seq[s], vtype[s], snapshots,
+        None if cover is None else cover[s], bottommost
     )
-    order = s[keep].astype(np.int32)
-    zero_flags = zero_seq[keep]
-    return order, zero_flags, bool(host_resolve.any()), seq, vtype
+    out = keep | host_resolve
+    order = s[out].astype(np.int32)
+    zero_flags = zero_seq[out]
+    cx_flags = host_resolve[out]
+    return order, zero_flags, cx_flags, bool(host_resolve.any()), seq, vtype
 
 
 def host_sort_with_boundaries(key_buf, key_offs, key_lens, max_key_bytes):
@@ -459,13 +489,18 @@ def _encode_from_bytes(key_buf, key_offs, key_lens, valid, num_key_words):
 
 
 
-@functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
-def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
-                               snap_hi, snap_lo, num_key_words, bottommost):
+@functools.partial(
+    jax.jit, static_argnames=("num_key_words", "bottommost", "has_tombs")
+)
+def _fused_encode_sort_gc_impl(key_buf, key_lens, valid, tomb_hi, tomb_lo,
+                               snap_hi, snap_lo, num_key_words, bottommost,
+                               has_tombs):
     """Columnar encode + sort + GC mask, all ON DEVICE: the host uploads raw
     internal-key bytes + lengths only (entries are densely packed, so the
     offsets are an on-device exclusive cumsum) and downloads the survivor
-    order. Tombstone-free jobs only."""
+    order. With has_tombs, tomb_hi/lo carry each original row's max
+    covering range-tombstone seqno words (the host interval-maps the few
+    fragments over the sorted input parts)."""
     key_offs = jnp.cumsum(key_lens) - key_lens  # dense layout: offs from lens
     key_words, key_len, inv_hi, inv_lo, vtype = _encode_from_bytes(
         key_buf, key_offs, key_lens, valid, num_key_words,
@@ -473,20 +508,25 @@ def _fused_encode_sort_gc_impl(key_buf, key_lens, valid,
     return _sort_gc_compact_tail(
         key_words, key_len, inv_hi, inv_lo, vtype, snap_hi, snap_lo,
         num_key_words, bottommost,
+        tomb_hi_orig=tomb_hi if has_tombs else None,
+        tomb_lo_orig=tomb_lo if has_tombs else None,
     )
 
 
 # Per-shard row budget for the 3-byte packed-order download: local row ids
-# must fit 22 bits (bit 23 carries the zero-seq flag, bit 22 is spare).
+# must fit 22 bits (bit 23 carries the zero-seq flag, bit 22 the
+# complex-group flag).
 MAX_SHARD_ROWS = 1 << 22
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_key_words", "uk_len", "bottommost")
+    jax.jit,
+    static_argnames=("num_key_words", "uk_len", "bottommost", "has_tombs"),
 )
 def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
+                              tomb_hi, tomb_lo,
                               snap_hi, snap_lo, total, num_key_words, uk_len,
-                              bottommost):
+                              bottommost, has_tombs):
     """ONE range-shard's encode+sort+GC over ONE uploaded buffer pair:
     `ukb` = trailer-stripped user-key bytes of every chunk packed
     contiguously (padded rows zero), `pkb` = one uint32 per row
@@ -496,9 +536,10 @@ def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
     cache keys only on pow2-padded shapes — arbitrary chunk-size tuples
     reuse one compilation. TWO bulk host→device transfers per shard.
     The result is (packed_bytes u8[3p], meta i32[2]): three
-    byte-planes of the 24-bit survivor row ids (bit 23 = zero-seq flag) —
-     3/4 the download of int32 orders — plus [count, has_complex].
-    Tombstone-free jobs only."""
+    byte-planes of the 24-bit survivor row ids (bit 23 = zero-seq flag,
+    bit 22 = complex-group flag) — 3/4 the download of int32 orders — plus
+    [count, has_complex]. With has_tombs, tomb_hi/lo carry each local row's
+    max covering range-tombstone seqno words."""
     u32 = jnp.uint32
     int32max = jnp.int32(2**31 - 1)
     sign = u32(_SIGN)
@@ -539,15 +580,21 @@ def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
     kw, kl, ih, il, vt, perm = _sort_impl(
         key_words, key_len, inv_hi, inv_lo, vtype, iota, num_key_words,
     )
-    zeros = jnp.zeros(p, dtype=jnp.uint32)
+    if has_tombs:
+        th = tomb_hi[perm]
+        tl = tomb_lo[perm]
+    else:
+        th = tl = jnp.zeros(p, dtype=jnp.uint32)
     keep, zero_seq, host_resolve, _ = _gc_mask_impl(
-        kw, kl, ih, il, vt, snap_hi, snap_lo, zeros, zeros,
+        kw, kl, ih, il, vt, snap_hi, snap_lo, th, tl,
         num_key_words, bottommost,
     )
-    take = jnp.argsort(~keep, stable=True)
+    out = keep | host_resolve
+    take = jnp.argsort(~out, stable=True)
     po = (
         jax.lax.bitcast_convert_type(perm[take], u32)
         | (zero_seq[take].astype(u32) << 23)
+        | (host_resolve[take].astype(u32) << 22)
     )
     packed_bytes = jnp.concatenate([
         (po & u32(0xFF)).astype(jnp.uint8),
@@ -555,7 +602,7 @@ def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
         ((po >> 16) & u32(0xFF)).astype(jnp.uint8),
     ])
     meta = jnp.stack([
-        jnp.sum(keep.astype(jnp.int32)),
+        jnp.sum(out.astype(jnp.int32)),
         jnp.any(host_resolve).astype(jnp.int32),
     ])
     return packed_bytes, meta
@@ -583,12 +630,14 @@ def prepare_uniform_chunk(key_buf: np.ndarray, n: int, key_len: int):
     return (uk, pk32, min_seq, n, uk_len)
 
 
-def upload_uniform_shard(chunks):
+def upload_uniform_shard(chunks, covers=None):
     """Pack one shard's prepared chunks (prepare_uniform_chunk outputs, in
     row order) into ONE key-byte buffer + ONE packed32 buffer, pad rows to
-    the next power of two, and START the two host→device transfers
+    the next power of two, and START the host→device transfers
     (device_put is async). Tunneled rigs pay a fixed ~60ms per transfer
-    regardless of size, so two big transfers beat 2-per-chunk small ones."""
+    regardless of size, so two big transfers beat 2-per-chunk small ones.
+    `covers`: optional per-chunk uint64 max-covering-tombstone arrays
+    (None = tombstone-free); uploaded as two extra u32 planes."""
     uk_len = chunks[0][4]
     ns = tuple(int(c[3]) for c in chunks)
     total = sum(ns)
@@ -599,10 +648,21 @@ def upload_uniform_shard(chunks):
     p = _next_pow2(max(1, total))
     ukb = np.zeros(p * uk_len, dtype=np.uint8)
     pkb = np.zeros(p, dtype=np.uint32)
+    has_tombs = covers is not None and any(
+        c is not None and np.any(c) for c in covers
+    )
+    if has_tombs:
+        tomb_hi = np.zeros(p, dtype=np.uint32)
+        tomb_lo = np.zeros(p, dtype=np.uint32)
     pos = 0
-    for uk, pk32, _mn, n, _l in chunks:
+    for ci, (uk, pk32, _mn, n, _l) in enumerate(chunks):
         ukb[pos * uk_len:(pos + n) * uk_len] = uk
         pkb[pos:pos + n] = pk32
+        if has_tombs and covers[ci] is not None:
+            cv = covers[ci]
+            tomb_hi[pos:pos + n] = (cv >> np.uint64(32)).astype(np.uint32)
+            tomb_lo[pos:pos + n] = (cv & np.uint64(0xFFFFFFFF)).astype(
+                np.uint32)
         pos += n
     mins = np.array([c[2] for c in chunks], dtype=np.uint64)
     # Chunk starts + per-chunk min seqnos, pow2-padded so the jit cache
@@ -614,10 +674,15 @@ def upload_uniform_shard(chunks):
     min_los = np.zeros(nc, dtype=np.uint32)
     min_his[: len(ns)] = (mins >> np.uint64(32)).astype(np.uint32)
     min_los[: len(ns)] = (mins & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    if has_tombs:
+        t_hi = jax.device_put(tomb_hi)
+        t_lo = jax.device_put(tomb_lo)
+    else:
+        t_hi = t_lo = None
     return (
         jax.device_put(ukb), jax.device_put(pkb), total,
         jax.device_put(starts), jax.device_put(min_his),
-        jax.device_put(min_los), uk_len,
+        jax.device_put(min_los), uk_len, t_hi, t_lo,
     )
 
 
@@ -629,12 +694,15 @@ def fused_uniform_shard_start(handle, snapshots: list[int], bottommost: bool):
         raise NotSupported(
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
         )
-    ukb, pkb, total, starts, min_his, min_los, uk_len = handle
+    ukb, pkb, total, starts, min_his, min_los, uk_len, t_hi, t_lo = handle
     snap_hi, snap_lo = _split_snapshots(snapshots)
     w = (max(uk_len, 4) + 3) // 4
+    has_tombs = t_hi is not None
+    if not has_tombs:
+        t_hi = t_lo = np.zeros(1, dtype=np.uint32)  # unused dummy
     out = _fused_uniform_shard_impl(
-        ukb, pkb, starts, min_his, min_los, snap_hi, snap_lo,
-        np.int32(total), w, uk_len, bool(bottommost),
+        ukb, pkb, starts, min_his, min_los, t_hi, t_lo, snap_hi, snap_lo,
+        np.int32(total), w, uk_len, bool(bottommost), has_tombs,
     )
     for a in out:
         if hasattr(a, "copy_to_host_async"):
@@ -644,7 +712,7 @@ def fused_uniform_shard_start(handle, snapshots: list[int], bottommost: bool):
 
 def fused_uniform_shard_finish(pending):
     """Block on one shard's result: (order[count] int32 LOCAL shard rows,
-    zero_flags[count] bool, has_complex)."""
+    zero_flags[count] bool, cx_flags[count] bool, has_complex)."""
     packed_bytes, meta = pending
     m = np.asarray(meta)
     c = int(m[0])
@@ -659,14 +727,18 @@ def fused_uniform_shard_finish(pending):
     )
     order = (po & np.uint32(MAX_SHARD_ROWS - 1)).astype(np.int32)
     zero_flags = (po >> np.uint32(23)).astype(bool)
-    return order, zero_flags, has_complex
+    cx_flags = ((po >> np.uint32(22)) & np.uint32(1)).astype(bool)
+    return order, zero_flags, cx_flags, has_complex
 
 
 def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
                          key_lens: np.ndarray, max_key_bytes: int,
-                         snapshots: list[int], bottommost: bool):
-    """Host wrapper: raw flat key bytes in, survivor order out (no range
-    tombstones). Returns (order[count], zero_flags[count], has_complex)."""
+                         snapshots: list[int], bottommost: bool,
+                         cover: np.ndarray | None = None):
+    """Host wrapper: raw flat key bytes in, survivor order out. `cover`:
+    optional per-original-row uint64 max covering tombstone seqno (0 =
+    uncovered). Returns (order[count], zero_flags[count], cx_flags[count],
+    has_complex)."""
     if len(snapshots) > MAX_SNAPSHOTS:
         raise NotSupported(
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
@@ -687,6 +759,14 @@ def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
     lens[:n] = key_lens
     valid[:n] = True
     snap_hi, snap_lo = _split_snapshots(snapshots)
+    has_tombs = cover is not None and bool(np.any(cover))
+    if has_tombs:
+        tc = np.zeros(p, dtype=np.uint64)
+        tc[:n] = cover
+        tomb_hi = (tc >> np.uint64(32)).astype(np.uint32)
+        tomb_lo = (tc & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    else:
+        tomb_hi = tomb_lo = np.zeros(1, dtype=np.uint32)  # unused dummy
     # Pad the raw byte buffer to a pow2 bucket too: otherwise every distinct
     # total-key-byte count compiles a fresh XLA program (the row count is
     # already bucketed; the gather clips, so over-length is semantically
@@ -694,14 +774,17 @@ def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
     blen = _next_pow2(max(8, len(key_buf)))
     kb = np.zeros(blen, dtype=np.uint8)
     kb[: len(key_buf)] = key_buf
-    order, zero_flags, count, has_complex = _fused_encode_sort_gc_impl(
-        kb, lens, valid, snap_hi, snap_lo, w, bool(bottommost),
-    )
-    for a in (order, zero_flags, count, has_complex):
+    order, zero_flags, cx_flags, count, has_complex = \
+        _fused_encode_sort_gc_impl(
+            kb, lens, valid, tomb_hi, tomb_lo, snap_hi, snap_lo, w,
+            bool(bottommost), has_tombs,
+        )
+    for a in (order, zero_flags, cx_flags, count, has_complex):
         if hasattr(a, "copy_to_host_async"):
             a.copy_to_host_async()  # stream D2H; sync np.asarray is ~15x
     c = int(count)
-    return np.asarray(order)[:c], np.asarray(zero_flags)[:c], bool(has_complex)
+    return (np.asarray(order)[:c], np.asarray(zero_flags)[:c],
+            np.asarray(cx_flags)[:c], bool(has_complex))
 
 
 def gc_mask(sorted_cols: dict, snapshots: list[int],

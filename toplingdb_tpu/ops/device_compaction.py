@@ -198,43 +198,6 @@ class _FallbackToEntries(Exception):
     semantics (complex groups present)."""
 
 
-def columnar_from_kv(kv, max_key_bytes: int | None = None):
-    """Build the device sort columns straight from flat buffers — the
-    zero-Python-loop encode for the fast path."""
-    import types
-
-    n = kv.n
-    offs = kv.key_offs.astype(np.int64)
-    lens = kv.key_lens.astype(np.int64)
-    tv = _kv_seq_vtype(kv)
-    seq = tv.seq
-    vtype = tv.vtype
-    inv = np.uint64(0xFFFFFFFFFFFFFFFF) - tv.packed
-    sign = np.uint32(0x80000000)
-    inv_hi = ((inv >> np.uint64(32)).astype(np.uint32) ^ sign).view(np.int32)
-    inv_lo = ((inv & np.uint64(0xFFFFFFFF)).astype(np.uint32) ^ sign).view(np.int32)
-    uk_len = (lens - 8).astype(np.int32)
-    maxlen = int(uk_len.max()) if n else 0
-    if max_key_bytes is None:
-        max_key_bytes = max(4, maxlen)
-    w = (max_key_bytes + 3) // 4
-    span = w * 4
-    idx = offs[:, None] + np.arange(span)[None, :]
-    np.clip(idx, 0, max(len(kv.key_buf) - 1, 0), out=idx)
-    kb = kv.key_buf[idx] if n else np.zeros((0, span), dtype=np.uint8)
-    kb = kb * (np.arange(span)[None, :] < uk_len[:, None])
-    words = np.ascontiguousarray(kb).reshape(n, w, 4).astype(np.uint32)
-    packed_words = (
-        (words[:, :, 0] << 24) | (words[:, :, 1] << 16)
-        | (words[:, :, 2] << 8) | words[:, :, 3]
-    )
-    key_words = (packed_words ^ sign).view(np.int32)
-    return types.SimpleNamespace(
-        key_words=key_words, key_len=uk_len, inv_hi=inv_hi, inv_lo=inv_lo,
-        vtype=vtype, seq=seq, n=n,
-    )
-
-
 def _kv_seq_vtype(kv):
     """Trailer columns (packed, seq, vtype) from flat buffers — shared by the
     full columnar encode and the cheap post-fused-run subset."""
@@ -328,8 +291,8 @@ def _collect_raw_columnar(compaction, table_cache, icmp, want_uploads=False):
     (the native block decoder runs GIL-free under ctypes). With
     want_uploads, ALSO split the sorted parts into user-key-range shards
     and prepare (host-side, no device traffic yet) each shard's uniform
-    chunk columns. Returns (kv, rd, shards) where shards is None when the
-    sharded uniform device path does not apply (tombstones, sparse layout,
+    chunk columns. Returns (kv, rd, shards, parts) where shards is None
+    when the sharded uniform device path does not apply (sparse layout,
     non-uniform key lengths, oversized shards); otherwise shards[s] =
     (chunks, row_ranges): prepare_uniform_chunk outputs plus the
     (global_lo, global_hi) row spans into the concatenated kv that each
@@ -337,7 +300,6 @@ def _collect_raw_columnar(compaction, table_cache, icmp, want_uploads=False):
     from concurrent.futures import ThreadPoolExecutor
 
     from toplingdb_tpu.ops.columnar_io import ColumnarKV, scan_table_columnar
-    from toplingdb_tpu.utils.status import NotSupported
 
     readers = [
         table_cache.get_reader(f.number) for _, f in compaction.all_inputs()
@@ -353,9 +315,61 @@ def _collect_raw_columnar(compaction, table_cache, icmp, want_uploads=False):
             rd.add(RangeTombstone.from_table_entry(b, e))
 
     shards = None
-    if want_uploads and rd.empty():
+    if want_uploads:
         shards = _prepare_uniform_shards(parts)
-    return ColumnarKV.concat(parts), rd, shards
+    return ColumnarKV.concat(parts), rd, shards, parts
+
+
+def _part_lower_bound(part, key: bytes, lo: int = 0) -> int:
+    """First row of the (sorted) part whose user key >= key."""
+    hi = part.n
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if _part_user_key(part, mid) < key:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+def _cover_for_parts(parts, rd: RangeDelAggregator, ucmp, snapshots):
+    """Per-ORIGINAL-row (concat order) max covering tombstone seqno,
+    stripe-clamped exactly like _tombstone_cover — computed per sorted
+    input part with interval binary searches (fragments are few, rows are
+    many), so the fused device paths can take tombstone-bearing jobs.
+    Returns uint64[sum(part.n)] or None when there are no tombstones."""
+    frags = list(fragment_tombstones(rd.tombstones(), ucmp))
+    if not frags:
+        return None
+    snaps = np.asarray(sorted(snapshots), dtype=np.uint64)
+    covers = []
+    for part in parts:
+        n = part.n
+        cov = np.zeros(n, dtype=np.uint64)
+        if n:
+            tv = _kv_seq_vtype(part)
+            seqs = tv.seq
+            if len(snaps):
+                idx = np.searchsorted(snaps, seqs, side="left")
+                upper = np.where(
+                    idx < len(snaps),
+                    snaps[np.minimum(idx, len(snaps) - 1)],
+                    np.uint64(dbformat.MAX_SEQUENCE_NUMBER),
+                )
+            else:
+                upper = np.full(n, dbformat.MAX_SEQUENCE_NUMBER,
+                                dtype=np.uint64)
+            for frag in frags:
+                lo = _part_lower_bound(part, frag.begin)
+                hi = _part_lower_bound(part, frag.end, lo)
+                if lo < hi:
+                    t = np.uint64(frag.seq)
+                    sl = slice(lo, hi)
+                    elig = ((t > seqs[sl]) & (t <= upper[sl])
+                            & (t > cov[sl]))
+                    cov[sl] = np.where(elig, t, cov[sl])
+        covers.append(cov)
+    return np.concatenate(covers) if covers else None
 
 
 def _prepare_uniform_shards(parts):
@@ -424,10 +438,88 @@ def _prepare_uniform_shards(parts):
     return shards or None
 
 
+def _kv_user_key(kv, r: int) -> bytes:
+    o = int(kv.key_offs[r])
+    return kv.key_buf[o: o + int(kv.key_lens[r]) - 8].tobytes()
+
+
+def _patch_kv_values(kv, rows: list[int], vals: list[bytes]) -> None:
+    """Append replacement values (folded merge results etc.) to kv's value
+    buffer and repoint the rows at them — the columnar writer then emits
+    them with zero further special-casing."""
+    side = b"".join(vals)
+    base = len(kv.val_buf)
+    if base + len(side) > 2 ** 31 - 8:
+        raise _FallbackToEntries()  # int32 offset budget
+    kv.val_buf = np.concatenate([
+        kv.val_buf, np.frombuffer(side, dtype=np.uint8)
+    ])
+    if not kv.val_offs.flags.writeable:
+        kv.val_offs = kv.val_offs.copy()
+    if not kv.val_lens.flags.writeable:
+        kv.val_lens = kv.val_lens.copy()
+    off = base
+    for r, v in zip(rows, vals):
+        kv.val_offs[r] = off
+        kv.val_lens[r] = len(v)
+        off += len(v)
+
+
+def _resolve_complex_stream(kv, order, cx_flags, trailer_override, seqs,
+                            vtypes, helper):
+    """Fold the complex (MERGE / SINGLE_DELETE) user-key groups the device
+    flagged in the survivor stream through the reference state machine
+    (CompactionIterator._process_group, the MergeHelper::MergeUntil role,
+    /root/reference/db/merge_helper.h:104) WITHOUT abandoning the columnar
+    path: each group's emitted entries overwrite the group's leading rows
+    (trailer/seq/vtype overrides + value replacements appended to kv's
+    side buffer); surplus rows drop out of the order. Returns the filtered
+    order; mutates trailer_override/seqs/vtypes and patches kv in place."""
+    n_stream = len(order)
+    keep_mask = np.ones(n_stream, dtype=bool)
+    repl_rows: list[int] = []
+    repl_vals: list[bytes] = []
+    pos_list = np.flatnonzero(cx_flags)
+    i = 0
+    P = len(pos_list)
+    while i < P:
+        p0 = int(pos_list[i])
+        uk = _kv_user_key(kv, int(order[p0]))
+        j = i + 1
+        while (j < P and int(pos_list[j]) == int(pos_list[j - 1]) + 1
+               and _kv_user_key(kv, int(order[int(pos_list[j])])) == uk):
+            j += 1
+        rows = [int(order[int(pos_list[t])]) for t in range(i, j)]
+        group = [(int(seqs[r]), int(vtypes[r]), kv.value(r)) for r in rows]
+        emitted = list(helper._process_group(uk, group))
+        if len(emitted) > len(rows):
+            raise _FallbackToEntries()  # cannot happen; belt and braces
+        for t, (ik, v) in enumerate(emitted):
+            r = rows[t]
+            if ik[:-8] != uk:
+                raise _FallbackToEntries()
+            packed = int.from_bytes(ik[-8:], "little")
+            if packed >= 2 ** 63:
+                raise _FallbackToEntries()  # int64 trailer budget
+            trailer_override[r] = packed
+            seqs[r] = packed >> 8
+            vtypes[r] = packed & 0xFF
+            if v != kv.value(r):
+                repl_rows.append(r)
+                repl_vals.append(v)
+        for t in range(len(emitted), len(rows)):
+            keep_mask[int(pos_list[i + t])] = False
+        i = j
+    if repl_rows:
+        _patch_kv_values(kv, repl_rows, repl_vals)
+    return order[keep_mask]
+
+
 def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                                     table_options, snapshots, merge_operator,
                                     new_file_number, creation_time,
-                                    device_name, column_family=(0, "default")):
+                                    device_name, column_family=(0, "default"),
+                                    blob_resolver=None):
     from toplingdb_tpu.compaction.compaction_job import (
         surviving_tombstone_fragments,
     )
@@ -440,7 +532,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()
     try:
-        kv, rd, shards = _collect_raw_columnar(
+        kv, rd, shards, parts = _collect_raw_columnar(
             compaction, table_cache, icmp, want_uploads=not _host_sort(),
         )
     except NotSupported:
@@ -453,108 +545,107 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # Exceeds the sort-operand budget (and the 4096B native block-builder
         # key buffer); the entries path re-checks and routes to the CPU.
         raise _FallbackToEntries()
-    if rd.empty():
-        # Tombstone-free: encode + sort + GC in ONE device program fed raw
-        # key bytes (half the upload of pre-built columns, no host gather).
-        mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
-        try:
-            if _host_sort():
-                import types as _types
+    mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
+    col = _kv_seq_vtype(kv)
+    _VT = dbformat.ValueType
+    any_complex = bool(kv.n) and bool(np.any(
+        (col.vtype == int(_VT.MERGE))
+        | (col.vtype == int(_VT.SINGLE_DELETION))
+    ))
+    streamed = False
+    order = zero_flags = cx_flags = None
+    has_complex = False
+    try:
+        # Range tombstones ride the fused kernels as a per-row max-covering
+        # seqno side input (stripe-clamped on host; fragments are few).
+        cover = (None if rd.empty() else _cover_for_parts(
+            parts, rd, icmp.user_comparator, snapshots))
+        if _host_sort():
+            import types as _types
 
-                order, zero_flags, has_complex, seq_a, vt_a = \
-                    ck.host_fused_full(
-                        kv.key_buf, kv.key_offs, kv.key_lens, mkb,
-                        snapshots, compaction.bottommost,
-                    )
-                col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
-            elif shards is not None:
-                # Upload + dispatch every shard up front (device_put and
-                # jit dispatch are async; shard s+1's transfer streams
-                # while shard s computes, and fused_uniform_shard_start
-                # enqueues each D2H copy so results stream back), then
+            order, zero_flags, cx_flags, has_complex, seq_a, vt_a = \
+                ck.host_fused_full(
+                    kv.key_buf, kv.key_offs, kv.key_lens, mkb,
+                    snapshots, compaction.bottommost, cover,
+                )
+            col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
+        elif shards is not None:
+            # Upload + dispatch every shard up front (device_put and
+            # jit dispatch are async; shard s+1's transfer streams
+            # while shard s computes, and fused_uniform_shard_start
+            # enqueues each D2H copy so results stream back).
+            pendings = []
+            for chunks, ranges in shards:
+                covers_s = (None if cover is None else
+                            [cover[lo:hi] for lo, hi in ranges])
+                pendings.append(ck.fused_uniform_shard_start(
+                    ck.upload_uniform_shard(chunks, covers_s), snapshots,
+                    compaction.bottommost,
+                ))
+            if not any_complex:
                 # STREAM each shard's survivors straight into the SST
                 # writer — block building overlaps the remaining shards'
                 # compute + download.
-                pendings = [
-                    ck.fused_uniform_shard_start(
-                        ck.upload_uniform_shard(chunks), snapshots,
-                        compaction.bottommost,
-                    )
-                    for chunks, _ in shards
-                ]
-                col = _kv_seq_vtype(kv)
-                has_complex = False
-                order = None  # streamed; see _shard_order_chunks below
+                streamed = True
             else:
-                order, zero_flags, has_complex = ck.fused_encode_sort_gc(
-                    kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
-                    compaction.bottommost,
-                )
-                col = None
-        except NotSupported:
-            raise _FallbackToEntries()  # non-dense buffers etc.
-        if has_complex:
-            raise _FallbackToEntries()
-        if order is None:
-            zero_orig = None  # shard streaming: trailers set per chunk
+                # Complex groups must fold BEFORE the writer hoists its
+                # value-buffer pointers, so collect every shard first;
+                # the shard programs still overlap each other.
+                orders, zfs, cxs = [], [], []
+                for (_chunks, ranges), pending in zip(shards, pendings):
+                    o, z, cx, hc = ck.fused_uniform_shard_finish(pending)
+                    lmap = np.concatenate([
+                        np.arange(lo, hi, dtype=np.int32)
+                        for lo, hi in ranges
+                    ]) if ranges else np.empty(0, np.int32)
+                    orders.append(lmap[o])
+                    zfs.append(z)
+                    cxs.append(cx)
+                    has_complex = has_complex or hc
+                order = (np.concatenate(orders) if orders
+                         else np.empty(0, np.int32))
+                zero_flags = (np.concatenate(zfs) if zfs
+                              else np.empty(0, bool))
+                cx_flags = (np.concatenate(cxs) if cxs
+                            else np.empty(0, bool))
         else:
-            zero_orig = order[zero_flags]
-        if col is None:
-            col = _kv_seq_vtype(kv)
-    elif _host_sort():
-        # Accelerator-less: host twins for the tombstone-bearing path too.
-        mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
-        s, new_key, seq, vtype = ck.host_sort_with_boundaries(
-            kv.key_buf, kv.key_offs, kv.key_lens, mkb
-        )
-        sorted_uks = [
-            kv.key_buf[kv.key_offs[i]: kv.key_offs[i] + kv.key_lens[i] - 8]
-            .tobytes() for i in s
-        ]
-        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator,
-                                 seq[s], snapshots)
-        keep, zero_seq, host_resolve, _ = ck.host_gc_mask(
-            new_key, seq[s], vtype[s], snapshots, cover,
-            compaction.bottommost,
-        )
-        if host_resolve.any():
-            raise _FallbackToEntries()
-        order = s[keep]
-        zero_orig = s[zero_seq]
-        import types as _types
-
-        col = _types.SimpleNamespace(seq=seq, vtype=vtype, n=kv.n)
-    else:
-        col = columnar_from_kv(kv)
-        padded = ck.pad_columns(col)
-        sorted_cols, perm = ck.device_sort(padded)
-        sorted_uks = [
-            kv.key_buf[kv.key_offs[i]: kv.key_offs[i] + kv.key_lens[i] - 8]
-            .tobytes() for i in perm
-        ]
-        cover = _tombstone_cover(sorted_uks, rd, icmp.user_comparator,
-                                 col.seq[perm], snapshots)
-        keep, zero_seq, host_resolve, group_id = ck.gc_mask(
-            sorted_cols, snapshots, cover, bottommost=compaction.bottommost
-        )
-        if host_resolve.any():
-            raise _FallbackToEntries()  # merge/single-delete groups present
-        order = perm[keep]
-        zero_orig = perm[zero_seq]
+            order, zero_flags, cx_flags, has_complex = \
+                ck.fused_encode_sort_gc(
+                    kv.key_buf, kv.key_offs, kv.key_lens, mkb, snapshots,
+                    compaction.bottommost, cover,
+                )
+    except NotSupported:
+        raise _FallbackToEntries()  # non-dense buffers, >cap snapshots etc.
 
     trailer_override = np.full(kv.n, -1, dtype=np.int64)
     seqs = col.seq.copy()
-    if zero_orig is not None:
-        # packed trailer for seq 0 is just the type byte.
+    vtypes = col.vtype
+    if not streamed:
+        # packed trailer for seq 0 is just the type byte. Complex rows'
+        # zero flags are provisional — _process_group re-decides them.
+        zmask = zero_flags if not has_complex else (zero_flags & ~cx_flags)
+        zero_orig = order[zmask]
         trailer_override[zero_orig] = col.vtype[zero_orig].astype(np.int64)
         seqs[zero_orig] = 0
+        if has_complex:
+            vtypes = vtypes.copy()
+            helper = CompactionIterator(
+                _EmptyIter(), icmp, snapshots,
+                bottommost_level=compaction.bottommost,
+                merge_operator=merge_operator,
+                range_del_agg=None if rd.empty() else rd,
+                blob_resolver=blob_resolver,
+            )
+            order = _resolve_complex_stream(
+                kv, order, cx_flags, trailer_override, seqs, vtypes, helper
+            )
         order_feed = order
     else:
         # Shard streaming: each chunk's trailers/seqs land just before the
         # writer consumes it (the writer reads both arrays per native call).
         def _shard_order_chunks():
             for (_chunks, ranges), pending in zip(shards, pendings):
-                o, z, hc = ck.fused_uniform_shard_finish(pending)
+                o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
                 if hc:
                     raise _FallbackToEntries()
                 lmap = np.concatenate([
@@ -578,7 +669,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         try:
             files = write_tables_columnar(
                 env, dbname, new_file_number, icmp, table_options, kv,
-                order_feed, trailer_override, col.vtype, seqs, tombs,
+                order_feed, trailer_override, vtypes, seqs, tombs,
                 creation_time if creation_time is not None else int(time.time()),
                 max_output_file_size=compaction.max_output_file_size,
                 column_family=column_family,
@@ -594,7 +685,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 env.delete_file(path)
                 continue
             blob_refs = set()
-            bi_mask = col.vtype[sel] == dbformat.ValueType.BLOB_INDEX
+            bi_mask = vtypes[sel] == dbformat.ValueType.BLOB_INDEX
             if bi_mask.any():
                 for oi in sel[bi_mask]:
                     blob_refs.add(decode_blob_index(kv.value(oi))[0])
@@ -640,7 +731,7 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
             return _run_device_compaction_columnar(
                 env, dbname, icmp, compaction, table_cache, table_options,
                 snapshots, merge_operator, new_file_number, creation_time,
-                device_name, column_family,
+                device_name, column_family, blob_resolver=blob_resolver,
             )
         except _FallbackToEntries:
             pass

@@ -251,7 +251,7 @@ def run_distributed_gc(mesh: Mesh, jobs: list, snapshots: list[int],
     All jobs must share the padded length and word count; the jobs list is
     padded to the 'jobs' mesh dim. Returns per-job (keep, zero_seq,
     sorted_idx) numpy arrays in global sorted order."""
-    from toplingdb_tpu.ops.compaction_kernels import MAX_SNAPSHOTS
+    from toplingdb_tpu.ops.compaction_kernels import _split_snapshots
 
     jdim = mesh.shape["jobs"]
     rdim = mesh.shape["range"]
@@ -271,10 +271,7 @@ def run_distributed_gc(mesh: Mesh, jobs: list, snapshots: list[int],
         cols[i, :n, w + 2] = job["inv_lo"]
         vtype[i, :n] = job["vtype"]
         idx[i, :n] = np.arange(n, dtype=np.int32)
-    pad_snap = 1 << 56
-    snaps = sorted(snapshots) + [pad_snap] * (MAX_SNAPSHOTS - len(snapshots))
-    snap_hi = np.array([s >> 32 for s in snaps], dtype=np.uint32)
-    snap_lo = np.array([s & 0xFFFFFFFF for s in snaps], dtype=np.uint32)
+    snap_hi, snap_lo = _split_snapshots(snapshots)  # pow2 bucket pad >= 64
 
     step = make_distributed_gc_step(mesh, w, bottommost)
     keep, zero_seq, sidx, overflow = step(cols, vtype, idx, snap_hi, snap_lo)
