@@ -686,3 +686,40 @@ def test_device_columnar_complex_host_twin_parity(tmp_path, monkeypatch):
     monkeypatch.setenv("TPULSM_HOST_SORT", "1")
     test_device_columnar_complex_tombstones_snapshots(
         tmp_path, monkeypatch, 0)
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33])
+def test_front_coded_upload_parity(seed):
+    """Front-coded uploads (prefix lengths + suffixes, decoded on device
+    with a cummax scan) must produce IDENTICAL survivor streams to the
+    plain full-key upload."""
+    import numpy as np
+
+    from toplingdb_tpu.ops import compaction_kernels as ck
+
+    rng = random.Random(seed)
+    L = rng.choice([12, 16, 24])  # internal key len (uk_len = L - 8)
+    chunks_raw = []
+    seq = 1
+    for _ in range(rng.randrange(1, 4)):  # chunks = sorted runs
+        n = rng.randrange(5, 200)
+        keys = sorted(
+            b"k%0*d" % (L - 9, rng.randrange(100)) for _ in range(n)
+        )
+        buf = bytearray()
+        for k in keys:
+            buf += make_internal_key(k, seq, ValueType.VALUE)
+            seq += 1
+        chunks_raw.append((np.frombuffer(bytes(buf), np.uint8), n, L))
+    chunks = [ck.prepare_uniform_chunk(b, n, l) for b, n, l in chunks_raw]
+    snaps = sorted(rng.sample(range(1, seq + 1), rng.randrange(0, 3)))
+    outs = []
+    for fc in (False, True):
+        h = ck.upload_uniform_shard(chunks, front_code=fc)
+        assert ("plens" in h) == fc
+        pending = ck.fused_uniform_shard_start(h, snaps, True)
+        outs.append(ck.fused_uniform_shard_finish(pending))
+    o0, z0, c0, h0 = outs[0]
+    o1, z1, c1, h1 = outs[1]
+    assert np.array_equal(o0, o1), "front-coded survivor order differs"
+    assert np.array_equal(z0, z1) and np.array_equal(c0, c1) and h0 == h1

@@ -519,27 +519,12 @@ def _fused_encode_sort_gc_impl(key_buf, key_lens, valid, tomb_hi, tomb_lo,
 MAX_SHARD_ROWS = 1 << 22
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_key_words", "uk_len", "bottommost", "has_tombs"),
-)
-def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
-                              tomb_hi, tomb_lo,
-                              snap_hi, snap_lo, total, num_key_words, uk_len,
-                              bottommost, has_tombs):
-    """ONE range-shard's encode+sort+GC over ONE uploaded buffer pair:
-    `ukb` = trailer-stripped user-key bytes of every chunk packed
-    contiguously (padded rows zero), `pkb` = one uint32 per row
-    ((seq - chunk_min_seq) << 8 | vtype, deltas < 2^24). Chunk row starts
-    arrive as a small DEVICE array `starts` (pow2-padded with sentinel
-    2^31-1), so per-row chunk ids come from one searchsorted and the jit
-    cache keys only on pow2-padded shapes — arbitrary chunk-size tuples
-    reuse one compilation. TWO bulk host→device transfers per shard.
-    The result is (packed_bytes u8[3p], meta i32[2]): three
-    byte-planes of the 24-bit survivor row ids (bit 23 = zero-seq flag,
-    bit 22 = complex-group flag) — 3/4 the download of int32 orders — plus
-    [count, has_complex]. With has_tombs, tomb_hi/lo carry each local row's
-    max covering range-tombstone seqno words."""
+def _uniform_shard_tail(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
+                        snap_hi, snap_lo, total, num_key_words, uk_len,
+                        bottommost, has_tombs):
+    """Shared traced tail of the uniform-shard kernels: [p, uk_len] u8 key
+    matrix in → packed survivor byte-planes out (see
+    _fused_uniform_shard_impl for the contract)."""
     u32 = jnp.uint32
     int32max = jnp.int32(2**31 - 1)
     sign = u32(_SIGN)
@@ -549,7 +534,6 @@ def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
     iota = jnp.arange(p, dtype=jnp.int32)
     valid = iota < total
 
-    kb = ukb.reshape(p, uk_len)
     if span > uk_len:
         kb = jnp.pad(kb, ((0, 0), (0, span - uk_len)))
     kb = kb.astype(u32).reshape(p, num_key_words, 4)
@@ -608,6 +592,71 @@ def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
     return packed_bytes, meta
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_key_words", "uk_len", "bottommost", "has_tombs"),
+)
+def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
+                              tomb_hi, tomb_lo,
+                              snap_hi, snap_lo, total, num_key_words, uk_len,
+                              bottommost, has_tombs):
+    """ONE range-shard's encode+sort+GC over ONE uploaded buffer pair:
+    `ukb` = trailer-stripped user-key bytes of every chunk packed
+    contiguously (padded rows zero), `pkb` = one uint32 per row
+    ((seq - chunk_min_seq) << 8 | vtype, deltas < 2^24). Chunk row starts
+    arrive as a small DEVICE array `starts` (pow2-padded with sentinel
+    2^31-1), so per-row chunk ids come from one searchsorted and the jit
+    cache keys only on pow2-padded shapes — arbitrary chunk-size tuples
+    reuse one compilation. TWO bulk host→device transfers per shard.
+    The result is (packed_bytes u8[3p], meta i32[2]): three
+    byte-planes of the 24-bit survivor row ids (bit 23 = zero-seq flag,
+    bit 22 = complex-group flag) — 3/4 the download of int32 orders — plus
+    [count, has_complex]. With has_tombs, tomb_hi/lo carry each local row's
+    max covering range-tombstone seqno words."""
+    p = pkb.shape[0]
+    kb = ukb.reshape(p, uk_len)
+    return _uniform_shard_tail(
+        kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
+        snap_hi, snap_lo, total, num_key_words, uk_len, bottommost,
+        has_tombs,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_key_words", "uk_len", "bottommost", "has_tombs"),
+)
+def _fused_uniform_shard_fc_impl(plens, sfx, pkb, starts, min_his, min_los,
+                                 tomb_hi, tomb_lo, snap_hi, snap_lo, total,
+                                 num_key_words, uk_len, bottommost,
+                                 has_tombs):
+    """Front-coded variant of _fused_uniform_shard_impl: instead of the full
+    [p, uk_len] key bytes, the host uploads per-row shared-prefix lengths
+    (`plens` u8, 0 at chunk starts) + the concatenated suffix bytes
+    (`sfx`) — typically a fraction of the full key bytes for sorted runs.
+    The device reconstructs the key matrix with a cummax scan (source row
+    of each inherited byte column) + one gather, then runs the shared
+    tail. Output is bit-identical to the plain upload (parity-tested)."""
+    p = pkb.shape[0]
+    pl = plens.astype(jnp.int32)
+    sfx_len = jnp.int32(uk_len) - pl
+    sfx_off = jnp.cumsum(sfx_len) - sfx_len
+    iota = jnp.arange(p, dtype=jnp.int32)
+    col = jnp.arange(uk_len, dtype=jnp.int32)[None, :]
+    # Column j of row i inherits from the LAST row i' <= i with
+    # plen[i'] <= j; chunk starts have plen 0, so inheritance never
+    # crosses a chunk boundary.
+    contrib = jnp.where(pl[:, None] <= col, iota[:, None], jnp.int32(-1))
+    src = jax.lax.cummax(contrib, axis=0)
+    pos = sfx_off[src] + (col - pl[src])
+    kb = sfx[jnp.clip(pos, 0, sfx.shape[0] - 1)]
+    return _uniform_shard_tail(
+        kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
+        snap_hi, snap_lo, total, num_key_words, uk_len, bottommost,
+        has_tombs,
+    )
+
+
 def prepare_uniform_chunk(key_buf: np.ndarray, n: int, key_len: int):
     """Host half of the uniform upload: strip the 8-byte trailers from one
     dense uniform-length key slice; no device traffic. Returns
@@ -630,14 +679,36 @@ def prepare_uniform_chunk(key_buf: np.ndarray, n: int, key_len: int):
     return (uk, pk32, min_seq, n, uk_len)
 
 
-def upload_uniform_shard(chunks, covers=None):
+# Front-coded uploads: on for uniform keys up to this many bytes unless
+# TPULSM_FRONT_CODE=0. The decode materializes [p, uk_len] int32
+# intermediates (cummax source rows + gather positions), so also cap the
+# total element count — beyond it the transient HBM spike would outweigh
+# the transfer win.
+_FC_MAX_UK_LEN = 32
+_FC_MAX_ELEMS = 64 << 20  # ~256 MB of int32 intermediates
+
+
+def _want_front_code(uk_len: int, total_rows: int) -> bool:
+    import os
+
+    if os.environ.get("TPULSM_FRONT_CODE", "1") == "0":
+        return False
+    return (0 < uk_len <= _FC_MAX_UK_LEN
+            and _next_pow2(max(1, total_rows)) * uk_len <= _FC_MAX_ELEMS)
+
+
+def upload_uniform_shard(chunks, covers=None, front_code=None):
     """Pack one shard's prepared chunks (prepare_uniform_chunk outputs, in
-    row order) into ONE key-byte buffer + ONE packed32 buffer, pad rows to
-    the next power of two, and START the host→device transfers
-    (device_put is async). Tunneled rigs pay a fixed ~60ms per transfer
-    regardless of size, so two big transfers beat 2-per-chunk small ones.
+    row order) into device buffers, pad rows to the next power of two, and
+    START the host→device transfers (device_put is async). Tunneled rigs
+    pay a fixed ~60ms per transfer regardless of size, so few big
+    transfers beat 2-per-chunk small ones.
     `covers`: optional per-chunk uint64 max-covering-tombstone arrays
-    (None = tombstone-free); uploaded as two extra u32 planes."""
+    (None = tombstone-free); uploaded as two extra u32 planes.
+    `front_code` (None = auto): upload per-row shared-prefix lengths +
+    suffix bytes instead of full key bytes — sorted runs share long
+    prefixes, so this cuts the dominant H2D transfer; the device
+    reconstructs the exact key matrix (bit-identical results)."""
     uk_len = chunks[0][4]
     ns = tuple(int(c[3]) for c in chunks)
     total = sum(ns)
@@ -645,8 +716,11 @@ def upload_uniform_shard(chunks, covers=None):
         raise NotSupported(
             f"shard rows {total} exceed the 24-bit packed-order budget"
         )
+    if front_code is None:
+        front_code = _want_front_code(uk_len, total)
+    if uk_len > 255:
+        front_code = False  # plens is uint8; a longer prefix would wrap
     p = _next_pow2(max(1, total))
-    ukb = np.zeros(p * uk_len, dtype=np.uint8)
     pkb = np.zeros(p, dtype=np.uint32)
     has_tombs = covers is not None and any(
         c is not None and np.any(c) for c in covers
@@ -654,9 +728,24 @@ def upload_uniform_shard(chunks, covers=None):
     if has_tombs:
         tomb_hi = np.zeros(p, dtype=np.uint32)
         tomb_lo = np.zeros(p, dtype=np.uint32)
+    if front_code:
+        plens = np.zeros(p, dtype=np.uint8)
+        sfx_parts = []
+    else:
+        ukb = np.zeros(p * uk_len, dtype=np.uint8)
     pos = 0
     for ci, (uk, pk32, _mn, n, _l) in enumerate(chunks):
-        ukb[pos * uk_len:(pos + n) * uk_len] = uk
+        if front_code and n:
+            kb2 = uk.reshape(n, uk_len)
+            eq = kb2[1:] == kb2[:-1]
+            pl = np.zeros(n, dtype=np.int32)
+            if n > 1:
+                all_eq = eq.all(axis=1)
+                pl[1:] = np.where(all_eq, uk_len, np.argmin(eq, axis=1))
+            plens[pos:pos + n] = pl.astype(np.uint8)
+            sfx_parts.append(kb2[np.arange(uk_len)[None, :] >= pl[:, None]])
+        elif not front_code:
+            ukb[pos * uk_len:(pos + n) * uk_len] = uk
         pkb[pos:pos + n] = pk32
         if has_tombs and covers[ci] is not None:
             cv = covers[ci]
@@ -674,16 +763,26 @@ def upload_uniform_shard(chunks, covers=None):
     min_los = np.zeros(nc, dtype=np.uint32)
     min_his[: len(ns)] = (mins >> np.uint64(32)).astype(np.uint32)
     min_los[: len(ns)] = (mins & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    if has_tombs:
-        t_hi = jax.device_put(tomb_hi)
-        t_lo = jax.device_put(tomb_lo)
+    h = {
+        "pkb": jax.device_put(pkb), "total": total,
+        "starts": jax.device_put(starts),
+        "min_his": jax.device_put(min_his),
+        "min_los": jax.device_put(min_los), "uk_len": uk_len,
+        "tomb_hi": jax.device_put(tomb_hi) if has_tombs else None,
+        "tomb_lo": jax.device_put(tomb_lo) if has_tombs else None,
+    }
+    if front_code:
+        sfx = (np.concatenate(sfx_parts) if sfx_parts
+               else np.zeros(0, dtype=np.uint8))
+        # Pad-row columns all "contribute themselves" (plen 0), so the
+        # decode's clipped gather needs only a pow2 bucket, not real bytes.
+        sb = np.zeros(_next_pow2(max(8, len(sfx))), dtype=np.uint8)
+        sb[: len(sfx)] = sfx
+        h["plens"] = jax.device_put(plens)
+        h["sfx"] = jax.device_put(sb)
     else:
-        t_hi = t_lo = None
-    return (
-        jax.device_put(ukb), jax.device_put(pkb), total,
-        jax.device_put(starts), jax.device_put(min_his),
-        jax.device_put(min_los), uk_len, t_hi, t_lo,
-    )
+        h["ukb"] = jax.device_put(ukb)
+    return h
 
 
 def fused_uniform_shard_start(handle, snapshots: list[int], bottommost: bool):
@@ -694,16 +793,25 @@ def fused_uniform_shard_start(handle, snapshots: list[int], bottommost: bool):
         raise NotSupported(
             f"device GC supports <= {MAX_SNAPSHOTS} live snapshots"
         )
-    ukb, pkb, total, starts, min_his, min_los, uk_len, t_hi, t_lo = handle
+    h = handle
     snap_hi, snap_lo = _split_snapshots(snapshots)
+    uk_len = h["uk_len"]
     w = (max(uk_len, 4) + 3) // 4
-    has_tombs = t_hi is not None
-    if not has_tombs:
-        t_hi = t_lo = np.zeros(1, dtype=np.uint32)  # unused dummy
-    out = _fused_uniform_shard_impl(
-        ukb, pkb, starts, min_his, min_los, t_hi, t_lo, snap_hi, snap_lo,
-        np.int32(total), w, uk_len, bool(bottommost), has_tombs,
-    )
+    has_tombs = h["tomb_hi"] is not None
+    t_hi = h["tomb_hi"] if has_tombs else np.zeros(1, dtype=np.uint32)
+    t_lo = h["tomb_lo"] if has_tombs else np.zeros(1, dtype=np.uint32)
+    if "plens" in h:
+        out = _fused_uniform_shard_fc_impl(
+            h["plens"], h["sfx"], h["pkb"], h["starts"], h["min_his"],
+            h["min_los"], t_hi, t_lo, snap_hi, snap_lo,
+            np.int32(h["total"]), w, uk_len, bool(bottommost), has_tombs,
+        )
+    else:
+        out = _fused_uniform_shard_impl(
+            h["ukb"], h["pkb"], h["starts"], h["min_his"], h["min_los"],
+            t_hi, t_lo, snap_hi, snap_lo,
+            np.int32(h["total"]), w, uk_len, bool(bottommost), has_tombs,
+        )
     for a in out:
         if hasattr(a, "copy_to_host_async"):
             a.copy_to_host_async()
