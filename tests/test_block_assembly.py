@@ -1,0 +1,141 @@
+"""On-device SST block assembly: whole-file byte parity with the CPU path
+(reference block build loop, table/block_based/block_builder.cc:66-180,
+re-expressed as one jit program — VERDICT r2 task 1)."""
+
+import random
+
+import pytest
+
+from toplingdb_tpu.db.dbformat import (
+    InternalKeyComparator,
+    ValueType,
+    make_internal_key,
+)
+
+ICMP = InternalKeyComparator()
+
+
+def _build_inputs(env, dbdir, rng, topts, n_files=3, n_per=350,
+                  with_deletes=True, with_tombstones=False):
+    import toplingdb_tpu.db.filename as fn
+    from toplingdb_tpu.db.version_edit import FileMetaData
+    from toplingdb_tpu.table.builder import TableBuilder
+
+    metas = []
+    seq = 1
+    for fi in range(n_files):
+        fnum = 31 + fi
+        entries = []
+        for _ in range(n_per):
+            k = b"key%06d" % rng.randrange(500)
+            t = ValueType.VALUE
+            if with_deletes and rng.random() < 0.15:
+                t = ValueType.DELETION
+            v = b"" if t != ValueType.VALUE else b"v%0*d" % (
+                rng.randrange(4, 40), seq)
+            entries.append((make_internal_key(k, seq, t), v))
+            seq += 1
+        entries.sort(key=lambda kv: ICMP.sort_key(kv[0]))
+        w = env.new_writable_file(fn.table_file_name(dbdir, fnum))
+        b = TableBuilder(w, ICMP, topts)
+        last = None
+        for k, v in entries:
+            if k == last:
+                continue
+            b.add(k, v)
+            last = k
+        if with_tombstones:
+            lo = rng.randrange(400)
+            b.add_tombstone(
+                make_internal_key(b"key%06d" % lo, seq,
+                                  ValueType.RANGE_DELETION),
+                b"key%06d" % (lo + 50))
+            seq += 1
+        props = b.finish()
+        w.close()
+        metas.append(FileMetaData(
+            number=fnum,
+            file_size=env.get_file_size(fn.table_file_name(dbdir, fnum)),
+            smallest=b.smallest_key, largest=b.largest_key,
+            smallest_seqno=props.smallest_seqno,
+            largest_seqno=props.largest_seqno,
+        ))
+    return metas, seq
+
+
+@pytest.mark.parametrize("seed,block_size,restart,tombs,nsnaps", [
+    (1, 512, 16, False, 0),
+    (2, 512, 4, False, 2),
+    (3, 4096, 16, False, 0),
+    (4, 1024, 16, True, 3),
+    (5, 256, 8, True, 0),
+])
+def test_block_assembly_byte_parity(tmp_path, monkeypatch, seed, block_size,
+                                    restart, tombs, nsnaps):
+    import os
+
+    from toplingdb_tpu.compaction.compaction_job import run_compaction_to_tables
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db.table_cache import TableCache
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.ops import device_compaction as dc
+    from toplingdb_tpu.ops.device_compaction import run_device_compaction
+    from toplingdb_tpu.table.builder import TableOptions
+    import toplingdb_tpu.db.filename as fn
+
+    monkeypatch.setenv("TPULSM_DEVICE_BLOCKS", "1")
+    monkeypatch.delenv("TPULSM_HOST_SORT", raising=False)
+    env = default_env()
+    dbdir = str(tmp_path)
+    rng = random.Random(seed)
+    topts = TableOptions(block_size=block_size, restart_interval=restart,
+                         filter_policy=None)
+    metas, seq_top = _build_inputs(env, dbdir, rng, topts,
+                                   with_tombstones=tombs)
+    tc = TableCache(env, dbdir, ICMP, topts)
+    snaps = sorted(rng.sample(range(1, seq_top), nsnaps))
+
+    def mk(base):
+        s = [base]
+
+        def alloc():
+            s[0] += 1
+            return s[0]
+
+        return alloc
+
+    c1 = Compaction(level=0, output_level=2, inputs=list(metas),
+                    bottommost=True, max_output_file_size=1 << 62)
+    out_cpu, _ = run_compaction_to_tables(
+        env, dbdir, ICMP, c1, tc, topts, snaps, new_file_number=mk(100),
+        creation_time=9,
+    )
+
+    # Assembly (not the columnar writer, not the per-entry path) must run.
+    import toplingdb_tpu.ops.block_assembly as ba
+
+    called = []
+    orig = ba.run_block_assembly
+
+    def spy(*a, **k):
+        called.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ba, "run_block_assembly", spy)
+    c2 = Compaction(level=0, output_level=2, inputs=list(metas),
+                    bottommost=True, max_output_file_size=1 << 62)
+    out_dev, _ = run_device_compaction(
+        env, dbdir, ICMP, c2, tc, topts, snaps, new_file_number=mk(200),
+        creation_time=9, device_name="cpu-jax",
+    )
+    assert called, "block assembly path was not taken"
+    assert len(out_cpu) == len(out_dev) == 1
+    bc = open(fn.table_file_name(dbdir, out_cpu[0].number), "rb").read()
+    bd = open(fn.table_file_name(dbdir, out_dev[0].number), "rb").read()
+    assert bc == bd, (
+        f"device-assembled SST differs from CPU build "
+        f"({len(bc)} vs {len(bd)} bytes)"
+    )
+    assert out_cpu[0].smallest == out_dev[0].smallest
+    assert out_cpu[0].largest == out_dev[0].largest
+    assert out_cpu[0].num_entries == out_dev[0].num_entries

@@ -336,9 +336,14 @@ class _ColumnarSST:
             offset += payload_len + fmt.BLOCK_TRAILER_SIZE
         self.w.append(section)
 
-    def finish(self, lib, kv, sel, vtypes, seqs, tombstones):
+    def finish(self, lib, kv, sel, vtypes, seqs, tombstones,
+               precomputed=None):
         """Write meta blocks + footer; `sel` = the original-index selection
-        of this file's entries (stats/bloom are vectorized over it)."""
+        of this file's entries (stats/bloom are vectorized over it).
+        `precomputed`: entry stats already reduced elsewhere (the on-device
+        block-assembly path, which never materializes sel) — a dict with
+        num_entries/raw_key_size/raw_value_size/num_deletions/
+        num_merge_operands/smallest_seqno/largest_seqno."""
         if self._dict == b"":
             self._train_dict_and_flush()  # small file: train from the lot
         self._drain(wait=True)
@@ -349,20 +354,31 @@ class _ColumnarSST:
         if self.pending_last_key is not None:
             succ = icmp.find_short_successor(self.pending_last_key)
             self.index_block.add(succ, self.pending_handle.encode())
-        props.num_entries = n
-        props.raw_key_size = int(kv.key_lens[sel].sum()) if n else 0
-        props.raw_value_size = int(kv.val_lens[sel].sum()) if n else 0
-        vt = vtypes[sel] if n else vtypes[:0]
-        props.num_deletions = int(np.count_nonzero(
-            (vt == int(dbformat.ValueType.DELETION))
-            | (vt == int(dbformat.ValueType.SINGLE_DELETION))
-        ))
-        props.num_merge_operands = int(np.count_nonzero(
-            vt == int(dbformat.ValueType.MERGE)
-        ))
-        sq = seqs[sel] if n else seqs[:0]
-        props.smallest_seqno = int(sq.min()) if n else 0
-        props.largest_seqno = int(sq.max()) if n else 0
+        if precomputed is not None:
+            n = precomputed["num_entries"]
+            props.num_entries = n
+            props.raw_key_size = precomputed["raw_key_size"]
+            props.raw_value_size = precomputed["raw_value_size"]
+            props.num_deletions = precomputed["num_deletions"]
+            props.num_merge_operands = precomputed["num_merge_operands"]
+            props.smallest_seqno = precomputed["smallest_seqno"]
+            props.largest_seqno = precomputed["largest_seqno"]
+            n = 0  # skip the sel-vectorized stats AND the bloom build
+        else:
+            props.num_entries = n
+            props.raw_key_size = int(kv.key_lens[sel].sum()) if n else 0
+            props.raw_value_size = int(kv.val_lens[sel].sum()) if n else 0
+            vt = vtypes[sel] if n else vtypes[:0]
+            props.num_deletions = int(np.count_nonzero(
+                (vt == int(dbformat.ValueType.DELETION))
+                | (vt == int(dbformat.ValueType.SINGLE_DELETION))
+            ))
+            props.num_merge_operands = int(np.count_nonzero(
+                vt == int(dbformat.ValueType.MERGE)
+            ))
+            sq = seqs[sel] if n else seqs[:0]
+            props.smallest_seqno = int(sq.min()) if n else 0
+            props.largest_seqno = int(sq.max()) if n else 0
 
         meta_entries = []
         metaindex = BlockBuilder(restart_interval=1)

@@ -519,12 +519,14 @@ def _fused_encode_sort_gc_impl(key_buf, key_lens, valid, tomb_hi, tomb_lo,
 MAX_SHARD_ROWS = 1 << 22
 
 
-def _uniform_shard_tail(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
+def _uniform_shard_core(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
                         snap_hi, snap_lo, total, num_key_words, uk_len,
                         bottommost, has_tombs):
-    """Shared traced tail of the uniform-shard kernels: [p, uk_len] u8 key
-    matrix in → packed survivor byte-planes out (see
-    _fused_uniform_shard_impl for the contract)."""
+    """Shared traced core of the uniform-shard kernels: [p, uk_len] u8 key
+    matrix in → sort + GC. Returns a dict of per-SORTED-row arrays
+    (perm, out, zero_seq, host_resolve, take) plus per-ORIGINAL-row
+    packed trailer words, for the packed-download and block-assembly
+    tails to consume."""
     u32 = jnp.uint32
     int32max = jnp.int32(2**31 - 1)
     sign = u32(_SIGN)
@@ -534,12 +536,13 @@ def _uniform_shard_tail(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
     iota = jnp.arange(p, dtype=jnp.int32)
     valid = iota < total
 
+    kbp = kb
     if span > uk_len:
-        kb = jnp.pad(kb, ((0, 0), (0, span - uk_len)))
-    kb = kb.astype(u32).reshape(p, num_key_words, 4)
+        kbp = jnp.pad(kbp, ((0, 0), (0, span - uk_len)))
+    kbp = kbp.astype(u32).reshape(p, num_key_words, 4)
     words = (
-        (kb[:, :, 0] << 24) | (kb[:, :, 1] << 16)
-        | (kb[:, :, 2] << 8) | kb[:, :, 3]
+        (kbp[:, :, 0] << 24) | (kbp[:, :, 1] << 16)
+        | (kbp[:, :, 2] << 8) | kbp[:, :, 3]
     )
     key_words = jnp.where(valid[:, None], i32(words ^ sign), int32max)
 
@@ -553,12 +556,12 @@ def _uniform_shard_tail(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
     seq_lo = mlo + rel
     carry = (seq_lo < mlo).astype(u32)
     seq_hi = min_his[cid] + carry
-    vt = pkb & u32(0xFF)
+    vt0 = pkb & u32(0xFF)
     packed_hi = (seq_hi << 8) | (seq_lo >> 24)
-    packed_lo = (seq_lo << 8) | vt
+    packed_lo = (seq_lo << 8) | vt0
     inv_hi = jnp.where(valid, i32(~packed_hi ^ sign), int32max)
     inv_lo = jnp.where(valid, i32(~packed_lo ^ sign), int32max)
-    vtype = jnp.where(valid, vt.astype(jnp.int32), -1)
+    vtype = jnp.where(valid, vt0.astype(jnp.int32), -1)
     key_len = jnp.where(valid, jnp.int32(uk_len), int32max)
 
     kw, kl, ih, il, vt, perm = _sort_impl(
@@ -575,10 +578,31 @@ def _uniform_shard_tail(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
     )
     out = keep | host_resolve
     take = jnp.argsort(~out, stable=True)
+    return {
+        "perm": perm, "take": take, "out": out, "zero_seq": zero_seq,
+        "host_resolve": host_resolve,
+        "packed_hi": packed_hi, "packed_lo": packed_lo,  # per ORIGINAL row
+        "vtype_orig": vt0.astype(jnp.int32),
+        "valid": valid,
+    }
+
+
+def _uniform_shard_tail(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
+                        snap_hi, snap_lo, total, num_key_words, uk_len,
+                        bottommost, has_tombs):
+    """Packed-download tail: [p, uk_len] u8 key matrix in → packed survivor
+    byte-planes out (see _fused_uniform_shard_impl for the contract)."""
+    u32 = jnp.uint32
+    core = _uniform_shard_core(
+        kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
+        snap_hi, snap_lo, total, num_key_words, uk_len, bottommost,
+        has_tombs,
+    )
+    take = core["take"]
     po = (
-        jax.lax.bitcast_convert_type(perm[take], u32)
-        | (zero_seq[take].astype(u32) << 23)
-        | (host_resolve[take].astype(u32) << 22)
+        jax.lax.bitcast_convert_type(core["perm"][take], u32)
+        | (core["zero_seq"][take].astype(u32) << 23)
+        | (core["host_resolve"][take].astype(u32) << 22)
     )
     packed_bytes = jnp.concatenate([
         (po & u32(0xFF)).astype(jnp.uint8),
@@ -586,10 +610,28 @@ def _uniform_shard_tail(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
         ((po >> 16) & u32(0xFF)).astype(jnp.uint8),
     ])
     meta = jnp.stack([
-        jnp.sum(out.astype(jnp.int32)),
-        jnp.any(host_resolve).astype(jnp.int32),
+        jnp.sum(core["out"].astype(jnp.int32)),
+        jnp.any(core["host_resolve"]).astype(jnp.int32),
     ])
     return packed_bytes, meta
+
+
+def _decode_front_coded(plens, sfx, uk_len):
+    """Reconstruct the [p, uk_len] u8 key matrix from front-coded uploads
+    (shared by the packed-download and block-assembly kernels)."""
+    p = plens.shape[0]
+    pl = plens.astype(jnp.int32)
+    sfx_len = jnp.int32(uk_len) - pl
+    sfx_off = jnp.cumsum(sfx_len) - sfx_len
+    iota = jnp.arange(p, dtype=jnp.int32)
+    col = jnp.arange(uk_len, dtype=jnp.int32)[None, :]
+    # Column j of row i inherits from the LAST row i' <= i with
+    # plen[i'] <= j; chunk starts have plen 0, so inheritance never
+    # crosses a chunk boundary.
+    contrib = jnp.where(pl[:, None] <= col, iota[:, None], jnp.int32(-1))
+    src = jax.lax.cummax(contrib, axis=0)
+    pos = sfx_off[src] + (col - pl[src])
+    return sfx[jnp.clip(pos, 0, sfx.shape[0] - 1)]
 
 
 @functools.partial(
@@ -637,19 +679,7 @@ def _fused_uniform_shard_fc_impl(plens, sfx, pkb, starts, min_his, min_los,
     The device reconstructs the key matrix with a cummax scan (source row
     of each inherited byte column) + one gather, then runs the shared
     tail. Output is bit-identical to the plain upload (parity-tested)."""
-    p = pkb.shape[0]
-    pl = plens.astype(jnp.int32)
-    sfx_len = jnp.int32(uk_len) - pl
-    sfx_off = jnp.cumsum(sfx_len) - sfx_len
-    iota = jnp.arange(p, dtype=jnp.int32)
-    col = jnp.arange(uk_len, dtype=jnp.int32)[None, :]
-    # Column j of row i inherits from the LAST row i' <= i with
-    # plen[i'] <= j; chunk starts have plen 0, so inheritance never
-    # crosses a chunk boundary.
-    contrib = jnp.where(pl[:, None] <= col, iota[:, None], jnp.int32(-1))
-    src = jax.lax.cummax(contrib, axis=0)
-    pos = sfx_off[src] + (col - pl[src])
-    kb = sfx[jnp.clip(pos, 0, sfx.shape[0] - 1)]
+    kb = _decode_front_coded(plens, sfx, uk_len)
     return _uniform_shard_tail(
         kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
         snap_hi, snap_lo, total, num_key_words, uk_len, bottommost,

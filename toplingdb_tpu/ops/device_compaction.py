@@ -438,6 +438,16 @@ def _prepare_uniform_shards(parts):
     return shards or None
 
 
+def _ranges_lmap(ranges) -> np.ndarray:
+    """Local shard row -> global concat row map for a shard's chunk
+    (global_lo, global_hi) spans."""
+    if not ranges:
+        return np.empty(0, np.int32)
+    return np.concatenate([
+        np.arange(lo, hi, dtype=np.int32) for lo, hi in ranges
+    ])
+
+
 def _kv_user_key(kv, r: int) -> bytes:
     o = int(kv.key_offs[r])
     return kv.key_buf[o: o + int(kv.key_lens[r]) - 8].tobytes()
@@ -560,6 +570,44 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # seqno side input (stripe-clamped on host; fragments are few).
         cover = (None if rd.empty() else _cover_for_parts(
             parts, rd, icmp.user_comparator, snapshots))
+        if not _host_sort():
+            from toplingdb_tpu.ops import block_assembly as ba
+
+            if ba.assembly_supported(table_options, kv, shards, any_complex,
+                                     compaction.max_output_file_size,
+                                     col.vtype):
+                # Full block build ON DEVICE: finished payloads come back,
+                # the host only frames + indexes (TPULSM_DEVICE_BLOCKS=1).
+                tombs = surviving_tombstone_fragments(
+                    rd, snapshots, compaction.bottommost,
+                    icmp.user_comparator,
+                )
+                files = ba.run_block_assembly(
+                    env, dbname, icmp, kv, shards[0], cover, snapshots,
+                    compaction.bottommost, table_options, new_file_number,
+                    creation_time, tombs, column_family,
+                )
+                outputs = []
+                for fnum, path, props, smallest, largest, _sel in files:
+                    if (props.num_entries == 0
+                            and props.num_range_deletions == 0):
+                        env.delete_file(path)
+                        continue
+                    meta = FileMetaData(
+                        number=fnum, file_size=env.get_file_size(path),
+                        smallest=smallest, largest=largest,
+                        smallest_seqno=props.smallest_seqno,
+                        largest_seqno=props.largest_seqno,
+                        num_entries=props.num_entries,
+                        num_deletions=props.num_deletions,
+                        num_range_deletions=props.num_range_deletions,
+                    )
+                    outputs.append(meta)
+                    stats.output_bytes += meta.file_size
+                    stats.output_files += 1
+                    stats.output_records += props.num_entries
+                stats.work_time_usec = int((time.time() - t0) * 1e6)
+                return outputs, stats
         if _host_sort():
             import types as _types
 
@@ -594,10 +642,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 orders, zfs, cxs = [], [], []
                 for (_chunks, ranges), pending in zip(shards, pendings):
                     o, z, cx, hc = ck.fused_uniform_shard_finish(pending)
-                    lmap = np.concatenate([
-                        np.arange(lo, hi, dtype=np.int32)
-                        for lo, hi in ranges
-                    ]) if ranges else np.empty(0, np.int32)
+                    lmap = _ranges_lmap(ranges)
                     orders.append(lmap[o])
                     zfs.append(z)
                     cxs.append(cx)
@@ -648,10 +693,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
                 if hc:
                     raise _FallbackToEntries()
-                lmap = np.concatenate([
-                    np.arange(lo, hi, dtype=np.int32)
-                    for lo, hi in ranges
-                ]) if ranges else np.empty(0, np.int32)
+                lmap = _ranges_lmap(ranges)
                 order_s = lmap[o]
                 zero_s = order_s[z]
                 trailer_override[zero_s] = \
