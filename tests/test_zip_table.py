@@ -1,0 +1,201 @@
+"""ZipTable (searchable-compression L2+ format): round-trip, seek
+semantics, DB integration via bottommost_format, recovery."""
+
+import random
+
+import pytest
+
+from toplingdb_tpu.db.dbformat import (
+    InternalKeyComparator,
+    ValueType,
+    make_internal_key,
+)
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.table.factory import new_table_builder, open_table
+from toplingdb_tpu.table import format as fmt
+
+ICMP = InternalKeyComparator()
+
+
+def _build(env, path, entries, topts):
+    w = env.new_writable_file(path)
+    b = new_table_builder(w, ICMP, topts)
+    for k, v in entries:
+        b.add(k, v)
+    props = b.finish()
+    w.close()
+    return props
+
+
+def _entries(rng, n, vlen_lo=4, vlen_hi=60):
+    out = {}
+    seq = 1
+    for _ in range(n):
+        k = b"user%07d" % rng.randrange(n * 3)
+        out[k] = (make_internal_key(k, seq, ValueType.VALUE),
+                  bytes(rng.randrange(97, 123)
+                        for _ in range(rng.randrange(vlen_lo, vlen_hi))))
+        seq += 1
+    return [out[k] for k in sorted(out)]
+
+
+@pytest.mark.parametrize("compression", [fmt.NO_COMPRESSION, fmt.ZSTD_COMPRESSION])
+@pytest.mark.parametrize("n", [1, 15, 16, 17, 400])
+def test_zip_round_trip(tmp_path, n, compression):
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.table.zip_table import ZipTableReader
+
+    env = default_env()
+    rng = random.Random(n + compression)
+    entries = _entries(rng, n)
+    topts = TableOptions(format="zip", compression=compression,
+                        filter_policy=None)
+    path = str(tmp_path / "t.sst")
+    props = _build(env, path, entries, topts)
+    assert props.num_entries == len(entries)
+    r = open_table(env.new_random_access_file(path), ICMP, topts)
+    assert isinstance(r, ZipTableReader)
+    it = r.new_iterator()
+    it.seek_to_first()
+    got = list(it.entries())
+    assert got == entries
+    # point seeks land on the exact entry
+    for k, v in entries[:: max(1, len(entries) // 37)]:
+        it.seek(k)
+        assert it.valid() and it.key() == k and it.value() == v
+    # seek between keys lands on the successor
+    for i in range(0, len(entries) - 1, max(1, len(entries) // 11)):
+        probe = entries[i][0][:-8] + b"\x00\xff"
+        it.seek(make_internal_key(probe, 1 << 40, ValueType.MAX))
+        assert it.valid() and it.key() == entries[i + 1][0]
+    # reverse iteration
+    it.seek_to_last()
+    rev = []
+    while it.valid():
+        rev.append((it.key(), it.value()))
+        it.prev()
+    assert rev == entries[::-1]
+
+
+def test_zip_dict_compression_and_big_values(tmp_path):
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.table.builder import CompressionOptions
+    from toplingdb_tpu.utils import codecs
+
+    if not codecs.available("zstd"):
+        pytest.skip("libzstd unavailable")
+    env = default_env()
+    rng = random.Random(7)
+    entries = []
+    for i in range(3000):
+        k = make_internal_key(b"k%07d" % i, i + 1, ValueType.VALUE)
+        v = (b"prefix-common-" * 3) + (b"%d" % (i % 50)) * rng.randrange(1, 9)
+        entries.append((k, v))
+    # one giant value forces the 32-bit length directory
+    entries[1234] = (entries[1234][0], b"Z" * 70000)
+    topts = TableOptions(format="zip", compression=fmt.ZSTD_COMPRESSION,
+                        filter_policy=None,
+                        compression_opts=CompressionOptions(max_dict_bytes=4096))
+    path = str(tmp_path / "d.sst")
+    props = _build(env, path, entries, topts)
+    assert props.compression_name == "zip+zstd"
+    r = open_table(env.new_random_access_file(path), ICMP, topts)
+    assert r.value_at(1234) == b"Z" * 70000
+    it = r.new_iterator()
+    it.seek_to_first()
+    assert list(it.entries()) == entries
+    # compressed smaller than raw
+    raw = sum(len(k) + len(v) for k, v in entries)
+    import os
+    assert os.path.getsize(path) < raw
+
+
+def test_zip_bottommost_format_in_db(tmp_path):
+    """Fill + flush + compact: bottommost outputs are zip tables; reads,
+    iteration and recovery all work over the mixed-format DB."""
+    import os
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    opts = Options(write_buffer_size=1 << 20, bottommost_format="zip",
+                   disable_auto_compactions=True)
+    d = str(tmp_path / "db")
+    with DB.open(d, opts) as db:
+        for i in range(5000):
+            db.put(b"key%06d" % (i % 2000), b"val%07d" % i)
+        db.delete_range(b"key000100", b"key000200")
+        db.flush()
+        db.compact_range()
+        assert db.get(b"key000150") is None
+        assert db.get(b"key001999") == b"val%07d" % 3999
+        it = db.new_iterator()
+        it.seek_to_first()
+        count = sum(1 for _ in it.entries())
+        assert count == 2000 - 100
+    with DB.open(d, opts) as db2:
+        assert db2.get(b"key000500") == b"val%07d" % 4500
+        assert db2.get(b"key000150") is None
+        # the bottommost file really is a zip table
+        from toplingdb_tpu.table.zip_table import ZipTableReader
+
+        v = db2.versions.current
+        files = [f for lvl, f in v.all_files() if lvl > 0]
+        assert files, "no bottommost files"
+        for f in files:
+            r = db2.table_cache.get_reader(f.number)
+            assert isinstance(r, ZipTableReader)
+
+
+def test_zip_tombstone_only_file(tmp_path):
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.table.zip_table import ZipTableReader
+
+    env = default_env()
+    topts = TableOptions(format="zip", filter_policy=None)
+    path = str(tmp_path / "t.sst")
+    w = env.new_writable_file(path)
+    b = new_table_builder(w, ICMP, topts)
+    b.add_tombstone(make_internal_key(b"a", 9, ValueType.RANGE_DELETION), b"m")
+    b.finish()
+    w.close()
+    r = open_table(env.new_random_access_file(path), ICMP, topts)
+    assert isinstance(r, ZipTableReader)
+    assert len(r.range_del_entries()) == 1
+    it = r.new_iterator()
+    it.seek_to_first()
+    assert not it.valid()
+
+
+def test_zip_long_keys_meta16(tmp_path):
+    """Keys past 255 bytes switch the front-coding meta to u16 pairs — no
+    compaction-killing cap."""
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.table.zip_table import ZipTableReader
+
+    env = default_env()
+    rng = random.Random(42)
+    entries = []
+    for i in range(120):
+        uk = (b"longprefix-" * 30) + b"%06d" % i  # ~336-byte user keys
+        entries.append((make_internal_key(uk, i + 1, ValueType.VALUE),
+                        b"v%04d" % i))
+    topts = TableOptions(format="zip", filter_policy=None)
+    path = str(tmp_path / "lk.sst")
+    _build(env, path, entries, topts)
+    r = open_table(env.new_random_access_file(path), ICMP, topts)
+    assert isinstance(r, ZipTableReader)
+    it = r.new_iterator()
+    it.seek_to_first()
+    assert list(it.entries()) == entries
+    it.seek(entries[77][0])
+    assert it.valid() and it.key() == entries[77][0]
+
+
+def test_bad_bottommost_format_fails_at_open(tmp_path):
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    with pytest.raises(InvalidArgument):
+        DB.open(str(tmp_path / "x"), Options(bottommost_format="Zip"))

@@ -140,6 +140,17 @@ class DB:
                 options.table_options,
                 prefix_extractor=options.prefix_extractor,
             )
+        if options.bottommost_format is not None:
+            from toplingdb_tpu.table.factory import FORMATS
+            from toplingdb_tpu.utils.status import InvalidArgument
+
+            if options.bottommost_format not in FORMATS:
+                # Fail at open — a typo must not surface hours later as a
+                # repeatedly failing background compaction.
+                raise InvalidArgument(
+                    f"bottommost_format {options.bottommost_format!r} is "
+                    f"not one of {FORMATS}"
+                )
         if getattr(options.table_options, "format", "block") == "plain":
             # Fail at open, not in a background flush/compaction job.
             from toplingdb_tpu.utils.slice_transform import (

@@ -128,6 +128,10 @@ class Options:
     # include/rocksdb/options.h): levels past the end reuse the last entry;
     # empty = `compression` (or table_options.compression).
     compression_per_level: list = field(default_factory=list)
+    # SST format for bottommost-level outputs (e.g. "zip": the
+    # searchable-compression ZipTable — the reference's ToplingZipTable
+    # L2+ role, README.md:50-56). None = table_options.format everywhere.
+    bottommost_format: Optional[str] = None
 
     # -- distributed compaction (the dcompact boundary) -----------------
     compaction_executor_factory: Any = None  # CompactionExecutorFactory
@@ -167,14 +171,19 @@ class Options:
         return self.table_options.compression
 
     def table_options_for_level(self, level: int, bottommost: bool = False):
-        """table_options with the per-level codec applied (identity when
-        nothing level-specific is configured)."""
+        """table_options with the per-level codec and bottommost format
+        applied (identity when nothing level-specific is configured)."""
         eff = self.compression_for_level(level, bottommost)
-        if eff == self.table_options.compression:
+        fmt_ = self.table_options.format
+        if bottommost and self.bottommost_format is not None:
+            fmt_ = self.bottommost_format
+        if eff == self.table_options.compression \
+                and fmt_ == self.table_options.format:
             return self.table_options
         import dataclasses
 
-        return dataclasses.replace(self.table_options, compression=eff)
+        return dataclasses.replace(self.table_options, compression=eff,
+                                   format=fmt_)
 
 
 @dataclass

@@ -18,9 +18,10 @@ from toplingdb_tpu.table.single_fast import (
     SingleFastTableBuilder,
     SingleFastTableReader,
 )
+from toplingdb_tpu.table.zip_table import ZipTableBuilder, ZipTableReader
 from toplingdb_tpu.utils.status import Corruption, InvalidArgument
 
-FORMATS = ("block", "single_fast", "cuckoo", "plain")
+FORMATS = ("block", "single_fast", "cuckoo", "plain", "zip")
 
 
 def new_table_builder(wfile, icmp, options: TableOptions | None = None,
@@ -40,6 +41,8 @@ def new_table_builder(wfile, icmp, options: TableOptions | None = None,
         return CuckooTableBuilder(wfile, icmp, options, **kw)
     if f == "plain":
         return PlainTableBuilder(wfile, icmp, options, **kw)
+    if f == "zip":
+        return ZipTableBuilder(wfile, icmp, options, **kw)
     raise InvalidArgument(f"unknown table format {f!r}")
 
 
@@ -58,4 +61,6 @@ def open_table(rfile, icmp, options: TableOptions | None = None,
         return CuckooTableReader(rfile, icmp, options)
     if magic == fmt.PLAIN_MAGIC:
         return PlainTableReader(rfile, icmp, options)
+    if magic == fmt.ZIP_MAGIC:
+        return ZipTableReader(rfile, icmp, options)
     raise Corruption(f"unknown SST magic {magic:#x}")

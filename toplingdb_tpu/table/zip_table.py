@@ -1,0 +1,597 @@
+"""ZipTable: the searchable-compression SST format for cold levels.
+
+The analogue of the reference's ToplingZipTable (the L2+ format of the
+absent topling-rocks submodule; /root/reference/README.md:50-56 bills it as
+"searchable compression": an FSA/succinct-trie key index + entropy-coded
+values, so point lookups never decompress a 4KB block). This re-design
+keeps the property that made it the reference's headline readrandom format
+(4.28M ops/s vs 376K for BlockBasedTable, BASELINE.md rows 19-22) with
+array-friendly structures instead of a trie:
+
+  keys    a front-coded dictionary in groups of G: each group's head key is
+          stored whole, followers as (shared-prefix len, suffix). Lookup =
+          binary search over group heads + a <=G-entry in-group decode —
+          no data blocks, no restart arrays, the whole dictionary stays
+          resident as flat numpy arrays.
+  values  compressed in mini-groups of VG with one ZSTD dictionary trained
+          over the file's values (util/compression dict training role), so
+          a point read decompresses ~1-4KB ONCE per group (cached) rather
+          than a block per miss; groups that don't shrink are stored raw
+          (per-group flag bit).
+
+Shares filter / properties / range-del meta blocks and the footer shape
+with the other formats; dispatched by footer magic ("tpulsmZT") through
+table/factory.py. Builder surface matches TableBuilder (build_outputs /
+flush compatible); target it at the bottommost level via
+Options.bottommost_format = "zip".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.block import BlockBuilder, BlockIter
+from toplingdb_tpu.table.builder import (
+    METAINDEX_FILTER,
+    METAINDEX_PROPERTIES,
+    METAINDEX_RANGE_DEL,
+    CompressionOptions,
+    TableOptions,
+)
+from toplingdb_tpu.table.filter import filter_policy_from_name
+from toplingdb_tpu.table.properties import TableProperties
+from toplingdb_tpu.utils import coding, crc32c
+from toplingdb_tpu.utils.status import Corruption, NotSupported
+
+METAINDEX_PARAMS = b"tpulsm.zt.params"
+METAINDEX_KEY_META = b"tpulsm.zt.k.meta"
+METAINDEX_KEY_SFX = b"tpulsm.zt.k.sfx"
+METAINDEX_KEY_GSO = b"tpulsm.zt.k.gso"
+METAINDEX_VAL_LENS = b"tpulsm.zt.v.lens"
+METAINDEX_VAL_GO = b"tpulsm.zt.v.go"
+METAINDEX_VAL_FLAGS = b"tpulsm.zt.v.flags"
+METAINDEX_VAL_DICT = b"tpulsm.zt.v.dict"
+METAINDEX_VAL_BLOB = b"tpulsm.zt.v.blob"
+
+_VERSION = 1
+_FLAG_LENS32 = 1
+_FLAG_HAS_DICT = 2
+_FLAG_META16 = 4  # key meta is u16 pairs (some internal key > 255 bytes)
+
+# Key-group width: binary search lands on a head, then decodes <= G-1
+# follower suffixes. 16 balances in-group decode cost vs head overhead.
+GROUP = 16
+# Value mini-group target: ~2KB of raw value bytes per compressed unit.
+VALUE_GROUP_TARGET = 2048
+
+
+class ZipTableBuilder:
+    """Same surface as TableBuilder (build_outputs/flush compatible)."""
+
+    FOOTER_MAGIC = fmt.ZIP_MAGIC
+
+    def __init__(self, wfile, icmp: InternalKeyComparator,
+                 options: TableOptions | None = None,
+                 column_family_id: int = 0, column_family_name: str = "",
+                 creation_time: int = 0):
+        self.opts = options or TableOptions()
+        self._w = wfile
+        self._icmp = icmp
+        self._keys: list[bytes] = []
+        self._vals: list[bytes] = []
+        self._approx_bytes = 0
+        self._filter_keys: list[bytes] = []
+        self._last_filter_prefix: bytes | None = None
+        self._range_del_block = BlockBuilder(restart_interval=1)
+        self.props = TableProperties(
+            comparator_name=icmp.user_comparator.name(),
+            filter_policy_name=(
+                self.opts.filter_policy.name() if self.opts.filter_policy
+                else ""
+            ),
+            compression_name="zip",
+            prefix_extractor_name=(
+                self.opts.prefix_extractor.name()
+                if getattr(self.opts, "prefix_extractor", None) else ""
+            ),
+            column_family_id=column_family_id,
+            column_family_name=column_family_name,
+            creation_time=creation_time,
+            smallest_seqno=dbformat.MAX_SEQUENCE_NUMBER,
+            whole_key_filtering=1 if self.opts.whole_key_filtering else 0,
+        )
+        self._last_key: bytes | None = None
+        self._smallest: bytes | None = None
+        self._largest: bytes | None = None
+        self._finished = False
+        self._collectors = [
+            f.create() for f in self.opts.properties_collector_factories
+        ]
+        self.need_compaction = False
+
+    @property
+    def num_entries(self) -> int:
+        return self.props.num_entries + self.props.num_range_deletions
+
+    def file_size(self) -> int:
+        return self._w.file_size() + self._approx_bytes
+
+    @property
+    def smallest_key(self) -> bytes | None:
+        return self._smallest
+
+    @property
+    def largest_key(self) -> bytes | None:
+        return self._largest
+
+    def _track_bounds(self, ikey: bytes) -> None:
+        if self._smallest is None or \
+                self._icmp.compare(ikey, self._smallest) < 0:
+            self._smallest = ikey
+        if self._largest is None or \
+                self._icmp.compare(ikey, self._largest) > 0:
+            self._largest = ikey
+        seq = dbformat.extract_seqno(ikey)
+        self.props.smallest_seqno = min(self.props.smallest_seqno, seq)
+        self.props.largest_seqno = max(self.props.largest_seqno, seq)
+
+    def add(self, ikey: bytes, value: bytes) -> None:
+        assert not self._finished
+        if self._last_key is not None:
+            assert self._icmp.compare(self._last_key, ikey) < 0
+        if len(ikey) >= 1 << 16:
+            raise NotSupported(
+                "zip table keys are capped at 64KiB (front-coding meta "
+                "is u16 at most); use the block format"
+            )
+        self._keys.append(ikey)
+        self._vals.append(value)
+        self._approx_bytes += len(ikey) + len(value) + 4
+        self._last_key = ikey
+        self._track_bounds(ikey)
+        uk, seq_, t = dbformat.split_internal_key(ikey)
+        if self.opts.filter_policy:
+            if self.opts.whole_key_filtering:
+                self._filter_keys.append(uk)
+            pe = getattr(self.opts, "prefix_extractor", None)
+            if pe is not None and pe.in_domain(uk):
+                p = pe.transform(uk)
+                if p != self._last_filter_prefix:
+                    self._filter_keys.append(p)
+                    self._last_filter_prefix = p
+        for c in self._collectors:
+            c.add_user_key(uk, value, t, seq_, self._approx_bytes)
+        self.props.num_entries += 1
+        self.props.raw_key_size += len(ikey)
+        self.props.raw_value_size += len(value)
+        if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
+            self.props.num_deletions += 1
+        elif t == ValueType.MERGE:
+            self.props.num_merge_operands += 1
+
+    def add_tombstone(self, begin_ikey: bytes, end_user_key: bytes) -> None:
+        assert not self._finished
+        self._range_del_block.add(begin_ikey, end_user_key)
+        self.props.num_range_deletions += 1
+        self._track_bounds(begin_ikey)
+        end_ikey = dbformat.make_internal_key(
+            end_user_key, dbformat.MAX_SEQUENCE_NUMBER,
+            dbformat.VALUE_TYPE_FOR_SEEK,
+        )
+        if self._largest is None or \
+                self._icmp.compare(end_ikey, self._largest) > 0:
+            self._largest = end_ikey
+
+    def _encode_keys(self) -> tuple[bytes, bytes, bytes, bool]:
+        """(meta (plen,slen) pairs, sfx blob, gso u32[nG], meta16) —
+        the front-coded key dictionary. Meta pairs are u8 unless any key
+        exceeds 255 bytes (then u16, flagged in params)."""
+        meta16 = any(len(k) > 255 for k in self._keys)
+        cap = 0xFFFF if meta16 else 0xFF
+        meta: list[int] = []
+        sfx = bytearray()
+        gso = []
+        prev = b""
+        for i, k in enumerate(self._keys):
+            if i % GROUP == 0:
+                gso.append(len(sfx))
+                plen = 0
+            else:
+                mx = min(len(prev), len(k))
+                plen = 0
+                while plen < mx and prev[plen] == k[plen]:
+                    plen += 1
+                plen = min(plen, cap)
+            meta.append(plen)
+            meta.append(len(k) - plen)
+            sfx += k[plen:]
+            prev = k
+        mraw = np.asarray(meta, dtype="<u2" if meta16 else np.uint8).tobytes()
+        return (mraw, bytes(sfx),
+                np.asarray(gso, dtype="<u4").tobytes(), meta16)
+
+    def _encode_values(self):
+        """(lens bytes, go u32[nVG+1], flags bitmask, dict, blob, vg,
+        lens32)"""
+        from toplingdb_tpu.utils import codecs
+
+        n = len(self._vals)
+        avg = (self.props.raw_value_size // n) if n else 1
+        vg = max(1, min(256, VALUE_GROUP_TARGET // max(1, avg)))
+        copts = getattr(self.opts, "compression_opts", None) \
+            or CompressionOptions()
+        compress = (self.opts.compression != fmt.NO_COMPRESSION
+                    and codecs.available("zstd"))
+        groups = [b"".join(self._vals[i:i + vg]) for i in range(0, n, vg)]
+        zdict = b""
+        if compress and copts.max_dict_bytes > 0 and len(groups) >= 8:
+            zdict = codecs.zstd_train_dictionary(
+                groups[:: max(1, len(groups) // 256)] or groups,
+                copts.max_dict_bytes,
+            )
+        blob = bytearray()
+        go = [0]
+        flags = bytearray((len(groups) + 7) // 8)
+        for gi, raw in enumerate(groups):
+            payload = raw
+            if compress and len(raw) >= 32:
+                z = codecs.zstd_compress(
+                    raw, copts.level if copts.level is not None else 3,
+                    zdict)
+                if len(z) < len(raw):
+                    payload = z
+                    flags[gi // 8] |= 1 << (gi % 8)
+            blob += payload
+            go.append(len(blob))
+        lens32 = any(len(v) >= 1 << 16 for v in self._vals)
+        lens = np.asarray([len(v) for v in self._vals],
+                          dtype="<u4" if lens32 else "<u2").tobytes()
+        if compress:
+            self.props.compression_name = "zip+zstd"
+        return (lens, np.asarray(go, dtype="<u4").tobytes(), bytes(flags),
+                zdict, bytes(blob), vg, lens32)
+
+    def finish(self) -> TableProperties:
+        assert not self._finished
+        for c in self._collectors:
+            self.props.user_collected.update(c.finish())
+            if c.need_compact():
+                self.need_compaction = True
+        kmeta, ksfx, kgso, meta16 = self._encode_keys()
+        vlens, vgo, vflags, vdict, vblob, vg, lens32 = self._encode_values()
+        n = len(self._keys)
+        self._keys = []
+        self._vals = []
+
+        meta_entries = []
+        metaindex = BlockBuilder(restart_interval=1)
+        flags = (_FLAG_LENS32 if lens32 else 0) | \
+            (_FLAG_HAS_DICT if vdict else 0) | \
+            (_FLAG_META16 if meta16 else 0)
+        params = b"".join(coding.encode_fixed32(x) for x in (
+            _VERSION, GROUP, vg, n, flags,
+        ))
+        for name, payload in (
+            (METAINDEX_PARAMS, params),
+            (METAINDEX_KEY_META, kmeta),
+            (METAINDEX_KEY_SFX, ksfx),
+            (METAINDEX_VAL_LENS, vlens),
+            (METAINDEX_VAL_GO, vgo),
+            (METAINDEX_VAL_FLAGS, vflags),
+            (METAINDEX_VAL_DICT, vdict),
+            (METAINDEX_VAL_BLOB, vblob),
+        ):
+            if name == METAINDEX_VAL_DICT and not vdict:
+                continue
+            h = fmt.write_block(self._w, payload, fmt.NO_COMPRESSION)
+            meta_entries.append((name, h))
+            if name == METAINDEX_VAL_BLOB:
+                self.props.data_size = len(vblob)
+        self.props.num_data_blocks = (n + vg - 1) // vg if n else 0
+        if self.opts.filter_policy and self._filter_keys:
+            fdata = self.opts.filter_policy.create_filter(self._filter_keys)
+            fh = fmt.write_block(self._w, fdata, fmt.NO_COMPRESSION)
+            self.props.filter_size = len(fdata)
+            meta_entries.append((METAINDEX_FILTER, fh))
+        if not self._range_del_block.empty():
+            rh = fmt.write_block(self._w, self._range_del_block.finish(),
+                                 fmt.NO_COMPRESSION)
+            meta_entries.append((METAINDEX_RANGE_DEL, rh))
+        self.props.index_size = len(kgso)
+        pblock = self.props.encode_block()
+        ph = fmt.write_block(self._w, pblock, fmt.NO_COMPRESSION)
+        meta_entries.append((METAINDEX_PROPERTIES, ph))
+        for name, handle in sorted(meta_entries):
+            metaindex.add(name, handle.encode())
+        mih = fmt.write_block(self._w, metaindex.finish(),
+                              fmt.NO_COMPRESSION)
+        ih = fmt.write_block(self._w, kgso, fmt.NO_COMPRESSION)
+        self._w.append(fmt.Footer(mih, ih, magic=self.FOOTER_MAGIC).encode())
+        self._w.flush()
+        self._finished = True
+        return self.props
+
+
+from toplingdb_tpu.table.single_fast import _Mem  # shared in-memory file view
+
+
+class ZipTableReader:
+    """Same surface as the other readers; the key dictionary and value
+    directory stay resident, value groups decompress lazily (cached)."""
+
+    FOOTER_MAGIC = fmt.ZIP_MAGIC
+
+    def __init__(self, rfile, icmp: InternalKeyComparator,
+                 options: TableOptions | None = None, block_cache=None,
+                 cache_key_prefix: bytes = b""):
+        self.opts = options or TableOptions()
+        self._icmp = icmp
+        size = rfile.size()
+        # The file bytes live only for this constructor: every section is
+        # copied out below, so keeping them would double resident memory.
+        data = rfile.read(0, size)
+        rfile.close()
+        mem = _Mem(data)
+        self.footer = fmt.Footer.decode(data, self.FOOTER_MAGIC)
+        meta = fmt.read_block(mem, self.footer.metaindex_handle,
+                              self.opts.verify_checksums)
+        mit = BlockIter(meta, dbformat.BYTEWISE.compare)
+        mit.seek_to_first()
+        self._meta_handles = {
+            k: fmt.BlockHandle.decode_exact(v) for k, v in mit.entries()
+        }
+        vc = self.opts.verify_checksums
+
+        def sect(name, required=True):
+            h = self._meta_handles.get(name)
+            if h is None:
+                if required:
+                    raise Corruption(f"zip table missing section {name!r}")
+                return b""
+            return fmt.read_block(mem, h, vc)
+
+        params = sect(METAINDEX_PARAMS)
+        if len(params) < 20:
+            raise Corruption("zip table params truncated")
+        ver = coding.decode_fixed32(params, 0)
+        if ver != _VERSION:
+            raise Corruption(f"zip table version {ver} unsupported")
+        self.G = coding.decode_fixed32(params, 4)
+        self.VG = coding.decode_fixed32(params, 8)
+        self.n = coding.decode_fixed32(params, 12)
+        flags = coding.decode_fixed32(params, 16)
+        self._kmeta = np.frombuffer(
+            sect(METAINDEX_KEY_META),
+            dtype="<u2" if flags & _FLAG_META16 else np.uint8,
+        )
+        self._ksfx = sect(METAINDEX_KEY_SFX)
+        # Group head offsets double as the footer's index block.
+        self._kgso = np.frombuffer(
+            fmt.read_block(mem, self.footer.index_handle, vc), dtype="<u4")
+        self._vlens = np.frombuffer(
+            sect(METAINDEX_VAL_LENS),
+            dtype="<u4" if flags & _FLAG_LENS32 else "<u2",
+        )
+        self._vgo = np.frombuffer(sect(METAINDEX_VAL_GO), dtype="<u4")
+        self._vflags = np.frombuffer(sect(METAINDEX_VAL_FLAGS),
+                                     dtype=np.uint8)
+        self._vdict = sect(METAINDEX_VAL_DICT, required=False) \
+            if flags & _FLAG_HAS_DICT else b""
+        self._vblob = sect(METAINDEX_VAL_BLOB)
+        # Per-group suffix start offsets; entry suffix offsets derive from
+        # one global exclusive cumsum of slen (kmeta odd bytes).
+        slen = self._kmeta[1::2].astype(np.int64)
+        self._soff = np.cumsum(slen) - slen
+        self.properties = TableProperties()
+        ph = self._meta_handles.get(METAINDEX_PROPERTIES)
+        if ph is not None:
+            self.properties = TableProperties.decode_block(
+                fmt.read_block(mem, ph, vc))
+        self._filter_data = None
+        self._filter_policy = None
+        fh = self._meta_handles.get(METAINDEX_FILTER)
+        if fh is not None:
+            self._filter_data = fmt.read_block(mem, fh, vc)
+            self._filter_policy = filter_policy_from_name(
+                self.properties.filter_policy_name)
+        rh = self._meta_handles.get(METAINDEX_RANGE_DEL)
+        self._range_del_data = fmt.read_block(mem, rh, vc) \
+            if rh is not None else None
+        self._nG = len(self._kgso)
+        from toplingdb_tpu.utils.slice_transform import resolve_file_extractor
+
+        self._resolved_pe = resolve_file_extractor(
+            getattr(self.opts, "prefix_extractor", None),
+            self.properties.prefix_extractor_name,
+        )
+
+    # --- key access ---
+
+    def _head(self, g: int) -> bytes:
+        o = int(self._kgso[g])
+        return self._ksfx[o: o + int(self._kmeta[2 * g * self.G + 1])]
+
+    def key_at(self, i: int) -> bytes:
+        """Decode entry i's internal key (walks its group prefix chain)."""
+        g = i // self.G
+        base = g * self.G
+        k = self._head(g)
+        for j in range(base + 1, i + 1):
+            pl = int(self._kmeta[2 * j])
+            o = int(self._soff[j])
+            k = k[:pl] + self._ksfx[o: o + int(self._kmeta[2 * j + 1])]
+        return k
+
+    def group_keys(self, g: int) -> list[bytes]:
+        """All internal keys of group g, decoded in one pass."""
+        base = g * self.G
+        end = min(base + self.G, self.n)
+        k = self._head(g)
+        out = [k]
+        for j in range(base + 1, end):
+            pl = int(self._kmeta[2 * j])
+            o = int(self._soff[j])
+            k = k[:pl] + self._ksfx[o: o + int(self._kmeta[2 * j + 1])]
+            out.append(k)
+        return out
+
+    def _group_for(self, target: bytes) -> int:
+        """Last group whose head <= target (internal order), or 0."""
+        lo, hi = 0, self._nG - 1
+        cmp = self._icmp.compare
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if cmp(self._head(mid), target) <= 0:
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    # --- value access ---
+
+    def _value_group(self, vg: int) -> tuple[bytes, np.ndarray]:
+        """(decoded group payload, in-group exclusive offsets). Stateless —
+        the reader is shared across threads via TableCache, so caching
+        lives in each (single-threaded) iterator instead."""
+        payload = self._vblob[int(self._vgo[vg]): int(self._vgo[vg + 1])]
+        if len(self._vflags) and self._vflags[vg // 8] & (1 << (vg % 8)):
+            from toplingdb_tpu.utils import codecs
+
+            payload = codecs.zstd_decompress(bytes(payload), self._vdict)
+        base = vg * self.VG
+        ls = self._vlens[base: base + self.VG].astype(np.int64)
+        return payload, np.concatenate([[0], np.cumsum(ls)])
+
+    def value_at(self, i: int) -> bytes:
+        """Uncached single-value decode (prefer iterator.value(), which
+        caches the group across adjacent reads)."""
+        payload, offs = self._value_group(i // self.VG)
+        off = int(offs[i % self.VG])
+        return bytes(payload[off: off + int(self._vlens[i])])
+
+    # --- reader surface ---
+
+    def key_may_match(self, user_key: bytes) -> bool:
+        if self._filter_data is None or self._filter_policy is None:
+            return True
+        if self.properties.whole_key_filtering:
+            return self._filter_policy.key_may_match(user_key,
+                                                     self._filter_data)
+        pe = self._resolved_pe
+        if pe is not None and pe.in_domain(user_key):
+            return self._filter_policy.key_may_match(pe.transform(user_key),
+                                                     self._filter_data)
+        return True
+
+    def new_iterator(self) -> "ZipTableIterator":
+        return ZipTableIterator(self)
+
+    def range_del_entries(self):
+        if self._range_del_data is None:
+            return []
+        it = BlockIter(self._range_del_data, self._icmp.compare)
+        it.seek_to_first()
+        return list(it.entries())
+
+    def approximate_offset_of(self, ikey: bytes) -> int:
+        if not self.n:
+            return 0
+        g = self._group_for(ikey)
+        return int(self._vgo[min(g * self.G // self.VG,
+                                 len(self._vgo) - 1)])
+
+    def anchors(self, max_anchors: int = 32):
+        if not self.n:
+            return []
+        step = max(1, self.n // max_anchors)
+        return [self.key_at(i)
+                for i in range(0, self.n, step)][:max_anchors]
+
+    def close(self) -> None:
+        pass
+
+
+class ZipTableIterator:
+    """Forward/backward iterator over one ZipTable (TableIterator shape)."""
+
+    def __init__(self, r: ZipTableReader):
+        self._r = r
+        self._i = r.n
+        self._gkeys: list[bytes] = []
+        self._g = -1
+        self._vg = -1
+        self._vg_payload: bytes = b""
+        self._vg_offs: np.ndarray | None = None
+
+    def _load(self, g: int) -> None:
+        if g != self._g:
+            self._gkeys = self._r.group_keys(g)
+            self._g = g
+
+    def valid(self) -> bool:
+        return 0 <= self._i < self._r.n
+
+    def key(self) -> bytes:
+        self._load(self._i // self._r.G)
+        return self._gkeys[self._i % self._r.G]
+
+    def value(self) -> bytes:
+        r = self._r
+        vg = self._i // r.VG
+        if vg != self._vg:
+            self._vg_payload, self._vg_offs = r._value_group(vg)
+            self._vg = vg
+        off = int(self._vg_offs[self._i % r.VG])
+        return bytes(
+            self._vg_payload[off: off + int(r._vlens[self._i])])
+
+    def seek_to_first(self) -> None:
+        self._i = 0
+
+    def seek_to_last(self) -> None:
+        self._i = self._r.n - 1
+
+    def seek(self, target: bytes) -> None:
+        r = self._r
+        if not r.n:
+            self._i = 0
+            return
+        g = r._group_for(target)
+        self._load(g)
+        cmp = r._icmp.compare
+        base = g * r.G
+        lo, hi = 0, len(self._gkeys)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if cmp(self._gkeys[mid], target) < 0:
+                lo = mid + 1
+            else:
+                hi = mid
+        # lo == len(gkeys) lands on the next group's head ordinal, which is
+        # > target by _group_for's choice; head(0) > target leaves i at 0.
+        self._i = base + lo
+
+    def seek_for_prev(self, target: bytes) -> None:
+        self.seek(target)
+        if not self.valid():
+            self.seek_to_last()
+            return
+        if self._r._icmp.compare(self.key(), target) > 0:
+            self.prev()
+
+    def seek_ordinal(self, i: int) -> None:
+        self._i = i
+
+    def next(self) -> None:
+        self._i += 1
+
+    def prev(self) -> None:
+        self._i -= 1
+
+    def entries(self):
+        while self.valid():
+            yield self.key(), self.value()
+            self.next()

@@ -110,6 +110,47 @@ def main(argv=None) -> int:
         run("native_sort", lambda: ck.host_sort_order(kb, ko, kl), n)
     run("lexsort_twin",
         lambda: ck.host_encode_sort(kb, ko, kl, 12), n)
+
+    # readrandom: ZipTable (searchable compression, ToplingZipTable role)
+    # vs BlockBasedTable+zstd — the BASELINE.md rows 19-22 comparison.
+    import random as _random
+
+    from toplingdb_tpu.table import format as fmt
+    from toplingdb_tpu.table.factory import new_table_builder, open_table
+    from toplingdb_tpu.utils import codecs
+
+    zstd_ok = codecs.available("zstd")
+    probes = _random.Random(3).sample(range(n), min(n, 20_000))
+    probe_keys = [entries[i][0] for i in probes]
+
+    def build_fmt(path, topt):
+        w = env.new_writable_file(path)
+        b = new_table_builder(w, icmp, topt)
+        for ik, v in entries:
+            b.add(ik, v)
+        b.finish()
+        w.close()
+
+    def readrandom(path, topt):
+        r = open_table(env.new_random_access_file(path), icmp, topt)
+        it = r.new_iterator()
+        for ik in probe_keys:
+            it.seek(ik)
+            assert it.valid() and it.key() == ik
+
+    if zstd_ok:
+        t_block = TableOptions(compression=fmt.ZSTD_COMPRESSION,
+                               filter_policy=None)
+        t_zip = TableOptions(format="zip", compression=fmt.ZSTD_COMPRESSION,
+                             filter_policy=None)
+        if args.filter in "readrandom_block_zstd" or \
+                args.filter in "readrandom_zip":
+            build_fmt("/mb_block.sst", t_block)
+            build_fmt("/mb_zip.sst", t_zip)
+        run("readrandom_block_zstd",
+            lambda: readrandom("/mb_block.sst", t_block), len(probe_keys))
+        run("readrandom_zip",
+            lambda: readrandom("/mb_zip.sst", t_zip), len(probe_keys))
     return 0
 
 
