@@ -92,6 +92,26 @@ class NativeSkipListRep(MemTableRep):
             self._h, uk, len(uk), inv, value, len(value)
         )
 
+    def insert_wb(self, rep: bytes, first_seq: int):
+        """Wire-image batch insert: ONE GIL-releasing native call parses
+        the WriteBatch bytes and splices every point record (lock-free, so
+        concurrent writers scale). Returns (count, mem_delta, deletes) or
+        None when the native side can't take the batch (no symbol,
+        CF-prefixed/range records, corruption → caller falls back)."""
+        import ctypes
+
+        from toplingdb_tpu import native
+
+        cl = native.lib()  # CDLL: releases the GIL during the call
+        if cl is None or not hasattr(cl, "tpulsm_skiplist_insert_wb"):
+            return None
+        out = (ctypes.c_int64 * 2)()
+        rc = cl.tpulsm_skiplist_insert_wb(self._h, rep, len(rep),
+                                          first_seq, out)
+        if rc < 0:
+            return None
+        return int(rc), int(out[0]), int(out[1])
+
     def insert_batch(self, keybuf, key_offs, key_lens, invs,
                      valbuf, val_offs, val_lens, n: int) -> None:
         """Bulk insert from flat numpy buffers — ONE ctypes call with the
@@ -405,6 +425,27 @@ class MemTable:
             self._mem_usage += len(user_key) + len(value) + 24
             if self._first_seqno is None:
                 self._first_seqno = seq
+
+    def add_encoded(self, first_seq: int, rep: bytes) -> int | None:
+        """Apply a whole WriteBatch wire image in one native call (the
+        WriteBatchInternal::InsertInto hot loop with zero per-record
+        Python). Returns the count applied, or None when the native fast
+        path can't take it (caller uses the parsed path). Thread-safe
+        against concurrent add/add_batch/add_encoded callers."""
+        wb = getattr(self._rep, "insert_wb", None)
+        if wb is None:
+            return None
+        res = wb(rep, first_seq)
+        if res is None:
+            return None
+        count, delta, deletes = res
+        with self._lock:
+            self._num_entries += count
+            self._num_deletes += deletes
+            self._mem_usage += delta
+            if self._first_seqno is None:
+                self._first_seqno = first_seq
+        return count
 
     def add_batch(self, first_seq: int, ops) -> int:
         """Apply a run of parsed ops [(type, key, value_or_None)] with

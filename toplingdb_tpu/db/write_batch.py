@@ -30,17 +30,22 @@ _CF_FLAG = 0x80
 
 class WriteBatch:
     def __init__(self, data: bytes | None = None):
+        # _simple: only default-CF point records so far — eligible for the
+        # one-call native wire-image insert (wire-loaded batches decode
+        # through the parsed path, so they start non-simple).
         if data is not None:
             if len(data) < HEADER_SIZE:
                 raise Corruption("write batch header too small")
             self._rep = bytearray(data)
             self._ops = None  # unknown provenance: decode when applying
+            self._simple = False
         else:
             self._rep = bytearray(HEADER_SIZE)
             # Ops built through this object are ALSO kept parsed so
             # insert_into need not re-decode the bytes it just encoded
             # (write-path hot loop); wire-deserialized batches decode.
             self._ops: list | None = []
+            self._simple = True
 
     # -- mutation -------------------------------------------------------
 
@@ -64,6 +69,8 @@ class WriteBatch:
         coding.put_length_prefixed_slice(self._rep, blob)
 
     def _add_record(self, t: ValueType, cf: int, *slices: bytes) -> None:
+        if cf != 0 or t == ValueType.RANGE_DELETION:
+            self._simple = False
         if cf == 0:
             self._rep.append(t)
         else:
@@ -84,11 +91,13 @@ class WriteBatch:
     def clear(self) -> None:
         self._rep = bytearray(HEADER_SIZE)
         self._ops = []
+        self._simple = True
 
     def append_from(self, other: "WriteBatch") -> None:
         """Group-commit helper: append other's records to self."""
         self._rep += other._rep[HEADER_SIZE:]
         self.set_count(self.count() + other.count())
+        self._simple = self._simple and other._simple
         if self._ops is not None:
             if other._ops is not None:
                 self._ops.extend(other._ops)
@@ -171,10 +180,19 @@ class WriteBatch:
         """Apply to one memtable (single-CF) or a {cf_id: memtable} dict;
         returns the number of sequence numbers consumed (== count).
         Records for CFs absent from the dict are skipped (dropped CF).
-        Runs of consecutive records for the same memtable go through
-        MemTable.add_batch (one GIL-releasing native call per run)."""
+        Simple batches (default-CF point records only) apply through ONE
+        native wire-image call (MemTable.add_encoded — no per-record
+        Python); the rest run the parsed path with one GIL-releasing
+        native call per same-memtable run."""
         seq = self.sequence() if sequence is None else sequence
         is_map = isinstance(memtable, dict)
+        mem0 = memtable.get(0) if is_map else memtable
+        if self._simple and self.count():
+            if mem0 is None:
+                return self.count()  # default CF dropped: all skipped
+            enc = getattr(mem0, "add_encoded", None)
+            if enc is not None and enc(seq, bytes(self._rep)) is not None:
+                return self.count()
         run_mem = None
         run_seq = seq
         run: list = []

@@ -127,6 +127,16 @@ def lib() -> ctypes.CDLL | None:
             ]
         except AttributeError:
             pass
+        try:
+            # WriteBatch wire-image insert: parse + insert natively, one
+            # GIL-free call per batch (no per-record Python/numpy at all).
+            l.tpulsm_skiplist_insert_wb.restype = ctypes.c_int64
+            l.tpulsm_skiplist_insert_wb.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_uint64, i64p,
+            ]
+        except AttributeError:
+            pass
         _lib = l
         return _lib
 

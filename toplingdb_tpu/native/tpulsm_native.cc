@@ -955,4 +955,68 @@ int64_t tpulsm_skiplist_insert_batch(
   return fresh;
 }
 
+// Insert every counted record of a WriteBatch WIRE IMAGE (db/write_batch.py
+// format: fixed64 seq | fixed32 count | [type][varint klen][key]
+// [varint vlen][value]...) into the skiplist — ONE GIL-free ctypes call
+// per batch, no per-record Python or numpy. Parses in two passes: a
+// validation scan first, so a batch this parser cannot take (non-default
+// CF record, range deletion, corruption) is rejected with NOTHING
+// inserted and the caller falls back to the Python path.
+// Returns inserted count; out[0] = memtable byte delta (k+v+24 per
+// record), out[1] = point-delete count. rc: -2 unsupported record,
+// -4 corrupt. Concurrency-safe (lock-free splice per record).
+int64_t tpulsm_skiplist_insert_wb(void* h, const uint8_t* rep, int64_t len,
+                                  uint64_t first_seq, int64_t* out) {
+  static const uint8_t kValue = 0x1, kDelete = 0x0, kMerge = 0x2,
+                       kSingleDelete = 0x7, kLogData = 0x3;
+  if (len < 12) return -4;
+  const uint8_t* end = rep + len;
+  uint32_t hdr_count = (uint32_t)rep[8] | ((uint32_t)rep[9] << 8) |
+                       ((uint32_t)rep[10] << 16) | ((uint32_t)rep[11] << 24);
+  for (int pass = 0; pass < 2; pass++) {
+    const uint8_t* p = rep + 12;
+    SkipList* sl = static_cast<SkipList*>(h);
+    uint64_t seq = first_seq;
+    int64_t count = 0, delta = 0, deletes = 0;
+    while (p < end) {
+      uint8_t t = *p++;
+      if (t & 0x80) return -2;  // CF-prefixed record: Python path
+      uint32_t klen, vlen = 0;
+      p = get_varint32(p, end, &klen);
+      if (!p || p + klen > end) return -4;
+      const uint8_t* k = p;
+      p += klen;
+      const uint8_t* v = p;
+      if (t == kValue || t == kMerge) {
+        p = get_varint32(p, end, &vlen);
+        if (!p || p + vlen > end) return -4;
+        v = p;
+        p += vlen;
+      } else if (t == kDelete || t == kSingleDelete) {
+        // key only
+      } else if (t == kLogData) {
+        continue;  // not counted, not applied (klen was the blob)
+      } else {
+        return -2;  // RANGE_DELETION etc.: Python path
+      }
+      if (pass == 1) {
+        uint64_t inv = ~((seq << 8) | (uint64_t)t);
+        sl->insert(k, klen, inv, v, vlen);
+        delta += (int64_t)klen + vlen + 24;
+        if (t == kDelete || t == kSingleDelete) deletes++;
+      }
+      seq++;
+      count++;
+    }
+    if (pass == 0) {
+      if ((uint32_t)count != hdr_count) return -4;
+    } else {
+      out[0] = delta;
+      out[1] = deletes;
+      return count;
+    }
+  }
+  return -4;  // unreachable
+}
+
 }  // extern "C"

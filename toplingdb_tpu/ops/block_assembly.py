@@ -271,6 +271,8 @@ def assembly_supported(table_options, kv, shards, any_complex,
         return False
     if shards is None or len(shards) != 1 or any_complex:
         return False
+    if getattr(table_options, "format", "block") != "block":
+        return False
     if table_options.compression != fmt.NO_COMPRESSION:
         return False
     if table_options.filter_policy is not None:

@@ -155,54 +155,68 @@ def scan_table_columnar(reader) -> ColumnarKV:
             val_out[:val_used].copy(), val_offs[:n].copy(), val_lens[:n].copy(),
         )
 
-    parts = []
-    for handle in handles:
+    # Compressed file: decompress + decode per block on a thread pool (the
+    # codecs and the native decoder both release the GIL, so the fallback
+    # scales with cores instead of crawling block-by-block).
+    from concurrent.futures import ThreadPoolExecutor
+
+    def _decode_one(handle):
         data = reader._read_data_block(handle)
-        blen = len(data)
-        key_cap = 4 * blen + 4096
-        val_cap = blen + 4096
-        max_e = blen // 3 + 16
-        while True:
-            key_out = np.empty(key_cap, dtype=np.uint8)
-            val_out = np.empty(val_cap, dtype=np.uint8)
-            key_offs = np.empty(max_e, dtype=np.int32)
-            key_lens = np.empty(max_e, dtype=np.int32)
-            val_offs = np.empty(max_e, dtype=np.int32)
-            val_lens = np.empty(max_e, dtype=np.int32)
-            rc = lib.tpulsm_decode_block(
-                bytes(data), blen,
-                native.np_u8p(key_out), key_cap,
-                native.np_u8p(val_out), val_cap,
-                native.np_i32p(key_offs), native.np_i32p(key_lens),
-                native.np_i32p(val_offs), native.np_i32p(val_lens), max_e,
-            )
-            if rc == -2:
-                key_cap *= 4
-                continue
-            if rc == -3:
-                val_cap *= 4
-                continue
-            if rc == -4:
-                max_e *= 4
-                continue
-            if rc == -7:
-                raise NotSupported("input too large for native columnar path")
-            if rc < 0:
-                raise Corruption(f"native block decode failed rc={rc}")
-            break
-        n = int(rc)
-        key_used = int(key_offs[n - 1] + key_lens[n - 1]) if n else 0
-        val_used = int(val_offs[n - 1] + val_lens[n - 1]) if n else 0
-        parts.append(ColumnarKV(
-            key_out[:key_used].copy(), key_offs[:n].copy(), key_lens[:n].copy(),
-            val_out[:val_used].copy(), val_offs[:n].copy(), val_lens[:n].copy(),
-        ))
+        return _decode_block_part(lib, data)
+
+    if len(handles) > 8:
+        with ThreadPoolExecutor(8) as ex:
+            parts = list(ex.map(_decode_one, handles))
+    else:
+        parts = [_decode_one(h) for h in handles]
     if not parts:
         return ColumnarKV(
             np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
             np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
         )
     return ColumnarKV.concat(parts)
+
+
+def _decode_block_part(lib, data: bytes) -> ColumnarKV:
+    blen = len(data)
+    key_cap = 4 * blen + 4096
+    val_cap = blen + 4096
+    max_e = blen // 3 + 16
+    while True:
+        key_out = np.empty(key_cap, dtype=np.uint8)
+        val_out = np.empty(val_cap, dtype=np.uint8)
+        key_offs = np.empty(max_e, dtype=np.int32)
+        key_lens = np.empty(max_e, dtype=np.int32)
+        val_offs = np.empty(max_e, dtype=np.int32)
+        val_lens = np.empty(max_e, dtype=np.int32)
+        rc = lib.tpulsm_decode_block(
+            bytes(data), blen,
+            native.np_u8p(key_out), key_cap,
+            native.np_u8p(val_out), val_cap,
+            native.np_i32p(key_offs), native.np_i32p(key_lens),
+            native.np_i32p(val_offs), native.np_i32p(val_lens), max_e,
+        )
+        if rc == -2:
+            key_cap *= 4
+            continue
+        if rc == -3:
+            val_cap *= 4
+            continue
+        if rc == -4:
+            max_e *= 4
+            continue
+        if rc == -7:
+            raise NotSupported("input too large for native columnar path")
+        if rc < 0:
+            raise Corruption(f"native block decode failed rc={rc}")
+        break
+    n = int(rc)
+    key_used = int(key_offs[n - 1] + key_lens[n - 1]) if n else 0
+    val_used = int(val_offs[n - 1] + val_lens[n - 1]) if n else 0
+    return ColumnarKV(
+        key_out[:key_used].copy(), key_offs[:n].copy(), key_lens[:n].copy(),
+        val_out[:val_used].copy(), val_offs[:n].copy(), val_lens[:n].copy(),
+    )
 
 
 class _ColumnarSST:

@@ -630,10 +630,11 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                     ck.upload_uniform_shard(chunks, covers_s), snapshots,
                     compaction.bottommost,
                 ))
-            if not any_complex:
+            if not any_complex and \
+                    getattr(table_options, "format", "block") == "block":
                 # STREAM each shard's survivors straight into the SST
                 # writer — block building overlaps the remaining shards'
-                # compute + download.
+                # compute + download. (The zip writer is whole-array.)
                 streamed = True
             else:
                 # Complex groups must fold BEFORE the writer hoists its
@@ -709,13 +710,28 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     outputs = []
     if order is None or len(order) or tombs:
         try:
-            files = write_tables_columnar(
-                env, dbname, new_file_number, icmp, table_options, kv,
-                order_feed, trailer_override, vtypes, seqs, tombs,
-                creation_time if creation_time is not None else int(time.time()),
-                max_output_file_size=compaction.max_output_file_size,
-                column_family=column_family,
-            )
+            if getattr(table_options, "format", "block") == "zip":
+                from toplingdb_tpu.table.zip_table import (
+                    write_tables_zip_columnar,
+                )
+
+                files = write_tables_zip_columnar(
+                    env, dbname, new_file_number, icmp, table_options, kv,
+                    order_feed, trailer_override, vtypes, seqs, tombs,
+                    creation_time if creation_time is not None
+                    else int(time.time()),
+                    max_output_file_size=compaction.max_output_file_size,
+                    column_family=column_family,
+                )
+            else:
+                files = write_tables_columnar(
+                    env, dbname, new_file_number, icmp, table_options, kv,
+                    order_feed, trailer_override, vtypes, seqs, tombs,
+                    creation_time if creation_time is not None
+                    else int(time.time()),
+                    max_output_file_size=compaction.max_output_file_size,
+                    column_family=column_family,
+                )
         except NotSupported:
             # Native builder refused (oversized key / restart overflow):
             # the per-entry path handles these (partials already cleaned).
@@ -766,7 +782,8 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
             and compaction_filter is None
             and (blob_gc is None or not blob_gc.active)
             and not getattr(table_options, "properties_collector_factories", None)
-            and getattr(table_options, "format", "block") == "block"
+            and getattr(table_options, "format", "block") in ("block",
+                                                                "zip")
             and getattr(table_options, "index_type", "binary") == "binary"
             and icmp.user_comparator.name() == dbformat.BYTEWISE.name()):
         try:
