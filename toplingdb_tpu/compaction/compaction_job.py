@@ -38,12 +38,17 @@ class CompactionStats:
     input_bytes: int = 0
     output_bytes: int = 0
     output_files: int = 0
+    input_files: int = 0
     dropped_obsolete: int = 0
     dropped_tombstone: int = 0
     merged_records: int = 0
     work_time_usec: int = 0
-    rpc_time_usec: int = 0   # transport time for remote jobs (curl analogue)
+    rpc_time_usec: int = 0      # transport time for remote jobs (curl role)
+    prepare_time_usec: int = 0  # params serde + job-dir/open setup (worker)
+    waiting_time_usec: int = 0  # queue wait before the job ran (worker)
+    transfer_time_usec: int = 0  # host<->device upload+download (device jobs)
     device: str = "cpu"
+    remote: bool = False        # ran in a worker process (dcompact)
 
 
 def collect_inputs(compaction: Compaction, table_cache, icmp):
@@ -291,6 +296,7 @@ def run_compaction_to_tables(
     t0 = time.time()
     stats = CompactionStats()
     stats.input_bytes = compaction.total_input_bytes()
+    stats.input_files = len(compaction.all_inputs())
     gc_active = blob_gc is not None and blob_gc.active
     bounds = (
         gen_subcompaction_boundaries(compaction, icmp, max_subcompactions)

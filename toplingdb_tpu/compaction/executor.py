@@ -313,6 +313,7 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             outputs.append(decode_file_meta(d, num))
         stats = CompactionStats(**results.stats)
         stats.device = self.device
+        stats.remote = True
         stats.work_time_usec = results.work_time_usec
         # Transport time, the analogue of the reference's curl_time_usec.
         stats.rpc_time_usec = rpc_usec - results.work_time_usec

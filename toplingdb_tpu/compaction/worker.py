@@ -37,7 +37,15 @@ def run_job(job_dir: str) -> int:
     from toplingdb_tpu.table.factory import open_table
     from toplingdb_tpu.utils.compaction_filter import create_compaction_filter
 
-    with open(os.path.join(job_dir, "params.json")) as f:
+    t_enter = time.time()
+    pjson = os.path.join(job_dir, "params.json")
+    try:
+        # Queue wait: params were written when the DB submitted the job
+        # (reference CompactionResults::waiting_time_usec).
+        waiting_usec = max(0, int((t_enter - os.path.getmtime(pjson)) * 1e6))
+    except OSError:
+        waiting_usec = 0
+    with open(pjson) as f:
         params = CompactionParams.from_json(f.read())
     t0 = time.time()
     env = default_env()
@@ -96,7 +104,12 @@ def run_job(job_dir: str) -> int:
 
     stats = CompactionStats(device=params.device)
     stats.input_records = len(entries)
+    stats.input_files = len(params.input_files)
     stats.input_bytes = sum(env.get_file_size(p) for p in params.input_files)
+    # Setup + input scan before the merge/GC work starts (the reference's
+    # prepare_time_usec, compaction_executor.h:146-150).
+    stats.prepare_time_usec = int((time.time() - t_enter) * 1e6)
+    stats.waiting_time_usec = waiting_usec
 
     fake_compaction = Compaction(
         level=0, output_level=params.output_level, inputs=[],
@@ -152,7 +165,10 @@ def run_job(job_dir: str) -> int:
             encode_file_meta(m, f"{m.number:06d}.sst") for m in outputs
         ],
         stats=dataclasses.asdict(stats),
-        work_time_usec=int((time.time() - t0) * 1e6),
+        # Disjoint from prepare: waiting + prepare + work partition the
+        # worker's wall clock (reference CompactionResults fields).
+        work_time_usec=max(
+            0, int((time.time() - t_enter) * 1e6) - stats.prepare_time_usec),
     )
     with open(os.path.join(job_dir, "results.json"), "w") as f:
         f.write(results.to_json())

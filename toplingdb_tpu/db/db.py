@@ -174,6 +174,7 @@ class DB:
         self.table_cache = TableCache(env, dbname, self.icmp,
                                       options.table_options,
                                       block_cache=options.block_cache)
+        self.table_cache.stats = options.statistics
         self.default_cf = ColumnFamilyHandle(0, "default")
         self._cfs: dict[int, _CFData] = {
             0: _CFData(self.default_cf, self.icmp, options.memtable_rep)
@@ -753,17 +754,31 @@ class DB:
         """WAL append + durability for one group (caller holds _mutex)."""
         if self.options.wal_enabled and not group[0].opts.disable_wal:
             if len(group) == 1:
-                self._wal.add_record(group[0].batch.data())
+                rec = group[0].batch.data()
             else:
                 merged = WriteBatch()
                 merged.set_sequence(first_seq)
                 for w in group:
                     merged.append_from(w.batch)
-                self._wal.add_record(merged.data())
+                rec = merged.data()
+            self._wal.add_record(rec)
             if any(w.opts.sync for w in group):
+                t_sync = time.perf_counter() if self.stats is not None else 0
                 self._wal.sync()
+                if self.stats is not None:
+                    from toplingdb_tpu.utils import statistics as st
+
+                    self.stats.record_tick(st.WAL_SYNCS)
+                    self.stats.record_in_histogram(
+                        st.WAL_FILE_SYNC_MICROS,
+                        (time.perf_counter() - t_sync) * 1e6)
             else:
                 self._wal.flush()
+            if self.stats is not None:
+                from toplingdb_tpu.utils import statistics as st
+
+                self.stats.record_tick(st.WAL_BYTES, len(rec))
+                self.stats.record_tick(st.WRITE_WITH_WAL, len(group))
             from toplingdb_tpu.utils.kill_point import test_kill_random
 
             test_kill_random("DBImpl::WriteImpl:AfterWAL")
@@ -893,9 +908,13 @@ class DB:
                 self.stats.record_tick(
                     st.NUMBER_KEYS_WRITTEN, sum(w.batch.count() for w in group)
                 )
-                self.stats.record_tick(
-                    st.BYTES_WRITTEN, sum(w.batch.data_size() for w in group)
-                )
+                bw = sum(w.batch.data_size() for w in group)
+                self.stats.record_tick(st.BYTES_WRITTEN, bw)
+                self.stats.record_in_histogram(st.BYTES_PER_WRITE, bw)
+                self.stats.record_tick(st.WRITE_DONE_BY_SELF)
+                if len(group) > 1:
+                    self.stats.record_tick(st.WRITE_DONE_BY_OTHER,
+                                           len(group) - 1)
             total_mem = sum(
                 c.mem.approximate_memory_usage() for c in self._cfs.values()
             )
@@ -1060,6 +1079,10 @@ class DB:
             if ucmp.compare(t.begin, key) <= 0 and ucmp.compare(key, t.end) < 0:
                 ctx.add_tombstone_seq(t.seq)
         if not reader.key_may_match(key):
+            if self.stats is not None:
+                from toplingdb_tpu.utils import statistics as st
+
+                self.stats.record_tick(st.BLOOM_USEFUL)
             return True, it
         if getattr(reader, "has_hash_index", False):
             # O(1) bucket probe (single_fast hash index): lands on the
@@ -1108,27 +1131,62 @@ class DB:
             blob_resolver=self.blob_source.get,
             excluded_ranges=self._excluded_for(opts),
         )
+        st_on = self.stats is not None
+        t0 = time.perf_counter() if st_on else 0.0
         # 1. Active memtable, then immutables (newest first).
         for mem in [cfd.mem] + cfd.imm:
             if not self._probe_memtable(mem, key, snap_seq, ctx):
-                return ctx.result()
+                val = ctx.result()
+                if st_on:
+                    self._record_get_stats(t0, val, "mem")
+                return val
         # 2. SST files, newest data first.
         version = self.versions.cf_current(cfd.handle.id)
-        self._walk_sst_chain(version, key, snap_seq, ctx)
-        return ctx.result()
+        hit_level = self._walk_sst_chain(version, key, snap_seq, ctx)
+        val = ctx.result()
+        if st_on:
+            self._record_get_stats(t0, val, hit_level)
+        return val
+
+    def _record_get_stats(self, t0: float, val, src) -> None:
+        """Read-path ticker family (reference MEMTABLE_HIT/GET_HIT_L*,
+        statistics.h)."""
+        from toplingdb_tpu.utils import statistics as st
+
+        s = self.stats
+        s.record_in_histogram(st.DB_GET_MICROS,
+                              (time.perf_counter() - t0) * 1e6)
+        s.record_tick(st.NUMBER_KEYS_READ)
+        if val is not None:
+            s.record_tick(st.BYTES_READ, len(val))
+            s.record_in_histogram(st.BYTES_PER_READ, len(val))
+        if src == "mem":
+            s.record_tick(st.MEMTABLE_HIT)
+            return
+        s.record_tick(st.MEMTABLE_MISS)
+        if src is None:
+            return
+        if src == 0:
+            s.record_tick(st.GET_HIT_L0)
+        elif src == 1:
+            s.record_tick(st.GET_HIT_L1)
+        else:
+            s.record_tick(st.GET_HIT_L2_AND_UP)
 
     def _walk_sst_chain(self, version, key: bytes, snap_seq: int, ctx,
-                        tombs_for=None) -> None:
+                        tombs_for=None):
         """Probe the key's SST candidates newest-first until the lookup
-        completes (shared by get, async multi_get, get_merge_operands)."""
-        for _level, f in version.files_for_get(key):
+        completes (shared by get, async multi_get, get_merge_operands).
+        Returns the level that completed the lookup, or None."""
+        for level, f in version.files_for_get(key):
             reader = self.table_cache.get_reader(f.number)
             tombs = (tombs_for(f) if tombs_for is not None
                      else self._parsed_tombstones(reader))
             more, _ = self._probe_file(reader, key, snap_seq, ctx, tombs)
             if not more:
-                return
+                return level
         ctx.finish()
+        return None
 
     def _max_l0_files(self) -> int:
         return max(
@@ -1437,6 +1495,11 @@ class DB:
                 # iterators can't refresh (reference Iterator::Refresh
                 # returns NotSupported for them).
                 it._refresh_fn = lambda: self.new_iterator(opts, cf)
+            if self.stats is not None:
+                from toplingdb_tpu.utils import statistics as st
+
+                it.stats = self.stats
+                self.stats.record_tick(st.NO_ITERATOR_CREATED)
             return it
 
     def _excluded_for(self, opts) -> tuple:

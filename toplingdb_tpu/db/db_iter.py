@@ -118,9 +118,37 @@ class DBIter:
             return
         self._iter.seek_to_first()
         self._find_next_user_entry(skip_key=None)
+        if self.stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self._tick_entry_read(st.NUMBER_DB_SEEK, st.NUMBER_DB_SEEK_FOUND)
+
+    # Optional Statistics sink (set by DB.new_iterator); records the
+    # NUMBER_DB_SEEK/NEXT/PREV + ITER_BYTES_READ family.
+    stats = None
+
+    def _tick(self, name: str, n: int = 1) -> None:
+        if self.stats is not None:
+            self.stats.record_tick(name, n)
+
+    def _tick_entry_read(self, op_name: str, found_name: str | None) -> None:
+        """One iterator step's tickers: the op count + (when positioned)
+        bytes read and the optional found counter."""
+        from toplingdb_tpu.utils import statistics as st
+
+        self._tick(op_name)
+        if self._valid:
+            if found_name is not None:
+                self._tick(found_name)
+            self._tick(st.ITER_BYTES_READ,
+                       len(self._key) + len(self._value))
 
     def seek(self, user_key: bytes) -> None:
         self._seek_impl(user_key, arm_prefix=True)
+        if self.stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self._tick_entry_read(st.NUMBER_DB_SEEK, st.NUMBER_DB_SEEK_FOUND)
 
     def _seek_impl(self, user_key: bytes, arm_prefix: bool) -> None:
         if self._lower is not None and self._vcmp(user_key, self._lower) < 0:
@@ -180,6 +208,10 @@ class DBIter:
         skip = self._key
         # _iter may sit anywhere within the current user key's versions.
         self._find_next_user_entry(skip_key=skip)
+        if self.stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self._tick_entry_read(st.NUMBER_DB_NEXT, None)
 
     def prev(self) -> None:
         assert self._valid
@@ -202,6 +234,10 @@ class DBIter:
             ) >= 0:
                 self._iter.prev()
         self._find_prev_user_entry()
+        if self.stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self._tick_entry_read(st.NUMBER_DB_PREV, None)
 
     def entries(self):
         while self.valid():

@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 
 from toplingdb_tpu.db import filename
@@ -30,6 +31,7 @@ class TableCache:
         self._cache_session = uuid.uuid4().bytes[:8]
         self._readers: OrderedDict[int, TableReader] = OrderedDict()
         self._lock = threading.Lock()
+        self.stats = None  # optional Statistics sink (set by the DB)
 
     def get_reader(self, file_number: int) -> TableReader:
         with self._lock:
@@ -38,11 +40,26 @@ class TableCache:
                 self._readers.move_to_end(file_number)
                 return r
         path = filename.table_file_name(self._dbname, file_number)
-        r = open_table(
-            self._env.new_random_access_file(path), self._icmp, self._topts,
-            block_cache=self._block_cache,
-            cache_key_prefix=self._cache_session + file_number.to_bytes(8, "little"),
-        )
+        t0 = time.perf_counter() if self.stats is not None else None
+        try:
+            r = open_table(
+                self._env.new_random_access_file(path), self._icmp,
+                self._topts, block_cache=self._block_cache,
+                cache_key_prefix=self._cache_session
+                + file_number.to_bytes(8, "little"),
+            )
+        except Exception:
+            if self.stats is not None:
+                from toplingdb_tpu.utils import statistics as st
+
+                self.stats.record_tick(st.NO_FILE_ERRORS)
+            raise
+        if t0 is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self.stats.record_tick(st.NO_FILE_OPENS)
+            self.stats.record_in_histogram(
+                st.TABLE_OPEN_IO_MICROS, (time.perf_counter() - t0) * 1e6)
         with self._lock:
             existing = self._readers.get(file_number)
             if existing is not None:

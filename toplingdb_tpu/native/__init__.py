@@ -128,6 +128,16 @@ def lib() -> ctypes.CDLL | None:
         except AttributeError:
             pass
         try:
+            # Bulk block inflate (snappy/zstd dlopen'd in C++): one
+            # GIL-free, multi-threaded call per compressed SST scan.
+            l.tpulsm_inflate_blocks.restype = ctypes.c_int64
+            l.tpulsm_inflate_blocks.argtypes = [
+                u8p, ctypes.c_int64, i64p, i64p, ctypes.c_int64,
+                ctypes.c_int32, u8p, ctypes.c_int64, i64p, i64p,
+            ]
+        except AttributeError:
+            pass
+        try:
             # WriteBatch wire-image insert: parse + insert natively, one
             # GIL-free call per batch (no per-record Python/numpy at all).
             l.tpulsm_skiplist_insert_wb.restype = ctypes.c_int64

@@ -19,6 +19,9 @@
 #ifdef __linux__
 #include <sched.h>
 #endif
+#ifndef _WIN32
+#include <dlfcn.h>
+#endif
 
 // CPUs this PROCESS may run on (cgroup quota / affinity mask), not the
 // host's core count — containers routinely pin far fewer than
@@ -953,6 +956,170 @@ int64_t tpulsm_skiplist_insert_batch(
                         valbuf + val_offs[i], (uint32_t)val_lens[i]);
   }
   return fresh;
+}
+
+// ---------------------------------------------------------------------------
+// Bulk block inflate: decompress EVERY data block of an SST image in one
+// GIL-free call (snappy / zstd dlopen'd at runtime like the Python codecs
+// module binds them), emitting a synthetic uncompressed file image
+// (payload + 5-byte trailer per block) that feeds tpulsm_decode_blocks
+// directly. Parallelized across the process's CPUs. The per-block Python
+// loop this replaces was GIL-bound at ~40us/block.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+typedef int (*snappy_len_fn)(const char*, size_t, size_t*);
+typedef int (*snappy_unc_fn)(const char*, size_t, char*, size_t*);
+typedef size_t (*zstd_sizefn)(const void*, size_t);
+typedef size_t (*zstd_dec_fn)(void*, size_t, const void*, size_t);
+typedef unsigned (*zstd_err_fn)(size_t);
+
+struct Codecs {
+  snappy_len_fn snappy_len = nullptr;
+  snappy_unc_fn snappy_unc = nullptr;
+  zstd_sizefn zstd_size = nullptr;
+  zstd_dec_fn zstd_dec = nullptr;
+  zstd_err_fn zstd_err = nullptr;
+};
+
+const Codecs& codecs() {
+  static Codecs c = [] {
+    Codecs r;
+#ifndef _WIN32
+    void* s = dlopen("libsnappy.so.1", RTLD_NOW);
+    if (!s) s = dlopen("libsnappy.so", RTLD_NOW);
+    if (s) {
+      r.snappy_len =
+          (snappy_len_fn)dlsym(s, "snappy_uncompressed_length");
+      r.snappy_unc = (snappy_unc_fn)dlsym(s, "snappy_uncompress");
+    }
+    void* z = dlopen("libzstd.so.1", RTLD_NOW);
+    if (!z) z = dlopen("libzstd.so", RTLD_NOW);
+    if (z) {
+      r.zstd_size = (zstd_sizefn)dlsym(z, "ZSTD_getFrameContentSize");
+      r.zstd_dec = (zstd_dec_fn)dlsym(z, "ZSTD_decompress");
+      r.zstd_err = (zstd_err_fn)dlsym(z, "ZSTD_isError");
+    }
+#endif
+    return r;
+  }();
+  return c;
+}
+
+}  // namespace
+
+// Inflate n framed blocks (payload at offs[b], len lens[b], type byte at
+// offs[b]+lens[b]; types: 0 raw, 1 snappy, 7 zstd-no-dict) into `out` as
+// payload + 5-byte zero trailer per block; out_offs/out_lens describe the
+// emitted payloads. verify_crc checks the COMPRESSED frame crc first
+// (masked crc32c, table/format.py framing). Returns total bytes used, or
+// -1 codec unavailable / unsupported type (caller: Python fallback),
+// -2 out_cap too small, -3 corrupt, -6 crc mismatch.
+int64_t tpulsm_inflate_blocks(const uint8_t* file_buf, int64_t file_len,
+                              const int64_t* offs, const int64_t* lens,
+                              int64_t n, int32_t verify_crc,
+                              uint8_t* out, int64_t out_cap,
+                              int64_t* out_offs, int64_t* out_lens) {
+  const Codecs& c = codecs();
+  // Pass 1: sizes (serial; header peeks are cheap).
+  int64_t used = 0;
+  for (int64_t b = 0; b < n; b++) {
+    int64_t off = offs[b], len = lens[b];
+    if (off < 0 || off + len + 5 > file_len) return -3;
+    uint8_t t = file_buf[off + len];
+    size_t ulen = 0;
+    if (t == 0) {
+      ulen = (size_t)len;
+    } else if (t == 1) {
+      if (!c.snappy_len || !c.snappy_unc) return -1;
+      if (c.snappy_len((const char*)file_buf + off, (size_t)len, &ulen) != 0)
+        return -3;
+    } else if (t == 7) {
+      if (!c.zstd_size || !c.zstd_dec || !c.zstd_err) return -1;
+      unsigned long long s =
+          (unsigned long long)c.zstd_size(file_buf + off, (size_t)len);
+      if (s == (unsigned long long)-1 || s == (unsigned long long)-2)
+        return -1;  // unknown size / not a frame (dict etc.): Python path
+      if (s > (1ull << 31)) return -3;
+      ulen = (size_t)s;
+    } else {
+      return -1;  // lz4/zlib/bzip2: Python fallback
+    }
+    out_offs[b] = used;
+    out_lens[b] = (int64_t)ulen;
+    used += (int64_t)ulen + 5;
+  }
+  if (used > out_cap) return -2;
+  // Pass 2: decompress in parallel.
+  size_t nthreads = effective_cpus();
+  if (nthreads > 8) nthreads = 8;
+  if (n < 16) nthreads = 1;
+  std::atomic<int64_t> next{0};
+  std::atomic<int> err{0};
+  auto worker = [&] {
+    while (true) {
+      int64_t b = next.fetch_add(1, std::memory_order_relaxed);
+      if (b >= n || err.load(std::memory_order_relaxed)) return;
+      int64_t off = offs[b], len = lens[b];
+      uint8_t t = file_buf[off + len];
+      if (verify_crc) {
+        uint32_t stored;
+        std::memcpy(&stored, file_buf + off + len + 1, 4);
+        uint32_t rot = stored - 0xa282ead8u;
+        uint32_t crc = (rot >> 17) | (rot << 15);
+        uint32_t actual =
+            tpulsm_crc32c_extend(0, file_buf + off, (size_t)(len + 1));
+        if (crc != actual) {
+          err.store(6, std::memory_order_relaxed);
+          return;
+        }
+      }
+      uint8_t* dst = out + out_offs[b];
+      size_t ulen = (size_t)out_lens[b];
+      bool ok = true;
+      if (t == 0) {
+        std::memcpy(dst, file_buf + off, (size_t)len);
+      } else if (t == 1) {
+        size_t got = ulen;
+        ok = c.snappy_unc((const char*)file_buf + off, (size_t)len,
+                          (char*)dst, &got) == 0 && got == ulen;
+      } else {
+        size_t got = c.zstd_dec(dst, ulen, file_buf + off, (size_t)len);
+        if (c.zstd_err(got)) {
+          // Dictionary frames land here: not corruption — route the file
+          // back to the Python per-block path, which has the dict.
+          err.store(1, std::memory_order_relaxed);
+          return;
+        }
+        ok = got == ulen;
+      }
+      if (!ok) {
+        err.store(3, std::memory_order_relaxed);
+        return;
+      }
+      std::memset(dst + ulen, 0, 5);  // type=0 + dummy crc (verify off)
+    }
+  };
+  if (nthreads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    for (size_t i = 1; i < nthreads; i++) {
+      try {
+        pool.emplace_back(worker);
+      } catch (...) {
+        break;
+      }
+    }
+    worker();
+    for (auto& w : pool) w.join();
+  }
+  int e = err.load();
+  if (e == 6) return -6;
+  if (e == 1) return -1;
+  if (e) return -3;
+  return used;
 }
 
 // Insert every counted record of a WriteBatch WIRE IMAGE (db/write_batch.py

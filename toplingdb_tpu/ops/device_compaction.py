@@ -541,6 +541,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     t0 = time.time()
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()
+    stats.input_files = len(compaction.all_inputs())
     try:
         kv, rd, shards, parts = _collect_raw_columnar(
             compaction, table_cache, icmp, want_uploads=not _host_sort(),
@@ -623,6 +624,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             # while shard s computes, and fused_uniform_shard_start
             # enqueues each D2H copy so results stream back).
             pendings = []
+            t_up = time.time()
             for chunks, ranges in shards:
                 covers_s = (None if cover is None else
                             [cover[lo:hi] for lo, hi in ranges])
@@ -630,6 +632,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                     ck.upload_uniform_shard(chunks, covers_s), snapshots,
                     compaction.bottommost,
                 ))
+            # Upload-enqueue span (device_put is async, so this is a lower
+            # bound; the blocking download waits below add the rest).
+            stats.transfer_time_usec += int((time.time() - t_up) * 1e6)
             if not any_complex and \
                     getattr(table_options, "format", "block") == "block":
                 # STREAM each shard's survivors straight into the SST
@@ -642,7 +647,10 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 # the shard programs still overlap each other.
                 orders, zfs, cxs = [], [], []
                 for (_chunks, ranges), pending in zip(shards, pendings):
+                    t_dn = time.time()
                     o, z, cx, hc = ck.fused_uniform_shard_finish(pending)
+                    stats.transfer_time_usec += int(
+                        (time.time() - t_dn) * 1e6)
                     lmap = _ranges_lmap(ranges)
                     orders.append(lmap[o])
                     zfs.append(z)
@@ -691,7 +699,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # writer consumes it (the writer reads both arrays per native call).
         def _shard_order_chunks():
             for (_chunks, ranges), pending in zip(shards, pendings):
+                t_dn = time.time()
                 o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
+                stats.transfer_time_usec += int((time.time() - t_dn) * 1e6)
                 if hc:
                     raise _FallbackToEntries()
                 lmap = _ranges_lmap(ranges)
@@ -797,6 +807,7 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
     t0 = time.time()
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()
+    stats.input_files = len(compaction.all_inputs())
     entries, rd = collect_raw_entries(compaction, table_cache, icmp)
     stats.input_records = len(entries)
     rd_or_none = None if rd.empty() else rd

@@ -345,6 +345,7 @@ class PessimisticTransaction(_TxnBase):
             raise InvalidArgument("set_name() required before prepare()")
         self._txn_db._persist_prepared(self)
         self.state = "prepared"
+        self._tick("TXN_PREPARE")
 
     def commit(self) -> None:
         if self.state not in ("started", "prepared"):
@@ -361,6 +362,7 @@ class PessimisticTransaction(_TxnBase):
                 self._txn_db._release_name(self.name)
         self.state = "committed"
         self._release()
+        self._tick("TXN_COMMIT")
 
     def rollback(self) -> None:
         if self.state == "prepared":
@@ -369,6 +371,14 @@ class PessimisticTransaction(_TxnBase):
             self._txn_db._release_name(self.name)
         super().rollback()
         self._release()
+        self._tick("TXN_ROLLBACK")
+
+    def _tick(self, which: str) -> None:
+        stats = getattr(self._db, "stats", None)
+        if stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            stats.record_tick(getattr(st, which))
 
     def _release(self) -> None:
         self._txn_db.lock_manager.unlock_all(self.id, self._locked)

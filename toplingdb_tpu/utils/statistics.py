@@ -9,33 +9,123 @@ import math
 import threading
 from collections import defaultdict
 
-# Ticker names (the subset the engine records; extensible by string).
+# Ticker names, grouped by the reference's families
+# (include/rocksdb/statistics.h Tickers enum); extensible by string.
+#
+# -- block cache -----------------------------------------------------
 BLOCK_CACHE_HIT = "block.cache.hit"
 BLOCK_CACHE_MISS = "block.cache.miss"
+BLOCK_CACHE_ADD = "block.cache.add"
+BLOCK_CACHE_ADD_FAILURES = "block.cache.add.failures"
+BLOCK_CACHE_DATA_HIT = "block.cache.data.hit"
+BLOCK_CACHE_DATA_MISS = "block.cache.data.miss"
+BLOCK_CACHE_DATA_ADD = "block.cache.data.add"
+BLOCK_CACHE_INDEX_HIT = "block.cache.index.hit"
+BLOCK_CACHE_INDEX_MISS = "block.cache.index.miss"
+BLOCK_CACHE_INDEX_ADD = "block.cache.index.add"
+BLOCK_CACHE_FILTER_HIT = "block.cache.filter.hit"
+BLOCK_CACHE_FILTER_MISS = "block.cache.filter.miss"
+BLOCK_CACHE_FILTER_ADD = "block.cache.filter.add"
+BLOCK_CACHE_BYTES_READ = "block.cache.bytes.read"
+BLOCK_CACHE_BYTES_WRITE = "block.cache.bytes.write"
+# -- bloom filters ---------------------------------------------------
 BLOOM_USEFUL = "bloom.filter.useful"
-BYTES_WRITTEN = "bytes.written"
+BLOOM_CHECKED = "bloom.filter.checked"
+BLOOM_FULL_POSITIVE = "bloom.filter.full.positive"
+BLOOM_FULL_TRUE_POSITIVE = "bloom.filter.full.true.positive"
+BLOOM_MEMTABLE_HIT = "bloom.memtable.hit"
+BLOOM_MEMTABLE_MISS = "bloom.memtable.miss"
+# -- reads -----------------------------------------------------------
 BYTES_READ = "bytes.read"
-NUMBER_KEYS_WRITTEN = "number.keys.written"
 NUMBER_KEYS_READ = "number.keys.read"
+MEMTABLE_HIT = "memtable.hit"
+MEMTABLE_MISS = "memtable.miss"
+GET_HIT_L0 = "get.hit.l0"
+GET_HIT_L1 = "get.hit.l1"
+GET_HIT_L2_AND_UP = "get.hit.l2andup"
+NUMBER_MULTIGET_CALLS = "number.multiget.get"
+NUMBER_MULTIGET_KEYS_READ = "number.multiget.keys.read"
+NUMBER_MULTIGET_BYTES_READ = "number.multiget.bytes.read"
+# -- iteration -------------------------------------------------------
+NUMBER_DB_SEEK = "number.db.seek"
+NUMBER_DB_NEXT = "number.db.next"
+NUMBER_DB_PREV = "number.db.prev"
+NUMBER_DB_SEEK_FOUND = "number.db.seek.found"
+ITER_BYTES_READ = "db.iter.bytes.read"
+NO_ITERATOR_CREATED = "no.iterator.created"
+NO_ITERATOR_DELETED = "no.iterator.deleted"
+# -- writes ----------------------------------------------------------
+BYTES_WRITTEN = "bytes.written"
+NUMBER_KEYS_WRITTEN = "number.keys.written"
+NUMBER_KEYS_UPDATED = "number.keys.updated"
+WRITE_DONE_BY_SELF = "write.self"
+WRITE_DONE_BY_OTHER = "write.other"
+WRITE_WITH_WAL = "write.wal"
+WAL_SYNCS = "wal.synced"
+WAL_BYTES = "wal.bytes"
+# -- compaction ------------------------------------------------------
 COMPACT_READ_BYTES = "compact.read.bytes"
 COMPACT_WRITE_BYTES = "compact.write.bytes"
-FLUSH_WRITE_BYTES = "flush.write.bytes"
-STALL_MICROS = "stall.micros"
-WAL_SYNCS = "wal.syncs"
+COMPACTION_KEY_DROP_OBSOLETE = "compaction.key.drop.obsolete"
+COMPACTION_KEY_DROP_RANGE_DEL = "compaction.key.drop.range_del"
+COMPACTION_CANCELLED = "compaction.cancelled"
+NUMBER_SUPERVERSION_ACQUIRES = "number.superversion_acquires"
+MERGE_OPERATION_TOTAL_TIME = "merge.operation.time.nanos"
+NUMBER_MERGE_FAILURES = "number.merge.failures"
 # Topling split: local vs distributed (device/remote) compaction bytes.
 LCOMPACTION_READ_BYTES = "lcompaction.read.bytes"
 LCOMPACTION_WRITE_BYTES = "lcompaction.write.bytes"
 DCOMPACTION_READ_BYTES = "dcompaction.read.bytes"
 DCOMPACTION_WRITE_BYTES = "dcompaction.write.bytes"
+# -- flush / WAL / files ---------------------------------------------
+FLUSH_WRITE_BYTES = "flush.write.bytes"
+NO_FILE_OPENS = "no.file.opens"
+NO_FILE_CLOSES = "no.file.closes"
+NO_FILE_ERRORS = "no.file.errors"
+# -- stalls ----------------------------------------------------------
+STALL_MICROS = "stall.micros"
+WRITE_STALL_COUNT = "write.stall.count"
+# -- transactions ----------------------------------------------------
+TXN_COMMIT = "txn.commit"
+TXN_ROLLBACK = "txn.rollback"
+TXN_PREPARE = "txn.prepare"
+TXN_LOCK_TIMEOUT = "txn.lock.timeout"
+TXN_DEADLOCK = "txn.deadlock"
+# -- blob files ------------------------------------------------------
+BLOB_DB_NUM_KEYS_READ = "blob.db.num.keys.read"
+BLOB_DB_NUM_KEYS_WRITTEN = "blob.db.num.keys.written"
+BLOB_DB_BYTES_READ = "blob.db.bytes.read"
+BLOB_DB_BYTES_WRITTEN = "blob.db.bytes.written"
+BLOB_DB_GC_NUM_FILES = "blob.db.gc.num.files"
+# -- row cache / persistent tiers ------------------------------------
+SECONDARY_CACHE_HITS = "secondary.cache.hits"
+PERSISTENT_CACHE_HIT = "persistent.cache.hit"
+PERSISTENT_CACHE_MISS = "persistent.cache.miss"
 
-# Histogram names.
+# Histogram names (reference Histograms enum families).
 DB_GET_MICROS = "db.get.micros"
 DB_WRITE_MICROS = "db.write.micros"
+DB_SEEK_MICROS = "db.seek.micros"
+DB_MULTIGET_MICROS = "db.multiget.micros"
 COMPACTION_TIME_MICROS = "compaction.time.micros"
+COMPACTION_PREPARE_MICROS = "compaction.prepare.micros"
+COMPACTION_WAITING_MICROS = "compaction.waiting.micros"
+COMPACTION_TRANSFER_MICROS = "compaction.transfer.micros"
 LCOMPACTION_TIME_MICROS = "lcompaction.time.micros"
 DCOMPACTION_TIME_MICROS = "dcompaction.time.micros"
+DCOMPACTION_PREPARE_MICROS = "dcompaction.prepare.micros"
+DCOMPACTION_WAITING_MICROS = "dcompaction.waiting.micros"
+DCOMPACTION_RPC_MICROS = "dcompaction.rpc.micros"
 FLUSH_TIME_MICROS = "flush.time.micros"
 SST_READ_MICROS = "sst.read.micros"
+TABLE_OPEN_IO_MICROS = "table.open.io.micros"
+WAL_FILE_SYNC_MICROS = "wal.file.sync.micros"
+MANIFEST_FILE_SYNC_MICROS = "manifest.file.sync.micros"
+WRITE_STALL_MICROS_HIST = "write.stall.micros"
+NUM_FILES_IN_SINGLE_COMPACTION = "numfiles.in.singlecompaction"
+BYTES_PER_READ = "bytes.per.read"
+BYTES_PER_WRITE = "bytes.per.write"
+NUM_SUBCOMPACTIONS_SCHEDULED = "num.subcompactions.scheduled"
 
 
 class Histogram:
@@ -107,9 +197,10 @@ class Statistics:
 
     def record_compaction(self, stats) -> None:
         """Merge a CompactionStats from a finished job; distributed/device
-        jobs go to the D* counters (reference compaction_job.cc:1113-1135
-        stat merge-back)."""
-        local = stats.device == "cpu"
+        jobs go to the D* counters with the reference's per-job timing
+        breakdown (compaction_job.cc:1113-1135 stat merge-back +
+        compaction_executor.h:146-150 prepare/waiting/work fields)."""
+        local = stats.device == "cpu" and not getattr(stats, "remote", False)
         if local:
             self.record_tick(LCOMPACTION_READ_BYTES, stats.input_bytes)
             self.record_tick(LCOMPACTION_WRITE_BYTES, stats.output_bytes)
@@ -118,9 +209,38 @@ class Statistics:
             self.record_tick(DCOMPACTION_READ_BYTES, stats.input_bytes)
             self.record_tick(DCOMPACTION_WRITE_BYTES, stats.output_bytes)
             self.record_in_histogram(DCOMPACTION_TIME_MICROS, stats.work_time_usec)
+            if stats.prepare_time_usec:
+                self.record_in_histogram(DCOMPACTION_PREPARE_MICROS,
+                                         stats.prepare_time_usec)
+            if stats.waiting_time_usec:
+                self.record_in_histogram(DCOMPACTION_WAITING_MICROS,
+                                         stats.waiting_time_usec)
+            if stats.rpc_time_usec:
+                self.record_in_histogram(DCOMPACTION_RPC_MICROS,
+                                         stats.rpc_time_usec)
         self.record_tick(COMPACT_READ_BYTES, stats.input_bytes)
         self.record_tick(COMPACT_WRITE_BYTES, stats.output_bytes)
         self.record_in_histogram(COMPACTION_TIME_MICROS, stats.work_time_usec)
+        if stats.transfer_time_usec:
+            self.record_in_histogram(COMPACTION_TRANSFER_MICROS,
+                                     stats.transfer_time_usec)
+        if stats.dropped_obsolete or stats.dropped_tombstone:
+            # CPU path: the iterator counts drops precisely.
+            self.record_tick(COMPACTION_KEY_DROP_OBSOLETE,
+                             stats.dropped_obsolete)
+            if stats.dropped_tombstone:
+                self.record_tick(COMPACTION_KEY_DROP_RANGE_DEL,
+                                 stats.dropped_tombstone)
+        else:
+            # Device/columnar path reports only totals: attribute the
+            # non-merge-collapsed remainder to obsolete drops.
+            drops = max(0, stats.input_records - stats.output_records
+                        - stats.merged_records)
+            if drops:
+                self.record_tick(COMPACTION_KEY_DROP_OBSOLETE, drops)
+        if stats.input_files:
+            self.record_in_histogram(NUM_FILES_IN_SINGLE_COMPACTION,
+                                     stats.input_files)
 
     def to_string(self) -> str:
         lines = []
@@ -132,16 +252,48 @@ class Statistics:
 
 
 class PerfContext:
-    """Per-thread perf counters (reference include/rocksdb/perf_context.h).
+    """Per-thread perf counters (reference include/rocksdb/perf_context.h —
+    the same measurement families, grouped as there).
     Access via perf_context() — a thread-local instance."""
 
     _FIELDS = (
+        # comparisons / blocks
         "user_key_comparison_count", "block_read_count", "block_read_byte",
-        "block_cache_hit_count", "bloom_memtable_hit_count",
+        "block_read_time", "block_cache_hit_count", "block_cache_miss_count",
+        "block_cache_index_hit_count", "block_cache_filter_hit_count",
+        "block_checksum_time", "block_decompress_time",
+        "raw_block_contents_count",
+        # bloom
+        "bloom_memtable_hit_count", "bloom_memtable_miss_count",
         "bloom_sst_hit_count", "bloom_sst_miss_count",
-        "get_from_memtable_count", "seek_on_memtable_count",
-        "next_on_memtable_count", "write_wal_time", "write_memtable_time",
+        # memtable / key resolution
+        "get_from_memtable_count", "get_from_memtable_time",
+        "seek_on_memtable_count", "seek_on_memtable_time",
+        "next_on_memtable_count", "prev_on_memtable_count",
+        "internal_key_skipped_count", "internal_delete_skipped_count",
+        "internal_merge_count", "internal_range_del_reseek_count",
+        # get path
         "get_snapshot_time", "get_from_output_files_time",
+        "get_post_process_time", "get_read_bytes",
+        # seek path
+        "seek_child_seek_count", "seek_child_seek_time",
+        "seek_internal_seek_time", "find_next_user_entry_time",
+        "iter_read_bytes",
+        # write path
+        "write_wal_time", "write_memtable_time", "write_pre_and_post_process_time",
+        "write_delay_time", "write_thread_wait_nanos",
+        "wal_write_bytes",
+        # file / env
+        "open_table_file_nanos", "find_table_nanos",
+        "new_table_iterator_nanos", "table_cache_hit_count",
+        "env_read_nanos", "env_write_nanos", "env_sync_nanos",
+        # txn
+        "key_lock_wait_count", "key_lock_wait_time",
+        # blob
+        "blob_read_count", "blob_read_byte", "blob_checksum_time",
+        "blob_decompress_time",
+        # merge operator
+        "merge_operator_time_nanos",
     )
 
     def __init__(self):
