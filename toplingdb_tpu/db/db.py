@@ -151,6 +151,15 @@ class DB:
                     f"bottommost_format {options.bottommost_format!r} is "
                     f"not one of {FORMATS}"
                 )
+        if (getattr(options.table_options, "partition_filters", False)
+                and options.table_options.prefix_extractor is not None):
+            from toplingdb_tpu.utils.status import InvalidArgument
+
+            # Fail at open, not in the first background flush.
+            raise InvalidArgument(
+                "partition_filters supports whole-key filtering only "
+                "(prefix probes could span filter partitions)"
+            )
         if getattr(options.table_options, "format", "block") == "plain":
             # Fail at open, not in a background flush/compaction job.
             from toplingdb_tpu.utils.slice_transform import (

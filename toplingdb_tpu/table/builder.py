@@ -23,6 +23,7 @@ from toplingdb_tpu.table.filter import BloomFilterPolicy, FilterPolicy
 from toplingdb_tpu.table.properties import TableProperties
 
 METAINDEX_FILTER = b"filter.fullfilter"
+METAINDEX_FILTER_PARTS = b"filter.partitioned"
 METAINDEX_PROPERTIES = b"tpulsm.properties"
 METAINDEX_RANGE_DEL = b"tpulsm.range_del"
 METAINDEX_COMPRESSION_DICT = b"tpulsm.compression_dict"
@@ -57,6 +58,12 @@ class TableOptions:
     # index, loaded lazily and block-cached — the big-SST memory saver.
     index_type: str = "binary"
     metadata_block_size: int = 4096
+    # Partitioned filters (reference PartitionedFilterBlockBuilder,
+    # table/block_based/partitioned_filter_block.h:27): the bloom splits
+    # into ~metadata_block_size partitions behind a small top index, so a
+    # point lookup loads/caches ONE partition instead of the whole filter.
+    # Whole-key filtering only (prefix probes could span partitions).
+    partition_filters: bool = False
     # single_fast only: also write an open-addressed hash bucket index for
     # O(1) point lookups (the CuckooTable / PlainTable prefix-hash role).
     hash_index: bool = False
@@ -109,6 +116,18 @@ class TableBuilder:
         self._index_entries: list[tuple[bytes, bytes]] = []  # two-level only
         self._filter_keys: list[bytes] = []
         self._last_filter_prefix: bytes | None = None
+        self._filter_parts: list[tuple[bytes, list[bytes]]] = []
+        self._partition_filters = bool(
+            getattr(self.opts, "partition_filters", False)
+            and self.opts.filter_policy is not None
+        )
+        if self._partition_filters and self.opts.prefix_extractor is not None:
+            from toplingdb_tpu.utils.status import InvalidArgument
+
+            raise InvalidArgument(
+                "partition_filters supports whole-key filtering only "
+                "(prefix probes could span filter partitions)"
+            )
         self._range_del_block = BlockBuilder(restart_interval=1)
         self.props = TableProperties(
             comparator_name=icmp.user_comparator.name(),
@@ -230,6 +249,16 @@ class TableBuilder:
             self.props.num_merge_operands += 1
         if self._data_block.current_size_estimate() >= self.opts.block_size:
             self._flush_data_block()
+            if self._partition_filters and self._filter_keys:
+                bp = self.opts.filter_policy
+                est = len(self._filter_keys) \
+                    * getattr(bp, "bits_per_key", 10.0) / 8
+                if est >= self.opts.metadata_block_size:
+                    # Cut at the data-block boundary: uk ranges of sibling
+                    # partitions stay disjoint except possibly the boundary
+                    # key, which lands in both (probe finds the first).
+                    self._filter_parts.append((uk, self._filter_keys))
+                    self._filter_keys = []
 
     def add_tombstone(self, begin_ikey: bytes, end_user_key: bytes) -> None:
         """Range tombstone: begin internal key (type RANGE_DELETION) → end user
@@ -358,7 +387,24 @@ class TableBuilder:
         metaindex = BlockBuilder(restart_interval=1)
         meta_entries: list[tuple[bytes, fmt.BlockHandle]] = []
 
-        if self.opts.filter_policy and self._filter_keys:
+        if self._partition_filters and (self._filter_parts
+                                        or self._filter_keys):
+            if self._filter_keys:
+                last_uk = dbformat.extract_user_key(self._last_key) \
+                    if self._last_key else b""
+                self._filter_parts.append((last_uk, self._filter_keys))
+                self._filter_keys = []
+            top = BlockBuilder(restart_interval=1)
+            total = 0
+            for last_uk, keys in self._filter_parts:
+                fdata = self.opts.filter_policy.create_filter(keys)
+                fh = fmt.write_block(self._w, fdata, fmt.NO_COMPRESSION)
+                top.add(last_uk, fh.encode())
+                total += len(fdata)
+            th = fmt.write_block(self._w, top.finish(), fmt.NO_COMPRESSION)
+            self.props.filter_size = total
+            meta_entries.append((METAINDEX_FILTER_PARTS, th))
+        elif self.opts.filter_policy and self._filter_keys:
             fdata = self.opts.filter_policy.create_filter(self._filter_keys)
             fh = fmt.write_block(self._w, fdata, fmt.NO_COMPRESSION)
             self.props.filter_size = len(fdata)

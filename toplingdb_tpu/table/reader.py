@@ -16,6 +16,7 @@ from toplingdb_tpu.table.block import BlockIter
 from toplingdb_tpu.table.builder import (
     METAINDEX_COMPRESSION_DICT,
     METAINDEX_FILTER,
+    METAINDEX_FILTER_PARTS,
     METAINDEX_PROPERTIES,
     METAINDEX_RANGE_DEL,
     TableOptions,
@@ -62,6 +63,18 @@ class TableReader:
             self._filter_policy = filter_policy_from_name(
                 self.properties.filter_policy_name
             )
+        # Partitioned filter (reference PartitionedFilterBlockReader): the
+        # small top index (last user key -> partition handle) loads now;
+        # partitions load lazily through the block cache on probes.
+        self._filter_top: bytes | None = None
+        self._filter_part_memo: dict[int, bytes] = {}
+        th = self._meta_handles.get(METAINDEX_FILTER_PARTS)
+        if th is not None:
+            self._filter_top = fmt.read_block(rfile, th,
+                                              self.opts.verify_checksums)
+            self._filter_policy = filter_policy_from_name(
+                self.properties.filter_policy_name
+            )
 
         # The extractor this FILE's prefix structures were built with,
         # resolved once (hot Get path must not reconstruct it per probe).
@@ -97,11 +110,36 @@ class TableReader:
         self._f.close()
 
     def key_may_match(self, user_key: bytes) -> bool:
+        if self._filter_top is not None:
+            return self._partitioned_filter_probe(user_key)
         return filter_probe(
             self._filter_policy, self._filter_data,
             bool(self.properties.whole_key_filtering),
             self._resolved_pe, user_key,
         )
+
+    def _partitioned_filter_probe(self, user_key: bytes) -> bool:
+        """Binary-search the filter-top index, load (and cache) ONE filter
+        partition, probe it. Fails open (like filter_probe) when the
+        policy can't be reconstructed from its recorded name."""
+        if self._filter_policy is None:
+            return True
+        it = BlockIter(self._filter_top, dbformat.BYTEWISE.compare)
+        it.seek(user_key)  # first partition whose last key >= user_key
+        if not it.valid():
+            return False  # past every partition's range: definitely absent
+        handle = fmt.BlockHandle.decode_exact(it.value())
+        if self._cache is not None:
+            fdata = self._read_data_block(handle)
+        else:
+            # No shared block cache: memoize per reader (bounded by the
+            # partition count) — a probe must stay cheaper than the block
+            # read it exists to avoid.
+            fdata = self._filter_part_memo.get(handle.offset)
+            if fdata is None:
+                fdata = self._read_data_block(handle)
+                self._filter_part_memo[handle.offset] = fdata
+        return self._filter_policy.key_may_match(user_key, fdata)
 
     def prefix_may_match(self, prefix: bytes) -> bool:
         """Probe the filter with an already-extracted prefix (prefix Seek
@@ -112,17 +150,20 @@ class TableReader:
             return True
         return self._filter_policy.key_may_match(prefix, self._filter_data)
 
-    def _read_data_block(self, handle: fmt.BlockHandle) -> bytes:
+    def _read_data_block(self, handle: fmt.BlockHandle, pf=None) -> bytes:
+        """`pf`: optional FilePrefetchBuffer (per-iterator readahead;
+        reference FilePrefetchBuffer, file/file_prefetch_buffer.h:63)."""
+        src = pf if pf is not None else self._f
         if self._cache is not None:
             ckey = self._cache_prefix + handle.encode()
             data = self._cache.lookup(ckey)
             if data is not None:
                 return data
-            data = fmt.read_block(self._f, handle, self.opts.verify_checksums,
+            data = fmt.read_block(src, handle, self.opts.verify_checksums,
                                   self._compression_dict)
             self._cache.insert(ckey, data, len(data))
             return data
-        return fmt.read_block(self._f, handle, self.opts.verify_checksums,
+        return fmt.read_block(src, handle, self.opts.verify_checksums,
                               self._compression_dict)
 
     def new_iterator(self) -> "TableIterator":
@@ -249,17 +290,23 @@ class TableIterator:
     """Two-level iterator: index (flat or partitioned) → data block."""
 
     def __init__(self, reader: TableReader):
+        from toplingdb_tpu.table.prefetch import FilePrefetchBuffer
+
         self._r = reader
         self._cmp = reader._icmp.compare
         self._idx = reader.new_index_iterator()
         self._data: BlockIter | None = None
+        # Per-iterator auto-readahead: sequential block loads escalate to
+        # windowed preads; random seeks pass through untouched.
+        self._pf = FilePrefetchBuffer(reader._f)
 
     def _load_data_block(self) -> None:
         if not self._idx.valid():
             self._data = None
             return
         handle = fmt.BlockHandle.decode_exact(self._idx.value())
-        self._data = BlockIter(self._r._read_data_block(handle), self._cmp)
+        self._data = BlockIter(
+            self._r._read_data_block(handle, pf=self._pf), self._cmp)
 
     def valid(self) -> bool:
         return self._data is not None and self._data.valid()
