@@ -50,6 +50,30 @@ def _split_snapshots(snapshots: list[int]) -> tuple[np.ndarray, np.ndarray]:
     return snap_hi, snap_lo
 
 
+def _split_cover(cover: np.ndarray, p: int):
+    """uint64 per-row max-covering-tombstone seqnos → (hi, lo) u32 word
+    arrays padded to p rows (shared by the single-chip and mesh drivers)."""
+    tc = np.zeros(p, dtype=np.uint64)
+    tc[: len(cover)] = cover
+    return ((tc >> np.uint64(32)).astype(np.uint32),
+            (tc & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def _tomb_covered(seq_hi, seq_lo, tomb_hi, tomb_lo, snap_hi, snap_lo,
+                  stripe):
+    """Same-stripe range-tombstone shadowing (traced; shared by the
+    single-chip GC mask and the mesh kernel so they cannot diverge)."""
+    has_tomb = (tomb_hi | tomb_lo) != 0
+    tomb_newer = (tomb_hi > seq_hi) | ((tomb_hi == seq_hi)
+                                       & (tomb_lo > seq_lo))
+    tsnap_lt = (snap_hi[None, :] < tomb_hi[:, None]) | (
+        (snap_hi[None, :] == tomb_hi[:, None])
+        & (snap_lo[None, :] < tomb_lo[:, None])
+    )
+    tomb_stripe = jnp.sum(tsnap_lt, axis=1).astype(jnp.int32)
+    return has_tomb & tomb_newer & (tomb_stripe == stripe)
+
+
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -159,13 +183,8 @@ def _gc_mask_impl(key_words, key_len, inv_hi, inv_lo, vtype,
     first_in_stripe = new_key | (stripe != prev_stripe)
 
     # --- tombstone coverage (same-stripe shadowing) ---
-    has_tomb = (tomb_hi | tomb_lo) != 0
-    tomb_newer = (tomb_hi > seq_hi) | ((tomb_hi == seq_hi) & (tomb_lo > seq_lo))
-    t_hi = tomb_hi[:, None]
-    t_lo = tomb_lo[:, None]
-    tsnap_lt = (s_hi < t_hi) | ((s_hi == t_hi) & (s_lo < t_lo))
-    tomb_stripe = jnp.sum(tsnap_lt, axis=1).astype(jnp.int32)
-    covered = has_tomb & tomb_newer & (tomb_stripe == stripe)
+    covered = _tomb_covered(seq_hi, seq_lo, tomb_hi, tomb_lo,
+                            snap_hi, snap_lo, stripe)
 
     # --- complex groups: contain MERGE or SINGLE_DELETION → host resolves ---
     is_complex = (vtype == int(ValueType.MERGE)) | (
@@ -899,10 +918,7 @@ def fused_encode_sort_gc(key_buf: np.ndarray, key_offs: np.ndarray,
     snap_hi, snap_lo = _split_snapshots(snapshots)
     has_tombs = cover is not None and bool(np.any(cover))
     if has_tombs:
-        tc = np.zeros(p, dtype=np.uint64)
-        tc[:n] = cover
-        tomb_hi = (tc >> np.uint64(32)).astype(np.uint32)
-        tomb_lo = (tc & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        tomb_hi, tomb_lo = _split_cover(cover, p)
     else:
         tomb_hi = tomb_lo = np.zeros(1, dtype=np.uint32)  # unused dummy
     # Pad the raw byte buffer to a pow2 bucket too: otherwise every distinct
@@ -945,10 +961,7 @@ def gc_mask(sorted_cols: dict, snapshots: list[int],
         tomb_hi = np.zeros(p, dtype=np.uint32)
         tomb_lo = np.zeros(p, dtype=np.uint32)
     else:
-        tc = np.zeros(p, dtype=np.uint64)
-        tc[:n] = tomb_cover
-        tomb_hi = (tc >> np.uint64(32)).astype(np.uint32)
-        tomb_lo = (tc & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        tomb_hi, tomb_lo = _split_cover(tomb_cover, p)
     keep, zero_seq, host_resolve, group_id = _gc_mask_impl(
         sorted_cols["key_words"], sorted_cols["key_len"],
         sorted_cols["inv_hi"], sorted_cols["inv_lo"], sorted_cols["vtype"],

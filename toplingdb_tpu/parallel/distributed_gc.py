@@ -60,10 +60,13 @@ def _local_sort(cols, payload):
     )
 
 
-def _gc_mask_local(cols, vtype, prev_last_cols, prev_last_stripe,
-                   prev_valid, snap_hi, snap_lo, bottommost):
+def _gc_mask_local(cols, vtype, tomb_hi_i32, tomb_lo_i32, prev_last_cols,
+                   prev_last_stripe, prev_valid, snap_hi, snap_lo,
+                   bottommost):
     """Mask survivors within one locally-sorted shard; the halo (previous
-    shard's last key/stripe) stitches group/stripe continuity."""
+    shard's last key/stripe) stitches group/stripe continuity. tomb_*:
+    per-row max covering range-tombstone seqno words (rode the sort as
+    payload; zero = uncovered)."""
     n = cols.shape[0]
     w = cols.shape[1] - 3  # key words + len + inv_hi + inv_lo
     key_cols = cols[:, : w + 1]  # words + len identify the user key
@@ -90,12 +93,24 @@ def _gc_mask_local(cols, vtype, prev_last_cols, prev_last_stripe,
     prev_stripe = prev_stripe.at[0].set(prev_last_stripe)
     first_in_stripe = new_key | (stripe != prev_stripe)
 
+    # Range-tombstone shadowing: the SAME traced rule as the single-chip
+    # GC mask (shared helper, so the two cannot diverge).
+    from toplingdb_tpu.ops.compaction_kernels import _tomb_covered
+
+    covered = _tomb_covered(seq_hi, seq_lo, u(tomb_hi_i32), u(tomb_lo_i32),
+                            snap_hi, snap_lo, stripe)
+
     is_pad = vtype < 0
-    keep = first_in_stripe & ~is_pad
+    keep = first_in_stripe & ~covered & ~is_pad
     drop_bottom_del = bottommost & (stripe == 0) & (vtype == int(ValueType.DELETION))
     keep = keep & ~drop_bottom_del
     zero_seq = keep & bottommost & (stripe == 0) & (vtype == int(ValueType.VALUE))
-    return keep, zero_seq, stripe
+    # Complex rows (MERGE / SINGLE_DELETE) flag per row; the group-level
+    # broadcast happens on the host, which sees the global sorted order
+    # (groups may span shard boundaries).
+    is_complex = ((vtype == int(ValueType.MERGE))
+                  | (vtype == int(ValueType.SINGLE_DELETION))) & ~is_pad
+    return keep, zero_seq, stripe, is_complex
 
 
 def make_distributed_gc_step(mesh: Mesh, num_key_words: int,
@@ -114,14 +129,15 @@ def make_distributed_gc_step(mesh: Mesh, num_key_words: int,
     r = mesh.shape["range"]
     c = num_key_words + 3
 
-    def step(cols, vtype, idx, snap_hi, snap_lo):
+    def step(cols, vtype, idx, tomb_hi, tomb_lo, snap_hi, snap_lo):
         j, p_local = vtype.shape  # inside shard_map: local job count, local rows
 
-        def one_job(cols1, vtype1, idx1):
+        def one_job(cols1, vtype1, idx1, th1, tl1):
             cap = int(capacity_factor * p_local / r) if r > 1 else p_local
             cap = max(cap, 1)
             payload = jnp.concatenate(
-                [vtype1[:, None], idx1[:, None]], axis=1
+                [vtype1[:, None], idx1[:, None],
+                 th1[:, None], tl1[:, None]], axis=1
             )
             cols_s, pay_s = _local_sort(cols1, payload)
 
@@ -160,7 +176,10 @@ def make_distributed_gc_step(mesh: Mesh, num_key_words: int,
                 )
                 slot = jnp.where(is_pad_row, cap, jnp.minimum(slot, cap))
                 send_cols = jnp.full((r, cap + 1, c), INT32MAX, dtype=jnp.int32)
-                send_pay = jnp.full((r, cap + 1, 2), -1, dtype=jnp.int32)
+                send_pay = jnp.full((r, cap + 1, 4), -1, dtype=jnp.int32)
+                # Pad-slot cover words must be ZERO (not -1): an all-ones
+                # word would read as a huge covering tombstone.
+                send_pay = send_pay.at[:, :, 2:].set(0)
                 send_cols = send_cols.at[bucket, slot].set(cols_s)
                 send_pay = send_pay.at[bucket, slot].set(pay_s)
                 send_cols = send_cols[:, :cap]
@@ -172,14 +191,15 @@ def make_distributed_gc_step(mesh: Mesh, num_key_words: int,
                 ).reshape(r * cap, c)
                 recv_pay = jax.lax.all_to_all(
                     send_pay, "range", split_axis=0, concat_axis=0, tiled=True
-                ).reshape(r * cap, 2)
+                ).reshape(r * cap, 4)
                 cols_s, pay_s = _local_sort(recv_cols, recv_pay)
             else:
                 overflow = jnp.zeros((), dtype=jnp.int32)
 
             return cols_s, pay_s, overflow
 
-        cols_s, pay_s, overflow = jax.vmap(one_job)(cols, vtype, idx)
+        cols_s, pay_s, overflow = jax.vmap(one_job)(cols, vtype, idx,
+                                                    tomb_hi, tomb_lo)
 
         # --- halo: previous shard's last row (key cols + stripe) ---
         # Recompute stripe needs snapshots; do mask per job via vmap with halo.
@@ -217,28 +237,29 @@ def make_distributed_gc_step(mesh: Mesh, num_key_words: int,
             prev_valid = jnp.array(False)
 
         def job_final(cols1, pay1, pcols, pstripe):
-            keep, zero_seq, stripe = _gc_mask_local(
-                cols1, pay1[:, 0], pcols, pstripe, prev_valid,
-                snap_hi, snap_lo, bottommost,
+            keep, zero_seq, stripe, is_cx = _gc_mask_local(
+                cols1, pay1[:, 0], pay1[:, 2], pay1[:, 3], pcols, pstripe,
+                prev_valid, snap_hi, snap_lo, bottommost,
             )
-            return keep, zero_seq, pay1[:, 1]
+            return keep, zero_seq, pay1[:, 1], is_cx
 
-        keep, zero_seq, sidx = jax.vmap(job_final)(
+        keep, zero_seq, sidx, is_cx = jax.vmap(job_final)(
             cols_s, pay_s, prev_cols, prev_stripe
         )
         # Total overflow per job across all source shards (psum over ICI).
         total_overflow = jax.lax.psum(overflow, "range")
-        return keep, zero_seq, sidx, total_overflow
+        return keep, zero_seq, sidx, is_cx, total_overflow
 
     sharded = shard_map(
         step, mesh=mesh,
         in_specs=(
             P("jobs", "range", None), P("jobs", "range"), P("jobs", "range"),
+            P("jobs", "range"), P("jobs", "range"),
             P(), P(),
         ),
         out_specs=(
             P("jobs", "range"), P("jobs", "range"), P("jobs", "range"),
-            P("jobs"),
+            P("jobs", "range"), P("jobs"),
         ),
         check_rep=False,
     )
@@ -249,8 +270,11 @@ def run_distributed_gc(mesh: Mesh, jobs: list, snapshots: list[int],
                        bottommost: bool):
     """Host driver: jobs = list of padded column dicts (ck.pad_columns).
     All jobs must share the padded length and word count; the jobs list is
-    padded to the 'jobs' mesh dim. Returns per-job (keep, zero_seq,
-    sorted_idx) numpy arrays in global sorted order."""
+    padded to the 'jobs' mesh dim. Jobs may carry a "tomb_cover" uint64
+    array (per-row max covering tombstone seqno). Returns per-job
+    (keep, zero_seq, sorted_idx, is_complex) numpy arrays in global
+    sorted order; complex rows (MERGE/SINGLE_DELETE) are flagged per row —
+    group-level resolution is the host's job (groups can span shards)."""
     from toplingdb_tpu.ops.compaction_kernels import _split_snapshots
 
     jdim = mesh.shape["jobs"]
@@ -262,7 +286,10 @@ def run_distributed_gc(mesh: Mesh, jobs: list, snapshots: list[int],
     jpad = -(-nj // jdim) * jdim
     cols = np.full((jpad, p, w + 3), INT32MAX, dtype=np.int32)
     vtype = np.full((jpad, p), -1, dtype=np.int32)
-    idx = np.zeros((jpad, p), dtype=np.int32)
+    # -1 marks pad rows even on range=1 meshes (no all_to_all refill).
+    idx = np.full((jpad, p), -1, dtype=np.int32)
+    tomb_hi = np.zeros((jpad, p), dtype=np.int32)
+    tomb_lo = np.zeros((jpad, p), dtype=np.int32)
     for i, job in enumerate(jobs):
         n = job["key_words"].shape[0]
         cols[i, :n, :w] = job["key_words"]
@@ -270,15 +297,26 @@ def run_distributed_gc(mesh: Mesh, jobs: list, snapshots: list[int],
         cols[i, :n, w + 1] = job["inv_hi"]
         cols[i, :n, w + 2] = job["inv_lo"]
         vtype[i, :n] = job["vtype"]
-        idx[i, :n] = np.arange(n, dtype=np.int32)
+        n_real = job["n"]
+        idx[i, :n_real] = np.arange(n_real, dtype=np.int32)
+        cv = job.get("tomb_cover")
+        if cv is not None and len(cv):
+            from toplingdb_tpu.ops.compaction_kernels import _split_cover
+
+            # Per ORIGINAL row (uint64): rides the sort as payload words.
+            hi_w, lo_w = _split_cover(np.asarray(cv, dtype=np.uint64), p)
+            tomb_hi[i] = hi_w.view(np.int32)
+            tomb_lo[i] = lo_w.view(np.int32)
     snap_hi, snap_lo = _split_snapshots(snapshots)  # pow2 bucket pad >= 64
 
     step = make_distributed_gc_step(mesh, w, bottommost)
-    keep, zero_seq, sidx, overflow = step(cols, vtype, idx, snap_hi, snap_lo)
+    keep, zero_seq, sidx, is_cx, overflow = step(
+        cols, vtype, idx, tomb_hi, tomb_lo, snap_hi, snap_lo)
     if int(np.max(np.asarray(overflow))) > 0:
         from toplingdb_tpu.utils.status import TryAgain
 
         raise TryAgain("bucket overflow in distributed sort; retry 1-chip")
     return (
-        np.asarray(keep)[:nj], np.asarray(zero_seq)[:nj], np.asarray(sidx)[:nj],
+        np.asarray(keep)[:nj], np.asarray(zero_seq)[:nj],
+        np.asarray(sidx)[:nj], np.asarray(is_cx)[:nj],
     )
