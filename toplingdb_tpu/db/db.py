@@ -1269,10 +1269,91 @@ class DB:
             return it.value(), it.timestamp()
         return None
 
+    _TS_SLOW = object()  # fast-path bail sentinel
+
+    def _ts_fast_lookup(self, key: bytes, opts: ReadOptions, cf):
+        """Layered memtable-first point lookup on a timestamped DB — the
+        per-Get full-iterator build was this path's flagged perf debt.
+        Each source (memtable, immutables, overlapping files per level) is
+        seeked independently for its newest visible version; candidates
+        combine by (ts desc, seq desc), matching DBIter's dedup order.
+        Returns (value, ts) | None | _TS_SLOW when the workload needs the
+        iterator path (merge operator, range tombstones, undecided-seqno
+        exclusions)."""
+        if self.options.merge_operator is not None:
+            return self._TS_SLOW  # operand chains need full resolution
+        if self._excluded_for(opts):
+            return self._TS_SLOW  # WritePrepared visibility exclusions
+        cfd = self._cf_data(cf)
+        read_ts = (opts.timestamp if opts.timestamp is not None
+                   else dbformat.MAX_TIMESTAMP)
+        snap_seq = (
+            opts.snapshot.sequence if opts.snapshot is not None
+            else self.versions.last_sequence
+        )
+        enc_hi = dbformat.encode_ts_key(key, read_ts)   # newest visible
+        enc_lo = dbformat.encode_ts_key(key, 0)         # oldest possible
+        seek_ikey = dbformat.make_internal_key(
+            enc_hi, snap_seq, dbformat.VALUE_TYPE_FOR_SEEK)
+        best = None  # (ts, seq, vtype, value)
+
+        esc = enc_lo[:-8]  # escaped base key + terminator (ts-independent)
+
+        def probe(it):
+            """Source's best visible version into `best`; False = bail."""
+            nonlocal best
+            it.seek(seek_ikey)
+            while it.valid():
+                uk, seq, t = dbformat.split_internal_key(it.key())
+                if len(uk) != len(esc) + 8 or not uk.startswith(esc):
+                    break  # past this base key's versions
+                if t in (dbformat.ValueType.MERGE,
+                         dbformat.ValueType.SINGLE_DELETION):
+                    return False
+                if seq <= snap_seq:
+                    ts = dbformat.decode_ts(uk[-8:])
+                    cand = (ts, seq, t, it.value())
+                    if best is None or cand[:2] > best[:2]:
+                        best = cand
+                    break  # ordered (ts desc, seq desc): first wins here
+                it.next()
+            return True
+
+
+        for mem in [cfd.mem] + cfd.imm:
+            if mem._range_dels:
+                return self._TS_SLOW
+            if not probe(mem.new_iterator()):
+                return self._TS_SLOW
+        version = self.versions.cf_current(cfd.handle.id)
+        for level in range(version.num_levels):
+            for f in version.overlapping_files(level, enc_hi, enc_lo):
+                reader = self.table_cache.get_reader(f.number)
+                if reader.range_del_entries():
+                    return self._TS_SLOW
+                if not probe(reader.new_iterator()):
+                    return self._TS_SLOW
+        if best is None:
+            return None
+        if best[2] == dbformat.ValueType.BLOB_INDEX:
+            # Resolve through the blob source like GetContext does.
+            return self.blob_source.get(best[3]), best[0]
+        if best[2] != dbformat.ValueType.VALUE:
+            return None
+        return best[3], best[0]
+
+    def _ts_point_lookup(self, key: bytes, opts: ReadOptions,
+                         cf) -> tuple[bytes, int] | None:
+        self._check_read_ts(opts)  # the iterator path checks in new_iterator
+        hit = self._ts_fast_lookup(key, opts, cf)
+        if hit is not self._TS_SLOW:
+            return hit
+        return self._ts_lookup(self.new_iterator(opts, cf=cf), key)
+
     def _get_with_ts(self, key: bytes, opts: ReadOptions, cf) -> bytes | None:
         """Point lookup on a timestamped DB (reference GetImpl with
         ReadOptions.timestamp)."""
-        hit = self._ts_lookup(self.new_iterator(opts, cf=cf), key)
+        hit = self._ts_point_lookup(key, opts, cf)
         if hit is None:
             return None
         return b"" if opts.just_check_key_exists else hit[0]
@@ -1282,7 +1363,7 @@ class DB:
         """Get returning (value, version timestamp) — the reference's
         Get(..., std::string* timestamp) overload."""
         self._check_open()
-        return self._ts_lookup(self.new_iterator(opts, cf=cf), key)
+        return self._ts_point_lookup(key, opts, cf)
 
     def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,
                   cf=None) -> list[bytes | None]:

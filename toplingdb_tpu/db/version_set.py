@@ -33,15 +33,31 @@ class Version:
 
     def overlapping_files(self, level: int, smallest_user_key: bytes | None,
                           largest_user_key: bytes | None) -> list[FileMetaData]:
-        """Files whose user-key range intersects [smallest, largest]."""
+        """Files whose user-key range intersects [smallest, largest].
+        L1+ file lists are sorted and disjoint, so the scan bisects to the
+        first candidate instead of walking the level."""
         ucmp = self.icmp.user_comparator
+        fl = self.files[level]
+        start = 0
+        if level > 0 and smallest_user_key is not None and fl:
+            lo, hi = 0, len(fl)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if ucmp.compare(dbformat.extract_user_key(fl[mid].largest),
+                                smallest_user_key) < 0:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            start = lo
         out = []
-        for f in self.files[level]:
+        for f in fl[start:]:
             f_small = dbformat.extract_user_key(f.smallest)
             f_large = dbformat.extract_user_key(f.largest)
             if smallest_user_key is not None and ucmp.compare(f_large, smallest_user_key) < 0:
                 continue
             if largest_user_key is not None and ucmp.compare(f_small, largest_user_key) > 0:
+                if level > 0:
+                    break  # sorted disjoint: nothing further overlaps
                 continue
             out.append(f)
         return out
