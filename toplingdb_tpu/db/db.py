@@ -208,6 +208,12 @@ class DB:
         self._complete_firsts: set[int] = set()
         self._wal: LogWriter | None = None
         self._wal_number = 0
+        self._recycle_wals: list[int] = []  # obsolete WALs kept for reuse
+        # Only logs THIS process wrote in recyclable format may enter the
+        # pool — a legacy-format WAL's stale records carry no log-number
+        # stamp and could silently replay after reuse (reference
+        # alive_log_files scoping).
+        self._recyclable_written: set[int] = set()
         self._closed = False
         self._compaction_scheduler = None  # set by compaction module
         self._pending_outputs: set[int] = set()  # files being written by jobs
@@ -408,7 +414,8 @@ class DB:
         mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
         for num in sorted(wal_numbers):
             path = filename.log_file_name(self.dbname, num)
-            reader = LogReader(self.env.new_sequential_file(path))
+            reader = LogReader(self.env.new_sequential_file(path),
+                               log_number=num)
             for rec in reader.records():
                 batch = WriteBatch(rec)
                 batch.insert_into(mems)
@@ -444,10 +451,20 @@ class DB:
 
     def _new_wal(self) -> None:
         self._wal_number = self.versions.new_file_number()
-        w = self.env.new_writable_file(
-            filename.log_file_name(self.dbname, self._wal_number)
-        )
-        self._wal = LogWriter(w)
+        path = filename.log_file_name(self.dbname, self._wal_number)
+        recycle_on = self.options.recycle_log_file_num > 0
+        if recycle_on and self._recycle_wals:
+            old_num = self._recycle_wals.pop(0)
+            w = self.env.reuse_writable_file(
+                filename.log_file_name(self.dbname, old_num), path)
+        else:
+            w = self.env.new_writable_file(path)
+        # recycle_log_file_num > 0 => ALWAYS the recyclable record format,
+        # so any WAL written from now on is safe to reuse later.
+        self._wal = LogWriter(w, log_number=self._wal_number,
+                              recycled=recycle_on)
+        if recycle_on:
+            self._recyclable_written.add(self._wal_number)
 
     def close(self) -> None:
         if self._stats_dumper is not None:
@@ -2009,7 +2026,17 @@ class DB:
             ftype, num = filename.parse_file_name(child)
             keep = True
             if ftype == filename.FileType.WAL:
-                keep = num >= self.versions.log_number or num == self._wal_number
+                keep = (num >= self.versions.log_number
+                        or num == self._wal_number
+                        or num in self._recycle_wals)
+                if not keep and (len(self._recycle_wals)
+                                 < self.options.recycle_log_file_num
+                                 and num in self._recyclable_written):
+                    self._recycle_wals.append(num)
+                    keep = True
+                if not keep and self.options.wal_ttl_seconds > 0:
+                    self._archive_wal(child)
+                    continue
             elif ftype == filename.FileType.TABLE:
                 keep = num in live or num in self._pending_outputs
             elif ftype == filename.FileType.BLOB:
@@ -2030,6 +2057,51 @@ class DB:
                     self.env.delete_file(f"{self.dbname}/{child}")
                 except NotFound:
                     pass
+
+    def _archive_wal(self, child: str) -> None:
+        """Move an obsolete WAL to <db>/archive/ and purge entries older
+        than wal_ttl_seconds (reference WalManager::ArchiveWALFile /
+        PurgeObsoleteWALFiles)."""
+        arch = f"{self.dbname}/archive"
+        self.env.create_dir(arch)
+        try:
+            self.env.rename_file(f"{self.dbname}/{child}", f"{arch}/{child}")
+        except (OSError, NotFound):
+            return
+        now = time.time()
+        try:
+            names = self.env.get_children(arch)
+        except NotFound:
+            return
+        for name in names:
+            p = f"{arch}/{name}"
+            try:
+                mtime = self.env.get_file_mtime(p)
+                if mtime is not None and \
+                        now - mtime > self.options.wal_ttl_seconds:
+                    self.env.delete_file(p)
+            except (OSError, NotFound):
+                continue
+
+    def get_wal_files(self) -> list[tuple[int, str, bool]]:
+        """(log_number, path, archived) for every retained WAL — live AND
+        archived — oldest first (the reference WalFile metadata shape;
+        get_sorted_wal_files keeps its names-only live-file contract for
+        the backup tooling)."""
+        out = []
+        for child in self.env.get_children(self.dbname):
+            ftype, num = filename.parse_file_name(child)
+            if ftype == filename.FileType.WAL:
+                out.append((num, f"{self.dbname}/{child}", False))
+        arch = f"{self.dbname}/archive"
+        try:
+            for child in self.env.get_children(arch):
+                ftype, num = filename.parse_file_name(child)
+                if ftype == filename.FileType.WAL:
+                    out.append((num, f"{arch}/{child}", True))
+        except NotFound:
+            pass
+        return sorted(out)
 
     def verify_checksum(self) -> None:
         """Full checksum scan of every live SST (reference

@@ -44,7 +44,8 @@ class ReadOnlyDB(DB):
         for num in wal_numbers:
             try:
                 reader = LogReader(self.env.new_sequential_file(
-                    filename.log_file_name(self.dbname, num)))
+                    filename.log_file_name(self.dbname, num)),
+                    log_number=num)
                 for rec in reader.records():
                     batch = WriteBatch(rec)
                     batch.insert_into(mems)

@@ -16,17 +16,35 @@ from toplingdb_tpu.utils.status import Corruption
 
 BLOCK_SIZE = 32768
 HEADER_SIZE = 7
+# Recyclable records carry the owning log number after the type byte
+# (reference kRecyclableFullType..kRecyclableLastType, db/log_format.h):
+# a reused WAL file's stale tail from its previous life then reads as
+# end-of-log instead of replaying into the wrong recovery.
+RECYCLABLE_HEADER_SIZE = 11
 
 FULL = 1
 FIRST = 2
 MIDDLE = 3
 LAST = 4
+RECYCLABLE_FULL = 5
+RECYCLABLE_FIRST = 6
+RECYCLABLE_MIDDLE = 7
+RECYCLABLE_LAST = 8
+
+_RECYCLE_OF = {FULL: RECYCLABLE_FULL, FIRST: RECYCLABLE_FIRST,
+               MIDDLE: RECYCLABLE_MIDDLE, LAST: RECYCLABLE_LAST}
 
 
 class LogWriter:
-    def __init__(self, wfile):
+    def __init__(self, wfile, log_number: int = 0, recycled: bool = False):
+        """`recycled`: emit recyclable record types stamped with
+        `log_number` (required for files that may later be reused AND for
+        writes into a reused file)."""
         self._f = wfile
         self._block_offset = wfile.file_size() % BLOCK_SIZE
+        self._log_number = log_number
+        self._recycled = recycled
+        self._hdr = RECYCLABLE_HEADER_SIZE if recycled else HEADER_SIZE
 
     def add_record(self, data: bytes) -> None:
         left = len(data)
@@ -34,12 +52,12 @@ class LogWriter:
         begin = True
         while True:
             leftover = BLOCK_SIZE - self._block_offset
-            if leftover < HEADER_SIZE:
+            if leftover < self._hdr:
                 if leftover > 0:
                     self._f.append(b"\x00" * leftover)
                 self._block_offset = 0
                 leftover = BLOCK_SIZE
-            avail = leftover - HEADER_SIZE
+            avail = leftover - self._hdr
             frag = min(left, avail)
             end = left == frag
             if begin and end:
@@ -58,15 +76,25 @@ class LogWriter:
                 break
 
     def _emit(self, t: int, frag: bytes) -> None:
-        crc = crc32c.value(bytes([t]) + frag)
-        hdr = (
-            coding.encode_fixed32(crc32c.mask(crc))
-            + coding.encode_fixed16(len(frag))
-            + bytes([t])
-        )
+        if self._recycled:
+            t = _RECYCLE_OF[t]
+            ln = coding.encode_fixed32(self._log_number)
+            crc = crc32c.value(bytes([t]) + ln + frag)
+            hdr = (
+                coding.encode_fixed32(crc32c.mask(crc))
+                + coding.encode_fixed16(len(frag))
+                + bytes([t]) + ln
+            )
+        else:
+            crc = crc32c.value(bytes([t]) + frag)
+            hdr = (
+                coding.encode_fixed32(crc32c.mask(crc))
+                + coding.encode_fixed16(len(frag))
+                + bytes([t])
+            )
         self._f.append(hdr)
         self._f.append(frag)
-        self._block_offset += HEADER_SIZE + len(frag)
+        self._block_offset += self._hdr + len(frag)
 
     def flush(self) -> None:
         self._f.flush()
@@ -83,12 +111,19 @@ class LogReader:
     normal crash case — reference log_reader's eof handling) but raises
     Corruption on checksum mismatches in the middle of the log."""
 
-    def __init__(self, sfile, verify_checksums: bool = True):
+    def __init__(self, sfile, verify_checksums: bool = True,
+                 log_number: int | None = None):
+        """`log_number`: expected owner of recyclable records; a mismatch
+        (the reused file's previous life) reads as end-of-log."""
         self._f = sfile
         self._verify = verify_checksums
+        self._log_number = log_number
         self._buf = b""
         self._buf_off = 0
         self._eof = False
+        # Once a recyclable record is seen, mid-block garbage is the stale
+        # tail of the file's previous life — end-of-log, not corruption.
+        self._recycled_seen = False
 
     def _read_block(self) -> bool:
         data = self._f.read(BLOCK_SIZE)
@@ -116,12 +151,43 @@ class LogReader:
                 # Zero-padded block tail; skip to the next block.
                 self._buf_off = len(self._buf)
                 continue
-            if off + HEADER_SIZE + length > len(b):
-                if self._eof:
-                    return None  # truncated tail fragment: drop it
+            recyclable = RECYCLABLE_FULL <= t <= RECYCLABLE_LAST
+            tolerate = self._eof or self._recycled_seen
+            if t > RECYCLABLE_LAST:
+                if tolerate:
+                    return None  # stale previous-life bytes: end of log
+                raise Corruption(f"unknown log record type {t}")
+            hdr = RECYCLABLE_HEADER_SIZE if recyclable else HEADER_SIZE
+            if off + hdr > len(b):
+                if tolerate:
+                    return None
+                raise Corruption("log header overflows block")
+            if off + hdr + length > len(b):
+                if tolerate:
+                    return None  # truncated tail / stale fragment: drop
                 raise Corruption("log fragment overflows block")
-            payload = b[off + HEADER_SIZE : off + HEADER_SIZE + length]
-            self._buf_off = off + HEADER_SIZE + length
+            payload = b[off + hdr : off + hdr + length]
+            self._buf_off = off + hdr + length
+            if recyclable:
+                rec_ln = coding.decode_fixed32(b, off + 7)
+                if (self._log_number is not None
+                        and rec_ln != self._log_number):
+                    # Previous life of a recycled file: end of THIS log.
+                    return None
+                if self._verify:
+                    actual = crc32c.value(
+                        bytes([t]) + b[off + 7: off + 11] + payload)
+                    if crc32c.unmask(stored_crc) != actual:
+                        if tolerate:
+                            return None  # torn write / stale tail
+                        raise Corruption("log record checksum mismatch")
+                self._recycled_seen = True
+                t -= RECYCLABLE_FULL - FULL  # normalize for read_record
+                return t, payload
+            if self._recycled_seen:
+                # A classic-format header after recyclable records can only
+                # be previous-life residue: end of this log.
+                return None
             if self._verify:
                 actual = crc32c.value(bytes([t]) + payload)
                 if crc32c.unmask(stored_crc) != actual:

@@ -127,7 +127,7 @@ def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
         max_file_number = max(max_file_number, num)
         try:
             reader = LogReader(env.new_sequential_file(
-                filename.log_file_name(dbname, num)))
+                filename.log_file_name(dbname, num)), log_number=num)
             for rec in reader.records():
                 batch = WriteBatch(rec)
                 for cf, _, _, _ in batch.entries_cf():

@@ -95,6 +95,19 @@ class Env:
     def rename_file(self, src: str, dst: str) -> None:
         raise NotImplementedError
 
+    def reuse_writable_file(self, old_path: str, new_path: str) -> WritableFile:
+        """Rename old_path to new_path and open it for OVERWRITE from
+        offset 0 WITHOUT truncating (WAL recycling, reference
+        Env::ReuseWritableFile: the already-allocated blocks are rewritten
+        in place; the recyclable log format makes the stale tail safe)."""
+        self.rename_file(old_path, new_path)
+        return self.new_writable_file(new_path)  # fallback: truncates
+
+    def get_file_mtime(self, path: str) -> float | None:
+        """Last-modification time (reference Env::GetFileModificationTime);
+        None when the env doesn't track one (callers must not purge)."""
+        return None
+
     def create_dir(self, path: str) -> None:
         raise NotImplementedError
 
@@ -127,11 +140,15 @@ class Env:
 
 
 class _PosixWritable(WritableFile):
-    def __init__(self, path: str):
+    def __init__(self, path: str, reuse: bool = False):
         try:
-            self._f = open(path, "wb")
+            # reuse: overwrite in place from offset 0 without truncating
+            # (the recycled file's preallocated blocks are rewritten).
+            self._f = open(path, "r+b" if reuse else "wb")
         except OSError as e:
             raise IOError_(f"open {path}: {e}") from e
+        if reuse:
+            self._f.seek(0)
         self._size = 0
 
     def append(self, data: bytes) -> None:
@@ -194,6 +211,16 @@ class _PosixSequential(SequentialFile):
 class PosixEnv(Env):
     def new_writable_file(self, path: str) -> WritableFile:
         return _PosixWritable(path)
+
+    def reuse_writable_file(self, old_path: str, new_path: str) -> WritableFile:
+        os.replace(old_path, new_path)
+        return _PosixWritable(new_path, reuse=True)
+
+    def get_file_mtime(self, path: str) -> float | None:
+        try:
+            return os.path.getmtime(path)
+        except FileNotFoundError as e:
+            raise NotFound(path) from e
 
     def new_random_access_file(self, path: str) -> RandomAccessFile:
         return _PosixRandomAccess(path)

@@ -133,6 +133,16 @@ class Options:
     # L2+ role, README.md:50-56). None = table_options.format everywhere.
     bottommost_format: Optional[str] = None
 
+    # -- WAL lifecycle --------------------------------------------------
+    # Keep up to N obsolete WAL files for reuse (reference
+    # recycle_log_file_num, include/rocksdb/options.h:795): new WALs
+    # overwrite a recycled file in place (recyclable record format stamps
+    # each record with its log number, so the stale tail is inert).
+    recycle_log_file_num: int = 0
+    # Archive obsolete WALs under <db>/archive/ for this long instead of
+    # deleting them (reference WAL_ttl_seconds / WalManager retention).
+    wal_ttl_seconds: float = 0.0
+
     # -- distributed compaction (the dcompact boundary) -----------------
     compaction_executor_factory: Any = None  # CompactionExecutorFactory
 

@@ -173,7 +173,12 @@ def _wal_dump(path: str) -> int:
     from toplingdb_tpu.env import default_env
 
     env = default_env()
-    for rec in LogReader(env.new_sequential_file(path)).records():
+    from toplingdb_tpu.db import filename as _fn
+    import os as _os
+
+    _t, _num = _fn.parse_file_name(_os.path.basename(path))
+    for rec in LogReader(env.new_sequential_file(path),
+                         log_number=_num).records():
         b = WriteBatch(rec)
         print(f"seq={b.sequence()} count={b.count()}")
         for cf, t, k, v in b.entries_cf():
