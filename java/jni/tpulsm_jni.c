@@ -307,3 +307,90 @@ Java_org_toplingdb_TpuLsmIterator_valueNative(JNIEnv* env, jclass cls,
     char* buf = tpulsm_iter_value((tpulsm_iterator_t*)(intptr_t)h, &n);
     return iter_bytes_to_java(env, buf, n);
 }
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_mergeNative(JNIEnv* env, jclass cls, jlong h,
+                                        jbyteArray key, jbyteArray val) {
+    (void)cls;
+    char* err = NULL;
+    jsize kl = (*env)->GetArrayLength(env, key);
+    jsize vl = (*env)->GetArrayLength(env, val);
+    jbyte* k = (*env)->GetByteArrayElements(env, key, NULL);
+    jbyte* v = (*env)->GetByteArrayElements(env, val, NULL);
+    if (k && v)
+        tpulsm_merge((tpulsm_db_t*)(intptr_t)h, (const char*)k, (size_t)kl,
+                     (const char*)v, (size_t)vl, &err);
+    if (k) (*env)->ReleaseByteArrayElements(env, key, k, JNI_ABORT);
+    if (v) (*env)->ReleaseByteArrayElements(env, val, v, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_deleteRangeNative(JNIEnv* env, jclass cls,
+                                              jlong h, jbyteArray b,
+                                              jbyteArray e) {
+    (void)cls;
+    char* err = NULL;
+    jsize bl = (*env)->GetArrayLength(env, b);
+    jsize el = (*env)->GetArrayLength(env, e);
+    jbyte* bb = (*env)->GetByteArrayElements(env, b, NULL);
+    jbyte* eb = (*env)->GetByteArrayElements(env, e, NULL);
+    if (bb && eb)
+        tpulsm_delete_range((tpulsm_db_t*)(intptr_t)h, (const char*)bb,
+                            (size_t)bl, (const char*)eb, (size_t)el, &err);
+    if (bb) (*env)->ReleaseByteArrayElements(env, b, bb, JNI_ABORT);
+    if (eb) (*env)->ReleaseByteArrayElements(env, e, eb, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_TpuLsmDB_snapshotNative(JNIEnv* env, jclass cls, jlong h) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_snapshot_t* s =
+        tpulsm_create_snapshot((tpulsm_db_t*)(intptr_t)h, &err);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)s;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_releaseSnapshotNative(JNIEnv* env, jclass cls,
+                                                  jlong snap) {
+    (void)env; (void)cls;
+    tpulsm_release_snapshot((tpulsm_snapshot_t*)(intptr_t)snap);
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_toplingdb_TpuLsmDB_getAtSnapshotNative(JNIEnv* env, jclass cls,
+                                                jlong h, jlong snap,
+                                                jbyteArray key) {
+    (void)cls;
+    char* err = NULL;
+    size_t vl = 0;
+    jsize kl = (*env)->GetArrayLength(env, key);
+    jbyte* k = (*env)->GetByteArrayElements(env, key, NULL);
+    if (!k) return NULL;
+    char* v = tpulsm_get_at_snapshot(
+        (tpulsm_db_t*)(intptr_t)h, (tpulsm_snapshot_t*)(intptr_t)snap,
+        (const char*)k, (size_t)kl, &vl, &err);
+    (*env)->ReleaseByteArrayElements(env, key, k, JNI_ABORT);
+    if (check_err(env, err)) { tpulsm_free(v); return NULL; }
+    if (!v) return NULL;
+    jbyteArray out = (*env)->NewByteArray(env, (jsize)vl);
+    if (out)
+        (*env)->SetByteArrayRegion(env, out, 0, (jsize)vl, (const jbyte*)v);
+    tpulsm_free(v);
+    return out;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_checkpointNative(JNIEnv* env, jclass cls,
+                                             jlong h, jstring dest) {
+    (void)cls;
+    char* err = NULL;
+    const char* cdest = (*env)->GetStringUTFChars(env, dest, NULL);
+    if (!cdest) return;
+    tpulsm_checkpoint_create((tpulsm_db_t*)(intptr_t)h, cdest, &err);
+    (*env)->ReleaseStringUTFChars(env, dest, cdest);
+    check_err(env, err);
+}

@@ -1,0 +1,23 @@
+package org.toplingdb;
+
+/** A consistent read view pinned until {@link #close()} (the reference
+ * RocksDB Snapshot role; backed by tpulsm_create_snapshot). */
+public final class Snapshot implements AutoCloseable {
+    private long handle;
+
+    Snapshot(long handle) {
+        this.handle = handle;
+    }
+
+    long handle() {
+        return handle;
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            TpuLsmDB.releaseSnapshotNative(handle);
+            handle = 0;
+        }
+    }
+}

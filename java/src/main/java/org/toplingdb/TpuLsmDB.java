@@ -52,6 +52,36 @@ public class TpuLsmDB implements AutoCloseable {
         deleteNative(handle, key);
     }
 
+    /** Merge-operator operand append (reference RocksDB#merge). */
+    public void merge(byte[] key, byte[] value) throws TpuLsmException {
+        checkOpen();
+        mergeNative(handle, key, value);
+    }
+
+    /** Delete every key in [begin, end) (reference deleteRange). */
+    public void deleteRange(byte[] begin, byte[] end) throws TpuLsmException {
+        checkOpen();
+        deleteRangeNative(handle, begin, end);
+    }
+
+    /** Consistent point-in-time read view (reference Snapshot). */
+    public Snapshot getSnapshot() throws TpuLsmException {
+        checkOpen();
+        return new Snapshot(snapshotNative(handle));
+    }
+
+    /** Read at a snapshot; null when absent. */
+    public byte[] get(byte[] key, Snapshot snapshot) throws TpuLsmException {
+        checkOpen();
+        return getAtSnapshotNative(handle, snapshot.handle(), key);
+    }
+
+    /** Hard-link consistent checkpoint (reference Checkpoint). */
+    public void createCheckpoint(String destDir) throws TpuLsmException {
+        checkOpen();
+        checkpointNative(handle, destDir);
+    }
+
     /** Atomically apply a batch of updates. */
     public void write(WriteBatch batch) throws TpuLsmException {
         checkOpen();
@@ -122,4 +152,20 @@ public class TpuLsmDB implements AutoCloseable {
     private static native String propertyNative(long h, String name);
 
     private static native long iteratorNative(long h) throws TpuLsmException;
+
+    private static native void mergeNative(long h, byte[] k, byte[] v)
+            throws TpuLsmException;
+
+    private static native void deleteRangeNative(long h, byte[] b, byte[] e)
+            throws TpuLsmException;
+
+    private static native long snapshotNative(long h) throws TpuLsmException;
+
+    static native void releaseSnapshotNative(long snap);
+
+    private static native byte[] getAtSnapshotNative(long h, long snap,
+            byte[] k) throws TpuLsmException;
+
+    private static native void checkpointNative(long h, String dest)
+            throws TpuLsmException;
 }

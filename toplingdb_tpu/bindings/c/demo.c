@@ -111,9 +111,126 @@ int main(int argc, char** argv) {
         return 1;
     }
 
+    /* snapshots: read-your-history */
+    tpulsm_put(db, "snapkey", 7, "v1", 2, &err);
+    CHECK(err);
+    tpulsm_snapshot_t* snap = tpulsm_create_snapshot(db, &err);
+    CHECK(err);
+    tpulsm_put(db, "snapkey", 7, "v2", 2, &err);
+    CHECK(err);
+    v = tpulsm_get_at_snapshot(db, snap, "snapkey", 7, &n, &err);
+    CHECK(err);
+    if (!v || n != 2 || memcmp(v, "v1", 2) != 0) {
+        fprintf(stderr, "FAIL: snapshot read\n");
+        return 1;
+    }
+    tpulsm_free(v);
+    tpulsm_release_snapshot(snap);
+
+    /* delete_range */
+    tpulsm_put(db, "rka", 3, "1", 1, &err); CHECK(err);
+    tpulsm_put(db, "rkb", 3, "2", 1, &err); CHECK(err);
+    tpulsm_delete_range(db, "rka", 3, "rkb", 3, &err); CHECK(err);
+    v = tpulsm_get(db, "rka", 3, &n, &err); CHECK(err);
+    if (v) { fprintf(stderr, "FAIL: delete_range\n"); return 1; }
+    v = tpulsm_get(db, "rkb", 3, &n, &err); CHECK(err);
+    if (!v) { fprintf(stderr, "FAIL: delete_range end excl\n"); return 1; }
+    tpulsm_free(v);
+
+    /* column families */
+    tpulsm_cf_t* cf = tpulsm_create_column_family(db, "aux", &err);
+    CHECK(err);
+    tpulsm_put_cf(db, cf, "cfk", 3, "cfv", 3, &err);
+    CHECK(err);
+    v = tpulsm_get_cf(db, cf, "cfk", 3, &n, &err);
+    CHECK(err);
+    if (!v || n != 3 || memcmp(v, "cfv", 3) != 0) {
+        fprintf(stderr, "FAIL: cf get\n");
+        return 1;
+    }
+    tpulsm_free(v);
+    v = tpulsm_get(db, "cfk", 3, &n, &err);
+    CHECK(err);
+    if (v) { fprintf(stderr, "FAIL: cf leaked to default\n"); return 1; }
+    tpulsm_delete_cf(db, cf, "cfk", 3, &err);
+    CHECK(err);
+    tpulsm_cf_t* cf2 = tpulsm_column_family_handle(db, "aux", &err);
+    CHECK(err);
+    tpulsm_cf_handle_destroy(cf2);
+    tpulsm_cf_handle_destroy(cf);
+
+    /* checkpoint + backup engine */
+    char aux[1024];
+    snprintf(aux, sizeof aux, "%s_ckpt", path);
+    tpulsm_checkpoint_create(db, aux, &err);
+    CHECK(err);
+    snprintf(aux, sizeof aux, "%s_backups", path);
+    tpulsm_backup_engine_t* be = tpulsm_backup_engine_open(aux, &err);
+    CHECK(err);
+    int bid = tpulsm_backup_engine_create_backup(be, db, &err);
+    CHECK(err);
+    if (bid <= 0 || tpulsm_backup_engine_count(be) != 1) {
+        fprintf(stderr, "FAIL: backup create/count\n");
+        return 1;
+    }
+    snprintf(aux, sizeof aux, "%s_restored", path);
+    tpulsm_backup_engine_restore(be, 0, aux, &err);
+    CHECK(err);
+    tpulsm_backup_engine_close(be);
+
+    /* external SST build + ingest */
+    snprintf(aux, sizeof aux, "%s_ext.sst", path);
+    tpulsm_sstwriter_t* sw = tpulsm_sstfilewriter_create(aux, &err);
+    CHECK(err);
+    tpulsm_sstfilewriter_put(sw, "zzz-ext", 7, "ingested", 8, &err);
+    CHECK(err);
+    tpulsm_sstfilewriter_finish(sw, &err);
+    CHECK(err);
+    tpulsm_sstfilewriter_destroy(sw);
+    tpulsm_ingest_external_file(db, aux, &err);
+    CHECK(err);
+    v = tpulsm_get(db, "zzz-ext", 7, &n, &err);
+    CHECK(err);
+    if (!v || n != 8) { fprintf(stderr, "FAIL: ingest\n"); return 1; }
+    tpulsm_free(v);
+
     tpulsm_flush(db, &err);
     CHECK(err);
     tpulsm_close(db);
+
+    /* transactions (separate DB dir) */
+    snprintf(aux, sizeof aux, "%s_txn", path);
+    tpulsm_txndb_t* tdb = tpulsm_txndb_open(aux, 1, &err);
+    CHECK(err);
+    tpulsm_txn_t* txn = tpulsm_txn_begin(tdb, &err);
+    CHECK(err);
+    tpulsm_txn_put(txn, "tk", 2, "tv", 2, &err);
+    CHECK(err);
+    v = tpulsm_txn_get(txn, "tk", 2, &n, &err);
+    CHECK(err);
+    if (!v || n != 2) { fprintf(stderr, "FAIL: txn read-own-write\n"); return 1; }
+    tpulsm_free(v);
+    tpulsm_txn_commit(txn, &err);
+    CHECK(err);
+    tpulsm_txn_destroy(txn);
+    v = tpulsm_txndb_get(tdb, "tk", 2, &n, &err);
+    CHECK(err);
+    if (!v || n != 2 || memcmp(v, "tv", 2) != 0) {
+        fprintf(stderr, "FAIL: txn commit visible\n");
+        return 1;
+    }
+    tpulsm_free(v);
+    tpulsm_txn_t* txn2 = tpulsm_txn_begin(tdb, &err);
+    CHECK(err);
+    tpulsm_txn_put(txn2, "tk2", 3, "x", 1, &err);
+    CHECK(err);
+    tpulsm_txn_rollback(txn2, &err);
+    CHECK(err);
+    tpulsm_txn_destroy(txn2);
+    v = tpulsm_txndb_get(tdb, "tk2", 3, &n, &err);
+    CHECK(err);
+    if (v) { fprintf(stderr, "FAIL: rolled-back write visible\n"); return 1; }
+    tpulsm_txndb_close(tdb);
 
     db = tpulsm_open(path, 0, &err); /* reopen: recovery path */
     CHECK(err);

@@ -428,3 +428,611 @@ char* tpulsm_property_value(tpulsm_db_t* db, const char* name) {
     PyGILState_Release(g);
     return out;
 }
+
+/* =======================================================================
+ * Extended surface: merge/delete_range, snapshots, column families,
+ * checkpoint, backup engine, transactions, SST ingest — the
+ * rocksdb_c-style breadth (reference include/rocksdb/c.h families).
+ * Shared helpers below keep each binding a thin adapter.
+ * ======================================================================= */
+
+/* Convert a python bytes/None result to a malloc'd buffer (tpulsm_get's
+ * contract); steals nothing, clears nothing. */
+static char* bytes_result(PyObject* r, size_t* vallen, char** errptr) {
+    char* out = NULL;
+    if (vallen) *vallen = 0;
+    if (!r) {
+        set_err_from_python(errptr);
+        return NULL;
+    }
+    if (r != Py_None) {
+        char* buf = NULL;
+        Py_ssize_t n = 0;
+        if (PyBytes_AsStringAndSize(r, &buf, &n) == 0) {
+            out = (char*)malloc(n > 0 ? (size_t)n : 1);
+            if (out) {
+                memcpy(out, buf, (size_t)n);
+                if (vallen) *vallen = (size_t)n;
+            } else if (errptr) {
+                *errptr = dup_cstr("out of memory");
+            }
+        } else {
+            set_err_from_python(errptr);
+        }
+    }
+    return out;
+}
+
+/* Call obj.meth(key[, val]) with the bytes convention; NULL obj guarded by
+ * callers. Returns 0 on success. */
+static void kv_call(PyObject* obj, const char* meth, const char* a,
+                    size_t alen, const char* b, size_t blen, char** errptr) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = b
+        ? PyObject_CallMethod(obj, meth, "y#y#", a, (Py_ssize_t)alen,
+                              b, (Py_ssize_t)blen)
+        : PyObject_CallMethod(obj, meth, "y#", a, (Py_ssize_t)alen);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_merge(tpulsm_db_t* db, const char* key, size_t keylen,
+                  const char* val, size_t vallen, char** errptr) {
+    if (!db) { if (errptr) *errptr = dup_cstr("null db handle"); return; }
+    kv_call(db->obj, "merge", key, keylen, val, vallen, errptr);
+}
+
+void tpulsm_delete_range(tpulsm_db_t* db, const char* begin, size_t blen,
+                         const char* end, size_t elen, char** errptr) {
+    if (!db) { if (errptr) *errptr = dup_cstr("null db handle"); return; }
+    kv_call(db->obj, "delete_range", begin, blen, end, elen, errptr);
+}
+
+void tpulsm_writebatch_merge(tpulsm_writebatch_t* wb, const char* key,
+                             size_t keylen, const char* val, size_t vallen,
+                             char** errptr) {
+    if (!wb) { if (errptr) *errptr = dup_cstr("null batch"); return; }
+    kv_call(wb->obj, "merge", key, keylen, val, vallen, errptr);
+}
+
+void tpulsm_writebatch_delete_range(tpulsm_writebatch_t* wb,
+                                    const char* begin, size_t blen,
+                                    const char* end, size_t elen,
+                                    char** errptr) {
+    if (!wb) { if (errptr) *errptr = dup_cstr("null batch"); return; }
+    kv_call(wb->obj, "delete_range", begin, blen, end, elen, errptr);
+}
+
+void tpulsm_writebatch_clear(tpulsm_writebatch_t* wb) {
+    if (!wb) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(wb->obj, "clear", NULL);
+    if (!r) PyErr_Clear();
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+int tpulsm_writebatch_count(tpulsm_writebatch_t* wb) {
+    if (!wb) return 0;
+    PyGILState_STATE g = PyGILState_Ensure();
+    int n = 0;
+    PyObject* r = PyObject_CallMethod(wb->obj, "count", NULL);
+    if (r) n = (int)PyLong_AsLong(r);
+    if (PyErr_Occurred()) PyErr_Clear();
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return n;
+}
+
+/* -- snapshots ----------------------------------------------------------- */
+
+struct tpulsm_snapshot_t { PyObject* obj; };
+
+tpulsm_snapshot_t* tpulsm_create_snapshot(tpulsm_db_t* db, char** errptr) {
+    if (!db) { if (errptr) *errptr = dup_cstr("null db handle"); return NULL; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_snapshot_t* out = NULL;
+    PyObject* r = PyObject_CallMethod(db->obj, "get_snapshot", NULL);
+    if (r) {
+        out = (tpulsm_snapshot_t*)malloc(sizeof(*out));
+        if (out) out->obj = r;
+        else { Py_DECREF(r); if (errptr) *errptr = dup_cstr("out of memory"); }
+    } else {
+        set_err_from_python(errptr);
+    }
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_release_snapshot(tpulsm_snapshot_t* snap) {
+    if (!snap) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(snap->obj, "release", NULL);
+    if (!r) PyErr_Clear();
+    Py_XDECREF(r);
+    Py_DECREF(snap->obj);
+    PyGILState_Release(g);
+    free(snap);
+}
+
+/* WriteOptions() helper (shared by the *_cf write bindings). */
+static PyObject* write_opts_new(void) {
+    PyObject* omod = PyImport_ImportModule("toplingdb_tpu.options");
+    if (!omod) return NULL;
+    PyObject* wo = PyObject_CallMethod(omod, "WriteOptions", NULL);
+    Py_DECREF(omod);
+    return wo;
+}
+
+/* ReadOptions(snapshot=snap) helper. */
+static PyObject* read_opts_with(PyObject* snap) {
+    PyObject* omod = PyImport_ImportModule("toplingdb_tpu.options");
+    if (!omod) return NULL;
+    PyObject* cls = PyObject_GetAttrString(omod, "ReadOptions");
+    Py_DECREF(omod);
+    if (!cls) return NULL;
+    PyObject* kw = PyDict_New();
+    PyObject* empty = PyTuple_New(0);
+    PyObject* ro = NULL;
+    if (kw && empty && (!snap || PyDict_SetItemString(kw, "snapshot", snap) == 0))
+        ro = PyObject_Call(cls, empty, kw);
+    Py_XDECREF(kw);
+    Py_XDECREF(empty);
+    Py_DECREF(cls);
+    return ro;
+}
+
+char* tpulsm_get_at_snapshot(tpulsm_db_t* db, tpulsm_snapshot_t* snap,
+                             const char* key, size_t keylen, size_t* vallen,
+                             char** errptr) {
+    if (!db || !snap) {
+        if (errptr) *errptr = dup_cstr("null handle");
+        if (vallen) *vallen = 0;
+        return NULL;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    char* out = NULL;
+    PyObject* ro = read_opts_with(snap->obj);
+    PyObject* r = ro ? PyObject_CallMethod(
+        db->obj, "get", "y#O", key, (Py_ssize_t)keylen, ro) : NULL;
+    out = bytes_result(r, vallen, errptr);
+    Py_XDECREF(r);
+    Py_XDECREF(ro);
+    PyGILState_Release(g);
+    return out;
+}
+
+/* -- column families ----------------------------------------------------- */
+
+struct tpulsm_cf_t { PyObject* obj; };
+
+tpulsm_cf_t* tpulsm_create_column_family(tpulsm_db_t* db, const char* name,
+                                         char** errptr) {
+    if (!db) { if (errptr) *errptr = dup_cstr("null db handle"); return NULL; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_cf_t* out = NULL;
+    PyObject* r = PyObject_CallMethod(db->obj, "create_column_family", "s",
+                                      name);
+    if (r) {
+        out = (tpulsm_cf_t*)malloc(sizeof(*out));
+        if (out) out->obj = r;
+        else { Py_DECREF(r); if (errptr) *errptr = dup_cstr("out of memory"); }
+    } else {
+        set_err_from_python(errptr);
+    }
+    PyGILState_Release(g);
+    return out;
+}
+
+tpulsm_cf_t* tpulsm_column_family_handle(tpulsm_db_t* db, const char* name,
+                                         char** errptr) {
+    if (!db) { if (errptr) *errptr = dup_cstr("null db handle"); return NULL; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_cf_t* out = NULL;
+    PyObject* lst = PyObject_CallMethod(db->obj, "list_column_families", NULL);
+    if (lst && PyList_Check(lst)) {
+        Py_ssize_t n = PyList_Size(lst);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject* h = PyList_GetItem(lst, i); /* borrowed */
+            PyObject* nm = h ? PyObject_GetAttrString(h, "name") : NULL;
+            const char* s = nm ? PyUnicode_AsUTF8(nm) : NULL;
+            if (s && strcmp(s, name) == 0) {
+                out = (tpulsm_cf_t*)malloc(sizeof(*out));
+                if (out) { Py_INCREF(h); out->obj = h; }
+                Py_XDECREF(nm);
+                break;
+            }
+            Py_XDECREF(nm);
+        }
+        if (!out && errptr)
+            *errptr = dup_cstr("column family not found");
+    } else {
+        set_err_from_python(errptr);
+    }
+    Py_XDECREF(lst);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_drop_column_family(tpulsm_db_t* db, tpulsm_cf_t* cf,
+                               char** errptr) {
+    if (!db || !cf) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(db->obj, "drop_column_family", "O",
+                                      cf->obj);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_cf_handle_destroy(tpulsm_cf_t* cf) {
+    if (!cf) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_DECREF(cf->obj);
+    PyGILState_Release(g);
+    free(cf);
+}
+
+void tpulsm_put_cf(tpulsm_db_t* db, tpulsm_cf_t* cf, const char* key,
+                   size_t keylen, const char* val, size_t vallen,
+                   char** errptr) {
+    if (!db || !cf) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* wo = write_opts_new();
+    PyObject* r = wo ? PyObject_CallMethod(
+        db->obj, "put", "y#y#OO", key, (Py_ssize_t)keylen,
+        val, (Py_ssize_t)vallen, wo, cf->obj) : NULL;
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    Py_XDECREF(wo);
+    PyGILState_Release(g);
+}
+
+char* tpulsm_get_cf(tpulsm_db_t* db, tpulsm_cf_t* cf, const char* key,
+                    size_t keylen, size_t* vallen, char** errptr) {
+    if (!db || !cf) {
+        if (errptr) *errptr = dup_cstr("null handle");
+        if (vallen) *vallen = 0;
+        return NULL;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* ro = read_opts_with(NULL);
+    PyObject* r = ro ? PyObject_CallMethod(
+        db->obj, "get", "y#OO", key, (Py_ssize_t)keylen, ro, cf->obj) : NULL;
+    char* out = bytes_result(r, vallen, errptr);
+    Py_XDECREF(r);
+    Py_XDECREF(ro);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_delete_cf(tpulsm_db_t* db, tpulsm_cf_t* cf, const char* key,
+                      size_t keylen, char** errptr) {
+    if (!db || !cf) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* wo = write_opts_new();
+    PyObject* r = wo ? PyObject_CallMethod(
+        db->obj, "delete", "y#OO", key, (Py_ssize_t)keylen, wo, cf->obj)
+        : NULL;
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    Py_XDECREF(wo);
+    PyGILState_Release(g);
+}
+
+/* -- checkpoint ---------------------------------------------------------- */
+
+void tpulsm_checkpoint_create(tpulsm_db_t* db, const char* dest,
+                              char** errptr) {
+    if (!db) { if (errptr) *errptr = dup_cstr("null db handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* mod = PyImport_ImportModule("toplingdb_tpu.utilities.checkpoint");
+    PyObject* r = mod ? PyObject_CallMethod(mod, "create_checkpoint", "Os",
+                                            db->obj, dest) : NULL;
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+}
+
+/* -- backup engine ------------------------------------------------------- */
+
+struct tpulsm_backup_engine_t { PyObject* obj; };
+
+tpulsm_backup_engine_t* tpulsm_backup_engine_open(const char* dir,
+                                                  char** errptr) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_backup_engine_t* out = NULL;
+    PyObject* mod = PyImport_ImportModule(
+        "toplingdb_tpu.utilities.backup_engine");
+    PyObject* be = mod ? PyObject_CallMethod(mod, "BackupEngine", "s", dir)
+                       : NULL;
+    if (be) {
+        out = (tpulsm_backup_engine_t*)malloc(sizeof(*out));
+        if (out) out->obj = be;
+        else { Py_DECREF(be); if (errptr) *errptr = dup_cstr("out of memory"); }
+    } else {
+        set_err_from_python(errptr);
+    }
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_backup_engine_close(tpulsm_backup_engine_t* be) {
+    if (!be) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_DECREF(be->obj);
+    PyGILState_Release(g);
+    free(be);
+}
+
+int tpulsm_backup_engine_create_backup(tpulsm_backup_engine_t* be,
+                                       tpulsm_db_t* db, char** errptr) {
+    if (!be || !db) {
+        if (errptr) *errptr = dup_cstr("null handle");
+        return 0;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    int id = 0;
+    PyObject* r = PyObject_CallMethod(be->obj, "create_backup", "O", db->obj);
+    if (r) id = (int)PyLong_AsLong(r);
+    if (!r || PyErr_Occurred()) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return id;
+}
+
+int tpulsm_backup_engine_count(tpulsm_backup_engine_t* be) {
+    if (!be) return 0;
+    PyGILState_STATE g = PyGILState_Ensure();
+    int n = 0;
+    PyObject* r = PyObject_CallMethod(be->obj, "get_backup_info", NULL);
+    if (r && PyList_Check(r)) n = (int)PyList_Size(r);
+    else PyErr_Clear();
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return n;
+}
+
+void tpulsm_backup_engine_restore(tpulsm_backup_engine_t* be, int backup_id,
+                                  const char* target_dir, char** errptr) {
+    if (!be) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    if (backup_id <= 0) {
+        /* 0 = latest */
+        PyObject* info = PyObject_CallMethod(be->obj, "get_backup_info", NULL);
+        if (info && PyList_Check(info) && PyList_Size(info) > 0) {
+            PyObject* last = PyList_GetItem(info, PyList_Size(info) - 1);
+            PyObject* bid = last ? PyDict_GetItemString(last, "backup_id")
+                                 : NULL;
+            if (bid) backup_id = (int)PyLong_AsLong(bid);
+            if (PyErr_Occurred()) PyErr_Clear();
+        }
+        Py_XDECREF(info);
+        if (backup_id <= 0) {
+            if (errptr) *errptr = dup_cstr("no backups");
+            PyGILState_Release(g);
+            return;
+        }
+    }
+    PyObject* r = PyObject_CallMethod(be->obj, "restore_db_from_backup",
+                                      "is", backup_id, target_dir);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_backup_engine_purge_old(tpulsm_backup_engine_t* be,
+                                    int num_to_keep, char** errptr) {
+    if (!be) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(be->obj, "purge_old_backups", "i",
+                                      num_to_keep);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+/* -- transactions -------------------------------------------------------- */
+
+struct tpulsm_txndb_t { PyObject* obj; };
+struct tpulsm_txn_t { PyObject* obj; };
+
+tpulsm_txndb_t* tpulsm_txndb_open(const char* path, int create_if_missing,
+                                  char** errptr) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_txndb_t* out = NULL;
+    PyObject* mod = PyImport_ImportModule(
+        "toplingdb_tpu.utilities.transactions");
+    PyObject* omod = PyImport_ImportModule("toplingdb_tpu.options");
+    PyObject* opts = omod ? PyObject_CallMethod(omod, "Options", NULL) : NULL;
+    if (opts)
+        PyObject_SetAttrString(opts, "create_if_missing",
+                               create_if_missing ? Py_True : Py_False);
+    PyObject* cls = mod ? PyObject_GetAttrString(mod, "TransactionDB") : NULL;
+    PyObject* tdb = (cls && opts)
+        ? PyObject_CallMethod(cls, "open", "sO", path, opts) : NULL;
+    if (tdb) {
+        out = (tpulsm_txndb_t*)malloc(sizeof(*out));
+        if (out) out->obj = tdb;
+        else { Py_DECREF(tdb); if (errptr) *errptr = dup_cstr("out of memory"); }
+    } else {
+        set_err_from_python(errptr);
+    }
+    Py_XDECREF(cls);
+    Py_XDECREF(opts);
+    Py_XDECREF(omod);
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_txndb_close(tpulsm_txndb_t* tdb) {
+    if (!tdb) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(tdb->obj, "close", NULL);
+    if (!r) PyErr_Clear();
+    Py_XDECREF(r);
+    Py_DECREF(tdb->obj);
+    PyGILState_Release(g);
+    free(tdb);
+}
+
+tpulsm_txn_t* tpulsm_txn_begin(tpulsm_txndb_t* tdb, char** errptr) {
+    if (!tdb) { if (errptr) *errptr = dup_cstr("null handle"); return NULL; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_txn_t* out = NULL;
+    PyObject* r = PyObject_CallMethod(tdb->obj, "begin_transaction", NULL);
+    if (r) {
+        out = (tpulsm_txn_t*)malloc(sizeof(*out));
+        if (out) out->obj = r;
+        else { Py_DECREF(r); if (errptr) *errptr = dup_cstr("out of memory"); }
+    } else {
+        set_err_from_python(errptr);
+    }
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_txn_put(tpulsm_txn_t* txn, const char* key, size_t keylen,
+                    const char* val, size_t vallen, char** errptr) {
+    if (!txn) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    kv_call(txn->obj, "put", key, keylen, val, vallen, errptr);
+}
+
+char* tpulsm_txn_get(tpulsm_txn_t* txn, const char* key, size_t keylen,
+                     size_t* vallen, char** errptr) {
+    if (!txn) {
+        if (errptr) *errptr = dup_cstr("null handle");
+        if (vallen) *vallen = 0;
+        return NULL;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(txn->obj, "get", "y#", key,
+                                      (Py_ssize_t)keylen);
+    char* out = bytes_result(r, vallen, errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_txn_delete(tpulsm_txn_t* txn, const char* key, size_t keylen,
+                       char** errptr) {
+    if (!txn) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    kv_call(txn->obj, "delete", key, keylen, NULL, 0, errptr);
+}
+
+void tpulsm_txn_commit(tpulsm_txn_t* txn, char** errptr) {
+    if (!txn) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(txn->obj, "commit", NULL);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_txn_rollback(tpulsm_txn_t* txn, char** errptr) {
+    if (!txn) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(txn->obj, "rollback", NULL);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_txn_destroy(tpulsm_txn_t* txn) {
+    if (!txn) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_DECREF(txn->obj);
+    PyGILState_Release(g);
+    free(txn);
+}
+
+char* tpulsm_txndb_get(tpulsm_txndb_t* tdb, const char* key, size_t keylen,
+                       size_t* vallen, char** errptr) {
+    if (!tdb) {
+        if (errptr) *errptr = dup_cstr("null handle");
+        if (vallen) *vallen = 0;
+        return NULL;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(tdb->obj, "get", "y#", key,
+                                      (Py_ssize_t)keylen);
+    char* out = bytes_result(r, vallen, errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+    return out;
+}
+
+/* -- external SSTs ------------------------------------------------------- */
+
+struct tpulsm_sstwriter_t { PyObject* obj; };
+
+tpulsm_sstwriter_t* tpulsm_sstfilewriter_create(const char* path,
+                                                char** errptr) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_sstwriter_t* out = NULL;
+    PyObject* mod = PyImport_ImportModule(
+        "toplingdb_tpu.utilities.sst_file_writer");
+    PyObject* w = mod ? PyObject_CallMethod(mod, "SstFileWriter", NULL)
+                      : NULL;
+    if (!w) {
+        set_err_from_python(errptr);
+    } else {
+        PyObject* r = PyObject_CallMethod(w, "open", "s", path);
+        if (!r) {
+            set_err_from_python(errptr);
+            Py_DECREF(w);
+            w = NULL;
+        }
+        Py_XDECREF(r);
+    }
+    if (w) {
+        out = (tpulsm_sstwriter_t*)malloc(sizeof(*out));
+        if (out) out->obj = w;
+        else { Py_DECREF(w); if (errptr) *errptr = dup_cstr("out of memory"); }
+    }
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+    return out;
+}
+
+void tpulsm_sstfilewriter_put(tpulsm_sstwriter_t* w, const char* key,
+                              size_t keylen, const char* val, size_t vallen,
+                              char** errptr) {
+    if (!w) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    kv_call(w->obj, "put", key, keylen, val, vallen, errptr);
+}
+
+void tpulsm_sstfilewriter_finish(tpulsm_sstwriter_t* w, char** errptr) {
+    if (!w) { if (errptr) *errptr = dup_cstr("null handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(w->obj, "finish", NULL);
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_sstfilewriter_destroy(tpulsm_sstwriter_t* w) {
+    if (!w) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_DECREF(w->obj);
+    PyGILState_Release(g);
+    free(w);
+}
+
+void tpulsm_ingest_external_file(tpulsm_db_t* db, const char* path,
+                                 char** errptr) {
+    if (!db) { if (errptr) *errptr = dup_cstr("null db handle"); return; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* mod = PyImport_ImportModule(
+        "toplingdb_tpu.utilities.sst_file_writer");
+    PyObject* r = mod ? PyObject_CallMethod(mod, "ingest_external_file",
+                                            "Os", db->obj, path) : NULL;
+    if (!r) set_err_from_python(errptr);
+    Py_XDECREF(r);
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+}

@@ -77,6 +77,97 @@ void tpulsm_iter_get_error(tpulsm_iterator_t* it, char** errptr);
 /* malloc'd property string (tpulsm_free), or NULL when unknown. */
 char* tpulsm_property_value(tpulsm_db_t* db, const char* name);
 
+/* -- more point ops (reference rocksdb_merge / rocksdb_delete_range) ----- */
+void tpulsm_merge(tpulsm_db_t* db, const char* key, size_t keylen,
+                  const char* val, size_t vallen, char** errptr);
+void tpulsm_delete_range(tpulsm_db_t* db, const char* begin, size_t blen,
+                         const char* end, size_t elen, char** errptr);
+void tpulsm_writebatch_merge(tpulsm_writebatch_t* wb, const char* key,
+                             size_t keylen, const char* val, size_t vallen,
+                             char** errptr);
+void tpulsm_writebatch_delete_range(tpulsm_writebatch_t* wb,
+                                    const char* begin, size_t blen,
+                                    const char* end, size_t elen,
+                                    char** errptr);
+void tpulsm_writebatch_clear(tpulsm_writebatch_t* wb);
+int tpulsm_writebatch_count(tpulsm_writebatch_t* wb);
+
+/* -- snapshots (reference rocksdb_create_snapshot / read at snapshot) ---- */
+typedef struct tpulsm_snapshot_t tpulsm_snapshot_t;
+tpulsm_snapshot_t* tpulsm_create_snapshot(tpulsm_db_t* db, char** errptr);
+void tpulsm_release_snapshot(tpulsm_snapshot_t* snap);
+char* tpulsm_get_at_snapshot(tpulsm_db_t* db, tpulsm_snapshot_t* snap,
+                             const char* key, size_t keylen, size_t* vallen,
+                             char** errptr);
+
+/* -- column families (reference rocksdb_column_family_handle_t) ---------- */
+typedef struct tpulsm_cf_t tpulsm_cf_t;
+tpulsm_cf_t* tpulsm_create_column_family(tpulsm_db_t* db, const char* name,
+                                         char** errptr);
+/* Existing CF by name (from DB.list_column_families), or NULL + error. */
+tpulsm_cf_t* tpulsm_column_family_handle(tpulsm_db_t* db, const char* name,
+                                         char** errptr);
+void tpulsm_drop_column_family(tpulsm_db_t* db, tpulsm_cf_t* cf,
+                               char** errptr);
+void tpulsm_cf_handle_destroy(tpulsm_cf_t* cf);
+void tpulsm_put_cf(tpulsm_db_t* db, tpulsm_cf_t* cf, const char* key,
+                   size_t keylen, const char* val, size_t vallen,
+                   char** errptr);
+char* tpulsm_get_cf(tpulsm_db_t* db, tpulsm_cf_t* cf, const char* key,
+                    size_t keylen, size_t* vallen, char** errptr);
+void tpulsm_delete_cf(tpulsm_db_t* db, tpulsm_cf_t* cf, const char* key,
+                      size_t keylen, char** errptr);
+
+/* -- checkpoint (reference rocksdb_checkpoint_create) -------------------- */
+void tpulsm_checkpoint_create(tpulsm_db_t* db, const char* dest,
+                              char** errptr);
+
+/* -- backup engine (reference rocksdb_backup_engine_*) ------------------- */
+typedef struct tpulsm_backup_engine_t tpulsm_backup_engine_t;
+tpulsm_backup_engine_t* tpulsm_backup_engine_open(const char* dir,
+                                                  char** errptr);
+void tpulsm_backup_engine_close(tpulsm_backup_engine_t* be);
+/* Returns the new backup id (>0), or 0 on error. */
+int tpulsm_backup_engine_create_backup(tpulsm_backup_engine_t* be,
+                                       tpulsm_db_t* db, char** errptr);
+int tpulsm_backup_engine_count(tpulsm_backup_engine_t* be);
+void tpulsm_backup_engine_restore(tpulsm_backup_engine_t* be, int backup_id,
+                                  const char* target_dir, char** errptr);
+void tpulsm_backup_engine_purge_old(tpulsm_backup_engine_t* be,
+                                    int num_to_keep, char** errptr);
+
+/* -- pessimistic transactions (reference rocksdb_transactiondb_*) -------- */
+typedef struct tpulsm_txndb_t tpulsm_txndb_t;
+typedef struct tpulsm_txn_t tpulsm_txn_t;
+tpulsm_txndb_t* tpulsm_txndb_open(const char* path, int create_if_missing,
+                                  char** errptr);
+void tpulsm_txndb_close(tpulsm_txndb_t* tdb);
+tpulsm_txn_t* tpulsm_txn_begin(tpulsm_txndb_t* tdb, char** errptr);
+void tpulsm_txn_put(tpulsm_txn_t* txn, const char* key, size_t keylen,
+                    const char* val, size_t vallen, char** errptr);
+char* tpulsm_txn_get(tpulsm_txn_t* txn, const char* key, size_t keylen,
+                     size_t* vallen, char** errptr);
+void tpulsm_txn_delete(tpulsm_txn_t* txn, const char* key, size_t keylen,
+                       char** errptr);
+void tpulsm_txn_commit(tpulsm_txn_t* txn, char** errptr);
+void tpulsm_txn_rollback(tpulsm_txn_t* txn, char** errptr);
+void tpulsm_txn_destroy(tpulsm_txn_t* txn);
+/* Point reads through the txn DB outside any transaction. */
+char* tpulsm_txndb_get(tpulsm_txndb_t* tdb, const char* key, size_t keylen,
+                       size_t* vallen, char** errptr);
+
+/* -- external SSTs (reference rocksdb_sstfilewriter / ingest) ------------ */
+typedef struct tpulsm_sstwriter_t tpulsm_sstwriter_t;
+tpulsm_sstwriter_t* tpulsm_sstfilewriter_create(const char* path,
+                                                char** errptr);
+void tpulsm_sstfilewriter_put(tpulsm_sstwriter_t* w, const char* key,
+                              size_t keylen, const char* val, size_t vallen,
+                              char** errptr);
+void tpulsm_sstfilewriter_finish(tpulsm_sstwriter_t* w, char** errptr);
+void tpulsm_sstfilewriter_destroy(tpulsm_sstwriter_t* w);
+void tpulsm_ingest_external_file(tpulsm_db_t* db, const char* path,
+                                 char** errptr);
+
 #ifdef __cplusplus
 }
 #endif
