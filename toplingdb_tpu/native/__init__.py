@@ -24,7 +24,7 @@ _tried = False
 def _build() -> bool:
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        "-o", _SO + ".tmp", _SRC,
+        "-o", _SO + ".tmp", _SRC, "-ldl",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -124,6 +124,30 @@ def lib() -> ctypes.CDLL | None:
             l.tpulsm_skiplist_insert_batch.argtypes = [
                 ctypes.c_void_p, u8p, i64p, i32p, u64p,
                 u8p, i64p, i32p, ctypes.c_int64,
+            ]
+        except AttributeError:
+            pass
+        try:
+            # Compressed section builder: build + compress + frame whole
+            # runs of blocks in one call (snappy/zstd dlopen'd).
+            l.tpulsm_build_data_section_c.restype = ctypes.c_int64
+            l.tpulsm_build_data_section_c.argtypes = [
+                u8p, i32p, i32p, u8p, i32p, i32p, i64p, i32p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int64, ctypes.c_int64,
+                i64p, i64p, i64p, ctypes.c_int64,
+                u8p, ctypes.c_int64, i64p,
+            ]
+        except AttributeError:
+            pass
+        try:
+            # In-block point seek (restart bsearch + linear scan in C):
+            # the BlockIter.seek hot path of every Get.
+            l.tpulsm_block_seek.restype = ctypes.c_int32
+            l.tpulsm_block_seek.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+                ctypes.c_int32, u8p, ctypes.c_int32, i32p,
             ]
         except AttributeError:
             pass

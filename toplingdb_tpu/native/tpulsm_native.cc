@@ -971,15 +971,23 @@ namespace {
 
 typedef int (*snappy_len_fn)(const char*, size_t, size_t*);
 typedef int (*snappy_unc_fn)(const char*, size_t, char*, size_t*);
+typedef size_t (*snappy_maxlen_fn)(size_t);
+typedef int (*snappy_cmp_fn)(const char*, size_t, char*, size_t*);
 typedef size_t (*zstd_sizefn)(const void*, size_t);
 typedef size_t (*zstd_dec_fn)(void*, size_t, const void*, size_t);
+typedef size_t (*zstd_cmp_fn)(void*, size_t, const void*, size_t, int);
+typedef size_t (*zstd_bound_fn)(size_t);
 typedef unsigned (*zstd_err_fn)(size_t);
 
 struct Codecs {
   snappy_len_fn snappy_len = nullptr;
   snappy_unc_fn snappy_unc = nullptr;
+  snappy_maxlen_fn snappy_maxlen = nullptr;
+  snappy_cmp_fn snappy_cmp = nullptr;
   zstd_sizefn zstd_size = nullptr;
   zstd_dec_fn zstd_dec = nullptr;
+  zstd_cmp_fn zstd_cmp = nullptr;
+  zstd_bound_fn zstd_bound = nullptr;
   zstd_err_fn zstd_err = nullptr;
 };
 
@@ -993,12 +1001,17 @@ const Codecs& codecs() {
       r.snappy_len =
           (snappy_len_fn)dlsym(s, "snappy_uncompressed_length");
       r.snappy_unc = (snappy_unc_fn)dlsym(s, "snappy_uncompress");
+      r.snappy_maxlen =
+          (snappy_maxlen_fn)dlsym(s, "snappy_max_compressed_length");
+      r.snappy_cmp = (snappy_cmp_fn)dlsym(s, "snappy_compress");
     }
     void* z = dlopen("libzstd.so.1", RTLD_NOW);
     if (!z) z = dlopen("libzstd.so", RTLD_NOW);
     if (z) {
       r.zstd_size = (zstd_sizefn)dlsym(z, "ZSTD_getFrameContentSize");
       r.zstd_dec = (zstd_dec_fn)dlsym(z, "ZSTD_decompress");
+      r.zstd_cmp = (zstd_cmp_fn)dlsym(z, "ZSTD_compress");
+      r.zstd_bound = (zstd_bound_fn)dlsym(z, "ZSTD_compressBound");
       r.zstd_err = (zstd_err_fn)dlsym(z, "ZSTD_isError");
     }
 #endif
@@ -1120,6 +1133,238 @@ int64_t tpulsm_inflate_blocks(const uint8_t* file_buf, int64_t file_len,
   if (e == 1) return -1;
   if (e) return -3;
   return used;
+}
+
+// ---------------------------------------------------------------------------
+// In-block point seek: restart binary search + linear scan entirely in C —
+// the BlockIter.seek() hot path of every Get (reference
+// Block::Iter::Seek, table/block_based/block_iter.h). Keys are INTERNAL
+// keys under the standard comparator (user bytes asc, then seq desc).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline int ikey_compare(const uint8_t* a, int32_t al, const uint8_t* b,
+                        int32_t bl) {
+  int32_t au = al - 8, bu = bl - 8;
+  if (au < 0 || bu < 0) {  // not internal keys; caller gated wrong
+    int m = al < bl ? al : bl;
+    int c = std::memcmp(a, b, (size_t)m);
+    if (c) return c;
+    return al < bl ? -1 : (al > bl ? 1 : 0);
+  }
+  int m = au < bu ? au : bu;
+  int c = std::memcmp(a, b, (size_t)m);
+  if (c) return c;
+  if (au != bu) return au < bu ? -1 : 1;
+  uint64_t pa = 0, pb = 0;
+  for (int i = 0; i < 8; i++) {
+    pa |= (uint64_t)a[au + i] << (8 * i);
+    pb |= (uint64_t)b[bu + i] << (8 * i);
+  }
+  if (pa != pb) return pa > pb ? -1 : 1;  // higher seqno sorts FIRST
+  return 0;
+}
+
+}  // namespace
+
+// Position at the first entry with key >= target. Outputs BlockIter's
+// cursor state into out[6]: {cur, next_off, val_off, val_len, key_len,
+// restart_idx}; the full key bytes land in key_out (<= key_cap).
+// Returns 1 = found, 0 = every key < target (invalid), -2 = key_cap too
+// small, -1 = corrupt/unsupported (caller reruns the Python path, which
+// raises the proper error).
+int32_t tpulsm_block_seek(const uint8_t* data, int64_t len,
+                          const uint8_t* target, int32_t tlen,
+                          uint8_t* key_out, int32_t key_cap,
+                          int32_t* out) {
+  if (len < 4) return -1;
+  uint32_t nr;
+  std::memcpy(&nr, data + len - 4, 4);
+  if (nr == 0) return -1;
+  int64_t restart_off = len - 4 - 4 * (int64_t)nr;
+  if (restart_off < 0) return -1;
+  const int64_t limit = restart_off;
+  auto restart_point = [&](uint32_t i) -> uint32_t {
+    uint32_t v;
+    std::memcpy(&v, data + restart_off + 4 * (int64_t)i, 4);
+    return v;
+  };
+  // Decode the FULL key at a restart (shared == 0 there).
+  auto restart_key = [&](uint32_t r, const uint8_t** k, uint32_t* kl,
+                         const uint8_t** next) -> bool {
+    const uint8_t* p = data + restart_point(r);
+    const uint8_t* end = data + limit;
+    uint32_t shared, non_shared, vlen;
+    p = get_varint32(p, end, &shared);
+    if (!p) return false;
+    p = get_varint32(p, end, &non_shared);
+    if (!p) return false;
+    p = get_varint32(p, end, &vlen);
+    if (!p || shared != 0 || p + non_shared + vlen > end) return false;
+    *k = p;
+    *kl = non_shared;
+    *next = p + non_shared + vlen;
+    return true;
+  };
+  // Binary search: last restart whose key < target.
+  uint32_t lo = 0, hi = nr - 1;
+  while (lo < hi) {
+    uint32_t mid = (lo + hi + 1) / 2;
+    const uint8_t* k;
+    uint32_t kl;
+    const uint8_t* nxt;
+    if (!restart_key(mid, &k, &kl, &nxt)) return -1;
+    if (ikey_compare(k, (int32_t)kl, target, tlen) < 0) {
+      lo = mid;
+    } else {
+      hi = mid - 1;
+    }
+  }
+  // Linear scan from restart lo, reconstructing keys in key_out.
+  int64_t off = restart_point(lo);
+  int32_t cur_len = 0;
+  const uint8_t* end = data + limit;
+  while (off < limit) {
+    const uint8_t* p = data + off;
+    uint32_t shared, non_shared, vlen;
+    p = get_varint32(p, end, &shared);
+    if (!p) return -1;
+    p = get_varint32(p, end, &non_shared);
+    if (!p) return -1;
+    p = get_varint32(p, end, &vlen);
+    if (!p || p + non_shared + vlen > end) return -1;
+    if ((int32_t)shared > cur_len) return -1;
+    if ((int64_t)shared + non_shared > key_cap) return -2;
+    std::memcpy(key_out + shared, p, non_shared);
+    cur_len = (int32_t)(shared + non_shared);
+    int64_t val_off = (p - data) + non_shared;
+    int64_t next_off = val_off + vlen;
+    if (ikey_compare(key_out, cur_len, target, tlen) >= 0) {
+      out[0] = (int32_t)off;
+      out[1] = (int32_t)next_off;
+      out[2] = (int32_t)val_off;
+      out[3] = (int32_t)vlen;
+      out[4] = cur_len;
+      out[5] = (int32_t)lo;
+      return 1;
+    }
+    off = next_off;
+  }
+  return 0;
+}
+
+// Compressed variant of tpulsm_build_data_section: each block builds RAW
+// into scratch, compresses with `ctype` (1=snappy, 7=zstd at `level`;
+// kept only when < raw - raw/8, the fmt.compress_for_block rule — else
+// stored raw with type 0), then frames with the type byte + masked crc.
+// block_raw_lens[b] = uncompressed payload length (props accounting).
+// Extra return codes: -9 codec unavailable (caller: Python write path).
+int64_t tpulsm_build_data_section_c(
+    const uint8_t* key_buf, const int32_t* key_offs, const int32_t* key_lens,
+    const uint8_t* val_buf, const int32_t* val_offs, const int32_t* val_lens,
+    const int64_t* trailer_override,
+    const int32_t* order, int64_t start, int64_t limit,
+    int64_t block_size_limit, int64_t restart_interval,
+    int32_t ctype, int32_t level,
+    int64_t base_file_size, int64_t max_file_size,
+    int64_t* block_counts, int64_t* block_payload_lens,
+    int64_t* block_raw_lens, int64_t max_blocks,
+    uint8_t* out, int64_t out_cap, int64_t* out_len) {
+  const Codecs& c = codecs();
+  if (ctype == 1 && (!c.snappy_maxlen || !c.snappy_cmp)) return -9;
+  if (ctype == 7 && (!c.zstd_cmp || !c.zstd_bound || !c.zstd_err)) return -9;
+  if (ctype != 1 && ctype != 7) return -9;
+  // level semantics must MATCH the Python path byte-for-byte: the caller
+  // passes INT32_MIN for "unset" (Python None -> zstd default 3); real
+  // levels — including zstd's valid negative fast levels and 0 — pass
+  // through unchanged.
+  if (level == INT32_MIN) level = 3;
+  std::vector<uint8_t> raw;
+  try {
+    raw.resize((size_t)block_size_limit * 2 + 8192);
+  } catch (...) {
+    return -2;
+  }
+  int64_t pos = start;
+  int64_t used = 0;
+  int64_t nb = 0;
+  while (pos < limit) {
+    if (nb > 0) {
+      if (base_file_size + used >= max_file_size) break;
+      if (nb >= max_blocks) break;
+    }
+    int64_t raw_len = 0;
+    int64_t rc;
+    for (;;) {
+      rc = tpulsm_build_block(
+          key_buf, key_offs, key_lens, val_buf, val_offs, val_lens,
+          trailer_override, order, pos, limit,
+          block_size_limit, restart_interval,
+          raw.data(), (int64_t)raw.size(), &raw_len);
+      if (rc == -2) {
+        try {
+          raw.resize(raw.size() * 2);
+        } catch (...) {
+          rc = -2;
+          break;
+        }
+        continue;
+      }
+      break;
+    }
+    if (rc <= 0) {
+      if (nb > 0) break;
+      return rc;
+    }
+    // Compress into out+used; keep only a >=12.5% win.
+    size_t bound = ctype == 1 ? c.snappy_maxlen((size_t)raw_len)
+                              : c.zstd_bound((size_t)raw_len);
+    if (used + (int64_t)bound + 5 > out_cap) {
+      // The compress scratch must fit or the store-raw/store-compressed
+      // decision would depend on buffer state (byte-nondeterminism);
+      // end the run (or ask the caller to regrow on the first block).
+      if (nb > 0) break;
+      return -2;
+    }
+    int64_t payload_len;
+    uint8_t tbyte;
+    bool ok = true;
+    size_t clen = bound;
+    if (ok && ctype == 1) {
+      ok = c.snappy_cmp((const char*)raw.data(), (size_t)raw_len,
+                        (char*)(out + used), &clen) == 0;
+    } else if (ok) {
+      clen = c.zstd_cmp(out + used, bound, raw.data(), (size_t)raw_len,
+                        level);
+      ok = !c.zstd_err(clen);
+    }
+    if (ok && (int64_t)clen < raw_len - raw_len / 8) {
+      payload_len = (int64_t)clen;
+      tbyte = (uint8_t)ctype;
+    } else {
+      if (used + raw_len + 5 > out_cap) {
+        if (nb > 0) break;
+        return -2;
+      }
+      std::memcpy(out + used, raw.data(), (size_t)raw_len);
+      payload_len = raw_len;
+      tbyte = 0;
+    }
+    out[used + payload_len] = tbyte;
+    uint32_t crc =
+        tpulsm_crc32c_extend(0, out + used, (size_t)(payload_len + 1));
+    uint32_t masked = ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+    std::memcpy(out + used + payload_len + 1, &masked, 4);
+    block_counts[nb] = rc;
+    block_payload_lens[nb] = payload_len;
+    block_raw_lens[nb] = raw_len;
+    nb++;
+    used += payload_len + 5;
+    pos += rc;
+  }
+  *out_len = used;
+  return nb;
 }
 
 // Insert every counted record of a WriteBatch WIRE IMAGE (db/write_batch.py

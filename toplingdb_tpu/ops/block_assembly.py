@@ -406,7 +406,7 @@ def run_block_assembly(env, dbname, icmp, kv, shard, cover, snapshots,
             off += pl
             crc = crc32c.mask(crc32c.extend(0, raw + b"\x00"))
             section += raw + b"\x00" + crc.to_bytes(4, "little")
-            blocks.append((pl, boundary_ikey(int(bfirst[b])),
+            blocks.append((pl, pl, boundary_ikey(int(bfirst[b])),
                            boundary_ikey(int(blast[b])), int(bcnt[b])))
             if len(section) >= 8 << 20:
                 sst.add_framed_section(bytes(section), blocks)

@@ -354,15 +354,16 @@ class _ColumnarSST:
             self._account_block(h, raw_len, first, last, n)
 
     def add_framed_section(self, section: bytes, blocks) -> None:
-        """Bulk form of add_block: `section` is a pre-framed run of
-        uncompressed blocks (payload + type byte + crc trailer, exactly what
-        write_block emits) and `blocks` yields
-        (payload_len, first_key, last_key, n_entries) per block in file
-        order. One append for the whole run."""
+        """Bulk form of add_block: `section` is a pre-framed run of blocks
+        (payload + type byte + crc trailer, exactly what write_block emits;
+        payloads may be compressed) and `blocks` yields
+        (payload_len, raw_len, first_key, last_key, n_entries) per block in
+        file order. One append for the whole run."""
         offset = self.w.file_size()
-        for payload_len, block_first, block_last, n_entries in blocks:
+        for payload_len, raw_len, block_first, block_last, n_entries \
+                in blocks:
             self._account_block(fmt.BlockHandle(offset, payload_len),
-                                payload_len, block_first, block_last,
+                                raw_len, block_first, block_last,
                                 n_entries)
             offset += payload_len + fmt.BLOCK_TRAILER_SIZE
         self.w.append(section)
@@ -565,10 +566,20 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
     # Bulk framing: emit a whole RUN of framed blocks per native call
     # (payload + type byte + crc trailer, byte-identical to write_block)
     # instead of one block per call — the per-block Python loop dominates
-    # the write side at bench scale. Only for uncompressed output; a stale
-    # .so without the symbol degrades to the per-block path.
-    use_section = (options.compression == fmt.NO_COMPRESSION
-                   and hasattr(lib, "tpulsm_build_data_section"))
+    # the write side at bench scale. Uncompressed output and snappy/zstd
+    # (dict-less) both run natively; a stale .so degrades per-block.
+    copts0 = getattr(options, "compression_opts", None)
+    sec_ctype = 0
+    if options.compression == fmt.NO_COMPRESSION:
+        use_section = hasattr(lib, "tpulsm_build_data_section")
+    elif (options.compression in (fmt.SNAPPY_COMPRESSION,
+                                  fmt.ZSTD_COMPRESSION)
+          and not (copts0 is not None and copts0.max_dict_bytes > 0)
+          and hasattr(lib, "tpulsm_build_data_section_c")):
+        use_section = True
+        sec_ctype = options.compression
+    else:
+        use_section = False
     if use_section and kv.n:
         # Upper bound over ALL entries (the survivor set streams in).
         sec_bytes = int(kv.key_lens.sum()) + int(kv.val_lens.sum())
@@ -582,11 +593,15 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
         max_blocks = sec_cap // max(1, options.block_size) + 1024
         sec_counts = np.empty(max_blocks, dtype=np.int64)
         sec_plens = np.empty(max_blocks, dtype=np.int64)
+        sec_rawlens = np.empty(max_blocks, dtype=np.int64)
         sec_len = np.zeros(1, dtype=np.int64)
         p_sec = native.np_u8p(sec_buf)
         p_counts = native.np_i64p(sec_counts)
         p_plens = native.np_i64p(sec_plens)
+        p_rawlens = native.np_i64p(sec_rawlens)
         p_seclen = native.np_i64p(sec_len)
+        sec_level = (copts0.level if copts0 is not None
+                     and copts0.level is not None else -(2 ** 31))
 
     pool = None
     if (options.compression != fmt.NO_COMPRESSION
@@ -647,14 +662,32 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                 budget = base_size + _SECTION_RUN_BYTES
                 if can_cut and max_output_file_size < budget:
                     budget = max_output_file_size
-                rc = lib.tpulsm_build_data_section(
-                    p_kbuf, p_koff, p_klen, p_vbuf, p_voff, p_vlen, p_tro,
-                    p_order, start, limit,
-                    options.block_size, options.restart_interval,
-                    base_size, budget,
-                    p_counts, p_plens, max_blocks,
-                    p_sec, sec_cap, p_seclen,
-                )
+                if sec_ctype:
+                    rc = lib.tpulsm_build_data_section_c(
+                        p_kbuf, p_koff, p_klen, p_vbuf, p_voff, p_vlen,
+                        p_tro, p_order, start, limit,
+                        options.block_size, options.restart_interval,
+                        sec_ctype, sec_level,
+                        base_size, budget,
+                        p_counts, p_plens, p_rawlens, max_blocks,
+                        p_sec, sec_cap, p_seclen,
+                    )
+                    if rc == -9:
+                        # codec .so unavailable: per-block Python framing
+                        use_section = False
+                        sec_ctype = 0
+                        continue
+                else:
+                    rc = lib.tpulsm_build_data_section(
+                        p_kbuf, p_koff, p_klen, p_vbuf, p_voff, p_vlen,
+                        p_tro, p_order, start, limit,
+                        options.block_size, options.restart_interval,
+                        base_size, budget,
+                        p_counts, p_plens, max_blocks,
+                        p_sec, sec_cap, p_seclen,
+                    )
+                    sec_rawlens[:max(0, int(rc))] = \
+                        sec_plens[:max(0, int(rc))] if rc > 0 else 0
                 if rc == -2:
                     sec_cap *= 4
                     sec_buf = np.empty(sec_cap, dtype=np.uint8)
@@ -686,7 +719,8 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                 bpos = start
                 for b in range(nb):
                     cnt = int(sec_counts[b])
-                    blocks.append((int(sec_plens[b]), entry_key(bpos),
+                    blocks.append((int(sec_plens[b]), int(sec_rawlens[b]),
+                                   entry_key(bpos),
                                    entry_key(bpos + cnt - 1), cnt))
                     bpos += cnt
                 cur.add_framed_section(section, blocks)

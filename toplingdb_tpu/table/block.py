@@ -73,11 +73,15 @@ class BlockIter:
     """Iterator over a finished block. Comparator `cmp(a, b) -> int` orders
     the keys stored in the block (internal-key order for data/index blocks)."""
 
-    def __init__(self, contents: bytes, cmp):
+    def __init__(self, contents: bytes, cmp, native_icmp_seek: bool = False):
+        """`native_icmp_seek`: keys are internal keys under the STANDARD
+        comparator (bytewise user keys, seq desc) — seek() may run the
+        native C scan (one ctypes call instead of ~25 Python decodes)."""
         if len(contents) < 4:
             raise Corruption("block too small")
         self._data = contents
         self._cmp = cmp
+        self._native_seek = native_icmp_seek
         self._num_restarts = coding.decode_fixed32(contents, len(contents) - 4)
         if self._num_restarts == 0:
             raise Corruption("block has no restarts")
@@ -146,6 +150,8 @@ class BlockIter:
 
     def seek(self, target: bytes) -> None:
         """Position at first entry with key >= target."""
+        if self._native_seek and self._try_native_seek(target):
+            return
         # Binary search restarts: find last restart whose key < target.
         lo, hi = 0, self._num_restarts - 1
         while lo < hi:
@@ -168,6 +174,42 @@ class BlockIter:
                 return
             off = nxt
         self._cur = self._limit  # all keys < target
+
+    _seek_out = None  # lazily-built per-iterator ctypes scratch
+
+    def _try_native_seek(self, target: bytes) -> bool:
+        """One-call native seek; False = run the Python path (no lib, or
+        the native scan refused — it re-raises proper errors there)."""
+        import ctypes
+
+        from toplingdb_tpu import native
+
+        lib = native.lib()
+        if lib is None or not hasattr(lib, "tpulsm_block_seek"):
+            self._native_seek = False
+            return False
+        if self._seek_out is None:
+            self._seek_out = (ctypes.c_int32 * 6)()
+            self._seek_key = ctypes.create_string_buffer(4096)
+        rc = lib.tpulsm_block_seek(
+            self._data, len(self._data), target, len(target),
+            ctypes.cast(self._seek_key,
+                        ctypes.POINTER(ctypes.c_ubyte)), 4096,
+            self._seek_out,
+        )
+        if rc < 0:
+            return False  # oversized key / corrupt: Python path decides
+        if rc == 0:
+            self._cur = self._limit  # all keys < target
+            return True
+        o = self._seek_out
+        self._cur = o[0]
+        self._next_off = o[1]
+        self._val_off = o[2]
+        self._val_len = o[3]
+        self._key = self._seek_key[: o[4]]  # slice copies only the key
+        self._restart_idx = o[5]
+        return True
 
     def seek_for_prev(self, target: bytes) -> None:
         """Position at last entry with key <= target."""

@@ -103,6 +103,11 @@ class TableReader:
         # partition blocks load lazily through the block cache (reference
         # partitioned index readers, table/block_based/partitioned_index_*).
         self._partitioned_index = self.properties.index_type == "two_level"
+        # Data/index block seeks may run the native C scan when raw
+        # bytewise order == comparator order (bytewise and u64ts — the ts
+        # encoding bakes its order into the bytes).
+        self._native_seek = icmp.user_comparator.name() in (
+            "tpulsm.BytewiseComparator", "tpulsm.BytewiseComparator.u64ts")
 
     # ------------------------------------------------------------------
 
@@ -174,7 +179,8 @@ class TableReader:
         partition-hopping depending on the file's index_type."""
         if self._partitioned_index:
             return _PartitionedIndexIter(self)
-        return BlockIter(self._index_data, self._icmp.compare)
+        return BlockIter(self._index_data, self._icmp.compare,
+                         native_icmp_seek=self._native_seek)
 
     def range_del_entries(self) -> list[tuple[bytes, bytes]]:
         """Raw (begin_internal_key, end_user_key) tombstones in this file
@@ -306,7 +312,8 @@ class TableIterator:
             return
         handle = fmt.BlockHandle.decode_exact(self._idx.value())
         self._data = BlockIter(
-            self._r._read_data_block(handle, pf=self._pf), self._cmp)
+            self._r._read_data_block(handle, pf=self._pf), self._cmp,
+            native_icmp_seek=self._r._native_seek)
 
     def valid(self) -> bool:
         return self._data is not None and self._data.valid()
