@@ -117,3 +117,42 @@ def test_perf_context_breadth():
     ctx.reset()
     d = ctx.to_dict()
     assert len(d) >= 50 and all(v == 0 for v in d.values())
+
+
+def test_perf_context_populates(tmp_path):
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils import statistics as st
+
+    with DB.open(str(tmp_path / "db"), Options(create_if_missing=True)) as db:
+        for i in range(500):
+            db.put(b"k%05d" % i, b"v" * 10)
+        db.flush()
+        ctx = st.perf_context()
+        ctx.reset()
+        for i in range(0, 500, 9):
+            db.get(b"k%05d" % i)
+        assert ctx.get_from_memtable_count > 0
+        assert ctx.block_read_count > 0
+        assert ctx.block_read_byte > 0
+        assert ctx.bloom_sst_hit_count > 0
+        db.get(b"k0025zz")  # inside file key range, absent
+        assert ctx.bloom_sst_miss_count >= 1
+
+
+def test_multiget_stats(tmp_path):
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils import statistics as st
+
+    stats = st.Statistics()
+    with DB.open(str(tmp_path / "db"),
+                 Options(create_if_missing=True, statistics=stats)) as db:
+        for i in range(100):
+            db.put(b"k%03d" % i, b"val-%03d" % i)
+        res = db.multi_get([b"k001", b"k050", b"nope"])
+        assert res[0] == b"val-001" and res[2] is None
+    assert stats.get_ticker_count(st.NUMBER_MULTIGET_CALLS) == 1
+    assert stats.get_ticker_count(st.NUMBER_MULTIGET_KEYS_READ) == 3
+    assert stats.get_ticker_count(st.NUMBER_MULTIGET_BYTES_READ) == 14
+    assert stats.get_histogram(st.DB_MULTIGET_MICROS).count == 1

@@ -1089,6 +1089,10 @@ class DB:
     def _probe_memtable(self, mem, key: bytes, snap_seq: int,
                         ctx: GetContext) -> bool:
         """One memtable source; returns False when the lookup is complete."""
+        from toplingdb_tpu.utils import statistics as st
+
+        if st.perf_level:
+            st.perf_context().get_from_memtable_count += 1
         ctx.add_tombstone_seq(mem.covering_tombstone_seq(key, snap_seq))
         for seq, t, val in mem.entries_for_key(key, snap_seq):
             if not ctx.save_value(seq, t, val):
@@ -1100,16 +1104,23 @@ class DB:
         """One SST source; `tombs` is the file's parsed RangeTombstone list;
         `it` is a reusable iterator for this reader (created on demand).
         Returns (continue?, iterator)."""
+        from toplingdb_tpu.utils import statistics as st
+
         ucmp = self.icmp.user_comparator
         for t in tombs:
             if ucmp.compare(t.begin, key) <= 0 and ucmp.compare(key, t.end) < 0:
                 ctx.add_tombstone_seq(t.seq)
+        has_filter = (getattr(reader, "_filter_data", None) is not None
+                      or getattr(reader, "_filter_top", None) is not None)
         if not reader.key_may_match(key):
             if self.stats is not None:
-                from toplingdb_tpu.utils import statistics as st
-
                 self.stats.record_tick(st.BLOOM_USEFUL)
+            if st.perf_level:
+                st.perf_context().bloom_sst_miss_count += 1
             return True, it
+        if has_filter and st.perf_level:
+            # Only a CONSULTED filter counts (fail-open paths don't).
+            st.perf_context().bloom_sst_hit_count += 1
         if getattr(reader, "has_hash_index", False):
             # O(1) bucket probe (single_fast hash index): lands on the
             # newest version; the loop below skips seqs above the snapshot.
@@ -1390,6 +1401,21 @@ class DB:
         instead of per-key)."""
         self._check_open()
         self._check_read_ts(opts)
+        t_mg = time.perf_counter() if self.stats is not None else 0.0
+        res = self._multi_get_impl(keys, opts, cf)
+        if self.stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self.stats.record_tick(st.NUMBER_MULTIGET_CALLS)
+            self.stats.record_tick(st.NUMBER_MULTIGET_KEYS_READ, len(keys))
+            self.stats.record_tick(
+                st.NUMBER_MULTIGET_BYTES_READ,
+                sum(len(v) for v in res if v is not None))
+            self.stats.record_in_histogram(
+                st.DB_MULTIGET_MICROS, (time.perf_counter() - t_mg) * 1e6)
+        return res
+
+    def _multi_get_impl(self, keys, opts, cf):
         if self.icmp.user_comparator.timestamp_size:
             # ONE iterator for the whole batch (single view/mutex), seeked
             # across the keys in sorted order.

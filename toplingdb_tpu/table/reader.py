@@ -135,14 +135,14 @@ class TableReader:
             return False  # past every partition's range: definitely absent
         handle = fmt.BlockHandle.decode_exact(it.value())
         if self._cache is not None:
-            fdata = self._read_data_block(handle)
+            fdata = self._read_data_block(handle, kind="filter")
         else:
             # No shared block cache: memoize per reader (bounded by the
             # partition count) — a probe must stay cheaper than the block
             # read it exists to avoid.
             fdata = self._filter_part_memo.get(handle.offset)
             if fdata is None:
-                fdata = self._read_data_block(handle)
+                fdata = self._read_data_block(handle, kind="filter")
                 self._filter_part_memo[handle.offset] = fdata
         return self._filter_policy.key_may_match(user_key, fdata)
 
@@ -155,21 +155,45 @@ class TableReader:
             return True
         return self._filter_policy.key_may_match(prefix, self._filter_data)
 
-    def _read_data_block(self, handle: fmt.BlockHandle, pf=None) -> bytes:
+    def _read_data_block(self, handle: fmt.BlockHandle, pf=None,
+                         kind: str = "") -> bytes:
         """`pf`: optional FilePrefetchBuffer (per-iterator readahead;
-        reference FilePrefetchBuffer, file/file_prefetch_buffer.h:63)."""
+        reference FilePrefetchBuffer, file/file_prefetch_buffer.h:63).
+        `kind`: "filter"/"index" routes PerfContext cache counters to the
+        typed fields; "" counts as a data block."""
+        from toplingdb_tpu.utils import statistics as st
+
         src = pf if pf is not None else self._f
         if self._cache is not None:
             ckey = self._cache_prefix + handle.encode()
             data = self._cache.lookup(ckey)
             if data is not None:
+                if st.perf_level:
+                    ctx = st.perf_context()
+                    if kind == "filter":
+                        ctx.block_cache_filter_hit_count += 1
+                    elif kind == "index":
+                        ctx.block_cache_index_hit_count += 1
+                    else:
+                        ctx.block_cache_hit_count += 1
                 return data
             data = fmt.read_block(src, handle, self.opts.verify_checksums,
                                   self._compression_dict)
             self._cache.insert(ckey, data, len(data))
+            if st.perf_level:
+                ctx = st.perf_context()
+                if not kind:
+                    ctx.block_cache_miss_count += 1
+                ctx.block_read_count += 1
+                ctx.block_read_byte += len(data)
             return data
-        return fmt.read_block(src, handle, self.opts.verify_checksums,
+        data = fmt.read_block(src, handle, self.opts.verify_checksums,
                               self._compression_dict)
+        if st.perf_level:
+            ctx = st.perf_context()
+            ctx.block_read_count += 1
+            ctx.block_read_byte += len(data)
+        return data
 
     def new_iterator(self) -> "TableIterator":
         return TableIterator(self)
@@ -231,7 +255,9 @@ class _PartitionedIndexIter:
             self._sub = None
             return
         h = fmt.BlockHandle.decode_exact(self._top.value())
-        self._sub = BlockIter(self._r._read_data_block(h), self._cmp)
+        self._sub = BlockIter(self._r._read_data_block(h, kind="index"),
+                              self._cmp,
+                              native_icmp_seek=self._r._native_seek)
 
     def valid(self) -> bool:
         return self._sub is not None and self._sub.valid()
