@@ -156,3 +156,33 @@ def test_multiget_stats(tmp_path):
     assert stats.get_ticker_count(st.NUMBER_MULTIGET_KEYS_READ) == 3
     assert stats.get_ticker_count(st.NUMBER_MULTIGET_BYTES_READ) == 14
     assert stats.get_histogram(st.DB_MULTIGET_MICROS).count == 1
+
+
+def test_prometheus_metrics_endpoint(tmp_path):
+    """GET /metrics serves Prometheus text over every registered DB's
+    statistics (the rockside WebView/Prometheus role)."""
+    import urllib.request
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils import statistics as st
+    from toplingdb_tpu.utils.config import SidePluginRepo
+
+    stats = st.Statistics()
+    db = DB.open(str(tmp_path / "db"),
+                 Options(create_if_missing=True, statistics=stats))
+    try:
+        for i in range(50):
+            db.put(b"k%02d" % i, b"v")
+        repo = SidePluginRepo()
+        repo._dbs["main"] = db  # register an externally-opened DB
+        port = repo.start_http()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        repo.stop_http()
+        assert "# TYPE tpulsm_number_keys_written counter" in body
+        assert 'tpulsm_number_keys_written{db="main"} 50' in body
+        assert "tpulsm_db_write_micros_count" in body
+        assert 'quantile="0.99"' in body
+    finally:
+        db.close()

@@ -317,8 +317,10 @@ class SidePluginRepo:
     # -- HTTP introspection --------------------------------------------
 
     def start_http(self, port: int = 0) -> int:
-        """Serves /dbs, /stats/<name>, /levels/<name>, /config/<name>.
-        Returns the bound port."""
+        """Serves /dbs, /stats/<name>, /levels/<name>, /config/<name>, and
+        /metrics (Prometheus text format over every registered DB's
+        Statistics — the rockside Prometheus role). Returns the bound
+        port."""
         repo = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -335,6 +337,23 @@ class SidePluginRepo:
 
             def do_GET(self):
                 parts = [p for p in self.path.split("/") if p]
+                if parts and parts[0] == "metrics":
+                    try:
+                        out = []
+                        for name, db in sorted(repo._dbs.items()):
+                            if db.stats is not None:
+                                out.append(db.stats.to_prometheus(
+                                    labels=f'db="{name}"'))
+                        data = "".join(out).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/plain; version=0.0.4")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                    except Exception as e:
+                        self._send_json(500, {"error": repr(e)})
+                    return
                 try:
                     body = repo._route(parts)
                     code = 200 if body is not None else 404
