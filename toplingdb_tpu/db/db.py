@@ -598,6 +598,22 @@ class DB:
         if batch.is_empty():
             return
         self._check_open()  # fail fast before any stall sleep
+        if self.stats is not None:
+            import time as _t
+
+            from toplingdb_tpu.utils import statistics as st
+
+            t0 = _t.perf_counter()
+            try:
+                self._write_impl(batch, opts, on_sequenced)
+            finally:
+                self.stats.record_in_histogram(
+                    st.DB_WRITE_MICROS, (_t.perf_counter() - t0) * 1e6)
+            return
+        self._write_impl(batch, opts, on_sequenced)
+
+    def _write_impl(self, batch: WriteBatch, opts: WriteOptions,
+                    on_sequenced) -> None:
         if self.icmp.user_comparator.timestamp_size:
             self._validate_ts_batch(batch)
         self._maybe_stall_writes()
