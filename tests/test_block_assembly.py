@@ -63,15 +63,15 @@ def _build_inputs(env, dbdir, rng, topts, n_files=3, n_per=350,
     return metas, seq
 
 
-@pytest.mark.parametrize("seed,block_size,restart,tombs,nsnaps", [
-    (1, 512, 16, False, 0),
-    (2, 512, 4, False, 2),
-    (3, 4096, 16, False, 0),
-    (4, 1024, 16, True, 3),
-    (5, 256, 8, True, 0),
+@pytest.mark.parametrize("seed,block_size,restart,tombs,nsnaps,bloom", [
+    (1, 512, 16, False, 0, False),
+    (2, 512, 4, False, 2, False),
+    (3, 4096, 16, False, 0, True),
+    (4, 1024, 16, True, 3, False),
+    (5, 256, 8, True, 0, True),
 ])
 def test_block_assembly_byte_parity(tmp_path, monkeypatch, seed, block_size,
-                                    restart, tombs, nsnaps):
+                                    restart, tombs, nsnaps, bloom):
     import os
 
     from toplingdb_tpu.compaction.compaction_job import run_compaction_to_tables
@@ -88,8 +88,11 @@ def test_block_assembly_byte_parity(tmp_path, monkeypatch, seed, block_size,
     env = default_env()
     dbdir = str(tmp_path)
     rng = random.Random(seed)
-    topts = TableOptions(block_size=block_size, restart_interval=restart,
-                         filter_policy=None)
+    from toplingdb_tpu.table.filter import BloomFilterPolicy
+
+    topts = TableOptions(
+        block_size=block_size, restart_interval=restart,
+        filter_policy=BloomFilterPolicy() if bloom else None)
     metas, seq_top = _build_inputs(env, dbdir, rng, topts,
                                    with_tombstones=tombs)
     tc = TableCache(env, dbdir, ICMP, topts)

@@ -22,7 +22,9 @@ asserts whole-file byte equality against the CPU path.
 
 Scope (falls back to the packed-order download path otherwise): uniform
 key length < 120B, values < 128B (single-byte varints), NO_COMPRESSION,
-no filter block, single output file, no complex groups / blob refs.
+whole-key (or no) filters, single output file, no complex groups /
+blob refs. A survivor bitmap (1 bit/row) rides down so the host builds
+the bloom byte-identically without the full order download.
 Transfers: values ride UP and finished blocks ride DOWN, so this path
 pays ~2x the bytes of the order-download path — it wins where the host
 CPU, not the link, is the bottleneck (TPULSM_DEVICE_BLOCKS=1 opts in;
@@ -63,7 +65,7 @@ def _assemble_blocks_impl(ukb, plens, sfx, pkb, starts, min_his, min_los,
     """Sort + GC + FULL block assembly in one device program.
 
     Returns (out u8[ubp], meta i32[10], bcounts i32[nbp], bpayload i32[nbp],
-    bfirst i32[nbp], blast i32[nbp]):
+    bfirst i32[nbp], blast i32[nbp], surv_bitmap u8[ceil(p/8)]):
       out      concatenated block payloads (no trailers)
       meta     [nb, m, total_payload, has_complex, num_deletions,
                 raw_value, smin_hi, smin_lo, smax_hi, smax_lo]
@@ -236,6 +238,20 @@ def _assemble_blocks_impl(ukb, plens, sfx, pkb, starts, min_his, min_los,
         bvalid,
         i32(sorder[blast]) | jnp.where(szero[blast], zbit, 0), -1,
     )
+    # Survivor bitmap over ORIGINAL local rows (1 bit/row): the host
+    # derives `sel` from it to build the bloom filter byte-identically to
+    # the CPU path (and blob refs) without downloading the full order.
+    surv = jnp.zeros(p, dtype=jnp.int32).at[sorder].max(
+        svalid.astype(jnp.int32))
+    sbytes = (p + 7) // 8
+    pad_rows = (-p) % 8
+    if pad_rows:
+        surv = jnp.pad(surv, (0, pad_rows))
+    bits = surv.reshape(sbytes, 8)
+    weights = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32))[None, :]
+    surv_bitmap = jnp.sum(
+        bits.astype(jnp.uint32) * weights, axis=1).astype(jnp.uint8)
+
     num_del = jnp.sum(
         (svalid & ((svt == int(ValueType.DELETION))
                    | (svt == int(ValueType.SINGLE_DELETION)))
@@ -256,7 +272,7 @@ def _assemble_blocks_impl(ukb, plens, sfx, pkb, starts, min_his, min_los,
         num_del, raw_value,
         i32(smin_hi), i32(smin_lo), i32(smax_hi), i32(smax_lo),
     ])
-    return out, meta, bcnt, bpayload, bfirst, blast_r
+    return out, meta, bcnt, bpayload, bfirst, blast_r, surv_bitmap
 
 
 def assembly_supported(table_options, kv, shards, any_complex,
@@ -275,7 +291,11 @@ def assembly_supported(table_options, kv, shards, any_complex,
         return False
     if table_options.compression != fmt.NO_COMPRESSION:
         return False
-    if table_options.filter_policy is not None:
+    if table_options.filter_policy is not None and (
+            not table_options.whole_key_filtering
+            or getattr(table_options, "prefix_extractor", None) is not None):
+        # Prefix filter keys only exist on the per-entry path; building a
+        # whole-key-only bloom here would break byte parity.
         return False
     if not kv.n:
         return False
@@ -303,7 +323,8 @@ def run_block_assembly(env, dbname, icmp, kv, shard, cover, snapshots,
     """Drive the device block-assembly program for a single-shard job and
     write the output SST (host: block trailers + index/meta/footer).
     Returns the same (fnum, path, props, smallest, largest, sel) tuples as
-    write_tables_columnar (sel=None: no per-row selection materializes)."""
+    write_tables_columnar; `sel` (from the downloaded survivor bitmap) is
+    materialized only when a whole-key bloom must build from it."""
     from toplingdb_tpu import native
     from toplingdb_tpu.ops.columnar_io import _ColumnarSST
     from toplingdb_tpu.ops.device_compaction import _ranges_lmap
@@ -352,14 +373,15 @@ def run_block_assembly(env, dbname, icmp, kv, shard, cover, snapshots,
     front_code = "plens" in h
     dummy = np.zeros(1, dtype=np.uint8)
     w = (max(uk_len, 4) + 3) // 4
-    out, meta, bcnt, bpayload, bfirst, blast = _assemble_blocks_impl(
+    (out, meta, bcnt, bpayload, bfirst, blast,
+     surv_bitmap) = _assemble_blocks_impl(
         h.get("ukb", dummy), h.get("plens", dummy), h.get("sfx", dummy),
         h["pkb"], h["starts"], h["min_his"], h["min_los"],
         jax.device_put(vlens), jax.device_put(vf), t_hi, t_lo,
         snap_hi, snap_lo, np.int32(h["total"]), w, uk_len,
         bool(bottommost), has_tombs, front_code, R, B, max_rec, ubp, nbp,
     )
-    for a in (meta, bcnt, bpayload, bfirst, blast):
+    for a in (meta, bcnt, bpayload, bfirst, blast, surv_bitmap):
         if hasattr(a, "copy_to_host_async"):
             a.copy_to_host_async()
     meta = np.asarray(meta)
@@ -381,6 +403,14 @@ def run_block_assembly(env, dbname, icmp, kv, shard, cover, snapshots,
         np.zeros(0, np.uint8)
 
     lmap = _ranges_lmap(ranges)
+    want_bloom = (table_options.filter_policy is not None
+                  and table_options.whole_key_filtering)
+    if want_bloom:
+        surv = np.unpackbits(np.asarray(surv_bitmap),
+                             bitorder="little")[: len(lmap)]
+        sel = lmap[np.flatnonzero(surv)]
+    else:
+        sel = np.empty(0, dtype=np.int64)  # nothing consumes it
 
     def boundary_ikey(enc: int) -> bytes:
         row = int(lmap[enc & ((1 << 30) - 1)])
@@ -426,10 +456,9 @@ def run_block_assembly(env, dbname, icmp, kv, shard, cover, snapshots,
                               | int(np.uint32(meta[9]))) if mtot else 0,
         }
         props, smallest, largest = sst.finish(
-            lib, kv, np.empty(0, dtype=np.int64), None, None, tombs,
-            precomputed=pre,
+            lib, kv, sel, None, None, tombs, precomputed=pre,
         )
-        return [(fnum, sst.path, props, smallest, largest, None)]
+        return [(fnum, sst.path, props, smallest, largest, sel)]
     except BaseException:
         try:
             sst.w.close()

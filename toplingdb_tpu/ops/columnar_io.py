@@ -373,7 +373,8 @@ class _ColumnarSST:
         """Write meta blocks + footer; `sel` = the original-index selection
         of this file's entries (stats/bloom are vectorized over it).
         `precomputed`: entry stats already reduced elsewhere (the on-device
-        block-assembly path, which never materializes sel) — a dict with
+        block-assembly path; its sel comes from a survivor bitmap and only
+        feeds the bloom build below) — a dict with
         num_entries/raw_key_size/raw_value_size/num_deletions/
         num_merge_operands/smallest_seqno/largest_seqno."""
         if self._dict == b"":
@@ -387,15 +388,15 @@ class _ColumnarSST:
             succ = icmp.find_short_successor(self.pending_last_key)
             self.index_block.add(succ, self.pending_handle.encode())
         if precomputed is not None:
-            n = precomputed["num_entries"]
-            props.num_entries = n
+            props.num_entries = precomputed["num_entries"]
             props.raw_key_size = precomputed["raw_key_size"]
             props.raw_value_size = precomputed["raw_value_size"]
             props.num_deletions = precomputed["num_deletions"]
             props.num_merge_operands = precomputed["num_merge_operands"]
             props.smallest_seqno = precomputed["smallest_seqno"]
             props.largest_seqno = precomputed["largest_seqno"]
-            n = 0  # skip the sel-vectorized stats AND the bloom build
+            # stats come precomputed; the bloom (below) still builds from
+            # `sel` when the caller materialized one (order-insensitive).
         else:
             props.num_entries = n
             props.raw_key_size = int(kv.key_lens[sel].sum()) if n else 0
