@@ -262,11 +262,14 @@ class PosixEnv(Env):
 
 
 class _MemFileState:
-    __slots__ = ("data", "synced_len")
+    __slots__ = ("data", "synced_len", "mtime")
 
     def __init__(self):
+        import time as _time
+
         self.data = bytearray()
         self.synced_len = 0
+        self.mtime = _time.time()
 
 
 class _MemWritable(WritableFile):
@@ -323,6 +326,13 @@ class MemEnv(Env):
             st = _MemFileState()
             self._files[self._norm(path)] = st
             return _MemWritable(st)
+
+    def get_file_mtime(self, path: str) -> float | None:
+        with self._lock:
+            st = self._files.get(self._norm(path))
+            if st is None:
+                raise NotFound(path)
+            return st.mtime
 
     def new_random_access_file(self, path: str) -> RandomAccessFile:
         with self._lock:
