@@ -39,6 +39,7 @@ class WriteBatch:
             self._rep = bytearray(data)
             self._ops = None  # unknown provenance: decode when applying
             self._simple = False
+            self._count = coding.decode_fixed32(self._rep, 8)
         else:
             self._rep = bytearray(HEADER_SIZE)
             # Ops built through this object are ALSO kept parsed so
@@ -46,6 +47,7 @@ class WriteBatch:
             # (write-path hot loop); wire-deserialized batches decode.
             self._ops: list | None = []
             self._simple = True
+            self._count = 0  # header count patched lazily (see data())
 
     # -- mutation -------------------------------------------------------
 
@@ -69,16 +71,23 @@ class WriteBatch:
         coding.put_length_prefixed_slice(self._rep, blob)
 
     def _add_record(self, t: ValueType, cf: int, *slices: bytes) -> None:
-        if cf != 0 or t == ValueType.RANGE_DELETION:
-            self._simple = False
+        rep = self._rep
         if cf == 0:
-            self._rep.append(t)
+            rep.append(t)
+            if t == ValueType.RANGE_DELETION:
+                self._simple = False
         else:
-            self._rep.append(_CF_FLAG | t)
-            self._rep += coding.encode_varint32(cf)
+            self._simple = False
+            rep.append(_CF_FLAG | t)
+            rep += coding.encode_varint32(cf)
         for s in slices:
-            coding.put_length_prefixed_slice(self._rep, s)
-        self.set_count(self.count() + 1)
+            n = len(s)
+            if n < 128:  # single-byte varint: the overwhelmingly common case
+                rep.append(n)
+                rep += s
+            else:
+                coding.put_length_prefixed_slice(rep, s)
+        self._count += 1
         if self._ops is not None:
             # bytes() snapshots: the decode path yields immutable copies, so
             # the fast path must too (a caller-mutated bytearray would
@@ -92,11 +101,12 @@ class WriteBatch:
         self._rep = bytearray(HEADER_SIZE)
         self._ops = []
         self._simple = True
+        self._count = 0
 
     def append_from(self, other: "WriteBatch") -> None:
         """Group-commit helper: append other's records to self."""
         self._rep += other._rep[HEADER_SIZE:]
-        self.set_count(self.count() + other.count())
+        self._count += other.count()
         self._simple = self._simple and other._simple
         if self._ops is not None:
             if other._ops is not None:
@@ -113,12 +123,16 @@ class WriteBatch:
         self._rep[0:8] = coding.encode_fixed64(seq)
 
     def count(self) -> int:
-        return coding.decode_fixed32(self._rep, 8)
+        return self._count
 
     def set_count(self, n: int) -> None:
-        self._rep[8:12] = coding.encode_fixed32(n)
+        # _count is the single source of truth; the header bytes are
+        # patched only at export (data()).
+        self._count = n
 
     def data(self) -> bytes:
+        # The header count is maintained lazily; patch it on export.
+        self._rep[8:12] = coding.encode_fixed32(self._count)
         return bytes(self._rep)
 
     def data_size(self) -> int:
@@ -191,7 +205,7 @@ class WriteBatch:
             if mem0 is None:
                 return self.count()  # default CF dropped: all skipped
             enc = getattr(mem0, "add_encoded", None)
-            if enc is not None and enc(seq, bytes(self._rep)) is not None:
+            if enc is not None and enc(seq, self.data()) is not None:
                 return self.count()
         run_mem = None
         run_seq = seq
