@@ -86,3 +86,37 @@ def test_wal_archival_and_ttl(tmp_path, monkeypatch):
         old = [f for f in os.listdir(arch)
                if os.path.getmtime(os.path.join(arch, f)) < 1000]
         assert not old, "TTL-expired archived WALs survived"
+
+
+def test_ldb_wal_dump_recycled_log(tmp_path, capsys):
+    """ldb dump_wal passes the log number, so a recycled WAL dumps only
+    its CURRENT life's records."""
+    from toplingdb_tpu.db.log import LogWriter
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.db.write_batch import WriteBatch
+    from toplingdb_tpu.tools import ldb
+
+    env = default_env()
+    p1 = str(tmp_path / "000004.log")
+    w = env.new_writable_file(p1)
+    lw = LogWriter(w, log_number=4, recycled=True)
+    for i in range(800):
+        b = WriteBatch()
+        b.put(b"old%04d" % i, b"x" * 40)
+        b.set_sequence(i + 1)
+        lw.add_record(b.data())
+    lw.close()
+    p2 = str(tmp_path / "000009.log")
+    w2 = env.reuse_writable_file(p1, p2)
+    lw2 = LogWriter(w2, log_number=9, recycled=True)
+    b = WriteBatch()
+    b.put(b"new-key", b"new-val")
+    b.set_sequence(500)
+    lw2.add_record(b.data())
+    lw2.flush()
+    lw2.close()
+    rc = ldb.main(["--db", str(tmp_path), "wal_dump", p2])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "new-key" in out
+    assert "old0000" not in out, "previous-life records dumped as live"
