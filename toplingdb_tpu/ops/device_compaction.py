@@ -548,6 +548,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         )
     except NotSupported:
         raise _FallbackToEntries()  # >2GiB columnar buffers etc.
+    stats.input_scan_usec = int((time.time() - t0) * 1e6)
     stats.input_records = kv.n
     if kv.n == 0 and rd.empty():
         stats.work_time_usec = int((time.time() - t0) * 1e6)
@@ -649,7 +650,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 for (_chunks, ranges), pending in zip(shards, pendings):
                     t_dn = time.time()
                     o, z, cx, hc = ck.fused_uniform_shard_finish(pending)
-                    stats.transfer_time_usec += int(
+                    stats.device_wait_usec += int(
                         (time.time() - t_dn) * 1e6)
                     lmap = _ranges_lmap(ranges)
                     orders.append(lmap[o])
@@ -690,9 +691,11 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 range_del_agg=None if rd.empty() else rd,
                 blob_resolver=blob_resolver,
             )
+            t_rs = time.time()
             order = _resolve_complex_stream(
                 kv, order, cx_flags, trailer_override, seqs, vtypes, helper
             )
+            stats.resolve_usec = int((time.time() - t_rs) * 1e6)
         order_feed = order
     else:
         # Shard streaming: each chunk's trailers/seqs land just before the
@@ -701,7 +704,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             for (_chunks, ranges), pending in zip(shards, pendings):
                 t_dn = time.time()
                 o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
-                stats.transfer_time_usec += int((time.time() - t_dn) * 1e6)
+                stats.device_wait_usec += int((time.time() - t_dn) * 1e6)
                 if hc:
                     raise _FallbackToEntries()
                 lmap = _ranges_lmap(ranges)
@@ -718,6 +721,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         rd, snapshots, compaction.bottommost, icmp.user_comparator
     )
     outputs = []
+    t_wr = time.time()
     if order is None or len(order) or tombs:
         try:
             if getattr(table_options, "format", "block") == "zip":
@@ -771,6 +775,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             stats.output_bytes += meta.file_size
             stats.output_files += 1
             stats.output_records += props.num_entries
+    stats.encode_write_usec = int((time.time() - t_wr) * 1e6)
     stats.work_time_usec = int((time.time() - t0) * 1e6)
     return outputs, stats
 

@@ -1,11 +1,20 @@
 """Accelerator-backend reachability probe.
 
 The axon (TPU-tunnel) jax plugin can hang FOREVER inside backend client
-creation when the tunnel is down — no error, no timeout. Anything that may
-touch the accelerator non-interactively (bench, driver entry points) probes
-first in a KILLABLE subprocess and falls back to the cpu backend when
-unreachable. Shared here so the tunnel-handling logic cannot diverge
-between callers."""
+creation when the tunnel is down — no error, no timeout (observed stack:
+``jaxlib/xla_client.py make_c_api_client`` never returns; the PJRT C-API
+client dials the relay and blocks). Anything that may touch the
+accelerator non-interactively (bench, driver entry points) probes first in
+a KILLABLE subprocess and falls back to the cpu backend when unreachable.
+
+Unlike a bare liveness check, the probe RECORDS EVIDENCE: the child runs
+with ``faulthandler.dump_traceback_later`` armed so a hang produces the
+exact blocking stack on stderr, and the parent keeps the stderr tail. The
+bench embeds that evidence in its JSON so an unreachable-TPU run is
+diagnosable after the fact instead of a silent CPU fallback.
+
+Shared here so the tunnel-handling logic cannot diverge between callers.
+"""
 
 from __future__ import annotations
 
@@ -13,30 +22,76 @@ import os
 import signal
 import subprocess
 import sys
+import time
+
+# The child arms faulthandler a little inside the parent's budget so ITS
+# stack dump (the evidence) wins the race against the parent's SIGKILL.
+_CHILD_GRACE_S = 5.0
+
+_PROBE_SRC = r"""
+import faulthandler, sys, time
+budget = float(sys.argv[1])
+faulthandler.dump_traceback_later(budget, exit=True)
+t0 = time.time()
+import jax
+print("probe: import jax ok %.1fs" % (time.time() - t0), file=sys.stderr)
+t0 = time.time()
+d = jax.devices()
+print("probe: devices ok %.1fs %s" % (time.time() - t0, d), file=sys.stderr)
+import jax.numpy as jnp
+t0 = time.time()
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+(x @ x).block_until_ready()
+print("probe: matmul ok %.1fs" % (time.time() - t0), file=sys.stderr)
+"""
 
 
-def probe_jax_backend(timeout_s: float) -> bool:
-    """True iff `import jax; jax.devices()` completes in a fresh process.
-    Runs in its own session with output discarded: a timeout kills the
-    whole process GROUP (the plugin may spawn helpers that would otherwise
-    hold pipes open past the child's death)."""
-    try:
-        p = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            start_new_session=True,
-        )
-    except OSError:
-        return False
-    try:
-        return p.wait(timeout=timeout_s) == 0
-    except subprocess.TimeoutExpired:
+def probe_jax_backend(timeout_s: float):
+    """Run ``import jax; jax.devices(); tiny matmul`` in a fresh process.
+
+    Returns ``(ok, diag)`` where diag is a JSON-able dict:
+    ``{"ok", "elapsed_s", "rc", "stderr_tail"}``. On a hang the child's
+    faulthandler stack (e.g. ``make_c_api_client``) appears in
+    stderr_tail — the recorded root cause VERDICT r03 asked for.
+
+    Runs in its own session: a timeout kills the whole process GROUP (the
+    plugin may spawn helpers that would otherwise hold pipes open past the
+    child's death)."""
+    import tempfile
+
+    t0 = time.time()
+    diag = {"ok": False, "elapsed_s": 0.0, "rc": None, "stderr_tail": ""}
+    # Child stderr goes to a FILE, not a pipe: a plugin helper that outlives
+    # the child would hold a pipe open and stall p.communicate() past the
+    # child's exit (the DEVNULL rationale of the original probe) — a file fd
+    # has no reader to block on, and we read it after wait().
+    with tempfile.TemporaryFile() as errf:
         try:
-            os.killpg(p.pid, signal.SIGKILL)
-        except OSError:
-            p.kill()
-        p.wait()
-        return False
+            p = subprocess.Popen(
+                [sys.executable, "-u", "-c", _PROBE_SRC,
+                 str(max(1.0, timeout_s - _CHILD_GRACE_S))],
+                stdout=subprocess.DEVNULL, stderr=errf,
+                start_new_session=True,
+            )
+        except OSError as e:
+            diag["stderr_tail"] = f"popen failed: {e!r}"
+            return False, diag
+        try:
+            diag["rc"] = p.wait(timeout=timeout_s)
+            diag["ok"] = diag["rc"] == 0
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except OSError:
+                p.kill()
+            p.wait()
+            diag["rc"] = "killed-after-timeout"
+        diag["elapsed_s"] = round(time.time() - t0, 1)
+        errf.seek(0, os.SEEK_END)
+        size = errf.tell()
+        errf.seek(max(0, size - 2000))
+        diag["stderr_tail"] = errf.read().decode("utf-8", "replace")
+    return diag["ok"], diag
 
 
 def redirect_to_cpu_backend() -> None:
@@ -55,19 +110,23 @@ def redirect_to_cpu_backend() -> None:
 
 def ensure_reachable_backend(timeout_s: float = 120.0,
                              attempts: int = 1,
-                             backoff_s: float = 30.0) -> bool:
+                             backoff_s: float = 30.0,
+                             diagnostics: list | None = None) -> bool:
     """Returns True when the configured accelerator is reachable (or no
     accelerator is configured); on False the process has been redirected to
     the cpu backend. `attempts` > 1 retries with `backoff_s` sleeps so one
-    transient tunnel outage doesn't decide an entire bench run."""
-    import time
-
+    transient tunnel outage doesn't decide an entire bench run. Each
+    attempt's evidence dict is appended to `diagnostics` when given."""
     if os.environ.get("JAX_PLATFORMS") != "axon":
         return True
     for i in range(max(1, attempts)):
         if i:
             time.sleep(backoff_s)
-        if probe_jax_backend(timeout_s):
+        ok, diag = probe_jax_backend(timeout_s)
+        diag["attempt"] = i + 1
+        if diagnostics is not None:
+            diagnostics.append(diag)
+        if ok:
             return True
     redirect_to_cpu_backend()
     return False

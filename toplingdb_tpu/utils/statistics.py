@@ -111,6 +111,7 @@ COMPACTION_TIME_MICROS = "compaction.time.micros"
 COMPACTION_PREPARE_MICROS = "compaction.prepare.micros"
 COMPACTION_WAITING_MICROS = "compaction.waiting.micros"
 COMPACTION_TRANSFER_MICROS = "compaction.transfer.micros"
+COMPACTION_DEVICE_WAIT_MICROS = "compaction.device.wait.micros"
 LCOMPACTION_TIME_MICROS = "lcompaction.time.micros"
 DCOMPACTION_TIME_MICROS = "dcompaction.time.micros"
 DCOMPACTION_PREPARE_MICROS = "dcompaction.prepare.micros"
@@ -248,6 +249,11 @@ class Statistics:
         if stats.transfer_time_usec:
             self.record_in_histogram(COMPACTION_TRANSFER_MICROS,
                                      stats.transfer_time_usec)
+        if getattr(stats, "device_wait_usec", 0):
+            # Blocking device-compute + D2H waits, split out of the
+            # transfer histogram by the r04 phase breakdown.
+            self.record_in_histogram(COMPACTION_DEVICE_WAIT_MICROS,
+                                     stats.device_wait_usec)
         if stats.dropped_obsolete or stats.dropped_tombstone:
             # CPU path: the iterator counts drops precisely.
             self.record_tick(COMPACTION_KEY_DROP_OBSOLETE,
