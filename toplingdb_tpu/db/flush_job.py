@@ -11,6 +11,69 @@ from toplingdb_tpu.table.factory import new_table_builder
 from toplingdb_tpu.table.merging_iterator import MergingIterator
 
 
+def _flush_columnar(env, dbname, file_number, icmp, mem, table_options,
+                    tombstones, creation_time, column_family):
+    """Single-memtable columnar flush: ONE native export of the whole rep +
+    the native block-building SST writer — no per-entry Python. Returns the
+    FileMetaData, or None when ineligible (caller uses the iterator path).
+    This is the write-path half of the memtable performance story: without
+    it, flushing a full memtable walks ~10^5 Python iterations while the
+    write group waits (reference FlushJob::WriteLevel0Table's tight C++
+    scan, db/flush_job.cc:833)."""
+    from toplingdb_tpu.db import dbformat as _dbf
+
+    if (getattr(table_options, "format", "block") != "block"
+            or getattr(table_options, "index_type", "binary") != "binary"
+            or getattr(table_options, "properties_collector_factories", None)
+            or getattr(table_options, "prefix_extractor", None) is not None
+            or getattr(table_options, "partition_filters", False)
+            or icmp.user_comparator.name() != _dbf.BYTEWISE.name()):
+        return None
+    exported = mem.export_columnar()
+    if exported is None:
+        return None
+    kv, seqs, vtypes = exported
+    if kv.n == 0:
+        # Tombstone-only table: the columnar writer's n==0 seqno accounting
+        # differs from TableBuilder's — the iterator path stays bit-true.
+        return None
+    import numpy as np
+
+    from toplingdb_tpu.ops.columnar_io import write_tables_columnar
+    from toplingdb_tpu.utils.status import NotSupported
+
+    frags = list(fragment_tombstones(tombstones, icmp.user_comparator))
+
+    numbers = iter([file_number])
+
+    def alloc():
+        return next(numbers)  # one output only (max size unbounded)
+
+    try:
+        files = write_tables_columnar(
+            env, dbname, alloc, icmp, table_options, kv,
+            np.arange(kv.n, dtype=np.int32),
+            np.full(kv.n, -1, dtype=np.int64), vtypes, seqs, frags,
+            creation_time, column_family=column_family,
+        )
+    except NotSupported:
+        return None  # oversized keys etc. — iterator path handles them
+    if not files:
+        return None
+    fnum, path, props, smallest, largest, _sel = files[0]
+    return FileMetaData(
+        number=fnum,
+        file_size=env.get_file_size(path),
+        smallest=smallest,
+        largest=largest,
+        smallest_seqno=props.smallest_seqno,
+        largest_seqno=props.largest_seqno,
+        num_entries=props.num_entries,
+        num_deletions=props.num_deletions,
+        num_range_deletions=props.num_range_deletions,
+    )
+
+
 def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
                             memtables: list[MemTable], table_options,
                             creation_time: int = 0,
@@ -31,6 +94,13 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
             tombstones.append(RangeTombstone(seq, begin, end))
     if total == 0 and not tombstones:
         return None
+
+    if len(memtables) == 1 and blob_file_number is None:
+        meta = _flush_columnar(env, dbname, file_number, icmp, memtables[0],
+                               table_options, tombstones, creation_time,
+                               column_family)
+        if meta is not None:
+            return meta
 
     blob_builder = None
     if blob_file_number is not None:

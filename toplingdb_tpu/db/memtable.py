@@ -144,6 +144,55 @@ class NativeSkipListRep(MemTableRep):
     def memory_usage(self) -> int:
         return self._l.tpulsm_skiplist_memory(self._h)
 
+    def export_columnar(self):
+        """Whole-rep ordered export in ONE GIL-releasing native call:
+        returns (kv: ColumnarKV with INTERNAL keys, seqs u64, vtypes i32)
+        or None when the native symbol is missing. Caller must guarantee
+        no concurrent inserts (flush runs on an immutable memtable)."""
+        import ctypes
+
+        import numpy as np
+
+        from toplingdb_tpu import native
+        from toplingdb_tpu.ops.columnar_io import ColumnarKV
+
+        cl = native.lib()
+        if cl is None or not hasattr(cl, "tpulsm_skiplist_export"):
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        sizes = np.zeros(3, dtype=np.int64)
+        rows = cl.tpulsm_skiplist_export(
+            self._h, ctypes.cast(None, u8p), None, None,
+            ctypes.cast(None, u64p), None, ctypes.cast(None, u8p), None,
+            None, 0, native.np_i64p(sizes),
+        )
+        if rows < 0 or sizes[0] > 2 ** 31 - 8 or sizes[1] > 2 ** 31 - 8:
+            return None  # int32 ColumnarKV offset budget
+        key_buf = np.empty(int(sizes[0]), dtype=np.uint8)
+        val_buf = np.empty(int(sizes[1]), dtype=np.uint8)
+        # The export fills int64 offsets (matching its C signature); the
+        # ColumnarKV convention is int32 — converted after the call.
+        key_offs = np.empty(rows, dtype=np.int64)
+        key_lens = np.empty(rows, dtype=np.int32)
+        val_offs = np.empty(rows, dtype=np.int64)
+        val_lens = np.empty(rows, dtype=np.int32)
+        seqs = np.empty(rows, dtype=np.uint64)
+        vtypes = np.empty(rows, dtype=np.int32)
+        got = cl.tpulsm_skiplist_export(
+            self._h, native.np_u8p(key_buf), native.np_i64p(key_offs),
+            native.np_i32p(key_lens), seqs.ctypes.data_as(u64p),
+            native.np_i32p(vtypes), native.np_u8p(val_buf),
+            native.np_i64p(val_offs), native.np_i32p(val_lens), rows,
+            native.np_i64p(sizes),
+        )
+        if got != rows:
+            return None  # concurrent mutation — caller uses the slow path
+        kv = ColumnarKV(key_buf, key_offs.astype(np.int32),
+                        key_lens, val_buf, val_offs.astype(np.int32),
+                        val_lens)
+        return kv, seqs, vtypes
+
     def _node_entry(self, node):
         import ctypes
 
@@ -504,6 +553,14 @@ class MemTable:
         rep_batch(keybuf, key_offs, key_lens, invs,
                   valbuf, val_offs, val_lens, m)
         return n
+
+    def export_columnar(self):
+        """Columnar flush fast path: ordered (kv, seqs, vtypes) of every
+        POINT entry in one native call (range tombstones are stored aside —
+        read them via range_del_entries). None when the rep can't bulk
+        export; callers fall back to the per-entry iterator."""
+        exp = getattr(self._rep, "export_columnar", None)
+        return exp() if exp is not None else None
 
     def entries_for_key(self, user_key: bytes, snapshot_seq: int):
         """Yield (seq, type, value) for user_key with seq <= snapshot,

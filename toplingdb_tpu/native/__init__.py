@@ -171,6 +171,17 @@ def lib() -> ctypes.CDLL | None:
             ]
         except AttributeError:
             pass
+        try:
+            # Ordered whole-memtable export into columnar buffers: the
+            # memtable half of the columnar flush fast path.
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            l.tpulsm_skiplist_export.restype = ctypes.c_int64
+            l.tpulsm_skiplist_export.argtypes = [
+                ctypes.c_void_p, u8p, i64p, i32p, u64p, i32p,
+                u8p, i64p, i32p, ctypes.c_int64, i64p,
+            ]
+        except AttributeError:
+            pass
         _lib = l
         return _lib
 

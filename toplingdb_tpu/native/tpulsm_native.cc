@@ -958,6 +958,68 @@ int64_t tpulsm_skiplist_insert_batch(
   return fresh;
 }
 
+// Bulk ordered export of the whole skiplist into flat columnar buffers —
+// the memtable half of the columnar flush fast path (one GIL-released
+// crossing instead of one Python iteration per entry; the role of
+// FlushJob::WriteLevel0Table's memtable scan, reference db/flush_job.cc:833).
+// Keys are emitted as INTERNAL keys: user_key bytes followed by the 8-byte
+// little-endian packed trailer ((seq<<8)|type == ~inv_packed), i.e. exactly
+// the SST key encoding. seqs[i]/vtypes[i] receive the split trailer.
+//
+// Sizing call: key_buf == nullptr → fills out_sizes[3] = {key_bytes (incl.
+// the 8B trailers), val_bytes, rows} and returns rows. Fill call: writes up
+// to max_rows rows, bounded by the byte capacities the caller passes back
+// in out_sizes[0]/[1] (the sizing results); returns rows written, or -1 on
+// any overflow — row count OR byte budget — so a mutation between the two
+// calls (contract violation: flush runs on an immutable memtable) can
+// never write past the caller's buffers.
+int64_t tpulsm_skiplist_export(
+    void* h, uint8_t* key_buf, int64_t* key_offs, int32_t* key_lens,
+    uint64_t* seqs, int32_t* vtypes, uint8_t* val_buf, int64_t* val_offs,
+    int32_t* val_lens, int64_t max_rows, int64_t* out_sizes) {
+  SkipList* sl = static_cast<SkipList*>(h);
+  if (key_buf == nullptr) {
+    int64_t kb = 0, vb = 0, rows = 0;
+    for (SLNode* n = sl->head->nxt(0); n; n = n->nxt(0)) {
+      const uint8_t* rec = n->val.load(std::memory_order_acquire);
+      uint32_t vl;
+      std::memcpy(&vl, rec, 4);
+      kb += n->key_len + 8;
+      vb += vl;
+      rows++;
+    }
+    out_sizes[0] = kb;
+    out_sizes[1] = vb;
+    out_sizes[2] = rows;
+    return rows;
+  }
+  const int64_t key_cap = out_sizes[0], val_cap = out_sizes[1];
+  int64_t ko = 0, vo = 0, rows = 0;
+  for (SLNode* n = sl->head->nxt(0); n; n = n->nxt(0)) {
+    if (rows >= max_rows) return -1;
+    const uint8_t* rec = n->val.load(std::memory_order_acquire);
+    uint32_t vl;
+    std::memcpy(&vl, rec, 4);
+    if (ko + (int64_t)n->key_len + 8 > key_cap || vo + (int64_t)vl > val_cap)
+      return -1;
+    uint64_t packed = ~n->inv_packed;
+    std::memcpy(key_buf + ko, n->key, n->key_len);
+    for (int b = 0; b < 8; b++)
+      key_buf[ko + n->key_len + b] = (uint8_t)(packed >> (8 * b));
+    key_offs[rows] = ko;
+    key_lens[rows] = (int32_t)(n->key_len + 8);
+    seqs[rows] = packed >> 8;
+    vtypes[rows] = (int32_t)(packed & 0xFF);
+    std::memcpy(val_buf + vo, rec + 4, vl);
+    val_offs[rows] = vo;
+    val_lens[rows] = (int32_t)vl;
+    ko += n->key_len + 8;
+    vo += vl;
+    rows++;
+  }
+  return rows;
+}
+
 // ---------------------------------------------------------------------------
 // Bulk block inflate: decompress EVERY data block of an SST image in one
 // GIL-free call (snappy / zstd dlopen'd at runtime like the Python codecs
