@@ -279,6 +279,17 @@ def db_path_rows(detail, n_db):
     dt = time.time() - t0
     detail["readrandom_ops_s"] = round(len(probes) / dt)
     detail["readrandom_hit_pct"] = round(100 * hits / len(probes), 1)
+
+    # multireadrandom (reference db_bench workload): batched native
+    # MultiGet, one GIL-released chain walk per 128-key batch.
+    t0 = time.time()
+    mg_hits = 0
+    for i in range(0, len(probes), 128):
+        for v in db.multi_get(probes[i:i + 128]):
+            if v is not None:
+                mg_hits += 1
+    detail["multireadrandom_ops_s"] = round(
+        len(probes) / (time.time() - t0))
     db.close()
     shutil.rmtree(d, ignore_errors=True)
 

@@ -130,14 +130,19 @@ def test_perf_context_populates(tmp_path):
         db.flush()
         ctx = st.perf_context()
         ctx.reset()
-        for i in range(0, 500, 9):
-            db.get(b"k%05d" % i)
-        assert ctx.get_from_memtable_count > 0
-        assert ctx.block_read_count > 0
-        assert ctx.block_read_byte > 0
-        assert ctx.bloom_sst_hit_count > 0
-        db.get(b"k0025zz")  # inside file key range, absent
-        assert ctx.bloom_sst_miss_count >= 1
+        # Collection is opt-in (reference SetPerfLevel; disabled default).
+        st.perf_level = 1
+        try:
+            for i in range(0, 500, 9):
+                db.get(b"k%05d" % i)
+            assert ctx.get_from_memtable_count > 0
+            assert ctx.block_read_count > 0
+            assert ctx.block_read_byte > 0
+            assert ctx.bloom_sst_hit_count > 0
+            db.get(b"k0025zz")  # inside file key range, absent
+            assert ctx.bloom_sst_miss_count >= 1
+        finally:
+            st.perf_level = 0
 
 
 def test_multiget_stats(tmp_path):

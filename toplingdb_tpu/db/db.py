@@ -121,6 +121,74 @@ class _CFData:
         self.imm: list[MemTable] = []
 
 
+class _SeqSnapshot:
+    """Sequence-pinning shim for internal reads: quacks like a Snapshot
+    (.sequence, no excluded ranges) without registering in the snapshot
+    list."""
+
+    __slots__ = ("sequence",)
+    excluded_ranges = ()
+
+    def __init__(self, seq: int):
+        self.sequence = seq
+
+
+class _NGetState:
+    """Per-thread bound state for the native point-read fast path: the
+    native ctx (owns out/value buffers), mapped views, and strong refs to
+    the memtables/version whose handles the ctx embeds (identity-compared
+    by the caller to detect memtable switches / version installs)."""
+
+    __slots__ = ("mem", "imm", "version", "ctx", "fn", "out",
+                 "val_ptr", "val_cap", "_lib")
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        ctx = getattr(self, "ctx", None)
+        if lib is not None and ctx:
+            try:
+                lib.tpulsm_getctx_free(ctx)
+            except Exception:
+                pass
+
+    def remap(self, lib, vlen: int) -> None:
+        # The C side grew its buffer to >= vlen; record vlen as the known
+        # capacity so any LARGER future value triggers another remap (the
+        # vector may reallocate again, moving the pointer).
+        self.val_ptr = lib.tpulsm_getctx_val(self.ctx)
+        self.val_cap = vlen
+
+    @classmethod
+    def build(cls, lib, mem, imm, version, table_cache):
+        import ctypes
+
+        handles = []
+        for m in [mem] + imm:
+            h = getattr(m._rep, "_h", None)
+            if h is None:
+                return None
+            handles.append(h)
+        vh = version.native_read_chain(table_cache)
+        if vh is None and any(version.files):
+            return None
+        marr = (ctypes.c_void_p * len(handles))(*handles)
+        ctx = lib.tpulsm_getctx_new(marr, len(handles), vh, 4096)
+        if not ctx:
+            return None
+        s = cls.__new__(cls)
+        s.mem = mem
+        s.imm = list(imm)
+        s.version = version
+        s.ctx = ctx
+        s.fn = lib.tpulsm_getctx_get
+        s.out = (ctypes.c_int64 * 8).from_address(
+            lib.tpulsm_getctx_out(ctx))
+        s.val_ptr = lib.tpulsm_getctx_val(ctx)
+        s.val_cap = 4096
+        s._lib = lib
+        return s
+
+
 class DB:
     """LSM engine instance (multi column family). Use DB.open()."""
 
@@ -129,6 +197,7 @@ class DB:
         self.options = options
         self.env = env
         self.icmp = InternalKeyComparator(options.comparator)
+        self._nget_tl = threading.local()  # native-get per-thread state
         if (options.prefix_extractor is not None
                 and options.table_options.prefix_extractor is None):
             # CF-level extractor feeds the table layer (prefix blooms, plain
@@ -1102,6 +1171,91 @@ class DB:
     # Read path
     # ==================================================================
 
+    def _nget_state(self, cfd, opts):
+        """Shared eligibility gate + per-thread call state for the native
+        read fast paths. Returns (lib, state) with state None when the
+        Python chain must run. State is PER-THREAD (the ctx's out/value
+        buffers are written inside a GIL-released call — sharing them
+        across threads would race), keyed by object IDENTITY of (active
+        mem, imm list, version); the state holds refs so ids can't recycle
+        while cached."""
+        lib = getattr(self, "_nget_lib", False)
+        if lib is False:
+            from toplingdb_tpu import native
+
+            lib = native.lib()
+            if lib is None or not hasattr(lib, "tpulsm_getctx_get"):
+                lib = None
+            if getattr(self.options, "block_cache", None) is not None:
+                # A user-configured block cache is a contract (capacity
+                # budget, secondary tier, tracer, stats) the native
+                # engine's internal LRU would silently bypass.
+                lib = None
+            self._nget_lib = lib
+        if (lib is None or opts.just_check_key_exists
+                or self._excluded_for(opts)):
+            return lib, None
+        mem = cfd.mem
+        imm = cfd.imm
+        if mem._range_dels or any(m._range_dels for m in imm):
+            return lib, None
+        version = self.versions.cf_current(cfd.handle.id)
+        tl = self._nget_tl
+        states = getattr(tl, "states", None)
+        if states is None:
+            states = tl.states = {}
+        cc = states.get(cfd.handle.id)
+        if cc is None or cc.mem is not mem or cc.version is not version \
+                or cc.imm != imm:
+            cc = _NGetState.build(lib, mem, imm, version, self.table_cache)
+            if cc is None:
+                return lib, None
+            states[cfd.handle.id] = cc
+        return lib, cc
+
+    def _native_get(self, cfd, key: bytes, snap_seq: int, opts):
+        """One-call native point lookup (reference GetImpl's chain in one
+        GIL-released call, db_impl.cc:2079 → version_set.cc:2606 →
+        block_based_table_reader.cc:2095). Returns (handled, value, src):
+        handled=False → run the Python chain (ineligible, or the native
+        walk hit something only the Python state machine handles). The
+        hot call carries 4 args against a persistent native context; the
+        value and counters are read from ctx-owned memory mapped once."""
+        lib, cc = self._nget_state(cfd, opts)
+        if cc is None:
+            return False, None, None
+        rc = cc.fn(cc.ctx, key, len(key), snap_seq)
+        if rc == 2 or rc < 0:
+            return False, None, None
+        out = cc.out
+        from toplingdb_tpu.utils import statistics as st
+
+        if st.perf_level:
+            pctx = st.perf_context()
+            pctx.get_from_memtable_count += out[2]
+            pctx.bloom_sst_miss_count += out[3]
+            pctx.bloom_sst_hit_count += out[4]
+            pctx.block_cache_hit_count += out[5]
+            pctx.block_read_count += out[6]
+            pctx.block_read_byte += out[7]
+        if self.stats is not None:
+            if out[3]:
+                self.stats.record_tick(st.BLOOM_USEFUL, out[3])
+            if out[5]:
+                self.stats.record_tick(st.BLOCK_CACHE_HIT, out[5])
+            if out[6]:
+                self.stats.record_tick(st.BLOCK_CACHE_MISS, out[6])
+        src = out[1]
+        src = "mem" if src == 0 else (src - 1 if src >= 1 else None)
+        if rc == 1:
+            vlen = out[0]
+            if vlen > cc.val_cap:  # ctx grew its buffer: re-map
+                cc.remap(lib, vlen)
+            import ctypes
+
+            return True, ctypes.string_at(cc.val_ptr, vlen), src
+        return True, None, src
+
     def _probe_memtable(self, mem, key: bytes, snap_seq: int,
                         ctx: GetContext) -> bool:
         """One memtable source; returns False when the lookup is complete."""
@@ -1179,13 +1333,23 @@ class DB:
             opts.snapshot.sequence if opts.snapshot is not None
             else self.versions.last_sequence
         )
+        st_on = self.stats is not None
+        t0 = time.perf_counter() if st_on else 0.0
+        # Native fast chain: memtable skiplists + SST walk in ONE
+        # GIL-released C call (reference GetImpl -> Version::Get ->
+        # BlockBasedTable::Get). Anything the Python state machine must
+        # see (merge operands, single-delete in SSTs, blob indexes, range
+        # tombstones, perf-context accounting) falls through below.
+        handled, val, src = self._native_get(cfd, key, snap_seq, opts)
+        if handled:
+            if st_on:
+                self._record_get_stats(t0, val, src)
+            return val
         ctx = GetContext(
             key, snap_seq, self.options.merge_operator,
             blob_resolver=self.blob_source.get,
             excluded_ranges=self._excluded_for(opts),
         )
-        st_on = self.stats is not None
-        t0 = time.perf_counter() if st_on else 0.0
         # 1. Active memtable, then immutables (newest first).
         for mem in [cfd.mem] + cfd.imm:
             if not self._probe_memtable(mem, key, snap_seq, ctx):
@@ -1409,6 +1573,84 @@ class DB:
         self._check_open()
         return self._ts_point_lookup(key, opts, cf)
 
+    def _native_multi_get(self, cfd, keys, snap_seq: int, opts, cf=None):
+        """Whole-batch native MultiGet: one GIL-released call walks every
+        key's chain; only keys the native engine can't decide (merge
+        chains, blob indexes, range-tombstoned tables) re-resolve through
+        the Python path. Returns (handled, results)."""
+        if not keys:
+            return False, None
+        lib, cc = self._nget_state(cfd, opts)
+        if cc is None or not hasattr(lib, "tpulsm_getctx_multiget"):
+            return False, None
+        import ctypes
+
+        import numpy as np
+
+        n = len(keys)
+        key_lens = np.fromiter((len(k) for k in keys), np.int32, n)
+        key_offs = np.zeros(n, np.int64)
+        np.cumsum(key_lens[:-1], out=key_offs[1:])
+        keybuf = np.frombuffer(b"".join(keys), np.uint8)
+        status = np.zeros(n, np.int8)
+        voffs = np.zeros(n, np.int64)
+        vlens = np.zeros(n, np.int64)
+        from toplingdb_tpu import native as _nat
+
+        arena_cap = 1 << 20
+        ctr = (ctypes.c_int64 * 6)()
+        used = (ctypes.c_int64 * 1)()
+        while True:
+            arena = np.empty(arena_cap, np.uint8)
+            rc = lib.tpulsm_getctx_multiget(
+                cc.ctx, _nat.np_u8p(keybuf), _nat.np_i64p(key_offs),
+                _nat.np_i32p(key_lens), n, snap_seq,
+                status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+                _nat.np_i64p(voffs), _nat.np_i64p(vlens),
+                _nat.np_u8p(arena), arena_cap, used, ctr,
+            )
+            if rc == -2:
+                arena_cap *= 4
+                continue
+            if rc != 0:
+                return False, None
+            break
+        from toplingdb_tpu.utils import statistics as st
+
+        if st.perf_level:
+            pctx = st.perf_context()
+            pctx.get_from_memtable_count += ctr[0]
+            pctx.bloom_sst_miss_count += ctr[1]
+            pctx.bloom_sst_hit_count += ctr[2]
+            pctx.block_cache_hit_count += ctr[3]
+            pctx.block_read_count += ctr[4]
+            pctx.block_read_byte += ctr[5]
+        if self.stats is not None:
+            for tick, cnt in ((st.BLOOM_USEFUL, ctr[1]),
+                              (st.BLOCK_CACHE_HIT, ctr[3]),
+                              (st.BLOCK_CACHE_MISS, ctr[4])):
+                if cnt:
+                    self.stats.record_tick(tick, cnt)
+        mv = arena[: used[0]].tobytes()
+        pinned_opts = opts
+        if opts.snapshot is None and 2 in status:
+            import dataclasses as _dcs
+
+            pinned_opts = _dcs.replace(opts, snapshot=_SeqSnapshot(snap_seq))
+        out: list[bytes | None] = [None] * n
+        for i in range(n):
+            s = status[i]
+            if s == 1:
+                o = voffs[i]
+                out[i] = mv[o: o + vlens[i]]
+            elif s == 2:
+                # Undecidable natively: full per-key Python resolution,
+                # PINNED to the batch's snapshot seqno — re-reading at a
+                # fresh last_sequence would mix sequence points within one
+                # MultiGet (the Python path gives every key one snap_seq).
+                out[i] = self.get(keys[i], pinned_opts, cf)
+        return True, out
+
     def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,
                   cf=None) -> list[bytes | None]:
         """Batched point lookups (reference DBImpl::MultiGet, including the
@@ -1446,6 +1688,10 @@ class DB:
             opts.snapshot.sequence if opts.snapshot is not None
             else self.versions.last_sequence
         )
+        handled, native_res = self._native_multi_get(cfd, keys, snap_seq,
+                                                     opts, cf)
+        if handled:
+            return native_res
         resolver = self.blob_source.get
         excluded = self._excluded_for(opts)
         ctxs = {

@@ -62,6 +62,57 @@ class Version:
             out.append(f)
         return out
 
+    def native_read_chain(self, table_cache):
+        """Native read-chain handle for tpulsm_db_get (built once — a
+        Version is immutable): L0 newest-first then deeper levels, each
+        table's handle from its (cached) reader. Returns the ctypes
+        pointer, or None when the native engine is unavailable. The chain
+        keeps strong refs to every reader so table handles outlive it."""
+        cached = getattr(self, "_nchain", False)
+        if cached is not False:
+            return cached[0] if cached else None
+        import ctypes
+        import weakref
+
+        from toplingdb_tpu import native
+        from toplingdb_tpu.db import dbformat as _dbf
+
+        cl = native.lib()
+        if cl is None or not hasattr(cl, "tpulsm_version_handle_new"):
+            self._nchain = None
+            return None
+        readers, handles = [], []
+        level_offs = []
+        try:
+            for level in range(self.num_levels):
+                for f in self.files[level]:
+                    r = table_cache.get_reader(f.number)
+                    h = r.native_get_handle(
+                        _dbf.extract_user_key(f.smallest),
+                        _dbf.extract_user_key(f.largest),
+                    )
+                    if h is None:
+                        self._nchain = None
+                        return None
+                    readers.append(r)
+                    handles.append(h)
+                # level_offs[0] == n_l0; [li], [li+1] bound deeper level li.
+                level_offs.append(len(handles))
+        except Exception:
+            self._nchain = None
+            return None
+        n_l0 = level_offs[0]
+        offs = (ctypes.c_int32 * len(level_offs))(*level_offs)
+        arr = (ctypes.c_void_p * max(1, len(handles)))(*handles)
+        vh = cl.tpulsm_version_handle_new(arr, n_l0, offs,
+                                          self.num_levels - 1)
+        if not vh:
+            self._nchain = None
+            return None
+        self._nchain = (vh, readers)
+        weakref.finalize(self, cl.tpulsm_version_handle_free, vh)
+        return vh
+
     def files_for_get(self, user_key: bytes):
         """Yield files that may contain user_key, newest data first:
         L0 newest-to-oldest, then each deeper level's single candidate

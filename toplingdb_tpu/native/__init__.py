@@ -182,6 +182,57 @@ def lib() -> ctypes.CDLL | None:
             ]
         except AttributeError:
             pass
+        try:
+            # Native point-read engine: table/version handles + the whole
+            # GetImpl chain in one GIL-released call.
+            l.tpulsm_table_handle_new.restype = ctypes.c_void_p
+            l.tpulsm_table_handle_new.argtypes = [
+                ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32,
+                u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+                u8p, ctypes.c_int32, u8p, ctypes.c_int32,
+            ]
+            l.tpulsm_table_handle_free.restype = None
+            l.tpulsm_table_handle_free.argtypes = [ctypes.c_void_p]
+            l.tpulsm_version_handle_new.restype = ctypes.c_void_p
+            l.tpulsm_version_handle_new.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+                i32p, ctypes.c_int32,
+            ]
+            l.tpulsm_version_handle_free.restype = None
+            l.tpulsm_version_handle_free.argtypes = [ctypes.c_void_p]
+            l.tpulsm_block_cache_config.restype = None
+            l.tpulsm_block_cache_config.argtypes = [ctypes.c_int64, i64p]
+            l.tpulsm_db_get.restype = ctypes.c_int32
+            l.tpulsm_db_get.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+                ctypes.c_uint64, u8p, ctypes.c_int32, i32p, i32p, i64p,
+            ]
+            l.tpulsm_getctx_new.restype = ctypes.c_void_p
+            l.tpulsm_getctx_new.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_int64,
+            ]
+            l.tpulsm_getctx_free.restype = None
+            l.tpulsm_getctx_free.argtypes = [ctypes.c_void_p]
+            l.tpulsm_getctx_out.restype = ctypes.c_void_p
+            l.tpulsm_getctx_out.argtypes = [ctypes.c_void_p]
+            l.tpulsm_getctx_val.restype = ctypes.c_void_p
+            l.tpulsm_getctx_val.argtypes = [ctypes.c_void_p]
+            l.tpulsm_getctx_get.restype = ctypes.c_int32
+            l.tpulsm_getctx_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+                ctypes.c_uint64,
+            ]
+            i8p = ctypes.POINTER(ctypes.c_int8)
+            l.tpulsm_getctx_multiget.restype = ctypes.c_int32
+            l.tpulsm_getctx_multiget.argtypes = [
+                ctypes.c_void_p, u8p, i64p, i32p, ctypes.c_int64,
+                ctypes.c_uint64, i8p, i64p, i64p, u8p, ctypes.c_int64,
+                i64p, i64p,
+            ]
+        except AttributeError:
+            pass
         _lib = l
         return _lib
 

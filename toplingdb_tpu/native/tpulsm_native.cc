@@ -12,10 +12,18 @@
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include <list>
+#include <memory>
 #include <mutex>
 #include <numeric>
+#include <string>
+#include <string_view>
 #include <thread>
+#include <unordered_map>
 #include <vector>
+#ifndef _WIN32
+#include <unistd.h>
+#endif
 #ifdef __linux__
 #include <sched.h>
 #endif
@@ -402,6 +410,19 @@ static inline const uint8_t* get_varint32(const uint8_t* p, const uint8_t* end,
   int shift = 0;
   while (p < end && shift <= 28) {
     uint32_t b = *p++;
+    result |= (b & 0x7f) << shift;
+    if (b < 0x80) { *v = result; return p; }
+    shift += 7;
+  }
+  return nullptr;
+}
+
+static inline const uint8_t* get_varint64(const uint8_t* p, const uint8_t* end,
+                                          uint64_t* v) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (p < end && shift <= 63) {
+    uint64_t b = *p++;
     result |= (b & 0x7f) << shift;
     if (b < 0x80) { *v = result; return p; }
     shift += 7;
@@ -1491,6 +1512,670 @@ int64_t tpulsm_skiplist_insert_wb(void* h, const uint8_t* rep, int64_t len,
     }
   }
   return -4;  // unreachable
+}
+
+// ---------------------------------------------------------------------------
+// Native point-read engine: the whole DBImpl::GetImpl hot chain in one
+// GIL-released call (reference db/db_impl/db_impl.cc:2079 GetImpl →
+// Version::Get → BlockBasedTable::Get, block_based_table_reader.cc:2095).
+// Python registers per-table handles (dup'd fd + in-memory index/filter
+// blocks + key bounds) and per-version handles (L0 list newest-first +
+// sorted deeper levels); tpulsm_db_get then probes memtable skiplists and
+// the SST chain with a shared decompressed-block LRU, returning the value
+// or a FALLBACK code for anything the Python state machine must handle
+// (merge operands, single-delete, blob indexes, range tombstones).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct NTable {
+  int fd = -1;                 // dup'd; owned
+  int64_t file_size = 0;       // bounds every BlockHandle before pread
+  uint64_t number = 0;         // block-cache key namespace
+  int32_t eligible = 0;        // 0 → chain walk returns FALLBACK on contact
+  std::string index;           // uncompressed single-level index block
+  std::string filter;          // whole-key bloom block ("" → no filter)
+  std::string smallest_uk, largest_uk;
+  ~NTable() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+struct NVersion {
+  std::vector<NTable*> l0;                   // newest first
+  std::vector<std::vector<NTable*>> levels;  // levels 1.. sorted by key
+};
+
+// Sharded LRU of decompressed data blocks keyed by (table number, offset).
+struct NBlockCache {
+  struct Entry {
+    std::shared_ptr<std::string> data;
+    std::list<std::pair<uint64_t, uint64_t>>::iterator lru_it;
+  };
+  struct Shard {
+    std::mutex mu;
+    std::unordered_map<uint64_t, Entry> map;
+    std::list<std::pair<uint64_t, uint64_t>> lru;  // front = hottest
+    size_t bytes = 0;
+  };
+  static const int kShards = 16;
+  Shard shards[kShards];
+  std::atomic<size_t> budget{256u << 20};
+  std::atomic<uint64_t> hits{0}, misses{0};
+
+  static uint64_t key_of(uint64_t number, uint64_t off) {
+    // splitmix64 over the pair; the map stores the mixed key. A collision
+    // would serve wrong bytes, so fold BOTH inputs through two rounds.
+    uint64_t x = number * 0x9E3779B97F4A7C15ULL ^ (off + 0xBF58476D1CE4E5B9ULL);
+    x ^= x >> 30; x *= 0xBF58476D1CE4E5B9ULL;
+    x ^= x >> 27; x *= 0x94D049BB133111EBULL;
+    x ^= x >> 31;
+    return x;
+  }
+
+  std::shared_ptr<std::string> lookup(uint64_t number, uint64_t off) {
+    uint64_t k = key_of(number, off);
+    Shard& s = shards[k % kShards];
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.map.find(k);
+    if (it == s.map.end()) {
+      misses.fetch_add(1, std::memory_order_relaxed);
+      return nullptr;
+    }
+    s.lru.splice(s.lru.begin(), s.lru, it->second.lru_it);
+    hits.fetch_add(1, std::memory_order_relaxed);
+    return it->second.data;
+  }
+
+  void insert(uint64_t number, uint64_t off,
+              std::shared_ptr<std::string> data) {
+    uint64_t k = key_of(number, off);
+    Shard& s = shards[k % kShards];
+    size_t per_shard = budget.load(std::memory_order_relaxed) / kShards;
+    std::lock_guard<std::mutex> g(s.mu);
+    if (s.map.count(k)) return;
+    s.bytes += data->size();
+    s.lru.emplace_front(k, (uint64_t)data->size());
+    s.map[k] = Entry{std::move(data), s.lru.begin()};
+    while (s.bytes > per_shard && !s.lru.empty()) {
+      auto victim = s.lru.back();
+      s.lru.pop_back();
+      s.bytes -= victim.second;
+      s.map.erase(victim.first);
+    }
+  }
+};
+
+NBlockCache& nblock_cache() {
+  static NBlockCache c;
+  return c;
+}
+
+// In-block cursor over the restart-compressed entry stream.
+struct BCur {
+  const uint8_t* data;
+  const uint8_t* p;
+  const uint8_t* limit;  // start of restart array
+  uint8_t key[4096];
+  uint32_t klen = 0;
+  const uint8_t* val = nullptr;
+  uint32_t vlen = 0;
+
+  bool init(const uint8_t* d, int64_t len) {
+    if (len < 8) return false;
+    uint32_t nr;
+    std::memcpy(&nr, d + len - 4, 4);
+    int64_t restart_off = len - 4 - 4 * (int64_t)nr;
+    if (nr == 0 || restart_off < 0) return false;
+    data = d;
+    p = d;
+    limit = d + restart_off;
+    klen = 0;
+    return true;
+  }
+
+  bool at_end() const { return p >= limit; }
+
+  // 1 = entry decoded, 0 = end of block, -1 = corrupt OR key too large
+  // for the cursor buffer (callers must FALL BACK, not report a miss — a
+  // legitimate >4KB stored key is not corruption).
+  int next() {
+    if (p >= limit) return 0;
+    uint32_t shared, non_shared, v;
+    p = get_varint32(p, limit, &shared);
+    if (!p) return -1;
+    p = get_varint32(p, limit, &non_shared);
+    if (!p) return -1;
+    p = get_varint32(p, limit, &v);
+    if (!p) return -1;
+    if (shared > klen || non_shared > sizeof(key) - shared) return -1;
+    if (p + non_shared + v > limit) return -1;
+    std::memcpy(key + shared, p, non_shared);
+    klen = shared + non_shared;
+    p += non_shared;
+    val = p;
+    vlen = v;
+    p += v;
+    return 1;
+  }
+};
+
+// Decoded-entry comparator vs target, using the internal-key order helper
+// defined in the block-seek section above.
+inline int bcur_cmp(const BCur& c, const uint8_t* target, int32_t tlen) {
+  return ikey_compare(c.key, (int32_t)c.klen, target, tlen);
+}
+
+// Position cursor at the first entry >= target (restart bsearch + scan).
+// Returns 1 = cursor holds that entry, 0 = every key < target (or empty),
+// -1 = corruption.
+int bcur_seek(BCur& c, const uint8_t* d, int64_t len, const uint8_t* target,
+              int32_t tlen) {
+  if (len < 8) return -1;
+  uint32_t nr;
+  std::memcpy(&nr, d + len - 4, 4);
+  int64_t restart_off = len - 4 - 4 * (int64_t)nr;
+  if (nr == 0 || restart_off < 0) return -1;
+  auto restart_point = [&](uint32_t i) -> uint32_t {
+    uint32_t v;
+    std::memcpy(&v, d + restart_off + 4 * (int64_t)i, 4);
+    return v;
+  };
+  // Find the last restart whose key < target.
+  uint32_t lo = 0, hi = nr - 1;
+  while (lo < hi) {
+    uint32_t mid = (lo + hi + 1) / 2;
+    BCur probe;
+    if (!probe.init(d, len)) return -1;
+    probe.p = d + restart_point(mid);
+    probe.klen = 0;
+    if (probe.next() != 1) return -1;
+    if (bcur_cmp(probe, target, tlen) < 0) {
+      lo = mid;
+    } else {
+      hi = mid - 1;
+    }
+  }
+  if (!c.init(d, len)) return -1;
+  c.p = d + restart_point(lo);
+  c.klen = 0;
+  int nr2;
+  while ((nr2 = c.next()) == 1) {
+    if (bcur_cmp(c, target, tlen) >= 0) return 1;
+  }
+  if (nr2 < 0) return -1;
+  return 0;  // all keys < target
+}
+
+// Whole-key bloom probe: layout varint32 num_bits | 1B k | bits.
+bool nfilter_may_match(const std::string& f, const uint8_t* key,
+                       int32_t klen) {
+  if (f.empty()) return true;
+  const uint8_t* p = (const uint8_t*)f.data();
+  const uint8_t* end = p + f.size();
+  uint32_t num_bits;
+  p = get_varint32(p, end, &num_bits);
+  if (!p || p >= end) return true;
+  uint32_t k = *p++;
+  const uint8_t* bits = p;
+  if (num_bits == 0 || (size_t)(end - bits) * 8 < num_bits) return true;
+  uint64_t h = tpulsm_xxh64(key, (size_t)klen, 0xA0761D64);
+  uint64_t h1 = h;
+  uint64_t h2 = ((h >> 33) | (h << 31)) | 1;
+  for (uint32_t i = 0; i < k; i++) {
+    uint64_t b = (h1 + (uint64_t)i * h2) % num_bits;
+    if (!((bits[b >> 3] >> (b & 7)) & 1)) return false;
+  }
+  return true;
+}
+
+// Per-call read counters surfaced to PerfContext/Statistics (indexes
+// documented at tpulsm_db_get).
+enum {
+  NC_MEMS = 0,
+  NC_BLOOM_MISS = 1,
+  NC_BLOOM_HIT = 2,
+  NC_CACHE_HIT = 3,
+  NC_CACHE_MISS = 4,
+  NC_READ_BYTES = 5,
+  NC_COUNT = 6,
+};
+
+// Fetch + decompress one data block through the shared LRU.
+// nullptr → error (unsupported codec / IO / corruption).
+std::shared_ptr<std::string> nfetch_block(NTable* t, uint64_t off,
+                                          uint64_t size, int64_t* ctr) {
+  // A corrupt index entry must become a Python-path fallback (which
+  // surfaces Corruption), not an OOM abort from resizing to a garbage
+  // varint64 — bound the handle against the file before allocating.
+  if (t->file_size > 0 &&
+      (off > (uint64_t)t->file_size || size + 5 > (uint64_t)t->file_size ||
+       off + size + 5 > (uint64_t)t->file_size))
+    return nullptr;
+  NBlockCache& cache = nblock_cache();
+  auto hit = cache.lookup(t->number, off);
+  if (hit) {
+    ctr[NC_CACHE_HIT]++;
+    return hit;
+  }
+  ctr[NC_CACHE_MISS]++;
+  ctr[NC_READ_BYTES] += (int64_t)size + 5;
+  std::string raw;
+  raw.resize(size + 5);  // payload + type byte + masked crc32c
+  ssize_t got = ::pread(t->fd, &raw[0], size + 5, (off_t)off);
+  if (got != (ssize_t)(size + 5)) return nullptr;
+  uint8_t type = (uint8_t)raw[size];
+  // Verify the masked trailer crc (table/format.py framing) — the Python
+  // read path verifies by default, so the fast path must not be laxer.
+  uint32_t stored;
+  std::memcpy(&stored, raw.data() + size + 1, 4);
+  uint32_t rot = stored - 0xA282EAD8u;
+  uint32_t unmasked = (rot >> 17) | (rot << 15);
+  uint32_t actual =
+      tpulsm_crc32c_extend(0, (const uint8_t*)raw.data(), size + 1);
+  if (unmasked != actual) return nullptr;
+  raw.resize(size + 1);
+  auto out = std::make_shared<std::string>();
+  const Codecs& c = codecs();
+  if (type == 0) {
+    raw.resize(size);
+    *out = std::move(raw);
+  } else if (type == 1) {
+    if (!c.snappy_len || !c.snappy_unc) return nullptr;
+    size_t ulen = 0;
+    if (c.snappy_len(raw.data(), size, &ulen) != 0) return nullptr;
+    out->resize(ulen);
+    if (c.snappy_unc(raw.data(), size, &(*out)[0], &ulen) != 0)
+      return nullptr;
+    out->resize(ulen);
+  } else if (type == 7) {
+    if (!c.zstd_size || !c.zstd_dec) return nullptr;
+    unsigned long long ulen = c.zstd_size(raw.data(), size);
+    if (ulen == 0ULL || ulen + 1 == 0ULL || ulen > (1ull << 31))
+      return nullptr;
+    out->resize((size_t)ulen);
+    size_t r = c.zstd_dec(&(*out)[0], (size_t)ulen, raw.data(), size);
+    if (c.zstd_err && c.zstd_err(r)) return nullptr;
+    out->resize(r);
+  } else {
+    return nullptr;  // dict-compressed or unknown: python path
+  }
+  cache.insert(t->number, off, out);
+  return out;
+}
+
+// rc codes for the probe chain.
+enum { NGET_NOTFOUND = 0, NGET_FOUND = 1, NGET_FALLBACK = 2, NGET_ERR = -1 };
+
+// Probe one table for ukey at snap_seq. Decisive answers only; anything
+// needing the Python state machine returns NGET_FALLBACK. NGET_NOTFOUND
+// here means "not in this table — continue the chain".
+int ntable_get(NTable* t, const uint8_t* ukey, int32_t klen,
+               uint64_t snap_seq, uint8_t* val_out, int32_t val_cap,
+               int32_t* val_len, int* decided, int64_t* ctr) {
+  *decided = 0;
+  if (!t || !t->eligible) return NGET_FALLBACK;
+  if (!t->filter.empty()) {
+    if (!nfilter_may_match(t->filter, ukey, klen)) {
+      ctr[NC_BLOOM_MISS]++;
+      return NGET_NOTFOUND;
+    }
+    ctr[NC_BLOOM_HIT]++;
+  }
+  // Seek target: (ukey, snap_seq, type 0x7F) — highest type sorts first.
+  uint8_t target[4096 + 8];
+  if (klen > 4096) return NGET_FALLBACK;
+  std::memcpy(target, ukey, klen);
+  uint64_t packed = (snap_seq << 8) | 0x7F;
+  for (int i = 0; i < 8; i++) target[klen + i] = (uint8_t)(packed >> (8 * i));
+  int32_t tlen = klen + 8;
+
+  BCur idx;
+  int sr = bcur_seek(idx, (const uint8_t*)t->index.data(),
+                     (int64_t)t->index.size(), target, tlen);
+  if (sr < 0) return NGET_FALLBACK;
+  if (sr == 0) return NGET_NOTFOUND;  // past the last block
+
+  bool first_block = true;
+  while (true) {
+    // idx cursor sits at the candidate block's index entry; its value is
+    // the BlockHandle (varint64 offset, varint64 size).
+    const uint8_t* vp = idx.val;
+    const uint8_t* vend = idx.val + idx.vlen;
+    uint64_t boff, bsize;
+    vp = get_varint64(vp, vend, &boff);
+    if (!vp) return NGET_FALLBACK;
+    vp = get_varint64(vp, vend, &bsize);
+    if (!vp) return NGET_FALLBACK;
+    auto block = nfetch_block(t, boff, bsize, ctr);
+    if (!block) return NGET_FALLBACK;
+    BCur c;
+    const uint8_t* bd = (const uint8_t*)block->data();
+    bool have = false;
+    if (first_block) {
+      int br = bcur_seek(c, bd, (int64_t)block->size(), target, tlen);
+      if (br < 0) return NGET_FALLBACK;
+      have = br == 1;  // br == 0: target past this block's keys — the run
+      first_block = false;  // may continue in the next block
+    } else {
+      if (!c.init(bd, (int64_t)block->size())) return NGET_FALLBACK;
+      int nr = c.next();  // scan continues from the block's first entry
+      if (nr < 0) return NGET_FALLBACK;
+      have = nr == 1;
+    }
+    while (have) {
+      if (c.klen < 8) return NGET_FALLBACK;
+      int32_t cu = (int32_t)c.klen - 8;
+      int m = cu < klen ? cu : klen;
+      int cmp = std::memcmp(c.key, ukey, (size_t)m);
+      if (cmp == 0 && cu != klen) cmp = cu < klen ? -1 : 1;
+      if (cmp > 0) return NGET_NOTFOUND;  // walked past ukey: absent here
+      if (cmp == 0) {
+        uint64_t p2 = 0;
+        for (int i = 0; i < 8; i++)
+          p2 |= (uint64_t)c.key[cu + i] << (8 * i);
+        uint64_t seq = p2 >> 8;
+        uint8_t vt = (uint8_t)(p2 & 0xFF);
+        if (seq <= snap_seq) {
+          if (vt == 0x1) {  // VALUE
+            *decided = 1;
+            if ((int32_t)c.vlen > val_cap) {
+              *val_len = (int32_t)c.vlen;
+              return NGET_ERR;  // caller re-sizes and retries
+            }
+            std::memcpy(val_out, c.val, c.vlen);
+            *val_len = (int32_t)c.vlen;
+            return NGET_FOUND;
+          }
+          if (vt == 0x0) {  // DELETION → definitive miss
+            *decided = 1;
+            return NGET_NOTFOUND;
+          }
+          return NGET_FALLBACK;  // MERGE / SINGLE_DELETE / BLOB_INDEX...
+        }
+      }
+      {
+        int nr = c.next();
+        if (nr < 0) return NGET_FALLBACK;
+        have = nr == 1;
+      }
+    }
+    // Block exhausted without passing ukey: the version run may continue
+    // in the next data block.
+    {
+      int nr = idx.next();
+      if (nr < 0) return NGET_FALLBACK;
+      if (nr == 0) return NGET_NOTFOUND;  // no further blocks
+    }
+  }
+}
+
+int nversion_get(NVersion* v, const uint8_t* ukey, int32_t klen,
+                 uint64_t snap_seq, uint8_t* val_out, int32_t val_cap,
+                 int32_t* val_len, int32_t* src_out, int64_t* ctr) {
+  int decided = 0;
+  for (NTable* t : v->l0) {
+    if (!t) return NGET_FALLBACK;
+    if (!t->smallest_uk.empty() || !t->largest_uk.empty()) {
+      if (std::string_view((const char*)ukey, (size_t)klen)
+              < std::string_view(t->smallest_uk) ||
+          std::string_view(t->largest_uk)
+              < std::string_view((const char*)ukey, (size_t)klen))
+        continue;
+    }
+    int rc = ntable_get(t, ukey, klen, snap_seq, val_out, val_cap, val_len,
+                        &decided, ctr);
+    if (rc == NGET_FOUND || rc == NGET_FALLBACK || rc == NGET_ERR ||
+        (rc == NGET_NOTFOUND && decided)) {
+      *src_out = 1;  // level 0 + 1
+      return rc;
+    }
+  }
+  for (size_t li = 0; li < v->levels.size(); li++) {
+    auto& fl = v->levels[li];
+    if (fl.empty()) continue;
+    std::string_view uk((const char*)ukey, (size_t)klen);
+    // Binary search: first file whose largest >= ukey.
+    size_t lo = 0, hi = fl.size();
+    while (lo < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (!fl[mid]) return NGET_FALLBACK;  // conservatively bail
+      if (std::string_view(fl[mid]->largest_uk) < uk)
+        lo = mid + 1;
+      else
+        hi = mid;
+    }
+    // Mirror files_for_get: subsequent files whose smallest <= ukey are
+    // also candidates (tombstone-widened bounds).
+    for (size_t pick = lo; pick < fl.size(); pick++) {
+      NTable* t = fl[pick];
+      if (!t) return NGET_FALLBACK;
+      if (uk < std::string_view(t->smallest_uk)) break;
+      int rc = ntable_get(t, ukey, klen, snap_seq, val_out, val_cap,
+                          val_len, &decided, ctr);
+      if (rc == NGET_FOUND || rc == NGET_FALLBACK || rc == NGET_ERR ||
+          (rc == NGET_NOTFOUND && decided)) {
+        *src_out = (int32_t)li + 2;
+        return rc;
+      }
+    }
+  }
+  *src_out = -1;
+  return NGET_NOTFOUND;
+}
+
+}  // namespace
+
+void* tpulsm_table_handle_new(int32_t fd, uint64_t number, int32_t eligible,
+                              const uint8_t* index, int64_t index_len,
+                              const uint8_t* filter, int64_t filter_len,
+                              const uint8_t* smallest_uk, int32_t sl,
+                              const uint8_t* largest_uk, int32_t ll) {
+  NTable* t = new (std::nothrow) NTable();
+  if (!t) return nullptr;
+  if (eligible && fd >= 0) {
+    t->fd = ::dup(fd);
+    if (t->fd < 0) {
+      delete t;
+      return nullptr;
+    }
+    off_t end = ::lseek(t->fd, 0, SEEK_END);
+    t->file_size = end > 0 ? (int64_t)end : 0;
+  }
+  t->number = number;
+  t->eligible = eligible && t->fd >= 0;
+  if (index_len > 0) t->index.assign((const char*)index, (size_t)index_len);
+  if (filter_len > 0)
+    t->filter.assign((const char*)filter, (size_t)filter_len);
+  if (sl > 0) t->smallest_uk.assign((const char*)smallest_uk, (size_t)sl);
+  if (ll > 0) t->largest_uk.assign((const char*)largest_uk, (size_t)ll);
+  return t;
+}
+
+void tpulsm_table_handle_free(void* t) { delete static_cast<NTable*>(t); }
+
+// tables: L0 handles (newest first) then levels 1.. concatenated;
+// level_offs[i]..level_offs[i+1] indexes level i+1's slice, with
+// level_offs[0] == n_l0. A null handle marks a python-only table (chain
+// walk returns FALLBACK on contact).
+void* tpulsm_version_handle_new(void** tables, int32_t n_l0,
+                                const int32_t* level_offs,
+                                int32_t n_deeper_levels) {
+  NVersion* v = new (std::nothrow) NVersion();
+  if (!v) return nullptr;
+  for (int32_t i = 0; i < n_l0; i++)
+    v->l0.push_back(static_cast<NTable*>(tables[i]));
+  for (int32_t li = 0; li < n_deeper_levels; li++) {
+    v->levels.emplace_back();
+    for (int32_t i = level_offs[li]; i < level_offs[li + 1]; i++)
+      v->levels.back().push_back(static_cast<NTable*>(tables[i]));
+  }
+  return v;
+}
+
+void tpulsm_version_handle_free(void* v) { delete static_cast<NVersion*>(v); }
+
+void tpulsm_block_cache_config(int64_t bytes, int64_t* out_stats) {
+  NBlockCache& c = nblock_cache();
+  if (bytes > 0) c.budget.store((size_t)bytes, std::memory_order_relaxed);
+  if (out_stats) {
+    out_stats[0] = (int64_t)c.hits.load(std::memory_order_relaxed);
+    out_stats[1] = (int64_t)c.misses.load(std::memory_order_relaxed);
+  }
+}
+
+// Persistent get context: binds (memtables, version, out buffers) once so
+// the per-call ctypes surface shrinks to (ctx, key, klen, seq) — arg
+// marshaling was ~40% of the measured per-get cost. Results land in
+// ctx-owned memory the caller maps once: out[0]=val_len, out[1]=src,
+// out[2..7]=counters (NC_* order).
+struct NGetCtx {
+  std::vector<void*> mems;
+  void* version = nullptr;
+  int64_t out[8];
+  std::vector<uint8_t> val;
+};
+
+void* tpulsm_getctx_new(void** mem_handles, int32_t n_mems, void* version,
+                        int64_t val_cap) {
+  NGetCtx* c = new (std::nothrow) NGetCtx();
+  if (!c) return nullptr;
+  for (int32_t i = 0; i < n_mems; i++) c->mems.push_back(mem_handles[i]);
+  c->version = version;
+  c->val.resize((size_t)(val_cap > 0 ? val_cap : 4096));
+  std::memset(c->out, 0, sizeof(c->out));
+  return c;
+}
+
+void tpulsm_getctx_free(void* ctx) { delete static_cast<NGetCtx*>(ctx); }
+
+int64_t* tpulsm_getctx_out(void* ctx) {
+  return static_cast<NGetCtx*>(ctx)->out;
+}
+
+uint8_t* tpulsm_getctx_val(void* ctx) {
+  return static_cast<NGetCtx*>(ctx)->val.data();
+}
+
+// Forward decl (definition below keeps the original entry point).
+int32_t tpulsm_db_get(void** mem_handles, int32_t n_mems, void* version,
+                      const uint8_t* ukey, int32_t klen, uint64_t snap_seq,
+                      uint8_t* val_out, int32_t val_cap, int32_t* val_len,
+                      int32_t* src_out, int64_t* counters);
+
+int32_t tpulsm_getctx_get(void* ctx, const uint8_t* ukey, int32_t klen,
+                          uint64_t snap_seq) {
+  NGetCtx* c = static_cast<NGetCtx*>(ctx);
+  int32_t vlen = 0, src = -1;
+  int32_t rc = tpulsm_db_get(
+      c->mems.data(), (int32_t)c->mems.size(), c->version, ukey, klen,
+      snap_seq, c->val.data(), (int32_t)c->val.size(), &vlen, &src,
+      c->out + 2);
+  if (rc == -1 && vlen > (int32_t)c->val.size()) {
+    // Value outgrew the buffer: grow and retry — the caller detects
+    // out[0] > its mapped capacity and re-maps tpulsm_getctx_val().
+    c->val.resize((size_t)vlen + 1024);
+    rc = tpulsm_db_get(
+        c->mems.data(), (int32_t)c->mems.size(), c->version, ukey, klen,
+        snap_seq, c->val.data(), (int32_t)c->val.size(), &vlen, &src,
+        c->out + 2);
+  }
+  c->out[0] = vlen;
+  c->out[1] = src;
+  return rc;
+}
+
+// Batched lookups against a get context — the reference's MultiGet role
+// (db_impl.cc:3026-3227): one GIL-released call for the whole batch, each
+// key running the full chain. status_out[i]: 1 found, 0 not found,
+// 2 fallback-to-python (resolve that key on the Python path). Values pack
+// into val_arena at val_offs_out/val_lens_out. Returns 0 ok, -2 arena too
+// small (caller grows + retries). Counters accumulate across keys.
+int32_t tpulsm_getctx_multiget(void* ctx, const uint8_t* keybuf,
+                               const int64_t* key_offs,
+                               const int32_t* key_lens, int64_t n,
+                               uint64_t snap_seq, int8_t* status_out,
+                               int64_t* val_offs_out, int64_t* val_lens_out,
+                               uint8_t* val_arena, int64_t arena_cap,
+                               int64_t* arena_used, int64_t* counters) {
+  NGetCtx* c = static_cast<NGetCtx*>(ctx);
+  for (int i = 0; i < NC_COUNT; i++) counters[i] = 0;
+  int64_t used = 0;
+  int64_t tmp_ctr[NC_COUNT];
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* k = keybuf + key_offs[i];
+    int32_t kl = key_lens[i];
+    int32_t vlen = 0, src = -1;
+    int32_t rc = tpulsm_db_get(
+        c->mems.data(), (int32_t)c->mems.size(), c->version, k, kl,
+        snap_seq, val_arena + used,
+        (int32_t)std::min<int64_t>(arena_cap - used, (1u << 31) - 1),
+        &vlen, &src, tmp_ctr);
+    for (int t = 0; t < NC_COUNT; t++) counters[t] += tmp_ctr[t];
+    if (rc == -1) return -2;  // arena exhausted: grow + retry whole batch
+    if (rc == 1) {
+      status_out[i] = 1;
+      val_offs_out[i] = used;
+      val_lens_out[i] = vlen;
+      used += vlen;
+    } else if (rc == 0) {
+      status_out[i] = 0;
+      val_offs_out[i] = 0;
+      val_lens_out[i] = 0;
+    } else {
+      status_out[i] = 2;
+      val_offs_out[i] = 0;
+      val_lens_out[i] = 0;
+    }
+  }
+  *arena_used = used;
+  return 0;
+}
+
+// The full read chain: memtable skiplists (newest first), then the SST
+// version. Returns 1 found (value in val_out, *val_len set), 0 not found,
+// 2 fallback-to-python, -1 val_cap too small (*val_len = needed size).
+// src_out: 0 = memtable, 1 = L0, n>=2 = level n-1, -1 = nothing.
+// counters: int64[6] = {memtables probed, bloom useful (filtered out),
+// bloom consulted-and-passed, block-cache hits, block-cache misses (device
+// preads), bytes read from disk}. Always written.
+int32_t tpulsm_db_get(void** mem_handles, int32_t n_mems, void* version,
+                      const uint8_t* ukey, int32_t klen, uint64_t snap_seq,
+                      uint8_t* val_out, int32_t val_cap, int32_t* val_len,
+                      int32_t* src_out, int64_t* counters) {
+  *src_out = -1;
+  for (int i = 0; i < NC_COUNT; i++) counters[i] = 0;
+  if (klen > 4096) return NGET_FALLBACK;
+  for (int32_t m = 0; m < n_mems; m++) {
+    counters[NC_MEMS]++;
+    SkipList* sl = static_cast<SkipList*>(mem_handles[m]);
+    uint64_t packed = (snap_seq << 8) | 0x7F;
+    uint64_t inv = ~packed;
+    SLNode* n = sl->seek_ge(ukey, (uint32_t)klen, inv, nullptr);
+    if (!n || n->key_len != (uint32_t)klen ||
+        std::memcmp(n->key, ukey, (size_t)klen) != 0)
+      continue;
+    uint64_t p2 = ~n->inv_packed;
+    uint8_t vt = (uint8_t)(p2 & 0xFF);
+    *src_out = 0;
+    if (vt == 0x1) {
+      const uint8_t* rec = n->val.load(std::memory_order_acquire);
+      uint32_t vl;
+      std::memcpy(&vl, rec, 4);
+      if ((int32_t)vl > val_cap) {
+        *val_len = (int32_t)vl;
+        return -1;
+      }
+      std::memcpy(val_out, rec + 4, vl);
+      *val_len = (int32_t)vl;
+      return NGET_FOUND;
+    }
+    if (vt == 0x0 || vt == 0x7) return NGET_NOTFOUND;  // (single-)delete
+    return NGET_FALLBACK;  // merge / blob / anything else
+  }
+  if (!version) return NGET_NOTFOUND;
+  return nversion_get(static_cast<NVersion*>(version), ukey, klen, snap_seq,
+                      val_out, val_cap, val_len, src_out, counters);
 }
 
 }  // extern "C"

@@ -25,6 +25,11 @@ from toplingdb_tpu.table.filter import filter_policy_from_name, filter_probe
 from toplingdb_tpu.table.properties import TableProperties
 
 
+import itertools as _it
+
+_NGET_ID = _it.count(1)  # atomic process-global cache-namespace allocator
+
+
 class TableReader:
     def __init__(self, rfile, icmp: InternalKeyComparator, options: TableOptions | None = None,
                  block_cache=None, cache_key_prefix: bytes = b""):
@@ -110,6 +115,70 @@ class TableReader:
             "tpulsm.BytewiseComparator", "tpulsm.BytewiseComparator.u64ts")
 
     # ------------------------------------------------------------------
+
+    def native_get_handle(self, smallest_uk: bytes, largest_uk: bytes):
+        """Handle for the native point-read engine (tpulsm_db_get), built
+        lazily and owned by this reader (freed at GC; the native side dups
+        the fd, so reader close doesn't invalidate it). Ineligible tables
+        (partitioned index/filter, range tombstones, dict compression,
+        non-posix file, non-bytewise comparator) get an eligible=0 handle:
+        the chain walk returns FALLBACK on contact, keeping the Python
+        state machine authoritative for everything it must see."""
+        h = getattr(self, "_nget_handle", False)
+        if h is not False:
+            return h
+        import ctypes
+        import weakref
+
+        from toplingdb_tpu import native
+
+        cl = native.lib()
+        if cl is None or not hasattr(cl, "tpulsm_table_handle_new"):
+            self._nget_handle = None
+            return None
+        fd = -1
+        try:
+            fd = self._f._f.fileno()  # posix random-access file only
+        except AttributeError:
+            fd = -1
+        eligible = (
+            fd >= 0
+            and not self._partitioned_index
+            and self._filter_top is None
+            and self._range_del_data is None
+            and not self._compression_dict
+            and self._icmp.user_comparator.name()
+            == "tpulsm.BytewiseComparator"
+        )
+        filt = b""
+        if (eligible and self._filter_data is not None
+                and self.properties.whole_key_filtering
+                and str(self.properties.filter_policy_name).startswith(
+                    "tpulsm.BloomFilter")):
+            filt = self._filter_data
+        idx = self._index_data if eligible else b""
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+
+        def buf(b):
+            return ctypes.cast(ctypes.c_char_p(bytes(b)), u8)
+
+        # Cache-key namespace: a process-global id, NOT the file number —
+        # the native block cache is process-wide and two DBs' file numbers
+        # collide (the Python block cache solves this with a per-open
+        # session prefix; a fresh id per handle is the same guarantee).
+        h = cl.tpulsm_table_handle_new(
+            fd if eligible else -1,
+            next(_NGET_ID),
+            1 if eligible else 0,
+            buf(idx), len(idx), buf(filt), len(filt),
+            buf(smallest_uk), len(smallest_uk),
+            buf(largest_uk), len(largest_uk),
+        )
+        h = h or None
+        self._nget_handle = h
+        if h:
+            weakref.finalize(self, cl.tpulsm_table_handle_free, h)
+        return h
 
     def close(self) -> None:
         self._f.close()

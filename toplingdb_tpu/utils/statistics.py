@@ -361,9 +361,10 @@ class PerfContext:
         return {f: getattr(self, f) for f in self._FIELDS}
 
 
-# PerfContext collection level (reference SetPerfLevel): 0 = disabled,
-# 1 = count-only (default), 2+ = reserved for timed fields.
-perf_level = 1
+# PerfContext collection level (reference SetPerfLevel): 0 = disabled
+# (the default, matching the reference's PerfLevel::kDisable), 1 =
+# count-only, 2+ = reserved for timed fields.
+perf_level = 0
 
 _perf_tls = threading.local()
 
