@@ -56,3 +56,47 @@ def test_prefix_kernel_random():
 def test_prefix_kernel_single_and_empty():
     m, lens = to_matrix([b"solo"])
     assert shared_prefix_lengths(m, lens).tolist() == [0]
+
+
+def test_gc_rows_matches_lax_mask():
+    """pallas_kernels.gc_rows (interpret mode on CPU) must agree with the
+    lax formulation of stripe / first-in-stripe / tombstone shadowing /
+    complex flags for random sorted streams with snapshots+tombstones."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from toplingdb_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(5)
+    n, s = 2048, 64
+    seq = np.sort(rng.integers(0, 1 << 40, n).astype(np.uint64))[::-1]
+    snaps = np.sort(rng.integers(0, 1 << 40, 5).astype(np.uint64))
+    snap_pad = np.full(s, 1 << 56, np.uint64)
+    snap_pad[:5] = snaps
+    tomb = np.where(rng.random(n) < 0.3,
+                    rng.integers(0, 1 << 40, n).astype(np.uint64),
+                    np.uint64(0))
+    vtype = rng.choice([0, 1, 2, 7], n).astype(np.int32)
+    new_key = rng.random(n) < 0.4
+    new_key[0] = True
+
+    hi = lambda x: (x >> np.uint64(32)).astype(np.uint32)
+    lo = lambda x: (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    pseq = np.roll(seq, 1)
+    stripe, fis, covered, cx = pk.gc_rows(
+        jnp.asarray(hi(seq)), jnp.asarray(lo(seq)),
+        jnp.asarray(hi(pseq)), jnp.asarray(lo(pseq)),
+        jnp.asarray(new_key), jnp.asarray(hi(tomb)), jnp.asarray(lo(tomb)),
+        jnp.asarray(vtype), jnp.asarray(hi(snap_pad)),
+        jnp.asarray(lo(snap_pad)), interpret=True,
+    )
+    # numpy reference
+    want_stripe = np.searchsorted(snap_pad, seq, side="left")
+    want_fis = new_key | (want_stripe != np.roll(want_stripe, 1))
+    tomb_stripe = np.searchsorted(snap_pad, tomb, side="left")
+    want_cov = (tomb != 0) & (tomb > seq) & (tomb_stripe == want_stripe)
+    want_cx = (vtype == 2) | (vtype == 7)
+    assert np.array_equal(np.asarray(stripe), want_stripe)
+    assert np.array_equal(np.asarray(fis) | new_key, want_fis | new_key)
+    assert np.array_equal(np.asarray(covered), want_cov)
+    assert np.array_equal(np.asarray(cx), want_cx)

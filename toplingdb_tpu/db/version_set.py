@@ -81,6 +81,13 @@ class Version:
         if cl is None or not hasattr(cl, "tpulsm_version_handle_new"):
             self._nchain = None
             return None
+        n_files = sum(len(fl) for fl in self.files)
+        if n_files > getattr(table_cache, "_capacity", 512):
+            # The chain pins a reader ref + a dup'd fd per file for the
+            # version's lifetime; past the table cache's open-file budget
+            # that would defeat its eviction contract.
+            self._nchain = None
+            return None
         readers, handles = [], []
         level_offs = []
         try:

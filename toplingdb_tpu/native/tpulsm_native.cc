@@ -1550,6 +1550,7 @@ struct NVersion {
 struct NBlockCache {
   struct Entry {
     std::shared_ptr<std::string> data;
+    uint64_t number, off;  // full key: a mixed-hash collision must MISS
     std::list<std::pair<uint64_t, uint64_t>>::iterator lru_it;
   };
   struct Shard {
@@ -1578,7 +1579,8 @@ struct NBlockCache {
     Shard& s = shards[k % kShards];
     std::lock_guard<std::mutex> g(s.mu);
     auto it = s.map.find(k);
-    if (it == s.map.end()) {
+    if (it == s.map.end() || it->second.number != number ||
+        it->second.off != off) {
       misses.fetch_add(1, std::memory_order_relaxed);
       return nullptr;
     }
@@ -1596,7 +1598,7 @@ struct NBlockCache {
     if (s.map.count(k)) return;
     s.bytes += data->size();
     s.lru.emplace_front(k, (uint64_t)data->size());
-    s.map[k] = Entry{std::move(data), s.lru.begin()};
+    s.map[k] = Entry{std::move(data), number, off, s.lru.begin()};
     while (s.bytes > per_shard && !s.lru.empty()) {
       auto victim = s.lru.back();
       s.lru.pop_back();
@@ -1746,11 +1748,11 @@ enum {
 std::shared_ptr<std::string> nfetch_block(NTable* t, uint64_t off,
                                           uint64_t size, int64_t* ctr) {
   // A corrupt index entry must become a Python-path fallback (which
-  // surfaces Corruption), not an OOM abort from resizing to a garbage
-  // varint64 — bound the handle against the file before allocating.
-  if (t->file_size > 0 &&
-      (off > (uint64_t)t->file_size || size + 5 > (uint64_t)t->file_size ||
-       off + size + 5 > (uint64_t)t->file_size))
+  // surfaces Corruption), not an OOM abort or a wrapped-arithmetic OOB
+  // read — bound the handle against the file with non-wrapping checks.
+  if (t->file_size <= 0) return nullptr;
+  uint64_t fsz = (uint64_t)t->file_size;
+  if (size > fsz || 5 > fsz - size || off > fsz - size - 5)
     return nullptr;
   NBlockCache& cache = nblock_cache();
   auto hit = cache.lookup(t->number, off);

@@ -81,6 +81,19 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _want_pallas_gc() -> bool:
+    """Use the Pallas GC-row kernel inside _gc_mask_impl. Decided at TRACE
+    time (the jit cache does not key on this): default ON for accelerator
+    backends, OFF on cpu (where interpret mode would crawl);
+    TPULSM_PALLAS_GC=1/0 forces. Flip the env var before first use."""
+    import os
+
+    env = os.environ.get("TPULSM_PALLAS_GC", "")
+    if env in ("0", "1"):
+        return env == "1"
+    return jax.default_backend() != "cpu"
+
+
 def pad_columns(col) -> dict:
     """Pad a ColumnarEntries to the next power of two. Sentinel rows sort
     last (int32 max keys) and carry vtype=-1."""
@@ -139,8 +152,80 @@ def device_sort(padded: dict):
 
 
 # ---------------------------------------------------------------------------
-# GC mask
+# Segmented merge of presorted runs
+#
+# The inputs of a compaction are ALREADY sorted runs (one per input SST
+# slice); a full lax.sort re-derives that order with O(N log^2 N)
+# compare-exchange stages. The reference merges K runs with a binary heap
+# (table/merging_iterator.cc:476-506, util/heap.h:43) — O(N log K). The
+# TPU-honest equivalent: hierarchical pairwise RANK merges. Each round
+# merges run pairs by computing every row's rank in its partner run with a
+# vectorized binary search (static ~log2(P) trip count, lexicographic
+# folded compare over the key columns), then applies the resulting
+# permutation — log2(R) rounds total, O(N log R log P) compares instead of
+# the sort network, and the non-key columns move once per round instead of
+# once per stage.
 # ---------------------------------------------------------------------------
+
+
+def _rows_less(cols, ai, bi):
+    """Lexicographic a < b over priority-ordered int32 column tuples,
+    folded from the least-significant column up (no data-dependent
+    control flow)."""
+    lt = jnp.zeros(ai.shape, dtype=bool)
+    for c in reversed(cols):
+        a = c[ai]
+        b = c[bi]
+        lt = (a < b) | ((a == b) & lt)
+    return lt
+
+
+def _partner_bound(cols, probe_idx, lo0, hi0, strict, steps):
+    """Vectorized binary search: for each probe row, the insertion point in
+    its partner run [lo0, hi0) — lower bound when strict (run[mid] < probe
+    moves right), upper bound otherwise (run[mid] <= probe moves right)."""
+    lo, hi = lo0, hi0
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        midc = jnp.clip(mid, 0, cols[0].shape[0] - 1)
+        if strict:
+            right = _rows_less(cols, midc, probe_idx)
+        else:
+            right = ~_rows_less(cols, probe_idx, midc)
+        open_ = lo < hi
+        lo = jnp.where(open_ & right, mid + 1, lo)
+        hi = jnp.where(open_ & ~right, mid, hi)
+    return lo
+
+
+def _merge_runs_perm(cols, run_starts, n_rounds):
+    """Permutation (new row -> old row) realizing the merge of the R
+    presorted runs bounded by run_starts ([R+1] int32, R a power of two,
+    empty runs allowed). `cols`: priority-ordered int32 key columns.
+    Stability: ties place even-run rows before their odd partner's."""
+    p = cols[0].shape[0]
+    steps = max(1, p.bit_length())
+    iota = jnp.arange(p, dtype=jnp.int32)
+    perm = iota
+    starts = run_starts
+    for _ in range(n_rounds):
+        c = tuple(col[perm] for col in cols)
+        r = jnp.searchsorted(starts, iota, side="right").astype(
+            jnp.int32) - 1
+        partner = r ^ 1
+        pc = jnp.clip(partner, 0, starts.shape[0] - 2)
+        lo_p = starts[pc]
+        hi_p = starts[pc + 1]
+        even = (r & 1) == 0
+        lb = _partner_bound(c, iota, lo_p, hi_p, True, steps)
+        ub = _partner_bound(c, iota, lo_p, hi_p, False, steps)
+        bound = jnp.where(even, lb, ub)
+        base = starts[jnp.clip(r & ~1, 0, starts.shape[0] - 2)]
+        new_pos = base + (iota - starts[r]) + (bound - lo_p)
+        inv_round = jnp.zeros(p, dtype=jnp.int32).at[new_pos].set(iota)
+        perm = perm[inv_round]
+        starts = starts[::2]
+    return perm
 
 
 @functools.partial(jax.jit, static_argnames=("num_key_words", "bottommost"))
@@ -169,27 +254,39 @@ def _gc_mask_impl(key_words, key_len, inv_hi, inv_lo, vtype,
     seq_hi = packed_hi >> 8                                   # top 24 bits
     seq_lo = (packed_hi << 24) | (packed_lo >> 8)             # low 32 bits
 
-    # --- snapshot stripe: count of snapshots strictly below seq ---
-    # snap arrays are sorted ascending, padded with 2^56 (never < any seq).
-    s_hi = snap_hi[None, :]
-    s_lo = snap_lo[None, :]
-    e_hi = seq_hi[:, None]
-    e_lo = seq_lo[:, None]
-    snap_lt = (s_hi < e_hi) | ((s_hi == e_hi) & (s_lo < e_lo))
-    stripe = jnp.sum(snap_lt, axis=1).astype(jnp.int32)
+    if _want_pallas_gc() and n % 1024 == 0 and tomb_hi.shape[0] == n:
+        # Pallas VPU kernel for the per-row mask core (stripe /
+        # first-in-stripe / tombstone shadowing / complex flag); the
+        # group-complex segment reduction below stays in lax.
+        from toplingdb_tpu.ops import pallas_kernels as _pk
 
-    # --- first-in-(group, stripe): the only candidate survivor ---
-    prev_stripe = jnp.roll(stripe, 1)
-    first_in_stripe = new_key | (stripe != prev_stripe)
+        stripe, first_in_stripe, covered, is_complex = _pk.gc_rows(
+            seq_hi, seq_lo, jnp.roll(seq_hi, 1), jnp.roll(seq_lo, 1),
+            new_key, tomb_hi, tomb_lo, vtype, snap_hi, snap_lo,
+        )
+        first_in_stripe = first_in_stripe | new_key
+    else:
+        # --- snapshot stripe: count of snapshots strictly below seq ---
+        # snap arrays sorted ascending, padded with 2^56 (never < any seq).
+        s_hi = snap_hi[None, :]
+        s_lo = snap_lo[None, :]
+        e_hi = seq_hi[:, None]
+        e_lo = seq_lo[:, None]
+        snap_lt = (s_hi < e_hi) | ((s_hi == e_hi) & (s_lo < e_lo))
+        stripe = jnp.sum(snap_lt, axis=1).astype(jnp.int32)
 
-    # --- tombstone coverage (same-stripe shadowing) ---
-    covered = _tomb_covered(seq_hi, seq_lo, tomb_hi, tomb_lo,
-                            snap_hi, snap_lo, stripe)
+        # --- first-in-(group, stripe): the only candidate survivor ---
+        prev_stripe = jnp.roll(stripe, 1)
+        first_in_stripe = new_key | (stripe != prev_stripe)
 
-    # --- complex groups: contain MERGE or SINGLE_DELETION → host resolves ---
-    is_complex = (vtype == int(ValueType.MERGE)) | (
-        vtype == int(ValueType.SINGLE_DELETION)
-    )
+        # --- tombstone coverage (same-stripe shadowing) ---
+        covered = _tomb_covered(seq_hi, seq_lo, tomb_hi, tomb_lo,
+                                snap_hi, snap_lo, stripe)
+
+        # --- complex groups: MERGE or SINGLE_DELETION → host resolves ---
+        is_complex = (vtype == int(ValueType.MERGE)) | (
+            vtype == int(ValueType.SINGLE_DELETION)
+        )
     group_complex = jax.ops.segment_max(
         is_complex.astype(jnp.int32), group_id, num_segments=n,
         indices_are_sorted=True,
@@ -540,12 +637,17 @@ MAX_SHARD_ROWS = 1 << 22
 
 def _uniform_shard_core(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
                         snap_hi, snap_lo, total, num_key_words, uk_len,
-                        bottommost, has_tombs):
+                        bottommost, has_tombs, run_starts=None,
+                        merge_mode="sort"):
     """Shared traced core of the uniform-shard kernels: [p, uk_len] u8 key
     matrix in → sort + GC. Returns a dict of per-SORTED-row arrays
     (perm, out, zero_seq, host_resolve, take) plus per-ORIGINAL-row
     packed trailer words, for the packed-download and block-assembly
-    tails to consume."""
+    tails to consume.
+
+    merge_mode (static): "sort" = full lax.sort; "merge" = segmented merge
+    of the presorted runs bounded by run_starts; "skip" = input is one
+    presorted run (pads trailing) — no reorder at all."""
     u32 = jnp.uint32
     int32max = jnp.int32(2**31 - 1)
     sign = u32(_SIGN)
@@ -583,9 +685,26 @@ def _uniform_shard_core(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
     vtype = jnp.where(valid, vt0.astype(jnp.int32), -1)
     key_len = jnp.where(valid, jnp.int32(uk_len), int32max)
 
-    kw, kl, ih, il, vt, perm = _sort_impl(
-        key_words, key_len, inv_hi, inv_lo, vtype, iota, num_key_words,
-    )
+    if merge_mode == "skip":
+        # One presorted run (+ trailing pads): already in output order.
+        perm = iota
+        kw, kl, ih, il, vt = key_words, key_len, inv_hi, inv_lo, vtype
+    elif merge_mode == "merge":
+        cols = tuple(
+            key_words[:, j] for j in range(num_key_words)
+        ) + (key_len, inv_hi, inv_lo)
+        n_runs = run_starts.shape[0] - 1
+        n_rounds = max(0, n_runs.bit_length() - 1)
+        perm = _merge_runs_perm(cols, run_starts, n_rounds)
+        kw = key_words[perm]
+        kl = key_len[perm]
+        ih = inv_hi[perm]
+        il = inv_lo[perm]
+        vt = vtype[perm]
+    else:
+        kw, kl, ih, il, vt, perm = _sort_impl(
+            key_words, key_len, inv_hi, inv_lo, vtype, iota, num_key_words,
+        )
     if has_tombs:
         th = tomb_hi[perm]
         tl = tomb_lo[perm]
@@ -608,14 +727,15 @@ def _uniform_shard_core(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
 
 def _uniform_shard_tail(kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
                         snap_hi, snap_lo, total, num_key_words, uk_len,
-                        bottommost, has_tombs):
+                        bottommost, has_tombs, run_starts=None,
+                        merge_mode="sort"):
     """Packed-download tail: [p, uk_len] u8 key matrix in → packed survivor
     byte-planes out (see _fused_uniform_shard_impl for the contract)."""
     u32 = jnp.uint32
     core = _uniform_shard_core(
         kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
         snap_hi, snap_lo, total, num_key_words, uk_len, bottommost,
-        has_tombs,
+        has_tombs, run_starts=run_starts, merge_mode=merge_mode,
     )
     take = core["take"]
     po = (
@@ -655,12 +775,14 @@ def _decode_front_coded(plens, sfx, uk_len):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_key_words", "uk_len", "bottommost", "has_tombs"),
+    static_argnames=("num_key_words", "uk_len", "bottommost", "has_tombs",
+                     "merge_mode"),
 )
 def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
                               tomb_hi, tomb_lo,
                               snap_hi, snap_lo, total, num_key_words, uk_len,
-                              bottommost, has_tombs):
+                              bottommost, has_tombs, run_starts=None,
+                              merge_mode="sort"):
     """ONE range-shard's encode+sort+GC over ONE uploaded buffer pair:
     `ukb` = trailer-stripped user-key bytes of every chunk packed
     contiguously (padded rows zero), `pkb` = one uint32 per row
@@ -679,18 +801,20 @@ def _fused_uniform_shard_impl(ukb, pkb, starts, min_his, min_los,
     return _uniform_shard_tail(
         kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
         snap_hi, snap_lo, total, num_key_words, uk_len, bottommost,
-        has_tombs,
+        has_tombs, run_starts=run_starts, merge_mode=merge_mode,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_key_words", "uk_len", "bottommost", "has_tombs"),
+    static_argnames=("num_key_words", "uk_len", "bottommost", "has_tombs",
+                     "merge_mode"),
 )
 def _fused_uniform_shard_fc_impl(plens, sfx, pkb, starts, min_his, min_los,
                                  tomb_hi, tomb_lo, snap_hi, snap_lo, total,
                                  num_key_words, uk_len, bottommost,
-                                 has_tombs):
+                                 has_tombs, run_starts=None,
+                                 merge_mode="sort"):
     """Front-coded variant of _fused_uniform_shard_impl: instead of the full
     [p, uk_len] key bytes, the host uploads per-row shared-prefix lengths
     (`plens` u8, 0 at chunk starts) + the concatenated suffix bytes
@@ -702,7 +826,7 @@ def _fused_uniform_shard_fc_impl(plens, sfx, pkb, starts, min_his, min_los,
     return _uniform_shard_tail(
         kb, pkb, starts, min_his, min_los, tomb_hi, tomb_lo,
         snap_hi, snap_lo, total, num_key_words, uk_len, bottommost,
-        has_tombs,
+        has_tombs, run_starts=run_starts, merge_mode=merge_mode,
     )
 
 
@@ -812,6 +936,15 @@ def upload_uniform_shard(chunks, covers=None, front_code=None):
     min_los = np.zeros(nc, dtype=np.uint32)
     min_his[: len(ns)] = (mins >> np.uint64(32)).astype(np.uint32)
     min_los[: len(ns)] = (mins & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    # Segmented-merge run boundaries: each chunk is one presorted run,
+    # the padding rows form a final sorted run, empty runs pad the count
+    # to a power of two (the merge does log2(R) pairwise rounds).
+    n_chunks = len(ns)
+    real_runs = n_chunks + (1 if p > total else 0)
+    rr = _next_pow2(max(1, real_runs))
+    run_starts = np.full(rr + 1, p, dtype=np.int32)
+    run_starts[:n_chunks] = np.cumsum([0] + list(ns[:-1]), dtype=np.int64)
+    run_starts[n_chunks] = total
     h = {
         "pkb": jax.device_put(pkb), "total": total,
         "starts": jax.device_put(starts),
@@ -819,6 +952,8 @@ def upload_uniform_shard(chunks, covers=None, front_code=None):
         "min_los": jax.device_put(min_los), "uk_len": uk_len,
         "tomb_hi": jax.device_put(tomb_hi) if has_tombs else None,
         "tomb_lo": jax.device_put(tomb_lo) if has_tombs else None,
+        "n_chunks": n_chunks,
+        "run_starts": jax.device_put(run_starts),
     }
     if front_code:
         sfx = (np.concatenate(sfx_parts) if sfx_parts
@@ -832,6 +967,29 @@ def upload_uniform_shard(chunks, covers=None, front_code=None):
     else:
         h["ukb"] = jax.device_put(ukb)
     return h
+
+
+def shard_merge_mode(handle):
+    """Pick the reorder strategy for one uploaded shard: "skip" when the
+    whole shard is a single presorted chunk (no reorder at all), the
+    segmented merge when run boundaries are available AND the backend is
+    an accelerator, else the full lax.sort. Rationale: on TPU, lax.sort
+    lowers to an O(log^2 N)-stage bitonic network that moves every operand
+    per stage, so the O(log R · log N) rank-merge wins; on the CPU backend
+    XLA's sort is already a sequential O(N log N) sort that beats the
+    merge's gather-heavy rounds. TPULSM_DEVICE_MERGE=1/0 forces the choice
+    either way. Returns (mode, run_starts)."""
+    import os
+
+    rs = handle.get("run_starts")
+    env = os.environ.get("TPULSM_DEVICE_MERGE", "")
+    if rs is None or env == "0":
+        return "sort", None
+    if handle.get("n_chunks", 0) == 1:
+        return "skip", None
+    if env != "1" and jax.default_backend() == "cpu":
+        return "sort", None
+    return "merge", rs
 
 
 def fused_uniform_shard_start(handle, snapshots: list[int], bottommost: bool):
@@ -849,17 +1007,20 @@ def fused_uniform_shard_start(handle, snapshots: list[int], bottommost: bool):
     has_tombs = h["tomb_hi"] is not None
     t_hi = h["tomb_hi"] if has_tombs else np.zeros(1, dtype=np.uint32)
     t_lo = h["tomb_lo"] if has_tombs else np.zeros(1, dtype=np.uint32)
+    merge_mode, run_starts = shard_merge_mode(h)
     if "plens" in h:
         out = _fused_uniform_shard_fc_impl(
             h["plens"], h["sfx"], h["pkb"], h["starts"], h["min_his"],
             h["min_los"], t_hi, t_lo, snap_hi, snap_lo,
             np.int32(h["total"]), w, uk_len, bool(bottommost), has_tombs,
+            run_starts=run_starts, merge_mode=merge_mode,
         )
     else:
         out = _fused_uniform_shard_impl(
             h["ukb"], h["pkb"], h["starts"], h["min_his"], h["min_los"],
             t_hi, t_lo, snap_hi, snap_lo,
             np.int32(h["total"]), w, uk_len, bool(bottommost), has_tombs,
+            run_starts=run_starts, merge_mode=merge_mode,
         )
     for a in out:
         if hasattr(a, "copy_to_host_async"):

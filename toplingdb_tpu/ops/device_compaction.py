@@ -809,6 +809,34 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
             )
         except _FallbackToEntries:
             pass
+        except Exception as e:  # noqa: BLE001
+            # A compiled-kernel failure on the real chip (e.g. a Mosaic
+            # lowering gap in an optional kernel) must degrade to the
+            # CONSERVATIVE device kernels — not lose the device data
+            # plane to the scheduler's run-local fallback. One retry
+            # with the optional kernels disabled and trace caches
+            # cleared (the kernel-choice env vars read at trace time).
+            if os.environ.get("TPULSM_PALLAS_GC") == "0" \
+                    and os.environ.get("TPULSM_DEVICE_MERGE") == "0":
+                raise
+            import sys as _sys
+
+            print(f"device columnar path failed ({e!r:.200}); retrying "
+                  "with conservative kernels", file=_sys.stderr, flush=True)
+            os.environ["TPULSM_PALLAS_GC"] = "0"
+            os.environ["TPULSM_DEVICE_MERGE"] = "0"
+            import jax as _jax
+
+            _jax.clear_caches()
+            try:
+                return _run_device_compaction_columnar(
+                    env, dbname, icmp, compaction, table_cache,
+                    table_options, snapshots, merge_operator,
+                    new_file_number, creation_time, device_name,
+                    column_family, blob_resolver=blob_resolver,
+                )
+            except _FallbackToEntries:
+                pass
     t0 = time.time()
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()

@@ -57,6 +57,95 @@ def _shared_prefix_impl(keys, prev, interpret):
     )(keys, prev)
 
 
+def _gc_rows_kernel(seq_hi_ref, seq_lo_ref, pseq_hi_ref, pseq_lo_ref,
+                    new_key_ref, tomb_hi_ref, tomb_lo_ref, vtype_ref,
+                    snap_hi_ref, snap_lo_ref,
+                    stripe_ref, fis_ref, covered_ref, cx_ref):
+    """Per-row MVCC GC mask core (reference CompactionIterator::
+    NextFromInput's visibility decisions, compaction_iterator.cc:475):
+    snapshot stripe via a [B, S] broadcast compare against the resident
+    snapshot words, first-in-stripe from the previous row's stripe, and
+    same-stripe range-tombstone shadowing. All u32 compares run as two
+    i32 word compares on the VPU; the group-complex propagation (a
+    segment reduction across arbitrary spans) stays in lax."""
+    i32 = jnp.int32
+    # Signed-compare trick: XOR the sign bit so i32 < == u32 <.
+    sign = jnp.int32(-0x80000000)
+    sh = seq_hi_ref[:] ^ sign      # [B, 1]
+    sl = seq_lo_ref[:] ^ sign
+    ph = pseq_hi_ref[:] ^ sign
+    pl_ = pseq_lo_ref[:] ^ sign
+    th = tomb_hi_ref[:] ^ sign
+    tl = tomb_lo_ref[:] ^ sign
+    nh = snap_hi_ref[:] ^ sign     # [1, S]
+    nl = snap_lo_ref[:] ^ sign
+
+    def stripe_of(hi, lo):
+        lt = (nh < hi) | ((nh == hi) & (nl < lo))
+        return jnp.sum(lt.astype(i32), axis=1, keepdims=True)
+
+    stripe = stripe_of(sh, sl)
+    pstripe = stripe_of(ph, pl_)
+    tstripe = stripe_of(th, tl)
+    has_tomb = (tomb_hi_ref[:] | tomb_lo_ref[:]) != 0
+    tomb_newer = (th > sh) | ((th == sh) & (tl > sl))
+    covered = has_tomb & tomb_newer & (tstripe == stripe)
+    fis = (new_key_ref[:] != 0) | (stripe != pstripe)
+    vt = vtype_ref[:]
+    cx = (vt == i32(2)) | (vt == i32(7))   # MERGE | SINGLE_DELETION
+    stripe_ref[:] = stripe
+    fis_ref[:] = fis.astype(i32)
+    covered_ref[:] = covered.astype(i32)
+    cx_ref[:] = cx.astype(i32)
+
+
+_GC_BLOCK_ROWS = 1024
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gc_rows_impl(seq_hi, seq_lo, pseq_hi, pseq_lo, new_key,
+                  tomb_hi, tomb_lo, vtype, snap_hi, snap_lo, interpret):
+    from jax.experimental import pallas as pl
+
+    n = seq_hi.shape[0]
+    s = snap_hi.shape[0]
+    grid = (n // _GC_BLOCK_ROWS,)
+    row = lambda: pl.BlockSpec((_GC_BLOCK_ROWS, 1), lambda i: (i, 0))
+    snap = lambda: pl.BlockSpec((1, s), lambda i: (0, 0))
+    col = lambda a: a.reshape(n, 1)
+    outs = pl.pallas_call(
+        _gc_rows_kernel,
+        out_shape=[jax.ShapeDtypeStruct((n, 1), jnp.int32)] * 4,
+        grid=grid,
+        in_specs=[row(), row(), row(), row(), row(), row(), row(), row(),
+                  snap(), snap()],
+        out_specs=[row()] * 4,
+        interpret=interpret,
+    )(col(seq_hi), col(seq_lo), col(pseq_hi), col(pseq_lo), col(new_key),
+      col(tomb_hi), col(tomb_lo), col(vtype),
+      snap_hi.reshape(1, s), snap_lo.reshape(1, s))
+    stripe, fis, covered, cx = (o.reshape(n) for o in outs)
+    return stripe, fis, covered, cx
+
+
+def gc_rows(seq_hi, seq_lo, pseq_hi, pseq_lo, new_key, tomb_hi, tomb_lo,
+            vtype, snap_hi, snap_lo, interpret=None):
+    """Traced entry: per-row (stripe, first_in_stripe, covered, complex)
+    for SORTED u32 seqno word columns. Inputs may be traced jax arrays
+    (called inside the fused compaction jit). Rows must be a multiple of
+    1024 (the caller's pow2 padding guarantees >= that when used)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    u = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    stripe, fis, covered, cx = _gc_rows_impl(
+        u(seq_hi), u(seq_lo), u(pseq_hi), u(pseq_lo),
+        new_key.astype(jnp.int32), u(tomb_hi), u(tomb_lo),
+        vtype.astype(jnp.int32), u(snap_hi), u(snap_lo),
+        bool(interpret),
+    )
+    return stripe, fis != 0, covered != 0, cx != 0
+
+
 def shared_prefix_lengths(key_bytes: np.ndarray,
                           key_lens: np.ndarray | None = None,
                           interpret: bool | None = None) -> np.ndarray:
