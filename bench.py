@@ -429,6 +429,27 @@ def main():
                                     t_none, device, max(1, runs - 1), 5000)
         detail["compaction_nocomp_MBps"] = round(
             RAW_PER_ENTRY * n_small / dt2 / 1e6, 2)
+        if device in ("tpu", "cpu-jax") and not tpu_fallback:
+            # Same job with FULL on-device block assembly
+            # (TPULSM_DEVICE_BLOCKS=1; single shard, uncompressed — its
+            # eligibility envelope). Both rows land in the detail so the
+            # default can be chosen from measured data per link class.
+            saved = {k: os.environ.get(k) for k in
+                     ("TPULSM_DEVICE_BLOCKS", "TPULSM_DEVICE_SHARDS")}
+            os.environ["TPULSM_DEVICE_BLOCKS"] = "1"
+            os.environ["TPULSM_DEVICE_SHARDS"] = "1"
+            try:
+                dt2b, _, _ = time_compaction(
+                    env, sbase, icmp, sm["none"], t_none, t_none, device,
+                    max(1, runs - 1), 5500)
+                detail["compaction_nocomp_deviceblocks_MBps"] = round(
+                    RAW_PER_ENTRY * n_small / dt2b / 1e6, 2)
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
         if codecs.available("zstd"):
             t_z = dataclasses.replace(t_none,
                                       compression=fmt.ZSTD_COMPRESSION)

@@ -394,3 +394,409 @@ Java_org_toplingdb_TpuLsmDB_checkpointNative(JNIEnv* env, jclass cls,
     (*env)->ReleaseStringUTFChars(env, dest, cdest);
     check_err(env, err);
 }
+
+/* -- column families (reference rocksjni/rocksjni.cc CF surface) -------- */
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_TpuLsmDB_createColumnFamilyNative(JNIEnv* env, jclass cls,
+                                                     jlong h, jstring name) {
+    (void)cls;
+    char* err = NULL;
+    const char* cname = (*env)->GetStringUTFChars(env, name, NULL);
+    if (cname == NULL) return 0;
+    tpulsm_cf_t* cf = tpulsm_create_column_family(
+        (tpulsm_db_t*)(intptr_t)h, cname, &err);
+    (*env)->ReleaseStringUTFChars(env, name, cname);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)cf;
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_TpuLsmDB_columnFamilyHandleNative(JNIEnv* env, jclass cls,
+                                                     jlong h, jstring name) {
+    (void)cls;
+    char* err = NULL;
+    const char* cname = (*env)->GetStringUTFChars(env, name, NULL);
+    if (cname == NULL) return 0;
+    tpulsm_cf_t* cf = tpulsm_column_family_handle(
+        (tpulsm_db_t*)(intptr_t)h, cname, &err);
+    (*env)->ReleaseStringUTFChars(env, name, cname);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)cf;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_dropColumnFamilyNative(JNIEnv* env, jclass cls,
+                                                   jlong h, jlong cf) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_drop_column_family((tpulsm_db_t*)(intptr_t)h,
+                              (tpulsm_cf_t*)(intptr_t)cf, &err);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_ColumnFamilyHandle_destroyNative(JNIEnv* env, jclass cls,
+                                                    jlong cf) {
+    (void)env; (void)cls;
+    tpulsm_cf_handle_destroy((tpulsm_cf_t*)(intptr_t)cf);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_putCfNative(JNIEnv* env, jclass cls, jlong h,
+                                        jlong cf, jbyteArray k,
+                                        jbyteArray v) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, k);
+    jsize vlen = (*env)->GetArrayLength(env, v);
+    jbyte* kb = (*env)->GetByteArrayElements(env, k, NULL);
+    jbyte* vb = (*env)->GetByteArrayElements(env, v, NULL);
+    tpulsm_put_cf((tpulsm_db_t*)(intptr_t)h, (tpulsm_cf_t*)(intptr_t)cf,
+                  (const char*)kb, (size_t)klen,
+                  (const char*)vb, (size_t)vlen, &err);
+    (*env)->ReleaseByteArrayElements(env, k, kb, JNI_ABORT);
+    (*env)->ReleaseByteArrayElements(env, v, vb, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_toplingdb_TpuLsmDB_getCfNative(JNIEnv* env, jclass cls, jlong h,
+                                        jlong cf, jbyteArray k) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, k);
+    jbyte* kb = (*env)->GetByteArrayElements(env, k, NULL);
+    size_t vlen = 0;
+    char* v = tpulsm_get_cf((tpulsm_db_t*)(intptr_t)h,
+                            (tpulsm_cf_t*)(intptr_t)cf,
+                            (const char*)kb, (size_t)klen, &vlen, &err);
+    (*env)->ReleaseByteArrayElements(env, k, kb, JNI_ABORT);
+    if (check_err(env, err)) return NULL;
+    if (v == NULL) return NULL;
+    jbyteArray out = (*env)->NewByteArray(env, (jsize)vlen);
+    if (out != NULL)
+        (*env)->SetByteArrayRegion(env, out, 0, (jsize)vlen,
+                                   (const jbyte*)v);
+    tpulsm_free(v);
+    return out;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_deleteCfNative(JNIEnv* env, jclass cls, jlong h,
+                                           jlong cf, jbyteArray k) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, k);
+    jbyte* kb = (*env)->GetByteArrayElements(env, k, NULL);
+    tpulsm_delete_cf((tpulsm_db_t*)(intptr_t)h, (tpulsm_cf_t*)(intptr_t)cf,
+                     (const char*)kb, (size_t)klen, &err);
+    (*env)->ReleaseByteArrayElements(env, k, kb, JNI_ABORT);
+    check_err(env, err);
+}
+
+/* -- external SST ingest ------------------------------------------------ */
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TpuLsmDB_ingestExternalFileNative(JNIEnv* env, jclass cls,
+                                                     jlong h, jstring path) {
+    (void)cls;
+    char* err = NULL;
+    const char* cpath = (*env)->GetStringUTFChars(env, path, NULL);
+    if (cpath == NULL) return;
+    tpulsm_ingest_external_file((tpulsm_db_t*)(intptr_t)h, cpath, &err);
+    (*env)->ReleaseStringUTFChars(env, path, cpath);
+    check_err(env, err);
+}
+
+/* -- SstFileWriter ------------------------------------------------------ */
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_SstFileWriter_createNative(JNIEnv* env, jclass cls,
+                                              jstring path) {
+    (void)cls;
+    char* err = NULL;
+    const char* cpath = (*env)->GetStringUTFChars(env, path, NULL);
+    if (cpath == NULL) return 0;
+    tpulsm_sstwriter_t* w = tpulsm_sstfilewriter_create(cpath, &err);
+    (*env)->ReleaseStringUTFChars(env, path, cpath);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)w;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_SstFileWriter_putNative(JNIEnv* env, jclass cls, jlong h,
+                                           jbyteArray k, jbyteArray v) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, k);
+    jsize vlen = (*env)->GetArrayLength(env, v);
+    jbyte* kb = (*env)->GetByteArrayElements(env, k, NULL);
+    jbyte* vb = (*env)->GetByteArrayElements(env, v, NULL);
+    tpulsm_sstfilewriter_put((tpulsm_sstwriter_t*)(intptr_t)h,
+                             (const char*)kb, (size_t)klen,
+                             (const char*)vb, (size_t)vlen, &err);
+    (*env)->ReleaseByteArrayElements(env, k, kb, JNI_ABORT);
+    (*env)->ReleaseByteArrayElements(env, v, vb, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_SstFileWriter_finishNative(JNIEnv* env, jclass cls,
+                                              jlong h) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_sstfilewriter_finish((tpulsm_sstwriter_t*)(intptr_t)h, &err);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_SstFileWriter_destroyNative(JNIEnv* env, jclass cls,
+                                               jlong h) {
+    (void)env; (void)cls;
+    tpulsm_sstfilewriter_destroy((tpulsm_sstwriter_t*)(intptr_t)h);
+}
+
+/* -- transactions (reference rocksjni/transaction.cc role) -------------- */
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_TransactionDB_openNative(JNIEnv* env, jclass cls,
+                                            jstring path, jboolean create) {
+    (void)cls;
+    char* err = NULL;
+    const char* cpath = (*env)->GetStringUTFChars(env, path, NULL);
+    if (cpath == NULL) return 0;
+    tpulsm_txndb_t* t = tpulsm_txndb_open(cpath, create == JNI_TRUE, &err);
+    (*env)->ReleaseStringUTFChars(env, path, cpath);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)t;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_TransactionDB_closeNative(JNIEnv* env, jclass cls,
+                                             jlong h) {
+    (void)env; (void)cls;
+    tpulsm_txndb_close((tpulsm_txndb_t*)(intptr_t)h);
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_toplingdb_TransactionDB_getNative(JNIEnv* env, jclass cls, jlong h,
+                                           jbyteArray k) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, k);
+    jbyte* kb = (*env)->GetByteArrayElements(env, k, NULL);
+    size_t vlen = 0;
+    char* v = tpulsm_txndb_get((tpulsm_txndb_t*)(intptr_t)h,
+                               (const char*)kb, (size_t)klen, &vlen, &err);
+    (*env)->ReleaseByteArrayElements(env, k, kb, JNI_ABORT);
+    if (check_err(env, err)) return NULL;
+    if (v == NULL) return NULL;
+    jbyteArray out = (*env)->NewByteArray(env, (jsize)vlen);
+    if (out != NULL)
+        (*env)->SetByteArrayRegion(env, out, 0, (jsize)vlen,
+                                   (const jbyte*)v);
+    tpulsm_free(v);
+    return out;
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_TransactionDB_beginNative(JNIEnv* env, jclass cls,
+                                             jlong h) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_txn_t* t = tpulsm_txn_begin((tpulsm_txndb_t*)(intptr_t)h, &err);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)t;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_Transaction_putNative(JNIEnv* env, jclass cls, jlong h,
+                                         jbyteArray k, jbyteArray v) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, k);
+    jsize vlen = (*env)->GetArrayLength(env, v);
+    jbyte* kb = (*env)->GetByteArrayElements(env, k, NULL);
+    jbyte* vb = (*env)->GetByteArrayElements(env, v, NULL);
+    tpulsm_txn_put((tpulsm_txn_t*)(intptr_t)h, (const char*)kb,
+                   (size_t)klen, (const char*)vb, (size_t)vlen, &err);
+    (*env)->ReleaseByteArrayElements(env, k, kb, JNI_ABORT);
+    (*env)->ReleaseByteArrayElements(env, v, vb, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_toplingdb_Transaction_getNative(JNIEnv* env, jclass cls, jlong h,
+                                         jbyteArray k) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, k);
+    jbyte* kb = (*env)->GetByteArrayElements(env, k, NULL);
+    size_t vlen = 0;
+    char* v = tpulsm_txn_get((tpulsm_txn_t*)(intptr_t)h, (const char*)kb,
+                             (size_t)klen, &vlen, &err);
+    (*env)->ReleaseByteArrayElements(env, k, kb, JNI_ABORT);
+    if (check_err(env, err)) return NULL;
+    if (v == NULL) return NULL;
+    jbyteArray out = (*env)->NewByteArray(env, (jsize)vlen);
+    if (out != NULL)
+        (*env)->SetByteArrayRegion(env, out, 0, (jsize)vlen,
+                                   (const jbyte*)v);
+    tpulsm_free(v);
+    return out;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_Transaction_deleteNative(JNIEnv* env, jclass cls, jlong h,
+                                            jbyteArray k) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, k);
+    jbyte* kb = (*env)->GetByteArrayElements(env, k, NULL);
+    tpulsm_txn_delete((tpulsm_txn_t*)(intptr_t)h, (const char*)kb,
+                      (size_t)klen, &err);
+    (*env)->ReleaseByteArrayElements(env, k, kb, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_Transaction_commitNative(JNIEnv* env, jclass cls,
+                                            jlong h) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_txn_commit((tpulsm_txn_t*)(intptr_t)h, &err);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_Transaction_rollbackNative(JNIEnv* env, jclass cls,
+                                              jlong h) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_txn_rollback((tpulsm_txn_t*)(intptr_t)h, &err);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_Transaction_destroyNative(JNIEnv* env, jclass cls,
+                                             jlong h) {
+    (void)env; (void)cls;
+    tpulsm_txn_destroy((tpulsm_txn_t*)(intptr_t)h);
+}
+
+/* -- backup engine (reference rocksjni/backup_engine.cc role) ----------- */
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_BackupEngine_openNative(JNIEnv* env, jclass cls,
+                                           jstring dir) {
+    (void)cls;
+    char* err = NULL;
+    const char* cdir = (*env)->GetStringUTFChars(env, dir, NULL);
+    if (cdir == NULL) return 0;
+    tpulsm_backup_engine_t* be = tpulsm_backup_engine_open(cdir, &err);
+    (*env)->ReleaseStringUTFChars(env, dir, cdir);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)be;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_BackupEngine_closeNative(JNIEnv* env, jclass cls,
+                                            jlong h) {
+    (void)env; (void)cls;
+    tpulsm_backup_engine_close((tpulsm_backup_engine_t*)(intptr_t)h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_toplingdb_BackupEngine_createBackupNative(JNIEnv* env, jclass cls,
+                                                   jlong h, jlong db) {
+    (void)cls;
+    char* err = NULL;
+    int id = tpulsm_backup_engine_create_backup(
+        (tpulsm_backup_engine_t*)(intptr_t)h, (tpulsm_db_t*)(intptr_t)db,
+        &err);
+    if (check_err(env, err)) return 0;
+    return (jint)id;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_toplingdb_BackupEngine_countNative(JNIEnv* env, jclass cls,
+                                            jlong h) {
+    (void)env; (void)cls;
+    return (jint)tpulsm_backup_engine_count(
+        (tpulsm_backup_engine_t*)(intptr_t)h);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_BackupEngine_restoreNative(JNIEnv* env, jclass cls,
+                                              jlong h, jint backup_id,
+                                              jstring dest) {
+    (void)cls;
+    char* err = NULL;
+    const char* cdest = (*env)->GetStringUTFChars(env, dest, NULL);
+    if (cdest == NULL) return;
+    tpulsm_backup_engine_restore((tpulsm_backup_engine_t*)(intptr_t)h,
+                                 (int)backup_id, cdest, &err);
+    (*env)->ReleaseStringUTFChars(env, dest, cdest);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_BackupEngine_purgeOldNative(JNIEnv* env, jclass cls,
+                                               jlong h, jint keep) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_backup_engine_purge_old((tpulsm_backup_engine_t*)(intptr_t)h,
+                                   (int)keep, &err);
+    check_err(env, err);
+}
+
+/* -- SidePluginRepo (reference SidePluginRepo.java:10-104 + its JNI) ---- */
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_SidePluginRepo_createNative(JNIEnv* env, jclass cls) {
+    (void)cls;
+    char* err = NULL;
+    tpulsm_repo_t* r = tpulsm_repo_create(&err);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)r;
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_toplingdb_SidePluginRepo_openDBNative(JNIEnv* env, jclass cls,
+                                               jlong h, jstring json) {
+    (void)cls;
+    char* err = NULL;
+    const char* cjson = (*env)->GetStringUTFChars(env, json, NULL);
+    if (cjson == NULL) return 0;
+    tpulsm_db_t* db = tpulsm_repo_open_db((tpulsm_repo_t*)(intptr_t)h,
+                                          cjson, &err);
+    (*env)->ReleaseStringUTFChars(env, json, cjson);
+    if (check_err(env, err)) return 0;
+    return (jlong)(intptr_t)db;
+}
+
+JNIEXPORT jint JNICALL
+Java_org_toplingdb_SidePluginRepo_startHttpNative(JNIEnv* env, jclass cls,
+                                                  jlong h, jint port) {
+    (void)cls;
+    char* err = NULL;
+    int bound = tpulsm_repo_start_http((tpulsm_repo_t*)(intptr_t)h,
+                                       (int)port, &err);
+    if (check_err(env, err)) return -1;
+    return (jint)bound;
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_SidePluginRepo_stopHttpNative(JNIEnv* env, jclass cls,
+                                                 jlong h) {
+    (void)env; (void)cls;
+    tpulsm_repo_stop_http((tpulsm_repo_t*)(intptr_t)h);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_SidePluginRepo_closeAllNative(JNIEnv* env, jclass cls,
+                                                 jlong h) {
+    (void)env; (void)cls;
+    tpulsm_repo_close_all((tpulsm_repo_t*)(intptr_t)h);
+}

@@ -1,0 +1,75 @@
+package org.toplingdb;
+
+/**
+ * Incremental backup engine (reference
+ * java/src/main/java/org/rocksdb/BackupEngine.java over our
+ * utilities.backup_engine): create/restore/count/purge.
+ */
+public class BackupEngine implements AutoCloseable {
+    static {
+        System.loadLibrary("tpulsm_jni");
+    }
+
+    private long handle;
+
+    private BackupEngine(long handle) {
+        this.handle = handle;
+    }
+
+    public static BackupEngine open(String backupDir)
+            throws TpuLsmException {
+        return new BackupEngine(openNative(backupDir));
+    }
+
+    /** @return the new backup's id (&gt; 0). */
+    public int createBackup(TpuLsmDB db) throws TpuLsmException {
+        checkOpen();
+        return createBackupNative(handle, db.handleForInternalUse());
+    }
+
+    public int backupCount() throws TpuLsmException {
+        checkOpen();
+        return countNative(handle);
+    }
+
+    public void restore(int backupId, String destDir)
+            throws TpuLsmException {
+        checkOpen();
+        restoreNative(handle, backupId, destDir);
+    }
+
+    public void purgeOldBackups(int keep) throws TpuLsmException {
+        checkOpen();
+        purgeOldNative(handle, keep);
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            closeNative(handle);
+            handle = 0;
+        }
+    }
+
+    private void checkOpen() throws TpuLsmException {
+        if (handle == 0) {
+            throw new TpuLsmException("backup engine is closed");
+        }
+    }
+
+    private static native long openNative(String dir)
+            throws TpuLsmException;
+
+    private static native void closeNative(long h);
+
+    private static native int createBackupNative(long h, long db)
+            throws TpuLsmException;
+
+    private static native int countNative(long h);
+
+    private static native void restoreNative(long h, int id, String dest)
+            throws TpuLsmException;
+
+    private static native void purgeOldNative(long h, int keep)
+            throws TpuLsmException;
+}

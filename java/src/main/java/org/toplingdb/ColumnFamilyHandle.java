@@ -1,0 +1,26 @@
+package org.toplingdb;
+
+/**
+ * Handle to one column family (reference
+ * java/src/main/java/org/rocksdb/ColumnFamilyHandle.java). Obtained from
+ * {@link TpuLsmDB#createColumnFamily} or
+ * {@link TpuLsmDB#getColumnFamilyHandle}; close() releases only the
+ * handle, not the family.
+ */
+public class ColumnFamilyHandle implements AutoCloseable {
+    long handle;
+
+    ColumnFamilyHandle(long handle) {
+        this.handle = handle;
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            destroyNative(handle);
+            handle = 0;
+        }
+    }
+
+    private static native void destroyNative(long h);
+}

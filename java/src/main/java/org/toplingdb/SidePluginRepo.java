@@ -1,0 +1,81 @@
+package org.toplingdb;
+
+/**
+ * Open DBs from a JSON config document and serve HTTP introspection —
+ * the reference's Topling SidePluginRepo
+ * (java/src/main/java/org/rocksdb/SidePluginRepo.java:10-104):
+ *
+ * <pre>
+ *   SidePluginRepo repo = SidePluginRepo.create();
+ *   TpuLsmDB db = repo.openDB(
+ *       "{\"path\": \"/data/db\", \"name\": \"main\", "
+ *       + "\"options\": {\"create_if_missing\": true}}");
+ *   int port = repo.startHttp(0);   // /dbs /stats/<n> /levels/<n> /metrics
+ *   ...
+ *   repo.closeAll();
+ * </pre>
+ */
+public class SidePluginRepo implements AutoCloseable {
+    static {
+        System.loadLibrary("tpulsm_jni");
+    }
+
+    private long handle;
+
+    private SidePluginRepo(long handle) {
+        this.handle = handle;
+    }
+
+    public static SidePluginRepo create() throws TpuLsmException {
+        return new SidePluginRepo(createNative());
+    }
+
+    /** configJson: {"path": ..., "name": ..., "options": {...}} */
+    public TpuLsmDB openDB(String configJson) throws TpuLsmException {
+        checkOpen();
+        return TpuLsmDB.fromHandleForInternalUse(
+            openDBNative(handle, configJson));
+    }
+
+    /** @return the bound port (pass 0 to auto-pick). */
+    public int startHttp(int port) throws TpuLsmException {
+        checkOpen();
+        return startHttpNative(handle, port);
+    }
+
+    public void stopHttp() throws TpuLsmException {
+        checkOpen();
+        stopHttpNative(handle);
+    }
+
+    /** Stops HTTP and closes every DB this repo opened. */
+    public synchronized void closeAll() {
+        if (handle != 0) {
+            closeAllNative(handle);
+            handle = 0;
+        }
+    }
+
+    @Override
+    public void close() {
+        closeAll();
+    }
+
+    private void checkOpen() throws TpuLsmException {
+        if (handle == 0) {
+            throw new TpuLsmException("repo is closed");
+        }
+    }
+
+    private static native long createNative() throws TpuLsmException;
+
+    private static native long openDBNative(long h, String json)
+            throws TpuLsmException;
+
+    private static native int startHttpNative(long h, int port)
+            throws TpuLsmException;
+
+    private static native void stopHttpNative(long h);
+
+    private static native void closeAllNative(long h);
+}

@@ -1,0 +1,57 @@
+package org.toplingdb;
+
+/**
+ * Builds an external SST for ingestion (reference
+ * java/src/main/java/org/rocksdb/SstFileWriter.java): put keys in sorted
+ * order, finish, then {@link TpuLsmDB#ingestExternalFile}.
+ */
+public class SstFileWriter implements AutoCloseable {
+    static {
+        System.loadLibrary("tpulsm_jni");
+    }
+
+    private long handle;
+
+    private SstFileWriter(long handle) {
+        this.handle = handle;
+    }
+
+    public static SstFileWriter create(String path) throws TpuLsmException {
+        return new SstFileWriter(createNative(path));
+    }
+
+    /** Keys must arrive in ascending order. */
+    public void put(byte[] key, byte[] value) throws TpuLsmException {
+        checkOpen();
+        putNative(handle, key, value);
+    }
+
+    public void finish() throws TpuLsmException {
+        checkOpen();
+        finishNative(handle);
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            destroyNative(handle);
+            handle = 0;
+        }
+    }
+
+    private void checkOpen() throws TpuLsmException {
+        if (handle == 0) {
+            throw new TpuLsmException("sst file writer is closed");
+        }
+    }
+
+    private static native long createNative(String path)
+            throws TpuLsmException;
+
+    private static native void putNative(long h, byte[] k, byte[] v)
+            throws TpuLsmException;
+
+    private static native void finishNative(long h) throws TpuLsmException;
+
+    private static native void destroyNative(long h);
+}

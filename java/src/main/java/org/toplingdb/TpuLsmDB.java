@@ -111,6 +111,61 @@ public class TpuLsmDB implements AutoCloseable {
         return new TpuLsmIterator(iteratorNative(handle));
     }
 
+    // -- column families (reference RocksDB#createColumnFamily etc.) ----
+
+    public ColumnFamilyHandle createColumnFamily(String name)
+            throws TpuLsmException {
+        checkOpen();
+        return new ColumnFamilyHandle(createColumnFamilyNative(handle, name));
+    }
+
+    /** Handle to an existing family by name. */
+    public ColumnFamilyHandle getColumnFamilyHandle(String name)
+            throws TpuLsmException {
+        checkOpen();
+        return new ColumnFamilyHandle(columnFamilyHandleNative(handle, name));
+    }
+
+    public void dropColumnFamily(ColumnFamilyHandle cf)
+            throws TpuLsmException {
+        checkOpen();
+        dropColumnFamilyNative(handle, cf.handle);
+    }
+
+    public void put(ColumnFamilyHandle cf, byte[] key, byte[] value)
+            throws TpuLsmException {
+        checkOpen();
+        putCfNative(handle, cf.handle, key, value);
+    }
+
+    public byte[] get(ColumnFamilyHandle cf, byte[] key)
+            throws TpuLsmException {
+        checkOpen();
+        return getCfNative(handle, cf.handle, key);
+    }
+
+    public void delete(ColumnFamilyHandle cf, byte[] key)
+            throws TpuLsmException {
+        checkOpen();
+        deleteCfNative(handle, cf.handle, key);
+    }
+
+    /** Ingest an externally built SST (see {@link SstFileWriter}). */
+    public void ingestExternalFile(String path) throws TpuLsmException {
+        checkOpen();
+        ingestExternalFileNative(handle, path);
+    }
+
+    /** For sibling bindings (BackupEngine) only. */
+    long handleForInternalUse() {
+        return handle;
+    }
+
+    /** For SidePluginRepo only: wrap a repo-owned native handle. */
+    static TpuLsmDB fromHandleForInternalUse(long h) {
+        return new TpuLsmDB(h);
+    }
+
     @Override
     public synchronized void close() {
         if (handle != 0) {
@@ -165,6 +220,27 @@ public class TpuLsmDB implements AutoCloseable {
 
     private static native byte[] getAtSnapshotNative(long h, long snap,
             byte[] k) throws TpuLsmException;
+
+    private static native long createColumnFamilyNative(long h, String name)
+            throws TpuLsmException;
+
+    private static native long columnFamilyHandleNative(long h, String name)
+            throws TpuLsmException;
+
+    private static native void dropColumnFamilyNative(long h, long cf)
+            throws TpuLsmException;
+
+    private static native void putCfNative(long h, long cf, byte[] k,
+                                           byte[] v) throws TpuLsmException;
+
+    private static native byte[] getCfNative(long h, long cf, byte[] k)
+            throws TpuLsmException;
+
+    private static native void deleteCfNative(long h, long cf, byte[] k)
+            throws TpuLsmException;
+
+    private static native void ingestExternalFileNative(long h, String path)
+            throws TpuLsmException;
 
     private static native void checkpointNative(long h, String dest)
             throws TpuLsmException;

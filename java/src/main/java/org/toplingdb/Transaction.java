@@ -1,0 +1,70 @@
+package org.toplingdb;
+
+/**
+ * Pessimistic transaction (reference
+ * java/src/main/java/org/rocksdb/Transaction.java): point ops acquire
+ * locks in the owning {@link TransactionDB}; commit/rollback end it.
+ */
+public class Transaction implements AutoCloseable {
+    private long handle;
+
+    Transaction(long handle) {
+        this.handle = handle;
+    }
+
+    public void put(byte[] key, byte[] value) throws TpuLsmException {
+        checkOpen();
+        putNative(handle, key, value);
+    }
+
+    /** Read-your-writes get through the transaction. */
+    public byte[] get(byte[] key) throws TpuLsmException {
+        checkOpen();
+        return getNative(handle, key);
+    }
+
+    public void delete(byte[] key) throws TpuLsmException {
+        checkOpen();
+        deleteNative(handle, key);
+    }
+
+    public void commit() throws TpuLsmException {
+        checkOpen();
+        commitNative(handle);
+    }
+
+    public void rollback() throws TpuLsmException {
+        checkOpen();
+        rollbackNative(handle);
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            destroyNative(handle);
+            handle = 0;
+        }
+    }
+
+    private void checkOpen() throws TpuLsmException {
+        if (handle == 0) {
+            throw new TpuLsmException("transaction is closed");
+        }
+    }
+
+    private static native void putNative(long h, byte[] k, byte[] v)
+            throws TpuLsmException;
+
+    private static native byte[] getNative(long h, byte[] k)
+            throws TpuLsmException;
+
+    private static native void deleteNative(long h, byte[] k)
+            throws TpuLsmException;
+
+    private static native void commitNative(long h) throws TpuLsmException;
+
+    private static native void rollbackNative(long h)
+            throws TpuLsmException;
+
+    private static native void destroyNative(long h);
+}

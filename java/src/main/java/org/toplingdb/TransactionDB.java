@@ -1,0 +1,59 @@
+package org.toplingdb;
+
+/**
+ * Transactional database (reference
+ * java/src/main/java/org/rocksdb/TransactionDB.java over our
+ * utilities.transactions engine): open, begin transactions, committed
+ * reads.
+ */
+public class TransactionDB implements AutoCloseable {
+    static {
+        System.loadLibrary("tpulsm_jni");
+    }
+
+    private long handle;
+
+    private TransactionDB(long handle) {
+        this.handle = handle;
+    }
+
+    public static TransactionDB open(String path, boolean createIfMissing)
+            throws TpuLsmException {
+        return new TransactionDB(openNative(path, createIfMissing));
+    }
+
+    public Transaction beginTransaction() throws TpuLsmException {
+        checkOpen();
+        return new Transaction(beginNative(handle));
+    }
+
+    /** Committed-state read (outside any transaction). */
+    public byte[] get(byte[] key) throws TpuLsmException {
+        checkOpen();
+        return getNative(handle, key);
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle != 0) {
+            closeNative(handle);
+            handle = 0;
+        }
+    }
+
+    private void checkOpen() throws TpuLsmException {
+        if (handle == 0) {
+            throw new TpuLsmException("transaction db is closed");
+        }
+    }
+
+    private static native long openNative(String path, boolean create)
+            throws TpuLsmException;
+
+    private static native void closeNative(long h);
+
+    private static native long beginNative(long h) throws TpuLsmException;
+
+    private static native byte[] getNative(long h, byte[] k)
+            throws TpuLsmException;
+}

@@ -1,0 +1,117 @@
+package org.toplingdb;
+
+/**
+ * Breadth test over every surface the C ABI exposes (VERDICT r03 item 9):
+ * column families, transactions, backup engine, checkpoint, external SST
+ * ingest, and the SidePluginRepo open-from-JSON-config flow (reference
+ * java/src/main/java/org/rocksdb/SidePluginRepo.java:10-104). Run by
+ * java/Makefile `make test-breadth`; prints JAVA-BREADTH-OK on success.
+ */
+public final class ApiBreadthTest {
+    private ApiBreadthTest() { }
+
+    public static void main(String[] args) throws Exception {
+        String base = args.length > 0 ? args[0] : "/tmp/tpulsm_java_breadth";
+
+        // -- column families --------------------------------------------
+        try (TpuLsmDB db = TpuLsmDB.open(base + "/cfdb", true)) {
+            try (ColumnFamilyHandle cf = db.createColumnFamily("meta")) {
+                db.put(cf, b("mk"), b("mv"));
+                db.put(b("dk"), b("dv"));
+                expect(eq(db.get(cf, b("mk")), b("mv")), "cf get");
+                expect(db.get(b("mk")) == null, "cf isolation");
+                db.delete(cf, b("mk"));
+                expect(db.get(cf, b("mk")) == null, "cf delete");
+                db.put(cf, b("mk2"), b("mv2"));
+            }
+            try (ColumnFamilyHandle cf2 =
+                     db.getColumnFamilyHandle("meta")) {
+                expect(eq(db.get(cf2, b("mk2")), b("mv2")), "cf reopen");
+                db.dropColumnFamily(cf2);
+            }
+        }
+
+        // -- transactions -----------------------------------------------
+        try (TransactionDB tdb = TransactionDB.open(base + "/txndb", true)) {
+            try (Transaction txn = tdb.beginTransaction()) {
+                txn.put(b("tk"), b("tv"));
+                expect(eq(txn.get(b("tk")), b("tv")), "txn ryw");
+                expect(tdb.get(b("tk")) == null, "txn isolation");
+                txn.commit();
+            }
+            expect(eq(tdb.get(b("tk")), b("tv")), "txn committed");
+            try (Transaction txn = tdb.beginTransaction()) {
+                txn.put(b("tk2"), b("x"));
+                txn.rollback();
+            }
+            expect(tdb.get(b("tk2")) == null, "txn rollback");
+        }
+
+        // -- external SST build + ingest --------------------------------
+        try (TpuLsmDB db = TpuLsmDB.open(base + "/ingestdb", true)) {
+            String sst = base + "/ext.sst";
+            try (SstFileWriter w = SstFileWriter.create(sst)) {
+                w.put(b("ik1"), b("iv1"));
+                w.put(b("ik2"), b("iv2"));
+                w.finish();
+            }
+            db.ingestExternalFile(sst);
+            expect(eq(db.get(b("ik1")), b("iv1")), "ingest get");
+
+            // -- checkpoint + backup + restore --------------------------
+            db.createCheckpoint(base + "/ckpt");
+            try (BackupEngine be = BackupEngine.open(base + "/backups")) {
+                int id = be.createBackup(db);
+                expect(id > 0, "backup id");
+                expect(be.backupCount() == 1, "backup count");
+                be.restore(id, base + "/restored");
+            }
+        }
+        try (TpuLsmDB db = TpuLsmDB.open(base + "/restored", false)) {
+            expect(eq(db.get(b("ik2")), b("iv2")), "restored get");
+        }
+        try (TpuLsmDB db = TpuLsmDB.open(base + "/ckpt", false)) {
+            expect(eq(db.get(b("ik1")), b("iv1")), "checkpoint get");
+        }
+
+        // -- SidePluginRepo: open from JSON config + HTTP ---------------
+        try (SidePluginRepo repo = SidePluginRepo.create()) {
+            TpuLsmDB db = repo.openDB(
+                "{\"path\": \"" + base + "/repodb\", \"name\": \"main\", "
+                + "\"options\": {\"create_if_missing\": true}}");
+            db.put(b("rk"), b("rv"));
+            expect(eq(db.get(b("rk")), b("rv")), "repo db get");
+            int port = repo.startHttp(0);
+            expect(port > 0, "http port");
+            java.net.URL url =
+                new java.net.URL("http://127.0.0.1:" + port + "/dbs");
+            try (java.io.BufferedReader r = new java.io.BufferedReader(
+                     new java.io.InputStreamReader(url.openStream()))) {
+                StringBuilder sb = new StringBuilder();
+                String line;
+                while ((line = r.readLine()) != null) {
+                    sb.append(line);
+                }
+                expect(sb.toString().contains("main"), "http /dbs");
+            }
+            repo.stopHttp();
+            repo.closeAll();
+        }
+
+        System.out.println("JAVA-BREADTH-OK");
+    }
+
+    private static byte[] b(String s) {
+        return s.getBytes(java.nio.charset.StandardCharsets.UTF_8);
+    }
+
+    private static boolean eq(byte[] a, byte[] e) {
+        return java.util.Arrays.equals(a, e);
+    }
+
+    private static void expect(boolean cond, String what) {
+        if (!cond) {
+            throw new IllegalStateException("FAILED: " + what);
+        }
+    }
+}

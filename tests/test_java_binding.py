@@ -79,3 +79,14 @@ def test_java_binding_end_to_end(tmp_path):
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "JAVA-API-OK" in r.stdout
+
+@pytest.mark.skipif(_java_home() is None, reason="JDK unavailable")
+def test_java_api_breadth(tmp_path):
+    """CFs, transactions, backup, checkpoint, SST ingest, and the
+    SidePluginRepo open-from-JSON flow through the Java API."""
+    env = dict(os.environ)
+    env["JAVA_HOME"] = _java_home()
+    r = subprocess.run(["make", "test-breadth"], cwd=JDIR, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "JAVA-BREADTH-OK" in r.stdout

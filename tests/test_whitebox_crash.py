@@ -66,3 +66,20 @@ def test_whitebox_crash_loop_recovers(tmp_path, prefix):
     out = r.stdout.decode()
     assert r.returncode == 0, out + r.stderr.decode()
     assert "crash test passed" in out
+
+
+def test_crash_matrix_driver_smoke():
+    """The db_crashtest matrix driver (reference tools/db_crashtest.py
+    parameter sweep role): two cells under a tiny budget must pass and
+    print the summary line."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "toplingdb_tpu.tools.db_crashtest",
+         "--duration", "16", "--variants", "blob", "--modes",
+         "blackbox,whitebox", "--ops", "8000"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MATRIX PASSED" in r.stdout

@@ -1036,3 +1036,92 @@ void tpulsm_ingest_external_file(tpulsm_db_t* db, const char* path,
     Py_XDECREF(mod);
     PyGILState_Release(g);
 }
+
+/* -- SidePluginRepo ------------------------------------------------------ */
+
+struct tpulsm_repo_t { PyObject* obj; };
+
+tpulsm_repo_t* tpulsm_repo_create(char** errptr) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_repo_t* out = NULL;
+    PyObject* mod = PyImport_ImportModule("toplingdb_tpu.utils.config");
+    PyObject* r = mod ? PyObject_CallMethod(mod, "SidePluginRepo", NULL)
+                      : NULL;
+    if (!r) {
+        set_err_from_python(errptr);
+    } else {
+        out = (tpulsm_repo_t*)malloc(sizeof(*out));
+        if (out) {
+            out->obj = r;
+        } else {
+            Py_DECREF(r);
+            if (errptr) *errptr = dup_cstr("out of memory");
+        }
+    }
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+    return out;
+}
+
+tpulsm_db_t* tpulsm_repo_open_db(tpulsm_repo_t* repo,
+                                 const char* config_json, char** errptr) {
+    if (!repo) {
+        if (errptr) *errptr = dup_cstr("null repo handle");
+        return NULL;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    tpulsm_db_t* out = NULL;
+    PyObject* r = PyObject_CallMethod(repo->obj, "open_db", "s",
+                                      config_json);
+    if (!r) {
+        set_err_from_python(errptr);
+    } else {
+        out = (tpulsm_db_t*)malloc(sizeof(*out));
+        if (out) {
+            out->obj = r; /* repo also holds a ref; ours via this handle */
+        } else {
+            Py_DECREF(r);
+            if (errptr) *errptr = dup_cstr("out of memory");
+        }
+    }
+    PyGILState_Release(g);
+    return out;
+}
+
+int tpulsm_repo_start_http(tpulsm_repo_t* repo, int port, char** errptr) {
+    if (!repo) {
+        if (errptr) *errptr = dup_cstr("null repo handle");
+        return -1;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    int bound = -1;
+    PyObject* r = PyObject_CallMethod(repo->obj, "start_http", "i", port);
+    if (!r) {
+        set_err_from_python(errptr);
+    } else {
+        bound = (int)PyLong_AsLong(r);
+        Py_DECREF(r);
+    }
+    PyGILState_Release(g);
+    return bound;
+}
+
+void tpulsm_repo_stop_http(tpulsm_repo_t* repo) {
+    if (!repo) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(repo->obj, "stop_http", NULL);
+    if (!r) PyErr_Clear();
+    Py_XDECREF(r);
+    PyGILState_Release(g);
+}
+
+void tpulsm_repo_close_all(tpulsm_repo_t* repo) {
+    if (!repo) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(repo->obj, "close_all", NULL);
+    if (!r) PyErr_Clear();
+    Py_XDECREF(r);
+    Py_DECREF(repo->obj);
+    PyGILState_Release(g);
+    free(repo);
+}

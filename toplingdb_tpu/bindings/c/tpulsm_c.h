@@ -168,6 +168,26 @@ void tpulsm_sstfilewriter_destroy(tpulsm_sstwriter_t* w);
 void tpulsm_ingest_external_file(tpulsm_db_t* db, const char* path,
                                  char** errptr);
 
+/* -- SidePluginRepo: open DBs from JSON config + HTTP introspection
+ *    (the reference's java SidePluginRepo.java:10-104 role). DB handles
+ *    returned by tpulsm_repo_open_db may be released with tpulsm_close
+ *    (DB.close is idempotent) or left to tpulsm_repo_close_all; after
+ *    close_all every repo-opened handle is CLOSED but still must be
+ *    freed by tpulsm_close if it was not already. ------------------- */
+typedef struct tpulsm_repo_t tpulsm_repo_t;
+
+tpulsm_repo_t* tpulsm_repo_create(char** errptr);
+/* config_json: {"path": ..., "name": ..., "options": {...}} */
+tpulsm_db_t* tpulsm_repo_open_db(tpulsm_repo_t* repo,
+                                 const char* config_json, char** errptr);
+/* Serves /dbs /stats/<name> /levels/<name> /config/<name> /metrics.
+ * Returns the bound port (pass 0 to auto-pick), or -1 + error. */
+int tpulsm_repo_start_http(tpulsm_repo_t* repo, int port, char** errptr);
+void tpulsm_repo_stop_http(tpulsm_repo_t* repo);
+/* Stops HTTP, closes every repo-opened DB, and DESTROYS the repo handle
+ * itself — `repo` is invalid after this call. */
+void tpulsm_repo_close_all(tpulsm_repo_t* repo);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,86 @@
+"""blob_dump: inspect one .blob file (reference tools/blob_dump.cc +
+db/blob/blob_dump_tool.cc in /root/reference): header check, per-record
+listing (key, value size, crc status), and summary totals.
+
+Usage: python -m toplingdb_tpu.tools.blob_dump --file F [--show_records]
+       [--limit N] [--no_verify]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from toplingdb_tpu.db.blob import MAGIC
+from toplingdb_tpu.utils import coding, crc32c
+
+
+def dump_blob_file(path: str, show_records: bool = False, limit: int = 0,
+                   verify: bool = True, out=sys.stdout) -> dict:
+    """Walk every record; returns summary dict. Raises on bad magic;
+    records after a corrupt point are reported and the walk stops."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[: len(MAGIC)] != MAGIC:
+        raise ValueError(f"bad blob magic in {path}")
+    off = len(MAGIC)
+    n = 0
+    total_key = 0
+    total_val = 0
+    bad_crc = 0
+    corrupt_at = None
+    while off < len(data):
+        start = off
+        try:
+            klen, off = coding.decode_varint32(data, off)
+            vlen, off = coding.decode_varint32(data, off)
+            key = data[off: off + klen]
+            off += klen
+            val = data[off: off + vlen]
+            off += vlen
+            if off + 4 > len(data) or len(val) != vlen:
+                raise ValueError("truncated record")
+            stored = crc32c.unmask(coding.decode_fixed32(data, off))
+            off += 4
+        except Exception:
+            corrupt_at = start
+            break
+        ok = True
+        if verify and crc32c.value(val) != stored:
+            bad_crc += 1
+            ok = False
+        if show_records and (not limit or n < limit):
+            print(f"  @{start}: key={key!r} value_size={vlen} "
+                  f"crc={'OK' if ok else 'BAD'}", file=out)
+        n += 1
+        total_key += klen
+        total_val += vlen
+    summary = {
+        "records": n,
+        "key_bytes": total_key,
+        "value_bytes": total_val,
+        "file_bytes": len(data),
+        "bad_crc": bad_crc,
+        "corrupt_at": corrupt_at,
+    }
+    print(f"{path}: {n} records, {total_val} value bytes, "
+          f"{len(data)} file bytes"
+          + (f", {bad_crc} BAD CRC" if bad_crc else "")
+          + (f", CORRUPT at offset {corrupt_at}" if corrupt_at is not None
+             else ""), file=out)
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="blob_dump")
+    ap.add_argument("--file", required=True)
+    ap.add_argument("--show_records", action="store_true")
+    ap.add_argument("--limit", type=int, default=0)
+    ap.add_argument("--no_verify", action="store_true")
+    a = ap.parse_args(argv)
+    s = dump_blob_file(a.file, a.show_records, a.limit, not a.no_verify)
+    return 1 if (s["bad_crc"] or s["corrupt_at"] is not None) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

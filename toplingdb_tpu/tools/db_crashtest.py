@@ -1,0 +1,101 @@
+"""db_crashtest: the crash-test MATRIX driver (reference
+tools/db_crashtest.py:17-28 in /root/reference): sweeps db_stress's
+option-variant matrix (blob / unordered+concurrent / pipelined /
+universal-compaction / tiny-buffer) through blackbox AND whitebox
+kill-recover rounds, dividing a wall-clock budget across the cells.
+
+CI-able 5-minute soak (the documented invocation):
+
+    python -m toplingdb_tpu.tools.db_crashtest --duration 300
+
+Each cell runs `db_stress --crash-test [--whitebox] --variant=V` in a
+fresh directory; any verification failure fails the whole matrix. A
+summary table prints at the end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+from toplingdb_tpu.tools.db_stress import VARIANTS
+
+
+def run_cell(variant: str, mode: str, budget_s: float, base: str,
+             seed: int, ops: int, threads: int) -> tuple[bool, str]:
+    """One (variant, blackbox|whitebox) cell under its time slice."""
+    d = os.path.join(base, f"{variant}_{mode}")
+    os.makedirs(d, exist_ok=True)
+    rounds = 3
+    kill_after = max(1.0, budget_s / (rounds + 1))
+    cmd = [
+        sys.executable, "-m", "toplingdb_tpu.tools.db_stress",
+        f"--db={d}/db", "--crash-test", f"--rounds={rounds}",
+        f"--kill-after={kill_after}", f"--variant={variant}",
+        f"--seed={seed}", f"--ops={ops}", f"--threads={threads}",
+    ]
+    if mode == "whitebox":
+        cmd.append("--whitebox")
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=budget_s * 3 + 120)
+    except subprocess.TimeoutExpired:
+        return False, "TIMEOUT"
+    dt = time.time() - t0
+    ok = r.returncode == 0 and "crash test passed" in r.stdout
+    tail = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    return ok, f"{dt:.0f}s {tail}" if ok else (r.stdout + r.stderr)[-1500:]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="db_crashtest")
+    ap.add_argument("--duration", type=float, default=300.0,
+                    help="total wall-clock budget (seconds)")
+    ap.add_argument("--variants", default=",".join(sorted(VARIANTS)),
+                    help="comma-separated variant subset")
+    ap.add_argument("--modes", default="blackbox,whitebox")
+    ap.add_argument("--ops", type=int, default=100_000)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the work dirs on success")
+    a = ap.parse_args(argv)
+
+    variants = [v for v in a.variants.split(",") if v]
+    for v in variants:
+        if v not in VARIANTS:
+            ap.error(f"unknown variant {v!r} (have {sorted(VARIANTS)})")
+    modes = [m for m in a.modes.split(",") if m]
+    for m in modes:
+        if m not in ("blackbox", "whitebox"):
+            ap.error(f"unknown mode {m!r} (blackbox|whitebox)")
+    cells = [(v, m) for v in variants for m in modes]
+    per_cell = a.duration / max(1, len(cells))
+    base = tempfile.mkdtemp(prefix="tpulsm_crashmatrix_")
+    print(f"crash matrix: {len(cells)} cells x ~{per_cell:.0f}s in {base}")
+
+    failures = []
+    for i, (v, m) in enumerate(cells):
+        ok, info = run_cell(v, m, per_cell, base, a.seed + i, a.ops,
+                            a.threads)
+        status = "OK " if ok else "FAIL"
+        print(f"  [{status}] {v:<12} {m:<9} {info if ok else ''}")
+        if not ok:
+            failures.append((v, m, info))
+    for v, m, info in failures:
+        print(f"--- {v}/{m} output tail ---\n{info}")
+    if not failures and not a.keep:
+        shutil.rmtree(base, ignore_errors=True)
+    print("MATRIX", "FAILED" if failures else "PASSED",
+          f"({len(cells) - len(failures)}/{len(cells)} cells)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,14 +118,38 @@ def verify(db, committed, pending) -> int:
     return bad
 
 
+# Option-variant matrix (reference tools/db_crashtest.py:17-28's parameter
+# sweep): each variant exercises a different durability/write-path/storage
+# configuration under the SAME expected-state model.
+VARIANTS = {
+    "default": {},
+    "blob": {"enable_blob_files": True, "min_blob_size": 32,
+             "enable_blob_garbage_collection": True,
+             "blob_garbage_collection_age_cutoff": 0.5},
+    "unordered": {"unordered_write": True,
+                  "allow_concurrent_memtable_write": True},
+    "pipelined": {"enable_pipelined_write": True},
+    "universal": {"compaction_style": "universal"},
+    "tiny_buffer": {"write_buffer_size": 16 * 1024},
+}
+
+
+def variant_options(args):
+    from toplingdb_tpu.options import Options
+
+    kw = dict(VARIANTS[args.variant])
+    kw.setdefault("write_buffer_size", args.write_buffer_size)
+    return Options(**kw)
+
+
 def run_stress(args) -> int:
     from toplingdb_tpu.db.db import DB
-    from toplingdb_tpu.options import Options, WriteOptions
+    from toplingdb_tpu.options import WriteOptions
 
     model_path = args.db + ".journal"
     expected = ExpectedState(model_path)
     committed, pending = expected.load()
-    db = DB.open(args.db, Options(write_buffer_size=args.write_buffer_size))
+    db = DB.open(args.db, variant_options(args))
 
     bad = verify(db, committed, pending)
     if bad:
@@ -211,7 +235,7 @@ def run_crash_test(args) -> int:
             sys.executable, "-m", "toplingdb_tpu.tools.db_stress",
             f"--db={args.db}", f"--ops={args.ops}",
             f"--threads={args.threads}", f"--seed={args.seed + round_}",
-            f"--max-key={args.max_key}",
+            f"--max-key={args.max_key}", f"--variant={args.variant}",
         ]
         env = dict(os.environ)
         if args.whitebox:
@@ -241,7 +265,7 @@ def run_crash_test(args) -> int:
     vcmd = [
         sys.executable, "-m", "toplingdb_tpu.tools.db_stress",
         f"--db={args.db}", "--ops=0", "--threads=1",
-        f"--max-key={args.max_key}",
+        f"--max-key={args.max_key}", f"--variant={args.variant}",
     ]
     r = subprocess.run(vcmd, capture_output=True)
     sys.stdout.write(r.stdout.decode())
@@ -260,6 +284,7 @@ def main(argv=None) -> int:
     ap.add_argument("--max-key", type=int, default=2000)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--write-buffer-size", type=int, default=64 * 1024)
+    ap.add_argument("--variant", default="default", choices=sorted(VARIANTS))
     ap.add_argument("--crash-test", action="store_true")
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--kill-after", type=float, default=5.0)
