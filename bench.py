@@ -214,6 +214,12 @@ def db_path_rows(detail, n_db):
     db.close()
     shutil.rmtree(d, ignore_errors=True)
 
+    # CSPP-role trie memtable (reference README.md:50's headline rep)
+    db2, d2, dt2 = fill({"memtable_rep": "cspp"})
+    detail["fillrandom_cspp_ops_s"] = round(n_threads * per_thread / dt2)
+    db2.close()
+    shutil.rmtree(d2, ignore_errors=True)
+
     # unordered + concurrent native memtable insert (the write levers)
     db, d, dt = fill({"unordered_write": True,
                       "allow_concurrent_memtable_write": True})

@@ -165,8 +165,8 @@ class _NGetState:
         handles = []
         for m in [mem] + imm:
             h = getattr(m._rep, "_h", None)
-            if h is None:
-                return None
+            if h is None or not getattr(m._rep, "native_get_probe", False):
+                return None  # rep layout the native probe can't walk
             handles.append(h)
         vh = version.native_read_chain(table_cache)
         if vh is None and any(version.files):

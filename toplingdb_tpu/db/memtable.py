@@ -71,43 +71,56 @@ class MemTableRep:
 
 class NativeSkipListRep(MemTableRep):
     """Arena skiplist in C++ (native/tpulsm_native.cc) — the native memtable
-    (reference InlineSkipList / the CSPP seam). Requires the native lib."""
+    (reference InlineSkipList / the CSPP seam). Requires the native lib.
+
+    The whole ctypes surface is symbol-parameterized (`_sym`): the trie rep
+    below shares every method body, differing only in its native prefix
+    and the next() call shape."""
+
+    # tpulsm_db_get may probe this rep's handle directly (it casts to the
+    # skiplist struct); reps with a different native layout must say no.
+    native_get_probe = True
+    _sym = "tpulsm_skiplist"
+    _entry_sym = "node"  # {sym}_{entry_sym}(pos, ...) decodes a position
 
     def __init__(self):
         from toplingdb_tpu import native
 
         self._l = native.pylib()
-        if self._l is None:
+        if self._l is None or not hasattr(self._l, self._sym + "_new"):
             raise RuntimeError("native library unavailable")
-        self._h = self._l.tpulsm_skiplist_new()
+        self._h = getattr(self._l, self._sym + "_new")()
 
     def __del__(self):
         if getattr(self, "_h", None):
-            self._l.tpulsm_skiplist_free(self._h)
+            getattr(self._l, self._sym + "_free")(self._h)
             self._h = None
+
+    def _next(self, pos):
+        return self._l.tpulsm_skiplist_next(pos)
 
     def insert(self, skey, value: bytes) -> None:
         uk, inv = skey
-        self._l.tpulsm_skiplist_insert(
+        getattr(self._l, self._sym + "_insert")(
             self._h, uk, len(uk), inv, value, len(value)
         )
 
     def insert_wb(self, rep: bytes, first_seq: int):
         """Wire-image batch insert: ONE GIL-releasing native call parses
-        the WriteBatch bytes and splices every point record (lock-free, so
-        concurrent writers scale). Returns (count, mem_delta, deletes) or
-        None when the native side can't take the batch (no symbol,
-        CF-prefixed/range records, corruption → caller falls back)."""
+        the WriteBatch bytes and inserts every point record. Returns
+        (count, mem_delta, deletes) or None when the native side can't
+        take the batch (no symbol, CF-prefixed/range records, corruption
+        → caller falls back)."""
         import ctypes
 
         from toplingdb_tpu import native
 
         cl = native.lib()  # CDLL: releases the GIL during the call
-        if cl is None or not hasattr(cl, "tpulsm_skiplist_insert_wb"):
+        fn = getattr(cl, self._sym + "_insert_wb", None) if cl else None
+        if fn is None:
             return None
         out = (ctypes.c_int64 * 2)()
-        rc = cl.tpulsm_skiplist_insert_wb(self._h, rep, len(rep),
-                                          first_seq, out)
+        rc = fn(self._h, rep, len(rep), first_seq, out)
         if rc < 0:
             return None
         return int(rc), int(out[0]), int(out[1])
@@ -115,13 +128,13 @@ class NativeSkipListRep(MemTableRep):
     def insert_batch(self, keybuf, key_offs, key_lens, invs,
                      valbuf, val_offs, val_lens, n: int) -> None:
         """Bulk insert from flat numpy buffers — ONE ctypes call with the
-        GIL released for the whole loop (the native skiplist insert is
-        lock-free, reference InsertConcurrently), so concurrent writer
-        threads run truly in parallel."""
+        GIL released for the whole loop, so concurrent writer threads run
+        truly in parallel."""
         from toplingdb_tpu import native
 
         cl = native.lib()  # CDLL: releases the GIL during the call
-        if cl is None or not hasattr(cl, "tpulsm_skiplist_insert_batch"):
+        fn = getattr(cl, self._sym + "_insert_batch", None) if cl else None
+        if fn is None:
             for i in range(n):
                 o, ln = key_offs[i], key_lens[i]
                 vo, vl = val_offs[i], val_lens[i]
@@ -131,7 +144,7 @@ class NativeSkipListRep(MemTableRep):
         import ctypes
 
         u64p = ctypes.POINTER(ctypes.c_uint64)
-        cl.tpulsm_skiplist_insert_batch(
+        fn(
             self._h, native.np_u8p(keybuf), native.np_i64p(key_offs),
             native.np_i32p(key_lens),
             invs.ctypes.data_as(u64p), native.np_u8p(valbuf),
@@ -139,10 +152,10 @@ class NativeSkipListRep(MemTableRep):
         )
 
     def __len__(self) -> int:
-        return self._l.tpulsm_skiplist_count(self._h)
+        return getattr(self._l, self._sym + "_count")(self._h)
 
     def memory_usage(self) -> int:
-        return self._l.tpulsm_skiplist_memory(self._h)
+        return getattr(self._l, self._sym + "_memory")(self._h)
 
     def export_columnar(self):
         """Whole-rep ordered export in ONE GIL-releasing native call:
@@ -157,12 +170,13 @@ class NativeSkipListRep(MemTableRep):
         from toplingdb_tpu.ops.columnar_io import ColumnarKV
 
         cl = native.lib()
-        if cl is None or not hasattr(cl, "tpulsm_skiplist_export"):
+        fn = getattr(cl, self._sym + "_export", None) if cl else None
+        if fn is None:
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u64p = ctypes.POINTER(ctypes.c_uint64)
         sizes = np.zeros(3, dtype=np.int64)
-        rows = cl.tpulsm_skiplist_export(
+        rows = fn(
             self._h, ctypes.cast(None, u8p), None, None,
             ctypes.cast(None, u64p), None, ctypes.cast(None, u8p), None,
             None, 0, native.np_i64p(sizes),
@@ -179,7 +193,7 @@ class NativeSkipListRep(MemTableRep):
         val_lens = np.empty(rows, dtype=np.int32)
         seqs = np.empty(rows, dtype=np.uint64)
         vtypes = np.empty(rows, dtype=np.int32)
-        got = cl.tpulsm_skiplist_export(
+        got = fn(
             self._h, native.np_u8p(key_buf), native.np_i64p(key_offs),
             native.np_i32p(key_lens), seqs.ctypes.data_as(u64p),
             native.np_i32p(vtypes), native.np_u8p(val_buf),
@@ -201,7 +215,7 @@ class NativeSkipListRep(MemTableRep):
         inv = ctypes.c_uint64()
         vptr = ctypes.c_void_p()
         vlen = ctypes.c_uint32()
-        self._l.tpulsm_skiplist_node(
+        getattr(self._l, f"{self._sym}_{self._entry_sym}")(
             node, ctypes.byref(kptr), ctypes.byref(klen), ctypes.byref(inv),
             ctypes.byref(vptr), ctypes.byref(vlen),
         )
@@ -211,36 +225,57 @@ class NativeSkipListRep(MemTableRep):
 
     def iter_from(self, skey):
         uk, inv = skey
-        node = self._l.tpulsm_skiplist_seek_ge(self._h, uk, len(uk), inv)
+        node = getattr(self._l, self._sym + "_seek_ge")(
+            self._h, uk, len(uk), inv)
         while node:
             yield self._node_entry(node)
-            node = self._l.tpulsm_skiplist_next(node)
+            node = self._next(node)
 
     def iter_all(self):
-        node = self._l.tpulsm_skiplist_first(self._h)
+        node = getattr(self._l, self._sym + "_first")(self._h)
         while node:
             yield self._node_entry(node)
-            node = self._l.tpulsm_skiplist_next(node)
+            node = self._next(node)
 
     def pos_first(self):
-        return self._l.tpulsm_skiplist_first(self._h) or None
+        return getattr(self._l, self._sym + "_first")(self._h) or None
 
     def pos_last(self):
-        return self._l.tpulsm_skiplist_last(self._h) or None
+        return getattr(self._l, self._sym + "_last")(self._h) or None
 
     def pos_seek_ge(self, skey):
         uk, inv = skey
-        return self._l.tpulsm_skiplist_seek_ge(self._h, uk, len(uk), inv) or None
+        return getattr(self._l, self._sym + "_seek_ge")(
+            self._h, uk, len(uk), inv) or None
 
     def pos_seek_lt(self, skey):
         uk, inv = skey
-        return self._l.tpulsm_skiplist_seek_lt(self._h, uk, len(uk), inv) or None
+        return getattr(self._l, self._sym + "_seek_lt")(
+            self._h, uk, len(uk), inv) or None
 
     def pos_next(self, pos):
-        return self._l.tpulsm_skiplist_next(pos) or None
+        return self._next(pos) or None
 
     def entry_at(self, pos):
         return self._node_entry(pos)
+
+
+class NativeTrieRep(NativeSkipListRep):
+    """Adaptive-radix-trie memtable in C++ — the CSPP role (reference
+    README.md:50: Topling's Crash-Safe Parallel Patricia trie, the 45M
+    ops/s write-path headline; factory seam memtablerep.h:309). Original
+    design: 257 first-byte-striped ART roots (4/16/48/256-way nodes, path
+    compression), per-stripe mutexes so concurrent writers on different
+    key regions never contend; versions hang off one leaf per user key
+    as release-published atomic lists (lockless readers)."""
+
+    native_get_probe = False  # handle is a TrieRep*, not a SkipList*
+    _sym = "tpulsm_trie"
+    _entry_sym = "ver"
+
+    def _next(self, pos):
+        # The trie successor re-descends from the root: needs the handle.
+        return self._l.tpulsm_trie_next(self._h, pos)
 
 
 class PyVectorRep(MemTableRep):
@@ -425,6 +460,13 @@ def create_memtable_rep(name: str) -> MemTableRep:
             return NativeSkipListRep()
         except RuntimeError:
             return PyVectorRep()  # no toolchain: degrade gracefully
+    if name in ("cspp", "trie", "patricia"):
+        # The CSPP-role trie rep (reference README.md:50); degrades to the
+        # skiplist chain when the native lib is unavailable.
+        try:
+            return NativeTrieRep()
+        except RuntimeError:
+            return create_memtable_rep("skiplist")
     if name in ("hash_skiplist", "hash_linklist", "prefix_hash"):
         return HashPrefixRep()
     from toplingdb_tpu.utils.status import InvalidArgument

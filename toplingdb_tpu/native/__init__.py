@@ -183,6 +183,26 @@ def lib() -> ctypes.CDLL | None:
         except AttributeError:
             pass
         try:
+            # Trie rep (CSPP role) GIL-released entry points.
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            l.tpulsm_trie_insert_batch.restype = ctypes.c_int64
+            l.tpulsm_trie_insert_batch.argtypes = [
+                ctypes.c_void_p, u8p, i64p, i32p, u64p,
+                u8p, i64p, i32p, ctypes.c_int64,
+            ]
+            l.tpulsm_trie_insert_wb.restype = ctypes.c_int64
+            l.tpulsm_trie_insert_wb.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_uint64, i64p,
+            ]
+            l.tpulsm_trie_export.restype = ctypes.c_int64
+            l.tpulsm_trie_export.argtypes = [
+                ctypes.c_void_p, u8p, i64p, i32p, u64p, i32p,
+                u8p, i64p, i32p, ctypes.c_int64, i64p,
+            ]
+        except AttributeError:
+            pass
+        try:
             # Native point-read engine: table/version handles + the whole
             # GetImpl chain in one GIL-released call.
             l.tpulsm_table_handle_new.restype = ctypes.c_void_p
@@ -280,6 +300,42 @@ def pylib() -> "ctypes.PyDLL | None":
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_uint32),
     ]
+    try:
+        # Trie memtable rep (the CSPP role) — same shape of surface.
+        l.tpulsm_trie_new.restype = vp
+        l.tpulsm_trie_new.argtypes = []
+        l.tpulsm_trie_free.restype = None
+        l.tpulsm_trie_free.argtypes = [vp]
+        l.tpulsm_trie_insert.restype = ctypes.c_int32
+        l.tpulsm_trie_insert.argtypes = [
+            vp, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        l.tpulsm_trie_count.restype = ctypes.c_int64
+        l.tpulsm_trie_count.argtypes = [vp]
+        l.tpulsm_trie_memory.restype = ctypes.c_int64
+        l.tpulsm_trie_memory.argtypes = [vp]
+        for name in ("tpulsm_trie_seek_ge", "tpulsm_trie_seek_lt"):
+            fn = getattr(l, name)
+            fn.restype = vp
+            fn.argtypes = [vp, ctypes.c_char_p, ctypes.c_uint32,
+                           ctypes.c_uint64]
+        for name in ("tpulsm_trie_first", "tpulsm_trie_last"):
+            fn = getattr(l, name)
+            fn.restype = vp
+            fn.argtypes = [vp]
+        l.tpulsm_trie_next.restype = vp
+        l.tpulsm_trie_next.argtypes = [vp, vp]
+        l.tpulsm_trie_ver.restype = None
+        l.tpulsm_trie_ver.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+    except AttributeError:
+        pass
     _pylib = l
     return _pylib
 

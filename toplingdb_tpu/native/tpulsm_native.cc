@@ -1460,8 +1460,15 @@ int64_t tpulsm_build_data_section_c(
 // Returns inserted count; out[0] = memtable byte delta (k+v+24 per
 // record), out[1] = point-delete count. rc: -2 unsupported record,
 // -4 corrupt. Concurrency-safe (lock-free splice per record).
-int64_t tpulsm_skiplist_insert_wb(void* h, const uint8_t* rep, int64_t len,
-                                  uint64_t first_seq, int64_t* out) {
+// Shared WriteBatch wire-image parse/apply loop: validates the whole
+// image on pass 0 (count header, varint bounds, supported record types),
+// applies on pass 1 through the insert callback. Returns the record
+// count, or -2 (unsupported record: Python path) / -4 (corrupt image).
+extern "C++" {
+template <typename InsertFn>
+static int64_t wb_wire_apply(const uint8_t* rep, int64_t len,
+                             uint64_t first_seq, int64_t* out,
+                             InsertFn&& ins) {
   static const uint8_t kValue = 0x1, kDelete = 0x0, kMerge = 0x2,
                        kSingleDelete = 0x7, kLogData = 0x3;
   if (len < 12) return -4;
@@ -1470,7 +1477,6 @@ int64_t tpulsm_skiplist_insert_wb(void* h, const uint8_t* rep, int64_t len,
                        ((uint32_t)rep[10] << 16) | ((uint32_t)rep[11] << 24);
   for (int pass = 0; pass < 2; pass++) {
     const uint8_t* p = rep + 12;
-    SkipList* sl = static_cast<SkipList*>(h);
     uint64_t seq = first_seq;
     int64_t count = 0, delta = 0, deletes = 0;
     while (p < end) {
@@ -1496,7 +1502,7 @@ int64_t tpulsm_skiplist_insert_wb(void* h, const uint8_t* rep, int64_t len,
       }
       if (pass == 1) {
         uint64_t inv = ~((seq << 8) | (uint64_t)t);
-        sl->insert(k, klen, inv, v, vlen);
+        ins(k, klen, inv, v, vlen);
         delta += (int64_t)klen + vlen + 24;
         if (t == kDelete || t == kSingleDelete) deletes++;
       }
@@ -1512,6 +1518,747 @@ int64_t tpulsm_skiplist_insert_wb(void* h, const uint8_t* rep, int64_t len,
     }
   }
   return -4;  // unreachable
+}
+}  // extern "C++"
+
+int64_t tpulsm_skiplist_insert_wb(void* h, const uint8_t* rep, int64_t len,
+                                  uint64_t first_seq, int64_t* out) {
+  SkipList* sl = static_cast<SkipList*>(h);
+  return wb_wire_apply(rep, len, first_seq, out,
+                       [sl](const uint8_t* k, uint32_t kl, uint64_t inv,
+                            const uint8_t* v, uint32_t vl) {
+                         sl->insert(k, kl, inv, v, vl);
+                       });
+}
+
+// ---------------------------------------------------------------------------
+// Trie memtable rep — the CSPP role (reference README.md:50: Topling's
+// Crash-Safe Parallel Patricia trie memtable, the 45M ops/s headline
+// component; main-tree seam include/rocksdb/memtablerep.h:309).
+//
+// Design is our own, NOT a port: an adaptive radix tree (4/16/48/256-way
+// nodes with path compression) per FIRST-BYTE STRIPE — 257 independent
+// roots (one per leading byte + one for the empty key), each under its
+// own mutex, so concurrent writers on different key regions never
+// contend, and in-stripe descent is mutex-simple rather than lock-free.
+// A leaf holds one USER KEY and its version list sorted by inv
+// ((~(seq<<8|type))) ascending == seqno descending — the memtable order.
+// Versions carry a back-pointer to their leaf, so a position handle is
+// just a Ver*, and the stateless successor re-descends from the root
+// (O(key) — iteration is the cold path; inserts are the hot one).
+// ---------------------------------------------------------------------------
+
+extern "C++" {  // templates may not have C linkage
+namespace {
+
+struct TVer {
+  uint64_t inv;
+  std::atomic<const uint8_t*> val;  // [u32 len][bytes] arena record
+  // Readers traverse version lists WITHOUT the stripe mutex (the tree
+  // descent locks; the returned leaf's list does not), while writers
+  // publish under it — so the links are release-published atomics like
+  // the skiplist's next pointers.
+  std::atomic<TVer*> next;          // next-older (inv ascending)
+  struct TLeafHdr* leaf;
+};
+
+struct TLeafHdr {
+  const uint8_t* key;  // FULL user key (arena copy)
+  uint32_t key_len;
+  std::atomic<TVer*> head;
+};
+
+struct TNode {
+  uint16_t ntype;       // 4, 16, 48, 256
+  uint16_t nkeys;
+  uint32_t prefix_len;
+  const uint8_t* prefix;
+  TLeafHdr* leaf;       // key ending exactly after this node's prefix
+  // N4/N16: keys[] + children[] parallel (sorted); N48: index[256] into
+  // children; N256: children[256].
+  uint8_t* keys;        // N4/N16: size ntype; N48: 256-byte index
+  TNode** children;     // size ntype (N48: 48, N256: 256)
+};
+
+struct TrieStripe {
+  std::mutex mu;
+  Arena arena;
+  TNode* root = nullptr;
+};
+
+struct TrieRep {
+  TrieStripe stripes[257];  // [b] = keys starting with byte b; [256] = ""
+  std::atomic<int64_t> count{0};
+
+  int64_t memory() {
+    int64_t m = 0;
+    for (auto& s : stripes)
+      m += (int64_t)s.arena.total.load(std::memory_order_relaxed);
+    return m;
+  }
+};
+
+TNode* tnode_new(Arena& a, uint16_t ntype, const uint8_t* prefix,
+                 uint32_t plen) {
+  TNode* n = (TNode*)a.alloc(sizeof(TNode));
+  n->ntype = ntype;
+  n->nkeys = 0;
+  n->prefix_len = plen;
+  if (plen) {
+    uint8_t* p = a.alloc(plen);
+    std::memcpy(p, prefix, plen);
+    n->prefix = p;
+  } else {
+    n->prefix = nullptr;
+  }
+  n->leaf = nullptr;
+  if (ntype == 4 || ntype == 16) {
+    n->keys = a.alloc(ntype);
+    n->children = (TNode**)a.alloc(sizeof(TNode*) * ntype);
+  } else if (ntype == 48) {
+    n->keys = a.alloc(256);
+    std::memset(n->keys, 0xFF, 256);
+    n->children = (TNode**)a.alloc(sizeof(TNode*) * 48);
+  } else {
+    n->keys = nullptr;
+    n->children = (TNode**)a.alloc(sizeof(TNode*) * 256);
+    std::memset(n->children, 0, sizeof(TNode*) * 256);
+  }
+  return n;
+}
+
+TNode** tnode_find(TNode* n, uint8_t c) {
+  if (n->ntype == 4 || n->ntype == 16) {
+    for (uint16_t i = 0; i < n->nkeys; i++)
+      if (n->keys[i] == c) return &n->children[i];
+    return nullptr;
+  }
+  if (n->ntype == 48) {
+    return n->keys[c] == 0xFF ? nullptr : &n->children[n->keys[c]];
+  }
+  return n->children[c] ? &n->children[c] : nullptr;
+}
+
+// Grow n to the next node size, copying children. Returns the new node
+// (caller re-links the parent slot).
+TNode* tnode_grow(Arena& a, TNode* n) {
+  if (n->ntype == 4 || n->ntype == 16) {
+    uint16_t nt = n->ntype == 4 ? 16 : 48;
+    TNode* g = tnode_new(a, nt, n->prefix, n->prefix_len);
+    g->leaf = n->leaf;
+    if (nt == 16) {
+      std::memcpy(g->keys, n->keys, n->nkeys);
+      std::memcpy(g->children, n->children, sizeof(TNode*) * n->nkeys);
+      g->nkeys = n->nkeys;
+    } else {
+      for (uint16_t i = 0; i < n->nkeys; i++) {
+        g->keys[n->keys[i]] = (uint8_t)i;
+        g->children[i] = n->children[i];
+      }
+      g->nkeys = n->nkeys;
+    }
+    return g;
+  }
+  // 48 -> 256
+  TNode* g = tnode_new(a, 256, n->prefix, n->prefix_len);
+  g->leaf = n->leaf;
+  for (int c = 0; c < 256; c++)
+    if (n->keys[c] != 0xFF) g->children[c] = n->children[n->keys[c]];
+  g->nkeys = n->nkeys;
+  return g;
+}
+
+// Add child c to n (must not exist); may replace n via growth.
+void tnode_add(Arena& a, TNode** slot, uint8_t c, TNode* child) {
+  TNode* n = *slot;
+  if ((n->ntype == 4 || n->ntype == 16 || n->ntype == 48) &&
+      n->nkeys >= (n->ntype == 48 ? 48 : n->ntype)) {
+    n = tnode_grow(a, n);
+    *slot = n;
+  }
+  if (n->ntype == 4 || n->ntype == 16) {
+    uint16_t i = n->nkeys;
+    while (i > 0 && n->keys[i - 1] > c) {
+      n->keys[i] = n->keys[i - 1];
+      n->children[i] = n->children[i - 1];
+      i--;
+    }
+    n->keys[i] = c;
+    n->children[i] = child;
+    n->nkeys++;
+  } else if (n->ntype == 48) {
+    n->keys[c] = (uint8_t)n->nkeys;
+    n->children[n->nkeys] = child;
+    n->nkeys++;
+  } else {
+    n->children[c] = child;
+    n->nkeys++;
+  }
+}
+
+void tleaf_set_val(Arena& a, TVer* v, const uint8_t* val, uint32_t vl) {
+  uint8_t* rec = a.alloc(4 + vl);
+  std::memcpy(rec, &vl, 4);
+  if (vl) std::memcpy(rec + 4, val, vl);
+  v->val.store(rec, std::memory_order_release);
+}
+
+// Insert a version into leaf's inv-ascending list; replace on exact dup.
+// Returns 1 on fresh insert. Writer-side only (stripe mutex held); the
+// new node is fully initialized before the release-publish, so lockless
+// readers see either the old list or the complete new one.
+int tleaf_add(Arena& a, TLeafHdr* lf, uint64_t inv, const uint8_t* val,
+              uint32_t vl) {
+  std::atomic<TVer*>* pp = &lf->head;
+  TVer* cur = pp->load(std::memory_order_relaxed);
+  while (cur && cur->inv < inv) {
+    pp = &cur->next;
+    cur = pp->load(std::memory_order_relaxed);
+  }
+  if (cur && cur->inv == inv) {
+    tleaf_set_val(a, cur, val, vl);  // WAL-replay duplicate: replace
+    return 0;
+  }
+  TVer* v = (TVer*)a.alloc(sizeof(TVer));
+  v->inv = inv;
+  v->next.store(cur, std::memory_order_relaxed);
+  v->leaf = lf;
+  tleaf_set_val(a, v, val, vl);
+  pp->store(v, std::memory_order_release);
+  return 1;
+}
+
+TLeafHdr* tleaf_new(Arena& a, const uint8_t* full_key, uint32_t kl) {
+  TLeafHdr* lf = (TLeafHdr*)a.alloc(sizeof(TLeafHdr));
+  uint8_t* kc = a.alloc(kl);
+  if (kl) std::memcpy(kc, full_key, kl);
+  lf->key = kc;
+  lf->key_len = kl;
+  lf->head.store(nullptr, std::memory_order_relaxed);
+  return lf;
+}
+
+// Insert (full user key, inv, value) into one stripe (mutex held).
+// `k`/`kl` exclude the stripe byte; `fk`/`fkl` are the full key.
+int trie_insert_locked(TrieStripe& st, const uint8_t* k, uint32_t kl,
+                       const uint8_t* fk, uint32_t fkl, uint64_t inv,
+                       const uint8_t* val, uint32_t vl) {
+  Arena& a = st.arena;
+  if (!st.root) st.root = tnode_new(a, 4, nullptr, 0);
+  TNode** slot = &st.root;
+  uint32_t d = 0;
+  while (true) {
+    TNode* n = *slot;
+    uint32_t m = 0;
+    uint32_t rem = kl - d;
+    while (m < n->prefix_len && m < rem && n->prefix[m] == k[d + m]) m++;
+    if (m < n->prefix_len) {
+      // Split: parent keeps prefix[0..m); old node trims to m+1..;
+      // the new key either ends at the split (parent leaf) or branches.
+      TNode* parent = tnode_new(a, 4, n->prefix, m);
+      uint8_t old_c = n->prefix[m];
+      // trim n's prefix in place
+      n->prefix = n->prefix + m + 1;
+      n->prefix_len -= m + 1;
+      tnode_add(a, &parent, old_c, n);
+      if (rem == m) {
+        parent->leaf = tleaf_new(a, fk, fkl);
+        *slot = parent;
+        return tleaf_add(a, parent->leaf, inv, val, vl);
+      }
+      TNode* nb = tnode_new(a, 4, k + d + m + 1, rem - m - 1);
+      nb->leaf = tleaf_new(a, fk, fkl);
+      tnode_add(a, &parent, k[d + m], nb);
+      *slot = parent;
+      return tleaf_add(a, nb->leaf, inv, val, vl);
+    }
+    d += n->prefix_len;
+    if (d == kl) {
+      if (!n->leaf) n->leaf = tleaf_new(a, fk, fkl);
+      return tleaf_add(a, n->leaf, inv, val, vl);
+    }
+    uint8_t c = k[d];
+    TNode** child = tnode_find(n, c);
+    if (!child) {
+      TNode* nb = tnode_new(a, 4, k + d + 1, kl - d - 1);
+      nb->leaf = tleaf_new(a, fk, fkl);
+      tnode_add(a, slot, c, nb);
+      return tleaf_add(a, nb->leaf, inv, val, vl);
+    }
+    slot = child;
+    d++;
+  }
+}
+
+int trie_insert(TrieRep* t, const uint8_t* k, uint32_t kl, uint64_t inv,
+                const uint8_t* val, uint32_t vl) {
+  int s = kl ? k[0] : 256;
+  TrieStripe& st = t->stripes[s];
+  std::lock_guard<std::mutex> g(st.mu);
+  int fresh = trie_insert_locked(st, kl ? k + 1 : k, kl ? kl - 1 : 0,
+                                 k, kl, inv, val, vl);
+  if (fresh) t->count.fetch_add(1, std::memory_order_relaxed);
+  return fresh;
+}
+
+// Smallest / largest leaf of a subtree (descending by child order).
+TLeafHdr* tmin_leaf(TNode* n) {
+  while (n) {
+    if (n->leaf) return n->leaf;  // key-ends-here sorts before children
+    if (n->ntype == 4 || n->ntype == 16) {
+      n = n->nkeys ? n->children[0] : nullptr;
+    } else if (n->ntype == 48) {
+      TNode* nx = nullptr;
+      for (int c = 0; c < 256 && !nx; c++)
+        if (n->keys[c] != 0xFF) nx = n->children[n->keys[c]];
+      n = nx;
+    } else {
+      TNode* nx = nullptr;
+      for (int c = 0; c < 256 && !nx; c++)
+        if (n->children[c]) nx = n->children[c];
+      n = nx;
+    }
+  }
+  return nullptr;
+}
+
+TLeafHdr* tmax_leaf(TNode* n) {
+  TLeafHdr* best = nullptr;
+  while (n) {
+    TNode* nx = nullptr;
+    if (n->ntype == 4 || n->ntype == 16) {
+      nx = n->nkeys ? n->children[n->nkeys - 1] : nullptr;
+    } else if (n->ntype == 48) {
+      for (int c = 255; c >= 0 && !nx; c--)
+        if (n->keys[c] != 0xFF) nx = n->children[n->keys[c]];
+    } else {
+      for (int c = 255; c >= 0 && !nx; c--)
+        if (n->children[c]) nx = n->children[c];
+    }
+    if (!nx) return n->leaf ? n->leaf : best;
+    if (n->leaf) best = n->leaf;  // deeper children are LARGER than leaf
+    n = nx;
+  }
+  return best;
+}
+
+// First leaf with key >= probe within one stripe (nullptr if none).
+TLeafHdr* trie_lower_bound(TNode* root, const uint8_t* k, uint32_t kl) {
+  TNode* n = root;
+  uint32_t d = 0;
+  TLeafHdr* succ = nullptr;  // min leaf of the nearest greater subtree
+  while (n) {
+    uint32_t rem = kl - d;
+    uint32_t m = 0;
+    while (m < n->prefix_len && m < rem && n->prefix[m] == k[d + m]) m++;
+    if (m < n->prefix_len) {
+      if (m == rem || k[d + m] < n->prefix[m]) return tmin_leaf(n);
+      return succ;  // whole subtree < probe
+    }
+    d += n->prefix_len;
+    if (d == kl) return tmin_leaf(n);  // node's min is >= probe
+    uint8_t c = k[d];
+    // Successor candidate: smallest child byte > c.
+    TNode* nx_gt = nullptr;
+    if (n->ntype == 4 || n->ntype == 16) {
+      for (uint16_t i = 0; i < n->nkeys; i++)
+        if (n->keys[i] > c) { nx_gt = n->children[i]; break; }
+    } else if (n->ntype == 48) {
+      for (int b = c + 1; b < 256 && !nx_gt; b++)
+        if (n->keys[b] != 0xFF) nx_gt = n->children[n->keys[b]];
+    } else {
+      for (int b = c + 1; b < 256 && !nx_gt; b++)
+        if (n->children[b]) nx_gt = n->children[b];
+    }
+    if (nx_gt) {
+      TLeafHdr* lm = tmin_leaf(nx_gt);
+      if (lm) succ = lm;
+    }
+    TNode** child = tnode_find(n, c);
+    if (!child) return succ;
+    n = *child;
+    d++;
+  }
+  return succ;
+}
+
+// Last leaf with key strictly < probe within one stripe.
+TLeafHdr* trie_pred(TNode* root, const uint8_t* k, uint32_t kl) {
+  TNode* n = root;
+  uint32_t d = 0;
+  TLeafHdr* pred = nullptr;
+  while (n) {
+    uint32_t rem = kl - d;
+    uint32_t m = 0;
+    while (m < n->prefix_len && m < rem && n->prefix[m] == k[d + m]) m++;
+    if (m < n->prefix_len) {
+      if (m == rem || k[d + m] < n->prefix[m]) return pred;
+      return tmax_leaf(n);  // whole subtree < probe
+    }
+    d += n->prefix_len;
+    if (d == kl) return pred;  // node min == probe's position
+    if (n->leaf) pred = n->leaf;  // "ends here" < any longer key
+    uint8_t c = k[d];
+    TNode* nx_lt = nullptr;
+    if (n->ntype == 4 || n->ntype == 16) {
+      for (int i = (int)n->nkeys - 1; i >= 0; i--)
+        if (n->keys[i] < c) { nx_lt = n->children[i]; break; }
+    } else if (n->ntype == 48) {
+      for (int b = c - 1; b >= 0 && !nx_lt; b--)
+        if (n->keys[b] != 0xFF) nx_lt = n->children[n->keys[b]];
+    } else {
+      for (int b = c - 1; b >= 0 && !nx_lt; b--)
+        if (n->children[b]) nx_lt = n->children[b];
+    }
+    if (nx_lt) {
+      TLeafHdr* lm = tmax_leaf(nx_lt);
+      if (lm) pred = lm;
+    }
+    TNode** child = tnode_find(n, c);
+    if (!child) return pred;
+    n = *child;
+    d++;
+  }
+  return pred;
+}
+
+// Stripe-aware leaf lookups over the whole rep.
+TLeafHdr* trie_leaf_ge(TrieRep* t, const uint8_t* k, uint32_t kl) {
+  int s0 = kl ? k[0] : 256;
+  if (s0 == 256) {  // empty probe: empty-key stripe first, then 0..255
+    TrieStripe& se = t->stripes[256];
+    {
+      std::lock_guard<std::mutex> g(se.mu);
+      if (se.root) {
+        TLeafHdr* lf = tmin_leaf(se.root);
+        if (lf) return lf;
+      }
+    }
+    for (int s = 0; s < 256; s++) {
+      std::lock_guard<std::mutex> g(t->stripes[s].mu);
+      if (t->stripes[s].root) {
+        TLeafHdr* lf = tmin_leaf(t->stripes[s].root);
+        if (lf) return lf;
+      }
+    }
+    return nullptr;
+  }
+  {
+    TrieStripe& st = t->stripes[s0];
+    std::lock_guard<std::mutex> g(st.mu);
+    if (st.root) {
+      TLeafHdr* lf = trie_lower_bound(st.root, k + 1, kl - 1);
+      if (lf) return lf;
+    }
+  }
+  for (int s = s0 + 1; s < 256; s++) {
+    std::lock_guard<std::mutex> g(t->stripes[s].mu);
+    if (t->stripes[s].root) {
+      TLeafHdr* lf = tmin_leaf(t->stripes[s].root);
+      if (lf) return lf;
+    }
+  }
+  return nullptr;
+}
+
+TLeafHdr* trie_leaf_lt(TrieRep* t, const uint8_t* k, uint32_t kl) {
+  int s0 = kl ? k[0] : 256;
+  if (s0 != 256) {
+    TrieStripe& st = t->stripes[s0];
+    std::lock_guard<std::mutex> g(st.mu);
+    if (st.root) {
+      TLeafHdr* lf = trie_pred(st.root, k + 1, kl - 1);
+      if (lf) return lf;
+    }
+  }
+  int hi = s0 == 256 ? -1 : s0 - 1;  // empty key: nothing precedes
+  for (int s = hi; s >= 0; s--) {
+    std::lock_guard<std::mutex> g(t->stripes[s].mu);
+    if (t->stripes[s].root) {
+      TLeafHdr* lf = tmax_leaf(t->stripes[s].root);
+      if (lf) return lf;
+    }
+  }
+  if (s0 != 256) {  // empty-key stripe precedes every non-empty key
+    TrieStripe& se = t->stripes[256];
+    std::lock_guard<std::mutex> g(se.mu);
+    if (se.root) {
+      TLeafHdr* lf = tmax_leaf(se.root);
+      if (lf) return lf;
+    }
+  }
+  return nullptr;
+}
+
+// DFS export of one stripe (mutex held by caller), leaves in key order.
+template <typename F>
+void trie_walk(TNode* n, F&& fn) {
+  if (!n) return;
+  if (n->leaf) fn(n->leaf);
+  if (n->ntype == 4 || n->ntype == 16) {
+    for (uint16_t i = 0; i < n->nkeys; i++) trie_walk(n->children[i], fn);
+  } else if (n->ntype == 48) {
+    for (int c = 0; c < 256; c++)
+      if (n->keys[c] != 0xFF) trie_walk(n->children[n->keys[c]], fn);
+  } else {
+    for (int c = 0; c < 256; c++)
+      if (n->children[c]) trie_walk(n->children[c], fn);
+  }
+}
+
+template <typename F>
+void trie_walk_all(TrieRep* t, F&& fn) {
+  {
+    // The empty key sorts before every non-empty key.
+    TrieStripe& se = t->stripes[256];
+    std::lock_guard<std::mutex> g(se.mu);
+    trie_walk(se.root, fn);
+  }
+  for (int s = 0; s < 256; s++) {
+    TrieStripe& st = t->stripes[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    trie_walk(st.root, fn);
+  }
+}
+
+}  // namespace
+}  // extern "C++"
+
+void* tpulsm_trie_new() { return new (std::nothrow) TrieRep(); }
+void tpulsm_trie_free(void* h) { delete static_cast<TrieRep*>(h); }
+
+int32_t tpulsm_trie_insert(void* h, const uint8_t* k, uint32_t kl,
+                           uint64_t inv, const uint8_t* v, uint32_t vl) {
+  return trie_insert(static_cast<TrieRep*>(h), k, kl, inv, v, vl);
+}
+
+int64_t tpulsm_trie_count(void* h) {
+  return static_cast<TrieRep*>(h)->count.load(std::memory_order_relaxed);
+}
+
+int64_t tpulsm_trie_memory(void* h) {
+  return static_cast<TrieRep*>(h)->memory();
+}
+
+int64_t tpulsm_trie_insert_batch(
+    void* h, const uint8_t* keybuf, const int64_t* key_offs,
+    const int32_t* key_lens, const uint64_t* invs, const uint8_t* valbuf,
+    const int64_t* val_offs, const int32_t* val_lens, int64_t n) {
+  TrieRep* t = static_cast<TrieRep*>(h);
+  int64_t fresh = 0;
+  for (int64_t i = 0; i < n; i++) {
+    fresh += trie_insert(t, keybuf + key_offs[i], (uint32_t)key_lens[i],
+                         invs[i], valbuf + val_offs[i],
+                         (uint32_t)val_lens[i]);
+  }
+  return fresh;
+}
+
+int64_t tpulsm_trie_insert_wb(void* h, const uint8_t* rep, int64_t len,
+                              uint64_t first_seq, int64_t* out) {
+  TrieRep* t = static_cast<TrieRep*>(h);
+  return wb_wire_apply(rep, len, first_seq, out,
+                       [t](const uint8_t* k, uint32_t kl, uint64_t inv,
+                           const uint8_t* v, uint32_t vl) {
+                         trie_insert(t, k, kl, inv, v, vl);
+                       });
+}
+
+// Position protocol: a position is a TVer*. seek_ge finds the first
+// (key, inv) pair >= probe; next follows the version list, then
+// re-descends for the successor key (stateless).
+void* tpulsm_trie_seek_ge(void* h, const uint8_t* k, uint32_t kl,
+                          uint64_t inv) {
+  TrieRep* t = static_cast<TrieRep*>(h);
+  TLeafHdr* lf = trie_leaf_ge(t, k, kl);
+  while (lf) {
+    if ((lf->key_len == kl && kl && std::memcmp(lf->key, k, kl) == 0)
+        || (lf->key_len == 0 && kl == 0)) {
+      for (TVer* v = lf->head.load(std::memory_order_acquire); v;
+           v = v->next.load(std::memory_order_acquire))
+        if (v->inv >= inv) return v;
+    } else {
+      return lf->head.load(std::memory_order_acquire);  // greater key
+    }
+    // Same key exhausted below inv: successor key = first leaf > key.
+    // Re-probe with key + 0x00 appended (smallest strict extension).
+    std::string tmp((const char*)lf->key, lf->key_len);
+    tmp.push_back('\0');
+    TLeafHdr* nx = trie_leaf_ge(t, (const uint8_t*)tmp.data(),
+                                (uint32_t)tmp.size());
+    if (nx == lf) return nullptr;  // defensive; cannot match
+    lf = nx;
+    if (lf) return lf->head.load(std::memory_order_acquire);
+    return nullptr;
+  }
+  return nullptr;
+}
+
+void* tpulsm_trie_first(void* h) {
+  TrieRep* t = static_cast<TrieRep*>(h);
+  TLeafHdr* lf = trie_leaf_ge(t, nullptr, 0);
+  return lf ? lf->head.load(std::memory_order_acquire) : nullptr;
+}
+
+void* tpulsm_trie_last(void* h) {
+  TrieRep* t = static_cast<TrieRep*>(h);
+  for (int s = 255; s >= 0; s--) {
+    std::lock_guard<std::mutex> g(t->stripes[s].mu);
+    if (t->stripes[s].root) {
+      TLeafHdr* lf = tmax_leaf(t->stripes[s].root);
+      if (lf) {
+        TVer* v = lf->head.load(std::memory_order_acquire);
+        while (v) {
+          TVer* nx = v->next.load(std::memory_order_acquire);
+          if (!nx) break;
+          v = nx;
+        }
+        return v;
+      }
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(t->stripes[256].mu);
+    if (t->stripes[256].root) {
+      TLeafHdr* lf = tmax_leaf(t->stripes[256].root);
+      if (lf) {
+        TVer* v = lf->head.load(std::memory_order_acquire);
+        while (v) {
+          TVer* nx = v->next.load(std::memory_order_acquire);
+          if (!nx) break;
+          v = nx;
+        }
+        return v;
+      }
+    }
+  }
+  return nullptr;
+}
+
+void* tpulsm_trie_next(void* h, void* pos) {
+  TVer* v = static_cast<TVer*>(pos);
+  TVer* nv = v->next.load(std::memory_order_acquire);
+  if (nv) return nv;
+  TLeafHdr* lf = v->leaf;
+  TrieRep* t = static_cast<TrieRep*>(h);
+  std::string tmp((const char*)lf->key, lf->key_len);
+  tmp.push_back('\0');
+  TLeafHdr* nx = trie_leaf_ge(t, (const uint8_t*)tmp.data(),
+                              (uint32_t)tmp.size());
+  return nx ? nx->head.load(std::memory_order_acquire) : nullptr;
+}
+
+// Last (key, inv) strictly BEFORE the probe pair.
+void* tpulsm_trie_seek_lt(void* h, const uint8_t* k, uint32_t kl,
+                          uint64_t inv) {
+  TrieRep* t = static_cast<TrieRep*>(h);
+  // Same-key versions with v->inv < inv come first (they sort before).
+  TLeafHdr* lf = nullptr;
+  {
+    int s0 = kl ? k[0] : 256;
+    TrieStripe& st = t->stripes[s0];
+    std::lock_guard<std::mutex> g(st.mu);
+    if (st.root) {
+      // exact-key leaf?
+      TLeafHdr* cand =
+          s0 == 256 ? (st.root->prefix_len == 0 ? st.root->leaf : nullptr)
+                    : trie_lower_bound(st.root, k + 1, kl - 1);
+      if (cand && cand->key_len == kl &&
+          (kl == 0 || std::memcmp(cand->key, k, kl) == 0))
+        lf = cand;
+    }
+  }
+  if (lf) {
+    TVer* best = nullptr;
+    for (TVer* v = lf->head.load(std::memory_order_acquire);
+         v && v->inv < inv; v = v->next.load(std::memory_order_acquire))
+      best = v;
+    if (best) return best;
+  }
+  TLeafHdr* pl = trie_leaf_lt(t, k, kl);
+  if (!pl) return nullptr;
+  TVer* v = pl->head.load(std::memory_order_acquire);
+  while (v) {
+    TVer* nx = v->next.load(std::memory_order_acquire);
+    if (!nx) break;
+    v = nx;
+  }
+  return v;
+}
+
+void tpulsm_trie_ver(void* pos, const uint8_t** k, uint32_t* kl,
+                     uint64_t* inv, const uint8_t** v, uint32_t* vl) {
+  TVer* ver = static_cast<TVer*>(pos);
+  *k = ver->leaf->key;
+  *kl = ver->leaf->key_len;
+  *inv = ver->inv;
+  const uint8_t* rec = ver->val.load(std::memory_order_acquire);
+  uint32_t len;
+  std::memcpy(&len, rec, 4);
+  *v = rec + 4;
+  *vl = len;
+}
+
+// Ordered whole-rep export — same contract as tpulsm_skiplist_export.
+int64_t tpulsm_trie_export(
+    void* h, uint8_t* key_buf, int64_t* key_offs, int32_t* key_lens,
+    uint64_t* seqs, int32_t* vtypes, uint8_t* val_buf, int64_t* val_offs,
+    int32_t* val_lens, int64_t max_rows, int64_t* out_sizes) {
+  TrieRep* t = static_cast<TrieRep*>(h);
+  if (key_buf == nullptr) {
+    int64_t kb = 0, vb = 0, rows = 0;
+    trie_walk_all(t, [&](TLeafHdr* lf) {
+      for (TVer* v = lf->head.load(std::memory_order_acquire); v;
+           v = v->next.load(std::memory_order_acquire)) {
+        const uint8_t* rec = v->val.load(std::memory_order_acquire);
+        uint32_t vl;
+        std::memcpy(&vl, rec, 4);
+        kb += lf->key_len + 8;
+        vb += vl;
+        rows++;
+      }
+    });
+    out_sizes[0] = kb;
+    out_sizes[1] = vb;
+    out_sizes[2] = rows;
+    return rows;
+  }
+  const int64_t key_cap = out_sizes[0], val_cap = out_sizes[1];
+  int64_t ko = 0, vo = 0, rows = 0;
+  bool overflow = false;
+  trie_walk_all(t, [&](TLeafHdr* lf) {
+    if (overflow) return;
+    for (TVer* v = lf->head.load(std::memory_order_acquire); v;
+         v = v->next.load(std::memory_order_acquire)) {
+      if (rows >= max_rows) {
+        overflow = true;
+        return;
+      }
+      const uint8_t* rec = v->val.load(std::memory_order_acquire);
+      uint32_t vl;
+      std::memcpy(&vl, rec, 4);
+      if (ko + (int64_t)lf->key_len + 8 > key_cap ||
+          vo + (int64_t)vl > val_cap) {
+        overflow = true;
+        return;
+      }
+      uint64_t packed = ~v->inv;
+      std::memcpy(key_buf + ko, lf->key, lf->key_len);
+      for (int b = 0; b < 8; b++)
+        key_buf[ko + lf->key_len + b] = (uint8_t)(packed >> (8 * b));
+      key_offs[rows] = ko;
+      key_lens[rows] = (int32_t)(lf->key_len + 8);
+      seqs[rows] = packed >> 8;
+      vtypes[rows] = (int32_t)(packed & 0xFF);
+      std::memcpy(val_buf + vo, rec + 4, vl);
+      val_offs[rows] = vo;
+      val_lens[rows] = (int32_t)vl;
+      ko += lf->key_len + 8;
+      vo += vl;
+      rows++;
+    }
+  });
+  return overflow ? -1 : rows;
 }
 
 // ---------------------------------------------------------------------------
