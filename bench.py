@@ -224,6 +224,11 @@ def db_path_rows(detail, n_db):
     db, d, dt = fill({"unordered_write": True,
                       "allow_concurrent_memtable_write": True})
     detail["fillrandom_unordered_ops_s"] = round(n_threads * per_thread / dt)
+    # Drain this (kept-open) DB's background queue BEFORE the write-path
+    # rows: timing them against leftover flush/compaction load understates
+    # the write path by 3-4x.
+    db.flush()
+    db.wait_for_compactions()
 
     # Write-PATH rows: batches prebuilt, so the measurement isolates
     # queue + WAL + memtable insert (what the unordered/concurrent levers

@@ -105,3 +105,101 @@ def test_db_bench_full_workload_matrix(tmp_path, capsys):
         assert re.search(rf"^{name} ", out, re.M), \
             f"workload {name} produced no report line"
     assert "unknown benchmark" not in out
+
+
+def test_db_start_trace_records_everything(tmp_path):
+    """DB::StartTrace role: every Get/Write/MultiGet/Iterator-seek issued
+    through the DB is captured (not just calls routed through the wrapper
+    Tracer), and the Replayer reproduces the workload's end state."""
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.trace import Replayer, read_trace
+
+    src = str(tmp_path / "src")
+    trace = str(tmp_path / "ops.trace")
+    with DB.open(src, Options(create_if_missing=True)) as db:
+        db.start_trace(trace)
+        for i in range(200):
+            db.put(b"k%04d" % i, b"v%d" % i)
+        db.delete(b"k0007")
+        db.get(b"k0005")
+        db.multi_get([b"k0001", b"k0002"])
+        it = db.new_iterator()
+        it.seek(b"k0100")
+        assert it.valid() and it.key() == b"k0100"
+        db.end_trace()
+        # post-end ops must NOT be recorded
+        db.put(b"untraced", b"x")
+
+    from toplingdb_tpu.env import default_env
+
+    ops = list(read_trace(default_env(), trace))
+    kinds = [op for op, _, _ in ops]
+    from toplingdb_tpu.utils import trace as T
+
+    assert kinds.count(T.OP_WRITE_BATCH) == 201  # 200 puts + 1 delete
+    assert T.OP_GET in kinds and T.OP_MULTIGET in kinds
+    assert T.OP_ITER_SEEK in kinds
+    assert not any(s and s[0] == b"untraced" for _, _, s in ops)
+
+    dst = str(tmp_path / "dst")
+    with DB.open(dst, Options(create_if_missing=True)) as db2:
+        n = Replayer(db2, trace).replay()
+        assert n == len(ops)
+        assert db2.get(b"k0005") == b"v5"
+        assert db2.get(b"k0007") is None
+        assert db2.get(b"untraced") is None
+
+
+def test_trace_sampling_and_size_cap(tmp_path):
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.trace import TraceOptions, read_trace
+
+    trace = str(tmp_path / "s.trace")
+    with DB.open(str(tmp_path / "db"),
+                 Options(create_if_missing=True)) as db:
+        db.start_trace(trace, TraceOptions(sampling_frequency=10))
+        for i in range(500):
+            db.get(b"k%d" % i)
+        db.end_trace()
+    ops = list(read_trace(default_env(), trace))
+    assert len(ops) == 50  # exactly 1-in-10
+
+    cap = str(tmp_path / "cap.trace")
+    with DB.open(str(tmp_path / "db2"),
+                 Options(create_if_missing=True)) as db:
+        db.start_trace(cap, TraceOptions(max_trace_file_size=2000))
+        for i in range(5000):
+            db.get(b"key%06d" % i)
+        assert db._op_tracer.stopped
+        db.end_trace()
+    sz = len(open(cap, "rb").read())
+    assert sz <= 4096  # stopped near the cap, not 5000 records
+
+
+def test_replay_timing_faithful_speedup(tmp_path):
+    import time as _time
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.trace import Replayer
+
+    trace = str(tmp_path / "t.trace")
+    with DB.open(str(tmp_path / "db"),
+                 Options(create_if_missing=True)) as db:
+        db.start_trace(trace)
+        db.put(b"a", b"1")
+        _time.sleep(0.3)
+        db.put(b"b", b"2")
+        db.end_trace()
+    with DB.open(str(tmp_path / "dst"),
+                 Options(create_if_missing=True)) as db2:
+        t0 = _time.time()
+        Replayer(db2, trace).replay(fast_forward=False, speedup=10.0)
+        dt = _time.time() - t0
+        assert dt < 0.25, dt  # 0.3s gap compressed ~10x
+        t0 = _time.time()
+        Replayer(db2, trace).replay(fast_forward=False, speedup=1.0)
+        assert _time.time() - t0 >= 0.25  # faithful replay keeps the gap

@@ -53,6 +53,7 @@ class CompactionStats:
     # path (device wait happens inside the encode loop), so they need not sum
     # to work_time_usec.
     input_scan_usec: int = 0    # SST read + block decode into columnar bufs
+    host_compute_usec: int = 0  # host-twin sort+GC (accelerator-less mode)
     device_wait_usec: int = 0   # blocking waits on device compute + D2H
     resolve_usec: int = 0       # host complex-group (merge/SD) resolution
     encode_write_usec: int = 0  # SST block build + frame + file write
@@ -62,9 +63,9 @@ class CompactionStats:
     def phase_dict(self) -> dict:
         """Non-zero timing phases, seconds — for bench/dcompact reporting."""
         out = {}
-        for f in ("input_scan_usec", "transfer_time_usec",
-                  "device_wait_usec", "resolve_usec", "encode_write_usec",
-                  "work_time_usec"):
+        for f in ("input_scan_usec", "host_compute_usec",
+                  "transfer_time_usec", "device_wait_usec", "resolve_usec",
+                  "encode_write_usec", "work_time_usec"):
             v = getattr(self, f)
             if v:
                 out[f.replace("_usec", "_s")] = round(v / 1e6, 3)

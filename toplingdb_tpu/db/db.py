@@ -198,6 +198,7 @@ class DB:
         self.env = env
         self.icmp = InternalKeyComparator(options.comparator)
         self._nget_tl = threading.local()  # native-get per-thread state
+        self._op_tracer = None             # DB::StartTrace recorder
         if (options.prefix_extractor is not None
                 and options.table_options.prefix_extractor is None):
             # CF-level extractor feeds the table layer (prefix blooms, plain
@@ -667,6 +668,9 @@ class DB:
         if batch.is_empty():
             return
         self._check_open()  # fail fast before any stall sleep
+        tr = self._op_tracer
+        if tr is not None:
+            tr.record_write(batch.data())
         if self.stats is not None:
             import time as _t
 
@@ -1171,6 +1175,27 @@ class DB:
     # Read path
     # ==================================================================
 
+    # -- workload tracing (reference DB::StartTrace / EndTrace) ----------
+
+    def start_trace(self, trace_path: str, options=None) -> None:
+        """Record every subsequent Get/MultiGet/Write/Iterator-seek to
+        `trace_path` until end_trace (reference DB::StartTrace,
+        trace_replay/trace_replay.cc). Replay with utils.trace.Replayer."""
+        from toplingdb_tpu.utils.trace import OpTracer
+
+        self._check_open()
+        if self._op_tracer is not None:
+            from toplingdb_tpu.utils.status import InvalidArgument
+
+            raise InvalidArgument("a trace is already being recorded")
+        self._op_tracer = OpTracer(self.env, trace_path, options)
+
+    def end_trace(self) -> None:
+        tr = self._op_tracer
+        self._op_tracer = None
+        if tr is not None:
+            tr.close()
+
     def _nget_state(self, cfd, opts):
         """Shared eligibility gate + per-thread call state for the native
         read fast paths. Returns (lib, state) with state None when the
@@ -1325,6 +1350,9 @@ class DB:
         """Point lookup (reference DBImpl::GetImpl, db_impl.cc:2079).
         Returns None if not found."""
         self._check_open()
+        tr = self._op_tracer
+        if tr is not None:
+            tr.record_get(key)
         if self.icmp.user_comparator.timestamp_size:
             return self._get_with_ts(key, opts, cf)
         self._check_read_ts(opts)
@@ -1658,6 +1686,9 @@ class DB:
         groups all keys per source so each memtable/file is visited once,
         instead of per-key)."""
         self._check_open()
+        tr = self._op_tracer
+        if tr is not None:
+            tr.record_multiget(keys)
         self._check_read_ts(opts)
         t_mg = time.perf_counter() if self.stats is not None else 0.0
         res = self._multi_get_impl(keys, opts, cf)
@@ -1834,9 +1865,15 @@ class DB:
 
             from toplingdb_tpu.db.forward_iterator import ForwardIterator
 
-            return ForwardIterator(
+            fwd = ForwardIterator(
                 self, _dcs.replace(opts, tailing=False), cf=cf
             )
+            tr = self._op_tracer
+            if tr is not None:
+                from toplingdb_tpu.utils.trace import TracingIterator
+
+                return TracingIterator(fwd, tr)
+            return fwd
         cfd = self._cf_data(cf)
         with self._mutex:
             snap_seq = (
@@ -1895,6 +1932,11 @@ class DB:
 
                 it.stats = self.stats
                 self.stats.record_tick(st.NO_ITERATOR_CREATED)
+            tr = self._op_tracer
+            if tr is not None:
+                from toplingdb_tpu.utils.trace import TracingIterator
+
+                return TracingIterator(it, tr)
             return it
 
     def _excluded_for(self, opts) -> tuple:

@@ -68,6 +68,10 @@ class DBIter:
 
             raise NotSupported("iterator was not created by DB.new_iterator")
         fresh = self._refresh_fn()
+        # A trace-wrapping proxy may come back; rebind to the REAL DBIter
+        # underneath (copying the proxy's __dict__ would silently keep the
+        # old sources).
+        fresh = getattr(fresh, "_it", fresh)
         fn = self._refresh_fn
         self.__dict__.update(fresh.__dict__)
         self._refresh_fn = fn

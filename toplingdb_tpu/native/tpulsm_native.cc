@@ -8,6 +8,7 @@
 // Build: g++ -O3 -shared -fPIC -std=c++17 -pthread \
 //          -o _tpulsm_native.so tpulsm_native.cc
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <cstdint>
 #include <cstddef>
@@ -2849,33 +2850,96 @@ int32_t tpulsm_getctx_multiget(void* ctx, const uint8_t* keybuf,
                                int64_t* arena_used, int64_t* counters) {
   NGetCtx* c = static_cast<NGetCtx*>(ctx);
   for (int i = 0; i < NC_COUNT; i++) counters[i] = 0;
-  int64_t used = 0;
-  int64_t tmp_ctr[NC_COUNT];
-  for (int64_t i = 0; i < n; i++) {
+
+  // One key's chain walk (writing into [lo, hi) of the arena). Returns
+  // bytes consumed, or -1 on arena-slice overflow.
+  auto walk = [&](int64_t i, int64_t lo, int64_t hi,
+                  int64_t* ctr) -> int64_t {
     const uint8_t* k = keybuf + key_offs[i];
     int32_t kl = key_lens[i];
     int32_t vlen = 0, src = -1;
+    int64_t tmp_ctr[NC_COUNT];
     int32_t rc = tpulsm_db_get(
         c->mems.data(), (int32_t)c->mems.size(), c->version, k, kl,
-        snap_seq, val_arena + used,
-        (int32_t)std::min<int64_t>(arena_cap - used, (1u << 31) - 1),
+        snap_seq, val_arena + lo,
+        (int32_t)std::min<int64_t>(hi - lo, (1u << 31) - 1),
         &vlen, &src, tmp_ctr);
-    for (int t = 0; t < NC_COUNT; t++) counters[t] += tmp_ctr[t];
-    if (rc == -1) return -2;  // arena exhausted: grow + retry whole batch
+    for (int t = 0; t < NC_COUNT; t++) ctr[t] += tmp_ctr[t];
+    if (rc == -1) return -1;
     if (rc == 1) {
       status_out[i] = 1;
-      val_offs_out[i] = used;
+      val_offs_out[i] = lo;
       val_lens_out[i] = vlen;
-      used += vlen;
-    } else if (rc == 0) {
-      status_out[i] = 0;
-      val_offs_out[i] = 0;
-      val_lens_out[i] = 0;
-    } else {
-      status_out[i] = 2;
-      val_offs_out[i] = 0;
-      val_lens_out[i] = 0;
+      return vlen;
     }
+    status_out[i] = rc == 0 ? 0 : 2;
+    val_offs_out[i] = 0;
+    val_lens_out[i] = 0;
+    return 0;
+  };
+
+  // Parallel chain walks for big batches — the fiber/io_uring MultiGet
+  // role (reference db_impl.cc:3026-3227): every structure on the path
+  // is read-safe (mutex-sharded block cache, atomic skiplist/trie links,
+  // pread), so keys fan out across threads, each with its own contiguous
+  // arena slice (value offsets stay global; no post-join copying).
+  size_t want = effective_cpus();
+  size_t nthreads = std::min<size_t>(std::min<size_t>(want, 8),
+                                     (size_t)(n / 64));
+  if (nthreads >= 2) {
+    std::vector<std::thread> pool;
+    std::vector<int64_t> used_per(nthreads, 0);
+    std::vector<std::array<int64_t, NC_COUNT>> ctrs(nthreads);
+    std::atomic<int> overflow{0};
+    bool spawn_fail = false;
+    int64_t slice = arena_cap / (int64_t)nthreads;
+    auto work = [&](size_t t) {
+      int64_t lo = slice * (int64_t)t;
+      int64_t hi = t + 1 == nthreads ? arena_cap : lo + slice;
+      int64_t pos = lo;
+      ctrs[t].fill(0);
+      int64_t i0 = n * (int64_t)t / (int64_t)nthreads;
+      int64_t i1 = n * (int64_t)(t + 1) / (int64_t)nthreads;
+      for (int64_t i = i0; i < i1; i++) {
+        int64_t got = walk(i, pos, hi, ctrs[t].data());
+        if (got < 0) {
+          overflow.store(1, std::memory_order_relaxed);
+          return;
+        }
+        pos += got;
+      }
+      used_per[t] = pos - lo;
+    };
+    for (size_t t = 1; t < nthreads; t++) {
+      try {
+        pool.emplace_back(work, t);
+      } catch (...) {
+        spawn_fail = true;  // resource exhaustion: sequential fallback
+        break;
+      }
+    }
+    if (!spawn_fail) {
+      work(0);
+      for (auto& th : pool) th.join();
+      for (size_t t = 0; t < nthreads; t++)
+        for (int x = 0; x < NC_COUNT; x++) counters[x] += ctrs[t][x];
+      if (overflow.load()) return -2;  // caller grows + retries
+      *arena_used =
+          slice * (int64_t)(nthreads - 1) + used_per[nthreads - 1];
+      return 0;
+    }
+    // Thread spawn failed: join what started, then run everything
+    // sequentially below (statuses/offsets are simply overwritten);
+    // returning -2 here would make the caller grow the arena forever.
+    for (auto& th : pool) th.join();
+    for (int x = 0; x < NC_COUNT; x++) counters[x] = 0;
+  }
+
+  int64_t used = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t got = walk(i, used, arena_cap, counters);
+    if (got < 0) return -2;  // arena exhausted: grow + retry whole batch
+    used += got;
   }
   *arena_used = used;
   return 0;

@@ -613,11 +613,13 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         if _host_sort():
             import types as _types
 
+            t_hc = time.time()
             order, zero_flags, cx_flags, has_complex, seq_a, vt_a = \
                 ck.host_fused_full(
                     kv.key_buf, kv.key_offs, kv.key_lens, mkb,
                     snapshots, compaction.bottommost, cover,
                 )
+            stats.host_compute_usec = int((time.time() - t_hc) * 1e6)
             col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
         elif shards is not None:
             # Upload + dispatch every shard up front (device_put and

@@ -18,12 +18,108 @@ OP_MERGE = 4
 OP_DELETE_RANGE = 5
 OP_ITER_SEEK = 6
 OP_WRITE_BATCH = 7
+OP_ITER_SEEK_FOR_PREV = 8
+OP_MULTIGET = 9
 
 _OP_NAMES = {
     OP_GET: "get", OP_PUT: "put", OP_DELETE: "delete", OP_MERGE: "merge",
     OP_DELETE_RANGE: "delete_range", OP_ITER_SEEK: "iter_seek",
     OP_WRITE_BATCH: "write_batch",
+    OP_ITER_SEEK_FOR_PREV: "iter_seek_for_prev", OP_MULTIGET: "multiget",
 }
+
+
+class TraceOptions:
+    """Reference TraceOptions (include/rocksdb/trace_reader_writer.h):
+    byte cap on the trace file + 1-in-N op sampling."""
+
+    def __init__(self, max_trace_file_size: int = 0,
+                 sampling_frequency: int = 1):
+        self.max_trace_file_size = max_trace_file_size
+        self.sampling_frequency = max(1, sampling_frequency)
+
+
+class OpTracer:
+    """DB-attached operation recorder (reference DB::StartTrace /
+    trace_replay/trace_replay.cc): the DB calls record_* from its own
+    read/write entry points, so EVERY op is captured — unlike the wrapper
+    Tracer below, which only sees calls routed through it. Thread-safe;
+    silently stops at max_trace_file_size (the reference's behavior)."""
+
+    def __init__(self, env, trace_path: str,
+                 options: TraceOptions | None = None):
+        import threading
+
+        self.options = options or TraceOptions()
+        self._w = LogWriter(env.new_writable_file(trace_path))
+        self._mu = threading.Lock()
+        self._written = 0
+        self._seq = 0
+        self.stopped = False
+
+    def _record(self, op: int, *slices: bytes) -> None:
+        if self.stopped:
+            return
+        with self._mu:
+            # Sampling decides BEFORE any encoding work: on a 1-in-N
+            # config the hot read path must not pay the record build for
+            # dropped ops. stopped re-checks under the lock so a racing
+            # close() can't hand us a closed writer.
+            if self.stopped:
+                return
+            self._seq += 1
+            if self._seq % self.options.sampling_frequency:
+                return
+            out = bytearray()
+            out += coding.encode_varint32(op)
+            out += coding.encode_varint64(int(time.time() * 1e6))
+            for s in slices:
+                coding.put_length_prefixed_slice(out, s)
+            cap = self.options.max_trace_file_size
+            if cap and self._written + len(out) > cap:
+                self.stopped = True
+                return
+            self._written += len(out) + 7  # log framing overhead
+            self._w.add_record(bytes(out))
+
+    def record_get(self, key: bytes) -> None:
+        self._record(OP_GET, key)
+
+    def record_multiget(self, keys) -> None:
+        self._record(OP_MULTIGET, *keys)
+
+    def record_write(self, batch_rep: bytes) -> None:
+        self._record(OP_WRITE_BATCH, batch_rep)
+
+    def record_iter_seek(self, key: bytes, for_prev: bool = False) -> None:
+        self._record(OP_ITER_SEEK_FOR_PREV if for_prev else OP_ITER_SEEK,
+                     key)
+
+    def close(self) -> None:
+        with self._mu:
+            self._w.sync()
+            self._w.close()
+            self.stopped = True
+
+
+class TracingIterator:
+    """Proxy recording the seeks of one DB iterator (reference traces
+    Iterator::Seek/SeekForPrev through the same mechanism)."""
+
+    def __init__(self, it, tracer: OpTracer):
+        self._it = it
+        self._tr = tracer
+
+    def seek(self, key):
+        self._tr.record_iter_seek(key)
+        return self._it.seek(key)
+
+    def seek_for_prev(self, key):
+        self._tr.record_iter_seek(key, for_prev=True)
+        return self._it.seek_for_prev(key)
+
+    def __getattr__(self, name):
+        return getattr(self._it, name)
 
 
 class Tracer:
@@ -79,30 +175,73 @@ def read_trace(env, trace_path: str):
 
 
 class Replayer:
-    """Replay a trace against a DB (reference Replayer)."""
+    """Replay a trace against a DB (reference Replayer,
+    include/rocksdb/utilities/replayer.h): fast-forward or
+    timing-faithful (inter-op gaps divided by `speedup`, the reference's
+    fast-forward factor), optionally fanned out over worker threads (the
+    reference's MultiThreadReplay)."""
 
     def __init__(self, db, trace_path: str):
         self._db = db
         self._path = trace_path
 
-    def replay(self, fast_forward: bool = True) -> int:
+    def _apply(self, op, slices):
+        db = self._db
+        if op in (OP_GET,):
+            db.get(slices[0])
+        elif op == OP_MULTIGET:
+            db.multi_get(list(slices))
+        elif op == OP_PUT:
+            db.put(slices[0], slices[1])
+        elif op == OP_DELETE:
+            db.delete(slices[0])
+        elif op == OP_MERGE:
+            db.merge(slices[0], slices[1])
+        elif op == OP_DELETE_RANGE:
+            db.delete_range(slices[0], slices[1])
+        elif op == OP_WRITE_BATCH:
+            from toplingdb_tpu.db.write_batch import WriteBatch
+
+            db.write(WriteBatch(data=slices[0]))
+        elif op in (OP_ITER_SEEK, OP_ITER_SEEK_FOR_PREV):
+            it = db.new_iterator()
+            if op == OP_ITER_SEEK:
+                it.seek(slices[0])
+            else:
+                it.seek_for_prev(slices[0])
+
+    def replay(self, fast_forward: bool = True, speedup: float = 1.0,
+               threads: int = 1) -> int:
+        """Returns the number of ops replayed. fast_forward=True ignores
+        recorded timing entirely; otherwise inter-op gaps are honored,
+        divided by `speedup`. With threads > 1, LOOKUP ops fan out over a
+        pool while writes stay ordered on the caller thread (writes
+        reordering against each other would corrupt the replayed state)."""
         n = 0
         prev_ts = None
-        for op, ts, slices in read_trace(self._db.env, self._path):
-            if not fast_forward and prev_ts is not None:
-                time.sleep(max(0, (ts - prev_ts) / 1e6))
-            prev_ts = ts
-            if op == OP_GET:
-                self._db.get(slices[0])
-            elif op == OP_PUT:
-                self._db.put(slices[0], slices[1])
-            elif op == OP_DELETE:
-                self._db.delete(slices[0])
-            elif op == OP_MERGE:
-                self._db.merge(slices[0], slices[1])
-            elif op == OP_DELETE_RANGE:
-                self._db.delete_range(slices[0], slices[1])
-            n += 1
+        pool = None
+        futures = []
+        if threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(threads)
+        try:
+            for op, ts, slices in read_trace(self._db.env, self._path):
+                if not fast_forward and prev_ts is not None:
+                    time.sleep(max(0, (ts - prev_ts) / 1e6 / speedup))
+                prev_ts = ts
+                if pool is not None and op in (OP_GET, OP_MULTIGET,
+                                               OP_ITER_SEEK,
+                                               OP_ITER_SEEK_FOR_PREV):
+                    futures.append(pool.submit(self._apply, op, slices))
+                else:
+                    self._apply(op, slices)
+                n += 1
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True)
+        for f in futures:
+            f.result()  # surface worker failures, not a clean count
         return n
 
 
