@@ -955,3 +955,31 @@ def test_blob_gc_shrinks_storage_on_overwrite(tmp_db_path):
         assert after < before * 0.6, (before, after)
         for i in range(0, 2000, 97):
             assert db.get(b"k%05d" % i) == b"C" * 500
+
+
+def test_wide_column_entity_semantics(tmp_db_path):
+    """Reference db/wide semantics: PutEntity stores columns; a plain Get
+    (and iterator value()) over the entity returns the anonymous default
+    column; GetEntity / Iterator.columns() return the full set — across
+    flush + compaction."""
+    with DB.open(tmp_db_path, opts()) as db:
+        db.put_entity(b"e1", {b"": b"defv", b"city": b"paris",
+                              b"age": b"30"})
+        db.put_entity(b"e2", {b"city": b"rome"})  # no default column
+        db.put(b"plain", b"pv")
+        assert db.get(b"e1") == b"defv"
+        assert db.get(b"e2") == b""
+        assert db.get(b"plain") == b"pv"
+        db.flush()
+        db.compact_range(None, None)
+        db.wait_for_compactions()
+        assert db.get(b"e1") == b"defv"
+        assert db.get_entity(b"e1") == {b"": b"defv", b"city": b"paris",
+                                        b"age": b"30"}
+        assert db.get_entity(b"plain") == {b"": b"pv"}
+        it = db.new_iterator()
+        it.seek(b"e1")
+        assert it.value() == b"defv"
+        assert it.columns()[b"city"] == b"paris"
+        it.seek(b"plain")
+        assert it.columns() == {b"": b"pv"}

@@ -1612,3 +1612,45 @@ os._exit(0)  # crash before deciding
     assert tdb.get(b"g150") == b"prepared-val"
     assert tdb.get(b"g175") == b"after-commit"
     tdb.close()
+
+
+def test_repo_webview_dashboard(tmp_path):
+    """The rockside WebView role: HTML dashboard over the repo HTTP
+    server — DB list, per-DB page with levels/tickers/config, and the
+    online-options form target actually applies changes."""
+    import json as _json
+    import urllib.request
+
+    from toplingdb_tpu.utils.config import SidePluginRepo
+
+    repo = SidePluginRepo()
+    db = repo.open_db({"path": str(tmp_path / "db"), "name": "web",
+                       "options": {"create_if_missing": True}})
+    for i in range(500):
+        db.put(b"k%04d" % i, b"v" * 20)
+    db.flush()
+    port = repo.start_http(0)
+    try:
+        idx = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/view").read().decode()
+        assert "web" in idx and "/view/web" in idx
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/view/web").read().decode()
+        assert "Levels" in page and "setoptions/web" in page
+        # the online-config endpoint the form posts to
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/setoptions/web",
+            data=_json.dumps({"write_buffer_size": 1 << 20}).encode(),
+            method="POST")
+        resp = _json.loads(urllib.request.urlopen(req).read())
+        assert resp["ok"]
+        assert db.options.write_buffer_size == 1 << 20
+        import urllib.error
+
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/view/nope")
+            assert False, "unknown db must 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        repo.close_all()

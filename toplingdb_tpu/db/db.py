@@ -1348,7 +1348,18 @@ class DB:
     def get(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
             cf=None) -> bytes | None:
         """Point lookup (reference DBImpl::GetImpl, db_impl.cc:2079).
-        Returns None if not found."""
+        Returns None if not found. A wide-column entity presents as its
+        anonymous default column (reference Get-on-entity semantics,
+        db/wide/wide_columns_helper) — use get_entity for every column."""
+        v = self._get_impl_entry(key, opts, cf)
+        if v is not None and v[:1] == b"\x00":
+            from toplingdb_tpu.db.wide_columns import default_column_of
+
+            return default_column_of(v)
+        return v
+
+    def _get_impl_entry(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
+                        cf=None) -> bytes | None:
         self._check_open()
         tr = self._op_tracer
         if tr is not None:
@@ -1502,7 +1513,9 @@ class DB:
         seek lands directly on the newest visible version of the key."""
         it.seek(key)
         if it.valid() and it.key() == key:
-            return it.value(), it.timestamp()
+            # raw: the caller layer does the wide-column unwrap exactly once
+            raw = getattr(it, "raw_value", it.value)()
+            return raw, it.timestamp()
         return None
 
     _TS_SLOW = object()  # fast-path bail sentinel
@@ -1676,7 +1689,7 @@ class DB:
                 # PINNED to the batch's snapshot seqno — re-reading at a
                 # fresh last_sequence would mix sequence points within one
                 # MultiGet (the Python path gives every key one snap_seq).
-                out[i] = self.get(keys[i], pinned_opts, cf)
+                out[i] = self._get_impl_entry(keys[i], pinned_opts, cf)
         return True, out
 
     def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,
@@ -1692,6 +1705,10 @@ class DB:
         self._check_read_ts(opts)
         t_mg = time.perf_counter() if self.stats is not None else 0.0
         res = self._multi_get_impl(keys, opts, cf)
+        if any(v is not None and v[:1] == b"\x00" for v in res):
+            from toplingdb_tpu.db.wide_columns import default_column_of
+
+            res = [v if v is None else default_column_of(v) for v in res]
         if self.stats is not None:
             from toplingdb_tpu.utils import statistics as st
 
@@ -1820,8 +1837,14 @@ class DB:
         as the anonymous default column."""
         from toplingdb_tpu.db.wide_columns import decode_entity
 
-        v = self.get(key, opts, cf=cf)
+        v = self._get_raw(key, opts, cf=cf)
         return None if v is None else decode_entity(v)
+
+    def _get_raw(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
+                 cf=None):
+        """Point lookup WITHOUT wide-column default-column unwrapping
+        (get_entity needs the full encoding)."""
+        return self._get_impl_entry(key, opts, cf)
 
     def get_merge_operands(self, key: bytes,
                            opts: ReadOptions = _DEFAULT_READ,

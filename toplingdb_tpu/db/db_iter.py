@@ -85,9 +85,32 @@ class DBIter:
         assert self._valid
         return self._key
 
-    def value(self) -> bytes:
+    def raw_value(self) -> bytes:
+        """The stored value WITHOUT wide-column default-column unwrapping
+        (internal consumers — get_entity's ts path — need the encoding)."""
         assert self._valid
         return self._value
+
+    def value(self) -> bytes:
+        assert self._valid
+        v = self._value
+        if v[:1] == b"\x00":
+            # Wide-column entity: present the anonymous default column
+            # (reference iterator-over-entity semantics); columns() gives
+            # the full set.
+            from toplingdb_tpu.db.wide_columns import default_column_of
+
+            return default_column_of(v)
+        return v
+
+    def columns(self) -> dict[bytes, bytes]:
+        """All columns of the current entry (reference
+        Iterator::columns(): a plain value presents as the anonymous
+        default column)."""
+        assert self._valid
+        from toplingdb_tpu.db.wide_columns import decode_entity
+
+        return decode_entity(self._value)
 
     def timestamp(self) -> int | None:
         """User timestamp of the current entry (ts-comparator DBs only)."""

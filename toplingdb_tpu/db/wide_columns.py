@@ -66,3 +66,13 @@ def get_entity(db, key: bytes, *, opts=None, cf=None) -> dict[bytes, bytes] | No
     """Thin alias for DB.get_entity."""
     kw = {"opts": opts} if opts is not None else {}
     return db.get_entity(key, cf=cf, **kw)
+
+
+def default_column_of(value: bytes) -> bytes:
+    """The reference's Get-on-entity semantics (db/wide/wide_columns_helper
+    in /root/reference): a plain Get over a wide-column entity returns the
+    anonymous default column's value (empty when the entity has none);
+    non-entity values pass through untouched."""
+    if not is_entity(value):
+        return value
+    return decode_entity(value).get(DEFAULT_COLUMN, b"")

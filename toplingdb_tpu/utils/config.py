@@ -299,7 +299,15 @@ class SidePluginRepo:
             config = json.loads(config)
         path = config["path"]
         name = name or config.get("name") or path
-        opts = options_from_config(config.get("options", {}))
+        cfg_opts = dict(config.get("options", {}))
+        # The rockside role always exposes live metrics: repo-opened DBs
+        # get a Statistics sink unless the config explicitly disables it
+        # ({"statistics": false}).
+        if cfg_opts.get("statistics", True) is False:
+            cfg_opts.pop("statistics", None)
+        else:
+            cfg_opts.setdefault("statistics", "default")
+        opts = options_from_config(cfg_opts)
         db = DB.open(path, opts)
         self._dbs[name] = db
         self._configs[name] = config
@@ -337,6 +345,22 @@ class SidePluginRepo:
 
             def do_GET(self):
                 parts = [p for p in self.path.split("/") if p]
+                if parts and parts[0] == "view":
+                    # The rockside WebView role: a human-readable HTML
+                    # dashboard over the same introspection routes.
+                    try:
+                        html = repo._render_view("/".join(parts[1:]))
+                        code = 200 if html is not None else 404
+                        data = (html or "<h1>not found</h1>").encode()
+                    except Exception as e:
+                        code, data = 500, repr(e).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 if parts and parts[0] == "metrics":
                     try:
                         out = []
@@ -393,6 +417,57 @@ class SidePluginRepo:
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+
+    def _render_view(self, name: str):
+        """HTML dashboard (the rockside WebView role): / lists DBs;
+        /view/<name> shows stats, levels, and the live config with an
+        online-options form posting to /setoptions/<name>."""
+        import html as _html
+
+        def esc(x):
+            return _html.escape(str(x))
+
+        if not name:
+            rows = "".join(
+                f'<li><a href="/view/{esc(n)}">{esc(n)}</a></li>'
+                for n in sorted(self._dbs))
+            return (f"<html><head><title>toplingdb_tpu</title></head>"
+                    f"<body><h1>toplingdb_tpu repo</h1><ul>{rows}</ul>"
+                    f'<p><a href="/metrics">/metrics</a> (Prometheus) · '
+                    f'<a href="/dbs">/dbs</a> (JSON)</p></body></html>')
+        db = self._dbs.get(name)
+        if db is None:
+            return None
+        levels = self._route(["levels", name]) or {}
+        cfg = self._configs.get(name, {})
+        stats_rows = ""
+        if db.stats is not None:
+            tickers = db.stats.tickers()
+            top = sorted(tickers.items(), key=lambda kv: -kv[1])[:30]
+            stats_rows = "".join(
+                f"<tr><td>{esc(k)}</td><td>{v}</td></tr>"
+                for k, v in top if v)
+        lvl_rows = "".join(
+            f"<tr><td>{esc(lv)}</td>"
+            f"<td>{len(files)} files, "
+            f"{sum(f['size'] for f in files)} bytes</td></tr>"
+            for lv, files in sorted(levels.items()))
+        return (
+            f"<html><head><title>{esc(name)}</title></head><body>"
+            f"<h1>{esc(name)}</h1>"
+            f"<h2>Levels</h2><table border=1>{lvl_rows}</table>"
+            f"<h2>Top tickers</h2><table border=1>{stats_rows}</table>"
+            f"<h2>Config</h2><pre>{esc(json.dumps(cfg, indent=1, default=str))}"
+            f"</pre>"
+            f"<h2>Online options</h2>"
+            f"<form onsubmit=\"fetch('/setoptions/{esc(name)}',"
+            f"{{method:'POST',body:this.body.value}})"
+            f".then(r=>r.json()).then(j=>alert(JSON.stringify(j)));"
+            f"return false\">"
+            f'<textarea name="body" rows="4" cols="60">'
+            f'{{"write_buffer_size": 67108864}}</textarea><br>'
+            f'<input type="submit" value="Apply"></form>'
+            f'<p><a href="/view">&larr; all dbs</a></p></body></html>')
 
     def _route(self, parts: list[str]):
         if not parts or parts == ["dbs"]:

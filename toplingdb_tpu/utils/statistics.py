@@ -212,6 +212,11 @@ class Statistics:
         with self._lock:
             return self._tickers.get(name, 0)
 
+    def tickers(self) -> dict:
+        """Consistent snapshot of every ticker (reference getTickerMap)."""
+        with self._lock:
+            return dict(self._tickers)
+
     def record_in_histogram(self, name: str, value: float) -> None:
         with self._lock:
             self._histograms[name].add(value)
