@@ -98,11 +98,30 @@ class ExpectedState:
         self._f.close()
 
 
+def _cf_map(db) -> dict:
+    return {h.name: h for h in db.list_column_families()}
+
+
+def _resolve_cf(cf_by_name: dict, k: str):
+    """Journal keys may carry a 'cfN|' prefix (multi_cf variant): returns
+    (cf_handle_or_None, raw_key). A journaled CF that the DB does not
+    know is ITSELF a verification failure — falling back to the default
+    CF would mask exactly the data-loss class this harness hunts."""
+    if "|" not in k:
+        return None, k
+    cfname, raw = k.split("|", 1)
+    if cfname not in cf_by_name:
+        raise AssertionError(f"journaled CF {cfname!r} missing from DB")
+    return cf_by_name[cfname], raw
+
+
 def verify(db, committed, pending) -> int:
     bad = 0
     keys = set(committed) | set(pending)
+    cfs = _cf_map(db)
     for k in sorted(keys):
-        got = db.get(k.encode())
+        cf, raw = _resolve_cf(cfs, k)
+        got = db.get(raw.encode(), cf=cf)
         acceptable = set()
         if k in committed:
             acceptable.add(committed[k])
@@ -131,13 +150,24 @@ VARIANTS = {
     "pipelined": {"enable_pipelined_write": True},
     "universal": {"compaction_style": "universal"},
     "tiny_buffer": {"write_buffer_size": 16 * 1024},
+    "cspp": {"memtable_rep": "cspp"},
+    # reference db_crashtest.py matrix rows: user-defined timestamps and
+    # multi-CF ops (writes fan across families; the model keys carry the
+    # cf so verification stays exact).
+    "timestamp": {"_ts": True},
+    "multi_cf": {"_cfs": 3},
 }
 
 
 def variant_options(args):
     from toplingdb_tpu.options import Options
 
-    kw = dict(VARIANTS[args.variant])
+    kw = {k: v for k, v in VARIANTS[args.variant].items()
+          if not k.startswith("_")}
+    if VARIANTS[args.variant].get("_ts"):
+        from toplingdb_tpu.db.dbformat import U64TsBytewiseComparator
+
+        kw["comparator"] = U64TsBytewiseComparator()
     kw.setdefault("write_buffer_size", args.write_buffer_size)
     return Options(**kw)
 
@@ -150,6 +180,18 @@ def run_stress(args) -> int:
     expected = ExpectedState(model_path)
     committed, pending = expected.load()
     db = DB.open(args.db, variant_options(args))
+    vspec = VARIANTS[args.variant]
+    use_ts = bool(vspec.get("_ts"))
+    n_cfs = int(vspec.get("_cfs", 0))
+    cfs = [None]
+    if n_cfs:
+        existing = {h.name: h for h in db.list_column_families()}
+        for i in range(n_cfs):
+            nm = "cf%d" % i
+            if nm in existing:
+                cfs.append(existing[nm])
+            else:
+                cfs.append(db.create_column_family(nm))
 
     bad = verify(db, committed, pending)
     if bad:
@@ -160,8 +202,10 @@ def run_stress(args) -> int:
           f"state: OK")
     # Fold pending into committed using what the DB actually holds.
     model = dict(committed)
+    cf_by_name = _cf_map(db)
     for k in pending:
-        got = db.get(k.encode())
+        cf, raw = _resolve_cf(cf_by_name, k)
+        got = db.get(raw.encode(), cf=cf)
         model[k] = got.decode() if got is not None else None
 
     lock = threading.Lock()
@@ -174,28 +218,44 @@ def run_stress(args) -> int:
         while ops_done[0] < args.ops and not errors:
             try:
                 k = "key%06d" % rng.randrange(args.max_key)
+                cf = None
+                if n_cfs:
+                    ci = rng.randrange(len(cfs))
+                    cf = cfs[ci]
+                    if ci:
+                        k = "cf%d|%s" % (ci - 1, k)
+                raw = k.split("|", 1)[1] if "|" in k else k
                 r = rng.random()
                 with lock:
                     if r < 0.55:
                         v = "val%010d" % rng.randrange(10**9)
                         op = expected.begin(k, v)
-                        db.put(k.encode(), v.encode(), wo_sync)
+                        # User timestamps must stay MONOTONIC ACROSS CRASH
+                        # RESTARTS (newest-ts-wins reads would otherwise
+                        # keep returning pre-crash values and the model
+                        # would flag them as lost writes): the journal op
+                        # id is persisted and strictly increasing — use it
+                        # as the timestamp.
+                        kw = {"ts": op} if use_ts else {}
+                        db.put(raw.encode(), v.encode(), wo_sync, cf=cf,
+                               **kw)
                         expected.commit(op)
                         model[k] = v
                     elif r < 0.75:
                         op = expected.begin(k, None)
-                        db.delete(k.encode(), wo_sync)
+                        kw = {"ts": op} if use_ts else {}
+                        db.delete(raw.encode(), wo_sync, cf=cf, **kw)
                         expected.commit(op)
                         model[k] = None
                     elif r < 0.9:
-                        got = db.get(k.encode())
+                        got = db.get(raw.encode(), cf=cf)
                         want = model.get(k)
                         wantb = want.encode() if want is not None else None
                         if k in model and got != wantb:
                             errors.append(f"read mismatch {k}: {got} != {wantb}")
                     else:
-                        it = db.new_iterator()
-                        it.seek(k.encode())
+                        it = db.new_iterator(cf=cf)
+                        it.seek(raw.encode())
                         for _ in range(5):
                             if not it.valid():
                                 break
