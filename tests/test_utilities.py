@@ -1654,3 +1654,61 @@ def test_repo_webview_dashboard(tmp_path):
             assert e.code == 404
     finally:
         repo.close_all()
+
+
+def test_backup_engine_depth(tmp_path):
+    """delete_backup / verify_backup / garbage_collect / app metadata
+    (reference backup_engine.cc surfaces beyond create+restore)."""
+    import pytest as _pytest
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utilities.backup_engine import BackupEngine
+    from toplingdb_tpu.utils.status import Corruption, NotFound
+
+    be = BackupEngine(str(tmp_path / "backups"))
+    with DB.open(str(tmp_path / "db"),
+                 Options(create_if_missing=True)) as db:
+        for i in range(300):
+            db.put(b"k%04d" % i, b"v" * 40)
+        db.flush()
+        b1 = be.create_backup(db, app_metadata="first")
+        for i in range(300, 600):
+            db.put(b"k%04d" % i, b"v" * 40)
+        db.flush()
+        b2 = be.create_backup(db)
+    infos = be.get_backup_info()
+    assert [i["backup_id"] for i in infos] == [b1, b2]
+    assert infos[0]["app_metadata"] == "first"
+    assert infos[0]["timestamp"] > 0
+    be.verify_backup(b1)
+    be.verify_backup(b2)
+    # corrupt one of B1'S OWN shared files: verify must catch it
+    import json as _json
+    import os
+
+    with open(str(tmp_path / f"backups/meta/{b1}.json")) as f:
+        victim_name = _json.load(f)["files"][0]["shared"]
+    victim = str(tmp_path / "backups/shared" / victim_name)
+    blob = bytearray(open(victim, "rb").read())
+    blob[30] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+    with _pytest.raises(Corruption):
+        be.verify_backup(b1)
+    open(victim, "wb").write(bytes(blob[:30]) + bytes([blob[30] ^ 0xFF])
+                             + bytes(blob[31:]))
+    be.verify_backup(b1)
+    # delete b1: shared files still used by b2 survive; b2 restorable
+    be.delete_backup(b1)
+    with _pytest.raises(NotFound):
+        be.verify_backup(b1)
+    be.verify_backup(b2)
+    be.restore_db_from_backup(b2, str(tmp_path / "restored"))
+    with DB.open(str(tmp_path / "restored"), Options()) as db2:
+        assert db2.get(b"k0000") == b"v" * 40
+        assert db2.get(b"k0599") == b"v" * 40
+    # orphaned shared file: gc removes it
+    orphan = str(tmp_path / "backups/shared/999_deadbeef_000001.sst")
+    open(orphan, "wb").write(b"junk")
+    assert be.garbage_collect() >= 1
+    assert not os.path.exists(orphan)

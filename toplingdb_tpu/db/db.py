@@ -1263,13 +1263,11 @@ class DB:
             pctx.block_cache_hit_count += out[5]
             pctx.block_read_count += out[6]
             pctx.block_read_byte += out[7]
-        if self.stats is not None:
-            if out[3]:
-                self.stats.record_tick(st.BLOOM_USEFUL, out[3])
-            if out[5]:
-                self.stats.record_tick(st.BLOCK_CACHE_HIT, out[5])
-            if out[6]:
-                self.stats.record_tick(st.BLOCK_CACHE_MISS, out[6])
+        if self.stats is not None and (out[3] or out[5] or out[6]):
+            self.stats.record_ticks(
+                (t, c) for t, c in ((st.BLOOM_USEFUL, out[3]),
+                                    (st.BLOCK_CACHE_HIT, out[5]),
+                                    (st.BLOCK_CACHE_MISS, out[6])) if c)
         src = out[1]
         src = "mem" if src == 0 else (src - 1 if src >= 1 else None)
         if rc == 1:
@@ -1412,22 +1410,21 @@ class DB:
         s = self.stats
         s.record_in_histogram(st.DB_GET_MICROS,
                               (time.perf_counter() - t0) * 1e6)
-        s.record_tick(st.NUMBER_KEYS_READ)
+        ticks = [(st.NUMBER_KEYS_READ, 1)]
         if val is not None:
-            s.record_tick(st.BYTES_READ, len(val))
+            ticks.append((st.BYTES_READ, len(val)))
             s.record_in_histogram(st.BYTES_PER_READ, len(val))
         if src == "mem":
-            s.record_tick(st.MEMTABLE_HIT)
-            return
-        s.record_tick(st.MEMTABLE_MISS)
-        if src is None:
-            return
-        if src == 0:
-            s.record_tick(st.GET_HIT_L0)
-        elif src == 1:
-            s.record_tick(st.GET_HIT_L1)
+            ticks.append((st.MEMTABLE_HIT, 1))
         else:
-            s.record_tick(st.GET_HIT_L2_AND_UP)
+            ticks.append((st.MEMTABLE_MISS, 1))
+            if src == 0:
+                ticks.append((st.GET_HIT_L0, 1))
+            elif src == 1:
+                ticks.append((st.GET_HIT_L1, 1))
+            elif src is not None:
+                ticks.append((st.GET_HIT_L2_AND_UP, 1))
+        s.record_ticks(ticks)
 
     def _walk_sst_chain(self, version, key: bytes, snap_seq: int, ctx,
                         tombs_for=None):

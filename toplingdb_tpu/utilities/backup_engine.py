@@ -21,7 +21,13 @@ from toplingdb_tpu.utils.status import InvalidArgument, NotFound
 
 class BackupEngine:
     def __init__(self, backup_dir: str):
+        import threading
+
         self.dir = backup_dir
+        # Serializes create/delete/purge/GC: shared files and private dirs
+        # land BEFORE their meta json, so an unsynchronized GC could sweep
+        # a half-created backup's files as unreferenced garbage.
+        self._mu = threading.Lock()
         os.makedirs(os.path.join(backup_dir, "shared"), exist_ok=True)
         os.makedirs(os.path.join(backup_dir, "meta"), exist_ok=True)
         os.makedirs(os.path.join(backup_dir, "private"), exist_ok=True)
@@ -33,12 +39,21 @@ class BackupEngine:
                if f.split(".")[0].isdigit()]
         return max(ids, default=0) + 1
 
-    def create_backup(self, db) -> int:
+    def create_backup(self, db, app_metadata: str | None = None) -> int:
         """Snapshot the DB (checkpoint = atomic consistent view), then dedup
         its SSTs into shared/ — the file list and the MANIFEST come from the
-        SAME checkpoint, so concurrent compactions can't skew them."""
+        SAME checkpoint, so concurrent compactions can't skew them.
+        app_metadata: reference CreateNewBackupWithMetadata."""
         from toplingdb_tpu.utilities.checkpoint import create_checkpoint
 
+        self._mu.acquire()
+        try:
+            return self._create_backup_locked(db, app_metadata,
+                                              create_checkpoint)
+        finally:
+            self._mu.release()
+
+    def _create_backup_locked(self, db, app_metadata, create_checkpoint):
         backup_id = self._next_backup_id()
         private = os.path.join(self.dir, "private", str(backup_id))
         os.makedirs(private, exist_ok=True)
@@ -67,7 +82,11 @@ class BackupEngine:
                 "size": len(data), "crc32c": crc,
             })
         shutil.rmtree(tmp_ckpt)
-        meta = {"backup_id": backup_id, "files": files}
+        import time as _time
+
+        meta = {"backup_id": backup_id, "files": files,
+                "timestamp": int(_time.time()),
+                "app_metadata": app_metadata}
         meta_path = os.path.join(self.dir, "meta", f"{backup_id}.json")
         with open(meta_path + ".tmp", "w") as f:
             json.dump(meta, f, indent=1)
@@ -88,8 +107,78 @@ class BackupEngine:
                 "backup_id": m["backup_id"],
                 "num_files": len(m["files"]),
                 "size": sum(f["size"] for f in m["files"]),
+                "timestamp": m.get("timestamp", 0),
+                "app_metadata": m.get("app_metadata"),
             })
         return out
+
+    def delete_backup(self, backup_id: int) -> None:
+        """Drop ONE backup (reference DeleteBackup); shared files still
+        referenced by other backups survive."""
+        with self._mu:
+            meta_path = os.path.join(self.dir, "meta", f"{backup_id}.json")
+            if not os.path.exists(meta_path):
+                raise NotFound(f"backup {backup_id}")
+            os.remove(meta_path)
+            shutil.rmtree(os.path.join(self.dir, "private", str(backup_id)),
+                          ignore_errors=True)
+            self._garbage_collect_locked()
+
+    def verify_backup(self, backup_id: int) -> None:
+        """Check every file of one backup exists with the recorded size +
+        crc32c (reference VerifyBackup with verify_with_checksum=true);
+        raises Corruption/NotFound on any divergence."""
+        from toplingdb_tpu.utils.status import Corruption
+
+        meta_path = os.path.join(self.dir, "meta", f"{backup_id}.json")
+        if not os.path.exists(meta_path):
+            raise NotFound(f"backup {backup_id}")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        for fi in meta["files"]:
+            path = os.path.join(self.dir, "shared", fi["shared"])
+            if not os.path.exists(path):
+                raise Corruption(f"backup {backup_id}: missing {fi['shared']}")
+            with open(path, "rb") as s_:
+                data = s_.read()
+            if len(data) != fi["size"]:
+                raise Corruption(
+                    f"backup {backup_id}: size mismatch {fi['shared']}")
+            if crc32c.value(data) != fi["crc32c"]:
+                raise Corruption(
+                    f"backup {backup_id}: checksum mismatch {fi['shared']}")
+        private = os.path.join(self.dir, "private", str(backup_id))
+        if not os.path.isdir(private):
+            raise Corruption(f"backup {backup_id}: private dir missing")
+
+    def garbage_collect(self) -> int:
+        """Remove shared files and private dirs no live backup references
+        (reference BackupEngine::GarbageCollect — cleanup after aborted
+        or deleted backups). Returns the number of entries removed."""
+        with self._mu:
+            return self._garbage_collect_locked()
+
+    def _garbage_collect_locked(self) -> int:
+        live = set()
+        meta_dir = os.path.join(self.dir, "meta")
+        ids = set()
+        for name in os.listdir(meta_dir):
+            if name.endswith(".json") and name[:-5].isdigit():
+                ids.add(int(name[:-5]))
+                with open(os.path.join(meta_dir, name)) as f:
+                    for fi in json.load(f)["files"]:
+                        live.add(fi["shared"])
+        removed = 0
+        for name in os.listdir(os.path.join(self.dir, "shared")):
+            if name not in live:
+                os.remove(os.path.join(self.dir, "shared", name))
+                removed += 1
+        for name in os.listdir(os.path.join(self.dir, "private")):
+            if name.isdigit() and int(name) not in ids:
+                shutil.rmtree(os.path.join(self.dir, "private", name),
+                              ignore_errors=True)
+                removed += 1
+        return removed
 
     def restore_db_from_backup(self, backup_id: int, db_dir: str) -> None:
         meta_path = os.path.join(self.dir, "meta", f"{backup_id}.json")
@@ -114,20 +203,11 @@ class BackupEngine:
             shutil.copy2(os.path.join(private, name), os.path.join(db_dir, name))
 
     def purge_old_backups(self, num_to_keep: int) -> None:
-        infos = self.get_backup_info()
-        to_drop = infos[: max(0, len(infos) - num_to_keep)]
-        keep_ids = {i["backup_id"] for i in infos} - {i["backup_id"] for i in to_drop}
-        # Collect shared files still referenced.
-        referenced = set()
-        for bid in keep_ids:
-            with open(os.path.join(self.dir, "meta", f"{bid}.json")) as f:
-                for fi in json.load(f)["files"]:
-                    referenced.add(fi["shared"])
-        for info in to_drop:
-            bid = info["backup_id"]
-            os.remove(os.path.join(self.dir, "meta", f"{bid}.json"))
-            shutil.rmtree(os.path.join(self.dir, "private", str(bid)),
-                          ignore_errors=True)
-        for name in os.listdir(os.path.join(self.dir, "shared")):
-            if name not in referenced:
-                os.remove(os.path.join(self.dir, "shared", name))
+        with self._mu:
+            infos = self.get_backup_info()
+            for info in infos[: max(0, len(infos) - num_to_keep)]:
+                bid = info["backup_id"]
+                os.remove(os.path.join(self.dir, "meta", f"{bid}.json"))
+                shutil.rmtree(os.path.join(self.dir, "private", str(bid)),
+                              ignore_errors=True)
+            self._garbage_collect_locked()

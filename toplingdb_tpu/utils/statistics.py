@@ -208,6 +208,15 @@ class Statistics:
         with self._lock:
             self._tickers[name] += count
 
+    def record_ticks(self, pairs) -> None:
+        """Batch ticker bump under ONE lock acquisition — the read hot
+        path records 3-6 tickers per Get, and per-tick locking was ~40%
+        of a warm native Get."""
+        with self._lock:
+            t = self._tickers
+            for name, count in pairs:
+                t[name] += count
+
     def get_ticker_count(self, name: str) -> int:
         with self._lock:
             return self._tickers.get(name, 0)
