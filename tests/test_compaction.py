@@ -391,3 +391,83 @@ def test_periodic_compaction_rewrites_old_files(tmp_db_path):
             db._maybe_schedule_compaction()
             db.wait_for_compactions()
             assert sched.num_completed - n <= 1, "periodic rewrite loop"
+
+
+def test_preclude_last_level_data_seconds(tmp_path):
+    """The seqno<->time mapping's consumer (reference
+    preclude_last_level_data_seconds): fresh data must NOT receive
+    last-level treatment — seqnos stay un-zeroed (job retargets /
+    drops bottommost semantics) until the data has aged past the
+    cutoff."""
+    import time as _time
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    d = str(tmp_path / "db")
+    with DB.open(d, Options(create_if_missing=True,
+                            preclude_last_level_data_seconds=3600,
+                            seqno_time_sample_period_sec=0)) as db:
+        for i in range(2000):
+            db.put(b"k%05d" % i, b"v" * 30)
+        db.flush()
+        # the mapping knows all data is recent
+        db.seqno_to_time.append(db.versions.last_sequence,
+                                int(_time.time()))
+        db.compact_range(None, None)
+        db.wait_for_compactions()
+        v = db.versions.cf_current(0)
+        # wherever the data landed, its seqnos must NOT be zeroed
+        reader = db.table_cache.get_reader(
+            next(f for _, f in v.all_files()).number)
+        assert reader.properties.smallest_seqno > 0, \
+            "fresh data received last-level seqno zeroing"
+        assert db.get(b"k00042") == b"v" * 30
+
+    # control: with the feature off the same flow zeroes seqnos
+    d2 = str(tmp_path / "db2")
+    with DB.open(d2, Options(create_if_missing=True)) as db:
+        for i in range(2000):
+            db.put(b"k%05d" % i, b"v" * 30)
+        db.flush()
+        db.compact_range(None, None)
+        db.wait_for_compactions()
+        v = db.versions.cf_current(0)
+        reader = db.table_cache.get_reader(
+            next(f for _, f in v.all_files()).number)
+        assert reader.properties.smallest_seqno == 0
+
+
+def test_seqno_time_mapping_survives_reopen(tmp_path):
+    """The seqno<->time sidecar must persist: after a reopen, old data is
+    still provably old, so preclude_last_level_data_seconds doesn't
+    suppress last-level treatment for aged data."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    d = str(tmp_path / "db")
+    with DB.open(d, Options(create_if_missing=True,
+                            preclude_last_level_data_seconds=2)) as db:
+        for i in range(500):
+            db.put(b"k%04d" % i, b"v" * 20)
+        db.flush()
+    path = _os.path.join(d, "SEQNO_TIME.json")
+    assert _os.path.exists(path)
+    pairs = _json.loads(open(path).read())
+    assert pairs and pairs[-1][1] > 0
+    # age the recorded samples past the cutoff, reopen, compact: data is
+    # provably old now -> last-level treatment applies (seqnos zero)
+    aged = [[s_, t - 10] for s_, t in pairs]
+    open(path, "w").write(_json.dumps(aged))
+    with DB.open(d, Options(preclude_last_level_data_seconds=2)) as db:
+        assert len(db.seqno_to_time) > 0
+        db.compact_range(None, None)
+        db.wait_for_compactions()
+        v = db.versions.cf_current(0)
+        reader = db.table_cache.get_reader(
+            next(f for _, f in v.all_files()).number)
+        assert reader.properties.smallest_seqno == 0

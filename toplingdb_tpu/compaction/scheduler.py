@@ -197,10 +197,38 @@ class CompactionScheduler:
             self.num_completed += 1
         return True
 
+    def _maybe_preclude_last_level(self, c: Compaction) -> None:
+        """preclude_last_level_data_seconds (reference options.h +
+        seqno_to_time_mapping consumer): a bottommost-targeting job whose
+        inputs hold data YOUNGER than the cutoff keeps full MVCC
+        semantics — no seqno zeroing, no tombstone dropping — until a
+        later compaction finds it aged. Placement is NOT changed (the
+        reference splits outputs to the penultimate level per key; a
+        job-granularity retarget would install overlapping files into
+        sorted-disjoint levels, so we defer the last-level TREATMENT
+        instead — the documented design difference)."""
+        import time as _time
+
+        db = self.db
+        secs = getattr(db.options, "preclude_last_level_data_seconds", 0)
+        if not secs or not c.bottommost or c.output_level <= c.level:
+            return
+        cutoff_seq = db.seqno_to_time.get_proximal_seqno(
+            int(_time.time()) - secs)
+        if cutoff_seq is None:
+            # The cutoff time predates every recorded sample: nothing can
+            # be PROVEN old, so everything is treated as young.
+            cutoff_seq = 0
+        newest = max((f.largest_seqno for _, f in c.all_inputs()),
+                     default=0)
+        if newest > cutoff_seq:
+            c.bottommost = False
+
     def _run_compaction(self, c: Compaction) -> None:
         db = self.db
         if not c.output_level_inputs and not c.inputs:
             return
+        self._maybe_preclude_last_level(c)
         if c.reason.startswith("fifo"):
             # Deletion-only compaction.
             edit = make_version_edit(c, [])

@@ -312,6 +312,11 @@ class DB:
             else None
         )
         self.seqno_to_time = SeqnoToTimeMapping()
+        # The mapping must survive reopens (reference persists it through
+        # MANIFEST/SST properties) or every restart would treat ALL data
+        # as young for preclude_last_level_data_seconds; a JSON sidecar
+        # is our persistence (loaded in DB.open, saved on sample/close).
+        self._seqno_time_path = None
         self._last_seqno_time_sample = 0.0
         self._wbm_charged = 0  # bytes charged to options.write_buffer_manager
         self._options_file_number = 0  # latest persisted OPTIONS file
@@ -441,6 +446,16 @@ class DB:
             db.identity = uuid.uuid4().hex
             env.write_file(filename.identity_file_name(dbname), db.identity.encode())
         db._new_wal()
+        import os as _os
+
+        db._seqno_time_path = _os.path.join(dbname, "SEQNO_TIME.json")
+        try:
+            import json as _json
+
+            raw = env.read_file(db._seqno_time_path)
+            db.seqno_to_time.load(_json.loads(raw.decode()))
+        except Exception:
+            pass  # absent/corrupt sidecar: start fresh (best effort)
         try:
             from toplingdb_tpu.utils.config import (
                 load_latest_options, persist_options,
@@ -561,6 +576,9 @@ class DB:
             if wbm is not None and self._wbm_charged:
                 wbm.free(self._wbm_charged)
                 self._wbm_charged = 0
+            self.seqno_to_time.append(self.versions.last_sequence,
+                                      int(time.time()))
+            self._save_seqno_time()
             self.versions.close()
             self.table_cache.close()
             self.blob_source.close()
@@ -928,6 +946,22 @@ class DB:
             if w is not group[0]:
                 w.event.set()
 
+    def _save_seqno_time(self) -> None:
+        """Best-effort sidecar persistence of the seqno<->time mapping
+        (the reference rides MANIFEST/SST properties): without it a
+        reopen would treat ALL existing data as young for
+        preclude_last_level_data_seconds."""
+        if self._seqno_time_path is None:
+            return
+        try:
+            import json as _json
+
+            self.env.write_file(
+                self._seqno_time_path,
+                _json.dumps(self.seqno_to_time.to_list()).encode())
+        except Exception:
+            pass
+
     def _post_publish_work(self, group: list[_Writer]) -> None:
         """Stats + seqno/time sampling + flush trigger after a publish
         (caller holds _mutex)."""
@@ -937,6 +971,7 @@ class DB:
                 self.options.seqno_time_sample_period_sec:
             self._last_seqno_time_sample = now
             self.seqno_to_time.append(seq_top - 1, int(now))
+            self._save_seqno_time()
         if self.stats is not None:
             from toplingdb_tpu.utils import statistics as st
 
@@ -1017,6 +1052,7 @@ class DB:
                     self.options.seqno_time_sample_period_sec:
                 self._last_seqno_time_sample = now
                 self.seqno_to_time.append(seq - 1, int(now))
+                self._save_seqno_time()
             if self.stats is not None:
                 from toplingdb_tpu.utils import statistics as st
 

@@ -108,6 +108,15 @@ class Options:
     # Sampling cadence of the seqno↔time mapping (reference
     # seqno_to_time_mapping recording period).
     seqno_time_sample_period_sec: int = 60
+    # Data written within this many seconds must not receive LAST-LEVEL
+    # TREATMENT (reference preclude_last_level_data_seconds, the
+    # tiered/temperature seam the seqno↔time mapping exists for). Design
+    # difference from the reference: instead of splitting outputs to the
+    # penultimate level per key, a bottommost job with young inputs keeps
+    # full MVCC semantics (no seqno zeroing / tombstone dropping) and the
+    # last-level treatment happens on a later compaction once aged —
+    # placement is unchanged.
+    preclude_last_level_data_seconds: int = 0
 
     # Cross-DB memtable memory budget (utils.rate_limiter.WriteBufferManager;
     # reference write_buffer_manager.h:37). Shared between DB instances;

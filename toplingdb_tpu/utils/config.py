@@ -124,6 +124,11 @@ _SIMPLE_OPTION_KEYS = {
     "enable_blob_files", "min_blob_size",
     "enable_blob_garbage_collection", "blob_garbage_collection_age_cutoff",
     "stats_persist_period_sec", "seqno_time_sample_period_sec",
+    "read_only", "memtable_rep", "db_write_buffer_size",
+    "allow_concurrent_memtable_write", "enable_pipelined_write",
+    "unordered_write", "preclude_last_level_data_seconds",
+    "compression", "bottommost_compression", "bottommost_format",
+    "recycle_log_file_num", "wal_ttl_seconds",
 }
 
 # MergeOperator.name() → registry key, for options_to_config round-trips.

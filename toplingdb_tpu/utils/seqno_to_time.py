@@ -55,3 +55,15 @@ class SeqnoToTimeMapping:
     def __len__(self) -> int:
         with self._mu:
             return len(self._pairs)
+
+    def to_list(self) -> list:
+        with self._mu:
+            return [list(p) for p in self._pairs]
+
+    def load(self, pairs) -> None:
+        """Replace contents from a persisted list (monotonic enforcement
+        re-applied)."""
+        with self._mu:
+            self._pairs = []
+        for seqno, t in pairs:
+            self.append(int(seqno), int(t))
