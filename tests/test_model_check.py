@@ -118,3 +118,97 @@ def test_iterator_refresh_rejected_with_snapshot(tmp_path):
         with pytest.raises(NotSupported):
             it.refresh()
         snap.release()
+
+
+@pytest.mark.parametrize("seed,rep", [(3, "skiplist"), (11, "cspp")])
+def test_db_matches_model_extended_surfaces(tmp_path, seed, rep):
+    """Round-4 surface fuzz: merges (model folds uint64add), wide-column
+    entities (plain get sees the default column), batched MultiGet, and
+    iterator columns — against both native memtable reps."""
+    from toplingdb_tpu.db.wide_columns import encode_entity
+    from toplingdb_tpu.utils.merge_operator import UInt64AddOperator
+
+    rng = random.Random(seed)
+    d = str(tmp_path / "db")
+    o = Options(write_buffer_size=8 * 1024,
+                target_file_size_base=16 * 1024,
+                level0_file_num_compaction_trigger=3,
+                memtable_rep=rep,
+                merge_operator=UInt64AddOperator())
+    db = DB.open(d, o)
+    # model[k] = ("v", bytes) plain | ("e", dict) entity | ("m", int) counter
+    model: dict[bytes, tuple] = {}
+    keyspace = [b"key%03d" % i for i in range(120)]
+
+    def visible(k):
+        ent = model.get(k)
+        if ent is None:
+            return None
+        kind, v = ent
+        if kind == "v":
+            return v
+        if kind == "e":
+            return v.get(b"", b"")
+        return v.to_bytes(8, "little")
+
+    try:
+        for step in range(1000):
+            r = rng.random()
+            k = rng.choice(keyspace)
+            if r < 0.35:
+                v = b"v%06d" % step
+                db.put(k, v)
+                model[k] = ("v", v)
+            elif r < 0.50:
+                add = rng.randrange(1000)
+                db.merge(k, add.to_bytes(8, "little"))
+                kind, old = model.get(k, ("m", 0))
+                if kind == "m":
+                    model[k] = ("m", old + add)
+                elif kind == "v" and len(old) == 8:
+                    model[k] = ("m",
+                                int.from_bytes(old, "little") + add)
+                else:
+                    # merging onto an entity/odd value: engine treats the
+                    # base as bytes; keep the model out of that corner
+                    # by overwriting with a fresh counter first.
+                    db.put(k, (0).to_bytes(8, "little"))
+                    db.merge(k, add.to_bytes(8, "little"))
+                    model[k] = ("m", add)
+            elif r < 0.62:
+                cols = {b"": b"d%04d" % step, b"c1": b"x" * rng.randrange(9)}
+                db.put_entity(k, cols)
+                model[k] = ("e", cols)
+            elif r < 0.72:
+                db.delete(k)
+                model.pop(k, None)
+            elif r < 0.80:
+                probe = rng.sample(keyspace, 16)
+                got = db.multi_get(probe)
+                for kk, vv in zip(probe, got):
+                    assert vv == visible(kk), (step, kk)
+            elif r < 0.84:
+                db.flush()
+            elif r < 0.87:
+                db.compact_range()
+            elif r < 0.90:
+                ent = model.get(k)
+                ge = db.get_entity(k)
+                if ent is None:
+                    assert ge is None
+                elif ent[0] == "e":
+                    assert ge == ent[1], (step, k)
+                else:
+                    assert ge == {b"": visible(k)}
+            if step % 250 == 249:
+                db.wait_for_compactions()
+                for kk in keyspace:
+                    assert db.get(kk) == visible(kk), (step, kk)
+        db.wait_for_compactions()
+        for kk in keyspace:
+            assert db.get(kk) == visible(kk), kk
+    finally:
+        db.close()
+    with DB.open(d, o) as db2:
+        for kk in keyspace:
+            assert db2.get(kk) == visible(kk), kk

@@ -816,10 +816,12 @@ def test_stats_history_and_seqno_time(tmp_db_path):
 
         # Second sample holds only the delta (2 keys) since the first.
         assert hist[1][1].get(st.NUMBER_KEYS_WRITTEN) == 2
-        # seqno↔time mapping sampled on every group (period 0).
-        assert len(db.seqno_to_time) >= 1
+        # Period 0 = MANUAL sampling only (consistent with
+        # stats_persist_period_sec); automatic samples are off.
+        assert len(db.seqno_to_time) == 0
+        db.seqno_to_time.append(db.versions.last_sequence, 12345)
         t = db.seqno_to_time.get_proximal_time(db.versions.last_sequence)
-        assert t is not None
+        assert t == 12345
         assert db.seqno_to_time.get_proximal_seqno(2 ** 40) is not None
 
 

@@ -211,7 +211,10 @@ class CompactionScheduler:
 
         db = self.db
         secs = getattr(db.options, "preclude_last_level_data_seconds", 0)
-        if not secs or not c.bottommost or c.output_level <= c.level:
+        if not secs or not c.bottommost:
+            # Same-level bottommost rewrites (marked-file rewrites,
+            # universal L0 self-compactions) are last-level-treatment jobs
+            # too — c.bottommost alone decides eligibility.
             return
         cutoff_seq = db.seqno_to_time.get_proximal_seqno(
             int(_time.time()) - secs)

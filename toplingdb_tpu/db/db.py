@@ -163,11 +163,14 @@ class _NGetState:
         import ctypes
 
         handles = []
+        kinds = []
         for m in [mem] + imm:
             h = getattr(m._rep, "_h", None)
-            if h is None or not getattr(m._rep, "native_get_probe", False):
+            kind = getattr(m._rep, "_nget_mem_kind", None)
+            if h is None or kind is None:
                 return None  # rep layout the native probe can't walk
             handles.append(h)
+            kinds.append(kind)
         vh = version.native_read_chain(table_cache)
         if vh is None and any(version.files):
             return None
@@ -175,6 +178,9 @@ class _NGetState:
         ctx = lib.tpulsm_getctx_new(marr, len(handles), vh, 4096)
         if not ctx:
             return None
+        for i, kind in enumerate(kinds):
+            if kind:
+                lib.tpulsm_getctx_set_mem_kind(ctx, i, kind)
         s = cls.__new__(cls)
         s.mem = mem
         s.imm = list(imm)
@@ -317,6 +323,7 @@ class DB:
         # as young for preclude_last_level_data_seconds; a JSON sidecar
         # is our persistence (loaded in DB.open, saved on sample/close).
         self._seqno_time_path = None
+        self._seqno_time_dirty = False
         self._last_seqno_time_sample = 0.0
         self._wbm_charged = 0  # bytes charged to options.write_buffer_manager
         self._options_file_number = 0  # latest persisted OPTIONS file
@@ -950,9 +957,11 @@ class DB:
         """Best-effort sidecar persistence of the seqno<->time mapping
         (the reference rides MANIFEST/SST properties): without it a
         reopen would treat ALL existing data as young for
-        preclude_last_level_data_seconds."""
+        preclude_last_level_data_seconds. Called OUTSIDE the write hot
+        path — samples mark dirty; flush/close persist."""
         if self._seqno_time_path is None:
             return
+        self._seqno_time_dirty = False
         try:
             import json as _json
 
@@ -967,11 +976,11 @@ class DB:
         (caller holds _mutex)."""
         seq_top = self.versions.last_sequence + 1
         now = time.time()
-        if now - self._last_seqno_time_sample >= \
-                self.options.seqno_time_sample_period_sec:
+        period = self.options.seqno_time_sample_period_sec
+        if period > 0 and now - self._last_seqno_time_sample >= period:
             self._last_seqno_time_sample = now
             self.seqno_to_time.append(seq_top - 1, int(now))
-            self._save_seqno_time()
+            self._seqno_time_dirty = True
         if self.stats is not None:
             from toplingdb_tpu.utils import statistics as st
 
@@ -1048,11 +1057,11 @@ class DB:
                     w.on_sequenced(s0, s0 + w.batch.count() - 1)
             self.versions.last_sequence = seq - 1
             now = time.time()
-            if now - self._last_seqno_time_sample >= \
-                    self.options.seqno_time_sample_period_sec:
+            period = self.options.seqno_time_sample_period_sec
+            if period > 0 and now - self._last_seqno_time_sample >= period:
                 self._last_seqno_time_sample = now
                 self.seqno_to_time.append(seq - 1, int(now))
-                self._save_seqno_time()
+                self._seqno_time_dirty = True
             if self.stats is not None:
                 from toplingdb_tpu.utils import statistics as st
 
@@ -1206,6 +1215,8 @@ class DB:
             if any(not c.mem.empty() for c in self._cfs.values()):
                 self._switch_memtable()
             self._flush_immutables()
+        if self._seqno_time_dirty:
+            self._save_seqno_time()  # outside _mutex: best-effort IO
 
     # ==================================================================
     # Read path

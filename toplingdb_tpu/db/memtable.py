@@ -77,9 +77,10 @@ class NativeSkipListRep(MemTableRep):
     below shares every method body, differing only in its native prefix
     and the next() call shape."""
 
-    # tpulsm_db_get may probe this rep's handle directly (it casts to the
-    # skiplist struct); reps with a different native layout must say no.
-    native_get_probe = True
+    # tpulsm_db_get probes this rep's handle directly; the kind tells the
+    # native side which layout to walk (0 = skiplist, 1 = trie); reps
+    # without the attribute are not natively probeable.
+    _nget_mem_kind = 0
     _sym = "tpulsm_skiplist"
     _entry_sym = "node"  # {sym}_{entry_sym}(pos, ...) decodes a position
 
@@ -269,7 +270,7 @@ class NativeTrieRep(NativeSkipListRep):
     key regions never contend; versions hang off one leaf per user key
     as release-published atomic lists (lockless readers)."""
 
-    native_get_probe = False  # handle is a TrieRep*, not a SkipList*
+    _nget_mem_kind = 1  # TrieRep* layout
     _sym = "tpulsm_trie"
     _entry_sym = "ver"
 

@@ -239,6 +239,10 @@ def lib() -> ctypes.CDLL | None:
             l.tpulsm_getctx_out.argtypes = [ctypes.c_void_p]
             l.tpulsm_getctx_val.restype = ctypes.c_void_p
             l.tpulsm_getctx_val.argtypes = [ctypes.c_void_p]
+            l.tpulsm_getctx_set_mem_kind.restype = None
+            l.tpulsm_getctx_set_mem_kind.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ]
             l.tpulsm_getctx_get.restype = ctypes.c_int32
             l.tpulsm_getctx_get.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,

@@ -2781,6 +2781,7 @@ void tpulsm_block_cache_config(int64_t bytes, int64_t* out_stats) {
 // out[2..7]=counters (NC_* order).
 struct NGetCtx {
   std::vector<void*> mems;
+  std::vector<int32_t> kinds;  // 0 = skiplist, 1 = trie
   void* version = nullptr;
   int64_t out[8];
   std::vector<uint8_t> val;
@@ -2791,10 +2792,17 @@ void* tpulsm_getctx_new(void** mem_handles, int32_t n_mems, void* version,
   NGetCtx* c = new (std::nothrow) NGetCtx();
   if (!c) return nullptr;
   for (int32_t i = 0; i < n_mems; i++) c->mems.push_back(mem_handles[i]);
+  c->kinds.assign((size_t)n_mems, 0);
   c->version = version;
   c->val.resize((size_t)(val_cap > 0 ? val_cap : 4096));
   std::memset(c->out, 0, sizeof(c->out));
   return c;
+}
+
+// Mark memtable i as a trie-rep handle (layout differs from the skiplist).
+void tpulsm_getctx_set_mem_kind(void* ctx, int32_t i, int32_t kind) {
+  NGetCtx* c = static_cast<NGetCtx*>(ctx);
+  if (i >= 0 && (size_t)i < c->kinds.size()) c->kinds[i] = kind;
 }
 
 void tpulsm_getctx_free(void* ctx) { delete static_cast<NGetCtx*>(ctx); }
@@ -2807,28 +2815,34 @@ uint8_t* tpulsm_getctx_val(void* ctx) {
   return static_cast<NGetCtx*>(ctx)->val.data();
 }
 
-// Forward decl (definition below keeps the original entry point).
+// Forward decls (definitions below keep the original entry points).
 int32_t tpulsm_db_get(void** mem_handles, int32_t n_mems, void* version,
                       const uint8_t* ukey, int32_t klen, uint64_t snap_seq,
                       uint8_t* val_out, int32_t val_cap, int32_t* val_len,
                       int32_t* src_out, int64_t* counters);
+int32_t tpulsm_db_get_kinds(void** mem_handles, const int32_t* mem_kinds,
+                            int32_t n_mems, void* version,
+                            const uint8_t* ukey, int32_t klen,
+                            uint64_t snap_seq, uint8_t* val_out,
+                            int32_t val_cap, int32_t* val_len,
+                            int32_t* src_out, int64_t* counters);
 
 int32_t tpulsm_getctx_get(void* ctx, const uint8_t* ukey, int32_t klen,
                           uint64_t snap_seq) {
   NGetCtx* c = static_cast<NGetCtx*>(ctx);
   int32_t vlen = 0, src = -1;
-  int32_t rc = tpulsm_db_get(
-      c->mems.data(), (int32_t)c->mems.size(), c->version, ukey, klen,
-      snap_seq, c->val.data(), (int32_t)c->val.size(), &vlen, &src,
-      c->out + 2);
+  int32_t rc = tpulsm_db_get_kinds(
+      c->mems.data(), c->kinds.data(), (int32_t)c->mems.size(), c->version,
+      ukey, klen, snap_seq, c->val.data(), (int32_t)c->val.size(), &vlen,
+      &src, c->out + 2);
   if (rc == -1 && vlen > (int32_t)c->val.size()) {
     // Value outgrew the buffer: grow and retry — the caller detects
     // out[0] > its mapped capacity and re-maps tpulsm_getctx_val().
     c->val.resize((size_t)vlen + 1024);
-    rc = tpulsm_db_get(
-        c->mems.data(), (int32_t)c->mems.size(), c->version, ukey, klen,
-        snap_seq, c->val.data(), (int32_t)c->val.size(), &vlen, &src,
-        c->out + 2);
+    rc = tpulsm_db_get_kinds(
+        c->mems.data(), c->kinds.data(), (int32_t)c->mems.size(), c->version,
+        ukey, klen, snap_seq, c->val.data(), (int32_t)c->val.size(), &vlen,
+        &src, c->out + 2);
   }
   c->out[0] = vlen;
   c->out[1] = src;
@@ -2859,9 +2873,9 @@ int32_t tpulsm_getctx_multiget(void* ctx, const uint8_t* keybuf,
     int32_t kl = key_lens[i];
     int32_t vlen = 0, src = -1;
     int64_t tmp_ctr[NC_COUNT];
-    int32_t rc = tpulsm_db_get(
-        c->mems.data(), (int32_t)c->mems.size(), c->version, k, kl,
-        snap_seq, val_arena + lo,
+    int32_t rc = tpulsm_db_get_kinds(
+        c->mems.data(), c->kinds.data(), (int32_t)c->mems.size(), c->version,
+        k, kl, snap_seq, val_arena + lo,
         (int32_t)std::min<int64_t>(hi - lo, (1u << 31) - 1),
         &vlen, &src, tmp_ctr);
     for (int t = 0; t < NC_COUNT; t++) ctr[t] += tmp_ctr[t];
@@ -2956,23 +2970,47 @@ int32_t tpulsm_db_get(void** mem_handles, int32_t n_mems, void* version,
                       const uint8_t* ukey, int32_t klen, uint64_t snap_seq,
                       uint8_t* val_out, int32_t val_cap, int32_t* val_len,
                       int32_t* src_out, int64_t* counters) {
+  return tpulsm_db_get_kinds(mem_handles, nullptr, n_mems, version, ukey,
+                             klen, snap_seq, val_out, val_cap, val_len,
+                             src_out, counters);
+}
+
+int32_t tpulsm_db_get_kinds(void** mem_handles, const int32_t* mem_kinds,
+                            int32_t n_mems, void* version,
+                            const uint8_t* ukey, int32_t klen,
+                            uint64_t snap_seq, uint8_t* val_out,
+                            int32_t val_cap, int32_t* val_len,
+                            int32_t* src_out, int64_t* counters) {
   *src_out = -1;
   for (int i = 0; i < NC_COUNT; i++) counters[i] = 0;
   if (klen > 4096) return NGET_FALLBACK;
+  uint64_t packed = (snap_seq << 8) | 0x7F;
+  uint64_t inv = ~packed;
   for (int32_t m = 0; m < n_mems; m++) {
     counters[NC_MEMS]++;
-    SkipList* sl = static_cast<SkipList*>(mem_handles[m]);
-    uint64_t packed = (snap_seq << 8) | 0x7F;
-    uint64_t inv = ~packed;
-    SLNode* n = sl->seek_ge(ukey, (uint32_t)klen, inv, nullptr);
-    if (!n || n->key_len != (uint32_t)klen ||
-        std::memcmp(n->key, ukey, (size_t)klen) != 0)
-      continue;
-    uint64_t p2 = ~n->inv_packed;
+    uint64_t p2;
+    const uint8_t* rec;
+    if (mem_kinds && mem_kinds[m] == 1) {
+      // Trie rep: newest visible version of exactly this key.
+      TVer* v = static_cast<TVer*>(
+          tpulsm_trie_seek_ge(mem_handles[m], ukey, (uint32_t)klen, inv));
+      if (!v || v->leaf->key_len != (uint32_t)klen ||
+          (klen && std::memcmp(v->leaf->key, ukey, (size_t)klen) != 0))
+        continue;
+      p2 = ~v->inv;
+      rec = v->val.load(std::memory_order_acquire);
+    } else {
+      SkipList* sl = static_cast<SkipList*>(mem_handles[m]);
+      SLNode* n = sl->seek_ge(ukey, (uint32_t)klen, inv, nullptr);
+      if (!n || n->key_len != (uint32_t)klen ||
+          std::memcmp(n->key, ukey, (size_t)klen) != 0)
+        continue;
+      p2 = ~n->inv_packed;
+      rec = n->val.load(std::memory_order_acquire);
+    }
     uint8_t vt = (uint8_t)(p2 & 0xFF);
     *src_out = 0;
     if (vt == 0x1) {
-      const uint8_t* rec = n->val.load(std::memory_order_acquire);
       uint32_t vl;
       std::memcpy(&vl, rec, 4);
       if ((int32_t)vl > val_cap) {
