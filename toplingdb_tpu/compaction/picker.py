@@ -126,6 +126,33 @@ class LeveledCompactionPicker(CompactionPicker):
                 return c
         return None
 
+    # Reference kMinFilesForIntraL0Compaction.
+    _INTRA_L0_MIN_FILES = 4
+
+    def _try_intra_l0(self, version: Version) -> Compaction | None:
+        """L0→L0 merge of the newest CONTIGUOUS run of free files
+        (reference TryPickIntraL0Compaction, compaction_picker.cc): L0
+        files hold disjoint seqno intervals in newest-first order, so a
+        contiguous prefix merges into one file that slots back at its
+        position; non-contiguous picks could interleave seqnos."""
+        run = []
+        total = 0
+        cap = self.options.max_compaction_bytes or (1 << 62)
+        for f in version.files[0]:  # newest-first
+            if f.being_compacted:
+                break
+            if total + f.file_size > cap and run:
+                break
+            run.append(f)
+            total += f.file_size
+        if len(run) < self._INTRA_L0_MIN_FILES:
+            return None
+        return Compaction(
+            level=0, output_level=0, inputs=run, output_level_inputs=[],
+            bottommost=False, reason="intra-L0",
+            max_output_file_size=1 << 62,  # one output file
+        )
+
     def _pick_level(self, version: Version, level: int) -> Compaction | None:
         if level == version.num_levels - 1:
             # In-place rewrite of a collector-marked bottommost file.
@@ -146,7 +173,11 @@ class LeveledCompactionPicker(CompactionPicker):
                     and not any(f.marked_for_compaction for f in inputs)):
                 return None
             if not inputs or any(f.being_compacted for f in version.files[0]):
-                return None  # L0→L1 must take all L0 files; wait
+                # L0→L1 must take all L0 files; while some are busy,
+                # compact the free newest prefix L0→L0 instead
+                # (reference TryPickIntraL0Compaction) so read-amp and
+                # the L0 stall triggers keep falling.
+                return self._try_intra_l0(version)
             output_level = 1
         else:
             # Pick the largest not-being-compacted file (simple heuristic;
@@ -171,7 +202,7 @@ class LeveledCompactionPicker(CompactionPicker):
             smallest, largest = self._key_range(inputs)
         outputs = self._expand_range_to_level(version, output_level, smallest, largest)
         if any(f.being_compacted for f in outputs):
-            return None
+            return self._try_intra_l0(version) if level == 0 else None
         all_small, all_large = self._key_range(inputs + outputs) if outputs else (smallest, largest)
         return Compaction(
             level=level,

@@ -39,6 +39,9 @@ class CompactionScheduler:
         self.last_error: BaseException | None = None
         self.num_completed = 0
         self.num_trivial_moves = 0
+        # (retry_ts, FileMetaData) of marked-rewrite jobs postponed by
+        # preclude_last_level_data_seconds; re-marked once aged.
+        self._preclude_remark: list = []
 
     # ------------------------------------------------------------------
 
@@ -164,6 +167,17 @@ class CompactionScheduler:
     def _run_one(self) -> bool:
         db = self.db
         self._apply_periodic_marking()
+        if self._preclude_remark:
+            import time as _t
+
+            now = _t.time()
+            still = []
+            for retry, f in self._preclude_remark:
+                if retry <= now:
+                    f.marked_for_compaction = True
+                else:
+                    still.append((retry, f))
+            self._preclude_remark = still
         with db._mutex:
             # Visit CFs by descending top compaction score — fixed id order
             # would starve later CFs under sustained load on an earlier one.
@@ -215,7 +229,7 @@ class CompactionScheduler:
             # Same-level bottommost rewrites (marked-file rewrites,
             # universal L0 self-compactions) are last-level-treatment jobs
             # too — c.bottommost alone decides eligibility.
-            return
+            return False
         cutoff_seq = db.seqno_to_time.get_proximal_seqno(
             int(_time.time()) - secs)
         if cutoff_seq is None:
@@ -225,13 +239,28 @@ class CompactionScheduler:
         newest = max((f.largest_seqno for _, f in c.all_inputs()),
                      default=0)
         if newest > cutoff_seq:
+            if c.reason == "bottommost marked":
+                # A marked-file rewrite exists ONLY to drop garbage; run
+                # precluded it would drop nothing and then suppress the
+                # re-mark — cancelling the collector's request forever.
+                # SKIP instead: unmark now, re-mark after a backoff so
+                # the picker doesn't spin on the same young file.
+                import time as _t2
+
+                retry = _t2.time() + min(60.0, float(secs))
+                for f in c.inputs:
+                    f.marked_for_compaction = False
+                    self._preclude_remark.append((retry, f))
+                return True
             c.bottommost = False
+        return False
 
     def _run_compaction(self, c: Compaction) -> None:
         db = self.db
         if not c.output_level_inputs and not c.inputs:
             return
-        self._maybe_preclude_last_level(c)
+        if self._maybe_preclude_last_level(c):
+            return  # postponed (young marked rewrite); re-marks later
         if c.reason.startswith("fifo"):
             # Deletion-only compaction.
             edit = make_version_edit(c, [])

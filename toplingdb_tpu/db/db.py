@@ -953,6 +953,19 @@ class DB:
             if w is not group[0]:
                 w.event.set()
 
+    def _maybe_sample_seqno_time(self, seq: int) -> None:
+        """Record (seq, now) when the sampling period elapsed (period 0 =
+        manual only); shared by both publish paths. Persistence happens
+        off the write hot path (bg flush / explicit flush / close)."""
+        period = self.options.seqno_time_sample_period_sec
+        if period <= 0:
+            return
+        now = time.time()
+        if now - self._last_seqno_time_sample >= period:
+            self._last_seqno_time_sample = now
+            self.seqno_to_time.append(seq, int(now))
+            self._seqno_time_dirty = True
+
     def _save_seqno_time(self) -> None:
         """Best-effort sidecar persistence of the seqno<->time mapping
         (the reference rides MANIFEST/SST properties): without it a
@@ -975,12 +988,7 @@ class DB:
         """Stats + seqno/time sampling + flush trigger after a publish
         (caller holds _mutex)."""
         seq_top = self.versions.last_sequence + 1
-        now = time.time()
-        period = self.options.seqno_time_sample_period_sec
-        if period > 0 and now - self._last_seqno_time_sample >= period:
-            self._last_seqno_time_sample = now
-            self.seqno_to_time.append(seq_top - 1, int(now))
-            self._seqno_time_dirty = True
+        self._maybe_sample_seqno_time(seq_top - 1)
         if self.stats is not None:
             from toplingdb_tpu.utils import statistics as st
 
@@ -1056,12 +1064,7 @@ class DB:
                     s0 = w.batch.sequence()
                     w.on_sequenced(s0, s0 + w.batch.count() - 1)
             self.versions.last_sequence = seq - 1
-            now = time.time()
-            period = self.options.seqno_time_sample_period_sec
-            if period > 0 and now - self._last_seqno_time_sample >= period:
-                self._last_seqno_time_sample = now
-                self.seqno_to_time.append(seq - 1, int(now))
-                self._seqno_time_dirty = True
+            self._maybe_sample_seqno_time(seq - 1)
             if self.stats is not None:
                 from toplingdb_tpu.utils import statistics as st
 
@@ -1158,6 +1161,12 @@ class DB:
     def _flush_memtables_inner(self, mems: list[MemTable],
                                wal_number: int | None, cf_id: int) -> None:
         t0 = time.time()
+        if self._seqno_time_dirty:
+            # Every flush path (auto-switch, write-path stall, bg worker)
+            # funnels here off the write hot path: persist pending
+            # seqno-time samples so a crash doesn't lose them and make
+            # all existing data look young after reopen.
+            self._save_seqno_time()
         fnum = self.versions.new_file_number()
         blob_num = (
             self.versions.new_file_number()
