@@ -203,3 +203,30 @@ def test_replay_timing_faithful_speedup(tmp_path):
         t0 = _time.time()
         Replayer(db2, trace).replay(fast_forward=False, speedup=1.0)
         assert _time.time() - t0 >= 0.25  # faithful replay keeps the gap
+
+
+def test_ldb_backup_restore_idump_compact(tmp_path):
+    """ldb gains compact / idump / backup / offline restore (reference
+    ldb command surfaces)."""
+    import subprocess
+    import sys
+
+    base = str(tmp_path)
+    d = base + "/db"
+
+    def run(*a):
+        return subprocess.run(
+            [sys.executable, "-m", "toplingdb_tpu.tools.ldb", *a],
+            capture_output=True, text=True, timeout=120)
+
+    assert run("--db", d, "put", "alpha", "one").returncode == 0
+    assert run("--db", d, "put", "beta", "two").returncode == 0
+    assert "compaction done" in run("--db", d, "compact").stdout
+    out = run("--db", d, "idump", "--limit", "10").stdout
+    assert "alpha" in out and "VALUE" in out
+    assert "backup 1 created" in run("--db", d, "backup",
+                                     base + "/bk").stdout
+    assert run("--db", base + "/restored", "restore", base + "/bk",
+               "1").returncode == 0
+    assert run("--db", base + "/restored",
+               "get", "alpha").stdout.strip() == "one"

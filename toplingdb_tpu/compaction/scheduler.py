@@ -171,13 +171,15 @@ class CompactionScheduler:
             import time as _t
 
             now = _t.time()
-            still = []
-            for retry, f in self._preclude_remark:
-                if retry <= now:
-                    f.marked_for_compaction = True
-                else:
-                    still.append((retry, f))
-            self._preclude_remark = still
+            with self._lock:  # concurrent bg workers append + sweep
+                pending = self._preclude_remark
+                still = []
+                expired = []
+                for retry, f in pending:
+                    (expired if retry <= now else still).append((retry, f))
+                self._preclude_remark = still
+            for _retry, f in expired:
+                f.marked_for_compaction = True
         with db._mutex:
             # Visit CFs by descending top compaction score — fixed id order
             # would starve later CFs under sustained load on an earlier one.
@@ -248,9 +250,10 @@ class CompactionScheduler:
                 import time as _t2
 
                 retry = _t2.time() + min(60.0, float(secs))
-                for f in c.inputs:
-                    f.marked_for_compaction = False
-                    self._preclude_remark.append((retry, f))
+                with self._lock:
+                    for f in c.inputs:
+                        f.marked_for_compaction = False
+                        self._preclude_remark.append((retry, f))
                 return True
             c.bottommost = False
         return False

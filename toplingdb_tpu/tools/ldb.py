@@ -7,7 +7,8 @@ Commands:
   batchput K1 V1 K2 V2 ... | deleterange BEGIN END
   manifest_dump | wal_dump WALFILE | list_files | checkpoint DEST
   repair | ingest_extern_sst FILE | approxsize --from=K --to=K
-  verify_checksum | list_column_families
+  verify_checksum | list_column_families | compact [--from --to]
+  idump [--limit] | backup BACKUP_DIR | restore BACKUP_DIR ID (into --db)
 """
 
 from __future__ import annotations
@@ -54,6 +55,13 @@ def main(argv=None) -> int:
 
         for child in default_env().get_children(args.db):
             print(child)
+        return 0
+    if cmd == "restore":
+        # Offline restore: ldb --db=DEST restore BACKUP_DIR BACKUP_ID
+        from toplingdb_tpu.utilities.backup_engine import BackupEngine
+
+        BackupEngine(a[0]).restore_db_from_backup(int(a[1]), args.db)
+        print(f"restored backup {a[1]} into {args.db}")
         return 0
 
     db = DB.open(args.db, Options(create_if_missing=(cmd in ("put", "batchput"))))
@@ -129,6 +137,38 @@ def main(argv=None) -> int:
         elif cmd == "list_column_families":
             for h in db.list_column_families():
                 print(h.name)
+        elif cmd == "compact":
+            lo = enc(args.from_key) if args.from_key else None
+            hi = enc(args.to_key) if args.to_key else None
+            db.compact_range(lo, hi)
+            db.wait_for_compactions()
+            print("compaction done")
+        elif cmd == "idump":
+            # Internal-key dump (reference ldb idump): every version of
+            # every key with seqno + type, straight off the SSTs.
+            from toplingdb_tpu.db import dbformat as _dbf
+
+            n = 0
+            v = db.versions.current
+            for _, f in v.all_files():
+                r = db.table_cache.get_reader(f.number)
+                it = r.new_iterator()
+                it.seek_to_first()
+                for ik, val in it.entries():
+                    uk, seq, t = _dbf.split_internal_key(ik)
+                    print(f"{dec(uk)} @ {seq} : "
+                          f"{_dbf.ValueType(t).name} => {dec(val)}")
+                    n += 1
+                    if args.limit and n >= args.limit:
+                        break
+                if args.limit and n >= args.limit:
+                    break
+            print(f"internal keys: {n}")
+        elif cmd == "backup":
+            from toplingdb_tpu.utilities.backup_engine import BackupEngine
+
+            bid = BackupEngine(a[0]).create_backup(db)
+            print(f"backup {bid} created in {a[0]}")
         else:
             print(f"unknown command {cmd!r}", file=sys.stderr)
             return 2
