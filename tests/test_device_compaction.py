@@ -779,3 +779,57 @@ def test_segmented_merge_parity_vs_sort():
             os.environ.pop("TPULSM_DEVICE_MERGE", None)
         else:
             os.environ["TPULSM_DEVICE_MERGE"] = old
+
+
+def test_host_merge_runs_matches_full_sort():
+    """tpulsm_merge_runs (multi-threaded k-way merge of presorted runs,
+    the host twin of the device segmented merge) must reproduce
+    tpulsm_sort_entries' exact order/new_key/packed outputs."""
+    import numpy as np
+
+    from toplingdb_tpu.ops import compaction_kernels as ck
+
+    rng = np.random.default_rng(9)
+    # (n_runs, rows_per_run, mixed_lens): the 60k-per-run case crosses the
+    # 1<<16 threshold that enables the SPLITTER-PARTITIONED multithread
+    # merge; mixed key lengths exercise the len tiebreak + kw padding.
+    for n_runs, rows, mixed in ((1, 2000, False), (3, 1500, True),
+                                (4, 60_000, False), (5, 1200, True)):
+        parts = []
+        for _ in range(n_runs):
+            n = int(rng.integers(rows // 2, rows + 1))
+            uk = np.sort(rng.integers(0, max(10, n // 2), n))
+            seqs = rng.integers(1, 1 << 40, n).astype(np.uint64)
+            if mixed:
+                ks = np.array([(b"%08d" % k)[: 4 + (k % 5)] for k in uk])
+                ks = np.array(sorted(ks))
+            else:
+                ks = np.array([b"%08d" % k for k in uk])
+            order = np.lexsort(
+                (np.iinfo(np.int64).max - seqs.view(np.int64), ks))
+            recs = []
+            for oi in order:
+                packed = (int(seqs[oi]) << 8) | 1
+                recs.append(bytes(ks[oi])
+                            + packed.to_bytes(8, "little"))
+            parts.append(recs)
+        recs = [r for p_ in parts for r in p_]
+        buf = np.frombuffer(b"".join(recs), np.uint8)
+        lens = np.array([len(r) for r in recs], np.int64)
+        offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+        ns = [len(p_) for p_ in parts]
+        rs = np.cumsum([0] + ns, dtype=np.int64)
+        a = ck.host_sort_order(buf, offs, lens)
+        b = ck.host_sort_order(buf, offs, lens, run_starts=rs)
+        if a is None or b is None:
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        assert np.array_equal(a[0], b[0]), (n_runs, mixed)
+        assert np.array_equal(a[1], b[1])
+        assert np.array_equal(a[2], b[2])
+        # malformed boundaries must fall back, not corrupt
+        bad = rs.copy()
+        bad[-1] -= 1
+        c = ck.host_sort_order(buf, offs, lens, run_starts=bad)
+        assert np.array_equal(a[0], c[0])

@@ -172,6 +172,17 @@ def lib() -> ctypes.CDLL | None:
         except AttributeError:
             pass
         try:
+            # Host k-way merge of presorted runs (separate block: a stale
+            # .so missing THIS symbol must not void older registrations).
+            l.tpulsm_merge_runs.restype = ctypes.c_int32
+            l.tpulsm_merge_runs.argtypes = [
+                u8p, i64p, i64p, ctypes.c_int64,
+                i64p, ctypes.c_int32,                   # run_starts, n_runs
+                i32p, u8p, ctypes.POINTER(ctypes.c_uint64),
+            ]
+        except AttributeError:
+            pass
+        try:
             # Ordered whole-memtable export into columnar buffers: the
             # memtable half of the columnar flush fast path.
             u64p = ctypes.POINTER(ctypes.c_uint64)

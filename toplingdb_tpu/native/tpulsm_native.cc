@@ -49,6 +49,41 @@ static size_t effective_cpus() {
 
 extern "C" {
 
+// Shared packed-entry representation of the <=8B-user-key fast path:
+// tpulsm_sort_entries and tpulsm_merge_runs promise BIT-EXACT identical
+// output, so the struct, comparator, and entry build live in ONE place.
+extern "C++" {
+struct PackedEntry {
+  uint64_t kw;      // BE-packed user key, zero-padded
+  uint64_t packed;  // (seq << 8) | type; DESCENDING
+  uint32_t len;
+  int32_t idx;
+};
+
+static inline bool packed_entry_less(const PackedEntry& a,
+                                     const PackedEntry& b) {
+  if (a.kw != b.kw) return a.kw < b.kw;
+  if (a.len != b.len) return a.len < b.len;
+  if (a.packed != b.packed) return a.packed > b.packed;  // newer seq first
+  return a.idx < b.idx;
+}
+
+static inline PackedEntry packed_entry_of(const uint8_t* key_buf,
+                                          const int64_t* offs,
+                                          const int64_t* lens, int64_t i) {
+  const uint8_t* k = key_buf + offs[i];
+  const int64_t l = lens[i] - 8;
+  uint64_t kw = 0;
+  for (int64_t b = 0; b < l; b++)
+    kw |= static_cast<uint64_t>(k[b]) << (8 * (7 - b));
+  const uint8_t* t = k + l;
+  uint64_t p = 0;
+  for (int b = 0; b < 8; b++) p |= static_cast<uint64_t>(t[b]) << (8 * b);
+  return {kw, p, static_cast<uint32_t>(l), static_cast<int32_t>(i)};
+}
+}  // extern "C++"
+
+
 // ---------------------------------------------------------------------------
 // Internal-key sort: order entries by (user key bytes asc, key length asc,
 // seqno desc) — the exact order the device sort realizes with zero-padded
@@ -81,34 +116,18 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
     // Packed fast path: user keys fit one big-endian word, so the whole
     // comparator is three integer compares on a cache-friendly struct —
     // ~6x faster than the indirect memcmp form at multi-million entries.
-    struct E {
-      uint64_t kw;      // BE-packed user key, zero-padded
-      uint64_t packed;  // (seq << 8) | type; DESCENDING
-      uint32_t len;
-      int32_t idx;
-    };
+    using E = PackedEntry;
     std::vector<E> es(n);
-    for (int64_t i = 0; i < n; i++) {
-      const uint8_t* k = key_buf + offs[i];
-      const int64_t l = lens[i] - 8;
-      uint64_t kw = 0;
-      for (int64_t b = 0; b < l; b++)
-        kw |= static_cast<uint64_t>(k[b]) << (8 * (7 - b));
-      es[i] = {kw,
-               packed_out ? packed_out[i]
-                          : packed_of(static_cast<int32_t>(i)),
-               static_cast<uint32_t>(l), static_cast<int32_t>(i)};
-    }
+    for (int64_t i = 0; i < n; i++)
+      es[i] = packed_entry_of(key_buf, offs, lens, i);
     // idx as the final tiebreak makes the order STRICT and total, so an
     // unstable chunked parallel sort + merges yields exactly the sequence
     // stable_sort would — independent of thread count. The single-core
     // radix path below realises the same order (stable LSD over the same
-    // composite), so every path emits identical bytes.
+    // composite), so every path emits identical bytes. The comparator is
+    // the SHARED packed_entry_less — merge_runs must stay bit-identical.
     auto cmp = [](const E& a, const E& b) {
-      if (a.kw != b.kw) return a.kw < b.kw;
-      if (a.len != b.len) return a.len < b.len;
-      if (a.packed != b.packed) return a.packed > b.packed;  // newer seq first
-      return a.idx < b.idx;
+      return packed_entry_less(a, b);
     };
     size_t nthreads = effective_cpus();
     if (nthreads > 8) nthreads = 8;
@@ -248,6 +267,132 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
     new_key_out[i] =
         (la != lb ||
          std::memcmp(key_buf + offs[a], key_buf + offs[b], la) != 0)
+            ? 1
+            : 0;
+  }
+  return 0;
+}
+
+
+// ---------------------------------------------------------------------------
+// K-way merge of PRESORTED runs — the host twin of the device segmented
+// merge (and the reference's heap merge, table/merging_iterator.cc:476):
+// compaction inputs are already internal-key-sorted runs, so re-deriving
+// the order with a full sort does O(N log N) work the structure already
+// paid for. Each of T threads owns a splitter-bounded slice of EVERY run
+// (binary-searched bounds → contiguous output range) and k-way merges its
+// slices with a linear head scan. Output contract matches
+// tpulsm_sort_entries exactly (same comparator incl. the idx tiebreak).
+// Returns 0, or -1 when ineligible (user keys > 8B: caller falls back).
+// ---------------------------------------------------------------------------
+int32_t tpulsm_merge_runs(const uint8_t* key_buf, const int64_t* offs,
+                          const int64_t* lens, int64_t n,
+                          const int64_t* run_starts, int32_t n_runs,
+                          int32_t* order_out, uint8_t* new_key_out,
+                          uint64_t* packed_out /* nullable */) {
+  if (n <= 0 || n_runs <= 0) return -1;
+  for (int64_t i = 0; i < n; i++)
+    if (lens[i] - 8 > 8) return -1;  // packed fast path only
+  using E = PackedEntry;
+  auto cmp = [](const E& a, const E& b) { return packed_entry_less(a, b); };
+  size_t nthreads = effective_cpus();
+  if (nthreads > 8) nthreads = 8;
+  if (n < (1 << 16)) nthreads = 1;
+  std::vector<E> es, out;
+  std::vector<std::vector<int64_t>> lb;
+  try {
+    es.resize(n);
+    out.resize(n);
+    lb.assign(nthreads + 1, std::vector<int64_t>(n_runs));
+  } catch (...) {
+    return -1;  // no exception may cross the extern "C" boundary
+  }
+  auto spawn_or_inline = [](std::vector<std::thread>& pool, auto&& fn) {
+    try {
+      pool.emplace_back(fn);
+    } catch (...) {
+      fn();
+    }
+  };
+  {
+    // Parallel entry build (+ packed_out per ORIGINAL index).
+    auto build = [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; i++) {
+        es[i] = packed_entry_of(key_buf, offs, lens, i);
+        if (packed_out) packed_out[i] = es[i].packed;
+      }
+    };
+    std::vector<std::thread> pool;
+    for (size_t t = 1; t < nthreads; t++)
+      spawn_or_inline(pool, [&, t] {
+        build(n * (int64_t)t / (int64_t)nthreads,
+              n * (int64_t)(t + 1) / (int64_t)nthreads);
+      });
+    build(0, n / (int64_t)nthreads);
+    for (auto& w : pool) w.join();
+  }
+  // Splitters from the largest run; per-run bounds via lower_bound.
+  int32_t big = 0;
+  for (int32_t r = 1; r < n_runs; r++)
+    if (run_starts[r + 1] - run_starts[r] >
+        run_starts[big + 1] - run_starts[big])
+      big = r;
+  for (int32_t r = 0; r < n_runs; r++) {
+    lb[0][r] = run_starts[r];
+    lb[nthreads][r] = run_starts[r + 1];
+  }
+  for (size_t t = 1; t < nthreads; t++) {
+    int64_t blo = run_starts[big], bhi = run_starts[big + 1];
+    const E& sp = es[blo + (bhi - blo) * (int64_t)t / (int64_t)nthreads];
+    for (int32_t r = 0; r < n_runs; r++) {
+      int64_t lo = run_starts[r], hi = run_starts[r + 1];
+      while (lo < hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (cmp(es[mid], sp))
+          lo = mid + 1;
+        else
+          hi = mid;
+      }
+      lb[t][r] = lo;
+    }
+  }
+  // Per-thread k-way merge into its contiguous output range. head/end
+  // scratch is preallocated HERE (a bad_alloc on a spawned thread would
+  // std::terminate the process).
+  std::vector<std::vector<int64_t>> heads(nthreads,
+                                          std::vector<int64_t>(n_runs)),
+      ends(nthreads, std::vector<int64_t>(n_runs));
+  auto merge_slice = [&](size_t t) {
+    int64_t pos = 0;
+    for (int32_t r = 0; r < n_runs; r++) pos += lb[t][r] - run_starts[r];
+    std::vector<int64_t>& head = heads[t];
+    std::vector<int64_t>& end = ends[t];
+    for (int32_t r = 0; r < n_runs; r++) {
+      head[r] = lb[t][r];
+      end[r] = lb[t + 1][r];
+    }
+    while (true) {
+      int32_t best = -1;
+      for (int32_t r = 0; r < n_runs; r++) {
+        if (head[r] >= end[r]) continue;
+        if (best < 0 || cmp(es[head[r]], es[head[best]])) best = r;
+      }
+      if (best < 0) break;
+      out[pos++] = es[head[best]++];
+    }
+  };
+  {
+    std::vector<std::thread> pool;
+    for (size_t t = 1; t < nthreads; t++)
+      spawn_or_inline(pool, [&, t] { merge_slice(t); });
+    merge_slice(0);
+    for (auto& w : pool) w.join();
+  }
+  for (int64_t i = 0; i < n; i++) {
+    order_out[i] = out[i].idx;
+    new_key_out[i] =
+        (i == 0 || out[i].kw != out[i - 1].kw ||
+         out[i].len != out[i - 1].len)
             ? 1
             : 0;
   }

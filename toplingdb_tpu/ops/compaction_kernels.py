@@ -18,6 +18,7 @@ size bucket, not per job. All lanes are 32-bit (TPU-native); 64-bit packed
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -418,12 +419,14 @@ def host_encode_sort(key_buf: np.ndarray, key_offs: np.ndarray,
 
 
 def host_sort_order(key_buf: np.ndarray, key_offs: np.ndarray,
-                    key_lens: np.ndarray):
-    """(order, new_key, packed) via the native byte-span comparator
-    (std::stable_sort in C++, GIL released) — same order as the device
-    sort; `packed` = per-ORIGINAL-index (seq<<8|type) trailers so callers
-    skip re-gathering them in numpy. None when the native lib is
-    unavailable."""
+                    key_lens: np.ndarray, run_starts=None):
+    """(order, new_key, packed) via the native byte-span comparator —
+    same order as the device sort; `packed` = per-ORIGINAL-index
+    (seq<<8|type) trailers so callers skip re-gathering them in numpy.
+    With `run_starts` ([R+1] boundaries of PRESORTED input runs), the
+    multi-threaded k-way run merge replaces the full sort (the host twin
+    of the device segmented merge; the reference's heap-merge role).
+    None when the native lib is unavailable."""
     import ctypes
 
     from toplingdb_tpu import native
@@ -440,11 +443,32 @@ def host_sort_order(key_buf: np.ndarray, key_offs: np.ndarray,
     # Sentinel prefill: a stale 6-arg .so would leave packed unwritten —
     # (seq=MAX, type=0xFF) is not a valid trailer, so survival means stale.
     packed = np.full(n, np.uint64(0xFFFFFFFFFFFFFFFF), dtype=np.uint64)
-    rc = lib.tpulsm_sort_entries(
-        native.np_u8p(kb), native.np_i64p(offs), native.np_i64p(lens), n,
-        native.np_i32p(order), native.np_u8p(new_key),
-        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-    )
+    rc = -1
+    if (run_starts is not None and len(run_starts) > 1 and n
+            and hasattr(lib, "tpulsm_merge_runs")
+            and os.environ.get("TPULSM_HOST_MERGE", "1") != "0"):
+        rs = np.ascontiguousarray(run_starts, dtype=np.int64)
+        # Malformed boundaries would leave output rows unmerged (silent
+        # corruption) or index past the entry array in C: validate here,
+        # falling back to the full sort.
+        if (int(rs[0]) != 0 or int(rs[-1]) != n
+                or not np.all(np.diff(rs) >= 0)):
+            rs = None
+    else:
+        rs = None
+    if rs is not None:
+        rc = lib.tpulsm_merge_runs(
+            native.np_u8p(kb), native.np_i64p(offs), native.np_i64p(lens),
+            n, native.np_i64p(rs), len(rs) - 1,
+            native.np_i32p(order), native.np_u8p(new_key),
+            packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+    if rc != 0:
+        rc = lib.tpulsm_sort_entries(
+            native.np_u8p(kb), native.np_i64p(offs), native.np_i64p(lens),
+            n, native.np_i32p(order), native.np_u8p(new_key),
+            packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
     if rc != 0:
         return None
     if n and packed[0] == np.uint64(0xFFFFFFFFFFFFFFFF):
@@ -504,7 +528,7 @@ def fused_encode_sort_gc_host(key_buf: np.ndarray, key_offs: np.ndarray,
 def host_fused_full(key_buf: np.ndarray, key_offs: np.ndarray,
                     key_lens: np.ndarray, max_key_bytes: int,
                     snapshots: list[int], bottommost: bool,
-                    cover: np.ndarray | None = None):
+                    cover: np.ndarray | None = None, run_starts=None):
     """Host twin of the fused kernel for accelerator-less deployments
     (TPULSM_HOST_SORT=1): native/lexsort order + vectorized GC mask —
     outputs identical to the jax path (parity-tested). `cover`: optional
@@ -522,7 +546,7 @@ def host_fused_full(key_buf: np.ndarray, key_offs: np.ndarray,
         return (np.empty(0, np.int32), np.empty(0, bool),
                 np.empty(0, bool), False, e, e.astype(np.int32))
     s, new_key, seq, vtype = host_sort_with_boundaries(
-        key_buf, key_offs, key_lens, max_key_bytes
+        key_buf, key_offs, key_lens, max_key_bytes, run_starts=run_starts
     )
     keep, zero_seq, host_resolve, _ = host_gc_mask(
         new_key, seq[s], vtype[s], snapshots,
@@ -535,10 +559,12 @@ def host_fused_full(key_buf: np.ndarray, key_offs: np.ndarray,
     return order, zero_flags, cx_flags, bool(host_resolve.any()), seq, vtype
 
 
-def host_sort_with_boundaries(key_buf, key_offs, key_lens, max_key_bytes):
+def host_sort_with_boundaries(key_buf, key_offs, key_lens, max_key_bytes,
+                              run_starts=None):
     """Shared host-path front half: (s, new_key, seq, vtype) — the native
     comparator when available, else the lexsort twin."""
-    nat = host_sort_order(key_buf, key_offs, key_lens)
+    nat = host_sort_order(key_buf, key_offs, key_lens,
+                          run_starts=run_starts)
     if nat is not None:
         s, new_key, packed = nat
         seq = packed >> np.uint64(8)

@@ -614,10 +614,12 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             import types as _types
 
             t_hc = time.time()
+            rs = np.cumsum([0] + [p_.n for p_ in parts], dtype=np.int64)
             order, zero_flags, cx_flags, has_complex, seq_a, vt_a = \
                 ck.host_fused_full(
                     kv.key_buf, kv.key_offs, kv.key_lens, mkb,
                     snapshots, compaction.bottommost, cover,
+                    run_starts=rs,
                 )
             stats.host_compute_usec = int((time.time() - t_hc) * 1e6)
             col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
