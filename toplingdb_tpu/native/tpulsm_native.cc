@@ -102,11 +102,6 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
     for (int b = 0; b < 8; b++) p |= static_cast<uint64_t>(t[b]) << (8 * b);
     return p;  // (seq << 8) | type
   };
-  if (packed_out) {
-    // Emit per-ORIGINAL-index trailers so callers skip a numpy re-gather.
-    for (int64_t i = 0; i < n; i++)
-      packed_out[i] = packed_of(static_cast<int32_t>(i));
-  }
   int64_t max_uklen = 0;
   for (int64_t i = 0; i < n; i++) {
     const int64_t l = lens[i] - 8;
@@ -118,8 +113,11 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
     // ~6x faster than the indirect memcmp form at multi-million entries.
     using E = PackedEntry;
     std::vector<E> es(n);
-    for (int64_t i = 0; i < n; i++)
+    for (int64_t i = 0; i < n; i++) {
       es[i] = packed_entry_of(key_buf, offs, lens, i);
+      // Per-ORIGINAL-index trailers for the caller, decoded exactly once.
+      if (packed_out) packed_out[i] = es[i].packed;
+    }
     // idx as the final tiebreak makes the order STRICT and total, so an
     // unstable chunked parallel sort + merges yields exactly the sequence
     // stable_sort would — independent of thread count. The single-core
@@ -241,6 +239,11 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
     }
     return 0;
   }
+  if (packed_out) {
+    // Slow (>8B-key) path: emit per-ORIGINAL-index trailers here.
+    for (int64_t i = 0; i < n; i++)
+      packed_out[i] = packed_of(static_cast<int32_t>(i));
+  }
   std::vector<int32_t> idx(n);
   std::iota(idx.begin(), idx.end(), 0);
   // stable: duplicate internal keys keep input order (the survivor choice
@@ -359,9 +362,13 @@ int32_t tpulsm_merge_runs(const uint8_t* key_buf, const int64_t* offs,
   // Per-thread k-way merge into its contiguous output range. head/end
   // scratch is preallocated HERE (a bad_alloc on a spawned thread would
   // std::terminate the process).
-  std::vector<std::vector<int64_t>> heads(nthreads,
-                                          std::vector<int64_t>(n_runs)),
-      ends(nthreads, std::vector<int64_t>(n_runs));
+  std::vector<std::vector<int64_t>> heads, ends;
+  try {
+    heads.assign(nthreads, std::vector<int64_t>(n_runs));
+    ends.assign(nthreads, std::vector<int64_t>(n_runs));
+  } catch (...) {
+    return -1;  // no exception may cross the extern "C" boundary
+  }
   auto merge_slice = [&](size_t t) {
     int64_t pos = 0;
     for (int32_t r = 0; r < n_runs; r++) pos += lb[t][r] - run_starts[r];

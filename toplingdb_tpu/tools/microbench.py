@@ -62,6 +62,61 @@ def main(argv=None) -> int:
 
     run("memtable_insert", memtable_insert, n)
 
+    def rep_insert_batch(rep_name):
+        from toplingdb_tpu.db.memtable import create_memtable_rep
+
+        m = n
+        keys = np.random.default_rng(1).integers(0, m * 2, m)
+        kb = np.zeros(m * 12, np.uint8)
+        for j in range(12):
+            kb[j::12] = (keys // 10 ** (11 - j)) % 10 + 48
+        offs = np.arange(m, dtype=np.int64) * 12
+        lens = np.full(m, 12, np.int32)
+        invs = (~((np.arange(m, dtype=np.uint64) + 1) << np.uint64(8)
+                  | np.uint64(1)))
+        vb = np.full(m * 16, 118, np.uint8)
+        voffs = np.arange(m, dtype=np.int64) * 16
+        vlens = np.full(m, 16, np.int32)
+
+        def go():
+            # Fresh rep per repeat: a COLD insert, not a re-insert into
+            # an already-populated structure.
+            rep = create_memtable_rep(rep_name)
+            rep.insert_batch(kb, offs, lens, invs, vb, voffs, vlens, m)
+
+        return go
+
+    run("skiplist_insert_batch", rep_insert_batch("skiplist"), n)
+    run("cspp_trie_insert_batch", rep_insert_batch("cspp"), n)
+
+    def host_merge_runs():
+        from toplingdb_tpu.ops import compaction_kernels as ck
+
+        rng = np.random.default_rng(2)
+        runs = []
+        seq_base = 1
+        for _ in range(4):
+            m = n // 4
+            uk = np.sort(rng.integers(0, n, m))
+            # Internal-key order: duplicate user keys need seq DESCENDING
+            # within the run (the merge's presorted precondition).
+            recs = []
+            j = m
+            for k in uk:
+                packed = ((seq_base + j) << 8) | 1
+                j -= 1
+                recs.append(b"%012d" % k + packed.to_bytes(8, "little"))
+            seq_base += m
+            runs.append(recs)
+        recs = [r for rr in runs for r in rr]
+        buf = np.frombuffer(b"".join(recs), np.uint8)
+        lens = np.full(len(recs), 20, np.int64)
+        offs = np.arange(len(recs), dtype=np.int64) * 20
+        rs = np.cumsum([0] + [len(rr) for rr in runs], dtype=np.int64)
+        return lambda: ck.host_sort_order(buf, offs, lens, run_starts=rs)
+
+    run("host_merge_runs_4way", host_merge_runs(), n)
+
     from toplingdb_tpu.env import MemEnv
     from toplingdb_tpu.table.builder import TableBuilder, TableOptions
 
