@@ -13,6 +13,7 @@
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include <functional>
 #include <list>
 #include <memory>
 #include <mutex>
@@ -66,6 +67,18 @@ static inline bool packed_entry_less(const PackedEntry& a,
   if (a.len != b.len) return a.len < b.len;
   if (a.packed != b.packed) return a.packed > b.packed;  // newer seq first
   return a.idx < b.idx;
+}
+
+// Run fn on a new thread, or inline when spawning fails (cgroup pid
+// limits, transient EAGAIN) — no exception crosses the extern "C"
+// boundary. Shared by every multi-threaded native routine here.
+static inline void spawn_or_inline_th(std::vector<std::thread>& pool,
+                                      std::function<void()> fn) {
+  try {
+    pool.emplace_back(fn);
+  } catch (...) {
+    fn();
+  }
 }
 
 static inline PackedEntry packed_entry_of(const uint8_t* key_buf,
@@ -1516,88 +1529,188 @@ int64_t tpulsm_build_data_section_c(
   // levels — including zstd's valid negative fast levels and 0 — pass
   // through unchanged.
   if (level == INT32_MIN) level = 3;
-  std::vector<uint8_t> raw;
-  try {
-    raw.resize((size_t)block_size_limit * 2 + 8192);
-  } catch (...) {
-    return -2;
-  }
+
+  // The reference's parallel block compression
+  // (ParallelCompressionRep, block_based_table_builder.cc:818-825),
+  // one-call form: blocks are CUT serially (entry consumption is
+  // data-dependent), compressed in PARALLEL in windows (the per-block
+  // raw-vs-compressed choice depends only on that block's bytes, so the
+  // output is byte-identical to the serial form), then emitted serially
+  // under the exact same file-size/out_cap cut rules. Blocks built past
+  // a mid-window cut are discarded — wasted work only at file ends.
+  struct Blk {
+    std::vector<uint8_t> raw;      // unframed payload
+    std::vector<uint8_t> framed;   // payload + type byte + masked crc
+    int64_t raw_len = 0;
+    int64_t payload_len = 0;
+    int64_t count = 0;
+    size_t bound = 0;
+  };
+  size_t nthreads = effective_cpus();
+  if (nthreads > 8) nthreads = 8;
   int64_t pos = start;
   int64_t used = 0;
   int64_t nb = 0;
-  while (pos < limit) {
-    if (nb > 0) {
-      if (base_file_size + used >= max_file_size) break;
-      if (nb >= max_blocks) break;
+  std::vector<Blk> blks;
+  bool stopped = false;
+  while (pos < limit && !stopped) {
+    // Window ≈ blocks remaining in THIS run's byte budget (callers pass
+    // a budget every run, not only at file ends), so speculative
+    // compression rarely overshoots the emit cut; capped to bound the
+    // transient raw/framed memory at large block sizes.
+    int64_t remaining = max_file_size - (base_file_size + used);
+    int64_t est_blocks = remaining > 0
+        ? remaining / (block_size_limit > 0 ? block_size_limit : 4096) + 2
+        : 1;
+    int64_t window = nthreads >= 2
+        ? std::min<int64_t>(est_blocks, 64 * (int64_t)nthreads)
+        : 1;
+    if (window * (block_size_limit * 2 + 8192) > (int64_t)(256u << 20))
+      window = std::max<int64_t>(
+          1, (int64_t)(256u << 20) / (block_size_limit * 2 + 8192));
+    // Phase 1: serially cut up to `window` raw blocks (speculative).
+    blks.clear();
+    try {
+      blks.reserve((size_t)window);
+    } catch (...) {
+      *out_len = used;
+      return nb > 0 ? nb : -2;
     }
-    int64_t raw_len = 0;
-    int64_t rc;
-    for (;;) {
-      rc = tpulsm_build_block(
-          key_buf, key_offs, key_lens, val_buf, val_offs, val_lens,
-          trailer_override, order, pos, limit,
-          block_size_limit, restart_interval,
-          raw.data(), (int64_t)raw.size(), &raw_len);
-      if (rc == -2) {
+    int64_t wpos = pos;
+    for (int64_t w = 0; w < window && wpos < limit; w++) {
+      Blk b;
+      int64_t cap = block_size_limit * 2 + 8192;
+      int64_t rc = -2;
+      for (;;) {
         try {
-          raw.resize(raw.size() * 2);
+          b.raw.resize((size_t)cap);
         } catch (...) {
           rc = -2;
           break;
         }
-        continue;
+        rc = tpulsm_build_block(
+            key_buf, key_offs, key_lens, val_buf, val_offs, val_lens,
+            trailer_override, order, wpos, limit,
+            block_size_limit, restart_interval,
+            b.raw.data(), cap, &b.raw_len);
+        if (rc == -2) {
+          cap *= 2;
+          continue;
+        }
+        break;
       }
-      break;
+      if (rc <= 0) {
+        if (nb == 0 && w == 0) return rc;
+        stopped = true;
+        break;
+      }
+      b.count = rc;
+      wpos += rc;
+      blks.push_back(std::move(b));
     }
-    if (rc <= 0) {
-      if (nb > 0) break;
-      return rc;
+    if (blks.empty()) break;
+
+    // Phase 2: parallel compress + frame each block into its own buffer.
+    std::atomic<int64_t> next{0};
+    std::atomic<int> fail{0};
+    auto work = [&] {
+      for (;;) {
+        int64_t i = next.fetch_add(1, std::memory_order_relaxed);
+        if (i >= (int64_t)blks.size()) return;
+        Blk& b = blks[(size_t)i];
+        size_t bound = ctype == 1 ? c.snappy_maxlen((size_t)b.raw_len)
+                                  : c.zstd_bound((size_t)b.raw_len);
+        b.bound = bound;
+        std::vector<uint8_t> cbuf;
+        try {
+          cbuf.resize(bound);
+        } catch (...) {
+          fail.store(1, std::memory_order_relaxed);
+          return;
+        }
+        bool ok = true;
+        size_t clen = bound;
+        if (ctype == 1) {
+          ok = c.snappy_cmp((const char*)b.raw.data(), (size_t)b.raw_len,
+                            (char*)cbuf.data(), &clen) == 0;
+        } else {
+          clen = c.zstd_cmp(cbuf.data(), bound, b.raw.data(),
+                            (size_t)b.raw_len, level);
+          ok = !c.zstd_err(clen);
+        }
+        const uint8_t* payload;
+        uint8_t tbyte;
+        if (ok && (int64_t)clen < b.raw_len - b.raw_len / 8) {
+          payload = cbuf.data();
+          b.payload_len = (int64_t)clen;
+          tbyte = (uint8_t)ctype;
+        } else {
+          payload = b.raw.data();
+          b.payload_len = b.raw_len;
+          tbyte = 0;
+        }
+        try {
+          b.framed.resize((size_t)b.payload_len + 5);
+        } catch (...) {
+          fail.store(1, std::memory_order_relaxed);
+          return;
+        }
+        std::memcpy(b.framed.data(), payload, (size_t)b.payload_len);
+        b.framed[(size_t)b.payload_len] = tbyte;
+        uint32_t crc = tpulsm_crc32c_extend(0, b.framed.data(),
+                                            (size_t)(b.payload_len + 1));
+        uint32_t masked = ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+        std::memcpy(b.framed.data() + b.payload_len + 1, &masked, 4);
+      }
+    };
+    {
+      std::vector<std::thread> pool;
+      size_t nt = std::min(nthreads, blks.size());
+      for (size_t t = 1; t < nt; t++) spawn_or_inline_th(pool, work);
+      work();
+      for (auto& w : pool) w.join();
     }
-    // Compress into out+used; keep only a >=12.5% win.
-    size_t bound = ctype == 1 ? c.snappy_maxlen((size_t)raw_len)
-                              : c.zstd_bound((size_t)raw_len);
-    if (used + (int64_t)bound + 5 > out_cap) {
-      // The compress scratch must fit or the store-raw/store-compressed
-      // decision would depend on buffer state (byte-nondeterminism);
-      // end the run (or ask the caller to regrow on the first block).
-      if (nb > 0) break;
-      return -2;
+    if (fail.load()) {
+      *out_len = used;
+      return nb > 0 ? nb : -2;
     }
-    int64_t payload_len;
-    uint8_t tbyte;
-    bool ok = true;
-    size_t clen = bound;
-    if (ok && ctype == 1) {
-      ok = c.snappy_cmp((const char*)raw.data(), (size_t)raw_len,
-                        (char*)(out + used), &clen) == 0;
-    } else if (ok) {
-      clen = c.zstd_cmp(out + used, bound, raw.data(), (size_t)raw_len,
-                        level);
-      ok = !c.zstd_err(clen);
-    }
-    if (ok && (int64_t)clen < raw_len - raw_len / 8) {
-      payload_len = (int64_t)clen;
-      tbyte = (uint8_t)ctype;
-    } else {
-      if (used + raw_len + 5 > out_cap) {
-        if (nb > 0) break;
+
+    // Phase 3: serial emit under the EXACT serial-path cut rules.
+    for (Blk& b : blks) {
+      if (nb > 0) {
+        if (base_file_size + used >= max_file_size) {
+          stopped = true;
+          break;
+        }
+        if (nb >= max_blocks) {
+          stopped = true;
+          break;
+        }
+      }
+      // Same bound check the serial form applied before compressing.
+      if (used + (int64_t)b.bound + 5 > out_cap) {
+        if (nb > 0) {
+          stopped = true;
+          break;
+        }
         return -2;
       }
-      std::memcpy(out + used, raw.data(), (size_t)raw_len);
-      payload_len = raw_len;
-      tbyte = 0;
+      if (b.framed[b.framed.size() - 5] == 0 &&
+          used + b.raw_len + 5 > out_cap) {
+        if (nb > 0) {
+          stopped = true;
+          break;
+        }
+        return -2;
+      }
+      std::memcpy(out + used, b.framed.data(), b.framed.size());
+      block_counts[nb] = b.count;
+      block_payload_lens[nb] = b.payload_len;
+      block_raw_lens[nb] = b.raw_len;
+      nb++;
+      used += (int64_t)b.framed.size();
+      pos += b.count;
     }
-    out[used + payload_len] = tbyte;
-    uint32_t crc =
-        tpulsm_crc32c_extend(0, out + used, (size_t)(payload_len + 1));
-    uint32_t masked = ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
-    std::memcpy(out + used + payload_len + 1, &masked, 4);
-    block_counts[nb] = rc;
-    block_payload_lens[nb] = payload_len;
-    block_raw_lens[nb] = raw_len;
-    nb++;
-    used += payload_len + 5;
-    pos += rc;
   }
   *out_len = used;
   return nb;
