@@ -189,13 +189,7 @@ int32_t tpulsm_sort_entries(const uint8_t* key_buf, const int64_t* offs,
       // spawn (cgroup pid limit, transient EAGAIN) runs the task inline on
       // this thread instead, and a failed scratch allocation degrades to a
       // serial sort over the already-sorted chunks.
-      auto spawn_or_inline = [](std::vector<std::thread>& pool, auto&& fn) {
-        try {
-          pool.emplace_back(fn);
-        } catch (...) {
-          fn();
-        }
-      };
+      auto spawn_or_inline = spawn_or_inline_th;
       std::vector<size_t> bounds(nthreads + 1);
       for (size_t t = 0; t <= nthreads; t++)
         bounds[t] = static_cast<size_t>(n) * t / nthreads;
@@ -323,13 +317,7 @@ int32_t tpulsm_merge_runs(const uint8_t* key_buf, const int64_t* offs,
   } catch (...) {
     return -1;  // no exception may cross the extern "C" boundary
   }
-  auto spawn_or_inline = [](std::vector<std::thread>& pool, auto&& fn) {
-    try {
-      pool.emplace_back(fn);
-    } catch (...) {
-      fn();
-    }
-  };
+  auto spawn_or_inline = spawn_or_inline_th;
   {
     // Parallel entry build (+ packed_out per ORIGINAL index).
     auto build = [&](int64_t lo, int64_t hi) {
@@ -426,10 +414,9 @@ int32_t tpulsm_merge_runs(const uint8_t* key_buf, const int64_t* offs,
 // ---------------------------------------------------------------------------
 
 static uint32_t kCrcTable[8][256];
-static bool kCrcInit = false;
+static std::once_flag kCrcOnce;
 
-static void crc32c_init() {
-  if (kCrcInit) return;
+static void crc32c_build_tables() {
   const uint32_t poly = 0x82f63b78u;
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t c = i;
@@ -443,7 +430,12 @@ static void crc32c_init() {
       kCrcTable[t][i] = c;
     }
   }
-  kCrcInit = true;
+}
+
+static inline void crc32c_init() {
+  // Parallel compression workers may race the first CRC use; a plain
+  // boolean guard was UB (torn table visibility) — call_once fences.
+  std::call_once(kCrcOnce, crc32c_build_tables);
 }
 
 uint32_t tpulsm_crc32c_extend(uint32_t crc, const uint8_t* data, size_t n) {
@@ -1614,6 +1606,10 @@ int64_t tpulsm_build_data_section_c(
     std::atomic<int64_t> next{0};
     std::atomic<int> fail{0};
     auto work = [&] {
+      // Per-WORKER compress scratch, grown monotonically and reused
+      // across this worker's blocks (a fresh zero-filled vector per
+      // block would memset > block_size bytes each time).
+      std::vector<uint8_t> cbuf;
       for (;;) {
         int64_t i = next.fetch_add(1, std::memory_order_relaxed);
         if (i >= (int64_t)blks.size()) return;
@@ -1621,9 +1617,8 @@ int64_t tpulsm_build_data_section_c(
         size_t bound = ctype == 1 ? c.snappy_maxlen((size_t)b.raw_len)
                                   : c.zstd_bound((size_t)b.raw_len);
         b.bound = bound;
-        std::vector<uint8_t> cbuf;
         try {
-          cbuf.resize(bound);
+          if (cbuf.size() < bound) cbuf.resize(bound);
         } catch (...) {
           fail.store(1, std::memory_order_relaxed);
           return;
