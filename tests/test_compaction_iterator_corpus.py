@@ -265,6 +265,43 @@ CASES = [
       (b"b", 8, M, u64(3)), (b"b", 4, V, u64(4))],
      (), False, UInt64AddOperator, None, (),
      [(b"a", 9, V, u64(3)), (b"b", 8, V, u64(7))]),
+    # --- D2. SingleDelete x Merge interleavings (VERDICT r03 item 8's
+    # explicitly named long-tail family) ------------------------------
+    ("sd_over_merge_chain_consumes_it",
+     # The SD shadows the merge chain below it; the SD itself travels
+     # (reads at/above it correctly see NotFound).
+     [(b"a", 9, SD, b""), (b"a", 7, M, u64(3)), (b"a", 5, V, u64(10))],
+     (), False, UInt64AddOperator, None, (),
+     [(b"a", 9, SD, b"")]),
+    ("sd_over_merge_chain_bottommost",
+     [(b"a", 9, SD, b""), (b"a", 7, M, u64(3)), (b"a", 5, V, u64(10))],
+     (), True, UInt64AddOperator, None, (),
+     [(b"a", 9, SD, b"")]),
+    ("merge_over_sd_restarts_chain",
+     # Like merge-over-DELETE: the SD terminates the operand scan, so the
+     # top merge folds with no base.
+     [(b"a", 9, M, u64(3)), (b"a", 7, SD, b""), (b"a", 5, V, u64(10))],
+     (), False, UInt64AddOperator, None, (),
+     [(b"a", 9, V, u64(3))]),
+    ("merge_over_sd_bottommost_zeroes",
+     [(b"a", 9, M, u64(3)), (b"a", 7, SD, b""), (b"a", 5, V, u64(10))],
+     (), True, UInt64AddOperator, None, (),
+     [(b"a", 0, V, u64(3))]),
+    ("sd_splits_merge_chain",
+     [(b"a", 9, M, u64(1)), (b"a", 8, SD, b""), (b"a", 7, M, u64(2)),
+      (b"a", 5, V, u64(4))],
+     (), True, UInt64AddOperator, None, (),
+     [(b"a", 0, V, u64(1))]),
+    ("delete_under_merge_bottommost",
+     [(b"a", 9, M, u64(5)), (b"a", 7, D, b""), (b"a", 5, V, u64(9))],
+     (), True, UInt64AddOperator, None, (),
+     [(b"a", 0, V, u64(5))]),
+    ("merge_chain_split_by_snapshot_bottommost",
+     # Stripe boundary: the newer operand stays an unfolded MERGE; the
+     # older finalizes and zeroes at the bottom.
+     [(b"a", 9, M, u64(1)), (b"a", 5, M, u64(2))],
+     (7,), True, UInt64AddOperator, None, (),
+     [(b"a", 9, M, u64(1)), (b"a", 0, V, u64(2))]),
 
     # --- E. range tombstones --------------------------------------------
     ("range_del_covers_older",
