@@ -391,26 +391,11 @@ def main():
     if tpu_fallback:
         from toplingdb_tpu.utils import backend_probe as bp
 
-        os.environ["JAX_PLATFORMS"] = orig_platforms or ""
-        if orig_pool_ips is not None:
-            os.environ["PALLAS_AXON_POOL_IPS"] = orig_pool_ips
-        ok, diag = bp.probe_jax_backend(probe_s)
-        diag["attempt"] = "post-input-build"
-        probe_diags.append(diag)
-        if ok:
+        if bp.retry_redirect(orig_platforms, orig_pool_ips, probe_s,
+                             "post-input-build", probe_diags):
             tpu_fallback = False
-            os.environ.pop("TPULSM_HOST_SORT", None)
-            if "jax" in sys.modules:
-                import jax
-
-                try:
-                    jax.config.update("jax_platforms", orig_platforms or "")
-                except Exception:
-                    pass
             print("jax backend came back; using accelerator",
                   file=sys.stderr, flush=True)
-        else:
-            bp.redirect_to_cpu_backend()
     detail["tpu_unreachable_cpu_fallback"] = tpu_fallback
     if probe_diags:
         detail["backend_probes"] = probe_diags
@@ -483,6 +468,37 @@ def main():
         shutil.rmtree(sbase, ignore_errors=True)
 
         db_path_rows(detail, n_db)
+
+    # LAST-CHANCE tunnel retry: the DB rows took minutes more — if the
+    # accelerator is back now, re-measure the HEADLINE on it (the input
+    # SSTs still exist; host-sort mode never initialized a jax backend,
+    # so the platform can still be flipped). Skipped under BENCH_FAST
+    # (the variants didn't run, so no meaningful time has passed).
+    if tpu_fallback and not fast:
+        from toplingdb_tpu.utils import backend_probe as bp
+
+        ok = bp.retry_redirect(
+            orig_platforms, orig_pool_ips,
+            float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")),
+            "post-db-rows", probe_diags)
+        detail["backend_probes"] = probe_diags
+        if ok:
+            print("jax backend came back late; re-measuring headline on "
+                  "the accelerator", file=sys.stderr, flush=True)
+            dt_l, stats_l, _ = time_compaction(
+                env, base, icmp, metas, topts, topts, device, runs, 8000)
+            mbps = raw_bytes / dt_l / 1e6
+            tpu_fallback = False
+            detail["tpu_unreachable_cpu_fallback"] = False
+            detail["headline_source"] = "tpu-late-probe"
+            detail["wall_s"] = round(dt_l, 3)
+            detail["phase_breakdown"] = stats_l.phase_dict()
+            phases = {k: v for k, v in detail["phase_breakdown"].items()
+                      if k != "work_time_s"}
+            detail["top_phases"] = sorted(
+                phases, key=phases.get, reverse=True)[:2]
+        else:
+            bp.redirect_to_cpu_backend()
 
     result = {
         "metric": "l2_compaction_MBps_per_chip",

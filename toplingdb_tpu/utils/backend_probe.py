@@ -130,3 +130,33 @@ def ensure_reachable_backend(timeout_s: float = 120.0,
             return True
     redirect_to_cpu_backend()
     return False
+
+
+def retry_redirect(orig_platforms, orig_pool_ips, timeout_s: float,
+                   attempt_label: str, diagnostics: list) -> bool:
+    """One mid-run tunnel retry, shared by every caller so the restore/
+    flip protocol cannot diverge: restore the accelerator env, probe with
+    evidence, and either flip an already-imported jax back to the
+    accelerator platform (safe only while no backend has been
+    initialized) or redirect to cpu again. Returns True when the
+    accelerator is reachable."""
+    import sys as _sys
+
+    os.environ["JAX_PLATFORMS"] = orig_platforms or ""
+    if orig_pool_ips is not None:
+        os.environ["PALLAS_AXON_POOL_IPS"] = orig_pool_ips
+    ok, diag = probe_jax_backend(timeout_s)
+    diag["attempt"] = attempt_label
+    diagnostics.append(diag)
+    if ok:
+        os.environ.pop("TPULSM_HOST_SORT", None)
+        if "jax" in _sys.modules:
+            import jax
+
+            try:
+                jax.config.update("jax_platforms", orig_platforms or "")
+            except Exception:
+                pass
+        return True
+    redirect_to_cpu_backend()
+    return False
