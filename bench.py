@@ -39,6 +39,39 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
 BASELINE_MBPS = 115.0  # reference manual compact: 2.8 GB raw / 24.34 s
 RAW_PER_ENTRY = 28     # 8B user key + 20B value (the baseline's accounting)
 
+# Full probe evidence (multi-KB hang stacks) goes to a SIDE FILE, never the
+# result line: r04's record was destroyed by embedding it (the driver keeps
+# only the stdout tail, so a bloated line loses its head — and its "value").
+PROBE_EVIDENCE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_PROBES.json")
+
+
+def file_probe_evidence(detail, probe_diags):
+    """Write full probe diagnostics to the side file; keep only a one-line
+    summary per attempt (≤160 chars) in the result record."""
+    if not probe_diags:
+        return
+    try:
+        with open(PROBE_EVIDENCE_PATH, "w") as f:
+            json.dump({"probes": probe_diags}, f, indent=1)
+        detail["backend_probes_file"] = os.path.basename(PROBE_EVIDENCE_PATH)
+    except OSError as e:
+        detail["backend_probes_file_error"] = str(e)[:120]
+    summaries = []
+    for p in probe_diags:
+        s = p if isinstance(p, str) else json.dumps(p)
+        summaries.append(" ".join(s.split())[:160])
+    detail["backend_probes_summary"] = summaries
+
+
+def fill_phase_detail(detail, stats):
+    """phase_breakdown + top_phases from a CompactionStats — NUMERIC values
+    only in the sort (phase_dict can carry a string overlap_note)."""
+    detail["phase_breakdown"] = stats.phase_dict()
+    phases = {k: v for k, v in detail["phase_breakdown"].items()
+              if k != "work_time_s" and isinstance(v, (int, float))}
+    detail["top_phases"] = sorted(phases, key=phases.get, reverse=True)[:2]
+
 
 def build_inputs(env, dbdir, icmp, n_entries, topts, num_runs=4, seed=1234):
     """Vectorized input builder: 8B keys / 20B values, ~2x overwrite
@@ -118,6 +151,7 @@ def time_compaction(env, base, icmp, metas, topts, out_topts, device, runs,
         return counter[0]
 
     best = None
+    run_times = []
     for _ in range(runs):
         c = Compaction(
             level=0, output_level=2, inputs=list(metas), bottommost=True,
@@ -155,11 +189,12 @@ def time_compaction(env, base, icmp, metas, topts, out_topts, device, runs,
                 creation_time=1,
             )
         dt = time.time() - t0
+        run_times.append(round(dt, 3))
         if best is None or dt < best[0]:
             best = (dt, stats)
         for m in outputs:
             env.delete_file(fn.table_file_name(base, m.number))
-    return best[0], best[1], sum(m.file_size for m in metas)
+    return best[0], best[1], sum(m.file_size for m in metas), run_times
 
 
 def db_path_rows(detail, n_db):
@@ -397,15 +432,12 @@ def main():
             print("jax backend came back; using accelerator",
                   file=sys.stderr, flush=True)
     detail["tpu_unreachable_cpu_fallback"] = tpu_fallback
-    if probe_diags:
-        detail["backend_probes"] = probe_diags
+    file_probe_evidence(detail, probe_diags)
 
-    dt, stats, input_file_bytes = time_compaction(
+    dt, stats, input_file_bytes, run_times = time_compaction(
         env, base, icmp, metas, topts, topts, device, runs, 1000)
-    detail["phase_breakdown"] = stats.phase_dict()
-    phases = {k: v for k, v in detail["phase_breakdown"].items()
-              if k != "work_time_s"}
-    detail["top_phases"] = sorted(phases, key=phases.get, reverse=True)[:2]
+    detail["headline_run_times_s"] = run_times  # all N, not just best
+    fill_phase_detail(detail, stats)
     mbps = raw_bytes / dt / 1e6
     detail["wall_s"] = round(dt, 3)
     detail["input_file_bytes"] = input_file_bytes
@@ -421,8 +453,8 @@ def main():
         sm = {}
         t_none = TableOptions(block_size=4096)
         sm["none"] = build_inputs(env, sbase, icmp, n_small, t_none)
-        dt2, _, _ = time_compaction(env, sbase, icmp, sm["none"], t_none,
-                                    t_none, device, max(1, runs - 1), 5000)
+        dt2, _, _, _ = time_compaction(env, sbase, icmp, sm["none"], t_none,
+                                       t_none, device, max(1, runs - 1), 5000)
         detail["compaction_nocomp_MBps"] = round(
             RAW_PER_ENTRY * n_small / dt2 / 1e6, 2)
         if device in ("tpu", "cpu-jax") and not tpu_fallback:
@@ -435,7 +467,7 @@ def main():
             os.environ["TPULSM_DEVICE_BLOCKS"] = "1"
             os.environ["TPULSM_DEVICE_SHARDS"] = "1"
             try:
-                dt2b, _, _ = time_compaction(
+                dt2b, _, _, _ = time_compaction(
                     env, sbase, icmp, sm["none"], t_none, t_none, device,
                     max(1, runs - 1), 5500)
                 detail["compaction_nocomp_deviceblocks_MBps"] = round(
@@ -449,8 +481,9 @@ def main():
         if codecs.available("zstd"):
             t_z = dataclasses.replace(t_none,
                                       compression=fmt.ZSTD_COMPRESSION)
-            dt3, _, _ = time_compaction(env, sbase, icmp, sm["none"], t_none,
-                                        t_z, device, max(1, runs - 1), 6000)
+            dt3, _, _, _ = time_compaction(env, sbase, icmp, sm["none"],
+                                           t_none, t_z, device,
+                                           max(1, runs - 1), 6000)
             detail["compaction_zstd_out_MBps"] = round(
                 RAW_PER_ENTRY * n_small / dt3 / 1e6, 2)
         # ZipTable emission (searchable-compression bottommost output;
@@ -460,8 +493,8 @@ def main():
                                  if os.path.isdir("/dev/shm") else None)
         zm = build_inputs(env, zbase, icmp, n_zip, t_none)
         t_zip = dataclasses.replace(t_none, format="zip")
-        dt4, _, _ = time_compaction(env, zbase, icmp, zm, t_none,
-                                    t_zip, device, 1, 7000)
+        dt4, _, _, _ = time_compaction(env, zbase, icmp, zm, t_none,
+                                       t_zip, device, 1, 7000)
         detail["compaction_zip_out_MBps"] = round(
             RAW_PER_ENTRY * n_zip / dt4 / 1e6, 2)
         shutil.rmtree(zbase, ignore_errors=True)
@@ -481,22 +514,23 @@ def main():
             orig_platforms, orig_pool_ips,
             float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")),
             "post-db-rows", probe_diags)
-        detail["backend_probes"] = probe_diags
+        file_probe_evidence(detail, probe_diags)
         if ok:
             print("jax backend came back late; re-measuring headline on "
                   "the accelerator", file=sys.stderr, flush=True)
-            dt_l, stats_l, _ = time_compaction(
+            dt_l, stats_l, _, run_times_l = time_compaction(
                 env, base, icmp, metas, topts, topts, device, runs, 8000)
             mbps = raw_bytes / dt_l / 1e6
             tpu_fallback = False
             detail["tpu_unreachable_cpu_fallback"] = False
             detail["headline_source"] = "tpu-late-probe"
+            # The non-headline rows above were measured BEFORE the tunnel
+            # came back (ADVICE r04): record their provenance explicitly
+            # instead of letting the global flag claim an all-TPU run.
+            detail["variant_rows_source"] = "cpu-fallback"
+            detail["headline_run_times_s"] = run_times_l
             detail["wall_s"] = round(dt_l, 3)
-            detail["phase_breakdown"] = stats_l.phase_dict()
-            phases = {k: v for k, v in detail["phase_breakdown"].items()
-                      if k != "work_time_s"}
-            detail["top_phases"] = sorted(
-                phases, key=phases.get, reverse=True)[:2]
+            fill_phase_detail(detail, stats_l)
         else:
             bp.redirect_to_cpu_backend()
 
@@ -507,7 +541,21 @@ def main():
         "vs_baseline": round(mbps / BASELINE_MBPS, 4),
         "detail": detail,
     }
-    print(json.dumps(result))
+    line = json.dumps(result)
+    # Self-check (VERDICT r04 item 1): the official record is ONE parseable
+    # line of bounded size. If any field bloats it past the driver's tail
+    # capture, shed detail down to the essentials rather than lose "value".
+    if len(line) > 8192:
+        slim = {k: detail[k] for k in (
+            "device", "tpu_unreachable_cpu_fallback", "n_entries",
+            "raw_kv_bytes", "wall_s", "headline_run_times_s",
+            "phase_breakdown", "compression", "headline_source",
+            "variant_rows_source") if k in detail}
+        slim["detail_truncated"] = True
+        result["detail"] = slim
+        line = json.dumps(result)
+    json.loads(line)  # hard guarantee: the printed record parses
+    print(line)
     shutil.rmtree(base, ignore_errors=True)
 
 

@@ -557,6 +557,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # Exceeds the sort-operand budget (and the 4096B native block-builder
         # key buffer); the entries path re-checks and routes to the CPU.
         raise _FallbackToEntries()
+    t_fin = time.time()
     mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
     col = _kv_seq_vtype(kv)
     _VT = dbformat.ValueType
@@ -564,14 +565,17 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         (col.vtype == int(_VT.MERGE))
         | (col.vtype == int(_VT.SINGLE_DELETION))
     ))
+    stats.finish_usec += int((time.time() - t_fin) * 1e6)
     streamed = False
     order = zero_flags = cx_flags = None
     has_complex = False
     try:
         # Range tombstones ride the fused kernels as a per-row max-covering
         # seqno side input (stripe-clamped on host; fragments are few).
+        t_cov = time.time()
         cover = (None if rd.empty() else _cover_for_parts(
             parts, rd, icmp.user_comparator, snapshots))
+        stats.host_compute_usec += int((time.time() - t_cov) * 1e6)
         if not _host_sort():
             from toplingdb_tpu.ops import block_assembly as ba
 
@@ -621,7 +625,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                     snapshots, compaction.bottommost, cover,
                     run_starts=rs,
                 )
-            stats.host_compute_usec = int((time.time() - t_hc) * 1e6)
+            stats.host_compute_usec += int((time.time() - t_hc) * 1e6)
             col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
         elif shards is not None:
             # Upload + dispatch every shard up front (device_put and
@@ -676,6 +680,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     except NotSupported:
         raise _FallbackToEntries()  # non-dense buffers, >cap snapshots etc.
 
+    t_fin = time.time()
     trailer_override = np.full(kv.n, -1, dtype=np.int64)
     seqs = col.seq.copy()
     vtypes = col.vtype
@@ -724,6 +729,10 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     tombs = surviving_tombstone_fragments(
         rd, snapshots, compaction.bottommost, icmp.user_comparator
     )
+    # finish = zero-seq patch + tombstone finalize, MINUS the separately
+    # reported complex-group resolve that ran inside this window.
+    stats.finish_usec += max(
+        0, int((time.time() - t_fin) * 1e6) - stats.resolve_usec)
     outputs = []
     t_wr = time.time()
     if order is None or len(order) or tombs:
