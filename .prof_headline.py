@@ -1,0 +1,39 @@
+"""Profile harness for the headline compaction path (host-sort fallback).
+Not part of the package; repo-root scratch tool."""
+import os
+import sys
+import tempfile
+import time
+
+os.environ["TPULSM_HOST_SORT"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import bench as B
+from toplingdb_tpu.db.dbformat import InternalKeyComparator
+from toplingdb_tpu.env import default_env
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.utils import codecs
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+runs = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+comp = sys.argv[3] if len(sys.argv) > 3 else "snappy"
+
+icmp = InternalKeyComparator()
+env = default_env()
+base = tempfile.mkdtemp(prefix="prof_", dir="/dev/shm")
+codec = fmt.SNAPPY_COMPRESSION if comp == "snappy" and codecs.available(
+    "snappy") else fmt.NO_COMPRESSION
+topts = TableOptions(block_size=4096, compression=codec)
+t0 = time.time()
+metas = B.build_inputs(env, base, icmp, n, topts)
+print(f"input_build: {time.time()-t0:.2f}s", flush=True)
+dt, stats, fbytes, rts = B.time_compaction(
+    env, base, icmp, metas, topts, topts, "tpu", runs, 1000)
+raw = 28 * n
+print(f"comp={comp} n={n} wall={dt:.3f} run_times={rts} "
+      f"MBps={raw/dt/1e6:.1f}")
+print("phases:", stats.phase_dict())
+import shutil
+shutil.rmtree(base, ignore_errors=True)

@@ -1,0 +1,92 @@
+"""Micro-profile of headline compaction components (repo-root scratch)."""
+import os
+import sys
+import tempfile
+import time
+
+os.environ["TPULSM_HOST_SORT"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import numpy as np
+
+import bench as B
+from toplingdb_tpu.db.dbformat import InternalKeyComparator
+from toplingdb_tpu.env import default_env
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.utils import codecs
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+comp = sys.argv[2] if len(sys.argv) > 2 else "snappy"
+
+icmp = InternalKeyComparator()
+env = default_env()
+base = tempfile.mkdtemp(prefix="prof_", dir="/dev/shm")
+codec = fmt.SNAPPY_COMPRESSION if comp == "snappy" and codecs.available(
+    "snappy") else fmt.NO_COMPRESSION
+topts = TableOptions(block_size=4096, compression=codec)
+metas = B.build_inputs(env, base, icmp, n, topts)
+
+from toplingdb_tpu.compaction.picker import Compaction
+from toplingdb_tpu.db.table_cache import TableCache
+from toplingdb_tpu.ops.columnar_io import ColumnarKV, scan_table_columnar
+from toplingdb_tpu.ops import compaction_kernels as ck
+
+tc = TableCache(env, base, icmp, topts)
+c = Compaction(level=0, output_level=2, inputs=list(metas), bottommost=True,
+               max_output_file_size=1 << 62)
+readers = [tc.get_reader(f.number) for _, f in c.all_inputs()]
+
+t0 = time.time()
+parts = [scan_table_columnar(r) for r in readers]
+t_scan = time.time() - t0
+t0 = time.time()
+kv = ColumnarKV.concat(parts)
+t_concat = time.time() - t0
+print(f"scan={t_scan:.3f} concat={t_concat:.3f} n={kv.n}")
+
+rs = np.cumsum([0] + [p.n for p in parts], dtype=np.int64)
+t0 = time.time()
+nat = ck.host_sort_order(kv.key_buf, kv.key_offs, kv.key_lens, run_starts=rs)
+t_merge = time.time() - t0
+s, new_key, packed = nat
+seq = packed >> np.uint64(8)
+vtype = (packed & np.uint64(0xFF)).astype(np.int32)
+t0 = time.time()
+keep, zero_seq, host_resolve, _ = ck.host_gc_mask(
+    new_key, seq[s], vtype[s], [], None, True)
+t_gc = time.time() - t0
+t0 = time.time()
+out = keep | host_resolve
+order = s[out].astype(np.int32)
+zero_flags = zero_seq[out]
+t_post = time.time() - t0
+print(f"native_merge={t_merge:.3f} gc_mask={t_gc:.3f} post={t_post:.3f} "
+      f"survivors={len(order)}")
+
+# encode/write
+from toplingdb_tpu.ops.columnar_io import write_tables_columnar
+from toplingdb_tpu.ops.device_compaction import _kv_seq_vtype
+t0 = time.time()
+col = _kv_seq_vtype(kv)
+t_tr = time.time() - t0
+trailer_override = np.full(kv.n, -1, dtype=np.int64)
+seqs = col.seq.copy()
+zero_orig = order[zero_flags]
+trailer_override[zero_orig] = col.vtype[zero_orig].astype(np.int64)
+seqs[zero_orig] = 0
+ctr = [2000]
+def alloc():
+    ctr[0] += 1
+    return ctr[0]
+t0 = time.time()
+files = write_tables_columnar(
+    env, base, alloc, icmp, topts, kv, order, trailer_override,
+    col.vtype, seqs, [], 1, max_output_file_size=1 << 62)
+t_wr = time.time() - t0
+print(f"trailers={t_tr:.3f} write={t_wr:.3f} files={len(files)}")
+total = t_scan + t_concat + t_merge + t_gc + t_post + t_tr + t_wr
+print(f"total={total:.3f} => {28*n/total/1e6:.1f} MB/s")
+import shutil
+shutil.rmtree(base, ignore_errors=True)

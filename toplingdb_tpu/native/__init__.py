@@ -183,6 +183,48 @@ def lib() -> ctypes.CDLL | None:
         except AttributeError:
             pass
         try:
+            # Whole-file index block build (separators + BlockHandle
+            # entries in C) for the columnar writer's section path.
+            l.tpulsm_build_index_block.restype = ctypes.c_int64
+            l.tpulsm_build_index_block.argtypes = [
+                u8p, i32p, i32p, i64p, i32p,
+                i64p, i64p, i64p, i64p,                 # pos/cnt/offs/plens
+                ctypes.c_int64, ctypes.c_int64,         # n_blocks, restart
+                u8p, ctypes.c_int64, i64p,              # out, cap, out_len
+            ]
+        except AttributeError:
+            pass
+        try:
+            # Fused whole-file scan (inflate + decode + absolute offsets)
+            # into caller-provided slices of a shared columnar buffer.
+            l.tpulsm_scan_blocks.restype = ctypes.c_int64
+            l.tpulsm_scan_blocks.argtypes = [
+                u8p, ctypes.c_int64,                    # file buf, len
+                i64p, i64p, ctypes.c_int64,             # block offs/lens, n
+                ctypes.c_int32,                         # verify_crc
+                u8p, ctypes.c_int64, u8p, ctypes.c_int64,  # key/val out+caps
+                i32p, i32p, i32p, i32p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,         # key_base, val_base
+            ]
+        except AttributeError:
+            pass
+        try:
+            # Fused k-way merge + MVCC GC: ONE pass over presorted runs,
+            # survivors only — replaces merge + numpy mask passes.
+            l.tpulsm_merge_gc_runs.restype = ctypes.c_int64
+            l.tpulsm_merge_gc_runs.argtypes = [
+                u8p, i64p, i64p, ctypes.c_int64,
+                i64p, ctypes.c_int32,                   # run_starts, n_runs
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_int32,  # snaps
+                ctypes.POINTER(ctypes.c_uint64),        # cover (nullable)
+                ctypes.c_int32,                         # bottommost
+                i32p, u8p, u8p,                         # order/zero/cx out
+                ctypes.POINTER(ctypes.c_uint64),        # packed_out
+                i32p,                                   # has_complex_out
+            ]
+        except AttributeError:
+            pass
+        try:
             # Ordered whole-memtable export into columnar buffers: the
             # memtable half of the columnar flush fast path.
             u64p = ctypes.POINTER(ctypes.c_uint64)

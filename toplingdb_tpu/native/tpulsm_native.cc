@@ -408,6 +408,222 @@ int32_t tpulsm_merge_runs(const uint8_t* key_buf, const int64_t* offs,
 }
 
 // ---------------------------------------------------------------------------
+// Fused k-way run merge + MVCC GC (host twin of the fused device kernel,
+// semantics of ops/compaction_kernels.host_gc_mask — the reference
+// CompactionIterator's snapshot-stripe dedup, db/compaction/
+// compaction_iterator.cc role). ONE pass: merge presorted runs in internal-
+// key order and emit only the surviving rows — no sorted scratch pass, no
+// numpy mask passes. Complex user-key groups (MERGE / SINGLE_DELETION
+// present) are emitted whole with cx=1 for the host state machine.
+//   snaps:  sorted-ascending live-snapshot seqnos (may be null when none)
+//   cover:  nullable per-ORIGINAL-row max covering range-tombstone seqno,
+//           stripe-clamped by the caller
+//   zero_out/cx_out: per SURVIVOR (parallel to the returned prefix of
+//           order_out)
+//   packed_out: per ORIGINAL row (seq<<8|type), like tpulsm_merge_runs
+// Returns the survivor count, or -1 when ineligible (keys > 8B, bad runs).
+// ---------------------------------------------------------------------------
+int64_t tpulsm_merge_gc_runs(const uint8_t* key_buf, const int64_t* offs,
+                             const int64_t* lens, int64_t n,
+                             const int64_t* run_starts, int32_t n_runs,
+                             const uint64_t* snaps, int32_t n_snaps,
+                             const uint64_t* cover, int32_t bottommost,
+                             int32_t* order_out, uint8_t* zero_out,
+                             uint8_t* cx_out, uint64_t* packed_out,
+                             int32_t* has_complex_out) {
+  if (n <= 0 || n_runs <= 0) return -1;
+  for (int64_t i = 0; i < n; i++)
+    if (lens[i] - 8 > 8) return -1;  // packed fast path only
+  using E = PackedEntry;
+  auto cmp = [](const E& a, const E& b) { return packed_entry_less(a, b); };
+  size_t nthreads = effective_cpus();
+  if (nthreads > 8) nthreads = 8;
+  if (n < (1 << 16)) nthreads = 1;
+  // Test hook: the group-aligned splitter path only engages multi-core,
+  // so parity tests force a thread count to exercise it on small boxes.
+  if (const char* ft = std::getenv("TPULSM_MERGE_THREADS")) {
+    long v = std::atol(ft);
+    if (v >= 1 && v <= 16) nthreads = (size_t)v;
+  }
+  std::vector<E> es;
+  std::vector<std::vector<int64_t>> lb;
+  std::vector<int64_t> tcount(nthreads, 0), tbase(nthreads, 0);
+  std::vector<uint8_t> tcomplex(nthreads, 0);
+  try {
+    es.resize(n);
+    lb.assign(nthreads + 1, std::vector<int64_t>(n_runs));
+  } catch (...) {
+    return -1;  // no exception may cross the extern "C" boundary
+  }
+  {
+    auto build = [&](int64_t lo, int64_t hi) {
+      for (int64_t i = lo; i < hi; i++) {
+        es[i] = packed_entry_of(key_buf, offs, lens, i);
+        if (packed_out) packed_out[i] = es[i].packed;
+      }
+    };
+    std::vector<std::thread> pool;
+    for (size_t t = 1; t < nthreads; t++)
+      spawn_or_inline_th(pool, [&, t] {
+        build(n * (int64_t)t / (int64_t)nthreads,
+              n * (int64_t)(t + 1) / (int64_t)nthreads);
+      });
+    build(0, n / (int64_t)nthreads);
+    for (auto& w : pool) w.join();
+  }
+  // Group-ALIGNED splitters: a synthetic (kw, len, seq=+inf) key compares
+  // before every real row of that user key, so lower_bound lands each
+  // boundary at a group start and no user-key group spans two threads
+  // (the per-group complex/stripe logic below needs whole groups).
+  int32_t big = 0;
+  for (int32_t r = 1; r < n_runs; r++)
+    if (run_starts[r + 1] - run_starts[r] >
+        run_starts[big + 1] - run_starts[big])
+      big = r;
+  for (int32_t r = 0; r < n_runs; r++) {
+    lb[0][r] = run_starts[r];
+    lb[nthreads][r] = run_starts[r + 1];
+  }
+  for (size_t t = 1; t < nthreads; t++) {
+    int64_t blo = run_starts[big], bhi = run_starts[big + 1];
+    E sp = es[blo + (bhi - blo) * (int64_t)t / (int64_t)nthreads];
+    sp.packed = ~0ull;
+    sp.idx = INT32_MIN;
+    for (int32_t r = 0; r < n_runs; r++) {
+      int64_t lo = run_starts[r], hi = run_starts[r + 1];
+      while (lo < hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (cmp(es[mid], sp))
+          lo = mid + 1;
+        else
+          hi = mid;
+      }
+      lb[t][r] = lo;
+    }
+  }
+  std::vector<std::vector<int64_t>> heads, ends;
+  try {
+    heads.assign(nthreads, std::vector<int64_t>(n_runs));
+    ends.assign(nthreads, std::vector<int64_t>(n_runs));
+  } catch (...) {
+    return -1;
+  }
+  constexpr uint8_t kDeletion = 0x0, kValue = 0x1, kMerge = 0x2,
+                    kSingleDel = 0x7;
+  auto stripe_of = [&](uint64_t seq) -> int32_t {
+    // count of snaps < seq (searchsorted left); n_snaps is usually 0.
+    int32_t lo = 0, hi = n_snaps;
+    while (lo < hi) {
+      int32_t mid = (lo + hi) >> 1;
+      if (snaps[mid] < seq)
+        lo = mid + 1;
+      else
+        hi = mid;
+    }
+    return lo;
+  };
+  // Per-thread merge with inline per-group GC. Survivors are written into
+  // the thread's slice region of order_out/zero_out/cx_out (slice offsets
+  // bound the survivor count from above), then compacted after the join.
+  auto merge_slice = [&](size_t t) {
+    int64_t base = 0;
+    for (int32_t r = 0; r < n_runs; r++) base += lb[t][r] - run_starts[r];
+    tbase[t] = base;
+    int64_t pos = base;
+    std::vector<int64_t>& head = heads[t];
+    std::vector<int64_t>& end = ends[t];
+    for (int32_t r = 0; r < n_runs; r++) {
+      head[r] = lb[t][r];
+      end[r] = lb[t + 1][r];
+    }
+    // Current user-key group buffer; emit decisions happen on group close.
+    uint64_t gkw = 0;
+    uint32_t glen = 0;
+    bool gcomplex = false;
+    int64_t gn = 0;             // rows buffered for this group
+    std::vector<E> grp;
+    auto flush_group = [&]() {
+      if (!gn) return;
+      if (gcomplex) {
+        tcomplex[t] = 1;
+        for (int64_t i = 0; i < gn; i++) {
+          order_out[pos] = grp[i].idx;
+          zero_out[pos] = 0;
+          cx_out[pos] = 1;
+          pos++;
+        }
+      } else {
+        int32_t ps = -1;
+        for (int64_t i = 0; i < gn; i++) {
+          const E& e = grp[i];
+          uint64_t seq = e.packed >> 8;
+          uint8_t vt = (uint8_t)(e.packed & 0xFF);
+          int32_t st = n_snaps ? stripe_of(seq) : 0;
+          bool first_in_stripe = (i == 0) || (st != ps);
+          ps = st;
+          bool covered = cover && cover[e.idx] != 0 && cover[e.idx] > seq;
+          bool keep = first_in_stripe && !covered;
+          if (bottommost && st == 0 && vt == kDeletion) keep = false;
+          if (!keep) continue;
+          bool zero = bottommost && st == 0 && vt == kValue;
+          order_out[pos] = e.idx;
+          zero_out[pos] = zero ? 1 : 0;
+          cx_out[pos] = 0;
+          pos++;
+        }
+      }
+      gn = 0;
+      grp.clear();
+    };
+    while (true) {
+      int32_t best = -1;
+      for (int32_t r = 0; r < n_runs; r++) {
+        if (head[r] >= end[r]) continue;
+        if (best < 0 || cmp(es[head[r]], es[head[best]])) best = r;
+      }
+      if (best < 0) break;
+      const E& e = es[head[best]++];
+      if (gn == 0 || e.kw != gkw || e.len != glen) {
+        flush_group();
+        gkw = e.kw;
+        glen = e.len;
+        gcomplex = false;
+      }
+      uint8_t vt = (uint8_t)(e.packed & 0xFF);
+      if (vt == kMerge || vt == kSingleDel) gcomplex = true;
+      grp.push_back(e);
+      gn++;
+    }
+    flush_group();
+    tcount[t] = pos - base;
+  };
+  {
+    std::vector<std::thread> pool;
+    for (size_t t = 1; t < nthreads; t++)
+      spawn_or_inline_th(pool, [&, t] { merge_slice(t); });
+    merge_slice(0);
+    for (auto& w : pool) w.join();
+  }
+  // Compact the per-thread survivor regions to a dense prefix.
+  int64_t n_out = tcount[0];
+  for (size_t t = 1; t < nthreads; t++) {
+    if (tbase[t] != n_out && tcount[t]) {
+      std::memmove(order_out + n_out, order_out + tbase[t],
+                   tcount[t] * sizeof(int32_t));
+      std::memmove(zero_out + n_out, zero_out + tbase[t], tcount[t]);
+      std::memmove(cx_out + n_out, cx_out + tbase[t], tcount[t]);
+    }
+    n_out += tcount[t];
+  }
+  if (has_complex_out) {
+    int32_t hc = 0;
+    for (size_t t = 0; t < nthreads; t++) hc |= tcomplex[t];
+    *has_complex_out = hc;
+  }
+  return n_out;
+}
+
+// ---------------------------------------------------------------------------
 // CRC32C (Castagnoli, polynomial 0x82f63b78 reflected), slicing-by-8.
 // Semantics match the reference util/crc32c.h: Value/Extend plus the rotated
 // mask used to store CRCs of CRC-carrying payloads.
@@ -790,6 +1006,147 @@ int64_t tpulsm_build_data_section(
   }
   *out_len = used;
   return nb;
+}
+
+static inline uint8_t* put_varint64(uint8_t* p, uint64_t v) {
+  while (v >= 128) {
+    *p++ = (uint8_t)(v | 128);
+    v >>= 7;
+  }
+  *p++ = (uint8_t)v;
+  return p;
+}
+
+// ---------------------------------------------------------------------------
+// Whole-file INDEX block build: per data block, the shortest internal-key
+// separator to the next block's first key (InternalKeyComparator::
+// FindShortestSeparator over the bytewise user comparator — reference
+// db/dbformat.cc:217-239 role, bindings in db/dbformat.py:250) + the
+// BlockHandle value, assembled with BlockBuilder prefix/restart semantics.
+// Replaces ~2 Python calls per data block (the dominant per-block cost of
+// the columnar writer at bench scale). The final entry uses the short
+// successor of the last block's last key. Returns index entries emitted,
+// -2 when out_cap is too small (caller grows), -3 oversized key.
+// ---------------------------------------------------------------------------
+int64_t tpulsm_build_index_block(
+    const uint8_t* key_buf, const int32_t* key_offs, const int32_t* key_lens,
+    const int64_t* trailer_override, const int32_t* order,
+    const int64_t* block_pos, const int64_t* block_cnt,
+    const int64_t* block_offsets, const int64_t* block_plens,
+    int64_t n_blocks, int64_t restart_interval,
+    uint8_t* out, int64_t out_cap, int64_t* out_len) {
+  if (n_blocks <= 0) return -1;
+  constexpr uint32_t kMaxKey = 4096;
+  // packed (MAX_SEQUENCE_NUMBER, ValueType::MAX) trailer, little-endian.
+  static const uint8_t kSeekTrailer[8] = {0x7F, 0xFF, 0xFF, 0xFF,
+                                          0xFF, 0xFF, 0xFF, 0xFF};
+  std::vector<uint8_t> last(kMaxKey), nextf(kMaxKey), sep(kMaxKey + 9),
+      prev_added(kMaxKey + 9);
+  std::vector<uint32_t> restarts;
+  restarts.push_back(0);
+  uint32_t prev_len = 0;
+  int64_t used = 0;
+  int64_t counter = 0;
+  auto load_key = [&](int64_t pos, uint8_t* dst, uint32_t* len) -> bool {
+    int32_t e = order[pos];
+    uint32_t kl = (uint32_t)key_lens[e];
+    if (kl > kMaxKey) return false;
+    std::memcpy(dst, key_buf + key_offs[e], kl);
+    if (trailer_override[e] >= 0 && kl >= 8) {
+      uint64_t t = (uint64_t)trailer_override[e];
+      for (int b = 0; b < 8; b++)
+        dst[kl - 8 + b] = (uint8_t)((t >> (8 * b)) & 0xff);
+    }
+    *len = kl;
+    return true;
+  };
+  for (int64_t b = 0; b < n_blocks; b++) {
+    uint32_t last_len = 0;
+    if (!load_key(block_pos[b] + block_cnt[b] - 1, last.data(), &last_len))
+      return -3;
+    uint32_t sep_len = 0;
+    if (b + 1 < n_blocks) {
+      uint32_t next_len = 0;
+      if (!load_key(block_pos[b + 1], nextf.data(), &next_len)) return -3;
+      // InternalKeyComparator::FindShortestSeparator (bytewise user cmp).
+      uint32_t su = last_len - 8, lu = next_len - 8;
+      uint32_t mn = su < lu ? su : lu;
+      uint32_t i = 0;
+      while (i < mn && last[i] == nextf[i]) i++;
+      bool shortened = false;
+      if (i < mn) {
+        uint8_t c = last[i];
+        if (c < 0xFF && (uint32_t)(c + 1) < (uint32_t)nextf[i]) {
+          // user separator = last[0..i] + (c+1); shorter than su => tag
+          // with the MAX (seq,type) trailer.
+          if (i + 1 < su) {
+            std::memcpy(sep.data(), last.data(), i);
+            sep[i] = (uint8_t)(c + 1);
+            std::memcpy(sep.data() + i + 1, kSeekTrailer, 8);
+            sep_len = i + 1 + 8;
+            shortened = true;
+          }
+        }
+      }
+      if (!shortened) {
+        std::memcpy(sep.data(), last.data(), last_len);
+        sep_len = last_len;
+      }
+    } else {
+      // find_short_successor on the user key.
+      uint32_t su = last_len - 8;
+      uint32_t i = 0;
+      while (i < su && last[i] == 0xFF) i++;
+      if (i < su && i + 1 < su) {
+        std::memcpy(sep.data(), last.data(), i);
+        sep[i] = (uint8_t)(last[i] + 1);
+        std::memcpy(sep.data() + i + 1, kSeekTrailer, 8);
+        sep_len = i + 1 + 8;
+      } else {
+        std::memcpy(sep.data(), last.data(), last_len);
+        sep_len = last_len;
+      }
+    }
+    uint8_t hval[20];
+    uint8_t* hp = put_varint64(hval, (uint64_t)block_offsets[b]);
+    hp = put_varint64(hp, (uint64_t)block_plens[b]);
+    uint32_t vlen = (uint32_t)(hp - hval);
+    // BlockBuilder::add semantics.
+    uint32_t shared = 0;
+    if (counter < restart_interval) {
+      uint32_t mx = sep_len < prev_len ? sep_len : prev_len;
+      while (shared < mx && prev_added[shared] == sep[shared]) shared++;
+    } else {
+      restarts.push_back((uint32_t)used);
+      counter = 0;
+    }
+    uint32_t non_shared = sep_len - shared;
+    int64_t need = (int64_t)varint32_len(shared) + varint32_len(non_shared) +
+                   varint32_len(vlen) + non_shared + vlen;
+    if (used + need + 4 * (int64_t)(restarts.size() + 1) + 4 > out_cap)
+      return -2;
+    uint8_t* p = out + used;
+    p = put_varint32(p, shared);
+    p = put_varint32(p, non_shared);
+    p = put_varint32(p, vlen);
+    std::memcpy(p, sep.data() + shared, non_shared);
+    p += non_shared;
+    std::memcpy(p, hval, vlen);
+    p += vlen;
+    used = p - out;
+    std::memcpy(prev_added.data(), sep.data(), sep_len);
+    prev_len = sep_len;
+    counter++;
+  }
+  for (uint32_t r : restarts) {
+    std::memcpy(out + used, &r, 4);
+    used += 4;
+  }
+  uint32_t nr = (uint32_t)restarts.size();
+  std::memcpy(out + used, &nr, 4);
+  used += 4;
+  *out_len = used;
+  return n_blocks;
 }
 
 // Bulk whole-file decode: every data block parsed in one native call.
@@ -1374,6 +1731,105 @@ int64_t tpulsm_inflate_blocks(const uint8_t* file_buf, int64_t file_len,
   if (e == 1) return -1;
   if (e) return -3;
   return used;
+}
+
+// ---------------------------------------------------------------------------
+// Fused whole-file scan: inflate (if compressed) + decode EVERY data block
+// in ONE call, writing straight into caller-provided slices of a shared
+// columnar buffer (offsets emitted ABSOLUTE via key_base/val_base) — no
+// synthetic uncompressed image, no Python-side copies, no concat. The
+// per-block scratch is reused, so peak extra memory is one block.
+// Returns total entries, or: -1 codec unavailable / exotic type (caller
+// falls back), -2/-3 key/val capacity, -4 max_entries, -6 crc mismatch,
+// -7 offsets exceed the int32 columnar budget, -8 corrupt.
+// ---------------------------------------------------------------------------
+int64_t tpulsm_scan_blocks(
+    const uint8_t* file_buf, int64_t file_len,
+    const int64_t* block_offs, const int64_t* block_lens, int64_t n_blocks,
+    int32_t verify_crc,
+    uint8_t* key_out, int64_t key_cap,
+    uint8_t* val_out, int64_t val_cap,
+    int32_t* key_offs, int32_t* key_lens,
+    int32_t* val_offs, int32_t* val_lens, int64_t max_entries,
+    int64_t key_base, int64_t val_base) {
+  const Codecs& c = codecs();
+  std::vector<uint8_t> scratch;
+  int64_t total = 0, key_used = 0, val_used = 0;
+  for (int64_t b = 0; b < n_blocks; b++) {
+    int64_t off = block_offs[b];
+    int64_t len = block_lens[b];
+    if (off < 0 || off + len + 5 > file_len) return -8;
+    uint8_t t = file_buf[off + len];
+    if (verify_crc) {
+      uint32_t stored;
+      std::memcpy(&stored, file_buf + off + len + 1, 4);
+      uint32_t rot = stored - 0xa282ead8u;
+      uint32_t crc = (rot >> 17) | (rot << 15);
+      uint32_t actual =
+          tpulsm_crc32c_extend(0, file_buf + off, (size_t)(len + 1));
+      if (crc != actual) return -6;
+    }
+    const uint8_t* payload = file_buf + off;
+    int64_t plen = len;
+    if (t == 1) {
+      if (!c.snappy_len || !c.snappy_unc) return -1;
+      size_t ulen = 0;
+      if (c.snappy_len((const char*)payload, (size_t)len, &ulen) != 0)
+        return -8;
+      try {
+        if (scratch.size() < ulen) scratch.resize(ulen);
+      } catch (...) {
+        return -8;
+      }
+      size_t got = ulen;
+      if (c.snappy_unc((const char*)payload, (size_t)len, (char*)scratch.data(),
+                       &got) != 0 ||
+          got != ulen)
+        return -8;
+      payload = scratch.data();
+      plen = (int64_t)ulen;
+    } else if (t == 7) {
+      if (!c.zstd_size || !c.zstd_dec || !c.zstd_err) return -1;
+      unsigned long long s =
+          (unsigned long long)c.zstd_size(payload, (size_t)len);
+      if (s == (unsigned long long)-1 || s == (unsigned long long)-2)
+        return -1;  // unknown size / dict frame: Python path has the dict
+      if (s > (1ull << 31)) return -8;
+      try {
+        if (scratch.size() < (size_t)s) scratch.resize((size_t)s);
+      } catch (...) {
+        return -8;
+      }
+      size_t got = c.zstd_dec(scratch.data(), (size_t)s, payload, (size_t)len);
+      if (c.zstd_err(got) || got != (size_t)s) return -8;
+      payload = scratch.data();
+      plen = (int64_t)s;
+    } else if (t != 0) {
+      return -1;  // lz4/zlib/bzip2: Python fallback
+    }
+    int64_t rc = tpulsm_decode_block(
+        payload, plen, key_out + key_used, key_cap - key_used,
+        val_out + val_used, val_cap - val_used, key_offs + total,
+        key_lens + total, val_offs + total, val_lens + total,
+        max_entries - total);
+    if (rc < 0) return rc;
+    if (key_base + key_used > 0x7FFFFF00LL ||
+        val_base + val_used > 0x7FFFFF00LL)
+      return -7;
+    int64_t kshift = key_base + key_used, vshift = val_base + val_used;
+    for (int64_t i = 0; i < rc; i++) {
+      key_offs[total + i] += (int32_t)kshift;
+      val_offs[total + i] += (int32_t)vshift;
+    }
+    if (rc > 0) {
+      key_used = key_offs[total + rc - 1] + key_lens[total + rc - 1] -
+                 key_base;
+      val_used = val_offs[total + rc - 1] + val_lens[total + rc - 1] -
+                 val_base;
+    }
+    total += rc;
+  }
+  return total;
 }
 
 // ---------------------------------------------------------------------------

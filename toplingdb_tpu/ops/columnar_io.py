@@ -85,6 +85,141 @@ class ColumnarKV:
         )
 
 
+def _file_scan_prologue(reader):
+    """Shared per-file scan setup: the whole raw file image plus the data
+    block handles as arrays and objects — (raw, block_offs, block_lens,
+    handles), or (None, None, None, []) for an empty file."""
+    idx = reader.new_index_iterator()  # flat or partitioned
+    idx.seek_to_first()
+    handles = [
+        fmt.BlockHandle.decode_exact(enc) for _, enc in idx.entries()
+    ]
+    if not handles:
+        return None, None, None, []
+    raw = reader._f.read(0, reader._f.size())
+    block_offs = np.array([h.offset for h in handles], dtype=np.int64)
+    block_lens = np.array([h.size for h in handles], dtype=np.int64)
+    return raw, block_offs, block_lens, handles
+
+
+def scan_tables_columnar_prealloc(readers):
+    """Scan EVERY input file into ONE preallocated pair of columnar
+    buffers, sized exactly from each file's TableProperties
+    (raw_key_size/raw_value_size/num_entries) — the fused native call
+    inflates + decodes per block with absolute offsets, so there is no
+    synthetic image, no per-file Python copies, and NO ColumnarKV.concat
+    (the r04 known debt: ~0.3-0.5s of pure copy at 10M entries).
+
+    Returns (kv, parts) where kv spans all files and parts[i] is a
+    ZERO-COPY per-file view (buffer slices + rebased offsets) with the
+    layout the shard/cover helpers expect — or None when ineligible
+    (native/symbol missing, props absent or wrong, exotic codec, >int32
+    buffers); the caller then uses the per-file scan + concat path."""
+    lib = native.lib()
+    if lib is None or not hasattr(lib, "tpulsm_scan_blocks"):
+        return None
+    infos = []
+    tk = tv = tn = 0
+    for r in readers:
+        if not hasattr(r, "new_index_iterator"):
+            return None
+        if getattr(r, "_compression_dict", b""):
+            # Dict-compressed frames need the stored dictionary; the
+            # native scan decodes without one (would mis-report healthy
+            # files as corrupt) — the per-file path carries the dict.
+            return None
+        p = getattr(r, "properties", None)
+        if p is None:
+            return None
+        ne, rk, rv = int(p.num_entries), int(p.raw_key_size), int(
+            p.raw_value_size)
+        if ne < 0 or rk < 0 or rv < 0 or (ne > 0 and rk == 0):
+            return None
+        infos.append((ne, rk, rv))
+        tk += rk
+        tv += rv
+        tn += ne
+    if tk > 0x7FFFFF00 or tv > 0x7FFFFF00:
+        return None
+    key_buf = np.empty(tk, dtype=np.uint8)
+    val_buf = np.empty(tv, dtype=np.uint8)
+    key_offs = np.empty(tn, dtype=np.int32)
+    key_lens = np.empty(tn, dtype=np.int32)
+    val_offs = np.empty(tn, dtype=np.int32)
+    val_lens = np.empty(tn, dtype=np.int32)
+
+    bases = []
+    kb = vb = nb = 0
+    for ne, rk, rv in infos:
+        bases.append((nb, kb, vb))
+        nb += ne
+        kb += rk
+        vb += rv
+
+    import ctypes as _ct
+
+    def scan_one(i):
+        r = readers[i]
+        ne, rk, rv = infos[i]
+        if ne == 0:
+            return 0
+        n_base, k_base, v_base = bases[i]
+        raw, b_offs, b_lens, _handles = _file_scan_prologue(r)
+        if raw is None:
+            return -100
+        rawb = np.frombuffer(raw, dtype=np.uint8) \
+            if not isinstance(raw, np.ndarray) else raw
+        rc = lib.tpulsm_scan_blocks(
+            native.np_u8p(rawb), len(rawb),
+            native.np_i64p(b_offs), native.np_i64p(b_lens), len(b_offs),
+            1 if r.opts.verify_checksums else 0,
+            _ct.cast(key_buf.ctypes.data + k_base,
+                     _ct.POINTER(_ct.c_uint8)), rk,
+            _ct.cast(val_buf.ctypes.data + v_base,
+                     _ct.POINTER(_ct.c_uint8)), rv,
+            _ct.cast(key_offs.ctypes.data + 4 * n_base,
+                     _ct.POINTER(_ct.c_int32)),
+            _ct.cast(key_lens.ctypes.data + 4 * n_base,
+                     _ct.POINTER(_ct.c_int32)),
+            _ct.cast(val_offs.ctypes.data + 4 * n_base,
+                     _ct.POINTER(_ct.c_int32)),
+            _ct.cast(val_lens.ctypes.data + 4 * n_base,
+                     _ct.POINTER(_ct.c_int32)),
+            ne, k_base, v_base,
+        )
+        return rc
+
+    if len(readers) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(min(8, len(readers))) as ex:
+            rcs = list(ex.map(scan_one, range(len(readers))))
+    else:
+        rcs = [scan_one(0)]
+    for i, rc in enumerate(rcs):
+        if rc == -6:
+            raise Corruption("block checksum mismatch (fused scan)")
+        if rc == -8:
+            raise Corruption("block decode/decompress failed (fused scan)")
+        if rc != infos[i][0]:
+            # Capacity/entry-count disagreement with the properties, codec
+            # fallback, or a dict frame: use the compatible path.
+            return None
+    kv = ColumnarKV(key_buf, key_offs, key_lens, val_buf, val_offs, val_lens)
+    parts = []
+    for i, (ne, rk, rv) in enumerate(infos):
+        n_base, k_base, v_base = bases[i]
+        parts.append(ColumnarKV(
+            key_buf[k_base:k_base + rk],
+            key_offs[n_base:n_base + ne] - np.int32(k_base),
+            key_lens[n_base:n_base + ne],
+            val_buf[v_base:v_base + rv],
+            val_offs[n_base:n_base + ne] - np.int32(v_base),
+            val_lens[n_base:n_base + ne],
+        ))
+    return kv, parts
+
+
 def scan_table_columnar(reader) -> ColumnarKV:
     """Whole-file bulk scan through the native block decoder. Uncompressed
     files decode in ONE native call over the raw file bytes; compressed files
@@ -94,22 +229,14 @@ def scan_table_columnar(reader) -> ColumnarKV:
         raise NotSupported("native library unavailable")
     if not hasattr(reader, "new_index_iterator"):
         raise NotSupported("bulk columnar scan requires the block format")
-    idx = reader.new_index_iterator()  # flat or partitioned
-    idx.seek_to_first()
-    handles = [
-        fmt.BlockHandle.decode_exact(enc) for _, enc in idx.entries()
-    ]
-    if not handles:
+    raw, block_offs, block_lens, handles = _file_scan_prologue(reader)
+    if raw is None:
         return ColumnarKV(
             np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
             np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
         )
 
-    # Bulk path: whole file in one read, all blocks in one native call.
-    file_size = reader._f.size()
-    raw = reader._f.read(0, file_size)
-    block_offs = np.array([h.offset for h in handles], dtype=np.int64)
-    block_lens = np.array([h.size for h in handles], dtype=np.int64)
+    # Bulk path: all blocks in one native call over the raw image.
     kv = _bulk_decode(lib, raw, block_offs, block_lens,
                       reader.opts.verify_checksums)
     if kv is not None:
@@ -353,6 +480,65 @@ class _ColumnarSST:
             h = fmt.write_compressed_block(self.w, payload, out_type)
             self._account_block(h, raw_len, first, last, n)
 
+    def add_framed_section_arrays(self, section, counts, plens, rawlens,
+                                  nb: int, start_pos: int,
+                                  entry_key_fn) -> None:
+        """Bulk form of add_framed_section that DEFERS index building to
+        one native call at finish: per-block metadata is kept as numpy
+        arrays (no per-block Python at all); only the file's first/last
+        keys are materialized here (two entry_key calls per section)."""
+        base = self.w.file_size()
+        if self.first_key is None:
+            self.first_key = entry_key_fn(start_pos)
+        cnts = counts[:nb].astype(np.int64, copy=True)
+        pls = plens[:nb].astype(np.int64, copy=True)
+        if not hasattr(self, "_nat_sections"):
+            self._nat_sections = []
+        self._nat_sections.append((start_pos, cnts, pls, base))
+        self.props.data_size += int(rawlens[:nb].sum())
+        self.props.num_data_blocks += nb
+        total = int(cnts.sum())
+        self.num_entries += total
+        self.last_key = entry_key_fn(start_pos + total - 1)
+        self.w.append(section)
+
+    def _native_index_raw(self, lib, kv, order, trailer_override) -> bytes:
+        """Build this file's whole index block in one native call from the
+        deferred section metadata (tpulsm_build_index_block)."""
+        pos_parts, cnt_parts, off_parts, plen_parts = [], [], [], []
+        for start_pos, cnts, pls, base in self._nat_sections:
+            cum = np.concatenate(([0], np.cumsum(cnts)[:-1]))
+            pos_parts.append(start_pos + cum)
+            cnt_parts.append(cnts)
+            offcum = np.concatenate(
+                ([0], np.cumsum(pls + fmt.BLOCK_TRAILER_SIZE)[:-1]))
+            off_parts.append(base + offcum)
+            plen_parts.append(pls)
+        bpos = np.ascontiguousarray(np.concatenate(pos_parts))
+        bcnt = np.ascontiguousarray(np.concatenate(cnt_parts))
+        boff = np.ascontiguousarray(np.concatenate(off_parts))
+        bpl = np.ascontiguousarray(np.concatenate(plen_parts))
+        nb = len(bpos)
+        cap = 64 * nb + 8192
+        out_len = np.zeros(1, dtype=np.int64)
+        while True:
+            out = np.empty(cap, dtype=np.uint8)
+            rc = lib.tpulsm_build_index_block(
+                native.np_u8p(kv.key_buf), native.np_i32p(kv.key_offs),
+                native.np_i32p(kv.key_lens), native.np_i64p(trailer_override),
+                native.np_i32p(order),
+                native.np_i64p(bpos), native.np_i64p(bcnt),
+                native.np_i64p(boff), native.np_i64p(bpl),
+                nb, self._options.index_restart_interval,
+                native.np_u8p(out), cap, native.np_i64p(out_len),
+            )
+            if rc == -2:
+                cap *= 4
+                continue
+            if rc != nb:
+                raise NotSupported(f"native index build failed rc={rc}")
+            return out[: int(out_len[0])].tobytes()
+
     def add_framed_section(self, section: bytes, blocks) -> None:
         """Bulk form of add_block: `section` is a pre-framed run of blocks
         (payload + type byte + crc trailer, exactly what write_block emits;
@@ -384,6 +570,11 @@ class _ColumnarSST:
         options = self._options
         props = self.props
         n = len(sel)
+        nat_sections = getattr(self, "_nat_sections", None)
+        if nat_sections and self.pending_last_key is not None:
+            # Per-block and deferred-index entries would interleave out of
+            # order; this cannot happen on the section path — refuse.
+            raise NotSupported("mixed index modes in one output file")
         if self.pending_last_key is not None:
             succ = icmp.find_short_successor(self.pending_last_key)
             self.index_block.add(succ, self.pending_handle.encode())
@@ -461,7 +652,11 @@ class _ColumnarSST:
             dh = fmt.write_block(self.w, self._dict, fmt.NO_COMPRESSION)
             meta_entries.append((METAINDEX_COMPRESSION_DICT, dh))
 
-        iraw = self.index_block.finish()
+        if nat_sections:
+            iraw = self._native_index_raw(lib, kv, self._idx_order,
+                                          self._idx_trailer)
+        else:
+            iraw = self.index_block.finish()
         props.index_size = len(iraw)
         pblock = props.encode_block()
         ph = fmt.write_block(self.w, pblock, fmt.NO_COMPRESSION)
@@ -604,6 +799,8 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
         sec_level = (copts0.level if copts0 is not None
                      and copts0.level is not None else -(2 ** 31))
 
+    use_nat_index = use_section and hasattr(lib, "tpulsm_build_index_block")
+
     pool = None
     if (options.compression != fmt.NO_COMPRESSION
             and getattr(options, "compression_parallel_threads", 1) > 1):
@@ -716,15 +913,25 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
                     if nb == 0:
                         continue
                 section = sec_buf[:sec_total].tobytes()
-                blocks = []
-                bpos = start
-                for b in range(nb):
-                    cnt = int(sec_counts[b])
-                    blocks.append((int(sec_plens[b]), int(sec_rawlens[b]),
-                                   entry_key(bpos),
-                                   entry_key(bpos + cnt - 1), cnt))
-                    bpos += cnt
-                cur.add_framed_section(section, blocks)
+                if use_nat_index:
+                    # Index entries defer to ONE native call at finish —
+                    # zero per-block Python on the section path.
+                    cur._idx_order = order
+                    cur._idx_trailer = trailer_override
+                    cur.add_framed_section_arrays(
+                        section, sec_counts, sec_plens, sec_rawlens, nb,
+                        start, entry_key)
+                else:
+                    blocks = []
+                    bpos = start
+                    for b in range(nb):
+                        cnt = int(sec_counts[b])
+                        blocks.append((int(sec_plens[b]),
+                                       int(sec_rawlens[b]),
+                                       entry_key(bpos),
+                                       entry_key(bpos + cnt - 1), cnt))
+                        bpos += cnt
+                    cur.add_framed_section(section, blocks)
                 start = pos
                 continue
             rc = lib.tpulsm_build_block(

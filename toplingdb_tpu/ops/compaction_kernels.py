@@ -478,6 +478,54 @@ def host_sort_order(key_buf: np.ndarray, key_offs: np.ndarray,
     return order, new_key.astype(bool), packed
 
 
+def host_merge_gc(key_buf, key_offs, key_lens, snapshots, bottommost,
+                  cover, run_starts):
+    """ONE native pass: k-way merge of presorted runs + inline GC mask —
+    returns the host_fused_full 6-tuple, or None when the native fused
+    routine is unavailable/ineligible (then the two-pass path runs)."""
+    import ctypes
+
+    from toplingdb_tpu import native
+
+    lib = native.lib()
+    if (lib is None or not hasattr(lib, "tpulsm_merge_gc_runs")
+            or os.environ.get("TPULSM_HOST_MERGE", "1") == "0"):
+        return None
+    if run_starts is None or len(run_starts) < 2:
+        return None
+    n = len(key_offs)
+    rs = np.ascontiguousarray(run_starts, dtype=np.int64)
+    if int(rs[0]) != 0 or int(rs[-1]) != n or not np.all(np.diff(rs) >= 0):
+        return None
+    offs = np.ascontiguousarray(key_offs, dtype=np.int64)
+    lens = np.ascontiguousarray(key_lens, dtype=np.int64)
+    kb = np.ascontiguousarray(key_buf)
+    order = np.empty(n, dtype=np.int32)
+    zero = np.empty(n, dtype=np.uint8)
+    cx = np.empty(n, dtype=np.uint8)
+    packed = np.empty(n, dtype=np.uint64)
+    hc = np.zeros(1, dtype=np.int32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    snaps = np.asarray(sorted(snapshots), dtype=np.uint64)
+    cov = (np.ascontiguousarray(cover, dtype=np.uint64)
+           if cover is not None else None)
+    n_out = lib.tpulsm_merge_gc_runs(
+        native.np_u8p(kb), native.np_i64p(offs), native.np_i64p(lens), n,
+        native.np_i64p(rs), len(rs) - 1,
+        snaps.ctypes.data_as(u64p) if len(snaps) else None, len(snaps),
+        cov.ctypes.data_as(u64p) if cov is not None else None,
+        1 if bottommost else 0,
+        native.np_i32p(order), native.np_u8p(zero), native.np_u8p(cx),
+        packed.ctypes.data_as(u64p), native.np_i32p(hc),
+    )
+    if n_out < 0:
+        return None
+    seq = packed >> np.uint64(8)
+    vtype = (packed & np.uint64(0xFF)).astype(np.int32)
+    return (order[:n_out], zero[:n_out].astype(bool),
+            cx[:n_out].astype(bool), bool(hc[0]), seq, vtype)
+
+
 def host_gc_mask(new_key, sseq, svt, snapshots, cover, bottommost):
     """NumPy twin of the GC mask over SORTED columns; `new_key` marks
     user-key group starts, `cover` is the per-sorted-entry stripe-clamped
@@ -545,6 +593,10 @@ def host_fused_full(key_buf: np.ndarray, key_offs: np.ndarray,
         e = np.empty(0, np.uint64)
         return (np.empty(0, np.int32), np.empty(0, bool),
                 np.empty(0, bool), False, e, e.astype(np.int32))
+    fused = host_merge_gc(key_buf, key_offs, key_lens, snapshots,
+                          bottommost, cover, run_starts)
+    if fused is not None:
+        return fused
     s, new_key, seq, vtype = host_sort_with_boundaries(
         key_buf, key_offs, key_lens, max_key_bytes, run_starts=run_starts
     )

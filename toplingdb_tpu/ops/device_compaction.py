@@ -299,16 +299,25 @@ def _collect_raw_columnar(compaction, table_cache, icmp, want_uploads=False):
     chunk covers, in chunk order."""
     from concurrent.futures import ThreadPoolExecutor
 
-    from toplingdb_tpu.ops.columnar_io import ColumnarKV, scan_table_columnar
+    from toplingdb_tpu.ops.columnar_io import (
+        ColumnarKV,
+        scan_table_columnar,
+        scan_tables_columnar_prealloc,
+    )
 
     readers = [
         table_cache.get_reader(f.number) for _, f in compaction.all_inputs()
     ]
-    if len(readers) > 1:
-        with ThreadPoolExecutor(min(8, len(readers))) as ex:
-            parts = list(ex.map(scan_table_columnar, readers))
+    pre = scan_tables_columnar_prealloc(readers)
+    if pre is not None:
+        kv, parts = pre
     else:
-        parts = [scan_table_columnar(r) for r in readers]
+        if len(readers) > 1:
+            with ThreadPoolExecutor(min(8, len(readers))) as ex:
+                parts = list(ex.map(scan_table_columnar, readers))
+        else:
+            parts = [scan_table_columnar(r) for r in readers]
+        kv = ColumnarKV.concat(parts)
     rd = RangeDelAggregator(icmp.user_comparator)
     for r in readers:
         for b, e in r.range_del_entries():
@@ -317,7 +326,7 @@ def _collect_raw_columnar(compaction, table_cache, icmp, want_uploads=False):
     shards = None
     if want_uploads:
         shards = _prepare_uniform_shards(parts)
-    return ColumnarKV.concat(parts), rd, shards, parts
+    return kv, rd, shards, parts
 
 
 def _part_lower_bound(part, key: bytes, lo: int = 0) -> int:
@@ -559,12 +568,16 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         raise _FallbackToEntries()
     t_fin = time.time()
     mkb = max(4, int(kv.key_lens.max()) - 8) if kv.n else 4
-    col = _kv_seq_vtype(kv)
-    _VT = dbformat.ValueType
-    any_complex = bool(kv.n) and bool(np.any(
-        (col.vtype == int(_VT.MERGE))
-        | (col.vtype == int(_VT.SINGLE_DELETION))
-    ))
+    col = any_complex = None
+    if not _host_sort():
+        # Host-sort mode gets seq/vtype from the fused native merge+GC —
+        # gathering trailers here would be pure waste at bench scale.
+        col = _kv_seq_vtype(kv)
+        _VT = dbformat.ValueType
+        any_complex = bool(kv.n) and bool(np.any(
+            (col.vtype == int(_VT.MERGE))
+            | (col.vtype == int(_VT.SINGLE_DELETION))
+        ))
     stats.finish_usec += int((time.time() - t_fin) * 1e6)
     streamed = False
     order = zero_flags = cx_flags = None
