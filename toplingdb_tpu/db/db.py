@@ -29,6 +29,7 @@ from toplingdb_tpu.db.version_set import VersionSet
 from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.env import Env, default_env
 from toplingdb_tpu.options import FlushOptions, Options, ReadOptions, WriteOptions
+from toplingdb_tpu.utils import statistics as _st
 from toplingdb_tpu.table.merging_iterator import MergingIterator
 from toplingdb_tpu.utils.status import (
     Busy, Corruption, InvalidArgument, IOError_, NotFound,
@@ -140,7 +141,7 @@ class _NGetState:
     by the caller to detect memtable switches / version installs)."""
 
     __slots__ = ("mem", "imm", "version", "ctx", "fn", "out",
-                 "val_ptr", "val_cap", "_lib")
+                 "val_ptr", "val_cap", "_lib", "mg", "mg_arena")
 
     def __del__(self):
         lib = getattr(self, "_lib", None)
@@ -1277,21 +1278,28 @@ class DB:
                 or self._excluded_for(opts)):
             return lib, None
         mem = cfd.mem
-        imm = cfd.imm
-        if mem._range_dels or any(m._range_dels for m in imm):
+        if mem._range_dels:
+            # The ACTIVE memtable mutates under a cached state — this
+            # check must run per call; immutables are frozen and are
+            # vetted once at state-build time below.
             return lib, None
+        imm = cfd.imm
         version = self.versions.cf_current(cfd.handle.id)
         tl = self._nget_tl
-        states = getattr(tl, "states", None)
-        if states is None:
+        try:
+            states = tl.states
+        except AttributeError:
             states = tl.states = {}
         cc = states.get(cfd.handle.id)
-        if cc is None or cc.mem is not mem or cc.version is not version \
-                or cc.imm != imm:
-            cc = _NGetState.build(lib, mem, imm, version, self.table_cache)
-            if cc is None:
-                return lib, None
-            states[cfd.handle.id] = cc
+        if cc is not None and cc.mem is mem and cc.version is version \
+                and cc.imm == imm:
+            return lib, cc
+        if any(m._range_dels for m in imm):
+            return lib, None
+        cc = _NGetState.build(lib, mem, imm, version, self.table_cache)
+        if cc is None:
+            return lib, None
+        states[cfd.handle.id] = cc
         return lib, cc
 
     def _native_get(self, cfd, key: bytes, snap_seq: int, opts):
@@ -1302,15 +1310,30 @@ class DB:
         walk hit something only the Python state machine handles). The
         hot call carries 4 args against a persistent native context; the
         value and counters are read from ctx-owned memory mapped once."""
-        lib, cc = self._nget_state(cfd, opts)
+        # Inlined steady-state check (one cached-state hit per Get is the
+        # common case; _nget_state handles every slow/ineligible path).
+        mem = cfd.mem
+        cc = None
+        if (opts is _DEFAULT_READ and not mem._range_dels
+                and self._undecided_provider is None):
+            states = getattr(self._nget_tl, "states", None)
+            if states is not None:
+                cc = states.get(cfd.handle.id)
+                if cc is not None and (
+                        cc.mem is not mem
+                        or cc.version is not self.versions.cf_current(
+                            cfd.handle.id)
+                        or cc.imm != cfd.imm):
+                    cc = None
         if cc is None:
-            return False, None, None
+            lib, cc = self._nget_state(cfd, opts)
+            if cc is None:
+                return False, None, None
         rc = cc.fn(cc.ctx, key, len(key), snap_seq)
         if rc == 2 or rc < 0:
             return False, None, None
         out = cc.out
-        from toplingdb_tpu.utils import statistics as st
-
+        st = _st
         if st.perf_level:
             pctx = st.perf_context()
             pctx.get_from_memtable_count += out[2]
@@ -1413,11 +1436,12 @@ class DB:
         return v
 
     def _get_impl_entry(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
-                        cf=None) -> bytes | None:
+                        cf=None, record_trace: bool = True) -> bytes | None:
         self._check_open()
-        tr = self._op_tracer
-        if tr is not None:
-            tr.record_get(key)
+        if record_trace:
+            tr = self._op_tracer
+            if tr is not None:
+                tr.record_get(key)
         if self.icmp.user_comparator.timestamp_size:
             return self._get_with_ts(key, opts, cf)
         self._check_read_ts(opts)
@@ -1460,27 +1484,10 @@ class DB:
 
     def _record_get_stats(self, t0: float, val, src) -> None:
         """Read-path ticker family (reference MEMTABLE_HIT/GET_HIT_L*,
-        statistics.h)."""
-        from toplingdb_tpu.utils import statistics as st
-
-        s = self.stats
-        s.record_in_histogram(st.DB_GET_MICROS,
-                              (time.perf_counter() - t0) * 1e6)
-        ticks = [(st.NUMBER_KEYS_READ, 1)]
-        if val is not None:
-            ticks.append((st.BYTES_READ, len(val)))
-            s.record_in_histogram(st.BYTES_PER_READ, len(val))
-        if src == "mem":
-            ticks.append((st.MEMTABLE_HIT, 1))
-        else:
-            ticks.append((st.MEMTABLE_MISS, 1))
-            if src == 0:
-                ticks.append((st.GET_HIT_L0, 1))
-            elif src == 1:
-                ticks.append((st.GET_HIT_L1, 1))
-            elif src is not None:
-                ticks.append((st.GET_HIT_L2_AND_UP, 1))
-        s.record_ticks(ticks)
+        statistics.h) — one lock acquisition via Statistics.record_get."""
+        self.stats.record_get(
+            (time.perf_counter() - t0) * 1e6,
+            len(val) if val is not None else None, src)
 
     def _walk_sst_chain(self, version, key: bytes, snap_seq: int, ctx,
                         tombs_for=None):
@@ -1686,31 +1693,37 @@ class DB:
         key_offs = np.zeros(n, np.int64)
         np.cumsum(key_lens[:-1], out=key_offs[1:])
         keybuf = np.frombuffer(b"".join(keys), np.uint8)
-        status = np.zeros(n, np.int8)
-        voffs = np.zeros(n, np.int64)
-        vlens = np.zeros(n, np.int64)
         from toplingdb_tpu import native as _nat
 
-        arena_cap = 1 << 20
+        # Per-batch scratch is PERSISTENT on the thread-local get state —
+        # a fresh 1MiB arena per 128-key batch dominated the multiget
+        # wall at bench scale.
+        mg = getattr(cc, "mg", None)
+        if mg is None or len(mg[0]) < n:
+            cap = max(n, 256)
+            mg = cc.mg = (np.zeros(cap, np.int8), np.zeros(cap, np.int64),
+                          np.zeros(cap, np.int64))
+        status, voffs, vlens = mg
+        arena = getattr(cc, "mg_arena", None)
+        if arena is None:
+            arena = cc.mg_arena = np.empty(1 << 20, np.uint8)
         ctr = (ctypes.c_int64 * 6)()
         used = (ctypes.c_int64 * 1)()
         while True:
-            arena = np.empty(arena_cap, np.uint8)
             rc = lib.tpulsm_getctx_multiget(
                 cc.ctx, _nat.np_u8p(keybuf), _nat.np_i64p(key_offs),
                 _nat.np_i32p(key_lens), n, snap_seq,
                 status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
                 _nat.np_i64p(voffs), _nat.np_i64p(vlens),
-                _nat.np_u8p(arena), arena_cap, used, ctr,
+                _nat.np_u8p(arena), len(arena), used, ctr,
             )
             if rc == -2:
-                arena_cap *= 4
+                arena = cc.mg_arena = np.empty(len(arena) * 4, np.uint8)
                 continue
             if rc != 0:
                 return False, None
             break
-        from toplingdb_tpu.utils import statistics as st
-
+        st = _st
         if st.perf_level:
             pctx = st.perf_context()
             pctx.get_from_memtable_count += ctr[0]
@@ -1725,9 +1738,9 @@ class DB:
                               (st.BLOCK_CACHE_MISS, ctr[4])):
                 if cnt:
                     self.stats.record_tick(tick, cnt)
-        mv = arena[: used[0]].tobytes()
+        mv = memoryview(arena)
         pinned_opts = opts
-        if opts.snapshot is None and 2 in status:
+        if opts.snapshot is None and 2 in status[:n]:
             import dataclasses as _dcs
 
             pinned_opts = _dcs.replace(opts, snapshot=_SeqSnapshot(snap_seq))
@@ -1736,13 +1749,17 @@ class DB:
             s = status[i]
             if s == 1:
                 o = voffs[i]
-                out[i] = mv[o: o + vlens[i]]
+                out[i] = bytes(mv[o: o + vlens[i]])
             elif s == 2:
                 # Undecidable natively: full per-key Python resolution,
                 # PINNED to the batch's snapshot seqno — re-reading at a
                 # fresh last_sequence would mix sequence points within one
                 # MultiGet (the Python path gives every key one snap_seq).
-                out[i] = self._get_impl_entry(keys[i], pinned_opts, cf)
+                # No tracer record: the OP_MULTIGET record above already
+                # covers this key (a second OP_GET would double it on
+                # replay).
+                out[i] = self._get_impl_entry(keys[i], pinned_opts, cf,
+                                              record_trace=False)
         return True, out
 
     def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,

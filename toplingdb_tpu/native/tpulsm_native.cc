@@ -86,12 +86,20 @@ static inline PackedEntry packed_entry_of(const uint8_t* key_buf,
                                           const int64_t* lens, int64_t i) {
   const uint8_t* k = key_buf + offs[i];
   const int64_t l = lens[i] - 8;
-  uint64_t kw = 0;
-  for (int64_t b = 0; b < l; b++)
-    kw |= static_cast<uint64_t>(k[b]) << (8 * (7 - b));
-  const uint8_t* t = k + l;
-  uint64_t p = 0;
-  for (int b = 0; b < 8; b++) p |= static_cast<uint64_t>(t[b]) << (8 * b);
+  // The 8-byte trailer always follows the user key, so an 8-byte load at
+  // k is in-bounds for any l >= 0; mask off the trailer bytes that leak
+  // into the word when l < 8. ~3x faster than the byte loops at 10M rows.
+  uint64_t raw, p;
+  std::memcpy(&raw, k, 8);
+  std::memcpy(&p, k + l, 8);
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_BIG_ENDIAN__
+  uint64_t kw_full = raw;
+  p = __builtin_bswap64(p);
+#else
+  uint64_t kw_full = __builtin_bswap64(raw);
+#endif
+  uint64_t kw = l >= 8 ? kw_full
+                       : (l ? (kw_full & (~0ull << (8 * (8 - l)))) : 0);
   return {kw, p, static_cast<uint32_t>(l), static_cast<int32_t>(i)};
 }
 }  // extern "C++"
@@ -810,6 +818,28 @@ static inline size_t varint32_len(uint32_t v) {
   return n;
 }
 
+// Length of the common prefix of a[0..n) and b[0..n), word-at-a-time.
+static inline uint32_t common_prefix_len(const uint8_t* a, const uint8_t* b,
+                                         uint32_t n) {
+  uint32_t i = 0;
+  while (i + 8 <= n) {
+    uint64_t x, y;
+    std::memcpy(&x, a + i, 8);
+    std::memcpy(&y, b + i, 8);
+    uint64_t d = x ^ y;
+    if (d) {
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_BIG_ENDIAN__
+      return i + (uint32_t)(__builtin_clzll(d) >> 3);
+#else
+      return i + (uint32_t)(__builtin_ctzll(d) >> 3);
+#endif
+    }
+    i += 8;
+  }
+  while (i < n && a[i] == b[i]) i++;
+  return i;
+}
+
 static inline uint8_t* put_varint32(uint8_t* p, uint32_t v) {
   while (v >= 0x80) { *p++ = (v & 0x7f) | 0x80; v >>= 7; }
   *p++ = (uint8_t)v;
@@ -839,12 +869,21 @@ int64_t tpulsm_decode_block(
   uint32_t last_len = 0;
   while (p < end) {
     uint32_t shared, non_shared, vlen;
-    p = get_varint32(p, end, &shared);
-    if (!p) return -1;
-    p = get_varint32(p, end, &non_shared);
-    if (!p) return -1;
-    p = get_varint32(p, end, &vlen);
-    if (!p) return -1;
+    if (p + 3 <= end && (p[0] | p[1] | p[2]) < 0x80) {
+      // All three lengths are single-byte varints — the dominant case for
+      // small-KV workloads; skips three bounds-checked decode calls.
+      shared = p[0];
+      non_shared = p[1];
+      vlen = p[2];
+      p += 3;
+    } else {
+      p = get_varint32(p, end, &shared);
+      if (!p) return -1;
+      p = get_varint32(p, end, &non_shared);
+      if (!p) return -1;
+      p = get_varint32(p, end, &vlen);
+      if (!p) return -1;
+    }
     if (p + non_shared + vlen > end) return -1;
     if (shared > last_len) return -1;
     if (n >= max_entries) return -4;
@@ -909,7 +948,7 @@ int64_t tpulsm_build_block(
     uint32_t shared = 0;
     if (counter < restart_interval) {
       uint32_t mx = klen < last_len ? klen : last_len;
-      while (shared < mx && last_key[shared] == cur_key[shared]) shared++;
+      shared = common_prefix_len(last_key, cur_key, mx);
     } else {
       if (num_restarts >= 1024) {
         // Restart table full: cutting here would diverge byte-wise from the
@@ -921,13 +960,24 @@ int64_t tpulsm_build_block(
       counter = 0;
     }
     uint32_t non_shared = klen - shared;
-    int64_t need = (int64_t)varint32_len(shared) + varint32_len(non_shared) +
-                   varint32_len(vlen) + non_shared + vlen;
+    bool fast_lens = (shared | non_shared | vlen) < 0x80;
+    int64_t need = (fast_lens ? 3
+                              : (int64_t)varint32_len(shared) +
+                                    varint32_len(non_shared) +
+                                    varint32_len(vlen)) +
+                   non_shared + vlen;
     if (used + need + 4 * (num_restarts + 1) + 4 > out_cap) return -2;
     uint8_t* p = out + used;
-    p = put_varint32(p, shared);
-    p = put_varint32(p, non_shared);
-    p = put_varint32(p, vlen);
+    if (fast_lens) {
+      p[0] = (uint8_t)shared;
+      p[1] = (uint8_t)non_shared;
+      p[2] = (uint8_t)vlen;
+      p += 3;
+    } else {
+      p = put_varint32(p, shared);
+      p = put_varint32(p, non_shared);
+      p = put_varint32(p, vlen);
+    }
     std::memcpy(p, cur_key + shared, non_shared);
     p += non_shared;
     std::memcpy(p, val_buf + val_offs[e], vlen);
@@ -1210,6 +1260,9 @@ void tpulsm_bloom_build(
     uint64_t h = tpulsm_xxh64(key_buf + key_offs[i], (size_t)key_lens[i],
                               0xA0761D64ULL);
     uint64_t h2 = ((h >> 33) | (h << 31)) | 1ULL;
+    // NOTE: the probe sequence is (h + k*h2) mod 2^64 mod num_bits — the
+    // 2^64 wraparound is part of the format (table/filter.py:47), so the
+    // per-probe modulo cannot be replaced by incremental reduction.
     uint64_t x = h;
     for (uint32_t k = 0; k < num_probes; k++) {
       uint64_t b = x % num_bits;
@@ -1779,7 +1832,7 @@ int64_t tpulsm_scan_blocks(
       try {
         if (scratch.size() < ulen) scratch.resize(ulen);
       } catch (...) {
-        return -8;
+        return -1;  // resource exhaustion, NOT corruption: fall back
       }
       size_t got = ulen;
       if (c.snappy_unc((const char*)payload, (size_t)len, (char*)scratch.data(),
@@ -1794,11 +1847,11 @@ int64_t tpulsm_scan_blocks(
           (unsigned long long)c.zstd_size(payload, (size_t)len);
       if (s == (unsigned long long)-1 || s == (unsigned long long)-2)
         return -1;  // unknown size / dict frame: Python path has the dict
-      if (s > (1ull << 31)) return -8;
+      if (s > (1ull << 31)) return -1;  // oversized: compatible path
       try {
         if (scratch.size() < (size_t)s) scratch.resize((size_t)s);
       } catch (...) {
-        return -8;
+        return -1;  // resource exhaustion, NOT corruption: fall back
       }
       size_t got = c.zstd_dec(scratch.data(), (size_t)s, payload, (size_t)len);
       if (c.zstd_err(got) || got != (size_t)s) return -8;
@@ -3000,10 +3053,29 @@ struct NTable {
   std::string index;           // uncompressed single-level index block
   std::string filter;          // whole-key bloom block ("" → no filter)
   std::string smallest_uk, largest_uk;
+  // Decoded index (built once per handle): flat arrays for a cache-
+  // friendly binary search — probing the raw multi-MB index block paid
+  // ~15 scattered cache misses per Get. idx_prefix holds the zero-padded
+  // big-endian first 8 USER-KEY bytes (coarse order: ties fall back to a
+  // full compare of the stored key). Empty when the block didn't decode
+  // cleanly (the BCur path remains as fallback).
+  std::vector<uint64_t> idx_prefix;
+  std::vector<uint32_t> idx_koff, idx_klen;
+  std::vector<uint64_t> idx_boff, idx_bsize;
+  std::string idx_keys;
   ~NTable() {
     if (fd >= 0) ::close(fd);
   }
 };
+
+// Zero-padded big-endian first-8-bytes of a user key: never orders two
+// keys WRONGLY, only ties (equal prefixes) need a full compare.
+static inline uint64_t nuk_prefix(const uint8_t* uk, int32_t ulen) {
+  uint64_t w = 0;
+  int32_t n = ulen < 8 ? ulen : 8;
+  for (int32_t i = 0; i < n; i++) w |= (uint64_t)uk[i] << (8 * (7 - i));
+  return w;
+}
 
 struct NVersion {
   std::vector<NTable*> l0;                   // newest first
@@ -3173,6 +3245,68 @@ int bcur_seek(BCur& c, const uint8_t* d, int64_t len, const uint8_t* target,
   return 0;  // all keys < target
 }
 
+// Decode a single-level index block into NTable's flat arrays; leaves
+// them empty (BCur fallback) on any irregularity.
+void ntable_decode_index(NTable* t) {
+  auto fail = [&] {
+    t->idx_prefix.clear();
+    t->idx_koff.clear();
+    t->idx_klen.clear();
+    t->idx_boff.clear();
+    t->idx_bsize.clear();
+    t->idx_keys.clear();
+  };
+  BCur c;
+  if (t->index.empty() ||
+      !c.init((const uint8_t*)t->index.data(), (int64_t)t->index.size()))
+    return;
+  size_t approx = t->index.size() / 24 + 8;
+  t->idx_prefix.reserve(approx);
+  t->idx_boff.reserve(approx);
+  t->idx_bsize.reserve(approx);
+  int r;
+  while ((r = c.next()) == 1) {
+    const uint8_t* vp = c.val;
+    const uint8_t* vend = c.val + c.vlen;
+    uint64_t boff = 0, bsize = 0;
+    vp = get_varint64(vp, vend, &boff);
+    if (vp) vp = get_varint64(vp, vend, &bsize);
+    if (!vp || c.klen < 8 || t->idx_keys.size() > 0xFFFFFF00u) {
+      fail();
+      return;
+    }
+    t->idx_prefix.push_back(nuk_prefix(c.key, (int32_t)c.klen - 8));
+    t->idx_koff.push_back((uint32_t)t->idx_keys.size());
+    t->idx_klen.push_back(c.klen);
+    t->idx_boff.push_back(boff);
+    t->idx_bsize.push_back(bsize);
+    t->idx_keys.append((const char*)c.key, c.klen);
+  }
+  if (r < 0) fail();
+}
+
+// First decoded-index entry whose key >= target (internal-key order).
+int64_t nindex_lower_bound(NTable* t, const uint8_t* target, int32_t tlen) {
+  uint64_t tp = nuk_prefix(target, tlen - 8);
+  const uint64_t* pre = t->idx_prefix.data();
+  const uint8_t* keys = (const uint8_t*)t->idx_keys.data();
+  int64_t lo = 0, hi = (int64_t)t->idx_prefix.size();
+  while (lo < hi) {
+    int64_t mid = (lo + hi) >> 1;
+    bool less;
+    if (pre[mid] != tp)
+      less = pre[mid] < tp;
+    else
+      less = ikey_compare(keys + t->idx_koff[mid],
+                          (int32_t)t->idx_klen[mid], target, tlen) < 0;
+    if (less)
+      lo = mid + 1;
+    else
+      hi = mid;
+  }
+  return lo;
+}
+
 // Whole-key bloom probe: layout varint32 num_bits | 1B k | bits.
 bool nfilter_may_match(const std::string& f, const uint8_t* key,
                        int32_t klen) {
@@ -3296,23 +3430,38 @@ int ntable_get(NTable* t, const uint8_t* ukey, int32_t klen,
   for (int i = 0; i < 8; i++) target[klen + i] = (uint8_t)(packed >> (8 * i));
   int32_t tlen = klen + 8;
 
+  // Candidate block via the decoded flat index (one cache-friendly
+  // binary search) when available; raw-block cursor otherwise.
+  bool use_arr = !t->idx_prefix.empty();
   BCur idx;
-  int sr = bcur_seek(idx, (const uint8_t*)t->index.data(),
-                     (int64_t)t->index.size(), target, tlen);
-  if (sr < 0) return NGET_FALLBACK;
-  if (sr == 0) return NGET_NOTFOUND;  // past the last block
+  int64_t ipos = 0;
+  int64_t icount = (int64_t)t->idx_prefix.size();
+  if (use_arr) {
+    ipos = nindex_lower_bound(t, target, tlen);
+    if (ipos >= icount) return NGET_NOTFOUND;  // past the last block
+  } else {
+    int sr = bcur_seek(idx, (const uint8_t*)t->index.data(),
+                       (int64_t)t->index.size(), target, tlen);
+    if (sr < 0) return NGET_FALLBACK;
+    if (sr == 0) return NGET_NOTFOUND;  // past the last block
+  }
 
   bool first_block = true;
   while (true) {
-    // idx cursor sits at the candidate block's index entry; its value is
-    // the BlockHandle (varint64 offset, varint64 size).
-    const uint8_t* vp = idx.val;
-    const uint8_t* vend = idx.val + idx.vlen;
     uint64_t boff, bsize;
-    vp = get_varint64(vp, vend, &boff);
-    if (!vp) return NGET_FALLBACK;
-    vp = get_varint64(vp, vend, &bsize);
-    if (!vp) return NGET_FALLBACK;
+    if (use_arr) {
+      boff = t->idx_boff[ipos];
+      bsize = t->idx_bsize[ipos];
+    } else {
+      // idx cursor sits at the candidate block's index entry; its value
+      // is the BlockHandle (varint64 offset, varint64 size).
+      const uint8_t* vp = idx.val;
+      const uint8_t* vend = idx.val + idx.vlen;
+      vp = get_varint64(vp, vend, &boff);
+      if (!vp) return NGET_FALLBACK;
+      vp = get_varint64(vp, vend, &bsize);
+      if (!vp) return NGET_FALLBACK;
+    }
     auto block = nfetch_block(t, boff, bsize, ctr);
     if (!block) return NGET_FALLBACK;
     BCur c;
@@ -3368,7 +3517,9 @@ int ntable_get(NTable* t, const uint8_t* ukey, int32_t klen,
     }
     // Block exhausted without passing ukey: the version run may continue
     // in the next data block.
-    {
+    if (use_arr) {
+      if (++ipos >= icount) return NGET_NOTFOUND;  // no further blocks
+    } else {
       int nr = idx.next();
       if (nr < 0) return NGET_FALLBACK;
       if (nr == 0) return NGET_NOTFOUND;  // no further blocks
@@ -3451,6 +3602,11 @@ void* tpulsm_table_handle_new(int32_t fd, uint64_t number, int32_t eligible,
   t->number = number;
   t->eligible = eligible && t->fd >= 0;
   if (index_len > 0) t->index.assign((const char*)index, (size_t)index_len);
+  if (t->eligible) {
+    ntable_decode_index(t);
+    if (!t->idx_prefix.empty())
+      std::string().swap(t->index);  // decoded copy supersedes the raw block
+  }
   if (filter_len > 0)
     t->filter.assign((const char*)filter, (size_t)filter_len);
   if (sl > 0) t->smallest_uk.assign((const char*)smallest_uk, (size_t)sl);
