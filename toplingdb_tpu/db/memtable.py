@@ -84,6 +84,11 @@ class NativeSkipListRep(MemTableRep):
     _sym = "tpulsm_skiplist"
     _entry_sym = "node"  # {sym}_{entry_sym}(pos, ...) decodes a position
 
+    # Both native reps charge handed-out arena bytes (content + node
+    # overhead) to flush/WBM budgets — the reference's physical
+    # ApproximateMemoryUsage semantics, and rep-fair flush cadence.
+    charge_physical_memory = True
+
     def __init__(self):
         from toplingdb_tpu import native
 
@@ -269,6 +274,7 @@ class NativeTrieRep(NativeSkipListRep):
     compression), per-stripe mutexes so concurrent writers on different
     key regions never contend; versions hang off one leaf per user key
     as release-published atomic lists (lockless readers)."""
+
 
     _nget_mem_kind = 1  # TrieRep* layout
     _sym = "tpulsm_trie"
@@ -654,6 +660,15 @@ class MemTable:
         return MemTableIterator(self)
 
     def approximate_memory_usage(self) -> int:
+        # Native reps (skiplist AND trie) charge PHYSICAL handed-out
+        # arena bytes — the reference's ApproximateMemoryUsage semantics
+        # — so write_buffer_size / WriteBufferManager see real footprint
+        # (node towers, version lists). Pure-Python reps keep the
+        # logical len+24 estimate.
+        if getattr(self._rep, "charge_physical_memory", False):
+            rep_mem = self._rep.memory_usage()
+            if rep_mem > self._mem_usage:
+                return rep_mem
         return self._mem_usage
 
     @property

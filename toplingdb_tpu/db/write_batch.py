@@ -37,15 +37,10 @@ class WriteBatch:
             if len(data) < HEADER_SIZE:
                 raise Corruption("write batch header too small")
             self._rep = bytearray(data)
-            self._ops = None  # unknown provenance: decode when applying
             self._simple = False
             self._count = coding.decode_fixed32(self._rep, 8)
         else:
             self._rep = bytearray(HEADER_SIZE)
-            # Ops built through this object are ALSO kept parsed so
-            # insert_into need not re-decode the bytes it just encoded
-            # (write-path hot loop); wire-deserialized batches decode.
-            self._ops: list | None = []
             self._simple = True
             self._count = 0  # header count patched lazily (see data())
 
@@ -88,18 +83,9 @@ class WriteBatch:
             else:
                 coding.put_length_prefixed_slice(rep, s)
         self._count += 1
-        if self._ops is not None:
-            # bytes() snapshots: the decode path yields immutable copies, so
-            # the fast path must too (a caller-mutated bytearray would
-            # otherwise diverge memtable contents from the WAL bytes).
-            self._ops.append((
-                cf, int(t), bytes(slices[0]),
-                bytes(slices[1]) if len(slices) > 1 else None,
-            ))
 
     def clear(self) -> None:
         self._rep = bytearray(HEADER_SIZE)
-        self._ops = []
         self._simple = True
         self._count = 0
 
@@ -108,11 +94,6 @@ class WriteBatch:
         self._rep += other._rep[HEADER_SIZE:]
         self._count += other.count()
         self._simple = self._simple and other._simple
-        if self._ops is not None:
-            if other._ops is not None:
-                self._ops.extend(other._ops)
-            else:
-                self._ops = None  # provenance lost: decode when applying
 
     # -- header ---------------------------------------------------------
 
@@ -154,14 +135,6 @@ class WriteBatch:
 
     def entries_cf(self):
         """Yields (cf_id, value_type, key, value_or_none)."""
-        if self._ops is not None:
-            if len(self._ops) != self.count():
-                raise Corruption(
-                    f"write batch count mismatch: header {self.count()}, "
-                    f"ops {len(self._ops)}"
-                )
-            yield from self._ops
-            return
         rep = self._rep
         off = HEADER_SIZE
         n = 0

@@ -1308,14 +1308,20 @@ struct Arena {
   std::vector<uint8_t*> blocks;
   size_t used = 0;
   size_t cap = 0;
-  std::atomic<size_t> total{0};
+  // Block size grows geometrically from min_block to 1MiB: 257 trie
+  // stripes at a fixed 1MiB first block held ~257MiB of mostly-empty
+  // arenas for byte-spread keys; the skiplist keeps a 1MiB start.
+  size_t min_block = 1u << 20;
+  std::atomic<size_t> total{0};   // allocated block bytes (physical)
+  std::atomic<size_t> handed{0};  // bytes handed to callers (tight bound)
   std::mutex mu;
 
   uint8_t* alloc(size_t n) {
     n = (n + 7) & ~size_t(7);
     std::lock_guard<std::mutex> g(mu);
     if (used + n > cap) {
-      size_t bs = n > (1u << 20) ? n : (1u << 20);
+      size_t bs = n > min_block ? n : min_block;
+      if (min_block < (1u << 20)) min_block *= 2;
       blocks.push_back(new uint8_t[bs]);
       used = 0;
       cap = bs;
@@ -1323,6 +1329,7 @@ struct Arena {
     }
     uint8_t* p = blocks.back() + used;
     used += n;
+    handed.fetch_add(n, std::memory_order_relaxed);
     return p;
   }
   ~Arena() {
@@ -1479,7 +1486,9 @@ int64_t tpulsm_skiplist_count(void* h) {
 }
 
 int64_t tpulsm_skiplist_memory(void* h) {
-  return (int64_t)static_cast<SkipList*>(h)->arena.total.load(
+  // Handed-out bytes (content + node overhead), matching the trie rep's
+  // accounting so flush cadence compares reps on equal footing.
+  return (int64_t)static_cast<SkipList*>(h)->arena.handed.load(
       std::memory_order_relaxed);
 }
 
@@ -2361,9 +2370,12 @@ struct TrieRep {
   std::atomic<int64_t> count{0};
 
   int64_t memory() {
+    // Handed-out bytes, not block caps: the flush/WBM charge tracks real
+    // content + node overhead without penalizing half-filled blocks
+    // (geometric growth bounds the cap/handed gap to <2x anyway).
     int64_t m = 0;
     for (auto& s : stripes)
-      m += (int64_t)s.arena.total.load(std::memory_order_relaxed);
+      m += (int64_t)s.arena.handed.load(std::memory_order_relaxed);
     return m;
   }
 };
@@ -2383,8 +2395,11 @@ TNode* tnode_new(Arena& a, uint16_t ntype, const uint8_t* prefix,
   }
   n->leaf = nullptr;
   if (ntype == 4 || ntype == 16) {
-    n->keys = a.alloc(ntype);
-    n->children = (TNode**)a.alloc(sizeof(TNode*) * ntype);
+    // LAZY arrays: tail nodes (one per unique key suffix) never gain a
+    // child — not allocating keys/children until the first tnode_add
+    // saves ~40B on the dominant node population.
+    n->keys = nullptr;
+    n->children = nullptr;
   } else if (ntype == 48) {
     n->keys = a.alloc(256);
     std::memset(n->keys, 0xFF, 256);
@@ -2417,6 +2432,9 @@ TNode* tnode_grow(Arena& a, TNode* n) {
     TNode* g = tnode_new(a, nt, n->prefix, n->prefix_len);
     g->leaf = n->leaf;
     if (nt == 16) {
+      // tnode_new leaves N16 arrays lazy — materialize before copying.
+      g->keys = a.alloc(16);
+      g->children = (TNode**)a.alloc(sizeof(TNode*) * 16);
       std::memcpy(g->keys, n->keys, n->nkeys);
       std::memcpy(g->children, n->children, sizeof(TNode*) * n->nkeys);
       g->nkeys = n->nkeys;
@@ -2447,6 +2465,10 @@ void tnode_add(Arena& a, TNode** slot, uint8_t c, TNode* child) {
     *slot = n;
   }
   if (n->ntype == 4 || n->ntype == 16) {
+    if (!n->keys) {  // lazily materialize (see tnode_new)
+      n->keys = a.alloc(n->ntype);
+      n->children = (TNode**)a.alloc(sizeof(TNode*) * n->ntype);
+    }
     uint16_t i = n->nkeys;
     while (i > 0 && n->keys[i - 1] > c) {
       n->keys[i] = n->keys[i - 1];
@@ -2794,7 +2816,15 @@ void trie_walk_all(TrieRep* t, F&& fn) {
 }  // namespace
 }  // extern "C++"
 
-void* tpulsm_trie_new() { return new (std::nothrow) TrieRep(); }
+void* tpulsm_trie_new() {
+  TrieRep* t = new (std::nothrow) TrieRep();
+  if (t) {
+    // Per-stripe arenas start small (16KiB, doubling to 1MiB): most of
+    // the 257 stripes see few keys.
+    for (auto& s : t->stripes) s.arena.min_block = 16u << 10;
+  }
+  return t;
+}
 void tpulsm_trie_free(void* h) { delete static_cast<TrieRep*>(h); }
 
 int32_t tpulsm_trie_insert(void* h, const uint8_t* k, uint32_t kl,

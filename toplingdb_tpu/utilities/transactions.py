@@ -927,11 +927,7 @@ class WriteUnpreparedTransaction(WritePreparedTransaction):
         part._rep = bytearray(part._rep[:HEADER_SIZE])
         part._rep += full._rep[self._spill_off:]
         part.set_count(full.count() - self._spill_count)
-        # Carry the parsed-ops tail too (kept in lockstep with the bytes).
-        part._ops = (
-            list(full._ops[self._spill_count:])
-            if full._ops is not None else None
-        )
+        part._simple = False  # sliced bytes: decode when applying
         return part
 
     def _wp_pending_batch(self):
