@@ -221,6 +221,57 @@ Java_org_toplingdb_WriteBatch_deleteNative(JNIEnv* env, jclass cls, jlong h,
     check_err(env, err);
 }
 
+JNIEXPORT void JNICALL
+Java_org_toplingdb_WriteBatch_mergeNative(JNIEnv* env, jclass cls, jlong h,
+                                          jbyteArray key, jbyteArray val) {
+    (void)cls;
+    char* err = NULL;
+    jsize klen = (*env)->GetArrayLength(env, key);
+    jsize vlen = (*env)->GetArrayLength(env, val);
+    jbyte* k = (*env)->GetByteArrayElements(env, key, NULL);
+    jbyte* v = (*env)->GetByteArrayElements(env, val, NULL);
+    if (k != NULL && v != NULL) {
+        tpulsm_writebatch_merge((tpulsm_writebatch_t*)(intptr_t)h,
+                                (const char*)k, (size_t)klen,
+                                (const char*)v, (size_t)vlen, &err);
+    }
+    if (k != NULL) (*env)->ReleaseByteArrayElements(env, key, k, JNI_ABORT);
+    if (v != NULL) (*env)->ReleaseByteArrayElements(env, val, v, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_WriteBatch_deleteRangeNative(JNIEnv* env, jclass cls,
+                                                jlong h, jbyteArray beg,
+                                                jbyteArray end) {
+    (void)cls;
+    char* err = NULL;
+    jsize blen = (*env)->GetArrayLength(env, beg);
+    jsize elen = (*env)->GetArrayLength(env, end);
+    jbyte* b = (*env)->GetByteArrayElements(env, beg, NULL);
+    jbyte* e = (*env)->GetByteArrayElements(env, end, NULL);
+    if (b != NULL && e != NULL) {
+        tpulsm_writebatch_delete_range((tpulsm_writebatch_t*)(intptr_t)h,
+                                       (const char*)b, (size_t)blen,
+                                       (const char*)e, (size_t)elen, &err);
+    }
+    if (b != NULL) (*env)->ReleaseByteArrayElements(env, beg, b, JNI_ABORT);
+    if (e != NULL) (*env)->ReleaseByteArrayElements(env, end, e, JNI_ABORT);
+    check_err(env, err);
+}
+
+JNIEXPORT void JNICALL
+Java_org_toplingdb_WriteBatch_clearNative(JNIEnv* env, jclass cls, jlong h) {
+    (void)env; (void)cls;
+    tpulsm_writebatch_clear((tpulsm_writebatch_t*)(intptr_t)h);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_toplingdb_WriteBatch_countNative(JNIEnv* env, jclass cls, jlong h) {
+    (void)env; (void)cls;
+    return (jint)tpulsm_writebatch_count((tpulsm_writebatch_t*)(intptr_t)h);
+}
+
 /* -- TpuLsmIterator ------------------------------------------------------ */
 
 JNIEXPORT void JNICALL

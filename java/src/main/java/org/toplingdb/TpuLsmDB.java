@@ -76,6 +76,24 @@ public class TpuLsmDB implements AutoCloseable {
         return getAtSnapshotNative(handle, snapshot.handle(), key);
     }
 
+    /** Batched point lookups (reference RocksDB.multiGetAsList): a null
+     *  element marks a missing key. */
+    public java.util.List<byte[]> multiGetAsList(java.util.List<byte[]> keys)
+            throws TpuLsmException {
+        checkOpen();
+        java.util.ArrayList<byte[]> out =
+                new java.util.ArrayList<byte[]>(keys.size());
+        for (byte[] k : keys) {
+            out.add(getNative(handle, k));
+        }
+        return out;
+    }
+
+    /** True when the key exists (reference RocksDB.keyExists role). */
+    public boolean keyExists(byte[] key) throws TpuLsmException {
+        return get(key) != null;
+    }
+
     /** Hard-link consistent checkpoint (reference Checkpoint). */
     public void createCheckpoint(String destDir) throws TpuLsmException {
         checkOpen();

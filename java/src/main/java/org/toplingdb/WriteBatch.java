@@ -19,6 +19,27 @@ public class WriteBatch implements AutoCloseable {
         deleteNative(handle, key);
     }
 
+    public void merge(byte[] key, byte[] value) throws TpuLsmException {
+        check();
+        mergeNative(handle, key, value);
+    }
+
+    public void deleteRange(byte[] begin, byte[] end)
+            throws TpuLsmException {
+        check();
+        deleteRangeNative(handle, begin, end);
+    }
+
+    public void clear() throws TpuLsmException {
+        check();
+        clearNative(handle);
+    }
+
+    public int count() throws TpuLsmException {
+        check();
+        return countNative(handle);
+    }
+
     long handle() throws TpuLsmException {
         check();
         return handle;
@@ -47,4 +68,14 @@ public class WriteBatch implements AutoCloseable {
 
     private static native void deleteNative(long h, byte[] k)
             throws TpuLsmException;
+
+    private static native void mergeNative(long h, byte[] k, byte[] v)
+            throws TpuLsmException;
+
+    private static native void deleteRangeNative(long h, byte[] b, byte[] e)
+            throws TpuLsmException;
+
+    private static native void clearNative(long h);
+
+    private static native int countNative(long h);
 }

@@ -74,6 +74,40 @@ public final class ApiBreadthTest {
             expect(eq(db.get(b("ik1")), b("iv1")), "checkpoint get");
         }
 
+        // -- WriteBatch breadth + multiGet + iterator walk --------------
+        try (TpuLsmDB db = TpuLsmDB.open(base + "/wbdb", true)) {
+            try (WriteBatch wb = new WriteBatch()) {
+                wb.put(b("wa"), b("1"));
+                wb.put(b("wb"), b("2"));
+                wb.put(b("wc"), b("3"));
+                wb.delete(b("wa"));
+                expect(wb.count() == 4, "wb count");
+                db.write(wb);
+                wb.clear();
+                expect(wb.count() == 0, "wb clear");
+                wb.deleteRange(b("wb"), b("wc"));
+                db.write(wb);
+            }
+            expect(db.get(b("wa")) == null, "wb delete applied");
+            expect(db.get(b("wb")) == null, "wb deleteRange applied");
+            expect(eq(db.get(b("wc")), b("3")), "wb survivor");
+            java.util.List<byte[]> got = db.multiGetAsList(
+                java.util.Arrays.asList(b("wa"), b("wc")));
+            expect(got.get(0) == null && eq(got.get(1), b("3")),
+                   "multiGetAsList");
+            expect(db.keyExists(b("wc")) && !db.keyExists(b("wa")),
+                   "keyExists");
+            try (TpuLsmIterator it = db.newIterator()) {
+                it.seekToFirst();
+                expect(it.isValid() && eq(it.key(), b("wc")), "iter first");
+                it.next();
+                expect(!it.isValid(), "iter end");
+            }
+            // No-crash smoke: property names are engine-defined; a miss
+            // returns null without throwing.
+            db.getProperty("tpulsm.stats");
+        }
+
         // -- SidePluginRepo: open from JSON config + HTTP ---------------
         try (SidePluginRepo repo = SidePluginRepo.create()) {
             TpuLsmDB db = repo.openDB(

@@ -1,0 +1,98 @@
+"""BlobSource cache tier (reference db/blob/blob_source.{h,cc} +
+blob_file_cache.cc): value-cache hits skip file reads, the open-reader
+set is LRU-capped, and the tickers tell the story."""
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.utils import statistics as st
+from toplingdb_tpu.utils.cache import LRUCache
+
+
+@pytest.fixture
+def tmp_db_path(tmp_path):
+    return str(tmp_path / "db")
+
+
+def _fill_blob_db(path, n=300, vsize=600, **kw):
+    stats = st.Statistics()
+    opts = Options(create_if_missing=True, enable_blob_files=True,
+                   min_blob_size=256, statistics=stats, **kw)
+    db = DB.open(path, opts)
+    for i in range(n):
+        db.put(b"k%06d" % i, b"B%05d" % i + b"x" * (vsize - 6))
+    db.flush()
+    db.wait_for_compactions()
+    return db, stats
+
+
+def test_blob_value_cache_hits(tmp_db_path):
+    db, stats = _fill_blob_db(tmp_db_path, blob_cache=4 << 20)
+    # Cold pass populates; warm pass must be all cache hits.
+    for i in range(300):
+        assert db.get(b"k%06d" % i)[:6] == b"B%05d" % i
+    misses0 = stats.get_ticker_count(st.BLOB_DB_CACHE_MISS)
+    file_bytes0 = stats.get_ticker_count(st.BLOB_DB_BLOB_FILE_BYTES_READ)
+    assert misses0 > 0 and file_bytes0 > 0
+    for i in range(300):
+        assert db.get(b"k%06d" % i)[:6] == b"B%05d" % i
+    assert stats.get_ticker_count(st.BLOB_DB_CACHE_MISS) == misses0, \
+        "warm pass must not miss"
+    assert stats.get_ticker_count(st.BLOB_DB_BLOB_FILE_BYTES_READ) \
+        == file_bytes0, "warm pass must not touch blob files"
+    assert stats.get_ticker_count(st.BLOB_DB_CACHE_HIT) >= 300
+    db.close()
+
+
+def test_blob_cache_capacity_evicts(tmp_db_path):
+    # Capacity for only a few values: the second pass must re-read.
+    db, stats = _fill_blob_db(tmp_db_path, blob_cache=2048)
+    for i in range(300):
+        db.get(b"k%06d" % i)
+    m0 = stats.get_ticker_count(st.BLOB_DB_CACHE_MISS)
+    for i in range(300):
+        db.get(b"k%06d" % i)
+    assert stats.get_ticker_count(st.BLOB_DB_CACHE_MISS) > m0
+    db.close()
+
+
+def test_blob_cache_accepts_cache_instance(tmp_db_path):
+    shared = LRUCache(1 << 20)
+    db, stats = _fill_blob_db(tmp_db_path, blob_cache=shared)
+    for i in range(100):
+        db.get(b"k%06d" % i)
+    for i in range(100):
+        db.get(b"k%06d" % i)
+    assert stats.get_ticker_count(st.BLOB_DB_CACHE_HIT) >= 100
+    db.close()
+
+
+def test_no_cache_still_reads(tmp_db_path):
+    db, stats = _fill_blob_db(tmp_db_path)  # blob_cache=None
+    for i in range(50):
+        assert db.get(b"k%06d" % i) is not None
+    assert stats.get_ticker_count(st.BLOB_DB_CACHE_HIT) == 0
+    assert stats.get_ticker_count(st.BLOB_DB_BLOB_FILE_BYTES_READ) > 0
+    db.close()
+
+
+def test_reader_open_limit(tmp_db_path):
+    # Many blob files (tiny write buffer forces many flushes), open cap 2.
+    db, stats = _fill_blob_db(tmp_db_path, n=400,
+                              write_buffer_size=16 << 10,
+                              blob_file_open_limit=2)
+    for i in range(0, 400, 7):
+        assert db.get(b"k%06d" % i) is not None
+    assert len(db.blob_source._readers) <= 2
+    db.close()
+
+
+def test_db_bench_blob_workloads(tmp_path):
+    from toplingdb_tpu.tools import db_bench as dbb
+
+    argv = ["--benchmarks=fillrandomblob,readrandomblob",
+            "--num=400", "--value-size=512",
+            f"--db={tmp_path}/benchdb", "--statistics"]
+    rc = dbb.main(argv)
+    assert rc in (0, None)

@@ -1,0 +1,142 @@
+"""Wide columns under the dedicated value type (reference
+kTypeWideColumnEntity, db/dbformat.h + db/wide/): typed detection (no
+magic-sniff ambiguity on plain binary values), flush/compaction
+survival, entity-aware merge, iterator columns() parity."""
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.db.wide_columns import _MAGIC
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.utils.merge_operator import StringAppendOperator
+
+
+@pytest.fixture
+def dbp(tmp_path):
+    return str(tmp_path / "db")
+
+
+def test_plain_value_with_magic_prefix_is_not_reinterpreted(dbp):
+    """The r04 ADVICE case: an arbitrary binary value that happens to
+    start with \\x00WCE1 and parse as an entity must come back VERBATIM."""
+    db = DB.open(dbp, Options(create_if_missing=True))
+    # _MAGIC + varint32(0) parses as an empty entity under the sniff.
+    tricky = _MAGIC + b"\x00"
+    db.put(b"k", tricky)
+    assert db.get(b"k") == tricky
+    assert db.multi_get([b"k"]) == [tricky]
+    it = db.new_iterator()
+    it.seek_to_first()
+    assert it.value() == tricky
+    db.flush()
+    db.wait_for_compactions()
+    assert db.get(b"k") == tricky
+    db.close()
+
+
+def test_entity_get_unwraps_default_column(dbp):
+    db = DB.open(dbp, Options(create_if_missing=True))
+    db.put_entity(b"e", {b"": b"dflt", b"name": b"alice"})
+    assert db.get(b"e") == b"dflt"
+    assert db.get_entity(b"e") == {b"": b"dflt", b"name": b"alice"}
+    assert db.multi_get([b"e"]) == [b"dflt"]
+    db.close()
+
+
+def test_entity_survives_flush_and_compaction(dbp):
+    db = DB.open(dbp, Options(create_if_missing=True))
+    for i in range(500):
+        db.put_entity(b"e%04d" % i, {b"": b"d%d" % i, b"c": b"x" * 50})
+    db.flush()
+    db.compact_range(None, None)
+    db.wait_for_compactions()
+    assert db.get(b"e0007") == b"d7"
+    assert db.get_entity(b"e0499") == {b"": b"d499", b"c": b"x" * 50}
+    db.close()
+    db = DB.open(dbp, Options())  # recovery keeps the type
+    assert db.get(b"e0007") == b"d7"
+    db.close()
+
+
+def test_iterator_columns_and_value(dbp):
+    db = DB.open(dbp, Options(create_if_missing=True))
+    db.put(b"a", b"plain")
+    db.put_entity(b"b", {b"": b"bd", b"col": b"cv"})
+    it = db.new_iterator()
+    it.seek_to_first()
+    assert it.key() == b"a" and it.value() == b"plain"
+    assert it.columns() == {b"": b"plain"}
+    it.next()
+    assert it.key() == b"b" and it.value() == b"bd"
+    assert it.columns() == {b"": b"bd", b"col": b"cv"}
+    it.prev()
+    assert it.value() == b"plain"
+    db.close()
+
+
+def test_merge_over_entity_folds_default_column(dbp):
+    db = DB.open(dbp, Options(create_if_missing=True,
+                              merge_operator=StringAppendOperator(b",")))
+    db.put_entity(b"m", {b"": b"base", b"keep": b"k"})
+    db.merge(b"m", b"x")
+    db.merge(b"m", b"y")
+    # Get path
+    assert db.get(b"m") == b"base,x,y"
+    assert db.get_entity(b"m") == {b"": b"base,x,y", b"keep": b"k"}
+    # Iterator path
+    it = db.new_iterator()
+    it.seek(b"m")
+    assert it.value() == b"base,x,y"
+    assert it.columns() == {b"": b"base,x,y", b"keep": b"k"}
+    # Compaction path: fold down to one entity entry
+    db.flush()
+    db.compact_range(None, None)
+    db.wait_for_compactions()
+    assert db.get(b"m") == b"base,x,y"
+    assert db.get_entity(b"m") == {b"": b"base,x,y", b"keep": b"k"}
+    db.close()
+
+
+def test_single_delete_annihilates_entity(dbp):
+    db = DB.open(dbp, Options(create_if_missing=True))
+    db.put_entity(b"s", {b"": b"v"})
+    db.single_delete(b"s")
+    db.flush()
+    db.compact_range(None, None)
+    db.wait_for_compactions()
+    assert db.get(b"s") is None
+    db.close()
+
+
+def test_entity_in_non_default_cf_and_parsed_path(dbp):
+    """Entity records must survive the PARSED WriteBatch path (non-simple
+    batches: CF-prefixed records decode through entries_cf)."""
+    from toplingdb_tpu.db.write_batch import WriteBatch
+    from toplingdb_tpu.db.wide_columns import encode_entity
+
+    db = DB.open(dbp, Options(create_if_missing=True))
+    cf = db.create_column_family("wide")
+    b = WriteBatch()
+    b.put_entity(b"ek", encode_entity({b"": b"cfd", b"c": b"v"}),
+                 cf=db._cf_id(cf))
+    assert list(b.entries_cf())  # decodes, no Corruption
+    db.write(b)
+    assert db.get(b"ek", cf=cf) == b"cfd"
+    assert db.get_entity(b"ek", cf=cf) == {b"": b"cfd", b"c": b"v"}
+    db.close()
+
+
+def test_legacy_unwrap_gate(dbp):
+    """Pre-type databases stored entities as VALUE + magic; the gate
+    restores the old presentation for them."""
+    from toplingdb_tpu.db.wide_columns import encode_entity
+
+    db = DB.open(dbp, Options(create_if_missing=True))
+    db.put(b"old", encode_entity({b"": b"legacy-default"}))  # r4-style
+    db.close()
+    db = DB.open(dbp, Options(legacy_wide_column_unwrap=True))
+    assert db.get(b"old") == b"legacy-default"
+    db.close()
+    db = DB.open(dbp, Options())  # gate off: raw bytes come back
+    assert db.get(b"old") == encode_entity({b"": b"legacy-default"})
+    db.close()

@@ -183,7 +183,8 @@ class CompactionIterator:
             # matching VALUE is in the same stripe by construction.
             if pending_single_del is not None:
                 sd_seq, _, _ = pending_single_del
-                if self._stripe(sd_seq) == stripe and t == ValueType.VALUE:
+                if self._stripe(sd_seq) == stripe and t in (
+                        ValueType.VALUE, ValueType.WIDE_COLUMN_ENTITY):
                     # Annihilate the pair (reference single-delete semantics).
                     self.num_single_del_pairs += 1
                     pending_single_del = None
@@ -224,7 +225,8 @@ class CompactionIterator:
                 last_stripe = stripe
                 i += 1
                 continue
-            if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+            if t in (ValueType.VALUE, ValueType.BLOB_INDEX,
+                     ValueType.WIDE_COLUMN_ENTITY):
                 survivors.append((seq, t, val))
                 last_stripe = stripe
                 i += 1
@@ -288,7 +290,8 @@ class CompactionIterator:
         # What terminated the chain?
         if j < n and self._stripe(entries[j][0]) == newest_stripe:
             seq, t, val = entries[j]
-            if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+            if t in (ValueType.VALUE, ValueType.BLOB_INDEX,
+                     ValueType.WIDE_COLUMN_ENTITY):
                 if t == ValueType.BLOB_INDEX:
                     # The merge base lives in a blob file: fold the REAL
                     # value, never the raw index bytes.
@@ -297,14 +300,29 @@ class CompactionIterator:
                             "merge over a blob value but no blob resolver"
                         )
                     val = self._blob_resolver(val)
-                v = self._merge_op.full_merge(uk, val, list(reversed(operands)))
+                ops = list(reversed(operands))
+                if t == ValueType.WIDE_COLUMN_ENTITY:
+                    # Entity base: fold against the DEFAULT column, emit
+                    # the entity back (reference MergeHelper over
+                    # kTypeWideColumnEntity / wide_columns_helper).
+                    from toplingdb_tpu.db.wide_columns import (
+                        merge_into_entity,
+                    )
+
+                    v = merge_into_entity(
+                        val,
+                        lambda b: self._merge_op.full_merge(uk, b, ops))
+                    out_t = ValueType.WIDE_COLUMN_ENTITY
+                else:
+                    v = self._merge_op.full_merge(uk, val, ops)
+                    out_t = ValueType.VALUE
                 self.num_merged += 1
                 # Consume the base too; skip the rest of the stripe.
                 j += 1
                 while j < n and self._stripe(entries[j][0]) == newest_stripe:
                     self.num_dropped_obsolete += 1
                     j += 1
-                return [(newest_seq, ValueType.VALUE, v)], j - i, newest_stripe
+                return [(newest_seq, out_t, v)], j - i, newest_stripe
             if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):
                 v = self._merge_op.full_merge(uk, None, list(reversed(operands)))
                 self.num_merged += 1

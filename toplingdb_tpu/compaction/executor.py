@@ -26,6 +26,7 @@ Three executors:
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import os
 import subprocess
@@ -212,6 +213,9 @@ def decode_file_meta(d: dict, number: int) -> FileMetaData:
     )
 
 
+_job_counter = itertools.count(1)
+
+
 class SubprocessCompactionExecutor(CompactionExecutor):
     """Ship the job to a worker process through a shared job dir — the
     transport shape of dcompact (HTTP+NFS in the reference; a local spawn +
@@ -241,7 +245,11 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             )
 
     def execute(self, db, compaction, snapshots, new_file_number):
-        self._job_seq += 1
+        # Job ids come from a PROCESS-WIDE counter: the factory builds one
+        # executor per compaction, and concurrent jobs with per-executor
+        # counters collided on the same job dir (each deleting the
+        # other's params/results mid-flight).
+        self._job_seq = next(_job_counter)
         job_root = self.job_root or os.path.join(db.dbname, "dcompact")
         job_dir = os.path.join(
             job_root, f"job-{self._job_seq:05d}", "att-00"

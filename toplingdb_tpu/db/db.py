@@ -267,7 +267,10 @@ class DB:
         }
         from toplingdb_tpu.db.blob import BlobSource
 
-        self.blob_source = BlobSource(env, dbname)
+        self.blob_source = BlobSource(
+            env, dbname, blob_cache=getattr(options, "blob_cache", None),
+            open_limit=getattr(options, "blob_file_open_limit", 256),
+            statistics=options.statistics)
         self.snapshots = SnapshotList()
         self._mutex = threading.RLock()
         self._writers: list[_Writer] = []  # FIFO write queue (leader = [0])
@@ -1427,23 +1430,35 @@ class DB:
         """Point lookup (reference DBImpl::GetImpl, db_impl.cc:2079).
         Returns None if not found. A wide-column entity presents as its
         anonymous default column (reference Get-on-entity semantics,
-        db/wide/wide_columns_helper) — use get_entity for every column."""
-        v = self._get_impl_entry(key, opts, cf)
-        if v is not None and v[:1] == b"\x00":
-            from toplingdb_tpu.db.wide_columns import default_column_of
+        db/wide/wide_columns_helper) — use get_entity for every column.
+        Entity detection is by the DEDICATED kTypeWideColumnEntity-style
+        value type, so plain binary values are never reinterpreted;
+        Options.legacy_wide_column_unwrap re-enables the old magic-prefix
+        sniff for databases written before the dedicated type existed."""
+        v, is_entity = self._get_impl_entry(key, opts, cf)
+        if v is not None:
+            if is_entity:
+                from toplingdb_tpu.db.wide_columns import default_column_of
 
-            return default_column_of(v)
+                return default_column_of(v)
+            if (v[:1] == b"\x00"
+                    and getattr(self.options, "legacy_wide_column_unwrap",
+                                False)):
+                from toplingdb_tpu.db.wide_columns import default_column_of
+
+                return default_column_of(v)
         return v
 
     def _get_impl_entry(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
-                        cf=None, record_trace: bool = True) -> bytes | None:
+                        cf=None, record_trace: bool = True):
+        """Returns (value_or_None, is_wide_column_entity)."""
         self._check_open()
         if record_trace:
             tr = self._op_tracer
             if tr is not None:
                 tr.record_get(key)
         if self.icmp.user_comparator.timestamp_size:
-            return self._get_with_ts(key, opts, cf)
+            return self._get_with_ts(key, opts, cf), False
         self._check_read_ts(opts)
         cfd = self._cf_data(cf)
         snap_seq = (
@@ -1456,12 +1471,13 @@ class DB:
         # GIL-released C call (reference GetImpl -> Version::Get ->
         # BlockBasedTable::Get). Anything the Python state machine must
         # see (merge operands, single-delete in SSTs, blob indexes, range
-        # tombstones, perf-context accounting) falls through below.
+        # tombstones, wide-column entities, perf-context accounting)
+        # falls through below.
         handled, val, src = self._native_get(cfd, key, snap_seq, opts)
         if handled:
             if st_on:
                 self._record_get_stats(t0, val, src)
-            return val
+            return val, False
         ctx = GetContext(
             key, snap_seq, self.options.merge_operator,
             blob_resolver=self.blob_source.get,
@@ -1473,14 +1489,14 @@ class DB:
                 val = ctx.result()
                 if st_on:
                     self._record_get_stats(t0, val, "mem")
-                return val
+                return val, ctx.result_is_entity
         # 2. SST files, newest data first.
         version = self.versions.cf_current(cfd.handle.id)
         hit_level = self._walk_sst_chain(version, key, snap_seq, ctx)
         val = ctx.result()
         if st_on:
             self._record_get_stats(t0, val, hit_level)
-        return val
+        return val, ctx.result_is_entity
 
     def _record_get_stats(self, t0: float, val, src) -> None:
         """Read-path ticker family (reference MEMTABLE_HIT/GET_HIT_L*,
@@ -1758,8 +1774,15 @@ class DB:
                 # No tracer record: the OP_MULTIGET record above already
                 # covers this key (a second OP_GET would double it on
                 # replay).
-                out[i] = self._get_impl_entry(keys[i], pinned_opts, cf,
-                                              record_trace=False)
+                v, is_entity = self._get_impl_entry(keys[i], pinned_opts,
+                                                    cf, record_trace=False)
+                if v is not None and is_entity:
+                    from toplingdb_tpu.db.wide_columns import (
+                        default_column_of,
+                    )
+
+                    v = default_column_of(v)
+                out[i] = v
         return True, out
 
     def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,
@@ -1775,7 +1798,10 @@ class DB:
         self._check_read_ts(opts)
         t_mg = time.perf_counter() if self.stats is not None else 0.0
         res = self._multi_get_impl(keys, opts, cf)
-        if any(v is not None and v[:1] == b"\x00" for v in res):
+        # Entities were already unwrapped per key by their typed fallback
+        # resolution; the magic sniff survives only behind the legacy gate.
+        if getattr(self.options, "legacy_wide_column_unwrap", False) \
+                and any(v is not None and v[:1] == b"\x00" for v in res):
             from toplingdb_tpu.db.wide_columns import default_column_of
 
             res = [v if v is None else default_column_of(v) for v in res]
@@ -1858,7 +1884,7 @@ class DB:
                     version, k, snap_seq, ctxs[k], tombs_for),
                 list(live),
             ))
-            return [ctxs[k].result() for k in keys]
+            return [self._ctx_plain_result(ctxs[k]) for k in keys]
         if live:
             per_file: dict[int, list[bytes]] = {}
             for k in live:
@@ -1889,17 +1915,32 @@ class DB:
                         del live[k]
         for ctx in live.values():
             ctx.finish()
-        return [ctxs[k].result() for k in keys]
+        return [self._ctx_plain_result(ctxs[k]) for k in keys]
+
+    @staticmethod
+    def _ctx_plain_result(ctx):
+        """GetContext result for a PLAIN Get: entities present as their
+        default column (the typed unwrap; reference Get-on-entity)."""
+        v = ctx.result()
+        if v is not None and ctx.result_is_entity:
+            from toplingdb_tpu.db.wide_columns import default_column_of
+
+            return default_column_of(v)
+        return v
 
     def key_exists(self, key: bytes, opts: ReadOptions = _DEFAULT_READ) -> bool:
         return self.get(key, opts) is not None
 
     def put_entity(self, key: bytes, columns: dict[bytes, bytes],
                    opts: WriteOptions = _DEFAULT_WRITE, cf=None) -> None:
-        """Wide-column write (reference DB::PutEntity)."""
+        """Wide-column write under the DEDICATED entity value type
+        (reference DB::PutEntity → kTypeWideColumnEntity)."""
         from toplingdb_tpu.db.wide_columns import encode_entity
 
-        self.put(key, encode_entity(columns), opts, cf=cf)
+        b = WriteBatch()
+        b.put_entity(self._ts_key(key, None), encode_entity(columns),
+                     cf=self._cf_id(cf))
+        self.write(b, opts)
 
     def get_entity(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
                    cf=None) -> dict[bytes, bytes] | None:
@@ -1914,7 +1955,7 @@ class DB:
                  cf=None):
         """Point lookup WITHOUT wide-column default-column unwrapping
         (get_entity needs the full encoding)."""
-        return self._get_impl_entry(key, opts, cf)
+        return self._get_impl_entry(key, opts, cf)[0]
 
     def get_merge_operands(self, key: bytes,
                            opts: ReadOptions = _DEFAULT_READ,
@@ -2014,6 +2055,8 @@ class DB:
                 ),
                 excluded_ranges=self._excluded_for(opts),
                 read_ts=opts.timestamp,
+                legacy_wce=bool(getattr(
+                    self.options, "legacy_wide_column_unwrap", False)),
             )
             if opts.snapshot is None:
                 # Refresh re-reads at the LATEST sequence; snapshot-pinned

@@ -21,7 +21,8 @@ class DBIter:
                  pinned=None, blob_resolver=None,
                  prefix_extractor=None, prefix_same_as_start: bool = False,
                  excluded_ranges: tuple = (),
-                 read_ts: int | None = None):
+                 read_ts: int | None = None,
+                 legacy_wce: bool = False):
         self._blob_resolver = blob_resolver
         # `pinned` keeps the source Version (and anything else) alive for the
         # iterator's lifetime so obsolete-file GC cannot delete SSTs that
@@ -58,6 +59,8 @@ class DBIter:
             if (self._ts_sz and read_ts is not None) else None
         )
         self._key_full: bytes | None = None
+        self._entry_type: int | None = None  # ValueType of current entry
+        self._legacy_wce = legacy_wce  # magic-sniff gate (pre-type DBs)
 
     def refresh(self) -> None:
         """Rebind to the DB's CURRENT state (reference Iterator::Refresh):
@@ -94,7 +97,7 @@ class DBIter:
     def value(self) -> bytes:
         assert self._valid
         v = self._value
-        if v[:1] == b"\x00":
+        if self._entry_is_entity():
             # Wide-column entity: present the anonymous default column
             # (reference iterator-over-entity semantics); columns() gives
             # the full set.
@@ -103,14 +106,25 @@ class DBIter:
             return default_column_of(v)
         return v
 
+    def _entry_is_entity(self) -> bool:
+        """Typed detection (kTypeWideColumnEntity role); the magic sniff
+        survives only behind the legacy gate for pre-type databases."""
+        if self._entry_type == ValueType.WIDE_COLUMN_ENTITY:
+            return True
+        return self._legacy_wce and self._value[:1] == b"\x00"
+
     def columns(self) -> dict[bytes, bytes]:
         """All columns of the current entry (reference
         Iterator::columns(): a plain value presents as the anonymous
         default column)."""
         assert self._valid
-        from toplingdb_tpu.db.wide_columns import decode_entity
+        if self._entry_is_entity():
+            from toplingdb_tpu.db.wide_columns import decode_entity
 
-        return decode_entity(self._value)
+            return decode_entity(self._value)
+        from toplingdb_tpu.db.wide_columns import DEFAULT_COLUMN
+
+        return {DEFAULT_COLUMN: self._value}
 
     def timestamp(self) -> int | None:
         """User timestamp of the current entry (ts-comparator DBs only)."""
@@ -340,17 +354,22 @@ class DBIter:
                 skip_key = vkey  # key is dead; skip all its older versions
                 self._iter.next()
                 continue
-            if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+            if t in (ValueType.VALUE, ValueType.BLOB_INDEX,
+                     ValueType.WIDE_COLUMN_ENTITY):
                 v = self._iter.value()
                 if t == ValueType.BLOB_INDEX:
                     v = self._resolve_blob(v)
+                    t = ValueType.VALUE
                 if merge_key is not None:
-                    self._emit_merge(merge_key, v, operands)
+                    self._emit_merge(merge_key, v, operands,
+                                     base_is_entity=(
+                                         t == ValueType.WIDE_COLUMN_ENTITY))
                     return
                 self._valid = True
                 self._key = vkey
                 self._key_full = uk
                 self._value = v
+                self._entry_type = t
                 return
             if t == ValueType.MERGE:
                 if self._ts_sz:
@@ -375,12 +394,25 @@ class DBIter:
             raise Corruption("blob index found but no blob resolver")
         return self._blob_resolver(idx)
 
-    def _emit_merge(self, uk: bytes, base: bytes | None, operands: list[bytes]) -> None:
+    def _emit_merge(self, uk: bytes, base: bytes | None,
+                    operands: list[bytes],
+                    base_is_entity: bool = False) -> None:
         # operands collected newest→oldest. (ts mode never reaches here.)
         self._valid = True
         self._key = uk
         self._key_full = uk
-        self._value = self._merge_op.full_merge(uk, base, list(reversed(operands)))
+        ops = list(reversed(operands))
+        if base_is_entity:
+            # Merge folds against the entity's default column; the entry
+            # stays an entity (reference wide_columns_helper semantics).
+            from toplingdb_tpu.db.wide_columns import merge_into_entity
+
+            self._value = merge_into_entity(
+                base, lambda b: self._merge_op.full_merge(uk, b, ops))
+            self._entry_type = ValueType.WIDE_COLUMN_ENTITY
+        else:
+            self._value = self._merge_op.full_merge(uk, base, ops)
+            self._entry_type = ValueType.VALUE
 
     def _find_prev_user_entry(self) -> None:
         """Position at the newest visible, live entry of the user key at or
@@ -449,10 +481,12 @@ class DBIter:
             return False
         if t_ == ValueType.BLOB_INDEX:
             val = self._resolve_blob(val)
+            t_ = ValueType.VALUE
         self._valid = True
         self._key = vkey
         self._key_full = full
         self._value = val
+        self._entry_type = t_
         return True
 
     def _resolve_backward(self, uk: bytes, entries: list[tuple[int, int, bytes]]) -> bool:
@@ -465,16 +499,21 @@ class DBIter:
                     self._emit_merge(uk, None, operands)
                     return True
                 return False
-            if t in (ValueType.VALUE, ValueType.BLOB_INDEX):
+            if t in (ValueType.VALUE, ValueType.BLOB_INDEX,
+                     ValueType.WIDE_COLUMN_ENTITY):
                 if t == ValueType.BLOB_INDEX:
                     val = self._resolve_blob(val)
+                    t = ValueType.VALUE
                 if operands:
-                    self._emit_merge(uk, val, operands)
+                    self._emit_merge(uk, val, operands,
+                                     base_is_entity=(
+                                         t == ValueType.WIDE_COLUMN_ENTITY))
                 else:
                     self._valid = True
                     self._key = uk
                     self._key_full = uk
                     self._value = val
+                    self._entry_type = t
                 return True
             if t == ValueType.MERGE:
                 if self._merge_op is None:

@@ -36,6 +36,7 @@ class ValueType(enum.IntEnum):
     SINGLE_DELETION = 0x7
     RANGE_DELETION = 0xF    # DeleteRange tombstone
     BLOB_INDEX = 0x11       # value is a pointer into a blob file
+    WIDE_COLUMN_ENTITY = 0x16  # value is a wide-column entity encoding
     MAX = 0x7F
 
 

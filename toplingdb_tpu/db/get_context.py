@@ -38,6 +38,7 @@ class GetContext:
         self.blob_resolver = blob_resolver  # BLOB_INDEX payload → real value
         self.state = GetState.NOT_FOUND
         self.value: bytes | None = None
+        self.result_is_entity = False  # value is a wide-column encoding
         self.operands: list[bytes] = []   # collected newest→oldest
         self.max_covering_tombstone_seq = 0
         self.found_final_value = False
@@ -86,6 +87,23 @@ class GetContext:
             else:
                 self.state = GetState.FOUND
                 self.value = value
+            self.found_final_value = True
+            return False
+        if t == ValueType.WIDE_COLUMN_ENTITY:
+            # A put of a wide-column entity (reference
+            # kTypeWideColumnEntity + wide_columns_helper): merge chains
+            # fold against the entity's DEFAULT column, and the result
+            # stays an entity with the default column replaced.
+            if self.state == GetState.MERGE and not self.collect_operands:
+                from toplingdb_tpu.db.wide_columns import merge_into_entity
+
+                self.state = GetState.FOUND
+                self.value = merge_into_entity(
+                    value, lambda base: self._fold(base))
+            else:
+                self.state = GetState.FOUND
+                self.value = value
+            self.result_is_entity = True
             self.found_final_value = True
             return False
         if t in (ValueType.DELETION, ValueType.SINGLE_DELETION):

@@ -3,10 +3,14 @@ gated by TOPLINGDB_WITH_WIDE_COLUMNS).
 
 An entity is a set of named columns serialized into one value:
   varint32 num_columns | per column: lp(name) lp(value)
-sorted by name; the anonymous default column uses name b"". put_entity /
-get_entity wrap the ordinary KV API (the reference stores entities under
-kTypeWideColumnEntity; ours uses a value-encoding wrapper, which keeps every
-other subsystem — compaction, blobs, CFs — unchanged).
+sorted by name; the anonymous default column uses name b"". Entities are
+stored under the DEDICATED ValueType.WIDE_COLUMN_ENTITY (the reference's
+kTypeWideColumnEntity, db/dbformat.h): plain binary values can never be
+reinterpreted as entities. The value payload keeps the magic prefix for
+self-description; detection is by TYPE (Options.legacy_wide_column_unwrap
+re-enables the pre-type magic sniff for old databases). Entities flow
+through compaction as puts, annihilate with SingleDelete, and merge
+chains fold against the default column (merge_into_entity).
 """
 
 from __future__ import annotations
@@ -66,6 +70,18 @@ def get_entity(db, key: bytes, *, opts=None, cf=None) -> dict[bytes, bytes] | No
     """Thin alias for DB.get_entity."""
     kw = {"opts": opts} if opts is not None else {}
     return db.get_entity(key, cf=cf, **kw)
+
+
+def merge_into_entity(encoded: bytes, fold_fn) -> bytes:
+    """Apply a merge fold to an entity's DEFAULT column (reference
+    MergeHelper-over-kTypeWideColumnEntity semantics,
+    db/wide/wide_columns_helper): fold_fn receives the current default
+    column value (or None when the entity has no default column) and
+    returns the merged bytes; the result is the entity re-encoded with
+    the default column replaced."""
+    cols = dict(decode_entity(encoded))
+    cols[DEFAULT_COLUMN] = fold_fn(cols.get(DEFAULT_COLUMN))
+    return encode_entity(cols)
 
 
 def default_column_of(value: bytes) -> bytes:

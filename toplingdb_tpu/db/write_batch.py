@@ -58,6 +58,14 @@ class WriteBatch:
     def merge(self, key: bytes, value: bytes, cf: int = 0) -> None:
         self._add_record(ValueType.MERGE, cf, key, value)
 
+    def put_entity(self, key: bytes, encoded_entity: bytes,
+                   cf: int = 0) -> None:
+        """Wide-column entity record (reference kTypeWideColumnEntity,
+        db/write_batch.cc WriteBatch::PutEntity) — the DEDICATED value
+        type makes plain binary values unambiguous (no magic sniffing)."""
+        self._add_record(ValueType.WIDE_COLUMN_ENTITY, cf, key,
+                         encoded_entity)
+
     def delete_range(self, begin: bytes, end: bytes, cf: int = 0) -> None:
         self._add_record(ValueType.RANGE_DELETION, cf, begin, end)
 
@@ -145,7 +153,9 @@ class WriteBatch:
             if t & _CF_FLAG and t != ValueType.LOG_DATA:
                 t &= ~_CF_FLAG
                 cf, off = coding.decode_varint32(rep, off)
-            if t in (ValueType.VALUE, ValueType.MERGE, ValueType.RANGE_DELETION):
+            if t in (ValueType.VALUE, ValueType.MERGE,
+                     ValueType.RANGE_DELETION,
+                     ValueType.WIDE_COLUMN_ENTITY):
                 k, off = coding.get_length_prefixed_slice(rep, off)
                 v, off = coding.get_length_prefixed_slice(rep, off)
                 yield cf, t, k, v

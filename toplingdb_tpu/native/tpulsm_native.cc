@@ -2249,7 +2249,8 @@ static int64_t wb_wire_apply(const uint8_t* rep, int64_t len,
                              uint64_t first_seq, int64_t* out,
                              InsertFn&& ins) {
   static const uint8_t kValue = 0x1, kDelete = 0x0, kMerge = 0x2,
-                       kSingleDelete = 0x7, kLogData = 0x3;
+                       kSingleDelete = 0x7, kLogData = 0x3,
+                       kWideEntity = 0x16;
   if (len < 12) return -4;
   const uint8_t* end = rep + len;
   uint32_t hdr_count = (uint32_t)rep[8] | ((uint32_t)rep[9] << 8) |
@@ -2267,7 +2268,7 @@ static int64_t wb_wire_apply(const uint8_t* rep, int64_t len,
       const uint8_t* k = p;
       p += klen;
       const uint8_t* v = p;
-      if (t == kValue || t == kMerge) {
+      if (t == kValue || t == kMerge || t == kWideEntity) {
         p = get_varint32(p, end, &vlen);
         if (!p || p + vlen > end) return -4;
         v = p;

@@ -100,6 +100,21 @@ class Options:
     # enable_blob_garbage_collection / blob_garbage_collection_age_cutoff).
     enable_blob_garbage_collection: bool = False
     blob_garbage_collection_age_cutoff: float = 0.25
+    # Blob VALUE cache (reference blob_cache option + BlobSource tier,
+    # db/blob/blob_source.h): a utils.cache.Cache instance, or an int
+    # capacity in bytes (an LRUCache is built), or None (no caching —
+    # every Get re-reads the blob file).
+    blob_cache: object | None = None
+    # Cap on concurrently OPEN blob file readers (reference
+    # blob_file_cache.cc holds readers in a capacity-bounded cache).
+    blob_file_open_limit: int = 256
+
+    # -- wide columns ---------------------------------------------------
+    # Entities carry the dedicated kTypeWideColumnEntity-style value type;
+    # this gate re-enables the pre-type magic-prefix sniff for databases
+    # written by older versions (plain binary values starting with
+    # \x00WCE1 would otherwise present as entities on those DBs).
+    legacy_wide_column_unwrap: bool = False
 
     # -- observability --------------------------------------------------
     # Periodic ticker snapshots for DB.get_stats_history (reference

@@ -147,6 +147,25 @@ class Bench:
                 hits += 1
         return n
 
+    def bench_fillrandomblob(self, n):
+        """fillrandom with blob separation on: every value >= min_blob_size
+        lands in .blob files (reference db_bench --enable_blob_files)."""
+        self.options.enable_blob_files = True
+        if self.args.value_size < self.options.min_blob_size:
+            self.options.min_blob_size = max(1, self.args.value_size // 2)
+        if self.options.blob_cache is None and self.args.blob_cache_size:
+            self.options.blob_cache = self.args.blob_cache_size
+        self.open_db(fresh=True)
+        return self.bench_fillrandom(n)
+
+    def bench_readrandomblob(self, n):
+        """readrandom against blob-separated values — exercises the
+        BlobSource value cache + file-reader LRU (reference
+        db/blob/blob_source.h tier)."""
+        self.db.flush()
+        self.db.wait_for_compactions()
+        return self.bench_readrandom(n)
+
     def bench_seekrandom(self, n):
         ro = ReadOptions()
         it = self.db.new_iterator(ro)
@@ -423,6 +442,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--use-existing-db", action="store_true")
     ap.add_argument("--statistics", action="store_true")
     ap.add_argument("--print-stats", action="store_true")
+    ap.add_argument("--blob-cache-size", type=int, default=32 << 20,
+                    help="BlobSource value cache bytes for *blob workloads")
     return ap
 
 

@@ -92,6 +92,11 @@ TXN_PREPARE = "txn.prepare"
 TXN_LOCK_TIMEOUT = "txn.lock.timeout"
 TXN_DEADLOCK = "txn.deadlock"
 # -- blob files ------------------------------------------------------
+BLOB_DB_CACHE_HIT = "blob.db.cache.hit"
+BLOB_DB_CACHE_MISS = "blob.db.cache.miss"
+BLOB_DB_CACHE_BYTES_READ = "blob.db.cache.bytes.read"
+BLOB_DB_CACHE_BYTES_WRITE = "blob.db.cache.bytes.write"
+BLOB_DB_BLOB_FILE_BYTES_READ = "blob.db.blob.file.bytes.read"
 BLOB_DB_NUM_KEYS_READ = "blob.db.num.keys.read"
 BLOB_DB_NUM_KEYS_WRITTEN = "blob.db.num.keys.written"
 BLOB_DB_BYTES_READ = "blob.db.bytes.read"
