@@ -1,0 +1,51 @@
+"""Budgeted runs of the greybox fuzz harness (tools/fuzz_native.py — the
+reference's fuzz/ targets role) + regression for its first finding."""
+
+import os
+
+import pytest
+
+from toplingdb_tpu import native
+from toplingdb_tpu.tools import fuzz_native as fz
+
+pytestmark = pytest.mark.skipif(native.lib() is None,
+                                reason="native library unavailable")
+
+
+@pytest.mark.parametrize("target,runs", [
+    ("wb", 400), ("block", 400), ("scan", 200), ("manifest", 25),
+])
+def test_fuzz_target_budgeted(target, runs, tmp_path):
+    import random
+
+    rng = random.Random(99)
+    corpus = fz.Corpus(str(tmp_path / target))
+    findings = fz.TARGETS[target](rng, runs, corpus)
+    assert findings == 0
+    # The novelty search must discover more than one behavior class.
+    assert len(corpus.signatures) >= 2
+    # Corpus persistence: interesting inputs landed on disk for reuse.
+    assert os.listdir(str(tmp_path / target))
+
+
+def test_manifest_garbage_head_fails_open(tmp_path):
+    """fuzz_native's first finding: an all-garbage MANIFEST must fail the
+    open with Corruption — NOT 'recover' an empty DB (silent data loss).
+    The log reader's torn-tail tolerance only applies after a good
+    snapshot record (reference VersionSet::Recover field checks)."""
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils.status import Corruption
+
+    d = str(tmp_path / "db")
+    db = DB.open(d, Options(create_if_missing=True))
+    for i in range(100):
+        db.put(b"k%03d" % i, b"v")
+    db.flush()
+    db.close()
+    cur = open(os.path.join(d, "CURRENT")).read().strip()
+    mpath = os.path.join(d, cur)
+    raw = open(mpath, "rb").read()
+    open(mpath, "wb").write(b"\xff" * len(raw))
+    with pytest.raises(Corruption):
+        DB.open(d, Options())

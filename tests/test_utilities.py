@@ -870,7 +870,10 @@ def test_persistent_cache_capacity_eviction(tmp_path):
     from toplingdb_tpu.utils.persistent_cache import PersistentCache
 
     pdir = str(tmp_path / "pc2")
-    pc = PersistentCache(pdir, capacity_bytes=32 * 1024, file_size=8 * 1024)
+    # Sync + uncompressed: this test pins the file-granularity EVICTION
+    # mechanics (write-behind/compression have their own tests).
+    pc = PersistentCache(pdir, capacity_bytes=32 * 1024, file_size=8 * 1024,
+                         compress=False, write_behind=False)
     for i in range(200):
         pc.insert(b"k%04d" % i, b"x" * 500)
     assert pc.usage() <= 40 * 1024  # capacity + one in-flight file
@@ -886,7 +889,8 @@ def test_persistent_cache_ignores_corrupt_tail(tmp_path):
     from toplingdb_tpu.utils.persistent_cache import PersistentCache
 
     pdir = str(tmp_path / "pc3")
-    pc = PersistentCache(pdir, capacity_bytes=1 << 20)
+    pc = PersistentCache(pdir, capacity_bytes=1 << 20, compress=False,
+                         write_behind=False)
     pc.insert(b"good", b"G" * 100)
     pc.insert(b"torn", b"T" * 100)
     pc.close()
@@ -898,6 +902,68 @@ def test_persistent_cache_ignores_corrupt_tail(tmp_path):
     assert pc2.lookup(b"good") == b"G" * 100
     assert pc2.lookup(b"torn") is None
     pc2.close()
+
+
+def test_persistent_cache_write_behind_and_compression(tmp_path):
+    """The writeback thread drains the insert queue; compressed records
+    round-trip; pending entries are visible to lookups immediately."""
+    from toplingdb_tpu.utils.persistent_cache import PersistentCache
+
+    pdir = str(tmp_path / "pc4")
+    pc = PersistentCache(pdir, capacity_bytes=1 << 20, compress=True,
+                         write_behind=True)
+    val = b"compress-me " * 100
+    for i in range(50):
+        pc.insert(b"wb%03d" % i, val)
+    # Visible BEFORE the writeback lands (pending-queue hit).
+    assert pc.lookup(b"wb000") == val
+    pc.flush()
+    st = pc.stats()
+    assert st["pending_bytes"] == 0 and st["inserts"] == 50
+    if st["compressed"]:
+        # 50 x 1.2KB highly-compressible records must land well under raw.
+        assert st["bytes_written"] < 50 * len(val) // 2
+    assert pc.lookup(b"wb042") == val
+    pc.close()
+    # Compressed records survive restart.
+    pc2 = PersistentCache(pdir, capacity_bytes=1 << 20)
+    assert pc2.lookup(b"wb042") == val
+    pc2.close()
+
+
+def test_persistent_cache_access_lru_eviction(tmp_path):
+    """Eviction drops the least-recently-ACCESSED file, not the oldest:
+    keys in the oldest file stay alive while they keep getting hit."""
+    from toplingdb_tpu.utils.persistent_cache import PersistentCache
+
+    pc = PersistentCache(str(tmp_path / "pc5"), capacity_bytes=24 * 1024,
+                         file_size=8 * 1024, compress=False,
+                         write_behind=False)
+    # File 0 fills with hot keys; keep touching one of them as later
+    # files push usage past capacity.
+    for i in range(14):
+        pc.insert(b"hot%03d" % i, b"h" * 500)
+    assert pc.lookup(b"hot000") is not None
+    for i in range(80):
+        pc.insert(b"cold%03d" % i, b"c" * 500)
+        pc.lookup(b"hot000")  # keep file 0 recent
+    assert pc.lookup(b"hot000") is not None, "hot file evicted despite use"
+    pc.close()
+
+
+def test_persistent_cache_stats_surface(tmp_path):
+    from toplingdb_tpu.utils.persistent_cache import PersistentCache
+
+    pc = PersistentCache(str(tmp_path / "pc6"), capacity_bytes=1 << 20,
+                         write_behind=False)
+    pc.insert(b"a", b"x" * 200)
+    assert pc.lookup(b"a") is not None
+    assert pc.lookup(b"zz") is None
+    st = pc.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    assert 0 < st["hit_rate"] < 1
+    assert st["bytes_written"] > 0 and st["files"] >= 1
+    pc.close()
 
 
 def test_db_with_block_cache_and_persistent_tier(tmp_db_path, tmp_path):

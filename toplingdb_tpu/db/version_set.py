@@ -350,7 +350,10 @@ class VersionSet:
         dropped: set[int] = set()
         have_comparator = None
         next_cf_hint = 0
+        have_log_number = have_next_file = have_last_seq = False
+        n_records = 0
         for rec in reader.records():
+            n_records += 1
             edit = VersionEdit.decode(rec)
             cf = edit.column_family
             if edit.column_family_add is not None:
@@ -366,12 +369,15 @@ class VersionSet:
                 have_comparator = edit.comparator
             if edit.log_number is not None:
                 self.log_number = edit.log_number
+                have_log_number = True
             if edit.prev_log_number is not None:
                 self.prev_log_number = edit.prev_log_number
             if edit.next_file_number is not None:
                 self._next_file_number = edit.next_file_number
+                have_next_file = True
             if edit.last_sequence is not None:
                 self.last_sequence = edit.last_sequence
+                have_last_seq = True
             if edit.new_files or edit.deleted_files:
                 builders.setdefault(
                     cf, VersionBuilder(Version(self.icmp, self.num_levels))
@@ -380,6 +386,19 @@ class VersionSet:
             raise Corruption(
                 f"comparator mismatch: DB created with {have_comparator}, "
                 f"opened with {self.icmp.user_comparator.name()}"
+            )
+        # A readable manifest MUST yield the descriptor fields (reference
+        # VersionSet::Recover's no-meta-*-entry checks, version_set.cc):
+        # a corrupt head otherwise "recovers" an EMPTY DB — the log reader
+        # treats undecodable bytes as a torn tail, which is only valid
+        # AFTER a good snapshot record. (Found by tools/fuzz_native.py.)
+        if not (have_next_file and have_last_seq and have_log_number):
+            missing = [name for ok, name in (
+                (have_next_file, "next-file"), (have_last_seq, "last-seq"),
+                (have_log_number, "log-number")) if not ok]
+            raise Corruption(
+                f"manifest {path} yields no {'/'.join(missing)} entry "
+                f"({n_records} records decoded): corrupt descriptor head"
             )
         builders.setdefault(0, VersionBuilder(Version(self.icmp, self.num_levels)))
         cf_names.setdefault(0, "default")
