@@ -518,6 +518,26 @@ def main():
 
         db_path_rows(detail, n_db)
 
+        # Range-axis weak-scaling of the distributed GC step (VERDICT r04
+        # item 10): a subprocess because virtual device counts must be set
+        # before the jax backend exists. Failure just drops the row.
+        import subprocess as _sp
+
+        try:
+            out = _sp.run(
+                [sys.executable, "-m",
+                 "toplingdb_tpu.parallel.scaling_probe",
+                 "--rows-per-device", "32768", "--devices", "8",
+                 "--repeats", "2"],
+                capture_output=True, timeout=600, cwd=os.path.dirname(
+                    os.path.abspath(__file__)))
+            if out.returncode == 0 and out.stdout:
+                detail["range_weak_scaling"] = json.loads(
+                    out.stdout.decode().strip().splitlines()[-1]
+                )["weak_scaling"]
+        except Exception as e:  # noqa: BLE001
+            detail["range_weak_scaling_error"] = str(e)[:120]
+
     # LAST-CHANCE tunnel retry: the DB rows took minutes more — if the
     # accelerator is back now, re-measure the HEADLINE on it (the input
     # SSTs still exist; host-sort mode never initialized a jax backend,
@@ -534,19 +554,49 @@ def main():
         if ok:
             print("jax backend came back late; re-measuring headline on "
                   "the accelerator", file=sys.stderr, flush=True)
-            dt_l, stats_l, _, run_times_l = time_compaction(
-                env, base, icmp, metas, topts, topts, device, runs, 8000)
-            mbps = raw_bytes / dt_l / 1e6
-            tpu_fallback = False
-            detail["tpu_unreachable_cpu_fallback"] = False
-            detail["headline_source"] = "tpu-late-probe"
-            # The non-headline rows above were measured BEFORE the tunnel
-            # came back (ADVICE r04): record their provenance explicitly
-            # instead of letting the global flag claim an all-TPU run.
-            detail["variant_rows_source"] = "cpu-fallback"
-            detail["headline_run_times_s"] = run_times_l
-            detail["wall_s"] = round(dt_l, 3)
-            fill_phase_detail(detail, stats_l)
+            # A brief tunnel window must still yield a RECORDED device
+            # row: one quick single run lands first (compile + measure,
+            # ~seconds); the full best-of-N follows while the window
+            # holds.
+            try:
+                t_q = time.time()
+                dt_q, stats_q, _, _ = time_compaction(
+                    env, base, icmp, metas, topts, topts, device, 1, 7800)
+                detail["headline_quick_tpu_MBps"] = round(
+                    raw_bytes / dt_q / 1e6, 2)
+                detail["headline_quick_tpu_total_s"] = round(
+                    time.time() - t_q, 2)  # incl. compile: window budget
+            except Exception as e:  # noqa: BLE001
+                # Window closed during the quick run: keep the CPU record.
+                detail["tpu_late_retry_error"] = repr(e)[:160]
+                dt_q = None
+            if dt_q is not None:
+                # Quick row is banked; the full best-of-N upgrades it if
+                # the window holds — a drop mid-run must not lose either
+                # the quick device row or the whole record.
+                mbps = raw_bytes / dt_q / 1e6
+                tpu_fallback = False
+                detail["tpu_unreachable_cpu_fallback"] = False
+                detail["headline_source"] = "tpu-late-probe-quick"
+                # The non-headline rows above were measured BEFORE the
+                # tunnel came back (ADVICE r04): record their provenance
+                # explicitly instead of letting the global flag claim an
+                # all-TPU run.
+                detail["variant_rows_source"] = "cpu-fallback"
+                detail["headline_run_times_s"] = [round(dt_q, 3)]
+                detail["wall_s"] = round(dt_q, 3)
+                fill_phase_detail(detail, stats_q)
+                try:
+                    dt_l, stats_l, _, run_times_l = time_compaction(
+                        env, base, icmp, metas, topts, topts, device,
+                        runs, 8000)
+                    mbps = raw_bytes / dt_l / 1e6
+                    detail["headline_source"] = "tpu-late-probe"
+                    detail["headline_run_times_s"] = run_times_l
+                    detail["wall_s"] = round(dt_l, 3)
+                    fill_phase_detail(detail, stats_l)
+                except Exception as e:  # noqa: BLE001
+                    detail["tpu_full_rerun_error"] = repr(e)[:160]
         else:
             bp.redirect_to_cpu_backend()
 

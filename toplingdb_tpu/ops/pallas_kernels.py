@@ -173,3 +173,155 @@ def shared_prefix_lengths(key_bytes: np.ndarray,
         cap[0] = 0
         out = np.minimum(out, cap).astype(np.int32)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Segmented-merge kernel: BITONIC pairwise merge of two presorted runs.
+#
+# The lax formulation of the device segmented merge (_merge_runs_perm in
+# compaction_kernels) ranks rows with per-row binary searches — dynamic
+# gathers that XLA lowers well but that have no legal Mosaic lowering
+# (TPU vector gathers are not expressible in a Pallas kernel). The
+# kernelizable formulation is the BITONIC merge network: concat(A,
+# reverse(B)) is a bitonic sequence, and each of the log2(P) stages is a
+# compare-exchange at a STATIC stride — pure reshapes + elementwise
+# min/max on the VPU, exactly what Mosaic lowers. One kernel invocation
+# holds the whole pair in VMEM (cap: _BITONIC_MAX_ROWS), so the grid is
+# trivial; larger pairs stay on the lax path.
+#
+# Keys are (hi, lo) u32 word columns (the packed internal-key order the
+# device sort already uses: bytewise-ascending user key, then inverted
+# (seq<<8|type)); `perm` rides along so the caller gets the merge
+# permutation, and ties keep A-before-B (stability) because the compare
+# treats equal keys as "no exchange" and A rows precede B rows.
+# ---------------------------------------------------------------------------
+
+_BITONIC_MAX_ROWS = 1 << 17  # 128K rows x (2 key cols + perm) fits VMEM
+
+
+def _bitonic_merge_kernel(*refs, n_stages, n_cols):
+    # refs = (col_0..col_{k-1}, tiebreak, perm) inputs then the outputs.
+    ins, outs = refs[: n_cols + 2], refs[n_cols + 2:]
+    cols = [r[:] for r in ins]  # [1, P] i32 (u32 order via sign-bit XOR)
+    p = cols[0].shape[1]
+    for s in range(n_stages - 1, -1, -1):
+        stride = 1 << s
+        # [1, P] -> [P/(2*stride), 2, stride]: partner = other half.
+        halves = [c.reshape(p // (2 * stride), 2, stride) for c in cols]
+        a = [h[:, 0, :] for h in halves]
+        b = [h[:, 1, :] for h in halves]
+        # Lexicographic u32 compare over the key columns, then the
+        # ORIGINAL-INDEX tiebreak column — bitonic networks are not
+        # stable by construction; the explicit tiebreak makes equal keys
+        # come out in concat(A, B) order (perm stays pure payload).
+        swap = jnp.zeros_like(a[0], dtype=jnp.bool_)
+        tie = jnp.ones_like(a[0], dtype=jnp.bool_)
+        for c in range(n_cols):
+            swap = swap | (tie & (a[c] > b[c]))
+            tie = tie & (a[c] == b[c])
+        swap = swap | (tie & (a[n_cols] > b[n_cols]))
+        nxt = []
+        for c in range(n_cols + 2):
+            mn = jnp.where(swap, b[c], a[c])
+            mx = jnp.where(swap, a[c], b[c])
+            nxt.append(jnp.stack([mn, mx], axis=1).reshape(1, p))
+        cols = nxt
+    for o, c in zip(outs, cols):
+        o[:] = c
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_stages", "n_cols", "interpret"))
+def _bitonic_merge_impl(arrays, n_stages, n_cols, interpret):
+    from jax.experimental import pallas as pl
+
+    p = arrays[0].shape[0]
+    kern = functools.partial(_bitonic_merge_kernel, n_stages=n_stages,
+                             n_cols=n_cols)
+    return pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct((1, p), jnp.int32)] * (n_cols + 2),
+        interpret=interpret,
+    )(*[a.reshape(1, p) for a in arrays])
+
+
+_SIGN32 = np.uint32(0x80000000)
+
+
+def bitonic_merge_pair(cols_a, cols_b, interpret=None):
+    """Merge two PRESORTED runs keyed by parallel uint32 word columns
+    (lexicographic order over the column list — e.g. [key_hi, key_lo,
+    inv_hi, inv_lo] for 8B-user-key internal order); returns the
+    permutation into concat(A, B) realizing ascending merged order.
+    STABLE: equal keys come out in concat(A, B) order (an original-index
+    tiebreak column rides the network). Pads to a power of two
+    internally; len(A)+len(B) must be <= _BITONIC_MAX_ROWS.
+    Parity-tested in tests/test_pallas_kernels.py; compiled-on-TPU
+    validation is pending first tunnel contact (interpret elsewhere)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    n_cols = len(cols_a)
+    na, nb = (len(cols_a[0]), len(cols_b[0]))
+    total = na + nb
+    if total == 0:
+        return np.empty(0, np.int32)
+    if total > _BITONIC_MAX_ROWS:
+        raise ValueError(f"pair of {total} rows exceeds the VMEM budget")
+    p = 1 << (total - 1).bit_length()
+    # Bitonic input: A ascending, max-padding, then B REVERSED
+    # (descending) in the tail — ascending prefix + plateau + descending
+    # suffix stays bitonic; padding keys (u32 max) drop from the result.
+    arrays = []
+    for c in range(n_cols):
+        col = np.full(p, 0xFFFFFFFF, np.uint32)
+        col[:na] = cols_a[c]
+        if nb:
+            col[p - nb:] = cols_b[c][::-1]
+        arrays.append(col)
+    perm = np.full(p, -1, np.int32)
+    perm[:na] = np.arange(na, dtype=np.int32)
+    if nb:
+        perm[p - nb:] = np.arange(na + nb - 1, na - 1, -1, np.int32)
+    # Stability tiebreak: original concat index, pads sort last.
+    tb = np.where(perm >= 0, perm.astype(np.int64),
+                  np.int64(0x7FFFFFFF)).astype(np.uint32)
+    i32 = lambda x: (x ^ _SIGN32).astype(np.int64).astype(np.int32)
+    out = _bitonic_merge_impl(
+        tuple(jnp.asarray(i32(a)) for a in arrays)
+        + (jnp.asarray(i32(tb)), jnp.asarray(perm)),
+        int(p).bit_length() - 1, n_cols, bool(interpret),
+    )
+    merged_perm = np.asarray(out[n_cols + 1]).reshape(p)
+    return merged_perm[merged_perm >= 0][:total]
+
+
+def bitonic_merge_runs(cols, run_starts, interpret=None):
+    """Segmented merge of R presorted runs via log2(R) rounds of pairwise
+    bitonic merges (the kernel-backed twin of _merge_runs_perm's lax
+    ranking). `cols`: parallel uint32 word columns, lexicographic.
+    Returns the permutation old->sorted over the whole array."""
+    starts = list(int(s) for s in run_starts)
+    runs = [np.arange(starts[i], starts[i + 1], dtype=np.int32)
+            for i in range(len(starts) - 1)]
+    cols = [np.asarray(c, np.uint32) for c in cols]
+    while len(runs) > 1:
+        nxt = []
+        for i in range(0, len(runs) - 1, 2):
+            a, b = runs[i], runs[i + 1]
+            ab = np.concatenate([a, b])
+            if len(ab) > _BITONIC_MAX_ROWS:
+                # Pair exceeds the kernel's VMEM budget: stable host
+                # merge for this pair (the documented oversized-pair
+                # fallback; the kernel handles the rest).
+                pm = np.argsort(
+                    np.rec.fromarrays([c[ab] for c in cols]),
+                    kind="stable").astype(np.int32)
+            else:
+                pm = bitonic_merge_pair([c[a] for c in cols],
+                                        [c[b] for c in cols],
+                                        interpret=interpret)
+            nxt.append(ab[pm])
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return runs[0] if runs else np.empty(0, np.int32)
