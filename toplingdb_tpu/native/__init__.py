@@ -21,17 +21,34 @@ _lib: ctypes.CDLL | None = None
 _tried = False
 
 
-def _build() -> bool:
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        "-o", _SO + ".tmp", _SRC, "-ldl",
-    ]
+def _compile(src: str, so: str, extra_flags: list[str]) -> bool:
+    """Shared compile-to-tmp-then-swap build step (per-pid tmp name: two
+    processes may race the first build)."""
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", *extra_flags,
+           "-o", tmp, src, "-ldl"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, so)
         return True
     except (subprocess.SubprocessError, OSError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
+
+
+def _stale(so: str, src: str) -> bool:
+    try:
+        return not os.path.exists(so) or (
+            os.path.getmtime(so) < os.path.getmtime(src))
+    except OSError:
+        return True
+
+
+def _build() -> bool:
+    return _compile(_SRC, _SO, ["-std=c++17", "-pthread"])
 
 
 def lib() -> ctypes.CDLL | None:
@@ -44,10 +61,7 @@ def lib() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        need_build = not os.path.exists(_SO) or (
-            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-        )
-        if need_build and not _build():
+        if _stale(_SO, _SRC) and not _build():
             return None
         try:
             l = ctypes.CDLL(_SO)
@@ -395,6 +409,65 @@ def pylib() -> "ctypes.PyDLL | None":
         pass
     _pylib = l
     return _pylib
+
+
+_FASTGET_SRC = os.path.join(_DIR, "fastget.c")
+_fastget_mod = None
+_fastget_tried = False
+
+
+def _fastget_so_path() -> str:
+    # The interpreter's cache tag rides in the filename so an extension
+    # built under an older CPython ABI is never dlopen'd after an
+    # interpreter upgrade (layout mismatches can segfault past any
+    # except clause).
+    import sys as _sys
+
+    tag = getattr(_sys.implementation, "cache_tag", "py") or "py"
+    return os.path.join(_DIR, f"tpulsm_fastget.{tag}.so")
+
+
+def fastget():
+    """The C-extension fast path for tpulsm_getctx_get (fastget.c), or
+    None when unavailable (missing Python headers / toolchain): callers
+    keep the ctypes path. Returns the bound module's `get` callable."""
+    global _fastget_mod, _fastget_tried
+    if _fastget_mod is not None:
+        return _fastget_mod.get
+    if _fastget_tried:
+        return None
+    if lib() is None:  # resolve the native .so FIRST (it takes _lock too)
+        return None
+    with _lock:
+        if _fastget_mod is not None:
+            return _fastget_mod.get
+        if _fastget_tried:
+            return None
+        _fastget_tried = True
+        so = _fastget_so_path()
+        if _stale(so, _FASTGET_SRC):
+            import sysconfig
+
+            inc = sysconfig.get_paths().get("include")
+            if not inc or not os.path.exists(
+                    os.path.join(inc, "Python.h")):
+                return None
+            if not _compile(_FASTGET_SRC, so, [f"-I{inc}", "-O2"]):
+                return None
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader(
+                "tpulsm_fastget", so)
+            spec = importlib.util.spec_from_loader("tpulsm_fastget", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+            mod.bind(_SO)
+            _fastget_mod = mod
+            return mod.get
+        except Exception:
+            return None
 
 
 def np_u8p(arr):
