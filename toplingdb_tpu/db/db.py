@@ -141,7 +141,8 @@ class _NGetState:
     by the caller to detect memtable switches / version installs)."""
 
     __slots__ = ("mem", "imm", "version", "ctx", "fn", "out",
-                 "val_ptr", "val_cap", "_lib", "mg", "mg_arena", "fast")
+                 "val_ptr", "val_cap", "_lib", "mg", "mg_arena", "fast",
+                 "fast_mg")
 
     def __del__(self):
         lib = getattr(self, "_lib", None)
@@ -193,11 +194,12 @@ class _NGetState:
         s.val_ptr = lib.tpulsm_getctx_val(ctx)
         s.val_cap = 4096
         s._lib = lib
-        # C-extension fast call (ctypes marshaling was ~30% of a warm
-        # Get); None → the ctypes path below stays in charge.
+        # C-extension fast calls (ctypes marshaling was ~30% of a warm
+        # Get); None → the ctypes paths stay in charge.
         from toplingdb_tpu import native as _nat
 
         s.fast = _nat.fastget()
+        s.fast_mg = _nat.fastmultiget()
         return s
 
 
@@ -1714,6 +1716,15 @@ class DB:
         lib, cc = self._nget_state(cfd, opts)
         if cc is None or not hasattr(lib, "tpulsm_getctx_multiget"):
             return False, None
+        if cc.fast_mg is not None and isinstance(keys, list) \
+                and all(type(k) is bytes for k in keys):
+            # Whole batch + result materialization in the C extension.
+            fm = cc.fast_mg(cc.ctx, keys, snap_seq)
+            if fm is not None:
+                res, ctr = fm
+                self._mg_record_stats(ctr)
+                return True, self._mg_resolve_fallbacks(
+                    res, keys, snap_seq, opts, cf)
         import ctypes
 
         import numpy as np
@@ -1753,6 +1764,22 @@ class DB:
             if rc != 0:
                 return False, None
             break
+        self._mg_record_stats(ctr)
+        mv = memoryview(arena)
+        out: list = [None] * n
+        for i in range(n):
+            s = status[i]
+            if s == 1:
+                o = voffs[i]
+                out[i] = bytes(mv[o: o + vlens[i]])
+            elif s == 2:
+                out[i] = False  # undecidable natively: resolve below
+        return True, self._mg_resolve_fallbacks(out, keys, snap_seq, opts,
+                                                cf)
+
+    def _mg_record_stats(self, ctr) -> None:
+        """Batch-level perf/ticker accounting from the native MultiGet's
+        six counters (shared by the ctypes and C-extension paths)."""
         st = _st
         if st.perf_level:
             pctx = st.perf_context()
@@ -1768,36 +1795,33 @@ class DB:
                               (st.BLOCK_CACHE_MISS, ctr[4])):
                 if cnt:
                     self.stats.record_tick(tick, cnt)
-        mv = memoryview(arena)
+
+    def _mg_resolve_fallbacks(self, out, keys, snap_seq, opts, cf):
+        """Replace False markers (keys the native walk could not decide:
+        merge chains, blob indexes, entities, range-tombstoned tables)
+        with full per-key Python resolutions, PINNED to the batch's
+        snapshot seqno — re-reading at a fresh last_sequence would mix
+        sequence points within one MultiGet. No tracer record: the
+        OP_MULTIGET record already covers these keys."""
+        if not any(v is False for v in out):
+            return out
         pinned_opts = opts
-        if opts.snapshot is None and 2 in status[:n]:
+        if opts.snapshot is None:
             import dataclasses as _dcs
 
-            pinned_opts = _dcs.replace(opts, snapshot=_SeqSnapshot(snap_seq))
-        out: list[bytes | None] = [None] * n
-        for i in range(n):
-            s = status[i]
-            if s == 1:
-                o = voffs[i]
-                out[i] = bytes(mv[o: o + vlens[i]])
-            elif s == 2:
-                # Undecidable natively: full per-key Python resolution,
-                # PINNED to the batch's snapshot seqno — re-reading at a
-                # fresh last_sequence would mix sequence points within one
-                # MultiGet (the Python path gives every key one snap_seq).
-                # No tracer record: the OP_MULTIGET record above already
-                # covers this key (a second OP_GET would double it on
-                # replay).
-                v, is_entity = self._get_impl_entry(keys[i], pinned_opts,
-                                                    cf, record_trace=False)
-                if v is not None and is_entity:
-                    from toplingdb_tpu.db.wide_columns import (
-                        default_column_of,
-                    )
+            pinned_opts = _dcs.replace(opts,
+                                       snapshot=_SeqSnapshot(snap_seq))
+        for i, v in enumerate(out):
+            if v is not False:
+                continue
+            r, is_entity = self._get_impl_entry(keys[i], pinned_opts, cf,
+                                                record_trace=False)
+            if r is not None and is_entity:
+                from toplingdb_tpu.db.wide_columns import default_column_of
 
-                    v = default_column_of(v)
-                out[i] = v
-        return True, out
+                r = default_column_of(r)
+            out[i] = r
+        return out
 
     def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,
                   cf=None) -> list[bytes | None]:

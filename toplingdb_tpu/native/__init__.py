@@ -108,6 +108,14 @@ def lib() -> ctypes.CDLL | None:
             ctypes.c_uint64, ctypes.c_uint32, u8p,
         ]
         try:
+            l.tpulsm_bloom_build_blocked.restype = None
+            l.tpulsm_bloom_build_blocked.argtypes = [
+                u8p, i32p, i32p, ctypes.c_int64,
+                ctypes.c_uint64, ctypes.c_uint32, u8p,
+            ]
+        except AttributeError:
+            pass
+        try:
             # A stale .so may predate this symbol; degrade to the numpy
             # sort twin instead of breaking every native caller.
             l.tpulsm_sort_entries.restype = ctypes.c_int32
@@ -425,6 +433,14 @@ def _fastget_so_path() -> str:
 
     tag = getattr(_sys.implementation, "cache_tag", "py") or "py"
     return os.path.join(_DIR, f"tpulsm_fastget.{tag}.so")
+
+
+def fastmultiget():
+    """The C-extension whole-batch MultiGet (list-of-bytes in, list out),
+    or None when unavailable."""
+    if fastget() is None:
+        return None
+    return getattr(_fastget_mod, "multiget", None)
 
 
 def fastget():

@@ -1251,6 +1251,27 @@ int64_t tpulsm_decode_blocks(
   return total;
 }
 
+// Cache-line blocked bloom fill; must match table/filter.py
+// BlockedBloomFilterPolicy (the reference's FastLocalBloom role): one
+// 64B line per key (line = h % num_lines), in-line probes
+// (h + (i+1)*h2) % 512.
+void tpulsm_bloom_build_blocked(
+    const uint8_t* key_buf, const int32_t* key_offs, const int32_t* key_lens,
+    int64_t n, uint64_t num_lines, uint32_t num_probes, uint8_t* data) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = tpulsm_xxh64(key_buf + key_offs[i], (size_t)key_lens[i],
+                              0xA0761D64ULL);
+    uint64_t h2 = ((h >> 33) | (h << 31)) | 1ULL;
+    uint8_t* line = data + (h % num_lines) * 64;
+    uint64_t x = h;
+    for (uint32_t k = 0; k < num_probes; k++) {
+      x += h2;
+      uint64_t b = x & 511;
+      line[b >> 3] |= (uint8_t)(1u << (b & 7));
+    }
+  }
+}
+
 // Bloom filter bit array fill; must match table/filter.py BloomFilterPolicy:
 // h = xxh64(key, 0xA0761D64); h2 = rotr(h, 33) | 1; probe_i = (h + i*h2) % bits.
 void tpulsm_bloom_build(
@@ -3083,6 +3104,7 @@ struct NTable {
   int32_t eligible = 0;        // 0 → chain walk returns FALLBACK on contact
   std::string index;           // uncompressed single-level index block
   std::string filter;          // whole-key bloom block ("" → no filter)
+  int32_t filter_kind = 0;     // 0 = classic bloom, 1 = blocked bloom
   std::string smallest_uk, largest_uk;
   // Decoded index (built once per handle): flat arrays for a cache-
   // friendly binary search — probing the raw multi-MB index block paid
@@ -3339,22 +3361,37 @@ int64_t nindex_lower_bound(NTable* t, const uint8_t* target, int32_t tlen) {
 }
 
 // Whole-key bloom probe: layout varint32 num_bits | 1B k | bits.
-bool nfilter_may_match(const std::string& f, const uint8_t* key,
-                       int32_t klen) {
+// kind 1 = blocked bloom (varint32 num_lines | 1B k | 64B lines): ONE
+// cache line touched per probe (table/filter.py BlockedBloomFilterPolicy).
+bool nfilter_may_match(const std::string& f, int32_t kind,
+                       const uint8_t* key, int32_t klen) {
   if (f.empty()) return true;
   const uint8_t* p = (const uint8_t*)f.data();
   const uint8_t* end = p + f.size();
-  uint32_t num_bits;
-  p = get_varint32(p, end, &num_bits);
+  uint32_t hdr;
+  p = get_varint32(p, end, &hdr);
   if (!p || p >= end) return true;
   uint32_t k = *p++;
   const uint8_t* bits = p;
-  if (num_bits == 0 || (size_t)(end - bits) * 8 < num_bits) return true;
   uint64_t h = tpulsm_xxh64(key, (size_t)klen, 0xA0761D64);
-  uint64_t h1 = h;
   uint64_t h2 = ((h >> 33) | (h << 31)) | 1;
+  if (kind == 1) {
+    uint64_t num_lines = hdr;
+    if (num_lines == 0 || (size_t)(end - bits) < (size_t)num_lines * 64)
+      return true;
+    const uint8_t* line = bits + (h % num_lines) * 64;
+    uint64_t x = h;
+    for (uint32_t i = 0; i < k; i++) {
+      x += h2;
+      uint64_t b = x & 511;
+      if (!((line[b >> 3] >> (b & 7)) & 1)) return false;
+    }
+    return true;
+  }
+  uint32_t num_bits = hdr;
+  if (num_bits == 0 || (size_t)(end - bits) * 8 < num_bits) return true;
   for (uint32_t i = 0; i < k; i++) {
-    uint64_t b = (h1 + (uint64_t)i * h2) % num_bits;
+    uint64_t b = (h + (uint64_t)i * h2) % num_bits;
     if (!((bits[b >> 3] >> (b & 7)) & 1)) return false;
   }
   return true;
@@ -3447,7 +3484,7 @@ int ntable_get(NTable* t, const uint8_t* ukey, int32_t klen,
   *decided = 0;
   if (!t || !t->eligible) return NGET_FALLBACK;
   if (!t->filter.empty()) {
-    if (!nfilter_may_match(t->filter, ukey, klen)) {
+    if (!nfilter_may_match(t->filter, t->filter_kind, ukey, klen)) {
       ctr[NC_BLOOM_MISS]++;
       return NGET_NOTFOUND;
     }
@@ -3621,6 +3658,10 @@ void* tpulsm_table_handle_new(int32_t fd, uint64_t number, int32_t eligible,
                               const uint8_t* largest_uk, int32_t ll) {
   NTable* t = new (std::nothrow) NTable();
   if (!t) return nullptr;
+  // eligible is a FLAG WORD: bit0 = eligible, bit1 = blocked-bloom filter
+  // layout (old callers pass 0/1, which decodes identically).
+  t->filter_kind = (eligible >> 1) & 1;
+  eligible = eligible & 1;
   if (eligible && fd >= 0) {
     t->fd = ::dup(fd);
     if (t->fd < 0) {

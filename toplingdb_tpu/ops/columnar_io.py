@@ -607,23 +607,11 @@ class _ColumnarSST:
         meta_entries = []
         metaindex = BlockBuilder(restart_interval=1)
         if options.filter_policy and options.whole_key_filtering and n:
-            from toplingdb_tpu.utils import coding
+            from toplingdb_tpu.table.filter import build_filter_block_native
 
-            bp = options.filter_policy
-            num_bits = max(64, int(n * bp.bits_per_key))
-            num_bytes = (num_bits + 7) // 8
-            num_bits = num_bytes * 8
-            bits = np.zeros(num_bytes, dtype=np.uint8)
-            uk_lens = (kv.key_lens[sel] - 8).astype(np.int32)
-            offs = kv.key_offs[sel].astype(np.int32)
-            lib.tpulsm_bloom_build(
-                native.np_u8p(kv.key_buf),
-                native.np_i32p(np.ascontiguousarray(offs)),
-                native.np_i32p(np.ascontiguousarray(uk_lens)), n,
-                num_bits, bp.num_probes, native.np_u8p(bits),
-            )
-            fdata = (coding.encode_varint32(num_bits) + bytes([bp.num_probes])
-                     + bits.tobytes())
+            fdata = build_filter_block_native(
+                lib, options.filter_policy, kv.key_buf,
+                kv.key_offs[sel], (kv.key_lens[sel] - 8), n)
             fh = fmt.write_block(self.w, fdata, fmt.NO_COMPRESSION)
             props.filter_size = len(fdata)
             meta_entries.append((METAINDEX_FILTER, fh))

@@ -78,6 +78,72 @@ class BloomFilterPolicy(FilterPolicy):
             return True  # corrupt filter: fail open
 
 
+class BlockedBloomFilterPolicy(FilterPolicy):
+    """Cache-line blocked bloom (the reference's FastLocalBloom role,
+    util/bloom_impl.h:FastLocalBloomImpl): every key's probes land in ONE
+    64-byte line, so a filter check costs one DRAM access instead of
+    num_probes scattered ones — the standard bloom's ~6 random misses
+    dominated the hot Get chain at bench scale.
+
+    Layout: varint32 num_lines | 1B num_probes | num_lines * 64B lines.
+    Line = h % num_lines; in-line bits via double hashing mod 512.
+    """
+
+    LINE_BYTES = 64
+    LINE_BITS = 512
+
+    def __init__(self, bits_per_key: float = 10.0):
+        self.bits_per_key = bits_per_key
+        self.num_probes = max(1, min(30,
+                                     int(round(bits_per_key * math.log(2)))))
+
+    def name(self) -> str:
+        return f"tpulsm.BlockedBloom:{self.bits_per_key}"
+
+    def _line_and_bits(self, key: bytes, num_lines: int, num_probes: int):
+        h = xxh64(key, 0xA0761D64)
+        h1 = h & 0xFFFFFFFFFFFFFFFF
+        h2 = ((h >> 33) | (h << 31)) & 0xFFFFFFFFFFFFFFFF | 1
+        line = h1 % num_lines
+        bits = [((h1 + (i + 1) * h2) & 0xFFFFFFFFFFFFFFFF) % self.LINE_BITS
+                for i in range(num_probes)]
+        return line, bits
+
+    def create_filter(self, keys: list[bytes]) -> bytes:
+        n = max(1, len(keys))
+        num_lines = max(1, (int(n * self.bits_per_key) + self.LINE_BITS - 1)
+                        // self.LINE_BITS)
+        data = bytearray(num_lines * self.LINE_BYTES)
+        for k in keys:
+            line, bits = self._line_and_bits(k, num_lines, self.num_probes)
+            base = line * self.LINE_BYTES
+            for b in bits:
+                data[base + (b >> 3)] |= 1 << (b & 7)
+        out = bytearray()
+        out += coding.encode_varint32(num_lines)
+        out.append(self.num_probes)
+        out += data
+        return bytes(out)
+
+    def key_may_match(self, key: bytes, filter_data: bytes) -> bool:
+        if not filter_data:
+            return True
+        try:
+            num_lines, off = coding.decode_varint32(filter_data, 0)
+            num_probes = filter_data[off]
+            data = memoryview(filter_data)[off + 1:]
+            if num_lines == 0 or len(data) < num_lines * self.LINE_BYTES:
+                return True
+            line, bits = self._line_and_bits(key, num_lines, num_probes)
+            base = line * self.LINE_BYTES
+            for b in bits:
+                if not (data[base + (b >> 3)] >> (b & 7)) & 1:
+                    return False
+            return True
+        except Exception:
+            return True  # corrupt filter: fail open
+
+
 def filter_probe(policy: FilterPolicy | None, filter_data: bytes | None,
                  whole_key_filtering: bool, prefix_extractor,
                  user_key: bytes) -> bool:
@@ -95,7 +161,53 @@ def filter_probe(policy: FilterPolicy | None, filter_data: bytes | None,
     return policy.key_may_match(user_key, filter_data)
 
 
+def build_filter_block_native(lib, bp: FilterPolicy, key_buf, offs,
+                              uk_lens, n: int) -> bytes:
+    """The filter-block bytes for n user keys held columnar (numpy
+    buffers) — ONE implementation of the wire layout shared by the
+    columnar and zip writers. Native fast path per policy kind; the
+    Python fallback builds the SAME layout via the policy itself, so the
+    data can never mismatch the recorded filter_policy_name (a classic
+    layout under a BlockedBloom name would silently fail open on every
+    probe)."""
+    import numpy as np
+
+    from toplingdb_tpu import native
+
+    name = bp.name()
+    if lib is not None and n:
+        o = np.ascontiguousarray(offs, dtype=np.int32)
+        ln = np.ascontiguousarray(uk_lens, dtype=np.int32)
+        if name.startswith("tpulsm.BlockedBloom") and \
+                hasattr(lib, "tpulsm_bloom_build_blocked"):
+            num_lines = max(1, (int(n * bp.bits_per_key) + 511) // 512)
+            bits = np.zeros(num_lines * 64, dtype=np.uint8)
+            lib.tpulsm_bloom_build_blocked(
+                native.np_u8p(key_buf), native.np_i32p(o),
+                native.np_i32p(ln), n, num_lines, bp.num_probes,
+                native.np_u8p(bits))
+            return (coding.encode_varint32(num_lines)
+                    + bytes([bp.num_probes]) + bits.tobytes())
+        if name.startswith("tpulsm.BloomFilter") and \
+                hasattr(lib, "tpulsm_bloom_build"):
+            num_bits = max(64, int(n * bp.bits_per_key))
+            num_bytes = (num_bits + 7) // 8
+            num_bits = num_bytes * 8
+            bits = np.zeros(num_bytes, dtype=np.uint8)
+            lib.tpulsm_bloom_build(
+                native.np_u8p(key_buf), native.np_i32p(o),
+                native.np_i32p(ln), n, num_bits, bp.num_probes,
+                native.np_u8p(bits))
+            return (coding.encode_varint32(num_bits)
+                    + bytes([bp.num_probes]) + bits.tobytes())
+    keys = [bytes(key_buf[int(offs[i]): int(offs[i]) + int(uk_lens[i])])
+            for i in range(n)]
+    return bp.create_filter(keys)
+
+
 def filter_policy_from_name(name: str) -> FilterPolicy | None:
     if name.startswith("tpulsm.BloomFilter:"):
         return BloomFilterPolicy(float(name.split(":", 1)[1]))
+    if name.startswith("tpulsm.BlockedBloom:"):
+        return BlockedBloomFilterPolicy(float(name.split(":", 1)[1]))
     return None

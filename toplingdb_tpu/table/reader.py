@@ -151,11 +151,15 @@ class TableReader:
             == "tpulsm.BytewiseComparator"
         )
         filt = b""
+        filter_kind = 0
+        fname = str(self.properties.filter_policy_name)
         if (eligible and self._filter_data is not None
-                and self.properties.whole_key_filtering
-                and str(self.properties.filter_policy_name).startswith(
-                    "tpulsm.BloomFilter")):
-            filt = self._filter_data
+                and self.properties.whole_key_filtering):
+            if fname.startswith("tpulsm.BloomFilter"):
+                filt = self._filter_data
+            elif fname.startswith("tpulsm.BlockedBloom"):
+                filt = self._filter_data
+                filter_kind = 1
         idx = self._index_data if eligible else b""
         u8 = ctypes.POINTER(ctypes.c_uint8)
 
@@ -169,7 +173,7 @@ class TableReader:
         h = cl.tpulsm_table_handle_new(
             fd if eligible else -1,
             next(_NGET_ID),
-            1 if eligible else 0,
+            (1 | (filter_kind << 1)) if eligible else 0,
             buf(idx), len(idx), buf(filt), len(filt),
             buf(smallest_uk), len(smallest_uk),
             buf(largest_uk), len(largest_uk),

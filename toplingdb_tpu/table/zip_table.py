@@ -793,20 +793,13 @@ def write_tables_zip_columnar(env, dbname, new_file_number, icmp, options,
                 fdata = None
                 bp = options.filter_policy
                 if bp is not None and options.whole_key_filtering and lib:
-                    num_bits = max(64, int(n * bp.bits_per_key))
-                    num_bytes = (num_bits + 7) // 8
-                    num_bits = num_bytes * 8
-                    bits = np.zeros(num_bytes, dtype=np.uint8)
-                    uk_lens = np.full(n, K - 8, dtype=np.int32)
-                    offs = kv.key_offs[rows].astype(np.int32)
-                    lib.tpulsm_bloom_build(
-                        native.np_u8p(kv.key_buf),
-                        native.np_i32p(np.ascontiguousarray(offs)),
-                        native.np_i32p(uk_lens), n,
-                        num_bits, bp.num_probes, native.np_u8p(bits),
+                    from toplingdb_tpu.table.filter import (
+                        build_filter_block_native,
                     )
-                    fdata = (coding.encode_varint32(num_bits)
-                             + bytes([bp.num_probes]) + bits.tobytes())
+
+                    fdata = build_filter_block_native(
+                        lib, bp, kv.key_buf, kv.key_offs[rows],
+                        np.full(n, K - 8, dtype=np.int32), n)
                 kmeta = meta.tobytes()
                 ksfx = sfx.tobytes()
                 kgso_b = kgso.tobytes()
