@@ -600,27 +600,36 @@ def main():
         else:
             bp.redirect_to_cpu_backend()
 
-    result = {
-        "metric": "l2_compaction_MBps_per_chip",
-        "value": round(mbps, 2),
-        "unit": "MB/s",
-        "vs_baseline": round(mbps / BASELINE_MBPS, 4),
-        "detail": detail,
-    }
-    line = json.dumps(result)
-    # Self-check (VERDICT r04 item 1): the official record is ONE parseable
-    # line of bounded size. If any field bloats it past the driver's tail
-    # capture, shed detail down to the essentials rather than lose "value".
-    if len(line) > 8192:
+    # Record layout (VERDICT r05 weak #1): the driver captures only the
+    # LAST ~2000 chars of stdout, so the headline keys must be the FINAL
+    # keys of the line (json.dumps preserves dict insertion order) and the
+    # whole line must stay ≤ 1800 bytes — otherwise the tail keeps the
+    # detail blob and drops "value", making the round's perf work
+    # officially invisible.
+    def make_record(det):
+        return {
+            "metric": "l2_compaction_MBps_per_chip",
+            "unit": "MB/s",
+            "detail": det,
+            # headline keys LAST so a tail capture always preserves them
+            "value": round(mbps, 2),
+            "vs_baseline": round(mbps / BASELINE_MBPS, 4),
+            "device": device,
+            "tpu_unreachable_cpu_fallback": tpu_fallback,
+        }
+
+    line = json.dumps(make_record(detail))
+    if len(line) > 1800:
         slim = {k: detail[k] for k in (
-            "device", "tpu_unreachable_cpu_fallback", "n_entries",
-            "raw_kv_bytes", "wall_s", "headline_run_times_s",
+            "n_entries", "raw_kv_bytes", "wall_s", "headline_run_times_s",
             "phase_breakdown", "compression", "headline_source",
             "variant_rows_source") if k in detail}
         slim["detail_truncated"] = True
-        result["detail"] = slim
-        line = json.dumps(result)
+        line = json.dumps(make_record(slim))
+    if len(line) > 1800:
+        line = json.dumps(make_record({"detail_truncated": True}))
     json.loads(line)  # hard guarantee: the printed record parses
+    assert len(line) <= 1800, len(line)
     print(line)
     shutil.rmtree(base, ignore_errors=True)
 

@@ -194,3 +194,45 @@ def test_columnar_writer_compressed_byte_parity(tmp_path):
         got = open(files[0][1], "rb").read()
         want = open(ref, "rb").read()
         assert got == want, f"{name}: native compressed section diverges"
+
+
+@pytest.mark.parametrize("compression", ["none", "snappy"])
+def test_scan_refvals_parity(tmp_path, compression):
+    """tpulsm_scan_blocks_refvals (values referenced into the file image)
+    returns exactly the entries of the value-copying scan. Compressed
+    files must transparently take the copying path (refvals returns -5)."""
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.ops.columnar_io import scan_table_columnar
+    from toplingdb_tpu.table import format as fmt
+    from toplingdb_tpu.table.builder import TableBuilder
+    from toplingdb_tpu.table.factory import open_table
+    from toplingdb_tpu.utils import codecs
+
+    if compression == "snappy" and not codecs.available("snappy"):
+        pytest.skip("snappy unavailable")
+    env = default_env()
+    icmp = InternalKeyComparator(dbformat.BYTEWISE)
+    topts = TableOptions(
+        block_size=512,
+        compression=(fmt.SNAPPY_COMPRESSION if compression == "snappy"
+                     else fmt.NO_COMPRESSION))
+    path = str(tmp_path / f"refvals_{compression}.sst")
+    w = env.new_writable_file(path)
+    b = TableBuilder(w, icmp, topts)
+    for i in range(4000):
+        ik = dbformat.make_internal_key(
+            b"key%06d" % i, 1000 + i, ValueType.VALUE)
+        b.add(ik, b"value-%06d" % (i * 13))
+    b.finish()
+    w.close()
+
+    r = open_table(env.new_random_access_file(path), icmp, topts)
+    kv_ref = scan_table_columnar(r, ref_values=True)
+    kv_cp = scan_table_columnar(r, ref_values=False)
+    assert kv_ref.n == kv_cp.n == 4000
+    assert kv_ref.to_entries() == kv_cp.to_entries()
+    if compression == "none" and hasattr(
+            native.lib(), "tpulsm_scan_blocks_refvals"):
+        # The refvals path actually engaged: val_buf IS the file image.
+        assert len(kv_ref.val_buf) == env.get_file_size(path)
+        assert len(kv_cp.val_buf) < len(kv_ref.val_buf)

@@ -1,0 +1,446 @@
+"""Resilient distributed compaction: retry/backoff, per-URL circuit
+breaking, graceful-degradation local pinning, job leases + orphan
+sweeping, and the DCOMPACTION_* attribution of every failure."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from toplingdb_tpu.compaction.dcompact_service import (
+    DcompactWorkerService,
+    HttpCompactionExecutorFactory,
+)
+from toplingdb_tpu.compaction.executor import (
+    SubprocessCompactionExecutorFactory,
+)
+from toplingdb_tpu.compaction.resilience import (
+    CircuitBreaker,
+    DcompactFaultInjector,
+    DcompactOptions,
+    LocalPinGate,
+    WorkerHealthRegistry,
+    sweep_orphan_jobs,
+)
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.utils import statistics as st
+from toplingdb_tpu.utils.statistics import Statistics
+
+
+# ---------------------------------------------------------------------------
+# Unit: breaker / registry / pin gate / policy
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_circuit_breaker_lifecycle():
+    clk = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, reset_timeout=10.0, clock=clk)
+    assert b.allow() and b.state == CircuitBreaker.CLOSED
+    b.on_failure()
+    b.on_failure()
+    assert b.allow()  # still closed below the threshold
+    assert b.on_failure() is True  # third consecutive: OPEN
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.allow()
+    clk.t += 9.0
+    assert not b.allow()  # reset timeout not reached
+    clk.t += 2.0
+    assert b.allow()  # half-open probe admitted
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert not b.allow()  # only ONE probe at a time
+    assert b.on_success() is True  # probe succeeded: CLOSED again
+    assert b.state == CircuitBreaker.CLOSED and b.allow()
+    # Half-open probe failure re-opens immediately.
+    for _ in range(3):
+        b.on_failure()
+    clk.t += 11.0
+    assert b.allow()
+    assert b.on_failure() is True
+    assert b.state == CircuitBreaker.OPEN and not b.allow()
+
+
+def test_health_registry_pick_skips_open_circuits():
+    clk = FakeClock()
+    pol = DcompactOptions(breaker_failure_threshold=1,
+                          breaker_reset_timeout=60.0)
+    reg = WorkerHealthRegistry(pol, clock=clk)
+    urls = ["http://a", "http://b", "http://c"]
+    picks = [reg.pick(urls) for _ in range(3)]
+    assert sorted(picks) == sorted(urls)  # plain round-robin when healthy
+    reg.record_failure("http://b")  # threshold 1: opens immediately
+    picks = {reg.pick(urls) for _ in range(6)}
+    assert "http://b" not in picks and picks == {"http://a", "http://c"}
+    assert reg.skipped_open > 0
+    reg.record_failure("http://a")
+    reg.record_failure("http://c")
+    assert reg.pick(urls) is None  # every circuit open
+    clk.t += 61.0
+    assert reg.pick(urls) in urls  # half-open probe re-admits
+    snap = reg.snapshot()
+    assert set(snap) == set(urls)
+
+
+def test_local_pin_gate():
+    clk = FakeClock()
+    pol = DcompactOptions(local_pin_failures=2, local_pin_cooldown=30.0)
+    g = LocalPinGate(pol, clock=clk)
+    assert not g.should_pin()
+    assert g.note_job_failure() is False
+    g.note_job_success()  # resets the streak
+    assert g.note_job_failure() is False
+    assert g.note_job_failure() is True  # second consecutive: pinned
+    assert g.should_pin() and g.pin_count == 1
+    clk.t += 31.0
+    assert not g.should_pin()  # cooldown lapsed
+
+
+def test_backoff_delay_exponential_with_jitter():
+    pol = DcompactOptions(backoff_base=0.1, backoff_multiplier=2.0,
+                          backoff_jitter=0.5)
+    import random
+
+    rng = random.Random(7)
+    for i in (1, 2, 3):
+        nominal = 0.1 * (2.0 ** (i - 1))
+        for _ in range(50):
+            d = pol.backoff_delay(i, rng)
+            assert 0.5 * nominal <= d <= 1.5 * nominal
+    nojit = DcompactOptions(backoff_base=0.1, backoff_jitter=0.0)
+    assert nojit.backoff_delay(3) == pytest.approx(0.4)
+
+
+def test_dcompact_options_config_roundtrip():
+    from toplingdb_tpu.utils.config import (
+        options_from_config, options_to_config,
+    )
+
+    opts = options_from_config({
+        "dcompact": {"max_attempts": 5, "backoff_base": 0.01,
+                     "lease_sec": 7.5, "breaker_failure_threshold": 2},
+    })
+    assert opts.dcompact.max_attempts == 5
+    assert opts.dcompact.lease_sec == 7.5
+    out = options_to_config(opts)
+    assert out["dcompact"] == {"max_attempts": 5, "backoff_base": 0.01,
+                               "lease_sec": 7.5,
+                               "breaker_failure_threshold": 2}
+    # Defaults serialize to nothing.
+    opts2 = options_from_config({"dcompact": {}})
+    assert "dcompact" not in options_to_config(opts2)
+
+
+def test_fault_injector_deterministic():
+    inj = DcompactFaultInjector(rate=0.5, plans=("drop",), seed=42)
+    seq1 = [inj.plan(i, 0) for i in range(40)]
+    inj2 = DcompactFaultInjector(rate=0.5, plans=("drop",), seed=42)
+    seq2 = [inj2.plan(i, 0) for i in range(40)]
+    assert seq1 == seq2 and "drop" in seq1 and None in seq1
+    assert inj.injected_counts()["drop"] == sum(p == "drop" for p in seq1)
+
+
+# ---------------------------------------------------------------------------
+# Integration helpers
+# ---------------------------------------------------------------------------
+
+
+def _fill(dbp, opts, n=2400, mod=800):
+    db = DB.open(dbp, opts)
+    for i in range(n):
+        db.put(b"key%05d" % (i % mod), b"val%07d" % i)
+        if i % 300 == 299:
+            db.flush()
+    db.flush()
+    return db
+
+
+def _fast_policy(**kw):
+    base = dict(max_attempts=3, backoff_base=0.005, backoff_jitter=0.1,
+                attempt_timeout=120.0, breaker_failure_threshold=2,
+                breaker_reset_timeout=0.2, local_pin_failures=2,
+                local_pin_cooldown=0.3, lease_sec=5.0)
+    base.update(kw)
+    return DcompactOptions(**base)
+
+
+# ---------------------------------------------------------------------------
+# Integration: retry + fallback through the real scheduler (HTTP transport)
+# ---------------------------------------------------------------------------
+
+
+def test_http_retry_recovers_failed_attempts(tmp_path):
+    """Attempt 1 of each job is dropped; the retry succeeds remotely —
+    no local fallback, every failure attributed as a retry."""
+    svc = DcompactWorkerService(device="cpu")
+    port = svc.start()
+    stats = Statistics()
+    policy = _fast_policy(breaker_failure_threshold=10)
+    # Every EVEN ordinal fails: each job's first attempt drops, retry runs.
+    inj = DcompactFaultInjector(
+        schedule={i: "drop" for i in range(0, 40, 2)})
+    fac = HttpCompactionExecutorFactory(
+        [f"http://127.0.0.1:{port}"], policy=policy, fault_injector=inj)
+    dbp = str(tmp_path / "db")
+    opts = Options(write_buffer_size=1 << 14, disable_auto_compactions=True,
+                   compaction_executor_factory=fac, statistics=stats,
+                   dcompact=policy)
+    db = _fill(dbp, opts)
+    try:
+        db.compact_range()
+        assert db.get(b"key00000") is not None
+        assert db.get(b"key00799") == b"val%07d" % 2399
+        t = stats.tickers()
+        assert t.get(st.DCOMPACTION_RETRIES, 0) > 0
+        assert t.get(st.DCOMPACTION_JOB_FAILURES, 0) == 0
+        assert t.get(st.DCOMPACTION_FALLBACK_LOCAL, 0) == 0
+        # attempts = successes (jobs) + retried failures
+        n_inj = sum(inj.injected_counts().values())
+        assert t[st.DCOMPACTION_ATTEMPTS] == svc.jobs_done + n_inj
+        assert t[st.DCOMPACTION_RETRIES] == n_inj
+        assert db._bg_error is None
+    finally:
+        db.close()
+        svc.stop()
+
+
+def test_exhausted_attempts_fall_back_local_and_pin(tmp_path):
+    """Every attempt fails: the job falls back local; after N consecutive
+    remote job failures the pin gate routes later jobs straight local
+    (DCOMPACTION_FALLBACK_PINNED) without touching the transport."""
+    stats = Statistics()
+    policy = _fast_policy(max_attempts=2, local_pin_failures=1,
+                          local_pin_cooldown=60.0)
+    inj = DcompactFaultInjector(rate=1.0, plans=("drop",), seed=1)
+    fac = SubprocessCompactionExecutorFactory(
+        device="cpu", policy=policy, fault_injector=inj)
+    dbp = str(tmp_path / "db")
+    opts = Options(write_buffer_size=1 << 14, disable_auto_compactions=True,
+                   compaction_executor_factory=fac, statistics=stats,
+                   dcompact=policy)
+    db = _fill(dbp, opts)
+    try:
+        db.compact_range()  # the L0 job exhausts its attempts -> pin
+        assert db.get(b"key00799") == b"val%07d" % 2399
+        t = stats.tickers()
+        assert t.get(st.DCOMPACTION_JOB_FAILURES, 0) >= 1
+        assert t.get(st.DCOMPACTION_FALLBACK_LOCAL, 0) >= 1
+        assert t.get(st.DCOMPACTION_LOCAL_PINS, 0) == 1
+        # A later job inside the cooldown goes straight local — no remote
+        # attempt, no transport wait.
+        attempts_before = t[st.DCOMPACTION_ATTEMPTS]
+        for i in range(900):
+            db.put(b"pin%05d" % (i % 300), b"pv%06d" % i)
+            if i % 300 == 299:
+                db.flush()
+        db.compact_range()
+        t = stats.tickers()
+        assert t.get(st.DCOMPACTION_FALLBACK_PINNED, 0) >= 1
+        assert t[st.DCOMPACTION_ATTEMPTS] == attempts_before
+        assert db.get(b"pin00299") == b"pv%06d" % 899
+        # The pinned jobs never spawned remote attempts.
+        assert t[st.DCOMPACTION_ATTEMPTS] == \
+            t[st.DCOMPACTION_RETRIES] + t[st.DCOMPACTION_JOB_FAILURES]
+        assert db._bg_error is None
+    finally:
+        db.close()
+
+
+def test_http_breaker_skips_dead_worker(tmp_path):
+    """Two workers, one a black hole that accepts and never replies (a
+    REAL HTTP timeout): its breaker opens after the configured consecutive
+    failures and round-robin stops paying the timeout for it."""
+    svc = DcompactWorkerService(device="cpu")
+    port = svc.start()
+    # Black-hole listener: accepts connections, never responds.
+    hole = socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(8)
+    hole_port = hole.getsockname()[1]
+    stats = Statistics()
+    policy = _fast_policy(max_attempts=3, breaker_failure_threshold=1,
+                          breaker_reset_timeout=300.0, attempt_timeout=0.5,
+                          local_pin_failures=100)
+    fac = HttpCompactionExecutorFactory(
+        [f"http://127.0.0.1:{hole_port}", f"http://127.0.0.1:{port}"],
+        policy=policy)
+    events = []
+    from toplingdb_tpu.utils.listener import EventListener
+
+    class Watch(EventListener):
+        def on_worker_health_changed(self, db, info):
+            events.append((info.url, info.state))
+
+        def on_dcompact_attempt(self, db, info):
+            events.append(("attempt", info.url, info.ok))
+
+    dbp = str(tmp_path / "db")
+    opts = Options(write_buffer_size=1 << 14, disable_auto_compactions=True,
+                   compaction_executor_factory=fac, statistics=stats,
+                   dcompact=policy, listeners=[Watch()])
+    db = _fill(dbp, opts)
+    try:
+        db.compact_range()
+        assert db.get(b"key00799") == b"val%07d" % 2399
+        t = stats.tickers()
+        assert t.get(st.DCOMPACTION_BREAKER_OPEN, 0) == 1
+        assert t.get(st.DCOMPACTION_FALLBACK_LOCAL, 0) == 0
+        assert svc.jobs_done >= 1
+        hole_url = f"http://127.0.0.1:{hole_port}"
+        assert (hole_url, "open") in events
+        assert any(e[0] == "attempt" and e[1] == hole_url and not e[2]
+                   for e in events)
+        assert fac.health.snapshot()[hole_url]["state"] == "open"
+        # After the breaker opened, every further attempt went to the live
+        # worker; the timeout was paid exactly once.
+        failed = [e for e in events
+                  if e[0] == "attempt" and e[2] is False]
+        assert len(failed) == 1
+        assert db._bg_error is None
+    finally:
+        db.close()
+        svc.stop()
+        hole.close()
+
+
+def test_all_circuits_open_skips_to_local_without_timeout(tmp_path):
+    """Every worker's breaker open -> new_executor returns None and the
+    job goes local instantly (DCOMPACTION_BREAKER_SKIPPED), not after
+    max_attempts * timeout."""
+    stats = Statistics()
+    policy = _fast_policy(breaker_failure_threshold=1,
+                          breaker_reset_timeout=600.0,
+                          local_pin_failures=100)
+    fac = HttpCompactionExecutorFactory(
+        ["http://worker-a", "http://worker-b"], policy=policy)
+    fac.health.record_failure("http://worker-a")
+    fac.health.record_failure("http://worker-b")
+    dbp = str(tmp_path / "db")
+    opts = Options(write_buffer_size=1 << 14, disable_auto_compactions=True,
+                   compaction_executor_factory=fac, statistics=stats,
+                   dcompact=policy)
+    db = _fill(dbp, opts)
+    try:
+        t0 = time.monotonic()
+        db.compact_range()
+        elapsed = time.monotonic() - t0
+        assert db.get(b"key00799") == b"val%07d" % 2399
+        t = stats.tickers()
+        assert t.get(st.DCOMPACTION_BREAKER_SKIPPED, 0) >= 1
+        assert t.get(st.DCOMPACTION_FALLBACK_LOCAL, 0) >= 1
+        assert t.get(st.DCOMPACTION_ATTEMPTS, 0) == 0
+        assert elapsed < 60.0  # nothing waited on a transport timeout
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# Job leases + orphan sweeping
+# ---------------------------------------------------------------------------
+
+
+def _make_orphan(job_root, job_id=42, attempt=0, age=300.0):
+    """Forge the on-disk state a kill -9'd worker leaves behind: params,
+    lease, a STALE heartbeat, and a partial output SST."""
+    att = os.path.join(job_root, f"job-{job_id:05d}", f"att-{attempt:02d}")
+    os.makedirs(os.path.join(att, "out"), exist_ok=True)
+    with open(os.path.join(att, "params.json"), "w") as f:
+        json.dump({"job_id": job_id, "attempt": attempt}, f)
+    with open(os.path.join(att, "lease.json"), "w") as f:
+        json.dump({"job_id": job_id, "lease_sec": 5.0}, f)
+    with open(os.path.join(att, "heartbeat"), "w") as f:
+        f.write("9999 0.0\n")
+    with open(os.path.join(att, "out", "000001.sst"), "wb") as f:
+        f.write(b"\x00" * 512)  # partial output
+    old = time.time() - age
+    for name in ("params.json", "lease.json", "heartbeat"):
+        os.utime(os.path.join(att, name), (old, old))
+    os.utime(att, (old, old))
+    return att
+
+
+def test_sweep_orphan_jobs_unit(tmp_path):
+    root = str(tmp_path / "dcompact")
+    dead = _make_orphan(root, job_id=1, age=300.0)
+    live = _make_orphan(root, job_id=2, age=0.0)  # fresh heartbeat: live
+    stats = Statistics()
+    swept = sweep_orphan_jobs(root, lease_sec=30.0, statistics=stats)
+    assert dead in swept and not os.path.exists(dead)
+    assert os.path.exists(live)
+    assert not os.path.exists(os.path.dirname(dead))  # skeleton removed
+    assert stats.get_ticker_count(st.DCOMPACTION_ORPHANS_SWEPT) == 1
+    # Idempotent.
+    assert sweep_orphan_jobs(root, lease_sec=30.0) == []
+
+
+def test_orphaned_job_swept_on_open_and_job_reruns(tmp_path):
+    """Acceptance: an orphaned job dir with an expired lease left by a
+    kill -9'd worker is detected and swept on DB open, and the compaction
+    whose job died re-runs successfully (its inputs are still live in the
+    version, so the picker re-picks it)."""
+    dbp = str(tmp_path / "db")
+    stats = Statistics()
+    policy = _fast_policy()
+    opts = Options(write_buffer_size=1 << 14, disable_auto_compactions=True,
+                   level0_file_num_compaction_trigger=2)
+    db = _fill(dbp, opts, n=1800, mod=600)
+    v = db.versions.cf_current(0)
+    assert len(v.files[0]) >= 2  # a compaction is due the moment auto is on
+    db.close()
+    # The worker that was running that compaction died mid-job. The forged
+    # id must not collide with the process-wide job counter: the reopened
+    # DB's background compaction creates fresh job-NNNNN dirs right after
+    # the sweep.
+    orphan = _make_orphan(os.path.join(dbp, "dcompact"), job_id=99942,
+                          age=600.0)
+    svc = DcompactWorkerService(device="cpu")
+    port = svc.start()
+    fac = HttpCompactionExecutorFactory(
+        [f"http://127.0.0.1:{port}"], policy=policy)
+    opts2 = Options(write_buffer_size=1 << 14,
+                    level0_file_num_compaction_trigger=2,
+                    compaction_executor_factory=fac, statistics=stats,
+                    dcompact=policy)
+    db = DB.open(dbp, opts2)
+    try:
+        assert not os.path.exists(orphan)
+        assert stats.get_ticker_count(st.DCOMPACTION_ORPHANS_SWEPT) == 1
+        db.wait_for_compactions()
+        assert svc.jobs_done >= 1  # the job re-ran through the worker
+        assert db.get(b"key00599") == b"val%07d" % 1799
+        v = db.versions.cf_current(0)
+        assert len(v.files[0]) < 2
+        assert db._bg_error is None
+    finally:
+        db.close()
+        svc.stop()
+
+
+def test_worker_heartbeats_while_running(tmp_path):
+    """The worker process heartbeats its job dir at ~lease/3 so the lease
+    stays fresh for as long as the job actually runs."""
+    from toplingdb_tpu.compaction.resilience import HeartbeatWriter
+
+    hb = HeartbeatWriter(str(tmp_path), lease_sec=0.9)
+    hb.start()
+    p = os.path.join(str(tmp_path), "heartbeat")
+    assert os.path.exists(p)
+    m0 = os.path.getmtime(p)
+    deadline = time.time() + 3.0
+    while os.path.getmtime(p) == m0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert os.path.getmtime(p) > m0  # it beats
+    hb.stop()
+    m1 = os.path.getmtime(p)
+    time.sleep(0.7)
+    assert os.path.getmtime(p) == m1  # and stops cleanly

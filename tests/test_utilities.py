@@ -904,6 +904,34 @@ def test_persistent_cache_ignores_corrupt_tail(tmp_path):
     pc2.close()
 
 
+def test_persistent_cache_insert_after_close_is_inert(tmp_path):
+    """insert()/_write_record after close() must early-return — a straggler
+    (e.g. a reader promoting a block during DB shutdown) must not roll a
+    FRESH cache file and resurrect the tier (ADVICE r5)."""
+    import os
+
+    from toplingdb_tpu.utils.persistent_cache import PersistentCache
+
+    for wb in (False, True):
+        pdir = str(tmp_path / f"pc_closed_{wb}")
+        pc = PersistentCache(pdir, capacity_bytes=1 << 20, compress=False,
+                             write_behind=wb)
+        pc.insert(b"live", b"L" * 64)
+        pc.flush()
+        pc.close()
+        files_after_close = sorted(os.listdir(pdir))
+        pc.insert(b"straggler", b"S" * 64)
+        pc._write_record(b"direct", b"D" * 64)
+        pc.flush()
+        assert sorted(os.listdir(pdir)) == files_after_close
+        assert pc.lookup(b"straggler") is None
+        # The pre-close insert is still on disk for the next incarnation.
+        pc2 = PersistentCache(pdir, capacity_bytes=1 << 20)
+        assert pc2.lookup(b"live") == b"L" * 64
+        assert pc2.lookup(b"direct") is None
+        pc2.close()
+
+
 def test_persistent_cache_write_behind_and_compression(tmp_path):
     """The writeback thread drains the insert queue; compressed records
     round-trip; pending entries are visible to lookups immediately."""

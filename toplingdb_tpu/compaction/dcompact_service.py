@@ -69,6 +69,10 @@ class DcompactWorkerService:
                         "device": svc.device, "jobs_done": svc.jobs_done,
                         "jobs_failed": svc.jobs_failed,
                     })
+                elif self.path == "/health":
+                    # Liveness probe for the DB-side health registry /
+                    # half-open breaker checks.
+                    self._reply(200, {"ok": True, "device": svc.device})
                 else:
                     self._reply(404, {"error": "not found"})
 
@@ -117,19 +121,34 @@ class DcompactWorkerService:
 
 
 class HttpCompactionExecutorFactory(CompactionExecutorFactory):
-    """DB-side factory: jobs go to worker URLs round-robin (the JobUrl
-    mechanism). Falls back to local on any transport/worker error."""
+    """DB-side factory: jobs go to worker URLs round-robin through a
+    per-URL circuit breaker (compaction/resilience.py): consecutive
+    failures open a worker's breaker, picks skip open circuits, and a
+    half-open probe re-admits a recovered worker. new_executor returns
+    None when EVERY circuit is open — the retry driver then falls back to
+    local without paying a remote timeout. Falls back to local on any
+    transport/worker error (scheduler policy)."""
 
     def __init__(self, worker_urls: list[str], device: str = "cpu",
                  allow_fallback: bool = True, min_input_bytes: int = 0,
-                 job_root: str | None = None, timeout: float = 3600.0):
+                 job_root: str | None = None, timeout: float | None = None,
+                 policy=None, fault_injector=None):
+        from toplingdb_tpu.compaction.resilience import (
+            DcompactOptions, WorkerHealthRegistry,
+        )
+
         self.worker_urls = list(worker_urls)
         self.device = device
         self._allow_fallback = allow_fallback
         self.min_input_bytes = min_input_bytes
         self.job_root = job_root
-        self.timeout = timeout
-        self._rr = 0
+        self.policy = policy or DcompactOptions()
+        # Legacy knob: an explicit timeout overrides the policy's
+        # per-attempt transport timeout.
+        self.timeout = timeout if timeout is not None \
+            else self.policy.attempt_timeout
+        self.health = WorkerHealthRegistry(self.policy)
+        self.fault_injector = fault_injector
 
     def should_run_local(self, compaction) -> bool:
         return compaction.total_input_bytes() < self.min_input_bytes
@@ -141,8 +160,9 @@ class HttpCompactionExecutorFactory(CompactionExecutorFactory):
         return self.worker_urls[(job_id + attempt) % len(self.worker_urls)]
 
     def new_executor(self, compaction):
-        url = self.worker_urls[self._rr % len(self.worker_urls)]
-        self._rr += 1
+        url = self.health.pick(self.worker_urls)
+        if url is None:
+            return None  # every circuit open: caller skips to local
 
         def spawn(job_dir: str, device: str) -> None:
             req = urllib.request.Request(
@@ -159,9 +179,12 @@ class HttpCompactionExecutorFactory(CompactionExecutorFactory):
             except OSError as e:
                 raise IOError_(f"dcompact POST to {url} failed: {e}") from e
 
-        return SubprocessCompactionExecutor(
-            self.device, self.job_root, spawn=spawn
+        ex = SubprocessCompactionExecutor(
+            self.device, self.job_root, spawn=spawn, policy=self.policy,
+            fault_injector=self.fault_injector,
         )
+        ex.url = url
+        return ex
 
 
 def main(argv=None) -> int:

@@ -148,6 +148,10 @@ class CompactionParams:
     # required when table_format == 'plain' (prefix hash index) and feeds
     # prefix blooms for the other formats.
     prefix_extractor: str | None = None
+    # Job-lease duration: the worker heartbeats job_dir/heartbeat at
+    # ~lease_sec/3; a heartbeat older than lease_sec marks the job
+    # orphaned (compaction/resilience.py). 0 disables heartbeating.
+    lease_sec: float = 30.0
     smallest_seqno_guard: int = 0
     device: str = "cpu"
     cf_id: int = 0
@@ -222,21 +226,35 @@ class SubprocessCompactionExecutor(CompactionExecutor):
     shared filesystem here; the RPC hop is pluggable via `spawn`)."""
 
     def __init__(self, device: str = "cpu", job_root: str | None = None,
-                 spawn=None):
+                 spawn=None, policy=None, fault_injector=None):
         self.device = device
         self.job_root = job_root
+        self._local_spawn = spawn is None
         self.spawn = spawn or self._spawn_local
         self._job_seq = 0
+        # Set by the retry driver (compaction/resilience.py) before each
+        # execute(); attempt N gets its own att-NN dir so a failed
+        # attempt's partial outputs never collide with the retry's.
+        self.attempt = 0
+        self.policy = policy          # DcompactOptions or None (defaults)
+        self.fault_injector = fault_injector
+        self.url = ""                 # transport identity (HTTP sets it)
+        self._plan = None             # active injected-fault plan
 
-    @staticmethod
-    def _spawn_local(job_dir: str, device: str) -> None:
+    def _spawn_local(self, job_dir: str, device: str) -> None:
         env = dict(os.environ)
         if device == "cpu":
             env.setdefault("JAX_PLATFORMS", "cpu")
+        if self._plan == "kill":
+            # The worker crashes hard mid-job (os._exit after heartbeats +
+            # partial output) — deterministically a kill -9.
+            env["TPULSM_TEST_WORKER_CRASH"] = "mid_job"
+        timeout = (self.policy.attempt_timeout
+                   if self.policy is not None else 3600.0)
         r = subprocess.run(
             [sys.executable, "-m", "toplingdb_tpu.compaction.worker",
              "--job-dir", job_dir],
-            capture_output=True, env=env, timeout=3600,
+            capture_output=True, env=env, timeout=timeout,
         )
         if r.returncode != 0:
             raise IOError_(
@@ -252,9 +270,24 @@ class SubprocessCompactionExecutor(CompactionExecutor):
         self._job_seq = next(_job_counter)
         job_root = self.job_root or os.path.join(db.dbname, "dcompact")
         job_dir = os.path.join(
-            job_root, f"job-{self._job_seq:05d}", "att-00"
+            job_root, f"job-{self._job_seq:05d}", f"att-{self.attempt:02d}"
         )
         os.makedirs(os.path.join(job_dir, "out"), exist_ok=True)
+        try:
+            return self._execute_in(db, compaction, snapshots,
+                                    new_file_number, job_dir)
+        except BaseException:
+            # Sweep THIS attempt's partial state (params, lease, partial
+            # outputs) so a retry or the on-open orphan sweep never sees
+            # half-written SSTs as live job state.
+            import shutil as _sh
+
+            _sh.rmtree(job_dir, ignore_errors=True)
+            self._rmdir_if_empty(os.path.dirname(job_dir))
+            raise
+
+    def _execute_in(self, db, compaction, snapshots, new_file_number,
+                    job_dir):
         opts = db.options
         if opts.compaction_filter is not None:
             # Unregistered filters can't travel the serialized boundary;
@@ -264,9 +297,13 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             )
 
             create_compaction_filter(opts.compaction_filter.name())
+        policy = self.policy
+        if policy is None:
+            policy = getattr(db.options, "dcompact", None)
+        lease_sec = policy.lease_sec if policy is not None else 30.0
         params = CompactionParams(
             job_id=self._job_seq,
-            attempt=0,
+            attempt=self.attempt,
             dbname=db.dbname,
             output_dir=os.path.join(job_dir, "out"),
             input_files=[
@@ -301,14 +338,37 @@ class SubprocessCompactionExecutor(CompactionExecutor):
                 serialize_collector_factory(f)
                 for f in opts.table_options.properties_collector_factories
             ],
+            lease_sec=lease_sec,
         )
         with open(os.path.join(job_dir, "params.json"), "w") as f:
             f.write(params.to_json())
+        from toplingdb_tpu.compaction.resilience import write_lease
+
+        write_lease(job_dir, self._job_seq, self.attempt, lease_sec)
+        inj = self.fault_injector
+        self._plan = inj.plan(self._job_seq, self.attempt) if inj else None
         t0 = time.time()
+        if inj is not None:
+            inj.before_spawn(self._plan)
+        if self._plan == "kill" and not self._local_spawn:
+            # Non-subprocess transports can't kill a real worker process;
+            # simulate the observable state of one: heartbeats + a partial
+            # output exist, then the connection dies.
+            with open(os.path.join(job_dir, "out", "partial.sst"), "wb") as f:
+                f.write(b"\x00" * 64)
+            raise IOError_("injected: worker killed mid-job")
         self.spawn(job_dir, self.device)
+        if inj is not None:
+            inj.after_spawn(self._plan, job_dir)
         rpc_usec = int((time.time() - t0) * 1e6)
-        with open(os.path.join(job_dir, "results.json")) as f:
-            results = CompactionResults.from_json(f.read())
+        try:
+            with open(os.path.join(job_dir, "results.json")) as f:
+                results = CompactionResults.from_json(f.read())
+        except (OSError, ValueError, TypeError) as e:
+            # Missing/truncated/garbage results.json: a worker crash
+            # between compute and a complete write — a transport failure,
+            # not DB corruption.
+            raise IOError_(f"dcompact results unreadable: {e!r}") from e
         if results.status != "ok":
             raise IOError_(f"worker error: {results.status}")
         # Rename outputs into the DB dir under fresh file numbers
@@ -329,26 +389,34 @@ class SubprocessCompactionExecutor(CompactionExecutor):
         return outputs, stats
 
     @staticmethod
-    def _cleanup(job_dir: str) -> None:
+    def _rmdir_if_empty(path: str) -> None:
         try:
-            for name in ("params.json", "results.json"):
-                p = os.path.join(job_dir, name)
-                if os.path.exists(p):
-                    os.remove(p)
-            out = os.path.join(job_dir, "out")
-            if os.path.isdir(out) and not os.listdir(out):
-                os.rmdir(out)
+            if os.path.isdir(path) and not os.listdir(path):
+                os.rmdir(path)
         except OSError:
             pass
+
+    @classmethod
+    def _cleanup(cls, job_dir: str) -> None:
+        """Remove the whole attempt dir (outputs were renamed into the DB
+        dir) and the job skeleton if this was its last attempt — a
+        successful job leaves NO residue for the on-open orphan sweep."""
+        import shutil as _sh
+
+        _sh.rmtree(job_dir, ignore_errors=True)
+        cls._rmdir_if_empty(os.path.dirname(job_dir))
 
 
 class SubprocessCompactionExecutorFactory(CompactionExecutorFactory):
     def __init__(self, device: str = "cpu", allow_fallback: bool = True,
-                 min_input_bytes: int = 0, job_root: str | None = None):
+                 min_input_bytes: int = 0, job_root: str | None = None,
+                 policy=None, fault_injector=None):
         self.device = device
         self._allow_fallback = allow_fallback
         self.min_input_bytes = min_input_bytes
         self.job_root = job_root
+        self.policy = policy                  # DcompactOptions or None
+        self.fault_injector = fault_injector  # DcompactFaultInjector
 
     def should_run_local(self, compaction: Compaction) -> bool:
         return compaction.total_input_bytes() < self.min_input_bytes
@@ -357,7 +425,9 @@ class SubprocessCompactionExecutorFactory(CompactionExecutorFactory):
         return self._allow_fallback
 
     def new_executor(self, compaction: Compaction) -> CompactionExecutor:
-        return SubprocessCompactionExecutor(self.device, self.job_root)
+        return SubprocessCompactionExecutor(
+            self.device, self.job_root, policy=self.policy,
+            fault_injector=self.fault_injector)
 
     def job_url(self, job_id: int, attempt: int) -> str:
         return f"file://{self.job_root or 'dcompact'}/job-{job_id:05d}/att-{attempt:02d}"

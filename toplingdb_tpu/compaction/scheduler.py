@@ -39,6 +39,10 @@ class CompactionScheduler:
         self.last_error: BaseException | None = None
         self.num_completed = 0
         self.num_trivial_moves = 0
+        # Graceful-degradation gate for remote compaction: after N
+        # consecutive remote JOB failures, jobs pin local for a cooldown
+        # (compaction/resilience.py). Lazily built from options.dcompact.
+        self._pin_gate = None
         # (retry_ts, FileMetaData) of marked-rewrite jobs postponed by
         # preclude_last_level_data_seconds; re-marked once aged.
         self._preclude_remark: list = []
@@ -336,18 +340,22 @@ class CompactionScheduler:
             return n
 
         try:
-            executor = None
             factory = db.options.compaction_executor_factory
             if factory is not None and not factory.should_run_local(c):
-                executor = factory.new_executor(c)
-            if executor is not None:
-                try:
-                    outputs, stats = executor.execute(db, c, snapshots, alloc)
-                except Exception:
-                    if not factory.allow_fallback_to_local():
-                        raise
-                    traceback.print_exc()
-                    outputs, stats = self._run_local(c, snapshots, alloc)
+                # The resilient path: per-attempt retry with backoff, a
+                # per-job deadline, breaker-aware worker picks, and the
+                # graceful-degradation local pin — with DCOMPACTION_*
+                # stats and listener events for every decision
+                # (compaction/resilience.py).
+                from toplingdb_tpu.compaction.resilience import (
+                    execute_resilient,
+                )
+
+                outputs, stats = execute_resilient(
+                    db, factory, c, snapshots, alloc,
+                    run_local=lambda: self._run_local(c, snapshots, alloc),
+                    gate=self._degradation_gate(),
+                )
             else:
                 outputs, stats = self._run_local(c, snapshots, alloc)
             if db.options.statistics is not None:
@@ -389,6 +397,17 @@ class CompactionScheduler:
         finally:
             with db._mutex:
                 db._pending_outputs.difference_update(pending)
+
+    def _degradation_gate(self):
+        if self._pin_gate is None:
+            from toplingdb_tpu.compaction.resilience import (
+                DcompactOptions, LocalPinGate,
+            )
+
+            policy = getattr(self.db.options, "dcompact", None) \
+                or DcompactOptions()
+            self._pin_gate = LocalPinGate(policy)
+        return self._pin_gate
 
     def _run_local(self, c: Compaction, snapshots, alloc):
         from toplingdb_tpu.db.blob import maybe_new_blob_gc

@@ -21,21 +21,7 @@ import traceback
 
 
 def run_job(job_dir: str) -> int:
-    from toplingdb_tpu.compaction.compaction_job import (
-        CompactionStats, build_outputs, surviving_tombstone_fragments,
-    )
-    from toplingdb_tpu.compaction.executor import (
-        CompactionParams, CompactionResults, encode_file_meta,
-    )
-    from toplingdb_tpu.compaction.picker import Compaction
-    from toplingdb_tpu.db import dbformat
-    from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone
-    from toplingdb_tpu.db.version_edit import FileMetaData
-    from toplingdb_tpu.env import default_env
-    from toplingdb_tpu.options import Options
-    from toplingdb_tpu.table.builder import TableOptions
-    from toplingdb_tpu.table.factory import open_table
-    from toplingdb_tpu.utils.compaction_filter import create_compaction_filter
+    from toplingdb_tpu.compaction.executor import CompactionParams
 
     t_enter = time.time()
     pjson = os.path.join(job_dir, "params.json")
@@ -47,6 +33,46 @@ def run_job(job_dir: str) -> int:
         waiting_usec = 0
     with open(pjson) as f:
         params = CompactionParams.from_json(f.read())
+    # Job lease: heartbeat the job dir while we run so the DB side (and a
+    # later DB open) can tell a live job from an orphan left by a crashed
+    # worker (compaction/resilience.py).
+    heartbeat = None
+    lease_sec = float(getattr(params, "lease_sec", 0.0) or 0.0)
+    if lease_sec > 0:
+        from toplingdb_tpu.compaction.resilience import HeartbeatWriter
+
+        heartbeat = HeartbeatWriter(job_dir, lease_sec).start()
+    try:
+        return _run_job_inner(job_dir, params, t_enter, waiting_usec)
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+
+
+def _run_job_inner(job_dir: str, params, t_enter: float,
+                   waiting_usec: int) -> int:
+    from toplingdb_tpu.compaction.compaction_job import (
+        CompactionStats, build_outputs, surviving_tombstone_fragments,
+    )
+    from toplingdb_tpu.compaction.executor import (
+        CompactionResults, encode_file_meta,
+    )
+    from toplingdb_tpu.compaction.picker import Compaction
+    from toplingdb_tpu.db import dbformat
+    from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.table.builder import TableOptions
+    from toplingdb_tpu.table.factory import open_table
+    from toplingdb_tpu.utils.compaction_filter import create_compaction_filter
+
+    if os.environ.get("TPULSM_TEST_WORKER_CRASH") == "mid_job":
+        # Chaos hook (resilience.DcompactFaultInjector "kill" plan): die
+        # the way kill -9 does — partial output on disk, heartbeats
+        # stopped, no results.json, no cleanup.
+        with open(os.path.join(params.output_dir, "partial.sst"),
+                  "wb") as f:
+            f.write(b"\x00" * 4096)
+        os._exit(137)
     t0 = time.time()
     env = default_env()
     if params.comparator == dbformat.BYTEWISE.name():

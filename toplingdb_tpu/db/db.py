@@ -494,6 +494,27 @@ class DB:
         except Exception:
             pass  # OPTIONS persistence is best-effort, like the reference
         db._delete_obsolete_files()
+        try:
+            # A kill -9'd dcompact worker leaves its job dir (params,
+            # partial outputs, stale heartbeat) behind; detect expiry by
+            # lease and sweep before background work starts. The job's
+            # inputs are still live in the version, so the picker simply
+            # re-runs it (compaction/resilience.py).
+            from toplingdb_tpu.compaction.resilience import (
+                DcompactOptions, sweep_orphan_jobs,
+            )
+
+            policy = options.dcompact or DcompactOptions()
+            roots = {_os.path.join(dbname, "dcompact")}
+            factory = options.compaction_executor_factory
+            if factory is not None and getattr(factory, "job_root", None):
+                roots.add(factory.job_root)
+            for root in roots:
+                sweep_orphan_jobs(root, policy.lease_sec,
+                                  statistics=options.statistics,
+                                  event_logger=db.event_logger)
+        except Exception:
+            pass  # sweeping is best-effort; never blocks open
         from toplingdb_tpu.compaction.scheduler import CompactionScheduler
 
         db._compaction_scheduler = CompactionScheduler(db)

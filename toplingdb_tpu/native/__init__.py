@@ -231,6 +231,21 @@ def lib() -> ctypes.CDLL | None:
         except AttributeError:
             pass
         try:
+            # Keys-copied / values-REFERENCED whole-file scan: val offsets
+            # point into the (uncompressed) file image the caller keeps
+            # alive as val_buf — no per-entry value memcpy.
+            l.tpulsm_scan_blocks_refvals.restype = ctypes.c_int64
+            l.tpulsm_scan_blocks_refvals.argtypes = [
+                u8p, ctypes.c_int64,                    # file buf, len
+                i64p, i64p, ctypes.c_int64,             # block offs/lens, n
+                ctypes.c_int32,                         # verify_crc
+                u8p, ctypes.c_int64,                    # key out + cap
+                i32p, i32p, i32p, i32p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,  # key_base, val_image_base
+            ]
+        except AttributeError:
+            pass
+        try:
             # Fused k-way merge + MVCC GC: ONE pass over presorted runs,
             # survivors only — replaces merge + numpy mask passes.
             l.tpulsm_merge_gc_runs.restype = ctypes.c_int64

@@ -1217,7 +1217,11 @@ int64_t tpulsm_decode_blocks(
   for (int64_t b = 0; b < n_blocks; b++) {
     int64_t off = block_offs[b];
     int64_t len = block_lens[b];
-    if (off < 0 || off + len + 5 > file_len) return -1;
+    // Overflow-safe (see tpulsm_scan_blocks): corrupt handles can carry
+    // negative or int64-wrapping off/len.
+    if (off < 0 || len < 0 || file_len < 5 || off > file_len - 5 ||
+        len > file_len - 5 - off)
+      return -1;
     uint8_t ctype = file_buf[off + len];
     if (ctype != 0) return -5;
     if (verify_crc) {
@@ -1720,7 +1724,11 @@ int64_t tpulsm_inflate_blocks(const uint8_t* file_buf, int64_t file_len,
   int64_t used = 0;
   for (int64_t b = 0; b < n; b++) {
     int64_t off = offs[b], len = lens[b];
-    if (off < 0 || off + len + 5 > file_len) return -3;
+    // Overflow-safe (see tpulsm_scan_blocks): corrupt handles can carry
+    // negative or int64-wrapping off/len.
+    if (off < 0 || len < 0 || file_len < 5 || off > file_len - 5 ||
+        len > file_len - 5 - off)
+      return -3;
     uint8_t t = file_buf[off + len];
     size_t ulen = 0;
     if (t == 0) {
@@ -1841,7 +1849,14 @@ int64_t tpulsm_scan_blocks(
   for (int64_t b = 0; b < n_blocks; b++) {
     int64_t off = block_offs[b];
     int64_t len = block_lens[b];
-    if (off < 0 || off + len + 5 > file_len) return -8;
+    // Overflow-safe bounds: a corrupt index handle can carry a negative
+    // len or an off/len pair whose sum wraps int64; `off + len + 5` would
+    // then pass the naive check and read out of bounds BEFORE the CRC
+    // ever sees the block. Every comparison below stays within
+    // [0, file_len], so nothing can wrap.
+    if (off < 0 || len < 0 || file_len < 5 || off > file_len - 5 ||
+        len > file_len - 5 - off)
+      return -8;
     uint8_t t = file_buf[off + len];
     if (verify_crc) {
       uint32_t stored;
@@ -1939,7 +1954,11 @@ int64_t tpulsm_scan_blocks_refvals(
   for (int64_t b = 0; b < n_blocks; b++) {
     int64_t off = block_offs[b];
     int64_t len = block_lens[b];
-    if (off < 0 || off + len + 5 > file_len) return -8;
+    // Same overflow-safe bounds as tpulsm_scan_blocks: reject negative
+    // lengths and signed-wrap off+len before touching file_buf.
+    if (off < 0 || len < 0 || file_len < 5 || off > file_len - 5 ||
+        len > file_len - 5 - off)
+      return -8;
     if (file_buf[off + len] != 0) return -5;  // compressed: inflate first
     if (verify_crc) {
       uint32_t stored;

@@ -220,10 +220,14 @@ def scan_tables_columnar_prealloc(readers):
     return kv, parts
 
 
-def scan_table_columnar(reader) -> ColumnarKV:
+def scan_table_columnar(reader, ref_values: bool = True) -> ColumnarKV:
     """Whole-file bulk scan through the native block decoder. Uncompressed
-    files decode in ONE native call over the raw file bytes; compressed files
-    fall back to per-block decompression + decode."""
+    files decode in ONE native call over the raw file bytes — values
+    REFERENCED into the file image (tpulsm_scan_blocks_refvals: the image
+    stays alive as val_buf, saving the per-entry value memcpy), keys
+    copied; compressed files fall back to per-block decompression +
+    decode. `ref_values=False` forces the value-copying twin (parity
+    tests)."""
     lib = native.lib()
     if lib is None:
         raise NotSupported("native library unavailable")
@@ -235,6 +239,12 @@ def scan_table_columnar(reader) -> ColumnarKV:
             np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
             np.zeros(0, np.uint8), np.zeros(0, np.int32), np.zeros(0, np.int32),
         )
+
+    if ref_values:
+        kv = _refvals_decode(lib, raw, block_offs, block_lens,
+                             reader.opts.verify_checksums)
+        if kv is not None:
+            return kv
 
     # Bulk path: all blocks in one native call over the raw image.
     kv = _bulk_decode(lib, raw, block_offs, block_lens,
@@ -309,6 +319,60 @@ def scan_table_columnar(reader) -> ColumnarKV:
     if kv is None:
         raise Corruption("decompressed blocks failed native bulk decode")
     return kv
+
+
+def _refvals_decode(lib, raw, block_offs, block_lens, verify):
+    """Values-referenced whole-file scan (tpulsm_scan_blocks_refvals): keys
+    decode into a dense buffer; val_offs point INTO the raw file image,
+    which becomes val_buf zero-copy. Returns None when ineligible (symbol
+    missing, compressed blocks, int32 budget, long keys) — the caller then
+    uses the value-copying path, which is also the authority on whether a
+    block is actually corrupt."""
+    if not hasattr(lib, "tpulsm_scan_blocks_refvals"):
+        return None
+    rawb = np.frombuffer(bytes(raw), dtype=np.uint8) \
+        if not isinstance(raw, np.ndarray) else raw
+    file_size = len(rawb)
+    if file_size > 0x7FFFFF00:
+        return None  # val offsets must fit the int32 columnar budget
+    data_bytes = int(block_lens.sum())
+    key_cap = 4 * data_bytes + 4096
+    max_e = data_bytes // 3 + 64
+    while True:
+        key_out = np.empty(key_cap, dtype=np.uint8)
+        key_offs = np.empty(max_e, dtype=np.int32)
+        key_lens = np.empty(max_e, dtype=np.int32)
+        val_offs = np.empty(max_e, dtype=np.int32)
+        val_lens = np.empty(max_e, dtype=np.int32)
+        rc = lib.tpulsm_scan_blocks_refvals(
+            native.np_u8p(rawb), file_size,
+            native.np_i64p(block_offs), native.np_i64p(block_lens),
+            len(block_offs), 1 if verify else 0,
+            native.np_u8p(key_out), key_cap,
+            native.np_i32p(key_offs), native.np_i32p(key_lens),
+            native.np_i32p(val_offs), native.np_i32p(val_lens), max_e,
+            0, 0,
+        )
+        if rc == -2:
+            key_cap *= 4
+            continue
+        if rc == -4:
+            max_e *= 4
+            continue
+        if rc == -6:
+            raise Corruption("block checksum mismatch (refvals scan)")
+        if rc < 0:
+            # -5 compressed, -7 offset budget, -8 long-key/corrupt: let the
+            # copying path decide (it supports what this one doesn't and
+            # raises the proper error for real corruption).
+            return None
+        n = int(rc)
+        key_used = int(key_offs[n - 1] + key_lens[n - 1]) if n else 0
+        return ColumnarKV(
+            key_out[:key_used].copy(), key_offs[:n].copy(),
+            key_lens[:n].copy(),
+            rawb, val_offs[:n].copy(), val_lens[:n].copy(),
+        )
 
 
 def _bulk_decode(lib, raw, block_offs, block_lens, verify):

@@ -169,6 +169,11 @@ class Options:
 
     # -- distributed compaction (the dcompact boundary) -----------------
     compaction_executor_factory: Any = None  # CompactionExecutorFactory
+    # Failure policy around the boundary: per-attempt retry with backoff +
+    # jitter, per-job deadline, circuit-breaker thresholds, local-pin
+    # degradation, and the job-lease duration (compaction/resilience.py).
+    # JSON-configurable under the "dcompact" key (utils/config.py).
+    dcompact: Any = None  # DcompactOptions; None = defaults, lazily built
 
     # -- observability --------------------------------------------------
     statistics: Any = None

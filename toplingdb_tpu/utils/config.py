@@ -94,6 +94,15 @@ def _register_builtins(reg: ObjectRegistry) -> None:
                  DeviceCompactionExecutorFactory)
     reg.register("compaction_executor_factory", "subprocess",
                  SubprocessCompactionExecutorFactory)
+
+    def _http_factory(worker_urls=(), **kw):
+        from toplingdb_tpu.compaction.dcompact_service import (
+            HttpCompactionExecutorFactory,
+        )
+
+        return HttpCompactionExecutorFactory(list(worker_urls), **kw)
+
+    reg.register("compaction_executor_factory", "http", _http_factory)
     reg.register("statistics", "default", Statistics)
     from toplingdb_tpu.utils.slice_transform import (
         CappedPrefixTransform, FixedPrefixTransform, NoopTransform,
@@ -169,6 +178,10 @@ def options_from_config(cfg: dict):
             opts.compaction_executor_factory = reg.create(
                 "compaction_executor_factory", v
             )
+        elif k == "dcompact":
+            from toplingdb_tpu.compaction.resilience import DcompactOptions
+
+            opts.dcompact = DcompactOptions.from_config(v)
         elif k == "statistics":
             opts.statistics = reg.create("statistics", v)
         elif k == "table_options":
@@ -215,6 +228,10 @@ def options_to_config(opts) -> dict:
         out["compaction_filter"] = "remove_empty_value"
     if opts.statistics is not None:
         out["statistics"] = "default"
+    if opts.dcompact is not None:
+        dc = opts.dcompact.to_config()
+        if dc:
+            out["dcompact"] = dc
     pe = opts.prefix_extractor
     if pe is not None:
         pname = pe.name()

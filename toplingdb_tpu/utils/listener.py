@@ -45,6 +45,31 @@ class IngestionInfo:
     level: int
 
 
+@dataclass
+class DcompactAttemptInfo:
+    """One remote compaction attempt (compaction/resilience.py): fired on
+    success AND failure, so monitoring can attribute every retry and
+    fallback to a worker."""
+
+    db_name: str
+    job_id: int
+    attempt: int          # 0-based
+    url: str              # "" for non-URL transports (subprocess/device)
+    ok: bool
+    error: str | None
+    elapsed_micros: int
+    will_retry: bool
+
+
+@dataclass
+class WorkerHealthInfo:
+    """A worker circuit-breaker state TRANSITION (open/close)."""
+
+    url: str
+    state: str            # CircuitBreaker.CLOSED / OPEN / HALF_OPEN
+    consecutive_failures: int
+
+
 class EventListener:
     """Override any subset (reference EventListener)."""
 
@@ -64,6 +89,12 @@ class EventListener:
         pass
 
     def on_background_error(self, db, error: BaseException) -> None:
+        pass
+
+    def on_dcompact_attempt(self, db, info: DcompactAttemptInfo) -> None:
+        pass
+
+    def on_worker_health_changed(self, db, info: WorkerHealthInfo) -> None:
         pass
 
 

@@ -158,6 +158,8 @@ class PersistentCache:
             return  # only raw blocks spill to disk
         value = bytes(value)
         with self._mu:
+            if self._closed:
+                return  # writes must not resurrect a shut-down tier
             if (key in self._index or key in self._pending
                     or key in self._inflight):
                 return
@@ -196,6 +198,12 @@ class PersistentCache:
     def _write_record(self, key: bytes, value: bytes) -> None:
         rec, poff, plen, flags = self._encode(key, value)
         with self._mu:
+            if self._closed and self._cur_f is None:
+                # Fully shut down (close() already closed the data file):
+                # appending would silently roll a FRESH cache file. The
+                # `_cur_f is not None` window keeps close()'s own final
+                # flush of queued inserts working.
+                return
             self._append_locked(key, rec, poff, plen, flags)
 
     def _append_locked(self, key, rec, poff, plen, flags) -> None:

@@ -77,6 +77,18 @@ LCOMPACTION_READ_BYTES = "lcompaction.read.bytes"
 LCOMPACTION_WRITE_BYTES = "lcompaction.write.bytes"
 DCOMPACTION_READ_BYTES = "dcompaction.read.bytes"
 DCOMPACTION_WRITE_BYTES = "dcompaction.write.bytes"
+# -- dcompact resilience (compaction/resilience.py) ------------------
+DCOMPACTION_ATTEMPTS = "dcompaction.attempts"            # remote tries
+DCOMPACTION_RETRIES = "dcompaction.retries"              # re-tries only
+DCOMPACTION_JOB_FAILURES = "dcompaction.job.failures"    # attempts exhausted
+DCOMPACTION_FALLBACK_LOCAL = "dcompaction.fallback.local"
+DCOMPACTION_FALLBACK_PINNED = "dcompaction.fallback.pinned"
+DCOMPACTION_LOCAL_PINS = "dcompaction.local.pins"        # gate engagements
+DCOMPACTION_DEADLINE_EXCEEDED = "dcompaction.deadline.exceeded"
+DCOMPACTION_BREAKER_OPEN = "dcompaction.breaker.open"
+DCOMPACTION_BREAKER_CLOSE = "dcompaction.breaker.close"
+DCOMPACTION_BREAKER_SKIPPED = "dcompaction.breaker.skipped"
+DCOMPACTION_ORPHANS_SWEPT = "dcompaction.orphans.swept"
 # -- flush / WAL / files ---------------------------------------------
 FLUSH_WRITE_BYTES = "flush.write.bytes"
 NO_FILE_OPENS = "no.file.opens"
@@ -122,6 +134,7 @@ DCOMPACTION_TIME_MICROS = "dcompaction.time.micros"
 DCOMPACTION_PREPARE_MICROS = "dcompaction.prepare.micros"
 DCOMPACTION_WAITING_MICROS = "dcompaction.waiting.micros"
 DCOMPACTION_RPC_MICROS = "dcompaction.rpc.micros"
+DCOMPACTION_ATTEMPT_MICROS = "dcompaction.attempt.micros"
 FLUSH_TIME_MICROS = "flush.time.micros"
 SST_READ_MICROS = "sst.read.micros"
 TABLE_OPEN_IO_MICROS = "table.open.io.micros"
