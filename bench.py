@@ -66,10 +66,12 @@ def file_probe_evidence(detail, probe_diags):
 
 def fill_phase_detail(detail, stats):
     """phase_breakdown + top_phases from a CompactionStats — NUMERIC values
-    only in the sort (phase_dict can carry a string overlap_note)."""
+    only in the sort, excluding the derived overlap row (it is not a busy
+    phase; it is sum(phases) - wall under the pipelined data plane)."""
     detail["phase_breakdown"] = stats.phase_dict()
     phases = {k: v for k, v in detail["phase_breakdown"].items()
-              if k != "work_time_s" and isinstance(v, (int, float))}
+              if k not in ("work_time_s", "pipeline_overlap_s")
+              and isinstance(v, (int, float))}
     detail["top_phases"] = sorted(phases, key=phases.get, reverse=True)[:2]
 
 
@@ -473,6 +475,21 @@ def main():
                                        t_none, device, max(1, runs - 1), 5000)
         detail["compaction_nocomp_MBps"] = round(
             RAW_PER_ENTRY * n_small / dt2 / 1e6, 2)
+        # Same job with the pipeline forced OFF: the serial comparator for
+        # compaction_nocomp_MBps (which runs pipelined by default).
+        saved_pipe = os.environ.get("TPULSM_PIPELINE")
+        os.environ["TPULSM_PIPELINE"] = "0"
+        try:
+            dt2s, _, _, _ = time_compaction(
+                env, sbase, icmp, sm["none"], t_none, t_none, device,
+                max(1, runs - 1), 5200)
+            detail["compaction_nocomp_serial_MBps"] = round(
+                RAW_PER_ENTRY * n_small / dt2s / 1e6, 2)
+        finally:
+            if saved_pipe is None:
+                os.environ.pop("TPULSM_PIPELINE", None)
+            else:
+                os.environ["TPULSM_PIPELINE"] = saved_pipe
         if device in ("tpu", "cpu-jax") and not tpu_fallback:
             # Same job with FULL on-device block assembly
             # (TPULSM_DEVICE_BLOCKS=1; single shard, uncompressed — its
@@ -616,6 +633,14 @@ def main():
             "vs_baseline": round(mbps / BASELINE_MBPS, 4),
             "device": device,
             "tpu_unreachable_cpu_fallback": tpu_fallback,
+            # Pipelined-data-plane headline rows: measured scan/compute/
+            # encode overlap of the headline job, and the pipelined
+            # nocomp variant (its serial twin is
+            # detail.compaction_nocomp_serial_MBps).
+            "pipeline_overlap_s": detail.get("phase_breakdown", {}).get(
+                "pipeline_overlap_s", 0.0),
+            "compaction_pipelined_MBps": detail.get(
+                "compaction_nocomp_MBps"),
         }
 
     line = json.dumps(make_record(detail))

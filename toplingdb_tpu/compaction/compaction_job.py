@@ -58,21 +58,28 @@ class CompactionStats:
     resolve_usec: int = 0       # host complex-group (merge/SD) resolution
     encode_write_usec: int = 0  # SST block build + frame + file write
     finish_usec: int = 0        # trailer decode, zero-seq patch, output metas
+    pipeline_stall_usec: int = 0  # writer starved waiting on compute chunks
+    prefetch_hits: int = 0      # input-scan reads served from readahead
+    prefetch_misses: int = 0    # input-scan reads that went to the file
     device: str = "cpu"
     remote: bool = False        # ran in a worker process (dcompact)
 
     def phase_dict(self) -> dict:
         """Non-zero timing phases, seconds — for bench/dcompact reporting.
-        Includes an `other_s` residual so the phases ALWAYS sum to
-        work_time_s (VERDICT r04 item weak-3): any wall the named timers
-        missed is reported, not hidden. Under the streamed shard path
-        device waits overlap the encode loop, so the residual can be 0
-        while named phases over-count; `overlap_note` flags that case."""
+        Includes an `other_s` residual (clamped at 0) so the phases sum to
+        at least work_time_s (VERDICT r04 item weak-3): wall the named
+        timers missed is reported, not hidden. Under the pipelined and
+        streamed-shard paths the stages run concurrently, so the named
+        phases OVER-count wall time; that over-count is reported
+        explicitly as `pipeline_overlap_s` = sum(phases) - wall — the
+        wall-clock the pipeline saved versus running the phases back to
+        back."""
         out = {}
         accounted = 0
         for f in ("input_scan_usec", "host_compute_usec",
                   "transfer_time_usec", "device_wait_usec", "resolve_usec",
-                  "encode_write_usec", "finish_usec", "work_time_usec"):
+                  "encode_write_usec", "finish_usec", "pipeline_stall_usec",
+                  "work_time_usec"):
             v = getattr(self, f)
             if v:
                 out[f.replace("_usec", "_s")] = round(v / 1e6, 3)
@@ -80,12 +87,9 @@ class CompactionStats:
                     accounted += v
         resid = self.work_time_usec - accounted
         if self.work_time_usec:
-            if resid >= 0:
-                out["other_s"] = round(resid / 1e6, 3)
-            else:
-                out["overlap_note"] = (
-                    "named phases overlap (streamed shards); sum exceeds "
-                    f"wall by {round(-resid / 1e6, 3)}s")
+            out["other_s"] = round(max(0, resid) / 1e6, 3)
+            if resid < 0:
+                out["pipeline_overlap_s"] = round(-resid / 1e6, 3)
         return out
 
 
@@ -426,6 +430,12 @@ def _run_subcompactions(env, dbname, icmp, compaction, table_cache,
             st.dropped_obsolete = ci.num_dropped_obsolete
             st.dropped_tombstone = ci.num_dropped_tombstone
             st.merged_records = ci.num_merged
+            for ch in children:
+                pc = getattr(ch, "prefetch_counts", None)
+                if pc is not None:
+                    h, m = pc()
+                    st.prefetch_hits += h
+                    st.prefetch_misses += m
             results[idx] = (outs, st)
         except BaseException as e:  # noqa: BLE001 — surfaced by the driver
             errors.append(e)
@@ -457,6 +467,8 @@ def _run_subcompactions(env, dbname, icmp, compaction, table_cache,
         stats.dropped_obsolete += st.dropped_obsolete
         stats.dropped_tombstone += st.dropped_tombstone
         stats.merged_records += st.merged_records
+        stats.prefetch_hits += st.prefetch_hits
+        stats.prefetch_misses += st.prefetch_misses
     return outputs
 
 

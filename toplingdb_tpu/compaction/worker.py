@@ -114,13 +114,72 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
         ],
     )
 
-    # Read inputs (raw, unsorted — the device sort is the merge).
+    from toplingdb_tpu.db.blob import BlobSource
+    from toplingdb_tpu.db.version_edit import FileMetaData
+
+    blob_source = BlobSource(env, params.dbname)
+    counter = [0]
+
+    def alloc():
+        counter[0] += 1
+        return counter[0]
+
+    device_job = params.device in ("tpu", "cpu-jax", "device")
+    if device_job and ucmp.name() == dbformat.BYTEWISE.name():
+        # Full data plane — the same columnar/pipelined path the in-process
+        # device executor takes (ops/device_compaction.py), so the worker
+        # overlaps scan/compute/encode and reports the per-phase shape
+        # (input_scan/host_compute/device_wait/encode_write/stall) in
+        # results.json instead of one opaque work_time.
+        from toplingdb_tpu.ops.device_compaction import run_device_compaction
+
+        readers = {}
+        metas = []
+        for i, path in enumerate(params.input_files, 1):
+            readers[i] = open_table(env.new_random_access_file(path), icmp,
+                                    topts)
+            metas.append(FileMetaData(number=i,
+                                      file_size=env.get_file_size(path)))
+        fake_compaction = Compaction(
+            level=0, output_level=params.output_level, inputs=metas,
+            bottommost=params.bottommost,
+            max_output_file_size=params.max_output_file_size,
+        )
+        outputs, stats = run_device_compaction(
+            env, params.output_dir, icmp, fake_compaction,
+            _PathTableCache(readers), topts, params.snapshots,
+            merge_operator=merge_op, compaction_filter=cfilter,
+            new_file_number=alloc, creation_time=params.creation_time,
+            device_name=params.device, blob_resolver=blob_source.get,
+            column_family=(getattr(params, "cf_id", 0),
+                           getattr(params, "cf_name", "default")),
+        )
+        stats.input_files = len(params.input_files)
+        stats.input_bytes = sum(
+            env.get_file_size(p) for p in params.input_files)
+        stats.prepare_time_usec = max(
+            0, int((time.time() - t_enter) * 1e6) - stats.work_time_usec)
+        stats.waiting_time_usec = waiting_usec
+        results = CompactionResults(
+            status="ok",
+            output_files=[
+                encode_file_meta(m, f"{m.number:06d}.sst") for m in outputs
+            ],
+            stats=dataclasses.asdict(stats),
+            work_time_usec=stats.work_time_usec,
+        )
+        with open(os.path.join(job_dir, "results.json"), "w") as f:
+            f.write(results.to_json())
+        return 0
+
+    # Per-entry path (CPU jobs and exotic comparators): read inputs raw —
+    # unsorted for the device stream, host-sorted for the CPU reference.
     entries = []
     rd = RangeDelAggregator(ucmp)
-    readers = []
+    readers_l = []
     for path in params.input_files:
         r = open_table(env.new_random_access_file(path), icmp, topts)
-        readers.append(r)
+        readers_l.append(r)
         it = r.new_iterator()
         it.seek_to_first()
         for k, v in it.entries():
@@ -143,16 +202,7 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
         max_output_file_size=params.max_output_file_size,
     )
 
-    counter = [0]
-
-    def alloc():
-        counter[0] += 1
-        return counter[0]
-
-    from toplingdb_tpu.db.blob import BlobSource
-
-    blob_source = BlobSource(env, params.dbname)
-    if params.device in ("tpu", "cpu-jax", "device"):
+    if device_job:
         from toplingdb_tpu.ops.device_compaction import device_gc_entries
 
         stream = device_gc_entries(
@@ -205,6 +255,17 @@ def _merge_operator_by_name(name: str):
     from toplingdb_tpu.utils.merge_operator import create_merge_operator
 
     return create_merge_operator(name)
+
+
+class _PathTableCache:
+    """TableCache-shaped view over the job's already-open input readers
+    (the worker addresses inputs by path, not by live version state)."""
+
+    def __init__(self, readers: dict):
+        self._readers = readers
+
+    def get_reader(self, number: int):
+        return self._readers[number]
 
 
 class _ListIter:

@@ -1911,25 +1911,28 @@ class DB:
         # 2. SSTs: group keys by candidate file so each reader/iterator is
         # reused across the batch (the fiber MultiGet's IO-batching effect).
         version = self.versions.cf_current(cfd.handle.id)
+        # Per-file tombstone parses are memoized ONCE per batch and shared
+        # by both the fiber path and the sync per-file loop below. The
+        # probe runs under the lock: a bare dict.get racing the insert
+        # relies on CPython's GIL atomicity; one uncontended acquire on
+        # the hit path buys correctness on any runtime, and the parse
+        # stays inside the lock so a file is never parsed twice.
+        tombs_cache: dict[int, list] = {}
+        cache_mu = threading.Lock()
+
+        def tombs_for(f):
+            with cache_mu:
+                t = tombs_cache.get(f.number)
+                if t is None:
+                    t = self._parsed_tombstones(
+                        self.table_cache.get_reader(f.number))
+                    tombs_cache[f.number] = t
+            return t
+
         if live and opts.async_io and len(live) > 1:
             # Fiber-MultiGet analogue: each missing key walks its own file
             # chain on a worker thread (one "fiber" per key; file pread
-            # releases the GIL, so misses overlap their IO). Per-file
-            # tombstone parses are memoized across the batch.
-            tombs_cache: dict[int, list] = {}
-            cache_mu = threading.Lock()
-
-            def tombs_for(f):
-                t = tombs_cache.get(f.number)
-                if t is None:
-                    with cache_mu:
-                        t = tombs_cache.get(f.number)
-                        if t is None:
-                            t = self._parsed_tombstones(
-                                self.table_cache.get_reader(f.number))
-                            tombs_cache[f.number] = t
-                return t
-
+            # releases the GIL, so misses overlap their IO).
             pool = self._mget_pool
             if pool is None:
                 from concurrent.futures import ThreadPoolExecutor
@@ -1961,7 +1964,7 @@ class DB:
                 if not todo:
                     continue
                 reader = self.table_cache.get_reader(f.number)
-                tombs = self._parsed_tombstones(reader)  # once per file
+                tombs = tombs_for(f)  # once per file per batch (shared memo)
                 it = None
                 for k in sorted(todo):
                     ctx = live.get(k)

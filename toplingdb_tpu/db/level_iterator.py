@@ -13,14 +13,35 @@ class LevelIterator:
         self._icmp = icmp
         self._file_idx = -1
         self._iter = None
+        self._pf_hits = 0    # readahead counts of already-closed file iters
+        self._pf_misses = 0
 
     def _open(self, idx: int) -> None:
+        self._bank_prefetch()
         self._file_idx = idx
         if 0 <= idx < len(self._files):
             reader = self._tc.get_reader(self._files[idx].number)
             self._iter = reader.new_iterator()
         else:
             self._iter = None
+
+    def _bank_prefetch(self) -> None:
+        pc = getattr(self._iter, "prefetch_counts", None)
+        if pc is not None:
+            h, m = pc()
+            self._pf_hits += h
+            self._pf_misses += m
+
+    def prefetch_counts(self) -> tuple[int, int]:
+        """(hits, misses) of every file iterator's FilePrefetchBuffer so
+        far — the compaction input scan exports these as tickers."""
+        h, m = self._pf_hits, self._pf_misses
+        pc = getattr(self._iter, "prefetch_counts", None)
+        if pc is not None:
+            ch, cm = pc()
+            h += ch
+            m += cm
+        return h, m
 
     def valid(self) -> bool:
         return self._iter is not None and self._iter.valid()

@@ -398,9 +398,23 @@ def run_block_assembly(env, dbname, icmp, kv, shard, cover, snapshots,
     bpayload = np.asarray(bpayload)[:nb]
     bfirst = np.asarray(bfirst)[:nb]
     blast = np.asarray(blast)[:nb]
-    # Download just the payload bytes (device-side slice avoids the pad).
-    payload = np.asarray(out[:total_payload]) if total_payload else \
-        np.zeros(0, np.uint8)
+    # Download the payload in ~8 MiB sections cut at block boundaries,
+    # with every section's D2H copy enqueued up front: the host frames
+    # (crc + index bookkeeping) section k while sections k+1.. are still
+    # streaming back, instead of blocking on one monolithic download.
+    bends = np.cumsum(bpayload, dtype=np.int64) if nb else \
+        np.zeros(0, np.int64)
+    sections = []  # (blk_lo, blk_hi, base_off, device_slice)
+    blk_lo = 0
+    base_off = 0
+    for b in range(nb):
+        if int(bends[b]) - base_off >= (8 << 20) or b == nb - 1:
+            dev = out[base_off:int(bends[b])]
+            if hasattr(dev, "copy_to_host_async"):
+                dev.copy_to_host_async()
+            sections.append((blk_lo, b + 1, base_off, dev))
+            blk_lo = b + 1
+            base_off = int(bends[b])
 
     lmap = _ranges_lmap(ranges)
     want_bloom = (table_options.filter_policy is not None
@@ -426,23 +440,21 @@ def run_block_assembly(env, dbname, icmp, kv, shard, cover, snapshots,
     sst = _ColumnarSST(env, dbname, fnum, icmp, table_options, creation_time,
                        column_family)
     try:
-        # Frame blocks: payload + type(0) + masked crc32c, in bulk sections.
-        off = 0
-        section = bytearray()
-        blocks = []
-        for b in range(nb):
-            pl = int(bpayload[b])
-            raw = payload[off:off + pl].tobytes()
-            off += pl
-            crc = crc32c.mask(crc32c.extend(0, raw + b"\x00"))
-            section += raw + b"\x00" + crc.to_bytes(4, "little")
-            blocks.append((pl, pl, boundary_ikey(int(bfirst[b])),
-                           boundary_ikey(int(blast[b])), int(bcnt[b])))
-            if len(section) >= 8 << 20:
-                sst.add_framed_section(bytes(section), blocks)
-                section = bytearray()
-                blocks = []
-        if section or blocks:
+        # Frame blocks: payload + type(0) + masked crc32c, one framed run
+        # per downloaded section (consumed as its copy completes).
+        for s_lo, s_hi, s_base, dev in sections:
+            chunk = np.asarray(dev)  # blocks on THIS section's copy only
+            section = bytearray()
+            blocks = []
+            off = 0
+            for b in range(s_lo, s_hi):
+                pl = int(bpayload[b])
+                raw = chunk[off:off + pl].tobytes()
+                off += pl
+                crc = crc32c.mask(crc32c.extend(0, raw + b"\x00"))
+                section += raw + b"\x00" + crc.to_bytes(4, "little")
+                blocks.append((pl, pl, boundary_ikey(int(bfirst[b])),
+                               boundary_ikey(int(blast[b])), int(bcnt[b])))
             sst.add_framed_section(bytes(section), blocks)
         pre = {
             "num_entries": mtot,

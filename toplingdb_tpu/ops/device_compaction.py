@@ -32,9 +32,10 @@ from toplingdb_tpu.ops import compaction_kernels as ck
 from toplingdb_tpu.ops.columnar import ColumnarEntries
 
 
-def collect_raw_entries(compaction, table_cache, icmp):
+def collect_raw_entries(compaction, table_cache, icmp, stats=None):
     """Sequentially read every input file's entries (NO host merge — the
-    device sort is the merge). Returns (entries list, RangeDelAggregator)."""
+    device sort is the merge). Returns (entries list, RangeDelAggregator);
+    `stats` (CompactionStats) accumulates the scan's readahead counters."""
     entries: list[tuple[bytes, bytes]] = []
     rd = RangeDelAggregator(icmp.user_comparator)
     for _, f in compaction.all_inputs():
@@ -43,6 +44,10 @@ def collect_raw_entries(compaction, table_cache, icmp):
         it.seek_to_first()
         for k, v in it.entries():
             entries.append((k, v))
+        if stats is not None:
+            h, m = it.prefetch_counts()
+            stats.prefetch_hits += h
+            stats.prefetch_misses += m
         for b, e in r.range_del_entries():
             rd.add(RangeTombstone.from_table_entry(b, e))
     return entries, rd
@@ -534,6 +539,40 @@ def _resolve_complex_stream(kv, order, cx_flags, trailer_override, seqs,
     return order[keep_mask]
 
 
+def _outputs_from_files(env, files, kv, vtypes, stats):
+    """Output FileMetaData list from write_tables_columnar tuples: empty
+    outputs deleted, blob refs decoded from surviving BLOB_INDEX rows —
+    shared by the serial columnar and pipelined paths."""
+    from toplingdb_tpu.db.blob import decode_blob_index
+    from toplingdb_tpu.db.version_edit import FileMetaData
+
+    outputs = []
+    for fnum, path, props, smallest, largest, sel in files:
+        if props.num_entries == 0 and props.num_range_deletions == 0:
+            env.delete_file(path)
+            continue
+        blob_refs = set()
+        bi_mask = vtypes[sel] == dbformat.ValueType.BLOB_INDEX
+        if bi_mask.any():
+            for oi in sel[bi_mask]:
+                blob_refs.add(decode_blob_index(kv.value(oi))[0])
+        meta = FileMetaData(
+            number=fnum, file_size=env.get_file_size(path),
+            smallest=smallest, largest=largest,
+            smallest_seqno=props.smallest_seqno,
+            largest_seqno=props.largest_seqno,
+            num_entries=props.num_entries,
+            num_deletions=props.num_deletions,
+            num_range_deletions=props.num_range_deletions,
+            blob_refs=sorted(blob_refs),
+        )
+        outputs.append(meta)
+        stats.output_bytes += meta.file_size
+        stats.output_files += 1
+        stats.output_records += props.num_entries
+    return outputs
+
+
 def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                                     table_options, snapshots, merge_operator,
                                     new_file_number, creation_time,
@@ -551,6 +590,29 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()
     stats.input_files = len(compaction.all_inputs())
+
+    # Pipelined data plane first: scan, sort+GC and encode overlap at
+    # key-range-shard granularity (ops/pipeline.py), byte-identical
+    # outputs. Shapes it does not cover fall through to the serial path
+    # below with clean stats.
+    from toplingdb_tpu.ops import pipeline as pl
+
+    if pl.pipeline_enabled(table_options):
+        pstats = CompactionStats(device=device_name)
+        pstats.input_bytes = stats.input_bytes
+        pstats.input_files = stats.input_files
+        try:
+            pfiles, pkv, pvt, _ptombs = pl.run_pipelined(
+                env, dbname, icmp, compaction, table_cache, table_options,
+                snapshots, new_file_number, creation_time, pstats,
+                MAX_DEVICE_KEY_BYTES, column_family,
+            )
+        except (pl.PipelineIneligible, NotSupported):
+            pass  # serial path decides (and re-raises what it must)
+        else:
+            outputs = _outputs_from_files(env, pfiles, pkv, pvt, pstats)
+            pstats.work_time_usec = int((time.time() - t0) * 1e6)
+            return outputs, pstats
     try:
         kv, rd, shards, parts = _collect_raw_columnar(
             compaction, table_cache, icmp, want_uploads=not _host_sort(),
@@ -776,31 +838,7 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             # Native builder refused (oversized key / restart overflow):
             # the per-entry path handles these (partials already cleaned).
             raise _FallbackToEntries()
-        from toplingdb_tpu.db.blob import decode_blob_index
-
-        for fnum, path, props, smallest, largest, sel in files:
-            if props.num_entries == 0 and props.num_range_deletions == 0:
-                env.delete_file(path)
-                continue
-            blob_refs = set()
-            bi_mask = vtypes[sel] == dbformat.ValueType.BLOB_INDEX
-            if bi_mask.any():
-                for oi in sel[bi_mask]:
-                    blob_refs.add(decode_blob_index(kv.value(oi))[0])
-            meta = FileMetaData(
-                number=fnum, file_size=env.get_file_size(path),
-                smallest=smallest, largest=largest,
-                smallest_seqno=props.smallest_seqno,
-                largest_seqno=props.largest_seqno,
-                num_entries=props.num_entries,
-                num_deletions=props.num_deletions,
-                num_range_deletions=props.num_range_deletions,
-                blob_refs=sorted(blob_refs),
-            )
-            outputs.append(meta)
-            stats.output_bytes += meta.file_size
-            stats.output_files += 1
-            stats.output_records += props.num_entries
+        outputs = _outputs_from_files(env, files, kv, vtypes, stats)
     stats.encode_write_usec = int((time.time() - t_wr) * 1e6)
     stats.work_time_usec = int((time.time() - t0) * 1e6)
     return outputs, stats
@@ -867,7 +905,7 @@ def run_device_compaction(env, dbname, icmp, compaction, table_cache,
     stats = CompactionStats(device=device_name)
     stats.input_bytes = compaction.total_input_bytes()
     stats.input_files = len(compaction.all_inputs())
-    entries, rd = collect_raw_entries(compaction, table_cache, icmp)
+    entries, rd = collect_raw_entries(compaction, table_cache, icmp, stats)
     stats.input_records = len(entries)
     rd_or_none = None if rd.empty() else rd
     stream = device_gc_entries(

@@ -14,9 +14,15 @@ from __future__ import annotations
 
 class FilePrefetchBuffer:
     """Wraps a RandomAccessFile with auto-readahead. Presents the same
-    read(offset, n) surface, so fmt.read_block can consume it directly."""
+    read(offset, n) surface, so fmt.read_block can consume it directly.
 
-    __slots__ = ("_f", "_buf", "_buf_off", "_readahead", "_max",
+    `initial_readahead` + `arm_immediately` configure the KNOWN-sequential
+    mode used by the compaction input scan (the reference's fixed
+    compaction readahead, CompactionOptions::compaction_readahead_size
+    role): the very first read already fetches a full window instead of
+    waiting for the doubling ramp."""
+
+    __slots__ = ("_f", "_buf", "_buf_off", "_readahead", "_init_ra", "_max",
                  "_next_expected", "_seq_reads", "hits", "misses")
 
     MIN_READAHEAD = 8 * 1024
@@ -25,14 +31,18 @@ class FilePrefetchBuffer:
     # BlockBasedTable::kMinNumFileReadsToStartAutoReadahead).
     ARM_AFTER = 2
 
-    def __init__(self, rfile, max_readahead: int = MAX_READAHEAD):
+    def __init__(self, rfile, max_readahead: int = MAX_READAHEAD,
+                 initial_readahead: int | None = None,
+                 arm_immediately: bool = False):
         self._f = rfile
         self._buf = b""
         self._buf_off = 0
-        self._readahead = self.MIN_READAHEAD
+        self._init_ra = min(initial_readahead or self.MIN_READAHEAD,
+                            max_readahead)
+        self._readahead = self._init_ra
         self._max = max_readahead
         self._next_expected = -1
-        self._seq_reads = 0
+        self._seq_reads = self.ARM_AFTER if arm_immediately else 0
         self.hits = 0      # reads served from the buffer
         self.misses = 0    # reads that went to the file
 
@@ -47,9 +57,11 @@ class FilePrefetchBuffer:
         self.misses += 1
         if offset == self._next_expected:
             self._seq_reads += 1
-        else:
+        elif self._next_expected >= 0:
+            # Random access mid-stream: back to the cold state. (A first
+            # read keeps any pre-armed window instead of resetting it.)
             self._seq_reads = 0
-            self._readahead = self.MIN_READAHEAD
+            self._readahead = self._init_ra
         if self._seq_reads >= self.ARM_AFTER:
             want = max(n, self._readahead)
             self._buf = self._f.read(offset, want)

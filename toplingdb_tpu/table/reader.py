@@ -405,6 +405,11 @@ class TableIterator:
         # windowed preads; random seeks pass through untouched.
         self._pf = FilePrefetchBuffer(reader._f)
 
+    def prefetch_counts(self) -> tuple[int, int]:
+        """(hits, misses) of this iterator's readahead buffer — exported
+        as PREFETCH_* tickers by the compaction input scan."""
+        return self._pf.hits, self._pf.misses
+
     def _load_data_block(self) -> None:
         if not self._idx.valid():
             self._data = None

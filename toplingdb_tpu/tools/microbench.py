@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 
@@ -206,6 +207,106 @@ def main(argv=None) -> int:
             lambda: readrandom("/mb_block.sst", t_block), len(probe_keys))
         run("readrandom_zip",
             lambda: readrandom("/mb_zip.sst", t_zip), len(probe_keys))
+
+    # Pipelined vs serial compaction data plane: the SAME job run with
+    # TPULSM_PIPELINE=0 and =1, printing per-phase sums vs wall so the
+    # scan/compute/encode overlap is directly visible.
+    if args.filter in "compaction_pipeline":
+        from toplingdb_tpu.compaction.picker import Compaction
+        from toplingdb_tpu.db.table_cache import TableCache
+        from toplingdb_tpu.db.version_edit import FileMetaData
+        from toplingdb_tpu.ops.columnar_io import (
+            ColumnarKV, write_tables_columnar,
+        )
+        from toplingdb_tpu.ops.device_compaction import run_device_compaction
+        from toplingdb_tpu.ops.pipeline import MIN_PIPELINE_ROWS
+
+        n_c = max(n, MIN_PIPELINE_ROWS * 2)
+        cenv = MemEnv()
+        rng2 = np.random.default_rng(7)
+        per_run = n_c // 4
+        metas = []
+        fn_c = [9]
+        for _run in range(4):
+            draws = rng2.integers(0, n_c // 2, per_run, dtype=np.int64)
+            seqs = np.arange(_run * per_run + 1, _run * per_run + per_run + 1,
+                             dtype=np.uint64)
+            ik = np.empty((per_run, 16), dtype=np.uint8)
+            for j in range(8):
+                ik[:, 7 - j] = (draws // 10 ** j) % 10 + ord("0")
+            packed = (seqs << np.uint64(8)) | np.uint64(1)
+            ik[:, 8:] = packed[:, None] >> (np.arange(8) * 8).astype(
+                np.uint64)[None, :] & np.uint64(0xFF)
+            vals = np.full((per_run, 20), ord("v"), dtype=np.uint8)
+            s = np.lexsort((np.iinfo(np.int64).max - seqs.view(np.int64),
+                            draws))
+            kv = ColumnarKV(
+                np.ascontiguousarray(ik[s]).reshape(-1),
+                np.arange(per_run, dtype=np.int32) * 16,
+                np.full(per_run, 16, dtype=np.int32),
+                np.ascontiguousarray(vals[s]).reshape(-1),
+                np.arange(per_run, dtype=np.int32) * 20,
+                np.full(per_run, 20, dtype=np.int32),
+            )
+            fn_c[0] += 1
+            files = write_tables_columnar(
+                cenv, "/cp", (lambda: fn_c[0]), icmp, TableOptions(), kv,
+                np.arange(per_run, dtype=np.int32),
+                np.full(per_run, -1, dtype=np.int64),
+                np.full(per_run, 1, dtype=np.int32), seqs[s], [],
+                creation_time=1,
+            )
+            for fnum, path, props, smallest, largest, _sel in files:
+                metas.append(FileMetaData(
+                    number=fnum, file_size=cenv.get_file_size(path),
+                    smallest=smallest, largest=largest,
+                ))
+        tc = TableCache(cenv, "/cp", icmp, TableOptions())
+        saved_env = {k: os.environ.get(k)
+                     for k in ("TPULSM_PIPELINE", "TPULSM_HOST_SORT",
+                               "TPULSM_PIPELINE_SHARDS")}
+        os.environ["TPULSM_HOST_SORT"] = "1"
+        os.environ["TPULSM_PIPELINE_SHARDS"] = "4"
+        try:
+            fn_c[0] = 1000
+            for knob in ("0", "1"):
+                os.environ["TPULSM_PIPELINE"] = knob
+                best = None
+                for _ in range(2):
+                    c = Compaction(level=0, output_level=2,
+                                   inputs=list(metas), bottommost=True,
+                                   max_output_file_size=1 << 62)
+                    t0 = time.perf_counter()
+                    outs, stats = run_device_compaction(
+                        cenv, "/cp", icmp, c, tc, TableOptions(), [],
+                        new_file_number=(lambda: (fn_c.__setitem__(
+                            0, fn_c[0] + 1), fn_c[0])[1]),
+                        creation_time=1, device_name="cpu-jax",
+                    )
+                    dt = time.perf_counter() - t0
+                    if best is None or dt < best[0]:
+                        best = (dt, stats)
+                    for m in outs:
+                        cenv.delete_file("/cp/%06d.sst" % m.number)
+                dt, stats = best
+                ph = stats.phase_dict()
+                phase_sum = round(sum(
+                    v for k2, v in ph.items()
+                    if k2 not in ("work_time_s", "other_s",
+                                  "pipeline_overlap_s")
+                    and isinstance(v, (int, float))), 3)
+                print(json.dumps({
+                    "bench": f"compaction_pipeline_{knob}", "items": n_c,
+                    "wall_s": round(dt, 3), "phase_sum_s": phase_sum,
+                    "pipeline_overlap_s": ph.get("pipeline_overlap_s", 0.0),
+                    "MBps": round(36 * n_c / dt / 1e6, 2),
+                }))
+        finally:
+            for k2, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k2, None)
+                else:
+                    os.environ[k2] = v
 
     # Persistent cache tier: spill 4KiB blocks through the write-behind
     # queue, then measure disk-tier lookups — the row reports the tier's

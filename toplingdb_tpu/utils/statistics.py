@@ -77,6 +77,9 @@ LCOMPACTION_READ_BYTES = "lcompaction.read.bytes"
 LCOMPACTION_WRITE_BYTES = "lcompaction.write.bytes"
 DCOMPACTION_READ_BYTES = "dcompaction.read.bytes"
 DCOMPACTION_WRITE_BYTES = "dcompaction.write.bytes"
+# Compaction input-scan readahead (FilePrefetchBuffer hits vs preads).
+PREFETCH_HITS = "compaction.prefetch.hits"
+PREFETCH_MISSES = "compaction.prefetch.misses"
 # -- dcompact resilience (compaction/resilience.py) ------------------
 DCOMPACTION_ATTEMPTS = "dcompaction.attempts"            # remote tries
 DCOMPACTION_RETRIES = "dcompaction.retries"              # re-tries only
@@ -307,6 +310,10 @@ class Statistics:
         self.record_tick(COMPACT_READ_BYTES, stats.input_bytes)
         self.record_tick(COMPACT_WRITE_BYTES, stats.output_bytes)
         self.record_in_histogram(COMPACTION_TIME_MICROS, stats.work_time_usec)
+        if getattr(stats, "prefetch_hits", 0):
+            self.record_tick(PREFETCH_HITS, stats.prefetch_hits)
+        if getattr(stats, "prefetch_misses", 0):
+            self.record_tick(PREFETCH_MISSES, stats.prefetch_misses)
         if stats.transfer_time_usec:
             self.record_in_histogram(COMPACTION_TRANSFER_MICROS,
                                      stats.transfer_time_usec)
