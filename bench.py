@@ -374,6 +374,53 @@ def db_path_rows(detail, n_db):
     mg_hits = sum(v is not None for b in batches for v in b)
     detail["multireadrandom_hit_pct"] = round(
         100 * mg_hits / len(probes), 1)
+
+    # readseq / seekrandom (reference db_bench workloads): the chunked
+    # scan plane (TPULSM_ITER_CHUNK=1, the default) vs the per-entry
+    # path (=0) on the same multi-level DB; byte-identical output is
+    # asserted so the ratio is pure data-plane.
+    def _scan_all():
+        it = db.new_iterator()
+        it.seek_to_first()
+        c = by = 0
+        while it.valid():
+            by += len(it.key()) + len(it.value())
+            c += 1
+            it.next()
+        return c, by
+
+    saved_chunk = os.environ.get("TPULSM_ITER_CHUNK")
+    try:
+        os.environ["TPULSM_ITER_CHUNK"] = "1"
+        _scan_all()  # warm the page cache for a fair serial comparison
+        t0 = time.time()
+        c_c, by_c = _scan_all()
+        dt_c = time.time() - t0
+        os.environ["TPULSM_ITER_CHUNK"] = "0"
+        t0 = time.time()
+        c_s, by_s = _scan_all()
+        dt_s = time.time() - t0
+        assert (c_c, by_c) == (c_s, by_s), "scan-plane output mismatch"
+        detail["readseq_MBps"] = round(by_c / dt_c / 1e6, 2)
+        detail["readseq_serial_MBps"] = round(by_s / dt_s / 1e6, 2)
+        detail["readseq_entries_s"] = round(c_c / dt_c)
+        detail["readseq_speedup"] = round(dt_s / dt_c, 2)
+        sk = probes[: min(20_000, len(probes))]
+        for label, knob in (("seekrandom_ops", "1"),
+                            ("seekrandom_serial_ops", "0")):
+            os.environ["TPULSM_ITER_CHUNK"] = knob
+            it = db.new_iterator()
+            for k in sk[:2000]:
+                it.seek(k)
+            t0 = time.time()
+            for k in sk:
+                it.seek(k)
+            detail[label] = round(len(sk) / (time.time() - t0))
+    finally:
+        if saved_chunk is None:
+            os.environ.pop("TPULSM_ITER_CHUNK", None)
+        else:
+            os.environ["TPULSM_ITER_CHUNK"] = saved_chunk
     db.close()
     shutil.rmtree(d, ignore_errors=True)
 
@@ -641,6 +688,10 @@ def main():
                 "pipeline_overlap_s", 0.0),
             "compaction_pipelined_MBps": detail.get(
                 "compaction_nocomp_MBps"),
+            # Chunked scan-plane headline rows (serial twins are
+            # detail.readseq_serial_MBps / detail.seekrandom_serial_ops).
+            "readseq_MBps": detail.get("readseq_MBps"),
+            "seekrandom_ops": detail.get("seekrandom_ops"),
         }
 
     line = json.dumps(make_record(detail))

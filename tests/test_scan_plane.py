@@ -1,0 +1,393 @@
+"""Chunked scan plane (ops/scan_plane.py): entry-for-entry parity with
+the per-entry DBIter path, ticker agreement, fallback behavior, and the
+secondary-cache promotion charge fix that rode along in the same PR."""
+
+import os
+import random
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options, ReadOptions
+
+
+@pytest.fixture
+def chunk_env():
+    """Restore TPULSM_ITER_CHUNK after each test."""
+    saved = os.environ.get("TPULSM_ITER_CHUNK")
+    yield
+    if saved is None:
+        os.environ.pop("TPULSM_ITER_CHUNK", None)
+    else:
+        os.environ["TPULSM_ITER_CHUNK"] = saved
+
+
+def set_chunk(v):
+    os.environ["TPULSM_ITER_CHUNK"] = v
+
+
+def build_db(path, n=3000, compression=None, **opt_kw):
+    """Multi-source DB: several SST files (flushes), overwrites,
+    deletions, plus live memtable entries."""
+    kw = dict(create_if_missing=True, write_buffer_size=32 * 1024)
+    if compression is not None:
+        from toplingdb_tpu.table.builder import TableOptions
+
+        kw["table_options"] = TableOptions(compression=compression)
+    kw.update(opt_kw)
+    db = DB.open(path, Options(**kw))
+    rng = random.Random(7)
+    for i in range(n):
+        db.put(b"key%06d" % rng.randrange(n), b"v%06d" % i)
+    for i in range(0, n, 11):
+        db.delete(b"key%06d" % i)
+    db.flush()
+    db.wait_for_compactions()
+    for i in range(n // 2, n // 2 + n // 10):
+        db.put(b"key%06d" % i, b"memv%06d" % i)
+    return db
+
+
+def scan_all(db, **ro_kw):
+    it = db.new_iterator(ReadOptions(**ro_kw))
+    it.seek_to_first()
+    return list(it.entries())
+
+
+def test_forward_parity_multi_source(tmp_db_path, chunk_env):
+    db = build_db(tmp_db_path)
+    try:
+        set_chunk("0")
+        a = scan_all(db)
+        set_chunk("1")
+        it = db.new_iterator()
+        assert it._plane is not None, "plane must engage on eligible DBs"
+        it.seek_to_first()
+        b = list(it.entries())
+        assert a == b and len(a) > 1000
+        # small chunks force many refills + resume cuts
+        set_chunk("64")
+        assert scan_all(db) == a
+    finally:
+        db.close()
+
+
+@pytest.mark.parametrize("codec", ["snappy", "zstd"])
+def test_forward_parity_codecs(tmp_path, chunk_env, codec):
+    from toplingdb_tpu.table import format as fmt
+    from toplingdb_tpu.utils import codecs
+
+    if not codecs.available(codec):
+        pytest.skip(f"{codec} unavailable")
+    comp = fmt.SNAPPY_COMPRESSION if codec == "snappy" \
+        else fmt.ZSTD_COMPRESSION
+    db = build_db(str(tmp_path / "db"), compression=comp)
+    try:
+        set_chunk("0")
+        a = scan_all(db)
+        set_chunk("1")
+        assert scan_all(db) == a
+    finally:
+        db.close()
+
+
+def test_seek_and_resume_parity(tmp_db_path, chunk_env):
+    db = build_db(tmp_db_path)
+    try:
+        probes = [b"key%06d" % i for i in range(0, 3000, 37)]
+        probes += [b"", b"zzz", b"key002999", b"key000000"]
+        set_chunk("64")
+        it1 = db.new_iterator()
+        set_chunk("0")
+        it0 = db.new_iterator()
+        for k in probes:
+            it1.seek(k)
+            it0.seek(k)
+            assert it1.valid() == it0.valid(), k
+            # resume: walk a few entries from the seek point
+            for _ in range(5):
+                if not it0.valid():
+                    break
+                assert (it1.key(), it1.value()) == (it0.key(), it0.value())
+                it0.next()
+                it1.next()
+                assert it1.valid() == it0.valid()
+    finally:
+        db.close()
+
+
+def test_snapshot_parity(tmp_db_path, chunk_env):
+    db = build_db(tmp_db_path, n=1000)
+    try:
+        snap = db.get_snapshot()
+        for i in range(0, 1000, 3):
+            db.put(b"key%06d" % i, b"after-snap")
+        db.delete(b"key000500")
+        set_chunk("0")
+        a = scan_all(db, snapshot=snap)
+        set_chunk("1")
+        b = scan_all(db, snapshot=snap)
+        assert a == b
+        assert all(v != b"after-snap" for _, v in b)
+        db.release_snapshot(snap)
+    finally:
+        db.close()
+
+
+def test_range_tombstone_parity(tmp_db_path, chunk_env):
+    db = build_db(tmp_db_path, n=1500)
+    try:
+        db.delete_range(b"key000200", b"key000400")
+        db.flush()
+        db.delete_range(b"key000900", b"key000950")
+        set_chunk("0")
+        a = scan_all(db)
+        set_chunk("64")
+        b = scan_all(db)
+        assert a == b
+        assert not any(b"key000200" <= k < b"key000400" for k, _ in b)
+    finally:
+        db.close()
+
+
+def test_bounds_parity(tmp_db_path, chunk_env):
+    db = build_db(tmp_db_path)
+    try:
+        for lo, hi in ((b"key000100", b"key002500"),
+                       (b"key001499", b"key001500"),
+                       (None, b"key000001"), (b"key002990", None)):
+            kw = {}
+            if lo is not None:
+                kw["iterate_lower_bound"] = lo
+            if hi is not None:
+                kw["iterate_upper_bound"] = hi
+            set_chunk("0")
+            a = scan_all(db, **kw)
+            set_chunk("64")
+            b = scan_all(db, **kw)
+            assert a == b, (lo, hi)
+            if a and lo is not None:
+                assert a[0][0] >= lo
+            if a and hi is not None:
+                assert a[-1][0] < hi
+    finally:
+        db.close()
+
+
+def test_direction_switch_fallback(tmp_db_path, chunk_env):
+    db = build_db(tmp_db_path, n=800)
+    try:
+        set_chunk("64")
+        it1 = db.new_iterator()
+        set_chunk("0")
+        it0 = db.new_iterator()
+        for it in (it1, it0):
+            it.seek(b"key000300")
+            for _ in range(7):
+                it.next()
+        assert it1.key() == it0.key()
+        it1.prev()
+        it0.prev()
+        assert it1._plane is None, "prev must degrade to per-entry"
+        for _ in range(5):
+            assert it1.valid() == it0.valid()
+            if not it0.valid():
+                break
+            assert (it1.key(), it1.value()) == (it0.key(), it0.value())
+            it1.prev()
+            it0.prev()
+        # seek_to_last / seek_for_prev drop the plane up front
+        set_chunk("1")
+        it2 = db.new_iterator()
+        it2.seek_to_last()
+        assert it2._plane is None
+        set_chunk("0")
+        it3 = db.new_iterator()
+        it3.seek_to_last()
+        assert (it2.valid(), it2.key()) == (it3.valid(), it3.key())
+    finally:
+        db.close()
+
+
+def test_mutate_while_iterating_soak(tmp_db_path, chunk_env):
+    """The chunk must stay pinned to its creation-time view: concurrent
+    puts/deletes/flushes are invisible to an open iterator."""
+    db = build_db(tmp_db_path, n=2000)
+    try:
+        set_chunk("0")
+        expect = scan_all(db)
+        set_chunk("128")
+        it = db.new_iterator()
+        it.seek_to_first()
+        got = []
+        rng = random.Random(3)
+        steps = 0
+        while it.valid():
+            got.append((it.key(), it.value()))
+            steps += 1
+            if steps % 150 == 0:
+                for _ in range(40):
+                    k = b"key%06d" % rng.randrange(2000)
+                    db.put(k, b"mutated")
+                    db.delete(b"key%06d" % rng.randrange(2000))
+                db.flush()
+            it.next()
+        assert got == expect
+    finally:
+        db.close()
+
+
+def test_ticker_parity_and_prefetch(tmp_db_path, chunk_env):
+    from toplingdb_tpu.utils import statistics as st
+
+    def run(mode):
+        set_chunk(mode)
+        stats = st.Statistics()
+        db = DB.open(tmp_db_path, Options(create_if_missing=True,
+                                          statistics=stats))
+        try:
+            it = db.new_iterator()
+            it.seek_to_first()
+            n = 0
+            while it.valid():
+                it.key(), it.value()
+                it.next()
+                n += 1
+            it.seek(b"key000100")
+            while it.valid():
+                it.next()
+            g = stats.get_ticker_count
+            return (n, g(st.NUMBER_DB_SEEK), g(st.NUMBER_DB_NEXT),
+                    g(st.NUMBER_DB_SEEK_FOUND), g(st.ITER_BYTES_READ),
+                    g(st.PREFETCH_HITS) + g(st.PREFETCH_MISSES),
+                    g(st.ITER_CHUNK_REFILLS))
+        finally:
+            db.close()
+
+    db = build_db(tmp_db_path, n=2500)
+    db.close()
+    r0 = run("0")
+    r1 = run("1")
+    # op/byte accounting agrees exactly between the two paths
+    assert r0[:5] == r1[:5]
+    assert r1[5] > 0, "chunked path must feed PREFETCH_* tickers"
+    assert r0[5] > 0, "per-entry path must feed PREFETCH_* tickers"
+    assert r1[6] > 0 and r0[6] == 0
+
+
+def test_plane_gating(tmp_db_path, chunk_env):
+    from toplingdb_tpu.utils.merge_operator import StringAppendOperator
+
+    set_chunk("1")
+    db = DB.open(tmp_db_path, Options(
+        create_if_missing=True, merge_operator=StringAppendOperator()))
+    try:
+        db.put(b"a", b"1")
+        it = db.new_iterator()
+        assert it._plane is None, "merge operator must gate the plane off"
+    finally:
+        db.close()
+
+
+def test_plane_with_snapshot_less_refresh(tmp_db_path, chunk_env):
+    set_chunk("1")
+    db = build_db(tmp_db_path, n=500)
+    try:
+        it = db.new_iterator()
+        it.seek_to_first()
+        k0 = it.key()
+        db.put(b"key000000a", b"fresh")
+        it.refresh()
+        it.seek_to_first()
+        assert it.valid()
+        keys = [k for k, _ in it.entries()]
+        assert b"key000000a" in keys and k0 in keys
+    finally:
+        db.close()
+
+
+def test_readahead_size_option(tmp_db_path, chunk_env):
+    """ReadOptions.readahead_size pins a fixed prefetch window through
+    TableIterator/LevelIterator (and the scan plane)."""
+    set_chunk("0")
+    db = build_db(tmp_db_path, n=2000)
+    try:
+        a = scan_all(db)
+        b = scan_all(db, readahead_size=128 * 1024)
+        assert a == b
+        set_chunk("1")
+        c = scan_all(db, readahead_size=128 * 1024)
+        assert a == c
+        # the fixed window reaches the file iterator
+        from toplingdb_tpu.table.reader import TableIterator
+
+        v = db.versions.cf_current(0)
+        meta = next(f for lvl in v.files for f in lvl)
+        r = db.table_cache.get_reader(meta.number)
+        ti = r.new_iterator(readahead_size=64 * 1024)
+        assert isinstance(ti, TableIterator)
+        assert ti._pf._max == 64 * 1024
+        assert ti._pf._readahead == 64 * 1024
+    finally:
+        db.close()
+
+
+def test_blob_db_parity(tmp_db_path, chunk_env):
+    db = DB.open(tmp_db_path, Options(
+        create_if_missing=True, enable_blob_files=True, min_blob_size=8,
+        write_buffer_size=16 * 1024))
+    try:
+        for i in range(400):
+            db.put(b"k%04d" % i, b"blobvalue-%04d" % i * 4)
+        db.flush()
+        for i in range(400, 450):
+            db.put(b"k%04d" % i, b"small")
+        set_chunk("0")
+        a = scan_all(db)
+        set_chunk("1")
+        b = scan_all(db)
+        assert a == b and len(a) == 450
+    finally:
+        db.close()
+
+
+# -- secondary-cache promotion charge (utils/cache.py satellite) --------
+
+
+def test_secondary_promote_uses_recorded_charge():
+    from toplingdb_tpu.utils.cache import CompressedSecondaryCache, LRUCache
+
+    sec = CompressedSecondaryCache(1 << 20)
+    lru = LRUCache(4096, num_shards=1, secondary=sec)
+    # Insert with a charge LARGER than len(value) (e.g. charged overhead):
+    # eviction spills to the secondary, promotion must re-insert with the
+    # SAME charge, not len(value).
+    lru.insert(b"k1", b"x" * 100, 3000)
+    lru.insert(b"k2", b"y" * 100, 3000)  # evicts k1 -> secondary
+    assert lru.lookup(b"k1") == b"x" * 100  # promoted back
+    shard = lru._shard(b"k1")
+    assert shard._items[b"k1"][1] == 3000, \
+        "promotion must use the secondary's recorded charge"
+    # and the shard budget stays enforced: usage <= capacity wiggle
+    assert shard.usage <= 3000
+
+
+def test_secondary_promote_guards_non_bytes():
+    from toplingdb_tpu.utils.cache import LRUCache
+
+    class OddSecondary:
+        def __init__(self):
+            self.store = {}
+
+        def insert(self, k, v):
+            self.store[k] = v
+
+        def lookup(self, k):
+            return self.store.get(k)
+
+    sec = OddSecondary()
+    lru = LRUCache(1024, num_shards=1, secondary=sec)
+    sec.store[b"obj"] = ["not", "bytes"]
+    # Served, but NOT promoted (unknown charge would corrupt accounting).
+    assert lru.lookup(b"obj") == ["not", "bytes"]
+    assert b"obj" not in lru._shard(b"obj")._items

@@ -2079,19 +2079,24 @@ class DB:
             version = self.versions.cf_current(cfd.handle.id)
             children = []
             rd = RangeDelAggregator(self.icmp.user_comparator)
+            ra = opts.readahead_size
             for mem in [cfd.mem] + cfd.imm:
                 children.append(mem.new_iterator())
                 for seq, begin, end in mem.range_del_entries():
                     rd.add(RangeTombstone(seq, begin, end))
             for f in version.files[0]:
                 reader = self.table_cache.get_reader(f.number)
-                children.append(reader.new_iterator())
+                if ra and hasattr(reader, "new_index_iterator"):
+                    children.append(reader.new_iterator(readahead_size=ra))
+                else:
+                    children.append(reader.new_iterator())
                 for b, e in reader.range_del_entries():
                     rd.add(RangeTombstone.from_table_entry(b, e))
             for level in range(1, version.num_levels):
                 if version.files[level]:
                     children.append(
-                        LevelIterator(self.table_cache, version.files[level], self.icmp)
+                        LevelIterator(self.table_cache, version.files[level],
+                                      self.icmp, readahead_size=ra)
                     )
                     # Only files that actually hold tombstones are opened here
                     # (num_range_deletions travels in the MANIFEST metadata);
@@ -2120,6 +2125,35 @@ class DB:
                 legacy_wce=bool(getattr(
                     self.options, "legacy_wide_column_unwrap", False)),
             )
+            # Chunked scan plane (ops/scan_plane.py): native block decode
+            # + k-way merge for forward scans; None when the iterator
+            # shape is ineligible (the per-entry path runs unchanged).
+            from toplingdb_tpu.ops.scan_plane import make_scan_plane
+
+            plane = make_scan_plane(
+                mems=[cfd.mem] + list(cfd.imm),
+                l0_files=list(version.files[0]),
+                level_runs=[version.files[lv]
+                            for lv in range(1, version.num_levels)
+                            if version.files[lv]],
+                table_cache=self.table_cache,
+                icmp=self.icmp,
+                snap_seq=snap_seq,
+                rd=None if rd.empty() else rd,
+                lower=opts.iterate_lower_bound,
+                upper=opts.iterate_upper_bound,
+                blob_resolver=self.blob_source.get,
+                merge_operator=self.options.merge_operator,
+                prefix_mode=(opts.prefix_same_as_start
+                             and not opts.total_order_seek
+                             and self.options.prefix_extractor is not None),
+                excluded=self._excluded_for(opts),
+                read_ts=opts.timestamp,
+                stats=self.stats,
+                readahead_size=ra,
+            )
+            if plane is not None:
+                it.attach_scan_plane(plane)
             if opts.snapshot is None:
                 # Refresh re-reads at the LATEST sequence; snapshot-pinned
                 # iterators can't refresh (reference Iterator::Refresh

@@ -61,6 +61,83 @@ class DBIter:
         self._key_full: bytes | None = None
         self._entry_type: int | None = None  # ValueType of current entry
         self._legacy_wce = legacy_wce  # magic-sniff gate (pre-type DBs)
+        # Chunked scan plane (ops/scan_plane.py): when attached, forward
+        # ops serve from its chunk cursor; backward ops and mid-stream
+        # ineligible shapes degrade to the per-entry path below.
+        self._plane = None
+        self._pf_banked = (0, 0)
+        # Access-pattern tracking for the plane: chunked decode wins for
+        # scans but re-decodes blocks per Seek; a seek-dominated pattern
+        # (many seeks, few next() steps between them) runs faster on the
+        # per-entry path through the warm block cache, so the plane is
+        # dropped once that pattern is established.
+        self._plane_seeks = 0
+        self._plane_steps = 0
+
+    def attach_scan_plane(self, plane) -> None:
+        self._plane = plane
+
+    def _plane_sync(self) -> None:
+        p = self._plane
+        if p.is_valid:
+            self._valid = True
+            self._key = p.cur_key
+            self._key_full = p.cur_key
+            self._value = p.cur_value
+            self._entry_type = p.cur_type
+        else:
+            self._valid = False
+
+    def _plane_drop(self) -> None:
+        """Deactivate the plane (direction switch / ineligible shape)."""
+        self._plane = None
+        if self.stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self._tick(st.ITER_CHUNK_FALLBACKS)
+
+    def _plane_position(self, user_key: bytes | None) -> bool:
+        """Position the plane (None = start of keyspace/lower bound);
+        False = the plane bailed and the caller must run the per-entry
+        path for this operation."""
+        from toplingdb_tpu.ops.scan_plane import PlaneIneligible
+
+        try:
+            if user_key is None:
+                self._plane.seek_first()
+            else:
+                self._plane.seek(user_key)
+        except PlaneIneligible:
+            self._plane_drop()
+            return False
+        self._plane_sync()
+        return True
+
+    def _resume_per_entry_after(self, cur: bytes) -> None:
+        """Position the per-entry path just past `cur` (the plane's last
+        emitted key) after a mid-stream degrade."""
+        self._seek_impl(cur, arm_prefix=False)
+        if self._valid and self._vcmp(self._key, cur) <= 0:
+            self._find_next_user_entry(skip_key=cur)
+
+    def _bank_prefetch(self) -> None:
+        """Flush the internal iterator's FilePrefetchBuffer deltas into
+        the PREFETCH_* tickers (the chunked plane banks its own)."""
+        if self.stats is None:
+            return
+        pc = getattr(self._iter, "prefetch_counts", None)
+        if pc is None:
+            return
+        h, m = pc()
+        dh, dm = h - self._pf_banked[0], m - self._pf_banked[1]
+        if dh or dm:
+            from toplingdb_tpu.utils import statistics as st
+
+            if dh:
+                self._tick(st.PREFETCH_HITS, dh)
+            if dm:
+                self._tick(st.PREFETCH_MISSES, dm)
+            self._pf_banked = (h, m)
 
     def refresh(self) -> None:
         """Rebind to the DB's CURRENT state (reference Iterator::Refresh):
@@ -154,11 +231,19 @@ class DBIter:
         # Total-order entry point: never arms prefix mode, even when a lower
         # bound redirects it through a seek.
         self._prefix = None
+        if self._plane is not None and self._plane_position(self._lower):
+            self._tick_seek()
+            return
+        self._bank_prefetch()
         if self._lower is not None:
             self._seek_impl(self._lower, arm_prefix=False)
+            self._tick_seek()
             return
         self._iter.seek_to_first()
         self._find_next_user_entry(skip_key=None)
+        self._tick_seek()
+
+    def _tick_seek(self) -> None:
         if self.stats is not None:
             from toplingdb_tpu.utils import statistics as st
 
@@ -185,11 +270,22 @@ class DBIter:
                        len(self._key) + len(self._value))
 
     def seek(self, user_key: bytes) -> None:
+        if self._plane is not None:
+            self._plane_seeks += 1
+            if self._plane_seeks >= 16 and \
+                    self._plane_steps < 64 * self._plane_seeks:
+                self._plane_drop()  # seek-dominated: per-entry path wins
+            else:
+                uk = user_key
+                if self._lower is not None \
+                        and self._vcmp(uk, self._lower) < 0:
+                    uk = self._lower
+                if self._plane_position(uk):
+                    self._tick_seek()
+                    return
+        self._bank_prefetch()
         self._seek_impl(user_key, arm_prefix=True)
-        if self.stats is not None:
-            from toplingdb_tpu.utils import statistics as st
-
-            self._tick_entry_read(st.NUMBER_DB_SEEK, st.NUMBER_DB_SEEK_FOUND)
+        self._tick_seek()
 
     def _seek_impl(self, user_key: bytes, arm_prefix: bool) -> None:
         if self._lower is not None and self._vcmp(user_key, self._lower) < 0:
@@ -213,6 +309,8 @@ class DBIter:
         self._find_next_user_entry(skip_key=None)
 
     def seek_to_last(self) -> None:
+        if self._plane is not None:
+            self._plane_drop()  # backward iteration: per-entry path only
         self._prefix = None
         if self._upper is not None:
             # Upper bound is exclusive: (upper, MAX_SEQ, FOR_SEEK) sorts before
@@ -233,6 +331,8 @@ class DBIter:
         self._find_prev_user_entry()
 
     def seek_for_prev(self, user_key: bytes) -> None:
+        if self._plane is not None:
+            self._plane_drop()  # backward iteration: per-entry path only
         self._arm_prefix(user_key)
         if self._ts_sz:
             # (key, ts=0) is the LAST version of key in ts-descending order.
@@ -246,6 +346,23 @@ class DBIter:
 
     def next(self) -> None:
         assert self._valid
+        if self._plane is not None:
+            from toplingdb_tpu.ops.scan_plane import PlaneIneligible
+
+            self._plane_steps += 1
+            cur = self._key
+            try:
+                self._plane.advance()
+            except PlaneIneligible:
+                self._plane_drop()
+                self._resume_per_entry_after(cur)
+            else:
+                self._plane_sync()
+            if self.stats is not None:
+                from toplingdb_tpu.utils import statistics as st
+
+                self._tick_entry_read(st.NUMBER_DB_NEXT, None)
+            return
         skip = self._key
         # _iter may sit anywhere within the current user key's versions.
         self._find_next_user_entry(skip_key=skip)
@@ -256,6 +373,13 @@ class DBIter:
 
     def prev(self) -> None:
         assert self._valid
+        if self._plane is not None:
+            # Direction switch: degrade to the per-entry path, positioned
+            # at the plane's current key (still visible — the snapshot is
+            # fixed), then run the normal backward step below.
+            cur0 = self._key
+            self._plane_drop()
+            self._seek_impl(cur0, arm_prefix=False)
         # Move internal iterator to strictly before the current user key.
         cur = self._key  # visible (stripped) key
         if not self._iter.valid():
@@ -388,6 +512,7 @@ class DBIter:
             self._emit_merge(merge_key, None, operands)
             return
         self._valid = False
+        self._bank_prefetch()
 
     def _resolve_blob(self, idx: bytes) -> bytes:
         if self._blob_resolver is None:
@@ -449,6 +574,7 @@ class DBIter:
                 return
             # Key dead/invisible: continue scanning previous keys.
         self._valid = False
+        self._bank_prefetch()
 
     def _resolve_backward_ts(self, vkey: bytes) -> bool:
         """ts-mode backward resolution: walk every (ts, seq) version of the

@@ -7,12 +7,16 @@ from toplingdb_tpu.db.version_edit import FileMetaData
 
 
 class LevelIterator:
-    def __init__(self, table_cache, files: list[FileMetaData], icmp):
+    def __init__(self, table_cache, files: list[FileMetaData], icmp,
+                 readahead_size: int = 0):
         self._tc = table_cache
         self._files = files
         self._icmp = icmp
         self._file_idx = -1
         self._iter = None
+        # ReadOptions.readahead_size: fixed per-file-iterator prefetch
+        # window (0 = the buffer's auto-scaling default).
+        self._ra = readahead_size
         self._pf_hits = 0    # readahead counts of already-closed file iters
         self._pf_misses = 0
 
@@ -21,7 +25,10 @@ class LevelIterator:
         self._file_idx = idx
         if 0 <= idx < len(self._files):
             reader = self._tc.get_reader(self._files[idx].number)
-            self._iter = reader.new_iterator()
+            if self._ra and hasattr(reader, "new_index_iterator"):
+                self._iter = reader.new_iterator(readahead_size=self._ra)
+            else:
+                self._iter = reader.new_iterator()
         else:
             self._iter = None
 

@@ -251,6 +251,11 @@ class ReadOptions:
     # db/forward_iterator.cc): forward-only, sees new writes after catching
     # up at end-of-data; incompatible with `snapshot`.
     tailing: bool = False
+    # Iterator prefetch window in bytes (reference
+    # ReadOptions.readahead_size): a fixed, immediately-armed
+    # FilePrefetchBuffer window for table iteration. 0 = auto-scaling
+    # (double on sequential reads, reset on seek).
+    readahead_size: int = 0
     # User-defined timestamp to read AS OF (reference ReadOptions.timestamp,
     # the TOPLINGDB_WITH_TIMESTAMP feature): only versions with ts <= this
     # are visible. Requires a timestamp-carrying comparator. None = latest.

@@ -65,6 +65,18 @@ class MergingIterator:
         lower = newer source, used for MVCC tie-breaks)."""
         return self._current
 
+    def prefetch_counts(self) -> tuple[int, int]:
+        """Summed FilePrefetchBuffer (hits, misses) of every child that
+        has one — DBIter banks the deltas into the PREFETCH_* tickers."""
+        h = m = 0
+        for c in self._children:
+            pc = getattr(c, "prefetch_counts", None)
+            if pc is not None:
+                ch, cm = pc()
+                h += ch
+                m += cm
+        return h, m
+
     def seek_to_first(self) -> None:
         for c in self._children:
             c.seek_to_first()

@@ -23,7 +23,7 @@ class FilePrefetchBuffer:
     waiting for the doubling ramp."""
 
     __slots__ = ("_f", "_buf", "_buf_off", "_readahead", "_init_ra", "_max",
-                 "_next_expected", "_seq_reads", "hits", "misses")
+                 "_next_expected", "_seq_reads", "_arm0", "hits", "misses")
 
     MIN_READAHEAD = 8 * 1024
     MAX_READAHEAD = 256 * 1024
@@ -42,9 +42,22 @@ class FilePrefetchBuffer:
         self._readahead = self._init_ra
         self._max = max_readahead
         self._next_expected = -1
+        self._arm0 = arm_immediately
         self._seq_reads = self.ARM_AFTER if arm_immediately else 0
         self.hits = 0      # reads served from the buffer
         self.misses = 0    # reads that went to the file
+
+    def reset(self) -> None:
+        """Back to the initial state (a seek): drop the window and the
+        readahead ramp so the next sequential run re-arms from
+        `initial_readahead` — the auto-scaling window doubles on
+        sequential refills and resets here. hit/miss counters survive
+        (they are cumulative scan accounting)."""
+        self._buf = b""
+        self._buf_off = 0
+        self._readahead = self._init_ra
+        self._next_expected = -1
+        self._seq_reads = self.ARM_AFTER if self._arm0 else 0
 
     def read(self, offset: int, n: int) -> bytes:
         end = offset + n

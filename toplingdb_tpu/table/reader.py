@@ -268,8 +268,11 @@ class TableReader:
             ctx.block_read_byte += len(data)
         return data
 
-    def new_iterator(self) -> "TableIterator":
-        return TableIterator(self)
+    def new_iterator(self, readahead_size: int = 0) -> "TableIterator":
+        """`readahead_size`: ReadOptions.readahead_size — a fixed,
+        immediately-armed prefetch window for this iterator; 0 keeps the
+        auto-scaling default."""
+        return TableIterator(self, readahead_size=readahead_size)
 
     def new_index_iterator(self):
         """Iterator over (separator_key, data BlockHandle bytes) — flat or
@@ -394,7 +397,7 @@ class _PartitionedIndexIter:
 class TableIterator:
     """Two-level iterator: index (flat or partitioned) → data block."""
 
-    def __init__(self, reader: TableReader):
+    def __init__(self, reader: TableReader, readahead_size: int = 0):
         from toplingdb_tpu.table.prefetch import FilePrefetchBuffer
 
         self._r = reader
@@ -402,8 +405,15 @@ class TableIterator:
         self._idx = reader.new_index_iterator()
         self._data: BlockIter | None = None
         # Per-iterator auto-readahead: sequential block loads escalate to
-        # windowed preads; random seeks pass through untouched.
-        self._pf = FilePrefetchBuffer(reader._f)
+        # windowed preads; random seeks pass through untouched. A nonzero
+        # ReadOptions.readahead_size pins a pre-armed fixed window
+        # instead of the auto-scaling ramp.
+        if readahead_size > 0:
+            self._pf = FilePrefetchBuffer(
+                reader._f, max_readahead=readahead_size,
+                initial_readahead=readahead_size, arm_immediately=True)
+        else:
+            self._pf = FilePrefetchBuffer(reader._f)
 
     def prefetch_counts(self) -> tuple[int, int]:
         """(hits, misses) of this iterator's readahead buffer — exported

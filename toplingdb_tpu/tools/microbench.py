@@ -308,6 +308,58 @@ def main(argv=None) -> int:
                 else:
                     os.environ[k2] = v
 
+    # Chunked vs per-entry iterator data plane: the SAME multi-level DB
+    # scanned with TPULSM_ITER_CHUNK=0 and =1 (byte-identical output is
+    # asserted; the ratio is the scan plane's win).
+    if args.filter in "iter_chunk":
+        import shutil as _sh
+        import tempfile as _tf
+
+        from toplingdb_tpu.db.db import DB
+        from toplingdb_tpu.db.write_batch import WriteBatch
+        from toplingdb_tpu.options import Options
+
+        di = _tf.mkdtemp(prefix="mb_iter_", dir="/dev/shm"
+                         if os.path.isdir("/dev/shm") else None)
+        dbi = DB.open(di, Options(create_if_missing=True,
+                                  write_buffer_size=8 << 20))
+        for i in range(0, n, 1000):
+            b = WriteBatch()
+            for j in range(i, min(i + 1000, n)):
+                k = (j * 2654435761) % (n * 2)
+                b.put(b"%016d" % k, b"value-%016d" % j)
+            dbi.write(b)
+        dbi.flush()
+        dbi.wait_for_compactions()
+        saved_chunk = os.environ.get("TPULSM_ITER_CHUNK")
+        rows = {}
+
+        def iter_scan(knob):
+            def go():
+                os.environ["TPULSM_ITER_CHUNK"] = knob
+                it = dbi.new_iterator()
+                it.seek_to_first()
+                c = 0
+                while it.valid():
+                    it.key()
+                    it.value()
+                    it.next()
+                    c += 1
+                rows[knob] = c
+            return go
+
+        try:
+            for knob in ("0", "1"):
+                _bench(f"iter_chunk_{knob}", iter_scan(knob), n)
+            assert rows["0"] == rows["1"], rows
+        finally:
+            if saved_chunk is None:
+                os.environ.pop("TPULSM_ITER_CHUNK", None)
+            else:
+                os.environ["TPULSM_ITER_CHUNK"] = saved_chunk
+            dbi.close()
+            _sh.rmtree(di, ignore_errors=True)
+
     # Persistent cache tier: spill 4KiB blocks through the write-behind
     # queue, then measure disk-tier lookups — the row reports the tier's
     # measured hit rate (reference block_cache_tier stats role).

@@ -51,12 +51,45 @@ def analyze_block_cache_trace(trace_path: str) -> dict:
             "accesses_per_file_prefix": per_file}
 
 
+def _spill_fn(secondary):
+    """Adapt secondary.insert to the (key, value, charge) spill callback:
+    charge-aware secondaries record the primary's charge; legacy 2-arg
+    tiers just drop it."""
+    import inspect
+
+    ins = secondary.insert
+    try:
+        takes_charge = len(inspect.signature(ins).parameters) >= 3
+    except (TypeError, ValueError):
+        takes_charge = False
+    if takes_charge:
+        return ins
+    return lambda k, v, c: ins(k, v)
+
+
+def _secondary_hit(secondary, key):
+    """(value, charge) from the secondary, or None. The charge is the
+    secondary's RECORDED charge when it tracks one (lookup_with_charge);
+    otherwise len(value) for raw bytes, and None for non-bytes values —
+    promotion with an unknown charge would under-account the shard
+    budget, so those are served without promoting."""
+    lw = getattr(secondary, "lookup_with_charge", None)
+    if lw is not None:
+        return lw(key)
+    v = secondary.lookup(key)
+    if v is None:
+        return None
+    charge = len(v) if isinstance(v, (bytes, bytearray, memoryview)) else None
+    return v, charge
+
+
 class LRUCache:
     def __init__(self, capacity_bytes: int, num_shards: int = 16,
                  secondary=None, tracer: BlockCacheTracer | None = None):
         self._shards = [
             _Shard(max(1, capacity_bytes // num_shards),
-                   spill=secondary.insert if secondary is not None else None)
+                   spill=_spill_fn(secondary) if secondary is not None
+                   else None)
             for _ in range(num_shards)
         ]
         self._n = num_shards
@@ -70,9 +103,11 @@ class LRUCache:
     def lookup(self, key: bytes):
         v = self._shard(key).lookup(key)
         if v is None and self.secondary is not None:
-            v = self.secondary.lookup(key)
-            if v is not None:
-                self._shard(key).insert(key, v, len(v))  # promote
+            hit = _secondary_hit(self.secondary, key)
+            if hit is not None:
+                v, charge = hit
+                if charge is not None:
+                    self._shard(key).insert(key, v, charge)  # promote
         if self.tracer is not None:
             self.tracer.record_access(key, v is not None)
         return v
@@ -113,6 +148,7 @@ class ClockCache:
         self.hits = 0
         self.misses = 0
         self.secondary = secondary
+        self._spill = _spill_fn(secondary) if secondary is not None else None
         self.tracer = tracer
 
     def lookup(self, key: bytes):
@@ -126,9 +162,11 @@ class ClockCache:
         self.misses += 1
         v = None
         if self.secondary is not None:
-            v = self.secondary.lookup(key)
-            if v is not None:
-                self.insert(key, v, len(v))  # promote
+            hit = _secondary_hit(self.secondary, key)
+            if hit is not None:
+                v, charge = hit
+                if charge is not None:
+                    self.insert(key, v, charge)  # promote
         if self.tracer is not None:
             self.tracer.record_access(key, v is not None)
         return v
@@ -166,11 +204,11 @@ class ClockCache:
                     self._ring.pop(self._hand)
                     del self._slots[k]
                     self._usage -= slot[1]
-                    evicted.append((k, slot[0]))
+                    evicted.append((k, slot[0], slot[1]))
                 spins += 1
-        if self.secondary is not None:
-            for k, v in evicted:
-                self.secondary.insert(k, v)
+        if self._spill is not None:
+            for k, v, c in evicted:
+                self._spill(k, v, c)
 
     def erase(self, key: bytes) -> None:
         with self._mu:
@@ -208,45 +246,54 @@ class CompressedSecondaryCache:
         self._zlib = zlib
         self._cap = capacity_bytes
         self._level = level
-        self._items: "OrderedDict[bytes, bytes]" = OrderedDict()
+        # key -> (compressed, original primary charge): the recorded
+        # charge rides along so promotion re-inserts with the SAME
+        # accounting the primary evicted with (a charge > len(value)
+        # would otherwise under-account the shard budget).
+        self._items: "OrderedDict[bytes, tuple[bytes, int]]" = OrderedDict()
         self._usage = 0
         self._mu = threading.Lock()
         self.hits = 0
         self.misses = 0
 
-    def insert(self, key: bytes, value) -> None:
+    def insert(self, key: bytes, value, charge: int | None = None) -> None:
         if not isinstance(value, (bytes, bytearray)):
             return
         c = self._zlib.compress(bytes(value), self._level)
+        rec = (c, charge if charge is not None else len(value))
         with self._mu:
             old = self._items.pop(key, None)
             if old is not None:
-                self._usage -= len(old)  # REPLACE: never serve stale bytes
-            self._items[key] = c
+                self._usage -= len(old[0])  # REPLACE: no stale bytes
+            self._items[key] = rec
             self._usage += len(c)
             while self._usage > self._cap and self._items:
-                _, dropped = self._items.popitem(last=False)
+                _, (dropped, _ch) = self._items.popitem(last=False)
                 self._usage -= len(dropped)
 
-    def lookup(self, key: bytes):
-        """Hit = ownership transfer: the entry is POPPED (the caller
-        promotes it to the primary, as the reference secondary cache hands
-        its value over) — re-eviction re-spills fresh bytes."""
+    def lookup_with_charge(self, key: bytes):
+        """(value, recorded charge) — hit = ownership transfer: the entry
+        is POPPED (the caller promotes it to the primary, as the
+        reference secondary cache hands its value over)."""
         with self._mu:
-            c = self._items.pop(key, None)
-            if c is not None:
-                self._usage -= len(c)
-        if c is None:
+            rec = self._items.pop(key, None)
+            if rec is not None:
+                self._usage -= len(rec[0])
+        if rec is None:
             self.misses += 1
             return None
         self.hits += 1
-        return self._zlib.decompress(c)
+        return self._zlib.decompress(rec[0]), rec[1]
+
+    def lookup(self, key: bytes):
+        hit = self.lookup_with_charge(key)
+        return None if hit is None else hit[0]
 
     def erase(self, key: bytes) -> None:
         with self._mu:
-            c = self._items.pop(key, None)
-            if c is not None:
-                self._usage -= len(c)
+            rec = self._items.pop(key, None)
+            if rec is not None:
+                self._usage -= len(rec[0])
 
     def usage(self) -> int:
         return self._usage
@@ -306,7 +353,7 @@ class _Shard:
         self.hits = 0
         self.misses = 0
         self._mu = threading.Lock()
-        self._spill = spill  # secondary.insert(key, value) on eviction
+        self._spill = spill  # spill(key, value, charge) on eviction
 
     def lookup(self, key: bytes):
         with self._mu:
@@ -329,10 +376,10 @@ class _Shard:
             while self.usage > self._cap and self._items:
                 k, (v, c) = self._items.popitem(last=False)
                 self.usage -= c
-                evicted.append((k, v))
+                evicted.append((k, v, c))
         if self._spill is not None:
-            for k, v in evicted:
-                self._spill(k, v)
+            for k, v, c in evicted:
+                self._spill(k, v, c)
 
     def erase(self, key: bytes) -> None:
         with self._mu:

@@ -54,6 +54,10 @@ NUMBER_DB_SEEK_FOUND = "number.db.seek.found"
 ITER_BYTES_READ = "db.iter.bytes.read"
 NO_ITERATOR_CREATED = "no.iterator.created"
 NO_ITERATOR_DELETED = "no.iterator.deleted"
+# Chunked scan plane (ops/scan_plane.py): chunk refills served to
+# DBIter, and mid-stream degradations to the per-entry path.
+ITER_CHUNK_REFILLS = "db.iter.chunk.refills"
+ITER_CHUNK_FALLBACKS = "db.iter.chunk.fallbacks"
 # -- writes ----------------------------------------------------------
 BYTES_WRITTEN = "bytes.written"
 NUMBER_KEYS_WRITTEN = "number.keys.written"
