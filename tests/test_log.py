@@ -67,3 +67,101 @@ def test_corrupt_crc_raises(mem_env):
     r = LogReader(mem_env.new_sequential_file("/wal"))
     with pytest.raises(Corruption):
         list(r.records())
+
+
+# -- tailing-tolerant reader (replication WAL shipping) ----------------------
+
+
+def test_tailing_reader_incremental(mem_env):
+    from toplingdb_tpu.db.log import TailingLogReader
+
+    w = LogWriter(mem_env.new_writable_file("/wal"))
+    tr = TailingLogReader(mem_env, "/wal")
+    assert tr.poll() == []
+    w.add_record(b"one")
+    w.sync()
+    assert tr.poll() == [b"one"]
+    assert tr.poll() == []  # no new bytes
+    w.add_record(b"two")
+    w.add_record(b"three" * 100)
+    w.sync()
+    assert tr.poll() == [b"two", b"three" * 100]
+
+
+def test_tailing_reader_spanning_blocks(mem_env):
+    from toplingdb_tpu.db.log import TailingLogReader
+
+    w = LogWriter(mem_env.new_writable_file("/wal"))
+    tr = TailingLogReader(mem_env, "/wal")
+    big = bytes(range(256)) * 512  # > 4 blocks: FIRST/MIDDLE/LAST chain
+    w.add_record(b"small")
+    w.add_record(big)
+    w.sync()
+    assert tr.poll() == [b"small", big]
+
+
+def test_tailing_torn_tail_retries_then_completes(mem_env):
+    """A partial trailing record is NOT corruption: poll() holds position
+    and delivers the record once the writer finishes it."""
+    from toplingdb_tpu.db.log import TailingLogReader
+
+    w = LogWriter(mem_env.new_writable_file("/wal"))
+    w.add_record(b"committed")
+    w.sync()
+    st = mem_env._files["/wal"]
+    full = bytes(st.data)
+    w.add_record(b"torn-in-flight")
+    whole = bytes(st.data)
+    # Roll back to a torn state: half the new record's bytes are missing.
+    cut = len(full) + (len(whole) - len(full)) // 2
+    del st.data[cut:]
+    tr = TailingLogReader(mem_env, "/wal")
+    assert tr.poll() == [b"committed"]  # torn tail parked, not raised
+    assert tr.poll() == []              # still parked
+    st.data += whole[cut:]              # writer finishes the append
+    assert tr.poll() == [b"torn-in-flight"]
+
+
+def test_tailing_torn_tail_dropped_on_final(mem_env):
+    from toplingdb_tpu.db.log import TailingLogReader
+
+    w = LogWriter(mem_env.new_writable_file("/wal"))
+    w.add_record(b"committed")
+    w.add_record(b"torn")
+    st = mem_env._files["/wal"]
+    del st.data[len(st.data) - 3 :]  # crash cut the tail
+    tr = TailingLogReader(mem_env, "/wal")
+    assert tr.poll(final=True) == [b"committed"]
+    assert tr.poll(final=True) == []
+
+
+def test_tailing_corrupt_middle_raises(mem_env):
+    """A checksum mismatch with durable bytes AFTER it can never be an
+    in-flight append: the tailing reader must fail loudly, not ship it."""
+    from toplingdb_tpu.db.log import TailingLogReader
+
+    w = LogWriter(mem_env.new_writable_file("/wal"))
+    w.add_record(b"a" * 100)
+    w.add_record(b"b" * 100)
+    w.add_record(b"c" * BLOCK_SIZE)  # push the damage away from EOF
+    w.sync()
+    st = mem_env._files["/wal"]
+    st.data[10] ^= 0xFF
+    tr = TailingLogReader(mem_env, "/wal")
+    with pytest.raises(Corruption):
+        tr.poll()
+
+
+def test_tailing_corrupt_at_tail_is_torn_not_corrupt(mem_env):
+    from toplingdb_tpu.db.log import TailingLogReader
+
+    w = LogWriter(mem_env.new_writable_file("/wal"))
+    w.add_record(b"good")
+    w.add_record(b"bad-tail")
+    w.sync()
+    st = mem_env._files["/wal"]
+    st.data[-2] ^= 0xFF  # flip a byte in the LAST record's payload
+    tr = TailingLogReader(mem_env, "/wal")
+    # Live tail: could be an append still in flight — park, don't raise.
+    assert tr.poll() == [b"good"]
+    assert tr.poll() == []

@@ -1,0 +1,561 @@
+"""Replication plane: WAL shipping, follower DBs, bounded-staleness router.
+
+Covers the acceptance matrix of the replication subsystem:
+  - frame encode/decode + corruption detection
+  - primary/follower byte-parity after convergence (shared + standalone)
+  - read-your-writes token guarantee (no read observes applied < token)
+  - bootstrap-after-WAL-GC through Checkpoint.restore_to
+  - chaos soak: 30% drop/delay/truncate of shipped batches still converges
+    to byte parity with the primary's checkpoint
+  - HTTP transport / ReplicationServer / SidePlugin views / promote
+  - SecondaryDB catch-up across CF create/drop and WAL deletion
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.replication import (
+    FaultyTransport,
+    FollowerDB,
+    HttpTransport,
+    LocalTransport,
+    LogShipper,
+    ReplicaRouter,
+    ReplicationServer,
+    ShipFrame,
+    WalRetentionGone,
+)
+from toplingdb_tpu.utils.statistics import Statistics
+from toplingdb_tpu.utils.status import Corruption
+
+
+def opts(**kw):
+    kw.setdefault("write_buffer_size", 1 << 20)
+    kw.setdefault("statistics", Statistics())
+    return Options(**kw)
+
+
+def dump(db):
+    """Full user-visible content across every CF: the parity fingerprint."""
+    out = []
+    for handle in sorted(db.list_column_families(), key=lambda h: h.id):
+        it = db.new_iterator(cf=handle)
+        it.seek_to_first()
+        rows = []
+        while it.valid():
+            rows.append((it.key(), it.value()))
+            it.next()
+        out.append((handle.id, handle.name, rows))
+    return out
+
+
+# -- frame format ------------------------------------------------------------
+
+
+def test_frame_roundtrip():
+    f = ShipFrame(epoch=7, first_seq=10, last_seq=42,
+                  shipped_unix_us=123456, batches=[b"abc", b"", b"x" * 999])
+    g = ShipFrame.decode(f.encode())
+    assert (g.epoch, g.first_seq, g.last_seq, g.shipped_unix_us,
+            g.batches) == (7, 10, 42, 123456, [b"abc", b"", b"x" * 999])
+
+
+def test_frame_detects_truncation_and_bitflips():
+    f = ShipFrame(epoch=1, first_seq=1, last_seq=3,
+                  shipped_unix_us=0, batches=[b"payload-bytes" * 10])
+    enc = f.encode()
+    for cut in (0, 4, len(enc) // 2, len(enc) - 1):
+        with pytest.raises(Corruption):
+            ShipFrame.decode(enc[:cut])
+    flipped = bytearray(enc)
+    flipped[len(enc) - 3] ^= 0x40  # payload bitflip → CRC mismatch
+    with pytest.raises(Corruption):
+        ShipFrame.decode(bytes(flipped))
+
+
+# -- shipper -----------------------------------------------------------------
+
+
+def test_shipper_serves_and_detects_retention_gone(tmp_path):
+    db = DB.open(str(tmp_path / "db"), opts(create_if_missing=True))
+    ship = LogShipper(db)
+    for i in range(20):
+        db.put(b"k%02d" % i, b"v%02d" % i)
+    frames, state = ship.frames_since(0)
+    assert frames and frames[0].first_seq == 1
+    assert frames[-1].last_seq == state["last_sequence"] == 20
+    # Already-applied cursor → empty.
+    frames, _ = ship.frames_since(20)
+    assert frames == []
+    # Flush twice so the WAL holding seqs 1..20 is GC'd.
+    db.flush()
+    for i in range(5):
+        db.put(b"x%02d" % i, b"y")
+    db.flush()
+    db.put(b"tail", b"t")
+    with pytest.raises(WalRetentionGone):
+        ship.frames_since(3)
+    db.close()
+
+
+# -- follower convergence ----------------------------------------------------
+
+
+def test_follower_shared_parity_and_epoch_reload(tmp_path):
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts(create_if_missing=True))
+    ship = LogShipper(db)
+    fol = FollowerDB.open(src, Options(statistics=db.stats),
+                          transport=LocalTransport(ship), mode="shared")
+    for i in range(50):
+        db.put(b"a%03d" % i, b"v%03d" % i)
+    fol.catch_up()
+    assert fol.get(b"a025") == b"v025"
+    # Flush + compact installs new versions → epoch reload path.
+    db.flush()
+    for i in range(50):
+        db.put(b"a%03d" % i, b"w%03d" % i)  # overwrite
+    db.delete(b"a000")
+    db.flush()
+    db.compact_range()
+    for _ in range(4):
+        fol.catch_up()
+    assert fol.get(b"a000") is None
+    assert fol.get(b"a001") == b"w001"
+    assert dump(fol) == dump(db)
+    st = fol.replication_status()
+    assert st["role"] == "follower"
+    assert st["applied_sequence"] == db.versions.last_sequence
+    assert db.stats.get_ticker_count(
+        "replication.epoch.reloads") >= 1
+    fol.close()
+    db.close()
+
+
+def test_follower_standalone_bootstrap_after_wal_gc(tmp_path):
+    src, fdir = str(tmp_path / "db"), str(tmp_path / "fol")
+    db = DB.open(src, opts(create_if_missing=True))
+    ship = LogShipper(db)
+    tr = LocalTransport(ship)
+    for i in range(30):
+        db.put(b"k%03d" % i, b"v%03d" % i)
+    fol = FollowerDB.open(fdir, Options(statistics=db.stats),
+                          transport=tr, mode="standalone")
+    assert fol.get(b"k010") == b"v010"  # bootstrapped via Checkpoint.restore_to
+    # Live tail keeps flowing.
+    db.put(b"live", b"1")
+    fol.catch_up()
+    assert fol.get(b"live") == b"1"
+    # Outrun WAL retention: two flush cycles delete the WALs the
+    # follower's cursor would need → automatic re-bootstrap.
+    db.flush()
+    for i in range(40):
+        db.put(b"g%03d" % i, b"w%03d" % i)
+    db.flush()
+    db.put(b"tail", b"t")
+    for _ in range(4):
+        fol.catch_up()
+    assert fol.get(b"g020") == b"w020"
+    assert fol.get(b"tail") == b"t"
+    assert fol.applied_sequence() == db.versions.last_sequence
+    assert db.stats.get_ticker_count("replication.bootstraps") >= 1
+    assert dump(fol) == dump(db)
+    fol.close()
+    db.close()
+
+
+# -- router: tokens, staleness, health ---------------------------------------
+
+
+def test_router_read_your_writes_token(tmp_path):
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts(create_if_missing=True))
+    ship = LogShipper(db)
+    fol = FollowerDB.open(src, transport=LocalTransport(ship), mode="shared")
+    router = ReplicaRouter(db, [fol])
+    stats = db.stats
+    token = router.put(b"k", b"v1")
+    assert token == db.versions.last_sequence
+    # Follower has NOT caught up: a token read must not serve stale data —
+    # it falls back to the primary.
+    assert router.get(b"k", token=token) == b"v1"
+    assert stats.get_ticker_count("replication.router.primary.reads") == 1
+    assert stats.get_ticker_count("replication.router.stale.skips") == 1
+    # After catch-up the same token read is served by the follower.
+    fol.catch_up()
+    assert fol.applied_sequence() >= token
+    assert router.get(b"k", token=token) == b"v1"
+    assert stats.get_ticker_count("replication.router.follower.reads") == 1
+    # Token-less reads always accept the follower.
+    assert router.get(b"k") == b"v1"
+    # multi_get honours tokens the same way.
+    t2 = router.put(b"k2", b"v2")
+    assert router.multi_get([b"k", b"k2"], token=t2) == [b"v1", b"v2"]
+    fol.catch_up()
+    assert router.multi_get([b"k", b"k2"], token=t2) == [b"v1", b"v2"]
+    # Iterators: stale follower skipped for token-carrying scans.
+    t3 = router.put(b"k3", b"v3")
+    it = router.new_iterator(token=t3)
+    it.seek(b"k3")
+    assert it.valid() and it.value() == b"v3"
+    fol.close()
+    db.close()
+
+
+def test_router_breaker_skips_failing_follower(tmp_path):
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts(create_if_missing=True))
+    db.put(b"k", b"v")
+
+    class BrokenReplica:
+        def applied_sequence(self):
+            return 1 << 60  # always "fresh" — only reads fail
+
+        def get(self, *a, **kw):
+            raise RuntimeError("replica down")
+
+        def multi_get(self, *a, **kw):
+            raise RuntimeError("replica down")
+
+        def new_iterator(self, *a, **kw):
+            raise RuntimeError("replica down")
+
+    from toplingdb_tpu.replication.router import RouterOptions
+
+    router = ReplicaRouter(db, [BrokenReplica()],
+                           RouterOptions(breaker_failure_threshold=2,
+                                         breaker_reset_timeout=3600.0))
+    for _ in range(4):
+        assert router.get(b"k") == b"v"  # served by primary fallback
+    # After 2 consecutive failures the breaker opens: later reads skip the
+    # replica without even trying it.
+    assert db.stats.get_ticker_count(
+        "replication.router.breaker.skips") >= 1
+    snap = router.status()["health"]
+    assert list(snap.values())[0]["state"] == "open"
+    db.close()
+
+
+def test_router_max_lag_bound(tmp_path):
+    from toplingdb_tpu.replication.router import RouterOptions
+
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts(create_if_missing=True))
+    ship = LogShipper(db)
+    fol = FollowerDB.open(src, transport=LocalTransport(ship), mode="shared")
+    router = ReplicaRouter(db, [fol], RouterOptions(max_lag_seq=5))
+    for i in range(20):
+        db.put(b"k%02d" % i, b"v")
+    # Follower is 20 seqs behind: token-less reads still must not use it.
+    assert router.get(b"k00") == b"v"
+    assert db.stats.get_ticker_count("replication.router.stale.skips") >= 1
+    fol.catch_up()
+    assert router.get(b"k00") == b"v"
+    assert db.stats.get_ticker_count(
+        "replication.router.follower.reads") >= 1
+    fol.close()
+    db.close()
+
+
+# -- chaos soak --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["shared", "standalone"])
+def test_chaos_soak_converges_to_checkpoint_parity(tmp_path, mode):
+    """30% injected ship-transport faults (drop/delay/truncate): the
+    follower still converges to byte-identical state vs the primary's
+    checkpoint, and token-carrying router reads never observe a sequence
+    older than their token."""
+    from toplingdb_tpu.db.db_readonly import ReadOnlyDB
+    from toplingdb_tpu.env.fault_injection import ShipFaultInjector
+
+    src = str(tmp_path / "db")
+    fdir = src if mode == "shared" else str(tmp_path / "fol")
+    db = DB.open(src, opts(create_if_missing=True))
+    ship = LogShipper(db)
+    injector = ShipFaultInjector(rate=0.30, seed=1234, delay_sec=0.001)
+    transport = FaultyTransport(LocalTransport(ship), injector)
+    fol = FollowerDB.open(fdir, Options(statistics=db.stats),
+                          transport=transport, mode=mode)
+    router = ReplicaRouter(db, [fol])
+
+    import random
+
+    rng = random.Random(99)
+    expected = {}
+    for round_no in range(30):
+        # A burst of writes; every 10th round a flush (epoch churn + WAL GC
+        # pressure so retention-gone paths fire under fault load too).
+        for _ in range(20):
+            k = b"key%03d" % rng.randrange(200)
+            if rng.random() < 0.15 and k in expected:
+                token = router.delete(k)
+                expected.pop(k, None)
+            else:
+                v = b"val%06d" % rng.randrange(1 << 20)
+                token = router.put(k, v)
+                expected[k] = v
+            if rng.random() < 0.3:
+                # Read-your-writes probe THROUGH the fault storm: the
+                # router must never serve a pre-token view of this key.
+                got = router.get(k, token=token)
+                assert got == expected.get(k), (round_no, k)
+        if round_no % 10 == 9:
+            db.flush()
+        fol.catch_up()
+    # Faults actually fired at meaningful volume.
+    counts = injector.injected_counts()
+    assert sum(counts.values()) >= 10, counts
+    # Drain: enough rounds that the (seeded) fault stream lets the tail
+    # through; drop/truncate rounds make no progress, they never corrupt.
+    for _ in range(60):
+        fol.catch_up()
+        if fol.applied_sequence() == db.versions.last_sequence:
+            break
+    assert fol.applied_sequence() == db.versions.last_sequence
+    # Byte-parity vs the primary's CHECKPOINT (the acceptance criterion:
+    # a frozen, openable snapshot of the primary's state).
+    from toplingdb_tpu.utilities.checkpoint import Checkpoint
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    Checkpoint.create(db, ckpt_dir)
+    ck = ReadOnlyDB.open(ckpt_dir)
+    try:
+        follower_view = {k: v for _, _, rows in dump(fol) for k, v in rows}
+        ckpt_view = {k: v for _, _, rows in dump(ck) for k, v in rows}
+        assert follower_view == ckpt_view == expected
+    finally:
+        ck.close()
+    # Corrupted (truncated) frames were detected, counted, and never
+    # half-applied.
+    if counts.get("truncate"):
+        assert db.stats.get_ticker_count("replication.frame.corrupt") >= 1
+    assert db.stats.get_histogram("replication.lag.micros").count >= 1
+    fol.close()
+    db.close()
+
+
+# -- background tailing ------------------------------------------------------
+
+
+def test_background_tailing_with_concurrent_writes(tmp_path):
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts(create_if_missing=True))
+    ship = LogShipper(db)
+    fol = FollowerDB.open(src, transport=LocalTransport(ship), mode="shared")
+    fol.start_tailing(interval=0.005)
+    router = ReplicaRouter(db, [fol])
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(150):
+                token = router.put(b"t%d-%03d" % (tid, i), b"v%03d" % i)
+                if i % 20 == 0:
+                    got = router.get(b"t%d-%03d" % (tid, i), token=token)
+                    assert got == b"v%03d" % i
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    deadline = 100
+    while (fol.applied_sequence() != db.versions.last_sequence
+           and deadline > 0):
+        import time
+
+        time.sleep(0.02)
+        deadline -= 1
+    assert fol.applied_sequence() == db.versions.last_sequence
+    fol.stop_tailing()
+    assert dump(fol) == dump(db)
+    fol.close()
+    db.close()
+
+
+# -- HTTP plane --------------------------------------------------------------
+
+
+def test_http_transport_and_replication_server(tmp_path):
+    src, fdir = str(tmp_path / "db"), str(tmp_path / "fol")
+    db = DB.open(src, opts(create_if_missing=True))
+    srv = ReplicationServer(db)
+    port = srv.start()
+    try:
+        for i in range(25):
+            db.put(b"h%03d" % i, b"v%03d" % i)
+        tr = HttpTransport(f"http://127.0.0.1:{port}")
+        fol = FollowerDB.open(fdir, transport=tr, mode="standalone")
+        assert fol.get(b"h011") == b"v011"
+        db.put(b"after", b"x")
+        fol.catch_up()
+        assert fol.get(b"after") == b"x"
+        assert dump(fol) == dump(db)
+        # Status endpoint serves shipper introspection.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/replication/status") as r:
+            st = json.loads(r.read())
+        assert st["role"] == "primary"
+        assert st["last_sequence"] == db.versions.last_sequence
+        fol.close()
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_sideplugin_replication_view_and_promote(tmp_path):
+    from toplingdb_tpu.utils.config import SidePluginRepo
+
+    src = str(tmp_path / "db")
+    repo = SidePluginRepo()
+    db = repo.open_db({"path": src,
+                       "options": {"create_if_missing": True}}, name="prim")
+    ship = LogShipper(db)
+    fol = FollowerDB.open(src, transport=LocalTransport(ship), mode="shared")
+    repo.attach_db("fol", fol, {"options": {}})
+    port = repo.start_http()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        db.put(b"a", b"1")
+        fol.catch_up()
+        with urllib.request.urlopen(f"{base}/replication/prim") as r:
+            prim = json.loads(r.read())
+        assert prim["role"] == "primary"
+        assert prim["frames_shipped"] >= 1
+        with urllib.request.urlopen(f"{base}/replication/fol") as r:
+            fv = json.loads(r.read())
+        assert fv["role"] == "follower"
+        assert fv["applied_sequence"] == db.versions.last_sequence
+
+        # repl_admin CLI against the same endpoints.
+        from toplingdb_tpu.tools.repl_admin import main as admin_main
+
+        assert admin_main(["--url", base, "status"]) == 0
+        assert admin_main(["--url", base, "lag", "--max-lag", "1000"]) == 0
+
+        # Promote: the primary "dies"; the follower becomes read-write.
+        db.close()
+        assert admin_main(["--url", base, "promote", "--db", "fol"]) == 0
+        promoted = repo.get_db("fol")
+        assert promoted is not fol
+        promoted.put(b"post-promote", b"yes")  # read-write now
+        assert promoted.get(b"a") == b"1"
+        with urllib.request.urlopen(f"{base}/replication/fol") as r:
+            pv = json.loads(r.read())
+        assert pv["role"] == "primary-unshipped"
+    finally:
+        repo.stop_http()
+        for name in ("fol",):
+            d = repo.get_db(name)
+            if d is not None:
+                d.close()
+
+
+# -- SecondaryDB satellite fixes ---------------------------------------------
+
+
+def test_secondary_catchup_cf_created_and_dropped(tmp_path):
+    from toplingdb_tpu.db.db_readonly import SecondaryDB
+
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts(create_if_missing=True))
+    doomed = db.create_column_family("doomed")
+    db.put(b"d", b"1", cf=doomed)
+    db.put(b"k", b"v")
+    db.flush()
+    sec = SecondaryDB.open(src)
+    assert sec.get(b"k") == b"v"
+    assert sec.get(b"d", cf=1) == b"1"
+    # Primary drops one CF and creates another between catch-ups.
+    db.drop_column_family(doomed)
+    newcf = db.create_column_family("fresh")
+    db.put(b"f", b"2", cf=newcf)
+    sec.try_catch_up_with_primary()
+    names = {h.name for h in sec.list_column_families()}
+    assert "doomed" not in names and "fresh" in names
+    fresh = sec.get_column_family("fresh")
+    assert sec.get(b"f", cf=fresh) == b"2"
+    sec.close()
+    db.close()
+
+
+def test_secondary_catchup_survives_wal_gc_and_drops_stale_mem(tmp_path):
+    """Flush+GC between catch-ups: deleted WALs are skipped, and stale
+    memtable entries from the PREVIOUS catch-up don't shadow the SSTs."""
+    from toplingdb_tpu.db.db_readonly import SecondaryDB
+
+    src = str(tmp_path / "db")
+    db = DB.open(src, opts(create_if_missing=True))
+    db.put(b"k", b"old")
+    sec = SecondaryDB.open(src)
+    assert sec.get(b"k") == b"old"
+    db.put(b"k", b"mid")
+    db.delete(b"k")
+    db.flush()          # WAL with "old"/"mid"/delete is GC'd
+    db.compact_range()  # tombstone compacted away
+    sec.try_catch_up_with_primary()
+    # A stale memtable carry-over would resurrect "old"/"mid" here.
+    assert sec.get(b"k") is None
+    db.put(b"k", b"new")
+    sec.try_catch_up_with_primary()
+    assert sec.get(b"k") == b"new"
+    sec.close()
+    db.close()
+
+
+# -- checkpoint satellite ----------------------------------------------------
+
+
+def test_checkpoint_includes_options_and_current_last(tmp_path):
+    from toplingdb_tpu.utilities.checkpoint import Checkpoint
+
+    from toplingdb_tpu.table import format as fmt
+
+    src, dst = str(tmp_path / "db"), str(tmp_path / "ck")
+    db = DB.open(src, opts(create_if_missing=True,
+                           compression=fmt.ZLIB_COMPRESSION))
+    for i in range(10):
+        db.put(b"c%02d" % i, b"v")
+    ck = Checkpoint.create(db, dst)
+    import os
+
+    names = sorted(os.listdir(dst))
+    assert "CURRENT" in names
+    assert any(n.startswith("OPTIONS-") for n in names), names
+    ck.verify()
+    # restore_to yields an independently openable copy.
+    restored = ck.restore_to(str(tmp_path / "restored"))
+    db.close()
+    # OPTIONS carried configuration, not just data (probe BEFORE opening:
+    # a fresh open persists the opener's own OPTIONS on top).
+    from toplingdb_tpu.utils.config import load_latest_options
+
+    lo = load_latest_options(restored)
+    assert lo is not None and lo.compression == fmt.ZLIB_COMPRESSION
+    with DB.open(restored, lo) as rdb:
+        assert rdb.get(b"c05") == b"v"
+
+
+def test_checkpoint_restore_refuses_partial(tmp_path):
+    from toplingdb_tpu.utilities.checkpoint import Checkpoint
+    from toplingdb_tpu.utils.status import InvalidArgument
+
+    src, dst = str(tmp_path / "db"), str(tmp_path / "ck")
+    db = DB.open(src, opts(create_if_missing=True))
+    db.put(b"a", b"1")
+    Checkpoint.create(db, dst)
+    db.close()
+    import os
+
+    os.remove(os.path.join(dst, "CURRENT"))  # interrupted create
+    with pytest.raises(InvalidArgument):
+        Checkpoint(dst).restore_to(str(tmp_path / "nope"))

@@ -340,6 +340,10 @@ class DB:
         self._options_file_number = 0  # latest persisted OPTIONS file
         self._mget_pool = None  # lazy long-lived async multi_get executor
         self._file_deletions_disabled = 0  # DisableFileDeletions pin count
+        # Replication plane hook: LogShipper / FollowerDB / ReplicaRouter
+        # register a status callable here; the SidePlugin HTTP layer serves
+        # it at /replication/<name> (utils/config.py).
+        self._repl_status_provider = None
         from toplingdb_tpu.utils.listener import EventLogger
 
         self._log_file = None
@@ -678,52 +682,62 @@ class DB:
         return dbformat.encode_ts_key(key, ts)
 
     def put(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE,
-            cf=None, ts: int | None = None) -> None:
+            cf=None, ts: int | None = None) -> int:
         b = WriteBatch()
         b.put(self._ts_key(key, ts), value, cf=self._cf_id(cf))
-        self.write(b, opts)
+        return self.write(b, opts)
 
     def delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
-               cf=None, ts: int | None = None) -> None:
+               cf=None, ts: int | None = None) -> int:
         b = WriteBatch()
         b.delete(self._ts_key(key, ts), cf=self._cf_id(cf))
-        self.write(b, opts)
+        return self.write(b, opts)
 
     def single_delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
-                      cf=None, ts: int | None = None) -> None:
+                      cf=None, ts: int | None = None) -> int:
         b = WriteBatch()
         b.single_delete(self._ts_key(key, ts), cf=self._cf_id(cf))
-        self.write(b, opts)
+        return self.write(b, opts)
 
     def merge(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE,
-              cf=None) -> None:
+              cf=None) -> int:
         if self.icmp.user_comparator.timestamp_size:
             raise InvalidArgument(
                 "Merge is not supported with user-defined timestamps"
             )
         b = WriteBatch()
         b.merge(key, value, cf=self._cf_id(cf))
-        self.write(b, opts)
+        return self.write(b, opts)
 
     def delete_range(self, begin: bytes, end: bytes,
-                     opts: WriteOptions = _DEFAULT_WRITE, cf=None) -> None:
+                     opts: WriteOptions = _DEFAULT_WRITE, cf=None) -> int:
         if self.icmp.user_comparator.timestamp_size:
             raise InvalidArgument(
                 "DeleteRange is not supported with user-defined timestamps"
             )
         b = WriteBatch()
         b.delete_range(begin, end, cf=self._cf_id(cf))
-        self.write(b, opts)
+        return self.write(b, opts)
+
+    def latest_sequence_number(self) -> int:
+        """The newest PUBLISHED sequence — a valid staleness token for
+        replication/router.py reads (reference GetLatestSequenceNumber)."""
+        return self.versions.last_sequence
 
     def write(self, batch: WriteBatch, opts: WriteOptions = _DEFAULT_WRITE,
-              on_sequenced=None) -> None:
+              on_sequenced=None) -> int:
         """Group-commit write path (reference DBImpl::WriteImpl +
         WriteThread::JoinBatchGroup, db/db_impl/db_impl_write.cc:169,311):
         concurrent writers queue up; the front writer leads, merging the
         queue into one WAL append + one fsync, then applies every batch to
-        the memtables and publishes the group's last sequence at once."""
+        the memtables and publishes the group's last sequence at once.
+
+        Returns this batch's LAST sequence number — the staleness token of
+        the replication plane: a token-carrying read served by any replica
+        whose applied sequence >= token observes this write
+        (replication/router.py)."""
         if batch.is_empty():
-            return
+            return self.versions.last_sequence  # trivially-satisfied token
         self._check_open()  # fail fast before any stall sleep
         tr = self._op_tracer
         if tr is not None:
@@ -735,15 +749,19 @@ class DB:
 
             t0 = _t.perf_counter()
             try:
-                self._write_impl(batch, opts, on_sequenced)
+                return self._write_impl(batch, opts, on_sequenced)
             finally:
                 self.stats.record_in_histogram(
                     st.DB_WRITE_MICROS, (_t.perf_counter() - t0) * 1e6)
-            return
-        self._write_impl(batch, opts, on_sequenced)
+        return self._write_impl(batch, opts, on_sequenced)
+
+    @staticmethod
+    def _write_token(w: _Writer) -> int:
+        """The completed writer's staleness token (its last sequence)."""
+        return w.batch.sequence() + w.batch.count() - 1
 
     def _write_impl(self, batch: WriteBatch, opts: WriteOptions,
-                    on_sequenced) -> None:
+                    on_sequenced) -> int:
         if self.icmp.user_comparator.timestamp_size:
             self._validate_ts_batch(batch)
         self._maybe_stall_writes()
@@ -771,19 +789,20 @@ class DB:
                     raise interrupted
                 if w.error is not None:
                     raise w.error
-                return
+                return self._write_token(w)
             if w.done:
                 if interrupted is not None:
                     raise interrupted
                 if w.error is not None:
                     raise w.error
-                return
+                return self._write_token(w)
             # Woken with done=False: promoted to lead the next group.
             self._lead_write_group(w)
             if interrupted is not None:
                 raise interrupted
-            return
+            return self._write_token(w)
         self._lead_write_group(w)
+        return self._write_token(w)
 
     def _parallel_member(self, w: _Writer) -> BaseException | None:
         """Follower half of a parallel memtable phase: insert own batch,

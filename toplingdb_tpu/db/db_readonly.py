@@ -14,7 +14,7 @@ from toplingdb_tpu.db.db import DB
 from toplingdb_tpu.db.log import LogReader
 from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.options import Options
-from toplingdb_tpu.utils.status import NotSupported
+from toplingdb_tpu.utils.status import NotFound, NotSupported
 
 
 class ReadOnlyDB(DB):
@@ -46,6 +46,12 @@ class ReadOnlyDB(DB):
                 reader = LogReader(self.env.new_sequential_file(
                     filename.log_file_name(self.dbname, num)),
                     log_number=num)
+            except NotFound:
+                # The primary flushed and GC'd this WAL between our listing
+                # and the open: its contents are durable in SSTs the next
+                # catch-up will see. Skip to the next live log.
+                continue
+            try:
                 for rec in reader.records():
                     batch = WriteBatch(rec)
                     batch.insert_into(mems)
@@ -96,14 +102,30 @@ class SecondaryDB(ReadOnlyDB):
 
     def try_catch_up_with_primary(self) -> None:
         """Re-read CURRENT → MANIFEST and WAL tails (reference
-        TryCatchUpWithPrimary)."""
-        from toplingdb_tpu.db.memtable import MemTable
+        TryCatchUpWithPrimary). Handles column families created or dropped
+        by the primary between catch-ups, and WALs the primary deleted
+        mid-tail (skips to the next live log)."""
+        with self._mutex:
+            self._reload_manifest_view()
+            self._replay_wals_into_mem()
+
+    def _reload_manifest_view(self) -> None:
+        """Swap in the primary's current MANIFEST state: fresh VersionSet,
+        per-CF memtables rebuilt to match (created CFs appear, dropped CFs
+        vanish — their stale memtable entries with them; surviving CFs get
+        EMPTY memtables so flushed-then-compacted history can't linger at
+        newer sequence numbers than the SSTs). Caller holds _mutex."""
         from toplingdb_tpu.db.version_set import VersionSet
 
-        with self._mutex:
-            vs = VersionSet(self.env, self.dbname, self.icmp,
-                            self.options.num_levels)
-            vs.recover(readonly=True)
-            self.versions = vs
-            self.mem = MemTable(self.icmp)
-            self._replay_wals_into_mem()
+        vs = VersionSet(self.env, self.dbname, self.icmp,
+                        self.options.num_levels)
+        vs.recover(readonly=True)
+        self.versions = vs
+        live = set(vs.column_families)
+        for cf_id in list(self._cfs):
+            if cf_id not in live:
+                del self._cfs[cf_id]  # dropped by the primary
+        for cfd in self._cfs.values():
+            cfd.mem = self._fresh_memtable()
+            cfd.imm = []
+        self._materialize_cfs()  # CFs the primary created since

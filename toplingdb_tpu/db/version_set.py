@@ -276,6 +276,11 @@ class VersionSet:
         self._next_file_number = 2
         self._manifest_writer: LogWriter | None = None
         self._lock = threading.Lock()
+        # Monotonic count of MANIFEST records in the live manifest — the
+        # replication plane's "epoch" minor component: a follower re-reads
+        # the MANIFEST when (manifest_file_number, edit_seq) changes
+        # (replication/log_shipper.py).
+        self.edit_seq = 0
 
     # The default CF's Version — the single-CF view used everywhere the CF
     # doesn't matter.
@@ -325,6 +330,7 @@ class VersionSet:
         self._manifest_writer = LogWriter(w)
         self._manifest_writer.add_record(edit.encode())
         self._manifest_writer.sync()
+        self.edit_seq = 1  # record count IN the live manifest file
         filename.set_current_file(self.env, self.dbname, self.manifest_file_number)
 
     def recover(self, readonly: bool = False) -> None:
@@ -414,6 +420,7 @@ class VersionSet:
         self.max_column_family = max(
             [next_cf_hint] + list(self.column_families)
         )
+        self.edit_seq = n_records
         self.mark_file_number_used(self.manifest_file_number)
         if not readonly:
             # Reopen the manifest for appending new edits.
@@ -427,9 +434,15 @@ class VersionSet:
         newpath = filename.manifest_file_name(self.dbname, self.manifest_file_number)
         w = self.env.new_writable_file(newpath)
         self._manifest_writer = LogWriter(w)
+        n = 0
         for snap in self._snapshot_edits():
             self._manifest_writer.add_record(snap.encode())
+            n += 1
         self._manifest_writer.sync()
+        # Epoch minor = records in the LIVE manifest: a readonly recover of
+        # this same file counts the same number, so a directory-sharing
+        # follower's local epoch matches the primary's until the next edit.
+        self.edit_seq = n
         filename.set_current_file(self.env, self.dbname, self.manifest_file_number)
 
     def _snapshot_edits(self) -> list[VersionEdit]:
@@ -511,6 +524,7 @@ class VersionSet:
             test_kill_random("VersionSet::LogAndApply:AfterManifestWrite")
             self._all_versions.add(new_version)
             st.current = new_version
+            self.edit_seq += 1
 
     def create_column_family(self, name: str) -> int:
         """Register a new CF in the MANIFEST; returns its id (reference
@@ -528,6 +542,7 @@ class VersionSet:
             assert self._manifest_writer is not None
             self._manifest_writer.add_record(edit.encode())
             self._manifest_writer.sync()
+            self.edit_seq += 1
             v = Version(self.icmp, self.num_levels)
             self._all_versions.add(v)
             self.column_families[cf_id] = ColumnFamilyState(cf_id, name, v)
@@ -549,6 +564,7 @@ class VersionSet:
             assert self._manifest_writer is not None
             self._manifest_writer.add_record(edit.encode())
             self._manifest_writer.sync()
+            self.edit_seq += 1
 
     def close(self) -> None:
         if self._manifest_writer is not None:

@@ -148,3 +148,60 @@ class _FISequential(SequentialFile):
 
     def close(self):
         self._base.close()
+
+
+class ShipFaultInjector:
+    """Deterministic fault points for the replication ship transport
+    (replication/log_shipper.py FaultyTransport), mirroring
+    DcompactFaultInjector's shape so replication chaos soaks are
+    reproducible from a seed. Plans, decided per pull ordinal:
+
+      "drop"      the pulled frames never arrive (follower sees no progress)
+      "delay"     the frames arrive after `delay_sec`
+      "truncate"  a frame's encoded bytes are cut mid-payload (the follower
+                  must detect the bad CRC/short frame and re-pull, never
+                  apply a half batch)
+
+    `rate` injects pseudo-randomly from `seed` with plan weights `plans`;
+    `schedule` pins a plan to a specific pull ordinal (0-based)."""
+
+    def __init__(self, schedule: dict | None = None, rate: float = 0.0,
+                 plans: tuple = ("drop", "delay", "truncate"),
+                 seed: int = 0, delay_sec: float = 0.01):
+        import random
+
+        self.schedule = dict(schedule or {})
+        self.rate = rate
+        self.plans = tuple(plans)
+        self.delay_sec = delay_sec
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+        self._ordinal = 0
+        self.injected: list[tuple[int, str]] = []  # (ordinal, plan)
+
+    def plan(self) -> str | None:
+        with self._mu:
+            ordinal = self._ordinal
+            self._ordinal += 1
+            p = self.schedule.get(ordinal)
+            if p is None and self.rate > 0 and self.plans:
+                if self._rng.random() < self.rate:
+                    p = self.plans[self._rng.randrange(len(self.plans))]
+            if p:
+                self.injected.append((ordinal, p))
+            return p
+
+    def injected_counts(self) -> dict:
+        with self._mu:
+            out: dict[str, int] = {}
+            for _o, p in self.injected:
+                out[p] = out.get(p, 0) + 1
+            return out
+
+    def truncate_bytes(self, data: bytes) -> bytes:
+        """Cut an encoded frame roughly in half — past the header when
+        possible, so the follower exercises the CRC check rather than the
+        short-header check every time."""
+        if len(data) <= 2:
+            return data[:1]
+        return data[: max(1, len(data) // 2)]

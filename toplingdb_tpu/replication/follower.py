@@ -1,0 +1,314 @@
+"""FollowerDB: a read replica that tails shipped WAL frames.
+
+Extends SecondaryDB (db/db_readonly.py) with a continuous tail/apply loop:
+shipped batches land in the follower's memtables at their original
+sequence numbers; when the primary's MANIFEST epoch advances (flush or
+compaction installed a new version) a directory-sharing follower swaps in
+the new version set and re-pulls the WAL tail; when lag outruns WAL
+retention, the follower bootstraps from a fresh primary checkpoint through
+utilities/checkpoint.py's Checkpoint.restore_to.
+
+Two deployment modes:
+
+  shared      dbname IS the primary's directory (the reference secondary
+              instance shape): SSTs and MANIFEST are read in place; only
+              the WAL tail travels as frames. Epoch changes trigger a
+              MANIFEST re-read; retention gaps resolve the same way
+              (the new MANIFEST's SSTs cover the GC'd WALs).
+  standalone  dbname is the follower's own directory, seeded by a
+              checkpoint restore over the shared filesystem (the dcompact
+              data-plane assumption): frames accumulate in the memtable;
+              retention gaps trigger a full re-bootstrap.
+
+The applied-sequence watermark (`applied_sequence()`) only advances AFTER
+a batch's entries are visible, so the router's token rule — serve a
+token-carrying read only from replicas with applied >= token — yields
+read-your-writes with no locks on the read path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from toplingdb_tpu.db import filename
+from toplingdb_tpu.db.db_readonly import SecondaryDB
+from toplingdb_tpu.db.write_batch import WriteBatch
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.replication.log_shipper import WalRetentionGone
+from toplingdb_tpu.utils import statistics as stats_mod
+from toplingdb_tpu.utils.status import Corruption, IOError_
+
+
+class FollowerDB(SecondaryDB):
+    """A SecondaryDB fed by a ReplicationTransport instead of (only) the
+    shared directory. Use FollowerDB.open(); then either call catch_up()
+    on your own cadence or start_tailing() for a background loop."""
+
+    @staticmethod
+    def open(dbname: str, options: Options | None = None, env=None,
+             transport=None, mode: str = "shared",
+             bootstrap: bool = True) -> "FollowerDB":
+        options = options or Options()
+        options.create_if_missing = False
+        options.disable_auto_compactions = True
+        options.read_only = True
+        from toplingdb_tpu.env import default_env
+
+        env = env or default_env()
+        if (mode == "standalone" and bootstrap and transport is not None
+                and not env.file_exists(filename.current_file_name(dbname))):
+            FollowerDB._restore_checkpoint_into(dbname, env, transport)
+        db = FollowerDB(dbname, options, env)
+        db._mode = mode
+        db._transport = transport
+        db._epoch = None
+        db._applied_seq = None  # None = pull from the retention head
+        db._tail_stop = threading.Event()
+        db._tail_thread = None
+        db.tail_errors = 0
+        db.versions.recover(readonly=True)
+        db._compaction_scheduler = None
+        if mode == "shared":
+            db._replay_wals_into_mem()
+            db._applied_seq = db.versions.last_sequence
+            db._epoch = db._local_epoch()
+        else:
+            # Checkpoint-restored: SSTs carry everything up to the
+            # checkpoint sequence; frames take it from there.
+            db._materialize_cfs()
+            db._applied_seq = db.versions.last_sequence
+        db._repl_status_provider = db.replication_status
+        return db
+
+    # -- bootstrap -------------------------------------------------------
+
+    @staticmethod
+    def _restore_checkpoint_into(dbname: str, env, transport) -> None:
+        from toplingdb_tpu.utilities.checkpoint import Checkpoint
+
+        ckpt = f"{dbname}.bootstrap-ckpt"
+        transport.request_checkpoint(ckpt)
+        Checkpoint(ckpt, env).restore_to(dbname)
+        _rm_tree(env, ckpt)
+
+    def _bootstrap(self) -> None:
+        """Standalone follower fell behind WAL retention: wipe and restore
+        from a fresh primary checkpoint (reference secondaries re-open)."""
+        if self.stats is not None:
+            self.stats.record_tick(stats_mod.REPLICATION_BOOTSTRAPS)
+        if self._transport is None:
+            raise IOError_("follower lag exceeds WAL retention and no "
+                           "transport is attached to bootstrap from")
+        from toplingdb_tpu.db.table_cache import TableCache
+        from toplingdb_tpu.db.version_set import VersionSet
+        from toplingdb_tpu.utilities.checkpoint import Checkpoint
+
+        ckpt = f"{self.dbname}.bootstrap-ckpt"
+        _rm_tree(self.env, ckpt)
+        self._transport.request_checkpoint(ckpt)
+        with self._mutex:
+            self.table_cache.close()
+            for child in list(self.env.get_children(self.dbname)):
+                try:
+                    self.env.delete_file(f"{self.dbname}/{child}")
+                except Exception:
+                    pass  # subdirectories (archive/) stay; files go
+            Checkpoint(ckpt, self.env).restore_to(self.dbname)
+            _rm_tree(self.env, ckpt)
+            vs = VersionSet(self.env, self.dbname, self.icmp,
+                            self.options.num_levels)
+            vs.recover(readonly=True)
+            self.versions = vs
+            self.table_cache = TableCache(
+                self.env, self.dbname, self.icmp, self.options.table_options,
+                block_cache=self.options.block_cache)
+            self.table_cache.stats = self.options.statistics
+            for cf_id in list(self._cfs):
+                if cf_id != 0:
+                    del self._cfs[cf_id]
+            self._cfs[0].mem = self._fresh_memtable()
+            self._cfs[0].imm = []
+            self._materialize_cfs()
+            self._applied_seq = vs.last_sequence
+            self._epoch = None  # next state observation resets it
+
+    # -- epoch / version swap -------------------------------------------
+
+    def _local_epoch(self) -> int:
+        from toplingdb_tpu.replication.log_shipper import pack_epoch
+
+        return pack_epoch(self.versions.manifest_file_number,
+                          getattr(self.versions, "edit_seq", 0))
+
+    def _reload_versions(self) -> None:
+        """Shared-directory version swap: the primary flushed/compacted.
+        Fresh memtables + applied=None forces the next pull to restart at
+        the retention head; everything below it is covered by the SSTs the
+        new MANIFEST installed. Readers between the swap and the re-pull
+        see the (consistent) manifest view."""
+        if self.stats is not None:
+            self.stats.record_tick(stats_mod.REPLICATION_EPOCH_RELOADS)
+        with self._mutex:
+            self._reload_manifest_view()
+            self._applied_seq = None
+
+    # -- tail/apply loop -------------------------------------------------
+
+    def applied_sequence(self) -> int:
+        """Router-facing watermark: every sequence <= this is visible to
+        reads. 0 while a reload/bootstrap is repositioning the cursor (the
+        router then treats this replica as arbitrarily stale)."""
+        s = self._applied_seq
+        return 0 if s is None else s
+
+    def catch_up(self, max_bytes: int = 1 << 22) -> int:
+        """One pull/apply round. Returns the number of batches applied."""
+        tr = self._transport
+        if tr is None:
+            # Pure shared-directory mode: behave like SecondaryDB.
+            self.try_catch_up_with_primary()
+            self._applied_seq = self.versions.last_sequence
+            self._epoch = self._local_epoch()
+            return 0
+        try:
+            frames, state = tr.pull(self._applied_seq, max_bytes=max_bytes)
+        except Corruption:
+            # Truncated/bitflipped frame: nothing applied; re-pull later.
+            if self.stats is not None:
+                self.stats.record_tick(stats_mod.REPLICATION_FRAME_CORRUPT)
+            return 0
+        except WalRetentionGone:
+            if self._mode == "shared":
+                # The MANIFEST that advanced past those WALs is in our
+                # directory: re-read it instead of copying a checkpoint.
+                self._reload_versions()
+            else:
+                self._bootstrap()
+            return 0
+        epoch = state.get("epoch")
+        if self._mode == "shared" and epoch is not None \
+                and epoch != self._epoch:
+            self._reload_versions()
+            self._epoch = epoch
+            return 0  # re-pull from the retention head next round
+        self._epoch = epoch
+        applied = self._apply_frames(frames)
+        if self._applied_seq is None and state.get("wal_floor_seq") is None:
+            # From-head pull and the primary retains NO WAL records: every
+            # published sequence is durable in the SSTs our MANIFEST view
+            # already covers — adopt the primary's watermark.
+            self._applied_seq = state.get(
+                "last_sequence", self.versions.last_sequence)
+        return applied
+
+    def _apply_frames(self, frames) -> int:
+        applied = 0
+        now_us = int(time.time() * 1e6)
+        for frame in frames:
+            if self._applied_seq is not None \
+                    and frame.last_seq <= self._applied_seq:
+                continue  # duplicate delivery
+            if self._applied_seq is not None \
+                    and frame.first_seq > self._applied_seq + 1 \
+                    and self.stats is not None:
+                # Sequences absent from the WAL (disable_wal writes) or an
+                # upstream anomaly: observable either way.
+                self.stats.record_tick(stats_mod.REPLICATION_FRAME_GAPS)
+            mems = {cf_id: cfd.mem for cf_id, cfd in self._cfs.items()}
+            for rep in frame.batches:
+                b = WriteBatch(rep)
+                cnt = b.count()
+                if cnt == 0:
+                    continue
+                end = b.sequence() + cnt - 1
+                if self._applied_seq is not None \
+                        and end <= self._applied_seq:
+                    continue
+                b.insert_into(mems)
+                # Publish order: entries first, then the watermark — a
+                # router read that saw applied>=token is guaranteed the
+                # token's entries are in the view it snapshots.
+                if end > self.versions.last_sequence:
+                    self.versions.last_sequence = end
+                self._applied_seq = end
+                applied += 1
+            if self.stats is not None:
+                self.stats.record_tick(stats_mod.REPLICATION_FRAMES_APPLIED)
+                lag = max(0, now_us - frame.shipped_unix_us)
+                self.stats.record_in_histogram(
+                    stats_mod.REPLICATION_LAG_MICROS, lag)
+        if applied and self.stats is not None:
+            self.stats.record_tick(
+                stats_mod.REPLICATION_RECORDS_APPLIED, applied)
+        return applied
+
+    # -- background tailing ---------------------------------------------
+
+    def start_tailing(self, interval: float = 0.05) -> None:
+        if self._tail_thread is not None:
+            return
+        self._tail_stop.clear()
+
+        def loop():
+            while not self._tail_stop.is_set():
+                try:
+                    self.catch_up()
+                except Exception:
+                    # The loop must survive transient primary restarts /
+                    # transport outages; the next round retries.
+                    self.tail_errors += 1
+                if self._tail_stop.wait(interval):
+                    return
+
+        self._tail_thread = threading.Thread(
+            target=loop, daemon=True, name="follower-tail")
+        self._tail_thread.start()
+
+    def stop_tailing(self) -> None:
+        self._tail_stop.set()
+        t = self._tail_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._tail_thread = None
+
+    def close(self) -> None:
+        self.stop_tailing()
+        super().close()
+
+    # -- admin ----------------------------------------------------------
+
+    def promote(self) -> str:
+        """Detach from the (dead) primary: final best-effort catch-up, stop
+        tailing, close, and return the path — reopen it with DB.open() for
+        read-write service (tools/repl_admin.py drives this)."""
+        self.stop_tailing()
+        try:
+            self.catch_up()
+        except Exception:
+            pass  # primary is gone; serve what we have
+        path = self.dbname
+        self.close()
+        return path
+
+    def replication_status(self) -> dict:
+        return {
+            "role": "follower",
+            "mode": self._mode,
+            "applied_sequence": self.applied_sequence(),
+            "epoch": self._epoch,
+            "tailing": self._tail_thread is not None,
+            "tail_errors": self.tail_errors,
+        }
+
+
+def _rm_tree(env, path: str) -> None:
+    """Best-effort recursive delete through the Env (checkpoint staging)."""
+    try:
+        for child in env.get_children(path):
+            try:
+                env.delete_file(f"{path}/{child}")
+            except Exception:
+                _rm_tree(env, f"{path}/{child}")
+    except Exception:
+        pass

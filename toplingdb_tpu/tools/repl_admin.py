@@ -1,0 +1,108 @@
+"""repl_admin: operate the replication plane over the SidePlugin HTTP layer.
+
+    python -m toplingdb_tpu.tools.repl_admin --url http://host:port status
+    python -m toplingdb_tpu.tools.repl_admin --url ... lag [--db NAME]
+    python -m toplingdb_tpu.tools.repl_admin --url ... promote --db NAME
+
+`status` dumps every registered DB's /replication view; `lag` prints a
+one-line applied-seq / lag summary per DB (scriptable: exits 1 when any
+follower lags more than --max-lag sequences); `promote` POSTs
+/promote/<name>, turning a registered FollowerDB into a read-write primary
+after its final catch-up (failover).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url: str, body: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _db_names(base: str, only: str | None) -> list[str]:
+    if only:
+        return [only]
+    return _get(f"{base}/dbs").get("dbs", [])
+
+
+def cmd_status(base: str, args) -> int:
+    out = {}
+    for name in _db_names(base, args.db):
+        try:
+            out[name] = _get(f"{base}/replication/{name}")
+        except Exception as e:  # noqa: BLE001
+            out[name] = {"error": str(e)}
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_lag(base: str, args) -> int:
+    worst = 0
+    rows = []
+    primary_seq = None
+    views = {name: _get(f"{base}/replication/{name}")
+             for name in _db_names(base, args.db)}
+    for name, v in views.items():
+        if v.get("role") in ("primary", "router"):
+            primary_seq = max(primary_seq or 0,
+                              v.get("last_sequence",
+                                    v.get("primary_sequence", 0)))
+    for name, v in views.items():
+        applied = v.get("applied_sequence", v.get("last_sequence", 0))
+        lag = (max(0, primary_seq - applied)
+               if primary_seq is not None and v.get("role") == "follower"
+               else 0)
+        worst = max(worst, lag)
+        rows.append(f"{name}\trole={v.get('role', '?')}\t"
+                    f"applied={applied}\tlag_seq={lag}")
+    print("\n".join(rows))
+    if args.max_lag is not None and worst > args.max_lag:
+        print(f"worst lag {worst} > --max-lag {args.max_lag}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_promote(base: str, args) -> int:
+    if not args.db:
+        print("promote requires --db NAME", file=sys.stderr)
+        return 2
+    try:
+        out = _post(f"{base}/promote/{args.db}", {})
+    except urllib.error.HTTPError as e:
+        print(f"promote failed: HTTP {e.code} {e.read().decode()[:200]}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="repl_admin")
+    ap.add_argument("--url", required=True,
+                    help="SidePluginRepo HTTP base, e.g. http://127.0.0.1:8080")
+    ap.add_argument("--db", default=None, help="restrict to one DB name")
+    ap.add_argument("--max-lag", type=int, default=None,
+                    help="lag: exit 1 when any follower lags more sequences")
+    ap.add_argument("command", choices=["status", "lag", "promote"])
+    args = ap.parse_args(argv)
+    base = args.url.rstrip("/")
+    return {"status": cmd_status, "lag": cmd_lag,
+            "promote": cmd_promote}[args.command](base, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,10 @@
 """Checkpoint: consistent openable snapshot of a live DB in a new directory
 (reference utilities/checkpoint/checkpoint_impl.cc in /root/reference):
 hard-link SSTs (copy on filesystems without links), write a fresh MANIFEST
-snapshot + CURRENT, flush first so no WAL tail is needed."""
+snapshot + OPTIONS + CURRENT, flush first so no WAL tail is needed.
+
+CURRENT is written LAST: a directory without CURRENT is not a checkpoint,
+so a crash mid-copy can never leave a half-snapshot that opens."""
 
 from __future__ import annotations
 
@@ -95,7 +98,89 @@ def _checkpoint_locked(db, env, dest: str) -> None:
             w.add_record(edit.encode())
         w.sync()
         w.close()
-        filename.set_current_file(db.env, dest, manifest_number)
         db.env.write_file(
             filename.identity_file_name(dest), db.identity.encode()
         )
+        # OPTIONS ride in the snapshot (reference checkpoints link the
+        # OPTIONS file): a restored DB / follower bootstrap reopens with
+        # the same comparator/merge-operator/table config it was built
+        # with instead of whatever the caller defaults to.
+        try:
+            from toplingdb_tpu.utils.config import options_to_config
+
+            import json as _json
+
+            db.env.write_file(
+                filename.options_file_name(dest, manifest_number + 1),
+                _json.dumps(options_to_config(db.options), indent=1).encode(),
+                sync=True,
+            )
+        except Exception:
+            pass  # unregistered custom plugin objects: OPTIONS best-effort
+        # CURRENT last — this write is what MAKES dest a checkpoint.
+        filename.set_current_file(db.env, dest, manifest_number)
+
+
+class Checkpoint:
+    """Handle on a checkpoint directory. `Checkpoint.create(db, dest)`
+    snapshots a live DB; `Checkpoint(path, env).restore_to(dest)` copies a
+    checkpoint into a fresh directory (the follower-bootstrap path in
+    replication/follower.py) after verifying it is complete."""
+
+    def __init__(self, path: str, env=None):
+        if env is None:
+            from toplingdb_tpu.env import default_env
+
+            env = default_env()
+        self.path = path
+        self.env = env
+
+    @staticmethod
+    def create(db, dest: str) -> "Checkpoint":
+        create_checkpoint(db, dest)
+        return Checkpoint(dest, db.env)
+
+    def verify(self) -> None:
+        """A complete checkpoint has CURRENT pointing at a present MANIFEST
+        (CURRENT was written last, so its presence implies the rest)."""
+        env = self.env
+        cur = filename.current_file_name(self.path)
+        if not env.file_exists(cur):
+            raise InvalidArgument(
+                f"{self.path} is not a checkpoint (no CURRENT — "
+                f"an interrupted create never writes one)"
+            )
+        name = env.read_file(cur).decode().strip()
+        if not env.file_exists(f"{self.path}/{name}"):
+            raise InvalidArgument(
+                f"{self.path}: CURRENT points at missing {name}"
+            )
+
+    def restore_to(self, dest: str) -> str:
+        """Copy this checkpoint into `dest` (must not exist or be empty) and
+        return dest, openable as a DB. CURRENT again lands last so an
+        interrupted restore is never mistaken for a database."""
+        env = self.env
+        self.verify()
+        if env.file_exists(dest):
+            try:
+                if env.get_children(dest):
+                    raise InvalidArgument(
+                        f"restore target {dest} exists and is not empty"
+                    )
+            except InvalidArgument:
+                raise
+            except Exception:
+                pass
+        env.create_dir(dest)
+        children = [c for c in env.get_children(self.path)
+                    if c != "CURRENT"]
+        for child in children:
+            try:
+                data = env.read_file(f"{self.path}/{child}")
+            except (OSError, IsADirectoryError):
+                continue  # stray subdirectory: checkpoints hold only files
+            env.write_file(f"{dest}/{child}", data, sync=True)
+        env.write_file(f"{dest}/CURRENT",
+                       env.read_file(f"{self.path}/CURRENT"), sync=True)
+        return dest

@@ -313,6 +313,12 @@ class SidePluginRepo:
         self._configs: dict[str, dict] = {}
         self._server: ThreadingHTTPServer | None = None
 
+    def attach_db(self, name: str, db, config: dict | None = None) -> None:
+        """Register an externally-opened DB (a FollowerDB, a router's
+        primary) so the HTTP layer serves its stats//replication views."""
+        self._dbs[name] = db
+        self._configs[name] = config or {}
+
     def open_db(self, config, name: str | None = None):
         """config: dict or JSON string: {"path": ..., "options": {...}}."""
         from toplingdb_tpu.db.db import DB
@@ -347,10 +353,13 @@ class SidePluginRepo:
     # -- HTTP introspection --------------------------------------------
 
     def start_http(self, port: int = 0) -> int:
-        """Serves /dbs, /stats/<name>, /levels/<name>, /config/<name>, and
-        /metrics (Prometheus text format over every registered DB's
-        Statistics — the rockside Prometheus role). Returns the bound
-        port."""
+        """Serves /dbs, /stats/<name>, /levels/<name>, /config/<name>,
+        /replication/<name> (role/lag/applied-seq of the replication
+        plane), and /metrics (Prometheus text format over every registered
+        DB's Statistics — the rockside Prometheus role). POST
+        /promote/<name> promotes a registered FollowerDB to a read-write
+        primary in place (tools/repl_admin.py drives it). Returns the
+        bound port."""
         repo = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -422,6 +431,9 @@ class SidePluginRepo:
                         else:
                             db.set_options(payload)
                             code, body = 200, {"ok": True, "applied": payload}
+                    elif parts and parts[0] == "promote":
+                        name = "/".join(parts[1:])
+                        code, body = repo._promote(name)
                     else:
                         code, body = 404, {"error": "not found"}
                 except (InvalidArgument, ValueError) as e:  # client's fault
@@ -515,4 +527,40 @@ class SidePluginRepo:
             }
         if kind == "config":
             return self._configs.get(name)
+        if kind == "replication":
+            provider = getattr(db, "_repl_status_provider", None)
+            if provider is not None:
+                out = dict(provider())
+            else:
+                out = {
+                    "role": ("standalone-readonly"
+                             if getattr(db.options, "read_only", False)
+                             else "primary-unshipped"),
+                }
+            out.setdefault("last_sequence", db.versions.last_sequence)
+            return out
         return None
+
+    def _promote(self, name: str):
+        """Promote a registered FollowerDB: detach it from the (dead)
+        primary and reopen its directory read-write under the same name —
+        the failover half of the replication plane."""
+        db = self._dbs.get(name)
+        if db is None:
+            return 404, {"error": "no such db"}
+        promote = getattr(db, "promote", None)
+        if promote is None:
+            return 400, {"error": f"{name} is not a follower"}
+        from toplingdb_tpu.db.db import DB
+        from toplingdb_tpu.options import Options
+
+        path = promote()  # final catch-up + close; returns the directory
+        opts_cfg = dict(self._configs.get(name, {}).get("options", {}))
+        opts_cfg.pop("read_only", None)
+        opts = options_from_config(opts_cfg) if opts_cfg else Options()
+        opts.create_if_missing = False
+        opts.read_only = False
+        new_db = DB.open(path, opts, env=db.env)
+        self._dbs[name] = new_db
+        return 200, {"promoted": name, "path": path,
+                     "last_sequence": new_db.versions.last_sequence}

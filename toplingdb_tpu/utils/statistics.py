@@ -96,6 +96,20 @@ DCOMPACTION_BREAKER_OPEN = "dcompaction.breaker.open"
 DCOMPACTION_BREAKER_CLOSE = "dcompaction.breaker.close"
 DCOMPACTION_BREAKER_SKIPPED = "dcompaction.breaker.skipped"
 DCOMPACTION_ORPHANS_SWEPT = "dcompaction.orphans.swept"
+
+# Replication plane (replication/): WAL shipping, follower apply, router.
+REPLICATION_FRAMES_SHIPPED = "replication.frames.shipped"
+REPLICATION_BYTES_SHIPPED = "replication.bytes.shipped"
+REPLICATION_FRAMES_APPLIED = "replication.frames.applied"
+REPLICATION_RECORDS_APPLIED = "replication.records.applied"
+REPLICATION_FRAME_GAPS = "replication.frame.gaps"          # missing seq run
+REPLICATION_FRAME_CORRUPT = "replication.frame.corrupt"    # bad CRC/frame
+REPLICATION_EPOCH_RELOADS = "replication.epoch.reloads"    # MANIFEST re-read
+REPLICATION_BOOTSTRAPS = "replication.bootstraps"          # checkpoint restore
+ROUTER_FOLLOWER_READS = "replication.router.follower.reads"
+ROUTER_PRIMARY_READS = "replication.router.primary.reads"  # fallbacks
+ROUTER_STALE_SKIPS = "replication.router.stale.skips"      # applied < token
+ROUTER_BREAKER_SKIPS = "replication.router.breaker.skips"
 # -- flush / WAL / files ---------------------------------------------
 FLUSH_WRITE_BYTES = "flush.write.bytes"
 NO_FILE_OPENS = "no.file.opens"
@@ -148,6 +162,7 @@ TABLE_OPEN_IO_MICROS = "table.open.io.micros"
 WAL_FILE_SYNC_MICROS = "wal.file.sync.micros"
 MANIFEST_FILE_SYNC_MICROS = "manifest.file.sync.micros"
 WRITE_STALL_MICROS_HIST = "write.stall.micros"
+REPLICATION_LAG_MICROS = "replication.lag.micros"  # ship→apply wall lag
 NUM_FILES_IN_SINGLE_COMPACTION = "numfiles.in.singlecompaction"
 BYTES_PER_READ = "bytes.per.read"
 BYTES_PER_WRITE = "bytes.per.write"
