@@ -207,6 +207,49 @@ def surviving_tombstone_fragments(rd: RangeDelAggregator, snapshots: list[int],
     return frags
 
 
+def verify_output_table(env, path: str, icmp, table_options,
+                        expected: dict, expected_entries: int) -> None:
+    """Protection-driven output verification (the reference's
+    paranoid_file_checks, generalized with per-entry checksums): re-read
+    a just-written output SST from disk and check every entry against the
+    multiset of checksums computed from the survivor stream that was
+    meant to land in it. Catches the native/device block writers altering
+    key or value bytes between emission and disk."""
+    import dataclasses as _dc
+
+    from toplingdb_tpu.table.factory import open_table
+    from toplingdb_tpu.utils import protection as _p
+    from toplingdb_tpu.utils.status import Corruption
+
+    pb = table_options.protection_bytes_per_key
+    topts = _dc.replace(table_options, verify_checksums=True)
+    reader = open_table(env.new_random_access_file(path), icmp, topts)
+    try:
+        remaining = dict(expected)
+        n = 0
+        it = reader.new_iterator()
+        it.seek_to_first()
+        for ikey, val in it.entries():
+            uk, _seq, t = dbformat.split_internal_key(ikey)
+            cs = _p.truncate(_p.protect_entry(t, uk, val), pb)
+            left = remaining.get(cs, 0)
+            if left <= 0:
+                raise Corruption(
+                    f"compaction output {path}: entry {uk!r} (type {t}) "
+                    f"does not match any emitted survivor — output bytes "
+                    f"corrupted by the write plane"
+                )
+            remaining[cs] = left - 1
+            n += 1
+        if n != expected_entries:
+            raise Corruption(
+                f"compaction output {path}: {n} entries on disk, "
+                f"{expected_entries} emitted"
+            )
+    finally:
+        reader.close()
+
+
 def build_outputs(env, dbname: str, icmp, compaction: Compaction,
                   entries_iter, surviving_tombstones, new_file_number,
                   table_options, stats: CompactionStats,
@@ -214,15 +257,23 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
                   column_family: tuple[int, str] = (0, "default"),
                   ) -> list[FileMetaData]:
     """Cut the survivor stream into output tables (reference
-    CompactionOutputs / SubcompactionState::AddToOutput)."""
+    CompactionOutputs / SubcompactionState::AddToOutput). With
+    protection_bytes_per_key active, each emitted entry's checksum is
+    banked and the finished file is re-read and verified against the bank
+    (verify_output_table) before it can reach the MANIFEST."""
+    from toplingdb_tpu.utils import protection as _p
+
+    pb = getattr(table_options, "protection_bytes_per_key", 0)
     outputs: list[FileMetaData] = []
     builder = None
     wfile = None
     fnum = None
     blob_refs: set[int] = set()
+    emitted: dict[int, int] = {}  # checksum -> count for the open output
+    emitted_n = 0
 
     def open_output():
-        nonlocal builder, wfile, fnum
+        nonlocal builder, wfile, fnum, emitted, emitted_n
         fnum = new_file_number()
         wfile = env.new_writable_file(filename.table_file_name(dbname, fnum))
         builder = new_table_builder(wfile, icmp, table_options,
@@ -230,6 +281,8 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
                                     column_family_id=column_family[0],
                                     column_family_name=column_family[1])
         blob_refs.clear()
+        emitted = {}
+        emitted_n = 0
 
     def close_output(pending_tombstones):
         nonlocal builder, wfile, fnum
@@ -247,6 +300,11 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
         props = builder.finish()
         wfile.sync()
         wfile.close()
+        if pb:
+            verify_output_table(
+                env, filename.table_file_name(dbname, fnum), icmp,
+                table_options, emitted, emitted_n,
+            )
         meta = FileMetaData(
             number=fnum,
             file_size=env.get_file_size(filename.table_file_name(dbname, fnum)),
@@ -285,6 +343,11 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
                 # partitioning is a later-round refinement).
                 close_output([])
                 open_output()
+            if pb:
+                cs = _p.truncate(
+                    _p.protect_entry(ikey[-8], uk, value), pb)
+                emitted[cs] = emitted.get(cs, 0) + 1
+                emitted_n += 1
             builder.add(ikey, value)
             if ikey[-8] == dbformat.ValueType.BLOB_INDEX:
                 blob_refs.add(decode_blob_index(value)[0])

@@ -17,6 +17,13 @@ from toplingdb_tpu.db.version_edit import FileMetaData
 from toplingdb_tpu.db.version_set import Version
 
 
+def _busy(f) -> bool:
+    """A file the picker must not touch: already in a running job, or
+    quarantined by the IntegrityScrubber (db/integrity.py) — corrupt
+    bytes must never be merged into new SSTs."""
+    return f.being_compacted or f.quarantined
+
+
 @dataclass
 class Compaction:
     """A picked compaction: inputs at `level` (+ overlapping at output_level),
@@ -92,23 +99,23 @@ class LeveledCompactionPicker(CompactionPicker):
         """(score, level) sorted descending; score >= 1.0 needs compaction
         (reference VersionStorageInfo::ComputeCompactionScore)."""
         scores = []
-        l0 = [f for f in version.files[0] if not f.being_compacted]
+        l0 = [f for f in version.files[0] if not _busy(f)]
         l0_score = len(l0) / self.options.level0_file_num_compaction_trigger
         if any(f.marked_for_compaction for f in l0):
             l0_score = max(l0_score, 1.0)
         scores.append((l0_score, 0))
         last = version.num_levels - 1
-        if any(f.marked_for_compaction and not f.being_compacted
+        if any(f.marked_for_compaction and not _busy(f)
                for f in version.files[last]):
             # Bottommost marked files are rewritten in place (reference
             # bottommost_files_marked_for_compaction_).
             scores.append((1.0, last))
         for level in range(1, version.num_levels - 1):
             total = sum(
-                f.file_size for f in version.files[level] if not f.being_compacted
+                f.file_size for f in version.files[level] if not _busy(f)
             )
             score = total / self.options.max_bytes_for_level(level)
-            if any(f.marked_for_compaction and not f.being_compacted
+            if any(f.marked_for_compaction and not _busy(f)
                    for f in version.files[level]):
                 # Collector-flagged files (reference
                 # files_marked_for_compaction_) force the level eligible.
@@ -139,7 +146,7 @@ class LeveledCompactionPicker(CompactionPicker):
         total = 0
         cap = self.options.max_compaction_bytes or (1 << 62)
         for f in version.files[0]:  # newest-first
-            if f.being_compacted:
+            if _busy(f):
                 break
             if total + f.file_size > cap and run:
                 break
@@ -157,7 +164,7 @@ class LeveledCompactionPicker(CompactionPicker):
         if level == version.num_levels - 1:
             # In-place rewrite of a collector-marked bottommost file.
             marked = [f for f in version.files[level]
-                      if f.marked_for_compaction and not f.being_compacted]
+                      if f.marked_for_compaction and not _busy(f)]
             if not marked:
                 return None
             f0 = marked[0]
@@ -168,11 +175,11 @@ class LeveledCompactionPicker(CompactionPicker):
                 max_output_file_size=self.options.target_file_size(level),
             )
         if level == 0:
-            inputs = [f for f in version.files[0] if not f.being_compacted]
+            inputs = [f for f in version.files[0] if not _busy(f)]
             if (len(inputs) < self.options.level0_file_num_compaction_trigger
                     and not any(f.marked_for_compaction for f in inputs)):
                 return None
-            if not inputs or any(f.being_compacted for f in version.files[0]):
+            if not inputs or any(_busy(f) for f in version.files[0]):
                 # L0→L1 must take all L0 files; while some are busy,
                 # compact the free newest prefix L0→L0 instead
                 # (reference TryPickIntraL0Compaction) so read-amp and
@@ -182,7 +189,7 @@ class LeveledCompactionPicker(CompactionPicker):
         else:
             # Pick the largest not-being-compacted file (simple heuristic;
             # the reference uses kByCompensatedSize by default).
-            candidates = [f for f in version.files[level] if not f.being_compacted]
+            candidates = [f for f in version.files[level] if not _busy(f)]
             if not candidates:
                 return None
             marked = [f for f in candidates if f.marked_for_compaction]
@@ -195,13 +202,13 @@ class LeveledCompactionPicker(CompactionPicker):
             # Expand inputs at the same level to cover the user-key range
             # fully; abort on conflict with a running job.
             more = self._expand_range_to_level(version, level, smallest, largest)
-            if any(f.being_compacted for f in more):
+            if any(_busy(f) for f in more):
                 return None
             merged = {f.number: f for f in inputs + more}
             inputs = sorted(merged.values(), key=lambda f: f.number)
             smallest, largest = self._key_range(inputs)
         outputs = self._expand_range_to_level(version, output_level, smallest, largest)
-        if any(f.being_compacted for f in outputs):
+        if any(_busy(f) for f in outputs):
             return self._try_intra_l0(version) if level == 0 else None
         all_small, all_large = self._key_range(inputs + outputs) if outputs else (smallest, largest)
         return Compaction(
@@ -225,10 +232,10 @@ class UniversalCompactionPicker(CompactionPicker):
         return [(n / max(1, self.options.level0_file_num_compaction_trigger), 0)]
 
     def pick_compaction(self, version: Version) -> Compaction | None:
-        runs = [f for f in version.files[0] if not f.being_compacted]
+        runs = [f for f in version.files[0] if not _busy(f)]
         if len(runs) < self.options.level0_file_num_compaction_trigger:
             return None
-        if any(f.being_compacted for f in version.files[0]):
+        if any(_busy(f) for f in version.files[0]):
             return None
         opts = self.options
         # 1. Size-amplification trigger: total/newest vs percent.
@@ -236,7 +243,7 @@ class UniversalCompactionPicker(CompactionPicker):
         base = version.files[last_level]
         younger_bytes = sum(f.file_size for f in runs)
         base_bytes = sum(f.file_size for f in base)
-        if base and not any(f.being_compacted for f in base):
+        if base and not any(_busy(f) for f in base):
             if base_bytes > 0 and younger_bytes * 100 >= (
                 opts.universal_max_size_amplification_percent * base_bytes
             ):
@@ -270,7 +277,7 @@ class UniversalCompactionPicker(CompactionPicker):
                 max_output_file_size=2**62,
             )
         # 3. Fall back: merge all runs into the last level.
-        if base and any(f.being_compacted for f in base):
+        if base and any(_busy(f) for f in base):
             return None
         smallest, largest = self._key_range(runs + list(base)) if base else self._key_range(runs)
         return Compaction(
@@ -305,7 +312,7 @@ class FIFOCompactionPicker(CompactionPicker):
         cutoff = int(_t.time()) - ttl
         out = []
         for f in version.files[0]:
-            if f.being_compacted:
+            if _busy(f):
                 continue
             ct = self.creation_time_fn(f)
             if ct and ct <= cutoff:
@@ -324,7 +331,7 @@ class FIFOCompactionPicker(CompactionPicker):
         # files[0] is newest-first; drop from the tail (oldest).
         drop = []
         for f in reversed(version.files[0]):
-            if f.being_compacted:
+            if _busy(f):
                 break
             drop.append(f)
             total -= f.file_size

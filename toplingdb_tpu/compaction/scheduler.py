@@ -369,6 +369,9 @@ class CompactionScheduler:
                 # snapshots pin its remaining tombstones.
                 for m in outputs:
                     m.marked_for_compaction = False
+            # Whole-file checksums ride into the MANIFEST with the install
+            # (covers local, device, and remote-worker outputs uniformly).
+            db._stamp_file_checksums(outputs)
             edit = make_version_edit(c, outputs)
             with db._mutex:
                 db.versions.log_and_apply(edit)
@@ -463,10 +466,12 @@ class CompactionScheduler:
             with db._mutex:
                 version = db.versions.cf_current(cf_id)
                 if level == 0:
-                    inputs = list(version.files[0])
+                    inputs = [f for f in version.files[0]
+                              if not f.quarantined]
                 else:
                     inputs = [
                         f for f in version.overlapping_files(level, begin, end)
+                        if not f.quarantined
                     ]
                 if not inputs:
                     continue
@@ -499,9 +504,9 @@ class CompactionScheduler:
         db = self.db
         with db._mutex:
             version = db.versions.cf_current(cf_id)
-            runs = list(version.files[0])
+            runs = [f for f in version.files[0] if not f.quarantined]
             last = version.num_levels - 1
-            base = list(version.files[last])
+            base = [f for f in version.files[last] if not f.quarantined]
             if not runs and not base:
                 return
             c = Compaction(

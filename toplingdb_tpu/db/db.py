@@ -114,11 +114,13 @@ class _CFData:
 
     __slots__ = ("handle", "mem", "imm")
 
-    def __init__(self, handle: ColumnFamilyHandle, icmp, rep_name: str = "vector"):
+    def __init__(self, handle: ColumnFamilyHandle, icmp, rep_name: str = "vector",
+                 protection_bytes: int = 0):
         from toplingdb_tpu.db.memtable import create_memtable_rep
 
         self.handle = handle
-        self.mem = MemTable(icmp, create_memtable_rep(rep_name))
+        self.mem = MemTable(icmp, create_memtable_rep(rep_name),
+                            protection_bytes=protection_bytes)
         self.imm: list[MemTable] = []
 
 
@@ -213,6 +215,28 @@ class DB:
         self.icmp = InternalKeyComparator(options.comparator)
         self._nget_tl = threading.local()  # native-get per-thread state
         self._op_tracer = None             # DB::StartTrace recorder
+        # Integrity plane: per-entry protection + whole-file checksums +
+        # scrubber state (utils/protection.py, utils/file_checksum.py,
+        # db/integrity.py).
+        from toplingdb_tpu.utils.file_checksum import factory_for
+        from toplingdb_tpu.utils.protection import check_protection_bytes
+
+        pb = getattr(options, "protection_bytes_per_key", 0)
+        check_protection_bytes(pb)
+        self._protection = pb
+        self._file_checksum_factory = factory_for(options)
+        self._quarantined: set[int] = set()
+        self._integrity_scrubber = None
+        if pb and getattr(options.table_options,
+                          "protection_bytes_per_key", 0) != pb:
+            # Propagate into the table layer (like prefix_extractor below)
+            # so the flush/compaction/scan data planes see the knob without
+            # signature plumbing; copy — never mutate the caller's object.
+            import dataclasses as _dcs_p
+
+            options.table_options = _dcs_p.replace(
+                options.table_options, protection_bytes_per_key=pb,
+            )
         if (options.prefix_extractor is not None
                 and options.table_options.prefix_extractor is None):
             # CF-level extractor feeds the table layer (prefix blooms, plain
@@ -270,7 +294,8 @@ class DB:
         self.table_cache.stats = options.statistics
         self.default_cf = ColumnFamilyHandle(0, "default")
         self._cfs: dict[int, _CFData] = {
-            0: _CFData(self.default_cf, self.icmp, options.memtable_rep)
+            0: _CFData(self.default_cf, self.icmp, options.memtable_rep,
+                       protection_bytes=self._protection)
         }
         from toplingdb_tpu.db.blob import BlobSource
 
@@ -399,7 +424,8 @@ class DB:
         with self._mutex:
             cf_id = self.versions.create_column_family(name)
             h = ColumnFamilyHandle(cf_id, name)
-            self._cfs[cf_id] = _CFData(h, self.icmp, self.options.memtable_rep)
+            self._cfs[cf_id] = _CFData(h, self.icmp, self.options.memtable_rep,
+                                       protection_bytes=self._protection)
             return h
 
     def drop_column_family(self, handle: ColumnFamilyHandle) -> None:
@@ -523,6 +549,12 @@ class DB:
 
         db._compaction_scheduler = CompactionScheduler(db)
         db._maybe_schedule_compaction()
+        if (not options.read_only
+                and getattr(options, "integrity_scrub_period_sec", 0) > 0):
+            from toplingdb_tpu.db.integrity import IntegrityScrubber
+
+            db._integrity_scrubber = IntegrityScrubber(db)
+            db._integrity_scrubber.start()
         return db
 
     def _recover(self) -> None:
@@ -545,7 +577,11 @@ class DB:
             reader = LogReader(self.env.new_sequential_file(path),
                                log_number=num)
             for rec in reader.records():
-                batch = WriteBatch(rec)
+                # The WAL record's own CRC vouched for `rec`; protection
+                # computed here covers the replayed entries from decode
+                # through memtable and flush.
+                batch = WriteBatch(
+                    rec, protection_bytes_per_key=self._protection)
                 batch.insert_into(mems)
                 end_seq = batch.sequence() + batch.count() - 1
                 max_seq = max(max_seq, end_seq)
@@ -567,12 +603,15 @@ class DB:
         for cf_id, st in self.versions.column_families.items():
             if cf_id not in self._cfs:
                 h = ColumnFamilyHandle(cf_id, st.name)
-                self._cfs[cf_id] = _CFData(h, self.icmp, self.options.memtable_rep)
+                self._cfs[cf_id] = _CFData(h, self.icmp,
+                                           self.options.memtable_rep,
+                                           protection_bytes=self._protection)
 
     def _fresh_memtable(self) -> MemTable:
         from toplingdb_tpu.db.memtable import create_memtable_rep
 
-        m = MemTable(self.icmp, create_memtable_rep(self.options.memtable_rep))
+        m = MemTable(self.icmp, create_memtable_rep(self.options.memtable_rep),
+                     protection_bytes=self._protection)
         self._mem_id_counter += 1
         m.mem_id = self._mem_id_counter
         return m
@@ -595,6 +634,8 @@ class DB:
             self._recyclable_written.add(self._wal_number)
 
     def close(self) -> None:
+        if self._integrity_scrubber is not None:
+            self._integrity_scrubber.stop()
         if self._stats_dumper is not None:
             self._stats_dumper.stop()
         if self._mget_pool is not None:
@@ -683,19 +724,19 @@ class DB:
 
     def put(self, key: bytes, value: bytes, opts: WriteOptions = _DEFAULT_WRITE,
             cf=None, ts: int | None = None) -> int:
-        b = WriteBatch()
+        b = WriteBatch(protection_bytes_per_key=self._protection)
         b.put(self._ts_key(key, ts), value, cf=self._cf_id(cf))
         return self.write(b, opts)
 
     def delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
                cf=None, ts: int | None = None) -> int:
-        b = WriteBatch()
+        b = WriteBatch(protection_bytes_per_key=self._protection)
         b.delete(self._ts_key(key, ts), cf=self._cf_id(cf))
         return self.write(b, opts)
 
     def single_delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
                       cf=None, ts: int | None = None) -> int:
-        b = WriteBatch()
+        b = WriteBatch(protection_bytes_per_key=self._protection)
         b.single_delete(self._ts_key(key, ts), cf=self._cf_id(cf))
         return self.write(b, opts)
 
@@ -705,7 +746,7 @@ class DB:
             raise InvalidArgument(
                 "Merge is not supported with user-defined timestamps"
             )
-        b = WriteBatch()
+        b = WriteBatch(protection_bytes_per_key=self._protection)
         b.merge(key, value, cf=self._cf_id(cf))
         return self.write(b, opts)
 
@@ -715,7 +756,7 @@ class DB:
             raise InvalidArgument(
                 "DeleteRange is not supported with user-defined timestamps"
             )
-        b = WriteBatch()
+        b = WriteBatch(protection_bytes_per_key=self._protection)
         b.delete_range(begin, end, cf=self._cf_id(cf))
         return self.write(b, opts)
 
@@ -739,6 +780,12 @@ class DB:
         if batch.is_empty():
             return self.versions.last_sequence  # trivially-satisfied token
         self._check_open()  # fail fast before any stall sleep
+        if self._protection:
+            # Materialize (caller-constructed batches / records added
+            # since the last compute): one native pass BEFORE the WAL
+            # append and group merge — the memtable-insert re-verification
+            # then spans the whole commit path.
+            batch.ensure_protection(self._protection)
         tr = self._op_tracer
         if tr is not None:
             tr.record_write(batch.data())
@@ -1240,6 +1287,8 @@ class DB:
             from toplingdb_tpu.utils.kill_point import test_kill_random
 
             test_kill_random("FlushJob::AfterTableWrite")
+            if meta is not None:
+                self._stamp_file_checksums([meta])
             edit = VersionEdit(log_number=wal_number, column_family=cf_id)
             if meta is not None:
                 edit.add_file(0, meta)
@@ -2170,6 +2219,7 @@ class DB:
                 read_ts=opts.timestamp,
                 stats=self.stats,
                 readahead_size=ra,
+                protection_bytes=self._protection,
             )
             if plane is not None:
                 it.attach_scan_plane(plane)
@@ -2459,11 +2509,18 @@ class DB:
         reference's ErrorHandler severity tables (db/error_handler.cc:
         kSoft for retryable/no-space flush+compaction IO errors, kFatal for
         MANIFEST failures and corruption, kUnrecoverable for corruption
-        found BY compaction — it would be baked into new SSTs)."""
+        found BY compaction — it would be baked into new SSTs). The
+        integrity scrubber's kCorruption latch (reason="scrub") is HARD,
+        not FATAL: the corrupt file is quarantined before the latch, so
+        nothing wrong was served or propagated — after the operator
+        restores/repairs the file and a clean re-scrub, resume() is
+        legitimate (db/integrity.py)."""
         from toplingdb_tpu.utils.status import Corruption as _Corr
         from toplingdb_tpu.utils.status import Severity
 
         if isinstance(e, _Corr):
+            if reason == "scrub":
+                return Severity.HARD_ERROR
             return (Severity.UNRECOVERABLE if reason == "compaction"
                     else Severity.FATAL_ERROR)
         if reason == "manifest":
@@ -2689,11 +2746,16 @@ class DB:
         DB::VerifyChecksum): every data block is read FROM DISK and
         CRC-verified — cached readers/blocks are bypassed, as the reference
         scans with fill_cache=false; raises Corruption on the first bad
-        block. Holding the Version objects pins the files against
-        concurrent obsolete-file GC."""
+        block. Opening with verify_checksums=True also CRC-verifies the
+        index, metaindex, properties, filter, and range-del meta blocks at
+        construction, and every BLOB_INDEX entry's referenced blob record
+        is probed with its record CRC — the meta/blob coverage the plain
+        data-block walk used to miss. Holding the Version objects pins the
+        files against concurrent obsolete-file GC."""
         import dataclasses as _dc
 
         from toplingdb_tpu.table.factory import open_table
+        from toplingdb_tpu.utils import statistics as _st
 
         with self._mutex:
             versions = [
@@ -2701,6 +2763,7 @@ class DB:
                 for cf_id in self.versions.column_families
             ]
         topts = _dc.replace(self.options.table_options, verify_checksums=True)
+        bytes_verified = 0
         for version in versions:
             for _, f in version.all_files():
                 path = filename.table_file_name(self.dbname, f.number)
@@ -2710,10 +2773,87 @@ class DB:
                 try:
                     it = reader.new_iterator()
                     it.seek_to_first()
-                    for _ in it.entries():  # decoding verifies block CRCs
-                        pass
+                    for ik, v in it.entries():  # decoding verifies block CRCs
+                        if ik[-8] == dbformat.ValueType.BLOB_INDEX:
+                            # Sweep the referenced blob record (its value
+                            # CRC rides in the blob file, db/blob.py).
+                            self.blob_source.get(v, verify=True)
                 finally:
                     reader.close()
+                bytes_verified += f.file_size
+        if self.stats is not None and bytes_verified:
+            self.stats.record_tick(_st.INTEGRITY_BYTES_VERIFIED,
+                                   bytes_verified)
+
+    def verify_file_checksums(self) -> dict:
+        """Recompute every live SST's whole-file checksum and compare with
+        the MANIFEST-recorded value (reference DB::VerifyFileChecksums);
+        raises Corruption on the first mismatch. Returns
+        {'files_verified', 'bytes_verified', 'files_skipped'} — skipped
+        files predate checksum recording (or it is disabled)."""
+        from toplingdb_tpu.utils import statistics as _st
+        from toplingdb_tpu.utils.file_checksum import (
+            verify_recorded_checksum,
+        )
+
+        with self._mutex:
+            versions = [
+                self.versions.cf_current(cf_id)
+                for cf_id in self.versions.column_families
+            ]
+        verified = bytes_v = skipped = 0
+        seen: set[int] = set()
+        for version in versions:
+            for _, f in version.all_files():
+                if f.number in seen:
+                    continue
+                seen.add(f.number)
+                path = filename.table_file_name(self.dbname, f.number)
+                n = verify_recorded_checksum(self.env, path, f)
+                if n:
+                    verified += 1
+                    bytes_v += n
+                else:
+                    skipped += 1
+        if self.stats is not None and bytes_v:
+            self.stats.record_tick(_st.INTEGRITY_BYTES_VERIFIED, bytes_v)
+        return {"files_verified": verified, "bytes_verified": bytes_v,
+                "files_skipped": skipped}
+
+    def scrub(self, deep: bool = False) -> dict:
+        """Run one IntegrityScrubber pass synchronously (db/integrity.py)
+        and return its report. Detected corruption quarantines the file,
+        fires on_corruption_detected, and latches the background-error
+        machinery (resume() after repair)."""
+        self._check_open()
+        if self._integrity_scrubber is None:
+            from toplingdb_tpu.db.integrity import IntegrityScrubber
+
+            self._integrity_scrubber = IntegrityScrubber(self)
+        return self._integrity_scrubber.run_pass(deep=deep)
+
+    def scrub_status(self) -> dict:
+        """The /integrity HTTP view's payload (utils/config.py)."""
+        if self._integrity_scrubber is None:
+            return {"running": False, "passes": 0,
+                    "quarantined_files": sorted(self._quarantined)}
+        return self._integrity_scrubber.status()
+
+    def _stamp_file_checksums(self, metas) -> None:
+        """Compute + record whole-file checksums on freshly produced SST
+        metadata before it reaches the MANIFEST (flush, compaction
+        install, ingest, import). No-op when disabled."""
+        factory = self._file_checksum_factory
+        if factory is None:
+            return
+        from toplingdb_tpu.utils.file_checksum import stamp_file_checksum
+
+        for meta in metas:
+            stamp_file_checksum(
+                self.env,
+                filename.table_file_name(self.dbname, meta.number),
+                meta, factory,
+            )
 
     def get_approximate_sizes(self, ranges: list[tuple[bytes, bytes]],
                               cf=None) -> list[int]:

@@ -50,6 +50,11 @@ class ExportedFile:
     num_entries: int
     num_deletions: int
     num_range_deletions: int
+    # Whole-file checksum carried from the source DB's MANIFEST (hex in
+    # JSON); import re-verifies the copy against it. Empty = unrecorded
+    # (pre-upgrade export) — defaults keep old export dirs loadable.
+    file_checksum: str = ""
+    file_checksum_func_name: str = ""
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
@@ -134,6 +139,8 @@ def export_column_family(db, cf, export_dir: str) -> ExportImportFilesMetaData:
                 num_entries=f.num_entries,
                 num_deletions=f.num_deletions,
                 num_range_deletions=f.num_range_deletions,
+                file_checksum=f.file_checksum.hex(),
+                file_checksum_func_name=f.file_checksum_func_name,
             ))
         meta = ExportImportFilesMetaData(
             db_comparator_name=db.icmp.user_comparator.name(),
@@ -187,7 +194,7 @@ def import_column_family(db, name: str, source_dir: str,
                     f"{src}: entry count {reader.properties.num_entries} "
                     f"!= exported metadata {ef.num_entries}"
                 )
-            edit_files.append((ef.level, FileMetaData(
+            meta = FileMetaData(
                 number=num, file_size=ef.file_size,
                 smallest=ef.smallest, largest=ef.largest,
                 smallest_seqno=ef.smallest_seqno,
@@ -195,7 +202,22 @@ def import_column_family(db, name: str, source_dir: str,
                 num_entries=ef.num_entries,
                 num_deletions=ef.num_deletions,
                 num_range_deletions=ef.num_range_deletions,
-            )))
+                file_checksum=bytes.fromhex(ef.file_checksum),
+                file_checksum_func_name=ef.file_checksum_func_name,
+            )
+            if meta.file_checksum:
+                # The exported checksum rode from the source DB's
+                # MANIFEST: the copy must still match it bit for bit.
+                from toplingdb_tpu.utils.file_checksum import (
+                    verify_recorded_checksum,
+                )
+
+                verify_recorded_checksum(env, dst, meta)
+            else:
+                # No recorded checksum to inherit: stamp a fresh one so
+                # the importing DB's integrity plane covers the file.
+                db._stamp_file_checksums([meta])
+            edit_files.append((ef.level, meta))
             max_seqno = max(max_seqno, ef.largest_seqno)
     except Exception:
         for p in copied:

@@ -28,6 +28,7 @@ TAG_MAX_COLUMN_FAMILY = 12
 TAG_NEW_FILE_EXT = 13           # NEW_FILE + varint flags [+ blob_refs list]
 _EXT_FLAG_MARKED = 1            # marked_for_compaction
 _EXT_FLAG_BLOBS = 2             # blob_refs list follows
+_EXT_FLAG_CHECKSUM = 4          # file checksum (func name + digest) follows
 
 
 @dataclass
@@ -50,10 +51,21 @@ class FileMetaData:
     # picker; persisted via the extended NEW_FILE tag (reference persists it
     # as a NewFile4 custom field).
     marked_for_compaction: bool = False
+    # Whole-file checksum (reference FileMetaData.file_checksum /
+    # file_checksum_func_name, recorded per SST in the MANIFEST): digest
+    # bytes + the generator name that produced them (utils/file_checksum).
+    # Empty = not recorded (pre-upgrade file or checksums disabled).
+    file_checksum: bytes = b""
+    file_checksum_func_name: str = ""
+    # In-memory only: the IntegrityScrubber found this file's on-disk bytes
+    # diverging from the recorded checksum — excluded from compaction picks
+    # so the corruption is never baked into new SSTs (db/integrity.py).
+    quarantined: bool = False
 
     def _ext_flags(self) -> int:
         return ((_EXT_FLAG_MARKED if self.marked_for_compaction else 0)
-                | (_EXT_FLAG_BLOBS if self.blob_refs else 0))
+                | (_EXT_FLAG_BLOBS if self.blob_refs else 0)
+                | (_EXT_FLAG_CHECKSUM if self.file_checksum else 0))
 
     def encode(self, extended: bool = False) -> bytes:
         out = bytearray()
@@ -75,6 +87,10 @@ class FileMetaData:
                 out += coding.encode_varint64(len(self.blob_refs))
                 for fn in self.blob_refs:
                     out += coding.encode_varint64(fn)
+            if flags & _EXT_FLAG_CHECKSUM:
+                coding.put_length_prefixed_slice(
+                    out, self.file_checksum_func_name.encode())
+                coding.put_length_prefixed_slice(out, self.file_checksum)
         return bytes(out)
 
     @staticmethod
@@ -91,6 +107,8 @@ class FileMetaData:
         nrd, off = coding.decode_varint64(buf, off)
         refs: list[int] = []
         marked = False
+        cksum = b""
+        cksum_name = ""
         if extended:
             flags, off = coding.decode_varint64(buf, off)
             marked = bool(flags & _EXT_FLAG_MARKED)
@@ -99,9 +117,15 @@ class FileMetaData:
                 for _ in range(nrefs):
                     fn, off = coding.decode_varint64(buf, off)
                     refs.append(fn)
+            if flags & _EXT_FLAG_CHECKSUM:
+                name_b, off = coding.get_length_prefixed_slice(buf, off)
+                cksum_name = name_b.decode()
+                cksum, off = coding.get_length_prefixed_slice(buf, off)
         return FileMetaData(number, size, smallest, largest, ssq, lsq,
                             ne, nd, nrd, refs,
-                            marked_for_compaction=marked), off
+                            marked_for_compaction=marked,
+                            file_checksum=cksum,
+                            file_checksum_func_name=cksum_name), off
 
 
 @dataclass

@@ -22,8 +22,97 @@ class FaultInjectionEnv(Env):
         self.op_count = 0
         self.io_counts: dict[str, int] = {}
         self._filesystem_active = True
+        # Read-side corruption rules (corrupt_reads): the file on disk
+        # stays intact; returned READ bytes are deterministically damaged.
+        self._corrupt_rules: list[dict] = []
+        self._corrupt_tick = 0  # transient-mode read counter
+        self.corruptions_injected: list[tuple[str, int, int]] = []
 
     # ------------------------------------------------------------------
+
+    # -- read-side corruption injection (`corrupt_read` kind) ----------
+
+    def corrupt_reads(self, pattern: str = "*", rate: float = 1e-5,
+                      seed: int = 0,
+                      kinds: tuple = ("bitflip", "byteswap"),
+                      transient: bool = False) -> None:
+        """Inject seeded read-side corruption: every read whose file's
+        BASENAME matches `pattern` (fnmatch; e.g. '*.sst', '000012.*')
+        has each returned byte independently damaged with probability
+        `rate`. Deterministic in (seed, basename, offset, length) — the
+        same read corrupts the same way every time, so integrity soaks
+        reproduce from a seed without hand-editing files. `kinds`:
+        'bitflip' XORs one random bit, 'byteswap' swaps adjacent bytes.
+        `transient=True` additionally mixes a running read counter into
+        the seed (still seeded, but a RETRY of the same read draws fresh
+        randomness — models transient bus/DMA flips, so detect-and-retry
+        paths like compaction can eventually make progress)."""
+        with self._mu:
+            self._corrupt_rules.append({
+                "pattern": pattern, "rate": float(rate), "seed": int(seed),
+                "kinds": tuple(kinds), "transient": bool(transient),
+            })
+
+    def clear_corrupt_reads(self) -> None:
+        with self._mu:
+            self._corrupt_rules = []
+
+    def _maybe_corrupt(self, path: str, offset: int, data: bytes) -> bytes:
+        if not self._corrupt_rules or not data:
+            return data
+        import fnmatch
+        import hashlib
+        import math
+        import random
+
+        name = path.rsplit("/", 1)[-1]
+        out = None
+        for rule in self._corrupt_rules:
+            if not fnmatch.fnmatch(name, rule["pattern"]):
+                continue
+            rate = rule["rate"]
+            if rate <= 0:
+                continue
+            # Stable digest seed (not hash(): per-process salt would break
+            # cross-process reproducibility of a corruption scenario).
+            tick = ""
+            if rule.get("transient"):
+                with self._mu:
+                    self._corrupt_tick += 1
+                    tick = f"|{self._corrupt_tick}"
+            material = (f"{rule['seed']}|{name}|{offset}|{len(data)}{tick}"
+                        .encode())
+            rng = random.Random(int.from_bytes(
+                hashlib.blake2s(material, digest_size=8).digest(),
+                "little"))
+            buf = bytearray(data if out is None else out)
+            n_hit = 0
+            # Geometric gap sampling: O(corrupted bytes), not O(length).
+            log1m = math.log1p(-rate) if rate < 1.0 else None
+            pos = 0
+            while True:
+                if log1m is None:
+                    gap = 0
+                else:
+                    gap = int(math.log(max(rng.random(), 1e-300)) / log1m)
+                pos += gap
+                if pos >= len(buf):
+                    break
+                kind = rule["kinds"][rng.randrange(len(rule["kinds"]))] \
+                    if rule["kinds"] else "bitflip"
+                if kind == "byteswap" and pos + 1 < len(buf):
+                    buf[pos], buf[pos + 1] = buf[pos + 1], buf[pos]
+                else:
+                    buf[pos] ^= 1 << rng.randrange(8)
+                n_hit += 1
+                pos += 1
+                if log1m is None:
+                    break
+            if n_hit:
+                out = bytes(buf)
+                with self._mu:
+                    self.corruptions_injected.append((name, offset, n_hit))
+        return data if out is None else out
 
     def _op(self, kind: str) -> None:
         with self._mu:
@@ -68,11 +157,11 @@ class FaultInjectionEnv(Env):
 
     def new_random_access_file(self, path: str) -> RandomAccessFile:
         self._op("open_r")
-        return _FIRandom(self, self.base.new_random_access_file(path))
+        return _FIRandom(self, self.base.new_random_access_file(path), path)
 
     def new_sequential_file(self, path: str) -> SequentialFile:
         self._op("open_s")
-        return _FISequential(self, self.base.new_sequential_file(path))
+        return _FISequential(self, self.base.new_sequential_file(path), path)
 
     def file_exists(self, path: str) -> bool:
         return self.base.file_exists(path)
@@ -122,13 +211,15 @@ class _FIWritable(WritableFile):
 
 
 class _FIRandom(RandomAccessFile):
-    def __init__(self, env, base):
+    def __init__(self, env, base, path: str = ""):
         self._env = env
         self._base = base
+        self._path = path
 
     def read(self, offset, n):
         self._env._op("read")
-        return self._base.read(offset, n)
+        data = self._base.read(offset, n)
+        return self._env._maybe_corrupt(self._path, offset, data)
 
     def size(self):
         return self._base.size()
@@ -138,13 +229,18 @@ class _FIRandom(RandomAccessFile):
 
 
 class _FISequential(SequentialFile):
-    def __init__(self, env, base):
+    def __init__(self, env, base, path: str = ""):
         self._env = env
         self._base = base
+        self._path = path
+        self._off = 0  # running offset: deterministic corruption keying
 
     def read(self, n):
         self._env._op("read")
-        return self._base.read(n)
+        data = self._base.read(n)
+        off = self._off
+        self._off += len(data)
+        return self._env._maybe_corrupt(self._path, off, data)
 
     def close(self):
         self._base.close()

@@ -194,6 +194,36 @@ def lib() -> ctypes.CDLL | None:
         except AttributeError:
             pass
         try:
+            # Fused verify+insert for protected batches: re-hash every
+            # record against the carried vector, insert only if ALL match.
+            _u64p = ctypes.POINTER(ctypes.c_uint64)
+            l.tpulsm_skiplist_insert_wb_prot.restype = ctypes.c_int64
+            l.tpulsm_skiplist_insert_wb_prot.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_uint64, _u64p, ctypes.c_int64, ctypes.c_int32, i64p,
+            ]
+        except AttributeError:
+            pass
+        try:
+            # Per-entry protection over a WriteBatch wire image: one call
+            # computes every counted record's checksum (utils/protection
+            # bit-compatible) — the protected write path's hot loop.
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            l.tpulsm_wb_protect.restype = ctypes.c_int64
+            l.tpulsm_wb_protect.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, u64p, ctypes.c_int64,
+            ]
+            # XOR-aggregate protection over a columnar export (flush's
+            # memtable->SST handoff check without per-entry Python).
+            l.tpulsm_columnar_protect.restype = ctypes.c_int64
+            l.tpulsm_columnar_protect.argtypes = [
+                u8p, i32p, i32p, u8p, i32p, i32p, i32p,
+                ctypes.c_int64, ctypes.c_int32, u64p,
+            ]
+        except AttributeError:
+            pass
+        try:
             # Host k-way merge of presorted runs (separate block: a stale
             # .so missing THIS symbol must not void older registrations).
             l.tpulsm_merge_runs.restype = ctypes.c_int32
@@ -284,6 +314,11 @@ def lib() -> ctypes.CDLL | None:
             l.tpulsm_trie_insert_wb.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
                 ctypes.c_uint64, i64p,
+            ]
+            l.tpulsm_trie_insert_wb_prot.restype = ctypes.c_int64
+            l.tpulsm_trie_insert_wb_prot.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_uint64, u64p, ctypes.c_int64, ctypes.c_int32, i64p,
             ]
             l.tpulsm_trie_export.restype = ctypes.c_int64
             l.tpulsm_trie_export.argtypes = [

@@ -776,6 +776,15 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
     max_entry = int(kv.key_lens.max() if kv.n else 0) + int(
         kv.val_lens.max() if kv.n else 0
     )
+    if not start_exhausted:
+        # Streamed (pipelined) callers hand over PREALLOCATED kv buffers
+        # that reader threads are still filling: the length arrays may
+        # hold uninitialized garbage here, so any size derived from them
+        # is only a capacity GUESS. Clamp it to a sane window — the
+        # rc==-2 grow-and-retry loops below make small guesses correct,
+        # and a negative/absurd garbage max must never turn into a
+        # negative np.empty (a heap-state-dependent crash).
+        max_entry = min(max(max_entry, 0), 4 << 20)
     out_cap = options.block_size * 2 + max_entry + 8192
     out_buf = np.empty(out_cap, dtype=np.uint8)
     out_len = np.zeros(1, dtype=np.int64)
@@ -830,7 +839,11 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
         use_section = False
     if use_section and kv.n:
         # Upper bound over ALL entries (the survivor set streams in).
-        sec_bytes = int(kv.key_lens.sum()) + int(kv.val_lens.sum())
+        # max(0, ·): under streamed callers the length arrays can still
+        # hold uninitialized garbage (see the max_entry clamp above);
+        # the sec rc==-2 grow loop recovers from an undersized guess.
+        sec_bytes = max(
+            0, int(kv.key_lens.sum()) + int(kv.val_lens.sum()))
         # Each native call emits at most ~_SECTION_RUN_BYTES (stopping a run
         # early is free: the next call continues the same file), so the
         # section buffer and the per-call copy stay bounded no matter how

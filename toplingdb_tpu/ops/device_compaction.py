@@ -539,18 +539,47 @@ def _resolve_complex_stream(kv, order, cx_flags, trailer_override, seqs,
     return order[keep_mask]
 
 
-def _outputs_from_files(env, files, kv, vtypes, stats):
+def _verify_columnar_output(env, icmp, table_options, path, kv, vtypes,
+                            sel) -> None:
+    """Protection check for ONE columnar-plane output file: the entries
+    on disk must be exactly the surviving input rows `sel` (post
+    merge-resolution value patching, seq zeroing exempt) — the
+    per-entry-checksum form of paranoid_file_checks, shared by the serial
+    columnar, sharded-device, and pipelined paths."""
+    from toplingdb_tpu.compaction.compaction_job import verify_output_table
+    from toplingdb_tpu.utils import protection as _p
+
+    pb = table_options.protection_bytes_per_key
+    expected: dict[int, int] = {}
+    for r in sel.tolist():
+        ik = kv.ikey(r)
+        cs = _p.truncate(
+            _p.protect_entry(int(vtypes[r]), ik[:-8], kv.value(r)), pb)
+        expected[cs] = expected.get(cs, 0) + 1
+    verify_output_table(env, path, icmp, table_options, expected, len(sel))
+
+
+def _outputs_from_files(env, files, kv, vtypes, stats, icmp=None,
+                        table_options=None):
     """Output FileMetaData list from write_tables_columnar tuples: empty
     outputs deleted, blob refs decoded from surviving BLOB_INDEX rows —
-    shared by the serial columnar and pipelined paths."""
+    shared by the serial columnar and pipelined paths. With icmp +
+    table_options given and protection active, every output is re-read
+    and verified against its surviving input rows before it is returned
+    (_verify_columnar_output)."""
     from toplingdb_tpu.db.blob import decode_blob_index
     from toplingdb_tpu.db.version_edit import FileMetaData
 
+    pb = (getattr(table_options, "protection_bytes_per_key", 0)
+          if table_options is not None else 0)
     outputs = []
     for fnum, path, props, smallest, largest, sel in files:
         if props.num_entries == 0 and props.num_range_deletions == 0:
             env.delete_file(path)
             continue
+        if pb:
+            _verify_columnar_output(env, icmp, table_options, path, kv,
+                                    vtypes, sel)
         blob_refs = set()
         bi_mask = vtypes[sel] == dbformat.ValueType.BLOB_INDEX
         if bi_mask.any():
@@ -610,7 +639,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         except (pl.PipelineIneligible, NotSupported):
             pass  # serial path decides (and re-raises what it must)
         else:
-            outputs = _outputs_from_files(env, pfiles, pkv, pvt, pstats)
+            outputs = _outputs_from_files(env, pfiles, pkv, pvt, pstats,
+                                          icmp=icmp,
+                                          table_options=table_options)
             pstats.work_time_usec = int((time.time() - t0) * 1e6)
             return outputs, pstats
     try:
@@ -669,11 +700,15 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                     creation_time, tombs, column_family,
                 )
                 outputs = []
+                pb_ = getattr(table_options, "protection_bytes_per_key", 0)
                 for fnum, path, props, smallest, largest, _sel in files:
                     if (props.num_entries == 0
                             and props.num_range_deletions == 0):
                         env.delete_file(path)
                         continue
+                    if pb_:
+                        _verify_columnar_output(env, icmp, table_options,
+                                                path, kv, col.vtype, _sel)
                     meta = FileMetaData(
                         number=fnum, file_size=env.get_file_size(path),
                         smallest=smallest, largest=largest,
@@ -838,7 +873,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             # Native builder refused (oversized key / restart overflow):
             # the per-entry path handles these (partials already cleaned).
             raise _FallbackToEntries()
-        outputs = _outputs_from_files(env, files, kv, vtypes, stats)
+        outputs = _outputs_from_files(env, files, kv, vtypes, stats,
+                                      icmp=icmp,
+                                      table_options=table_options)
     stats.encode_write_usec = int((time.time() - t_wr) * 1e6)
     stats.work_time_usec = int((time.time() - t0) * 1e6)
     return outputs, stats

@@ -175,6 +175,26 @@ class Options:
     # JSON-configurable under the "dcompact" key (utils/config.py).
     dcompact: Any = None  # DcompactOptions; None = defaults, lazily built
 
+    # -- integrity plane (utils/protection.py, utils/file_checksum.py,
+    # db/integrity.py) ---------------------------------------------------
+    # Per-KV protection info (reference protection_bytes_per_key,
+    # include/rocksdb/options.h + db/kv_checksum.h): 8/4/2/1-byte per-entry
+    # checksums computed in WriteBatch, carried through the memtable, and
+    # verified at every handoff (memtable insert, flush emission,
+    # compaction output emission in the serial AND columnar/pipelined
+    # planes, scan-plane chunk emission). 0 = off.
+    protection_bytes_per_key: int = 0
+    # Whole-file checksum function recorded per SST in the MANIFEST
+    # (reference file_checksum_gen_factory): 'crc32c' (default) or
+    # 'xxh64'; None/'off' disables. Verified by DB.verify_file_checksums,
+    # checkpoint/backup/import/follower-bootstrap, and the scrubber.
+    file_checksum: Optional[str] = "crc32c"
+    # Background IntegrityScrubber cadence: re-read live SSTs from disk
+    # and compare against MANIFEST checksums every N seconds (0 = manual
+    # db.scrub() only), paced at integrity_scrub_bytes_per_sec.
+    integrity_scrub_period_sec: int = 0
+    integrity_scrub_bytes_per_sec: int = 32 * 1024 * 1024
+
     # -- observability --------------------------------------------------
     statistics: Any = None
     listeners: list = field(default_factory=list)

@@ -97,6 +97,10 @@ class TableOptions:
     # User TablePropertiesCollectorFactory list (reference
     # table_properties_collector_factories); a fresh collector per SST.
     properties_collector_factories: list = field(default_factory=list)
+    # Per-entry protection info (Options.protection_bytes_per_key,
+    # propagated here at DB.open so the flush/compaction/scan data planes
+    # see it without signature plumbing). 0 = off.
+    protection_bytes_per_key: int = 0
 
 
 class TableBuilder:

@@ -7,7 +7,8 @@ Commands:
   batchput K1 V1 K2 V2 ... | deleterange BEGIN END
   manifest_dump | wal_dump WALFILE | list_files | checkpoint DEST
   repair | ingest_extern_sst FILE | approxsize --from=K --to=K
-  verify_checksum | list_column_families | compact [--from --to]
+  verify_checksum | verify_file_checksums | scrub [--report] [--deep]
+  list_column_families | compact [--from --to]
   idump [--limit] | backup BACKUP_DIR | restore BACKUP_DIR ID (into --db)
 """
 
@@ -29,6 +30,10 @@ def main(argv=None) -> int:
     ap.add_argument("--from", dest="from_key", default=None)
     ap.add_argument("--to", dest="to_key", default=None)
     ap.add_argument("--limit", type=int, default=0)
+    ap.add_argument("--report", action="store_true",
+                    help="scrub: print the full JSON pass report")
+    ap.add_argument("--deep", action="store_true",
+                    help="scrub: also re-verify every block + blob record")
     args = ap.parse_args(argv)
 
     def enc(s: str) -> bytes:
@@ -134,6 +139,26 @@ def main(argv=None) -> int:
         elif cmd == "verify_checksum":
             db.verify_checksum()
             print("OK")
+        elif cmd == "verify_file_checksums":
+            # Whole-file checksums vs the MANIFEST (DB.verify_file_checksums)
+            res = db.verify_file_checksums()
+            print(f"OK: {res['files_verified']} files "
+                  f"({res['bytes_verified']} bytes) verified, "
+                  f"{res['files_skipped']} without a recorded checksum")
+        elif cmd == "scrub":
+            # One synchronous IntegrityScrubber pass (db/integrity.py).
+            import json as _json
+
+            rep = db.scrub(deep=args.deep)
+            if args.report:
+                print(_json.dumps(rep, indent=1, default=str))
+            else:
+                print(f"scrubbed {rep['files_scanned']} files "
+                      f"({rep['bytes_verified']} bytes): "
+                      f"{len(rep['corruptions'])} corruptions, "
+                      f"quarantined {rep['quarantined']}")
+            if rep["corruptions"]:
+                return 1
         elif cmd == "list_column_families":
             for h in db.list_column_families():
                 print(h.name)

@@ -2,7 +2,8 @@
 
 Usage:
   python -m toplingdb_tpu.tools.sst_dump --file=X.sst \
-      [--command=scan|raw|verify|props] [--limit=N]
+      [--command=scan|raw|verify|props] [--limit=N] \
+      [--verify-file-checksum]
 """
 
 from __future__ import annotations
@@ -22,15 +23,58 @@ _TYPE_NAMES = {
 }
 
 
+def _verify_file_checksum(env, path: str) -> int:
+    """--verify-file-checksum: find the file's recorded digest in the
+    containing DB directory's MANIFEST (utils/file_checksum offline
+    lookup) and recompute it; falls back to printing a fresh crc32c when
+    no MANIFEST records one (standalone/exported files)."""
+    import os
+
+    from toplingdb_tpu.db.filename import parse_file_name
+    from toplingdb_tpu.utils.file_checksum import (
+        FileChecksumGenFactory,
+        compute_file_checksum,
+        manifest_file_checksums,
+    )
+
+    dbdir = os.path.dirname(os.path.abspath(path)) or "."
+    _, num = parse_file_name(os.path.basename(path))
+    recorded = None
+    try:
+        recorded = manifest_file_checksums(dbdir, env).get(num)
+    except Exception:
+        pass  # no CURRENT/MANIFEST next to the file: standalone mode
+    func = recorded[0] if recorded else "crc32c"
+    gen = FileChecksumGenFactory(func or "crc32c").create()
+    actual = compute_file_checksum(env, path, gen)
+    if recorded is None:
+        print(f"no recorded checksum for {path}; computed "
+              f"{func}:{actual.hex()}")
+        return 0
+    if actual == recorded[1]:
+        print(f"OK: {path} {func}:{actual.hex()} matches MANIFEST")
+        return 0
+    print(f"MISMATCH: {path} MANIFEST records {func}:{recorded[1].hex()}, "
+          f"disk has {actual.hex()}")
+    return 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--file", required=True)
     ap.add_argument("--command", default="scan",
                     choices=["scan", "raw", "verify", "props"])
     ap.add_argument("--limit", type=int, default=0)
+    ap.add_argument("--verify-file-checksum", action="store_true",
+                    dest="verify_file_checksum",
+                    help="recompute the whole-file checksum and compare "
+                         "with the one recorded in the containing DB "
+                         "directory's MANIFEST")
     args = ap.parse_args(argv)
 
     env = default_env()
+    if args.verify_file_checksum:
+        return _verify_file_checksum(env, args.file)
     r = open_table(env.new_random_access_file(args.file), InternalKeyComparator())
     p = r.properties
     if args.command == "props":

@@ -67,9 +67,18 @@ def _checkpoint_locked(db, env, dest: str) -> None:
                     pass
             env.write_file(dst, env.read_file(src), sync=True)
 
+        from toplingdb_tpu.utils.file_checksum import (
+            verify_recorded_checksum,
+        )
+
         for _, f in files:
             link_or_copy(filename.table_file_name(db.dbname, f.number),
                          filename.table_file_name(dest, f.number))
+            # A checkpoint must not propagate corruption: the copy is
+            # re-read and compared against the MANIFEST-recorded checksum
+            # (no-op for pre-upgrade files without one).
+            verify_recorded_checksum(
+                db.env, filename.table_file_name(dest, f.number), f)
         # Blob files too: all present ones (deletions are excluded for the
         # duration, so every LIVE blob is here; extra not-yet-GC'd ones are
         # harmless dead weight in the snapshot).
@@ -183,4 +192,16 @@ class Checkpoint:
             env.write_file(f"{dest}/{child}", data, sync=True)
         env.write_file(f"{dest}/CURRENT",
                        env.read_file(f"{self.path}/CURRENT"), sync=True)
+        # Deep integrity check on the restored copy (the replication
+        # follower's bootstrap path rides through here): every
+        # MANIFEST-recorded SST checksum is recomputed on the copy, so a
+        # truncated/bit-rotted restore fails HERE, not hours later.
+        try:
+            from toplingdb_tpu.utils.file_checksum import (
+                verify_dir_file_checksums,
+            )
+
+            verify_dir_file_checksums(dest, env)
+        except ImportError:  # pragma: no cover
+            pass
         return dest

@@ -138,6 +138,8 @@ _SIMPLE_OPTION_KEYS = {
     "unordered_write", "preclude_last_level_data_seconds",
     "compression", "bottommost_compression", "bottommost_format",
     "recycle_log_file_num", "wal_ttl_seconds",
+    "protection_bytes_per_key", "file_checksum",
+    "integrity_scrub_period_sec", "integrity_scrub_bytes_per_sec",
 }
 
 # MergeOperator.name() → registry key, for options_to_config round-trips.
@@ -355,11 +357,14 @@ class SidePluginRepo:
     def start_http(self, port: int = 0) -> int:
         """Serves /dbs, /stats/<name>, /levels/<name>, /config/<name>,
         /replication/<name> (role/lag/applied-seq of the replication
-        plane), and /metrics (Prometheus text format over every registered
-        DB's Statistics — the rockside Prometheus role). POST
-        /promote/<name> promotes a registered FollowerDB to a read-write
-        primary in place (tools/repl_admin.py drives it). Returns the
-        bound port."""
+        plane), /integrity/<name> (scrub progress, quarantined files,
+        mismatch counters — the integrity plane's view), and /metrics
+        (Prometheus text format over every registered DB's Statistics —
+        the rockside Prometheus role). POST /promote/<name> promotes a
+        registered FollowerDB to a read-write primary in place
+        (tools/repl_admin.py drives it); POST /scrub/<name> runs one
+        integrity-scrub pass and returns its report. Returns the bound
+        port."""
         repo = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -434,6 +439,16 @@ class SidePluginRepo:
                     elif parts and parts[0] == "promote":
                         name = "/".join(parts[1:])
                         code, body = repo._promote(name)
+                    elif parts and parts[0] == "scrub":
+                        # Trigger one synchronous integrity-scrub pass:
+                        # POST /scrub/<name> [{"deep": true}]
+                        db = repo._dbs.get("/".join(parts[1:]))
+                        if db is None:
+                            code, body = 404, {"error": "no such db"}
+                        else:
+                            rep = db.scrub(
+                                deep=bool(payload.get("deep", False)))
+                            code, body = 200, {"ok": True, "report": rep}
                     else:
                         code, body = 404, {"error": "not found"}
                 except (InvalidArgument, ValueError) as e:  # client's fault
@@ -538,6 +553,26 @@ class SidePluginRepo:
                              else "primary-unshipped"),
                 }
             out.setdefault("last_sequence", db.versions.last_sequence)
+            return out
+        if kind == "integrity":
+            # Scrub progress + quarantine + mismatch counters (mirrors the
+            # /replication view pattern; POST /scrub/<name> runs a pass).
+            out = dict(db.scrub_status())
+            out["protection_bytes_per_key"] = getattr(
+                db.options, "protection_bytes_per_key", 0)
+            out["file_checksum"] = getattr(db.options, "file_checksum",
+                                           None)
+            if db.stats is not None:
+                from toplingdb_tpu.utils import statistics as _st
+
+                t = db.stats.tickers()
+                out["tickers"] = {
+                    k: t.get(k, 0)
+                    for k in (_st.INTEGRITY_SCRUB_PASSES,
+                              _st.INTEGRITY_BYTES_VERIFIED,
+                              _st.INTEGRITY_CORRUPTIONS_DETECTED,
+                              _st.INTEGRITY_PROTECTION_MISMATCHES)
+                }
             return out
         return None
 

@@ -1,0 +1,225 @@
+"""Whole-file checksums (the integrity plane's at-rest half).
+
+Role of the reference's FileChecksumGenFactory / FileChecksumGenCrc32c
+(include/rocksdb/file_checksum.h, util/file_checksum_helper.cc in
+/root/reference): every SST gets a whole-file checksum computed when the
+file is produced (flush, compaction, ingest, import, repair), recorded in
+its FileMetaData and persisted through the MANIFEST, then re-verified by
+`DB.verify_file_checksums()`, checkpoint/backup creation, CF import, the
+replication follower's checkpoint bootstrap, and the background
+IntegrityScrubber (db/integrity.py).
+
+Two generators ship: `crc32c` (streaming crc32c.extend over the file) and
+`xxh64` (per-chunk xxh64 chained through the seed — an xxh-style
+combinator). Factories are name-keyed so the MANIFEST records WHICH
+function produced each digest and verification always replays the same
+one.
+"""
+
+from __future__ import annotations
+
+from toplingdb_tpu.utils import crc32c
+from toplingdb_tpu.utils.status import Corruption, InvalidArgument
+
+DEFAULT_CHECKSUM_NAME = "crc32c"
+_CHUNK = 1 << 20
+
+
+class FileChecksumGenerator:
+    """Streaming digest over a file's bytes (reference
+    FileChecksumGenerator): update() with consecutive chunks, then
+    finalize() -> digest bytes."""
+
+    name = "base"
+
+    def update(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def finalize(self) -> bytes:
+        raise NotImplementedError
+
+
+class Crc32cFileChecksumGen(FileChecksumGenerator):
+    name = "crc32c"
+
+    def __init__(self):
+        self._crc = 0
+
+    def update(self, data: bytes) -> None:
+        self._crc = crc32c.extend(self._crc, data)
+
+    def finalize(self) -> bytes:
+        return self._crc.to_bytes(4, "little")
+
+
+class Xxh64FileChecksumGen(FileChecksumGenerator):
+    """xxh64 combinator: chunk digests chain through the seed, so the
+    result is order- and framing-sensitive without buffering the file."""
+
+    name = "xxh64"
+
+    def __init__(self):
+        self._h = 0
+        self._len = 0
+
+    def update(self, data: bytes) -> None:
+        self._h = crc32c.xxh64(bytes(data), seed=self._h)
+        self._len += len(data)
+
+    def finalize(self) -> bytes:
+        # Fold the total length so ab|c and a|bc differ even when chunk
+        # digests collide.
+        return crc32c.xxh64(self._len.to_bytes(8, "little"),
+                            seed=self._h).to_bytes(8, "little")
+
+
+class FileChecksumGenFactory:
+    """Name -> generator registry (reference FileChecksumGenFactory)."""
+
+    _GENS = {
+        "crc32c": Crc32cFileChecksumGen,
+        "xxh64": Xxh64FileChecksumGen,
+    }
+
+    def __init__(self, default: str = DEFAULT_CHECKSUM_NAME):
+        if default not in self._GENS:
+            raise InvalidArgument(
+                f"unknown file checksum function {default!r}; "
+                f"known: {sorted(self._GENS)}"
+            )
+        self.default_name = default
+
+    def create(self, name: str | None = None) -> FileChecksumGenerator:
+        name = name or self.default_name
+        cls = self._GENS.get(name)
+        if cls is None:
+            raise InvalidArgument(
+                f"unknown file checksum function {name!r}; "
+                f"known: {sorted(self._GENS)}"
+            )
+        return cls()
+
+    def names(self) -> list[str]:
+        return sorted(self._GENS)
+
+
+def factory_for(options) -> FileChecksumGenFactory | None:
+    """The effective factory for an Options: `file_checksum` names the
+    default generator; None/''/'off' disables whole-file checksums."""
+    name = getattr(options, "file_checksum", DEFAULT_CHECKSUM_NAME)
+    if not name or name == "off":
+        return None
+    return FileChecksumGenFactory(name)
+
+
+def compute_file_checksum(env, path: str, gen: FileChecksumGenerator,
+                          pacer=None) -> bytes:
+    """Digest the whole file through the Env in chunks. `pacer`, when
+    given, is called with each chunk's size (the scrubber's rate
+    limiter)."""
+    f = env.new_random_access_file(path)
+    try:
+        size = f.size()
+        off = 0
+        while off < size:
+            data = f.read(off, min(_CHUNK, size - off))
+            if not data:
+                raise Corruption(f"{path}: short read at {off}/{size}")
+            gen.update(data)
+            off += len(data)
+            if pacer is not None:
+                pacer(len(data))
+    finally:
+        f.close()
+    return gen.finalize()
+
+
+def stamp_file_checksum(env, path: str, meta, factory) -> None:
+    """Compute + record the file checksum on one FileMetaData (no-op when
+    disabled or already stamped)."""
+    if factory is None or meta.file_checksum:
+        return
+    gen = factory.create()
+    meta.file_checksum = compute_file_checksum(env, path, gen)
+    meta.file_checksum_func_name = gen.name
+
+
+def verify_recorded_checksum(env, path: str, meta, pacer=None) -> int:
+    """Recompute and compare one file's recorded checksum; returns bytes
+    verified (0 when the meta carries none). Raises Corruption on
+    mismatch."""
+    if not meta.file_checksum:
+        return 0
+    gen = FileChecksumGenFactory(meta.file_checksum_func_name
+                                 or DEFAULT_CHECKSUM_NAME).create()
+    actual = compute_file_checksum(env, path, gen, pacer=pacer)
+    if actual != meta.file_checksum:
+        raise Corruption(
+            f"file checksum mismatch on {path}: recorded "
+            f"{meta.file_checksum.hex()} ({meta.file_checksum_func_name}), "
+            f"recomputed {actual.hex()}"
+        )
+    return env.get_file_size(path)
+
+
+def manifest_file_checksums(dbdir: str, env=None) -> dict[int, tuple[str, bytes]]:
+    """file_number -> (func_name, digest) from a DB/checkpoint directory's
+    CURRENT+MANIFEST, without opening a DB — the offline half used by
+    Checkpoint.restore_to, backup verification, and tools/sst_dump."""
+    from toplingdb_tpu.db import filename
+    from toplingdb_tpu.db.log import LogReader
+    from toplingdb_tpu.db.version_edit import VersionEdit
+
+    if env is None:
+        from toplingdb_tpu.env import default_env
+
+        env = default_env()
+    cur = env.read_file(filename.current_file_name(dbdir)).decode().strip()
+    path = f"{dbdir}/{cur}"
+    out: dict[int, tuple[str, bytes]] = {}
+    live: set[int] = set()
+    for rec in LogReader(env.new_sequential_file(path)).records():
+        e = VersionEdit.decode(rec)
+        for _lvl, num in e.deleted_files:
+            live.discard(num)
+        for _lvl, meta in e.new_files:
+            live.add(meta.number)
+            if meta.file_checksum:
+                out[meta.number] = (meta.file_checksum_func_name,
+                                    meta.file_checksum)
+    return {n: v for n, v in out.items() if n in live}
+
+
+def verify_dir_file_checksums(dbdir: str, env=None) -> dict:
+    """Verify every MANIFEST-recorded SST checksum in a directory (the
+    checkpoint-restore / follower-bootstrap / ldb offline check). Returns
+    {'files_verified': n, 'bytes_verified': n, 'files_skipped': n}."""
+    from toplingdb_tpu.db import filename
+
+    if env is None:
+        from toplingdb_tpu.env import default_env
+
+        env = default_env()
+    recorded = manifest_file_checksums(dbdir, env)
+    verified = bytes_v = skipped = 0
+    for num, (fname, digest) in sorted(recorded.items()):
+        path = filename.table_file_name(dbdir, num)
+        if not env.file_exists(path):
+            raise Corruption(f"{dbdir}: MANIFEST references missing {path}")
+        gen = FileChecksumGenFactory(fname or DEFAULT_CHECKSUM_NAME).create()
+        actual = compute_file_checksum(env, path, gen)
+        if actual != digest:
+            raise Corruption(
+                f"file checksum mismatch on {path}: recorded "
+                f"{digest.hex()} ({fname}), recomputed {actual.hex()}"
+            )
+        verified += 1
+        bytes_v += env.get_file_size(path)
+    # Live SSTs without a recorded checksum (pre-upgrade files) are
+    # counted so callers can see partial coverage.
+    for child in env.get_children(dbdir):
+        t, num = filename.parse_file_name(child)
+        if t == filename.FileType.TABLE and num not in recorded:
+            skipped += 1
+    return {"files_verified": verified, "bytes_verified": bytes_v,
+            "files_skipped": skipped}

@@ -70,6 +70,20 @@ class WorkerHealthInfo:
     consecutive_failures: int
 
 
+@dataclass
+class CorruptionInfo:
+    """The IntegrityScrubber (db/integrity.py) found a live file whose
+    on-disk bytes no longer match the MANIFEST-recorded checksum; the
+    file has been quarantined."""
+
+    db_name: str
+    file_number: int
+    path: str
+    reason: str
+    recorded_checksum: str = ""      # hex
+    checksum_func_name: str = ""
+
+
 class EventListener:
     """Override any subset (reference EventListener)."""
 
@@ -95,6 +109,9 @@ class EventListener:
         pass
 
     def on_worker_health_changed(self, db, info: WorkerHealthInfo) -> None:
+        pass
+
+    def on_corruption_detected(self, db, info: CorruptionInfo) -> None:
         pass
 
 

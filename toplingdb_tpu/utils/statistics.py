@@ -139,6 +139,11 @@ BLOB_DB_GC_NUM_FILES = "blob.db.gc.num.files"
 SECONDARY_CACHE_HITS = "secondary.cache.hits"
 PERSISTENT_CACHE_HIT = "persistent.cache.hit"
 PERSISTENT_CACHE_MISS = "persistent.cache.miss"
+# -- integrity plane (db/integrity.py, utils/protection.py) ----------
+INTEGRITY_SCRUB_PASSES = "integrity.scrub.passes"
+INTEGRITY_BYTES_VERIFIED = "integrity.bytes.verified"
+INTEGRITY_CORRUPTIONS_DETECTED = "integrity.corruptions.detected"
+INTEGRITY_PROTECTION_MISMATCHES = "integrity.protection.mismatches"
 
 # Histogram names (reference Histograms enum families).
 DB_GET_MICROS = "db.get.micros"
@@ -163,6 +168,7 @@ WAL_FILE_SYNC_MICROS = "wal.file.sync.micros"
 MANIFEST_FILE_SYNC_MICROS = "manifest.file.sync.micros"
 WRITE_STALL_MICROS_HIST = "write.stall.micros"
 REPLICATION_LAG_MICROS = "replication.lag.micros"  # ship→apply wall lag
+SCRUB_LATENCY_MICROS = "scrub.latency.micros"      # one scrubber pass
 NUM_FILES_IN_SINGLE_COMPACTION = "numfiles.in.singlecompaction"
 BYTES_PER_READ = "bytes.per.read"
 BYTES_PER_WRITE = "bytes.per.write"
