@@ -355,6 +355,114 @@ def integrity_rows(detail, n_db):
     shutil.rmtree(scrub_dir, ignore_errors=True)
 
 
+def write_plane_rows(detail, n_db):
+    """Native group-commit write plane rows (ISSUE 7): protected WAL-on
+    write-PATH fillrandom (prebuilt mixed-size batches so the row
+    isolates queue + WAL + protection + memtable insert) with
+    TPULSM_WRITE_PLANE=1 vs the =0 serial twin; a coalesced-fsync sync
+    row (async WAL writer merging concurrent leaders' fsync barriers)
+    vs inline-fsync; and an 8-writer concurrent run with its twin.
+    Runs are interleaved best-of like integrity_rows: the headline
+    divides two measurements, so drift must not read as speedup."""
+    import threading
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.db.write_batch import WriteBatch
+    from toplingdb_tpu.options import Options, WriteOptions
+
+    n = max(100_000, min(1_000_000, n_db))
+
+    def fill(knob, nt, sync=False, async_wal=False, batch_sizes=(100, 1000),
+             on_disk=False, pipelined=False):
+        saved = os.environ.get("TPULSM_WRITE_PLANE")
+        os.environ["TPULSM_WRITE_PLANE"] = knob
+        try:
+            per = n // nt
+            allb = []
+            for t in range(nt):
+                bs, i, si = [], 0, 0
+                while i < per:
+                    bsz = min(batch_sizes[si % len(batch_sizes)], per - i)
+                    si += 1
+                    b = WriteBatch(protection_bytes_per_key=8)
+                    for j in range(i, i + bsz):
+                        k = ((t * per + j) * 2654435761) % (n * 2)
+                        b.put(b"%016d" % k, b"v" * 20)
+                    bs.append(b)
+                    i += bsz
+                allb.append(bs)
+            # Sync rows run on REAL disk (fsync on tmpfs is a no-op, which
+            # would measure nothing); throughput rows stay on /dev/shm.
+            d = tempfile.mkdtemp(prefix="benchwp_", dir=None if on_disk else (
+                "/dev/shm" if os.path.isdir("/dev/shm") else None))
+            db = DB.open(d, Options(create_if_missing=True,
+                                    write_buffer_size=1 << 30,
+                                    protection_bytes_per_key=8,
+                                    enable_async_wal=async_wal,
+                                    enable_pipelined_write=pipelined))
+            wo = WriteOptions(sync=sync)
+            errs = []
+
+            def w(bs):
+                try:
+                    for b in bs:
+                        db.write(b, wo)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=w, args=(bs,)) for bs in allb]
+            t0 = time.time()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.time() - t0
+            assert not errs, errs
+            db.close()
+            shutil.rmtree(d, ignore_errors=True)
+            return (nt * per) / dt
+        finally:
+            if saved is None:
+                os.environ.pop("TPULSM_WRITE_PLANE", None)
+            else:
+                os.environ["TPULSM_WRITE_PLANE"] = saved
+
+    rows = {
+        "fillrandom_native_plane_ops_s": lambda: fill("1", 4),
+        "fillrandom_plane_off_ops_s": lambda: fill("0", 4),
+        "fillrandom_8w_ops_s": lambda: fill("1", 8),
+        "fillrandom_8w_plane_off_ops_s": lambda: fill("0", 8),
+    }
+    best = {k: 0.0 for k in rows}
+    for _ in range(3):
+        for k, f in rows.items():
+            best[k] = max(best[k], f())
+    for k, v in best.items():
+        detail[k] = round(v)
+
+    # Sync rows at reduced scale (each group pays durability): coalesced
+    # fsyncs through the async WAL writer vs inline per-group fsync.
+    saved_n = n
+    n = max(2_000, saved_n // 50)  # fill() closes over n
+    # Pipelined: the durability barrier waits OUTSIDE the commit mutex, so
+    # concurrent leaders' sync tokens overlap in the ring and coalesce.
+    sync_rows = {
+        "fillrandom_sync_ops_s": lambda: fill(
+            "1", 4, sync=True, async_wal=True, on_disk=True,
+            pipelined=True),
+        "fillrandom_sync_inline_ops_s": lambda: fill(
+            "1", 4, sync=True, async_wal=False, on_disk=True,
+            pipelined=True),
+    }
+    sbest = {k: 0.0 for k in sync_rows}
+    for _ in range(2):
+        for k, f in sync_rows.items():
+            sbest[k] = max(sbest[k], f())
+    for k, v in sbest.items():
+        detail[k] = round(v)
+    n = saved_n
+
+
 def db_path_rows(detail, n_db):
     """Sustained multi-job DB rows: multi-thread fillrandom (plain vs
     unordered+concurrent), readrandom, write amplification."""
@@ -739,6 +847,11 @@ def main():
         db_path_rows(detail, n_db)
 
         try:
+            write_plane_rows(detail, n_db)
+        except Exception as e:  # noqa: BLE001
+            detail["write_plane_rows_error"] = repr(e)[:120]
+
+        try:
             replication_rows(detail)
         except Exception as e:  # noqa: BLE001
             detail["replication_rows_error"] = repr(e)[:120]
@@ -862,6 +975,12 @@ def main():
             # writer (detail.readwhilewriting_replica_ops is the row) and
             # mean ship→apply lag of the tailing follower.
             "replication_lag_ms": detail.get("replication_lag_ms"),
+            # Native group-commit write plane (serial twin is
+            # detail.fillrandom_plane_off_ops_s; sync twin is
+            # detail.fillrandom_sync_inline_ops_s).
+            "fillrandom_native_plane_ops_s": detail.get(
+                "fillrandom_native_plane_ops_s"),
+            "fillrandom_sync_ops_s": detail.get("fillrandom_sync_ops_s"),
         }
 
     line = json.dumps(make_record(detail))

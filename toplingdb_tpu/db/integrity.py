@@ -73,6 +73,7 @@ class IntegrityScrubber:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._in_progress = False
+        self._aio = None  # per-pass AsyncIORing (chunk-read double buffer)
         # Rolling status (the /integrity HTTP view's payload).
         self.passes = 0
         self.last_pass_time: float | None = None
@@ -141,6 +142,14 @@ class IntegrityScrubber:
             "repaired": [],
             "quarantined": [],
         }
+        # Scrub reads submit through the shared Env async-I/O primitive
+        # (the write plane's AsyncIORing facility): the next chunk's
+        # pread overlaps the current chunk's checksum compute. A private
+        # ring, not the WAL's — scrub I/O must not queue behind (or
+        # ahead of) group-commit appends.
+        from toplingdb_tpu.env.env import AsyncIORing
+
+        self._aio = AsyncIORing(capacity=4, name="tpulsm-scrub-io")
         try:
             files, _pin = self._snapshot_files()
             for cf_id, meta in files:
@@ -157,6 +166,8 @@ class IntegrityScrubber:
                 else:
                     self._on_corruption(db, meta, path, err, report)
         finally:
+            self._aio.close()
+            self._aio = None
             micros = int((time.perf_counter() - t0) * 1e6)
             with self._mu:
                 self._in_progress = False
@@ -192,7 +203,8 @@ class IntegrityScrubber:
         try:
             gen = FileChecksumGenFactory(
                 meta.file_checksum_func_name or "crc32c").create()
-            actual = compute_file_checksum(db.env, path, gen, pacer=pacer)
+            actual = compute_file_checksum(db.env, path, gen, pacer=pacer,
+                                           aio_ring=self._aio)
         except Corruption as e:
             return e
         except Exception as e:  # unreadable file == corrupt for our purposes

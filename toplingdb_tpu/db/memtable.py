@@ -635,6 +635,35 @@ class MemTable:
                 self._first_seqno = first_seq
         return count
 
+    def group_handle(self):
+        """(native_rep_handle, kind) for the fused group-commit plane
+        (db.py _native_group_commit; kind 0 = skiplist, 1 = trie), or None
+        when this rep has no native handle (pure-Python reps)."""
+        rep = self._rep
+        kind = getattr(rep, "_nget_mem_kind", None)
+        h = getattr(rep, "_h", None)
+        if kind is None or not h:
+            return None
+        return h, kind
+
+    def note_group_applied(self, entries_meta, mem_delta: int,
+                           deletes: int, total: int) -> None:
+        """Bookkeeping for a whole write group the native plane already
+        applied straight into the rep (tpulsm_wb_group_commit):
+        entries_meta is [(first_seq, rep_bytes, prots_or_None)] per member
+        batch — protected members park in _prot_pending exactly like
+        add_encoded's wire-image deferral, so flush verification sees the
+        same carried checksums either way."""
+        with self._lock:
+            if self._prot is not None:
+                for fs, rep, prots in entries_meta:
+                    self._prot_pending.append((fs, rep, prots))
+            self._num_entries += total
+            self._num_deletes += deletes
+            self._mem_usage += mem_delta
+            if self._first_seqno is None and entries_meta:
+                self._first_seqno = entries_meta[0][0]
+
     def add_batch(self, first_seq: int, ops, prots=None) -> int:
         """Apply a run of parsed ops [(type, key, value_or_None)] with
         consecutive seqnos starting at first_seq (reference

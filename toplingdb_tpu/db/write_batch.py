@@ -98,6 +98,11 @@ class WriteBatch:
         # (ensure_protection at DB.write / insert) — per-record Python
         # hashing would double the write cost.
         self._prot_n = 0
+        # Group-plane eligibility hints (db.py _native_group_commit):
+        # wide-column entities and merge-heavy batches keep the Python
+        # interiors as the oracle (ISSUE 7 fallback matrix).
+        self._has_wide = False
+        self._n_merge = 0
         if data is not None:
             if len(data) < HEADER_SIZE:
                 raise Corruption("write batch header too small")
@@ -144,6 +149,10 @@ class WriteBatch:
 
     def _add_record(self, t: ValueType, cf: int, *slices: bytes) -> None:
         rep = self._rep
+        if t == ValueType.MERGE:
+            self._n_merge += 1
+        elif t == ValueType.WIDE_COLUMN_ENTITY:
+            self._has_wide = True
         if cf == 0:
             rep.append(t)
             if t == ValueType.RANGE_DELETION:
@@ -166,6 +175,8 @@ class WriteBatch:
         self._simple = True
         self._count = 0
         self._prot_n = 0
+        self._has_wide = False
+        self._n_merge = 0
         if self._prot is not None:
             self._prot = []
 
@@ -174,6 +185,8 @@ class WriteBatch:
         self._rep += other._rep[HEADER_SIZE:]
         self._count += other.count()
         self._simple = self._simple and other._simple
+        self._has_wide = self._has_wide or other._has_wide
+        self._n_merge += other._n_merge
         if self._prot is not None:
             if (other._prot is not None and other._pb == self._pb
                     and self._prot_n == self._count - other.count()

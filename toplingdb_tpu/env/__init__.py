@@ -8,6 +8,9 @@ injection and IO counting.
 """
 
 from toplingdb_tpu.env.env import (  # noqa: F401
+    AioToken,
+    AsyncIORing,
+    AsyncWritableFile,
     Env,
     PosixEnv,
     MemEnv,

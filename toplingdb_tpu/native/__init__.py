@@ -224,6 +224,24 @@ def lib() -> ctypes.CDLL | None:
         except AttributeError:
             pass
         try:
+            # Fused group-commit write plane: validate + protect-verify a
+            # whole write group, frame the merged WAL record gather-style,
+            # and apply every record to the memtable rep — one GIL-free
+            # call per group (db.py _native_group_commit).
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            l.tpulsm_wb_group_commit.restype = ctypes.c_int64
+            l.tpulsm_wb_group_commit.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,            # mem, mem_kind
+                ctypes.POINTER(ctypes.c_char_p), i64p,      # reps, lens
+                ctypes.c_int64, ctypes.c_uint64,            # n_batches, seq
+                u64p, ctypes.c_int64, ctypes.c_int32,       # prots, n, pb
+                ctypes.c_int32,                             # mode
+                ctypes.c_int64, ctypes.c_int64,             # blk_off, log_no
+                u8p, ctypes.c_int64, i64p,                  # wal out/cap, out
+            ]
+        except AttributeError:
+            pass
+        try:
             # Host k-way merge of presorted runs (separate block: a stale
             # .so missing THIS symbol must not void older registrations).
             l.tpulsm_merge_runs.restype = ctypes.c_int32

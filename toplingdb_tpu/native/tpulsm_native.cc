@@ -4301,4 +4301,419 @@ int32_t tpulsm_db_get_kinds(void** mem_handles, const int32_t* mem_kinds,
                       val_out, val_cap, val_len, src_out, counters);
 }
 
+// ---------------------------------------------------------------------------
+// Fused group-commit write plane (db/db.py write path). ONE call per write
+// group: pass 0 validates every member batch's wire image (supported record
+// types, per-batch header counts, optional protection re-hash against the
+// carried vectors); then mode bit 0 frames the MERGED WAL record
+// gather-style — the 12-byte re-sequenced header plus each member's body
+// stream straight into log-format fragments, byte-identical to db/log.py
+// LogWriter.add_record, with no merged-batch copy on the Python side — and
+// mode bit 1 applies every counted record to the target memtable rep with
+// consecutive seqnos. A batch this parser cannot take (CF-prefixed records,
+// range deletes, corruption) rejects the WHOLE group with NOTHING framed or
+// inserted, and the caller falls back to the Python interiors.
+// ---------------------------------------------------------------------------
+
+extern "C++" {
+#include <condition_variable>
+namespace {
+
+// Persistent worker pool for the group-apply phase: per-group
+// std::thread spawns cost ~30-50us — more than the insert work of a
+// typical group — so the write plane keeps a small lazily-grown pool
+// alive for the process. One job runs at a time (run_mu): the caller
+// publishes a shared closure, k workers plus the caller execute it, the
+// caller waits for all k. Workers idle on a condvar between groups.
+struct ApplyPool {
+  std::mutex run_mu;  // serializes whole jobs
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  const std::function<void()>* fn = nullptr;
+  uint64_t gen = 0;
+  int want = 0, started = 0, done_count = 0;
+  bool shutdown = false;
+  std::vector<std::thread> ths;
+
+  ~ApplyPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      shutdown = true;
+    }
+    cv_work.notify_all();
+    for (auto& t : ths) t.join();
+  }
+
+  void worker() {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> lk(mu);
+    for (;;) {
+      cv_work.wait(lk, [&] {
+        return shutdown || (gen != seen && started < want);
+      });
+      if (shutdown) return;
+      seen = gen;
+      if (started >= want) continue;
+      started++;
+      const std::function<void()>* f = fn;
+      lk.unlock();
+      (*f)();
+      lk.lock();
+      if (++done_count == want) cv_done.notify_all();
+    }
+  }
+
+  // Runs f on min(k, pool) workers concurrently with the caller.
+  void run(const std::function<void()>& f, int k) {
+    std::lock_guard<std::mutex> job(run_mu);
+    std::unique_lock<std::mutex> lk(mu);
+    while ((int)ths.size() < k) {
+      try {
+        ths.emplace_back([this] { worker(); });
+      } catch (...) {
+        break;  // pid limits: run with what we have
+      }
+    }
+    if ((int)ths.size() < k) k = (int)ths.size();
+    if (k <= 0) {
+      lk.unlock();
+      f();
+      return;
+    }
+    fn = &f;
+    want = k;
+    started = 0;
+    done_count = 0;
+    gen++;
+    cv_work.notify_all();
+    lk.unlock();
+    f();  // caller participates
+    lk.lock();
+    cv_done.wait(lk, [&] { return done_count == want; });
+  }
+};
+
+static ApplyPool& apply_pool() {
+  static ApplyPool p;
+  return p;
+}
+
+struct GcPiece {
+  const uint8_t* p;
+  int64_t n;
+};
+
+// Gather cursor over the virtual concatenation [header | body0 | body1 ...]:
+// copies fragment bytes into the framed output while extending the record
+// CRC, so the merged WAL image is never materialized contiguously.
+struct GcCursor {
+  const GcPiece* pieces;
+  int64_t n;
+  int64_t pi = 0;
+  int64_t off = 0;
+  void copy(uint8_t* dst, int64_t m, uint32_t* crc) {
+    while (m > 0) {
+      int64_t avail = pieces[pi].n - off;
+      if (avail <= 0) {
+        pi++;
+        off = 0;
+        continue;
+      }
+      int64_t take = avail < m ? avail : m;
+      std::memcpy(dst, pieces[pi].p + off, (size_t)take);
+      *crc = tpulsm_crc32c_extend(*crc, dst, (size_t)take);
+      dst += take;
+      off += take;
+      m -= take;
+    }
+  }
+};
+
+// Frame one logical record of total_len bytes (read through cur) into the
+// 32KiB-block log format, starting at block_offset. log_number >= 0 selects
+// the recyclable record types stamped with that number. Byte-identical to
+// LogWriter.add_record / _emit (db/log.py). Returns framed bytes written,
+// or -3 when out_cap is too small.
+static int64_t gc_frame_merged(GcCursor& cur, int64_t total_len,
+                               int64_t block_offset, int64_t log_number,
+                               uint8_t* out, int64_t cap,
+                               int64_t* new_block_offset) {
+  const int64_t kBlock = 32768;
+  const bool recycled = log_number >= 0;
+  const int64_t hdr = recycled ? 11 : 7;
+  int64_t used = 0, left = total_len;
+  bool begin = true;
+  while (true) {
+    int64_t leftover = kBlock - block_offset;
+    if (leftover < hdr) {
+      if (leftover > 0) {
+        if (used + leftover > cap) return -3;
+        std::memset(out + used, 0, (size_t)leftover);
+        used += leftover;
+      }
+      block_offset = 0;
+      leftover = kBlock;
+    }
+    int64_t avail = leftover - hdr;
+    int64_t frag = left < avail ? left : avail;
+    bool end = (left == frag);
+    uint8_t t = begin && end ? 1 : (begin ? 2 : (end ? 4 : 3));
+    if (recycled) t = (uint8_t)(t + 4);
+    if (used + hdr + frag > cap) return -3;
+    uint8_t* h = out + used;
+    uint32_t crc = tpulsm_crc32c_extend(0, &t, 1);
+    if (recycled) {
+      uint32_t ln = (uint32_t)log_number;
+      std::memcpy(h + 7, &ln, 4);
+      crc = tpulsm_crc32c_extend(crc, h + 7, 4);
+    }
+    cur.copy(h + hdr, frag, &crc);
+    uint32_t masked = ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+    std::memcpy(h, &masked, 4);
+    h[4] = (uint8_t)(frag & 0xFF);
+    h[5] = (uint8_t)((frag >> 8) & 0xFF);
+    h[6] = t;
+    used += hdr + frag;
+    block_offset += hdr + frag;
+    left -= frag;
+    begin = false;
+    if (left == 0) break;
+  }
+  *new_block_offset = block_offset;
+  return used;
+}
+
+}  // namespace
+}  // extern "C++"
+
+// mem/mem_kind: target rep (0 = SkipList*, 1 = TrieRep*); may be null when
+//   mode bit 1 is clear.
+// reps/lens/n_batches: member batch wire images, group order.
+// prots/n_prots/pb: concatenated per-record protection vectors in group
+//   order, or null (unprotected).
+// mode: bit 0 (1) = frame WAL, bit 1 (2) = insert into the memtable,
+//   bit 2 (4) = skip the validation pass — ONLY legal when a prior call on
+//   the SAME buffers (the leader's frame call, microseconds earlier under
+//   the commit mutex) already validated them; protection was checked there.
+//   bit 3 (8) = protection FILL: prots is an OUT buffer of capacity
+//   n_prots — the validation pass writes each counted record's truncated
+//   checksum instead of comparing (fusing tpulsm_wb_protect into the WAL
+//   frame walk: the protected write path hashes each record ONCE).
+// block_offset/log_number: the LogWriter's framing state (log_number >= 0
+//   selects the recyclable format stamped with that number; -1 = classic).
+// out[0]=framed bytes, out[1]=new block offset, out[2]=memtable byte delta,
+// out[3]=point-delete count, out[4]=merged (unframed) record length.
+// Returns total counted records, or -2 (unsupported record: Python path),
+// -3 (wal_cap too small), -4 (corrupt image), -5 - i (protection mismatch
+// at group record index i).
+int64_t tpulsm_wb_group_commit(void* mem, int32_t mem_kind,
+                               const void* const* reps, const int64_t* lens,
+                               int64_t n_batches, uint64_t first_seq,
+                               uint64_t* prots, int64_t n_prots,
+                               int32_t pb, int32_t mode, int64_t block_offset,
+                               int64_t log_number, uint8_t* wal_out,
+                               int64_t wal_cap, int64_t* out) {
+  const uint64_t kKey = 0x9E3779B97F4A7C15ull, kVal = 0xC2B2AE3D27D4EB4Full,
+                 kType = 0x165667B19E3779F9ull, kCf = 0x27D4EB2F165667C5ull;
+  const uint64_t mask = prot_trunc_mask(pb);
+  int64_t total = 0;
+  if (mode & 4) {
+    // Caller vouches (see above): counts come from the batch headers.
+    for (int64_t b = 0; b < n_batches; b++) {
+      const uint8_t* rep = (const uint8_t*)reps[b];
+      total += (uint32_t)rep[8] | ((uint32_t)rep[9] << 8) |
+               ((uint32_t)rep[10] << 16) | ((uint32_t)rep[11] << 24);
+    }
+  }
+  // Pass 0: validate every batch — nothing is framed or inserted unless the
+  // WHOLE group parses and (when protected) every record re-hashes clean.
+  for (int64_t b = 0; (mode & 4) == 0 && b < n_batches; b++) {
+    const uint8_t* rep = (const uint8_t*)reps[b];
+    int64_t len = lens[b];
+    if (len < 12) return -4;
+    const uint8_t* end = rep + len;
+    const uint8_t* p = rep + 12;
+    uint32_t hdr_count = (uint32_t)rep[8] | ((uint32_t)rep[9] << 8) |
+                         ((uint32_t)rep[10] << 16) | ((uint32_t)rep[11] << 24);
+    int64_t count = 0;
+    while (p < end) {
+      uint8_t t = *p++;
+      if (t & 0x80) return -2;  // CF-prefixed record: Python path
+      uint32_t klen, vlen = 0;
+      p = get_varint32(p, end, &klen);
+      if (!p || p + klen > end) return -4;
+      const uint8_t* k = p;
+      p += klen;
+      const uint8_t* v = p;
+      if (t == 0x1 || t == 0x2 || t == 0x16) {  // VALUE / MERGE / WIDE
+        p = get_varint32(p, end, &vlen);
+        if (!p || p + vlen > end) return -4;
+        v = p;
+        p += vlen;
+      } else if (t == 0x0 || t == 0x7) {  // (SINGLE_)DELETION: key only
+      } else if (t == 0x3) {              // LOG_DATA: klen was the blob
+        continue;
+      } else {
+        return -2;  // RANGE_DELETION etc.: Python path
+      }
+      if (prots) {
+        int64_t gi = total + count;
+        if (gi >= n_prots) return (mode & 8) ? -3 : -5 - gi;
+        uint64_t cs = prot_mix(kKey ^ (uint64_t)zcrc32(k, klen) ^
+                               ((uint64_t)klen << 32)) ^
+                      prot_mix(kVal ^ (uint64_t)zcrc32(v, vlen) ^
+                               ((uint64_t)vlen << 32)) ^
+                      prot_mix(kType ^ (uint64_t)t) ^ prot_mix(kCf ^ 1ull);
+        if (mode & 8)
+          prots[gi] = cs & mask;
+        else if ((cs & mask) != prots[gi])
+          return -5 - gi;
+      }
+      count++;
+    }
+    if ((uint32_t)count != hdr_count) return -4;
+    total += count;
+  }
+  if ((mode & 4) == 0 && prots && (mode & 8) == 0 && total != n_prots)
+    return -5 - total;
+  int64_t merged_len = 12;
+  for (int64_t b = 0; b < n_batches; b++) merged_len += lens[b] - 12;
+  int64_t wal_len = 0, new_bo = block_offset;
+  if (mode & 1) {
+    uint8_t hdr12[12];
+    for (int i = 0; i < 8; i++) hdr12[i] = (uint8_t)(first_seq >> (8 * i));
+    uint32_t tc = (uint32_t)total;
+    for (int i = 0; i < 4; i++) hdr12[8 + i] = (uint8_t)(tc >> (8 * i));
+    std::vector<GcPiece> pieces;
+    pieces.reserve((size_t)n_batches + 1);
+    pieces.push_back({hdr12, 12});
+    for (int64_t b = 0; b < n_batches; b++)
+      if (lens[b] > 12)
+        pieces.push_back({(const uint8_t*)reps[b] + 12, lens[b] - 12});
+    GcCursor cur{pieces.data(), (int64_t)pieces.size()};
+    wal_len = gc_frame_merged(cur, merged_len, block_offset, log_number,
+                              wal_out, wal_cap, &new_bo);
+    if (wal_len < 0) return wal_len;
+  }
+  int64_t delta = 0, deletes = 0;
+  if (mode & 2) {
+    SkipList* sl = mem_kind == 0 ? static_cast<SkipList*>(mem) : nullptr;
+    TrieRep* tr = mem_kind == 1 ? static_cast<TrieRep*>(mem) : nullptr;
+    if (!sl && !tr) return -2;
+    // Work units: contiguous record ranges with a known start seq — one
+    // per small batch, plus INTRA-batch splits for large batches (a quick
+    // varint walk, ~10x cheaper than the inserts it parallelizes), so
+    // even a single-batch group fans out across the ApplyPool. Both
+    // native reps take concurrent inserts (CAS splice / per-stripe
+    // mutexes) and records are order-independent (distinct seqnos), so
+    // unit order does not matter.
+    struct GcUnit {
+      const uint8_t* p;
+      const uint8_t* end;
+      uint64_t seq;
+    };
+    size_t nt_max = std::min(effective_cpus(), (size_t)8);
+    int64_t S = total / (int64_t)(2 * nt_max);
+    if (S < 256) S = 256;
+    std::vector<GcUnit> units;
+    units.reserve((size_t)(total / S + n_batches + 1));
+    {
+      uint64_t seq = first_seq;
+      for (int64_t b = 0; b < n_batches; b++) {
+        const uint8_t* rep = (const uint8_t*)reps[b];
+        const uint8_t* end = rep + lens[b];
+        uint32_t cnt = (uint32_t)rep[8] | ((uint32_t)rep[9] << 8) |
+                       ((uint32_t)rep[10] << 16) | ((uint32_t)rep[11] << 24);
+        if ((int64_t)cnt <= S) {
+          units.push_back({rep + 12, end, seq});
+          seq += cnt;
+          continue;
+        }
+        const uint8_t* p = rep + 12;
+        const uint8_t* ustart = p;
+        uint64_t useq = seq;
+        int64_t in_unit = 0;
+        while (p < end) {
+          uint8_t t = *p++;
+          uint32_t klen, vlen;
+          p = get_varint32(p, end, &klen);
+          if (!p) break;  // validated earlier; defensive
+          p += klen;
+          if (t == 0x1 || t == 0x2 || t == 0x16) {
+            p = get_varint32(p, end, &vlen);
+            if (!p) break;
+            p += vlen;
+          } else if (t == 0x3) {
+            continue;
+          }
+          in_unit++;
+          seq++;
+          if (in_unit >= S) {
+            units.push_back({ustart, p, useq});
+            ustart = p;
+            useq = seq;
+            in_unit = 0;
+          }
+        }
+        if (p > ustart) units.push_back({ustart, p, useq});
+      }
+    }
+    std::atomic<int64_t> a_delta{0}, a_deletes{0};
+    std::atomic<size_t> next_unit{0};
+    size_t n_units = units.size();
+    auto apply = [&]() {
+      int64_t d = 0, dl = 0;
+      for (;;) {
+        size_t u = next_unit.fetch_add(1, std::memory_order_relaxed);
+        if (u >= n_units) break;
+        const uint8_t* p = units[u].p;
+        const uint8_t* end = units[u].end;
+        uint64_t seq = units[u].seq;
+        while (p < end) {
+          uint8_t t = *p++;
+          uint32_t klen, vlen = 0;
+          p = get_varint32(p, end, &klen);
+          if (!p) break;  // validated earlier; defensive
+          const uint8_t* k = p;
+          p += klen;
+          const uint8_t* v = p;
+          if (t == 0x1 || t == 0x2 || t == 0x16) {
+            p = get_varint32(p, end, &vlen);
+            if (!p) break;
+            v = p;
+            p += vlen;
+          } else if (t == 0x3) {
+            continue;
+          }
+          uint64_t inv = ~((seq << 8) | (uint64_t)t);
+          if (sl)
+            sl->insert(k, klen, inv, v, vlen);
+          else
+            trie_insert(tr, k, klen, inv, v, vlen);
+          d += (int64_t)klen + vlen + 24;
+          if (t == 0x0 || t == 0x7) dl++;
+          seq++;
+        }
+      }
+      a_delta.fetch_add(d, std::memory_order_relaxed);
+      a_deletes.fetch_add(dl, std::memory_order_relaxed);
+    };
+    size_t nt = 1;
+    if (n_units > 1 && total >= 512) nt = std::min(nt_max, n_units);
+    if (nt > 1) {
+      apply_pool().run(apply, (int)nt - 1);
+    } else {
+      apply();
+    }
+    delta = a_delta.load();
+    deletes = a_deletes.load();
+  }
+  out[0] = wal_len;
+  out[1] = new_bo;
+  out[2] = delta;
+  out[3] = deletes;
+  out[4] = merged_len;
+  return total;
+}
+
 }  // extern "C"

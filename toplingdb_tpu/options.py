@@ -52,6 +52,17 @@ class Options:
     # a low watermark and GetSnapshot drains pending writes (reference
     # unordered_write, db_impl_write.cc:267-301 WriteImplWALOnly).
     unordered_write: bool = False
+    # Async WAL writer (env/env.py AsyncIORing): WAL appends/fsyncs run on
+    # a dedicated writer thread behind a bounded submit ring, the leader
+    # waits on its durability barrier AFTER the memtable phase (outside
+    # the commit critical section), and concurrent leaders' sync=True
+    # barriers coalesce into shared fsyncs. A write is still acknowledged
+    # only after its barrier settles; ordering relaxation: a barrier
+    # FAILURE after the memtable insert latches a HARD background error
+    # (writes raise until resume()) instead of preceding the insert.
+    enable_async_wal: bool = False
+    # Submit-ring capacity (entries) of the async WAL writer.
+    async_wal_ring_size: int = 256
 
     # -- LSM shape ------------------------------------------------------
     num_levels: int = 7

@@ -23,7 +23,8 @@ class FilePrefetchBuffer:
     waiting for the doubling ramp."""
 
     __slots__ = ("_f", "_buf", "_buf_off", "_readahead", "_init_ra", "_max",
-                 "_next_expected", "_seq_reads", "_arm0", "hits", "misses")
+                 "_next_expected", "_seq_reads", "_arm0", "hits", "misses",
+                 "_ring", "_pending")
 
     MIN_READAHEAD = 8 * 1024
     MAX_READAHEAD = 256 * 1024
@@ -33,7 +34,7 @@ class FilePrefetchBuffer:
 
     def __init__(self, rfile, max_readahead: int = MAX_READAHEAD,
                  initial_readahead: int | None = None,
-                 arm_immediately: bool = False):
+                 arm_immediately: bool = False, aio_ring=None):
         self._f = rfile
         self._buf = b""
         self._buf_off = 0
@@ -46,6 +47,12 @@ class FilePrefetchBuffer:
         self._seq_reads = self.ARM_AFTER if arm_immediately else 0
         self.hits = 0      # reads served from the buffer
         self.misses = 0    # reads that went to the file
+        # Async readahead (env/env.py AsyncIORing — the write plane's
+        # submit ring doubles as a prefetch I/O lane): when armed, the
+        # NEXT window's pread is submitted to the ring as the current one
+        # is returned, so the scan's compute overlaps its I/O.
+        self._ring = aio_ring
+        self._pending = None  # (offset, AioToken) of the in-flight window
 
     def reset(self) -> None:
         """Back to the initial state (a seek): drop the window and the
@@ -58,6 +65,15 @@ class FilePrefetchBuffer:
         self._readahead = self._init_ra
         self._next_expected = -1
         self._seq_reads = self.ARM_AFTER if self._arm0 else 0
+        self._pending = None
+
+    def _schedule_next(self) -> None:
+        """Submit the window after the current one through the ring."""
+        nxt = self._buf_off + len(self._buf)
+        want = self._readahead
+        f = self._f
+        self._pending = (nxt, self._ring.submit_task(
+            lambda: f.read(nxt, want)))
 
     def read(self, offset: int, n: int) -> bytes:
         end = offset + n
@@ -67,6 +83,27 @@ class FilePrefetchBuffer:
             o = offset - self._buf_off
             self._track(end)
             return self._buf[o: o + n]
+        if self._pending is not None:
+            # Adopt the async window if the read landed in/at it.
+            p_off, tok = self._pending
+            if offset >= p_off and self._seq_reads >= self.ARM_AFTER:
+                self._pending = None
+                try:
+                    data = tok.wait()
+                except Exception:
+                    data = b""
+                if data and end <= p_off + len(data):
+                    self.hits += 1
+                    self._buf = data
+                    self._buf_off = p_off
+                    self._readahead = min(self._readahead * 2, self._max)
+                    if self._ring is not None:
+                        self._schedule_next()
+                    self._track(end)
+                    o = offset - p_off
+                    return self._buf[o: o + n]
+            elif offset < p_off:
+                self._pending = None  # seek backwards: drop the window
         self.misses += 1
         if offset == self._next_expected:
             self._seq_reads += 1
@@ -80,6 +117,8 @@ class FilePrefetchBuffer:
             self._buf = self._f.read(offset, want)
             self._buf_off = offset
             self._readahead = min(self._readahead * 2, self._max)
+            if self._ring is not None:
+                self._schedule_next()
             self._track(end)
             return self._buf[:n]
         self._track(end)

@@ -360,6 +360,93 @@ def main(argv=None) -> int:
             dbi.close()
             _sh.rmtree(di, ignore_errors=True)
 
+    # Native group-commit write plane vs the Python interiors: the SAME
+    # mixed-batch-size protected fillrandom (WAL on) through DB.write with
+    # TPULSM_WRITE_PLANE=0 and =1. At the intended scale (--n >= 1000000:
+    # the 1M-op mixed-size run) the native plane must win; smaller runs
+    # (the test suite's smoke --n) just print both rows.
+    if args.filter in "write_group_native":
+        import shutil as _sh
+        import tempfile as _tf
+        import threading as _th
+
+        from toplingdb_tpu.db.db import DB
+        from toplingdb_tpu.db.write_batch import WriteBatch
+        from toplingdb_tpu.options import Options
+
+        n_w = max(n, 4000)
+        nt_w = 4
+        sizes = (10, 100, 1000)  # mixed batch sizes, round-robin
+        per = n_w // nt_w
+
+        def mkbatches():
+            out = []
+            for t in range(nt_w):
+                bs, i, si = [], 0, 0
+                while i < per:
+                    bsz = min(sizes[si % len(sizes)], per - i)
+                    si += 1
+                    b = WriteBatch(protection_bytes_per_key=8)
+                    for j in range(i, i + bsz):
+                        k = ((t * per + j) * 2654435761) % (n_w * 2)
+                        b.put(b"%016d" % k, b"v" * (8 + (j % 3) * 24))
+                    bs.append(b)
+                    i += bsz
+                out.append(bs)
+            return out
+
+        saved_wp = os.environ.get("TPULSM_WRITE_PLANE")
+        results = {}
+        try:
+            for knob in ("0", "1"):
+                os.environ["TPULSM_WRITE_PLANE"] = knob
+                best = None
+                for _ in range(3):
+                    batches = mkbatches()
+                    dw = _tf.mkdtemp(prefix="mb_wg_", dir="/dev/shm"
+                                     if os.path.isdir("/dev/shm") else None)
+                    dbw = DB.open(dw, Options(
+                        create_if_missing=True,
+                        write_buffer_size=1 << 30,
+                        protection_bytes_per_key=8))
+                    errs = []
+
+                    def go(bs):
+                        try:
+                            for b in bs:
+                                dbw.write(b)
+                        except Exception as e:  # noqa: BLE001
+                            errs.append(e)
+
+                    ts = [_th.Thread(target=go, args=(bs,))
+                          for bs in batches]
+                    t0 = time.perf_counter()
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    dt = time.perf_counter() - t0
+                    assert not errs, errs
+                    dbw.close()
+                    _sh.rmtree(dw, ignore_errors=True)
+                    if best is None or dt < best:
+                        best = dt
+                results[knob] = best
+                print(json.dumps({
+                    "bench": f"write_group_native_{knob}", "items": n_w,
+                    "best_s": round(best, 4),
+                    "items_per_s": round(n_w / best),
+                }))
+        finally:
+            if saved_wp is None:
+                os.environ.pop("TPULSM_WRITE_PLANE", None)
+            else:
+                os.environ["TPULSM_WRITE_PLANE"] = saved_wp
+        if n_w >= 1_000_000:
+            assert results["1"] <= results["0"], (
+                f"native write plane lost: plane1 {results['1']:.3f}s vs "
+                f"plane0 {results['0']:.3f}s")
+
     # Persistent cache tier: spill 4KiB blocks through the write-behind
     # queue, then measure disk-tier lookups — the row reports the tier's
     # measured hit rate (reference block_cache_tier stats role).

@@ -140,6 +140,7 @@ _SIMPLE_OPTION_KEYS = {
     "recycle_log_file_num", "wal_ttl_seconds",
     "protection_bytes_per_key", "file_checksum",
     "integrity_scrub_period_sec", "integrity_scrub_bytes_per_sec",
+    "enable_async_wal", "async_wal_ring_size",
 }
 
 # MergeOperator.name() → registry key, for options_to_config round-trips.
@@ -356,6 +357,8 @@ class SidePluginRepo:
 
     def start_http(self, port: int = 0) -> int:
         """Serves /dbs, /stats/<name>, /levels/<name>, /config/<name>,
+        /db/<name> (write-plane view: WAL_* + WRITE_GROUP_* counters,
+        write.group.bytes histogram, async-WAL ring state),
         /replication/<name> (role/lag/applied-seq of the replication
         plane), /integrity/<name> (scrub progress, quarantined files,
         mismatch counters — the integrity plane's view), and /metrics
@@ -553,6 +556,46 @@ class SidePluginRepo:
                              else "primary-unshipped"),
                 }
             out.setdefault("last_sequence", db.versions.last_sequence)
+            return out
+        if kind == "db":
+            # Write-plane view: WAL_* counters with the WRITE_GROUP_*
+            # family beside them (groups led, followers merged, native
+            # plane commits vs fallbacks, coalesced fsyncs) plus the
+            # write.group.bytes histogram and the plane's live config.
+            out = {
+                "write_plane_enabled": bool(
+                    getattr(db, "_write_plane_knob", False)),
+                "write_plane_resolved": bool(
+                    getattr(db, "_write_plane", None)),
+                "async_wal": getattr(db, "_wal_ring", None) is not None,
+                "last_sequence": db.versions.last_sequence,
+            }
+            ring = getattr(db, "_wal_ring", None)
+            if ring is not None:
+                out["async_wal_ring"] = {
+                    "appends": ring.appends, "syncs": ring.syncs,
+                    "fsyncs": ring.fsyncs,
+                    "fsyncs_coalesced": ring.fsyncs_coalesced,
+                }
+            if db.stats is not None:
+                from toplingdb_tpu.utils import statistics as _st
+
+                t = db.stats.tickers()
+                out["tickers"] = {
+                    k: t.get(k, 0)
+                    for k in (_st.WAL_BYTES, _st.WAL_SYNCS,
+                              _st.WRITE_WITH_WAL,
+                              _st.WRITE_GROUP_LED,
+                              _st.WRITE_GROUP_FOLLOWERS,
+                              _st.WRITE_GROUP_NATIVE_COMMITS,
+                              _st.WRITE_GROUP_FALLBACKS,
+                              _st.WRITE_GROUP_FSYNCS_COALESCED)
+                }
+                h = db.stats.get_histogram(_st.WRITE_GROUP_BYTES)
+                out["write_group_bytes"] = {
+                    "count": h.count, "avg": round(h.average, 1),
+                    "p99": h.percentile(99),
+                }
             return out
         if kind == "integrity":
             # Scrub progress + quarantine + mismatch counters (mirrors the

@@ -113,16 +113,33 @@ def factory_for(options) -> FileChecksumGenFactory | None:
 
 
 def compute_file_checksum(env, path: str, gen: FileChecksumGenerator,
-                          pacer=None) -> bytes:
+                          pacer=None, aio_ring=None) -> bytes:
     """Digest the whole file through the Env in chunks. `pacer`, when
     given, is called with each chunk's size (the scrubber's rate
-    limiter)."""
+    limiter). `aio_ring` (env/env.py AsyncIORing — the shared Env async
+    batched-I/O primitive) double-buffers: the NEXT chunk's read is
+    submitted to the ring while the current chunk digests, overlapping
+    the scrubber's I/O with its checksum compute."""
     f = env.new_random_access_file(path)
     try:
         size = f.size()
         off = 0
+        pending = None
+        if aio_ring is not None and size:
+            want = min(_CHUNK, size)
+            pending = aio_ring.submit_task(lambda o=0, w=want: f.read(o, w))
         while off < size:
-            data = f.read(off, min(_CHUNK, size - off))
+            want = min(_CHUNK, size - off)
+            if pending is not None:
+                data = pending.wait()
+                pending = None
+                nxt = off + (len(data) or 0)
+                if nxt < size and data:
+                    w2 = min(_CHUNK, size - nxt)
+                    pending = aio_ring.submit_task(
+                        lambda o=nxt, w=w2: f.read(o, w))
+            else:
+                data = f.read(off, want)
             if not data:
                 raise Corruption(f"{path}: short read at {off}/{size}")
             gen.update(data)

@@ -67,6 +67,15 @@ WRITE_DONE_BY_OTHER = "write.other"
 WRITE_WITH_WAL = "write.wal"
 WAL_SYNCS = "wal.synced"
 WAL_BYTES = "wal.bytes"
+# Group-commit write plane (db.py _lead_write_group family + the native
+# fused plane): groups led by a leader, follower batches merged into them,
+# groups committed through tpulsm_wb_group_commit vs the Python interiors,
+# and sync barriers merged into shared fsyncs by the async WAL writer.
+WRITE_GROUP_LED = "write.group.led"
+WRITE_GROUP_FOLLOWERS = "write.group.followers"
+WRITE_GROUP_NATIVE_COMMITS = "write.group.native.commits"
+WRITE_GROUP_FALLBACKS = "write.group.fallbacks"
+WRITE_GROUP_FSYNCS_COALESCED = "write.group.fsyncs.coalesced"
 # -- compaction ------------------------------------------------------
 COMPACT_READ_BYTES = "compact.read.bytes"
 COMPACT_WRITE_BYTES = "compact.write.bytes"
@@ -172,6 +181,7 @@ SCRUB_LATENCY_MICROS = "scrub.latency.micros"      # one scrubber pass
 NUM_FILES_IN_SINGLE_COMPACTION = "numfiles.in.singlecompaction"
 BYTES_PER_READ = "bytes.per.read"
 BYTES_PER_WRITE = "bytes.per.write"
+WRITE_GROUP_BYTES = "write.group.bytes"  # bytes merged per commit group
 NUM_SUBCOMPACTIONS_SCHEDULED = "num.subcompactions.scheduled"
 
 
