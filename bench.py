@@ -355,6 +355,117 @@ def integrity_rows(detail, n_db):
     shutil.rmtree(scrub_dir, ignore_errors=True)
 
 
+def observability_rows(detail, n_db):
+    """Telemetry-plane overhead rows: fillrandom/readrandom twins with
+    tracing off / sampled 1-in-64 / always-on. All three modes run as
+    fine-grained INTERLEAVED segments on the SAME DB instance (separate
+    twin DBs drift by several percent from layout/compaction timing
+    alone, which would swamp a ~1% effect); Statistics is attached —
+    the repo-served rockside-role DB this plane exists for always
+    carries a stats sink, so that is the measured baseline. Gate:
+    sampled <= 2% (`trace_overhead_pct`)."""
+    import itertools as _it
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.db.write_batch import WriteBatch
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils import telemetry as _tm
+    from toplingdb_tpu.utils.statistics import Statistics
+
+    n = max(60_000, min(240_000, n_db // 5))
+    batch = 100
+    seg = 3000  # ops per timed segment before rotating modes
+    keys = [b"%016d" % ((i * 2654435761) % (n * 2)) for i in range(n)]
+
+    d = tempfile.mkdtemp(prefix="benchobs_", dir="/dev/shm"
+                         if os.path.isdir("/dev/shm") else None)
+    db = DB.open(d, Options(create_if_missing=True,
+                            write_buffer_size=1 << 30,
+                            statistics=Statistics()))
+
+    def make_state(se):
+        if se == 0:
+            return (None, None)
+        tr = _tm.Tracer(sample_every=se)
+        return (tr, _it.cycle([0] * (se - 1) + [1]).__next__)
+
+    import gc
+
+    modes = ("off", "sampled", "always")
+    states = {"off": make_state(0), "sampled": make_state(64),
+              "always": make_state(1)}
+    spent = {m: [0.0, 0] for m in modes}  # wall, ops (fill)
+    rspent = {m: [0.0, 0] for m in modes}  # wall, ops (read)
+
+    def set_mode(m):
+        # Collect OUTSIDE the timed region so one mode's allocation debt
+        # (always-on churns a trace per op) never bills a neighbor.
+        gc.collect(0)
+        db.tracer, db._trace_sched = states[m]
+
+    def fill_seg(m, s0, hi):
+        set_mode(m)
+        t0 = time.perf_counter()
+        for i in range(s0, hi, batch):
+            b = WriteBatch()
+            for k in keys[i:i + batch]:
+                b.put(k, b"v" * 20)
+            db.write(b)
+        spent[m][0] += time.perf_counter() - t0
+        spent[m][1] += hi - s0
+
+    def read_seg(m, s0, hi):
+        set_mode(m)
+        t0 = time.perf_counter()
+        for i in range(s0, hi):
+            db.get(keys[(i * 7919) % n])
+        rspent[m][0] += time.perf_counter() - t0
+        rspent[m][1] += hi - s0
+
+    try:
+        # The GATED pair (off vs sampled) alternates in balanced A/B
+        # order on one DB; always-on — informational, and heavy enough
+        # to pollute neighbors — runs as its own tail slice.
+        n_ab = n * 3 // 4
+        for idx, s0 in enumerate(range(0, n_ab, seg)):
+            fill_seg(("off", "sampled")[(idx + idx // 2) % 2],
+                     s0, min(s0 + seg, n_ab))
+        for s0 in range(n_ab, n, seg):
+            fill_seg("always", s0, min(s0 + seg, n))
+        # readrandom reads SST-resident data (the workload's normal
+        # shape): flush so gets walk bloom + table, not just memtable.
+        set_mode("off")
+        db.flush()
+        db.wait_for_compactions()
+        nr = min(2 * n, 300_000)
+        for i in range(0, nr, seg):
+            db.get(keys[(i * 7919) % n])  # keep caches warm at rotation
+        nr_ab = nr * 3 // 4
+        for idx, s0 in enumerate(range(0, nr_ab, seg)):
+            read_seg(("off", "sampled")[(idx + idx // 2) % 2],
+                     s0, min(s0 + seg, nr_ab))
+        for s0 in range(nr_ab, nr, seg):
+            read_seg("always", s0, min(s0 + seg, nr))
+    finally:
+        db.tracer = None
+        db._trace_sched = None
+        db.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+    for m in modes:
+        detail[f"fillrandom_trace_{m}_ops_s"] = round(
+            spent[m][1] / spent[m][0])
+        detail[f"readrandom_trace_{m}_ops_s"] = round(
+            rspent[m][1] / rspent[m][0])
+    overhead = max(
+        100 * (1 - detail["fillrandom_trace_sampled_ops_s"]
+               / detail["fillrandom_trace_off_ops_s"]),
+        100 * (1 - detail["readrandom_trace_sampled_ops_s"]
+               / detail["readrandom_trace_off_ops_s"]),
+    )
+    detail["trace_overhead_pct"] = round(max(0.0, overhead), 2)
+
+
 def write_plane_rows(detail, n_db):
     """Native group-commit write plane rows (ISSUE 7): protected WAL-on
     write-PATH fillrandom (prebuilt mixed-size batches so the row
@@ -861,6 +972,11 @@ def main():
         except Exception as e:  # noqa: BLE001
             detail["integrity_rows_error"] = repr(e)[:120]
 
+        try:
+            observability_rows(detail, n_db)
+        except Exception as e:  # noqa: BLE001
+            detail["observability_rows_error"] = repr(e)[:120]
+
         # Range-axis weak-scaling of the distributed GC step (VERDICT r04
         # item 10): a subprocess because virtual device counts must be set
         # before the jax backend exists. Failure just drops the row.
@@ -981,6 +1097,9 @@ def main():
             "fillrandom_native_plane_ops_s": detail.get(
                 "fillrandom_native_plane_ops_s"),
             "fillrandom_sync_ops_s": detail.get("fillrandom_sync_ops_s"),
+            # Telemetry plane: sampled (1-in-64) tracing cost vs the
+            # tracing-off twin (gate: <= 2%).
+            "trace_overhead_pct": detail.get("trace_overhead_pct"),
         }
 
     line = json.dumps(make_record(detail))

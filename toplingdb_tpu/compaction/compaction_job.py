@@ -63,6 +63,7 @@ class CompactionStats:
     prefetch_misses: int = 0    # input-scan reads that went to the file
     device: str = "cpu"
     remote: bool = False        # ran in a worker process (dcompact)
+    pipelined: bool = False     # ran the 3-stage pipeline (ops/pipeline.py)
 
     def phase_dict(self) -> dict:
         """Non-zero timing phases, seconds — for bench/dcompact reporting.
@@ -91,6 +92,37 @@ class CompactionStats:
             if resid < 0:
                 out["pipeline_overlap_s"] = round(-resid / 1e6, 3)
         return out
+
+
+# Stats phase field → telemetry span name: every compaction mode reports
+# its interior through CompactionStats, so one synthesis point gives every
+# mode (serial / columnar / device / pipelined / remote) a stage waterfall
+# without restructuring the data planes. The DB-side scheduler emits them
+# under its compaction root; a dcompact worker emits them under its own
+# adopted root so the stitched trace shows the remote interior. Live
+# per-shard spans from the pipeline workers land beside these.
+_PHASE_SPANS = (
+    ("waiting_time_usec", "compaction.queue_wait"),
+    ("prepare_time_usec", "compaction.prepare"),
+    ("input_scan_usec", "compaction.input_scan"),
+    ("host_compute_usec", "compaction.compute"),
+    ("transfer_time_usec", "compaction.transfer"),
+    ("device_wait_usec", "compaction.device_wait"),
+    ("resolve_usec", "compaction.resolve"),
+    ("encode_write_usec", "compaction.encode_write"),
+    ("rpc_time_usec", "compaction.rpc"),
+)
+
+
+def emit_phase_spans(stats) -> None:
+    """Pre-finished child spans from a CompactionStats phase breakdown,
+    attached under the calling thread's active span (no-op untraced)."""
+    from toplingdb_tpu.utils import telemetry
+
+    for field, name in _PHASE_SPANS:
+        v = getattr(stats, field, 0)
+        if v:
+            telemetry.span_event(name, v)
 
 
 def collect_inputs(compaction: Compaction, table_cache, icmp):
@@ -434,6 +466,8 @@ def _run_subcompactions(env, dbname, icmp, compaction, table_cache,
     files."""
     import threading
 
+    from toplingdb_tpu.utils import telemetry
+
     ucmp = icmp.user_comparator
     ranges = [
         (bounds[i - 1] if i > 0 else None,
@@ -455,8 +489,13 @@ def _run_subcompactions(env, dbname, icmp, compaction, table_cache,
     )
     results: list = [None] * len(ranges)
     errors: list[BaseException] = []
+    # Serial-plane telemetry: the streamed merge→GC→build stage per key
+    # range, parented cross-thread under the compaction root.
+    trace_handle = telemetry.current_handle()
 
     def work(idx: int, lo: bytes | None, hi: bytes | None) -> None:
+        _tsp = telemetry.span_under(trace_handle,
+                                    "compaction.subcompaction", range=idx)
         try:
             st = CompactionStats()
             children, rd = collect_inputs(compaction, table_cache, icmp)
@@ -502,6 +541,8 @@ def _run_subcompactions(env, dbname, icmp, compaction, table_cache,
             results[idx] = (outs, st)
         except BaseException as e:  # noqa: BLE001 — surfaced by the driver
             errors.append(e)
+        finally:
+            _tsp.finish()
 
     if len(ranges) == 1:
         work(0, None, None)

@@ -95,8 +95,21 @@ class DcompactWorkerService:
                         ppath = os.path.join(job_dir, "params.json")
                         with open(ppath) as pf:
                             params = json.load(pf)
+                        dirty = False
                         if params.get("device") != svc.device:
                             params["device"] = svc.device
+                            dirty = True
+                        hdr = self.headers.get("X-Tpulsm-Trace")
+                        if hdr and not params.get("trace"):
+                            # Header-carried trace context (cross-host
+                            # deployments where the submitter wrote params
+                            # before sampling): fold into the job.
+                            try:
+                                params["trace"] = json.loads(hdr)
+                                dirty = True
+                            except ValueError:
+                                pass
+                        if dirty:
                             with open(ppath, "w") as pf:
                                 json.dump(params, pf, indent=1)
                         rc = worker.run_job(job_dir)
@@ -165,10 +178,24 @@ class HttpCompactionExecutorFactory(CompactionExecutorFactory):
             return None  # every circuit open: caller skips to local
 
         def spawn(job_dir: str, device: str) -> None:
+            headers = {"Content-Type": "application/json"}
+            try:
+                # Cross-process trace propagation rides the control plane
+                # as a header (the params.json copy serves non-HTTP
+                # transports); the worker service folds it back into the
+                # job's params before running.
+                import os as _os
+
+                with open(_os.path.join(job_dir, "params.json")) as pf:
+                    ctx = json.load(pf).get("trace")
+                if ctx:
+                    headers["X-Tpulsm-Trace"] = json.dumps(ctx)
+            except (OSError, ValueError):
+                pass
             req = urllib.request.Request(
                 url + "/dcompact",
                 data=json.dumps({"job_dir": job_dir}).encode(),
-                headers={"Content-Type": "application/json"},
+                headers=headers,
                 method="POST",
             )
             try:

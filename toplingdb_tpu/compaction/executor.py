@@ -43,6 +43,12 @@ from toplingdb_tpu.db.version_edit import FileMetaData
 from toplingdb_tpu.utils.status import Corruption, IOError_
 
 
+def _telemetry():
+    from toplingdb_tpu.utils import telemetry
+
+    return telemetry
+
+
 class CompactionExecutor:
     def execute(self, db, compaction: Compaction, snapshots: list[int],
                 new_file_number) -> tuple[list[FileMetaData], CompactionStats]:
@@ -157,6 +163,11 @@ class CompactionParams:
     cf_id: int = 0
     cf_name: str = "default"
     collectors: list = dataclasses.field(default_factory=list)
+    # Propagated trace context (utils/telemetry.py inject()): the worker
+    # adopts it, records its spans locally, and returns them in
+    # results.json so the DB stitches one end-to-end trace. None = the
+    # submitting op was untraced.
+    trace: dict | None = None
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=1)
@@ -176,6 +187,9 @@ class CompactionResults:
     stats: dict
     curl_time_usec: int = 0          # kept for parity with reference fields
     work_time_usec: int = 0
+    # Worker-side finished span dicts (telemetry plane): the DB side
+    # attaches them to the originating trace (attach_remote).
+    spans: list = dataclasses.field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=1)
@@ -339,6 +353,7 @@ class SubprocessCompactionExecutor(CompactionExecutor):
                 for f in opts.table_options.properties_collector_factories
             ],
             lease_sec=lease_sec,
+            trace=_telemetry().inject(),
         )
         with open(os.path.join(job_dir, "params.json"), "w") as f:
             f.write(params.to_json())
@@ -371,6 +386,10 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             raise IOError_(f"dcompact results unreadable: {e!r}") from e
         if results.status != "ok":
             raise IOError_(f"worker error: {results.status}")
+        if results.spans:
+            # Stitch the worker's spans into the compaction trace active
+            # on this thread (no-op when the job ran untraced).
+            _telemetry().attach_current(results.spans)
         # Rename outputs into the DB dir under fresh file numbers
         # (reference RunRemote rename loop, compaction_job.cc:1019-1073).
         outputs = []

@@ -22,6 +22,11 @@ from toplingdb_tpu.compaction.compaction_job import (
 from toplingdb_tpu.compaction.picker import Compaction, create_picker
 
 
+from toplingdb_tpu.compaction.compaction_job import (  # noqa: E402
+    emit_phase_spans as _emit_phase_spans,
+)
+
+
 class CompactionScheduler:
     def __init__(self, db, background: bool = True):
         self.db = db
@@ -326,6 +331,23 @@ class CompactionScheduler:
             self._run_compaction_inner(c)
 
     def _run_compaction_inner(self, c: Compaction) -> None:
+        from toplingdb_tpu.utils import telemetry as _tm
+
+        db = self.db
+        # Compactions are always traced while a tracer exists — they are
+        # the ops RESYSTANCE-style stage visibility pays off on most.
+        _root = (db.tracer.start(
+            "compaction", level=c.level, output_level=c.output_level,
+            reason=c.reason, cf_id=c.cf_id)
+            if getattr(db, "tracer", None) is not None else _tm.NOOP_SPAN)
+        try:
+            self._run_compaction_traced(c, _root)
+        finally:
+            _root.finish()
+
+    def _run_compaction_traced(self, c: Compaction, _root) -> None:
+        from toplingdb_tpu.utils import telemetry as _tm
+
         db = self.db
         snapshots = db.snapshots.sequences()
         pending: list[int] = []
@@ -358,6 +380,10 @@ class CompactionScheduler:
                 )
             else:
                 outputs, stats = self._run_local(c, snapshots, alloc)
+            _root.tag(mode=self._compaction_mode(stats),
+                      input_records=stats.input_records,
+                      output_records=stats.output_records)
+            _emit_phase_spans(stats)
             if db.options.statistics is not None:
                 db.options.statistics.record_compaction(stats)
             from toplingdb_tpu.utils.sync_point import sync_point_callback
@@ -400,6 +426,21 @@ class CompactionScheduler:
         finally:
             with db._mutex:
                 db._pending_outputs.difference_update(pending)
+
+    @staticmethod
+    def _compaction_mode(stats) -> str:
+        """serial / columnar / device / pipelined / remote — the trace tag
+        the ISSUE's per-mode waterfalls key on."""
+        if getattr(stats, "remote", False):
+            return "remote"
+        if getattr(stats, "pipelined", False):
+            return "pipelined"
+        if stats.device not in ("cpu",):
+            return "device"
+        if getattr(stats, "host_compute_usec", 0) \
+                or getattr(stats, "encode_write_usec", 0):
+            return "columnar"
+        return "serial"
 
     def _degradation_gate(self):
         if self._pin_gate is None:

@@ -42,9 +42,27 @@ def run_job(job_dir: str) -> int:
         from toplingdb_tpu.compaction.resilience import HeartbeatWriter
 
         heartbeat = HeartbeatWriter(job_dir, lease_sec).start()
+    # Cross-process trace propagation: adopt the DB side's context (when
+    # it sampled this compaction), record this worker's spans locally, and
+    # append them to results.json for the primary to stitch.
+    from toplingdb_tpu.utils import telemetry as _tm
+
+    ctx = getattr(params, "trace", None)
+    root = None
+    if ctx and ctx.get("sampled"):
+        tracer = _tm.Tracer(sample_every=1, proc="dcompact-worker")
+        root = tracer.start_from(ctx, "dcompact.worker",
+                                 job_id=params.job_id,
+                                 attempt=params.attempt,
+                                 device=params.device)
     try:
         return _run_job_inner(job_dir, params, t_enter, waiting_usec)
     finally:
+        if root is not None:
+            tracer_ = root._tracer
+            root.finish()
+            _append_result_spans(job_dir,
+                                 tracer_.export_trace(root.trace_id))
         if heartbeat is not None:
             heartbeat.stop()
 
@@ -136,10 +154,22 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
         readers = {}
         metas = []
         for i, path in enumerate(params.input_files, 1):
-            readers[i] = open_table(env.new_random_access_file(path), icmp,
-                                    topts)
-            metas.append(FileMetaData(number=i,
-                                      file_size=env.get_file_size(path)))
+            r = open_table(env.new_random_access_file(path), icmp, topts)
+            readers[i] = r
+            # Real key bounds + entry counts: the columnar/pipelined plane
+            # shards by them (metas built bare broke every device job into
+            # the error-fallback path before this).
+            it = r.new_iterator()
+            it.seek_to_first()
+            smallest = it.key() if it.valid() else b""
+            it.seek_to_last()
+            largest = it.key() if it.valid() else smallest
+            metas.append(FileMetaData(
+                number=i, file_size=env.get_file_size(path),
+                smallest=smallest, largest=largest,
+                num_entries=r.properties.num_entries,
+                num_deletions=r.properties.num_deletions,
+            ))
         fake_compaction = Compaction(
             level=0, output_level=params.output_level, inputs=metas,
             bottommost=params.bottommost,
@@ -160,6 +190,9 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
         stats.prepare_time_usec = max(
             0, int((time.time() - t_enter) * 1e6) - stats.work_time_usec)
         stats.waiting_time_usec = waiting_usec
+        from toplingdb_tpu.compaction.compaction_job import emit_phase_spans
+
+        emit_phase_spans(stats)  # worker-side interior, under its root
         results = CompactionResults(
             status="ok",
             output_files=[
@@ -174,18 +207,21 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
 
     # Per-entry path (CPU jobs and exotic comparators): read inputs raw —
     # unsorted for the device stream, host-sorted for the CPU reference.
+    from toplingdb_tpu.utils import telemetry as _tm
+
     entries = []
     rd = RangeDelAggregator(ucmp)
     readers_l = []
-    for path in params.input_files:
-        r = open_table(env.new_random_access_file(path), icmp, topts)
-        readers_l.append(r)
-        it = r.new_iterator()
-        it.seek_to_first()
-        for k, v in it.entries():
-            entries.append((k, v))
-        for b, e in r.range_del_entries():
-            rd.add(RangeTombstone.from_table_entry(b, e))
+    with _tm.span("compaction.input_scan", files=len(params.input_files)):
+        for path in params.input_files:
+            r = open_table(env.new_random_access_file(path), icmp, topts)
+            readers_l.append(r)
+            it = r.new_iterator()
+            it.seek_to_first()
+            for k, v in it.entries():
+                entries.append((k, v))
+            for b, e in r.range_del_entries():
+                rd.add(RangeTombstone.from_table_entry(b, e))
 
     stats = CompactionStats(device=params.device)
     stats.input_records = len(entries)
@@ -229,12 +265,13 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
     tombs = surviving_tombstone_fragments(
         rd, params.snapshots, params.bottommost, ucmp
     )
-    outputs = build_outputs(
-        env, params.output_dir, icmp, fake_compaction, stream, tombs,
-        alloc, topts, stats, params.creation_time,
-        column_family=(getattr(params, "cf_id", 0),
-                       getattr(params, "cf_name", "default")),
-    )
+    with _tm.span("compaction.encode_write"):
+        outputs = build_outputs(
+            env, params.output_dir, icmp, fake_compaction, stream, tombs,
+            alloc, topts, stats, params.creation_time,
+            column_family=(getattr(params, "cf_id", 0),
+                           getattr(params, "cf_name", "default")),
+        )
     results = CompactionResults(
         status="ok",
         output_files=[
@@ -249,6 +286,23 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
     with open(os.path.join(job_dir, "results.json"), "w") as f:
         f.write(results.to_json())
     return 0
+
+
+def _append_result_spans(job_dir: str, spans: list) -> None:
+    """Re-open results.json and attach the worker's finished spans (the
+    results were written by the job body before the tracer could close its
+    root). Best-effort: a failed job has no results.json to annotate."""
+    import json
+
+    path = os.path.join(job_dir, "results.json")
+    try:
+        with open(path) as f:
+            results = json.load(f)
+        results["spans"] = spans
+        with open(path, "w") as f:
+            json.dump(results, f, indent=1)
+    except (OSError, ValueError):
+        pass
 
 
 def _merge_operator_by_name(name: str):

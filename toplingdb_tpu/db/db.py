@@ -30,6 +30,7 @@ from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.env import Env, default_env
 from toplingdb_tpu.options import FlushOptions, Options, ReadOptions, WriteOptions
 from toplingdb_tpu.utils import statistics as _st
+from toplingdb_tpu.utils import telemetry as _tm
 from toplingdb_tpu.table.merging_iterator import MergingIterator
 from toplingdb_tpu.utils.status import (
     Busy, Corruption, InvalidArgument, IOError_, NotFound,
@@ -389,6 +390,28 @@ class DB:
             if self.stats is not None and options.stats_persist_period_sec > 0
             else None
         )
+        # stats_dump_period_sec: periodic snapshot + a compact `stats_dump`
+        # event-log line (the reference's stats-dump thread); started after
+        # event_logger exists, below.
+        self._stats_dump_thread = None
+        # Request-scoped span tracer (utils/telemetry.py): None unless a
+        # trace_* knob turns it on — the hot paths check `is not None`
+        # before paying anything. The get path's 1-in-N decision is a
+        # precomputed cycle iterator (`_trace_sched` yields 1 on the Nth
+        # op, 2 for slow-watch rounds, 0 otherwise): the unsampled cost
+        # is one attribute load + one C-level next + one branch.
+        self.tracer = _tm.tracer_from_options(options)
+        self._trace_sched = None
+        _tr = self.tracer
+        if _tr is not None:
+            import itertools as _it
+
+            se, slow = _tr.sample_every, _tr.slow_usec
+            if se:
+                pat = [2 if slow else 0] * (se - 1) + [1]
+            else:
+                pat = [2]  # slow-watch only
+            self._trace_sched = _it.cycle(pat).__next__
         self.seqno_to_time = SeqnoToTimeMapping()
         # The mapping must survive reopens (reference persists it through
         # MANIFEST/SST properties) or every restart would treat ALL data
@@ -421,6 +444,28 @@ class DB:
         self.event_logger = EventLogger(
             (lambda line: self._log_file.append(line.encode() + b"\n"))
             if self._log_file is not None else None
+        )
+        if (self.stats is not None
+                and getattr(options, "stats_dump_period_sec", 0) > 0):
+            from toplingdb_tpu.utils.stats_history import StatsDumpScheduler
+
+            self._stats_dump_thread = StatsDumpScheduler(
+                self.stats_history, options.stats_dump_period_sec,
+                on_snapshot=self._log_stats_dump)
+
+    def _log_stats_dump(self) -> None:
+        """One compact stats line per dump period (the reference's periodic
+        stats dump into the info LOG), fed from the history ring's latest
+        delta sample so the dump and /stats_history always agree."""
+        sample = self.stats_history.last_sample()
+        if sample is None:
+            return
+        ts, delta = sample
+        top = sorted(delta.items(), key=lambda kv: -abs(kv[1]))[:12]
+        self.event_logger.log(
+            "stats_dump", sample_ts=ts,
+            tickers={k: v for k, v in top},
+            last_sequence=self.versions.last_sequence,
         )
 
     # -- default-CF views (most callers are single-CF) ------------------
@@ -678,6 +723,8 @@ class DB:
             self._integrity_scrubber.stop()
         if self._stats_dumper is not None:
             self._stats_dumper.stop()
+        if self._stats_dump_thread is not None:
+            self._stats_dump_thread.stop()
         if self._mget_pool is not None:
             self._mget_pool.shutdown(wait=True)
             self._mget_pool = None
@@ -840,16 +887,38 @@ class DB:
         tr = self._op_tracer
         if tr is not None:
             tr.record_write(batch.data())
-        if self.stats is not None:
-            # time/_st are module-level imports: no per-call import
-            # machinery on the write hot path.
-            t0 = time.perf_counter()
-            try:
-                return self._write_impl(batch, opts, on_sequenced)
-            finally:
-                self.stats.record_in_histogram(
-                    _st.DB_WRITE_MICROS, (time.perf_counter() - t0) * 1e6)
-        return self._write_impl(batch, opts, on_sequenced)
+        tracer = self.tracer
+        root = None
+        if tracer is not None and tracer.sample_every \
+                and next(tracer.counter) % tracer.sample_every == 0:
+            # Sampled: full span tree for this write (the inline check is
+            # the whole unsampled cost — one count + one mod).
+            root = tracer.start("db.write", records=batch.count(),
+                                bytes=batch.data_size(),
+                                sync=bool(opts.sync))
+        stats = self.stats
+        if stats is None and tracer is None:
+            return self._write_impl(batch, opts, on_sequenced)
+        # time/_st are module-level imports: no per-call import
+        # machinery on the write hot path.
+        t0 = time.perf_counter()
+        try:
+            seq = self._write_impl(batch, opts, on_sequenced)
+            if root is not None:
+                # Replication propagation: WAL shipping forwards this
+                # write's context to followers by sequence range.
+                tracer.note_seq(seq, root)
+            return seq
+        finally:
+            micros = (time.perf_counter() - t0) * 1e6
+            if stats is not None:
+                stats.record_in_histogram(_st.DB_WRITE_MICROS, micros)
+            if root is not None:
+                root.finish()
+            elif tracer is not None and tracer.slow_usec \
+                    and micros >= tracer.slow_usec:
+                tracer.note_slow("db.write", micros,
+                                 records=batch.count())
 
     @staticmethod
     def _write_token(w: _Writer) -> int:
@@ -867,6 +936,9 @@ class DB:
             is_leader = self._writers[0] is w
         if not is_leader:
             interrupted: BaseException | None = None
+            # Time spent queued behind the current leader (a sampled
+            # follower's dominant latency component).
+            _wsp = _tm.span("write.leader_wait")
             while True:
                 try:
                     w.event.wait()
@@ -876,6 +948,7 @@ class DB:
                     # slot MUST still resolve — abandoning it would deadlock
                     # every later writer behind a never-driven leader.
                     interrupted = e
+            _wsp.finish()
             if w.parallel:
                 # Drafted into the group's parallel memtable phase: insert
                 # our own batch (GIL-free native path), then wait for the
@@ -995,10 +1068,13 @@ class DB:
                     # Native plane frames+appends the merged record here;
                     # its insert half runs OUTSIDE _mutex below, exactly
                     # like the Python interiors it replaces.
-                    plane = self._native_group_commit(group, first, mems,
-                                                      frame=True)
-                    wal_wait = (plane[0] if plane is not None
-                                else self._append_group_wal(group, first))
+                    with _tm.span("write.wal_frame", group=len(group),
+                                  staged=True):
+                        plane = self._native_group_commit(group, first,
+                                                          mems, frame=True)
+                        wal_wait = (plane[0] if plane is not None
+                                    else self._append_group_wal(group,
+                                                                first))
                 self._seq_alloc = last
                 entry = [first, last, False]
                 self._alloc_ranges.append(entry)
@@ -1025,6 +1101,8 @@ class DB:
         # GIL-free native inserts) and pipelined-only mode fans out when
         # allowed.
         native_used = False
+        _msp = _tm.span("write.memtable_apply", group=len(group),
+                        staged=True)
         if plane is not None:
             try:
                 plane[1]()
@@ -1064,15 +1142,19 @@ class DB:
                         w.batch.insert_into(mems)
                 except BaseException as e:  # noqa: BLE001
                     err = e
+        _msp.finish()
         if wal_wait is not None:
             # Async WAL: the durability barrier overlapped the memtable
             # phase; settle it before completion so a failed group never
             # acknowledges.
+            _fsp = _tm.span("write.fsync_barrier", staged=True)
             try:
                 wal_wait()
             except BaseException as e:  # noqa: BLE001
                 if err is None:
                     err = e
+            finally:
+                _fsp.finish()
         self._tick_write_group(group, native_used and err is None)
         self._complete_staged_group(group, first, last, err)
         if err is not None:
@@ -1111,9 +1193,19 @@ class DB:
         if stats is not None:
             stats.record_tick(_st.WAL_BYTES, rec_len)
             stats.record_tick(_st.WRITE_WITH_WAL, len(group))
+        if _st.perf_level:
+            # PerfContext write-plane feed (reference wal_write_bytes):
+            # the leader's thread accounts the whole group's WAL record.
+            _st.perf_context().wal_write_bytes += rec_len
         want_sync = any(w.opts.sync for w in group)
         wfile = self._wal._f
         if self._wal_ring is not None and hasattr(wfile, "sync_async"):
+            _sp = _tm.current_span()
+            if _sp is not None:
+                # Ring depth AT ENQUEUE: how backed up the async WAL
+                # writer was when this group's barrier was submitted.
+                _sp.tag(wal_ring_depth=len(self._wal_ring._q),
+                        want_sync=want_sync)
             tok = wfile.sync_async() if want_sync else wfile.append_barrier()
 
             def wait(tok=tok, want_sync=want_sync, stats=stats):
@@ -1260,7 +1352,12 @@ class DB:
                     prot_ptr = pv.ctypes.data_as(
                         ctypes.POINTER(ctypes.c_uint64))
                     n_prots = len(pv)
-        out = (ctypes.c_int64 * 5)()
+        # out[0..4]: framed bytes / new block offset / mem byte delta /
+        # delete count / merged record length. out[5..7]: native interior
+        # timings in ns (validate / WAL frame / memtable insert) — the
+        # telemetry plane's window into the GIL-released interior without
+        # any per-record Python overhead (older .so builds leave them 0).
+        out = (ctypes.c_int64 * 8)()
 
         def run(mode, block_off=0, log_no=-1, wal_ptr=None, cap=0):
             rc = fn(gh[0], gh[1], rep_arr, len_arr, n, first_seq, prot_ptr,
@@ -1291,6 +1388,9 @@ class DB:
             rc = run(2 | (4 if validated else 8 if fill else 0))
             if rc < 0:  # only reachable from the unvalidated single call
                 return None
+            if out[7]:
+                _tm.span_event("native.memtable_insert", out[7] // 1000,
+                               records=total)
             if fill and not validated:
                 adopt_filled()
             seq = first_seq
@@ -1319,6 +1419,12 @@ class DB:
         del wal_ptr  # release the bytearray's buffer export
         if rc < 0:
             return None  # -2/-4: the Python path decides (and names) it
+        if out[5]:
+            _tm.span_event("native.wal_validate", out[5] // 1000,
+                           records=rc)
+        if out[6]:
+            _tm.span_event("native.wal_frame", out[6] // 1000,
+                           bytes=int(out[0]))
         if fill:
             adopt_filled()
         self._wal.append_preframed(memoryview(wal_buf)[:int(out[0])],
@@ -1454,46 +1560,63 @@ class DB:
             wal_on = (self.options.wal_enabled
                       and not group[0].opts.disable_wal)
             wal_wait = None
-            plane = self._native_group_commit(group, first_seq, mems,
-                                              frame=wal_on)
+            with _tm.span("write.wal_frame", group=len(group),
+                          wal=wal_on):
+                plane = self._native_group_commit(group, first_seq, mems,
+                                                  frame=wal_on)
+                if plane is None and wal_on:
+                    wal_wait = self._append_group_wal(group, first_seq)
+            _mt0 = time.perf_counter() if _st.perf_level >= 2 else 0.0
             if plane is not None:
-                wal_wait, insert_fn = plane
+                p_wait, insert_fn = plane
+                if p_wait is not None:
+                    wal_wait = p_wait
                 if insert_fn is not None:
-                    insert_fn()
+                    with _tm.span("write.memtable_apply",
+                                  group=len(group), native=True):
+                        insert_fn()
             if plane is not None:
                 self._tick_write_group(group, native=True)
             else:
-                wal_wait = self._append_group_wal(group, first_seq)
-                if (self.options.allow_concurrent_memtable_write
-                        and len(group) > 1):
-                    # Parallel memtable phase (reference
-                    # LaunchParallelMemTableWriters): followers insert their
-                    # own batches concurrently — the native skiplist insert
-                    # is lock-free and GIL-releasing, so this scales with
-                    # threads. The leader holds _mutex throughout, so no
-                    # memtable switch can race the phase.
-                    pg = _InsertBarrier(len(group))
-                    for w in group[1:]:
-                        w.pg = pg
-                        w.pg_mems = mems
-                        w.parallel = True
-                        w.event.set()
-                    try:
-                        group[0].batch.insert_into(mems)
-                        pg.member_done()
-                    except BaseException as e:  # noqa: BLE001
-                        pg.member_done(e)
-                    pg.all_done.wait()
-                    for w in group[1:]:
-                        w.parallel = False
-                    if pg.error is not None:
-                        raise pg.error
-                else:
-                    for w in group:
-                        w.batch.insert_into(mems)
+                with _tm.span("write.memtable_apply", group=len(group),
+                              native=False):
+                    if (self.options.allow_concurrent_memtable_write
+                            and len(group) > 1):
+                        # Parallel memtable phase (reference
+                        # LaunchParallelMemTableWriters): followers insert
+                        # their own batches concurrently — the native
+                        # skiplist insert is lock-free and GIL-releasing, so
+                        # this scales with threads. The leader holds _mutex
+                        # throughout, so no memtable switch can race the
+                        # phase.
+                        pg = _InsertBarrier(len(group))
+                        for w in group[1:]:
+                            w.pg = pg
+                            w.pg_mems = mems
+                            w.parallel = True
+                            w.event.set()
+                        try:
+                            group[0].batch.insert_into(mems)
+                            pg.member_done()
+                        except BaseException as e:  # noqa: BLE001
+                            pg.member_done(e)
+                        pg.all_done.wait()
+                        for w in group[1:]:
+                            w.parallel = False
+                        if pg.error is not None:
+                            raise pg.error
+                    else:
+                        for w in group:
+                            w.batch.insert_into(mems)
                 self._tick_write_group(group, native=False)
+            if _mt0:
+                # PerfContext timed tier (reference write_memtable_time).
+                _st.perf_context().write_memtable_time += int(
+                    (time.perf_counter() - _mt0) * 1e9)
             if wal_wait is not None:
-                wal_wait()  # async WAL: durability overlapped the inserts
+                # async WAL: durability overlapped the inserts
+                with _tm.span("write.fsync_barrier"):
+                    wal_wait()
             # on_sequenced fires only after the WAL append + memtable insert
             # succeeded (a failed group must not leak registrations), but
             # BEFORE the group's sequence publishes: entries stay invisible
@@ -1599,6 +1722,18 @@ class DB:
 
     def _flush_memtables_inner(self, mems: list[MemTable],
                                wal_number: int | None, cf_id: int) -> None:
+        # Flushes are rare and high-value: always traced while a tracer
+        # exists (sampling applies to the per-op read/write roots only).
+        _root = (self.tracer.start("flush", cf_id=cf_id,
+                                   memtables=len(mems))
+                 if self.tracer is not None else _tm.NOOP_SPAN)
+        try:
+            self._flush_memtables_traced(mems, wal_number, cf_id)
+        finally:
+            _root.finish()
+
+    def _flush_memtables_traced(self, mems: list[MemTable],
+                                wal_number: int | None, cf_id: int) -> None:
         t0 = time.time()
         if self._seqno_time_dirty:
             # Every flush path (auto-switch, write-path stall, bg worker)
@@ -1617,14 +1752,15 @@ class DB:
         if blob_num is not None:
             self._pending_outputs.add(blob_num)
         try:
-            meta = flush_memtable_to_table(
-                self.env, self.dbname, fnum, self.icmp, mems,
-                self.options.table_options_for_level(0),
-                creation_time=int(time.time()),
-                blob_file_number=blob_num,
-                min_blob_size=self.options.min_blob_size,
-                column_family=(cf_id, self.cf_name(cf_id)),
-            )
+            with _tm.span("flush.build_table", file_number=fnum):
+                meta = flush_memtable_to_table(
+                    self.env, self.dbname, fnum, self.icmp, mems,
+                    self.options.table_options_for_level(0),
+                    creation_time=int(time.time()),
+                    blob_file_number=blob_num,
+                    min_blob_size=self.options.min_blob_size,
+                    column_family=(cf_id, self.cf_name(cf_id)),
+                )
             from toplingdb_tpu.utils.kill_point import test_kill_random
 
             test_kill_random("FlushJob::AfterTableWrite")
@@ -1881,7 +2017,41 @@ class DB:
         value type, so plain binary values are never reinterpreted;
         Options.legacy_wide_column_unwrap re-enables the old magic-prefix
         sniff for databases written before the dedicated type existed."""
+        sched = self._trace_sched
+        if sched is not None:
+            m = sched()
+            if m:
+                return self._get_traced(key, opts, cf, m == 1)
         v, is_entity = self._get_impl_entry(key, opts, cf)
+        if v is not None:
+            if is_entity:
+                from toplingdb_tpu.db.wide_columns import default_column_of
+
+                return default_column_of(v)
+            if (v[:1] == b"\x00"
+                    and getattr(self.options, "legacy_wide_column_unwrap",
+                                False)):
+                from toplingdb_tpu.db.wide_columns import default_column_of
+
+                return default_column_of(v)
+        return v
+
+    def _get_traced(self, key: bytes, opts, cf, sampled: bool):
+        """The rare half of get(): sampled root span, or the slow-watch
+        backstop when trace_slow_usec is set (every get pays one
+        perf_counter pair in that mode)."""
+        tracer = self.tracer
+        root = tracer.start("db.get") if sampled else None
+        t0 = 0.0 if sampled else time.perf_counter()
+        try:
+            v, is_entity = self._get_impl_entry(key, opts, cf)
+        finally:
+            if root is not None:
+                root.finish()
+            else:
+                _us = (time.perf_counter() - t0) * 1e6
+                if _us >= tracer.slow_usec:
+                    tracer.note_slow("db.get", _us)
         if v is not None:
             if is_entity:
                 from toplingdb_tpu.db.wide_columns import default_column_of
@@ -2264,8 +2434,22 @@ class DB:
         if tr is not None:
             tr.record_multiget(keys)
         self._check_read_ts(opts)
-        t_mg = time.perf_counter() if self.stats is not None else 0.0
-        res = self._multi_get_impl(keys, opts, cf)
+        tracer = self.tracer
+        root = None
+        if tracer is not None and tracer.sample_every \
+                and next(tracer.counter) % tracer.sample_every == 0:
+            root = tracer.start("db.multiget", keys=len(keys))
+        t_mg = time.perf_counter() \
+            if (self.stats is not None or tracer is not None) else 0.0
+        try:
+            res = self._multi_get_impl(keys, opts, cf)
+        finally:
+            if root is not None:
+                root.finish()
+            elif tracer is not None and tracer.slow_usec:
+                _us = (time.perf_counter() - t_mg) * 1e6
+                if _us >= tracer.slow_usec:
+                    tracer.note_slow("db.multiget", _us, keys=len(keys))
         # Entities were already unwrapped per key by their typed fallback
         # resolution; the magic sniff survives only behind the legacy gate.
         if getattr(self.options, "legacy_wide_column_unwrap", False) \

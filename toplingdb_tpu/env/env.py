@@ -14,6 +14,7 @@ import os
 import threading
 import time
 
+from toplingdb_tpu.utils import statistics as _stats_mod
 from toplingdb_tpu.utils.status import IOError_, NotFound
 
 
@@ -390,15 +391,33 @@ class _PosixWritable(WritableFile):
         self._size = 0
 
     def append(self, data: bytes) -> None:
-        self._f.write(data)
+        # IOStatsContext twin of PerfContext (reference iostats_context.h):
+        # byte counts at perf_level >= 1, wall timings at >= 2 — level 0
+        # pays one module-attribute read.
+        lvl = _stats_mod.perf_level
+        if lvl >= 2:
+            t0 = time.perf_counter()
+            self._f.write(data)
+            ctx = _stats_mod.iostats_context()
+            ctx.write_nanos += int((time.perf_counter() - t0) * 1e9)
+            ctx.bytes_written += len(data)
+        else:
+            self._f.write(data)
+            if lvl:
+                _stats_mod.iostats_context().bytes_written += len(data)
         self._size += len(data)
 
     def flush(self) -> None:
         self._f.flush()
 
     def sync(self) -> None:
+        lvl = _stats_mod.perf_level
+        t0 = time.perf_counter() if lvl >= 2 else 0.0
         self._f.flush()
         os.fsync(self._f.fileno())
+        if lvl >= 2:
+            _stats_mod.iostats_context().fsync_nanos += int(
+                (time.perf_counter() - t0) * 1e9)
 
     def close(self) -> None:
         if not self._f.closed:
@@ -419,7 +438,18 @@ class _PosixRandomAccess(RandomAccessFile):
         self._size = os.fstat(self._f.fileno()).st_size
 
     def read(self, offset: int, n: int) -> bytes:
-        return os.pread(self._f.fileno(), n, offset)
+        lvl = _stats_mod.perf_level
+        if lvl >= 2:
+            t0 = time.perf_counter()
+            data = os.pread(self._f.fileno(), n, offset)
+            ctx = _stats_mod.iostats_context()
+            ctx.read_nanos += int((time.perf_counter() - t0) * 1e9)
+            ctx.bytes_read += len(data)
+            return data
+        data = os.pread(self._f.fileno(), n, offset)
+        if lvl:
+            _stats_mod.iostats_context().bytes_read += len(data)
+        return data
 
     def size(self) -> int:
         return self._size
@@ -439,7 +469,10 @@ class _PosixSequential(SequentialFile):
             raise IOError_(f"open {path}: {e}") from e
 
     def read(self, n: int) -> bytes:
-        return self._f.read(n)
+        data = self._f.read(n)
+        if _stats_mod.perf_level:
+            _stats_mod.iostats_context().bytes_read += len(data)
+        return data
 
     def close(self) -> None:
         if not self._f.closed:

@@ -10,6 +10,7 @@
 #include <algorithm>
 #include <array>
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
@@ -4502,7 +4503,9 @@ static int64_t gc_frame_merged(GcCursor& cur, int64_t total_len,
 // block_offset/log_number: the LogWriter's framing state (log_number >= 0
 //   selects the recyclable format stamped with that number; -1 = classic).
 // out[0]=framed bytes, out[1]=new block offset, out[2]=memtable byte delta,
-// out[3]=point-delete count, out[4]=merged (unframed) record length.
+// out[3]=point-delete count, out[4]=merged (unframed) record length,
+// out[5..7]=interior phase timings in ns (validate / WAL frame / memtable
+// insert) for the telemetry plane — the caller must size out >= 8.
 // Returns total counted records, or -2 (unsupported record: Python path),
 // -3 (wal_cap too small), -4 (corrupt image), -5 - i (protection mismatch
 // at group record index i).
@@ -4516,6 +4519,12 @@ int64_t tpulsm_wb_group_commit(void* mem, int32_t mem_kind,
   const uint64_t kKey = 0x9E3779B97F4A7C15ull, kVal = 0xC2B2AE3D27D4EB4Full,
                  kType = 0x165667B19E3779F9ull, kCf = 0x27D4EB2F165667C5ull;
   const uint64_t mask = prot_trunc_mask(pb);
+  auto gc_now_ns = []() -> int64_t {
+    return (int64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  };
+  const int64_t t_entry_ns = gc_now_ns();
   int64_t total = 0;
   if (mode & 4) {
     // Caller vouches (see above): counts come from the batch headers.
@@ -4576,6 +4585,7 @@ int64_t tpulsm_wb_group_commit(void* mem, int32_t mem_kind,
   }
   if ((mode & 4) == 0 && prots && (mode & 8) == 0 && total != n_prots)
     return -5 - total;
+  const int64_t t_validated_ns = gc_now_ns();
   int64_t merged_len = 12;
   for (int64_t b = 0; b < n_batches; b++) merged_len += lens[b] - 12;
   int64_t wal_len = 0, new_bo = block_offset;
@@ -4595,6 +4605,7 @@ int64_t tpulsm_wb_group_commit(void* mem, int32_t mem_kind,
                               wal_out, wal_cap, &new_bo);
     if (wal_len < 0) return wal_len;
   }
+  const int64_t t_framed_ns = gc_now_ns();
   int64_t delta = 0, deletes = 0;
   if (mode & 2) {
     SkipList* sl = mem_kind == 0 ? static_cast<SkipList*>(mem) : nullptr;
@@ -4713,6 +4724,9 @@ int64_t tpulsm_wb_group_commit(void* mem, int32_t mem_kind,
   out[2] = delta;
   out[3] = deletes;
   out[4] = merged_len;
+  out[5] = t_validated_ns - t_entry_ns;
+  out[6] = t_framed_ns - t_validated_ns;
+  out[7] = gc_now_ns() - t_framed_ns;
   return total;
 }
 

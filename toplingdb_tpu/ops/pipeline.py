@@ -43,6 +43,7 @@ import numpy as np
 
 from toplingdb_tpu import native
 from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.utils import telemetry
 from toplingdb_tpu.utils.status import Corruption, NotSupported
 
 
@@ -296,7 +297,8 @@ def _build_plan(readers):
     return kv, files, splitters
 
 
-def _scan_file(fi, fp, kv, prog, splitters, stats, stats_mu):
+def _scan_file(fi, fp, kv, prog, splitters, stats, stats_mu,
+               trace_handle=None):
     """Reader worker: decode one file shard-by-shard into its slice of the
     global buffers, publishing row bounds + progress per shard."""
     lib = native.lib()
@@ -308,6 +310,7 @@ def _scan_file(fi, fp, kv, prog, splitters, stats, stats_mu):
         for s in range(n_shards):
             if prog.stop:
                 return
+            t_sh = time.time() if trace_handle is not None else 0.0
             blo, bhi = fp.groups[s], fp.groups[s + 1]
             if bhi > blo:
                 w0 = int(fp.block_offs[blo])
@@ -359,6 +362,11 @@ def _scan_file(fi, fp, kv, prog, splitters, stats, stats_mu):
             if s == n_shards - 1 and (rows != fp.ne or k_used != fp.rk
                                       or v_used != fp.rv):
                 raise PipelineIneligible("scan totals disagree with props")
+            if trace_handle is not None and bhi > blo:
+                telemetry.span_event_under(
+                    trace_handle, "pipeline.scan",
+                    (time.time() - t_sh) * 1e6, file=fi, shard=s,
+                    blocks=bhi - blo)
             prog.mark(fi, s)
         with stats_mu:
             stats.prefetch_hits += fp.pf.hits
@@ -442,6 +450,8 @@ def _host_compute(kv, files, splitters, prog, outq, shared, snapshots,
         ranges = _shard_ranges(files, s)
         if not ranges:
             continue
+        _tsp = telemetry.span_under(shared.trace, "pipeline.merge_gc",
+                                    shard=s)
         soffs = np.concatenate(
             [kv.key_offs[lo:hi] for lo, hi in ranges]).astype(np.int64)
         slens = np.concatenate(
@@ -466,6 +476,7 @@ def _host_compute(kv, files, splitters, prog, outq, shared, snapshots,
         shared.trailer_override[zg] = shared.vtypes[zg].astype(np.int64)
         shared.seqs[zg] = 0
         shared.stats.host_compute_usec += int((time.time() - t0) * 1e6)
+        _tsp.finish()
         _put(outq, prog, og)
     _put(outq, prog, _DONE)
 
@@ -484,10 +495,13 @@ def _device_compute(kv, files, splitters, prog, outq, shared, snapshots,
     def finish_one(item):
         if item is None:
             return
-        ranges, lmap, pending = item
+        ranges, lmap, pending, s = item
         t0 = time.time()
         o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
-        shared.stats.device_wait_usec += int((time.time() - t0) * 1e6)
+        dwait = time.time() - t0
+        shared.stats.device_wait_usec += int(dwait * 1e6)
+        telemetry.span_event_under(shared.trace, "pipeline.merge_gc",
+                                   dwait * 1e6, shard=s, device=True)
         if hc:
             raise PipelineIneligible("complex groups present")
         og = lmap[o]
@@ -536,7 +550,7 @@ def _device_compute(kv, files, splitters, prog, outq, shared, snapshots,
                 bottommost,
             )
             shared.stats.transfer_time_usec += int((time.time() - t0) * 1e6)
-            pendings.append((ranges, _ranges_lmap(ranges), pending))
+            pendings.append((ranges, _ranges_lmap(ranges), pending, s))
         # keep one upload of lookahead in flight; finish older shards now
         while len(pendings) > 1:
             finish_one(pendings.pop(0))
@@ -547,9 +561,10 @@ def _device_compute(kv, files, splitters, prog, outq, shared, snapshots,
 
 class _Shared:
     """Arrays shared between compute and the writer (aliased per the
-    chunked-order contract of write_tables_columnar) plus the stats."""
+    chunked-order contract of write_tables_columnar) plus the stats and
+    the telemetry handle stage workers parent their spans under."""
 
-    __slots__ = ("trailer_override", "seqs", "vtypes", "stats")
+    __slots__ = ("trailer_override", "seqs", "vtypes", "stats", "trace")
 
 
 def run_pipelined(env, dbname, icmp, compaction, table_cache, table_options,
@@ -597,6 +612,10 @@ def run_pipelined(env, dbname, icmp, compaction, table_cache, table_options,
     shared.seqs = np.zeros(kv.n, dtype=np.uint64)
     shared.vtypes = np.zeros(kv.n, dtype=np.int32)
     shared.stats = stats
+    stats.pipelined = True
+    # The compaction root span lives on the ORCHESTRATING thread; stage
+    # workers parent their per-shard spans under this exported handle.
+    shared.trace = telemetry.current_handle()
 
     prog = _Progress(len(files))
     outq: Queue = Queue(maxsize=4)
@@ -606,7 +625,7 @@ def run_pipelined(env, dbname, icmp, compaction, table_cache, table_options,
     rthreads = [
         threading.Thread(target=_scan_file, daemon=True,
                          args=(fi, fp, kv, prog, splitters, stats,
-                               stats_mu))
+                               stats_mu, shared.trace))
         for fi, fp in enumerate(files)
     ]
     from toplingdb_tpu.ops.device_compaction import _host_sort
@@ -622,14 +641,25 @@ def run_pipelined(env, dbname, icmp, compaction, table_cache, table_options,
     cthread.start()
 
     def chunk_stream():
+        chunk = 0
+        t_resumed = None  # when the writer got control back after a yield
         while True:
             t0 = time.time()
+            if t_resumed is not None:
+                # Time since the previous chunk was handed over = that
+                # chunk's encode+write stage (the writer consumed it
+                # before asking for the next one).
+                telemetry.span_event_under(
+                    shared.trace, "pipeline.encode_write",
+                    (t0 - t_resumed) * 1e6, chunk=chunk)
+                chunk += 1
             item = outq.get()
             stats.pipeline_stall_usec += int((time.time() - t0) * 1e6)
             if item is _DONE:
                 return
             if isinstance(item, _Err):
                 raise item.exc
+            t_resumed = time.time()
             yield item
 
     t_wr = time.time()

@@ -50,6 +50,7 @@ from toplingdb_tpu import native
 from toplingdb_tpu.db import dbformat
 from toplingdb_tpu.db.dbformat import ValueType
 from toplingdb_tpu.table.prefetch import FilePrefetchBuffer
+from toplingdb_tpu.utils import statistics as _stats_mod
 
 
 class PlaneIneligible(Exception):
@@ -499,6 +500,14 @@ class _SSTSource:
             # Codec/corruption/capacity shapes the plane doesn't cover:
             # the per-entry path re-reads and raises the canonical error.
             raise PlaneIneligible(f"native scan rc={rc}")
+        if _stats_mod.perf_level:
+            # PerfContext parity with the per-entry path: every data block
+            # this window decoded counts once, bytes at on-disk block size
+            # (== decoded size for the uncompressed blocks the plane
+            # serves natively).
+            _pctx = _stats_mod.perf_context()
+            _pctx.block_read_count += b1 - b0
+            _pctx.block_read_byte += span
         self._bi = b1
         if rc == 0:
             return

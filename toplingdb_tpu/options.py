@@ -131,6 +131,23 @@ class Options:
     # Periodic ticker snapshots for DB.get_stats_history (reference
     # stats_persist_period_sec; 0 = manual persist_stats() only).
     stats_persist_period_sec: int = 0
+    # Periodic stats DUMP (reference stats_dump_period_sec): snapshots the
+    # tickers into the stats-history ring AND logs a compact `stats_dump`
+    # line through the event log every N seconds. Served over HTTP at
+    # /stats_history/<name>?window=S. 0 = off.
+    stats_dump_period_sec: int = 0
+    # Request-scoped span tracing (utils/telemetry.py): sample one DB
+    # operation in N as a full span tree (1 = every op, 0 = off). Rare
+    # high-value ops (flush, compaction) are always traced while a tracer
+    # exists. Finished traces land in a bounded ring served at
+    # /traces/<name>; remote spans (dcompact workers, replication
+    # followers) stitch into the same trace.
+    trace_sample_every: int = 0
+    # Always-sample latency backstop: an op slower than this many µs
+    # leaves a (root-only) trace even when the sampler skipped it. 0 = off.
+    trace_slow_usec: int = 0
+    # Bound on retained finished traces (and the remote-stitch index).
+    trace_ring: int = 256
     # Sampling cadence of the seqno↔time mapping (reference
     # seqno_to_time_mapping recording period).
     seqno_time_sample_period_sec: int = 60

@@ -67,6 +67,10 @@ class FollowerDB(SecondaryDB):
         db._tail_stop = threading.Event()
         db._tail_thread = None
         db.tail_errors = 0
+        # Telemetry: finished apply-span dicts awaiting the next pull (the
+        # ship-frame ack channel). Fire-and-forget and bounded — a dead
+        # primary or a dropped pull must neither error nor leak.
+        db._span_outbox = []
         db.versions.recover(readonly=True)
         db._compaction_scheduler = None
         if mode == "shared":
@@ -171,8 +175,15 @@ class FollowerDB(SecondaryDB):
             self._applied_seq = self.versions.last_sequence
             self._epoch = self._local_epoch()
             return 0
+        outbox = None
+        if self._span_outbox:
+            # Hand the pending apply spans to this pull (the ack). The
+            # outbox clears regardless of outcome: a dropped exchange
+            # degrades the primary's trace to primary-only, nothing leaks.
+            outbox, self._span_outbox = self._span_outbox, []
         try:
-            frames, state = tr.pull(self._applied_seq, max_bytes=max_bytes)
+            frames, state = tr.pull(self._applied_seq, max_bytes=max_bytes,
+                                    span_export=outbox)
         except Corruption:
             # Truncated/bitflipped frame: nothing applied; re-pull later.
             if self.stats is not None:
@@ -193,7 +204,11 @@ class FollowerDB(SecondaryDB):
             self._epoch = epoch
             return 0  # re-pull from the retention head next round
         self._epoch = epoch
+        t_ap = time.monotonic()
         applied = self._apply_frames(frames)
+        if applied:
+            self._bank_apply_spans(state.get("trace_ctxs"),
+                                   (time.monotonic() - t_ap) * 1e6)
         if self._applied_seq is None and state.get("wal_floor_seq") is None:
             # From-head pull and the primary retains NO WAL records: every
             # published sequence is durable in the SSTs our MANIFEST view
@@ -201,6 +216,30 @@ class FollowerDB(SecondaryDB):
             self._applied_seq = state.get(
                 "last_sequence", self.versions.last_sequence)
         return applied
+
+    def _bank_apply_spans(self, ctxs, dur_us: float) -> None:
+        """Record one finished `follower.apply` span per propagated write
+        context this round actually covered; they ride the NEXT pull back
+        to the primary and stitch into the write's trace."""
+        if not ctxs:
+            return
+        aseq = self.applied_sequence()
+        for c in ctxs:
+            if not c.get("trace_id") or c.get("seq", 0) > aseq:
+                continue
+            self._span_outbox.append({
+                "name": "follower.apply",
+                "trace_id": c["trace_id"],
+                "parent_id": c.get("span_id", 0),
+                "span_id": 0,
+                "start_us": 0,
+                "dur_us": int(dur_us),
+                "proc": "follower",
+                "tags": {"seq": c.get("seq"), "mode": self._mode,
+                         "db": self.dbname},
+            })
+        if len(self._span_outbox) > 256:
+            del self._span_outbox[: len(self._span_outbox) - 256]
 
     def _apply_frames(self, frames) -> int:
         applied = 0

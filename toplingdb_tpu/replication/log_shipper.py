@@ -270,7 +270,25 @@ class LogShipper:
                         stats_mod.REPLICATION_FRAMES_SHIPPED, len(frames))
                     self.stats.record_tick(
                         stats_mod.REPLICATION_BYTES_SHIPPED, total)
+                tracer = getattr(self.db, "tracer", None)
+                if tracer is not None:
+                    # Telemetry propagation: contexts of sampled writes
+                    # covered by these frames ride the pull state, so the
+                    # follower can record its apply spans under them.
+                    ctxs = tracer.ctxs_in_range(frames[0].first_seq,
+                                                frames[-1].last_seq)
+                    if ctxs:
+                        state["trace_ctxs"] = ctxs
             return frames, state
+
+    def accept_spans(self, spans) -> int:
+        """Follower-ack half of the telemetry plane: finished follower
+        span dicts arriving with a later pull stitch into the primary's
+        originating traces. Unknown/evicted trace ids drop silently."""
+        tracer = getattr(self.db, "tracer", None)
+        if tracer is None or not spans:
+            return 0
+        return tracer.attach_remote(spans)
 
     def status(self) -> dict:
         return {
@@ -290,10 +308,13 @@ class LogShipper:
 
 
 class ReplicationTransport:
-    """Follower-side view of a primary: pull frames, ask for checkpoints."""
+    """Follower-side view of a primary: pull frames, ask for checkpoints.
+    `span_export` carries the follower's finished telemetry spans back to
+    the primary piggybacked on the pull (the ship-frame ack channel) —
+    fire-and-forget: a dropped pull drops the spans with it."""
 
-    def pull(self, since_seq: int | None,
-             max_bytes: int = 1 << 22) -> tuple[list[ShipFrame], dict]:
+    def pull(self, since_seq: int | None, max_bytes: int = 1 << 22,
+             span_export=None) -> tuple[list[ShipFrame], dict]:
         raise NotImplementedError
 
     def request_checkpoint(self, dest: str) -> str:
@@ -306,7 +327,9 @@ class LocalTransport(ReplicationTransport):
     def __init__(self, shipper: LogShipper):
         self.shipper = shipper
 
-    def pull(self, since_seq, max_bytes: int = 1 << 22):
+    def pull(self, since_seq, max_bytes: int = 1 << 22, span_export=None):
+        if span_export:
+            self.shipper.accept_spans(span_export)
         return self.shipper.frames_since(since_seq, max_bytes=max_bytes)
 
     def request_checkpoint(self, dest: str) -> str:
@@ -347,10 +370,11 @@ class HttpTransport(ReplicationTransport):
                 f"replication POST {path} to {self.url} failed: {e}"
             ) from e
 
-    def pull(self, since_seq, max_bytes: int = 1 << 22):
-        body = self._post("/replication/pull", {
-            "since_seq": since_seq, "max_bytes": max_bytes,
-        })
+    def pull(self, since_seq, max_bytes: int = 1 << 22, span_export=None):
+        req = {"since_seq": since_seq, "max_bytes": max_bytes}
+        if span_export:
+            req["spans"] = span_export
+        body = self._post("/replication/pull", req)
         frames = [ShipFrame.decode(base64.b64decode(f))
                   for f in body.get("frames_b64", [])]
         return frames, body.get("state", {})
@@ -371,11 +395,16 @@ class FaultyTransport(ReplicationTransport):
         self.inner = inner
         self.injector = injector
 
-    def pull(self, since_seq, max_bytes: int = 1 << 22):
+    def pull(self, since_seq, max_bytes: int = 1 << 22, span_export=None):
         plan = self.injector.plan()
         if plan == "delay":
             time.sleep(self.injector.delay_sec)
-        frames, state = self.inner.pull(since_seq, max_bytes=max_bytes)
+        if plan == "drop":
+            # The whole exchange is lost — the ack's span export with it
+            # (the primary keeps a primary-only trace; no error, no leak).
+            span_export = None
+        frames, state = self.inner.pull(since_seq, max_bytes=max_bytes,
+                                        span_export=span_export)
         if plan == "drop":
             return [], state
         if plan == "truncate" and frames:
@@ -437,6 +466,8 @@ class ReplicationServer:
                     return
                 try:
                     if self.path == "/replication/pull":
+                        if req.get("spans"):
+                            srv.shipper.accept_spans(req["spans"])
                         frames, state = srv.shipper.frames_since(
                             req.get("since_seq"),
                             max_bytes=int(req.get("max_bytes", 1 << 22)))

@@ -1,0 +1,163 @@
+"""Telemetry name lint (tier-1 CI): keeps metric and span names from
+silently forking.
+
+Two invariants over the whole `toplingdb_tpu/` tree:
+
+  1. Every ticker/histogram name passed to `record_tick` /
+     `record_ticks` / `record_in_histogram` / `get_ticker_count` /
+     `get_histogram` — whether as a string literal or as an attribute of a
+     `utils.statistics` alias (`st.FOO`, `_st.FOO`, `stats_mod.FOO`, ...)
+     — must be DECLARED in utils/statistics.py.
+  2. Every span name passed as a string literal to the telemetry span
+     factories (`span`, `span_under`, `span_event`, `span_event_under`,
+     `start`, `start_from`, `maybe_sample`, `note_slow`) must appear in
+     ARCHITECTURE.md's Telemetry span table.
+
+Run: python -m toplingdb_tpu.tools.check_telemetry [repo_root]
+Exit 0 clean; 1 with one violation per line otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+TICKER_FNS = {"record_tick", "record_in_histogram", "get_ticker_count",
+              "get_histogram"}
+SPAN_FNS = {"span", "span_under", "span_event", "span_event_under",
+            "start", "start_from", "maybe_sample", "note_slow"}
+# Module aliases under which utils.statistics name constants are accessed.
+STAT_ALIASES = {"st", "_st", "stats_mod", "_stats_mod", "statistics",
+                "stats"}
+
+
+def declared_stat_names() -> tuple[set[str], set[str]]:
+    """(name VALUES, CONSTANT attribute names) declared in statistics.py."""
+    from toplingdb_tpu.utils import statistics as mod
+
+    values, attrs = set(), set()
+    for attr in dir(mod):
+        if attr.isupper() and isinstance(getattr(mod, attr), str):
+            attrs.add(attr)
+            values.add(getattr(mod, attr))
+    return values, attrs
+
+
+def span_names_in_architecture(repo_root: str) -> set[str]:
+    """Span names listed in ARCHITECTURE.md's Telemetry section (every
+    `backtick-quoted` token in that section counts as declared)."""
+    import re
+
+    path = os.path.join(repo_root, "ARCHITECTURE.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return set()
+    lower = text.lower()
+    start = lower.find("telemetry")
+    if start < 0:
+        return set()
+    # Section runs until the next top/second-level heading after it.
+    end = len(text)
+    for m in re.finditer(r"\n#{1,3} ", text[start:]):
+        end = start + m.start()
+        break
+    return set(re.findall(r"`([a-z0-9_.]+)`", text[start:end]))
+
+
+def _call_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _first_str_arg(node: ast.Call) -> str | None:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def check_file(path: str, stat_values: set[str], stat_attrs: set[str],
+               span_names: set[str]) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}: syntax error: {e}"]
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in TICKER_FNS:
+            lit = _first_str_arg(node)
+            if lit is not None and lit not in stat_values:
+                out.append(
+                    f"{path}:{node.lineno}: ticker/histogram name {lit!r} "
+                    f"is not declared in utils/statistics.py")
+            a0 = node.args[0] if node.args else None
+            if (isinstance(a0, ast.Attribute)
+                    and isinstance(a0.value, ast.Name)
+                    and a0.value.id in STAT_ALIASES
+                    and a0.attr.isupper()
+                    and a0.attr not in stat_attrs):
+                out.append(
+                    f"{path}:{node.lineno}: statistics constant "
+                    f"{a0.value.id}.{a0.attr} does not exist")
+        if name in SPAN_FNS:
+            lit = _first_str_arg(node)
+            if name in ("span_under", "span_event_under", "start_from"):
+                # First positional is the parent handle / context; the
+                # span name is the second positional.
+                lit = None
+                if len(node.args) > 1 and isinstance(node.args[1],
+                                                     ast.Constant) \
+                        and isinstance(node.args[1].value, str):
+                    lit = node.args[1].value
+            if lit is not None and "." in lit and lit not in span_names:
+                out.append(
+                    f"{path}:{node.lineno}: span name {lit!r} is not in "
+                    f"ARCHITECTURE.md's Telemetry span table")
+    return out
+
+
+def run(repo_root: str | None = None) -> list[str]:
+    repo_root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pkg = os.path.join(repo_root, "toplingdb_tpu")
+    stat_values, stat_attrs = declared_stat_names()
+    span_names = span_names_in_architecture(repo_root)
+    skip = {os.path.abspath(__file__)}
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if os.path.abspath(path) in skip:
+                continue
+            violations.extend(
+                check_file(path, stat_values, stat_attrs, span_names))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = run(root)
+    for v in violations:
+        print(v)
+    print(f"check_telemetry: {len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ Commands:
   get KEY | put KEY VALUE | delete KEY | scan [--from=K] [--to=K] [--limit=N]
   batchput K1 V1 K2 V2 ... | deleterange BEGIN END
   manifest_dump | wal_dump WALFILE | list_files | checkpoint DEST
+  dump_events [--since=UNIX_SECONDS | --since=-SECONDS_AGO]
   repair | ingest_extern_sst FILE | approxsize --from=K --to=K
   verify_checksum | verify_file_checksums | scrub [--report] [--deep]
   list_column_families | compact [--from --to]
@@ -34,6 +35,9 @@ def main(argv=None) -> int:
                     help="scrub: print the full JSON pass report")
     ap.add_argument("--deep", action="store_true",
                     help="scrub: also re-verify every block + blob record")
+    ap.add_argument("--since", type=float, default=None,
+                    help="dump_events: unix seconds floor (negative = "
+                         "that many seconds before now)")
     args = ap.parse_args(argv)
 
     def enc(s: str) -> bytes:
@@ -51,6 +55,8 @@ def main(argv=None) -> int:
         report = repair_db(args.db)
         print(report)
         return 0
+    if cmd == "dump_events":
+        return _dump_events(args.db, args.since)
     if cmd == "manifest_dump":
         return _manifest_dump(args.db)
     if cmd == "wal_dump":
@@ -199,6 +205,43 @@ def main(argv=None) -> int:
             return 2
     finally:
         db.close()
+    return 0
+
+
+def _dump_events(dbname: str, since: float | None) -> int:
+    """Print the structured event-log stream (the EventLogger JSONL lines
+    the DB writes to <db>/LOG; the rolled LOG.old is read first so output
+    stays chronological). `since` filters on time_micros; a negative value
+    means that many seconds before now. Does NOT open the DB — DB.open
+    would roll the very LOG being dumped."""
+    import json as _json
+    import time as _time
+
+    from toplingdb_tpu.env import default_env
+
+    env = default_env()
+    floor_us = None
+    if since is not None:
+        base = _time.time() + since if since < 0 else since
+        floor_us = int(base * 1e6)
+    n = 0
+    for fname in ("LOG.old", "LOG"):
+        path = f"{dbname}/{fname}"
+        if not env.file_exists(path):
+            continue
+        for line in env.read_file(path).decode(errors="replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = _json.loads(line)
+            except ValueError:
+                continue  # non-JSON noise must not kill the dump
+            if floor_us is not None and rec.get("time_micros", 0) < floor_us:
+                continue
+            print(line)
+            n += 1
+    print(f"# {n} events", flush=True)
     return 0
 
 

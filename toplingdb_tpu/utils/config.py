@@ -132,7 +132,9 @@ _SIMPLE_OPTION_KEYS = {
     "full_history_ts_low",
     "enable_blob_files", "min_blob_size",
     "enable_blob_garbage_collection", "blob_garbage_collection_age_cutoff",
-    "stats_persist_period_sec", "seqno_time_sample_period_sec",
+    "stats_persist_period_sec", "stats_dump_period_sec",
+    "trace_sample_every", "trace_slow_usec", "trace_ring",
+    "seqno_time_sample_period_sec",
     "read_only", "memtable_rep", "db_write_buffer_size",
     "allow_concurrent_memtable_write", "enable_pipelined_write",
     "unordered_write", "preclude_last_level_data_seconds",
@@ -307,6 +309,82 @@ def load_latest_options(dbname: str, env=None):
     return options_from_config(_json.loads(data.decode()))
 
 
+_BREAKER_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def _prometheus_gauges(name: str, db) -> str:
+    """Point-in-time gauges beside the ticker/histogram exposition:
+    memtable bytes, per-level file counts/bytes, async-WAL ring depth,
+    replication status numbers, dcompact breaker states, and tracer ring
+    occupancy. Best-effort: a half-closed DB yields what it can."""
+    lines = []
+    lab = f'{{db="{name}"}}'
+
+    def g(metric, value, labels=None):
+        m = f"tpulsm_{metric}"
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m}{labels or lab} {value}")
+
+    try:
+        cfs = getattr(db, "_cfs", None)
+        if cfs:
+            g("memtable_bytes", sum(
+                c.mem.approximate_memory_usage()
+                + sum(m.approximate_memory_usage() for m in c.imm)
+                for c in cfs.values()))
+            g("immutable_memtables", sum(len(c.imm) for c in cfs.values()))
+    except Exception:
+        pass
+    try:
+        v = db.versions.current
+        for lvl in range(v.num_levels):
+            files = v.files[lvl]
+            if files:
+                ll = f'{{db="{name}",level="{lvl}"}}'
+                g("level_files", len(files), ll)
+                g("level_bytes", sum(f.file_size for f in files), ll)
+        g("last_sequence", db.versions.last_sequence)
+    except Exception:
+        pass
+    try:
+        ring = getattr(db, "_wal_ring", None)
+        if ring is not None:
+            g("async_wal_ring_depth", len(ring._q))
+    except Exception:
+        pass
+    try:
+        provider = getattr(db, "_repl_status_provider", None)
+        if provider is not None:
+            for k, val in provider().items():
+                if isinstance(val, bool) or not isinstance(val,
+                                                           (int, float)):
+                    continue
+                g(f"replication_{k}", val)
+    except Exception:
+        pass
+    try:
+        health = getattr(
+            getattr(db.options, "compaction_executor_factory", None),
+            "health", None)
+        breakers = getattr(health, "_breakers", None)
+        if breakers:
+            for url, b in sorted(breakers.items()):
+                ul = f'{{db="{name}",url="{url}"}}'
+                g("dcompaction_breaker_state",
+                  _BREAKER_STATE_NUM.get(b.state, -1), ul)
+    except Exception:
+        pass
+    try:
+        tracer = getattr(db, "tracer", None)
+        if tracer is not None:
+            st = tracer.status()
+            g("trace_ring_retained", st["traces_retained"])
+            g("traces_started_total", st["traces_started"])
+    except Exception:
+        pass
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 class SidePluginRepo:
     """Open DBs from one JSON document; serve introspection over HTTP
     (reference java SidePluginRepo + rockside WebView)."""
@@ -383,7 +461,12 @@ class SidePluginRepo:
                 self.wfile.write(data)
 
             def do_GET(self):
-                parts = [p for p in self.path.split("/") if p]
+                from urllib.parse import parse_qs, urlsplit
+
+                split = urlsplit(self.path)
+                query = {k: v[-1] for k, v in
+                         parse_qs(split.query).items()}
+                parts = [p for p in split.path.split("/") if p]
                 if parts and parts[0] == "view":
                     # The rockside WebView role: a human-readable HTML
                     # dashboard over the same introspection routes.
@@ -407,6 +490,7 @@ class SidePluginRepo:
                             if db.stats is not None:
                                 out.append(db.stats.to_prometheus(
                                     labels=f'db="{name}"'))
+                            out.append(_prometheus_gauges(name, db))
                         data = "".join(out).encode()
                         self.send_response(200)
                         self.send_header("Content-Type",
@@ -418,7 +502,7 @@ class SidePluginRepo:
                         self._send_json(500, {"error": repr(e)})
                     return
                 try:
-                    body = repo._route(parts)
+                    body = repo._route(parts, query)
                     code = 200 if body is not None else 404
                     body = body if body is not None else {"error": "not found"}
                 except Exception as e:  # introspection must not crash
@@ -481,12 +565,15 @@ class SidePluginRepo:
 
         if not name:
             rows = "".join(
-                f'<li><a href="/view/{esc(n)}">{esc(n)}</a></li>'
+                f'<li><a href="/view/{esc(n)}">{esc(n)}</a> '
+                f'(<a href="/view/traces/{esc(n)}">traces</a>)</li>'
                 for n in sorted(self._dbs))
             return (f"<html><head><title>toplingdb_tpu</title></head>"
                     f"<body><h1>toplingdb_tpu repo</h1><ul>{rows}</ul>"
                     f'<p><a href="/metrics">/metrics</a> (Prometheus) · '
                     f'<a href="/dbs">/dbs</a> (JSON)</p></body></html>')
+        if name.startswith("traces/"):
+            return self._render_traces_view(name[len("traces/"):])
         db = self._dbs.get(name)
         if db is None:
             return None
@@ -521,10 +608,107 @@ class SidePluginRepo:
             f'<input type="submit" value="Apply"></form>'
             f'<p><a href="/view">&larr; all dbs</a></p></body></html>')
 
-    def _route(self, parts: list[str]):
+    def _render_traces_view(self, name: str):
+        """Waterfall rendering of recent traces (slow first): one block per
+        trace, one proportional bar per span, remote spans tinted — the
+        human half of the /traces JSON routes."""
+        import html as _html
+
+        db = self._dbs.get(name)
+        tracer = getattr(db, "tracer", None) if db is not None else None
+        if db is None or tracer is None:
+            return None
+
+        def esc(x):
+            return _html.escape(str(x))
+
+        blocks = []
+        traces = tracer.finished(limit=32)
+        traces.sort(key=lambda t: (not t.slow, -t.dur_us))
+        for t in traces:
+            total = max(1, t.dur_us,
+                        max((s.start_us + s.dur_us for s in t.spans),
+                            default=1))
+            bars = []
+            for s in t.spans:
+                left = 100.0 * s.start_us / total
+                width = max(0.5, 100.0 * max(1, s.dur_us) / total)
+                color = "#4a90d9" if s.proc == tracer.proc else "#d98a4a"
+                label = (f"{esc(s.name)} [{esc(s.proc)}] "
+                         f"{s.dur_us}µs {esc(s.tags) if s.tags else ''}")
+                bars.append(
+                    f'<div style="position:relative;height:14px;'
+                    f'margin:1px 0;font-size:10px">'
+                    f'<div title="{label}" style="position:absolute;'
+                    f'left:{left:.2f}%;width:{width:.2f}%;height:12px;'
+                    f'background:{color}"></div>'
+                    f'<span style="position:absolute;left:0">{esc(s.name)}'
+                    f'</span></div>')
+            slow = " ⚠ slow" if t.slow else ""
+            blocks.append(
+                f'<div style="border:1px solid #ccc;margin:6px;padding:4px">'
+                f'<b>{esc(t.name)}</b>{slow} — {t.dur_us}µs, '
+                f'{len(t.spans)} spans, procs={esc(",".join(sorted({s.proc for s in t.spans})))} '
+                f'(<a href="/traces/{esc(name)}/{esc(t.trace_id)}">json</a>)'
+                f'{"".join(bars)}</div>')
+        st = tracer.status()
+        return (
+            f"<html><head><title>traces: {esc(name)}</title></head><body>"
+            f"<h1>traces: {esc(name)}</h1>"
+            f"<p>sample 1-in-{st['sample_every'] or '∞'}, "
+            f"slow ≥ {st['slow_usec']}µs, "
+            f"{st['traces_retained']} retained / "
+            f"{st['traces_started']} started</p>"
+            f'{"".join(blocks) or "<p>no finished traces yet</p>"}'
+            f'<p><a href="/view/{esc(name)}">&larr; {esc(name)}</a>'
+            f"</p></body></html>")
+
+    def _route(self, parts: list[str], query: dict | None = None):
+        query = query or {}
         if not parts or parts == ["dbs"]:
             return {"dbs": sorted(self._dbs)}
         kind, name = parts[0], "/".join(parts[1:])
+        if kind == "traces":
+            # /traces/<name> (recent traces; ?slow=1 filters),
+            # /traces/<name>/<trace_id> (one trace as Chrome trace JSON).
+            trace_id = None
+            if len(parts) >= 3:
+                name, trace_id = "/".join(parts[1:-1]), parts[-1]
+                if self._dbs.get(name) is None:
+                    name, trace_id = "/".join(parts[1:]), None
+            db = self._dbs.get(name)
+            tracer = getattr(db, "tracer", None) if db is not None else None
+            if db is None or tracer is None:
+                return None
+            if trace_id is not None:
+                return tracer.chrome_trace(trace_id)
+            slow_only = query.get("slow") in ("1", "true")
+            return {
+                "tracer": tracer.status(),
+                "traces": [t.summary()
+                           for t in tracer.finished(slow_only=slow_only)],
+            }
+        if kind == "stats_history":
+            # /stats_history/<name>?window=SECONDS (0/absent = everything
+            # retained in the ring).
+            db = self._dbs.get(name)
+            if db is None or getattr(db, "stats_history", None) is None:
+                return None
+            import time as _time
+
+            start = 0
+            try:
+                window = int(query.get("window", 0))
+            except ValueError:
+                window = 0
+            if window > 0:
+                start = int(_time.time()) - window
+            samples = db.stats_history.get(start_time=start)
+            return {
+                "window_sec": window or None,
+                "n_samples": len(samples),
+                "samples": [{"ts": ts, "tickers": d} for ts, d in samples],
+            }
         db = self._dbs.get(name)
         if db is None:
             return None
@@ -640,5 +824,7 @@ class SidePluginRepo:
         opts.read_only = False
         new_db = DB.open(path, opts, env=db.env)
         self._dbs[name] = new_db
+        new_db.event_logger.log("promote_finished", name=name, path=path,
+                                last_sequence=new_db.versions.last_sequence)
         return 200, {"promoted": name, "path": path,
                      "last_sequence": new_db.versions.last_sequence}

@@ -137,6 +137,14 @@ class EventLogger:
 
     def log(self, event: str, **payload) -> str:
         rec = {"time_micros": int(time.time() * 1e6), "event": event}
+        # Telemetry correlation: lifecycle events emitted inside a traced
+        # operation carry its trace_id, so `ldb dump_events` lines join
+        # against /traces waterfalls.
+        from toplingdb_tpu.utils import telemetry as _tm
+
+        tid = _tm.current_trace_id()
+        if tid is not None:
+            rec["trace_id"] = tid
         rec.update(payload)
         line = json.dumps(rec)
         if self._sink is not None:

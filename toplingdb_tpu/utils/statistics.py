@@ -222,30 +222,6 @@ class Histogram:
                 return float(1 << b)
         return float(self.max)
 
-    def to_prometheus(self, prefix: str = "tpulsm",
-                      labels: str = "") -> str:
-        """Prometheus text exposition of every ticker (counter) and
-        histogram (count/sum + p50/p99 gauges) — the rockside WebView /
-        Prometheus-metrics role (reference README.md:9-10)."""
-        lab = "{" + labels + "}" if labels else ""
-        lines = []
-        with self._lock:
-            tickers = sorted(self._tickers.items())
-            hists = sorted(self._histograms.items())
-        for k, v in tickers:
-            m = f"{prefix}_{k.replace('.', '_')}"
-            lines.append(f"# TYPE {m} counter")
-            lines.append(f"{m}{lab} {v}")
-        for k, h in hists:
-            m = f"{prefix}_{k.replace('.', '_')}"
-            lines.append(f"# TYPE {m} summary")
-            lines.append(f"{m}_count{lab} {h.count}")
-            lines.append(f"{m}_sum{lab} {h.sum}")
-            for q, val in ((0.5, h.percentile(50)), (0.99, h.percentile(99))):
-                ql = (labels + "," if labels else "") + f'quantile="{q}"'
-                lines.append(f"{m}{{{ql}}} {val}")
-        return "\n".join(lines) + "\n"
-
     def to_string(self) -> str:
         return (
             f"count={self.count} avg={self.average:.1f} "
@@ -486,12 +462,18 @@ class IOStatsContext:
     def __init__(self):
         self.reset()
 
+    _FIELDS = ("bytes_written", "bytes_read", "write_nanos", "read_nanos",
+               "fsync_nanos")
+
     def reset(self) -> None:
         self.bytes_written = 0
         self.bytes_read = 0
         self.write_nanos = 0
         self.read_nanos = 0
         self.fsync_nanos = 0
+
+    def to_dict(self) -> dict:
+        return {f: getattr(self, f) for f in self._FIELDS}
 
 
 _iostats_tls = threading.local()

@@ -63,11 +63,15 @@ class StatsHistory:
 
 class StatsDumpScheduler:
     """Periodic snapshot thread (reference stats_persist_period_sec /
-    the periodic task scheduler). Daemonized; stop() joins."""
+    stats_dump_period_sec via the periodic task scheduler). Daemonized;
+    stop() joins. `on_snapshot` (optional) fires after each snapshot —
+    the DB hooks its event-log stats_dump line there."""
 
-    def __init__(self, history: StatsHistory, period_sec: float):
+    def __init__(self, history: StatsHistory, period_sec: float,
+                 on_snapshot=None):
         self._history = history
         self._period = period_sec
+        self._on_snapshot = on_snapshot
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -75,6 +79,11 @@ class StatsDumpScheduler:
     def _run(self) -> None:
         while not self._stop.wait(self._period):
             self._history.snapshot()
+            if self._on_snapshot is not None:
+                try:
+                    self._on_snapshot()
+                except Exception:
+                    pass  # a dump-line failure must not kill the sampler
 
     def stop(self) -> None:
         self._stop.set()
