@@ -364,6 +364,12 @@ class DB:
         # alive_log_files scoping).
         self._recyclable_written: set[int] = set()
         self._closed = False
+        # Write-stall accounting surfaced by write_stall_state() (the
+        # sharding router's backpressure signal): cumulative counters are
+        # folded in by _maybe_stall_writes; the live state is derived from
+        # L0 vs the triggers at query time.
+        self._stall_totals = {"stalls": 0, "stall_micros": 0,
+                              "last_stall_micros": 0, "last_state": "none"}
         self._compaction_scheduler = None  # set by compaction module
         self._pending_outputs: set[int] = set()  # files being written by jobs
         self._bg_error: BaseException | None = None
@@ -2158,8 +2164,6 @@ class DB:
             return  # nothing can drain L0; stalling would only block
         n_l0 = self._max_l0_files()
         if n_l0 >= opts.level0_stop_writes_trigger:
-            from toplingdb_tpu.utils import statistics as st
-
             t0 = _time.monotonic()
             while (self._max_l0_files() >= opts.level0_stop_writes_trigger
                    and _time.monotonic() - t0 < timeout
@@ -2167,8 +2171,7 @@ class DB:
                 self._maybe_schedule_compaction()
                 _time.sleep(0.01)
             stalled = _time.monotonic() - t0
-            if self.stats is not None:
-                self.stats.record_tick(st.STALL_MICROS, int(stalled * 1e6))
+            self._account_stall("stopped", stalled)
             if stalled >= timeout:
                 self.event_logger.log(
                     "write_stall_timeout", l0_files=self._max_l0_files(),
@@ -2179,7 +2182,58 @@ class DB:
             span = max(1, opts.level0_stop_writes_trigger
                        - opts.level0_slowdown_writes_trigger)
             frac = (n_l0 - opts.level0_slowdown_writes_trigger + 1) / span
-            _time.sleep(min(0.05 * frac, 0.05))
+            delay = min(0.05 * frac, 0.05)
+            _time.sleep(delay)
+            self._account_stall("delayed", delay)
+
+    def _account_stall(self, state: str, stalled_s: float) -> None:
+        """Fold one stall episode into the cumulative totals + the
+        STALL_MICROS/WRITE_STALL_COUNT tickers and the write.stall.micros
+        histogram (previously only the stop path ticked, and only
+        STALL_MICROS — the delay ramp was invisible)."""
+        micros = int(stalled_s * 1e6)
+        tot = self._stall_totals
+        tot["stalls"] += 1
+        tot["stall_micros"] += micros
+        tot["last_stall_micros"] = micros
+        tot["last_state"] = state
+        if self.stats is not None:
+            from toplingdb_tpu.utils import statistics as st
+
+            self.stats.record_tick(st.STALL_MICROS, micros)
+            self.stats.record_tick(st.WRITE_STALL_COUNT)
+            self.stats.record_in_histogram(st.WRITE_STALL_MICROS_HIST,
+                                           micros)
+
+    def write_stall_state(self) -> dict:
+        """Queryable write-stall state (the sharding router's backpressure
+        signal, also exposed as /metrics gauges): the LIVE state derived
+        from L0 file counts vs the slowdown/stop triggers — "none",
+        "delayed", or "stopped" — plus cumulative stall totals. `drainable`
+        is False when nothing can reduce L0 (auto compaction off /
+        scheduler paused), in which case writes are never stalled either."""
+        opts = self.options
+        n_l0 = self._max_l0_files()
+        drainable = not (opts.disable_auto_compactions
+                         or self._compaction_scheduler is None
+                         or self._compaction_scheduler._paused)
+        if not drainable:
+            state = "none"
+        elif n_l0 >= opts.level0_stop_writes_trigger:
+            state = "stopped"
+        elif n_l0 >= opts.level0_slowdown_writes_trigger:
+            state = "delayed"
+        else:
+            state = "none"
+        out = dict(self._stall_totals)
+        out.update(
+            state=state,
+            l0_files=n_l0,
+            drainable=drainable,
+            slowdown_trigger=opts.level0_slowdown_writes_trigger,
+            stop_trigger=opts.level0_stop_writes_trigger,
+        )
+        return out
 
     def _check_read_ts(self, opts: ReadOptions) -> None:
         """Validate ReadOptions.timestamp against this DB (reference: reads

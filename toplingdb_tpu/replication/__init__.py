@@ -27,7 +27,11 @@ from toplingdb_tpu.replication.log_shipper import (
     ShipFrame,
     WalRetentionGone,
 )
-from toplingdb_tpu.replication.router import ReplicaRouter, RouterOptions
+from toplingdb_tpu.replication.router import (
+    ReplicaRouter,
+    RouterOptions,
+    StalenessToken,
+)
 
 __all__ = [
     "FaultyTransport",
@@ -39,5 +43,6 @@ __all__ = [
     "ReplicationServer",
     "RouterOptions",
     "ShipFrame",
+    "StalenessToken",
     "WalRetentionGone",
 ]

@@ -71,6 +71,7 @@ class FollowerDB(SecondaryDB):
         # ship-frame ack channel). Fire-and-forget and bounded — a dead
         # primary or a dropped pull must neither error nor leak.
         db._span_outbox = []
+        db._journal = None  # standalone-mode frame journal (local WAL)
         db.versions.recover(readonly=True)
         db._compaction_scheduler = None
         if mode == "shared":
@@ -79,9 +80,17 @@ class FollowerDB(SecondaryDB):
             db._epoch = db._local_epoch()
         else:
             # Checkpoint-restored: SSTs carry everything up to the
-            # checkpoint sequence; frames take it from there.
+            # checkpoint sequence; frames take it from there. The frame
+            # JOURNAL (a local WAL of every applied rep) makes applied
+            # frames durable in OUR directory: re-opens resume from it,
+            # and promote() → DB.open replays it — without it every frame
+            # applied after the checkpoint lived only in the memtable and
+            # silently vanished at promote (the migration-cutover
+            # data-loss hole the sharding chaos soak caught).
             db._materialize_cfs()
+            db._replay_wals_into_mem()  # prior journals, on re-open
             db._applied_seq = db.versions.last_sequence
+            db._open_frame_journal()
         db._repl_status_provider = db.replication_status
         return db
 
@@ -112,6 +121,7 @@ class FollowerDB(SecondaryDB):
         _rm_tree(self.env, ckpt)
         self._transport.request_checkpoint(ckpt)
         with self._mutex:
+            self._close_frame_journal(sync=False)  # wiped with the rest
             self.table_cache.close()
             for child in list(self.env.get_children(self.dbname)):
                 try:
@@ -136,6 +146,32 @@ class FollowerDB(SecondaryDB):
             self._materialize_cfs()
             self._applied_seq = vs.last_sequence
             self._epoch = None  # next state observation resets it
+            self._open_frame_journal()
+
+    # -- frame journal (standalone durability) ---------------------------
+
+    def _open_frame_journal(self) -> None:
+        """A fresh local WAL for applied frame reps (standalone mode owns
+        its directory, so writing one is safe — shared mode must never:
+        dbname is the PRIMARY's directory). Reps carry their original
+        sequence numbers, so DB recovery replays them verbatim."""
+        from toplingdb_tpu.db.log import LogWriter
+
+        num = self.versions.new_file_number()
+        self._journal = LogWriter(self.env.new_writable_file(
+            filename.log_file_name(self.dbname, num)))
+
+    def _close_frame_journal(self, sync: bool) -> None:
+        j = self._journal
+        self._journal = None
+        if j is None:
+            return
+        try:
+            if sync:
+                j.sync()
+            j.close()
+        except Exception:
+            pass  # a broken journal close must not block shutdown
 
     # -- epoch / version swap -------------------------------------------
 
@@ -264,6 +300,10 @@ class FollowerDB(SecondaryDB):
                 if self._applied_seq is not None \
                         and end <= self._applied_seq:
                     continue
+                if self._journal is not None:
+                    # Journal-first (WAL discipline): a crash between the
+                    # append and the insert replays the rep on re-open.
+                    self._journal.add_record(rep)
                 b.insert_into(mems)
                 # Publish order: entries first, then the watermark — a
                 # router read that saw applied>=token is guaranteed the
@@ -313,6 +353,7 @@ class FollowerDB(SecondaryDB):
 
     def close(self) -> None:
         self.stop_tailing()
+        self._close_frame_journal(sync=True)
         super().close()
 
     # -- admin ----------------------------------------------------------

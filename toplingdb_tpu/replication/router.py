@@ -40,19 +40,36 @@ class RouterOptions:
     breaker_reset_timeout: float = 5.0
 
 
+@dataclasses.dataclass(frozen=True)
+class StalenessToken:
+    """Read-your-writes token: the write's last published sequence plus the
+    epoch of the replica set that produced it. A token whose epoch no
+    longer matches the router's current epoch is REJECTED — the read is
+    re-routed to the primary — never silently served by a follower whose
+    applied watermark happens to satisfy the (now meaningless) sequence.
+    The sharding plane stamps shard epochs here, so a split/merge/migration
+    invalidates every outstanding token for the moved range cleanly."""
+
+    seq: int
+    epoch: int = 0
+
+
 class ReplicaRouter:
     """Fans reads across followers; writes go to the primary and return
     staleness tokens. Pass the token back into get/multi_get/new_iterator
-    for read-your-writes."""
+    for read-your-writes. `epoch_provider` (a callable returning the
+    replica set's current epoch) arms the StalenessToken epoch check; when
+    None, bare integer sequence tokens keep their original meaning."""
 
     def __init__(self, primary, followers=(), options: RouterOptions | None
-                 = None, statistics=None):
+                 = None, statistics=None, epoch_provider=None):
         self.primary = primary
         self.options = options or RouterOptions()
         self.stats = statistics if statistics is not None else primary.stats
         self._mu = threading.Lock()
         self._followers: list = list(followers)
         self._rr = 0
+        self._epoch_provider = epoch_provider
         self.health = WorkerHealthRegistry(DcompactOptions(
             breaker_failure_threshold=self.options.breaker_failure_threshold,
             breaker_reset_timeout=self.options.breaker_reset_timeout,
@@ -92,14 +109,30 @@ class ReplicaRouter:
     def latest_token(self) -> int:
         return self.primary.latest_sequence_number()
 
+    def current_epoch(self) -> int:
+        ep = self._epoch_provider
+        return int(ep()) if ep is not None else 0
+
+    def token(self, seq: int) -> StalenessToken:
+        """Epoch-stamp a write's returned sequence into a StalenessToken."""
+        return StalenessToken(seq=seq, epoch=self.current_epoch())
+
     # -- replica selection ----------------------------------------------
 
     def _tick(self, name, n=1):
         if self.stats is not None:
             self.stats.record_tick(name, n)
 
-    def _candidates(self, token: int | None):
-        """Breaker- and staleness-filtered followers, round-robin order."""
+    def _candidates(self, token):
+        """Breaker- and staleness-filtered followers, round-robin order.
+        `token` is an int sequence, a StalenessToken, or None. An
+        epoch-mismatched StalenessToken yields NO followers (the caller
+        then re-routes to the primary, which is never stale)."""
+        if isinstance(token, StalenessToken):
+            if token.epoch != self.current_epoch():
+                self._tick(stats_mod.ROUTER_EPOCH_REJECTS)
+                return
+            token = token.seq
         with self._mu:
             followers = list(self._followers)
             start = self._rr
@@ -126,7 +159,7 @@ class ReplicaRouter:
     # -- read path -------------------------------------------------------
 
     def get(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
-            cf=None, token: int | None = None):
+            cf=None, token=None):
         for f, label in self._candidates(token):
             try:
                 v = f.get(key, opts, cf=cf)
@@ -140,7 +173,7 @@ class ReplicaRouter:
         return self.primary.get(key, opts, cf=cf)
 
     def multi_get(self, keys, opts: ReadOptions = _DEFAULT_READ,
-                  cf=None, token: int | None = None):
+                  cf=None, token=None):
         for f, label in self._candidates(token):
             try:
                 out = f.multi_get(keys, opts, cf=cf)
@@ -154,7 +187,7 @@ class ReplicaRouter:
         return self.primary.multi_get(keys, opts, cf=cf)
 
     def new_iterator(self, opts: ReadOptions = _DEFAULT_READ,
-                     cf=None, token: int | None = None):
+                     cf=None, token=None):
         """An iterator over one token-eligible replica (an iterator is a
         point-in-time view, so it binds to a single DB). Creation errors
         trip the replica's breaker; the primary always serves as backstop."""

@@ -1,0 +1,73 @@
+"""Sharding plane: many primaries behind one front door.
+
+PR 4's replication plane scaled reads vertically (one primary, N
+followers); this package scales the write axis horizontally — the layer
+that turns a single DB into a fleet (ROADMAP item 3):
+
+  shard_map   versioned key-range → shard metadata: epoch-stamped,
+              JSON-persistable, gap/overlap-free by construction.
+  router      ShardRouter — the front door: routes by key range, composes
+              with replication.router.ReplicaRouter per shard (each shard
+              owns its follower set and read-your-writes tokens; tokens
+              carry the shard epoch so a split/merge/migration invalidates
+              them cleanly), write fences for topology changes, and
+              per-tenant admission control.
+  admission   token-bucket rate limits (utils/rate_limiter.py) + write-
+              stall shedding fed by DB.write_stall_state().
+  migration   live shard migration: checkpoint bootstrap → WAL-shipping
+              catch-up (the dual-write window) → fence/drain →
+              promote-style cutover with an epoch bump.
+  balancer    split/merge decisions from per-shard size/traffic stats.
+"""
+
+from toplingdb_tpu.sharding.admission import AdmissionController, TenantQuota
+from toplingdb_tpu.sharding.balancer import BalancerOptions, ShardBalancer
+from toplingdb_tpu.sharding.migration import MigrationAborted, ShardMigration
+from toplingdb_tpu.sharding.router import ShardRouter, ShardServing, ShardToken
+from toplingdb_tpu.sharding.shard_map import Shard, ShardMap
+
+__all__ = [
+    "AdmissionController",
+    "BalancerOptions",
+    "MigrationAborted",
+    "Shard",
+    "ShardBalancer",
+    "ShardMap",
+    "ShardMigration",
+    "ShardRouter",
+    "ShardServing",
+    "ShardToken",
+    "TenantQuota",
+    "open_local_cluster",
+]
+
+
+def open_local_cluster(base_dir: str, bounds, options_factory=None,
+                       statistics=None, admission=None,
+                       fence_timeout: float = 5.0) -> ShardRouter:
+    """Stand up one DB instance per shard under `base_dir` and return the
+    ShardRouter fronting them — the README/bench "4-shard local cluster"
+    in one call. `bounds` is a list of (name, start, end) rows (None =
+    open bound) or an int N for N uniform shards over fixed-width keys.
+    `options_factory(shard_name)` builds each primary's Options (default:
+    fresh Options(create_if_missing=True)). Close with router.close()."""
+    import os
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+
+    if isinstance(bounds, int):
+        shard_map = ShardMap.uniform(bounds)
+    else:
+        shard_map = ShardMap.from_bounds(list(bounds))
+    router = ShardRouter(shard_map, statistics=statistics,
+                         admission=admission, fence_timeout=fence_timeout)
+    for name in shard_map.names():
+        if options_factory is not None:
+            opts = options_factory(name)
+        else:
+            opts = Options(create_if_missing=True)
+            opts.statistics = statistics
+        db = DB.open(os.path.join(base_dir, name), opts)
+        router.attach_shard(name, db)
+    return router

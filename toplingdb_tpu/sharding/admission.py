@@ -1,0 +1,130 @@
+"""Per-tenant / per-shard admission control for the front-door router.
+
+Two cooperating mechanisms (the ROADMAP's "one hot tenant can't starve the
+rest" bar):
+
+  rate limits      one token bucket per (tenant, dimension) reusing
+                   utils/rate_limiter.py's RateLimiter — bytes/sec and
+                   ops/sec, enforced with a BOUNDED wait
+                   (TenantQuota.max_wait) after which the write is shed
+                   with Busy instead of queueing unboundedly.
+  stall shedding   when the target shard's primary reports
+                   write_stall_state() == "stopped" (L0 past the stop
+                   trigger), a tenant whose bucket is EMPTY is shed
+                   immediately — zero wait — so the stalled shard's
+                   capacity drains to in-quota tenants and siblings keep
+                   serving. In-quota writes still pass through (and then
+                   block inside _maybe_stall_writes like any other write):
+                   backpressure, not a brownout.
+
+The controller is deliberately router-agnostic: admit_write(tenant,
+nbytes, stall_state) is the whole contract, so tests can drive it directly
+and the ShardRouter just forwards the shard's live stall state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from toplingdb_tpu.utils import statistics as stats_mod
+from toplingdb_tpu.utils.rate_limiter import RateLimiter
+from toplingdb_tpu.utils.status import Busy
+
+
+@dataclasses.dataclass
+class TenantQuota:
+    """0 = unlimited for either dimension."""
+
+    write_bytes_per_sec: int = 0
+    write_ops_per_sec: int = 0
+    # Bounded bucket wait before the write is shed with Busy.
+    max_wait: float = 0.25
+    # Shed with zero wait while the target shard is stall-stopped.
+    shed_on_stall: bool = True
+
+
+class AdmissionController:
+    """Token-bucket admission with stall-aware shedding. One instance per
+    ShardRouter; quotas are keyed by tenant name (None = the anonymous
+    tenant, governed by `default_quota` when set)."""
+
+    def __init__(self, default_quota: TenantQuota | None = None,
+                 statistics=None):
+        self.default_quota = default_quota
+        self.stats = statistics
+        self._mu = threading.Lock()
+        self._quotas: dict[str | None, TenantQuota] = {}
+        # (tenant, "bytes"|"ops") → RateLimiter
+        self._buckets: dict[tuple, RateLimiter] = {}
+        self.shed_count = 0
+        self.waited_count = 0
+
+    def set_quota(self, tenant: str | None, quota: TenantQuota) -> None:
+        with self._mu:
+            self._quotas[tenant] = quota
+            # Rate changes rebuild the buckets lazily.
+            self._buckets.pop((tenant, "bytes"), None)
+            self._buckets.pop((tenant, "ops"), None)
+
+    def quota_for(self, tenant: str | None) -> TenantQuota | None:
+        with self._mu:
+            return self._quotas.get(tenant, self.default_quota)
+
+    def _bucket(self, tenant, dim: str, rate: int) -> RateLimiter:
+        with self._mu:
+            b = self._buckets.get((tenant, dim))
+            if b is None or b.rate != rate:
+                b = RateLimiter(rate)
+                self._buckets[(tenant, dim)] = b
+            return b
+
+    def _tick(self, name: str) -> None:
+        if self.stats is not None:
+            self.stats.record_tick(name)
+
+    def admit_write(self, tenant: str | None, nbytes: int,
+                    stall_state: str = "none") -> float:
+        """Admit or shed one write of `nbytes` from `tenant` against a
+        shard currently in `stall_state`. Returns the seconds spent
+        waiting on buckets (0.0 for the fast path); raises Busy when shed.
+        """
+        quota = self.quota_for(tenant)
+        if quota is None:
+            return 0.0
+        budget = (0.0 if (stall_state == "stopped" and quota.shed_on_stall)
+                  else quota.max_wait)
+        t0 = time.monotonic()
+        for dim, rate, n in (("ops", quota.write_ops_per_sec, 1),
+                             ("bytes", quota.write_bytes_per_sec, nbytes)):
+            if rate <= 0:
+                continue
+            remaining = max(0.0, budget - (time.monotonic() - t0))
+            if not self._bucket(tenant, dim, rate).try_request(
+                    n, timeout=remaining):
+                self.shed_count += 1
+                self._tick(stats_mod.SHARD_WRITES_SHED)
+                raise Busy(
+                    f"tenant {tenant!r} over {dim} quota "
+                    f"({rate}/s, stall_state={stall_state})")
+        waited = time.monotonic() - t0
+        if waited > 0.001:
+            self.waited_count += 1
+            self._tick(stats_mod.SHARD_ADMISSION_WAITS)
+        return waited
+
+    def status(self) -> dict:
+        with self._mu:
+            quotas = {
+                str(t): dataclasses.asdict(q)
+                for t, q in sorted(self._quotas.items(),
+                                   key=lambda kv: str(kv[0]))
+            }
+        return {
+            "default_quota": (dataclasses.asdict(self.default_quota)
+                              if self.default_quota else None),
+            "quotas": quotas,
+            "shed_count": self.shed_count,
+            "waited_count": self.waited_count,
+        }

@@ -1,0 +1,161 @@
+"""ShardBalancer: split/merge decisions from per-shard size + traffic.
+
+The decision loop is deliberately mechanical (no ML, no history beyond one
+snapshot): a shard whose approximate on-disk+memtable size exceeds
+`split_bytes`, or whose write traffic since the last tick exceeds
+`split_writes`, is split at a median key; two ADJACENT shards that are
+both tiny (< `merge_bytes`) and share a backing primary are merged
+metadata-only. run_once() returns the actions it took so operators (and
+tools/shard_admin.py --balance) can audit every topology change.
+
+Split-key selection prefers SST boundary keys (free — they already live in
+the version metadata and land near the data's real mass), falling back to
+an iterator sample for memtable-only shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from toplingdb_tpu.db import dbformat
+
+
+@dataclasses.dataclass
+class BalancerOptions:
+    split_bytes: int = 256 << 20
+    split_writes: int = 0          # writes/tick; 0 = size-only splits
+    merge_bytes: int = 8 << 20
+    max_shards: int = 64
+    min_shards: int = 1
+
+
+class ShardBalancer:
+    def __init__(self, router, options: BalancerOptions | None = None):
+        self.router = router
+        self.options = options or BalancerOptions()
+        self._last_traffic: dict[str, dict] = {}
+
+    # -- measurements -----------------------------------------------------
+
+    def shard_size(self, name: str) -> int:
+        """Approximate bytes owned by the shard: SST bytes in its range
+        plus the primary's memtable usage scaled by nothing (cheap upper
+        bound — the memtable may hold other shards' keys when stacks are
+        shared post-split)."""
+        shard = self.router.map.get(name)
+        db = self.router._serving(name).primary
+        lo = shard.start if shard.start is not None else b""
+        hi = shard.end
+        if hi is None:
+            # An effectively-infinite upper bound: past any real user key.
+            hi = (lo or b"") + b"\xff" * 64
+        try:
+            size = db.get_approximate_sizes([(lo, hi)])[0]
+        except Exception:
+            size = 0
+        try:
+            cfs = getattr(db, "_cfs", {})
+            size += sum(c.mem.approximate_memory_usage()
+                        for c in cfs.values())
+        except Exception:
+            pass
+        return size
+
+    def pick_split_key(self, name: str) -> bytes | None:
+        """A key strictly inside the shard's range, near its data median:
+        SST file boundary user keys inside the range when available, else
+        an iterator sample (every 16th key, capped)."""
+        shard = self.router.map.get(name)
+        db = self.router._serving(name).primary
+        candidates: list[bytes] = []
+        try:
+            version = db.versions.current
+            for level in range(version.num_levels):
+                for f in version.files[level]:
+                    for ik in (f.smallest, f.largest):
+                        uk = dbformat.extract_user_key(ik)
+                        if shard.contains(uk) and uk != shard.start:
+                            candidates.append(uk)
+        except Exception:
+            candidates = []
+        if len(candidates) < 3:
+            it = db.new_iterator()
+            try:
+                if shard.start is None:
+                    it.seek_to_first()
+                else:
+                    it.seek(shard.start)
+                n = 0
+                while it.valid() and n < 4096:
+                    k = it.key()
+                    if shard.end is not None and k >= shard.end:
+                        break
+                    if n % 16 == 0 and k != shard.start:
+                        candidates.append(k)
+                    n += 1
+                    it.next()
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+        candidates = sorted(set(candidates))
+        if not candidates:
+            return None
+        key = candidates[len(candidates) // 2]
+        if (shard.start is not None and key <= shard.start) or \
+                (shard.end is not None and key >= shard.end):
+            return None
+        return key
+
+    def _write_delta(self, name: str, traffic: dict) -> int:
+        cur = traffic.get(name, {}).get("writes", 0)
+        prev = self._last_traffic.get(name, {}).get("writes", 0)
+        return max(0, cur - prev)
+
+    # -- the decision loop ------------------------------------------------
+
+    def run_once(self) -> list[dict]:
+        """One balancing pass: at most one split and one merge (topology
+        changes are rare and should be observable one at a time)."""
+        opts = self.options
+        router = self.router
+        actions: list[dict] = []
+        traffic = router.traffic()
+        names = router.map.names()
+
+        if len(names) < opts.max_shards:
+            for name in names:
+                size = self.shard_size(name)
+                hot = (opts.split_writes > 0
+                       and self._write_delta(name, traffic)
+                       >= opts.split_writes)
+                if size < opts.split_bytes and not hot:
+                    continue
+                key = self.pick_split_key(name)
+                if key is None:
+                    continue
+                left, right = router.split_shard(name, key)
+                actions.append({
+                    "action": "split", "shard": name,
+                    "split_key_hex": key.hex(), "bytes": size,
+                    "hot": hot, "left": left.name, "right": right.name,
+                })
+                break
+
+        if len(router.map.names()) > opts.min_shards:
+            shards = list(router.map.shards)
+            for a, b in zip(shards, shards[1:]):
+                sa = router._servings.get(a.name)
+                sb = router._servings.get(b.name)
+                if sa is None or sb is None or sa.primary is not sb.primary:
+                    continue  # cross-backend merges are an operator call
+                if self.shard_size(a.name) >= opts.merge_bytes or \
+                        self.shard_size(b.name) >= opts.merge_bytes:
+                    continue
+                router.merge_shards(a.name, b.name)
+                actions.append({"action": "merge", "left": a.name,
+                                "right": b.name})
+                break
+
+        self._last_traffic = traffic
+        return actions

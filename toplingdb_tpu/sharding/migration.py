@@ -1,0 +1,209 @@
+"""Live shard migration, assembled from pieces the repo already owns.
+
+State machine (each arrow is a phase boundary where `fault_hook` fires —
+the chaos soak kills migrations exactly there and at every catch-up round):
+
+  bootstrap   Checkpoint.restore_to seeds the destination from a source
+              checkpoint (replication/follower.py's standalone bootstrap:
+              FollowerDB.open(transport=...) requests the checkpoint over
+              the migration transport and restores it).
+  catchup     the destination tails the source's WAL through a LogShipper
+              until its applied sequence is within `catchup_lag` of the
+              source — the DUAL-WRITE window: the source keeps serving
+              writes, every one of which also lands on the destination via
+              shipping. Transport faults (drop/delay/truncate) only slow
+              this phase down; a torn frame never half-applies.
+  fence       the router closes the shard's write gate and DRAINS in-flight
+              writers, then the destination pulls the final frames until
+              applied == source.last_sequence. Bounded: a drain that cannot
+              complete aborts the migration with the source untouched.
+  cutover     FollowerDB.promote() → DB.open on the destination, the router
+              swaps the serving stack and bumps the shard epoch (every
+              outstanding token for the shard now re-routes), the fence
+              lifts. Writers parked at the fence re-resolve and land on the
+              NEW primary.
+
+Abort safety: until the swap inside `cutover` the source is authoritative
+and untouched — any failure (or a hard kill) leaves a correct cluster; the
+destination directory is garbage to delete and retry. A HARD-killed
+migration can leave the fence closed; `ShardMigration.recover(router,
+shard)` is the supervisor-side cleanup (lift fence, reset state), after
+which writes flow to the source again.
+"""
+
+from __future__ import annotations
+
+import time
+
+from toplingdb_tpu.replication.follower import FollowerDB
+from toplingdb_tpu.replication.log_shipper import LocalTransport, LogShipper
+from toplingdb_tpu.utils import statistics as stats_mod
+from toplingdb_tpu.utils import telemetry as _tm
+from toplingdb_tpu.utils.status import Busy, IOError_
+
+
+class MigrationAborted(Exception):
+    """Raised when a migration gives up before cutover; the source shard
+    is still authoritative and serving."""
+
+
+class ShardMigration:
+    """One shard → one new DB instance at `dest_path`.
+
+    `transport_factory` wraps the LocalTransport built over the source's
+    LogShipper (tests wrap FaultyTransport for chaos); `fault_hook(phase)`
+    is called at every phase boundary and each catch-up round — raising
+    from it aborts the migration exactly there."""
+
+    PHASES = ("bootstrap", "catchup", "fence", "cutover")
+
+    def __init__(self, router, shard_name: str, dest_path: str,
+                 options=None, transport_factory=None,
+                 catchup_lag: int = 0, catchup_timeout: float = 60.0,
+                 fence_drain_timeout: float = 30.0, fault_hook=None):
+        self.router = router
+        self.shard_name = shard_name
+        self.dest_path = dest_path
+        self.options = options
+        self.transport_factory = transport_factory
+        self.catchup_lag = max(0, catchup_lag)
+        self.catchup_timeout = catchup_timeout
+        self.fence_drain_timeout = fence_drain_timeout
+        self.fault_hook = fault_hook
+        self.phase = "idle"
+        self.rounds = 0
+
+    def _hook(self, phase: str) -> None:
+        self.phase = phase
+        if self.fault_hook is not None:
+            self.fault_hook(phase)
+
+    def _tick(self, name: str) -> None:
+        if self.router.stats is not None:
+            self.router.stats.record_tick(name)
+
+    def run(self) -> dict:
+        router = self.router
+        serving = router._serving(self.shard_name)
+        src = serving.primary
+        self._tick(stats_mod.SHARD_MIGRATIONS)
+        t_start = time.monotonic()
+        tracer = getattr(src, "tracer", None)
+        root = tracer.start("shard.migrate", shard=self.shard_name,
+                            dest=self.dest_path) if tracer else None
+        router.map.set_state(self.shard_name, "migrating")
+        follower = None
+        fence_t0 = None
+        try:
+            # -- bootstrap: checkpoint restore into dest ------------------
+            self._hook("bootstrap")
+            sp = _tm.span("shard.migrate.bootstrap")
+            shipper = LogShipper(src, statistics=router.stats)
+            transport = LocalTransport(shipper)
+            if self.transport_factory is not None:
+                transport = self.transport_factory(transport)
+            follower = FollowerDB.open(
+                self.dest_path, self.options, env=src.env,
+                transport=transport, mode="standalone", bootstrap=True)
+            sp.finish()
+
+            # -- catchup: the dual-write window ---------------------------
+            sp = _tm.span("shard.migrate.catchup")
+            deadline = time.monotonic() + self.catchup_timeout
+            while True:
+                self._hook("catchup")
+                self.rounds += 1
+                follower.catch_up()
+                lag = (src.versions.last_sequence
+                       - follower.applied_sequence())
+                if lag <= self.catchup_lag:
+                    break
+                if time.monotonic() > deadline:
+                    raise MigrationAborted(
+                        f"catch-up stuck {lag} sequences behind after "
+                        f"{self.catchup_timeout}s")
+            sp.finish()
+
+            # -- fence: drain writers, pull the last frames ---------------
+            self._hook("fence")
+            sp = _tm.span("shard.migrate.fence")
+            fence_t0 = router.fence_shard(
+                self.shard_name, drain_timeout=self.fence_drain_timeout)
+            drain_deadline = time.monotonic() + self.fence_drain_timeout
+            while follower.applied_sequence() < src.versions.last_sequence:
+                follower.catch_up()
+                if time.monotonic() > drain_deadline:
+                    raise MigrationAborted(
+                        "final drain did not converge under the fence")
+            sp.finish()
+
+            # -- cutover: promote + swap + epoch bump ---------------------
+            self._hook("cutover")
+            sp = _tm.span("shard.migrate.cutover")
+            from toplingdb_tpu.db.db import DB
+            from toplingdb_tpu.options import Options
+
+            path = follower.promote()  # final catch-up + close
+            follower = None
+            new_opts = self.options or Options()
+            new_opts.read_only = False
+            new_opts.create_if_missing = False
+            new_opts.disable_auto_compactions = False
+            if new_opts.statistics is None:
+                new_opts.statistics = router.stats
+            new_db = DB.open(path, new_opts, env=src.env)
+            router.swap_serving(self.shard_name, new_db)
+            router.unfence_shard(self.shard_name, fence_t0)
+            fence_t0 = None
+            sp.finish()
+            if router.stats is not None:
+                router.stats.record_in_histogram(
+                    stats_mod.SHARD_MIGRATION_MICROS,
+                    int((time.monotonic() - t_start) * 1e6))
+            self.phase = "done"
+            return {
+                "shard": self.shard_name,
+                "dest": path,
+                "rounds": self.rounds,
+                "epoch": router.map.epoch_of(self.shard_name),
+                "last_sequence": new_db.versions.last_sequence,
+            }
+        except BaseException as e:
+            # Source stays authoritative: lift the fence, reset the state,
+            # retire the half-built destination. A retry starts clean.
+            self.phase = "aborted"
+            self._tick(stats_mod.SHARD_MIGRATION_FAILURES)
+            if fence_t0 is not None:
+                try:
+                    router.unfence_shard(self.shard_name, fence_t0)
+                except Exception:
+                    pass
+            else:
+                try:
+                    router.map.set_state(self.shard_name, "serving")
+                except Exception:
+                    pass
+            if follower is not None:
+                try:
+                    follower.close()
+                except Exception:
+                    pass
+            if isinstance(e, (MigrationAborted, Busy)):
+                raise
+            raise MigrationAborted(f"migration of {self.shard_name!r} "
+                                   f"failed in {self.phase}: {e!r}") from e
+        finally:
+            if root is not None:
+                root.finish()
+
+    @staticmethod
+    def recover(router, shard_name: str) -> None:
+        """Supervisor-side cleanup after a HARD-killed migration (the
+        process died holding the fence): lift the fence and return the
+        shard to serving — the source was never demoted, so this restores
+        full service; the destination directory is garbage to remove
+        before a retry."""
+        try:
+            router.unfence_shard(shard_name)
+        except Exception as e:  # pragma: no cover - map gone entirely
+            raise IOError_(f"cannot recover shard {shard_name!r}: {e}")

@@ -1,0 +1,614 @@
+"""ShardRouter: the front door that turns one DB into a fleet.
+
+Routes every read/write through the ShardMap to that key's shard, where a
+per-shard serving stack — primary DB + follower set behind a
+replication.router.ReplicaRouter — actually serves it. Composition rules:
+
+  tokens     writes return a ShardToken(shard, epoch, seq). On a read the
+             router re-resolves the key: if the shard NAME or EPOCH no
+             longer matches (split/merge/migration happened), the token is
+             rejected and the read re-routes to the CURRENT shard's
+             primary — never silently served stale. When they match, the
+             token degrades to a replication StalenessToken(seq, epoch)
+             and the shard's ReplicaRouter enforces the same epoch rule
+             against its follower set (epoch_provider = the live shard
+             epoch from the map).
+  fences     every shard has a write gate. Topology changes (migration
+             cutover, cross-backend merge) fence the gate: new writers
+             park (bounded by fence_timeout, then Busy), in-flight writers
+             drain, and only then may the final WAL drain + cutover run —
+             so no write can land on the old primary after the new one
+             took over (the no-lost-write half of the chaos bar). Reads
+             are never fenced.
+  admission  per-tenant token buckets + stall shedding
+             (sharding/admission.py), fed the target shard primary's LIVE
+             write_stall_state() so a hot tenant sheds load instead of
+             starving siblings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from toplingdb_tpu.options import ReadOptions, WriteOptions
+from toplingdb_tpu.replication.router import (
+    ReplicaRouter,
+    RouterOptions,
+    StalenessToken,
+)
+from toplingdb_tpu.sharding.shard_map import Shard, ShardMap
+from toplingdb_tpu.utils import statistics as stats_mod
+from toplingdb_tpu.utils.status import Busy, InvalidArgument, NotFound
+
+_DEFAULT_READ = ReadOptions()
+_DEFAULT_WRITE = WriteOptions()
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardToken:
+    """Read-your-writes token stamped with the shard identity + epoch the
+    write was routed under. Either changing invalidates it (rejected and
+    re-routed, never served stale)."""
+
+    shard: str
+    epoch: int
+    seq: int
+
+
+class _WriteGate:
+    """Per-shard write fence: enter/exit bracket every routed write;
+    fence() closes the gate AND drains in-flight writers, so after it
+    returns no write can still be in the old primary's pipeline."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._open = True
+        self._inflight = 0
+
+    def enter(self, timeout: float):
+        """True on entry, None on fence timeout; the truthy value is
+        "waited" (the caller ticks SHARD_FENCE_WAITS on 2)."""
+        deadline = time.monotonic() + timeout
+        waited = 1
+        with self._cv:
+            while not self._open:
+                waited = 2
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._cv.wait(left)
+            self._inflight += 1
+            return waited
+
+    def exit(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._cv.notify_all()
+
+    def fence(self, drain_timeout: float = 30.0) -> bool:
+        with self._cv:
+            self._open = False
+            deadline = time.monotonic() + drain_timeout
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+            return True
+
+    def unfence(self) -> None:
+        with self._cv:
+            self._open = True
+            self._cv.notify_all()
+
+    @property
+    def fenced(self) -> bool:
+        return not self._open
+
+
+class ShardServing:
+    """One shard's serving stack: primary DB + follower set behind a
+    ReplicaRouter whose epoch_provider is the LIVE shard epoch — so
+    replication-level token checks stay correct across re-sharding without
+    the replica router knowing the map exists."""
+
+    def __init__(self, primary, followers=(), statistics=None,
+                 router_options: RouterOptions | None = None,
+                 epoch_provider=None):
+        self.primary = primary
+        self.followers = list(followers)
+        self.replicas = ReplicaRouter(
+            primary, self.followers, options=router_options,
+            statistics=statistics, epoch_provider=epoch_provider)
+
+    def stall_state(self) -> str:
+        fn = getattr(self.primary, "write_stall_state", None)
+        if fn is None:
+            return "none"
+        try:
+            return fn()["state"]
+        except Exception:
+            return "none"
+
+
+class ShardRouter:
+    """Front-door router over a ShardMap. Serving stacks are attached per
+    shard name; split shares the stack between the halves, migration swaps
+    a fresh one in under the shard's fence."""
+
+    def __init__(self, shard_map: ShardMap | None = None, statistics=None,
+                 admission=None, fence_timeout: float = 5.0,
+                 router_options: RouterOptions | None = None):
+        self.map = shard_map or ShardMap()
+        self.stats = statistics
+        self.admission = admission
+        self.fence_timeout = fence_timeout
+        self.router_options = router_options
+        self._mu = threading.RLock()
+        self._servings: dict[str, ShardServing] = {}
+        self._gates: dict[str, _WriteGate] = {}
+        self._traffic: dict[str, dict] = {}
+
+    # -- wiring -----------------------------------------------------------
+
+    def _shard_epoch(self, name: str) -> int:
+        try:
+            return self.map.get(name).epoch
+        except NotFound:
+            return -1  # shard merged/renamed away: no token matches again
+
+    def _new_serving(self, name: str, primary, followers=()) -> ShardServing:
+        return ShardServing(
+            primary, followers, statistics=self.stats,
+            router_options=self.router_options,
+            epoch_provider=lambda n=name: self._shard_epoch(n))
+
+    def attach_shard(self, name: str, primary, followers=()) -> None:
+        """Bind a serving stack to a map shard (must exist in the map)."""
+        self.map.get(name)  # raises NotFound for a name the map lacks
+        with self._mu:
+            self._servings[name] = self._new_serving(name, primary,
+                                                     followers)
+            self._gates.setdefault(name, _WriteGate())
+            self._traffic.setdefault(name, {
+                "reads": 0, "writes": 0, "read_keys": 0, "write_bytes": 0})
+
+    def add_follower(self, name: str, follower) -> None:
+        self._serving(name).replicas.add_follower(follower)
+
+    def _serving(self, name: str) -> ShardServing:
+        s = self._servings.get(name)
+        if s is None:
+            raise NotFound(f"no serving stack attached for shard {name!r}")
+        return s
+
+    def _gate(self, name: str) -> _WriteGate:
+        # Lock-free on the hot path: a topology op holding _mu (e.g. a
+        # cross-backend merge copy) must not block writers of OTHER shards.
+        g = self._gates.get(name)
+        if g is None:
+            with self._mu:
+                g = self._gates.setdefault(name, _WriteGate())
+        return g
+
+    def _tick(self, name: str, n: int = 1) -> None:
+        if self.stats is not None:
+            self.stats.record_tick(name, n)
+
+    def _note_traffic(self, name: str, *, reads=0, writes=0, read_keys=0,
+                      write_bytes=0) -> None:
+        t = self._traffic.get(name)
+        if t is None:
+            with self._mu:
+                t = self._traffic.setdefault(name, {
+                    "reads": 0, "writes": 0, "read_keys": 0,
+                    "write_bytes": 0})
+        t["reads"] += reads
+        t["writes"] += writes
+        t["read_keys"] += read_keys
+        t["write_bytes"] += write_bytes
+
+    # -- write path -------------------------------------------------------
+
+    def _enter_shard(self, key: bytes):
+        """Resolve key → shard and enter its write gate, re-resolving when
+        the topology changed while we were parked at a fence. Returns
+        (shard, serving, gate) with the gate ENTERED."""
+        for _ in range(16):
+            shard = self.map.shard_for(key)
+            gate = self._gate(shard.name)
+            entered = gate.enter(self.fence_timeout)
+            if entered is None:
+                self._tick(stats_mod.SHARD_FENCE_WAITS)
+                raise Busy(f"shard {shard.name!r} write-fenced "
+                           f"(> {self.fence_timeout}s)")
+            if entered == 2:
+                self._tick(stats_mod.SHARD_FENCE_WAITS)
+            cur = self.map.shard_for(key)
+            serving = self._servings.get(cur.name)
+            if cur.name == shard.name and cur.epoch == shard.epoch \
+                    and serving is not None:
+                return cur, serving, gate
+            gate.exit()  # re-sharded while entering: route again
+        raise Busy(f"shard routing for key {key!r} did not settle")
+
+    def _admit(self, tenant, nbytes: int, serving: ShardServing) -> None:
+        if self.admission is not None:
+            self.admission.admit_write(tenant, nbytes,
+                                       stall_state=serving.stall_state())
+
+    def put(self, key: bytes, value: bytes,
+            opts: WriteOptions = _DEFAULT_WRITE, tenant=None) -> ShardToken:
+        shard, serving, gate = self._enter_shard(key)
+        try:
+            self._admit(tenant, len(key) + len(value), serving)
+            seq = serving.replicas.put(key, value, opts)
+        finally:
+            gate.exit()
+        self._tick(stats_mod.SHARD_ROUTED_WRITES)
+        self._note_traffic(shard.name, writes=1,
+                           write_bytes=len(key) + len(value))
+        return ShardToken(shard=shard.name, epoch=shard.epoch, seq=seq)
+
+    def delete(self, key: bytes, opts: WriteOptions = _DEFAULT_WRITE,
+               tenant=None) -> ShardToken:
+        shard, serving, gate = self._enter_shard(key)
+        try:
+            self._admit(tenant, len(key), serving)
+            seq = serving.replicas.delete(key, opts)
+        finally:
+            gate.exit()
+        self._tick(stats_mod.SHARD_ROUTED_WRITES)
+        self._note_traffic(shard.name, writes=1, write_bytes=len(key))
+        return ShardToken(shard=shard.name, epoch=shard.epoch, seq=seq)
+
+    def merge(self, key: bytes, value: bytes,
+              opts: WriteOptions = _DEFAULT_WRITE, tenant=None) -> ShardToken:
+        shard, serving, gate = self._enter_shard(key)
+        try:
+            self._admit(tenant, len(key) + len(value), serving)
+            seq = serving.replicas.merge(key, value, opts)
+        finally:
+            gate.exit()
+        self._tick(stats_mod.SHARD_ROUTED_WRITES)
+        self._note_traffic(shard.name, writes=1,
+                           write_bytes=len(key) + len(value))
+        return ShardToken(shard=shard.name, epoch=shard.epoch, seq=seq)
+
+    def write(self, batch, opts: WriteOptions = _DEFAULT_WRITE, tenant=None,
+              shard: str | None = None) -> list[ShardToken]:
+        """Route a WriteBatch. With `shard` given (callers that pre-bucket
+        their batches, e.g. bench fill loops) the whole batch goes to that
+        shard with no per-record inspection. Otherwise records are grouped
+        by shard — point records route by key, range deletions are clipped
+        to each overlapping shard. Returns one token per touched shard."""
+        from toplingdb_tpu.db.write_batch import WriteBatch
+        from toplingdb_tpu.db.dbformat import ValueType
+
+        if shard is not None:
+            return [self._write_to_shard(shard, batch, opts, tenant)]
+        groups: dict[str, WriteBatch] = {}
+        for cf, t, k, v in batch.entries_cf():
+            if t == ValueType.RANGE_DELETION:
+                for sh in list(self.map.shards):
+                    clipped = sh.clip(k, v)
+                    if clipped is None:
+                        continue
+                    b, e = clipped
+                    if b is None or e is None:
+                        raise InvalidArgument(
+                            "unbounded range deletion through the shard "
+                            "router is not supported")
+                    groups.setdefault(sh.name,
+                                      WriteBatch()).delete_range(b, e, cf=cf)
+                continue
+            name = self.map.shard_for(k).name
+            g = groups.setdefault(name, WriteBatch())
+            if t == ValueType.VALUE:
+                g.put(k, v, cf=cf)
+            elif t == ValueType.MERGE:
+                g.merge(k, v, cf=cf)
+            elif t == ValueType.DELETION:
+                g.delete(k, cf=cf)
+            elif t == ValueType.SINGLE_DELETION:
+                g.single_delete(k, cf=cf)
+            elif t == ValueType.WIDE_COLUMN_ENTITY:
+                g.put_entity(k, v, cf=cf)
+            else:
+                raise InvalidArgument(
+                    f"record type {t} not routable through the shard router")
+        return [self._write_to_shard(name, g, opts, tenant)
+                for name, g in groups.items()]
+
+    def _write_to_shard(self, name: str, batch, opts, tenant) -> ShardToken:
+        # The gate is entered via a representative key resolve so a
+        # concurrent re-shard still re-routes; the shard NAME the caller
+        # targeted must still own the batch after entry.
+        for _ in range(16):
+            try:
+                shard = self.map.get(name)
+            except NotFound:
+                raise InvalidArgument(f"shard {name!r} no longer exists")
+            gate = self._gate(shard.name)
+            entered = gate.enter(self.fence_timeout)
+            if entered is None:
+                self._tick(stats_mod.SHARD_FENCE_WAITS)
+                raise Busy(f"shard {name!r} write-fenced")
+            if entered == 2:
+                self._tick(stats_mod.SHARD_FENCE_WAITS)
+            cur = self.map.get(name)
+            serving = self._servings.get(name)
+            if cur.epoch == shard.epoch and serving is not None:
+                try:
+                    nbytes = batch.data_size()
+                    self._admit(tenant, nbytes, serving)
+                    seq = serving.replicas.write(batch, opts)
+                finally:
+                    gate.exit()
+                self._tick(stats_mod.SHARD_ROUTED_WRITES)
+                self._note_traffic(name, writes=batch.count(),
+                                   write_bytes=nbytes)
+                return ShardToken(shard=name, epoch=cur.epoch, seq=seq)
+            gate.exit()
+        raise Busy(f"shard {name!r} routing did not settle")
+
+    # -- read path --------------------------------------------------------
+
+    def _check_token(self, shard: Shard, token: ShardToken | None):
+        """None → token-less read; StalenessToken → delegate to the shard's
+        ReplicaRouter; the string "primary" → epoch/name mismatch, serve
+        from the current primary (re-routed, never stale)."""
+        if token is None:
+            return None
+        if token.shard != shard.name or token.epoch != shard.epoch:
+            self._tick(stats_mod.SHARD_TOKEN_REJECTS)
+            return "primary"
+        return StalenessToken(seq=token.seq, epoch=token.epoch)
+
+    def get(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
+            token: ShardToken | None = None):
+        shard = self.map.shard_for(key)
+        serving = self._serving(shard.name)
+        self._tick(stats_mod.SHARD_ROUTED_READS)
+        self._note_traffic(shard.name, reads=1, read_keys=1)
+        rt = self._check_token(shard, token)
+        if rt == "primary":
+            return serving.replicas.primary.get(key, opts)
+        return serving.replicas.get(key, opts, token=rt)
+
+    def multi_get(self, keys, opts: ReadOptions = _DEFAULT_READ,
+                  token: ShardToken | None = None):
+        """Group keys by shard, fan out one multi_get per shard, reassemble
+        in input order. A single token applies to whichever shard it still
+        matches (other shards read token-less)."""
+        by_shard: dict[str, list[int]] = {}
+        shards: dict[str, Shard] = {}
+        for i, k in enumerate(keys):
+            sh = self.map.shard_for(k)
+            by_shard.setdefault(sh.name, []).append(i)
+            shards[sh.name] = sh
+        out = [None] * len(keys)
+        for name, idxs in by_shard.items():
+            sh = shards[name]
+            serving = self._serving(name)
+            sub = [keys[i] for i in idxs]
+            rt = self._check_token(sh, token)
+            if rt == "primary":
+                vals = serving.replicas.primary.multi_get(sub, opts)
+            else:
+                vals = serving.replicas.multi_get(sub, opts, token=rt)
+            for i, v in zip(idxs, vals):
+                out[i] = v
+            self._note_traffic(name, reads=1, read_keys=len(sub))
+        self._tick(stats_mod.SHARD_ROUTED_READS, len(by_shard))
+        return out
+
+    def scan(self, begin: bytes | None = None, end: bytes | None = None,
+             opts: ReadOptions = _DEFAULT_READ):
+        """Ordered (key, value) iteration across the whole fleet: shards
+        partition the keyspace and are stored sorted, so chaining per-shard
+        iterators (each clipped to its shard ∩ [begin, end)) yields every
+        live key exactly once, in order."""
+        for name in self.map.names():
+            try:
+                shard = self.map.get(name)
+            except NotFound:
+                continue  # merged away mid-scan: successor covers it
+            clipped = shard.clip(begin, end)
+            if clipped is None:
+                continue
+            b, e = clipped
+            serving = self._serving(name)
+            it = serving.replicas.primary.new_iterator(opts)
+            try:
+                if b is None:
+                    it.seek_to_first()
+                else:
+                    it.seek(b)
+                while it.valid():
+                    k = it.key()
+                    if e is not None and k >= e:
+                        break
+                    yield k, it.value()
+                    it.next()
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+
+    # -- topology: split / merge -----------------------------------------
+
+    def _span_root(self, db, name: str, **tags):
+        tracer = getattr(db, "tracer", None)
+        return tracer.start(name, **tags) if tracer is not None else None
+
+    def split_shard(self, name: str, split_key: bytes,
+                    right_name: str | None = None) -> tuple[Shard, Shard]:
+        """Metadata split: both halves keep serving from the SAME stack
+        (fresh epochs invalidate outstanding tokens); a later migration
+        gives a half its own instance. No fence needed — in-flight writes
+        commit to the shared primary either way."""
+        with self._mu:
+            serving = self._serving(name)
+            sp = self._span_root(serving.primary, "shard.split", shard=name)
+            try:
+                left, right = self.map.split(name, split_key,
+                                             right_name=right_name)
+                # Left keeps its stack (same name, live epoch provider);
+                # the right half gets its own serving entry over the SAME
+                # primary/followers.
+                self._servings[right.name] = self._new_serving(
+                    right.name, serving.primary, serving.followers)
+                self._gates.setdefault(right.name, _WriteGate())
+                self._traffic.setdefault(right.name, {
+                    "reads": 0, "writes": 0, "read_keys": 0,
+                    "write_bytes": 0})
+            finally:
+                if sp is not None:
+                    sp.finish()
+        self._tick(stats_mod.SHARD_SPLITS)
+        return left, right
+
+    def merge_shards(self, left_name: str, right_name: str):
+        """Merge two adjacent shards. Same backing primary → metadata-only.
+        Different primaries → the right shard is write-fenced, its rows are
+        copied into the left primary, then the map merges; the orphaned
+        right serving stack is returned for the caller to close (None when
+        the backends were shared)."""
+        from toplingdb_tpu.db.write_batch import WriteBatch
+
+        with self._mu:
+            left_s = self._serving(left_name)
+            right_s = self._serving(right_name)
+            right_shard = self.map.get(right_name)
+            sp = self._span_root(left_s.primary, "shard.merge",
+                                 left=left_name, right=right_name)
+            orphan = None
+            gate = self._gate(right_name)
+            fenced = False
+            try:
+                if right_s.primary is not left_s.primary:
+                    if not gate.fence():
+                        raise Busy(f"could not drain writers on "
+                                   f"{right_name!r} for merge")
+                    fenced = True
+                    # Copy the right shard's rows (bounded to its range —
+                    # the primary may physically hold more) into the left.
+                    b = WriteBatch()
+                    n = 0
+                    it = right_s.replicas.primary.new_iterator()
+                    if right_shard.start is None:
+                        it.seek_to_first()
+                    else:
+                        it.seek(right_shard.start)
+                    while it.valid():
+                        k = it.key()
+                        if right_shard.end is not None \
+                                and k >= right_shard.end:
+                            break
+                        b.put(k, it.value())
+                        n += 1
+                        if n % 1000 == 0:
+                            left_s.primary.write(b)
+                            b = WriteBatch()
+                        it.next()
+                    if b.count():
+                        left_s.primary.write(b)
+                    orphan = right_s
+                self.map.merge(left_name, right_name)
+                self._servings.pop(right_name, None)
+                self._traffic.pop(right_name, None)
+            finally:
+                if fenced:
+                    gate.unfence()  # parked writers re-route to the merge
+                if sp is not None:
+                    sp.finish()
+        self._tick(stats_mod.SHARD_MERGES)
+        return orphan
+
+    # -- topology: migration hooks (sharding/migration.py drives) ---------
+
+    def fence_shard(self, name: str, drain_timeout: float = 30.0) -> float:
+        """Close the shard's write gate and drain in-flight writers;
+        returns the fence start time (for SHARD_FENCE_MICROS)."""
+        t0 = time.monotonic()
+        if not self._gate(name).fence(drain_timeout):
+            self._gate(name).unfence()
+            raise Busy(f"writers on shard {name!r} did not drain")
+        self.map.set_state(name, "fenced")
+        return t0
+
+    def unfence_shard(self, name: str, t0: float | None = None) -> None:
+        try:
+            self.map.set_state(name, "serving")
+        except NotFound:
+            pass  # merged away while fenced
+        self._gate(name).unfence()
+        if t0 is not None and self.stats is not None:
+            self.stats.record_in_histogram(
+                stats_mod.SHARD_FENCE_MICROS,
+                int((time.monotonic() - t0) * 1e6))
+
+    def swap_serving(self, name: str, primary, followers=()) -> ShardServing:
+        """Replace a shard's serving stack (migration cutover, under the
+        fence) and bump its epoch so outstanding tokens die. Returns the
+        OLD stack for the caller to retire."""
+        with self._mu:
+            old = self._serving(name)
+            self._servings[name] = self._new_serving(name, primary,
+                                                     followers)
+            self.map.bump_epoch(name)
+            return old
+
+    # -- introspection ----------------------------------------------------
+
+    def traffic(self) -> dict:
+        with self._mu:
+            return {k: dict(v) for k, v in self._traffic.items()}
+
+    def status(self) -> dict:
+        shards = []
+        for s in list(self.map.shards):
+            serving = self._servings.get(s.name)
+            row = dict(s.to_config())
+            row["fenced"] = self._gate(s.name).fenced
+            row["traffic"] = dict(self._traffic.get(s.name, {}))
+            if serving is not None:
+                row["primary"] = getattr(serving.primary, "dbname", None)
+                row["followers"] = len(serving.followers)
+                row["stall"] = serving.stall_state()
+                try:
+                    row["last_sequence"] = \
+                        serving.primary.versions.last_sequence
+                except Exception:
+                    pass
+            shards.append(row)
+        out = {
+            "role": "shard-router",
+            "map_version": self.map.version,
+            "n_shards": len(shards),
+            "shards": shards,
+        }
+        if self.admission is not None:
+            out["admission"] = self.admission.status()
+        return out
+
+    def close(self) -> None:
+        """Close every DISTINCT primary/follower referenced by the serving
+        stacks (shared stacks after a split close once)."""
+        with self._mu:
+            servings = list(self._servings.values())
+            self._servings.clear()
+        seen: set[int] = set()
+        for s in servings:
+            for db in [*s.followers, s.primary]:
+                if id(db) in seen:
+                    continue
+                seen.add(id(db))
+                try:
+                    db.close()
+                except Exception:
+                    pass

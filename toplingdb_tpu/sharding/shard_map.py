@@ -1,0 +1,280 @@
+"""ShardMap: versioned key-range → shard metadata, epoch-stamped.
+
+The map is PURE metadata — names, [start, end) user-key ranges, per-shard
+epochs, and serving state — deliberately free of any backend wiring so it
+can be JSON-persisted (the utils/config.py SidePlugin shape), shipped over
+the HTTP control plane, and diffed between processes. The ShardRouter
+resolves names to serving stacks separately.
+
+Invariants (checked by validate(), enforced by every mutator):
+
+  - shards are sorted by start key and EXACTLY partition the keyspace:
+    the first shard starts at -inf (None), the last ends at +inf (None),
+    and every shard's end equals the next shard's start — no gaps, no
+    overlap, so a key routes to exactly ONE shard (the no-double-serve
+    half of the chaos-soak acceptance bar).
+  - epochs are allocated from a map-wide monotonic counter and NEVER
+    reused: any topology change (split/merge/migration cutover) gives the
+    affected shards fresh epochs, so a staleness token stamped under the
+    old epoch can never compare equal again.
+  - `version` increments on every mutation — cheap "did anything change"
+    probe for caches and the HTTP view.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+
+from toplingdb_tpu.utils.status import InvalidArgument, NotFound
+
+# Serving states a shard moves through (migration.py drives the cycle).
+SHARD_STATES = ("serving", "migrating", "fenced")
+
+
+@dataclasses.dataclass
+class Shard:
+    """One key-range: [start, end) with None as -inf/+inf open bounds."""
+
+    name: str
+    start: bytes | None  # inclusive; None = -inf
+    end: bytes | None    # exclusive; None = +inf
+    epoch: int = 1
+    state: str = "serving"
+
+    def contains(self, key: bytes) -> bool:
+        if self.start is not None and key < self.start:
+            return False
+        if self.end is not None and key >= self.end:
+            return False
+        return True
+
+    def clip(self, begin: bytes | None, end: bytes | None):
+        """Intersection of [begin, end) with this shard's range, as a
+        (begin, end) pair with the same None-as-infinity convention, or
+        None when the ranges are disjoint."""
+        b = self.start if begin is None else (
+            begin if self.start is None else max(begin, self.start))
+        e = self.end if end is None else (
+            end if self.end is None else min(end, self.end))
+        if b is not None and e is not None and b >= e:
+            return None
+        return b, e
+
+    def to_config(self) -> dict:
+        return {
+            "name": self.name,
+            # hex keeps arbitrary key bytes JSON-safe; null = open bound
+            "start_hex": self.start.hex() if self.start is not None else None,
+            "end_hex": self.end.hex() if self.end is not None else None,
+            "epoch": self.epoch,
+            "state": self.state,
+        }
+
+    @staticmethod
+    def from_config(cfg: dict) -> "Shard":
+        sh = cfg.get("start_hex")
+        eh = cfg.get("end_hex")
+        return Shard(
+            name=cfg["name"],
+            start=bytes.fromhex(sh) if sh is not None else None,
+            end=bytes.fromhex(eh) if eh is not None else None,
+            epoch=int(cfg.get("epoch", 1)),
+            state=cfg.get("state", "serving"),
+        )
+
+
+class ShardMap:
+    """Sorted, contiguous, epoch-stamped shard table. All mutators bump
+    `version`; epoch allocation is monotonic across the map's lifetime
+    (persisted, so a reloaded map cannot re-issue an old epoch)."""
+
+    def __init__(self, shards: list[Shard] | None = None):
+        self._mu = threading.RLock()
+        self.shards: list[Shard] = list(shards) if shards else [
+            Shard(name="s0", start=None, end=None, epoch=1)
+        ]
+        self.version = 1
+        self._next_epoch = max(s.epoch for s in self.shards) + 1
+        self._name_seq = len(self.shards)
+        self.validate()
+
+    @staticmethod
+    def from_bounds(bounds: list[tuple[str, bytes | None, bytes | None]]
+                    ) -> "ShardMap":
+        """Build from explicit (name, start, end) rows (cluster setup)."""
+        return ShardMap([Shard(name=n, start=s, end=e, epoch=i + 1)
+                         for i, (n, s, e) in enumerate(bounds)])
+
+    @staticmethod
+    def uniform(n: int, key_width: int = 16, prefix: str = "s") -> "ShardMap":
+        """n equal-width shards over fixed-width big-endian byte keys —
+        the bench/README "4-shard local cluster" shape. Split points are
+        the top byte of the key space scaled by i/n."""
+        if n < 1:
+            raise InvalidArgument("uniform shard count must be >= 1")
+        bounds = []
+        for i in range(n):
+            start = None if i == 0 else \
+                bytes([256 * i // n]) + b"\x00" * (key_width - 1)
+            end = None if i == n - 1 else \
+                bytes([256 * (i + 1) // n]) + b"\x00" * (key_width - 1)
+            bounds.append((f"{prefix}{i}", start, end))
+        return ShardMap.from_bounds(bounds)
+
+    # -- introspection ----------------------------------------------------
+
+    def validate(self) -> None:
+        with self._mu:
+            if not self.shards:
+                raise InvalidArgument("shard map is empty")
+            names = [s.name for s in self.shards]
+            if len(set(names)) != len(names):
+                raise InvalidArgument(f"duplicate shard names: {names}")
+            if self.shards[0].start is not None:
+                raise InvalidArgument("first shard must start at -inf")
+            if self.shards[-1].end is not None:
+                raise InvalidArgument("last shard must end at +inf")
+            for a, b in zip(self.shards, self.shards[1:]):
+                if a.end is None or b.start is None or a.end != b.start:
+                    raise InvalidArgument(
+                        f"shards {a.name}/{b.name} do not tile: "
+                        f"{a.end!r} != {b.start!r}")
+
+    def get(self, name: str) -> Shard:
+        with self._mu:
+            for s in self.shards:
+                if s.name == name:
+                    return s
+        raise NotFound(f"no shard named {name!r}")
+
+    def shard_for(self, key: bytes) -> Shard:
+        """The unique shard whose range contains `key` (binary search on
+        the sorted start bounds)."""
+        with self._mu:
+            shards = self.shards
+            lo, hi = 0, len(shards) - 1
+            while lo < hi:  # last shard with start <= key
+                mid = (lo + hi + 1) // 2
+                st = shards[mid].start
+                if st is not None and key < st:
+                    hi = mid - 1
+                else:
+                    lo = mid
+            return shards[lo]
+
+    def epoch_of(self, name: str) -> int:
+        return self.get(name).epoch
+
+    def names(self) -> list[str]:
+        with self._mu:
+            return [s.name for s in self.shards]
+
+    # -- mutation ---------------------------------------------------------
+
+    def _alloc_epoch(self) -> int:
+        e = self._next_epoch
+        self._next_epoch += 1
+        return e
+
+    def _alloc_name(self, hint: str | None = None) -> str:
+        with self._mu:
+            taken = {s.name for s in self.shards}
+            if hint and hint not in taken:
+                return hint
+            while True:
+                name = f"s{self._name_seq}"
+                self._name_seq += 1
+                if name not in taken:
+                    return name
+
+    def bump_epoch(self, name: str) -> int:
+        """Fresh epoch for one shard (migration cutover): every token
+        stamped under the old epoch is now rejected by the routers."""
+        with self._mu:
+            s = self.get(name)
+            s.epoch = self._alloc_epoch()
+            self.version += 1
+            return s.epoch
+
+    def set_state(self, name: str, state: str) -> None:
+        if state not in SHARD_STATES:
+            raise InvalidArgument(f"unknown shard state {state!r}")
+        with self._mu:
+            self.get(name).state = state
+            self.version += 1
+
+    def split(self, name: str, split_key: bytes,
+              right_name: str | None = None) -> tuple[Shard, Shard]:
+        """Split one shard at `split_key` (strictly inside its range):
+        the left half keeps the name (fresh epoch), the right half gets
+        `right_name` or a generated one. Returns (left, right)."""
+        with self._mu:
+            s = self.get(name)
+            if (s.start is not None and split_key <= s.start) or \
+                    (s.end is not None and split_key >= s.end):
+                raise InvalidArgument(
+                    f"split key {split_key!r} outside shard {name!r} "
+                    f"range [{s.start!r}, {s.end!r})")
+            idx = self.shards.index(s)
+            left = Shard(name=s.name, start=s.start, end=split_key,
+                         epoch=self._alloc_epoch(), state=s.state)
+            right = Shard(name=self._alloc_name(right_name),
+                          start=split_key, end=s.end,
+                          epoch=self._alloc_epoch(), state=s.state)
+            self.shards[idx:idx + 1] = [left, right]
+            self.version += 1
+            self.validate()
+            return left, right
+
+    def merge(self, left_name: str, right_name: str) -> Shard:
+        """Merge two ADJACENT shards into one carrying the left name and a
+        fresh epoch."""
+        with self._mu:
+            l, r = self.get(left_name), self.get(right_name)
+            li = self.shards.index(l)
+            if li + 1 >= len(self.shards) or self.shards[li + 1] is not r:
+                raise InvalidArgument(
+                    f"shards {left_name!r}/{right_name!r} are not adjacent")
+            merged = Shard(name=l.name, start=l.start, end=r.end,
+                           epoch=self._alloc_epoch())
+            self.shards[li:li + 2] = [merged]
+            self.version += 1
+            self.validate()
+            return merged
+
+    # -- persistence (the utils/config.py JSON shape) ---------------------
+
+    def to_config(self) -> dict:
+        with self._mu:
+            return {
+                "version": self.version,
+                "next_epoch": self._next_epoch,
+                "shards": [s.to_config() for s in self.shards],
+            }
+
+    @staticmethod
+    def from_config(cfg: dict) -> "ShardMap":
+        m = ShardMap([Shard.from_config(s) for s in cfg["shards"]])
+        m.version = int(cfg.get("version", m.version))
+        # Epoch monotonicity must survive reload: never below what the
+        # persisted map had already handed out.
+        m._next_epoch = max(m._next_epoch, int(cfg.get("next_epoch", 0)))
+        return m
+
+    def save(self, path: str, env=None) -> None:
+        if env is None:
+            from toplingdb_tpu.env import default_env
+
+            env = default_env()
+        env.write_file(path, json.dumps(self.to_config(), indent=1).encode(),
+                       sync=True)
+
+    @staticmethod
+    def load(path: str, env=None) -> "ShardMap":
+        if env is None:
+            from toplingdb_tpu.env import default_env
+
+            env = default_env()
+        return ShardMap.from_config(json.loads(env.read_file(path).decode()))

@@ -1,0 +1,141 @@
+"""shard_admin: operate the sharding plane over the SidePlugin HTTP layer.
+
+    python -m toplingdb_tpu.tools.shard_admin --url http://host:port status
+    python -m toplingdb_tpu.tools.shard_admin --url ... status --cluster C
+    python -m toplingdb_tpu.tools.shard_admin --url ... split \
+        --cluster C --shard S --key K
+    python -m toplingdb_tpu.tools.shard_admin --url ... merge \
+        --cluster C --left A --right B
+    python -m toplingdb_tpu.tools.shard_admin --url ... migrate \
+        --cluster C --shard S --dest /path/to/new-instance
+    python -m toplingdb_tpu.tools.shard_admin --url ... balance --cluster C
+
+`status` with no --cluster lists registered clusters; with one it prints
+the shard table (range, epoch, state, fence, stall, traffic). `split` /
+`merge` / `migrate` / `balance` POST the matching /shards/<cluster>/...
+endpoint; migrate is synchronous and prints the cutover summary (new
+epoch, destination path). Keys are utf-8 by default; pass --hex to send
+--key as hex bytes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(url: str, body: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=600) as r:
+        return json.loads(r.read())
+
+
+def _fail(e) -> int:
+    if isinstance(e, urllib.error.HTTPError):
+        print(f"HTTP {e.code}: {e.read().decode()[:300]}", file=sys.stderr)
+    else:
+        print(str(e), file=sys.stderr)
+    return 1
+
+
+def cmd_status(base: str, args) -> int:
+    if not args.cluster:
+        print(json.dumps(_get(f"{base}/shards"), indent=1))
+        return 0
+    view = _get(f"{base}/shards/{args.cluster}")
+    print(f"cluster={args.cluster} map_version={view.get('map_version')} "
+          f"shards={view.get('n_shards')}")
+    for row in view.get("shards", []):
+        rng = (f"[{row.get('start_hex') or '-inf'}, "
+               f"{row.get('end_hex') or '+inf'})")
+        tr = row.get("traffic", {})
+        print(f"{row['name']}\tepoch={row['epoch']}\t{row.get('state')}"
+              f"{' FENCED' if row.get('fenced') else ''}\t{rng}\t"
+              f"stall={row.get('stall', '?')}\t"
+              f"r={tr.get('reads', 0)} w={tr.get('writes', 0)} "
+              f"wB={tr.get('write_bytes', 0)}")
+    return 0
+
+
+def _key_payload(args) -> dict:
+    if args.hex:
+        return {"split_key_hex": args.key}
+    return {"split_key": args.key}
+
+
+def cmd_split(base: str, args) -> int:
+    out = _post(f"{base}/shards/{args.cluster}/split",
+                {"shard": args.shard, **_key_payload(args)})
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_merge(base: str, args) -> int:
+    out = _post(f"{base}/shards/{args.cluster}/merge",
+                {"left": args.left, "right": args.right})
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_migrate(base: str, args) -> int:
+    out = _post(f"{base}/shards/{args.cluster}/migrate",
+                {"shard": args.shard, "dest": args.dest})
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_balance(base: str, args) -> int:
+    out = _post(f"{base}/shards/{args.cluster}/balance", {})
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shard_admin")
+    ap.add_argument("--url", required=True,
+                    help="SidePluginRepo HTTP base, e.g. http://127.0.0.1:8080")
+    ap.add_argument("--cluster", default=None)
+    ap.add_argument("--shard", default=None)
+    ap.add_argument("--key", default=None, help="split key (utf-8)")
+    ap.add_argument("--hex", action="store_true",
+                    help="--key is hex-encoded bytes")
+    ap.add_argument("--left", default=None)
+    ap.add_argument("--right", default=None)
+    ap.add_argument("--dest", default=None,
+                    help="migration destination directory")
+    ap.add_argument("command",
+                    choices=["status", "split", "merge", "migrate",
+                             "balance"])
+    args = ap.parse_args(argv)
+    base = args.url.rstrip("/")
+    need = {
+        "split": ("cluster", "shard", "key"),
+        "merge": ("cluster", "left", "right"),
+        "migrate": ("cluster", "shard", "dest"),
+        "balance": ("cluster",),
+        "status": (),
+    }[args.command]
+    missing = [f"--{n}" for n in need if getattr(args, n) is None]
+    if missing:
+        print(f"{args.command} requires {' '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    try:
+        return {"status": cmd_status, "split": cmd_split,
+                "merge": cmd_merge, "migrate": cmd_migrate,
+                "balance": cmd_balance}[args.command](base, args)
+    except Exception as e:  # noqa: BLE001 - CLI boundary
+        return _fail(e)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -382,6 +382,47 @@ def _prometheus_gauges(name: str, db) -> str:
             g("traces_started_total", st["traces_started"])
     except Exception:
         pass
+    try:
+        stall_fn = getattr(db, "write_stall_state", None)
+        if stall_fn is not None:
+            stall = stall_fn()
+            g("write_stall_state",
+              {"none": 0, "delayed": 1, "stopped": 2}.get(
+                  stall.get("state"), -1))
+            g("write_stall_l0_files", stall.get("l0_files", 0))
+            g("write_stall_micros_total", stall.get("stall_micros", 0))
+    except Exception:
+        pass
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def _prometheus_cluster_gauges(name: str, router) -> str:
+    """Per-shard gauges for a registered ShardRouter: map version, shard
+    epochs/fence state, and the router's traffic counters."""
+    lines = []
+
+    def g(metric, value, labels):
+        m = f"tpulsm_{metric}"
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m}{labels} {value}")
+
+    try:
+        status = router.status()
+        g("shard_map_version", status["map_version"],
+          f'{{cluster="{name}"}}')
+        g("shard_count", status["n_shards"], f'{{cluster="{name}"}}')
+        for row in status["shards"]:
+            lab = f'{{cluster="{name}",shard="{row["name"]}"}}'
+            g("shard_epoch", row["epoch"], lab)
+            g("shard_fenced", int(bool(row.get("fenced"))), lab)
+            g("shard_stall_state",
+              {"none": 0, "delayed": 1, "stopped": 2}.get(
+                  row.get("stall"), -1), lab)
+            for k in ("reads", "writes", "write_bytes"):
+                g(f"shard_traffic_{k}", row.get("traffic", {}).get(k, 0),
+                  lab)
+    except Exception:
+        pass
     return "\n".join(lines) + "\n" if lines else ""
 
 
@@ -392,6 +433,7 @@ class SidePluginRepo:
     def __init__(self):
         self._dbs: dict[str, object] = {}
         self._configs: dict[str, dict] = {}
+        self._clusters: dict[str, object] = {}
         self._server: ThreadingHTTPServer | None = None
 
     def attach_db(self, name: str, db, config: dict | None = None) -> None:
@@ -399,6 +441,14 @@ class SidePluginRepo:
         primary) so the HTTP layer serves its stats//replication views."""
         self._dbs[name] = db
         self._configs[name] = config or {}
+
+    def attach_cluster(self, name: str, router) -> None:
+        """Register a sharding.ShardRouter: GET /shards/<name> serves its
+        status (shard map + per-shard epoch/fence/stall/traffic), POST
+        /shards/<name>/{split,merge,migrate,balance} drive topology
+        changes (tools/shard_admin.py is the CLI), and /metrics grows
+        per-shard gauges."""
+        self._clusters[name] = router
 
     def open_db(self, config, name: str | None = None):
         """config: dict or JSON string: {"path": ..., "options": {...}}."""
@@ -491,6 +541,13 @@ class SidePluginRepo:
                                 out.append(db.stats.to_prometheus(
                                     labels=f'db="{name}"'))
                             out.append(_prometheus_gauges(name, db))
+                        for name, cl in sorted(repo._clusters.items()):
+                            out.append(
+                                _prometheus_cluster_gauges(name, cl))
+                            cs = getattr(cl, "stats", None)
+                            if cs is not None:
+                                out.append(cs.to_prometheus(
+                                    labels=f'cluster="{name}"'))
                         data = "".join(out).encode()
                         self.send_response(200)
                         self.send_header("Content-Type",
@@ -526,6 +583,12 @@ class SidePluginRepo:
                     elif parts and parts[0] == "promote":
                         name = "/".join(parts[1:])
                         code, body = repo._promote(name)
+                    elif parts and parts[0] == "shards" \
+                            and len(parts) >= 3:
+                        # POST /shards/<cluster>/{split,merge,migrate,
+                        # balance} — the sharding control plane.
+                        code, body = repo._shard_action(
+                            "/".join(parts[1:-1]), parts[-1], payload)
                     elif parts and parts[0] == "scrub":
                         # Trigger one synchronous integrity-scrub pass:
                         # POST /scrub/<name> [{"deep": true}]
@@ -668,6 +731,17 @@ class SidePluginRepo:
         if not parts or parts == ["dbs"]:
             return {"dbs": sorted(self._dbs)}
         kind, name = parts[0], "/".join(parts[1:])
+        if kind == "shards":
+            # /shards (list clusters) and /shards/<name> (one router's
+            # status: map + per-shard epoch/fence/stall/traffic rows).
+            if not name:
+                return {"clusters": sorted(self._clusters)}
+            cl = self._clusters.get(name)
+            if cl is None:
+                return None
+            out = cl.status()
+            out["map"] = cl.map.to_config()
+            return out
         if kind == "traces":
             # /traces/<name> (recent traces; ?slow=1 filters),
             # /traces/<name>/<trace_id> (one trace as Chrome trace JSON).
@@ -802,6 +876,72 @@ class SidePluginRepo:
                 }
             return out
         return None
+
+    @staticmethod
+    def _payload_key(payload: dict, field: str = "split_key") -> bytes:
+        """A key from JSON: `<field>` (utf-8 string) or `<field>_hex`."""
+        if payload.get(f"{field}_hex"):
+            return bytes.fromhex(payload[f"{field}_hex"])
+        v = payload.get(field)
+        if not isinstance(v, str) or not v:
+            raise InvalidArgument(f"need {field!r} or {field}_hex")
+        return v.encode()
+
+    def _shard_action(self, name: str, action: str, payload: dict):
+        """The sharding control plane behind POST /shards/<name>/<action>:
+        split {"shard", "split_key"|"split_key_hex"}, merge {"left",
+        "right"}, migrate {"shard", "dest"} (synchronous: replies when the
+        cutover finished or the migration aborted), balance {} (one
+        ShardBalancer pass)."""
+        cl = self._clusters.get(name)
+        if cl is None:
+            return 404, {"error": "no such cluster"}
+        if action == "split":
+            shard = payload.get("shard")
+            if not shard:
+                raise InvalidArgument("split needs 'shard'")
+            left, right = cl.split_shard(shard, self._payload_key(payload))
+            return 200, {"ok": True, "left": left.to_config(),
+                         "right": right.to_config()}
+        if action == "merge":
+            left, right = payload.get("left"), payload.get("right")
+            if not left or not right:
+                raise InvalidArgument("merge needs 'left' and 'right'")
+            orphan = cl.merge_shards(left, right)
+            if orphan is not None:
+                # Cross-backend merge: the copied-out stack is done
+                # serving; retire it here rather than leak it.
+                for db in [*orphan.followers, orphan.primary]:
+                    try:
+                        db.close()
+                    except Exception:
+                        pass
+            return 200, {"ok": True,
+                         "merged": cl.map.get(left).to_config()}
+        if action == "migrate":
+            from toplingdb_tpu.sharding.migration import (
+                MigrationAborted, ShardMigration,
+            )
+
+            shard, dest = payload.get("shard"), payload.get("dest")
+            if not shard or not dest:
+                raise InvalidArgument("migrate needs 'shard' and 'dest'")
+            try:
+                out = ShardMigration(cl, shard, dest).run()
+            except MigrationAborted as e:
+                return 500, {"error": f"migration aborted: {e}"}
+            return 200, {"ok": True, "migration": out}
+        if action == "balance":
+            from toplingdb_tpu.sharding.balancer import (
+                BalancerOptions, ShardBalancer,
+            )
+
+            kw = {k: int(v) for k, v in payload.items()
+                  if k in ("split_bytes", "split_writes", "merge_bytes",
+                           "max_shards", "min_shards")}
+            actions = ShardBalancer(cl, BalancerOptions(**kw)).run_once()
+            return 200, {"ok": True, "actions": actions}
+        return 404, {"error": f"unknown shard action {action!r}"}
 
     def _promote(self, name: str):
         """Promote a registered FollowerDB: detach it from the (dead)

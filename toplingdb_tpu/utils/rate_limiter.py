@@ -31,6 +31,35 @@ class RateLimiter:
             self._request_chunk(chunk)
             n -= chunk
 
+    def try_request(self, n: int, timeout: float = 0.0) -> bool:
+        """Bounded-wait variant of request() for admission control
+        (sharding/admission.py): take n units within `timeout` seconds or
+        return False taking nothing. Requests larger than one period's
+        budget are admitted against the full accumulated budget and carry
+        the remainder as debt (available goes negative), so a big batch
+        pays its cost by delaying LATER requests instead of blocking the
+        caller unboundedly."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._mu:
+                now = time.monotonic()
+                elapsed = now - self._last_refill
+                if elapsed >= self._period:
+                    self._available = min(
+                        self.rate * self._period,
+                        self._available + self.rate * elapsed,
+                    )
+                    self._last_refill = now
+                need = min(n, self.rate * self._period)
+                if self._available >= need:
+                    self._available -= n  # may go negative: debt
+                    self.total_through += n
+                    return True
+                now = time.monotonic()
+            if now >= deadline:
+                return False
+            time.sleep(min(self._period / 4, max(0.0, deadline - now)))
+
     def _request_chunk(self, n: int) -> None:
         while True:
             with self._mu:

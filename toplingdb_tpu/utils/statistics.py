@@ -119,6 +119,19 @@ ROUTER_FOLLOWER_READS = "replication.router.follower.reads"
 ROUTER_PRIMARY_READS = "replication.router.primary.reads"  # fallbacks
 ROUTER_STALE_SKIPS = "replication.router.stale.skips"      # applied < token
 ROUTER_BREAKER_SKIPS = "replication.router.breaker.skips"
+ROUTER_EPOCH_REJECTS = "replication.router.epoch.rejects"  # token epoch old
+# Sharding plane (toplingdb_tpu/sharding/): key-range shard map, front-door
+# router, split/merge/migration, per-tenant admission control.
+SHARD_ROUTED_READS = "shard.routed.reads"
+SHARD_ROUTED_WRITES = "shard.routed.writes"
+SHARD_TOKEN_REJECTS = "shard.token.rejects"        # shard/epoch moved → re-route
+SHARD_SPLITS = "shard.splits"
+SHARD_MERGES = "shard.merges"
+SHARD_MIGRATIONS = "shard.migrations"              # attempts started
+SHARD_MIGRATION_FAILURES = "shard.migration.failures"
+SHARD_FENCE_WAITS = "shard.fence.waits"            # writers parked at a fence
+SHARD_WRITES_SHED = "shard.writes.shed"            # admission denied (Busy)
+SHARD_ADMISSION_WAITS = "shard.admission.waits"    # rate-limit throttles
 # -- flush / WAL / files ---------------------------------------------
 FLUSH_WRITE_BYTES = "flush.write.bytes"
 NO_FILE_OPENS = "no.file.opens"
@@ -178,6 +191,8 @@ MANIFEST_FILE_SYNC_MICROS = "manifest.file.sync.micros"
 WRITE_STALL_MICROS_HIST = "write.stall.micros"
 REPLICATION_LAG_MICROS = "replication.lag.micros"  # ship→apply wall lag
 SCRUB_LATENCY_MICROS = "scrub.latency.micros"      # one scrubber pass
+SHARD_FENCE_MICROS = "shard.fence.micros"          # write-block cutover window
+SHARD_MIGRATION_MICROS = "shard.migration.micros"  # whole migration wall
 NUM_FILES_IN_SINGLE_COMPACTION = "numfiles.in.singlecompaction"
 BYTES_PER_READ = "bytes.per.read"
 BYTES_PER_WRITE = "bytes.per.write"
