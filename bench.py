@@ -654,6 +654,130 @@ def observability_rows(detail, n_db):
     detail["trace_overhead_pct"] = round(max(0.0, overhead), 2)
 
 
+def health_rows(detail, n_db):
+    """Health-plane overhead rows (ISSUE 12): fillrandom/readrandom with
+    cumulative-only histograms vs windowed histograms + a live SLO
+    engine, as interleaved A/B segments on the SAME DB (the
+    observability_rows pattern — twin DBs drift more than the effect
+    measured). The 'win' mode over-counts SLO cost on purpose: one full
+    evaluation per ~3000-op segment, far more frequent than any real
+    slo_eval_period_sec. Gate: `health_overhead_pct` <= 2, computed as
+    the median win/cum rate ratio over adjacent segment pairs (robust to
+    background-compaction spikes that whipsaw an aggregate mean)."""
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.db.write_batch import WriteBatch
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils import statistics as _st
+    from toplingdb_tpu.utils.slo import SLOEngine, SLOSpec
+    from toplingdb_tpu.utils.statistics import Statistics
+
+    n = max(60_000, min(240_000, n_db // 5))
+    batch = 100
+    seg = 3000
+    segs = {"fill": [], "read": []}  # (mode, ops_per_sec) per segment
+    keys = [b"%016d" % ((i * 2654435761) % (n * 2)) for i in range(n)]
+
+    d = tempfile.mkdtemp(prefix="benchhp_", dir="/dev/shm"
+                         if os.path.isdir("/dev/shm") else None)
+    cum = Statistics(histogram_window_sec=0)
+    win = Statistics(histogram_window_sec=60.0)
+    engine = SLOEngine(win, [
+        SLOSpec(name="get-p99", kind="latency",
+                histogram=_st.DB_GET_MICROS, objective=0.99,
+                threshold_usec=10_000),
+        SLOSpec(name="write-p99", kind="latency",
+                histogram=_st.DB_WRITE_MICROS, objective=0.99,
+                threshold_usec=50_000),
+        SLOSpec(name="stall", kind="stall", objective=0.999),
+    ], db_name="bench")
+    # Opened with the cumulative sink; the windowed twin swaps in per
+    # segment (every hot-path histogram add resolves through db.stats).
+    db = DB.open(d, Options(create_if_missing=True,
+                            write_buffer_size=1 << 30, statistics=cum))
+    import gc
+
+    modes = ("cum", "win")
+    sinks = {"cum": cum, "win": win}
+    spent = {m: [0.0, 0] for m in modes}   # wall, ops (fill)
+    rspent = {m: [0.0, 0] for m in modes}  # wall, ops (read)
+
+    def set_mode(m):
+        gc.collect(0)
+        db.stats = sinks[m]
+
+    def fill_seg(m, s0, hi):
+        set_mode(m)
+        t0 = time.perf_counter()
+        for i in range(s0, hi, batch):
+            b = WriteBatch()
+            for k in keys[i:i + batch]:
+                b.put(k, b"v" * 20)
+            db.write(b)
+        if m == "win":
+            engine.evaluate()
+        dt = time.perf_counter() - t0
+        spent[m][0] += dt
+        spent[m][1] += hi - s0
+        segs["fill"].append((m, (hi - s0) / dt))
+
+    def read_seg(m, s0, hi):
+        set_mode(m)
+        t0 = time.perf_counter()
+        for i in range(s0, hi):
+            db.get(keys[(i * 7919) % n])
+        if m == "win":
+            engine.evaluate()
+        dt = time.perf_counter() - t0
+        rspent[m][0] += dt
+        rspent[m][1] += hi - s0
+        segs["read"].append((m, (hi - s0) / dt))
+
+    try:
+        for idx, s0 in enumerate(range(0, n, seg)):
+            fill_seg(("cum", "win")[(idx + idx // 2) % 2],
+                     s0, min(s0 + seg, n))
+        set_mode("cum")
+        db.flush()
+        db.wait_for_compactions()
+        nr = min(2 * n, 300_000)
+        for i in range(0, nr, seg):
+            db.get(keys[(i * 7919) % n])  # warm caches at rotation
+        for idx, s0 in enumerate(range(0, nr, seg)):
+            read_seg(("cum", "win")[(idx + idx // 2) % 2],
+                     s0, min(s0 + seg, nr))
+    finally:
+        db.stats = cum
+        db.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+    for m in modes:
+        detail[f"fillrandom_hist_{m}_ops_s"] = round(
+            spent[m][1] / spent[m][0])
+        detail[f"readrandom_hist_{m}_ops_s"] = round(
+            rspent[m][1] / rspent[m][0])
+
+    def paired_overhead(rows):
+        # The interleave pattern is cum,win,win,cum,... — every adjacent
+        # pair holds one segment of each mode, in alternating order, so
+        # the per-pair win/cum rate ratio cancels slow drift (compaction
+        # debt) and the MEDIAN over pairs shrugs off the occasional
+        # background-compaction spike that dominates an aggregate mean.
+        ratios = []
+        for (ma, ra), (mb, rb) in zip(rows[::2], rows[1::2]):
+            if ma == mb:
+                continue
+            w, c = (ra, rb) if ma == "win" else (rb, ra)
+            ratios.append(w / c)
+        if not ratios:
+            return 0.0
+        ratios.sort()
+        return 100 * (1 - ratios[len(ratios) // 2])
+
+    overhead = max(paired_overhead(segs["fill"]),
+                   paired_overhead(segs["read"]))
+    detail["health_overhead_pct"] = round(max(0.0, overhead), 2)
+
+
 def write_plane_rows(detail, n_db):
     """Native group-commit write plane rows (ISSUE 7): protected WAL-on
     write-PATH fillrandom (prebuilt mixed-size batches so the row
@@ -1166,6 +1290,11 @@ def main():
             detail["observability_rows_error"] = repr(e)[:120]
 
         try:
+            health_rows(detail, n_db)
+        except Exception as e:  # noqa: BLE001
+            detail["health_rows_error"] = repr(e)[:120]
+
+        try:
             sharding_rows(detail)
         except Exception as e:  # noqa: BLE001
             detail["sharding_rows_error"] = repr(e)[:120]
@@ -1293,6 +1422,9 @@ def main():
             # Telemetry plane: sampled (1-in-64) tracing cost vs the
             # tracing-off twin (gate: <= 2%).
             "trace_overhead_pct": detail.get("trace_overhead_pct"),
+            # Health plane: windowed histograms + per-segment SLO
+            # evaluation vs cumulative-only twin (gate: <= 2%).
+            "health_overhead_pct": detail.get("health_overhead_pct"),
             # Sharding plane: 4-shard vs 1-shard router fillrandom ratio
             # (detail has the per-config ops/s + hot-tenant isolation).
             "shard_scaling_x": detail.get("shard_scaling_x"),

@@ -1,0 +1,542 @@
+"""Fleet health plane (ISSUE 12): windowed histograms (ring rotation,
+interpolated quantiles, exact merge), the SLO burn-rate engine (synthetic
+latency shift + a REAL induced write stall), stats-history interval rows,
+the dump-scheduler error ticker, shard health scores in the router view,
+the /health–/slo–/cluster/health HTTP surface with fleet members, the
+ReplicationServer scrape points, and the check_telemetry SLO/gauge lint.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import Options
+from toplingdb_tpu.utils import statistics as st
+from toplingdb_tpu.utils import slo as slomod
+from toplingdb_tpu.utils.listener import EventListener
+from toplingdb_tpu.utils.slo import SLOEngine, SLOSpec
+from toplingdb_tpu.utils.statistics import (Histogram, Statistics,
+                                            WindowedHistogram)
+
+
+def opts(**kw):
+    kw.setdefault("create_if_missing", True)
+    kw.setdefault("write_buffer_size", 1 << 20)
+    return Options(**kw)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Histogram quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_interpolation_and_clamping():
+    h = Histogram()
+    assert h.percentile(99) == 0.0
+    assert h.observed_min == 0.0  # empty: never inf
+    h.add(100)
+    # One sample: every quantile reports the sample itself, not the
+    # power-of-two bucket bound (128).
+    assert h.percentile(50) == 100 and h.percentile(99) == 100
+    for v in (10, 20, 40, 5000):
+        h.add(v)
+    assert h.observed_min == 10
+    assert h.percentile(0.1) >= 10
+    assert h.percentile(100) <= h.max == 5000
+    assert h.percentile(50) <= h.percentile(90) <= h.percentile(99)
+
+
+def test_fraction_above_interpolates():
+    h = Histogram()
+    for _ in range(100):
+        h.add(100)
+    assert h.fraction_above(5000) == 0.0
+    assert h.fraction_above(1) == 1.0
+    # threshold inside the occupied [64, 128) bucket: partial credit
+    assert 0.0 < h.fraction_above(100) < 1.0
+
+
+def test_histogram_merge_and_dict_roundtrip():
+    a, b = Histogram(), Histogram()
+    for v in (1, 2, 300):
+        a.add(v)
+    for v in (4_000_000, 7):
+        b.add(v)
+    m = Histogram.from_dict(a.to_dict()).merge(Histogram.from_dict(
+        b.to_dict()))
+    assert m.count == 5 and m.sum == a.sum + b.sum
+    assert m.min == 1 and m.max == 4_000_000
+    one = Histogram()
+    for v in (1, 2, 300, 4_000_000, 7):
+        one.add(v)
+    assert m.buckets == one.buckets
+
+
+# ---------------------------------------------------------------------------
+# Windowed histograms
+# ---------------------------------------------------------------------------
+
+
+def test_windowed_p99_tracks_latency_shift_cumulative_misses():
+    """The tentpole behavior: after a long healthy run, a latency shift
+    shows in the windowed p99 within one window while the cumulative p99
+    stays diluted below the alert threshold."""
+    clk = FakeClock()
+    w = WindowedHistogram(window_sec=60.0, intervals=6, clock=clk)
+    for _ in range(50_000):
+        w.add(100)  # a long healthy history of ~100us gets
+    clk.t = 70.0
+    w.windowed()  # reader-side rotation past the healthy epoch
+    for _ in range(400):
+        w.add(20_000)  # the regression: 20ms gets
+    recent = w.windowed()
+    assert recent.count == 400
+    assert recent.percentile(99) >= 10_000
+    # 400 / 50_400 = 0.8% slow: lifetime p99 never crosses the threshold
+    assert w.percentile(99) < 1_000
+    assert w.count == 50_400
+
+
+def test_windowed_ring_expiry_and_lifetime_retention():
+    clk = FakeClock()
+    w = WindowedHistogram(window_sec=60.0, intervals=6, clock=clk)
+    for _ in range(100):
+        w.add(100)
+    clk.t = 35.0
+    w.windowed()
+    for _ in range(100):
+        w.add(20_000)
+    assert w.windowed().count == 200  # both batches inside the window
+    clk.t = 75.0  # epoch 7: the t=0 batch expired, the t=35 one lives
+    win = w.windowed()
+    assert win.count == 100 and win.min == 20_000
+    clk.t = 500.0  # everything expired from the window...
+    assert w.windowed().count == 0
+    # ...but the lifetime series retains every sample exactly
+    assert w.count == 200 and w.min == 100 and w.max == 20_000
+    assert sum(w.buckets) == 200
+
+
+def test_windowed_merge_folds_into_lifetime_not_window():
+    clk = FakeClock()
+    w = WindowedHistogram(window_sec=60.0, intervals=6, clock=clk)
+    other = Histogram()
+    for _ in range(50):
+        other.add(7)
+    w.merge(other)  # merged-in data is historical
+    assert w.count == 50 and w.windowed().count == 0
+
+
+def test_windowed_merge_parity_across_members():
+    """The aggregator invariant: merging two members' windowed dumps
+    equals one histogram fed both streams."""
+    clk = FakeClock()
+    a = WindowedHistogram(window_sec=60.0, intervals=6, clock=clk)
+    b = WindowedHistogram(window_sec=60.0, intervals=6, clock=clk)
+    one = Histogram()
+    for i in range(1000):
+        v = (i % 97) + 1
+        (a if i % 2 else b).add(v)
+        one.add(v)
+    merged = Histogram.from_dict(a.windowed().to_dict()).merge(
+        Histogram.from_dict(b.windowed().to_dict()))
+    assert merged.count == one.count == 1000
+    assert merged.buckets == one.buckets
+    assert merged.sum == one.sum
+
+
+def test_statistics_windowed_wiring_and_prometheus_recent():
+    s = Statistics(histogram_window_sec=60.0)
+    for v in (100, 200, 400):
+        s.record_in_histogram(st.DB_GET_MICROS, v)
+    text = s.to_prometheus()
+    assert "_recent" in text and 'quantile="0.99"' in text
+    # window disabled -> plain histograms, no _recent series
+    s0 = Statistics(histogram_window_sec=0)
+    s0.record_in_histogram(st.DB_GET_MICROS, 100)
+    assert "_recent" not in s0.to_prometheus()
+    # re-keying rebuilds only empty histograms
+    s0.set_histogram_window(30.0, 3)
+    assert isinstance(s0._histograms[st.BYTES_PER_READ], WindowedHistogram)
+    assert not isinstance(s0._histograms[st.DB_GET_MICROS],
+                          WindowedHistogram)  # populated: kept
+
+
+# ---------------------------------------------------------------------------
+# Stats history interval rows + dump scheduler errors
+# ---------------------------------------------------------------------------
+
+
+def test_stats_history_interval_histogram_rows():
+    from toplingdb_tpu.utils.stats_history import StatsHistory
+
+    s = Statistics(histogram_window_sec=60.0)
+    sh = StatsHistory(s, max_samples=10)
+    s.record_in_histogram(st.DB_WRITE_MICROS, 100)
+    s.record_in_histogram(st.DB_WRITE_MICROS, 300)
+    sh.snapshot()
+    s.record_in_histogram(st.DB_WRITE_MICROS, 900)
+    sh.snapshot()
+    rows = sh.series()
+    assert len(rows) == 2
+    first, last = rows[0]["histograms"], rows[-1]["histograms"]
+    assert first[st.DB_WRITE_MICROS]["count"] == 2
+    assert first[st.DB_WRITE_MICROS]["sum"] == 400
+    assert last[st.DB_WRITE_MICROS]["count"] == 1
+    assert last[st.DB_WRITE_MICROS]["sum"] == 900
+    assert last[st.DB_WRITE_MICROS]["max"] >= 900
+
+
+def test_stats_dump_scheduler_error_ticker_and_stop():
+    from toplingdb_tpu.utils.stats_history import (StatsDumpScheduler,
+                                                   StatsHistory)
+
+    s = Statistics()
+    sh = StatsHistory(s, max_samples=50)
+    boom = {"n": 0}
+
+    def on_snapshot():
+        boom["n"] += 1
+        raise RuntimeError("dump line failed")
+
+    sched = StatsDumpScheduler(sh, period_sec=0.01, on_snapshot=on_snapshot)
+    deadline = time.time() + 5.0
+    while boom["n"] < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert sched.stop() is True  # clean join reported
+    assert boom["n"] >= 3
+    assert sched.errors == boom["n"]
+    assert s.get_ticker_count(st.STATS_DUMP_ERRORS) == sched.errors
+    assert sh.last_sample() is not None  # snapshots kept flowing
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", kind="bogus")
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", objective=1.0)
+    with pytest.raises(ValueError):
+        # fraction needs BOTH the bad and the total ticker sets
+        SLOSpec(name="x", kind="fraction", bad_tickers=(st.STALL_MICROS,))
+    with pytest.raises(ValueError):
+        SLOEngine(Statistics(), [SLOSpec(name="a"), SLOSpec(name="a")])
+
+
+def test_slo_burn_rate_fires_and_resolves_with_listener():
+    clk = FakeClock()
+    s = Statistics(histogram_window_sec=60.0)
+    seen = []
+
+    class L(EventListener):
+        def on_slo_alert(self, db, info):
+            seen.append(info)
+
+    eng = SLOEngine(
+        s, [SLOSpec(name="get-p99", kind="latency", objective=0.99,
+                    histogram=st.DB_GET_MICROS, threshold_usec=10_000,
+                    window_fast_sec=30.0, window_slow_sec=150.0)],
+        db_name="t", listeners=[L()], clock=clk)
+    for _ in range(200):
+        s.record_in_histogram(st.DB_GET_MICROS, 100)
+    for _ in range(3):
+        clk.t += 10.0
+        eng.evaluate()
+    assert not eng.status()["specs"]["get-p99"]["firing"]
+    assert eng.health() == slomod.HEALTH_GREEN
+    # 20% of gets go slow: burn rate ~20x the 1% budget
+    for i in range(500):
+        s.record_in_histogram(
+            st.DB_GET_MICROS, 50_000 if i % 5 == 0 else 100)
+    fired_after = None
+    for step in range(3):  # acceptance: fires within 3 windows
+        clk.t += 10.0
+        eng.evaluate()
+        if eng.status()["specs"]["get-p99"]["firing"]:
+            fired_after = step + 1
+            break
+    assert fired_after is not None and fired_after <= 3
+    assert eng.health() == slomod.HEALTH_UNHEALTHY
+    assert [a.state for a in seen] == ["firing"]
+    assert seen[0].slo_name == "get-p99" and seen[0].db_name == "t"
+    assert seen[0].burn_rate_fast >= 6.0
+    # recovery: fast burn falls below the fast threshold -> resolved
+    for _ in range(20_000):
+        s.record_in_histogram(st.DB_GET_MICROS, 100)
+    for _ in range(30):
+        clk.t += 10.0
+        eng.evaluate()
+        if not eng.status()["specs"]["get-p99"]["firing"]:
+            break
+    assert not eng.status()["specs"]["get-p99"]["firing"]
+    assert [a.state for a in seen] == ["firing", "resolved"]
+    assert s.get_ticker_count(st.SLO_ALERTS_FIRED) == 1
+    assert s.get_ticker_count(st.SLO_ALERTS_RESOLVED) == 1
+    assert s.get_ticker_count(st.SLO_EVALUATIONS) > 0
+    assert "get-p99" in eng.last_alerts()
+
+
+def test_slo_alert_under_induced_write_stall(tmp_path):
+    """The acceptance scenario on a REAL DB: level0_slowdown_writes_trigger=1
+    makes every post-flush write ride the delay ramp; the stall SLO's
+    burn rate crosses its thresholds within 3 evaluation passes."""
+    stats = Statistics(histogram_window_sec=60.0)
+    db = DB.open(str(tmp_path / "d"),
+                 opts(statistics=stats,
+                      level0_slowdown_writes_trigger=1,
+                      level0_stop_writes_trigger=100,
+                      level0_file_num_compaction_trigger=64,
+                      slo_specs=(SLOSpec(name="stall", kind="stall",
+                                         objective=0.999),),
+                      slo_window_sec=5.0))
+    try:
+        eng = db.slo_engine
+        assert eng is not None
+        eng.evaluate()  # baseline sample, everything green
+        assert eng.health() == slomod.HEALTH_GREEN
+        db.put(b"a", b"1")
+        db.flush()
+        db.put(b"b", b"2")
+        db.flush()
+        for i in range(4):
+            db.put(b"c%d" % i, b"3")  # each write sleeps on the ramp
+        assert stats.get_ticker_count(st.STALL_MICROS) > 0
+        fired = False
+        for _ in range(3):
+            time.sleep(0.02)
+            eng.evaluate()
+            if eng.status()["specs"]["stall"]["firing"]:
+                fired = True
+                break
+        assert fired
+        assert eng.health() == slomod.HEALTH_UNHEALTHY
+        # and the doc every fleet endpoint serves carries the verdict
+        doc = slomod.health_doc(db, "d")
+        assert doc["health"] == slomod.HEALTH_UNHEALTHY
+        assert doc["slo"]["specs"]["stall"]["firing"]
+        assert st.DB_WRITE_MICROS in doc["histograms"]
+    finally:
+        db.close()
+
+
+def test_health_score_rubric():
+    assert slomod.health_score() == slomod.HEALTH_GREEN
+    assert slomod.health_score(stall_state="delayed") \
+        == slomod.HEALTH_DEGRADED
+    assert slomod.health_score(stall_state="stopped") \
+        == slomod.HEALTH_UNHEALTHY
+    assert slomod.health_score(breakers_open=1) == slomod.HEALTH_DEGRADED
+    assert slomod.health_score(lag_exceeded=True) == slomod.HEALTH_DEGRADED
+    # worst input wins
+    assert slomod.health_score(stall_state="delayed",
+                               slo_health=slomod.HEALTH_UNHEALTHY) \
+        == slomod.HEALTH_UNHEALTHY
+    assert slomod.health_num(slomod.HEALTH_UNHEALTHY) == 2
+
+
+# ---------------------------------------------------------------------------
+# Shard health in the router view
+# ---------------------------------------------------------------------------
+
+
+def test_shard_health_stalled_shard_flips_while_siblings_stay_green(
+        tmp_path):
+    from toplingdb_tpu.sharding import open_local_cluster
+
+    def factory(name):
+        return opts(statistics=Statistics(),
+                    level0_slowdown_writes_trigger=1,
+                    level0_stop_writes_trigger=100,
+                    level0_file_num_compaction_trigger=64)
+
+    router = open_local_cluster(
+        str(tmp_path), [("a", None, b"m"), ("b", b"m", None)],
+        options_factory=factory, statistics=Statistics())
+    try:
+        rows = {r["name"]: r for r in router.status()["shards"]}
+        assert rows["a"]["health"] == slomod.HEALTH_GREEN
+        assert rows["b"]["health"] == slomod.HEALTH_GREEN
+        # stall ONLY shard a's primary
+        pa = router._servings["a"].primary
+        pa.put(b"a1", b"1")
+        pa.flush()
+        pa.put(b"a2", b"2")
+        pa.flush()
+        assert pa.write_stall_state()["state"] == "delayed"
+        rows = {r["name"]: r for r in router.status()["shards"]}
+        assert rows["a"]["health"] == slomod.HEALTH_DEGRADED
+        assert rows["b"]["health"] == slomod.HEALTH_GREEN  # sibling green
+        assert "breakers_open" in rows["a"]
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /slo, /health, /metrics gauges, /cluster/health + fleet
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_http_slo_health_and_cluster_fleet(tmp_path):
+    from toplingdb_tpu.replication.log_shipper import ReplicationServer
+    from toplingdb_tpu.utils.config import SidePluginRepo
+
+    stats = Statistics(histogram_window_sec=60.0)
+    db = DB.open(str(tmp_path / "d"),
+                 opts(statistics=stats,
+                      slo_specs=({"name": "get-p99", "kind": "latency",
+                                  "histogram": st.DB_GET_MICROS,
+                                  "objective": 0.99,
+                                  "threshold_usec": 10_000},),))
+    member = DB.open(str(tmp_path / "m"),
+                     opts(statistics=Statistics(histogram_window_sec=60.0)))
+    rsrv = ReplicationServer(member)
+    rport = rsrv.start()
+    repo = SidePluginRepo()
+    repo.attach_db("d", db)
+    repo.attach_fleet_member(
+        "member", f"http://127.0.0.1:{rport}/replication/health")
+    repo.attach_fleet_member("ghost", "http://127.0.0.1:9/health/x")
+    port = repo.start_http()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        db.put(b"k", b"v")
+        for _ in range(20):
+            db.get(b"k")
+        member.put(b"mk", b"mv")
+        member.get(b"mk")
+
+        out = _get_json(f"{base}/slo/d?evaluate=1")
+        assert out["health"] == slomod.HEALTH_GREEN
+        assert out["specs"]["get-p99"]["burn_rate_fast"] >= 0.0
+
+        doc = _get_json(f"{base}/health/d")
+        assert doc["name"] == "d" and doc["role"] == "primary"
+        row = doc["histograms"][st.DB_GET_MICROS]
+        assert row["cumulative"]["count"] == 20 and "recent" in row
+
+        # the member's own scrape points
+        mdoc = _get_json(
+            f"http://127.0.0.1:{rport}/replication/health")
+        assert mdoc["role"] == "primary" and "replication" in mdoc
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rport}/metrics", timeout=10) as r:
+            mtext = r.read().decode()
+        assert "tpulsm_" in mtext and 'db="m"' in mtext
+
+        cluster = _get_json(f"{base}/cluster/health")
+        assert cluster["health"] == slomod.HEALTH_UNHEALTHY  # the ghost
+        assert cluster["n_unreachable"] == 1
+        names = {m["name"]: m for m in cluster["members"]}
+        assert names["ghost"]["health"] == "unreachable"
+        # the member self-reports its identity (db basename); the
+        # registration alias only names unreachable rows
+        assert names["m"]["role"] == "primary"
+        assert names["d"]["health"] == slomod.HEALTH_GREEN
+        # merge parity: fleet cumulative gets == local + member
+        gets = cluster["histograms"][st.DB_GET_MICROS]["cumulative"]
+        assert gets["count"] == 21
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'tpulsm_slo_firing{db="d",slo="get-p99"} 0' in text
+        assert 'tpulsm_slo_health{db="d"} 0' in text
+        assert 'tpulsm_fleet_members{repo="fleet"} 2' in text
+        assert 'tpulsm_fleet_members_unreachable{repo="fleet"} 1' in text
+        assert "_recent" in text  # windowed series exposed
+    finally:
+        repo.stop_http()
+        rsrv.stop()
+        member.close()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregator units + CLI rendering
+# ---------------------------------------------------------------------------
+
+
+def _doc(name, health, n_gets):
+    h = Histogram()
+    for _ in range(n_gets):
+        h.add(100)
+    return {"name": name, "role": "primary", "health": health,
+            "stall": {"state": "none"},
+            "histograms": {st.DB_GET_MICROS: {
+                "cumulative": h.to_dict(), "recent": h.to_dict(),
+                "window_sec": 60.0}},
+            "slo": {"specs": {"s": {"firing": health != "green"}}}}
+
+
+def test_fleet_aggregator_merge_and_summarize():
+    from toplingdb_tpu.tools.fleet_health import (FleetHealthAggregator,
+                                                  render)
+
+    docs = [_doc("a", "green", 10), _doc("b", "degraded", 5)]
+    merged = FleetHealthAggregator.merge_histograms(docs)
+    assert merged[st.DB_GET_MICROS]["cumulative"].count == 15
+    summary = FleetHealthAggregator.summarize(docs, {"c": "boom"})
+    assert summary["health"] == slomod.HEALTH_UNHEALTHY  # unreachable
+    assert summary["n_members"] == 2 and summary["n_unreachable"] == 1
+    rows = {m["name"]: m for m in summary["members"]}
+    assert rows["b"]["firing"] == ["s"]
+    assert rows["c"]["health"] == "unreachable"
+    assert summary["histograms"][st.DB_GET_MICROS]["cumulative"][
+        "count"] == 15
+    text = render(summary)
+    assert "fleet health: unhealthy" in text and "MEMBER" in text
+
+
+# ---------------------------------------------------------------------------
+# check_telemetry: gauge + SLO lint
+# ---------------------------------------------------------------------------
+
+
+def test_check_telemetry_flags_bad_gauges_and_slo_specs(tmp_path):
+    from toplingdb_tpu.tools import check_telemetry as ct
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "g(\"not_a_gauge\", 1)\n"
+        "SLOSpec(name=\"x\", kind=\"bogus\")\n"
+        "SLOSpec(name=\"y\", histogram=\"no.such.hist\")\n")
+    stat_values, stat_attrs = ct.declared_stat_names()
+    out = ct.check_file(str(bad), stat_values, stat_attrs, set(),
+                        gauge_names={"memtable_bytes"},
+                        slo_kinds=set(slomod.KINDS))
+    assert len(out) == 3
+    assert any("not_a_gauge" in v for v in out)
+    assert any("bogus" in v for v in out)
+    assert any("no.such.hist" in v for v in out)
+    good = tmp_path / "good.py"
+    good.write_text(
+        "g(\"memtable_bytes\", 1)\n"
+        "SLOSpec(name=\"x\", kind=\"latency\", histogram=\"db.get.micros\")\n")
+    assert ct.check_file(str(good), stat_values, stat_attrs, set(),
+                         gauge_names={"memtable_bytes"},
+                         slo_kinds=set(slomod.KINDS)) == []
+
+
+def test_check_telemetry_tree_is_clean():
+    from toplingdb_tpu.tools.check_telemetry import run
+
+    assert run() == []

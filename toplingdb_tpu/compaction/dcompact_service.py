@@ -71,8 +71,28 @@ class DcompactWorkerService:
                     })
                 elif self.path == "/health":
                     # Liveness probe for the DB-side health registry /
-                    # half-open breaker checks.
+                    # half-open breaker checks; tools/fleet_health.py
+                    # maps this bare shape onto its health-doc format.
                     self._reply(200, {"ok": True, "device": svc.device})
+                elif self.path == "/metrics":
+                    # Minimal Prometheus exposition so the worker shows
+                    # up on the same scrape config as the DB repos.
+                    lines = []
+                    for metric, v in (("dcompact_jobs_done",
+                                       svc.jobs_done),
+                                      ("dcompact_jobs_failed",
+                                       svc.jobs_failed)):
+                        m = f"tpulsm_{metric}"
+                        lines.append(f"# TYPE {m} gauge")
+                        lines.append(
+                            f'{m}{{device="{svc.device}"}} {v}')
+                    data = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 else:
                     self._reply(404, {"error": "not found"})
 

@@ -389,7 +389,28 @@ class DB:
             StatsDumpScheduler, StatsHistory,
         )
 
+        if (self.stats is not None
+                and getattr(options, "histogram_window_sec", None) is not None
+                and options.histogram_window_sec != self.stats._window_sec):
+            # Re-key the windowed-histogram ring to the DB's knob (only
+            # empty histograms are rebuilt; a shared Statistics keeps
+            # its populated series).
+            self.stats.set_histogram_window(options.histogram_window_sec)
         self.stats_history = StatsHistory(self.stats)
+        # SLO engine (utils/slo.py): declarative burn-rate objectives
+        # over the stats; /slo/<name> + /metrics serve its verdicts and
+        # ShardRouter folds them into per-shard health scores.
+        self.slo_engine = None
+        if self.stats is not None and getattr(options, "slo_specs", ()):
+            from toplingdb_tpu.utils.slo import SLOEngine
+
+            self.slo_engine = SLOEngine(
+                self.stats, options.slo_specs, db=self,
+                db_name=dbname, listeners=options.listeners,
+                default_window_sec=getattr(options, "slo_window_sec", 60.0)
+                or 60.0)
+            if getattr(options, "slo_eval_period_sec", 0) > 0:
+                self.slo_engine.start(options.slo_eval_period_sec)
         self._stats_dumper = (
             StatsDumpScheduler(self.stats_history,
                                options.stats_persist_period_sec)
@@ -731,6 +752,8 @@ class DB:
             self._stats_dumper.stop()
         if self._stats_dump_thread is not None:
             self._stats_dump_thread.stop()
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         if self._mget_pool is not None:
             self._mget_pool.shutdown(wait=True)
             self._mget_pool = None

@@ -148,6 +148,22 @@ class Options:
     trace_slow_usec: int = 0
     # Bound on retained finished traces (and the remote-stitch index).
     trace_ring: int = 256
+    # Health plane (utils/slo.py). Windowed-histogram ring span: every
+    # `*.micros` histogram keeps, besides the cumulative series, a ring
+    # of per-interval histograms covering the trailing
+    # histogram_window_sec seconds, exposed as `*_recent` quantiles on
+    # /metrics. 0 = cumulative-only histograms (no ring).
+    histogram_window_sec: float = 60.0
+    # Declarative SLO specs: a list/tuple of slo.SLOSpec (or dicts with
+    # the same fields) evaluated with multi-window burn-rate alerting.
+    # Empty = no SLO engine.
+    slo_specs: tuple = ()
+    # Background SLO evaluation cadence (0 = manual db.slo_engine
+    # .evaluate() only — tests and embedders drive it by hand).
+    slo_eval_period_sec: float = 0.0
+    # Default fast window for specs that don't set their own; the slow
+    # window defaults to 5x this.
+    slo_window_sec: float = 60.0
     # Sampling cadence of the seqno↔time mapping (reference
     # seqno_to_time_mapping recording period).
     seqno_time_sample_period_sec: int = 60

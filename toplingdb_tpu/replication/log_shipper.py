@@ -31,6 +31,7 @@ from __future__ import annotations
 import base64
 import dataclasses
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -429,12 +430,20 @@ class ReplicationServer:
     {"frames_b64": [...], "state": {...}}; 410 when WAL retention can no
     longer serve the range. POST /replication/checkpoint {"dest": path}
     creates a bootstrap checkpoint on the shared filesystem. GET
-    /replication/status for introspection."""
+    /replication/status for introspection; GET /replication/health for
+    the fleet aggregator's health doc and GET /metrics for Prometheus
+    text — the health plane's per-member scrape points."""
 
     def __init__(self, db, shipper: LogShipper | None = None):
         self.db = db
         self.shipper = shipper or LogShipper(db)
         self._server: ThreadingHTTPServer | None = None
+
+    def _label(self) -> str:
+        """Member identity for /metrics labels and the health doc: the DB
+        directory's basename (the full path would bloat every series)."""
+        return os.path.basename(
+            str(getattr(self.db, "dbname", "")).rstrip("/")) or "primary"
 
     def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
         srv = self
@@ -454,6 +463,28 @@ class ReplicationServer:
             def do_GET(self):
                 if self.path == "/replication/status":
                     self._reply(200, srv.shipper.status())
+                elif self.path == "/replication/health":
+                    from toplingdb_tpu.utils.slo import health_doc
+
+                    try:
+                        doc = health_doc(srv.db, srv._label(),
+                                         role="primary")
+                        doc["replication"] = srv.shipper.status()
+                        self._reply(200, doc)
+                    except Exception as e:
+                        self._reply(500, {"error": repr(e)[:300]})
+                elif self.path == "/metrics":
+                    stats = getattr(srv.db, "stats", None)
+                    text = stats.to_prometheus(
+                        labels=f'db="{srv._label()}"'
+                    ) if stats is not None else ""
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 else:
                     self._reply(404, {"error": "not found"})
 

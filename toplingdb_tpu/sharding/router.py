@@ -133,6 +133,42 @@ class ShardServing:
         except Exception:
             return "none"
 
+    def health(self) -> dict:
+        """This shard's health verdict (utils/slo.health_score rubric):
+        stall state + the primary's SLO engine + open replica breakers.
+        The balancer's hysteresis signal and /shards' at-a-glance row."""
+        from toplingdb_tpu.utils import slo as _slo
+
+        engine = getattr(self.primary, "slo_engine", None)
+        slo_health, firing, last_alert = _slo.HEALTH_GREEN, [], None
+        if engine is not None:
+            s = engine.status()
+            slo_health = s["health"]
+            firing = sorted(n for n, r in s["specs"].items()
+                            if r["firing"])
+            alerts = engine.last_alerts()
+            if alerts:
+                # Most recent transition across the specs.
+                last_alert = max(
+                    alerts.values(),
+                    key=lambda a: a.get("burn_rate_fast", 0)
+                    if a.get("state") == "firing" else -1)
+        breakers_open = 0
+        try:
+            regs = self.replicas.health._breakers
+            breakers_open = sum(
+                1 for b in regs.values() if b.state == "open")
+        except Exception:
+            pass
+        return {
+            "health": _slo.health_score(
+                stall_state=self.stall_state(), slo_health=slo_health,
+                breakers_open=breakers_open),
+            "slo_firing": firing,
+            "breakers_open": breakers_open,
+            "last_slo_alert": last_alert,
+        }
+
 
 class ShardRouter:
     """Front-door router over a ShardMap. Serving stacks are attached per
@@ -580,6 +616,7 @@ class ShardRouter:
                 row["primary"] = getattr(serving.primary, "dbname", None)
                 row["followers"] = len(serving.followers)
                 row["stall"] = serving.stall_state()
+                row.update(serving.health())
                 try:
                     row["last_sequence"] = \
                         serving.primary.versions.last_sequence

@@ -12,6 +12,13 @@ Two invariants over the whole `toplingdb_tpu/` tree:
      factories (`span`, `span_under`, `span_event`, `span_event_under`,
      `start`, `start_from`, `maybe_sample`, `note_slow`) must appear in
      ARCHITECTURE.md's Telemetry span table.
+  3. Every Prometheus gauge emitted through the `g(...)` helper idiom
+     (utils/config.py's exposition blocks) with a literal metric name
+     must be declared in utils/statistics.py GAUGE_NAMES — a typo'd
+     gauge would otherwise silently fork a new series.
+  4. Every literal `SLOSpec(kind=...)` must name a kind in
+     utils/slo.py KINDS, and a literal `SLOSpec(histogram=...)` must
+     name a histogram declared in utils/statistics.py.
 
 Run: python -m toplingdb_tpu.tools.check_telemetry [repo_root]
 Exit 0 clean; 1 with one violation per line otherwise.
@@ -27,6 +34,7 @@ TICKER_FNS = {"record_tick", "record_in_histogram", "get_ticker_count",
               "get_histogram"}
 SPAN_FNS = {"span", "span_under", "span_event", "span_event_under",
             "start", "start_from", "maybe_sample", "note_slow"}
+GAUGE_FNS = {"g"}
 # Module aliases under which utils.statistics name constants are accessed.
 STAT_ALIASES = {"st", "_st", "stats_mod", "_stats_mod", "statistics",
                 "stats"}
@@ -84,7 +92,8 @@ def _first_str_arg(node: ast.Call) -> str | None:
 
 
 def check_file(path: str, stat_values: set[str], stat_attrs: set[str],
-               span_names: set[str]) -> list[str]:
+               span_names: set[str], gauge_names: set[str] = frozenset(),
+               slo_kinds: set[str] = frozenset()) -> list[str]:
     with open(path, encoding="utf-8") as f:
         src = f.read()
     try:
@@ -125,6 +134,27 @@ def check_file(path: str, stat_values: set[str], stat_attrs: set[str],
                 out.append(
                     f"{path}:{node.lineno}: span name {lit!r} is not in "
                     f"ARCHITECTURE.md's Telemetry span table")
+        if name in GAUGE_FNS:
+            lit = _first_str_arg(node)
+            if lit is not None and lit not in gauge_names:
+                out.append(
+                    f"{path}:{node.lineno}: gauge name {lit!r} is not "
+                    f"declared in utils/statistics.py GAUGE_NAMES")
+        if name == "SLOSpec":
+            for kw in node.keywords:
+                if not (isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    continue
+                if kw.arg == "kind" and kw.value.value not in slo_kinds:
+                    out.append(
+                        f"{path}:{node.lineno}: SLO kind "
+                        f"{kw.value.value!r} is not in utils/slo.py KINDS")
+                if kw.arg == "histogram" \
+                        and kw.value.value not in stat_values:
+                    out.append(
+                        f"{path}:{node.lineno}: SLO histogram "
+                        f"{kw.value.value!r} is not declared in "
+                        f"utils/statistics.py")
     return out
 
 
@@ -134,6 +164,11 @@ def run(repo_root: str | None = None) -> list[str]:
     pkg = os.path.join(repo_root, "toplingdb_tpu")
     stat_values, stat_attrs = declared_stat_names()
     span_names = span_names_in_architecture(repo_root)
+    from toplingdb_tpu.utils import slo as _slo
+    from toplingdb_tpu.utils import statistics as _stmod
+
+    gauge_names = set(_stmod.GAUGE_NAMES)
+    slo_kinds = set(_slo.KINDS)
     skip = {os.path.abspath(__file__)}
     violations = []
     for dirpath, dirnames, filenames in os.walk(pkg):
@@ -145,7 +180,8 @@ def run(repo_root: str | None = None) -> list[str]:
             if os.path.abspath(path) in skip:
                 continue
             violations.extend(
-                check_file(path, stat_values, stat_attrs, span_names))
+                check_file(path, stat_values, stat_attrs, span_names,
+                           gauge_names, slo_kinds))
     return violations
 
 

@@ -1,0 +1,176 @@
+"""Fleet health aggregator: one table over every process in a cluster.
+
+PR 8's sharding/replication/dcompact planes spread one logical store over
+many processes, each already serving its own /metrics + /slo + health
+doc. This tool (and the `/cluster/health` route in utils/config.py that
+embeds it) pulls the JSON *health documents* (utils/slo.health_doc) from
+registered fleet members — the primary, followers via ReplicationServer's
+`/replication/health`, shard-server repos via `/health/<db>`, dcompact
+workers via `/health` — merges their windowed histograms (exactly: the
+power-of-two buckets sum), folds the per-member verdicts into one fleet
+health, and renders one table.
+
+CLI:  python -m toplingdb_tpu.tools.fleet_health URL [URL ...]
+      (each URL points directly at a member's health-doc endpoint)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+from toplingdb_tpu.utils import slo as _slo
+from toplingdb_tpu.utils import statistics as _st
+
+
+def fetch_doc(url: str, timeout: float = 2.0) -> dict:
+    """GET one member's health document."""
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        doc = json.loads(r.read().decode())
+    if not isinstance(doc, dict):
+        raise ValueError(f"{url}: health doc is not a JSON object")
+    # A dcompact worker's bare /health ({"ok": true, ...}) maps onto the
+    # doc shape: reachable-and-ok is green, anything else unhealthy.
+    if "health" not in doc:
+        doc = {"role": "worker",
+               "health": _slo.HEALTH_GREEN if doc.get("ok")
+               else _slo.HEALTH_UNHEALTHY,
+               "detail": doc}  # name comes from the member registration
+    return doc
+
+
+class FleetHealthAggregator:
+    """Collects health docs from (name, url) members and merges them —
+    optionally together with locally-built docs (the embedding repo's
+    own DBs) passed straight to summarize()."""
+
+    def __init__(self, members=None, timeout: float = 2.0):
+        self.members = list(members or [])  # (name, url) pairs
+        self.timeout = timeout
+
+    def collect(self) -> tuple[list[dict], dict[str, str]]:
+        """Fetch every member; unreachable ones land in the error map
+        (and count as unhealthy in the summary) instead of raising."""
+        docs, errors = [], {}
+        for name, url in self.members:
+            try:
+                d = fetch_doc(url, timeout=self.timeout)
+                d.setdefault("name", name)
+                docs.append(d)
+            except Exception as e:
+                errors[name] = repr(e)
+        return docs, errors
+
+    @staticmethod
+    def merge_histograms(docs) -> dict[str, dict[str, _st.Histogram]]:
+        """{hist_name: {"cumulative": Histogram, "recent": Histogram}}
+        across all members — exact, because bucketed histograms merge by
+        summation (the property WindowedHistogram preserves per slot)."""
+        out: dict[str, dict[str, _st.Histogram]] = {}
+        for d in docs:
+            for hname, row in (d.get("histograms") or {}).items():
+                slot = out.setdefault(hname, {})
+                for series in ("cumulative", "recent"):
+                    if row.get(series):
+                        h = _st.Histogram.from_dict(row[series])
+                        if series in slot:
+                            slot[series].merge(h)
+                        else:
+                            slot[series] = h
+        return out
+
+    @staticmethod
+    def summarize(docs, errors=None) -> dict:
+        """One fleet view: worst-member health (unreachable = unhealthy),
+        per-member rows, and merged histogram quantiles."""
+        errors = errors or {}
+        members, worst = [], _slo.HEALTH_GREEN
+        for d in docs:
+            h = d.get("health", _slo.HEALTH_GREEN)
+            if _slo.health_num(h) > _slo.health_num(worst):
+                worst = h
+            slo_rows = (d.get("slo") or {}).get("specs") or {}
+            members.append({
+                "name": d.get("name"),
+                "role": d.get("role", "?"),
+                "health": h,
+                "stall": (d.get("stall") or {}).get("state")
+                if isinstance(d.get("stall"), dict) else d.get("stall"),
+                "firing": sorted(n for n, r in slo_rows.items()
+                                 if r.get("firing")),
+                "last_sequence": d.get("last_sequence"),
+            })
+        for name in sorted(errors):
+            worst = _slo.HEALTH_UNHEALTHY
+            members.append({"name": name, "role": "?",
+                            "health": "unreachable",
+                            "error": errors[name]})
+        hists = {}
+        for hname, slot in sorted(
+                FleetHealthAggregator.merge_histograms(docs).items()):
+            hists[hname] = {
+                series: {
+                    "count": h.count,
+                    "p50": round(h.percentile(50), 1),
+                    "p99": round(h.percentile(99), 1),
+                    "max": h.max,
+                }
+                for series, h in slot.items()
+            }
+        return {
+            "health": worst,
+            "n_members": len(docs),
+            "n_unreachable": len(errors),
+            "members": members,
+            "histograms": hists,
+        }
+
+    def run(self) -> dict:
+        docs, errors = self.collect()
+        return self.summarize(docs, errors)
+
+
+def render(summary: dict) -> str:
+    """The human table: one row per member, then the merged latency
+    quantiles."""
+    lines = [f"fleet health: {summary['health']} "
+             f"({summary['n_members']} members, "
+             f"{summary['n_unreachable']} unreachable)"]
+    fmt = "{:<24} {:<10} {:<12} {:<9} {:<16} {}"
+    lines.append(fmt.format("MEMBER", "ROLE", "HEALTH", "STALL",
+                            "LAST_SEQ", "FIRING"))
+    for m in summary["members"]:
+        lines.append(fmt.format(
+            str(m.get("name"))[:24], str(m.get("role"))[:10],
+            m.get("health", "?"), str(m.get("stall") or "-"),
+            str(m.get("last_sequence") if m.get("last_sequence")
+                is not None else "-"),
+            ",".join(m.get("firing") or []) or
+            (m.get("error", "")[:40] if m.get("error") else "-")))
+    if summary["histograms"]:
+        lines.append("")
+        hfmt = "{:<28} {:<10} {:>10} {:>10} {:>10} {:>10}"
+        lines.append(hfmt.format("HISTOGRAM", "SERIES", "COUNT", "P50",
+                                 "P99", "MAX"))
+        for hname, slot in summary["histograms"].items():
+            for series, row in slot.items():
+                lines.append(hfmt.format(
+                    hname[:28], series, row["count"], row["p50"],
+                    row["p99"], row["max"]))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv else 2
+    agg = FleetHealthAggregator([(u, u) for u in argv])
+    summary = agg.run()
+    print(render(summary))
+    return 0 if summary["health"] != _slo.HEALTH_UNHEALTHY else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -58,11 +58,17 @@ def cmd_status(base: str, args) -> int:
         rng = (f"[{row.get('start_hex') or '-inf'}, "
                f"{row.get('end_hex') or '+inf'})")
         tr = row.get("traffic", {})
+        firing = ",".join(row.get("slo_firing") or [])
+        alert = row.get("last_slo_alert") or {}
+        alert_s = (f"\tlast_alert={alert.get('slo_name')}:"
+                   f"{alert.get('state')}" if alert else "")
         print(f"{row['name']}\tepoch={row['epoch']}\t{row.get('state')}"
               f"{' FENCED' if row.get('fenced') else ''}\t{rng}\t"
+              f"health={row.get('health', '?')}"
+              f"{'!' + firing if firing else ''}\t"
               f"stall={row.get('stall', '?')}\t"
               f"r={tr.get('reads', 0)} w={tr.get('writes', 0)} "
-              f"wB={tr.get('write_bytes', 0)}")
+              f"wB={tr.get('write_bytes', 0)}{alert_s}")
     return 0
 
 

@@ -143,6 +143,7 @@ _SIMPLE_OPTION_KEYS = {
     "protection_bytes_per_key", "file_checksum",
     "integrity_scrub_period_sec", "integrity_scrub_bytes_per_sec",
     "enable_async_wal", "async_wal_ring_size",
+    "histogram_window_sec", "slo_eval_period_sec", "slo_window_sec",
 }
 
 # MergeOperator.name() → registry key, for options_to_config round-trips.
@@ -189,6 +190,10 @@ def options_from_config(cfg: dict):
             opts.dcompact = DcompactOptions.from_config(v)
         elif k == "statistics":
             opts.statistics = reg.create("statistics", v)
+        elif k == "slo_specs":
+            # Plain dicts straight from JSON; utils/slo.SLOEngine
+            # normalizes them into SLOSpec at engine construction.
+            opts.slo_specs = tuple(v)
         elif k == "table_options":
             t = TableOptions()
             for tk, tv in v.items():
@@ -233,6 +238,13 @@ def options_to_config(opts) -> dict:
         out["compaction_filter"] = "remove_empty_value"
     if opts.statistics is not None:
         out["statistics"] = "default"
+    if getattr(opts, "slo_specs", ()):
+        from dataclasses import asdict, is_dataclass
+
+        out["slo_specs"] = [
+            asdict(s) if is_dataclass(s) else dict(s)
+            for s in opts.slo_specs
+        ]
     if opts.dcompact is not None:
         dc = opts.dcompact.to_config()
         if dc:
@@ -393,6 +405,20 @@ def _prometheus_gauges(name: str, db) -> str:
             g("write_stall_micros_total", stall.get("stall_micros", 0))
     except Exception:
         pass
+    try:
+        engine = getattr(db, "slo_engine", None)
+        if engine is not None:
+            from toplingdb_tpu.utils.slo import health_num
+
+            s = engine.status()
+            g("slo_health", health_num(s["health"]))
+            for sname, row in sorted(s["specs"].items()):
+                sl = f'{{db="{name}",slo="{sname}"}}'
+                g("slo_burn_rate_fast", row["burn_rate_fast"], sl)
+                g("slo_burn_rate_slow", row["burn_rate_slow"], sl)
+                g("slo_firing", int(row["firing"]), sl)
+    except Exception:
+        pass
     return "\n".join(lines) + "\n" if lines else ""
 
 
@@ -418,6 +444,10 @@ def _prometheus_cluster_gauges(name: str, router) -> str:
             g("shard_stall_state",
               {"none": 0, "delayed": 1, "stopped": 2}.get(
                   row.get("stall"), -1), lab)
+            if row.get("health") is not None:
+                from toplingdb_tpu.utils.slo import health_num
+
+                g("shard_health", health_num(row["health"]), lab)
             for k in ("reads", "writes", "write_bytes"):
                 g(f"shard_traffic_{k}", row.get("traffic", {}).get(k, 0),
                   lab)
@@ -434,6 +464,13 @@ class SidePluginRepo:
         self._dbs: dict[str, object] = {}
         self._configs: dict[str, dict] = {}
         self._clusters: dict[str, object] = {}
+        # Remote fleet members for /cluster/health: (name, url) pairs,
+        # each url pointing at a health-doc endpoint (/health/<db> on a
+        # sibling repo, /replication/health on a follower's
+        # ReplicationServer, /health on a dcompact worker).
+        self._fleet: list[tuple[str, str]] = []
+        self._fleet_timeout = 2.0
+        self._fleet_last_errors: dict[str, str] = {}
         self._server: ThreadingHTTPServer | None = None
 
     def attach_db(self, name: str, db, config: dict | None = None) -> None:
@@ -449,6 +486,12 @@ class SidePluginRepo:
         changes (tools/shard_admin.py is the CLI), and /metrics grows
         per-shard gauges."""
         self._clusters[name] = router
+
+    def attach_fleet_member(self, name: str, url: str) -> None:
+        """Register a remote process for /cluster/health aggregation;
+        `url` must serve a health document (utils/slo.health_doc shape,
+        or a dcompact worker's bare /health)."""
+        self._fleet.append((name, url))
 
     def open_db(self, config, name: str | None = None):
         """config: dict or JSON string: {"path": ..., "options": {...}}."""
@@ -548,6 +591,8 @@ class SidePluginRepo:
                             if cs is not None:
                                 out.append(cs.to_prometheus(
                                     labels=f'cluster="{name}"'))
+                        if repo._fleet:
+                            out.append(repo._fleet_gauges())
                         data = "".join(out).encode()
                         self.send_response(200)
                         self.send_header("Content-Type",
@@ -777,12 +822,36 @@ class SidePluginRepo:
                 window = 0
             if window > 0:
                 start = int(_time.time()) - window
-            samples = db.stats_history.get(start_time=start)
+            samples = db.stats_history.series(start_time=start)
             return {
                 "window_sec": window or None,
                 "n_samples": len(samples),
-                "samples": [{"ts": ts, "tickers": d} for ts, d in samples],
+                "samples": samples,
             }
+        if kind == "cluster" and name == "health":
+            # The fleet view: every registered DB's local health doc +
+            # every attach_fleet_member() remote, merged into one table.
+            return self._cluster_health()
+        if kind == "slo":
+            # /slo/<name>: the SLO engine's burn-rate rows;
+            # ?evaluate=1 forces one evaluation pass first (ops/tests).
+            db = self._dbs.get(name)
+            engine = getattr(db, "slo_engine", None) \
+                if db is not None else None
+            if engine is None:
+                return None
+            if query.get("evaluate") in ("1", "true"):
+                engine.evaluate()
+            return engine.status()
+        if kind == "health":
+            # /health/<name>: this member's aggregator health doc — what
+            # a sibling repo's /cluster/health scrapes.
+            db = self._dbs.get(name)
+            if db is None:
+                return None
+            from toplingdb_tpu.utils.slo import health_doc
+
+            return health_doc(db, name, role=self._role_of(db))
         db = self._dbs.get(name)
         if db is None:
             return None
@@ -876,6 +945,65 @@ class SidePluginRepo:
                 }
             return out
         return None
+
+    @staticmethod
+    def _role_of(db) -> str:
+        """Role for a local DB's health doc: whatever the replication
+        plane reports, else primary/readonly."""
+        provider = getattr(db, "_repl_status_provider", None)
+        if provider is not None:
+            try:
+                return str(provider().get("role", "primary"))
+            except Exception:
+                pass
+        return ("standalone-readonly"
+                if getattr(db.options, "read_only", False) else "primary")
+
+    def _fleet_gauges(self) -> str:
+        """Registry-size gauges for /metrics. Reachability reflects the
+        LAST /cluster/health collection — a scrape must not itself probe
+        the fleet."""
+        lines = []
+
+        def g(metric, value):
+            m = f"tpulsm_{metric}"
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f'{m}{{repo="fleet"}} {value}')
+
+        g("fleet_members", len(self._fleet))
+        g("fleet_members_unreachable", len(self._fleet_last_errors))
+        return "\n".join(lines) + "\n"
+
+    def _cluster_health(self) -> dict:
+        """GET /cluster/health: local DBs' health docs + remote fleet
+        members, merged by tools/fleet_health.py; per-cluster shard
+        health rows ride along so one page answers 'which shard'."""
+        from toplingdb_tpu.tools.fleet_health import FleetHealthAggregator
+        from toplingdb_tpu.utils.slo import health_doc
+
+        docs = [health_doc(db, name, role=self._role_of(db))
+                for name, db in sorted(self._dbs.items())]
+        agg = FleetHealthAggregator(self._fleet,
+                                    timeout=self._fleet_timeout)
+        remote_docs, errors = agg.collect()
+        self._fleet_last_errors = errors
+        out = FleetHealthAggregator.summarize(docs + remote_docs, errors)
+        clusters = {}
+        for cname, cl in sorted(self._clusters.items()):
+            try:
+                rows = [
+                    {"name": r["name"], "health": r.get("health"),
+                     "stall": r.get("stall"),
+                     "slo_firing": r.get("slo_firing"),
+                     "last_alert": r.get("last_slo_alert")}
+                    for r in cl.status()["shards"]
+                ]
+                clusters[cname] = {"shards": rows}
+            except Exception as e:
+                clusters[cname] = {"error": repr(e)}
+        if clusters:
+            out["clusters"] = clusters
+        return out
 
     @staticmethod
     def _payload_key(payload: dict, field: str = "split_key") -> bytes:

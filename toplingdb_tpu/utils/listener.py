@@ -84,6 +84,24 @@ class CorruptionInfo:
     checksum_func_name: str = ""
 
 
+@dataclass
+class SLOAlertInfo:
+    """A multi-window burn-rate SLO alert TRANSITION (utils/slo.py):
+    fired when both the fast and slow windows burn error budget faster
+    than the spec's thresholds, resolved when the fast window recovers."""
+
+    db_name: str
+    slo_name: str
+    kind: str             # "latency" / "fraction" / "stall" / "replication_lag"
+    state: str            # "firing" / "resolved"
+    burn_rate_fast: float
+    burn_rate_slow: float
+    value: float          # last bad-fraction over the fast window
+    objective: float
+    window_fast_sec: float
+    window_slow_sec: float
+
+
 class EventListener:
     """Override any subset (reference EventListener)."""
 
@@ -112,6 +130,9 @@ class EventListener:
         pass
 
     def on_corruption_detected(self, db, info: CorruptionInfo) -> None:
+        pass
+
+    def on_slo_alert(self, db, info: SLOAlertInfo) -> None:
         pass
 
 

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from collections import defaultdict
 
 # Ticker names, grouped by the reference's families
@@ -166,6 +167,12 @@ INTEGRITY_SCRUB_PASSES = "integrity.scrub.passes"
 INTEGRITY_BYTES_VERIFIED = "integrity.bytes.verified"
 INTEGRITY_CORRUPTIONS_DETECTED = "integrity.corruptions.detected"
 INTEGRITY_PROTECTION_MISMATCHES = "integrity.protection.mismatches"
+# -- health plane (utils/slo.py, utils/stats_history.py) -------------
+SLO_EVALUATIONS = "slo.evaluations"                # engine passes
+SLO_WINDOWS_BREACHED = "slo.windows.breached"      # fast+slow both over
+SLO_ALERTS_FIRED = "slo.alerts.fired"              # firing transitions
+SLO_ALERTS_RESOLVED = "slo.alerts.resolved"        # recovery transitions
+STATS_DUMP_ERRORS = "stats.dump.errors"            # swallowed on_snapshot
 
 # Histogram names (reference Histograms enum families).
 DB_GET_MICROS = "db.get.micros"
@@ -199,9 +206,33 @@ BYTES_PER_WRITE = "bytes.per.write"
 WRITE_GROUP_BYTES = "write.group.bytes"  # bytes merged per commit group
 NUM_SUBCOMPACTIONS_SCHEDULED = "num.subcompactions.scheduled"
 
+# Every `tpulsm_<name>` gauge the HTTP planes may emit (config.py g(),
+# replication/dcompact /metrics). tools/check_telemetry.py lints literal
+# gauge emissions against this set so a typo'd metric name fails CI
+# instead of silently forking a new series.
+GAUGE_NAMES = frozenset({
+    # per-DB gauges (config._prometheus_gauges)
+    "memtable_bytes", "immutable_memtables", "level_files", "level_bytes",
+    "last_sequence", "async_wal_ring_depth", "dcompaction_breaker_state",
+    "trace_ring_retained", "traces_started_total",
+    "write_stall_state", "write_stall_l0_files", "write_stall_micros_total",
+    # per-cluster gauges (config._prometheus_cluster_gauges)
+    "shard_map_version", "shard_count", "shard_epoch", "shard_fenced",
+    "shard_stall_state", "shard_health",
+    # SLO engine gauges (config: /metrics burn-rate block)
+    "slo_burn_rate_fast", "slo_burn_rate_slow", "slo_firing", "slo_health",
+    # fleet aggregator gauges (/cluster/health)
+    "fleet_members", "fleet_members_unreachable",
+    # dcompact worker /metrics
+    "dcompact_jobs_done", "dcompact_jobs_failed",
+})
+
 
 class Histogram:
-    """Power-of-two bucketed histogram (lock-free-ish: GIL-atomic adds)."""
+    """Power-of-two bucketed histogram (lock-free-ish: GIL-atomic adds).
+    Bucket b holds values in [2^(b-1), 2^b) (b=0 holds [0, 1)), so two
+    histograms merge exactly by summing buckets — the property the
+    windowed ring and the fleet aggregator both lean on."""
 
     __slots__ = ("buckets", "count", "sum", "min", "max")
 
@@ -226,16 +257,92 @@ class Histogram:
     def average(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    @property
+    def observed_min(self) -> float:
+        """min with the empty case guarded: an empty histogram reports
+        0.0, never `inf` (which would corrupt Prometheus exposition)."""
+        return 0.0 if self.count == 0 else float(self.min)
+
     def percentile(self, p: float) -> float:
+        """In-bucket-interpolated quantile, clamped to [min, max].
+        The plain power-of-two bucket upper bound was up to 2x above the
+        true value; assuming a uniform spread inside the crossing bucket
+        and clamping to the observed extremes keeps every quantile inside
+        the data's actual range (a one-sample histogram reports the
+        sample itself)."""
         if not self.count:
             return 0.0
         target = self.count * p / 100.0
         acc = 0
         for b, n in enumerate(self.buckets):
+            if not n:
+                continue
+            if acc + n >= target:
+                lo = float(1 << (b - 1)) if b else 0.0
+                hi = float(1 << b)
+                if hi <= lo:  # bucket 63 clamp overflow guard
+                    hi = lo * 2.0
+                v = lo + (hi - lo) * ((target - acc) / n)
+                return min(max(v, self.observed_min), float(self.max))
             acc += n
-            if acc >= target:
-                return float(1 << b)
         return float(self.max)
+
+    def fraction_above(self, threshold: float) -> float:
+        """Fraction of recorded values above `threshold`, interpolating
+        inside the bucket the threshold lands in — the SLO engine's
+        bad-event estimator for latency objectives."""
+        if not self.count:
+            return 0.0
+        above = 0.0
+        for b, n in enumerate(self.buckets):
+            if not n:
+                continue
+            lo = float(1 << (b - 1)) if b else 0.0
+            hi = float(1 << b)
+            if hi <= lo:
+                hi = lo * 2.0
+            if threshold < lo:
+                above += n
+            elif threshold < hi:
+                above += n * (hi - threshold) / (hi - lo)
+        return min(1.0, above / self.count)
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold `other` into self (exact: buckets sum). Returns self."""
+        sb, ob = self.buckets, other.buckets
+        for i in range(64):
+            if ob[i]:
+                sb[i] += ob[i]
+        self.count += other.count
+        self.sum += other.sum
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        return self
+
+    def to_dict(self) -> dict:
+        """JSON-portable form (sparse buckets) — the aggregator wire
+        format; from_dict() round-trips it and merge() recombines."""
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": None if self.count == 0 else self.min,
+            "max": self.max,
+            "buckets": {str(i): n for i, n in enumerate(self.buckets) if n},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Histogram":
+        h = cls()
+        h.count = int(d.get("count", 0))
+        h.sum = d.get("sum", 0)
+        mn = d.get("min")
+        h.min = math.inf if mn is None else mn
+        h.max = d.get("max", 0)
+        for i, n in (d.get("buckets") or {}).items():
+            h.buckets[int(i)] = int(n)
+        return h
 
     def to_string(self) -> str:
         return (
@@ -245,15 +352,185 @@ class Histogram:
         )
 
 
+class WindowedHistogram(Histogram):
+    """Histogram with a ring of K per-interval slots AND a lifetime view.
+
+    The hot path writes ONE place: add() lands in the ring slot covering
+    the current `window_sec / intervals`-second interval (a single bucket
+    add plus a countdown — the clock is only consulted every
+    `_CHECK_EVERY` adds, so attribution near an interval boundary can lag
+    by up to `_CHECK_EVERY - 1` samples, which a health plane does not
+    care about). Slots evicted from the ring fold into a lifetime base
+    histogram, and the cumulative attributes (`count`, `sum`, `min`,
+    `max`, `buckets`) are derived on read as base ⊕ live slots — exact,
+    because power-of-two buckets merge by summation. That keeps the
+    per-add cost within noise of a plain Histogram (the bench gate
+    asserts ≤2% on fill+read) while `windowed()` still answers recent
+    quantiles from at most the last `window_sec` seconds — a p99
+    regression after an hour of uptime shows up within one window instead
+    of being diluted into the lifetime distribution. Readers rotate too
+    (`windowed()` checks the clock unconditionally), so a stale slot
+    never leaks into a fresh window after a quiet period."""
+
+    __slots__ = ("window_sec", "interval_sec", "_ring", "_ring_epochs",
+                 "_folded", "_slot", "_slot_epoch", "_clock", "_tick")
+
+    _CHECK_EVERY = 16  # adds between clock reads on the hot path
+
+    def __init__(self, window_sec: float = 60.0, intervals: int = 6,
+                 clock=None):
+        # Deliberately no super().__init__(): the Histogram attrs are
+        # shadowed by the derived properties below.
+        intervals = max(1, int(intervals))
+        self.window_sec = float(window_sec)
+        self.interval_sec = max(1e-9, self.window_sec / intervals)
+        self._ring = [Histogram() for _ in range(intervals)]
+        self._ring_epochs = [-1] * intervals
+        self._folded = Histogram()
+        self._clock = clock if clock is not None else time.monotonic
+        e = int(self._clock() / self.interval_sec)
+        i = e % intervals
+        self._ring_epochs[i] = e
+        self._slot = self._ring[i]
+        self._slot_epoch = e
+        self._tick = self._CHECK_EVERY
+
+    # Lifetime view: folded evicted slots ⊕ live ring. Read-side cost is
+    # O(intervals) (O(64 * intervals) for buckets); every reader of these
+    # is a cold path (exposition, snapshots, SLO evaluation).
+
+    @property
+    def count(self) -> int:
+        c = self._folded.count
+        for h in self._ring:
+            c += h.count
+        return c
+
+    @property
+    def sum(self):
+        s = self._folded.sum
+        for h in self._ring:
+            s += h.sum
+        return s
+
+    @property
+    def min(self):
+        m = self._folded.min
+        for h in self._ring:
+            if h.min < m:
+                m = h.min
+        return m
+
+    @property
+    def max(self):
+        m = self._folded.max
+        for h in self._ring:
+            if h.max > m:
+                m = h.max
+        return m
+
+    @property
+    def buckets(self) -> list:
+        out = list(self._folded.buckets)
+        for h in self._ring:
+            if h.count:
+                hb = h.buckets
+                for i in range(64):
+                    if hb[i]:
+                        out[i] += hb[i]
+        return out
+
+    def _rotate(self, epoch: int) -> None:
+        ring = self._ring
+        k = len(ring)
+        steps = epoch - self._slot_epoch
+        if steps <= 0:
+            return
+        # Every interval entered (or skipped over) evicts whatever slot
+        # held its ring index: fold it into the lifetime base, then give
+        # the index a fresh object (a reader merging the ring
+        # concurrently keeps a consistent old slot).
+        lo = self._slot_epoch + 1 if steps < k else epoch - k + 1
+        for e in range(lo, epoch + 1):
+            old = ring[e % k]
+            if old.count:
+                self._folded.merge(old)
+            ring[e % k] = Histogram()
+            self._ring_epochs[e % k] = -1
+        self._ring_epochs[epoch % k] = epoch
+        self._slot = ring[epoch % k]
+        self._slot_epoch = epoch
+
+    def add(self, v: float) -> None:
+        t = self._tick - 1
+        if t > 0:
+            self._tick = t
+        else:
+            self._tick = self._CHECK_EVERY
+            epoch = int(self._clock() / self.interval_sec)
+            if epoch != self._slot_epoch:
+                self._rotate(epoch)
+        self._slot.add(v)
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        # Merged-in data is historical, not "recent": it folds into the
+        # lifetime base so the window stays honest.
+        self._folded.merge(other)
+        return self
+
+    def windowed(self, seconds: float | None = None) -> Histogram:
+        """Merge the live ring slots (at most the trailing `seconds`,
+        default the full window) into one mergeable Histogram."""
+        now_epoch = int(self._clock() / self.interval_sec)
+        if now_epoch != self._slot_epoch:
+            self._rotate(now_epoch)
+            self._tick = self._CHECK_EVERY
+        k = len(self._ring)
+        span = k if seconds is None else min(
+            k, max(1, math.ceil(seconds / self.interval_sec)))
+        lo = now_epoch - span + 1
+        out = Histogram()
+        for i in range(k):
+            e = self._ring_epochs[i]
+            if lo <= e <= now_epoch:
+                out.merge(self._ring[i])
+        return out
+
+
 class Statistics:
-    def __init__(self):
+    def __init__(self, histogram_window_sec: float = 60.0,
+                 histogram_window_intervals: int = 6):
         self._tickers: dict[str, int] = defaultdict(int)
-        self._histograms: dict[str, Histogram] = defaultdict(Histogram)
+        self._window_sec = float(histogram_window_sec)
+        self._window_intervals = max(1, int(histogram_window_intervals))
+        self._histograms: dict[str, Histogram] = defaultdict(
+            self._new_histogram)
         self._lock = threading.Lock()
         # Hot read-path histograms pre-created so record_get skips the
         # defaultdict machinery per call.
         self._h_get_micros = self._histograms[DB_GET_MICROS]
         self._h_bytes_read = self._histograms[BYTES_PER_READ]
+
+    def _new_histogram(self) -> Histogram:
+        """histogram_window_sec > 0 → windowed (cumulative + recent ring);
+        0 disables the ring entirely (plain cumulative Histogram)."""
+        if self._window_sec > 0:
+            return WindowedHistogram(self._window_sec, self._window_intervals)
+        return Histogram()
+
+    def set_histogram_window(self, window_sec: float,
+                             intervals: int = 6) -> None:
+        """Re-key the windowed ring (Options.histogram_window_sec wiring).
+        Only empty histograms are rebuilt — a populated cumulative series
+        is never discarded mid-flight."""
+        with self._lock:
+            self._window_sec = float(window_sec)
+            self._window_intervals = max(1, int(intervals))
+            for name, h in list(self._histograms.items()):
+                if h.count == 0:
+                    self._histograms[name] = self._new_histogram()
+            self._h_get_micros = self._histograms[DB_GET_MICROS]
+            self._h_bytes_read = self._histograms[BYTES_PER_READ]
 
     def record_get(self, micros: float, val_len, src) -> None:
         """ONE-lock fast path for the per-Get ticker/histogram family
@@ -388,6 +665,19 @@ class Statistics:
             for q, val in ((0.5, h.percentile(50)), (0.99, h.percentile(99))):
                 ql = (labels + "," if labels else "") + f'quantile="{q}"'
                 lines.append(f"{m}{{{ql}}} {val}")
+            if isinstance(h, WindowedHistogram):
+                # Recent-window twin: quantiles over the trailing ring
+                # only, so a p99 regression shows within one window.
+                w = h.windowed()
+                r = f"{m}_recent"
+                lines.append(f"# TYPE {r} summary")
+                lines.append(f"{r}_count{lab} {w.count}")
+                lines.append(f"{r}_sum{lab} {w.sum}")
+                for q, val in ((0.5, w.percentile(50)),
+                               (0.95, w.percentile(95)),
+                               (0.99, w.percentile(99))):
+                    ql = (labels + "," if labels else "") + f'quantile="{q}"'
+                    lines.append(f"{r}{{{ql}}} {val}")
         return "\n".join(lines) + "\n"
 
     def to_string(self) -> str:
