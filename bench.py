@@ -778,6 +778,111 @@ def health_rows(detail, n_db):
     detail["health_overhead_pct"] = round(max(0.0, overhead), 2)
 
 
+def concurrency_rows(detail, n_db):
+    """Concurrency-plane overhead rows (ISSUE 13).
+
+    `lock_factory_overhead_pct`: off-mode `ccy.Lock(name)` hands back a
+    PLAIN threading.Lock, so an acquire/release spin through it must
+    price identically to a raw lock — best-of interleaved reps, gate
+    <= 1%.
+
+    `lock_debug_overhead_pct`: fillrandom with every DB lock created as
+    an instrumented debug wrapper vs a plain twin. Lock mode is fixed at
+    creation time, so this is a twin-DB A/B: the same key segments run
+    on both DBs in alternating order and the MEDIAN per-segment rate
+    ratio sets the row (the health_rows drift argument). Reported as
+    slowdown-minus-one percent; gate <= 100 (debug stays within 2x).
+    The debug twin doubles as a soak: a lock inversion anywhere on the
+    write path would raise out of this row."""
+    import threading
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.db.write_batch import WriteBatch
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.utils import concurrency as ccy
+
+    # -- factory microbench (off mode) -----------------------------------
+    raw = threading.Lock()
+    fac = ccy.Lock("bench.concurrency_rows.fac")
+    spins = 200_000
+
+    def spin(lk):
+        t0 = time.perf_counter()
+        for _ in range(spins):
+            with lk:
+                pass
+        return time.perf_counter() - t0
+
+    best = {"raw": float("inf"), "fac": float("inf")}
+    for rep in range(7):
+        order = (("raw", raw), ("fac", fac)) if rep % 2 == 0 \
+            else (("fac", fac), ("raw", raw))
+        for name, lk in order:
+            best[name] = min(best[name], spin(lk))
+    detail["lock_factory_overhead_pct"] = round(
+        max(0.0, 100.0 * (best["fac"] / best["raw"] - 1.0)), 2)
+
+    # -- debug-wrapper fillrandom A/B (twin DBs) --------------------------
+    n = max(40_000, min(120_000, n_db // 10))
+    seg = 2000
+    batch = 100
+    keys = [b"%016d" % ((i * 2654435761) % (n * 2)) for i in range(n)]
+
+    ccy.reset_lock_graph()
+    dbs = {}
+    try:
+        for mode in ("off", "dbg"):
+            d = tempfile.mkdtemp(prefix=f"benchccy_{mode}_",
+                                 dir="/dev/shm"
+                                 if os.path.isdir("/dev/shm") else None)
+            ccy.set_debug(mode == "dbg")
+            try:
+                dbs[mode] = (DB.open(d, Options(create_if_missing=True,
+                                                write_buffer_size=1 << 30)),
+                             d)
+            finally:
+                ccy.set_debug(False)
+
+        spent = {m: [0.0, 0] for m in ("off", "dbg")}
+        ratios = []
+
+        def fill_seg(mode, s0, hi):
+            db = dbs[mode][0]
+            t0 = time.perf_counter()
+            for i in range(s0, hi, batch):
+                b = WriteBatch()
+                for k in keys[i:i + batch]:
+                    b.put(k, b"v" * 20)
+                db.write(b)
+            dt = time.perf_counter() - t0
+            spent[mode][0] += dt
+            spent[mode][1] += hi - s0
+            return (hi - s0) / dt
+
+        for idx, s0 in enumerate(range(0, n, seg)):
+            hi = min(s0 + seg, n)
+            order = ("off", "dbg") if idx % 2 == 0 else ("dbg", "off")
+            rates = {m: fill_seg(m, s0, hi) for m in order}
+            ratios.append(rates["dbg"] / rates["off"])
+
+        for m in ("off", "dbg"):
+            detail[f"fillrandom_lock_{m}_ops_s"] = round(
+                spent[m][1] / spent[m][0])
+        ratios.sort()
+        median = ratios[len(ratios) // 2]
+        detail["lock_debug_overhead_pct"] = round(
+            max(0.0, 100.0 * (1.0 / median - 1.0)), 2)
+        detail["lock_debug_edges"] = len(ccy.lock_order_edges())
+    finally:
+        for db, d in dbs.values():
+            try:
+                db.close()
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+        ccy.set_debug(False)
+        ccy.reset_lock_graph()
+
+
 def write_plane_rows(detail, n_db):
     """Native group-commit write plane rows (ISSUE 7): protected WAL-on
     write-PATH fillrandom (prebuilt mixed-size batches so the row
@@ -1299,6 +1404,11 @@ def main():
         except Exception as e:  # noqa: BLE001
             detail["sharding_rows_error"] = repr(e)[:120]
 
+        try:
+            concurrency_rows(detail, n_db)
+        except Exception as e:  # noqa: BLE001
+            detail["concurrency_rows_error"] = repr(e)[:120]
+
         # Range-axis weak-scaling of the distributed GC step (VERDICT r04
         # item 10): a subprocess because virtual device counts must be set
         # before the jax backend exists. Failure just drops the row.
@@ -1428,6 +1538,13 @@ def main():
             # Sharding plane: 4-shard vs 1-shard router fillrandom ratio
             # (detail has the per-config ops/s + hot-tenant isolation).
             "shard_scaling_x": detail.get("shard_scaling_x"),
+            # Concurrency plane: off-mode factories must price as raw
+            # locks (gate: <= 1%) and debug-instrumented fillrandom must
+            # stay within 2x of plain (gate: <= 100).
+            "lock_factory_overhead_pct": detail.get(
+                "lock_factory_overhead_pct"),
+            "lock_debug_overhead_pct": detail.get(
+                "lock_debug_overhead_pct"),
         }
 
     line = json.dumps(make_record(detail))

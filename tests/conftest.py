@@ -30,6 +30,24 @@ if "jax" in sys.modules:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from tier-1 (-m 'not slow')")
+
+
+@pytest.fixture
+def no_thread_leaks():
+    """Opt-in guard: the test must not leave any ThreadRegistry-tracked
+    background thread behind (concurrency plane, ISSUE 13)."""
+    from toplingdb_tpu.utils import concurrency as ccy
+
+    before = {id(t) for t in ccy.registry.live()}
+    yield
+    leaked = [t.name for t in ccy.registry.live() if id(t) not in before]
+    assert not leaked, f"test leaked registered threads: {leaked}"
+
+
 @pytest.fixture
 def mem_env():
     from toplingdb_tpu.env import MemEnv

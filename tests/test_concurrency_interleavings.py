@@ -1,0 +1,143 @@
+"""Sync-point interleaving tests for the two cross-thread seams the
+concurrency plane pins down:
+
+1. db.py — a staged write group's async-WAL durability barrier vs the
+   memtable switch (which closes the WAL the group appended to). The
+   `_mt_inflight` drain in `_switch_memtable` is the protocol; the
+   dependency forces the switch to start only once a group has entered
+   its barrier window, so the drain handshake (cv wait vs completion
+   notify) actually runs under contention.
+2. sharding — a writer parked at a closed write fence vs the migration
+   cutover. The dependency holds the cutover until a writer is parked,
+   so the parked writer MUST wake into the post-swap world and
+   re-resolve onto the new primary (epoch bump).
+
+Both tests drive the orders with
+`get_sync_point_registry().load_dependency(...)` — no sleeps.
+"""
+
+import threading
+import time
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.options import FlushOptions, Options, WriteOptions
+from toplingdb_tpu.sharding import ShardMigration, open_local_cluster
+from toplingdb_tpu.utils.statistics import Statistics
+from toplingdb_tpu.utils.sync_point import get_sync_point_registry
+
+
+@pytest.fixture
+def sync_points():
+    reg = get_sync_point_registry()
+    reg.clear_all()
+    yield reg
+    reg.clear_all()
+
+
+def test_wal_barrier_vs_memtable_switch(tmp_path, sync_points):
+    """Forced order: a pipelined group reaches its async-WAL barrier ->
+    THEN the flush thread's memtable switch may start. The switch closes
+    the group's WAL; every acknowledged write must survive reopen."""
+    reg = sync_points
+    opts = Options(create_if_missing=True, enable_pipelined_write=True,
+                   enable_async_wal=True)
+    db = DB.open(str(tmp_path / "db"), opts)
+    at_barrier = threading.Event()
+    reg.set_callback("DBImpl::GroupCommit:BeforeWALBarrier",
+                     lambda _arg: at_barrier.set())
+    reg.load_dependency([
+        ("DBImpl::GroupCommit:BeforeWALBarrier",
+         "DBImpl::SwitchMemtable:Start"),
+    ])
+    reg.enable_processing()
+
+    err = []
+
+    def writer():
+        try:
+            for i in range(50):
+                db.put(b"k%04d" % i, b"v%d" % i,
+                       WriteOptions(sync=(i % 7 == 0)))
+        except BaseException as e:  # noqa: BLE001
+            err.append(e)
+
+    t = threading.Thread(target=writer, name="interleave-writer")
+    t.start()
+    # The flush (and its switch) may only start once a write group is in
+    # its barrier window; the event keeps the mutex free until then so
+    # the dependency cannot deadlock the leader out of ever reaching it.
+    assert at_barrier.wait(timeout=30.0), "no group reached the barrier"
+    db.flush(FlushOptions())
+    t.join(timeout=30.0)
+    assert not t.is_alive()
+    assert not err, err
+    reg.clear_all()
+    db.close()
+
+    db2 = DB.open(str(tmp_path / "db"), Options())
+    try:
+        for i in range(50):
+            assert db2.get(b"k%04d" % i) == b"v%d" % i
+    finally:
+        db2.close()
+
+
+def test_fenced_writer_vs_migration_cutover(tmp_path, sync_points):
+    """Forced order: the migration cutover waits until a writer is
+    parked at the closed fence. The parked writer must wake AFTER the
+    swap + epoch bump and land its write on the NEW primary."""
+    reg = sync_points
+    r = open_local_cluster(str(tmp_path),
+                           [("a", None, b"m"), ("b", b"m", None)],
+                           statistics=Statistics())
+    old_primary = None
+    try:
+        for i in range(120):
+            r.put(b"m%05d" % i, b"v%d" % i)
+        old_primary = r._serving("b").primary
+        old_epoch = r.map.get("b").epoch
+
+        reg.load_dependency([
+            ("ShardRouter::WriteGate:Parked",
+             "ShardMigration::BeforeCutover"),
+        ])
+        reg.enable_processing()
+
+        mig_out, mig_err = [], []
+
+        def migrate():
+            try:
+                mig_out.append(
+                    ShardMigration(r, "b", str(tmp_path / "b-new")).run())
+            except BaseException as e:  # noqa: BLE001
+                mig_err.append(e)
+
+        mt = threading.Thread(target=migrate, name="interleave-migrate")
+        mt.start()
+        # Wait for the fence to close, then write: the writer parks at
+        # the gate, which is what releases the cutover.
+        for _ in range(3000):
+            if r.map.get("b").state == "fenced":
+                break
+            time.sleep(0.01)
+        assert r.map.get("b").state == "fenced"
+        tok = r.put(b"m88888", b"post-cutover")
+        mt.join(timeout=60.0)
+        assert not mt.is_alive()
+        assert not mig_err, mig_err
+        reg.clear_all()
+
+        # The parked write re-resolved onto the NEW primary/epoch.
+        assert tok.epoch == r.map.get("b").epoch
+        assert tok.epoch > old_epoch
+        assert r._serving("b").primary is not old_primary
+        assert r.get(b"m88888", token=tok) == b"post-cutover"
+        assert old_primary.get(b"m88888") is None
+        assert r.get(b"m00042") == b"v42"
+    finally:
+        reg.clear_all()
+        if old_primary is not None:
+            old_primary.close()
+        r.close()

@@ -1,0 +1,90 @@
+"""ASan/UBSan replay of the native fuzz corpus (satellite of the
+concurrency-correctness plane): rebuild tpulsm_native.cc with
+TPULSM_NATIVE_SANITIZE set and drive the same budgeted fuzz targets
+through the instrumented .so in a subprocess. A sanitizer report aborts
+the child, so a clean exit IS the assertion.
+
+ASan must be loaded before libc allocates, hence the LD_PRELOAD of
+libasan in the child environment (the parent process stays
+uninstrumented). Skips when the toolchain or the runtime library is
+missing.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from toplingdb_tpu import native
+
+pytestmark = [pytest.mark.slow,
+              pytest.mark.skipif(native.lib() is None,
+                                 reason="native library unavailable")]
+
+_CHILD = r"""
+import random
+from toplingdb_tpu import native
+from toplingdb_tpu.tools import fuzz_native as fz
+
+assert native._SANITIZE == {mode!r}, "sanitize mode did not take"
+assert native.lib() is not None, "sanitized .so failed to build/load"
+rng = random.Random(1234)
+total = 0
+for target, runs in (("wb", 120), ("block", 120), ("scan", 60),
+                     ("manifest", 10)):
+    corpus = fz.Corpus({corpus_dir!r} + "/" + target)
+    total += fz.TARGETS[target](rng, runs, corpus)
+assert total == 0, f"{{total}} finding(s) under sanitizer"
+print("SANITIZED_REPLAY_OK")
+"""
+
+
+def _libasan() -> str | None:
+    gxx = shutil.which("g++")
+    if gxx is None:
+        return None
+    try:
+        out = subprocess.run(
+            [gxx, "-print-file-name=libasan.so"], capture_output=True,
+            text=True, timeout=30).stdout.strip()
+    except (subprocess.SubprocessError, OSError):
+        return None
+    return out if out and os.path.sep in out and os.path.exists(out) \
+        else None
+
+
+def _replay(mode: str, env_extra: dict, tmp_path) -> None:
+    env = dict(os.environ)
+    env["TPULSM_NATIVE_SANITIZE"] = mode
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra)
+    src = _CHILD.format(mode=mode, corpus_dir=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, "-c", src], capture_output=True, text=True,
+        timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if proc.returncode != 0 and "failed to build/load" in \
+            (proc.stdout + proc.stderr):
+        pytest.skip(f"{mode}-instrumented build unavailable")
+    assert proc.returncode == 0, (
+        f"sanitized replay died (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "SANITIZED_REPLAY_OK" in proc.stdout
+
+
+def test_fuzz_corpus_replay_asan(tmp_path):
+    lib = _libasan()
+    if lib is None:
+        pytest.skip("libasan not found")
+    _replay("asan", {
+        "LD_PRELOAD": lib,
+        # ctypes dlopens the .so after interpreter start; leak reports of
+        # interpreter-lifetime allocations are noise here.
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+    }, tmp_path)
+
+
+def test_fuzz_corpus_replay_ubsan(tmp_path):
+    _replay("undefined", {"UBSAN_OPTIONS": "halt_on_error=1"}, tmp_path)

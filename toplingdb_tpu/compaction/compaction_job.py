@@ -17,6 +17,7 @@ import bisect
 import time
 from dataclasses import dataclass
 
+from toplingdb_tpu.utils import concurrency as ccy
 from toplingdb_tpu.db import dbformat, filename
 from toplingdb_tpu.db.blob import decode_blob_index
 from toplingdb_tpu.db.level_iterator import LevelIterator
@@ -548,7 +549,8 @@ def _run_subcompactions(env, dbname, icmp, compaction, table_cache,
         work(0, None, None)
     else:
         threads = [
-            threading.Thread(target=work, args=(i, lo, hi), daemon=True)
+            ccy.spawn(f"subcompaction-{i}", work, args=(i, lo, hi),
+                      start=False)
             for i, (lo, hi) in enumerate(ranges)
         ]
         for t in threads:

@@ -18,6 +18,8 @@ from __future__ import annotations
 import argparse
 import json
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -37,7 +39,7 @@ class DcompactWorkerService:
         self.device = device
         self._sem = threading.Semaphore(max_workers)
         self._server: ThreadingHTTPServer | None = None
-        self._counter_mu = threading.Lock()
+        self._counter_mu = ccy.Lock("dcompact_service.DcompactWorkerService._counter_mu")
         self.jobs_done = 0
         self.jobs_failed = 0
 
@@ -143,8 +145,8 @@ class DcompactWorkerService:
                                       "output_files": [], "stats": {}})
 
         self._server = ThreadingHTTPServer((host, port), Handler)
-        t = threading.Thread(target=self._server.serve_forever, daemon=True)
-        t.start()
+        ccy.spawn("dcompact-http", self._server.serve_forever, owner=self,
+                  stop=self.stop)
         return self._server.server_address[1]
 
     def stop(self) -> None:

@@ -36,6 +36,8 @@ import os
 import random
 import shutil
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 from toplingdb_tpu.utils import statistics as stats_mod
@@ -113,7 +115,7 @@ class CircuitBreaker:
         self.failure_threshold = max(1, failure_threshold)
         self.reset_timeout = reset_timeout
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("resilience.CircuitBreaker._mu")
         self.state = self.CLOSED
         self.consecutive_failures = 0
         self._opened_at = 0.0
@@ -167,7 +169,7 @@ class WorkerHealthRegistry:
                  clock=time.monotonic):
         self.policy = policy or DcompactOptions()
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("resilience.WorkerHealthRegistry._mu")
         self._breakers: dict[str, CircuitBreaker] = {}
         self._rr = 0
         # Observers: callables (url, state, consecutive_failures) -> None,
@@ -247,7 +249,7 @@ class LocalPinGate:
     def __init__(self, policy: DcompactOptions, clock=time.monotonic):
         self.policy = policy
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("resilience.LocalPinGate._mu")
         self._consecutive = 0
         self._pinned_until = 0.0
         self.pin_count = 0  # times the gate engaged (for introspection)
@@ -318,9 +320,8 @@ class HeartbeatWriter:
 
     def start(self) -> "HeartbeatWriter":
         self.beat()
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="dcompact-heartbeat")
-        self._thread.start()
+        self._thread = ccy.spawn("dcompact-heartbeat", self._loop,
+                                 owner=self, stop=self.stop)
         return self
 
     def _loop(self) -> None:
@@ -428,7 +429,7 @@ class DcompactFaultInjector:
         self.plans = tuple(plans)
         self.delay_sec = delay_sec
         self._rng = random.Random(seed)
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("resilience.DcompactFaultInjector._mu")
         self._ordinal = 0
         self.injected: list[tuple[int, int, str]] = []  # (job, attempt, plan)
 

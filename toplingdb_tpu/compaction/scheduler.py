@@ -11,6 +11,8 @@ one is configured (device=cpu|tpu|remote), with fallback to local
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import traceback
 
 from toplingdb_tpu.db import dbformat
@@ -36,8 +38,8 @@ class CompactionScheduler:
         self.background = background
         self._pending = 0
         self._running = 0
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = ccy.Lock("scheduler.CompactionScheduler._lock")
+        self._cv = ccy.Condition(lock=self._lock)
         self._shutdown = False
         self._manual_active = False
         self._paused = 0
@@ -78,8 +80,8 @@ class CompactionScheduler:
                 if self._running + self._pending >= self.db.options.max_background_jobs:
                     return
                 self._pending += 1
-            t = threading.Thread(target=self._bg_work, daemon=True)
-            t.start()
+            ccy.spawn("compaction-bg", self._bg_work, owner=self,
+                      stop=self.shutdown)
         else:
             with self._lock:
                 if self._paused:

@@ -107,14 +107,14 @@ class BlobSource:
 
     def __init__(self, env, dbname: str, blob_cache=None,
                  open_limit: int = 256, statistics=None):
-        import threading
+        from toplingdb_tpu.utils import concurrency as ccy
         from collections import OrderedDict
 
         self._env = env
         self._dbname = dbname
         self._readers: "OrderedDict[int, BlobFileReader]" = OrderedDict()
         self._open_limit = max(1, int(open_limit))
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("blob.BlobSource._mu")
         self.stats = statistics
         if isinstance(blob_cache, int):
             from toplingdb_tpu.utils.cache import LRUCache

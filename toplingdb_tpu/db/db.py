@@ -10,8 +10,11 @@ toplingdb_tpu/compaction (installed via `_maybe_schedule_compaction`).
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 import uuid
+import warnings
 
 from toplingdb_tpu.db import dbformat, filename
 from toplingdb_tpu.db.db_iter import DBIter
@@ -26,6 +29,7 @@ from toplingdb_tpu.db.snapshot import SnapshotList
 from toplingdb_tpu.db.table_cache import TableCache
 from toplingdb_tpu.db.version_edit import VersionEdit
 from toplingdb_tpu.db.version_set import VersionSet
+from toplingdb_tpu.utils.sync_point import sync_point
 from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.env import Env, default_env
 from toplingdb_tpu.options import FlushOptions, Options, ReadOptions, WriteOptions
@@ -90,7 +94,7 @@ class _InsertBarrier:
         self.remaining = n
         self.all_done = threading.Event()
         self.error: BaseException | None = None
-        self.lock = threading.Lock()
+        self.lock = ccy.Lock("db._InsertBarrier.lock")
 
     def member_done(self, err: BaseException | None = None) -> None:
         with self.lock:
@@ -310,9 +314,9 @@ class DB:
             open_limit=getattr(options, "blob_file_open_limit", 256),
             statistics=options.statistics)
         self.snapshots = SnapshotList()
-        self._mutex = threading.RLock()
+        self._mutex = ccy.RLock("db.DB._mutex")
         self._writers: list[_Writer] = []  # FIFO write queue (leader = [0])
-        self._wq_lock = threading.Lock()
+        self._wq_lock = ccy.Lock("db.DB._wq_lock")
         # Staged write modes (pipelined/unordered): seqno ALLOCATION runs
         # ahead of PUBLICATION. _alloc_ranges is a deque of [first, last,
         # done] entries in allocation order (indexed by _alloc_entry for
@@ -322,7 +326,7 @@ class DB:
         # to memtable-switch / snapshot / close waiters.
         from collections import deque as _deque
 
-        self._mt_cv = threading.Condition(self._mutex)
+        self._mt_cv = ccy.Condition(lock=self._mutex)
         self._mt_inflight = 0
         self._seq_alloc = 0
         self._alloc_ranges: "_deque[list]" = _deque()
@@ -364,6 +368,9 @@ class DB:
         # alive_log_files scoping).
         self._recyclable_written: set[int] = set()
         self._closed = False
+        # Wakes sleeping auto-recover threads so close() can join them
+        # promptly instead of waiting out their backoff.
+        self._recover_stop = threading.Event()
         # Write-stall accounting surfaced by write_stall_state() (the
         # sharding router's backpressure signal): cumulative counters are
         # folded in by _maybe_stall_writes; the live state is derived from
@@ -746,6 +753,7 @@ class DB:
             self._recyclable_written.add(self._wal_number)
 
     def close(self) -> None:
+        self._recover_stop.set()
         if self._integrity_scrubber is not None:
             self._integrity_scrubber.stop()
         if self._stats_dumper is not None:
@@ -787,6 +795,14 @@ class DB:
             if self._log_file is not None:
                 self._log_file.close()
             self._closed = True
+        # Thread-lifecycle check: everything spawned with owner=self must
+        # be gone by now. A leak here is a bug in a stop() path above.
+        ccy.registry.join_all(owner=self, timeout=5.0)
+        leaked = ccy.registry.check_leaks(owner=self)
+        if leaked:
+            warnings.warn(
+                f"DB.close() leaked threads: {leaked}", RuntimeWarning,
+                stacklevel=2)
 
     def __enter__(self):
         return self
@@ -1176,6 +1192,7 @@ class DB:
             # Async WAL: the durability barrier overlapped the memtable
             # phase; settle it before completion so a failed group never
             # acknowledges.
+            sync_point("DBImpl::GroupCommit:BeforeWALBarrier")
             _fsp = _tm.span("write.fsync_barrier", staged=True)
             try:
                 wal_wait()
@@ -1644,6 +1661,7 @@ class DB:
                     (time.perf_counter() - _mt0) * 1e9)
             if wal_wait is not None:
                 # async WAL: durability overlapped the inserts
+                sync_point("DBImpl::GroupCommit:BeforeWALBarrier")
                 with _tm.span("write.fsync_barrier"):
                     wal_wait()
             # on_sequenced fires only after the WAL append + memtable insert
@@ -1712,6 +1730,11 @@ class DB:
         while self._mt_inflight > 0:
             self._mt_cv.wait(timeout=10.0)
         test_kill_random("DBImpl::SwitchMemtable:Start")
+        # Interleaving seam (tests/test_concurrency_interleavings.py):
+        # the switch closes the current WAL, so its ordering against a
+        # staged group's async durability barrier is the drain protocol
+        # above — this point lets tests pin that order.
+        sync_point("DBImpl::SwitchMemtable:Start")
         if self._wal is not None:
             self._wal.sync()
             self._wal.close()
@@ -2588,7 +2611,7 @@ class DB:
         # the hit path buys correctness on any runtime, and the parse
         # stays inside the lock so a file is never parsed twice.
         tombs_cache: dict[int, list] = {}
-        cache_mu = threading.Lock()
+        cache_mu = ccy.Lock("db.DB.cache_mu")
 
         def tombs_for(f):
             with cache_mu:
@@ -3162,9 +3185,8 @@ class DB:
         if sev == Severity.SOFT_ERROR or (
                 getattr(e, "retryable", False)
                 and sev < Severity.FATAL_ERROR):
-            t = threading.Thread(target=self._auto_recover_loop, args=(e,),
-                                 daemon=True)
-            t.start()
+            ccy.spawn("db-auto-recover", self._auto_recover_loop,
+                      args=(e,), owner=self)
 
     def _auto_recover_loop(self, target: BaseException,
                            max_attempts: int = 10,
@@ -3174,7 +3196,8 @@ class DB:
         or a manual resume(), ends the loop untouched (reference checks the
         recovery error identity the same way)."""
         for attempt in range(max_attempts):
-            time.sleep(min(base_delay * (2 ** attempt), 2.0))
+            if self._recover_stop.wait(min(base_delay * (2 ** attempt), 2.0)):
+                return  # DB is closing; abandon recovery
             with self._mutex:
                 if self._closed or self._bg_error is not target:
                     return

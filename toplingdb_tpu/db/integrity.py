@@ -28,6 +28,8 @@ thread at DB.open; db.scrub() runs one pass synchronously either way.
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 from toplingdb_tpu.db import filename
@@ -69,7 +71,7 @@ class IntegrityScrubber:
         self.period_sec = (period_sec if period_sec is not None
                            else getattr(opts,
                                         "integrity_scrub_period_sec", 0))
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("integrity.IntegrityScrubber._mu")
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._in_progress = False
@@ -88,9 +90,8 @@ class IntegrityScrubber:
         if self.period_sec <= 0 or self._thread is not None:
             return
         self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="integrity-scrubber")
-        self._thread.start()
+        self._thread = ccy.spawn("integrity-scrubber", self._loop,
+                                 owner=self.db, stop=self.stop)
 
     def stop(self) -> None:
         self._stop.set()

@@ -17,6 +17,8 @@ from __future__ import annotations
 import bisect
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 from toplingdb_tpu.db import dbformat
 from toplingdb_tpu.db.dbformat import ValueType
 
@@ -539,7 +541,7 @@ class MemTable:
         self._num_entries = 0
         self._num_deletes = 0
         self._first_seqno: int | None = None
-        self._lock = threading.Lock()
+        self._lock = ccy.Lock("memtable.MemTable._lock")
         self.mem_id = 0
         # Per-entry protection carry (reference memtable KV checksums,
         # db/kv_checksum.h): CF-stripped truncated checksums keyed by the

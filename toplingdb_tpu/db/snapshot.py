@@ -5,6 +5,8 @@ from __future__ import annotations
 
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 
 class Snapshot:
     __slots__ = ("sequence", "excluded_ranges", "_list")
@@ -33,7 +35,7 @@ class Snapshot:
 
 class SnapshotList:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ccy.Lock("snapshot.SnapshotList._lock")
         self._snapshots: list[Snapshot] = []
 
     def new_snapshot(self, sequence: int,

@@ -4,6 +4,8 @@
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 from collections import OrderedDict
 
@@ -30,7 +32,7 @@ class TableCache:
         # file numbers collide.
         self._cache_session = uuid.uuid4().bytes[:8]
         self._readers: OrderedDict[int, TableReader] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = ccy.Lock("table_cache.TableCache._lock")
         self.stats = None  # optional Statistics sink (set by the DB)
 
     def get_reader(self, file_number: int) -> TableReader:

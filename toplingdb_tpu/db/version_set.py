@@ -11,6 +11,8 @@ VersionEdits to produce new Versions.
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import weakref
 
 from toplingdb_tpu.db import dbformat, filename
@@ -275,7 +277,7 @@ class VersionSet:
         self.manifest_file_number = 0
         self._next_file_number = 2
         self._manifest_writer: LogWriter | None = None
-        self._lock = threading.Lock()
+        self._lock = ccy.Lock("version_set.VersionSet._lock")
         # Monotonic count of MANIFEST records in the live manifest — the
         # replication plane's "epoch" minor component: a follower re-reads
         # the MANIFEST when (manifest_file_number, edit_seq) changes

@@ -12,6 +12,8 @@ from __future__ import annotations
 import io
 import os
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 from toplingdb_tpu.utils import statistics as _stats_mod
@@ -194,7 +196,7 @@ class AsyncIORing:
                  fault_hook=None, name: str = "tpulsm-aio"):
         self._cap = max(1, int(capacity))
         self._q: list = []
-        self._cv = threading.Condition()
+        self._cv = ccy.Condition("env.AsyncIORing._cv")
         self._closed = False
         self.coalesce_cb = coalesce_cb     # callable(n_merged_fsyncs)
         self.fault_hook = fault_hook       # callable(kind, nbytes) -> None
@@ -203,9 +205,8 @@ class AsyncIORing:
         self.fsyncs = 0
         self.fsyncs_coalesced = 0
         self._pending_err: dict[int, BaseException] = {}
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=name)
-        self._thread.start()
+        self._thread = ccy.spawn(f"aio-{name}", self._run, owner=self,
+                                 stop=self.close)
 
     # -- submission ----------------------------------------------------
 
@@ -587,7 +588,7 @@ class MemEnv(Env):
     def __init__(self):
         self._files: dict[str, _MemFileState] = {}
         self._dirs: set[str] = {"/"}
-        self._lock = threading.Lock()
+        self._lock = ccy.Lock("env.MemEnv._lock")
 
     def _norm(self, path: str) -> str:
         return os.path.normpath(path)

@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 from toplingdb_tpu.env.env import Env, RandomAccessFile, SequentialFile, WritableFile
 from toplingdb_tpu.utils.status import IOError_
 
@@ -14,7 +16,7 @@ from toplingdb_tpu.utils.status import IOError_
 class FaultInjectionEnv(Env):
     def __init__(self, base: Env):
         self.base = base
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("fault_injection.FaultInjectionEnv._mu")
         self._unsynced: dict[str, int] = {}   # path → synced length
         self._files: dict[str, "_FIWritable"] = {}
         self.fail_after_ops: int | None = None
@@ -273,7 +275,7 @@ class WalWriterFaultInjector:
         self.delay_sec = delay_sec
         self.ops = tuple(ops)
         self._rng = random.Random(seed)
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("fault_injection.WalWriterFaultInjector._mu")
         self._ordinal = 0
         self.injected: list[tuple[int, str, str]] = []  # (ordinal, kind, plan)
 
@@ -330,7 +332,7 @@ class ShipFaultInjector:
         self.plans = tuple(plans)
         self.delay_sec = delay_sec
         self._rng = random.Random(seed)
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("fault_injection.ShipFaultInjector._mu")
         self._ordinal = 0
         self.injected: list[tuple[int, str]] = []  # (ordinal, plan)
 

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 from toplingdb_tpu.env.env import Env
@@ -20,7 +22,7 @@ from toplingdb_tpu.env.env import Env
 class IOTracer:
     def __init__(self, trace_path: str):
         self._f = open(trace_path, "a", buffering=1)
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("io_tracer.IOTracer._mu")
         self.num_records = 0
 
     def record(self, op: str, path: str, offset: int = 0, length: int = 0,

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 from toplingdb_tpu.env.env import Env
 from toplingdb_tpu.utils.status import NotFound
 
@@ -21,7 +23,7 @@ class OverlayEnv(Env):
         self.base = base
         self.overlay = overlay
         self._whiteouts: set[str] = set()
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("overlay.OverlayEnv._mu")
 
     def _hidden(self, path: str) -> bool:
         with self._mu:

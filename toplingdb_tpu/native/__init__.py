@@ -12,11 +12,30 @@ import os
 import subprocess
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "tpulsm_native.cc")
-_SO = os.path.join(_DIR, "_tpulsm_native.so")
+# TPULSM_NATIVE_SANITIZE=asan|undefined builds (and loads) a separate
+# sanitized .so — slower, instrumented, used by tests/test_sanitize_native
+# to replay the fuzz corpus under ASan/UBSan without disturbing the
+# regular artifact. For asan, run python under
+# LD_PRELOAD=$(g++ -print-file-name=libasan.so).
+_SANITIZE = os.environ.get("TPULSM_NATIVE_SANITIZE", "").strip().lower()
+_SAN_FLAGS = {
+    "asan": ["-fsanitize=address"],
+    "address": ["-fsanitize=address"],
+    "undefined": ["-fsanitize=undefined",
+                  "-fno-sanitize-recover=undefined"],
+    "ubsan": ["-fsanitize=undefined", "-fno-sanitize-recover=undefined"],
+}
+if _SANITIZE and _SANITIZE in _SAN_FLAGS:
+    _SO = os.path.join(_DIR, f"_tpulsm_native.{_SANITIZE}.so")
+else:
+    _SANITIZE = ""
+    _SO = os.path.join(_DIR, "_tpulsm_native.so")
 
-_lock = threading.Lock()
+_lock = ccy.Lock("native._lock")
 _lib: ctypes.CDLL | None = None
 _tried = False
 
@@ -25,7 +44,9 @@ def _compile(src: str, so: str, extra_flags: list[str]) -> bool:
     """Shared compile-to-tmp-then-swap build step (per-pid tmp name: two
     processes may race the first build)."""
     tmp = f"{so}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", *extra_flags,
+    opt = ["-O1", "-g"] if _SANITIZE else ["-O3"]
+    cmd = ["g++", *opt, "-shared", "-fPIC", *extra_flags,
+           *_SAN_FLAGS.get(_SANITIZE, []),
            "-o", tmp, src, "-ldl"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -500,6 +521,8 @@ def _fastget_so_path() -> str:
     import sys as _sys
 
     tag = getattr(_sys.implementation, "cache_tag", "py") or "py"
+    if _SANITIZE:
+        tag = f"{tag}.{_SANITIZE}"  # keep the sanitized artifact separate
     return os.path.join(_DIR, f"tpulsm_fastget.{tag}.so")
 
 

@@ -36,6 +36,8 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 from queue import Empty, Full, Queue
 
@@ -119,7 +121,7 @@ class _Progress:
 
     def __init__(self, n_files: int):
         self._done = [-1] * n_files
-        self._cv = threading.Condition()
+        self._cv = ccy.Condition("pipeline._Progress._cv")
         self.err: BaseException | None = None
         self.stop = False
         self.scan_end = 0.0
@@ -619,20 +621,20 @@ def run_pipelined(env, dbname, icmp, compaction, table_cache, table_options,
 
     prog = _Progress(len(files))
     outq: Queue = Queue(maxsize=4)
-    stats_mu = threading.Lock()
+    stats_mu = ccy.Lock("pipeline.run_pipelined.stats_mu")
 
     t_scan0 = time.time()
     rthreads = [
-        threading.Thread(target=_scan_file, daemon=True,
-                         args=(fi, fp, kv, prog, splitters, stats,
-                               stats_mu, shared.trace))
+        ccy.spawn(f"pipeline-scan-{fi}", _scan_file, start=False,
+                  args=(fi, fp, kv, prog, splitters, stats,
+                        stats_mu, shared.trace))
         for fi, fp in enumerate(files)
     ]
     from toplingdb_tpu.ops.device_compaction import _host_sort
 
     compute_fn = _host_compute if _host_sort() else _device_compute
-    cthread = threading.Thread(
-        target=_compute_guard, daemon=True,
+    cthread = ccy.spawn(
+        "pipeline-compute", _compute_guard, start=False,
         args=(compute_fn, kv, files, splitters, prog, outq, shared,
               snapshots, compaction.bottommost, frags, max_dev_key),
     )

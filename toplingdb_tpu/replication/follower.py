@@ -29,6 +29,8 @@ read-your-writes with no locks on the read path.
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 from toplingdb_tpu.db import filename
@@ -340,9 +342,8 @@ class FollowerDB(SecondaryDB):
                 if self._tail_stop.wait(interval):
                     return
 
-        self._tail_thread = threading.Thread(
-            target=loop, daemon=True, name="follower-tail")
-        self._tail_thread.start()
+        self._tail_thread = ccy.spawn("follower-tail", loop, owner=self,
+                                      stop=self.stop_tailing)
 
     def stop_tailing(self) -> None:
         self._tail_stop.set()

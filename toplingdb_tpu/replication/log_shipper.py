@@ -33,6 +33,8 @@ import dataclasses
 import json
 import os
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -140,7 +142,7 @@ class LogShipper:
         self.db = db
         self.stats = statistics if statistics is not None else db.stats
         self.max_frame_bytes = max_frame_bytes
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("log_shipper.LogShipper._mu")
         self._tails: dict[int, TailingLogReader] = {}
         # (first_seq, last_seq, rep, wal_number), ascending by sequence.
         self._records: list[tuple[int, int, bytes, int]] = []
@@ -526,9 +528,8 @@ class ReplicationServer:
                     self._reply(500, {"error": repr(e)[:300]})
 
         self._server = ThreadingHTTPServer((host, port), Handler)
-        t = threading.Thread(target=self._server.serve_forever, daemon=True,
-                             name="replication-server")
-        t.start()
+        ccy.spawn("replication-server", self._server.serve_forever,
+                  owner=self, stop=self.stop)
         return self._server.server_address[1]
 
     def stop(self) -> None:

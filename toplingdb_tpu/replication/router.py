@@ -19,6 +19,8 @@ from __future__ import annotations
 import dataclasses
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 from toplingdb_tpu.compaction.resilience import (
     DcompactOptions,
     WorkerHealthRegistry,
@@ -66,7 +68,7 @@ class ReplicaRouter:
         self.primary = primary
         self.options = options or RouterOptions()
         self.stats = statistics if statistics is not None else primary.stats
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("router.ReplicaRouter._mu")
         self._followers: list = list(followers)
         self._rr = 0
         self._epoch_provider = epoch_provider

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 from toplingdb_tpu.utils import statistics as stats_mod
@@ -54,7 +56,7 @@ class AdmissionController:
                  statistics=None):
         self.default_quota = default_quota
         self.stats = statistics
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("admission.AdmissionController._mu")
         self._quotas: dict[str | None, TenantQuota] = {}
         # (tenant, "bytes"|"ops") → RateLimiter
         self._buckets: dict[tuple, RateLimiter] = {}

@@ -40,6 +40,7 @@ from toplingdb_tpu.replication.log_shipper import LocalTransport, LogShipper
 from toplingdb_tpu.utils import statistics as stats_mod
 from toplingdb_tpu.utils import telemetry as _tm
 from toplingdb_tpu.utils.status import Busy, IOError_
+from toplingdb_tpu.utils.sync_point import sync_point
 
 
 class MigrationAborted(Exception):
@@ -138,6 +139,10 @@ class ShardMigration:
             sp.finish()
 
             # -- cutover: promote + swap + epoch bump ---------------------
+            # Interleaving seam: tests order the cutover against writers
+            # parked at the fence (WriteGate:Parked -> BeforeCutover) to
+            # pin that parked writers re-resolve onto the NEW primary.
+            sync_point("ShardMigration::BeforeCutover")
             self._hook("cutover")
             sp = _tm.span("shard.migrate.cutover")
             from toplingdb_tpu.db.db import DB

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 from toplingdb_tpu.options import ReadOptions, WriteOptions
@@ -63,18 +65,23 @@ class _WriteGate:
     returns no write can still be in the old primary's pipeline."""
 
     def __init__(self):
-        self._cv = threading.Condition()
+        self._cv = ccy.Condition("router._WriteGate._cv")
         self._open = True
         self._inflight = 0
 
     def enter(self, timeout: float):
         """True on entry, None on fence timeout; the truthy value is
         "waited" (the caller ticks SHARD_FENCE_WAITS on 2)."""
+        from toplingdb_tpu.utils.sync_point import sync_point
+
         deadline = time.monotonic() + timeout
         waited = 1
         with self._cv:
             while not self._open:
                 waited = 2
+                # Interleaving seam: a writer is parked at a closed fence
+                # (predecessor-only point — never blocks the gate).
+                sync_point("ShardRouter::WriteGate:Parked")
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return None
@@ -183,7 +190,7 @@ class ShardRouter:
         self.admission = admission
         self.fence_timeout = fence_timeout
         self.router_options = router_options
-        self._mu = threading.RLock()
+        self._mu = ccy.RLock("router.ShardRouter._mu")
         self._servings: dict[str, ShardServing] = {}
         self._gates: dict[str, _WriteGate] = {}
         self._traffic: dict[str, dict] = {}

@@ -27,6 +27,8 @@ import dataclasses
 import json
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 from toplingdb_tpu.utils.status import InvalidArgument, NotFound
 
 # Serving states a shard moves through (migration.py drives the cycle).
@@ -91,7 +93,7 @@ class ShardMap:
     (persisted, so a reloaded map cannot re-issue an old epoch)."""
 
     def __init__(self, shards: list[Shard] | None = None):
-        self._mu = threading.RLock()
+        self._mu = ccy.RLock("shard_map.ShardMap._mu")
         self.shards: list[Shard] = list(shards) if shards else [
             Shard(name="s0", start=None, end=None, epoch=1)
         ]

@@ -1,0 +1,732 @@
+"""Concurrency lint (tier-1 CI): lock-order static analysis, lock
+hierarchy enforcement, and thread-lifecycle checks over the whole
+`toplingdb_tpu/` tree.
+
+The package has ONE way to make locks and threads — the factories in
+utils/concurrency.py (`ccy.Lock(name)`, `ccy.RLock(name)`,
+`ccy.Condition(...)`, `ccy.spawn(name, target, ...)`). That single
+funnel is what makes whole-tree static analysis possible; this lint is
+the other half of the bargain. Invariants:
+
+Locks
+  L1. No raw `threading.Lock/RLock/Condition/Thread` outside
+      utils/concurrency.py — everything goes through the factories.
+  L2. Every `ccy.Lock`/`ccy.RLock` carries a string-literal lock-class
+      name of the form `<module>.<Class-or-fn>.<attr>`, prefixed with
+      the defining module's stem. `ccy.Condition` carries either such a
+      name or `lock=` (aliasing an existing lock).
+  L3. A lock-class name names ONE creation site (striped locks share a
+      site, never a copy-pasted name) — duplicate names would silently
+      merge classes in the order graph.
+  L4. Locks are held via `with` only; bare `.acquire()`/`.release()`
+      on a lock attribute defeats the region analysis.
+  L5. The inter-class acquisition-order graph — built from nested
+      `with` scopes plus cross-function edges through call resolution —
+      must be acyclic. Any cycle is reported with a witness (file:line
+      and call chain) for every edge on it.
+  L6. Every lock class must appear in ARCHITECTURE.md's lock-hierarchy
+      table, and every acquisition edge must go from a lower rank to a
+      strictly higher rank. Stale table rows (classes that no longer
+      exist) are also errors.
+
+Threads
+  T1. Every `ccy.spawn` carries a literal (or f-string) thread name.
+  T2. Every spawned thread has a reachable join path: either
+      `owner=` (dynamic lifecycle ownership via the ThreadRegistry —
+      DB.close()/tests assert leaks) or a static `.join(` on the
+      binding the spawn result was stored into.
+
+Run: python -m toplingdb_tpu.tools.check_concurrency [repo_root]
+Exit 0 clean; 1 with one violation per line otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+CCY_ALIASES = {"ccy", "concurrency"}
+RAW_BANNED = {"Lock", "RLock", "Condition", "Thread"}
+EXEMPT_REL = {os.path.join("utils", "concurrency.py")}
+
+# Method names too generic to attribute to a package-level definition:
+# a call `x.get(...)` is far more likely dict.get than DB.get, so these
+# never resolve through the "globally unique name" rule (same-class
+# `self.<name>()` calls still resolve).
+_COMMON_CALLEES = {
+    "get", "put", "set", "add", "remove", "pop", "append", "extend",
+    "close", "open", "read", "write", "flush", "seek", "tell",
+    "items", "keys", "values", "update", "copy", "clear", "sort",
+    "join", "split", "strip", "encode", "decode", "format", "count",
+    "start", "stop", "run", "wait", "notify", "notify_all", "send",
+    "recv", "submit", "result", "cancel", "acquire", "release",
+    "index", "insert", "find", "replace", "next", "setdefault",
+    "discard", "startswith", "endswith", "lower", "upper", "search",
+    "match", "group", "commit", "name", "exists", "empty", "size",
+}
+
+_LOCK_NAME_RE = re.compile(r"^[A-Za-z_][\w.]*$")
+
+
+def _modname(path: str) -> str:
+    stem = os.path.splitext(os.path.basename(path))[0]
+    if stem == "__init__":
+        return os.path.basename(os.path.dirname(path))
+    return stem
+
+
+def _is_ccy_call(node: ast.Call, attr: str) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == attr
+            and isinstance(f.value, ast.Name) and f.value.id in CCY_ALIASES)
+
+
+def _expr_key(e: ast.AST) -> str | None:
+    """Dotted key for a Name/Attribute chain: `t` -> "t",
+    `self._thread` -> "self._thread"."""
+    parts = []
+    while isinstance(e, ast.Attribute):
+        parts.append(e.attr)
+        e = e.value
+    if isinstance(e, ast.Name):
+        parts.append(e.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _FuncInfo:
+    """Per-function acquisition and call events, in source order."""
+
+    __slots__ = ("fid", "path", "modname", "classname", "direct", "calls")
+
+    def __init__(self, fid, path, modname, classname):
+        self.fid = fid
+        self.path = path
+        self.modname = modname
+        self.classname = classname
+        # (held lock-class tuple, acquired lock-class, lineno)
+        self.direct: list[tuple[tuple[str, ...], str, int]] = []
+        # (held lock-class tuple, callee name, is_self_call, lineno)
+        self.calls: list[tuple[tuple[str, ...], str, bool, int]] = []
+
+
+class Analysis:
+    """Whole-tree lock/thread model. `violations` is the lint output;
+    `edges` the inter-class acquisition-order graph with witnesses."""
+
+    def __init__(self, repo_root: str, pkg_dir: str):
+        self.repo_root = repo_root
+        self.pkg_dir = pkg_dir
+        self.violations: list[str] = []
+        self.modules: list[tuple[str, str, ast.AST]] = []  # path, mod, tree
+        # Lock-class registry --------------------------------------------
+        self.lock_sites: dict[str, tuple[str, int]] = {}   # name -> site
+        self.class_attr: dict[tuple[str, str, str], str] = {}
+        self.attr_classes: dict[str, set[str]] = {}        # attr -> names
+        self.name_classes: dict[tuple[str, str], set[str]] = {}  # mod,var
+        self._cond_aliases: list[tuple] = []
+        # Function registry ----------------------------------------------
+        self.funcs: dict[str, _FuncInfo] = {}
+        self.defs_by_name: dict[str, list[str]] = {}
+        self.methods: dict[tuple[str, str], dict[str, str]] = {}
+        # name -> fid (only same-(mod,class) lookups use this)
+        # Edge graph ------------------------------------------------------
+        # (A, B) -> (path, lineno, description)
+        self.edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+    # -- loading ---------------------------------------------------------
+
+    def load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.pkg_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        src = f.read()
+                    tree = ast.parse(src, filename=path)
+                except (OSError, SyntaxError) as e:
+                    self.violations.append(f"{path}: unparseable: {e}")
+                    continue
+                self.modules.append((path, _modname(path), tree))
+
+    def _rel(self, path: str) -> str:
+        return os.path.relpath(path, self.pkg_dir)
+
+    def _exempt(self, path: str) -> bool:
+        return self._rel(path) in EXEMPT_REL
+
+    # -- pass 1: lock creation sites + local lint ------------------------
+
+    def collect_locks(self) -> None:
+        for path, mod, tree in self.modules:
+            self._collect_locks_in(path, mod, tree)
+        # Condition(lock=X) aliases resolve once every direct lock is known.
+        for path, mod, classname, target, lock_expr, lineno in \
+                self._cond_aliases:
+            cls = self.resolve(lock_expr, mod, classname)
+            if cls is None:
+                self.violations.append(
+                    f"{path}:{lineno}: ccy.Condition(lock=...) wraps an "
+                    f"expression that does not resolve to a known lock "
+                    f"class")
+                continue
+            self._bind(mod, classname, target, cls)
+
+    def _bind(self, mod, classname, target, lockclass) -> None:
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self" and classname:
+            self.class_attr[(mod, classname, target.attr)] = lockclass
+            self.attr_classes.setdefault(target.attr, set()).add(lockclass)
+        elif isinstance(target, ast.Name):
+            self.name_classes.setdefault(
+                (mod, target.id), set()).add(lockclass)
+
+    def _collect_locks_in(self, path, mod, tree) -> None:
+        viol = self.violations
+
+        def handle_factory(node: ast.Call, classname: str | None,
+                           target: ast.AST | None) -> None:
+            kind = node.func.attr
+            lit = None
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                lit = node.args[0].value
+            lock_kw = next(
+                (kw.value for kw in node.keywords if kw.arg == "lock"), None)
+            if kind == "Condition" and lock_kw is not None:
+                if target is not None:
+                    self._cond_aliases.append(
+                        (path, mod, classname, target, lock_kw, node.lineno))
+                return
+            if lit is None:
+                viol.append(
+                    f"{path}:{node.lineno}: ccy.{kind}() needs a "
+                    f"string-literal lock-class name")
+                return
+            if not _LOCK_NAME_RE.match(lit) or \
+                    not lit.startswith(mod + "."):
+                viol.append(
+                    f"{path}:{node.lineno}: lock-class name {lit!r} must "
+                    f"be '<module>.<scope>.<attr>' prefixed with "
+                    f"{mod + '.'!r}")
+            if lit in self.lock_sites:
+                op, ol = self.lock_sites[lit]
+                viol.append(
+                    f"{path}:{node.lineno}: lock-class name {lit!r} "
+                    f"already created at {op}:{ol} — duplicate names "
+                    f"merge lock classes")
+            else:
+                self.lock_sites[lit] = (path, node.lineno)
+            if target is not None:
+                self._bind(mod, classname, target, lit)
+
+        def walk(node, classname):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                    continue
+                if isinstance(child, ast.Assign) and \
+                        isinstance(child.value, ast.Call) and \
+                        isinstance(child.value.func, ast.Attribute) and \
+                        child.value.func.attr in ("Lock", "RLock",
+                                                  "Condition") and \
+                        isinstance(child.value.func.value, ast.Name) and \
+                        child.value.func.value.id in CCY_ALIASES:
+                    handle_factory(child.value, classname,
+                                   child.targets[0])
+                    continue
+                walk(child, classname)
+
+        walk(tree, None)
+        # Factory calls that are NOT simple assignments (returned, passed
+        # as args, ...) still need the name lint.
+        assigned = set()
+
+        def mark(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Assign) and \
+                        isinstance(child.value, ast.Call):
+                    assigned.add(id(child.value))
+                mark(child)
+
+        mark(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and id(node) not in assigned and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("Lock", "RLock", "Condition") and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in CCY_ALIASES:
+                handle_factory(node, None, None)
+
+    # -- resolution ------------------------------------------------------
+
+    def resolve(self, expr: ast.AST, mod: str,
+                classname: str | None) -> str | None:
+        """Lock class acquired by `with <expr>:`, or None."""
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self" and classname:
+                cls = self.class_attr.get((mod, classname, attr))
+                if cls is not None:
+                    return cls
+            cands = self.attr_classes.get(attr, ())
+            if len(cands) == 1:
+                return next(iter(cands))
+            return None
+        if isinstance(expr, ast.Name):
+            cands = self.name_classes.get((mod, expr.id), ())
+            if len(cands) == 1:
+                return next(iter(cands))
+        return None
+
+    # -- pass 2: per-function acquisition/call events --------------------
+
+    def collect_funcs(self) -> None:
+        for path, mod, tree in self.modules:
+            self._collect_funcs_in(path, mod, tree)
+
+    def _collect_funcs_in(self, path, mod, tree) -> None:
+        ana = self
+
+        def visit_func(fn, classname, qualprefix):
+            fid = f"{mod}:{qualprefix}{fn.name}"
+            info = _FuncInfo(fid, path, mod, classname)
+            # Redefinitions (e.g. overloads behind `if`) keep the first.
+            if fid not in ana.funcs:
+                ana.funcs[fid] = info
+                ana.defs_by_name.setdefault(fn.name, []).append(fid)
+                if classname:
+                    ana.methods.setdefault((mod, classname), {})[
+                        fn.name] = fid
+            else:
+                info = ana.funcs[fid]
+            held: list[str] = []
+
+            def record_calls(expr):
+                """Call events inside an expression (not nested defs)."""
+                for node in ast.walk(expr):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    if isinstance(f, ast.Attribute):
+                        is_self = (isinstance(f.value, ast.Name)
+                                   and f.value.id == "self")
+                        info.calls.append(
+                            (tuple(held), f.attr, is_self, node.lineno))
+                    elif isinstance(f, ast.Name):
+                        info.calls.append(
+                            (tuple(held), f.id, False, node.lineno))
+
+            def walk_stmts(stmts):
+                for st in stmts:
+                    if isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        visit_func(st, classname,
+                                   f"{qualprefix}{fn.name}.")
+                        continue
+                    if isinstance(st, ast.ClassDef):
+                        visit_class(st, f"{qualprefix}{fn.name}.")
+                        continue
+                    if isinstance(st, (ast.With, ast.AsyncWith)):
+                        pushed = 0
+                        for item in st.items:
+                            record_calls(item.context_expr)
+                            cls = ana.resolve(item.context_expr, mod,
+                                              classname)
+                            if cls is not None:
+                                info.direct.append(
+                                    (tuple(held), cls, st.lineno))
+                                held.append(cls)
+                                pushed += 1
+                        walk_stmts(st.body)
+                        del held[len(held) - pushed:len(held)]
+                        continue
+                    # Generic statement: collect calls from its
+                    # expressions, then recurse into its statement bodies.
+                    for field in st._fields:
+                        val = getattr(st, field, None)
+                        if isinstance(val, list) and val and \
+                                isinstance(val[0], ast.stmt):
+                            walk_stmts(val)
+                        elif isinstance(val, ast.expr):
+                            record_calls(val)
+                        elif isinstance(val, list):
+                            for v in val:
+                                if isinstance(v, ast.expr):
+                                    record_calls(v)
+
+            walk_stmts(fn.body)
+
+        def visit_class(cls_node, qualprefix):
+            for st in cls_node.body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    visit_func(st, cls_node.name,
+                               f"{qualprefix}{cls_node.name}.")
+                elif isinstance(st, ast.ClassDef):
+                    visit_class(st, f"{qualprefix}{cls_node.name}.")
+
+        for st in tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_func(st, None, "")
+            elif isinstance(st, ast.ClassDef):
+                visit_class(st, "")
+
+    # -- pass 3: call resolution + closure + edges -----------------------
+
+    def _resolve_call(self, name, is_self, mod, classname) -> str | None:
+        if name.startswith("__"):
+            return None
+        if is_self and classname:
+            fid = self.methods.get((mod, classname), {}).get(name)
+            if fid is not None:
+                return fid
+        if name in _COMMON_CALLEES:
+            return None
+        fids = self.defs_by_name.get(name, ())
+        if len(fids) == 1:
+            return fids[0]
+        return None
+
+    def build_edges(self) -> None:
+        closures: dict[str, dict[str, tuple[tuple[str, ...], int]]] = {}
+
+        def closure(fid, stack):
+            if fid in closures:
+                return closures[fid]
+            if fid in stack:
+                return {}
+            stack.add(fid)
+            info = self.funcs[fid]
+            out: dict[str, tuple[tuple[str, ...], int]] = {}
+            for _held, cls, line in info.direct:
+                out.setdefault(cls, ((fid,), line))
+            for _held, name, is_self, line in info.calls:
+                callee = self._resolve_call(name, is_self, info.modname,
+                                            info.classname)
+                if callee is None:
+                    continue
+                for cls, (chain, cl) in closure(callee, stack).items():
+                    out.setdefault(cls, ((fid,) + chain, cl))
+            stack.discard(fid)
+            closures[fid] = out
+            return out
+
+        for fid in self.funcs:
+            closure(fid, set())
+
+        def add_edge(a, b, path, line, desc):
+            if a == b:
+                return  # striping / RLock reentrancy
+            self.edges.setdefault((a, b), (path, line, desc))
+
+        for fid, info in self.funcs.items():
+            for held, cls, line in info.direct:
+                for a in held:
+                    add_edge(a, cls, info.path, line,
+                             f"{a} held at `with` acquiring {cls} "
+                             f"in {fid}")
+            for held, name, is_self, line in info.calls:
+                if not held:
+                    continue
+                callee = self._resolve_call(name, is_self, info.modname,
+                                            info.classname)
+                if callee is None:
+                    continue
+                for cls, (chain, cl) in closures[callee].items():
+                    for a in held:
+                        add_edge(a, cls, info.path, line,
+                                 f"{a} held in {fid} calling "
+                                 f"{' -> '.join(chain)} which acquires "
+                                 f"{cls} at line {cl}")
+
+    # -- pass 4: cycles --------------------------------------------------
+
+    def check_cycles(self) -> None:
+        graph: dict[str, list[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, []).append(b)
+            graph.setdefault(b, [])
+        # Tarjan SCC, iterative.
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        onstack: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(root):
+            work = [(root, iter(graph[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            onstack.add(root)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        onstack.add(w)
+                        work.append((w, iter(graph[w])))
+                        advanced = True
+                        break
+                    elif w in onstack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == index[v]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        onstack.discard(w)
+                        scc.append(w)
+                        if w == v:
+                            break
+                    sccs.append(scc)
+
+        for v in graph:
+            if v not in index:
+                strongconnect(v)
+
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            cyc = self._find_cycle(set(scc))
+            lines = [f"lock-order cycle: {' -> '.join(cyc + [cyc[0]])}"]
+            for i, a in enumerate(cyc):
+                b = cyc[(i + 1) % len(cyc)]
+                path, line, desc = self.edges[(a, b)]
+                lines.append(f"    {a} -> {b}: {path}:{line}: {desc}")
+            self.violations.append("\n".join(lines))
+
+    def _find_cycle(self, scc: set[str]) -> list[str]:
+        start = sorted(scc)[0]
+        seen = {start: None}
+        queue = [start]
+        while queue:
+            v = queue.pop(0)
+            for (a, b) in self.edges:
+                if a != v or b not in scc:
+                    continue
+                if b == start:
+                    # Reconstruct start -> ... -> v, edge v -> start.
+                    out = []
+                    cur = v
+                    while cur is not None:
+                        out.append(cur)
+                        cur = seen[cur]
+                    return list(reversed(out))
+                if b not in seen:
+                    seen[b] = v
+                    queue.append(b)
+        return sorted(scc)  # unreachable, defensive
+
+    # -- pass 5: declared hierarchy --------------------------------------
+
+    def check_hierarchy(self) -> None:
+        ranks = hierarchy_from_architecture(self.repo_root)
+        if ranks is None:
+            return  # synthetic trees without ARCHITECTURE.md: skip
+        for name, (path, line) in sorted(self.lock_sites.items()):
+            if name not in ranks:
+                self.violations.append(
+                    f"{path}:{line}: lock class {name!r} is not declared "
+                    f"in ARCHITECTURE.md's lock-hierarchy table")
+        for name in sorted(ranks):
+            if name not in self.lock_sites:
+                self.violations.append(
+                    f"ARCHITECTURE.md: lock-hierarchy row {name!r} names "
+                    f"a lock class that no longer exists")
+        for (a, b), (path, line, desc) in sorted(self.edges.items()):
+            ra, rb = ranks.get(a), ranks.get(b)
+            if ra is None or rb is None:
+                continue  # already reported as undeclared
+            if ra >= rb:
+                self.violations.append(
+                    f"{path}:{line}: acquisition edge {a} (rank {ra}) -> "
+                    f"{b} (rank {rb}) violates the declared lock "
+                    f"hierarchy: {desc}")
+
+    # -- thread lifecycle + raw-primitive lint ---------------------------
+
+    def check_threads(self) -> None:
+        for path, mod, tree in self.modules:
+            if self._exempt(path):
+                continue
+            self._check_threads_in(path, mod, tree)
+
+    def _check_threads_in(self, path, mod, tree) -> None:
+        viol = self.violations
+        # L1: raw threading primitives.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "threading":
+                for alias in node.names:
+                    if alias.name in RAW_BANNED:
+                        viol.append(
+                            f"{path}:{node.lineno}: `from threading "
+                            f"import {alias.name}` — use the "
+                            f"utils/concurrency factories")
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in RAW_BANNED and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "threading":
+                viol.append(
+                    f"{path}:{node.lineno}: raw threading."
+                    f"{node.func.attr}() — use ccy."
+                    f"{'spawn' if node.func.attr == 'Thread' else node.func.attr}"
+                    f" from utils/concurrency")
+            # L4: bare acquire/release on a lock attribute.
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("acquire", "release"):
+                tgt = node.func.value
+                attr = tgt.attr if isinstance(tgt, ast.Attribute) else None
+                if attr in self.attr_classes:
+                    viol.append(
+                        f"{path}:{node.lineno}: bare .{node.func.attr}() "
+                        f"on lock attribute {attr!r} — hold locks with "
+                        f"`with` so regions stay statically analyzable")
+        # T-rules: spawn discipline.
+        joined: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join":
+                key = _expr_key(node.func.value)
+                if key:
+                    joined.add(key)
+        # `for t in threads: t.join()` marks `threads` joined too.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.For) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.target.id in joined:
+                key = _expr_key(node.iter)
+                if key:
+                    joined.add(key)
+        # Bind each spawn call to the name its result lands in.
+        bound: dict[int, str] = {}
+        spawns: list[ast.Call] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_ccy_call(node, "spawn"):
+                spawns.append(node)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                key = _expr_key(node.targets[0])
+                if key:
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Call) and \
+                                _is_ccy_call(sub, "spawn"):
+                            bound[id(sub)] = key
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "append":
+                key = _expr_key(node.func.value)
+                if key:
+                    for arg in node.args:
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Call) and \
+                                    _is_ccy_call(sub, "spawn"):
+                                bound[id(sub)] = key
+        for node in spawns:
+            a0 = node.args[0] if node.args else None
+            named = (isinstance(a0, ast.Constant)
+                     and isinstance(a0.value, str)) or \
+                isinstance(a0, ast.JoinedStr)
+            if not named:
+                viol.append(
+                    f"{path}:{node.lineno}: ccy.spawn() needs a literal "
+                    f"(or f-string) thread name as its first argument")
+            has_owner = any(kw.arg == "owner" for kw in node.keywords)
+            if has_owner:
+                continue
+            key = bound.get(id(node))
+            if key is None or key not in joined:
+                viol.append(
+                    f"{path}:{node.lineno}: spawned thread has no join "
+                    f"path — pass owner= (ThreadRegistry lifecycle) or "
+                    f"store the thread and .join() it in this module")
+
+
+def hierarchy_from_architecture(repo_root: str) -> dict[str, int] | None:
+    """Parse the lock-hierarchy table: rows `| <rank> | \\`<class>\\` | ...`
+    under a heading containing 'lock hierarchy'. Repeated rank numbers
+    group incomparable classes. Returns {class: rank} or None if the
+    table is absent."""
+    path = os.path.join(repo_root, "ARCHITECTURE.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    m = re.search(r"^#{1,5}.*lock hierarchy.*$", text,
+                  re.IGNORECASE | re.MULTILINE)
+    if not m:
+        return None
+    section = text[m.end():]
+    nxt = re.search(r"\n#{1,5} ", section)
+    if nxt:
+        section = section[: nxt.start()]
+    ranks: dict[str, int] = {}
+    for line in section.splitlines():
+        rm = re.match(r"\|\s*(\d+)\s*\|", line)
+        if not rm:
+            continue
+        cm = re.search(r"`([\w.]+)`", line)
+        if cm:
+            ranks[cm.group(1)] = int(rm.group(1))
+    return ranks or None
+
+
+def analyze(repo_root: str | None = None) -> Analysis:
+    repo_root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pkg = os.path.join(repo_root, "toplingdb_tpu")
+    if not os.path.isdir(pkg):
+        pkg = repo_root  # synthetic trees in tests
+    ana = Analysis(repo_root, pkg)
+    ana.load()
+    ana.collect_locks()
+    ana.collect_funcs()
+    ana.build_edges()
+    ana.check_cycles()
+    ana.check_hierarchy()
+    ana.check_threads()
+    return ana
+
+
+def run(repo_root: str | None = None) -> list[str]:
+    return analyze(repo_root).violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv and not argv[0].startswith("-") else None
+    ana = analyze(root)
+    if "--dump-graph" in (argv or []):
+        for (a, b), (path, line, desc) in sorted(ana.edges.items()):
+            print(f"{a} -> {b}  [{path}:{line}]")
+    for v in ana.violations:
+        print(v)
+    print(f"check_concurrency: {len(ana.lock_sites)} lock classes, "
+          f"{len(ana.edges)} acquisition edges, "
+          f"{len(ana.violations)} violation(s)")
+    return 1 if ana.violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

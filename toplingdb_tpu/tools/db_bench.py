@@ -19,6 +19,7 @@ import random
 import shutil
 import time
 
+from toplingdb_tpu.utils import concurrency as ccy
 from toplingdb_tpu.db.db import DB
 from toplingdb_tpu.options import Options, ReadOptions, WriteOptions
 from toplingdb_tpu.db.write_batch import WriteBatch
@@ -215,8 +216,7 @@ class Bench:
                 bg_op(i)
                 i += 1
 
-        t = threading.Thread(target=loop, daemon=True)
-        t.start()
+        t = ccy.spawn("db-bench-background", loop)
         try:
             return fg_bench(n)
         finally:

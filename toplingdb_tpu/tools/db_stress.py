@@ -25,6 +25,8 @@ import random
 import subprocess
 import sys
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 
@@ -38,7 +40,7 @@ class ExpectedState:
     def __init__(self, path: str):
         self.path = path
         self._f = open(path, "a")
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("db_stress.ExpectedState._mu")
         self._next_id = 1
 
     def load(self):
@@ -208,7 +210,7 @@ def run_stress(args) -> int:
         got = db.get(raw.encode(), cf=cf)
         model[k] = got.decode() if got is not None else None
 
-    lock = threading.Lock()
+    lock = ccy.Lock("db_stress.run_stress.lock")
     errors = []
     ops_done = [0]
 
@@ -264,7 +266,8 @@ def run_stress(args) -> int:
             except Exception as e:
                 errors.append(repr(e))
 
-    threads = [threading.Thread(target=worker, args=(t,))
+    threads = [ccy.spawn(f"stress-worker-{t}", worker, args=(t,),
+                         daemon=False, start=False)
                for t in range(args.threads)]
     for t in threads:
         t.start()

@@ -21,13 +21,13 @@ from toplingdb_tpu.utils.status import InvalidArgument, NotFound
 
 class BackupEngine:
     def __init__(self, backup_dir: str):
-        import threading
+        from toplingdb_tpu.utils import concurrency as ccy
 
         self.dir = backup_dir
         # Serializes create/delete/purge/GC: shared files and private dirs
         # land BEFORE their meta json, so an unsynchronized GC could sweep
         # a half-created backup's files as unreferenced garbage.
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("backup_engine.BackupEngine._mu")
         os.makedirs(os.path.join(backup_dir, "shared"), exist_ok=True)
         os.makedirs(os.path.join(backup_dir, "meta"), exist_ok=True)
         os.makedirs(os.path.join(backup_dir, "private"), exist_ok=True)
@@ -46,12 +46,9 @@ class BackupEngine:
         app_metadata: reference CreateNewBackupWithMetadata."""
         from toplingdb_tpu.utilities.checkpoint import create_checkpoint
 
-        self._mu.acquire()
-        try:
+        with self._mu:
             return self._create_backup_locked(db, app_metadata,
                                               create_checkpoint)
-        finally:
-            self._mu.release()
 
     def _create_backup_locked(self, db, app_metadata, create_checkpoint):
         backup_id = self._next_backup_id()

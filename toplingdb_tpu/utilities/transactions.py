@@ -14,6 +14,8 @@ Reference utilities/transactions/ in /root/reference:
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 from toplingdb_tpu.db.db import DB
@@ -60,13 +62,13 @@ class PointLockManager:
 
     def __init__(self, num_stripes: int = NUM_STRIPES):
         self._stripes = [
-            {"mu": threading.Lock(), "cv": threading.Condition(threading.Lock()),
+            {"mu": ccy.Lock("transactions.PointLockManager.stripe_mu"), "cv": ccy.Condition("transactions.PointLockManager.stripe_cv"),
              "locks": {}}
             for _ in range(num_stripes)
         ]
         self._n = num_stripes
         self._waits_for: dict[int, int] = {}   # txn id → txn id it waits on
-        self._wf_mu = threading.Lock()
+        self._wf_mu = ccy.Lock("transactions.PointLockManager._wf_mu")
 
     def _stripe(self, key: bytes):
         return self._stripes[hash(key) % self._n]
@@ -127,7 +129,7 @@ class RangeLockManager:
     PointLockManager (try_lock / unlock_all have the same shape)."""
 
     def __init__(self, max_ranges_per_txn: int = 1024):
-        self._cv = threading.Condition()
+        self._cv = ccy.Condition("transactions.RangeLockManager._cv")
         self._ranges: list[list] = []  # [begin, end, owner], sorted by begin
         self._max_per_txn = max_ranges_per_txn
         self._counts: dict[int, int] = {}
@@ -227,7 +229,7 @@ class RangeLockManager:
 
 class _TxnBase:
     _next_id = [1]
-    _id_lock = threading.Lock()
+    _id_lock = ccy.Lock("transactions._TxnBase._id_lock")
 
     def __init__(self, db: DB, write_options: WriteOptions):
         with self._id_lock:
@@ -412,13 +414,13 @@ class TransactionDB:
         self._txn_dir = f"{db.dbname}/txns"
         self._recovered: list[PessimisticTransaction] = []
         self._names: set[str] = set()
-        self._names_mu = threading.Lock()
+        self._names_mu = ccy.Lock("transactions.TransactionDB._names_mu")
         # WritePrepared/WriteUnprepared: seqno ranges of in-DB data belonging
         # to undecided transactions (name → [(lo, hi), ...]). Exposed to the
         # engine's read paths via DB._undecided_provider (the reference's
         # SnapshotChecker / commit-cache visibility role).
         self._undecided: dict[str, list] = {}
-        self._undecided_mu = threading.Lock()
+        self._undecided_mu = ccy.Lock("transactions.TransactionDB._undecided_mu")
         self._parked_guards: list = []  # (guard snapshot, ranges) — see
         #                                 _wp_release_guard
         db._undecided_provider = self._undecided_ranges

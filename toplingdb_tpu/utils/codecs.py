@@ -20,10 +20,12 @@ import ctypes
 import ctypes.util
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 from toplingdb_tpu.utils import coding
 from toplingdb_tpu.utils.status import Corruption, NotSupported
 
-_lock = threading.Lock()
+_lock = ccy.Lock("codecs._lock")
 _libs: dict[str, ctypes.CDLL | None] = {}
 
 

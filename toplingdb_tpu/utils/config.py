@@ -16,6 +16,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from toplingdb_tpu.utils import concurrency as ccy
 from toplingdb_tpu.utils.status import InvalidArgument
 
 
@@ -653,8 +654,8 @@ class SidePluginRepo:
                 self._send_json(code, body)
 
         self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        t = threading.Thread(target=self._server.serve_forever, daemon=True)
-        t.start()
+        ccy.spawn("sideplugin-http", self._server.serve_forever, owner=self,
+                  stop=self.stop_http)
         return self._server.server_address[1]
 
     def stop_http(self) -> None:

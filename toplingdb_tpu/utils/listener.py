@@ -151,10 +151,10 @@ class EventLogger:
     sink."""
 
     def __init__(self, sink=None):
-        import threading
+        from toplingdb_tpu.utils import concurrency as ccy
 
         self._sink = sink  # callable(str) or file-like; None = discarded
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("listener.EventLogger._mu")
 
     def log(self, event: str, **payload) -> str:
         rec = {"time_micros": int(time.time() * 1e6), "event": event}

@@ -24,6 +24,8 @@ from __future__ import annotations
 import os
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 from toplingdb_tpu.utils import coding, crc32c
 
 _F_SNAPPY = 0x1
@@ -46,7 +48,7 @@ class PersistentCache:
         self._tick = 0
         self._cur: int | None = None
         self._cur_f = None
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("persistent_cache.PersistentCache._mu")
         self._compress = compress and codecs.available("snappy")
         # -- stats (reference PersistentCache::Stats role) --------------
         self.hits = 0
@@ -65,13 +67,12 @@ class PersistentCache:
         self._pending_bytes = 0                  # not yet appended
         self._queue_cap = max(1 << 16, queue_bytes)
         self._closed = False
-        self._wake = threading.Condition(self._mu)
+        self._wake = ccy.Condition(lock=self._mu)
         self._writer = None
         if write_behind:
-            self._writer = threading.Thread(
-                target=self._writeback_loop, daemon=True,
-                name="pcache-writeback")
-            self._writer.start()
+            self._writer = ccy.spawn("pcache-writeback",
+                                     self._writeback_loop, owner=self,
+                                     stop=self.close)
 
     # -- layout helpers -------------------------------------------------
 

@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 
@@ -18,7 +20,7 @@ class RateLimiter:
         self._period = refill_period_us / 1e6
         self._available = bytes_per_second * self._period
         self._last_refill = time.monotonic()
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("rate_limiter.RateLimiter._mu")
         self.total_through = 0
 
     def request(self, n: int) -> None:
@@ -86,8 +88,8 @@ class WriteController:
     def __init__(self):
         self._stopped = False
         self._delay_bytes_per_sec = 0
-        self._mu = threading.Lock()
-        self._cv = threading.Condition(self._mu)
+        self._mu = ccy.Lock("rate_limiter.WriteController._mu")
+        self._cv = ccy.Condition(lock=self._mu)
         self.total_stall_micros = 0
 
     def stop_writes(self) -> None:
@@ -124,7 +126,7 @@ class WriteBufferManager:
     def __init__(self, buffer_size: int):
         self.buffer_size = buffer_size
         self._usage = 0
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("rate_limiter.WriteBufferManager._mu")
 
     def reserve(self, n: int) -> None:
         with self._mu:
@@ -149,7 +151,9 @@ class SstFileManager:
                  max_trash_db_ratio: float = 0.25):
         self.rate = bytes_per_sec_delete
         self._tracked: dict[str, int] = {}
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("rate_limiter.SstFileManager._mu")
+        self._stop = threading.Event()
+        self._delete_threads: list[threading.Thread] = []
 
     def on_add_file(self, path: str, size: int | None = None) -> None:
         with self._mu:
@@ -180,10 +184,29 @@ class SstFileManager:
 
         def worker():
             if self.rate > 0 and size > 0:
-                time.sleep(min(size / self.rate, 10.0))
+                # Interruptible pacing: wait_for_deletes()/close() must not
+                # block behind a sleeping deleter (the lifecycle hole the
+                # concurrency lint flagged — these workers were
+                # fire-and-forget).
+                self._stop.wait(min(size / self.rate, 10.0))
             try:
                 os.remove(trash)
             except OSError:
                 pass
 
-        threading.Thread(target=worker, daemon=True).start()
+        t = ccy.spawn("sst-trash-delete", worker, owner=self)
+        with self._mu:
+            self._delete_threads = [
+                x for x in self._delete_threads if x.is_alive()]
+            self._delete_threads.append(t)
+
+    def wait_for_deletes(self, timeout: float = 15.0) -> None:
+        """Join every in-flight trash deleter (close path / tests)."""
+        self._stop.set()
+        with self._mu:
+            pending, self._delete_threads = self._delete_threads, []
+        for t in pending:
+            t.join(timeout)
+        self._stop.clear()
+
+    close = wait_for_deletes

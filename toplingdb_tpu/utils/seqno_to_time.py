@@ -12,12 +12,14 @@ from __future__ import annotations
 import bisect
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 
 class SeqnoToTimeMapping:
     def __init__(self, max_capacity: int = 100):
         self._pairs: list[tuple[int, int]] = []  # (seqno, unix_time) ascending
         self._max = max(2, max_capacity)
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("seqno_to_time.SeqnoToTimeMapping._mu")
 
     def append(self, seqno: int, time_: int) -> None:
         """Record seqno existed at time_; out-of-order appends are ignored

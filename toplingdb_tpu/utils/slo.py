@@ -24,6 +24,8 @@ green/degraded/unhealthy rubric ShardRouter.status() reports.
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 from dataclasses import asdict, dataclass, field
 
@@ -121,7 +123,7 @@ class SLOEngine:
         self._listeners = list(listeners or ())
         self._default_fast = float(default_window_sec) or 60.0
         self._clock = clock if clock is not None else time.time
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("slo.SLOEngine._mu")
         # Ring of (ts, {spec_name: (bad, total)}) cumulative measures.
         self._ring: list[tuple[float, dict[str, tuple[float, float]]]] = []
         self._state: dict[str, _SpecState] = {
@@ -293,8 +295,8 @@ class SLOEngine:
                 except Exception:
                     pass  # an evaluation bug must not kill the sampler
 
-        self._thread = threading.Thread(target=_run, daemon=True)
-        self._thread.start()
+        self._thread = ccy.spawn("slo-eval", _run, owner=self,
+                                 stop=self.stop)
 
     def stop(self) -> None:
         if self._thread is None:

@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import math
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 from collections import defaultdict
 
@@ -505,7 +507,7 @@ class Statistics:
         self._window_intervals = max(1, int(histogram_window_intervals))
         self._histograms: dict[str, Histogram] = defaultdict(
             self._new_histogram)
-        self._lock = threading.Lock()
+        self._lock = ccy.Lock("statistics.Statistics._lock")
         # Hot read-path histograms pre-created so record_get skips the
         # defaultdict machinery per call.
         self._h_get_micros = self._histograms[DB_GET_MICROS]

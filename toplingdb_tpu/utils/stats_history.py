@@ -15,6 +15,8 @@ and the fleet autopilot (ROADMAP item 1) consume.
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 import warnings
 
@@ -33,7 +35,7 @@ class StatsHistory:
         # Per-histogram (count, sum) at the previous snapshot, for the
         # interval-delta rows.
         self._last_hist: dict[str, tuple[int, float]] = {}
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("stats_history.StatsHistory._mu")
 
     def snapshot(self, now: int | None = None) -> None:
         """Record the ticker + histogram deltas since the previous
@@ -123,8 +125,8 @@ class StatsDumpScheduler:
             else history._stats
         self.errors = 0
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._thread = ccy.spawn("stats-dump", self._run, owner=self,
+                                 stop=self.stop)
 
     def _run(self) -> None:
         while not self._stop.wait(self._period):

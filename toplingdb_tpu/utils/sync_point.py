@@ -12,12 +12,14 @@ from __future__ import annotations
 
 import threading
 
+from toplingdb_tpu.utils import concurrency as ccy
+
 
 class _SyncPointRegistry:
     def __init__(self):
         self._enabled = False
-        self._mu = threading.Lock()
-        self._cv = threading.Condition(self._mu)
+        self._mu = ccy.Lock("sync_point._SyncPointRegistry._mu")
+        self._cv = ccy.Condition(lock=self._mu)
         self._callbacks: dict[str, object] = {}
         self._successors: dict[str, list[str]] = {}   # A → [B]: A before B
         self._predecessors: dict[str, list[str]] = {}

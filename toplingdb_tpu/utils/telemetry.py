@@ -33,6 +33,8 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 from collections import OrderedDict, deque
 
@@ -176,7 +178,7 @@ class Tracer:
         # cost.
         self._tid_base = os.urandom(6).hex()
         self._tid_n = itertools.count(1)
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("telemetry.Tracer._mu")
         self._ring: deque[Trace] = deque(maxlen=max(1, int(ring)))
         self._by_id: dict[str, Trace] = {}
         self._active: dict[str, Trace] = {}

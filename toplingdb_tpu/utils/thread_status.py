@@ -9,10 +9,12 @@ the "what is the DB doing right now" introspection surface."""
 from __future__ import annotations
 
 import threading
+
+from toplingdb_tpu.utils import concurrency as ccy
 import time
 
 _REGISTRY: dict[int, dict] = {}
-_MU = threading.Lock()
+_MU = ccy.Lock("thread_status._MU")
 
 
 def set_thread_operation(operation: str, stage: str = "",

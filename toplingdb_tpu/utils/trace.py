@@ -48,11 +48,11 @@ class OpTracer:
 
     def __init__(self, env, trace_path: str,
                  options: TraceOptions | None = None):
-        import threading
+        from toplingdb_tpu.utils import concurrency as ccy
 
         self.options = options or TraceOptions()
         self._w = LogWriter(env.new_writable_file(trace_path))
-        self._mu = threading.Lock()
+        self._mu = ccy.Lock("trace.OpTracer._mu")
         self._written = 0
         self._seq = 0
         self.stopped = False
