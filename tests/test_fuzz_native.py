@@ -14,6 +14,7 @@ pytestmark = pytest.mark.skipif(native.lib() is None,
 
 @pytest.mark.parametrize("target,runs", [
     ("wb", 400), ("block", 400), ("scan", 200), ("manifest", 25),
+    ("abi", 800),
 ])
 def test_fuzz_target_budgeted(target, runs, tmp_path):
     import random
@@ -26,6 +27,25 @@ def test_fuzz_target_budgeted(target, runs, tmp_path):
     assert len(corpus.signatures) >= 2
     # Corpus persistence: interesting inputs landed on disk for reuse.
     assert os.listdir(str(tmp_path / target))
+
+
+def test_shapes_come_from_the_parsed_contract():
+    """The abi target's argument lists are generated from the SAME three
+    sources the ABI checker cross-validates; handle-taking symbols (`:!`
+    specs) are correctly refused rather than minted from garbage."""
+    import random
+
+    sigs, bindings, rows = fz.load_abi_contract()
+    rng = random.Random(7)
+    for sym in fz.ABI_FUZZ_SYMS:
+        shaped = fz.shapes_from_contract(rng, sym, sigs, bindings, rows,
+                                         b"\x00" * 32)
+        assert shaped is not None, sym
+        args, _keep = shaped
+        assert len(args) == len(sigs[sym][1])  # one value per C parameter
+    # Opaque-handle symbols are not fuzzable from bytes.
+    assert fz.shapes_from_contract(rng, "tpulsm_db_get", sigs, bindings,
+                                   rows, b"") is None
 
 
 def test_manifest_garbage_head_fails_open(tmp_path):

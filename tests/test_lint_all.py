@@ -1,0 +1,61 @@
+"""The unified tier-1 lint driver (tools/lint_all.py).
+
+One invocation runs every static correctness plane — telemetry,
+concurrency, native-abi, errors — with per-checker exit semantics
+preserved in the report and a single aggregate exit code. The whole run
+must stay inside a 10s tier-1 budget.
+"""
+
+import textwrap
+
+from toplingdb_tpu.tools import lint_all
+
+_BUDGET_S = 10.0
+
+
+def test_real_tree_clean_within_budget():
+    violations, results = lint_all.run()
+    assert violations == []
+    # Every plane ran, none was silently skipped.
+    assert set(results) == {"native-abi", "telemetry", "errors",
+                            "concurrency"}
+    for name, (found, _dt) in results.items():
+        assert found == [], (name, found)
+    assert sum(dt for _, dt in results.values()) < _BUDGET_S
+
+
+def test_cli_exit_zero_and_per_checker_report(capsys):
+    assert lint_all.main([]) == 0
+    out = capsys.readouterr().out
+    for name in ("native-abi", "telemetry", "errors", "concurrency"):
+        assert f"lint_all: {name:<12} exit=0" in out
+    assert "0 violation(s) total" in out
+
+
+def test_single_nonzero_exit_on_any_finding(tmp_path, capsys):
+    """A violation in ONE plane must flip the aggregate exit code while
+    the per-checker report still attributes it to that plane."""
+    pkg = tmp_path / "toplingdb_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(textwrap.dedent("""\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        """))
+    assert lint_all.main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "bad.py:4:" in out  # the finding's witness survives aggregation
+    assert "lint_all: errors" in out and "exit=1" in out
+
+
+def test_crashed_checker_is_a_finding(tmp_path):
+    """An analyzer that cannot even parse its inputs must fail the run,
+    not vanish from it (a missing native source tree crashes the ABI
+    parse)."""
+    (tmp_path / "toplingdb_tpu").mkdir()
+    violations, results = lint_all.run(str(tmp_path))
+    assert any("native-abi" in v and "crashed" in v for v in violations) \
+        or results["native-abi"][0], violations
+    assert violations

@@ -1,0 +1,170 @@
+"""The ctypes↔C ABI contract checker (tools/check_native_abi.py).
+
+Static: the checker must pass over the real tree (every export bound,
+every binding shaped by the C signature, every pointer paired in the
+ARCHITECTURE.md §2.10.2 table), and must catch seeded contract breaks on
+synthetic trees — a drifted argtype, an unbound export, a phantom
+binding, a missing length pairing, a stale table row — each with a
+file:line witness.
+"""
+
+import textwrap
+
+from toplingdb_tpu.tools import check_native_abi as abi
+
+# ---------------------------------------------------------------------------
+# The real tree
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_clean_and_nonempty():
+    assert abi.run() == []
+    sigs, v = abi.parse_c_signatures(
+        abi.os.path.join(abi.os.path.dirname(abi.__file__), "..",
+                         "native", "tpulsm_native.cc"))
+    assert v == []
+    # The parser actually saw the surface (not a silently-empty scan).
+    assert len(sigs) >= 65
+
+
+def test_cli_exits_zero_on_clean_tree(capsys):
+    assert abi.main([]) == 0
+    out = capsys.readouterr().out
+    assert "check_native_abi:" in out
+    assert "0 violation(s)" in out
+
+
+def test_every_export_has_a_contract_row():
+    root = abi.os.path.dirname(abi.os.path.dirname(abi.os.path.dirname(
+        abi.os.path.abspath(abi.__file__))))
+    sigs, _ = abi.parse_c_signatures(abi.os.path.join(
+        root, "toplingdb_tpu", "native", "tpulsm_native.cc"))
+    rows, v = abi.parse_contract_table(abi.os.path.join(root,
+                                                        "ARCHITECTURE.md"))
+    assert v == []
+    assert set(rows) == set(sigs)
+
+
+# ---------------------------------------------------------------------------
+# Seeded contract breaks on synthetic trees
+# ---------------------------------------------------------------------------
+
+_CC = """\
+extern "C" {
+
+int32_t tpulsm_add(const uint8_t* buf, int64_t n, int32_t flag) {
+  return 0;
+}
+
+void* tpulsm_open(void) { return 0; }
+
+}
+"""
+
+_INIT = """\
+import ctypes
+
+
+def lib():
+    l = ctypes.CDLL("libx.so")
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    l.tpulsm_add.restype = ctypes.c_int32
+    l.tpulsm_add.argtypes = [u8p, ctypes.c_int64, ctypes.c_int32]
+    l.tpulsm_open.restype = ctypes.c_void_p
+    l.tpulsm_open.argtypes = []
+    return l
+"""
+
+_ARCH = """\
+## Native
+
+#### §2.10.2 ABI contract
+
+| symbol | ret | argc | buffers |
+|---|---|---|---|
+| `tpulsm_add` | int32_t | 3 | `buf:n` |
+| `tpulsm_open` | void* | 0 | — |
+"""
+
+
+def _tree(tmp_path, cc=_CC, init=_INIT, arch=_ARCH):
+    nat = tmp_path / "toplingdb_tpu" / "native"
+    nat.mkdir(parents=True)
+    (nat / "tpulsm_native.cc").write_text(textwrap.dedent(cc))
+    (nat / "__init__.py").write_text(textwrap.dedent(init))
+    (tmp_path / "ARCHITECTURE.md").write_text(textwrap.dedent(arch))
+    return abi.run(str(tmp_path))
+
+
+def test_synthetic_baseline_is_clean(tmp_path):
+    assert _tree(tmp_path) == []
+
+
+def test_detects_drifted_argtype(tmp_path):
+    out = _tree(tmp_path, init=_INIT.replace(
+        "[u8p, ctypes.c_int64, ctypes.c_int32]",
+        "[u8p, ctypes.c_int32, ctypes.c_int32]"))
+    hits = [v for v in out if "argtypes[1]" in v and "tpulsm_add" in v]
+    assert len(hits) == 1, out
+    assert "__init__.py:" in hits[0]  # file:line witness
+
+
+def test_detects_unbound_export(tmp_path):
+    out = _tree(tmp_path, init="\n".join(
+        ln for ln in _INIT.splitlines() if "tpulsm_open" not in ln) + "\n")
+    hits = [v for v in out if "unbound export" in v]
+    assert len(hits) == 1, out
+    assert "tpulsm_open" in hits[0] and "tpulsm_native.cc" in hits[0]
+
+
+def test_detects_phantom_binding(tmp_path):
+    out = _tree(tmp_path, init=_INIT.replace(
+        "    return l",
+        "    l.tpulsm_ghost.restype = ctypes.c_int32\n"
+        "    l.tpulsm_ghost.argtypes = []\n"
+        "    return l"))
+    hits = [v for v in out if "phantom binding" in v]
+    assert len(hits) == 1, out
+    assert "tpulsm_ghost" in hits[0] and "__init__.py:" in hits[0]
+
+
+def test_detects_missing_length_pairing(tmp_path):
+    out = _tree(tmp_path, arch=_ARCH.replace("`buf:n`", "—"))
+    hits = [v for v in out if "no buffer-pairing spec" in v]
+    assert len(hits) == 1, out
+    assert "'buf'" in hits[0] and "tpulsm_add" in hits[0]
+
+
+def test_detects_stale_table_row(tmp_path):
+    out = _tree(tmp_path, arch=_ARCH.replace(
+        "| `tpulsm_add` | int32_t | 3 |", "| `tpulsm_add` | int32_t | 2 |"))
+    hits = [v for v in out if "stale row" in v and "tpulsm_add" in v]
+    assert len(hits) == 1, out
+
+
+def test_detects_missing_table_row(tmp_path):
+    out = _tree(tmp_path, arch=_ARCH.replace(
+        "| `tpulsm_open` | void* | 0 | — |\n", ""))
+    hits = [v for v in out if "missing a row" in v]
+    assert len(hits) == 1, out
+    assert "tpulsm_open" in hits[0]
+
+
+def test_detects_restype_drift(tmp_path):
+    out = _tree(tmp_path, init=_INIT.replace(
+        "l.tpulsm_add.restype = ctypes.c_int32",
+        "l.tpulsm_add.restype = ctypes.c_int64"))
+    hits = [v for v in out if "restype" in v and "tpulsm_add" in v]
+    assert len(hits) == 1, out
+    assert "__init__.py:" in hits[0]
+
+
+def test_mutable_buffer_refuses_c_char_p(tmp_path):
+    """c_char_p points at immutable Python bytes — binding a non-const
+    C out-buffer to it is the classic silent-corruption drift."""
+    cc = _CC.replace("const uint8_t* buf", "uint8_t* buf")
+    init = _INIT.replace("[u8p,", "[ctypes.c_char_p,")
+    out = _tree(tmp_path, cc=cc, init=init)
+    hits = [v for v in out if "argtypes[0]" in v]
+    assert len(hits) == 1, out
+    assert "c_char_p" in hits[0]

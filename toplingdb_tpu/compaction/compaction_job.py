@@ -27,6 +27,7 @@ from toplingdb_tpu.compaction.compaction_iterator import CompactionIterator
 from toplingdb_tpu.compaction.picker import Compaction
 from toplingdb_tpu.table.factory import new_table_builder
 from toplingdb_tpu.table.merging_iterator import MergingIterator
+from toplingdb_tpu.utils import errors as _errors
 
 
 @dataclass
@@ -397,13 +398,13 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
         if wfile is not None:
             try:
                 wfile.close()
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="compact-abort-close", exc=e)
         for m in outputs:
             try:
                 env.delete_file(filename.table_file_name(dbname, m.number))
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="compact-abort-delete-output", exc=e)
         # fnum may name an output whose builder never constructed (the
         # ctor raised) — the file exists, so delete unconditionally; a
         # stale fnum from a completed output is already gone above and the
@@ -411,8 +412,8 @@ def build_outputs(env, dbname: str, icmp, compaction: Compaction,
         if fnum is not None:
             try:
                 env.delete_file(filename.table_file_name(dbname, fnum))
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="compact-abort-delete-current", exc=e)
         raise
     return outputs
 

@@ -51,6 +51,7 @@ from toplingdb_tpu.compaction.executor import (
 from toplingdb_tpu.compaction.picker import Compaction
 from toplingdb_tpu.db import filename
 from toplingdb_tpu.utils.status import Corruption, InvalidArgument
+from toplingdb_tpu.utils import errors as _errors
 
 
 @dataclasses.dataclass
@@ -320,8 +321,8 @@ class CompactionServiceExecutor(CompactionExecutor):
                     self._env.delete_file(
                         os.path.join(self._output_dir, child)
                     )
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="remote-output-cleanup", exc=e)
             try:
                 os.rmdir(self._output_dir)  # best-effort for posix envs
             except OSError:

@@ -42,6 +42,7 @@ import time
 
 from toplingdb_tpu.utils import statistics as stats_mod
 from toplingdb_tpu.utils.status import IOError_
+from toplingdb_tpu.utils import errors as _errors
 
 
 # ---------------------------------------------------------------------------
@@ -188,11 +189,10 @@ class WorkerHealthRegistry:
             return b
 
     def _notify(self, url: str, b: CircuitBreaker) -> None:
+        # observers must never take down job routing
         for obs in list(self.observers):
-            try:
+            with _errors.guard(listener=obs):
                 obs(url, b.state, b.consecutive_failures)
-            except Exception:
-                pass  # observers must never take down job routing
 
     def pick(self, urls: list[str]) -> str | None:
         """Round-robin over `urls`, skipping URLs whose breaker refuses

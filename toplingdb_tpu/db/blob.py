@@ -21,6 +21,7 @@ import os
 
 from toplingdb_tpu.utils import coding, crc32c
 from toplingdb_tpu.utils.status import Corruption
+from toplingdb_tpu.utils import errors as _errors
 
 MAGIC = b"TPULSMBL"
 
@@ -256,8 +257,8 @@ class BlobGarbageCollector:
                 self._env.delete_file(
                     blob_file_name(self._dbname, self.new_blob_file)
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="blob-empty-file-delete", exc=e)
             self.new_blob_file = None
         self._builder = None
 
@@ -271,8 +272,8 @@ class BlobGarbageCollector:
             self._env.delete_file(
                 blob_file_name(self._dbname, self.new_blob_file)
             )
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="blob-abort-delete", exc=e)
         self.new_blob_file = None
         self._builder = None
 

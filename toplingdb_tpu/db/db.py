@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 
 from toplingdb_tpu.utils import concurrency as ccy
+from toplingdb_tpu.utils import errors as _errors
 import time
 import uuid
 import warnings
@@ -162,8 +163,8 @@ class _NGetState:
         if lib is not None and ctx:
             try:
                 lib.tpulsm_getctx_free(ctx)
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="getctx-free-at-gc", exc=e)
 
     def remap(self, lib, vlen: int) -> None:
         # The C side grew its buffer to >= vlen; record vlen as the known
@@ -473,8 +474,8 @@ class DB:
                 if env.file_exists(f"{dbname}/LOG"):
                     env.rename_file(f"{dbname}/LOG", f"{dbname}/LOG.old")
                 self._log_file = env.new_writable_file(f"{dbname}/LOG")
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="info-log-roll-best-effort", exc=e)
         self.event_logger = EventLogger(
             (lambda line: self._log_file.append(line.encode() + b"\n"))
             if self._log_file is not None else None
@@ -617,8 +618,9 @@ class DB:
 
             raw = env.read_file(db._seqno_time_path)
             db.seqno_to_time.load(_json.loads(raw.decode()))
-        except Exception:
-            pass  # absent/corrupt sidecar: start fresh (best effort)
+        except Exception as e:
+            # Absent/corrupt sidecar: start fresh (best effort).
+            _errors.swallow(reason="seqno-time-sidecar-load", exc=e)
         try:
             from toplingdb_tpu.utils.config import (
                 load_latest_options, persist_options,
@@ -636,8 +638,10 @@ class DB:
                         prev.full_history_ts_low,
                     )
             persist_options(db)  # reference PersistRocksDBOptions on open
-        except Exception:
-            pass  # OPTIONS persistence is best-effort, like the reference
+        except Exception as e:
+            # OPTIONS persistence is best-effort, like the reference.
+            _errors.swallow(reason="options-persist-on-open", exc=e,
+                            stats=options.statistics)
         db._delete_obsolete_files()
         try:
             # A kill -9'd dcompact worker leaves its job dir (params,
@@ -658,8 +662,10 @@ class DB:
                 sweep_orphan_jobs(root, policy.lease_sec,
                                   statistics=options.statistics,
                                   event_logger=db.event_logger)
-        except Exception:
-            pass  # sweeping is best-effort; never blocks open
+        except Exception as e:
+            # Sweeping is best-effort; never blocks open.
+            _errors.swallow(reason="orphan-job-sweep-on-open", exc=e,
+                            stats=options.statistics)
         from toplingdb_tpu.compaction.scheduler import CompactionScheduler
 
         db._compaction_scheduler = CompactionScheduler(db)
@@ -1553,8 +1559,9 @@ class DB:
             self.env.write_file(
                 self._seqno_time_path,
                 _json.dumps(self.seqno_to_time.to_list()).encode())
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="seqno-time-sidecar-save", exc=e,
+                            stats=self.stats)
 
     def _post_publish_work(self, group: list[_Writer]) -> None:
         """Stats + seqno/time sampling + flush trigger after a publish

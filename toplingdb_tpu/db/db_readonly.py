@@ -15,6 +15,7 @@ from toplingdb_tpu.db.log import LogReader
 from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.options import Options
 from toplingdb_tpu.utils.status import NotFound, NotSupported
+from toplingdb_tpu.utils import errors as _errors
 
 
 class ReadOnlyDB(DB):
@@ -58,8 +59,9 @@ class ReadOnlyDB(DB):
                     end = batch.sequence() + batch.count() - 1
                     if end > self.versions.last_sequence:
                         self.versions.last_sequence = end
-            except Exception:
-                pass  # primary may be appending; read what's durable
+            except Exception as e:
+                # primary may be appending; read what's durable
+                _errors.swallow(reason="catch-up-tail-race", exc=e)
 
     def write(self, batch, opts=None) -> None:
         raise NotSupported("DB is open read-only")

@@ -10,6 +10,7 @@ from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
 from toplingdb_tpu.table.factory import new_table_builder
 from toplingdb_tpu.table.merging_iterator import MergingIterator
 from toplingdb_tpu.utils.status import Corruption
+from toplingdb_tpu.utils import errors as _errors
 
 
 def _flush_protection(memtables, table_options):
@@ -268,8 +269,8 @@ def flush_memtable_to_table(env, dbname: str, file_number: int, icmp,
             if blob_builder.finish() == 0:
                 try:
                     env.delete_file(blob_file_name(dbname, blob_file_number))
-                except Exception:
-                    pass
+                except Exception as e:
+                    _errors.swallow(reason="blob-empty-file-delete", exc=e)
 
     return FileMetaData(
         number=file_number,

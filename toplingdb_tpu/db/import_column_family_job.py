@@ -18,6 +18,7 @@ import os
 from toplingdb_tpu.db import filename
 from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
 from toplingdb_tpu.utils.status import Corruption, InvalidArgument, NotSupported
+from toplingdb_tpu.utils import errors as _errors
 
 METADATA_FILE = "export_metadata.json"
 
@@ -223,8 +224,8 @@ def import_column_family(db, name: str, source_dir: str,
         for p in copied:
             try:
                 env.delete_file(p)
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="import-cleanup-delete", exc=e)
         raise
     with db._mutex:
         handle = db.create_column_family(name)
@@ -242,14 +243,14 @@ def import_column_family(db, name: str, source_dir: str,
             for p in copied:
                 try:
                     env.delete_file(p)
-                except Exception:
-                    pass
+                except Exception as e:
+                    _errors.swallow(reason="import-rollback-delete", exc=e)
             db.drop_column_family(handle)
             raise
     if move_files:
         for ef in metadata.files:
             try:
                 env.delete_file(os.path.join(source_dir, ef.name))
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="import-move-source-delete", exc=e)
     return handle

@@ -39,6 +39,7 @@ from toplingdb_tpu.utils.file_checksum import (
     compute_file_checksum,
 )
 from toplingdb_tpu.utils.status import Corruption
+from toplingdb_tpu.utils import errors as _errors
 
 
 class _Pacer:
@@ -104,8 +105,9 @@ class IntegrityScrubber:
         while not self._stop.wait(self.period_sec):
             try:
                 self.run_pass()
-            except Exception:
-                pass  # a broken pass must not kill the cadence
+            except Exception as e:
+                # a broken pass must not kill the cadence
+                _errors.swallow(reason="integrity-pass-retry", exc=e)
 
     # -- one pass ------------------------------------------------------
 

@@ -25,6 +25,7 @@ from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
 from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.options import Options
 from toplingdb_tpu.table.factory import open_table
+from toplingdb_tpu.utils import errors as _errors
 
 
 def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
@@ -109,7 +110,8 @@ def repair_db(dbname: str, options: Options | None = None, env=None) -> dict:
             ))
             max_seq = max(max_seq, props.largest_seqno)
             report["tables_kept"] += 1
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="repair-table-unreadable", exc=e)
             env.rename_file(path, f"{archive}/{child}")
             report["tables_dropped"] += 1
 

@@ -20,6 +20,7 @@ from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType
 from toplingdb_tpu.db.log import LogReader, LogWriter
 from toplingdb_tpu.db.version_edit import FileMetaData, VersionEdit
 from toplingdb_tpu.utils.status import Corruption, NotFound
+from toplingdb_tpu.utils import errors as _errors
 
 
 class Version:
@@ -107,7 +108,8 @@ class Version:
                     handles.append(h)
                 # level_offs[0] == n_l0; [li], [li+1] bound deeper level li.
                 level_offs.append(len(handles))
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="native-chain-build-fallback", exc=e)
             self._nchain = None
             return None
         n_l0 = level_offs[0]
@@ -479,7 +481,8 @@ class VersionSet:
                     return self.env.get_file_size(
                         filename.manifest_file_name(
                             self.dbname, self.manifest_file_number))
-                except Exception:
+                except Exception as e:
+                    _errors.swallow(reason="manifest-size-probe", exc=e)
                     return 0
             self._manifest_writer.sync()
             return self._manifest_writer._f.file_size()

@@ -18,6 +18,7 @@ import time
 
 from toplingdb_tpu.utils import statistics as _stats_mod
 from toplingdb_tpu.utils.status import IOError_, NotFound
+from toplingdb_tpu.utils import errors as _errors
 
 
 class WritableFile:
@@ -315,10 +316,9 @@ class AsyncIORing:
                             merged = len(sync_toks) - 1
                             self.fsyncs_coalesced += merged
                             if self.coalesce_cb is not None:
-                                try:
+                                with _errors.guard(
+                                        listener=self.coalesce_cb):
                                     self.coalesce_cb(merged)
-                                except Exception:
-                                    pass
                 elif appended and err is None:
                     # No fsync requested: hand the bytes to the OS so a
                     # process crash behaves like the inline write path.

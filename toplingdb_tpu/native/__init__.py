@@ -13,6 +13,7 @@ import subprocess
 import threading
 
 from toplingdb_tpu.utils import concurrency as ccy
+from toplingdb_tpu.utils import errors as _errors
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "tpulsm_native.cc")
@@ -38,6 +39,11 @@ else:
 _lock = ccy.Lock("native._lock")
 _lib: ctypes.CDLL | None = None
 _tried = False
+
+# Must match TPULSM_ABI_VERSION in tpulsm_native.cc. The loader refuses a
+# .so reporting a different version: mtime staleness alone cannot catch a
+# restored backup or a clock-skewed rebuild.
+_ABI_VERSION = 1
 
 
 def _compile(src: str, so: str, extra_flags: list[str]) -> bool:
@@ -88,6 +94,22 @@ def lib() -> ctypes.CDLL | None:
             l = ctypes.CDLL(_SO)
         except OSError:
             return None
+        try:
+            l.tpulsm_abi_version.restype = ctypes.c_int32
+            l.tpulsm_abi_version.argtypes = []
+            abi_ok = l.tpulsm_abi_version() == _ABI_VERSION
+        except AttributeError:
+            abi_ok = False  # artifact predates the handshake symbol
+        if not abi_ok:
+            # mtime lied (restored backup / clock skew): one forced
+            # rebuild, then give up rather than run a drifted ABI.
+            if not _build():
+                return None
+            l = ctypes.CDLL(_SO)
+            l.tpulsm_abi_version.restype = ctypes.c_int32
+            l.tpulsm_abi_version.argtypes = []
+            if l.tpulsm_abi_version() != _ABI_VERSION:
+                return None
         l.tpulsm_crc32c_extend.restype = ctypes.c_uint32
         l.tpulsm_crc32c_extend.argtypes = [
             ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t,
@@ -392,6 +414,12 @@ def lib() -> ctypes.CDLL | None:
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
                 ctypes.c_uint64, u8p, ctypes.c_int32, i32p, i32p, i64p,
             ]
+            l.tpulsm_db_get_kinds.restype = ctypes.c_int32
+            l.tpulsm_db_get_kinds.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), i32p, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+                ctypes.c_uint64, u8p, ctypes.c_int32, i32p, i32p, i64p,
+            ]
             l.tpulsm_getctx_new.restype = ctypes.c_void_p
             l.tpulsm_getctx_new.argtypes = [
                 ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
@@ -452,14 +480,16 @@ def pylib() -> "ctypes.PyDLL | None":
     l.tpulsm_skiplist_count.argtypes = [vp]
     l.tpulsm_skiplist_memory.restype = ctypes.c_int64
     l.tpulsm_skiplist_memory.argtypes = [vp]
-    for name in ("tpulsm_skiplist_seek_ge", "tpulsm_skiplist_seek_lt"):
-        fn = getattr(l, name)
-        fn.restype = vp
-        fn.argtypes = [vp, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
-    for name in ("tpulsm_skiplist_first", "tpulsm_skiplist_last"):
-        fn = getattr(l, name)
-        fn.restype = vp
-        fn.argtypes = [vp]
+    l.tpulsm_skiplist_seek_ge.restype = vp
+    l.tpulsm_skiplist_seek_ge.argtypes = [
+        vp, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+    l.tpulsm_skiplist_seek_lt.restype = vp
+    l.tpulsm_skiplist_seek_lt.argtypes = [
+        vp, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+    l.tpulsm_skiplist_first.restype = vp
+    l.tpulsm_skiplist_first.argtypes = [vp]
+    l.tpulsm_skiplist_last.restype = vp
+    l.tpulsm_skiplist_last.argtypes = [vp]
     l.tpulsm_skiplist_next.restype = vp
     l.tpulsm_skiplist_next.argtypes = [vp]
     l.tpulsm_skiplist_node.restype = None
@@ -483,15 +513,16 @@ def pylib() -> "ctypes.PyDLL | None":
         l.tpulsm_trie_count.argtypes = [vp]
         l.tpulsm_trie_memory.restype = ctypes.c_int64
         l.tpulsm_trie_memory.argtypes = [vp]
-        for name in ("tpulsm_trie_seek_ge", "tpulsm_trie_seek_lt"):
-            fn = getattr(l, name)
-            fn.restype = vp
-            fn.argtypes = [vp, ctypes.c_char_p, ctypes.c_uint32,
-                           ctypes.c_uint64]
-        for name in ("tpulsm_trie_first", "tpulsm_trie_last"):
-            fn = getattr(l, name)
-            fn.restype = vp
-            fn.argtypes = [vp]
+        l.tpulsm_trie_seek_ge.restype = vp
+        l.tpulsm_trie_seek_ge.argtypes = [
+            vp, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+        l.tpulsm_trie_seek_lt.restype = vp
+        l.tpulsm_trie_seek_lt.argtypes = [
+            vp, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+        l.tpulsm_trie_first.restype = vp
+        l.tpulsm_trie_first.argtypes = [vp]
+        l.tpulsm_trie_last.restype = vp
+        l.tpulsm_trie_last.argtypes = [vp]
         l.tpulsm_trie_next.restype = vp
         l.tpulsm_trie_next.argtypes = [vp, vp]
         l.tpulsm_trie_ver.restype = None
@@ -573,7 +604,8 @@ def fastget():
             mod.bind(_SO)
             _fastget_mod = mod
             return mod.get
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="fastget-bind-fallback", exc=e)
             return None
 
 

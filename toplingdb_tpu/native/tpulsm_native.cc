@@ -51,6 +51,14 @@ static size_t effective_cpus() {
 
 extern "C" {
 
+// ABI version handshake: the ctypes loader refuses a .so whose version
+// differs from its own expectation, so a stale artifact (mtime lies —
+// e.g. a restored backup or clock skew) can never drift silently.
+// Bump whenever any exported signature changes shape.
+#define TPULSM_ABI_VERSION 1
+
+int32_t tpulsm_abi_version(void) { return TPULSM_ABI_VERSION; }
+
 // Shared packed-entry representation of the <=8B-user-key fast path:
 // tpulsm_sort_entries and tpulsm_merge_runs promise BIT-EXACT identical
 // output, so the struct, comparator, and entry build live in ONE place.
@@ -4510,7 +4518,8 @@ static int64_t gc_frame_merged(GcCursor& cur, int64_t total_len,
 // -3 (wal_cap too small), -4 (corrupt image), -5 - i (protection mismatch
 // at group record index i).
 int64_t tpulsm_wb_group_commit(void* mem, int32_t mem_kind,
-                               const void* const* reps, const int64_t* lens,
+                               const uint8_t* const* reps,
+                               const int64_t* lens,
                                int64_t n_batches, uint64_t first_seq,
                                uint64_t* prots, int64_t n_prots,
                                int32_t pb, int32_t mode, int64_t block_offset,

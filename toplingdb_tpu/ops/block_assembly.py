@@ -43,6 +43,7 @@ import numpy as np
 from toplingdb_tpu.db.dbformat import ValueType
 from toplingdb_tpu.ops import compaction_kernels as ck
 from toplingdb_tpu.utils.status import NotSupported
+from toplingdb_tpu.utils import errors as _errors
 
 _I32MAX = 2 ** 31 - 1
 
@@ -475,6 +476,6 @@ def run_block_assembly(env, dbname, icmp, kv, shard, cover, snapshots,
         try:
             sst.w.close()
             env.delete_file(sst.path)
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="sst-abort-cleanup", exc=e)
         raise

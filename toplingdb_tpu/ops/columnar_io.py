@@ -30,6 +30,7 @@ from toplingdb_tpu.table.builder import (
 )
 from toplingdb_tpu.table.properties import TableProperties
 from toplingdb_tpu.utils.status import Corruption, NotSupported
+from toplingdb_tpu.utils import errors as _errors
 
 
 # Soft per-native-call output budget for the bulk block builder: bounds the
@@ -1038,13 +1039,13 @@ def write_tables_columnar(env, dbname, new_file_number, icmp, options,
             cur.w.close()
             try:
                 env.delete_file(cur.path)
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="sst-abort-cleanup", exc=e)
         for r in results:
             try:
                 env.delete_file(r[1])
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="sst-abort-cleanup", exc=e)
         raise
     finally:
         if pool is not None:

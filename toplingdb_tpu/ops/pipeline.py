@@ -47,6 +47,7 @@ from toplingdb_tpu import native
 from toplingdb_tpu.db import dbformat
 from toplingdb_tpu.utils import telemetry
 from toplingdb_tpu.utils.status import Corruption, NotSupported
+from toplingdb_tpu.utils import errors as _errors
 
 
 class PipelineIneligible(Exception):
@@ -699,13 +700,14 @@ def _compute_guard(fn, kv, files, splitters, prog, outq, shared, snapshots,
         prog.fail(e)
         try:
             outq.put_nowait(_Err(e))
-        except Exception:
+        except Exception as e2:
             # Queue full: the writer will observe prog.err after draining.
+            _errors.swallow(reason="producer-error-queue-full", exc=e2)
             try:
                 outq.get_nowait()
                 outq.put_nowait(_Err(e))
-            except Exception:
-                pass
+            except Exception as e3:
+                _errors.swallow(reason="producer-error-queue-race", exc=e3)
 
 
 def _drain_join(outq: Queue, threads) -> None:

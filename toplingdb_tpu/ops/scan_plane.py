@@ -51,6 +51,7 @@ from toplingdb_tpu.db import dbformat
 from toplingdb_tpu.db.dbformat import ValueType
 from toplingdb_tpu.table.prefetch import FilePrefetchBuffer
 from toplingdb_tpu.utils import statistics as _stats_mod
+from toplingdb_tpu.utils import errors as _errors
 
 
 class PlaneIneligible(Exception):
@@ -260,7 +261,8 @@ class _MemSource:
         res = None
         try:
             res = mem.export_columnar()
-        except Exception:  # noqa: BLE001 — concurrent mutation: slow path
+        except Exception as e:  # noqa: BLE001 — concurrent mutation: slow path
+            _errors.swallow(reason="memtable-export-race", exc=e)
             res = None
         if res is not None:
             kv, _seqs, _vtypes = res

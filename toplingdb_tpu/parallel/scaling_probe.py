@@ -22,6 +22,7 @@ import os
 import re
 import sys
 import time
+from toplingdb_tpu.utils import errors as _errors
 
 
 def main(argv=None) -> int:
@@ -46,8 +47,8 @@ def main(argv=None) -> int:
 
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="jax-platform-pin", exc=e)
     import numpy as np
     from jax.sharding import Mesh
 

@@ -40,6 +40,7 @@ from toplingdb_tpu.options import Options
 from toplingdb_tpu.replication.log_shipper import WalRetentionGone
 from toplingdb_tpu.utils import statistics as stats_mod
 from toplingdb_tpu.utils.status import Corruption, IOError_
+from toplingdb_tpu.utils import errors as _errors
 
 
 class FollowerDB(SecondaryDB):
@@ -128,8 +129,9 @@ class FollowerDB(SecondaryDB):
             for child in list(self.env.get_children(self.dbname)):
                 try:
                     self.env.delete_file(f"{self.dbname}/{child}")
-                except Exception:
-                    pass  # subdirectories (archive/) stay; files go
+                except Exception as e:
+                    # subdirectories (archive/) stay; files go
+                    _errors.swallow(reason="wipe-db-file-delete", exc=e)
             Checkpoint(ckpt, self.env).restore_to(self.dbname)
             _rm_tree(self.env, ckpt)
             vs = VersionSet(self.env, self.dbname, self.icmp,
@@ -172,8 +174,9 @@ class FollowerDB(SecondaryDB):
             if sync:
                 j.sync()
             j.close()
-        except Exception:
-            pass  # a broken journal close must not block shutdown
+        except Exception as e:
+            # a broken journal close must not block shutdown
+            _errors.swallow(reason="frame-journal-close-on-shutdown", exc=e)
 
     # -- epoch / version swap -------------------------------------------
 
@@ -335,9 +338,10 @@ class FollowerDB(SecondaryDB):
             while not self._tail_stop.is_set():
                 try:
                     self.catch_up()
-                except Exception:
+                except Exception as e:
                     # The loop must survive transient primary restarts /
                     # transport outages; the next round retries.
+                    _errors.swallow(reason="tail-loop-retry", exc=e)
                     self.tail_errors += 1
                 if self._tail_stop.wait(interval):
                     return
@@ -366,8 +370,9 @@ class FollowerDB(SecondaryDB):
         self.stop_tailing()
         try:
             self.catch_up()
-        except Exception:
-            pass  # primary is gone; serve what we have
+        except Exception as e:
+            # primary is gone; serve what we have
+            _errors.swallow(reason="promote-final-catch-up", exc=e)
         path = self.dbname
         self.close()
         return path
@@ -389,7 +394,8 @@ def _rm_tree(env, path: str) -> None:
         for child in env.get_children(path):
             try:
                 env.delete_file(f"{path}/{child}")
-            except Exception:
+            except Exception as e:
+                _errors.swallow(reason="rm-tree-recurse-dir", exc=e)
                 _rm_tree(env, f"{path}/{child}")
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="rm-tree-best-effort", exc=e)

@@ -44,6 +44,7 @@ from toplingdb_tpu.db.write_batch import WriteBatch
 from toplingdb_tpu.utils import coding, crc32c
 from toplingdb_tpu.utils import statistics as stats_mod
 from toplingdb_tpu.utils.status import Corruption, IOError_, NotFound
+from toplingdb_tpu.utils import errors as _errors
 
 FRAME_MAGIC = b"TSHP"
 FRAME_VERSION = 1
@@ -361,7 +362,8 @@ class HttpTransport(ReplicationTransport):
         except urllib.error.HTTPError as e:
             try:
                 payload = json.loads(e.read())
-            except Exception:
+            except Exception as e2:
+                _errors.swallow(reason="http-error-body-parse", exc=e2)
                 payload = {}
             if e.code == 410 or payload.get("error") == "wal_retention_gone":
                 raise WalRetentionGone(payload.get("detail", "")) from e

@@ -26,6 +26,7 @@ from toplingdb_tpu.compaction.resilience import (
     WorkerHealthRegistry,
 )
 from toplingdb_tpu.options import ReadOptions, WriteOptions
+from toplingdb_tpu.utils import errors as _errors
 from toplingdb_tpu.utils import statistics as stats_mod
 
 _DEFAULT_READ = ReadOptions()
@@ -165,7 +166,8 @@ class ReplicaRouter:
         for f, label in self._candidates(token):
             try:
                 v = f.get(key, opts, cf=cf)
-            except Exception:
+            except Exception as e:
+                _errors.swallow(reason="replica-get-failover", exc=e)
                 self.health.record_failure(label)
                 continue
             self.health.record_success(label)
@@ -179,7 +181,8 @@ class ReplicaRouter:
         for f, label in self._candidates(token):
             try:
                 out = f.multi_get(keys, opts, cf=cf)
-            except Exception:
+            except Exception as e:
+                _errors.swallow(reason="replica-multiget-failover", exc=e)
                 self.health.record_failure(label)
                 continue
             self.health.record_success(label)
@@ -196,7 +199,8 @@ class ReplicaRouter:
         for f, label in self._candidates(token):
             try:
                 it = f.new_iterator(opts, cf=cf)
-            except Exception:
+            except Exception as e:
+                _errors.swallow(reason="replica-iter-failover", exc=e)
                 self.health.record_failure(label)
                 continue
             self.health.record_success(label)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 
 from toplingdb_tpu.db import dbformat
+from toplingdb_tpu.utils import errors as _errors
 
 
 @dataclasses.dataclass
@@ -51,14 +52,15 @@ class ShardBalancer:
             hi = (lo or b"") + b"\xff" * 64
         try:
             size = db.get_approximate_sizes([(lo, hi)])[0]
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="shard-size-probe", exc=e)
             size = 0
         try:
             cfs = getattr(db, "_cfs", {})
             size += sum(c.mem.approximate_memory_usage()
                         for c in cfs.values())
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="shard-mem-size-probe", exc=e)
         return size
 
     def pick_split_key(self, name: str) -> bytes | None:
@@ -76,7 +78,8 @@ class ShardBalancer:
                         uk = dbformat.extract_user_key(ik)
                         if shard.contains(uk) and uk != shard.start:
                             candidates.append(uk)
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="split-key-file-scan", exc=e)
             candidates = []
         if len(candidates) < 3:
             it = db.new_iterator()

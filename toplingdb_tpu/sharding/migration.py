@@ -41,6 +41,7 @@ from toplingdb_tpu.utils import statistics as stats_mod
 from toplingdb_tpu.utils import telemetry as _tm
 from toplingdb_tpu.utils.status import Busy, IOError_
 from toplingdb_tpu.utils.sync_point import sync_point
+from toplingdb_tpu.utils import errors as _errors
 
 
 class MigrationAborted(Exception):
@@ -181,18 +182,18 @@ class ShardMigration:
             if fence_t0 is not None:
                 try:
                     router.unfence_shard(self.shard_name, fence_t0)
-                except Exception:
-                    pass
+                except Exception as e2:
+                    _errors.swallow(reason="abort-unfence", exc=e2)
             else:
                 try:
                     router.map.set_state(self.shard_name, "serving")
-                except Exception:
-                    pass
+                except Exception as e2:
+                    _errors.swallow(reason="abort-state-restore", exc=e2)
             if follower is not None:
                 try:
                     follower.close()
-                except Exception:
-                    pass
+                except Exception as e2:
+                    _errors.swallow(reason="abort-follower-close", exc=e2)
             if isinstance(e, (MigrationAborted, Busy)):
                 raise
             raise MigrationAborted(f"migration of {self.shard_name!r} "

@@ -43,6 +43,7 @@ from toplingdb_tpu.replication.router import (
 from toplingdb_tpu.sharding.shard_map import Shard, ShardMap
 from toplingdb_tpu.utils import statistics as stats_mod
 from toplingdb_tpu.utils.status import Busy, InvalidArgument, NotFound
+from toplingdb_tpu.utils import errors as _errors
 
 _DEFAULT_READ = ReadOptions()
 _DEFAULT_WRITE = WriteOptions()
@@ -137,7 +138,8 @@ class ShardServing:
             return "none"
         try:
             return fn()["state"]
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="stall-state-probe", exc=e)
             return "none"
 
     def health(self) -> dict:
@@ -165,8 +167,8 @@ class ShardServing:
             regs = self.replicas.health._breakers
             breakers_open = sum(
                 1 for b in regs.values() if b.state == "open")
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="replica-breaker-probe", exc=e)
         return {
             "health": _slo.health_score(
                 stall_state=self.stall_state(), slo_health=slo_health,
@@ -627,8 +629,8 @@ class ShardRouter:
                 try:
                     row["last_sequence"] = \
                         serving.primary.versions.last_sequence
-                except Exception:
-                    pass
+                except Exception as e:
+                    _errors.swallow(reason="status-last-sequence-probe", exc=e)
             shards.append(row)
         out = {
             "role": "shard-router",
@@ -654,5 +656,5 @@ class ShardRouter:
                 seen.add(id(db))
                 try:
                     db.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    _errors.swallow(reason="shard-close-on-shutdown", exc=e)

@@ -13,6 +13,7 @@ import math
 
 from toplingdb_tpu.utils import coding
 from toplingdb_tpu.utils.crc32c import xxh64
+from toplingdb_tpu.utils import errors as _errors
 
 
 class FilterPolicy:
@@ -74,7 +75,8 @@ class BloomFilterPolicy(FilterPolicy):
                 if not (bits[b >> 3] >> (b & 7)) & 1:
                     return False
             return True
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="bloom-corrupt-fail-open", exc=e)
             return True  # corrupt filter: fail open
 
 
@@ -140,7 +142,8 @@ class BlockedBloomFilterPolicy(FilterPolicy):
                 if not (data[base + (b >> 3)] >> (b & 7)) & 1:
                     return False
             return True
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="blocked-bloom-corrupt-fail-open", exc=e)
             return True  # corrupt filter: fail open
 
 

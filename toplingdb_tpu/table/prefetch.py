@@ -10,6 +10,7 @@ state is a property of the scan, not the file.
 """
 
 from __future__ import annotations
+from toplingdb_tpu.utils import errors as _errors
 
 
 class FilePrefetchBuffer:
@@ -90,7 +91,8 @@ class FilePrefetchBuffer:
                 self._pending = None
                 try:
                     data = tok.wait()
-                except Exception:
+                except Exception as e:
+                    _errors.swallow(reason="prefetch-wait-failed", exc=e)
                     data = b""
                 if data and end <= p_off + len(data):
                     self.hits += 1

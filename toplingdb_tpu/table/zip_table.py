@@ -45,6 +45,7 @@ from toplingdb_tpu.table.filter import filter_policy_from_name
 from toplingdb_tpu.table.properties import TableProperties
 from toplingdb_tpu.utils import coding, crc32c
 from toplingdb_tpu.utils.status import Corruption, NotSupported
+from toplingdb_tpu.utils import errors as _errors
 
 METAINDEX_PARAMS = b"tpulsm.zt.params"
 METAINDEX_KEY_META = b"tpulsm.zt.k.meta"
@@ -862,6 +863,6 @@ def write_tables_zip_columnar(env, dbname, new_file_number, icmp, options,
         for p in written:
             try:
                 env.delete_file(p)
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="sst-abort-cleanup", exc=e)
         raise

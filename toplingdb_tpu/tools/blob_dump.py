@@ -13,6 +13,7 @@ import sys
 
 from toplingdb_tpu.db.blob import MAGIC
 from toplingdb_tpu.utils import coding, crc32c
+from toplingdb_tpu.utils import errors as _errors
 
 
 def dump_blob_file(path: str, show_records: bool = False, limit: int = 0,
@@ -42,7 +43,8 @@ def dump_blob_file(path: str, show_records: bool = False, limit: int = 0,
                 raise ValueError("truncated record")
             stored = crc32c.unmask(coding.decode_fixed32(data, off))
             off += 4
-        except Exception:
+        except Exception as e:
+            _errors.swallow(reason="blob-scan-stop-at-corruption", exc=e)
             corrupt_at = start
             break
         ok = True

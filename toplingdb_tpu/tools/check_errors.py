@@ -1,0 +1,170 @@
+"""Exception-hygiene lint (tier-1 CI): no silent broad excepts.
+
+A broad handler (`except Exception`, `except BaseException`, a tuple
+containing either, or a bare `except:`) can hide a dying background
+loop from every observability plane in the engine. This lint makes the
+swallow policy explicit: every broad handler in `toplingdb_tpu/` must
+do at least one of
+
+  E1. re-raise — any `raise` statement in the handler body;
+  E2. latch the DB background error —
+      `_set_background_error(...)` / `set_background_error(...)`;
+  E3. tick a declared ticker — `record_tick(...)` / `record_ticks(...)`
+      (ticker NAMES are linted separately by check_telemetry);
+  E4. route through the `utils/errors.py` policy helpers —
+      `errors.swallow(reason="...", exc=e)` with a string-literal
+      reason, or `errors.guard(listener=...)`;
+  E5. consume the exception VALUE — `except ... as e` where `e` is read
+      in the handler body (`err = e`, `pg.member_done(e)`,
+      `{"error": repr(e)}`): the failure is being propagated or
+      reported, not silenced. A bound-but-unread `e` does not count.
+
+Handlers satisfying none of these are reported with a `file:line`
+witness. Two supporting rules keep the policy calls honest:
+
+  E6. every `swallow(...)` call carries a string-literal, non-empty
+      `reason=` (a variable reason defeats grep-ability and review);
+  E7. every `guard(...)` call carries a `listener=` argument.
+
+Sites with no fallback work should drop the try/except entirely and use
+`with errors.swallow(reason=...):` — no handler, nothing to annotate.
+
+Run: python -m toplingdb_tpu.tools.check_errors [repo_root]
+Exit 0 clean; 1 with one violation per line otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+BROAD_NAMES = {"Exception", "BaseException"}
+BG_ERROR_FNS = {"_set_background_error", "set_background_error"}
+TICKER_FNS = {"record_tick", "record_ticks"}
+# utils/errors.py implements the policy (its __exit__ IS the swallow).
+EXEMPT_REL = {os.path.join("utils", "errors.py")}
+
+
+def _callee(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _kw(node: ast.Call, name: str) -> ast.expr | None:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return True
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in exprs:
+        if isinstance(e, ast.Name) and e.id in BROAD_NAMES:
+            return True
+        if isinstance(e, ast.Attribute) and e.attr in BROAD_NAMES:
+            return True
+    return False
+
+
+def _annotated(handler: ast.ExceptHandler) -> bool:
+    """True if the handler body satisfies one of E1-E5."""
+    if handler.name:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Name) and node.id == handler.name \
+                    and isinstance(node.ctx, ast.Load):
+                return True  # E5: exception value consumed
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = _callee(node)
+            if name in BG_ERROR_FNS or name in TICKER_FNS:
+                return True
+            if name == "swallow":
+                r = _kw(node, "reason")
+                if isinstance(r, ast.Constant) and isinstance(r.value, str) \
+                        and r.value:
+                    return True
+            if name == "guard" and _kw(node, "listener") is not None:
+                return True
+    return False
+
+
+def check_file(path: str) -> list[str]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+    except (OSError, SyntaxError) as e:
+        return [f"{path}: unparseable: {e}"]
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad(node):
+            if not _annotated(node):
+                out.append(
+                    f"{path}:{node.lineno}: broad except without an error "
+                    f"policy — re-raise, latch the background error, tick "
+                    f"a ticker, or call errors.swallow(reason=..., exc=e) "
+                    f"/ errors.guard(listener=...)")
+        if isinstance(node, ast.Call):
+            name = _callee(node)
+            if name == "swallow" and node.keywords:
+                r = _kw(node, "reason")
+                has_policy_kws = any(
+                    kw.arg in ("reason", "exc", "stats")
+                    for kw in node.keywords)
+                if has_policy_kws and not (
+                        isinstance(r, ast.Constant)
+                        and isinstance(r.value, str) and r.value):
+                    out.append(
+                        f"{path}:{node.lineno}: errors.swallow() needs a "
+                        f"non-empty string-literal reason=")
+            if name == "guard" and any(
+                    kw.arg in ("listener", "stats") for kw in node.keywords):
+                if _kw(node, "listener") is None:
+                    out.append(
+                        f"{path}:{node.lineno}: errors.guard() needs a "
+                        f"listener= argument naming the hook")
+    return out
+
+
+def run(repo_root: str | None = None) -> list[str]:
+    repo_root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pkg = os.path.join(repo_root, "toplingdb_tpu")
+    if not os.path.isdir(pkg):
+        pkg = repo_root  # synthetic trees in tests
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if os.path.relpath(path, pkg) in EXEMPT_REL:
+                continue
+            violations.extend(check_file(path))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else None
+    violations = run(root)
+    for v in violations:
+        print(v)
+    print(f"check_errors: {len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,521 @@
+"""ctypes↔C ABI contract checker (tier-1 CI): the native boundary as data.
+
+A ctypes binding that drifts from its `extern "C"` definition does not
+fail loudly — it reinterprets registers. A missing length argument next
+to a buffer pointer is a heap overwrite waiting for the first oversized
+key. Neither is caught by any Python test that happens not to cross the
+drifted symbol. This checker makes the whole boundary a declared,
+machine-checked contract:
+
+  A1. every `extern "C"` export in native/tpulsm_native.cc has a ctypes
+      binding in native/__init__.py (no unbound export), and every
+      binding names a real definition (no phantom binding);
+  A2. every binding's restype/argtypes match the C signature through the
+      correspondence table below (arity AND per-position type);
+  A3. a forward declaration and its definition must agree exactly;
+  A4. every sanitize-variant artifact (_tpulsm_native.asan.so /
+      .undefined.so) that is up to date with the source exports the
+      IDENTICAL `tpulsm_*` symbol set (a variant must never silently
+      lag the ABI; stale-by-mtime variants are skipped — the loader
+      rebuilds those on demand);
+  A5. every pointer parameter is covered by the buffer-pairing contract
+      in ARCHITECTURE.md §2.10.2 — paired with an integer length/
+      capacity parameter in the same signature, a literal element
+      count, or explicitly exempted (`!`: opaque handle, NUL-terminated
+      string, or internally sized). A stale, missing, or extra table
+      row fails, exactly like the §2.10.1 lock-rank table.
+
+Correspondence (C type → allowed ctypes tokens):
+
+  void           → None (restype only)
+  intN_t/uintN_t → c_intN / c_uintN          size_t → c_size_t
+  const char*    → c_char_p
+  const uint8_t* → c_char_p or POINTER(c_uint8)
+  uint8_t*       → POINTER(c_uint8)          (writable: c_char_p is
+                                              immutable in ctypes)
+  intN_t*        → POINTER(c_intN)           (same for unsigned)
+  void*          → c_void_p                  void** → POINTER(c_void_p)
+  any pointer RETURN additionally allows c_void_p (opaque handles).
+
+`--emit-table` prints a §2.10.2-format table inferred from the source
+(pairing guessed as "the next integer parameter"; `!` otherwise) as a
+starting point for hand-audit — never paste it unreviewed.
+
+Run: python -m toplingdb_tpu.tools.check_native_abi [repo_root]
+Exit 0 clean; 1 with one violation per line otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+# -- correspondence table -------------------------------------------------
+
+_SCALARS = {
+    "int8_t": "c_int8", "uint8_t": "c_uint8",
+    "int32_t": "c_int32", "uint32_t": "c_uint32",
+    "int64_t": "c_int64", "uint64_t": "c_uint64",
+    "size_t": "c_size_t", "int": "c_int32",
+}
+
+_INT_TYPES = set(_SCALARS)  # acceptable length-parameter types
+
+
+def allowed_tokens(ctype: str, is_return: bool) -> set[str] | None:
+    """ctypes tokens allowed for normalized C type `ctype`; None if the
+    type is outside the contract vocabulary."""
+    const = ctype.startswith("const ")
+    base = ctype[6:] if const else ctype
+    stars = len(base) - len(base.rstrip("*"))
+    base = base.rstrip("*").strip()
+    out: set[str] | None = None
+    if stars == 0:
+        if base == "void":
+            out = {"None"} if is_return else None
+        elif base in _SCALARS:
+            out = {_SCALARS[base]}
+    elif stars == 1:
+        if base == "char":
+            out = {"c_char_p"}
+        elif base == "uint8_t":
+            out = {"POINTER(c_uint8)"}
+            if const:
+                out.add("c_char_p")
+        elif base in _SCALARS:
+            out = {f"POINTER({_SCALARS[base]})"}
+        elif base == "void":
+            out = {"c_void_p"}
+    elif stars == 2 and base == "void":
+        out = {"POINTER(c_void_p)"}
+    elif stars == 2 and base in ("uint8_t", "char"):
+        # array of byte-buffer pointers; c_char_p elements are the
+        # idiomatic ctypes spelling when the buffers are const
+        out = {"POINTER(c_void_p)"}
+        if const:
+            out.add("POINTER(c_char_p)")
+    if out is not None and stars > 0 and is_return:
+        out.add("c_void_p")  # opaque handle returns
+    return out
+
+
+def _is_pointer(ctype: str) -> bool:
+    return ctype.rstrip().endswith("*")
+
+
+def _is_int(ctype: str) -> bool:
+    c = ctype[6:] if ctype.startswith("const ") else ctype
+    return c in _INT_TYPES
+
+
+# -- C signature parsing --------------------------------------------------
+
+_SIG_RE = re.compile(
+    r"(?m)^([A-Za-z_][A-Za-z0-9_]*(?:\s*\*+|\s+[A-Za-z_][A-Za-z0-9_]*"
+    r"(?:\s*\*+)?)*)\s+\**(tpulsm_[a-z0-9_]+)\s*\(")
+
+
+def _strip_comments(src: str) -> str:
+    src = re.sub(r"/\*.*?\*/", " ", src, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", src)
+
+
+def _norm_type(toks: str) -> str:
+    """'const uint8_t *' → 'const uint8_t*'; 'const void* const*' →
+    'const void**' (const folded to one leading qualifier, stars glued)."""
+    t = toks.replace("*", " * ").split()
+    stars = t.count("*")
+    words = [w for w in t if w not in ("*", "const")]
+    const = "const " if "const" in t else ""
+    return const + " ".join(words) + "*" * stars
+
+
+def _parse_params(blob: str, sym: str) -> list[tuple[str, str]] | str:
+    blob = blob.strip()
+    if blob in ("", "void"):
+        return []
+    params = []
+    for i, p in enumerate(blob.split(",")):
+        p = p.strip()
+        m = re.match(r"^(.*?)([A-Za-z_][A-Za-z0-9_]*)$", p, re.S)
+        if not m or not m.group(1).strip():
+            return f"{sym}: unparseable parameter {i}: {p!r}"
+        params.append((_norm_type(m.group(1)), m.group(2)))
+    return params
+
+
+def parse_c_signatures(cc_path: str):
+    """-> (signatures {sym: (ret, [(type, name), ...])}, violations)."""
+    with open(cc_path, encoding="utf-8") as f:
+        src = _strip_comments(f.read())
+    sigs: dict[str, tuple[str, list[tuple[str, str]]]] = {}
+    violations: list[str] = []
+    for m in _SIG_RE.finditer(src):
+        ret_raw, sym = m.group(1), m.group(2)
+        stars_after = src[m.end(1):m.start(2)].count("*")
+        close = src.find(")", m.end())  # param lists have no nested parens
+        if close < 0:
+            violations.append(f"{cc_path}: {sym}: unterminated parameters")
+            continue
+        nxt = src[close + 1:close + 80].lstrip()[:1]
+        if nxt not in ("{", ";"):
+            continue  # a call or macro, not a signature
+        if "return" in ret_raw.split():
+            continue
+        ret = _norm_type(ret_raw) + "*" * stars_after
+        params = _parse_params(src[m.end():close], sym)
+        if isinstance(params, str):
+            violations.append(f"{cc_path}: {params}")
+            continue
+        if sym in sigs:
+            if sigs[sym] != (ret, params):
+                violations.append(
+                    f"{cc_path}: {sym}: forward declaration and definition "
+                    f"disagree")
+            continue
+        sigs[sym] = (ret, params)
+    return sigs, violations
+
+
+# -- ctypes binding parsing ----------------------------------------------
+
+
+def _ct_token(node: ast.expr, aliases: dict[str, str]) -> str | None:
+    """AST expr → canonical ctypes token ('c_int32', 'POINTER(c_uint8)',
+    'None'), resolving local aliases; None when unrecognized."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    if isinstance(node, ast.Attribute):  # ctypes.c_int32
+        return node.attr
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    if isinstance(node, ast.Call):
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id if isinstance(node.func, ast.Name) else None
+        if fname == "POINTER" and len(node.args) == 1:
+            inner = _ct_token(node.args[0], aliases)
+            return f"POINTER({inner})" if inner else None
+    return None
+
+
+def parse_ctypes_bindings(init_path: str):
+    """-> (bindings {sym: {'restype': tok, 'argtypes': [tok], 'line': n}},
+    violations). Scans every function in native/__init__.py for
+    `<var>.<sym>.restype/argtypes = ...` with per-function alias
+    resolution (u8p = ctypes.POINTER(ctypes.c_uint8), ...)."""
+    with open(init_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=init_path)
+    bindings: dict[str, dict] = {}
+    violations: list[str] = []
+
+    def scan(body, aliases):
+        for node in body:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+                    continue
+                tgt = sub.targets[0]
+                if isinstance(tgt, ast.Name):
+                    tok = _ct_token(sub.value, aliases)
+                    if tok:
+                        aliases[tgt.id] = tok
+                    continue
+                if not (isinstance(tgt, ast.Attribute)
+                        and tgt.attr in ("restype", "argtypes")
+                        and isinstance(tgt.value, ast.Attribute)
+                        and tgt.value.attr.startswith("tpulsm_")):
+                    continue
+                sym = tgt.value.attr
+                b = bindings.setdefault(
+                    sym, {"restype": None, "argtypes": None,
+                          "line": sub.lineno})
+                if tgt.attr == "restype":
+                    tok = _ct_token(sub.value, aliases)
+                    if tok is None:
+                        violations.append(
+                            f"{init_path}:{sub.lineno}: {sym}: "
+                            f"unrecognized restype expression")
+                    b["restype"] = tok
+                else:
+                    if not isinstance(sub.value, (ast.List, ast.Tuple)):
+                        violations.append(
+                            f"{init_path}:{sub.lineno}: {sym}: argtypes "
+                            f"is not a literal list (static check "
+                            f"impossible)")
+                        continue
+                    toks = []
+                    for el in sub.value.elts:
+                        tok = _ct_token(el, aliases)
+                        if tok is None:
+                            violations.append(
+                                f"{init_path}:{sub.lineno}: {sym}: "
+                                f"unrecognized argtypes element")
+                        toks.append(tok)
+                    b["argtypes"] = toks
+
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            scan(node.body, {})
+    return bindings, violations
+
+
+# -- §2.10.2 contract table ----------------------------------------------
+
+_ROW_RE = re.compile(
+    r"^\|\s*`(tpulsm_[a-z0-9_]+)`\s*\|\s*([^|]+?)\s*\|\s*(\d+)\s*"
+    r"\|\s*([^|]*?)\s*\|\s*$")
+
+
+def parse_contract_table(arch_path: str):
+    """-> (rows {sym: (ret, argc, {ptr: spec})}, violations)."""
+    rows: dict[str, tuple[str, int, dict[str, str]]] = {}
+    violations: list[str] = []
+    try:
+        with open(arch_path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return rows, [f"{arch_path}: unreadable (ABI contract table lives "
+                      f"in §2.10.2)"]
+    sec = text.find("§2.10.2")
+    if sec < 0:
+        sec = text.find("### 2.10.2")
+    if sec < 0:
+        return rows, [f"{arch_path}: no '§2.10.2' section (ABI contract "
+                      f"table missing)"]
+    end = text.find("\n## ", sec)
+    chunk = text[sec:end if end > 0 else len(text)]
+    for off, line in enumerate(chunk.splitlines()):
+        m = _ROW_RE.match(line.strip())
+        if not m:
+            continue
+        sym, ret, argc, buffers = m.groups()
+        specs: dict[str, str] = {}
+        ok = True
+        if buffers.strip() not in ("", "—", "-"):
+            for part in buffers.split(","):
+                part = part.strip().strip("`")
+                if ":" not in part:
+                    violations.append(
+                        f"{arch_path}: §2.10.2 {sym}: malformed buffer "
+                        f"spec {part!r} (want `name:len`, `name:N`, or "
+                        f"`name:!`)")
+                    ok = False
+                    continue
+                pname, spec = part.split(":", 1)
+                specs[pname.strip()] = spec.strip()
+        if ok:
+            rows[sym] = (ret.strip(), int(argc), specs)
+    return rows, violations
+
+
+# -- variant artifact check ----------------------------------------------
+
+
+def _exported_syms(so_path: str) -> set[str] | None:
+    nm = shutil.which("nm")
+    if nm is None:
+        return None
+    try:
+        out = subprocess.run(
+            [nm, "-D", "--defined-only", so_path],
+            capture_output=True, text=True, timeout=30, check=True).stdout
+    except (subprocess.SubprocessError, OSError):
+        return None
+    return {ln.split()[-1] for ln in out.splitlines()
+            if " T " in ln and ln.split()[-1].startswith("tpulsm_")}
+
+
+def check_variants(native_dir: str, source_syms: set[str],
+                   notes: list[str]) -> list[str]:
+    violations = []
+    cc = os.path.join(native_dir, "tpulsm_native.cc")
+    for fn in sorted(os.listdir(native_dir)):
+        if not (fn.startswith("_tpulsm_native") and fn.endswith(".so")):
+            continue
+        so = os.path.join(native_dir, fn)
+        try:
+            if os.path.getmtime(so) < os.path.getmtime(cc):
+                notes.append(f"note: {fn} stale by mtime — skipped "
+                             f"(loader rebuilds on demand)")
+                continue
+        except OSError:
+            continue
+        syms = _exported_syms(so)
+        if syms is None:
+            notes.append(f"note: {fn}: nm unavailable — export set "
+                         f"unchecked")
+            continue
+        missing = source_syms - syms
+        extra = syms - source_syms
+        for s in sorted(missing):
+            violations.append(f"{so}: exports lag the source: {s} missing")
+        for s in sorted(extra):
+            violations.append(f"{so}: exports {s} which has no definition "
+                              f"in tpulsm_native.cc")
+    return violations
+
+
+# -- the checks -----------------------------------------------------------
+
+
+def check_contract(sigs, bindings, rows, cc, init, arch) -> list[str]:
+    violations = []
+    # A1: bidirectional coverage
+    for sym in sorted(set(sigs) - set(bindings)):
+        violations.append(
+            f"{cc}: {sym}: exported but never bound in native/__init__.py "
+            f"(unbound export)")
+    for sym in sorted(set(bindings) - set(sigs)):
+        violations.append(
+            f"{init}:{bindings[sym]['line']}: {sym}: bound but not defined "
+            f"in tpulsm_native.cc (phantom binding)")
+    # A2: per-symbol shape
+    for sym in sorted(set(sigs) & set(bindings)):
+        ret, params = sigs[sym]
+        b = bindings[sym]
+        loc = f"{init}:{b['line']}"
+        if b["restype"] is None or b["argtypes"] is None:
+            violations.append(f"{loc}: {sym}: binding sets "
+                              f"{'argtypes' if b['argtypes'] is None else 'restype'}"
+                              f" but not "
+                              f"{'restype' if b['argtypes'] is None else 'argtypes'}")
+            continue
+        want_ret = allowed_tokens(ret, is_return=True)
+        if want_ret is None:
+            violations.append(f"{cc}: {sym}: return type {ret!r} outside "
+                              f"the contract vocabulary")
+        elif b["restype"] not in want_ret:
+            violations.append(
+                f"{loc}: {sym}: restype {b['restype']} does not match C "
+                f"return {ret!r} (allowed: {', '.join(sorted(want_ret))})")
+        if len(b["argtypes"]) != len(params):
+            violations.append(
+                f"{loc}: {sym}: argtypes has {len(b['argtypes'])} entries, "
+                f"C signature has {len(params)} parameters")
+            continue
+        for i, ((ptype, pname), tok) in enumerate(zip(params,
+                                                      b["argtypes"])):
+            want = allowed_tokens(ptype, is_return=False)
+            if want is None:
+                violations.append(
+                    f"{cc}: {sym}: parameter {pname!r} type {ptype!r} "
+                    f"outside the contract vocabulary")
+            elif tok not in want:
+                violations.append(
+                    f"{loc}: {sym}: argtypes[{i}] ({pname}) is {tok}, C "
+                    f"type {ptype!r} allows "
+                    f"{', '.join(sorted(want))}")
+    # A5: table vs source
+    for sym in sorted(set(sigs) - set(rows)):
+        violations.append(
+            f"{arch}: §2.10.2 missing a row for {sym} (declare its buffer "
+            f"pairing or exempt its pointers)")
+    for sym in sorted(set(rows) - set(sigs)):
+        violations.append(
+            f"{arch}: §2.10.2 row for {sym} names no exported symbol "
+            f"(stale row)")
+    for sym in sorted(set(rows) & set(sigs)):
+        ret, params = sigs[sym]
+        tret, targc, specs = rows[sym]
+        if tret != ret:
+            violations.append(
+                f"{arch}: §2.10.2 {sym}: return {tret!r} but source says "
+                f"{ret!r} (stale row)")
+        if targc != len(params):
+            violations.append(
+                f"{arch}: §2.10.2 {sym}: argc {targc} but source has "
+                f"{len(params)} parameters (stale row)")
+            continue
+        names = {n for _, n in params}
+        ptrs = {n for t, n in params if _is_pointer(t)}
+        ints = {n for t, n in params if _is_int(t)}
+        for p in sorted(ptrs - set(specs)):
+            violations.append(
+                f"{arch}: §2.10.2 {sym}: pointer parameter {p!r} has no "
+                f"buffer-pairing spec (pair it `{p}:lenparam`, size it "
+                f"`{p}:N`, or exempt it `{p}:!`)")
+        for p, spec in specs.items():
+            if p not in names:
+                violations.append(
+                    f"{arch}: §2.10.2 {sym}: spec names unknown parameter "
+                    f"{p!r} (stale row)")
+                continue
+            if p not in ptrs:
+                violations.append(
+                    f"{arch}: §2.10.2 {sym}: {p!r} is not a pointer "
+                    f"parameter (stale row)")
+                continue
+            if spec == "!" or spec.isdigit():
+                continue
+            if spec not in ints:
+                violations.append(
+                    f"{arch}: §2.10.2 {sym}: {p!r} paired with {spec!r} "
+                    f"which is not an integer parameter of {sym}")
+    return violations
+
+
+# -- entry points ---------------------------------------------------------
+
+
+def emit_table(sigs) -> str:
+    lines = ["| symbol | ret | argc | buffers |",
+             "|---|---|---|---|"]
+    for sym in sorted(sigs):
+        ret, params = sigs[sym]
+        specs = []
+        for i, (t, n) in enumerate(params):
+            if not _is_pointer(t):
+                continue
+            nxt = next((n2 for t2, n2 in params[i + 1:] if _is_int(t2)),
+                       None)
+            specs.append(f"`{n}:{nxt}`" if nxt else f"`{n}:!`")
+        lines.append(f"| `{sym}` | {ret} | {len(params)} | "
+                     f"{', '.join(specs) if specs else '—'} |")
+    return "\n".join(lines)
+
+
+def run(repo_root: str | None = None, notes: list[str] | None = None):
+    repo_root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    native = os.path.join(repo_root, "toplingdb_tpu", "native")
+    cc = os.path.join(native, "tpulsm_native.cc")
+    init = os.path.join(native, "__init__.py")
+    arch = os.path.join(repo_root, "ARCHITECTURE.md")
+    notes = notes if notes is not None else []
+    sigs, violations = parse_c_signatures(cc)
+    bindings, v2 = parse_ctypes_bindings(init)
+    rows, v3 = parse_contract_table(arch)
+    violations += v2 + v3
+    violations += check_contract(sigs, bindings, rows, cc, init, arch)
+    violations += check_variants(native, set(sigs), notes)
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if "--emit-table" in argv:
+        argv = [a for a in argv if a != "--emit-table"]
+        root = argv[0] if argv else os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        sigs, violations = parse_c_signatures(
+            os.path.join(root, "toplingdb_tpu", "native",
+                         "tpulsm_native.cc"))
+        for v in violations:
+            print(v, file=sys.stderr)
+        print(emit_table(sigs))
+        return 0
+    notes: list[str] = []
+    violations = run(argv[0] if argv else None, notes)
+    for v in violations:
+        print(v)
+    for n in notes:
+        print(n)
+    print(f"check_native_abi: {len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

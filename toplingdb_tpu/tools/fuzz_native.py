@@ -16,6 +16,10 @@ Targets:
   block    single data-block decode (tpulsm_decode_block vs Python Block)
   scan     whole-SST fused scan (tpulsm_scan_blocks)
   manifest MANIFEST/VersionEdit recovery
+  abi      contract-driven shapes: argument lists are generated from the
+           parsed C signatures + the §2.10.2 buffer-pairing table
+           (tools/check_native_abi), so every parser-surface export is
+           driven with correctly-paired caps and hostile content/indices
 
 Usage: python -m toplingdb_tpu.tools.fuzz_native --target wb --runs 5000
        [--corpus DIR] [--seed N]
@@ -29,6 +33,7 @@ import hashlib
 import os
 import random
 import sys
+from toplingdb_tpu.utils import errors as _errors
 
 
 def _mutate(rng: random.Random, data: bytes, max_ops: int = 4) -> bytes:
@@ -199,7 +204,8 @@ def fuzz_block(rng, runs, corpus: Corpus):
                 bi = BlockIter(data, None)
                 bi.seek_to_first()
                 py_n = sum(1 for _ in bi.entries())
-            except Exception:
+            except Exception as e:
+                _errors.swallow(reason="py-decoder-refused", exc=e)
                 py_n = None
             if py_n is not None and py_n != rc:
                 print(f"FINDING[block]: native decoded {rc}, python {py_n}")
@@ -319,8 +325,143 @@ def fuzz_manifest(rng, runs, corpus: Corpus):
     return findings
 
 
+# -- contract-driven shapes (tools/check_native_abi) ------------------------
+
+# Parser-surface exports: every pointer they take is paired with an
+# explicit length/cap and the C side bounds-checks untrusted indices
+# against them, so contract-shaped hostile inputs are safe to run
+# in-process. Producer-surface exports (builders, memtables) trust their
+# offs/lens arrays by design and are excluded.
+ABI_FUZZ_SYMS = (
+    "tpulsm_crc32c_extend", "tpulsm_xxh64", "tpulsm_wb_protect",
+    "tpulsm_block_seek", "tpulsm_decode_block", "tpulsm_decode_blocks",
+    "tpulsm_inflate_blocks", "tpulsm_scan_blocks",
+    "tpulsm_scan_blocks_refvals",
+)
+
+_BLOB_NAMES = ("data", "block", "file_buf", "rep", "target")
+
+
+def load_abi_contract(repo_root: str | None = None):
+    """Parse the three sources of truth the ABI checker cross-validates
+    (C signatures, ctypes bindings, §2.10.2 table) and return
+    (sigs, bindings, rows). Raises if any of them fails to parse — a
+    fuzz run on a drifted contract would test the wrong shapes."""
+    from toplingdb_tpu.tools import check_native_abi as abi
+
+    root = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    nat = os.path.join(root, "toplingdb_tpu", "native")
+    sigs, v1 = abi.parse_c_signatures(os.path.join(nat, "tpulsm_native.cc"))
+    bindings, v2 = abi.parse_ctypes_bindings(os.path.join(nat, "__init__.py"))
+    rows, v3 = abi.parse_contract_table(os.path.join(root, "ARCHITECTURE.md"))
+    if v1 or v2 or v3:
+        raise RuntimeError("ABI contract failed to parse: "
+                           + "; ".join(v1 + v2 + v3))
+    return sigs, bindings, rows
+
+
+def shapes_from_contract(rng, sym, sigs, bindings, rows, data=b""):
+    """Build one concrete ctypes argument list for `sym` from the parsed
+    contract: the §2.10.2 row says which integer parameter sizes each
+    buffer, the C signature says constness/element width, and the binding
+    token says the exact ctypes value to construct. `data` feeds the
+    primary input blob so the corpus loop drives the parser; index arrays
+    get values straddling the valid range (including negatives) to hit
+    the bounds-check paths. Returns (args, keepalive) or None when the
+    symbol takes opaque handles (`:!`) the fuzzer cannot mint."""
+    import ctypes
+
+    import numpy as np
+
+    _, params = sigs[sym]
+    specs = rows[sym][2]
+    argtoks = bindings[sym]["argtypes"]
+    if "!" in specs.values():
+        return None
+    ptr_ct = {"POINTER(c_uint8)": (np.uint8, ctypes.c_uint8),
+              "POINTER(c_int8)": (np.int8, ctypes.c_int8),
+              "POINTER(c_int32)": (np.int32, ctypes.c_int32),
+              "POINTER(c_uint32)": (np.uint32, ctypes.c_uint32),
+              "POINTER(c_int64)": (np.int64, ctypes.c_int64),
+              "POINTER(c_uint64)": (np.uint64, ctypes.c_uint64)}
+    # Element count for every sizing parameter: the primary blob's length
+    # param carries len(data); other counts stay small so out-buffers are
+    # bounded and count-indexed loops terminate quickly.
+    blob = next((n for _, n in params if n in specs
+                 and n in _BLOB_NAMES), None)
+    sized: dict[str, int] = {}
+    for pname, spec in specs.items():
+        if spec.isdigit():
+            continue
+        sized[spec] = (len(data) if pname == blob
+                       else sized.get(spec, rng.randrange(0, 257)))
+    args, keepalive = [], []
+    for (ctype, pname), tok in zip(params, argtoks):
+        if pname not in specs:  # scalar: a chosen size, or a flag/seed
+            args.append(sized.get(pname, rng.randrange(0, 4)))
+            continue
+        spec = specs[pname]
+        n = int(spec) if spec.isdigit() else sized[spec]
+        if tok == "c_char_p":
+            raw = (data if pname == blob
+                   else rng.randbytes(n))[:n].ljust(n, b"\x00")
+            keepalive.append(raw)
+            args.append(raw)
+            continue
+        dt, ct = ptr_ct[tok]
+        if not ctype.startswith("const"):
+            arr = np.zeros(max(n, 1), dt)  # out-buffer sized to its cap
+        elif dt is np.uint8:
+            raw = (data if pname == blob else rng.randbytes(n))
+            arr = np.frombuffer(raw[:n].ljust(n, b"\x00"), dt).copy()
+        else:
+            # Untrusted index/length array: straddle the valid range.
+            hi = max(len(data), 2)
+            arr = np.array([rng.randrange(-4, 2 * hi)
+                            for _ in range(max(n, 1))], dt)
+        keepalive.append(arr)
+        args.append(ctypes.cast(arr.ctypes.data, ctypes.POINTER(ct)))
+    return args, keepalive
+
+
+def fuzz_abi(rng, runs, corpus: Corpus):
+    from toplingdb_tpu import native
+
+    lib = native.lib()
+    sigs, bindings, rows = load_abi_contract()
+    syms = [s for s in ABI_FUZZ_SYMS
+            if s in sigs and s in bindings and s in rows
+            and hasattr(lib, s)]
+    if not syms:
+        print("fuzz[abi]: no contract symbols available (native lib "
+              "missing?)")
+        return 0
+    seeds = _block_seeds(rng) + [rng.randbytes(256)]
+    findings = 0
+    for it in range(runs):
+        sym = syms[it % len(syms)]
+        data = _mutate(rng, corpus.pick(rng, seeds))
+        shaped = shapes_from_contract(rng, sym, sigs, bindings, rows, data)
+        if shaped is None:
+            continue
+        args, keepalive = shaped
+        rc = getattr(lib, sym)(*args)
+        del keepalive
+        signed = sigs[sym][0] in ("int32_t", "int64_t")
+        if signed and rc < -16:
+            # Error codes are small negative ints; anything below the
+            # contract band means a length/count escaped as a status.
+            print(f"FINDING[abi]: {sym} returned out-of-contract rc {rc}")
+            corpus.maybe_add(data, ("FINDING", it))
+            findings += 1
+        sig = (sym, max(-16, min(int(rc), 8)) if signed else "u")
+        corpus.maybe_add(data, sig)
+    return findings
+
+
 TARGETS = {"wb": fuzz_wb, "block": fuzz_block, "scan": fuzz_scan,
-           "manifest": fuzz_manifest}
+           "manifest": fuzz_manifest, "abi": fuzz_abi}
 
 
 def main(argv=None) -> int:

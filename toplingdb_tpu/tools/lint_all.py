@@ -1,0 +1,82 @@
+"""Unified tier-1 lint driver: every static correctness plane, one exit
+code.
+
+Runs the four repo analyzers over the working tree and aggregates their
+findings:
+
+  telemetry    tools/check_telemetry    span/metric discipline
+  concurrency  tools/check_concurrency  lock-rank order + thread lifecycle
+  native-abi   tools/check_native_abi   ctypes bindings vs C signatures vs
+                                        the §2.10.2 contract table
+  errors       tools/check_errors       broad-except hygiene (every
+                                        swallow is an annotated policy)
+
+Each checker keeps its own exit semantics (0 clean / 1 findings); the
+driver preserves them in the per-checker report and exits nonzero when
+ANY checker found a violation — so CI needs exactly one invocation:
+
+    python -m toplingdb_tpu.tools.lint_all [repo_root]
+
+Per-checker wall time is printed so a checker that regresses past the
+tier-1 budget (tests/test_lint_all.py holds the whole run under 10s) is
+identifiable from the output alone.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from toplingdb_tpu.tools import (
+    check_concurrency,
+    check_errors,
+    check_native_abi,
+    check_telemetry,
+)
+
+# (name, callable(repo_root) -> list[str]). Order is cheap-first so a
+# fast failure surfaces before the heavier whole-tree passes.
+CHECKERS = (
+    ("native-abi", check_native_abi.run),
+    ("telemetry", check_telemetry.run),
+    ("errors", check_errors.run),
+    ("concurrency", check_concurrency.run),
+)
+
+
+def run(repo_root: str | None = None):
+    """-> (all_violations, per_checker {name: (violations, seconds)})."""
+    repo_root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    results: dict[str, tuple[list[str], float]] = {}
+    violations: list[str] = []
+    for name, fn in CHECKERS:
+        t0 = time.monotonic()
+        try:
+            found = list(fn(repo_root))
+        except Exception as e:  # noqa: BLE001 — a crashed checker IS a finding
+            found = [f"lint_all: checker {name!r} crashed: "
+                     f"{type(e).__name__}: {e}"]
+        results[name] = (found, time.monotonic() - t0)
+        violations += found
+    return violations, results
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv and not argv[0].startswith("-") else None
+    violations, results = run(root)
+    for v in violations:
+        print(v)
+    for name, (found, dt) in results.items():
+        rc = 1 if found else 0
+        print(f"lint_all: {name:<12} exit={rc} "
+              f"{len(found):>3} violation(s) in {dt:6.2f}s")
+    total = sum(dt for _, dt in results.values())
+    print(f"lint_all: {len(violations)} violation(s) total in {total:.2f}s")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

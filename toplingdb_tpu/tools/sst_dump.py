@@ -13,6 +13,7 @@ import argparse
 from toplingdb_tpu.db.dbformat import InternalKeyComparator, ValueType, split_internal_key
 from toplingdb_tpu.env import default_env
 from toplingdb_tpu.table.factory import open_table
+from toplingdb_tpu.utils import errors as _errors
 
 _TYPE_NAMES = {
     int(ValueType.VALUE): "PUT",
@@ -42,8 +43,9 @@ def _verify_file_checksum(env, path: str) -> int:
     recorded = None
     try:
         recorded = manifest_file_checksums(dbdir, env).get(num)
-    except Exception:
-        pass  # no CURRENT/MANIFEST next to the file: standalone mode
+    except Exception as e:
+        # no CURRENT/MANIFEST next to the file: standalone mode
+        _errors.swallow(reason="manifest-checksum-lookup", exc=e)
     func = recorded[0] if recorded else "crc32c"
     gen = FileChecksumGenFactory(func or "crc32c").create()
     actual = compute_file_checksum(env, path, gen)

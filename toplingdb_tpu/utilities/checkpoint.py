@@ -18,6 +18,7 @@ from toplingdb_tpu.db.import_column_family_job import (  # noqa: F401
 from toplingdb_tpu.db.log import LogWriter
 from toplingdb_tpu.db.version_edit import VersionEdit
 from toplingdb_tpu.utils.status import InvalidArgument
+from toplingdb_tpu.utils import errors as _errors
 
 
 def create_checkpoint(db, dest: str) -> None:
@@ -30,8 +31,8 @@ def create_checkpoint(db, dest: str) -> None:
                 )
         except InvalidArgument:
             raise
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="checkpoint-dest-probe", exc=e)
     env.create_dir(dest)
     # Pin the file set (reference DisableFileDeletions during checkpoint);
     # the mutex already excludes GC, but the pin also protects any future
@@ -124,8 +125,9 @@ def _checkpoint_locked(db, env, dest: str) -> None:
                 _json.dumps(options_to_config(db.options), indent=1).encode(),
                 sync=True,
             )
-        except Exception:
-            pass  # unregistered custom plugin objects: OPTIONS best-effort
+        except Exception as e:
+            # unregistered custom plugin objects: OPTIONS best-effort
+            _errors.swallow(reason="options-manifest-best-effort", exc=e)
         # CURRENT last — this write is what MAKES dest a checkpoint.
         filename.set_current_file(db.env, dest, manifest_number)
 
@@ -179,8 +181,8 @@ class Checkpoint:
                     )
             except InvalidArgument:
                 raise
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="restore-dest-probe", exc=e)
         env.create_dir(dest)
         children = [c for c in env.get_children(self.path)
                     if c != "CURRENT"]

@@ -22,6 +22,7 @@ from toplingdb_tpu.db.db import DB
 from toplingdb_tpu.options import Options, ReadOptions, WriteOptions
 from toplingdb_tpu.utilities.write_batch_with_index import WriteBatchWithIndex
 from toplingdb_tpu.utils.status import Busy, Expired, InvalidArgument, TryAgain
+from toplingdb_tpu.utils import errors as _errors
 
 NUM_STRIPES = 16
 
@@ -431,8 +432,8 @@ class TransactionDB:
             db.create_column_family(self._TXN_CF)
         try:
             db.env.create_dir(self._txn_dir)
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="txn-dir-create-exists", exc=e)
         try:
             self._recover_prepared()
         except BaseException:
@@ -489,8 +490,8 @@ class TransactionDB:
         self.db.write(batch, txn._wo)
         try:
             self.db.env.delete_file(self._prep_path(txn.name))
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="prepared-journal-cleanup", exc=e)
         self.db.delete(marker, cf=self._txn_cf)
         if txn in self._recovered:
             self._recovered.remove(txn)
@@ -499,8 +500,8 @@ class TransactionDB:
     def _discard_prepared(self, txn) -> None:
         try:
             self.db.env.delete_file(self._prep_path(txn.name))
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="prepared-journal-cleanup", exc=e)
         if txn in self._recovered:
             self._recovered.remove(txn)
         self._release_name(txn.name)
@@ -684,8 +685,8 @@ class TransactionDB:
         self._wp_release_guard(txn)
         try:
             self.db.env.delete_file(self._prep_path(txn.name))
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="prepared-journal-cleanup", exc=e)
         self.db.delete(marker, cf=self._txn_cf)
         if txn in self._recovered:
             self._recovered.remove(txn)
@@ -724,8 +725,8 @@ class TransactionDB:
         self._wp_release_guard(txn)
         try:
             self.db.env.delete_file(self._prep_path(txn.name))
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="prepared-journal-cleanup", exc=e)
         self.db.delete(rb_marker, cf=self._txn_cf)
         if txn in self._recovered:
             self._recovered.remove(txn)
@@ -771,8 +772,8 @@ class TransactionDB:
             # Committed; crash before cleanup. Data is visible already.
             try:
                 self.db.env.delete_file(self._prep_path(name))
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="prepared-journal-cleanup", exc=e)
             self.db.delete(marker, cf=self._txn_cf)
             return
         txn = WritePreparedTransaction(self, WriteOptions())

@@ -39,6 +39,7 @@ t0 = time.time()
 d = jax.devices()
 print("probe: devices ok %.1fs %s" % (time.time() - t0, d), file=sys.stderr)
 import jax.numpy as jnp
+from toplingdb_tpu.utils import errors as _errors
 t0 = time.time()
 x = jnp.ones((256, 256), dtype=jnp.bfloat16)
 (x @ x).block_until_ready()
@@ -104,8 +105,8 @@ def redirect_to_cpu_backend() -> None:
 
         try:
             jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+        except Exception as e:
+            _errors.swallow(reason="jax-platform-pin", exc=e)
 
 
 def ensure_reachable_backend(timeout_s: float = 120.0,
@@ -155,8 +156,8 @@ def retry_redirect(orig_platforms, orig_pool_ips, timeout_s: float,
 
             try:
                 jax.config.update("jax_platforms", orig_platforms or "")
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="jax-platform-restore", exc=e)
         return True
     redirect_to_cpu_backend()
     return False

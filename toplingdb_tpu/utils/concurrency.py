@@ -481,8 +481,10 @@ class ThreadRegistry:
             if stop is not None:
                 try:
                     stop()
-                except Exception:       # noqa: BLE001 — best-effort sweep
-                    pass
+                except Exception as e:  # noqa: BLE001 — best-effort sweep
+                    # Lazy: errors.py imports this module at module level.
+                    from toplingdb_tpu.utils import errors as _errors
+                    _errors.swallow(reason="thread-stop-sweep", exc=e)
         return self.join_all(owner, timeout)
 
     def join_all(self, owner=None, timeout: float = 5.0) -> list[str]:

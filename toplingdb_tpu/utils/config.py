@@ -17,6 +17,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from toplingdb_tpu.utils import concurrency as ccy
+from toplingdb_tpu.utils import errors as _errors
 from toplingdb_tpu.utils.status import InvalidArgument
 
 
@@ -346,8 +347,8 @@ def _prometheus_gauges(name: str, db) -> str:
                 + sum(m.approximate_memory_usage() for m in c.imm)
                 for c in cfs.values()))
             g("immutable_memtables", sum(len(c.imm) for c in cfs.values()))
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-memtable", exc=e)
     try:
         v = db.versions.current
         for lvl in range(v.num_levels):
@@ -357,14 +358,14 @@ def _prometheus_gauges(name: str, db) -> str:
                 g("level_files", len(files), ll)
                 g("level_bytes", sum(f.file_size for f in files), ll)
         g("last_sequence", db.versions.last_sequence)
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-levels", exc=e)
     try:
         ring = getattr(db, "_wal_ring", None)
         if ring is not None:
             g("async_wal_ring_depth", len(ring._q))
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-wal-ring", exc=e)
     try:
         provider = getattr(db, "_repl_status_provider", None)
         if provider is not None:
@@ -373,8 +374,8 @@ def _prometheus_gauges(name: str, db) -> str:
                                                            (int, float)):
                     continue
                 g(f"replication_{k}", val)
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-replication", exc=e)
     try:
         health = getattr(
             getattr(db.options, "compaction_executor_factory", None),
@@ -385,16 +386,16 @@ def _prometheus_gauges(name: str, db) -> str:
                 ul = f'{{db="{name}",url="{url}"}}'
                 g("dcompaction_breaker_state",
                   _BREAKER_STATE_NUM.get(b.state, -1), ul)
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-dcompact-breaker", exc=e)
     try:
         tracer = getattr(db, "tracer", None)
         if tracer is not None:
             st = tracer.status()
             g("trace_ring_retained", st["traces_retained"])
             g("traces_started_total", st["traces_started"])
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-tracer", exc=e)
     try:
         stall_fn = getattr(db, "write_stall_state", None)
         if stall_fn is not None:
@@ -404,8 +405,8 @@ def _prometheus_gauges(name: str, db) -> str:
                   stall.get("state"), -1))
             g("write_stall_l0_files", stall.get("l0_files", 0))
             g("write_stall_micros_total", stall.get("stall_micros", 0))
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-write-stall", exc=e)
     try:
         engine = getattr(db, "slo_engine", None)
         if engine is not None:
@@ -418,8 +419,8 @@ def _prometheus_gauges(name: str, db) -> str:
                 g("slo_burn_rate_fast", row["burn_rate_fast"], sl)
                 g("slo_burn_rate_slow", row["burn_rate_slow"], sl)
                 g("slo_firing", int(row["firing"]), sl)
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-slo", exc=e)
     return "\n".join(lines) + "\n" if lines else ""
 
 
@@ -452,8 +453,8 @@ def _prometheus_cluster_gauges(name: str, router) -> str:
             for k in ("reads", "writes", "write_bytes"):
                 g(f"shard_traffic_{k}", row.get("traffic", {}).get(k, 0),
                   lab)
-    except Exception:
-        pass
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-shard", exc=e)
     return "\n".join(lines) + "\n" if lines else ""
 
 
@@ -594,6 +595,12 @@ class SidePluginRepo:
                                     labels=f'cluster="{name}"'))
                         if repo._fleet:
                             out.append(repo._fleet_gauges())
+                        from toplingdb_tpu.utils import errors as _errs
+
+                        out.append(
+                            "# TYPE tpulsm_bg_error_swallowed_total gauge\n"
+                            "tpulsm_bg_error_swallowed_total "
+                            f"{_errs.swallowed_total()}\n")
                         data = "".join(out).encode()
                         self.send_response(200)
                         self.send_header("Content-Type",
@@ -955,8 +962,8 @@ class SidePluginRepo:
         if provider is not None:
             try:
                 return str(provider().get("role", "primary"))
-            except Exception:
-                pass
+            except Exception as e:
+                _errors.swallow(reason="repl-role-probe", exc=e)
         return ("standalone-readonly"
                 if getattr(db.options, "read_only", False) else "primary")
 
@@ -1043,8 +1050,8 @@ class SidePluginRepo:
                 for db in [*orphan.followers, orphan.primary]:
                     try:
                         db.close()
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        _errors.swallow(reason="merge-retire-close", exc=e)
             return 200, {"ok": True,
                          "merged": cl.map.get(left).to_config()}
         if action == "migrate":

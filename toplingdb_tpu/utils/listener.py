@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import time
+from toplingdb_tpu.utils import errors as _errors
 from dataclasses import dataclass, field
 
 
@@ -137,11 +138,10 @@ class EventListener:
 
 
 def notify(listeners, method: str, *args) -> None:
+    # listener failures must never take down the engine
     for l in listeners or ():
-        try:
+        with _errors.guard(listener=method):
             getattr(l, method)(*args)
-        except Exception:
-            pass  # listener failures must never take down the engine
 
 
 class EventLogger:

@@ -27,6 +27,7 @@ import threading
 from toplingdb_tpu.utils import concurrency as ccy
 
 from toplingdb_tpu.utils import coding, crc32c
+from toplingdb_tpu.utils import errors as _errors
 
 _F_SNAPPY = 0x1
 
@@ -107,7 +108,8 @@ class PersistentCache:
                         break  # torn/corrupt tail: ignore the rest
                     self._index[key] = (num, po, plen, flags)
                     off = po + plen + 4
-                except Exception:
+                except Exception as e:
+                    _errors.swallow(reason="cache-index-scan-stop", exc=e)
                     break
             self._files.append(num)
             self._sizes[num] = off
@@ -147,7 +149,8 @@ class PersistentCache:
 
             try:
                 payload = codecs.snappy_decompress(payload)
-            except Exception:
+            except Exception as e:
+                _errors.swallow(reason="cache-snappy-corrupt", exc=e)
                 return None
         with self._mu:
             self.hits += 1

@@ -27,6 +27,7 @@ import threading
 
 from toplingdb_tpu.utils import concurrency as ccy
 import time
+from toplingdb_tpu.utils import errors as _errors
 from dataclasses import asdict, dataclass, field
 
 from . import statistics as _st
@@ -292,8 +293,9 @@ class SLOEngine:
             while not self._stop_ev.wait(period_sec):
                 try:
                     self.evaluate()
-                except Exception:
-                    pass  # an evaluation bug must not kill the sampler
+                except Exception as e:
+                    # an evaluation bug must not kill the sampler
+                    _errors.swallow(reason="slo-eval-retry", exc=e)
 
         self._thread = ccy.spawn("slo-eval", _run, owner=self,
                                  stop=self.stop)

@@ -175,6 +175,8 @@ SLO_WINDOWS_BREACHED = "slo.windows.breached"      # fast+slow both over
 SLO_ALERTS_FIRED = "slo.alerts.fired"              # firing transitions
 SLO_ALERTS_RESOLVED = "slo.alerts.resolved"        # recovery transitions
 STATS_DUMP_ERRORS = "stats.dump.errors"            # swallowed on_snapshot
+# -- error-policy plane (utils/errors.py) ----------------------------
+BG_ERROR_SWALLOWED = "bg.error.swallowed"          # policy-swallowed excs
 
 # Histogram names (reference Histograms enum families).
 DB_GET_MICROS = "db.get.micros"
@@ -227,6 +229,8 @@ GAUGE_NAMES = frozenset({
     "fleet_members", "fleet_members_unreachable",
     # dcompact worker /metrics
     "dcompact_jobs_done", "dcompact_jobs_failed",
+    # error-policy plane (utils/errors.py, process-wide)
+    "bg_error_swallowed_total",
 })
 
 

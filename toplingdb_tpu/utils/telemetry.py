@@ -36,6 +36,7 @@ import threading
 
 from toplingdb_tpu.utils import concurrency as ccy
 import time
+from toplingdb_tpu.utils import errors as _errors
 from collections import OrderedDict, deque
 
 _tls = threading.local()
@@ -300,7 +301,8 @@ class Tracer:
         for d in spans or ():
             try:
                 sp = Span.from_dict(d)
-            except Exception:
+            except Exception as e:
+                _errors.swallow(reason="span-ack-parse", exc=e)
                 continue
             with self._mu:
                 tr = self._active.get(sp.trace_id) \
