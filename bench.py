@@ -1216,6 +1216,59 @@ def db_path_rows(detail, n_db):
     db.close()
     shutil.rmtree(d, ignore_errors=True)
 
+    # Zip data plane read rows: the same keyspace rebuilt with
+    # bottommost_format="zip" so readrandom probes compressed value
+    # groups (native zip Get — one mini-group inflate per hit, never a
+    # whole-file inflate) and readseq runs the zip scan window
+    # (ZipTableReader.scan_columnar). Block-table twins are the
+    # readrandom_ops_s / readseq_MBps rows above.
+    try:
+        n_z = min(n_db, 200_000)
+        dz = tempfile.mkdtemp(prefix="benchdb_zip_", dir="/dev/shm"
+                              if os.path.isdir("/dev/shm") else None)
+        dbz = DB.open(dz, Options(create_if_missing=True,
+                                  write_buffer_size=8 << 20,
+                                  bottommost_format="zip",
+                                  disable_auto_compactions=True))
+        for i in range(0, n_z, 1000):
+            b = WriteBatch()
+            for j in range(i, min(i + 1000, n_z)):
+                k = (j * 2654435761) % (n_z * 2)
+                b.put(b"%016d" % k, b"value-%016d" % j)
+            dbz.write(b)
+        dbz.flush()
+        dbz.compact_range()  # -> bottommost zip tables
+        rngz = _r.Random(9)
+        pz = [b"%016d" % ((rngz.randrange(n_z) * 2654435761) % (n_z * 2))
+              for _ in range(min(20_000, n_z))]
+        for k in pz[:2000]:
+            dbz.get(k)
+        t0 = time.time()
+        hz = sum(dbz.get(k) is not None for k in pz)
+        detail["readrandom_zip_ops_s"] = round(len(pz) / (time.time() - t0))
+        detail["readrandom_zip_hit_pct"] = round(100 * hz / len(pz), 1)
+
+        def _scan_zip():
+            it = dbz.new_iterator()
+            it.seek_to_first()
+            c = by = 0
+            while it.valid():
+                by += len(it.key()) + len(it.value())
+                c += 1
+                it.next()
+            return c, by
+
+        _scan_zip()  # warm
+        t0 = time.time()
+        c_z, by_z = _scan_zip()
+        dt_z = time.time() - t0
+        detail["readseq_zip_MBps"] = round(by_z / dt_z / 1e6, 2)
+        detail["readseq_zip_entries_s"] = round(c_z / dt_z)
+        dbz.close()
+        shutil.rmtree(dz, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001
+        detail["zip_read_rows_error"] = repr(e)[:120]
+
 
 def main():
     n_entries = int(os.environ.get("BENCH_N", "10000000"))
@@ -1358,18 +1411,38 @@ def main():
                                            max(1, runs - 1), 6000)
             detail["compaction_zstd_out_MBps"] = round(
                 RAW_PER_ENTRY * n_small / dt3 / 1e6, 2)
-        # ZipTable emission (searchable-compression bottommost output;
-        # per-entry build path, so measured at reduced scale).
-        n_zip = max(1, n_small // 5)
+        # ZipTable emission (searchable-compression bottommost output).
+        # The batched native zip plane (tpulsm_zip_* kernels inside the
+        # pipeline's encode stage) builds these at full scale; the serial
+        # twin (TPULSM_ZIP_PLANE=0: per-entry Python ZipTableBuilder)
+        # runs at reduced scale so its cost doesn't dominate the round.
         zbase = tempfile.mkdtemp(prefix="bench_z_", dir="/dev/shm"
                                  if os.path.isdir("/dev/shm") else None)
-        zm = build_inputs(env, zbase, icmp, n_zip, t_none)
+        zm = build_inputs(env, zbase, icmp, n_small, t_none)
         t_zip = dataclasses.replace(t_none, format="zip")
         dt4, _, _, _ = time_compaction(env, zbase, icmp, zm, t_none,
-                                       t_zip, device, 1, 7000)
+                                       t_zip, device, max(1, runs - 1),
+                                       7000)
         detail["compaction_zip_out_MBps"] = round(
-            RAW_PER_ENTRY * n_zip / dt4 / 1e6, 2)
+            RAW_PER_ENTRY * n_small / dt4 / 1e6, 2)
         shutil.rmtree(zbase, ignore_errors=True)
+        n_zs = max(1, n_small // 5)
+        zsbase = tempfile.mkdtemp(prefix="bench_zs_", dir="/dev/shm"
+                                  if os.path.isdir("/dev/shm") else None)
+        zsm = build_inputs(env, zsbase, icmp, n_zs, t_none)
+        saved_zp = os.environ.get("TPULSM_ZIP_PLANE")
+        os.environ["TPULSM_ZIP_PLANE"] = "0"
+        try:
+            dt5, _, _, _ = time_compaction(env, zsbase, icmp, zsm, t_none,
+                                           t_zip, device, 1, 7500)
+            detail["compaction_zip_serial_MBps"] = round(
+                RAW_PER_ENTRY * n_zs / dt5 / 1e6, 2)
+        finally:
+            if saved_zp is None:
+                os.environ.pop("TPULSM_ZIP_PLANE", None)
+            else:
+                os.environ["TPULSM_ZIP_PLANE"] = saved_zp
+        shutil.rmtree(zsbase, ignore_errors=True)
         shutil.rmtree(sbase, ignore_errors=True)
 
         db_path_rows(detail, n_db)
@@ -1545,6 +1618,15 @@ def main():
                 "lock_factory_overhead_pct"),
             "lock_debug_overhead_pct": detail.get(
                 "lock_debug_overhead_pct"),
+            # Searchable-compression zip data plane: batched native zip
+            # emission inside the compaction pipeline (serial twin is
+            # detail.compaction_zip_serial_MBps) and compressed-block
+            # reads without whole-file inflate (block-table twins are
+            # readrandom_ops_s / readseq_MBps).
+            "compaction_zip_out_MBps": detail.get(
+                "compaction_zip_out_MBps"),
+            "readrandom_zip_ops_s": detail.get("readrandom_zip_ops_s"),
+            "readseq_zip_MBps": detail.get("readseq_zip_MBps"),
         }
 
     line = json.dumps(make_record(detail))
@@ -1554,7 +1636,8 @@ def main():
             "phase_breakdown", "compression", "headline_source",
             "variant_rows_source", "readwhilewriting_replica_ops",
             "replica_read_pct", "shard_scaling_x", "sibling_keep_pct",
-            "fillrandom_4shard_ops_s") if k in detail}
+            "fillrandom_4shard_ops_s",
+            "compaction_zip_serial_MBps") if k in detail}
         slim["detail_truncated"] = True
         line = json.dumps(make_record(slim))
     if len(line) > 1800:

@@ -317,6 +317,59 @@ def test_pipeline_complex_groups_fall_back_byte_identical(tmp_path,
     assert after == expect, f"stray files: {sorted(after - expect)}"
 
 
+def test_pipeline_zip_byte_parity(tmp_path, monkeypatch):
+    """Zip-format outputs ride the pipeline: pipelined vs serial zip
+    compaction produce byte-identical SSTs (snapshots + a surviving range
+    tombstone included), and TPULSM_ZIP_PLANE=0 restores the serial
+    fallback gate with the Python builder emitting the same bytes."""
+    from toplingdb_tpu.env import default_env
+    from toplingdb_tpu.table import format as fmt
+    from toplingdb_tpu.table.builder import TableOptions
+    from toplingdb_tpu.utils import codecs
+
+    monkeypatch.setenv("TPULSM_HOST_SORT", "1")
+    _enable_small_pipeline(monkeypatch)
+    calls = _spy_pipeline(monkeypatch)
+
+    env = default_env()
+    dbdir = str(tmp_path)
+    comp = fmt.ZSTD_COMPRESSION if codecs.available("zstd") \
+        else fmt.NO_COMPRESSION
+    topts = TableOptions(block_size=512)
+    zip_topts = TableOptions(format="zip", compression=comp)
+    n = 24_000
+    metas = _build_runs(env, dbdir, n, topts, seed=3, tombstone_file=True)
+    snapshots = [n // 3, 2 * n // 3]
+
+    monkeypatch.setenv("TPULSM_PIPELINE", "0")
+    out_serial, _ = _run_job(env, dbdir, metas, topts, zip_topts, 1000,
+                             snapshots)
+    assert not calls
+    monkeypatch.setenv("TPULSM_PIPELINE", "1")
+    out_pipe, _ = _run_job(env, dbdir, metas, topts, zip_topts, 2000,
+                           snapshots)
+    assert calls, "zip job did not ride the pipeline"
+
+    assert len(out_serial) == len(out_pipe) >= 1
+    for a, b in zip(_sst_bytes(env, dbdir, out_serial),
+                    _sst_bytes(env, dbdir, out_pipe)):
+        assert a == b, "pipelined zip SST bytes differ from serial"
+    for a, b in zip(out_serial, out_pipe):
+        assert (a.smallest, a.largest, a.num_entries) == \
+            (b.smallest, b.largest, b.num_entries)
+
+    # Knob off: the pipeline gate is back AND the pure-Python builder
+    # reproduces the native kernels' bytes (the PR's writer oracle).
+    calls.clear()
+    monkeypatch.setenv("TPULSM_ZIP_PLANE", "0")
+    out_off, _ = _run_job(env, dbdir, metas, topts, zip_topts, 3000,
+                          snapshots)
+    assert not calls, "TPULSM_ZIP_PLANE=0 must gate the pipeline"
+    for a, b in zip(_sst_bytes(env, dbdir, out_serial),
+                    _sst_bytes(env, dbdir, out_off)):
+        assert a == b, "python zip builder bytes differ from native"
+
+
 class _Cancel(BaseException):
     """Out-of-band cancellation (BaseException so no fallback retries)."""
 

@@ -351,6 +351,145 @@ def test_blob_db_parity(tmp_db_path, chunk_env):
         db.close()
 
 
+# -- searchable-compression zip tables on the plane ---------------------
+
+
+@pytest.fixture
+def zip_env():
+    """Restore TPULSM_ZIP_PLANE after each test."""
+    saved = os.environ.get("TPULSM_ZIP_PLANE")
+    yield
+    if saved is None:
+        os.environ.pop("TPULSM_ZIP_PLANE", None)
+    else:
+        os.environ["TPULSM_ZIP_PLANE"] = saved
+
+
+def build_zip_db(path, n=3000):
+    """Multi-level mixed-format DB: zip tables at the bottommost level
+    under block-format L0 files, plus live memtable entries (overwrites
+    and deletions layered on top of the zip level)."""
+    db = DB.open(path, Options(create_if_missing=True,
+                               write_buffer_size=64 * 1024,
+                               bottommost_format="zip",
+                               disable_auto_compactions=True))
+    rng = random.Random(13)
+    for i in range(n):
+        db.put(b"key%06d" % rng.randrange(n), b"zipv%06d" % i)
+    db.flush()
+    db.compact_range()          # bottommost level is now zip tables
+    for i in range(0, n, 5):    # block-format L0 on top
+        db.put(b"key%06d" % i, b"over%06d" % i)
+    for i in range(0, n, 17):
+        db.delete(b"key%06d" % i)
+    db.flush()
+    for i in range(n // 3, n // 3 + n // 10):  # live memtable layer
+        db.put(b"key%06d" % i, b"memv%06d" % i)
+    return db
+
+
+def _assert_zip_bottom(db):
+    from toplingdb_tpu.table.zip_table import ZipTableReader
+
+    files = [f for lvl, f in db.versions.current.all_files() if lvl > 0]
+    assert files, "no bottommost files"
+    assert all(isinstance(db.table_cache.get_reader(f.number),
+                          ZipTableReader) for f in files)
+
+
+def test_zip_plane_readseq_and_seek_parity(tmp_path, chunk_env, zip_env):
+    db = build_zip_db(str(tmp_path / "db"))
+    try:
+        _assert_zip_bottom(db)
+        set_chunk("0")
+        a = scan_all(db)
+        set_chunk("1")
+        it = db.new_iterator()
+        assert it._plane is not None, "zip tables must stay plane-eligible"
+        it.seek_to_first()
+        assert list(it.entries()) == a and len(a) > 1000
+        # small chunks force refills that straddle zip value groups
+        set_chunk("64")
+        assert scan_all(db) == a
+        # seek + resume parity into and across the zip level
+        probes = [k for k, _ in a[:: len(a) // 16]] + [b"", b"zzz"]
+        probes += [k + b"\x00" for k, _ in a[:: len(a) // 7]]
+        set_chunk("64")
+        it1 = db.new_iterator()
+        set_chunk("0")
+        it0 = db.new_iterator()
+        for k in probes:
+            it1.seek(k)
+            it0.seek(k)
+            assert it1.valid() == it0.valid(), k
+            for _ in range(4):
+                if not it0.valid():
+                    break
+                assert (it1.key(), it1.value()) == (it0.key(), it0.value())
+                it0.next()
+                it1.next()
+                assert it1.valid() == it0.valid()
+        # upper bound cutting inside the zip level
+        mid = a[len(a) // 2][0]
+        set_chunk("1")
+        b = scan_all(db, iterate_upper_bound=mid)
+        set_chunk("0")
+        assert b == scan_all(db, iterate_upper_bound=mid)
+    finally:
+        db.close()
+
+
+def test_zip_plane_ticker_parity(tmp_path, chunk_env, zip_env):
+    from toplingdb_tpu.utils import statistics as st
+
+    d = str(tmp_path / "db")
+    db = build_zip_db(d, n=2500)
+    db.close()
+
+    def run(mode):
+        set_chunk(mode)
+        stats = st.Statistics()
+        db = DB.open(d, Options(bottommost_format="zip",
+                                disable_auto_compactions=True,
+                                statistics=stats))
+        try:
+            it = db.new_iterator()
+            it.seek_to_first()
+            n = 0
+            while it.valid():
+                it.key(), it.value()
+                it.next()
+                n += 1
+            it.seek(b"key000100")
+            while it.valid():
+                it.next()
+            g = stats.get_ticker_count
+            return (n, g(st.NUMBER_DB_SEEK), g(st.NUMBER_DB_NEXT),
+                    g(st.NUMBER_DB_SEEK_FOUND), g(st.ITER_BYTES_READ),
+                    g(st.ITER_CHUNK_REFILLS), g(st.ITER_CHUNK_FALLBACKS),
+                    g(st.ZIP_GROUP_DECODES), g(st.ZIP_GROUP_DECODE_BYTES),
+                    g(st.ZIP_PLANE_FALLBACKS))
+        finally:
+            db.close()
+
+    r0 = run("0")
+    r1 = run("1")
+    # op/byte accounting agrees exactly between the two paths
+    assert r0[:5] == r1[:5]
+    assert r1[5] > 0 and r0[5] == 0, "refills only on the chunked path"
+    assert r1[6] == 0, "zip tables must not trigger chunk fallbacks"
+    assert r1[7] > 0 and r1[8] > 0, "zip group decodes must serve the scan"
+    assert r0[7] == 0, "per-entry path never bulk-decodes groups"
+
+    # knob off: identical scan via per-entry fallback, fallback tickers fire
+    os.environ["TPULSM_ZIP_PLANE"] = "0"
+    roff = run("1")
+    assert roff[:5] == r1[:5]
+    assert roff[7] == 0, "no group decodes with the plane off"
+    assert roff[9] > 0, "plane-off zip DB must tick ZIP_PLANE_FALLBACKS"
+    assert roff[6] > 0, "plane-off zip DB degrades via ITER_CHUNK_FALLBACKS"
+
+
 # -- secondary-cache promotion charge (utils/cache.py satellite) --------
 
 

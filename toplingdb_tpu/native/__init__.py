@@ -449,6 +449,53 @@ def lib() -> ctypes.CDLL | None:
             ]
         except AttributeError:
             pass
+        try:
+            # Zip-table data plane: batched builder kernels (bit-identical
+            # to the Python encoders in table/zip_table.py), the columnar
+            # key/value-group decoders, and the zip Get handle.
+            l.tpulsm_zip_newkey.restype = ctypes.c_int64
+            l.tpulsm_zip_newkey.argtypes = [
+                u8p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int32,
+                u8p,
+            ]
+            l.tpulsm_zip_encode_keys.restype = ctypes.c_int64
+            l.tpulsm_zip_encode_keys.argtypes = [
+                u8p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int32,
+                i64p, ctypes.c_int32, ctypes.c_int32, u8p, u8p,
+                ctypes.c_int64, u8p,
+            ]
+            l.tpulsm_zip_encode_values.restype = ctypes.c_int64
+            l.tpulsm_zip_encode_values.argtypes = [
+                u8p, ctypes.c_int64, i64p, i64p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+                u8p, u8p, i64p,
+            ]
+            l.tpulsm_zip_decode_keys.restype = ctypes.c_int64
+            l.tpulsm_zip_decode_keys.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int32, u8p, ctypes.c_int64,
+                u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int64, ctypes.c_int64, u8p, ctypes.c_int64, i64p,
+                i64p, ctypes.c_int64,
+            ]
+            l.tpulsm_zip_group_decode.restype = ctypes.c_int64
+            l.tpulsm_zip_group_decode.argtypes = [
+                u8p, ctypes.c_int64, u8p, ctypes.c_int64, u8p,
+                ctypes.c_int64, u8p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, i64p, u8p, ctypes.c_int64,
+            ]
+            l.tpulsm_zip_table_handle_new.restype = ctypes.c_void_p
+            l.tpulsm_zip_table_handle_new.argtypes = [
+                ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+                u8p, ctypes.c_int64, u8p, ctypes.c_int64, u8p,
+                ctypes.c_int64, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+                u8p, ctypes.c_int64, u8p, ctypes.c_int64, u8p,
+                ctypes.c_int32, u8p, ctypes.c_int32,
+            ]
+        except AttributeError:
+            pass
         _lib = l
         return _lib
 

@@ -1672,6 +1672,15 @@ typedef size_t (*zstd_dec_fn)(void*, size_t, const void*, size_t);
 typedef size_t (*zstd_cmp_fn)(void*, size_t, const void*, size_t, int);
 typedef size_t (*zstd_bound_fn)(size_t);
 typedef unsigned (*zstd_err_fn)(size_t);
+typedef void* (*zstd_ctx_new_fn)();
+typedef size_t (*zstd_ctx_free_fn)(void*);
+typedef size_t (*zstd_cmp_dict_fn)(void*, void*, size_t, const void*, size_t,
+                                   const void*, size_t, int);
+typedef size_t (*zstd_dec_dict_fn)(void*, void*, size_t, const void*, size_t,
+                                   const void*, size_t);
+typedef size_t (*zdict_train_fn)(void*, size_t, const void*, const size_t*,
+                                 unsigned);
+typedef unsigned (*zdict_err_fn)(size_t);
 
 struct Codecs {
   snappy_len_fn snappy_len = nullptr;
@@ -1683,6 +1692,17 @@ struct Codecs {
   zstd_cmp_fn zstd_cmp = nullptr;
   zstd_bound_fn zstd_bound = nullptr;
   zstd_err_fn zstd_err = nullptr;
+  // Dictionary surface for the zip-table kernels. Same libzstd the
+  // Python utils/codecs.py binds: trained dicts and compressed frames
+  // must be bit-identical across the two paths (parity oracle).
+  zstd_ctx_new_fn zstd_cctx_new = nullptr;
+  zstd_ctx_free_fn zstd_cctx_free = nullptr;
+  zstd_cmp_dict_fn zstd_cmp_dict = nullptr;
+  zstd_ctx_new_fn zstd_dctx_new = nullptr;
+  zstd_ctx_free_fn zstd_dctx_free = nullptr;
+  zstd_dec_dict_fn zstd_dec_dict = nullptr;
+  zdict_train_fn zdict_train = nullptr;
+  zdict_err_fn zdict_err = nullptr;
 };
 
 const Codecs& codecs() {
@@ -1707,6 +1727,16 @@ const Codecs& codecs() {
       r.zstd_cmp = (zstd_cmp_fn)dlsym(z, "ZSTD_compress");
       r.zstd_bound = (zstd_bound_fn)dlsym(z, "ZSTD_compressBound");
       r.zstd_err = (zstd_err_fn)dlsym(z, "ZSTD_isError");
+      r.zstd_cctx_new = (zstd_ctx_new_fn)dlsym(z, "ZSTD_createCCtx");
+      r.zstd_cctx_free = (zstd_ctx_free_fn)dlsym(z, "ZSTD_freeCCtx");
+      r.zstd_cmp_dict =
+          (zstd_cmp_dict_fn)dlsym(z, "ZSTD_compress_usingDict");
+      r.zstd_dctx_new = (zstd_ctx_new_fn)dlsym(z, "ZSTD_createDCtx");
+      r.zstd_dctx_free = (zstd_ctx_free_fn)dlsym(z, "ZSTD_freeDCtx");
+      r.zstd_dec_dict =
+          (zstd_dec_dict_fn)dlsym(z, "ZSTD_decompress_usingDict");
+      r.zdict_train = (zdict_train_fn)dlsym(z, "ZDICT_trainFromBuffer");
+      r.zdict_err = (zdict_err_fn)dlsym(z, "ZDICT_isError");
     }
 #endif
     return r;
@@ -3453,10 +3483,60 @@ struct NTable {
   std::vector<uint32_t> idx_koff, idx_klen;
   std::vector<uint64_t> idx_boff, idx_bsize;
   std::string idx_keys;
+  // --- zip-table sections (kind == 1). BORROWED: the Python reader owns
+  // the section buffers and keeps them alive until it frees the handle
+  // (weakref.finalize closure), so no copies of the multi-MB blob. ---
+  int32_t kind = 0;  // 0 = block SST, 1 = zip table
+  int32_t zg = 0, zvg = 0;
+  int64_t zn = 0;
+  int32_t zmeta16 = 0, zlens32 = 0;
+  const uint8_t* zkmeta = nullptr;
+  const uint8_t* zksfx = nullptr;
+  int64_t zksfx_len = 0;
+  const uint8_t* zkgso = nullptr;
+  int64_t zng = 0;  // key groups
+  const uint8_t* zvlens = nullptr;
+  const uint8_t* zvgo = nullptr;  // (znvg + 1) u32 payload offsets
+  const uint8_t* zvflags = nullptr;
+  int64_t zvflags_len = 0;
+  const uint8_t* zvdict = nullptr;
+  int64_t zvdict_len = 0;
+  const uint8_t* zvblob = nullptr;
+  int64_t zvblob_len = 0;
+  int64_t znvg = 0;                   // value groups
+  std::vector<uint64_t> zhead_pre;    // nuk_prefix of each group head
   ~NTable() {
     if (fd >= 0) ::close(fd);
   }
 };
+
+static inline uint32_t zload_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+// (plen, slen) meta pair of zip entry i.
+static inline void zmeta_pair(const NTable* t, int64_t i, uint32_t* pl,
+                              uint32_t* sl) {
+  if (t->zmeta16) {
+    uint16_t a, b;
+    std::memcpy(&a, t->zkmeta + 4 * i, 2);
+    std::memcpy(&b, t->zkmeta + 4 * i + 2, 2);
+    *pl = a;
+    *sl = b;
+  } else {
+    *pl = t->zkmeta[2 * i];
+    *sl = t->zkmeta[2 * i + 1];
+  }
+}
+
+static inline uint64_t zvlen_at(const NTable* t, int64_t i) {
+  if (t->zlens32) return zload_u32(t->zvlens + 4 * i);
+  uint16_t v;
+  std::memcpy(&v, t->zvlens + 2 * i, 2);
+  return v;
+}
 
 // Zero-padded big-endian first-8-bytes of a user key: never orders two
 // keys WRONGLY, only ties (equal prefixes) need a full compare.
@@ -3812,6 +3892,171 @@ std::shared_ptr<std::string> nfetch_block(NTable* t, uint64_t off,
 // rc codes for the probe chain.
 enum { NGET_NOTFOUND = 0, NGET_FOUND = 1, NGET_FALLBACK = 2, NGET_ERR = -1 };
 
+// Get threads are long-lived, so a thread_local DCtx amortizes context
+// setup across probes; the wrapper frees it at thread exit.
+struct ZDctx {
+  void* ctx = nullptr;
+  ~ZDctx() {
+    if (ctx) {
+      const Codecs& c = codecs();
+      if (c.zstd_dctx_free) c.zstd_dctx_free(ctx);
+    }
+  }
+};
+
+// Value bytes of zip entry i. Raw groups are served zero-copy from the
+// borrowed blob; compressed groups decode once into the shared LRU keyed
+// by (table number, group payload offset). false → fall back to Python.
+bool nzvalue(NTable* t, int64_t i, const uint8_t** base, uint64_t* len,
+             std::shared_ptr<std::string>* keep, int64_t* ctr) {
+  int64_t gi = i / t->zvg;
+  uint64_t off = 0;
+  for (int64_t j = gi * (int64_t)t->zvg; j < i; j++) off += zvlen_at(t, j);
+  *len = zvlen_at(t, i);
+  uint64_t p0 = zload_u32(t->zvgo + 4 * gi);
+  uint64_t p1 = zload_u32(t->zvgo + 4 * (gi + 1));
+  if (!((t->zvflags[gi >> 3] >> (gi & 7)) & 1)) {
+    if (off + *len > p1 - p0) return false;
+    *base = t->zvblob + p0 + off;
+    return true;
+  }
+  NBlockCache& cache = nblock_cache();
+  auto hit = cache.lookup(t->number, p0);
+  if (hit) {
+    ctr[NC_CACHE_HIT]++;
+  } else {
+    ctr[NC_CACHE_MISS]++;
+    ctr[NC_READ_BYTES] += (int64_t)(p1 - p0);
+    const Codecs& c = codecs();
+    if (!c.zstd_dec_dict || !c.zstd_dctx_new) return false;
+    static thread_local ZDctx d;
+    if (!d.ctx) d.ctx = c.zstd_dctx_new();
+    if (!d.ctx) return false;
+    uint64_t raw = 0;
+    int64_t gend = (gi + 1) * (int64_t)t->zvg;
+    if (gend > t->zn) gend = t->zn;
+    for (int64_t j = gi * (int64_t)t->zvg; j < gend; j++)
+      raw += zvlen_at(t, j);
+    auto out = std::make_shared<std::string>();
+    out->resize(raw);
+    size_t got = c.zstd_dec_dict(
+        d.ctx, raw ? &(*out)[0] : nullptr, (size_t)raw, t->zvblob + p0,
+        (size_t)(p1 - p0), t->zvdict_len ? t->zvdict : nullptr,
+        (size_t)t->zvdict_len);
+    if ((c.zstd_err && c.zstd_err(got)) || got != raw) return false;
+    cache.insert(t->number, p0, out);
+    hit = std::move(out);
+  }
+  if (off + *len > hit->size()) return false;
+  *base = (const uint8_t*)hit->data() + off;
+  *keep = std::move(hit);
+  return true;
+}
+
+// Sequential cursor over the front-coded zip key stream. The suffix blob
+// is contiguous across group boundaries, so one running offset suffices.
+struct ZCur {
+  NTable* t = nullptr;
+  int64_t i = -1;   // current entry index
+  uint64_t so = 0;  // suffix offset of the NEXT entry
+  uint8_t key[4096 + 16];
+  uint32_t klen = 0;
+
+  // 1 = positioned at group g's head, 0 = empty, -1 = corrupt.
+  int seek_group(int64_t g) {
+    if (g < 0 || g >= t->zng) return -1;
+    so = zload_u32(t->zkgso + 4 * g);
+    i = g * (int64_t)t->zg - 1;
+    klen = 0;
+    return next();
+  }
+
+  // 1 = entry decoded, 0 = end of table, -1 = corrupt.
+  int next() {
+    if (i + 1 >= t->zn) return 0;
+    i++;
+    uint32_t pl, sl;
+    zmeta_pair(t, i, &pl, &sl);
+    if (pl > klen || (uint64_t)pl + sl > sizeof(key)) return -1;
+    if (so + sl > (uint64_t)t->zksfx_len) return -1;
+    std::memcpy(key + pl, t->zksfx + so, sl);
+    so += sl;
+    klen = pl + sl;
+    return klen >= 8 ? 1 : -1;
+  }
+};
+
+// Zip-table probe: bsearch group-head prefixes for the last head <=
+// target, then walk the front-coded stream with the same user-key /
+// seqno dispatch as the block path below.
+int nztable_get(NTable* t, const uint8_t* ukey, int32_t klen,
+                const uint8_t* target, int32_t tlen, uint64_t snap_seq,
+                uint8_t* val_out, int32_t val_cap, int32_t* val_len,
+                int* decided, int64_t* ctr) {
+  if (t->zn <= 0 || t->zng <= 0) return NGET_FALLBACK;
+  uint64_t tp = nuk_prefix(target, tlen - 8);
+  int64_t lo = 0, hi = t->zng;  // first head > target
+  while (lo < hi) {
+    int64_t mid = (lo + hi) >> 1;
+    bool gt;
+    if (t->zhead_pre[(size_t)mid] != tp) {
+      gt = t->zhead_pre[(size_t)mid] > tp;
+    } else {
+      uint32_t pl, sl;
+      zmeta_pair(t, mid * (int64_t)t->zg, &pl, &sl);
+      uint64_t hso = zload_u32(t->zkgso + 4 * mid);
+      gt = ikey_compare(t->zksfx + hso, (int32_t)sl, target, tlen) > 0;
+    }
+    if (gt)
+      hi = mid;
+    else
+      lo = mid + 1;
+  }
+  int64_t g = lo > 0 ? lo - 1 : 0;  // target < first key: walk from start
+  ZCur c;
+  c.t = t;
+  int nr = c.seek_group(g);
+  while (nr == 1) {
+    if (c.klen < 8) return NGET_FALLBACK;
+    int32_t cu = (int32_t)c.klen - 8;
+    int m = cu < klen ? cu : klen;
+    int cmp = std::memcmp(c.key, ukey, (size_t)m);
+    if (cmp == 0 && cu != klen) cmp = cu < klen ? -1 : 1;
+    if (cmp > 0) return NGET_NOTFOUND;  // walked past ukey: absent here
+    if (cmp == 0) {
+      uint64_t p2 = 0;
+      for (int b = 0; b < 8; b++)
+        p2 |= (uint64_t)c.key[cu + b] << (8 * b);
+      uint64_t seq = p2 >> 8;
+      uint8_t vt = (uint8_t)(p2 & 0xFF);
+      if (seq <= snap_seq) {
+        if (vt == 0x1) {  // VALUE
+          *decided = 1;
+          const uint8_t* vb = nullptr;
+          uint64_t vl = 0;
+          std::shared_ptr<std::string> keep;
+          if (!nzvalue(t, c.i, &vb, &vl, &keep, ctr) || vl > 0x7FFFFFFF)
+            return NGET_FALLBACK;
+          if ((int32_t)vl > val_cap) {
+            *val_len = (int32_t)vl;
+            return NGET_ERR;  // caller re-sizes and retries
+          }
+          std::memcpy(val_out, vb, vl);
+          *val_len = (int32_t)vl;
+          return NGET_FOUND;
+        }
+        if (vt == 0x0) {  // DELETION → definitive miss
+          *decided = 1;
+          return NGET_NOTFOUND;
+        }
+        return NGET_FALLBACK;  // MERGE / SINGLE_DELETE / BLOB_INDEX...
+      }
+    }
+    nr = c.next();
+  }
+  return nr < 0 ? NGET_FALLBACK : NGET_NOTFOUND;
+}
+
 // Probe one table for ukey at snap_seq. Decisive answers only; anything
 // needing the Python state machine returns NGET_FALLBACK. NGET_NOTFOUND
 // here means "not in this table — continue the chain".
@@ -3834,6 +4079,10 @@ int ntable_get(NTable* t, const uint8_t* ukey, int32_t klen,
   uint64_t packed = (snap_seq << 8) | 0x7F;
   for (int i = 0; i < 8; i++) target[klen + i] = (uint8_t)(packed >> (8 * i));
   int32_t tlen = klen + 8;
+
+  if (t->kind == 1)
+    return nztable_get(t, ukey, klen, target, tlen, snap_seq, val_out,
+                       val_cap, val_len, decided, ctr);
 
   // Candidate block via the decoded flat index (one cache-friendly
   // binary search) when available; raw-block cursor otherwise.
@@ -4024,6 +4273,101 @@ void* tpulsm_table_handle_new(int32_t fd, uint64_t number, int32_t eligible,
 }
 
 void tpulsm_table_handle_free(void* t) { delete static_cast<NTable*>(t); }
+
+// Zip-table Get handle. Section buffers are BORROWED — the Python reader
+// keeps them alive until tpulsm_table_handle_free. flags: bit0 eligible,
+// bit1 blocked-bloom filter layout. Every section is validated ONCE here
+// (one O(n) pass) so the per-Get walk can trust offsets; any violation
+// demotes the handle to eligible=0 (Python fallback) instead of failing,
+// keeping the version chain intact.
+void* tpulsm_zip_table_handle_new(
+    uint64_t number, int32_t flags, int32_t group, int32_t vgroup,
+    int64_t n, int32_t meta16, int32_t lens32, const uint8_t* kmeta,
+    int64_t kmeta_len, const uint8_t* ksfx, int64_t ksfx_len,
+    const uint8_t* kgso, int64_t kgso_len, const uint8_t* vlens,
+    int64_t vlens_len, const uint8_t* vgo, int64_t vgo_len,
+    const uint8_t* vflags, int64_t vflags_len, const uint8_t* vdict,
+    int64_t vdict_len, const uint8_t* vblob, int64_t vblob_len,
+    const uint8_t* filter, int64_t filter_len, const uint8_t* smallest_uk,
+    int32_t sl, const uint8_t* largest_uk, int32_t ll) {
+  NTable* t = new (std::nothrow) NTable();
+  if (!t) return nullptr;
+  t->kind = 1;
+  t->number = number;
+  t->filter_kind = (flags >> 1) & 1;
+  if (filter_len > 0)
+    t->filter.assign((const char*)filter, (size_t)filter_len);
+  if (sl > 0) t->smallest_uk.assign((const char*)smallest_uk, (size_t)sl);
+  if (ll > 0) t->largest_uk.assign((const char*)largest_uk, (size_t)ll);
+  t->eligible = 0;
+  if (!(flags & 1) || group <= 0 || vgroup <= 0 || n <= 0 || !kmeta ||
+      !ksfx || !kgso || !vlens || !vgo || !vflags || !vblob)
+    return t;
+  int64_t ng = (n + group - 1) / group;
+  int64_t ngv = (n + vgroup - 1) / vgroup;
+  int64_t msz = meta16 ? 4 : 2, lsz = lens32 ? 4 : 2;
+  if (kmeta_len < n * msz || kgso_len < 4 * ng || vlens_len < n * lsz ||
+      vgo_len < 4 * (ngv + 1) || vflags_len < (ngv + 7) / 8)
+    return t;
+  t->zg = group;
+  t->zvg = vgroup;
+  t->zn = n;
+  t->zmeta16 = meta16;
+  t->zlens32 = lens32;
+  t->zkmeta = kmeta;
+  t->zksfx = ksfx;
+  t->zksfx_len = ksfx_len;
+  t->zkgso = kgso;
+  t->zng = ng;
+  t->zvlens = vlens;
+  t->zvgo = vgo;
+  t->zvflags = vflags;
+  t->zvflags_len = vflags_len;
+  t->zvdict = vdict;
+  t->zvdict_len = vdict_len;
+  t->zvblob = vblob;
+  t->zvblob_len = vblob_len;
+  t->znvg = ngv;
+  // Key-section walk: meta pairs must reconstruct, suffix offsets must
+  // agree with the per-group directory and consume the blob exactly.
+  t->zhead_pre.reserve((size_t)ng);
+  uint64_t so = 0;
+  uint32_t prev_klen = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t pl, sl2;
+    zmeta_pair(t, i, &pl, &sl2);
+    uint64_t klen = (uint64_t)pl + sl2;
+    if (i % group == 0) {
+      if (pl != 0 || so != zload_u32(kgso + 4 * (i / group))) return t;
+      if (klen < 8) return t;
+      t->zhead_pre.push_back(nuk_prefix(ksfx + so, (int32_t)klen - 8));
+    }
+    if (pl > prev_klen || klen < 8 || klen > 4096 + 8) return t;
+    if (so + sl2 > (uint64_t)ksfx_len) return t;
+    so += sl2;
+    prev_klen = (uint32_t)klen;
+  }
+  if (so != (uint64_t)ksfx_len) return t;
+  // Value directory: monotone payload offsets covering the blob; raw
+  // groups' payloads must equal the sum of their entry lengths.
+  uint64_t prev_off = zload_u32(vgo);
+  if (prev_off != 0) return t;
+  for (int64_t gi = 0; gi < ngv; gi++) {
+    uint64_t p0 = zload_u32(vgo + 4 * gi);
+    uint64_t p1 = zload_u32(vgo + 4 * (gi + 1));
+    if (p1 < p0 || p1 > (uint64_t)vblob_len) return t;
+    int64_t e1 = (gi + 1) * (int64_t)vgroup;
+    if (e1 > n) e1 = n;
+    uint64_t raw = 0;
+    for (int64_t j = gi * (int64_t)vgroup; j < e1; j++)
+      raw += zvlen_at(t, j);
+    bool flagged = (vflags[gi >> 3] >> (gi & 7)) & 1;
+    if (!flagged && p1 - p0 != raw) return t;
+    if (flagged && (p1 == p0 || (vdict_len > 0 && !vdict))) return t;
+  }
+  t->eligible = 1;
+  return t;
+}
 
 // tables: L0 handles (newest first) then levels 1.. concatenated;
 // level_offs[i]..level_offs[i+1] indexes level i+1's slice, with
@@ -4737,6 +5081,526 @@ int64_t tpulsm_wb_group_commit(void* mem, int32_t mem_kind,
   out[6] = t_framed_ns - t_validated_ns;
   out[7] = gc_now_ns() - t_framed_ns;
   return total;
+}
+
+// ---------------------------------------------------------------------------
+// Zip-table data plane (table/zip_table.py): batched builder kernels that
+// replace the numpy matrix materialization in write_tables_zip_columnar
+// (key gather + front-coding + value group compression were the whole
+// serial cost), and reader kernels that decode front-coded key groups /
+// compressed value groups straight into the scan plane's columnar
+// buffers. The builder kernels must be BIT-IDENTICAL to the Python
+// encoders — same front-coding ties, same ZDICT sampling stride, same
+// per-group "compress only if smaller" decision — because the Python
+// writer is the parity oracle (tests/test_zip_table.py).
+// ---------------------------------------------------------------------------
+
+// newkey[i] = 1 iff the first `uklen` key bytes of row i differ from row
+// i-1 (row 0 always 1): the survivor-boundary vector the zip writer cuts
+// value groups on. offs are per-row byte offsets into key_buf. Returns n,
+// or -3 on out-of-range offsets.
+int64_t tpulsm_zip_newkey(const uint8_t* key_buf, int64_t key_buf_len,
+                          const int64_t* offs, int64_t n, int32_t uklen,
+                          uint8_t* out) {
+  if (n <= 0 || uklen < 0) return -3;
+  for (int64_t i = 0; i < n; i++)
+    if (offs[i] < 0 || offs[i] > key_buf_len - uklen) return -3;
+  out[0] = 1;
+  size_t nthreads = effective_cpus();
+  if (nthreads > 8) nthreads = 8;
+  if (n < (1 << 16)) nthreads = 1;
+  std::atomic<int64_t> next_c{1};
+  const int64_t kChunk = 1 << 15;
+  auto worker = [&] {
+    while (true) {
+      int64_t lo = next_c.fetch_add(kChunk, std::memory_order_relaxed);
+      if (lo >= n) return;
+      int64_t hi = lo + kChunk < n ? lo + kChunk : n;
+      for (int64_t i = lo; i < hi; i++)
+        out[i] = std::memcmp(key_buf + offs[i], key_buf + offs[i - 1],
+                             (size_t)uklen) != 0;
+    }
+  };
+  if (nthreads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    for (size_t i = 1; i < nthreads; i++) {
+      try {
+        pool.emplace_back(worker);
+      } catch (...) {
+        break;
+      }
+    }
+    worker();
+    for (auto& w : pool) w.join();
+  }
+  return n;
+}
+
+// Front-code one zip segment: rows are full internal keys of uniform
+// length `klen` at key_buf[offs[i]], with the 8-byte trailer REPLACED by
+// the little-endian bytes of trailer_ov[i] when >= 0 (the compaction's
+// seqno-zeroing patch, applied on the fly instead of on a materialized
+// matrix). Emits (plen, slen) meta pairs (u16 LE when meta16 else u8),
+// the concatenated suffix stream, and the per-group suffix offsets
+// (u32). Prefix lengths tie byte-for-byte with the numpy argmin over the
+// FULL key including the patched trailer. Returns the suffix length, or
+// -2 sfx_cap too small, -3 invalid shape/offsets.
+int64_t tpulsm_zip_encode_keys(
+    const uint8_t* key_buf, int64_t key_buf_len, const int64_t* offs,
+    int64_t n, int32_t klen, const int64_t* trailer_ov, int32_t group,
+    int32_t meta16, uint8_t* meta_out, uint8_t* sfx_out, int64_t sfx_cap,
+    uint8_t* gso_out) {
+  if (n <= 0 || group <= 0 || klen < 8) return -3;
+  if (meta16 ? klen > 0xFFFF : klen > 0xFF) return -3;
+  for (int64_t i = 0; i < n; i++)
+    if (offs[i] < 0 || offs[i] > key_buf_len - klen) return -3;
+  const int32_t uk = klen - 8;
+  auto tbyte = [&](int64_t i, int32_t j) -> uint8_t {
+    int64_t ov = trailer_ov[i];
+    if (ov >= 0) return (uint8_t)((uint64_t)ov >> (8 * (j - uk)));
+    return key_buf[offs[i] + j];
+  };
+  std::vector<uint32_t> pl(n, 0);
+  size_t nthreads = effective_cpus();
+  if (nthreads > 8) nthreads = 8;
+  if (n < (1 << 14)) nthreads = 1;
+  {
+    std::atomic<int64_t> next_c{0};
+    const int64_t kChunk = 1 << 13;
+    auto worker = [&] {
+      while (true) {
+        int64_t lo = next_c.fetch_add(kChunk, std::memory_order_relaxed);
+        if (lo >= n) return;
+        int64_t hi = lo + kChunk < n ? lo + kChunk : n;
+        for (int64_t i = lo; i < hi; i++) {
+          if (i == 0 || i % group == 0) continue;  // group heads: plen 0
+          const uint8_t* a = key_buf + offs[i - 1];
+          const uint8_t* b = key_buf + offs[i];
+          int32_t p = 0;
+          while (p < uk && a[p] == b[p]) p++;
+          if (p == uk)
+            while (p < klen && tbyte(i - 1, p) == tbyte(i, p)) p++;
+          pl[i] = (uint32_t)p;
+        }
+      }
+    };
+    if (nthreads <= 1) {
+      worker();
+    } else {
+      std::vector<std::thread> pool;
+      for (size_t i = 1; i < nthreads; i++) {
+        try {
+          pool.emplace_back(worker);
+        } catch (...) {
+          break;
+        }
+      }
+      worker();
+      for (auto& w : pool) w.join();
+    }
+  }
+  // Serial: meta pairs, per-row suffix offsets, group directory.
+  std::vector<int64_t> soff(n);
+  int64_t cum = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t p = pl[i], s = (uint32_t)klen - p;
+    if (meta16) {
+      uint16_t a = (uint16_t)p, b = (uint16_t)s;
+      std::memcpy(meta_out + 4 * i, &a, 2);
+      std::memcpy(meta_out + 4 * i + 2, &b, 2);
+    } else {
+      meta_out[2 * i] = (uint8_t)p;
+      meta_out[2 * i + 1] = (uint8_t)s;
+    }
+    soff[i] = cum;
+    if (i % group == 0) {
+      if (cum > 0xFFFFFFFFll) return -3;  // u32 directory would wrap
+      uint32_t v = (uint32_t)cum;
+      std::memcpy(gso_out + 4 * (i / group), &v, 4);
+    }
+    cum += s;
+  }
+  if (cum > sfx_cap) return -2;
+  // Parallel: suffix byte emission.
+  {
+    std::atomic<int64_t> next_c{0};
+    const int64_t kChunk = 1 << 13;
+    auto worker = [&] {
+      while (true) {
+        int64_t lo = next_c.fetch_add(kChunk, std::memory_order_relaxed);
+        if (lo >= n) return;
+        int64_t hi = lo + kChunk < n ? lo + kChunk : n;
+        for (int64_t i = lo; i < hi; i++) {
+          int32_t j = (int32_t)pl[i];
+          uint8_t* dst = sfx_out + soff[i];
+          const uint8_t* src = key_buf + offs[i];
+          if (j < uk) {
+            std::memcpy(dst, src + j, (size_t)(uk - j));
+            dst += uk - j;
+            j = uk;
+          }
+          int64_t ov = trailer_ov[i];
+          if (ov >= 0) {
+            for (; j < klen; j++)
+              *dst++ = (uint8_t)((uint64_t)ov >> (8 * (j - uk)));
+          } else if (j < klen) {
+            std::memcpy(dst, src + j, (size_t)(klen - j));
+          }
+        }
+      }
+    };
+    if (nthreads <= 1) {
+      worker();
+    } else {
+      std::vector<std::thread> pool;
+      for (size_t i = 1; i < nthreads; i++) {
+        try {
+          pool.emplace_back(worker);
+        } catch (...) {
+          break;
+        }
+      }
+      worker();
+      for (auto& w : pool) w.join();
+    }
+  }
+  return cum;
+}
+
+// Value-plane encoder for one zip segment: gathers each VG-entry value
+// group from the columnar value buffer, trains one ZDICT dictionary over
+// every (ngroups//256)-th group (the Python sampling stride), compresses
+// groups >= 32 raw bytes in parallel, and packs payloads ("compress only
+// if strictly smaller" per group, flag bit set) with the u32 offset
+// directory. dict_out must hold max_dict_bytes; flags_out arrives
+// zeroed. out_meta returns [blob_len, dict_len]. Returns the group
+// count, or -1 zstd/ZDICT entry points unavailable (Python fallback),
+// -2 blob_cap/dict_cap too small, -3 invalid offsets or a compressor
+// error.
+int64_t tpulsm_zip_encode_values(
+    const uint8_t* val_buf, int64_t val_buf_len, const int64_t* offs,
+    const int64_t* lens, int64_t n, int32_t vg, int32_t compress,
+    int32_t level, int32_t max_dict_bytes, uint8_t* dict_out,
+    int64_t dict_cap, uint8_t* blob_out, int64_t blob_cap,
+    uint8_t* go_out, uint8_t* flags_out, int64_t* out_meta) {
+  if (n <= 0 || vg <= 0) return -3;
+  const int64_t ng = (n + vg - 1) / vg;
+  std::vector<int64_t> gb(ng + 1, 0);
+  for (int64_t i = 0; i < n; i++) {
+    if (lens[i] < 0 || offs[i] < 0 || lens[i] > val_buf_len ||
+        offs[i] > val_buf_len - lens[i])
+      return -3;
+    gb[i / vg + 1] += lens[i];
+  }
+  for (int64_t g = 0; g < ng; g++) gb[g + 1] += gb[g];
+  auto gather = [&](int64_t g, uint8_t* dst) {
+    int64_t e1 = (g + 1) * (int64_t)vg;
+    if (e1 > n) e1 = n;
+    for (int64_t i = g * (int64_t)vg; i < e1; i++) {
+      std::memcpy(dst, val_buf + offs[i], (size_t)lens[i]);
+      dst += lens[i];
+    }
+  };
+  const Codecs& c = codecs();
+  int64_t dlen = 0;
+  if (compress) {
+    if (!c.zstd_cmp || !c.zstd_bound || !c.zstd_err) return -1;
+    if (max_dict_bytes > 0 && ng >= 8) {
+      if (!c.zdict_train || !c.zdict_err || !c.zstd_cmp_dict ||
+          !c.zstd_cctx_new || !c.zstd_cctx_free)
+        return -1;
+      if (dict_cap < max_dict_bytes) return -2;
+      int64_t stride = ng / 256;
+      if (stride < 1) stride = 1;
+      std::string sblob;
+      std::vector<size_t> sizes;
+      for (int64_t g = 0; g < ng; g += stride) {
+        size_t base = sblob.size();
+        sblob.resize(base + (size_t)(gb[g + 1] - gb[g]));
+        gather(g, (uint8_t*)&sblob[base]);
+        sizes.push_back((size_t)(gb[g + 1] - gb[g]));
+      }
+      size_t r = c.zdict_train(dict_out, (size_t)max_dict_bytes,
+                               sblob.data(), sizes.data(),
+                               (unsigned)sizes.size());
+      // Training failure is NOT an error: the Python path gets b"" and
+      // compresses dictionary-less (utils/codecs.py contract).
+      if (!c.zdict_err(r)) dlen = (int64_t)r;
+    }
+  }
+  std::vector<std::string> zs(ng);  // "" → raw payload
+  if (compress) {
+    size_t nthreads = effective_cpus();
+    if (nthreads > 8) nthreads = 8;
+    if (ng < 4) nthreads = 1;
+    std::atomic<int64_t> nextg{0};
+    std::atomic<int> err{0};
+    auto worker = [&] {
+      void* cctx = nullptr;
+      if (dlen > 0) {
+        cctx = c.zstd_cctx_new();
+        if (!cctx) {
+          err.store(1, std::memory_order_relaxed);
+          return;
+        }
+      }
+      std::vector<uint8_t> raw;
+      while (true) {
+        int64_t g = nextg.fetch_add(1, std::memory_order_relaxed);
+        if (g >= ng || err.load(std::memory_order_relaxed)) break;
+        int64_t rsz = gb[g + 1] - gb[g];
+        if (rsz < 32) continue;  // python skips tiny groups entirely
+        if ((int64_t)raw.size() < rsz) raw.resize((size_t)rsz);
+        gather(g, raw.data());
+        size_t bound = c.zstd_bound((size_t)rsz);
+        std::string z;
+        z.resize(bound);
+        size_t zn = dlen > 0
+                        ? c.zstd_cmp_dict(cctx, &z[0], bound, raw.data(),
+                                          (size_t)rsz, dict_out,
+                                          (size_t)dlen, level)
+                        : c.zstd_cmp(&z[0], bound, raw.data(), (size_t)rsz,
+                                     level);
+        if (c.zstd_err(zn)) {
+          err.store(2, std::memory_order_relaxed);
+          break;
+        }
+        z.resize(zn);
+        zs[g] = std::move(z);
+      }
+      if (cctx) c.zstd_cctx_free(cctx);
+    };
+    if (nthreads <= 1) {
+      worker();
+    } else {
+      std::vector<std::thread> pool;
+      for (size_t i = 1; i < nthreads; i++) {
+        try {
+          pool.emplace_back(worker);
+        } catch (...) {
+          break;
+        }
+      }
+      worker();
+      for (auto& w : pool) w.join();
+    }
+    if (err.load()) return err.load() == 1 ? -1 : -3;
+  }
+  // Serial pack: compressed payload only when strictly smaller.
+  int64_t cum = 0;
+  uint32_t zero = 0;
+  std::memcpy(go_out, &zero, 4);
+  for (int64_t g = 0; g < ng; g++) {
+    int64_t rsz = gb[g + 1] - gb[g];
+    bool use_z = !zs[g].empty() && (int64_t)zs[g].size() < rsz;
+    int64_t psz = use_z ? (int64_t)zs[g].size() : rsz;
+    if (psz > blob_cap - cum) return -2;
+    if (use_z) {
+      std::memcpy(blob_out + cum, zs[g].data(), (size_t)psz);
+      flags_out[g >> 3] |= (uint8_t)(1 << (g & 7));
+    } else {
+      gather(g, blob_out + cum);
+    }
+    cum += psz;
+    if (cum > 0xFFFFFFFFll) return -3;  // u32 directory would wrap
+    uint32_t v = (uint32_t)cum;
+    std::memcpy(go_out + 4 * (g + 1), &v, 4);
+  }
+  out_meta[0] = cum;
+  out_meta[1] = dlen;
+  return ng;
+}
+
+// Reconstruct full internal keys for zip entries [e0, e1) into a
+// columnar slab: key_offs/key_lens are emitted per entry (offsets
+// ABSOLUTE via key_base). The meta/suffix/directory buffers come straight
+// from an on-disk file, so every offset is treated as hostile and
+// bounds-checked before use. Returns bytes written, or -2 key_cap too
+// small, -3 malformed sections/ranges.
+int64_t tpulsm_zip_decode_keys(
+    const uint8_t* kmeta, int64_t kmeta_len, int32_t meta16,
+    const uint8_t* ksfx, int64_t ksfx_len, const uint8_t* kgso,
+    int64_t kgso_len, int64_t n, int32_t group, int64_t e0, int64_t e1,
+    uint8_t* key_out, int64_t key_cap, int64_t* key_offs,
+    int64_t* key_lens, int64_t key_base) {
+  const int64_t kMaxKey = 1 << 17;
+  if (n < 0 || group <= 0 || e0 < 0 || e0 > e1 || e1 > n) return -3;
+  if (e0 == e1) return 0;
+  const int64_t msz = meta16 ? 4 : 2;
+  if (n > kmeta_len / msz) return -3;
+  const int64_t ng = (n + group - 1) / group;
+  if (ng > kgso_len / 4) return -3;
+  auto meta_at = [&](int64_t i, uint32_t* p, uint32_t* s) {
+    if (meta16) {
+      uint16_t a, b;
+      std::memcpy(&a, kmeta + 4 * i, 2);
+      std::memcpy(&b, kmeta + 4 * i + 2, 2);
+      *p = a;
+      *s = b;
+    } else {
+      *p = kmeta[2 * i];
+      *s = kmeta[2 * i + 1];
+    }
+  };
+  const int64_t g0 = e0 / group, g1 = (e1 - 1) / group;
+  // Serial validation + length prefix: the parallel decode below trusts
+  // exactly what this pass proves (front-coding chain, suffix bounds).
+  int64_t cum = 0;
+  for (int64_t g = g0; g <= g1; g++) {
+    uint64_t so = zload_u32(kgso + 4 * g);
+    if (so > (uint64_t)ksfx_len) return -3;
+    uint64_t klen_prev = 0;
+    int64_t jend = (g + 1) * (int64_t)group;
+    if (jend > e1) jend = e1;
+    for (int64_t j = g * (int64_t)group; j < jend; j++) {
+      uint32_t p, s;
+      meta_at(j, &p, &s);
+      if (j % group == 0 && p != 0) return -3;
+      uint64_t klen = (uint64_t)p + s;
+      if (p > klen_prev || klen == 0 || klen > (uint64_t)kMaxKey) return -3;
+      if (s > (uint64_t)ksfx_len - so) return -3;
+      so += s;
+      klen_prev = klen;
+      if (j >= e0) {
+        key_offs[j - e0] = key_base + cum;
+        key_lens[j - e0] = (int64_t)klen;
+        cum += (int64_t)klen;
+      }
+    }
+  }
+  if (cum > key_cap) return -2;
+  size_t nthreads = effective_cpus();
+  if (nthreads > 8) nthreads = 8;
+  if (g1 - g0 < 8) nthreads = 1;
+  std::atomic<int64_t> nextg{g0};
+  auto worker = [&] {
+    std::vector<uint8_t> cur((size_t)kMaxKey);
+    while (true) {
+      int64_t g = nextg.fetch_add(1, std::memory_order_relaxed);
+      if (g > g1) return;
+      uint64_t so = zload_u32(kgso + 4 * g);
+      int64_t jend = (g + 1) * (int64_t)group;
+      if (jend > e1) jend = e1;
+      for (int64_t j = g * (int64_t)group; j < jend; j++) {
+        uint32_t p, s;
+        meta_at(j, &p, &s);
+        std::memcpy(cur.data() + p, ksfx + so, s);
+        so += s;
+        if (j >= e0)
+          std::memcpy(key_out + (key_offs[j - e0] - key_base), cur.data(),
+                      (size_t)(p + s));
+      }
+    }
+  };
+  if (nthreads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    for (size_t i = 1; i < nthreads; i++) {
+      try {
+        pool.emplace_back(worker);
+      } catch (...) {
+        break;
+      }
+    }
+    worker();
+    for (auto& w : pool) w.join();
+  }
+  return cum;
+}
+
+// Bulk-decode zip value groups [g0, g1) into one contiguous raw buffer:
+// raw_offs (g1-g0+1 entries, raw_offs[0] == 0) gives each group's output
+// offset AND expected raw size — the caller derives both from the
+// v.lens section, and a group that inflates to anything else is
+// corruption. Raw (unflagged) groups memcpy straight through. Returns
+// total bytes, or -1 zstd unavailable for a flagged group, -2 out_cap
+// too small, -3 malformed directory/payload.
+int64_t tpulsm_zip_group_decode(
+    const uint8_t* vblob, int64_t vblob_len, const uint8_t* vgo,
+    int64_t vgo_len, const uint8_t* vflags, int64_t vflags_len,
+    const uint8_t* vdict, int64_t vdict_len, int64_t g0, int64_t g1,
+    const int64_t* raw_offs, uint8_t* out, int64_t out_cap) {
+  if (g0 < 0 || g1 < g0) return -3;
+  if (g0 == g1) return 0;
+  if (g1 > vgo_len / 4 - 1) return -3;
+  if (vflags_len < (g1 + 7) / 8) return -3;
+  if (raw_offs[0] != 0) return -3;
+  bool any_z = false;
+  for (int64_t g = g0; g < g1; g++) {
+    int64_t k = g - g0;
+    if (raw_offs[k + 1] < raw_offs[k]) return -3;
+    uint64_t p0 = zload_u32(vgo + 4 * g);
+    uint64_t p1 = zload_u32(vgo + 4 * (g + 1));
+    if (p1 < p0 || p1 > (uint64_t)vblob_len) return -3;
+    bool flagged = (vflags[g >> 3] >> (g & 7)) & 1;
+    if (flagged)
+      any_z = true;
+    else if (p1 - p0 != (uint64_t)(raw_offs[k + 1] - raw_offs[k]))
+      return -3;
+  }
+  if (raw_offs[g1 - g0] > out_cap) return -2;
+  const Codecs& c = codecs();
+  if (any_z && (!c.zstd_dec_dict || !c.zstd_dctx_new || !c.zstd_dctx_free))
+    return -1;
+  if (any_z && vdict_len > 0 && !vdict) return -3;
+  size_t nthreads = effective_cpus();
+  if (nthreads > 8) nthreads = 8;
+  if (g1 - g0 < 4) nthreads = 1;
+  std::atomic<int64_t> nextg{g0};
+  std::atomic<int> err{0};
+  auto worker = [&] {
+    void* dctx = nullptr;
+    while (true) {
+      int64_t g = nextg.fetch_add(1, std::memory_order_relaxed);
+      if (g >= g1 || err.load(std::memory_order_relaxed)) break;
+      int64_t k = g - g0;
+      uint64_t p0 = zload_u32(vgo + 4 * g);
+      uint64_t p1 = zload_u32(vgo + 4 * (g + 1));
+      uint8_t* dst = out + raw_offs[k];
+      size_t rawsz = (size_t)(raw_offs[k + 1] - raw_offs[k]);
+      if (!((vflags[g >> 3] >> (g & 7)) & 1)) {
+        std::memcpy(dst, vblob + p0, rawsz);
+        continue;
+      }
+      if (!dctx) {
+        dctx = c.zstd_dctx_new();
+        if (!dctx) {
+          err.store(1, std::memory_order_relaxed);
+          break;
+        }
+      }
+      size_t got = c.zstd_dec_dict(dctx, dst, rawsz, vblob + p0,
+                                   (size_t)(p1 - p0),
+                                   vdict_len > 0 ? vdict : nullptr,
+                                   (size_t)vdict_len);
+      if ((c.zstd_err && c.zstd_err(got)) || got != rawsz) {
+        err.store(2, std::memory_order_relaxed);
+        break;
+      }
+    }
+    if (dctx) c.zstd_dctx_free(dctx);
+  };
+  if (nthreads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    for (size_t i = 1; i < nthreads; i++) {
+      try {
+        pool.emplace_back(worker);
+      } catch (...) {
+        break;
+      }
+    }
+    worker();
+    for (auto& w : pool) w.join();
+  }
+  int e = err.load();
+  if (e == 1) return -1;
+  if (e) return -3;
+  return raw_offs[g1 - g0];
 }
 
 }  // extern "C"

@@ -755,10 +755,12 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             # bound; the blocking download waits below add the rest).
             stats.transfer_time_usec += int((time.time() - t_up) * 1e6)
             if not any_complex and \
-                    getattr(table_options, "format", "block") == "block":
+                    getattr(table_options, "format", "block") in ("block",
+                                                                  "zip"):
                 # STREAM each shard's survivors straight into the SST
                 # writer — block building overlaps the remaining shards'
-                # compute + download. (The zip writer is whole-array.)
+                # compute + download. (The zip writer drains the feed,
+                # overlapping shard compute with its own encode setup.)
                 streamed = True
             else:
                 # Complex groups must fold BEFORE the writer hoists its

@@ -82,9 +82,17 @@ def pipeline_enabled(table_options=None) -> bool:
         return False
     if os.environ.get("TPULSM_DEVICE_BLOCKS") == "1":
         return False  # on-device block assembly has its own data plane
-    if table_options is not None and \
-            getattr(table_options, "format", "block") != "block":
-        return False  # the zip writer consumes whole arrays
+    if table_options is not None:
+        f = getattr(table_options, "format", "block")
+        if f == "zip":
+            from toplingdb_tpu.table.zip_table import zip_plane_enabled
+
+            # Zip rides the pipeline when the native zip data plane is
+            # on: scan/merge overlap with the drain-then-encode writer
+            # stage (write_tables_zip_columnar collects the chunk feed).
+            return zip_plane_enabled()
+        if f != "block":
+            return False  # other formats consume whole arrays serially
     return True
 
 
@@ -665,9 +673,14 @@ def run_pipelined(env, dbname, icmp, compaction, table_cache, table_options,
             t_resumed = time.time()
             yield item
 
+    writer = write_tables_columnar
+    if getattr(table_options, "format", "block") == "zip":
+        from toplingdb_tpu.table.zip_table import write_tables_zip_columnar
+
+        writer = write_tables_zip_columnar
     t_wr = time.time()
     try:
-        out_files = write_tables_columnar(
+        out_files = writer(
             env, dbname, new_file_number, icmp, table_options, kv,
             chunk_stream(), shared.trailer_override, shared.vtypes,
             shared.seqs, tombs,

@@ -9,8 +9,12 @@ run native and batched. This module gives forward scans the same shape:
   source runs   each SST source decodes a run of entries per native
                 call (`tpulsm_scan_blocks` through a pre-armed
                 FilePrefetchBuffer window, reusing the pipeline's
-                machinery); the memtable contributes its run via the
-                native rep export (`tpulsm_skiplist_export`)
+                machinery; zip tables instead decode a window of entries
+                via `ZipTableReader.scan_columnar` — bulk key
+                front-decode plus `tpulsm_zip_group_decode` over the
+                compressed value groups, no whole-file inflate); the
+                memtable contributes its run via the native rep export
+                (`tpulsm_skiplist_export`)
   merge         ONE `tpulsm_merge_runs` call (native full-sort fallback
                 for >8B user keys) orders the concatenated runs and
                 hands back per-row (seq, type) trailers + new-key marks
@@ -32,8 +36,10 @@ Fallbacks — the plane refuses (construction) or bails mid-stream
 position) for: TPULSM_ITER_CHUNK=0, missing native lib, non-bytewise
 comparators (user timestamps ride on u64ts and are excluded with them),
 merge operators, prefix-mode iteration, WritePrepared excluded ranges,
-backward iteration (seek_to_last/seek_for_prev/prev), non-block or
-dict-compressed files, and codecs the native scanner can't inflate.
+backward iteration (seek_to_last/seek_for_prev/prev), block files that
+are dict-compressed or use codecs the native scanner can't inflate, zip
+files when TPULSM_ZIP_PLANE=0 or the zip decode kernels are absent
+(ticked as ZIP_PLANE_FALLBACKS), and any other table format.
 
 `TPULSM_ITER_CHUNK`: 0 disables, unset/1 = default chunk rows, N>1 =
 chunk rows.
@@ -316,16 +322,30 @@ class _MemSource:
         return 0, 0
 
 
+class _NoPf:
+    """Prefetch-buffer stand-in for zip files: the reader is fully
+    resident (sections mmap'd/loaded at open), so there is nothing to
+    prefetch and the counters stay zero."""
+
+    hits = 0
+    misses = 0
+
+    def reset(self) -> None:
+        pass
+
+
 class _SSTSource:
     """A sorted run of SST files (one L0 file, or one level's disjoint
     file chain). Files open lazily through the table cache (the pinned
     Version keeps them on disk); per fetch, one `tpulsm_scan_blocks`
     call decodes a doubling window of data blocks read through a
-    pre-armed FilePrefetchBuffer."""
+    pre-armed FilePrefetchBuffer. Zip files window in entries instead:
+    `scan_columnar` bulk-decodes value groups natively, so the plane
+    keeps chunk-merge eligibility on searchable-compression levels."""
 
     def __init__(self, files, table_cache, icmp, upper_target,
                  readahead_size: int = 0, prot_bank=None,
-                 protection_bytes: int = 0):
+                 protection_bytes: int = 0, stats=None):
         self._files = files
         self._tc = table_cache
         self._icmp = icmp
@@ -333,11 +353,13 @@ class _SSTSource:
         self._ra = readahead_size
         self._prot_bank = prot_bank
         self._pb = protection_bytes
+        self._stats = stats
         self.pending = _Pending()
         self.exhausted = not files
         self._next_fi = 0
         self._reader = None
         self._pf = None
+        self._zip = False
         self._win = 1
         self._seek_t: bytes | None = None
         # file number -> (reader, offs, lens, seps, pf): repeated seeks
@@ -371,6 +393,7 @@ class _SSTSource:
     def _close_file(self) -> None:
         self._reader = None
         self._pf = None
+        self._zip = False
 
     def _open_next_file(self) -> None:
         self._close_file()
@@ -388,7 +411,19 @@ class _SSTSource:
         memo = self._fmemo.get(meta.number)
         if memo is None:
             reader = self._tc.get_reader(meta.number)
-            if not hasattr(reader, "new_index_iterator") or \
+            if hasattr(reader, "scan_columnar"):
+                # Zip table: served natively through scan_columnar, no
+                # index/prefetch machinery (sections are resident).
+                if not reader.scan_native_ready():
+                    if self._stats is not None:
+                        self._stats.record_tick(
+                            _stats_mod.ZIP_PLANE_FALLBACKS)
+                    raise PlaneIneligible("zip plane disabled/unavailable")
+                memo = (reader, None, None, None, _NoPf())
+                self._fmemo[meta.number] = memo
+                self._open_memo(memo)
+                return
+            elif not hasattr(reader, "new_index_iterator") or \
                     getattr(reader, "_compression_dict", b""):
                 raise PlaneIneligible("non-block or dict-compressed input")
             idx = reader.new_index_iterator()
@@ -415,12 +450,28 @@ class _SSTSource:
                     np.array([h.size for h in handles], dtype=np.int64),
                     seps, pf)
             self._fmemo[meta.number] = memo
+        self._open_memo(memo)
+
+    def _open_memo(self, memo) -> None:
         reader, self._offs, self._lens, seps, pf = memo
         self._reader = reader
-        self._verify = bool(reader.opts.verify_checksums)
         if self._seek_t is not None:
             pf.reset()  # seek: restart the auto-scaling readahead ramp
         self._pf = pf
+        if self._offs is None:
+            # Zip file: windows advance in entries (value-group
+            # multiples); positioning is exact via entry_lower_bound, so
+            # there is no straddling block to include at either end.
+            self._zip = True
+            self._nwin = reader.n
+            bi = (reader.entry_lower_bound(self._seek_t)
+                  if self._seek_t is not None else 0)
+            bstop = (reader.entry_lower_bound(self._upper_t)
+                     if self._upper_t is not None else reader.n)
+            self._bi, self._bstop = bi, max(bi, bstop)
+            return
+        self._verify = bool(reader.opts.verify_checksums)
+        self._nwin = len(self._offs)
         bi = 0
         if self._seek_t is not None:
             lo, hi = 0, len(seps)
@@ -452,14 +503,17 @@ class _SSTSource:
         while not self.exhausted and self.pending.rows() < min_rows:
             if self._reader is None or self._bi >= self._bstop:
                 if self._reader is not None and self._bi >= self._bstop \
-                        and self._bstop < len(self._offs):
+                        and self._bstop < self._nwin:
                     # Upper-bound prune hit inside the file: the rest of
                     # this run is entirely at/beyond the bound.
                     self.exhausted = True
                     return
                 self._open_next_file()
                 continue
-            self._fetch_window(lib)
+            if self._zip:
+                self._fetch_zip_window()
+            else:
+                self._fetch_window(lib)
 
     def _fetch_window(self, lib) -> None:
         b0 = self._bi
@@ -530,6 +584,32 @@ class _SSTSource:
             _bank_rows(self._prot_bank, self._pb, kb, ko, kl, vb, vo, vl,
                        lo, rc)
         self.pending.append(kb, ko[lo:], kl[lo:], vb, vo[lo:], vl[lo:])
+
+    def _fetch_zip_window(self) -> None:
+        """Zip analogue of _fetch_window: one scan_columnar call decodes
+        a doubling window of entries (sized in value groups so each
+        group's zstd inflate amortizes over a full window). No seek trim
+        is needed — _open_memo positioned _bi with entry_lower_bound."""
+        r = self._reader
+        vg = max(1, int(r.VG))
+        e0 = self._bi
+        e1 = min(e0 + self._win * vg, self._bstop)
+        self._win = min(self._win * 2, _MAX_FETCH_BLOCKS)
+        kb, ko, kl, vb, vo, vl = r.scan_columnar(e0, e1)
+        self._bi = e1
+        n = e1 - e0
+        if n <= 0:
+            return
+        self._seek_t = None
+        if self._stats is not None:
+            self._stats.record_tick(
+                _stats_mod.ZIP_GROUP_DECODES, -(-e1 // vg) - e0 // vg)
+            self._stats.record_tick(
+                _stats_mod.ZIP_GROUP_DECODE_BYTES, int(len(vb)))
+        if self._prot_bank is not None:
+            _bank_rows(self._prot_bank, self._pb, kb, ko, kl, vb, vo, vl,
+                       0, n)
+        self.pending.append(kb, ko, kl, vb, vo, vl)
 
     def prefetch_counts(self) -> tuple[int, int]:
         h = m = 0
@@ -877,7 +957,12 @@ def make_scan_plane(mems, l0_files, level_runs, table_cache, icmp,
     # them): reject known-bad formats now instead of bailing later.
     for f in l0_files:
         r = table_cache.get_reader(f.number)
-        if not hasattr(r, "new_index_iterator") or \
+        if hasattr(r, "scan_columnar"):
+            if not r.scan_native_ready():
+                if stats is not None:
+                    stats.record_tick(_stats_mod.ZIP_PLANE_FALLBACKS)
+                return None
+        elif not hasattr(r, "new_index_iterator") or \
                 getattr(r, "_compression_dict", b""):
             return None
     upper_t = None
@@ -891,11 +976,13 @@ def make_scan_plane(mems, l0_files, level_runs, table_cache, icmp,
     for f in l0_files:
         sources.append(_SSTSource([f], table_cache, icmp, upper_t,
                                   readahead_size, prot_bank=bank,
-                                  protection_bytes=protection_bytes))
+                                  protection_bytes=protection_bytes,
+                                  stats=stats))
     for files in level_runs:
         sources.append(_SSTSource(list(files), table_cache, icmp, upper_t,
                                   readahead_size, prot_bank=bank,
-                                  protection_bytes=protection_bytes))
+                                  protection_bytes=protection_bytes,
+                                  stats=stats))
     if not sources:
         return None
     return ScanPlane(sources, icmp, snap_seq, rd, upper, lower,

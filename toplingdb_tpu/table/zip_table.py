@@ -28,6 +28,8 @@ Options.bottommost_format = "zip".
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from toplingdb_tpu.db import dbformat
@@ -67,6 +69,14 @@ _FLAG_META16 = 4  # key meta is u16 pairs (some internal key > 255 bytes)
 GROUP = 16
 # Value mini-group target: ~2KB of raw value bytes per compressed unit.
 VALUE_GROUP_TARGET = 2048
+
+
+def zip_plane_enabled() -> bool:
+    """TPULSM_ZIP_PLANE=0 restores the pure-Python zip paths everywhere:
+    the numpy builder in write_tables_zip_columnar (and with it the
+    pipeline's serial-zip fallback), PlaneIneligible scans, and
+    Python-only Get (no native table handle)."""
+    return os.environ.get("TPULSM_ZIP_PLANE", "1") != "0"
 
 
 class ZipTableBuilder:
@@ -525,8 +535,198 @@ class ZipTableReader:
         return [self.key_at(i)
                 for i in range(0, self.n, step)][:max_anchors]
 
+    # --- batched data-plane surface (native kernels) ---
+
+    def scan_native_ready(self) -> bool:
+        """True when scan_columnar can serve the scan plane (native bulk
+        decoders present and the zip plane not knob-disabled)."""
+        if not (zip_plane_enabled() and self.n):
+            return False
+        from toplingdb_tpu import native
+
+        lib = native.lib()
+        return (
+            lib is not None
+            and getattr(lib, "tpulsm_zip_decode_keys", None) is not None
+            and getattr(lib, "tpulsm_zip_group_decode", None) is not None
+        )
+
+    def _scan_sections(self):
+        """Zero-copy u8 views over the resident sections plus per-entry
+        key-length cumsums — the operands the native kernels take. Built
+        once; the views pin the backing bytes for the handle's lifetime."""
+        s = getattr(self, "_scan_sect", None)
+        if s is None:
+            def u8(b):
+                a = (b.view(np.uint8) if isinstance(b, np.ndarray)
+                     else np.frombuffer(b, dtype=np.uint8))
+                return a if len(a) else np.zeros(1, dtype=np.uint8)
+
+            kl = (self._kmeta[0::2].astype(np.int64)
+                  + self._kmeta[1::2].astype(np.int64))
+            s = {
+                "kmeta": u8(self._kmeta), "ksfx": u8(self._ksfx),
+                "kgso": u8(self._kgso), "vlens": u8(self._vlens),
+                "vgo": u8(self._vgo), "vflags": u8(self._vflags),
+                "vdict": u8(self._vdict), "vblob": u8(self._vblob),
+                "kcum": np.concatenate([[0], np.cumsum(kl)]),
+            }
+            self._scan_sect = s
+        return s
+
+    def entry_lower_bound(self, target: bytes) -> int:
+        """First entry index whose internal key >= target (n past end)."""
+        if not self.n:
+            return 0
+        g = self._group_for(target)
+        base = g * self.G
+        cmp = self._icmp.compare
+        for j, k in enumerate(self.group_keys(g)):
+            if cmp(k, target) >= 0:
+                return base + j
+        return min(base + self.G, self.n)
+
+    def scan_columnar(self, e0: int, e1: int):
+        """Bulk-decode entries [e0, e1) into columnar slabs: (key_buf,
+        key_offs, key_lens, val_buf, val_offs, val_lens), int64 offsets
+        into the two uint8 slabs. Values come straight out of compressed
+        groups via tpulsm_zip_group_decode — no whole-file inflate, no
+        per-entry Python. Callers gate on scan_native_ready()."""
+        from toplingdb_tpu import native
+        from toplingdb_tpu.utils import telemetry as tele
+
+        lib = native.lib()
+        s = self._scan_sections()
+        e0 = max(0, int(e0))
+        e1 = min(self.n, int(e1))
+        cnt = e1 - e0
+        if cnt <= 0:
+            z8 = np.zeros(0, dtype=np.uint8)
+            z64 = np.zeros(0, dtype=np.int64)
+            return z8, z64, z64, z8, z64, z64
+        kcap = int(s["kcum"][e1] - s["kcum"][e0])
+        key_out = np.empty(kcap, dtype=np.uint8)
+        key_offs = np.empty(cnt, dtype=np.int64)
+        key_lens = np.empty(cnt, dtype=np.int64)
+        rc = lib.tpulsm_zip_decode_keys(
+            native.np_u8p(s["kmeta"]), self._kmeta.nbytes,
+            1 if self._kmeta.dtype.itemsize == 2 else 0,
+            native.np_u8p(s["ksfx"]), len(self._ksfx),
+            native.np_u8p(s["kgso"]), self._kgso.nbytes, self.n, self.G,
+            e0, e1, native.np_u8p(key_out), kcap, native.np_i64p(key_offs),
+            native.np_i64p(key_lens), 0)
+        if rc != kcap:
+            raise Corruption(f"zip key decode failed (rc={rc})")
+        g0 = e0 // self.VG
+        g1 = (e1 + self.VG - 1) // self.VG
+        first = g0 * self.VG
+        last = min(g1 * self.VG, self.n)
+        ls = self._vlens[first:last].astype(np.int64)
+        gsz = np.add.reduceat(ls, np.arange(0, len(ls), self.VG))
+        raw_offs = np.ascontiguousarray(
+            np.concatenate([[0], np.cumsum(gsz)]), dtype=np.int64)
+        vcap = int(raw_offs[-1])
+        val_out = np.empty(max(1, vcap), dtype=np.uint8)
+        with tele.span("zip.group_decode", groups=g1 - g0, nbytes=vcap):
+            rc2 = lib.tpulsm_zip_group_decode(
+                native.np_u8p(s["vblob"]), len(self._vblob),
+                native.np_u8p(s["vgo"]), self._vgo.nbytes,
+                native.np_u8p(s["vflags"]), self._vflags.nbytes,
+                native.np_u8p(s["vdict"]), len(self._vdict), g0, g1,
+                native.np_i64p(raw_offs), native.np_u8p(val_out), vcap)
+        if rc2 != vcap:
+            raise Corruption(f"zip group decode failed (rc={rc2})")
+        voff_all = np.cumsum(ls) - ls
+        val_offs = np.ascontiguousarray(voff_all[e0 - first: e1 - first])
+        val_lens = np.ascontiguousarray(ls[e0 - first: e1 - first])
+        return (key_out, key_offs, key_lens, val_out[:vcap], val_offs,
+                val_lens)
+
+    def native_get_handle(self, smallest_uk: bytes, largest_uk: bytes):
+        """Handle for the native point-read engine. Unlike the block
+        reader (which hands C an index copy + fd), the zip sections are
+        BORROWED by C — the finalize closure pins them until
+        tpulsm_table_handle_free runs. Ineligible tables (plane disabled,
+        range tombstones, non-bytewise comparator, empty file) get an
+        eligible=0 handle so the chain walk FALLBACKs on contact, same
+        contract as reader.py."""
+        h = getattr(self, "_nget_handle", False)
+        if h is not False:
+            return h
+        import ctypes
+        import weakref
+
+        from toplingdb_tpu import native
+        from toplingdb_tpu.table.reader import _NGET_ID
+
+        cl = native.lib()
+        if cl is None or not hasattr(cl, "tpulsm_zip_table_handle_new"):
+            self._nget_handle = None
+            return None
+        eligible = (
+            zip_plane_enabled()
+            and self.n > 0
+            and self._range_del_data is None
+            and self._icmp.user_comparator.name()
+            == "tpulsm.BytewiseComparator"
+        )
+        filt = b""
+        filter_kind = 0
+        fname = str(self.properties.filter_policy_name)
+        if (eligible and self._filter_data is not None
+                and self.properties.whole_key_filtering):
+            if fname.startswith("tpulsm.BloomFilter"):
+                filt = self._filter_data
+            elif fname.startswith("tpulsm.BlockedBloom"):
+                filt = self._filter_data
+                filter_kind = 1
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+
+        def buf(b):
+            return ctypes.cast(ctypes.c_char_p(bytes(b)), u8)
+
+        keep = None
+        if eligible:
+            s = self._scan_sections()
+            keep = (s, filt)
+            h = cl.tpulsm_zip_table_handle_new(
+                next(_NGET_ID), 1 | (filter_kind << 1), self.G, self.VG,
+                self.n, 1 if self._kmeta.dtype.itemsize == 2 else 0,
+                1 if self._vlens.dtype.itemsize == 4 else 0,
+                native.np_u8p(s["kmeta"]), self._kmeta.nbytes,
+                native.np_u8p(s["ksfx"]), len(self._ksfx),
+                native.np_u8p(s["kgso"]), self._kgso.nbytes,
+                native.np_u8p(s["vlens"]), self._vlens.nbytes,
+                native.np_u8p(s["vgo"]), self._vgo.nbytes,
+                native.np_u8p(s["vflags"]), self._vflags.nbytes,
+                native.np_u8p(s["vdict"]), len(self._vdict),
+                native.np_u8p(s["vblob"]), len(self._vblob),
+                buf(filt), len(filt),
+                buf(smallest_uk), len(smallest_uk),
+                buf(largest_uk), len(largest_uk),
+            )
+        else:
+            h = cl.tpulsm_zip_table_handle_new(
+                next(_NGET_ID), 0, 0, 0, 0, 0, 0,
+                None, 0, None, 0, None, 0, None, 0, None, 0, None, 0,
+                None, 0, None, 0, None, 0,
+                buf(smallest_uk), len(smallest_uk),
+                buf(largest_uk), len(largest_uk),
+            )
+        h = h or None
+        self._nget_handle = h
+        if h:
+            weakref.finalize(self, _zip_handle_free,
+                             cl.tpulsm_table_handle_free, h, keep)
+        return h
+
     def close(self) -> None:
         pass
+
+
+def _zip_handle_free(free_fn, h, _sections):
+    # _sections pins the buffers C borrowed until the handle dies with it
+    free_fn(h)
 
 
 class ZipTableIterator:
@@ -612,6 +812,60 @@ class ZipTableIterator:
             self.next()
 
 
+def _zip_encode_segment_native(lib, kv, rows, ko_seg, ov_seg, fvl, K, n, vg,
+                               compress, copts, meta16):
+    """One output segment through the tpulsm_zip_* kernels. Returns the
+    encoded sections (kmeta, ksfx, kgso, vlens, vgo, vblob, vflags, zdict,
+    lens32) bit-identical to the numpy encoder below (parity-tested), or
+    None when a kernel declines — the caller then re-encodes in Python."""
+    from toplingdb_tpu import native
+    from toplingdb_tpu.utils import telemetry as tele
+
+    ko_seg = np.ascontiguousarray(ko_seg, dtype=np.int64)
+    ov_seg = np.ascontiguousarray(ov_seg, dtype=np.int64)
+    fvl = np.ascontiguousarray(fvl, dtype=np.int64)
+    meta_out = np.empty(n * (4 if meta16 else 2), dtype=np.uint8)
+    sfx_cap = n * K
+    sfx_out = np.empty(max(1, sfx_cap), dtype=np.uint8)
+    ngk = (n + GROUP - 1) // GROUP
+    gso_out = np.empty(4 * ngk, dtype=np.uint8)
+    with tele.span("zip.index_build", rows=n, groups=ngk):
+        rc = lib.tpulsm_zip_encode_keys(
+            native.np_u8p(kv.key_buf), len(kv.key_buf),
+            native.np_i64p(ko_seg), n, K, native.np_i64p(ov_seg), GROUP,
+            1 if meta16 else 0, native.np_u8p(meta_out),
+            native.np_u8p(sfx_out), sfx_cap, native.np_u8p(gso_out))
+    if rc < 0:
+        return None
+    voffs = np.ascontiguousarray(kv.val_offs[rows], dtype=np.int64)
+    total_v = int(fvl.sum())
+    ngv = (n + vg - 1) // vg
+    mdb = int(getattr(copts, "max_dict_bytes", 0) or 0)
+    lvl = copts.level if copts.level is not None else 3
+    dict_out = np.zeros(max(1, mdb), dtype=np.uint8)
+    blob_out = np.empty(max(1, total_v), dtype=np.uint8)
+    go_out = np.empty(4 * (ngv + 1), dtype=np.uint8)
+    flags_out = np.zeros((ngv + 7) // 8, dtype=np.uint8)
+    om = np.zeros(2, dtype=np.int64)
+    vb = kv.val_buf if len(kv.val_buf) else np.zeros(1, dtype=np.uint8)
+    with tele.span("zip.encode", rows=n, groups=ngv,
+                   compress=1 if compress else 0):
+        rc2 = lib.tpulsm_zip_encode_values(
+            native.np_u8p(vb), len(kv.val_buf), native.np_i64p(voffs),
+            native.np_i64p(fvl), n, vg, 1 if compress else 0, int(lvl),
+            mdb, native.np_u8p(dict_out), len(dict_out),
+            native.np_u8p(blob_out), total_v, native.np_u8p(go_out),
+            native.np_u8p(flags_out), native.np_i64p(om))
+    if rc2 != ngv:
+        return None
+    lens32 = bool((fvl >= 1 << 16).any())
+    vlens = fvl.astype("<u4" if lens32 else "<u2").tobytes()
+    return (meta_out.tobytes(), sfx_out[:rc].tobytes(), gso_out.tobytes(),
+            vlens, go_out.tobytes(), blob_out[: int(om[0])].tobytes(),
+            flags_out.tobytes(), dict_out[: int(om[1])].tobytes(),
+            lens32)
+
+
 def write_tables_zip_columnar(env, dbname, new_file_number, icmp, options,
                               kv, order, trailer_override, vtypes, seqs,
                               tombstones, creation_time: int,
@@ -635,30 +889,64 @@ def write_tables_zip_columnar(env, dbname, new_file_number, icmp, options,
     if getattr(options, "properties_collector_factories", None):
         raise NotSupported("zip columnar writer: collectors use the "
                            "per-entry path")
+    if not isinstance(order, np.ndarray):
+        # Pipelined callers stream order chunks; the zip encoders work on
+        # whole segments, so drain the feed first (the scan/merge stages
+        # upstream still overlap with THIS call's encode work).
+        chunks = [np.asarray(c, dtype=np.int64) for c in order]
+        order = (np.concatenate(chunks) if chunks
+                 else np.empty(0, np.int64))
     order = np.ascontiguousarray(order, dtype=np.int64)
     m = len(order)
     if m == 0 and not tombstones:
         return []
+    lib = native.lib()
+    use_native = (
+        zip_plane_enabled() and lib is not None
+        and getattr(lib, "tpulsm_zip_encode_keys", None) is not None
+    )
+    mat = None
+
+    def _build_mat():
+        # internal-key matrix with trailer overrides applied (Python
+        # encoder path only; the native kernels patch trailers on the fly)
+        nonlocal mat
+        if mat is not None:
+            return mat
+        mat = kv.key_buf[ko[:, None] + np.arange(K)]
+        has_ov = ov >= 0
+        if has_ov.any():
+            tb = (ov[:, None] >> (8 * np.arange(8))) & 0xFF
+            mat[has_ov, K - 8:] = tb[has_ov].astype(np.uint8)
+        return mat
+
     if m:
         if int(kv.key_lens.min()) != int(kv.key_lens.max()):
             raise NotSupported("zip columnar writer requires uniform keys")
         K = int(kv.key_lens[0])
         if K >= 1 << 16:
             raise NotSupported("zip table keys are capped at 64KiB")
-        # internal-key matrix with trailer overrides applied
-        mat = kv.key_buf[
-            kv.key_offs[order].astype(np.int64)[:, None] + np.arange(K)
-        ]
+        ko = kv.key_offs[order].astype(np.int64)
         ov = trailer_override[order]
-        has_ov = ov >= 0
-        if has_ov.any():
-            tb = (ov[:, None] >> (8 * np.arange(8))) & 0xFF
-            mat[has_ov, K - 8:] = tb[has_ov].astype(np.uint8)
         vl = kv.val_lens[order].astype(np.int64)
         cum = np.cumsum(K + vl + 4)  # builder.file_size() approximation
         newkey = np.ones(m, dtype=bool)
         if m > 1:
-            newkey[1:] = (mat[1:, : K - 8] != mat[:-1, : K - 8]).any(axis=1)
+            nk_done = False
+            if use_native:
+                nk8 = np.empty(m, dtype=np.uint8)
+                rc = lib.tpulsm_zip_newkey(
+                    native.np_u8p(kv.key_buf), len(kv.key_buf),
+                    native.np_i64p(ko), m, K - 8, native.np_u8p(nk8))
+                if rc == m:
+                    newkey = nk8.view(bool)
+                    nk_done = True
+                else:
+                    use_native = False
+            if not nk_done:
+                _build_mat()
+                newkey[1:] = (mat[1:, : K - 8]
+                              != mat[:-1, : K - 8]).any(axis=1)
         nk_pos = np.flatnonzero(newkey)
     else:
         K = 0
@@ -680,7 +968,6 @@ def write_tables_zip_columnar(env, dbname, new_file_number, icmp, options,
             cuts.append(s)
     cuts.append(m)
 
-    lib = native.lib()
     results = []
     written = []
     try:
@@ -703,77 +990,111 @@ def write_tables_zip_columnar(env, dbname, new_file_number, icmp, options,
                 whole_key_filtering=1 if options.whole_key_filtering else 0,
             )
             if n:
-                fmat = mat[seg]
                 fvl = vl[seg]
-                # --- keys: front-coded groups of GROUP ---
                 meta16 = K > 255
-                pl = np.zeros(n, dtype=np.int64)
-                if n > 1:
-                    eq = fmat[1:] == fmat[:-1]
-                    all_eq = eq.all(axis=1)
-                    pl[1:] = np.where(all_eq, K, np.argmin(eq, axis=1))
-                pl[np.arange(0, n, GROUP)] = 0
-                slen = K - pl
-                meta = np.empty(2 * n, dtype="<u2" if meta16 else np.uint8)
-                meta[0::2] = pl
-                meta[1::2] = slen
-                sfx = fmat[np.arange(K)[None, :] >= pl[:, None]]
-                soff = np.cumsum(slen) - slen
-                kgso = soff[::GROUP].astype("<u4")
-                # --- values (order-gathered flat bytes, VG groups) ---
                 total_v = int(fvl.sum())
                 props.raw_key_size = n * K
                 props.raw_value_size = total_v
                 avg = total_v // n
                 vg = max(1, min(256, VALUE_GROUP_TARGET // max(1, avg)))
-                if total_v:
-                    vpos = np.repeat(
-                        kv.val_offs[rows].astype(np.int64), fvl
-                    ) + (np.arange(total_v)
-                         - np.repeat(np.cumsum(fvl) - fvl, fvl))
-                    ordered_v = kv.val_buf[vpos]
-                else:
-                    ordered_v = np.zeros(0, dtype=np.uint8)
-                gb = np.concatenate([[0], np.cumsum(np.add.reduceat(
-                    fvl, np.arange(0, n, vg)))]).astype(np.int64) \
-                    if n else np.zeros(1, np.int64)
-                groups = [
-                    ordered_v[gb[i]: gb[i + 1]].tobytes()
-                    for i in range(len(gb) - 1)
-                ]
                 copts = getattr(options, "compression_opts", None) \
                     or CompressionOptions()
                 compress = (options.compression != fmt.NO_COMPRESSION
                             and codecs.available("zstd"))
-                zdict = b""
-                if compress and copts.max_dict_bytes > 0 and len(groups) >= 8:
-                    zdict = codecs.zstd_train_dictionary(
-                        groups[:: max(1, len(groups) // 256)] or groups,
-                        copts.max_dict_bytes,
-                    )
-                blob = bytearray()
-                go = [0]
-                vflags = bytearray((len(groups) + 7) // 8)
-                if compress:
-                    from concurrent.futures import ThreadPoolExecutor
-
-                    lvl = copts.level if copts.level is not None else 3
-                    with ThreadPoolExecutor(8) as ex:
-                        zs = list(ex.map(
-                            lambda raw: codecs.zstd_compress(raw, lvl, zdict)
-                            if len(raw) >= 32 else None, groups))
+                enc = None
+                if use_native:
+                    enc = _zip_encode_segment_native(
+                        lib, kv, rows, ko[seg], ov[seg], fvl, K, n, vg,
+                        compress, copts, meta16)
+                if enc is not None:
+                    (kmeta, ksfx, kgso_b, vlens, vgo, vblob, vflags_b,
+                     zdict, lens32) = enc
+                    smallest = kv.key_buf[
+                        int(ko[lo]): int(ko[lo]) + K].tobytes()
+                    largest = kv.key_buf[
+                        int(ko[hi - 1]): int(ko[hi - 1]) + K].tobytes()
+                    t0, tn = int(ov[lo]), int(ov[hi - 1])
+                    if t0 >= 0:
+                        smallest = (smallest[: K - 8]
+                                    + t0.to_bytes(8, "little"))
+                    if tn >= 0:
+                        largest = (largest[: K - 8]
+                                   + tn.to_bytes(8, "little"))
                 else:
-                    zs = [None] * len(groups)
-                for gi, raw in enumerate(groups):
-                    payload = raw
-                    z = zs[gi]
-                    if z is not None and len(z) < len(raw):
-                        payload = z
-                        vflags[gi // 8] |= 1 << (gi % 8)
-                    blob += payload
-                    go.append(len(blob))
-                lens32 = bool((fvl >= 1 << 16).any())
-                vlens = fvl.astype("<u4" if lens32 else "<u2").tobytes()
+                    fmat = _build_mat()[seg]
+                    # --- keys: front-coded groups of GROUP ---
+                    pl = np.zeros(n, dtype=np.int64)
+                    if n > 1:
+                        eq = fmat[1:] == fmat[:-1]
+                        all_eq = eq.all(axis=1)
+                        pl[1:] = np.where(all_eq, K,
+                                          np.argmin(eq, axis=1))
+                    pl[np.arange(0, n, GROUP)] = 0
+                    slen = K - pl
+                    meta = np.empty(2 * n,
+                                    dtype="<u2" if meta16 else np.uint8)
+                    meta[0::2] = pl
+                    meta[1::2] = slen
+                    sfx = fmat[np.arange(K)[None, :] >= pl[:, None]]
+                    soff = np.cumsum(slen) - slen
+                    kgso = soff[::GROUP].astype("<u4")
+                    # --- values (order-gathered flat bytes, VG groups) ---
+                    if total_v:
+                        vpos = np.repeat(
+                            kv.val_offs[rows].astype(np.int64), fvl
+                        ) + (np.arange(total_v)
+                             - np.repeat(np.cumsum(fvl) - fvl, fvl))
+                        ordered_v = kv.val_buf[vpos]
+                    else:
+                        ordered_v = np.zeros(0, dtype=np.uint8)
+                    gb = np.concatenate([[0], np.cumsum(np.add.reduceat(
+                        fvl, np.arange(0, n, vg)))]).astype(np.int64) \
+                        if n else np.zeros(1, np.int64)
+                    groups = [
+                        ordered_v[gb[i]: gb[i + 1]].tobytes()
+                        for i in range(len(gb) - 1)
+                    ]
+                    zdict = b""
+                    if (compress and copts.max_dict_bytes > 0
+                            and len(groups) >= 8):
+                        zdict = codecs.zstd_train_dictionary(
+                            groups[:: max(1, len(groups) // 256)]
+                            or groups,
+                            copts.max_dict_bytes,
+                        )
+                    blob = bytearray()
+                    go = [0]
+                    vflags = bytearray((len(groups) + 7) // 8)
+                    if compress:
+                        from concurrent.futures import ThreadPoolExecutor
+
+                        lvl = copts.level if copts.level is not None else 3
+                        with ThreadPoolExecutor(8) as ex:
+                            zs = list(ex.map(
+                                lambda raw: codecs.zstd_compress(
+                                    raw, lvl, zdict)
+                                if len(raw) >= 32 else None, groups))
+                    else:
+                        zs = [None] * len(groups)
+                    for gi, raw in enumerate(groups):
+                        payload = raw
+                        z = zs[gi]
+                        if z is not None and len(z) < len(raw):
+                            payload = z
+                            vflags[gi // 8] |= 1 << (gi % 8)
+                        blob += payload
+                        go.append(len(blob))
+                    lens32 = bool((fvl >= 1 << 16).any())
+                    vlens = fvl.astype(
+                        "<u4" if lens32 else "<u2").tobytes()
+                    smallest = fmat[0].tobytes()
+                    largest = fmat[-1].tobytes()
+                    kmeta = meta.tobytes()
+                    ksfx = sfx.tobytes()
+                    kgso_b = kgso.tobytes()
+                    vgo = np.asarray(go, dtype="<u4").tobytes()
+                    vblob = bytes(blob)
+                    vflags_b = bytes(vflags)
                 if compress:
                     props.compression_name = "zip+zstd"
                 # --- stats ---
@@ -787,8 +1108,6 @@ def write_tables_zip_columnar(env, dbname, new_file_number, icmp, options,
                 sq = seqs[rows]
                 props.smallest_seqno = int(sq.min())
                 props.largest_seqno = int(sq.max())
-                smallest = fmat[0].tobytes()
-                largest = fmat[-1].tobytes()
                 # --- bloom (native build, byte-identical to the python
                 # policy per the block-format parity tests) ---
                 fdata = None
@@ -801,12 +1120,6 @@ def write_tables_zip_columnar(env, dbname, new_file_number, icmp, options,
                     fdata = build_filter_block_native(
                         lib, bp, kv.key_buf, kv.key_offs[rows],
                         np.full(n, K - 8, dtype=np.int32), n)
-                kmeta = meta.tobytes()
-                ksfx = sfx.tobytes()
-                kgso_b = kgso.tobytes()
-                vgo = np.asarray(go, dtype="<u4").tobytes()
-                vblob = bytes(blob)
-                vflags_b = bytes(vflags)
             else:
                 # Parity with ZipTableBuilder on an entry-less file: its
                 # _encode_values computes avg=1 -> vg=256, and its seqno

@@ -337,9 +337,50 @@ ABI_FUZZ_SYMS = (
     "tpulsm_block_seek", "tpulsm_decode_block", "tpulsm_decode_blocks",
     "tpulsm_inflate_blocks", "tpulsm_scan_blocks",
     "tpulsm_scan_blocks_refvals",
+    # Zip data plane: every kernel validates its full input surface
+    # (section length floors, offs/lens bounds, entry/group windows)
+    # before touching a byte, so hostile contract-shaped input is safe.
+    "tpulsm_zip_newkey", "tpulsm_zip_encode_keys",
+    "tpulsm_zip_encode_values", "tpulsm_zip_decode_keys",
+    "tpulsm_zip_group_decode", "tpulsm_zip_table_handle_new",
 )
 
-_BLOB_NAMES = ("data", "block", "file_buf", "rep", "target")
+_BLOB_NAMES = ("data", "block", "file_buf", "rep", "target",
+               "key_buf", "val_buf", "kmeta", "vblob")
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (max(a, 0) + max(b, 1) - 1) // max(b, 1)
+
+
+# §2.10.2 `:!` exemptions fall in two classes: opaque handles the fuzzer
+# cannot mint (symbol stays unfuzzable), and derived capacities the
+# callee recomputes from its scalar parameters. This table sizes the
+# second class — worst case, so an under-allocation can never masquerade
+# as a kernel bug — from the same scalars the argument list carries.
+_DERIVED_ELEMS = {
+    ("tpulsm_zip_encode_keys", "meta_out"): lambda v: 4 * max(v["n"], 1),
+    ("tpulsm_zip_encode_keys", "gso_out"):
+        lambda v: 4 * _cdiv(v["n"], v["group"]),
+    ("tpulsm_zip_encode_values", "go_out"):
+        lambda v: 4 * (_cdiv(v["n"], v["vg"]) + 1),
+    ("tpulsm_zip_encode_values", "flags_out"):
+        lambda v: _cdiv(_cdiv(v["n"], v["vg"]), 8),
+    ("tpulsm_zip_decode_keys", "key_offs"): lambda v: v["e1"] - v["e0"],
+    ("tpulsm_zip_decode_keys", "key_lens"): lambda v: v["e1"] - v["e0"],
+    ("tpulsm_zip_group_decode", "raw_offs"):
+        lambda v: v["g1"] - v["g0"] + 1,
+}
+
+# Ranges for scalars whose default 0..3 draw would pin a kernel in its
+# reject path (e.g. zip klen < 8 is always -3): wide enough to cross the
+# accept/reject boundary in both directions.
+_SCALAR_HINTS = {
+    "klen": (6, 72), "uklen": (0, 64), "group": (0, 33), "vg": (0, 33),
+    "meta16": (0, 2), "lens32": (0, 2), "n": (0, 513), "e0": (-2, 64),
+    "e1": (-2, 64), "g0": (-2, 8), "g1": (-2, 8), "key_base": (0, 4),
+    "compress": (0, 2), "level": (0, 9), "max_dict_bytes": (0, 1025),
+}
 
 
 def load_abi_contract(repo_root: str | None = None):
@@ -377,8 +418,9 @@ def shapes_from_contract(rng, sym, sigs, bindings, rows, data=b""):
     _, params = sigs[sym]
     specs = rows[sym][2]
     argtoks = bindings[sym]["argtypes"]
-    if "!" in specs.values():
-        return None
+    if any(s == "!" and (sym, p) not in _DERIVED_ELEMS
+           for p, s in specs.items()):
+        return None  # true opaque handles: not mintable from bytes
     ptr_ct = {"POINTER(c_uint8)": (np.uint8, ctypes.c_uint8),
               "POINTER(c_int8)": (np.int8, ctypes.c_int8),
               "POINTER(c_int32)": (np.int32, ctypes.c_int32),
@@ -392,17 +434,34 @@ def shapes_from_contract(rng, sym, sigs, bindings, rows, data=b""):
                  and n in _BLOB_NAMES), None)
     sized: dict[str, int] = {}
     for pname, spec in specs.items():
-        if spec.isdigit():
+        if spec.isdigit() or spec == "!":
             continue
         sized[spec] = (len(data) if pname == blob
                        else sized.get(spec, rng.randrange(0, 257)))
+    # Scalars draw before buffers so derived-capacity outputs (zip group
+    # counts, entry windows) can size themselves from the same values.
+    scalars: dict[str, int] = {}
+    for _, pname in params:
+        if pname in specs:
+            continue
+        if pname in sized:
+            scalars[pname] = sized[pname]
+        else:
+            lo, hi = _SCALAR_HINTS.get(pname, (0, 4))
+            scalars[pname] = rng.randrange(lo, hi)
     args, keepalive = [], []
     for (ctype, pname), tok in zip(params, argtoks):
         if pname not in specs:  # scalar: a chosen size, or a flag/seed
-            args.append(sized.get(pname, rng.randrange(0, 4)))
+            args.append(scalars[pname])
             continue
         spec = specs[pname]
-        n = int(spec) if spec.isdigit() else sized[spec]
+        derive = _DERIVED_ELEMS.get((sym, pname))
+        if derive is not None:
+            n = derive(scalars)
+        elif spec.isdigit():
+            n = int(spec)
+        else:
+            n = sized[spec]
         if tok == "c_char_p":
             raw = (data if pname == blob
                    else rng.randbytes(n))[:n].ljust(n, b"\x00")
@@ -447,6 +506,13 @@ def fuzz_abi(rng, runs, corpus: Corpus):
             continue
         args, keepalive = shaped
         rc = getattr(lib, sym)(*args)
+        if sigs[sym][0] == "void*" and rc:
+            # Minted handles (zip table ctor) borrow the keepalive
+            # buffers: free before they go away, and never leak.
+            import ctypes
+
+            lib.tpulsm_table_handle_free(ctypes.c_void_p(rc))
+            rc = 1  # signature: handle minted vs refused, not the address
         del keepalive
         signed = sigs[sym][0] in ("int32_t", "int64_t")
         if signed and rc < -16:
@@ -455,7 +521,8 @@ def fuzz_abi(rng, runs, corpus: Corpus):
             print(f"FINDING[abi]: {sym} returned out-of-contract rc {rc}")
             corpus.maybe_add(data, ("FINDING", it))
             findings += 1
-        sig = (sym, max(-16, min(int(rc), 8)) if signed else "u")
+        sig = (sym, max(-16, min(int(rc), 8)) if signed
+               else "h%d" % bool(rc))
         corpus.maybe_add(data, sig)
     return findings
 

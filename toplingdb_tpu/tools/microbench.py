@@ -308,6 +308,74 @@ def main(argv=None) -> int:
                 else:
                     os.environ[k2] = v
 
+    # Native zip encode plane vs the Python ZipTableBuilder oracle: the
+    # SAME survivor segment emitted through write_tables_zip_columnar with
+    # TPULSM_ZIP_PLANE=0 and =1 (byte-identical table files are asserted;
+    # the ratio is the batched dict-sample/entropy-encode/index-build win).
+    if args.filter in "zip_encode":
+        from toplingdb_tpu.ops.columnar_io import ColumnarKV
+        from toplingdb_tpu.table.zip_table import write_tables_zip_columnar
+
+        n_z = max(n, 4096)
+        zenv = MemEnv()
+        zq = np.arange(n_z, dtype=np.int64)
+        zseqs = np.arange(1, n_z + 1, dtype=np.uint64)
+        ikz = np.empty((n_z, 16), dtype=np.uint8)
+        for j in range(8):
+            ikz[:, 7 - j] = (zq // 10 ** j) % 10 + ord("0")
+        packed_z = (zseqs << np.uint64(8)) | np.uint64(1)
+        ikz[:, 8:] = packed_z[:, None] >> (np.arange(8) * 8).astype(
+            np.uint64)[None, :] & np.uint64(0xFF)
+        vz = np.full((n_z, 48), ord("z"), dtype=np.uint8)
+        for j in range(8):
+            vz[:, 7 - j] = (zq // 10 ** j) % 10 + ord("0")
+        zkv = ColumnarKV(
+            np.ascontiguousarray(ikz).reshape(-1),
+            np.arange(n_z, dtype=np.int32) * 16,
+            np.full(n_z, 16, dtype=np.int32),
+            np.ascontiguousarray(vz).reshape(-1),
+            np.arange(n_z, dtype=np.int32) * 48,
+            np.full(n_z, 48, dtype=np.int32),
+        )
+        topt_z = TableOptions(
+            format="zip",
+            compression=(fmt.ZSTD_COMPRESSION if zstd_ok
+                         else fmt.NO_COMPRESSION),
+            filter_policy=None)
+        fz = [100]
+        outs_z = {}
+
+        def zip_build(knob):
+            def go():
+                os.environ["TPULSM_ZIP_PLANE"] = knob
+                fz[0] = 100  # same file numbers per run: bytes comparable
+                files = write_tables_zip_columnar(
+                    zenv, "/zb", (lambda: (fz.__setitem__(
+                        0, fz[0] + 1), fz[0])[1]), icmp, topt_z, zkv,
+                    np.arange(n_z, dtype=np.int64),
+                    np.full(n_z, -1, dtype=np.int64),
+                    np.full(n_z, 1, dtype=np.int32), zseqs, [],
+                    creation_time=1)
+                blobs = []
+                for _fnum, path, _props, _sm, _lg, _sel in files:
+                    f = zenv.new_random_access_file(path)
+                    blobs.append(f.read(0, zenv.get_file_size(path)))
+                    zenv.delete_file(path)
+                outs_z[knob] = blobs
+            return go
+
+        saved_zp = os.environ.get("TPULSM_ZIP_PLANE")
+        try:
+            for knob in ("0", "1"):
+                _bench(f"zip_encode_{knob}", zip_build(knob), n_z)
+            assert outs_z["0"] == outs_z["1"] and outs_z["1"], \
+                "zip plane output diverged from the Python builder"
+        finally:
+            if saved_zp is None:
+                os.environ.pop("TPULSM_ZIP_PLANE", None)
+            else:
+                os.environ["TPULSM_ZIP_PLANE"] = saved_zp
+
     # Chunked vs per-entry iterator data plane: the SAME multi-level DB
     # scanned with TPULSM_ITER_CHUNK=0 and =1 (byte-identical output is
     # asserted; the ratio is the scan plane's win).

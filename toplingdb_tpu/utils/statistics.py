@@ -61,6 +61,13 @@ NO_ITERATOR_DELETED = "no.iterator.deleted"
 # DBIter, and mid-stream degradations to the per-entry path.
 ITER_CHUNK_REFILLS = "db.iter.chunk.refills"
 ITER_CHUNK_FALLBACKS = "db.iter.chunk.fallbacks"
+# Searchable-compression zip data plane (table/zip_table.py serving
+# ops/scan_plane.py): value groups bulk-decoded per scan window, raw
+# bytes those decodes produced, and zip files the plane had to refuse
+# (TPULSM_ZIP_PLANE=0 or native zip kernels missing).
+ZIP_GROUP_DECODES = "zip.group.decodes"
+ZIP_GROUP_DECODE_BYTES = "zip.group.decode.bytes"
+ZIP_PLANE_FALLBACKS = "zip.plane.fallbacks"
 # -- writes ----------------------------------------------------------
 BYTES_WRITTEN = "bytes.written"
 NUMBER_KEYS_WRITTEN = "number.keys.written"
