@@ -447,6 +447,121 @@ def sharding_rows(detail):
     detail["hot_tenant_shed_ops"] = hot[1]
 
 
+_FLEET_DRIVER = """
+import random
+import sys
+import time
+
+from toplingdb_tpu.db.write_batch import WriteBatch
+from toplingdb_tpu.sharding.fleet import FleetRouter
+from toplingdb_tpu.sharding.lease import LeaseClient
+
+co_url, shard = sys.argv[1], sys.argv[2]
+lo, hi, bs, vlen, seed = (int(a) for a in sys.argv[3:8])
+keys = list(range(lo, hi))
+random.Random(seed).shuffle(keys)
+v = b"s" * vlen
+batches = []
+for j in range(0, len(keys), bs):
+    b = WriteBatch()
+    for k in keys[j:j + bs]:
+        b.put(b"%016d" % k, v)
+    batches.append(b)
+router = FleetRouter(LeaseClient(co_url), map_lease=60.0)
+print("READY", flush=True)   # batches prebuilt; wait for the gun
+sys.stdin.readline()
+for b in batches:
+    router.write(b, shard=shard)
+"""
+
+
+def fleet_rows(detail):
+    """1-process vs 4-process out-of-process fleet fillrandom: a real
+    lease-coordinator process plus one ShardServer process per shard,
+    prebuilt per-shard WriteBatches pushed over HTTP through the
+    FleetRouter by 4 driver PROCESSES (one client process cannot feed
+    4 servers — its GIL becomes the bottleneck and the measurement
+    flattens). Everything here genuinely overlaps across cores, so the
+    4-process fleet must sustain at least the in-process plane's
+    shard_scaling_x despite paying the HTTP hop."""
+    import subprocess
+
+    from toplingdb_tpu.sharding.fleet import FleetSupervisor
+    from toplingdb_tpu.sharding.shard_map import ShardMap
+
+    n_keys = 100_000
+    vlen = 400
+    bs = 250
+    T = 4
+
+    def bounds(nsh):
+        step = n_keys // nsh
+        return [(f"s{i}",
+                 None if i == 0 else b"%016d" % (i * step),
+                 None if i == nsh - 1 else b"%016d" % ((i + 1) * step))
+                for i in range(nsh)]
+
+    def run(nsh):
+        d = tempfile.mkdtemp(prefix=f"benchfleet{nsh}_", dir="/dev/shm"
+                             if os.path.isdir("/dev/shm") else None)
+        co_proc, co_url = FleetSupervisor.start_coordinator(
+            os.path.join(d, "lease.jsonl"), ttl=30.0)
+        sup = FleetSupervisor(co_url, lease_ttl=30.0)
+        drivers = []
+        try:
+            sup.coordinator.install_map(
+                ShardMap.from_bounds(bounds(nsh)).to_config(), {})
+            members = [sup.spawn_server(f"s{i}", os.path.join(d, f"s{i}"))
+                       for i in range(nsh)]
+            doc = sup.coordinator.get_map()
+            sup.coordinator.cas_map(doc["version"], doc["map"],
+                                    {m.shard: m.url for m in members})
+            # One driver process per writer: disjoint key slices, each
+            # slice entirely inside one shard's range.
+            per = n_keys // T
+            step = n_keys // max(nsh, 1)
+            for t in range(T):
+                if nsh == 1:
+                    shard, lo, hi = "s0", t * per, (t + 1) * per
+                else:
+                    i = t % nsh
+                    shard, lo, hi = f"s{i}", i * step, (i + 1) * step
+                drivers.append(subprocess.Popen(
+                    [sys.executable, "-c", _FLEET_DRIVER, co_url, shard,
+                     str(lo), str(hi), str(bs), str(vlen), str(t)],
+                    env=FleetSupervisor._proc_env(),
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE))
+            for p in drivers:  # all batches built before the clock starts
+                assert p.stdout.readline().strip() == b"READY"
+            t0 = time.time()
+            for p in drivers:
+                p.stdin.write(b"\n")
+                p.stdin.flush()
+            for p in drivers:
+                if p.wait() != 0:
+                    raise RuntimeError("fleet fill driver failed")
+            return n_keys / (time.time() - t0)
+        finally:
+            for p in drivers:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            sup.stop_all()
+            co_proc.terminate()
+            try:
+                co_proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 - bench teardown
+                co_proc.kill()
+                co_proc.wait()
+            shutil.rmtree(d, ignore_errors=True)
+
+    f1 = run(1)
+    f4 = run(4)
+    detail["fleet_fill_1proc_ops_s"] = round(f1)
+    detail["fleet_fill_4proc_ops_s"] = round(f4)
+    detail["fleet_scaling_x"] = round(f4 / max(1.0, f1), 2)
+
+
 def _mk_batch(keys, vlen, WriteBatch, lo=None, hi=None):
     b = WriteBatch()
     v = b"s" * vlen
@@ -1478,6 +1593,11 @@ def main():
             detail["sharding_rows_error"] = repr(e)[:120]
 
         try:
+            fleet_rows(detail)
+        except Exception as e:  # noqa: BLE001
+            detail["fleet_rows_error"] = repr(e)[:120]
+
+        try:
             concurrency_rows(detail, n_db)
         except Exception as e:  # noqa: BLE001
             detail["concurrency_rows_error"] = repr(e)[:120]
@@ -1611,6 +1731,10 @@ def main():
             # Sharding plane: 4-shard vs 1-shard router fillrandom ratio
             # (detail has the per-config ops/s + hot-tenant isolation).
             "shard_scaling_x": detail.get("shard_scaling_x"),
+            # Out-of-process fleet: 4 ShardServer processes vs 1 through
+            # the FleetRouter's HTTP data plane (gate: >= in-process
+            # shard_scaling_x — no shared GIL across primaries).
+            "fleet_scaling_x": detail.get("fleet_scaling_x"),
             # Concurrency plane: off-mode factories must price as raw
             # locks (gate: <= 1%) and debug-instrumented fillrandom must
             # stay within 2x of plain (gate: <= 100).
@@ -1635,8 +1759,8 @@ def main():
             "n_entries", "raw_kv_bytes", "wall_s", "headline_run_times_s",
             "phase_breakdown", "compression", "headline_source",
             "variant_rows_source", "readwhilewriting_replica_ops",
-            "replica_read_pct", "shard_scaling_x", "sibling_keep_pct",
-            "fillrandom_4shard_ops_s",
+            "replica_read_pct", "shard_scaling_x", "fleet_scaling_x",
+            "sibling_keep_pct", "fillrandom_4shard_ops_s",
             "compaction_zip_serial_MBps") if k in detail}
         slim["detail_truncated"] = True
         line = json.dumps(make_record(slim))

@@ -1,0 +1,434 @@
+"""Out-of-process fleet tests: lease semantics, fencing, graceful
+shutdown, transport resilience, and the multi-process chaos soak.
+
+The lease-protocol tests drive LeaseCoordinator with a FAKE clock so
+expiry, grace and restart scenarios are exact, not sleep-calibrated;
+process-level behaviour (kill -9, partition, coordinator crash) is
+covered by the seeded fast soak at the bottom — the full soak rides
+behind the `slow` marker.
+"""
+
+import os
+import time
+
+import pytest
+
+from toplingdb_tpu.sharding.lease import (
+    LeaseClient,
+    LeaseConflict,
+    LeaseCoordinator,
+    LeaseCoordinatorServer,
+)
+from toplingdb_tpu.sharding.shard_map import Shard, ShardMap
+from toplingdb_tpu.utils.statistics import Statistics
+from toplingdb_tpu.utils.status import Busy, IOError_
+
+
+@pytest.fixture
+def clk():
+    """Mutable fake clock: clk.now to read, clk.tick(dt) to advance."""
+    class _Clk:
+        now = 1000.0
+
+        def __call__(self):
+            return self.now
+
+        def tick(self, dt):
+            self.now += dt
+    return _Clk()
+
+
+@pytest.fixture
+def coord(tmp_path, clk):
+    co = LeaseCoordinator(str(tmp_path / "lease.jsonl"), default_ttl=10.0,
+                          grace=2.0, clock=clk, statistics=Statistics())
+    co.install_map(ShardMap.uniform(2).to_config(),
+                   {"s0": "http://a", "s1": "http://b"})
+    yield co
+    co.close()
+
+
+# ---------------------------------------------------------------------------
+# Lease semantics (satellite 4)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_expiry_then_fencing_token_rejection(coord, clk):
+    g1 = coord.acquire("s0", "h1")
+    # Past expiry + grace the shard is up for grabs; the NEW grant's
+    # token is strictly higher, and the old token is dead everywhere.
+    clk.tick(10.0 + 2.0 + 0.001)
+    g2 = coord.acquire("s0", "h2")
+    assert g2["token"] > g1["token"]
+    with pytest.raises(LeaseConflict):
+        coord.renew("s0", "h1", g1["token"])
+    with pytest.raises(LeaseConflict):
+        coord.release("s0", "h1", g1["token"])
+    with pytest.raises(LeaseConflict):
+        coord.bump_epoch("s0", g1["token"])
+    assert coord.stats.get_ticker_count("lease.rejects") >= 3
+    assert coord.stats.get_ticker_count("lease.expiries") == 1
+
+
+def test_clock_skew_grace_window(coord, clk):
+    g = coord.acquire("s0", "h1")
+    # Inside expiry+grace: the (possibly clock-lagged) holder may still
+    # renew, and a competitor must keep waiting — the windows are the
+    # same on both sides, so they can never overlap.
+    clk.tick(11.0)  # expired 1s ago, grace is 2s
+    with pytest.raises(LeaseConflict):
+        coord.acquire("s0", "h2")
+    renewed = coord.renew("s0", "h1", g["token"])
+    assert renewed["expires"] == clk.now + 10.0
+    # Fully past grace: renewals die too.
+    clk.tick(12.001)
+    with pytest.raises(LeaseConflict):
+        coord.renew("s0", "h1", g["token"])
+
+
+def test_double_grant_impossible_after_coordinator_restart(tmp_path, clk):
+    path = str(tmp_path / "lease.jsonl")
+    co = LeaseCoordinator(path, default_ttl=10.0, grace=2.0, clock=clk)
+    co.install_map(ShardMap.uniform(1).to_config(), {})
+    g = co.acquire("s0", "h1")
+    co.close()  # coordinator "crashes" (state only in the log)
+    co2 = LeaseCoordinator(path, default_ttl=10.0, grace=2.0, clock=clk)
+    # The unexpired grant is still binding on the amnesiac restart...
+    with pytest.raises(LeaseConflict):
+        co2.acquire("s0", "h2")
+    # ...the holder's token still works...
+    renewed = co2.renew("s0", "h1", g["token"])
+    assert renewed["token"] == g["token"]
+    # ...and tokens granted after the restart are strictly higher
+    # (next_token replays as max(seen) + 1, never reused).
+    g2 = co2.reassign("s0", "h2", token=g["token"])
+    assert g2["token"] > g["token"]
+    co2.close()
+
+
+def test_replay_ignores_torn_tail(tmp_path, clk):
+    path = str(tmp_path / "lease.jsonl")
+    co = LeaseCoordinator(path, default_ttl=10.0, grace=2.0, clock=clk)
+    co.install_map(ShardMap.uniform(1).to_config(), {"s0": "http://a"})
+    g = co.acquire("s0", "h1")
+    co.close()
+    with open(path, "ab") as f:  # crash mid-append: torn JSON tail
+        f.write(b'{"op":"grant","shard":"s0","hol')
+    co2 = LeaseCoordinator(path, default_ttl=10.0, grace=2.0, clock=clk)
+    assert co2.status()["leases"]["s0"]["token"] == g["token"]
+    assert co2.get_map()["placement"] == {"s0": "http://a"}
+    co2.close()
+
+
+def test_map_cas_conflict(coord):
+    doc = coord.get_map()
+    m = ShardMap.from_config(doc["map"])
+    m.split("s0", b"\x20" + b"\x00" * 15)
+    coord.cas_map(doc["version"], m.to_config())  # winner
+    with pytest.raises(LeaseConflict):
+        coord.cas_map(doc["version"], m.to_config())  # loser: stale version
+    assert coord.stats.get_ticker_count("lease.cas.conflicts") == 1
+
+
+def test_reassign_requires_token_expiry_or_force(coord, clk):
+    g = coord.acquire("s0", "h1")
+    epoch0 = ShardMap.from_config(coord.get_map()["map"]).epoch_of("s0")
+    with pytest.raises(LeaseConflict):
+        coord.reassign("s0", "h2")  # live lease, no admission path
+    out = coord.reassign("s0", "h2", force=True, url="http://c")
+    assert out["token"] > g["token"]
+    assert out["epoch"] > epoch0  # the cutover epoch bump fences stragglers
+    assert coord.get_map()["placement"]["s0"] == "http://c"
+
+
+# ---------------------------------------------------------------------------
+# In-process ShardServer: epoch/lease write admission + graceful shutdown
+# ---------------------------------------------------------------------------
+
+
+def _mini_batch(key=b"k", val=b"v"):
+    import base64
+
+    from toplingdb_tpu.db.write_batch import WriteBatch
+
+    b = WriteBatch()
+    b.put(key, val)
+    return base64.b64encode(b.data()).decode()
+
+
+def test_server_rejects_stale_epoch_and_lapsed_lease(tmp_path,
+                                                     no_thread_leaks):
+    from toplingdb_tpu.sharding.fleet import ShardServer
+
+    co = LeaseCoordinator(str(tmp_path / "lease.jsonl"), default_ttl=0.25,
+                          grace=0.1)
+    co.install_map(
+        ShardMap([Shard(name="s0", start=None, end=None)]).to_config(), {})
+    srv = ShardServer("s0", str(tmp_path / "s0"), coordinator=co,
+                      lease_ttl=0.25, heartbeat_interval=30.0,
+                      statistics=Statistics())
+    try:
+        srv.start()
+        code, out = srv.handle_write({"epoch": 1,
+                                      "batch_b64": _mini_batch()})
+        assert code == 200 and out["epoch"] == 1
+        # Wrong epoch: refused 409, counted, never applied.
+        code, out = srv.handle_write({"epoch": 99,
+                                      "batch_b64": _mini_batch(b"x")})
+        assert (code, out["error"]) == (409, "stale_epoch")
+        assert srv.stats.get_ticker_count("fleet.stale.epoch.rejects") == 1
+        # Lease lapses (heartbeat disabled): server self-fences writes.
+        time.sleep(0.3)
+        assert not srv._lease_ok()
+        code, out = srv.handle_write({"epoch": 1,
+                                      "batch_b64": _mini_batch(b"y")})
+        assert (code, out["error"]) == (503, "lease_expired")
+        assert srv.stats.get_ticker_count("fleet.write.rejects") == 1
+        assert srv.router.get(b"x") is None  # the 409 write never landed
+        assert srv.router.get(b"y") is None  # nor the 503 one
+    finally:
+        srv.shutdown()
+        co.close()
+
+
+def test_graceful_shutdown_drains_flushes_and_reopens(tmp_path,
+                                                      no_thread_leaks):
+    """Satellite 3: shutdown fences + drains via the _WriteGate, flushes,
+    closes — zero leaked owner-scoped threads (fixture) and a clean
+    re-open that still holds every acked write."""
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.sharding.fleet import ShardServer
+
+    co = LeaseCoordinator(str(tmp_path / "lease.jsonl"), default_ttl=5.0,
+                          grace=1.0)
+    co.install_map(
+        ShardMap([Shard(name="s0", start=None, end=None)]).to_config(), {})
+    srv = ShardServer("s0", str(tmp_path / "s0"), coordinator=co,
+                      statistics=Statistics())
+    srv.start()
+    for i in range(50):
+        code, _ = srv.handle_write(
+            {"epoch": 1, "batch_b64": _mini_batch(b"k%03d" % i, b"v")})
+        assert code == 200
+    srv.shutdown()
+    srv.shutdown()  # idempotent
+    assert co.status()["leases"] == {}  # lease released on the way out
+    co.close()
+    db = DB.open(str(tmp_path / "s0"), Options(create_if_missing=False))
+    try:
+        assert db.get(b"k000") == b"v" and db.get(b"k049") == b"v"
+    finally:
+        db.close()
+
+
+def test_fleet_router_fails_closed_when_partitioned(tmp_path,
+                                                    no_thread_leaks):
+    """Satellite 4's router-side half: a router that cannot re-validate
+    its map within the map lease refuses to route (Busy), and counts it
+    — `shard.token.rejects` parity for the cross-process plane."""
+    from toplingdb_tpu.env.fault_injection import PartitionGate
+    from toplingdb_tpu.sharding.fleet import FleetRouter, ShardServer
+
+    co = LeaseCoordinator(str(tmp_path / "lease.jsonl"), default_ttl=5.0,
+                          grace=1.0)
+    co.install_map(
+        ShardMap([Shard(name="s0", start=None, end=None)]).to_config(), {})
+    csrv = LeaseCoordinatorServer(co)
+    cport = csrv.start()
+    srv = ShardServer("s0", str(tmp_path / "s0"),
+                      coordinator=LeaseClient(f"http://127.0.0.1:{cport}"),
+                      statistics=Statistics())
+    try:
+        port = srv.start()
+        doc = co.get_map()
+        co.cas_map(doc["version"], doc["map"],
+                   {"s0": f"http://127.0.0.1:{port}"})
+        gate = PartitionGate()
+        stats = Statistics()
+        router = FleetRouter(
+            LeaseClient(f"http://127.0.0.1:{cport}", timeout=2.0,
+                        partition=gate),
+            statistics=stats, map_lease=0.2, write_deadline=1.5)
+        router.put(b"a", b"1")
+        gate.engage()
+        time.sleep(0.25)  # map lease lapses while partitioned
+        with pytest.raises(Busy):
+            router.put(b"b", b"2")
+        assert stats.get_ticker_count("fleet.write.rejects") > 0
+        gate.heal()
+        router.put(b"b", b"2")  # heals transparently
+        assert [k for k, _ in router.scan()] == [b"a", b"b"]
+    finally:
+        srv.shutdown()
+        csrv.stop()
+        co.close()
+
+
+# ---------------------------------------------------------------------------
+# HttpTransport resilience (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_http_transport_bounded_retry_and_breaker():
+    from toplingdb_tpu.compaction.resilience import DcompactOptions
+    from toplingdb_tpu.replication.log_shipper import HttpTransport
+
+    t = HttpTransport("http://127.0.0.1:1",  # closed port: refused fast
+                      timeout=0.5,
+                      options=DcompactOptions(
+                          max_attempts=2, backoff_base=0.01,
+                          backoff_jitter=0.0, attempt_timeout=0.5,
+                          breaker_failure_threshold=2,
+                          breaker_reset_timeout=30.0))
+    t0 = time.monotonic()
+    with pytest.raises(IOError_, match="after 2 attempts"):
+        t.pull(None)
+    assert time.monotonic() - t0 < 5.0  # bounded, not wedged
+    # Two strikes opened the breaker: the next call fails FAST without
+    # touching the network at all.
+    assert t.breaker.state == "open"
+    with pytest.raises(IOError_, match="circuit open"):
+        t.pull(None)
+
+
+def test_http_transport_does_not_retry_http_answers(tmp_path):
+    """An HTTP-level answer is deterministic: 410 maps to
+    WalRetentionGone once, with no retry burn-down and no breaker
+    strike (the peer is alive)."""
+    import base64
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.options import Options
+    from toplingdb_tpu.replication.log_shipper import (
+        HttpTransport,
+        LogShipper,
+        ReplicationServer,
+        WalRetentionGone,
+    )
+
+    db = DB.open(str(tmp_path / "db"), Options(create_if_missing=True))
+    shipper = LogShipper(db, max_frame_bytes=1 << 16)
+    srv = ReplicationServer(db, shipper)
+    try:
+        port = srv.start()
+        for i in range(20):
+            db.put(b"k%05d" % i, os.urandom(256))
+        # Flush twice so the WAL holding the early seqs is GC'd and a
+        # pull from seq 3 is genuinely unservable (410 on the wire).
+        db.flush()
+        for i in range(5):
+            db.put(b"x%02d" % i, b"y")
+        db.flush()
+        db.put(b"tail", b"t")
+        t = HttpTransport(f"http://127.0.0.1:{port}", timeout=5.0)
+        with pytest.raises(WalRetentionGone):
+            t.pull(3)  # below the retention floor
+        assert t.breaker.state == "closed"
+        frames, state = t.pull(None)  # healthy pull still fine
+        assert state["last_sequence"] == db.versions.last_sequence
+    finally:
+        srv.stop()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# The chaos soak (tentpole proof)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_soak_fast(tmp_path):
+    """Seeded fast soak: 2 shard-server processes + coordinator process,
+    concurrent writers, kill -9 mid-migration + recover, router
+    partition fail-closed, coordinator crash/replay, stale-epoch 409 —
+    then exact merged-oracle parity and all-zero exit codes."""
+    from toplingdb_tpu.tools.fleet_soak import run_soak
+
+    out = run_soak(str(tmp_path / "soak"), seed=1234, fast=True,
+                   log=lambda *a: None)
+    assert out["ok"]
+    assert out["scanned_keys"] == out["oracle_keys"]
+    assert out["acked_writes"] > 100
+    assert out["router_fail_closed"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_soak_full(tmp_path):
+    from toplingdb_tpu.tools.fleet_soak import run_soak
+
+    out = run_soak(str(tmp_path / "soak"), seed=99, fast=False,
+                   log=lambda *a: None)
+    assert out["ok"]
+    assert out["scanned_keys"] == out["oracle_keys"]
+
+
+def test_sideplugin_fleet_view(tmp_path, no_thread_leaks):
+    """GET /fleet and /fleet/<name> on the SidePluginRepo HTTP layer:
+    supervisor members merged with the coordinator's lease table."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from toplingdb_tpu.sharding.fleet import FleetSupervisor
+    from toplingdb_tpu.utils.config import SidePluginRepo
+
+    co = LeaseCoordinator(str(tmp_path / "lease.jsonl"))
+    co.install_map(ShardMap.uniform(1).to_config(), {})
+    csrv = LeaseCoordinatorServer(co)
+    cport = csrv.start()
+    repo = SidePluginRepo()
+    try:
+        sup = FleetSupervisor(f"http://127.0.0.1:{cport}")
+        repo.attach_fleet_supervisor("f1", sup)
+        port = repo.start_http()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=10) as r:
+            assert _json.loads(r.read()) == {"fleets": ["f1"]}
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/f1", timeout=10) as r:
+            doc = _json.loads(r.read())
+        assert doc["members"] == []
+        assert doc["coordinator"]["map_version"] == 1
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/nope", timeout=10)
+    finally:
+        repo.stop_http()
+        csrv.stop()
+        co.close()
+
+
+def test_fleet_admin_cli_roundtrip(tmp_path, no_thread_leaks):
+    """The operator CLI against a live in-process coordinator + server:
+    status, map, server-status, fence/unfence, kill."""
+    from toplingdb_tpu.sharding.fleet import ShardServer
+    from toplingdb_tpu.tools import fleet_admin
+
+    co = LeaseCoordinator(str(tmp_path / "lease.jsonl"), default_ttl=5.0,
+                          grace=1.0)
+    co.install_map(
+        ShardMap([Shard(name="s0", start=None, end=None)]).to_config(), {})
+    csrv = LeaseCoordinatorServer(co)
+    cport = csrv.start()
+    srv = ShardServer("s0", str(tmp_path / "s0"),
+                      coordinator=LeaseClient(f"http://127.0.0.1:{cport}"),
+                      statistics=Statistics())
+    try:
+        port = srv.start()
+        co_url = f"http://127.0.0.1:{cport}"
+        s_url = f"http://127.0.0.1:{port}"
+        assert fleet_admin.main(["--coordinator", co_url, "status"]) == 0
+        assert fleet_admin.main(["--coordinator", co_url, "map"]) == 0
+        assert fleet_admin.main(["--server", s_url, "server-status"]) == 0
+        assert fleet_admin.main(["--server", s_url, "fence"]) == 0
+        assert srv.router._gate("s0").fenced
+        assert fleet_admin.main(["--server", s_url, "unfence"]) == 0
+        assert not srv.router._gate("s0").fenced
+        assert fleet_admin.main(["--server", s_url, "kill"]) == 0
+        assert srv.shutdown_requested.wait(timeout=5.0)
+        # missing required flag → usage error, not a traceback
+        assert fleet_admin.main(["status"]) == 2
+    finally:
+        srv.shutdown()
+        csrv.stop()
+        co.close()

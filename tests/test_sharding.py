@@ -614,6 +614,35 @@ def test_shard_map_save_load(tmp_path):
     assert m2.to_config() == m.to_config()
 
 
+def test_shard_map_save_is_crash_atomic(tmp_path):
+    """Crash twin for ShardMap.save: a process killed mid-save leaves a
+    torn `.tmp` side file — never a torn map. The complete OLD map must
+    survive, and a later save must atomically replace both."""
+    import json as _json
+
+    path = str(tmp_path / "shardmap.json")
+    old = ShardMap.uniform(2)
+    old.save(path)
+
+    # Crash mid-save: the new map's bytes were only partially written to
+    # the side file when the process died (save() goes tmp → fsync →
+    # rename, so `path` itself was never touched).
+    new = ShardMap.uniform(2)
+    new.split("s0", b"\x40" + b"\x00" * 15)
+    torn = _json.dumps(new.to_config(), indent=1).encode()[:37]
+    with open(path + ".tmp", "wb") as f:
+        f.write(torn)
+
+    loaded = ShardMap.load(path)  # readers ignore stray .tmp files
+    assert loaded.to_config() == old.to_config()
+
+    # Retrying the save replaces the torn residue and the old map in one
+    # atomic step; nothing is left behind.
+    new.save(path)
+    assert ShardMap.load(path).to_config() == new.to_config()
+    assert not (tmp_path / "shardmap.json.tmp").exists()
+
+
 def test_check_telemetry_lint_covers_shard_names():
     """The new SHARD_* tickers and shard.* spans must satisfy the tier-1
     telemetry lint (names declared / span table rows present)."""

@@ -362,3 +362,35 @@ class ShipFaultInjector:
         if len(data) <= 2:
             return data[:1]
         return data[: max(1, len(data) // 2)]
+
+
+class PartitionGate:
+    """Network-partition switch for HTTP clients (fleet chaos soak): an
+    engaged gate makes every guarded call fail fast with IOError_, as a
+    dropped route would — the caller sees unreachability, not hangs.
+    Thread-safe; `blocked` counts the calls the partition ate."""
+
+    def __init__(self):
+        self._mu = ccy.Lock("fault_injection.PartitionGate._mu")
+        self._engaged = False
+        self.blocked = 0
+
+    def engage(self) -> None:
+        with self._mu:
+            self._engaged = True
+
+    def heal(self) -> None:
+        with self._mu:
+            self._engaged = False
+
+    @property
+    def engaged(self) -> bool:
+        with self._mu:
+            return self._engaged
+
+    def check(self, what: str = "call") -> None:
+        """Raise IOError_ if the partition is engaged."""
+        with self._mu:
+            if self._engaged:
+                self.blocked += 1
+                raise IOError_(f"partitioned: {what}")

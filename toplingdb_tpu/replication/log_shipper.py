@@ -346,34 +346,78 @@ class LocalTransport(ReplicationTransport):
 class HttpTransport(ReplicationTransport):
     """Pulls frames from a ReplicationServer. Control plane over HTTP,
     bulk data (checkpoints) over the shared filesystem — the same split as
-    the dcompact service."""
+    the dcompact service.
 
-    def __init__(self, url: str, timeout: float = 30.0):
+    Failure policy reuses the dcompact boundary's (resilience.py): every
+    request carries a per-attempt timeout (a hung peer can no longer wedge
+    the calling router thread indefinitely), network-level failures get a
+    bounded exponential-backoff retry, and a per-URL CircuitBreaker makes
+    a dead primary fail FAST after `breaker_failure_threshold` strikes
+    instead of paying the timeout on every pull. HTTP-level answers are
+    authoritative (the peer is alive): 410 maps to WalRetentionGone, other
+    codes to IOError_ — neither is retried here."""
+
+    def __init__(self, url: str, timeout: float = 30.0, options=None):
+        from toplingdb_tpu.compaction.resilience import (
+            CircuitBreaker,
+            DcompactOptions,
+        )
+
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.options = options or DcompactOptions(
+            max_attempts=3, backoff_base=0.05, attempt_timeout=timeout,
+            breaker_reset_timeout=5.0)
+        self.breaker = CircuitBreaker(
+            failure_threshold=self.options.breaker_failure_threshold,
+            reset_timeout=self.options.breaker_reset_timeout)
 
     def _post(self, path: str, body: dict) -> dict:
-        req = urllib.request.Request(
-            self.url + path, data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as e:
+        data = json.dumps(body).encode()
+        last_err: Exception | None = None
+        for attempt in range(1, self.options.max_attempts + 1):
+            if not self.breaker.allow():
+                raise IOError_(
+                    f"replication peer {self.url} circuit open "
+                    f"(consecutive failures "
+                    f">= {self.breaker.failure_threshold})")
+            req = urllib.request.Request(
+                self.url + path, data=data,
+                headers={"Content-Type": "application/json"},
+                method="POST")
             try:
-                payload = json.loads(e.read())
-            except Exception as e2:
-                _errors.swallow(reason="http-error-body-parse", exc=e2)
-                payload = {}
-            if e.code == 410 or payload.get("error") == "wal_retention_gone":
-                raise WalRetentionGone(payload.get("detail", "")) from e
-            raise IOError_(
-                f"replication POST {path} to {self.url}: HTTP {e.code}"
-            ) from e
-        except OSError as e:
-            raise IOError_(
-                f"replication POST {path} to {self.url} failed: {e}"
-            ) from e
+                with urllib.request.urlopen(
+                        req, timeout=min(self.timeout,
+                                         self.options.attempt_timeout)) as r:
+                    out = json.loads(r.read())
+                self.breaker.on_success()
+                return out
+            except urllib.error.HTTPError as e:
+                # The peer ANSWERED: it is alive (breaker success), and the
+                # answer is deterministic — retrying cannot change it.
+                self.breaker.on_success()
+                try:
+                    payload = json.loads(e.read())
+                except Exception as e2:
+                    _errors.swallow(reason="http-error-body-parse", exc=e2)
+                    payload = {}
+                if e.code == 410 or \
+                        payload.get("error") == "wal_retention_gone":
+                    raise WalRetentionGone(payload.get("detail", "")) from e
+                raise IOError_(
+                    f"replication POST {path} to {self.url}: HTTP {e.code}"
+                ) from e
+            except OSError as e:
+                # Network-level (refused / reset / timeout): the retryable
+                # class — back off and try again, up to the bound.
+                self.breaker.on_failure()
+                last_err = e
+                if attempt < self.options.max_attempts:
+                    time.sleep(self.options.backoff_delay(attempt))
+        raise IOError_(
+            f"replication POST {path} to {self.url} failed after "
+            f"{self.options.max_attempts} attempts: {last_err}"
+        ) from last_err
 
     def pull(self, since_seq, max_bytes: int = 1 << 22, span_export=None):
         req = {"since_seq": since_seq, "max_bytes": max_bytes}

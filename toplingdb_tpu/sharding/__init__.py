@@ -18,10 +18,29 @@ that turns a single DB into a fleet (ROADMAP item 3):
               catch-up (the dual-write window) → fence/drain →
               promote-style cutover with an epoch bump.
   balancer    split/merge decisions from per-shard size/traffic stats.
+  lease       the fleet's consensus substrate: a single-coordinator lease
+              store with monotonic fencing tokens, epoch CAS on map
+              mutations, and a durable replayable log (out-of-process
+              deployments; PR 16).
+  fleet       the out-of-process deployment: ShardServer processes behind
+              HTTP, the lease-validated FleetRouter front door, and the
+              crash-safe FleetSupervisor (heartbeats, promotion on
+              primary death, cross-process migration + recovery).
 """
 
 from toplingdb_tpu.sharding.admission import AdmissionController, TenantQuota
 from toplingdb_tpu.sharding.balancer import BalancerOptions, ShardBalancer
+from toplingdb_tpu.sharding.fleet import (
+    FleetRouter,
+    FleetSupervisor,
+    ShardServer,
+)
+from toplingdb_tpu.sharding.lease import (
+    LeaseClient,
+    LeaseConflict,
+    LeaseCoordinator,
+    LeaseCoordinatorServer,
+)
 from toplingdb_tpu.sharding.migration import MigrationAborted, ShardMigration
 from toplingdb_tpu.sharding.router import ShardRouter, ShardServing, ShardToken
 from toplingdb_tpu.sharding.shard_map import Shard, ShardMap
@@ -29,12 +48,19 @@ from toplingdb_tpu.sharding.shard_map import Shard, ShardMap
 __all__ = [
     "AdmissionController",
     "BalancerOptions",
+    "FleetRouter",
+    "FleetSupervisor",
+    "LeaseClient",
+    "LeaseConflict",
+    "LeaseCoordinator",
+    "LeaseCoordinatorServer",
     "MigrationAborted",
     "Shard",
     "ShardBalancer",
     "ShardMap",
     "ShardMigration",
     "ShardRouter",
+    "ShardServer",
     "ShardServing",
     "ShardToken",
     "TenantQuota",

@@ -1,0 +1,1213 @@
+"""Out-of-process shard fleet: ShardServer processes, a leased front
+door, and crash-safe supervision.
+
+PR 8's sharding plane proved the split/merge/migration protocol with
+every "process" as an in-process helper. This module promotes each
+shard primary to a real OS process behind the HTTP control/data split
+the repo already uses at the dcompact and replication seams:
+
+  ShardServer      one process per shard: the shard's DB fronted by a
+                   single-shard ShardRouter (reusing the `_WriteGate`
+                   fence/drain and token machinery), a LogShipper behind
+                   /replication/* (so followers and migrations pull WAL
+                   frames exactly as PR 4 does), a lease heartbeat to the
+                   coordinator, and SIGTERM-graceful shutdown:
+                   fence → drain in-flight writes → flush → close.
+  FleetRouter      the multi-process front door: routes by a CACHED
+                   shard map validated against the lease coordinator; a
+                   router that cannot re-validate within its map-lease
+                   window fails writes CLOSED (Busy) instead of routing
+                   on stale topology. Server-side epoch checks reject
+                   anything the cache got wrong (409 → refresh → retry),
+                   the cross-process analogue of `shard.token.rejects`.
+  FleetSupervisor  spawns/watches the processes: heartbeat liveness,
+                   kill -9 detection, automatic follower promotion on
+                   primary death (coordinator `reassign` = epoch bump +
+                   fresh fencing token), cross-process migration with
+                   `ShardMigration.recover` invoked over HTTP when a
+                   crash interrupts it mid-flight.
+
+Safety invariants (chaos-soaked by tools/fleet_soak.py):
+  - a write is acked iff it committed on the CURRENT epoch's primary
+    under a live lease — never under a stale epoch or lapsed lease;
+  - ownership moves only through the coordinator's fencing tokens, so
+    two processes can never both accept writes for one shard;
+  - kill -9 at any point loses nothing acked (WAL recovery on respawn;
+    migration sources stay authoritative until the cutover grant).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import http.client
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from toplingdb_tpu.compaction.resilience import DcompactOptions
+from toplingdb_tpu.replication.log_shipper import LogShipper, WalRetentionGone
+from toplingdb_tpu.sharding.lease import LeaseClient, LeaseConflict
+from toplingdb_tpu.sharding.migration import ShardMigration
+from toplingdb_tpu.sharding.router import ShardRouter
+from toplingdb_tpu.sharding.shard_map import Shard, ShardMap
+from toplingdb_tpu.utils import concurrency as ccy
+from toplingdb_tpu.utils import errors as _errors
+from toplingdb_tpu.utils import statistics as stats_mod
+from toplingdb_tpu.utils import telemetry as _tm
+from toplingdb_tpu.utils.status import Busy, IOError_, NotSupported
+
+DEFAULT_LEASE_TTL = 3.0
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http_json(url: str, path: str, body: dict | None = None,
+               timeout: float = 10.0) -> dict:
+    """One JSON round-trip, no retries (callers own their retry loop)."""
+    if body is None:
+        req = urllib.request.Request(url.rstrip("/") + path)
+    else:
+        req = urllib.request.Request(
+            url.rstrip("/") + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class _StaleEpoch(Busy):
+    """Server rejected the routed epoch (cutover happened): refresh the
+    map and retry — the cross-process `shard.token.rejects`."""
+
+    def __init__(self, msg: str, epoch: int | None = None):
+        super().__init__(msg)
+        self.epoch = epoch
+
+
+class _Unavailable(Busy):
+    """Server answered 503 (fenced / draining / lease lapsed / not the
+    primary): transient by contract, retry after refresh."""
+
+
+# ---------------------------------------------------------------------------
+# ShardServer: one process per shard
+# ---------------------------------------------------------------------------
+
+
+class ShardServer:
+    """One shard's serving process. Wraps the shard DB in a one-shard
+    ShardRouter so the in-process fence/drain (`_WriteGate`), token and
+    traffic machinery is reused verbatim; range clipping is the fleet
+    router's job (this map spans the whole keyspace on purpose).
+
+    Usable in-process for tests (`start()` / `shutdown()`), and as a
+    process via `python -m toplingdb_tpu.sharding.fleet` (SIGTERM runs
+    the same graceful shutdown)."""
+
+    def __init__(self, shard: str, path: str, *, coordinator=None,
+                 role: str = "primary", source_url: str | None = None,
+                 holder: str | None = None,
+                 lease_ttl: float = DEFAULT_LEASE_TTL,
+                 options=None, statistics=None,
+                 heartbeat_interval: float | None = None):
+        from toplingdb_tpu.utils.statistics import Statistics
+
+        self.shard = shard
+        self.path = path
+        self.coordinator = coordinator
+        self.role = role
+        self.source_url = source_url
+        self.holder = holder or f"{shard}@{os.getpid()}"
+        self.lease_ttl = lease_ttl
+        self.options = options
+        self.stats = statistics or Statistics()
+        self.heartbeat_interval = heartbeat_interval or (lease_ttl / 3.0)
+        self._mu = ccy.Lock("fleet.ShardServer._mu")
+        self._lease: dict | None = None
+        self._lease_valid_until = 0.0  # monotonic deadline (self-fence)
+        self.router: ShardRouter | None = None
+        self.db = None
+        self.follower = None
+        self.shipper: LogShipper | None = None
+        self._http: ThreadingHTTPServer | None = None
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
+        self._down = False
+        self.shutdown_requested = threading.Event()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        if self.role == "primary":
+            self._open_primary()
+        else:
+            self._open_follower()
+        self._http = ThreadingHTTPServer((host, port), self._handler())
+        ccy.spawn("fleet-shard-server", self._http.serve_forever,
+                  owner=self, stop=self.shutdown)
+        if self.coordinator is not None and self.role == "primary":
+            self._acquire_lease_blocking()
+            self._start_heartbeat()
+        return self._http.server_address[1]
+
+    @property
+    def port(self) -> int:
+        return self._http.server_address[1] if self._http else 0
+
+    def _db_options(self, create: bool):
+        from toplingdb_tpu.options import Options
+
+        opts = self.options or Options()
+        opts.create_if_missing = create
+        if opts.statistics is None:
+            opts.statistics = self.stats
+        return opts
+
+    def _open_primary(self) -> None:
+        from toplingdb_tpu.db.db import DB
+
+        epoch = 1
+        if self.coordinator is not None:
+            doc = self.coordinator.get_map()
+            if doc.get("map"):
+                m = ShardMap.from_config(doc["map"])
+                epoch = m.epoch_of(self.shard)
+        self.db = DB.open(self.path, self._db_options(create=True))
+        self.router = ShardRouter(
+            ShardMap([Shard(name=self.shard, start=None, end=None,
+                            epoch=epoch)]),
+            statistics=self.stats)
+        self.router.attach_shard(self.shard, self.db)
+        self.shipper = LogShipper(self.db, statistics=self.stats)
+
+    def _open_follower(self) -> None:
+        from toplingdb_tpu.replication.follower import FollowerDB
+        from toplingdb_tpu.replication.log_shipper import HttpTransport
+
+        if not self.source_url:
+            raise NotSupported("follower role needs --source <primary url>")
+        self.follower = FollowerDB.open(
+            self.path, self._db_options(create=False),
+            transport=HttpTransport(self.source_url),
+            mode="standalone", bootstrap=True)
+        self.follower.start_tailing()
+
+    def promote(self, grant: dict) -> dict:
+        """Follower → primary on the supervisor's order. `grant` is the
+        coordinator's reassign result: the fresh fencing token + the
+        bumped epoch that fences every pre-promotion write path."""
+        if self.follower is None:
+            raise NotSupported("promote: not a follower")
+        sp = _tm.span("fleet.promote")
+        path = self.follower.promote()
+        self.follower = None
+        from toplingdb_tpu.db.db import DB
+
+        # FollowerDB.open flipped these on the shared Options; a primary
+        # must write (migration.py's cutover does the same reset).
+        opts = self._db_options(create=False)
+        opts.read_only = False
+        opts.disable_auto_compactions = False
+        self.db = DB.open(path, opts)
+        epoch = int(grant.get("epoch", 1))
+        self.router = ShardRouter(
+            ShardMap([Shard(name=self.shard, start=None, end=None,
+                            epoch=epoch)]),
+            statistics=self.stats)
+        self.router.attach_shard(self.shard, self.db)
+        self.shipper = LogShipper(self.db, statistics=self.stats)
+        self.role = "primary"
+        with self._mu:
+            self._lease = {k: grant[k] for k in
+                           ("holder", "token", "expires", "ttl")
+                           if k in grant}
+            self._lease_valid_until = (
+                time.monotonic() + float(grant.get("ttl", self.lease_ttl)))
+        self.holder = grant.get("holder", self.holder)
+        if self.coordinator is not None:
+            self._start_heartbeat()
+        self._tick(stats_mod.FLEET_PROMOTIONS)
+        sp.finish()
+        return {"role": self.role, "epoch": epoch,
+                "applied_seq": self.db.versions.last_sequence}
+
+    def shutdown(self) -> None:
+        """Graceful teardown (SIGTERM handler and /fleet/shutdown): stop
+        heartbeating, fence the shard and DRAIN in-flight writes through
+        the _WriteGate, flush, close the DB, release the lease, stop
+        HTTP. Idempotent; leaves zero owner-scoped threads behind."""
+        with self._mu:
+            if self._down:
+                return
+            self._down = True
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._hb_thread = None
+        if self.router is not None:
+            try:
+                self.router.fence_shard(self.shard, drain_timeout=5.0)
+            except Busy as e:
+                _errors.swallow(reason="fleet-shutdown-drain-timeout", exc=e)
+        lease = self._lease
+        if self.coordinator is not None and lease is not None:
+            try:
+                self.coordinator.release(self.shard, self.holder,
+                                         lease["token"])
+            except (LeaseConflict, IOError_, OSError) as e:
+                _errors.swallow(reason="fleet-shutdown-lease-release", exc=e)
+        self._lease = None
+        if self.db is not None:
+            try:
+                self.db.flush()
+            except Exception as e:
+                _errors.swallow(reason="fleet-shutdown-flush", exc=e)
+        if self.router is not None:
+            self.router.close()  # closes the primary DB
+            self.router = None
+            self.db = None
+        elif self.db is not None:
+            self.db.close()
+            self.db = None
+        if self.follower is not None:
+            self.follower.close()
+            self.follower = None
+        if self._http is not None:
+            self._http.shutdown()
+            self._http = None
+        self.shutdown_requested.set()
+
+    # -- lease machinery --------------------------------------------------
+
+    def _tick(self, name: str) -> None:
+        if self.stats is not None:
+            self.stats.record_tick(name)
+
+    def _acquire_lease_blocking(self, timeout: float = 30.0) -> None:
+        """Primaries must hold the lease before serving a single write.
+        A fresh grant may have to sit out the previous holder's expiry +
+        grace (kill -9 respawn) — that wait IS the fencing protocol."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                grant = self.coordinator.acquire(self.shard, self.holder,
+                                                 self.lease_ttl)
+                self._adopt_grant(grant)
+                return
+            except LeaseConflict as e:
+                if time.monotonic() > deadline:
+                    raise Busy(
+                        f"could not acquire lease for {self.shard!r} "
+                        f"within {timeout}s: {e}") from e
+                time.sleep(0.1)
+            except (IOError_, OSError) as e:
+                self._tick(stats_mod.FLEET_HEARTBEAT_MISSES)
+                if time.monotonic() > deadline:
+                    raise IOError_(
+                        f"lease coordinator unreachable: {e}") from e
+                time.sleep(0.2)
+
+    def _adopt_grant(self, grant: dict) -> None:
+        with self._mu:
+            self._lease = grant
+            self._lease_valid_until = (
+                time.monotonic() + float(grant.get("ttl", self.lease_ttl)))
+        epoch = int(grant.get("epoch", 0))
+        if self.router is not None \
+                and epoch > self.router.map.epoch_of(self.shard):
+            self.router.map.adopt_epoch(self.shard, epoch)
+
+    def _start_heartbeat(self) -> None:
+        if self._hb_thread is not None:
+            return
+        self._hb_stop.clear()
+        self._hb_thread = ccy.spawn("fleet-lease-heartbeat",
+                                    self._heartbeat_loop, owner=self,
+                                    stop=self._hb_stop.set)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            with self._mu:
+                lease = self._lease
+            try:
+                if lease is None:
+                    grant = self.coordinator.acquire(
+                        self.shard, self.holder, self.lease_ttl)
+                else:
+                    grant = self.coordinator.renew(
+                        self.shard, self.holder, lease["token"],
+                        self.lease_ttl)
+                self._adopt_grant(grant)
+            except LeaseConflict as e:
+                # Superseded or lapsed: SELF-FENCE — stop acking writes
+                # now, re-acquire (fresh token) on a later beat.
+                _errors.swallow(reason="fleet-lease-superseded", exc=e)
+                with self._mu:
+                    fenced_now = self._lease is not None
+                    self._lease = None
+                if fenced_now:
+                    self._tick(stats_mod.FLEET_SELF_FENCES)
+            except (IOError_, OSError) as e:
+                # Coordinator unreachable: keep serving strictly within
+                # the lease we already hold; local expiry self-fences.
+                _errors.swallow(reason="fleet-heartbeat-miss", exc=e)
+                self._tick(stats_mod.FLEET_HEARTBEAT_MISSES)
+
+    def _lease_ok(self) -> bool:
+        if self.coordinator is None:
+            return True
+        with self._mu:
+            return (self._lease is not None
+                    and time.monotonic() < self._lease_valid_until)
+
+    def recover(self) -> dict:
+        """Cross-process ShardMigration.recover: lift a fence left by a
+        migration that died with the driver (kill -9 chaos). The source
+        is still authoritative — cutover never happened — so unfencing
+        restores service on the old epoch."""
+        ShardMigration.recover(self.router, self.shard)
+        self._tick(stats_mod.FLEET_MIGRATIONS_RECOVERED)
+        return {"recovered": True, "shard": self.shard,
+                "epoch": self.router.map.epoch_of(self.shard)}
+
+    # -- request handling -------------------------------------------------
+
+    def _current_epoch(self) -> int:
+        return self.router.map.epoch_of(self.shard)
+
+    def handle_write(self, req: dict) -> tuple[int, dict]:
+        """The data-plane hot path, and the safety choke point: a write
+        is admitted iff this process is the primary, holds a live lease,
+        and the router stamped the CURRENT epoch. 409/503 are answers,
+        not errors — the fleet router refreshes and retries."""
+        if self.role != "primary" or self.router is None:
+            return 503, {"error": "not_primary"}
+        if not self._lease_ok():
+            self._tick(stats_mod.FLEET_WRITE_REJECTS)
+            return 503, {"error": "lease_expired"}
+        epoch = self._current_epoch()
+        if int(req.get("epoch", -1)) != epoch:
+            self._tick(stats_mod.FLEET_STALE_EPOCH_REJECTS)
+            return 409, {"error": "stale_epoch", "epoch": epoch}
+        from toplingdb_tpu.db.write_batch import WriteBatch
+
+        batch = WriteBatch(base64.b64decode(req["batch_b64"]))
+        try:
+            tokens = self.router.write(batch, shard=self.shard)
+        except Busy as e:
+            return 503, {"error": "fenced", "detail": str(e)}
+        tok = tokens[0]
+        return 200, {"seq": tok.seq, "epoch": tok.epoch, "shard": self.shard}
+
+    def handle_get(self, req: dict) -> tuple[int, dict]:
+        key = base64.b64decode(req["key_b64"])
+        if self.follower is not None:
+            v = self.follower.get(key)
+        elif self.router is not None:
+            v = self.router.get(key)
+        else:
+            return 503, {"error": "not_serving"}
+        return 200, {"value_b64":
+                     base64.b64encode(v).decode() if v is not None else None}
+
+    def handle_multiget(self, req: dict) -> tuple[int, dict]:
+        if self.router is None:
+            return 503, {"error": "not_primary"}
+        keys = [base64.b64decode(k) for k in req["keys_b64"]]
+        vals = self.router.multi_get(keys)
+        return 200, {"values_b64": [
+            base64.b64encode(v).decode() if v is not None else None
+            for v in vals]}
+
+    def handle_scan(self, req: dict) -> tuple[int, dict]:
+        if self.router is None:
+            return 503, {"error": "not_primary"}
+        begin = base64.b64decode(req["begin_b64"]) \
+            if req.get("begin_b64") else None
+        end = base64.b64decode(req["end_b64"]) if req.get("end_b64") else None
+        limit = int(req.get("limit", 10000))
+        rows = []
+        truncated = False
+        for k, v in self.router.scan(begin, end):
+            if len(rows) >= limit:
+                truncated = True
+                break
+            rows.append([base64.b64encode(k).decode(),
+                         base64.b64encode(v).decode()])
+        return 200, {"rows": rows, "truncated": truncated}
+
+    def status(self) -> dict:
+        with self._mu:
+            lease = dict(self._lease) if self._lease else None
+        doc = {
+            "shard": self.shard, "role": self.role, "holder": self.holder,
+            "pid": os.getpid(), "lease": lease,
+            "lease_ok": self._lease_ok(),
+        }
+        if self.router is not None:
+            doc["epoch"] = self._current_epoch()
+            doc["applied_seq"] = self.db.versions.last_sequence
+            doc["fenced"] = self.router._gate(self.shard).fenced
+            doc["stale_epoch_rejects"] = self.stats.get_ticker_count(
+                stats_mod.FLEET_STALE_EPOCH_REJECTS) \
+                if self.stats is not None else 0
+        elif self.follower is not None:
+            doc.update(self.follower.replication_status())
+            doc["applied_seq"] = self.follower.applied_sequence()
+        return doc
+
+    def _handler(self):
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    doc = {"ok": True, "shard": srv.shard, "role": srv.role,
+                           "pid": os.getpid()}
+                    if srv.router is not None:
+                        doc["epoch"] = srv._current_epoch()
+                        doc["fenced"] = srv.router._gate(srv.shard).fenced
+                    self._reply(200, doc)
+                elif self.path == "/fleet/status":
+                    self._reply(200, srv.status())
+                elif self.path == "/metrics":
+                    text = srv.stats.to_prometheus(
+                        labels=f'shard="{srv.shard}"') \
+                        if srv.stats is not None else ""
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._reply(400, {"error": "bad json"})
+                    return
+                try:
+                    self._route(req)
+                except WalRetentionGone as e:
+                    self._reply(410, {"error": "wal_retention_gone",
+                                      "detail": str(e)})
+                except Exception as e:  # transport must answer, not die
+                    self._reply(500, {"error": repr(e)[:300]})
+
+            def _route(self, req: dict):
+                p = self.path
+                if p == "/fleet/write":
+                    self._reply(*srv.handle_write(req))
+                elif p == "/fleet/get":
+                    self._reply(*srv.handle_get(req))
+                elif p == "/fleet/multiget":
+                    self._reply(*srv.handle_multiget(req))
+                elif p == "/fleet/scan":
+                    self._reply(*srv.handle_scan(req))
+                elif p == "/fleet/fence":
+                    srv.router.fence_shard(
+                        srv.shard,
+                        drain_timeout=float(req.get("drain_timeout", 30.0)))
+                    self._reply(200, {
+                        "fenced": True,
+                        "applied_seq": srv.db.versions.last_sequence})
+                elif p == "/fleet/unfence":
+                    srv.router.unfence_shard(srv.shard)
+                    self._reply(200, {"fenced": False})
+                elif p == "/fleet/recover":
+                    self._reply(200, srv.recover())
+                elif p == "/fleet/epoch":
+                    with srv._mu:
+                        lease = srv._lease
+                    if lease is not None and \
+                            int(req.get("token", -1)) != lease["token"]:
+                        self._reply(409, {"error": "stale_token"})
+                        return
+                    srv.router.map.adopt_epoch(srv.shard,
+                                               int(req["epoch"]))
+                    self._reply(200, {"epoch": srv._current_epoch()})
+                elif p == "/fleet/promote":
+                    self._reply(200, srv.promote(req))
+                elif p == "/fleet/release_lease":
+                    with srv._mu:
+                        lease = srv._lease
+                        srv._lease = None
+                    if lease is not None and srv.coordinator is not None:
+                        srv.coordinator.release(srv.shard, srv.holder,
+                                                lease["token"])
+                    self._reply(200, {"released": lease is not None})
+                elif p == "/fleet/flush":
+                    srv.db.flush()
+                    self._reply(200, {"flushed": True})
+                elif p == "/fleet/shutdown":
+                    self._reply(200, {"stopping": True})
+                    srv.shutdown_requested.set()
+                elif p == "/replication/pull":
+                    if req.get("spans"):
+                        srv.shipper.accept_spans(req["spans"])
+                    frames, state = srv.shipper.frames_since(
+                        req.get("since_seq"),
+                        max_bytes=int(req.get("max_bytes", 1 << 22)))
+                    self._reply(200, {
+                        "frames_b64": [
+                            base64.b64encode(f.encode()).decode()
+                            for f in frames],
+                        "state": state,
+                    })
+                elif p == "/replication/checkpoint":
+                    from toplingdb_tpu.utilities.checkpoint import (
+                        create_checkpoint,
+                    )
+
+                    create_checkpoint(srv.db, req["dest"])
+                    self._reply(200, {"dest": req["dest"]})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+        return Handler
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter: the multi-process front door
+# ---------------------------------------------------------------------------
+
+
+class FleetRouter:
+    """Routes keys to ShardServer processes by a cached, lease-validated
+    shard map. Fail-closed: if the coordinator has been unreachable for
+    longer than `map_lease` seconds, writes raise Busy rather than
+    routing on possibly-stale topology (the soak's partition scenario).
+    Stale-epoch 409s from servers trigger refresh + bounded retry and
+    tick `shard.token.rejects` — parity with the in-process router."""
+
+    def __init__(self, coordinator, *, statistics=None,
+                 map_lease: float = 3.0, request_timeout: float = 10.0,
+                 write_deadline: float = 15.0,
+                 options: DcompactOptions | None = None):
+        self.coordinator = coordinator
+        self.stats = statistics
+        self.map_lease = map_lease
+        self.request_timeout = request_timeout
+        self.write_deadline = write_deadline
+        self.options = options or DcompactOptions(
+            max_attempts=3, backoff_base=0.05,
+            attempt_timeout=request_timeout)
+        self._mu = ccy.Lock("fleet.FleetRouter._mu")
+        self.map: ShardMap | None = None
+        self.placement: dict[str, str] = {}
+        self._synced_at = 0.0
+        self.refresh()
+
+    def _tick(self, name: str, n: int = 1) -> None:
+        if self.stats is not None:
+            self.stats.record_tick(name, n)
+
+    def refresh(self) -> None:
+        doc = self.coordinator.get_map()
+        if not doc.get("map"):
+            raise IOError_("coordinator has no shard map installed")
+        m = ShardMap.from_config(doc["map"])
+        with self._mu:
+            self.map = m
+            self.placement = dict(doc.get("placement", {}))
+            self._synced_at = time.monotonic()
+        self._tick(stats_mod.FLEET_MAP_REFRESHES)
+
+    def _ensure_fresh(self) -> None:
+        with self._mu:
+            age = time.monotonic() - self._synced_at
+            stale = self.map is None or age > self.map_lease
+        if not stale:
+            return
+        try:
+            self.refresh()
+        except (IOError_, OSError) as e:
+            self._tick(stats_mod.FLEET_WRITE_REJECTS)
+            raise Busy(
+                f"shard map lease expired ({age:.1f}s > "
+                f"{self.map_lease}s) and the coordinator is "
+                f"unreachable: {e}") from e
+
+    def _route(self, key: bytes) -> tuple[Shard, str]:
+        with self._mu:
+            shard = self.map.shard_for(key)
+            url = self.placement.get(shard.name)
+        if url is None:
+            raise IOError_(f"no placement for shard {shard.name!r}")
+        return shard, url
+
+    def _server_post(self, url: str, path: str, body: dict,
+                     timeout: float | None = None) -> dict:
+        try:
+            return _http_json(url, path, body,
+                              timeout=timeout or self.request_timeout)
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except ValueError:
+                payload = {}
+            if e.code == 409:
+                raise _StaleEpoch(payload.get("error", "stale_epoch"),
+                                  payload.get("epoch")) from e
+            if e.code == 503:
+                raise _Unavailable(payload.get("error", "busy")) from e
+            raise IOError_(
+                f"shard server {url}{path}: HTTP {e.code}") from e
+        except (OSError, http.client.HTTPException) as e:
+            # HTTPException covers a peer killed MID-response
+            # (IncompleteRead): same retryable class as a refused connect.
+            raise IOError_(f"shard server {url}{path}: {e}") from e
+
+    # -- writes -----------------------------------------------------------
+
+    def put(self, key: bytes, value: bytes):
+        from toplingdb_tpu.db.write_batch import WriteBatch
+
+        b = WriteBatch()
+        b.put(key, value)
+        return self._write_routed(key, b)
+
+    def delete(self, key: bytes):
+        from toplingdb_tpu.db.write_batch import WriteBatch
+
+        b = WriteBatch()
+        b.delete(key)
+        return self._write_routed(key, b)
+
+    def _write_routed(self, key: bytes, batch):
+        self._ensure_fresh()
+        shard, _url = self._route(key)
+        return self.write(batch, shard=shard.name)
+
+    def write(self, batch, shard: str | None = None):
+        """Send a (pre-bucketed) WriteBatch to `shard`'s primary. The
+        retry loop converges through topology changes: 409 → the epoch
+        moved (refresh, restamp, retry); 503 → fenced or lease-lapsed
+        (cutover or failover in progress — back off and retry); network
+        error → the primary may have died (refresh picks up the
+        respawned/promoted placement)."""
+        from toplingdb_tpu.sharding.router import ShardToken
+
+        if shard is None:
+            raise NotSupported(
+                "FleetRouter.write routes pre-bucketed batches; "
+                "use put()/delete() for by-key routing")
+        payload_b64 = base64.b64encode(batch.data()).decode()
+        deadline = time.monotonic() + self.write_deadline
+        delay = 0.05
+        while True:
+            self._ensure_fresh()
+            with self._mu:
+                epoch = self.map.epoch_of(shard)
+                url = self.placement.get(shard)
+            if url is None:
+                raise IOError_(f"no placement for shard {shard!r}")
+            try:
+                out = self._server_post(url, "/fleet/write", {
+                    "epoch": epoch, "batch_b64": payload_b64})
+                self._tick(stats_mod.SHARD_ROUTED_WRITES)
+                return [ShardToken(shard=shard, epoch=int(out["epoch"]),
+                                   seq=int(out["seq"]))]
+            except _StaleEpoch as e:
+                self._tick(stats_mod.SHARD_TOKEN_REJECTS)
+                err: Busy = e
+            except (_Unavailable, IOError_) as e:
+                err = e
+            if time.monotonic() > deadline:
+                raise Busy(
+                    f"write to shard {shard!r} did not converge within "
+                    f"{self.write_deadline}s: {err}") from err
+            time.sleep(delay)
+            delay = min(delay * 2, 0.5)
+            try:
+                self.refresh()
+            except (IOError_, OSError) as e2:
+                _errors.swallow(reason="fleet-write-refresh-miss", exc=e2)
+
+    # -- reads ------------------------------------------------------------
+
+    def get(self, key: bytes):
+        self._ensure_fresh()
+        deadline = time.monotonic() + self.write_deadline
+        while True:
+            shard, url = self._route(key)
+            try:
+                out = self._server_post(url, "/fleet/get", {
+                    "key_b64": base64.b64encode(key).decode()})
+                self._tick(stats_mod.SHARD_ROUTED_READS)
+                v = out.get("value_b64")
+                return base64.b64decode(v) if v is not None else None
+            except (_Unavailable, IOError_) as e:
+                if time.monotonic() > deadline:
+                    raise Busy(f"read of {key!r} did not converge: "
+                               f"{e}") from e
+                time.sleep(0.05)
+                try:
+                    self.refresh()
+                except (IOError_, OSError) as e2:
+                    _errors.swallow(reason="fleet-read-refresh-miss",
+                                    exc=e2)
+
+    def _shard_post(self, shard: str, path: str, body: dict) -> dict:
+        """POST to `shard`'s current placement with refresh-and-retry on
+        transport errors — a migrated/promoted shard's old address gives
+        connection-refused until the next refresh picks up the move."""
+        deadline = time.monotonic() + self.write_deadline
+        while True:
+            with self._mu:
+                url = self.placement.get(shard)
+            try:
+                if url is None:
+                    raise IOError_(f"no placement for shard {shard!r}")
+                return self._server_post(url, path, body)
+            except (_Unavailable, IOError_) as e:
+                if time.monotonic() > deadline:
+                    raise Busy(f"shard {shard!r} {path} did not "
+                               f"converge: {e}") from e
+                time.sleep(0.05)
+                try:
+                    self.refresh()
+                except (IOError_, OSError) as e2:
+                    _errors.swallow(reason="fleet-shard-refresh-miss",
+                                    exc=e2)
+
+    def scan(self, begin: bytes | None = None, end: bytes | None = None,
+             page: int = 5000):
+        """Ordered iteration across every shard process (merged-oracle
+        parity checks): shards tile the keyspace, so chaining per-shard
+        paged scans yields each live key exactly once, in order."""
+        self._ensure_fresh()
+        with self._mu:
+            shards = list(self.map.shards)
+        for s in shards:
+            clipped = s.clip(begin, end)
+            if clipped is None:
+                continue
+            lo, hi = clipped
+            while True:
+                out = self._shard_post(s.name, "/fleet/scan", {
+                    "begin_b64":
+                        base64.b64encode(lo).decode() if lo else None,
+                    "end_b64":
+                        base64.b64encode(hi).decode() if hi else None,
+                    "limit": page,
+                })
+                rows = out.get("rows", [])
+                for k64, v64 in rows:
+                    yield base64.b64decode(k64), base64.b64decode(v64)
+                if not out.get("truncated"):
+                    break
+                lo = base64.b64decode(rows[-1][0]) + b"\x00"
+
+    def status(self) -> dict:
+        with self._mu:
+            age = time.monotonic() - self._synced_at
+            return {
+                "map_version": self.map.version if self.map else 0,
+                "map_age_sec": round(age, 3),
+                "map_lease_sec": self.map_lease,
+                "placement": dict(self.placement),
+            }
+
+    def close(self) -> None:
+        pass  # no background threads: freshness is checked per request
+
+
+# ---------------------------------------------------------------------------
+# FleetSupervisor: process supervision
+# ---------------------------------------------------------------------------
+
+
+class _Member:
+    """One supervised ShardServer process."""
+
+    def __init__(self, holder: str, shard: str, path: str, port: int,
+                 role: str, proc: subprocess.Popen, cmd: list[str],
+                 source_url: str | None = None):
+        self.holder = holder
+        self.shard = shard
+        self.path = path
+        self.port = port
+        self.role = role
+        self.proc = proc
+        self.cmd = cmd
+        self.source_url = source_url
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class FleetSupervisor:
+    """Spawns and watches the fleet's processes. The supervisor is the
+    failure DETECTOR (waitpid + /health probes); the coordinator stays
+    the failure ARBITER — every ownership change goes through its
+    fencing tokens, so a confused supervisor cannot create two
+    primaries."""
+
+    def __init__(self, coordinator_url: str, *, statistics=None,
+                 python: str = sys.executable,
+                 lease_ttl: float = DEFAULT_LEASE_TTL):
+        self.coordinator_url = coordinator_url
+        self.coordinator = LeaseClient(coordinator_url)
+        self.stats = statistics
+        self.python = python
+        self.lease_ttl = lease_ttl
+        self._mu = ccy.Lock("fleet.FleetSupervisor._mu")
+        self.members: dict[str, _Member] = {}
+        self._seq = 0
+
+    def _tick(self, name: str) -> None:
+        if self.stats is not None:
+            self.stats.record_tick(name)
+
+    @staticmethod
+    def _proc_env() -> dict:
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    @staticmethod
+    def start_coordinator(log_path: str, port: int = 0,
+                          ttl: float = DEFAULT_LEASE_TTL,
+                          grace: float = 1.0,
+                          python: str = sys.executable
+                          ) -> tuple[subprocess.Popen, str]:
+        """Spawn the lease-coordinator process; returns (proc, url)."""
+        cmd = [python, "-m", "toplingdb_tpu.sharding.lease",
+               "--log", log_path, "--port", str(port),
+               "--ttl", str(ttl), "--grace", str(grace)]
+        proc = subprocess.Popen(cmd, env=FleetSupervisor._proc_env(),
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+        line = proc.stdout.readline().decode().strip()
+        if not line.startswith("READY "):
+            proc.kill()
+            raise IOError_(f"coordinator failed to start: {line!r}")
+        return proc, f"http://127.0.0.1:{int(line.split()[1])}"
+
+    def spawn_server(self, shard: str, path: str, port: int = 0, *,
+                     role: str = "primary", source_url: str | None = None,
+                     holder: str | None = None,
+                     wait_ready: float = 30.0) -> _Member:
+        with self._mu:
+            self._seq += 1
+            holder = holder or f"{shard}-p{self._seq}"
+        cmd = [self.python, "-m", "toplingdb_tpu.sharding.fleet",
+               "--shard", shard, "--path", path, "--port", str(port),
+               "--coordinator", self.coordinator_url, "--role", role,
+               "--holder", holder, "--ttl", str(self.lease_ttl)]
+        if source_url:
+            cmd += ["--source", source_url]
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        logf = open(path + ".log", "ab")  # noqa: SIM115 - process log
+        proc = subprocess.Popen(cmd, env=self._proc_env(),
+                                stdout=subprocess.PIPE, stderr=logf)
+        logf.close()  # the child inherited the descriptor
+        line = proc.stdout.readline().decode().strip()
+        if not line.startswith("READY "):
+            proc.kill()
+            raise IOError_(
+                f"shard server {holder} failed to start: {line!r} "
+                f"(see {path}.log)")
+        real_port = int(line.split()[1])
+        m = _Member(holder, shard, path, real_port, role, proc, cmd,
+                    source_url)
+        self._wait_healthy(m, timeout=wait_ready)
+        with self._mu:
+            self.members[holder] = m
+        return m
+
+    @staticmethod
+    def _wait_healthy(m: _Member, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                doc = _http_json(m.url, "/health", timeout=2.0)
+                if doc.get("ok"):
+                    return
+            except (OSError, http.client.HTTPException) as e:
+                if not m.alive():
+                    raise IOError_(
+                        f"{m.holder} died during startup "
+                        f"(see {m.path}.log)") from e
+            if time.monotonic() > deadline:
+                raise IOError_(f"{m.holder} not healthy after {timeout}s")
+            time.sleep(0.05)
+
+    # -- liveness + failover ----------------------------------------------
+
+    def poll(self) -> dict:
+        """One supervision pass: process liveness + /health probes.
+        Returns {holder: "ok" | "dead" | "unhealthy"}."""
+        out = {}
+        with self._mu:
+            members = list(self.members.values())
+        for m in members:
+            if not m.alive():
+                out[m.holder] = "dead"
+                continue
+            try:
+                doc = _http_json(m.url, "/health", timeout=2.0)
+                out[m.holder] = "ok" if doc.get("ok") else "unhealthy"
+            except (OSError, http.client.HTTPException):
+                out[m.holder] = "unhealthy"
+        return out
+
+    def handle_death(self, holder: str) -> _Member:
+        """Failover for a dead primary: promote its follower if one is
+        attached, else respawn on the same data directory (WAL recovery
+        — kill -9 loses nothing acked). Either path goes through the
+        coordinator: promotion bumps the epoch + issues a fresh fencing
+        token; a respawn re-acquires a lease (sitting out the dead
+        process's expiry + grace)."""
+        with self._mu:
+            m = self.members.pop(holder)
+            follower = next(
+                (f for f in self.members.values()
+                 if f.shard == m.shard and f.role == "follower"), None)
+        if m.alive():
+            m.proc.kill()
+            m.proc.wait()
+        if follower is not None:
+            return self.promote(follower.holder)
+        self._tick(stats_mod.FLEET_RESTARTS)
+        return self.spawn_server(m.shard, m.path, 0, role="primary",
+                                 holder=None)
+
+    def promote(self, follower_holder: str) -> _Member:
+        """Follower → primary through the coordinator's reassign (the
+        dead holder's lease is force-revoked — the supervisor positively
+        observed the death — and the epoch bump fences stragglers)."""
+        sp = _tm.span("fleet.promote")
+        with self._mu:
+            m = self.members[follower_holder]
+        grant = self.coordinator.reassign(m.shard, m.holder, force=True,
+                                          url=m.url, ttl=self.lease_ttl)
+        _http_json(m.url, "/fleet/promote", grant, timeout=30.0)
+        with self._mu:
+            m.role = "primary"
+        self._tick(stats_mod.FLEET_PROMOTIONS)
+        sp.finish()
+        return m
+
+    # -- migration (cross-process) ----------------------------------------
+
+    def migrate(self, shard: str, dest_path: str, *,
+                catchup_timeout: float = 30.0,
+                fault_hook=None) -> _Member:
+        """Move `shard` to a new process: bootstrap a follower process
+        off the source's /replication seam, catch up, fence + final
+        drain, then hand ownership over through the coordinator (the
+        source surrenders its lease; the grant to the dest bumps the
+        epoch). The source stays authoritative until that grant: a crash
+        anywhere before it is recovered by `recover_migration` with zero
+        lost keys. `fault_hook(phase)` is the chaos seam."""
+        sp = _tm.span("fleet.migrate")
+        hook = fault_hook or (lambda phase: None)
+        with self._mu:
+            src = next(m for m in self.members.values()
+                       if m.shard == shard and m.role == "primary")
+        hook("bootstrap")
+        dest = self.spawn_server(shard, dest_path, 0, role="follower",
+                                 source_url=src.url)
+        try:
+            hook("catchup")
+            self._await_catchup(src, dest, catchup_timeout)
+            hook("fence")
+            _http_json(src.url, "/fleet/fence", {"drain_timeout": 10.0},
+                       timeout=30.0)
+            self._await_catchup(src, dest, catchup_timeout)
+            hook("cutover")
+            _http_json(src.url, "/fleet/release_lease", {}, timeout=10.0)
+            grant = self.coordinator.reassign(shard, dest.holder,
+                                              url=dest.url,
+                                              ttl=self.lease_ttl)
+            _http_json(dest.url, "/fleet/promote", grant, timeout=30.0)
+            with self._mu:
+                dest.role = "primary"
+        except BaseException:
+            # Source is still authoritative (ownership never moved):
+            # tear the half-built dest down and restore the source.
+            self._abort_migration(src, dest)
+            raise
+        self.retire(src.holder)
+        sp.finish()
+        return dest
+
+    @staticmethod
+    def _await_catchup(src: _Member, dest: _Member,
+                       timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            s = _http_json(src.url, "/fleet/status", timeout=5.0)
+            d = _http_json(dest.url, "/fleet/status", timeout=5.0)
+            if d.get("applied_seq", -1) >= s.get("applied_seq", 0):
+                return
+            if time.monotonic() > deadline:
+                raise Busy(
+                    f"migration catch-up stuck: dest "
+                    f"{d.get('applied_seq')} < src {s.get('applied_seq')}")
+            time.sleep(0.05)
+
+    def _abort_migration(self, src: _Member, dest: _Member) -> None:
+        with self._mu:
+            self.members.pop(dest.holder, None)
+        if dest.alive():
+            dest.proc.kill()
+            dest.proc.wait()
+        shutil.rmtree(dest.path, ignore_errors=True)
+        if src.alive():
+            try:
+                _http_json(src.url, "/fleet/recover", {}, timeout=10.0)
+            except OSError as e:
+                _errors.swallow(reason="fleet-migration-abort-recover",
+                                exc=e)
+
+    def recover_migration(self, shard: str) -> _Member:
+        """Recovery after a kill -9 mid-migration: respawn the source if
+        the crash took it down, invoke ShardMigration.recover ACROSS the
+        process boundary (unfence; the source never stopped being the
+        owner), and discard any half-bootstrapped dest follower."""
+        with self._mu:
+            src = next((m for m in self.members.values()
+                        if m.shard == shard and m.role == "primary"), None)
+            dests = [m for m in self.members.values()
+                     if m.shard == shard and m.role == "follower"]
+        for d in dests:
+            with self._mu:
+                self.members.pop(d.holder, None)
+            if d.alive():
+                d.proc.kill()
+                d.proc.wait()
+            shutil.rmtree(d.path, ignore_errors=True)
+        if src is None:
+            raise Busy(f"no primary member recorded for {shard!r}")
+        if not src.alive():
+            with self._mu:
+                self.members.pop(src.holder, None)
+            self._tick(stats_mod.FLEET_RESTARTS)
+            src = self.spawn_server(shard, src.path, 0, role="primary")
+        _http_json(src.url, "/fleet/recover", {}, timeout=10.0)
+        return src
+
+    # -- teardown ---------------------------------------------------------
+
+    def retire(self, holder: str, timeout: float = 10.0) -> None:
+        """Graceful stop (SIGTERM → fence/drain/flush/close) with a
+        kill -9 escalation if the process does not exit in time."""
+        with self._mu:
+            m = self.members.pop(holder, None)
+        if m is None or not m.alive():
+            return
+        m.proc.terminate()
+        try:
+            m.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            _errors.swallow(reason="fleet-retire-sigterm-timeout", exc=e)
+            m.proc.kill()
+            m.proc.wait()
+
+    def stop_all(self, timeout: float = 10.0) -> None:
+        with self._mu:
+            holders = list(self.members)
+        for h in holders:
+            self.retire(h, timeout=timeout)
+
+    def status(self) -> dict:
+        with self._mu:
+            members = list(self.members.values())
+        rows = []
+        for m in members:
+            row = {"holder": m.holder, "shard": m.shard, "role": m.role,
+                   "url": m.url, "pid": m.proc.pid,
+                   "alive": m.alive()}
+            try:
+                row.update(_http_json(m.url, "/fleet/status", timeout=2.0))
+            except OSError as e:
+                row["error"] = str(e)[:120]
+            rows.append(row)
+        return {"members": rows}
+
+
+# ---------------------------------------------------------------------------
+# Process entry point: python -m toplingdb_tpu.sharding.fleet ...
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shard-server")
+    ap.add_argument("--shard", required=True)
+    ap.add_argument("--path", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--coordinator", default=None,
+                    help="lease coordinator base URL")
+    ap.add_argument("--role", choices=["primary", "follower"],
+                    default="primary")
+    ap.add_argument("--source", default=None,
+                    help="primary URL to tail (follower role)")
+    ap.add_argument("--holder", default=None)
+    ap.add_argument("--ttl", type=float, default=DEFAULT_LEASE_TTL)
+    args = ap.parse_args(argv)
+
+    from toplingdb_tpu.utils.statistics import Statistics
+
+    coordinator = LeaseClient(args.coordinator) if args.coordinator else None
+    server = ShardServer(args.shard, args.path, coordinator=coordinator,
+                         role=args.role, source_url=args.source,
+                         holder=args.holder, lease_ttl=args.ttl,
+                         statistics=Statistics())
+
+    def _term(signum, frame):
+        server.shutdown_requested.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    port = server.start(args.port, host=args.host)
+    print(f"READY {port}", flush=True)
+    server.shutdown_requested.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

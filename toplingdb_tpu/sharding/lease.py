@@ -1,0 +1,563 @@
+"""Lease-based shard-map consensus: the fleet's single coordinator.
+
+The multi-process fleet (sharding/fleet.py) needs every router and every
+shard server to agree on WHO owns each shard and under WHICH epoch —
+during splits, merges, migrations and crash-promotions. Full Paxos/Raft
+is overkill for one small map; the reference deployment runs exactly
+this shape: a single lightweight metadata coordinator whose state is a
+durable log, with *leases + fencing tokens* carrying the safety story:
+
+  - Every shard primary holds a time-bounded lease stamped with a
+    monotonically increasing **fencing token**. Tokens are never reused,
+    survive coordinator restarts (the grant is fsynced before it is
+    acked) and strictly order ownership: any request carrying an older
+    token than the current grant is rejected.
+  - Every shard-map mutation is an **epoch CAS**: the caller presents
+    the map version it read; a concurrent mutation wins and the loser
+    retries against the fresh map. Epochs themselves are allocated by
+    the map (never reused), so a router that routed under a pre-cutover
+    map is rejected by the shard server's epoch check — the same
+    `shard.token.rejects` contract the in-process plane proves.
+  - Routers cache the map under a read lease: a router that cannot
+    re-validate its map within the lease window fails writes CLOSED
+    (Busy) instead of routing on possibly-stale topology.
+  - Expiry honours a **clock-skew grace window**: a holder may renew
+    slightly past nominal expiry (its clock may run behind), but a NEW
+    holder is only granted after expiry + grace — the two windows
+    cannot overlap, so two primaries can never both believe they hold
+    the shard.
+
+Durability: an append-only JSONL log, fsynced per mutation, replayed on
+restart. Map records are full snapshots (the map is small), lease
+records are deltas; `next fencing token = max(seen) + 1` keeps token
+monotonicity across restarts, which is what makes double grants
+impossible even when the coordinator loses its memory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from toplingdb_tpu.compaction.resilience import DcompactOptions
+from toplingdb_tpu.sharding.shard_map import ShardMap
+from toplingdb_tpu.utils import concurrency as ccy
+from toplingdb_tpu.utils import statistics as stats_mod
+from toplingdb_tpu.utils.status import Busy, IOError_, InvalidArgument
+
+DEFAULT_TTL = 5.0      # seconds a grant/renewal is valid
+DEFAULT_GRACE = 1.0    # clock-skew allowance around expiry
+
+
+class LeaseConflict(Busy):
+    """Lease or CAS refused: held by another holder, stale fencing
+    token, expired lease, or a lost map-version CAS."""
+
+
+class LeaseCoordinator:
+    """The fleet's metadata authority: shard map + placement + leases,
+    all behind one durable log. Thread-safe; single-writer by design
+    (one coordinator process per fleet)."""
+
+    def __init__(self, log_path: str, *, default_ttl: float = DEFAULT_TTL,
+                 grace: float = DEFAULT_GRACE, clock=time.time,
+                 statistics=None):
+        self.log_path = log_path
+        self.default_ttl = default_ttl
+        self.grace = grace
+        self._clock = clock
+        self.stats = statistics
+        self._mu = ccy.RLock("lease.LeaseCoordinator._mu")
+        self.map: ShardMap | None = None
+        self.placement: dict[str, str] = {}
+        # shard -> {"holder", "token", "expires", "ttl"}
+        self.leases: dict[str, dict] = {}
+        self._next_token = 1
+        self._log = None
+        self._replay()
+        self._log = open(self.log_path, "ab")  # noqa: SIM115 - held open
+
+    # -- durability -------------------------------------------------------
+
+    def _replay(self) -> None:
+        """Fold the log back into memory. Absolute expiry times survive
+        the restart, so an unexpired grant is still binding on the
+        restarted coordinator — the double-grant-impossibility proof."""
+        if not os.path.exists(self.log_path):
+            return
+        with open(self.log_path, "rb") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail from a crash mid-append: ignore rest
+                self._apply(rec)
+
+    def _apply(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "map":
+            if rec.get("cfg") is not None:
+                self.map = ShardMap.from_config(rec["cfg"])
+            self.placement = dict(rec.get("placement", {}))
+        elif op == "grant":
+            self.leases[rec["shard"]] = {
+                "holder": rec["holder"], "token": int(rec["token"]),
+                "expires": float(rec["expires"]),
+                "ttl": float(rec.get("ttl", self.default_ttl)),
+            }
+            self._next_token = max(self._next_token, int(rec["token"]) + 1)
+        elif op == "renew":
+            l = self.leases.get(rec["shard"])
+            if l is not None and l["token"] == int(rec["token"]):
+                l["expires"] = float(rec["expires"])
+        elif op == "release":
+            l = self.leases.get(rec["shard"])
+            if l is not None and l["token"] == int(rec["token"]):
+                del self.leases[rec["shard"]]
+
+    def _append(self, rec: dict) -> None:
+        """fsync-before-ack: a grant that was ever visible to a caller
+        is in the log, so a restarted coordinator still honours it."""
+        data = json.dumps(rec, separators=(",", ":")).encode() + b"\n"
+        self._log.write(data)
+        self._log.flush()
+        os.fsync(self._log.fileno())
+
+    def _tick(self, name: str) -> None:
+        if self.stats is not None:
+            self.stats.record_tick(name)
+
+    # -- shard map: epoch CAS ---------------------------------------------
+
+    def install_map(self, cfg: dict, placement: dict | None = None) -> dict:
+        """Bootstrap the fleet's first map (version CAS against 0)."""
+        return self.cas_map(0, cfg, placement)
+
+    def cas_map(self, expected_version: int, cfg: dict,
+                placement: dict | None = None) -> dict:
+        with self._mu:
+            cur = self.map.version if self.map is not None else 0
+            if int(expected_version) != cur:
+                self._tick(stats_mod.LEASE_CAS_CONFLICTS)
+                raise LeaseConflict(
+                    f"map CAS lost: expected version {expected_version}, "
+                    f"coordinator has {cur}")
+            m = ShardMap.from_config(cfg)
+            m.validate()
+            m.version = max(m.version, cur + 1)
+            new_placement = dict(placement if placement is not None
+                                 else self.placement)
+            self._append({"op": "map", "cfg": m.to_config(),
+                          "placement": new_placement})
+            self.map = m
+            self.placement = new_placement
+            return {"version": m.version}
+
+    def get_map(self) -> dict:
+        with self._mu:
+            if self.map is None:
+                return {"map": None, "placement": {}, "version": 0}
+            return {"map": self.map.to_config(),
+                    "placement": dict(self.placement),
+                    "version": self.map.version}
+
+    def bump_epoch(self, shard: str, token: int) -> dict:
+        """Cutover: a fresh epoch for `shard`, fenced by the holder's
+        token so a deposed primary cannot bump behind the new one."""
+        with self._mu:
+            self._check_token(shard, token)
+            epoch = self.map.bump_epoch(shard)
+            self._append({"op": "map", "cfg": self.map.to_config(),
+                          "placement": dict(self.placement)})
+            return {"epoch": epoch, "version": self.map.version}
+
+    # -- leases -----------------------------------------------------------
+
+    def _check_token(self, shard: str, token: int) -> dict:
+        l = self.leases.get(shard)
+        if l is None or l["token"] != int(token):
+            self._tick(stats_mod.LEASE_REJECTS)
+            raise LeaseConflict(
+                f"stale fencing token {token} for {shard!r} "
+                f"(current: {l['token'] if l else None})")
+        return l
+
+    def acquire(self, shard: str, holder: str,
+                ttl: float | None = None) -> dict:
+        """Grant `shard` to `holder` with a fresh fencing token. Refused
+        while another holder's lease could still be live (expiry +
+        grace). The same holder may re-acquire at any time (it gets a
+        NEW, higher token — its old one is thereby fenced)."""
+        ttl = float(ttl or self.default_ttl)
+        with self._mu:
+            if self.map is not None and shard not in set(self.map.names()):
+                raise InvalidArgument(f"unknown shard {shard!r}")
+            now = self._clock()
+            l = self.leases.get(shard)
+            if l is not None and l["holder"] != holder:
+                if now < l["expires"] + self.grace:
+                    self._tick(stats_mod.LEASE_REJECTS)
+                    raise LeaseConflict(
+                        f"shard {shard!r} leased to {l['holder']!r} until "
+                        f"{l['expires']:.3f} (+{self.grace}s grace)")
+                self._tick(stats_mod.LEASE_EXPIRIES)
+            return self._grant(shard, holder, ttl, now)
+
+    def _grant(self, shard: str, holder: str, ttl: float,
+               now: float) -> dict:
+        token = self._next_token
+        self._next_token += 1
+        expires = now + ttl
+        self._append({"op": "grant", "shard": shard, "holder": holder,
+                      "token": token, "expires": expires, "ttl": ttl})
+        self.leases[shard] = {"holder": holder, "token": token,
+                              "expires": expires, "ttl": ttl}
+        self._tick(stats_mod.LEASE_GRANTS)
+        epoch = self.map.epoch_of(shard) if self.map is not None else 0
+        return {"shard": shard, "holder": holder, "token": token,
+                "expires": expires, "ttl": ttl, "epoch": epoch}
+
+    def renew(self, shard: str, holder: str, token: int,
+              ttl: float | None = None) -> dict:
+        """Extend a live lease. The holder's clock may lag: renewals are
+        honoured up to `grace` past nominal expiry, which is exactly the
+        window a competing acquire must also sit out."""
+        ttl = float(ttl or self.default_ttl)
+        with self._mu:
+            now = self._clock()
+            l = self._check_token(shard, token)
+            if l["holder"] != holder:
+                self._tick(stats_mod.LEASE_REJECTS)
+                raise LeaseConflict(
+                    f"lease for {shard!r} held by {l['holder']!r}, "
+                    f"not {holder!r}")
+            if now >= l["expires"] + self.grace:
+                self._tick(stats_mod.LEASE_EXPIRIES)
+                self._tick(stats_mod.LEASE_REJECTS)
+                raise LeaseConflict(
+                    f"lease for {shard!r} expired at {l['expires']:.3f} "
+                    f"(now {now:.3f}, grace {self.grace}s)")
+            expires = now + ttl
+            self._append({"op": "renew", "shard": shard, "token": token,
+                          "expires": expires})
+            l["expires"] = expires
+            self._tick(stats_mod.LEASE_RENEWALS)
+            epoch = self.map.epoch_of(shard) if self.map is not None else 0
+            return {"shard": shard, "holder": holder, "token": token,
+                    "expires": expires, "ttl": ttl, "epoch": epoch}
+
+    def release(self, shard: str, holder: str, token: int) -> dict:
+        with self._mu:
+            l = self._check_token(shard, token)
+            if l["holder"] != holder:
+                self._tick(stats_mod.LEASE_REJECTS)
+                raise LeaseConflict(
+                    f"lease for {shard!r} held by {l['holder']!r}")
+            self._append({"op": "release", "shard": shard, "token": token})
+            del self.leases[shard]
+            return {"shard": shard, "released": True}
+
+    def reassign(self, shard: str, holder: str, *, token: int | None = None,
+                 url: str | None = None, force: bool = False,
+                 ttl: float | None = None) -> dict:
+        """Move ownership of `shard` to `holder` and bump its epoch — the
+        promotion/cutover primitive. Three admission paths:
+          - cooperative: `token` is the CURRENT holder's fencing token
+            (migration cutover — the source surrenders);
+          - supervised: `force=True` when the supervisor has positively
+            observed the holder's death (waitpid, kill -9);
+          - expiry: otherwise the old lease must be past expiry + grace.
+        The epoch bump is what fences stragglers: writes routed under
+        the old epoch are rejected by the new primary's epoch check."""
+        ttl = float(ttl or self.default_ttl)
+        with self._mu:
+            now = self._clock()
+            l = self.leases.get(shard)
+            if l is not None and token is not None:
+                self._check_token(shard, token)
+            elif l is not None and not force \
+                    and now < l["expires"] + self.grace:
+                self._tick(stats_mod.LEASE_REJECTS)
+                raise LeaseConflict(
+                    f"shard {shard!r} leased to {l['holder']!r} until "
+                    f"{l['expires']:.3f}; need its token, its expiry, "
+                    f"or force")
+            epoch = None
+            if self.map is not None and shard in set(self.map.names()):
+                epoch = self.map.bump_epoch(shard)
+            if url is not None:
+                self.placement[shard] = url
+            self._append({"op": "map",
+                          "cfg": self.map.to_config()
+                          if self.map is not None else None,
+                          "placement": dict(self.placement)})
+            out = self._grant(shard, holder, ttl, now)
+            if epoch is not None:
+                out["epoch"] = epoch
+            out["version"] = self.map.version if self.map is not None else 0
+            return out
+
+    def status(self) -> dict:
+        with self._mu:
+            now = self._clock()
+            return {
+                "map_version": self.map.version if self.map else 0,
+                "n_shards": len(self.map.shards) if self.map else 0,
+                "next_token": self._next_token,
+                "placement": dict(self.placement),
+                "leases": {
+                    s: {**l, "remaining": round(l["expires"] - now, 3)}
+                    for s, l in self.leases.items()
+                },
+            }
+
+    def close(self) -> None:
+        with self._mu:
+            if self._log is not None:
+                self._log.close()
+                self._log = None
+
+
+# ---------------------------------------------------------------------------
+# HTTP service (the dcompact_service / ReplicationServer transport shape)
+# ---------------------------------------------------------------------------
+
+
+class LeaseCoordinatorServer:
+    """The coordinator behind HTTP: POST /lease/{acquire,renew,release,
+    cas_map,bump_epoch,reassign}, GET /lease/{map,status} and /health.
+    Lease/CAS refusals answer 409 so clients can tell policy from
+    transport failure."""
+
+    def __init__(self, coordinator: LeaseCoordinator):
+        self.coordinator = coordinator
+        self._server: ThreadingHTTPServer | None = None
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        co = self.coordinator
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/lease/map":
+                    self._reply(200, co.get_map())
+                elif self.path == "/lease/status":
+                    self._reply(200, co.status())
+                elif self.path == "/health":
+                    self._reply(200, {"ok": True, "role": "coordinator"})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._reply(400, {"error": "bad json"})
+                    return
+                try:
+                    if self.path == "/lease/acquire":
+                        self._reply(200, co.acquire(
+                            req["shard"], req["holder"], req.get("ttl")))
+                    elif self.path == "/lease/renew":
+                        self._reply(200, co.renew(
+                            req["shard"], req["holder"],
+                            int(req["token"]), req.get("ttl")))
+                    elif self.path == "/lease/release":
+                        self._reply(200, co.release(
+                            req["shard"], req["holder"], int(req["token"])))
+                    elif self.path == "/lease/cas_map":
+                        self._reply(200, co.cas_map(
+                            int(req["expected_version"]), req["map"],
+                            req.get("placement")))
+                    elif self.path == "/lease/bump_epoch":
+                        self._reply(200, co.bump_epoch(
+                            req["shard"], int(req["token"])))
+                    elif self.path == "/lease/reassign":
+                        tok = req.get("token")
+                        self._reply(200, co.reassign(
+                            req["shard"], req["holder"],
+                            token=int(tok) if tok is not None else None,
+                            url=req.get("url"),
+                            force=bool(req.get("force", False)),
+                            ttl=req.get("ttl")))
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except LeaseConflict as e:
+                    self._reply(409, {"error": "lease_conflict",
+                                      "detail": str(e)})
+                except Exception as e:  # transport must answer, not die
+                    self._reply(500, {"error": repr(e)[:300]})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        ccy.spawn("lease-coordinator-server", self._server.serve_forever,
+                  owner=self, stop=self.stop)
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class LeaseClient:
+    """HTTP client for a LeaseCoordinatorServer: per-request timeouts +
+    bounded retry/backoff on transport errors (a hung coordinator must
+    not wedge a router thread), 409 mapped back to LeaseConflict (never
+    retried — a refusal is an answer). Duck-type compatible with
+    LeaseCoordinator so routers/servers take either.
+
+    `partition` is an optional env/fault_injection.PartitionGate: while
+    engaged, every call fails fast with IOError_ — the chaos soak's
+    router-partitioned-from-lease-store scenario."""
+
+    def __init__(self, url: str, *, timeout: float = 5.0,
+                 options: DcompactOptions | None = None, partition=None):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self.options = options or DcompactOptions(
+            max_attempts=3, backoff_base=0.05, attempt_timeout=timeout)
+        self.partition = partition
+
+    def _call(self, method: str, path: str, body: dict | None = None) -> dict:
+        if self.partition is not None:
+            self.partition.check(f"{method} {path}")
+        last: Exception | None = None
+        for attempt in range(1, self.options.max_attempts + 1):
+            if attempt > 1:
+                time.sleep(self.options.backoff_delay(attempt - 1))
+                if self.partition is not None:
+                    self.partition.check(f"{method} {path}")
+            try:
+                if body is None:
+                    req = urllib.request.Request(self.url + path)
+                else:
+                    req = urllib.request.Request(
+                        self.url + path, data=json.dumps(body).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST")
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                try:
+                    payload = json.loads(e.read())
+                except ValueError:
+                    payload = {}
+                if e.code == 409:
+                    raise LeaseConflict(
+                        payload.get("detail", "lease conflict")) from e
+                raise IOError_(
+                    f"coordinator {path}: HTTP {e.code} "
+                    f"{payload.get('error', '')}") from e
+            except (OSError, http.client.HTTPException) as e:
+                # a coordinator killed mid-response (IncompleteRead) is
+                # the same retryable class as a refused connect
+                last = e
+        raise IOError_(
+            f"coordinator {path} unreachable after "
+            f"{self.options.max_attempts} attempts: {last}") from last
+
+    def install_map(self, cfg, placement=None):
+        return self._call("POST", "/lease/cas_map",
+                          {"expected_version": 0, "map": cfg,
+                           "placement": placement})
+
+    def cas_map(self, expected_version, cfg, placement=None):
+        return self._call("POST", "/lease/cas_map",
+                          {"expected_version": expected_version, "map": cfg,
+                           "placement": placement})
+
+    def get_map(self):
+        return self._call("GET", "/lease/map")
+
+    def bump_epoch(self, shard, token):
+        return self._call("POST", "/lease/bump_epoch",
+                          {"shard": shard, "token": token})
+
+    def acquire(self, shard, holder, ttl=None):
+        return self._call("POST", "/lease/acquire",
+                          {"shard": shard, "holder": holder, "ttl": ttl})
+
+    def renew(self, shard, holder, token, ttl=None):
+        return self._call("POST", "/lease/renew",
+                          {"shard": shard, "holder": holder, "token": token,
+                           "ttl": ttl})
+
+    def release(self, shard, holder, token):
+        return self._call("POST", "/lease/release",
+                          {"shard": shard, "holder": holder, "token": token})
+
+    def reassign(self, shard, holder, *, token=None, url=None, force=False,
+                 ttl=None):
+        return self._call("POST", "/lease/reassign",
+                          {"shard": shard, "holder": holder, "token": token,
+                           "url": url, "force": force, "ttl": ttl})
+
+    def status(self):
+        return self._call("GET", "/lease/status")
+
+
+# ---------------------------------------------------------------------------
+# Process entry point: python -m toplingdb_tpu.sharding.lease ...
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="lease-coordinator")
+    ap.add_argument("--log", required=True, help="durable JSONL log path")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--ttl", type=float, default=DEFAULT_TTL)
+    ap.add_argument("--grace", type=float, default=DEFAULT_GRACE)
+    args = ap.parse_args(argv)
+
+    from toplingdb_tpu.utils.statistics import Statistics
+
+    co = LeaseCoordinator(args.log, default_ttl=args.ttl, grace=args.grace,
+                          statistics=Statistics())
+    srv = LeaseCoordinatorServer(co)
+    port = srv.start(args.port, host=args.host)
+    done = threading.Event()
+
+    def _term(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    print(f"READY {port}", flush=True)
+    done.wait()
+    srv.stop()
+    co.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

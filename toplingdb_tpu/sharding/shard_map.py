@@ -200,6 +200,20 @@ class ShardMap:
             self.version += 1
             return s.epoch
 
+    def adopt_epoch(self, name: str, epoch: int) -> None:
+        """Adopt a coordinator-assigned epoch (fleet cutover/promotion).
+        The map-wide allocator floor rises past it so locally allocated
+        epochs can never collide with coordinator-issued ones."""
+        with self._mu:
+            s = self.get(name)
+            if epoch < s.epoch:
+                raise InvalidArgument(
+                    f"epoch for {name!r} may not move backwards "
+                    f"({s.epoch} -> {epoch})")
+            s.epoch = epoch
+            self._next_epoch = max(self._next_epoch, epoch + 1)
+            self.version += 1
+
     def set_state(self, name: str, state: str) -> None:
         if state not in SHARD_STATES:
             raise InvalidArgument(f"unknown shard state {state!r}")
@@ -266,12 +280,18 @@ class ShardMap:
         return m
 
     def save(self, path: str, env=None) -> None:
+        """Crash-atomic: the new map is written (and fsynced) to a side
+        file, then renamed over `path` — a kill at any instant leaves
+        either the complete old map or the complete new one, never a
+        torn prefix. Readers must ignore stray `.tmp` files."""
         if env is None:
             from toplingdb_tpu.env import default_env
 
             env = default_env()
-        env.write_file(path, json.dumps(self.to_config(), indent=1).encode(),
+        tmp = path + ".tmp"
+        env.write_file(tmp, json.dumps(self.to_config(), indent=1).encode(),
                        sync=True)
+        env.rename_file(tmp, path)
 
     @staticmethod
     def load(path: str, env=None) -> "ShardMap":

@@ -1,0 +1,152 @@
+"""fleet_admin: operate the out-of-process shard fleet.
+
+    python -m toplingdb_tpu.tools.fleet_admin --coordinator URL status
+    python -m toplingdb_tpu.tools.fleet_admin --coordinator URL map
+    python -m toplingdb_tpu.tools.fleet_admin --server URL server-status
+    python -m toplingdb_tpu.tools.fleet_admin --server URL kill
+    python -m toplingdb_tpu.tools.fleet_admin --server URL fence
+    python -m toplingdb_tpu.tools.fleet_admin --server URL recover
+    python -m toplingdb_tpu.tools.fleet_admin --coordinator URL \
+        --server URL promote --shard S --holder H
+
+`status` prints the coordinator's lease table (shard, holder, fencing
+token, remaining TTL); `map` dumps the shard map + placement. Server
+commands talk to one ShardServer: `server-status` its role/epoch/lease,
+`kill` its graceful /fleet/shutdown, `fence`/`unfence` the write gate,
+`recover` the cross-process ShardMigration.recover. `promote` reassigns
+the shard's lease to the target server (force: for when the old primary
+is positively dead) and POSTs its /fleet/promote — the manual form of
+the supervisor's failover.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(url: str, body: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _fail(e) -> int:
+    if isinstance(e, urllib.error.HTTPError):
+        print(f"HTTP {e.code}: {e.read().decode()[:300]}", file=sys.stderr)
+    else:
+        print(str(e), file=sys.stderr)
+    return 1
+
+
+def cmd_status(args) -> int:
+    doc = _get(f"{args.coordinator}/lease/status")
+    print(f"map_version={doc.get('map_version')} "
+          f"shards={doc.get('n_shards')} "
+          f"next_token={doc.get('next_token')}")
+    placement = doc.get("placement", {})
+    for shard, l in sorted(doc.get("leases", {}).items()):
+        print(f"{shard}\tholder={l['holder']}\ttoken={l['token']}\t"
+              f"remaining={l.get('remaining')}s\t"
+              f"url={placement.get(shard, '?')}")
+    for shard, url in sorted(placement.items()):
+        if shard not in doc.get("leases", {}):
+            print(f"{shard}\tUNLEASED\turl={url}")
+    return 0
+
+
+def cmd_map(args) -> int:
+    print(json.dumps(_get(f"{args.coordinator}/lease/map"), indent=1))
+    return 0
+
+
+def cmd_server_status(args) -> int:
+    print(json.dumps(_get(f"{args.server}/fleet/status"), indent=1))
+    return 0
+
+
+def cmd_kill(args) -> int:
+    print(json.dumps(_post(f"{args.server}/fleet/shutdown", {})))
+    return 0
+
+
+def cmd_fence(args) -> int:
+    print(json.dumps(_post(f"{args.server}/fleet/fence", {})))
+    return 0
+
+
+def cmd_unfence(args) -> int:
+    print(json.dumps(_post(f"{args.server}/fleet/unfence", {})))
+    return 0
+
+
+def cmd_recover(args) -> int:
+    print(json.dumps(_post(f"{args.server}/fleet/recover", {})))
+    return 0
+
+
+def cmd_promote(args) -> int:
+    grant = _post(f"{args.coordinator}/lease/reassign", {
+        "shard": args.shard, "holder": args.holder,
+        "url": args.server, "force": args.force})
+    out = _post(f"{args.server}/fleet/promote", grant)
+    print(json.dumps({"grant": grant, "promoted": out}, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_admin")
+    ap.add_argument("--coordinator", default=None,
+                    help="lease coordinator base URL")
+    ap.add_argument("--server", default=None,
+                    help="shard server base URL")
+    ap.add_argument("--shard", default=None)
+    ap.add_argument("--holder", default=None,
+                    help="lease holder id for promote")
+    ap.add_argument("--force", action="store_true",
+                    help="promote even over a live lease (dead primary)")
+    ap.add_argument("command",
+                    choices=["status", "map", "server-status", "kill",
+                             "fence", "unfence", "recover", "promote"])
+    args = ap.parse_args(argv)
+    for u in ("coordinator", "server"):
+        v = getattr(args, u)
+        if v is not None:
+            setattr(args, u, v.rstrip("/"))
+    need = {
+        "status": ("coordinator",),
+        "map": ("coordinator",),
+        "server-status": ("server",),
+        "kill": ("server",),
+        "fence": ("server",),
+        "unfence": ("server",),
+        "recover": ("server",),
+        "promote": ("coordinator", "server", "shard", "holder"),
+    }[args.command]
+    missing = [f"--{n}" for n in need if getattr(args, n) is None]
+    if missing:
+        print(f"{args.command} requires {' '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    try:
+        return {"status": cmd_status, "map": cmd_map,
+                "server-status": cmd_server_status, "kill": cmd_kill,
+                "fence": cmd_fence, "unfence": cmd_unfence,
+                "recover": cmd_recover, "promote": cmd_promote,
+                }[args.command](args)
+    except Exception as e:  # noqa: BLE001 - CLI boundary
+        return _fail(e)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,367 @@
+"""fleet_soak: the multi-process chaos soak (ISSUE 16's robustness bar).
+
+Stands up a REAL fleet — a lease-coordinator process and one ShardServer
+process per shard — drives seeded concurrent writers through a
+FleetRouter, and runs the four chaos scenarios while they write:
+
+  migrate-kill   kill -9 the s0 source process exactly at the fence
+                 phase of a cross-process migration, then recover it via
+                 the supervisor (respawn + /fleet/recover across the
+                 process boundary; the half-built dest is discarded).
+  partition      cut a second router off from the lease store with an
+                 env/fault_injection.PartitionGate for longer than its
+                 map lease: every write must fail CLOSED (Busy) — the
+                 router may never route on topology it cannot re-validate.
+  coordinator    kill -9 the coordinator and restart it from its durable
+                 log on the same port: existing leases stay binding,
+                 renewals resume, and fencing tokens keep strictly
+                 increasing (double-grant impossibility across restart).
+  stale-epoch    migrate s1 for real, then replay a write stamped with
+                 the PRE-migration epoch at the new primary: it must be
+                 rejected 409 and counted (`fleet.stale.epoch.rejects`),
+                 never applied.
+
+Oracle: writers record a key only once its write is ACKED; values are a
+pure function of the key, so the ack-lost-then-retried case is
+idempotent. At the end the fleet must satisfy merged-oracle parity —
+`FleetRouter.scan()` yields exactly the acked key set, each key once
+(zero lost, zero double-served) — and every server must report zero
+writes accepted under an expired lease or stale epoch, then shut down
+cleanly (SIGTERM → fence/drain/flush/close → exit 0).
+
+    python -m toplingdb_tpu.tools.fleet_soak --dir /dev/shm/soak --fast
+    python -m toplingdb_tpu.tools.fleet_soak --dir ... --seed 7 --full
+
+Fast mode (~20s) is the tier-1 registration (tests/test_fleet.py); the
+full soak adds more keys, rounds and a second migrate-kill pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import random
+import shutil
+import signal
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from toplingdb_tpu.env.fault_injection import PartitionGate
+from toplingdb_tpu.sharding.fleet import (
+    FleetRouter,
+    FleetSupervisor,
+    _http_json,
+)
+from toplingdb_tpu.sharding.lease import LeaseClient
+from toplingdb_tpu.sharding.shard_map import ShardMap
+from toplingdb_tpu.utils import errors as _errors
+from toplingdb_tpu.utils import concurrency as ccy
+from toplingdb_tpu.utils.statistics import Statistics
+from toplingdb_tpu.utils.status import Busy, IOError_
+
+SPLIT_KEY = b"%016d" % 500_000  # digit keyspace: half to s0, half to s1
+
+
+class SoakFailure(AssertionError):
+    """A chaos invariant did not hold."""
+
+
+def _check(cond: bool, what: str) -> None:
+    if not cond:
+        raise SoakFailure(what)
+
+
+class _Writer:
+    """One seeded writer with a private key slice. A key is recorded in
+    `acked` only after a successful ack; values derive from the key, so
+    retrying an ack-lost write is idempotent."""
+
+    def __init__(self, wid: int, router: FleetRouter, seed: int,
+                 keyspace: int):
+        self.wid = wid
+        self.router = router
+        self.rng = random.Random(seed * 1000003 + wid)
+        self.keyspace = keyspace
+        self.acked: dict[bytes, bytes] = {}
+        self.rejects = 0
+        self.stop = False
+        self.error: Exception | None = None
+
+    def _one_key(self) -> bytes:
+        # Slice by writer id so oracles merge without conflicts.
+        n = self.rng.randrange(self.keyspace) * 10 + self.wid
+        return b"%016d" % n
+
+    def run(self) -> None:
+        try:
+            while not self.stop:
+                k = self._one_key()
+                v = b"v-" + k
+                try:
+                    self.router.put(k, v)
+                except (Busy, IOError_, OSError):
+                    # Fence/failover/partition in progress: the write was
+                    # refused (fail-closed) — NOT acked, NOT recorded.
+                    self.rejects += 1
+                    time.sleep(0.02)
+                    continue
+                self.acked[k] = v
+        except Exception as e:  # noqa: BLE001 - soak verdict, re-raised
+            self.error = e
+
+
+def _post_raw(url: str, path: str, body: dict):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _sync_placement(sup: FleetSupervisor) -> None:
+    doc = sup.coordinator.get_map()
+    placement = {m.shard: m.url for m in sup.members.values()
+                 if m.role == "primary"}
+    sup.coordinator.cas_map(doc["version"], doc["map"], placement)
+
+
+def _scenario_migrate_kill(sup, base_dir, log) -> None:
+    """kill -9 the s0 source at the fence phase; recover across the
+    process boundary; the shard serves again on its OLD epoch."""
+    def bomb(phase):
+        if phase == "fence":
+            src = next(m for m in sup.members.values()
+                       if m.shard == "s0" and m.role == "primary")
+            src.proc.send_signal(signal.SIGKILL)
+            src.proc.wait()
+    try:
+        sup.migrate("s0", os.path.join(base_dir, "s0-doomed"),
+                    fault_hook=bomb)
+        raise SoakFailure("migration survived kill -9 of its source")
+    except SoakFailure:
+        raise
+    except Exception as e:  # the kill lands as transport chaos
+        _errors.swallow(reason="soak-migrate-kill-expected", exc=e)
+    src = sup.recover_migration("s0")
+    _sync_placement(sup)
+    st = _http_json(src.url, "/fleet/status", timeout=10)
+    _check(not st.get("fenced", True),
+           "recovered source still fenced after /fleet/recover")
+    _check(not os.path.exists(os.path.join(base_dir, "s0-doomed")),
+           "half-built migration dest not discarded")
+    log("migrate-kill: source killed at fence, recovered, serving again")
+
+
+def _scenario_partition(co_url, stats, oracle, log) -> None:
+    """A router partitioned from the lease store past its map lease must
+    fail writes CLOSED, and heal transparently."""
+    gate = PartitionGate()
+    client = LeaseClient(co_url, timeout=2.0, partition=gate)
+    router = FleetRouter(client, statistics=stats, map_lease=0.25,
+                         write_deadline=2.0)
+    k = b"%016d" % 17  # last digit outside every writer's slice
+    router.put(k, b"pre-partition")  # healthy path first
+    gate.engage()
+    time.sleep(0.35)  # let the map lease lapse while partitioned
+    try:
+        router.put(k, b"under-partition")
+        raise SoakFailure("write routed on stale topology while "
+                          "partitioned from the lease store")
+    except Busy:
+        pass
+    _check(gate.blocked > 0, "partition gate never intercepted a call")
+    _check(stats.get_ticker_count("fleet.write.rejects") > 0,
+           "fail-closed reject not counted in fleet.write.rejects")
+    gate.heal()
+    router.put(k, b"post-partition")
+    oracle[k] = b"post-partition"
+    log(f"partition: fail-closed Busy while cut off "
+        f"({gate.blocked} calls blocked), healed")
+
+
+def _scenario_coordinator_crash(sup, cop, co_port, lease_log, ttl, log):
+    """kill -9 the coordinator; restart from its durable log on the same
+    port. Leases stay binding, tokens keep strictly increasing."""
+    before = sup.coordinator.status()
+    tok_floor = before["next_token"]
+    held = {s: l["token"] for s, l in before["leases"].items()}
+    cop.send_signal(signal.SIGKILL)
+    cop.wait()
+    cop2, url2 = FleetSupervisor.start_coordinator(
+        lease_log, port=co_port, ttl=ttl)
+    after = sup.coordinator.status()  # same port → same client works
+    _check(after["next_token"] >= tok_floor,
+           f"fencing tokens regressed across restart: "
+           f"{after['next_token']} < {tok_floor}")
+    for s, t in held.items():
+        l = after["leases"].get(s)
+        _check(l is not None and l["token"] == t,
+               f"lease for {s} not honoured after coordinator restart")
+    # Renewals must resume: wait one heartbeat period and re-read.
+    deadline = time.monotonic() + ttl * 3
+    while True:
+        cur = sup.coordinator.status()["leases"]
+        if all(cur.get(s, {}).get("remaining", -1) > 0 for s in held):
+            break
+        _check(time.monotonic() < deadline,
+               "heartbeat renewals did not resume after restart")
+        time.sleep(0.1)
+    log(f"coordinator: crashed + replayed {len(held)} leases from log, "
+        f"renewals resumed, tokens monotonic")
+    return cop2, url2
+
+
+def _scenario_stale_epoch(sup, router, log) -> None:
+    """Migrate s1 for real, then replay a write stamped with the OLD
+    epoch: the new primary must 409 it and count the reject."""
+    with router._mu:
+        old_epoch = router.map.epoch_of("s1")
+    dest = sup.migrate("s1", os.path.join(
+        os.path.dirname(sup.members[next(iter(sup.members))].path),
+        "s1-moved"))
+    _sync_placement(sup)
+    from toplingdb_tpu.db.write_batch import WriteBatch
+
+    b = WriteBatch()
+    b.put(b"%016d" % 999_999, b"stale-epoch-write")
+    try:
+        _post_raw(dest.url, "/fleet/write", {
+            "epoch": old_epoch,
+            "batch_b64": base64.b64encode(b.data()).decode()})
+        raise SoakFailure("write under a stale epoch was accepted")
+    except urllib.error.HTTPError as e:
+        _check(e.code == 409, f"stale epoch answered {e.code}, not 409")
+    st = _http_json(dest.url, "/fleet/status", timeout=10)
+    _check(st.get("stale_epoch_rejects", 0) > 0,
+           "stale-epoch reject not counted on the server")
+    _check(st["epoch"] > old_epoch, "cutover did not bump the epoch")
+    log(f"stale-epoch: migrated s1 (epoch {old_epoch} -> {st['epoch']}), "
+        f"pre-cutover write rejected 409")
+
+
+def run_soak(base_dir: str, *, seed: int = 1234, fast: bool = True,
+             log=print) -> dict:
+    ttl = 1.5 if fast else 3.0
+    keyspace = 2_000 if fast else 20_000
+    write_window = 0.5 if fast else 3.0
+    os.makedirs(base_dir, exist_ok=True)
+    lease_log = os.path.join(base_dir, "lease.jsonl")
+    stats = Statistics()
+    cop, co_url = FleetSupervisor.start_coordinator(
+        lease_log, ttl=ttl, grace=0.3)
+    co_port = int(co_url.rsplit(":", 1)[1])
+    sup = FleetSupervisor(co_url, statistics=stats, lease_ttl=ttl)
+    writers: list[_Writer] = []
+    threads = []
+    router = None
+    try:
+        m = ShardMap.from_bounds([("s0", None, SPLIT_KEY),
+                                  ("s1", SPLIT_KEY, None)])
+        sup.coordinator.install_map(m.to_config(), {})
+        for shard in ("s0", "s1"):
+            sup.spawn_server(shard, os.path.join(base_dir, shard))
+        _sync_placement(sup)
+        router = FleetRouter(sup.coordinator, statistics=stats,
+                             map_lease=ttl, write_deadline=15.0)
+        writers = [_Writer(i, router, seed, keyspace) for i in range(3)]
+        for w in writers:
+            threads.append(ccy.spawn(f"soak-writer-{w.wid}", w.run,
+                                     daemon=True))
+        time.sleep(write_window)  # steady-state traffic first
+
+        scenario_oracle: dict[bytes, bytes] = {}
+        _scenario_migrate_kill(sup, base_dir, log)
+        time.sleep(write_window)
+        _scenario_partition(co_url, stats, scenario_oracle, log)
+        cop, co_url = _scenario_coordinator_crash(
+            sup, cop, co_port, lease_log, ttl, log)
+        time.sleep(write_window)
+        _scenario_stale_epoch(sup, router, log)
+        if not fast:
+            _scenario_migrate_kill(sup, base_dir, log)
+        time.sleep(write_window)
+
+        # -- drain writers, then merged-oracle parity --------------------
+        for w in writers:
+            w.stop = True
+        for t in threads:
+            t.join(timeout=30.0)
+        for w in writers:
+            if w.error is not None:
+                raise SoakFailure(f"writer {w.wid} died: {w.error!r}")
+        oracle: dict[bytes, bytes] = dict(scenario_oracle)
+        for w in writers:
+            oracle.update(w.acked)
+        scanned = list(router.scan())
+        keys = [k for k, _ in scanned]
+        _check(len(keys) == len(set(keys)),
+               "double-served: a key appeared twice in the merged scan")
+        got = dict(scanned)
+        lost = [k for k in oracle if k not in got]
+        _check(not lost, f"lost {len(lost)} acked keys, e.g. "
+               f"{sorted(lost)[:3]}")
+        ghost = [k for k in got if k not in oracle]
+        _check(not ghost, f"{len(ghost)} unacked ghost keys served, "
+               f"e.g. {sorted(ghost)[:3]}")
+        for k, v in oracle.items():
+            _check(got[k] == v, f"value mismatch for {k!r}")
+        # No server ever admitted a write without a live lease + epoch:
+        # the rejects prove the checks fired; parity proves none leaked.
+        n_writes = sum(len(w.acked) for w in writers)
+        n_rejects = sum(w.rejects for w in writers)
+
+        # -- graceful shutdown: SIGTERM → clean exit everywhere ----------
+        members = list(sup.members.values())
+        sup.stop_all()
+        for mem in members:
+            _check(mem.proc.returncode == 0,
+                   f"{mem.holder} exited {mem.proc.returncode}, not 0 "
+                   f"(graceful SIGTERM path broken)")
+        result = {
+            "ok": True, "seed": seed, "acked_writes": n_writes,
+            "writer_rejects": n_rejects, "oracle_keys": len(oracle),
+            "scanned_keys": len(keys),
+            "map_refreshes": stats.get_ticker_count("fleet.map.refreshes"),
+            "router_fail_closed":
+                stats.get_ticker_count("fleet.write.rejects"),
+        }
+        log(f"soak OK: {json.dumps(result)}")
+        return result
+    finally:
+        for w in writers:
+            w.stop = True
+        for t in threads:
+            t.join(timeout=10.0)
+        sup.stop_all()
+        if cop.poll() is None:
+            cop.terminate()
+            cop.wait()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_soak")
+    ap.add_argument("--dir", required=True, help="scratch directory")
+    ap.add_argument("--seed", type=int, default=1234)
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--fast", action="store_true", default=True)
+    mode.add_argument("--full", dest="fast", action="store_false")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir for inspection")
+    args = ap.parse_args(argv)
+    try:
+        run_soak(args.dir, seed=args.seed, fast=args.fast)
+        return 0
+    except SoakFailure as e:
+        print(f"SOAK FAILED: {e}", file=sys.stderr)
+        return 1
+    finally:
+        if not args.keep:
+            shutil.rmtree(args.dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from toplingdb_tpu.utils import concurrency as ccy
 from toplingdb_tpu.utils import errors as _errors
-from toplingdb_tpu.utils.status import InvalidArgument
+from toplingdb_tpu.utils.status import Busy, IOError_, InvalidArgument
 
 
 class ObjectRegistry:
@@ -473,6 +473,8 @@ class SidePluginRepo:
         self._fleet: list[tuple[str, str]] = []
         self._fleet_timeout = 2.0
         self._fleet_last_errors: dict[str, str] = {}
+        # Out-of-process fleets (sharding.FleetSupervisor) for /fleet/*.
+        self._fleet_sups: dict[str, object] = {}
         self._server: ThreadingHTTPServer | None = None
 
     def attach_db(self, name: str, db, config: dict | None = None) -> None:
@@ -488,6 +490,14 @@ class SidePluginRepo:
         changes (tools/shard_admin.py is the CLI), and /metrics grows
         per-shard gauges."""
         self._clusters[name] = router
+
+    def attach_fleet_supervisor(self, name: str, supervisor) -> None:
+        """Register a sharding.FleetSupervisor: GET /fleet lists fleets,
+        GET /fleet/<name> serves the fleet view — every supervised
+        ShardServer process (holder/role/url/alive + its own
+        /fleet/status document) merged with the lease coordinator's
+        lease table (tools/fleet_admin.py is the per-process CLI)."""
+        self._fleet_sups[name] = supervisor
 
     def attach_fleet_member(self, name: str, url: str) -> None:
         """Register a remote process for /cluster/health aggregation;
@@ -794,6 +804,20 @@ class SidePluginRepo:
                 return None
             out = cl.status()
             out["map"] = cl.map.to_config()
+            return out
+        if kind == "fleet":
+            # /fleet (list fleets) and /fleet/<name> (one supervisor's
+            # members + the lease coordinator's lease table).
+            if not name:
+                return {"fleets": sorted(self._fleet_sups)}
+            sup = self._fleet_sups.get(name)
+            if sup is None:
+                return None
+            out = sup.status()
+            try:
+                out["coordinator"] = sup.coordinator.status()
+            except (Busy, IOError_, OSError) as e:
+                out["coordinator_error"] = str(e)[:200]
             return out
         if kind == "traces":
             # /traces/<name> (recent traces; ?slow=1 filters),

@@ -142,6 +142,21 @@ SHARD_MIGRATION_FAILURES = "shard.migration.failures"
 SHARD_FENCE_WAITS = "shard.fence.waits"            # writers parked at a fence
 SHARD_WRITES_SHED = "shard.writes.shed"            # admission denied (Busy)
 SHARD_ADMISSION_WAITS = "shard.admission.waits"    # rate-limit throttles
+# Fleet plane (sharding/lease.py, sharding/fleet.py): out-of-process shard
+# servers behind a lease-based shard-map coordinator.
+LEASE_GRANTS = "lease.grants"                      # fresh fencing tokens
+LEASE_RENEWALS = "lease.renewals"
+LEASE_EXPIRIES = "lease.expiries"                  # lapsed at grant/renew time
+LEASE_REJECTS = "lease.rejects"                    # fencing-token/holder mismatch
+LEASE_CAS_CONFLICTS = "lease.cas.conflicts"        # map version CAS lost
+FLEET_MAP_REFRESHES = "fleet.map.refreshes"        # router map re-pulls
+FLEET_WRITE_REJECTS = "fleet.write.rejects"        # router map lease expired
+FLEET_STALE_EPOCH_REJECTS = "fleet.stale.epoch.rejects"  # server 409s
+FLEET_SELF_FENCES = "fleet.self.fences"            # server lost its lease
+FLEET_PROMOTIONS = "fleet.promotions"              # follower -> primary
+FLEET_RESTARTS = "fleet.restarts"                  # supervisor respawns
+FLEET_HEARTBEAT_MISSES = "fleet.heartbeat.misses"  # renew attempts that failed
+FLEET_MIGRATIONS_RECOVERED = "fleet.migrations.recovered"  # cross-process recover
 # -- flush / WAL / files ---------------------------------------------
 FLUSH_WRITE_BYTES = "flush.write.bytes"
 NO_FILE_OPENS = "no.file.opens"
