@@ -120,6 +120,51 @@ def test_replay_ignores_torn_tail(tmp_path, clk):
     co2.close()
 
 
+def test_append_after_torn_tail_survives_second_restart(tmp_path, clk):
+    """The torn tail is TRUNCATED on replay, so the first post-restart
+    append starts on a fresh line. Without that, the new record would
+    weld onto the fragment, and a SECOND restart would drop it plus
+    every record after it — replayed tokens regress and the double
+    grant the module rules out becomes possible."""
+    path = str(tmp_path / "lease.jsonl")
+    co = LeaseCoordinator(path, default_ttl=10.0, grace=2.0, clock=clk)
+    co.install_map(ShardMap.uniform(1).to_config(), {"s0": "http://a"})
+    g1 = co.acquire("s0", "h1")
+    co.close()
+    with open(path, "ab") as f:  # crash mid-append: torn JSON tail
+        f.write(b'{"op":"grant","shard":"s0","hol')
+    co2 = LeaseCoordinator(path, default_ttl=10.0, grace=2.0, clock=clk)
+    g2 = co2.reassign("s0", "h2", token=g1["token"])  # fsynced post-tear
+    co2.close()
+    co3 = LeaseCoordinator(path, default_ttl=10.0, grace=2.0, clock=clk)
+    lease = co3.status()["leases"]["s0"]
+    assert (lease["holder"], lease["token"]) == ("h2", g2["token"])
+    assert co3.status()["next_token"] == g2["token"] + 1  # never reused
+    assert co3.get_map()["placement"] == {"s0": "http://a"}
+    co3.close()
+
+
+def test_lease_client_does_not_retry_non_idempotent_posts():
+    """A mutating POST that dies in transit may already have been
+    applied (epoch bumped, CAS landed): the client must fail fast and
+    let the caller re-read the map, not blindly resend. Replay-safe
+    renew still burns the whole retry budget."""
+    from toplingdb_tpu.compaction.resilience import DcompactOptions
+
+    c = LeaseClient("http://127.0.0.1:1",  # closed port: refused fast
+                    timeout=0.5,
+                    options=DcompactOptions(max_attempts=3,
+                                            backoff_base=0.2,
+                                            backoff_jitter=0.0,
+                                            attempt_timeout=0.5))
+    t0 = time.monotonic()
+    with pytest.raises(IOError_, match="not idempotent"):
+        c.reassign("s0", "h1", force=True)
+    assert time.monotonic() - t0 < 0.5  # one attempt, no backoff sleeps
+    with pytest.raises(IOError_, match="after 3 attempts"):
+        c.renew("s0", "h1", token=1)
+
+
 def test_map_cas_conflict(coord):
     doc = coord.get_map()
     m = ShardMap.from_config(doc["map"])
@@ -220,6 +265,76 @@ def test_graceful_shutdown_drains_flushes_and_reopens(tmp_path,
         assert db.get(b"k000") == b"v" and db.get(b"k049") == b"v"
     finally:
         db.close()
+
+
+def test_lease_validity_anchored_before_request(tmp_path, no_thread_leaks):
+    """The self-fence deadline counts from BEFORE the acquire request
+    went out: the coordinator stamps expires = its_now + ttl while the
+    request is in flight, so a slow response must SHRINK the local
+    validity window, never let it trail the coordinator's expiry."""
+    from toplingdb_tpu.sharding.fleet import ShardServer
+
+    co = LeaseCoordinator(str(tmp_path / "lease.jsonl"), default_ttl=1.0,
+                          grace=0.2)
+    co.install_map(
+        ShardMap([Shard(name="s0", start=None, end=None)]).to_config(), {})
+
+    class SlowCoordinator:
+        """Delays acquire RESPONSES by 0.5s — the grant is already
+        stamped at the coordinator when the delay happens."""
+
+        def __getattr__(self, name):
+            attr = getattr(co, name)
+            if name != "acquire":
+                return attr
+
+            def acquire(*a, **k):
+                out = attr(*a, **k)
+                time.sleep(0.5)
+                return out
+            return acquire
+
+    srv = ShardServer("s0", str(tmp_path / "s0"),
+                      coordinator=SlowCoordinator(), lease_ttl=1.0,
+                      heartbeat_interval=30.0, statistics=Statistics())
+    try:
+        srv.start()
+        assert srv._lease_ok()
+        remaining = srv._lease_valid_until - time.monotonic()
+        assert remaining < 0.55  # ~ttl - delay; pre-fix it was ~ttl
+    finally:
+        srv.shutdown()
+        co.close()
+
+
+def test_release_lease_stops_heartbeat_reacquire(tmp_path,
+                                                 no_thread_leaks):
+    """Migration-cutover race: after /fleet/release_lease, a heartbeat
+    landing before the supervisor's reassign must NOT re-acquire the
+    surrendered lease (that aborts a fully caught-up migration). The
+    endpoint stops the heartbeat and hands back the fencing token."""
+    from toplingdb_tpu.sharding.fleet import ShardServer, _http_json
+
+    co = LeaseCoordinator(str(tmp_path / "lease.jsonl"), default_ttl=5.0,
+                          grace=0.2)
+    co.install_map(
+        ShardMap([Shard(name="s0", start=None, end=None)]).to_config(), {})
+    srv = ShardServer("s0", str(tmp_path / "s0"), coordinator=co,
+                      lease_ttl=0.5, heartbeat_interval=0.02,
+                      statistics=Statistics())
+    try:
+        port = srv.start()
+        out = _http_json(f"http://127.0.0.1:{port}",
+                         "/fleet/release_lease", {})
+        assert out["released"] and out["token"] is not None
+        # A still-running heartbeat would re-acquire within a beat or
+        # two (20ms); the surrendered lease must STAY surrendered.
+        time.sleep(0.2)
+        assert co.status()["leases"] == {}
+        assert srv._lease is None
+    finally:
+        srv.shutdown()
+        co.close()
 
 
 def test_fleet_router_fails_closed_when_partitioned(tmp_path,
@@ -330,6 +445,25 @@ def test_http_transport_does_not_retry_http_answers(tmp_path):
     finally:
         srv.stop()
         db.close()
+
+
+def test_spawn_ready_deadline_kills_wedged_child(no_thread_leaks):
+    """A child wedged before its READY print (hung DB open, dead
+    coordinator) must fail the spawn under a deadline — not hang the
+    supervisor thread on a bare readline forever."""
+    import subprocess
+    import sys
+
+    from toplingdb_tpu.sharding.fleet import FleetSupervisor
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        stdout=subprocess.PIPE)
+    t0 = time.monotonic()
+    with pytest.raises(IOError_, match="did not come up"):
+        FleetSupervisor._read_ready(proc, "wedged child", timeout=0.5)
+    assert time.monotonic() - t0 < 5.0  # bounded, not wedged
+    assert proc.poll() is not None  # killed, not orphaned
 
 
 # ---------------------------------------------------------------------------

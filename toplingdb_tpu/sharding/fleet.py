@@ -213,6 +213,7 @@ class ShardServer:
         bumped epoch that fences every pre-promotion write path."""
         if self.follower is None:
             raise NotSupported("promote: not a follower")
+        t0 = time.monotonic()  # before the promotion work: conservative
         sp = _tm.span("fleet.promote")
         path = self.follower.promote()
         self.follower = None
@@ -232,12 +233,7 @@ class ShardServer:
         self.router.attach_shard(self.shard, self.db)
         self.shipper = LogShipper(self.db, statistics=self.stats)
         self.role = "primary"
-        with self._mu:
-            self._lease = {k: grant[k] for k in
-                           ("holder", "token", "expires", "ttl")
-                           if k in grant}
-            self._lease_valid_until = (
-                time.monotonic() + float(grant.get("ttl", self.lease_ttl)))
+        self._adopt_grant(grant, t0)
         self.holder = grant.get("holder", self.holder)
         if self.coordinator is not None:
             self._start_heartbeat()
@@ -255,11 +251,7 @@ class ShardServer:
             if self._down:
                 return
             self._down = True
-        self._hb_stop.set()
-        t = self._hb_thread
-        if t is not None:
-            t.join(timeout=5.0)
-            self._hb_thread = None
+        self._stop_heartbeat()
         if self.router is not None:
             try:
                 self.router.fence_shard(self.shard, drain_timeout=5.0)
@@ -306,9 +298,10 @@ class ShardServer:
         deadline = time.monotonic() + timeout
         while True:
             try:
+                t0 = time.monotonic()
                 grant = self.coordinator.acquire(self.shard, self.holder,
                                                  self.lease_ttl)
-                self._adopt_grant(grant)
+                self._adopt_grant(grant, t0)
                 return
             except LeaseConflict as e:
                 if time.monotonic() > deadline:
@@ -323,11 +316,20 @@ class ShardServer:
                         f"lease coordinator unreachable: {e}") from e
                 time.sleep(0.2)
 
-    def _adopt_grant(self, grant: dict) -> None:
+    def _adopt_grant(self, grant: dict, t0: float | None = None) -> None:
+        """Anchor the local self-fence deadline at `t0` — the monotonic
+        clock captured IMMEDIATELY BEFORE the acquire/renew request was
+        sent. The coordinator stamps expires = its_now + ttl while the
+        request is in flight, so `t0 + ttl` is strictly conservative:
+        a response delayed past the grace window (network, GC pause)
+        can never leave this process believing in a lease the
+        coordinator has already re-granted to a new holder."""
+        if t0 is None:
+            t0 = time.monotonic()
         with self._mu:
             self._lease = grant
             self._lease_valid_until = (
-                time.monotonic() + float(grant.get("ttl", self.lease_ttl)))
+                t0 + float(grant.get("ttl", self.lease_ttl)))
         epoch = int(grant.get("epoch", 0))
         if self.router is not None \
                 and epoch > self.router.map.epoch_of(self.shard):
@@ -341,11 +343,19 @@ class ShardServer:
                                     self._heartbeat_loop, owner=self,
                                     stop=self._hb_stop.set)
 
+    def _stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._hb_thread = None
+
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.heartbeat_interval):
             with self._mu:
                 lease = self._lease
             try:
+                t0 = time.monotonic()
                 if lease is None:
                     grant = self.coordinator.acquire(
                         self.shard, self.holder, self.lease_ttl)
@@ -353,7 +363,12 @@ class ShardServer:
                     grant = self.coordinator.renew(
                         self.shard, self.holder, lease["token"],
                         self.lease_ttl)
-                self._adopt_grant(grant)
+                if self._hb_stop.is_set():
+                    # Released/retired while this beat was in flight:
+                    # adopting now would resurrect a lease the server
+                    # just surrendered (migration cutover).
+                    return
+                self._adopt_grant(grant, t0)
             except LeaseConflict as e:
                 # Superseded or lapsed: SELF-FENCE — stop acking writes
                 # now, re-acquire (fresh token) on a later beat.
@@ -561,13 +576,26 @@ class ShardServer:
                 elif p == "/fleet/promote":
                     self._reply(200, srv.promote(req))
                 elif p == "/fleet/release_lease":
+                    # Stop the heartbeat FIRST: a beat landing between
+                    # this release and the supervisor's reassign would
+                    # re-acquire the lease and make the cutover fail
+                    # spuriously (aborting a caught-up migration).
+                    srv._stop_heartbeat()
                     with srv._mu:
                         lease = srv._lease
                         srv._lease = None
                     if lease is not None and srv.coordinator is not None:
-                        srv.coordinator.release(srv.shard, srv.holder,
-                                                lease["token"])
-                    self._reply(200, {"released": lease is not None})
+                        try:
+                            srv.coordinator.release(srv.shard, srv.holder,
+                                                    lease["token"])
+                        except (LeaseConflict, IOError_, OSError) as e:
+                            # Best-effort: the caller's reassign carries
+                            # the token and settles ownership either way.
+                            _errors.swallow(
+                                reason="fleet-release-lease", exc=e)
+                    self._reply(200, {
+                        "released": lease is not None,
+                        "token": lease["token"] if lease else None})
                 elif p == "/fleet/flush":
                     srv.db.flush()
                     self._reply(200, {"flushed": True})
@@ -906,10 +934,32 @@ class FleetSupervisor:
         return env
 
     @staticmethod
+    def _read_ready(proc: subprocess.Popen, what: str,
+                    timeout: float) -> int:
+        """Read the child's `READY <port>` line under a deadline: a
+        child wedged BEFORE its READY print (hung DB open, unreachable
+        coordinator inside start()) must fail the spawn, not hang the
+        supervisor thread on a bare readline forever."""
+        box: list[bytes] = []
+        t = ccy.spawn("fleet-ready-reader",
+                      lambda: box.append(proc.stdout.readline()))
+        t.join(timeout)
+        line = box[0].decode().strip() if box else ""
+        if not line.startswith("READY "):
+            proc.kill()  # unblocks the reader thread too (pipe EOF)
+            proc.wait()
+            t.join(timeout=5.0)
+            raise IOError_(
+                f"{what} did not come up within {timeout}s "
+                f"(last stdout line: {line!r})")
+        return int(line.split()[1])
+
+    @staticmethod
     def start_coordinator(log_path: str, port: int = 0,
                           ttl: float = DEFAULT_LEASE_TTL,
                           grace: float = 1.0,
-                          python: str = sys.executable
+                          python: str = sys.executable,
+                          wait_ready: float = 30.0
                           ) -> tuple[subprocess.Popen, str]:
         """Spawn the lease-coordinator process; returns (proc, url)."""
         cmd = [python, "-m", "toplingdb_tpu.sharding.lease",
@@ -918,11 +968,9 @@ class FleetSupervisor:
         proc = subprocess.Popen(cmd, env=FleetSupervisor._proc_env(),
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL)
-        line = proc.stdout.readline().decode().strip()
-        if not line.startswith("READY "):
-            proc.kill()
-            raise IOError_(f"coordinator failed to start: {line!r}")
-        return proc, f"http://127.0.0.1:{int(line.split()[1])}"
+        real_port = FleetSupervisor._read_ready(
+            proc, "lease coordinator", wait_ready)
+        return proc, f"http://127.0.0.1:{real_port}"
 
     def spawn_server(self, shard: str, path: str, port: int = 0, *,
                      role: str = "primary", source_url: str | None = None,
@@ -942,13 +990,8 @@ class FleetSupervisor:
         proc = subprocess.Popen(cmd, env=self._proc_env(),
                                 stdout=subprocess.PIPE, stderr=logf)
         logf.close()  # the child inherited the descriptor
-        line = proc.stdout.readline().decode().strip()
-        if not line.startswith("READY "):
-            proc.kill()
-            raise IOError_(
-                f"shard server {holder} failed to start: {line!r} "
-                f"(see {path}.log)")
-        real_port = int(line.split()[1])
+        real_port = self._read_ready(
+            proc, f"shard server {holder} (see {path}.log)", wait_ready)
         m = _Member(holder, shard, path, real_port, role, proc, cmd,
                     source_url)
         self._wait_healthy(m, timeout=wait_ready)
@@ -1057,8 +1100,15 @@ class FleetSupervisor:
                        timeout=30.0)
             self._await_catchup(src, dest, catchup_timeout)
             hook("cutover")
-            _http_json(src.url, "/fleet/release_lease", {}, timeout=10.0)
+            # The source surrenders: release_lease stops its heartbeat
+            # (so the lease can never be re-acquired behind our back)
+            # and hands back its fencing token for a COOPERATIVE
+            # reassign — the cutover admits on the token, not on a
+            # racy released-lease window.
+            rel = _http_json(src.url, "/fleet/release_lease", {},
+                             timeout=10.0)
             grant = self.coordinator.reassign(shard, dest.holder,
+                                              token=rel.get("token"),
                                               url=dest.url,
                                               ttl=self.lease_ttl)
             _http_json(dest.url, "/fleet/promote", grant, timeout=30.0)

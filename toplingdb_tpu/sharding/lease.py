@@ -91,19 +91,41 @@ class LeaseCoordinator:
     def _replay(self) -> None:
         """Fold the log back into memory. Absolute expiry times survive
         the restart, so an unexpired grant is still binding on the
-        restarted coordinator — the double-grant-impossibility proof."""
+        restarted coordinator — the double-grant-impossibility proof.
+
+        A torn tail (crash mid-append) is TRUNCATED away, not merely
+        skipped: reopening in append mode behind a partial line would
+        weld the next record onto the fragment, and on the following
+        restart that one corrupt merged line would poison every fsynced
+        record after it — acked grants silently lost, fencing tokens
+        replayed to an old value."""
         if not os.path.exists(self.log_path):
             return
+        good = 0  # byte offset just past the last parseable record
         with open(self.log_path, "rb") as f:
+            pos = 0
             for line in f:
-                line = line.strip()
-                if not line:
+                pos += len(line)
+                if not line.endswith(b"\n"):
+                    # Unterminated final write: record + newline go out
+                    # in ONE append, fsynced before the ack — a missing
+                    # newline means the mutation was never acked, so it
+                    # is safe (and necessary) to drop it.
+                    break
+                stripped = line.strip()
+                if not stripped:
+                    good = pos
                     continue
                 try:
-                    rec = json.loads(line)
+                    rec = json.loads(stripped)
                 except ValueError:
-                    break  # torn tail from a crash mid-append: ignore rest
+                    break  # torn tail from a crash mid-append
                 self._apply(rec)
+                good = pos
+            size = f.seek(0, os.SEEK_END)
+        if good < size:
+            with open(self.log_path, "r+b") as f:
+                f.truncate(good)
 
     def _apply(self, rec: dict) -> None:
         op = rec.get("op")
@@ -434,6 +456,15 @@ class LeaseClient:
     retried — a refusal is an answer). Duck-type compatible with
     LeaseCoordinator so routers/servers take either.
 
+    Transport retries are restricted to IDEMPOTENT paths (GETs, renew,
+    release — replay-safe: a duplicate is a no-op or a clean 409). The
+    mutating POSTs (acquire, cas_map, bump_epoch, reassign) are NOT
+    retried: a connection dropped after the server applied the mutation
+    would make a blind retry double-bump an epoch or report a CAS
+    conflict for an install that actually landed. Those fail fast with
+    IOError_ and the caller — whose retry loops re-read the map first —
+    decides the true outcome.
+
     `partition` is an optional env/fault_injection.PartitionGate: while
     engaged, every call fails fast with IOError_ — the chaos soak's
     router-partitioned-from-lease-store scenario."""
@@ -446,9 +477,14 @@ class LeaseClient:
             max_attempts=3, backoff_base=0.05, attempt_timeout=timeout)
         self.partition = partition
 
+    # Replay-safe POSTs: renew/release against a moved token answer a
+    # deterministic 409, so a duplicate delivery cannot corrupt state.
+    _RETRY_SAFE_POSTS = ("/lease/renew", "/lease/release")
+
     def _call(self, method: str, path: str, body: dict | None = None) -> dict:
         if self.partition is not None:
             self.partition.check(f"{method} {path}")
+        retryable = method == "GET" or path in self._RETRY_SAFE_POSTS
         last: Exception | None = None
         for attempt in range(1, self.options.max_attempts + 1):
             if attempt > 1:
@@ -481,6 +517,12 @@ class LeaseClient:
                 # a coordinator killed mid-response (IncompleteRead) is
                 # the same retryable class as a refused connect
                 last = e
+                if not retryable:
+                    raise IOError_(
+                        f"coordinator {path} failed in transit (not "
+                        f"retried: the request is not idempotent and may "
+                        f"have been applied; re-read the map to learn the "
+                        f"outcome): {e}") from e
         raise IOError_(
             f"coordinator {path} unreachable after "
             f"{self.options.max_attempts} attempts: {last}") from last
