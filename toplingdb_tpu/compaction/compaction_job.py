@@ -66,6 +66,13 @@ class CompactionStats:
     device: str = "cpu"
     remote: bool = False        # ran in a worker process (dcompact)
     pipelined: bool = False     # ran the 3-stage pipeline (ops/pipeline.py)
+    # Mesh plane (ops/mesh_compaction.py): >1 chips means the job's
+    # key-range shards fanned out over a device mesh; fallbacks counts
+    # eligibility misses while the knob was on PLUS mid-job chip
+    # demotions (a wedged chip's shards re-ran on the survivors).
+    mesh_chips: int = 0
+    mesh_shards: int = 0
+    mesh_fallbacks: int = 0
 
     def phase_dict(self) -> dict:
         """Non-zero timing phases, seconds — for bench/dcompact reporting.

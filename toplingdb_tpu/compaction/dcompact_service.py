@@ -10,14 +10,25 @@ moves through the shared filesystem, exactly like the reference's
 NFS/S3 exchange.
 
 Worker:  python -m toplingdb_tpu.compaction.dcompact_service --port 8080 \
-             [--device tpu] [--workers 1]
+             [--device tpu] [--workers 1] [--chips N]
+
+Pod-level packing (`--chips N`): the worker host owns N chips; each chip
+is a failure domain behind its own circuit breaker (PR 1's
+WorkerHealthRegistry reused with "chip:<i>" keys). Jobs are admitted with
+as many healthy free chips as the pool can grant (chip-count-aware
+admission) and run the mesh plane sized to the grant; a wedged chip
+demotes later jobs to fewer chips — down to single-chip/local when every
+breaker is open — instead of stalling the queue. Per-chip queue depths
+ride /metrics beside the existing dcompact gauges.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
+import time
 
 from toplingdb_tpu.utils import concurrency as ccy
 import urllib.request
@@ -30,18 +41,151 @@ from toplingdb_tpu.compaction.executor import (
 from toplingdb_tpu.utils.status import IOError_
 
 
+class ChipPool:
+    """Per-chip work queues + chip-count-aware job admission for one
+    worker host. `admit()` targets the least-loaded healthy chips and
+    gang-waits for them; a chip that wedges while a job queues is dropped
+    from the grant (fewer-chip demotion), and a grant that times out
+    takes whatever subset is free NOW — so a dead device degrades
+    throughput, never progress. Chip health is the SAME breaker machinery
+    the DB side uses for worker URLs, keyed "chip:<i>", so
+    record_failure/record_success from finished jobs open and re-close
+    chips exactly like remote workers."""
+
+    def __init__(self, chips: int, policy=None):
+        from toplingdb_tpu.compaction.resilience import (
+            DcompactOptions, WorkerHealthRegistry,
+        )
+
+        self.chips = ["chip:%d" % i for i in range(max(1, chips))]
+        self.health = WorkerHealthRegistry(policy or DcompactOptions())
+        self._cv = ccy.Condition("dcompact_service.ChipPool._cv")
+        self._busy: set[str] = set()
+        # Granted-but-unreleased + queued-targeting counts per chip — the
+        # /metrics queue-depth gauge.
+        self._depth = {c: 0 for c in self.chips}
+
+    def _healthy(self) -> list[str]:
+        return [c for c in self.chips if self.health.breaker(c).allow()]
+
+    def _pick_targets(self, want: int) -> list[str]:
+        healthy = self._healthy()
+        healthy.sort(key=lambda c: self._depth[c])
+        return healthy[: max(0, want)]
+
+    def admit(self, want: int | None = None,
+              timeout: float = 30.0) -> list[str]:
+        """Block until the targeted chips are free; returns the granted
+        chip list (possibly smaller than `want` — demotion), or [] when no
+        healthy chip exists (caller runs local/serial)."""
+        want = len(self.chips) if want is None else max(1, want)
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cv:
+            target = self._pick_targets(want)
+            for c in target:
+                self._depth[c] += 1
+            while True:
+                healthy = set(self._healthy())
+                alive = [c for c in target if c in healthy]
+                if len(alive) < len(target):
+                    # Wedged while queued: demote to the survivors.
+                    for c in set(target) - set(alive):
+                        self._depth[c] -= 1
+                    target = alive
+                if not target:
+                    return []
+                free = [c for c in target if c not in self._busy]
+                if len(free) == len(target):
+                    self._busy.update(target)
+                    return list(target)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # Take what is free NOW rather than stall the job.
+                    for c in set(target) - set(free):
+                        self._depth[c] -= 1
+                    self._busy.update(free)
+                    return list(free)
+                self._cv.wait(min(0.05, remaining))
+
+    def release(self, grant: list[str], ok: bool = True,
+                failed_chips=()) -> None:
+        with self._cv:
+            for c in grant:
+                self._busy.discard(c)
+                self._depth[c] -= 1
+            self._cv.notify_all()
+        # Health updates OUTSIDE the pool lock: the registry/breaker locks
+        # rank below (after) the pool's in the §2.10.1 order, but release
+        # has no reason to nest them.
+        for c in grant:
+            if ok and c not in failed_chips:
+                self.health.record_success(c)
+            else:
+                self.health.record_failure(c)
+
+    def queue_depths(self) -> dict[str, int]:
+        with self._cv:
+            return dict(self._depth)
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            depths = dict(self._depth)
+            busy = set(self._busy)
+        health = self.health.snapshot()
+        return {
+            c: {"queue_depth": depths[c], "busy": c in busy,
+                "state": health.get(c, {}).get("state", "closed")}
+            for c in self.chips
+        }
+
+
 class DcompactWorkerService:
     """Hosts job execution: POST /dcompact {"job_dir": ...} → runs the job
     in-process (owning the chip), returns the results JSON. GET /stats for
     introspection."""
 
-    def __init__(self, device: str = "cpu", max_workers: int = 1):
+    def __init__(self, device: str = "cpu", max_workers: int = 1,
+                 chips: int = 0):
         self.device = device
         self._sem = threading.Semaphore(max_workers)
         self._server: ThreadingHTTPServer | None = None
         self._counter_mu = ccy.Lock("dcompact_service.DcompactWorkerService._counter_mu")
         self.jobs_done = 0
         self.jobs_failed = 0
+        # Pod-level packing: chips > 0 builds the per-chip admission pool;
+        # 0 keeps the legacy one-process-per-chip shape.
+        self.pool = ChipPool(chips) if chips > 0 else None
+
+    def _run_with_chips(self, run) -> int:
+        """Admit chips for one job, size the mesh plane to the grant via
+        env, run, and feed the outcome back into the chip breakers. The
+        env export is process-wide, so with --workers > 1 overlapping jobs
+        may see each other's grant size — that only skews chip COUNTS
+        (outputs are byte-identical at any count); the admission ledger
+        itself is race-free under the pool lock."""
+        if self.pool is None:
+            return run()
+        grant = self.pool.admit()
+        saved = {k: os.environ.get(k)
+                 for k in ("TPULSM_MESH_COMPACT", "TPULSM_MESH_DEVICES")}
+        if len(grant) > 1:
+            os.environ["TPULSM_MESH_COMPACT"] = "1"
+            os.environ["TPULSM_MESH_DEVICES"] = str(len(grant))
+        else:
+            # 0/1 healthy chips: run local/serial, never half-meshed.
+            os.environ.pop("TPULSM_MESH_COMPACT", None)
+        ok = False
+        try:
+            rc = run()
+            ok = True
+            return rc
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            self.pool.release(grant, ok=ok)
 
     def _count(self, ok: bool) -> None:
         with self._counter_mu:
@@ -67,10 +211,13 @@ class DcompactWorkerService:
 
             def do_GET(self):
                 if self.path == "/stats":
-                    self._reply(200, {
+                    body = {
                         "device": svc.device, "jobs_done": svc.jobs_done,
                         "jobs_failed": svc.jobs_failed,
-                    })
+                    }
+                    if svc.pool is not None:
+                        body["chips"] = svc.pool.snapshot()
+                    self._reply(200, body)
                 elif self.path == "/health":
                     # Liveness probe for the DB-side health registry /
                     # half-open breaker checks; tools/fleet_health.py
@@ -88,6 +235,21 @@ class DcompactWorkerService:
                         lines.append(f"# TYPE {m} gauge")
                         lines.append(
                             f'{m}{{device="{svc.device}"}} {v}')
+                    if svc.pool is not None:
+                        snap = svc.pool.snapshot()
+                        for metric, val in (
+                            ("dcompact_chip_queue_depth",
+                             lambda s: s["queue_depth"]),
+                            ("dcompact_chip_busy",
+                             lambda s: int(s["busy"])),
+                            ("dcompact_chip_wedged",
+                             lambda s: int(s["state"] != "closed")),
+                        ):
+                            m = f"tpulsm_{metric}"
+                            lines.append(f"# TYPE {m} gauge")
+                            for chip, s in snap.items():
+                                lines.append(
+                                    f'{m}{{chip="{chip}"}} {val(s)}')
                     data = ("\n".join(lines) + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
@@ -134,7 +296,8 @@ class DcompactWorkerService:
                         if dirty:
                             with open(ppath, "w") as pf:
                                 json.dump(params, pf, indent=1)
-                        rc = worker.run_job(job_dir)
+                        rc = svc._run_with_chips(
+                            lambda: worker.run_job(job_dir))
                     with open(f"{job_dir}/results.json") as f:
                         results = json.load(f)
                     svc._count(ok=True)
@@ -243,11 +406,15 @@ def main(argv=None) -> int:
                     help="bind address (cross-host deployments need non-loopback)")
     ap.add_argument("--device", default="cpu")
     ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--chips", type=int, default=0,
+                    help="chips this host owns; >0 enables pod-level "
+                         "packing (per-chip queues + mesh-sized jobs)")
     args = ap.parse_args(argv)
-    svc = DcompactWorkerService(args.device, args.workers)
+    svc = DcompactWorkerService(args.device, args.workers,
+                                chips=args.chips)
     port = svc.start(args.port, args.host)
     print(f"dcompact worker listening on {args.host}:{port} "
-          f"(device={svc.device})", flush=True)
+          f"(device={svc.device}, chips={args.chips})", flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

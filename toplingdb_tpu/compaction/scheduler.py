@@ -431,10 +431,12 @@ class CompactionScheduler:
 
     @staticmethod
     def _compaction_mode(stats) -> str:
-        """serial / columnar / device / pipelined / remote — the trace tag
-        the ISSUE's per-mode waterfalls key on."""
+        """serial / columnar / device / pipelined / remote / mesh — the
+        trace tag the ISSUE's per-mode waterfalls key on."""
         if getattr(stats, "remote", False):
             return "remote"
+        if getattr(stats, "mesh_chips", 0) > 1:
+            return "mesh"
         if getattr(stats, "pipelined", False):
             return "pipelined"
         if stats.device not in ("cpu",):

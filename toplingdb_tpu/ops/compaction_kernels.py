@@ -948,7 +948,7 @@ def _want_front_code(uk_len: int, total_rows: int) -> bool:
             and _next_pow2(max(1, total_rows)) * uk_len <= _FC_MAX_ELEMS)
 
 
-def upload_uniform_shard(chunks, covers=None, front_code=None):
+def upload_uniform_shard(chunks, covers=None, front_code=None, device=None):
     """Pack one shard's prepared chunks (prepare_uniform_chunk outputs, in
     row order) into device buffers, pad rows to the next power of two, and
     START the host→device transfers (device_put is async). Tunneled rigs
@@ -959,7 +959,11 @@ def upload_uniform_shard(chunks, covers=None, front_code=None):
     `front_code` (None = auto): upload per-row shared-prefix lengths +
     suffix bytes instead of full key bytes — sorted runs share long
     prefixes, so this cuts the dominant H2D transfer; the device
-    reconstructs the exact key matrix (bit-identical results)."""
+    reconstructs the exact key matrix (bit-identical results).
+    `device` (None = backend default): COMMIT the shard's buffers to one
+    specific chip — the fused program carries no pin of its own, so the
+    committed inputs decide where it runs (ops/mesh_compaction.py places
+    shards round-robin over a mesh this way)."""
     uk_len = chunks[0][4]
     ns = tuple(int(c[3]) for c in chunks)
     total = sum(ns)
@@ -1023,15 +1027,21 @@ def upload_uniform_shard(chunks, covers=None, front_code=None):
     run_starts = np.full(rr + 1, p, dtype=np.int32)
     run_starts[:n_chunks] = np.cumsum([0] + list(ns[:-1]), dtype=np.int64)
     run_starts[n_chunks] = total
+    def put(x):
+        # A committed transfer (device=) pins the downstream jit program to
+        # that chip; the default keeps today's backend-default placement.
+        return jax.device_put(x, device) if device is not None \
+            else jax.device_put(x)
+
     h = {
-        "pkb": jax.device_put(pkb), "total": total,
-        "starts": jax.device_put(starts),
-        "min_his": jax.device_put(min_his),
-        "min_los": jax.device_put(min_los), "uk_len": uk_len,
-        "tomb_hi": jax.device_put(tomb_hi) if has_tombs else None,
-        "tomb_lo": jax.device_put(tomb_lo) if has_tombs else None,
+        "pkb": put(pkb), "total": total,
+        "starts": put(starts),
+        "min_his": put(min_his),
+        "min_los": put(min_los), "uk_len": uk_len,
+        "tomb_hi": put(tomb_hi) if has_tombs else None,
+        "tomb_lo": put(tomb_lo) if has_tombs else None,
         "n_chunks": n_chunks,
-        "run_starts": jax.device_put(run_starts),
+        "run_starts": put(run_starts),
     }
     if front_code:
         sfx = (np.concatenate(sfx_parts) if sfx_parts
@@ -1040,10 +1050,10 @@ def upload_uniform_shard(chunks, covers=None, front_code=None):
         # decode's clipped gather needs only a pow2 bucket, not real bytes.
         sb = np.zeros(_next_pow2(max(8, len(sfx))), dtype=np.uint8)
         sb[: len(sfx)] = sfx
-        h["plens"] = jax.device_put(plens)
-        h["sfx"] = jax.device_put(sb)
+        h["plens"] = put(plens)
+        h["sfx"] = put(sb)
     else:
-        h["ukb"] = jax.device_put(ukb)
+        h["ukb"] = put(ukb)
     return h
 
 

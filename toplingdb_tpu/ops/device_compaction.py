@@ -738,19 +738,22 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
             stats.host_compute_usec += int((time.time() - t_hc) * 1e6)
             col = _types.SimpleNamespace(seq=seq_a, vtype=vt_a, n=kv.n)
         elif shards is not None:
-            # Upload + dispatch every shard up front (device_put and
-            # jit dispatch are async; shard s+1's transfer streams
-            # while shard s computes, and fused_uniform_shard_start
-            # enqueues each D2H copy so results stream back).
-            pendings = []
+            # Upload + dispatch through the mesh seam: serial mode uploads
+            # every shard up front to the default device (device_put and
+            # jit dispatch are async; shard s+1's transfer streams while
+            # shard s computes, and fused_uniform_shard_start enqueues
+            # each D2H copy so results stream back); TPULSM_MESH_COMPACT
+            # places shards round-robin over every chip instead, double-
+            # buffered per chip (ops/mesh_compaction.py).
+            from toplingdb_tpu.ops import mesh_compaction as mc
+            from toplingdb_tpu.utils import telemetry as _tele
+
             t_up = time.time()
-            for chunks, ranges in shards:
-                covers_s = (None if cover is None else
-                            [cover[lo:hi] for lo, hi in ranges])
-                pendings.append(ck.fused_uniform_shard_start(
-                    ck.upload_uniform_shard(chunks, covers_s), snapshots,
-                    compaction.bottommost,
-                ))
+            finish_shard, _mesh_on = mc.dispatch_shards(
+                shards, cover, snapshots, compaction.bottommost,
+                stats=stats, any_complex=bool(any_complex),
+                trace=_tele.current_handle(),
+            )
             # Upload-enqueue span (device_put is async, so this is a lower
             # bound; the blocking download waits below add the rest).
             stats.transfer_time_usec += int((time.time() - t_up) * 1e6)
@@ -767,9 +770,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
                 # value-buffer pointers, so collect every shard first;
                 # the shard programs still overlap each other.
                 orders, zfs, cxs = [], [], []
-                for (_chunks, ranges), pending in zip(shards, pendings):
+                for s_i, (_chunks, ranges) in enumerate(shards):
                     t_dn = time.time()
-                    o, z, cx, hc = ck.fused_uniform_shard_finish(pending)
+                    o, z, cx, hc = finish_shard(s_i)
                     stats.device_wait_usec += int(
                         (time.time() - t_dn) * 1e6)
                     lmap = _ranges_lmap(ranges)
@@ -822,9 +825,9 @@ def _run_device_compaction_columnar(env, dbname, icmp, compaction, table_cache,
         # Shard streaming: each chunk's trailers/seqs land just before the
         # writer consumes it (the writer reads both arrays per native call).
         def _shard_order_chunks():
-            for (_chunks, ranges), pending in zip(shards, pendings):
+            for s_i, (_chunks, ranges) in enumerate(shards):
                 t_dn = time.time()
-                o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
+                o, z, _cx, hc = finish_shard(s_i)
                 stats.device_wait_usec += int((time.time() - t_dn) * 1e6)
                 if hc:
                     raise _FallbackToEntries()

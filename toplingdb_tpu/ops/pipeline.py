@@ -496,23 +496,58 @@ def _device_compute(kv, files, splitters, prog, outq, shared, snapshots,
                     bottommost, frags, max_dev_key):
     """Compute worker, device mode: upload each shard's uniform chunks as
     soon as its scan lands (async H2D + dispatch), finish in order —
-    double-buffered so shard s+1 transfers while shard s computes."""
+    double-buffered so shard s+1 transfers while shard s computes. Under
+    TPULSM_MESH_COMPACT shards round-robin over every chip instead
+    (committed uploads pin each program, ops/mesh_compaction.py) and the
+    lookahead widens to UPLOAD_DEPTH per chip; a chip that fails mid-job
+    demotes the remaining shards to the default device."""
     from toplingdb_tpu.ops import compaction_kernels as ck
+    from toplingdb_tpu.ops import mesh_compaction as mc
+    from toplingdb_tpu.parallel import mesh_plan as mp
+    from toplingdb_tpu.utils.status import NotSupported
 
     n_shards = len(splitters) + 1
     snaps = np.asarray(sorted(snapshots), dtype=np.uint64)
-    pendings = []  # (ranges, lmap, pending) or None for empty shards
+    mesh_devs = mc.pipeline_devices(n_shards, stats=shared.stats,
+                                    trace=shared.trace)
+    depth = [mp.UPLOAD_DEPTH * len(mesh_devs) if mesh_devs else 1]
+    pendings = []  # (ranges, lmap, pending, s, dev, chunks, covers) | None
+
+    def _demote(exc) -> None:
+        # Wedged chip: the rest of the job runs single-device; bytes are
+        # unchanged (same kernels), only placement degrades.
+        mesh_devs.clear()
+        depth[0] = 1
+        shared.stats.mesh_chips = 1
+        shared.stats.mesh_fallbacks = getattr(
+            shared.stats, "mesh_fallbacks", 0) + 1
+        telemetry.span_event_under(shared.trace, "compaction.mesh.fallback",
+                                   0, reason="chip-wedged",
+                                   error=type(exc).__name__)
 
     def finish_one(item):
         if item is None:
             return
-        ranges, lmap, pending, s = item
+        ranges, lmap, pending, s, dev, chunks, covers = item
         t0 = time.time()
-        o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
+        try:
+            o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
+        except Exception as e:
+            if dev is None or isinstance(e, NotSupported):
+                raise
+            _demote(e)  # re-run this shard on the default device
+            pending = ck.fused_uniform_shard_start(
+                ck.upload_uniform_shard(chunks, covers), snapshots,
+                bottommost,
+            )
+            o, z, _cx, hc = ck.fused_uniform_shard_finish(pending)
         dwait = time.time() - t0
         shared.stats.device_wait_usec += int(dwait * 1e6)
         telemetry.span_event_under(shared.trace, "pipeline.merge_gc",
                                    dwait * 1e6, shard=s, device=True)
+        if dev is not None:
+            telemetry.span_event_under(shared.trace, "compaction.mesh.shard",
+                                       dwait * 1e6, shard=s, chip=str(dev))
         if hc:
             raise PipelineIneligible("complex groups present")
         og = lmap[o]
@@ -556,14 +591,27 @@ def _device_compute(kv, files, splitters, prog, outq, shared, snapshots,
                 for lo, hi in ranges:
                     covers.append(cov[pos:pos + (hi - lo)])
                     pos += hi - lo
-            pending = ck.fused_uniform_shard_start(
-                ck.upload_uniform_shard(chunks, covers), snapshots,
-                bottommost,
-            )
+            dev = mesh_devs[s % len(mesh_devs)] if mesh_devs else None
+            try:
+                pending = ck.fused_uniform_shard_start(
+                    ck.upload_uniform_shard(chunks, covers, device=dev),
+                    snapshots, bottommost,
+                )
+            except Exception as e:
+                if dev is None or isinstance(e, NotSupported):
+                    raise
+                _demote(e)
+                dev = None
+                pending = ck.fused_uniform_shard_start(
+                    ck.upload_uniform_shard(chunks, covers), snapshots,
+                    bottommost,
+                )
             shared.stats.transfer_time_usec += int((time.time() - t0) * 1e6)
-            pendings.append((ranges, _ranges_lmap(ranges), pending, s))
-        # keep one upload of lookahead in flight; finish older shards now
-        while len(pendings) > 1:
+            pendings.append((ranges, _ranges_lmap(ranges), pending, s, dev,
+                             chunks, covers))
+        # keep the lookahead window in flight (one upload serially,
+        # UPLOAD_DEPTH per chip under the mesh); finish older shards now
+        while len(pendings) > depth[0]:
             finish_one(pendings.pop(0))
     while pendings:
         finish_one(pendings.pop(0))

@@ -1,99 +1,77 @@
-"""Range-axis WEAK-SCALING probe for the distributed GC step.
+"""Multichip probe CLI: a THIN wrapper over parallel/mesh_plan.py.
 
-Runs `run_distributed_gc` over a (jobs=1, range=R) mesh for R in the
-requested device counts with a FIXED per-device row count, and prints one
-JSON line of per-R wall times — the measured story for the all_to_all /
-ppermute collective design (VERDICT r04 item 10). On a CPU host the
-devices are virtual (--xla_force_host_platform_device_count), so the
-numbers characterize the COLLECTIVE/PARTITIONING overhead scaling, not
-chip throughput; the same harness runs unchanged on a real multi-chip
-backend.
+Two modes, both printing one JSON line:
+
+  weak (default)  range-axis WEAK-SCALING of the distributed GC step:
+                  `run_distributed_gc` over a (jobs=1, range=R) mesh for
+                  R = 1,2,4..devices with a FIXED per-device row count —
+                  the measured story for the all_to_all/ppermute
+                  collective design (VERDICT r04 item 10).
+  mesh            MEASURED mesh compaction: the same uniform key-range
+                  shards through the mesh shard runner
+                  (ops/mesh_compaction.py) at 1 chip vs all chips —
+                  strong scaling of one fanned-out job (bench.py promotes
+                  this into compaction_mesh_MBps / mesh_scaling_x).
+
+On a CPU host the devices are virtual
+(--xla_force_host_platform_device_count), so the numbers characterize
+partitioning/dispatch overhead scaling, not chip throughput; the same
+harness runs unchanged on a real multi-chip backend.
 
 Runs in a SUBPROCESS (bench.py invokes `python -m
-toplingdb_tpu.parallel.scaling_probe --rows-per-device N --devices 8`)
-because the device count must be set before the jax backend exists.
+toplingdb_tpu.parallel.scaling_probe ...`) because the device count must
+be set before the jax backend exists.
+
+Exit codes: 0 measured; 3 SKIP (environment cannot run the probe — no
+jax backend / too few devices; the caller drops the row); 1 the
+measurement itself failed.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import os
-import re
 import sys
-import time
-from toplingdb_tpu.utils import errors as _errors
+
+from toplingdb_tpu.parallel import mesh_plan
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("weak", "mesh"), default="weak")
     ap.add_argument("--rows-per-device", type=int, default=1 << 16)
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--repeats", type=int, default=3)
     args = ap.parse_args(argv)
 
     # Virtual CPU devices must be configured BEFORE the backend exists.
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", ""))
-    os.environ["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={args.devices}"
-    ).strip()
+    mesh_plan.configure_virtual_devices(args.devices)
+    try:
+        import jax
 
-    # Re-assert via jax.config too: on axon hosts sitecustomize pre-imports
-    # jax and force-registers the tunnel backend over JAX_PLATFORMS.
-    import jax
+        mesh_plan.pin_cpu_backend()
+        n_dev = len(jax.devices())
+    except Exception as e:  # no usable backend: a skip, not a failure
+        print(json.dumps({"skip": f"jax backend unavailable: {e!r}"[:200]}))
+        return mesh_plan.EXIT_SKIP
+    if n_dev < args.devices:
+        print(json.dumps({"skip": f"{n_dev} devices < {args.devices} "
+                                  "requested"}))
+        return mesh_plan.EXIT_SKIP
 
     try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception as e:
-        _errors.swallow(reason="jax-platform-pin", exc=e)
-    import numpy as np
-    from jax.sharding import Mesh
-
-    from toplingdb_tpu.db.dbformat import ValueType, make_internal_key
-    from toplingdb_tpu.ops import compaction_kernels as ck
-    from toplingdb_tpu.ops.columnar import ColumnarEntries
-    from toplingdb_tpu.parallel.distributed_gc import run_distributed_gc
-
-    rows_list = []
-    counts = [1 << i for i in range(args.devices.bit_length())
-              if (1 << i) <= args.devices]
-    for r in counts:
-        n = args.rows_per_device * r
-        rng = np.random.default_rng(7)
-        draws = rng.integers(0, n, n)
-        entries = [
-            (make_internal_key(b"%012d" % draws[i], i + 1, ValueType.VALUE),
-             b"v")
-            for i in range(n)
-        ]
-        col = ColumnarEntries.from_entries(entries, 12)
-        padded = ck.pad_columns(col)
-        job = {
-            "key_words": np.asarray(padded["key_words"]),
-            "key_len": np.asarray(padded["key_len"]),
-            "inv_hi": np.asarray(padded["inv_hi"]),
-            "inv_lo": np.asarray(padded["inv_lo"]),
-            "vtype": np.asarray(padded["vtype"]),
-            "w": padded["w"],
-            "n": col.n,
-        }
-        devices = jax.devices()[:r]
-        mesh = Mesh(np.array(devices).reshape(1, r), ("jobs", "range"))
-        best = None
-        for _ in range(args.repeats):
-            t0 = time.time()
-            run_distributed_gc(mesh, [job], [], True)
-            dt = time.time() - t0
-            best = dt if best is None else min(best, dt)
-        rows_list.append({"range_devices": r, "rows": n,
-                          "rows_per_device": args.rows_per_device,
-                          "best_s": round(best, 4),
-                          "rows_per_s": round(n / best)})
-    print(json.dumps({"weak_scaling": rows_list}))
-    return 0
+        if args.mode == "mesh":
+            rows = mesh_plan.mesh_compact_rows(
+                args.rows_per_device, args.devices, args.repeats)
+            print(json.dumps({"mesh_compact": rows}))
+        else:
+            rows = mesh_plan.weak_scaling_rows(
+                args.rows_per_device, args.devices, args.repeats)
+            print(json.dumps({"weak_scaling": rows}))
+    except Exception as e:  # noqa: BLE001 — measurement broke
+        print(json.dumps({"error": repr(e)[:300]}))
+        return mesh_plan.EXIT_FAILURE
+    return mesh_plan.EXIT_OK
 
 
 if __name__ == "__main__":

@@ -32,6 +32,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     n = args.n
 
+    if args.filter in "compaction_mesh":
+        # The mesh case needs >1 device; the count is fixed at jax
+        # backend creation, so rewrite the env NOW if jax isn't up yet.
+        import sys as _sys
+
+        if "jax" not in _sys.modules:
+            from toplingdb_tpu.parallel import mesh_plan as _mp
+
+            _mp.configure_virtual_devices(8)
+
     import numpy as np
 
     from toplingdb_tpu.db import dbformat
@@ -307,6 +317,47 @@ def main(argv=None) -> int:
                     os.environ.pop(k2, None)
                 else:
                     os.environ[k2] = v
+
+    # Mesh compaction (§2.2.4): the SAME uniform shard set through the
+    # mesh shard runner at 1 chip vs 8 — strong scaling of one fanned-out
+    # job. On virtual CPU devices XLA executes every "chip" through one
+    # shared host threadpool, so no cross-device overlap materializes and
+    # the ratio reports ~1x with virtual_devices=true provenance; the
+    # >=4x-at-8-chips win is asserted only on a real multi-device backend.
+    if args.filter in "compaction_mesh":
+        import jax
+
+        from toplingdb_tpu.parallel import mesh_plan
+
+        mesh_plan.pin_cpu_backend()
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            print(json.dumps({"bench": "compaction_mesh",
+                              "skip": f"{n_dev} device(s)"}))
+        else:
+            virtual = jax.default_backend() == "cpu"
+            rows_per_shard = max(2048, n // 16)
+            rows = mesh_plan.mesh_compact_rows(rows_per_shard,
+                                               min(8, n_dev), repeats=2)
+            for r in rows:
+                print(json.dumps({
+                    "bench": "compaction_mesh_%d" % r["devices"],
+                    "items": r["rows"], "shards": r["shards"],
+                    "best_s": r["best_s"], "items_per_s": r["rows_per_s"],
+                    "MBps": r["MBps"],
+                }))
+            base = rows[0]["rows_per_s"]
+            top = rows[-1]
+            scaling = round(top["rows_per_s"] / base, 2) if base else None
+            ok = None if virtual else bool(scaling and scaling >= 4.0)
+            print(json.dumps({
+                "bench": "compaction_mesh_scaling",
+                "devices": top["devices"], "mesh_scaling_x": scaling,
+                "virtual_devices": virtual, "expect_ge_x": 4.0,
+                "pass": ok,
+            }))
+            if ok is False:
+                return 1
 
     # Native zip encode plane vs the Python ZipTableBuilder oracle: the
     # SAME survivor segment emitted through write_tables_zip_columnar with

@@ -115,6 +115,10 @@ DCOMPACTION_BREAKER_OPEN = "dcompaction.breaker.open"
 DCOMPACTION_BREAKER_CLOSE = "dcompaction.breaker.close"
 DCOMPACTION_BREAKER_SKIPPED = "dcompaction.breaker.skipped"
 DCOMPACTION_ORPHANS_SWEPT = "dcompaction.orphans.swept"
+# -- mesh compaction (ops/mesh_compaction.py): one job fanned over chips
+DCOMPACTION_MESH_JOBS = "dcompaction.mesh.jobs"          # mesh-mode jobs
+DCOMPACTION_MESH_SHARDS = "dcompaction.mesh.shards"      # shards dispatched
+DCOMPACTION_MESH_FALLBACKS = "dcompaction.mesh.fallbacks"  # misses+demotions
 
 # Replication plane (replication/): WAL shipping, follower apply, router.
 REPLICATION_FRAMES_SHIPPED = "replication.frames.shipped"
@@ -249,8 +253,10 @@ GAUGE_NAMES = frozenset({
     "slo_burn_rate_fast", "slo_burn_rate_slow", "slo_firing", "slo_health",
     # fleet aggregator gauges (/cluster/health)
     "fleet_members", "fleet_members_unreachable",
-    # dcompact worker /metrics
+    # dcompact worker /metrics (per-chip rows carry a chip="<i>" label)
     "dcompact_jobs_done", "dcompact_jobs_failed",
+    "dcompact_chip_queue_depth", "dcompact_chip_busy",
+    "dcompact_chip_wedged",
     # error-policy plane (utils/errors.py, process-wide)
     "bg_error_swallowed_total",
 })
@@ -638,6 +644,13 @@ class Statistics:
             if stats.rpc_time_usec:
                 self.record_in_histogram(DCOMPACTION_RPC_MICROS,
                                          stats.rpc_time_usec)
+        if getattr(stats, "mesh_chips", 0) > 1:
+            self.record_tick(DCOMPACTION_MESH_JOBS)
+            self.record_tick(DCOMPACTION_MESH_SHARDS,
+                             getattr(stats, "mesh_shards", 0))
+        if getattr(stats, "mesh_fallbacks", 0):
+            self.record_tick(DCOMPACTION_MESH_FALLBACKS,
+                             stats.mesh_fallbacks)
         self.record_tick(COMPACT_READ_BYTES, stats.input_bytes)
         self.record_tick(COMPACT_WRITE_BYTES, stats.output_bytes)
         self.record_in_histogram(COMPACTION_TIME_MICROS, stats.work_time_usec)
