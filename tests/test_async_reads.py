@@ -1,0 +1,363 @@
+"""Async read plane (env/async_reads.py + TPULSM_ASYNC_READS=1):
+ring task back-pressure, batch coalescing, closed-batcher fallback,
+sync/async byte parity across table formats x codecs x snapshots x
+range tombstones, fault injection through the reader rings, and
+thread hygiene (DB.close joins every reader-ring thread)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.env.async_reads import AsyncReadBatcher
+from toplingdb_tpu.env.env import AsyncIORing
+from toplingdb_tpu.env.fault_injection import ReadFaultInjector
+from toplingdb_tpu.options import Options, ReadOptions
+from toplingdb_tpu.table.builder import TableOptions
+from toplingdb_tpu.table import format as fmt
+from toplingdb_tpu.utils import statistics as st
+from toplingdb_tpu.utils.statistics import Statistics
+from toplingdb_tpu.utils.status import IOError_
+
+
+@pytest.fixture
+def async_knob():
+    """Restore TPULSM_ASYNC_READS after each test."""
+    saved = os.environ.get("TPULSM_ASYNC_READS")
+    yield
+    if saved is None:
+        os.environ.pop("TPULSM_ASYNC_READS", None)
+    else:
+        os.environ["TPULSM_ASYNC_READS"] = saved
+
+
+def set_knob(v: str) -> None:
+    os.environ["TPULSM_ASYNC_READS"] = v
+
+
+class _StubFile:
+    """read(offset, n)/append(data) double that counts carrier preads."""
+
+    def __init__(self, data: bytes = b""):
+        self.data = bytearray(data)
+        self.reads = 0
+        self.read_ranges = []
+
+    def read(self, offset: int, n: int) -> bytes:
+        self.reads += 1
+        self.read_ranges.append((offset, n))
+        return bytes(self.data[offset:offset + n])
+
+    def append(self, data: bytes) -> None:
+        self.data += data
+
+    def flush(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Satellite (a): AsyncIORing task back-pressure
+# ---------------------------------------------------------------------------
+
+
+def test_ring_task_submissions_are_bounded(no_thread_leaks):
+    """submit_task must hit back-pressure at task_capacity: before the
+    fix, the capacity wait was gated on kind == "append", so a fast
+    producer could grow the queue without bound."""
+    ring = AsyncIORing(capacity=64, task_capacity=4, name="bp-test")
+    gate = threading.Event()
+    running = threading.Event()
+    try:
+        # Wedge the worker mid-round so later submissions pile up.
+        blocker = ring.submit_task(
+            lambda: (running.set(), gate.wait(timeout=10.0)))
+        assert running.wait(timeout=5.0)
+        toks = [ring.submit_task(lambda i=i: i) for i in range(4)]
+
+        stalled = threading.Event()
+        passed = threading.Event()
+
+        def overflow():
+            stalled.set()
+            ring.submit_task(lambda: 99)  # 5th queued task: must block
+            passed.set()
+
+        t = threading.Thread(target=overflow, daemon=True)
+        t.start()
+        assert stalled.wait(timeout=5.0)
+        time.sleep(0.1)
+        assert not passed.is_set(), "task submission was NOT back-pressured"
+
+        # Appends have their own (larger) budget: a full task queue must
+        # not block the WAL lane.
+        f = _StubFile()
+        t0 = time.monotonic()
+        ring.submit_append(f, b"wal-bytes")
+        assert time.monotonic() - t0 < 1.0
+
+        gate.set()  # drain: the blocked producer gets through
+        assert passed.is_set() or passed.wait(timeout=5.0)
+        for tok in toks:
+            tok.wait()
+        blocker.wait()
+        t.join(timeout=5.0)
+    finally:
+        gate.set()
+        ring.close()
+    assert bytes(f.data) == b"wal-bytes"
+
+
+# ---------------------------------------------------------------------------
+# Batcher unit tests: coalescing, max_span, closed fallback
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_coalesces_adjacent_ranges(no_thread_leaks):
+    stats = Statistics()
+    payload = bytes(range(256)) * 64  # 16 KiB
+    f = _StubFile(payload)
+    b = AsyncReadBatcher(rings=2, stats=stats, name="coal-test")
+    try:
+        reqs = [(f, 0, 100), (f, 100, 100), (f, 150, 200),  # one run
+                (f, 8000, 64)]                               # detached
+        toks = b.submit_batch(reqs)
+        got = [t.wait() for t in toks]
+        assert got == [payload[0:100], payload[100:200],
+                       payload[150:350], payload[8000:8064]]
+        assert f.reads == 2  # 3 adjacent requests -> 1 carrier pread
+        assert sorted(f.read_ranges) == [(0, 350), (8000, 64)]
+        assert b.batches == 1 and b.coalesced == 2 and b.fallbacks == 0
+        assert stats.get_ticker_count(st.READ_ASYNC_BATCHES) == 1
+        assert stats.get_ticker_count(st.READ_ASYNC_COALESCED) == 2
+    finally:
+        b.close()
+
+
+def test_batcher_max_span_bounds_carrier_reads(no_thread_leaks):
+    f = _StubFile(b"x" * 4096)
+    b = AsyncReadBatcher(rings=1, name="span-test")
+    b.max_span = 256
+    try:
+        toks = b.submit_batch([(f, i * 128, 128) for i in range(8)])
+        assert all(t.wait() == b"x" * 128 for t in toks)
+        # 8 adjacent 128-byte requests, 256-byte cap -> 4 carrier preads
+        assert f.reads == 4
+        assert all(n <= 256 for _, n in f.read_ranges)
+    finally:
+        b.close()
+
+
+def test_closed_batcher_serves_inline(no_thread_leaks):
+    stats = Statistics()
+    f = _StubFile(b"abcdefgh" * 16)
+    b = AsyncReadBatcher(rings=2, stats=stats, name="closed-test")
+    b.close()
+    toks = b.submit_batch([(f, 0, 8), (f, 64, 8)])
+    assert [t.wait() for t in toks] == [b"abcdefgh", b"abcdefgh"]
+    assert b.fallbacks > 0
+    assert stats.get_ticker_count(st.READ_ASYNC_FALLBACKS) > 0
+    tok = b.submit_task(lambda: 41 + 1)
+    assert tok.wait() == 42
+
+
+# ---------------------------------------------------------------------------
+# Sync/async parity matrix (tentpole): block + zip x codecs x snapshots
+# x range tombstones, byte-identical across TPULSM_ASYNC_READS=0/1
+# ---------------------------------------------------------------------------
+
+
+def _build_matrix_db(path, table_options):
+    """Several SSTs + overwrites + a snapshot pinning pre-tombstone
+    state + a range tombstone + live memtable entries."""
+    db = DB.open(path, Options(
+        create_if_missing=True, write_buffer_size=16 * 1024,
+        statistics=Statistics(), table_options=table_options))
+    n = 500
+    for i in range(n):
+        db.put(b"key%05d" % i, b"val-%05d-" % i + b"p" * (i % 37))
+    db.flush()
+    for i in range(0, n, 3):
+        db.put(b"key%05d" % i, b"OVR-%05d" % i)
+    db.flush()
+    db.wait_for_compactions()
+    snap = db.get_snapshot()
+    db.delete_range(b"key00100", b"key00160")
+    for i in range(200, 230):
+        db.put(b"key%05d" % i, b"mem-%05d" % i)  # stays in memtable
+    return db, snap, n
+
+
+PARITY_CASES = [
+    ("block-none", TableOptions(block_size=512,
+                                compression=fmt.NO_COMPRESSION)),
+    ("block-zstd", TableOptions(block_size=512,
+                                compression=fmt.ZSTD_COMPRESSION)),
+    ("zip-none", TableOptions(format="zip",
+                              compression=fmt.NO_COMPRESSION)),
+    ("zip-zstd", TableOptions(format="zip",
+                              compression=fmt.ZSTD_COMPRESSION)),
+]
+
+
+@pytest.mark.parametrize("label,topts", PARITY_CASES,
+                         ids=[c[0] for c in PARITY_CASES])
+def test_sync_async_parity_matrix(tmp_db_path, async_knob, no_thread_leaks,
+                                  label, topts):
+    db, snap, n = _build_matrix_db(tmp_db_path, topts)
+    try:
+        keys = [b"key%05d" % i for i in range(n)] + [b"nope", b"zzzz"]
+
+        def observe():
+            out = {
+                "mget": db.multi_get(keys),
+                "mget_snap": db.multi_get(
+                    keys[::7], ReadOptions(snapshot=snap)),
+                "gets": [db.get(k) for k in keys[::13]],
+                "get_snap": db.get(b"key00120", ReadOptions(snapshot=snap)),
+            }
+            it = db.new_iterator()
+            it.seek_to_first()
+            out["scan"] = list(it.entries())
+            fut = db.multi_get_async(keys[::11])
+            out["mget_async"] = fut.result()
+            return out
+
+        set_knob("0")
+        sync_view = observe()
+        set_knob("1")
+        async_view = observe()
+        assert async_view == sync_view  # byte-identical, all surfaces
+        # the tombstoned range really exercises deletes at both knobs
+        assert sync_view["mget"][110] is None
+        assert sync_view["get_snap"] is not None
+        # knob=1 actually drove the batcher (cold blocks / compressed
+        # value groups were planned). zip-none has nothing to prefetch:
+        # the table is fully resident and its value groups uncompressed.
+        if label != "zip-none":
+            assert db.stats.get_ticker_count(st.READ_ASYNC_BATCHES) > 0
+    finally:
+        db.release_snapshot(snap)
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# Fault injection through the reader rings
+# ---------------------------------------------------------------------------
+
+
+def test_async_read_error_propagates_then_resumes(tmp_db_path, async_knob,
+                                                  no_thread_leaks):
+    db, snap, n = _build_matrix_db(
+        tmp_db_path, TableOptions(block_size=512,
+                                  compression=fmt.NO_COMPRESSION))
+    try:
+        db.release_snapshot(snap)
+        keys = [b"key%05d" % i for i in range(n)]
+        set_knob("0")
+        oracle = db.multi_get(keys)
+        # Injector armed BEFORE the first async read: the batcher wires
+        # db.read_fault_hook into its rings at creation.
+        db.read_fault_hook = ReadFaultInjector(schedule={0: "fail"})
+        set_knob("1")
+        with pytest.raises(IOError_, match="injected reader-ring"):
+            db.multi_get(keys)
+        # Schedule exhausted -> the SAME rings serve cleanly (the error
+        # settled one token, it did not poison the ring).
+        assert db.multi_get(keys) == oracle
+        assert db.read_fault_hook.injected_counts() == {"fail": 1}
+    finally:
+        db.close()
+
+
+def test_async_read_delay_plan_keeps_parity(tmp_db_path, async_knob,
+                                            no_thread_leaks):
+    db, snap, n = _build_matrix_db(
+        tmp_db_path, TableOptions(block_size=512,
+                                  compression=fmt.NO_COMPRESSION))
+    try:
+        db.release_snapshot(snap)
+        keys = [b"key%05d" % i for i in range(n)]
+        set_knob("0")
+        oracle = db.multi_get(keys)
+        db.read_fault_hook = ReadFaultInjector(rate=1.0, plans=("delay",),
+                                               delay_sec=0.0002)
+        set_knob("1")
+        assert db.multi_get(keys) == oracle
+        assert db.read_fault_hook.injected_counts().get("delay", 0) > 0
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# Thread hygiene + async API
+# ---------------------------------------------------------------------------
+
+
+def test_db_close_joins_reader_rings(tmp_db_path, async_knob,
+                                     no_thread_leaks):
+    """Zero leaked ring threads after DB.close (acceptance criterion).
+    The no_thread_leaks fixture asserts the ccy registry is clean."""
+    from toplingdb_tpu.utils import concurrency as ccy
+
+    db, snap, _ = _build_matrix_db(
+        tmp_db_path, TableOptions(block_size=512,
+                                  compression=fmt.NO_COMPRESSION))
+    db.release_snapshot(snap)
+    set_knob("1")
+    db.multi_get([b"key%05d" % i for i in range(0, 500, 5)])
+    it = db.new_iterator()
+    it.seek_to_first()
+    next(iter(it.entries()), None)
+    fut = db.get_async(b"key00042")
+    assert fut.result() == db.get(b"key00042")
+    before = {t.name for t in ccy.registry.live()}
+    assert any(n.startswith("aio-tpulsm-read") for n in before)
+    db.close()
+    after = {t.name for t in ccy.registry.live()}
+    assert not any(n.startswith("aio-tpulsm-read") for n in after)
+
+
+def test_get_async_multi_get_async_futures(tmp_db_path, async_knob,
+                                           no_thread_leaks):
+    db = DB.open(tmp_db_path, Options(create_if_missing=True,
+                                      statistics=Statistics()))
+    try:
+        for i in range(64):
+            db.put(b"k%03d" % i, b"v%03d" % i)
+        db.flush()
+        set_knob("1")
+        futs = [db.get_async(b"k%03d" % i) for i in range(0, 64, 4)]
+        assert [f.result() for f in futs] == \
+            [b"v%03d" % i for i in range(0, 64, 4)]
+        mf = db.multi_get_async([b"k001", b"missing", b"k050"])
+        assert mf.result() == [b"v001", None, b"v050"]
+    finally:
+        db.close()
+
+
+def test_shard_router_fans_out_concurrently(tmp_path, async_knob,
+                                            no_thread_leaks):
+    """Front-door parity: a multi-shard batch reassembles byte-identical
+    results through the future-based fan-out, tokened or not."""
+    from toplingdb_tpu.sharding import open_local_cluster
+
+    for knob in ("0", "1"):
+        set_knob(knob)
+        base = tmp_path / ("cluster" + knob)
+        r = open_local_cluster(str(base),
+                               [("a", None, b"m"), ("b", b"m", None)],
+                               statistics=Statistics())
+        try:
+            rows = {b"a%04d" % i: b"v%d" % i for i in range(80)}
+            rows.update({b"z%04d" % i: b"w%d" % i for i in range(80)})
+            toks = {k: r.put(k, v) for k, v in rows.items()}
+            keys = list(rows) + [b"absent", b"zz-absent"]
+            want = [rows.get(k) for k in keys]
+            assert r.multi_get(keys) == want
+            tok = toks[b"a0001"]
+            tok = tok[0] if isinstance(tok, list) else tok
+            assert r.multi_get(keys, token=tok) == want
+        finally:
+            r.close()

@@ -458,6 +458,14 @@ class DB:
         self._wbm_charged = 0  # bytes charged to options.write_buffer_manager
         self._options_file_number = 0  # latest persisted OPTIONS file
         self._mget_pool = None  # lazy long-lived async multi_get executor
+        # Async read plane (env/async_reads.py, TPULSM_ASYNC_READS=1):
+        # lazy AsyncReadBatcher fanning batched block fetches across
+        # Options.async_read_rings reader rings; closed by DB.close.
+        self._read_batcher = None
+        self._async_pool = None  # lazy get_async/multi_get_async executor
+        # Test seam: set before the first async-routed read to plug a
+        # ReadFaultInjector into every reader ring (fault_hook).
+        self.read_fault_hook = None
         self._file_deletions_disabled = 0  # DisableFileDeletions pin count
         # Replication plane hook: LogShipper / FollowerDB / ReplicaRouter
         # register a status callable here; the SidePlugin HTTP layer serves
@@ -771,6 +779,14 @@ class DB:
         if self._mget_pool is not None:
             self._mget_pool.shutdown(wait=True)
             self._mget_pool = None
+        if self._async_pool is not None:
+            self._async_pool.shutdown(wait=True)
+            self._async_pool = None
+        if self._read_batcher is not None:
+            # Joins every reader-ring thread (zero leaked ring threads
+            # after close — the no_thread_leaks guarantee).
+            self._read_batcher.close()
+            self._read_batcher = None
         if self._compaction_scheduler is not None:
             self._compaction_scheduler.shutdown()
         with self._mutex:
@@ -2016,10 +2032,12 @@ class DB:
         return True
 
     def _probe_file(self, reader, key: bytes, snap_seq: int, ctx: GetContext,
-                    tombs, it=None) -> tuple[bool, object]:
+                    tombs, it=None, preread=None) -> tuple[bool, object]:
         """One SST source; `tombs` is the file's parsed RangeTombstone list;
         `it` is a reusable iterator for this reader (created on demand).
-        Returns (continue?, iterator)."""
+        `preread`: async read plane overlay (block-table PrereadSpans or
+        zip value-group preload) — only ever non-None for readers whose
+        new_iterator accepts it. Returns (continue?, iterator)."""
         from toplingdb_tpu.utils import statistics as st
 
         ucmp = self.icmp.user_comparator
@@ -2048,7 +2066,8 @@ class DB:
             it.seek_ordinal(ordinal)
         else:
             if it is None:
-                it = reader.new_iterator()
+                it = (reader.new_iterator(preread=preread)
+                      if preread is not None else reader.new_iterator())
             it.seek(dbformat.make_internal_key(
                 key, snap_seq, dbformat.VALUE_TYPE_FOR_SEEK
             ))
@@ -2147,12 +2166,16 @@ class DB:
         # BlockBasedTable::Get). Anything the Python state machine must
         # see (merge operands, single-delete in SSTs, blob indexes, range
         # tombstones, wide-column entities, perf-context accounting)
-        # falls through below.
-        handled, val, src = self._native_get(cfd, key, snap_seq, opts)
-        if handled:
-            if st_on:
-                self._record_get_stats(t0, val, src)
-            return val, False
+        # falls through below. TPULSM_ASYNC_READS=1 routes around it:
+        # the async read plane lives in the Python walk, whose block
+        # fetches batch-submit through the reader rings.
+        async_on = self._async_reads_on()
+        if not async_on:
+            handled, val, src = self._native_get(cfd, key, snap_seq, opts)
+            if handled:
+                if st_on:
+                    self._record_get_stats(t0, val, src)
+                return val, False
         ctx = GetContext(
             key, snap_seq, self.options.merge_operator,
             blob_resolver=self.blob_source.get,
@@ -2165,9 +2188,20 @@ class DB:
                 if st_on:
                     self._record_get_stats(t0, val, "mem")
                 return val, ctx.result_is_entity
-        # 2. SST files, newest data first.
+        # 2. SST files, newest data first. Async plane: every candidate
+        # file's cache-missing blocks are submitted as ONE batch before
+        # the walk, so a multi-level chain overlaps its preads (deeper
+        # candidates are speculative — wasted only when an upper level
+        # terminates the lookup first).
         version = self.versions.cf_current(cfd.handle.id)
-        hit_level = self._walk_sst_chain(version, key, snap_seq, ctx)
+        preread_map = None
+        if async_on:
+            file_order = [f for _lvl, f in version.files_for_get(key)]
+            preread_map = self._plan_async_preread(
+                file_order, {f.number: [key] for f in file_order},
+                {key}, snap_seq)
+        hit_level = self._walk_sst_chain(version, key, snap_seq, ctx,
+                                         preread_map=preread_map)
         val = ctx.result()
         if st_on:
             self._record_get_stats(t0, val, hit_level)
@@ -2181,15 +2215,22 @@ class DB:
             len(val) if val is not None else None, src)
 
     def _walk_sst_chain(self, version, key: bytes, snap_seq: int, ctx,
-                        tombs_for=None):
+                        tombs_for=None, preread_map=None):
         """Probe the key's SST candidates newest-first until the lookup
         completes (shared by get, async multi_get, get_merge_operands).
-        Returns the level that completed the lookup, or None."""
+        `preread_map`: async read plane overlays keyed by file number —
+        the chain's block fetches were batch-submitted up front, so a
+        deep walk consumes already-overlapped reads instead of paying
+        one serial pread per level. Returns the level that completed
+        the lookup, or None."""
         for level, f in version.files_for_get(key):
             reader = self.table_cache.get_reader(f.number)
             tombs = (tombs_for(f) if tombs_for is not None
                      else self._parsed_tombstones(reader))
-            more, _ = self._probe_file(reader, key, snap_seq, ctx, tombs)
+            more, _ = self._probe_file(
+                reader, key, snap_seq, ctx, tombs,
+                preread=(preread_map.get(f.number)
+                         if preread_map is not None else None))
             if not more:
                 return level
         ctx.finish()
@@ -2530,6 +2571,134 @@ class DB:
             out[i] = r
         return out
 
+    # -- async read plane (env/async_reads.py; ROADMAP item 4b) --------
+
+    @staticmethod
+    def _async_reads_on() -> bool:
+        """TPULSM_ASYNC_READS=1 routes multi_get/get block fetches
+        through the AsyncReadBatcher; default 0 keeps the synchronous
+        path — the byte-parity oracle (write/scan/zip plane pattern)."""
+        import os as _os
+
+        return _os.environ.get("TPULSM_ASYNC_READS", "0") == "1"
+
+    def _reader_batcher(self):
+        """Lazy per-DB AsyncReadBatcher (first async-routed read)."""
+        b = self._read_batcher
+        if b is None:
+            from toplingdb_tpu.env.async_reads import AsyncReadBatcher
+
+            with self._mutex:
+                b = self._read_batcher
+                if b is None and not self._closed:
+                    opts = self.options
+                    b = self._read_batcher = AsyncReadBatcher(
+                        rings=max(1, getattr(opts, "async_read_rings", 4)),
+                        task_capacity=getattr(
+                            opts, "async_read_task_capacity", 256),
+                        stats=self.stats,
+                        fault_hook=self.read_fault_hook,
+                        name="tpulsm-read")
+        return b
+
+    def _plan_async_preread(self, file_order, per_file, live, snap_seq):
+        """Plan + submit one batch of block fetches for a (multi_)get:
+        per candidate file, seek the resident index for each live key's
+        data-block handle, drop cache-resident blocks, and fan the rest
+        through the reader rings in ONE submit_batch (coalescing merges
+        neighbours). Returns {file_number: overlay} where the overlay is
+        a PrereadSpans (block tables) or a {vg: token} value-group
+        preload (zip tables); files the plane cannot serve (hash-index /
+        plain formats) get no entry and probe synchronously —
+        READ_ASYNC_FALLBACKS counts them."""
+        batcher = self._reader_batcher()
+        if batcher is None:
+            return None
+        mk = dbformat.make_internal_key
+        flat: list[tuple] = []       # (rfile, offset, length)
+        flat_file: list[int] = []    # aligned file numbers
+        zip_plans: dict[int, tuple] = {}
+        planned: set[int] = set()
+        fallbacks = 0
+        for f in file_order:
+            if f.number in planned:
+                continue
+            planned.add(f.number)
+            todo = sorted(k for k in per_file[f.number] if k in live)
+            if not todo:
+                continue
+            reader = self.table_cache.get_reader(f.number)
+            ikeys = [mk(k, snap_seq, dbformat.VALUE_TYPE_FOR_SEEK)
+                     for k in todo if reader.key_may_match(k)]
+            if not ikeys:
+                continue
+            if hasattr(reader, "plan_block_reads") \
+                    and not getattr(reader, "has_hash_index", False):
+                for off, n in reader.plan_block_reads(ikeys):
+                    flat.append((reader._f, off, n))
+                    flat_file.append(f.number)
+            elif hasattr(reader, "plan_value_groups"):
+                vgs = reader.plan_value_groups(ikeys)
+                if vgs:
+                    zip_plans[f.number] = (reader, vgs)
+            else:
+                fallbacks += 1
+        overlays: dict[int, object] = {}
+        if flat:
+            from toplingdb_tpu.env.async_reads import PrereadSpans
+
+            toks = batcher.submit_batch(flat)
+            spans: dict[int, list] = {}
+            for (rf, off, n), fnum, tok in zip(flat, flat_file, toks):
+                spans.setdefault(fnum, []).append((off, off + n, tok))
+            for fnum, sp in spans.items():
+                overlays[fnum] = PrereadSpans(
+                    self.table_cache.get_reader(fnum)._f, sp)
+        for fnum, (reader, vgs) in zip_plans.items():
+            overlays[fnum] = {
+                vg: batcher.submit_task(
+                    lambda r=reader, v=vg: r._value_group(v))
+                for vg in vgs
+            }
+        if zip_plans and self.stats is not None:
+            # A value-group preload is one planned batch too: keep the
+            # ticker meaningful for zip-format tables.
+            self.stats.record_tick(_st.READ_ASYNC_BATCHES, len(zip_plans))
+        if fallbacks and self.stats is not None:
+            self.stats.record_tick(_st.READ_ASYNC_FALLBACKS, fallbacks)
+        return overlays
+
+    def _submit_async(self, fn):
+        """Run `fn` on the lazy async-read executor; returns a
+        concurrent.futures.Future."""
+        self._check_open()
+        pool = self._async_pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._mutex:
+                pool = self._async_pool
+                if pool is None:
+                    pool = self._async_pool = ThreadPoolExecutor(
+                        max_workers=max(
+                            2, getattr(self.options, "async_read_rings", 4)),
+                        thread_name_prefix="tpulsm-get-async")
+        return pool.submit(fn)
+
+    def get_async(self, key: bytes, opts: ReadOptions = _DEFAULT_READ,
+                  cf=None):
+        """Future-returning point lookup: `.result()` is exactly what
+        `get(key, opts, cf)` returns. The batched async surface the
+        shard/fleet routers fan requests across shards with."""
+        return self._submit_async(lambda: self.get(key, opts, cf))
+
+    def multi_get_async(self, keys: list[bytes],
+                        opts: ReadOptions = _DEFAULT_READ, cf=None):
+        """Future-returning batched lookup: `.result()` is exactly what
+        `multi_get(keys, opts, cf)` returns."""
+        keys = list(keys)
+        return self._submit_async(lambda: self.multi_get(keys, opts, cf))
+
     def multi_get(self, keys: list[bytes], opts: ReadOptions = _DEFAULT_READ,
                   cf=None) -> list[bytes | None]:
         """Batched point lookups (reference DBImpl::MultiGet, including the
@@ -2591,10 +2760,16 @@ class DB:
             opts.snapshot.sequence if opts.snapshot is not None
             else self.versions.last_sequence
         )
-        handled, native_res = self._native_multi_get(cfd, keys, snap_seq,
-                                                     opts, cf)
-        if handled:
-            return native_res
+        # TPULSM_ASYNC_READS=1: the batch runs the Python per-file walk
+        # with its block fetches fanned through the reader rings; the
+        # native whole-batch path serializes its preads in-call and is
+        # bypassed (knob off = the sync oracle, default).
+        async_on = self._async_reads_on()
+        if not async_on:
+            handled, native_res = self._native_multi_get(cfd, keys, snap_seq,
+                                                         opts, cf)
+            if handled:
+                return native_res
         resolver = self.blob_source.get
         excluded = self._excluded_for(opts)
         ctxs = {
@@ -2629,7 +2804,7 @@ class DB:
                     tombs_cache[f.number] = t
             return t
 
-        if live and opts.async_io and len(live) > 1:
+        if live and opts.async_io and len(live) > 1 and not async_on:
             # Fiber-MultiGet analogue: each missing key walks its own file
             # chain on a worker thread (one "fiber" per key; file pread
             # releases the GIL, so misses overlap their IO).
@@ -2659,22 +2834,37 @@ class DB:
                 f for lvl in range(version.num_levels)
                 for f in version.files[lvl] if f.number in per_file
             ]
-            for f in file_order:
-                todo = [k for k in per_file[f.number] if k in live]
-                if not todo:
-                    continue
-                reader = self.table_cache.get_reader(f.number)
-                tombs = tombs_for(f)  # once per file per batch (shared memo)
-                it = None
-                for k in sorted(todo):
-                    ctx = live.get(k)
-                    if ctx is None:
+            # Async read plane: submit EVERY file's cache-missing blocks
+            # as one batch before any probe — the fiber-MultiGet overlap
+            # (PAPER.md item 4) with the rings doing the preads while
+            # this thread decodes whatever completed first.
+            overlays = None
+            if async_on and file_order:
+                overlays = self._plan_async_preread(
+                    file_order, per_file, live, snap_seq)
+            import contextlib as _ctxlib
+            span_cm = (_tm.span("read.async.wait", files=len(file_order))
+                       if overlays else _ctxlib.nullcontext())
+            with span_cm:
+                for f in file_order:
+                    todo = [k for k in per_file[f.number] if k in live]
+                    if not todo:
                         continue
-                    more, it = self._probe_file(
-                        reader, k, snap_seq, ctx, tombs, it
-                    )
-                    if not more:
-                        del live[k]
+                    reader = self.table_cache.get_reader(f.number)
+                    tombs = tombs_for(f)  # once per file per batch
+                    it = None
+                    preread = (overlays.get(f.number)
+                               if overlays is not None else None)
+                    for k in sorted(todo):
+                        ctx = live.get(k)
+                        if ctx is None:
+                            continue
+                        more, it = self._probe_file(
+                            reader, k, snap_seq, ctx, tombs, it,
+                            preread=preread
+                        )
+                        if not more:
+                            del live[k]
         for ctx in live.values():
             ctx.finish()
         return [self._ctx_plain_result(ctxs[k]) for k in keys]
@@ -2771,6 +2961,11 @@ class DB:
                 return TracingIterator(fwd, tr)
             return fwd
         cfd = self._cf_data(cf)
+        # Async read plane: iterator readahead windows become reader-ring
+        # tasks (FilePrefetchBuffer(aio_ring=)); each child pins one ring
+        # so its windows stay ordered while children overlap. The batcher
+        # is resolved BEFORE taking the DB mutex (its creation takes it).
+        batcher = self._reader_batcher() if self._async_reads_on() else None
         with self._mutex:
             snap_seq = (
                 opts.snapshot.sequence if opts.snapshot is not None
@@ -2784,10 +2979,14 @@ class DB:
                 children.append(mem.new_iterator())
                 for seq, begin, end in mem.range_del_entries():
                     rd.add(RangeTombstone(seq, begin, end))
-            for f in version.files[0]:
+            for i, f in enumerate(version.files[0]):
                 reader = self.table_cache.get_reader(f.number)
-                if ra and hasattr(reader, "new_index_iterator"):
-                    children.append(reader.new_iterator(readahead_size=ra))
+                if (ra or batcher is not None) \
+                        and hasattr(reader, "new_index_iterator"):
+                    children.append(reader.new_iterator(
+                        readahead_size=ra,
+                        aio_ring=(batcher.ring_for(i)
+                                  if batcher is not None else None)))
                 else:
                     children.append(reader.new_iterator())
                 for b, e in reader.range_del_entries():
@@ -2796,7 +2995,10 @@ class DB:
                 if version.files[level]:
                     children.append(
                         LevelIterator(self.table_cache, version.files[level],
-                                      self.icmp, readahead_size=ra)
+                                      self.icmp, readahead_size=ra,
+                                      aio_ring=(batcher.ring_for(level)
+                                                if batcher is not None
+                                                else None))
                     )
                     # Only files that actually hold tombstones are opened here
                     # (num_range_deletions travels in the MANIFEST metadata);
@@ -2852,6 +3054,7 @@ class DB:
                 stats=self.stats,
                 readahead_size=ra,
                 protection_bytes=self._protection,
+                aio_rings=batcher,
             )
             if plane is not None:
                 it.attach_scan_plane(plane)

@@ -7,6 +7,11 @@ the real thing; MemEnv backs unit tests; wrappers can interpose for fault
 injection and IO counting.
 """
 
+from toplingdb_tpu.env.async_reads import (  # noqa: F401
+    AsyncReadBatcher,
+    PrereadSpans,
+    ReadToken,
+)
 from toplingdb_tpu.env.env import (  # noqa: F401
     AioToken,
     AsyncIORing,

@@ -194,9 +194,16 @@ class AsyncIORing:
     """
 
     def __init__(self, capacity: int = 256, coalesce_cb=None,
-                 fault_hook=None, name: str = "tpulsm-aio"):
+                 fault_hook=None, name: str = "tpulsm-aio",
+                 task_capacity: int | None = None):
         self._cap = max(1, int(capacity))
+        # Reads (submit_task) get their OWN cap: a miss storm must not fill
+        # the shared queue and starve WAL appends of their capacity slots,
+        # and appends must not let tasks pile up unbounded (ISSUE 18).
+        self._task_cap = max(1, int(task_capacity if task_capacity is not None
+                                    else capacity))
         self._q: list = []
+        self._n_task = 0
         self._cv = ccy.Condition("env.AsyncIORing._cv")
         self._closed = False
         self.coalesce_cb = coalesce_cb     # callable(n_merged_fsyncs)
@@ -216,8 +223,14 @@ class AsyncIORing:
         with self._cv:
             if self._closed:
                 raise IOError_("async IO ring is closed")
-            while len(self._q) >= self._cap and kind == "append":
+            while not self._closed and (
+                    (kind == "append" and len(self._q) >= self._cap)
+                    or (kind == "task" and self._n_task >= self._task_cap)):
                 self._cv.wait()  # bounded: back-pressure the producer
+            if self._closed:
+                raise IOError_("async IO ring is closed")
+            if kind == "task":
+                self._n_task += 1
             self._q.append((kind, f, data, tok))
             self._cv.notify_all()
         return tok
@@ -271,6 +284,7 @@ class AsyncIORing:
                     return
                 batch = self._q
                 self._q = []
+                self._n_task = 0
                 self._cv.notify_all()
             per_file: dict[int, list] = {}  # id -> [f, appended, syncs, fbars]
             global_bars: list[AioToken] = []

@@ -63,6 +63,14 @@ class Options:
     enable_async_wal: bool = False
     # Submit-ring capacity (entries) of the async WAL writer.
     async_wal_ring_size: int = 256
+    # Async read plane (env/async_reads.py AsyncReadBatcher, engaged by
+    # TPULSM_ASYNC_READS=1): number of reader rings — dedicated I/O
+    # threads the batched block fetches fan out across. os.pread drops
+    # the GIL, so N rings genuinely overlap a cold-cache miss storm.
+    async_read_rings: int = 4
+    # Per-reader-ring cap on queued read tasks (separate from the append
+    # capacity so a miss storm cannot starve WAL appends).
+    async_read_task_capacity: int = 256
 
     # -- LSM shape ------------------------------------------------------
     num_levels: int = 7

@@ -191,6 +191,16 @@ class ReplicaRouter:
         self._tick(stats_mod.ROUTER_PRIMARY_READS, len(keys))
         return self.primary.multi_get(keys, opts, cf=cf)
 
+    def multi_get_async(self, keys, opts: ReadOptions = _DEFAULT_READ,
+                        cf=None, token=None):
+        """Future-returning multi_get: the whole replica-routed walk
+        (candidate failover + health accounting) runs on the primary DB's
+        async-read executor, so a shard front door can fan sub-batches
+        across many shards concurrently (env/async_reads.py)."""
+        keys = list(keys)
+        return self.primary._submit_async(
+            lambda: self.multi_get(keys, opts, cf=cf, token=token))
+
     def new_iterator(self, opts: ReadOptions = _DEFAULT_READ,
                      cf=None, token=None):
         """An iterator over one token-eligible replica (an iterator is a

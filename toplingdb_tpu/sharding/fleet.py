@@ -808,6 +808,46 @@ class FleetRouter:
                     _errors.swallow(reason="fleet-read-refresh-miss",
                                     exc=e2)
 
+    def multi_get(self, keys):
+        """Batched read across the fleet: group keys by shard, POST one
+        `/fleet/multiget` per shard — concurrently when the batch spans
+        more than one shard — and reassemble values in input order.
+        Each shard's POST keeps `_shard_post`'s refresh-and-retry
+        convergence, so a mid-batch migration only stalls that shard's
+        sub-batch, not the whole request."""
+        self._ensure_fresh()
+        by_shard: dict[str, list[int]] = {}
+        for i, k in enumerate(keys):
+            with self._mu:
+                shard = self.map.shard_for(k)
+            by_shard.setdefault(shard.name, []).append(i)
+        out: list[bytes | None] = [None] * len(keys)
+
+        def _fetch(name: str, idxs: list[int]):
+            resp = self._shard_post(name, "/fleet/multiget", {
+                "keys_b64": [base64.b64encode(keys[i]).decode()
+                             for i in idxs]})
+            return [base64.b64decode(v) if v is not None else None
+                    for v in resp["values_b64"]]
+
+        if len(by_shard) == 1:
+            ((name, idxs),) = by_shard.items()
+            for i, v in zip(idxs, _fetch(name, idxs)):
+                out[i] = v
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=len(by_shard),
+                    thread_name_prefix="tpulsm-fleet-mget") as pool:
+                futs = [(idxs, pool.submit(_fetch, name, idxs))
+                        for name, idxs in by_shard.items()]
+                for idxs, fut in futs:
+                    for i, v in zip(idxs, fut.result()):
+                        out[i] = v
+        self._tick(stats_mod.SHARD_ROUTED_READS, len(by_shard))
+        return out
+
     def _shard_post(self, shard: str, path: str, body: dict) -> dict:
         """POST to `shard`'s current placement with refresh-and-retry on
         transport errors — a migrated/promoted shard's old address gives

@@ -436,18 +436,35 @@ class ShardRouter:
             by_shard.setdefault(sh.name, []).append(i)
             shards[sh.name] = sh
         out = [None] * len(keys)
+        # Fan the per-shard sub-batches out concurrently: each shard's
+        # lookup becomes a future (DB.multi_get_async / the replica
+        # router's async twin), so one request overlaps N shards' block
+        # fetches instead of walking them shard-by-shard.  A single
+        # shard keeps the plain sync call — no future overhead.
+        pending: list[tuple[list[int], object]] = []
         for name, idxs in by_shard.items():
             sh = shards[name]
             serving = self._serving(name)
             sub = [keys[i] for i in idxs]
             rt = self._check_token(sh, token)
-            if rt == "primary":
-                vals = serving.replicas.primary.multi_get(sub, opts)
+            if len(by_shard) == 1:
+                if rt == "primary":
+                    vals = serving.replicas.primary.multi_get(sub, opts)
+                else:
+                    vals = serving.replicas.multi_get(sub, opts, token=rt)
+                for i, v in zip(idxs, vals):
+                    out[i] = v
+            elif rt == "primary":
+                pending.append(
+                    (idxs, serving.replicas.primary.multi_get_async(sub, opts)))
             else:
-                vals = serving.replicas.multi_get(sub, opts, token=rt)
-            for i, v in zip(idxs, vals):
-                out[i] = v
+                pending.append(
+                    (idxs, serving.replicas.multi_get_async(sub, opts,
+                                                            token=rt)))
             self._note_traffic(name, reads=1, read_keys=len(sub))
+        for idxs, fut in pending:
+            for i, v in zip(idxs, fut.result()):
+                out[i] = v
         self._tick(stats_mod.SHARD_ROUTED_READS, len(by_shard))
         return out
 

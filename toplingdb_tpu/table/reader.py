@@ -268,11 +268,40 @@ class TableReader:
             ctx.block_read_byte += len(data)
         return data
 
-    def new_iterator(self, readahead_size: int = 0) -> "TableIterator":
+    def new_iterator(self, readahead_size: int = 0, preread=None,
+                     aio_ring=None) -> "TableIterator":
         """`readahead_size`: ReadOptions.readahead_size — a fixed,
         immediately-armed prefetch window for this iterator; 0 keeps the
-        auto-scaling default."""
-        return TableIterator(self, readahead_size=readahead_size)
+        auto-scaling default. `preread`: a PrereadSpans-style overlay
+        (env/async_reads.py) replacing the prefetch buffer — the async
+        read plane's batched block fetches serve this iterator's loads.
+        `aio_ring`: AsyncIORing for the prefetch buffer's readahead
+        windows (they become ring tasks instead of inline preads)."""
+        return TableIterator(self, readahead_size=readahead_size,
+                             preread=preread, aio_ring=aio_ring)
+
+    def plan_block_reads(self, seek_ikeys) -> list[tuple[int, int]]:
+        """Async read plane planner: the (offset, length) byte ranges the
+        data blocks landed on by seeking each internal key would pread —
+        deduplicated, block-cache-resident handles skipped. The length
+        covers the block trailer, exactly what `fmt.read_block` consumes,
+        so a prefetched range serves `_read_data_block` byte-for-byte."""
+        idx = self.new_index_iterator()
+        seen: set[int] = set()
+        out: list[tuple[int, int]] = []
+        for ik in seek_ikeys:
+            idx.seek(ik)
+            if not idx.valid():
+                continue
+            h = fmt.BlockHandle.decode_exact(idx.value())
+            if h.offset in seen:
+                continue
+            seen.add(h.offset)
+            if self._cache is not None and self._cache.lookup(
+                    self._cache_prefix + h.encode()) is not None:
+                continue  # resident: the probe will hit the cache
+            out.append((h.offset, h.size + fmt.BLOCK_TRAILER_SIZE))
+        return out
 
     def new_index_iterator(self):
         """Iterator over (separator_key, data BlockHandle bytes) — flat or
@@ -397,7 +426,8 @@ class _PartitionedIndexIter:
 class TableIterator:
     """Two-level iterator: index (flat or partitioned) → data block."""
 
-    def __init__(self, reader: TableReader, readahead_size: int = 0):
+    def __init__(self, reader: TableReader, readahead_size: int = 0,
+                 preread=None, aio_ring=None):
         from toplingdb_tpu.table.prefetch import FilePrefetchBuffer
 
         self._r = reader
@@ -407,13 +437,19 @@ class TableIterator:
         # Per-iterator auto-readahead: sequential block loads escalate to
         # windowed preads; random seeks pass through untouched. A nonzero
         # ReadOptions.readahead_size pins a pre-armed fixed window
-        # instead of the auto-scaling ramp.
-        if readahead_size > 0:
+        # instead of the auto-scaling ramp. A `preread` overlay (async
+        # read plane batched fetches) replaces the buffer outright; an
+        # `aio_ring` moves the buffer's readahead windows onto a reader
+        # ring thread.
+        if preread is not None:
+            self._pf = preread
+        elif readahead_size > 0:
             self._pf = FilePrefetchBuffer(
                 reader._f, max_readahead=readahead_size,
-                initial_readahead=readahead_size, arm_immediately=True)
+                initial_readahead=readahead_size, arm_immediately=True,
+                aio_ring=aio_ring)
         else:
-            self._pf = FilePrefetchBuffer(reader._f)
+            self._pf = FilePrefetchBuffer(reader._f, aio_ring=aio_ring)
 
     def prefetch_counts(self) -> tuple[int, int]:
         """(hits, misses) of this iterator's readahead buffer — exported

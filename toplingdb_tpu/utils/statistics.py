@@ -49,6 +49,14 @@ GET_HIT_L2_AND_UP = "get.hit.l2andup"
 NUMBER_MULTIGET_CALLS = "number.multiget.get"
 NUMBER_MULTIGET_KEYS_READ = "number.multiget.keys.read"
 NUMBER_MULTIGET_BYTES_READ = "number.multiget.bytes.read"
+# Async read plane (env/async_reads.py AsyncReadBatcher serving db.py
+# multi_get/get behind TPULSM_ASYNC_READS): block-fetch batches submitted
+# to the reader rings, requests merged away by per-file range coalescing,
+# and reads the plane had to refuse (non-block tables, knob off mid-call,
+# closed rings) — served synchronously instead.
+READ_ASYNC_BATCHES = "read.async.batches"
+READ_ASYNC_COALESCED = "read.async.coalesced"
+READ_ASYNC_FALLBACKS = "read.async.fallbacks"
 # -- iteration -------------------------------------------------------
 NUMBER_DB_SEEK = "number.db.seek"
 NUMBER_DB_NEXT = "number.db.next"
