@@ -134,10 +134,17 @@ def test_fenced_writer_vs_migration_cutover(tmp_path, sync_points):
         assert tok.epoch > old_epoch
         assert r._serving("b").primary is not old_primary
         assert r.get(b"m88888", token=tok) == b"post-cutover"
-        assert old_primary.get(b"m88888") is None
+        # Cutover retires the replaced stack (the old primary is closed,
+        # so no late write can ever land there); reopen its directory to
+        # prove the parked write was never applied to it.
+        assert old_primary._closed
+        reopened = DB.open(old_primary.dbname,
+                           Options(create_if_missing=False))
+        try:
+            assert reopened.get(b"m88888") is None
+        finally:
+            reopened.close()
         assert r.get(b"m00042") == b"v42"
     finally:
         reg.clear_all()
-        if old_primary is not None:
-            old_primary.close()
         r.close()

@@ -73,6 +73,10 @@ class CompactionStats:
     mesh_chips: int = 0
     mesh_shards: int = 0
     mesh_fallbacks: int = 0
+    # SST payload bytes that crossed the job transport (storage/: 0 when
+    # the worker resolved inputs from the shared store and published its
+    # outputs back — the job shipped only metadata).
+    sst_bytes_shipped: int = 0
 
     def phase_dict(self) -> dict:
         """Non-zero timing phases, seconds — for bench/dcompact reporting.

@@ -49,6 +49,20 @@ def _telemetry():
     return telemetry
 
 
+def _store_spec_of(env) -> str | None:
+    """Serializable store spec a worker process can reopen: the HTTP URL
+    of a StoreClient or the root path of a LocalObjectStore. None when
+    the env has no store or its backend has no process-portable name."""
+    store = getattr(env, "store", None)
+    if store is None:
+        return None
+    url = getattr(store, "url", None)
+    if isinstance(url, str) and url:
+        return url
+    root = getattr(store, "root", None)
+    return root if isinstance(root, str) and root else None
+
+
 class CompactionExecutor:
     def execute(self, db, compaction: Compaction, snapshots: list[int],
                 new_file_number) -> tuple[list[FileMetaData], CompactionStats]:
@@ -168,6 +182,13 @@ class CompactionParams:
     # results.json so the DB stitches one end-to-end trace. None = the
     # submitting op was untraced.
     trace: dict | None = None
+    # Disaggregated-storage mode (toplingdb_tpu/storage/): when set, the
+    # worker resolves inputs by content address from the shared store
+    # (input_addrs pairs with input_files) and publishes outputs back —
+    # ZERO SST bytes cross the job transport. None = classic path mode.
+    store_spec: str | None = None
+    input_addrs: list | None = None
+    checksum_func: str | None = None  # output stamping func in store mode
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=1)
@@ -228,6 +249,12 @@ def decode_file_meta(d: dict, number: int) -> FileMetaData:
         num_range_deletions=d["num_range_deletions"],
         blob_refs=list(d.get("blob_refs", [])),
         marked_for_compaction=d.get("marked_for_compaction", False),
+        # Store-mode outputs arrive pre-stamped (the worker checksummed
+        # them for their content address) — the install path's
+        # stamp_file_checksum sees the digest and skips the re-read.
+        file_checksum=bytes.fromhex(d["file_checksum"])
+        if d.get("file_checksum") else b"",
+        file_checksum_func_name=d.get("file_checksum_func_name", ""),
     )
 
 
@@ -315,6 +342,19 @@ class SubprocessCompactionExecutor(CompactionExecutor):
         if policy is None:
             policy = getattr(db.options, "dcompact", None)
         lease_sec = policy.lease_sec if policy is not None else 30.0
+        # Store mode: when the DB runs on a SharedSstEnv and EVERY input
+        # carries a checksum address, the worker pulls inputs from the
+        # store and publishes outputs back — the job dir ships only
+        # metadata. One unstamped input (pre-upgrade file) falls back to
+        # path mode for the whole job.
+        store_spec, input_addrs = None, None
+        if hasattr(db.env, "publish_sst"):
+            from toplingdb_tpu.storage.object_store import address_of_meta
+
+            addrs = [address_of_meta(f) for _, f in compaction.all_inputs()]
+            spec = _store_spec_of(db.env)
+            if spec is not None and all(a is not None for a in addrs):
+                store_spec, input_addrs = spec, addrs
         params = CompactionParams(
             job_id=self._job_seq,
             attempt=self.attempt,
@@ -354,6 +394,10 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             ],
             lease_sec=lease_sec,
             trace=_telemetry().inject(),
+            store_spec=store_spec,
+            input_addrs=input_addrs,
+            checksum_func=(opts.file_checksum or "crc32c")
+            if store_spec else None,
         )
         with open(os.path.join(job_dir, "params.json"), "w") as f:
             f.write(params.to_json())
@@ -392,13 +436,28 @@ class SubprocessCompactionExecutor(CompactionExecutor):
             _telemetry().attach_current(results.spans)
         # Rename outputs into the DB dir under fresh file numbers
         # (reference RunRemote rename loop, compaction_job.cc:1019-1073).
+        # Store-mode outputs ADOPT instead: the bytes live in the shared
+        # store under their content address; only the reference lands here.
         outputs = []
+        shipped = 0
         for d in results.output_files:
             num = new_file_number()
             dst = filename.table_file_name(db.dbname, num)
-            os.replace(os.path.join(params.output_dir, d["path"]), dst)
+            addr = d.get("store_addr")
+            if addr and hasattr(db.env, "adopt"):
+                db.env.adopt(dst, addr)
+                try:
+                    db.env.store.unpin(addr)  # ref now shields it from GC
+                except Exception as e:  # noqa: BLE001
+                    from toplingdb_tpu.utils import errors as _errors
+
+                    _errors.swallow(reason="dcompact-adopt-unpin", exc=e)
+            else:
+                os.replace(os.path.join(params.output_dir, d["path"]), dst)
+                shipped += int(d["file_size"])
             outputs.append(decode_file_meta(d, num))
         stats = CompactionStats(**results.stats)
+        stats.sst_bytes_shipped = shipped
         stats.device = self.device
         stats.remote = True
         stats.work_time_usec = results.work_time_usec

@@ -69,12 +69,21 @@ def run_job(job_dir: str) -> int:
 
 def _run_job_inner(job_dir: str, params, t_enter: float,
                    waiting_usec: int) -> int:
+    store_mode = _StoreJobMode.maybe(params)
+    try:
+        return _run_job_body(job_dir, params, t_enter, waiting_usec,
+                             store_mode)
+    finally:
+        if store_mode is not None:
+            store_mode.cleanup()
+
+
+def _run_job_body(job_dir: str, params, t_enter: float,
+                  waiting_usec: int, store_mode) -> int:
     from toplingdb_tpu.compaction.compaction_job import (
         CompactionStats, build_outputs, surviving_tombstone_fragments,
     )
-    from toplingdb_tpu.compaction.executor import (
-        CompactionResults, encode_file_meta,
-    )
+    from toplingdb_tpu.compaction.executor import CompactionResults
     from toplingdb_tpu.compaction.picker import Compaction
     from toplingdb_tpu.db import dbformat
     from toplingdb_tpu.db.range_del import RangeDelAggregator, RangeTombstone
@@ -93,6 +102,12 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
         os._exit(137)
     t0 = time.time()
     env = default_env()
+    if store_mode is not None:
+        # Disaggregated mode: inputs resolve from the shared store by
+        # content address into a process-local scratch dir, outputs are
+        # written there and published back — the job dir (the transport)
+        # carries only params/results metadata, zero SST bytes.
+        env = store_mode.attach(env)
     if params.comparator == dbformat.BYTEWISE.name():
         ucmp = dbformat.BYTEWISE
     elif params.comparator == dbformat.REVERSE_BYTEWISE.name():
@@ -195,9 +210,7 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
         emit_phase_spans(stats)  # worker-side interior, under its root
         results = CompactionResults(
             status="ok",
-            output_files=[
-                encode_file_meta(m, f"{m.number:06d}.sst") for m in outputs
-            ],
+            output_files=_encode_outputs(outputs, env, params, store_mode),
             stats=dataclasses.asdict(stats),
             work_time_usec=stats.work_time_usec,
         )
@@ -274,9 +287,7 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
         )
     results = CompactionResults(
         status="ok",
-        output_files=[
-            encode_file_meta(m, f"{m.number:06d}.sst") for m in outputs
-        ],
+        output_files=_encode_outputs(outputs, env, params, store_mode),
         stats=dataclasses.asdict(stats),
         # Disjoint from prepare: waiting + prepare + work partition the
         # worker's wall clock (reference CompactionResults fields).
@@ -286,6 +297,86 @@ def _run_job_inner(job_dir: str, params, t_enter: float,
     with open(os.path.join(job_dir, "results.json"), "w") as f:
         f.write(results.to_json())
     return 0
+
+
+class _StoreJobMode:
+    """Disaggregated-storage job context (storage/): resolve inputs from
+    the shared store by content address, publish outputs back, pin them
+    until the DB side adopts. All SST bytes live in a process-local
+    scratch dir torn down when the job ends — never in the job dir."""
+
+    @staticmethod
+    def maybe(params):
+        return (_StoreJobMode(params) if getattr(params, "store_spec", None)
+                else None)
+
+    def __init__(self, params):
+        import tempfile
+
+        self.params = params
+        self.holder = f"dcompact-job-{params.job_id}"
+        self.scratch = tempfile.mkdtemp(
+            prefix=f"dcompact-store-{params.job_id}-")
+        self.env = None
+        self.store = None
+
+    def attach(self, base_env):
+        from toplingdb_tpu.storage import SharedSstEnv, open_store
+
+        self.store = open_store(self.params.store_spec)
+        self.env = SharedSstEnv(base_env, self.store)
+        out_dir = os.path.join(self.scratch, "out")
+        os.makedirs(out_dir, exist_ok=True)
+        local_inputs = []
+        for path, addr in zip(self.params.input_files,
+                              self.params.input_addrs):
+            lp = os.path.join(self.scratch, os.path.basename(path))
+            self.env.adopt(lp, addr)  # materializes on first open
+            local_inputs.append(lp)
+        self.params.input_files = local_inputs
+        self.params.output_dir = out_dir
+        return self.env
+
+    def publish_output(self, env, path: str, meta) -> dict:
+        """Checksum-stamp + publish one output; returns the extra keys
+        the DB side needs to adopt it (address + pre-computed digest)."""
+        from toplingdb_tpu.storage.object_store import address_of_meta
+        from toplingdb_tpu.utils.file_checksum import (
+            FileChecksumGenFactory, stamp_file_checksum,
+        )
+
+        factory = FileChecksumGenFactory(
+            getattr(self.params, "checksum_func", None) or "crc32c")
+        stamp_file_checksum(env, path, meta, factory)
+        addr = address_of_meta(meta)
+        self.store.publish_file(path, addr, src_env=env.base)
+        # Pin until the DB side's adopt makes a refs-table entry (the GC
+        # mark phase sees that); the TTL bounds a crashed primary.
+        self.store.pin(addr, self.holder)
+        return {"store_addr": addr,
+                "file_checksum": meta.file_checksum.hex(),
+                "file_checksum_func_name": meta.file_checksum_func_name}
+
+    def cleanup(self):
+        import shutil
+
+        shutil.rmtree(self.scratch, ignore_errors=True)
+        if self.env is not None:
+            self.env.close()
+
+
+def _encode_outputs(outputs, env, params, store_mode) -> list[dict]:
+    from toplingdb_tpu.compaction.executor import encode_file_meta
+
+    docs = []
+    for m in outputs:
+        name = f"{m.number:06d}.sst"
+        d = encode_file_meta(m, name)
+        if store_mode is not None:
+            d.update(store_mode.publish_output(
+                env, os.path.join(params.output_dir, name), m))
+        docs.append(d)
+    return docs
 
 
 def _append_result_spans(job_dir: str, spans: list) -> None:

@@ -598,8 +598,38 @@ class DB:
         """Reference DBImpl::Open (db/db_impl/db_impl_open.cc:1906)."""
         options = options or Options()
         env = env or default_env()
+        # Disaggregated SST storage (toplingdb_tpu/storage/): when the
+        # shared-store knob is on, wrap the env so installed tables
+        # publish to the content-addressed store and live as references.
+        # The env var wins over Options so the parity harness can flip
+        # modes without touching code.
+        import os as _os_knob
+        spec = _os_knob.environ.get("TPULSM_SHARED_STORE")
+        if spec is None:
+            spec = options.shared_store
+        owns_shared_env = False
+        from toplingdb_tpu.storage import store_spec_enabled
+        if store_spec_enabled(spec) and not hasattr(env, "publish_sst"):
+            from toplingdb_tpu.storage import SharedSstEnv, open_store
+
+            cache_dir = None
+            if isinstance(spec, str) and not spec.startswith(
+                    ("http://", "https://")):
+                cache_dir = _os_knob.path.join(spec, "cache")
+            env = SharedSstEnv(env, open_store(spec, env=env),
+                               cache_dir=cache_dir,
+                               stats=options.statistics)
+            owns_shared_env = True
+        elif hasattr(env, "publish_sst") and hasattr(env, "retain"):
+            # Reopening on a caller-supplied shared env (migration dest,
+            # checkpoint restore): co-own it — the LAST close tears down
+            # the cache/prefetch threads.
+            owns_shared_env = True
         env.create_dir(dbname)
         db = DB(dbname, options, env)
+        db._owns_shared_env = owns_shared_env
+        if owns_shared_env:
+            env.retain()
         current = filename.current_file_name(dbname)
         if env.file_exists(current):
             if options.error_if_exists:
@@ -817,6 +847,11 @@ class DB:
             if self._log_file is not None:
                 self._log_file.close()
             self._closed = True
+        # Shared-store env: DB.open retained it (knob-built or reopened
+        # on a caller-supplied one); the last release closes the
+        # warm-ring thread + persistent cache.
+        if getattr(self, "_owns_shared_env", False):
+            self.env.release()
         # Thread-lifecycle check: everything spawned with owner=self must
         # be gone by now. A leak here is a bug in a stop() path above.
         ccy.registry.join_all(owner=self, timeout=5.0)
@@ -3683,12 +3718,22 @@ class DB:
             return
         from toplingdb_tpu.utils.file_checksum import stamp_file_checksum
 
+        publish = getattr(self.env, "publish_sst", None)
         for meta in metas:
-            stamp_file_checksum(
-                self.env,
-                filename.table_file_name(self.dbname, meta.number),
-                meta, factory,
-            )
+            path = filename.table_file_name(self.dbname, meta.number)
+            stamp_file_checksum(self.env, path, meta, factory)
+            # Shared-store mode: every install (flush, compaction,
+            # ingest, import) also publishes the table to the
+            # content-addressed store. Idempotent — an already-published
+            # address (dcompact adoption) is a contains() probe.
+            if publish is not None:
+                try:
+                    publish(path, meta)
+                except Exception as e:  # noqa: BLE001 — store outage
+                    # The install stays valid on local bytes; a later
+                    # checkpoint/dcompact re-publishes (idempotent).
+                    from toplingdb_tpu.utils import errors as _errors
+                    _errors.swallow(reason="install-publish-sst", exc=e)
 
     def get_approximate_sizes(self, ranges: list[tuple[bytes, bytes]],
                               cf=None) -> list[int]:

@@ -499,3 +499,95 @@ class PartitionGate:
             if self._engaged:
                 self.blocked += 1
                 raise IOError_(f"partitioned: {what}")
+
+
+class StoreFaultInjector:
+    """Seeded fault wrapper for a shared SST object store
+    (storage/object_store.py LocalObjectStore or storage/store_server.py
+    StoreClient): interposes on the data-plane verbs so storage chaos
+    soaks reproduce exactly from a seed. Plans, decided per data op
+    ordinal (fetch/put/publish_file):
+
+      "drop"      the op raises IOError_ (an unreachable/refusing store)
+      "delay"     the op completes after `delay_sec`
+      "corrupt"   a fetch returns payload bytes with one flipped bit —
+                  the cache tier's address verification must catch it and
+                  re-fetch; a corrupt object must NEVER materialize
+      "truncate"  a fetch returns a prefix of the payload (same contract)
+
+    Writes only ever see "drop"/"delay": the store itself verifies
+    payloads before making them visible, so a corrupted upload is the
+    uploader's bug, not a transport fault. Control verbs (contains, pins,
+    list, delete, status) pass through untouched."""
+
+    def __init__(self, store, schedule: dict | None = None,
+                 rate: float = 0.0,
+                 plans: tuple = ("drop", "delay", "corrupt", "truncate"),
+                 seed: int = 0, delay_sec: float = 0.002):
+        import random
+
+        self._store = store
+        self.schedule = dict(schedule or {})
+        self.rate = rate
+        self.plans = tuple(plans)
+        self.delay_sec = delay_sec
+        self._rng = random.Random(seed)
+        self._mu = ccy.Lock("fault_injection.StoreFaultInjector._mu")
+        self._ordinal = 0
+        self.injected: list[tuple[int, str, str]] = []  # (ordinal, op, plan)
+
+    def _plan(self, op: str) -> str | None:
+        with self._mu:
+            ordinal = self._ordinal
+            self._ordinal += 1
+            p = self.schedule.get(ordinal)
+            if p is None and self.rate > 0 and self.plans:
+                if self._rng.random() < self.rate:
+                    p = self.plans[self._rng.randrange(len(self.plans))]
+            if p and op != "fetch" and p in ("corrupt", "truncate"):
+                p = "drop"  # writes can't lie (the store verifies): drop
+            if p:
+                self.injected.append((ordinal, op, p))
+            return p
+
+    def _apply(self, op: str):
+        p = self._plan(op)
+        if p == "delay":
+            import time as _t
+
+            _t.sleep(self.delay_sec)
+        elif p == "drop":
+            raise IOError_(f"injected: store {op} dropped")
+        return p
+
+    # -- data-plane verbs (faulted) ------------------------------------
+
+    def fetch(self, addr: str) -> bytes:
+        p = self._apply("fetch")
+        data = self._store.fetch(addr)
+        if p == "corrupt" and data:
+            i = self._rng.randrange(len(data))
+            return data[:i] + bytes([data[i] ^ 0x40]) + data[i + 1:]
+        if p == "truncate":
+            return data[: len(data) // 2]
+        return data
+
+    def put(self, addr: str, payload: bytes) -> bool:
+        self._apply("put")
+        return self._store.put(addr, payload)
+
+    def publish_file(self, src_path: str, addr: str, src_env=None) -> bool:
+        self._apply("publish")
+        return self._store.publish_file(src_path, addr, src_env=src_env)
+
+    # -- control verbs (clean) -----------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def injected_counts(self) -> dict:
+        with self._mu:
+            out: dict[str, int] = {}
+            for _o, _op, p in self.injected:
+                out[p] = out.get(p, 0) + 1
+            return out

@@ -227,6 +227,19 @@ class Options:
     # JSON-configurable under the "dcompact" key (utils/config.py).
     dcompact: Any = None  # DcompactOptions; None = defaults, lazily built
 
+    # -- disaggregated SST storage (toplingdb_tpu/storage/) -------------
+    # Content-addressed shared object store for SSTs, keyed by the
+    # MANIFEST-recorded whole-file checksums (requires file_checksum on).
+    # A filesystem path selects the local-directory backend, an http://
+    # URL a StoreServer, a store-shaped object passes through; None/""/"0"
+    # keeps the classic local-files path (the byte-parity oracle).
+    # Env var TPULSM_SHARED_STORE overrides at DB.open. When enabled the
+    # DB env is wrapped in SharedSstEnv: tables publish on install, live
+    # thereafter as references, and re-materialize through the persistent
+    # cache tier on first read. See ARCHITECTURE.md "Disaggregated SST
+    # storage".
+    shared_store: Any = None
+
     # -- integrity plane (utils/protection.py, utils/file_checksum.py,
     # db/integrity.py) ---------------------------------------------------
     # Per-KV protection info (reference protection_bytes_per_key,

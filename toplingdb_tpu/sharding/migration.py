@@ -158,9 +158,20 @@ class ShardMigration:
             if new_opts.statistics is None:
                 new_opts.statistics = router.stats
             new_db = DB.open(path, new_opts, env=src.env)
-            router.swap_serving(self.shard_name, new_db)
+            old = router.swap_serving(self.shard_name, new_db)
             router.unfence_shard(self.shard_name, fence_t0)
             fence_t0 = None
+            # Retire the replaced stack (swap_serving hands it back for
+            # exactly this): after cutover the old directory serves
+            # nothing, and an unclosed primary pins its shared-store env
+            # (cache + prefetch threads) forever.
+            for db in [*old.followers, old.primary]:
+                if db is new_db:
+                    continue
+                try:
+                    db.close()
+                except Exception as e2:
+                    _errors.swallow(reason="cutover-retire-old", exc=e2)
             sp.finish()
             if router.stats is not None:
                 router.stats.record_in_histogram(

@@ -1,0 +1,54 @@
+"""Disaggregated SST storage (ROADMAP item 5, PAPER.md item 2).
+
+A content-addressed object store for SSTs keyed by the integrity plane's
+whole-file checksums, an Env wrapper (`SharedSstEnv`) that lets DB
+directories hold SSTs by reference, reference-mode checkpoints/bootstrap
+(utilities/checkpoint.py), zero-SST-byte dcompact jobs
+(compaction/executor.py + worker.py), and a leased mark-sweep GC.
+
+Opt-in via `Options.shared_store` / `TPULSM_SHARED_STORE`: a filesystem
+path selects the LocalObjectStore backend, an http:// URL the
+StoreServer/StoreClient pair, and "0"/"" leaves the classic local-files
+path (the byte-parity oracle) in charge.
+"""
+
+from toplingdb_tpu.storage.gc import (  # noqa: F401
+    collect_live_addresses,
+    mark_sweep,
+)
+from toplingdb_tpu.storage.object_store import (  # noqa: F401
+    LocalObjectStore,
+    address_of_meta,
+    address_size,
+    compute_address,
+    object_address,
+    parse_address,
+    verify_payload,
+)
+from toplingdb_tpu.storage.shared_env import (  # noqa: F401
+    REFS_NAME,
+    SharedSstEnv,
+    StoreCacheTier,
+)
+from toplingdb_tpu.storage.store_server import (  # noqa: F401
+    StoreClient,
+    StoreServer,
+)
+
+
+def store_spec_enabled(spec) -> bool:
+    """Is a shared_store knob value actually ON? ("0"/""/None are off)."""
+    return bool(spec) and spec != "0"
+
+
+def open_store(spec, env=None):
+    """Build a store backend from a knob value: an existing store object
+    passes through, an http(s):// URL builds a StoreClient, anything else
+    is a LocalObjectStore root path."""
+    if not store_spec_enabled(spec):
+        raise ValueError(f"shared store disabled by spec {spec!r}")
+    if not isinstance(spec, str):
+        return spec  # already a store-shaped object
+    if spec.startswith(("http://", "https://")):
+        return StoreClient(spec)
+    return LocalObjectStore(spec, env=env)

@@ -72,14 +72,37 @@ def _checkpoint_locked(db, env, dest: str) -> None:
             verify_recorded_checksum,
         )
 
+        # Reference mode (storage/shared_env.py): when the DB runs on a
+        # SharedSstEnv, a checkpoint holds its SSTs as store references —
+        # publish (idempotent; install already did) + adopt, no bytes.
+        # Unstamped files (file_checksum='off' / pre-upgrade) still copy.
+        ref_env = hasattr(env, "publish_sst") and hasattr(env, "adopt")
         for _, f in files:
-            link_or_copy(filename.table_file_name(db.dbname, f.number),
-                         filename.table_file_name(dest, f.number))
+            src = filename.table_file_name(db.dbname, f.number)
+            dst = filename.table_file_name(dest, f.number)
+            if ref_env and f.file_checksum:
+                from toplingdb_tpu.storage.object_store import (
+                    address_of_meta,
+                )
+
+                try:
+                    addr = address_of_meta(f)
+                    if not env.store.contains(addr):
+                        # Install already published (idempotent); this
+                        # only fires for pre-store tables.
+                        env.publish_sst(src, f)
+                    if env.store.contains(addr):
+                        env.adopt(dst, addr)
+                        continue  # self-verifying: checked at first fetch
+                except Exception as e:  # noqa: BLE001 — store outage
+                    # A flaky/unreachable store must not abort the
+                    # checkpoint: degrade this file to the byte path.
+                    _errors.swallow(reason="checkpoint-ref-fallback", exc=e)
+            link_or_copy(src, dst)
             # A checkpoint must not propagate corruption: the copy is
             # re-read and compared against the MANIFEST-recorded checksum
             # (no-op for pre-upgrade files without one).
-            verify_recorded_checksum(
-                db.env, filename.table_file_name(dest, f.number), f)
+            verify_recorded_checksum(db.env, dst, f)
         # Blob files too: all present ones (deletions are excluded for the
         # duration, so every LIVE blob is here; extra not-yet-GC'd ones are
         # harmless dead weight in the snapshot).
@@ -184,9 +207,31 @@ class Checkpoint:
             except Exception as e:
                 _errors.swallow(reason="restore-dest-probe", exc=e)
         env.create_dir(dest)
+        # Reference mode (storage/shared_env.py): SSTs the checkpoint
+        # holds by reference restore as references — the bootstrap becomes
+        # a metadata swap and the bytes arrive lazily through the cache
+        # tier on first read (or eagerly via warm_refs below).
+        refs = dict(env.refs_of(self.path)) if hasattr(env, "refs_of") \
+            else {}
+        for name, addr in sorted(refs.items()):
+            env.adopt(f"{dest}/{name}", addr)
         children = [c for c in env.get_children(self.path)
-                    if c != "CURRENT"]
+                    if c != "CURRENT" and c not in refs]
+        # Hard-link fast path: same-filesystem restore of a real posix
+        # tree links instead of copying (EXDEV or any link failure falls
+        # back to the byte copy, so cross-device restores still work).
+        # (Fault-injection wrappers also expose .base — only the shared
+        # env may unwrap, or injected read faults would be linked around.)
+        from toplingdb_tpu.env.env import PosixEnv
+        base = env.base if hasattr(env, "refs_of") else env
+        can_link = type(base) is PosixEnv
         for child in children:
+            if can_link:
+                try:
+                    os.link(f"{self.path}/{child}", f"{dest}/{child}")
+                    continue
+                except OSError:
+                    pass
             try:
                 data = env.read_file(f"{self.path}/{child}")
             except (OSError, IsADirectoryError):
@@ -198,12 +243,18 @@ class Checkpoint:
         # follower's bootstrap path rides through here): every
         # MANIFEST-recorded SST checksum is recomputed on the copy, so a
         # truncated/bit-rotted restore fails HERE, not hours later.
-        try:
-            from toplingdb_tpu.utils.file_checksum import (
-                verify_dir_file_checksums,
-            )
+        # Referenced SSTs are exempt: their address IS the checksum and
+        # the cache tier verifies every fetch, so recomputing here would
+        # force the full download the reference mode exists to avoid.
+        if not refs:
+            try:
+                from toplingdb_tpu.utils.file_checksum import (
+                    verify_dir_file_checksums,
+                )
 
-            verify_dir_file_checksums(dest, env)
-        except ImportError:  # pragma: no cover
-            pass
+                verify_dir_file_checksums(dest, env)
+            except ImportError:  # pragma: no cover
+                pass
+        elif hasattr(env, "warm_refs"):
+            env.warm_refs(dest)  # fire-and-forget cache warm
         return dest

@@ -186,6 +186,10 @@ def options_from_config(cfg: dict):
             opts.compaction_executor_factory = reg.create(
                 "compaction_executor_factory", v
             )
+        elif k == "shared_store":
+            # A string spec (store root path or http:// URL) rides the
+            # JSON config; live store objects are code-only.
+            opts.shared_store = v
         elif k == "dcompact":
             from toplingdb_tpu.compaction.resilience import DcompactOptions
 
@@ -224,6 +228,9 @@ def options_to_config(opts) -> dict:
         v = getattr(opts, k)
         if v != getattr(base, k):
             out[k] = v
+    if isinstance(getattr(opts, "shared_store", None), str) \
+            and opts.shared_store:
+        out["shared_store"] = opts.shared_store
     if opts.comparator.name() == "tpulsm.ReverseBytewiseComparator":
         out["comparator"] = "reverse_bytewise"
     elif opts.comparator.name() == "tpulsm.BytewiseComparator.u64ts":
@@ -544,7 +551,9 @@ class SidePluginRepo:
         write.group.bytes histogram, async-WAL ring state),
         /replication/<name> (role/lag/applied-seq of the replication
         plane), /integrity/<name> (scrub progress, quarantined files,
-        mismatch counters — the integrity plane's view), and /metrics
+        mismatch counters — the integrity plane's view), /store/<name>
+        (disaggregated-SST-storage view: reference counts, cache tier,
+        store.* tickers), and /metrics
         (Prometheus text format over every registered DB's Statistics —
         the rockside Prometheus role). POST /promote/<name> promotes a
         registered FollowerDB to a read-write primary in place
@@ -974,6 +983,27 @@ class SidePluginRepo:
                               _st.INTEGRITY_BYTES_VERIFIED,
                               _st.INTEGRITY_CORRUPTIONS_DETECTED,
                               _st.INTEGRITY_PROTECTION_MISMATCHES)
+                }
+            return out
+        if kind == "store":
+            # Disaggregated-SST-storage view (toplingdb_tpu/storage/):
+            # per-directory reference counts, cache-tier stats, backend
+            # status, and the store.* ticker block.
+            if not hasattr(db.env, "publish_sst"):
+                return {"enabled": False}
+            out = {"enabled": True}
+            out.update(db.env.status())
+            if db.stats is not None:
+                from toplingdb_tpu.utils import statistics as _st
+
+                t = db.stats.tickers()
+                out["tickers"] = {
+                    k: t.get(k, 0)
+                    for k in (_st.STORE_HITS, _st.STORE_MISSES,
+                              _st.STORE_PUBLISHES,
+                              _st.STORE_BYTES_FETCHED,
+                              _st.STORE_GC_SWEPT,
+                              _st.STORE_FETCH_RETRIES)
                 }
             return out
         return None

@@ -198,6 +198,14 @@ BLOB_DB_GC_NUM_FILES = "blob.db.gc.num.files"
 SECONDARY_CACHE_HITS = "secondary.cache.hits"
 PERSISTENT_CACHE_HIT = "persistent.cache.hit"
 PERSISTENT_CACHE_MISS = "persistent.cache.miss"
+# -- disaggregated SST storage (toplingdb_tpu/storage/): the
+# content-addressed shared object store behind SharedSstEnv -----------
+STORE_HITS = "store.hits"                    # resident serves (cache tier)
+STORE_MISSES = "store.misses"                # cold fetches from the store
+STORE_PUBLISHES = "store.publishes"          # objects published on install
+STORE_BYTES_FETCHED = "store.bytes.fetched"  # payload bytes pulled cold
+STORE_GC_SWEPT = "store.gc.swept"            # objects removed by mark-sweep
+STORE_FETCH_RETRIES = "store.fetch.retries"  # verify/transport re-fetches
 # -- integrity plane (db/integrity.py, utils/protection.py) ----------
 INTEGRITY_SCRUB_PASSES = "integrity.scrub.passes"
 INTEGRITY_BYTES_VERIFIED = "integrity.bytes.verified"
@@ -238,6 +246,7 @@ REPLICATION_LAG_MICROS = "replication.lag.micros"  # ship→apply wall lag
 SCRUB_LATENCY_MICROS = "scrub.latency.micros"      # one scrubber pass
 SHARD_FENCE_MICROS = "shard.fence.micros"          # write-block cutover window
 SHARD_MIGRATION_MICROS = "shard.migration.micros"  # whole migration wall
+STORE_FETCH_MICROS = "store.fetch.micros"          # cold-tier object fetch
 NUM_FILES_IN_SINGLE_COMPACTION = "numfiles.in.singlecompaction"
 BYTES_PER_READ = "bytes.per.read"
 BYTES_PER_WRITE = "bytes.per.write"
