@@ -1,0 +1,629 @@
+"""Storage-pressure resilience plane (env free-space sensing, the promoted
+SstFileManager, flush/compaction preflight, no_space SOFT latch with
+autonomous recovery, red-pressure write shedding, reclaim ladder, and the
+disk-full chaos soak).
+
+Acceptance bars covered here:
+  - Env.get_free_space across Posix/Mem/wrapper envs
+  - pressure hysteresis + callbacks; paced trash deletion with the
+    trash-ratio bypass and accelerate_deletes
+  - live-DB deletion/addition paths route through the manager
+  - flush preflight refuses over-budget flushes, latches SOFT
+    reason="no_space", and AUTO-resumes once space returns — zero
+    operator resume() calls
+  - compaction preflight pauses amber-first without hot-looping
+  - manual AND auto resume() notify on_error_recovery_completed and
+    tick BG_ERROR_RESUMES
+  - SOFT→HARD escalation spawns exactly one successor recovery thread
+    and never double-resumes (runtime lock-debug on)
+  - admission + fleet front door shed writes at red (Busy / 503)
+  - disk-full soak: genuine injected ENOSPC mid-append (torn short
+    writes), merged-oracle parity for plain DB + replicated follower +
+    fleet shard server
+  - SLOSpec kind="disk_pressure" + /metrics disk gauges
+"""
+
+import threading
+import time
+
+import pytest
+
+from toplingdb_tpu.db.db import DB
+from toplingdb_tpu.env import MemEnv, PosixEnv
+from toplingdb_tpu.env.fault_injection import FaultInjectionEnv
+from toplingdb_tpu.options import Options, WriteOptions
+from toplingdb_tpu.utils import concurrency as ccy
+from toplingdb_tpu.utils import statistics as st
+from toplingdb_tpu.utils.listener import EventListener
+from toplingdb_tpu.utils.rate_limiter import SstFileManager
+from toplingdb_tpu.utils.statistics import Statistics
+from toplingdb_tpu.utils.status import (
+    Busy,
+    IOError_,
+    NoSpace,
+    Severity,
+    is_no_space,
+)
+
+
+def _wait_until(cond, timeout=15.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# Sensing: Env.get_free_space
+# ---------------------------------------------------------------------------
+
+
+def test_posix_free_space_real_and_unborn_paths(tmp_path):
+    env = PosixEnv()
+    free = env.get_free_space(str(tmp_path))
+    assert 0 < free < (1 << 61)
+    # A path that does not exist yet walks up to its closest live parent.
+    assert env.get_free_space(str(tmp_path / "not" / "yet" / "made")) > 0
+
+
+def test_mem_env_capacity_and_wrappers():
+    env = MemEnv()
+    assert env.get_free_space("/x") == 1 << 62  # unlimited by default
+    env.set_capacity(1000)
+    env.write_file("/x/a", b"z" * 300)
+    assert env.get_free_space("/x") == 700
+    fe = FaultInjectionEnv(env)
+    assert fe.get_free_space("/x") == 700  # passthrough
+    fe.set_disk_budget("*", 100)
+    assert fe.get_free_space("/x") == 100  # injected budget wins when lower
+
+
+def test_fault_env_budget_torn_write_refund_and_enospc():
+    env = MemEnv()
+    fe = FaultInjectionEnv(env)
+    fe.set_disk_budget("*", 10)
+    f = fe.new_writable_file("/d/a")
+    with pytest.raises(OSError) as ei:
+        f.append(b"x" * 25)
+    assert is_no_space(ei.value)
+    assert fe.enospc_injected == 1
+    # Torn short write: the affordable prefix landed before the failure.
+    assert env.get_file_size("/d/a") == 10
+    with pytest.raises(OSError):
+        f.sync()  # fsync on a full disk fails too
+    f.close()
+    fe.delete_file("/d/a")  # refund
+    assert fe.disk_budget_remaining("*") == 10
+    g = fe.new_writable_file("/d/b")
+    g.append(b"y" * 8)
+    g.sync()  # budget not exhausted: sync succeeds again
+    g.close()
+
+
+# ---------------------------------------------------------------------------
+# The manager: accounting, hysteresis, trash pacing
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_levels_and_hysteresis():
+    stats = Statistics()
+    m = SstFileManager(max_allowed_space_usage=1000, statistics=stats,
+                       amber_free_ratio=0.10, red_free_ratio=0.05,
+                       pressure_hysteresis=0.02)
+    seen = []
+    m.add_pressure_callback(lambda lvl, prev, info: seen.append((prev, lvl)))
+    try:
+        m.on_add_file("/x/a.sst", 850)
+        assert m.poll() == "ok"
+        m.on_add_file("/x/b.sst", 80)  # used 930 → frac 0.07 → amber
+        assert m.poll() == "amber"
+        m.on_add_file("/x/c.sst", 25)  # used 955 → frac 0.045 → red
+        assert m.poll() == "red"
+        # De-escalation needs to CLEAR the threshold plus hysteresis:
+        # frac 0.06 is above red (0.05) but inside red+hysteresis (0.07).
+        m.on_delete_file("/x/c.sst")
+        m.on_file_size("/x/b.sst", 90)  # used 940 → frac 0.06
+        assert m.poll() == "red"
+        m.on_file_size("/x/b.sst", 20)  # used 870 → frac 0.13 → ok
+        assert m.poll() == "ok"
+        assert seen == [("ok", "amber"), ("amber", "red"), ("red", "ok")]
+        assert stats.get_ticker_count(st.DISK_PRESSURE_TRANSITIONS) == 3
+        assert stats.get_ticker_count(st.DISK_PRESSURE_POLLS) == 5
+        assert stats.get_ticker_count(st.DISK_PRESSURE_POLLS_BAD) == 3
+    finally:
+        m.close()
+
+
+def test_preflight_math_reserves_flush_headroom():
+    m = SstFileManager(max_allowed_space_usage=1000,
+                       flush_headroom_bytes=200,
+                       compaction_buffer_size=100)
+    try:
+        m.on_add_file("/x/a.sst", 500)
+        # Flushes may consume the headroom: full budget applies.
+        assert m.check_flush(400)
+        assert not m.check_flush(600)
+        # Compactions must leave headroom + buffer (300) untouched.
+        assert m.check_compaction(200)
+        assert not m.check_compaction(300)
+    finally:
+        m.close()
+
+
+def test_trash_ratio_bypass_and_accelerate(tmp_path):
+    env = MemEnv()
+    for name in ("a", "b"):
+        env.write_file(f"/db/{name}.sst", b"z" * 100)
+    stats = Statistics()
+    # 1 byte/sec: a paced delete of 100 bytes would sleep ~10s (capped).
+    m = SstFileManager(bytes_per_sec_delete=1, max_trash_db_ratio=0.25,
+                       env=env, path="/db", statistics=stats)
+    try:
+        m.on_add_file("/db/a.sst", 100)
+        m.on_add_file("/db/b.sst", 100)
+        t0 = time.monotonic()
+        m.schedule_delete("/db/a.sst")  # trash 100 > 0.25*100 live → bypass
+        assert _wait_until(lambda: not env.file_exists("/db/a.sst.trash"),
+                           timeout=5.0)
+        assert time.monotonic() - t0 < 5.0  # ratio bypass skipped pacing
+        assert not env.file_exists("/db/a.sst")
+        # A paced delete wakes immediately under accelerate_deletes().
+        m.on_add_file("/db/big.sst", 100_000)  # ratio no longer trips
+        m.schedule_delete("/db/b.sst")
+        m.accelerate_deletes()
+        assert _wait_until(lambda: not env.file_exists("/db/b.sst.trash"),
+                           timeout=5.0)
+        assert stats.get_ticker_count(st.DISK_TRASH_BYTES_FREED) == 200
+        assert m.trash_size() == 0
+    finally:
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# Live-DB wiring: additions and deletions route through the manager
+# ---------------------------------------------------------------------------
+
+
+def test_live_db_deletions_seen_by_manager(tmp_path, no_thread_leaks):
+    stats = Statistics()
+    db = DB.open(str(tmp_path / "d"),
+                 Options(write_buffer_size=8 * 1024,
+                         level0_file_num_compaction_trigger=2,
+                         max_allowed_space_usage=1 << 30,
+                         statistics=stats))
+    try:
+        assert db._sfm is not None
+        for i in range(400):
+            db.put(b"k%04d" % (i % 120), b"v" * 64)
+            if i % 100 == 99:
+                db.flush()
+        db.wait_for_compactions()
+        db._sfm.wait_for_deletes()
+        tracked = dict(db._sfm._tracked)
+        assert tracked, "manager lost track of the live tree"
+        # Every tracked file exists; every obsolete SST went through
+        # schedule_delete (no stale entries for vanished files).
+        for path in tracked:
+            assert db.env.file_exists(path), f"stale tracked entry {path}"
+        live = {f"{db.dbname}/{c}" for c in db.env.get_children(db.dbname)}
+        sst_on_disk = {p for p in live if p.endswith(".sst")}
+        sst_tracked = {p for p in tracked if p.endswith(".sst")}
+        assert sst_tracked == sst_on_disk
+        assert stats.get_ticker_count(st.DISK_TRASH_BYTES_FREED) > 0
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# Degradation policy: preflight + SOFT latch + autonomous recovery
+# ---------------------------------------------------------------------------
+
+
+class _RecoveryWatch(EventListener):
+    def __init__(self):
+        self.recovered = []
+        self.pressure = []
+        self.bg_errors = []
+
+    def on_error_recovery_completed(self, db, info):
+        self.recovered.append(info)
+
+    def on_disk_pressure(self, db, info):
+        self.pressure.append((info.prev_level, info.level))
+
+    def on_background_error(self, db, e):
+        self.bg_errors.append(e)
+
+
+def test_flush_preflight_latches_soft_and_auto_resumes(tmp_path,
+                                                       no_thread_leaks):
+    stats = Statistics()
+    watch = _RecoveryWatch()
+    db = DB.open(str(tmp_path / "d"),
+                 Options(write_buffer_size=8 * 1024,
+                         disable_auto_compactions=True,
+                         max_allowed_space_usage=24 * 1024,
+                         flush_headroom_bytes=1,  # starve the headroom
+                         statistics=stats, listeners=[watch]))
+    try:
+        acked = {}
+        latched = False
+        for i in range(4000):
+            k, v = b"k%05d" % i, b"v" * 120
+            try:
+                db.put(k, v)
+                acked[k] = v
+            except Exception as e:
+                assert is_no_space(e), repr(e)
+                latched = True
+                break
+            if db._bg_error is not None:
+                latched = True
+                break
+        assert latched, "budget never tripped"
+        assert _wait_until(lambda: db._bg_error is not None, timeout=5.0)
+        assert db._bg_error_reason == "no_space"
+        assert db._bg_error_severity == Severity.SOFT_ERROR
+        assert stats.get_ticker_count(st.NO_SPACE_ERRORS) >= 1
+        assert stats.get_ticker_count(st.NO_SPACE_PREFLIGHT_BLOCKS) >= 1
+        # Operator-free recovery: GROW the budget (the "space came back"
+        # event) and the auto-recover loop must clear the latch itself.
+        db._sfm.set_max_allowed_space_usage(1 << 30)
+        assert _wait_until(lambda: db._bg_error is None, timeout=20.0), \
+            "auto-recovery never cleared the no_space latch"
+        assert stats.get_ticker_count(st.BG_ERROR_RESUMES) >= 1
+        assert any(i.auto and i.reason == "no_space"
+                   for i in watch.recovered)
+        # Zero lost acked writes.
+        bad = [k for k, v in acked.items() if db.get(k) != v]
+        assert not bad, bad[:3]
+        # Red-pressure flush headroom: the DB can still flush now.
+        db.flush()
+    finally:
+        db.close()
+
+
+def test_compaction_preflight_pauses_amber_first(tmp_path, no_thread_leaks):
+    stats = Statistics()
+    db = DB.open(str(tmp_path / "d"),
+                 Options(write_buffer_size=4 * 1024,
+                         level0_file_num_compaction_trigger=2,
+                         max_allowed_space_usage=1 << 30,
+                         statistics=stats))
+    try:
+        for i in range(200):
+            db.put(b"k%04d" % i, b"v" * 64)
+        db.flush()
+        for i in range(200):
+            db.put(b"k%04d" % i, b"w" * 64)
+        db.flush()
+        db.wait_for_compactions()
+        # Force amber and pile up L0: the scheduler must refuse to START
+        # (ticker moves) and must not hot-loop (num_completed frozen).
+        with db._sfm._mu:
+            db._sfm._level = "amber"
+        done_before = db._compaction_scheduler.num_completed
+        for i in range(200):
+            db.put(b"x%04d" % i, b"y" * 64)
+        db.flush()
+        for i in range(200):
+            db.put(b"x%04d" % i, b"z" * 64)
+        db.flush()
+        db._maybe_schedule_compaction()
+        db._compaction_scheduler.wait_idle()
+        assert stats.get_ticker_count(st.NO_SPACE_PREFLIGHT_BLOCKS) >= 1
+        assert db._compaction_scheduler.num_completed == done_before
+        # Pressure clears → compactions resume via the pressure callback.
+        with db._sfm._mu:
+            db._sfm._level = "ok"
+        db._maybe_schedule_compaction()
+        db.wait_for_compactions()
+        assert db._compaction_scheduler.num_completed > done_before
+        assert db.get(b"x0000") == b"z" * 64
+    finally:
+        db.close()
+
+
+def test_manual_resume_notifies_and_ticks(tmp_path, no_thread_leaks):
+    stats = Statistics()
+    watch = _RecoveryWatch()
+    db = DB.open(str(tmp_path / "d"),
+                 Options(statistics=stats, listeners=[watch]))
+    try:
+        err = IOError_("synthetic hard flush failure")  # not retryable
+        db._set_background_error(err, reason="wal")
+        assert db._bg_error is err
+        assert db._bg_error_severity == Severity.HARD_ERROR
+        db.resume()
+        assert db._bg_error is None
+        assert stats.get_ticker_count(st.BG_ERROR_RESUMES) == 1
+        assert [i.auto for i in watch.recovered] == [False]
+        assert watch.recovered[0].reason == "wal"
+        db.resume()  # no latch: must NOT notify or tick again
+        assert stats.get_ticker_count(st.BG_ERROR_RESUMES) == 1
+        assert len(watch.recovered) == 1
+    finally:
+        db.close()
+
+
+@pytest.fixture
+def debug_locks():
+    ccy.reset_lock_graph()
+    ccy.set_debug(True)
+    yield
+    ccy.set_debug(False)
+    ccy.reset_lock_graph()
+
+
+def test_soft_to_hard_escalation_single_successor(tmp_path, debug_locks,
+                                                  no_thread_leaks):
+    """Race satellite: a SOFT no_space latch being chased by one recovery
+    thread escalates to a HARD retryable error. Exactly one successor
+    thread may resume; the first loop must bow out at its identity check
+    — never a double resume (BG_ERROR_RESUMES == 1)."""
+    stats = Statistics()
+    watch = _RecoveryWatch()
+    db = DB.open(str(tmp_path / "d"),
+                 Options(statistics=stats, listeners=[watch],
+                         max_allowed_space_usage=1000))
+    try:
+        # Pin the manager at red so the no_space chaser parks on its
+        # headroom gate (it must never consume attempts while parked).
+        db._sfm.on_add_file("/x/fill.sst", 990)
+        db._sfm.poll()
+        assert db.disk_pressure() == "red"
+        soft = NoSpace("flush would breach budget")
+        db._set_background_error(soft, reason="no_space")
+        assert db._bg_error is soft
+        assert _wait_until(lambda: any(
+            t.name.startswith("db-auto-recover")
+            for t in threading.enumerate()), timeout=5.0)
+        # Escalate: HARD but retryable → replaces the latch, spawns ONE
+        # successor; the soft chaser exits at `is not target`.
+        hard = IOError_("wal torn tail", retryable=True)
+        db._set_background_error(hard, reason="wal")
+        assert db._bg_error is hard
+        assert db._bg_error_severity == Severity.HARD_ERROR
+        assert _wait_until(lambda: db._bg_error is None, timeout=20.0), \
+            "successor thread never resumed the HARD retryable latch"
+        # Free the manager and give the ex-chaser time to exit cleanly.
+        db._sfm.on_delete_file("/x/fill.sst")
+        db._sfm.poll()
+        assert _wait_until(lambda: not any(
+            t.name.startswith("db-auto-recover")
+            for t in threading.enumerate()), timeout=10.0)
+        assert stats.get_ticker_count(st.BG_ERROR_RESUMES) == 1
+        assert len(watch.recovered) == 1
+        assert watch.recovered[0].reason == "wal"
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# Shedding: admission + fleet front door
+# ---------------------------------------------------------------------------
+
+
+def test_admission_sheds_all_writes_at_red():
+    from toplingdb_tpu.sharding.admission import (
+        AdmissionController,
+        TenantQuota,
+    )
+
+    stats = Statistics()
+    ac = AdmissionController(default_quota=TenantQuota(), statistics=stats)
+    assert ac.admit_write("t1", 100, disk_pressure="ok") < 0.5  # admitted
+    with pytest.raises(Busy):
+        ac.admit_write("t1", 100, disk_pressure="red")
+    # Even quota-less tenants shed at red: this is capacity protection.
+    ac2 = AdmissionController(statistics=stats)
+    with pytest.raises(Busy):
+        ac2.admit_write(None, 1, disk_pressure="red")
+    assert stats.get_ticker_count(st.NO_SPACE_WRITES_SHED) == 2
+    assert stats.get_ticker_count(st.SHARD_WRITES_SHED) == 2
+
+
+def _mini_batch(key=b"k", val=b"v"):
+    import base64
+
+    from toplingdb_tpu.db.write_batch import WriteBatch
+
+    b = WriteBatch()
+    b.put(key, val)
+    return base64.b64encode(b.data()).decode()
+
+
+def test_fleet_shard_sheds_503_at_red_then_recovers(tmp_path,
+                                                    no_thread_leaks):
+    from toplingdb_tpu.sharding.fleet import ShardServer
+
+    stats = Statistics()
+    srv = ShardServer("s0", str(tmp_path / "s0"), statistics=stats,
+                      options=Options(max_allowed_space_usage=1 << 20))
+    try:
+        srv.start()
+        code, out = srv.handle_write({"epoch": 1, "batch_b64": _mini_batch()})
+        assert code == 200
+        sfm = srv.db._sfm
+        sfm.on_add_file("/x/fill.sst", (1 << 20) - 1024)
+        sfm.poll()
+        assert srv.db.disk_pressure() == "red"
+        code, out = srv.handle_write(
+            {"epoch": 1, "batch_b64": _mini_batch(b"shed")})
+        assert (code, out["error"]) == (503, "disk_pressure")
+        assert stats.get_ticker_count(st.NO_SPACE_WRITES_SHED) == 1
+        assert srv.router.get(b"shed") is None  # never reached the WAL
+        # Space returns → the front door reopens; nothing was lost.
+        sfm.on_delete_file("/x/fill.sst")
+        sfm.poll()
+        code, _ = srv.handle_write(
+            {"epoch": 1, "batch_b64": _mini_batch(b"back")})
+        assert code == 200
+        assert srv.router.get(b"k") == b"v"
+        assert srv.router.get(b"back") == b"v"
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The disk-full chaos soak
+# ---------------------------------------------------------------------------
+
+
+def test_disk_full_soak_enospc_recover_parity(tmp_path, no_thread_leaks):
+    """Fill a byte-budgeted injected filesystem until genuine ENOSPC
+    latches the DB, free space, and require: autonomous un-latch (zero
+    resume() calls), zero lost acked writes, zero resurrected failed
+    writes (merged-oracle parity), a clean follower catch-up, and a clean
+    reopen."""
+    from toplingdb_tpu.replication import FollowerDB, LocalTransport
+    from toplingdb_tpu.replication.log_shipper import LogShipper
+
+    stats = Statistics()
+    watch = _RecoveryWatch()
+    fe = FaultInjectionEnv(PosixEnv())
+    budget = 192 * 1024
+    fe.set_disk_budget("*", budget)
+    src = str(tmp_path / "d")
+    db = DB.open(src, Options(write_buffer_size=16 * 1024,
+                              level0_file_num_compaction_trigger=3,
+                              free_space_poll_period_sec=0.02,
+                              flush_headroom_bytes=32 * 1024,
+                              statistics=stats, listeners=[watch]),
+                 env=fe)
+    ship = LogShipper(db, statistics=stats)
+    oracle: dict[bytes, bytes] = {}
+    wo = WriteOptions(sync=True)
+    try:
+        hit_wall = False
+        # The live set (800 keys x 256B ~= 200KB) exceeds the budget, so
+        # trash-refund reclamation alone can never dodge the wall.
+        for i in range(6000):
+            k = b"k%05d" % (i % 800)
+            v = (b"v%06d" % i).ljust(256, b".")
+            try:
+                db.put(k, v, wo)
+                oracle[k] = v  # acked → must survive
+            except Exception as e:
+                assert is_no_space(e) or isinstance(e, Busy), repr(e)
+                hit_wall = True
+            if hit_wall and db._bg_error is not None:
+                break
+        assert hit_wall, "budget never filled"
+        assert _wait_until(lambda: db._bg_error is not None, timeout=10.0)
+        assert db._bg_error_reason == "no_space"
+        assert db._bg_error_severity == Severity.SOFT_ERROR
+        # While latched SOFT, reads still serve every acked write.
+        bad = [k for k, v in oracle.items() if db.get(k) != v]
+        assert not bad, ("read during latch", bad[:3])
+        # Space comes back (trash drain / operator): ZERO resume() calls
+        # from here on — recovery must be autonomous.
+        fe.add_disk_budget("*", 8 << 20)
+        assert _wait_until(lambda: db._bg_error is None, timeout=30.0), \
+            "no_space latch never auto-cleared after space returned"
+        assert any(i.auto and i.reason == "no_space"
+                   for i in watch.recovered)
+        assert _wait_until(lambda: db.disk_pressure() == "ok", timeout=10.0)
+        # Writes flow again; merged-oracle parity on the primary.
+        for i in range(200):
+            k, v = b"post%04d" % i, (b"p%06d" % i).ljust(256, b".")
+            db.put(k, v, wo)
+            oracle[k] = v
+        db.flush()
+        db.wait_for_compactions()
+        bad = [k for k, v in oracle.items() if db.get(k) != v]
+        assert not bad, ("post-recovery", bad[:3])
+        # Follower leg: a replica fed from the recovered primary's WAL
+        # stream converges to the same merged oracle.
+        fol = FollowerDB.open(src, Options(statistics=stats),
+                              transport=LocalTransport(ship), mode="shared")
+        try:
+            for _ in range(4):
+                fol.catch_up()
+            fbad = [k for k, v in oracle.items() if fol.get(k) != v]
+            assert not fbad, ("follower", fbad[:3])
+        finally:
+            fol.close()
+        db.close()
+        db = None
+        # Reopen on the REAL env: durability held through the chaos.
+        with DB.open(src, Options()) as db2:
+            rbad = [k for k, v in oracle.items() if db2.get(k) != v]
+            assert not rbad, ("reopen", rbad[:3])
+    finally:
+        if db is not None:
+            db.close()
+
+
+def test_db_stress_disk_budget_mode(tmp_path):
+    """Satellite: the --disk-budget stress mode runs its starve/refill
+    cycle and exits 0 (serving / SOFT-latched / cleanly-shed only)."""
+    from toplingdb_tpu.tools.db_stress import main
+
+    rc = main([f"--db={tmp_path}/sdb", "--ops=300", "--max-key=200",
+               "--write-buffer-size=16384",
+               f"--disk-budget={128 * 1024}"])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# Observability: SLO kind + /metrics gauges
+# ---------------------------------------------------------------------------
+
+
+def test_slo_disk_pressure_kind():
+    from toplingdb_tpu.utils.slo import SLOEngine, SLOSpec
+
+    stats = Statistics()
+    engine = SLOEngine(stats, [SLOSpec(name="disk", kind="disk_pressure",
+                                       objective=0.9, burn_fast=1.0,
+                                       burn_slow=1.0)],
+                       default_window_sec=10.0, clock=lambda: clock[0])
+    clock = [1000.0]
+    engine.evaluate(now=clock[0])
+    for _ in range(40):
+        stats.record_tick(st.DISK_PRESSURE_POLLS, 1)
+        stats.record_tick(st.DISK_PRESSURE_POLLS_BAD, 1)  # 100% bad
+    clock[0] += 11.0
+    out = engine.evaluate(now=clock[0])
+    assert out["specs"]["disk"]["bad_fraction_fast"] == pytest.approx(1.0)
+    assert out["specs"]["disk"]["firing"]
+
+
+def test_metrics_scrape_has_disk_gauges(tmp_path):
+    import urllib.request
+
+    from toplingdb_tpu.utils.config import SidePluginRepo
+
+    stats = Statistics()
+    db = DB.open(str(tmp_path / "d"),
+                 Options(statistics=stats,
+                         max_allowed_space_usage=1 << 30,
+                         slo_specs=({"name": "disk-ok",
+                                     "kind": "disk_pressure",
+                                     "objective": 0.9},)))
+    repo = SidePluginRepo()
+    repo.attach_db("d", db)
+    port = repo.start_http()
+    try:
+        db.put(b"k", b"v")
+        db.flush()
+        db._sfm.poll()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'tpulsm_disk_pressure_state{db="d"} 0' in text
+        assert 'tpulsm_disk_budget_bytes{db="d"}' in text
+        assert 'tpulsm_disk_tracked_bytes{db="d"}' in text
+        assert 'tpulsm_disk_free_bytes{db="d"}' in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo/d?evaluate=1", timeout=10) as r:
+            import json as _json
+
+            doc = _json.loads(r.read())
+        assert "disk-ok" in doc["specs"]
+    finally:
+        repo.stop_http()
+        db.close()

@@ -53,6 +53,9 @@ class CompactionScheduler:
         # (retry_ts, FileMetaData) of marked-rewrite jobs postponed by
         # preclude_last_level_data_seconds; re-marked once aged.
         self._preclude_remark: list = []
+        # Consecutive space-preflight refusals since the last job that ran
+        # (log the FIRST refusal of a streak, tick all of them).
+        self._space_blocks = 0
 
     # ------------------------------------------------------------------
 
@@ -212,6 +215,13 @@ class CompactionScheduler:
                     break
             if c is None:
                 return False
+            if self._space_refused(c):
+                # Nothing is marked being_compacted yet, so the exact same
+                # job stays pickable. Returning False stops the drain loop
+                # (a True here would re-pick this compaction in a hot
+                # loop); the pressure callback's _maybe_schedule_compaction
+                # re-enters once the poller sees headroom again.
+                return False
             for _, f in c.all_inputs():
                 f.being_compacted = True
         try:
@@ -222,6 +232,37 @@ class CompactionScheduler:
                     f.being_compacted = False
         with self._lock:
             self.num_completed += 1
+            self._space_blocks = 0
+        return True
+
+    def _space_refused(self, c: Compaction) -> bool:
+        """Storage-pressure preflight (reference
+        SstFileManagerImpl::EnoughRoomForCompaction): refuse to START a
+        rewriting compaction while pressure is amber/red — degradation is
+        amber-first, compactions pause before anything errors — or when
+        the estimated output (~= input bytes) would eat into the reserved
+        flush headroom / compaction buffer. FIFO deletion jobs are exempt:
+        they only free space. Manual compact_range does not route through
+        _run_one and stays operator-controlled."""
+        db = self.db
+        sfm = db._sfm
+        if sfm is None or c.reason.startswith("fifo"):
+            return False
+        est = sum(f.file_size for _, f in c.all_inputs())
+        if sfm.pressure() == "ok" and sfm.check_compaction(est):
+            return False
+        if db.stats is not None:
+            from toplingdb_tpu.utils import statistics as _st
+
+            db.stats.record_tick(_st.NO_SPACE_PREFLIGHT_BLOCKS, 1)
+        with self._lock:
+            first = self._space_blocks == 0
+            self._space_blocks += 1
+        if first:
+            db.event_logger.log(
+                "compaction_space_blocked", reason=c.reason,
+                estimated_bytes=est, pressure=sfm.pressure(),
+            )
         return True
 
     def _maybe_preclude_last_level(self, c: Compaction) -> None:
@@ -404,6 +445,13 @@ class CompactionScheduler:
             with db._mutex:
                 db.versions.log_and_apply(edit)
                 db._delete_obsolete_files()
+            if db._sfm is not None:
+                from toplingdb_tpu.db import filename as _fn
+
+                for m in outputs:
+                    db._sfm.on_add_file(
+                        _fn.table_file_name(db.dbname, m.number),
+                        m.file_size)
             from toplingdb_tpu.utils.listener import CompactionJobInfo, notify
 
             db.event_logger.log(

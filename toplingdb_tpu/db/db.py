@@ -383,6 +383,8 @@ class DB:
         self._bg_error: BaseException | None = None
         from toplingdb_tpu.utils.status import Severity as _Sev
         self._bg_error_severity = _Sev.NO_ERROR
+        self._bg_error_reason = ""
+        self._store_gc_inflight = False  # one reclaim GC sweep at a time
         self._mem_id_counter = 0
         # WritePrepared policy hook (reference SnapshotChecker): a callable
         # returning the seqno ranges of prepared-but-undecided transactions,
@@ -392,6 +394,43 @@ class DB:
         self._undecided_provider = None
         self.identity = ""
         self.stats = options.statistics  # may be None
+        # Storage-pressure plane: an SstFileManager tracking this DB's
+        # live SST+WAL+blob bytes. Caller-shared via
+        # Options.sst_file_manager, else built privately when any disk
+        # budget/poller knob is set (the common no-knob path carries None
+        # and pays nothing).
+        from toplingdb_tpu.utils.rate_limiter import SstFileManager
+        sfm = options.sst_file_manager
+        self._sfm_owned = False
+        if sfm is None and (options.max_allowed_space_usage > 0
+                            or options.free_space_poll_period_sec > 0):
+            headroom = options.flush_headroom_bytes
+            if headroom <= 0 and options.max_allowed_space_usage > 0:
+                headroom = 2 * options.write_buffer_size
+            sfm = SstFileManager(
+                env=env, path=dbname,
+                max_allowed_space_usage=options.max_allowed_space_usage,
+                compaction_buffer_size=options.compaction_buffer_size,
+                flush_headroom_bytes=headroom,
+                free_space_poll_period_sec=(
+                    options.free_space_poll_period_sec),
+                amber_free_ratio=options.disk_amber_free_ratio,
+                red_free_ratio=options.disk_red_free_ratio,
+                pressure_hysteresis=options.disk_pressure_hysteresis,
+                statistics=self.stats)
+            self._sfm_owned = True
+        elif sfm is not None:
+            # Shared manager: adopt this DB's env/root/stats only if the
+            # owner didn't already bind them.
+            if sfm._env is None:
+                sfm._env = env
+            if sfm._path is None:
+                sfm._path = dbname
+            if sfm._stats is None:
+                sfm._stats = self.stats
+        self._sfm = sfm
+        if sfm is not None:
+            sfm.add_pressure_callback(self._on_disk_pressure_change)
         from toplingdb_tpu.utils.seqno_to_time import SeqnoToTimeMapping
         from toplingdb_tpu.utils.stats_history import (
             StatsDumpScheduler, StatsHistory,
@@ -704,6 +743,18 @@ class DB:
             # Sweeping is best-effort; never blocks open.
             _errors.swallow(reason="orphan-job-sweep-on-open", exc=e,
                             stats=options.statistics)
+        if db._sfm is not None:
+            # Seed the manager with the surviving tree (recovered SSTs,
+            # blobs, the fresh WAL) so budget math starts from reality,
+            # then start the free-space poller.
+            for child in env.get_children(dbname):
+                ftype, _num = filename.parse_file_name(child)
+                if ftype in (filename.FileType.TABLE,
+                             filename.FileType.BLOB,
+                             filename.FileType.WAL):
+                    db._sfm.on_add_file(f"{dbname}/{child}")
+            db._sfm.poll()
+            db._sfm.start_poller()
         from toplingdb_tpu.compaction.scheduler import CompactionScheduler
 
         db._compaction_scheduler = CompactionScheduler(db)
@@ -781,8 +832,10 @@ class DB:
         recycle_on = self.options.recycle_log_file_num > 0
         if recycle_on and self._recycle_wals:
             old_num = self._recycle_wals.pop(0)
-            w = self.env.reuse_writable_file(
-                filename.log_file_name(self.dbname, old_num), path)
+            old_path = filename.log_file_name(self.dbname, old_num)
+            w = self.env.reuse_writable_file(old_path, path)
+            if self._sfm is not None:
+                self._sfm.on_delete_file(old_path)  # renamed onto `path`
         else:
             w = self.env.new_writable_file(path)
         if self._wal_ring is not None:
@@ -795,6 +848,8 @@ class DB:
                               recycled=recycle_on)
         if recycle_on:
             self._recyclable_written.add(self._wal_number)
+        if self._sfm is not None:
+            self._sfm.on_add_file(path, 0)  # grows; resized at switch/close
 
     def close(self) -> None:
         self._recover_stop.set()
@@ -819,6 +874,11 @@ class DB:
             self._read_batcher = None
         if self._compaction_scheduler is not None:
             self._compaction_scheduler.shutdown()
+        if self._sfm is not None and self._sfm_owned:
+            # Private manager: join its poller + trash deleters. A shared
+            # manager (Options.sst_file_manager) outlives this DB and is
+            # closed by whoever built it.
+            self._sfm.close()
         with self._mutex:
             if self._closed:
                 return
@@ -1796,6 +1856,10 @@ class DB:
         if self._wal is not None:
             self._wal.sync()
             self._wal.close()
+            if self._sfm is not None:
+                # Final size of the sealed WAL (tracked as 0 at creation).
+                self._sfm.on_add_file(filename.log_file_name(
+                    self.dbname, self._wal_number))
         for cfd in self._cfs.values():
             if not cfd.mem.empty():
                 cfd.imm.insert(0, cfd.mem)
@@ -1825,6 +1889,22 @@ class DB:
         from toplingdb_tpu.utils.sync_point import sync_point
 
         sync_point("FlushJob::Start")
+        if self._sfm is not None:
+            # Preflight: refuse to START a flush only when even the
+            # reserved flush/WAL headroom can't absorb it (flushes may
+            # spend the headroom compactions must leave alone, so a
+            # red-pressure DB still drains its memtables). A refusal
+            # latches SOFT no_space — ingest resumes when space frees.
+            est = sum(m.approximate_memory_usage() for m in mems)
+            if not self._sfm.check_flush(est):
+                if self.stats is not None:
+                    self.stats.record_tick(_st.NO_SPACE_PREFLIGHT_BLOCKS, 1)
+                from toplingdb_tpu.utils.status import NoSpace
+
+                err = NoSpace(
+                    f"flush of ~{est} bytes would breach the disk budget")
+                self._set_background_error(err, reason="no_space")
+                raise err
         from toplingdb_tpu.utils.thread_status import thread_operation
 
         with thread_operation("flush", f"cf{cf_id}", self.dbname):
@@ -1884,6 +1964,16 @@ class DB:
             self._pending_outputs.discard(fnum)
             if blob_num is not None:
                 self._pending_outputs.discard(blob_num)
+        if meta is not None and self._sfm is not None:
+            self._sfm.on_add_file(
+                filename.table_file_name(self.dbname, meta.number),
+                meta.file_size)
+            if blob_num is not None:
+                from toplingdb_tpu.db.blob import blob_file_name
+
+                bpath = blob_file_name(self.dbname, blob_num)
+                if self.env.file_exists(bpath):
+                    self._sfm.on_add_file(bpath)
         if meta is not None:
             from toplingdb_tpu.utils import statistics as st
             from toplingdb_tpu.utils.listener import FlushJobInfo, notify
@@ -3395,6 +3485,11 @@ class DB:
                     else Severity.FATAL_ERROR)
         if reason == "manifest":
             return Severity.FATAL_ERROR
+        if reason == "no_space":
+            # kNoSpace: space comes back (trash drain, store GC, operator
+            # freeing the disk) — SOFT, so the auto-recover loop clears
+            # the latch once the free-space poller sees headroom again.
+            return Severity.SOFT_ERROR
         if getattr(e, "retryable", False) and reason in (
                 "flush", "compaction"):
             return Severity.SOFT_ERROR
@@ -3407,8 +3502,22 @@ class DB:
         background work pauses, auto-recovery retries; HARD — writes raise
         until resume(); FATAL/UNRECOVERABLE (corruption, MANIFEST loss) —
         resume() refuses, the DB must be reopened."""
-        from toplingdb_tpu.utils.status import Severity
+        from toplingdb_tpu.utils.status import Severity, is_no_space
 
+        if reason != "no_space" and is_no_space(e):
+            # Re-reason a raw ENOSPC surfacing through any background
+            # path (flush, compaction, WAL sync) so it classifies SOFT
+            # and auto-recovers, mirroring the reference's kNoSpace
+            # subcode extraction in ErrorHandler::SetBGError.
+            reason = "no_space"
+        if reason == "no_space":
+            try:
+                e.retryable = True  # the recover loop's keep-retrying gate
+                e._bg_reason = "no_space"
+            except Exception as attr_err:  # __slots__-style exceptions
+                _errors.swallow(reason="bg-error-annotate", exc=attr_err)
+            if self.stats is not None:
+                self.stats.record_tick(_st.NO_SPACE_ERRORS, 1)
         sev = self._classify_bg_error(e, reason)
         with self._mutex:
             if self._bg_error is not None:
@@ -3417,9 +3526,11 @@ class DB:
                     return
                 self._bg_error = e
                 self._bg_error_severity = sev
+                self._bg_error_reason = reason
             else:
                 self._bg_error = e
                 self._bg_error_severity = sev
+                self._bg_error_reason = reason
         # Listener + auto-recovery apply to escalations too: monitoring must
         # learn the DB got WORSE, and a retryable error that replaced the
         # one a recovery thread was chasing needs a fresh thread (the old
@@ -3440,21 +3551,31 @@ class DB:
         it re-latched itself) — a concurrently latched non-retryable error,
         or a manual resume(), ends the loop untouched (reference checks the
         recovery error identity the same way)."""
-        for attempt in range(max_attempts):
-            if self._recover_stop.wait(min(base_delay * (2 ** attempt), 2.0)):
+        no_space = getattr(target, "_bg_reason", "") == "no_space" or (
+            self._bg_error is target and self._bg_error_reason == "no_space")
+        attempt = 0
+        backoff = 0  # grows on every pass, attempted or not
+        while attempt < max_attempts:
+            if self._recover_stop.wait(
+                    min(base_delay * (2 ** min(backoff, 8)), 2.0)):
                 return  # DB is closing; abandon recovery
+            backoff += 1
             with self._mutex:
                 if self._closed or self._bg_error is not target:
                     return
+            if (no_space and self._sfm is not None
+                    and not self._sfm.has_headroom()):
+                # Space hasn't come back yet (trash still draining, store
+                # GC pending, disk still full). Waiting here doesn't
+                # consume an attempt: a no_space latch clears exactly when
+                # the poller sees headroom, however long that takes.
+                continue
+            attempt += 1
             try:
-                self.resume()
+                self.resume(_auto=True)
                 self.wait_for_compactions()
                 self.event_logger.log("auto_recovery_succeeded",
-                                      attempts=attempt + 1)
-                from toplingdb_tpu.utils.listener import notify
-
-                notify(self.options.listeners, "on_error_recovery_completed",
-                       self, None)
+                                      attempts=attempt)
                 return
             except Exception as err:  # still failing
                 # ONE thread per latched error: chase only `target`. A new
@@ -3478,11 +3599,14 @@ class DB:
                 return
         self.event_logger.log("auto_recovery_gave_up", attempts=max_attempts)
 
-    def resume(self) -> None:
+    def resume(self, *, _auto: bool = False) -> None:
         """Clear a background error and restart background work (reference
         DB::Resume / ErrorHandler::RecoverFromBGError). FATAL and
         UNRECOVERABLE errors (corruption, MANIFEST loss) refuse: the DB
-        must be reopened to rebuild consistent state."""
+        must be reopened to rebuild consistent state. Clearing a live
+        latch notifies on_error_recovery_completed on BOTH the manual and
+        auto paths (previously only the auto-recover loop notified) and
+        ticks BG_ERROR_RESUMES."""
         from toplingdb_tpu.utils.status import Severity as _Sev
 
         with self._mutex:
@@ -3493,13 +3617,116 @@ class DB:
                     f"({self._bg_error_severity.name}); reopen the DB: "
                     f"{self._bg_error!r}"
                 )
+            had = self._bg_error
+            reason = self._bg_error_reason
             self._bg_error = None
             self._bg_error_severity = _Sev.NO_ERROR
+            self._bg_error_reason = ""
+        if had is not None:
+            if self.stats is not None:
+                self.stats.record_tick(_st.BG_ERROR_RESUMES, 1)
+            from toplingdb_tpu.utils.listener import (
+                ErrorRecoveryInfo, notify,
+            )
+
+            notify(self.options.listeners, "on_error_recovery_completed",
+                   self, ErrorRecoveryInfo(db_name=self.dbname,
+                                           reason=reason, auto=_auto))
         self._maybe_schedule_compaction()
 
     def _maybe_schedule_compaction(self) -> None:
         if self._compaction_scheduler is not None and not self.options.disable_auto_compactions:
             self._compaction_scheduler.maybe_schedule()
+
+    def disk_pressure(self) -> str:
+        """Current storage-pressure level ("ok" / "amber" / "red") from the
+        SstFileManager's poller; "ok" when no manager is attached. The
+        sharding admission controller and fleet write front door consult
+        this to shed writes BEFORE the disk actually fills."""
+        return self._sfm.pressure() if self._sfm is not None else "ok"
+
+    def _on_disk_pressure_change(self, level: str, prev: str,
+                                 info: dict) -> None:
+        """SstFileManager pressure-transition callback (fires outside the
+        manager's locks, on the poller thread). Escalations climb the
+        reclaim ladder; a recovery to ok restarts paused compactions."""
+        from toplingdb_tpu.utils.listener import DiskPressureInfo, notify
+
+        notify(self.options.listeners, "on_disk_pressure", self,
+               DiskPressureInfo(
+                   db_name=self.dbname, path=self.dbname, level=level,
+                   prev_level=prev,
+                   free_fraction=info.get("free_fraction", 0.0),
+                   tracked_bytes=info.get("tracked_bytes", 0),
+                   trash_bytes=info.get("trash_bytes", 0),
+                   budget_bytes=info.get("budget_bytes", 0)))
+        self.event_logger.log(
+            "disk_pressure", level=level, prev=prev,
+            free_fraction=round(info.get("free_fraction", 0.0), 4))
+        order = {"ok": 0, "amber": 1, "red": 2}
+        if order.get(level, 0) > order.get(prev, 0):
+            self._run_reclaim_ladder(level)
+        elif level == "ok":
+            self._maybe_schedule_compaction()
+
+    def _run_reclaim_ladder(self, level: str) -> None:
+        """Free bytes in escalating cost order: (1) unpace trash deletion
+        — bytes already condemned drain immediately; at red additionally
+        (2) drop the clean shared-store cache tier and (3) kick a
+        mark-sweep GC of the shared object store (own thread — the sweep
+        walks manifests and may contend on the store-gc lease)."""
+        if self._sfm is None:
+            return
+        if self.stats is not None:
+            self.stats.record_tick(_st.DISK_RECLAIM_RUNS, 1)
+        self._sfm.accelerate_deletes()
+        if level != "red":
+            return
+        tier = getattr(self.env, "tier", None)
+        if tier is not None and hasattr(tier, "prune"):
+            try:
+                tier.prune()
+            except Exception as e:
+                _errors.swallow(reason="disk-reclaim-cache-prune", exc=e,
+                                stats=self.stats)
+        store = getattr(self.env, "store", None)
+        if store is not None and not self._store_gc_inflight:
+            self._store_gc_inflight = True
+
+            def run_gc():
+                try:
+                    from toplingdb_tpu.storage.gc import mark_sweep
+
+                    # Roots: this DB plus every sibling directory that
+                    # looks like a DB (has a CURRENT) — fleet shards
+                    # share one store, and a sweep rooted only at *this*
+                    # shard would reap its neighbors' live objects. The
+                    # grace window additionally shields anything a root
+                    # scan can't see yet.
+                    import os as _os_gc
+
+                    roots = {self.dbname}
+                    parent = _os_gc.path.dirname(self.dbname)
+                    try:
+                        for child in self.env.get_children(parent or "."):
+                            d = f"{parent}/{child}" if parent else child
+                            if self.env.file_exists(
+                                    filename.current_file_name(d)):
+                                roots.add(d)
+                    except Exception as probe_err:
+                        _errors.swallow(reason="reclaim-gc-root-scan",
+                                        exc=probe_err)
+                    mark_sweep(store, sorted(roots), env=self.env,
+                               grace_sec=60.0, statistics=self.stats)
+                except Exception as e:
+                    # Busy (another sweeper holds the lease) or a mid-
+                    # sweep IO error: reclaim is best-effort by design.
+                    _errors.swallow(reason="disk-reclaim-store-gc", exc=e,
+                                    stats=self.stats)
+                finally:
+                    self._store_gc_inflight = False
+
+            ccy.spawn("disk-reclaim-store-gc", run_gc, owner=self)
 
     def disable_file_deletions(self) -> None:
         """Reference DB::DisableFileDeletions (used by backup/checkpoint
@@ -3557,12 +3784,24 @@ class DB:
             elif ftype == filename.FileType.TEMP:
                 keep = False
             if not keep:
+                path = f"{self.dbname}/{child}"
                 if ftype == filename.FileType.TABLE:
                     self.table_cache.evict(num)
                 elif ftype == filename.FileType.BLOB:
                     self.blob_source.evict(num)
+                if (self._sfm is not None
+                        and ftype in (filename.FileType.TABLE,
+                                      filename.FileType.BLOB)):
+                    # Obsolete SSTs/blobs (and store-materialized refs —
+                    # the SharedSstEnv rename/delete passthroughs keep the
+                    # local tree authoritative) go through the manager:
+                    # paced trash deletion + live-byte accounting.
+                    self._sfm.schedule_delete(path)
+                    continue
+                if self._sfm is not None:
+                    self._sfm.on_delete_file(path)
                 try:
-                    self.env.delete_file(f"{self.dbname}/{child}")
+                    self.env.delete_file(path)
                 except NotFound:
                     pass
 
@@ -3576,6 +3815,9 @@ class DB:
             self.env.rename_file(f"{self.dbname}/{child}", f"{arch}/{child}")
         except (OSError, NotFound):
             return
+        if self._sfm is not None:
+            # Archived WALs leave the tracked tree (TTL purge owns them).
+            self._sfm.on_delete_file(f"{self.dbname}/{child}")
         now = time.time()
         try:
             names = self.env.get_children(arch)

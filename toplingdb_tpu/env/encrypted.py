@@ -116,6 +116,9 @@ class EncryptedEnv(Env):
             self.base.new_sequential_file(path), self.cipher
         )
 
+    def get_free_space(self, path: str) -> int:
+        return self.base.get_free_space(path)
+
     def read_file(self, path: str) -> bytes:
         return self.cipher.crypt(self.base.read_file(path), 0)
 

@@ -137,6 +137,14 @@ class Env:
         finally:
             f.close()
 
+    def get_free_space(self, path: str) -> int:
+        """Free bytes on the filesystem holding `path`.
+
+        Envs with no real capacity notion (pure wrappers, in-memory stores
+        without a configured size) report effectively-infinite space so
+        pressure logic stays dormant until someone sets a budget."""
+        return 1 << 62
+
 
 # ---------------------------------------------------------------------------
 # Async batched I/O (the Env-level submit ring)
@@ -498,6 +506,19 @@ class PosixEnv(Env):
     def new_writable_file(self, path: str) -> WritableFile:
         return _PosixWritable(path)
 
+    def get_free_space(self, path: str) -> int:
+        p = path
+        while p and not os.path.exists(p):
+            parent = os.path.dirname(p)
+            if parent == p:
+                break
+            p = parent
+        try:
+            st = os.statvfs(p or "/")
+        except OSError as e:
+            raise IOError_(f"statvfs {path}: {e}") from e
+        return st.f_bavail * st.f_frsize
+
     def reuse_writable_file(self, old_path: str, new_path: str) -> WritableFile:
         os.replace(old_path, new_path)
         return _PosixWritable(new_path, reuse=True)
@@ -603,6 +624,7 @@ class MemEnv(Env):
         self._files: dict[str, _MemFileState] = {}
         self._dirs: set[str] = {"/"}
         self._lock = ccy.Lock("env.MemEnv._lock")
+        self._capacity = 0  # 0 = unlimited (get_free_space reports huge)
 
     def _norm(self, path: str) -> str:
         return os.path.normpath(path)
@@ -672,6 +694,18 @@ class MemEnv(Env):
         with self._lock:
             for st in self._files.values():
                 del st.data[st.synced_len :]
+
+    def set_capacity(self, nbytes: int) -> None:
+        """Simulated filesystem size; get_free_space = capacity - stored."""
+        with self._lock:
+            self._capacity = int(nbytes)
+
+    def get_free_space(self, path: str) -> int:
+        with self._lock:
+            if self._capacity <= 0:
+                return 1 << 62
+            used = sum(len(st.data) for st in self._files.values())
+            return max(0, self._capacity - used)
 
 
 _default = PosixEnv()

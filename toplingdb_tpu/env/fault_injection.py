@@ -29,6 +29,14 @@ class FaultInjectionEnv(Env):
         self._corrupt_rules: list[dict] = []
         self._corrupt_tick = 0  # transient-mode read counter
         self.corruptions_injected: list[tuple[str, int, int]] = []
+        # Disk-full injection (set_disk_budget): fnmatch pattern →
+        # remaining writable bytes. Appends charge the first matching
+        # budget; exhaustion writes the affordable PREFIX (a torn short
+        # write, exactly what a real disk does) then raises genuine
+        # OSError(ENOSPC). delete_file refunds the deleted size, so
+        # trash-deleter / GC reclamation genuinely restores headroom.
+        self._disk_budgets: dict[str, int] = {}
+        self.enospc_injected = 0
 
     # ------------------------------------------------------------------
 
@@ -116,6 +124,72 @@ class FaultInjectionEnv(Env):
                     self.corruptions_injected.append((name, offset, n_hit))
         return data if out is None else out
 
+    # -- disk-full injection (`set_disk_budget` kind) ------------------
+
+    def set_disk_budget(self, pattern: str, budget_bytes: int) -> None:
+        """Cap the bytes writable to files matching `pattern` (fnmatch
+        against the full path OR the basename — use '*' for a whole-disk
+        budget, '*.sst' to starve only table writes). Writing past the
+        budget injects a torn short write + genuine OSError(ENOSPC);
+        deleting a matching file refunds its size. get_free_space()
+        reports the remaining budget, so the SstFileManager poller sees
+        the same full disk the writers hit."""
+        with self._mu:
+            self._disk_budgets[pattern] = int(budget_bytes)
+
+    def add_disk_budget(self, pattern: str, delta: int) -> None:
+        """Grow (or shrink) an existing budget — 'the operator freed
+        space' move in a disk-full soak."""
+        with self._mu:
+            if pattern in self._disk_budgets:
+                self._disk_budgets[pattern] += int(delta)
+
+    def clear_disk_budgets(self) -> None:
+        with self._mu:
+            self._disk_budgets.clear()
+
+    def disk_budget_remaining(self, pattern: str = "*") -> int | None:
+        with self._mu:
+            return self._disk_budgets.get(pattern)
+
+    @staticmethod
+    def _disk_match(path: str, pattern: str) -> bool:
+        import fnmatch
+
+        return (fnmatch.fnmatch(path, pattern)
+                or fnmatch.fnmatch(path.rsplit("/", 1)[-1], pattern))
+
+    def _charge_disk(self, path: str, nbytes: int) -> int:
+        """Charge `nbytes` against the first matching budget; returns the
+        affordable byte count (== nbytes when no budget matches)."""
+        if nbytes <= 0:
+            return nbytes
+        with self._mu:
+            for pat, rem in self._disk_budgets.items():
+                if self._disk_match(path, pat):
+                    afford = max(0, min(nbytes, rem))
+                    self._disk_budgets[pat] = rem - afford
+                    if afford < nbytes:
+                        self.enospc_injected += 1
+                    return afford
+        return nbytes
+
+    def _refund_disk(self, path: str, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._mu:
+            for pat in self._disk_budgets:
+                if self._disk_match(path, pat):
+                    self._disk_budgets[pat] += nbytes
+                    return
+
+    def _disk_exhausted(self, path: str) -> bool:
+        with self._mu:
+            for pat, rem in self._disk_budgets.items():
+                if self._disk_match(path, pat):
+                    return rem <= 0
+        return False
+
     def _op(self, kind: str) -> None:
         with self._mu:
             self.op_count += 1
@@ -173,7 +247,24 @@ class FaultInjectionEnv(Env):
 
     def delete_file(self, path: str) -> None:
         self._op("delete")
+        freed = 0
+        if self._disk_budgets:
+            try:
+                freed = self.base.get_file_size(path)
+            except Exception as e:
+                from toplingdb_tpu.utils import errors as _errors
+
+                _errors.swallow(reason="fi-delete-size-probe", exc=e)
         self.base.delete_file(path)
+        self._refund_disk(path, freed)
+
+    def get_free_space(self, path: str) -> int:
+        free = self.base.get_free_space(path)
+        with self._mu:
+            for pat, rem in self._disk_budgets.items():
+                if self._disk_match(path, pat):
+                    return min(free, max(0, rem))
+        return free
 
     def rename_file(self, src: str, dst: str) -> None:
         self._op("rename")
@@ -194,6 +285,17 @@ class _FIWritable(WritableFile):
 
     def append(self, data: bytes) -> None:
         self._env._op("append")
+        afford = self._env._charge_disk(self._path, len(data))
+        if afford < len(data):
+            import errno
+            import os as _os
+
+            if afford > 0:
+                # Torn short write: a real disk persists the prefix that
+                # fit before failing the call.
+                self._base.append(data[:afford])
+            raise OSError(errno.ENOSPC, _os.strerror(errno.ENOSPC),
+                          self._path)
         self._base.append(data)
 
     def flush(self) -> None:
@@ -201,6 +303,14 @@ class _FIWritable(WritableFile):
 
     def sync(self) -> None:
         self._env._op("sync")
+        if self._env._disk_exhausted(self._path):
+            # fsync on a full filesystem fails too (dirty pages can't
+            # land); recovers once something refunds the budget.
+            import errno
+            import os as _os
+
+            raise OSError(errno.ENOSPC, _os.strerror(errno.ENOSPC),
+                          self._path)
         self._base.sync()
         with self._env._mu:
             self._env._unsynced[self._path] = self._base.file_size()

@@ -123,6 +123,9 @@ class IOTracingEnv(Env):
     def file_exists(self, path: str) -> bool:
         return self.base.file_exists(path)
 
+    def get_free_space(self, path: str) -> int:
+        return self.base.get_free_space(path)
+
     def get_file_size(self, path: str) -> int:
         return self.base.get_file_size(path)
 

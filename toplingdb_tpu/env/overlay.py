@@ -33,6 +33,10 @@ class OverlayEnv(Env):
         with self._mu:
             self._whiteouts.discard(path)
 
+    def get_free_space(self, path: str) -> int:
+        # New bytes land in the overlay; its filesystem is the one filling.
+        return self.overlay.get_free_space(path)
+
     # -- reads: overlay first, then base --------------------------------
 
     def new_random_access_file(self, path: str):

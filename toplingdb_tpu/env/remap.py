@@ -47,6 +47,9 @@ class RemapEnv(Env):
     def get_file_size(self, path: str) -> int:
         return self.base.get_file_size(self.remap(path))
 
+    def get_free_space(self, path: str) -> int:
+        return self.base.get_free_space(self.remap(path))
+
     def delete_file(self, path: str) -> None:
         self.base.delete_file(self.remap(path))
 

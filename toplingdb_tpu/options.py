@@ -190,6 +190,38 @@ class Options:
     # over budget, writers flush their memtables early.
     write_buffer_manager: Optional[object] = None
 
+    # -- storage pressure -----------------------------------------------
+    # Shared utils.rate_limiter.SstFileManager instance, or None to have
+    # DB.open build a private one when any pressure knob below is set
+    # (reference NewSstFileManager). Tracks live SST+WAL+blob bytes,
+    # paces trash deletion, and publishes the ok/amber/red pressure level.
+    sst_file_manager: Optional[object] = None
+    # Hard byte budget for the DB's tracked tree (reference
+    # SstFileManager::SetMaxAllowedSpaceUsage). 0 = unlimited. A flush or
+    # compaction whose estimated output would breach it refuses to start;
+    # an actual breach latches a retryable SOFT "no_space" background
+    # error that auto-resumes once space frees.
+    max_allowed_space_usage: int = 0
+    # Slack compactions must leave under the budget (reference
+    # SetCompactionBufferSize): a compaction may only start if
+    # used + estimated_output + buffer + flush headroom fits.
+    compaction_buffer_size: int = 0
+    # Bytes reserved for flush+WAL so ingest can always drain even at red
+    # pressure (flushes may consume this slice; compactions may not).
+    # 0 = auto: 2x write_buffer_size whenever a budget is set.
+    flush_headroom_bytes: int = 0
+    # Free-space poller cadence (reference SetStatsDumpPeriodSec analogue
+    # for the space poller). 0 = no poller thread; pressure only updates
+    # when something calls SstFileManager.poll() explicitly.
+    free_space_poll_period_sec: float = 0.0
+    # Pressure thresholds on the free fraction (min of budget-remaining
+    # fraction and filesystem-free fraction): <= red → "red",
+    # <= amber → "amber". De-escalation requires clearing the threshold
+    # by the hysteresis margin so the level never flaps.
+    disk_amber_free_ratio: float = 0.10
+    disk_red_free_ratio: float = 0.05
+    disk_pressure_hysteresis: float = 0.02
+
     # -- caches ---------------------------------------------------------
     # Shared block cache (utils.cache.LRUCache; optionally backed by a
     # utils.persistent_cache.PersistentCache secondary tier). None = the

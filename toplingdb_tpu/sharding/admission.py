@@ -87,11 +87,24 @@ class AdmissionController:
             self.stats.record_tick(name)
 
     def admit_write(self, tenant: str | None, nbytes: int,
-                    stall_state: str = "none") -> float:
+                    stall_state: str = "none",
+                    disk_pressure: str = "ok") -> float:
         """Admit or shed one write of `nbytes` from `tenant` against a
         shard currently in `stall_state`. Returns the seconds spent
         waiting on buckets (0.0 for the fast path); raises Busy when shed.
-        """
+
+        `disk_pressure` is the target shard's storage-pressure level
+        (DB.disk_pressure()): at "red" EVERY write is shed immediately,
+        quota or not — accepting it would push the shard into the ENOSPC
+        latch and take reads down with it. Shedding here keeps the shard
+        serving reads while the reclaim ladder frees space; callers
+        retry against the 503/Busy like any stall shed."""
+        if disk_pressure == "red":
+            self.shed_count += 1
+            self._tick(stats_mod.NO_SPACE_WRITES_SHED)
+            self._tick(stats_mod.SHARD_WRITES_SHED)
+            raise Busy(
+                f"tenant {tenant!r} shed: shard at red disk pressure")
         quota = self.quota_for(tenant)
         if quota is None:
             return 0.0

@@ -416,6 +416,14 @@ class ShardServer:
         if not self._lease_ok():
             self._tick(stats_mod.FLEET_WRITE_REJECTS)
             return 503, {"error": "lease_expired"}
+        db = self.db
+        if db is not None and db.disk_pressure() == "red":
+            # Red storage pressure: shed the write BEFORE it reaches the
+            # WAL. A 503 is retryable — the fleet router backs off while
+            # the reclaim ladder frees space; reads keep serving.
+            self._tick(stats_mod.NO_SPACE_WRITES_SHED)
+            self._tick(stats_mod.FLEET_WRITE_REJECTS)
+            return 503, {"error": "disk_pressure", "level": "red"}
         epoch = self._current_epoch()
         if int(req.get("epoch", -1)) != epoch:
             self._tick(stats_mod.FLEET_STALE_EPOCH_REJECTS)

@@ -142,6 +142,16 @@ class ShardServing:
             _errors.swallow(reason="stall-state-probe", exc=e)
             return "none"
 
+    def disk_pressure(self) -> str:
+        fn = getattr(self.primary, "disk_pressure", None)
+        if fn is None:
+            return "ok"
+        try:
+            return fn()
+        except Exception as e:
+            _errors.swallow(reason="disk-pressure-probe", exc=e)
+            return "ok"
+
     def health(self) -> dict:
         """This shard's health verdict (utils/slo.health_score rubric):
         stall state + the primary's SLO engine + open replica breakers.
@@ -282,8 +292,9 @@ class ShardRouter:
 
     def _admit(self, tenant, nbytes: int, serving: ShardServing) -> None:
         if self.admission is not None:
-            self.admission.admit_write(tenant, nbytes,
-                                       stall_state=serving.stall_state())
+            self.admission.admit_write(
+                tenant, nbytes, stall_state=serving.stall_state(),
+                disk_pressure=serving.disk_pressure())
 
     def put(self, key: bytes, value: bytes,
             opts: WriteOptions = _DEFAULT_WRITE, tenant=None) -> ShardToken:

@@ -169,6 +169,13 @@ class StoreCacheTier:
             pc = self._pcache
         return pc.stats() if pc is not None else {}
 
+    def prune(self) -> int:
+        """Disk-pressure reclaim: drop the clean cached objects (they
+        refetch from the store on demand). Returns bytes freed."""
+        with self._mu:
+            pc = self._pcache
+        return pc.prune() if pc is not None else 0
+
 
 class SharedSstEnv(Env):
     """Env wrapper that resolves referenced SSTs from a content-addressed
@@ -197,6 +204,9 @@ class SharedSstEnv(Env):
     @property
     def base(self) -> Env:
         return self._base
+
+    def get_free_space(self, path: str) -> int:
+        return self._base.get_free_space(path)
 
     def close(self) -> None:
         self.tier.close()

@@ -282,6 +282,95 @@ def run_stress(args) -> int:
     return 0
 
 
+def run_disk_budget_stress(args) -> int:
+    """--disk-budget mode: the storage-pressure invariant harness. The DB
+    runs on a FaultInjectionEnv whose writable bytes are capped; mid-run
+    the budget is slammed to zero (disk full) and later refilled (operator
+    frees space / trash drains). The invariant, checked on every op: the
+    DB is in EXACTLY one of
+      serving                    — no latch, op succeeds
+      SOFT-latched-recovering    — bg error latched, reason no_space,
+                                   severity SOFT (auto-recovery armed)
+      cleanly-shed               — op refused by a no-space-classified
+                                   error or Busy while pressure is red
+    Anything else (HARD/FATAL latch, corruption, an unclassified raise,
+    a lost acked write) fails the run. Recovery must be autonomous: this
+    harness NEVER calls resume()."""
+    import shutil
+
+    from toplingdb_tpu.db.db import DB
+    from toplingdb_tpu.env import PosixEnv
+    from toplingdb_tpu.env.fault_injection import FaultInjectionEnv
+    from toplingdb_tpu.options import Options, WriteOptions
+    from toplingdb_tpu.utils.statistics import Statistics
+    from toplingdb_tpu.utils.status import Busy, Severity, is_no_space
+
+    shutil.rmtree(args.db, ignore_errors=True)
+    fe = FaultInjectionEnv(PosixEnv())
+    budget = args.disk_budget
+    fe.set_disk_budget("*", budget)
+    opts = Options(write_buffer_size=args.write_buffer_size,
+                   free_space_poll_period_sec=0.02,
+                   flush_headroom_bytes=2 * args.write_buffer_size,
+                   statistics=Statistics())
+    db = DB.open(args.db, opts, env=fe)
+    rng = random.Random(args.seed)
+    wo = WriteOptions(sync=True)
+    model: dict[str, str] = {}
+    served = shed = 0
+    starve_at, refill_at = args.ops // 3, (2 * args.ops) // 3
+
+    def state() -> str:
+        err = db._bg_error
+        if err is not None:
+            if (db._bg_error_reason == "no_space"
+                    and db._bg_error_severity == Severity.SOFT_ERROR):
+                return "soft-latched-recovering"
+            return f"BAD-LATCH({db._bg_error_reason}," \
+                   f"{db._bg_error_severity.name})"
+        return "shedding" if db.disk_pressure() == "red" else "serving"
+
+    try:
+        for i in range(args.ops):
+            if i == starve_at:
+                fe.set_disk_budget("*", 0)
+            if i == refill_at:
+                fe.add_disk_budget("*", max(budget, 1 << 22))
+            k = "key%06d" % rng.randrange(args.max_key)
+            v = "val%010d" % rng.randrange(10 ** 9)
+            try:
+                db.put(k.encode(), v.encode(), wo)
+                model[k] = v
+                served += 1
+            except Exception as e:
+                if not (is_no_space(e) or isinstance(e, Busy)):
+                    print(f"UNCLASSIFIED FAILURE at op {i}: {e!r}")
+                    return 1
+                shed += 1
+            st = state()
+            if st.startswith("BAD-LATCH"):
+                print(f"INVARIANT VIOLATION at op {i}: {st}")
+                return 1
+        # Budget is refilled: the latch must clear with ZERO resume()
+        # calls from here, however the run ended.
+        deadline = time.monotonic() + 30.0
+        while db._bg_error is not None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if db._bg_error is not None:
+            print(f"AUTO-RECOVERY STALLED: {state()}")
+            return 1
+        bad = sum(1 for k, v in model.items()
+                  if db.get(k.encode()) != v.encode())
+        if bad:
+            print(f"PARITY FAILED: {bad} acked writes lost")
+            return 1
+        print(f"disk-budget stress OK: {served} served, {shed} shed, "
+              f"{len(model)} keys verified, state={state()}")
+        return 0
+    finally:
+        db.close()
+
+
 def run_crash_test(args) -> int:
     """Crash loop (reference tools/db_crashtest.py). Blackbox: run the
     stress child, kill -9 it at a random wall-clock moment. Whitebox
@@ -355,7 +444,11 @@ def main(argv=None) -> int:
     ap.add_argument("--whitebox", action="store_true")
     ap.add_argument("--kill-odds", type=int, default=300)
     ap.add_argument("--kill-prefix", default="")
+    # Disk-full mode: byte budget for the injected filesystem (0 = off).
+    ap.add_argument("--disk-budget", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.disk_budget > 0:
+        return run_disk_budget_stress(args)
     if args.crash_test:
         return run_crash_test(args)
     return run_stress(args)

@@ -428,6 +428,18 @@ def _prometheus_gauges(name: str, db) -> str:
                 g("slo_firing", int(row["firing"]), sl)
     except Exception as e:
         _errors.swallow(reason="prom-gauge-slo", exc=e)
+    try:
+        sfm = getattr(db, "_sfm", None)
+        if sfm is not None:
+            g("disk_free_bytes", sfm.free_space())
+            g("disk_tracked_bytes", sfm.total_size())
+            g("disk_trash_bytes", sfm.trash_size())
+            g("disk_pressure_state",
+              {"ok": 0, "amber": 1, "red": 2}.get(sfm.pressure(), -1))
+            g("disk_budget_bytes", sfm.max_allowed_space_usage)
+            g("disk_reserved_bytes", sfm.reserved_bytes())
+    except Exception as e:
+        _errors.swallow(reason="prom-gauge-disk", exc=e)
     return "\n".join(lines) + "\n" if lines else ""
 
 

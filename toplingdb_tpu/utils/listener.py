@@ -103,6 +103,32 @@ class SLOAlertInfo:
     window_slow_sec: float
 
 
+@dataclass
+class DiskPressureInfo:
+    """A disk-pressure level TRANSITION from the SstFileManager's
+    free-space poller (utils/rate_limiter.py). `level`/`prev` are one of
+    "ok" / "amber" / "red"; a red→ok recovery is also a transition."""
+
+    db_name: str
+    path: str
+    level: str
+    prev_level: str
+    free_fraction: float
+    tracked_bytes: int
+    trash_bytes: int
+    budget_bytes: int     # 0 = no max_allowed_space_usage budget set
+
+
+@dataclass
+class ErrorRecoveryInfo:
+    """A cleared background-error latch (manual resume() or the
+    auto-recover loop), reference ErrorHandler recovery notifications."""
+
+    db_name: str
+    reason: str           # the latched error's bg reason ("" if unknown)
+    auto: bool            # True when the auto-recover loop cleared it
+
+
 class EventListener:
     """Override any subset (reference EventListener)."""
 
@@ -134,6 +160,12 @@ class EventListener:
         pass
 
     def on_slo_alert(self, db, info: SLOAlertInfo) -> None:
+        pass
+
+    def on_disk_pressure(self, db, info: DiskPressureInfo) -> None:
+        pass
+
+    def on_error_recovery_completed(self, db, info: ErrorRecoveryInfo) -> None:
         pass
 
 
@@ -170,8 +202,17 @@ class EventLogger:
         line = json.dumps(rec)
         if self._sink is not None:
             with self._mu:
-                if callable(self._sink):
-                    self._sink(line)
-                else:
-                    self._sink.write(line + "\n")
+                try:
+                    if callable(self._sink):
+                        self._sink(line)
+                    else:
+                        self._sink.write(line + "\n")
+                except Exception as e:
+                    # The info LOG is best-effort, like the reference's:
+                    # a full or failing disk must not take down whatever
+                    # background thread happened to emit an event (the
+                    # disk-pressure poller, most ironically).
+                    from toplingdb_tpu.utils import errors as _errors
+
+                    _errors.swallow(reason="event-log-append", exc=e)
         return line

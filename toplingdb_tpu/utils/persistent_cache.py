@@ -324,6 +324,28 @@ class PersistentCache:
                 self._cur_f.close()
                 self._cur_f = None
 
+    def prune(self) -> int:
+        """Drop every sealed cache file (disk-pressure reclaim: everything
+        here is a clean copy of a store object, so dropping costs only
+        refetch latency). Returns bytes freed. The file being written
+        stays — its writer handle is live."""
+        freed = 0
+        with self._mu:
+            victims = [f for f in self._files if f != self._cur]
+            for old in victims:
+                self._files.remove(old)
+                self._index = {
+                    k: loc for k, loc in self._index.items()
+                    if loc[0] != old
+                }
+                freed += self._sizes.pop(old, 0)
+                self._atime.pop(old, None)
+                try:
+                    os.remove(self._fname(old))
+                except OSError:
+                    pass
+        return freed
+
     def usage(self) -> int:
         with self._mu:
             return sum(self._sizes.values())

@@ -35,7 +35,8 @@ from .listener import SLOAlertInfo, notify
 
 # The closed set of spec kinds; tools/check_telemetry.py lints literal
 # SLOSpec(kind=...) arguments against it.
-KINDS = ("latency", "fraction", "stall", "replication_lag")
+KINDS = ("latency", "fraction", "stall", "replication_lag",
+         "disk_pressure")
 
 HEALTH_GREEN = "green"
 HEALTH_DEGRADED = "degraded"
@@ -76,6 +77,12 @@ class SLOSpec:
         if self.kind == "replication_lag":
             # Sugar: a latency objective over the ship->apply lag series.
             self.histogram = _st.REPLICATION_LAG_MICROS
+        if self.kind == "disk_pressure":
+            # Sugar: a fraction objective over the free-space poller —
+            # bad events are passes that landed at amber/red, so
+            # "objective=0.99" reads "99% of polls see a healthy disk".
+            self.bad_tickers = (_st.DISK_PRESSURE_POLLS_BAD,)
+            self.total_tickers = (_st.DISK_PRESSURE_POLLS,)
         if self.kind == "fraction" and (not self.bad_tickers
                                         or not self.total_tickers):
             raise ValueError(

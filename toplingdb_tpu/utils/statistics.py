@@ -219,6 +219,17 @@ SLO_ALERTS_RESOLVED = "slo.alerts.resolved"        # recovery transitions
 STATS_DUMP_ERRORS = "stats.dump.errors"            # swallowed on_snapshot
 # -- error-policy plane (utils/errors.py) ----------------------------
 BG_ERROR_SWALLOWED = "bg.error.swallowed"          # policy-swallowed excs
+BG_ERROR_RESUMES = "bg.error.resumes"              # latch cleared (manual+auto)
+# -- storage-pressure plane (utils/rate_limiter.py SstFileManager,
+# db flush/compaction preflight, sharding admission) ------------------
+DISK_PRESSURE_POLLS = "disk.pressure.polls"            # poller passes
+DISK_PRESSURE_POLLS_BAD = "disk.pressure.polls.bad"    # passes at amber/red
+DISK_PRESSURE_TRANSITIONS = "disk.pressure.transitions"  # level changes
+DISK_RECLAIM_RUNS = "disk.reclaim.runs"                # reclaim-ladder firings
+DISK_TRASH_BYTES_FREED = "disk.trash.bytes.freed"      # paced deleter drains
+NO_SPACE_ERRORS = "no_space.errors"                    # ENOSPC/budget latches
+NO_SPACE_PREFLIGHT_BLOCKS = "no_space.preflight.blocks"  # jobs refused start
+NO_SPACE_WRITES_SHED = "no_space.writes.shed"          # admission/fleet sheds
 
 # Histogram names (reference Histograms enum families).
 DB_GET_MICROS = "db.get.micros"
@@ -276,6 +287,9 @@ GAUGE_NAMES = frozenset({
     "dcompact_chip_wedged",
     # error-policy plane (utils/errors.py, process-wide)
     "bg_error_swallowed_total",
+    # storage-pressure plane (config: per-DB SstFileManager block)
+    "disk_free_bytes", "disk_tracked_bytes", "disk_trash_bytes",
+    "disk_pressure_state", "disk_budget_bytes", "disk_reserved_bytes",
 })
 
 

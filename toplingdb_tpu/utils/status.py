@@ -97,3 +97,33 @@ class Busy(Status):
 
 class Expired(Status):
     code = Code.EXPIRED
+
+
+class NoSpace(IOError_):
+    """Out-of-disk-space IO error (reference Status::NoSpace() subcode
+    kNoSpace). Retryable by default: the error-handler latches it SOFT
+    and the auto-recover loop clears it once space frees."""
+
+    def __init__(self, msg: str = "", *, retryable: bool = True):
+        super().__init__(msg, retryable=retryable)
+
+
+def is_no_space(e: BaseException) -> bool:
+    """Does this exception chain mean the disk (or byte budget) is full?
+    Recognizes our NoSpace, a raw OSError ENOSPC anywhere in the cause
+    chain, and wrapped messages (the posix Env re-raises OSErrors as
+    IOError_ with the strerror text embedded)."""
+    import errno
+
+    seen = 0
+    while e is not None and seen < 8:
+        if isinstance(e, NoSpace):
+            return True
+        if isinstance(e, OSError) and e.errno == errno.ENOSPC:
+            return True
+        msg = str(e).lower()
+        if "enospc" in msg or "no space left" in msg:
+            return True
+        e = e.__cause__ or e.__context__
+        seen += 1
+    return False
